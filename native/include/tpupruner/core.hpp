@@ -1,0 +1,124 @@
+// tpu-pruner core domain model.
+//
+// Reference analog: gpu-pruner/src/lib.rs:36-135 (ScaleKind, ResourceKind,
+// get_enabled_resources), lib.rs:188-202 & 287-335 (Meta), lib.rs:389-427
+// (event generation), and the eligibility gates inlined in
+// gpu-pruner/src/main.rs:452-510. Pure, cluster-free, fully unit-testable
+// (reference tests: lib.rs:578-998).
+//
+// TPU-first deltas vs the reference:
+// - a sixth scalable kind, JobSet (jobset.x-k8s.io), the owner of multi-host
+//   TPU slice pods on GKE; flag char 'j'.
+// - involvedObject apiVersions are the full group/version strings (the
+//   reference emits bare "v1"/"v1beta1" for the CR kinds, lib.rs:313-314).
+// - event text is device-aware ("was not using TPU" / "... GPU").
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <string_view>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::core {
+
+// ── scalable kinds ────────────────────────────────────────────────────────
+
+enum class Kind : uint8_t {
+  Deployment,
+  ReplicaSet,
+  StatefulSet,
+  InferenceService,
+  Notebook,
+  JobSet,
+};
+
+constexpr int kNumKinds = 6;
+
+// Bitflag set over Kind (reference: bitflags ResourceKind, lib.rs:96-105).
+using ResourceSet = uint8_t;
+constexpr ResourceSet flag(Kind k) { return static_cast<ResourceSet>(1u << static_cast<int>(k)); }
+constexpr ResourceSet kAllResources = (1u << kNumKinds) - 1;
+
+// Parse "drsinj" flag chars; unknown characters are silently ignored
+// (reference: get_enabled_resources, lib.rs:116-129).
+ResourceSet parse_enabled_resources(std::string_view flags);
+
+std::string_view kind_name(Kind k);         // "Deployment", ..., "JobSet"
+std::optional<Kind> kind_from_name(std::string_view name);
+std::string_view api_version(Kind k);       // "apps/v1", "kubeflow.org/v1", ...
+std::string_view api_group(Kind k);         // "" for core/apps..., group for CRs
+std::string_view plural(Kind k);            // REST path segment, e.g. "jobsets"
+
+// ── scale targets ─────────────────────────────────────────────────────────
+
+// A root scalable object selected for scale-down. Holds the fetched object
+// as semi-structured JSON rather than typed CRD bindings (SURVEY.md §2 #10:
+// "do not hand-port 31k lines").
+struct ScaleTarget {
+  Kind kind;
+  json::Value object;  // at minimum {"metadata": {...}}
+
+  std::string name() const;
+  std::optional<std::string> ns() const;
+  std::optional<std::string> uid() const;
+  std::optional<std::string> resource_version() const;
+
+  // Identity for dedup: (kind, uid) when uid is present — the reference's
+  // uid-based Eq/Hash (lib.rs:45-82) — falling back to (kind, ns, name) for
+  // objects without uid so distinct uid-less objects stay distinct.
+  std::string identity() const;
+  bool operator==(const ScaleTarget& other) const { return identity() == other.identity(); }
+};
+
+// Drop duplicate targets, preserving first-seen order (reference:
+// HashSet<ScaleKind> collect at main.rs:534).
+std::vector<ScaleTarget> dedup_targets(std::vector<ScaleTarget> targets);
+
+// ── event generation ──────────────────────────────────────────────────────
+
+struct EventOptions {
+  std::string device = "tpu";               // "tpu" | "gpu" — reason text
+  std::string reporting_instance;           // default: $POD_NAME or "tpu-pruner"
+  std::optional<int64_t> now_unix;          // test injection; default wall clock
+};
+
+// Build the v1 Event posted before any scale action (reference:
+// generate_scale_event, lib.rs:389-427). Name "tpupruner-<32 hex>",
+// action "scale_down", type "Normal", reason
+// "Pod <ns>::<name> was not using TPU|GPU".
+json::Value generate_scale_event(const ScaleTarget& target, const EventOptions& opts = {});
+
+// ── eligibility policy ────────────────────────────────────────────────────
+
+enum class Eligibility : uint8_t {
+  Eligible,
+  Pending,        // pod phase == "Pending" (main.rs:473-483)
+  NoCreationTs,   // missing creationTimestamp (main.rs:485-492)
+  TooYoung,       // created within lookback+grace (main.rs:494-510)
+  BadTimestamp,   // creationTimestamp unparseable
+};
+
+std::string_view eligibility_name(Eligibility e);
+
+// Apply the per-pod gates from main.rs:452-510 to a Pod object.
+// `lookback_secs` = duration*60 + grace_period (main.rs:413-414).
+Eligibility check_eligibility(const json::Value& pod, int64_t now_unix, int64_t lookback_secs);
+
+// ── metric samples ────────────────────────────────────────────────────────
+
+// One decoded Prometheus series (reference: PodMetricData, lib.rs:136-145).
+// `accelerator` generalizes the reference's gpu_model: DCGM `modelName` for
+// GPUs; the GKE TPU accelerator type (e.g. "tpu-v5-lite-podslice") for TPUs.
+struct PodMetricSample {
+  std::string name;
+  std::string ns;
+  std::string container;
+  std::string node_type;
+  std::string accelerator;
+  double value = 0.0;
+};
+
+}  // namespace tpupruner::core

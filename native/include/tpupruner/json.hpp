@@ -1,0 +1,146 @@
+// tpu-pruner: minimal JSON value, parser, and serializer.
+//
+// The reference (wseaton/gpu-pruner) leans on serde_json for three jobs:
+// decoding Prometheus instant-vector responses (lib.rs:153-187), building
+// merge-patch bodies (lib.rs:521, 536-545, 563-572), and constructing K8s
+// Event objects (lib.rs:389-427). This module provides the same capability
+// natively: a small immutable-ish DOM with strict RFC 8259 parsing and
+// deterministic serialization. CR objects (Notebook, InferenceService,
+// JobSet) are handled as semi-structured Values rather than 31k lines of
+// generated bindings (SURVEY.md §2 #10).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace tpupruner::json {
+
+class Value;
+using Array = std::vector<Value>;
+// std::map keeps key order deterministic for serialization and tests.
+using Object = std::map<std::string, Value, std::less<>>;
+
+enum class Type : uint8_t { Null, Bool, Int, Double, String, Array, Object };
+
+class ParseError : public std::runtime_error {
+ public:
+  ParseError(const std::string& msg, size_t offset)
+      : std::runtime_error(msg + " at offset " + std::to_string(offset)),
+        offset_(offset) {}
+  size_t offset() const { return offset_; }
+
+ private:
+  size_t offset_;
+};
+
+class Value {
+ public:
+  Value() : type_(Type::Null) {}
+  Value(std::nullptr_t) : type_(Type::Null) {}
+  Value(bool b) : type_(Type::Bool), bool_(b) {}
+  Value(int i) : type_(Type::Int), int_(i) {}
+  Value(int64_t i) : type_(Type::Int), int_(i) {}
+  Value(uint64_t i) : type_(Type::Int), int_(static_cast<int64_t>(i)) {}
+  Value(double d) : type_(Type::Double), dbl_(d) {}
+  Value(const char* s) : type_(Type::String), str_(std::make_shared<std::string>(s)) {}
+  Value(std::string s) : type_(Type::String), str_(std::make_shared<std::string>(std::move(s))) {}
+  Value(std::string_view s) : type_(Type::String), str_(std::make_shared<std::string>(s)) {}
+  Value(Array a) : type_(Type::Array), arr_(std::make_shared<Array>(std::move(a))) {}
+  Value(Object o) : type_(Type::Object), obj_(std::make_shared<Object>(std::move(o))) {}
+
+  static Value array() { return Value(Array{}); }
+  static Value object() { return Value(Object{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_bool() const { return type_ == Type::Bool; }
+  bool is_number() const { return type_ == Type::Int || type_ == Type::Double; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_object() const { return type_ == Type::Object; }
+
+  bool as_bool() const { expect(Type::Bool); return bool_; }
+  int64_t as_int() const {
+    if (type_ == Type::Double) return static_cast<int64_t>(dbl_);
+    expect(Type::Int);
+    return int_;
+  }
+  double as_double() const {
+    if (type_ == Type::Int) return static_cast<double>(int_);
+    expect(Type::Double);
+    return dbl_;
+  }
+  const std::string& as_string() const { expect(Type::String); return *str_; }
+
+  const Array& as_array() const { expect(Type::Array); return *arr_; }
+  Array& as_array() { expect(Type::Array); return mutable_arr(); }
+  const Object& as_object() const { expect(Type::Object); return *obj_; }
+  Object& as_object() { expect(Type::Object); return mutable_obj(); }
+
+  // Object lookup: returns nullptr when absent or when *this is not an object.
+  const Value* find(std::string_view key) const {
+    if (type_ != Type::Object) return nullptr;
+    auto it = obj_->find(key);
+    return it == obj_->end() ? nullptr : &it->second;
+  }
+
+  // Dotted-path lookup, e.g. at_path("metadata.ownerReferences").
+  const Value* at_path(std::string_view path) const;
+
+  // String at key, or fallback when absent/not a string.
+  std::string get_string(std::string_view key, std::string_view fallback = "") const {
+    const Value* v = find(key);
+    return (v && v->is_string()) ? v->as_string() : std::string(fallback);
+  }
+
+  // Mutating object set (copy-on-write).
+  Value& set(std::string key, Value v) {
+    expect(Type::Object);
+    mutable_obj()[std::move(key)] = std::move(v);
+    return *this;
+  }
+  Value& push_back(Value v) {
+    expect(Type::Array);
+    mutable_arr().push_back(std::move(v));
+    return *this;
+  }
+
+  bool operator==(const Value& other) const;
+  bool operator!=(const Value& other) const { return !(*this == other); }
+
+  // Serialize. indent < 0 → compact, otherwise pretty with that indent.
+  std::string dump(int indent = -1) const;
+
+  static Value parse(std::string_view text);
+
+ private:
+  void expect(Type t) const {
+    if (type_ != t) throw std::runtime_error("json: wrong type access");
+  }
+  Array& mutable_arr() {
+    if (arr_.use_count() > 1) arr_ = std::make_shared<Array>(*arr_);
+    return *arr_;
+  }
+  Object& mutable_obj() {
+    if (obj_.use_count() > 1) obj_ = std::make_shared<Object>(*obj_);
+    return *obj_;
+  }
+
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double dbl_ = 0.0;
+  std::shared_ptr<std::string> str_;
+  std::shared_ptr<Array> arr_;
+  std::shared_ptr<Object> obj_;
+};
+
+// Escape a string for embedding in JSON output (without surrounding quotes).
+std::string escape(std::string_view s);
+
+}  // namespace tpupruner::json

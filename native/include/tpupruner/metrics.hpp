@@ -1,0 +1,33 @@
+// Metric-sample decoding: Prometheus instant-query response → pod samples.
+//
+// Reference analog: PodMetricData + TryFrom<&InstantVector>
+// (gpu-pruner/src/lib.rs:136-187) and the per-cycle series dedup
+// (main.rs:416-437). Pure JSON-in/structs-out; the HTTP client lives in
+// http.hpp / prom.hpp.
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "tpupruner/core.hpp"
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::metrics {
+
+struct DecodeResult {
+  std::vector<core::PodMetricSample> samples;  // unique by (pod, namespace)
+  size_t num_series = 0;                       // raw series count pre-dedup
+  std::vector<std::string> errors;             // per-series decode failures
+};
+
+// Decode {"status":"success","data":{"resultType":"vector","result":[...]}}.
+// Tolerates both native and exported_* label names (lib.rs:161-175).
+// device == "gpu" requires the DCGM modelName label (hard error per series,
+// lib.rs:180-183); device == "tpu" reads accelerator_type/node_type labels
+// with "unknown" fallbacks (GKE label enrichment may be disabled).
+// Throws std::runtime_error when the response is not a success/vector
+// payload (the reference panics via into_vector().expect, main.rs:405-409 —
+// here it is a typed error feeding the daemon's failure budget).
+DecodeResult decode_instant_vector(const json::Value& response, const std::string& device);
+
+}  // namespace tpupruner::metrics

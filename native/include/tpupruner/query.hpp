@@ -1,0 +1,58 @@
+// tpu-pruner idle-workload query builders.
+//
+// Reference analog: gpu-pruner/src/query.promql.j2 (rendered once at startup,
+// main.rs:280-282). The reference renders a Jinja template; here the same
+// query semantics are produced by native builders with a backend seam
+// (SURVEY.md §7.2): one source per device class.
+//
+// Shared query shape (the reference's contract, asserted by its template
+// tests at main.rs:572-740):
+//   - peak (max_over_time), never average, over the lookback window;
+//   - a primary utilization metric with a normalized (/100) fallback,
+//     combined with `or`;
+//   - optional node-type enrichment join with a bare fallback (`or`) so
+//     series still match when the node-info metric is absent;
+//   - `== 0` idle predicate on the peak;
+//   - an optional corroborating `unless` clause that rescues workloads the
+//     utilization metric misses (GPU: peak power draw >= threshold W;
+//     TPU: peak HBM bandwidth utilization >= threshold);
+//   - honor_labels switch between native (pod/namespace/container) and
+//     Prometheus-prefixed (exported_*) label names.
+//
+// TPU source specifics: `tensorcore_utilization` (0-1, v5e+) is the primary
+// signal with `tensorcore_duty_cycle` (0-100, all generations) as the /100
+// fallback — mirroring DCGM_FI_PROF_GR_ENGINE_ACTIVE vs DCGM_FI_DEV_GPU_UTIL.
+// Node-type enrichment joins `kube_node_labels` on the node label and lifts
+// `cloud.google.com/gke-tpu-accelerator` into `node_type` (the analog of the
+// node_dmi_info product_name join). Metric names are overridable because GMP
+// relabeling differs across clusters.
+#pragma once
+
+#include <optional>
+#include <string>
+
+namespace tpupruner::query {
+
+struct QueryArgs {
+  std::string device = "tpu";  // "tpu" | "gpu"
+  int64_t duration_min = 30;   // lookback window (reference -t/--duration)
+
+  std::string namespace_regex;    // pattern pushed into every selector
+  std::string model_regex;        // GPU model filter (DCGM modelName)
+  std::string accelerator_regex;  // TPU accelerator-type filter
+
+  std::optional<double> power_threshold;  // GPU corroboration, watts
+  std::optional<double> hbm_threshold;    // TPU corroboration, HBM bw util (0-1)
+
+  bool honor_labels = false;
+
+  // TPU metric-name overrides (GMP export names vary by cluster config).
+  std::string tensorcore_metric = "tensorcore_utilization";
+  std::string duty_cycle_metric = "tensorcore_duty_cycle";
+  std::string hbm_metric = "hbm_memory_bandwidth_utilization";
+};
+
+// Build the instant-query PromQL for the configured source.
+std::string build_idle_query(const QueryArgs& args);
+
+}  // namespace tpupruner::query

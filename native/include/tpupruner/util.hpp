@@ -1,0 +1,49 @@
+// tpu-pruner: small shared utilities (time, ids, strings, files).
+//
+// Covers the reference's uses of jiff (Timestamp::now, SignedDuration —
+// main.rs:413-414, lib.rs:391-402) and uuid (event names, lib.rs:390,412)
+// without external dependencies.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace tpupruner::util {
+
+// Unix epoch seconds (wall clock, UTC).
+int64_t now_unix();
+
+// Format epoch seconds (+ optional subsecond digits of `nanos`) as RFC 3339
+// UTC, e.g. "2026-07-29T07:47:45Z" / "2026-07-29T07:47:45.123456Z".
+std::string format_rfc3339(int64_t unix_secs, int64_t nanos = 0, int subsec_digits = 0);
+
+// Current time as RFC 3339 with microsecond precision (K8s MicroTime shape).
+std::string now_rfc3339_micro();
+// Current time as RFC 3339 with second precision (K8s Time shape).
+std::string now_rfc3339();
+
+// Parse RFC 3339 (e.g. K8s creationTimestamp "2026-07-29T07:47:45Z",
+// fractional seconds and numeric offsets accepted). Returns epoch seconds.
+std::optional<int64_t> parse_rfc3339(std::string_view s);
+
+// 32 hex chars from the system CSPRNG, like uuid::Uuid::new_v4().as_simple()
+// in the reference (lib.rs:390, 412).
+std::string random_hex32();
+
+std::vector<std::string> split(std::string_view s, char sep);
+std::string to_lower(std::string_view s);
+bool starts_with(std::string_view s, std::string_view prefix);
+std::string trim(std::string_view s);
+
+std::optional<std::string> read_file(const std::string& path);
+
+// Getenv as optional<string>.
+std::optional<std::string> env(const char* name);
+
+// URL-encode for application/x-www-form-urlencoded bodies / query strings.
+std::string url_encode(std::string_view s);
+
+}  // namespace tpupruner::util

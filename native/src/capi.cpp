@@ -1,0 +1,212 @@
+// C API over the pure domain functions, for the Python test tiers (ctypes).
+//
+// Every function takes a JSON (or plain) C string and returns a
+// heap-allocated JSON C string the caller frees with tp_free. Errors come
+// back as {"error": "..."} so test assertions can target messages.
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "tpupruner/core.hpp"
+#include "tpupruner/json.hpp"
+#include "tpupruner/metrics.hpp"
+#include "tpupruner/query.hpp"
+
+using tpupruner::json::Value;
+namespace core = tpupruner::core;
+
+namespace {
+
+char* dup_cstr(const std::string& s) {
+  char* out = static_cast<char*>(::malloc(s.size() + 1));
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+char* ok(const Value& v) { return dup_cstr(v.dump()); }
+
+char* err(const std::string& msg) {
+  Value v = Value::object();
+  v.set("error", Value(msg));
+  return ok(v);
+}
+
+template <typename Fn>
+char* guarded(Fn&& fn) {
+  try {
+    return fn();
+  } catch (const std::exception& e) {
+    return err(e.what());
+  } catch (...) {
+    return err("unknown error");
+  }
+}
+
+std::string checked_device(const std::string& d) {
+  if (d != "tpu" && d != "gpu")
+    throw std::runtime_error("unknown device: " + d + " (expected tpu|gpu)");
+  return d;
+}
+
+core::ScaleTarget target_from_json(const Value& v) {
+  const Value* kind = v.find("kind");
+  if (!kind || !kind->is_string()) throw std::runtime_error("target missing kind");
+  auto k = core::kind_from_name(kind->as_string());
+  if (!k) throw std::runtime_error("unknown kind: " + kind->as_string());
+  const Value* object = v.find("object");
+  return core::ScaleTarget{*k, object ? *object : Value::object()};
+}
+
+Value meta_to_json(const core::ScaleTarget& t) {
+  Value out = Value::object();
+  out.set("kind", Value(std::string(core::kind_name(t.kind))));
+  out.set("name", Value(t.name()));
+  out.set("apiVersion", Value(std::string(core::api_version(t.kind))));
+  out.set("plural", Value(std::string(core::plural(t.kind))));
+  auto set_opt = [&](const char* key, const std::optional<std::string>& v) {
+    out.set(key, v ? Value(*v) : Value(nullptr));
+  };
+  set_opt("namespace", t.ns());
+  set_opt("uid", t.uid());
+  set_opt("resourceVersion", t.resource_version());
+  out.set("identity", Value(t.identity()));
+  return out;
+}
+
+tpupruner::query::QueryArgs query_args_from_json(const Value& v) {
+  tpupruner::query::QueryArgs a;
+  if (const Value* x = v.find("device"); x && x->is_string()) a.device = x->as_string();
+  if (const Value* x = v.find("duration"); x && x->is_number()) a.duration_min = x->as_int();
+  if (const Value* x = v.find("namespace"); x && x->is_string()) a.namespace_regex = x->as_string();
+  if (const Value* x = v.find("model_name"); x && x->is_string()) a.model_regex = x->as_string();
+  if (const Value* x = v.find("accelerator_type"); x && x->is_string())
+    a.accelerator_regex = x->as_string();
+  if (const Value* x = v.find("power_threshold"); x && x->is_number())
+    a.power_threshold = x->as_double();
+  if (const Value* x = v.find("hbm_threshold"); x && x->is_number())
+    a.hbm_threshold = x->as_double();
+  if (const Value* x = v.find("honor_labels"); x && x->is_bool()) a.honor_labels = x->as_bool();
+  if (const Value* x = v.find("tensorcore_metric"); x && x->is_string())
+    a.tensorcore_metric = x->as_string();
+  if (const Value* x = v.find("duty_cycle_metric"); x && x->is_string())
+    a.duty_cycle_metric = x->as_string();
+  if (const Value* x = v.find("hbm_metric"); x && x->is_string()) a.hbm_metric = x->as_string();
+  return a;
+}
+
+}  // namespace
+
+extern "C" {
+
+void tp_free(void* p) { ::free(p); }
+
+char* tp_version(const char*) {
+  Value v = Value::object();
+  v.set("version", Value("0.1.0"));
+  return ok(v);
+}
+
+char* tp_build_query(const char* args_json) {
+  return guarded([&] {
+    Value args = Value::parse(args_json);
+    Value out = Value::object();
+    out.set("query", Value(tpupruner::query::build_idle_query(query_args_from_json(args))));
+    return ok(out);
+  });
+}
+
+char* tp_enabled_resources(const char* flags_json) {
+  return guarded([&] {
+    Value flags = Value::parse(flags_json);
+    core::ResourceSet set = core::parse_enabled_resources(flags.as_string());
+    Value kinds = Value::array();
+    for (int i = 0; i < core::kNumKinds; ++i) {
+      core::Kind k = static_cast<core::Kind>(i);
+      if (set & core::flag(k)) kinds.push_back(Value(std::string(core::kind_name(k))));
+    }
+    Value out = Value::object();
+    out.set("kinds", std::move(kinds));
+    return ok(out);
+  });
+}
+
+char* tp_decode_samples(const char* payload_json) {
+  return guarded([&] {
+    Value payload = Value::parse(payload_json);
+    const Value* response = payload.find("response");
+    if (!response) throw std::runtime_error("missing response");
+    std::string device = checked_device(payload.get_string("device", "tpu"));
+    auto result = tpupruner::metrics::decode_instant_vector(*response, device);
+
+    Value samples = Value::array();
+    for (const auto& s : result.samples) {
+      Value sv = Value::object();
+      sv.set("name", Value(s.name));
+      sv.set("namespace", Value(s.ns));
+      sv.set("container", Value(s.container));
+      sv.set("node_type", Value(s.node_type));
+      sv.set("accelerator", Value(s.accelerator));
+      sv.set("value", Value(s.value));
+      samples.push_back(std::move(sv));
+    }
+    Value errors = Value::array();
+    for (const auto& e : result.errors) errors.push_back(Value(e));
+    Value out = Value::object();
+    out.set("samples", std::move(samples));
+    out.set("num_series", Value(static_cast<int64_t>(result.num_series)));
+    out.set("errors", std::move(errors));
+    return ok(out);
+  });
+}
+
+char* tp_generate_event(const char* payload_json) {
+  return guarded([&] {
+    Value payload = Value::parse(payload_json);
+    const Value* target_v = payload.find("target");
+    if (!target_v) throw std::runtime_error("missing target");
+    core::ScaleTarget target = target_from_json(*target_v);
+
+    core::EventOptions opts;
+    opts.device = checked_device(payload.get_string("device", "tpu"));
+    if (const Value* now = payload.find("now"); now && now->is_number())
+      opts.now_unix = now->as_int();
+    return ok(core::generate_scale_event(target, opts));
+  });
+}
+
+char* tp_check_eligibility(const char* payload_json) {
+  return guarded([&] {
+    Value payload = Value::parse(payload_json);
+    const Value* pod = payload.find("pod");
+    if (!pod) throw std::runtime_error("missing pod");
+    const Value* now = payload.find("now_unix");
+    const Value* lookback = payload.find("lookback_secs");
+    if (!now || !lookback) throw std::runtime_error("missing now_unix/lookback_secs");
+    core::Eligibility e = core::check_eligibility(*pod, now->as_int(), lookback->as_int());
+    Value out = Value::object();
+    out.set("result", Value(std::string(core::eligibility_name(e))));
+    out.set("eligible", Value(e == core::Eligibility::Eligible));
+    return ok(out);
+  });
+}
+
+char* tp_dedup_targets(const char* targets_json) {
+  return guarded([&] {
+    Value targets = Value::parse(targets_json);
+    std::vector<core::ScaleTarget> parsed;
+    for (const Value& t : targets.as_array()) parsed.push_back(target_from_json(t));
+    Value out = Value::array();
+    for (const core::ScaleTarget& t : core::dedup_targets(std::move(parsed))) {
+      out.push_back(meta_to_json(t));
+    }
+    return ok(out);
+  });
+}
+
+char* tp_target_meta(const char* target_json) {
+  return guarded([&] {
+    return ok(meta_to_json(target_from_json(Value::parse(target_json))));
+  });
+}
+
+}  // extern "C"

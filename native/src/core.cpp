@@ -1,0 +1,194 @@
+#include "tpupruner/core.hpp"
+
+#include <unordered_set>
+
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::core {
+
+ResourceSet parse_enabled_resources(std::string_view flags) {
+  ResourceSet set = 0;
+  for (char c : flags) {
+    switch (c) {
+      case 'd': set |= flag(Kind::Deployment); break;
+      case 'r': set |= flag(Kind::ReplicaSet); break;
+      case 's': set |= flag(Kind::StatefulSet); break;
+      case 'i': set |= flag(Kind::InferenceService); break;
+      case 'n': set |= flag(Kind::Notebook); break;
+      case 'j': set |= flag(Kind::JobSet); break;
+      default: break;  // unknown characters are silently ignored (lib.rs:125)
+    }
+  }
+  return set;
+}
+
+std::string_view kind_name(Kind k) {
+  switch (k) {
+    case Kind::Deployment: return "Deployment";
+    case Kind::ReplicaSet: return "ReplicaSet";
+    case Kind::StatefulSet: return "StatefulSet";
+    case Kind::InferenceService: return "InferenceService";
+    case Kind::Notebook: return "Notebook";
+    case Kind::JobSet: return "JobSet";
+  }
+  return "";
+}
+
+std::optional<Kind> kind_from_name(std::string_view name) {
+  for (int i = 0; i < kNumKinds; ++i) {
+    Kind k = static_cast<Kind>(i);
+    if (kind_name(k) == name) return k;
+  }
+  return std::nullopt;
+}
+
+std::string_view api_version(Kind k) {
+  switch (k) {
+    case Kind::Deployment:
+    case Kind::ReplicaSet:
+    case Kind::StatefulSet: return "apps/v1";
+    case Kind::InferenceService: return "serving.kserve.io/v1beta1";
+    case Kind::Notebook: return "kubeflow.org/v1";
+    case Kind::JobSet: return "jobset.x-k8s.io/v1alpha2";
+  }
+  return "";
+}
+
+std::string_view api_group(Kind k) {
+  switch (k) {
+    case Kind::Deployment:
+    case Kind::ReplicaSet:
+    case Kind::StatefulSet: return "apps";
+    case Kind::InferenceService: return "serving.kserve.io";
+    case Kind::Notebook: return "kubeflow.org";
+    case Kind::JobSet: return "jobset.x-k8s.io";
+  }
+  return "";
+}
+
+std::string_view plural(Kind k) {
+  switch (k) {
+    case Kind::Deployment: return "deployments";
+    case Kind::ReplicaSet: return "replicasets";
+    case Kind::StatefulSet: return "statefulsets";
+    case Kind::InferenceService: return "inferenceservices";
+    case Kind::Notebook: return "notebooks";
+    case Kind::JobSet: return "jobsets";
+  }
+  return "";
+}
+
+namespace {
+std::optional<std::string> meta_string(const json::Value& object, std::string_view key) {
+  const json::Value* v = object.at_path("metadata");
+  if (!v) return std::nullopt;
+  const json::Value* s = v->find(key);
+  if (!s || !s->is_string()) return std::nullopt;
+  return s->as_string();
+}
+}  // namespace
+
+std::string ScaleTarget::name() const { return meta_string(object, "name").value_or(""); }
+std::optional<std::string> ScaleTarget::ns() const { return meta_string(object, "namespace"); }
+std::optional<std::string> ScaleTarget::uid() const { return meta_string(object, "uid"); }
+std::optional<std::string> ScaleTarget::resource_version() const {
+  return meta_string(object, "resourceVersion");
+}
+
+std::string ScaleTarget::identity() const {
+  std::string id(kind_name(kind));
+  id.push_back('/');
+  if (auto u = uid()) {
+    id += "uid:";
+    id += *u;
+  } else {
+    id += "name:";
+    id += ns().value_or("");
+    id.push_back('/');
+    id += name();
+  }
+  return id;
+}
+
+std::vector<ScaleTarget> dedup_targets(std::vector<ScaleTarget> targets) {
+  std::unordered_set<std::string> seen;
+  std::vector<ScaleTarget> out;
+  out.reserve(targets.size());
+  for (ScaleTarget& t : targets) {
+    if (seen.insert(t.identity()).second) out.push_back(std::move(t));
+  }
+  return out;
+}
+
+json::Value generate_scale_event(const ScaleTarget& target, const EventOptions& opts) {
+  int64_t now = opts.now_unix.value_or(util::now_unix());
+  std::string now_s = util::format_rfc3339(now);
+  std::string now_micro =
+      opts.now_unix ? util::format_rfc3339(now, 0, 6) : util::now_rfc3339_micro();
+
+  std::string reporting_instance = opts.reporting_instance;
+  if (reporting_instance.empty()) {
+    // intended to be set via downward-API pushdown (lib.rs:393-395)
+    reporting_instance = util::env("POD_NAME").value_or("tpu-pruner");
+  }
+
+  std::string ns = target.ns().value_or("");
+  std::string device_upper = opts.device == "gpu" ? "GPU" : "TPU";
+
+  json::Value involved = json::Value::object();
+  involved.set("apiVersion", json::Value(std::string(api_version(target.kind))));
+  involved.set("kind", json::Value(std::string(kind_name(target.kind))));
+  involved.set("name", json::Value(target.name()));
+  if (auto n = target.ns()) involved.set("namespace", json::Value(*n));
+  if (auto rv = target.resource_version()) involved.set("resourceVersion", json::Value(*rv));
+  if (auto u = target.uid()) involved.set("uid", json::Value(*u));
+
+  json::Value metadata = json::Value::object();
+  metadata.set("name", json::Value("tpupruner-" + util::random_hex32()));
+  if (auto n = target.ns()) metadata.set("namespace", json::Value(*n));
+
+  json::Value event = json::Value::object();
+  event.set("apiVersion", json::Value("v1"));
+  event.set("kind", json::Value("Event"));
+  event.set("metadata", std::move(metadata));
+  event.set("involvedObject", std::move(involved));
+  event.set("action", json::Value("scale_down"));
+  event.set("type", json::Value("Normal"));
+  event.set("reason",
+            json::Value("Pod " + ns + "::" + target.name() + " was not using " + device_upper));
+  event.set("reportingComponent", json::Value("tpu-pruner"));
+  event.set("reportingInstance", json::Value(reporting_instance));
+  event.set("firstTimestamp", json::Value(now_s));
+  event.set("lastTimestamp", json::Value(now_s));
+  event.set("eventTime", json::Value(now_micro));
+  return event;
+}
+
+std::string_view eligibility_name(Eligibility e) {
+  switch (e) {
+    case Eligibility::Eligible: return "eligible";
+    case Eligibility::Pending: return "pending";
+    case Eligibility::NoCreationTs: return "no_creation_timestamp";
+    case Eligibility::TooYoung: return "too_young";
+    case Eligibility::BadTimestamp: return "bad_timestamp";
+  }
+  return "";
+}
+
+Eligibility check_eligibility(const json::Value& pod, int64_t now_unix, int64_t lookback_secs) {
+  const json::Value* phase = pod.at_path("status.phase");
+  if (phase && phase->is_string() && phase->as_string() == "Pending") {
+    return Eligibility::Pending;
+  }
+  const json::Value* created = pod.at_path("metadata.creationTimestamp");
+  if (!created || !created->is_string()) return Eligibility::NoCreationTs;
+  auto created_unix = util::parse_rfc3339(created->as_string());
+  if (!created_unix) return Eligibility::BadTimestamp;
+  // A pod created at or after (now - lookback) hasn't had the chance to show
+  // `duration` minutes of idleness yet — the grace mechanism (main.rs:494-510).
+  int64_t lookback_start = now_unix - lookback_secs;
+  if (*created_unix >= lookback_start) return Eligibility::TooYoung;
+  return Eligibility::Eligible;
+}
+
+}  // namespace tpupruner::core

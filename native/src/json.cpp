@@ -1,0 +1,374 @@
+#include "tpupruner/json.hpp"
+
+#include <array>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace tpupruner::json {
+
+namespace {
+
+struct Parser {
+  std::string_view text;
+  size_t pos = 0;
+
+  [[noreturn]] void fail(const std::string& msg) { throw ParseError(msg, pos); }
+
+  char peek() {
+    if (pos >= text.size()) fail("unexpected end of input");
+    return text[pos];
+  }
+  char next() {
+    char c = peek();
+    ++pos;
+    return c;
+  }
+  bool eof() const { return pos >= text.size(); }
+
+  void skip_ws() {
+    while (pos < text.size()) {
+      char c = text[pos];
+      if (c == ' ' || c == '\t' || c == '\n' || c == '\r') {
+        ++pos;
+      } else {
+        break;
+      }
+    }
+  }
+
+  void expect_lit(std::string_view lit) {
+    if (text.substr(pos, lit.size()) != lit) fail("invalid literal");
+    pos += lit.size();
+  }
+
+  Value parse_value(int depth) {
+    if (depth > 256) fail("nesting too deep");
+    skip_ws();
+    char c = peek();
+    switch (c) {
+      case '{': return parse_object(depth);
+      case '[': return parse_array(depth);
+      case '"': return Value(parse_string());
+      case 't': expect_lit("true"); return Value(true);
+      case 'f': expect_lit("false"); return Value(false);
+      case 'n': expect_lit("null"); return Value(nullptr);
+      default: return parse_number();
+    }
+  }
+
+  Value parse_object(int depth) {
+    next();  // '{'
+    Object obj;
+    skip_ws();
+    if (peek() == '}') {
+      next();
+      return Value(std::move(obj));
+    }
+    while (true) {
+      skip_ws();
+      if (peek() != '"') fail("expected object key");
+      std::string key = parse_string();
+      skip_ws();
+      if (next() != ':') fail("expected ':'");
+      obj[std::move(key)] = parse_value(depth + 1);
+      skip_ws();
+      char c = next();
+      if (c == '}') break;
+      if (c != ',') fail("expected ',' or '}'");
+    }
+    return Value(std::move(obj));
+  }
+
+  Value parse_array(int depth) {
+    next();  // '['
+    Array arr;
+    skip_ws();
+    if (peek() == ']') {
+      next();
+      return Value(std::move(arr));
+    }
+    while (true) {
+      arr.push_back(parse_value(depth + 1));
+      skip_ws();
+      char c = next();
+      if (c == ']') break;
+      if (c != ',') fail("expected ',' or ']'");
+    }
+    return Value(std::move(arr));
+  }
+
+  std::string parse_string() {
+    next();  // '"'
+    std::string out;
+    while (true) {
+      char c = next();
+      if (c == '"') break;
+      if (static_cast<unsigned char>(c) < 0x20) fail("control character in string");
+      if (c != '\\') {
+        out.push_back(c);
+        continue;
+      }
+      char esc = next();
+      switch (esc) {
+        case '"': out.push_back('"'); break;
+        case '\\': out.push_back('\\'); break;
+        case '/': out.push_back('/'); break;
+        case 'b': out.push_back('\b'); break;
+        case 'f': out.push_back('\f'); break;
+        case 'n': out.push_back('\n'); break;
+        case 'r': out.push_back('\r'); break;
+        case 't': out.push_back('\t'); break;
+        case 'u': {
+          unsigned cp = parse_hex4();
+          if (cp >= 0xDC00 && cp <= 0xDFFF) fail("unpaired low surrogate");
+          if (cp >= 0xD800 && cp <= 0xDBFF) {
+            // surrogate pair
+            if (pos + 1 < text.size() && text[pos] == '\\' && text[pos + 1] == 'u') {
+              pos += 2;
+              unsigned lo = parse_hex4();
+              if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              } else {
+                fail("invalid low surrogate");
+              }
+            } else {
+              fail("unpaired surrogate");
+            }
+          }
+          append_utf8(out, cp);
+          break;
+        }
+        default: fail("invalid escape");
+      }
+    }
+    return out;
+  }
+
+  unsigned parse_hex4() {
+    unsigned v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = next();
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= static_cast<unsigned>(c - '0');
+      else if (c >= 'a' && c <= 'f') v |= static_cast<unsigned>(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') v |= static_cast<unsigned>(c - 'A' + 10);
+      else fail("invalid \\u escape");
+    }
+    return v;
+  }
+
+  static void append_utf8(std::string& out, unsigned cp) {
+    if (cp < 0x80) {
+      out.push_back(static_cast<char>(cp));
+    } else if (cp < 0x800) {
+      out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else {
+      out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    }
+  }
+
+  Value parse_number() {
+    // RFC 8259 grammar: -?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][+-]?[0-9]+)?
+    size_t start = pos;
+    auto digits = [&]() {
+      size_t n = 0;
+      while (!eof() && isdigit(static_cast<unsigned char>(text[pos]))) ++pos, ++n;
+      return n;
+    };
+    if (!eof() && text[pos] == '-') ++pos;
+    if (eof() || !isdigit(static_cast<unsigned char>(text[pos]))) fail("bad number");
+    if (text[pos] == '0') {
+      ++pos;
+      if (!eof() && isdigit(static_cast<unsigned char>(text[pos]))) fail("leading zero");
+    } else {
+      digits();
+    }
+    bool is_double = false;
+    if (!eof() && text[pos] == '.') {
+      is_double = true;
+      ++pos;
+      if (digits() == 0) fail("digits required after '.'");
+    }
+    if (!eof() && (text[pos] == 'e' || text[pos] == 'E')) {
+      is_double = true;
+      ++pos;
+      if (!eof() && (text[pos] == '+' || text[pos] == '-')) ++pos;
+      if (digits() == 0) fail("digits required in exponent");
+    }
+    std::string num(text.substr(start, pos - start));
+    try {
+      if (!is_double) {
+        try {
+          return Value(static_cast<int64_t>(std::stoll(num)));
+        } catch (const std::out_of_range&) {
+          // magnitude exceeds int64 — fall through to double
+        }
+      }
+      return Value(std::stod(num));
+    } catch (const std::exception&) {
+      fail("bad number");
+    }
+  }
+};
+
+void dump_impl(const Value& v, std::string& out, int indent, int depth) {
+  auto newline = [&](int d) {
+    if (indent >= 0) {
+      out.push_back('\n');
+      out.append(static_cast<size_t>(indent * d), ' ');
+    }
+  };
+  switch (v.type()) {
+    case Type::Null: out += "null"; break;
+    case Type::Bool: out += v.as_bool() ? "true" : "false"; break;
+    case Type::Int: out += std::to_string(v.as_int()); break;
+    case Type::Double: {
+      double d = v.as_double();
+      if (std::isnan(d) || std::isinf(d)) {
+        out += "null";  // JSON has no NaN/Inf
+      } else {
+        char buf[32];
+        snprintf(buf, sizeof(buf), "%.17g", d);
+        // trim to shortest round-trip-ish representation
+        double rt = std::strtod(buf, nullptr);
+        char shorter[32];
+        for (int prec = 1; prec < 17; ++prec) {
+          snprintf(shorter, sizeof(shorter), "%.*g", prec, d);
+          if (std::strtod(shorter, nullptr) == rt) {
+            std::memcpy(buf, shorter, sizeof(shorter));
+            break;
+          }
+        }
+        out += buf;
+      }
+      break;
+    }
+    case Type::String:
+      out.push_back('"');
+      out += escape(v.as_string());
+      out.push_back('"');
+      break;
+    case Type::Array: {
+      const Array& a = v.as_array();
+      if (a.empty()) {
+        out += "[]";
+        break;
+      }
+      out.push_back('[');
+      bool first = true;
+      for (const Value& e : a) {
+        if (!first) out.push_back(',');
+        first = false;
+        newline(depth + 1);
+        dump_impl(e, out, indent, depth + 1);
+      }
+      newline(depth);
+      out.push_back(']');
+      break;
+    }
+    case Type::Object: {
+      const Object& o = v.as_object();
+      if (o.empty()) {
+        out += "{}";
+        break;
+      }
+      out.push_back('{');
+      bool first = true;
+      for (const auto& [k, e] : o) {
+        if (!first) out.push_back(',');
+        first = false;
+        newline(depth + 1);
+        out.push_back('"');
+        out += escape(k);
+        out += indent >= 0 ? "\": " : "\":";
+        dump_impl(e, out, indent, depth + 1);
+      }
+      newline(depth);
+      out.push_back('}');
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+std::string escape(std::string_view s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out.push_back(c);
+        }
+    }
+  }
+  return out;
+}
+
+const Value* Value::at_path(std::string_view path) const {
+  const Value* cur = this;
+  size_t start = 0;
+  while (start <= path.size()) {
+    size_t dot = path.find('.', start);
+    std::string_view key =
+        dot == std::string_view::npos ? path.substr(start) : path.substr(start, dot - start);
+    cur = cur->find(key);
+    if (!cur) return nullptr;
+    if (dot == std::string_view::npos) break;
+    start = dot + 1;
+  }
+  return cur;
+}
+
+bool Value::operator==(const Value& other) const {
+  if (is_number() && other.is_number()) {
+    if (type_ == Type::Int && other.type_ == Type::Int) return int_ == other.int_;
+    return as_double() == other.as_double();
+  }
+  if (type_ != other.type_) return false;
+  switch (type_) {
+    case Type::Null: return true;
+    case Type::Bool: return bool_ == other.bool_;
+    case Type::String: return *str_ == *other.str_;
+    case Type::Array: return *arr_ == *other.arr_;
+    case Type::Object: return *obj_ == *other.obj_;
+    default: return false;  // unreachable
+  }
+}
+
+std::string Value::dump(int indent) const {
+  std::string out;
+  dump_impl(*this, out, indent, 0);
+  return out;
+}
+
+Value Value::parse(std::string_view text) {
+  Parser p{text};
+  Value v = p.parse_value(0);
+  p.skip_ws();
+  if (!p.eof()) throw ParseError("trailing characters", p.pos);
+  return v;
+}
+
+}  // namespace tpupruner::json

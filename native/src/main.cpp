@@ -1,0 +1,11 @@
+// tpu-pruner daemon entry point (reference analog: gpu-pruner/src/main.rs:273).
+// Grows subcommands: default daemon/single-shot run, plus `querytest`
+// (reference: gpu-pruner/src/bin/querytest.rs).
+#include <cstdio>
+
+int main(int argc, char** argv) {
+  (void)argc;
+  (void)argv;
+  std::fprintf(stderr, "tpu-pruner: daemon not wired yet (scaffolding build)\n");
+  return 2;
+}

@@ -1,0 +1,104 @@
+#include "tpupruner/metrics.hpp"
+
+#include <stdexcept>
+#include <unordered_set>
+
+namespace tpupruner::metrics {
+
+namespace {
+
+// Label lookup with the exported_*/native fallback chain (lib.rs:161-175).
+const std::string* label(const json::Value& metric, const std::string& exported,
+                         const std::string& native) {
+  const json::Value* v = metric.find(exported);
+  if (v && v->is_string()) return &v->as_string();
+  v = metric.find(native);
+  if (v && v->is_string()) return &v->as_string();
+  return nullptr;
+}
+
+}  // namespace
+
+DecodeResult decode_instant_vector(const json::Value& response, const std::string& device) {
+  const json::Value* status = response.find("status");
+  if (!status || !status->is_string() || status->as_string() != "success") {
+    std::string err = response.get_string("error", "unknown error");
+    throw std::runtime_error("prometheus query failed: " + err);
+  }
+  const json::Value* rtype = response.at_path("data.resultType");
+  if (!rtype || !rtype->is_string() || rtype->as_string() != "vector") {
+    throw std::runtime_error("expected vector response from prometheus");
+  }
+  const json::Value* result = response.at_path("data.result");
+  if (!result || !result->is_array()) {
+    throw std::runtime_error("malformed vector response: missing data.result");
+  }
+
+  DecodeResult out;
+  out.num_series = result->as_array().size();
+  // Dedup by (pod, namespace): multi-chip pods emit one series per chip but
+  // the owner chain only needs resolving once (main.rs:416-437).
+  std::unordered_set<std::string> seen;
+
+  for (const json::Value& series : result->as_array()) {
+    const json::Value* metric = series.find("metric");
+    if (!metric || !metric->is_object()) {
+      out.errors.push_back("series missing metric labels");
+      continue;
+    }
+    const std::string* pod = label(*metric, "exported_pod", "pod");
+    if (!pod) {
+      out.errors.push_back("the data for key `exported_pod/pod` is not available");
+      continue;
+    }
+    const std::string* ns = label(*metric, "exported_namespace", "namespace");
+    if (!ns) {
+      out.errors.push_back("the data for key `exported_namespace/namespace` is not available");
+      continue;
+    }
+    const std::string* container = label(*metric, "exported_container", "container");
+    if (!container) {
+      out.errors.push_back("the data for key `exported_container/container` is not available");
+      continue;
+    }
+
+    core::PodMetricSample sample;
+    sample.name = *pod;
+    sample.ns = *ns;
+    sample.container = *container;
+    sample.node_type = metric->get_string("node_type", "unknown");
+
+    if (device == "gpu") {
+      const json::Value* model = metric->find("modelName");
+      if (!model || !model->is_string()) {
+        out.errors.push_back("the data for key `modelName` is not available");
+        continue;
+      }
+      sample.accelerator = model->as_string();
+    } else {
+      // GKE TPU label enrichment is optional; never reject a series for it.
+      sample.accelerator = metric->get_string("accelerator_type", "unknown");
+    }
+
+    // value: [<unix ts>, "<string float>"]
+    const json::Value* value = series.find("value");
+    if (!value || !value->is_array() || value->as_array().size() != 2) {
+      out.errors.push_back("series missing sample value");
+      continue;
+    }
+    const json::Value& v = value->as_array()[1];
+    try {
+      sample.value = v.is_string() ? std::stod(v.as_string()) : v.as_double();
+    } catch (const std::exception&) {
+      out.errors.push_back("unparseable sample value for pod " + sample.name);
+      continue;
+    }
+
+    if (seen.insert(sample.ns + "/" + sample.name).second) {
+      out.samples.push_back(std::move(sample));
+    }
+  }
+  return out;
+}
+
+}  // namespace tpupruner::metrics

@@ -1,0 +1,143 @@
+#include "tpupruner/query.hpp"
+
+#include <sstream>
+#include <stdexcept>
+
+namespace tpupruner::query {
+
+namespace {
+
+// Label names switch on honor_labels exactly as in the reference template
+// (query.promql.j2:1-7): honorLabels scrape configs keep the exporter's own
+// pod/namespace/container labels; default Prometheus configs prefix them.
+struct Labels {
+  std::string pod, ns, container;
+  explicit Labels(bool honor)
+      : pod(honor ? "pod" : "exported_pod"),
+        ns(honor ? "namespace" : "exported_namespace"),
+        container(honor ? "container" : "exported_container") {}
+};
+
+std::string fmt_threshold(double v) {
+  std::ostringstream oss;
+  oss << v;
+  return oss.str();
+}
+
+// Escape a user-supplied regex for embedding in a double-quoted PromQL
+// string literal (Go string escape rules): backslashes and quotes double.
+std::string promql_string_escape(std::string_view s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    if (c == '\\' || c == '"') out.push_back('\\');
+    out.push_back(c);
+  }
+  return out;
+}
+
+// The reference's Jinja `{% if args.power_threshold %}` treats 0 as falsy
+// (query.promql.j2:36): a zero threshold means "no corroboration clause",
+// never an always-true `>= 0` clause.
+bool threshold_set(const std::optional<double>& t) { return t && *t != 0.0; }
+
+// One metric selector: {<pod> != "", <ns> =~ "...", <extra> =~ "..."}.
+std::string selector(const Labels& l, const QueryArgs& a, const std::string& extra_label,
+                     const std::string& extra_regex) {
+  std::string s = "{\n      " + l.pod + " != \"\"";
+  if (!a.namespace_regex.empty())
+    s += ", " + l.ns + " =~ \"" + promql_string_escape(a.namespace_regex) + "\"";
+  if (!extra_label.empty() && !extra_regex.empty())
+    s += ", " + extra_label + " =~ \"" + promql_string_escape(extra_regex) + "\"";
+  s += "\n    }";
+  return s;
+}
+
+std::string window(const QueryArgs& a) {
+  return "[" + std::to_string(a.duration_min) + "m]";
+}
+
+// The shared skeleton: enriched-or-bare idle block, == 0 predicate, optional
+// unless corroboration (query.promql.j2:23-44 semantics).
+std::string assemble(const std::string& idle_block, const std::string& group_labels,
+                     const std::string& enrich_join, const std::string& unless_clause) {
+  std::string q = "(\n  " + idle_block + " " + enrich_join + "\n  or on (" + group_labels +
+                  ")\n  " + idle_block + "\n)\n== 0";
+  if (!unless_clause.empty()) q += "\n" + unless_clause;
+  return q;
+}
+
+std::string build_tpu_query(const QueryArgs& a) {
+  Labels l(a.honor_labels);
+  // Per-chip series keyed by node + chip id + accelerator type; summed per
+  // (pod, chip) the same way the reference sums per (pod, gpu).
+  std::string group_labels = "node, " + l.container + ", " + l.pod + ", " + l.ns +
+                             ", accelerator_id, accelerator_type";
+  std::string sel = selector(l, a, "accelerator_type", a.accelerator_regex);
+
+  std::string idle_block = "sum by (" + group_labels + ") (\n    max_over_time(" +
+                           a.tensorcore_metric + sel + window(a) + ")\n    or\n    max_over_time(" +
+                           a.duty_cycle_metric + sel + window(a) + ") / 100\n)";
+
+  // Enrichment: lift the GKE TPU accelerator node label into node_type via
+  // kube_node_labels (kube-state-metrics), joined on the node label — the
+  // TPU analog of the reference's node_dmi_info/product_name join.
+  std::string enrich_join =
+      "* on (node) group_left(node_type) (\n"
+      "    label_replace(\n"
+      "      kube_node_labels{label_cloud_google_com_gke_tpu_accelerator != \"\"},\n"
+      "      \"node_type\", \"$1\", \"label_cloud_google_com_gke_tpu_accelerator\", \"(.+)\"\n"
+      "    )\n"
+      "  )";
+
+  std::string unless_clause;
+  if (threshold_set(a.hbm_threshold)) {
+    // HBM traffic corroboration: a workload streaming from HBM is not idle
+    // even if tensorcore peak reads zero (infeed-bound phases, host
+    // offload). Analog of the reference's power clause (query.promql.j2:36-44).
+    unless_clause = "unless on (" + l.pod + ", " + l.ns + ")\n(\n  max_over_time(" + a.hbm_metric +
+                    selector(l, a, "", "") + window(a) + ") >= " + fmt_threshold(*a.hbm_threshold) +
+                    "\n)";
+  }
+  return assemble(idle_block, group_labels, enrich_join, unless_clause);
+}
+
+std::string build_gpu_query(const QueryArgs& a) {
+  Labels l(a.honor_labels);
+  std::string group_labels =
+      "Hostname, " + l.container + ", " + l.pod + ", " + l.ns + ", gpu, modelName";
+  std::string sel = selector(l, a, "modelName", a.model_regex);
+
+  std::string idle_block =
+      "sum by (" + group_labels + ") (\n    max_over_time(DCGM_FI_PROF_GR_ENGINE_ACTIVE" + sel +
+      window(a) + ")\n    or\n    max_over_time(DCGM_FI_DEV_GPU_UTIL" + sel + window(a) +
+      ") / 100\n)";
+
+  std::string enrich_join =
+      "* on (Hostname) group_left(node_type) (\n"
+      "    label_replace(\n"
+      "      label_replace(node_dmi_info,\n"
+      "        \"Hostname\", \"$1\", \"instance\", \"(.+)\"\n"
+      "      ),\n"
+      "      \"node_type\", \"$1\", \"product_name\", \"(.+)\"\n"
+      "    )\n"
+      "  )";
+
+  std::string unless_clause;
+  if (threshold_set(a.power_threshold)) {
+    unless_clause = "unless on (" + l.pod + ", " + l.ns +
+                    ")\n(\n  max_over_time(DCGM_FI_DEV_POWER_USAGE" + selector(l, a, "", "") +
+                    window(a) + ") >= " + fmt_threshold(*a.power_threshold) + "\n)";
+  }
+  return assemble(idle_block, group_labels, enrich_join, unless_clause);
+}
+
+}  // namespace
+
+std::string build_idle_query(const QueryArgs& args) {
+  if (args.device == "gpu") return build_gpu_query(args);
+  if (args.device == "tpu") return build_tpu_query(args);
+  throw std::invalid_argument("unknown device: " + args.device + " (expected tpu|gpu)");
+}
+
+}  // namespace tpupruner::query

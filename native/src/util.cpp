@@ -1,0 +1,196 @@
+#include "tpupruner/util.hpp"
+
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <fstream>
+#include <sstream>
+
+#include <cerrno>
+#include <sys/random.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+namespace tpupruner::util {
+
+int64_t now_unix() { return static_cast<int64_t>(::time(nullptr)); }
+
+std::string format_rfc3339(int64_t unix_secs, int64_t nanos, int subsec_digits) {
+  std::tm tm{};
+  time_t t = static_cast<time_t>(unix_secs);
+  gmtime_r(&t, &tm);
+  char buf[64];
+  size_t n = strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%S", &tm);
+  std::string out(buf, n);
+  if (subsec_digits > 0) {
+    char frac[32];
+    // nanos → the requested number of leading digits
+    int64_t scaled = nanos;
+    for (int i = subsec_digits; i < 9; ++i) scaled /= 10;
+    snprintf(frac, sizeof(frac), ".%0*lld", subsec_digits, static_cast<long long>(scaled));
+    out += frac;
+  }
+  out += "Z";
+  return out;
+}
+
+std::string now_rfc3339_micro() {
+  struct timeval tv{};
+  gettimeofday(&tv, nullptr);
+  return format_rfc3339(tv.tv_sec, static_cast<int64_t>(tv.tv_usec) * 1000, 6);
+}
+
+std::string now_rfc3339() { return format_rfc3339(now_unix()); }
+
+std::optional<int64_t> parse_rfc3339(std::string_view s) {
+  // YYYY-MM-DDTHH:MM:SS[.frac][Z|±HH:MM]
+  std::tm tm{};
+  int y, mo, d, h, mi, se;
+  if (s.size() < 19) return std::nullopt;
+  std::string head(s.substr(0, 19));
+  if (sscanf(head.c_str(), "%d-%d-%dT%d:%d:%d", &y, &mo, &d, &h, &mi, &se) != 6) {
+    // allow space separator
+    if (sscanf(head.c_str(), "%d-%d-%d %d:%d:%d", &y, &mo, &d, &h, &mi, &se) != 6)
+      return std::nullopt;
+  }
+  tm.tm_year = y - 1900;
+  tm.tm_mon = mo - 1;
+  tm.tm_mday = d;
+  tm.tm_hour = h;
+  tm.tm_min = mi;
+  tm.tm_sec = se;
+  int64_t base = static_cast<int64_t>(timegm(&tm));
+
+  size_t i = 19;
+  if (i < s.size() && s[i] == '.') {
+    ++i;
+    while (i < s.size() && isdigit(static_cast<unsigned char>(s[i]))) ++i;
+  }
+  if (i >= s.size()) return base;  // tolerate missing zone (treat as UTC)
+  char z = s[i];
+  if (z == 'Z' || z == 'z') return base;
+  if (z == '+' || z == '-') {
+    // Accept exactly HH:MM or HHMM.
+    std::string_view tail = s.substr(i + 1);
+    auto two_digits = [](std::string_view t, int& out) {
+      if (t.size() < 2 || !isdigit((unsigned char)t[0]) || !isdigit((unsigned char)t[1]))
+        return false;
+      out = (t[0] - '0') * 10 + (t[1] - '0');
+      return true;
+    };
+    int oh = 0, om = 0;
+    if (!two_digits(tail, oh)) return std::nullopt;
+    tail.remove_prefix(2);
+    if (!tail.empty() && tail[0] == ':') tail.remove_prefix(1);
+    if (!tail.empty()) {
+      if (!two_digits(tail, om) || tail.size() > 2) return std::nullopt;
+    }
+    if (oh > 23 || om > 59) return std::nullopt;
+    int64_t off = oh * 3600 + om * 60;
+    return z == '+' ? base - off : base + off;
+  }
+  return std::nullopt;
+}
+
+std::string random_hex32() {
+  unsigned char raw[16];
+  size_t got = 0;
+  while (got < sizeof(raw)) {
+    ssize_t n = getrandom(raw + got, sizeof(raw) - got, 0);
+    if (n > 0) {
+      got += static_cast<size_t>(n);
+      continue;
+    }
+    if (n < 0 && errno == EINTR) continue;
+    // CSPRNG unavailable: mix time/pid/counter through splitmix64 so event
+    // names stay distinct across replicas even in this degraded path.
+    static uint64_t counter = 0;
+    struct timeval tv{};
+    gettimeofday(&tv, nullptr);
+    uint64_t state = static_cast<uint64_t>(tv.tv_sec) * 1000000ull +
+                     static_cast<uint64_t>(tv.tv_usec);
+    state ^= static_cast<uint64_t>(::getpid()) << 32;
+    state += ++counter * 0x9E3779B97F4A7C15ull;
+    for (size_t i = 0; i < sizeof(raw); i += 8) {
+      state += 0x9E3779B97F4A7C15ull;
+      uint64_t zmix = state;
+      zmix = (zmix ^ (zmix >> 30)) * 0xBF58476D1CE4E5B9ull;
+      zmix = (zmix ^ (zmix >> 27)) * 0x94D049BB133111EBull;
+      zmix ^= zmix >> 31;
+      std::memcpy(raw + i, &zmix, 8);
+    }
+    break;
+  }
+  static const char* hexd = "0123456789abcdef";
+  std::string out(32, '0');
+  for (size_t i = 0; i < 16; ++i) {
+    out[2 * i] = hexd[raw[i] >> 4];
+    out[2 * i + 1] = hexd[raw[i] & 0xF];
+  }
+  return out;
+}
+
+std::vector<std::string> split(std::string_view s, char sep) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  while (true) {
+    size_t p = s.find(sep, start);
+    if (p == std::string_view::npos) {
+      out.emplace_back(s.substr(start));
+      break;
+    }
+    out.emplace_back(s.substr(start, p - start));
+    start = p + 1;
+  }
+  return out;
+}
+
+std::string to_lower(std::string_view s) {
+  std::string out(s);
+  for (char& c : out) c = static_cast<char>(tolower(static_cast<unsigned char>(c)));
+  return out;
+}
+
+bool starts_with(std::string_view s, std::string_view prefix) {
+  return s.size() >= prefix.size() && s.substr(0, prefix.size()) == prefix;
+}
+
+std::string trim(std::string_view s) {
+  size_t b = 0, e = s.size();
+  while (b < e && isspace(static_cast<unsigned char>(s[b]))) ++b;
+  while (e > b && isspace(static_cast<unsigned char>(s[e - 1]))) --e;
+  return std::string(s.substr(b, e - b));
+}
+
+std::optional<std::string> read_file(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return std::nullopt;
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+std::optional<std::string> env(const char* name) {
+  const char* v = ::getenv(name);
+  if (!v) return std::nullopt;
+  return std::string(v);
+}
+
+std::string url_encode(std::string_view s) {
+  std::string out;
+  out.reserve(s.size() * 3);
+  for (unsigned char c : s) {
+    if (isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~') {
+      out.push_back(static_cast<char>(c));
+    } else {
+      char buf[4];
+      snprintf(buf, sizeof(buf), "%%%02X", c);
+      out += buf;
+    }
+  }
+  return out;
+}
+
+}  // namespace tpupruner::util

@@ -1,0 +1,206 @@
+// C++-level domain tests (reference: gpu-pruner/src/lib.rs:578-998).
+// The fuller port of the reference's domain suite lives in
+// tests/test_domain.py, driving this same code through the C API.
+#include "testing.hpp"
+#include "tpupruner/core.hpp"
+#include "tpupruner/metrics.hpp"
+#include "tpupruner/query.hpp"
+
+using namespace tpupruner;
+using core::Kind;
+using json::Value;
+
+namespace {
+core::ScaleTarget make_target(Kind k, const char* name, const char* ns, const char* uid) {
+  Value obj = Value::object();
+  Value meta = Value::object();
+  meta.set("name", Value(name));
+  meta.set("namespace", Value(ns));
+  if (uid) meta.set("uid", Value(uid));
+  obj.set("metadata", std::move(meta));
+  return core::ScaleTarget{k, std::move(obj)};
+}
+}  // namespace
+
+TP_TEST(enabled_resources_parsing) {
+  auto all = core::parse_enabled_resources("drsinj");
+  TP_CHECK_EQ(all, core::kAllResources);
+  auto just_n = core::parse_enabled_resources("n");
+  TP_CHECK(just_n & core::flag(Kind::Notebook));
+  TP_CHECK(!(just_n & core::flag(Kind::Deployment)));
+  TP_CHECK_EQ(core::parse_enabled_resources(""), 0);
+  TP_CHECK_EQ(core::parse_enabled_resources("xdqz"), core::flag(Kind::Deployment));
+  TP_CHECK_EQ(core::parse_enabled_resources("dddd"), core::parse_enabled_resources("d"));
+  TP_CHECK_EQ(core::parse_enabled_resources("j"), core::flag(Kind::JobSet));
+}
+
+TP_TEST(target_identity_uid_based) {
+  auto a = make_target(Kind::Deployment, "d", "ns", "uid-1");
+  auto b = make_target(Kind::Deployment, "other-name", "ns", "uid-1");
+  auto c = make_target(Kind::Deployment, "d", "ns", "uid-2");
+  auto d = make_target(Kind::ReplicaSet, "d", "ns", "uid-1");
+  TP_CHECK(a == b);   // same uid → equal despite names
+  TP_CHECK(!(a == c));  // different uid
+  TP_CHECK(!(a == d));  // different variant, same uid (lib.rs:774-778)
+}
+
+TP_TEST(target_identity_uidless_fallback) {
+  auto a = make_target(Kind::Deployment, "d", "ns", nullptr);
+  auto b = make_target(Kind::Deployment, "d", "ns", nullptr);
+  auto c = make_target(Kind::Deployment, "d2", "ns", nullptr);
+  TP_CHECK(a == b);
+  TP_CHECK(!(a == c));
+}
+
+TP_TEST(dedup_targets_mixed) {
+  std::vector<core::ScaleTarget> in;
+  in.push_back(make_target(Kind::Deployment, "d1", "ns", "uid-d"));
+  in.push_back(make_target(Kind::ReplicaSet, "r1", "ns", "uid-r"));
+  in.push_back(make_target(Kind::StatefulSet, "s1", "ns", "uid-s"));
+  in.push_back(make_target(Kind::InferenceService, "i1", "ns", "uid-i"));
+  in.push_back(make_target(Kind::Notebook, "n1", "ns", "uid-n"));
+  in.push_back(make_target(Kind::JobSet, "j1", "ns", "uid-j"));
+  in.push_back(make_target(Kind::Deployment, "d1", "ns", "uid-d"));  // dup
+  auto out = core::dedup_targets(std::move(in));
+  TP_CHECK_EQ(out.size(), size_t(6));
+  TP_CHECK_EQ(out[0].name(), std::string("d1"));  // first-seen order preserved
+}
+
+TP_TEST(event_generation_fields) {
+  auto t = make_target(Kind::Notebook, "tpu-test", "ml-ns", "nb-uid-1");
+  core::EventOptions opts;
+  opts.device = "tpu";
+  opts.reporting_instance = "pruner-pod-0";
+  opts.now_unix = 1785312000;
+  Value e = core::generate_scale_event(t, opts);
+
+  TP_CHECK_EQ(e.at_path("involvedObject.name")->as_string(), std::string("tpu-test"));
+  TP_CHECK_EQ(e.at_path("involvedObject.namespace")->as_string(), std::string("ml-ns"));
+  TP_CHECK_EQ(e.at_path("involvedObject.kind")->as_string(), std::string("Notebook"));
+  TP_CHECK_EQ(e.at_path("involvedObject.uid")->as_string(), std::string("nb-uid-1"));
+  TP_CHECK_EQ(e.at_path("involvedObject.apiVersion")->as_string(), std::string("kubeflow.org/v1"));
+  TP_CHECK_EQ(e.get_string("action"), std::string("scale_down"));
+  TP_CHECK_EQ(e.get_string("type"), std::string("Normal"));
+  TP_CHECK_EQ(e.get_string("reason"), std::string("Pod ml-ns::tpu-test was not using TPU"));
+  TP_CHECK_EQ(e.get_string("reportingComponent"), std::string("tpu-pruner"));
+  TP_CHECK_EQ(e.get_string("reportingInstance"), std::string("pruner-pod-0"));
+  TP_CHECK(e.at_path("metadata.name")->as_string().starts_with("tpupruner-"));
+  TP_CHECK_EQ(e.at_path("metadata.namespace")->as_string(), std::string("ml-ns"));
+  TP_CHECK_EQ(e.get_string("firstTimestamp"), std::string("2026-07-29T08:00:00Z"));
+  TP_CHECK_EQ(e.get_string("lastTimestamp"), std::string("2026-07-29T08:00:00Z"));
+  TP_CHECK(!e.get_string("eventTime").empty());
+}
+
+TP_TEST(event_names_unique) {
+  auto t = make_target(Kind::Deployment, "d", "ns", nullptr);
+  Value e1 = core::generate_scale_event(t);
+  Value e2 = core::generate_scale_event(t);
+  TP_CHECK(e1.at_path("metadata.name")->as_string() != e2.at_path("metadata.name")->as_string());
+}
+
+TP_TEST(eligibility_gates) {
+  int64_t now = 1785312000;
+  int64_t lookback = 30 * 60 + 300;
+
+  Value pending = Value::parse(R"({"metadata":{"creationTimestamp":"2026-07-01T00:00:00Z"},
+                                   "status":{"phase":"Pending"}})");
+  TP_CHECK(core::check_eligibility(pending, now, lookback) == core::Eligibility::Pending);
+
+  Value no_ts = Value::parse(R"({"metadata":{},"status":{"phase":"Running"}})");
+  TP_CHECK(core::check_eligibility(no_ts, now, lookback) == core::Eligibility::NoCreationTs);
+
+  Value young = Value::parse(R"({"metadata":{"creationTimestamp":"2026-07-29T07:45:00Z"},
+                                 "status":{"phase":"Running"}})");
+  TP_CHECK(core::check_eligibility(young, now, lookback) == core::Eligibility::TooYoung);
+
+  // created exactly at the boundary is still too young (>= in main.rs:508)
+  Value boundary = Value::parse(R"({"metadata":{"creationTimestamp":"2026-07-29T07:25:00Z"},
+                                    "status":{"phase":"Running"}})");
+  TP_CHECK(core::check_eligibility(boundary, now, lookback) == core::Eligibility::TooYoung);
+
+  Value old_pod = Value::parse(R"({"metadata":{"creationTimestamp":"2026-07-29T07:24:59Z"},
+                                   "status":{"phase":"Running"}})");
+  TP_CHECK(core::check_eligibility(old_pod, now, lookback) == core::Eligibility::Eligible);
+
+  Value bad_ts = Value::parse(R"({"metadata":{"creationTimestamp":"not-a-time"}})");
+  TP_CHECK(core::check_eligibility(bad_ts, now, lookback) == core::Eligibility::BadTimestamp);
+}
+
+TP_TEST(query_tpu_shape) {
+  query::QueryArgs a;
+  a.device = "tpu";
+  a.duration_min = 45;
+  a.hbm_threshold = 0.05;
+  std::string q = query::build_idle_query(a);
+  TP_CHECK(q.find("max_over_time(") != std::string::npos);
+  TP_CHECK(q.find("avg_over_time(") == std::string::npos);
+  TP_CHECK(q.find("tensorcore_utilization") != std::string::npos);
+  TP_CHECK(q.find("tensorcore_duty_cycle") != std::string::npos);
+  TP_CHECK(q.find("/ 100") != std::string::npos);
+  TP_CHECK(q.find("[45m]") != std::string::npos);
+  TP_CHECK(q.find("== 0") != std::string::npos);
+  TP_CHECK(q.find("unless on (exported_pod, exported_namespace)") != std::string::npos);
+  TP_CHECK(q.find("hbm_memory_bandwidth_utilization") != std::string::npos);
+  TP_CHECK(q.find(">= 0.05") != std::string::npos);
+  TP_CHECK(q.find("gke_tpu_accelerator") != std::string::npos);
+}
+
+TP_TEST(query_gpu_shape) {
+  query::QueryArgs a;
+  a.device = "gpu";
+  a.duration_min = 30;
+  a.power_threshold = 150.0;
+  std::string q = query::build_idle_query(a);
+  TP_CHECK(q.find("DCGM_FI_PROF_GR_ENGINE_ACTIVE") != std::string::npos);
+  TP_CHECK(q.find("DCGM_FI_DEV_GPU_UTIL") != std::string::npos);
+  TP_CHECK(q.find("DCGM_FI_DEV_POWER_USAGE") != std::string::npos);
+  TP_CHECK(q.find(">= 150") != std::string::npos);
+  TP_CHECK(q.find("node_dmi_info") != std::string::npos);
+}
+
+TP_TEST(decode_samples_basic) {
+  Value resp = Value::parse(R"({
+    "status": "success",
+    "data": {"resultType": "vector", "result": [
+      {"metric": {"exported_pod": "p1", "exported_namespace": "ns", "exported_container": "c",
+                  "accelerator_type": "tpu-v5-lite-podslice", "node_type": "ct5lp-hightpu-4t"},
+       "value": [1785312000, "0"]},
+      {"metric": {"exported_pod": "p1", "exported_namespace": "ns", "exported_container": "c",
+                  "accelerator_id": "1"},
+       "value": [1785312000, "0"]},
+      {"metric": {"pod": "p2", "namespace": "ns2", "container": "c2"},
+       "value": [1785312000, "0"]}
+    ]}
+  })");
+  auto r = metrics::decode_instant_vector(resp, "tpu");
+  TP_CHECK_EQ(r.num_series, size_t(3));
+  TP_CHECK_EQ(r.samples.size(), size_t(2));  // p1 deduped across chips
+  TP_CHECK_EQ(r.samples[0].accelerator, std::string("tpu-v5-lite-podslice"));
+  TP_CHECK_EQ(r.samples[1].name, std::string("p2"));  // native label fallback
+  TP_CHECK_EQ(r.samples[1].accelerator, std::string("unknown"));
+}
+
+TP_TEST(decode_gpu_requires_model_name) {
+  Value resp = Value::parse(R"({
+    "status": "success",
+    "data": {"resultType": "vector", "result": [
+      {"metric": {"exported_pod": "p1", "exported_namespace": "ns", "exported_container": "c"},
+       "value": [1785312000, "0"]}
+    ]}
+  })");
+  auto r = metrics::decode_instant_vector(resp, "gpu");
+  TP_CHECK_EQ(r.samples.size(), size_t(0));
+  TP_CHECK_EQ(r.errors.size(), size_t(1));
+  TP_CHECK(r.errors[0].find("modelName") != std::string::npos);
+}
+
+TP_TEST(decode_rejects_non_vector) {
+  Value resp = Value::parse(R"({"status":"success","data":{"resultType":"matrix","result":[]}})");
+  bool threw = false;
+  try {
+    metrics::decode_instant_vector(resp, "tpu");
+  } catch (const std::runtime_error&) {
+    threw = true;
+  }
+  TP_CHECK(threw);
+}

@@ -1,0 +1,154 @@
+#include "testing.hpp"
+#include "tpupruner/json.hpp"
+#include "tpupruner/util.hpp"
+
+using namespace tpupruner;
+using json::Value;
+
+TP_TEST(json_parse_scalars) {
+  TP_CHECK(Value::parse("null").is_null());
+  TP_CHECK_EQ(Value::parse("true").as_bool(), true);
+  TP_CHECK_EQ(Value::parse("false").as_bool(), false);
+  TP_CHECK_EQ(Value::parse("42").as_int(), 42);
+  TP_CHECK_EQ(Value::parse("-7").as_int(), -7);
+  TP_CHECK_EQ(Value::parse("2.5").as_double(), 2.5);
+  TP_CHECK_EQ(Value::parse("1e3").as_double(), 1000.0);
+  TP_CHECK_EQ(Value::parse("\"hi\"").as_string(), std::string("hi"));
+}
+
+TP_TEST(json_parse_structures) {
+  Value v = Value::parse(R"({"a": [1, 2, {"b": "c"}], "d": null})");
+  TP_CHECK(v.is_object());
+  TP_CHECK_EQ(v.find("a")->as_array().size(), size_t(3));
+  TP_CHECK_EQ(v.at_path("a")->as_array()[2].get_string("b"), std::string("c"));
+  TP_CHECK(v.find("d")->is_null());
+  TP_CHECK(v.find("missing") == nullptr);
+}
+
+TP_TEST(json_string_escapes) {
+  Value v = Value::parse(R"("line\n\t\"q\" é 😀")");
+  const std::string& s = v.as_string();
+  TP_CHECK(s.find('\n') != std::string::npos);
+  TP_CHECK(s.find("\"q\"") != std::string::npos);
+  TP_CHECK(s.find("\xc3\xa9") != std::string::npos);      // é
+  TP_CHECK(s.find("\xf0\x9f\x98\x80") != std::string::npos);  // 😀 via surrogate pair
+}
+
+TP_TEST(json_roundtrip) {
+  const char* text = R"({"metadata":{"name":"p","namespace":"ns"},"spec":{"replicas":0},"x":[1,2.5,"s",null,true]})";
+  Value v = Value::parse(text);
+  Value v2 = Value::parse(v.dump());
+  TP_CHECK(v == v2);
+}
+
+TP_TEST(json_dump_compact_and_pretty) {
+  Value v = Value::object();
+  v.set("b", Value(1)).set("a", Value("x"));
+  TP_CHECK_EQ(v.dump(), std::string(R"({"a":"x","b":1})"));
+  TP_CHECK(v.dump(2).find("\n  \"a\": \"x\"") != std::string::npos);
+}
+
+TP_TEST(json_parse_errors) {
+  bool threw = false;
+  try {
+    Value::parse("{\"a\": }");
+  } catch (const json::ParseError&) {
+    threw = true;
+  }
+  TP_CHECK(threw);
+  threw = false;
+  try {
+    Value::parse("[1,2]trailing");
+  } catch (const json::ParseError&) {
+    threw = true;
+  }
+  TP_CHECK(threw);
+}
+
+TP_TEST(json_strict_number_grammar) {
+  for (const char* bad : {".", ".5", "1.", "01", "1e+", "1e", "-", "+1"}) {
+    bool threw = false;
+    try {
+      Value::parse(bad);
+    } catch (const json::ParseError&) {
+      threw = true;
+    }
+    TP_CHECK(threw);
+  }
+  TP_CHECK_EQ(Value::parse("0.5").as_double(), 0.5);
+  TP_CHECK_EQ(Value::parse("-0.5e+2").as_double(), -50.0);
+  // int64 overflow degrades to double rather than failing
+  TP_CHECK(Value::parse("99999999999999999999").is_number());
+}
+
+TP_TEST(json_rejects_lone_low_surrogate) {
+  bool threw = false;
+  try {
+    Value::parse("\"\\udc00\"");
+  } catch (const json::ParseError&) {
+    threw = true;
+  }
+  TP_CHECK(threw);
+}
+
+TP_TEST(json_at_path_nested) {
+  Value v = Value::parse(R"({"spec":{"predictor":{"minReplicas":0}}})");
+  TP_CHECK_EQ(v.at_path("spec.predictor.minReplicas")->as_int(), 0);
+  TP_CHECK(v.at_path("spec.missing.x") == nullptr);
+}
+
+TP_TEST(json_copy_on_write_isolation) {
+  Value a = Value::parse(R"({"k":[1]})");
+  Value b = a;
+  b.set("k", Value(2));
+  TP_CHECK(a.find("k")->is_array());
+  TP_CHECK_EQ(b.find("k")->as_int(), 2);
+}
+
+TP_TEST(util_rfc3339_roundtrip) {
+  int64_t t = 1785312000;  // 2026-07-29T08:00:00Z
+  std::string s = util::format_rfc3339(t);
+  TP_CHECK_EQ(s, std::string("2026-07-29T08:00:00Z"));
+  auto parsed = util::parse_rfc3339(s);
+  TP_CHECK(parsed.has_value());
+  TP_CHECK_EQ(*parsed, t);
+}
+
+TP_TEST(util_rfc3339_offsets_and_fractions) {
+  auto a = util::parse_rfc3339("2026-07-29T08:00:00.123456Z");
+  TP_CHECK(a.has_value());
+  TP_CHECK_EQ(*a, 1785312000);
+  auto b = util::parse_rfc3339("2026-07-29T10:00:00+02:00");
+  TP_CHECK(b.has_value());
+  TP_CHECK_EQ(*b, 1785312000);
+  auto c = util::parse_rfc3339("2026-07-29T06:00:00-02:00");
+  TP_CHECK(c.has_value());
+  TP_CHECK_EQ(*c, 1785312000);
+  auto d = util::parse_rfc3339("2026-07-29T10:00:00+0200");  // colon-less offset
+  TP_CHECK(d.has_value());
+  TP_CHECK_EQ(*d, 1785312000);
+  TP_CHECK(!util::parse_rfc3339("2026-07-29T10:00:00+2").has_value());
+  TP_CHECK(!util::parse_rfc3339("2026-07-29T10:00:00+99:00").has_value());
+  TP_CHECK(!util::parse_rfc3339("garbage").has_value());
+}
+
+TP_TEST(util_random_hex32_shape_and_uniqueness) {
+  std::string a = util::random_hex32();
+  std::string b = util::random_hex32();
+  TP_CHECK_EQ(a.size(), size_t(32));
+  TP_CHECK(a != b);
+  for (char c : a) TP_CHECK((c >= '0' && c <= '9') || (c >= 'a' && c <= 'f'));
+}
+
+TP_TEST(util_split_and_trim) {
+  auto parts = util::split("a,b,,c", ',');
+  TP_CHECK_EQ(parts.size(), size_t(4));
+  TP_CHECK_EQ(parts[2], std::string(""));
+  TP_CHECK_EQ(util::trim("  x \n"), std::string("x"));
+  TP_CHECK(util::starts_with("https://x", "https://"));
+}
+
+TP_TEST(util_url_encode) {
+  TP_CHECK_EQ(util::url_encode("a b&c=d"), std::string("a%20b%26c%3Dd"));
+  TP_CHECK_EQ(util::url_encode("safe-._~"), std::string("safe-._~"));
+}

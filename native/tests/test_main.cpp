@@ -1,0 +1,3 @@
+#include "testing.hpp"
+
+int main(int argc, char** argv) { return tptest::run_all(argc, argv); }

@@ -1,0 +1,80 @@
+// Minimal native test harness for tpu-pruner's C++ units (the reference uses
+// `cargo test` in-crate tests; this plays the same role for the C++ build).
+#pragma once
+
+#include <cstdio>
+#include <functional>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace tptest {
+
+struct Case {
+  const char* name;
+  std::function<void()> fn;
+};
+
+inline std::vector<Case>& registry() {
+  static std::vector<Case> r;
+  return r;
+}
+
+struct Registrar {
+  Registrar(const char* name, std::function<void()> fn) {
+    registry().push_back({name, std::move(fn)});
+  }
+};
+
+struct Failure {
+  std::string msg;
+};
+
+#define TP_TEST(name)                                             \
+  static void tptest_fn_##name();                                 \
+  static ::tptest::Registrar tptest_reg_##name(#name, tptest_fn_##name); \
+  static void tptest_fn_##name()
+
+#define TP_CHECK(cond)                                                          \
+  do {                                                                          \
+    if (!(cond)) {                                                              \
+      std::ostringstream oss_;                                                  \
+      oss_ << __FILE__ << ":" << __LINE__ << ": check failed: " #cond;          \
+      throw ::tptest::Failure{oss_.str()};                                      \
+    }                                                                           \
+  } while (0)
+
+#define TP_CHECK_EQ(a, b)                                                       \
+  do {                                                                          \
+    auto va_ = (a);                                                             \
+    auto vb_ = (b);                                                             \
+    if (!(va_ == vb_)) {                                                        \
+      std::ostringstream oss_;                                                  \
+      oss_ << __FILE__ << ":" << __LINE__ << ": expected " #a " == " #b         \
+           << "  (lhs=" << va_ << ", rhs=" << vb_ << ")";                       \
+      throw ::tptest::Failure{oss_.str()};                                      \
+    }                                                                           \
+  } while (0)
+
+inline int run_all(int argc, char** argv) {
+  std::string filter = argc > 1 ? argv[1] : "";
+  int failed = 0, ran = 0;
+  for (const Case& c : registry()) {
+    if (!filter.empty() && std::string(c.name).find(filter) == std::string::npos) continue;
+    ++ran;
+    try {
+      c.fn();
+      printf("ok      %s\n", c.name);
+    } catch (const Failure& f) {
+      ++failed;
+      printf("FAILED  %s\n        %s\n", c.name, f.msg.c_str());
+    } catch (const std::exception& e) {
+      ++failed;
+      printf("FAILED  %s\n        exception: %s\n", c.name, e.what());
+    }
+  }
+  printf("%d tests, %d failed\n", ran, failed);
+  return failed == 0 ? 0 : 1;
+}
+
+}  // namespace tptest

@@ -1,0 +1,31 @@
+"""Test harness config.
+
+JAX-based tests (the fleet policy engine) run on a virtual 8-device CPU mesh
+so multi-chip sharding is exercised without TPU hardware; the driver's
+separate dryrun validates the same path. Set before any jax import.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+from tpu_pruner import native  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def built():
+    """Session-scoped native build: returns the tpu_pruner.native module."""
+    native.ensure_built()
+    return native

@@ -1,0 +1,382 @@
+"""Tier-2 domain tests (reference: gpu-pruner/src/lib.rs:578-998, ~30 tests).
+
+Pure in-memory: ScaleTarget construction, enabled-resource parsing,
+uid-based identity/dedup, Meta accessors, Event generation, eligibility.
+Driven through the C API so the exact daemon code paths are covered.
+"""
+
+import pytest
+
+from tpu_pruner import native
+
+
+def make(kind, name, ns, uid=None, rv=None):
+    meta = {"name": name, "namespace": ns}
+    if uid is not None:
+        meta["uid"] = uid
+    if rv is not None:
+        meta["resourceVersion"] = rv
+    return {"kind": kind, "object": {"metadata": meta}}
+
+
+# ── get_enabled_resources (lib.rs:656-703) ─────────────────────────────────
+
+
+def test_enabled_resources_all_flags(built):
+    kinds = native.enabled_resources("drsin")
+    assert set(kinds) == {
+        "Deployment",
+        "ReplicaSet",
+        "StatefulSet",
+        "InferenceService",
+        "Notebook",
+    }
+
+
+def test_enabled_resources_with_jobset(built):
+    kinds = native.enabled_resources("drsinj")
+    assert "JobSet" in kinds
+
+
+def test_enabled_resources_single_flag(built):
+    assert native.enabled_resources("n") == ["Notebook"]
+
+
+def test_enabled_resources_subset(built):
+    assert set(native.enabled_resources("di")) == {"Deployment", "InferenceService"}
+
+
+def test_enabled_resources_empty_string(built):
+    assert native.enabled_resources("") == []
+
+
+def test_enabled_resources_ignores_unknown_chars(built):
+    assert native.enabled_resources("xdqz") == ["Deployment"]
+
+
+def test_enabled_resources_duplicate_chars_idempotent(built):
+    assert native.enabled_resources("dddd") == native.enabled_resources("d")
+
+
+# ── identity / dedup (lib.rs:759-839) ──────────────────────────────────────
+
+
+def test_same_deployment_is_equal(built):
+    out = native.dedup_targets(
+        [make("Deployment", "d", "ns", "uid-1"), make("Deployment", "d", "ns", "uid-1")]
+    )
+    assert len(out) == 1
+
+
+def test_different_uid_deployments_not_equal(built):
+    out = native.dedup_targets(
+        [make("Deployment", "d", "ns", "uid-1"), make("Deployment", "d", "ns", "uid-2")]
+    )
+    assert len(out) == 2
+
+
+def test_different_variants_same_uid_not_equal(built):
+    out = native.dedup_targets(
+        [make("Deployment", "x", "ns", "uid-1"), make("ReplicaSet", "x", "ns", "uid-1")]
+    )
+    assert len(out) == 2
+
+
+def test_notebook_identity_uses_uid_not_name(built):
+    out = native.dedup_targets(
+        [make("Notebook", "nb-a", "ns", "same-uid"), make("Notebook", "nb-b", "ns", "same-uid")]
+    )
+    assert len(out) == 1
+
+
+def test_inference_service_identity_uses_uid(built):
+    out = native.dedup_targets(
+        [
+            make("InferenceService", "is-a", "ns", "uid-x"),
+            make("InferenceService", "is-b", "ns", "uid-x"),
+        ]
+    )
+    assert len(out) == 1
+
+
+def test_jobset_identity_uses_uid(built):
+    out = native.dedup_targets(
+        [make("JobSet", "js-a", "ns", "uid-j"), make("JobSet", "js-b", "ns", "uid-j")]
+    )
+    assert len(out) == 1
+
+
+def test_dedup_mixed_resources(built):
+    targets = [
+        make("Deployment", "d1", "ns", "uid-d"),
+        make("ReplicaSet", "r1", "ns", "uid-r"),
+        make("StatefulSet", "s1", "ns", "uid-s"),
+        make("InferenceService", "i1", "ns", "uid-i"),
+        make("Notebook", "n1", "ns", "uid-n"),
+        make("Deployment", "d1", "ns", "uid-d"),  # duplicate
+    ]
+    out = native.dedup_targets(targets)
+    assert len(out) == 5
+    assert out[0]["name"] == "d1"  # first-seen order
+
+
+def test_dedup_uidless_targets_fall_back_to_name(built):
+    out = native.dedup_targets(
+        [make("Deployment", "d", "ns"), make("Deployment", "d", "ns")]
+    )
+    assert len(out) == 1
+    out2 = native.dedup_targets(
+        [make("Deployment", "d", "ns"), make("Deployment", "d2", "ns")]
+    )
+    assert len(out2) == 2
+
+
+def test_unknown_kind_rejected(built):
+    with pytest.raises(ValueError, match="unknown kind"):
+        native.dedup_targets([make("CronJob", "c", "ns")])
+
+
+# ── Meta accessors (lib.rs:843-891) ────────────────────────────────────────
+
+
+@pytest.mark.parametrize(
+    "kind,api_version,plural",
+    [
+        ("Deployment", "apps/v1", "deployments"),
+        ("ReplicaSet", "apps/v1", "replicasets"),
+        ("StatefulSet", "apps/v1", "statefulsets"),
+        ("Notebook", "kubeflow.org/v1", "notebooks"),
+        ("InferenceService", "serving.kserve.io/v1beta1", "inferenceservices"),
+        ("JobSet", "jobset.x-k8s.io/v1alpha2", "jobsets"),
+    ],
+)
+def test_meta_per_kind(built, kind, api_version, plural):
+    meta = native.target_meta(make(kind, "obj", "ns", "the-uid", rv="42"))
+    assert meta["name"] == "obj"
+    assert meta["namespace"] == "ns"
+    assert meta["kind"] == kind
+    assert meta["uid"] == "the-uid"
+    assert meta["apiVersion"] == api_version
+    assert meta["plural"] == plural
+    assert meta["resourceVersion"] == "42"
+
+
+def test_meta_missing_fields_are_null(built):
+    meta = native.target_meta({"kind": "Deployment", "object": {"metadata": {"name": "x"}}})
+    assert meta["namespace"] is None
+    assert meta["uid"] is None
+    assert meta["resourceVersion"] is None
+
+
+# ── Event generation (lib.rs:895-983) ──────────────────────────────────────
+
+
+def test_event_for_notebook(built):
+    e = native.generate_event(make("Notebook", "tpu-test", "ml-ns", "nb-uid-1"))
+    io = e["involvedObject"]
+    assert io["name"] == "tpu-test"
+    assert io["namespace"] == "ml-ns"
+    assert io["kind"] == "Notebook"
+    assert io["uid"] == "nb-uid-1"
+    assert io["apiVersion"] == "kubeflow.org/v1"
+    assert e["action"] == "scale_down"
+    assert e["type"] == "Normal"
+    assert e["reason"] == "Pod ml-ns::tpu-test was not using TPU"
+    assert e["reportingComponent"] == "tpu-pruner"
+    assert e["metadata"]["name"].startswith("tpupruner-")
+    assert e["metadata"]["namespace"] == "ml-ns"
+    assert e["firstTimestamp"] and e["lastTimestamp"] and e["eventTime"]
+
+
+def test_event_for_deployment_gpu_device(built):
+    e = native.generate_event(make("Deployment", "my-dep", "prod", "dep-uid"), device="gpu")
+    assert e["involvedObject"]["kind"] == "Deployment"
+    assert e["involvedObject"]["apiVersion"] == "apps/v1"
+    assert e["reason"] == "Pod prod::my-dep was not using GPU"
+
+
+def test_event_for_replica_set_without_uid(built):
+    e = native.generate_event(make("ReplicaSet", "my-rs", "staging"))
+    assert e["involvedObject"]["kind"] == "ReplicaSet"
+    assert "uid" not in e["involvedObject"]
+
+
+def test_event_for_stateful_set(built):
+    e = native.generate_event(make("StatefulSet", "my-ss", "dev", "ss-uid"))
+    assert e["involvedObject"]["kind"] == "StatefulSet"
+    assert e["involvedObject"]["apiVersion"] == "apps/v1"
+
+
+def test_event_for_inference_service(built):
+    e = native.generate_event(make("InferenceService", "my-is", "serving", "is-uid"))
+    assert e["involvedObject"]["kind"] == "InferenceService"
+    assert e["involvedObject"]["apiVersion"] == "serving.kserve.io/v1beta1"
+
+
+def test_event_for_jobset(built):
+    e = native.generate_event(make("JobSet", "slice-a", "tpu-jobs", "js-uid"))
+    assert e["involvedObject"]["kind"] == "JobSet"
+    assert e["involvedObject"]["apiVersion"] == "jobset.x-k8s.io/v1alpha2"
+    assert e["reason"] == "Pod tpu-jobs::slice-a was not using TPU"
+
+
+def test_event_names_are_unique(built):
+    t = make("Notebook", "nb", "ns")
+    e1 = native.generate_event(t)
+    e2 = native.generate_event(t)
+    assert e1["metadata"]["name"] != e2["metadata"]["name"]
+
+
+def test_event_with_no_namespace(built):
+    e = native.generate_event({"kind": "Deployment", "object": {"metadata": {"name": "orphan"}}})
+    assert "namespace" not in e["involvedObject"]
+    assert e["reason"] == "Pod ::orphan was not using TPU"
+
+
+def test_event_deterministic_timestamp_injection(built):
+    e = native.generate_event(make("Deployment", "d", "ns"), now=1785312000)
+    assert e["firstTimestamp"] == "2026-07-29T08:00:00Z"
+    assert e["lastTimestamp"] == "2026-07-29T08:00:00Z"
+    assert e["eventTime"] == "2026-07-29T08:00:00.000000Z"
+
+
+# ── eligibility gates (main.rs:452-510) ────────────────────────────────────
+
+NOW = 1785312000  # 2026-07-29T08:00:00Z
+LOOKBACK = 30 * 60 + 300
+
+
+def pod(created=None, phase="Running"):
+    p = {"metadata": {}, "status": {"phase": phase}}
+    if created:
+        p["metadata"]["creationTimestamp"] = created
+    return p
+
+
+def test_pending_pod_skipped(built):
+    r = native.check_eligibility(pod("2026-07-01T00:00:00Z", phase="Pending"), NOW, LOOKBACK)
+    assert r["result"] == "pending"
+    assert not r["eligible"]
+
+
+def test_missing_creation_timestamp_skipped(built):
+    r = native.check_eligibility(pod(), NOW, LOOKBACK)
+    assert r["result"] == "no_creation_timestamp"
+
+
+def test_young_pod_skipped(built):
+    r = native.check_eligibility(pod("2026-07-29T07:45:00Z"), NOW, LOOKBACK)
+    assert r["result"] == "too_young"
+
+
+def test_boundary_pod_still_too_young(built):
+    # created exactly at now - lookback → >= comparison (main.rs:508)
+    r = native.check_eligibility(pod("2026-07-29T07:25:00Z"), NOW, LOOKBACK)
+    assert r["result"] == "too_young"
+
+
+def test_old_idle_pod_eligible(built):
+    r = native.check_eligibility(pod("2026-07-29T07:24:59Z"), NOW, LOOKBACK)
+    assert r["result"] == "eligible"
+    assert r["eligible"]
+
+
+def test_bad_timestamp_skipped(built):
+    r = native.check_eligibility(pod("not-a-time"), NOW, LOOKBACK)
+    assert r["result"] == "bad_timestamp"
+
+
+# ── metric-sample decode (lib.rs:136-187, main.rs:416-437) ─────────────────
+
+
+def vector_response(series):
+    return {"status": "success", "data": {"resultType": "vector", "result": series}}
+
+
+def series(labels, value="0"):
+    return {"metric": labels, "value": [NOW, value]}
+
+
+def test_decode_exported_labels(built):
+    r = native.decode_samples(
+        vector_response(
+            [
+                series(
+                    {
+                        "exported_pod": "p1",
+                        "exported_namespace": "ns",
+                        "exported_container": "c",
+                        "accelerator_type": "tpu-v5-lite-podslice",
+                        "node_type": "ct5lp-hightpu-4t",
+                    }
+                )
+            ]
+        )
+    )
+    s = r["samples"][0]
+    assert s["name"] == "p1"
+    assert s["namespace"] == "ns"
+    assert s["accelerator"] == "tpu-v5-lite-podslice"
+    assert s["node_type"] == "ct5lp-hightpu-4t"
+    assert s["value"] == 0.0
+
+
+def test_decode_native_label_fallback(built):
+    r = native.decode_samples(
+        vector_response([series({"pod": "p", "namespace": "n", "container": "c"})])
+    )
+    assert r["samples"][0]["name"] == "p"
+    assert r["samples"][0]["accelerator"] == "unknown"
+
+
+def test_decode_dedups_multichip_pods(built):
+    labels = {"exported_pod": "p", "exported_namespace": "n", "exported_container": "c"}
+    r = native.decode_samples(
+        vector_response(
+            [
+                series({**labels, "accelerator_id": "0"}),
+                series({**labels, "accelerator_id": "1"}),
+                series({**labels, "accelerator_id": "2"}),
+                series({**labels, "accelerator_id": "3"}),
+            ]
+        )
+    )
+    assert r["num_series"] == 4
+    assert len(r["samples"]) == 1
+
+
+def test_decode_missing_pod_label_is_per_series_error(built):
+    r = native.decode_samples(vector_response([series({"exported_namespace": "n"})]))
+    assert r["samples"] == []
+    assert "exported_pod/pod" in r["errors"][0]
+
+
+def test_decode_gpu_requires_model_name(built):
+    r = native.decode_samples(
+        vector_response([series({"pod": "p", "namespace": "n", "container": "c"})]),
+        device="gpu",
+    )
+    assert r["samples"] == []
+    assert "modelName" in r["errors"][0]
+
+
+def test_decode_gpu_reads_model_name(built):
+    r = native.decode_samples(
+        vector_response(
+            [series({"pod": "p", "namespace": "n", "container": "c", "modelName": "NVIDIA A100"})]
+        ),
+        device="gpu",
+    )
+    assert r["samples"][0]["accelerator"] == "NVIDIA A100"
+
+
+def test_decode_error_response_raises(built):
+    with pytest.raises(ValueError, match="prometheus query failed"):
+        native.decode_samples({"status": "error", "error": "boom"})
+
+
+def test_decode_matrix_response_raises(built):
+    with pytest.raises(ValueError, match="expected vector"):
+        native.decode_samples(
+            {"status": "success", "data": {"resultType": "matrix", "result": []}}
+        )

@@ -1,0 +1,18 @@
+"""Run the C++ unit-test binary as part of the pytest suite.
+
+The reference keeps its unit tests in-crate and runs them with `cargo test`
+(SURVEY.md §4); here `pytest` is the single entry point, so the native
+tier is driven through the built test binary.
+"""
+
+import subprocess
+
+from tpu_pruner.native import TESTS_PATH
+
+
+def test_native_unit_suite(built):
+    proc = subprocess.run(
+        [str(TESTS_PATH)], capture_output=True, text=True, timeout=120
+    )
+    assert proc.returncode == 0, f"native tests failed:\n{proc.stdout}{proc.stderr}"
+    assert ", 0 failed" in proc.stdout

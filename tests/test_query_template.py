@@ -1,0 +1,205 @@
+"""Tier-1 query tests: the rendered-query contract.
+
+Port of the reference's 11 template tests (gpu-pruner/src/main.rs:572-740),
+run against BOTH sources: the DCGM-compatible GPU builder (drop-in parity)
+and the TPU/GMP builder (the north-star source). The reference asserts on
+the rendered PromQL text — its de-facto contract for the query semantics
+(SURVEY.md §4 tier 1) — and so do we.
+"""
+
+import pytest
+
+from tpu_pruner import native
+
+
+def q(**kwargs):
+    return native.build_query(kwargs)
+
+
+# ── GPU source: reference parity (main.rs:584-739) ─────────────────────────
+
+
+def test_gpu_query_uses_max_over_time(built):
+    query = q(device="gpu", duration=30)
+    assert "max_over_time(" in query
+    assert "avg_over_time(" not in query
+
+
+def test_gpu_query_includes_gpu_util_fallback(built):
+    query = q(device="gpu", duration=30)
+    assert "DCGM_FI_PROF_GR_ENGINE_ACTIVE" in query
+    assert "DCGM_FI_DEV_GPU_UTIL" in query
+    assert "/ 100" in query  # fallback normalizes 0-100 to 0-1
+
+
+def test_gpu_query_without_power_threshold_has_no_unless(built):
+    query = q(device="gpu", duration=30)
+    assert "unless" not in query
+    assert "DCGM_FI_DEV_POWER_USAGE" not in query
+
+
+def test_gpu_query_with_power_threshold_adds_unless(built):
+    query = q(device="gpu", duration=30, power_threshold=150.0)
+    assert "unless on (exported_pod, exported_namespace)" in query
+    assert "DCGM_FI_DEV_POWER_USAGE" in query
+    assert ">= 150" in query
+
+
+def test_gpu_query_with_namespace_filter(built):
+    query = q(device="gpu", duration=15, namespace="ml-team")
+    # idle block appears twice (enriched + bare fallback), 2 metrics each = 4
+    assert query.count('exported_namespace =~ "ml-team"') == 4
+
+
+def test_gpu_query_with_namespace_and_power_threshold(built):
+    query = q(device="gpu", duration=15, namespace="ml-team", power_threshold=100.0)
+    # 4 from compute (2 paths x 2 metrics) + 1 from power = 5
+    assert query.count('exported_namespace =~ "ml-team"') == 5
+
+
+def test_gpu_query_with_model_name_filter(built):
+    query = q(device="gpu", duration=30, model_name="NVIDIA A100")
+    assert query.count('modelName =~ "NVIDIA A100"') == 4
+
+
+def test_gpu_query_duration_is_interpolated(built):
+    query = q(device="gpu", duration=45)
+    assert "[45m]" in query
+
+
+def test_gpu_query_default_uses_exported_labels(built):
+    query = q(device="gpu", duration=30)
+    assert "exported_pod" in query
+    assert "exported_namespace" in query
+    assert "exported_container" in query
+
+
+def test_gpu_query_honor_labels_uses_native_labels(built):
+    query = q(device="gpu", duration=30, honor_labels=True)
+    assert "exported_pod" not in query
+    assert "exported_namespace" not in query
+    assert 'pod !=' in query
+    assert "sum by (Hostname, container, pod, namespace" in query
+
+
+def test_gpu_query_honor_labels_with_power_threshold(built):
+    query = q(device="gpu", duration=30, honor_labels=True, power_threshold=120.0)
+    assert "unless on (pod, namespace)" in query
+
+
+# ── TPU source: same contract over GKE/GMP metrics ─────────────────────────
+
+
+def test_tpu_query_uses_max_over_time(built):
+    query = q(device="tpu", duration=30)
+    assert "max_over_time(" in query
+    assert "avg_over_time(" not in query
+
+
+def test_tpu_query_duty_cycle_fallback(built):
+    query = q(device="tpu", duration=30)
+    assert "tensorcore_utilization" in query  # primary, 0-1
+    assert "tensorcore_duty_cycle" in query  # fallback, percent
+    assert "/ 100" in query
+
+
+def test_tpu_query_idle_predicate(built):
+    query = q(device="tpu", duration=30)
+    assert "== 0" in query
+
+
+def test_tpu_query_without_hbm_threshold_has_no_unless(built):
+    query = q(device="tpu", duration=30)
+    assert "unless" not in query
+    assert "hbm_memory_bandwidth_utilization" not in query
+
+
+def test_tpu_query_with_hbm_threshold_adds_unless(built):
+    query = q(device="tpu", duration=30, hbm_threshold=0.05)
+    assert "unless on (exported_pod, exported_namespace)" in query
+    assert "hbm_memory_bandwidth_utilization" in query
+    assert ">= 0.05" in query
+
+
+def test_tpu_query_with_namespace_filter(built):
+    query = q(device="tpu", duration=15, namespace="ml-team")
+    assert query.count('exported_namespace =~ "ml-team"') == 4
+
+
+def test_tpu_query_with_namespace_and_hbm_threshold(built):
+    query = q(device="tpu", duration=15, namespace="ml-team", hbm_threshold=0.1)
+    assert query.count('exported_namespace =~ "ml-team"') == 5
+
+
+def test_tpu_query_with_accelerator_filter(built):
+    query = q(device="tpu", duration=30, accelerator_type="tpu-v5-lite-podslice")
+    assert query.count('accelerator_type =~ "tpu-v5-lite-podslice"') == 4
+
+
+def test_tpu_query_duration_is_interpolated(built):
+    query = q(device="tpu", duration=45)
+    assert "[45m]" in query
+
+
+def test_tpu_query_default_uses_exported_labels(built):
+    query = q(device="tpu", duration=30)
+    for lbl in ("exported_pod", "exported_namespace", "exported_container"):
+        assert lbl in query
+
+
+def test_tpu_query_honor_labels_uses_native_labels(built):
+    query = q(device="tpu", duration=30, honor_labels=True)
+    assert "exported_pod" not in query
+    assert "exported_namespace" not in query
+    assert "sum by (node, container, pod, namespace" in query
+
+
+def test_tpu_query_honor_labels_with_hbm_threshold(built):
+    query = q(device="tpu", duration=30, honor_labels=True, hbm_threshold=0.05)
+    assert "unless on (pod, namespace)" in query
+
+
+def test_tpu_query_node_type_enrichment_join(built):
+    query = q(device="tpu", duration=30)
+    assert "kube_node_labels" in query
+    assert "label_cloud_google_com_gke_tpu_accelerator" in query
+    assert "group_left(node_type)" in query
+    # bare fallback keeps series when node labels are absent
+    assert "or on (node," in query
+
+
+def test_tpu_query_metric_name_overrides(built):
+    query = q(
+        device="tpu",
+        duration=30,
+        tensorcore_metric="kubernetes_io:node_accelerator_tensorcore_utilization",
+        duty_cycle_metric="kubernetes_io:node_accelerator_duty_cycle",
+    )
+    assert "kubernetes_io:node_accelerator_tensorcore_utilization" in query
+    assert "kubernetes_io:node_accelerator_duty_cycle" in query
+    assert "tensorcore_duty_cycle{" not in query
+
+
+def test_default_device_is_tpu(built):
+    query = q(duration=30)
+    assert "tensorcore" in query
+    assert "DCGM" not in query
+
+
+def test_unknown_device_rejected(built):
+    with pytest.raises(ValueError, match="unknown device"):
+        q(device="cuda", duration=30)
+
+
+def test_regex_filters_are_promql_escaped(built):
+    query = q(device="tpu", duration=30, namespace=r"ml-\d+")
+    assert r'exported_namespace =~ "ml-\\d+"' in query
+    query = q(device="tpu", duration=30, accelerator_type='a"b')
+    assert r'accelerator_type =~ "a\"b"' in query
+
+
+def test_zero_threshold_means_no_unless_clause(built):
+    # Jinja truthiness parity: 0 threshold disables the clause rather than
+    # emitting an always-true `>= 0` (query.promql.j2:36).
+    assert "unless" not in q(device="tpu", duration=30, hbm_threshold=0.0)
+    assert "unless" not in q(device="gpu", duration=30, power_threshold=0.0)

@@ -1,0 +1,23 @@
+"""tpu-pruner: TPU-native idle-workload pruner for Kubernetes.
+
+A from-scratch rebuild of the capability set of ``wseaton/gpu-pruner``
+(see SURVEY.md) for GKE TPU clusters: a native C++20 control-plane daemon
+that queries a Prometheus-compatible metric plane (GKE managed Prometheus /
+Cloud Monitoring: per-chip ``tensorcore/duty_cycle`` with
+``hbm/memory_bandwidth_utilization`` corroboration), resolves idle
+``google.com/tpu`` pods to their root scalable owner (Deployment,
+ReplicaSet, StatefulSet, Kubeflow Notebook, KServe InferenceService,
+multi-host JobSet slices), and non-destructively pauses them.
+
+This Python package hosts:
+
+- ``tpu_pruner.native`` — ctypes bindings over the C++ core
+  (``libtpupruner.so``), used by the test suite and tooling;
+- ``tpu_pruner.policy`` — the JAX fleet-scale idleness policy engine
+  (the TPU compute path: batch evaluation of idle verdicts over whole
+  fleets, shardable across a device mesh);
+- ``tpu_pruner.testing`` — hermetic fixtures (fake Prometheus / fake
+  K8s API servers) that the reference lacks (SURVEY.md §4).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,145 @@
+"""ctypes bindings over the tpu-pruner C++ core (libtpupruner.so).
+
+The C++ core exposes a narrow C API (native/src/capi.cpp) over its pure
+domain functions — query building, enabled-resource parsing, metric-sample
+decoding, eligibility policy, event generation — so the Python test tiers
+can exercise exactly the code the daemon runs (reference analog: the
+in-crate unit tests of gpu-pruner/src/lib.rs:578-998 and main.rs:572-740).
+
+All C API functions exchange JSON strings; results are heap-allocated by
+the library and released with ``tp_free``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+BUILD_DIR = REPO_ROOT / "build"
+LIB_PATH = BUILD_DIR / "libtpupruner.so"
+DAEMON_PATH = BUILD_DIR / "tpu-pruner"
+TESTS_PATH = BUILD_DIR / "tpupruner_tests"
+
+_lib = None
+
+
+def _newest_mtime(*dirs: Path) -> float:
+    newest = 0.0
+    for d in dirs:
+        for root, _dirs, files in os.walk(d):
+            for f in files:
+                if f.endswith((".cpp", ".hpp", ".txt")):
+                    newest = max(newest, os.path.getmtime(os.path.join(root, f)))
+    return newest
+
+
+def ensure_built(force: bool = False) -> Path:
+    """Configure+build the native tree with CMake/Ninja if stale."""
+    src_mtime = _newest_mtime(REPO_ROOT / "native")
+    src_mtime = max(src_mtime, os.path.getmtime(REPO_ROOT / "CMakeLists.txt"))
+    if not force and LIB_PATH.exists() and os.path.getmtime(LIB_PATH) >= src_mtime:
+        return LIB_PATH
+    BUILD_DIR.mkdir(exist_ok=True)
+
+    def run_step(step: str, cmd: list[str]) -> None:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"native {step} failed:\n{proc.stdout}\n{proc.stderr}")
+
+    if not (BUILD_DIR / "build.ninja").exists():
+        run_step(
+            "configure",
+            ["cmake", "-G", "Ninja", "-S", str(REPO_ROOT), "-B", str(BUILD_DIR)],
+        )
+    run_step("build", ["cmake", "--build", str(BUILD_DIR)])
+    return LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    ensure_built()
+    lib = ctypes.CDLL(str(LIB_PATH))
+    lib.tp_free.argtypes = [ctypes.c_void_p]
+    lib.tp_free.restype = None
+    for fn in (
+        "tp_build_query",
+        "tp_enabled_resources",
+        "tp_decode_samples",
+        "tp_generate_event",
+        "tp_check_eligibility",
+        "tp_dedup_targets",
+        "tp_target_meta",
+        "tp_version",
+    ):
+        f = getattr(lib, fn)
+        f.argtypes = [ctypes.c_char_p]
+        f.restype = ctypes.c_void_p
+    _lib = lib
+    return lib
+
+
+def _call(name: str, payload) -> dict | list | str | int | float | None:
+    """Call a JSON-in/JSON-out C API function.
+
+    Errors are surfaced as ``{"error": "..."}`` payloads and re-raised.
+    """
+    lib = load()
+    raw = json.dumps(payload).encode()
+    ptr = getattr(lib, name)(raw)
+    if not ptr:
+        raise RuntimeError(f"{name}: null result")
+    try:
+        out = ctypes.string_at(ptr).decode()
+    finally:
+        lib.tp_free(ptr)
+    result = json.loads(out)
+    if isinstance(result, dict) and "error" in result:
+        raise ValueError(result["error"])
+    return result
+
+
+def build_query(args: dict) -> str:
+    """Render the idle-workload PromQL for the given CLI-style args."""
+    return _call("tp_build_query", args)["query"]
+
+
+def enabled_resources(flags: str) -> list[str]:
+    """Parse a 'drsinj' flag string into the enabled resource kinds."""
+    return _call("tp_enabled_resources", flags)["kinds"]
+
+
+def decode_samples(prom_response: dict, device: str = "tpu") -> dict:
+    """Decode a Prometheus instant-query response into pod metric samples."""
+    return _call("tp_decode_samples", {"response": prom_response, "device": device})
+
+
+def generate_event(target: dict, device: str = "tpu", now: int | None = None) -> dict:
+    """Build the K8s Event emitted before a scale-down action."""
+    payload = {"target": target, "device": device}
+    if now is not None:
+        payload["now"] = int(now)
+    return _call("tp_generate_event", payload)
+
+
+def check_eligibility(pod: dict, now_unix: int, lookback_secs: int) -> dict:
+    """Apply the reference's eligibility gates to a Pod object."""
+    return _call(
+        "tp_check_eligibility",
+        {"pod": pod, "now_unix": now_unix, "lookback_secs": lookback_secs},
+    )
+
+
+def dedup_targets(targets: list[dict]) -> list[dict]:
+    """uid+kind dedup of scale targets (reference HashSet<ScaleKind>)."""
+    return _call("tp_dedup_targets", targets)
+
+
+def target_meta(target: dict) -> dict:
+    """Meta accessors (name/namespace/kind/uid/apiVersion) for a target."""
+    return _call("tp_target_meta", target)
