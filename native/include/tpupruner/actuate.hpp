@@ -1,0 +1,40 @@
+// Scale actuators: the non-destructive "pause" per kind.
+//
+// Reference analog: Scaler::scale (gpu-pruner/src/lib.rs:337-427, 515-576).
+// Ordering contract preserved: the K8s Event is posted FIRST and its
+// failure only logged (lib.rs:340-349) — the audit trail must not block the
+// action, and the action must not be skipped because auditing failed.
+//
+// Patch shapes:
+//   Deployment/ReplicaSet/StatefulSet → /scale subresource merge-patch
+//     {"spec":{"replicas":0}}                           (lib.rs:517-525)
+//   Notebook → annotation kubeflow-resource-stopped=<now RFC3339>
+//     (Kubeflow's stop contract)                        (lib.rs:529-549)
+//   InferenceService → {"spec":{"predictor":{"minReplicas":0}}} so KServe
+//     drains and auto-rescales on traffic               (lib.rs:553-576)
+//   JobSet → {"spec":{"suspend":true}} — the idiomatic pause for multi-host
+//     TPU slices: JobSet deletes child Jobs' pods, freeing every chip in
+//     the slice, and resume is a single unsuspend       (TPU-native, new)
+#pragma once
+
+#include <string>
+
+#include "tpupruner/core.hpp"
+#include "tpupruner/k8s.hpp"
+
+namespace tpupruner::actuate {
+
+struct ScaleOptions {
+  std::string device = "tpu";  // event reason text
+  // Test injection; production uses wall clock / $POD_NAME.
+  std::optional<int64_t> now_unix;
+  std::string reporting_instance;
+};
+
+// Emit the Event (failure logged only), then apply the per-kind patch.
+// Throws std::runtime_error when the PATCH itself fails — the caller counts
+// scale_failures and continues (main.rs:347-353).
+void scale_to_zero(const k8s::Client& client, const core::ScaleTarget& target,
+                   const ScaleOptions& opts = {});
+
+}  // namespace tpupruner::actuate

@@ -1,0 +1,46 @@
+// Bearer-token resolution chain for the metric plane.
+//
+// Reference analog: get_prometheus_token (gpu-pruner/src/lib.rs:205-231):
+//   PROMETHEUS_TOKEN env → kube config token_file → kube config token →
+//   `oc whoami -t` subprocess.
+//
+// TPU-native chain (GKE managed Prometheus / Cloud Monitoring auth):
+//   explicit --prometheus-token flag
+//   → PROMETHEUS_TOKEN env
+//   → in-cluster ServiceAccount token file
+//   → kubeconfig user token / tokenFile
+//   → GCE metadata server access token (Workload Identity / ADC path)
+//   → `gcloud auth print-access-token` subprocess (operator-laptop analog
+//     of the reference's `oc whoami -t`).
+// Every step is overridable for hermetic tests (env vars below).
+#pragma once
+
+#include <optional>
+#include <string>
+
+namespace tpupruner::auth {
+
+struct TokenOptions {
+  std::string explicit_token;  // from the CLI flag; wins when non-empty
+  // Env overrides honored (mainly for tests):
+  //   PROMETHEUS_TOKEN            — token value (reference parity, lib.rs:206)
+  //   TPU_PRUNER_SA_TOKEN_FILE    — in-cluster SA token path override
+  //   KUBECONFIG                  — kubeconfig path ("~/.kube/config" default)
+  //   GCE_METADATA_HOST           — metadata server host:port override
+  //   TPU_PRUNER_DISABLE_GCLOUD   — skip the subprocess fallback
+  bool allow_metadata_server = true;
+  bool allow_gcloud = true;
+  int metadata_timeout_ms = 2000;
+};
+
+// Returns a bearer token, or nullopt when every source comes up empty.
+// Never throws: each failed source falls through to the next.
+std::optional<std::string> get_bearer_token(const TokenOptions& opts = {});
+
+// Individual sources (exposed for tests).
+std::optional<std::string> token_from_sa_file();
+std::optional<std::string> token_from_kubeconfig();
+std::optional<std::string> token_from_metadata_server(int timeout_ms);
+std::optional<std::string> token_from_gcloud();
+
+}  // namespace tpupruner::auth

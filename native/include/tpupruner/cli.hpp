@@ -1,0 +1,70 @@
+// CLI flag surface.
+//
+// Reference analog: struct Cli (gpu-pruner/src/main.rs:46-119) — all 15
+// reference flags are kept (same names, shorts, defaults) so a gpu-pruner
+// deployment manifest ports by changing the binary name; TPU-native flags
+// are added (--device, --accelerator-type, --hbm-threshold, metric-name
+// overrides, --metrics-port). The reference serializes Cli into the Jinja
+// context (main.rs:281); here Cli maps onto query::QueryArgs the same way.
+#pragma once
+
+#include <optional>
+#include <stdexcept>
+#include <string>
+
+#include "tpupruner/core.hpp"
+#include "tpupruner/log.hpp"
+#include "tpupruner/query.hpp"
+
+namespace tpupruner::cli {
+
+struct CliError : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+// Raised by parse() on -h/--help; carries the usage text.
+struct HelpRequested : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+struct Cli {
+  // ── reference flags (main.rs:46-119) ──
+  int64_t duration = 30;                  // -t, minutes of no activity
+  bool daemon_mode = false;               // -d
+  std::string enabled_resources = "drsinj";  // -e (reference default "drsin" + JobSet)
+  int64_t check_interval = 180;           // -c, seconds (daemon mode)
+  std::string ns_regex;                   // -n, namespace pattern
+  int64_t grace_period = 300;             // -g, seconds
+  std::string model_name;                 // -m, GPU model pattern (device=gpu)
+  std::optional<double> power_threshold;  // --power-threshold, watts
+  bool honor_labels = false;              // --honor-labels
+  std::string run_mode = "dry-run";       // -r {scale-down, dry-run}
+  std::string prometheus_url;             // --prometheus-url (required for run)
+  std::string prometheus_token;           // --prometheus-token
+  std::string prometheus_tls_mode = "verify";  // {skip, verify}
+  std::string prometheus_tls_cert;        // --prometheus-tls-cert
+  std::string log_format = "default";     // -l {json, default, pretty}
+
+  // ── TPU-native flags ──
+  std::string device = "tpu";             // --device {tpu, gpu}
+  std::string accelerator_type;           // --accelerator-type pattern (device=tpu)
+  std::optional<double> hbm_threshold;    // --hbm-threshold, HBM bw util 0-1
+  std::string tensorcore_metric;          // --tensorcore-metric override
+  std::string duty_cycle_metric;          // --duty-cycle-metric override
+  std::string hbm_metric;                 // --hbm-metric override
+  int64_t resolve_concurrency = 10;       // --resolve-concurrency (ref: fixed 10)
+  int metrics_port = 0;                   // --metrics-port (>0 serves /metrics)
+
+  bool dry_run() const { return run_mode != "scale-down"; }
+};
+
+// Parse argv (past any subcommand). Throws CliError on unknown flags, bad
+// values, or missing required flags; HelpRequested on -h/--help.
+Cli parse(int argc, char** argv);
+
+std::string usage();
+
+query::QueryArgs to_query_args(const Cli& cli);
+log::Format log_format_of(const Cli& cli);
+
+}  // namespace tpupruner::cli

@@ -1,0 +1,46 @@
+// Daemon runtime: producer/consumer with a bounded queue.
+//
+// Reference analog: main() orchestration (gpu-pruner/src/main.rs:273-375):
+//   - query task (producer): optional interval tick → rebuild Prometheus
+//     client (fresh token each cycle) → run the query pipeline → reset or
+//     bump the consecutive-failure budget, exiting after >5 failures;
+//   - scale-down task (consumer): enabled-kind filter → scale, counting
+//     successes/failures;
+//   - bounded channel of 100 between them.
+// Tokio tasks become two std::threads; the channel becomes a
+// condvar-bounded queue. The daemon stays stateless across cycles
+// (SURVEY.md §5 checkpoint/resume: idempotency substitutes for resume).
+#pragma once
+
+#include <cstddef>
+#include <functional>
+#include <string>
+
+#include "tpupruner/cli.hpp"
+#include "tpupruner/k8s.hpp"
+
+namespace tpupruner::daemon {
+
+struct CycleStats {
+  size_t num_series = 0;       // raw series from the query
+  size_t num_pods = 0;         // unique (pod, ns)
+  size_t shutdown_events = 0;  // deduped root objects surviving gates
+};
+
+// One evaluation cycle (reference: run_query_and_scale, main.rs:390-570).
+// `enqueue` receives each surviving target (already enabled-kind agnostic —
+// filtering happens consumer-side, as in the reference). Throws on query
+// failure (feeds the failure budget).
+CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
+                     const std::function<void(core::ScaleTarget)>& enqueue);
+
+// Full daemon: spawns the two threads, joins them, returns the process
+// exit code (0 normal, 1 after failure-budget exhaustion).
+int run(const cli::Cli& args);
+
+// Failure budget: consecutive failures tolerated before exit (>5,
+// main.rs:317-320).
+constexpr int kMaxConsecutiveFailures = 5;
+constexpr size_t kQueueCapacity = 100;
+
+}  // namespace tpupruner::daemon

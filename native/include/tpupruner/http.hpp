@@ -1,0 +1,62 @@
+// Minimal blocking HTTP/1.1 client over POSIX sockets.
+//
+// Reference analog: the reqwest-backed clients (gpu-pruner/src/lib.rs:240-282
+// for Prometheus; kube's hyper client for the API server). This image ships
+// no libcurl/OpenSSL headers, so transport is hand-rolled: plain HTTP
+// natively, HTTPS through a dlopen()'d OpenSSL 3 shim (tls.cpp) — the
+// system libssl.so.3 exists even though its headers don't.
+//
+// Scope matches the reference's needs exactly: request/response with
+// bearer-token headers, TLS skip/verify modes and a custom CA bundle
+// (TlsMode, lib.rs:233-238, 248-271), content-length and chunked bodies.
+// No connection pooling: the reference rebuilds its Prometheus client every
+// cycle (main.rs:296) and the K8s call pattern is a handful of GETs/PATCHes
+// per candidate pod.
+#pragma once
+
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace tpupruner::http {
+
+enum class TlsMode { Skip, Verify };
+
+struct Url {
+  std::string scheme;  // "http" | "https"
+  std::string host;
+  int port = 80;
+  std::string target;  // path + query, always starts with '/'
+};
+
+std::optional<Url> parse_url(std::string_view url);
+
+struct Request {
+  std::string method = "GET";
+  std::string url;
+  std::vector<std::pair<std::string, std::string>> headers;
+  std::string body;
+  int timeout_ms = 30000;
+};
+
+struct Response {
+  int status = 0;
+  std::string body;
+  std::map<std::string, std::string> headers;  // keys lowercased
+};
+
+class Client {
+ public:
+  explicit Client(TlsMode tls_mode = TlsMode::Verify, std::string ca_file = "");
+
+  // Throws std::runtime_error on transport/TLS errors; HTTP error statuses
+  // are returned, not thrown.
+  Response request(const Request& req) const;
+
+ private:
+  TlsMode tls_mode_;
+  std::string ca_file_;
+};
+
+}  // namespace tpupruner::http

@@ -1,0 +1,71 @@
+// Minimal Kubernetes REST client.
+//
+// Reference analog: the kube-rs Client (gpu-pruner/src/main.rs:333, 411) —
+// but deliberately watch-free and typed-binding-free: the reference only
+// ever GETs single objects, LISTs pods by label, PATCHes, and POSTs Events
+// (SURVEY.md §7 "hard parts" #2), and CR objects are handled as JSON
+// (§2 #10). Config inference order:
+//   1. env: KUBE_API_URL (+ KUBE_TOKEN / KUBE_TOKEN_FILE / KUBE_CA_FILE /
+//      KUBE_TLS_SKIP) — also the hermetic-test seam;
+//   2. in-cluster: KUBERNETES_SERVICE_HOST/PORT + mounted SA token and CA;
+//   3. kubeconfig scan: current cluster server + user token (token auth
+//      only; exec/client-cert auth is out of scope and errors clearly).
+#pragma once
+
+#include <optional>
+#include <string>
+
+#include "tpupruner/core.hpp"
+#include "tpupruner/http.hpp"
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::k8s {
+
+struct Config {
+  std::string api_url;   // e.g. https://10.0.0.1:443
+  std::string token;     // bearer; empty for anonymous (tests)
+  std::string ca_file;   // PEM bundle for the API server
+  bool tls_skip = false;
+  int timeout_ms = 15000;
+
+  // Throws std::runtime_error with the probed locations when nothing works.
+  static Config infer();
+};
+
+class Client {
+ public:
+  explicit Client(Config config);
+
+  const Config& config() const { return config_; }
+
+  // GET that treats 404 as nullopt (reference get_opt, main.rs:453).
+  std::optional<json::Value> get_opt(const std::string& path) const;
+  // GET that throws on any non-2xx.
+  json::Value get(const std::string& path) const;
+  // LIST with an urlencoded labelSelector; returns the List object.
+  json::Value list(const std::string& path, const std::string& label_selector) const;
+  // application/merge-patch+json PATCH (reference Patch::Merge).
+  json::Value patch_merge(const std::string& path, const json::Value& body) const;
+  json::Value post(const std::string& path, const json::Value& body) const;
+
+  // ── path builders ──
+  static std::string pod_path(const std::string& ns, const std::string& name);
+  static std::string pods_path(const std::string& ns);
+  static std::string events_path(const std::string& ns);
+  // Object path for a scalable kind (CRs included).
+  static std::string object_path(core::Kind kind, const std::string& ns,
+                                 const std::string& name);
+  // /scale subresource path (Deployment/ReplicaSet/StatefulSet).
+  static std::string scale_path(core::Kind kind, const std::string& ns,
+                                const std::string& name);
+
+ private:
+  json::Value request_json(const std::string& method, const std::string& path,
+                           const std::string& body, const std::string& content_type,
+                           int* status_out) const;
+
+  Config config_;
+  http::Client http_;
+};
+
+}  // namespace tpupruner::k8s

@@ -1,0 +1,26 @@
+// Shared minimal kubeconfig scan (token-auth users only).
+//
+// The reference gets full kubeconfig semantics from kube-rs
+// (lib.rs:212-223); here the daemon only needs the cluster server URL and
+// a bearer token, so one line-scanner serves both the auth chain
+// (auth.cpp) and K8s config inference (k8s.cpp). Exec plugins and client
+// certificates are intentionally unsupported — in-cluster SA auth and
+// env-based config are the production paths.
+#pragma once
+
+#include <optional>
+#include <string>
+
+namespace tpupruner::kubeconfig {
+
+struct Info {
+  std::string server;  // first `server:` value
+  std::string token;   // first `token:` value, or contents of `tokenFile:`
+  bool tls_skip = false;
+};
+
+// Scan $KUBECONFIG (or ~/.kube/config). nullopt when the file is missing
+// or contains no server.
+std::optional<Info> scan();
+
+}  // namespace tpupruner::kubeconfig

@@ -1,0 +1,41 @@
+// Structured logging + operational counters.
+//
+// Reference analog: tracing-subscriber with three formats (json / default /
+// pretty, main.rs:128-134, 176-192), level filtering via RUST_LOG
+// (main.rs:173), and tracing-field counters that the OTEL layer turns into
+// metrics (main.rs:300-321, 349-365). Here: same three formats on stderr,
+// level via TPU_PRUNER_LOG (or RUST_LOG for drop-in familiarity), and a
+// process-wide counter registry with the reference's six counter names —
+// exposed over the optional /metrics endpoint instead of OTLP push.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+
+namespace tpupruner::log {
+
+enum class Level : uint8_t { Trace = 0, Debug, Info, Warn, Error };
+enum class Format : uint8_t { Default, Json, Pretty };
+
+void init(Format format);
+// Level resolution: TPU_PRUNER_LOG → RUST_LOG → "info".
+Level threshold();
+
+void write(Level level, const std::string& msg);
+
+inline void trace(const std::string& msg) { write(Level::Trace, msg); }
+inline void debug(const std::string& msg) { write(Level::Debug, msg); }
+inline void info(const std::string& msg) { write(Level::Info, msg); }
+inline void warn(const std::string& msg) { write(Level::Warn, msg); }
+inline void error(const std::string& msg) { write(Level::Error, msg); }
+
+// Counters (reference names, main.rs:300-365):
+//   query_successes, query_failures, scale_successes, scale_failures,
+//   query_returned_candidates, query_returned_shutdown_events
+void counter_add(const std::string& name, uint64_t delta);
+void counter_set(const std::string& name, uint64_t value);
+std::map<std::string, uint64_t> counters_snapshot();
+void counters_reset_for_test();
+
+}  // namespace tpupruner::log

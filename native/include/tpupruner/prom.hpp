@@ -1,0 +1,33 @@
+// Prometheus HTTP API client (instant queries).
+//
+// Reference analog: prometheus_http_query::Client built per cycle with
+// bearer auth + TLS modes (gpu-pruner/src/lib.rs:240-282, main.rs:296,
+// 377-388). Works against vanilla Prometheus, Thanos query frontends, and
+// the GKE managed-Prometheus query endpoint (all speak /api/v1/query).
+#pragma once
+
+#include <string>
+
+#include "tpupruner/http.hpp"
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::prom {
+
+class Client {
+ public:
+  Client(std::string base_url, std::string bearer_token,
+         http::TlsMode tls_mode = http::TlsMode::Verify, std::string ca_file = "",
+         int timeout_ms = 30000);
+
+  // POST /api/v1/query (form-encoded). Returns the decoded JSON response
+  // body; throws std::runtime_error on transport errors or non-2xx status.
+  json::Value instant_query(const std::string& promql) const;
+
+ private:
+  std::string base_url_;
+  std::string token_;
+  http::Client http_;
+  int timeout_ms_;
+};
+
+}  // namespace tpupruner::prom

@@ -1,0 +1,46 @@
+// Owner-reference resolution: pod → root scalable object.
+//
+// Reference analog: find_root_object (gpu-pruner/src/lib.rs:437-513):
+//   kserve label shortcut → InferenceService;
+//   ownerRef ReplicaSet → (Deployment | ReplicaSet);
+//   ownerRef StatefulSet → (Notebook | StatefulSet);
+//   unknown kinds ignored; error when nothing matches.
+//
+// TPU-native addition (SURVEY.md §7.3): ownerRef Job → JobSet — the owner
+// chain of every multi-host GKE TPU slice pod — plus the slice-completeness
+// gate: a JobSet may only be suspended when EVERY tpu-requesting pod of the
+// slice is in the idle set (a partially idle slice means the workload is
+// alive and mid-collective; suspending it would kill healthy hosts).
+#pragma once
+
+#include <functional>
+#include <set>
+#include <string>
+
+#include "tpupruner/core.hpp"
+#include "tpupruner/k8s.hpp"
+
+namespace tpupruner::walker {
+
+// Resolve the root scalable object for a pod (fetched Pod JSON).
+// Throws std::runtime_error("no scalable root object ...") when the pod has
+// no recognized owner chain — callers log-and-skip (main.rs:517-527).
+core::ScaleTarget find_root_object(const k8s::Client& client, const json::Value& pod);
+
+// Key "ns/pod" set of idle pods discovered this cycle.
+using IdlePodSet = std::set<std::string>;
+inline std::string pod_key(const std::string& ns, const std::string& name) {
+  return ns + "/" + name;
+}
+
+// True when every pod of `jobset` that requests google.com/tpu resources is
+// present in `idle`. Lists the JobSet's pods via the
+// jobset.sigs.k8s.io/jobset-name label.
+bool jobset_fully_idle(const k8s::Client& client, const core::ScaleTarget& jobset,
+                       const IdlePodSet& idle);
+
+// True when any container of the pod requests google.com/tpu (requests or
+// limits) — the resource-model filter for slice membership.
+bool pod_requests_tpu(const json::Value& pod);
+
+}  // namespace tpupruner::walker

@@ -1,0 +1,71 @@
+#include "tpupruner/actuate.hpp"
+
+#include <stdexcept>
+
+#include "tpupruner/log.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::actuate {
+
+using core::Kind;
+using core::ScaleTarget;
+using json::Value;
+
+void scale_to_zero(const k8s::Client& client, const ScaleTarget& target,
+                   const ScaleOptions& opts) {
+  auto ns_opt = target.ns();
+  if (!ns_opt) throw std::runtime_error("target has no namespace: " + target.name());
+  const std::string& ns = *ns_opt;
+  const std::string name = target.name();
+
+  // 1. audit Event first; failure is log-only (lib.rs:344-348)
+  {
+    core::EventOptions ev_opts;
+    ev_opts.device = opts.device;
+    ev_opts.now_unix = opts.now_unix;
+    ev_opts.reporting_instance = opts.reporting_instance;
+    Value event = core::generate_scale_event(target, ev_opts);
+    try {
+      client.post(k8s::Client::events_path(ns), event);
+      log::debug("emitted scale event for " + ns + "/" + name);
+    } catch (const std::exception& e) {
+      log::error(std::string("Failed to push Event for scale down!: ") + e.what());
+    }
+  }
+
+  // 2. the per-kind pause
+  switch (target.kind) {
+    case Kind::Deployment:
+    case Kind::ReplicaSet:
+    case Kind::StatefulSet: {
+      Value patch = Value::parse(R"({"spec":{"replicas":0}})");
+      client.patch_merge(k8s::Client::scale_path(target.kind, ns, name), patch);
+      break;
+    }
+    case Kind::Notebook: {
+      int64_t now = opts.now_unix.value_or(util::now_unix());
+      Value patch = Value::object();
+      Value annotations = Value::object();
+      // Kubeflow's notebook-controller stops the notebook when this
+      // annotation carries a timestamp (lib.rs:536-545).
+      annotations.set("kubeflow-resource-stopped", Value(util::format_rfc3339(now)));
+      Value meta = Value::object();
+      meta.set("annotations", std::move(annotations));
+      patch.set("metadata", std::move(meta));
+      client.patch_merge(k8s::Client::object_path(Kind::Notebook, ns, name), patch);
+      break;
+    }
+    case Kind::InferenceService: {
+      Value patch = Value::parse(R"({"spec":{"predictor":{"minReplicas":0}}})");
+      client.patch_merge(k8s::Client::object_path(Kind::InferenceService, ns, name), patch);
+      break;
+    }
+    case Kind::JobSet: {
+      Value patch = Value::parse(R"({"spec":{"suspend":true}})");
+      client.patch_merge(k8s::Client::object_path(Kind::JobSet, ns, name), patch);
+      break;
+    }
+  }
+}
+
+}  // namespace tpupruner::actuate

@@ -1,0 +1,87 @@
+#include "tpupruner/auth.hpp"
+
+#include <cstdio>
+
+#include "tpupruner/http.hpp"
+#include "tpupruner/json.hpp"
+#include "tpupruner/kubeconfig.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::auth {
+
+namespace {
+constexpr const char* kDefaultSaTokenFile =
+    "/var/run/secrets/kubernetes.io/serviceaccount/token";
+}
+
+std::optional<std::string> token_from_sa_file() {
+  std::string path =
+      util::env("TPU_PRUNER_SA_TOKEN_FILE").value_or(kDefaultSaTokenFile);
+  auto content = util::read_file(path);
+  if (!content) return std::nullopt;
+  std::string token = util::trim(*content);
+  if (token.empty()) return std::nullopt;
+  return token;
+}
+
+std::optional<std::string> token_from_kubeconfig() {
+  auto info = kubeconfig::scan();
+  if (info && !info->token.empty()) return info->token;
+  return std::nullopt;
+}
+
+std::optional<std::string> token_from_metadata_server(int timeout_ms) {
+  // Workload Identity / ADC: the GCE metadata server mints access tokens
+  // for the bound service account. This is how a GKE pod talks to the
+  // Cloud Monitoring / GMP query endpoint without mounted secrets.
+  std::string host = util::env("GCE_METADATA_HOST").value_or("metadata.google.internal");
+  try {
+    http::Client client(http::TlsMode::Verify);
+    http::Request req;
+    req.url = "http://" + host +
+              "/computeMetadata/v1/instance/service-accounts/default/token";
+    req.headers.push_back({"Metadata-Flavor", "Google"});
+    req.timeout_ms = timeout_ms;
+    http::Response resp = client.request(req);
+    if (resp.status != 200) return std::nullopt;
+    json::Value v = json::Value::parse(resp.body);
+    const json::Value* token = v.find("access_token");
+    if (!token || !token->is_string() || token->as_string().empty()) return std::nullopt;
+    return token->as_string();
+  } catch (const std::exception&) {
+    return std::nullopt;
+  }
+}
+
+std::optional<std::string> token_from_gcloud() {
+  // Operator-laptop fallback, the analog of `oc whoami -t` (lib.rs:225-230).
+  FILE* pipe = ::popen("gcloud auth print-access-token 2>/dev/null", "r");
+  if (!pipe) return std::nullopt;
+  std::string out;
+  char buf[4096];
+  size_t n;
+  while ((n = ::fread(buf, 1, sizeof(buf), pipe)) > 0) out.append(buf, n);
+  int rc = ::pclose(pipe);
+  if (rc != 0) return std::nullopt;
+  std::string token = util::trim(out);
+  if (token.empty()) return std::nullopt;
+  return token;
+}
+
+std::optional<std::string> get_bearer_token(const TokenOptions& opts) {
+  if (!opts.explicit_token.empty()) return opts.explicit_token;
+  if (auto t = util::env("PROMETHEUS_TOKEN")) {
+    if (!t->empty()) return t;
+  }
+  if (auto t = token_from_sa_file()) return t;
+  if (auto t = token_from_kubeconfig()) return t;
+  if (opts.allow_metadata_server && !util::env("TPU_PRUNER_DISABLE_METADATA")) {
+    if (auto t = token_from_metadata_server(opts.metadata_timeout_ms)) return t;
+  }
+  if (opts.allow_gcloud && !util::env("TPU_PRUNER_DISABLE_GCLOUD")) {
+    if (auto t = token_from_gcloud()) return t;
+  }
+  return std::nullopt;
+}
+
+}  // namespace tpupruner::auth

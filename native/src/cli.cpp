@@ -1,0 +1,220 @@
+#include "tpupruner/cli.hpp"
+
+#include <cstdlib>
+#include <functional>
+#include <map>
+#include <vector>
+
+namespace tpupruner::cli {
+
+namespace {
+
+int64_t parse_int(const std::string& flag, const std::string& v) {
+  try {
+    size_t idx = 0;
+    int64_t out = std::stoll(v, &idx);
+    if (idx != v.size()) throw std::invalid_argument("trailing");
+    return out;
+  } catch (const std::exception&) {
+    throw CliError("invalid integer for " + flag + ": '" + v + "'");
+  }
+}
+
+double parse_double(const std::string& flag, const std::string& v) {
+  try {
+    size_t idx = 0;
+    double out = std::stod(v, &idx);
+    if (idx != v.size()) throw std::invalid_argument("trailing");
+    return out;
+  } catch (const std::exception&) {
+    throw CliError("invalid number for " + flag + ": '" + v + "'");
+  }
+}
+
+void check_choice(const std::string& flag, const std::string& v,
+                  std::initializer_list<const char*> choices) {
+  for (const char* c : choices) {
+    if (v == c) return;
+  }
+  std::string opts;
+  for (const char* c : choices) {
+    if (!opts.empty()) opts += ", ";
+    opts += c;
+  }
+  throw CliError("invalid value for " + flag + ": '" + v + "' (expected one of: " + opts + ")");
+}
+
+}  // namespace
+
+std::string usage() {
+  return R"(tpu-pruner — TPU-native idle-workload pruner for Kubernetes
+
+Queries a Prometheus-compatible metric plane for pods whose accelerators
+showed zero peak utilization over a lookback window, resolves each pod's
+owner chain to the root scalable object, and non-destructively pauses it.
+
+USAGE:
+  tpu-pruner [FLAGS]
+  tpu-pruner querytest <promql> <prometheus-url>
+
+FLAGS:
+  -t, --duration <MIN>          minutes of no activity required to prune [default: 30]
+  -d, --daemon-mode             run indefinitely on --check-interval
+  -e, --enabled-resources <S>   kinds that may be scaled, as flag chars [default: drsinj]
+                                  d=Deployment r=ReplicaSet s=StatefulSet
+                                  i=InferenceService n=Notebook j=JobSet
+  -c, --check-interval <SEC>    daemon-mode cycle interval [default: 180]
+  -n, --namespace <REGEX>       namespace filter pushed into the query
+  -g, --grace-period <SEC>      extra seconds for metric publication lag [default: 300]
+  -m, --model-name <REGEX>      GPU model filter, e.g. "NVIDIA A10G" (device=gpu)
+      --power-threshold <W>     GPU power corroboration threshold (device=gpu)
+  -r, --run-mode <MODE>         scale-down | dry-run [default: dry-run]
+      --honor-labels            scrape config uses honorLabels: true
+      --prometheus-url <URL>    metric-plane query endpoint (required)
+      --prometheus-token <TOK>  bearer token; default: auth chain (env →
+                                SA token → kubeconfig → GCE metadata → gcloud)
+      --prometheus-tls-mode <M> verify | skip [default: verify]
+      --prometheus-tls-cert <F> custom PEM bundle for TLS verification
+  -l, --log-format <F>          default | json | pretty [default: default]
+
+TPU FLAGS:
+      --device <D>              tpu | gpu [default: tpu]
+      --accelerator-type <RE>   TPU accelerator filter, e.g. "tpu-v5-lite-podslice"
+      --hbm-threshold <F>       HBM bandwidth-util corroboration, 0-1 (e.g. 0.05)
+      --tensorcore-metric <N>   override primary utilization metric name
+      --duty-cycle-metric <N>   override duty-cycle fallback metric name
+      --hbm-metric <N>          override HBM bandwidth metric name
+      --resolve-concurrency <N> concurrent pod resolutions [default: 10]
+      --metrics-port <P>        serve Prometheus /metrics on this port
+  -h, --help                    print this help
+)";
+}
+
+Cli parse(int argc, char** argv) {
+  Cli cli;
+  std::vector<std::string> args(argv, argv + argc);
+
+  // flag → handler(value). Boolean flags take no value.
+  std::map<std::string, std::function<void(const std::string&)>> with_value = {
+      {"--duration", [&](const std::string& v) { cli.duration = parse_int("--duration", v); }},
+      {"--enabled-resources", [&](const std::string& v) { cli.enabled_resources = v; }},
+      {"--check-interval",
+       [&](const std::string& v) { cli.check_interval = parse_int("--check-interval", v); }},
+      {"--namespace", [&](const std::string& v) { cli.ns_regex = v; }},
+      {"--grace-period",
+       [&](const std::string& v) { cli.grace_period = parse_int("--grace-period", v); }},
+      {"--model-name", [&](const std::string& v) { cli.model_name = v; }},
+      {"--power-threshold",
+       [&](const std::string& v) { cli.power_threshold = parse_double("--power-threshold", v); }},
+      {"--run-mode",
+       [&](const std::string& v) {
+         check_choice("--run-mode", v, {"scale-down", "dry-run"});
+         cli.run_mode = v;
+       }},
+      {"--prometheus-url", [&](const std::string& v) { cli.prometheus_url = v; }},
+      {"--prometheus-token", [&](const std::string& v) { cli.prometheus_token = v; }},
+      {"--prometheus-tls-mode",
+       [&](const std::string& v) {
+         check_choice("--prometheus-tls-mode", v, {"verify", "skip"});
+         cli.prometheus_tls_mode = v;
+       }},
+      {"--prometheus-tls-cert", [&](const std::string& v) { cli.prometheus_tls_cert = v; }},
+      {"--log-format",
+       [&](const std::string& v) {
+         check_choice("--log-format", v, {"default", "json", "pretty"});
+         cli.log_format = v;
+       }},
+      {"--device",
+       [&](const std::string& v) {
+         check_choice("--device", v, {"tpu", "gpu"});
+         cli.device = v;
+       }},
+      {"--accelerator-type", [&](const std::string& v) { cli.accelerator_type = v; }},
+      {"--hbm-threshold",
+       [&](const std::string& v) { cli.hbm_threshold = parse_double("--hbm-threshold", v); }},
+      {"--tensorcore-metric", [&](const std::string& v) { cli.tensorcore_metric = v; }},
+      {"--duty-cycle-metric", [&](const std::string& v) { cli.duty_cycle_metric = v; }},
+      {"--hbm-metric", [&](const std::string& v) { cli.hbm_metric = v; }},
+      {"--resolve-concurrency",
+       [&](const std::string& v) {
+         cli.resolve_concurrency = parse_int("--resolve-concurrency", v);
+         if (cli.resolve_concurrency < 1) throw CliError("--resolve-concurrency must be >= 1");
+       }},
+      {"--metrics-port",
+       [&](const std::string& v) {
+         cli.metrics_port = static_cast<int>(parse_int("--metrics-port", v));
+         if (cli.metrics_port < 0 || cli.metrics_port > 65535)
+           throw CliError("--metrics-port out of range");
+       }},
+  };
+  std::map<std::string, std::string> shorts = {
+      {"-t", "--duration"},       {"-e", "--enabled-resources"},
+      {"-c", "--check-interval"}, {"-n", "--namespace"},
+      {"-g", "--grace-period"},   {"-m", "--model-name"},
+      {"-r", "--run-mode"},       {"-l", "--log-format"},
+  };
+
+  for (size_t i = 1; i < args.size(); ++i) {
+    std::string arg = args[i];
+    if (arg == "-h" || arg == "--help") throw HelpRequested(usage());
+    if (arg == "-d" || arg == "--daemon-mode") {
+      cli.daemon_mode = true;
+      continue;
+    }
+    if (arg == "--honor-labels") {
+      cli.honor_labels = true;
+      continue;
+    }
+    // --flag=value form
+    std::string value;
+    bool has_inline = false;
+    size_t eq = arg.find('=');
+    if (eq != std::string::npos && arg.size() > 2 && arg[0] == '-' && arg[1] == '-') {
+      value = arg.substr(eq + 1);
+      arg = arg.substr(0, eq);
+      has_inline = true;
+    }
+    if (auto s = shorts.find(arg); s != shorts.end()) arg = s->second;
+    auto handler = with_value.find(arg);
+    if (handler == with_value.end()) {
+      throw CliError("unknown flag: " + arg + " (see --help)");
+    }
+    if (!has_inline) {
+      if (i + 1 >= args.size()) throw CliError(arg + " requires a value");
+      value = args[++i];
+    }
+    handler->second(value);
+  }
+
+  if (cli.prometheus_url.empty()) {
+    throw CliError("--prometheus-url is required (see --help)");
+  }
+  if (cli.duration < 1) throw CliError("--duration must be >= 1 minute");
+  if (cli.check_interval < 1) throw CliError("--check-interval must be >= 1 second");
+  if (cli.grace_period < 0) throw CliError("--grace-period must be >= 0");
+  return cli;
+}
+
+query::QueryArgs to_query_args(const Cli& cli) {
+  query::QueryArgs a;
+  a.device = cli.device;
+  a.duration_min = cli.duration;
+  a.namespace_regex = cli.ns_regex;
+  a.model_regex = cli.model_name;
+  a.accelerator_regex = cli.accelerator_type;
+  a.power_threshold = cli.power_threshold;
+  a.hbm_threshold = cli.hbm_threshold;
+  a.honor_labels = cli.honor_labels;
+  if (!cli.tensorcore_metric.empty()) a.tensorcore_metric = cli.tensorcore_metric;
+  if (!cli.duty_cycle_metric.empty()) a.duty_cycle_metric = cli.duty_cycle_metric;
+  if (!cli.hbm_metric.empty()) a.hbm_metric = cli.hbm_metric;
+  return a;
+}
+
+log::Format log_format_of(const Cli& cli) {
+  if (cli.log_format == "json") return log::Format::Json;
+  if (cli.log_format == "pretty") return log::Format::Pretty;
+  return log::Format::Default;
+}
+
+}  // namespace tpupruner::cli

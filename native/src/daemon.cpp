@@ -1,0 +1,309 @@
+#include "tpupruner/daemon.hpp"
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "metrics_http.hpp"
+#include "tpupruner/actuate.hpp"
+#include "tpupruner/auth.hpp"
+#include "tpupruner/log.hpp"
+#include "tpupruner/metrics.hpp"
+#include "tpupruner/prom.hpp"
+#include "tpupruner/util.hpp"
+#include "tpupruner/walker.hpp"
+
+namespace tpupruner::daemon {
+
+using core::ScaleTarget;
+
+namespace {
+
+// Bounded MPSC queue with close semantics (reference: tokio mpsc::channel
+// of 100, main.rs:284).
+class TargetQueue {
+ public:
+  explicit TargetQueue(size_t capacity) : capacity_(capacity) {}
+
+  void push(ScaleTarget t) {
+    std::unique_lock<std::mutex> lock(mutex_);
+    not_full_.wait(lock, [&] { return queue_.size() < capacity_ || closed_; });
+    if (closed_) return;
+    queue_.push_back(std::move(t));
+    not_empty_.notify_one();
+  }
+
+  std::optional<ScaleTarget> pop() {
+    std::unique_lock<std::mutex> lock(mutex_);
+    not_empty_.wait(lock, [&] { return !queue_.empty() || closed_; });
+    if (queue_.empty()) return std::nullopt;  // closed and drained
+    ScaleTarget t = std::move(queue_.front());
+    queue_.pop_front();
+    not_full_.notify_one();
+    return t;
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> lock(mutex_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+ private:
+  std::mutex mutex_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<ScaleTarget> queue_;
+  size_t capacity_;
+  bool closed_ = false;
+};
+
+prom::Client build_prom_client(const cli::Cli& args) {
+  // Fresh token each cycle, like the reference's per-cycle client rebuild
+  // (main.rs:296, 377-388) — tokens rotate (SA projection, metadata server).
+  auth::TokenOptions topts;
+  topts.explicit_token = args.prometheus_token;
+  std::string token = auth::get_bearer_token(topts).value_or("");
+  if (token.empty()) {
+    log::warn("no bearer token resolved for prometheus; sending unauthenticated requests");
+  }
+  http::TlsMode tls =
+      args.prometheus_tls_mode == "skip" ? http::TlsMode::Skip : http::TlsMode::Verify;
+  return prom::Client(args.prometheus_url, token, tls, args.prometheus_tls_cert);
+}
+
+struct ResolveOutcome {
+  std::vector<ScaleTarget> targets;
+  walker::IdlePodSet idle_pods;  // pods idle AND eligible (for the slice gate)
+};
+
+// Concurrent pod-resolution fan-out (reference: buffer_unordered(10),
+// main.rs:447-532). Each sample costs 1-3 K8s API round-trips.
+ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
+                            const std::vector<core::PodMetricSample>& samples) {
+  ResolveOutcome out;
+  std::mutex out_mutex;
+  std::atomic<size_t> next{0};
+  int64_t lookback_secs = args.duration * 60 + args.grace_period;  // main.rs:413-414
+  int64_t now = util::now_unix();
+
+  size_t workers =
+      std::min<size_t>(static_cast<size_t>(args.resolve_concurrency), samples.size());
+  if (workers == 0) return out;
+
+  auto worker_fn = [&] {
+    while (true) {
+      size_t i = next.fetch_add(1);
+      if (i >= samples.size()) break;
+      const core::PodMetricSample& pmd = samples[i];
+      std::string key = pmd.ns + "/" + pmd.name;
+
+      std::optional<json::Value> pod;
+      try {
+        pod = kube.get_opt(k8s::Client::pod_path(pmd.ns, pmd.name));
+      } catch (const std::exception& e) {
+        log::error("Skipping " + key + ", retrieval error: " + e.what());
+        continue;
+      }
+      if (!pod) {
+        log::info("Skipping " + key + ", pod no longer exists");
+        continue;
+      }
+
+      core::Eligibility elig = core::check_eligibility(*pod, now, lookback_secs);
+      switch (elig) {
+        case core::Eligibility::Pending:
+          log::info("Skipping pod " + key + ", it's still pending");
+          continue;
+        case core::Eligibility::NoCreationTs:
+          log::warn("Pod " + key + " has no creation timestamp, skipping");
+          continue;
+        case core::Eligibility::BadTimestamp:
+          log::warn("Pod " + key + " has unparseable creation timestamp, skipping");
+          continue;
+        case core::Eligibility::TooYoung:
+          log::info("Pod " + key + " created within lookback window, skipping");
+          continue;
+        case core::Eligibility::Eligible:
+          break;
+      }
+      log::info("Pod " + key + " is idle and eligible for scaledown");
+
+      std::optional<ScaleTarget> target;
+      try {
+        target = walker::find_root_object(kube, *pod);
+      } catch (const std::exception& e) {
+        log::warn("Skipping " + key + ", no scalable root object: " + e.what());
+      }
+
+      std::lock_guard<std::mutex> lock(out_mutex);
+      out.idle_pods.insert(key);
+      if (target) out.targets.push_back(std::move(*target));
+    }
+  };
+
+  std::vector<std::thread> threads;
+  threads.reserve(workers);
+  for (size_t i = 0; i < workers; ++i) threads.emplace_back(worker_fn);
+  for (std::thread& t : threads) t.join();
+  return out;
+}
+
+}  // namespace
+
+CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
+                     const std::function<void(ScaleTarget)>& enqueue) {
+  prom::Client prom_client = build_prom_client(args);
+  json::Value response = prom_client.instant_query(query);
+
+  metrics::DecodeResult decoded = metrics::decode_instant_vector(response, args.device);
+  for (const std::string& err : decoded.errors) {
+    log::error("Failed to unwrap pod fields: " + err);
+  }
+  log::info("Query returned " + std::to_string(decoded.num_series) + " series across " +
+            std::to_string(decoded.samples.size()) + " unique pods");
+
+  ResolveOutcome resolved = resolve_pods(args, kube, decoded.samples);
+  std::vector<ScaleTarget> unique = core::dedup_targets(std::move(resolved.targets));
+
+  // Multi-host slice gate: a JobSet is only a candidate when every
+  // google.com/tpu pod of the slice is idle (SURVEY.md §7 hard-part #1 —
+  // a partial-slice suspend would kill live hosts mid-collective).
+  std::vector<ScaleTarget> survivors;
+  survivors.reserve(unique.size());
+  for (ScaleTarget& t : unique) {
+    if (t.kind == core::Kind::JobSet) {
+      try {
+        if (!walker::jobset_fully_idle(kube, t, resolved.idle_pods)) continue;
+      } catch (const std::exception& e) {
+        log::warn("jobset idleness check failed for " + t.name() + ": " + e.what());
+        continue;
+      }
+    }
+    survivors.push_back(std::move(t));
+  }
+
+  CycleStats stats;
+  stats.num_series = decoded.num_series;
+  stats.num_pods = decoded.samples.size();
+  stats.shutdown_events = survivors.size();
+
+  for (ScaleTarget& t : survivors) {
+    std::string desc = "[" + std::string(core::kind_name(t.kind)) + "] " +
+                       t.ns().value_or("") + ":" + t.name();
+    if (args.dry_run()) {
+      log::info("Dry-run: Would have sent " + desc + " for scaledown");
+    } else {
+      log::info("Sending " + desc + " for scaledown");
+      enqueue(std::move(t));
+    }
+  }
+  return stats;
+}
+
+int run(const cli::Cli& args) {
+  core::ResourceSet enabled = core::parse_enabled_resources(args.enabled_resources);
+  {
+    std::string kinds;
+    for (int i = 0; i < core::kNumKinds; ++i) {
+      core::Kind k = static_cast<core::Kind>(i);
+      if (enabled & core::flag(k)) {
+        if (!kinds.empty()) kinds += ", ";
+        kinds += core::kind_name(k);
+      }
+    }
+    log::info("Enabled resources: [" + kinds + "]");
+  }
+
+  // Query built once, reused every cycle (main.rs:280-282).
+  std::string query = query::build_idle_query(cli::to_query_args(args));
+  log::info("Running w/ Query: " + query);
+
+  k8s::Client kube = [&] {
+    try {
+      return k8s::Client(k8s::Config::infer());
+    } catch (const std::exception& e) {
+      log::error(std::string("failed to get kube client: ") + e.what());
+      throw;
+    }
+  }();
+
+  // Optional pull-based counters exposition (OTLP-push analog, SURVEY.md §2 #12).
+  std::unique_ptr<metrics_http::Server> metrics_server;
+  if (args.metrics_port > 0) {
+    metrics_server = std::make_unique<metrics_http::Server>(args.metrics_port);
+  }
+
+  TargetQueue queue(kQueueCapacity);
+
+  std::thread consumer([&] {
+    while (true) {
+      std::optional<ScaleTarget> t = queue.pop();
+      if (!t) break;  // closed + drained
+      if (!(enabled & core::flag(t->kind))) {
+        log::info("Skipping resource type " + std::string(core::kind_name(t->kind)) +
+                  " because it is not enabled");
+        continue;
+      }
+      actuate::ScaleOptions opts;
+      opts.device = args.device;
+      try {
+        actuate::scale_to_zero(kube, *t, opts);
+      } catch (const std::exception& e) {
+        log::counter_add("scale_failures", 1);
+        log::error(std::string("Failed to scale resource! ") + e.what());
+        continue;
+      }
+      log::counter_add("scale_successes", 1);
+      log::info("Scaled Resource: [" + std::string(core::kind_name(t->kind)) + "] - " +
+                t->ns().value_or("default") + ":" + t->name());
+    }
+  });
+
+  // Producer loop (reference query_task, main.rs:286-330).
+  int consecutive_failures = 0;
+  bool budget_exhausted = false;
+  bool last_cycle_failed = false;
+  while (true) {
+    auto cycle_start = std::chrono::steady_clock::now();
+    last_cycle_failed = false;
+    try {
+      CycleStats stats = run_cycle(args, query, kube, [&](ScaleTarget t) {
+        queue.push(std::move(t));
+      });
+      consecutive_failures = 0;
+      log::counter_add("query_successes", 1);
+      log::counter_set("query_returned_candidates", stats.num_pods);
+      log::counter_set("query_returned_shutdown_events", stats.shutdown_events);
+      log::info("Query succeeded: " + std::to_string(stats.num_pods) + " candidates, " +
+                std::to_string(stats.shutdown_events) + " shutdown events");
+    } catch (const std::exception& e) {
+      int prev = consecutive_failures++;
+      last_cycle_failed = true;
+      log::counter_add("query_failures", 1);
+      log::error(std::string("Failed to run query and scale down: ") + e.what());
+      if (prev > kMaxConsecutiveFailures) {
+        log::error("Too many consecutive failures, exiting");
+        budget_exhausted = true;
+        break;
+      }
+    }
+    if (!args.daemon_mode) break;
+    auto elapsed = std::chrono::steady_clock::now() - cycle_start;
+    auto interval = std::chrono::seconds(args.check_interval);
+    if (elapsed < interval) std::this_thread::sleep_for(interval - elapsed);
+  }
+
+  queue.close();
+  consumer.join();
+  // Deviation from the reference (which exits 0 even when its only cycle
+  // failed, main.rs:324-326): a failed single-shot run exits 1 so cron/CI
+  // wrappers can detect it. Daemon mode exits 1 only on budget exhaustion.
+  return (budget_exhausted || (!args.daemon_mode && last_cycle_failed)) ? 1 : 0;
+}
+
+}  // namespace tpupruner::daemon

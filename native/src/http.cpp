@@ -1,0 +1,298 @@
+#include "tpupruner/http.hpp"
+
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <memory>
+#include <stdexcept>
+
+#include "tls.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::http {
+
+namespace {
+
+struct FdGuard {
+  int fd = -1;
+  ~FdGuard() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+[[noreturn]] void fail(const std::string& msg) { throw std::runtime_error("http: " + msg); }
+
+int connect_with_timeout(const std::string& host, int port, int timeout_ms) {
+  struct addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  int rc = ::getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res);
+  if (rc != 0) fail("resolve " + host + ": " + gai_strerror(rc));
+  std::unique_ptr<addrinfo, decltype(&freeaddrinfo)> res_guard(res, freeaddrinfo);
+
+  std::string last_err = "no addresses";
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    int fd = ::socket(ai->ai_family, ai->ai_socktype | SOCK_NONBLOCK, ai->ai_protocol);
+    if (fd < 0) continue;
+    rc = ::connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc != 0 && errno == EINPROGRESS) {
+      struct pollfd pfd{fd, POLLOUT, 0};
+      rc = ::poll(&pfd, 1, timeout_ms);
+      if (rc == 1) {
+        int err = 0;
+        socklen_t len = sizeof(err);
+        ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+        if (err == 0) rc = 0;
+        else {
+          last_err = std::strerror(err);
+          rc = -1;
+        }
+      } else {
+        last_err = rc == 0 ? "connect timeout" : std::strerror(errno);
+        rc = -1;
+      }
+    } else if (rc != 0) {
+      last_err = std::strerror(errno);
+    }
+    if (rc == 0) {
+      // Back to blocking mode with socket-level timeouts for read/write.
+      int flags = 0;
+      struct timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      int nodelay = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nodelay, sizeof(nodelay));
+      // clear O_NONBLOCK
+      flags = ::fcntl(fd, F_GETFL, 0);
+      ::fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
+      return fd;
+    }
+    ::close(fd);
+  }
+  fail("connect " + host + ":" + port_s + ": " + last_err);
+}
+
+// Transport abstraction over plain fd vs TLS session.
+struct Transport {
+  int fd = -1;
+  std::unique_ptr<tls::Conn> tls_conn;
+
+  size_t read(char* buf, size_t n) {
+    if (tls_conn) return tls_conn->read(buf, n);
+    ssize_t rc = ::recv(fd, buf, n, 0);
+    if (rc < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) fail("read timeout");
+      fail(std::string("read: ") + std::strerror(errno));
+    }
+    return static_cast<size_t>(rc);
+  }
+  void write_all(const char* buf, size_t n) {
+    if (tls_conn) {
+      tls_conn->write_all(buf, n);
+      return;
+    }
+    size_t off = 0;
+    while (off < n) {
+      ssize_t rc = ::send(fd, buf + off, n - off, MSG_NOSIGNAL);
+      if (rc < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) fail("write timeout");
+        fail(std::string("write: ") + std::strerror(errno));
+      }
+      off += static_cast<size_t>(rc);
+    }
+  }
+};
+
+// Incremental reader with buffering for header/line parsing.
+struct Reader {
+  Transport& t;
+  std::string buf;
+  size_t pos = 0;
+  bool eof = false;
+
+  bool fill() {
+    if (eof) return false;
+    char chunk[8192];
+    size_t n = t.read(chunk, sizeof(chunk));
+    if (n == 0) {
+      eof = true;
+      return false;
+    }
+    buf.append(chunk, n);
+    return true;
+  }
+
+  // Read a CRLF (or LF) terminated line, without the terminator.
+  std::string read_line() {
+    while (true) {
+      size_t nl = buf.find('\n', pos);
+      if (nl != std::string::npos) {
+        std::string line = buf.substr(pos, nl - pos);
+        pos = nl + 1;
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        return line;
+      }
+      if (!fill()) fail("unexpected EOF in headers");
+    }
+  }
+
+  std::string read_exact(size_t n) {
+    while (buf.size() - pos < n) {
+      if (!fill()) fail("unexpected EOF in body");
+    }
+    std::string out = buf.substr(pos, n);
+    pos += n;
+    return out;
+  }
+
+  std::string read_to_eof() {
+    while (fill()) {
+    }
+    std::string out = buf.substr(pos);
+    pos = buf.size();
+    return out;
+  }
+};
+
+}  // namespace
+
+std::optional<Url> parse_url(std::string_view url) {
+  Url out;
+  size_t scheme_end = url.find("://");
+  if (scheme_end == std::string_view::npos) return std::nullopt;
+  out.scheme = std::string(url.substr(0, scheme_end));
+  if (out.scheme != "http" && out.scheme != "https") return std::nullopt;
+  out.port = out.scheme == "https" ? 443 : 80;
+
+  std::string_view rest = url.substr(scheme_end + 3);
+  size_t path_start = rest.find('/');
+  std::string_view authority =
+      path_start == std::string_view::npos ? rest : rest.substr(0, path_start);
+  out.target = path_start == std::string_view::npos ? "/" : std::string(rest.substr(path_start));
+  if (authority.empty()) return std::nullopt;
+
+  if (authority.front() == '[') {  // IPv6 literal
+    size_t close = authority.find(']');
+    if (close == std::string_view::npos) return std::nullopt;
+    out.host = std::string(authority.substr(1, close - 1));
+    if (close + 1 < authority.size() && authority[close + 1] == ':') {
+      out.port = std::atoi(std::string(authority.substr(close + 2)).c_str());
+    }
+  } else {
+    size_t colon = authority.rfind(':');
+    if (colon != std::string_view::npos) {
+      out.host = std::string(authority.substr(0, colon));
+      out.port = std::atoi(std::string(authority.substr(colon + 1)).c_str());
+    } else {
+      out.host = std::string(authority);
+    }
+  }
+  if (out.host.empty() || out.port <= 0 || out.port > 65535) return std::nullopt;
+  return out;
+}
+
+Client::Client(TlsMode tls_mode, std::string ca_file)
+    : tls_mode_(tls_mode), ca_file_(std::move(ca_file)) {}
+
+Response Client::request(const Request& req) const {
+  auto url = parse_url(req.url);
+  if (!url) fail("invalid url: " + req.url);
+
+  FdGuard fd{connect_with_timeout(url->host, url->port, req.timeout_ms)};
+  Transport transport;
+  transport.fd = fd.fd;
+  if (url->scheme == "https") {
+    transport.tls_conn = std::make_unique<tls::Conn>(
+        fd.fd, url->host, tls_mode_ == TlsMode::Verify, ca_file_);
+  }
+
+  // ── send request ──
+  std::string msg = req.method + " " + url->target + " HTTP/1.1\r\n";
+  msg += "Host: " + url->host +
+         (url->port != (url->scheme == "https" ? 443 : 80) ? ":" + std::to_string(url->port) : "") +
+         "\r\n";
+  bool has_ua = false;
+  for (const auto& [k, v] : req.headers) {
+    msg += k + ": " + v + "\r\n";
+    if (util::to_lower(k) == "user-agent") has_ua = true;
+  }
+  if (!has_ua) msg += "User-Agent: tpu-pruner/0.1\r\n";
+  if (!req.body.empty() || req.method == "POST" || req.method == "PATCH" || req.method == "PUT") {
+    msg += "Content-Length: " + std::to_string(req.body.size()) + "\r\n";
+  }
+  msg += "Connection: close\r\n\r\n";
+  msg += req.body;
+  transport.write_all(msg.data(), msg.size());
+
+  // ── read response ──
+  Reader reader{transport};
+  std::string status_line = reader.read_line();
+  // "HTTP/1.1 200 OK"
+  Response resp;
+  {
+    auto sp1 = status_line.find(' ');
+    if (sp1 == std::string::npos) fail("malformed status line: " + status_line);
+    resp.status = std::atoi(status_line.c_str() + sp1 + 1);
+    if (resp.status < 100 || resp.status > 599) fail("bad status in: " + status_line);
+  }
+  while (true) {
+    std::string line = reader.read_line();
+    if (line.empty()) break;
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string key = util::to_lower(util::trim(line.substr(0, colon)));
+    resp.headers[key] = util::trim(line.substr(colon + 1));
+  }
+
+  if (req.method == "HEAD" || resp.status == 204 || resp.status == 304) return resp;
+
+  auto te = resp.headers.find("transfer-encoding");
+  if (te != resp.headers.end() && util::to_lower(te->second).find("chunked") != std::string::npos) {
+    while (true) {
+      std::string size_line = reader.read_line();
+      size_t semi = size_line.find(';');
+      if (semi != std::string::npos) size_line.resize(semi);
+      size_t chunk_size = 0;
+      try {
+        chunk_size = static_cast<size_t>(std::stoul(util::trim(size_line), nullptr, 16));
+      } catch (const std::exception&) {
+        fail("bad chunk size: " + size_line);
+      }
+      if (chunk_size == 0) break;
+      resp.body += reader.read_exact(chunk_size);
+      reader.read_line();  // trailing CRLF after chunk data
+    }
+    // drain trailers until blank line (tolerate EOF)
+    while (true) {
+      if (reader.eof && reader.pos >= reader.buf.size()) break;
+      std::string line;
+      try {
+        line = reader.read_line();
+      } catch (const std::exception&) {
+        break;
+      }
+      if (line.empty()) break;
+    }
+  } else if (auto cl = resp.headers.find("content-length"); cl != resp.headers.end()) {
+    size_t n = 0;
+    try {
+      n = static_cast<size_t>(std::stoul(cl->second));
+    } catch (const std::exception&) {
+      fail("bad content-length: " + cl->second);
+    }
+    resp.body = reader.read_exact(n);
+  } else {
+    resp.body = reader.read_to_eof();
+  }
+  return resp;
+}
+
+}  // namespace tpupruner::http

@@ -1,0 +1,139 @@
+#include "tpupruner/k8s.hpp"
+
+#include <stdexcept>
+
+#include "tpupruner/kubeconfig.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::k8s {
+
+namespace {
+constexpr const char* kSaDir = "/var/run/secrets/kubernetes.io/serviceaccount";
+}
+
+Config Config::infer() {
+  Config c;
+  // 1. explicit env (hermetic tests, kubectl-proxy setups)
+  if (auto url = util::env("KUBE_API_URL")) {
+    c.api_url = *url;
+    if (auto t = util::env("KUBE_TOKEN")) c.token = *t;
+    else if (auto tf = util::env("KUBE_TOKEN_FILE")) {
+      if (auto content = util::read_file(*tf)) c.token = util::trim(*content);
+    }
+    if (auto ca = util::env("KUBE_CA_FILE")) c.ca_file = *ca;
+    c.tls_skip = util::env("KUBE_TLS_SKIP").has_value();
+    return c;
+  }
+
+  // 2. in-cluster (the deployment path, hack/deployment.yaml analog)
+  auto host = util::env("KUBERNETES_SERVICE_HOST");
+  if (host) {
+    std::string port = util::env("KUBERNETES_SERVICE_PORT").value_or("443");
+    std::string h = *host;
+    if (h.find(':') != std::string::npos) h = "[" + h + "]";  // IPv6
+    c.api_url = "https://" + h + ":" + port;
+    std::string sa_dir = util::env("TPU_PRUNER_SA_DIR").value_or(kSaDir);
+    if (auto token = util::read_file(sa_dir + "/token")) c.token = util::trim(*token);
+    c.ca_file = sa_dir + "/ca.crt";
+    return c;
+  }
+
+  // 3. kubeconfig scan (token-auth users only)
+  if (auto info = kubeconfig::scan()) {
+    c.api_url = info->server;
+    c.token = info->token;
+    c.tls_skip = info->tls_skip;
+    return c;
+  }
+
+  throw std::runtime_error(
+      "no kubernetes config: set KUBE_API_URL, run in-cluster "
+      "(KUBERNETES_SERVICE_HOST), or provide a kubeconfig with token auth");
+}
+
+Client::Client(Config config)
+    : config_(std::move(config)),
+      http_(config_.tls_skip ? http::TlsMode::Skip : http::TlsMode::Verify, config_.ca_file) {}
+
+json::Value Client::request_json(const std::string& method, const std::string& path,
+                                 const std::string& body, const std::string& content_type,
+                                 int* status_out) const {
+  http::Request req;
+  req.method = method;
+  req.url = config_.api_url + path;
+  req.timeout_ms = config_.timeout_ms;
+  req.headers.push_back({"Accept", "application/json"});
+  if (!config_.token.empty())
+    req.headers.push_back({"Authorization", "Bearer " + config_.token});
+  if (!content_type.empty()) req.headers.push_back({"Content-Type", content_type});
+  req.body = body;
+
+  http::Response resp = http_.request(req);
+  if (status_out) *status_out = resp.status;
+  if (resp.status >= 200 && resp.status < 300) {
+    if (resp.body.empty()) return json::Value::object();
+    try {
+      return json::Value::parse(resp.body);
+    } catch (const json::ParseError& e) {
+      throw std::runtime_error("k8s: unparseable response body from " + path + ": " + e.what());
+    }
+  }
+  if (status_out && resp.status == 404) return json::Value();  // caller handles
+  // Surface the API server's message (Status object) for logs.
+  std::string message;
+  try {
+    json::Value status = json::Value::parse(resp.body);
+    message = status.get_string("message", resp.body.substr(0, 256));
+  } catch (const std::exception&) {
+    message = resp.body.substr(0, 256);
+  }
+  throw std::runtime_error("k8s: " + method + " " + path + " → HTTP " +
+                           std::to_string(resp.status) + ": " + message);
+}
+
+std::optional<json::Value> Client::get_opt(const std::string& path) const {
+  int status = 0;
+  json::Value v = request_json("GET", path, "", "", &status);
+  if (status == 404) return std::nullopt;
+  return v;
+}
+
+json::Value Client::get(const std::string& path) const {
+  return request_json("GET", path, "", "", nullptr);
+}
+
+json::Value Client::list(const std::string& path, const std::string& label_selector) const {
+  std::string full = path;
+  if (!label_selector.empty()) full += "?labelSelector=" + util::url_encode(label_selector);
+  return request_json("GET", full, "", "", nullptr);
+}
+
+json::Value Client::patch_merge(const std::string& path, const json::Value& body) const {
+  return request_json("PATCH", path, body.dump(), "application/merge-patch+json", nullptr);
+}
+
+json::Value Client::post(const std::string& path, const json::Value& body) const {
+  return request_json("POST", path, body.dump(), "application/json", nullptr);
+}
+
+std::string Client::pod_path(const std::string& ns, const std::string& name) {
+  return "/api/v1/namespaces/" + ns + "/pods/" + name;
+}
+std::string Client::pods_path(const std::string& ns) {
+  return "/api/v1/namespaces/" + ns + "/pods";
+}
+std::string Client::events_path(const std::string& ns) {
+  return "/api/v1/namespaces/" + ns + "/events";
+}
+
+std::string Client::object_path(core::Kind kind, const std::string& ns, const std::string& name) {
+  std::string group_version(core::api_version(kind));  // e.g. "apps/v1"
+  return "/apis/" + group_version + "/namespaces/" + ns + "/" +
+         std::string(core::plural(kind)) + "/" + name;
+}
+
+std::string Client::scale_path(core::Kind kind, const std::string& ns, const std::string& name) {
+  return object_path(kind, ns, name) + "/scale";
+}
+
+}  // namespace tpupruner::k8s

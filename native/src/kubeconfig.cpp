@@ -1,0 +1,49 @@
+#include "tpupruner/kubeconfig.hpp"
+
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::kubeconfig {
+
+namespace {
+std::string strip_quotes(std::string v) {
+  if (v.size() >= 2 && ((v.front() == '"' && v.back() == '"') ||
+                        (v.front() == '\'' && v.back() == '\''))) {
+    return v.substr(1, v.size() - 2);
+  }
+  return v;
+}
+}  // namespace
+
+std::optional<Info> scan() {
+  std::string path;
+  if (auto kc = util::env("KUBECONFIG")) {
+    path = *kc;
+  } else if (auto home = util::env("HOME")) {
+    path = *home + "/.kube/config";
+  } else {
+    return std::nullopt;
+  }
+  auto content = util::read_file(path);
+  if (!content) return std::nullopt;
+
+  Info info;
+  for (const std::string& raw : util::split(*content, '\n')) {
+    std::string line = util::trim(raw);
+    if (info.server.empty() && util::starts_with(line, "server:")) {
+      info.server = strip_quotes(util::trim(line.substr(7)));
+    }
+    if (info.token.empty() && util::starts_with(line, "token:")) {
+      info.token = strip_quotes(util::trim(line.substr(6)));
+    }
+    if (info.token.empty() && util::starts_with(line, "tokenFile:")) {
+      if (auto tf = util::read_file(strip_quotes(util::trim(line.substr(10))))) {
+        info.token = util::trim(*tf);
+      }
+    }
+    if (line == "insecure-skip-tls-verify: true") info.tls_skip = true;
+  }
+  if (info.server.empty()) return std::nullopt;
+  return info;
+}
+
+}  // namespace tpupruner::kubeconfig

@@ -1,0 +1,119 @@
+#include "tpupruner/log.hpp"
+
+#include <cstdio>
+#include <mutex>
+
+#include "tpupruner/json.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::log {
+
+namespace {
+
+std::mutex g_mutex;
+Format g_format = Format::Default;
+Level g_threshold = Level::Info;
+bool g_initialized = false;
+std::map<std::string, uint64_t> g_counters;
+
+Level parse_level(const std::string& s) {
+  std::string l = util::to_lower(s);
+  if (l == "trace") return Level::Trace;
+  if (l == "debug") return Level::Debug;
+  if (l == "info") return Level::Info;
+  if (l == "warn" || l == "warning") return Level::Warn;
+  if (l == "error") return Level::Error;
+  return Level::Info;
+}
+
+const char* level_name(Level l) {
+  switch (l) {
+    case Level::Trace: return "TRACE";
+    case Level::Debug: return "DEBUG";
+    case Level::Info: return "INFO";
+    case Level::Warn: return "WARN";
+    case Level::Error: return "ERROR";
+  }
+  return "?";
+}
+
+const char* level_color(Level l) {
+  switch (l) {
+    case Level::Trace: return "\x1b[90m";
+    case Level::Debug: return "\x1b[36m";
+    case Level::Info: return "\x1b[32m";
+    case Level::Warn: return "\x1b[33m";
+    case Level::Error: return "\x1b[31m";
+  }
+  return "";
+}
+
+void ensure_init() {
+  if (g_initialized) return;
+  if (auto lv = util::env("TPU_PRUNER_LOG")) g_threshold = parse_level(*lv);
+  else if (auto lv2 = util::env("RUST_LOG")) g_threshold = parse_level(*lv2);
+  g_initialized = true;
+}
+
+}  // namespace
+
+void init(Format format) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  g_format = format;
+  g_initialized = false;
+  ensure_init();
+}
+
+Level threshold() {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  ensure_init();
+  return g_threshold;
+}
+
+void write(Level level, const std::string& msg) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  ensure_init();
+  if (level < g_threshold) return;
+  std::string ts = util::now_rfc3339_micro();
+  switch (g_format) {
+    case Format::Json: {
+      json::Value v = json::Value::object();
+      v.set("timestamp", json::Value(ts));
+      v.set("level", json::Value(util::to_lower(level_name(level))));
+      v.set("fields", json::Value(json::Object{{"message", json::Value(msg)}}));
+      v.set("target", json::Value("tpu_pruner"));
+      std::fprintf(stderr, "%s\n", v.dump().c_str());
+      break;
+    }
+    case Format::Pretty:
+      std::fprintf(stderr, "  %s%s\x1b[0m %s\n    \x1b[90mat %s\x1b[0m\n",
+                   level_color(level), level_name(level), msg.c_str(), ts.c_str());
+      break;
+    case Format::Default:
+      std::fprintf(stderr, "%s %5s tpu_pruner: %s\n", ts.c_str(), level_name(level), msg.c_str());
+      break;
+  }
+  std::fflush(stderr);
+}
+
+void counter_add(const std::string& name, uint64_t delta) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  g_counters[name] += delta;
+}
+
+void counter_set(const std::string& name, uint64_t value) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  g_counters[name] = value;
+}
+
+std::map<std::string, uint64_t> counters_snapshot() {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  return g_counters;
+}
+
+void counters_reset_for_test() {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  g_counters.clear();
+}
+
+}  // namespace tpupruner::log

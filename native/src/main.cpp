@@ -1,11 +1,50 @@
-// tpu-pruner daemon entry point (reference analog: gpu-pruner/src/main.rs:273).
-// Grows subcommands: default daemon/single-shot run, plus `querytest`
-// (reference: gpu-pruner/src/bin/querytest.rs).
+// tpu-pruner entry point.
+//
+// Reference analog: gpu-pruner/src/main.rs:273-375 (main) plus the separate
+// querytest binary (src/bin/querytest.rs) — folded in as a subcommand so
+// the container image stays single-binary.
 #include <cstdio>
+#include <cstring>
+#include <exception>
+
+#include "querytest.hpp"
+#include "tpupruner/cli.hpp"
+#include "tpupruner/daemon.hpp"
+#include "tpupruner/log.hpp"
 
 int main(int argc, char** argv) {
-  (void)argc;
-  (void)argv;
-  std::fprintf(stderr, "tpu-pruner: daemon not wired yet (scaffolding build)\n");
-  return 2;
+  using namespace tpupruner;
+
+  if (argc >= 2 && std::strcmp(argv[1], "querytest") == 0) {
+    if (argc != 4) {
+      std::fprintf(stderr, "usage: tpu-pruner querytest <promql> <prometheus-url>\n");
+      return 2;
+    }
+    log::init(log::Format::Default);
+    try {
+      return querytest::run(argv[2], argv[3]);
+    } catch (const std::exception& e) {
+      std::fprintf(stderr, "querytest: %s\n", e.what());
+      return 1;
+    }
+  }
+
+  cli::Cli args;
+  try {
+    args = cli::parse(argc, argv);
+  } catch (const cli::HelpRequested& e) {
+    std::fprintf(stdout, "%s\n", e.what());
+    return 0;
+  } catch (const cli::CliError& e) {
+    std::fprintf(stderr, "%s\n", e.what());
+    return 2;
+  }
+
+  log::init(cli::log_format_of(args));
+  try {
+    return daemon::run(args);
+  } catch (const std::exception& e) {
+    log::error(std::string("fatal: ") + e.what());
+    return 1;
+  }
 }

@@ -1,0 +1,75 @@
+#include "metrics_http.hpp"
+
+#include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+#include "tpupruner/log.hpp"
+
+namespace tpupruner::metrics_http {
+
+Server::Server(int port) {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (listen_fd_ < 0) throw std::runtime_error("metrics: socket() failed");
+  int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(listen_fd_);
+    throw std::runtime_error("metrics: bind to port " + std::to_string(port) + " failed: " +
+                             std::strerror(errno));
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+  port_ = ntohs(addr.sin_port);
+  if (::listen(listen_fd_, 16) != 0) {
+    ::close(listen_fd_);
+    throw std::runtime_error("metrics: listen failed");
+  }
+  thread_ = std::thread([this] { serve(); });
+  log::info("serving /metrics on port " + std::to_string(port_));
+}
+
+Server::~Server() {
+  stop_.store(true);
+  if (thread_.joinable()) thread_.join();
+  if (listen_fd_ >= 0) ::close(listen_fd_);
+}
+
+void Server::serve() {
+  while (!stop_.load()) {
+    struct pollfd pfd{listen_fd_, POLLIN, 0};
+    int rc = ::poll(&pfd, 1, 200);
+    if (rc <= 0) continue;
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) continue;
+    // Read (and discard) the request line + headers; any GET gets metrics.
+    char buf[2048];
+    struct timeval tv{1, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::recv(fd, buf, sizeof(buf), 0);
+
+    std::string body = "# tpu-pruner operational counters\n";
+    for (const auto& [name, value] : log::counters_snapshot()) {
+      std::string metric = "tpu_pruner_" + name;
+      body += "# TYPE " + metric +
+              (name.find("returned") != std::string::npos ? " gauge\n" : " counter\n");
+      body += metric + " " + std::to_string(value) + "\n";
+    }
+    std::string resp =
+        "HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\nContent-Length: " +
+        std::to_string(body.size()) + "\r\nConnection: close\r\n\r\n" + body;
+    ::send(fd, resp.data(), resp.size(), MSG_NOSIGNAL);
+    ::close(fd);
+  }
+}
+
+}  // namespace tpupruner::metrics_http

@@ -1,0 +1,29 @@
+// Minimal Prometheus /metrics exposition server (internal).
+//
+// The reference pushes its six operational counters over OTLP when built
+// with the `otel` feature (main.rs:138-155, 194-271). Pull-based /metrics
+// is the idiomatic GKE shape (PodMonitoring scrapes it), so the daemon
+// serves the same counter names as a text exposition instead.
+#pragma once
+
+#include <atomic>
+#include <thread>
+
+namespace tpupruner::metrics_http {
+
+class Server {
+ public:
+  // Binds 0.0.0.0:port; throws std::runtime_error when the bind fails.
+  explicit Server(int port);
+  ~Server();
+  int port() const { return port_; }
+
+ private:
+  void serve();
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stop_{false};
+  std::thread thread_;
+};
+
+}  // namespace tpupruner::metrics_http

@@ -1,0 +1,43 @@
+#include "tpupruner/prom.hpp"
+
+#include <stdexcept>
+
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::prom {
+
+Client::Client(std::string base_url, std::string bearer_token, http::TlsMode tls_mode,
+               std::string ca_file, int timeout_ms)
+    : base_url_(std::move(base_url)),
+      token_(std::move(bearer_token)),
+      http_(tls_mode, std::move(ca_file)),
+      timeout_ms_(timeout_ms) {
+  while (!base_url_.empty() && base_url_.back() == '/') base_url_.pop_back();
+}
+
+json::Value Client::instant_query(const std::string& promql) const {
+  http::Request req;
+  req.method = "POST";
+  req.url = base_url_ + "/api/v1/query";
+  req.headers.push_back({"Content-Type", "application/x-www-form-urlencoded"});
+  req.headers.push_back({"Accept", "application/json"});
+  if (!token_.empty()) req.headers.push_back({"Authorization", "Bearer " + token_});
+  req.body = "query=" + util::url_encode(promql);
+  req.timeout_ms = timeout_ms_;
+
+  http::Response resp = http_.request(req);
+  if (resp.status < 200 || resp.status >= 300) {
+    // Prometheus error bodies are JSON {"status":"error","error":...};
+    // surface them verbatim (truncated) for the failure-budget log line.
+    std::string snippet = resp.body.substr(0, 512);
+    throw std::runtime_error("prometheus returned HTTP " + std::to_string(resp.status) + ": " +
+                             snippet);
+  }
+  try {
+    return json::Value::parse(resp.body);
+  } catch (const json::ParseError& e) {
+    throw std::runtime_error(std::string("prometheus returned unparseable body: ") + e.what());
+  }
+}
+
+}  // namespace tpupruner::prom

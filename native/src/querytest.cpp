@@ -1,0 +1,153 @@
+// `tpu-pruner querytest <promql> <prometheus-url>` — ad-hoc query runner.
+//
+// Reference analog: the querytest debug binary
+// (gpu-pruner/src/bin/querytest.rs): runs one instant query, prints the
+// label table to stdout, and writes output.csv. Vector and matrix results
+// supported; auth goes through the same token chain as the daemon.
+#include "querytest.hpp"
+
+#include <algorithm>
+#include <cstdio>
+#include <fstream>
+#include <set>
+#include <vector>
+
+#include "tpupruner/auth.hpp"
+#include "tpupruner/json.hpp"
+#include "tpupruner/prom.hpp"
+
+namespace tpupruner::querytest {
+
+using json::Value;
+
+namespace {
+
+// Collect the union of label names across series, sorted, __name__ first.
+std::vector<std::string> collect_columns(const json::Array& result) {
+  std::set<std::string> names;
+  for (const Value& series : result) {
+    const Value* metric = series.find("metric");
+    if (!metric || !metric->is_object()) continue;
+    for (const auto& [k, _] : metric->as_object()) names.insert(k);
+  }
+  std::vector<std::string> cols(names.begin(), names.end());
+  auto it = std::find(cols.begin(), cols.end(), "__name__");
+  if (it != cols.end()) {
+    cols.erase(it);
+    cols.insert(cols.begin(), "__name__");
+  }
+  cols.push_back("value");
+  return cols;
+}
+
+std::string csv_quote(const std::string& s) {
+  if (s.find_first_of(",\"\n") == std::string::npos) return s;
+  std::string out = "\"";
+  for (char c : s) {
+    if (c == '"') out += "\"\"";
+    else out.push_back(c);
+  }
+  out += "\"";
+  return out;
+}
+
+std::string series_value(const Value& series) {
+  // vector: "value": [ts, "v"]; matrix: "values": [[ts,"v"],...] → last
+  const Value* v = series.find("value");
+  if (v && v->is_array() && v->as_array().size() == 2) {
+    const Value& x = v->as_array()[1];
+    return x.is_string() ? x.as_string() : x.dump();
+  }
+  const Value* vs = series.find("values");
+  if (vs && vs->is_array() && !vs->as_array().empty()) {
+    const Value& last = vs->as_array().back();
+    if (last.is_array() && last.as_array().size() == 2) {
+      const Value& x = last.as_array()[1];
+      return x.is_string() ? x.as_string() : x.dump();
+    }
+  }
+  return "";
+}
+
+}  // namespace
+
+int run(const std::string& promql, const std::string& url, const std::string& csv_path) {
+  auth::TokenOptions topts;
+  std::string token = auth::get_bearer_token(topts).value_or("");
+  prom::Client client(url, token);
+
+  Value response;
+  try {
+    response = client.instant_query(promql);
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "querytest: %s\n", e.what());
+    return 1;
+  }
+
+  const Value* status = response.find("status");
+  if (!status || !status->is_string() || status->as_string() != "success") {
+    std::fprintf(stderr, "querytest: query failed: %s\n",
+                 response.get_string("error", response.dump()).c_str());
+    return 1;
+  }
+  const Value* result = response.at_path("data.result");
+  if (!result || !result->is_array()) {
+    std::fprintf(stderr, "querytest: no data.result in response\n");
+    return 1;
+  }
+  const json::Array& series_list = result->as_array();
+  const Value* rtype = response.at_path("data.resultType");
+  std::string rtype_s = (rtype && rtype->is_string()) ? rtype->as_string() : "unknown";
+  std::printf("resultType: %s, %zu series\n", rtype_s.c_str(), series_list.size());
+
+  std::vector<std::string> cols = collect_columns(series_list);
+
+  // column widths for the stdout table
+  std::vector<size_t> widths;
+  for (const std::string& c : cols) widths.push_back(c.size());
+  std::vector<std::vector<std::string>> rows;
+  for (const Value& series : series_list) {
+    std::vector<std::string> row;
+    const Value* metric = series.find("metric");
+    for (size_t i = 0; i + 1 < cols.size(); ++i) {
+      std::string cell = metric ? metric->get_string(cols[i]) : "";
+      widths[i] = std::max(widths[i], cell.size());
+      row.push_back(std::move(cell));
+    }
+    std::string val = series_value(series);
+    widths.back() = std::max(widths.back(), val.size());
+    row.push_back(std::move(val));
+    rows.push_back(std::move(row));
+  }
+
+  auto print_row = [&](const std::vector<std::string>& row) {
+    std::printf("|");
+    for (size_t i = 0; i < row.size(); ++i) {
+      std::printf(" %-*s |", static_cast<int>(widths[i]), row[i].c_str());
+    }
+    std::printf("\n");
+  };
+  print_row(cols);
+  {
+    std::vector<std::string> sep;
+    for (size_t w : widths) sep.push_back(std::string(w, '-'));
+    print_row(sep);
+  }
+  for (const auto& row : rows) print_row(row);
+
+  std::ofstream csv(csv_path);
+  for (size_t i = 0; i < cols.size(); ++i) {
+    csv << (i ? "," : "") << csv_quote(cols[i]);
+  }
+  csv << "\n";
+  for (const auto& row : rows) {
+    for (size_t i = 0; i < row.size(); ++i) {
+      csv << (i ? "," : "") << csv_quote(row[i]);
+    }
+    csv << "\n";
+  }
+  std::printf("wrote %zu rows to %s\n", rows.size(), csv_path.c_str());
+  return 0;
+}
+
+}  // namespace tpupruner::querytest

@@ -1,0 +1,11 @@
+#pragma once
+
+#include <string>
+
+namespace tpupruner::querytest {
+
+// Run one ad-hoc query, print a label table, write a CSV. Returns exit code.
+int run(const std::string& promql, const std::string& url,
+        const std::string& csv_path = "output.csv");
+
+}  // namespace tpupruner::querytest

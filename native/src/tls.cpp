@@ -1,0 +1,173 @@
+#include "tls.hpp"
+
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <mutex>
+#include <stdexcept>
+
+namespace tpupruner::tls {
+
+namespace {
+
+// Subset of the OpenSSL 3 ABI used by a verifying TLS client.
+struct Api {
+  void* (*TLS_client_method)();
+  void* (*SSL_CTX_new)(void*);
+  void (*SSL_CTX_free)(void*);
+  void (*SSL_CTX_set_verify)(void*, int, void*);
+  int (*SSL_CTX_set_default_verify_paths)(void*);
+  int (*SSL_CTX_load_verify_locations)(void*, const char*, const char*);
+  void* (*SSL_new)(void*);
+  void (*SSL_free)(void*);
+  int (*SSL_set_fd)(void*, int);
+  int (*SSL_connect)(void*);
+  int (*SSL_read)(void*, void*, int);
+  int (*SSL_write)(void*, const void*, int);
+  int (*SSL_shutdown)(void*);
+  long (*SSL_ctrl)(void*, int, long, void*);
+  int (*SSL_get_error)(const void*, int);
+  long (*SSL_get_verify_result)(const void*);
+  int (*SSL_set1_host)(void*, const char*);
+  unsigned long (*ERR_get_error)();
+  void (*ERR_error_string_n)(unsigned long, char*, size_t);
+
+  bool ok = false;
+};
+
+constexpr int kSslVerifyNone = 0x00;
+constexpr int kSslVerifyPeer = 0x01;
+constexpr int kSslCtrlSetTlsextHostname = 55;
+constexpr long kTlsextNametypeHostName = 0;
+constexpr long kX509VOk = 0;
+constexpr int kSslErrorZeroReturn = 6;
+
+const Api& api() {
+  static Api a = [] {
+    Api out{};
+    void* ssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    void* crypto = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (!ssl || !crypto) return out;
+    bool all = true;
+    auto load = [&](auto& fn, const char* name, void* lib) {
+      fn = reinterpret_cast<std::decay_t<decltype(fn)>>(dlsym(lib, name));
+      if (!fn) all = false;
+    };
+    load(out.TLS_client_method, "TLS_client_method", ssl);
+    load(out.SSL_CTX_new, "SSL_CTX_new", ssl);
+    load(out.SSL_CTX_free, "SSL_CTX_free", ssl);
+    load(out.SSL_CTX_set_verify, "SSL_CTX_set_verify", ssl);
+    load(out.SSL_CTX_set_default_verify_paths, "SSL_CTX_set_default_verify_paths", ssl);
+    load(out.SSL_CTX_load_verify_locations, "SSL_CTX_load_verify_locations", ssl);
+    load(out.SSL_new, "SSL_new", ssl);
+    load(out.SSL_free, "SSL_free", ssl);
+    load(out.SSL_set_fd, "SSL_set_fd", ssl);
+    load(out.SSL_connect, "SSL_connect", ssl);
+    load(out.SSL_read, "SSL_read", ssl);
+    load(out.SSL_write, "SSL_write", ssl);
+    load(out.SSL_shutdown, "SSL_shutdown", ssl);
+    load(out.SSL_ctrl, "SSL_ctrl", ssl);
+    load(out.SSL_get_error, "SSL_get_error", ssl);
+    load(out.SSL_get_verify_result, "SSL_get_verify_result", ssl);
+    load(out.SSL_set1_host, "SSL_set1_host", ssl);
+    load(out.ERR_get_error, "ERR_get_error", crypto);
+    load(out.ERR_error_string_n, "ERR_error_string_n", crypto);
+    out.ok = all;
+    return out;
+  }();
+  return a;
+}
+
+std::string last_error(const std::string& what) {
+  const Api& a = api();
+  char buf[256] = "unknown";
+  if (a.ok) {
+    unsigned long code = a.ERR_get_error();
+    if (code) a.ERR_error_string_n(code, buf, sizeof(buf));
+  }
+  return "tls: " + what + ": " + buf;
+}
+
+}  // namespace
+
+bool available() { return api().ok; }
+
+Conn::Conn(int fd, const std::string& sni_host, bool verify, const std::string& ca_file) {
+  const Api& a = api();
+  if (!a.ok) {
+    throw std::runtime_error(
+        "tls: libssl.so.3 unavailable in this environment (https unsupported; "
+        "use http or install OpenSSL 3)");
+  }
+  ctx_ = a.SSL_CTX_new(a.TLS_client_method());
+  if (!ctx_) throw std::runtime_error(last_error("SSL_CTX_new"));
+
+  if (verify) {
+    a.SSL_CTX_set_verify(ctx_, kSslVerifyPeer, nullptr);
+    if (!ca_file.empty()) {
+      if (a.SSL_CTX_load_verify_locations(ctx_, ca_file.c_str(), nullptr) != 1) {
+        std::string err = last_error("load CA bundle " + ca_file);
+        a.SSL_CTX_free(ctx_);
+        ctx_ = nullptr;
+        throw std::runtime_error(err);
+      }
+    } else {
+      a.SSL_CTX_set_default_verify_paths(ctx_);
+    }
+  } else {
+    a.SSL_CTX_set_verify(ctx_, kSslVerifyNone, nullptr);
+  }
+
+  ssl_ = a.SSL_new(ctx_);
+  if (!ssl_) {
+    a.SSL_CTX_free(ctx_);
+    ctx_ = nullptr;
+    throw std::runtime_error(last_error("SSL_new"));
+  }
+  a.SSL_set_fd(ssl_, fd);
+  a.SSL_ctrl(ssl_, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
+             const_cast<char*>(sni_host.c_str()));
+  if (verify) a.SSL_set1_host(ssl_, sni_host.c_str());
+
+  int rc = a.SSL_connect(ssl_);
+  if (rc != 1) {
+    std::string err = last_error("handshake failed");
+    if (verify && a.SSL_get_verify_result(ssl_) != kX509VOk) {
+      err += " (certificate verification failed)";
+    }
+    a.SSL_free(ssl_);
+    a.SSL_CTX_free(ctx_);
+    ssl_ = ctx_ = nullptr;
+    throw std::runtime_error(err);
+  }
+}
+
+Conn::~Conn() {
+  const Api& a = api();
+  if (ssl_) {
+    a.SSL_shutdown(ssl_);
+    a.SSL_free(ssl_);
+  }
+  if (ctx_) a.SSL_CTX_free(ctx_);
+}
+
+size_t Conn::read(char* buf, size_t n) {
+  const Api& a = api();
+  int rc = a.SSL_read(ssl_, buf, static_cast<int>(n));
+  if (rc > 0) return static_cast<size_t>(rc);
+  int err = a.SSL_get_error(ssl_, rc);
+  if (err == kSslErrorZeroReturn) return 0;  // clean close_notify
+  throw std::runtime_error(last_error("read failed"));
+}
+
+void Conn::write_all(const char* buf, size_t n) {
+  const Api& a = api();
+  size_t off = 0;
+  while (off < n) {
+    int rc = a.SSL_write(ssl_, buf + off, static_cast<int>(n - off));
+    if (rc <= 0) throw std::runtime_error(last_error("write failed"));
+    off += static_cast<size_t>(rc);
+  }
+}
+
+}  // namespace tpupruner::tls

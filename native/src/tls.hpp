@@ -1,0 +1,37 @@
+// dlopen()-based OpenSSL 3 shim (internal).
+//
+// The image ships /lib/x86_64-linux-gnu/libssl.so.3 but no development
+// headers, so the handful of functions a TLS client needs are declared here
+// by ABI and resolved at runtime. If libssl cannot be loaded, https URLs
+// fail with a clear error while plain http (the hermetic test path and
+// many in-cluster Prometheus endpoints) keeps working.
+#pragma once
+
+#include <memory>
+#include <string>
+
+namespace tpupruner::tls {
+
+// True when libssl.so.3/libcrypto.so.3 resolved.
+bool available();
+
+// One TLS client session over an already-connected socket fd.
+// Construction performs the handshake; throws std::runtime_error on
+// failure (including certificate verification when verify=true).
+class Conn {
+ public:
+  Conn(int fd, const std::string& sni_host, bool verify, const std::string& ca_file);
+  ~Conn();
+  Conn(const Conn&) = delete;
+  Conn& operator=(const Conn&) = delete;
+
+  // Return >0 bytes, 0 on orderly close, throw on error.
+  size_t read(char* buf, size_t n);
+  void write_all(const char* buf, size_t n);
+
+ private:
+  void* ctx_ = nullptr;  // SSL_CTX*
+  void* ssl_ = nullptr;  // SSL*
+};
+
+}  // namespace tpupruner::tls

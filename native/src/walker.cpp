@@ -1,0 +1,155 @@
+#include "tpupruner/walker.hpp"
+
+#include <stdexcept>
+
+#include "tpupruner/log.hpp"
+
+namespace tpupruner::walker {
+
+using core::Kind;
+using core::ScaleTarget;
+using json::Value;
+
+namespace {
+
+std::string pod_ns(const Value& pod) {
+  const Value* ns = pod.at_path("metadata.namespace");
+  return (ns && ns->is_string()) ? ns->as_string() : "";
+}
+
+// Fetch `kind`/`name`, returning a target; nullopt when the fetch fails
+// (reference behavior: `if let Ok(rs) = rs_api.get(...)`, lib.rs:465).
+std::optional<ScaleTarget> fetch(const k8s::Client& client, Kind kind, const std::string& ns,
+                                 const std::string& name) {
+  try {
+    auto obj = client.get_opt(k8s::Client::object_path(kind, ns, name));
+    if (!obj) return std::nullopt;
+    return ScaleTarget{kind, std::move(*obj)};
+  } catch (const std::exception& e) {
+    log::warn("fetch " + std::string(core::kind_name(kind)) + " " + ns + "/" + name +
+              " failed: " + e.what());
+    return std::nullopt;
+  }
+}
+
+// First ownerReference of `object` with the given kind, or nullptr.
+const Value* owner_of_kind(const Value& object, std::string_view kind) {
+  const Value* ors = object.at_path("metadata.ownerReferences");
+  if (!ors || !ors->is_array()) return nullptr;
+  for (const Value& o : ors->as_array()) {
+    if (o.get_string("kind") == kind) return &o;
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+ScaleTarget find_root_object(const k8s::Client& client, const Value& pod) {
+  std::string ns = pod_ns(pod);
+  std::string pod_name = pod.at_path("metadata.name") ? pod.at_path("metadata.name")->as_string()
+                                                      : "<unnamed>";
+
+  // kserve shortcut: serving pods carry the InferenceService name as a
+  // label — skip the ownerRef chain entirely (lib.rs:448-456).
+  if (const Value* labels = pod.at_path("metadata.labels"); labels && labels->is_object()) {
+    const Value* ks = labels->find("serving.kserve.io/inferenceservice");
+    if (ks && ks->is_string()) {
+      Value is = client.get(k8s::Client::object_path(Kind::InferenceService, ns, ks->as_string()));
+      return ScaleTarget{Kind::InferenceService, std::move(is)};
+    }
+  }
+
+  const Value* ors = pod.at_path("metadata.ownerReferences");
+  if (ors && ors->is_array()) {
+    for (const Value& owner : ors->as_array()) {
+      std::string kind = owner.get_string("kind");
+      std::string name = owner.get_string("name");
+
+      if (kind == "ReplicaSet") {
+        if (auto rs = fetch(client, Kind::ReplicaSet, ns, name)) {
+          if (const Value* dep_or = owner_of_kind(rs->object, "Deployment")) {
+            if (auto dep = fetch(client, Kind::Deployment, ns, dep_or->get_string("name"))) {
+              return std::move(*dep);
+            }
+          }
+          return std::move(*rs);  // ReplicaSet with no Deployment owner
+        }
+      } else if (kind == "StatefulSet") {
+        if (auto ss = fetch(client, Kind::StatefulSet, ns, name)) {
+          if (const Value* nb_or = owner_of_kind(ss->object, "Notebook")) {
+            if (auto nb = fetch(client, Kind::Notebook, ns, nb_or->get_string("name"))) {
+              return std::move(*nb);
+            }
+          }
+          return std::move(*ss);  // StatefulSet with no Notebook owner
+        }
+      } else if (kind == "Job") {
+        // Multi-host TPU slice chain: Pod → Job → JobSet. Bare Jobs (no
+        // JobSet owner) are batch workloads the pruner must not touch —
+        // suspending them mid-run is destructive, so fall through.
+        try {
+          auto job = client.get_opt("/apis/batch/v1/namespaces/" + ns + "/jobs/" + name);
+          if (job) {
+            if (const Value* js_or = owner_of_kind(*job, "JobSet")) {
+              if (auto js = fetch(client, Kind::JobSet, ns, js_or->get_string("name"))) {
+                return std::move(*js);
+              }
+            }
+            log::debug("pod " + ns + "/" + pod_name + ": bare Job owner '" + name +
+                       "' is not scalable, ignoring");
+          }
+        } catch (const std::exception& e) {
+          log::warn("fetch Job " + ns + "/" + name + " failed: " + e.what());
+        }
+      } else {
+        log::debug("ignoring unrecognized owner ref kind: " + kind);
+      }
+    }
+  }
+
+  throw std::runtime_error("no scalable root object found for pod " + ns + "/" + pod_name);
+}
+
+bool pod_requests_tpu(const json::Value& pod) {
+  const Value* containers = pod.at_path("spec.containers");
+  if (!containers || !containers->is_array()) return false;
+  for (const Value& c : containers->as_array()) {
+    for (const char* section : {"requests", "limits"}) {
+      const Value* resources = c.at_path("resources");
+      if (!resources) continue;
+      const Value* res = resources->find(section);
+      if (res && res->is_object() && res->find("google.com/tpu")) return true;
+    }
+  }
+  return false;
+}
+
+bool jobset_fully_idle(const k8s::Client& client, const ScaleTarget& jobset,
+                       const IdlePodSet& idle) {
+  std::string ns = jobset.ns().value_or("");
+  std::string name = jobset.name();
+  Value pods = client.list(k8s::Client::pods_path(ns),
+                           "jobset.sigs.k8s.io/jobset-name=" + name);
+  const Value* items = pods.find("items");
+  if (!items || !items->is_array()) return false;
+
+  size_t tpu_pods = 0;
+  for (const Value& pod : items->as_array()) {
+    if (!pod_requests_tpu(pod)) continue;  // leader/coordinator pods w/o chips
+    ++tpu_pods;
+    const Value* pn = pod.at_path("metadata.name");
+    if (!pn || !pn->is_string()) return false;
+    if (!idle.count(pod_key(ns, pn->as_string()))) {
+      log::info("jobset " + ns + "/" + name + " not fully idle: pod " + pn->as_string() +
+                " is active — skipping suspend");
+      return false;
+    }
+  }
+  if (tpu_pods == 0) {
+    log::info("jobset " + ns + "/" + name + " has no google.com/tpu pods — skipping");
+    return false;
+  }
+  return true;
+}
+
+}  // namespace tpupruner::walker

@@ -1,0 +1,14 @@
+"""Hermetic test fixtures: fake Prometheus and fake Kubernetes API servers.
+
+The reference has no mock metric backend at all — its query layer is tested
+only via rendered-query assertions and its K8s layer only against a real
+kind cluster (SURVEY.md §4). These fixtures close that gap: the full
+pipeline (query → decode → resolve → scale) runs against local HTTP servers
+with canned instant-vector responses and an in-memory object store that
+applies real merge-patch semantics.
+"""
+
+from tpu_pruner.testing.fake_k8s import FakeK8s
+from tpu_pruner.testing.fake_prom import FakePrometheus
+
+__all__ = ["FakeK8s", "FakePrometheus"]

@@ -1,0 +1,323 @@
+"""In-process fake Kubernetes API server.
+
+Generic path-keyed object store with real RFC 7386 merge-patch semantics,
+labelSelector pod LISTs, the /scale subresource, and an Events sink — the
+exact surface the pruner's watch-free client uses (GET/LIST/PATCH/POST).
+
+Scenario helpers build the reference's ownership chains (Pod→RS→Deployment,
+Pod→SS→Notebook, kserve-labelled pods) plus the TPU-native one
+(Pod→Job→JobSet multi-host slices with google.com/tpu requests).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from datetime import datetime, timedelta, timezone
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+def merge_patch(target, patch):
+    """RFC 7386 JSON merge patch."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = merge_patch(out.get(k), v)
+    return out
+
+
+def rfc3339(dt: datetime) -> str:
+    return dt.astimezone(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def age(seconds: int) -> str:
+    """creationTimestamp `seconds` ago."""
+    return rfc3339(datetime.now(timezone.utc) - timedelta(seconds=seconds))
+
+
+class FakeK8s:
+    def __init__(self):
+        # path (e.g. "/api/v1/namespaces/ns/pods/p") → object dict
+        self.objects: dict[str, dict] = {}
+        self.events: list[dict] = []
+        self.patches: list[tuple[str, dict]] = []  # (path, body) in arrival order
+        self.requests: list[tuple[str, str]] = []  # (method, path)
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # ── object builders ────────────────────────────────────────────────
+    @staticmethod
+    def _meta(name, ns, uid=None, owners=None, labels=None, created_age=7200):
+        meta = {
+            "name": name,
+            "namespace": ns,
+            "uid": uid or str(uuid.uuid4()),
+            "resourceVersion": "1",
+            "creationTimestamp": age(created_age),
+        }
+        if owners:
+            meta["ownerReferences"] = owners
+        if labels:
+            meta["labels"] = labels
+        return meta
+
+    @staticmethod
+    def owner(kind, name, uid="owner-uid"):
+        return {"apiVersion": "v1", "kind": kind, "name": name, "uid": uid, "controller": True}
+
+    def add_pod(self, ns, name, owners=None, labels=None, phase="Running",
+                created_age=7200, tpu_chips=4, no_creation_ts=False):
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": self._meta(name, ns, owners=owners, labels=labels,
+                                   created_age=created_age),
+            "spec": {
+                "containers": [
+                    {
+                        "name": "main",
+                        "resources": (
+                            {"requests": {"google.com/tpu": str(tpu_chips)},
+                             "limits": {"google.com/tpu": str(tpu_chips)}}
+                            if tpu_chips
+                            else {}
+                        ),
+                    }
+                ]
+            },
+            "status": {"phase": phase},
+        }
+        if no_creation_ts:
+            del pod["metadata"]["creationTimestamp"]
+        self.objects[f"/api/v1/namespaces/{ns}/pods/{name}"] = pod
+        return pod
+
+    def _add_apps(self, plural, kind, ns, name, uid=None, owners=None, replicas=2):
+        obj = {
+            "apiVersion": "apps/v1",
+            "kind": kind,
+            "metadata": self._meta(name, ns, uid=uid, owners=owners),
+            "spec": {"replicas": replicas},
+        }
+        self.objects[f"/apis/apps/v1/namespaces/{ns}/{plural}/{name}"] = obj
+        return obj
+
+    def add_deployment(self, ns, name, uid=None, replicas=2):
+        return self._add_apps("deployments", "Deployment", ns, name, uid, replicas=replicas)
+
+    def add_replicaset(self, ns, name, uid=None, owners=None, replicas=2):
+        return self._add_apps("replicasets", "ReplicaSet", ns, name, uid, owners, replicas)
+
+    def add_statefulset(self, ns, name, uid=None, owners=None, replicas=1):
+        return self._add_apps("statefulsets", "StatefulSet", ns, name, uid, owners, replicas)
+
+    def add_notebook(self, ns, name, uid=None):
+        obj = {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "Notebook",
+            "metadata": self._meta(name, ns, uid=uid),
+            "spec": {"template": {}},
+        }
+        self.objects[f"/apis/kubeflow.org/v1/namespaces/{ns}/notebooks/{name}"] = obj
+        return obj
+
+    def add_inference_service(self, ns, name, uid=None, min_replicas=1):
+        obj = {
+            "apiVersion": "serving.kserve.io/v1beta1",
+            "kind": "InferenceService",
+            "metadata": self._meta(name, ns, uid=uid),
+            "spec": {"predictor": {"minReplicas": min_replicas}},
+        }
+        self.objects[
+            f"/apis/serving.kserve.io/v1beta1/namespaces/{ns}/inferenceservices/{name}"
+        ] = obj
+        return obj
+
+    def add_job(self, ns, name, uid=None, owners=None):
+        obj = {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": self._meta(name, ns, uid=uid, owners=owners),
+            "spec": {},
+        }
+        self.objects[f"/apis/batch/v1/namespaces/{ns}/jobs/{name}"] = obj
+        return obj
+
+    def add_jobset(self, ns, name, uid=None):
+        obj = {
+            "apiVersion": "jobset.x-k8s.io/v1alpha2",
+            "kind": "JobSet",
+            "metadata": self._meta(name, ns, uid=uid),
+            "spec": {"suspend": False, "replicatedJobs": []},
+        }
+        self.objects[f"/apis/jobset.x-k8s.io/v1alpha2/namespaces/{ns}/jobsets/{name}"] = obj
+        return obj
+
+    def add_jobset_slice(self, ns, jobset_name, num_hosts=4, tpu_chips=4, uid=None,
+                         pod_age=7200):
+        """A multi-host TPU slice: JobSet → Job → worker pods (one per host)."""
+        js = self.add_jobset(ns, jobset_name, uid=uid)
+        job_name = f"{jobset_name}-workers-0"
+        self.add_job(ns, job_name,
+                     owners=[self.owner("JobSet", jobset_name, js["metadata"]["uid"])])
+        pods = []
+        for host in range(num_hosts):
+            pods.append(
+                self.add_pod(
+                    ns,
+                    f"{job_name}-{host}",
+                    owners=[self.owner("Job", job_name)],
+                    labels={
+                        "jobset.sigs.k8s.io/jobset-name": jobset_name,
+                        "batch.kubernetes.io/job-name": job_name,
+                    },
+                    tpu_chips=tpu_chips,
+                    created_age=pod_age,
+                )
+            )
+        return js, pods
+
+    # ── deployment chain helper (Pod→RS→Deployment) ──
+    def add_deployment_chain(self, ns, name, num_pods=1, tpu_chips=4, pod_age=7200):
+        dep = self.add_deployment(ns, name)
+        rs = self.add_replicaset(
+            ns, f"{name}-abc123",
+            owners=[self.owner("Deployment", name, dep["metadata"]["uid"])])
+        pods = [
+            self.add_pod(
+                ns, f"{name}-abc123-{i}",
+                owners=[self.owner("ReplicaSet", rs["metadata"]["name"], rs["metadata"]["uid"])],
+                tpu_chips=tpu_chips, created_age=pod_age)
+            for i in range(num_pods)
+        ]
+        return dep, rs, pods
+
+    # ── introspection ──
+    def scale_patches(self):
+        return [(p, b) for p, b in self.patches if p.endswith("/scale")]
+
+    def patches_for(self, path_suffix):
+        return [b for p, b in self.patches if p.endswith(path_suffix)]
+
+    # ── lifecycle ──────────────────────────────────────────────────────
+    def start(self) -> int:
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _respond(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _not_found(self):
+                self._respond(404, {"kind": "Status", "status": "Failure",
+                                    "reason": "NotFound", "code": 404,
+                                    "message": f"{self.path} not found"})
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                path = parsed.path
+                with fake._lock:
+                    fake.requests.append(("GET", self.path))
+                    # pod LIST with labelSelector
+                    if path.endswith("/pods") and "/namespaces/" in path:
+                        selector = parse_qs(parsed.query).get("labelSelector", [""])[0]
+                        wanted = {}
+                        for clause in filter(None, selector.split(",")):
+                            if "=" in clause:
+                                k, v = clause.split("=", 1)
+                                wanted[k] = v
+                        prefix = path + "/"
+                        items = [
+                            obj for p, obj in fake.objects.items()
+                            if p.startswith(prefix)
+                            and all(
+                                obj["metadata"].get("labels", {}).get(k) == v
+                                for k, v in wanted.items()
+                            )
+                        ]
+                        self._respond(200, {"kind": "PodList", "apiVersion": "v1",
+                                            "items": items})
+                        return
+                    obj = fake.objects.get(path)
+                if obj is None:
+                    self._not_found()
+                    return
+                self._respond(200, obj)
+
+            def do_PATCH(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                path = urlparse(self.path).path
+                with fake._lock:
+                    fake.requests.append(("PATCH", self.path))
+                    fake.patches.append((path, body))
+                    target_path = path.removesuffix("/scale")
+                    obj = fake.objects.get(target_path)
+                    if obj is None:
+                        self._not_found()
+                        return
+                    fake.objects[target_path] = merge_patch(obj, body)
+                    self._respond(200, fake.objects[target_path])
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                path = urlparse(self.path).path
+                with fake._lock:
+                    fake.requests.append(("POST", self.path))
+                    if path.endswith("/events"):
+                        fake.events.append(body)
+                        self._respond(201, body)
+                        return
+                self._not_found()
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        assert self._server is not None
+        return f"http://127.0.0.1:{self._server.server_address[1]}"
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def main() -> None:  # standalone: python -m tpu_pruner.testing.fake_k8s
+    fake = FakeK8s()
+    fake.add_deployment_chain("default", "demo")
+    port = fake.start()
+    print(f"fake k8s api listening on http://127.0.0.1:{port}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        fake.stop()
+
+
+if __name__ == "__main__":
+    main()

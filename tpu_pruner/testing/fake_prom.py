@@ -1,0 +1,138 @@
+"""In-process fake Prometheus serving /api/v1/query.
+
+Returns canned instant-vector series, records every query (and auth
+header) it receives, and can be told to fail N requests — which is how the
+daemon's consecutive-failure budget is exercised hermetically.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+class FakePrometheus:
+    def __init__(self):
+        self.series: list[dict] = []
+        self.queries: list[str] = []
+        self.auth_headers: list[str | None] = []
+        self.fail_requests_remaining = 0
+        self.fail_status = 500
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # ── scenario helpers ──
+    def add_idle_pod_series(
+        self,
+        pod: str,
+        namespace: str,
+        container: str = "main",
+        value: float = 0.0,
+        accelerator_type: str = "tpu-v5-lite-podslice",
+        chips: int = 1,
+        exported: bool = True,
+        extra_labels: dict | None = None,
+    ) -> None:
+        """One series per chip, like real per-chip TPU metrics."""
+        prefix = "exported_" if exported else ""
+        for chip in range(chips):
+            labels = {
+                f"{prefix}pod": pod,
+                f"{prefix}namespace": namespace,
+                f"{prefix}container": container,
+                "accelerator_id": str(chip),
+                "accelerator_type": accelerator_type,
+                "node_type": accelerator_type,
+            }
+            labels.update(extra_labels or {})
+            self.series.append({"metric": labels, "value": [time.time(), str(value)]})
+
+    # ── lifecycle ──
+    def start(self) -> int:
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence
+                pass
+
+            def _respond(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _handle_query(self, query: str):
+                with fake._lock:
+                    fake.queries.append(query)
+                    fake.auth_headers.append(self.headers.get("Authorization"))
+                    if fake.fail_requests_remaining > 0:
+                        fake.fail_requests_remaining -= 1
+                        self._respond(
+                            fake.fail_status,
+                            {"status": "error", "errorType": "internal", "error": "injected"},
+                        )
+                        return
+                    result = list(fake.series)
+                self._respond(
+                    200,
+                    {
+                        "status": "success",
+                        "data": {"resultType": "vector", "result": result},
+                    },
+                )
+
+            def do_POST(self):
+                parsed = urlparse(self.path)
+                if parsed.path != "/api/v1/query":
+                    self._respond(404, {"status": "error", "error": "not found"})
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length).decode()
+                query = parse_qs(body).get("query", [""])[0]
+                self._handle_query(query)
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                if parsed.path != "/api/v1/query":
+                    self._respond(404, {"status": "error", "error": "not found"})
+                    return
+                query = parse_qs(parsed.query).get("query", [""])[0]
+                self._handle_query(query)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        assert self._server is not None
+        return f"http://127.0.0.1:{self._server.server_address[1]}"
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def main() -> None:  # standalone: python -m tpu_pruner.testing.fake_prom
+    fake = FakePrometheus()
+    fake.add_idle_pod_series("demo-pod", "default", chips=4)
+    port = fake.start()
+    print(f"fake prometheus listening on http://127.0.0.1:{port}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        fake.stop()
+
+
+if __name__ == "__main__":
+    main()
