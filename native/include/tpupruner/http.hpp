@@ -9,12 +9,17 @@
 // Scope matches the reference's needs exactly: request/response with
 // bearer-token headers, TLS skip/verify modes and a custom CA bundle
 // (TlsMode, lib.rs:233-238, 248-271), content-length and chunked bodies.
-// No connection pooling: the reference rebuilds its Prometheus client every
-// cycle (main.rs:296) and the K8s call pattern is a handful of GETs/PATCHes
-// per candidate pod.
+// Persistent connections: requests default to HTTP/1.1 keep-alive with a
+// per-client connection pool (keyed host:port), because the owner walk
+// issues 1-3 API calls per candidate pod (main.rs:444-446) and paying a
+// TCP+TLS handshake for each one dominates the resolve fan-out at scale.
+// A request on a stale pooled connection (server closed it) is retried
+// once on a fresh connection iff no response bytes were received.
 #pragma once
 
 #include <map>
+#include <memory>
+#include <mutex>
 #include <optional>
 #include <string>
 #include <vector>
@@ -46,17 +51,29 @@ struct Response {
   std::map<std::string, std::string> headers;  // keys lowercased
 };
 
+namespace detail {
+struct Conn;  // pooled transport (fd + optional TLS session)
+}
+
 class Client {
  public:
   explicit Client(TlsMode tls_mode = TlsMode::Verify, std::string ca_file = "");
+  ~Client();
+  Client(Client&&) noexcept;
+  Client& operator=(Client&&) = delete;
 
   // Throws std::runtime_error on transport/TLS errors; HTTP error statuses
-  // are returned, not thrown.
+  // are returned, not thrown. Thread-safe; idle connections are pooled and
+  // reused across calls.
   Response request(const Request& req) const;
 
  private:
+  Response request_once(const Request& req, const Url& url, bool allow_reuse) const;
+
   TlsMode tls_mode_;
   std::string ca_file_;
+  mutable std::mutex pool_mutex_;
+  mutable std::multimap<std::string, std::unique_ptr<detail::Conn>> pool_;
 };
 
 }  // namespace tpupruner::http

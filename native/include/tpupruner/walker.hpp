@@ -13,19 +13,51 @@
 // alive and mid-collective; suspending it would kill healthy hosts).
 #pragma once
 
+#include <condition_variable>
 #include <functional>
+#include <memory>
+#include <mutex>
+#include <optional>
 #include <set>
 #include <string>
+#include <unordered_map>
+#include <vector>
 
 #include "tpupruner/core.hpp"
 #include "tpupruner/k8s.hpp"
 
 namespace tpupruner::walker {
 
+// Per-cycle memoization of owner fetches. Every pod of a multi-host slice
+// shares the same Job → JobSet chain and every pod of a Deployment shares
+// its ReplicaSet, so the reference's refetch-per-pod pattern (lib.rs:465,
+// 485) costs O(pods) API calls where O(owners) suffices. Entries live for
+// one evaluation cycle — the same staleness window the reference already
+// tolerates for in-flight objects. Thread-safe.
+class FetchCache {
+ public:
+  // nullopt-cached misses are remembered too (404s repeat per cycle).
+  using Entry = std::optional<json::Value>;
+  Entry get_or_fetch(const std::string& key, const std::function<Entry()>& fetch);
+
+ private:
+  struct Flight {
+    std::mutex m;
+    std::condition_variable cv;
+    bool done = false;
+    bool failed = false;  // leader threw; waiters retry instead of caching
+    Entry entry;
+  };
+  std::mutex mutex_;
+  std::unordered_map<std::string, std::shared_ptr<Flight>> map_;
+};
+
 // Resolve the root scalable object for a pod (fetched Pod JSON).
 // Throws std::runtime_error("no scalable root object ...") when the pod has
 // no recognized owner chain — callers log-and-skip (main.rs:517-527).
-core::ScaleTarget find_root_object(const k8s::Client& client, const json::Value& pod);
+// `cache` (optional) memoizes owner fetches within an evaluation cycle.
+core::ScaleTarget find_root_object(const k8s::Client& client, const json::Value& pod,
+                                   FetchCache* cache = nullptr);
 
 // Key "ns/pod" set of idle pods discovered this cycle.
 using IdlePodSet = std::set<std::string>;
@@ -38,6 +70,14 @@ inline std::string pod_key(const std::string& ns, const std::string& name) {
 // jobset.sigs.k8s.io/jobset-name label.
 bool jobset_fully_idle(const k8s::Client& client, const core::ScaleTarget& jobset,
                        const IdlePodSet& idle);
+
+// Batch form: ONE set-based-selector LIST per namespace
+// (`jobset-name in (a,b,...)`) instead of one LIST per JobSet — at reclaim
+// scale the per-slice LISTs dominate the gate. Returns keep flags aligned
+// with `jobsets`; entries the LIST failed for are kept=false (safe side).
+std::vector<char> jobsets_fully_idle(const k8s::Client& client,
+                                     const std::vector<const core::ScaleTarget*>& jobsets,
+                                     const IdlePodSet& idle);
 
 // True when any container of the pod requests google.com/tpu (requests or
 // limits) — the resource-model filter for slice membership.

@@ -85,6 +85,7 @@ TPU FLAGS:
       --duty-cycle-metric <N>   override duty-cycle fallback metric name
       --hbm-metric <N>          override HBM bandwidth metric name
       --resolve-concurrency <N> concurrent pod resolutions [default: 10]
+      --scale-concurrency <N>   concurrent scale actuations [default: 8]
       --metrics-port <P>        serve Prometheus /metrics on this port
   -h, --help                    print this help
 )";
@@ -139,6 +140,11 @@ Cli parse(int argc, char** argv) {
        [&](const std::string& v) {
          cli.resolve_concurrency = parse_int("--resolve-concurrency", v);
          if (cli.resolve_concurrency < 1) throw CliError("--resolve-concurrency must be >= 1");
+       }},
+      {"--scale-concurrency",
+       [&](const std::string& v) {
+         cli.scale_concurrency = parse_int("--scale-concurrency", v);
+         if (cli.scale_concurrency < 1) throw CliError("--scale-concurrency must be >= 1");
        }},
       {"--metrics-port",
        [&](const std::string& v) {

@@ -88,6 +88,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   ResolveOutcome out;
   std::mutex out_mutex;
   std::atomic<size_t> next{0};
+  walker::FetchCache owner_cache;  // memoize shared owner chains this cycle
   int64_t lookback_secs = args.duration * 60 + args.grace_period;  // main.rs:413-414
   int64_t now = util::now_unix();
 
@@ -135,7 +136,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
 
       std::optional<ScaleTarget> target;
       try {
-        target = walker::find_root_object(kube, *pod);
+        target = walker::find_root_object(kube, *pod, &owner_cache);
       } catch (const std::exception& e) {
         log::warn("Skipping " + key + ", no scalable root object: " + e.what());
       }
@@ -173,18 +174,26 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   // Multi-host slice gate: a JobSet is only a candidate when every
   // google.com/tpu pod of the slice is idle (SURVEY.md §7 hard-part #1 —
   // a partial-slice suspend would kill live hosts mid-collective).
-  std::vector<ScaleTarget> survivors;
-  survivors.reserve(unique.size());
-  for (ScaleTarget& t : unique) {
-    if (t.kind == core::Kind::JobSet) {
-      try {
-        if (!walker::jobset_fully_idle(kube, t, resolved.idle_pods)) continue;
-      } catch (const std::exception& e) {
-        log::warn("jobset idleness check failed for " + t.name() + ": " + e.what());
-        continue;
+  // One set-based-selector LIST per namespace covers every JobSet in it.
+  std::vector<char> keep(unique.size(), 1);
+  {
+    std::vector<const ScaleTarget*> jobsets;
+    std::vector<size_t> jobset_indices;
+    for (size_t i = 0; i < unique.size(); ++i) {
+      if (unique[i].kind == core::Kind::JobSet) {
+        jobsets.push_back(&unique[i]);
+        jobset_indices.push_back(i);
       }
     }
-    survivors.push_back(std::move(t));
+    if (!jobsets.empty()) {
+      std::vector<char> verdicts = walker::jobsets_fully_idle(kube, jobsets, resolved.idle_pods);
+      for (size_t j = 0; j < jobset_indices.size(); ++j) keep[jobset_indices[j]] = verdicts[j];
+    }
+  }
+  std::vector<ScaleTarget> survivors;
+  survivors.reserve(unique.size());
+  for (size_t i = 0; i < unique.size(); ++i) {
+    if (keep[i]) survivors.push_back(std::move(unique[i]));
   }
 
   CycleStats stats;
@@ -240,7 +249,11 @@ int run(const cli::Cli& args) {
 
   TargetQueue queue(kQueueCapacity);
 
-  std::thread consumer([&] {
+  // Consumer pool (the reference's single scale_down_task, main.rs:332-367,
+  // widened: each target still does event-then-patch in order, but separate
+  // targets actuate concurrently — on big reclaim cycles the serial
+  // consumer dominates wall clock).
+  auto consume_fn = [&] {
     while (true) {
       std::optional<ScaleTarget> t = queue.pop();
       if (!t) break;  // closed + drained
@@ -262,7 +275,9 @@ int run(const cli::Cli& args) {
       log::info("Scaled Resource: [" + std::string(core::kind_name(t->kind)) + "] - " +
                 t->ns().value_or("default") + ":" + t->name());
     }
-  });
+  };
+  std::vector<std::thread> consumers;
+  for (int64_t i = 0; i < args.scale_concurrency; ++i) consumers.emplace_back(consume_fn);
 
   // Producer loop (reference query_task, main.rs:286-330).
   int consecutive_failures = 0;
@@ -299,7 +314,7 @@ int run(const cli::Cli& args) {
   }
 
   queue.close();
-  consumer.join();
+  for (std::thread& c : consumers) c.join();
   // Deviation from the reference (which exits 0 even when its only cycle
   // failed, main.rs:324-326): a failed single-shot run exits 1 so cron/CI
   // wrappers can detect it. Daemon mode exits 1 only on budget exhaustion.

@@ -9,7 +9,6 @@
 #include <unistd.h>
 
 #include <cstring>
-#include <memory>
 #include <stdexcept>
 
 #include "tls.hpp"
@@ -18,15 +17,60 @@
 namespace tpupruner::http {
 
 namespace {
+[[noreturn]] void fail(const std::string& msg) { throw std::runtime_error("http: " + msg); }
+}  // namespace
 
-struct FdGuard {
+namespace detail {
+
+// One live connection: owned fd, optional TLS session, leftover read buffer.
+struct Conn {
   int fd = -1;
-  ~FdGuard() {
+  std::unique_ptr<tls::Conn> tls_conn;
+  bool reused = false;  // came from the pool (stale-retry eligibility)
+
+  ~Conn() {
+    tls_conn.reset();  // TLS shutdown before close
     if (fd >= 0) ::close(fd);
+  }
+
+  size_t read(char* buf, size_t n) {
+    if (tls_conn) return tls_conn->read(buf, n);
+    ssize_t rc = ::recv(fd, buf, n, 0);
+    if (rc < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) fail("read timeout");
+      fail(std::string("read: ") + std::strerror(errno));
+    }
+    return static_cast<size_t>(rc);
+  }
+
+  void write_all(const char* buf, size_t n) {
+    if (tls_conn) {
+      tls_conn->write_all(buf, n);
+      return;
+    }
+    size_t off = 0;
+    while (off < n) {
+      ssize_t rc = ::send(fd, buf + off, n - off, MSG_NOSIGNAL);
+      if (rc < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) fail("write timeout");
+        fail(std::string("write: ") + std::strerror(errno));
+      }
+      off += static_cast<size_t>(rc);
+    }
+  }
+
+  void set_timeout(int timeout_ms) {
+    struct timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
   }
 };
 
-[[noreturn]] void fail(const std::string& msg) { throw std::runtime_error("http: " + msg); }
+}  // namespace detail
+
+namespace {
+
+using detail::Conn;
 
 int connect_with_timeout(const std::string& host, int port, int timeout_ms) {
   struct addrinfo hints{};
@@ -63,15 +107,15 @@ int connect_with_timeout(const std::string& host, int port, int timeout_ms) {
       last_err = std::strerror(errno);
     }
     if (rc == 0) {
-      // Back to blocking mode with socket-level timeouts for read/write.
-      int flags = 0;
+      int nodelay = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nodelay, sizeof(nodelay));
+      // Install socket timeouts BEFORE any TLS handshake runs on this fd —
+      // SSL_connect on a blocking socket would otherwise hang forever on a
+      // black-holed peer.
       struct timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
       ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
       ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-      int nodelay = 1;
-      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nodelay, sizeof(nodelay));
-      // clear O_NONBLOCK
-      flags = ::fcntl(fd, F_GETFL, 0);
+      int flags = ::fcntl(fd, F_GETFL, 0);
       ::fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
       return fd;
     }
@@ -80,57 +124,27 @@ int connect_with_timeout(const std::string& host, int port, int timeout_ms) {
   fail("connect " + host + ":" + port_s + ": " + last_err);
 }
 
-// Transport abstraction over plain fd vs TLS session.
-struct Transport {
-  int fd = -1;
-  std::unique_ptr<tls::Conn> tls_conn;
-
-  size_t read(char* buf, size_t n) {
-    if (tls_conn) return tls_conn->read(buf, n);
-    ssize_t rc = ::recv(fd, buf, n, 0);
-    if (rc < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) fail("read timeout");
-      fail(std::string("read: ") + std::strerror(errno));
-    }
-    return static_cast<size_t>(rc);
-  }
-  void write_all(const char* buf, size_t n) {
-    if (tls_conn) {
-      tls_conn->write_all(buf, n);
-      return;
-    }
-    size_t off = 0;
-    while (off < n) {
-      ssize_t rc = ::send(fd, buf + off, n - off, MSG_NOSIGNAL);
-      if (rc < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK) fail("write timeout");
-        fail(std::string("write: ") + std::strerror(errno));
-      }
-      off += static_cast<size_t>(rc);
-    }
-  }
-};
-
 // Incremental reader with buffering for header/line parsing.
 struct Reader {
-  Transport& t;
-  std::string buf;
+  Conn& conn;
+  std::string buf{};
   size_t pos = 0;
   bool eof = false;
+  bool got_bytes = false;  // any response bytes at all (stale-retry signal)
 
   bool fill() {
     if (eof) return false;
-    char chunk[8192];
-    size_t n = t.read(chunk, sizeof(chunk));
+    char chunk[16384];
+    size_t n = conn.read(chunk, sizeof(chunk));
     if (n == 0) {
       eof = true;
       return false;
     }
+    got_bytes = true;
     buf.append(chunk, n);
     return true;
   }
 
-  // Read a CRLF (or LF) terminated line, without the terminator.
   std::string read_line() {
     while (true) {
       size_t nl = buf.find('\n', pos);
@@ -140,7 +154,7 @@ struct Reader {
         if (!line.empty() && line.back() == '\r') line.pop_back();
         return line;
       }
-      if (!fill()) fail("unexpected EOF in headers");
+      if (!fill()) fail("unexpected EOF in response");
     }
   }
 
@@ -160,6 +174,12 @@ struct Reader {
     pos = buf.size();
     return out;
   }
+
+  bool drained() const { return pos >= buf.size(); }
+};
+
+struct StaleConnection : std::runtime_error {
+  using std::runtime_error::runtime_error;
 };
 
 }  // namespace
@@ -202,22 +222,60 @@ std::optional<Url> parse_url(std::string_view url) {
 Client::Client(TlsMode tls_mode, std::string ca_file)
     : tls_mode_(tls_mode), ca_file_(std::move(ca_file)) {}
 
+Client::~Client() = default;
+
+Client::Client(Client&& other) noexcept
+    : tls_mode_(other.tls_mode_), ca_file_(std::move(other.ca_file_)) {
+  std::lock_guard<std::mutex> lock(other.pool_mutex_);
+  pool_ = std::move(other.pool_);
+}
+
 Response Client::request(const Request& req) const {
   auto url = parse_url(req.url);
   if (!url) fail("invalid url: " + req.url);
-
-  FdGuard fd{connect_with_timeout(url->host, url->port, req.timeout_ms)};
-  Transport transport;
-  transport.fd = fd.fd;
-  if (url->scheme == "https") {
-    transport.tls_conn = std::make_unique<tls::Conn>(
-        fd.fd, url->host, tls_mode_ == TlsMode::Verify, ca_file_);
+  // POST is the one non-idempotent method this client carries (Event
+  // creation); it always goes out on a fresh connection so a stale pooled
+  // socket can never force the replay-or-fail dilemma (RFC 9110 §9.2.2
+  // permits automatic retry only for idempotent requests). GET/PATCH
+  // (merge-patches here: replicas=0, suspend=true) are safe to replay.
+  bool reuse_ok = req.method != "POST";
+  try {
+    return request_once(req, *url, reuse_ok);
+  } catch (const StaleConnection&) {
+    // The pooled connection died between requests (idle timeout on the
+    // server side). No response bytes were received, so a single retry on
+    // a fresh connection is safe for these idempotent methods.
+    return request_once(req, *url, /*allow_reuse=*/false);
   }
+}
+
+Response Client::request_once(const Request& req, const Url& url, bool allow_reuse) const {
+  const std::string pool_key = url.scheme + "://" + url.host + ":" + std::to_string(url.port);
+
+  std::unique_ptr<Conn> conn;
+  if (allow_reuse) {
+    std::lock_guard<std::mutex> lock(pool_mutex_);
+    auto it = pool_.find(pool_key);
+    if (it != pool_.end()) {
+      conn = std::move(it->second);
+      conn->reused = true;
+      pool_.erase(it);
+    }
+  }
+  if (!conn) {
+    conn = std::make_unique<Conn>();
+    conn->fd = connect_with_timeout(url.host, url.port, req.timeout_ms);
+    if (url.scheme == "https") {
+      conn->tls_conn = std::make_unique<tls::Conn>(conn->fd, url.host,
+                                                   tls_mode_ == TlsMode::Verify, ca_file_);
+    }
+  }
+  conn->set_timeout(req.timeout_ms);
 
   // ── send request ──
-  std::string msg = req.method + " " + url->target + " HTTP/1.1\r\n";
-  msg += "Host: " + url->host +
-         (url->port != (url->scheme == "https" ? 443 : 80) ? ":" + std::to_string(url->port) : "") +
+  std::string msg = req.method + " " + url.target + " HTTP/1.1\r\n";
+  msg += "Host: " + url.host +
+         (url.port != (url.scheme == "https" ? 443 : 80) ? ":" + std::to_string(url.port) : "") +
          "\r\n";
   bool has_ua = false;
   for (const auto& [k, v] : req.headers) {
@@ -228,20 +286,29 @@ Response Client::request(const Request& req) const {
   if (!req.body.empty() || req.method == "POST" || req.method == "PATCH" || req.method == "PUT") {
     msg += "Content-Length: " + std::to_string(req.body.size()) + "\r\n";
   }
-  msg += "Connection: close\r\n\r\n";
+  msg += "\r\n";
   msg += req.body;
-  transport.write_all(msg.data(), msg.size());
+
+  Reader reader{*conn};
+  try {
+    conn->write_all(msg.data(), msg.size());
+  } catch (const std::exception& e) {
+    if (conn->reused) throw StaleConnection(e.what());
+    throw;
+  }
 
   // ── read response ──
-  Reader reader{transport};
-  std::string status_line = reader.read_line();
-  // "HTTP/1.1 200 OK"
   Response resp;
-  {
+  try {
+    std::string status_line = reader.read_line();
     auto sp1 = status_line.find(' ');
     if (sp1 == std::string::npos) fail("malformed status line: " + status_line);
     resp.status = std::atoi(status_line.c_str() + sp1 + 1);
     if (resp.status < 100 || resp.status > 599) fail("bad status in: " + status_line);
+  } catch (const std::exception& e) {
+    // EOF/reset before any bytes on a reused connection → stale.
+    if (conn->reused && !reader.got_bytes) throw StaleConnection(e.what());
+    throw;
   }
   while (true) {
     std::string line = reader.read_line();
@@ -252,45 +319,64 @@ Response Client::request(const Request& req) const {
     resp.headers[key] = util::trim(line.substr(colon + 1));
   }
 
-  if (req.method == "HEAD" || resp.status == 204 || resp.status == 304) return resp;
+  bool keep_alive = true;
+  if (auto c = resp.headers.find("connection"); c != resp.headers.end()) {
+    keep_alive = util::to_lower(c->second).find("close") == std::string::npos;
+  }
 
-  auto te = resp.headers.find("transfer-encoding");
-  if (te != resp.headers.end() && util::to_lower(te->second).find("chunked") != std::string::npos) {
-    while (true) {
-      std::string size_line = reader.read_line();
-      size_t semi = size_line.find(';');
-      if (semi != std::string::npos) size_line.resize(semi);
-      size_t chunk_size = 0;
-      try {
-        chunk_size = static_cast<size_t>(std::stoul(util::trim(size_line), nullptr, 16));
-      } catch (const std::exception&) {
-        fail("bad chunk size: " + size_line);
+  bool body_expected = !(req.method == "HEAD" || resp.status == 204 || resp.status == 304);
+  if (body_expected) {
+    auto te = resp.headers.find("transfer-encoding");
+    if (te != resp.headers.end() &&
+        util::to_lower(te->second).find("chunked") != std::string::npos) {
+      while (true) {
+        std::string size_line = reader.read_line();
+        size_t semi = size_line.find(';');
+        if (semi != std::string::npos) size_line.resize(semi);
+        size_t chunk_size = 0;
+        try {
+          chunk_size = static_cast<size_t>(std::stoul(util::trim(size_line), nullptr, 16));
+        } catch (const std::exception&) {
+          fail("bad chunk size: " + size_line);
+        }
+        if (chunk_size == 0) break;
+        resp.body += reader.read_exact(chunk_size);
+        reader.read_line();  // CRLF after chunk data
       }
-      if (chunk_size == 0) break;
-      resp.body += reader.read_exact(chunk_size);
-      reader.read_line();  // trailing CRLF after chunk data
-    }
-    // drain trailers until blank line (tolerate EOF)
-    while (true) {
-      if (reader.eof && reader.pos >= reader.buf.size()) break;
-      std::string line;
+      // Trailers until blank line; the body is already complete, so a
+      // server closing without the final CRLF is tolerated (the connection
+      // just isn't reusable).
       try {
-        line = reader.read_line();
+        while (true) {
+          std::string line = reader.read_line();
+          if (line.empty()) break;
+        }
       } catch (const std::exception&) {
-        break;
+        keep_alive = false;
       }
-      if (line.empty()) break;
+    } else if (auto cl = resp.headers.find("content-length"); cl != resp.headers.end()) {
+      size_t n = 0;
+      try {
+        n = static_cast<size_t>(std::stoul(cl->second));
+      } catch (const std::exception&) {
+        fail("bad content-length: " + cl->second);
+      }
+      resp.body = reader.read_exact(n);
+    } else {
+      // Close-delimited body: connection is not reusable afterwards.
+      resp.body = reader.read_to_eof();
+      keep_alive = false;
     }
-  } else if (auto cl = resp.headers.find("content-length"); cl != resp.headers.end()) {
-    size_t n = 0;
-    try {
-      n = static_cast<size_t>(std::stoul(cl->second));
-    } catch (const std::exception&) {
-      fail("bad content-length: " + cl->second);
+  }
+
+  // Return the connection to the pool only when the response framing left
+  // it exactly at a message boundary.
+  if (keep_alive && reader.drained() && !reader.eof) {
+    std::lock_guard<std::mutex> lock(pool_mutex_);
+    if (pool_.count(pool_key) < 32) {
+      conn->reused = false;
+      pool_.emplace(pool_key, std::move(conn));
     }
-    resp.body = reader.read_exact(n);
-  } else {
-    resp.body = reader.read_to_eof();
   }
   return resp;
 }

@@ -17,12 +17,20 @@ std::string pod_ns(const Value& pod) {
   return (ns && ns->is_string()) ? ns->as_string() : "";
 }
 
-// Fetch `kind`/`name`, returning a target; nullopt when the fetch fails
-// (reference behavior: `if let Ok(rs) = rs_api.get(...)`, lib.rs:465).
-std::optional<ScaleTarget> fetch(const k8s::Client& client, Kind kind, const std::string& ns,
-                                 const std::string& name) {
+std::optional<Value> cached_get_opt(const k8s::Client& client, FetchCache* cache,
+                                    const std::string& path) {
+  auto do_fetch = [&]() -> FetchCache::Entry { return client.get_opt(path); };
+  if (cache) return cache->get_or_fetch(path, do_fetch);
+  return do_fetch();
+}
+
+// Mid-level fetch (ReplicaSet/StatefulSet/Job): failures are swallowed and
+// the ownerRef loop moves on (reference: `if let Ok(rs) = rs_api.get(...)`,
+// lib.rs:465, 485).
+std::optional<ScaleTarget> fetch(const k8s::Client& client, FetchCache* cache, Kind kind,
+                                 const std::string& ns, const std::string& name) {
   try {
-    auto obj = client.get_opt(k8s::Client::object_path(kind, ns, name));
+    auto obj = cached_get_opt(client, cache, k8s::Client::object_path(kind, ns, name));
     if (!obj) return std::nullopt;
     return ScaleTarget{kind, std::move(*obj)};
   } catch (const std::exception& e) {
@@ -30,6 +38,21 @@ std::optional<ScaleTarget> fetch(const k8s::Client& client, Kind kind, const std
               " failed: " + e.what());
     return std::nullopt;
   }
+}
+
+// Root-level fetch (Deployment from RS, Notebook from SS, JobSet from Job):
+// errors AND 404s propagate so the pod is skipped this cycle rather than
+// silently actuating the intermediate owner (reference `?` operator,
+// lib.rs:472, 492 — a transient apiserver error must not demote the target
+// from Deployment to ReplicaSet).
+ScaleTarget fetch_must(const k8s::Client& client, FetchCache* cache, Kind kind,
+                       const std::string& ns, const std::string& name) {
+  auto obj = cached_get_opt(client, cache, k8s::Client::object_path(kind, ns, name));
+  if (!obj) {
+    throw std::runtime_error(std::string(core::kind_name(kind)) + " " + ns + "/" + name +
+                             " referenced by owner chain but not found");
+  }
+  return ScaleTarget{kind, std::move(*obj)};
 }
 
 // First ownerReference of `object` with the given kind, or nullptr.
@@ -44,7 +67,59 @@ const Value* owner_of_kind(const Value& object, std::string_view kind) {
 
 }  // namespace
 
-ScaleTarget find_root_object(const k8s::Client& client, const Value& pod) {
+FetchCache::Entry FetchCache::get_or_fetch(const std::string& key,
+                                           const std::function<Entry()>& fetch) {
+  // Single-flight: the pods of one slice resolve concurrently, so a plain
+  // check-then-fetch would still issue one fetch per pod. The first caller
+  // for a key fetches; everyone else blocks on its completion. A leader
+  // failure is NOT cached — the flight is evicted and waiters retry, so a
+  // transient 500/timeout can't poison the key into a 404-style miss for
+  // the rest of the cycle (a miss here silently changes which owner gets
+  // scaled, e.g. ReplicaSet instead of its Deployment).
+  while (true) {
+    std::shared_ptr<Flight> flight;
+    bool leader = false;
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      auto it = map_.find(key);
+      if (it == map_.end()) {
+        it = map_.emplace(key, std::make_shared<Flight>()).first;
+        leader = true;
+      }
+      flight = it->second;
+    }
+    if (leader) {
+      Entry e;
+      try {
+        e = fetch();
+      } catch (...) {
+        {
+          std::lock_guard<std::mutex> lock(mutex_);
+          auto it = map_.find(key);
+          if (it != map_.end() && it->second == flight) map_.erase(it);
+        }
+        std::lock_guard<std::mutex> lock(flight->m);
+        flight->failed = true;
+        flight->done = true;
+        flight->cv.notify_all();
+        throw;
+      }
+      std::lock_guard<std::mutex> lock(flight->m);
+      flight->entry = std::move(e);
+      flight->done = true;
+      flight->cv.notify_all();
+      return flight->entry;
+    }
+    {
+      std::unique_lock<std::mutex> lock(flight->m);
+      flight->cv.wait(lock, [&] { return flight->done; });
+      if (!flight->failed) return flight->entry;
+    }
+    // leader failed: loop and try again (possibly becoming the leader)
+  }
+}
+
+ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchCache* cache) {
   std::string ns = pod_ns(pod);
   std::string pod_name = pod.at_path("metadata.name") ? pod.at_path("metadata.name")->as_string()
                                                       : "<unnamed>";
@@ -66,20 +141,16 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod) {
       std::string name = owner.get_string("name");
 
       if (kind == "ReplicaSet") {
-        if (auto rs = fetch(client, Kind::ReplicaSet, ns, name)) {
+        if (auto rs = fetch(client, cache, Kind::ReplicaSet, ns, name)) {
           if (const Value* dep_or = owner_of_kind(rs->object, "Deployment")) {
-            if (auto dep = fetch(client, Kind::Deployment, ns, dep_or->get_string("name"))) {
-              return std::move(*dep);
-            }
+            return fetch_must(client, cache, Kind::Deployment, ns, dep_or->get_string("name"));
           }
           return std::move(*rs);  // ReplicaSet with no Deployment owner
         }
       } else if (kind == "StatefulSet") {
-        if (auto ss = fetch(client, Kind::StatefulSet, ns, name)) {
+        if (auto ss = fetch(client, cache, Kind::StatefulSet, ns, name)) {
           if (const Value* nb_or = owner_of_kind(ss->object, "Notebook")) {
-            if (auto nb = fetch(client, Kind::Notebook, ns, nb_or->get_string("name"))) {
-              return std::move(*nb);
-            }
+            return fetch_must(client, cache, Kind::Notebook, ns, nb_or->get_string("name"));
           }
           return std::move(*ss);  // StatefulSet with no Notebook owner
         }
@@ -87,19 +158,19 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod) {
         // Multi-host TPU slice chain: Pod → Job → JobSet. Bare Jobs (no
         // JobSet owner) are batch workloads the pruner must not touch —
         // suspending them mid-run is destructive, so fall through.
+        std::optional<Value> job;
         try {
-          auto job = client.get_opt("/apis/batch/v1/namespaces/" + ns + "/jobs/" + name);
-          if (job) {
-            if (const Value* js_or = owner_of_kind(*job, "JobSet")) {
-              if (auto js = fetch(client, Kind::JobSet, ns, js_or->get_string("name"))) {
-                return std::move(*js);
-              }
-            }
-            log::debug("pod " + ns + "/" + pod_name + ": bare Job owner '" + name +
-                       "' is not scalable, ignoring");
-          }
+          job = cached_get_opt(client, cache,
+                               "/apis/batch/v1/namespaces/" + ns + "/jobs/" + name);
         } catch (const std::exception& e) {
           log::warn("fetch Job " + ns + "/" + name + " failed: " + e.what());
+        }
+        if (job) {
+          if (const Value* js_or = owner_of_kind(*job, "JobSet")) {
+            return fetch_must(client, cache, Kind::JobSet, ns, js_or->get_string("name"));
+          }
+          log::debug("pod " + ns + "/" + pod_name + ": bare Job owner '" + name +
+                     "' is not scalable, ignoring");
         }
       } else {
         log::debug("ignoring unrecognized owner ref kind: " + kind);
@@ -124,20 +195,16 @@ bool pod_requests_tpu(const json::Value& pod) {
   return false;
 }
 
-bool jobset_fully_idle(const k8s::Client& client, const ScaleTarget& jobset,
-                       const IdlePodSet& idle) {
-  std::string ns = jobset.ns().value_or("");
-  std::string name = jobset.name();
-  Value pods = client.list(k8s::Client::pods_path(ns),
-                           "jobset.sigs.k8s.io/jobset-name=" + name);
-  const Value* items = pods.find("items");
-  if (!items || !items->is_array()) return false;
+namespace {
 
+// Evaluate one jobset's verdict from its (already listed) pods.
+bool verdict_from_pods(const std::string& ns, const std::string& name,
+                       const std::vector<const Value*>& pods, const IdlePodSet& idle) {
   size_t tpu_pods = 0;
-  for (const Value& pod : items->as_array()) {
-    if (!pod_requests_tpu(pod)) continue;  // leader/coordinator pods w/o chips
+  for (const Value* pod : pods) {
+    if (!pod_requests_tpu(*pod)) continue;  // leader/coordinator pods w/o chips
     ++tpu_pods;
-    const Value* pn = pod.at_path("metadata.name");
+    const Value* pn = pod->at_path("metadata.name");
     if (!pn || !pn->is_string()) return false;
     if (!idle.count(pod_key(ns, pn->as_string()))) {
       log::info("jobset " + ns + "/" + name + " not fully idle: pod " + pn->as_string() +
@@ -150,6 +217,59 @@ bool jobset_fully_idle(const k8s::Client& client, const ScaleTarget& jobset,
     return false;
   }
   return true;
+}
+
+}  // namespace
+
+std::vector<char> jobsets_fully_idle(const k8s::Client& client,
+                                     const std::vector<const core::ScaleTarget*>& jobsets,
+                                     const IdlePodSet& idle) {
+  std::vector<char> keep(jobsets.size(), 0);
+  // group target indices by namespace
+  std::unordered_map<std::string, std::vector<size_t>> by_ns;
+  for (size_t i = 0; i < jobsets.size(); ++i) {
+    by_ns[jobsets[i]->ns().value_or("")].push_back(i);
+  }
+  for (auto& [ns, indices] : by_ns) {
+    std::string selector = "jobset.sigs.k8s.io/jobset-name in (";
+    for (size_t j = 0; j < indices.size(); ++j) {
+      if (j) selector += ",";
+      selector += jobsets[indices[j]]->name();
+    }
+    selector += ")";
+    Value pods;
+    try {
+      pods = client.list(k8s::Client::pods_path(ns), selector);
+    } catch (const std::exception& e) {
+      log::warn("jobset idleness LIST failed in namespace " + ns + ": " + e.what());
+      continue;  // all targets in this ns stay kept=false (safe side)
+    }
+    const Value* items = pods.find("items");
+    if (!items || !items->is_array()) continue;
+    // partition listed pods by jobset label
+    std::unordered_map<std::string, std::vector<const Value*>> pods_by_jobset;
+    for (const Value& pod : items->as_array()) {
+      const Value* labels = pod.at_path("metadata.labels");
+      if (!labels) continue;
+      const Value* js = labels->find("jobset.sigs.k8s.io/jobset-name");
+      if (js && js->is_string()) pods_by_jobset[js->as_string()].push_back(&pod);
+    }
+    for (size_t idx : indices) {
+      const std::string name = jobsets[idx]->name();
+      auto it = pods_by_jobset.find(name);
+      if (it == pods_by_jobset.end()) {
+        log::info("jobset " + ns + "/" + name + " has no pods — skipping");
+        continue;
+      }
+      keep[idx] = verdict_from_pods(ns, name, it->second, idle) ? 1 : 0;
+    }
+  }
+  return keep;
+}
+
+bool jobset_fully_idle(const k8s::Client& client, const ScaleTarget& jobset,
+                       const IdlePodSet& idle) {
+  return jobsets_fully_idle(client, {&jobset}, idle)[0] != 0;
 }
 
 }  // namespace tpupruner::walker

@@ -293,7 +293,10 @@ def test_metrics_endpoint_serves_counters(built, fake_prom, fake_k8s):
             try:
                 body = urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/metrics", timeout=2).read().decode()
-                if "tpu_pruner_query_successes" in body:
+                # counters appear once nonzero; wait for the full cycle
+                # including the consumer-side scale
+                if ("tpu_pruner_query_successes" in body
+                        and "tpu_pruner_scale_successes" in body):
                     break
             except OSError:
                 pass

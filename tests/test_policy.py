@@ -1,0 +1,153 @@
+"""JAX fleet policy engine tests — on a virtual 8-device CPU mesh.
+
+Checks the engine against a pure-numpy oracle and verifies the sharded
+(mesh + psum) evaluator agrees with the single-device one, including
+slices that span shard boundaries.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_pruner.policy import (
+    PolicyParams,
+    evaluate_fleet,
+    make_example_fleet,
+    make_sharded_evaluator,
+)
+from tpu_pruner.policy.engine import params_array
+
+
+def numpy_oracle(tc, hbm, valid, age, slice_id, lookback_s, hbm_cutoff, num_slices):
+    tc = np.asarray(tc); hbm = np.asarray(hbm); valid = np.asarray(valid)
+    age = np.asarray(age); slice_id = np.asarray(slice_id)
+    peak_tc = np.where(valid, tc, -1.0).max(axis=-1)
+    peak_hbm = np.where(valid, hbm, -1.0).max(axis=-1)
+    has_data = valid.any(axis=-1)
+    cand = (peak_tc <= 0) & has_data & ~(peak_hbm >= hbm_cutoff) & (age >= lookback_s)
+    verdict = np.zeros(num_slices, dtype=bool)
+    for s in range(num_slices):
+        members = slice_id == s
+        verdict[s] = members.any() and cand[members].all()
+    return verdict, cand
+
+
+def test_example_fleet_verdicts():
+    inputs, expected = make_example_fleet(num_chips=64, num_slices=8, idle_fraction=0.25)
+    verdicts, cand = evaluate_fleet(*inputs, num_slices=8)
+    np.testing.assert_array_equal(np.asarray(verdicts), expected)
+    assert int(np.asarray(cand).sum()) == 16  # 2 idle slices * 8 chips
+
+
+def test_matches_numpy_oracle_random():
+    rng = np.random.default_rng(42)
+    C, T, S = 96, 12, 7
+    tc = (rng.uniform(size=(C, T)) < 0.5).astype(np.float32) * rng.uniform(size=(C, T))
+    hbm = rng.uniform(0, 0.2, size=(C, T)).astype(np.float32)
+    valid = rng.uniform(size=(C, T)) < 0.9
+    age = rng.uniform(0, 4000, size=C).astype(np.float32)
+    slice_id = rng.integers(0, S, size=C).astype(np.int32)
+    params = PolicyParams(lookback_s=2100, hbm_threshold=0.05)
+
+    verdicts, cand = evaluate_fleet(
+        jnp.asarray(tc), jnp.asarray(hbm), jnp.asarray(valid), jnp.asarray(age),
+        jnp.asarray(slice_id), params_array(params), num_slices=S)
+    exp_v, exp_c = numpy_oracle(tc, hbm, valid, age, slice_id, 2100, 0.05, S)
+    np.testing.assert_array_equal(np.asarray(verdicts), exp_v)
+    np.testing.assert_array_equal(np.asarray(cand), exp_c)
+
+
+def test_one_busy_chip_vetoes_slice():
+    inputs, expected = make_example_fleet(num_chips=32, num_slices=4, idle_fraction=1.0)
+    tc = np.asarray(inputs[0]).copy()
+    tc[5, 3] = 0.7  # one sample of activity on one chip of slice 0
+    verdicts, _ = evaluate_fleet(jnp.asarray(tc), *inputs[1:], num_slices=4)
+    assert not bool(verdicts[0])
+    assert all(bool(v) for v in np.asarray(verdicts)[1:])
+
+
+def test_hbm_corroboration_rescues_slice():
+    """Zero tensorcore peak but streaming HBM → not idle (infeed-bound)."""
+    inputs, _ = make_example_fleet(num_chips=16, num_slices=2, idle_fraction=1.0)
+    hbm = np.asarray(inputs[1]).copy()
+    hbm[0:8, :] = 0.3  # slice 0 streams from HBM
+    params = params_array(PolicyParams(hbm_threshold=0.05))
+    verdicts, _ = evaluate_fleet(inputs[0], jnp.asarray(hbm), *inputs[2:5], params,
+                                 num_slices=2)
+    assert not bool(verdicts[0])
+    assert bool(verdicts[1])
+    # threshold disabled (0) → HBM ignored, both slices idle (Jinja-falsy parity)
+    verdicts2, _ = evaluate_fleet(inputs[0], jnp.asarray(hbm), *inputs[2:5],
+                                  params_array(PolicyParams(hbm_threshold=0.0)),
+                                  num_slices=2)
+    assert bool(verdicts2[0]) and bool(verdicts2[1])
+
+
+def test_age_gate_blocks_young_pods():
+    inputs, _ = make_example_fleet(num_chips=16, num_slices=2, idle_fraction=1.0)
+    age = np.asarray(inputs[3]).copy()
+    age[0] = 60.0  # one freshly restarted worker in slice 0
+    verdicts, _ = evaluate_fleet(*inputs[:3], jnp.asarray(age), *inputs[4:],
+                                 num_slices=2)
+    assert not bool(verdicts[0])
+    assert bool(verdicts[1])
+
+
+def test_no_data_chip_is_never_candidate():
+    inputs, _ = make_example_fleet(num_chips=16, num_slices=2, idle_fraction=1.0)
+    valid = np.asarray(inputs[2]).copy()
+    valid[3, :] = False  # chip 3 has no samples at all
+    _, cand = evaluate_fleet(*inputs[:2], jnp.asarray(valid), *inputs[3:],
+                             num_slices=2)
+    assert not bool(cand[3])
+
+
+def test_empty_slice_id_space_not_idle():
+    """Slices with zero chips must not report idle (chips > 0 guard)."""
+    inputs, _ = make_example_fleet(num_chips=16, num_slices=2, idle_fraction=1.0)
+    # declare 4 slices but only ids 0,1 are populated
+    verdicts, _ = evaluate_fleet(*inputs[:5], inputs[5], num_slices=4)
+    assert bool(verdicts[0]) and bool(verdicts[1])
+    assert not bool(verdicts[2]) and not bool(verdicts[3])
+
+
+# ── sharded evaluation on the 8-device CPU mesh ───────────────────────────
+
+
+def test_sharded_matches_single_device():
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 virtual devices, got {len(devices)}"
+    mesh = Mesh(np.array(devices), axis_names=("fleet",))
+
+    C, S = 128, 16  # 16 chips/slice → slices span the 8-way shard boundary
+    inputs, expected = make_example_fleet(num_chips=C, num_slices=S, idle_fraction=0.5)
+
+    sharded_eval = make_sharded_evaluator(mesh, num_slices=S)
+    shard = NamedSharding(mesh, P("fleet"))
+    placed = [jax.device_put(x, shard) for x in inputs[:5]]
+    params = jax.device_put(inputs[5], NamedSharding(mesh, P()))
+
+    verdicts, cand = sharded_eval(*placed, params)
+    ref_verdicts, ref_cand = evaluate_fleet(*inputs, num_slices=S)
+    np.testing.assert_array_equal(np.asarray(verdicts), np.asarray(ref_verdicts))
+    np.testing.assert_array_equal(np.asarray(cand), np.asarray(ref_cand))
+    np.testing.assert_array_equal(np.asarray(verdicts), expected)
+
+
+def test_sharded_cross_shard_veto():
+    """A busy chip on device 7 vetoes a slice whose chips live on all devices."""
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), axis_names=("fleet",))
+    C, S = 64, 1  # one giant slice spanning every shard
+    inputs, _ = make_example_fleet(num_chips=C, num_slices=S, idle_fraction=1.0)
+    tc = np.asarray(inputs[0]).copy()
+    tc[C - 1, 0] = 0.9  # last chip (device 7's shard) is busy
+
+    sharded_eval = make_sharded_evaluator(mesh, num_slices=S)
+    shard = NamedSharding(mesh, P("fleet"))
+    placed = [jax.device_put(x, shard) for x in
+              (jnp.asarray(tc), *inputs[1:5])]
+    verdicts, _ = sharded_eval(*placed, inputs[5])
+    assert not bool(verdicts[0])

@@ -1,0 +1,33 @@
+"""Fleet-scale idleness policy engine (the TPU compute path).
+
+The daemon's per-cycle PromQL evaluates idleness series-by-series inside
+Prometheus. At large fleet sizes (100k+ chips across many clusters) that
+evaluation — peak-over-window, corroboration, age gating, and per-slice
+all-idle reduction — is itself a dense, embarrassingly batched computation.
+This package implements it as a JAX program: one fused evaluation over
+``[chips, samples]`` metric tensors, shardable across a device mesh with a
+``psum`` collective aggregating slice verdicts that span hosts — the same
+reduction the multi-host JobSet gate performs, at fleet scale.
+
+Semantics mirror the query layer exactly (native/src/query.cpp):
+peak == 0 over the window, HBM-bandwidth ``unless`` corroboration, and the
+lookback+grace age gate (reference: query.promql.j2 + main.rs:494-510).
+"""
+
+from tpu_pruner.policy.engine import (
+    PolicyParams,
+    evaluate_chips,
+    evaluate_fleet,
+    make_example_fleet,
+    make_sharded_evaluator,
+    slice_verdicts,
+)
+
+__all__ = [
+    "PolicyParams",
+    "evaluate_chips",
+    "evaluate_fleet",
+    "make_example_fleet",
+    "make_sharded_evaluator",
+    "slice_verdicts",
+]

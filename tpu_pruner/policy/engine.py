@@ -1,0 +1,171 @@
+"""Batched idle-verdict evaluation over fleet metric tensors.
+
+Data model (structure-of-arrays, one row per TPU chip):
+
+- ``tc_util``  f32[C, T]: tensorcore utilization samples (0-1) over the
+  lookback window (analog of ``tensorcore_utilization`` /
+  ``tensorcore_duty_cycle/100`` in the query layer);
+- ``hbm_util`` f32[C, T]: HBM memory-bandwidth utilization samples (0-1);
+- ``valid``   bool[C, T]: sample validity (scrape gaps, chip attach time);
+- ``pod_age_s`` f32[C]: age of the owning pod;
+- ``slice_id`` i32[C]: workload/slice membership (0..S-1) — all chips of a
+  multi-host slice share an id, exactly like pods sharing a JobSet.
+
+The evaluation is TPU-friendly by construction: fixed shapes, elementwise
+reductions over the sample axis (fused by XLA into a single pass over HBM),
+no data-dependent control flow, and a segment-sum slice reduction that maps
+onto one scatter-add. The sharded variant splits the chip axis across a
+``Mesh`` and aggregates per-slice busy counts with ``psum`` — verdicts for
+slices whose chips live on different devices come out identical everywhere.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class PolicyParams:
+    """Mirror of the daemon's eligibility semantics.
+
+    lookback_s: duration*60 + grace_period (main.rs:413-414 analog).
+    hbm_threshold: the `unless` corroboration threshold; <= 0 disables it
+      (query.promql.j2:36 Jinja-falsy parity).
+    """
+
+    lookback_s: float = 30 * 60 + 300
+    hbm_threshold: float = 0.0
+
+    def hbm_cutoff(self) -> float:
+        # Disabled threshold → +inf so no chip is ever "rescued" by HBM.
+        return self.hbm_threshold if self.hbm_threshold > 0 else float("inf")
+
+
+def evaluate_chips(tc_util, hbm_util, valid, pod_age_s, lookback_s, hbm_cutoff):
+    """Per-chip idle-candidate mask (bool[C]).
+
+    A chip is a candidate iff it has at least one valid sample (absent
+    series are never candidates — PromQL parity), its peak utilization over
+    the window is zero, its peak HBM bandwidth stays below the cutoff, and
+    its pod cleared the age gate.
+    """
+    neg = jnp.float32(-1.0)
+    peak_tc = jnp.max(jnp.where(valid, tc_util, neg), axis=-1)
+    peak_hbm = jnp.max(jnp.where(valid, hbm_util, neg), axis=-1)
+    has_data = jnp.any(valid, axis=-1)
+    idle = (peak_tc <= 0.0) & has_data            # `== 0` idle predicate
+    hbm_active = peak_hbm >= hbm_cutoff           # `unless` corroboration
+    eligible = pod_age_s >= lookback_s            # age gate
+    return idle & ~hbm_active & eligible
+
+
+def slice_verdicts(candidate, slice_id, num_slices):
+    """Reduce chip candidacy to per-slice all-idle verdicts (bool[S]).
+
+    The multi-host gate: one busy chip anywhere in the slice vetoes it
+    (walker.cpp jobset_fully_idle analog, at fleet scale).
+    """
+    busy = jax.ops.segment_sum(
+        (~candidate).astype(jnp.int32), slice_id, num_segments=num_slices
+    )
+    chips = jax.ops.segment_sum(
+        jnp.ones_like(slice_id, dtype=jnp.int32), slice_id, num_segments=num_slices
+    )
+    return (busy == 0) & (chips > 0)
+
+
+@partial(jax.jit, static_argnames=("num_slices",))
+def evaluate_fleet(tc_util, hbm_util, valid, pod_age_s, slice_id, params_arr, num_slices):
+    """Single-device fused evaluation.
+
+    params_arr: f32[2] = [lookback_s, hbm_cutoff] (kept as an array so
+    parameter changes don't trigger recompilation).
+    Returns (slice_idle bool[S], chip_candidate bool[C]).
+    """
+    candidate = evaluate_chips(
+        tc_util, hbm_util, valid, pod_age_s, params_arr[0], params_arr[1]
+    )
+    return slice_verdicts(candidate, slice_id, num_slices), candidate
+
+
+def params_array(params: PolicyParams) -> jax.Array:
+    return jnp.array([params.lookback_s, params.hbm_cutoff()], dtype=jnp.float32)
+
+
+def make_sharded_evaluator(mesh: Mesh, num_slices: int, axis: str = "fleet"):
+    """Build the mesh-sharded evaluator.
+
+    The chip axis is split across `axis`; slice membership freely spans
+    shards. Each device computes local per-slice busy/chip counts, then a
+    `psum` over the mesh produces the global counts — the cross-host
+    reduction a real multi-host slice verdict requires. Slice verdicts are
+    replicated; chip candidacy stays sharded.
+    """
+
+    def local_eval(tc_util, hbm_util, valid, pod_age_s, slice_id, params_arr):
+        candidate = evaluate_chips(
+            tc_util, hbm_util, valid, pod_age_s, params_arr[0], params_arr[1]
+        )
+        busy_local = jax.ops.segment_sum(
+            (~candidate).astype(jnp.int32), slice_id, num_segments=num_slices
+        )
+        chips_local = jax.ops.segment_sum(
+            jnp.ones_like(slice_id, dtype=jnp.int32), slice_id, num_segments=num_slices
+        )
+        busy = jax.lax.psum(busy_local, axis)
+        chips = jax.lax.psum(chips_local, axis)
+        return (busy == 0) & (chips > 0), candidate
+
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P(axis)),
+    )
+    return jax.jit(sharded)
+
+
+def make_example_fleet(
+    num_chips: int = 256,
+    num_samples: int = 16,
+    num_slices: int = 16,
+    idle_fraction: float = 0.5,
+    seed: int = 0,
+    dtype=jnp.float32,
+):
+    """Synthetic fleet: contiguous equal slices, a fraction fully idle.
+
+    Returns (inputs tuple for evaluate_fleet minus num_slices, expected
+    per-slice verdicts as a numpy array).
+    """
+    rng = np.random.default_rng(seed)
+    chips_per_slice = num_chips // num_slices
+    assert chips_per_slice * num_slices == num_chips, "chips must divide slices"
+
+    slice_id = np.repeat(np.arange(num_slices, dtype=np.int32), chips_per_slice)
+    idle_slices = np.zeros(num_slices, dtype=bool)
+    idle_slices[: int(num_slices * idle_fraction)] = True
+
+    chip_idle = idle_slices[slice_id]
+    tc = rng.uniform(0.2, 1.0, size=(num_chips, num_samples)).astype(np.float32)
+    tc[chip_idle] = 0.0
+    hbm = rng.uniform(0.1, 0.9, size=(num_chips, num_samples)).astype(np.float32)
+    hbm[chip_idle] = 0.0
+    valid = np.ones((num_chips, num_samples), dtype=bool)
+    age = np.full((num_chips,), 7200.0, dtype=np.float32)
+
+    inputs = (
+        jnp.asarray(tc, dtype=dtype),
+        jnp.asarray(hbm, dtype=dtype),
+        jnp.asarray(valid),
+        jnp.asarray(age),
+        jnp.asarray(slice_id),
+        params_array(PolicyParams()),
+    )
+    return inputs, idle_slices

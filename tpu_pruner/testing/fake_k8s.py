@@ -35,6 +35,39 @@ def merge_patch(target, patch):
     return out
 
 
+def parse_label_selector(selector: str) -> list[tuple[str, set]]:
+    """Parse `k=v` and set-based `k in (v1,v2)` requirements.
+
+    Top-level commas separate requirements; commas inside parentheses
+    belong to the value set.
+    """
+    reqs: list[tuple[str, set]] = []
+    clauses, depth, cur = [], 0, ""
+    for ch in selector:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            clauses.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        clauses.append(cur)
+    for clause in map(str.strip, clauses):
+        if not clause:
+            continue
+        if " in " in clause:
+            key, _, vals = clause.partition(" in ")
+            vals = vals.strip().lstrip("(").rstrip(")")
+            reqs.append((key.strip(), {v.strip() for v in vals.split(",")}))
+        elif "=" in clause:
+            k, v = clause.split("=", 1)
+            reqs.append((k.strip(), {v.strip()}))
+    return reqs
+
+
 def rfc3339(dt: datetime) -> str:
     return dt.astimezone(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
@@ -215,6 +248,9 @@ class FakeK8s:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # real API servers (Go net/http) set TCP_NODELAY; without it the
+            # keep-alive body write stalls behind the client's delayed ACK
+            disable_nagle_algorithm = True
 
             def log_message(self, *args):
                 pass
@@ -240,18 +276,14 @@ class FakeK8s:
                     # pod LIST with labelSelector
                     if path.endswith("/pods") and "/namespaces/" in path:
                         selector = parse_qs(parsed.query).get("labelSelector", [""])[0]
-                        wanted = {}
-                        for clause in filter(None, selector.split(",")):
-                            if "=" in clause:
-                                k, v = clause.split("=", 1)
-                                wanted[k] = v
+                        reqs = parse_label_selector(selector)
                         prefix = path + "/"
                         items = [
                             obj for p, obj in fake.objects.items()
                             if p.startswith(prefix)
                             and all(
-                                obj["metadata"].get("labels", {}).get(k) == v
-                                for k, v in wanted.items()
+                                obj["metadata"].get("labels", {}).get(k) in vals
+                                for k, vals in reqs
                             )
                         ]
                         self._respond(200, {"kind": "PodList", "apiVersion": "v1",
@@ -290,6 +322,8 @@ class FakeK8s:
                         return
                 self._not_found()
 
+        # default backlog of 5 drops SYNs under the concurrent resolve fan-out
+        ThreadingHTTPServer.request_queue_size = 128
         self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()

@@ -21,6 +21,9 @@ class FakePrometheus:
         self.auth_headers: list[str | None] = []
         self.fail_requests_remaining = 0
         self.fail_status = 500
+        self._cached = None
+        self._cached_version = -1
+        self._version = 0
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -50,12 +53,18 @@ class FakePrometheus:
             }
             labels.update(extra_labels or {})
             self.series.append({"metric": labels, "value": [time.time(), str(value)]})
+        self._version += 1
 
     # ── lifecycle ──
     def start(self) -> int:
         fake = self
 
         class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            # real API servers (Go net/http) set TCP_NODELAY; without it the
+            # keep-alive body write stalls behind the client's delayed ACK
+            disable_nagle_algorithm = True  # keep-alive
+
             def log_message(self, *args):  # silence
                 pass
 
@@ -78,14 +87,19 @@ class FakePrometheus:
                             {"status": "error", "errorType": "internal", "error": "injected"},
                         )
                         return
-                    result = list(fake.series)
-                self._respond(
-                    200,
-                    {
-                        "status": "success",
-                        "data": {"resultType": "vector", "result": result},
-                    },
-                )
+                    # serialize once per series-list version (large fleets)
+                    if fake._cached_version != fake._version or fake._cached is None:
+                        fake._cached = json.dumps({
+                            "status": "success",
+                            "data": {"resultType": "vector", "result": fake.series},
+                        }).encode()
+                        fake._cached_version = fake._version
+                    body = fake._cached
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def do_POST(self):
                 parsed = urlparse(self.path)
@@ -105,6 +119,8 @@ class FakePrometheus:
                 query = parse_qs(parsed.query).get("query", [""])[0]
                 self._handle_query(query)
 
+        # default backlog of 5 drops SYNs under concurrent load
+        ThreadingHTTPServer.request_queue_size = 128
         self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
