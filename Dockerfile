@@ -1,0 +1,20 @@
+# Two-stage build (reference analog: Dockerfile.rhel / Dockerfile.fedora —
+# UBI9 two-stage cargo build; here a debian toolchain building the CMake
+# tree into a slim runtime image).
+FROM debian:12 AS build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ cmake ninja-build && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY CMakeLists.txt ./
+COPY native ./native
+RUN cmake -G Ninja -S . -B build -DCMAKE_BUILD_TYPE=Release \
+    && cmake --build build --target tpu-pruner tpupruner_tests \
+    && ./build/tpupruner_tests
+
+FROM debian:12-slim
+# libssl3 for the dlopen'd TLS shim; ca-certificates for verify mode
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    libssl3 ca-certificates && rm -rf /var/lib/apt/lists/*
+COPY --from=build /src/build/tpu-pruner /usr/local/bin/tpu-pruner
+USER 65534:65534
+ENTRYPOINT ["/usr/local/bin/tpu-pruner"]

@@ -1,0 +1,39 @@
+# Task runner (reference analog: the gpu-pruner justfile).
+
+build:
+    cmake -G Ninja -S . -B build && cmake --build build
+
+test: build
+    ./build/tpupruner_tests
+    python -m pytest tests/ -q
+
+# unit tiers only (fast)
+test-unit: build
+    ./build/tpupruner_tests
+    python -m pytest tests/test_domain.py tests/test_query_template.py -q
+
+# hermetic end-to-end tier (fake Prometheus + fake K8s API)
+test-e2e: build
+    python -m pytest tests/test_pipeline_e2e.py tests/test_querytest_auth.py -q
+
+# sanitizer builds (the race/memory tier the reference lacks, SURVEY.md §5)
+test-asan:
+    cmake -G Ninja -S . -B build-asan -DTP_SANITIZE=ON && cmake --build build-asan
+    ./build-asan/tpupruner_tests
+
+test-tsan:
+    cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
+    ./build-tsan/tpupruner_tests
+
+bench: build
+    python bench.py
+
+# dry-run against a live cluster (current kubeconfig + GMP frontend)
+run prometheus_url="http://frontend.gmp-system.svc:9090":
+    ./build/tpu-pruner --prometheus-url {{prometheus_url}} --run-mode dry-run -d
+
+querytest query url:
+    ./build/tpu-pruner querytest '{{query}}' {{url}}
+
+docker-build:
+    docker build -t tpu-pruner:latest .

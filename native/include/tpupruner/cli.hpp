@@ -31,7 +31,7 @@ struct Cli {
   // ── reference flags (main.rs:46-119) ──
   int64_t duration = 30;                  // -t, minutes of no activity
   bool daemon_mode = false;               // -d
-  std::string enabled_resources = "drsinj";  // -e (reference default "drsin" + JobSet)
+  std::string enabled_resources = "drsinjl";  // -e (ref default "drsin" + JobSet/LWS)
   int64_t check_interval = 180;           // -c, seconds (daemon mode)
   std::string ns_regex;                   // -n, namespace pattern
   int64_t grace_period = 300;             // -g, seconds

@@ -7,8 +7,9 @@
 // (reference tests: lib.rs:578-998).
 //
 // TPU-first deltas vs the reference:
-// - a sixth scalable kind, JobSet (jobset.x-k8s.io), the owner of multi-host
-//   TPU slice pods on GKE; flag char 'j'.
+// - two extra scalable kinds for GKE multi-host TPU topologies: JobSet
+//   (jobset.x-k8s.io, flag 'j') for training slices and LeaderWorkerSet
+//   (leaderworkerset.x-k8s.io, flag 'l') for multi-host serving groups.
 // - involvedObject apiVersions are the full group/version strings (the
 //   reference emits bare "v1"/"v1beta1" for the CR kinds, lib.rs:313-314).
 // - event text is device-aware ("was not using TPU" / "... GPU").
@@ -33,16 +34,17 @@ enum class Kind : uint8_t {
   InferenceService,
   Notebook,
   JobSet,
+  LeaderWorkerSet,
 };
 
-constexpr int kNumKinds = 6;
+constexpr int kNumKinds = 7;
 
 // Bitflag set over Kind (reference: bitflags ResourceKind, lib.rs:96-105).
 using ResourceSet = uint8_t;
 constexpr ResourceSet flag(Kind k) { return static_cast<ResourceSet>(1u << static_cast<int>(k)); }
 constexpr ResourceSet kAllResources = (1u << kNumKinds) - 1;
 
-// Parse "drsinj" flag chars; unknown characters are silently ignored
+// Parse "drsinjl" flag chars; unknown characters are silently ignored
 // (reference: get_enabled_resources, lib.rs:116-129).
 ResourceSet parse_enabled_resources(std::string_view flags);
 

@@ -65,19 +65,21 @@ inline std::string pod_key(const std::string& ns, const std::string& name) {
   return ns + "/" + name;
 }
 
-// True when every pod of `jobset` that requests google.com/tpu resources is
-// present in `idle`. Lists the JobSet's pods via the
-// jobset.sigs.k8s.io/jobset-name label.
-bool jobset_fully_idle(const k8s::Client& client, const core::ScaleTarget& jobset,
-                       const IdlePodSet& idle);
+// True when every pod of the group that requests google.com/tpu resources
+// is present in `idle`. Applies to the two multi-host group kinds: JobSet
+// (pods labelled jobset.sigs.k8s.io/jobset-name) and LeaderWorkerSet
+// (pods labelled leaderworkerset.sigs.k8s.io/name).
+bool group_fully_idle(const k8s::Client& client, const core::ScaleTarget& group,
+                      const IdlePodSet& idle);
 
-// Batch form: ONE set-based-selector LIST per namespace
-// (`jobset-name in (a,b,...)`) instead of one LIST per JobSet — at reclaim
-// scale the per-slice LISTs dominate the gate. Returns keep flags aligned
-// with `jobsets`; entries the LIST failed for are kept=false (safe side).
-std::vector<char> jobsets_fully_idle(const k8s::Client& client,
-                                     const std::vector<const core::ScaleTarget*>& jobsets,
-                                     const IdlePodSet& idle);
+// Batch form: ONE set-based-selector LIST per (namespace, group kind)
+// instead of one LIST per group — at reclaim scale the per-slice LISTs
+// dominate the gate. Returns keep flags aligned with `groups`; entries the
+// LIST failed for are kept=false (safe side). Non-group kinds in `groups`
+// are rejected with keep=false.
+std::vector<char> groups_fully_idle(const k8s::Client& client,
+                                    const std::vector<const core::ScaleTarget*>& groups,
+                                    const IdlePodSet& idle);
 
 // True when any container of the pod requests google.com/tpu (requests or
 // limits) — the resource-model filter for slice membership.

@@ -37,7 +37,10 @@ void scale_to_zero(const k8s::Client& client, const ScaleTarget& target,
   switch (target.kind) {
     case Kind::Deployment:
     case Kind::ReplicaSet:
-    case Kind::StatefulSet: {
+    case Kind::StatefulSet:
+    // LeaderWorkerSet serves the /scale subresource over its replica-group
+    // count; zero groups releases every host of every group.
+    case Kind::LeaderWorkerSet: {
       Value patch = Value::parse(R"({"spec":{"replicas":0}})");
       client.patch_merge(k8s::Client::scale_path(target.kind, ns, name), patch);
       break;

@@ -60,8 +60,8 @@ USAGE:
 FLAGS:
   -t, --duration <MIN>          minutes of no activity required to prune [default: 30]
   -d, --daemon-mode             run indefinitely on --check-interval
-  -e, --enabled-resources <S>   kinds that may be scaled, as flag chars [default: drsinj]
-                                  d=Deployment r=ReplicaSet s=StatefulSet
+  -e, --enabled-resources <S>   kinds that may be scaled, as flag chars [default: drsinjl]
+                                  d=Deployment r=ReplicaSet s=StatefulSet l=LeaderWorkerSet
                                   i=InferenceService n=Notebook j=JobSet
   -c, --check-interval <SEC>    daemon-mode cycle interval [default: 180]
   -n, --namespace <REGEX>       namespace filter pushed into the query

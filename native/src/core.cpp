@@ -16,6 +16,7 @@ ResourceSet parse_enabled_resources(std::string_view flags) {
       case 'i': set |= flag(Kind::InferenceService); break;
       case 'n': set |= flag(Kind::Notebook); break;
       case 'j': set |= flag(Kind::JobSet); break;
+      case 'l': set |= flag(Kind::LeaderWorkerSet); break;
       default: break;  // unknown characters are silently ignored (lib.rs:125)
     }
   }
@@ -30,6 +31,7 @@ std::string_view kind_name(Kind k) {
     case Kind::InferenceService: return "InferenceService";
     case Kind::Notebook: return "Notebook";
     case Kind::JobSet: return "JobSet";
+    case Kind::LeaderWorkerSet: return "LeaderWorkerSet";
   }
   return "";
 }
@@ -50,6 +52,7 @@ std::string_view api_version(Kind k) {
     case Kind::InferenceService: return "serving.kserve.io/v1beta1";
     case Kind::Notebook: return "kubeflow.org/v1";
     case Kind::JobSet: return "jobset.x-k8s.io/v1alpha2";
+    case Kind::LeaderWorkerSet: return "leaderworkerset.x-k8s.io/v1";
   }
   return "";
 }
@@ -62,6 +65,7 @@ std::string_view api_group(Kind k) {
     case Kind::InferenceService: return "serving.kserve.io";
     case Kind::Notebook: return "kubeflow.org";
     case Kind::JobSet: return "jobset.x-k8s.io";
+    case Kind::LeaderWorkerSet: return "leaderworkerset.x-k8s.io";
   }
   return "";
 }
@@ -74,6 +78,7 @@ std::string_view plural(Kind k) {
     case Kind::InferenceService: return "inferenceservices";
     case Kind::Notebook: return "notebooks";
     case Kind::JobSet: return "jobsets";
+    case Kind::LeaderWorkerSet: return "leaderworkersets";
   }
   return "";
 }

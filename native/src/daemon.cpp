@@ -171,23 +171,25 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   ResolveOutcome resolved = resolve_pods(args, kube, decoded.samples);
   std::vector<ScaleTarget> unique = core::dedup_targets(std::move(resolved.targets));
 
-  // Multi-host slice gate: a JobSet is only a candidate when every
-  // google.com/tpu pod of the slice is idle (SURVEY.md §7 hard-part #1 —
-  // a partial-slice suspend would kill live hosts mid-collective).
-  // One set-based-selector LIST per namespace covers every JobSet in it.
+  // Multi-host group gate: a JobSet/LeaderWorkerSet is only a candidate
+  // when every google.com/tpu pod of the group is idle (SURVEY.md §7
+  // hard-part #1 — a partial-slice suspend kills live hosts
+  // mid-collective). One set-based-selector LIST per namespace+kind.
   std::vector<char> keep(unique.size(), 1);
   {
-    std::vector<const ScaleTarget*> jobsets;
-    std::vector<size_t> jobset_indices;
+    std::vector<const ScaleTarget*> group_targets;
+    std::vector<size_t> group_indices;
     for (size_t i = 0; i < unique.size(); ++i) {
-      if (unique[i].kind == core::Kind::JobSet) {
-        jobsets.push_back(&unique[i]);
-        jobset_indices.push_back(i);
+      if (unique[i].kind == core::Kind::JobSet ||
+          unique[i].kind == core::Kind::LeaderWorkerSet) {
+        group_targets.push_back(&unique[i]);
+        group_indices.push_back(i);
       }
     }
-    if (!jobsets.empty()) {
-      std::vector<char> verdicts = walker::jobsets_fully_idle(kube, jobsets, resolved.idle_pods);
-      for (size_t j = 0; j < jobset_indices.size(); ++j) keep[jobset_indices[j]] = verdicts[j];
+    if (!group_targets.empty()) {
+      std::vector<char> verdicts =
+          walker::groups_fully_idle(kube, group_targets, resolved.idle_pods);
+      for (size_t j = 0; j < group_indices.size(); ++j) keep[group_indices[j]] = verdicts[j];
     }
   }
   std::vector<ScaleTarget> survivors;

@@ -132,6 +132,14 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
       Value is = client.get(k8s::Client::object_path(Kind::InferenceService, ns, ks->as_string()));
       return ScaleTarget{Kind::InferenceService, std::move(is)};
     }
+    // LWS shortcut: EVERY pod of a LeaderWorkerSet (leader and worker)
+    // carries this label, while the ownerRef chain differs by role (the
+    // controller owns worker StatefulSets via the leader Pod, not via the
+    // LWS object) — the label is the only uniform path to the root.
+    const Value* lws = labels->find("leaderworkerset.sigs.k8s.io/name");
+    if (lws && lws->is_string()) {
+      return fetch_must(client, cache, Kind::LeaderWorkerSet, ns, lws->as_string());
+    }
   }
 
   const Value* ors = pod.at_path("metadata.ownerReferences");
@@ -152,7 +160,13 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
           if (const Value* nb_or = owner_of_kind(ss->object, "Notebook")) {
             return fetch_must(client, cache, Kind::Notebook, ns, nb_or->get_string("name"));
           }
-          return std::move(*ss);  // StatefulSet with no Notebook owner
+          // Multi-host serving groups: LWS creates one StatefulSet per
+          // replica group; the LeaderWorkerSet is the scalable root.
+          if (const Value* lws_or = owner_of_kind(ss->object, "LeaderWorkerSet")) {
+            return fetch_must(client, cache, Kind::LeaderWorkerSet, ns,
+                              lws_or->get_string("name"));
+          }
+          return std::move(*ss);  // StatefulSet with no CR owner
         }
       } else if (kind == "Job") {
         // Multi-host TPU slice chain: Pod → Job → JobSet. Bare Jobs (no
@@ -207,13 +221,13 @@ bool verdict_from_pods(const std::string& ns, const std::string& name,
     const Value* pn = pod->at_path("metadata.name");
     if (!pn || !pn->is_string()) return false;
     if (!idle.count(pod_key(ns, pn->as_string()))) {
-      log::info("jobset " + ns + "/" + name + " not fully idle: pod " + pn->as_string() +
+      log::info("group " + ns + "/" + name + " not fully idle: pod " + pn->as_string() +
                 " is active — skipping suspend");
       return false;
     }
   }
   if (tpu_pods == 0) {
-    log::info("jobset " + ns + "/" + name + " has no google.com/tpu pods — skipping");
+    log::info("group " + ns + "/" + name + " has no google.com/tpu pods — skipping");
     return false;
   }
   return true;
@@ -221,44 +235,62 @@ bool verdict_from_pods(const std::string& ns, const std::string& name,
 
 }  // namespace
 
-std::vector<char> jobsets_fully_idle(const k8s::Client& client,
-                                     const std::vector<const core::ScaleTarget*>& jobsets,
-                                     const IdlePodSet& idle) {
-  std::vector<char> keep(jobsets.size(), 0);
-  // group target indices by namespace
-  std::unordered_map<std::string, std::vector<size_t>> by_ns;
-  for (size_t i = 0; i < jobsets.size(); ++i) {
-    by_ns[jobsets[i]->ns().value_or("")].push_back(i);
+namespace {
+const char* group_label_key(Kind k) {
+  switch (k) {
+    case Kind::JobSet: return "jobset.sigs.k8s.io/jobset-name";
+    case Kind::LeaderWorkerSet: return "leaderworkerset.sigs.k8s.io/name";
+    default: return nullptr;
   }
-  for (auto& [ns, indices] : by_ns) {
-    std::string selector = "jobset.sigs.k8s.io/jobset-name in (";
+}
+}  // namespace
+
+std::vector<char> groups_fully_idle(const k8s::Client& client,
+                                    const std::vector<const core::ScaleTarget*>& groups,
+                                    const IdlePodSet& idle) {
+  std::vector<char> keep(groups.size(), 0);
+  // bucket target indices by (namespace, label key)
+  std::unordered_map<std::string, std::vector<size_t>> buckets;
+  for (size_t i = 0; i < groups.size(); ++i) {
+    const char* label = group_label_key(groups[i]->kind);
+    if (!label) {
+      log::warn("groups_fully_idle: " + std::string(core::kind_name(groups[i]->kind)) +
+                " is not a multi-host group kind");
+      continue;
+    }
+    buckets[groups[i]->ns().value_or("") + "\x1f" + label].push_back(i);
+  }
+  for (auto& [bucket_key, indices] : buckets) {
+    std::string ns = bucket_key.substr(0, bucket_key.find('\x1f'));
+    std::string label = bucket_key.substr(bucket_key.find('\x1f') + 1);
+    std::string selector = label + " in (";
     for (size_t j = 0; j < indices.size(); ++j) {
       if (j) selector += ",";
-      selector += jobsets[indices[j]]->name();
+      selector += groups[indices[j]]->name();
     }
     selector += ")";
     Value pods;
     try {
       pods = client.list(k8s::Client::pods_path(ns), selector);
     } catch (const std::exception& e) {
-      log::warn("jobset idleness LIST failed in namespace " + ns + ": " + e.what());
-      continue;  // all targets in this ns stay kept=false (safe side)
+      log::warn("group idleness LIST failed in namespace " + ns + ": " + e.what());
+      continue;  // all targets in this bucket stay kept=false (safe side)
     }
     const Value* items = pods.find("items");
     if (!items || !items->is_array()) continue;
-    // partition listed pods by jobset label
-    std::unordered_map<std::string, std::vector<const Value*>> pods_by_jobset;
+    // partition listed pods by group label
+    std::unordered_map<std::string, std::vector<const Value*>> pods_by_group;
     for (const Value& pod : items->as_array()) {
       const Value* labels = pod.at_path("metadata.labels");
       if (!labels) continue;
-      const Value* js = labels->find("jobset.sigs.k8s.io/jobset-name");
-      if (js && js->is_string()) pods_by_jobset[js->as_string()].push_back(&pod);
+      const Value* g = labels->find(label);
+      if (g && g->is_string()) pods_by_group[g->as_string()].push_back(&pod);
     }
     for (size_t idx : indices) {
-      const std::string name = jobsets[idx]->name();
-      auto it = pods_by_jobset.find(name);
-      if (it == pods_by_jobset.end()) {
-        log::info("jobset " + ns + "/" + name + " has no pods — skipping");
+      const std::string name = groups[idx]->name();
+      auto it = pods_by_group.find(name);
+      if (it == pods_by_group.end()) {
+        log::info("group " + ns + "/" + name + " has no pods — skipping");
         continue;
       }
       keep[idx] = verdict_from_pods(ns, name, it->second, idle) ? 1 : 0;
@@ -267,9 +299,9 @@ std::vector<char> jobsets_fully_idle(const k8s::Client& client,
   return keep;
 }
 
-bool jobset_fully_idle(const k8s::Client& client, const ScaleTarget& jobset,
-                       const IdlePodSet& idle) {
-  return jobsets_fully_idle(client, {&jobset}, idle)[0] != 0;
+bool group_fully_idle(const k8s::Client& client, const ScaleTarget& group,
+                      const IdlePodSet& idle) {
+  return groups_fully_idle(client, {&group}, idle)[0] != 0;
 }
 
 }  // namespace tpupruner::walker

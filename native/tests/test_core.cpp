@@ -23,8 +23,10 @@ core::ScaleTarget make_target(Kind k, const char* name, const char* ns, const ch
 }  // namespace
 
 TP_TEST(enabled_resources_parsing) {
-  auto all = core::parse_enabled_resources("drsinj");
+  auto all = core::parse_enabled_resources("drsinjl");
   TP_CHECK_EQ(all, core::kAllResources);
+  TP_CHECK(core::parse_enabled_resources("drsinj") != core::kAllResources);
+  TP_CHECK_EQ(core::parse_enabled_resources("l"), core::flag(Kind::LeaderWorkerSet));
   auto just_n = core::parse_enabled_resources("n");
   TP_CHECK(just_n & core::flag(Kind::Notebook));
   TP_CHECK(!(just_n & core::flag(Kind::Deployment)));

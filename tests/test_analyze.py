@@ -1,0 +1,80 @@
+"""Offline fleet-audit CLI (tpu_pruner.analyze) tests."""
+
+import json
+import subprocess
+import sys
+
+from tpu_pruner.native import REPO_ROOT
+
+
+def run_analyze(tmp_path, doc, *args):
+    dump = tmp_path / "dump.json"
+    dump.write_text(json.dumps(doc))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_pruner.analyze", str(dump), *args],
+        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "HOME": str(tmp_path)},
+    )
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout.strip()), proc.stderr
+
+
+def chip(slice_name, tc, hbm=None, age=7200):
+    c = {"slice": slice_name, "tc": tc, "pod_age_s": age}
+    if hbm is not None:
+        c["hbm"] = hbm
+    return c
+
+
+def test_analyze_identifies_reclaimable_slices(built, tmp_path):
+    doc = {"chips": [
+        chip("ml/idle-a", [0.0] * 8),
+        chip("ml/idle-a", [0.0] * 8),
+        chip("ml/busy-b", [0.0, 0.5, 0.0, 0.0]),
+        chip("ml/busy-b", [0.0] * 4),
+    ]}
+    out, table = run_analyze(tmp_path, doc)
+    assert out["reclaimable_slices"] == ["ml/idle-a"]
+    assert out["idle_chips"] == 3  # both of a + the quiet chip of b
+    assert "IDLE — reclaimable" in table
+    assert "active" in table
+
+
+def test_analyze_hbm_threshold_rescues(built, tmp_path):
+    doc = {"hbm_threshold": 0.05, "chips": [
+        chip("ml/streaming", [0.0] * 4, hbm=[0.2] * 4),
+        chip("ml/truly-idle", [0.0] * 4, hbm=[0.0] * 4),
+    ]}
+    out, _ = run_analyze(tmp_path, doc)
+    assert out["reclaimable_slices"] == ["ml/truly-idle"]
+
+
+def test_analyze_age_gate_and_overrides(built, tmp_path):
+    doc = {"chips": [
+        chip("ml/young", [0.0] * 4, age=60),
+        chip("ml/old", [0.0] * 4, age=9999),
+    ]}
+    out, _ = run_analyze(tmp_path, doc)
+    assert out["reclaimable_slices"] == ["ml/old"]
+    # lookback override makes the young slice eligible too
+    out2, _ = run_analyze(tmp_path, doc, "--lookback-s", "30")
+    assert set(out2["reclaimable_slices"]) == {"ml/old", "ml/young"}
+
+
+def test_analyze_hbm_longer_than_tc(built, tmp_path):
+    # HBM scraped at a finer cadence than tensorcore must not crash
+    doc = {"hbm_threshold": 0.05, "chips": [
+        chip("ml/s", [0.0], hbm=[0.2, 0.2, 0.2]),
+        chip("ml/t", [0.0], hbm=[0.0]),
+    ]}
+    out, _ = run_analyze(tmp_path, doc)
+    assert out["reclaimable_slices"] == ["ml/t"]
+
+
+def test_analyze_ragged_series_padding(built, tmp_path):
+    doc = {"chips": [
+        chip("ml/ragged", [0.0] * 3),
+        chip("ml/ragged", [0.0] * 9),
+    ]}
+    out, _ = run_analyze(tmp_path, doc)
+    assert out["reclaimable_slices"] == ["ml/ragged"]

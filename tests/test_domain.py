@@ -38,6 +38,11 @@ def test_enabled_resources_with_jobset(built):
     assert "JobSet" in kinds
 
 
+def test_enabled_resources_with_leaderworkerset(built):
+    assert native.enabled_resources("l") == ["LeaderWorkerSet"]
+    assert "LeaderWorkerSet" in native.enabled_resources("drsinjl")
+
+
 def test_enabled_resources_single_flag(built):
     assert native.enabled_resources("n") == ["Notebook"]
 
@@ -148,6 +153,7 @@ def test_unknown_kind_rejected(built):
         ("Notebook", "kubeflow.org/v1", "notebooks"),
         ("InferenceService", "serving.kserve.io/v1beta1", "inferenceservices"),
         ("JobSet", "jobset.x-k8s.io/v1alpha2", "jobsets"),
+        ("LeaderWorkerSet", "leaderworkerset.x-k8s.io/v1", "leaderworkersets"),
     ],
 )
 def test_meta_per_kind(built, kind, api_version, plural):

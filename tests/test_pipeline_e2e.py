@@ -205,6 +205,42 @@ def test_young_slice_pod_blocks_jobset_suspend(built, fake_prom, fake_k8s):
     assert fake_k8s.patches_for("/jobsets/v5e-16") == []
 
 
+def test_fully_idle_leaderworkerset_scaled_to_zero(built, fake_prom, fake_k8s):
+    """Multi-host serving group (LWS): all hosts idle → /scale replicas=0."""
+    lws, pods = fake_k8s.add_lws_group("serving", "vllm-tpu", num_hosts=2, tpu_chips=4)
+    for pod in pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "serving", chips=4)
+
+    run_pruner(fake_prom, fake_k8s)
+
+    assert fake_k8s.scale_patches() == [(
+        "/apis/leaderworkerset.x-k8s.io/v1/namespaces/serving/leaderworkersets/vllm-tpu/scale",
+        {"spec": {"replicas": 0}})]
+    obj = fake_k8s.objects[
+        "/apis/leaderworkerset.x-k8s.io/v1/namespaces/serving/leaderworkersets/vllm-tpu"]
+    assert obj["spec"]["replicas"] == 0
+    assert fake_k8s.events[0]["involvedObject"]["kind"] == "LeaderWorkerSet"
+
+
+def test_partially_idle_leaderworkerset_not_scaled(built, fake_prom, fake_k8s):
+    lws, pods = fake_k8s.add_lws_group("serving", "vllm-tpu", num_hosts=2)
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "serving")  # 1 of 2
+
+    run_pruner(fake_prom, fake_k8s)
+    assert fake_k8s.scale_patches() == []
+    assert fake_k8s.events == []
+
+
+def test_lws_disabled_via_resource_flags(built, fake_prom, fake_k8s):
+    lws, pods = fake_k8s.add_lws_group("serving", "vllm-tpu", num_hosts=2)
+    for pod in pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "serving")
+
+    proc = run_pruner(fake_prom, fake_k8s, "--enabled-resources", "drsinj")
+    assert fake_k8s.scale_patches() == []
+    assert "not enabled" in proc.stderr
+
+
 def test_bare_job_is_not_scaled(built, fake_prom, fake_k8s):
     fake_k8s.add_job("batch", "one-off")
     fake_k8s.add_pod("batch", "one-off-xyz",

@@ -1,0 +1,204 @@
+"""querytest subcommand (SURVEY.md §2 #13) and auth-chain e2e coverage."""
+
+import json
+import subprocess
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def test_querytest_prints_table_and_writes_csv(built, fake_prom, tmp_path):
+    fake_prom.add_idle_pod_series("pod-a", "ns1", chips=2)
+    fake_prom.add_idle_pod_series("pod-b", "ns2")
+
+    proc = subprocess.run(
+        [str(DAEMON_PATH), "querytest", "up == 0", fake_prom.url],
+        capture_output=True, text=True, timeout=60, cwd=tmp_path,
+        env={"PROMETHEUS_TOKEN": "qt-token", "PATH": "/usr/bin:/bin"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "resultType: vector, 3 series" in proc.stdout
+    assert "exported_pod" in proc.stdout  # label column present
+    assert "pod-a" in proc.stdout and "pod-b" in proc.stdout
+    # the query made it to the server with auth
+    assert fake_prom.queries == ["up == 0"]
+    assert fake_prom.auth_headers == ["Bearer qt-token"]
+    # CSV written (reference querytest.rs writes output.csv)
+    csv = (tmp_path / "output.csv").read_text()
+    assert csv.count("\n") == 4  # header + 3 rows
+    assert "pod-a" in csv
+
+
+def test_querytest_reports_query_failure(built, fake_prom, tmp_path):
+    fake_prom.fail_requests_remaining = 1
+    proc = subprocess.run(
+        [str(DAEMON_PATH), "querytest", "up", fake_prom.url],
+        capture_output=True, text=True, timeout=60, cwd=tmp_path,
+        env={"PATH": "/usr/bin:/bin"},
+    )
+    assert proc.returncode == 1
+    assert "querytest:" in proc.stderr
+
+
+def test_querytest_usage_without_args(built):
+    proc = subprocess.run(
+        [str(DAEMON_PATH), "querytest"], capture_output=True, text=True, timeout=30,
+        env={"PATH": "/usr/bin:/bin"},
+    )
+    assert proc.returncode == 2
+    assert "usage:" in proc.stderr
+
+
+class FakeMetadataServer:
+    """GCE metadata server double (Workload Identity token mint)."""
+
+    def __init__(self, token="metadata-minted-token"):
+        self.token = token
+        self.requests = []
+        self._server = None
+
+    def start(self):
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                fake.requests.append((self.path, self.headers.get("Metadata-Flavor")))
+                if self.path.endswith("/token"):
+                    body = json.dumps(
+                        {"access_token": fake.token, "expires_in": 3599,
+                         "token_type": "Bearer"}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self._server.server_address[1]
+
+    @property
+    def hostport(self):
+        return f"127.0.0.1:{self._server.server_address[1]}"
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+
+
+def test_auth_chain_falls_back_to_metadata_server(built, fake_prom, fake_k8s):
+    """No explicit/env/SA/kubeconfig token → Workload Identity (metadata
+    server) mints the bearer token — the GKE production path."""
+    md = FakeMetadataServer()
+    md.start()
+    try:
+        fake_k8s.add_deployment_chain("ml", "dep", num_pods=1)
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", fake_prom.url, "--run-mode", "dry-run"],
+            capture_output=True, text=True, timeout=60,
+            env={
+                "KUBE_API_URL": fake_k8s.url,
+                "GCE_METADATA_HOST": md.hostport,
+                "TPU_PRUNER_DISABLE_GCLOUD": "1",
+                "PATH": "/usr/bin:/bin",
+            },
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert fake_prom.auth_headers == ["Bearer metadata-minted-token"]
+        assert md.requests[0][1] == "Google"  # Metadata-Flavor header required
+    finally:
+        md.stop()
+
+
+def test_auth_chain_env_token_wins_over_metadata(built, fake_prom, fake_k8s):
+    md = FakeMetadataServer()
+    md.start()
+    try:
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", fake_prom.url, "--run-mode", "dry-run"],
+            capture_output=True, text=True, timeout=60,
+            env={
+                "KUBE_API_URL": fake_k8s.url,
+                "PROMETHEUS_TOKEN": "env-token",
+                "GCE_METADATA_HOST": md.hostport,
+                "PATH": "/usr/bin:/bin",
+            },
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert fake_prom.auth_headers == ["Bearer env-token"]
+        assert md.requests == []  # chain short-circuits before metadata
+    finally:
+        md.stop()
+
+
+def test_explicit_flag_token_wins_over_env(built, fake_prom, fake_k8s):
+    proc = subprocess.run(
+        [str(DAEMON_PATH), "--prometheus-url", fake_prom.url, "--run-mode", "dry-run",
+         "--prometheus-token", "flag-token"],
+        capture_output=True, text=True, timeout=60,
+        env={"KUBE_API_URL": fake_k8s.url, "PROMETHEUS_TOKEN": "env-token",
+             "PATH": "/usr/bin:/bin"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert fake_prom.auth_headers == ["Bearer flag-token"]
+
+
+def test_sa_token_file_used_when_no_env(built, fake_prom, fake_k8s, tmp_path):
+    sa_file = tmp_path / "token"
+    sa_file.write_text("sa-file-token\n")
+    proc = subprocess.run(
+        [str(DAEMON_PATH), "--prometheus-url", fake_prom.url, "--run-mode", "dry-run"],
+        capture_output=True, text=True, timeout=60,
+        env={"KUBE_API_URL": fake_k8s.url,
+             "TPU_PRUNER_SA_TOKEN_FILE": str(sa_file),
+             "TPU_PRUNER_DISABLE_METADATA": "1",
+             "TPU_PRUNER_DISABLE_GCLOUD": "1",
+             "PATH": "/usr/bin:/bin"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert fake_prom.auth_headers == ["Bearer sa-file-token"]
+
+
+def test_kubeconfig_token_scan(built, fake_prom, fake_k8s, tmp_path):
+    kubeconfig = tmp_path / "config"
+    kubeconfig.write_text(
+        "apiVersion: v1\nclusters:\n- cluster:\n    server: " + fake_k8s.url +
+        "\n  name: c\nusers:\n- name: u\n  user:\n    token: \"kubeconfig-token\"\n")
+    proc = subprocess.run(
+        [str(DAEMON_PATH), "--prometheus-url", fake_prom.url, "--run-mode", "dry-run"],
+        capture_output=True, text=True, timeout=60,
+        env={"KUBECONFIG": str(kubeconfig),
+             "TPU_PRUNER_DISABLE_METADATA": "1",
+             "TPU_PRUNER_DISABLE_GCLOUD": "1",
+             "PATH": "/usr/bin:/bin"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    # both the prometheus bearer AND the k8s api url come from the kubeconfig
+    assert fake_prom.auth_headers == ["Bearer kubeconfig-token"]

@@ -1,0 +1,120 @@
+"""Offline fleet idleness audit: `python -m tpu_pruner.analyze dump.json`.
+
+Batch-evaluates the daemon's idle semantics over an exported metrics dump
+using the JAX policy engine (tpu_pruner/policy) — useful for capacity
+reviews ("which slices were reclaimable last week?") and for validating
+threshold choices before enabling scale-down mode.
+
+Input format (JSON):
+
+    {
+      "lookback_s": 2100,          # optional, default 30m + 300s grace
+      "hbm_threshold": 0.05,       # optional, default disabled
+      "chips": [
+        {"slice": "tpu-jobs/v5e-16",   # slice/workload identity
+         "pod_age_s": 7200,
+         "tc": [0.0, 0.0, ...],        # tensorcore utilization samples, 0-1
+         "hbm": [0.01, 0.0, ...]},     # optional, HBM bandwidth util
+        ...
+      ]
+    }
+
+Chips of one slice may have different sample counts; series are
+right-aligned and padded with invalid samples. Output: one human table on
+stderr and one machine-readable JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def load_fleet(doc: dict):
+    chips = doc["chips"]
+    if not chips:
+        raise ValueError("empty fleet: no chips in dump")
+    num_chips = len(chips)
+    # HBM may be scraped at a different cadence than tensorcore; size the
+    # sample axis to the longest series of either kind.
+    T = max(max(len(c["tc"]), len(c.get("hbm") or [])) for c in chips)
+
+    slice_names = sorted({c["slice"] for c in chips})
+    slice_index = {name: i for i, name in enumerate(slice_names)}
+
+    tc = np.zeros((num_chips, T), dtype=np.float32)
+    hbm = np.zeros((num_chips, T), dtype=np.float32)
+    valid = np.zeros((num_chips, T), dtype=bool)
+    age = np.zeros(num_chips, dtype=np.float32)
+    slice_id = np.zeros(num_chips, dtype=np.int32)
+
+    for i, c in enumerate(chips):
+        samples = np.asarray(c["tc"], dtype=np.float32)
+        n = len(samples)
+        tc[i, T - n:] = samples
+        valid[i, T - n:] = True
+        hbm_samples = c.get("hbm")
+        if hbm_samples is not None:
+            h = np.asarray(hbm_samples, dtype=np.float32)
+            hbm[i, T - len(h):] = h
+        age[i] = float(c.get("pod_age_s", 0))
+        slice_id[i] = slice_index[c["slice"]]
+    return (tc, hbm, valid, age, slice_id), slice_names
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_pruner.analyze", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("dump", help="metrics dump JSON path, or '-' for stdin")
+    parser.add_argument("--lookback-s", type=float, default=None,
+                        help="override lookback seconds (default: dump value or 2100)")
+    parser.add_argument("--hbm-threshold", type=float, default=None,
+                        help="override HBM corroboration threshold (0 disables)")
+    args = parser.parse_args(argv)
+
+    doc = json.load(sys.stdin if args.dump == "-" else open(args.dump))
+    (tc, hbm, valid, age, slice_id), slice_names = load_fleet(doc)
+
+    from tpu_pruner.policy import PolicyParams, evaluate_fleet
+    from tpu_pruner.policy.engine import params_array
+
+    params = PolicyParams(
+        lookback_s=(args.lookback_s if args.lookback_s is not None
+                    else float(doc.get("lookback_s", 30 * 60 + 300))),
+        hbm_threshold=(args.hbm_threshold if args.hbm_threshold is not None
+                       else float(doc.get("hbm_threshold", 0.0))),
+    )
+    verdicts, candidates = evaluate_fleet(
+        tc, hbm, valid, age, slice_id, params_array(params),
+        num_slices=len(slice_names))
+    verdicts = np.asarray(verdicts)
+    candidates = np.asarray(candidates)
+
+    chips_per_slice = np.bincount(slice_id, minlength=len(slice_names))
+    idle_chips = int(candidates.sum())
+    print(f"{'slice':40s} {'chips':>6s} {'idle':>6s} verdict", file=sys.stderr)
+    for i, name in enumerate(slice_names):
+        members = slice_id == i
+        print(f"{name:40s} {int(chips_per_slice[i]):6d} "
+              f"{int(candidates[members].sum()):6d} "
+              f"{'IDLE — reclaimable' if verdicts[i] else 'active'}",
+              file=sys.stderr)
+
+    print(json.dumps({
+        "num_chips": int(len(slice_id)),
+        "num_slices": len(slice_names),
+        "idle_chips": idle_chips,
+        "reclaimable_slices": [slice_names[i] for i in range(len(slice_names))
+                               if verdicts[i]],
+        "lookback_s": params.lookback_s,
+        "hbm_threshold": params.hbm_threshold,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -220,6 +220,46 @@ class FakeK8s:
             )
         return js, pods
 
+    def add_leaderworkerset(self, ns, name, uid=None, replicas=1):
+        obj = {
+            "apiVersion": "leaderworkerset.x-k8s.io/v1",
+            "kind": "LeaderWorkerSet",
+            "metadata": self._meta(name, ns, uid=uid),
+            "spec": {"replicas": replicas, "leaderWorkerTemplate": {}},
+        }
+        self.objects[
+            f"/apis/leaderworkerset.x-k8s.io/v1/namespaces/{ns}/leaderworkersets/{name}"
+        ] = obj
+        return obj
+
+    def add_lws_group(self, ns, lws_name, num_hosts=2, tpu_chips=4, uid=None,
+                      pod_age=7200):
+        """A multi-host serving group with realistic LWS topology: the
+        leader StatefulSet is owned by the LWS, but the worker StatefulSet
+        is owned by the *leader Pod* (upstream controller semantics) — so
+        only the leaderworkerset.sigs.k8s.io/name pod label reaches the
+        root uniformly."""
+        lws = self.add_leaderworkerset(ns, lws_name, uid=uid)
+        leader_ss = self.add_statefulset(
+            ns, lws_name,
+            owners=[self.owner("LeaderWorkerSet", lws_name, lws["metadata"]["uid"])])
+        labels = {"leaderworkerset.sigs.k8s.io/name": lws_name}
+        pods = [self.add_pod(
+            ns, f"{lws_name}-0",
+            owners=[self.owner("StatefulSet", leader_ss["metadata"]["name"],
+                               leader_ss["metadata"]["uid"])],
+            labels=labels, tpu_chips=tpu_chips, created_age=pod_age)]
+        worker_ss = self.add_statefulset(
+            ns, f"{lws_name}-0-workers",
+            owners=[self.owner("Pod", f"{lws_name}-0", pods[0]["metadata"]["uid"])])
+        for host in range(1, num_hosts):
+            pods.append(self.add_pod(
+                ns, f"{lws_name}-0-{host}",
+                owners=[self.owner("StatefulSet", worker_ss["metadata"]["name"],
+                                   worker_ss["metadata"]["uid"])],
+                labels=labels, tpu_chips=tpu_chips, created_age=pod_age))
+        return lws, pods
+
     # ── deployment chain helper (Pod→RS→Deployment) ──
     def add_deployment_chain(self, ns, name, num_pods=1, tpu_chips=4, pod_age=7200):
         dep = self.add_deployment(ns, name)
