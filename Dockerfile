@@ -12,7 +12,8 @@ RUN cmake -G Ninja -S . -B build -DCMAKE_BUILD_TYPE=Release \
     && ./build/tpupruner_tests
 
 FROM debian:12-slim
-# libssl3 for the dlopen'd TLS shim; ca-certificates for verify mode
+# libssl3 for the dlopen'd TLS shim; ca-certificates for verify mode.
+# The binary is self-contained (object-linked, no libtpupruner.so).
 RUN apt-get update && apt-get install -y --no-install-recommends \
     libssl3 ca-certificates && rm -rf /var/lib/apt/lists/*
 COPY --from=build /src/build/tpu-pruner /usr/local/bin/tpu-pruner

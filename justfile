@@ -12,9 +12,9 @@ test-unit: build
     ./build/tpupruner_tests
     python -m pytest tests/test_domain.py tests/test_query_template.py -q
 
-# hermetic end-to-end tier (fake Prometheus + fake K8s API)
+# hermetic end-to-end tier (fake Prometheus + fake K8s API, TLS, OTLP)
 test-e2e: build
-    python -m pytest tests/test_pipeline_e2e.py tests/test_querytest_auth.py -q
+    python -m pytest tests/ -q -k "pipeline or querytest or auth or tls or otlp"
 
 # sanitizer builds (the race/memory tier the reference lacks, SURVEY.md §5)
 test-asan:

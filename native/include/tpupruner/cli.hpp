@@ -55,6 +55,7 @@ struct Cli {
   int64_t resolve_concurrency = 10;       // --resolve-concurrency (ref: fixed 10)
   int64_t scale_concurrency = 8;          // --scale-concurrency (ref: serial consumer)
   int metrics_port = 0;                   // --metrics-port (>0 serves /metrics)
+  std::string otlp_endpoint;              // --otlp-endpoint (default: $OTEL_EXPORTER_OTLP_ENDPOINT)
 
   bool dry_run() const { return run_mode != "scale-down"; }
 };

@@ -87,6 +87,8 @@ TPU FLAGS:
       --resolve-concurrency <N> concurrent pod resolutions [default: 10]
       --scale-concurrency <N>   concurrent scale actuations [default: 8]
       --metrics-port <P>        serve Prometheus /metrics on this port
+      --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
+                                [default: $OTEL_EXPORTER_OTLP_ENDPOINT]
   -h, --help                    print this help
 )";
 }
@@ -152,6 +154,7 @@ Cli parse(int argc, char** argv) {
          if (cli.metrics_port < 0 || cli.metrics_port > 65535)
            throw CliError("--metrics-port out of range");
        }},
+      {"--otlp-endpoint", [&](const std::string& v) { cli.otlp_endpoint = v; }},
   };
   std::map<std::string, std::string> shorts = {
       {"-t", "--duration"},       {"-e", "--enabled-resources"},

@@ -9,6 +9,7 @@
 #include <vector>
 
 #include "metrics_http.hpp"
+#include "otlp.hpp"
 #include "tpupruner/actuate.hpp"
 #include "tpupruner/auth.hpp"
 #include "tpupruner/log.hpp"
@@ -247,6 +248,24 @@ int run(const cli::Cli& args) {
   std::unique_ptr<metrics_http::Server> metrics_server;
   if (args.metrics_port > 0) {
     metrics_server = std::make_unique<metrics_http::Server>(args.metrics_port);
+  }
+  // Optional OTLP/HTTP push (reference `otel` feature; OTEL_* env config).
+  std::unique_ptr<otlp::Exporter> otlp_exporter;
+  {
+    std::string endpoint = args.otlp_endpoint;
+    if (endpoint.empty())
+      endpoint = util::env("OTEL_EXPORTER_OTLP_ENDPOINT").value_or("");
+    if (!endpoint.empty()) {
+      int interval_ms = 15000;
+      if (auto iv = util::env("OTEL_METRIC_EXPORT_INTERVAL")) {
+        try {
+          interval_ms = std::max(100, std::stoi(*iv));
+        } catch (const std::exception&) {
+          log::warn("ignoring unparseable OTEL_METRIC_EXPORT_INTERVAL: " + *iv);
+        }
+      }
+      otlp_exporter = std::make_unique<otlp::Exporter>(endpoint, interval_ms);
+    }
   }
 
   TargetQueue queue(kQueueCapacity);

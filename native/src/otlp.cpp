@@ -1,0 +1,122 @@
+#include "otlp.hpp"
+
+#include "tpupruner/http.hpp"
+#include "tpupruner/json.hpp"
+#include "tpupruner/log.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::otlp {
+
+using json::Value;
+
+namespace {
+
+// Counter names ending in "returned_*" are last-cycle gauges; the rest are
+// monotonic sums (the reference's monotonic_counter.* vs counter.* split,
+// main.rs:300-321, 349-365).
+bool is_gauge(const std::string& name) {
+  return name.find("returned") != std::string::npos;
+}
+
+Value data_point(uint64_t value, int64_t start_nanos, int64_t now_nanos) {
+  Value dp = Value::object();
+  dp.set("asInt", Value(std::to_string(value)));  // OTLP JSON: int64 as string
+  dp.set("startTimeUnixNano", Value(std::to_string(start_nanos)));
+  dp.set("timeUnixNano", Value(std::to_string(now_nanos)));
+  return dp;
+}
+
+}  // namespace
+
+Exporter::Exporter(std::string endpoint, int interval_ms)
+    : endpoint_(std::move(endpoint)),
+      interval_ms_(interval_ms),
+      start_unix_nanos_(util::now_unix() * 1000000000ll) {
+  while (!endpoint_.empty() && endpoint_.back() == '/') endpoint_.pop_back();
+  thread_ = std::thread([this] { loop(); });
+  log::info("OTLP metrics export to " + endpoint_ + "/v1/metrics every " +
+            std::to_string(interval_ms_) + "ms");
+}
+
+Exporter::~Exporter() {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    stop_.store(true);
+    cv_.notify_all();
+  }
+  if (thread_.joinable()) thread_.join();
+  export_once();  // shutdown flush (reference OtelGuard::drop, main.rs:262-271)
+}
+
+void Exporter::loop() {
+  std::unique_lock<std::mutex> lock(mutex_);
+  while (!stop_.load()) {
+    cv_.wait_for(lock, std::chrono::milliseconds(interval_ms_),
+                 [&] { return stop_.load(); });
+    if (stop_.load()) break;
+    lock.unlock();
+    export_once();
+    lock.lock();
+  }
+}
+
+bool Exporter::export_once() {
+  int64_t now_nanos = util::now_unix() * 1000000000ll;
+  Value metrics = Value::array();
+  for (const auto& [name, value] : log::counters_snapshot()) {
+    Value metric = Value::object();
+    metric.set("name", Value("tpu_pruner." + name));
+    Value points = Value::array();
+    points.push_back(data_point(value, start_unix_nanos_, now_nanos));
+    if (is_gauge(name)) {
+      Value gauge = Value::object();
+      gauge.set("dataPoints", std::move(points));
+      metric.set("gauge", std::move(gauge));
+    } else {
+      Value sum = Value::object();
+      sum.set("dataPoints", std::move(points));
+      sum.set("aggregationTemporality", Value(2));  // CUMULATIVE
+      sum.set("isMonotonic", Value(true));
+      metric.set("sum", std::move(sum));
+    }
+    metrics.push_back(std::move(metric));
+  }
+
+  Value scope_metrics = Value::object();
+  scope_metrics.set("scope", Value(json::Object{{"name", Value("tpu_pruner")}}));
+  scope_metrics.set("metrics", std::move(metrics));
+
+  Value attr = Value::object();
+  attr.set("key", Value("service.name"));
+  attr.set("value", Value(json::Object{{"stringValue", Value("tpu-pruner")}}));
+  Value resource = Value::object();
+  resource.set("attributes", Value(json::Array{std::move(attr)}));
+
+  Value rm = Value::object();
+  rm.set("resource", std::move(resource));
+  rm.set("scopeMetrics", Value(json::Array{std::move(scope_metrics)}));
+
+  Value body = Value::object();
+  body.set("resourceMetrics", Value(json::Array{std::move(rm)}));
+
+  try {
+    http::Client client;
+    http::Request req;
+    req.method = "POST";
+    req.url = endpoint_ + "/v1/metrics";
+    req.headers.push_back({"Content-Type", "application/json"});
+    req.body = body.dump();
+    req.timeout_ms = 5000;
+    http::Response resp = client.request(req);
+    if (resp.status < 200 || resp.status >= 300) {
+      log::warn("OTLP export got HTTP " + std::to_string(resp.status));
+      return false;
+    }
+    return true;
+  } catch (const std::exception& e) {
+    log::warn(std::string("OTLP export failed: ") + e.what());
+    return false;
+  }
+}
+
+}  // namespace tpupruner::otlp

@@ -1,0 +1,44 @@
+// OTLP/HTTP metrics exporter (internal).
+//
+// Reference analog: the optional `otel` cargo feature (gpu-pruner
+// main.rs:138-155, 194-271) pushing the six tracing-field counters over
+// OTLP gRPC, configured purely by OTEL_* env vars (README.md:79-98).
+// Here: the same counters pushed as OTLP/HTTP JSON (the spec's JSON
+// encoding of ExportMetricsServiceRequest) on a periodic background
+// thread. Enabled by OTEL_EXPORTER_OTLP_ENDPOINT (or the CLI flag);
+// interval from OTEL_METRIC_EXPORT_INTERVAL (ms, default 15000).
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <thread>
+
+namespace tpupruner::otlp {
+
+class Exporter {
+ public:
+  // `endpoint` is the OTLP base (e.g. http://collector:4318); metrics go
+  // to <endpoint>/v1/metrics.
+  Exporter(std::string endpoint, int interval_ms);
+  ~Exporter();  // final flush, then stop
+
+  // One export now (also used for the shutdown flush). Returns false and
+  // logs on failure; the daemon never fails because telemetry did.
+  bool export_once();
+
+ private:
+  void loop();
+  std::string endpoint_;
+  int interval_ms_;
+  std::atomic<bool> stop_{false};
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  std::thread thread_;
+  int64_t start_unix_nanos_;
+};
+
+}  // namespace tpupruner::otlp

@@ -1,0 +1,136 @@
+"""OTLP/HTTP metrics export (reference `otel` feature analog)."""
+
+import json
+import subprocess
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+
+class FakeOtlpCollector:
+    def __init__(self):
+        self.requests = []
+        self._server = None
+
+    def start(self):
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length))
+                fake.requests.append((self.path, body))
+                resp = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(resp)))
+                self.end_headers()
+                self.wfile.write(resp)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self._server.server_address[1]
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self._server.server_address[1]}"
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+
+
+@pytest.fixture()
+def collector():
+    c = FakeOtlpCollector()
+    c.start()
+    yield c
+    c.stop()
+
+
+def _metrics_by_name(body):
+    out = {}
+    for rm in body["resourceMetrics"]:
+        for sm in rm["scopeMetrics"]:
+            for m in sm["metrics"]:
+                out[m["name"]] = m
+    return out
+
+
+def run_cycle(prom, k8s, collector, env_extra=None):
+    env = {"KUBE_API_URL": k8s.url, "PROMETHEUS_TOKEN": "t", "PATH": "/usr/bin:/bin"}
+    env.update(env_extra or {})
+    return subprocess.run(
+        [str(DAEMON_PATH), "--prometheus-url", prom.url, "--run-mode", "scale-down",
+         "--otlp-endpoint", collector.url],
+        capture_output=True, text=True, timeout=60, env=env)
+
+
+def test_otlp_shutdown_flush_exports_counters(built, collector):
+    prom, k8s = FakePrometheus(), FakeK8s()
+    _, _, pods = k8s.add_deployment_chain("ml", "dep", num_pods=1)
+    prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    prom.start(); k8s.start()
+    try:
+        proc = run_cycle(prom, k8s, collector)
+        assert proc.returncode == 0, proc.stderr
+    finally:
+        prom.stop(); k8s.stop()
+
+    # single-shot run: at least the shutdown flush must have arrived
+    assert collector.requests, "no OTLP export received"
+    path, body = collector.requests[-1]
+    assert path == "/v1/metrics"
+    # resource attribution
+    attrs = body["resourceMetrics"][0]["resource"]["attributes"]
+    assert {"key": "service.name", "value": {"stringValue": "tpu-pruner"}} in attrs
+
+    metrics = _metrics_by_name(body)
+    # monotonic sums keep the reference counter names (main.rs:300-365)
+    assert metrics["tpu_pruner.query_successes"]["sum"]["isMonotonic"] is True
+    assert metrics["tpu_pruner.query_successes"]["sum"]["dataPoints"][0]["asInt"] == "1"
+    assert metrics["tpu_pruner.scale_successes"]["sum"]["dataPoints"][0]["asInt"] == "1"
+    # last-cycle values are gauges
+    assert "gauge" in metrics["tpu_pruner.query_returned_candidates"]
+    assert metrics["tpu_pruner.query_returned_candidates"]["gauge"]["dataPoints"][0][
+        "asInt"] == "1"
+
+
+def test_otlp_env_var_enables_export(built, collector):
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start(); k8s.start()
+    try:
+        env = {"KUBE_API_URL": k8s.url, "PROMETHEUS_TOKEN": "t",
+               "PATH": "/usr/bin:/bin",
+               "OTEL_EXPORTER_OTLP_ENDPOINT": collector.url}
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", prom.url, "--run-mode", "dry-run"],
+            capture_output=True, text=True, timeout=60, env=env)
+        assert proc.returncode == 0, proc.stderr
+    finally:
+        prom.stop(); k8s.stop()
+    assert collector.requests
+    assert collector.requests[-1][0] == "/v1/metrics"
+
+
+def test_collector_failure_does_not_fail_daemon(built):
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start(); k8s.start()
+    try:
+        env = {"KUBE_API_URL": k8s.url, "PROMETHEUS_TOKEN": "t",
+               "PATH": "/usr/bin:/bin"}
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", prom.url, "--run-mode", "dry-run",
+             "--otlp-endpoint", "http://127.0.0.1:1"],  # nothing listening
+            capture_output=True, text=True, timeout=60, env=env)
+        assert proc.returncode == 0, proc.stderr
+        assert "OTLP export failed" in proc.stderr
+    finally:
+        prom.stop(); k8s.stop()

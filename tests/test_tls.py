@@ -1,0 +1,106 @@
+"""TLS end-to-end: the dlopen'd OpenSSL shim against a real TLS server.
+
+Reference analog: TlsMode skip/verify + custom PEM bundle
+(gpu-pruner/src/lib.rs:233-282). Covers: skip mode, verify-mode rejection
+of an unknown CA, and verify mode trusting a --prometheus-tls-cert bundle
+(including hostname verification via SAN).
+"""
+
+import datetime
+import subprocess
+
+import pytest
+
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """Self-signed CA-ish cert for CN/SAN localhost."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    tmp = tmp_path_factory.mktemp("certs")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]), critical=False)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    cert_path = tmp / "cert.pem"
+    key_path = tmp / "key.pem"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption()))
+    return str(cert_path), str(key_path)
+
+
+@pytest.fixture()
+def tls_prom(certs):
+    f = FakePrometheus()
+    f.start(certfile=certs[0], keyfile=certs[1])
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def run_pruner(url, fake_k8s, *extra):
+    return subprocess.run(
+        [str(DAEMON_PATH), "--prometheus-url", url, "--run-mode", "dry-run", *extra],
+        capture_output=True, text=True, timeout=60,
+        env={"KUBE_API_URL": fake_k8s.url, "PROMETHEUS_TOKEN": "t",
+             "PATH": "/usr/bin:/bin"},
+    )
+
+
+def test_tls_skip_mode_connects(built, tls_prom, fake_k8s):
+    proc = run_pruner(tls_prom.url, fake_k8s, "--prometheus-tls-mode", "skip")
+    assert proc.returncode == 0, proc.stderr
+    assert len(tls_prom.queries) == 1
+
+
+def test_tls_verify_rejects_unknown_ca(built, tls_prom, fake_k8s):
+    proc = run_pruner(tls_prom.url, fake_k8s)  # default verify
+    assert proc.returncode == 1
+    assert "tls" in proc.stderr.lower()
+    assert tls_prom.queries == []
+
+
+def test_tls_verify_with_custom_ca_bundle(built, tls_prom, fake_k8s, certs):
+    proc = run_pruner(tls_prom.url, fake_k8s, "--prometheus-tls-cert", certs[0])
+    assert proc.returncode == 0, proc.stderr
+    assert len(tls_prom.queries) == 1
+
+
+def test_tls_hostname_mismatch_rejected(built, certs, fake_k8s):
+    """Cert is for 'localhost'; connecting via 127.0.0.1 must fail verify."""
+    f = FakePrometheus()
+    f.start(certfile=certs[0], keyfile=certs[1])
+    try:
+        url = f.url.replace("localhost", "127.0.0.1")
+        proc = run_pruner(url, fake_k8s, "--prometheus-tls-cert", certs[0])
+        assert proc.returncode == 1
+        assert "tls" in proc.stderr.lower()
+    finally:
+        f.stop()

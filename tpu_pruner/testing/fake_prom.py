@@ -56,7 +56,7 @@ class FakePrometheus:
         self._version += 1
 
     # ── lifecycle ──
-    def start(self) -> int:
+    def start(self, certfile: str | None = None, keyfile: str | None = None) -> int:
         fake = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -122,6 +122,13 @@ class FakePrometheus:
         # default backlog of 5 drops SYNs under concurrent load
         ThreadingHTTPServer.request_queue_size = 128
         self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._tls = certfile is not None
+        if certfile:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self._server.socket = ctx.wrap_socket(self._server.socket, server_side=True)
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
         return self._server.server_address[1]
@@ -129,7 +136,9 @@ class FakePrometheus:
     @property
     def url(self) -> str:
         assert self._server is not None
-        return f"http://127.0.0.1:{self._server.server_address[1]}"
+        scheme = "https" if getattr(self, "_tls", False) else "http"
+        host = "localhost" if getattr(self, "_tls", False) else "127.0.0.1"
+        return f"{scheme}://{host}:{self._server.server_address[1]}"
 
     def stop(self) -> None:
         if self._server:
