@@ -33,9 +33,16 @@ inline void error(const std::string& msg) { write(Level::Error, msg); }
 // Counters (reference names, main.rs:300-365):
 //   query_successes, query_failures, scale_successes, scale_failures,
 //   query_returned_candidates, query_returned_shutdown_events
+// The call site fixes the metric kind, mirroring the reference's
+// monotonic_counter.* vs counter.* tracing-field prefixes: counter_add
+// registers a monotonic cumulative sum, counter_set a last-value gauge.
+struct Counter {
+  uint64_t value = 0;
+  bool gauge = false;
+};
 void counter_add(const std::string& name, uint64_t delta);
 void counter_set(const std::string& name, uint64_t value);
-std::map<std::string, uint64_t> counters_snapshot();
+std::map<std::string, Counter> counters_snapshot();
 void counters_reset_for_test();
 
 }  // namespace tpupruner::log

@@ -85,7 +85,8 @@ struct ResolveOutcome {
 // Concurrent pod-resolution fan-out (reference: buffer_unordered(10),
 // main.rs:447-532). Each sample costs 1-3 K8s API round-trips.
 ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
-                            const std::vector<core::PodMetricSample>& samples) {
+                            const std::vector<core::PodMetricSample>& samples,
+                            const otlp::SpanContext& parent_ctx) {
   ResolveOutcome out;
   std::mutex out_mutex;
   std::atomic<size_t> next{0};
@@ -136,10 +137,15 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       log::info("Pod " + key + " is idle and eligible for scaledown");
 
       std::optional<ScaleTarget> target;
-      try {
-        target = walker::find_root_object(kube, *pod, &owner_cache);
-      } catch (const std::exception& e) {
-        log::warn("Skipping " + key + ", no scalable root object: " + e.what());
+      {
+        otlp::Span span("find_root_object", &parent_ctx);  // lib.rs:436 span
+        span.attr("pod", key);
+        try {
+          target = walker::find_root_object(kube, *pod, &owner_cache);
+        } catch (const std::exception& e) {
+          span.set_error(e.what());
+          log::warn("Skipping " + key + ", no scalable root object: " + e.what());
+        }
       }
 
       std::lock_guard<std::mutex> lock(out_mutex);
@@ -157,10 +163,40 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
 
 }  // namespace
 
+static CycleStats run_cycle_inner(const cli::Cli& args, const std::string& query,
+                                  const k8s::Client& kube,
+                                  const std::function<void(ScaleTarget)>& enqueue,
+                                  otlp::Span& cycle);
+
 CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
                      const std::function<void(ScaleTarget)>& enqueue) {
+  // Cycle span (reference #[tracing::instrument] on run_query_and_scale,
+  // main.rs:390); children below mirror the instrumented callees. A throw
+  // out of the cycle marks the span before it unwinds so failed cycles
+  // export with error status.
+  otlp::Span cycle("run_query_and_scale");
+  try {
+    return run_cycle_inner(args, query, kube, enqueue, cycle);
+  } catch (const std::exception& e) {
+    cycle.set_error(e.what());
+    throw;
+  }
+}
+
+static CycleStats run_cycle_inner(const cli::Cli& args, const std::string& query,
+                                  const k8s::Client& kube,
+                                  const std::function<void(ScaleTarget)>& enqueue,
+                                  otlp::Span& cycle) {
   prom::Client prom_client = build_prom_client(args);
-  json::Value response = prom_client.instant_query(query);
+  json::Value response = [&] {
+    otlp::Span span("prometheus.instant_query", &cycle.context());
+    try {
+      return prom_client.instant_query(query);
+    } catch (const std::exception& e) {
+      span.set_error(e.what());
+      throw;
+    }
+  }();
 
   metrics::DecodeResult decoded = metrics::decode_instant_vector(response, args.device);
   for (const std::string& err : decoded.errors) {
@@ -169,7 +205,7 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   log::info("Query returned " + std::to_string(decoded.num_series) + " series across " +
             std::to_string(decoded.samples.size()) + " unique pods");
 
-  ResolveOutcome resolved = resolve_pods(args, kube, decoded.samples);
+  ResolveOutcome resolved = resolve_pods(args, kube, decoded.samples, cycle.context());
   std::vector<ScaleTarget> unique = core::dedup_targets(std::move(resolved.targets));
 
   // Multi-host group gate: a JobSet/LeaderWorkerSet is only a candidate
@@ -188,9 +224,16 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
       }
     }
     if (!group_targets.empty()) {
-      std::vector<char> verdicts =
-          walker::groups_fully_idle(kube, group_targets, resolved.idle_pods);
-      for (size_t j = 0; j < group_indices.size(); ++j) keep[group_indices[j]] = verdicts[j];
+      otlp::Span span("groups_fully_idle", &cycle.context());
+      span.attr("groups", static_cast<int64_t>(group_targets.size()));
+      try {
+        std::vector<char> verdicts =
+            walker::groups_fully_idle(kube, group_targets, resolved.idle_pods);
+        for (size_t j = 0; j < group_indices.size(); ++j) keep[group_indices[j]] = verdicts[j];
+      } catch (const std::exception& e) {
+        span.set_error(e.what());
+        throw;
+      }
     }
   }
   std::vector<ScaleTarget> survivors;
@@ -203,6 +246,9 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   stats.num_series = decoded.num_series;
   stats.num_pods = decoded.samples.size();
   stats.shutdown_events = survivors.size();
+  cycle.attr("num_series", static_cast<int64_t>(stats.num_series));
+  cycle.attr("num_pods", static_cast<int64_t>(stats.num_pods));
+  cycle.attr("shutdown_events", static_cast<int64_t>(stats.shutdown_events));
 
   for (ScaleTarget& t : survivors) {
     std::string desc = "[" + std::string(core::kind_name(t.kind)) + "] " +
@@ -285,9 +331,17 @@ int run(const cli::Cli& args) {
       }
       actuate::ScaleOptions opts;
       opts.device = args.device;
+      // Root span per actuation: the consumer runs on its own task, so
+      // scale traces are separate from the query cycle's, as in the
+      // reference (lib.rs:338 instrument on scale()).
+      otlp::Span span("scale");
+      span.attr("kind", std::string(core::kind_name(t->kind)));
+      span.attr("name", t->name());
+      span.attr("namespace", t->ns().value_or(""));
       try {
         actuate::scale_to_zero(kube, *t, opts);
       } catch (const std::exception& e) {
+        span.set_error(e.what());
         log::counter_add("scale_failures", 1);
         log::error(std::string("Failed to scale resource! ") + e.what());
         continue;

@@ -14,7 +14,7 @@ std::mutex g_mutex;
 Format g_format = Format::Default;
 Level g_threshold = Level::Info;
 bool g_initialized = false;
-std::map<std::string, uint64_t> g_counters;
+std::map<std::string, Counter> g_counters;
 
 Level parse_level(const std::string& s) {
   std::string l = util::to_lower(s);
@@ -98,15 +98,19 @@ void write(Level level, const std::string& msg) {
 
 void counter_add(const std::string& name, uint64_t delta) {
   std::lock_guard<std::mutex> lock(g_mutex);
-  g_counters[name] += delta;
+  Counter& c = g_counters[name];
+  c.value += delta;
+  c.gauge = false;
 }
 
 void counter_set(const std::string& name, uint64_t value) {
   std::lock_guard<std::mutex> lock(g_mutex);
-  g_counters[name] = value;
+  Counter& c = g_counters[name];
+  c.value = value;
+  c.gauge = true;
 }
 
-std::map<std::string, uint64_t> counters_snapshot() {
+std::map<std::string, Counter> counters_snapshot() {
   std::lock_guard<std::mutex> lock(g_mutex);
   return g_counters;
 }

@@ -58,11 +58,10 @@ void Server::serve() {
     ::recv(fd, buf, sizeof(buf), 0);
 
     std::string body = "# tpu-pruner operational counters\n";
-    for (const auto& [name, value] : log::counters_snapshot()) {
+    for (const auto& [name, counter] : log::counters_snapshot()) {
       std::string metric = "tpu_pruner_" + name;
-      body += "# TYPE " + metric +
-              (name.find("returned") != std::string::npos ? " gauge\n" : " counter\n");
-      body += metric + " " + std::to_string(value) + "\n";
+      body += "# TYPE " + metric + (counter.gauge ? " gauge\n" : " counter\n");
+      body += metric + " " + std::to_string(counter.value) + "\n";
     }
     std::string resp =
         "HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\nContent-Length: " +

@@ -11,13 +11,6 @@ using json::Value;
 
 namespace {
 
-// Counter names ending in "returned_*" are last-cycle gauges; the rest are
-// monotonic sums (the reference's monotonic_counter.* vs counter.* split,
-// main.rs:300-321, 349-365).
-bool is_gauge(const std::string& name) {
-  return name.find("returned") != std::string::npos;
-}
-
 Value data_point(uint64_t value, int64_t start_nanos, int64_t now_nanos) {
   Value dp = Value::object();
   dp.set("asInt", Value(std::to_string(value)));  // OTLP JSON: int64 as string
@@ -26,15 +19,90 @@ Value data_point(uint64_t value, int64_t start_nanos, int64_t now_nanos) {
   return dp;
 }
 
+// service.name = tpu-pruner (reference Resource, main.rs:139-143).
+Value service_resource() {
+  Value attr = Value::object();
+  attr.set("key", Value("service.name"));
+  attr.set("value", Value(json::Object{{"stringValue", Value("tpu-pruner")}}));
+  Value resource = Value::object();
+  resource.set("attributes", Value(json::Array{std::move(attr)}));
+  return resource;
+}
+
+// ── span buffer ──
+std::atomic<bool> g_recording{false};
+std::mutex g_spans_mutex;
+std::vector<FinishedSpan> g_spans;
+uint64_t g_spans_dropped = 0;
+constexpr size_t kSpanBufferCap = 4096;
+
+void buffer_span(FinishedSpan&& span) {
+  std::lock_guard<std::mutex> lock(g_spans_mutex);
+  if (g_spans.size() >= kSpanBufferCap) {
+    ++g_spans_dropped;  // exporter stalled or absent; telemetry never blocks
+    return;
+  }
+  g_spans.push_back(std::move(span));
+}
+
+std::vector<FinishedSpan> drain_spans() {
+  std::lock_guard<std::mutex> lock(g_spans_mutex);
+  std::vector<FinishedSpan> out;
+  out.swap(g_spans);
+  if (g_spans_dropped > 0) {
+    log::warn("OTLP span buffer overflowed; dropped " + std::to_string(g_spans_dropped) +
+              " spans");
+    g_spans_dropped = 0;
+  }
+  return out;
+}
+
 }  // namespace
+
+bool recording() { return g_recording.load(std::memory_order_relaxed); }
+void set_recording_for_test(bool on) { g_recording.store(on); }
+std::vector<FinishedSpan> drain_spans_for_test() { return drain_spans(); }
+
+Span::Span(std::string name, const SpanContext* parent) : enabled_(recording()) {
+  if (!enabled_) return;
+  rec_.name = std::move(name);
+  std::string rand = util::random_hex32();
+  ctx_.trace_id = parent ? parent->trace_id : rand;
+  ctx_.span_id = rand.substr(16);
+  rec_.trace_id = ctx_.trace_id;
+  rec_.span_id = ctx_.span_id;
+  if (parent) rec_.parent_span_id = parent->span_id;
+  rec_.start_nanos = util::now_unix_nanos();
+}
+
+Span::~Span() {
+  if (!enabled_) return;
+  rec_.end_nanos = util::now_unix_nanos();
+  buffer_span(std::move(rec_));
+}
+
+void Span::attr(std::string key, std::string value) {
+  if (enabled_) rec_.str_attrs.emplace_back(std::move(key), std::move(value));
+}
+
+void Span::attr(std::string key, int64_t value) {
+  if (enabled_) rec_.int_attrs.emplace_back(std::move(key), value);
+}
+
+void Span::set_error(std::string message) {
+  if (!enabled_) return;
+  rec_.error = true;
+  rec_.error_message = std::move(message);
+}
 
 Exporter::Exporter(std::string endpoint, int interval_ms)
     : endpoint_(std::move(endpoint)),
       interval_ms_(interval_ms),
       start_unix_nanos_(util::now_unix() * 1000000000ll) {
   while (!endpoint_.empty() && endpoint_.back() == '/') endpoint_.pop_back();
+  g_recording.store(true);
   thread_ = std::thread([this] { loop(); });
-  log::info("OTLP metrics export to " + endpoint_ + "/v1/metrics every " +
+  log::info("OTLP metrics+trace export to " + endpoint_ + "/v1/{metrics,traces} every " +
             std::to_string(interval_ms_) + "ms");
 }
 
@@ -45,6 +113,7 @@ Exporter::~Exporter() {
     cv_.notify_all();
   }
   if (thread_.joinable()) thread_.join();
+  g_recording.store(false);
   export_once();  // shutdown flush (reference OtelGuard::drop, main.rs:262-271)
 }
 
@@ -61,14 +130,21 @@ void Exporter::loop() {
 }
 
 bool Exporter::export_once() {
-  int64_t now_nanos = util::now_unix() * 1000000000ll;
+  bool metrics_ok = export_metrics(util::now_unix_nanos());
+  bool traces_ok = export_traces();
+  return metrics_ok && traces_ok;
+}
+
+bool Exporter::export_metrics(int64_t now_nanos) {
   Value metrics = Value::array();
-  for (const auto& [name, value] : log::counters_snapshot()) {
+  for (const auto& [name, counter] : log::counters_snapshot()) {
     Value metric = Value::object();
     metric.set("name", Value("tpu_pruner." + name));
     Value points = Value::array();
-    points.push_back(data_point(value, start_unix_nanos_, now_nanos));
-    if (is_gauge(name)) {
+    points.push_back(data_point(counter.value, start_unix_nanos_, now_nanos));
+    // Kind fixed at the call site (the reference's monotonic_counter.* vs
+    // counter.* split, main.rs:300-321, 349-365).
+    if (counter.gauge) {
       Value gauge = Value::object();
       gauge.set("dataPoints", std::move(points));
       metric.set("gauge", std::move(gauge));
@@ -86,35 +162,82 @@ bool Exporter::export_once() {
   scope_metrics.set("scope", Value(json::Object{{"name", Value("tpu_pruner")}}));
   scope_metrics.set("metrics", std::move(metrics));
 
-  Value attr = Value::object();
-  attr.set("key", Value("service.name"));
-  attr.set("value", Value(json::Object{{"stringValue", Value("tpu-pruner")}}));
-  Value resource = Value::object();
-  resource.set("attributes", Value(json::Array{std::move(attr)}));
-
   Value rm = Value::object();
-  rm.set("resource", std::move(resource));
+  rm.set("resource", service_resource());
   rm.set("scopeMetrics", Value(json::Array{std::move(scope_metrics)}));
 
   Value body = Value::object();
   body.set("resourceMetrics", Value(json::Array{std::move(rm)}));
+  return post("/v1/metrics", body.dump());
+}
 
+bool Exporter::export_traces() {
+  std::vector<FinishedSpan> finished = drain_spans();
+  if (finished.empty()) return true;
+
+  Value spans = Value::array();
+  for (FinishedSpan& fs : finished) {
+    Value span = Value::object();
+    span.set("traceId", Value(std::move(fs.trace_id)));
+    span.set("spanId", Value(std::move(fs.span_id)));
+    if (!fs.parent_span_id.empty()) span.set("parentSpanId", Value(std::move(fs.parent_span_id)));
+    span.set("name", Value(std::move(fs.name)));
+    span.set("kind", Value(1));  // SPAN_KIND_INTERNAL
+    span.set("startTimeUnixNano", Value(std::to_string(fs.start_nanos)));
+    span.set("endTimeUnixNano", Value(std::to_string(fs.end_nanos)));
+    Value attrs = Value::array();
+    for (auto& [k, v] : fs.str_attrs) {
+      Value a = Value::object();
+      a.set("key", Value(std::move(k)));
+      a.set("value", Value(json::Object{{"stringValue", Value(std::move(v))}}));
+      attrs.push_back(std::move(a));
+    }
+    for (auto& [k, v] : fs.int_attrs) {
+      Value a = Value::object();
+      a.set("key", Value(std::move(k)));
+      a.set("value", Value(json::Object{{"intValue", Value(std::to_string(v))}}));
+      attrs.push_back(std::move(a));
+    }
+    span.set("attributes", std::move(attrs));
+    Value status = Value::object();
+    if (fs.error) {
+      status.set("code", Value(2));  // STATUS_CODE_ERROR
+      status.set("message", Value(std::move(fs.error_message)));
+    }
+    span.set("status", std::move(status));
+    spans.push_back(std::move(span));
+  }
+
+  Value scope_spans = Value::object();
+  scope_spans.set("scope", Value(json::Object{{"name", Value("tpu_pruner")}}));
+  scope_spans.set("spans", std::move(spans));
+
+  Value rs = Value::object();
+  rs.set("resource", service_resource());
+  rs.set("scopeSpans", Value(json::Array{std::move(scope_spans)}));
+
+  Value body = Value::object();
+  body.set("resourceSpans", Value(json::Array{std::move(rs)}));
+  return post("/v1/traces", body.dump());
+}
+
+bool Exporter::post(const std::string& path, const std::string& body_json) {
   try {
     http::Client client;
     http::Request req;
     req.method = "POST";
-    req.url = endpoint_ + "/v1/metrics";
+    req.url = endpoint_ + path;
     req.headers.push_back({"Content-Type", "application/json"});
-    req.body = body.dump();
+    req.body = body_json;
     req.timeout_ms = 5000;
     http::Response resp = client.request(req);
     if (resp.status < 200 || resp.status >= 300) {
-      log::warn("OTLP export got HTTP " + std::to_string(resp.status));
+      log::warn("OTLP export to " + path + " got HTTP " + std::to_string(resp.status));
       return false;
     }
     return true;
   } catch (const std::exception& e) {
-    log::warn(std::string("OTLP export failed: ") + e.what());
+    log::warn("OTLP export to " + path + " failed: " + e.what());
     return false;
   }
 }

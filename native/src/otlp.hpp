@@ -1,12 +1,15 @@
-// OTLP/HTTP metrics exporter (internal).
+// OTLP/HTTP metrics + trace exporter (internal).
 //
 // Reference analog: the optional `otel` cargo feature (gpu-pruner
-// main.rs:138-155, 194-271) pushing the six tracing-field counters over
-// OTLP gRPC, configured purely by OTEL_* env vars (README.md:79-98).
-// Here: the same counters pushed as OTLP/HTTP JSON (the spec's JSON
-// encoding of ExportMetricsServiceRequest) on a periodic background
-// thread. Enabled by OTEL_EXPORTER_OTLP_ENDPOINT (or the CLI flag);
-// interval from OTEL_METRIC_EXPORT_INTERVAL (ms, default 15000).
+// main.rs:138-155, 194-271) pushing OTLP gRPC span and metric exports —
+// the six tracing-field counters plus the #[tracing::instrument] spans on
+// the pipeline and actuators (main.rs:390; lib.rs:338, 388, 436, 516, 528,
+// 552) — configured purely by OTEL_* env vars (README.md:79-98).
+// Here: the same counters and spans pushed as OTLP/HTTP JSON (the spec's
+// JSON encoding of ExportMetricsServiceRequest / ExportTraceServiceRequest)
+// on a periodic background thread. Enabled by OTEL_EXPORTER_OTLP_ENDPOINT
+// (or the CLI flag); interval from OTEL_METRIC_EXPORT_INTERVAL (ms,
+// default 15000).
 #pragma once
 
 #include <atomic>
@@ -16,8 +19,56 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <utility>
+#include <vector>
 
 namespace tpupruner::otlp {
+
+// ── Trace spans ──────────────────────────────────────────────────────────
+//
+// Recording is process-global and off by default; the Exporter switches it
+// on for its lifetime, so instrumented code pays one relaxed atomic load
+// when telemetry is disabled. Finished spans land in a bounded buffer
+// (drops counted) drained by each export.
+
+struct SpanContext {
+  std::string trace_id;  // 32 hex chars
+  std::string span_id;   // 16 hex chars
+};
+
+struct FinishedSpan {
+  std::string name;
+  std::string trace_id, span_id, parent_span_id;
+  int64_t start_nanos = 0, end_nanos = 0;
+  std::vector<std::pair<std::string, std::string>> str_attrs;
+  std::vector<std::pair<std::string, int64_t>> int_attrs;
+  bool error = false;
+  std::string error_message;
+};
+
+// RAII span: starts at construction, finishes (and is buffered) at
+// destruction. A default-constructed parent starts a new trace.
+class Span {
+ public:
+  explicit Span(std::string name, const SpanContext* parent = nullptr);
+  ~Span();
+  Span(const Span&) = delete;
+  Span& operator=(const Span&) = delete;
+
+  void attr(std::string key, std::string value);
+  void attr(std::string key, int64_t value);
+  void set_error(std::string message);
+  const SpanContext& context() const { return ctx_; }
+
+ private:
+  bool enabled_;
+  FinishedSpan rec_;
+  SpanContext ctx_;
+};
+
+bool recording();                        // true while an Exporter is live
+void set_recording_for_test(bool on);    // test hook
+std::vector<FinishedSpan> drain_spans_for_test();
 
 class Exporter {
  public:
@@ -32,6 +83,9 @@ class Exporter {
 
  private:
   void loop();
+  bool export_metrics(int64_t now_nanos);
+  bool export_traces();
+  bool post(const std::string& path, const std::string& body_json);
   std::string endpoint_;
   int interval_ms_;
   std::atomic<bool> stop_{false};

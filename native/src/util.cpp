@@ -17,6 +17,12 @@ namespace tpupruner::util {
 
 int64_t now_unix() { return static_cast<int64_t>(::time(nullptr)); }
 
+int64_t now_unix_nanos() {
+  struct timespec ts;
+  ::clock_gettime(CLOCK_REALTIME, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000000ll + ts.tv_nsec;
+}
+
 std::string format_rfc3339(int64_t unix_secs, int64_t nanos, int subsec_digits) {
   std::tm tm{};
   time_t t = static_cast<time_t>(unix_secs);

@@ -1,0 +1,98 @@
+// Trace-span machinery units (reference analog: the #[tracing::instrument]
+// spans exported under the `otel` feature, gpu-pruner main.rs:194-221).
+#include "testing.hpp"
+
+#include "../src/otlp.hpp"
+
+using tpupruner::otlp::FinishedSpan;
+using tpupruner::otlp::Span;
+using tpupruner::otlp::SpanContext;
+
+namespace {
+
+// RAII recording toggle so a failing test can't poison the others.
+struct Recording {
+  Recording() {
+    tpupruner::otlp::set_recording_for_test(true);
+    tpupruner::otlp::drain_spans_for_test();
+  }
+  ~Recording() {
+    tpupruner::otlp::set_recording_for_test(false);
+    tpupruner::otlp::drain_spans_for_test();
+  }
+};
+
+}  // namespace
+
+TP_TEST(span_disabled_records_nothing) {
+  tpupruner::otlp::drain_spans_for_test();
+  {
+    Span s("noop");
+    s.attr("k", std::string("v"));
+  }
+  TP_CHECK(tpupruner::otlp::drain_spans_for_test().empty());
+}
+
+TP_TEST(span_ids_and_timing) {
+  Recording rec;
+  {
+    Span s("cycle");
+  }
+  auto spans = tpupruner::otlp::drain_spans_for_test();
+  TP_CHECK_EQ(spans.size(), 1u);
+  const FinishedSpan& fs = spans[0];
+  TP_CHECK_EQ(fs.name, "cycle");
+  TP_CHECK_EQ(fs.trace_id.size(), 32u);  // 16-byte trace id
+  TP_CHECK_EQ(fs.span_id.size(), 16u);   // 8-byte span id
+  TP_CHECK(fs.parent_span_id.empty());   // root span
+  TP_CHECK(fs.end_nanos >= fs.start_nanos);
+  TP_CHECK(fs.start_nanos > 1000000000ll * 1000000000ll);  // post-2001 wall clock
+  TP_CHECK(!fs.error);
+}
+
+TP_TEST(span_child_inherits_trace_and_parents) {
+  Recording rec;
+  {
+    Span parent("run_query_and_scale");
+    Span child("find_root_object", &parent.context());
+    TP_CHECK_EQ(child.context().trace_id, parent.context().trace_id);
+    TP_CHECK(child.context().span_id != parent.context().span_id);
+  }
+  auto spans = tpupruner::otlp::drain_spans_for_test();
+  TP_CHECK_EQ(spans.size(), 2u);  // child finishes first (reverse destruction)
+  const FinishedSpan& child = spans[0];
+  const FinishedSpan& parent = spans[1];
+  TP_CHECK_EQ(child.name, "find_root_object");
+  TP_CHECK_EQ(child.trace_id, parent.trace_id);
+  TP_CHECK_EQ(child.parent_span_id, parent.span_id);
+}
+
+TP_TEST(span_attrs_and_error_status) {
+  Recording rec;
+  {
+    Span s("scale");
+    s.attr("kind", std::string("JobSet"));
+    s.attr("shutdown_events", static_cast<int64_t>(7));
+    s.set_error("patch failed");
+  }
+  auto spans = tpupruner::otlp::drain_spans_for_test();
+  TP_CHECK_EQ(spans.size(), 1u);
+  const FinishedSpan& fs = spans[0];
+  TP_CHECK_EQ(fs.str_attrs.size(), 1u);
+  TP_CHECK_EQ(fs.str_attrs[0].first, "kind");
+  TP_CHECK_EQ(fs.str_attrs[0].second, "JobSet");
+  TP_CHECK_EQ(fs.int_attrs.size(), 1u);
+  TP_CHECK_EQ(fs.int_attrs[0].second, 7);
+  TP_CHECK(fs.error);
+  TP_CHECK_EQ(fs.error_message, "patch failed");
+}
+
+TP_TEST(span_buffer_caps_and_drains) {
+  Recording rec;
+  for (int i = 0; i < 5000; ++i) {
+    Span s("burst");
+  }
+  auto spans = tpupruner::otlp::drain_spans_for_test();
+  TP_CHECK_EQ(spans.size(), 4096u);  // cap, excess dropped not blocked
+  TP_CHECK(tpupruner::otlp::drain_spans_for_test().empty());
+}

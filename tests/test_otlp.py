@@ -85,9 +85,9 @@ def test_otlp_shutdown_flush_exports_counters(built, collector):
         prom.stop(); k8s.stop()
 
     # single-shot run: at least the shutdown flush must have arrived
-    assert collector.requests, "no OTLP export received"
-    path, body = collector.requests[-1]
-    assert path == "/v1/metrics"
+    metric_bodies = [b for p, b in collector.requests if p == "/v1/metrics"]
+    assert metric_bodies, "no OTLP metrics export received"
+    body = metric_bodies[-1]
     # resource attribution
     attrs = body["resourceMetrics"][0]["resource"]["attributes"]
     assert {"key": "service.name", "value": {"stringValue": "tpu-pruner"}} in attrs
@@ -103,6 +103,79 @@ def test_otlp_shutdown_flush_exports_counters(built, collector):
         "asInt"] == "1"
 
 
+def _spans_by_name(requests):
+    spans = {}
+    for path, body in requests:
+        if path != "/v1/traces":
+            continue
+        for rs in body["resourceSpans"]:
+            for ss in rs["scopeSpans"]:
+                for s in ss["spans"]:
+                    spans.setdefault(s["name"], []).append(s)
+    return spans
+
+
+def test_otlp_trace_spans_exported_with_parenting(built, collector):
+    """Span parity with the reference's instrumented pipeline (main.rs:390;
+    lib.rs:338, 436): cycle span, per-pod resolve children, scale spans."""
+    prom, k8s = FakePrometheus(), FakeK8s()
+    _, _, pods = k8s.add_deployment_chain("ml", "dep", num_pods=1)
+    prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    prom.start(); k8s.start()
+    try:
+        proc = run_cycle(prom, k8s, collector)
+        assert proc.returncode == 0, proc.stderr
+    finally:
+        prom.stop(); k8s.stop()
+
+    spans = _spans_by_name(collector.requests)
+    assert "run_query_and_scale" in spans, spans.keys()
+    cycle = spans["run_query_and_scale"][0]
+    attrs = {a["key"]: a["value"] for a in cycle["attributes"]}
+    assert attrs["num_pods"] == {"intValue": "1"}
+    assert attrs["shutdown_events"] == {"intValue": "1"}
+    assert "status" in cycle and "code" not in cycle["status"]  # OK status
+
+    # children share the cycle's trace and parent onto its span id
+    query_span = spans["prometheus.instant_query"][0]
+    assert query_span["traceId"] == cycle["traceId"]
+    assert query_span["parentSpanId"] == cycle["spanId"]
+    resolve = spans["find_root_object"][0]
+    assert resolve["traceId"] == cycle["traceId"]
+    assert resolve["parentSpanId"] == cycle["spanId"]
+
+    # actuation runs on the consumer task: its own trace, like the reference
+    scale = spans["scale"][0]
+    assert scale["traceId"] != cycle["traceId"]
+    sattrs = {a["key"]: a["value"] for a in scale["attributes"]}
+    assert sattrs["kind"] == {"stringValue": "Deployment"}
+
+    # every span is well-formed per OTLP/JSON
+    for name, ss in spans.items():
+        for s in ss:
+            assert len(s["traceId"]) == 32 and len(s["spanId"]) == 16, name
+            assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+            assert s["kind"] == 1
+
+
+def test_otlp_failed_cycle_span_carries_error_status(built, collector):
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.fail_requests_remaining = 10  # every query 500s; single-shot exits 1
+    prom.start(); k8s.start()
+    try:
+        proc = run_cycle(prom, k8s, collector)
+        assert proc.returncode == 1
+    finally:
+        prom.stop(); k8s.stop()
+
+    spans = _spans_by_name(collector.requests)
+    cycle = spans["run_query_and_scale"][0]
+    assert cycle["status"].get("code") == 2, cycle["status"]  # STATUS_CODE_ERROR
+    query_span = spans["prometheus.instant_query"][0]
+    assert query_span["status"].get("code") == 2
+    assert query_span["parentSpanId"] == cycle["spanId"]
+
+
 def test_otlp_env_var_enables_export(built, collector):
     prom, k8s = FakePrometheus(), FakeK8s()
     prom.start(); k8s.start()
@@ -116,8 +189,7 @@ def test_otlp_env_var_enables_export(built, collector):
         assert proc.returncode == 0, proc.stderr
     finally:
         prom.stop(); k8s.stop()
-    assert collector.requests
-    assert collector.requests[-1][0] == "/v1/metrics"
+    assert any(p == "/v1/metrics" for p, _ in collector.requests)
 
 
 def test_collector_failure_does_not_fail_daemon(built):
@@ -131,6 +203,6 @@ def test_collector_failure_does_not_fail_daemon(built):
              "--otlp-endpoint", "http://127.0.0.1:1"],  # nothing listening
             capture_output=True, text=True, timeout=60, env=env)
         assert proc.returncode == 0, proc.stderr
-        assert "OTLP export failed" in proc.stderr
+        assert "failed" in proc.stderr  # export warning logged, daemon unaffected
     finally:
         prom.stop(); k8s.stop()
