@@ -56,6 +56,8 @@ struct Cli {
   int64_t scale_concurrency = 8;          // --scale-concurrency (ref: serial consumer)
   int metrics_port = 0;                   // --metrics-port (>0 serves /metrics)
   std::string otlp_endpoint;              // --otlp-endpoint (default: $OTEL_EXPORTER_OTLP_ENDPOINT)
+  std::string gcp_project;                // --gcp-project (Cloud Monitoring PromQL API)
+  std::string monitoring_endpoint = "https://monitoring.googleapis.com";  // --monitoring-endpoint
 
   bool dry_run() const { return run_mode != "scale-down"; }
 };
@@ -68,5 +70,12 @@ std::string usage();
 
 query::QueryArgs to_query_args(const Cli& cli);
 log::Format log_format_of(const Cli& cli);
+
+// Effective PromQL base URL: --prometheus-url verbatim, or (GKE-native)
+// the Cloud Monitoring PromQL API for --gcp-project —
+// <monitoring-endpoint>/v1/projects/<p>/location/global/prometheus — to
+// which prom::Client appends /api/v1/query. Auth rides the same bearer
+// chain (Workload Identity metadata-server tokens in-cluster).
+std::string prometheus_base(const Cli& cli);
 
 }  // namespace tpupruner::cli

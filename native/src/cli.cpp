@@ -70,7 +70,7 @@ FLAGS:
       --power-threshold <W>     GPU power corroboration threshold (device=gpu)
   -r, --run-mode <MODE>         scale-down | dry-run [default: dry-run]
       --honor-labels            scrape config uses honorLabels: true
-      --prometheus-url <URL>    metric-plane query endpoint (required)
+      --prometheus-url <URL>    metric-plane query endpoint (this or --gcp-project required)
       --prometheus-token <TOK>  bearer token; default: auth chain (env →
                                 SA token → kubeconfig → GCE metadata → gcloud)
       --prometheus-tls-mode <M> verify | skip [default: verify]
@@ -89,6 +89,11 @@ TPU FLAGS:
       --metrics-port <P>        serve Prometheus /metrics on this port
       --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
                                 [default: $OTEL_EXPORTER_OTLP_ENDPOINT]
+      --gcp-project <ID>        query the Cloud Monitoring PromQL API for this
+                                project instead of --prometheus-url (GKE-native;
+                                auth via Workload Identity / ADC)
+      --monitoring-endpoint <U> Cloud Monitoring API base
+                                [default: https://monitoring.googleapis.com]
   -h, --help                    print this help
 )";
 }
@@ -155,6 +160,8 @@ Cli parse(int argc, char** argv) {
            throw CliError("--metrics-port out of range");
        }},
       {"--otlp-endpoint", [&](const std::string& v) { cli.otlp_endpoint = v; }},
+      {"--gcp-project", [&](const std::string& v) { cli.gcp_project = v; }},
+      {"--monitoring-endpoint", [&](const std::string& v) { cli.monitoring_endpoint = v; }},
   };
   std::map<std::string, std::string> shorts = {
       {"-t", "--duration"},       {"-e", "--enabled-resources"},
@@ -195,8 +202,11 @@ Cli parse(int argc, char** argv) {
     handler->second(value);
   }
 
-  if (cli.prometheus_url.empty()) {
-    throw CliError("--prometheus-url is required (see --help)");
+  if (cli.prometheus_url.empty() && cli.gcp_project.empty()) {
+    throw CliError("--prometheus-url or --gcp-project is required (see --help)");
+  }
+  if (!cli.prometheus_url.empty() && !cli.gcp_project.empty()) {
+    throw CliError("--prometheus-url and --gcp-project are mutually exclusive");
   }
   if (cli.duration < 1) throw CliError("--duration must be >= 1 minute");
   if (cli.check_interval < 1) throw CliError("--check-interval must be >= 1 second");
@@ -224,6 +234,13 @@ log::Format log_format_of(const Cli& cli) {
   if (cli.log_format == "json") return log::Format::Json;
   if (cli.log_format == "pretty") return log::Format::Pretty;
   return log::Format::Default;
+}
+
+std::string prometheus_base(const Cli& cli) {
+  if (!cli.prometheus_url.empty()) return cli.prometheus_url;
+  std::string base = cli.monitoring_endpoint;
+  while (!base.empty() && base.back() == '/') base.pop_back();
+  return base + "/v1/projects/" + cli.gcp_project + "/location/global/prometheus";
 }
 
 }  // namespace tpupruner::cli

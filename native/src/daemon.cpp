@@ -74,7 +74,7 @@ prom::Client build_prom_client(const cli::Cli& args) {
   }
   http::TlsMode tls =
       args.prometheus_tls_mode == "skip" ? http::TlsMode::Skip : http::TlsMode::Verify;
-  return prom::Client(args.prometheus_url, token, tls, args.prometheus_tls_cert);
+  return prom::Client(cli::prometheus_base(args), token, tls, args.prometheus_tls_cert);
 }
 
 struct ResolveOutcome {
@@ -161,41 +161,30 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   return out;
 }
 
-}  // namespace
-
-static CycleStats run_cycle_inner(const cli::Cli& args, const std::string& query,
-                                  const k8s::Client& kube,
-                                  const std::function<void(ScaleTarget)>& enqueue,
-                                  otlp::Span& cycle);
-
-CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
-                     const std::function<void(ScaleTarget)>& enqueue) {
-  // Cycle span (reference #[tracing::instrument] on run_query_and_scale,
-  // main.rs:390); children below mirror the instrumented callees. A throw
-  // out of the cycle marks the span before it unwinds so failed cycles
-  // export with error status.
-  otlp::Span cycle("run_query_and_scale");
+// Runs `fn`, marking `span` with error status if it throws (the reference
+// exports #[tracing::instrument] spans whose status reflects the Result).
+template <typename Fn>
+static auto with_span(otlp::Span& span, Fn&& fn) -> decltype(fn()) {
   try {
-    return run_cycle_inner(args, query, kube, enqueue, cycle);
+    return fn();
   } catch (const std::exception& e) {
-    cycle.set_error(e.what());
+    span.set_error(e.what());
     throw;
   }
 }
 
-static CycleStats run_cycle_inner(const cli::Cli& args, const std::string& query,
-                                  const k8s::Client& kube,
-                                  const std::function<void(ScaleTarget)>& enqueue,
-                                  otlp::Span& cycle) {
+}  // namespace
+
+CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
+                     const std::function<void(ScaleTarget)>& enqueue) {
+  // Cycle span (reference #[tracing::instrument] on run_query_and_scale,
+  // main.rs:390); children below mirror the instrumented callees.
+  otlp::Span cycle("run_query_and_scale");
+  return with_span(cycle, [&] {
   prom::Client prom_client = build_prom_client(args);
   json::Value response = [&] {
     otlp::Span span("prometheus.instant_query", &cycle.context());
-    try {
-      return prom_client.instant_query(query);
-    } catch (const std::exception& e) {
-      span.set_error(e.what());
-      throw;
-    }
+    return with_span(span, [&] { return prom_client.instant_query(query); });
   }();
 
   metrics::DecodeResult decoded = metrics::decode_instant_vector(response, args.device);
@@ -226,14 +215,11 @@ static CycleStats run_cycle_inner(const cli::Cli& args, const std::string& query
     if (!group_targets.empty()) {
       otlp::Span span("groups_fully_idle", &cycle.context());
       span.attr("groups", static_cast<int64_t>(group_targets.size()));
-      try {
+      with_span(span, [&] {
         std::vector<char> verdicts =
             walker::groups_fully_idle(kube, group_targets, resolved.idle_pods);
         for (size_t j = 0; j < group_indices.size(); ++j) keep[group_indices[j]] = verdicts[j];
-      } catch (const std::exception& e) {
-        span.set_error(e.what());
-        throw;
-      }
+      });
     }
   }
   std::vector<ScaleTarget> survivors;
@@ -261,6 +247,7 @@ static CycleStats run_cycle_inner(const cli::Cli& args, const std::string& query
     }
   }
   return stats;
+  });
 }
 
 int run(const cli::Cli& args) {

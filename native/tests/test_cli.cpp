@@ -1,0 +1,55 @@
+// CLI parse + metric-plane endpoint resolution units (reference analog:
+// clap derive validation on struct Cli, gpu-pruner main.rs:46-119).
+#include "testing.hpp"
+
+#include <vector>
+
+#include "tpupruner/cli.hpp"
+
+using tpupruner::cli::Cli;
+using tpupruner::cli::CliError;
+
+namespace {
+
+Cli parse(std::vector<const char*> argv) {
+  argv.insert(argv.begin(), "tpu-pruner");
+  return tpupruner::cli::parse(static_cast<int>(argv.size()),
+                               const_cast<char**>(argv.data()));
+}
+
+bool parse_fails(std::vector<const char*> argv, const std::string& needle) {
+  try {
+    parse(std::move(argv));
+  } catch (const CliError& e) {
+    return std::string(e.what()).find(needle) != std::string::npos;
+  }
+  return false;
+}
+
+}  // namespace
+
+TP_TEST(cli_requires_some_metric_plane) {
+  TP_CHECK(parse_fails({}, "--prometheus-url or --gcp-project"));
+}
+
+TP_TEST(cli_prometheus_url_and_gcp_project_exclusive) {
+  TP_CHECK(parse_fails({"--prometheus-url", "http://p:9090", "--gcp-project", "proj"},
+                       "mutually exclusive"));
+}
+
+TP_TEST(cli_prometheus_url_used_verbatim) {
+  Cli cli = parse({"--prometheus-url", "http://thanos:9091"});
+  TP_CHECK_EQ(tpupruner::cli::prometheus_base(cli), "http://thanos:9091");
+}
+
+TP_TEST(cli_gcp_project_resolves_cloud_monitoring_base) {
+  Cli cli = parse({"--gcp-project", "ml-prod"});
+  TP_CHECK_EQ(tpupruner::cli::prometheus_base(cli),
+              "https://monitoring.googleapis.com/v1/projects/ml-prod/location/global/prometheus");
+}
+
+TP_TEST(cli_monitoring_endpoint_override) {
+  Cli cli = parse({"--gcp-project", "p1", "--monitoring-endpoint", "http://127.0.0.1:9/"});
+  TP_CHECK_EQ(tpupruner::cli::prometheus_base(cli),
+              "http://127.0.0.1:9/v1/projects/p1/location/global/prometheus");
+}

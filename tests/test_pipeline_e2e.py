@@ -287,6 +287,40 @@ def test_bearer_token_sent_to_prometheus(built, fake_prom, fake_k8s):
     assert fake_prom.auth_headers == ["Bearer prom-token"]
 
 
+def test_gcp_project_routes_to_cloud_monitoring_promql_api(built, fake_prom, fake_k8s):
+    """--gcp-project targets the Cloud Monitoring PromQL API path shape
+    (the GKE-native metric plane of the BASELINE north star) with the same
+    bearer-auth wire protocol; the full pipeline still lands the patch."""
+    dep, rs, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    cmd = [
+        str(DAEMON_PATH),
+        "--gcp-project", "ml-prod",
+        "--monitoring-endpoint", fake_prom.url,
+        "--run-mode", "scale-down",
+    ]
+    env = {"KUBE_API_URL": fake_k8s.url, "PROMETHEUS_TOKEN": "adc-token",
+           "PATH": "/usr/bin:/bin"}
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=60, env=env)
+    assert proc.returncode == 0, proc.stderr
+
+    assert fake_prom.query_paths == [
+        "/v1/projects/ml-prod/location/global/prometheus/api/v1/query"
+    ]
+    assert fake_prom.auth_headers == ["Bearer adc-token"]
+    assert fake_k8s.objects["/apis/apps/v1/namespaces/ml/deployments/trainer"]["spec"][
+        "replicas"] == 0
+
+
+def test_prometheus_url_and_gcp_project_are_mutually_exclusive(built, fake_prom, fake_k8s):
+    proc = subprocess.run(
+        [str(DAEMON_PATH), "--prometheus-url", fake_prom.url, "--gcp-project", "p"],
+        capture_output=True, text=True, timeout=60, env={"PATH": "/usr/bin:/bin"})
+    assert proc.returncode != 0
+    assert "mutually exclusive" in proc.stderr
+
+
 def test_tpu_query_reaches_prometheus(built, fake_prom, fake_k8s):
     run_pruner(fake_prom, fake_k8s, "--duration", "45", "--hbm-threshold", "0.05")
     assert len(fake_prom.queries) == 1

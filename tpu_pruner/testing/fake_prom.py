@@ -18,6 +18,7 @@ class FakePrometheus:
     def __init__(self):
         self.series: list[dict] = []
         self.queries: list[str] = []
+        self.query_paths: list[str] = []  # full request paths (Cloud Monitoring prefix checks)
         self.auth_headers: list[str | None] = []
         self.fail_requests_remaining = 0
         self.fail_status = 500
@@ -102,10 +103,14 @@ class FakePrometheus:
                 self.wfile.write(body)
 
             def do_POST(self):
+                # Accept both the vanilla path and the Cloud Monitoring
+                # PromQL API shape (/v1/projects/<p>/location/global/
+                # prometheus/api/v1/query) — same wire protocol.
                 parsed = urlparse(self.path)
-                if parsed.path != "/api/v1/query":
+                if not parsed.path.endswith("/api/v1/query"):
                     self._respond(404, {"status": "error", "error": "not found"})
                     return
+                fake.query_paths.append(parsed.path)
                 length = int(self.headers.get("Content-Length", "0"))
                 body = self.rfile.read(length).decode()
                 query = parse_qs(body).get("query", [""])[0]
@@ -113,9 +118,10 @@ class FakePrometheus:
 
             def do_GET(self):
                 parsed = urlparse(self.path)
-                if parsed.path != "/api/v1/query":
+                if not parsed.path.endswith("/api/v1/query"):
                     self._respond(404, {"status": "error", "error": "not found"})
                     return
+                fake.query_paths.append(parsed.path)
                 query = parse_qs(parsed.query).get("query", [""])[0]
                 self._handle_query(query)
 
