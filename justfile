@@ -16,6 +16,21 @@ test-unit: build
 test-e2e: build
     python -m pytest tests/ -q -k "pipeline or querytest or auth or tls or otlp"
 
+# live-cluster tier (reference analog: just kind-create / test-e2e running
+# the #[ignore]-gated tests/e2e.rs against a throwaway kind cluster)
+kind-create:
+    kind create cluster --name tpu-pruner
+    kubectl apply -f hack/kind/crds.yaml
+    kubectl wait --for condition=established --timeout=60s \
+        crd/jobsets.jobset.x-k8s.io crd/leaderworkersets.leaderworkerset.x-k8s.io \
+        crd/notebooks.kubeflow.org crd/inferenceservices.serving.kserve.io
+
+kind-delete:
+    kind delete cluster --name tpu-pruner
+
+test-e2e-kind: build
+    TP_E2E_KIND=1 python -m pytest tests/e2e_kind -q
+
 # sanitizer builds (the race/memory tier the reference lacks, SURVEY.md §5)
 test-asan:
     cmake -G Ninja -S . -B build-asan -DTP_SANITIZE=ON && cmake --build build-asan

@@ -1,0 +1,339 @@
+"""kind-cluster e2e harness (reference analog: tests/e2e.rs, `#[ignore]`-
+gated, run via `just test-e2e` against a throwaway kind cluster).
+
+Gate: set TP_E2E_KIND=1 with a kind (or any) cluster reachable through the
+current kubeconfig, CRDs from hack/kind/crds.yaml applied (`just
+kind-create` does both). The real daemon binary runs the FULL pipeline:
+a local fake Prometheus serves idle series for real pod names, the K8s
+side is the live API server reached through `kubectl proxy` (the binary's
+KUBE_API_URL path — kind kubeconfigs use client certs the daemon
+deliberately doesn't implement).
+
+Age-gate handling: pods must be older than duration+grace (min 60 s with
+--duration 1 --grace-period 0). All workloads are created once in a
+session fixture; a single wait covers every test (reference e2e avoids
+this only because it calls library functions directly, skipping the gate).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from tpu_pruner.testing import FakePrometheus  # noqa: E402
+
+
+HERE = Path(__file__).resolve().parent
+
+
+def pytest_collection_modifyitems(items):
+    # This hook sees the whole session's items; gate only this directory.
+    if os.environ.get("TP_E2E_KIND"):
+        return
+    skip = pytest.mark.skip(
+        reason="live-cluster e2e (set TP_E2E_KIND=1 with a kind cluster + CRDs)")
+    for item in items:
+        if HERE in Path(str(item.fspath)).resolve().parents:
+            item.add_marker(skip)
+
+E2E_NS = "tpu-pruner-e2e"
+PAUSE_IMAGE = "registry.k8s.io/pause:3.9"
+
+
+def kubectl(*args, input_json=None, check=True):
+    cmd = ["kubectl", *args]
+    proc = subprocess.run(
+        cmd,
+        input=json.dumps(input_json) if input_json is not None else None,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    if check and proc.returncode != 0:
+        raise RuntimeError(f"{' '.join(cmd)} failed:\n{proc.stdout}\n{proc.stderr}")
+    return proc
+
+
+def kubectl_json(*args):
+    return json.loads(kubectl(*args, "-o", "json").stdout)
+
+
+def apply(manifest: dict):
+    kubectl("apply", "-f", "-", input_json=manifest)
+
+
+def pod_names(selector: str) -> list[str]:
+    out = kubectl_json("get", "pods", "-n", E2E_NS, "-l", selector)
+    return [p["metadata"]["name"] for p in out["items"]]
+
+
+def wait_pods_running(selector: str, count: int, timeout=180):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = kubectl_json("get", "pods", "-n", E2E_NS, "-l", selector)
+        running = [p for p in out["items"] if p["status"].get("phase") == "Running"]
+        if len(running) >= count:
+            return
+        time.sleep(3)
+    raise RuntimeError(f"pods {selector} not running after {timeout}s")
+
+
+def pause_container(name="main", tpu: int = 0) -> dict:
+    c = {"name": name, "image": PAUSE_IMAGE}
+    if tpu:
+        c["resources"] = {"limits": {"google.com/tpu": str(tpu)}}
+    return c
+
+
+@pytest.fixture(scope="session")
+def cluster():
+    """Namespace + all test workloads, created once; yields creation time."""
+    # fake google.com/tpu capacity on every node so TPU-requesting pods
+    # schedule (SURVEY.md §2 #15: "kind-based e2e with fake TPU pods")
+    nodes = kubectl_json("get", "nodes")
+    for node in nodes["items"]:
+        kubectl(
+            "patch", "node", node["metadata"]["name"], "--subresource=status",
+            "--type=merge", "-p",
+            json.dumps({"status": {"capacity": {"google.com/tpu": "16"},
+                                   "allocatable": {"google.com/tpu": "16"}}}),
+        )
+
+    kubectl("delete", "namespace", E2E_NS, "--ignore-not-found", "--wait=true")
+    kubectl("create", "namespace", E2E_NS)
+    created = time.time()
+
+    # 1. Deployment chain (Pod → RS → Deployment), 2 pods for uid dedup
+    apply({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "trainer", "namespace": E2E_NS},
+        "spec": {
+            "replicas": 2,
+            "selector": {"matchLabels": {"app": "trainer"}},
+            "template": {
+                "metadata": {"labels": {"app": "trainer"}},
+                "spec": {"containers": [pause_container(tpu=1)]},
+            },
+        },
+    })
+
+    # 2. Bare StatefulSet (resolves to itself)
+    apply({
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {"name": "ss-plain", "namespace": E2E_NS},
+        "spec": {
+            "replicas": 1, "serviceName": "ss-plain",
+            "selector": {"matchLabels": {"app": "ss-plain"}},
+            "template": {
+                "metadata": {"labels": {"app": "ss-plain"}},
+                "spec": {"containers": [pause_container()]},
+            },
+        },
+    })
+
+    # 3. Notebook CR owning a StatefulSet (Pod → SS → Notebook)
+    apply({
+        "apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+        "metadata": {"name": "nb1", "namespace": E2E_NS},
+        "spec": {"template": {}},
+    })
+    nb = kubectl_json("get", "notebook", "nb1", "-n", E2E_NS)
+    apply({
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {
+            "name": "nb1", "namespace": E2E_NS,
+            "ownerReferences": [{
+                "apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+                "name": "nb1", "uid": nb["metadata"]["uid"],
+            }],
+        },
+        "spec": {
+            "replicas": 1, "serviceName": "nb1",
+            "selector": {"matchLabels": {"app": "nb1"}},
+            "template": {
+                "metadata": {"labels": {"app": "nb1"}},
+                "spec": {"containers": [pause_container()]},
+            },
+        },
+    })
+
+    # 4. JobSet CR owning a Job with 2 TPU worker pods (Pod → Job → JobSet);
+    #    the controller-managed labels are set on the template by hand (no
+    #    JobSet controller in a bare kind cluster)
+    apply({
+        "apiVersion": "jobset.x-k8s.io/v1alpha2", "kind": "JobSet",
+        "metadata": {"name": "slice", "namespace": E2E_NS},
+        "spec": {"suspend": False, "replicatedJobs": []},
+    })
+    js = kubectl_json("get", "jobset", "slice", "-n", E2E_NS)
+    apply({
+        "apiVersion": "batch/v1", "kind": "Job",
+        "metadata": {
+            "name": "slice-workers-0", "namespace": E2E_NS,
+            "ownerReferences": [{
+                "apiVersion": "jobset.x-k8s.io/v1alpha2", "kind": "JobSet",
+                "name": "slice", "uid": js["metadata"]["uid"],
+            }],
+        },
+        "spec": {
+            "parallelism": 2, "completions": 2,
+            "template": {
+                "metadata": {"labels": {"jobset.sigs.k8s.io/jobset-name": "slice"}},
+                "spec": {
+                    "restartPolicy": "Never",
+                    "containers": [pause_container(tpu=4)],
+                },
+            },
+        },
+    })
+
+    # 5. LeaderWorkerSet CR + bare labeled TPU pods (label shortcut path)
+    apply({
+        "apiVersion": "leaderworkerset.x-k8s.io/v1", "kind": "LeaderWorkerSet",
+        "metadata": {"name": "serve-group", "namespace": E2E_NS},
+        "spec": {"replicas": 1, "leaderWorkerTemplate": {}},
+    })
+    for i in range(2):
+        apply({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": f"serve-group-0-{i}", "namespace": E2E_NS,
+                "labels": {"leaderworkerset.sigs.k8s.io/name": "serve-group"},
+            },
+            "spec": {"containers": [pause_container(tpu=4)]},
+        })
+
+    # 6. InferenceService CR + Deployment whose pods carry the kserve label
+    apply({
+        "apiVersion": "serving.kserve.io/v1beta1", "kind": "InferenceService",
+        "metadata": {"name": "llm", "namespace": E2E_NS},
+        "spec": {"predictor": {"minReplicas": 1, "model": {}}},
+    })
+    apply({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "llm-predictor", "namespace": E2E_NS},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "llm-predictor"}},
+            "template": {
+                "metadata": {"labels": {
+                    "app": "llm-predictor",
+                    "serving.kserve.io/inferenceservice": "llm",
+                }},
+                "spec": {"containers": [pause_container(tpu=1)]},
+            },
+        },
+    })
+
+    # 7. Orphan pod (no owners, no shortcut labels)
+    apply({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "orphan", "namespace": E2E_NS},
+        "spec": {"containers": [pause_container()]},
+    })
+
+    # 8. Dry-run victim (never scaled; its pods must outlive the others)
+    apply({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "dryrun-dep", "namespace": E2E_NS},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "dryrun-dep"}},
+            "template": {
+                "metadata": {"labels": {"app": "dryrun-dep"}},
+                "spec": {"containers": [pause_container(tpu=1)]},
+            },
+        },
+    })
+
+    wait_pods_running("app=trainer", 2)
+    wait_pods_running("app=ss-plain", 1)
+    wait_pods_running("app=nb1", 1)
+    wait_pods_running("jobset.sigs.k8s.io/jobset-name=slice", 2)
+    wait_pods_running("leaderworkerset.sigs.k8s.io/name=serve-group", 2)
+    wait_pods_running("app=llm-predictor", 1)
+    wait_pods_running("app=dryrun-dep", 1)
+
+    yield {"created": created}
+
+    kubectl("delete", "namespace", E2E_NS, "--ignore-not-found", "--wait=false")
+
+
+@pytest.fixture(scope="session")
+def kube_proxy():
+    """kubectl proxy — plaintext localhost API for the daemon's KUBE_API_URL."""
+    proc = subprocess.Popen(
+        ["kubectl", "proxy", "--port=0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    line = proc.stdout.readline()
+    m = re.search(r"127\.0\.0\.1:(\d+)", line)
+    if not m:
+        proc.kill()
+        raise RuntimeError(f"kubectl proxy gave no port: {line!r}")
+    yield f"http://127.0.0.1:{m.group(1)}"
+    proc.kill()
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture(scope="session")
+def daemon_path():
+    from tpu_pruner import native
+
+    native.ensure_built()
+    return native.DAEMON_PATH
+
+
+@pytest.fixture()
+def run_pruner(cluster, kube_proxy, fake_prom, daemon_path):
+    """Callable running one single-shot scale-down cycle; waits out the
+    age gate (duration 1 min + grace 0) once per session."""
+
+    def _run(*extra_args, check=True):
+        remaining = cluster["created"] + 70 - time.time()
+        if remaining > 0:
+            time.sleep(remaining)
+        env = {"KUBE_API_URL": kube_proxy, "PROMETHEUS_TOKEN": "t",
+               "PATH": os.environ.get("PATH", "/usr/bin:/bin")}
+        proc = subprocess.run(
+            [str(daemon_path), "--prometheus-url", fake_prom.url,
+             "--run-mode", "scale-down", "--duration", "1", "--grace-period", "0",
+             *extra_args],
+            capture_output=True, text=True, timeout=120, env=env)
+        if check:
+            assert proc.returncode == 0, f"pruner failed:\n{proc.stdout}\n{proc.stderr}"
+        return proc
+
+    return _run
+
+
+@pytest.fixture()
+def events():
+    """Callable returning current tpupruner-* Events in the e2e namespace."""
+
+    def _events(kind=None, name=None):
+        out = kubectl_json("get", "events", "-n", E2E_NS)
+        evs = [e for e in out["items"]
+               if e["metadata"]["name"].startswith("tpupruner-")]
+        if kind:
+            evs = [e for e in evs if e["involvedObject"]["kind"] == kind]
+        if name:
+            evs = [e for e in evs if e["involvedObject"]["name"] == name]
+        return evs
+
+    return _events
