@@ -170,17 +170,21 @@ def tpu_fleet_eval():
         idle_fraction=0.5,
     )
     platform = jax.devices()[0].platform
-    run = lambda: jax.block_until_ready(
-        evaluate_fleet(*inputs, num_slices=num_slices))
-    t0 = time.monotonic()
-    run()
-    compile_s = time.monotonic() - t0
-    iters = 20
-    t0 = time.monotonic()
-    for _ in range(iters):
+
+    def measure(fn):
+        run = lambda: jax.block_until_ready(fn(*inputs, num_slices=num_slices))
+        t0 = time.monotonic()
         run()
-    per_cycle = (time.monotonic() - t0) / iters
-    return {
+        compile_s = time.monotonic() - t0
+        iters = 20
+        t0 = time.monotonic()
+        for _ in range(iters):
+            run()
+        per_cycle = (time.monotonic() - t0) / iters
+        return per_cycle, compile_s
+
+    per_cycle, compile_s = measure(evaluate_fleet)
+    result = {
         "platform": platform,
         "chips_per_s": num_chips / per_cycle,
         "cycle_ms": per_cycle * 1000,
@@ -188,6 +192,18 @@ def tpu_fleet_eval():
         "fleet_chips": num_chips,
         "samples_per_chip": num_samples,
     }
+    # Pallas variant of the chip pass (guaranteed single-pass fusion; real
+    # Mosaic compile on TPU, skipped errors fall back to the XLA number).
+    try:
+        from tpu_pruner.policy import evaluate_fleet_pallas
+
+        pal_cycle, pal_compile = measure(evaluate_fleet_pallas)
+        result["pallas_chips_per_s"] = num_chips / pal_cycle
+        result["pallas_cycle_ms"] = pal_cycle * 1000
+        result["pallas_compile_s"] = pal_compile
+    except Exception as e:
+        result["pallas_error"] = str(e)[:200]
+    return result
 
 
 def main():

@@ -151,3 +151,52 @@ def test_sharded_cross_shard_veto():
               (jnp.asarray(tc), *inputs[1:5])]
     verdicts, _ = sharded_eval(*placed, inputs[5])
     assert not bool(verdicts[0])
+
+
+# ── pallas kernel parity (interpret mode on CPU; Mosaic on TPU) ──────────
+
+
+def test_pallas_matches_engine_random():
+    """evaluate_fleet_pallas ≡ evaluate_fleet on a random fleet with scrape
+    gaps, all-invalid rows, HBM rescues, and young pods — including the
+    chip-padding path (C not a block multiple)."""
+    from tpu_pruner.policy import evaluate_fleet, evaluate_fleet_pallas
+
+    rng = np.random.default_rng(7)
+    C, T, S = 200, 24, 9  # C=200: pads to 256 with block_c=128
+    tc = (rng.uniform(size=(C, T)) < 0.5).astype(np.float32) * rng.uniform(size=(C, T))
+    hbm = rng.uniform(0, 0.2, size=(C, T)).astype(np.float32)
+    valid = rng.uniform(size=(C, T)) < 0.9
+    valid[:5] = False  # absent series: never candidates
+    age = rng.uniform(0, 4000, size=C).astype(np.float32)
+    slice_id = rng.integers(0, S, size=C).astype(np.int32)
+    params = params_array(PolicyParams(lookback_s=2100, hbm_threshold=0.05))
+
+    args = (jnp.asarray(tc), jnp.asarray(hbm), jnp.asarray(valid),
+            jnp.asarray(age), jnp.asarray(slice_id), params)
+    ref_v, ref_c = evaluate_fleet(*args, num_slices=S)
+    pal_v, pal_c = evaluate_fleet_pallas(*args, num_slices=S)
+    np.testing.assert_array_equal(np.asarray(pal_c), np.asarray(ref_c))
+    np.testing.assert_array_equal(np.asarray(pal_v), np.asarray(ref_v))
+
+
+def test_pallas_disabled_hbm_threshold_inf_cutoff():
+    """PolicyParams() disables corroboration via an inf cutoff; the kernel
+    must never rescue a chip then."""
+    from tpu_pruner.policy import evaluate_fleet_pallas
+
+    inputs, expected = make_example_fleet(num_chips=128, num_slices=8,
+                                          idle_fraction=0.5)
+    verdicts, _ = evaluate_fleet_pallas(*inputs, num_slices=8)
+    np.testing.assert_array_equal(np.asarray(verdicts), expected)
+
+
+def test_pallas_small_block_exercises_grid():
+    """block_c=8 (f32 sublane minimum) forces a multi-step grid."""
+    from tpu_pruner.policy import evaluate_fleet, evaluate_fleet_pallas
+
+    inputs, _ = make_example_fleet(num_chips=64, num_slices=4, idle_fraction=0.25)
+    ref_v, ref_c = evaluate_fleet(*inputs, num_slices=4)
+    pal_v, pal_c = evaluate_fleet_pallas(*inputs, num_slices=4, block_c=8)
+    np.testing.assert_array_equal(np.asarray(pal_c), np.asarray(ref_c))
+    np.testing.assert_array_equal(np.asarray(pal_v), np.asarray(ref_v))

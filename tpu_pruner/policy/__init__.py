@@ -22,7 +22,6 @@ from tpu_pruner.policy.engine import (
     make_sharded_evaluator,
     slice_verdicts,
 )
-
 __all__ = [
     "PolicyParams",
     "evaluate_chips",
@@ -31,3 +30,15 @@ __all__ = [
     "make_sharded_evaluator",
     "slice_verdicts",
 ]
+
+# Pallas is optional: jax builds without jax.experimental.pallas.tpu must
+# still serve the XLA engine (bench baseline, tpu_pruner.analyze).
+try:
+    from tpu_pruner.policy.pallas_engine import (
+        evaluate_chips_pallas,
+        evaluate_fleet_pallas,
+    )
+
+    __all__ += ["evaluate_chips_pallas", "evaluate_fleet_pallas"]
+except ImportError:  # pragma: no cover - depends on the jax build
+    pass

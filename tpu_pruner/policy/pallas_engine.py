@@ -1,0 +1,110 @@
+"""Pallas TPU kernel for the per-chip idle-verdict pass.
+
+The fleet evaluation's hot op is a streaming reduction over the
+``[chips, samples]`` metric tensors (tpu_pruner/policy/engine.py
+``evaluate_chips``): every byte of tc/hbm/valid is read exactly once and
+reduced to one mask bit per chip — pure HBM-bandwidth-bound VPU work. XLA
+already fuses this well; the Pallas kernel makes the fusion explicit and
+guaranteed: one pass over a ``[block_c, T]`` VMEM tile computes both peaks,
+the validity reduction, and the age/corroboration gates, writing a single
+``int32`` verdict column. No MXU involvement — this is deliberately a
+VPU/bandwidth kernel (pallas_guide.md: elementwise → VPU).
+
+The slice segment-reduction stays in XLA (``segment_sum`` maps to one
+scatter-add; nothing to win in Pallas at ``num_slices << num_chips``).
+
+CPU tests run the same kernel in interpret mode (the default when the
+backend is CPU), so the kernel body is covered hermetically; the real
+Mosaic compile path runs on TPU (bench.py exercises it).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .engine import slice_verdicts
+
+
+def _chip_kernel(tc_ref, hbm_ref, valid_ref, age_ref, params_ref, out_ref):
+    """One chip-block: fused peaks + gates → int32 candidate column.
+
+    params_ref (SMEM, [1,2]): [lookback_s, hbm_cutoff] — scalars kept out
+    of VMEM so parameter changes never re-tile the tensor operands.
+    """
+    valid = valid_ref[:] != 0
+    neg = jnp.float32(-1.0)
+    peak_tc = jnp.max(jnp.where(valid, tc_ref[:], neg), axis=1, keepdims=True)
+    peak_hbm = jnp.max(jnp.where(valid, hbm_ref[:], neg), axis=1, keepdims=True)
+    has_data = jnp.max(valid.astype(jnp.float32), axis=1, keepdims=True) > 0.0
+
+    lookback = params_ref[0, 0]
+    cutoff = params_ref[0, 1]
+    idle = (peak_tc <= 0.0) & has_data          # `== 0` idle predicate
+    hbm_active = peak_hbm >= cutoff             # `unless` corroboration
+    eligible = age_ref[:] >= lookback           # age gate
+    out_ref[:] = (idle & jnp.logical_not(hbm_active) & eligible).astype(jnp.int32)
+
+
+def evaluate_chips_pallas(
+    tc_util, hbm_util, valid, pod_age_s, params_arr, *, block_c: int = 128,
+    interpret: bool | None = None,
+):
+    """Per-chip candidate mask (bool[C]) — Pallas analog of
+    engine.evaluate_chips (same semantics, asserted by tests/test_policy.py).
+
+    The chip axis is padded to a block multiple; padded rows carry
+    valid=0 and are sliced away (absent series are never candidates, so
+    padding cannot leak verdicts).
+    """
+    num_chips, num_samples = tc_util.shape
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    padded = ((num_chips + block_c - 1) // block_c) * block_c
+    pad = padded - num_chips
+    if pad:
+        tc_util = jnp.pad(tc_util, ((0, pad), (0, 0)))
+        hbm_util = jnp.pad(hbm_util, ((0, pad), (0, 0)))
+        valid = jnp.pad(valid, ((0, pad), (0, 0)))
+        pod_age_s = jnp.pad(pod_age_s, (0, pad))
+
+    block = lambda i: (i, 0)  # noqa: E731 — block-index map, one row-block per step
+    out = pl.pallas_call(
+        _chip_kernel,
+        grid=(padded // block_c,),
+        in_specs=[
+            pl.BlockSpec((block_c, num_samples), block),
+            pl.BlockSpec((block_c, num_samples), block),
+            pl.BlockSpec((block_c, num_samples), block),
+            pl.BlockSpec((block_c, 1), block),
+            pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((block_c, 1), block),
+        out_shape=jax.ShapeDtypeStruct((padded, 1), jnp.int32),
+        interpret=interpret,
+    )(
+        tc_util,
+        hbm_util,
+        valid.astype(jnp.int8),  # i8 mask: pallas-friendly bool carrier
+        pod_age_s.astype(jnp.float32).reshape(-1, 1),
+        params_arr.astype(jnp.float32).reshape(1, 2),
+    )
+    return out[:num_chips, 0] > 0
+
+
+@partial(jax.jit, static_argnames=("num_slices", "block_c", "interpret"))
+def evaluate_fleet_pallas(
+    tc_util, hbm_util, valid, pod_age_s, slice_id, params_arr, num_slices,
+    block_c: int = 128, interpret: bool | None = None,
+):
+    """Drop-in for engine.evaluate_fleet with the chip pass in Pallas."""
+    candidate = evaluate_chips_pallas(
+        tc_util, hbm_util, valid, pod_age_s, params_arr,
+        block_c=block_c, interpret=interpret,
+    )
+    return slice_verdicts(candidate, slice_id, num_slices), candidate
