@@ -53,6 +53,7 @@ struct Cli {
   std::string duty_cycle_metric;          // --duty-cycle-metric override
   std::string hbm_metric;                 // --hbm-metric override
   int64_t resolve_concurrency = 10;       // --resolve-concurrency (ref: fixed 10)
+  int64_t resolve_batch_threshold = 8;    // --resolve-batch-threshold (0 = off)
   int64_t scale_concurrency = 8;          // --scale-concurrency (ref: serial consumer)
   int metrics_port = 0;                   // --metrics-port (>0 serves /metrics)
   std::string otlp_endpoint;              // --otlp-endpoint (default: $OTEL_EXPORTER_OTLP_ENDPOINT)

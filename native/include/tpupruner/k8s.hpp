@@ -55,6 +55,13 @@ class Client {
   // Object path for a scalable kind (CRs included).
   static std::string object_path(core::Kind kind, const std::string& ns,
                                  const std::string& name);
+  // Collection path for a scalable kind (object_path minus the name) —
+  // the LIST endpoint used by batched owner-chain prefetch.
+  static std::string collection_path(core::Kind kind, const std::string& ns);
+  // batch/v1 Job paths (Jobs are walked through, never scaled, so Job is
+  // not a core::Kind — see walker.cpp Pod→Job→JobSet chain).
+  static std::string jobs_path(const std::string& ns);
+  static std::string job_path(const std::string& ns, const std::string& name);
   // /scale subresource path (Deployment/ReplicaSet/StatefulSet).
   static std::string scale_path(core::Kind kind, const std::string& ns,
                                 const std::string& name);

@@ -5,10 +5,13 @@
 // without external dependencies.
 #pragma once
 
+#include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <optional>
 #include <string>
 #include <string_view>
+#include <thread>
 #include <vector>
 
 namespace tpupruner::util {
@@ -48,5 +51,26 @@ std::optional<std::string> env(const char* name);
 
 // URL-encode for application/x-www-form-urlencoded bodies / query strings.
 std::string url_encode(std::string_view s);
+
+// Run fn(i) for i in [0, n) from min(workers, n) threads pulling indices
+// off a shared counter, then join. The daemon's fan-out idiom (reference:
+// buffer_unordered, main.rs:530).
+template <typename Fn>
+void fan_out(size_t workers, size_t n, Fn&& fn) {
+  workers = std::min(workers, n);
+  if (workers == 0) return;
+  std::atomic<size_t> next{0};
+  auto worker_fn = [&] {
+    while (true) {
+      size_t i = next.fetch_add(1);
+      if (i >= n) break;
+      fn(i);
+    }
+  };
+  std::vector<std::thread> threads;
+  threads.reserve(workers);
+  for (size_t i = 0; i < workers; ++i) threads.emplace_back(worker_fn);
+  for (std::thread& t : threads) t.join();
+}
 
 }  // namespace tpupruner::util

@@ -40,6 +40,10 @@ class FetchCache {
   using Entry = std::optional<json::Value>;
   Entry get_or_fetch(const std::string& key, const std::function<Entry()>& fetch);
 
+  // Pre-populate an entry (batched-LIST prefetch). First writer wins: a
+  // seed never overwrites a fetched or previously seeded entry.
+  void seed(const std::string& key, Entry entry);
+
  private:
   struct Flight {
     std::mutex m;
@@ -51,6 +55,22 @@ class FetchCache {
   std::mutex mutex_;
   std::unordered_map<std::string, std::shared_ptr<Flight>> map_;
 };
+
+// Batched owner-chain prefetch: scan the (already fetched, eligible) pods'
+// labels and ownerReferences, and for every owner collection demanded by
+// more than `threshold` distinct names, issue ONE namespace-collection
+// LIST and seed the results into `cache` — so the subsequent per-pod
+// find_root_object walks hit memory instead of the API server. Two waves:
+//   wave 1: Pod → {ReplicaSet, StatefulSet, Job, kserve/LWS label roots}
+//   wave 2: listed wave-1 objects → {Deployment, Notebook, JobSet, LWS}
+// The reference pays 1-3 GETs per candidate pod (main.rs:444-446); with
+// batching an N-pod reclaim cycle costs O(namespaces × kinds) LISTs.
+// Collections at or below the threshold keep per-object GETs (a LIST
+// returns the whole collection — not worth it for a handful of owners).
+// LIST failures degrade to the unbatched path. Returns #LISTs issued.
+size_t prefetch_owner_chains(const k8s::Client& client, FetchCache& cache,
+                             const std::vector<const json::Value*>& pods,
+                             int64_t threshold, size_t concurrency);
 
 // Resolve the root scalable object for a pod (fetched Pod JSON).
 // Throws std::runtime_error("no scalable root object ...") when the pod has

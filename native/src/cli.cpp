@@ -85,6 +85,11 @@ TPU FLAGS:
       --duty-cycle-metric <N>   override duty-cycle fallback metric name
       --hbm-metric <N>          override HBM bandwidth metric name
       --resolve-concurrency <N> concurrent pod resolutions [default: 10]
+      --resolve-batch-threshold <N>
+                                when more than N pods (or owners) of one
+                                namespace are candidates, fetch them with one
+                                collection LIST instead of per-object GETs;
+                                0 disables batching [default: 8]
       --scale-concurrency <N>   concurrent scale actuations [default: 8]
       --metrics-port <P>        serve Prometheus /metrics on this port
       --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
@@ -147,6 +152,12 @@ Cli parse(int argc, char** argv) {
        [&](const std::string& v) {
          cli.resolve_concurrency = parse_int("--resolve-concurrency", v);
          if (cli.resolve_concurrency < 1) throw CliError("--resolve-concurrency must be >= 1");
+       }},
+      {"--resolve-batch-threshold",
+       [&](const std::string& v) {
+         cli.resolve_batch_threshold = parse_int("--resolve-batch-threshold", v);
+         if (cli.resolve_batch_threshold < 0)
+           throw CliError("--resolve-batch-threshold must be >= 0");
        }},
       {"--scale-concurrency",
        [&](const std::string& v) {

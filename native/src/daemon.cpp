@@ -82,82 +82,160 @@ struct ResolveOutcome {
   walker::IdlePodSet idle_pods;  // pods idle AND eligible (for the slice gate)
 };
 
+using util::fan_out;
+
 // Concurrent pod-resolution fan-out (reference: buffer_unordered(10),
-// main.rs:447-532). Each sample costs 1-3 K8s API round-trips.
+// main.rs:447-532 — 1-3 K8s round-trips per sample). Above
+// --resolve-batch-threshold candidates per namespace, pod fetches collapse
+// into one namespace LIST and owner fetches into per-collection LISTs
+// (walker::prefetch_owner_chains), so a big reclaim cycle costs
+// O(namespaces × kinds) API calls instead of O(pods).
 ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
                             const std::vector<core::PodMetricSample>& samples,
                             const otlp::SpanContext& parent_ctx) {
   ResolveOutcome out;
   std::mutex out_mutex;
-  std::atomic<size_t> next{0};
   walker::FetchCache owner_cache;  // memoize shared owner chains this cycle
   int64_t lookback_secs = args.duration * 60 + args.grace_period;  // main.rs:413-414
   int64_t now = util::now_unix();
+  size_t workers = static_cast<size_t>(args.resolve_concurrency);
 
-  size_t workers =
-      std::min<size_t>(static_cast<size_t>(args.resolve_concurrency), samples.size());
-  if (workers == 0) return out;
-
-  auto worker_fn = [&] {
-    while (true) {
-      size_t i = next.fetch_add(1);
-      if (i >= samples.size()) break;
-      const core::PodMetricSample& pmd = samples[i];
-      std::string key = pmd.ns + "/" + pmd.name;
-
-      std::optional<json::Value> pod;
+  // Phase 1 — acquire pods. Namespaces with more candidates than the batch
+  // threshold are fetched with one pods LIST; the rest (and any pod missing
+  // from its LIST snapshot) fall back to per-pod GETs.
+  std::unordered_map<std::string, size_t> ns_counts;
+  for (const core::PodMetricSample& s : samples) ++ns_counts[s.ns];
+  std::vector<std::string> batch_ns;
+  for (const auto& [ns, count] : ns_counts) {
+    if (args.resolve_batch_threshold > 0 &&
+        count > static_cast<size_t>(args.resolve_batch_threshold)) {
+      batch_ns.push_back(ns);
+    }
+  }
+  std::unordered_map<std::string, const json::Value*> prefetched;  // "ns/name" → Pod
+  std::vector<json::Value> pod_lists;  // keeps prefetched items alive
+  pod_lists.resize(batch_ns.size());
+  std::mutex prefetch_mutex;
+  if (!batch_ns.empty()) {
+    otlp::Span span("prefetch_pods", &parent_ctx);
+    span.attr("namespaces", static_cast<int64_t>(batch_ns.size()));
+    fan_out(workers, batch_ns.size(), [&](size_t i) {
+      const std::string& ns = batch_ns[i];
+      json::Value list;
       try {
-        pod = kube.get_opt(k8s::Client::pod_path(pmd.ns, pmd.name));
+        list = kube.list(k8s::Client::pods_path(ns), "");
+      } catch (const std::exception& e) {
+        log::warn("pods LIST failed in namespace " + ns + " (falling back to GETs): " + e.what());
+        return;
+      }
+      pod_lists[i] = std::move(list);  // distinct index per worker; no lock
+      const json::Value* items = pod_lists[i].find("items");
+      if (!items || !items->is_array()) return;
+      // parse outside the lock, merge the per-namespace entries under it
+      std::vector<std::pair<std::string, const json::Value*>> entries;
+      entries.reserve(items->as_array().size());
+      for (const json::Value& pod : items->as_array()) {
+        const json::Value* name = pod.at_path("metadata.name");
+        if (name && name->is_string()) entries.push_back({ns + "/" + name->as_string(), &pod});
+      }
+      std::lock_guard<std::mutex> lock(prefetch_mutex);
+      for (auto& [key, pod] : entries) prefetched[std::move(key)] = pod;
+    });
+  }
+  if (!batch_ns.empty()) {
+    log::info("Batched pod resolution: " + std::to_string(batch_ns.size()) +
+              " namespace LIST(s) covering " + std::to_string(prefetched.size()) + " pods");
+  }
+
+  // Phase 2 — per-pod acquisition (cache hit or GET) + eligibility gates.
+  struct EligiblePod {
+    const core::PodMetricSample* sample;
+    const json::Value* pod;
+  };
+  std::vector<EligiblePod> eligible;
+  std::deque<json::Value> owned_pods;  // stable storage for GET results
+  fan_out(workers, samples.size(), [&](size_t i) {
+    const core::PodMetricSample& pmd = samples[i];
+    std::string key = pmd.ns + "/" + pmd.name;
+
+    const json::Value* pod = nullptr;
+    {
+      auto it = prefetched.find(key);
+      if (it != prefetched.end()) pod = it->second;
+    }
+    if (!pod) {
+      std::optional<json::Value> fetched;
+      try {
+        fetched = kube.get_opt(k8s::Client::pod_path(pmd.ns, pmd.name));
       } catch (const std::exception& e) {
         log::error("Skipping " + key + ", retrieval error: " + e.what());
-        continue;
+        return;
       }
-      if (!pod) {
+      if (!fetched) {
         log::info("Skipping " + key + ", pod no longer exists");
-        continue;
+        return;
       }
-
-      core::Eligibility elig = core::check_eligibility(*pod, now, lookback_secs);
-      switch (elig) {
-        case core::Eligibility::Pending:
-          log::info("Skipping pod " + key + ", it's still pending");
-          continue;
-        case core::Eligibility::NoCreationTs:
-          log::warn("Pod " + key + " has no creation timestamp, skipping");
-          continue;
-        case core::Eligibility::BadTimestamp:
-          log::warn("Pod " + key + " has unparseable creation timestamp, skipping");
-          continue;
-        case core::Eligibility::TooYoung:
-          log::info("Pod " + key + " created within lookback window, skipping");
-          continue;
-        case core::Eligibility::Eligible:
-          break;
-      }
-      log::info("Pod " + key + " is idle and eligible for scaledown");
-
-      std::optional<ScaleTarget> target;
-      {
-        otlp::Span span("find_root_object", &parent_ctx);  // lib.rs:436 span
-        span.attr("pod", key);
-        try {
-          target = walker::find_root_object(kube, *pod, &owner_cache);
-        } catch (const std::exception& e) {
-          span.set_error(e.what());
-          log::warn("Skipping " + key + ", no scalable root object: " + e.what());
-        }
-      }
-
       std::lock_guard<std::mutex> lock(out_mutex);
-      out.idle_pods.insert(key);
-      if (target) out.targets.push_back(std::move(*target));
+      owned_pods.push_back(std::move(*fetched));
+      pod = &owned_pods.back();
     }
-  };
 
-  std::vector<std::thread> threads;
-  threads.reserve(workers);
-  for (size_t i = 0; i < workers; ++i) threads.emplace_back(worker_fn);
-  for (std::thread& t : threads) t.join();
+    core::Eligibility elig = core::check_eligibility(*pod, now, lookback_secs);
+    switch (elig) {
+      case core::Eligibility::Pending:
+        log::info("Skipping pod " + key + ", it's still pending");
+        return;
+      case core::Eligibility::NoCreationTs:
+        log::warn("Pod " + key + " has no creation timestamp, skipping");
+        return;
+      case core::Eligibility::BadTimestamp:
+        log::warn("Pod " + key + " has unparseable creation timestamp, skipping");
+        return;
+      case core::Eligibility::TooYoung:
+        log::info("Pod " + key + " created within lookback window, skipping");
+        return;
+      case core::Eligibility::Eligible:
+        break;
+    }
+    log::info("Pod " + key + " is idle and eligible for scaledown");
+    std::lock_guard<std::mutex> lock(out_mutex);
+    out.idle_pods.insert(std::move(key));
+    eligible.push_back({&pmd, pod});
+  });
+
+  // Phase 3 — batched owner prefetch, then the owner walk per eligible pod.
+  if (args.resolve_batch_threshold > 0 && !eligible.empty()) {
+    otlp::Span span("prefetch_owner_chains", &parent_ctx);
+    std::vector<const json::Value*> pods;
+    pods.reserve(eligible.size());
+    for (const EligiblePod& e : eligible) pods.push_back(e.pod);
+    size_t lists =
+        walker::prefetch_owner_chains(kube, owner_cache, pods,
+                                      args.resolve_batch_threshold, workers);
+    span.attr("collection_lists", static_cast<int64_t>(lists));
+    if (lists > 0) {
+      log::info("Batched owner resolution: " + std::to_string(lists) + " collection LIST(s)");
+    }
+  }
+  fan_out(workers, eligible.size(), [&](size_t i) {
+    const EligiblePod& e = eligible[i];
+    std::string key = e.sample->ns + "/" + e.sample->name;
+    std::optional<ScaleTarget> target;
+    {
+      otlp::Span span("find_root_object", &parent_ctx);  // lib.rs:436 span
+      span.attr("pod", key);
+      try {
+        target = walker::find_root_object(kube, *e.pod, &owner_cache);
+      } catch (const std::exception& e2) {
+        span.set_error(e2.what());
+        log::warn("Skipping " + key + ", no scalable root object: " + e2.what());
+      }
+    }
+    if (target) {
+      std::lock_guard<std::mutex> lock(out_mutex);
+      out.targets.push_back(std::move(*target));
+    }
+  });
   return out;
 }
 

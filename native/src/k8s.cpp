@@ -127,9 +127,20 @@ std::string Client::events_path(const std::string& ns) {
 }
 
 std::string Client::object_path(core::Kind kind, const std::string& ns, const std::string& name) {
+  return collection_path(kind, ns) + "/" + name;
+}
+
+std::string Client::collection_path(core::Kind kind, const std::string& ns) {
   std::string group_version(core::api_version(kind));  // e.g. "apps/v1"
   return "/apis/" + group_version + "/namespaces/" + ns + "/" +
-         std::string(core::plural(kind)) + "/" + name;
+         std::string(core::plural(kind));
+}
+
+std::string Client::jobs_path(const std::string& ns) {
+  return "/apis/batch/v1/namespaces/" + ns + "/jobs";
+}
+std::string Client::job_path(const std::string& ns, const std::string& name) {
+  return jobs_path(ns) + "/" + name;
 }
 
 std::string Client::scale_path(core::Kind kind, const std::string& ns, const std::string& name) {

@@ -1,8 +1,11 @@
 #include "tpupruner/walker.hpp"
 
+#include <atomic>
 #include <stdexcept>
+#include <thread>
 
 #include "tpupruner/log.hpp"
+#include "tpupruner/util.hpp"
 
 namespace tpupruner::walker {
 
@@ -119,6 +122,135 @@ FetchCache::Entry FetchCache::get_or_fetch(const std::string& key,
   }
 }
 
+void FetchCache::seed(const std::string& key, Entry entry) {
+  auto flight = std::make_shared<Flight>();
+  flight->done = true;
+  flight->entry = std::move(entry);
+  std::lock_guard<std::mutex> lock(mutex_);
+  map_.emplace(key, std::move(flight));  // emplace: no-op when key exists
+}
+
+namespace {
+
+// LIST path → distinct object names the walk will ask for.
+using DemandMap = std::unordered_map<std::string, std::set<std::string>>;
+
+void demand(DemandMap& demands, std::string list_path, std::string name) {
+  demands[std::move(list_path)].insert(std::move(name));
+}
+
+// LIST every collection demanded by more than `threshold` names — the LISTs
+// of one wave run concurrently (they are independent apiserver calls, and a
+// wide cycle can demand one per namespace × kind) — and seed the demanded
+// objects into the cache. Seeded objects are appended to `seeded_out` for
+// the next wave's ownerRef scan.
+size_t list_and_seed(const k8s::Client& client, FetchCache& cache, const DemandMap& demands,
+                     int64_t threshold, size_t concurrency, std::vector<Value>* seeded_out) {
+  std::vector<std::pair<const std::string*, const std::set<std::string>*>> over;
+  for (const auto& [path, names] : demands) {
+    if (names.size() > static_cast<size_t>(threshold)) over.push_back({&path, &names});
+  }
+  std::atomic<size_t> lists{0};
+  std::mutex out_mutex;
+  util::fan_out(concurrency, over.size(), [&](size_t i) {
+    const std::string& path = *over[i].first;
+    const std::set<std::string>& names = *over[i].second;
+    Value collection;
+    try {
+      collection = client.list(path, "");
+      lists.fetch_add(1);
+    } catch (const std::exception& e) {
+      log::warn("prefetch LIST " + path + " failed (falling back to GETs): " + e.what());
+      return;
+    }
+    const Value* items = collection.find("items");
+    if (!items || !items->is_array()) return;
+    size_t hit = 0;
+    for (const Value& item : items->as_array()) {
+      const Value* name = item.at_path("metadata.name");
+      if (!name || !name->is_string() || !names.count(name->as_string())) continue;
+      cache.seed(path + "/" + name->as_string(), item);  // shallow copy (shared nodes)
+      if (seeded_out) {
+        std::lock_guard<std::mutex> lock(out_mutex);
+        seeded_out->push_back(item);
+      }
+      ++hit;
+    }
+    log::debug("prefetch " + path + ": " + std::to_string(hit) + "/" +
+               std::to_string(names.size()) + " demanded owners seeded");
+  });
+  return lists.load();
+}
+
+}  // namespace
+
+size_t prefetch_owner_chains(const k8s::Client& client, FetchCache& cache,
+                             const std::vector<const Value*>& pods, int64_t threshold,
+                             size_t concurrency) {
+  if (threshold <= 0) return 0;
+
+  // Wave 1: first-hop demands straight off the pods.
+  DemandMap wave1;
+  for (const Value* pod : pods) {
+    std::string ns = pod_ns(*pod);
+    if (const Value* labels = pod->at_path("metadata.labels"); labels && labels->is_object()) {
+      const Value* ks = labels->find("serving.kserve.io/inferenceservice");
+      if (ks && ks->is_string()) {
+        demand(wave1, k8s::Client::collection_path(Kind::InferenceService, ns), ks->as_string());
+        continue;  // label shortcut: the walk never touches ownerRefs
+      }
+      const Value* lws = labels->find("leaderworkerset.sigs.k8s.io/name");
+      if (lws && lws->is_string()) {
+        demand(wave1, k8s::Client::collection_path(Kind::LeaderWorkerSet, ns), lws->as_string());
+        continue;
+      }
+    }
+    const Value* ors = pod->at_path("metadata.ownerReferences");
+    if (!ors || !ors->is_array()) continue;
+    for (const Value& owner : ors->as_array()) {
+      std::string kind = owner.get_string("kind");
+      if (kind == "ReplicaSet") {
+        demand(wave1, k8s::Client::collection_path(Kind::ReplicaSet, ns), owner.get_string("name"));
+      } else if (kind == "StatefulSet") {
+        demand(wave1, k8s::Client::collection_path(Kind::StatefulSet, ns),
+               owner.get_string("name"));
+      } else if (kind == "Job") {
+        demand(wave1, k8s::Client::jobs_path(ns), owner.get_string("name"));
+      }
+    }
+  }
+  std::vector<Value> mid_owners;
+  size_t lists = list_and_seed(client, cache, wave1, threshold, concurrency, &mid_owners);
+
+  // Wave 2: root demands off the listed mid-chain objects. Mid-chain owners
+  // that stayed below the threshold (not listed) resolve their roots via
+  // plain GETs in the walk — correct, just unbatched.
+  DemandMap wave2;
+  for (const Value& obj : mid_owners) {
+    std::string ns;
+    if (const Value* n = obj.at_path("metadata.namespace"); n && n->is_string())
+      ns = n->as_string();
+    const Value* ors = obj.at_path("metadata.ownerReferences");
+    if (!ors || !ors->is_array()) continue;
+    for (const Value& owner : ors->as_array()) {
+      std::string kind = owner.get_string("kind");
+      if (kind == "Deployment") {
+        demand(wave2, k8s::Client::collection_path(Kind::Deployment, ns),
+               owner.get_string("name"));
+      } else if (kind == "Notebook") {
+        demand(wave2, k8s::Client::collection_path(Kind::Notebook, ns), owner.get_string("name"));
+      } else if (kind == "JobSet") {
+        demand(wave2, k8s::Client::collection_path(Kind::JobSet, ns), owner.get_string("name"));
+      } else if (kind == "LeaderWorkerSet") {
+        demand(wave2, k8s::Client::collection_path(Kind::LeaderWorkerSet, ns),
+               owner.get_string("name"));
+      }
+    }
+  }
+  lists += list_and_seed(client, cache, wave2, threshold, concurrency, nullptr);
+  return lists;
+}
+
 ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchCache* cache) {
   std::string ns = pod_ns(pod);
   std::string pod_name = pod.at_path("metadata.name") ? pod.at_path("metadata.name")->as_string()
@@ -129,8 +261,7 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
   if (const Value* labels = pod.at_path("metadata.labels"); labels && labels->is_object()) {
     const Value* ks = labels->find("serving.kserve.io/inferenceservice");
     if (ks && ks->is_string()) {
-      Value is = client.get(k8s::Client::object_path(Kind::InferenceService, ns, ks->as_string()));
-      return ScaleTarget{Kind::InferenceService, std::move(is)};
+      return fetch_must(client, cache, Kind::InferenceService, ns, ks->as_string());
     }
     // LWS shortcut: EVERY pod of a LeaderWorkerSet (leader and worker)
     // carries this label, while the ownerRef chain differs by role (the
@@ -174,8 +305,7 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
         // suspending them mid-run is destructive, so fall through.
         std::optional<Value> job;
         try {
-          job = cached_get_opt(client, cache,
-                               "/apis/batch/v1/namespaces/" + ns + "/jobs/" + name);
+          job = cached_get_opt(client, cache, k8s::Client::job_path(ns, name));
         } catch (const std::exception& e) {
           log::warn("fetch Job " + ns + "/" + name + " failed: " + e.what());
         }

@@ -423,3 +423,76 @@ def test_daemon_recovers_after_transient_failures(built, fake_prom, fake_k8s):
         proc.wait(timeout=10)
     assert len(fake_prom.queries) >= 7  # 6 failures + at least one success
     assert fake_k8s.scale_patches()  # recovered and scaled
+
+
+# ── batched resolution (--resolve-batch-threshold) ─────────────────────────
+# Above the threshold, per-pod GETs collapse into one pods LIST per
+# namespace and owner fetches into per-collection LISTs (two prefetch
+# waves: Pod→{RS,Job,…} then {RS→Deployment, Job→JobSet}).
+
+
+def test_batched_resolution_uses_lists_not_gets(built, fake_prom, fake_k8s):
+    for i in range(6):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}", num_pods=1)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    run_pruner(fake_prom, fake_k8s, "--resolve-batch-threshold", "2")
+
+    assert len(fake_k8s.scale_patches()) == 6
+    gets = [p for m, p in fake_k8s.requests if m == "GET"]
+    # no per-object GETs anywhere on the chain
+    assert [p for p in gets if "/pods/" in p] == []
+    assert [p for p in gets if "/replicasets/" in p] == []
+    assert [p for p in gets if "/deployments/" in p] == []
+    # exactly one LIST per collection
+    def lists_of(suffix):
+        return [p for p in gets if p.split("?")[0].endswith(suffix)]
+    assert len(lists_of("/namespaces/ml/pods")) == 1
+    assert len(lists_of("/namespaces/ml/replicasets")) == 1
+    assert len(lists_of("/namespaces/ml/deployments")) == 1
+
+
+def test_batched_resolution_jobset_slices(built, fake_prom, fake_k8s):
+    for i in range(4):
+        _, pods = fake_k8s.add_jobset_slice("tpu", f"slice-{i}", num_hosts=4)
+        for pod in pods:
+            fake_prom.add_idle_pod_series(pod["metadata"]["name"], "tpu", chips=4)
+
+    run_pruner(fake_prom, fake_k8s, "--resolve-batch-threshold", "3")
+
+    patched = {p for p, _ in fake_k8s.patches}
+    assert patched == {
+        f"/apis/jobset.x-k8s.io/v1alpha2/namespaces/tpu/jobsets/slice-{i}"
+        for i in range(4)
+    }
+    gets = [p for m, p in fake_k8s.requests if m == "GET"]
+    assert [p for p in gets if "/jobs/" in p] == []       # Jobs came from one LIST
+    assert [p for p in gets if "/jobsets/" in p] == []    # JobSets too
+    assert [p for p in gets if "/pods/" in p] == []
+
+
+def test_batched_resolution_missing_pod_falls_back(built, fake_prom, fake_k8s):
+    for i in range(3):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}", num_pods=1)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    # in the metric plane but gone from the cluster: LIST snapshot misses it,
+    # the walk falls back to a direct GET and skips on the 404
+    fake_prom.add_idle_pod_series("ghost-pod", "ml")
+
+    run_pruner(fake_prom, fake_k8s, "--resolve-batch-threshold", "1")
+
+    assert len(fake_k8s.scale_patches()) == 3
+    assert ("GET", "/api/v1/namespaces/ml/pods/ghost-pod") in fake_k8s.requests
+
+
+def test_batching_disabled_keeps_per_pod_gets(built, fake_prom, fake_k8s):
+    for i in range(3):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}", num_pods=1)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    run_pruner(fake_prom, fake_k8s, "--resolve-batch-threshold", "0")
+
+    assert len(fake_k8s.scale_patches()) == 3
+    gets = [p for m, p in fake_k8s.requests if m == "GET"]
+    assert len([p for p in gets if "/pods/" in p]) == 3
+    assert [p for p in gets if p.split("?")[0].endswith("/namespaces/ml/pods")] == []

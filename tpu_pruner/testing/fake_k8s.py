@@ -308,25 +308,32 @@ class FakeK8s:
                                     "reason": "NotFound", "code": 404,
                                     "message": f"{self.path} not found"})
 
+            # namespaced collection resources the real API server LISTs
+            # (a GET of /…/namespaces/<ns>/<plural> with no trailing name)
+            COLLECTIONS = {
+                "pods", "replicasets", "deployments", "statefulsets", "jobs",
+                "jobsets", "leaderworkersets", "notebooks", "inferenceservices",
+            }
+
             def do_GET(self):
                 parsed = urlparse(self.path)
                 path = parsed.path
                 with fake._lock:
                     fake.requests.append(("GET", self.path))
-                    # pod LIST with labelSelector
-                    if path.endswith("/pods") and "/namespaces/" in path:
+                    # collection LIST (optional labelSelector), incl. empty lists
+                    if path.rsplit("/", 1)[-1] in self.COLLECTIONS and "/namespaces/" in path:
                         selector = parse_qs(parsed.query).get("labelSelector", [""])[0]
                         reqs = parse_label_selector(selector)
                         prefix = path + "/"
                         items = [
                             obj for p, obj in fake.objects.items()
-                            if p.startswith(prefix)
+                            if p.startswith(prefix) and "/" not in p[len(prefix):]
                             and all(
                                 obj["metadata"].get("labels", {}).get(k) in vals
                                 for k, vals in reqs
                             )
                         ]
-                        self._respond(200, {"kind": "PodList", "apiVersion": "v1",
+                        self._respond(200, {"kind": "List", "apiVersion": "v1",
                                             "items": items})
                         return
                     obj = fake.objects.get(path)
