@@ -35,10 +35,15 @@ test-e2e-kind: build
 test-asan:
     cmake -G Ninja -S . -B build-asan -DTP_SANITIZE=ON && cmake --build build-asan
     ./build-asan/tpupruner_tests
+    ./build-asan/tpupruner_fuzz 200000
 
 test-tsan:
     cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
     ./build-tsan/tpupruner_tests
+
+# deterministic mutation fuzz over the untrusted-input surfaces
+fuzz iterations="500000": build
+    ./build/tpupruner_fuzz {{iterations}}
 
 bench: build
     python bench.py

@@ -7,7 +7,7 @@ tier is driven through the built test binary.
 
 import subprocess
 
-from tpu_pruner.native import TESTS_PATH
+from tpu_pruner.native import BUILD_DIR, TESTS_PATH
 
 
 def test_native_unit_suite(built):
@@ -16,3 +16,16 @@ def test_native_unit_suite(built):
     )
     assert proc.returncode == 0, f"native tests failed:\n{proc.stdout}{proc.stderr}"
     assert ", 0 failed" in proc.stdout
+
+
+def test_fuzz_smoke(built):
+    """Deterministic mutation fuzz over the untrusted-input surfaces (JSON
+    parse/dump round-trip, prometheus decode, timestamp parse). The heavy
+    run lives in the ASan CI job (just test-asan, 200k iters); this smoke
+    keeps the invariants enforced in every plain test run."""
+    proc = subprocess.run(
+        [str(BUILD_DIR / "tpupruner_fuzz"), "20000"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "fuzz ok: 20000 iterations" in proc.stderr
