@@ -3,6 +3,7 @@
 // Reference analog: gpu-pruner/src/main.rs:273-375 (main) plus the separate
 // querytest binary (src/bin/querytest.rs) — folded in as a subcommand so
 // the container image stays single-binary.
+#include <csignal>
 #include <cstdio>
 #include <cstring>
 #include <exception>
@@ -14,6 +15,12 @@
 
 int main(int argc, char** argv) {
   using namespace tpupruner;
+
+  // A reset pooled connection (GMP frontends close idle HTTPS links; the
+  // transport deliberately retries stale keep-alive sockets) must surface
+  // as a write error, not a process-killing SIGPIPE — OpenSSL writes via
+  // SSL_set_fd bypass MSG_NOSIGNAL. Process-wide, covers every subcommand.
+  std::signal(SIGPIPE, SIG_IGN);
 
   if (argc >= 2 && std::strcmp(argv[1], "querytest") == 0) {
     if (argc != 4) {

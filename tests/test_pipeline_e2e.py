@@ -380,6 +380,37 @@ def test_metrics_endpoint_serves_counters(built, fake_prom, fake_k8s):
     assert "tpu_pruner_query_returned_candidates" in body
 
 
+def test_daemon_sigterm_graceful_shutdown(built, fake_prom, fake_k8s):
+    """SIGTERM (what a K8s rollout sends) ends the daemon cleanly: exit 0,
+    a graceful-shutdown log line, queue drained — not the default
+    signal-death exit 143."""
+    import signal
+    import time
+
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "scale-down", "--daemon-mode", "--check-interval", "60"]
+    env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin"}
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and not fake_k8s.scale_patches():
+            time.sleep(0.2)
+        assert fake_k8s.scale_patches(), "first cycle never landed a patch"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    stderr = proc.stderr.read()
+    assert proc.returncode == 0, stderr
+    assert "Received SIGTERM, shutting down gracefully" in stderr
+
+
 # ── failure budget (main.rs:299-320) ───────────────────────────────────────
 
 
