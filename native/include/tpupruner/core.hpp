@@ -101,9 +101,24 @@ enum class Eligibility : uint8_t {
   NoCreationTs,   // missing creationTimestamp (main.rs:485-492)
   TooYoung,       // created within lookback+grace (main.rs:494-510)
   BadTimestamp,   // creationTimestamp unparseable
+  OptedOut,       // annotated tpu-pruner.dev/skip=true (no reference analog)
 };
 
 std::string_view eligibility_name(Eligibility e);
+
+// Operator opt-out valve (beyond reference parity). On a ROOT object:
+// authoritative, the target is never pruned. On a POD: effective whenever
+// the pod is in the idle candidate set — it vetoes the pod's resolved
+// root for EVERY kind (a sibling pod of the same Deployment must not
+// scale the shared root away) and is excluded from the idle set so a
+// group kind (JobSet/LWS) containing it fails the all-idle slice gate;
+// an unresolvable root fails closed on the namespace for the cycle. A
+// BUSY annotated pod is absent from the idle query results, so its
+// annotation can't be seen that cycle — root annotation is the standing
+// guarantee.
+constexpr std::string_view kSkipAnnotation = "tpu-pruner.dev/skip";
+
+bool is_opted_out(const json::Value& object);
 
 // Apply the per-pod gates from main.rs:452-510 to a Pod object.
 // `lookback_secs` = duration*60 + grace_period (main.rs:413-414).

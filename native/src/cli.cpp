@@ -91,7 +91,8 @@ TPU FLAGS:
                                 collection LIST instead of per-object GETs;
                                 0 disables batching [default: 8]
       --scale-concurrency <N>   concurrent scale actuations [default: 8]
-      --metrics-port <P>        serve Prometheus /metrics on this port
+      --metrics-port <P>        serve Prometheus /metrics + /healthz on this port
+                                (0 = disabled, "auto" = ephemeral)
       --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
                                 [default: $OTEL_EXPORTER_OTLP_ENDPOINT]
       --gcp-project <ID>        query the Cloud Monitoring PromQL API for this
@@ -166,9 +167,15 @@ Cli parse(int argc, char** argv) {
        }},
       {"--metrics-port",
        [&](const std::string& v) {
-         cli.metrics_port = static_cast<int>(parse_int("--metrics-port", v));
-         if (cli.metrics_port < 0 || cli.metrics_port > 65535)
-           throw CliError("--metrics-port out of range");
+         if (v == "auto") {  // ephemeral port, logged at startup (tests)
+           cli.metrics_port = 0;
+           return;
+         }
+         int port = static_cast<int>(parse_int("--metrics-port", v));
+         if (port < 0 || port > 65535) throw CliError("--metrics-port out of range");
+         // "0" keeps its pre-/healthz meaning of "disabled" (= the unset
+         // default) so existing manifests don't start binding random ports.
+         cli.metrics_port = port == 0 ? -1 : port;
        }},
       {"--otlp-endpoint", [&](const std::string& v) { cli.otlp_endpoint = v; }},
       {"--gcp-project", [&](const std::string& v) { cli.gcp_project = v; }},

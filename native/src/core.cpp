@@ -176,11 +176,20 @@ std::string_view eligibility_name(Eligibility e) {
     case Eligibility::NoCreationTs: return "no_creation_timestamp";
     case Eligibility::TooYoung: return "too_young";
     case Eligibility::BadTimestamp: return "bad_timestamp";
+    case Eligibility::OptedOut: return "opted_out";
   }
   return "";
 }
 
+bool is_opted_out(const json::Value& object) {
+  const json::Value* v = object.at_path("metadata.annotations");
+  if (!v || !v->is_object()) return false;
+  const json::Value* skip = v->find(std::string(kSkipAnnotation));
+  return skip && skip->is_string() && skip->as_string() == "true";
+}
+
 Eligibility check_eligibility(const json::Value& pod, int64_t now_unix, int64_t lookback_secs) {
+  if (is_opted_out(pod)) return Eligibility::OptedOut;
   const json::Value* phase = pod.at_path("status.phase");
   if (phase && phase->is_string() && phase->as_string() == "Pending") {
     return Eligibility::Pending;

@@ -7,6 +7,7 @@
 #include <deque>
 #include <mutex>
 #include <thread>
+#include <unordered_set>
 #include <vector>
 
 #include "metrics_http.hpp"
@@ -81,6 +82,17 @@ prom::Client build_prom_client(const cli::Cli& args) {
 struct ResolveOutcome {
   std::vector<ScaleTarget> targets;
   walker::IdlePodSet idle_pods;  // pods idle AND eligible (for the slice gate)
+  // Root identities vetoed by a pod-level tpu-pruner.dev/skip annotation:
+  // an annotated pod must protect its owner for EVERY kind, not only the
+  // group kinds the all-idle gate covers — a sibling pod of the same
+  // Deployment would otherwise scale the shared root to zero and delete
+  // the annotated pod with it.
+  std::unordered_set<std::string> vetoed_roots;
+  // Namespaces where an annotated pod's root could NOT be resolved (walk
+  // error). A safety valve must fail closed: with the protected root
+  // unknown, every target in the namespace is dropped this cycle rather
+  // than risk pruning it; transient API errors self-heal next cycle.
+  std::unordered_set<std::string> vetoed_namespaces;
 };
 
 using util::fan_out;
@@ -171,6 +183,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   struct EligiblePod {
     const core::PodMetricSample* sample;
     const json::Value* pod;
+    bool opted_out = false;  // walks to find its root, which is then vetoed
   };
   std::vector<EligiblePod> eligible;
   std::deque<json::Value> owned_pods;  // stable storage for GET results
@@ -214,6 +227,16 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       case core::Eligibility::TooYoung:
         log::info("Pod " + key + " created within lookback window, skipping");
         return;
+      case core::Eligibility::OptedOut: {
+        // Not a candidate — but its root must be vetoed for every kind, so
+        // it still walks (kept out of idle_pods: an opted-out worker also
+        // fails its group's all-idle gate).
+        log::info("Pod " + key + " is annotated " + std::string(core::kSkipAnnotation) +
+                  "=true, vetoing its root object");
+        std::lock_guard<std::mutex> lock(out_mutex);
+        eligible.push_back({&pmd, pod, /*opted_out=*/true});
+        return;
+      }
       case core::Eligibility::Eligible:
         break;
     }
@@ -248,12 +271,25 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
         target = walker::find_root_object(kube, *e.pod, &owner_cache);
       } catch (const std::exception& e2) {
         span.set_error(e2.what());
-        log::warn("Skipping " + key + ", no scalable root object: " + e2.what());
+        if (e.opted_out) {
+          // Can't learn which root the annotation protects — fail closed
+          // on the whole namespace this cycle instead of failing open.
+          log::warn("Annotated pod " + key + " has no resolvable root (" + e2.what() +
+                    "); vetoing namespace " + e.sample->ns + " this cycle");
+          std::lock_guard<std::mutex> lock(out_mutex);
+          out.vetoed_namespaces.insert(e.sample->ns);
+        } else {
+          log::warn("Skipping " + key + ", no scalable root object: " + e2.what());
+        }
       }
     }
     if (target) {
       std::lock_guard<std::mutex> lock(out_mutex);
-      out.targets.push_back(std::move(*target));
+      if (e.opted_out) {
+        out.vetoed_roots.insert(target->identity());
+      } else {
+        out.targets.push_back(std::move(*target));
+      }
     }
   });
   return out;
@@ -294,6 +330,31 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
 
   ResolveOutcome resolved = resolve_pods(args, kube, decoded.samples, cycle.context());
   std::vector<ScaleTarget> unique = core::dedup_targets(std::move(resolved.targets));
+
+  // Opt-out valves, applied before the group gate so a skipped JobSet/LWS
+  // doesn't still pay that gate's per-namespace pods LIST: (a) the root
+  // object itself carries the annotation, (b) any of its pods did.
+  {
+    std::vector<ScaleTarget> kept;
+    kept.reserve(unique.size());
+    for (ScaleTarget& t : unique) {
+      std::string why;
+      if (core::is_opted_out(t.object)) {
+        why = "annotated " + std::string(core::kSkipAnnotation) + "=true";
+      } else if (resolved.vetoed_roots.count(t.identity())) {
+        why = "vetoed by an annotated pod";
+      } else if (resolved.vetoed_namespaces.count(t.ns().value_or(""))) {
+        why = "namespace vetoed (annotated pod with unresolvable root)";
+      }
+      if (!why.empty()) {
+        log::info("Skipping [" + std::string(core::kind_name(t.kind)) + "] " +
+                  t.ns().value_or("") + ":" + t.name() + ", " + why);
+        continue;
+      }
+      kept.push_back(std::move(t));
+    }
+    unique = std::move(kept);
+  }
 
   // Multi-host group gate: a JobSet/LeaderWorkerSet is only a candidate
   // when every google.com/tpu pod of the group is idle (SURVEY.md §7
@@ -380,7 +441,7 @@ int run(const cli::Cli& args) {
 
   // Optional pull-based counters exposition (OTLP-push analog, SURVEY.md §2 #12).
   std::unique_ptr<metrics_http::Server> metrics_server;
-  if (args.metrics_port > 0) {
+  if (args.metrics_port >= 0) {  // 0 = ephemeral (port logged at startup)
     metrics_server = std::make_unique<metrics_http::Server>(args.metrics_port);
   }
   // Optional OTLP/HTTP push (reference `otel` feature; OTEL_* env config).

@@ -8,6 +8,7 @@
 #include <cstring>
 #include <stdexcept>
 #include <string>
+#include <string_view>
 
 #include "tpupruner/log.hpp"
 
@@ -51,21 +52,44 @@ void Server::serve() {
     if (rc <= 0) continue;
     int fd = ::accept(listen_fd_, nullptr, nullptr);
     if (fd < 0) continue;
-    // Read (and discard) the request line + headers; any GET gets metrics.
+    // Read until the request line is complete (a probe's first TCP segment
+    // may split mid-line), bounded by the buffer and the 1s socket timeout.
+    // /healthz (exact path, query string allowed) answers probes; any
+    // other GET gets the metrics exposition.
     char buf[2048];
     struct timeval tv{1, 0};
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-    ::recv(fd, buf, sizeof(buf), 0);
-
-    std::string body = "# tpu-pruner operational counters\n";
-    for (const auto& [name, counter] : log::counters_snapshot()) {
-      std::string metric = "tpu_pruner_" + name;
-      body += "# TYPE " + metric + (counter.gauge ? " gauge\n" : " counter\n");
-      body += metric + " " + std::to_string(counter.value) + "\n";
+    size_t have = 0;
+    while (have < sizeof(buf) - 1) {
+      ssize_t n = ::recv(fd, buf + have, sizeof(buf) - 1 - have, 0);
+      if (n <= 0) break;
+      have += static_cast<size_t>(n);
+      if (std::memchr(buf, '\n', have)) break;  // request line complete
     }
-    std::string resp =
-        "HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\nContent-Length: " +
-        std::to_string(body.size()) + "\r\nConnection: close\r\n\r\n" + body;
+    buf[have] = '\0';
+    bool healthz = false;
+    if (std::strncmp(buf, "GET ", 4) == 0) {
+      const char* path = buf + 4;
+      size_t len = std::strcspn(path, " ?\r\n");
+      healthz = std::string_view(path, len) == "/healthz";
+    }
+
+    std::string body;
+    std::string content_type = "text/plain";
+    if (healthz) {
+      body = "ok\n";
+    } else {
+      content_type = "text/plain; version=0.0.4";
+      body = "# tpu-pruner operational counters\n";
+      for (const auto& [name, counter] : log::counters_snapshot()) {
+        std::string metric = "tpu_pruner_" + name;
+        body += "# TYPE " + metric + (counter.gauge ? " gauge\n" : " counter\n");
+        body += metric + " " + std::to_string(counter.value) + "\n";
+      }
+    }
+    std::string resp = "HTTP/1.1 200 OK\r\nContent-Type: " + content_type +
+                       "\r\nContent-Length: " + std::to_string(body.size()) +
+                       "\r\nConnection: close\r\n\r\n" + body;
     ::send(fd, resp.data(), resp.size(), MSG_NOSIGNAL);
     ::close(fd);
   }

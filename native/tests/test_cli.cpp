@@ -53,3 +53,15 @@ TP_TEST(cli_monitoring_endpoint_override) {
   TP_CHECK_EQ(tpupruner::cli::prometheus_base(cli),
               "http://127.0.0.1:9/v1/projects/p1/location/global/prometheus");
 }
+
+TP_TEST(cli_metrics_port_semantics) {
+  // unset and "0" both mean disabled (an operator's explicit 0 must not
+  // start binding random ports); "auto" = ephemeral; else the port.
+  TP_CHECK_EQ(parse({"--prometheus-url", "http://p"}).metrics_port, -1);
+  TP_CHECK_EQ(parse({"--prometheus-url", "http://p", "--metrics-port", "0"}).metrics_port, -1);
+  TP_CHECK_EQ(parse({"--prometheus-url", "http://p", "--metrics-port", "auto"}).metrics_port, 0);
+  TP_CHECK_EQ(parse({"--prometheus-url", "http://p", "--metrics-port", "8080"}).metrics_port,
+              8080);
+  TP_CHECK(parse_fails({"--prometheus-url", "http://p", "--metrics-port", "65536"},
+                       "out of range"));
+}

@@ -292,6 +292,24 @@ def test_bad_timestamp_skipped(built):
     assert r["result"] == "bad_timestamp"
 
 
+def test_skip_annotation_opts_pod_out(built):
+    """tpu-pruner.dev/skip=true vetoes an otherwise-eligible pod (operator
+    opt-out valve; no reference analog)."""
+    p = pod("2026-07-29T07:24:59Z")
+    p["metadata"]["annotations"] = {"tpu-pruner.dev/skip": "true"}
+    r = native.check_eligibility(p, NOW, LOOKBACK)
+    assert r["result"] == "opted_out"
+    assert not r["eligible"]
+
+
+def test_skip_annotation_non_true_values_ignored(built):
+    for value in ("false", "True", "1", ""):
+        p = pod("2026-07-29T07:24:59Z")
+        p["metadata"]["annotations"] = {"tpu-pruner.dev/skip": value}
+        r = native.check_eligibility(p, NOW, LOOKBACK)
+        assert r["result"] == "eligible", value
+
+
 # ── metric-sample decode (lib.rs:136-187, main.rs:416-437) ─────────────────
 
 

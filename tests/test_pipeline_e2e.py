@@ -380,6 +380,92 @@ def test_metrics_endpoint_serves_counters(built, fake_prom, fake_k8s):
     assert "tpu_pruner_query_returned_candidates" in body
 
 
+def test_skip_annotation_on_pod_vetoes_scaledown(built, fake_prom, fake_k8s):
+    """A pod annotated tpu-pruner.dev/skip=true protects its root object
+    even when an UN-annotated idle sibling resolves to the same root — the
+    sibling must not scale the shared Deployment away (which would delete
+    the annotated pod with it)."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=2)
+    pods[0]["metadata"]["annotations"] = {"tpu-pruner.dev/skip": "true"}
+    for pod in pods:  # both idle; only one annotated
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml")
+
+    proc = run_pruner(fake_prom, fake_k8s)
+    assert fake_k8s.scale_patches() == []
+    assert fake_k8s.events == []
+    assert "vetoed by an annotated pod" in proc.stderr
+
+
+def test_skip_annotation_on_root_object_vetoes_scaledown(built, fake_prom, fake_k8s):
+    """One skip annotation on the owner (here the Deployment) protects the
+    whole workload without annotating every pod."""
+    dep, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=2)
+    dep["metadata"]["annotations"] = {"tpu-pruner.dev/skip": "true"}
+    for pod in pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml")
+
+    proc = run_pruner(fake_prom, fake_k8s)
+    assert fake_k8s.scale_patches() == []
+    assert fake_k8s.events == []
+    assert "annotated tpu-pruner.dev/skip=true" in proc.stderr
+
+
+def test_skip_annotation_unresolvable_root_fails_closed(built, fake_prom, fake_k8s):
+    """If an annotated pod's root can't be resolved (here: ownerRef to a
+    ReplicaSet that no longer exists), the safety valve can't know which
+    root to protect, so the whole namespace is vetoed for the cycle.
+    Other namespaces are unaffected."""
+    fake_k8s.add_pod("ml", "ghost-0",
+                     owners=[fake_k8s.owner("ReplicaSet", "gone-rs", "gone-uid")])
+    orphan = fake_k8s.objects["/api/v1/namespaces/ml/pods/ghost-0"]
+    orphan["metadata"]["annotations"] = {"tpu-pruner.dev/skip": "true"}
+    fake_prom.add_idle_pod_series("ghost-0", "ml")
+    # idle sibling workload in the SAME namespace: spared this cycle
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    # idle workload in ANOTHER namespace: still pruned
+    _, _, pods2 = fake_k8s.add_deployment_chain("other", "victim")
+    fake_prom.add_idle_pod_series(pods2[0]["metadata"]["name"], "other")
+
+    proc = run_pruner(fake_prom, fake_k8s)
+    assert "vetoing namespace ml" in proc.stderr
+    assert [p for p, _ in fake_k8s.scale_patches()] == \
+        ["/apis/apps/v1/namespaces/other/deployments/victim/scale"]
+
+
+def test_healthz_endpoint(built, fake_prom, fake_k8s):
+    """/healthz on the metrics port answers K8s liveness/readiness probes
+    (hack/deployment.yaml) without the metrics exposition."""
+    import re
+    import urllib.request
+
+    # --metrics-port auto binds an ephemeral port; the daemon logs the real
+    # one (no bind-then-close TOCTOU race against other test processes).
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "dry-run", "--daemon-mode", "--check-interval", "60",
+           "--metrics-port", "auto"]
+    env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin"}
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        for line in proc.stderr:
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port, "daemon never reported its metrics port"
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5).read().decode()
+        assert body == "ok\n"
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "tpu-pruner operational counters" in metrics  # still the exposition
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
 def test_daemon_sigterm_graceful_shutdown(built, fake_prom, fake_k8s):
     """SIGTERM (what a K8s rollout sends) ends the daemon cleanly: exit 0,
     a graceful-shutdown log line, queue drained — not the default
