@@ -21,7 +21,12 @@ from pathlib import Path
 REPO_ROOT = Path(__file__).resolve().parent.parent
 BUILD_DIR = REPO_ROOT / "build"
 LIB_PATH = BUILD_DIR / "libtpupruner.so"
-DAEMON_PATH = BUILD_DIR / "tpu-pruner"
+# TP_DAEMON_PATH points the e2e tiers at an alternate binary — e.g.
+# build-tsan/tpu-pruner to run the whole hermetic suite under TSan
+# (`just test-tsan-e2e`), exercising the daemon's real concurrency
+# (resolve fan-out, consumer pool, metrics server, OTLP thread) rather
+# than only the unit tests.
+DAEMON_PATH = Path(os.environ.get("TP_DAEMON_PATH", BUILD_DIR / "tpu-pruner"))
 TESTS_PATH = BUILD_DIR / "tpupruner_tests"
 
 _lib = None
