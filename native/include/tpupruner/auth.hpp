@@ -10,8 +10,11 @@
 //   → in-cluster ServiceAccount token file
 //   → kubeconfig user token / tokenFile
 //   → GCE metadata server access token (Workload Identity / ADC path)
-//   → `gcloud auth print-access-token` subprocess (operator-laptop analog
-//     of the reference's `oc whoami -t`).
+//   → `gcloud auth print-access-token` subprocess (operator-laptop path)
+//   → `oc whoami -t` subprocess (the reference's literal last resort,
+//     kept for drop-in --device=gpu use on OpenShift).
+// Subprocess steps run under `timeout 5` so a wedged CLI (e.g. oc logged
+// into an unreachable cluster) can't stall every cycle's client rebuild.
 // Every step is overridable for hermetic tests (env vars below).
 #pragma once
 
@@ -27,7 +30,8 @@ struct TokenOptions {
   //   TPU_PRUNER_SA_TOKEN_FILE    — in-cluster SA token path override
   //   KUBECONFIG                  — kubeconfig path ("~/.kube/config" default)
   //   GCE_METADATA_HOST           — metadata server host:port override
-  //   TPU_PRUNER_DISABLE_GCLOUD   — skip the subprocess fallback
+  //   TPU_PRUNER_DISABLE_GCLOUD   — skip the gcloud subprocess fallback
+  //   TPU_PRUNER_DISABLE_OC       — skip the oc subprocess fallback
   bool allow_metadata_server = true;
   bool allow_gcloud = true;
   int metadata_timeout_ms = 2000;
@@ -42,5 +46,6 @@ std::optional<std::string> token_from_sa_file();
 std::optional<std::string> token_from_kubeconfig();
 std::optional<std::string> token_from_metadata_server(int timeout_ms);
 std::optional<std::string> token_from_gcloud();
+std::optional<std::string> token_from_oc();  // reference last resort, lib.rs:225-230
 
 }  // namespace tpupruner::auth

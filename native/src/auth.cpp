@@ -53,9 +53,10 @@ std::optional<std::string> token_from_metadata_server(int timeout_ms) {
   }
 }
 
-std::optional<std::string> token_from_gcloud() {
-  // Operator-laptop fallback, the analog of `oc whoami -t` (lib.rs:225-230).
-  FILE* pipe = ::popen("gcloud auth print-access-token 2>/dev/null", "r");
+namespace {
+
+std::optional<std::string> token_from_command(const char* cmd) {
+  FILE* pipe = ::popen(cmd, "r");
   if (!pipe) return std::nullopt;
   std::string out;
   char buf[4096];
@@ -66,6 +67,21 @@ std::optional<std::string> token_from_gcloud() {
   std::string token = util::trim(out);
   if (token.empty()) return std::nullopt;
   return token;
+}
+
+}  // namespace
+
+std::optional<std::string> token_from_gcloud() {
+  // Operator-laptop fallback. `timeout 5`: the client is rebuilt every
+  // cycle, so a wedged CLI must not stall the daemon (a missing timeout
+  // binary fails the step harmlessly; in-cluster auth never reaches here).
+  return token_from_command("timeout 5 gcloud auth print-access-token 2>/dev/null");
+}
+
+std::optional<std::string> token_from_oc() {
+  // The reference's literal last resort (lib.rs:225-230) — kept for
+  // drop-in --device=gpu use on OpenShift against Thanos.
+  return token_from_command("timeout 5 oc whoami -t 2>/dev/null");
 }
 
 std::optional<std::string> get_bearer_token(const TokenOptions& opts) {
@@ -80,6 +96,9 @@ std::optional<std::string> get_bearer_token(const TokenOptions& opts) {
   }
   if (opts.allow_gcloud && !util::env("TPU_PRUNER_DISABLE_GCLOUD")) {
     if (auto t = token_from_gcloud()) return t;
+  }
+  if (opts.allow_gcloud && !util::env("TPU_PRUNER_DISABLE_OC")) {
+    if (auto t = token_from_oc()) return t;
   }
   return std::nullopt;
 }

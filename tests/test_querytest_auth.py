@@ -186,6 +186,29 @@ def test_sa_token_file_used_when_no_env(built, fake_prom, fake_k8s, tmp_path):
     assert fake_prom.auth_headers == ["Bearer sa-file-token"]
 
 
+def test_subprocess_fallbacks_gcloud_then_oc(built, fake_prom, fake_k8s, tmp_path):
+    """Last resorts in order: `gcloud auth print-access-token`, then the
+    reference's literal `oc whoami -t` (lib.rs:225-230). Here gcloud is
+    absent and a stub `oc` supplies the token."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    oc = bindir / "oc"
+    oc.write_text("#!/bin/sh\n[ \"$1\" = whoami ] && echo oc-token\n")
+    oc.chmod(0o755)
+    failing_gcloud = bindir / "gcloud"  # shadows any real gcloud on PATH
+    failing_gcloud.write_text("#!/bin/sh\nexit 1\n")
+    failing_gcloud.chmod(0o755)
+    proc = subprocess.run(
+        [str(DAEMON_PATH), "--prometheus-url", fake_prom.url, "--run-mode", "dry-run"],
+        capture_output=True, text=True, timeout=60,
+        env={"KUBE_API_URL": fake_k8s.url,
+             "TPU_PRUNER_DISABLE_METADATA": "1",
+             "PATH": f"{bindir}:/usr/bin:/bin"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert fake_prom.auth_headers == ["Bearer oc-token"]
+
+
 def test_kubeconfig_token_scan(built, fake_prom, fake_k8s, tmp_path):
     kubeconfig = tmp_path / "config"
     kubeconfig.write_text(
