@@ -52,6 +52,7 @@ struct Cli {
   std::string tensorcore_metric;          // --tensorcore-metric override
   std::string duty_cycle_metric;          // --duty-cycle-metric override
   std::string hbm_metric;                 // --hbm-metric override
+  int64_t max_scale_per_cycle = 0;        // --max-scale-per-cycle (0 = unlimited)
   int64_t resolve_concurrency = 10;       // --resolve-concurrency (ref: fixed 10)
   int64_t resolve_batch_threshold = 8;    // --resolve-batch-threshold (0 = off)
   int64_t scale_concurrency = 8;          // --scale-concurrency (ref: serial consumer)

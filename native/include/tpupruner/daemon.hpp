@@ -28,10 +28,12 @@ struct CycleStats {
 };
 
 // One evaluation cycle (reference: run_query_and_scale, main.rs:390-570).
-// `enqueue` receives each surviving target (already enabled-kind agnostic —
-// filtering happens consumer-side, as in the reference). Throws on query
-// failure (feeds the failure budget).
+// `enqueue` receives each surviving target (enabled-kind filtering stays
+// consumer-side, as in the reference; `enabled` is used only so the
+// --max-scale-per-cycle budget counts actionable targets, not ones the
+// consumer will skip). Throws on query failure (feeds the failure budget).
 CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
+                     core::ResourceSet enabled,
                      const std::function<void(core::ScaleTarget)>& enqueue);
 
 // Full daemon: spawns the two threads, joins them, returns the process

@@ -91,6 +91,11 @@ TPU FLAGS:
                                 collection LIST instead of per-object GETs;
                                 0 disables batching [default: 8]
       --scale-concurrency <N>   concurrent scale actuations [default: 8]
+      --max-scale-per-cycle <N> blast-radius circuit breaker: pause at most N
+                                root objects per cycle, deferring the rest
+                                (a metric-plane outage reading the whole fleet
+                                as idle then can't suspend it all at once);
+                                0 = unlimited [default: 0]
       --metrics-port <P>        serve Prometheus /metrics + /healthz on this port
                                 (0 = disabled, "auto" = ephemeral)
       --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
@@ -164,6 +169,12 @@ Cli parse(int argc, char** argv) {
        [&](const std::string& v) {
          cli.scale_concurrency = parse_int("--scale-concurrency", v);
          if (cli.scale_concurrency < 1) throw CliError("--scale-concurrency must be >= 1");
+       }},
+      {"--max-scale-per-cycle",
+       [&](const std::string& v) {
+         cli.max_scale_per_cycle = parse_int("--max-scale-per-cycle", v);
+         if (cli.max_scale_per_cycle < 0)
+           throw CliError("--max-scale-per-cycle must be >= 0");
        }},
       {"--metrics-port",
        [&](const std::string& v) {

@@ -310,6 +310,7 @@ static auto with_span(otlp::Span& span, Fn&& fn) -> decltype(fn()) {
 }  // namespace
 
 CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
+                     core::ResourceSet enabled,
                      const std::function<void(ScaleTarget)>& enqueue) {
   // Cycle span (reference #[tracing::instrument] on run_query_and_scale,
   // main.rs:390); children below mirror the instrumented callees.
@@ -385,6 +386,40 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   survivors.reserve(unique.size());
   for (size_t i = 0; i < unique.size(); ++i) {
     if (keep[i]) survivors.push_back(std::move(unique[i]));
+  }
+
+  // Blast-radius circuit breaker: a poisoned metric plane (scrape outage,
+  // relabeling bug) can read the entire fleet as idle; cap how much of it
+  // one cycle may pause. Deferred targets are re-discovered next cycle if
+  // still idle — the daemon is stateless, so "defer" is free. The budget
+  // counts only enabled-kind targets: disabled kinds pass through (the
+  // consumer skips them, as in the reference) without consuming slots.
+  if (args.max_scale_per_cycle > 0) {
+    size_t budget = static_cast<size_t>(args.max_scale_per_cycle);
+    size_t actionable = 0, deferred = 0;
+    std::vector<ScaleTarget> capped;
+    capped.reserve(survivors.size());
+    for (ScaleTarget& t : survivors) {
+      if (!(enabled & core::flag(t.kind))) {
+        capped.push_back(std::move(t));
+        continue;
+      }
+      ++actionable;
+      if (budget > 0) {
+        --budget;
+        capped.push_back(std::move(t));
+      } else {
+        ++deferred;
+      }
+    }
+    if (deferred > 0) {
+      log::warn("Circuit breaker: " + std::to_string(actionable) +
+                " scale candidates exceed --max-scale-per-cycle=" +
+                std::to_string(args.max_scale_per_cycle) + "; deferring " +
+                std::to_string(deferred) + " to later cycles");
+      log::counter_add("scale_deferred", static_cast<int64_t>(deferred));
+    }
+    survivors = std::move(capped);
   }
 
   CycleStats stats;
@@ -512,7 +547,7 @@ int run(const cli::Cli& args) {
     auto cycle_start = std::chrono::steady_clock::now();
     last_cycle_failed = false;
     try {
-      CycleStats stats = run_cycle(args, query, kube, [&](ScaleTarget t) {
+      CycleStats stats = run_cycle(args, query, kube, enabled, [&](ScaleTarget t) {
         queue.push(std::move(t));
       });
       consecutive_failures = 0;

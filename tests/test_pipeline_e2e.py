@@ -410,6 +410,52 @@ def test_skip_annotation_on_root_object_vetoes_scaledown(built, fake_prom, fake_
     assert "annotated tpu-pruner.dev/skip=true" in proc.stderr
 
 
+def test_max_scale_per_cycle_circuit_breaker(built, fake_prom, fake_k8s):
+    """--max-scale-per-cycle caps the blast radius of one cycle: with 6
+    idle Deployments and a cap of 2, exactly 2 are paused and 4 deferred
+    (a poisoned metric plane can't suspend the whole fleet at once)."""
+    for i in range(6):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    proc = run_pruner(fake_prom, fake_k8s, "--max-scale-per-cycle", "2")
+    assert len(fake_k8s.scale_patches()) == 2
+    assert len(fake_k8s.events) == 2
+    assert "Circuit breaker: 6 scale candidates" in proc.stderr
+    assert "deferring 4 to later cycles" in proc.stderr
+
+
+def test_max_scale_per_cycle_budget_counts_only_enabled_kinds(built, fake_prom, fake_k8s):
+    """Roots of disabled kinds pass through to the consumer (which skips
+    them, reference semantics) WITHOUT consuming circuit-breaker slots: a
+    disabled JobSet must not starve enabled Deployments of the budget."""
+    _, pods = fake_k8s.add_jobset_slice("tpu-jobs", "slice-a", num_hosts=2)
+    for pod in pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "tpu-jobs")
+    for i in range(3):
+        _, _, dpods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(dpods[0]["metadata"]["name"], "ml")
+
+    # JobSet kind disabled ('j' absent); budget 3 → all 3 Deployments land
+    proc = run_pruner(fake_prom, fake_k8s,
+                      "--enabled-resources", "d", "--max-scale-per-cycle", "3")
+    paths = sorted(p for p, _ in fake_k8s.scale_patches())
+    assert paths == [f"/apis/apps/v1/namespaces/ml/deployments/dep-{i}/scale"
+                     for i in range(3)]
+    assert "Circuit breaker" not in proc.stderr
+    assert "Skipping resource type JobSet" in proc.stderr
+
+
+def test_max_scale_per_cycle_unlimited_by_default(built, fake_prom, fake_k8s):
+    for i in range(6):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    proc = run_pruner(fake_prom, fake_k8s)
+    assert len(fake_k8s.scale_patches()) == 6
+    assert "Circuit breaker" not in proc.stderr
+
+
 def test_skip_annotation_unresolvable_root_fails_closed(built, fake_prom, fake_k8s):
     """If an annotated pod's root can't be resolved (here: ownerRef to a
     ReplicaSet that no longer exists), the safety valve can't know which
