@@ -96,7 +96,10 @@ def run_e2e(k8s, prom):
     patched = {p for p, _ in k8s.patches}
     if len(patched) != TOTAL_TARGETS:
         raise RuntimeError(f"expected {TOTAL_TARGETS} patched targets, got {len(patched)}")
-    return elapsed
+    # p50 detect→scaledown (BASELINE.json north-star metric): per-target
+    # latency from daemon start (detection begins) to its patch landing.
+    p50 = statistics.median(t - t0 for t in k8s.patch_times)
+    return elapsed, p50
 
 
 def model_reference_ceiling(k8s):
@@ -151,11 +154,26 @@ def model_reference_ceiling(k8s):
 
     event_body = {"metadata": {"name": "sim-event"}, "reason": "sim", "type": "Normal"}
     t0 = time.monotonic()
+    cum_scale = []
     for ns, patch_path, body in scale_ops:
         req(f"/api/v1/namespaces/{ns}/events", "POST", event_body)
         req(patch_path, "PATCH", body)
-    scale_s = time.monotonic() - t0
-    return resolve_s + scale_s, resolve_s, scale_s
+        cum_scale.append(time.monotonic() - t0)
+    scale_s = cum_scale[-1]
+    # p50 detect→scaledown under the reference's pipelined shape (producer
+    # fan-out feeds a channel drained by the serial consumer concurrently,
+    # main.rs:284-375): target i's patch lands no earlier than both its
+    # resolve completing (~uniform progress over resolve_s) and the serial
+    # consumer reaching it.
+    n = len(cum_scale)
+    latencies = [max(resolve_s * (i + 1) / n, cum_scale[i]) for i in range(n)]
+    ref_p50 = statistics.median(latencies)
+    # Pipelined wall: the cycle ends when the last target is scaled — its
+    # resolve must finish (resolve_s) and the consumer then needs one more
+    # scale op if it was ahead. (Strictly sequential resolve_s + scale_s
+    # would overstate the reference's disadvantage.)
+    ref_wall = max(latencies[-1], resolve_s + scale_s / n)
+    return ref_wall, resolve_s, scale_s, ref_p50
 
 
 def tpu_fleet_eval():
@@ -212,8 +230,8 @@ def main():
     log(f"e2e: {TOTAL_PODS} pods / {TOTAL_CHIPS} chips / {TOTAL_TARGETS} targets")
     k8s, prom = build_cluster()
     try:
-        elapsed = run_e2e(k8s, prom)
-        ref_wall, ref_resolve, ref_scale = model_reference_ceiling(k8s)
+        elapsed, p50_s = run_e2e(k8s, prom)
+        ref_wall, ref_resolve, ref_scale, ref_p50 = model_reference_ceiling(k8s)
     finally:
         k8s.stop()
         prom.stop()
@@ -221,9 +239,10 @@ def main():
     pods_per_s = TOTAL_PODS / elapsed
     chips_per_hr = TOTAL_CHIPS / elapsed * 3600
     ref_chips_per_hr = TOTAL_CHIPS / ref_wall * 3600
-    log(f"e2e: {elapsed:.2f}s wall → {pods_per_s:.0f} pods/s, "
-        f"{chips_per_hr:.0f} chips/hr | ref simulated: {ref_wall:.2f}s "
-        f"(resolve {ref_resolve:.2f}s + scale {ref_scale:.2f}s)")
+    log(f"e2e: {elapsed:.2f}s wall, p50 detect→scaledown {p50_s*1000:.0f}ms → "
+        f"{pods_per_s:.0f} pods/s, {chips_per_hr:.0f} chips/hr | ref simulated "
+        f"(pipelined): {ref_wall:.2f}s wall, p50 {ref_p50*1000:.0f}ms "
+        f"(resolve {ref_resolve:.2f}s, serial scale {ref_scale:.2f}s)")
 
     try:
         tpu = tpu_fleet_eval()
@@ -240,12 +259,14 @@ def main():
         "vs_baseline": round(chips_per_hr / ref_chips_per_hr, 3),
         "e2e_wall_s": round(elapsed, 3),
         "e2e_pods_per_s": round(pods_per_s, 1),
+        "p50_detect_to_scaledown_s": round(p50_s, 3),
         "cluster": {"pods": TOTAL_PODS, "chips": TOTAL_CHIPS, "targets": TOTAL_TARGETS,
                     "jobset_slices": NUM_SLICES},
         "baseline_model": {"ref_wall_s": round(ref_wall, 3),
                            "ref_resolve_s": round(ref_resolve, 3),
                            "ref_scale_s": round(ref_scale, 3),
-                           "note": "reference simulated on same fake API: 10-way resolve x 3 GETs/pod + serial 2-call scale (reference publishes no numbers)"},
+                           "ref_p50_detect_to_scaledown_s": round(ref_p50, 3),
+                           "note": "reference simulated on same fake API, pipelined producer/consumer model: 10-way resolve x 3 GETs/pod overlapping a serial 2-call-per-target consumer (reference publishes no numbers)"},
         "fleet_eval": tpu,
     }))
 

@@ -83,6 +83,7 @@ class FakeK8s:
         self.objects: dict[str, dict] = {}
         self.events: list[dict] = []
         self.patches: list[tuple[str, dict]] = []  # (path, body) in arrival order
+        self.patch_times: list[float] = []  # time.monotonic() per patch (latency benches)
         self.requests: list[tuple[str, str]] = []  # (method, path)
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
@@ -349,6 +350,7 @@ class FakeK8s:
                 with fake._lock:
                     fake.requests.append(("PATCH", self.path))
                     fake.patches.append((path, body))
+                    fake.patch_times.append(time.monotonic())
                     target_path = path.removesuffix("/scale")
                     obj = fake.objects.get(target_path)
                     if obj is None:
