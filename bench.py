@@ -160,20 +160,12 @@ def model_reference_ceiling(k8s):
         req(patch_path, "PATCH", body)
         cum_scale.append(time.monotonic() - t0)
     scale_s = cum_scale[-1]
-    # p50 detect→scaledown under the reference's pipelined shape (producer
-    # fan-out feeds a channel drained by the serial consumer concurrently,
-    # main.rs:284-375): target i's patch lands no earlier than both its
-    # resolve completing (~uniform progress over resolve_s) and the serial
-    # consumer reaching it.
-    n = len(cum_scale)
-    latencies = [max(resolve_s * (i + 1) / n, cum_scale[i]) for i in range(n)]
-    ref_p50 = statistics.median(latencies)
-    # Pipelined wall: the cycle ends when the last target is scaled — its
-    # resolve must finish (resolve_s) and the consumer then needs one more
-    # scale op if it was ahead. (Strictly sequential resolve_s + scale_s
-    # would overstate the reference's disadvantage.)
-    ref_wall = max(latencies[-1], resolve_s + scale_s / n)
-    return ref_wall, resolve_s, scale_s, ref_p50
+    # detect→scaledown per target: the reference's resolve fan-out is a
+    # BARRIER — targets are collected into a HashSet for dedup and only
+    # then sent down the channel (main.rs:534, 552), so no patch can land
+    # before resolve_s, and the serial consumer's progression adds on top.
+    ref_p50 = statistics.median(resolve_s + c for c in cum_scale)
+    return resolve_s + scale_s, resolve_s, scale_s, ref_p50
 
 
 def tpu_fleet_eval():
@@ -240,9 +232,9 @@ def main():
     chips_per_hr = TOTAL_CHIPS / elapsed * 3600
     ref_chips_per_hr = TOTAL_CHIPS / ref_wall * 3600
     log(f"e2e: {elapsed:.2f}s wall, p50 detect→scaledown {p50_s*1000:.0f}ms → "
-        f"{pods_per_s:.0f} pods/s, {chips_per_hr:.0f} chips/hr | ref simulated "
-        f"(pipelined): {ref_wall:.2f}s wall, p50 {ref_p50*1000:.0f}ms "
-        f"(resolve {ref_resolve:.2f}s, serial scale {ref_scale:.2f}s)")
+        f"{pods_per_s:.0f} pods/s, {chips_per_hr:.0f} chips/hr | ref simulated: "
+        f"{ref_wall:.2f}s wall, p50 {ref_p50*1000:.0f}ms "
+        f"(resolve {ref_resolve:.2f}s barrier + serial scale {ref_scale:.2f}s)")
 
     try:
         tpu = tpu_fleet_eval()
@@ -266,7 +258,7 @@ def main():
                            "ref_resolve_s": round(ref_resolve, 3),
                            "ref_scale_s": round(ref_scale, 3),
                            "ref_p50_detect_to_scaledown_s": round(ref_p50, 3),
-                           "note": "reference simulated on same fake API, pipelined producer/consumer model: 10-way resolve x 3 GETs/pod overlapping a serial 2-call-per-target consumer (reference publishes no numbers)"},
+                           "note": "reference simulated on same fake API: 10-way resolve x 3 GETs/pod with a collect barrier (HashSet dedup, main.rs:534) before the serial 2-call-per-target consumer (reference publishes no numbers)"},
         "fleet_eval": tpu,
     }))
 

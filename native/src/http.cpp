@@ -124,6 +124,12 @@ int connect_with_timeout(const std::string& host, int port, int timeout_ms) {
   fail("connect " + host + ":" + port_s + ": " + last_err);
 }
 
+// Largest response the client will buffer. Far above any real Prometheus
+// vector or K8s LIST this daemon sees, but finite: a hostile or broken
+// server advertising a multi-terabyte content-length / chunk size must
+// produce a transport error, not an OOM kill.
+constexpr size_t kMaxResponseBytes = 256u << 20;  // 256 MiB
+
 // Incremental reader with buffering for header/line parsing.
 struct Reader {
   Conn& conn;
@@ -134,6 +140,16 @@ struct Reader {
 
   bool fill() {
     if (eof) return false;
+    // Cap the UNCONSUMED tail, not the lifetime stream: consumed bytes are
+    // trimmed below, so a legal body of exactly kMaxResponseBytes passes
+    // while a hostile one fails before buffering past ~the cap.
+    if (buf.size() - pos > kMaxResponseBytes) {
+      fail("response exceeds " + std::to_string(kMaxResponseBytes) + " bytes");
+    }
+    if (pos > (1u << 20)) {  // trim consumed prefix; keeps peak ≈ cap, not 2x
+      buf.erase(0, pos);
+      pos = 0;
+    }
     char chunk[16384];
     size_t n = conn.read(chunk, sizeof(chunk));
     if (n == 0) {
@@ -159,6 +175,10 @@ struct Reader {
   }
 
   std::string read_exact(size_t n) {
+    if (n > kMaxResponseBytes) {
+      fail("declared body size " + std::to_string(n) + " exceeds " +
+           std::to_string(kMaxResponseBytes) + " bytes");
+    }
     while (buf.size() - pos < n) {
       if (!fill()) fail("unexpected EOF in body");
     }
@@ -341,6 +361,9 @@ Response Client::request_once(const Request& req, const Url& url, bool allow_reu
         }
         if (chunk_size == 0) break;
         resp.body += reader.read_exact(chunk_size);
+        if (resp.body.size() > kMaxResponseBytes) {
+          fail("chunked response exceeds " + std::to_string(kMaxResponseBytes) + " bytes");
+        }
         reader.read_line();  // CRLF after chunk data
       }
       // Trailers until blank line; the body is already complete, so a

@@ -543,6 +543,45 @@ def test_daemon_sigterm_graceful_shutdown(built, fake_prom, fake_k8s):
     assert "Received SIGTERM, shutting down gracefully" in stderr
 
 
+def test_oversized_response_is_transport_error_not_oom(built, fake_k8s):
+    """A server advertising a multi-terabyte Content-Length must produce a
+    clean transport error (feeding the failure budget), not buffer until
+    the OOM killer fires."""
+    import socket
+    import threading
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def serve():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            conn.recv(65536)
+            conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Type: application/json\r\n"
+                         b"Content-Length: 99999999999999\r\n\r\n{}")
+            conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", f"http://127.0.0.1:{port}",
+             "--run-mode", "dry-run"],
+            capture_output=True, text=True, timeout=60,
+            env={"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin"},
+        )
+        assert proc.returncode == 1
+        assert "exceeds" in proc.stderr
+    finally:
+        srv.close()
+
+
 # ── failure budget (main.rs:299-320) ───────────────────────────────────────
 
 
