@@ -186,12 +186,16 @@ def tpu_fleet_eval():
         t0 = time.monotonic()
         run()
         compile_s = time.monotonic() - t0
-        iters = 20
-        t0 = time.monotonic()
-        for _ in range(iters):
-            run()
-        per_cycle = (time.monotonic() - t0) / iters
-        return per_cycle, compile_s
+        # Median-of-batches: single-batch means on a shared TPU have shown
+        # 4x run-to-run swings (device contention); 5 batches of 10 with a
+        # median collapse that noise.
+        batch_means = []
+        for _ in range(5):
+            t0 = time.monotonic()
+            for _ in range(10):
+                run()
+            batch_means.append((time.monotonic() - t0) / 10)
+        return statistics.median(batch_means), compile_s
 
     per_cycle, compile_s = measure(evaluate_fleet)
     result = {
