@@ -543,6 +543,65 @@ def test_daemon_sigterm_graceful_shutdown(built, fake_prom, fake_k8s):
     assert "Received SIGTERM, shutting down gracefully" in stderr
 
 
+def test_daemon_soak_with_churn(built, fake_prom, fake_k8s):
+    """Multi-cycle soak: new idle workloads appear while the daemon runs;
+    each is reclaimed in a later cycle (stateless rediscovery), counters
+    accumulate on /metrics, and SIGTERM still exits cleanly afterwards."""
+    import re
+    import signal
+    import time
+    import urllib.request
+
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "gen-0")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "scale-down", "--daemon-mode", "--check-interval", "1",
+           "--metrics-port", "auto"]
+    env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin"}
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        for line in proc.stderr:
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port
+
+        def patched_paths():
+            return {p for p, _ in fake_k8s.scale_patches()}
+
+        # three churn generations, each added only after the previous landed
+        for gen in range(1, 4):
+            want = f"/apis/apps/v1/namespaces/ml/deployments/gen-{gen - 1}/scale"
+            deadline = time.time() + 30
+            while time.time() < deadline and want not in patched_paths():
+                time.sleep(0.2)
+            assert want in patched_paths(), f"gen-{gen - 1} never reclaimed"
+            _, _, pods = fake_k8s.add_deployment_chain("ml", f"gen-{gen}")
+            fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+        deadline = time.time() + 30
+        while time.time() < deadline and len(patched_paths()) < 4:
+            time.sleep(0.2)
+        assert len(patched_paths()) == 4
+
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        m = re.search(r"tpu_pruner_scale_successes (\d+)", body)
+        assert m and int(m.group(1)) >= 4, body
+
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+        assert proc.returncode == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
 def test_oversized_response_is_transport_error_not_oom(built, fake_k8s):
     """A server advertising a multi-terabyte Content-Length must produce a
     clean transport error (feeding the failure budget), not buffer until
