@@ -99,7 +99,8 @@ def run_e2e(k8s, prom):
     # p50 detect→scaledown (BASELINE.json north-star metric): per-target
     # latency from daemon start (detection begins) to its patch landing.
     p50 = statistics.median(t - t0 for t in k8s.patch_times)
-    return elapsed, p50
+    api_calls = len(k8s.requests)  # batched LISTs keep this near O(ns x kinds)
+    return elapsed, p50, api_calls
 
 
 def model_reference_ceiling(k8s):
@@ -226,8 +227,10 @@ def main():
     log(f"e2e: {TOTAL_PODS} pods / {TOTAL_CHIPS} chips / {TOTAL_TARGETS} targets")
     k8s, prom = build_cluster()
     try:
-        elapsed, p50_s = run_e2e(k8s, prom)
+        elapsed, p50_s, api_calls = run_e2e(k8s, prom)
+        ref_calls_before = len(k8s.requests)
         ref_wall, ref_resolve, ref_scale, ref_p50 = model_reference_ceiling(k8s)
+        ref_api_calls = len(k8s.requests) - ref_calls_before
     finally:
         k8s.stop()
         prom.stop()
@@ -256,6 +259,8 @@ def main():
         "e2e_wall_s": round(elapsed, 3),
         "e2e_pods_per_s": round(pods_per_s, 1),
         "p50_detect_to_scaledown_s": round(p50_s, 3),
+        "k8s_api_calls": api_calls,
+        "ref_k8s_api_calls": ref_api_calls,
         "cluster": {"pods": TOTAL_PODS, "chips": TOTAL_CHIPS, "targets": TOTAL_TARGETS,
                     "jobset_slices": NUM_SLICES},
         "baseline_model": {"ref_wall_s": round(ref_wall, 3),
