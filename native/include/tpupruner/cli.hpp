@@ -61,6 +61,10 @@ struct Cli {
   std::string otlp_endpoint;              // --otlp-endpoint (default: $OTEL_EXPORTER_OTLP_ENDPOINT)
   std::string gcp_project;                // --gcp-project (Cloud Monitoring PromQL API)
   std::string monitoring_endpoint = "https://monitoring.googleapis.com";  // --monitoring-endpoint
+  bool leader_elect = false;              // --leader-elect (HA; requires daemon mode)
+  std::string lease_namespace;            // --lease-namespace (default: $POD_NAMESPACE or "tpu-pruner")
+  std::string lease_name = "tpu-pruner";  // --lease-name
+  int64_t lease_duration = 15;            // --lease-duration seconds
 
   bool dry_run() const { return run_mode != "scale-down"; }
 };

@@ -1,0 +1,73 @@
+// Lease-based leader election (coordination.k8s.io/v1).
+//
+// No reference analog — the reference runs a single replica and relies on
+// crash-only restarts. With --leader-elect, operators can run 2+ replicas
+// for fast failover: exactly one runs evaluation cycles; standbys renew
+// their candidacy and take over when the holder's lease expires.
+//
+// Semantics (the standard K8s leader-election recipe, client-go style):
+// - the Lease object's spec.holderIdentity names the leader;
+// - the holder renews spec.renewTime every leaseDuration/3;
+// - a candidate takes over iff the lease RECORD (holder, renewTime) has
+//   remained unchanged for > leaseDuration by the candidate's own
+//   monotonic clock — never by comparing the holder's wall-clock
+//   renewTime against the local wall clock, which cross-replica skew
+//   would break — using a resourceVersion-preconditioned patch so racing
+//   candidates can't both win (the API server 409s the loser);
+// - a leader that cannot reach the API server demotes itself once
+//   leaseDuration passes without a successful renew (a standby will have
+//   taken over by then), bounding dual-leadership to one lease window;
+// - losing the lease mid-cycle lets the cycle finish: every action is an
+//   idempotent patch, so a brief dual-leader overlap is harmless
+//   (duplicate Events at worst) — the same argument as stateless resume.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <optional>
+#include <string>
+#include <thread>
+
+#include "tpupruner/k8s.hpp"
+
+namespace tpupruner::leader {
+
+struct Options {
+  std::string lease_ns = "tpu-pruner";   // --lease-namespace
+  std::string lease_name = "tpu-pruner"; // --lease-name
+  std::string identity;                  // default: $POD_NAME or host-pid
+  int64_t lease_duration_s = 15;
+};
+
+class Elector {
+ public:
+  // Starts the renew thread immediately; is_leader() flips as acquisition
+  // succeeds/fails. `client` must outlive the Elector.
+  Elector(const k8s::Client& client, Options opts);
+  ~Elector();  // stops the thread; best-effort lease release when leading
+
+  bool is_leader() const { return is_leader_.load(); }
+  const std::string& identity() const { return opts_.identity; }
+
+  // One acquisition/renewal attempt (exposed for tests; the thread calls
+  // this every lease_duration/3). Returns the new leadership state.
+  bool try_acquire_or_renew();
+
+ private:
+  void release();
+
+  const k8s::Client& client_;
+  Options opts_;
+  std::string lease_path_;
+  std::atomic<bool> is_leader_{false};
+  std::atomic<bool> stop_{false};
+  // Local (monotonic) observation of the remote record, client-go style:
+  // expiry is judged by how long the record stayed unchanged on OUR clock.
+  std::string observed_record_;
+  std::chrono::steady_clock::time_point observed_at_{};
+  // Last successful acquire/renew on our clock — the self-demotion deadline.
+  std::optional<std::chrono::steady_clock::time_point> last_renew_ok_;
+  std::thread thread_;
+};
+
+}  // namespace tpupruner::leader

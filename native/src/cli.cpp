@@ -105,6 +105,12 @@ TPU FLAGS:
                                 auth via Workload Identity / ADC)
       --monitoring-endpoint <U> Cloud Monitoring API base
                                 [default: https://monitoring.googleapis.com]
+      --leader-elect            coordinate replicas through a coordination.k8s.io
+                                Lease: one leader evaluates, standbys take over
+                                on expiry (daemon mode only)
+      --lease-namespace <NS>    Lease namespace [default: $POD_NAMESPACE or tpu-pruner]
+      --lease-name <N>          Lease name [default: tpu-pruner]
+      --lease-duration <S>      seconds a leader may go unrenewed [default: 15]
   -h, --help                    print this help
 )";
 }
@@ -191,6 +197,13 @@ Cli parse(int argc, char** argv) {
       {"--otlp-endpoint", [&](const std::string& v) { cli.otlp_endpoint = v; }},
       {"--gcp-project", [&](const std::string& v) { cli.gcp_project = v; }},
       {"--monitoring-endpoint", [&](const std::string& v) { cli.monitoring_endpoint = v; }},
+      {"--lease-namespace", [&](const std::string& v) { cli.lease_namespace = v; }},
+      {"--lease-name", [&](const std::string& v) { cli.lease_name = v; }},
+      {"--lease-duration",
+       [&](const std::string& v) {
+         cli.lease_duration = parse_int("--lease-duration", v);
+         if (cli.lease_duration < 1) throw CliError("--lease-duration must be >= 1 second");
+       }},
   };
   std::map<std::string, std::string> shorts = {
       {"-t", "--duration"},       {"-e", "--enabled-resources"},
@@ -208,6 +221,10 @@ Cli parse(int argc, char** argv) {
     }
     if (arg == "--honor-labels") {
       cli.honor_labels = true;
+      continue;
+    }
+    if (arg == "--leader-elect") {
+      cli.leader_elect = true;
       continue;
     }
     // --flag=value form
@@ -240,6 +257,13 @@ Cli parse(int argc, char** argv) {
   if (cli.duration < 1) throw CliError("--duration must be >= 1 minute");
   if (cli.check_interval < 1) throw CliError("--check-interval must be >= 1 second");
   if (cli.grace_period < 0) throw CliError("--grace-period must be >= 0");
+  if (cli.leader_elect && !cli.daemon_mode) {
+    throw CliError("--leader-elect requires --daemon-mode");
+  }
+  if (cli.lease_namespace.empty()) {
+    if (auto ns = std::getenv("POD_NAMESPACE")) cli.lease_namespace = ns;
+    else cli.lease_namespace = "tpu-pruner";
+  }
   return cli;
 }
 

@@ -14,6 +14,7 @@
 #include "otlp.hpp"
 #include "tpupruner/actuate.hpp"
 #include "tpupruner/auth.hpp"
+#include "tpupruner/leader.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/metrics.hpp"
 #include "tpupruner/prom.hpp"
@@ -498,6 +499,17 @@ int run(const cli::Cli& args) {
     }
   }
 
+  // Optional HA: only the lease holder evaluates; standbys idle until the
+  // lease expires or is released (no reference analog — it runs 1 replica).
+  std::unique_ptr<leader::Elector> elector;
+  if (args.leader_elect) {
+    leader::Options lopts;
+    lopts.lease_ns = args.lease_namespace;
+    lopts.lease_name = args.lease_name;
+    lopts.lease_duration_s = args.lease_duration;
+    elector = std::make_unique<leader::Elector>(kube, std::move(lopts));
+  }
+
   TargetQueue queue(kQueueCapacity);
 
   // Consumer pool (the reference's single scale_down_task, main.rs:332-367,
@@ -545,6 +557,15 @@ int run(const cli::Cli& args) {
   while (true) {
     if (g_shutdown_signal) break;
     auto cycle_start = std::chrono::steady_clock::now();
+    if (elector && !elector->is_leader()) {
+      // Standby: no cycles, no failure-budget ticks — just wait out the
+      // interval (interruptibly) and re-check leadership.
+      while (!g_shutdown_signal &&
+             std::chrono::steady_clock::now() - cycle_start < std::chrono::seconds(1)) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      }
+      continue;
+    }
     last_cycle_failed = false;
     try {
       CycleStats stats = run_cycle(args, query, kube, enabled, [&](ScaleTarget t) {

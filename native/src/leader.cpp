@@ -1,0 +1,223 @@
+#include "tpupruner/leader.hpp"
+
+#include <unistd.h>
+
+#include <chrono>
+
+#include "tpupruner/json.hpp"
+#include "tpupruner/log.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::leader {
+
+using json::Value;
+
+namespace {
+
+std::string lease_collection(const std::string& ns) {
+  return "/apis/coordination.k8s.io/v1/namespaces/" + ns + "/leases";
+}
+
+// MicroTime per the Lease schema (RFC 3339 with 6 fractional digits).
+std::string micro_time(int64_t unix_secs) {
+  return util::format_rfc3339(unix_secs, 0, 6);
+}
+
+Value lease_spec(const std::string& holder, int64_t duration_s,
+                 std::optional<int64_t> acquire_unix, int64_t renew_unix,
+                 std::optional<int64_t> transitions) {
+  Value spec = Value::object();
+  spec.set("holderIdentity", Value(holder));
+  spec.set("leaseDurationSeconds", Value(duration_s));
+  if (acquire_unix) spec.set("acquireTime", Value(micro_time(*acquire_unix)));
+  spec.set("renewTime", Value(micro_time(renew_unix)));
+  if (transitions) spec.set("leaseTransitions", Value(*transitions));
+  return spec;
+}
+
+}  // namespace
+
+Elector::Elector(const k8s::Client& client, Options opts)
+    : client_(client), opts_(std::move(opts)) {
+  if (opts_.identity.empty()) {
+    if (auto pn = util::env("POD_NAME")) {
+      opts_.identity = *pn;
+    } else {
+      char host[256] = "tpu-pruner";
+      ::gethostname(host, sizeof(host) - 1);
+      opts_.identity = std::string(host) + "-" + std::to_string(::getpid());
+    }
+  }
+  lease_path_ = lease_collection(opts_.lease_ns) + "/" + opts_.lease_name;
+
+  thread_ = std::thread([this] {
+    // First attempt immediately, then every leaseDuration/3 (the client-go
+    // renew cadence), polling stop_ in short chunks so shutdown is fast.
+    while (!stop_.load()) {
+      bool was = is_leader_.load();
+      bool now = false;
+      try {
+        now = try_acquire_or_renew();
+      } catch (const std::exception& e) {
+        log::warn(std::string("leader election attempt failed: ") + e.what());
+        // Transport errors: a leader keeps leading only until the lease
+        // would have expired anyway — past that, a standby has taken over,
+        // so self-demote to bound dual-leadership to one lease window. A
+        // candidate just retries.
+        auto deadline = std::chrono::seconds(opts_.lease_duration_s);
+        now = was && last_renew_ok_ &&
+              std::chrono::steady_clock::now() - *last_renew_ok_ < deadline;
+        if (was && !now) {
+          log::warn("leader election: could not renew within the lease duration, "
+                    "self-demoting");
+        }
+      }
+      if (now != was) {
+        log::info(now ? "leader election: acquired lease " + opts_.lease_ns + "/" +
+                            opts_.lease_name + " as " + opts_.identity
+                      : "leader election: lost lease " + opts_.lease_ns + "/" +
+                            opts_.lease_name);
+      }
+      is_leader_.store(now);
+      int64_t wait_ms = opts_.lease_duration_s * 1000 / 3;
+      for (int64_t waited = 0; waited < wait_ms && !stop_.load(); waited += 100) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      }
+    }
+  });
+}
+
+Elector::~Elector() {
+  stop_.store(true);
+  if (thread_.joinable()) thread_.join();
+  if (is_leader_.load()) release();
+}
+
+bool Elector::try_acquire_or_renew() {
+  int64_t now = util::now_unix();
+  auto mono_now = std::chrono::steady_clock::now();
+  std::optional<Value> lease = client_.get_opt(lease_path_);
+
+  if (!lease) {
+    // No lease yet: create it. A racing candidate's create wins with 201;
+    // the loser's POST 409s (AlreadyExists) and throws → caught by the
+    // renew loop, retried next tick.
+    Value body = Value::object();
+    body.set("apiVersion", Value("coordination.k8s.io/v1"));
+    body.set("kind", Value("Lease"));
+    Value meta = Value::object();
+    meta.set("name", Value(opts_.lease_name));
+    meta.set("namespace", Value(opts_.lease_ns));
+    body.set("metadata", std::move(meta));
+    body.set("spec", lease_spec(opts_.identity, opts_.lease_duration_s, now, now, 1));
+    try {
+      client_.post(lease_collection(opts_.lease_ns), body);
+      last_renew_ok_ = mono_now;
+      return true;
+    } catch (const std::exception&) {
+      return false;  // lost the creation race
+    }
+  }
+
+  std::string rv;
+  if (const Value* v = lease->at_path("metadata.resourceVersion"); v && v->is_string()) {
+    rv = v->as_string();
+  }
+  std::string holder;
+  if (const Value* h = lease->at_path("spec.holderIdentity"); h && h->is_string()) {
+    holder = h->as_string();
+  }
+  int64_t duration = opts_.lease_duration_s;
+  if (const Value* d = lease->at_path("spec.leaseDurationSeconds"); d && d->is_number()) {
+    duration = d->as_int();
+  }
+  std::string renew_str;
+  if (const Value* r = lease->at_path("spec.renewTime"); r && r->is_string()) {
+    renew_str = r->as_string();
+  }
+
+  // Local-observation expiry (client-go semantics): the holder's renewTime
+  // is another machine's wall clock, so never compare it against ours —
+  // skew > leaseDuration would let a standby steal a live lease. Instead,
+  // the record (holder, renewTime) must stay UNCHANGED for > leaseDuration
+  // on our monotonic clock before it counts as expired.
+  std::string record = holder + "\x1f" + renew_str;
+  if (record != observed_record_) {
+    observed_record_ = record;
+    observed_at_ = mono_now;
+  }
+
+  if (holder == opts_.identity) {
+    // Renew. No precondition needed: only the holder writes renewTime
+    // while the lease is live, and a takeover after expiry bumps
+    // resourceVersion, which would make a stale holder's next renew a
+    // plain overwrite — so guard with the precondition anyway.
+    Value patch = Value::object();
+    Value meta = Value::object();
+    meta.set("resourceVersion", Value(rv));
+    patch.set("metadata", std::move(meta));
+    patch.set("spec", lease_spec(opts_.identity, opts_.lease_duration_s, std::nullopt, now,
+                                 std::nullopt));
+    try {
+      client_.patch_merge(lease_path_, patch);
+      last_renew_ok_ = mono_now;
+      return true;
+    } catch (const std::exception&) {
+      return false;  // conflict: someone took over after an expiry window
+    }
+  }
+
+  bool expired = !holder.empty() &&
+                 mono_now - observed_at_ > std::chrono::seconds(duration);
+  if (holder.empty() || renew_str.empty() || expired) {
+    // Takeover, CAS-guarded by resourceVersion so exactly one racing
+    // candidate wins (the API server 409s the rest).
+    int64_t transitions = 0;
+    if (const Value* t = lease->at_path("spec.leaseTransitions"); t && t->is_number()) {
+      transitions = t->as_int();
+    }
+    Value patch = Value::object();
+    Value meta = Value::object();
+    meta.set("resourceVersion", Value(rv));
+    patch.set("metadata", std::move(meta));
+    patch.set("spec", lease_spec(opts_.identity, opts_.lease_duration_s, now, now,
+                                 transitions + 1));
+    try {
+      client_.patch_merge(lease_path_, patch);
+      last_renew_ok_ = mono_now;
+      return true;
+    } catch (const std::exception&) {
+      return false;  // lost the takeover race
+    }
+  }
+  return false;  // live lease held by someone else
+}
+
+void Elector::release() {
+  // Best-effort: clearing holderIdentity lets a standby take over at its
+  // next tick instead of waiting out the lease (client-go releaseOnCancel).
+  // Guarded: re-read the lease and only release if WE still hold it, with
+  // the resourceVersion precondition — a stale ex-leader (demoted during a
+  // partition) must not clear the current leader's claim.
+  try {
+    std::optional<Value> lease = client_.get_opt(lease_path_);
+    if (!lease) return;
+    const Value* h = lease->at_path("spec.holderIdentity");
+    if (!h || !h->is_string() || h->as_string() != opts_.identity) return;
+    const Value* rv = lease->at_path("metadata.resourceVersion");
+    Value patch = Value::object();
+    if (rv && rv->is_string()) {
+      Value meta = Value::object();
+      meta.set("resourceVersion", Value(rv->as_string()));
+      patch.set("metadata", std::move(meta));
+    }
+    Value spec = Value::object();
+    spec.set("holderIdentity", Value(""));
+    patch.set("spec", std::move(spec));
+    client_.patch_merge(lease_path_, patch);
+  } catch (const std::exception& e) {
+    log::debug(std::string("lease release failed (will expire instead): ") + e.what());
+  }
+}
+
+}  // namespace tpupruner::leader

@@ -1,0 +1,201 @@
+"""Leader-election e2e: two daemons coordinate through a coordination.k8s.io
+Lease on the fake API server (which implements resourceVersion-precondition
+PATCH and 409-on-exists POST, the two primitives the elector's CAS needs).
+
+No reference analog — the reference runs one replica. Lease semantics follow
+the standard client-go recipe: holder renews every duration/3, candidates
+take over on expiry or release, takeover is resourceVersion-guarded.
+"""
+
+import re
+import signal
+import subprocess
+import time
+
+import pytest
+
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+LEASE_PATH = "/apis/coordination.k8s.io/v1/namespaces/tpu-pruner/leases/tpu-pruner"
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def start_daemon(fake_prom, fake_k8s, identity, *extra):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "scale-down", "--daemon-mode", "--check-interval", "1",
+           "--leader-elect", "--lease-duration", "3", *extra]
+    env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin",
+           "POD_NAME": identity}
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def wait_for(pred, timeout=30, interval=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def stop(proc):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def test_leader_elect_requires_daemon_mode(built, fake_prom):
+    proc = subprocess.run(
+        [str(DAEMON_PATH), "--prometheus-url", fake_prom.url, "--leader-elect"],
+        capture_output=True, text=True, timeout=30, env={"PATH": "/usr/bin:/bin"},
+    )
+    assert proc.returncode == 2
+    assert "requires --daemon-mode" in proc.stderr
+
+
+def test_single_daemon_acquires_lease_and_scales(built, fake_prom, fake_k8s):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    proc = start_daemon(fake_prom, fake_k8s, "replica-a")
+    try:
+        assert wait_for(lambda: fake_k8s.scale_patches()), "leader never scaled"
+        lease = fake_k8s.objects.get(LEASE_PATH)
+        assert lease and lease["spec"]["holderIdentity"] == "replica-a"
+        assert lease["spec"]["leaseDurationSeconds"] == 3
+    finally:
+        stop(proc)
+
+
+def test_standby_defers_then_takes_over_on_release(built, fake_prom, fake_k8s):
+    """B stays standby while A holds the lease; A's graceful shutdown
+    releases it (holderIdentity cleared) and B takes over within a tick."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "gen-a")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    a = start_daemon(fake_prom, fake_k8s, "replica-a")
+    b = None
+    try:
+        assert wait_for(lambda: fake_k8s.scale_patches())
+        assert fake_k8s.objects[LEASE_PATH]["spec"]["holderIdentity"] == "replica-a"
+
+        b = start_daemon(fake_prom, fake_k8s, "replica-b")
+        # B must not take the lease from a live holder
+        time.sleep(3)
+        assert fake_k8s.objects[LEASE_PATH]["spec"]["holderIdentity"] == "replica-a"
+
+        # graceful shutdown of A releases the lease...
+        a.send_signal(signal.SIGTERM)
+        a.wait(timeout=10)
+        assert a.returncode == 0
+        # ...so B acquires without waiting out the full expiry
+        assert wait_for(
+            lambda: fake_k8s.objects[LEASE_PATH]["spec"]["holderIdentity"] == "replica-b",
+            timeout=10)
+
+        # and B now runs cycles: a new idle workload gets reclaimed by B
+        _, _, pods2 = fake_k8s.add_deployment_chain("ml", "gen-b")
+        fake_prom.add_idle_pod_series(pods2[0]["metadata"]["name"], "ml")
+        want = "/apis/apps/v1/namespaces/ml/deployments/gen-b/scale"
+        assert wait_for(lambda: want in {p for p, _ in fake_k8s.scale_patches()})
+    finally:
+        stop(a)
+        if b:
+            stop(b)
+
+
+def test_takeover_after_expired_lease(built, fake_prom, fake_k8s):
+    """A lease whose holder stopped renewing (crashed, no graceful release)
+    is taken over once renewTime + duration passes."""
+    from datetime import datetime, timedelta, timezone
+
+    stale = (datetime.now(timezone.utc) - timedelta(seconds=60)).strftime(
+        "%Y-%m-%dT%H:%M:%S.000000Z")
+    fake_k8s.objects[LEASE_PATH] = {
+        "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+        "metadata": {"name": "tpu-pruner", "namespace": "tpu-pruner",
+                     "resourceVersion": "7"},
+        "spec": {"holderIdentity": "crashed-replica", "leaseDurationSeconds": 3,
+                 "renewTime": stale, "leaseTransitions": 4},
+    }
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    proc = start_daemon(fake_prom, fake_k8s, "replica-new")
+    try:
+        assert wait_for(lambda: fake_k8s.scale_patches()), "takeover never happened"
+        lease = fake_k8s.objects[LEASE_PATH]
+        assert lease["spec"]["holderIdentity"] == "replica-new"
+        assert lease["spec"]["leaseTransitions"] == 5
+    finally:
+        stop(proc)
+
+
+def test_leader_self_demotes_when_apiserver_unreachable(built, fake_prom, fake_k8s,
+                                                        tmp_path):
+    """A leader that can't renew for a full lease duration must demote
+    itself (a standby will have taken over), bounding dual-leadership to
+    one lease window."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    stderr_path = tmp_path / "daemon.log"
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "scale-down", "--daemon-mode", "--check-interval", "1",
+           "--leader-elect", "--lease-duration", "3"]
+    env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin",
+           "POD_NAME": "replica-a"}
+    with open(stderr_path, "w") as log:
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL, stderr=log)
+    try:
+        assert wait_for(lambda: fake_k8s.scale_patches()), "never became leader"
+        fake_k8s.outage = True  # every request 503s; renewals start failing
+        assert wait_for(lambda: "self-demoting" in stderr_path.read_text(),
+                        timeout=30), stderr_path.read_text()
+    finally:
+        stop(proc)
+
+
+def test_standby_runs_no_cycles(built, fake_prom, fake_k8s):
+    """A standby issues no Prometheus queries at all — leadership gates the
+    whole evaluation, not just actuation."""
+    fake_k8s.objects[LEASE_PATH] = {
+        "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+        "metadata": {"name": "tpu-pruner", "namespace": "tpu-pruner",
+                     "resourceVersion": "1"},
+        "spec": {"holderIdentity": "someone-else", "leaseDurationSeconds": 3600,
+                 "renewTime": None},
+    }
+    # a live lease needs a fresh renewTime
+    from datetime import datetime, timezone
+    fake_k8s.objects[LEASE_PATH]["spec"]["renewTime"] = datetime.now(
+        timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.000000Z")
+
+    proc = start_daemon(fake_prom, fake_k8s, "replica-standby")
+    try:
+        time.sleep(4)
+        assert fake_prom.queries == []
+        assert fake_k8s.objects[LEASE_PATH]["spec"]["holderIdentity"] == "someone-else"
+    finally:
+        stop(proc)

@@ -12,6 +12,7 @@ Pod→SS→Notebook, kserve-labelled pods) plus the TPU-native one
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 import uuid
@@ -85,6 +86,7 @@ class FakeK8s:
         self.patches: list[tuple[str, dict]] = []  # (path, body) in arrival order
         self.patch_times: list[float] = []  # time.monotonic() per patch (latency benches)
         self.requests: list[tuple[str, str]] = []  # (method, path)
+        self.outage = False  # True → every request 503s (apiserver outage)
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -309,6 +311,25 @@ class FakeK8s:
                                     "reason": "NotFound", "code": 404,
                                     "message": f"{self.path} not found"})
 
+            def handle_one_request(self):
+                # Outage simulation: stop() alone can't take the server
+                # dark — handler threads keep serving pooled keep-alive
+                # connections — so every verb checks the switch first.
+                if fake.outage:
+                    try:
+                        self.raw_requestline = self.rfile.readline(65537)
+                        if not self.raw_requestline or not self.parse_request():
+                            self.close_connection = True
+                            return
+                        self._respond(503, {"kind": "Status", "status": "Failure",
+                                            "reason": "ServiceUnavailable",
+                                            "message": "apiserver outage (test)"})
+                        self.close_connection = True
+                    except Exception:
+                        self.close_connection = True
+                    return
+                super().handle_one_request()
+
             # namespaced collection resources the real API server LISTs
             # (a GET of /…/namespaces/<ns>/<plural> with no trailing name)
             COLLECTIONS = {
@@ -356,8 +377,20 @@ class FakeK8s:
                     if obj is None:
                         self._not_found()
                         return
-                    fake.objects[target_path] = merge_patch(obj, body)
-                    self._respond(200, fake.objects[target_path])
+                    # resourceVersion precondition (optimistic concurrency,
+                    # as the real API server: mismatch → 409 Conflict)
+                    want_rv = (body.get("metadata") or {}).get("resourceVersion")
+                    have_rv = (obj.get("metadata") or {}).get("resourceVersion")
+                    if want_rv is not None and want_rv != have_rv:
+                        self._respond(409, {"kind": "Status", "status": "Failure",
+                                            "reason": "Conflict",
+                                            "message": "resourceVersion mismatch"})
+                        return
+                    merged = merge_patch(obj, body)
+                    merged.setdefault("metadata", {})["resourceVersion"] = str(
+                        int(have_rv or "0") + 1)
+                    fake.objects[target_path] = merged
+                    self._respond(200, merged)
 
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", "0"))
@@ -367,6 +400,27 @@ class FakeK8s:
                     fake.requests.append(("POST", self.path))
                     if path.endswith("/events"):
                         fake.events.append(body)
+                        self._respond(201, body)
+                        return
+                    # Lease create (leader election). Deliberately NOT a
+                    # generic create: unknown collection paths must keep
+                    # 404ing so client-side path-construction bugs fail
+                    # here the way they would on a real API server.
+                    name = (body.get("metadata") or {}).get("name")
+                    is_lease = re.fullmatch(
+                        r"/apis/coordination\.k8s\.io/v1/namespaces/[^/]+/leases", path)
+                    if name and is_lease:
+                        key = path.rstrip("/") + "/" + name
+                        if key in fake.objects:
+                            self._respond(409, {"kind": "Status", "status": "Failure",
+                                                "reason": "AlreadyExists",
+                                                "message": f"{name} already exists"})
+                            return
+                        meta = body.setdefault("metadata", {})
+                        meta.setdefault("uid", str(uuid.uuid4()))
+                        meta.setdefault("resourceVersion", "1")
+                        meta.setdefault("creationTimestamp", age(0))
+                        fake.objects[key] = body
                         self._respond(201, body)
                         return
                 self._not_found()
