@@ -22,6 +22,13 @@ int main(int argc, char** argv) {
   // SSL_set_fd bypass MSG_NOSIGNAL. Process-wide, covers every subcommand.
   std::signal(SIGPIPE, SIG_IGN);
 
+  if (argc >= 2 && (std::strcmp(argv[1], "--version") == 0 ||
+                    std::strcmp(argv[1], "-V") == 0 ||
+                    std::strcmp(argv[1], "version") == 0)) {
+    std::fprintf(stdout, "tpu-pruner %s (%s)\n", TP_VERSION, TP_GIT_REV);
+    return 0;
+  }
+
   if (argc >= 2 && std::strcmp(argv[1], "querytest") == 0) {
     if (argc != 4) {
       std::fprintf(stderr, "usage: tpu-pruner querytest <promql> <prometheus-url>\n");
