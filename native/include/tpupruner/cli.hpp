@@ -34,6 +34,7 @@ struct Cli {
   std::string enabled_resources = "drsinjl";  // -e (ref default "drsin" + JobSet/LWS)
   int64_t check_interval = 180;           // -c, seconds (daemon mode)
   std::string ns_regex;                   // -n, namespace pattern
+  std::string ns_exclude_regex;           // --namespace-exclude (ns !~; RE2 has no lookahead)
   int64_t grace_period = 300;             // -g, seconds
   std::string model_name;                 // -m, GPU model pattern (device=gpu)
   std::optional<double> power_threshold;  // --power-threshold, watts

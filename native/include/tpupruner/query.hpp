@@ -38,6 +38,10 @@ struct QueryArgs {
   int64_t duration_min = 30;   // lookback window (reference -t/--duration)
 
   std::string namespace_regex;    // pattern pushed into every selector
+  // Negative namespace match (ns !~ "..."). A separate flag because RE2
+  // (PromQL's regex engine) has no negative lookahead — exclusion is not
+  // expressible through the include pattern. No reference analog.
+  std::string namespace_exclude_regex;
   std::string model_regex;        // GPU model filter (DCGM modelName)
   std::string accelerator_regex;  // TPU accelerator-type filter
 

@@ -78,6 +78,8 @@ tpupruner::query::QueryArgs query_args_from_json(const Value& v) {
   if (const Value* x = v.find("device"); x && x->is_string()) a.device = x->as_string();
   if (const Value* x = v.find("duration"); x && x->is_number()) a.duration_min = x->as_int();
   if (const Value* x = v.find("namespace"); x && x->is_string()) a.namespace_regex = x->as_string();
+  if (const Value* x = v.find("namespace_exclude"); x && x->is_string())
+    a.namespace_exclude_regex = x->as_string();
   if (const Value* x = v.find("model_name"); x && x->is_string()) a.model_regex = x->as_string();
   if (const Value* x = v.find("accelerator_type"); x && x->is_string())
     a.accelerator_regex = x->as_string();

@@ -65,6 +65,9 @@ FLAGS:
                                   i=InferenceService n=Notebook j=JobSet
   -c, --check-interval <SEC>    daemon-mode cycle interval [default: 180]
   -n, --namespace <REGEX>       namespace filter pushed into the query
+      --namespace-exclude <RE>  namespaces to exclude (ns !~ in the query;
+                                RE2 has no lookahead, so this can't be
+                                expressed through -n)
   -g, --grace-period <SEC>      extra seconds for metric publication lag [default: 300]
   -m, --model-name <REGEX>      GPU model filter, e.g. "NVIDIA A10G" (device=gpu)
       --power-threshold <W>     GPU power corroboration threshold (device=gpu)
@@ -126,6 +129,7 @@ Cli parse(int argc, char** argv) {
       {"--check-interval",
        [&](const std::string& v) { cli.check_interval = parse_int("--check-interval", v); }},
       {"--namespace", [&](const std::string& v) { cli.ns_regex = v; }},
+      {"--namespace-exclude", [&](const std::string& v) { cli.ns_exclude_regex = v; }},
       {"--grace-period",
        [&](const std::string& v) { cli.grace_period = parse_int("--grace-period", v); }},
       {"--model-name", [&](const std::string& v) { cli.model_name = v; }},
@@ -272,6 +276,7 @@ query::QueryArgs to_query_args(const Cli& cli) {
   a.device = cli.device;
   a.duration_min = cli.duration;
   a.namespace_regex = cli.ns_regex;
+  a.namespace_exclude_regex = cli.ns_exclude_regex;
   a.model_regex = cli.model_name;
   a.accelerator_regex = cli.accelerator_type;
   a.power_threshold = cli.power_threshold;

@@ -47,6 +47,8 @@ std::string selector(const Labels& l, const QueryArgs& a, const std::string& ext
   std::string s = "{\n      " + l.pod + " != \"\"";
   if (!a.namespace_regex.empty())
     s += ", " + l.ns + " =~ \"" + promql_string_escape(a.namespace_regex) + "\"";
+  if (!a.namespace_exclude_regex.empty())
+    s += ", " + l.ns + " !~ \"" + promql_string_escape(a.namespace_exclude_regex) + "\"";
   if (!extra_label.empty() && !extra_regex.empty())
     s += ", " + extra_label + " =~ \"" + promql_string_escape(extra_regex) + "\"";
   s += "\n    }";

@@ -57,6 +57,29 @@ def test_gpu_query_with_namespace_and_power_threshold(built):
     assert query.count('exported_namespace =~ "ml-team"') == 5
 
 
+@pytest.mark.parametrize("device", ["gpu", "tpu"])
+def test_namespace_exclude_renders_negative_match(built, device):
+    """--namespace-exclude emits ns !~ in every selector (RE2 has no
+    lookahead, so exclusion needs its own matcher); composes with -n."""
+    query = q(device=device, duration=15, namespace="ml-.*",
+              namespace_exclude="kube-system|gmp-system")
+    assert query.count('exported_namespace !~ "kube-system|gmp-system"') == 4
+    assert query.count('exported_namespace =~ "ml-.*"') == 4
+
+
+def test_namespace_exclude_absent_by_default(built):
+    assert "!~" not in q(device="tpu", duration=15)
+
+
+def test_namespace_exclude_reaches_corroboration_selector(built):
+    """The unless-corroboration selector must also carry the exclusion —
+    otherwise an excluded namespace's power/HBM draw could suppress
+    pruning of matching idle pods. 4 compute + 1 corroboration = 5."""
+    query = q(device="gpu", duration=15, namespace_exclude="kube-.*",
+              power_threshold=100.0)
+    assert query.count('exported_namespace !~ "kube-.*"') == 5
+
+
 def test_gpu_query_with_model_name_filter(built):
     query = q(device="gpu", duration=30, model_name="NVIDIA A100")
     assert query.count('modelName =~ "NVIDIA A100"') == 4
