@@ -254,6 +254,21 @@ def cluster():
         },
     })
 
+    # 9. Root-annotated opt-out Deployment (never scaled despite idle pods)
+    apply({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "skip-dep", "namespace": E2E_NS,
+                     "annotations": {"tpu-pruner.dev/skip": "true"}},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "skip-dep"}},
+            "template": {
+                "metadata": {"labels": {"app": "skip-dep"}},
+                "spec": {"containers": [pause_container(tpu=1)]},
+            },
+        },
+    })
+
     wait_pods_running("app=trainer", 2)
     wait_pods_running("app=ss-plain", 1)
     wait_pods_running("app=nb1", 1)
@@ -261,6 +276,7 @@ def cluster():
     wait_pods_running("leaderworkerset.sigs.k8s.io/name=serve-group", 2)
     wait_pods_running("app=llm-predictor", 1)
     wait_pods_running("app=dryrun-dep", 1)
+    wait_pods_running("app=skip-dep", 1)
 
     yield {"created": created}
 

@@ -120,6 +120,64 @@ def test_orphan_pod_skipped_without_action(run_pruner, fake_prom, events):
     assert events(name="orphan") == []
 
 
+def test_skip_annotation_respected_on_live_cluster(run_pruner, fake_prom, events):
+    """Root object annotated tpu-pruner.dev/skip=true survives an idle
+    verdict against the real API server."""
+    _mark_idle(fake_prom, "app=skip-dep")
+    proc = run_pruner()
+    dep = kubectl_json("get", "deployment", "skip-dep", "-n", E2E_NS)
+    assert dep["spec"]["replicas"] == 1
+    assert events(kind="Deployment", name="skip-dep") == []
+    assert "annotated tpu-pruner.dev/skip=true" in proc.stderr
+
+
+def test_leader_election_against_real_lease_api(cluster, kube_proxy, fake_prom,
+                                                daemon_path):
+    """--leader-elect creates and renews a real coordination.k8s.io/v1
+    Lease (no CRD needed), and graceful shutdown releases it."""
+    import json as _json
+    import os
+    import signal
+    import subprocess
+    import time
+
+    from .conftest import kubectl
+
+    # clean slate (earlier runs of this test in the same cluster)
+    kubectl("delete", "lease", "kind-e2e", "-n", E2E_NS, "--ignore-not-found")
+
+    env = {"KUBE_API_URL": kube_proxy, "PROMETHEUS_TOKEN": "t",
+           "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+           "POD_NAME": "kind-replica-a"}
+    cmd = [str(daemon_path), "--prometheus-url", fake_prom.url,
+           "--run-mode", "dry-run", "--daemon-mode", "--check-interval", "1",
+           "--leader-elect", "--lease-duration", "3",
+           "--lease-namespace", E2E_NS, "--lease-name", "kind-e2e"]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 30
+        lease = None
+        while time.time() < deadline:
+            got = kubectl("get", "lease", "kind-e2e", "-n", E2E_NS,
+                          "-o", "json", check=False)
+            if got.returncode == 0:
+                lease = _json.loads(got.stdout)
+                if lease["spec"].get("holderIdentity") == "kind-replica-a":
+                    break
+            time.sleep(0.5)
+        assert lease and lease["spec"]["holderIdentity"] == "kind-replica-a"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+        assert proc.returncode == 0
+        released = kubectl_json("get", "lease", "kind-e2e", "-n", E2E_NS)
+        assert released["spec"].get("holderIdentity", "") == ""
+    finally:
+        if proc and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
 def test_dry_run_patches_nothing(run_pruner, fake_prom, events):
     """Dry-run against the live cluster: candidate found, no patch, no
     Event. --run-mode appears twice (the fixture passes scale-down
