@@ -486,7 +486,13 @@ int run(const cli::Cli& args) {
     std::string endpoint = args.otlp_endpoint;
     if (endpoint.empty())
       endpoint = util::env("OTEL_EXPORTER_OTLP_ENDPOINT").value_or("");
-    if (!endpoint.empty()) {
+    // Signal-specific endpoint vars alone also activate the exporter — a
+    // metrics-only configuration needs no base endpoint (the Exporter
+    // resolves per-signal URLs itself).
+    bool signal_only =
+        util::env("OTEL_EXPORTER_OTLP_METRICS_ENDPOINT").has_value() ||
+        util::env("OTEL_EXPORTER_OTLP_TRACES_ENDPOINT").has_value();
+    if (!endpoint.empty() || signal_only) {
       int interval_ms = 15000;
       if (auto iv = util::env("OTEL_METRIC_EXPORT_INTERVAL")) {
         try {

@@ -96,13 +96,34 @@ void Span::set_error(std::string message) {
 }
 
 Exporter::Exporter(std::string endpoint, int interval_ms)
-    : endpoint_(std::move(endpoint)),
-      interval_ms_(interval_ms),
+    : interval_ms_(interval_ms),
       start_unix_nanos_(util::now_unix() * 1000000000ll) {
-  while (!endpoint_.empty() && endpoint_.back() == '/') endpoint_.pop_back();
-  g_recording.store(true);
+  while (!endpoint.empty() && endpoint.back() == '/') endpoint.pop_back();
+
+  // Per-signal resolution (OTEL spec; the reference documents exactly this
+  // env shape, README.md:79-98): signal endpoint vars are full URLs used
+  // verbatim; `none` exporters disable the signal.
+  auto signal_url = [&](const char* endpoint_var, const char* exporter_var,
+                        const char* default_path) -> std::string {
+    if (auto ex = util::env(exporter_var); ex && *ex == "none") return "";
+    if (auto url = util::env(endpoint_var); url && !url->empty()) return *url;
+    // No signal override and no base endpoint → the signal is off (a
+    // signal-only env configuration leaves the other signal disabled).
+    return endpoint.empty() ? "" : endpoint + default_path;
+  };
+  metrics_url_ = signal_url("OTEL_EXPORTER_OTLP_METRICS_ENDPOINT",
+                            "OTEL_METRICS_EXPORTER", "/v1/metrics");
+  traces_url_ = signal_url("OTEL_EXPORTER_OTLP_TRACES_ENDPOINT",
+                           "OTEL_TRACES_EXPORTER", "/v1/traces");
+
+  if (metrics_url_.empty() && traces_url_.empty()) {
+    log::info("OTLP export: both signals disabled (OTEL_*_EXPORTER=none)");
+    return;  // no thread, no recording — a fully inert exporter
+  }
+  if (!traces_url_.empty()) g_recording.store(true);
   thread_ = std::thread([this] { loop(); });
-  log::info("OTLP metrics+trace export to " + endpoint_ + "/v1/{metrics,traces} every " +
+  log::info("OTLP export: metrics -> " + (metrics_url_.empty() ? "(off)" : metrics_url_) +
+            ", traces -> " + (traces_url_.empty() ? "(off)" : traces_url_) + " every " +
             std::to_string(interval_ms_) + "ms");
 }
 
@@ -130,8 +151,8 @@ void Exporter::loop() {
 }
 
 bool Exporter::export_once() {
-  bool metrics_ok = export_metrics(util::now_unix_nanos());
-  bool traces_ok = export_traces();
+  bool metrics_ok = metrics_url_.empty() || export_metrics(util::now_unix_nanos());
+  bool traces_ok = traces_url_.empty() || export_traces();
   return metrics_ok && traces_ok;
 }
 
@@ -168,7 +189,7 @@ bool Exporter::export_metrics(int64_t now_nanos) {
 
   Value body = Value::object();
   body.set("resourceMetrics", Value(json::Array{std::move(rm)}));
-  return post("/v1/metrics", body.dump());
+  return post(metrics_url_, body.dump());
 }
 
 bool Exporter::export_traces() {
@@ -218,26 +239,26 @@ bool Exporter::export_traces() {
 
   Value body = Value::object();
   body.set("resourceSpans", Value(json::Array{std::move(rs)}));
-  return post("/v1/traces", body.dump());
+  return post(traces_url_, body.dump());
 }
 
-bool Exporter::post(const std::string& path, const std::string& body_json) {
+bool Exporter::post(const std::string& url, const std::string& body_json) {
   try {
     http::Client client;
     http::Request req;
     req.method = "POST";
-    req.url = endpoint_ + path;
+    req.url = url;
     req.headers.push_back({"Content-Type", "application/json"});
     req.body = body_json;
     req.timeout_ms = 5000;
     http::Response resp = client.request(req);
     if (resp.status < 200 || resp.status >= 300) {
-      log::warn("OTLP export to " + path + " got HTTP " + std::to_string(resp.status));
+      log::warn("OTLP export to " + url + " got HTTP " + std::to_string(resp.status));
       return false;
     }
     return true;
   } catch (const std::exception& e) {
-    log::warn("OTLP export to " + path + " failed: " + e.what());
+    log::warn("OTLP export to " + url + " failed: " + e.what());
     return false;
   }
 }

@@ -73,7 +73,11 @@ std::vector<FinishedSpan> drain_spans_for_test();
 class Exporter {
  public:
   // `endpoint` is the OTLP base (e.g. http://collector:4318); metrics go
-  // to <endpoint>/v1/metrics.
+  // to <endpoint>/v1/metrics. Signal-specific OTEL env vars are honored
+  // per the spec (and the reference's documented config, README.md:79-98):
+  // OTEL_EXPORTER_OTLP_{METRICS,TRACES}_ENDPOINT override the full URL for
+  // that signal (used verbatim, no /v1/* appended), and
+  // OTEL_{METRICS,TRACES}_EXPORTER=none disables the signal.
   Exporter(std::string endpoint, int interval_ms);
   ~Exporter();  // final flush, then stop
 
@@ -85,8 +89,8 @@ class Exporter {
   void loop();
   bool export_metrics(int64_t now_nanos);
   bool export_traces();
-  bool post(const std::string& path, const std::string& body_json);
-  std::string endpoint_;
+  bool post(const std::string& url, const std::string& body_json);
+  std::string metrics_url_, traces_url_;  // empty = signal disabled
   int interval_ms_;
   std::atomic<bool> stop_{false};
   std::mutex mutex_;

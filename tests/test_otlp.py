@@ -192,6 +192,35 @@ def test_otlp_env_var_enables_export(built, collector):
     assert any(p == "/v1/metrics" for p, _ in collector.requests)
 
 
+def test_signal_specific_endpoint_and_none_exporter(built, collector):
+    """OTEL spec (and the reference's documented env shape): a
+    signal-specific endpoint var is a full URL used verbatim, and
+    OTEL_TRACES_EXPORTER=none disables that signal entirely."""
+    prom, k8s = FakePrometheus(), FakeK8s()
+    _, _, pods = k8s.add_deployment_chain("ml", "dep", num_pods=1)
+    prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    prom.start(); k8s.start()
+    try:
+        env_extra = {
+            # NO base endpoint at all: the signal var alone must activate
+            # the exporter (metrics-only configuration)
+            "OTEL_EXPORTER_OTLP_METRICS_ENDPOINT": collector.url + "/custom/metrics",
+            "OTEL_TRACES_EXPORTER": "none",
+        }
+        env = {"KUBE_API_URL": k8s.url, "PROMETHEUS_TOKEN": "t",
+               "PATH": "/usr/bin:/bin", **env_extra}
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", prom.url, "--run-mode", "scale-down"],
+            capture_output=True, text=True, timeout=60, env=env)
+        assert proc.returncode == 0, proc.stderr
+    finally:
+        prom.stop(); k8s.stop()
+    paths = [p for p, _ in collector.requests]
+    assert "/custom/metrics" in paths           # signal URL used verbatim
+    assert not any(p == "/v1/traces" for p in paths)  # traces disabled
+    assert "traces -> (off)" in proc.stderr
+
+
 def test_collector_failure_does_not_fail_daemon(built):
     prom, k8s = FakePrometheus(), FakeK8s()
     prom.start(); k8s.start()
