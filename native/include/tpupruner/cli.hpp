@@ -62,6 +62,7 @@ struct Cli {
   std::string otlp_endpoint;              // --otlp-endpoint (default: $OTEL_EXPORTER_OTLP_ENDPOINT)
   std::string gcp_project;                // --gcp-project (Cloud Monitoring PromQL API)
   std::string monitoring_endpoint = "https://monitoring.googleapis.com";  // --monitoring-endpoint
+  std::string notify_webhook;             // --notify-webhook (POST per pause; Slack-compatible)
   bool leader_elect = false;              // --leader-elect (HA; requires daemon mode)
   std::string lease_namespace;            // --lease-namespace (default: $POD_NAMESPACE or "tpu-pruner")
   std::string lease_name = "tpu-pruner";  // --lease-name

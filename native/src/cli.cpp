@@ -108,6 +108,9 @@ TPU FLAGS:
                                 auth via Workload Identity / ADC)
       --monitoring-endpoint <U> Cloud Monitoring API base
                                 [default: https://monitoring.googleapis.com]
+      --notify-webhook <URL>    POST a Slack-compatible JSON message per pause
+                                (the operator notification the reference README
+                                lists as future work; failure is log-only)
       --leader-elect            coordinate replicas through a coordination.k8s.io
                                 Lease: one leader evaluates, standbys take over
                                 on expiry (daemon mode only)
@@ -201,6 +204,7 @@ Cli parse(int argc, char** argv) {
       {"--otlp-endpoint", [&](const std::string& v) { cli.otlp_endpoint = v; }},
       {"--gcp-project", [&](const std::string& v) { cli.gcp_project = v; }},
       {"--monitoring-endpoint", [&](const std::string& v) { cli.monitoring_endpoint = v; }},
+      {"--notify-webhook", [&](const std::string& v) { cli.notify_webhook = v; }},
       {"--lease-namespace", [&](const std::string& v) { cli.lease_namespace = v; }},
       {"--lease-name", [&](const std::string& v) { cli.lease_name = v; }},
       {"--lease-duration",

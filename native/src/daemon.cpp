@@ -14,6 +14,7 @@
 #include "otlp.hpp"
 #include "tpupruner/actuate.hpp"
 #include "tpupruner/auth.hpp"
+#include "tpupruner/http.hpp"
 #include "tpupruner/leader.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/metrics.hpp"
@@ -481,29 +482,10 @@ int run(const cli::Cli& args) {
     metrics_server = std::make_unique<metrics_http::Server>(args.metrics_port);
   }
   // Optional OTLP/HTTP push (reference `otel` feature; OTEL_* env config).
-  std::unique_ptr<otlp::Exporter> otlp_exporter;
-  {
-    std::string endpoint = args.otlp_endpoint;
-    if (endpoint.empty())
-      endpoint = util::env("OTEL_EXPORTER_OTLP_ENDPOINT").value_or("");
-    // Signal-specific endpoint vars alone also activate the exporter — a
-    // metrics-only configuration needs no base endpoint (the Exporter
-    // resolves per-signal URLs itself).
-    bool signal_only =
-        util::env("OTEL_EXPORTER_OTLP_METRICS_ENDPOINT").has_value() ||
-        util::env("OTEL_EXPORTER_OTLP_TRACES_ENDPOINT").has_value();
-    if (!endpoint.empty() || signal_only) {
-      int interval_ms = 15000;
-      if (auto iv = util::env("OTEL_METRIC_EXPORT_INTERVAL")) {
-        try {
-          interval_ms = std::max(100, std::stoi(*iv));
-        } catch (const std::exception&) {
-          log::warn("ignoring unparseable OTEL_METRIC_EXPORT_INTERVAL: " + *iv);
-        }
-      }
-      otlp_exporter = std::make_unique<otlp::Exporter>(endpoint, interval_ms);
-    }
-  }
+  // Activation, per-signal URLs, and interval all resolve inside the
+  // factory — one point of truth for the env shape.
+  std::unique_ptr<otlp::Exporter> otlp_exporter =
+      otlp::Exporter::from_config(args.otlp_endpoint);
 
   // Optional HA: only the lease holder evaluates; standbys idle until the
   // lease expires or is released (no reference analog — it runs 1 replica).
@@ -522,6 +504,69 @@ int run(const cli::Cli& args) {
   // widened: each target still does event-then-patch in order, but separate
   // targets actuate concurrently — on big reclaim cycles the serial
   // consumer dominates wall clock).
+  // Operator notification per pause (the reference README's stated future
+  // work: "Features may be added in the future for better notifications").
+  // Slack-compatible {"text": ...} plus structured fields. Best-effort by
+  // design: POSTs run on a dedicated notifier thread behind a bounded
+  // drop-on-overflow queue, so a slow or blackholed webhook can never
+  // stall the scale consumers or the shutdown drain (failures and drops
+  // are log-only, like Event posting).
+  std::deque<std::string> notify_queue;
+  std::mutex notify_mutex;
+  std::condition_variable notify_cv;
+  bool notify_closed = false;
+  constexpr size_t kNotifyQueueCap = 1000;
+  std::thread notifier;
+  if (!args.notify_webhook.empty()) {
+    notifier = std::thread([&] {
+      while (true) {
+        std::string body_json;
+        {
+          std::unique_lock<std::mutex> lock(notify_mutex);
+          notify_cv.wait(lock, [&] { return !notify_queue.empty() || notify_closed; });
+          if (notify_queue.empty()) return;  // closed + drained
+          body_json = std::move(notify_queue.front());
+          notify_queue.pop_front();
+        }
+        try {
+          http::Client client;
+          http::Request req;
+          req.method = "POST";
+          req.url = args.notify_webhook;
+          req.headers.push_back({"Content-Type", "application/json"});
+          req.body = std::move(body_json);
+          req.timeout_ms = 5000;
+          http::Response resp = client.request(req);
+          if (resp.status < 200 || resp.status >= 300) {
+            log::warn("notify webhook returned HTTP " + std::to_string(resp.status));
+          }
+        } catch (const std::exception& e) {
+          log::warn(std::string("notify webhook failed: ") + e.what());
+        }
+      }
+    });
+  }
+  auto notify = [&](const ScaleTarget& t) {
+    if (args.notify_webhook.empty()) return;
+    json::Value body = json::Value::object();
+    std::string desc = "[" + std::string(core::kind_name(t.kind)) + "] " +
+                       t.ns().value_or("") + "/" + t.name();
+    body.set("text", json::Value("tpu-pruner paused " + desc + " after " +
+                                 std::to_string(args.duration) + "m of no " +
+                                 (args.device == "gpu" ? "GPU" : "TPU") + " activity"));
+    body.set("kind", json::Value(std::string(core::kind_name(t.kind))));
+    body.set("name", json::Value(t.name()));
+    body.set("namespace", json::Value(t.ns().value_or("")));
+    body.set("action", json::Value("scale_down"));
+    std::lock_guard<std::mutex> lock(notify_mutex);
+    if (notify_queue.size() >= kNotifyQueueCap) {
+      log::warn("notify webhook queue full; dropping notification for " + desc);
+      return;
+    }
+    notify_queue.push_back(body.dump());
+    notify_cv.notify_one();
+  };
+
   auto consume_fn = [&] {
     while (true) {
       std::optional<ScaleTarget> t = queue.pop();
@@ -551,6 +596,7 @@ int run(const cli::Cli& args) {
       log::counter_add("scale_successes", 1);
       log::info("Scaled Resource: [" + std::string(core::kind_name(t->kind)) + "] - " +
                 t->ns().value_or("default") + ":" + t->name());
+      notify(*t);
     }
   };
   std::vector<std::thread> consumers;
@@ -613,6 +659,16 @@ int run(const cli::Cli& args) {
   }
   queue.close();
   for (std::thread& c : consumers) c.join();
+  if (notifier.joinable()) {
+    // Consumers are done, so no new notifications arrive; drain what's
+    // queued (bounded: cap x 5s worst case, usually zero) and stop.
+    {
+      std::lock_guard<std::mutex> lock(notify_mutex);
+      notify_closed = true;
+      notify_cv.notify_all();
+    }
+    notifier.join();
+  }
   // Deviation from the reference (which exits 0 even when its only cycle
   // failed, main.rs:324-326): a failed single-shot run exits 1 so cron/CI
   // wrappers can detect it. Daemon mode exits 1 only on budget exhaustion.

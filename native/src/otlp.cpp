@@ -127,6 +127,28 @@ Exporter::Exporter(std::string endpoint, int interval_ms)
             std::to_string(interval_ms_) + "ms");
 }
 
+std::unique_ptr<Exporter> Exporter::from_config(const std::string& cli_endpoint) {
+  auto env_nonempty = [](const char* var) -> std::string {
+    if (auto v = util::env(var); v && !v->empty()) return *v;
+    return "";
+  };
+  std::string base = cli_endpoint;
+  if (base.empty()) base = env_nonempty("OTEL_EXPORTER_OTLP_ENDPOINT");
+  bool signal_set = !env_nonempty("OTEL_EXPORTER_OTLP_METRICS_ENDPOINT").empty() ||
+                    !env_nonempty("OTEL_EXPORTER_OTLP_TRACES_ENDPOINT").empty();
+  if (base.empty() && !signal_set) return nullptr;
+
+  int interval_ms = 15000;
+  if (auto iv = util::env("OTEL_METRIC_EXPORT_INTERVAL")) {
+    try {
+      interval_ms = std::max(100, std::stoi(*iv));
+    } catch (const std::exception&) {
+      log::warn("ignoring unparseable OTEL_METRIC_EXPORT_INTERVAL: " + *iv);
+    }
+  }
+  return std::make_unique<Exporter>(std::move(base), interval_ms);
+}
+
 Exporter::~Exporter() {
   {
     std::lock_guard<std::mutex> lock(mutex_);

@@ -16,6 +16,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -80,6 +81,13 @@ class Exporter {
   // OTEL_{METRICS,TRACES}_EXPORTER=none disables the signal.
   Exporter(std::string endpoint, int interval_ms);
   ~Exporter();  // final flush, then stop
+
+  // Single point of truth for OTLP activation: resolves the CLI flag plus
+  // the OTEL_* env shape (base endpoint, signal-specific endpoints,
+  // exporter=none switches, export interval) and returns nullptr when no
+  // signal would be active. Set-but-empty env vars count as unset, here
+  // and in the per-signal resolution alike.
+  static std::unique_ptr<Exporter> from_config(const std::string& cli_endpoint);
 
   // One export now (also used for the shutdown flush). Returns false and
   // logs on failure; the daemon never fails because telemetry did.

@@ -602,6 +602,79 @@ def test_daemon_soak_with_churn(built, fake_prom, fake_k8s):
             proc.wait(timeout=10)
 
 
+class WebhookSink:
+    """Minimal JSON sink for --notify-webhook tests."""
+
+    def __init__(self):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.posts = []
+        sink = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                import json as _json
+                n = int(self.headers.get("Content-Length", "0"))
+                sink.posts.append(_json.loads(self.rfile.read(n) or b"{}"))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        self.url = f"http://127.0.0.1:{self._server.server_address[1]}/hook"
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def test_notify_webhook_posts_per_pause(built, fake_prom, fake_k8s):
+    """--notify-webhook delivers one Slack-compatible message per paused
+    root object (the reference README's stated future work)."""
+    sink = WebhookSink()
+    try:
+        _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=2)
+        for pod in pods:
+            fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml")
+
+        run_pruner(fake_prom, fake_k8s, "--notify-webhook", sink.url)
+        assert len(sink.posts) == 1  # deduped: one root, one message
+        msg = sink.posts[0]
+        assert msg["kind"] == "Deployment" and msg["name"] == "trainer"
+        assert msg["namespace"] == "ml" and msg["action"] == "scale_down"
+        assert "tpu-pruner paused [Deployment] ml/trainer" in msg["text"]
+        assert "no TPU activity" in msg["text"]
+    finally:
+        sink.stop()
+
+
+def test_notify_webhook_silent_in_dry_run(built, fake_prom, fake_k8s):
+    sink = WebhookSink()
+    try:
+        _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+        run_pruner(fake_prom, fake_k8s, "--run-mode", "dry-run",
+                   "--notify-webhook", sink.url)
+        assert sink.posts == []
+    finally:
+        sink.stop()
+
+
+def test_notify_webhook_failure_is_log_only(built, fake_prom, fake_k8s):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    proc = run_pruner(fake_prom, fake_k8s,
+                      "--notify-webhook", "http://127.0.0.1:1/hook")
+    assert proc.returncode == 0
+    assert "notify webhook failed" in proc.stderr
+    assert fake_k8s.scale_patches()  # the pause itself still landed
+
+
 def test_oversized_response_is_transport_error_not_oom(built, fake_k8s):
     """A server advertising a multi-terabyte Content-Length must produce a
     clean transport error (feeding the failure budget), not buffer until
