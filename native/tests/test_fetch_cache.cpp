@@ -1,0 +1,98 @@
+// FetchCache single-flight semantics (native/include/tpupruner/walker.hpp).
+// The cache sits under the concurrent resolve fan-out: every pod of a
+// slice demands the same Job→JobSet chain, so correctness here decides
+// both the API-call count and WHICH owner gets scaled (a poisoned miss
+// would demote a Deployment to its ReplicaSet). Exercised under TSan via
+// `just test-tsan`.
+#include "testing.hpp"
+
+#include <atomic>
+#include <chrono>
+#include <stdexcept>
+#include <thread>
+#include <vector>
+
+#include "tpupruner/walker.hpp"
+
+using tpupruner::json::Value;
+using tpupruner::walker::FetchCache;
+
+TP_TEST(fetch_cache_single_flight_one_fetch_for_concurrent_callers) {
+  FetchCache cache;
+  std::atomic<int> fetches{0};
+  auto slow_fetch = [&]() -> FetchCache::Entry {
+    fetches.fetch_add(1);
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    return Value::parse(R"({"metadata":{"name":"dep"}})");
+  };
+  std::vector<std::thread> threads;
+  std::atomic<int> ok{0};
+  for (int i = 0; i < 8; ++i) {
+    threads.emplace_back([&] {
+      FetchCache::Entry e = cache.get_or_fetch("apis/.../dep", slow_fetch);
+      if (e && e->at_path("metadata.name")->as_string() == "dep") ok.fetch_add(1);
+    });
+  }
+  for (auto& t : threads) t.join();
+  TP_CHECK_EQ(fetches.load(), 1);  // everyone else blocked on the leader
+  TP_CHECK_EQ(ok.load(), 8);
+}
+
+TP_TEST(fetch_cache_miss_is_cached_too) {
+  FetchCache cache;
+  std::atomic<int> fetches{0};
+  auto fetch_404 = [&]() -> FetchCache::Entry {
+    fetches.fetch_add(1);
+    return std::nullopt;  // 404: remembered for the cycle
+  };
+  TP_CHECK(!cache.get_or_fetch("k", fetch_404).has_value());
+  TP_CHECK(!cache.get_or_fetch("k", fetch_404).has_value());
+  TP_CHECK_EQ(fetches.load(), 1);
+}
+
+TP_TEST(fetch_cache_leader_failure_not_cached_waiters_retry) {
+  FetchCache cache;
+  std::atomic<int> attempts{0};
+  auto flaky = [&]() -> FetchCache::Entry {
+    if (attempts.fetch_add(1) == 0) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(30));
+      throw std::runtime_error("transient 500");
+    }
+    return Value::parse(R"({"ok":true})");
+  };
+  std::atomic<int> got{0}, threw{0};
+  std::vector<std::thread> threads;
+  for (int i = 0; i < 6; ++i) {
+    threads.emplace_back([&] {
+      try {
+        if (cache.get_or_fetch("k", flaky)) got.fetch_add(1);
+      } catch (const std::runtime_error&) {
+        threw.fetch_add(1);  // only the failing leader itself rethrows
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  // the first leader failed (and threw to its caller); a waiter became the
+  // new leader, succeeded, and the rest got its entry — exactly 2 attempts
+  TP_CHECK_EQ(attempts.load(), 2);
+  TP_CHECK_EQ(threw.load(), 1);
+  TP_CHECK_EQ(got.load(), 5);
+  // and the success IS cached now
+  TP_CHECK(cache.get_or_fetch("k", flaky).has_value());
+  TP_CHECK_EQ(attempts.load(), 2);
+}
+
+TP_TEST(fetch_cache_seed_prevents_fetch_and_first_writer_wins) {
+  FetchCache cache;
+  cache.seed("k", Value::parse(R"({"v":1})"));
+  cache.seed("k", Value::parse(R"({"v":2})"));  // no-op: first writer wins
+  std::atomic<int> fetches{0};
+  auto fetch = [&]() -> FetchCache::Entry {
+    fetches.fetch_add(1);
+    return std::nullopt;
+  };
+  FetchCache::Entry e = cache.get_or_fetch("k", fetch);
+  TP_CHECK(e.has_value());
+  TP_CHECK_EQ(e->find("v")->as_int(), 1);
+  TP_CHECK_EQ(fetches.load(), 0);
+}
