@@ -7,13 +7,14 @@ import sys
 from tpu_pruner.native import REPO_ROOT
 
 
-def run_analyze(tmp_path, doc, *args):
+def run_analyze(tmp_path, doc, *args, env_extra=None):
     dump = tmp_path / "dump.json"
     dump.write_text(json.dumps(doc))
+    env = {"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "HOME": str(tmp_path)}
+    env.update(env_extra or {})
     proc = subprocess.run(
         [sys.executable, "-m", "tpu_pruner.analyze", str(dump), *args],
-        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT,
-        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "HOME": str(tmp_path)},
+        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT, env=env,
     )
     assert proc.returncode == 0, proc.stderr
     return json.loads(proc.stdout.strip()), proc.stderr
@@ -78,3 +79,21 @@ def test_analyze_ragged_series_padding(built, tmp_path):
     ]}
     out, _ = run_analyze(tmp_path, doc)
     assert out["reclaimable_slices"] == ["ml/ragged"]
+
+
+def test_analyze_sharded_matches_single_device(built, tmp_path):
+    """--shard splits the chip axis over the 8-device virtual CPU mesh
+    (chips don't divide evenly → padding slice) and must produce verdicts
+    identical to the single-device path."""
+    doc = {"hbm_threshold": 0.05, "chips": [
+        # 11 chips across 3 slices on 8 devices: padding required
+        *[chip("ml/idle", [0.0] * 6, hbm=[0.0] * 6) for _ in range(4)],
+        *[chip("ml/busy", [0.0, 0.7, 0.0], hbm=[0.1] * 3) for _ in range(3)],
+        *[chip("ml/hbm-active", [0.0] * 6, hbm=[0.2] * 6) for _ in range(4)],
+    ]}
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    single, _ = run_analyze(tmp_path, doc, env_extra=env)
+    sharded, _ = run_analyze(tmp_path, doc, "--shard", env_extra=env)
+    assert sharded["reclaimable_slices"] == single["reclaimable_slices"] == ["ml/idle"]
+    assert sharded["idle_chips"] == single["idle_chips"] == 4
+    assert sharded["num_chips"] == 11

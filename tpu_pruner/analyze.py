@@ -74,6 +74,10 @@ def main(argv=None) -> int:
                         help="override lookback seconds (default: dump value or 2100)")
     parser.add_argument("--hbm-threshold", type=float, default=None,
                         help="override HBM corroboration threshold (0 disables)")
+    parser.add_argument("--shard", action="store_true",
+                        help="shard the chip axis over all visible JAX devices "
+                             "(pads chips to a device multiple; verdicts are "
+                             "identical to the single-device path)")
     args = parser.parse_args(argv)
 
     doc = json.load(sys.stdin if args.dump == "-" else open(args.dump))
@@ -88,9 +92,17 @@ def main(argv=None) -> int:
         hbm_threshold=(args.hbm_threshold if args.hbm_threshold is not None
                        else float(doc.get("hbm_threshold", 0.0))),
     )
-    verdicts, candidates = evaluate_fleet(
-        tc, hbm, valid, age, slice_id, params_array(params),
-        num_slices=len(slice_names))
+    num_slices = len(slice_names)
+    if args.shard:
+        from tpu_pruner.policy import evaluate_fleet_sharded
+
+        verdicts, candidates = evaluate_fleet_sharded(
+            tc, hbm, valid, age, slice_id, params_array(params),
+            num_slices=num_slices)
+    else:
+        verdicts, candidates = evaluate_fleet(
+            tc, hbm, valid, age, slice_id, params_array(params),
+            num_slices=num_slices)
     verdicts = np.asarray(verdicts)
     candidates = np.asarray(candidates)
 

@@ -18,6 +18,7 @@ from tpu_pruner.policy.engine import (
     PolicyParams,
     evaluate_chips,
     evaluate_fleet,
+    evaluate_fleet_sharded,
     make_example_fleet,
     make_sharded_evaluator,
     slice_verdicts,
@@ -26,6 +27,7 @@ __all__ = [
     "PolicyParams",
     "evaluate_chips",
     "evaluate_fleet",
+    "evaluate_fleet_sharded",
     "make_example_fleet",
     "make_sharded_evaluator",
     "slice_verdicts",

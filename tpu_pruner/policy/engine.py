@@ -131,6 +131,45 @@ def make_sharded_evaluator(mesh: Mesh, num_slices: int, axis: str = "fleet"):
     return jax.jit(sharded)
 
 
+def evaluate_fleet_sharded(tc_util, hbm_util, valid, pod_age_s, slice_id, params_arr,
+                           num_slices, mesh: Mesh | None = None, axis: str = "fleet"):
+    """evaluate_fleet over a device mesh, tolerating uneven chip counts.
+
+    `shard_map` needs the chip axis divisible by the mesh, so chips are
+    padded to a device multiple: padded rows carry valid=False (an
+    all-invalid chip is never a candidate) and a dedicated sentinel slice
+    id routed to one extra segment that is sliced off the output — no
+    real verdict can be affected. Results match evaluate_fleet exactly
+    (asserted by tests/test_analyze.py on an 8-device CPU mesh).
+    """
+    if mesh is None:
+        devices = jax.devices()
+        mesh = Mesh(np.array(devices), axis_names=(axis,))
+    n_dev = mesh.devices.size
+    num_chips = tc_util.shape[0]
+    padded = ((num_chips + n_dev - 1) // n_dev) * n_dev
+    pad = padded - num_chips
+    tc_util, hbm_util, valid, pod_age_s, slice_id = (
+        np.asarray(tc_util), np.asarray(hbm_util), np.asarray(valid),
+        np.asarray(pod_age_s), np.asarray(slice_id))
+    if pad:
+        tc_util = np.pad(tc_util, ((0, pad), (0, 0)))
+        hbm_util = np.pad(hbm_util, ((0, pad), (0, 0)))
+        valid = np.pad(valid, ((0, pad), (0, 0)))  # False: never candidates
+        pod_age_s = np.pad(pod_age_s, (0, pad))
+        slice_id = np.pad(slice_id, (0, pad), constant_values=num_slices)
+
+    from jax.sharding import NamedSharding
+
+    evaluator = make_sharded_evaluator(mesh, num_slices=num_slices + 1, axis=axis)
+    shard = NamedSharding(mesh, P(axis))
+    placed = [jax.device_put(x, shard)
+              for x in (tc_util, hbm_util, valid, pod_age_s, slice_id)]
+    params = jax.device_put(np.asarray(params_arr), NamedSharding(mesh, P()))
+    verdicts, candidates = evaluator(*placed, params)
+    return verdicts[:num_slices], candidates[:num_chips]
+
+
 def make_example_fleet(
     num_chips: int = 256,
     num_samples: int = 16,
