@@ -192,6 +192,36 @@ def test_partially_idle_jobset_not_suspended(built, fake_prom, fake_k8s):
     assert fake_k8s.events == []
 
 
+def test_multislice_jobset_vetoed_by_one_busy_slice(built, fake_prom, fake_k8s):
+    """A MULTI-SLICE JobSet (two DCN-connected slices as replicated jobs
+    under one owner, SURVEY.md §5): every pod of every slice must be idle
+    before the single root is suspended — slice 0 fully idle while slice 1
+    works must NOT suspend."""
+    js, pods = fake_k8s.add_jobset_slice("tpu-jobs", "v5e-2x16", num_hosts=2,
+                                         num_jobs=2)
+    assert len(pods) == 4
+    for pod in pods:  # both slices' pods resolve to the same JobSet root
+        assert pod["metadata"]["labels"]["jobset.sigs.k8s.io/jobset-name"] == "v5e-2x16"
+    for pod in pods[:2]:  # only slice 0 (workers-0-*) reads idle
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "tpu-jobs")
+
+    run_pruner(fake_prom, fake_k8s)
+    assert fake_k8s.patches_for("/jobsets/v5e-2x16") == []
+    assert fake_k8s.events == []
+
+
+def test_multislice_jobset_suspended_when_all_slices_idle(built, fake_prom, fake_k8s):
+    js, pods = fake_k8s.add_jobset_slice("tpu-jobs", "v5e-2x16", num_hosts=2,
+                                         num_jobs=2)
+    for pod in pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "tpu-jobs")
+
+    run_pruner(fake_prom, fake_k8s)
+    # two jobs, four pods, ONE owner: exactly one suspend patch
+    assert fake_k8s.patches_for("/jobsets/v5e-2x16") == [{"spec": {"suspend": True}}]
+    assert len(fake_k8s.events) == 1
+
+
 def test_young_slice_pod_blocks_jobset_suspend(built, fake_prom, fake_k8s):
     """A freshly restarted worker (age gate) blocks the whole slice."""
     js, pods = fake_k8s.add_jobset_slice("tpu-jobs", "v5e-16", num_hosts=2)

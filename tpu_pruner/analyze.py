@@ -94,15 +94,11 @@ def main(argv=None) -> int:
     )
     num_slices = len(slice_names)
     if args.shard:
-        from tpu_pruner.policy import evaluate_fleet_sharded
-
-        verdicts, candidates = evaluate_fleet_sharded(
-            tc, hbm, valid, age, slice_id, params_array(params),
-            num_slices=num_slices)
+        from tpu_pruner.policy import evaluate_fleet_sharded as eval_fn
     else:
-        verdicts, candidates = evaluate_fleet(
-            tc, hbm, valid, age, slice_id, params_array(params),
-            num_slices=num_slices)
+        eval_fn = evaluate_fleet
+    verdicts, candidates = eval_fn(
+        tc, hbm, valid, age, slice_id, params_array(params), num_slices=num_slices)
     verdicts = np.asarray(verdicts)
     candidates = np.asarray(candidates)
 

@@ -22,7 +22,7 @@ slices whose chips live on different devices come out identical everywhere.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -131,6 +131,13 @@ def make_sharded_evaluator(mesh: Mesh, num_slices: int, axis: str = "fleet"):
     return jax.jit(sharded)
 
 
+# Per-call make_sharded_evaluator would re-jit every time (a fresh closure
+# defeats jit's cache); Mesh is hashable, so memoize on the full key.
+@lru_cache(maxsize=None)
+def _cached_sharded_evaluator(mesh: Mesh, num_segments: int, axis: str):
+    return make_sharded_evaluator(mesh, num_slices=num_segments, axis=axis)
+
+
 def evaluate_fleet_sharded(tc_util, hbm_util, valid, pod_age_s, slice_id, params_arr,
                            num_slices, mesh: Mesh | None = None, axis: str = "fleet"):
     """evaluate_fleet over a device mesh, tolerating uneven chip counts.
@@ -161,7 +168,7 @@ def evaluate_fleet_sharded(tc_util, hbm_util, valid, pod_age_s, slice_id, params
 
     from jax.sharding import NamedSharding
 
-    evaluator = make_sharded_evaluator(mesh, num_slices=num_slices + 1, axis=axis)
+    evaluator = _cached_sharded_evaluator(mesh, num_slices + 1, axis)
     shard = NamedSharding(mesh, P(axis))
     placed = [jax.device_put(x, shard)
               for x in (tc_util, hbm_util, valid, pod_age_s, slice_id)]

@@ -200,27 +200,30 @@ class FakeK8s:
         return obj
 
     def add_jobset_slice(self, ns, jobset_name, num_hosts=4, tpu_chips=4, uid=None,
-                         pod_age=7200):
-        """A multi-host TPU slice: JobSet → Job → worker pods (one per host)."""
+                         pod_age=7200, num_jobs=1):
+        """A multi-host TPU slice: JobSet → Job → worker pods (one per host).
+        num_jobs > 1 models a MULTI-SLICE JobSet (DCN-connected slices as
+        replicated jobs under one owner, SURVEY.md §5): workers-0..N-1."""
         js = self.add_jobset(ns, jobset_name, uid=uid)
-        job_name = f"{jobset_name}-workers-0"
-        self.add_job(ns, job_name,
-                     owners=[self.owner("JobSet", jobset_name, js["metadata"]["uid"])])
         pods = []
-        for host in range(num_hosts):
-            pods.append(
-                self.add_pod(
-                    ns,
-                    f"{job_name}-{host}",
-                    owners=[self.owner("Job", job_name)],
-                    labels={
-                        "jobset.sigs.k8s.io/jobset-name": jobset_name,
-                        "batch.kubernetes.io/job-name": job_name,
-                    },
-                    tpu_chips=tpu_chips,
-                    created_age=pod_age,
+        for j in range(num_jobs):
+            job_name = f"{jobset_name}-workers-{j}"
+            self.add_job(ns, job_name,
+                         owners=[self.owner("JobSet", jobset_name, js["metadata"]["uid"])])
+            for host in range(num_hosts):
+                pods.append(
+                    self.add_pod(
+                        ns,
+                        f"{job_name}-{host}",
+                        owners=[self.owner("Job", job_name)],
+                        labels={
+                            "jobset.sigs.k8s.io/jobset-name": jobset_name,
+                            "batch.kubernetes.io/job-name": job_name,
+                        },
+                        tpu_chips=tpu_chips,
+                        created_age=pod_age,
+                    )
                 )
-            )
         return js, pods
 
     def add_leaderworkerset(self, ns, name, uid=None, replicas=1):
