@@ -13,8 +13,9 @@
 //   → `gcloud auth print-access-token` subprocess (operator-laptop path)
 //   → `oc whoami -t` subprocess (the reference's literal last resort,
 //     kept for drop-in --device=gpu use on OpenShift).
-// Subprocess steps run under `timeout 5` so a wedged CLI (e.g. oc logged
-// into an unreachable cluster) can't stall every cycle's client rebuild.
+// Subprocess steps run under a native 5 s deadline (fork/exec + poll, no
+// coreutils `timeout` dependency) so a wedged CLI (e.g. oc logged into an
+// unreachable cluster) can't stall every cycle's client rebuild.
 // Every step is overridable for hermetic tests (env vars below).
 #pragma once
 
@@ -34,7 +35,9 @@ struct TokenOptions {
   //   TPU_PRUNER_DISABLE_OC       — skip the oc subprocess fallback
   bool allow_metadata_server = true;
   bool allow_gcloud = true;
+  bool allow_oc = true;  // own gate — oc is not a gcloud concern
   int metadata_timeout_ms = 2000;
+  int subprocess_timeout_ms = 5000;  // native deadline for gcloud/oc
 };
 
 // Returns a bearer token, or nullopt when every source comes up empty.
@@ -45,7 +48,8 @@ std::optional<std::string> get_bearer_token(const TokenOptions& opts = {});
 std::optional<std::string> token_from_sa_file();
 std::optional<std::string> token_from_kubeconfig();
 std::optional<std::string> token_from_metadata_server(int timeout_ms);
-std::optional<std::string> token_from_gcloud();
-std::optional<std::string> token_from_oc();  // reference last resort, lib.rs:225-230
+std::optional<std::string> token_from_gcloud(int timeout_ms = 5000);
+// Reference last resort, lib.rs:225-230.
+std::optional<std::string> token_from_oc(int timeout_ms = 5000);
 
 }  // namespace tpupruner::auth

@@ -13,6 +13,7 @@
 #pragma once
 
 #include <optional>
+#include <stdexcept>
 #include <string>
 
 #include "tpupruner/core.hpp"
@@ -20,6 +21,15 @@
 #include "tpupruner/json.hpp"
 
 namespace tpupruner::k8s {
+
+// Non-2xx API-server response. Subclasses runtime_error so existing broad
+// handlers keep working; `status` lets CAS callers (leader election) tell a
+// genuine 409 conflict from a transient transport/server failure.
+struct ApiError : std::runtime_error {
+  int status;
+  ApiError(int status_code, const std::string& what)
+      : std::runtime_error(what), status(status_code) {}
+};
 
 struct Config {
   std::string api_url;   // e.g. https://10.0.0.1:443

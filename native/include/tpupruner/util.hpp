@@ -22,6 +22,9 @@ int64_t now_unix();
 // Unix epoch nanoseconds (wall clock; OTLP span/metric timestamps).
 int64_t now_unix_nanos();
 
+// Monotonic seconds (steady clock; staleness windows immune to NTP steps).
+int64_t mono_secs();
+
 // Format epoch seconds (+ optional subsecond digits of `nanos`) as RFC 3339
 // UTC, e.g. "2026-07-29T07:47:45Z" / "2026-07-29T07:47:45.123456Z".
 std::string format_rfc3339(int64_t unix_secs, int64_t nanos = 0, int subsec_digits = 0);

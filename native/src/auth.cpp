@@ -1,6 +1,16 @@
 #include "tpupruner/auth.hpp"
 
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
 #include <cstdio>
+#include <thread>
+#include <vector>
 
 #include "tpupruner/http.hpp"
 #include "tpupruner/json.hpp"
@@ -55,15 +65,78 @@ std::optional<std::string> token_from_metadata_server(int timeout_ms) {
 
 namespace {
 
-std::optional<std::string> token_from_command(const char* cmd) {
-  FILE* pipe = ::popen(cmd, "r");
-  if (!pipe) return std::nullopt;
+// Runs argv with a native deadline: fork/exec, poll the stdout pipe, SIGKILL
+// past the deadline. No dependency on a coreutils `timeout` binary (absent on
+// macOS/minimal containers, where shelling out through it silently broke the
+// fallback). The client is rebuilt every cycle, so a wedged CLI must not
+// stall the daemon.
+std::optional<std::string> token_from_command(const std::vector<const char*>& argv,
+                                              int timeout_ms) {
+  int fds[2];
+  if (::pipe(fds) != 0) return std::nullopt;
+  pid_t pid = ::fork();
+  if (pid < 0) {
+    ::close(fds[0]);
+    ::close(fds[1]);
+    return std::nullopt;
+  }
+  if (pid == 0) {
+    ::dup2(fds[1], STDOUT_FILENO);
+    int devnull = ::open("/dev/null", O_WRONLY);
+    if (devnull >= 0) ::dup2(devnull, STDERR_FILENO);
+    ::close(fds[0]);
+    ::close(fds[1]);
+    ::execvp(argv[0], const_cast<char* const*>(argv.data()));
+    ::_exit(127);
+  }
+  ::close(fds[1]);
   std::string out;
   char buf[4096];
-  size_t n;
-  while ((n = ::fread(buf, 1, sizeof(buf), pipe)) > 0) out.append(buf, n);
-  int rc = ::pclose(pipe);
-  if (rc != 0) return std::nullopt;
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  bool timed_out = false;
+  for (;;) {
+    auto remain = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      deadline - std::chrono::steady_clock::now())
+                      .count();
+    if (remain <= 0) {
+      timed_out = true;
+      break;
+    }
+    struct pollfd pfd {fds[0], POLLIN, 0};
+    int pr = ::poll(&pfd, 1, static_cast<int>(remain));
+    if (pr == 0) {
+      timed_out = true;
+      break;
+    }
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    ssize_t n = ::read(fds[0], buf, sizeof(buf));
+    if (n <= 0) break;  // EOF or read error
+    out.append(buf, static_cast<size_t>(n));
+  }
+  ::close(fds[0]);
+  if (timed_out) ::kill(pid, SIGKILL);
+  // Reap under the SAME deadline: EOF on stdout does not imply exit (a CLI
+  // can print the token, close stdout, then hang in telemetry or a prompt),
+  // and a blocking waitpid would unbound the deadline this function exists
+  // to enforce.
+  int st = 0;
+  for (;;) {
+    pid_t r = ::waitpid(pid, &st, WNOHANG);
+    if (r == pid) break;
+    if (r < 0 && errno != EINTR) return std::nullopt;
+    if (std::chrono::steady_clock::now() >= deadline) {
+      timed_out = true;
+      ::kill(pid, SIGKILL);
+      while (::waitpid(pid, &st, 0) < 0 && errno == EINTR) {
+      }
+      break;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  if (timed_out || !WIFEXITED(st) || WEXITSTATUS(st) != 0) return std::nullopt;
   std::string token = util::trim(out);
   if (token.empty()) return std::nullopt;
   return token;
@@ -71,17 +144,15 @@ std::optional<std::string> token_from_command(const char* cmd) {
 
 }  // namespace
 
-std::optional<std::string> token_from_gcloud() {
-  // Operator-laptop fallback. `timeout 5`: the client is rebuilt every
-  // cycle, so a wedged CLI must not stall the daemon (a missing timeout
-  // binary fails the step harmlessly; in-cluster auth never reaches here).
-  return token_from_command("timeout 5 gcloud auth print-access-token 2>/dev/null");
+std::optional<std::string> token_from_gcloud(int timeout_ms) {
+  // Operator-laptop fallback (in-cluster auth never reaches here).
+  return token_from_command({"gcloud", "auth", "print-access-token", nullptr}, timeout_ms);
 }
 
-std::optional<std::string> token_from_oc() {
+std::optional<std::string> token_from_oc(int timeout_ms) {
   // The reference's literal last resort (lib.rs:225-230) — kept for
   // drop-in --device=gpu use on OpenShift against Thanos.
-  return token_from_command("timeout 5 oc whoami -t 2>/dev/null");
+  return token_from_command({"oc", "whoami", "-t", nullptr}, timeout_ms);
 }
 
 std::optional<std::string> get_bearer_token(const TokenOptions& opts) {
@@ -95,10 +166,10 @@ std::optional<std::string> get_bearer_token(const TokenOptions& opts) {
     if (auto t = token_from_metadata_server(opts.metadata_timeout_ms)) return t;
   }
   if (opts.allow_gcloud && !util::env("TPU_PRUNER_DISABLE_GCLOUD")) {
-    if (auto t = token_from_gcloud()) return t;
+    if (auto t = token_from_gcloud(opts.subprocess_timeout_ms)) return t;
   }
-  if (opts.allow_gcloud && !util::env("TPU_PRUNER_DISABLE_OC")) {
-    if (auto t = token_from_oc()) return t;
+  if (opts.allow_oc && !util::env("TPU_PRUNER_DISABLE_OC")) {
+    if (auto t = token_from_oc(opts.subprocess_timeout_ms)) return t;
   }
   return std::nullopt;
 }

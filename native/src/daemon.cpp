@@ -1,7 +1,9 @@
 #include "tpupruner/daemon.hpp"
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <memory>
 #include <condition_variable>
 #include <csignal>
 #include <deque>
@@ -203,7 +205,15 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       try {
         fetched = kube.get_opt(k8s::Client::pod_path(pmd.ns, pmd.name));
       } catch (const std::exception& e) {
-        log::error("Skipping " + key + ", retrieval error: " + e.what());
+        // Fail CLOSED, like the unresolvable-root case below: the unfetched
+        // pod could carry the skip annotation, and silently dropping it
+        // would let an idle un-annotated sibling scale their shared root
+        // away this very cycle. Veto the namespace; it self-heals next
+        // cycle once the API answers again.
+        log::error("Skipping " + key + ", retrieval error (vetoing namespace " + pmd.ns +
+                   " this cycle): " + e.what());
+        std::lock_guard<std::mutex> lock(out_mutex);
+        out.vetoed_namespaces.insert(pmd.ns);
         return;
       }
       if (!fetched) {
@@ -481,6 +491,26 @@ int run(const cli::Cli& args) {
   if (args.metrics_port >= 0) {  // 0 = ephemeral (port logged at startup)
     metrics_server = std::make_unique<metrics_http::Server>(args.metrics_port);
   }
+  // Liveness = the producer loop ticked (cycle completed, failed-but-handled,
+  // or standby poll) within 3 check intervals. A static "ok" would keep a
+  // wedged loop alive forever — K8s restarts crashes on its own, but only
+  // this probe can catch hangs (stuck HTTP call, deadlocked consumer).
+  auto last_progress = std::make_shared<std::atomic<int64_t>>(util::mono_secs());
+  if (metrics_server && args.daemon_mode) {
+    // 3 intervals tolerates a cycle that legitimately runs long (big fleet,
+    // slow API) — only a loop that stopped ticking altogether fails the
+    // probe. Env override is a test seam.
+    int64_t stale_after = std::max<int64_t>(3 * args.check_interval, 60);
+    if (auto o = util::env("TPU_PRUNER_HEALTH_STALE_AFTER")) {
+      try {
+        stale_after = std::stoll(*o);
+      } catch (const std::exception&) {
+      }
+    }
+    metrics_server->set_health_probe([last_progress, stale_after] {
+      return util::mono_secs() - last_progress->load() <= stale_after;
+    });
+  }
   // Optional OTLP/HTTP push (reference `otel` feature; OTEL_* env config).
   // Activation, per-signal URLs, and interval all resolve inside the
   // factory — one point of truth for the env shape.
@@ -611,7 +641,9 @@ int run(const cli::Cli& args) {
     auto cycle_start = std::chrono::steady_clock::now();
     if (elector && !elector->is_leader()) {
       // Standby: no cycles, no failure-budget ticks — just wait out the
-      // interval (interruptibly) and re-check leadership.
+      // interval (interruptibly) and re-check leadership. Ticking counts as
+      // liveness: an idle standby is healthy, not stalled.
+      last_progress->store(util::mono_secs());
       while (!g_shutdown_signal &&
              std::chrono::steady_clock::now() - cycle_start < std::chrono::seconds(1)) {
         std::this_thread::sleep_for(std::chrono::milliseconds(100));
@@ -640,6 +672,7 @@ int run(const cli::Cli& args) {
         break;
       }
     }
+    last_progress->store(util::mono_secs());  // cycle completed (or failed cleanly)
     if (!args.daemon_mode) break;
     // Interruptible interval sleep: a signal handler can't safely notify a
     // condition variable, so poll the flag in short chunks instead of one
@@ -649,6 +682,7 @@ int run(const cli::Cli& args) {
     while (!g_shutdown_signal &&
            std::chrono::steady_clock::now() - cycle_start < interval) {
       std::this_thread::sleep_for(std::chrono::milliseconds(250));
+      last_progress->store(util::mono_secs());  // sleeping ≠ stalled
     }
   }
 

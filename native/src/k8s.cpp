@@ -87,8 +87,8 @@ json::Value Client::request_json(const std::string& method, const std::string& p
   } catch (const std::exception&) {
     message = resp.body.substr(0, 256);
   }
-  throw std::runtime_error("k8s: " + method + " " + path + " → HTTP " +
-                           std::to_string(resp.status) + ": " + message);
+  throw ApiError(resp.status, "k8s: " + method + " " + path + " → HTTP " +
+                                  std::to_string(resp.status) + ": " + message);
 }
 
 std::optional<json::Value> Client::get_opt(const std::string& path) const {

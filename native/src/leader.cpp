@@ -5,6 +5,7 @@
 #include <chrono>
 
 #include "tpupruner/json.hpp"
+#include "tpupruner/k8s.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/util.hpp"
 
@@ -100,8 +101,8 @@ bool Elector::try_acquire_or_renew() {
 
   if (!lease) {
     // No lease yet: create it. A racing candidate's create wins with 201;
-    // the loser's POST 409s (AlreadyExists) and throws → caught by the
-    // renew loop, retried next tick.
+    // the loser's POST 409s (AlreadyExists) → return false, retried next
+    // tick. Non-409 failures throw into the renew loop's grace window.
     Value body = Value::object();
     body.set("apiVersion", Value("coordination.k8s.io/v1"));
     body.set("kind", Value("Lease"));
@@ -114,8 +115,9 @@ bool Elector::try_acquire_or_renew() {
       client_.post(lease_collection(opts_.lease_ns), body);
       last_renew_ok_ = mono_now;
       return true;
-    } catch (const std::exception&) {
-      return false;  // lost the creation race
+    } catch (const k8s::ApiError& e) {
+      if (e.status == 409) return false;  // lost the creation race
+      throw;  // transport/server failure → renew loop's grace window
     }
   }
 
@@ -162,8 +164,12 @@ bool Elector::try_acquire_or_renew() {
       client_.patch_merge(lease_path_, patch);
       last_renew_ok_ = mono_now;
       return true;
-    } catch (const std::exception&) {
-      return false;  // conflict: someone took over after an expiry window
+    } catch (const k8s::ApiError& e) {
+      // Only a genuine CAS conflict proves someone took over; a 5xx or
+      // timeout mid-renew must flow to the loop's leaseDuration grace
+      // window instead of demoting the leader on one API blip.
+      if (e.status == 409) return false;
+      throw;
     }
   }
 
@@ -186,8 +192,9 @@ bool Elector::try_acquire_or_renew() {
       client_.patch_merge(lease_path_, patch);
       last_renew_ok_ = mono_now;
       return true;
-    } catch (const std::exception&) {
-      return false;  // lost the takeover race
+    } catch (const k8s::ApiError& e) {
+      if (e.status == 409) return false;  // lost the takeover race
+      throw;
     }
   }
   return false;  // live lease held by someone else

@@ -45,6 +45,11 @@ Server::~Server() {
   if (listen_fd_ >= 0) ::close(listen_fd_);
 }
 
+void Server::set_health_probe(std::function<bool()> probe) {
+  std::lock_guard<std::mutex> lock(probe_mutex_);
+  probe_ = std::move(probe);
+}
+
 void Server::serve() {
   while (!stop_.load()) {
     struct pollfd pfd{listen_fd_, POLLIN, 0};
@@ -76,8 +81,13 @@ void Server::serve() {
 
     std::string body;
     std::string content_type = "text/plain";
+    bool healthy = true;
     if (healthz) {
-      body = "ok\n";
+      {
+        std::lock_guard<std::mutex> lock(probe_mutex_);
+        if (probe_) healthy = probe_();
+      }
+      body = healthy ? "ok\n" : "stalled: no completed cycle within the staleness window\n";
     } else {
       content_type = "text/plain; version=0.0.4";
       body = "# tpu-pruner operational counters\n";
@@ -87,7 +97,8 @@ void Server::serve() {
         body += metric + " " + std::to_string(counter.value) + "\n";
       }
     }
-    std::string resp = "HTTP/1.1 200 OK\r\nContent-Type: " + content_type +
+    std::string status_line = healthy ? "HTTP/1.1 200 OK" : "HTTP/1.1 503 Service Unavailable";
+    std::string resp = status_line + "\r\nContent-Type: " + content_type +
                        "\r\nContent-Length: " + std::to_string(body.size()) +
                        "\r\nConnection: close\r\n\r\n" + body;
     ::send(fd, resp.data(), resp.size(), MSG_NOSIGNAL);

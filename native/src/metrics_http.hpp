@@ -7,6 +7,8 @@
 #pragma once
 
 #include <atomic>
+#include <functional>
+#include <mutex>
 #include <thread>
 
 namespace tpupruner::metrics_http {
@@ -18,11 +20,19 @@ class Server {
   ~Server();
   int port() const { return port_; }
 
+  // Liveness seam: when set, /healthz answers 503 while the probe returns
+  // false. The daemon wires a cycle-staleness check here so a wedged
+  // producer loop (stuck cycle, deadlocked consumer) fails the kubelet
+  // probe — process death alone K8s already handles; hangs it cannot see.
+  void set_health_probe(std::function<bool()> probe);
+
  private:
   void serve();
   int listen_fd_ = -1;
   int port_ = 0;
   std::atomic<bool> stop_{false};
+  std::function<bool()> probe_;
+  std::mutex probe_mutex_;
   std::thread thread_;
 };
 

@@ -23,6 +23,12 @@ int64_t now_unix_nanos() {
   return static_cast<int64_t>(ts.tv_sec) * 1000000000ll + ts.tv_nsec;
 }
 
+int64_t mono_secs() {
+  struct timespec ts;
+  ::clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec);
+}
+
 std::string format_rfc3339(int64_t unix_secs, int64_t nanos, int subsec_digits) {
   std::tm tm{};
   time_t t = static_cast<time_t>(unix_secs);

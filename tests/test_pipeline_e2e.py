@@ -9,6 +9,7 @@ daemon, and the multi-host JobSet v5e-16 slice.
 """
 
 import subprocess
+import time
 
 import pytest
 
@@ -509,6 +510,50 @@ def test_skip_annotation_unresolvable_root_fails_closed(built, fake_prom, fake_k
         ["/apis/apps/v1/namespaces/other/deployments/victim/scale"]
 
 
+def test_pod_fetch_error_vetoes_namespace(built, fake_prom, fake_k8s):
+    """The opt-out valve fails CLOSED on pod-fetch errors too (ADVICE r1):
+    a candidate pod whose GET fails could carry tpu-pruner.dev/skip, so its
+    namespace is spared this cycle — otherwise an idle un-annotated sibling
+    could scale their shared root away. Self-heals next cycle."""
+    fake_k8s.add_deployment_chain("ml", "job-a")
+    fake_k8s.add_deployment_chain("ml", "job-b")
+    _, _, pods_c = fake_k8s.add_deployment_chain("other", "job-c")
+    fake_prom.add_idle_pod_series("job-a-abc123-0", "ml")
+    fake_prom.add_idle_pod_series("job-b-abc123-0", "ml")
+    fake_prom.add_idle_pod_series(pods_c[0]["metadata"]["name"], "other")
+    fake_k8s.fail_next("GET", "/api/v1/namespaces/ml/pods/job-a-abc123-0", 503)
+
+    proc = run_pruner(fake_prom, fake_k8s)
+    assert "vetoing namespace ml" in proc.stderr
+    # job-b resolved fine, but shares the vetoed namespace → spared too;
+    # the other namespace is unaffected
+    assert [p for p, _ in fake_k8s.scale_patches()] == \
+        ["/apis/apps/v1/namespaces/other/deployments/job-c/scale"]
+
+
+def test_pod_fetch_error_veto_self_heals_next_cycle(built, fake_prom, fake_k8s):
+    """The fetch-error veto is per-cycle state: once the API answers again,
+    the namespace is reclaimed normally (daemon mode, transient 503)."""
+    fake_k8s.add_deployment_chain("ml", "job-a")
+    fake_prom.add_idle_pod_series("job-a-abc123-0", "ml")
+    fake_k8s.fail_next("GET", "/api/v1/namespaces/ml/pods/job-a-abc123-0", 503, times=1)
+
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "scale-down", "--daemon-mode", "--check-interval", "1"]
+    env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin"}
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and not fake_k8s.scale_patches():
+            time.sleep(0.2)
+        assert [p for p, _ in fake_k8s.scale_patches()] == \
+            ["/apis/apps/v1/namespaces/ml/deployments/job-a/scale"]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
 def test_healthz_endpoint(built, fake_prom, fake_k8s):
     """/healthz on the metrics port answers K8s liveness/readiness probes
     (hack/deployment.yaml) without the metrics exposition."""
@@ -539,6 +584,53 @@ def test_healthz_endpoint(built, fake_prom, fake_k8s):
         assert "tpu-pruner operational counters" in metrics  # still the exposition
     finally:
         proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_healthz_turns_503_when_cycle_wedges(built, fake_prom, fake_k8s):
+    """ADVICE r1: a static 'ok' adds nothing over process liveness — the
+    probe must catch HANGS. When a cycle wedges (Prometheus read stalls),
+    /healthz flips to 503 once no loop tick lands within the staleness
+    window, so the kubelet can restart a daemon the failure budget can't
+    see. Window = max(3×check_interval, 60s); env-overridden here."""
+    import re
+    import urllib.error
+    import urllib.request
+
+    fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series("trainer-abc123-0", "ml")
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "dry-run", "--daemon-mode", "--check-interval", "1",
+           "--metrics-port", "auto"]
+    env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin",
+           "TPU_PRUNER_HEALTH_STALE_AFTER": "2"}
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        for line in proc.stderr:
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port
+
+        def healthz_status():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        assert healthz_status() == 200  # cycles ticking → healthy
+        fake_prom.hang_seconds = 25  # next query wedges the producer loop
+        deadline = time.time() + 15
+        while time.time() < deadline and healthz_status() == 200:
+            time.sleep(0.3)
+        assert healthz_status() == 503, "probe never noticed the wedged cycle"
+    finally:
+        proc.kill()  # SIGKILL: the producer is stuck mid-recv by design
         proc.wait(timeout=10)
 
 

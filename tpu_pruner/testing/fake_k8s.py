@@ -87,6 +87,9 @@ class FakeK8s:
         self.patch_times: list[float] = []  # time.monotonic() per patch (latency benches)
         self.requests: list[tuple[str, str]] = []  # (method, path)
         self.outage = False  # True → every request 503s (apiserver outage)
+        # targeted fault injection: (method or "*", exact path) → [code, n]
+        # where n is the remaining failure count (-1 = fail forever)
+        self.fail_rules: dict[tuple[str, str], list] = {}
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -282,6 +285,21 @@ class FakeK8s:
         return dep, rs, pods
 
     # ── introspection ──
+    def fail_next(self, method: str, path: str, code: int = 503, times: int = -1):
+        """Make `method` (or "*" for any) requests to the exact `path` fail
+        with `code`, `times` times (-1 = until cleared)."""
+        self.fail_rules[(method, path)] = [code, times]
+
+    def _injected_failure(self, method: str, path: str):
+        """Returns an HTTP code to fail with, or None. Caller holds _lock."""
+        for key in ((method, path), ("*", path)):
+            rule = self.fail_rules.get(key)
+            if rule and rule[1] != 0:
+                if rule[1] > 0:
+                    rule[1] -= 1
+                return rule[0]
+        return None
+
     def scale_patches(self):
         return [(p, b) for p, b in self.patches if p.endswith("/scale")]
 
@@ -345,6 +363,10 @@ class FakeK8s:
                 path = parsed.path
                 with fake._lock:
                     fake.requests.append(("GET", self.path))
+                    if (code := fake._injected_failure("GET", path)) is not None:
+                        self._respond(code, {"kind": "Status", "status": "Failure",
+                                             "message": "injected failure (test)"})
+                        return
                     # collection LIST (optional labelSelector), incl. empty lists
                     if path.rsplit("/", 1)[-1] in self.COLLECTIONS and "/namespaces/" in path:
                         selector = parse_qs(parsed.query).get("labelSelector", [""])[0]
@@ -373,6 +395,10 @@ class FakeK8s:
                 path = urlparse(self.path).path
                 with fake._lock:
                     fake.requests.append(("PATCH", self.path))
+                    if (code := fake._injected_failure("PATCH", path)) is not None:
+                        self._respond(code, {"kind": "Status", "status": "Failure",
+                                             "message": "injected failure (test)"})
+                        return
                     fake.patches.append((path, body))
                     fake.patch_times.append(time.monotonic())
                     target_path = path.removesuffix("/scale")
@@ -401,6 +427,10 @@ class FakeK8s:
                 path = urlparse(self.path).path
                 with fake._lock:
                     fake.requests.append(("POST", self.path))
+                    if (code := fake._injected_failure("POST", path)) is not None:
+                        self._respond(code, {"kind": "Status", "status": "Failure",
+                                             "message": "injected failure (test)"})
+                        return
                     if path.endswith("/events"):
                         fake.events.append(body)
                         self._respond(201, body)

@@ -22,6 +22,7 @@ class FakePrometheus:
         self.auth_headers: list[str | None] = []
         self.fail_requests_remaining = 0
         self.fail_status = 500
+        self.hang_seconds = 0.0  # >0 → every query stalls (wedged-backend sim)
         self._cached = None
         self._cached_version = -1
         self._version = 0
@@ -78,6 +79,8 @@ class FakePrometheus:
                 self.wfile.write(body)
 
             def _handle_query(self, query: str):
+                if fake.hang_seconds:  # before the lock: other verbs stay live
+                    time.sleep(fake.hang_seconds)
                 with fake._lock:
                     fake.queries.append(query)
                     fake.auth_headers.append(self.headers.get("Authorization"))
