@@ -1,34 +1,52 @@
 // Structured logging + operational counters.
 //
 // Reference analog: tracing-subscriber with three formats (json / default /
-// pretty, main.rs:128-134, 176-192), level filtering via RUST_LOG
-// (main.rs:173), and tracing-field counters that the OTEL layer turns into
-// metrics (main.rs:300-321, 349-365). Here: same three formats on stderr,
-// level via TPU_PRUNER_LOG (or RUST_LOG for drop-in familiarity), and a
-// process-wide counter registry with the reference's six counter names —
-// exposed over the optional /metrics endpoint instead of OTLP push.
+// pretty, main.rs:128-134, 176-192), EnvFilter level directives via RUST_LOG
+// (main.rs:159-173 — e.g. `gpu_pruner=debug,hyper=error` to silence wire
+// noise), and tracing-field counters that the OTEL layer turns into metrics
+// (main.rs:300-321, 349-365). Here: same three formats on stderr, the same
+// directive grammar via TPU_PRUNER_LOG (or RUST_LOG for drop-in
+// familiarity) — `debug`, `walker=debug,http=error`, `info,http=trace`,
+// `off` — and a process-wide counter registry with the reference's six
+// counter names, exposed over the optional /metrics endpoint instead of
+// OTLP push.
 #pragma once
 
 #include <cstdint>
 #include <map>
 #include <string>
+#include <string_view>
 
 namespace tpupruner::log {
 
-enum class Level : uint8_t { Trace = 0, Debug, Info, Warn, Error };
+// Off is a threshold-only sentinel (nothing logs AT Off).
+enum class Level : uint8_t { Trace = 0, Debug, Info, Warn, Error, Off };
 enum class Format : uint8_t { Default, Json, Pretty };
 
 void init(Format format);
-// Level resolution: TPU_PRUNER_LOG → RUST_LOG → "info".
+// Global default level after directive parsing (bare tokens in the spec).
 Level threshold();
+// Effective level for one module: exact `module=level` directive, else the
+// global default. Modules are flat names (walker, http, daemon, leader,
+// otlp, auth, actuate, metrics, query), not Rust-style paths.
+Level threshold_for(std::string_view module);
 
 void write(Level level, const std::string& msg);
+void write(Level level, std::string_view module, const std::string& msg);
 
 inline void trace(const std::string& msg) { write(Level::Trace, msg); }
 inline void debug(const std::string& msg) { write(Level::Debug, msg); }
 inline void info(const std::string& msg) { write(Level::Info, msg); }
 inline void warn(const std::string& msg) { write(Level::Warn, msg); }
 inline void error(const std::string& msg) { write(Level::Error, msg); }
+
+// Module-tagged variants; the module lands in the `target` field
+// (tpu_pruner::<module>) and selects its filter directive.
+inline void trace(std::string_view m, const std::string& msg) { write(Level::Trace, m, msg); }
+inline void debug(std::string_view m, const std::string& msg) { write(Level::Debug, m, msg); }
+inline void info(std::string_view m, const std::string& msg) { write(Level::Info, m, msg); }
+inline void warn(std::string_view m, const std::string& msg) { write(Level::Warn, m, msg); }
+inline void error(std::string_view m, const std::string& msg) { write(Level::Error, m, msg); }
 
 // Counters (reference names, main.rs:300-365):
 //   query_successes, query_failures, scale_successes, scale_failures,

@@ -25,6 +25,9 @@ int64_t now_unix_nanos();
 // Monotonic seconds (steady clock; staleness windows immune to NTP steps).
 int64_t mono_secs();
 
+// RFC 4648 base64 (no line breaks) — Proxy-Authorization: Basic credentials.
+std::string base64_encode(std::string_view in);
+
 // Format epoch seconds (+ optional subsecond digits of `nanos`) as RFC 3339
 // UTC, e.g. "2026-07-29T07:47:45Z" / "2026-07-29T07:47:45.123456Z".
 std::string format_rfc3339(int64_t unix_secs, int64_t nanos = 0, int subsec_digits = 0);
@@ -54,6 +57,8 @@ std::optional<std::string> env(const char* name);
 
 // URL-encode for application/x-www-form-urlencoded bodies / query strings.
 std::string url_encode(std::string_view s);
+// Inverse: %XX → byte; malformed escapes pass through verbatim.
+std::string url_decode(std::string_view s);
 
 // Run fn(i) for i in [0, n) from min(workers, n) threads pulling indices
 // off a shared counter, then join. The daemon's fan-out idiom (reference:

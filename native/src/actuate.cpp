@@ -27,9 +27,9 @@ void scale_to_zero(const k8s::Client& client, const ScaleTarget& target,
     Value event = core::generate_scale_event(target, ev_opts);
     try {
       client.post(k8s::Client::events_path(ns), event);
-      log::debug("emitted scale event for " + ns + "/" + name);
+      log::debug("actuate", "emitted scale event for " + ns + "/" + name);
     } catch (const std::exception& e) {
-      log::error(std::string("Failed to push Event for scale down!: ") + e.what());
+      log::error("actuate", std::string("Failed to push Event for scale down!: ") + e.what());
     }
   }
 

@@ -9,6 +9,7 @@
 #include <deque>
 #include <mutex>
 #include <thread>
+#include <unordered_map>
 #include <unordered_set>
 #include <vector>
 
@@ -76,7 +77,7 @@ prom::Client build_prom_client(const cli::Cli& args) {
   topts.explicit_token = args.prometheus_token;
   std::string token = auth::get_bearer_token(topts).value_or("");
   if (token.empty()) {
-    log::warn("no bearer token resolved for prometheus; sending unauthenticated requests");
+    log::warn("daemon", "no bearer token resolved for prometheus; sending unauthenticated requests");
   }
   http::TlsMode tls =
       args.prometheus_tls_mode == "skip" ? http::TlsMode::Skip : http::TlsMode::Verify;
@@ -92,11 +93,13 @@ struct ResolveOutcome {
   // Deployment would otherwise scale the shared root to zero and delete
   // the annotated pod with it.
   std::unordered_set<std::string> vetoed_roots;
-  // Namespaces where an annotated pod's root could NOT be resolved (walk
-  // error). A safety valve must fail closed: with the protected root
-  // unknown, every target in the namespace is dropped this cycle rather
-  // than risk pruning it; transient API errors self-heal next cycle.
-  std::unordered_set<std::string> vetoed_namespaces;
+  // Namespaces vetoed for the cycle, with the first cause (for operator-
+  // facing skip logs): an annotated pod whose root could NOT be resolved,
+  // or a candidate pod whose GET failed (it could carry the annotation).
+  // A safety valve must fail closed: with the protected root unknown,
+  // every target in the namespace is dropped this cycle rather than risk
+  // pruning it; transient API errors self-heal next cycle.
+  std::unordered_map<std::string, std::string> vetoed_namespaces;
 };
 
 using util::fan_out;
@@ -161,7 +164,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       try {
         list = kube.list(k8s::Client::pods_path(ns), "");
       } catch (const std::exception& e) {
-        log::warn("pods LIST failed in namespace " + ns + " (falling back to GETs): " + e.what());
+        log::warn("daemon", "pods LIST failed in namespace " + ns + " (falling back to GETs): " + e.what());
         return;
       }
       pod_lists[i] = std::move(list);  // distinct index per worker; no lock
@@ -179,7 +182,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     });
   }
   if (!batch_ns.empty()) {
-    log::info("Batched pod resolution: " + std::to_string(batch_ns.size()) +
+    log::info("daemon", "Batched pod resolution: " + std::to_string(batch_ns.size()) +
               " namespace LIST(s) covering " + std::to_string(prefetched.size()) + " pods");
   }
 
@@ -210,14 +213,14 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
         // would let an idle un-annotated sibling scale their shared root
         // away this very cycle. Veto the namespace; it self-heals next
         // cycle once the API answers again.
-        log::error("Skipping " + key + ", retrieval error (vetoing namespace " + pmd.ns +
+        log::error("daemon", "Skipping " + key + ", retrieval error (vetoing namespace " + pmd.ns +
                    " this cycle): " + e.what());
         std::lock_guard<std::mutex> lock(out_mutex);
-        out.vetoed_namespaces.insert(pmd.ns);
+        out.vetoed_namespaces.emplace(pmd.ns, "fetch error for pod " + key);
         return;
       }
       if (!fetched) {
-        log::info("Skipping " + key + ", pod no longer exists");
+        log::info("daemon", "Skipping " + key + ", pod no longer exists");
         return;
       }
       std::lock_guard<std::mutex> lock(out_mutex);
@@ -228,22 +231,22 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     core::Eligibility elig = core::check_eligibility(*pod, now, lookback_secs);
     switch (elig) {
       case core::Eligibility::Pending:
-        log::info("Skipping pod " + key + ", it's still pending");
+        log::info("daemon", "Skipping pod " + key + ", it's still pending");
         return;
       case core::Eligibility::NoCreationTs:
-        log::warn("Pod " + key + " has no creation timestamp, skipping");
+        log::warn("daemon", "Pod " + key + " has no creation timestamp, skipping");
         return;
       case core::Eligibility::BadTimestamp:
-        log::warn("Pod " + key + " has unparseable creation timestamp, skipping");
+        log::warn("daemon", "Pod " + key + " has unparseable creation timestamp, skipping");
         return;
       case core::Eligibility::TooYoung:
-        log::info("Pod " + key + " created within lookback window, skipping");
+        log::info("daemon", "Pod " + key + " created within lookback window, skipping");
         return;
       case core::Eligibility::OptedOut: {
         // Not a candidate — but its root must be vetoed for every kind, so
         // it still walks (kept out of idle_pods: an opted-out worker also
         // fails its group's all-idle gate).
-        log::info("Pod " + key + " is annotated " + std::string(core::kSkipAnnotation) +
+        log::info("daemon", "Pod " + key + " is annotated " + std::string(core::kSkipAnnotation) +
                   "=true, vetoing its root object");
         std::lock_guard<std::mutex> lock(out_mutex);
         eligible.push_back({&pmd, pod, /*opted_out=*/true});
@@ -252,7 +255,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       case core::Eligibility::Eligible:
         break;
     }
-    log::info("Pod " + key + " is idle and eligible for scaledown");
+    log::info("daemon", "Pod " + key + " is idle and eligible for scaledown");
     std::lock_guard<std::mutex> lock(out_mutex);
     out.idle_pods.insert(std::move(key));
     eligible.push_back({&pmd, pod});
@@ -269,7 +272,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
                                       args.resolve_batch_threshold, workers);
     span.attr("collection_lists", static_cast<int64_t>(lists));
     if (lists > 0) {
-      log::info("Batched owner resolution: " + std::to_string(lists) + " collection LIST(s)");
+      log::info("daemon", "Batched owner resolution: " + std::to_string(lists) + " collection LIST(s)");
     }
   }
   fan_out(workers, eligible.size(), [&](size_t i) {
@@ -286,12 +289,13 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
         if (e.opted_out) {
           // Can't learn which root the annotation protects — fail closed
           // on the whole namespace this cycle instead of failing open.
-          log::warn("Annotated pod " + key + " has no resolvable root (" + e2.what() +
+          log::warn("daemon", "Annotated pod " + key + " has no resolvable root (" + e2.what() +
                     "); vetoing namespace " + e.sample->ns + " this cycle");
           std::lock_guard<std::mutex> lock(out_mutex);
-          out.vetoed_namespaces.insert(e.sample->ns);
+          out.vetoed_namespaces.emplace(e.sample->ns,
+                                        "annotated pod " + key + " with unresolvable root");
         } else {
-          log::warn("Skipping " + key + ", no scalable root object: " + e2.what());
+          log::warn("daemon", "Skipping " + key + ", no scalable root object: " + e2.what());
         }
       }
     }
@@ -336,9 +340,9 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
 
   metrics::DecodeResult decoded = metrics::decode_instant_vector(response, args.device);
   for (const std::string& err : decoded.errors) {
-    log::error("Failed to unwrap pod fields: " + err);
+    log::error("daemon", "Failed to unwrap pod fields: " + err);
   }
-  log::info("Query returned " + std::to_string(decoded.num_series) + " series across " +
+  log::info("daemon", "Query returned " + std::to_string(decoded.num_series) + " series across " +
             std::to_string(decoded.samples.size()) + " unique pods");
 
   ResolveOutcome resolved = resolve_pods(args, kube, decoded.samples, cycle.context());
@@ -356,11 +360,12 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
         why = "annotated " + std::string(core::kSkipAnnotation) + "=true";
       } else if (resolved.vetoed_roots.count(t.identity())) {
         why = "vetoed by an annotated pod";
-      } else if (resolved.vetoed_namespaces.count(t.ns().value_or(""))) {
-        why = "namespace vetoed (annotated pod with unresolvable root)";
+      } else if (auto it = resolved.vetoed_namespaces.find(t.ns().value_or(""));
+                 it != resolved.vetoed_namespaces.end()) {
+        why = "namespace vetoed (" + it->second + ")";
       }
       if (!why.empty()) {
-        log::info("Skipping [" + std::string(core::kind_name(t.kind)) + "] " +
+        log::info("daemon", "Skipping [" + std::string(core::kind_name(t.kind)) + "] " +
                   t.ns().value_or("") + ":" + t.name() + ", " + why);
         continue;
       }
@@ -425,7 +430,7 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
       }
     }
     if (deferred > 0) {
-      log::warn("Circuit breaker: " + std::to_string(actionable) +
+      log::warn("daemon", "Circuit breaker: " + std::to_string(actionable) +
                 " scale candidates exceed --max-scale-per-cycle=" +
                 std::to_string(args.max_scale_per_cycle) + "; deferring " +
                 std::to_string(deferred) + " to later cycles");
@@ -446,9 +451,9 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
     std::string desc = "[" + std::string(core::kind_name(t.kind)) + "] " +
                        t.ns().value_or("") + ":" + t.name();
     if (args.dry_run()) {
-      log::info("Dry-run: Would have sent " + desc + " for scaledown");
+      log::info("daemon", "Dry-run: Would have sent " + desc + " for scaledown");
     } else {
-      log::info("Sending " + desc + " for scaledown");
+      log::info("daemon", "Sending " + desc + " for scaledown");
       enqueue(std::move(t));
     }
   }
@@ -470,18 +475,18 @@ int run(const cli::Cli& args) {
         kinds += core::kind_name(k);
       }
     }
-    log::info("Enabled resources: [" + kinds + "]");
+    log::info("daemon", "Enabled resources: [" + kinds + "]");
   }
 
   // Query built once, reused every cycle (main.rs:280-282).
   std::string query = query::build_idle_query(cli::to_query_args(args));
-  log::info("Running w/ Query: " + query);
+  log::info("daemon", "Running w/ Query: " + query);
 
   k8s::Client kube = [&] {
     try {
       return k8s::Client(k8s::Config::infer());
     } catch (const std::exception& e) {
-      log::error(std::string("failed to get kube client: ") + e.what());
+      log::error("daemon", std::string("failed to get kube client: ") + e.what());
       throw;
     }
   }();
@@ -503,7 +508,9 @@ int run(const cli::Cli& args) {
     int64_t stale_after = std::max<int64_t>(3 * args.check_interval, 60);
     if (auto o = util::env("TPU_PRUNER_HEALTH_STALE_AFTER")) {
       try {
-        stale_after = std::stoll(*o);
+        // Floor at 1: zero/negative would make a healthy daemon read as
+        // permanently stalled and restart-loop the pod.
+        stale_after = std::max<int64_t>(std::stoll(*o), 1);
       } catch (const std::exception&) {
       }
     }
@@ -568,10 +575,10 @@ int run(const cli::Cli& args) {
           req.timeout_ms = 5000;
           http::Response resp = client.request(req);
           if (resp.status < 200 || resp.status >= 300) {
-            log::warn("notify webhook returned HTTP " + std::to_string(resp.status));
+            log::warn("daemon", "notify webhook returned HTTP " + std::to_string(resp.status));
           }
         } catch (const std::exception& e) {
-          log::warn(std::string("notify webhook failed: ") + e.what());
+          log::warn("daemon", std::string("notify webhook failed: ") + e.what());
         }
       }
     });
@@ -590,7 +597,7 @@ int run(const cli::Cli& args) {
     body.set("action", json::Value("scale_down"));
     std::lock_guard<std::mutex> lock(notify_mutex);
     if (notify_queue.size() >= kNotifyQueueCap) {
-      log::warn("notify webhook queue full; dropping notification for " + desc);
+      log::warn("daemon", "notify webhook queue full; dropping notification for " + desc);
       return;
     }
     notify_queue.push_back(body.dump());
@@ -602,7 +609,7 @@ int run(const cli::Cli& args) {
       std::optional<ScaleTarget> t = queue.pop();
       if (!t) break;  // closed + drained
       if (!(enabled & core::flag(t->kind))) {
-        log::info("Skipping resource type " + std::string(core::kind_name(t->kind)) +
+        log::info("daemon", "Skipping resource type " + std::string(core::kind_name(t->kind)) +
                   " because it is not enabled");
         continue;
       }
@@ -620,11 +627,11 @@ int run(const cli::Cli& args) {
       } catch (const std::exception& e) {
         span.set_error(e.what());
         log::counter_add("scale_failures", 1);
-        log::error(std::string("Failed to scale resource! ") + e.what());
+        log::error("daemon", std::string("Failed to scale resource! ") + e.what());
         continue;
       }
       log::counter_add("scale_successes", 1);
-      log::info("Scaled Resource: [" + std::string(core::kind_name(t->kind)) + "] - " +
+      log::info("daemon", "Scaled Resource: [" + std::string(core::kind_name(t->kind)) + "] - " +
                 t->ns().value_or("default") + ":" + t->name());
       notify(*t);
     }
@@ -659,15 +666,15 @@ int run(const cli::Cli& args) {
       log::counter_add("query_successes", 1);
       log::counter_set("query_returned_candidates", stats.num_pods);
       log::counter_set("query_returned_shutdown_events", stats.shutdown_events);
-      log::info("Query succeeded: " + std::to_string(stats.num_pods) + " candidates, " +
+      log::info("daemon", "Query succeeded: " + std::to_string(stats.num_pods) + " candidates, " +
                 std::to_string(stats.shutdown_events) + " shutdown events");
     } catch (const std::exception& e) {
       int prev = consecutive_failures++;
       last_cycle_failed = true;
       log::counter_add("query_failures", 1);
-      log::error(std::string("Failed to run query and scale down: ") + e.what());
+      log::error("daemon", std::string("Failed to run query and scale down: ") + e.what());
       if (prev > kMaxConsecutiveFailures) {
-        log::error("Too many consecutive failures, exiting");
+        log::error("daemon", "Too many consecutive failures, exiting");
         budget_exhausted = true;
         break;
       }
@@ -687,7 +694,7 @@ int run(const cli::Cli& args) {
   }
 
   if (g_shutdown_signal) {
-    log::info(std::string("Received ") +
+    log::info("daemon", std::string("Received ") +
               (g_shutdown_signal == SIGINT ? "SIGINT" : "SIGTERM") +
               ", shutting down gracefully");
   }

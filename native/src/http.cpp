@@ -12,6 +12,7 @@
 #include <stdexcept>
 
 #include "tls.hpp"
+#include "tpupruner/log.hpp"
 #include "tpupruner/util.hpp"
 
 namespace tpupruner::http {
@@ -202,6 +203,117 @@ struct StaleConnection : std::runtime_error {
   using std::runtime_error::runtime_error;
 };
 
+// ── egress proxy (HTTPS_PROXY / HTTP_PROXY / NO_PROXY) ──
+//
+// The reference inherits this de-facto env contract from reqwest
+// (lib.rs:240-282 builds on its defaults): https targets honor
+// HTTPS_PROXY, http targets HTTP_PROXY, NO_PROXY lists bypass hosts
+// ("*" = bypass all; entries match exact host or domain suffix, string-
+// wise like curl — "127.0.0.1" does not match "localhost"). https is
+// tunneled with CONNECT; http is forwarded in absolute-form. Only
+// http:// proxies are supported (TLS *to* the proxy is rare enough that
+// reqwest gates it behind a non-default feature too).
+struct ProxyTarget {
+  std::string host;
+  int port = 80;
+  std::string basic_auth;  // full header value, e.g. "Basic dXNlcjpwdw=="
+};
+
+bool no_proxy_match(const std::string& host, const std::string& list) {
+  std::string h = util::to_lower(host);
+  size_t start = 0;
+  while (start <= list.size()) {
+    size_t comma = list.find(',', start);
+    if (comma == std::string::npos) comma = list.size();
+    std::string e = util::to_lower(util::trim(list.substr(start, comma - start)));
+    start = comma + 1;
+    if (e.empty()) continue;
+    if (e == "*") return true;
+    if (!e.empty() && e.front() == '.') e.erase(0, 1);
+    // strip a :port suffix (but leave IPv6 literals alone)
+    if (size_t colon = e.rfind(':');
+        colon != std::string::npos && e.find(':') == colon) {
+      e.resize(colon);
+    }
+    if (h == e) return true;
+    if (h.size() > e.size() && h[h.size() - e.size() - 1] == '.' &&
+        h.compare(h.size() - e.size(), e.size(), e) == 0) {
+      return true;
+    }
+  }
+  return false;
+}
+
+std::optional<ProxyTarget> proxy_for(const Url& url) {
+  // The GCE metadata server is link-local: no egress proxy can ever reach
+  // it, and google-auth/gcloud always bypass proxies for it. Without this,
+  // HTTPS_PROXY would break Workload Identity token minting in-cluster.
+  if (url.host == "metadata.google.internal" || url.host == "169.254.169.254") {
+    return std::nullopt;
+  }
+  auto env2 = [](const char* upper, const char* lower) -> std::optional<std::string> {
+    if (auto v = util::env(upper); v && !v->empty()) return v;
+    if (auto v = util::env(lower); v && !v->empty()) return v;
+    return std::nullopt;
+  };
+  std::optional<std::string> spec = url.scheme == "https"
+                                        ? env2("HTTPS_PROXY", "https_proxy")
+                                        : env2("HTTP_PROXY", "http_proxy");
+  if (!spec) return std::nullopt;
+  if (auto np = env2("NO_PROXY", "no_proxy"); np && no_proxy_match(url.host, *np)) {
+    return std::nullopt;
+  }
+  std::string s = *spec;
+  if (s.find("://") == std::string::npos) s = "http://" + s;
+  // Only plaintext-HTTP proxies: https:// (TLS to the proxy) and socks5://
+  // would silently speak the wrong protocol to that port, turning every
+  // cycle into opaque transport errors — fail loudly instead.
+  if (s.compare(0, 7, "http://") != 0) {
+    fail("unsupported proxy scheme in " + *spec + " (only http:// proxies are supported)");
+  }
+  // split out userinfo before parse_url (which doesn't model it)
+  ProxyTarget out;
+  std::string rest = s.substr(7);
+  if (size_t slash = rest.find('/'); slash != std::string::npos) rest.resize(slash);
+  if (size_t at = rest.rfind('@'); at != std::string::npos) {
+    // Percent-decode first (curl/reqwest semantics): a password containing
+    // '@' or ':' MUST be %-encoded in the URL, and the Basic credentials
+    // carry the decoded form.
+    out.basic_auth = "Basic " + util::base64_encode(util::url_decode(rest.substr(0, at)));
+    rest = rest.substr(at + 1);
+  }
+  auto parsed = parse_url("http://" + rest + "/");
+  if (!parsed) fail("invalid proxy url in environment: " + *spec);
+  out.host = parsed->host;
+  out.port = parsed->port;
+  return out;
+}
+
+// Issues CONNECT on a fresh proxy connection and validates the 200 before
+// the TLS handshake rides the tunnel.
+void establish_tunnel(Conn& conn, const Url& target, const ProxyTarget& proxy,
+                      int timeout_ms) {
+  std::string authority = target.host + ":" + std::to_string(target.port);
+  std::string creq = "CONNECT " + authority + " HTTP/1.1\r\nHost: " + authority + "\r\n";
+  if (!proxy.basic_auth.empty()) creq += "Proxy-Authorization: " + proxy.basic_auth + "\r\n";
+  creq += "\r\n";
+  conn.set_timeout(timeout_ms);
+  conn.write_all(creq.data(), creq.size());
+  Reader reader{conn};
+  std::string status_line = reader.read_line();
+  size_t sp = status_line.find(' ');
+  int code = sp == std::string::npos ? 0 : std::atoi(status_line.c_str() + sp + 1);
+  while (!reader.read_line().empty()) {
+  }
+  if (code != 200) {
+    fail("proxy CONNECT " + authority + " via " + proxy.host + ":" +
+         std::to_string(proxy.port) + " → " + status_line);
+  }
+  // Safe to hand the fd to TLS: the server end of the tunnel cannot have
+  // sent bytes yet (TLS servers speak only after ClientHello), so the
+  // reader buffer is empty past the proxy headers.
+}
+
 }  // namespace
 
 std::optional<Url> parse_url(std::string_view url) {
@@ -271,6 +383,7 @@ Response Client::request(const Request& req) const {
 
 Response Client::request_once(const Request& req, const Url& url, bool allow_reuse) const {
   const std::string pool_key = url.scheme + "://" + url.host + ":" + std::to_string(url.port);
+  std::optional<ProxyTarget> proxy = proxy_for(url);
 
   std::unique_ptr<Conn> conn;
   if (allow_reuse) {
@@ -284,7 +397,14 @@ Response Client::request_once(const Request& req, const Url& url, bool allow_reu
   }
   if (!conn) {
     conn = std::make_unique<Conn>();
-    conn->fd = connect_with_timeout(url.host, url.port, req.timeout_ms);
+    if (proxy) {
+      conn->fd = connect_with_timeout(proxy->host, proxy->port, req.timeout_ms);
+      if (url.scheme == "https") {
+        establish_tunnel(*conn, url, *proxy, req.timeout_ms);
+      }
+    } else {
+      conn->fd = connect_with_timeout(url.host, url.port, req.timeout_ms);
+    }
     if (url.scheme == "https") {
       conn->tls_conn = std::make_unique<tls::Conn>(conn->fd, url.host,
                                                    tls_mode_ == TlsMode::Verify, ca_file_);
@@ -293,10 +413,21 @@ Response Client::request_once(const Request& req, const Url& url, bool allow_reu
   conn->set_timeout(req.timeout_ms);
 
   // ── send request ──
-  std::string msg = req.method + " " + url.target + " HTTP/1.1\r\n";
+  // Through an http proxy, plain-http requests go out in absolute-form
+  // (RFC 9112 §3.2.2) so the proxy knows the upstream; tunneled https and
+  // direct connections keep origin-form.
+  std::string request_target = url.target;
+  if (proxy && url.scheme == "http") {
+    request_target = "http://" + url.host +
+                     (url.port != 80 ? ":" + std::to_string(url.port) : "") + url.target;
+  }
+  std::string msg = req.method + " " + request_target + " HTTP/1.1\r\n";
   msg += "Host: " + url.host +
          (url.port != (url.scheme == "https" ? 443 : 80) ? ":" + std::to_string(url.port) : "") +
          "\r\n";
+  if (proxy && url.scheme == "http" && !proxy->basic_auth.empty()) {
+    msg += "Proxy-Authorization: " + proxy->basic_auth + "\r\n";
+  }
   bool has_ua = false;
   for (const auto& [k, v] : req.headers) {
     msg += k + ": " + v + "\r\n";
@@ -308,6 +439,20 @@ Response Client::request_once(const Request& req, const Url& url, bool allow_reu
   }
   msg += "\r\n";
   msg += req.body;
+
+  // Wire log under its own module so production debugging can do
+  // `TPU_PRUNER_LOG=info,http=trace` (or the inverse: silence it with
+  // http=error) — the reference's hyper/reqwest EnvFilter noise story
+  // (main.rs:159-170). Never logs bodies: they can carry bearer tokens.
+  // Gated up front: hundreds of requests per cycle must not pay the
+  // string building just to have write() drop it.
+  const bool wire_trace = log::threshold_for("http") <= log::Level::Trace;
+  if (wire_trace) {
+    log::trace("http", req.method + " " + url.scheme + "://" + url.host + ":" +
+                           std::to_string(url.port) + url.target + " body=" +
+                           std::to_string(req.body.size()) + "B" +
+                           (conn->reused ? " (pooled)" : " (fresh)"));
+  }
 
   Reader reader{*conn};
   try {
@@ -390,6 +535,11 @@ Response Client::request_once(const Request& req, const Url& url, bool allow_reu
       resp.body = reader.read_to_eof();
       keep_alive = false;
     }
+  }
+
+  if (wire_trace) {
+    log::trace("http", "→ " + std::to_string(resp.status) + ", " +
+                           std::to_string(resp.body.size()) + "B");
   }
 
   // Return the connection to the pool only when the response framing left

@@ -60,7 +60,7 @@ Elector::Elector(const k8s::Client& client, Options opts)
       try {
         now = try_acquire_or_renew();
       } catch (const std::exception& e) {
-        log::warn(std::string("leader election attempt failed: ") + e.what());
+        log::warn("leader", std::string("leader election attempt failed: ") + e.what());
         // Transport errors: a leader keeps leading only until the lease
         // would have expired anyway — past that, a standby has taken over,
         // so self-demote to bound dual-leadership to one lease window. A
@@ -69,12 +69,12 @@ Elector::Elector(const k8s::Client& client, Options opts)
         now = was && last_renew_ok_ &&
               std::chrono::steady_clock::now() - *last_renew_ok_ < deadline;
         if (was && !now) {
-          log::warn("leader election: could not renew within the lease duration, "
+          log::warn("leader", "leader election: could not renew within the lease duration, "
                     "self-demoting");
         }
       }
       if (now != was) {
-        log::info(now ? "leader election: acquired lease " + opts_.lease_ns + "/" +
+        log::info("leader", now ? "leader election: acquired lease " + opts_.lease_ns + "/" +
                             opts_.lease_name + " as " + opts_.identity
                       : "leader election: lost lease " + opts_.lease_ns + "/" +
                             opts_.lease_name);
@@ -223,7 +223,7 @@ void Elector::release() {
     patch.set("spec", std::move(spec));
     client_.patch_merge(lease_path_, patch);
   } catch (const std::exception& e) {
-    log::debug(std::string("lease release failed (will expire instead): ") + e.what());
+    log::debug("leader", std::string("lease release failed (will expire instead): ") + e.what());
   }
 }
 

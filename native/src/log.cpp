@@ -15,6 +15,7 @@ Format g_format = Format::Default;
 Level g_threshold = Level::Info;
 bool g_initialized = false;
 std::map<std::string, Counter> g_counters;
+std::map<std::string, Level, std::less<>> g_module_levels;
 
 Level parse_level(const std::string& s) {
   std::string l = util::to_lower(s);
@@ -23,7 +24,30 @@ Level parse_level(const std::string& s) {
   if (l == "info") return Level::Info;
   if (l == "warn" || l == "warning") return Level::Warn;
   if (l == "error") return Level::Error;
+  if (l == "off" || l == "none") return Level::Off;
   return Level::Info;
+}
+
+// EnvFilter directive grammar (reference main.rs:173 semantics): a comma-
+// separated list where a bare level sets the global default and
+// `module=level` overrides one module. Unknown level words fall back to
+// info rather than erroring — a typo'd filter must not kill the daemon.
+void parse_directives(const std::string& spec) {
+  size_t start = 0;
+  while (start <= spec.size()) {
+    size_t comma = spec.find(',', start);
+    if (comma == std::string::npos) comma = spec.size();
+    std::string token = util::trim(spec.substr(start, comma - start));
+    start = comma + 1;
+    if (token.empty()) continue;
+    size_t eq = token.find('=');
+    if (eq == std::string::npos) {
+      g_threshold = parse_level(token);
+    } else {
+      std::string module = util::trim(token.substr(0, eq));
+      if (!module.empty()) g_module_levels[module] = parse_level(token.substr(eq + 1));
+    }
+  }
 }
 
 const char* level_name(Level l) {
@@ -33,6 +57,7 @@ const char* level_name(Level l) {
     case Level::Info: return "INFO";
     case Level::Warn: return "WARN";
     case Level::Error: return "ERROR";
+    case Level::Off: break;  // threshold-only; nothing logs AT Off
   }
   return "?";
 }
@@ -44,15 +69,25 @@ const char* level_color(Level l) {
     case Level::Info: return "\x1b[32m";
     case Level::Warn: return "\x1b[33m";
     case Level::Error: return "\x1b[31m";
+    case Level::Off: break;
   }
   return "";
 }
 
 void ensure_init() {
   if (g_initialized) return;
-  if (auto lv = util::env("TPU_PRUNER_LOG")) g_threshold = parse_level(*lv);
-  else if (auto lv2 = util::env("RUST_LOG")) g_threshold = parse_level(*lv2);
+  g_module_levels.clear();
+  if (auto lv = util::env("TPU_PRUNER_LOG")) parse_directives(*lv);
+  else if (auto lv2 = util::env("RUST_LOG")) parse_directives(*lv2);
   g_initialized = true;
+}
+
+Level threshold_for_locked(std::string_view module) {
+  if (!module.empty()) {
+    auto it = g_module_levels.find(module);
+    if (it != g_module_levels.end()) return it->second;
+  }
+  return g_threshold;
 }
 
 }  // namespace
@@ -70,10 +105,20 @@ Level threshold() {
   return g_threshold;
 }
 
-void write(Level level, const std::string& msg) {
+Level threshold_for(std::string_view module) {
   std::lock_guard<std::mutex> lock(g_mutex);
   ensure_init();
-  if (level < g_threshold) return;
+  return threshold_for_locked(module);
+}
+
+void write(Level level, const std::string& msg) { write(level, std::string_view(), msg); }
+
+void write(Level level, std::string_view module, const std::string& msg) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  ensure_init();
+  if (level < threshold_for_locked(module)) return;
+  std::string target = "tpu_pruner";
+  if (!module.empty()) target += "::" + std::string(module);
   std::string ts = util::now_rfc3339_micro();
   switch (g_format) {
     case Format::Json: {
@@ -81,16 +126,18 @@ void write(Level level, const std::string& msg) {
       v.set("timestamp", json::Value(ts));
       v.set("level", json::Value(util::to_lower(level_name(level))));
       v.set("fields", json::Value(json::Object{{"message", json::Value(msg)}}));
-      v.set("target", json::Value("tpu_pruner"));
+      v.set("target", json::Value(target));
       std::fprintf(stderr, "%s\n", v.dump().c_str());
       break;
     }
     case Format::Pretty:
-      std::fprintf(stderr, "  %s%s\x1b[0m %s\n    \x1b[90mat %s\x1b[0m\n",
-                   level_color(level), level_name(level), msg.c_str(), ts.c_str());
+      std::fprintf(stderr, "  %s%s\x1b[0m %s\n    \x1b[90mat %s %s\x1b[0m\n",
+                   level_color(level), level_name(level), msg.c_str(), target.c_str(),
+                   ts.c_str());
       break;
     case Format::Default:
-      std::fprintf(stderr, "%s %5s tpu_pruner: %s\n", ts.c_str(), level_name(level), msg.c_str());
+      std::fprintf(stderr, "%s %5s %s: %s\n", ts.c_str(), level_name(level), target.c_str(),
+                   msg.c_str());
       break;
   }
   std::fflush(stderr);

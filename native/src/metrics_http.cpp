@@ -36,7 +36,7 @@ Server::Server(int port) {
     throw std::runtime_error("metrics: listen failed");
   }
   thread_ = std::thread([this] { serve(); });
-  log::info("serving /metrics on port " + std::to_string(port_));
+  log::info("metrics", "serving /metrics on port " + std::to_string(port_));
 }
 
 Server::~Server() {

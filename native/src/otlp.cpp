@@ -50,7 +50,7 @@ std::vector<FinishedSpan> drain_spans() {
   std::vector<FinishedSpan> out;
   out.swap(g_spans);
   if (g_spans_dropped > 0) {
-    log::warn("OTLP span buffer overflowed; dropped " + std::to_string(g_spans_dropped) +
+    log::warn("otlp", "OTLP span buffer overflowed; dropped " + std::to_string(g_spans_dropped) +
               " spans");
     g_spans_dropped = 0;
   }
@@ -117,12 +117,12 @@ Exporter::Exporter(std::string endpoint, int interval_ms)
                            "OTEL_TRACES_EXPORTER", "/v1/traces");
 
   if (metrics_url_.empty() && traces_url_.empty()) {
-    log::info("OTLP export: both signals disabled (OTEL_*_EXPORTER=none)");
+    log::info("otlp", "OTLP export: both signals disabled (OTEL_*_EXPORTER=none)");
     return;  // no thread, no recording — a fully inert exporter
   }
   if (!traces_url_.empty()) g_recording.store(true);
   thread_ = std::thread([this] { loop(); });
-  log::info("OTLP export: metrics -> " + (metrics_url_.empty() ? "(off)" : metrics_url_) +
+  log::info("otlp", "OTLP export: metrics -> " + (metrics_url_.empty() ? "(off)" : metrics_url_) +
             ", traces -> " + (traces_url_.empty() ? "(off)" : traces_url_) + " every " +
             std::to_string(interval_ms_) + "ms");
 }
@@ -143,7 +143,7 @@ std::unique_ptr<Exporter> Exporter::from_config(const std::string& cli_endpoint)
     try {
       interval_ms = std::max(100, std::stoi(*iv));
     } catch (const std::exception&) {
-      log::warn("ignoring unparseable OTEL_METRIC_EXPORT_INTERVAL: " + *iv);
+      log::warn("otlp", "ignoring unparseable OTEL_METRIC_EXPORT_INTERVAL: " + *iv);
     }
   }
   return std::make_unique<Exporter>(std::move(base), interval_ms);
@@ -275,12 +275,12 @@ bool Exporter::post(const std::string& url, const std::string& body_json) {
     req.timeout_ms = 5000;
     http::Response resp = client.request(req);
     if (resp.status < 200 || resp.status >= 300) {
-      log::warn("OTLP export to " + url + " got HTTP " + std::to_string(resp.status));
+      log::warn("otlp", "OTLP export to " + url + " got HTTP " + std::to_string(resp.status));
       return false;
     }
     return true;
   } catch (const std::exception& e) {
-    log::warn("OTLP export to " + url + " failed: " + e.what());
+    log::warn("otlp", "OTLP export to " + url + " failed: " + e.what());
     return false;
   }
 }

@@ -37,7 +37,7 @@ std::optional<ScaleTarget> fetch(const k8s::Client& client, FetchCache* cache, K
     if (!obj) return std::nullopt;
     return ScaleTarget{kind, std::move(*obj)};
   } catch (const std::exception& e) {
-    log::warn("fetch " + std::string(core::kind_name(kind)) + " " + ns + "/" + name +
+    log::warn("walker", "fetch " + std::string(core::kind_name(kind)) + " " + ns + "/" + name +
               " failed: " + e.what());
     return std::nullopt;
   }
@@ -160,7 +160,7 @@ size_t list_and_seed(const k8s::Client& client, FetchCache& cache, const DemandM
       collection = client.list(path, "");
       lists.fetch_add(1);
     } catch (const std::exception& e) {
-      log::warn("prefetch LIST " + path + " failed (falling back to GETs): " + e.what());
+      log::warn("walker", "prefetch LIST " + path + " failed (falling back to GETs): " + e.what());
       return;
     }
     const Value* items = collection.find("items");
@@ -176,7 +176,7 @@ size_t list_and_seed(const k8s::Client& client, FetchCache& cache, const DemandM
       }
       ++hit;
     }
-    log::debug("prefetch " + path + ": " + std::to_string(hit) + "/" +
+    log::debug("walker", "prefetch " + path + ": " + std::to_string(hit) + "/" +
                std::to_string(names.size()) + " demanded owners seeded");
   });
   return lists.load();
@@ -307,17 +307,17 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
         try {
           job = cached_get_opt(client, cache, k8s::Client::job_path(ns, name));
         } catch (const std::exception& e) {
-          log::warn("fetch Job " + ns + "/" + name + " failed: " + e.what());
+          log::warn("walker", "fetch Job " + ns + "/" + name + " failed: " + e.what());
         }
         if (job) {
           if (const Value* js_or = owner_of_kind(*job, "JobSet")) {
             return fetch_must(client, cache, Kind::JobSet, ns, js_or->get_string("name"));
           }
-          log::debug("pod " + ns + "/" + pod_name + ": bare Job owner '" + name +
+          log::debug("walker", "pod " + ns + "/" + pod_name + ": bare Job owner '" + name +
                      "' is not scalable, ignoring");
         }
       } else {
-        log::debug("ignoring unrecognized owner ref kind: " + kind);
+        log::debug("walker", "ignoring unrecognized owner ref kind: " + kind);
       }
     }
   }
@@ -351,13 +351,13 @@ bool verdict_from_pods(const std::string& ns, const std::string& name,
     const Value* pn = pod->at_path("metadata.name");
     if (!pn || !pn->is_string()) return false;
     if (!idle.count(pod_key(ns, pn->as_string()))) {
-      log::info("group " + ns + "/" + name + " not fully idle: pod " + pn->as_string() +
+      log::info("walker", "group " + ns + "/" + name + " not fully idle: pod " + pn->as_string() +
                 " is active — skipping suspend");
       return false;
     }
   }
   if (tpu_pods == 0) {
-    log::info("group " + ns + "/" + name + " has no google.com/tpu pods — skipping");
+    log::info("walker", "group " + ns + "/" + name + " has no google.com/tpu pods — skipping");
     return false;
   }
   return true;
@@ -384,7 +384,7 @@ std::vector<char> groups_fully_idle(const k8s::Client& client,
   for (size_t i = 0; i < groups.size(); ++i) {
     const char* label = group_label_key(groups[i]->kind);
     if (!label) {
-      log::warn("groups_fully_idle: " + std::string(core::kind_name(groups[i]->kind)) +
+      log::warn("walker", "groups_fully_idle: " + std::string(core::kind_name(groups[i]->kind)) +
                 " is not a multi-host group kind");
       continue;
     }
@@ -403,7 +403,7 @@ std::vector<char> groups_fully_idle(const k8s::Client& client,
     try {
       pods = client.list(k8s::Client::pods_path(ns), selector);
     } catch (const std::exception& e) {
-      log::warn("group idleness LIST failed in namespace " + ns + ": " + e.what());
+      log::warn("walker", "group idleness LIST failed in namespace " + ns + ": " + e.what());
       continue;  // all targets in this bucket stay kept=false (safe side)
     }
     const Value* items = pods.find("items");
@@ -420,7 +420,7 @@ std::vector<char> groups_fully_idle(const k8s::Client& client,
       const std::string name = groups[idx]->name();
       auto it = pods_by_group.find(name);
       if (it == pods_by_group.end()) {
-        log::info("group " + ns + "/" + name + " has no pods — skipping");
+        log::info("walker", "group " + ns + "/" + name + " has no pods — skipping");
         continue;
       }
       keep[idx] = verdict_from_pods(ns, name, it->second, idle) ? 1 : 0;

@@ -836,6 +836,64 @@ def test_oversized_response_is_transport_error_not_oom(built, fake_k8s):
         srv.close()
 
 
+# ── per-module log filtering (reference EnvFilter, main.rs:159-173) ────────
+
+
+def run_with_log_spec(fake_prom, fake_k8s, spec):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", f"w{abs(hash(spec)) % 1000}")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "dry-run", "--log-format", "json"]
+    env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin",
+           "TPU_PRUNER_LOG": spec}
+    return subprocess.run(cmd, capture_output=True, text=True, timeout=60, env=env)
+
+
+def test_log_filter_enables_one_module(built, fake_prom, fake_k8s):
+    """`info,http=trace` turns on wire logs alone: http trace lines appear,
+    no other module logs below info."""
+    proc = run_with_log_spec(fake_prom, fake_k8s, "info,http=trace")
+    assert proc.returncode == 0
+    assert '"target":"tpu_pruner::http"' in proc.stderr.replace(" ", "")
+    # trace from http only — no daemon/walker debug leaked through
+    for line in proc.stderr.splitlines():
+        if '"level":"trace"' in line.replace(" ", "") or \
+           '"level":"debug"' in line.replace(" ", ""):
+            assert "tpu_pruner::http" in line, line
+
+
+def test_log_filter_silences_one_module(built, fake_prom, fake_k8s):
+    """`debug,http=error` is the reference's hyper-noise story inverted:
+    everything verbose except the wire."""
+    proc = run_with_log_spec(fake_prom, fake_k8s, "debug,http=error")
+    assert proc.returncode == 0
+    flat = proc.stderr.replace(" ", "")
+    assert '"target":"tpu_pruner::http"' not in flat  # http has no error logs
+    assert '"level":"debug"' in flat or '"level":"info"' in flat
+
+
+def test_log_filter_off_is_silent(built, fake_prom, fake_k8s):
+    proc = run_with_log_spec(fake_prom, fake_k8s, "off")
+    assert proc.returncode == 0
+    assert proc.stderr.strip() == ""
+
+
+def test_log_filter_rust_log_fallback(built, fake_prom, fake_k8s):
+    """RUST_LOG works as the directive source when TPU_PRUNER_LOG is unset
+    (drop-in familiarity with the reference)."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "rl")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "dry-run", "--log-format", "json"]
+    env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin",
+           "RUST_LOG": "error,http=trace"}
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=60, env=env)
+    assert proc.returncode == 0
+    flat = proc.stderr.replace(" ", "")
+    assert '"target":"tpu_pruner::http"' in flat
+    assert '"level":"info"' not in flat  # global error threshold held
+
+
 # ── failure budget (main.rs:299-320) ───────────────────────────────────────
 
 

@@ -10,5 +10,6 @@ applies real merge-patch semantics.
 
 from tpu_pruner.testing.fake_k8s import FakeK8s
 from tpu_pruner.testing.fake_prom import FakePrometheus
+from tpu_pruner.testing.fake_proxy import FakeProxy
 
-__all__ = ["FakeK8s", "FakePrometheus"]
+__all__ = ["FakeK8s", "FakePrometheus", "FakeProxy"]
