@@ -221,12 +221,8 @@ struct ProxyTarget {
 
 bool no_proxy_match(const std::string& host, const std::string& list) {
   std::string h = util::to_lower(host);
-  size_t start = 0;
-  while (start <= list.size()) {
-    size_t comma = list.find(',', start);
-    if (comma == std::string::npos) comma = list.size();
-    std::string e = util::to_lower(util::trim(list.substr(start, comma - start)));
-    start = comma + 1;
+  for (const std::string& raw : util::split(list, ',')) {
+    std::string e = util::to_lower(util::trim(raw));
     if (e.empty()) continue;
     if (e == "*") return true;
     if (!e.empty() && e.front() == '.') e.erase(0, 1);
@@ -244,32 +240,15 @@ bool no_proxy_match(const std::string& host, const std::string& list) {
   return false;
 }
 
-std::optional<ProxyTarget> proxy_for(const Url& url) {
-  // The GCE metadata server is link-local: no egress proxy can ever reach
-  // it, and google-auth/gcloud always bypass proxies for it. Without this,
-  // HTTPS_PROXY would break Workload Identity token minting in-cluster.
-  if (url.host == "metadata.google.internal" || url.host == "169.254.169.254") {
-    return std::nullopt;
-  }
-  auto env2 = [](const char* upper, const char* lower) -> std::optional<std::string> {
-    if (auto v = util::env(upper); v && !v->empty()) return v;
-    if (auto v = util::env(lower); v && !v->empty()) return v;
-    return std::nullopt;
-  };
-  std::optional<std::string> spec = url.scheme == "https"
-                                        ? env2("HTTPS_PROXY", "https_proxy")
-                                        : env2("HTTP_PROXY", "http_proxy");
-  if (!spec) return std::nullopt;
-  if (auto np = env2("NO_PROXY", "no_proxy"); np && no_proxy_match(url.host, *np)) {
-    return std::nullopt;
-  }
-  std::string s = *spec;
+ProxyTarget parse_proxy_spec(const std::string& spec) {
+  std::string s = spec;
   if (s.find("://") == std::string::npos) s = "http://" + s;
   // Only plaintext-HTTP proxies: https:// (TLS to the proxy) and socks5://
   // would silently speak the wrong protocol to that port, turning every
-  // cycle into opaque transport errors — fail loudly instead.
-  if (s.compare(0, 7, "http://") != 0) {
-    fail("unsupported proxy scheme in " + *spec + " (only http:// proxies are supported)");
+  // cycle into opaque transport errors — fail loudly instead. Schemes are
+  // case-insensitive (RFC 3986), so HTTP://… must pass.
+  if (util::to_lower(s.substr(0, 7)) != "http://") {
+    fail("unsupported proxy scheme in " + spec + " (only http:// proxies are supported)");
   }
   // split out userinfo before parse_url (which doesn't model it)
   ProxyTarget out;
@@ -283,10 +262,51 @@ std::optional<ProxyTarget> proxy_for(const Url& url) {
     rest = rest.substr(at + 1);
   }
   auto parsed = parse_url("http://" + rest + "/");
-  if (!parsed) fail("invalid proxy url in environment: " + *spec);
+  if (!parsed) fail("invalid proxy url in environment: " + spec);
   out.host = parsed->host;
   out.port = parsed->port;
   return out;
+}
+
+// Env is fixed for the process lifetime, so the whole proxy config —
+// getenv, URL parse, credential encoding — is computed exactly once
+// (thread-safe static init); per-request work is one NO_PROXY string
+// match. A malformed proxy URL throws on first use and retries on the
+// next call (function-local static init semantics), staying loud.
+struct ProxyEnv {
+  std::optional<ProxyTarget> https_proxy, http_proxy;
+  std::string no_proxy;
+};
+
+const ProxyEnv& proxy_env() {
+  static const ProxyEnv env = [] {
+    auto env2 = [](const char* upper, const char* lower) -> std::optional<std::string> {
+      if (auto v = util::env(upper); v && !v->empty()) return v;
+      if (auto v = util::env(lower); v && !v->empty()) return v;
+      return std::nullopt;
+    };
+    ProxyEnv out;
+    out.no_proxy = env2("NO_PROXY", "no_proxy").value_or("");
+    if (auto s = env2("HTTPS_PROXY", "https_proxy")) out.https_proxy = parse_proxy_spec(*s);
+    if (auto s = env2("HTTP_PROXY", "http_proxy")) out.http_proxy = parse_proxy_spec(*s);
+    return out;
+  }();
+  return env;
+}
+
+std::optional<ProxyTarget> proxy_for(const Url& url) {
+  // The GCE metadata server is link-local: no egress proxy can ever reach
+  // it, and google-auth/gcloud always bypass proxies for it. Without this,
+  // HTTPS_PROXY would break Workload Identity token minting in-cluster.
+  if (url.host == "metadata.google.internal" || url.host == "169.254.169.254") {
+    return std::nullopt;
+  }
+  const ProxyEnv& env = proxy_env();
+  const std::optional<ProxyTarget>& proxy =
+      url.scheme == "https" ? env.https_proxy : env.http_proxy;
+  if (!proxy) return std::nullopt;
+  if (!env.no_proxy.empty() && no_proxy_match(url.host, env.no_proxy)) return std::nullopt;
+  return proxy;
 }
 
 // Issues CONNECT on a fresh proxy connection and validates the 200 before

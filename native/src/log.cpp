@@ -33,12 +33,8 @@ Level parse_level(const std::string& s) {
 // `module=level` overrides one module. Unknown level words fall back to
 // info rather than erroring — a typo'd filter must not kill the daemon.
 void parse_directives(const std::string& spec) {
-  size_t start = 0;
-  while (start <= spec.size()) {
-    size_t comma = spec.find(',', start);
-    if (comma == std::string::npos) comma = spec.size();
-    std::string token = util::trim(spec.substr(start, comma - start));
-    start = comma + 1;
+  for (const std::string& raw : util::split(spec, ',')) {
+    std::string token = util::trim(raw);
     if (token.empty()) continue;
     size_t eq = token.find('=');
     if (eq == std::string::npos) {
