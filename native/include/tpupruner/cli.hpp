@@ -50,9 +50,17 @@ struct Cli {
   std::string device = "tpu";             // --device {tpu, gpu}
   std::string accelerator_type;           // --accelerator-type pattern (device=tpu)
   std::optional<double> hbm_threshold;    // --hbm-threshold, HBM bw util 0-1
+  // --metric-schema {auto, gmp, gke-system}; parse() resolves "auto" →
+  // gke-system when --gcp-project is set (the Cloud Monitoring PromQL API
+  // is the only plane serving kubernetes_io:node_accelerator_* names),
+  // gmp otherwise — so this field is always concrete after parse().
+  std::string metric_schema = "auto";
   std::string tensorcore_metric;          // --tensorcore-metric override
   std::string duty_cycle_metric;          // --duty-cycle-metric override
   std::string hbm_metric;                 // --hbm-metric override
+  std::string join_metric;                // --join-metric override (gke-system)
+  // --join-resource (gke-system): KSM resource selector; "none" disables.
+  std::string join_resource;
   int64_t max_scale_per_cycle = 0;        // --max-scale-per-cycle (0 = unlimited)
   int64_t resolve_concurrency = 10;       // --resolve-concurrency (ref: fixed 10)
   int64_t resolve_batch_threshold = 8;    // --resolve-batch-threshold (0 = off)
@@ -63,6 +71,7 @@ struct Cli {
   std::string gcp_project;                // --gcp-project (Cloud Monitoring PromQL API)
   std::string monitoring_endpoint = "https://monitoring.googleapis.com";  // --monitoring-endpoint
   std::string notify_webhook;             // --notify-webhook (POST per pause; Slack-compatible)
+  bool print_query = false;               // --print-query: render the query and exit
   bool leader_elect = false;              // --leader-elect (HA; requires daemon mode)
   std::string lease_namespace;            // --lease-namespace (default: $POD_NAMESPACE or "tpu-pruner")
   std::string lease_name = "tpu-pruner";  // --lease-name
@@ -79,6 +88,13 @@ std::string usage();
 
 query::QueryArgs to_query_args(const Cli& cli);
 log::Format log_format_of(const Cli& cli);
+
+// The concrete metric schema ("gmp" | "gke-system") for a Cli whose
+// metric_schema may still read "auto" (hand-built values; parse() output
+// is always concrete). Single point of truth — the daemon's decoder and
+// to_query_args both resolve through here so query build and decode can
+// never disagree.
+std::string resolved_schema(const Cli& cli);
 
 // Effective PromQL base URL: --prometheus-url verbatim, or (GKE-native)
 // the Cloud Monitoring PromQL API for --gcp-project —

@@ -24,10 +24,17 @@ struct DecodeResult {
 // Tolerates both native and exported_* label names (lib.rs:161-175).
 // device == "gpu" requires the DCGM modelName label (hard error per series,
 // lib.rs:180-183); device == "tpu" reads accelerator_type/node_type labels
-// with "unknown" fallbacks (GKE label enrichment may be disabled).
+// with a `model` fallback (the gke-system accelerator series' metric
+// label) before "unknown" (GKE label enrichment may be disabled).
+// schema == "gke-system" additionally tolerates a missing container label
+// ("unknown"): rows are node-keyed there and the container name only
+// arrives via the KSM join, which a kube_pod_info-style --join-metric
+// override doesn't carry. Under "gmp" a missing container stays a hard
+// per-series error, as in the reference.
 // Throws std::runtime_error when the response is not a success/vector
 // payload (the reference panics via into_vector().expect, main.rs:405-409 —
 // here it is a typed error feeding the daemon's failure budget).
-DecodeResult decode_instant_vector(const json::Value& response, const std::string& device);
+DecodeResult decode_instant_vector(const json::Value& response, const std::string& device,
+                                   const std::string& schema = "gmp");
 
 }  // namespace tpupruner::metrics

@@ -26,6 +26,29 @@
 // `cloud.google.com/gke-tpu-accelerator` into `node_type` (the analog of the
 // node_dmi_info product_name join). Metric names are overridable because GMP
 // relabeling differs across clusters.
+//
+// Two TPU schemas (metric_schema):
+//   - "gmp": pod-labeled series, the shape a self-managed exporter or a
+//     relabeling GMP pipeline produces (the profile above).
+//   - "gke-system": the stock GKE system-metric schema as served by the
+//     Cloud Monitoring PromQL API. TPU utilization surfaces there as
+//     `kubernetes_io:node_accelerator_tensorcore_utilization` /
+//     `…_duty_cycle` / `…_memory_bandwidth_utilization` on the k8s_node
+//     monitored resource — node-scoped labels (node_name, accelerator_id,
+//     make, model), NO pod/namespace/container labels. Pod attribution is
+//     a `* on (node_name) group_left(pod, namespace, container)` join
+//     against kube-state-metrics' `kube_pod_container_resource_requests`
+//     restricted to `resource="google_com_tpu"`, leaning on GKE's
+//     exclusive TPU-node scheduling (google.com/tpu is allocated
+//     whole-node, so at most one TPU-requesting pod per node; the join
+//     metric's resource selector is what enforces the one-to-one match —
+//     non-TPU sidecar pods on the node never enter the join). The
+//     accelerator-type filter matches the `model` metric label; namespace
+//     filters apply on the join side (the node series carry none).
+//     honor_labels keeps its meaning on the join: GMP-managed KSM collides
+//     the `namespace` metric label with the prometheus_target resource
+//     label, so stock GMP serves it as `exported_namespace` (default);
+//     honor-labels pipelines keep the bare name.
 #pragma once
 
 #include <optional>
@@ -50,10 +73,25 @@ struct QueryArgs {
 
   bool honor_labels = false;
 
+  // TPU query schema: "gmp" (pod-labeled series) or "gke-system" (stock
+  // GKE node-scoped system metrics + pod-attribution join). The CLI's
+  // "auto" resolves before this struct is built (cli::to_query_args).
+  std::string metric_schema = "gmp";
+
   // TPU metric-name overrides (GMP export names vary by cluster config).
+  // Under metric_schema=="gke-system" these defaults are remapped to the
+  // Cloud Monitoring PromQL forms (kubernetes_io:node_accelerator_*)
+  // unless explicitly overridden.
   std::string tensorcore_metric = "tensorcore_utilization";
   std::string duty_cycle_metric = "tensorcore_duty_cycle";
   std::string hbm_metric = "hbm_memory_bandwidth_utilization";
+
+  // gke-system pod-attribution join (kube-state-metrics). join_resource
+  // selects TPU-requesting containers; empty disables the resource
+  // selector — the override metric must then itself be limited to one
+  // pod per node, or group_left fails many-to-many (docs/OPERATIONS.md).
+  std::string join_metric = "kube_pod_container_resource_requests";
+  std::string join_resource = "google_com_tpu";
 };
 
 // Build the instant-query PromQL for the configured source.

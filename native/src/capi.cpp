@@ -88,6 +88,12 @@ tpupruner::query::QueryArgs query_args_from_json(const Value& v) {
   if (const Value* x = v.find("hbm_threshold"); x && x->is_number())
     a.hbm_threshold = x->as_double();
   if (const Value* x = v.find("honor_labels"); x && x->is_bool()) a.honor_labels = x->as_bool();
+  if (const Value* x = v.find("metric_schema"); x && x->is_string())
+    a.metric_schema = x->as_string();
+  if (const Value* x = v.find("join_metric"); x && x->is_string())
+    a.join_metric = x->as_string();
+  if (const Value* x = v.find("join_resource"); x && x->is_string())
+    a.join_resource = x->as_string();
   if (const Value* x = v.find("tensorcore_metric"); x && x->is_string())
     a.tensorcore_metric = x->as_string();
   if (const Value* x = v.find("duty_cycle_metric"); x && x->is_string())
@@ -138,7 +144,8 @@ char* tp_decode_samples(const char* payload_json) {
     const Value* response = payload.find("response");
     if (!response) throw std::runtime_error("missing response");
     std::string device = checked_device(payload.get_string("device", "tpu"));
-    auto result = tpupruner::metrics::decode_instant_vector(*response, device);
+    std::string schema = payload.get_string("schema", "gmp");
+    auto result = tpupruner::metrics::decode_instant_vector(*response, device, schema);
 
     Value samples = Value::array();
     for (const auto& s : result.samples) {

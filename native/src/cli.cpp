@@ -83,10 +83,24 @@ FLAGS:
 TPU FLAGS:
       --device <D>              tpu | gpu [default: tpu]
       --accelerator-type <RE>   TPU accelerator filter, e.g. "tpu-v5-lite-podslice"
+                                (matches the `model` label under gke-system)
       --hbm-threshold <F>       HBM bandwidth-util corroboration, 0-1 (e.g. 0.05)
+      --metric-schema <S>       auto | gmp | gke-system [default: auto]
+                                gmp: pod-labeled series (self-managed exporter)
+                                gke-system: stock GKE node-scoped system
+                                metrics (kubernetes_io:node_accelerator_*)
+                                with a kube_pod_container_resource_requests
+                                on(node_name) join for pod attribution;
+                                auto: gke-system when --gcp-project is set
       --tensorcore-metric <N>   override primary utilization metric name
       --duty-cycle-metric <N>   override duty-cycle fallback metric name
       --hbm-metric <N>          override HBM bandwidth metric name
+      --join-metric <N>         gke-system pod-attribution join metric
+                                [default: kube_pod_container_resource_requests]
+      --join-resource <R>       resource selector on the join metric
+                                [default: google_com_tpu]; "none" disables —
+                                the join metric must then itself be limited
+                                to one pod per node (see OPERATIONS.md)
       --resolve-concurrency <N> concurrent pod resolutions [default: 10]
       --resolve-batch-threshold <N>
                                 when more than N pods (or owners) of one
@@ -108,6 +122,8 @@ TPU FLAGS:
                                 auth via Workload Identity / ADC)
       --monitoring-endpoint <U> Cloud Monitoring API base
                                 [default: https://monitoring.googleapis.com]
+      --print-query             print the rendered idle query and exit
+                                (sanity-check selectors before daemonizing)
       --notify-webhook <URL>    POST a Slack-compatible JSON message per pause
                                 (the operator notification the reference README
                                 lists as future work; failure is log-only)
@@ -162,6 +178,13 @@ Cli parse(int argc, char** argv) {
          cli.device = v;
        }},
       {"--accelerator-type", [&](const std::string& v) { cli.accelerator_type = v; }},
+      {"--metric-schema",
+       [&](const std::string& v) {
+         check_choice("--metric-schema", v, {"auto", "gmp", "gke-system"});
+         cli.metric_schema = v;
+       }},
+      {"--join-metric", [&](const std::string& v) { cli.join_metric = v; }},
+      {"--join-resource", [&](const std::string& v) { cli.join_resource = v; }},
       {"--hbm-threshold",
        [&](const std::string& v) { cli.hbm_threshold = parse_double("--hbm-threshold", v); }},
       {"--tensorcore-metric", [&](const std::string& v) { cli.tensorcore_metric = v; }},
@@ -235,6 +258,10 @@ Cli parse(int argc, char** argv) {
       cli.leader_elect = true;
       continue;
     }
+    if (arg == "--print-query") {
+      cli.print_query = true;
+      continue;
+    }
     // --flag=value form
     std::string value;
     bool has_inline = false;
@@ -262,6 +289,13 @@ Cli parse(int argc, char** argv) {
   if (!cli.prometheus_url.empty() && !cli.gcp_project.empty()) {
     throw CliError("--prometheus-url and --gcp-project are mutually exclusive");
   }
+  cli.metric_schema = resolved_schema(cli);
+  if (cli.metric_schema == "gke-system" && cli.device != "tpu") {
+    // only reachable with an EXPLICIT gke-system choice: auto resolves
+    // per-device, so `--gcp-project --device gpu` (the DCGM profile over
+    // the Cloud Monitoring PromQL API) keeps working.
+    throw CliError("--metric-schema=gke-system requires --device=tpu");
+  }
   if (cli.duration < 1) throw CliError("--duration must be >= 1 minute");
   if (cli.check_interval < 1) throw CliError("--check-interval must be >= 1 second");
   if (cli.grace_period < 0) throw CliError("--grace-period must be >= 0");
@@ -275,6 +309,13 @@ Cli parse(int argc, char** argv) {
   return cli;
 }
 
+std::string resolved_schema(const Cli& cli) {
+  if (cli.metric_schema != "auto") return cli.metric_schema;
+  // auto is per-device: the gke-system schema only describes TPU series,
+  // and only the Cloud Monitoring PromQL API serves its metric names.
+  return (!cli.gcp_project.empty() && cli.device == "tpu") ? "gke-system" : "gmp";
+}
+
 query::QueryArgs to_query_args(const Cli& cli) {
   query::QueryArgs a;
   a.device = cli.device;
@@ -286,9 +327,14 @@ query::QueryArgs to_query_args(const Cli& cli) {
   a.power_threshold = cli.power_threshold;
   a.hbm_threshold = cli.hbm_threshold;
   a.honor_labels = cli.honor_labels;
+  a.metric_schema = resolved_schema(cli);
   if (!cli.tensorcore_metric.empty()) a.tensorcore_metric = cli.tensorcore_metric;
   if (!cli.duty_cycle_metric.empty()) a.duty_cycle_metric = cli.duty_cycle_metric;
   if (!cli.hbm_metric.empty()) a.hbm_metric = cli.hbm_metric;
+  if (!cli.join_metric.empty()) a.join_metric = cli.join_metric;
+  if (!cli.join_resource.empty()) {
+    a.join_resource = cli.join_resource == "none" ? "" : cli.join_resource;
+  }
   return a;
 }
 

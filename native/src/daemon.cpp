@@ -338,7 +338,8 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
     return with_span(span, [&] { return prom_client.instant_query(query); });
   }();
 
-  metrics::DecodeResult decoded = metrics::decode_instant_vector(response, args.device);
+  metrics::DecodeResult decoded =
+      metrics::decode_instant_vector(response, args.device, cli::resolved_schema(args));
   for (const std::string& err : decoded.errors) {
     log::error("daemon", "Failed to unwrap pod fields: " + err);
   }

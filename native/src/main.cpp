@@ -12,6 +12,7 @@
 #include "tpupruner/cli.hpp"
 #include "tpupruner/daemon.hpp"
 #include "tpupruner/log.hpp"
+#include "tpupruner/query.hpp"
 
 int main(int argc, char** argv) {
   using namespace tpupruner;
@@ -52,6 +53,16 @@ int main(int argc, char** argv) {
   } catch (const cli::CliError& e) {
     std::fprintf(stderr, "%s\n", e.what());
     return 2;
+  }
+
+  if (args.print_query) {
+    try {
+      std::fprintf(stdout, "%s\n", query::build_idle_query(cli::to_query_args(args)).c_str());
+      return 0;
+    } catch (const std::exception& e) {
+      std::fprintf(stderr, "%s\n", e.what());
+      return 2;
+    }
   }
 
   log::init(cli::log_format_of(args));

@@ -19,7 +19,13 @@ const std::string* label(const json::Value& metric, const std::string& exported,
 
 }  // namespace
 
-DecodeResult decode_instant_vector(const json::Value& response, const std::string& device) {
+DecodeResult decode_instant_vector(const json::Value& response, const std::string& device,
+                                   const std::string& schema) {
+  if (schema != "gmp" && schema != "gke-system") {
+    // Same strictness as build_idle_query: a typo'd schema must not
+    // silently decode with gmp semantics.
+    throw std::runtime_error("unknown metric schema: " + schema + " (expected gmp|gke-system)");
+  }
   const json::Value* status = response.find("status");
   if (!status || !status->is_string() || status->as_string() != "success") {
     std::string err = response.get_string("error", "unknown error");
@@ -57,7 +63,7 @@ DecodeResult decode_instant_vector(const json::Value& response, const std::strin
       continue;
     }
     const std::string* container = label(*metric, "exported_container", "container");
-    if (!container) {
+    if (!container && schema != "gke-system") {
       out.errors.push_back("the data for key `exported_container/container` is not available");
       continue;
     }
@@ -65,8 +71,9 @@ DecodeResult decode_instant_vector(const json::Value& response, const std::strin
     core::PodMetricSample sample;
     sample.name = *pod;
     sample.ns = *ns;
-    sample.container = *container;
-    sample.node_type = metric->get_string("node_type", "unknown");
+    sample.container = container ? *container : "unknown";
+    // gke-system rows carry the accelerator model but no node_type label.
+    sample.node_type = metric->get_string("node_type", metric->get_string("model", "unknown"));
 
     if (device == "gpu") {
       const json::Value* model = metric->find("modelName");
@@ -77,7 +84,9 @@ DecodeResult decode_instant_vector(const json::Value& response, const std::strin
       sample.accelerator = model->as_string();
     } else {
       // GKE TPU label enrichment is optional; never reject a series for it.
-      sample.accelerator = metric->get_string("accelerator_type", "unknown");
+      // gke-system series name the accelerator in `model` instead.
+      sample.accelerator =
+          metric->get_string("accelerator_type", metric->get_string("model", "unknown"));
     }
 
     // value: [<unix ts>, "<string float>"]

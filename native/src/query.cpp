@@ -104,6 +104,86 @@ std::string build_tpu_query(const QueryArgs& a) {
   return assemble(idle_block, group_labels, enrich_join, unless_clause);
 }
 
+// Stock-GKE system-metric schema (Cloud Monitoring PromQL API). The
+// de-facto contract this builder encodes, pinned by the gke-system tier of
+// tests/test_query_template.py the way main.rs:572-740 pins the DCGM shape:
+//   - node-scoped accelerator series (k8s_node monitored resource):
+//     kubernetes_io:node_accelerator_tensorcore_utilization (0-1, v4+)
+//     `or` kubernetes_io:node_accelerator_duty_cycle (percent, all gens)
+//     / 100, peak over the lookback window, per (node_name,
+//     accelerator_id, model);
+//   - pod attribution via `* on (node_name) group_left(pod, <ns>,
+//     container)` against the KSM requests metric filtered to
+//     resource="google_com_tpu" (its `node` label is lifted into
+//     node_name to align the join keys). GKE schedules TPU-requesting
+//     pods exclusively on their slice's nodes, so the match is 1:1; a
+//     second TPU-requesting pod on one node would be a many-to-many
+//     execution error, surfaced loudly by Prometheus rather than
+//     silently misattributed.
+//   - == 0 idle predicate AFTER the join: only pod-attributed chips are
+//     candidates (an idle node with no TPU pod has nothing to prune);
+//   - `unless on (node_name)` HBM-bandwidth corroboration: any chip on
+//     the node moving HBM traffic rescues the whole node's pod.
+// The join side multiplies utilization by the requested chip count —
+// harmless under == 0 (only exact zeros survive the filter).
+std::string build_tpu_gke_system_query(const QueryArgs& a) {
+  Labels l(a.honor_labels);
+  // Remap bare GMP default names to the Cloud Monitoring forms; explicit
+  // overrides pass through (the gke-system schema has no bare names, so
+  // an untouched default would return zero rows on a stock cluster).
+  auto effective = [](const std::string& configured, const char* gmp_default,
+                     const char* gke_name) {
+    return configured == gmp_default ? std::string(gke_name) : configured;
+  };
+  std::string tensorcore =
+      effective(a.tensorcore_metric, "tensorcore_utilization",
+                "kubernetes_io:node_accelerator_tensorcore_utilization");
+  std::string duty = effective(a.duty_cycle_metric, "tensorcore_duty_cycle",
+                               "kubernetes_io:node_accelerator_duty_cycle");
+  std::string hbm = effective(a.hbm_metric, "hbm_memory_bandwidth_utilization",
+                              "kubernetes_io:node_accelerator_memory_bandwidth_utilization");
+
+  // Accelerator-series selector: model filter only (node-scoped series
+  // carry no pod/namespace labels to filter on).
+  std::string accel_sel;
+  if (!a.accelerator_regex.empty()) {
+    accel_sel = "{model =~ \"" + promql_string_escape(a.accelerator_regex) + "\"}";
+  }
+
+  // Join-side selector: TPU-resource restriction + the namespace filters.
+  std::string join_sel = "{";
+  bool first = true;
+  auto add = [&](const std::string& clause) {
+    if (!first) join_sel += ", ";
+    join_sel += clause;
+    first = false;
+  };
+  if (!a.join_resource.empty())
+    add("resource = \"" + promql_string_escape(a.join_resource) + "\"");
+  if (!a.namespace_regex.empty())
+    add(l.ns + " =~ \"" + promql_string_escape(a.namespace_regex) + "\"");
+  if (!a.namespace_exclude_regex.empty())
+    add(l.ns + " !~ \"" + promql_string_escape(a.namespace_exclude_regex) + "\"");
+  join_sel += "}";
+  if (join_sel == "{}") join_sel.clear();
+
+  std::string idle_block = "sum by (node_name, accelerator_id, model) (\n    max_over_time(" +
+                           tensorcore + accel_sel + window(a) + ")\n    or\n    max_over_time(" +
+                           duty + accel_sel + window(a) + ") / 100\n)";
+
+  std::string join = "* on (node_name) group_left (pod, " + l.ns +
+                     ", container)\n  max by (node_name, pod, " + l.ns +
+                     ", container) (\n    label_replace(\n      " + a.join_metric + join_sel +
+                     ",\n      \"node_name\", \"$1\", \"node\", \"(.+)\"\n    )\n  )";
+
+  std::string q = "(\n  " + idle_block + "\n  " + join + "\n)\n== 0";
+  if (threshold_set(a.hbm_threshold)) {
+    q += "\nunless on (node_name)\n(\n  max_over_time(" + hbm + accel_sel + window(a) +
+         ") >= " + fmt_threshold(*a.hbm_threshold) + "\n)";
+  }
+  return q;
+}
+
 std::string build_gpu_query(const QueryArgs& a) {
   Labels l(a.honor_labels);
   std::string group_labels =
@@ -137,8 +217,22 @@ std::string build_gpu_query(const QueryArgs& a) {
 }  // namespace
 
 std::string build_idle_query(const QueryArgs& args) {
-  if (args.device == "gpu") return build_gpu_query(args);
-  if (args.device == "tpu") return build_tpu_query(args);
+  if (args.metric_schema != "gmp" && args.metric_schema != "gke-system") {
+    throw std::invalid_argument("unknown metric schema: " + args.metric_schema +
+                                " (expected gmp|gke-system)");
+  }
+  if (args.device == "gpu") {
+    if (args.metric_schema == "gke-system") {
+      // The node_accelerator metrics do cover GPUs, but the DCGM profile is
+      // the reference-parity path; refuse rather than emit a half-schema.
+      throw std::invalid_argument("--metric-schema=gke-system requires --device=tpu");
+    }
+    return build_gpu_query(args);
+  }
+  if (args.device == "tpu") {
+    return args.metric_schema == "gke-system" ? build_tpu_gke_system_query(args)
+                                              : build_tpu_query(args);
+  }
   throw std::invalid_argument("unknown device: " + args.device + " (expected tpu|gpu)");
 }
 

@@ -54,6 +54,37 @@ TP_TEST(cli_monitoring_endpoint_override) {
               "http://127.0.0.1:9/v1/projects/p1/location/global/prometheus");
 }
 
+TP_TEST(cli_metric_schema_auto_resolution) {
+  // auto → gke-system under --gcp-project (the Cloud Monitoring PromQL API
+  // is the only plane serving kubernetes_io:node_accelerator_* names),
+  // gmp for a plain Prometheus URL; explicit choices always win.
+  TP_CHECK_EQ(parse({"--prometheus-url", "http://p"}).metric_schema, "gmp");
+  TP_CHECK_EQ(parse({"--gcp-project", "p1"}).metric_schema, "gke-system");
+  TP_CHECK_EQ(parse({"--gcp-project", "p1", "--metric-schema", "gmp"}).metric_schema, "gmp");
+  TP_CHECK_EQ(parse({"--prometheus-url", "http://p", "--metric-schema", "gke-system"})
+                  .metric_schema,
+              "gke-system");
+  TP_CHECK(parse_fails({"--prometheus-url", "http://p", "--metric-schema", "bogus"},
+                       "invalid value for --metric-schema"));
+  // auto is per-device: the pre-existing `--gcp-project --device gpu`
+  // invocation (DCGM profile over the Cloud Monitoring PromQL API) must
+  // keep working — auto resolves it to gmp, never to an error.
+  TP_CHECK_EQ(parse({"--gcp-project", "p1", "--device", "gpu"}).metric_schema, "gmp");
+  // only an EXPLICIT gke-system choice conflicts with device=gpu
+  TP_CHECK(parse_fails({"--gcp-project", "p1", "--device", "gpu",
+                        "--metric-schema", "gke-system"},
+                       "--metric-schema=gke-system requires --device=tpu"));
+}
+
+TP_TEST(cli_join_flags_reach_query_args) {
+  Cli cli = parse({"--gcp-project", "p1", "--join-metric", "kube_pod_info",
+                   "--join-resource", "none"});
+  auto a = tpupruner::cli::to_query_args(cli);
+  TP_CHECK_EQ(a.metric_schema, "gke-system");
+  TP_CHECK_EQ(a.join_metric, "kube_pod_info");
+  TP_CHECK_EQ(a.join_resource, "");  // "none" disables the resource selector
+}
+
 TP_TEST(cli_metrics_port_semantics) {
   // unset and "0" both mean disabled (an operator's explicit 0 must not
   // start binding random ports); "auto" = ephemeral; else the port.

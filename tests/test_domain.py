@@ -394,6 +394,72 @@ def test_decode_gpu_reads_model_name(built):
     assert r["samples"][0]["accelerator"] == "NVIDIA A100"
 
 
+def test_decode_gke_system_node_keyed_row(built):
+    """gke-system rows: node_name/accelerator_id/model from the node series,
+    pod/exported_namespace/container carried in by the KSM join."""
+    r = native.decode_samples(
+        vector_response(
+            [
+                series(
+                    {
+                        "node_name": "gke-tpu-node-0",
+                        "accelerator_id": "0",
+                        "model": "tpu-v5-lite-podslice",
+                        "pod": "trainer-0",
+                        "exported_namespace": "ml",
+                        "container": "main",
+                    }
+                )
+            ]
+        ),
+        schema="gke-system",
+    )
+    s = r["samples"][0]
+    assert s["name"] == "trainer-0"
+    assert s["namespace"] == "ml"
+    assert s["container"] == "main"
+    # accelerator/node_type fall back to the gke-system `model` label
+    assert s["accelerator"] == "tpu-v5-lite-podslice"
+    assert s["node_type"] == "tpu-v5-lite-podslice"
+
+
+def test_decode_gke_system_tolerates_missing_container(built):
+    """A kube_pod_info-style --join-metric override carries no container
+    label; gke-system decodes it as unknown instead of erroring."""
+    r = native.decode_samples(
+        vector_response([series({"pod": "p", "namespace": "n", "node_name": "no-container"})]),
+        schema="gke-system",
+    )
+    assert r["errors"] == []
+    assert r["samples"][0]["container"] == "unknown"
+
+
+def test_decode_gmp_still_requires_container(built):
+    # under the default schema a missing container stays a hard per-series
+    # error, as in the reference (lib.rs:161-175)
+    r = native.decode_samples(vector_response([series({"pod": "p", "namespace": "n"})]))
+    assert r["samples"] == []
+    assert "container" in r["errors"][0]
+
+
+def test_decode_gke_system_dedups_multichip_nodes(built):
+    labels = {"pod": "p", "exported_namespace": "n", "container": "c", "node_name": "nd"}
+    r = native.decode_samples(
+        vector_response(
+            [series({**labels, "accelerator_id": str(i), "model": "tpu-v5p-slice"}) for i in range(4)]
+        ),
+        schema="gke-system",
+    )
+    assert r["num_series"] == 4
+    assert len(r["samples"]) == 1
+
+
+def test_decode_unknown_schema_rejected(built):
+    # a typo'd schema must not silently decode with gmp semantics
+    with pytest.raises(ValueError, match="unknown metric schema"):
+        native.decode_samples(vector_response([]), schema="gke_system")
+
+
 def test_decode_error_response_raises(built):
     with pytest.raises(ValueError, match="prometheus query failed"):
         native.decode_samples({"status": "error", "error": "boom"})

@@ -344,6 +344,57 @@ def test_gcp_project_routes_to_cloud_monitoring_promql_api(built, fake_prom, fak
         "replicas"] == 0
 
 
+def test_gcp_project_defaults_to_gke_system_schema_end_to_end(built, fake_prom, fake_k8s):
+    """The flagship stock-GKE path: --gcp-project resolves the gke-system
+    schema, sends the kubernetes_io:node_accelerator_* query with the
+    on(node_name) pod-attribution join to the Cloud Monitoring PromQL API,
+    decodes the node-keyed rows it returns, and lands the patch."""
+    dep, rs, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=2)
+    for i, pod in enumerate(pods):
+        fake_prom.add_idle_node_series(
+            pod["metadata"]["name"], "ml", node=f"gke-tpu-node-{i}", chips=4)
+
+    cmd = [
+        str(DAEMON_PATH),
+        "--gcp-project", "ml-prod",
+        "--monitoring-endpoint", fake_prom.url,
+        "--accelerator-type", "tpu-v5-lite-podslice",
+        "--hbm-threshold", "0.05",
+        "--run-mode", "scale-down",
+    ]
+    env = {"KUBE_API_URL": fake_k8s.url, "PROMETHEUS_TOKEN": "adc-token",
+           "PATH": "/usr/bin:/bin"}
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=60, env=env)
+    assert proc.returncode == 0, proc.stderr
+
+    # the query is the stock-GKE shape, not the bare GMP names
+    assert len(fake_prom.queries) == 1
+    q = fake_prom.queries[0]
+    assert "kubernetes_io:node_accelerator_tensorcore_utilization" in q
+    assert "kubernetes_io:node_accelerator_duty_cycle" in q
+    assert "kubernetes_io:node_accelerator_memory_bandwidth_utilization" in q
+    assert 'kube_pod_container_resource_requests{resource = "google_com_tpu"' in q
+    assert "* on (node_name) group_left" in q
+
+    # 8 node-keyed chip rows → 2 unique pods → 1 deduped deployment patch
+    assert len(fake_k8s.scale_patches()) == 1
+    assert fake_k8s.objects["/apis/apps/v1/namespaces/ml/deployments/trainer"]["spec"][
+        "replicas"] == 0
+
+
+def test_print_query_renders_and_exits(built):
+    """--print-query is the operator's sanity-check seam: render the exact
+    query (no daemon, no cluster access) and exit 0."""
+    proc = subprocess.run(
+        [str(DAEMON_PATH), "--gcp-project", "p", "--namespace", "ml-.*", "--print-query"],
+        capture_output=True, text=True, timeout=60, env={"PATH": "/usr/bin:/bin"})
+    assert proc.returncode == 0, proc.stderr
+    assert "kubernetes_io:node_accelerator_tensorcore_utilization" in proc.stdout
+    assert 'exported_namespace =~ "ml-.*"' in proc.stdout
+    # no stray logging pollutes the output (it must be pipeable to querytest)
+    assert proc.stdout.strip().startswith("(")
+
+
 def test_prometheus_url_and_gcp_project_are_mutually_exclusive(built, fake_prom, fake_k8s):
     proc = subprocess.run(
         [str(DAEMON_PATH), "--prometheus-url", fake_prom.url, "--gcp-project", "p"],

@@ -203,6 +203,128 @@ def test_tpu_query_metric_name_overrides(built):
     assert "tensorcore_duty_cycle{" not in query
 
 
+# ── TPU source, gke-system schema: the stock-GKE Cloud Monitoring contract ──
+#
+# These tests pin the rendered query against the real GKE system-metric
+# schema (the way main.rs:572-740 pins the DCGM shape): node-scoped
+# kubernetes_io:node_accelerator_* series, pod attribution via an
+# on(node_name) join against kube-state-metrics' TPU resource requests.
+
+
+def gke(**kwargs):
+    return q(device="tpu", metric_schema="gke-system", **kwargs)
+
+
+def test_gke_system_uses_cloud_monitoring_metric_names(built):
+    query = gke(duration=30, hbm_threshold=0.05)
+    assert "kubernetes_io:node_accelerator_tensorcore_utilization" in query
+    assert "kubernetes_io:node_accelerator_duty_cycle" in query
+    assert "kubernetes_io:node_accelerator_memory_bandwidth_utilization" in query
+    # the bare GMP names would return zero rows on a stock cluster
+    assert "tensorcore_utilization{" not in query.replace(
+        "kubernetes_io:node_accelerator_tensorcore_utilization", "")
+    assert "max_over_time(" in query
+    assert "avg_over_time(" not in query
+
+
+def test_gke_system_idle_predicate_and_normalization(built):
+    query = gke(duration=30)
+    assert "== 0" in query
+    assert "/ 100" in query  # duty_cycle is a percent; utilization is 0-1
+
+
+def test_gke_system_pod_attribution_join(built):
+    query = gke(duration=30)
+    # node-keyed series join to TPU-requesting pods via KSM requests
+    assert 'kube_pod_container_resource_requests{resource = "google_com_tpu"}' in query
+    assert "* on (node_name) group_left (pod, exported_namespace, container)" in query
+    # KSM's `node` label is lifted to node_name to align the join keys
+    assert '"node_name", "$1", "node", "(.+)"' in query
+
+
+def test_gke_system_namespace_filter_applies_on_join_side_only(built):
+    # node-scoped accelerator series have no namespace label: the filter
+    # must appear exactly once, inside the join selector.
+    query = gke(duration=30, namespace="ml-.*")
+    assert query.count('exported_namespace =~ "ml-.*"') == 1
+    assert 'resource = "google_com_tpu", exported_namespace =~ "ml-.*"' in query
+
+
+def test_gke_system_namespace_exclude_on_join_side(built):
+    query = gke(duration=30, namespace="ml-.*", namespace_exclude="kube-.*")
+    assert query.count('exported_namespace =~ "ml-.*"') == 1
+    assert query.count('exported_namespace !~ "kube-.*"') == 1
+
+
+def test_gke_system_accelerator_filter_matches_model_label(built):
+    # 2 utilization selectors; +1 on the HBM corroboration selector
+    query = gke(duration=30, accelerator_type="tpu-v5p-slice")
+    assert query.count('model =~ "tpu-v5p-slice"') == 2
+    query = gke(duration=30, accelerator_type="tpu-v5p-slice", hbm_threshold=0.05)
+    assert query.count('model =~ "tpu-v5p-slice"') == 3
+
+
+def test_gke_system_hbm_corroboration_is_node_scoped(built):
+    query = gke(duration=30, hbm_threshold=0.05)
+    # any chip on the node moving HBM traffic rescues the node's pod
+    assert "unless on (node_name)" in query
+    assert ">= 0.05" in query
+    assert "unless" not in gke(duration=30)
+    assert "unless" not in gke(duration=30, hbm_threshold=0.0)
+
+
+def test_gke_system_honor_labels_switches_join_namespace_label(built):
+    # GMP-managed KSM collides the namespace metric label with the
+    # prometheus_target resource label → exported_namespace by default;
+    # honor-labels pipelines keep the bare name.
+    query = gke(duration=30, namespace="ml", honor_labels=True)
+    assert "exported_namespace" not in query
+    assert query.count('namespace =~ "ml"') == 1
+    assert "group_left (pod, namespace, container)" in query
+
+
+def test_gke_system_duration_is_interpolated(built):
+    assert "[45m]" in gke(duration=45)
+
+
+def test_gke_system_metric_name_overrides_pass_through(built):
+    query = gke(duration=30, tensorcore_metric="custom:tc_util")
+    assert "custom:tc_util" in query
+    assert "kubernetes_io:node_accelerator_tensorcore_utilization" not in query
+    assert "kubernetes_io:node_accelerator_duty_cycle" in query  # others still remapped
+
+
+def test_gke_system_join_overrides(built):
+    query = gke(duration=30, join_metric="kube_pod_info", join_resource="")
+    assert "kube_pod_info" in query
+    assert "kube_pod_container_resource_requests" not in query
+    assert "resource =" not in query  # empty join_resource drops the selector
+
+
+def test_gke_system_requires_tpu_device(built):
+    with pytest.raises(ValueError, match="requires --device=tpu"):
+        q(device="gpu", metric_schema="gke-system", duration=30)
+
+
+def test_unknown_metric_schema_rejected(built):
+    with pytest.raises(ValueError, match="unknown metric schema"):
+        q(device="tpu", metric_schema="stackdriver", duration=30)
+
+
+def test_gke_system_regex_filters_are_promql_escaped(built):
+    query = gke(duration=30, accelerator_type='tpu"v5')
+    assert r'model =~ "tpu\"v5"' in query
+    query = gke(duration=30, namespace=r"ml-\d+")
+    assert r'exported_namespace =~ "ml-\\d+"' in query
+
+
+def test_default_schema_is_gmp(built):
+    # without metric_schema the pod-labeled GMP profile renders unchanged
+    query = q(device="tpu", duration=30)
+    assert "kubernetes_io:" not in query
+    assert "kube_pod_container_resource_requests" not in query
+
+
 def test_default_device_is_tpu(built):
     query = q(duration=30)
     assert "tensorcore" in query

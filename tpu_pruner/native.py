@@ -119,9 +119,11 @@ def enabled_resources(flags: str) -> list[str]:
     return _call("tp_enabled_resources", flags)["kinds"]
 
 
-def decode_samples(prom_response: dict, device: str = "tpu") -> dict:
+def decode_samples(prom_response: dict, device: str = "tpu", schema: str = "gmp") -> dict:
     """Decode a Prometheus instant-query response into pod metric samples."""
-    return _call("tp_decode_samples", {"response": prom_response, "device": device})
+    return _call(
+        "tp_decode_samples", {"response": prom_response, "device": device, "schema": schema}
+    )
 
 
 def generate_event(target: dict, device: str = "tpu", now: int | None = None) -> dict:

@@ -69,6 +69,104 @@ def parse_label_selector(selector: str) -> list[tuple[str, set]]:
     return reqs
 
 
+# ── server-side structural-schema validation ────────────────────────────
+#
+# A real API server rejects patches that violate the target's schema:
+# built-in types via field validation, CRs via the CRD's structural schema
+# (the validation gpu-pruner's kind tier hits in tests/e2e.rs:256-333).
+# The merge-patch store alone would absorb a typo'd patch path
+# (spec.suspended, minReplica) that only a live cluster would catch —
+# these validators close that gap for the five patch shapes the daemon
+# emits. Unknown fields → 400 (fieldValidation=Strict / structural-schema
+# pruning); wrong types or out-of-range values → 422 reason=Invalid.
+
+
+class PatchInvalid(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def _check_allowed(obj: dict, allowed: set, where: str) -> None:
+    unknown = set(obj) - allowed
+    if unknown:
+        raise PatchInvalid(400, f"unknown field(s) in {where}: {sorted(unknown)}")
+
+
+def _check_metadata(meta) -> None:
+    if meta is None:
+        return
+    if not isinstance(meta, dict):
+        raise PatchInvalid(422, "metadata must be an object")
+    ann = meta.get("annotations")
+    if ann is not None:
+        if not isinstance(ann, dict):
+            raise PatchInvalid(422, "metadata.annotations must be an object")
+        for k, v in ann.items():
+            # deletion via merge-patch null is legal; values must be strings
+            if v is not None and not isinstance(v, str):
+                raise PatchInvalid(422, f"annotation {k!r} value must be a string")
+
+
+def _non_negative_int(value, where: str) -> None:
+    if not isinstance(value, int) or isinstance(value, bool) or value < 0:
+        raise PatchInvalid(422, f"{where} must be a non-negative integer")
+
+
+def validate_patch(path: str, body) -> None:
+    """Raise PatchInvalid if `body` violates the target's schema."""
+    if not isinstance(body, dict):
+        raise PatchInvalid(400, "patch body must be a JSON object")
+    if path.endswith("/scale"):
+        # autoscaling/v1 Scale: only spec.replicas is patchable
+        _check_allowed(body, {"apiVersion", "kind", "metadata", "spec"}, "Scale")
+        _check_metadata(body.get("metadata"))
+        spec = body.get("spec")
+        if spec is not None:
+            if not isinstance(spec, dict):
+                raise PatchInvalid(422, "Scale.spec must be an object")
+            _check_allowed(spec, {"replicas"}, "Scale.spec")
+            if "replicas" in spec:
+                _non_negative_int(spec["replicas"], "Scale.spec.replicas")
+        return
+    _check_allowed(body, {"apiVersion", "kind", "metadata", "spec", "status"}, "patch")
+    _check_metadata(body.get("metadata"))
+    spec = body.get("spec")
+    if spec is None:
+        return
+    if not isinstance(spec, dict):
+        raise PatchInvalid(422, "spec must be an object")
+    if "/jobsets/" in path:
+        _check_allowed(
+            spec, {"suspend", "replicatedJobs", "network", "successPolicy",
+                   "failurePolicy", "startupPolicy", "ttlSecondsAfterFinished"},
+            "JobSet.spec")
+        if "suspend" in spec and not isinstance(spec["suspend"], bool):
+            raise PatchInvalid(422, "JobSet.spec.suspend must be a boolean")
+    elif "/inferenceservices/" in path:
+        _check_allowed(spec, {"predictor", "transformer", "explainer"},
+                       "InferenceService.spec")
+        predictor = spec.get("predictor")
+        if predictor is not None:
+            if not isinstance(predictor, dict):
+                raise PatchInvalid(422, "spec.predictor must be an object")
+            _check_allowed(predictor, {"minReplicas", "maxReplicas", "scaleTarget",
+                                       "scaleMetric", "model", "containers"},
+                           "InferenceService.spec.predictor")
+            if "minReplicas" in predictor:
+                _non_negative_int(predictor["minReplicas"],
+                                  "spec.predictor.minReplicas")
+    elif "/notebooks/" in path:
+        # the pause shape is metadata-only (kubeflow-resource-stopped
+        # annotation); spec.template is the only structural spec field
+        _check_allowed(spec, {"template"}, "Notebook.spec")
+    elif "/leaderworkersets/" in path:
+        _check_allowed(spec, {"replicas", "leaderWorkerTemplate", "startupPolicy",
+                              "rolloutStrategy"}, "LeaderWorkerSet.spec")
+        if "replicas" in spec:
+            _non_negative_int(spec["replicas"], "LeaderWorkerSet.spec.replicas")
+
+
 def rfc3339(dt: datetime) -> str:
     return dt.astimezone(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
@@ -87,6 +185,11 @@ class FakeK8s:
         self.patch_times: list[float] = []  # time.monotonic() per patch (latency benches)
         self.requests: list[tuple[str, str]] = []  # (method, path)
         self.outage = False  # True → every request 503s (apiserver outage)
+        # Server-side structural-schema validation (see validate_patch).
+        # ON by default so every hermetic test proves the daemon's patches
+        # survive a validating API server; tests may disable it to model
+        # a permissive aggregated apiserver.
+        self.strict_validation = True
         # targeted fault injection: (method or "*", exact path) → [code, n]
         # where n is the remaining failure count (-1 = fail forever)
         self.fail_rules: dict[tuple[str, str], list] = {}
@@ -406,6 +509,15 @@ class FakeK8s:
                     if obj is None:
                         self._not_found()
                         return
+                    if fake.strict_validation:
+                        try:
+                            validate_patch(path, body)
+                        except PatchInvalid as e:
+                            self._respond(e.code, {
+                                "kind": "Status", "status": "Failure",
+                                "reason": "Invalid" if e.code == 422 else "BadRequest",
+                                "code": e.code, "message": str(e)})
+                            return
                     # resourceVersion precondition (optimistic concurrency,
                     # as the real API server: mismatch → 409 Conflict)
                     want_rv = (body.get("metadata") or {}).get("resourceVersion")

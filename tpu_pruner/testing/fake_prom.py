@@ -57,6 +57,37 @@ class FakePrometheus:
             self.series.append({"metric": labels, "value": [time.time(), str(value)]})
         self._version += 1
 
+    def add_idle_node_series(
+        self,
+        pod: str,
+        namespace: str,
+        node: str,
+        container: str = "main",
+        value: float = 0.0,
+        model: str = "tpu-v5-lite-podslice",
+        chips: int = 1,
+        honor_labels: bool = False,
+    ) -> None:
+        """gke-system shaped rows: what the Cloud Monitoring PromQL API
+        returns for the kubernetes_io:node_accelerator_* query after the
+        on(node_name) KSM join — node-scoped accelerator labels plus the
+        joined pod/namespace/container (namespace surfaces as
+        exported_namespace under stock GMP-managed KSM)."""
+        ns_label = "namespace" if honor_labels else "exported_namespace"
+        for chip in range(chips):
+            self.series.append({
+                "metric": {
+                    "node_name": node,
+                    "accelerator_id": str(chip),
+                    "model": model,
+                    "pod": pod,
+                    ns_label: namespace,
+                    "container": container,
+                },
+                "value": [time.time(), str(value)],
+            })
+        self._version += 1
+
     # ── lifecycle ──
     def start(self, certfile: str | None = None, keyfile: str | None = None) -> int:
         fake = self
