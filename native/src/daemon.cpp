@@ -648,9 +648,16 @@ int run(const cli::Cli& args) {
     if (g_shutdown_signal) break;
     auto cycle_start = std::chrono::steady_clock::now();
     if (elector && !elector->is_leader()) {
-      // Standby: no cycles, no failure-budget ticks — just wait out the
-      // interval (interruptibly) and re-check leadership. Ticking counts as
-      // liveness: an idle standby is healthy, not stalled.
+      // Standby: no cycles, no failure-budget ticks. The 1 s re-check is
+      // deliberately NOT scaled to the lease duration: is_leader() is an
+      // atomic read (zero API traffic — the elector's own thread does the
+      // Lease GETs, already at its leaseDuration/3 cadence, asserted by
+      // tests/test_leader.py::test_standby_lease_get_rate_scales_with_
+      // lease_duration), and a longer wait here would only delay the
+      // first post-takeover cycle and starve the /healthz progress stamp
+      // below max(3*check_interval, 60) s staleness on long leases.
+      // Ticking counts as liveness: an idle standby is healthy, not
+      // stalled.
       last_progress->store(util::mono_secs());
       while (!g_shutdown_signal &&
              std::chrono::steady_clock::now() - cycle_start < std::chrono::seconds(1)) {

@@ -103,9 +103,57 @@ json::Value Client::get(const std::string& path) const {
 }
 
 json::Value Client::list(const std::string& path, const std::string& label_selector) const {
-  std::string full = path;
-  if (!label_selector.empty()) full += "?labelSelector=" + util::url_encode(label_selector);
-  return request_json("GET", full, "", "", nullptr);
+  // Follow metadata.continue. Stock apiservers only paginate when the
+  // client sends `limit` (we never do), but an intermediary cache or
+  // aggregated apiserver may chunk anyway — ignoring the token would
+  // silently truncate batched resolution (e.g. a JobSet's all-idle gate
+  // deciding on half its worker pods).
+  std::string base_query;
+  if (!label_selector.empty()) base_query = "labelSelector=" + util::url_encode(label_selector);
+
+  json::Value out;
+  std::string continue_token;
+  constexpr int kMaxPages = 1000;  // runaway-server guard, not a size cap
+  for (int page = 0; page < kMaxPages; ++page) {
+    std::string query = base_query;
+    if (!continue_token.empty()) {
+      if (!query.empty()) query += "&";
+      query += "continue=" + util::url_encode(continue_token);
+    }
+    json::Value chunk =
+        request_json("GET", query.empty() ? path : path + "?" + query, "", "", nullptr);
+
+    std::string next;
+    if (const json::Value* c = chunk.at_path("metadata.continue"); c && c->is_string()) {
+      next = c->as_string();
+    }
+    if (page == 0) {
+      out = std::move(chunk);
+    } else if (const json::Value* items = chunk.find("items"); items && items->is_array()) {
+      const json::Value* out_items = out.find("items");
+      if (out_items && out_items->is_array()) {
+        json::Value& dst = out.as_object()["items"];
+        for (json::Value& item : chunk.as_object()["items"].as_array()) {
+          dst.push_back(std::move(item));
+        }
+      } else {
+        out.set("items", std::move(chunk.as_object()["items"]));
+      }
+    }
+    if (next.empty()) {
+      // drop the stale token so callers never see a half-consumed cursor
+      if (page > 0) {
+        const json::Value* meta = out.find("metadata");
+        if (meta && meta->is_object()) {
+          out.as_object()["metadata"].set("continue", json::Value(""));
+        }
+      }
+      return out;
+    }
+    continue_token = next;
+  }
+  throw std::runtime_error("k8s: LIST " + path + " did not terminate after " +
+                           std::to_string(kMaxPages) + " continue pages");
 }
 
 json::Value Client::patch_merge(const std::string& path, const json::Value& body) const {

@@ -154,6 +154,46 @@ def test_takeover_after_expired_lease(built, fake_prom, fake_k8s):
         stop(proc)
 
 
+def test_standby_lease_get_rate_scales_with_lease_duration(built, fake_prom, fake_k8s):
+    """VERDICT r2 #6: a standby's API traffic is one Lease GET per
+    leaseDuration/3 elector tick (and zero PATCHes) — a long-lease config
+    must not GET at a fixed 1 s cadence. This pins the ELECTOR thread's
+    cadence (leader.cpp renew loop), the only place a standby touches the
+    API; the daemon standby loop's own 1 s re-check is an atomic read
+    (see daemon.cpp) and deliberately stays short for takeover latency."""
+    from datetime import datetime, timezone
+
+    # plant a live lease held by an external replica with a long duration:
+    # the standby observes the record as live for the whole test window
+    fresh = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.000000Z")
+    fake_k8s.objects[LEASE_PATH] = {
+        "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+        "metadata": {"name": "tpu-pruner", "namespace": "tpu-pruner",
+                     "resourceVersion": "1"},
+        "spec": {"holderIdentity": "external-holder", "leaseDurationSeconds": 120,
+                 "renewTime": fresh, "leaseTransitions": 1},
+    }
+
+    # lease-duration 9 → elector tick every 3 s
+    proc = start_daemon(fake_prom, fake_k8s, "replica-b", "--lease-duration", "9")
+    try:
+        # wait for the first GET so process startup isn't in the window
+        assert wait_for(lambda: ("GET", LEASE_PATH) in fake_k8s.requests)
+        before = len(fake_k8s.requests)
+        time.sleep(7)  # window covers ~2 ticks at duration/3 = 3 s
+        window = fake_k8s.requests[before:]
+        gets = [r for r in window if r == ("GET", LEASE_PATH)]
+        patches = [r for r in window if r[0] == "PATCH" and r[1] == LEASE_PATH]
+        # ~7s / 3s-tick ≈ 2; a 1 s cadence would show ≥6
+        assert 1 <= len(gets) <= 4, f"standby Lease GETs in 7s: {len(gets)}"
+        assert not patches, "a standby must never write the lease"
+        assert fake_k8s.objects[LEASE_PATH]["spec"]["holderIdentity"] == "external-holder"
+        # and it ran no evaluation cycles
+        assert not fake_prom.queries
+    finally:
+        stop(proc)
+
+
 def test_leader_self_demotes_when_apiserver_unreachable(built, fake_prom, fake_k8s,
                                                         tmp_path):
     """A leader that can't renew for a full lease duration must demote

@@ -174,3 +174,57 @@ def test_dead_proxy_fails_the_query(fake_prom, fake_k8s, built):
     proc = run_daemon(localhost_url(fake_prom), fake_k8s,
                       {"HTTP_PROXY": "http://127.0.0.1:1", "NO_PROXY": "127.0.0.1"})
     assert proc.returncode == 1
+
+
+def test_proxy_cloud_monitoring_and_metadata_auth_compose(built, fake_prom, fake_k8s,
+                                                          fake_proxy):
+    """VERDICT r2 #7: the three features compose — egress proxy (HTTP_PROXY
+    with NO_PROXY bypass), --gcp-project → Cloud Monitoring PromQL API
+    (the gke-system query), and Workload-Identity auth minted by the GCE
+    metadata server. Metric-plane traffic rides the proxy; the K8s API and
+    the metadata server stay direct (NO_PROXY), exactly the stock-GKE
+    egress topology. The pipeline must still land the patch."""
+    from tests.test_querytest_auth import FakeMetadataServer
+
+    md = FakeMetadataServer()
+    md.start()
+    try:
+        dep, rs, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+        fake_prom.add_idle_node_series(pods[0]["metadata"]["name"], "ml",
+                                       node="gke-tpu-0", chips=4)
+
+        cm_base = localhost_url(fake_prom)  # "localhost" routes via proxy
+        cmd = [str(DAEMON_PATH), "--gcp-project", "ml-prod",
+               "--monitoring-endpoint", cm_base, "--run-mode", "scale-down"]
+        env = {
+            "KUBE_API_URL": fake_k8s.url,            # 127.0.0.1 → direct
+            "HTTP_PROXY": fake_proxy.url,
+            "NO_PROXY": "127.0.0.1",                 # k8s + metadata bypass
+            "GCE_METADATA_HOST": md.hostport,        # 127.0.0.1:<port>
+            "TPU_PRUNER_DISABLE_GCLOUD": "1",
+            "PATH": "/usr/bin:/bin",
+        }
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=60, env=env)
+        assert proc.returncode == 0, proc.stderr
+
+        # metric-plane request went THROUGH the proxy, to the Cloud
+        # Monitoring path shape, carrying the metadata-minted bearer
+        assert any("/v1/projects/ml-prod/location/global/prometheus/api/v1/query" in r
+                   for r in fake_proxy.requests), fake_proxy.requests
+        assert fake_prom.query_paths == [
+            "/v1/projects/ml-prod/location/global/prometheus/api/v1/query"]
+        assert fake_prom.auth_headers == ["Bearer metadata-minted-token"]
+        assert "kubernetes_io:node_accelerator_tensorcore_utilization" in fake_prom.queries[0]
+
+        # metadata + K8s traffic stayed OFF the proxy
+        assert md.requests and md.requests[0][1] == "Google"
+        k8s_port = fake_k8s.url.rsplit(":", 1)[1]
+        md_port = md.hostport.rsplit(":", 1)[1]
+        for r in fake_proxy.requests:
+            assert f":{k8s_port}" not in r and f":{md_port}" not in r
+
+        # and the pause landed
+        assert fake_k8s.objects["/apis/apps/v1/namespaces/ml/deployments/trainer"][
+            "spec"]["replicas"] == 0
+    finally:
+        md.stop()

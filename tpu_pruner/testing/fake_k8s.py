@@ -190,6 +190,11 @@ class FakeK8s:
         # survive a validating API server; tests may disable it to model
         # a permissive aggregated apiserver.
         self.strict_validation = True
+        # >0 → chunk every collection LIST into pages of this size with
+        # metadata.continue tokens (what an intermediary cache or an
+        # apiserver serving `limit` does); clients that ignore the token
+        # silently see only the first page.
+        self.paginate_lists = 0
         # targeted fault injection: (method or "*", exact path) → [code, n]
         # where n is the remaining failure count (-1 = fail forever)
         self.fail_rules: dict[tuple[str, str], list] = {}
@@ -483,6 +488,17 @@ class FakeK8s:
                                 for k, vals in reqs
                             )
                         ]
+                        page = fake.paginate_lists
+                        if page > 0:
+                            start = int(parse_qs(parsed.query).get(
+                                "continue", ["0"])[0] or "0")
+                            chunk = items[start:start + page]
+                            meta = {}
+                            if start + page < len(items):
+                                meta["continue"] = str(start + page)
+                            self._respond(200, {"kind": "List", "apiVersion": "v1",
+                                                "metadata": meta, "items": chunk})
+                            return
                         self._respond(200, {"kind": "List", "apiVersion": "v1",
                                             "items": items})
                         return
