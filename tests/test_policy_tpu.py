@@ -1,0 +1,83 @@
+"""Opt-in real-hardware tier for the fleet policy engine (TP_POLICY_TPU=1).
+
+The standard suite pins JAX to a virtual CPU mesh (conftest.py), so the
+Pallas kernel only ever runs in interpret mode there. This tier runs the
+SAME verdict contract on the real TPU backend — XLA path and the
+Mosaic-compiled Pallas path — in a fresh subprocess (the session backend
+is already initialized to CPU and can't be switched in-process). Gated
+like the kind tier (TP_E2E_KIND) because chip availability varies by
+environment; the TPU backend here can hang at init, so the subprocess
+carries a hard timeout and a failed probe skips rather than fails.
+
+Run: TP_POLICY_TPU=1 python -m pytest tests/test_policy_tpu.py -q
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_pruner.native import REPO_ROOT
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("TP_POLICY_TPU") != "1",
+    reason="real-TPU policy tier is opt-in: set TP_POLICY_TPU=1",
+)
+
+# Runs with the environment's own JAX platform (axon/TPU), NOT the
+# suite's CPU pin. 4096 chips x 64 samples keeps compile+run well under
+# the timeout while still exercising multi-block Pallas grids (32 blocks
+# of 128 chips).
+CHILD = """
+import json
+import numpy as np
+import jax
+from tpu_pruner.policy import (
+    evaluate_fleet, evaluate_fleet_pallas, make_example_fleet)
+
+NUM_SLICES = 256
+inputs, expected = make_example_fleet(
+    num_chips=4096, num_samples=64, num_slices=NUM_SLICES, idle_fraction=0.5)
+platform = jax.devices()[0].platform
+
+verdicts, candidates = jax.block_until_ready(
+    evaluate_fleet(*inputs, num_slices=NUM_SLICES))
+pallas_verdicts, pallas_candidates = jax.block_until_ready(
+    evaluate_fleet_pallas(*inputs, num_slices=NUM_SLICES))
+
+print(json.dumps({
+    "platform": platform,
+    "xla_verdicts_ok": bool((np.asarray(verdicts) == expected).all()),
+    "pallas_verdicts_ok": bool((np.asarray(pallas_verdicts) == expected).all()),
+    "paths_agree": bool(
+        (np.asarray(candidates) == np.asarray(pallas_candidates)).all()),
+}))
+"""
+
+
+def run_child(timeout=300):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    return subprocess.run([sys.executable, "-c", CHILD], capture_output=True,
+                          text=True, timeout=timeout, env=env,
+                          cwd=str(REPO_ROOT))
+
+
+# No `built` fixture: the child only imports tpu_pruner.policy (pure
+# JAX) — forcing the native cmake build here would fail on TPU hosts
+# without a C++ toolchain and waste minutes on ones with it.
+def test_policy_engine_verdicts_on_real_tpu():
+    try:
+        proc = run_child()
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU backend init hung (wedged tunnel); see bench.py probes")
+    if proc.returncode != 0:
+        pytest.skip(f"TPU backend unavailable: {proc.stderr.strip()[-300:]}")
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    if out["platform"] == "cpu":
+        pytest.skip("no TPU visible; child fell back to cpu")
+    assert out["xla_verdicts_ok"], "XLA fleet verdicts diverged on TPU"
+    assert out["pallas_verdicts_ok"], "Mosaic-compiled Pallas verdicts diverged on TPU"
+    assert out["paths_agree"], "XLA and Pallas candidate masks disagree on TPU"

@@ -36,7 +36,7 @@ def _chip_kernel(tc_ref, hbm_ref, valid_ref, age_ref, params_ref, out_ref):
     params_ref (SMEM, [1,2]): [lookback_s, hbm_cutoff] — scalars kept out
     of VMEM so parameter changes never re-tile the tensor operands.
     """
-    valid = valid_ref[:] != 0
+    valid = valid_ref[:] != 0  # robust to bool or integer mask dtypes
     neg = jnp.float32(-1.0)
     peak_tc = jnp.max(jnp.where(valid, tc_ref[:], neg), axis=1, keepdims=True)
     peak_hbm = jnp.max(jnp.where(valid, hbm_ref[:], neg), axis=1, keepdims=True)
@@ -90,7 +90,11 @@ def evaluate_chips_pallas(
     )(
         tc_util,
         hbm_util,
-        valid.astype(jnp.int8),  # i8 mask: pallas-friendly bool carrier
+        # i8 mask carrier, measured fastest on hardware (round 3, tunneled
+        # v5e, 131k x 360 integrated cycle, interleaved A/B): int8 4.5 ms
+        # vs direct bool 5.5 ms (Mosaic widens bool masks internally) vs
+        # XLA's fused path 3.2 ms. block_c sweep 128-1024 was flat.
+        valid.astype(jnp.int8),
         pod_age_s.astype(jnp.float32).reshape(-1, 1),
         params_arr.astype(jnp.float32).reshape(1, 2),
     )
