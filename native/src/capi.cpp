@@ -110,7 +110,7 @@ void tp_free(void* p) { ::free(p); }
 
 char* tp_version(const char*) {
   Value v = Value::object();
-  v.set("version", Value("0.1.0"));
+  v.set("version", Value(TP_VERSION));  // single source: CMake PROJECT_VERSION
   return ok(v);
 }
 

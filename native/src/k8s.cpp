@@ -157,7 +157,14 @@ json::Value Client::list(const std::string& path, const std::string& label_selec
 }
 
 json::Value Client::patch_merge(const std::string& path, const json::Value& body) const {
-  return request_json("PATCH", path, body.dump(), "application/merge-patch+json", nullptr);
+  // fieldValidation=Strict (server-side field validation, K8s >= 1.25):
+  // without it a typo'd CR patch path (spec.suspended, minReplica) is
+  // silently PRUNED by the structural schema — the patch "succeeds" and
+  // nothing pauses. Strict turns that into a loud 400, matching the
+  // hermetic fake's validator. Older apiservers ignore unknown query
+  // params, so this degrades safely.
+  return request_json("PATCH", path + "?fieldValidation=Strict", body.dump(),
+                      "application/merge-patch+json", nullptr);
 }
 
 json::Value Client::post(const std::string& path, const json::Value& body) const {

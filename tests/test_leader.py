@@ -182,8 +182,14 @@ def test_standby_lease_get_rate_scales_with_lease_duration(built, fake_prom, fak
         before = len(fake_k8s.requests)
         time.sleep(7)  # window covers ~2 ticks at duration/3 = 3 s
         window = fake_k8s.requests[before:]
-        gets = [r for r in window if r == ("GET", LEASE_PATH)]
-        patches = [r for r in window if r[0] == "PATCH" and r[1] == LEASE_PATH]
+        # requests stores RAW paths: PATCHes carry ?fieldValidation=Strict,
+        # so match on the parsed path, not string equality (an exact-match
+        # filter would be vacuously empty and hide a standby write).
+        from urllib.parse import urlparse
+
+        gets = [r for r in window if r[0] == "GET" and urlparse(r[1]).path == LEASE_PATH]
+        patches = [r for r in window
+                   if r[0] == "PATCH" and urlparse(r[1]).path == LEASE_PATH]
         # ~7s / 3s-tick ≈ 2; a 1 s cadence would show ≥6
         assert 1 <= len(gets) <= 4, f"standby Lease GETs in 7s: {len(gets)}"
         assert not patches, "a standby must never write the lease"

@@ -403,6 +403,37 @@ def test_paginated_lists_are_followed_to_completion(built, fake_prom, fake_k8s):
     assert fake_k8s.patches_for("/jobsets/slice") == [{"spec": {"suspend": True}}]
 
 
+def test_patches_request_strict_field_validation(built, fake_prom, fake_k8s):
+    """Every PATCH carries ?fieldValidation=Strict: a real apiserver would
+    otherwise silently PRUNE a typo'd CR patch path (structural-schema
+    pruning) — the patch 'succeeds' and nothing pauses. Strict makes the
+    live cluster behave like the hermetic fake's validator."""
+    dep, rs, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    run_pruner(fake_prom, fake_k8s)
+    patch_paths = [p for m, p in fake_k8s.requests if m == "PATCH"]
+    assert patch_paths, "no patches landed"
+    assert all("fieldValidation=Strict" in p for p in patch_paths), patch_paths
+
+
+def test_gke_system_honor_labels_end_to_end(built, fake_prom, fake_k8s):
+    """Self-managed collection with honorLabels keeps the bare `namespace`
+    on the KSM join; --honor-labels must flow through query AND decode."""
+    dep, rs, pods = fake_k8s.add_deployment_chain("ml", "hl")
+    fake_prom.add_idle_node_series(pods[0]["metadata"]["name"], "ml",
+                                   node="gke-tpu-hl", honor_labels=True)
+    cmd = [str(DAEMON_PATH), "--gcp-project", "p", "--monitoring-endpoint",
+           fake_prom.url, "--honor-labels", "--run-mode", "scale-down"]
+    env = {"KUBE_API_URL": fake_k8s.url, "PROMETHEUS_TOKEN": "t",
+           "PATH": "/usr/bin:/bin"}
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=60, env=env)
+    assert proc.returncode == 0, proc.stderr
+    assert "group_left (pod, namespace, container)" in fake_prom.queries[0]
+    assert "exported_namespace" not in fake_prom.queries[0]
+    assert fake_k8s.objects["/apis/apps/v1/namespaces/ml/deployments/hl"]["spec"][
+        "replicas"] == 0
+
+
 def test_print_query_renders_and_exits(built):
     """--print-query is the operator's sanity-check seam: render the exact
     query (no daemon, no cluster access) and exit 0."""
