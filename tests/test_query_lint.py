@@ -1,0 +1,65 @@
+"""Structural PromQL lint: the fake Prometheus rejects malformed queries.
+
+No promtool exists in this image, so rendered-query syntax was previously
+unchecked — an unbalanced brace from an escaping bug would pass every
+hermetic e2e and fail only on a real Prometheus. The fake now 400s any
+structurally broken query (fake_prom.promql_structure_error), and this
+tier (a) pins the linter itself and (b) sweeps the native builders over
+an argument matrix asserting every rendered query lints clean.
+"""
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.testing.fake_prom import promql_structure_error as lint
+
+
+@pytest.mark.parametrize("query,ok", [
+    ("up", True),
+    ('max_over_time(m{pod != ""}[30m]) == 0', True),
+    ('m{pod != "a}b"}', True),           # brace inside string literal
+    ('m{l="\\""}', True),                # escaped quote
+    ("m{l='a}b'}", True),                # single-quoted literal
+    ("m{l=`a)b`}", True),                # backtick literal (no escapes)
+    ("m{l=`a\\`}", True),                # backslash is literal in backticks
+    ("m{l='unterminated", False),
+    ("", False),
+    ("   ", False),
+    ('m{pod != "x"', False),             # unclosed brace
+    ("m)", False),
+    ("max_over_time(m[30m]", False),     # unclosed paren
+    ('m{l="unterminated', False),
+    ("m[30m)", False),                   # mismatched pair
+])
+def test_linter_verdicts(query, ok):
+    assert (lint(query) is None) == ok, lint(query)
+
+
+def builder_arg_matrix():
+    cases = []
+    for device in ("tpu", "gpu"):
+        schemas = ("gmp", "gke-system") if device == "tpu" else ("gmp",)
+        for schema in schemas:
+            for honor in (False, True):
+                for ns in ("", r"ml-\d+", 'a"b'):
+                    for thr in (None, 0.05 if device == "tpu" else 120.0):
+                        kw = dict(device=device, metric_schema=schema,
+                                  duration=30, honor_labels=honor,
+                                  namespace_exclude="kube-.*")
+                        if ns:
+                            kw["namespace"] = ns
+                        if device == "tpu":
+                            kw["accelerator_type"] = 'v5"e'  # hostile regex
+                            if thr:
+                                kw["hbm_threshold"] = thr
+                        else:
+                            kw["model_name"] = "NVIDIA A100"
+                            if thr:
+                                kw["power_threshold"] = thr
+                        cases.append(kw)
+    return cases
+
+
+@pytest.mark.parametrize("kw", builder_arg_matrix())
+def test_every_rendered_query_lints_clean(built, kw):
+    assert lint(native.build_query(kw)) is None

@@ -14,6 +14,39 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 
+def promql_structure_error(query: str) -> str | None:
+    """Structural lint of a received PromQL string: balanced (), {}, []
+    outside string literals, terminated strings, non-empty. No promtool
+    exists in this image (conftest gotcha), so this is the hermetic
+    guard against escaping/rendering bugs in the native query builders —
+    a query with an unbalanced brace would otherwise sail through every
+    e2e and fail only on a real Prometheus."""
+    if not query.strip():
+        return "empty query"
+    stack = []
+    pairs = {")": "(", "}": "{", "]": "["}
+    i, n = 0, len(query)
+    while i < n:
+        ch = query[i]
+        if ch in "\"'`":  # PromQL strings: double-, single-, or backtick-quoted
+            quote = ch
+            i += 1
+            while i < n and query[i] != quote:
+                # backslash escapes exist in " and ' strings, not backticks
+                i += 2 if (query[i] == "\\" and quote != "`") else 1
+            if i >= n:
+                return "unterminated string literal"
+        elif ch in "({[":
+            stack.append(ch)
+        elif ch in ")}]":
+            if not stack or stack.pop() != pairs[ch]:
+                return f"unbalanced '{ch}' at offset {i}"
+        i += 1
+    if stack:
+        return f"unclosed '{stack[-1]}'"
+    return None
+
+
 class FakePrometheus:
     def __init__(self):
         self.series: list[dict] = []
@@ -115,6 +148,13 @@ class FakePrometheus:
                 with fake._lock:
                     fake.queries.append(query)
                     fake.auth_headers.append(self.headers.get("Authorization"))
+                    if err := promql_structure_error(query):
+                        # 400 like a real Prometheus parse error — feeds the
+                        # daemon's failure budget instead of fake success
+                        self._respond(400, {"status": "error",
+                                            "errorType": "bad_data",
+                                            "error": f"parse error: {err}"})
+                        return
                     if fake.fail_requests_remaining > 0:
                         fake.fail_requests_remaining -= 1
                         self._respond(
