@@ -399,6 +399,12 @@ std::vector<char> groups_fully_idle(const k8s::Client& client,
       selector += groups[indices[j]]->name();
     }
     selector += ")";
+    // Deliberately a FRESH LIST, not a reuse of the resolution phase's
+    // prefetched namespace snapshot: this gate is the last check before
+    // suspending every host of a slice, and a worker pod created while
+    // resolution ran (restart, scale-up) must be seen here so it vetoes
+    // the group. Reusing the earlier snapshot would widen that race from
+    // milliseconds to the whole resolution phase to save one LIST.
     Value pods;
     try {
       pods = client.list(k8s::Client::pods_path(ns), selector);
