@@ -157,6 +157,20 @@ def check_patched(k8s, start_idx):
     return patched
 
 
+def median_of(fn, n=3, wall_key=0):
+    """Run a daemon measurement n times and keep the median-wall result.
+
+    Single runs of the e2e modes have shown ~±20% wall swings (Python
+    fake-server scheduling, host contention), which is enough to flip
+    the cross-mode ratios' sign; the median run stabilizes them.
+    Re-running is free: patches are idempotent and each run's stats are
+    windowed by start indices. wall_key indexes the wall-clock value in
+    the result (tuple position or dict key)."""
+    results = [fn() for _ in range(n)]
+    results.sort(key=lambda r: r[wall_key])
+    return results[len(results) // 2]
+
+
 def run_e2e(k8s, prom):
     start_idx = len(k8s.patches)
     start_req = len(k8s.requests)
@@ -235,11 +249,11 @@ def run_self_reference_mode_same_kinds(k8s, prom):
                 "scale-concurrency 1 — isolates pipeline speed from kind "
                 "capability. Still benefits from the single-flight owner "
                 "FetchCache the real reference lacks (conservative). "
-                "Interpretation: at this topology BOTH runs saturate the "
-                "single-process (GIL-bound) fake API server, so wall-clock "
-                "lands near parity by construction; the ~2.5x fewer API "
-                "calls of the batched headline run is the architecture "
-                "signal that transfers to a real apiserver.",
+                "Interpretation: both modes contend on the single-process "
+                "(GIL-bound) fake API server and single runs swing ~20%, "
+                "so all modes report the median of 3 runs; the ~2.5x fewer "
+                "API calls of the batched headline run is the architecture "
+                "signal that transfers directly to a real apiserver.",
     }
 
 
@@ -315,6 +329,7 @@ def model_reference_ceiling(k8s):
             ])
 
     req(chains[0][0])  # warm
+    start_req = len(k8s.requests)
     t0 = time.monotonic()
     with concurrent.futures.ThreadPoolExecutor(max_workers=REF_CONCURRENCY) as ex:
         list(ex.map(lambda chain: [req(p) for p in chain], chains))
@@ -335,7 +350,8 @@ def model_reference_ceiling(k8s):
     lat = sorted(resolve_s + c for c in cum_scale)
     ref_p50 = statistics.median(lat)
     ref_p95 = lat[int(len(lat) * 0.95)]
-    return resolve_s + scale_s, resolve_s, scale_s, ref_p50, ref_p95
+    return (resolve_s + scale_s, resolve_s, scale_s, ref_p50, ref_p95,
+            len(k8s.requests) - start_req)
 
 
 # ── TPU path (VERDICT r1 #1: preflight, retries, diagnostics) ──
@@ -576,17 +592,20 @@ def main():
     log(f"cluster built in {time.monotonic() - t_build:.1f}s")
 
     try:
-        elapsed, p50_s, p95_s, api_calls, batched = run_e2e(k8s, prom)
-        log(f"e2e: {elapsed:.2f}s wall, p50 {p50_s * 1000:.0f}ms / "
+        elapsed, p50_s, p95_s, api_calls, batched = median_of(
+            lambda: run_e2e(k8s, prom))
+        log(f"e2e (median of 3): {elapsed:.2f}s wall, p50 {p50_s * 1000:.0f}ms / "
             f"p95 {p95_s * 1000:.0f}ms, {api_calls} API calls, "
             f"{batched} batched-resolution cycles")
 
-        self_ref = run_self_reference_mode(k8s, prom)
+        self_ref = median_of(lambda: run_self_reference_mode(k8s, prom),
+                             wall_key="wall_s")
         log(f"self reference-mode: {self_ref['wall_s']:.2f}s wall, "
             f"p50 {self_ref['p50_detect_to_scaledown_s'] * 1000:.0f}ms, "
             f"{self_ref['api_calls']} API calls")
 
-        self_ref_same = run_self_reference_mode_same_kinds(k8s, prom)
+        self_ref_same = median_of(
+            lambda: run_self_reference_mode_same_kinds(k8s, prom), wall_key="wall_s")
         log(f"self reference-mode (same kinds): {self_ref_same['wall_s']:.2f}s wall, "
             f"p50 {self_ref_same['p50_detect_to_scaledown_s'] * 1000:.0f}ms, "
             f"{self_ref_same['api_calls']} API calls")
@@ -595,9 +614,8 @@ def main():
         log(f"circuit breaker: {breaker['patched']}/{RECLAIM_TARGETS} patched "
             f"(cap {BREAKER_CAP}), {breaker['deferred']} deferred")
 
-        ref_calls_before = len(k8s.requests)
-        ref_wall, ref_resolve, ref_scale, ref_p50, ref_p95 = model_reference_ceiling(k8s)
-        ref_api_calls = len(k8s.requests) - ref_calls_before
+        (ref_wall, ref_resolve, ref_scale, ref_p50, ref_p95,
+         ref_api_calls) = median_of(lambda: model_reference_ceiling(k8s))
     finally:
         k8s.stop()
         prom.stop()
