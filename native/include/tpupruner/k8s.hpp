@@ -48,15 +48,25 @@ class Client {
 
   const Config& config() const { return config_; }
 
+  // retry_throttle on the verbs below: honor 429 + Retry-After with a
+  // bounded wait (API Priority & Fairness). Leader-election traffic
+  // passes false — blocking a renew attempt for seconds inside the
+  // elector would widen the dual-leadership window past the
+  // lease-duration bound its grace logic promises; a 429 there must
+  // surface immediately and ride that grace window instead.
+
   // GET that treats 404 as nullopt (reference get_opt, main.rs:453).
-  std::optional<json::Value> get_opt(const std::string& path) const;
+  std::optional<json::Value> get_opt(const std::string& path,
+                                     bool retry_throttle = true) const;
   // GET that throws on any non-2xx.
   json::Value get(const std::string& path) const;
   // LIST with an urlencoded labelSelector; returns the List object.
   json::Value list(const std::string& path, const std::string& label_selector) const;
   // application/merge-patch+json PATCH (reference Patch::Merge).
-  json::Value patch_merge(const std::string& path, const json::Value& body) const;
-  json::Value post(const std::string& path, const json::Value& body) const;
+  json::Value patch_merge(const std::string& path, const json::Value& body,
+                          bool retry_throttle = true) const;
+  json::Value post(const std::string& path, const json::Value& body,
+                   bool retry_throttle = true) const;
 
   // ── path builders ──
   static std::string pod_path(const std::string& ns, const std::string& name);
@@ -79,7 +89,7 @@ class Client {
  private:
   json::Value request_json(const std::string& method, const std::string& path,
                            const std::string& body, const std::string& content_type,
-                           int* status_out) const;
+                           int* status_out, bool retry_throttle = true) const;
 
   Config config_;
   http::Client http_;

@@ -28,6 +28,13 @@ int64_t mono_secs();
 // RFC 4648 base64 (no line breaks) — Proxy-Authorization: Basic credentials.
 std::string base64_encode(std::string_view in);
 
+// Process-wide graceful-shutdown flag: the daemon's SIGTERM/SIGINT
+// handler stores the signal number here; interruptible waits (daemon
+// interval sleep, k8s 429-retry sleep) poll it so shutdown latency stays
+// bounded even mid-backoff. Function-local static — call once before
+// installing signal handlers so the handler never hits first-init.
+std::atomic<int>& shutdown_flag();
+
 // Format epoch seconds (+ optional subsecond digits of `nanos`) as RFC 3339
 // UTC, e.g. "2026-07-29T07:47:45Z" / "2026-07-29T07:47:45.123456Z".
 std::string format_rfc3339(int64_t unix_secs, int64_t nanos = 0, int subsec_digits = 0);

@@ -112,8 +112,10 @@ using util::fan_out;
 // lock-free atomic stores are async-signal-safe. The handler does nothing
 // else; the producer loop observes the flag between cycles and during the
 // interval sleep, then drains the queue and flushes OTLP on the way out.
-std::atomic<int> g_shutdown_signal{0};
-static_assert(std::atomic<int>::is_always_lock_free);
+// Shared with util::shutdown_flag() so the k8s client's 429-retry sleep
+// is interruptible too (a SIGTERM during an APF throttle storm must not
+// wait out tens of stacked backoff sleeps before the drain starts).
+std::atomic<int>& g_shutdown_signal = util::shutdown_flag();
 
 extern "C" void on_shutdown_signal(int signum) {
   g_shutdown_signal = signum;

@@ -1,8 +1,11 @@
 #include "tpupruner/k8s.hpp"
 
+#include <chrono>
 #include <stdexcept>
+#include <thread>
 
 #include "tpupruner/kubeconfig.hpp"
+#include "tpupruner/log.hpp"
 #include "tpupruner/util.hpp"
 
 namespace tpupruner::k8s {
@@ -57,7 +60,7 @@ Client::Client(Config config)
 
 json::Value Client::request_json(const std::string& method, const std::string& path,
                                  const std::string& body, const std::string& content_type,
-                                 int* status_out) const {
+                                 int* status_out, bool retry_throttle) const {
   http::Request req;
   req.method = method;
   req.url = config_.api_url + path;
@@ -69,6 +72,38 @@ json::Value Client::request_json(const std::string& method, const std::string& p
   req.body = body;
 
   http::Response resp = http_.request(req);
+  // API Priority & Fairness throttling (stock GKE behavior): the server
+  // sheds load with 429 + Retry-After. Honoring it with a bounded wait
+  // turns a throttled burst into a short stall instead of a failed
+  // request — which otherwise escalates into a fail-closed namespace
+  // veto (resolve phase) or a consumed failure-budget tick. All verbs
+  // here are safe to retry: GET/LIST trivially, PATCH/POST because
+  // a 429 is shed BEFORE admission (nothing was applied). Two retries,
+  // waits capped at 10 s, keeps the worst case << one check interval.
+  for (int attempt = 0; resp.status == 429 && retry_throttle && attempt < 2; ++attempt) {
+    int64_t wait_ms = 1000;
+    if (auto it = resp.headers.find("retry-after"); it != resp.headers.end()) {
+      try {
+        wait_ms = std::max<int64_t>(std::stoll(it->second), 1) * 1000;
+      } catch (const std::exception&) {
+      }
+    }
+    wait_ms = std::min<int64_t>(wait_ms, 10000);
+    // Deterministic per-path jitter: every throttled worker receives the
+    // same Retry-After, and waking them in lockstep would re-hammer the
+    // already-shedding apiserver.
+    wait_ms += static_cast<int64_t>(std::hash<std::string>{}(path) % 500);
+    log::warn("k8s", "HTTP 429 (apiserver throttling) on " + method + " " + path +
+              "; retrying in " + std::to_string(wait_ms) + "ms");
+    // Chunked, shutdown-interruptible wait (the daemon's sleep convention):
+    // a SIGTERM mid-backoff aborts the retry so the drain starts promptly.
+    for (int64_t waited = 0; waited < wait_ms && !util::shutdown_flag().load();
+         waited += 100) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+    if (util::shutdown_flag().load()) break;
+    resp = http_.request(req);
+  }
   if (status_out) *status_out = resp.status;
   if (resp.status >= 200 && resp.status < 300) {
     if (resp.body.empty()) return json::Value::object();
@@ -91,9 +126,10 @@ json::Value Client::request_json(const std::string& method, const std::string& p
                                   std::to_string(resp.status) + ": " + message);
 }
 
-std::optional<json::Value> Client::get_opt(const std::string& path) const {
+std::optional<json::Value> Client::get_opt(const std::string& path,
+                                           bool retry_throttle) const {
   int status = 0;
-  json::Value v = request_json("GET", path, "", "", &status);
+  json::Value v = request_json("GET", path, "", "", &status, retry_throttle);
   if (status == 404) return std::nullopt;
   return v;
 }
@@ -156,7 +192,8 @@ json::Value Client::list(const std::string& path, const std::string& label_selec
                            std::to_string(kMaxPages) + " continue pages");
 }
 
-json::Value Client::patch_merge(const std::string& path, const json::Value& body) const {
+json::Value Client::patch_merge(const std::string& path, const json::Value& body,
+                                bool retry_throttle) const {
   // fieldValidation=Strict (server-side field validation, K8s >= 1.25):
   // without it a typo'd CR patch path (spec.suspended, minReplica) is
   // silently PRUNED by the structural schema — the patch "succeeds" and
@@ -164,11 +201,12 @@ json::Value Client::patch_merge(const std::string& path, const json::Value& body
   // hermetic fake's validator. Older apiservers ignore unknown query
   // params, so this degrades safely.
   return request_json("PATCH", path + "?fieldValidation=Strict", body.dump(),
-                      "application/merge-patch+json", nullptr);
+                      "application/merge-patch+json", nullptr, retry_throttle);
 }
 
-json::Value Client::post(const std::string& path, const json::Value& body) const {
-  return request_json("POST", path, body.dump(), "application/json", nullptr);
+json::Value Client::post(const std::string& path, const json::Value& body,
+                         bool retry_throttle) const {
+  return request_json("POST", path, body.dump(), "application/json", nullptr, retry_throttle);
 }
 
 std::string Client::pod_path(const std::string& ns, const std::string& name) {

@@ -97,7 +97,7 @@ Elector::~Elector() {
 bool Elector::try_acquire_or_renew() {
   int64_t now = util::now_unix();
   auto mono_now = std::chrono::steady_clock::now();
-  std::optional<Value> lease = client_.get_opt(lease_path_);
+  std::optional<Value> lease = client_.get_opt(lease_path_, /*retry_throttle=*/false);
 
   if (!lease) {
     // No lease yet: create it. A racing candidate's create wins with 201;
@@ -112,7 +112,7 @@ bool Elector::try_acquire_or_renew() {
     body.set("metadata", std::move(meta));
     body.set("spec", lease_spec(opts_.identity, opts_.lease_duration_s, now, now, 1));
     try {
-      client_.post(lease_collection(opts_.lease_ns), body);
+      client_.post(lease_collection(opts_.lease_ns), body, /*retry_throttle=*/false);
       last_renew_ok_ = mono_now;
       return true;
     } catch (const k8s::ApiError& e) {
@@ -161,7 +161,7 @@ bool Elector::try_acquire_or_renew() {
     patch.set("spec", lease_spec(opts_.identity, opts_.lease_duration_s, std::nullopt, now,
                                  std::nullopt));
     try {
-      client_.patch_merge(lease_path_, patch);
+      client_.patch_merge(lease_path_, patch, /*retry_throttle=*/false);
       last_renew_ok_ = mono_now;
       return true;
     } catch (const k8s::ApiError& e) {
@@ -189,7 +189,7 @@ bool Elector::try_acquire_or_renew() {
     patch.set("spec", lease_spec(opts_.identity, opts_.lease_duration_s, now, now,
                                  transitions + 1));
     try {
-      client_.patch_merge(lease_path_, patch);
+      client_.patch_merge(lease_path_, patch, /*retry_throttle=*/false);
       last_renew_ok_ = mono_now;
       return true;
     } catch (const k8s::ApiError& e) {
@@ -207,7 +207,7 @@ void Elector::release() {
   // the resourceVersion precondition — a stale ex-leader (demoted during a
   // partition) must not clear the current leader's claim.
   try {
-    std::optional<Value> lease = client_.get_opt(lease_path_);
+    std::optional<Value> lease = client_.get_opt(lease_path_, /*retry_throttle=*/false);
     if (!lease) return;
     const Value* h = lease->at_path("spec.holderIdentity");
     if (!h || !h->is_string() || h->as_string() != opts_.identity) return;
@@ -221,7 +221,7 @@ void Elector::release() {
     Value spec = Value::object();
     spec.set("holderIdentity", Value(""));
     patch.set("spec", std::move(spec));
-    client_.patch_merge(lease_path_, patch);
+    client_.patch_merge(lease_path_, patch, /*retry_throttle=*/false);
   } catch (const std::exception& e) {
     log::debug("leader", std::string("lease release failed (will expire instead): ") + e.what());
   }

@@ -249,3 +249,13 @@ std::string url_decode(std::string_view s) {
 }
 
 }  // namespace tpupruner::util
+
+namespace tpupruner::util {
+
+std::atomic<int>& shutdown_flag() {
+  static std::atomic<int> flag{0};
+  static_assert(std::atomic<int>::is_always_lock_free);
+  return flag;
+}
+
+}  // namespace tpupruner::util

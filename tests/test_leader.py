@@ -154,6 +154,33 @@ def test_takeover_after_expired_lease(built, fake_prom, fake_k8s):
         stop(proc)
 
 
+def test_lease_traffic_exempt_from_throttle_retry(built, fake_prom, fake_k8s):
+    """Lease renewal opts out of the client's 429+Retry-After retry: a
+    blocked renew attempt (Retry-After: 10, two injected throttles = 20 s
+    of in-attempt sleeping) would widen dual-leadership past the
+    lease-duration bound. The 429 must surface immediately, ride the
+    grace window, and the next 1 s tick must renew — well inside the 3 s
+    lease."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    proc = start_daemon(fake_prom, fake_k8s, "replica-a")
+    try:
+        assert wait_for(lambda: fake_k8s.scale_patches()), "never became leader"
+        before = fake_k8s.objects[LEASE_PATH]["spec"]["renewTime"]
+        fake_k8s.fail_next("PATCH", LEASE_PATH, code=429, times=2, retry_after=10)
+        # with the exemption both 429s are consumed within ~2 ticks and a
+        # fresh renew lands right after; a retrying client would still be
+        # asleep inside its first 10 s backoff
+        assert wait_for(
+            lambda: fake_k8s.fail_rules[("PATCH", LEASE_PATH)][1] == 0
+            and fake_k8s.objects[LEASE_PATH]["spec"]["renewTime"] != before,
+            timeout=6, interval=0.2), "renew did not recover within the lease window"
+        assert fake_k8s.objects[LEASE_PATH]["spec"]["holderIdentity"] == "replica-a"
+    finally:
+        stop(proc)
+
+
 def test_standby_lease_get_rate_scales_with_lease_duration(built, fake_prom, fake_k8s):
     """VERDICT r2 #6: a standby's API traffic is one Lease GET per
     leaseDuration/3 elector tick (and zero PATCHes) — a long-lease config

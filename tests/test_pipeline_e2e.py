@@ -403,6 +403,37 @@ def test_paginated_lists_are_followed_to_completion(built, fake_prom, fake_k8s):
     assert fake_k8s.patches_for("/jobsets/slice") == [{"spec": {"suspend": True}}]
 
 
+def test_apiserver_throttling_is_retried(built, fake_prom, fake_k8s):
+    """API Priority & Fairness sheds load with 429 + Retry-After (stock
+    GKE): a transient throttle on a pod GET must be absorbed by the
+    client's bounded retry, not escalate into the fail-closed namespace
+    veto that would skip the whole cycle."""
+    dep, rs, pods = fake_k8s.add_deployment_chain("ml", "thr")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    pod_path = f"/api/v1/namespaces/ml/pods/{pods[0]['metadata']['name']}"
+    fake_k8s.fail_next("GET", pod_path, code=429, times=1, retry_after=1)
+
+    proc = run_pruner(fake_prom, fake_k8s)
+    assert "429" in proc.stderr and "retrying" in proc.stderr
+    assert "vetoing namespace" not in proc.stderr
+    assert fake_k8s.objects["/apis/apps/v1/namespaces/ml/deployments/thr"]["spec"][
+        "replicas"] == 0
+
+
+def test_persistent_throttling_still_fails_closed(built, fake_prom, fake_k8s):
+    """Retries are bounded (2): a persistent 429 on the pod fetch must
+    still trip the fail-closed namespace veto rather than loop forever."""
+    dep, rs, pods = fake_k8s.add_deployment_chain("ml", "thr2")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    pod_path = f"/api/v1/namespaces/ml/pods/{pods[0]['metadata']['name']}"
+    fake_k8s.fail_next("GET", pod_path, code=429, times=-1, retry_after=1)
+
+    proc = run_pruner(fake_prom, fake_k8s, timeout=90)
+    assert "vetoing namespace" in proc.stderr
+    assert fake_k8s.objects["/apis/apps/v1/namespaces/ml/deployments/thr2"]["spec"][
+        "replicas"] == 2  # untouched
+
+
 def test_patches_request_strict_field_validation(built, fake_prom, fake_k8s):
     """Every PATCH carries ?fieldValidation=Strict: a real apiserver would
     otherwise silently PRUNE a typo'd CR patch path (structural-schema

@@ -393,19 +393,22 @@ class FakeK8s:
         return dep, rs, pods
 
     # ── introspection ──
-    def fail_next(self, method: str, path: str, code: int = 503, times: int = -1):
+    def fail_next(self, method: str, path: str, code: int = 503, times: int = -1,
+                  retry_after: int | None = None):
         """Make `method` (or "*" for any) requests to the exact `path` fail
-        with `code`, `times` times (-1 = until cleared)."""
-        self.fail_rules[(method, path)] = [code, times]
+        with `code`, `times` times (-1 = until cleared). retry_after adds
+        a Retry-After header (API Priority & Fairness 429 shape)."""
+        self.fail_rules[(method, path)] = [code, times, retry_after]
 
     def _injected_failure(self, method: str, path: str):
-        """Returns an HTTP code to fail with, or None. Caller holds _lock."""
+        """Returns (code, retry_after|None) to fail with, or None.
+        Caller holds _lock."""
         for key in ((method, path), ("*", path)):
             rule = self.fail_rules.get(key)
             if rule and rule[1] != 0:
                 if rule[1] > 0:
                     rule[1] -= 1
-                return rule[0]
+                return rule[0], (rule[2] if len(rule) > 2 else None)
         return None
 
     def scale_patches(self):
@@ -427,11 +430,13 @@ class FakeK8s:
             def log_message(self, *args):
                 pass
 
-            def _respond(self, code, payload):
+            def _respond(self, code, payload, retry_after=None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -471,9 +476,11 @@ class FakeK8s:
                 path = parsed.path
                 with fake._lock:
                     fake.requests.append(("GET", self.path))
-                    if (code := fake._injected_failure("GET", path)) is not None:
+                    if (inj := fake._injected_failure("GET", path)) is not None:
+                        code, retry_after = inj
                         self._respond(code, {"kind": "Status", "status": "Failure",
-                                             "message": "injected failure (test)"})
+                                             "message": "injected failure (test)"},
+                                      retry_after=retry_after)
                         return
                     # collection LIST (optional labelSelector), incl. empty lists
                     if path.rsplit("/", 1)[-1] in self.COLLECTIONS and "/namespaces/" in path:
@@ -514,9 +521,11 @@ class FakeK8s:
                 path = urlparse(self.path).path
                 with fake._lock:
                     fake.requests.append(("PATCH", self.path))
-                    if (code := fake._injected_failure("PATCH", path)) is not None:
+                    if (inj := fake._injected_failure("PATCH", path)) is not None:
+                        code, retry_after = inj
                         self._respond(code, {"kind": "Status", "status": "Failure",
-                                             "message": "injected failure (test)"})
+                                             "message": "injected failure (test)"},
+                                      retry_after=retry_after)
                         return
                     fake.patches.append((path, body))
                     fake.patch_times.append(time.monotonic())
@@ -555,9 +564,11 @@ class FakeK8s:
                 path = urlparse(self.path).path
                 with fake._lock:
                     fake.requests.append(("POST", self.path))
-                    if (code := fake._injected_failure("POST", path)) is not None:
+                    if (inj := fake._injected_failure("POST", path)) is not None:
+                        code, retry_after = inj
                         self._respond(code, {"kind": "Status", "status": "Failure",
-                                             "message": "injected failure (test)"})
+                                             "message": "injected failure (test)"},
+                                      retry_after=retry_after)
                         return
                     if path.endswith("/events"):
                         fake.events.append(body)
