@@ -35,14 +35,16 @@
 //     `kubernetes_io:node_accelerator_tensorcore_utilization` /
 //     `…_duty_cycle` / `…_memory_bandwidth_utilization` on the k8s_node
 //     monitored resource — node-scoped labels (node_name, accelerator_id,
-//     make, model), NO pod/namespace/container labels. Pod attribution is
-//     a `* on (node_name) group_left(pod, namespace, container)` join
-//     against kube-state-metrics' `kube_pod_container_resource_requests`
-//     restricted to `resource="google_com_tpu"`, leaning on GKE's
-//     exclusive TPU-node scheduling (google.com/tpu is allocated
-//     whole-node, so at most one TPU-requesting pod per node; the join
-//     metric's resource selector is what enforces the one-to-one match —
-//     non-TPU sidecar pods on the node never enter the join). The
+//     make, model), NO pod/namespace/container labels. Node idleness is
+//     computed first (max over the node's chips of each chip's window
+//     peak), then attributed to pods with a many-to-one
+//     `* on (node_name) group_left(model)` join — pods, from
+//     kube-state-metrics' `kube_pod_container_resource_requests`
+//     restricted to `resource="google_com_tpu"`, are the many side, so
+//     any number of TPU-requesting pods (and containers) per node is
+//     legal: shared single-host nodes make every TPU pod on an idle node
+//     a candidate, and one busy chip rescues them all. The resource
+//     selector keeps non-TPU sidecar/daemonset pods out of the join. The
 //     accelerator-type filter matches the `model` metric label; namespace
 //     filters apply on the join side (the node series carry none).
 //     honor_labels keeps its meaning on the join: GMP-managed KSM collides
@@ -88,8 +90,9 @@ struct QueryArgs {
 
   // gke-system pod-attribution join (kube-state-metrics). join_resource
   // selects TPU-requesting containers; empty disables the resource
-  // selector — the override metric must then itself be limited to one
-  // pod per node, or group_left fails many-to-many (docs/OPERATIONS.md).
+  // selector — the override metric must then itself be limited to
+  // TPU-requesting pods, or every daemonset pod on an idle node becomes
+  // a candidate (docs/OPERATIONS.md).
   std::string join_metric = "kube_pod_container_resource_requests";
   std::string join_resource = "google_com_tpu";
 };

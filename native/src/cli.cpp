@@ -100,7 +100,7 @@ TPU FLAGS:
       --join-resource <R>       resource selector on the join metric
                                 [default: google_com_tpu]; "none" disables —
                                 the join metric must then itself be limited
-                                to one pod per node (see OPERATIONS.md)
+                                to TPU-requesting pods (see OPERATIONS.md)
       --resolve-concurrency <N> concurrent pod resolutions [default: 10]
       --resolve-batch-threshold <N>
                                 when more than N pods (or owners) of one

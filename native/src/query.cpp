@@ -107,25 +107,34 @@ std::string build_tpu_query(const QueryArgs& a) {
 // Stock-GKE system-metric schema (Cloud Monitoring PromQL API). The
 // de-facto contract this builder encodes, pinned by the gke-system tier of
 // tests/test_query_template.py the way main.rs:572-740 pins the DCGM shape:
-//   - node-scoped accelerator series (k8s_node monitored resource):
-//     kubernetes_io:node_accelerator_tensorcore_utilization (0-1, v4+)
-//     `or` kubernetes_io:node_accelerator_duty_cycle (percent, all gens)
-//     / 100, peak over the lookback window, per (node_name,
-//     accelerator_id, model);
-//   - pod attribution via `* on (node_name) group_left(pod, <ns>,
-//     container)` against the KSM requests metric filtered to
-//     resource="google_com_tpu" (its `node` label is lifted into
-//     node_name to align the join keys). GKE schedules TPU-requesting
-//     pods exclusively on their slice's nodes, so the match is 1:1; a
-//     second TPU-requesting pod on one node would be a many-to-many
-//     execution error, surfaced loudly by Prometheus rather than
-//     silently misattributed.
-//   - == 0 idle predicate AFTER the join: only pod-attributed chips are
-//     candidates (an idle node with no TPU pod has nothing to prune);
+//   - node idleness first: kubernetes_io:node_accelerator_tensorcore_
+//     utilization (0-1, v4+) `or` kubernetes_io:node_accelerator_duty_cycle
+//     (percent, all gens) / 100, peak over the lookback window, then
+//     `max by (node_name, model)` over the node's chips — a node is idle
+//     only when EVERY chip's peak over the window is zero. One row per
+//     node (a GKE node exposes exactly one accelerator model, so keeping
+//     `model` in the grouping does not split rows; it exists to be
+//     carried onto pods by group_left below).
+//   - pod attribution with pods as the MANY side: the KSM requests metric
+//     filtered to resource="google_com_tpu" (its `node` label lifted into
+//     node_name to align join keys), aggregated per (node_name, pod, <ns>,
+//     container), `> 0` to drop degenerate zero-quantity requests, then
+//     `* on (node_name) group_left (model)` onto the node-idleness row.
+//     Many-to-one is the point: any number of TPU-requesting pods per node
+//     — shared single-host nodes (e.g. fractional ct5lp-hightpu-8t pools)
+//     and pods splitting requests across containers — render a LEGAL
+//     query. A fully-idle node makes every TPU pod on it a candidate; one
+//     busy chip (node peak > 0) rescues them all. Round-3 shipped the
+//     opposite direction (one pod per node or per-cycle many-to-many
+//     failure, crash-looping the daemon on legitimate shared-node
+//     topologies); node-scoped metrics cannot distinguish pods, so
+//     node-level attribution is the honest structure.
+//   - == 0 idle predicate AFTER the join: only pod-attributed nodes are
+//     candidates (an idle node with no TPU pod has nothing to prune).
+//     The joined value is request_count x node_peak: zero exactly when
+//     the node is idle.
 //   - `unless on (node_name)` HBM-bandwidth corroboration: any chip on
-//     the node moving HBM traffic rescues the whole node's pod.
-// The join side multiplies utilization by the requested chip count —
-// harmless under == 0 (only exact zeros survive the filter).
+//     the node moving HBM traffic rescues all of the node's pods.
 std::string build_tpu_gke_system_query(const QueryArgs& a) {
   Labels l(a.honor_labels);
   // Remap bare GMP default names to the Cloud Monitoring forms; explicit
@@ -167,16 +176,19 @@ std::string build_tpu_gke_system_query(const QueryArgs& a) {
   join_sel += "}";
   if (join_sel == "{}") join_sel.clear();
 
-  std::string idle_block = "sum by (node_name, accelerator_id, model) (\n    max_over_time(" +
-                           tensorcore + accel_sel + window(a) + ")\n    or\n    max_over_time(" +
-                           duty + accel_sel + window(a) + ") / 100\n)";
+  // PromQL gotcha: comparison binds looser than *, so the > 0 guard needs
+  // explicit parens or `pods > 0 * node_idle` parses as `pods > (0 * ...)`.
+  std::string pods_block = "(\n    max by (node_name, pod, " + l.ns +
+                           ", container) (\n      label_replace(\n        " + a.join_metric +
+                           join_sel + ",\n        \"node_name\", \"$1\", \"node\", \"(.+)\"\n"
+                           "      )\n    ) > 0\n  )";
 
-  std::string join = "* on (node_name) group_left (pod, " + l.ns +
-                     ", container)\n  max by (node_name, pod, " + l.ns +
-                     ", container) (\n    label_replace(\n      " + a.join_metric + join_sel +
-                     ",\n      \"node_name\", \"$1\", \"node\", \"(.+)\"\n    )\n  )";
+  std::string node_idle = "max by (node_name, model) (\n    max_over_time(" + tensorcore +
+                          accel_sel + window(a) + ")\n    or\n    max_over_time(" + duty +
+                          accel_sel + window(a) + ") / 100\n  )";
 
-  std::string q = "(\n  " + idle_block + "\n  " + join + "\n)\n== 0";
+  std::string q = "(\n  " + pods_block + "\n  * on (node_name) group_left (model)\n  " +
+                  node_idle + "\n)\n== 0";
   if (threshold_set(a.hbm_threshold)) {
     q += "\nunless on (node_name)\n(\n  max_over_time(" + hbm + accel_sel + window(a) +
          ") >= " + fmt_threshold(*a.hbm_threshold) + "\n)";

@@ -235,11 +235,37 @@ def test_gke_system_idle_predicate_and_normalization(built):
 
 def test_gke_system_pod_attribution_join(built):
     query = gke(duration=30)
-    # node-keyed series join to TPU-requesting pods via KSM requests
+    # TPU-requesting pods (KSM requests) are the MANY side of the join
     assert 'kube_pod_container_resource_requests{resource = "google_com_tpu"}' in query
-    assert "* on (node_name) group_left (pod, exported_namespace, container)" in query
+    assert "max by (node_name, pod, exported_namespace, container)" in query
+    # node idleness is the ONE side; group_left carries the model onto pods
+    assert "* on (node_name) group_left (model)" in query
+    assert "max by (node_name, model)" in query
     # KSM's `node` label is lifted to node_name to align the join keys
     assert '"node_name", "$1", "node", "(.+)"' in query
+
+
+def test_gke_system_shared_node_pods_are_the_many_side(built):
+    """Round-4 contract: two TPU pods on one node (shared single-host
+    pools) and multi-container pods must render a many-to-one join, not a
+    per-cycle many-to-many execution error. Structurally: the pod labels
+    live in the left-side `max by`, group_left copies only node-scoped
+    labels, and no pod label appears in the group_left clause."""
+    query = gke(duration=30)
+    assert "group_left (model)" in query
+    assert "group_left (pod" not in query
+    # the idle side aggregates chips away: node idle == max over chips == 0
+    left, _, right = query.partition("* on (node_name) group_left (model)")
+    assert "kube_pod_container_resource_requests" in left
+    assert "max_over_time" in right
+    assert "max_over_time" not in left
+
+
+def test_gke_system_zero_quantity_requests_are_guarded(built):
+    # a degenerate google_com_tpu request of 0 must not become a candidate
+    # on a busy node via 0 * node_peak == 0
+    query = gke(duration=30)
+    assert ") > 0" in query
 
 
 def test_gke_system_namespace_filter_applies_on_join_side_only(built):
@@ -280,7 +306,7 @@ def test_gke_system_honor_labels_switches_join_namespace_label(built):
     query = gke(duration=30, namespace="ml", honor_labels=True)
     assert "exported_namespace" not in query
     assert query.count('namespace =~ "ml"') == 1
-    assert "group_left (pod, namespace, container)" in query
+    assert "max by (node_name, pod, namespace, container)" in query
 
 
 def test_gke_system_duration_is_interpolated(built):

@@ -103,9 +103,13 @@ class FakePrometheus:
     ) -> None:
         """gke-system shaped rows: what the Cloud Monitoring PromQL API
         returns for the kubernetes_io:node_accelerator_* query after the
-        on(node_name) KSM join — node-scoped accelerator labels plus the
-        joined pod/namespace/container (namespace surfaces as
-        exported_namespace under stock GMP-managed KSM)."""
+        on(node_name) KSM join — pod-keyed rows (pods are the many side)
+        carrying the node's node_name/model via group_left (namespace
+        surfaces as exported_namespace under stock GMP-managed KSM).
+        Several pods may share one node: call once per pod with the same
+        `node`. chips>1 emits per-chip rows, which real evaluation no
+        longer produces (node idleness aggregates chips first) but the
+        decoder must keep tolerating."""
         ns_label = "namespace" if honor_labels else "exported_namespace"
         for chip in range(chips):
             self.series.append({
