@@ -69,13 +69,17 @@ from tpu_pruner import native
 from tpu_pruner.testing import FakeK8s, FakePrometheus
 
 # ── topology ──
-NUM_SLICES = 128            # fully idle v5e-16 slices (4 hosts x 4 chips)
-NUM_PARTIAL_SLICES = 16     # one busy host each → must NOT be reclaimed
+# TP_BENCH_SMOKE=1 shrinks the cluster 16x and runs each mode once — a
+# fast output-path check, never a measurement (summary carries smoke:true).
+SMOKE = os.environ.get("TP_BENCH_SMOKE") == "1"
+_S = 16 if SMOKE else 1
+NUM_SLICES = 128 // _S      # fully idle v5e-16 slices (4 hosts x 4 chips)
+NUM_PARTIAL_SLICES = 16 // _S  # one busy host each → must NOT be reclaimed
 HOSTS_PER_SLICE = 4
 CHIPS_PER_HOST = 4
 NUM_NAMESPACES = 8          # ml-0..ml-7
-IDLE_DEPLOYMENTS = 3584     # spread across the namespaces
-BUSY_DEPLOYMENTS = 256      # exist in K8s, never appear idle
+IDLE_DEPLOYMENTS = 3584 // _S  # spread across the namespaces
+BUSY_DEPLOYMENTS = 256 // _S   # exist in K8s, never appear idle
 CHIPS_PER_DEPLOYMENT = 4
 
 TOTAL_PODS = ((NUM_SLICES + NUM_PARTIAL_SLICES) * HOSTS_PER_SLICE
@@ -145,10 +149,21 @@ def run_daemon(k8s, prom, *extra):
     return elapsed, t0, proc
 
 
+RECLAIM_FRACTION_TARGET = 0.95  # BASELINE.md: ≥95% of idle slices in one window
+
+
 def check_patched(k8s, start_idx):
     """Validates exactly the reclaimable roots (and no partial slice) were
     patched in k8s.patches[start_idx:]. Returns the patched path set."""
     patched = {p for p, _ in k8s.patches[start_idx:]}
+    fraction = len(patched) / RECLAIM_TARGETS
+    if fraction < RECLAIM_FRACTION_TARGET:
+        # the north star is an assertion, not an implication the reader
+        # derives from patch counts (BASELINE.md:24-31)
+        raise RuntimeError(
+            f"NORTH-STAR MISS: reclaimed_fraction {fraction:.3f} < "
+            f"{RECLAIM_FRACTION_TARGET} ({len(patched)}/{RECLAIM_TARGETS} "
+            f"reclaimable targets patched in one cycle)")
     if len(patched) != RECLAIM_TARGETS:
         raise RuntimeError(f"expected {RECLAIM_TARGETS} patched targets, got {len(patched)}")
     partials = [p for p in patched if "/jobsets/partial-" in p]
@@ -157,7 +172,7 @@ def check_patched(k8s, start_idx):
     return patched
 
 
-def median_of(fn, n=3, wall_key=0):
+def median_of(fn, n=None, wall_key=0):
     """Run a daemon measurement n times and keep the median-wall result.
 
     Single runs of the e2e modes have shown ~±20% wall swings (Python
@@ -166,6 +181,8 @@ def median_of(fn, n=3, wall_key=0):
     Re-running is free: patches are idempotent and each run's stats are
     windowed by start indices. wall_key indexes the wall-clock value in
     the result (tuple position or dict key)."""
+    if n is None:
+        n = 1 if SMOKE else 3
     results = [fn() for _ in range(n)]
     results.sort(key=lambda r: r[wall_key])
     return results[len(results) // 2]
@@ -176,13 +193,13 @@ def run_e2e(k8s, prom):
     start_req = len(k8s.requests)
     elapsed, t0, proc = run_daemon(
         k8s, prom, "--resolve-concurrency", "64", "--scale-concurrency", "32")
-    check_patched(k8s, start_idx)
+    patched = check_patched(k8s, start_idx)
     lat = sorted(t - t0 for t in k8s.patch_times[start_idx:])
     p50 = statistics.median(lat)
     p95 = lat[int(len(lat) * 0.95)]
     api_calls = len(k8s.requests) - start_req
     batched_lists = proc.stderr.count("namespace LIST(s)")
-    return elapsed, p50, p95, api_calls, batched_lists
+    return elapsed, p50, p95, api_calls, batched_lists, len(patched) / RECLAIM_TARGETS
 
 
 def run_self_reference_mode(k8s, prom):
@@ -592,7 +609,7 @@ def main():
     log(f"cluster built in {time.monotonic() - t_build:.1f}s")
 
     try:
-        elapsed, p50_s, p95_s, api_calls, batched = median_of(
+        elapsed, p50_s, p95_s, api_calls, batched, reclaimed_fraction = median_of(
             lambda: run_e2e(k8s, prom))
         log(f"e2e (median of 3): {elapsed:.2f}s wall, p50 {p50_s * 1000:.0f}ms / "
             f"p95 {p95_s * 1000:.0f}ms, {api_calls} API calls, "
@@ -628,7 +645,7 @@ def main():
         f"p50 {ref_p50 * 1000:.0f}ms / p95 {ref_p95 * 1000:.0f}ms")
 
     # TPU fleet eval with spaced retries: now, +60s, +120s (only on failure).
-    tpu = tpu_section([
+    tpu = tpu_section([None] if SMOKE else [
         None,
         lambda: time.sleep(60),
         lambda: time.sleep(60),
@@ -646,7 +663,7 @@ def main():
         log(f"fleet eval skipped entirely: {tpu.get('error')} / "
             f"{tpu.get('cpu_fallback_error')}")
 
-    print(json.dumps({
+    detail = {
         "metric": "idle_chips_reclaimed_per_hr",
         "value": round(chips_per_hr, 1),
         "unit": "chips/hr",
@@ -654,6 +671,8 @@ def main():
         "vs_self_reference_mode": round(chips_per_hr / self_ref["chips_per_hr"], 3),
         "vs_self_reference_mode_same_kinds": round(
             chips_per_hr / self_ref_same["chips_per_hr"], 3),
+        "reclaimed_fraction": round(reclaimed_fraction, 4),
+        "reclaimed_fraction_target": RECLAIM_FRACTION_TARGET,
         "e2e_wall_s": round(elapsed, 3),
         "e2e_pods_per_s": round(pods_per_s, 1),
         "p50_detect_to_scaledown_s": round(p50_s, 3),
@@ -681,7 +700,55 @@ def main():
                                    "2-call-per-target consumer (reference publishes "
                                    "no numbers)"},
         "fleet_eval": tpu,
-    }))
+    }
+
+    # Full detail goes to a FILE (and stderr for humans); stdout gets ONE
+    # compact line. The driver records only the last ~2,000 chars of
+    # stdout: rounds 2-3 printed the whole detail object there, outgrew
+    # the window mid-JSON, and the driver recorded parsed:null — no
+    # headline number — for two rounds before anyone noticed.
+    detail_path = Path(__file__).resolve().parent / "bench_detail.json"
+    detail_path.write_text(json.dumps(detail, indent=1) + "\n")
+    log(f"full detail written to {detail_path}")
+
+    summary = {
+        "metric": detail["metric"],
+        "value": detail["value"],
+        "unit": detail["unit"],
+        "vs_baseline": detail["vs_baseline"],
+        "vs_self_reference_mode": detail["vs_self_reference_mode"],
+        "vs_self_reference_mode_same_kinds": detail["vs_self_reference_mode_same_kinds"],
+        "reclaimed_fraction": detail["reclaimed_fraction"],
+        "p50_detect_to_scaledown_s": detail["p50_detect_to_scaledown_s"],
+        "p95_detect_to_scaledown_s": detail["p95_detect_to_scaledown_s"],
+        "k8s_api_calls": api_calls,
+        "ref_k8s_api_calls": ref_api_calls,
+        "detail_file": detail_path.name,
+    }
+    if SMOKE:
+        summary["smoke"] = True  # 16x-shrunk cluster, n=1 — not a measurement
+    # fleet-eval essentials only (the full diagnostics live in the detail file)
+    fe = {}
+    for k in ("platform", "chips_per_s", "cycle_ms", "effective_gbytes_per_s",
+              "ceiling_gbytes_per_s", "pct_of_ceiling", "pallas_chips_per_s"):
+        if k in tpu:
+            fe[k] = round(tpu[k], 3) if isinstance(tpu[k], float) else tpu[k]
+    if not fe and "cpu_fallback" in tpu:
+        fe = {"platform": "cpu_fallback",
+              "chips_per_s": round(tpu["cpu_fallback"]["chips_per_s"], 1)}
+    summary["fleet_eval"] = fe
+
+    # The driver's capture window is ~2,000 chars; stay comfortably under.
+    # Trim rather than assert: dying here after a multi-minute run would
+    # print NOTHING — the exact parsed:null failure this path prevents.
+    line = json.dumps(summary)
+    for drop in ("fleet_eval", "detail_file", "ref_k8s_api_calls", "k8s_api_calls"):
+        if len(line) < 1000:
+            break
+        log(f"summary line {len(line)} chars — dropping {drop} (see detail file)")
+        summary.pop(drop, None)
+        line = json.dumps(summary)
+    print(line)
 
 
 if __name__ == "__main__":

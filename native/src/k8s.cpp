@@ -1,6 +1,9 @@
 #include "tpupruner/k8s.hpp"
 
 #include <chrono>
+#include <ctime>
+#include <iomanip>
+#include <sstream>
 #include <stdexcept>
 #include <thread>
 
@@ -86,12 +89,27 @@ json::Value Client::request_json(const std::string& method, const std::string& p
       try {
         wait_ms = std::max<int64_t>(std::stoll(it->second), 1) * 1000;
       } catch (const std::exception&) {
+        // RFC 7231 also allows the HTTP-date form ("Wed, 21 Oct 2015
+        // 07:28:00 GMT"); apiservers send delta-seconds, but an
+        // intermediary proxy may rewrite it.
+        std::tm tm{};
+        std::istringstream ss(it->second);
+        ss >> std::get_time(&tm, "%a, %d %b %Y %H:%M:%S");
+        if (!ss.fail()) {
+          std::time_t when = timegm(&tm);
+          std::time_t now = std::time(nullptr);
+          if (when > now) wait_ms = static_cast<int64_t>(when - now) * 1000;
+        }
       }
     }
-    wait_ms = std::min<int64_t>(wait_ms, 10000);
     // Deterministic per-path jitter: every throttled worker receives the
     // same Retry-After, and waking them in lockstep would re-hammer the
-    // already-shedding apiserver.
+    // already-shedding apiserver. The base is capped BEFORE the jitter —
+    // capping the sum would collapse every long Retry-After to an
+    // identical 10,000 ms, recreating exactly the lockstep wake the
+    // jitter exists to break — and the cap leaves the jitter headroom so
+    // the documented 10 s worst case per attempt still holds.
+    wait_ms = std::min<int64_t>(wait_ms, 10000 - 500);
     wait_ms += static_cast<int64_t>(std::hash<std::string>{}(path) % 500);
     log::warn("k8s", "HTTP 429 (apiserver throttling) on " + method + " " + path +
               "; retrying in " + std::to_string(wait_ms) + "ms");
@@ -165,23 +183,30 @@ json::Value Client::list(const std::string& path, const std::string& label_selec
     }
     if (page == 0) {
       out = std::move(chunk);
-    } else if (const json::Value* items = chunk.find("items"); items && items->is_array()) {
-      const json::Value* out_items = out.find("items");
-      if (out_items && out_items->is_array()) {
-        json::Value& dst = out.as_object()["items"];
-        for (json::Value& item : chunk.as_object()["items"].as_array()) {
-          dst.push_back(std::move(item));
+    } else {
+      if (const json::Value* items = chunk.find("items"); items && items->is_array()) {
+        const json::Value* out_items = out.find("items");
+        if (out_items && out_items->is_array()) {
+          json::Value& dst = out.as_object()["items"];
+          for (json::Value& item : chunk.as_object()["items"].as_array()) {
+            dst.push_back(std::move(item));
+          }
+        } else {
+          out.set("items", std::move(chunk.as_object()["items"]));
         }
-      } else {
-        out.set("items", std::move(chunk.as_object()["items"]));
+      }
+      // Carry the LAST page's metadata: its resourceVersion is the newest
+      // snapshot a future watch/precondition caller could legally use;
+      // page 1's would be the stalest.
+      if (const json::Value* meta = chunk.find("metadata"); meta && meta->is_object()) {
+        out.set("metadata", std::move(chunk.as_object()["metadata"]));
       }
     }
     if (next.empty()) {
-      // drop the stale token so callers never see a half-consumed cursor
+      // drop the consumed token so callers never see a half-used cursor
       if (page > 0) {
-        const json::Value* meta = out.find("metadata");
-        if (meta && meta->is_object()) {
-          out.as_object()["metadata"].set("continue", json::Value(""));
+        if (const json::Value* meta = out.find("metadata"); meta && meta->is_object()) {
+          out.as_object()["metadata"].as_object().erase("continue");
         }
       }
       return out;

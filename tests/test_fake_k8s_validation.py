@@ -102,6 +102,28 @@ def test_scale_unknown_spec_field_rejected(fake_k8s):
         "replicas"] == 2
 
 
+def test_rejected_patches_never_count_as_landed(fake_k8s):
+    """ADVICE r3: the fake used to append to patches/patch_times BEFORE
+    validation and the 404 check, so a test asserting only via
+    fake.patches would pass even when the daemon's patch was rejected.
+    Rejections must land in rejected_patches instead."""
+    fake_k8s.add_deployment("ml", "trainer")
+    patch(fake_k8s, "/apis/apps/v1/namespaces/ml/deployments/trainer/scale",
+          {"spec": {"replica": 0}})                               # 400
+    patch(fake_k8s, "/apis/apps/v1/namespaces/ml/deployments/gone/scale",
+          {"spec": {"replicas": 0}})                              # 404
+    patch(fake_k8s, "/apis/apps/v1/namespaces/ml/deployments/trainer/scale",
+          {"spec": {"replicas": -1}})                             # 422
+    assert fake_k8s.patches == []
+    assert fake_k8s.patch_times == []
+    assert [code for _, _, code in fake_k8s.rejected_patches] == [400, 404, 422]
+    # and a valid patch still lands
+    code, _ = patch(fake_k8s, "/apis/apps/v1/namespaces/ml/deployments/trainer/scale",
+                    {"spec": {"replicas": 0}})
+    assert code == 200
+    assert len(fake_k8s.patches) == 1
+
+
 def test_scale_wrong_type_rejected(fake_k8s):
     fake_k8s.add_deployment("ml", "trainer")
     code, status = patch(fake_k8s, "/apis/apps/v1/namespaces/ml/deployments/trainer/scale",

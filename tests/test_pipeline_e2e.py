@@ -452,6 +452,30 @@ def test_apiserver_throttling_is_retried(built, fake_prom, fake_k8s):
         "replicas"] == 0
 
 
+def test_throttling_http_date_retry_after_is_honored(built, fake_prom, fake_k8s):
+    """RFC 7231 allows the HTTP-date Retry-After form; an intermediary
+    proxy may rewrite the apiserver's delta-seconds into it. The client
+    must parse it (bounded wait) instead of silently falling back to the
+    1 s default — and still land the patch on retry."""
+    import email.utils
+    dep, rs, pods = fake_k8s.add_deployment_chain("ml", "thrd")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    pod_path = f"/api/v1/namespaces/ml/pods/{pods[0]['metadata']['name']}"
+    when = email.utils.formatdate(time.time() + 8, usegmt=True)
+    fake_k8s.fail_next("GET", pod_path, code=429, times=1, retry_after=when)
+
+    proc = run_pruner(fake_prom, fake_k8s)
+    assert "429" in proc.stderr and "retrying" in proc.stderr
+    # the parsed date (~8s out; >= ~5.5s even after time_t truncation and
+    # a loaded machine's startup->GET delay) was used, not the 1s
+    # fallback (max 1.5s with jitter) — and the cap keeps waits <= 10s
+    import re
+    waits = [int(m) for m in re.findall(r"retrying in (\d+)ms", proc.stderr)]
+    assert waits and all(5500 <= w <= 10000 for w in waits), waits
+    assert fake_k8s.objects["/apis/apps/v1/namespaces/ml/deployments/thrd"]["spec"][
+        "replicas"] == 0
+
+
 def test_persistent_throttling_still_fails_closed(built, fake_prom, fake_k8s):
     """Retries are bounded (2): a persistent 429 on the pod fetch must
     still trip the fail-closed namespace veto rather than loop forever."""

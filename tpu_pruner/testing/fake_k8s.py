@@ -181,8 +181,10 @@ class FakeK8s:
         # path (e.g. "/api/v1/namespaces/ns/pods/p") → object dict
         self.objects: dict[str, dict] = {}
         self.events: list[dict] = []
-        self.patches: list[tuple[str, dict]] = []  # (path, body) in arrival order
-        self.patch_times: list[float] = []  # time.monotonic() per patch (latency benches)
+        self.patches: list[tuple[str, dict]] = []  # LANDED (path, body) in arrival order
+        self.patch_times: list[float] = []  # time.monotonic() per landed patch
+        # (path, body, status) for patches the server refused (400/404/409/422)
+        self.rejected_patches: list[tuple[str, dict, int]] = []
         self.requests: list[tuple[str, str]] = []  # (method, path)
         self.outage = False  # True → every request 503s (apiserver outage)
         # Server-side structural-schema validation (see validate_patch).
@@ -394,10 +396,11 @@ class FakeK8s:
 
     # ── introspection ──
     def fail_next(self, method: str, path: str, code: int = 503, times: int = -1,
-                  retry_after: int | None = None):
+                  retry_after: int | str | None = None):
         """Make `method` (or "*" for any) requests to the exact `path` fail
         with `code`, `times` times (-1 = until cleared). retry_after adds
-        a Retry-After header (API Priority & Fairness 429 shape)."""
+        a Retry-After header (API Priority & Fairness 429 shape):
+        delta-seconds as int, or an HTTP-date string (RFC 7231 form)."""
         self.fail_rules[(method, path)] = [code, times, retry_after]
 
     def _injected_failure(self, method: str, path: str):
@@ -527,17 +530,17 @@ class FakeK8s:
                                              "message": "injected failure (test)"},
                                       retry_after=retry_after)
                         return
-                    fake.patches.append((path, body))
-                    fake.patch_times.append(time.monotonic())
                     target_path = path.removesuffix("/scale")
                     obj = fake.objects.get(target_path)
                     if obj is None:
+                        fake.rejected_patches.append((path, body, 404))
                         self._not_found()
                         return
                     if fake.strict_validation:
                         try:
                             validate_patch(path, body)
                         except PatchInvalid as e:
+                            fake.rejected_patches.append((path, body, e.code))
                             self._respond(e.code, {
                                 "kind": "Status", "status": "Failure",
                                 "reason": "Invalid" if e.code == 422 else "BadRequest",
@@ -548,10 +551,16 @@ class FakeK8s:
                     want_rv = (body.get("metadata") or {}).get("resourceVersion")
                     have_rv = (obj.get("metadata") or {}).get("resourceVersion")
                     if want_rv is not None and want_rv != have_rv:
+                        fake.rejected_patches.append((path, body, 409))
                         self._respond(409, {"kind": "Status", "status": "Failure",
                                             "reason": "Conflict",
                                             "message": "resourceVersion mismatch"})
                         return
+                    # recorded only once validation + existence + precondition
+                    # passed: a test asserting via patches/patch_times must
+                    # never count a rejected patch as landed
+                    fake.patches.append((path, body))
+                    fake.patch_times.append(time.monotonic())
                     merged = merge_patch(obj, body)
                     merged.setdefault("metadata", {})["resourceVersion"] = str(
                         int(have_rv or "0") + 1)
