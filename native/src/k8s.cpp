@@ -1,5 +1,6 @@
 #include "tpupruner/k8s.hpp"
 
+#include <algorithm>
 #include <chrono>
 #include <ctime>
 #include <iomanip>
@@ -87,7 +88,10 @@ json::Value Client::request_json(const std::string& method, const std::string& p
     int64_t wait_ms = 1000;
     if (auto it = resp.headers.find("retry-after"); it != resp.headers.end()) {
       try {
-        wait_ms = std::max<int64_t>(std::stoll(it->second), 1) * 1000;
+        // cap the seconds BEFORE the multiply: a hostile/broken proxy can
+        // send a delta that fits int64 but overflows once *1000 (UB, and
+        // the negative product would skip the wait entirely)
+        wait_ms = std::clamp<int64_t>(std::stoll(it->second), 1, 10) * 1000;
       } catch (const std::exception&) {
         // RFC 7231 also allows the HTTP-date form ("Wed, 21 Oct 2015
         // 07:28:00 GMT"); apiservers send delta-seconds, but an

@@ -116,9 +116,45 @@ Exporter::Exporter(std::string endpoint, int interval_ms)
   traces_url_ = signal_url("OTEL_EXPORTER_OTLP_TRACES_ENDPOINT",
                            "OTEL_TRACES_EXPORTER", "/v1/traces");
 
+  // Drop-in guardrail: the reference's otel feature exports OTLP over
+  // gRPC and its own deployment example points OTEL_EXPORTER_OTLP_ENDPOINT
+  // at :4317 — the gRPC port (main.rs:146-155, README.md:92-98). This
+  // exporter speaks OTLP/HTTP JSON only; against a gRPC-only collector
+  // port it would silently export nothing. Warn loudly instead of
+  // vanishing (README "OTLP transport" section has the collector fix).
+  auto warn_if_grpc = [](const std::string& url, const char* signal) {
+    if (url.empty()) return;
+    bool grpc_scheme = url.rfind("grpc://", 0) == 0 || url.rfind("grpcs://", 0) == 0;
+    // port := digits after the last ':' that is part of the authority
+    std::string authority = url;
+    if (auto p = authority.find("://"); p != std::string::npos) authority = authority.substr(p + 3);
+    if (auto p = authority.find('/'); p != std::string::npos) authority = authority.substr(0, p);
+    bool grpc_port = authority.size() >= 5 && authority.compare(authority.size() - 5, 5, ":4317") == 0;
+    if (grpc_scheme || grpc_port) {
+      log::warn("otlp", std::string(signal) + " endpoint " + url +
+                " looks like an OTLP/gRPC collector (" +
+                (grpc_scheme ? "grpc scheme" : "port 4317") +
+                "); this exporter speaks OTLP/HTTP JSON only and a gRPC-only "
+                "listener will reject it silently. Point it at the collector's "
+                "HTTP port (default 4318) or enable the otlp http receiver "
+                "(README: OTLP transport)");
+    }
+  };
+  warn_if_grpc(metrics_url_, "metrics");
+  warn_if_grpc(traces_url_, "traces");
+
   if (metrics_url_.empty() && traces_url_.empty()) {
     log::info("otlp", "OTLP export: both signals disabled (OTEL_*_EXPORTER=none)");
     return;  // no thread, no recording — a fully inert exporter
+  }
+  // below the early return: with no endpoint nothing exports, and
+  // claiming "exporting regardless" would send the operator debugging a
+  // collector that was never going to receive data
+  if (auto proto = util::env("OTEL_EXPORTER_OTLP_PROTOCOL");
+      proto && proto->rfind("grpc", 0) == 0) {
+    log::warn("otlp", "OTEL_EXPORTER_OTLP_PROTOCOL=" + *proto +
+              " requested, but only http/json is implemented; exporting "
+              "OTLP/HTTP JSON regardless (README: OTLP transport)");
   }
   if (!traces_url_.empty()) g_recording.store(true);
   thread_ = std::thread([this] { loop(); });
