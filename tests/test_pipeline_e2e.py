@@ -1200,3 +1200,44 @@ def test_batching_disabled_keeps_per_pod_gets(built, fake_prom, fake_k8s):
     gets = [p for m, p in fake_k8s.requests if m == "GET"]
     assert len([p for p in gets if "/pods/" in p]) == 3
     assert [p for p in gets if p.split("?")[0].endswith("/namespaces/ml/pods")] == []
+
+
+# ── multi-process fake-apiserver mode (bench fixture, round-4 de-GIL) ──────
+
+
+def test_worker_mode_serves_full_pipeline(built, fake_prom):
+    """start(workers=3): forked pre-fork workers over one shared socket.
+    The daemon's whole cycle (query → batched resolve → scale) must land
+    the same patches as the in-process server, with recordings merged
+    across workers in patch-time order."""
+    fake = FakeK8s()
+    for i in range(4):
+        _, _, pods = fake.add_deployment_chain("ml", f"dep-{i}", num_pods=1)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    _, slice_pods = fake.add_jobset_slice("tpu-jobs", "slice-0", num_hosts=4)
+    for pod in slice_pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "tpu-jobs", chips=4)
+    fake.start(workers=3)
+    try:
+        t_before = time.monotonic()
+        run_pruner(fake_prom, fake, "--resolve-concurrency", "8",
+                   "--scale-concurrency", "4")
+        t_after = time.monotonic()
+        patched = {p for p, _ in fake.patches}
+        assert patched == {
+            *(f"/apis/apps/v1/namespaces/ml/deployments/dep-{i}/scale"
+              for i in range(4)),
+            "/apis/jobset.x-k8s.io/v1alpha2/namespaces/tpu-jobs/jobsets/slice-0",
+        }
+        times = fake.patch_times
+        assert len(times) == len(fake.patches) == 5
+        # cross-process clock contract: bench windows patches by these
+        # timestamps, so every worker must record CLOCK_MONOTONIC (a
+        # worker recording time.time() would land far outside the run's
+        # parent-side monotonic window)
+        assert all(t_before <= t <= t_after for t in times), (t_before, times)
+        # every worker's request log is visible in the merged view
+        assert len(fake.requests) >= 5
+        assert len(fake.events) == 5  # one Event per scaled root
+    finally:
+        fake.stop()

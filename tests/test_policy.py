@@ -200,3 +200,184 @@ def test_pallas_small_block_exercises_grid():
     pal_v, pal_c = evaluate_fleet_pallas(*inputs, num_slices=4, block_c=8)
     np.testing.assert_array_equal(np.asarray(pal_c), np.asarray(ref_c))
     np.testing.assert_array_equal(np.asarray(pal_v), np.asarray(ref_v))
+
+
+# ── int8 quantized sample storage (engine.py UTIL_SCALE block) ───────────
+
+
+def random_fleet(seed, C=200, T=24, S=9):
+    """Random fleet with scrape gaps, absent series, and arbitrary floats
+    (deliberately NOT 1%-aligned — the exactness claims must hold anyway)."""
+    rng = np.random.default_rng(seed)
+    tc = (rng.uniform(size=(C, T)) < 0.5).astype(np.float32) * rng.uniform(size=(C, T))
+    hbm = rng.uniform(0, 0.2, size=(C, T)).astype(np.float32)
+    valid = rng.uniform(size=(C, T)) < 0.9
+    valid[:5] = False
+    age = rng.uniform(0, 4000, size=C).astype(np.float32)
+    slice_id = rng.integers(0, S, size=C).astype(np.int32)
+    return tc, hbm, valid, age, slice_id, S
+
+
+def test_quantize_samples_sentinel_and_zero():
+    from tpu_pruner.policy import quantize_samples
+
+    util = np.array([[0.0, 1e-9, 0.004, 0.05, 1.0, 0.3]], dtype=np.float32)
+    valid = np.array([[True, True, True, True, True, False]])
+    q = quantize_samples(util, valid)
+    assert q.dtype == np.int8
+    # 0 maps to 0 and ONLY 0 does: any positive util lands in bucket >= 1,
+    # which is what keeps the `== 0` idle predicate exact under quantization.
+    assert q[0, 0] == 0
+    assert (q[0, 1:5] >= 1).all()
+    assert q[0, 4] == 100  # full utilization -> top bucket
+    assert q[0, 5] == -1  # invalid sample -> in-band sentinel
+
+
+def test_quantize_device_matches_numpy():
+    """The jitted device quantizer must be bit-identical to the numpy
+    ingest quantizer (both f32): a disagreement at a bucket boundary
+    would break the threshold-consistency guarantee."""
+    from tpu_pruner.policy.engine import quantize_samples, quantize_samples_device
+
+    rng = np.random.default_rng(23)
+    util = rng.uniform(0, 1, size=(64, 48)).astype(np.float32)
+    # salt in exact bucket boundaries and denormals
+    util[0, :4] = [0.0, 0.01, 0.05, 1e-38]
+    valid = rng.uniform(size=(64, 48)) < 0.9
+    np.testing.assert_array_equal(
+        np.asarray(quantize_samples_device(jnp.asarray(util), jnp.asarray(valid))),
+        quantize_samples(util, valid))
+
+
+def test_quantized_exact_when_hbm_disabled():
+    """With the `unless` clause disabled, the quantized path is EXACTLY the
+    f32 path on arbitrary float inputs (idle + age + has_data are all
+    quantization-invariant)."""
+    from tpu_pruner.policy import (
+        evaluate_fleet, evaluate_fleet_q, quantize_fleet_inputs)
+
+    tc, hbm, valid, age, slice_id, S = random_fleet(11)
+    params = params_array(PolicyParams(lookback_s=2100, hbm_threshold=0.0))
+    args = (jnp.asarray(tc), jnp.asarray(hbm), jnp.asarray(valid),
+            jnp.asarray(age), jnp.asarray(slice_id), params)
+    ref_v, ref_c = evaluate_fleet(*args, num_slices=S)
+    q_v, q_c = evaluate_fleet_q(*quantize_fleet_inputs(args), num_slices=S)
+    np.testing.assert_array_equal(np.asarray(q_c), np.asarray(ref_c))
+    np.testing.assert_array_equal(np.asarray(q_v), np.asarray(ref_v))
+
+
+def test_quantized_exact_on_aligned_threshold():
+    """A cutoff on a 1% boundary with 1%-aligned samples: exact equality."""
+    from tpu_pruner.policy import (
+        evaluate_fleet, evaluate_fleet_q, quantize_fleet_inputs)
+
+    rng = np.random.default_rng(13)
+    C, T, S = 96, 12, 7
+    tc = rng.integers(0, 3, size=(C, T)).astype(np.float32) / 100
+    hbm = rng.integers(0, 20, size=(C, T)).astype(np.float32) / 100
+    valid = rng.uniform(size=(C, T)) < 0.9
+    age = rng.uniform(0, 4000, size=C).astype(np.float32)
+    slice_id = rng.integers(0, S, size=C).astype(np.int32)
+    params = params_array(PolicyParams(lookback_s=2100, hbm_threshold=0.05))
+    args = (jnp.asarray(tc), jnp.asarray(hbm), jnp.asarray(valid),
+            jnp.asarray(age), jnp.asarray(slice_id), params)
+    ref_v, ref_c = evaluate_fleet(*args, num_slices=S)
+    q_v, q_c = evaluate_fleet_q(*quantize_fleet_inputs(args), num_slices=S)
+    np.testing.assert_array_equal(np.asarray(q_c), np.asarray(ref_c))
+    np.testing.assert_array_equal(np.asarray(q_v), np.asarray(ref_v))
+
+
+def test_quantized_only_errs_toward_rescue():
+    """On arbitrary (unaligned) thresholds the quantized path may RESCUE a
+    chip whose HBM peak shares the cutoff's 1% bucket, but must never cull
+    a chip the f32 path keeps: q_candidates ⊆ f32_candidates."""
+    from tpu_pruner.policy import (
+        evaluate_fleet, evaluate_fleet_q, quantize_fleet_inputs)
+
+    for seed in range(5):
+        tc, hbm, valid, age, slice_id, S = random_fleet(100 + seed)
+        params = params_array(PolicyParams(lookback_s=2100, hbm_threshold=0.0437))
+        args = (jnp.asarray(tc), jnp.asarray(hbm), jnp.asarray(valid),
+                jnp.asarray(age), jnp.asarray(slice_id), params)
+        _, ref_c = evaluate_fleet(*args, num_slices=S)
+        _, q_c = evaluate_fleet_q(*quantize_fleet_inputs(args), num_slices=S)
+        assert not np.any(np.asarray(q_c) & ~np.asarray(ref_c)), (
+            f"seed {100 + seed}: quantization culled a chip f32 keeps")
+
+
+def test_contiguous_matches_general():
+    """evaluate_fleet_c / _qc ≡ evaluate_fleet / _q on slice-contiguous
+    fleets — including partially-busy slices, empty slice ids, and the
+    age/HBM gates. The cumsum reduction is the 12x-measured replacement
+    for the scatter (engine.py contiguous block)."""
+    from tpu_pruner.policy import (
+        evaluate_fleet, evaluate_fleet_c, evaluate_fleet_q, evaluate_fleet_qc,
+        quantize_fleet_inputs, slice_bounds)
+
+    rng = np.random.default_rng(29)
+    C, T, S = 192, 16, 12
+    # sorted slice ids with uneven sizes and two empty slices (3, 9)
+    sizes = rng.multinomial(C, np.array([1 if s not in (3, 9) else 0
+                                         for s in range(S)]) / (S - 2))
+    slice_id = np.repeat(np.arange(S, dtype=np.int32), sizes)
+    tc = (rng.uniform(size=(C, T)) < 0.5).astype(np.float32) * rng.uniform(size=(C, T))
+    hbm = rng.uniform(0, 0.2, size=(C, T)).astype(np.float32)
+    valid = rng.uniform(size=(C, T)) < 0.9
+    age = rng.uniform(0, 4000, size=C).astype(np.float32)
+    params = params_array(PolicyParams(lookback_s=2100, hbm_threshold=0.05))
+    args = (jnp.asarray(tc), jnp.asarray(hbm), jnp.asarray(valid),
+            jnp.asarray(age), jnp.asarray(slice_id), params)
+
+    bounds = slice_bounds(slice_id, S)
+    ref_v, ref_c = evaluate_fleet(*args, num_slices=S)
+    c_v, c_c = evaluate_fleet_c(*args[:4], bounds, params)
+    np.testing.assert_array_equal(np.asarray(c_v), np.asarray(ref_v))
+    np.testing.assert_array_equal(np.asarray(c_c), np.asarray(ref_c))
+
+    q_args = quantize_fleet_inputs(args)
+    qref_v, qref_c = evaluate_fleet_q(*q_args, num_slices=S)
+    qc_v, qc_c = evaluate_fleet_qc(q_args[0], q_args[1], q_args[2], bounds, q_args[4])
+    np.testing.assert_array_equal(np.asarray(qc_v), np.asarray(qref_v))
+    np.testing.assert_array_equal(np.asarray(qc_c), np.asarray(qref_c))
+
+
+def test_slice_bounds_rejects_unsorted():
+    from tpu_pruner.policy import slice_bounds
+
+    with pytest.raises(ValueError, match="sorted"):
+        slice_bounds(np.array([0, 2, 1], dtype=np.int32), 3)
+
+
+def test_pallas_qc_matches_engine_qc():
+    from tpu_pruner.policy import (
+        evaluate_fleet_pallas_qc, evaluate_fleet_qc, quantize_fleet_inputs,
+        slice_bounds)
+
+    tc, hbm, valid, age, _, S = random_fleet(31)
+    C = tc.shape[0]
+    slice_id = np.sort(np.random.default_rng(31).integers(0, S, size=C)).astype(np.int32)
+    params = params_array(PolicyParams(lookback_s=2100, hbm_threshold=0.05))
+    q = quantize_fleet_inputs((jnp.asarray(tc), jnp.asarray(hbm), jnp.asarray(valid),
+                               jnp.asarray(age), jnp.asarray(slice_id), params))
+    bounds = slice_bounds(slice_id, S)
+    ref_v, ref_c = evaluate_fleet_qc(q[0], q[1], q[2], bounds, q[4])
+    pal_v, pal_c = evaluate_fleet_pallas_qc(q[0], q[1], q[2], bounds, q[4])
+    np.testing.assert_array_equal(np.asarray(pal_c), np.asarray(ref_c))
+    np.testing.assert_array_equal(np.asarray(pal_v), np.asarray(ref_v))
+
+
+def test_pallas_q_matches_engine_q():
+    """evaluate_fleet_pallas_q ≡ evaluate_fleet_q, including the -1 sentinel
+    padding path (C=200 pads to 256)."""
+    from tpu_pruner.policy import (
+        evaluate_fleet_pallas_q, evaluate_fleet_q, quantize_fleet_inputs)
+
+    tc, hbm, valid, age, slice_id, S = random_fleet(17)
+    params = params_array(PolicyParams(lookback_s=2100, hbm_threshold=0.05))
+    q_args = quantize_fleet_inputs(
+        (jnp.asarray(tc), jnp.asarray(hbm), jnp.asarray(valid),
+         jnp.asarray(age), jnp.asarray(slice_id), params))
+    ref_v, ref_c = evaluate_fleet_q(*q_args, num_slices=S)
+    pal_v, pal_c = evaluate_fleet_pallas_q(*q_args, num_slices=S)
+    np.testing.assert_array_equal(np.asarray(pal_c), np.asarray(ref_c))
+    np.testing.assert_array_equal(np.asarray(pal_v), np.asarray(ref_v))

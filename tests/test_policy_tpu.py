@@ -35,7 +35,9 @@ import json
 import numpy as np
 import jax
 from tpu_pruner.policy import (
-    evaluate_fleet, evaluate_fleet_pallas, make_example_fleet)
+    evaluate_fleet, evaluate_fleet_pallas, evaluate_fleet_pallas_qc,
+    evaluate_fleet_qc, make_example_fleet, quantize_fleet_inputs,
+    slice_bounds)
 
 NUM_SLICES = 256
 inputs, expected = make_example_fleet(
@@ -47,12 +49,28 @@ verdicts, candidates = jax.block_until_ready(
 pallas_verdicts, pallas_candidates = jax.block_until_ready(
     evaluate_fleet_pallas(*inputs, num_slices=NUM_SLICES))
 
+# Recommended production configuration (round 4): int8 quantized samples
+# with the in-band -1 sentinel + contiguous cumsum slice reduction, both
+# XLA-fused and Mosaic-Pallas — pinned on hardware, not just interpret
+# mode, because quantization leans on the TPU's f32 flush-to-zero.
+q = quantize_fleet_inputs(inputs)
+bounds = slice_bounds(np.asarray(inputs[4]), NUM_SLICES)
+q_verdicts, q_candidates = jax.block_until_ready(
+    evaluate_fleet_qc(q[0], q[1], q[2], bounds, q[4]))
+qp_verdicts, qp_candidates = jax.block_until_ready(
+    evaluate_fleet_pallas_qc(q[0], q[1], q[2], bounds, q[4]))
+
 print(json.dumps({
     "platform": platform,
     "xla_verdicts_ok": bool((np.asarray(verdicts) == expected).all()),
     "pallas_verdicts_ok": bool((np.asarray(pallas_verdicts) == expected).all()),
     "paths_agree": bool(
         (np.asarray(candidates) == np.asarray(pallas_candidates)).all()),
+    "q_verdicts_ok": bool((np.asarray(q_verdicts) == expected).all()),
+    "q_pallas_verdicts_ok": bool((np.asarray(qp_verdicts) == expected).all()),
+    "q_paths_agree": bool(
+        (np.asarray(q_candidates) == np.asarray(qp_candidates)).all()
+        and (np.asarray(q_candidates) == np.asarray(candidates)).all()),
 }))
 """
 
@@ -81,3 +99,6 @@ def test_policy_engine_verdicts_on_real_tpu():
     assert out["xla_verdicts_ok"], "XLA fleet verdicts diverged on TPU"
     assert out["pallas_verdicts_ok"], "Mosaic-compiled Pallas verdicts diverged on TPU"
     assert out["paths_agree"], "XLA and Pallas candidate masks disagree on TPU"
+    assert out["q_verdicts_ok"], "int8+cumsum verdicts diverged on TPU"
+    assert out["q_pallas_verdicts_ok"], "Pallas int8+cumsum verdicts diverged on TPU"
+    assert out["q_paths_agree"], "quantized candidate masks disagree with f32 on TPU"

@@ -17,20 +17,38 @@ lookback+grace age gate (reference: query.promql.j2 + main.rs:494-510).
 from tpu_pruner.policy.engine import (
     PolicyParams,
     evaluate_chips,
+    evaluate_chips_q,
     evaluate_fleet,
+    evaluate_fleet_c,
+    evaluate_fleet_q,
+    evaluate_fleet_qc,
     evaluate_fleet_sharded,
     make_example_fleet,
     make_sharded_evaluator,
+    quantize_fleet_inputs,
+    quantize_params,
+    quantize_samples,
+    slice_bounds,
     slice_verdicts,
+    slice_verdicts_contiguous,
 )
 __all__ = [
     "PolicyParams",
     "evaluate_chips",
+    "evaluate_chips_q",
     "evaluate_fleet",
+    "evaluate_fleet_c",
+    "evaluate_fleet_q",
+    "evaluate_fleet_qc",
     "evaluate_fleet_sharded",
     "make_example_fleet",
     "make_sharded_evaluator",
+    "quantize_fleet_inputs",
+    "quantize_params",
+    "quantize_samples",
+    "slice_bounds",
     "slice_verdicts",
+    "slice_verdicts_contiguous",
 ]
 
 # Pallas is optional: jax builds without jax.experimental.pallas.tpu must
@@ -38,9 +56,18 @@ __all__ = [
 try:
     from tpu_pruner.policy.pallas_engine import (
         evaluate_chips_pallas,
+        evaluate_chips_pallas_q,
         evaluate_fleet_pallas,
+        evaluate_fleet_pallas_q,
+        evaluate_fleet_pallas_qc,
     )
 
-    __all__ += ["evaluate_chips_pallas", "evaluate_fleet_pallas"]
+    __all__ += [
+        "evaluate_chips_pallas",
+        "evaluate_chips_pallas_q",
+        "evaluate_fleet_pallas",
+        "evaluate_fleet_pallas_q",
+        "evaluate_fleet_pallas_qc",
+    ]
 except ImportError:  # pragma: no cover - depends on the jax build
     pass

@@ -98,6 +98,178 @@ def params_array(params: PolicyParams) -> jax.Array:
     return jnp.array([params.lookback_s, params.hbm_cutoff()], dtype=jnp.float32)
 
 
+# --- int8 quantized sample storage ------------------------------------------
+#
+# The fleet pass is HBM-bandwidth-bound: every byte of tc/hbm/valid is read
+# once and reduced to one bit per chip. f32 samples + a separate bool mask
+# spend 9 bytes per (chip, sample); the policy only ever asks two questions
+# of them — `peak == 0` (idle) and `peak >= cutoff` (corroboration) — so
+# 1%-resolution int8 buckets carry everything the predicates can see:
+#
+#   q = ceil(util * 100), invalid samples stored in-band as -1.
+#
+# ceil maps 0 -> 0 and (0, inf) -> >= 1, so the `== 0` idle predicate is
+# EXACT for arbitrary float inputs (not just 1%-aligned ones), and the -1
+# sentinel folds the validity mask into the same byte: the row peak is -1
+# iff no valid sample exists, which is precisely the has_data gate. The
+# threshold predicate quantizes the cutoff with the same ceil, which can
+# only err in the RESCUE direction (a peak in the cutoff's 1% bucket reads
+# as active) — quantization never culls a chip the f32 path would keep.
+# Both properties are pinned by tests/test_policy.py.
+#
+# Net: 2 bytes per (chip, sample) instead of 9 — a 4.5x cut in the bytes
+# the bandwidth-bound pass must stream (bench.py fleet_eval q_* fields).
+
+UTIL_SCALE = 100  # 1% buckets: tensorcore/duty_cycle's native granularity
+INVALID_Q = -1  # in-band validity sentinel; peak == -1 <=> no data
+_FLT_MIN = 1.1754944e-38  # smallest normal f32 (subnormals flush to 0)
+
+
+def quantize_samples(util, valid):
+    """f32 utilization [0, 1] + validity mask -> int8 samples (ingest-side).
+
+    Deliberately float32 end-to-end: quantize_params and the jitted
+    device-side quantizer use the identical f32 multiply/ceil, so a
+    sample exactly at the cutoff always lands in the cutoff's bucket —
+    mixed f32/f64 quantization could disagree at a bucket boundary and
+    flip the threshold comparison in the CULL direction, the one error
+    the quantized path promises never to make.
+    """
+    util = np.asarray(util, dtype=np.float32)
+    # Explicit flush-to-zero below FLT_MIN: the TPU VPU flushes subnormal
+    # inputs (so they already read as idle on-device); flushing here keeps
+    # the host quantizer bit-identical to the device one on every backend.
+    util = np.where(util < np.float32(_FLT_MIN), np.float32(0), util)
+    q = np.ceil(util * np.float32(UTIL_SCALE))
+    q = np.clip(q, 0, 127)
+    return np.where(np.asarray(valid, dtype=bool), q, INVALID_Q).astype(np.int8)
+
+
+@jax.jit
+def quantize_samples_device(util, valid):
+    """quantize_samples on-device (bit-identical f32 arithmetic).
+
+    Host-side numpy quantization of a 131k x 360 fleet costs tens of
+    seconds on a small VM; on-device it is one bandwidth-bound pass.
+    """
+    util = util.astype(jnp.float32)
+    util = jnp.where(util < _FLT_MIN, jnp.float32(0), util)
+    q = jnp.clip(jnp.ceil(util * UTIL_SCALE), 0, 127)
+    return jnp.where(valid, q, INVALID_Q).astype(jnp.int8)
+
+
+def quantize_params(params_arr) -> np.ndarray:
+    """[lookback_s, hbm_cutoff] -> [lookback_s, ceil(cutoff * SCALE)].
+
+    A disabled cutoff (+inf) stays +inf; np.ceil preserves it.
+    """
+    arr = np.asarray(params_arr, dtype=np.float32)
+    return np.array([arr[0], np.ceil(arr[1] * UTIL_SCALE)], dtype=np.float32)
+
+
+def evaluate_chips_q(tc_q, hbm_q, pod_age_s, lookback_s, hbm_cutoff_q):
+    """evaluate_chips over int8 quantized samples (bool[C]).
+
+    The -1 sentinel makes has_data implicit: peak == 0 already demands at
+    least one valid zero sample and no positive one.
+    """
+    peak_tc = jnp.max(tc_q, axis=-1)
+    peak_hbm = jnp.max(hbm_q, axis=-1)
+    idle = peak_tc == 0                                        # exact `== 0`
+    hbm_active = peak_hbm.astype(jnp.float32) >= hbm_cutoff_q  # `unless`
+    eligible = pod_age_s >= lookback_s                         # age gate
+    return idle & ~hbm_active & eligible
+
+
+@partial(jax.jit, static_argnames=("num_slices",))
+def evaluate_fleet_q(tc_q, hbm_q, pod_age_s, slice_id, params_arr_q, num_slices):
+    """evaluate_fleet over int8 quantized samples.
+
+    params_arr_q: f32[2] = [lookback_s, quantized hbm cutoff]
+    (quantize_params). Returns (slice_idle bool[S], chip_candidate bool[C]).
+    """
+    candidate = evaluate_chips_q(
+        tc_q, hbm_q, pod_age_s, params_arr_q[0], params_arr_q[1]
+    )
+    return slice_verdicts(candidate, slice_id, num_slices), candidate
+
+
+# --- contiguous-slice (sorted) fleets: cumsum slice reduction ---------------
+#
+# segment_sum lowers to a scatter-add, which the TPU serializes: measured
+# 2.2 ms alone for 131k chips -> 8k slices on v5e (round-4 probe) — 2/3 of
+# the whole evaluation cycle — and `indices_are_sorted=True` changes
+# nothing. When chips are grouped by slice (an ingest-side sort of rows,
+# free at tensor-build time), the same reduction is an inclusive cumsum
+# plus one gather at the segment boundaries: 0.18 ms, 12x faster, and the
+# full fused cycle drops 3.2 ms -> ~1.0 ms (f32) / ~0.7-0.8 ms (int8,
+# run-to-run on the tunneled chip; BENCH_r04 pins the round's values).
+# This is
+# the recommended production layout; the segment_sum path stays for
+# arbitrary orderings and for the shard_map evaluator.
+
+def slice_bounds(slice_id, num_slices: int):
+    """Host-side segment bounds (int32[S+1]) for slice-contiguous fleets.
+
+    Requires slice_id sorted ascending (chips grouped by slice) — raises
+    otherwise, because silently wrong bounds would merge neighbor slices'
+    verdicts. Empty slices get start == end and are never idle (chips > 0
+    guard), matching the segment_sum path.
+    """
+    sid = np.asarray(slice_id)
+    if sid.size and (np.diff(sid) < 0).any():
+        raise ValueError(
+            "slice_id must be sorted ascending for the contiguous evaluator; "
+            "sort chips by slice at ingest or use evaluate_fleet")
+    return jnp.asarray(
+        np.searchsorted(sid, np.arange(num_slices + 1)).astype(np.int32))
+
+
+def slice_verdicts_contiguous(candidate, bounds):
+    """slice_verdicts for slice-contiguous chips via cumsum + boundary gather."""
+    busy_cum = jnp.cumsum((~candidate).astype(jnp.int32))
+    busy_cum = jnp.concatenate([jnp.zeros((1,), jnp.int32), busy_cum])
+    busy = busy_cum[bounds[1:]] - busy_cum[bounds[:-1]]
+    chips = bounds[1:] - bounds[:-1]
+    return (busy == 0) & (chips > 0)
+
+
+@jax.jit
+def evaluate_fleet_c(tc_util, hbm_util, valid, pod_age_s, bounds, params_arr):
+    """evaluate_fleet for slice-contiguous fleets (bounds = slice_bounds)."""
+    candidate = evaluate_chips(
+        tc_util, hbm_util, valid, pod_age_s, params_arr[0], params_arr[1]
+    )
+    return slice_verdicts_contiguous(candidate, bounds), candidate
+
+
+@jax.jit
+def evaluate_fleet_qc(tc_q, hbm_q, pod_age_s, bounds, params_arr_q):
+    """evaluate_fleet_q for slice-contiguous fleets — the fastest
+    configuration measured on v5e (int8 storage + cumsum reduction)."""
+    candidate = evaluate_chips_q(
+        tc_q, hbm_q, pod_age_s, params_arr_q[0], params_arr_q[1]
+    )
+    return slice_verdicts_contiguous(candidate, bounds), candidate
+
+
+def quantize_fleet_inputs(inputs):
+    """Convert evaluate_fleet's input tuple to evaluate_fleet_q's.
+
+    (tc, hbm, valid, age, slice_id, params) ->
+    (tc_q, hbm_q, age, slice_id, params_q)
+    """
+    tc, hbm, valid, age, slice_id, params_arr = inputs
+    valid_dev = jnp.asarray(valid)
+    return (
+        quantize_samples_device(jnp.asarray(tc), valid_dev),
+        quantize_samples_device(jnp.asarray(hbm), valid_dev),
+        age,
+        slice_id,
+        jnp.asarray(quantize_params(params_arr)),
+    )
+
+
 def make_sharded_evaluator(mesh: Mesh, num_slices: int, axis: str = "fleet"):
     """Build the mesh-sharded evaluator.
 

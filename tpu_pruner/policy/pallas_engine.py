@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .engine import slice_verdicts
+from .engine import slice_verdicts, slice_verdicts_contiguous
 
 
 def _chip_kernel(tc_ref, hbm_ref, valid_ref, age_ref, params_ref, out_ref):
@@ -99,6 +99,91 @@ def evaluate_chips_pallas(
         params_arr.astype(jnp.float32).reshape(1, 2),
     )
     return out[:num_chips, 0] > 0
+
+
+def _chip_kernel_q(tc_ref, hbm_ref, age_ref, params_ref, out_ref):
+    """Quantized chip-block: int8 loads, widened in-register compute.
+
+    Loads stay int8 (the bandwidth win — 2 bytes per chip-sample); the
+    max/compare widen to int32/f32 in registers, which costs nothing on
+    the VPU. The -1 sentinel folds validity in-band (engine.py UTIL_SCALE
+    block), so there is no third operand to stream at all.
+    """
+    peak_tc = jnp.max(tc_ref[:].astype(jnp.int32), axis=1, keepdims=True)
+    peak_hbm = jnp.max(hbm_ref[:].astype(jnp.int32), axis=1, keepdims=True)
+    idle = peak_tc == 0
+    hbm_active = peak_hbm.astype(jnp.float32) >= params_ref[0, 1]
+    eligible = age_ref[:] >= params_ref[0, 0]
+    out_ref[:] = (idle & jnp.logical_not(hbm_active) & eligible).astype(jnp.int32)
+
+
+def evaluate_chips_pallas_q(
+    tc_q, hbm_q, pod_age_s, params_arr_q, *, block_c: int = 128,
+    interpret: bool | None = None,
+):
+    """Per-chip candidate mask over int8 quantized samples.
+
+    Padding uses the -1 invalid sentinel so padded rows can never become
+    candidates (peak -1 fails the `== 0` idle predicate).
+    """
+    num_chips, num_samples = tc_q.shape
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    padded = ((num_chips + block_c - 1) // block_c) * block_c
+    pad = padded - num_chips
+    if pad:
+        tc_q = jnp.pad(tc_q, ((0, pad), (0, 0)), constant_values=-1)
+        hbm_q = jnp.pad(hbm_q, ((0, pad), (0, 0)), constant_values=-1)
+        pod_age_s = jnp.pad(pod_age_s, (0, pad))
+
+    block = lambda i: (i, 0)  # noqa: E731 — block-index map, one row-block per step
+    out = pl.pallas_call(
+        _chip_kernel_q,
+        grid=(padded // block_c,),
+        in_specs=[
+            pl.BlockSpec((block_c, num_samples), block),
+            pl.BlockSpec((block_c, num_samples), block),
+            pl.BlockSpec((block_c, 1), block),
+            pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((block_c, 1), block),
+        out_shape=jax.ShapeDtypeStruct((padded, 1), jnp.int32),
+        interpret=interpret,
+    )(
+        tc_q.astype(jnp.int8),
+        hbm_q.astype(jnp.int8),
+        pod_age_s.astype(jnp.float32).reshape(-1, 1),
+        params_arr_q.astype(jnp.float32).reshape(1, 2),
+    )
+    return out[:num_chips, 0] > 0
+
+
+@partial(jax.jit, static_argnames=("num_slices", "block_c", "interpret"))
+def evaluate_fleet_pallas_q(
+    tc_q, hbm_q, pod_age_s, slice_id, params_arr_q, num_slices,
+    block_c: int = 128, interpret: bool | None = None,
+):
+    """Drop-in for engine.evaluate_fleet_q with the chip pass in Pallas."""
+    candidate = evaluate_chips_pallas_q(
+        tc_q, hbm_q, pod_age_s, params_arr_q,
+        block_c=block_c, interpret=interpret,
+    )
+    return slice_verdicts(candidate, slice_id, num_slices), candidate
+
+
+@partial(jax.jit, static_argnames=("block_c", "interpret"))
+def evaluate_fleet_pallas_qc(
+    tc_q, hbm_q, pod_age_s, bounds, params_arr_q,
+    block_c: int = 128, interpret: bool | None = None,
+):
+    """engine.evaluate_fleet_qc with the chip pass in Pallas (contiguous
+    slices, cumsum reduction — the scatter-free slice gate)."""
+    candidate = evaluate_chips_pallas_q(
+        tc_q, hbm_q, pod_age_s, params_arr_q,
+        block_c=block_c, interpret=interpret,
+    )
+    return slice_verdicts_contiguous(candidate, bounds), candidate
 
 
 @partial(jax.jit, static_argnames=("num_slices", "block_c", "interpret"))

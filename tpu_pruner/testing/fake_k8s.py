@@ -20,6 +20,50 @@ from datetime import datetime, timedelta, timezone
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+import multiprocessing
+
+
+def _mp_worker_main(fake: "FakeK8s", sock, conn) -> None:
+    """Entry point of one forked API-server worker (start(workers=N)).
+
+    The worker inherits a copy-on-write snapshot of the fully-built fake
+    (fork start method — nothing is pickled) plus the already-listening
+    socket; all workers accept() from that one socket, the kernel handing
+    each new connection to whichever worker is free — the classic pre-fork
+    server shape. Recording attributes (patches/requests/...) are the
+    worker's own copies; the parent merges them on demand over the control
+    pipe. Must be module-level so the fork context can invoke it directly.
+    """
+    # The fork may have captured control pipes of earlier-started siblings;
+    # drop them so this process serves its OWN state (plain-attribute mode).
+    fake._mp_conns = []
+    fake._mp_procs = []
+    # Fresh lock: the parent's may have been held mid-fork in a scenario
+    # helper thread, which would deadlock every request here.
+    fake._lock = threading.Lock()
+    server = ThreadingHTTPServer(sock.getsockname(), fake._make_handler(),
+                                 bind_and_activate=False)
+    server.socket.close()  # replace the unused socket with the shared one
+    server.socket = sock
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, KeyboardInterrupt):
+            break
+        if msg == "stats":
+            conn.send({
+                "patches": fake.patches,
+                "patch_times": fake.patch_times,
+                "rejected_patches": fake.rejected_patches,
+                "requests": fake.requests,
+                "events": fake.events,
+            })
+        elif msg == "stop":
+            conn.send("ok")
+            break
+    server.shutdown()
+
 
 def merge_patch(target, patch):
     """RFC 7386 JSON merge patch."""
@@ -180,12 +224,16 @@ class FakeK8s:
     def __init__(self):
         # path (e.g. "/api/v1/namespaces/ns/pods/p") → object dict
         self.objects: dict[str, dict] = {}
-        self.events: list[dict] = []
-        self.patches: list[tuple[str, dict]] = []  # LANDED (path, body) in arrival order
-        self.patch_times: list[float] = []  # time.monotonic() per landed patch
+        # Recording state lives in underscored attributes; the public names
+        # are properties so that in multi-process mode (start(workers=N))
+        # the parent transparently serves the MERGED view across workers
+        # while handlers keep appending to their process-local lists.
+        self._events: list[dict] = []
+        self._patches: list[tuple[str, dict]] = []  # LANDED (path, body) in arrival order
+        self._patch_times: list[float] = []  # time.monotonic() per landed patch
         # (path, body, status) for patches the server refused (400/404/409/422)
-        self.rejected_patches: list[tuple[str, dict, int]] = []
-        self.requests: list[tuple[str, str]] = []  # (method, path)
+        self._rejected_patches: list[tuple[str, dict, int]] = []
+        self._requests: list[tuple[str, str]] = []  # (method, path)
         self.outage = False  # True → every request 503s (apiserver outage)
         # Server-side structural-schema validation (see validate_patch).
         # ON by default so every hermetic test proves the daemon's patches
@@ -203,6 +251,56 @@ class FakeK8s:
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
+        # multi-process mode (start(workers=N)): control pipes + processes
+        self._mp_conns: list = []
+        self._mp_procs: list = []
+        self._mp_socket = None
+        self._mp_port: int | None = None
+
+    # ── recording views (merged across workers in multi-process mode) ──
+    def _mp_stats(self) -> dict:
+        """Pull and merge every worker's recordings. Patches and their
+        times are re-interleaved globally by timestamp (CLOCK_MONOTONIC is
+        system-wide on Linux, so cross-process times are comparable) —
+        sequential bench runs window them by start index, which stays
+        correct because later runs' patches all carry later times."""
+        for conn in self._mp_conns:
+            conn.send("stats")
+        per = [conn.recv() for conn in self._mp_conns]
+        merged = {"rejected_patches": [], "requests": [], "events": []}
+        timed = []
+        for d in per:
+            timed.extend(zip(d["patch_times"], d["patches"]))
+            merged["rejected_patches"].extend(d["rejected_patches"])
+            merged["requests"].extend(d["requests"])
+            merged["events"].extend(d["events"])
+        timed.sort(key=lambda tp: tp[0])
+        merged["patches"] = [tuple(p) for _, p in timed]
+        merged["patch_times"] = [t for t, _ in timed]
+        merged["rejected_patches"] = [tuple(r) for r in merged["rejected_patches"]]
+        merged["requests"] = [tuple(r) for r in merged["requests"]]
+        return merged
+
+    @property
+    def patches(self):
+        return self._mp_stats()["patches"] if self._mp_conns else self._patches
+
+    @property
+    def patch_times(self):
+        return self._mp_stats()["patch_times"] if self._mp_conns else self._patch_times
+
+    @property
+    def rejected_patches(self):
+        return (self._mp_stats()["rejected_patches"] if self._mp_conns
+                else self._rejected_patches)
+
+    @property
+    def requests(self):
+        return self._mp_stats()["requests"] if self._mp_conns else self._requests
+
+    @property
+    def events(self):
+        return self._mp_stats()["events"] if self._mp_conns else self._events
 
     # ── object builders ────────────────────────────────────────────────
     @staticmethod
@@ -421,7 +519,7 @@ class FakeK8s:
         return [b for p, b in self.patches if p.endswith(path_suffix)]
 
     # ── lifecycle ──────────────────────────────────────────────────────
-    def start(self) -> int:
+    def _make_handler(self):
         fake = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -606,15 +704,54 @@ class FakeK8s:
                         return
                 self._not_found()
 
+        return Handler
+
+    def start(self, workers: int | None = None) -> int:
+        """Serve the fake API. workers<=1 (default): one in-process
+        threading server — the hermetic-test mode, where recording
+        attributes are plain in-memory lists and fault switches
+        (outage/fail_next/paginate) can be flipped live.
+
+        workers=N>1: N forked processes all accept()ing from one shared
+        listening socket (pre-fork shape), so request handling stops
+        contending on a single interpreter's GIL — the bench mode
+        (round-3 verdict: single-process wall-clock measured the fixture,
+        not the pipeline). State is a fork-time snapshot per worker;
+        recordings are merged on access. Flip fault switches BEFORE
+        start; per-worker fail_next counts apply per process.
+        """
         # default backlog of 5 drops SYNs under the concurrent resolve fan-out
         ThreadingHTTPServer.request_queue_size = 128
-        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
-        self._thread.start()
-        return self._server.server_address[1]
+        if workers is None or workers <= 1:
+            self._server = ThreadingHTTPServer(("127.0.0.1", 0), self._make_handler())
+            self._thread = threading.Thread(target=self._server.serve_forever,
+                                            daemon=True)
+            self._thread.start()
+            return self._server.server_address[1]
+
+        import socket as socket_mod
+
+        sock = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+        sock.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
+        sock.bind(("127.0.0.1", 0))
+        sock.listen(128)
+        self._mp_socket = sock
+        self._mp_port = sock.getsockname()[1]
+        ctx = multiprocessing.get_context("fork")  # COW state, no pickling
+        for _ in range(workers):
+            parent_conn, child_conn = ctx.Pipe()
+            proc = ctx.Process(target=_mp_worker_main,
+                               args=(self, sock, child_conn), daemon=True)
+            proc.start()
+            child_conn.close()
+            self._mp_conns.append(parent_conn)
+            self._mp_procs.append(proc)
+        return self._mp_port
 
     @property
     def url(self) -> str:
+        if self._mp_port is not None:
+            return f"http://127.0.0.1:{self._mp_port}"
         assert self._server is not None
         return f"http://127.0.0.1:{self._server.server_address[1]}"
 
@@ -623,6 +760,23 @@ class FakeK8s:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        if self._mp_conns:
+            for conn in self._mp_conns:
+                try:
+                    conn.send("stop")
+                    conn.recv()
+                    conn.close()
+                except (BrokenPipeError, EOFError, OSError):
+                    pass
+            for proc in self._mp_procs:
+                proc.join(timeout=5)
+                if proc.is_alive():
+                    proc.terminate()
+            self._mp_conns, self._mp_procs = [], []
+        if self._mp_socket:
+            self._mp_socket.close()
+            self._mp_socket = None
+            self._mp_port = None
 
 
 def main() -> None:  # standalone: python -m tpu_pruner.testing.fake_k8s
