@@ -1,5 +1,6 @@
 #include "otlp.hpp"
 
+#include "otlp_grpc.hpp"
 #include "tpupruner/http.hpp"
 #include "tpupruner/json.hpp"
 #include "tpupruner/log.hpp"
@@ -100,67 +101,95 @@ Exporter::Exporter(std::string endpoint, int interval_ms)
       start_unix_nanos_(util::now_unix() * 1000000000ll) {
   while (!endpoint.empty() && endpoint.back() == '/') endpoint.pop_back();
 
-  // Per-signal resolution (OTEL spec; the reference documents exactly this
+  // Per-signal protocol (OTEL spec): signal-specific var wins, then the
+  // base var, default http (this exporter's JSON flavor). "grpc" selects
+  // the OTLP/gRPC transport (otlp_grpc.cpp) — the reference's transport
+  // (main.rs:146-155) — over plaintext h2c.
+  auto signal_grpc = [](const char* signal_var) -> bool {
+    std::string p;
+    if (auto v = util::env(signal_var); v && !v->empty()) p = *v;
+    else if (auto v = util::env("OTEL_EXPORTER_OTLP_PROTOCOL"); v && !v->empty()) p = *v;
+    return p.rfind("grpc", 0) == 0;
+  };
+  metrics_grpc_ = signal_grpc("OTEL_EXPORTER_OTLP_METRICS_PROTOCOL");
+  traces_grpc_ = signal_grpc("OTEL_EXPORTER_OTLP_TRACES_PROTOCOL");
+
+  // Per-signal endpoints (OTEL spec; the reference documents exactly this
   // env shape, README.md:79-98): signal endpoint vars are full URLs used
-  // verbatim; `none` exporters disable the signal.
+  // verbatim; `none` exporters disable the signal. For gRPC the service
+  // path is fixed by the protocol, so no /v1/* suffix is appended.
   auto signal_url = [&](const char* endpoint_var, const char* exporter_var,
-                        const char* default_path) -> std::string {
+                        const char* default_path, bool grpc) -> std::string {
     if (auto ex = util::env(exporter_var); ex && *ex == "none") return "";
     if (auto url = util::env(endpoint_var); url && !url->empty()) return *url;
     // No signal override and no base endpoint → the signal is off (a
     // signal-only env configuration leaves the other signal disabled).
-    return endpoint.empty() ? "" : endpoint + default_path;
+    if (endpoint.empty()) return "";
+    // A grpc:// base endpoint selects the gRPC transport below; its
+    // service path is fixed, so the HTTP /v1/* suffix must not stick.
+    bool scheme_grpc = endpoint.rfind("grpc", 0) == 0;
+    return (grpc || scheme_grpc) ? endpoint : endpoint + default_path;
   };
   metrics_url_ = signal_url("OTEL_EXPORTER_OTLP_METRICS_ENDPOINT",
-                            "OTEL_METRICS_EXPORTER", "/v1/metrics");
+                            "OTEL_METRICS_EXPORTER", "/v1/metrics", metrics_grpc_);
   traces_url_ = signal_url("OTEL_EXPORTER_OTLP_TRACES_ENDPOINT",
-                           "OTEL_TRACES_EXPORTER", "/v1/traces");
+                           "OTEL_TRACES_EXPORTER", "/v1/traces", traces_grpc_);
 
-  // Drop-in guardrail: the reference's otel feature exports OTLP over
-  // gRPC and its own deployment example points OTEL_EXPORTER_OTLP_ENDPOINT
-  // at :4317 — the gRPC port (main.rs:146-155, README.md:92-98). This
-  // exporter speaks OTLP/HTTP JSON only; against a gRPC-only collector
-  // port it would silently export nothing. Warn loudly instead of
-  // vanishing (README "OTLP transport" section has the collector fix).
-  auto warn_if_grpc = [](const std::string& url, const char* signal) {
-    if (url.empty()) return;
-    bool grpc_scheme = url.rfind("grpc://", 0) == 0 || url.rfind("grpcs://", 0) == 0;
-    // port := digits after the last ':' that is part of the authority
+  // A grpc:// scheme on the endpoint also selects the gRPC transport
+  // (normalized to http for parsing — gRPC here is plaintext h2c).
+  auto normalize = [](std::string& url, bool& grpc, const char* signal) {
+    if (url.rfind("grpc://", 0) == 0) {
+      url = "http://" + url.substr(7);
+      grpc = true;
+    } else if (url.rfind("grpcs://", 0) == 0 ||
+               (grpc && url.rfind("https://", 0) == 0)) {
+      // gRPC over TLS needs ALPN "h2", which the dlopen'd TLS shim can't
+      // negotiate — refuse loudly rather than export nothing silently.
+      log::warn("otlp", std::string(signal) + " endpoint " + url +
+                ": gRPC over TLS is not supported (no ALPN); use a plaintext "
+                "h2c collector listener or the OTLP/HTTP transport "
+                "(README: OTLP transport). Signal disabled.");
+      url.clear();
+    }
+  };
+  normalize(metrics_url_, metrics_grpc_, "metrics");
+  normalize(traces_url_, traces_grpc_, "traces");
+
+  // Drop-in guardrail, inverted from rounds 2-3: with an HTTP-protocol
+  // signal pointed at :4317 (the collector's gRPC port — the reference's
+  // own deploy example, README.md:92-98), the fix now exists in-process:
+  // set OTEL_EXPORTER_OTLP_PROTOCOL=grpc.
+  auto warn_if_grpc_port = [](const std::string& url, bool grpc, const char* signal) {
+    if (url.empty() || grpc) return;
     std::string authority = url;
     if (auto p = authority.find("://"); p != std::string::npos) authority = authority.substr(p + 3);
     if (auto p = authority.find('/'); p != std::string::npos) authority = authority.substr(0, p);
-    bool grpc_port = authority.size() >= 5 && authority.compare(authority.size() - 5, 5, ":4317") == 0;
-    if (grpc_scheme || grpc_port) {
+    if (authority.size() >= 5 && authority.compare(authority.size() - 5, 5, ":4317") == 0) {
       log::warn("otlp", std::string(signal) + " endpoint " + url +
-                " looks like an OTLP/gRPC collector (" +
-                (grpc_scheme ? "grpc scheme" : "port 4317") +
-                "); this exporter speaks OTLP/HTTP JSON only and a gRPC-only "
-                "listener will reject it silently. Point it at the collector's "
-                "HTTP port (default 4318) or enable the otlp http receiver "
-                "(README: OTLP transport)");
+                " looks like an OTLP/gRPC collector port but the transport is "
+                "OTLP/HTTP JSON; a gRPC-only listener will reject it silently. "
+                "Set OTEL_EXPORTER_OTLP_PROTOCOL=grpc (supported, h2c) or "
+                "point at the collector's HTTP port (default 4318)");
     }
   };
-  warn_if_grpc(metrics_url_, "metrics");
-  warn_if_grpc(traces_url_, "traces");
+  warn_if_grpc_port(metrics_url_, metrics_grpc_, "metrics");
+  warn_if_grpc_port(traces_url_, traces_grpc_, "traces");
 
   if (metrics_url_.empty() && traces_url_.empty()) {
-    log::info("otlp", "OTLP export: both signals disabled (OTEL_*_EXPORTER=none)");
+    // Reached via OTEL_*_EXPORTER=none on both signals OR both endpoints
+    // refused above (gRPC over TLS) — the warn lines say which.
+    log::info("otlp", "OTLP export: no active signal; exporter inert");
     return;  // no thread, no recording — a fully inert exporter
-  }
-  // below the early return: with no endpoint nothing exports, and
-  // claiming "exporting regardless" would send the operator debugging a
-  // collector that was never going to receive data
-  if (auto proto = util::env("OTEL_EXPORTER_OTLP_PROTOCOL");
-      proto && proto->rfind("grpc", 0) == 0) {
-    log::warn("otlp", "OTEL_EXPORTER_OTLP_PROTOCOL=" + *proto +
-              " requested, but only http/json is implemented; exporting "
-              "OTLP/HTTP JSON regardless (README: OTLP transport)");
   }
   if (!traces_url_.empty()) g_recording.store(true);
   thread_ = std::thread([this] { loop(); });
-  log::info("otlp", "OTLP export: metrics -> " + (metrics_url_.empty() ? "(off)" : metrics_url_) +
-            ", traces -> " + (traces_url_.empty() ? "(off)" : traces_url_) + " every " +
-            std::to_string(interval_ms_) + "ms");
+  log::info("otlp", "OTLP export: metrics -> " +
+            (metrics_url_.empty() ? "(off)"
+                                  : metrics_url_ + (metrics_grpc_ ? " [grpc]" : " [http/json]")) +
+            ", traces -> " +
+            (traces_url_.empty() ? "(off)"
+                                 : traces_url_ + (traces_grpc_ ? " [grpc]" : " [http/json]")) +
+            " every " + std::to_string(interval_ms_) + "ms");
 }
 
 std::unique_ptr<Exporter> Exporter::from_config(const std::string& cli_endpoint) {
@@ -215,6 +244,11 @@ bool Exporter::export_once() {
 }
 
 bool Exporter::export_metrics(int64_t now_nanos) {
+  if (metrics_grpc_) {
+    return grpc_post(metrics_url_, otlp_grpc::kMetricsPath,
+                     otlp_grpc::encode_metrics_request(
+                         log::counters_snapshot(), start_unix_nanos_, now_nanos));
+  }
   Value metrics = Value::array();
   for (const auto& [name, counter] : log::counters_snapshot()) {
     Value metric = Value::object();
@@ -254,6 +288,10 @@ bool Exporter::export_traces() {
   std::vector<FinishedSpan> finished = drain_spans();
   if (finished.empty()) return true;
 
+  if (traces_grpc_) {
+    return grpc_post(traces_url_, otlp_grpc::kTracesPath,
+                     otlp_grpc::encode_traces_request(finished));
+  }
   Value spans = Value::array();
   for (FinishedSpan& fs : finished) {
     Value span = Value::object();
@@ -298,6 +336,29 @@ bool Exporter::export_traces() {
   Value body = Value::object();
   body.set("resourceSpans", Value(json::Array{std::move(rs)}));
   return post(traces_url_, body.dump());
+}
+
+bool Exporter::grpc_post(const std::string& url, const char* path,
+                         const std::string& proto) {
+  auto parsed = http::parse_url(url);
+  if (!parsed) {
+    log::warn("otlp", "OTLP/gRPC endpoint unparseable: " + url);
+    return false;
+  }
+  otlp_grpc::CallResult res =
+      otlp_grpc::unary_call(parsed->host, parsed->port, path, proto, 5000);
+  if (!res.ok) {
+    log::warn("otlp", "OTLP/gRPC export to " + url + path + " failed: " +
+              (!res.error.empty() ? res.error
+                                  : "grpc-status " + std::to_string(res.grpc_status) +
+                                        (res.grpc_message.empty() ? "" : " (" + res.grpc_message + ")")));
+    return false;
+  }
+  if (res.status_undecoded) {
+    log::debug("otlp", "OTLP/gRPC trailers huffman-coded; success inferred "
+               "from clean close on HTTP 200");
+  }
+  return true;
 }
 
 bool Exporter::post(const std::string& url, const std::string& body_json) {

@@ -98,7 +98,9 @@ class Exporter {
   bool export_metrics(int64_t now_nanos);
   bool export_traces();
   bool post(const std::string& url, const std::string& body_json);
+  bool grpc_post(const std::string& url, const char* path, const std::string& proto);
   std::string metrics_url_, traces_url_;  // empty = signal disabled
+  bool metrics_grpc_ = false, traces_grpc_ = false;  // OTLP/gRPC transport
   int interval_ms_;
   std::atomic<bool> stop_{false};
   std::mutex mutex_;

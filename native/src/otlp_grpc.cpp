@@ -1,0 +1,585 @@
+#include "otlp_grpc.hpp"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+#include <stdexcept>
+
+#include "tpupruner/log.hpp"
+
+namespace tpupruner::otlp_grpc {
+
+// ── protobuf writer ─────────────────────────────────────────────────────
+namespace pb {
+
+void put_varint(std::string& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back(static_cast<char>((v & 0x7f) | 0x80));
+    v >>= 7;
+  }
+  out.push_back(static_cast<char>(v));
+}
+
+void put_varint_field(std::string& out, int field, uint64_t v) {
+  put_varint(out, static_cast<uint64_t>(field) << 3 | 0);
+  put_varint(out, v);
+}
+
+void put_fixed64_field(std::string& out, int field, uint64_t v) {
+  put_varint(out, static_cast<uint64_t>(field) << 3 | 1);
+  for (int i = 0; i < 8; ++i) out.push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+}
+
+void put_bytes_field(std::string& out, int field, std::string_view bytes) {
+  put_varint(out, static_cast<uint64_t>(field) << 3 | 2);
+  put_varint(out, bytes.size());
+  out.append(bytes.data(), bytes.size());
+}
+
+}  // namespace pb
+
+namespace {
+
+using pb::put_bytes_field;
+using pb::put_fixed64_field;
+using pb::put_varint_field;
+
+// KeyValue{key=1, value=2:AnyValue{string_value=1 | int_value=3}}
+// (opentelemetry/proto/common/v1/common.proto)
+std::string kv_string(std::string_view key, std::string_view value) {
+  std::string any;
+  put_bytes_field(any, 1, value);  // AnyValue.string_value
+  std::string kv;
+  put_bytes_field(kv, 1, key);
+  put_bytes_field(kv, 2, any);
+  return kv;
+}
+
+std::string kv_int(std::string_view key, int64_t value) {
+  std::string any;
+  put_varint_field(any, 3, static_cast<uint64_t>(value));  // AnyValue.int_value
+  std::string kv;
+  put_bytes_field(kv, 1, key);
+  put_bytes_field(kv, 2, any);
+  return kv;
+}
+
+// Resource{attributes=1} carrying service.name=tpu-pruner (the JSON
+// exporter's service_resource() analog, otlp.cpp).
+std::string resource_proto() {
+  std::string res;
+  put_bytes_field(res, 1, kv_string("service.name", "tpu-pruner"));
+  return res;
+}
+
+// InstrumentationScope{name=1}
+std::string scope_proto() {
+  std::string scope;
+  put_bytes_field(scope, 1, "tpu_pruner");
+  return scope;
+}
+
+std::string hex_to_bytes(const std::string& hex) {
+  std::string out;
+  out.reserve(hex.size() / 2);
+  auto nib = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return 0;
+  };
+  for (size_t i = 0; i + 1 < hex.size(); i += 2)
+    out.push_back(static_cast<char>(nib(hex[i]) << 4 | nib(hex[i + 1])));
+  return out;
+}
+
+}  // namespace
+
+std::string encode_metrics_request(const std::map<std::string, log::Counter>& counters,
+                                   int64_t start_nanos, int64_t now_nanos) {
+  // Mirrors the JSON shape in otlp.cpp export_metrics: one ResourceMetrics,
+  // one ScopeMetrics, one Sum-or-Gauge metric per counter, one data point.
+  std::string metrics;
+  for (const auto& [name, counter] : counters) {
+    // NumberDataPoint{start_time_unix_nano=2(f64), time_unix_nano=3(f64),
+    // as_int=6(sfixed64)} (proto/metrics/v1/metrics.proto)
+    std::string dp;
+    put_fixed64_field(dp, 2, static_cast<uint64_t>(start_nanos));
+    put_fixed64_field(dp, 3, static_cast<uint64_t>(now_nanos));
+    {  // as_int: sfixed64 = wiretype 1
+      put_fixed64_field(dp, 6, counter.value);
+    }
+    std::string metric;
+    put_bytes_field(metric, 1, "tpu_pruner." + name);  // Metric.name
+    if (counter.gauge) {
+      std::string gauge;  // Gauge{data_points=1}
+      put_bytes_field(gauge, 1, dp);
+      put_bytes_field(metric, 5, gauge);  // Metric.gauge
+    } else {
+      std::string sum;  // Sum{data_points=1, aggregation_temporality=2, is_monotonic=3}
+      put_bytes_field(sum, 1, dp);
+      put_varint_field(sum, 2, 2);  // AGGREGATION_TEMPORALITY_CUMULATIVE
+      put_varint_field(sum, 3, 1);  // is_monotonic
+      put_bytes_field(metric, 7, sum);  // Metric.sum
+    }
+    metrics += [&] {
+      std::string field;
+      put_bytes_field(field, 2, metric);  // ScopeMetrics.metrics
+      return field;
+    }();
+  }
+  std::string scope_metrics;
+  put_bytes_field(scope_metrics, 1, scope_proto());  // ScopeMetrics.scope
+  scope_metrics += metrics;
+
+  std::string rm;  // ResourceMetrics{resource=1, scope_metrics=2}
+  put_bytes_field(rm, 1, resource_proto());
+  put_bytes_field(rm, 2, scope_metrics);
+
+  std::string req;  // ExportMetricsServiceRequest{resource_metrics=1}
+  put_bytes_field(req, 1, rm);
+  return req;
+}
+
+std::string encode_traces_request(const std::vector<otlp::FinishedSpan>& spans) {
+  // Mirrors otlp.cpp export_traces: one ResourceSpans, one ScopeSpans.
+  std::string spans_fields;
+  for (const otlp::FinishedSpan& fs : spans) {
+    // Span{trace_id=1, span_id=2, parent_span_id=4, name=5, kind=6,
+    // start=7(f64), end=8(f64), attributes=9, status=15}
+    // (proto/trace/v1/trace.proto)
+    std::string span;
+    put_bytes_field(span, 1, hex_to_bytes(fs.trace_id));
+    put_bytes_field(span, 2, hex_to_bytes(fs.span_id));
+    if (!fs.parent_span_id.empty())
+      put_bytes_field(span, 4, hex_to_bytes(fs.parent_span_id));
+    put_bytes_field(span, 5, fs.name);
+    put_varint_field(span, 6, 1);  // SPAN_KIND_INTERNAL
+    put_fixed64_field(span, 7, static_cast<uint64_t>(fs.start_nanos));
+    put_fixed64_field(span, 8, static_cast<uint64_t>(fs.end_nanos));
+    for (const auto& [k, v] : fs.str_attrs) put_bytes_field(span, 9, kv_string(k, v));
+    for (const auto& [k, v] : fs.int_attrs) put_bytes_field(span, 9, kv_int(k, v));
+    if (fs.error) {
+      std::string status;  // Status{message=2, code=3}
+      put_bytes_field(status, 2, fs.error_message);
+      put_varint_field(status, 3, 2);  // STATUS_CODE_ERROR
+      put_bytes_field(span, 15, status);
+    }
+    put_bytes_field(spans_fields, 2, span);  // ScopeSpans.spans
+  }
+  std::string scope_spans;
+  put_bytes_field(scope_spans, 1, scope_proto());  // ScopeSpans.scope
+  scope_spans += spans_fields;
+
+  std::string rs;  // ResourceSpans{resource=1, scope_spans=2}
+  put_bytes_field(rs, 1, resource_proto());
+  put_bytes_field(rs, 2, scope_spans);
+
+  std::string req;  // ExportTraceServiceRequest{resource_spans=1}
+  put_bytes_field(req, 1, rs);
+  return req;
+}
+
+// ── minimal HTTP/2 / gRPC client ────────────────────────────────────────
+namespace {
+
+constexpr uint8_t kFrameData = 0x0, kFrameHeaders = 0x1, kFrameRst = 0x3,
+                  kFrameSettings = 0x4, kFramePing = 0x6, kFrameGoaway = 0x7,
+                  kFrameWindowUpdate = 0x8, kFrameContinuation = 0x9;
+constexpr uint8_t kFlagEndStream = 0x1, kFlagAck = 0x1, kFlagEndHeaders = 0x4,
+                  kFlagPadded = 0x8, kFlagPriority = 0x20;
+
+struct Sock {
+  int fd = -1;
+  ~Sock() {
+    if (fd >= 0) ::close(fd);
+  }
+  void write_all(const char* buf, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t w = ::send(fd, buf + off, n - off, MSG_NOSIGNAL);
+      if (w <= 0) throw std::runtime_error("h2 send: " + std::string(std::strerror(errno)));
+      off += static_cast<size_t>(w);
+    }
+  }
+  void read_exact(char* buf, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t r = ::recv(fd, buf + off, n - off, 0);
+      if (r == 0) throw std::runtime_error("h2 recv: connection closed");
+      if (r < 0) throw std::runtime_error("h2 recv: " + std::string(std::strerror(errno)));
+      off += static_cast<size_t>(r);
+    }
+  }
+};
+
+int dial(const std::string& host, int port, int timeout_ms) {
+  struct addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  int rc = ::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res);
+  if (rc != 0) throw std::runtime_error("resolve " + host + ": " + gai_strerror(rc));
+  int fd = -1;
+  std::string last = "no addresses";
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    struct timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+      int nodelay = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nodelay, sizeof(nodelay));
+      break;
+    }
+    last = std::strerror(errno);
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) throw std::runtime_error("connect " + host + ": " + last);
+  return fd;
+}
+
+std::string frame_header(size_t len, uint8_t type, uint8_t flags, uint32_t stream) {
+  std::string h(9, '\0');
+  h[0] = static_cast<char>((len >> 16) & 0xff);
+  h[1] = static_cast<char>((len >> 8) & 0xff);
+  h[2] = static_cast<char>(len & 0xff);
+  h[3] = static_cast<char>(type);
+  h[4] = static_cast<char>(flags);
+  h[5] = static_cast<char>((stream >> 24) & 0x7f);
+  h[6] = static_cast<char>((stream >> 16) & 0xff);
+  h[7] = static_cast<char>((stream >> 8) & 0xff);
+  h[8] = static_cast<char>(stream & 0xff);
+  return h;
+}
+
+// HPACK "literal header field without indexing — new name", both strings
+// raw (huffman bit 0). Always legal regardless of table state (RFC 7541
+// §6.2.2); names must already be lowercase.
+void hpack_literal(std::string& out, std::string_view name, std::string_view value) {
+  auto put_str = [&](std::string_view s) {
+    // 7-bit prefix integer, H bit 0
+    if (s.size() < 127) {
+      out.push_back(static_cast<char>(s.size()));
+    } else {
+      out.push_back(0x7f);
+      uint64_t rest = s.size() - 127;
+      while (rest >= 0x80) {
+        out.push_back(static_cast<char>((rest & 0x7f) | 0x80));
+        rest >>= 7;
+      }
+      out.push_back(static_cast<char>(rest));
+    }
+    out.append(s.data(), s.size());
+  };
+  out.push_back(0x00);
+  put_str(name);
+  put_str(value);
+}
+
+// HPACK static table (RFC 7541 appendix A), names only; the handful of
+// entries with fixed values carry them.
+const char* kStaticNames[62] = {
+    nullptr, ":authority", ":method", ":method", ":path", ":path", ":scheme",
+    ":scheme", ":status", ":status", ":status", ":status", ":status", ":status",
+    ":status", "accept-charset", "accept-encoding", "accept-language",
+    "accept-ranges", "accept", "access-control-allow-origin", "age", "allow",
+    "authorization", "cache-control", "content-disposition", "content-encoding",
+    "content-language", "content-length", "content-location", "content-range",
+    "content-type", "cookie", "date", "etag", "expect", "expires", "from",
+    "host", "if-match", "if-modified-since", "if-none-match", "if-range",
+    "if-unmodified-since", "last-modified", "link", "location", "max-forwards",
+    "proxy-authenticate", "proxy-authorization", "range", "referer", "refresh",
+    "retry-after", "server", "set-cookie", "strict-transport-security",
+    "transfer-encoding", "user-agent", "vary", "via", "www-authenticate"};
+const char* kStaticValues[62] = {
+    nullptr, "", "GET", "POST", "/", "/index.html", "http", "https", "200",
+    "204", "206", "304", "400", "404", "500", "", "gzip, deflate", "", "", "",
+    "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "",
+    "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "",
+    "", "", "", "", "", ""};
+
+struct Header {
+  std::string name, value;
+  bool huffman_value = false;  // value bytes are huffman-coded (opaque)
+};
+
+// Decode one HPACK header block (static table + literals; dynamic-table
+// references can't legally appear because we advertise table size 0, but
+// are tolerated as unknowns). Returns false on malformed input.
+bool hpack_decode(std::string_view block, std::vector<Header>& out) {
+  size_t i = 0;
+  auto read_int = [&](int prefix_bits, uint64_t& v) -> bool {
+    if (i >= block.size()) return false;
+    uint8_t mask = static_cast<uint8_t>((1u << prefix_bits) - 1);
+    v = static_cast<uint8_t>(block[i]) & mask;
+    ++i;
+    if (v < mask) return true;
+    int shift = 0;
+    while (i < block.size()) {
+      uint8_t b = static_cast<uint8_t>(block[i++]);
+      v += static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return true;
+      shift += 7;
+      if (shift > 56) return false;
+    }
+    return false;
+  };
+  auto read_str = [&](std::string& s, bool& huff) -> bool {
+    if (i >= block.size()) return false;
+    huff = (static_cast<uint8_t>(block[i]) & 0x80) != 0;
+    uint64_t len = 0;
+    if (!read_int(7, len)) return false;
+    if (i + len > block.size()) return false;
+    s.assign(block.data() + i, len);
+    i += len;
+    return true;
+  };
+  while (i < block.size()) {
+    uint8_t b = static_cast<uint8_t>(block[i]);
+    if (b & 0x80) {  // indexed
+      uint64_t idx = 0;
+      if (!read_int(7, idx)) return false;
+      Header h;
+      if (idx >= 1 && idx <= 61) {
+        h.name = kStaticNames[idx];
+        h.value = kStaticValues[idx];
+      } else {
+        h.name = "<dynamic-" + std::to_string(idx) + ">";
+      }
+      out.push_back(std::move(h));
+    } else if ((b & 0xe0) == 0x20) {  // dynamic table size update
+      uint64_t sz = 0;
+      if (!read_int(5, sz)) return false;
+    } else {  // literal (incremental 01, without 0000, never 0001)
+      int prefix = (b & 0xc0) == 0x40 ? 6 : 4;
+      uint64_t idx = 0;
+      if (!read_int(prefix, idx)) return false;
+      Header h;
+      bool name_huff = false;
+      if (idx == 0) {
+        if (!read_str(h.name, name_huff)) return false;
+      } else if (idx <= 61) {
+        h.name = kStaticNames[idx];
+      } else {
+        h.name = "<dynamic-" + std::to_string(idx) + ">";
+      }
+      if (!read_str(h.value, h.huffman_value)) return false;
+      if (name_huff) h.name = "<huffman>";  // opaque name: can't match it
+      out.push_back(std::move(h));
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+CallResult unary_call(const std::string& host, int port, const std::string& path,
+                      const std::string& message, int timeout_ms) {
+  CallResult result;
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  auto expired = [&] { return std::chrono::steady_clock::now() > deadline; };
+  try {
+    Sock sock;
+    sock.fd = dial(host, port, timeout_ms);
+
+    // Connection preface + SETTINGS: table size 0 (no dynamic HPACK state
+    // for peers to reference), push off.
+    std::string out("PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n");
+    std::string settings;
+    auto put_setting = [&](uint16_t id, uint32_t v) {
+      settings.push_back(static_cast<char>(id >> 8));
+      settings.push_back(static_cast<char>(id & 0xff));
+      for (int s = 24; s >= 0; s -= 8) settings.push_back(static_cast<char>((v >> s) & 0xff));
+    };
+    put_setting(0x1, 0);  // HEADER_TABLE_SIZE
+    put_setting(0x2, 0);  // ENABLE_PUSH
+    out += frame_header(settings.size(), kFrameSettings, 0, 0) + settings;
+
+    // HEADERS (stream 1): gRPC request pseudo-headers + metadata.
+    std::string hb;
+    hpack_literal(hb, ":method", "POST");
+    hpack_literal(hb, ":scheme", "http");
+    hpack_literal(hb, ":path", path);
+    hpack_literal(hb, ":authority", host + ":" + std::to_string(port));
+    hpack_literal(hb, "te", "trailers");
+    hpack_literal(hb, "content-type", "application/grpc");
+    hpack_literal(hb, "user-agent", "tpu-pruner-otlp/1.0");
+    out += frame_header(hb.size(), kFrameHeaders, kFlagEndHeaders, 1) + hb;
+    sock.write_all(out.data(), out.size());
+
+    // gRPC message frame: compressed flag 0 + 4-byte BE length + payload.
+    std::string body(5, '\0');
+    uint32_t mlen = static_cast<uint32_t>(message.size());
+    body[1] = static_cast<char>((mlen >> 24) & 0xff);
+    body[2] = static_cast<char>((mlen >> 16) & 0xff);
+    body[3] = static_cast<char>((mlen >> 8) & 0xff);
+    body[4] = static_cast<char>(mlen & 0xff);
+    body += message;
+
+    // DATA with flow control: default 65535-byte connection and stream
+    // windows, 16384 max frame until the server raises them (we keep the
+    // defaults regardless — conservative is fine for telemetry sizes).
+    int64_t conn_window = 65535, stream_window = 65535;
+    size_t sent = 0;
+    bool stream_closed = false;
+    std::vector<Header> headers;
+    std::string header_block;
+    bool collecting_headers = false;
+
+    auto pump_one_frame = [&]() {
+      char fh[9];
+      sock.read_exact(fh, 9);
+      size_t len = (static_cast<uint8_t>(fh[0]) << 16) |
+                   (static_cast<uint8_t>(fh[1]) << 8) | static_cast<uint8_t>(fh[2]);
+      uint8_t type = static_cast<uint8_t>(fh[3]);
+      uint8_t flags = static_cast<uint8_t>(fh[4]);
+      uint32_t stream = ((static_cast<uint8_t>(fh[5]) & 0x7f) << 24) |
+                        (static_cast<uint8_t>(fh[6]) << 16) |
+                        (static_cast<uint8_t>(fh[7]) << 8) | static_cast<uint8_t>(fh[8]);
+      if (len > (1u << 24)) throw std::runtime_error("h2 frame too large");
+      std::string payload(len, '\0');
+      if (len) sock.read_exact(payload.data(), len);
+
+      switch (type) {
+        case kFrameSettings:
+          if (!(flags & kFlagAck)) {
+            std::string ack = frame_header(0, kFrameSettings, kFlagAck, 0);
+            sock.write_all(ack.data(), ack.size());
+          }
+          break;
+        case kFramePing:
+          if (!(flags & kFlagAck)) {
+            std::string pong = frame_header(8, kFramePing, kFlagAck, 0) + payload;
+            sock.write_all(pong.data(), pong.size());
+          }
+          break;
+        case kFrameWindowUpdate: {
+          if (payload.size() == 4) {
+            uint32_t inc = ((static_cast<uint8_t>(payload[0]) & 0x7f) << 24) |
+                           (static_cast<uint8_t>(payload[1]) << 16) |
+                           (static_cast<uint8_t>(payload[2]) << 8) |
+                           static_cast<uint8_t>(payload[3]);
+            (stream == 0 ? conn_window : stream_window) += inc;
+          }
+          break;
+        }
+        case kFrameRst:
+          throw std::runtime_error("h2 stream reset by server (RST_STREAM)");
+        case kFrameGoaway:
+          throw std::runtime_error("h2 GOAWAY from server");
+        case kFrameHeaders: {
+          std::string_view block(payload);
+          if (flags & kFlagPadded) {
+            if (block.empty()) throw std::runtime_error("h2 PADDED frame without pad length");
+            uint8_t pad = static_cast<uint8_t>(block[0]);
+            block.remove_prefix(1);
+            if (pad <= block.size()) block.remove_suffix(pad);
+          }
+          if (flags & kFlagPriority) block.remove_prefix(block.size() >= 5 ? 5 : block.size());
+          header_block.assign(block);
+          collecting_headers = !(flags & kFlagEndHeaders);
+          if (flags & kFlagEndHeaders) {
+            std::vector<Header> decoded;
+            if (hpack_decode(header_block, decoded))
+              headers.insert(headers.end(), decoded.begin(), decoded.end());
+          }
+          if (flags & kFlagEndStream) stream_closed = true;
+          break;
+        }
+        case kFrameContinuation: {
+          header_block += payload;
+          if (flags & kFlagEndHeaders) {
+            collecting_headers = false;
+            std::vector<Header> decoded;
+            if (hpack_decode(header_block, decoded))
+              headers.insert(headers.end(), decoded.begin(), decoded.end());
+          }
+          break;
+        }
+        case kFrameData:
+          // Response message body (Export*ServiceResponse is empty);
+          // nothing to do — grpc-status arrives in the trailers.
+          if (flags & kFlagEndStream) stream_closed = true;
+          break;
+        default:
+          break;  // PRIORITY, PUSH_PROMISE (disabled), unknown — skip
+      }
+    };
+
+    while (sent < body.size()) {
+      if (expired()) throw std::runtime_error("h2 deadline exceeded during send");
+      int64_t window = std::min(conn_window, stream_window);
+      if (window <= 0) {
+        pump_one_frame();  // wait for WINDOW_UPDATE
+        continue;
+      }
+      size_t chunk = std::min({body.size() - sent, static_cast<size_t>(window),
+                               static_cast<size_t>(16384)});
+      bool last = sent + chunk == body.size();
+      std::string f = frame_header(chunk, kFrameData, last ? kFlagEndStream : 0, 1);
+      f.append(body, sent, chunk);
+      sock.write_all(f.data(), f.size());
+      sent += chunk;
+      conn_window -= static_cast<int64_t>(chunk);
+      stream_window -= static_cast<int64_t>(chunk);
+    }
+
+    // Keep reading past END_STREAM while a header block is split across a
+    // pending CONTINUATION (RFC 7540 §4.3) — the trailers' grpc-status
+    // may live there.
+    while (!stream_closed || collecting_headers) {
+      if (expired()) throw std::runtime_error("h2 deadline exceeded awaiting response");
+      pump_one_frame();
+    }
+
+    bool any_huffman = false;
+    for (const Header& h : headers) {
+      if (h.name == ":status") {
+        try {
+          result.http_status = std::stoi(h.value);
+        } catch (const std::exception&) {
+        }
+      } else if (h.name == "grpc-status" && !h.huffman_value) {
+        try {
+          result.grpc_status = std::stoi(h.value);
+        } catch (const std::exception&) {
+        }
+      } else if (h.name == "grpc-message" && !h.huffman_value) {
+        result.grpc_message = h.value;
+      }
+      if (h.huffman_value) any_huffman = true;
+    }
+    if (result.grpc_status >= 0) {
+      result.ok = result.grpc_status == 0;
+      if (!result.ok && result.grpc_message.empty())
+        result.grpc_message = "grpc-status " + std::to_string(result.grpc_status);
+    } else if (result.http_status == 200 && any_huffman) {
+      // Trailers present but huffman-coded beyond this decoder's scope:
+      // a clean END_STREAM on a 200 without RST is success in practice.
+      result.ok = true;
+      result.status_undecoded = true;
+    } else {
+      result.error = "no grpc-status in trailers (HTTP " +
+                     std::to_string(result.http_status) + ")";
+    }
+  } catch (const std::exception& e) {
+    result.error = e.what();
+    result.ok = false;
+  }
+  return result;
+}
+
+}  // namespace tpupruner::otlp_grpc

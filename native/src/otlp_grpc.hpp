@@ -1,0 +1,71 @@
+// OTLP/gRPC transport: hand-rolled protobuf encoding of the two OTLP
+// export requests plus a minimal unary gRPC client over plaintext HTTP/2
+// (h2c with prior knowledge).
+//
+// The reference's `otel` feature exports OTLP over gRPC and its deploy
+// example points OTEL_EXPORTER_OTLP_ENDPOINT at :4317, the gRPC port
+// (gpu-pruner/src/main.rs:146-155, README.md:92-98). Rounds 1-3 spoke
+// OTLP/HTTP JSON only and could merely warn; this module closes the gap
+// for the common in-cluster case — a plaintext collector gRPC listener —
+// selected via OTEL_EXPORTER_OTLP_PROTOCOL=grpc (OTEL spec env).
+//
+// Scope, deliberately: unary calls, h2c only (the dlopen'd TLS shim has
+// no ALPN, which gRPC-over-TLS servers require — https gRPC endpoints
+// are rejected at startup with a pointed message), HPACK decoding of the
+// static table + literal strings (we advertise SETTINGS_HEADER_TABLE_SIZE
+// 0 so conformant peers never reference a dynamic table entry; huffman-
+// coded strings are treated as opaque and only prevent reading that one
+// header's text, not the call).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <string_view>
+#include <vector>
+
+#include "otlp.hpp"
+#include "tpupruner/log.hpp"
+
+namespace tpupruner::otlp_grpc {
+
+// ── protobuf wire-format writer (public for native unit tests) ──────────
+namespace pb {
+
+void put_varint(std::string& out, uint64_t v);
+// field numbers/wire types per protobuf encoding: tag = field<<3 | type
+void put_varint_field(std::string& out, int field, uint64_t v);
+void put_fixed64_field(std::string& out, int field, uint64_t v);
+void put_bytes_field(std::string& out, int field, std::string_view bytes);
+
+}  // namespace pb
+
+// opentelemetry.proto.collector.metrics.v1.ExportMetricsServiceRequest
+std::string encode_metrics_request(const std::map<std::string, log::Counter>& counters,
+                                   int64_t start_nanos, int64_t now_nanos);
+// opentelemetry.proto.collector.trace.v1.ExportTraceServiceRequest
+std::string encode_traces_request(const std::vector<otlp::FinishedSpan>& spans);
+
+// gRPC request paths for the two services.
+inline constexpr const char* kMetricsPath =
+    "/opentelemetry.proto.collector.metrics.v1.MetricsService/Export";
+inline constexpr const char* kTracesPath =
+    "/opentelemetry.proto.collector.trace.v1.TraceService/Export";
+
+struct CallResult {
+  bool ok = false;           // grpc-status 0 (or clean close, see below)
+  int http_status = 0;       // :status pseudo-header, 0 if never seen
+  int grpc_status = -1;      // -1 = absent/undecodable
+  std::string grpc_message;  // grpc-message trailer when readable
+  std::string error;         // transport-level failure, empty on success
+  // Trailers arrived but every candidate grpc-status was huffman-coded:
+  // ok is then inferred from a clean END_STREAM + :status 200.
+  bool status_undecoded = false;
+};
+
+// One unary gRPC call (h2c). `message` is the serialized protobuf; the
+// 5-byte gRPC frame header is added internally. Never throws.
+CallResult unary_call(const std::string& host, int port, const std::string& path,
+                      const std::string& message, int timeout_ms);
+
+}  // namespace tpupruner::otlp_grpc

@@ -1,0 +1,176 @@
+// Protobuf wire-format units for the OTLP/gRPC transport (otlp_grpc.cpp).
+// Golden bytes are hand-computed from the protobuf encoding rules so the
+// writer is checked against the spec, not against itself.
+#include "testing.hpp"
+
+#include "../src/otlp_grpc.hpp"
+
+using tpupruner::log::Counter;
+using tpupruner::otlp::FinishedSpan;
+namespace pb = tpupruner::otlp_grpc::pb;
+
+namespace {
+
+std::string hex(const std::string& s) {
+  static const char* d = "0123456789abcdef";
+  std::string out;
+  for (unsigned char c : s) {
+    out.push_back(d[c >> 4]);
+    out.push_back(d[c & 0xf]);
+  }
+  return out;
+}
+
+// Minimal generic protobuf reader: returns (field, wiretype, payload)
+// triples of one message level. Independent re-implementation of the wire
+// rules used to cross-check the writer.
+struct Field {
+  int number;
+  int wire;
+  uint64_t varint = 0;
+  std::string bytes;
+};
+
+std::vector<Field> parse(const std::string& buf) {
+  std::vector<Field> out;
+  size_t i = 0;
+  auto varint = [&]() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (i < buf.size()) {
+      uint8_t b = static_cast<uint8_t>(buf[i++]);
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    return v;
+  };
+  while (i < buf.size()) {
+    uint64_t tag = varint();
+    Field f;
+    f.number = static_cast<int>(tag >> 3);
+    f.wire = static_cast<int>(tag & 7);
+    if (f.wire == 0) {
+      f.varint = varint();
+    } else if (f.wire == 1) {
+      for (int k = 0; k < 8; ++k) f.varint |= static_cast<uint64_t>(static_cast<uint8_t>(buf[i++])) << (8 * k);
+    } else if (f.wire == 2) {
+      uint64_t len = varint();
+      f.bytes = buf.substr(i, len);
+      i += len;
+    }
+    out.push_back(std::move(f));
+  }
+  return out;
+}
+
+const Field* find(const std::vector<Field>& fs, int number) {
+  for (const Field& f : fs)
+    if (f.number == number) return &f;
+  return nullptr;
+}
+
+}  // namespace
+
+TP_TEST(pb_varint_golden) {
+  std::string out;
+  pb::put_varint(out, 0);
+  pb::put_varint(out, 1);
+  pb::put_varint(out, 127);
+  pb::put_varint(out, 128);
+  pb::put_varint(out, 300);
+  TP_CHECK_EQ(hex(out), "00017f8001ac02");
+}
+
+TP_TEST(pb_fields_golden) {
+  std::string out;
+  pb::put_varint_field(out, 1, 150);       // tag 0x08, varint 0x9601
+  TP_CHECK_EQ(hex(out), "089601");
+  out.clear();
+  pb::put_bytes_field(out, 2, "testing");  // tag 0x12, len 7
+  TP_CHECK_EQ(hex(out), "120774657374696e67");
+  out.clear();
+  pb::put_fixed64_field(out, 3, 0x0102030405060708ull);  // tag 0x19, LE bytes
+  TP_CHECK_EQ(hex(out), "190807060504030201");
+}
+
+TP_TEST(metrics_request_shape) {
+  std::map<std::string, Counter> counters;
+  counters["query_successes"] = Counter{7, false};
+  counters["query_returned_candidates"] = Counter{42, true};
+  std::string req = tpupruner::otlp_grpc::encode_metrics_request(counters, 1000, 2000);
+
+  auto top = parse(req);
+  const Field* rm = find(top, 1);  // resource_metrics
+  TP_CHECK(rm && rm->wire == 2);
+  auto rm_fields = parse(rm->bytes);
+  const Field* resource = find(rm_fields, 1);
+  TP_CHECK(resource != nullptr);
+  // Resource.attributes[0] = KeyValue{service.name, tpu-pruner}
+  auto res_fields = parse(resource->bytes);
+  auto kv = parse(find(res_fields, 1)->bytes);
+  TP_CHECK_EQ(find(kv, 1)->bytes, "service.name");
+  TP_CHECK_EQ(parse(find(kv, 2)->bytes)[0].bytes, "tpu-pruner");
+
+  const Field* sm = find(rm_fields, 2);  // scope_metrics
+  TP_CHECK(sm != nullptr);
+  auto sm_fields = parse(sm->bytes);
+  // two metrics, map-ordered: query_returned_candidates (gauge) first
+  std::vector<const Field*> metrics;
+  for (const Field& f : sm_fields)
+    if (f.number == 2) metrics.push_back(&f);
+  TP_CHECK_EQ(metrics.size(), static_cast<size_t>(2));
+
+  auto m0 = parse(metrics[0]->bytes);
+  TP_CHECK_EQ(find(m0, 1)->bytes, "tpu_pruner.query_returned_candidates");
+  const Field* gauge = find(m0, 5);
+  TP_CHECK(gauge != nullptr);          // gauge kind
+  TP_CHECK(find(m0, 7) == nullptr);    // not a sum
+  auto dp0 = parse(find(parse(gauge->bytes), 1)->bytes);
+  TP_CHECK_EQ(find(dp0, 2)->varint, static_cast<uint64_t>(1000));  // start (fixed64)
+  TP_CHECK_EQ(find(dp0, 3)->varint, static_cast<uint64_t>(2000));  // now
+  TP_CHECK_EQ(find(dp0, 6)->varint, static_cast<uint64_t>(42));    // as_int
+
+  auto m1 = parse(metrics[1]->bytes);
+  TP_CHECK_EQ(find(m1, 1)->bytes, "tpu_pruner.query_successes");
+  const Field* sum = find(m1, 7);
+  TP_CHECK(sum != nullptr);
+  auto sum_fields = parse(sum->bytes);
+  TP_CHECK_EQ(find(sum_fields, 2)->varint, static_cast<uint64_t>(2));  // CUMULATIVE
+  TP_CHECK_EQ(find(sum_fields, 3)->varint, static_cast<uint64_t>(1));  // monotonic
+  auto dp1 = parse(find(sum_fields, 1)->bytes);
+  TP_CHECK_EQ(find(dp1, 6)->varint, static_cast<uint64_t>(7));
+}
+
+TP_TEST(traces_request_shape) {
+  FinishedSpan fs;
+  fs.name = "cycle";
+  fs.trace_id = "0102030405060708090a0b0c0d0e0f10";
+  fs.span_id = "1112131415161718";
+  fs.parent_span_id = "";
+  fs.start_nanos = 111;
+  fs.end_nanos = 222;
+  fs.str_attrs = {{"mode", "scale-down"}};
+  fs.int_attrs = {{"candidates", 5}};
+  fs.error = true;
+  fs.error_message = "boom";
+
+  std::string req = tpupruner::otlp_grpc::encode_traces_request({fs});
+  auto rs = parse(find(parse(req), 1)->bytes);     // resource_spans
+  auto ss = parse(find(rs, 2)->bytes);             // scope_spans
+  auto span = parse(find(ss, 2)->bytes);           // spans[0]
+  TP_CHECK_EQ(hex(find(span, 1)->bytes), "0102030405060708090a0b0c0d0e0f10");
+  TP_CHECK_EQ(hex(find(span, 2)->bytes), "1112131415161718");
+  TP_CHECK(find(span, 4) == nullptr);  // no parent -> field omitted
+  TP_CHECK_EQ(find(span, 5)->bytes, "cycle");
+  TP_CHECK_EQ(find(span, 7)->varint, static_cast<uint64_t>(111));
+  TP_CHECK_EQ(find(span, 8)->varint, static_cast<uint64_t>(222));
+  // two attributes (one string, one int)
+  int attrs = 0;
+  for (const Field& f : span)
+    if (f.number == 9) ++attrs;
+  TP_CHECK_EQ(attrs, 2);
+  auto status = parse(find(span, 15)->bytes);
+  TP_CHECK_EQ(find(status, 2)->bytes, "boom");
+  TP_CHECK_EQ(find(status, 3)->varint, static_cast<uint64_t>(2));
+}

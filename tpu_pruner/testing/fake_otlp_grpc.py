@@ -1,0 +1,219 @@
+"""Fake OTLP/gRPC collector: a minimal plaintext HTTP/2 (h2c) server.
+
+Speaks just enough of RFC 7540 + gRPC framing to receive the daemon's
+unary Export calls (native/src/otlp_grpc.cpp) hermetically: connection
+preface, SETTINGS exchange, HEADERS decoded from the client's
+literal-without-indexing HPACK, DATA reassembled into the gRPC message,
+and a 200 + empty Export*ServiceResponse + grpc-status trailers reply —
+all literal, non-huffman, so the client's HPACK-subset decoder reads it
+deterministically. A generic protobuf walker (`pb_fields`) lets tests
+assert on the received request bytes without a protobuf dependency.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+FRAME_DATA, FRAME_HEADERS, FRAME_SETTINGS, FRAME_PING = 0x0, 0x1, 0x4, 0x6
+FRAME_WINDOW_UPDATE = 0x8
+FLAG_END_STREAM, FLAG_ACK, FLAG_END_HEADERS = 0x1, 0x1, 0x4
+
+
+def pb_fields(buf: bytes):
+    """Generic protobuf decode: list of (field_number, wire_type, value).
+
+    wire 0 -> int, wire 1 -> int (little-endian fixed64), wire 2 -> bytes.
+    """
+    out, i = [], 0
+
+    def varint():
+        nonlocal i
+        v = shift = 0
+        while True:
+            b = buf[i]
+            i += 1
+            v |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return v
+            shift += 7
+
+    while i < len(buf):
+        tag = varint()
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            out.append((field, 0, varint()))
+        elif wire == 1:
+            out.append((field, 1, struct.unpack("<Q", buf[i:i + 8])[0]))
+            i += 8
+        elif wire == 2:
+            ln = varint()
+            out.append((field, 2, bytes(buf[i:i + ln])))
+            i += ln
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+    return out
+
+
+def pb_find(fields, number):
+    return [v for f, _, v in fields if f == number]
+
+
+def _hpack_literal(name: bytes, value: bytes) -> bytes:
+    """Literal without indexing, new name, raw strings (RFC 7541 §6.2.2)."""
+    assert len(name) < 127 and len(value) < 127
+    return b"\x00" + bytes([len(name)]) + name + bytes([len(value)]) + value
+
+
+def _hpack_decode_literals(block: bytes):
+    """Decode the client's own header encoding (all literal, non-huffman)."""
+    headers, i = [], 0
+    while i < len(block):
+        b = block[i]
+        if b & 0x80 or (b & 0xE0) == 0x20:  # indexed / table-size update
+            i += 1
+            continue
+        i += 1  # literal marker (name index 0 assumed — our client's shape)
+        nlen = block[i] & 0x7F
+        i += 1
+        name = block[i:i + nlen]
+        i += nlen
+        vlen = block[i] & 0x7F
+        i += 1
+        value = block[i:i + vlen]
+        i += vlen
+        headers.append((name.decode(), value.decode()))
+    return headers
+
+
+def _frame(ftype: int, flags: int, stream: int, payload: bytes) -> bytes:
+    return struct.pack("!I", len(payload))[1:] + bytes([ftype, flags]) + \
+        struct.pack("!I", stream & 0x7FFFFFFF) + payload
+
+
+class FakeGrpcCollector:
+    """One request per connection (matching the client's dial-per-export)."""
+
+    def __init__(self, grpc_status: int = 0, grpc_message: str = "",
+                 split_trailers: bool = False):
+        self.grpc_status = grpc_status
+        self.grpc_message = grpc_message
+        # Send trailers as HEADERS(END_STREAM) + CONTINUATION(END_HEADERS)
+        # (RFC 7540 §4.3) — exercises the client's split-block path.
+        self.split_trailers = split_trailers
+        self.requests = []  # (path, message_bytes, headers list)
+        self._sock: socket.socket | None = None
+        self._stop = threading.Event()
+
+    def start(self) -> int:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        return self._sock.getsockname()[1]
+
+    @property
+    def url(self) -> str:
+        assert self._sock is not None
+        return f"http://127.0.0.1:{self._sock.getsockname()[1]}"
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock:
+            self._sock.close()
+            self._sock = None
+
+    # ── internals ──────────────────────────────────────────────────────
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        conn.settimeout(10)
+        try:
+            buf = b""
+            while len(buf) < len(PREFACE):
+                buf += conn.recv(4096)
+            assert buf.startswith(PREFACE), "missing h2 preface"
+            buf = buf[len(PREFACE):]
+
+            # Server SETTINGS first (RFC 7540 §3.5), defaults are fine.
+            conn.sendall(_frame(FRAME_SETTINGS, 0, 0, b""))
+
+            headers, data, path = [], b"", ""
+            while True:
+                while len(buf) < 9:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                length = int.from_bytes(buf[:3], "big")
+                ftype, flags = buf[3], buf[4]
+                stream = int.from_bytes(buf[5:9], "big") & 0x7FFFFFFF
+                while len(buf) < 9 + length:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                payload = buf[9:9 + length]
+                buf = buf[9 + length:]
+
+                if ftype == FRAME_SETTINGS and not flags & FLAG_ACK:
+                    conn.sendall(_frame(FRAME_SETTINGS, FLAG_ACK, 0, b""))
+                elif ftype == FRAME_PING and not flags & FLAG_ACK:
+                    conn.sendall(_frame(FRAME_PING, FLAG_ACK, 0, payload))
+                elif ftype == FRAME_HEADERS:
+                    headers = _hpack_decode_literals(payload)
+                    path = dict(headers).get(":path", "")
+                elif ftype == FRAME_DATA:
+                    data += payload
+                    if flags & FLAG_END_STREAM:
+                        break
+                if ftype == FRAME_HEADERS and flags & FLAG_END_STREAM:
+                    break  # request without body (not our client, but legal)
+
+            # gRPC frame: flag byte + BE32 length + protobuf message.
+            message = b""
+            if len(data) >= 5:
+                (mlen,) = struct.unpack("!I", data[1:5])
+                message = data[5:5 + mlen]
+            self.requests.append((path, message, headers))
+
+            resp_headers = _hpack_literal(b":status", b"200") + \
+                _hpack_literal(b"content-type", b"application/grpc")
+            conn.sendall(_frame(FRAME_HEADERS, FLAG_END_HEADERS, stream, resp_headers))
+            # Empty Export*ServiceResponse message.
+            conn.sendall(_frame(FRAME_DATA, 0, stream, b"\x00\x00\x00\x00\x00"))
+            trailers = _hpack_literal(b"grpc-status", str(self.grpc_status).encode())
+            if self.grpc_message:
+                trailers += _hpack_literal(b"grpc-message", self.grpc_message.encode())
+            if self.split_trailers:
+                FRAME_CONTINUATION = 0x9
+                conn.sendall(_frame(FRAME_HEADERS, FLAG_END_STREAM, stream, b""))
+                conn.sendall(_frame(FRAME_CONTINUATION, FLAG_END_HEADERS,
+                                    stream, trailers))
+            else:
+                conn.sendall(_frame(FRAME_HEADERS,
+                                    FLAG_END_HEADERS | FLAG_END_STREAM, stream,
+                                    trailers))
+            # Half-close and drain: a bare close() while the client's late
+            # SETTINGS ACK is in flight RSTs the connection and discards
+            # the buffered trailers on the client side. FIN + read-to-EOF
+            # lets the client consume everything first.
+            conn.shutdown(socket.SHUT_WR)
+            conn.settimeout(2)
+            while conn.recv(4096):
+                pass
+        except Exception:
+            pass  # connection-level failures surface as client errors
+        finally:
+            conn.close()
