@@ -386,6 +386,16 @@ bool hpack_decode(std::string_view block, std::vector<Header>& out) {
 
 }  // namespace
 
+bool hpack_decode_for_test(
+    std::string_view block,
+    std::vector<std::tuple<std::string, std::string, bool>>& out) {
+  std::vector<Header> headers;
+  if (!hpack_decode(block, headers)) return false;
+  for (Header& h : headers)
+    out.emplace_back(std::move(h.name), std::move(h.value), h.huffman_value);
+  return true;
+}
+
 CallResult unary_call(const std::string& host, int port, const std::string& path,
                       const std::string& message, int timeout_ms) {
   CallResult result;

@@ -22,6 +22,7 @@
 #include <map>
 #include <string>
 #include <string_view>
+#include <tuple>
 #include <vector>
 
 #include "otlp.hpp"
@@ -67,5 +68,14 @@ struct CallResult {
 // 5-byte gRPC frame header is added internally. Never throws.
 CallResult unary_call(const std::string& host, int port, const std::string& path,
                       const std::string& message, int timeout_ms);
+
+// Test/fuzz hook for the response-path HPACK subset decoder (static table
+// + literals; huffman-coded strings surface as "<huffman>" names or are
+// flagged via the bool). Decodes server-controlled bytes, so the contract
+// is total: returns false on malformed input, never crashes or throws.
+// (name, value, value_is_huffman) per decoded header.
+bool hpack_decode_for_test(
+    std::string_view block,
+    std::vector<std::tuple<std::string, std::string, bool>>& out);
 
 }  // namespace tpupruner::otlp_grpc

@@ -30,6 +30,7 @@
 #include <string>
 #include <vector>
 
+#include "../src/otlp_grpc.hpp"
 #include "tpupruner/core.hpp"
 #include "tpupruner/json.hpp"
 #include "tpupruner/metrics.hpp"
@@ -84,6 +85,14 @@ const std::vector<std::string>& seeds() {
       "0x10",
       "2026-07-28T10:00:00Z",
       "2026-07-28T10:00:00.123456+05:30",
+      // HPACK header blocks (invariant 5, the OTLP/gRPC response path):
+      // literal-without-indexing :status 200 + grpc-status 0 (the fake
+      // collector's shape), static-indexed :status 200 (0x88), literal
+      // with incremental indexing + huffman flag, multi-byte prefix int.
+      std::string("\x00\x07:status\x03""200\x00\x0bgrpc-status\x01""0", 28),
+      std::string("\x88\x00\x0bgrpc-status\x01""0", 16),
+      std::string("\x40\x0bgrpc-status\x83\x30\x31\x32", 17),
+      std::string("\x7f\x80\x01zzzzzz", 9),
   };
   return kSeeds;
 }
@@ -141,6 +150,14 @@ int run(uint64_t iterations, uint64_t seed) {
 
     // invariant 4: timestamp parser is total
     (void)tpupruner::util::parse_rfc3339(input);
+
+    // invariant 5: the HPACK response decoder is total on arbitrary
+    // server-controlled bytes (otlp_grpc.cpp; the OTLP/gRPC response
+    // path) — false on malformed input, never a crash or a throw
+    {
+      std::vector<std::tuple<std::string, std::string, bool>> headers;
+      (void)tpupruner::otlp_grpc::hpack_decode_for_test(input, headers);
+    }
 
     Value v;
     try {

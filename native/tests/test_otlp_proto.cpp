@@ -174,3 +174,68 @@ TP_TEST(traces_request_shape) {
   TP_CHECK_EQ(find(status, 2)->bytes, "boom");
   TP_CHECK_EQ(find(status, 3)->varint, static_cast<uint64_t>(2));
 }
+
+// ── HPACK response-path decoder (otlp_grpc.cpp hpack_decode) ──────────────
+
+using HpackHeaders = std::vector<std::tuple<std::string, std::string, bool>>;
+
+TP_TEST(hpack_literal_without_indexing) {
+  // the fake collector's exact shape: 0x00, len-prefixed raw strings
+  std::string block("\x00\x07:status\x03""200\x00\x0bgrpc-status\x01""0", 28);
+  HpackHeaders h;
+  TP_CHECK(tpupruner::otlp_grpc::hpack_decode_for_test(block, h));
+  TP_CHECK_EQ(h.size(), static_cast<size_t>(2));
+  TP_CHECK_EQ(std::get<0>(h[0]), ":status");
+  TP_CHECK_EQ(std::get<1>(h[0]), "200");
+  TP_CHECK_EQ(std::get<0>(h[1]), "grpc-status");
+  TP_CHECK_EQ(std::get<1>(h[1]), "0");
+  TP_CHECK(!std::get<2>(h[1]));
+}
+
+TP_TEST(hpack_static_indexed_and_name_index) {
+  // 0x88 = indexed static 8 (:status 200); 0x48 = literal incremental
+  // with static name index 8 (:status) + raw value "404"
+  std::string block("\x88\x48\x03""404", 6);
+  HpackHeaders h;
+  TP_CHECK(tpupruner::otlp_grpc::hpack_decode_for_test(block, h));
+  TP_CHECK_EQ(h.size(), static_cast<size_t>(2));
+  TP_CHECK_EQ(std::get<0>(h[0]), ":status");
+  TP_CHECK_EQ(std::get<1>(h[0]), "200");
+  TP_CHECK_EQ(std::get<0>(h[1]), ":status");
+  TP_CHECK_EQ(std::get<1>(h[1]), "404");
+}
+
+TP_TEST(hpack_huffman_value_flagged_opaque) {
+  // literal new name "x", value huffman-flagged (0x83 = H bit + len 3)
+  std::string block("\x00\x01x\x83\x30\x31\x32", 7);
+  HpackHeaders h;
+  TP_CHECK(tpupruner::otlp_grpc::hpack_decode_for_test(block, h));
+  TP_CHECK_EQ(h.size(), static_cast<size_t>(1));
+  TP_CHECK_EQ(std::get<0>(h[0]), "x");
+  TP_CHECK(std::get<2>(h[0]));  // flagged, not decoded
+}
+
+TP_TEST(hpack_dynamic_size_update_skipped) {
+  // 0x20 = table size update to 0, then one literal
+  std::string block("\x20\x00\x01x\x01y", 6);
+  HpackHeaders h;
+  TP_CHECK(tpupruner::otlp_grpc::hpack_decode_for_test(block, h));
+  TP_CHECK_EQ(h.size(), static_cast<size_t>(1));
+  TP_CHECK_EQ(std::get<0>(h[0]), "x");
+  TP_CHECK_EQ(std::get<1>(h[0]), "y");
+}
+
+TP_TEST(hpack_malformed_rejected_not_crash) {
+  HpackHeaders h;
+  // truncated length prefix
+  TP_CHECK(!tpupruner::otlp_grpc::hpack_decode_for_test(std::string("\x00\x7f", 2), h));
+  // string length past end of block
+  TP_CHECK(!tpupruner::otlp_grpc::hpack_decode_for_test(std::string("\x00\x10x", 3), h));
+  // unterminated multi-byte integer
+  TP_CHECK(!tpupruner::otlp_grpc::hpack_decode_for_test(
+      std::string("\x7f\x80\x80\x80\x80\x80\x80\x80\x80\x80\x80", 11), h));
+  // empty block is valid (no headers)
+  HpackHeaders h2;
+  TP_CHECK(tpupruner::otlp_grpc::hpack_decode_for_test("", h2));
+  TP_CHECK_EQ(h2.size(), static_cast<size_t>(0));
+}
