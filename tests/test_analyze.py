@@ -97,3 +97,26 @@ def test_analyze_sharded_matches_single_device(built, tmp_path):
     assert sharded["reclaimable_slices"] == single["reclaimable_slices"] == ["ml/idle"]
     assert sharded["idle_chips"] == single["idle_chips"] == 4
     assert sharded["num_chips"] == 11
+
+
+def test_analyze_quantize_matches_f32(built, tmp_path):
+    """--quantize (int8 storage, contiguous cumsum single-device, psum
+    sharded) reproduces the f32 verdicts, including an interleaved dump
+    order that exercises the load-time slice grouping."""
+    doc = {"hbm_threshold": 0.05, "chips": [
+        # deliberately interleaved slices: load_fleet must group them
+        chip("ml/idle", [0.0] * 6, hbm=[0.0] * 6),
+        chip("ml/busy", [0.0, 0.7, 0.0], hbm=[0.1] * 3),
+        chip("ml/idle", [0.0] * 6, hbm=[0.0] * 6),
+        chip("ml/hbm-active", [0.0] * 6, hbm=[0.2] * 6),
+        chip("ml/busy", [0.0] * 3, hbm=[0.1] * 3),
+        chip("ml/idle", [0.0] * 6, hbm=[0.0] * 6),
+    ]}
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    f32, _ = run_analyze(tmp_path, doc, env_extra=env)
+    q, _ = run_analyze(tmp_path, doc, "--quantize", env_extra=env)
+    q_sharded, _ = run_analyze(tmp_path, doc, "--quantize", "--shard",
+                               env_extra=env)
+    assert q["reclaimable_slices"] == f32["reclaimable_slices"] == ["ml/idle"]
+    assert q_sharded["reclaimable_slices"] == ["ml/idle"]
+    assert q["idle_chips"] == q_sharded["idle_chips"] == f32["idle_chips"] == 3

@@ -153,6 +153,37 @@ def test_sharded_cross_shard_veto():
     assert not bool(verdicts[0])
 
 
+def test_sharded_q_matches_single_device_q():
+    """evaluate_fleet_sharded_q ≡ evaluate_fleet_q across the 8-device
+    mesh, including the -1-sentinel padding path (C=100 pads to 104)."""
+    from tpu_pruner.policy import (
+        evaluate_fleet_q, evaluate_fleet_sharded_q, quantize_fleet_inputs)
+
+    C, S = 100, 10
+    inputs, _ = make_example_fleet(num_chips=C, num_slices=S, idle_fraction=0.5)
+    q = quantize_fleet_inputs(inputs)
+    ref_v, ref_c = evaluate_fleet_q(*q, num_slices=S)
+    sh_v, sh_c = evaluate_fleet_sharded_q(q[0], q[1], q[2], q[3], q[4],
+                                          num_slices=S)
+    np.testing.assert_array_equal(np.asarray(sh_v), np.asarray(ref_v))
+    np.testing.assert_array_equal(np.asarray(sh_c), np.asarray(ref_c))
+
+
+def test_sharded_q_cross_shard_veto():
+    """One busy chip in the last shard vetoes a slice spanning all devices
+    in the quantized sharded evaluator (the psum multi-host gate)."""
+    from tpu_pruner.policy import evaluate_fleet_sharded_q, quantize_fleet_inputs
+
+    C, S = 64, 1
+    inputs, _ = make_example_fleet(num_chips=C, num_slices=S, idle_fraction=1.0)
+    tc = np.asarray(inputs[0]).copy()
+    tc[C - 1, 0] = 0.9
+    q = quantize_fleet_inputs((jnp.asarray(tc), *inputs[1:]))
+    verdicts, _ = evaluate_fleet_sharded_q(q[0], q[1], q[2], q[3], q[4],
+                                           num_slices=S)
+    assert not bool(verdicts[0])
+
+
 # ── pallas kernel parity (interpret mode on CPU; Mosaic on TPU) ──────────
 
 

@@ -62,7 +62,14 @@ def load_fleet(doc: dict):
             hbm[i, T - len(h):] = h
         age[i] = float(c.get("pod_age_s", 0))
         slice_id[i] = slice_index[c["slice"]]
-    return (tc, hbm, valid, age, slice_id), slice_names
+
+    # Group chips by slice (stable sort): enables the contiguous cumsum
+    # slice reduction (engine.py, 12x faster than the scatter at fleet
+    # scale). All outputs below are per-slice aggregates, so the
+    # permutation is invisible to callers.
+    order = np.argsort(slice_id, kind="stable")
+    return (tc[order], hbm[order], valid[order], age[order],
+            slice_id[order]), slice_names
 
 
 def main(argv=None) -> int:
@@ -78,12 +85,16 @@ def main(argv=None) -> int:
                         help="shard the chip axis over all visible JAX devices "
                              "(pads chips to a device multiple; verdicts are "
                              "identical to the single-device path)")
+    parser.add_argument("--quantize", action="store_true",
+                        help="evaluate on int8 quantized samples (1%% buckets, "
+                             "4.5x fewer bytes; == 0 idle predicate stays exact, "
+                             "threshold errs only toward rescue)")
     args = parser.parse_args(argv)
 
     doc = json.load(sys.stdin if args.dump == "-" else open(args.dump))
     (tc, hbm, valid, age, slice_id), slice_names = load_fleet(doc)
 
-    from tpu_pruner.policy import PolicyParams, evaluate_fleet
+    from tpu_pruner.policy import PolicyParams
     from tpu_pruner.policy.engine import params_array
 
     params = PolicyParams(
@@ -93,12 +104,34 @@ def main(argv=None) -> int:
                        else float(doc.get("hbm_threshold", 0.0))),
     )
     num_slices = len(slice_names)
-    if args.shard:
-        from tpu_pruner.policy import evaluate_fleet_sharded as eval_fn
+    parr = params_array(params)
+    if args.quantize:
+        from tpu_pruner.policy import quantize_fleet_inputs
+
+        tc_q, hbm_q, age_q, sid_q, parr_q = quantize_fleet_inputs(
+            (tc, hbm, valid, age, slice_id, parr))
+        if args.shard:
+            from tpu_pruner.policy import evaluate_fleet_sharded_q
+
+            verdicts, candidates = evaluate_fleet_sharded_q(
+                tc_q, hbm_q, age_q, sid_q, parr_q, num_slices=num_slices)
+        else:
+            from tpu_pruner.policy import evaluate_fleet_qc, slice_bounds
+
+            verdicts, candidates = evaluate_fleet_qc(
+                tc_q, hbm_q, age_q, slice_bounds(slice_id, num_slices), parr_q)
+    elif args.shard:
+        from tpu_pruner.policy import evaluate_fleet_sharded
+
+        verdicts, candidates = evaluate_fleet_sharded(
+            tc, hbm, valid, age, slice_id, parr, num_slices=num_slices)
     else:
-        eval_fn = evaluate_fleet
-    verdicts, candidates = eval_fn(
-        tc, hbm, valid, age, slice_id, params_array(params), num_slices=num_slices)
+        # load_fleet groups chips by slice, so the single-device default
+        # takes the contiguous cumsum path.
+        from tpu_pruner.policy import evaluate_fleet_c, slice_bounds
+
+        verdicts, candidates = evaluate_fleet_c(
+            tc, hbm, valid, age, slice_bounds(slice_id, num_slices), parr)
     verdicts = np.asarray(verdicts)
     candidates = np.asarray(candidates)
 

@@ -23,8 +23,10 @@ from tpu_pruner.policy.engine import (
     evaluate_fleet_q,
     evaluate_fleet_qc,
     evaluate_fleet_sharded,
+    evaluate_fleet_sharded_q,
     make_example_fleet,
     make_sharded_evaluator,
+    make_sharded_evaluator_q,
     quantize_fleet_inputs,
     quantize_params,
     quantize_samples,
@@ -41,8 +43,10 @@ __all__ = [
     "evaluate_fleet_q",
     "evaluate_fleet_qc",
     "evaluate_fleet_sharded",
+    "evaluate_fleet_sharded_q",
     "make_example_fleet",
     "make_sharded_evaluator",
+    "make_sharded_evaluator_q",
     "quantize_fleet_inputs",
     "quantize_params",
     "quantize_samples",

@@ -270,20 +270,29 @@ def quantize_fleet_inputs(inputs):
     )
 
 
-def make_sharded_evaluator(mesh: Mesh, num_slices: int, axis: str = "fleet"):
-    """Build the mesh-sharded evaluator.
+def _make_sharded_impl(mesh: Mesh, num_slices: int, axis: str, quantized: bool):
+    """Shared body of the two mesh-sharded evaluator builders.
 
     The chip axis is split across `axis`; slice membership freely spans
     shards. Each device computes local per-slice busy/chip counts, then a
     `psum` over the mesh produces the global counts — the cross-host
     reduction a real multi-host slice verdict requires. Slice verdicts are
-    replicated; chip candidacy stays sharded.
+    replicated; chip candidacy stays sharded. The slice reduction keeps
+    segment_sum here — the cumsum trick needs globally contiguous chips,
+    which a sharded chip axis doesn't guarantee per device.
     """
 
-    def local_eval(tc_util, hbm_util, valid, pod_age_s, slice_id, params_arr):
-        candidate = evaluate_chips(
-            tc_util, hbm_util, valid, pod_age_s, params_arr[0], params_arr[1]
-        )
+    def local_eval(*args):
+        if quantized:
+            tc_q, hbm_q, pod_age_s, slice_id, params_arr = args
+            candidate = evaluate_chips_q(
+                tc_q, hbm_q, pod_age_s, params_arr[0], params_arr[1]
+            )
+        else:
+            tc_util, hbm_util, valid, pod_age_s, slice_id, params_arr = args
+            candidate = evaluate_chips(
+                tc_util, hbm_util, valid, pod_age_s, params_arr[0], params_arr[1]
+            )
         busy_local = jax.ops.segment_sum(
             (~candidate).astype(jnp.int32), slice_id, num_segments=num_slices
         )
@@ -294,13 +303,26 @@ def make_sharded_evaluator(mesh: Mesh, num_slices: int, axis: str = "fleet"):
         chips = jax.lax.psum(chips_local, axis)
         return (busy == 0) & (chips > 0), candidate
 
+    num_inputs = 5 if quantized else 6
     sharded = jax.shard_map(
         local_eval,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+        in_specs=tuple([P(axis)] * (num_inputs - 1) + [P()]),
         out_specs=(P(), P(axis)),
     )
     return jax.jit(sharded)
+
+
+def make_sharded_evaluator(mesh: Mesh, num_slices: int, axis: str = "fleet"):
+    """Build the mesh-sharded evaluator (see _make_sharded_impl)."""
+    return _make_sharded_impl(mesh, num_slices, axis, quantized=False)
+
+
+def make_sharded_evaluator_q(mesh: Mesh, num_slices: int, axis: str = "fleet"):
+    """Quantized-storage variant of make_sharded_evaluator: same mesh/psum
+    shape, int8 inputs (engine.py UTIL_SCALE block) — the bandwidth win
+    applies per shard."""
+    return _make_sharded_impl(mesh, num_slices, axis, quantized=True)
 
 
 # Per-call make_sharded_evaluator would re-jit every time (a fresh closure
@@ -310,43 +332,70 @@ def _cached_sharded_evaluator(mesh: Mesh, num_segments: int, axis: str):
     return make_sharded_evaluator(mesh, num_slices=num_segments, axis=axis)
 
 
-def evaluate_fleet_sharded(tc_util, hbm_util, valid, pod_age_s, slice_id, params_arr,
-                           num_slices, mesh: Mesh | None = None, axis: str = "fleet"):
-    """evaluate_fleet over a device mesh, tolerating uneven chip counts.
+@lru_cache(maxsize=None)
+def _cached_sharded_evaluator_q(mesh: Mesh, num_segments: int, axis: str):
+    return make_sharded_evaluator_q(mesh, num_slices=num_segments, axis=axis)
+
+
+def _evaluate_sharded_impl(chip_arrays, pad_values, params_arr, num_slices,
+                           mesh, axis, quantized):
+    """Shared pad-and-dispatch path of the two sharded entry points.
 
     `shard_map` needs the chip axis divisible by the mesh, so chips are
-    padded to a device multiple: padded rows carry valid=False (an
+    padded to a device multiple with verdict-neutral rows (pad_values:
+    valid=False for f32 storage, the -1 invalid sentinel for int8 — an
     all-invalid chip is never a candidate) and a dedicated sentinel slice
     id routed to one extra segment that is sliced off the output — no
-    real verdict can be affected. Results match evaluate_fleet exactly
-    (asserted by tests/test_analyze.py on an 8-device CPU mesh).
+    real verdict can be affected. Padding runs in jnp on device: inputs
+    that already live there (e.g. straight from the device quantizer)
+    must not bounce device→host→device just to be padded.
     """
     if mesh is None:
         devices = jax.devices()
         mesh = Mesh(np.array(devices), axis_names=(axis,))
     n_dev = mesh.devices.size
-    num_chips = tc_util.shape[0]
+    num_chips = chip_arrays[0].shape[0]
     padded = ((num_chips + n_dev - 1) // n_dev) * n_dev
     pad = padded - num_chips
-    tc_util, hbm_util, valid, pod_age_s, slice_id = (
-        np.asarray(tc_util), np.asarray(hbm_util), np.asarray(valid),
-        np.asarray(pod_age_s), np.asarray(slice_id))
+    arrays = [jnp.asarray(x) for x in chip_arrays]
     if pad:
-        tc_util = np.pad(tc_util, ((0, pad), (0, 0)))
-        hbm_util = np.pad(hbm_util, ((0, pad), (0, 0)))
-        valid = np.pad(valid, ((0, pad), (0, 0)))  # False: never candidates
-        pod_age_s = np.pad(pod_age_s, (0, pad))
-        slice_id = np.pad(slice_id, (0, pad), constant_values=num_slices)
+        arrays = [
+            jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1), constant_values=pv)
+            for x, pv in zip(arrays, pad_values)
+        ]
 
     from jax.sharding import NamedSharding
 
-    evaluator = _cached_sharded_evaluator(mesh, num_slices + 1, axis)
+    cache = _cached_sharded_evaluator_q if quantized else _cached_sharded_evaluator
+    evaluator = cache(mesh, num_slices + 1, axis)
     shard = NamedSharding(mesh, P(axis))
-    placed = [jax.device_put(x, shard)
-              for x in (tc_util, hbm_util, valid, pod_age_s, slice_id)]
-    params = jax.device_put(np.asarray(params_arr), NamedSharding(mesh, P()))
+    placed = [jax.device_put(x, shard) for x in arrays]
+    params = jax.device_put(jnp.asarray(params_arr), NamedSharding(mesh, P()))
     verdicts, candidates = evaluator(*placed, params)
     return verdicts[:num_slices], candidates[:num_chips]
+
+
+def evaluate_fleet_sharded(tc_util, hbm_util, valid, pod_age_s, slice_id, params_arr,
+                           num_slices, mesh: Mesh | None = None, axis: str = "fleet"):
+    """evaluate_fleet over a device mesh, tolerating uneven chip counts
+    (_evaluate_sharded_impl). Results match evaluate_fleet exactly
+    (asserted by tests/test_analyze.py on an 8-device CPU mesh)."""
+    return _evaluate_sharded_impl(
+        (tc_util, hbm_util, valid, pod_age_s, slice_id),
+        (0.0, 0.0, False, 0.0, num_slices),
+        params_arr, num_slices, mesh, axis, quantized=False)
+
+
+def evaluate_fleet_sharded_q(tc_q, hbm_q, pod_age_s, slice_id, params_arr_q,
+                             num_slices, mesh: Mesh | None = None,
+                             axis: str = "fleet"):
+    """evaluate_fleet_q over a device mesh (int8 storage, psum verdicts).
+    Results match evaluate_fleet_q exactly (asserted on the 8-device CPU
+    mesh in tests/test_policy.py)."""
+    return _evaluate_sharded_impl(
+        (tc_q, hbm_q, pod_age_s, slice_id),
+        (INVALID_Q, INVALID_Q, 0.0, num_slices),
+        params_arr_q, num_slices, mesh, axis, quantized=True)
 
 
 def make_example_fleet(
