@@ -1,5 +1,7 @@
 #include "otlp.hpp"
 
+#include <cctype>
+
 #include "otlp_grpc.hpp"
 #include "tpupruner/http.hpp"
 #include "tpupruner/json.hpp"
@@ -113,6 +115,58 @@ Exporter::Exporter(std::string endpoint, int interval_ms)
   };
   metrics_grpc_ = signal_grpc("OTEL_EXPORTER_OTLP_METRICS_PROTOCOL");
   traces_grpc_ = signal_grpc("OTEL_EXPORTER_OTLP_TRACES_PROTOCOL");
+
+  // OTEL_EXPORTER_OTLP[_SIGNAL]_HEADERS (OTEL spec): comma-separated
+  // key=value pairs, values percent-decoded (W3C-baggage octets) — how
+  // managed collectors take auth (e.g. "authorization=Bearer%20tok",
+  // "api-key=..."). Applied on both transports; the reference's
+  // opentelemetry-otlp honors the same variables.
+  auto signal_headers = [](const char* signal_var) {
+    std::vector<std::pair<std::string, std::string>> out;
+    std::string raw;
+    if (auto v = util::env(signal_var); v && !v->empty()) raw = *v;
+    else if (auto v = util::env("OTEL_EXPORTER_OTLP_HEADERS"); v && !v->empty()) raw = *v;
+    size_t pos = 0;
+    while (pos <= raw.size()) {
+      size_t comma = raw.find(',', pos);
+      std::string pair = raw.substr(
+          pos, comma == std::string::npos ? std::string::npos : comma - pos);
+      pos = comma == std::string::npos ? raw.size() + 1 : comma + 1;
+      size_t eq = pair.find('=');
+      if (eq == std::string::npos) continue;  // malformed entry: skip, per spec
+      std::string key = util::trim(pair.substr(0, eq));
+      std::string value = util::url_decode(util::trim(pair.substr(eq + 1)));
+      // Decoded octets go verbatim into HTTP/1.1 header lines and HPACK
+      // literals: a CR/LF (or other control char) in the value would split
+      // the request / trip h2 PROTOCOL_ERROR, and a non-token key emits an
+      // invalid header name — reject such entries loudly instead of
+      // corrupting every export with no hint the env value is the cause.
+      auto token_key = [](const std::string& k) {
+        if (k.empty()) return false;
+        for (unsigned char c : k) {
+          bool tchar = std::isalnum(c) || std::string_view("!#$%&'*+-.^_`|~")
+                                                  .find(static_cast<char>(c)) !=
+                                              std::string_view::npos;
+          if (!tchar) return false;
+        }
+        return true;
+      };
+      auto clean_value = [](const std::string& v) {
+        for (unsigned char c : v)
+          if (c < 0x20 || c == 0x7f) return false;
+        return true;
+      };
+      if (!token_key(key) || !clean_value(value)) {
+        log::warn("otlp", "ignoring OTLP header entry with invalid key or "
+                  "control characters in value: '" + pair + "'");
+        continue;
+      }
+      out.emplace_back(std::move(key), std::move(value));
+    }
+    return out;
+  };
+  metrics_headers_ = signal_headers("OTEL_EXPORTER_OTLP_METRICS_HEADERS");
+  traces_headers_ = signal_headers("OTEL_EXPORTER_OTLP_TRACES_HEADERS");
 
   // Per-signal endpoints (OTEL spec; the reference documents exactly this
   // env shape, README.md:79-98): signal endpoint vars are full URLs used
@@ -247,7 +301,8 @@ bool Exporter::export_metrics(int64_t now_nanos) {
   if (metrics_grpc_) {
     return grpc_post(metrics_url_, otlp_grpc::kMetricsPath,
                      otlp_grpc::encode_metrics_request(
-                         log::counters_snapshot(), start_unix_nanos_, now_nanos));
+                         log::counters_snapshot(), start_unix_nanos_, now_nanos),
+                     metrics_headers_);
   }
   Value metrics = Value::array();
   for (const auto& [name, counter] : log::counters_snapshot()) {
@@ -281,7 +336,7 @@ bool Exporter::export_metrics(int64_t now_nanos) {
 
   Value body = Value::object();
   body.set("resourceMetrics", Value(json::Array{std::move(rm)}));
-  return post(metrics_url_, body.dump());
+  return post(metrics_url_, body.dump(), metrics_headers_);
 }
 
 bool Exporter::export_traces() {
@@ -290,7 +345,7 @@ bool Exporter::export_traces() {
 
   if (traces_grpc_) {
     return grpc_post(traces_url_, otlp_grpc::kTracesPath,
-                     otlp_grpc::encode_traces_request(finished));
+                     otlp_grpc::encode_traces_request(finished), traces_headers_);
   }
   Value spans = Value::array();
   for (FinishedSpan& fs : finished) {
@@ -335,18 +390,19 @@ bool Exporter::export_traces() {
 
   Value body = Value::object();
   body.set("resourceSpans", Value(json::Array{std::move(rs)}));
-  return post(traces_url_, body.dump());
+  return post(traces_url_, body.dump(), traces_headers_);
 }
 
 bool Exporter::grpc_post(const std::string& url, const char* path,
-                         const std::string& proto) {
+                         const std::string& proto,
+                         const std::vector<std::pair<std::string, std::string>>& headers) {
   auto parsed = http::parse_url(url);
   if (!parsed) {
     log::warn("otlp", "OTLP/gRPC endpoint unparseable: " + url);
     return false;
   }
   otlp_grpc::CallResult res =
-      otlp_grpc::unary_call(parsed->host, parsed->port, path, proto, 5000);
+      otlp_grpc::unary_call(parsed->host, parsed->port, path, proto, 5000, headers);
   if (!res.ok) {
     log::warn("otlp", "OTLP/gRPC export to " + url + path + " failed: " +
               (!res.error.empty() ? res.error
@@ -361,13 +417,15 @@ bool Exporter::grpc_post(const std::string& url, const char* path,
   return true;
 }
 
-bool Exporter::post(const std::string& url, const std::string& body_json) {
+bool Exporter::post(const std::string& url, const std::string& body_json,
+                    const std::vector<std::pair<std::string, std::string>>& headers) {
   try {
     http::Client client;
     http::Request req;
     req.method = "POST";
     req.url = url;
     req.headers.push_back({"Content-Type", "application/json"});
+    for (const auto& [k, v] : headers) req.headers.push_back({k, v});
     req.body = body_json;
     req.timeout_ms = 5000;
     http::Response resp = client.request(req);

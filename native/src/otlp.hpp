@@ -97,10 +97,15 @@ class Exporter {
   void loop();
   bool export_metrics(int64_t now_nanos);
   bool export_traces();
-  bool post(const std::string& url, const std::string& body_json);
-  bool grpc_post(const std::string& url, const char* path, const std::string& proto);
+  bool post(const std::string& url, const std::string& body_json,
+            const std::vector<std::pair<std::string, std::string>>& headers);
+  bool grpc_post(const std::string& url, const char* path, const std::string& proto,
+                 const std::vector<std::pair<std::string, std::string>>& headers);
   std::string metrics_url_, traces_url_;  // empty = signal disabled
   bool metrics_grpc_ = false, traces_grpc_ = false;  // OTLP/gRPC transport
+  // OTEL_EXPORTER_OTLP[_SIGNAL]_HEADERS: auth/routing headers for managed
+  // collectors, applied on both transports.
+  std::vector<std::pair<std::string, std::string>> metrics_headers_, traces_headers_;
   int interval_ms_;
   std::atomic<bool> stop_{false};
   std::mutex mutex_;

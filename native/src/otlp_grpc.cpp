@@ -15,6 +15,7 @@
 #include <stdexcept>
 
 #include "tpupruner/log.hpp"
+#include "tpupruner/util.hpp"
 
 namespace tpupruner::otlp_grpc {
 
@@ -397,7 +398,9 @@ bool hpack_decode_for_test(
 }
 
 CallResult unary_call(const std::string& host, int port, const std::string& path,
-                      const std::string& message, int timeout_ms) {
+                      const std::string& message, int timeout_ms,
+                      const std::vector<std::pair<std::string, std::string>>&
+                          metadata) {
   CallResult result;
   auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
   auto expired = [&] { return std::chrono::steady_clock::now() > deadline; };
@@ -427,6 +430,8 @@ CallResult unary_call(const std::string& host, int port, const std::string& path
     hpack_literal(hb, "te", "trailers");
     hpack_literal(hb, "content-type", "application/grpc");
     hpack_literal(hb, "user-agent", "tpu-pruner-otlp/1.0");
+    for (const auto& [name, value] : metadata)
+      hpack_literal(hb, util::to_lower(name), value);
     out += frame_header(hb.size(), kFrameHeaders, kFlagEndHeaders, 1) + hb;
     sock.write_all(out.data(), out.size());
 

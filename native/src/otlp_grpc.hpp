@@ -65,9 +65,13 @@ struct CallResult {
 };
 
 // One unary gRPC call (h2c). `message` is the serialized protobuf; the
-// 5-byte gRPC frame header is added internally. Never throws.
+// 5-byte gRPC frame header is added internally. `metadata` entries are
+// sent as request headers (names lowercased — h2 requirement). Never
+// throws.
 CallResult unary_call(const std::string& host, int port, const std::string& path,
-                      const std::string& message, int timeout_ms);
+                      const std::string& message, int timeout_ms,
+                      const std::vector<std::pair<std::string, std::string>>&
+                          metadata = {});
 
 // Test/fuzz hook for the response-path HPACK subset decoder (static table
 // + literals; huffman-coded strings surface as "<huffman>" names or are

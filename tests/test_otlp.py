@@ -14,6 +14,7 @@ from tpu_pruner.testing import FakeK8s, FakePrometheus
 class FakeOtlpCollector:
     def __init__(self):
         self.requests = []
+        self.header_log = []  # dict of request headers per POST, in order
         self._server = None
 
     def start(self):
@@ -27,6 +28,7 @@ class FakeOtlpCollector:
                 length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length))
                 fake.requests.append((self.path, body))
+                fake.header_log.append({k.lower(): v for k, v in self.headers.items()})
                 resp = b"{}"
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(resp)))
@@ -402,5 +404,51 @@ def test_collector_failure_does_not_fail_daemon(built):
             capture_output=True, text=True, timeout=60, env=env)
         assert proc.returncode == 0, proc.stderr
         assert "OTLP export to" in proc.stderr  # warning logged, daemon unaffected
+    finally:
+        prom.stop(); k8s.stop()
+
+
+def test_otlp_headers_env_applied_on_both_transports(built, collector):
+    """OTEL_EXPORTER_OTLP_HEADERS (auth for managed collectors): parsed as
+    comma-separated key=value with percent-decoded values and sent on the
+    HTTP POST and as gRPC request metadata alike."""
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start(); k8s.start()
+    # the third entry decodes to a CRLF-bearing value (header smuggling) and
+    # must be rejected at parse time, not written to the wire
+    headers_env = {"OTEL_EXPORTER_OTLP_HEADERS":
+                   "Authorization=Bearer%20tok-1, api-key=k2,"
+                   "x-evil=a%0D%0AX-Smuggled:%201"}
+    try:
+        # HTTP transport: headers land on the POST
+        proc = run_cycle(prom, k8s, collector, env_extra=headers_env)
+        assert proc.returncode == 0, proc.stderr
+        assert collector.header_log, "no HTTP export received"
+        assert collector.header_log[0]["authorization"] == "Bearer tok-1"
+        assert collector.header_log[0]["api-key"] == "k2"
+        assert "x-evil" not in collector.header_log[0]
+        assert "x-smuggled" not in collector.header_log[0]
+        assert "ignoring OTLP header entry" in proc.stderr
+
+        grpc = FakeGrpcCollector()
+        grpc.start()
+        try:
+            proc = subprocess.run(
+                [str(DAEMON_PATH), "--prometheus-url", prom.url,
+                 "--run-mode", "dry-run", "--otlp-endpoint", grpc.url],
+                capture_output=True, text=True, timeout=60,
+                env={"KUBE_API_URL": k8s.url, "PROMETHEUS_TOKEN": "t",
+                     "PATH": "/usr/bin:/bin",
+                     "OTEL_EXPORTER_OTLP_PROTOCOL": "grpc", **headers_env})
+            assert proc.returncode == 0, proc.stderr
+            assert grpc.requests, "no gRPC export received"
+            hdrs = dict(grpc.requests[0][2])
+            # h2 requires lowercase header names
+            assert hdrs["authorization"] == "Bearer tok-1"
+            assert hdrs["api-key"] == "k2"
+        finally:
+            grpc.stop()
     finally:
         prom.stop(); k8s.stop()
