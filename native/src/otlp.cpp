@@ -126,12 +126,7 @@ Exporter::Exporter(std::string endpoint, int interval_ms)
     std::string raw;
     if (auto v = util::env(signal_var); v && !v->empty()) raw = *v;
     else if (auto v = util::env("OTEL_EXPORTER_OTLP_HEADERS"); v && !v->empty()) raw = *v;
-    size_t pos = 0;
-    while (pos <= raw.size()) {
-      size_t comma = raw.find(',', pos);
-      std::string pair = raw.substr(
-          pos, comma == std::string::npos ? std::string::npos : comma - pos);
-      pos = comma == std::string::npos ? raw.size() + 1 : comma + 1;
+    for (const std::string& pair : util::split(raw, ',')) {
       size_t eq = pair.find('=');
       if (eq == std::string::npos) continue;  // malformed entry: skip, per spec
       std::string key = util::trim(pair.substr(0, eq));
@@ -157,8 +152,11 @@ Exporter::Exporter(std::string endpoint, int interval_ms)
         return true;
       };
       if (!token_key(key) || !clean_value(value)) {
+        // Key only — the value is typically a credential (that's what this
+        // env is FOR) and must never land in logs, malformed or not.
         log::warn("otlp", "ignoring OTLP header entry with invalid key or "
-                  "control characters in value: '" + pair + "'");
+                  "control characters in value (key: '" + key + "', value "
+                  "redacted)");
         continue;
       }
       out.emplace_back(std::move(key), std::move(value));

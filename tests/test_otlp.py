@@ -431,6 +431,10 @@ def test_otlp_headers_env_applied_on_both_transports(built, collector):
         assert "x-evil" not in collector.header_log[0]
         assert "x-smuggled" not in collector.header_log[0]
         assert "ignoring OTLP header entry" in proc.stderr
+        # the rejected entry's VALUE is typically a credential: the warn
+        # must name only the key, never the (decoded or raw) value
+        assert "X-Smuggled" not in proc.stderr
+        assert "%0D" not in proc.stderr
 
         grpc = FakeGrpcCollector()
         grpc.start()

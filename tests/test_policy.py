@@ -184,6 +184,84 @@ def test_sharded_q_cross_shard_veto():
     assert not bool(verdicts[0])
 
 
+# ── streaming sliding-window evaluation (engine.py two-level max) ────────
+
+
+def test_streaming_window_matches_full_reeval():
+    """Feeding chunks through update_window + evaluate_window_qc must equal
+    evaluate_fleet_qc over the concatenation of the SAME chunks — partial
+    window (fewer chunks than the ring) and exactly-full cases."""
+    from tpu_pruner.policy import (
+        evaluate_fleet_qc, evaluate_window_qc, init_window, quantize_samples,
+        slice_bounds, update_window)
+    from tpu_pruner.policy.engine import quantize_params
+
+    rng = np.random.default_rng(41)
+    C, S, K, T_new = 96, 8, 6, 4
+    slice_id = np.sort(rng.integers(0, S, size=C)).astype(np.int32)
+    bounds = slice_bounds(slice_id, S)
+    age = np.full(C, 7200, np.float32)
+    params_q = jnp.asarray(quantize_params(
+        params_array(PolicyParams(lookback_s=2100, hbm_threshold=0.05))))
+
+    chunks = []
+    state = init_window(C, K)
+    for step in range(K):  # fill exactly K chunks
+        tc = (rng.uniform(size=(C, T_new)) < 0.6).astype(np.float32) \
+            * rng.uniform(size=(C, T_new))
+        hbm = rng.uniform(0, 0.1, size=(C, T_new)).astype(np.float32)
+        valid = rng.uniform(size=(C, T_new)) < 0.9
+        tc_q = jnp.asarray(quantize_samples(tc, valid))
+        hbm_q = jnp.asarray(quantize_samples(hbm, valid))
+        chunks.append((tc_q, hbm_q))
+        state = update_window(state, tc_q, hbm_q)
+
+        # at every prefix, streaming == full re-eval over the seen chunks
+        full_tc = jnp.concatenate([c[0] for c in chunks], axis=1)
+        full_hbm = jnp.concatenate([c[1] for c in chunks], axis=1)
+        ref_v, ref_c = evaluate_fleet_qc(full_tc, full_hbm, jnp.asarray(age),
+                                         bounds, params_q)
+        st_v, st_c = evaluate_window_qc(state, jnp.asarray(age), bounds, params_q)
+        np.testing.assert_array_equal(np.asarray(st_c), np.asarray(ref_c),
+                                      err_msg=f"step {step}")
+        np.testing.assert_array_equal(np.asarray(st_v), np.asarray(ref_v))
+
+
+def test_streaming_window_evicts_old_activity():
+    """A busy sample K+1 cycles ago falls out of the ring: the chip turns
+    idle again exactly when the window slides past it."""
+    from tpu_pruner.policy import (
+        evaluate_window_qc, init_window, quantize_samples, slice_bounds,
+        update_window)
+    from tpu_pruner.policy.engine import quantize_params
+
+    C, S, K = 4, 2, 3
+    slice_id = np.array([0, 0, 1, 1], np.int32)
+    bounds = slice_bounds(slice_id, S)
+    age = np.full(C, 7200, np.float32)
+    params_q = jnp.asarray(quantize_params(params_array(PolicyParams())))
+    valid = np.ones((C, 2), bool)
+
+    busy = quantize_samples(np.array([[0.9, 0.9]] + [[0.0, 0.0]] * 3, np.float32), valid)
+    idle = quantize_samples(np.zeros((C, 2), np.float32), valid)
+    zero_hbm = quantize_samples(np.zeros((C, 2), np.float32), valid)
+
+    state = init_window(C, K)
+    state = update_window(state, jnp.asarray(busy), jnp.asarray(zero_hbm))
+    v, c = evaluate_window_qc(state, jnp.asarray(age), bounds, params_q)
+    assert not bool(v[0]) and bool(v[1])  # chip 0 busy -> slice 0 vetoed
+
+    for _ in range(K - 1):  # busy chunk still inside the window
+        state = update_window(state, jnp.asarray(idle), jnp.asarray(zero_hbm))
+        v, _ = evaluate_window_qc(state, jnp.asarray(age), bounds, params_q)
+        assert not bool(v[0])
+
+    # K-th idle update overwrites the busy chunk -> slice 0 reclaims
+    state = update_window(state, jnp.asarray(idle), jnp.asarray(zero_hbm))
+    v, _ = evaluate_window_qc(state, jnp.asarray(age), bounds, params_q)
+    assert bool(v[0]) and bool(v[1])
+
+
 # ── pallas kernel parity (interpret mode on CPU; Mosaic on TPU) ──────────
 
 

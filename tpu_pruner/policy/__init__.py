@@ -24,6 +24,8 @@ from tpu_pruner.policy.engine import (
     evaluate_fleet_qc,
     evaluate_fleet_sharded,
     evaluate_fleet_sharded_q,
+    evaluate_window_qc,
+    init_window,
     make_example_fleet,
     make_sharded_evaluator,
     make_sharded_evaluator_q,
@@ -33,6 +35,7 @@ from tpu_pruner.policy.engine import (
     slice_bounds,
     slice_verdicts,
     slice_verdicts_contiguous,
+    update_window,
 )
 __all__ = [
     "PolicyParams",
@@ -44,6 +47,8 @@ __all__ = [
     "evaluate_fleet_qc",
     "evaluate_fleet_sharded",
     "evaluate_fleet_sharded_q",
+    "evaluate_window_qc",
+    "init_window",
     "make_example_fleet",
     "make_sharded_evaluator",
     "make_sharded_evaluator_q",
@@ -53,6 +58,7 @@ __all__ = [
     "slice_bounds",
     "slice_verdicts",
     "slice_verdicts_contiguous",
+    "update_window",
 ]
 
 # Pallas is optional: jax builds without jax.experimental.pallas.tpu must
