@@ -7,6 +7,7 @@
 #include <cstring>
 #include <string>
 
+#include "otlp_grpc.hpp"
 #include "tpupruner/core.hpp"
 #include "tpupruner/json.hpp"
 #include "tpupruner/metrics.hpp"
@@ -14,6 +15,7 @@
 
 using tpupruner::json::Value;
 namespace core = tpupruner::core;
+namespace otlp_grpc = tpupruner::otlp_grpc;
 
 namespace {
 
@@ -215,6 +217,40 @@ char* tp_dedup_targets(const char* targets_json) {
 char* tp_target_meta(const char* target_json) {
   return guarded([&] {
     return ok(meta_to_json(target_from_json(Value::parse(target_json))));
+  });
+}
+
+char* tp_otlp_grpc_call(const char* payload_json) {
+  // Test hook for the OTLP/gRPC unary client (otlp_grpc.cpp): lets the
+  // hermetic pytest tier drive unary_call with arbitrary payload SIZES —
+  // in particular > 65535 bytes, where HTTP/2 flow control (WINDOW_UPDATE
+  // handling during the DATA send) kicks in; the daemon's own exports are
+  // too small to reach that path. Payload bytes are zeros: the fake
+  // collector checks lengths, not content.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    auto require = [&](const char* key) -> const Value& {
+      const Value* v = p.find(key);
+      if (!v) throw std::runtime_error(std::string("missing ") + key);
+      return *v;
+    };
+    std::string message(static_cast<size_t>(require("message_size").as_int()), '\0');
+    int timeout_ms = 5000;
+    if (const Value* t = p.find("timeout_ms"); t) timeout_ms = static_cast<int>(t->as_int());
+    otlp_grpc::CallResult res = otlp_grpc::unary_call(
+        require("host").as_string(),
+        static_cast<int>(require("port").as_int()),
+        require("path").as_string(), message, timeout_ms);
+    Value out = Value::object();
+    out.set("ok", Value(res.ok));
+    out.set("http_status", Value(res.http_status));
+    out.set("grpc_status", Value(res.grpc_status));
+    out.set("grpc_message", Value(res.grpc_message));
+    // "error" only when set: the ctypes _call helper treats the key's
+    // presence as a failed call
+    if (!res.error.empty()) out.set("call_error", Value(res.error));
+    out.set("status_undecoded", Value(res.status_undecoded));
+    return ok(std::move(out));
   });
 }
 

@@ -153,10 +153,22 @@ Exporter::Exporter(std::string endpoint, int interval_ms)
       };
       if (!token_key(key) || !clean_value(value)) {
         // Key only — the value is typically a credential (that's what this
-        // env is FOR) and must never land in logs, malformed or not.
+        // env is FOR) and must never land in logs, malformed or not. The
+        // key itself may be rejected FOR containing raw control bytes, so
+        // escape non-printables before they reach stderr (log injection).
+        std::string safe_key;
+        for (unsigned char c : key) {
+          if (c >= 0x20 && c < 0x7f) {
+            safe_key.push_back(static_cast<char>(c));
+          } else {
+            char buf[5];
+            std::snprintf(buf, sizeof(buf), "\\x%02x", c);
+            safe_key += buf;
+          }
+        }
         log::warn("otlp", "ignoring OTLP header entry with invalid key or "
-                  "control characters in value (key: '" + key + "', value "
-                  "redacted)");
+                  "control characters in value (key: '" + safe_key +
+                  "', value redacted)");
         continue;
       }
       out.emplace_back(std::move(key), std::move(value));

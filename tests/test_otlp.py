@@ -456,3 +456,24 @@ def test_otlp_headers_env_applied_on_both_transports(built, collector):
             grpc.stop()
     finally:
         prom.stop(); k8s.stop()
+
+
+def test_grpc_flow_control_large_payload(built):
+    """A payload far beyond the 65535-byte initial h2 window forces the
+    client through chunked DATA frames and WINDOW_UPDATE replenishment —
+    the path the daemon's own small exports never reach."""
+    from tpu_pruner import native
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    grpc = FakeGrpcCollector()
+    port = grpc.start()
+    try:
+        out = native.otlp_grpc_call(
+            "127.0.0.1", port, "/test.Service/Big", 512 * 1024)
+        assert out["ok"] is True, out
+        assert out["grpc_status"] == 0
+        path, message, _ = grpc.requests[0]
+        assert path == "/test.Service/Big"
+        assert len(message) == 512 * 1024  # reassembled across DATA frames
+    finally:
+        grpc.stop()

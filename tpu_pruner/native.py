@@ -80,6 +80,7 @@ def load() -> ctypes.CDLL:
         "tp_check_eligibility",
         "tp_dedup_targets",
         "tp_target_meta",
+        "tp_otlp_grpc_call",
         "tp_version",
     ):
         f = getattr(lib, fn)
@@ -150,3 +151,12 @@ def dedup_targets(targets: list[dict]) -> list[dict]:
 def target_meta(target: dict) -> dict:
     """Meta accessors (name/namespace/kind/uid/apiVersion) for a target."""
     return _call("tp_target_meta", target)
+
+
+def otlp_grpc_call(host: str, port: int, path: str, message_size: int,
+                   timeout_ms: int = 5000) -> dict:
+    """Test hook: drive the OTLP/gRPC unary client with an arbitrary-size
+    zero-filled payload (otlp_grpc.cpp flow-control coverage)."""
+    return _call("tp_otlp_grpc_call", {
+        "host": host, "port": port, "path": path,
+        "message_size": message_size, "timeout_ms": timeout_ms})

@@ -441,19 +441,15 @@ def update_window(state, tc_q_new, hbm_q_new):
     return (tc_ring, hbm_ring, (cursor + 1) % num_chunks)
 
 
-@jax.jit
 def evaluate_window_qc(state, pod_age_s, bounds, params_arr_q):
     """Slice verdicts from streaming state (contiguous fleets).
 
-    The ring of chunk maxima IS a valid [C, K] sample tensor for
-    evaluate_chips_q: max over chunk maxima = max over all window samples,
-    and all-sentinel rows stay non-candidates.
+    The ring of chunk maxima IS a valid [C, K] sample tensor for the qc
+    evaluator: max over chunk maxima = max over all window samples, and
+    all-sentinel rows stay non-candidates — so this simply delegates.
     """
     tc_ring, hbm_ring, _ = state
-    candidate = evaluate_chips_q(
-        tc_ring, hbm_ring, pod_age_s, params_arr_q[0], params_arr_q[1]
-    )
-    return slice_verdicts_contiguous(candidate, bounds), candidate
+    return evaluate_fleet_qc(tc_ring, hbm_ring, pod_age_s, bounds, params_arr_q)
 
 
 def make_example_fleet(

@@ -176,6 +176,14 @@ class FakeGrpcCollector:
                     path = dict(headers).get(":path", "")
                 elif ftype == FRAME_DATA:
                     data += payload
+                    # Replenish flow-control windows as a real server does
+                    # when it consumes DATA — without this, requests larger
+                    # than the 65535-byte initial window would stall the
+                    # client forever (the >64 KB flow-control test path).
+                    if payload:
+                        inc = struct.pack("!I", len(payload))
+                        conn.sendall(_frame(FRAME_WINDOW_UPDATE, 0, 0, inc))
+                        conn.sendall(_frame(FRAME_WINDOW_UPDATE, 0, stream, inc))
                     if flags & FLAG_END_STREAM:
                         break
                 if ftype == FRAME_HEADERS and flags & FLAG_END_STREAM:
