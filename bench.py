@@ -41,7 +41,14 @@ Measurements:
 
 5. **TPU fleet policy engine**: chips/s evaluated by the fused JAX
    idle-verdict computation on the real TPU chip — 131,072 chips x 360
-   samples per cycle — including the Pallas Mosaic-compiled variant.
+   samples per cycle — against a MEASURED roofline (same-dtype 4 GB
+   row-max on device, per dtype), across the implementation ladder:
+   f32+segment_sum baseline, f32+contiguous-cumsum, int8+cumsum (the
+   recommended storage), the Pallas Mosaic-compiled variants, the
+   1M-chip XL point, and the streaming steady-state cycle (two-level
+   sliding max over a chunk-maxima ring, data-dependency-chained so the
+   tunnel cannot flatter sub-ms cycles). best_config/best_chips_per_s
+   name the winner.
    The TPU backend in this environment can HANG during init (the axon
    tunnel), so the path is defended: a cheap preflight probe subprocess
    with a hard timeout, up to 3 spaced attempts across the bench run
