@@ -490,3 +490,47 @@ def test_pallas_q_matches_engine_q():
     pal_v, pal_c = evaluate_fleet_pallas_q(*q_args, num_slices=S)
     np.testing.assert_array_equal(np.asarray(pal_c), np.asarray(ref_c))
     np.testing.assert_array_equal(np.asarray(pal_v), np.asarray(ref_v))
+
+
+def test_uniform_fast_path_matches_qc():
+    """evaluate_fleet_qu (reshape+all reduction) ≡ evaluate_fleet_qc on
+    equal-size contiguous slices — including partially-busy slices, HBM
+    rescues, young pods, and no-data chips."""
+    from tpu_pruner.policy import (
+        evaluate_fleet_qc, evaluate_fleet_qu, quantize_fleet_inputs,
+        slice_bounds)
+
+    rng = np.random.default_rng(47)
+    C, S = 128, 16  # 8 chips/slice, uniform
+    tc = (rng.uniform(size=(C, 12)) < 0.5).astype(np.float32) * rng.uniform(size=(C, 12))
+    hbm = rng.uniform(0, 0.2, size=(C, 12)).astype(np.float32)
+    valid = rng.uniform(size=(C, 12)) < 0.9
+    valid[:3] = False
+    age = rng.uniform(0, 4000, size=C).astype(np.float32)
+    slice_id = np.repeat(np.arange(S, dtype=np.int32), C // S)
+    params = params_array(PolicyParams(lookback_s=2100, hbm_threshold=0.05))
+    q = quantize_fleet_inputs((jnp.asarray(tc), jnp.asarray(hbm), jnp.asarray(valid),
+                               jnp.asarray(age), jnp.asarray(slice_id), params))
+    ref_v, ref_c = evaluate_fleet_qc(q[0], q[1], q[2], slice_bounds(slice_id, S), q[4])
+    u_v, u_c = evaluate_fleet_qu(q[0], q[1], q[2], q[4], chips_per_slice=C // S)
+    np.testing.assert_array_equal(np.asarray(u_c), np.asarray(ref_c))
+    np.testing.assert_array_equal(np.asarray(u_v), np.asarray(ref_v))
+
+
+def test_assert_uniform_slices_guards_layout():
+    """The qu precondition raises on heterogeneous or ungrouped fleets and
+    returns num_slices on valid ones — the loud check the silent reshape
+    reduction depends on."""
+    from tpu_pruner.policy import assert_uniform_slices
+
+    ok = np.repeat(np.arange(4, dtype=np.int32), 8)
+    assert assert_uniform_slices(ok, 8) == 4
+    with pytest.raises(ValueError, match="do not divide"):
+        assert_uniform_slices(ok[:30], 8)
+    # heterogeneous sizes whose total still divides: one 8-chip and one
+    # 24-chip slice in a fleet declared as 16-chip-uniform
+    hetero = np.concatenate([np.zeros(8, np.int32), np.ones(24, np.int32)])
+    with pytest.raises(ValueError, match="not uniform-contiguous"):
+        assert_uniform_slices(hetero, 16)
+    with pytest.raises(ValueError, match="not uniform-contiguous"):
+        assert_uniform_slices(ok[::-1].copy(), 8)  # grouped but descending

@@ -16,12 +16,14 @@ lookback+grace age gate (reference: query.promql.j2 + main.rs:494-510).
 
 from tpu_pruner.policy.engine import (
     PolicyParams,
+    assert_uniform_slices,
     evaluate_chips,
     evaluate_chips_q,
     evaluate_fleet,
     evaluate_fleet_c,
     evaluate_fleet_q,
     evaluate_fleet_qc,
+    evaluate_fleet_qu,
     evaluate_fleet_sharded,
     evaluate_fleet_sharded_q,
     evaluate_window_qc,
@@ -39,12 +41,14 @@ from tpu_pruner.policy.engine import (
 )
 __all__ = [
     "PolicyParams",
+    "assert_uniform_slices",
     "evaluate_chips",
     "evaluate_chips_q",
     "evaluate_fleet",
     "evaluate_fleet_c",
     "evaluate_fleet_q",
     "evaluate_fleet_qc",
+    "evaluate_fleet_qu",
     "evaluate_fleet_sharded",
     "evaluate_fleet_sharded_q",
     "evaluate_window_qc",

@@ -398,6 +398,50 @@ def evaluate_fleet_sharded_q(tc_q, hbm_q, pod_age_s, slice_id, params_arr_q,
         params_arr_q, num_slices, mesh, axis, quantized=True)
 
 
+def assert_uniform_slices(slice_id, chips_per_slice: int) -> int:
+    """Host-side precondition for evaluate_fleet_qu; returns num_slices.
+
+    The reshape reduction cannot detect a heterogeneous or ungrouped
+    fleet on its own — a wrong layout would silently merge neighbor
+    slices' verdicts (the same hazard slice_bounds raises for). Run this
+    at ingest, where the layout is decided.
+    """
+    sid = np.asarray(slice_id)
+    if sid.size % chips_per_slice != 0:
+        raise ValueError(
+            f"{sid.size} chips do not divide into slices of {chips_per_slice}")
+    num_slices = sid.size // chips_per_slice
+    expected = np.repeat(np.arange(num_slices, dtype=sid.dtype), chips_per_slice)
+    if not np.array_equal(sid, expected):
+        raise ValueError(
+            "fleet is not uniform-contiguous (expected slice ids "
+            f"repeat(arange({num_slices}), {chips_per_slice})); use "
+            "evaluate_fleet_qc with slice_bounds instead")
+    return num_slices
+
+
+@partial(jax.jit, static_argnames=("chips_per_slice",))
+def evaluate_fleet_qu(tc_q, hbm_q, pod_age_s, params_arr_q, chips_per_slice: int):
+    """Uniform-fleet fast path: int8 storage + equal-size contiguous slices.
+
+    Homogeneous fleets (every slice the same shape — e.g. all v5e-16) are
+    the common production case, and there the slice reduction needs no
+    cumsum at all: reshape the candidate mask to [S, chips_per_slice] and
+    AND-reduce the minor axis — one tiny fused reduction XLA folds into
+    the chip pass itself, leaving the cycle at the pure streaming cost of
+    the int8 samples. The layout contract (chips grouped into equal
+    consecutive slices) is NOT detectable in here — validate it at ingest
+    with assert_uniform_slices, which raises on heterogeneous or
+    ungrouped fleets instead of letting the reshape silently merge
+    neighbor slices' verdicts. Verdict parity with evaluate_fleet_qc is
+    pinned in tests/test_policy.py.
+    """
+    candidate = evaluate_chips_q(
+        tc_q, hbm_q, pod_age_s, params_arr_q[0], params_arr_q[1]
+    )
+    return candidate.reshape(-1, chips_per_slice).all(axis=1), candidate
+
+
 # --- streaming sliding-window evaluation ------------------------------------
 #
 # The daemon re-evaluates every check_interval (180 s) over a lookback of
