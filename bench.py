@@ -660,14 +660,20 @@ def tpu_fleet_eval():
     # through the tunnel; see the ceiling comment).
     try:
         from tpu_pruner.policy import (
-            evaluate_window_qc, init_window, quantize_params, update_window)
+            assert_uniform_slices, evaluate_window_qu, init_window,
+            quantize_params, update_window)
 
         stream_chunks, stream_new = 12, 6
+        stream_cps = num_chips // num_slices
+        assert_uniform_slices(np.asarray(inputs[4]), stream_cps)
 
         @jax.jit
-        def stream_cycle(state, tc_new, hbm_new, age, b, pq):
+        def stream_cycle(state, tc_new, hbm_new, age, pq):
             state = update_window(state, tc_new, hbm_new)
-            verdicts, _ = evaluate_window_qc(state, age, b, pq)
+            # uniform window reduction: at streaming sizes the ring read is
+            # tiny, so the fused reshape+all (vs cumsum) is most of the cycle
+            verdicts, _ = evaluate_window_qu(state, age, pq,
+                                             chips_per_slice=stream_cps)
             poison = (verdicts.sum() * 0).astype(jnp.int8)  # zero, but data-dependent
             return state, verdicts, poison
 
@@ -679,7 +685,7 @@ def tpu_fleet_eval():
         t0 = time.monotonic()
         for _ in range(stream_chunks):  # fill the ring; first call compiles
             state, verdicts, poison = stream_cycle(
-                state, base_tc, base_hbm, age_arr, bounds, pq)
+                state, base_tc, base_hbm, age_arr, pq)
         np.asarray(verdicts).sum()
         stream_compile_s = time.monotonic() - t0
 
@@ -687,7 +693,7 @@ def tpu_fleet_eval():
             t0 = time.monotonic()
             s, tc_in, v = state, base_tc, None
             for _ in range(k):
-                s, v, poison = stream_cycle(s, tc_in, base_hbm, age_arr, bounds, pq)
+                s, v, poison = stream_cycle(s, tc_in, base_hbm, age_arr, pq)
                 tc_in = base_tc + poison  # chain next input on prior verdicts
             np.asarray(v).sum()
             return time.monotonic() - t0

@@ -534,3 +534,34 @@ def test_assert_uniform_slices_guards_layout():
         assert_uniform_slices(hetero, 16)
     with pytest.raises(ValueError, match="not uniform-contiguous"):
         assert_uniform_slices(ok[::-1].copy(), 8)  # grouped but descending
+
+
+def test_streaming_uniform_matches_qc_window():
+    """evaluate_window_qu ≡ evaluate_window_qc on a uniform fleet fed the
+    same chunks (including a wrapped ring)."""
+    from tpu_pruner.policy import (
+        evaluate_window_qc, evaluate_window_qu, init_window, quantize_samples,
+        slice_bounds, update_window)
+    from tpu_pruner.policy.engine import quantize_params
+
+    rng = np.random.default_rng(53)
+    C, S, K = 64, 8, 4
+    cps = C // S
+    slice_id = np.repeat(np.arange(S, dtype=np.int32), cps)
+    bounds = slice_bounds(slice_id, S)
+    age = np.full(C, 7200, np.float32)
+    params_q = jnp.asarray(quantize_params(
+        params_array(PolicyParams(lookback_s=2100, hbm_threshold=0.05))))
+
+    state = init_window(C, K)
+    for _ in range(K + 2):  # wrap the ring
+        tc = (rng.uniform(size=(C, 3)) < 0.6).astype(np.float32) * rng.uniform(size=(C, 3))
+        hbm = rng.uniform(0, 0.1, size=(C, 3)).astype(np.float32)
+        valid = rng.uniform(size=(C, 3)) < 0.9
+        state = update_window(state, jnp.asarray(quantize_samples(tc, valid)),
+                              jnp.asarray(quantize_samples(hbm, valid)))
+        qc_v, qc_c = evaluate_window_qc(state, jnp.asarray(age), bounds, params_q)
+        qu_v, qu_c = evaluate_window_qu(state, jnp.asarray(age), params_q,
+                                        chips_per_slice=cps)
+        np.testing.assert_array_equal(np.asarray(qu_v), np.asarray(qc_v))
+        np.testing.assert_array_equal(np.asarray(qu_c), np.asarray(qc_c))

@@ -27,6 +27,7 @@ from tpu_pruner.policy.engine import (
     evaluate_fleet_sharded,
     evaluate_fleet_sharded_q,
     evaluate_window_qc,
+    evaluate_window_qu,
     init_window,
     make_example_fleet,
     make_sharded_evaluator,
@@ -52,6 +53,7 @@ __all__ = [
     "evaluate_fleet_sharded",
     "evaluate_fleet_sharded_q",
     "evaluate_window_qc",
+    "evaluate_window_qu",
     "init_window",
     "make_example_fleet",
     "make_sharded_evaluator",

@@ -496,6 +496,16 @@ def evaluate_window_qc(state, pod_age_s, bounds, params_arr_q):
     return evaluate_fleet_qc(tc_ring, hbm_ring, pod_age_s, bounds, params_arr_q)
 
 
+def evaluate_window_qu(state, pod_age_s, params_arr_q, chips_per_slice: int):
+    """evaluate_window_qc for uniform fleets (delegates to the reshape
+    reduction; validate the layout at ingest with assert_uniform_slices).
+    At streaming sizes the ring read is tiny, so dropping the cumsum for
+    the fused reshape+all is most of the remaining cycle."""
+    tc_ring, hbm_ring, _ = state
+    return evaluate_fleet_qu(tc_ring, hbm_ring, pod_age_s, params_arr_q,
+                             chips_per_slice=chips_per_slice)
+
+
 def make_example_fleet(
     num_chips: int = 256,
     num_samples: int = 16,
