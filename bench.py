@@ -469,14 +469,41 @@ def tpu_fleet_eval():
     """Fleet policy engine throughput on whatever accelerator JAX gives us."""
     import jax
 
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # The axon TPU plugin overrides JAX_PLATFORMS at import time (the
+        # same hazard tests/conftest.py and __graft_entry__ pin against),
+        # so the env var ALONE does not keep a wedged tunnel out of
+        # backend init — the cpu fallback would hang exactly when it is
+        # needed. Pin via config before any jax.devices() call.
+        jax.config.update("jax_platforms", "cpu")
+
+    t_start = time.monotonic()
+
+    def mark(section):
+        # stderr breadcrumbs: when the child nears its subprocess timeout,
+        # the parent's captured stderr says which section ate the budget
+        print(f"[fleet-eval {time.monotonic() - t_start:6.1f}s] {section}",
+              file=sys.stderr, flush=True)
+
     from tpu_pruner.policy import make_example_fleet, evaluate_fleet
 
-    num_chips, num_samples, num_slices = 131072, 360, 8192
+    platform = jax.devices()[0].platform
+    mark("backend up")
+    if platform == "cpu":
+        # CPU fallback is a LOWER BOUND, not the measurement: the full
+        # 131k x 360 shape is intractable on one host core (the XLA-CPU
+        # compile alone of the 8k-segment scatter runs for minutes and a
+        # single dispatch for seconds — measured round 4, where the full
+        # shape blew a 1200 s budget before finishing the baseline).
+        # Shrink 8x/4x; chips/s stays a rate and the shape is recorded.
+        num_chips, num_samples, num_slices = 16384, 90, 1024
+    else:
+        num_chips, num_samples, num_slices = 131072, 360, 8192
     inputs, _ = make_example_fleet(
         num_chips=num_chips, num_samples=num_samples, num_slices=num_slices,
         idle_fraction=0.5,
     )
-    platform = jax.devices()[0].platform
+    mark("fleet built")
 
     import numpy as np
 
@@ -521,6 +548,12 @@ def tpu_fleet_eval():
         return slope, compile_s
 
     per_cycle, compile_s = measure(evaluate_fleet)
+    mark("f32 baseline measured")
+    # On the CPU fallback only the baseline is measured: the roofline,
+    # quantized/uniform/streaming variants, and XL points exist to
+    # characterize the TPU; on one host core they would blow the
+    # subprocess budget and say nothing about the accelerator.
+    accelerated = platform != "cpu"
     f32_bytes = num_chips * num_samples * 9  # f32 tc + f32 hbm + bool valid
     result = {
         "platform": platform,
@@ -563,11 +596,14 @@ def tpu_fleet_eval():
         return arr.nbytes / slope
 
     try:
+        if not accelerated:
+            raise RuntimeError("cpu fallback: baseline only")
         ceil_arr = jnp.zeros((num_chips, 8192), jnp.float32)  # 4.29 GB
         ceiling = measure_ceiling(ceil_arr)
         del ceil_arr
         result["ceiling_gbytes_per_s"] = round(ceiling / 1e9, 1)
         result["pct_of_ceiling"] = round(100 * (f32_bytes / per_cycle) / ceiling, 1)
+        mark("f32 ceiling measured")
     except Exception as e:
         result["ceiling_error"] = str(e)[:200]
 
@@ -581,6 +617,8 @@ def tpu_fleet_eval():
     no_ns = lambda fn: lambda *a, num_slices=None: fn(*a)  # noqa: E731
 
     try:
+        if not accelerated:
+            raise RuntimeError("cpu fallback: baseline only")
         from tpu_pruner.policy import evaluate_fleet_c
 
         c_inputs = (*inputs[:4], bounds, inputs[5])
@@ -591,6 +629,7 @@ def tpu_fleet_eval():
         if "ceiling_gbytes_per_s" in result:
             result["c_pct_of_ceiling"] = round(
                 100 * (f32_bytes / c_cycle) / ceiling, 1)
+        mark("f32+cumsum measured")
     except Exception as e:
         result["c_error"] = str(e)[:200]
 
@@ -600,10 +639,13 @@ def tpu_fleet_eval():
     # q_* fields are the RECOMMENDED production configuration: int8 storage
     # + contiguous cumsum reduction (evaluate_fleet_qc).
     try:
+        if not accelerated:
+            raise RuntimeError("cpu fallback: baseline only")
         from tpu_pruner.policy import (
             evaluate_fleet_qc, quantize_fleet_inputs)
 
         q_inputs = quantize_fleet_inputs(inputs)
+        mark("quantized inputs built")
         qc_inputs = (q_inputs[0], q_inputs[1], q_inputs[2], bounds, q_inputs[4])
         q_bytes = num_chips * num_samples * 2
         q_cycle, q_compile = measure(no_ns(evaluate_fleet_qc), qc_inputs)
@@ -611,6 +653,7 @@ def tpu_fleet_eval():
         result["q_cycle_ms"] = q_cycle * 1000
         result["q_compile_s"] = q_compile
         result["q_effective_gbytes_per_s"] = round(q_bytes / q_cycle / 1e9, 1)
+        mark("int8+cumsum measured")
         try:
             ceil_i8 = jnp.zeros((num_chips, 32768), jnp.int8)  # 4.29 GB
             q_ceiling = measure_ceiling(ceil_i8)
@@ -618,6 +661,7 @@ def tpu_fleet_eval():
             result["q_ceiling_gbytes_per_s"] = round(q_ceiling / 1e9, 1)
             result["q_pct_of_ceiling"] = round(
                 100 * (q_bytes / q_cycle) / q_ceiling, 1)
+            mark("i8 ceiling measured")
         except Exception as e:
             result["q_ceiling_error"] = str(e)[:200]
         try:
@@ -626,6 +670,7 @@ def tpu_fleet_eval():
             qp_cycle, _ = measure(no_ns(evaluate_fleet_pallas_qc), qc_inputs)
             result["q_pallas_chips_per_s"] = num_chips / qp_cycle
             result["q_pallas_cycle_ms"] = qp_cycle * 1000
+            mark("pallas qc measured")
         except Exception as e:
             result["q_pallas_error"] = str(e)[:200]
         # Uniform-fleet fast path: the bench fleet IS homogeneous (16
@@ -645,6 +690,7 @@ def tpu_fleet_eval():
             if "q_ceiling_gbytes_per_s" in result:
                 result["qu_pct_of_ceiling"] = round(
                     100 * (q_bytes / qu_cycle) / q_ceiling, 1)
+            mark("int8+uniform measured")
         except Exception as e:
             result["qu_error"] = str(e)[:200]
         del q_inputs, qc_inputs
@@ -658,70 +704,84 @@ def tpu_fleet_eval():
     # data-dependent end-to-end — the slope harness stays valid even at
     # sub-ms cycles (unchained sub-ms kernels measure impossibly fast
     # through the tunnel; see the ceiling comment).
-    try:
+    def measure_stream(chips, cps, age_arr, pq, prefix):
+        """Chained streaming harness (shared by the headline and XL
+        points): one new 6-sample chunk into a 12-chunk ring + uniform
+        verdict pass, the state threading through every dispatch and the
+        next input depending on the previous verdicts — data-dependent
+        end-to-end, so the slope stays valid at sub-ms cycles. Writes
+        <prefix>cycle_ms/chips_per_s/compile_s or <prefix>error."""
         from tpu_pruner.policy import (
-            assert_uniform_slices, evaluate_window_qu, init_window,
-            quantize_params, update_window)
+            evaluate_window_qu, init_window, update_window)
 
         stream_chunks, stream_new = 12, 6
-        stream_cps = num_chips // num_slices
-        assert_uniform_slices(np.asarray(inputs[4]), stream_cps)
 
         @jax.jit
-        def stream_cycle(state, tc_new, hbm_new, age, pq):
+        def stream_cycle(state, tc_new, hbm_new, age, p):
             state = update_window(state, tc_new, hbm_new)
             # uniform window reduction: at streaming sizes the ring read is
             # tiny, so the fused reshape+all (vs cumsum) is most of the cycle
-            verdicts, _ = evaluate_window_qu(state, age, pq,
-                                             chips_per_slice=stream_cps)
+            verdicts, _ = evaluate_window_qu(state, age, p,
+                                             chips_per_slice=cps)
             poison = (verdicts.sum() * 0).astype(jnp.int8)  # zero, but data-dependent
             return state, verdicts, poison
 
-        pq = jnp.asarray(quantize_params(np.asarray(inputs[5])))
-        age_arr = inputs[3]
-        base_tc = jnp.zeros((num_chips, stream_new), jnp.int8)
-        base_hbm = jnp.zeros((num_chips, stream_new), jnp.int8)
-        state = init_window(num_chips, stream_chunks)
+        base = jnp.zeros((chips, stream_new), jnp.int8)
+        state = init_window(chips, stream_chunks)
         t0 = time.monotonic()
         for _ in range(stream_chunks):  # fill the ring; first call compiles
-            state, verdicts, poison = stream_cycle(
-                state, base_tc, base_hbm, age_arr, pq)
+            state, verdicts, poison = stream_cycle(state, base, base, age_arr, pq)
         np.asarray(verdicts).sum()
-        stream_compile_s = time.monotonic() - t0
+        compile_s = time.monotonic() - t0
 
         def stream_batch(k):
             t0 = time.monotonic()
-            s, tc_in, v = state, base_tc, None
+            s, tc_in, v = state, base, None
             for _ in range(k):
-                s, v, poison = stream_cycle(s, tc_in, base_hbm, age_arr, pq)
-                tc_in = base_tc + poison  # chain next input on prior verdicts
+                s, v, poison = stream_cycle(s, tc_in, base, age_arr, pq)
+                tc_in = base + poison  # chain next input on prior verdicts
             np.asarray(v).sum()
             return time.monotonic() - t0
 
         t_small = statistics.median(stream_batch(5) for _ in range(3))
         t_big = statistics.median(stream_batch(55) for _ in range(3))
-        stream_slope = (t_big - t_small) / 50
-        if stream_slope > 0:
-            result["stream_cycle_ms"] = stream_slope * 1000
-            result["stream_chips_per_s"] = num_chips / stream_slope
-            result["stream_window_chunks"] = stream_chunks
-            result["stream_new_samples"] = stream_new
-            result["stream_compile_s"] = stream_compile_s
+        slope = (t_big - t_small) / 50
+        if slope > 0:
+            result[prefix + "cycle_ms"] = slope * 1000
+            result[prefix + "chips_per_s"] = chips / slope
+            result[prefix + "window_chunks"] = stream_chunks
+            result[prefix + "new_samples"] = stream_new
+            result[prefix + "compile_s"] = compile_s
+            mark(prefix + "measured")
         else:
-            result["stream_error"] = (
+            result[prefix + "error"] = (
                 f"non-positive slope (t5={t_small:.4f}, t55={t_big:.4f})")
+
+    try:
+        if not accelerated:
+            raise RuntimeError("cpu fallback: baseline only")
+        from tpu_pruner.policy import assert_uniform_slices, quantize_params
+
+        stream_cps = num_chips // num_slices
+        assert_uniform_slices(np.asarray(inputs[4]), stream_cps)
+        measure_stream(num_chips, stream_cps,
+                       inputs[3], jnp.asarray(quantize_params(np.asarray(inputs[5]))),
+                       "stream_")
     except Exception as e:
         result["stream_error"] = str(e)[:200]
 
     # Pallas variant of the baseline chip pass (guaranteed single-pass
     # fusion; real Mosaic compile on TPU, errors fall back to XLA numbers).
     try:
+        if not accelerated:
+            raise RuntimeError("cpu fallback: baseline only")
         from tpu_pruner.policy import evaluate_fleet_pallas
 
         pal_cycle, pal_compile = measure(evaluate_fleet_pallas)
         result["pallas_chips_per_s"] = num_chips / pal_cycle
         result["pallas_cycle_ms"] = pal_cycle * 1000
         result["pallas_compile_s"] = pal_compile
+        mark("pallas f32 measured")
     except Exception as e:
         result["pallas_error"] = str(e)[:200]
 
@@ -739,25 +799,23 @@ def tpu_fleet_eval():
         result["best_chips_per_s"] = best[0]
         result["best_config"] = best[1]
 
-    # XL scale point: 1,048,576 chips (a full hypothetical 1M-chip fleet;
-    # ~3.4 GB of metric tensors, well inside one v5e's HBM) — pins that
-    # the bandwidth-bound pass scales linearly 8x beyond the headline
-    # shape. Skipped on hosts/backends where it doesn't fit.
+    # XL scale point: 1,048,576 chips (a full hypothetical 1M-chip fleet)
+    # in the RECOMMENDED configuration (int8 + cumsum, ~755 MB of
+    # samples) — pins that the pass scales 8x beyond the headline shape.
+    # The f32-scatter XL row was dropped in round 4: its compile alone
+    # costs ~a minute of the child's budget and the configuration is
+    # superseded (rounds 1-3 recorded it at 24.9-25.0 ms). Skipped on
+    # hosts/backends where it doesn't fit.
     try:
+        if not accelerated:
+            raise RuntimeError("cpu fallback: baseline only")
         xl_chips, xl_slices = 1_048_576, 65_536
         xl_inputs, _ = make_example_fleet(
             num_chips=xl_chips, num_samples=num_samples, num_slices=xl_slices,
             idle_fraction=0.5,
         )
-        xl_cycle, xl_compile_s = measure(evaluate_fleet, xl_inputs, xl_slices)
         result["xl_fleet_chips"] = xl_chips
-        result["xl_chips_per_s"] = xl_chips / xl_cycle
-        result["xl_cycle_ms"] = xl_cycle * 1000
-        result["xl_compile_s"] = xl_compile_s
-        result["xl_effective_gbytes_per_s"] = round(
-            xl_chips * num_samples * 9 / xl_cycle / 1e9, 1)
-        # Same 1M-chip point in the recommended configuration (int8 +
-        # cumsum, ~755 MB of samples).
+        mark("xl fleet built")
         from tpu_pruner.policy import evaluate_fleet_qc, quantize_fleet_inputs
 
         xl_q = quantize_fleet_inputs(xl_inputs)
@@ -768,6 +826,16 @@ def tpu_fleet_eval():
         result["xl_q_cycle_ms"] = xl_q_cycle * 1000
         result["xl_q_effective_gbytes_per_s"] = round(
             xl_chips * num_samples * 2 / xl_q_cycle / 1e9, 1)
+        mark("xl int8+cumsum measured")
+
+        # Streaming steady state at the 1M-chip scale (the shared
+        # measure_stream harness; uniform XL fleet).
+        from tpu_pruner.policy import assert_uniform_slices
+
+        xl_cps = xl_chips // xl_slices
+        assert_uniform_slices(np.asarray(xl_inputs[4]), xl_cps)
+        measure_stream(xl_chips, xl_cps, jnp.asarray(xl_inputs[3]), xl_q[4],
+                       "xl_stream_")
     except Exception as e:
         result["xl_error"] = str(e)[:200]
     return result
