@@ -68,3 +68,13 @@ querytest query url:
 
 docker-build:
     docker build -t tpu-pruner:latest .
+
+# fast output-path check of the benchmark (16x-shrunk cluster, n=1; the
+# summary line carries smoke:true — never a measurement)
+bench-smoke:
+    TP_BENCH_SMOKE=1 python bench.py
+
+# opt-in real-hardware policy tier: XLA + Mosaic-Pallas verdict parity
+# (f32 and int8+cumsum) on an actual TPU chip
+test-policy-tpu:
+    TP_POLICY_TPU=1 python -m pytest tests/test_policy_tpu.py -q

@@ -738,14 +738,25 @@ class FakeK8s:
         self._mp_socket = sock
         self._mp_port = sock.getsockname()[1]
         ctx = multiprocessing.get_context("fork")  # COW state, no pickling
-        for _ in range(workers):
-            parent_conn, child_conn = ctx.Pipe()
-            proc = ctx.Process(target=_mp_worker_main,
-                               args=(self, sock, child_conn), daemon=True)
-            proc.start()
-            child_conn.close()
-            self._mp_conns.append(parent_conn)
-            self._mp_procs.append(proc)
+        # Python 3.12 warns that fork() in a multi-threaded process can
+        # deadlock the child on inherited locks. Accounted for here:
+        # _mp_worker_main replaces the fake's lock first thing, the child
+        # touches no other inherited synchronization, and the harness's
+        # other threads simply don't run in the child. Suppress ONLY the
+        # fork message (not all DeprecationWarnings) for the spawn loop.
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message=".*fork.*",
+                                    category=DeprecationWarning)
+            for _ in range(workers):
+                parent_conn, child_conn = ctx.Pipe()
+                proc = ctx.Process(target=_mp_worker_main,
+                                   args=(self, sock, child_conn), daemon=True)
+                proc.start()
+                child_conn.close()
+                self._mp_conns.append(parent_conn)
+                self._mp_procs.append(proc)
         return self._mp_port
 
     @property
