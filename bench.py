@@ -846,8 +846,8 @@ def tpu_fleet_eval():
         from tpu_pruner.policy import evaluate_fleet_qc, quantize_fleet_inputs
 
         xl_q = quantize_fleet_inputs(xl_inputs)
-        xl_bounds = slice_bounds(np.asarray(xl_inputs[4]), xl_slices)
-        xl_slice_id = np.asarray(xl_inputs[4])
+        xl_slice_id = np.asarray(xl_inputs[4])  # one device→host transfer
+        xl_bounds = slice_bounds(xl_slice_id, xl_slices)
         xl_age = jnp.asarray(xl_inputs[3])
         del xl_inputs  # ~3.4 GB of f32 only needed as quantization input
         xl_qc = (xl_q[0], xl_q[1], xl_q[2], xl_bounds, xl_q[4])
@@ -931,8 +931,11 @@ def tpu_section(probe_points):
             {"JAX_PLATFORMS": "cpu", "XLA_FLAGS":
              (os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=1").strip()},
             timeout=900)
-        cpu["note"] = ("CPU-backend lower bound (TPU probes failed); not a "
-                       "TPU measurement")
+        # Merge, don't clobber: the child's own note carries the
+        # variant-sections-skipped marker (skip-vs-failure labeling).
+        cpu["note"] = "; ".join(
+            n for n in ("CPU-backend lower bound (TPU probes failed); not a "
+                        "TPU measurement", cpu.get("note")) if n)
         return {**evidence, "cpu_fallback": cpu}
     except Exception as e:
         return {**evidence, "cpu_fallback_error": str(e)[:300]}

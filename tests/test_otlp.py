@@ -477,3 +477,46 @@ def test_grpc_flow_control_large_payload(built):
         assert len(message) == 512 * 1024  # reassembled across DATA frames
     finally:
         grpc.stop()
+
+
+def test_grpc_periodic_export_in_daemon_mode(built):
+    """The gRPC transport must also serve the exporter's PERIODIC interval
+    loop (OTEL_METRIC_EXPORT_INTERVAL), not only the single-shot shutdown
+    flush the other transport tests exercise: multiple exports arrive
+    over separate connections while the daemon keeps cycling."""
+    import time as time_mod
+
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    prom, k8s = FakePrometheus(), FakeK8s()
+    _, _, pods = k8s.add_deployment_chain("ml", "dep", num_pods=1)
+    prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    grpc = FakeGrpcCollector()
+    grpc.start()
+    prom.start(); k8s.start()
+    proc = subprocess.Popen(
+        [str(DAEMON_PATH), "--prometheus-url", prom.url,
+         "--run-mode", "scale-down", "--daemon-mode", "--check-interval", "1",
+         "--otlp-endpoint", grpc.url],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+        env={"KUBE_API_URL": k8s.url, "PROMETHEUS_TOKEN": "t",
+             "PATH": "/usr/bin:/bin",
+             "OTEL_EXPORTER_OTLP_PROTOCOL": "grpc",
+             "OTEL_METRIC_EXPORT_INTERVAL": "300"})
+    try:
+        deadline = time_mod.time() + 30
+        metrics_path = ("/opentelemetry.proto.collector.metrics.v1."
+                        "MetricsService/Export")
+        while time_mod.time() < deadline:
+            if sum(1 for p, _, _ in grpc.requests if p == metrics_path) >= 3:
+                break
+            time_mod.sleep(0.2)
+        periodic = [m for p, m, _ in grpc.requests if p == metrics_path]
+        assert len(periodic) >= 3, f"only {len(periodic)} periodic gRPC exports"
+        # later exports carry growing counters (the daemon kept cycling)
+        assert _grpc_metric_names(periodic[-1]) >= {
+            "tpu_pruner.query_successes", "tpu_pruner.scale_successes"}
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        prom.stop(); k8s.stop(); grpc.stop()
