@@ -520,3 +520,30 @@ def test_grpc_periodic_export_in_daemon_mode(built):
         proc.terminate()
         proc.wait(timeout=10)
         prom.stop(); k8s.stop(); grpc.stop()
+
+
+def test_grpc_padded_headers_and_midstream_ping(built):
+    """RFC 7540 edge shapes on the response path: PADDED response HEADERS
+    (pad stripped before HPACK decode) and a server PING mid-response
+    (client must ACK and keep reading to the trailers)."""
+    from tpu_pruner import native
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    grpc = FakeGrpcCollector(pad_headers=True, ping_before_response=True)
+    port = grpc.start()
+    try:
+        out = native.otlp_grpc_call("127.0.0.1", port, "/test.Service/Edge", 64)
+        assert out["ok"] is True, out
+        assert out["http_status"] == 200
+        assert out["grpc_status"] == 0
+        # the client must have ECHOED the ping payload with FLAG_ACK, not
+        # merely tolerated the frame (the server thread records it during
+        # its post-response drain, which finishes just after the client
+        # returns — poll briefly)
+        import time as time_mod
+        deadline = time_mod.time() + 3
+        while time_mod.time() < deadline and not grpc.ping_acks:
+            time_mod.sleep(0.05)
+        assert b"\x01\x02\x03\x04\x05\x06\x07\x08" in grpc.ping_acks, grpc.ping_acks
+    finally:
+        grpc.stop()

@@ -98,12 +98,20 @@ class FakeGrpcCollector:
     """One request per connection (matching the client's dial-per-export)."""
 
     def __init__(self, grpc_status: int = 0, grpc_message: str = "",
-                 split_trailers: bool = False):
+                 split_trailers: bool = False, pad_headers: bool = False,
+                 ping_before_response: bool = False):
         self.grpc_status = grpc_status
         self.grpc_message = grpc_message
         # Send trailers as HEADERS(END_STREAM) + CONTINUATION(END_HEADERS)
         # (RFC 7540 §4.3) — exercises the client's split-block path.
         self.split_trailers = split_trailers
+        # Send the response HEADERS with the PADDED flag (pad length +
+        # trailing padding octets) — exercises the client's pad stripping.
+        self.pad_headers = pad_headers
+        # Send a PING before the response — the client must ACK it and
+        # keep reading.
+        self.ping_before_response = ping_before_response
+        self.ping_acks = []  # payloads of PING ACK frames the client sent
         self.requests = []  # (path, message_bytes, headers list)
         self._sock: socket.socket | None = None
         self._stop = threading.Event()
@@ -196,9 +204,18 @@ class FakeGrpcCollector:
                 message = data[5:5 + mlen]
             self.requests.append((path, message, headers))
 
+            if self.ping_before_response:
+                conn.sendall(_frame(FRAME_PING, 0, 0, b"\x01\x02\x03\x04\x05\x06\x07\x08"))
             resp_headers = _hpack_literal(b":status", b"200") + \
                 _hpack_literal(b"content-type", b"application/grpc")
-            conn.sendall(_frame(FRAME_HEADERS, FLAG_END_HEADERS, stream, resp_headers))
+            if self.pad_headers:
+                FLAG_PADDED = 0x8
+                padded = bytes([4]) + resp_headers + b"\x00" * 4
+                conn.sendall(_frame(FRAME_HEADERS, FLAG_END_HEADERS | FLAG_PADDED,
+                                    stream, padded))
+            else:
+                conn.sendall(_frame(FRAME_HEADERS, FLAG_END_HEADERS, stream,
+                                    resp_headers))
             # Empty Export*ServiceResponse message.
             conn.sendall(_frame(FRAME_DATA, 0, stream, b"\x00\x00\x00\x00\x00"))
             trailers = _hpack_literal(b"grpc-status", str(self.grpc_status).encode())
@@ -216,11 +233,25 @@ class FakeGrpcCollector:
             # Half-close and drain: a bare close() while the client's late
             # SETTINGS ACK is in flight RSTs the connection and discards
             # the buffered trailers on the client side. FIN + read-to-EOF
-            # lets the client consume everything first.
+            # lets the client consume everything first. The drained bytes
+            # are parsed as frames so tests can assert the client's PING
+            # ACK actually went out (not just that it kept reading).
             conn.shutdown(socket.SHUT_WR)
             conn.settimeout(2)
-            while conn.recv(4096):
-                pass
+            drained = buf
+            while True:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                drained += chunk
+            while len(drained) >= 9:
+                flen = int.from_bytes(drained[:3], "big")
+                ftype, fflags = drained[3], drained[4]
+                if len(drained) < 9 + flen:
+                    break
+                if ftype == FRAME_PING and fflags & FLAG_ACK:
+                    self.ping_acks.append(bytes(drained[9:9 + flen]))
+                drained = drained[9 + flen:]
         except Exception:
             pass  # connection-level failures surface as client errors
         finally:
