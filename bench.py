@@ -1095,8 +1095,14 @@ def main():
         if k in tpu:
             fe[k] = round(tpu[k], 3) if isinstance(tpu[k], float) else tpu[k]
     if not fe and "cpu_fallback" in tpu:
+        # carry the reduced fallback shape: its chips/s is measured at
+        # 16k x 90, not the TPU headline shape, and must not be misread
+        cps = tpu["cpu_fallback"].get("chips_per_s")
         fe = {"platform": "cpu_fallback",
-              "chips_per_s": round(tpu["cpu_fallback"]["chips_per_s"], 1)}
+              # None when absent: a missing measurement must not read as 0.0
+              "chips_per_s": round(cps, 1) if cps is not None else None,
+              "fleet_chips": tpu["cpu_fallback"].get("fleet_chips"),
+              "samples_per_chip": tpu["cpu_fallback"].get("samples_per_chip")}
     summary["fleet_eval"] = fe
 
     # The driver's capture window is ~2,000 chars; stay comfortably under.

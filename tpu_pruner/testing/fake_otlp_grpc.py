@@ -239,19 +239,24 @@ class FakeGrpcCollector:
             conn.shutdown(socket.SHUT_WR)
             conn.settimeout(2)
             drained = buf
-            while True:
-                chunk = conn.recv(4096)
-                if not chunk:
-                    break
-                drained += chunk
-            while len(drained) >= 9:
-                flen = int.from_bytes(drained[:3], "big")
-                ftype, fflags = drained[3], drained[4]
-                if len(drained) < 9 + flen:
-                    break
-                if ftype == FRAME_PING and fflags & FLAG_ACK:
-                    self.ping_acks.append(bytes(drained[9:9 + flen]))
-                drained = drained[9 + flen:]
+            try:
+                while True:
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        break
+                    drained += chunk
+            finally:
+                # Parse whatever arrived even if the final recv timed out
+                # (a slow client close must not discard an ACK already in
+                # hand — that would flake the PING-ACK assertion).
+                while len(drained) >= 9:
+                    flen = int.from_bytes(drained[:3], "big")
+                    ftype, fflags = drained[3], drained[4]
+                    if len(drained) < 9 + flen:
+                        break
+                    if ftype == FRAME_PING and fflags & FLAG_ACK:
+                        self.ping_acks.append(bytes(drained[9:9 + flen]))
+                    drained = drained[9 + flen:]
         except Exception:
             pass  # connection-level failures surface as client errors
         finally:
