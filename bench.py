@@ -327,6 +327,35 @@ def run_circuit_breaker(k8s, prom):
             "wall_s": round(elapsed, 3)}
 
 
+def measure_fixture_ceiling(k8s, seconds=1.5, threads=8):
+    """Standalone serving ceiling of the fake apiserver (VERDICT r4 #7).
+
+    A trivial multi-threaded client hammers one pod GET for ~1.5 s; the
+    resulting req/s is the fixture's own roof on this host, so e2e_wall_s
+    can be decomposed into fixture floor (api_calls / ceiling) vs daemon
+    cost. Run right after cluster build, before any daemon contends."""
+    import concurrent.futures
+    import urllib.request
+
+    path = (k8s.url + ("/api/v1/namespaces/tpu-jobs/pods/slice-0-workers-0-0"
+                       if NUM_SLICES else
+                       f"/api/v1/namespaces/{dep_ns(0)}/pods/dep-0-abc123-0"))
+    urllib.request.urlopen(path, timeout=10).read()  # warm
+    stop = time.monotonic() + seconds
+
+    def worker(_):
+        n = 0
+        while time.monotonic() < stop:
+            urllib.request.urlopen(path, timeout=10).read()
+            n += 1
+        return n
+
+    t0 = time.monotonic()
+    with concurrent.futures.ThreadPoolExecutor(max_workers=threads) as ex:
+        total = sum(ex.map(worker, range(threads)))
+    return round(total / (time.monotonic() - t0), 1)
+
+
 def model_reference_ceiling(k8s):
     """Simulate the reference's exact access pattern against the same fake API.
 
@@ -408,6 +437,73 @@ def model_reference_ceiling(k8s):
 
 
 # ── TPU path (VERDICT r1 #1: preflight, retries, diagnostics) ──
+
+# Wedge-proof hardware evidence (VERDICT r4 #1): every successful TPU
+# fleet eval is persisted to a COMMITTED artifact with its git SHA and
+# timestamp, and every CPU fallback carries that last-good block, so a
+# tunnel wedge at capture time can no longer erase the round's hardware
+# story (round 4 lost all of its TPU numbers exactly this way).
+LAST_GOOD_PATH = Path(__file__).resolve().parent / "bench_tpu_last_good.json"
+
+
+def git_sha():
+    """HEAD sha, with a -dirty suffix when the tree has uncommitted edits —
+    an artifact stamped from a dirty tree must say so or its provenance
+    claim is silently wrong."""
+    repo = str(Path(__file__).resolve().parent)
+    try:
+        sha = subprocess.run(
+            ["git", "-C", repo, "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10).stdout.strip()
+        if not sha:
+            return None
+        dirty = subprocess.run(
+            ["git", "-C", repo, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=10).stdout.strip()
+        return sha + ("-dirty" if dirty else "")
+    except Exception:
+        return None
+
+
+def persist_last_good(result):
+    """Write the successful TPU fleet eval to bench_tpu_last_good.json.
+
+    Called only when the eval ran on a real accelerator. Failure to write
+    must not fail the bench (the number still goes to stdout/detail)."""
+    try:
+        artifact = {
+            "captured_at_unix": time.time(),
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "git_sha": git_sha(),
+            "fleet_eval": result,
+        }
+        LAST_GOOD_PATH.write_text(json.dumps(artifact, indent=1) + "\n")
+        log(f"TPU last-good artifact written to {LAST_GOOD_PATH}")
+    except Exception as e:  # pragma: no cover - diagnostics only
+        log(f"WARNING: could not persist last-good TPU artifact: {e}")
+
+
+def load_last_good():
+    """Compact last-good block for fallback outputs (None if never captured)."""
+    try:
+        artifact = json.loads(LAST_GOOD_PATH.read_text())
+    except Exception:
+        return None
+    fe = artifact.get("fleet_eval", {})
+    block = {
+        "captured_at": artifact.get("captured_at"),
+        "age_days": round(
+            (time.time() - artifact.get("captured_at_unix", 0)) / 86400, 2),
+        "git_sha": (artifact.get("git_sha") or "")[:12] or None,
+        "platform": fe.get("platform"),
+        "artifact": LAST_GOOD_PATH.name,
+    }
+    for k in ("chips_per_s", "best_chips_per_s", "best_config",
+              "stream_chips_per_s", "ceiling_gbytes_per_s", "pct_of_ceiling"):
+        if k in fe:
+            v = fe[k]
+            block[k] = round(v, 1) if isinstance(v, float) else v
+    return block
 
 
 def tpu_diagnostics():
@@ -884,7 +980,7 @@ def run_fleet_eval_subprocess(env_overrides=None, timeout=560):
                        f"{proc.stderr.strip()[-300:]}")
 
 
-def tpu_section(probe_points):
+def tpu_section(probe_points, cpu_fallback=True):
     """Probe (with retries spaced across the bench via probe_points thunks),
     then run the fleet eval only against a proven-reachable backend. Each
     retry rung tries a different JAX_PLATFORMS shape so the evidence
@@ -912,7 +1008,21 @@ def tpu_section(probe_points):
     evidence = {"probes": probes, "diagnostics": tpu_diagnostics()}
     if reachable:
         try:
-            return {**run_fleet_eval_subprocess(reachable_env), **evidence}
+            fleet = run_fleet_eval_subprocess(reachable_env)
+            if fleet.get("platform") in (None, "cpu"):
+                # The probe saw a TPU but the eval child landed on the CPU
+                # backend (tunnel wedged between probe and eval): that is a
+                # FAILURE of the TPU capture, not a success — it must not
+                # be headlined as a platform measurement or exit 0 from
+                # --tpu-only.
+                evidence = {**evidence,
+                            "error": "fleet eval landed on platform="
+                                     f"{fleet.get('platform')} after a "
+                                     "successful TPU probe (tunnel wedged "
+                                     "mid-run?)"}
+            else:
+                persist_last_good(fleet)
+                return {**fleet, **evidence}
         except subprocess.TimeoutExpired:
             evidence = {**evidence,
                         "error": "fleet eval timed out after probe succeeded "
@@ -925,6 +1035,13 @@ def tpu_section(probe_points):
                              "failed (jax.devices() hang/timeout)"}
     # CPU fallback: pin the engine's lower bound on the host backend.
     # Never conflated with the TPU target — platform-labeled and nested.
+    # The committed last-good TPU artifact (if any) rides along so the
+    # round's hardware story survives a wedged tunnel (VERDICT r4 #1).
+    last_good = load_last_good()
+    if last_good:
+        evidence["last_good"] = last_good
+    if not cpu_fallback:
+        return evidence
     try:
         log("fleet eval falling back to CPU backend")
         cpu = run_fleet_eval_subprocess(
@@ -950,6 +1067,13 @@ def main():
     t_build = time.monotonic()
     k8s, prom = build_cluster()
     log(f"cluster built in {time.monotonic() - t_build:.1f}s")
+
+    try:
+        fixture_rps = measure_fixture_ceiling(k8s)
+        log(f"fixture ceiling: {fixture_rps:.0f} req/s standalone")
+    except Exception as e:
+        fixture_rps = None
+        log(f"WARNING: fixture ceiling measurement failed: {e}")
 
     try:
         elapsed, p50_s, p95_s, api_calls, batched, reclaimed_fraction = median_of(
@@ -1032,6 +1156,15 @@ def main():
         "p95_detect_to_scaledown_s": round(p95_s, 3),
         "k8s_api_calls": api_calls,
         "ref_k8s_api_calls": ref_api_calls,
+        "api_call_ratio": round(ref_api_calls / api_calls, 3),
+        "fixture_ceiling_rps": fixture_rps,
+        "fixture_note": (
+            None if not fixture_rps else
+            f"fake-apiserver standalone ceiling {fixture_rps:.0f} req/s "
+            f"(trivial 8-thread client, this host); the headline run's "
+            f"{api_calls} API calls imply a fixture-only floor of "
+            f"{api_calls / fixture_rps:.2f}s of its {elapsed:.2f}s wall — "
+            f"the remainder is daemon cost + fixture contention"),
         "fake_k8s_workers": FAKE_WORKERS,
         "host_cpus": os.cpu_count(),
         "wall_spread": RUN_SPREADS,
@@ -1058,14 +1191,7 @@ def main():
         "fleet_eval": tpu,
     }
 
-    # Full detail goes to a FILE (and stderr for humans); stdout gets ONE
-    # compact line. The driver records only the last ~2,000 chars of
-    # stdout: rounds 2-3 printed the whole detail object there, outgrew
-    # the window mid-JSON, and the driver recorded parsed:null — no
-    # headline number — for two rounds before anyone noticed.
     detail_path = Path(__file__).resolve().parent / "bench_detail.json"
-    detail_path.write_text(json.dumps(detail, indent=1) + "\n")
-    log(f"full detail written to {detail_path}")
 
     summary = {
         "metric": detail["metric"],
@@ -1074,6 +1200,7 @@ def main():
         "vs_baseline": detail["vs_baseline"],
         "vs_self_reference_mode": detail["vs_self_reference_mode"],
         "vs_self_reference_mode_same_kinds": detail["vs_self_reference_mode_same_kinds"],
+        "api_call_ratio": detail["api_call_ratio"],
         "reclaimed_fraction": detail["reclaimed_fraction"],
         "p50_detect_to_scaledown_s": detail["p50_detect_to_scaledown_s"],
         "p95_detect_to_scaledown_s": detail["p95_detect_to_scaledown_s"],
@@ -1083,6 +1210,35 @@ def main():
                        if RUN_SPREADS else None),
         "detail_file": detail_path.name,
     }
+    # Honest wall-clock ratios (VERDICT r4 #5): a cross-mode wall ratio is
+    # only headlined when the runs behind BOTH sides were stable (<10%
+    # relative spread). Noisier ratios move to a labeled block carrying
+    # their spread; the deterministic api_call_ratio (2.6x fewer calls)
+    # stays the durable architecture signal either way.
+    RATIO_SPREAD_LIMIT = 0.10
+    ratio_inputs = {
+        "vs_baseline": ("headline", "baseline_model"),
+        "vs_self_reference_mode": ("headline", "self_reference_mode"),
+        "vs_self_reference_mode_same_kinds": (
+            "headline", "self_reference_mode_same_kinds"),
+    }
+    noisy = {}
+    for key, labels in ratio_inputs.items():
+        spread = max((RUN_SPREADS.get(lb, 0.0) for lb in labels), default=0.0)
+        if spread > RATIO_SPREAD_LIMIT:
+            noisy[key] = {"ratio": summary.pop(key),
+                          "wall_spread": round(spread, 3)}
+    if noisy:
+        summary["noisy_wall_ratios"] = noisy
+    detail["noisy_wall_ratios"] = noisy or None
+
+    # Full detail goes to a FILE (and stderr for humans); stdout gets ONE
+    # compact line. The driver records only the last ~2,000 chars of
+    # stdout: rounds 2-3 printed the whole detail object there, outgrew
+    # the window mid-JSON, and the driver recorded parsed:null — no
+    # headline number — for two rounds before anyone noticed.
+    detail_path.write_text(json.dumps(detail, indent=1) + "\n")
+    log(f"full detail written to {detail_path}")
     if SMOKE:
         summary["smoke"] = True  # 16x-shrunk cluster, n=1 — not a measurement
     # fleet-eval essentials only (the full diagnostics live in the detail file)
@@ -1103,13 +1259,21 @@ def main():
               "chips_per_s": round(cps, 1) if cps is not None else None,
               "fleet_chips": tpu["cpu_fallback"].get("fleet_chips"),
               "samples_per_chip": tpu["cpu_fallback"].get("samples_per_chip")}
+    if "platform" not in tpu and tpu.get("last_good"):
+        # no TPU this run: surface the committed SHA-stamped last-good
+        # capture (compact: the audit trail lives in the artifact file)
+        lg = tpu["last_good"]
+        fe["last_good"] = {k: lg.get(k) for k in
+                          ("git_sha", "age_days", "best_chips_per_s",
+                           "best_config", "artifact") if lg.get(k) is not None}
     summary["fleet_eval"] = fe
 
     # The driver's capture window is ~2,000 chars; stay comfortably under.
     # Trim rather than assert: dying here after a multi-minute run would
     # print NOTHING — the exact parsed:null failure this path prevents.
     line = json.dumps(summary)
-    for drop in ("fleet_eval", "detail_file", "ref_k8s_api_calls", "k8s_api_calls"):
+    for drop in ("noisy_wall_ratios", "fleet_eval", "detail_file",
+                 "ref_k8s_api_calls", "k8s_api_calls"):
         if len(line) < 1000:
             break
         log(f"summary line {len(line)} chars — dropping {drop} (see detail file)")
@@ -1122,5 +1286,16 @@ if __name__ == "__main__":
     if "--fleet-eval-json" in sys.argv:
         # Child mode (see tpu_section): only the TPU fleet eval, JSON out.
         print(json.dumps(tpu_fleet_eval()))
+    elif "--tpu-only" in sys.argv:
+        # Standalone TPU capture: probe + fleet eval + last-good artifact,
+        # no e2e cluster. Run this EARLY and whenever the tunnel is up so
+        # the round always has committed hardware evidence regardless of
+        # the tunnel's state at the driver's capture time (VERDICT r4 #1).
+        out = tpu_section([None, lambda: time.sleep(30)], cpu_fallback=False)
+        print(json.dumps({k: out[k] for k in out
+                          if k not in ("probes", "diagnostics")}, indent=1))
+        # success = a real accelerator measurement (mirrors the persist
+        # guard); a cpu-platform eval after a lucky probe is still a miss
+        sys.exit(0 if out.get("platform") not in (None, "cpu") else 1)
     else:
         main()
