@@ -421,8 +421,12 @@ bool Exporter::grpc_post(const std::string& url, const char* path,
     return false;
   }
   if (res.status_undecoded) {
-    log::debug("otlp", "OTLP/gRPC trailers huffman-coded; success inferred "
-               "from clean close on HTTP 200");
+    // warn, not debug: an undecodable grpc-status could hide a collector
+    // rejection behind the inferred success (round-4 advisor finding).
+    log::warn("otlp", "OTLP/gRPC export to " + url + path + ": trailers "
+              "present but grpc-status undecodable (malformed huffman); "
+              "success inferred from clean close on HTTP 200 — a rejection "
+              "would be invisible");
   }
   return true;
 }

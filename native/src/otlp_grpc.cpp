@@ -312,9 +312,134 @@ const char* kStaticValues[62] = {
     "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "",
     "", "", "", "", "", ""};
 
+// ── HPACK huffman decoding (RFC 7541 §5.2, appendix B) ──────────────────
+// Real gRPC servers huffman-code literal trailer NAMES: grpc-go emits
+// "grpc-status" as ~8 huffman bytes vs 11 raw, so reading the status
+// verbatim requires an actual decoder — opaque-flagging the string made
+// every successful export against otel-collector log as "no grpc-status
+// in trailers" (round-4 advisor finding). Codes are the canonical RFC
+// 7541 appendix B table, one (code, bit-length) pair per symbol 0..255
+// plus EOS=256; decode walks a binary tree built from it once.
+const uint32_t kHuffCodes[257] = {
+    0x1ff8,    0x7fffd8,  0xfffffe2, 0xfffffe3, 0xfffffe4, 0xfffffe5,
+    0xfffffe6, 0xfffffe7, 0xfffffe8, 0xffffea,  0x3ffffffc, 0xfffffe9,
+    0xfffffea, 0x3ffffffd, 0xfffffeb, 0xfffffec, 0xfffffed, 0xfffffee,
+    0xfffffef, 0xffffff0, 0xffffff1, 0xffffff2, 0x3ffffffe, 0xffffff3,
+    0xffffff4, 0xffffff5, 0xffffff6, 0xffffff7, 0xffffff8, 0xffffff9,
+    0xffffffa, 0xffffffb, 0x14,      0x3f8,     0x3f9,     0xffa,
+    0x1ff9,    0x15,      0xf8,      0x7fa,     0x3fa,     0x3fb,
+    0xf9,      0x7fb,     0xfa,      0x16,      0x17,      0x18,
+    0x0,       0x1,       0x2,       0x19,      0x1a,      0x1b,
+    0x1c,      0x1d,      0x1e,      0x1f,      0x5c,      0xfb,
+    0x7ffc,    0x20,      0xffb,     0x3fc,     0x1ffa,    0x21,
+    0x5d,      0x5e,      0x5f,      0x60,      0x61,      0x62,
+    0x63,      0x64,      0x65,      0x66,      0x67,      0x68,
+    0x69,      0x6a,      0x6b,      0x6c,      0x6d,      0x6e,
+    0x6f,      0x70,      0x71,      0x72,      0xfc,      0x73,
+    0xfd,      0x1ffb,    0x7fff0,   0x1ffc,    0x3ffc,    0x22,
+    0x7ffd,    0x3,       0x23,      0x4,       0x24,      0x5,
+    0x25,      0x26,      0x27,      0x6,       0x74,      0x75,
+    0x28,      0x29,      0x2a,      0x7,       0x2b,      0x76,
+    0x2c,      0x8,       0x9,       0x2d,      0x77,      0x78,
+    0x79,      0x7a,      0x7b,      0x7ffe,    0x7fc,     0x3ffd,
+    0x1ffd,    0xffffffc, 0xfffe6,   0x3fffd2,  0xfffe7,   0xfffe8,
+    0x3fffd3,  0x3fffd4,  0x3fffd5,  0x7fffd9,  0x3fffd6,  0x7fffda,
+    0x7fffdb,  0x7fffdc,  0x7fffdd,  0x7fffde,  0xffffeb,  0x7fffdf,
+    0xffffec,  0xffffed,  0x3fffd7,  0x7fffe0,  0xffffee,  0x7fffe1,
+    0x7fffe2,  0x7fffe3,  0x7fffe4,  0x1fffdc,  0x3fffd8,  0x7fffe5,
+    0x3fffd9,  0x7fffe6,  0x7fffe7,  0xffffef,  0x3fffda,  0x1fffdd,
+    0xfffe9,   0x3fffdb,  0x3fffdc,  0x7fffe8,  0x7fffe9,  0x1fffde,
+    0x7fffea,  0x3fffdd,  0x3fffde,  0xfffff0,  0x1fffdf,  0x3fffdf,
+    0x7fffeb,  0x7fffec,  0x1fffe0,  0x1fffe1,  0x3fffe0,  0x1fffe2,
+    0x7fffed,  0x3fffe1,  0x7fffee,  0x7fffef,  0xfffea,   0x3fffe2,
+    0x3fffe3,  0x3fffe4,  0x7ffff0,  0x3fffe5,  0x3fffe6,  0x7ffff1,
+    0x3ffffe0, 0x3ffffe1, 0xfffeb,   0x7fff1,   0x3fffe7,  0x7ffff2,
+    0x3fffe8,  0x1ffffec, 0x3ffffe2, 0x3ffffe3, 0x3ffffe4, 0x7ffffde,
+    0x7ffffdf, 0x3ffffe5, 0xfffff1,  0x1ffffed, 0x7fff2,   0x1fffe3,
+    0x3ffffe6, 0x7ffffe0, 0x7ffffe1, 0x3ffffe7, 0x7ffffe2, 0xfffff2,
+    0x1fffe4,  0x1fffe5,  0x3ffffe8, 0x3ffffe9, 0xffffffd, 0x7ffffe3,
+    0x7ffffe4, 0x7ffffe5, 0xfffec,   0xfffff3,  0xfffed,   0x1fffe6,
+    0x3fffe9,  0x1fffe7,  0x1fffe8,  0x7ffff3,  0x3fffea,  0x3fffeb,
+    0x1ffffee, 0x1ffffef, 0xfffff4,  0xfffff5,  0x3ffffea, 0x7ffff4,
+    0x3ffffeb, 0x7ffffe6, 0x3ffffec, 0x3ffffed, 0x7ffffe7, 0x7ffffe8,
+    0x7ffffe9, 0x7ffffea, 0x7ffffeb, 0xffffffe, 0x7ffffec, 0x7ffffed,
+    0x7ffffee, 0x7ffffef, 0x7fffff0, 0x3ffffee, 0x3fffffff};
+const uint8_t kHuffBits[257] = {
+    13, 23, 28, 28, 28, 28, 28, 28, 28, 24, 30, 28, 28, 30, 28, 28,  //
+    28, 28, 28, 28, 28, 28, 30, 28, 28, 28, 28, 28, 28, 28, 28, 28,  //
+    6,  10, 10, 12, 13, 6,  8,  11, 10, 10, 8,  11, 8,  6,  6,  6,   //
+    5,  5,  5,  6,  6,  6,  6,  6,  6,  6,  7,  8,  15, 6,  12, 10,  //
+    13, 6,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,   //
+    7,  7,  7,  7,  7,  7,  7,  7,  8,  7,  8,  13, 19, 13, 14, 6,   //
+    15, 5,  6,  5,  6,  5,  6,  6,  6,  5,  7,  7,  6,  6,  6,  5,   //
+    6,  7,  6,  5,  5,  6,  7,  7,  7,  7,  7,  15, 11, 14, 13, 28,  //
+    20, 22, 20, 20, 22, 22, 22, 23, 22, 23, 23, 23, 23, 23, 24, 23,  //
+    24, 24, 22, 23, 24, 23, 23, 23, 23, 21, 22, 23, 22, 23, 23, 24,  //
+    22, 21, 20, 22, 22, 23, 23, 21, 23, 22, 22, 24, 21, 22, 23, 23,  //
+    21, 21, 22, 21, 23, 22, 23, 23, 20, 22, 22, 22, 23, 22, 22, 23,  //
+    26, 26, 20, 19, 22, 23, 22, 25, 26, 26, 26, 27, 27, 26, 24, 25,  //
+    19, 21, 26, 27, 27, 26, 27, 24, 21, 21, 26, 26, 28, 27, 27, 27,  //
+    20, 24, 20, 21, 22, 21, 21, 23, 22, 22, 25, 25, 24, 24, 26, 23,  //
+    26, 27, 26, 26, 27, 27, 27, 27, 27, 28, 27, 27, 27, 27, 27, 26,  //
+    30};
+
+struct HuffNode {
+  int16_t next[2] = {-1, -1};
+  int16_t sym = -1;
+};
+
+const std::vector<HuffNode>& huff_tree() {
+  static const std::vector<HuffNode> tree = [] {
+    std::vector<HuffNode> t(1);
+    for (int s = 0; s < 257; ++s) {
+      size_t cur = 0;
+      for (int b = kHuffBits[s] - 1; b >= 0; --b) {
+        int bit = (kHuffCodes[s] >> b) & 1;
+        if (t[cur].next[bit] < 0) {
+          t[cur].next[bit] = static_cast<int16_t>(t.size());
+          t.emplace_back();
+        }
+        cur = static_cast<size_t>(t[cur].next[bit]);
+      }
+      t[cur].sym = static_cast<int16_t>(s);
+    }
+    return t;
+  }();
+  return tree;
+}
+
+// Decodes a huffman-coded HPACK string. False on: a bit path outside the
+// code tree, EOS inside the string, or padding that is not a (<8-bit)
+// prefix of EOS — all decoding errors per RFC 7541 §5.2.
+bool huffman_decode(std::string_view in, std::string& out) {
+  const std::vector<HuffNode>& t = huff_tree();
+  size_t cur = 0;
+  int pad_bits = 0;
+  bool pad_all_ones = true;
+  for (char c : in) {
+    uint8_t byte = static_cast<uint8_t>(c);
+    for (int b = 7; b >= 0; --b) {
+      int bit = (byte >> b) & 1;
+      int16_t nxt = t[cur].next[bit];
+      if (nxt < 0) return false;
+      cur = static_cast<size_t>(nxt);
+      ++pad_bits;
+      pad_all_ones = pad_all_ones && bit == 1;
+      if (t[cur].sym >= 0) {
+        if (t[cur].sym == 256) return false;  // EOS must never appear in-string
+        out.push_back(static_cast<char>(t[cur].sym));
+        cur = 0;
+        pad_bits = 0;
+        pad_all_ones = true;
+      }
+    }
+  }
+  return pad_bits < 8 && pad_all_ones;
+}
+
 struct Header {
   std::string name, value;
-  bool huffman_value = false;  // value bytes are huffman-coded (opaque)
+  bool huffman_value = false;  // huffman-coded AND undecodable (opaque)
 };
 
 // Decode one HPACK header block (static table + literals; dynamic-table
@@ -346,6 +471,16 @@ bool hpack_decode(std::string_view block, std::vector<Header>& out) {
     if (i + len > block.size()) return false;
     s.assign(block.data() + i, len);
     i += len;
+    if (huff) {
+      // Decode in place; only an undecodable string stays opaque (huff
+      // stays true). A malformed huffman string is NOT a block error —
+      // the surrounding headers still parse (server-controlled bytes).
+      std::string decoded;
+      if (huffman_decode(s, decoded)) {
+        s = std::move(decoded);
+        huff = false;
+      }
+    }
     return true;
   };
   while (i < block.size()) {
@@ -378,7 +513,7 @@ bool hpack_decode(std::string_view block, std::vector<Header>& out) {
         h.name = "<dynamic-" + std::to_string(idx) + ">";
       }
       if (!read_str(h.value, h.huffman_value)) return false;
-      if (name_huff) h.name = "<huffman>";  // opaque name: can't match it
+      if (name_huff) h.name = "<huffman>";  // UNDECODABLE name: can't match it
       out.push_back(std::move(h));
     }
   }
@@ -386,6 +521,10 @@ bool hpack_decode(std::string_view block, std::vector<Header>& out) {
 }
 
 }  // namespace
+
+bool huffman_decode_for_test(std::string_view in, std::string& out) {
+  return huffman_decode(in, out);
+}
 
 bool hpack_decode_for_test(
     std::string_view block,
@@ -447,7 +586,11 @@ CallResult unary_call(const std::string& host, int port, const std::string& path
     // DATA with flow control: default 65535-byte connection and stream
     // windows, 16384 max frame until the server raises them (we keep the
     // defaults regardless — conservative is fine for telemetry sizes).
+    // The server MAY shrink the per-stream initial window via SETTINGS
+    // (RFC 7540 §6.5.2/§6.9.2) — honored below, or payloads between its
+    // window and 65535 bytes would overrun and get the stream RST.
     int64_t conn_window = 65535, stream_window = 65535;
+    int64_t initial_stream_window = 65535;
     size_t sent = 0;
     bool stream_closed = false;
     std::vector<Header> headers;
@@ -471,6 +614,21 @@ CallResult unary_call(const std::string& host, int port, const std::string& path
       switch (type) {
         case kFrameSettings:
           if (!(flags & kFlagAck)) {
+            // Honor SETTINGS_INITIAL_WINDOW_SIZE (0x4): the delta applies
+            // to the already-open stream's window (RFC 7540 §6.9.2).
+            for (size_t o = 0; o + 6 <= payload.size(); o += 6) {
+              uint16_t id = static_cast<uint16_t>(
+                  (static_cast<uint8_t>(payload[o]) << 8) |
+                  static_cast<uint8_t>(payload[o + 1]));
+              uint32_t v = (static_cast<uint32_t>(static_cast<uint8_t>(payload[o + 2])) << 24) |
+                           (static_cast<uint32_t>(static_cast<uint8_t>(payload[o + 3])) << 16) |
+                           (static_cast<uint32_t>(static_cast<uint8_t>(payload[o + 4])) << 8) |
+                           static_cast<uint32_t>(static_cast<uint8_t>(payload[o + 5]));
+              if (id == 0x4) {
+                stream_window += static_cast<int64_t>(v) - initial_stream_window;
+                initial_stream_window = static_cast<int64_t>(v);
+              }
+            }
             std::string ack = frame_header(0, kFrameSettings, kFlagAck, 0);
             sock.write_all(ack.data(), ack.size());
           }
@@ -487,7 +645,13 @@ CallResult unary_call(const std::string& host, int port, const std::string& path
                            (static_cast<uint8_t>(payload[1]) << 16) |
                            (static_cast<uint8_t>(payload[2]) << 8) |
                            static_cast<uint8_t>(payload[3]);
-            (stream == 0 ? conn_window : stream_window) += inc;
+            // Only our one request stream may be credited: a buggy or
+            // hostile peer crediting other ids must not inflate stream
+            // 1's send window into a flow-control overrun.
+            if (stream == 0)
+              conn_window += inc;
+            else if (stream == 1)
+              stream_window += inc;
           }
           break;
         }
@@ -534,11 +698,15 @@ CallResult unary_call(const std::string& host, int port, const std::string& path
       }
     };
 
-    while (sent < body.size()) {
+    // Stop sending the moment the server half-closes the stream: a legal
+    // early rejection (trailers + END_STREAM mid-upload, no RST, no more
+    // credit) must surface its decoded grpc-status, not burn the full
+    // deadline waiting for WINDOW_UPDATEs that will never come.
+    while (sent < body.size() && !stream_closed) {
       if (expired()) throw std::runtime_error("h2 deadline exceeded during send");
       int64_t window = std::min(conn_window, stream_window);
       if (window <= 0) {
-        pump_one_frame();  // wait for WINDOW_UPDATE
+        pump_one_frame();  // wait for WINDOW_UPDATE (or an early close)
         continue;
       }
       size_t chunk = std::min({body.size() - sent, static_cast<size_t>(window),
@@ -575,15 +743,21 @@ CallResult unary_call(const std::string& host, int port, const std::string& path
       } else if (h.name == "grpc-message" && !h.huffman_value) {
         result.grpc_message = h.value;
       }
-      if (h.huffman_value) any_huffman = true;
+      // Undecodable huffman NAMES count too: the status may hide behind
+      // an opaque name, and the contract is "trailers present but
+      // unreadable -> inferred success + warning", not a hard failure.
+      if (h.huffman_value || h.name == "<huffman>") any_huffman = true;
     }
     if (result.grpc_status >= 0) {
       result.ok = result.grpc_status == 0;
       if (!result.ok && result.grpc_message.empty())
         result.grpc_message = "grpc-status " + std::to_string(result.grpc_status);
     } else if (result.http_status == 200 && any_huffman) {
-      // Trailers present but huffman-coded beyond this decoder's scope:
-      // a clean END_STREAM on a 200 without RST is success in practice.
+      // Trailers present but some string was huffman-UNDECODABLE (a
+      // conformant peer's huffman always decodes — see huffman_decode —
+      // so this is a malformed peer): a clean END_STREAM on a 200
+      // without RST is inferred success, flagged so the caller warns
+      // that a rejection could hide behind the opaque status.
       result.ok = true;
       result.status_undecoded = true;
     } else {

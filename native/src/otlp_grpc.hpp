@@ -12,10 +12,11 @@
 // Scope, deliberately: unary calls, h2c only (the dlopen'd TLS shim has
 // no ALPN, which gRPC-over-TLS servers require — https gRPC endpoints
 // are rejected at startup with a pointed message), HPACK decoding of the
-// static table + literal strings (we advertise SETTINGS_HEADER_TABLE_SIZE
-// 0 so conformant peers never reference a dynamic table entry; huffman-
-// coded strings are treated as opaque and only prevent reading that one
-// header's text, not the call).
+// static table + literal strings with full RFC 7541 huffman decoding
+// (grpc-go huffman-codes literal trailer names like "grpc-status", so a
+// huffman-less decoder misreads every real collector's reply; we still
+// advertise SETTINGS_HEADER_TABLE_SIZE 0 so conformant peers never
+// reference a dynamic table entry).
 #pragma once
 
 #include <cstdint>
@@ -59,8 +60,9 @@ struct CallResult {
   int grpc_status = -1;      // -1 = absent/undecodable
   std::string grpc_message;  // grpc-message trailer when readable
   std::string error;         // transport-level failure, empty on success
-  // Trailers arrived but every candidate grpc-status was huffman-coded:
-  // ok is then inferred from a clean END_STREAM + :status 200.
+  // Trailers arrived but a string was huffman-UNDECODABLE (malformed
+  // peer; conformant huffman always decodes): ok is then inferred from a
+  // clean END_STREAM + :status 200 and the caller logs a warning.
   bool status_undecoded = false;
 };
 
@@ -73,13 +75,18 @@ CallResult unary_call(const std::string& host, int port, const std::string& path
                       const std::vector<std::pair<std::string, std::string>>&
                           metadata = {});
 
-// Test/fuzz hook for the response-path HPACK subset decoder (static table
-// + literals; huffman-coded strings surface as "<huffman>" names or are
-// flagged via the bool). Decodes server-controlled bytes, so the contract
-// is total: returns false on malformed input, never crashes or throws.
-// (name, value, value_is_huffman) per decoded header.
+// Test/fuzz hook for the response-path HPACK decoder (static table +
+// literals + RFC 7541 huffman; only UNDECODABLE huffman surfaces as a
+// "<huffman>" name or the bool flag). Decodes server-controlled bytes, so
+// the contract is total: returns false on malformed input, never crashes
+// or throws. (name, value, value_still_opaque) per decoded header.
 bool hpack_decode_for_test(
     std::string_view block,
     std::vector<std::tuple<std::string, std::string, bool>>& out);
+
+// RFC 7541 §5.2 huffman string decoder (exposed for native unit tests —
+// appendix C vectors). False on invalid padding, EOS-in-string, or a bit
+// path outside the code tree.
+bool huffman_decode_for_test(std::string_view in, std::string& out);
 
 }  // namespace tpupruner::otlp_grpc

@@ -206,13 +206,70 @@ TP_TEST(hpack_static_indexed_and_name_index) {
 }
 
 TP_TEST(hpack_huffman_value_flagged_opaque) {
-  // literal new name "x", value huffman-flagged (0x83 = H bit + len 3)
+  // literal new name "x", value huffman-flagged (0x83 = H bit + len 3);
+  // \x30\x31\x32 decodes part-way ("i0G3") but ends on a 0 padding bit —
+  // invalid per RFC 7541 §5.2, so the value must stay opaque/flagged
   std::string block("\x00\x01x\x83\x30\x31\x32", 7);
   HpackHeaders h;
   TP_CHECK(tpupruner::otlp_grpc::hpack_decode_for_test(block, h));
   TP_CHECK_EQ(h.size(), static_cast<size_t>(1));
   TP_CHECK_EQ(std::get<0>(h[0]), "x");
   TP_CHECK(std::get<2>(h[0]));  // flagged, not decoded
+}
+
+TP_TEST(huffman_rfc7541_appendix_c_vectors) {
+  // the RFC's own request/response examples pin the whole code table
+  auto dec = [](std::string_view in) {
+    std::string out;
+    TP_CHECK(tpupruner::otlp_grpc::huffman_decode_for_test(in, out));
+    return out;
+  };
+  TP_CHECK_EQ(dec("\xf1\xe3\xc2\xe5\xf2\x3a\x6b\xa0\xab\x90\xf4\xff"),
+              "www.example.com");                          // C.4.1
+  TP_CHECK_EQ(dec("\xa8\xeb\x10\x64\x9c\xbf"), "no-cache");  // C.4.2
+  TP_CHECK_EQ(dec("\x25\xa8\x49\xe9\x5b\xa9\x7d\x7f"), "custom-key");
+  TP_CHECK_EQ(dec("\x25\xa8\x49\xe9\x5b\xb8\xe8\xb4\xbf"), "custom-value");
+  TP_CHECK_EQ(dec("\x64\x02"), "302");                       // C.6.1
+  TP_CHECK_EQ(dec("\xae\xc3\x77\x1a\x4b"), "private");       // C.6.1
+  TP_CHECK_EQ(dec(std::string(
+                  "\x9d\x29\xad\x17\x18\x63\xc7\x8f\x0b\x97\xc8\xe9\xae"
+                  "\x82\xae\x43\xd3", 17)),
+              "https://www.example.com");                    // C.6.1
+  TP_CHECK_EQ(dec(std::string(
+                  "\xd0\x7a\xbe\x94\x10\x54\xd4\x44\xa8\x20\x05\x95\x04"
+                  "\x0b\x81\x66\xe0\x82\xa6\x2d\x1b\xff", 22)),
+              "Mon, 21 Oct 2013 20:13:21 GMT");              // C.6.1
+}
+
+TP_TEST(huffman_invalid_rejected) {
+  std::string out;
+  // EOS (30 one-bits) inside the string is a decoding error
+  TP_CHECK(!tpupruner::otlp_grpc::huffman_decode_for_test(
+      std::string("\xff\xff\xff\xff", 4), out));
+  // 'a' followed by 11 one-bits: padding must be < 8 bits
+  out.clear();
+  TP_CHECK(!tpupruner::otlp_grpc::huffman_decode_for_test(
+      std::string("\x1f\xff", 2), out));
+  // empty input decodes to the empty string
+  out.clear();
+  TP_CHECK(tpupruner::otlp_grpc::huffman_decode_for_test("", out));
+  TP_CHECK_EQ(out, "");
+}
+
+TP_TEST(hpack_huffman_coded_trailer_name_decoded) {
+  // the grpc-go shape this decoder exists for: literal with the NAME
+  // huffman-coded ("grpc-status", 11 raw -> 8 coded bytes) and the
+  // 1-byte value "0" raw. Before huffman decoding landed, the name
+  // surfaced as "<huffman>" and every real collector export misread.
+  std::string name_huff("\x9a\xca\xc8\xb2\x12\x34\xda\x8f", 8);
+  std::string block = std::string("\x00\x88", 2) + name_huff +
+                      std::string("\x01""0", 2);
+  HpackHeaders h;
+  TP_CHECK(tpupruner::otlp_grpc::hpack_decode_for_test(block, h));
+  TP_CHECK_EQ(h.size(), static_cast<size_t>(1));
+  TP_CHECK_EQ(std::get<0>(h[0]), "grpc-status");
+  TP_CHECK_EQ(std::get<1>(h[0]), "0");
+  TP_CHECK(!std::get<2>(h[0]));
 }
 
 TP_TEST(hpack_dynamic_size_update_skipped) {

@@ -392,6 +392,77 @@ def test_grpc_collector_rejection_logged_not_fatal(built):
         prom.stop(); k8s.stop(); grpc.stop()
 
 
+def test_fake_collector_huffman_encoder_rfc_vectors():
+    """The fixture's encoder table is pinned by RFC 7541 appendix C — the
+    same vectors the C++ decoder pins (test_otlp_proto.cpp), so the two
+    independently-written tables can only pass together if they agree."""
+    from tpu_pruner.testing.fake_otlp_grpc import huffman_encode
+
+    assert huffman_encode(b"www.example.com") == bytes.fromhex(
+        "f1e3c2e5f23a6ba0ab90f4ff")
+    assert huffman_encode(b"no-cache") == bytes.fromhex("a8eb10649cbf")
+    assert huffman_encode(b"custom-key") == bytes.fromhex("25a849e95ba97d7f")
+    assert huffman_encode(b"custom-value") == bytes.fromhex(
+        "25a849e95bb8e8b4bf")
+    assert huffman_encode(b"Mon, 21 Oct 2013 20:13:21 GMT") == bytes.fromhex(
+        "d07abe941054d444a8200595040b8166e082a62d1bff")
+    assert huffman_encode(b"grpc-status") == bytes.fromhex("9acac8b21234da8f")
+
+
+def test_grpc_huffman_trailers_read_verbatim(built):
+    """grpc-go (otel-collector) huffman-codes the literal trailer NAME
+    'grpc-status'; the client must decode it and read the status — not
+    fall back to inferring success from a clean close (round-4 advisor:
+    the all-raw fake could never catch that misread)."""
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    prom, k8s = FakePrometheus(), FakeK8s()
+    grpc = FakeGrpcCollector(huffman_trailers=True)
+    grpc.start()
+    prom.start(); k8s.start()
+    try:
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", prom.url,
+             "--run-mode", "dry-run", "--otlp-endpoint", grpc.url],
+            capture_output=True, text=True, timeout=60,
+            env={"KUBE_API_URL": k8s.url, "PROMETHEUS_TOKEN": "t",
+                 "PATH": "/usr/bin:/bin",
+                 "OTEL_EXPORTER_OTLP_PROTOCOL": "grpc"})
+        assert proc.returncode == 0, proc.stderr
+        assert "OTLP/gRPC export" not in proc.stderr, proc.stderr
+        # the status was READ (0), not inferred from the clean close
+        assert "undecodable" not in proc.stderr, proc.stderr
+        assert grpc.requests, "collector received nothing"
+    finally:
+        prom.stop(); k8s.stop(); grpc.stop()
+
+
+def test_grpc_huffman_rejection_not_silent_success(built):
+    """A non-zero grpc-status in huffman-coded trailers must surface as a
+    failure with the decoded status/message — the silent-loss mode the
+    gRPC transport exists to eliminate (round-4 advisor low)."""
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    prom, k8s = FakePrometheus(), FakeK8s()
+    grpc = FakeGrpcCollector(grpc_status=13, grpc_message="write failure",
+                             huffman_trailers=True)
+    grpc.start()
+    prom.start(); k8s.start()
+    try:
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", prom.url,
+             "--run-mode", "dry-run", "--otlp-endpoint", grpc.url],
+            capture_output=True, text=True, timeout=60,
+            env={"KUBE_API_URL": k8s.url, "PROMETHEUS_TOKEN": "t",
+                 "PATH": "/usr/bin:/bin",
+                 "OTEL_EXPORTER_OTLP_PROTOCOL": "grpc"})
+        assert proc.returncode == 0, proc.stderr  # telemetry never fails the daemon
+        assert "grpc-status 13" in proc.stderr, proc.stderr
+        assert "write failure" in proc.stderr, proc.stderr
+    finally:
+        prom.stop(); k8s.stop(); grpc.stop()
+
+
 def test_collector_failure_does_not_fail_daemon(built):
     prom, k8s = FakePrometheus(), FakeK8s()
     prom.start(); k8s.start()
@@ -477,6 +548,83 @@ def test_grpc_flow_control_large_payload(built):
         assert len(message) == 512 * 1024  # reassembled across DATA frames
     finally:
         grpc.stop()
+
+
+def test_grpc_server_shrunk_initial_window_honored(built):
+    """RFC 7540 §6.5.2/§6.9.2: the server advertises a 1000-byte
+    SETTINGS_INITIAL_WINDOW_SIZE mid-flight (the delta makes the client's
+    stream window negative) and a bogus WINDOW_UPDATE for a stream the
+    client never opened. The client must (a) go credit-negative and wait,
+    (b) ignore the foreign-stream credit, so every DATA frame after the
+    initial 65535-byte burst fits the 1000-byte replenishment cycle —
+    a client with either round-4 advisor bug bursts 16384-byte frames."""
+    from tpu_pruner import native
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    grpc = FakeGrpcCollector(initial_window_size=1000,
+                             bogus_stream_window_update=True)
+    port = grpc.start()
+    try:
+        out = native.otlp_grpc_call(
+            "127.0.0.1", port, "/test.Service/Big", 256 * 1024)
+        assert out["ok"] is True, out
+        assert len(grpc.requests[0][1]) == 256 * 1024
+    finally:
+        grpc.stop()
+    # frames sent before the server's SETTINGS could reach the client ride
+    # the default 65535 window; everything after must respect the shrunk one
+    sent, after_burst = 0, []
+    for size in grpc.data_frame_sizes:
+        if sent >= 65535:
+            after_burst.append(size)
+        sent += size
+    assert after_burst, grpc.data_frame_sizes
+    assert max(after_burst) <= 1000, grpc.data_frame_sizes
+
+
+def test_grpc_early_rejection_mid_upload_surfaces_status(built):
+    """A server may half-close with trailers before reading the body and
+    stop crediting (legal early rejection, e.g. RESOURCE_EXHAUSTED). The
+    client — stalled mid-upload by a zero initial window — must break out
+    of the send loop and report the decoded status, not burn its deadline
+    waiting for WINDOW_UPDATEs that never come."""
+    import time as time_mod
+
+    from tpu_pruner import native
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    grpc = FakeGrpcCollector(grpc_status=8, grpc_message="quota",
+                             initial_window_size=0, reject_before_body=True)
+    port = grpc.start()
+    try:
+        t0 = time_mod.monotonic()
+        out = native.otlp_grpc_call(
+            "127.0.0.1", port, "/test.Service/Big", 256 * 1024)
+        elapsed = time_mod.monotonic() - t0
+    finally:
+        grpc.stop()
+    assert out["ok"] is False, out
+    assert out["grpc_status"] == 8, out
+    assert out["grpc_message"] == "quota", out
+    assert elapsed < 4, f"status took {elapsed:.1f}s — send loop ate the deadline"
+
+
+def test_grpc_undecodable_trailer_names_infer_success(built):
+    """Trailers whose names are huffman-flagged but UNDECODABLE (malformed
+    peer): the status is unreadable, so a clean 200 END_STREAM is inferred
+    success with status_undecoded set — not a hard export failure."""
+    from tpu_pruner import native
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    grpc = FakeGrpcCollector(corrupt_huffman_names=True)
+    port = grpc.start()
+    try:
+        out = native.otlp_grpc_call("127.0.0.1", port, "/test.Service/E", 64)
+    finally:
+        grpc.stop()
+    assert out["ok"] is True, out
+    assert out["grpc_status"] == -1, out      # never readable
+    assert out["status_undecoded"] is True, out
 
 
 def test_grpc_periodic_export_in_daemon_mode(built):
