@@ -237,10 +237,17 @@ char* tp_otlp_grpc_call(const char* payload_json) {
     std::string message(static_cast<size_t>(require("message_size").as_int()), '\0');
     int timeout_ms = 5000;
     if (const Value* t = p.find("timeout_ms"); t) timeout_ms = static_cast<int>(t->as_int());
+    // "tls_ca" present selects gRPC-over-TLS (ALPN h2) verified against
+    // that CA bundle — the pytest tier's hook for the https path.
+    otlp_grpc::TlsOptions tls;
+    if (const Value* ca = p.find("tls_ca"); ca) {
+      tls.use_tls = true;
+      tls.ca_file = ca->as_string();
+    }
     otlp_grpc::CallResult res = otlp_grpc::unary_call(
         require("host").as_string(),
         static_cast<int>(require("port").as_int()),
-        require("path").as_string(), message, timeout_ms);
+        require("path").as_string(), message, timeout_ms, {}, tls);
     Value out = Value::object();
     out.set("ok", Value(res.ok));
     out.set("http_status", Value(res.http_status));

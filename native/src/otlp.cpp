@@ -178,6 +178,17 @@ Exporter::Exporter(std::string endpoint, int interval_ms)
   metrics_headers_ = signal_headers("OTEL_EXPORTER_OTLP_METRICS_HEADERS");
   traces_headers_ = signal_headers("OTEL_EXPORTER_OTLP_TRACES_HEADERS");
 
+  // OTEL_EXPORTER_OTLP[_SIGNAL]_CERTIFICATE (OTEL spec): CA bundle for
+  // TLS endpoints, same signal-specific-then-base fallback as every
+  // other OTLP env this exporter reads.
+  auto signal_ca = [](const char* signal_var) -> std::string {
+    if (auto v = util::env(signal_var); v && !v->empty()) return *v;
+    if (auto v = util::env("OTEL_EXPORTER_OTLP_CERTIFICATE"); v && !v->empty()) return *v;
+    return "";
+  };
+  metrics_ca_ = signal_ca("OTEL_EXPORTER_OTLP_METRICS_CERTIFICATE");
+  traces_ca_ = signal_ca("OTEL_EXPORTER_OTLP_TRACES_CERTIFICATE");
+
   // Per-signal endpoints (OTEL spec; the reference documents exactly this
   // env shape, README.md:79-98): signal endpoint vars are full URLs used
   // verbatim; `none` exporters disable the signal. For gRPC the service
@@ -200,20 +211,16 @@ Exporter::Exporter(std::string endpoint, int interval_ms)
                            "OTEL_TRACES_EXPORTER", "/v1/traces", traces_grpc_);
 
   // A grpc:// scheme on the endpoint also selects the gRPC transport
-  // (normalized to http for parsing — gRPC here is plaintext h2c).
-  auto normalize = [](std::string& url, bool& grpc, const char* signal) {
+  // (normalized to http for parsing — plaintext h2c); grpcs:// and
+  // https-with-grpc-protocol select gRPC over TLS (ALPN "h2" handshake in
+  // otlp_grpc.cpp, tonic https-endpoint parity: main.rs:146-155).
+  auto normalize = [](std::string& url, bool& grpc, const char*) {
     if (url.rfind("grpc://", 0) == 0) {
       url = "http://" + url.substr(7);
       grpc = true;
-    } else if (url.rfind("grpcs://", 0) == 0 ||
-               (grpc && url.rfind("https://", 0) == 0)) {
-      // gRPC over TLS needs ALPN "h2", which the dlopen'd TLS shim can't
-      // negotiate — refuse loudly rather than export nothing silently.
-      log::warn("otlp", std::string(signal) + " endpoint " + url +
-                ": gRPC over TLS is not supported (no ALPN); use a plaintext "
-                "h2c collector listener or the OTLP/HTTP transport "
-                "(README: OTLP transport). Signal disabled.");
-      url.clear();
+    } else if (url.rfind("grpcs://", 0) == 0) {
+      url = "https://" + url.substr(8);
+      grpc = true;
     }
   };
   normalize(metrics_url_, metrics_grpc_, "metrics");
@@ -240,8 +247,7 @@ Exporter::Exporter(std::string endpoint, int interval_ms)
   warn_if_grpc_port(traces_url_, traces_grpc_, "traces");
 
   if (metrics_url_.empty() && traces_url_.empty()) {
-    // Reached via OTEL_*_EXPORTER=none on both signals OR both endpoints
-    // refused above (gRPC over TLS) — the warn lines say which.
+    // Reached via OTEL_*_EXPORTER=none on both signals.
     log::info("otlp", "OTLP export: no active signal; exporter inert");
     return;  // no thread, no recording — a fully inert exporter
   }
@@ -312,7 +318,7 @@ bool Exporter::export_metrics(int64_t now_nanos) {
     return grpc_post(metrics_url_, otlp_grpc::kMetricsPath,
                      otlp_grpc::encode_metrics_request(
                          log::counters_snapshot(), start_unix_nanos_, now_nanos),
-                     metrics_headers_);
+                     metrics_headers_, metrics_ca_);
   }
   Value metrics = Value::array();
   for (const auto& [name, counter] : log::counters_snapshot()) {
@@ -355,7 +361,8 @@ bool Exporter::export_traces() {
 
   if (traces_grpc_) {
     return grpc_post(traces_url_, otlp_grpc::kTracesPath,
-                     otlp_grpc::encode_traces_request(finished), traces_headers_);
+                     otlp_grpc::encode_traces_request(finished), traces_headers_,
+                     traces_ca_);
   }
   Value spans = Value::array();
   for (FinishedSpan& fs : finished) {
@@ -405,14 +412,20 @@ bool Exporter::export_traces() {
 
 bool Exporter::grpc_post(const std::string& url, const char* path,
                          const std::string& proto,
-                         const std::vector<std::pair<std::string, std::string>>& headers) {
+                         const std::vector<std::pair<std::string, std::string>>& headers,
+                         const std::string& ca_file) {
   auto parsed = http::parse_url(url);
   if (!parsed) {
     log::warn("otlp", "OTLP/gRPC endpoint unparseable: " + url);
     return false;
   }
+  otlp_grpc::TlsOptions tls;
+  if (parsed->scheme == "https") {
+    tls.use_tls = true;
+    tls.ca_file = ca_file;  // per-signal OTEL_*_CERTIFICATE chain (init)
+  }
   otlp_grpc::CallResult res =
-      otlp_grpc::unary_call(parsed->host, parsed->port, path, proto, 5000, headers);
+      otlp_grpc::unary_call(parsed->host, parsed->port, path, proto, 5000, headers, tls);
   if (!res.ok) {
     log::warn("otlp", "OTLP/gRPC export to " + url + path + " failed: " +
               (!res.error.empty() ? res.error

@@ -100,12 +100,16 @@ class Exporter {
   bool post(const std::string& url, const std::string& body_json,
             const std::vector<std::pair<std::string, std::string>>& headers);
   bool grpc_post(const std::string& url, const char* path, const std::string& proto,
-                 const std::vector<std::pair<std::string, std::string>>& headers);
+                 const std::vector<std::pair<std::string, std::string>>& headers,
+                 const std::string& ca_file);
   std::string metrics_url_, traces_url_;  // empty = signal disabled
   bool metrics_grpc_ = false, traces_grpc_ = false;  // OTLP/gRPC transport
   // OTEL_EXPORTER_OTLP[_SIGNAL]_HEADERS: auth/routing headers for managed
   // collectors, applied on both transports.
   std::vector<std::pair<std::string, std::string>> metrics_headers_, traces_headers_;
+  // CA bundle for TLS endpoints, per signal (OTEL spec
+  // OTEL_EXPORTER_OTLP[_SIGNAL]_CERTIFICATE); empty = system trust store.
+  std::string metrics_ca_, traces_ca_;
   int interval_ms_;
   std::atomic<bool> stop_{false};
   std::mutex mutex_;

@@ -14,6 +14,9 @@
 #include <cstring>
 #include <stdexcept>
 
+#include <memory>
+
+#include "tls.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/util.hpp"
 
@@ -199,12 +202,23 @@ constexpr uint8_t kFrameData = 0x0, kFrameHeaders = 0x1, kFrameRst = 0x3,
 constexpr uint8_t kFlagEndStream = 0x1, kFlagAck = 0x1, kFlagEndHeaders = 0x4,
                   kFlagPadded = 0x8, kFlagPriority = 0x20;
 
+// Near-twin of http.cpp's detail::Conn (fd + optional TLS session), kept
+// separate deliberately: that one classifies EAGAIN as a typed timeout
+// for the pooled HTTP/1.1 client's retry logic, while this h2 client
+// needs exact-length reads under a frame-level deadline — merging them
+// would couple two different error taxonomies for ~20 shared lines.
 struct Sock {
   int fd = -1;
+  std::unique_ptr<tls::Conn> tls_conn;  // set = all IO rides the TLS session
   ~Sock() {
+    tls_conn.reset();  // close_notify before the fd goes away
     if (fd >= 0) ::close(fd);
   }
   void write_all(const char* buf, size_t n) {
+    if (tls_conn) {
+      tls_conn->write_all(buf, n);
+      return;
+    }
     size_t off = 0;
     while (off < n) {
       ssize_t w = ::send(fd, buf + off, n - off, MSG_NOSIGNAL);
@@ -215,6 +229,12 @@ struct Sock {
   void read_exact(char* buf, size_t n) {
     size_t off = 0;
     while (off < n) {
+      if (tls_conn) {
+        size_t r = tls_conn->read(buf + off, n - off);
+        if (r == 0) throw std::runtime_error("h2 recv: connection closed");
+        off += r;
+        continue;
+      }
       ssize_t r = ::recv(fd, buf + off, n - off, 0);
       if (r == 0) throw std::runtime_error("h2 recv: connection closed");
       if (r < 0) throw std::runtime_error("h2 recv: " + std::string(std::strerror(errno)));
@@ -539,13 +559,22 @@ bool hpack_decode_for_test(
 CallResult unary_call(const std::string& host, int port, const std::string& path,
                       const std::string& message, int timeout_ms,
                       const std::vector<std::pair<std::string, std::string>>&
-                          metadata) {
+                          metadata,
+                      const TlsOptions& tls_opts) {
   CallResult result;
   auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
   auto expired = [&] { return std::chrono::steady_clock::now() > deadline; };
   try {
     Sock sock;
     sock.fd = dial(host, port, timeout_ms);
+    if (tls_opts.use_tls) {
+      // Handshake with ALPN "h2": gRPC-over-TLS requires the negotiated
+      // protocol (tls::Conn throws the actionable error if the server
+      // selects nothing/else). Reference parity: tonic's https OTLP
+      // endpoints (gpu-pruner/src/main.rs:146-155).
+      sock.tls_conn = std::make_unique<tls::Conn>(
+          sock.fd, host, tls_opts.verify, tls_opts.ca_file, "h2");
+    }
 
     // Connection preface + SETTINGS: table size 0 (no dynamic HPACK state
     // for peers to reference), push off.
@@ -563,7 +592,7 @@ CallResult unary_call(const std::string& host, int port, const std::string& path
     // HEADERS (stream 1): gRPC request pseudo-headers + metadata.
     std::string hb;
     hpack_literal(hb, ":method", "POST");
-    hpack_literal(hb, ":scheme", "http");
+    hpack_literal(hb, ":scheme", tls_opts.use_tls ? "https" : "http");
     hpack_literal(hb, ":path", path);
     hpack_literal(hb, ":authority", host + ":" + std::to_string(port));
     hpack_literal(hb, "te", "trailers");

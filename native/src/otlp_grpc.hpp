@@ -1,6 +1,6 @@
 // OTLP/gRPC transport: hand-rolled protobuf encoding of the two OTLP
-// export requests plus a minimal unary gRPC client over plaintext HTTP/2
-// (h2c with prior knowledge).
+// export requests plus a minimal unary gRPC client over HTTP/2 —
+// plaintext (h2c with prior knowledge) or TLS with ALPN "h2".
 //
 // The reference's `otel` feature exports OTLP over gRPC and its deploy
 // example points OTEL_EXPORTER_OTLP_ENDPOINT at :4317, the gRPC port
@@ -9,10 +9,10 @@
 // for the common in-cluster case — a plaintext collector gRPC listener —
 // selected via OTEL_EXPORTER_OTLP_PROTOCOL=grpc (OTEL spec env).
 //
-// Scope, deliberately: unary calls, h2c only (the dlopen'd TLS shim has
-// no ALPN, which gRPC-over-TLS servers require — https gRPC endpoints
-// are rejected at startup with a pointed message), HPACK decoding of the
-// static table + literal strings with full RFC 7541 huffman decoding
+// Scope, deliberately: unary calls over h2c or h2-over-TLS (ALPN "h2"
+// via the dlopen'd shim — https/grpcs endpoints verified against the
+// default trust store or OTEL_EXPORTER_OTLP_CERTIFICATE), HPACK decoding
+// of the static table + literal strings with full RFC 7541 huffman decoding
 // (grpc-go huffman-codes literal trailer names like "grpc-status", so a
 // huffman-less decoder misreads every real collector's reply; we still
 // advertise SETTINGS_HEADER_TABLE_SIZE 0 so conformant peers never
@@ -66,14 +66,25 @@ struct CallResult {
   bool status_undecoded = false;
 };
 
-// One unary gRPC call (h2c). `message` is the serialized protobuf; the
-// 5-byte gRPC frame header is added internally. `metadata` entries are
-// sent as request headers (names lowercased — h2 requirement). Never
-// throws.
+// TLS for the unary client (https/grpcs endpoints): handshake with ALPN
+// "h2" (required by gRPC servers, RFC 7301) and certificate verification
+// against the default trust store or `ca_file` (OTEL spec
+// OTEL_EXPORTER_OTLP_CERTIFICATE).
+struct TlsOptions {
+  bool use_tls = false;
+  bool verify = true;
+  std::string ca_file;
+};
+
+// One unary gRPC call (h2c, or h2-over-TLS when tls.use_tls). `message`
+// is the serialized protobuf; the 5-byte gRPC frame header is added
+// internally. `metadata` entries are sent as request headers (names
+// lowercased — h2 requirement). Never throws.
 CallResult unary_call(const std::string& host, int port, const std::string& path,
                       const std::string& message, int timeout_ms,
                       const std::vector<std::pair<std::string, std::string>>&
-                          metadata = {});
+                          metadata = {},
+                      const TlsOptions& tls = {});
 
 // Test/fuzz hook for the response-path HPACK decoder (static table +
 // literals + RFC 7541 huffman; only UNDECODABLE huffman surfaces as a

@@ -29,6 +29,8 @@ struct Api {
   int (*SSL_get_error)(const void*, int);
   long (*SSL_get_verify_result)(const void*);
   int (*SSL_set1_host)(void*, const char*);
+  int (*SSL_set_alpn_protos)(void*, const unsigned char*, unsigned int);
+  void (*SSL_get0_alpn_selected)(const void*, const unsigned char**, unsigned int*);
   unsigned long (*ERR_get_error)();
   void (*ERR_error_string_n)(unsigned long, char*, size_t);
 
@@ -70,6 +72,8 @@ const Api& api() {
     load(out.SSL_get_error, "SSL_get_error", ssl);
     load(out.SSL_get_verify_result, "SSL_get_verify_result", ssl);
     load(out.SSL_set1_host, "SSL_set1_host", ssl);
+    load(out.SSL_set_alpn_protos, "SSL_set_alpn_protos", ssl);
+    load(out.SSL_get0_alpn_selected, "SSL_get0_alpn_selected", ssl);
     load(out.ERR_get_error, "ERR_get_error", crypto);
     load(out.ERR_error_string_n, "ERR_error_string_n", crypto);
     out.ok = all;
@@ -92,7 +96,8 @@ std::string last_error(const std::string& what) {
 
 bool available() { return api().ok; }
 
-Conn::Conn(int fd, const std::string& sni_host, bool verify, const std::string& ca_file) {
+Conn::Conn(int fd, const std::string& sni_host, bool verify, const std::string& ca_file,
+           const std::string& alpn) {
   const Api& a = api();
   if (!a.ok) {
     throw std::runtime_error(
@@ -128,6 +133,15 @@ Conn::Conn(int fd, const std::string& sni_host, bool verify, const std::string& 
   a.SSL_ctrl(ssl_, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
              const_cast<char*>(sni_host.c_str()));
   if (verify) a.SSL_set1_host(ssl_, sni_host.c_str());
+  if (!alpn.empty()) {
+    // RFC 7301 wire format: length-prefixed protocol names.
+    std::string wire;
+    wire.push_back(static_cast<char>(alpn.size()));
+    wire += alpn;
+    // Returns 0 on success (unlike most SSL_* APIs).
+    a.SSL_set_alpn_protos(ssl_, reinterpret_cast<const unsigned char*>(wire.data()),
+                          static_cast<unsigned int>(wire.size()));
+  }
 
   int rc = a.SSL_connect(ssl_);
   if (rc != 1) {
@@ -139,6 +153,25 @@ Conn::Conn(int fd, const std::string& sni_host, bool verify, const std::string& 
     a.SSL_CTX_free(ctx_);
     ssl_ = ctx_ = nullptr;
     throw std::runtime_error(err);
+  }
+  if (!alpn.empty()) {
+    // gRPC servers require the negotiated protocol, not just a working
+    // TLS session: no/different selection means the peer would reset the
+    // h2 stream anyway — fail with the actionable error instead.
+    const unsigned char* sel = nullptr;
+    unsigned int sel_len = 0;
+    a.SSL_get0_alpn_selected(ssl_, &sel, &sel_len);
+    if (!sel || std::string(reinterpret_cast<const char*>(sel), sel_len) != alpn) {
+      a.SSL_free(ssl_);
+      a.SSL_CTX_free(ctx_);
+      ssl_ = ctx_ = nullptr;
+      throw std::runtime_error(
+          "tls: server did not negotiate ALPN \"" + alpn +
+          "\" (selected " +
+          (sel ? "\"" + std::string(reinterpret_cast<const char*>(sel), sel_len) + "\""
+               : "nothing") +
+          "); the endpoint does not speak HTTP/2 — is it a gRPC listener?");
+    }
   }
 }
 

@@ -17,10 +17,13 @@ bool available();
 
 // One TLS client session over an already-connected socket fd.
 // Construction performs the handshake; throws std::runtime_error on
-// failure (including certificate verification when verify=true).
+// failure (including certificate verification when verify=true, and a
+// missing/different ALPN selection when `alpn` is non-empty — gRPC
+// servers require a negotiated "h2", RFC 7301).
 class Conn {
  public:
-  Conn(int fd, const std::string& sni_host, bool verify, const std::string& ca_file);
+  Conn(int fd, const std::string& sni_host, bool verify, const std::string& ca_file,
+       const std::string& alpn = "");
   ~Conn();
   Conn(const Conn&) = delete;
   Conn& operator=(const Conn&) = delete;

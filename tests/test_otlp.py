@@ -228,8 +228,8 @@ def test_grpc_endpoint_guardrails(built):
     OTEL_EXPORTER_OTLP_ENDPOINT at :4317 — the gRPC port. The gRPC
     transport now exists, so the :4317-with-HTTP-protocol mismatch warns
     and points at OTEL_EXPORTER_OTLP_PROTOCOL=grpc, the grpc protocol
-    request is honored (no warning), and gRPC-over-TLS (no ALPN in the
-    TLS shim) is refused loudly instead of silently exporting nothing."""
+    request is honored (no warning), and gRPC-over-TLS endpoints
+    (https/grpcs) are accepted and attempted (ALPN h2, round 5)."""
     prom, k8s = FakePrometheus(), FakeK8s()
     prom.start(); k8s.start()
     try:
@@ -267,10 +267,18 @@ def test_grpc_endpoint_guardrails(built):
         assert "metrics -> http://127.0.0.1:1 [grpc]" in p.stderr
         assert "/v1/metrics" not in p.stderr.split("OTLP export:")[1].splitlines()[0]
 
-        # gRPC over TLS: refused loudly (no ALPN in the dlopen'd TLS shim)
+        # gRPC over TLS: a real transport since round 5 (ALPN h2 in the
+        # TLS shim) — the https endpoint is kept and ATTEMPTED, with the
+        # failure surfaced per-export, never silently dropped
         p = run({"OTEL_EXPORTER_OTLP_ENDPOINT": "https://collector:4317",
                  "OTEL_EXPORTER_OTLP_PROTOCOL": "grpc"})
-        assert "gRPC over TLS is not supported" in p.stderr
+        assert "gRPC over TLS is not supported" not in p.stderr
+        assert "https://collector:4317 [grpc]" in p.stderr
+        assert "OTLP/gRPC export" in p.stderr  # attempted + failure logged
+
+        # grpcs:// scheme: TLS + gRPC in one
+        p = run({"OTEL_EXPORTER_OTLP_TRACES_ENDPOINT": "grpcs://127.0.0.1:1"})
+        assert "traces -> https://127.0.0.1:1 [grpc]" in p.stderr
 
         # no false positive on the HTTP port
         p = run({"OTEL_EXPORTER_OTLP_ENDPOINT": "http://collector:4318"})
@@ -580,6 +588,128 @@ def test_grpc_server_shrunk_initial_window_honored(built):
         sent += size
     assert after_burst, grpc.data_frame_sizes
     assert max(after_burst) <= 1000, grpc.data_frame_sizes
+
+
+def test_grpc_over_tls_exports_end_to_end(built, tls_certs):
+    """gRPC over TLS (https endpoint): ALPN-h2 handshake, certificate
+    verified against OTEL_EXPORTER_OTLP_CERTIFICATE, exports land — the
+    reference's tonic https-endpoint shape (main.rs:146-155), previously
+    this repo's last refused transport configuration."""
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    cert, key = tls_certs
+    prom, k8s = FakePrometheus(), FakeK8s()
+    grpc = FakeGrpcCollector()
+    port = grpc.start(certfile=cert, keyfile=key)
+    prom.start(); k8s.start()
+    try:
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", prom.url,
+             "--run-mode", "dry-run",
+             "--otlp-endpoint", f"https://localhost:{port}"],
+            capture_output=True, text=True, timeout=60,
+            env={"KUBE_API_URL": k8s.url, "PROMETHEUS_TOKEN": "t",
+                 "PATH": "/usr/bin:/bin",
+                 "OTEL_EXPORTER_OTLP_PROTOCOL": "grpc",
+                 "OTEL_EXPORTER_OTLP_CERTIFICATE": cert})
+        assert proc.returncode == 0, proc.stderr
+        assert "OTLP/gRPC export" not in proc.stderr, proc.stderr
+        assert grpc.requests, "collector received nothing over TLS"
+    finally:
+        prom.stop(); k8s.stop(); grpc.stop()
+
+
+def test_grpcs_scheme_selects_tls_grpc(built, tls_certs):
+    """grpcs:// endpoints select the gRPC transport AND TLS in one go."""
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    cert, key = tls_certs
+    prom, k8s = FakePrometheus(), FakeK8s()
+    grpc = FakeGrpcCollector()
+    port = grpc.start(certfile=cert, keyfile=key)
+    prom.start(); k8s.start()
+    try:
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", prom.url,
+             "--run-mode", "dry-run",
+             "--otlp-endpoint", f"grpcs://localhost:{port}"],
+            capture_output=True, text=True, timeout=60,
+            env={"KUBE_API_URL": k8s.url, "PROMETHEUS_TOKEN": "t",
+                 "PATH": "/usr/bin:/bin",
+                 "OTEL_EXPORTER_OTLP_CERTIFICATE": cert})
+        assert proc.returncode == 0, proc.stderr
+        assert "OTLP/gRPC export" not in proc.stderr, proc.stderr
+        assert grpc.requests, "collector received nothing via grpcs://"
+    finally:
+        prom.stop(); k8s.stop(); grpc.stop()
+
+
+def test_grpc_tls_signal_specific_certificate_env(built, tls_certs):
+    """OTEL_EXPORTER_OTLP_TRACES_CERTIFICATE (signal-specific, OTEL spec)
+    must be honored like every other per-signal OTLP env — with only the
+    base var unset, a private-CA collector still verifies."""
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    cert, key = tls_certs
+    prom, k8s = FakePrometheus(), FakeK8s()
+    grpc = FakeGrpcCollector()
+    port = grpc.start(certfile=cert, keyfile=key)
+    prom.start(); k8s.start()
+    try:
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", prom.url,
+             "--run-mode", "dry-run"],
+            capture_output=True, text=True, timeout=60,
+            env={"KUBE_API_URL": k8s.url, "PROMETHEUS_TOKEN": "t",
+                 "PATH": "/usr/bin:/bin",
+                 "OTEL_EXPORTER_OTLP_TRACES_ENDPOINT":
+                     f"grpcs://localhost:{port}",
+                 "OTEL_METRICS_EXPORTER": "none",
+                 "OTEL_EXPORTER_OTLP_TRACES_CERTIFICATE": cert})
+        assert proc.returncode == 0, proc.stderr
+        assert "OTLP/gRPC export" not in proc.stderr, proc.stderr
+        assert grpc.requests, "collector received nothing"
+    finally:
+        prom.stop(); k8s.stop(); grpc.stop()
+
+
+def test_grpc_tls_without_alpn_fails_loudly(built, tls_certs):
+    """A TLS server that negotiates no ALPN protocol cannot be a gRPC
+    peer: the export must fail with the actionable ALPN error (and the
+    daemon carry on), never hang or pretend success."""
+    from tpu_pruner import native
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    cert, key = tls_certs
+    grpc = FakeGrpcCollector()
+    port = grpc.start(certfile=cert, keyfile=key, alpn=None)
+    try:
+        out = native.otlp_grpc_call("localhost", port, "/test.Service/E",
+                                    64, tls_ca=cert)
+        assert out["ok"] is False, out
+        assert "ALPN" in out.get("call_error", ""), out
+    finally:
+        grpc.stop()
+
+
+def test_grpc_tls_unknown_ca_rejected(built, tls_certs):
+    """TLS verification stays on for gRPC: a server whose cert is not in
+    the trust bundle is rejected at handshake (no silent export)."""
+    from tpu_pruner import native
+    from tpu_pruner.testing.fake_otlp_grpc import FakeGrpcCollector
+
+    cert, key = tls_certs
+    grpc = FakeGrpcCollector()
+    port = grpc.start(certfile=cert, keyfile=key)
+    try:
+        # default trust store: our self-signed cert is unknown
+        out = native.otlp_grpc_call("localhost", port, "/test.Service/E",
+                                    64, tls_ca="")
+        assert out["ok"] is False, out
+        assert "handshake" in out.get("call_error", "").lower() or \
+            "certificate" in out.get("call_error", "").lower(), out
+    finally:
+        grpc.stop()
 
 
 def test_grpc_early_rejection_mid_upload_surfaces_status(built):

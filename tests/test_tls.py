@@ -6,7 +6,6 @@ of an unknown CA, and verify mode trusting a --prometheus-tls-cert bundle
 (including hostname verification via SAN).
 """
 
-import datetime
 import subprocess
 
 import pytest
@@ -16,37 +15,10 @@ from tpu_pruner.testing import FakeK8s, FakePrometheus
 
 
 @pytest.fixture(scope="module")
-def certs(tmp_path_factory):
-    """Self-signed CA-ish cert for CN/SAN localhost."""
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import rsa
-    from cryptography.x509.oid import NameOID
-
-    tmp = tmp_path_factory.mktemp("certs")
-    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
-    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
-    now = datetime.datetime.now(datetime.timezone.utc)
-    cert = (
-        x509.CertificateBuilder()
-        .subject_name(name)
-        .issuer_name(name)
-        .public_key(key.public_key())
-        .serial_number(x509.random_serial_number())
-        .not_valid_before(now - datetime.timedelta(minutes=5))
-        .not_valid_after(now + datetime.timedelta(days=1))
-        .add_extension(
-            x509.SubjectAlternativeName([x509.DNSName("localhost")]), critical=False)
-        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
-        .sign(key, hashes.SHA256())
-    )
-    cert_path = tmp / "cert.pem"
-    key_path = tmp / "key.pem"
-    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
-    key_path.write_bytes(key.private_bytes(
-        serialization.Encoding.PEM, serialization.PrivateFormat.TraditionalOpenSSL,
-        serialization.NoEncryption()))
-    return str(cert_path), str(key_path)
+def certs(tls_certs):
+    """Self-signed CA-ish cert for CN/SAN localhost (the shared conftest
+    fixture; kept under the local name the tests here predate)."""
+    return tls_certs
 
 
 @pytest.fixture()

@@ -154,9 +154,12 @@ def target_meta(target: dict) -> dict:
 
 
 def otlp_grpc_call(host: str, port: int, path: str, message_size: int,
-                   timeout_ms: int = 5000) -> dict:
+                   timeout_ms: int = 5000, tls_ca: str | None = None) -> dict:
     """Test hook: drive the OTLP/gRPC unary client with an arbitrary-size
-    zero-filled payload (otlp_grpc.cpp flow-control coverage)."""
-    return _call("tp_otlp_grpc_call", {
-        "host": host, "port": port, "path": path,
-        "message_size": message_size, "timeout_ms": timeout_ms})
+    zero-filled payload (otlp_grpc.cpp flow-control coverage). tls_ca
+    selects gRPC-over-TLS (ALPN h2) verified against that CA bundle."""
+    payload = {"host": host, "port": port, "path": path,
+               "message_size": message_size, "timeout_ms": timeout_ms}
+    if tls_ca is not None:
+        payload["tls_ca"] = tls_ca
+    return _call("tp_otlp_grpc_call", payload)

@@ -246,7 +246,18 @@ class FakeGrpcCollector:
         self._sock: socket.socket | None = None
         self._stop = threading.Event()
 
-    def start(self) -> int:
+    def start(self, certfile: str | None = None, keyfile: str | None = None,
+              alpn: list[str] | None = ("h2",)) -> int:
+        """certfile/keyfile switch the listener to TLS (gRPC-over-TLS
+        testing); `alpn` is what the server offers — pass None to model a
+        TLS server without ALPN, which a gRPC client must reject."""
+        self._tls_ctx = None
+        if certfile:
+            import ssl
+            self._tls_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._tls_ctx.load_cert_chain(certfile, keyfile)
+            if alpn:
+                self._tls_ctx.set_alpn_protocols(list(alpn))
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", 0))
@@ -257,7 +268,8 @@ class FakeGrpcCollector:
     @property
     def url(self) -> str:
         assert self._sock is not None
-        return f"http://127.0.0.1:{self._sock.getsockname()[1]}"
+        scheme = "https" if self._tls_ctx else "http"
+        return f"{scheme}://127.0.0.1:{self._sock.getsockname()[1]}"
 
     def stop(self) -> None:
         self._stop.set()
@@ -280,6 +292,12 @@ class FakeGrpcCollector:
         # Without NODELAY, Nagle + delayed ACK turns every WINDOW_UPDATE
         # exchange into ~40ms (the shrunk-window test does ~200 of them).
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._tls_ctx is not None:
+            try:
+                conn = self._tls_ctx.wrap_socket(conn, server_side=True)
+            except Exception:
+                conn.close()  # handshake refused (e.g. client bailed on ALPN)
+                return
         try:
             buf = b""
             while len(buf) < len(PREFACE):
