@@ -352,7 +352,7 @@ bool Exporter::export_metrics(int64_t now_nanos) {
 
   Value body = Value::object();
   body.set("resourceMetrics", Value(json::Array{std::move(rm)}));
-  return post(metrics_url_, body.dump(), metrics_headers_);
+  return post(metrics_url_, body.dump(), metrics_headers_, metrics_ca_);
 }
 
 bool Exporter::export_traces() {
@@ -407,7 +407,7 @@ bool Exporter::export_traces() {
 
   Value body = Value::object();
   body.set("resourceSpans", Value(json::Array{std::move(rs)}));
-  return post(traces_url_, body.dump(), traces_headers_);
+  return post(traces_url_, body.dump(), traces_headers_, traces_ca_);
 }
 
 bool Exporter::grpc_post(const std::string& url, const char* path,
@@ -445,9 +445,12 @@ bool Exporter::grpc_post(const std::string& url, const char* path,
 }
 
 bool Exporter::post(const std::string& url, const std::string& body_json,
-                    const std::vector<std::pair<std::string, std::string>>& headers) {
+                    const std::vector<std::pair<std::string, std::string>>& headers,
+                    const std::string& ca_file) {
   try {
-    http::Client client;
+    // Same OTEL_EXPORTER_OTLP[_SIGNAL]_CERTIFICATE chain as the gRPC
+    // transport — the spec defines the env for both.
+    http::Client client(http::TlsMode::Verify, ca_file);
     http::Request req;
     req.method = "POST";
     req.url = url;

@@ -98,7 +98,8 @@ class Exporter {
   bool export_metrics(int64_t now_nanos);
   bool export_traces();
   bool post(const std::string& url, const std::string& body_json,
-            const std::vector<std::pair<std::string, std::string>>& headers);
+            const std::vector<std::pair<std::string, std::string>>& headers,
+            const std::string& ca_file);
   bool grpc_post(const std::string& url, const char* path, const std::string& proto,
                  const std::vector<std::pair<std::string, std::string>>& headers,
                  const std::string& ca_file);

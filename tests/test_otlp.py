@@ -16,8 +16,9 @@ class FakeOtlpCollector:
         self.requests = []
         self.header_log = []  # dict of request headers per POST, in order
         self._server = None
+        self._tls = False
 
-    def start(self):
+    def start(self, certfile=None, keyfile=None):
         fake = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -35,13 +36,21 @@ class FakeOtlpCollector:
                 self.end_headers()
                 self.wfile.write(resp)
 
+        self._tls = certfile is not None
         self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        if certfile:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True)
         threading.Thread(target=self._server.serve_forever, daemon=True).start()
         return self._server.server_address[1]
 
     @property
     def url(self):
-        return f"http://127.0.0.1:{self._server.server_address[1]}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://127.0.0.1:{self._server.server_address[1]}"
 
     def stop(self):
         if self._server:
@@ -588,6 +597,31 @@ def test_grpc_server_shrunk_initial_window_honored(built):
         sent += size
     assert after_burst, grpc.data_frame_sizes
     assert max(after_burst) <= 1000, grpc.data_frame_sizes
+
+
+def test_http_transport_honors_certificate_env(built, tls_certs):
+    """The OTLP/HTTP JSON transport must honor the same
+    OTEL_EXPORTER_OTLP_CERTIFICATE chain as gRPC (OTEL spec defines the
+    env for both): a private-CA https collector verifies and receives."""
+    cert, key = tls_certs
+    prom, k8s = FakePrometheus(), FakeK8s()
+    col = FakeOtlpCollector()
+    port = col.start(certfile=cert, keyfile=key)
+    prom.start(); k8s.start()
+    try:
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", prom.url,
+             "--run-mode", "dry-run",
+             "--otlp-endpoint", f"https://localhost:{port}"],
+            capture_output=True, text=True, timeout=60,
+            env={"KUBE_API_URL": k8s.url, "PROMETHEUS_TOKEN": "t",
+                 "PATH": "/usr/bin:/bin",
+                 "OTEL_EXPORTER_OTLP_CERTIFICATE": cert})
+        assert proc.returncode == 0, proc.stderr
+        assert "OTLP export to" not in proc.stderr, proc.stderr  # no failures
+        assert any(p == "/v1/metrics" for p, _ in col.requests), col.requests
+    finally:
+        prom.stop(); k8s.stop(); col.stop()
 
 
 def test_grpc_over_tls_exports_end_to_end(built, tls_certs):

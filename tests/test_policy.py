@@ -565,3 +565,114 @@ def test_streaming_uniform_matches_qc_window():
                                         chips_per_slice=cps)
         np.testing.assert_array_equal(np.asarray(qu_v), np.asarray(qc_v))
         np.testing.assert_array_equal(np.asarray(qu_c), np.asarray(qc_c))
+
+
+# ── sharded forms of the RECOMMENDED evaluators (VERDICT r4 #2) ──────────
+
+
+def test_sharded_qc_matches_single_device_qc():
+    """evaluate_fleet_sharded_qc ≡ evaluate_fleet_qc on the 8-device mesh:
+    per-shard cumsum over clipped bounds + one psum, heterogeneous slice
+    sizes spanning shard boundaries, chip count NOT divisible by mesh."""
+    from tpu_pruner.policy import (
+        evaluate_fleet_qc, evaluate_fleet_sharded_qc, quantize_fleet_inputs,
+        slice_bounds)
+
+    rng = np.random.default_rng(7)
+    # heterogeneous contiguous slices: sizes 1..23, C=100 (pads to 104)
+    sizes = [1, 23, 4, 9, 17, 2, 11, 6, 13, 14]
+    C, S = sum(sizes), len(sizes)
+    assert C == 100
+    slice_id = np.repeat(np.arange(S, dtype=np.int32), sizes)
+    tc = rng.uniform(0, 1, (C, 12)).astype(np.float32)
+    idle_rows = np.isin(slice_id, [1, 4, 7])
+    tc[idle_rows] = 0.0
+    hbm = np.zeros_like(tc)
+    valid = np.ones((C, 12), dtype=bool)
+    age = np.full((C,), 7200.0, np.float32)
+    inputs = (jnp.asarray(tc), jnp.asarray(hbm), jnp.asarray(valid),
+              jnp.asarray(age), jnp.asarray(slice_id),
+              params_array(PolicyParams()))
+    q = quantize_fleet_inputs(inputs)
+    bounds = slice_bounds(slice_id, S)
+    ref_v, ref_c = evaluate_fleet_qc(q[0], q[1], q[2], bounds, q[4])
+    sh_v, sh_c = evaluate_fleet_sharded_qc(q[0], q[1], q[2], bounds, q[4])
+    np.testing.assert_array_equal(np.asarray(sh_v), np.asarray(ref_v))
+    np.testing.assert_array_equal(np.asarray(sh_c), np.asarray(ref_c))
+    assert np.asarray(sh_v).sum() == 3
+
+
+def test_sharded_qc_cross_shard_veto():
+    """A slice spanning every shard is vetoed by ONE busy chip in the last
+    shard — the psum'd busy count is what carries the veto across devices."""
+    from tpu_pruner.policy import (
+        evaluate_fleet_sharded_qc, quantize_fleet_inputs, slice_bounds)
+
+    C, S = 64, 2  # slice 0: chips 0..47 (6 per shard on 8 devices), slice 1: rest
+    slice_id = np.array([0] * 48 + [1] * 16, dtype=np.int32)
+    tc = np.zeros((C, 4), dtype=np.float32)
+    tc[47, 2] = 0.9  # busy chip of slice 0 lands in a late shard
+    inputs = (jnp.asarray(tc), jnp.zeros((C, 4), jnp.float32),
+              jnp.ones((C, 4), dtype=bool), jnp.full((C,), 7200.0, jnp.float32),
+              jnp.asarray(slice_id), params_array(PolicyParams()))
+    q = quantize_fleet_inputs(inputs)
+    bounds = slice_bounds(slice_id, S)
+    v, c = evaluate_fleet_sharded_qc(q[0], q[1], q[2], bounds, q[4])
+    assert not bool(np.asarray(v)[0])  # vetoed across shards
+    assert bool(np.asarray(v)[1])
+    assert not bool(np.asarray(c)[47])
+
+
+def test_sharded_qu_matches_single_device_qu():
+    """evaluate_fleet_sharded_qu ≡ evaluate_fleet_qu: collective-free
+    whole-slices-per-shard layout, incl. slice-count padding (S=10 pads
+    to 16 on the 8-device mesh)."""
+    from tpu_pruner.policy import (
+        assert_uniform_slices, evaluate_fleet_qu, evaluate_fleet_sharded_qu,
+        quantize_fleet_inputs)
+
+    C, S = 100, 10
+    cps = C // S
+    inputs, _ = make_example_fleet(num_chips=C, num_slices=S, idle_fraction=0.3)
+    assert_uniform_slices(np.asarray(inputs[4]), cps)
+    q = quantize_fleet_inputs(inputs)
+    ref_v, ref_c = evaluate_fleet_qu(q[0], q[1], q[2], q[4], chips_per_slice=cps)
+    sh_v, sh_c = evaluate_fleet_sharded_qu(q[0], q[1], q[2], q[4],
+                                           chips_per_slice=cps)
+    np.testing.assert_array_equal(np.asarray(sh_v), np.asarray(ref_v))
+    np.testing.assert_array_equal(np.asarray(sh_c), np.asarray(ref_c))
+
+
+def test_sharded_stream_step_matches_single_device_window():
+    """make_sharded_stream_step ≡ update_window + evaluate_window_qu over
+    a multi-cycle streaming run: same rings, same verdicts each cycle,
+    including eviction (more cycles than ring chunks)."""
+    from tpu_pruner.policy import (
+        evaluate_window_qu, init_window, make_sharded_stream_step,
+        quantize_params, quantize_samples, update_window)
+
+    devices = jax.devices()[:8]
+    mesh = Mesh(np.array(devices), axis_names=("fleet",))
+    C, cps, K, T_new = 64, 4, 5, 3  # 16 slices, 2 per shard
+    age = jnp.full((C,), 7200.0, jnp.float32)
+    pq = jnp.asarray(quantize_params(params_array(PolicyParams())))
+    step = make_sharded_stream_step(mesh, chips_per_slice=cps)
+
+    rng = np.random.default_rng(3)
+    sh_state = init_window(C, K)
+    ref_state = init_window(C, K)
+    for cycle in range(8):  # > K: exercises ring eviction
+        util = rng.uniform(0, 1, (C, T_new)).astype(np.float32)
+        util[rng.uniform(size=C) < 0.6] = 0.0  # many idle rows, varying
+        valid = rng.uniform(size=(C, T_new)) < 0.9
+        tc_new = jnp.asarray(quantize_samples(util, valid))
+        hbm_new = jnp.asarray(quantize_samples(np.zeros_like(util), valid))
+
+        sh_state, sh_v = step(sh_state, tc_new, hbm_new, age, pq)
+        ref_state = update_window(ref_state, tc_new, hbm_new)
+        ref_v, _ = evaluate_window_qu(ref_state, age, pq, chips_per_slice=cps)
+        np.testing.assert_array_equal(
+            np.asarray(sh_v), np.asarray(ref_v), err_msg=f"cycle {cycle}")
+        np.testing.assert_array_equal(
+            np.asarray(sh_state[0]), np.asarray(ref_state[0]))
+        assert int(sh_state[2]) == int(ref_state[2])

@@ -12,6 +12,34 @@ reduction the multi-host JobSet gate performs, at fleet scale.
 Semantics mirror the query layer exactly (native/src/query.cpp):
 peak == 0 over the window, HBM-bandwidth ``unless`` corroboration, and the
 lookback+grace age gate (reference: query.promql.j2 + main.rs:494-510).
+
+Deployment contract — which evaluator for which fleet, single- and
+multi-device (every pairing below has CPU-mesh parity tests in
+tests/test_policy.py and is exercised by ``__graft_entry__.dryrun_multichip``):
+
+==========================  =========================  ===========================
+fleet layout                single device              device mesh
+==========================  =========================  ===========================
+uniform contiguous          ``evaluate_fleet_qu``      ``evaluate_fleet_sharded_qu``
+(all slices equal-size;     (reshape+all, fused)       (whole slices per shard —
+``assert_uniform_slices``                              NO collective)
+at ingest)
+heterogeneous contiguous    ``evaluate_fleet_qc``      ``evaluate_fleet_sharded_qc``
+(sorted by slice;           (cumsum + boundary         (per-shard cumsum + one
+``slice_bounds`` at          gather)                    ``psum`` of slice counts)
+ingest)
+arbitrary order             ``evaluate_fleet_q``       ``evaluate_fleet_sharded_q``
+                            (segment_sum scatter)      (segment_sum + ``psum``)
+streaming (daemon loop)     ``update_window`` +        ``make_sharded_stream_step``
+                            ``evaluate_window_qu/qc``  (fused update+verdict per
+                                                       shard, no collective)
+==========================  =========================  ===========================
+
+int8 quantized storage (``quantize_fleet_inputs``) is the recommended
+form everywhere — the pass is bandwidth-bound and verdict parity with
+f32 is exact (engine.py UTIL_SCALE block). f32 forms (``evaluate_fleet``,
+``evaluate_fleet_c``, ``evaluate_fleet_sharded``) remain for ingest paths
+that cannot pre-quantize.
 """
 
 from tpu_pruner.policy.engine import (
@@ -26,15 +54,21 @@ from tpu_pruner.policy.engine import (
     evaluate_fleet_qu,
     evaluate_fleet_sharded,
     evaluate_fleet_sharded_q,
+    evaluate_fleet_sharded_qc,
+    evaluate_fleet_sharded_qu,
     evaluate_window_qc,
     evaluate_window_qu,
     init_window,
     make_example_fleet,
     make_sharded_evaluator,
     make_sharded_evaluator_q,
+    make_sharded_evaluator_qc,
+    make_sharded_evaluator_qu,
+    make_sharded_stream_step,
     quantize_fleet_inputs,
     quantize_params,
     quantize_samples,
+    shard_bounds,
     slice_bounds,
     slice_verdicts,
     slice_verdicts_contiguous,
@@ -52,15 +86,21 @@ __all__ = [
     "evaluate_fleet_qu",
     "evaluate_fleet_sharded",
     "evaluate_fleet_sharded_q",
+    "evaluate_fleet_sharded_qc",
+    "evaluate_fleet_sharded_qu",
     "evaluate_window_qc",
     "evaluate_window_qu",
     "init_window",
     "make_example_fleet",
     "make_sharded_evaluator",
     "make_sharded_evaluator_q",
+    "make_sharded_evaluator_qc",
+    "make_sharded_evaluator_qu",
+    "make_sharded_stream_step",
     "quantize_fleet_inputs",
     "quantize_params",
     "quantize_samples",
+    "shard_bounds",
     "slice_bounds",
     "slice_verdicts",
     "slice_verdicts_contiguous",

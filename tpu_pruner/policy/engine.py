@@ -398,6 +398,212 @@ def evaluate_fleet_sharded_q(tc_q, hbm_q, pod_age_s, slice_id, params_arr_q,
         params_arr_q, num_slices, mesh, axis, quantized=True)
 
 
+# --- sharded variants of the RECOMMENDED evaluators -------------------------
+#
+# Round 4 proved multi-chip correctness only for the slowest path
+# (segment_sum + psum); the configurations the package recommends — the
+# contiguous cumsum (qc), the uniform reshape (qu), and the streaming
+# window — were single-device only. The sharded forms below keep each
+# path's own reduction per shard and add the MINIMUM cross-device work:
+#
+# - qu / streaming: shards are cut on slice boundaries (whole slices per
+#   device), so per-slice verdicts are purely local — NO collective at
+#   all; the verdict vector itself comes back sharded over the mesh.
+# - qc: slices may span shards, so each shard runs its cumsum over per-
+#   shard CLIPPED bounds and one psum merges the per-slice busy/chip
+#   counts — "per-shard cumsum with psum'd verdicts".
+#
+# This is the deployment contract for multi-host fleets: uniform fleets
+# shard collective-free; heterogeneous contiguous fleets pay exactly one
+# psum; arbitrary (unsorted) fleets keep the segment_sum path above.
+
+
+def shard_bounds(bounds, n_shards: int, shard_size: int):
+    """Per-shard clipped segment bounds ([n_shards, S+1] int32, host-side).
+
+    Shard d sees global chips [d*shard_size, (d+1)*shard_size); clipping
+    the global bounds into that range yields, for every slice, the part
+    of it that lives on shard d (possibly empty) — the cumsum boundary
+    gather then counts exactly the local busy chips of each slice.
+    """
+    b = np.asarray(bounds)
+    offs = np.arange(n_shards, dtype=np.int64) * shard_size
+    return jnp.asarray(
+        np.clip(b[None, :] - offs[:, None], 0, shard_size).astype(np.int32))
+
+
+def make_sharded_evaluator_qc(mesh: Mesh, num_slices: int, axis: str = "fleet"):
+    """int8 + per-shard cumsum + psum'd per-slice counts (recommended
+    layout for heterogeneous slice-contiguous fleets on a mesh)."""
+
+    def local_eval(tc_q, hbm_q, pod_age_s, local_bounds, params_arr):
+        lb = local_bounds[0]  # [1, S+1] shard -> this shard's bounds
+        candidate = evaluate_chips_q(
+            tc_q, hbm_q, pod_age_s, params_arr[0], params_arr[1]
+        )
+        busy_cum = jnp.cumsum((~candidate).astype(jnp.int32))
+        busy_cum = jnp.concatenate([jnp.zeros((1,), jnp.int32), busy_cum])
+        busy = jax.lax.psum(busy_cum[lb[1:]] - busy_cum[lb[:-1]], axis)
+        chips = jax.lax.psum(lb[1:] - lb[:-1], axis)
+        return (busy == 0) & (chips > 0), candidate
+
+    del num_slices  # shape carried by local_bounds; kept in the cache key
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P(axis)),
+    )
+    return jax.jit(sharded)
+
+
+def make_sharded_evaluator_qu(mesh: Mesh, chips_per_slice: int, axis: str = "fleet"):
+    """int8 + uniform reshape per shard — collective-FREE: shards hold
+    whole slices, so verdicts are local and come back sharded."""
+
+    def local_eval(tc_q, hbm_q, pod_age_s, params_arr):
+        candidate = evaluate_chips_q(
+            tc_q, hbm_q, pod_age_s, params_arr[0], params_arr[1]
+        )
+        return candidate.reshape(-1, chips_per_slice).all(axis=1), candidate
+
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P()),
+        out_specs=(P(axis), P(axis)),
+    )
+    return jax.jit(sharded)
+
+
+@lru_cache(maxsize=None)
+def _cached_sharded_evaluator_qc(mesh: Mesh, num_segments: int, axis: str):
+    return make_sharded_evaluator_qc(mesh, num_slices=num_segments, axis=axis)
+
+
+@lru_cache(maxsize=None)
+def _cached_sharded_evaluator_qu(mesh: Mesh, chips_per_slice: int, axis: str):
+    return make_sharded_evaluator_qu(mesh, chips_per_slice, axis=axis)
+
+
+def evaluate_fleet_sharded_qc(tc_q, hbm_q, pod_age_s, bounds, params_arr_q,
+                              mesh: Mesh | None = None, axis: str = "fleet"):
+    """evaluate_fleet_qc over a device mesh: per-shard cumsum + one psum.
+
+    Chips are padded to a device multiple with the -1 sentinel (outside
+    every bound, so no verdict moves); bounds come from slice_bounds.
+    Results match evaluate_fleet_qc exactly (tests/test_policy.py, on the
+    8-device CPU mesh)."""
+    if mesh is None:
+        mesh = Mesh(np.array(jax.devices()), axis_names=(axis,))
+    n_dev = mesh.devices.size
+    num_chips = tc_q.shape[0]
+    num_slices = int(bounds.shape[0]) - 1
+    padded = ((num_chips + n_dev - 1) // n_dev) * n_dev
+    pad = padded - num_chips
+    arrays = [jnp.asarray(tc_q), jnp.asarray(hbm_q), jnp.asarray(pod_age_s)]
+    if pad:
+        pvs = (INVALID_Q, INVALID_Q, 0.0)
+        arrays = [
+            jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1), constant_values=pv)
+            for x, pv in zip(arrays, pvs)
+        ]
+    local_bounds = shard_bounds(bounds, n_dev, padded // n_dev)
+
+    from jax.sharding import NamedSharding
+
+    evaluator = _cached_sharded_evaluator_qc(mesh, num_slices, axis)
+    shard = NamedSharding(mesh, P(axis))
+    placed = [jax.device_put(x, shard) for x in arrays]
+    lb = jax.device_put(local_bounds, shard)
+    params = jax.device_put(jnp.asarray(params_arr_q), NamedSharding(mesh, P()))
+    verdicts, candidates = evaluator(placed[0], placed[1], placed[2], lb, params)
+    return verdicts, candidates[:num_chips]
+
+
+def evaluate_fleet_sharded_qu(tc_q, hbm_q, pod_age_s, params_arr_q,
+                              chips_per_slice: int,
+                              mesh: Mesh | None = None, axis: str = "fleet"):
+    """evaluate_fleet_qu over a device mesh — no collective.
+
+    The uniform-contiguous layout contract is the caller's (validate with
+    assert_uniform_slices at ingest, same as the single-device path).
+    Slices are padded to a device multiple with whole all-invalid slices
+    (never idle, sliced off the output). Results match evaluate_fleet_qu
+    exactly (tests/test_policy.py)."""
+    if mesh is None:
+        mesh = Mesh(np.array(jax.devices()), axis_names=(axis,))
+    n_dev = mesh.devices.size
+    num_chips = tc_q.shape[0]
+    if num_chips % chips_per_slice != 0:
+        raise ValueError(
+            f"{num_chips} chips do not divide into slices of {chips_per_slice}")
+    num_slices = num_chips // chips_per_slice
+    padded_slices = ((num_slices + n_dev - 1) // n_dev) * n_dev
+    pad_chips = (padded_slices - num_slices) * chips_per_slice
+    arrays = [jnp.asarray(tc_q), jnp.asarray(hbm_q), jnp.asarray(pod_age_s)]
+    if pad_chips:
+        pvs = (INVALID_Q, INVALID_Q, 0.0)
+        arrays = [
+            jnp.pad(x, ((0, pad_chips),) + ((0, 0),) * (x.ndim - 1),
+                    constant_values=pv)
+            for x, pv in zip(arrays, pvs)
+        ]
+
+    from jax.sharding import NamedSharding
+
+    evaluator = _cached_sharded_evaluator_qu(mesh, chips_per_slice, axis)
+    shard = NamedSharding(mesh, P(axis))
+    placed = [jax.device_put(x, shard) for x in arrays]
+    params = jax.device_put(jnp.asarray(params_arr_q), NamedSharding(mesh, P()))
+    verdicts, candidates = evaluator(placed[0], placed[1], placed[2], params)
+    return verdicts[:num_slices], candidates[:num_chips]
+
+
+def make_sharded_stream_step(mesh: Mesh, chips_per_slice: int, axis: str = "fleet"):
+    """One fused streaming cycle over the mesh: fold this cycle's new int8
+    samples into the sharded chunk-maxima rings AND evaluate the uniform
+    window verdicts — all per shard, no collective (whole slices per
+    device, like make_sharded_evaluator_qu).
+
+    Returned step(state, tc_new, hbm_new, age, params) -> (state, verdicts)
+    where state = (tc_ring, hbm_ring, cursor); rings/new-samples/age are
+    sharded over `axis`, cursor and params replicated. The caller cuts
+    shards on slice boundaries: chips % (devices * chips_per_slice) == 0.
+    """
+
+    def local_step(tc_ring, hbm_ring, cursor, tc_new, hbm_new, pod_age_s,
+                   params_arr):
+        tc_max = jnp.max(tc_new, axis=-1, keepdims=True)
+        hbm_max = jnp.max(hbm_new, axis=-1, keepdims=True)
+        zero = jnp.int32(0)
+        tc_ring = jax.lax.dynamic_update_slice(tc_ring, tc_max, (zero, cursor))
+        hbm_ring = jax.lax.dynamic_update_slice(hbm_ring, hbm_max, (zero, cursor))
+        candidate = evaluate_chips_q(
+            tc_ring, hbm_ring, pod_age_s, params_arr[0], params_arr[1]
+        )
+        verdicts = candidate.reshape(-1, chips_per_slice).all(axis=1)
+        return tc_ring, hbm_ring, verdicts
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(axis), P(axis), P(axis)),
+    )
+
+    @jax.jit
+    def step(state, tc_q_new, hbm_q_new, pod_age_s, params_arr_q):
+        tc_ring, hbm_ring, cursor = state
+        tc_ring, hbm_ring, verdicts = sharded(
+            tc_ring, hbm_ring, cursor, tc_q_new, hbm_q_new, pod_age_s,
+            params_arr_q)
+        num_chunks = tc_ring.shape[1]
+        return (tc_ring, hbm_ring, (cursor + 1) % num_chunks), verdicts
+
+    return step
+
+
 def assert_uniform_slices(slice_id, chips_per_slice: int) -> int:
     """Host-side precondition for evaluate_fleet_qu; returns num_slices.
 
