@@ -1,4 +1,7 @@
-"""Live-cluster e2e: the real binary against a real API server.
+"""Cluster e2e: the real binary against an API server — the fake
+apiserver by default (hermetic, every suite run), a live kind cluster
+under TP_E2E_KIND=1 (same test bodies, swapped conftest backend; only
+the real-cluster transport is live-only).
 
 Mirrors the reference's kind tier (tests/e2e.rs: ownerRef chains 168-236,
 orphan 238-252, scale lands + Event 256-333, event round-trip 337-366, uid

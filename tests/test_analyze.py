@@ -168,10 +168,8 @@ def test_stream_scrape_gap_preserves_evidence(built, tmp_path):
     """An all-gap cycle (scrape outage) folds an all-invalid chunk: prior
     idle AND prior busy evidence both survive — no verdict flips."""
     run_stream(tmp_path, stream_dump(1000.0, idle=["ml/a"], busy=["ml/b"]))
-    out, _ = run_stream(tmp_path, stream_dump(1180.0, idle=[], busy=[],
-                                              gap=True) | {
-        "chips": stream_dump(1180.0, idle=["ml/a"], busy=["ml/b"],
-                             gap=True)["chips"]})
+    out, _ = run_stream(tmp_path, stream_dump(1180.0, idle=["ml/a"],
+                                              busy=["ml/b"], gap=True))
     assert out["reclaimable_slices"] == ["ml/a"]
     assert out["newly_reclaimable"] == [] and out["no_longer_reclaimable"] == []
 

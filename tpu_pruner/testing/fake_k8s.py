@@ -478,8 +478,11 @@ class FakeK8s:
         return lws, pods
 
     # ── deployment chain helper (Pod→RS→Deployment) ──
-    def add_deployment_chain(self, ns, name, num_pods=1, tpu_chips=4, pod_age=7200):
+    def add_deployment_chain(self, ns, name, num_pods=1, tpu_chips=4, pod_age=7200,
+                             pod_labels=None, annotations=None):
         dep = self.add_deployment(ns, name)
+        if annotations:
+            dep["metadata"]["annotations"] = dict(annotations)
         rs = self.add_replicaset(
             ns, f"{name}-abc123",
             owners=[self.owner("Deployment", name, dep["metadata"]["uid"])])
@@ -487,6 +490,7 @@ class FakeK8s:
             self.add_pod(
                 ns, f"{name}-abc123-{i}",
                 owners=[self.owner("ReplicaSet", rs["metadata"]["name"], rs["metadata"]["uid"])],
+                labels=dict(pod_labels) if pod_labels else None,
                 tpu_chips=tpu_chips, created_age=pod_age)
             for i in range(num_pods)
         ]
