@@ -201,9 +201,10 @@ def _fake_cluster():
     ns = E2E_NS
 
     def chain(dep_name, num_pods, tpu, labels, annotations=None):
+        # replicas mirrors the live manifests (replicas == pod count)
         fake.add_deployment_chain(ns, dep_name, num_pods=num_pods,
                                   tpu_chips=tpu, pod_labels=labels,
-                                  annotations=annotations)
+                                  annotations=annotations, replicas=num_pods)
 
     # 1. Deployment chain, 2 pods for uid dedup
     chain("trainer", 2, 1, {"app": "trainer"})

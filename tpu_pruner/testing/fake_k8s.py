@@ -479,8 +479,9 @@ class FakeK8s:
 
     # ── deployment chain helper (Pod→RS→Deployment) ──
     def add_deployment_chain(self, ns, name, num_pods=1, tpu_chips=4, pod_age=7200,
-                             pod_labels=None, annotations=None):
-        dep = self.add_deployment(ns, name)
+                             pod_labels=None, annotations=None, replicas=None):
+        dep = self.add_deployment(
+            ns, name, replicas=replicas if replicas is not None else 2)
         if annotations:
             dep["metadata"]["annotations"] = dict(annotations)
         rs = self.add_replicaset(
