@@ -74,6 +74,12 @@ docker-build:
 bench-smoke:
     TP_BENCH_SMOKE=1 python bench.py
 
+# standalone TPU capture: probe + fleet eval + bench_tpu_last_good.json
+# (run EARLY in a round / whenever the chip tunnel is up; exits 1 when no
+# real accelerator measurement happened)
+bench-tpu:
+    python bench.py --tpu-only
+
 # opt-in real-hardware policy tier: XLA + Mosaic-Pallas verdict parity
 # (f32 and int8+cumsum) on an actual TPU chip
 test-policy-tpu:
