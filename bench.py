@@ -330,29 +330,46 @@ def run_circuit_breaker(k8s, prom):
 def measure_fixture_ceiling(k8s, seconds=1.5, threads=8):
     """Standalone serving ceiling of the fake apiserver (VERDICT r4 #7).
 
-    A trivial multi-threaded client hammers one pod GET for ~1.5 s; the
-    resulting req/s is the fixture's own roof on this host, so e2e_wall_s
-    can be decomposed into fixture floor (api_calls / ceiling) vs daemon
+    A trivial multi-threaded client hammers one pod GET for ~1.5 s over
+    PERSISTENT connections (one keep-alive socket per thread — the daemon
+    pools connections, so a new-connection-per-request client would
+    understate the roof and make e2e walls "beat the floor"); the
+    resulting req/s is the fixture's roof on this host, so e2e_wall_s can
+    be decomposed into fixture floor (api_calls / ceiling) vs daemon
     cost. Run right after cluster build, before any daemon contends."""
     import concurrent.futures
-    import urllib.request
+    import http.client
+    from urllib.parse import urlparse
 
-    path = (k8s.url + ("/api/v1/namespaces/tpu-jobs/pods/slice-0-workers-0-0"
-                       if NUM_SLICES else
-                       f"/api/v1/namespaces/{dep_ns(0)}/pods/dep-0-abc123-0"))
-    urllib.request.urlopen(path, timeout=10).read()  # warm
-    stop = time.monotonic() + seconds
+    parsed = urlparse(k8s.url)
+    path = ("/api/v1/namespaces/tpu-jobs/pods/slice-0-workers-0-0"
+            if NUM_SLICES else
+            f"/api/v1/namespaces/{dep_ns(0)}/pods/dep-0-abc123-0")
 
-    def worker(_):
+    def worker(stop):
+        conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
+                                          timeout=10)
         n = 0
-        while time.monotonic() < stop:
-            urllib.request.urlopen(path, timeout=10).read()
-            n += 1
+        try:
+            while time.monotonic() < stop:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    # a stale path must fail the measurement loudly, not
+                    # report the 404 handler's serving rate as the ceiling
+                    raise RuntimeError(
+                        f"fixture ceiling probe got HTTP {resp.status} for {path}")
+                n += 1
+        finally:
+            conn.close()
         return n
 
+    worker(time.monotonic() + 0.1)  # warm (server threads, route cache)
     t0 = time.monotonic()
+    stop = t0 + seconds
     with concurrent.futures.ThreadPoolExecutor(max_workers=threads) as ex:
-        total = sum(ex.map(worker, range(threads)))
+        total = sum(ex.map(worker, [stop] * threads))
     return round(total / (time.monotonic() - t0), 1)
 
 
@@ -1161,7 +1178,8 @@ def main():
         "fixture_note": (
             None if not fixture_rps else
             f"fake-apiserver standalone ceiling {fixture_rps:.0f} req/s "
-            f"(trivial 8-thread client, this host); the headline run's "
+            f"(8-thread keep-alive client, this host — matching the "
+            f"daemon's pooled connections); the headline run's "
             f"{api_calls} API calls imply a fixture-only floor of "
             f"{api_calls / fixture_rps:.2f}s of its {elapsed:.2f}s wall — "
             f"the remainder is daemon cost + fixture contention"),
