@@ -45,12 +45,12 @@ test-tsan:
 # consumer pool, metrics server, and OTLP exporter surface here
 test-tsan-e2e: test-tsan
     TP_DAEMON_PATH=./build-tsan/tpu-pruner TSAN_OPTIONS=exitcode=66 \
-        python -m pytest tests/test_pipeline_e2e.py tests/test_otlp.py tests/test_leader.py -q
+        python -m pytest tests/test_pipeline_e2e.py tests/test_otlp.py tests/test_leader.py tests/e2e_kind -q
 
 test-asan-e2e:
     cmake -G Ninja -S . -B build-asan -DTP_SANITIZE=ON && cmake --build build-asan
     TP_DAEMON_PATH=./build-asan/tpu-pruner \
-        python -m pytest tests/test_pipeline_e2e.py tests/test_otlp.py tests/test_leader.py -q
+        python -m pytest tests/test_pipeline_e2e.py tests/test_otlp.py tests/test_leader.py tests/e2e_kind -q
 
 # deterministic mutation fuzz over the untrusted-input surfaces
 fuzz iterations="500000": build
