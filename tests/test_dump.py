@@ -1,0 +1,142 @@
+"""Export→analyze pipeline (tpu_pruner.dump → tpu_pruner.analyze).
+
+The dump tool pulls raw utilization matrices from Prometheus
+(/api/v1/query_range) and emits the analyze input format — the missing
+producer for offline threshold audits and incremental streaming runs
+(analyze's own docstring use case). Reference analog: querytest's ad-hoc
+query export (querytest.rs), extended to the policy engine's input.
+"""
+
+import json
+import subprocess
+import sys
+
+from tpu_pruner.native import REPO_ROOT
+from tpu_pruner.testing import FakePrometheus
+
+SLICE_LABEL = "label_jobset_sigs_k8s_io_jobset_name"
+
+
+def run_dump(prom, *args):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_pruner.dump",
+         "--prometheus-url", prom.url, *args],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT,
+        env={"PATH": "/usr/bin:/bin", "PROMETHEUS_TOKEN": "dump-tok",
+             "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout.strip()), proc.stderr
+
+
+def run_analyze_stdin(doc, *args):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_pruner.analyze", "-", *args],
+        input=json.dumps(doc), capture_output=True, text=True, timeout=300,
+        cwd=REPO_ROOT, env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                            "HOME": "/tmp"})
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_dump_exports_range_series_grouped_by_slice(built):
+    prom = FakePrometheus()
+    # a 2-chip idle slice, a slice with one busy sample, a labelless pod
+    for host in range(2):
+        prom.add_range_pod_series(
+            f"slice-a-{host}", "tpu-jobs", [0.0] * 6,
+            extra_labels={SLICE_LABEL: "slice-a"})
+    prom.add_range_pod_series(
+        "slice-b-0", "tpu-jobs", [0.0, 0.6, 0.0, 0.0, 0.0, 0.0],
+        extra_labels={SLICE_LABEL: "slice-b"})
+    prom.add_range_pod_series("loner", "ml", [0.0] * 6)
+    prom.start()
+    try:
+        doc, _ = run_dump(prom)
+    finally:
+        prom.stop()
+
+    assert prom.auth_headers[-1] == "Bearer dump-tok"  # daemon's env honored
+    assert any(p.endswith("/api/v1/query_range") for p in prom.query_paths)
+    by_slice = {}
+    for chip in doc["chips"]:
+        by_slice.setdefault(chip["slice"], []).append(chip)
+    assert len(by_slice["slice-a"]) == 2
+    assert len(by_slice["slice-b"]) == 1
+    assert by_slice["ml/loner"][0]["id"] == "ml/loner/0"  # per-pod fallback
+    assert by_slice["slice-b"][0]["tc"][1] == 0.6
+    assert doc["lookback_s"] == 2100.0
+
+    # the export feeds analyze directly: slice-a reclaimable, slice-b not
+    out = run_analyze_stdin(doc)
+    assert out["reclaimable_slices"] == ["ml/loner", "slice-a"]
+
+
+def test_dump_joins_hbm_and_percent_scaling(built):
+    """tc and hbm are DISTINCT metrics joined by chip identity — the fake
+    filters query_range by __name__, so a swapped join or a wrong metric
+    default returns the wrong (or no) values here."""
+    prom = FakePrometheus()
+    prom.add_range_pod_series(
+        "pinned", "ml", [0.0, 0.0, 0.0, 0.0],
+        extra_labels={SLICE_LABEL: "pinned-slice"})
+    prom.add_range_pod_series(
+        "pinned", "ml", [20.0, 30.0, 20.0, 20.0],
+        metric_name="hbm_memory_bandwidth_utilization",
+        extra_labels={SLICE_LABEL: "pinned-slice"})
+    prom.start()
+    try:
+        doc, _ = run_dump(prom, "--percent")
+    finally:
+        prom.stop()
+    assert len(doc["chips"]) == 1  # hbm series are joined, not extra chips
+    chip = doc["chips"][0]
+    assert chip["tc"] == [0.0] * 4
+    assert chip["hbm"] == [0.2, 0.3, 0.2, 0.2]  # percent-scaled, hbm values
+    # the default --hbm-metric matches the daemon's (query.cpp)
+    assert any(q.startswith("hbm_memory_bandwidth_utilization")
+               for q in prom.queries)
+
+
+def test_dump_prometheus_error_fails_loudly(built):
+    prom = FakePrometheus()
+    prom.add_range_pod_series("p", "ml", [0.0] * 3)
+    prom.fail_requests_remaining = 1
+    prom.start()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_pruner.dump",
+             "--prometheus-url", prom.url],
+            capture_output=True, text=True, timeout=120, cwd=REPO_ROOT,
+            env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"})
+    finally:
+        prom.stop()
+    assert proc.returncode != 0
+    assert "500" in proc.stderr or "error" in proc.stderr.lower()
+
+
+def test_dump_streamed_cycles_feed_analyze_stream(built, tmp_path):
+    """Two successive exports (one per cycle) drive analyze --stream:
+    chip ids are stable, deltas come out — the full metrics → dump →
+    incremental verdicts loop."""
+    state = tmp_path / "state.npz"
+
+    def cycle(busy: bool):
+        prom = FakePrometheus()
+        samples = [0.0, 0.5, 0.0] if busy else [0.0] * 3
+        for host in range(2):
+            prom.add_range_pod_series(
+                f"s-{host}", "tpu-jobs", samples,
+                extra_labels={SLICE_LABEL: "s"})
+        prom.start()
+        try:
+            doc, _ = run_dump(prom, "--window-s", "180")
+        finally:
+            prom.stop()
+        return run_analyze_stdin(doc, "--stream", str(state),
+                                 "--window-chunks", "3")
+
+    out = cycle(busy=False)
+    assert out["newly_reclaimable"] == ["s"]
+    out = cycle(busy=True)
+    assert out["no_longer_reclaimable"] == ["s"]
+    assert out["window"]["filled"] == 2

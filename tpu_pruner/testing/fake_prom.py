@@ -8,6 +8,7 @@ daemon's consecutive-failure budget is exercised hermetically.
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -125,6 +126,41 @@ class FakePrometheus:
             })
         self._version += 1
 
+    def add_range_pod_series(
+        self,
+        pod: str,
+        namespace: str,
+        values: list[float],
+        metric_name: str = "tensorcore_utilization",
+        container: str = "main",
+        chips: int = 1,
+        step_s: float = 300.0,
+        exported: bool = True,
+        extra_labels: dict | None = None,
+    ) -> None:
+        """Range-query series (one per chip): `values` are the window's
+        samples, newest last, timestamped `step_s` apart ending now —
+        what /api/v1/query_range returns and tpu_pruner.dump consumes.
+        `metric_name` becomes __name__ and query_range filters on it, so
+        a test's tc and hbm registrations stay distinguishable."""
+        prefix = "exported_" if exported else ""
+        now = time.time()
+        for chip in range(chips):
+            labels = {
+                "__name__": metric_name,
+                f"{prefix}pod": pod,
+                f"{prefix}namespace": namespace,
+                f"{prefix}container": container,
+                "accelerator_id": str(chip),
+            }
+            labels.update(extra_labels or {})
+            self.series.append({
+                "metric": labels,
+                "values": [[now - (len(values) - 1 - i) * step_s, str(v)]
+                           for i, v in enumerate(values)],
+            })
+        self._version += 1
+
     # ── lifecycle ──
     def start(self, certfile: str | None = None, keyfile: str | None = None) -> int:
         fake = self
@@ -166,11 +202,14 @@ class FakePrometheus:
                             {"status": "error", "errorType": "internal", "error": "injected"},
                         )
                         return
-                    # serialize once per series-list version (large fleets)
+                    # serialize once per series-list version (large fleets);
+                    # instant vectors exclude range-only series (no "value")
                     if fake._cached_version != fake._version or fake._cached is None:
                         fake._cached = json.dumps({
                             "status": "success",
-                            "data": {"resultType": "vector", "result": fake.series},
+                            "data": {"resultType": "vector",
+                                     "result": [s for s in fake.series
+                                                if "value" in s]},
                         }).encode()
                         fake._cached_version = fake._version
                     body = fake._cached
@@ -180,27 +219,76 @@ class FakePrometheus:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _handle_query_range(self, query: str):
+                """Matrix response filtered by the queried metric name (a
+                real Prometheus never mixes metrics in one response — an
+                unfiltered fake would mask tc/hbm join bugs): series whose
+                __name__ equals the query's leading identifier; series
+                without __name__ match any query (legacy instant helpers).
+                Range-only series return their stored values; instant
+                series synthesize a one-sample matrix. Honors the same
+                hang/failure-injection knobs as the instant path."""
+                if fake.hang_seconds:
+                    time.sleep(fake.hang_seconds)
+                with fake._lock:
+                    fake.queries.append(query)
+                    fake.auth_headers.append(self.headers.get("Authorization"))
+                    if err := promql_structure_error(query):
+                        self._respond(400, {"status": "error",
+                                            "errorType": "bad_data",
+                                            "error": f"parse error: {err}"})
+                        return
+                    if fake.fail_requests_remaining > 0:
+                        fake.fail_requests_remaining -= 1
+                        self._respond(
+                            fake.fail_status,
+                            {"status": "error", "errorType": "internal",
+                             "error": "injected"})
+                        return
+                    name = re.match(r"[A-Za-z_:][A-Za-z0-9_:]*",
+                                    query.strip())
+                    name = name.group(0) if name else ""
+                    result = [
+                        {"metric": s["metric"],
+                         "values": (s["values"] if "values" in s
+                                    else [s["value"]])}
+                        for s in fake.series
+                        if s["metric"].get("__name__", name) == name
+                    ]
+                self._respond(200, {
+                    "status": "success",
+                    "data": {"resultType": "matrix", "result": result},
+                })
+
             def do_POST(self):
                 # Accept both the vanilla path and the Cloud Monitoring
                 # PromQL API shape (/v1/projects/<p>/location/global/
                 # prometheus/api/v1/query) — same wire protocol.
                 parsed = urlparse(self.path)
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length).decode()
+                query = parse_qs(body).get("query", [""])[0]
+                if parsed.path.endswith("/api/v1/query_range"):
+                    fake.query_paths.append(parsed.path)
+                    self._handle_query_range(query)
+                    return
                 if not parsed.path.endswith("/api/v1/query"):
                     self._respond(404, {"status": "error", "error": "not found"})
                     return
                 fake.query_paths.append(parsed.path)
-                length = int(self.headers.get("Content-Length", "0"))
-                body = self.rfile.read(length).decode()
-                query = parse_qs(body).get("query", [""])[0]
                 self._handle_query(query)
 
             def do_GET(self):
                 parsed = urlparse(self.path)
+                query = parse_qs(parsed.query).get("query", [""])[0]
+                if parsed.path.endswith("/api/v1/query_range"):
+                    fake.query_paths.append(parsed.path)
+                    self._handle_query_range(query)
+                    return
                 if not parsed.path.endswith("/api/v1/query"):
                     self._respond(404, {"status": "error", "error": "not found"})
                     return
                 fake.query_paths.append(parsed.path)
-                query = parse_qs(parsed.query).get("query", [""])[0]
                 self._handle_query(query)
 
         # default backlog of 5 drops SYNs under concurrent load
