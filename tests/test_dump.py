@@ -129,9 +129,11 @@ def test_dump_streamed_cycles_feed_analyze_stream(built, tmp_path):
                 extra_labels={SLICE_LABEL: "s"})
         prom.start()
         try:
-            doc, _ = run_dump(prom, "--window-s", "180")
+            doc, _ = run_dump(prom, "--window-s", "180",
+                              "--lookback-s", "2100")
         finally:
             prom.stop()
+        assert doc["lookback_s"] == 2100.0  # age gate ≠ one-cycle window
         return run_analyze_stdin(doc, "--stream", str(state),
                                  "--window-chunks", "3")
 
@@ -140,3 +142,6 @@ def test_dump_streamed_cycles_feed_analyze_stream(built, tmp_path):
     out = cycle(busy=True)
     assert out["no_longer_reclaimable"] == ["s"]
     assert out["window"]["filled"] == 2
+    # --lookback-s kept the age gate at the FULL policy lookback even
+    # though each export covers one 180s cycle
+    assert out["lookback_s"] == 2100.0

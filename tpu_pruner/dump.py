@@ -103,12 +103,24 @@ def main(argv=None) -> int:
                         help="lookback window to export (default "
                              "duration+grace = 2100s); for analyze --stream "
                              "set this to one check-interval")
+    parser.add_argument("--lookback-s", type=float, default=None,
+                        help="lookback_s stamped on the dump (analyze's age "
+                             "gate). Defaults to --window-s, which is right "
+                             "for one-shot audits but NOT for per-cycle "
+                             "stream exports — there, pass the full policy "
+                             "lookback (e.g. 2100) or the age gate shrinks "
+                             "to one cycle")
     parser.add_argument("--step-s", type=float, default=300,
                         help="sample resolution (default 300s — the typical "
                              "GMP TPU metric cadence)")
     parser.add_argument("--tc-metric", default="tensorcore_utilization",
                         help="tensorcore utilization metric (0-1 or 0-100 "
-                             "with --percent)")
+                             "with --percent). Any instant-vector PromQL "
+                             "expression works — e.g. the gke-system "
+                             "node-to-pod group_left join (`tpu-pruner "
+                             "--print-query` shows the daemon's), since "
+                             "node-scoped series alone carry no pod "
+                             "identity to group chips by")
     parser.add_argument("--hbm-metric",
                         default="hbm_memory_bandwidth_utilization",
                         help="HBM bandwidth metric (the daemon's gmp-schema "
@@ -137,7 +149,9 @@ def main(argv=None) -> int:
     hbm = (fetch_range(args.prometheus_url, args.hbm_metric, start, end,
                        args.step_s, token)
            if args.hbm_metric else [])
-    doc = build_dump(tc, hbm, args.slice_label, args.pod_age_s, args.window_s)
+    doc = build_dump(tc, hbm, args.slice_label, args.pod_age_s,
+                     args.lookback_s if args.lookback_s is not None
+                     else args.window_s)
     if args.percent:
         for chip in doc["chips"]:
             chip["tc"] = [v / 100.0 for v in chip["tc"]]
