@@ -220,7 +220,8 @@ def main(argv=None) -> int:
                         help="incremental mode: fold this dump's samples into "
                              "the sliding-window ring state carried in STATE "
                              "(.npz) and emit per-cycle verdict deltas + window "
-                             "staleness; one invocation per daemon cycle")
+                             "staleness; one invocation per daemon cycle. "
+                             "Always evaluates int8 (--quantize is implied)")
     parser.add_argument("--window-chunks", type=int, default=12,
                         help="sliding-window size in cycles for --stream "
                              "(default 12 — a 35min lookback at 180s cycles)")
