@@ -16,6 +16,11 @@ offline under different thresholds, or incrementally via
 
 Auth: `PROMETHEUS_TOKEN` (Bearer), same env the daemon honors first in
 its chain (native/src/auth.cpp).
+
+Reference analog: the querytest debug binary (gpu-pruner
+src/bin/querytest.rs:7-70) exports ad-hoc query results to CSV for
+humans; this tool exports range matrices in the policy engine's input
+format so the same data feeds machine re-evaluation.
 """
 
 from __future__ import annotations
