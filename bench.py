@@ -200,6 +200,33 @@ def check_patched(k8s, start_idx):
     return patched
 
 
+RATIO_SPREAD_LIMIT = 0.10  # VERDICT r4 #5: ratios with noisier runs demote
+
+
+def demote_noisy_ratios(summary: dict, spreads: dict) -> dict:
+    """Honest wall-clock ratios: a cross-mode wall ratio is only headlined
+    when the runs behind BOTH of its sides were stable (<10% relative
+    spread). Noisier ratios move to a labeled noisy_wall_ratios block
+    carrying their spread; the deterministic api_call_ratio stays the
+    durable architecture signal either way. Mutates `summary`, returns
+    the demoted block (empty when all ratios were stable)."""
+    ratio_inputs = {
+        "vs_baseline": ("headline", "baseline_model"),
+        "vs_self_reference_mode": ("headline", "self_reference_mode"),
+        "vs_self_reference_mode_same_kinds": (
+            "headline", "self_reference_mode_same_kinds"),
+    }
+    noisy = {}
+    for key, labels in ratio_inputs.items():
+        spread = max((spreads.get(lb, 0.0) for lb in labels), default=0.0)
+        if spread > RATIO_SPREAD_LIMIT and key in summary:
+            noisy[key] = {"ratio": summary.pop(key),
+                          "wall_spread": round(spread, 3)}
+    if noisy:
+        summary["noisy_wall_ratios"] = noisy
+    return noisy
+
+
 def median_of(fn, n=None, wall_key=0, label=None):
     """Run a daemon measurement n times and keep the median-wall result.
 
@@ -1228,26 +1255,7 @@ def main():
                        if RUN_SPREADS else None),
         "detail_file": detail_path.name,
     }
-    # Honest wall-clock ratios (VERDICT r4 #5): a cross-mode wall ratio is
-    # only headlined when the runs behind BOTH sides were stable (<10%
-    # relative spread). Noisier ratios move to a labeled block carrying
-    # their spread; the deterministic api_call_ratio (2.6x fewer calls)
-    # stays the durable architecture signal either way.
-    RATIO_SPREAD_LIMIT = 0.10
-    ratio_inputs = {
-        "vs_baseline": ("headline", "baseline_model"),
-        "vs_self_reference_mode": ("headline", "self_reference_mode"),
-        "vs_self_reference_mode_same_kinds": (
-            "headline", "self_reference_mode_same_kinds"),
-    }
-    noisy = {}
-    for key, labels in ratio_inputs.items():
-        spread = max((RUN_SPREADS.get(lb, 0.0) for lb in labels), default=0.0)
-        if spread > RATIO_SPREAD_LIMIT:
-            noisy[key] = {"ratio": summary.pop(key),
-                          "wall_spread": round(spread, 3)}
-    if noisy:
-        summary["noisy_wall_ratios"] = noisy
+    noisy = demote_noisy_ratios(summary, RUN_SPREADS)
     detail["noisy_wall_ratios"] = noisy or None
 
     # Full detail goes to a FILE (and stderr for humans); stdout gets ONE

@@ -1,0 +1,77 @@
+"""Unit pins for bench.py's output-integrity helpers.
+
+The bench is a harness, not product code, but two of its behaviors are
+round deliverables with contracts of their own: the noisy-ratio
+demotion (VERDICT r4 #5 — no wall ratio >10% spread may be headlined
+unlabeled) and the wedge-proof last-good TPU artifact (VERDICT r4 #1).
+"""
+
+import importlib.util
+import sys
+
+from tpu_pruner.native import REPO_ROOT
+
+
+def load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", str(REPO_ROOT / "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    argv, sys.argv = sys.argv, ["bench.py"]
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.argv = argv
+    return mod
+
+
+def test_demote_noisy_ratios_moves_only_unstable_keys(built):
+    bench = load_bench()
+    summary = {"value": 1.0, "vs_baseline": 3.2,
+               "vs_self_reference_mode": 1.5,
+               "vs_self_reference_mode_same_kinds": 1.2,
+               "api_call_ratio": 2.7}
+    # headline stable; only the same-kinds comparison run was noisy
+    noisy = bench.demote_noisy_ratios(
+        summary, {"headline": 0.05, "baseline_model": 0.08,
+                  "self_reference_mode": 0.09,
+                  "self_reference_mode_same_kinds": 0.31})
+    assert list(noisy) == ["vs_self_reference_mode_same_kinds"]
+    assert noisy["vs_self_reference_mode_same_kinds"] == {
+        "ratio": 1.2, "wall_spread": 0.31}
+    assert "vs_self_reference_mode_same_kinds" not in summary
+    assert summary["vs_baseline"] == 3.2          # stable ratios stay
+    assert summary["vs_self_reference_mode"] == 1.5
+    assert summary["api_call_ratio"] == 2.7       # deterministic, untouched
+    assert summary["noisy_wall_ratios"] is noisy
+
+
+def test_demote_noisy_ratios_headline_spread_demotes_all(built):
+    bench = load_bench()
+    summary = {"vs_baseline": 3.2, "vs_self_reference_mode": 1.5,
+               "vs_self_reference_mode_same_kinds": 1.2}
+    noisy = bench.demote_noisy_ratios(summary, {"headline": 0.14})
+    assert set(noisy) == {"vs_baseline", "vs_self_reference_mode",
+                          "vs_self_reference_mode_same_kinds"}
+    assert all(v["wall_spread"] == 0.14 for v in noisy.values())
+
+
+def test_demote_noisy_ratios_all_stable_is_noop(built):
+    bench = load_bench()
+    summary = {"vs_baseline": 3.2}
+    assert bench.demote_noisy_ratios(summary, {"headline": 0.1}) == {}
+    assert summary == {"vs_baseline": 3.2}  # 10% is the limit, not beyond it
+
+
+def test_last_good_round_trip_and_dirty_sha(built, tmp_path, monkeypatch):
+    bench = load_bench()
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", tmp_path / "lg.json")
+    assert bench.load_last_good() is None
+    bench.persist_last_good({"platform": "tpu", "best_chips_per_s": 2.27e8,
+                             "best_config": "int8+uniform"})
+    block = bench.load_last_good()
+    assert block["best_config"] == "int8+uniform"
+    assert block["platform"] == "tpu"
+    assert block["age_days"] < 0.01
+    # the SHA must state dirty-tree provenance when the tree is dirty
+    sha = bench.git_sha()
+    assert sha and len(sha.split("-")[0]) == 40
