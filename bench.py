@@ -122,7 +122,7 @@ def dep_ns(i):
     return f"ml-{i % NUM_NAMESPACES}"
 
 
-def build_cluster():
+def build_cluster(workers=None):
     k8s = FakeK8s()
     prom = FakePrometheus()
     for i in range(NUM_SLICES):
@@ -147,7 +147,7 @@ def build_cluster():
     for i in range(BUSY_DEPLOYMENTS):
         k8s.add_deployment_chain(dep_ns(i), f"busy-{i}", num_pods=1,
                                  tpu_chips=CHIPS_PER_DEPLOYMENT)
-    k8s.start(workers=FAKE_WORKERS)
+    k8s.start(workers=FAKE_WORKERS if workers is None else workers)
     prom.start()
     return k8s, prom
 
@@ -352,6 +352,135 @@ def run_circuit_breaker(k8s, prom):
         raise RuntimeError("circuit breaker never logged at fleet scale")
     return {"cap": BREAKER_CAP, "patched": len(patched), "deferred": deferred,
             "wall_s": round(elapsed, 3)}
+
+
+CHURN_DEPLOYMENTS = max(2, 64 // _S)  # new idle targets injected mid-run
+WATCH_CHECK_INTERVAL_S = 8 if SMOKE else 20  # > cold-cycle wall, < patience
+
+
+def run_watch_cache_steady_state():
+    """Tentpole measurement (ISSUE 1): informer-backed steady state.
+
+    A dedicated single-process fixture (watch events do not propagate
+    across the pre-fork bench workers) with the same cluster topology.
+    ONE daemon process runs TWO cycles with --watch-cache on:
+
+      cycle 1 (cold): informer LISTs everything, resolves from the store,
+        patches the full reclaimable set — same target-set contract as the
+        headline run (no partial slice, no busy deployment);
+      between cycles: CHURN_DEPLOYMENTS new idle deployments appear (the
+        only cluster change, flowing to the store via watch events);
+      cycle 2 (warm): must patch EXACTLY the churn — already-paused
+        targets are detected from the store and skipped — and its K8s API
+        traffic must be ≤ 10% of the cold cycle's (the acceptance bar;
+        in practice it is O(changes): one group-gate LIST + 2 calls per
+        new target).
+
+    warm p50 detect→scaledown is measured from the warm cycle's
+    Prometheus query (the detect instant) to each churn patch.
+    """
+    k8s, prom = build_cluster(workers=1)
+    try:
+        cmd = [str(native.DAEMON_PATH),
+               "--prometheus-url", prom.url,
+               "--run-mode", "scale-down",
+               "--daemon-mode", "--check-interval", str(WATCH_CHECK_INTERVAL_S),
+               "--max-cycles", "2", "--watch-cache", "on",
+               "--resolve-concurrency", "64", "--scale-concurrency", "32"]
+        env = {"KUBE_API_URL": k8s.url, "KUBE_TOKEN": "bench",
+               "PROMETHEUS_TOKEN": "bench", "PATH": "/usr/bin:/bin"}
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.PIPE, text=True)
+        # Drain stderr continuously: the daemon logs per-pod lines, and an
+        # undrained 64 KiB pipe would wedge it mid-cycle at fleet scale.
+        import threading
+        stderr_tail: list = []
+
+        def _drain():
+            for line in proc.stderr:
+                stderr_tail.append(line)
+                del stderr_tail[:-50]
+
+        drainer = threading.Thread(target=_drain, daemon=True)
+        drainer.start()
+        try:
+            deadline = time.monotonic() + 300
+            # cold quiesce: every reclaimable target patched once
+            while (len(k8s.patches) < RECLAIM_TARGETS
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+            time.sleep(0.5)  # drain actuation stragglers
+            cold_patches = len(k8s.patches)
+            cold_api_calls = len(k8s.requests)
+            patched_cold = {p for p, _ in k8s.patches[:cold_patches]}
+            wrong = [p for p in patched_cold
+                     if "/jobsets/partial-" in p or "/deployments/busy-" in p]
+            if wrong:
+                raise RuntimeError(f"watch-cache cold cycle over-patched: {wrong[:3]}")
+            if len(patched_cold) < RECLAIM_TARGETS:
+                raise RuntimeError(
+                    f"watch-cache cold cycle under-patched: "
+                    f"{len(patched_cold)}/{RECLAIM_TARGETS}")
+
+            # inject churn (the watch stream carries it into the store)
+            churn_paths = set()
+            for i in range(CHURN_DEPLOYMENTS):
+                _, _, pods = k8s.add_deployment_chain(
+                    dep_ns(i), f"churn-{i}", num_pods=1,
+                    tpu_chips=CHIPS_PER_DEPLOYMENT)
+                prom.add_idle_pod_series(pods[0]["metadata"]["name"], dep_ns(i),
+                                         chips=CHIPS_PER_DEPLOYMENT)
+                churn_paths.add(f"/apis/apps/v1/namespaces/{dep_ns(i)}"
+                                f"/deployments/churn-{i}/scale")
+            warm_req_idx = len(k8s.requests)
+            warm_query_idx = len(prom.query_times)
+
+            proc.wait(timeout=300)
+            drainer.join(timeout=5)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    "watch-cache daemon failed:\n" + "".join(stderr_tail)[-2000:])
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+        warm_patched = {p for p, _ in k8s.patches[cold_patches:]}
+        if warm_patched != churn_paths:
+            raise RuntimeError(
+                "warm cycle did not patch exactly the churn set: "
+                f"extra={sorted(warm_patched - churn_paths)[:3]} "
+                f"missing={sorted(churn_paths - warm_patched)[:3]}")
+        steady_calls = len(k8s.requests) - warm_req_idx
+        ratio = steady_calls / cold_api_calls
+        if ratio > 0.10:
+            raise RuntimeError(
+                f"ACCEPTANCE MISS: warm cycle used {steady_calls} K8s API "
+                f"calls = {ratio:.1%} of the cold cycle's {cold_api_calls} "
+                "(bar: <= 10%)")
+        if len(prom.query_times) <= warm_query_idx:
+            raise RuntimeError("warm cycle never queried prometheus")
+        t_detect = prom.query_times[warm_query_idx]
+        lat = sorted(t - t_detect for t in k8s.patch_times[cold_patches:])
+        warm_p50 = statistics.median(lat)
+        return {
+            "cold_api_calls": cold_api_calls,
+            "steady_state_api_calls": steady_calls,
+            "steady_to_cold_call_ratio": round(ratio, 4),
+            "churn_targets": CHURN_DEPLOYMENTS,
+            "warm_p50_detect_to_scaledown_s": round(warm_p50, 3),
+            "warm_p95_detect_to_scaledown_s": round(
+                lat[int(len(lat) * 0.95)], 3),
+            "note": "single daemon process, two cycles, --watch-cache on, "
+                    "single-process fake apiserver; cold = full reclaim "
+                    "(informer LISTs included), warm = churn of "
+                    f"{CHURN_DEPLOYMENTS} new idle deployments only — "
+                    "steady-state API cost scales with churn, not the "
+                    f"{TOTAL_PODS}-pod cluster",
+        }
+    finally:
+        k8s.stop()
+        prom.stop()
 
 
 def measure_fixture_ceiling(k8s, seconds=1.5, threads=8):
@@ -1157,6 +1286,16 @@ def main():
         f"(resolve {ref_resolve:.2f}s barrier + serial scale {ref_scale:.2f}s), "
         f"p50 {ref_p50 * 1000:.0f}ms / p95 {ref_p95 * 1000:.0f}ms")
 
+    # Informer steady state (--watch-cache on): own single-process fixture,
+    # one daemon across two cycles. Correctness misses (wrong target set,
+    # >10% warm/cold call ratio) are fatal like check_patched.
+    watch_cache = run_watch_cache_steady_state()
+    log(f"watch-cache steady state: {watch_cache['steady_state_api_calls']} warm-cycle "
+        f"API calls ({100 * watch_cache['steady_to_cold_call_ratio']:.1f}% of cold "
+        f"{watch_cache['cold_api_calls']}), warm p50 "
+        f"{watch_cache['warm_p50_detect_to_scaledown_s'] * 1000:.0f}ms over "
+        f"{watch_cache['churn_targets']} churn targets")
+
     # TPU fleet eval with spaced retries: now, +60s, +120s (only on failure).
     tpu = tpu_section([None] if SMOKE else [
         None,
@@ -1223,6 +1362,7 @@ def main():
         "self_reference_mode": self_ref,
         "self_reference_mode_same_kinds": self_ref_same,
         "circuit_breaker": breaker,
+        "watch_cache": watch_cache,
         "baseline_model": {"ref_wall_s": round(ref_wall, 3),
                            "ref_resolve_s": round(ref_resolve, 3),
                            "ref_scale_s": round(ref_scale, 3),
@@ -1251,6 +1391,9 @@ def main():
         "p95_detect_to_scaledown_s": detail["p95_detect_to_scaledown_s"],
         "k8s_api_calls": api_calls,
         "ref_k8s_api_calls": ref_api_calls,
+        "steady_state_api_calls": watch_cache["steady_state_api_calls"],
+        "warm_p50_detect_to_scaledown_s": watch_cache[
+            "warm_p50_detect_to_scaledown_s"],
         "spread_max": (round(max(RUN_SPREADS.values()), 3)
                        if RUN_SPREADS else None),
         "detail_file": detail_path.name,

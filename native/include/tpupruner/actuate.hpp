@@ -29,12 +29,26 @@ struct ScaleOptions {
   // Test injection; production uses wall clock / $POD_NAME.
   std::optional<int64_t> now_unix;
   std::string reporting_instance;
+  // Skip (no Event, no PATCH) when the target's resolved object already
+  // shows its paused state. Only safe when the resolved object is FRESH —
+  // the daemon enables it with --watch-cache=on, where objects come from
+  // the watch-backed store (or a live GET); the watch-free mode keeps the
+  // re-patch-every-cycle behavior (idempotent, and the parity contract).
+  bool skip_if_already_paused = false;
 };
 
+// True when the target object already carries its kind's paused state:
+// replicas==0 (Deployment/ReplicaSet/StatefulSet/LeaderWorkerSet),
+// suspend==true (JobSet), kubeflow-resource-stopped annotation (Notebook),
+// predictor.minReplicas==0 (InferenceService).
+bool already_paused(const core::ScaleTarget& target);
+
 // Emit the Event (failure logged only), then apply the per-kind patch.
-// Throws std::runtime_error when the PATCH itself fails — the caller counts
-// scale_failures and continues (main.rs:347-353).
-void scale_to_zero(const k8s::Client& client, const core::ScaleTarget& target,
+// Returns false when skip_if_already_paused elided the actuation, true
+// when the patch was applied. Throws std::runtime_error when the PATCH
+// itself fails — the caller counts scale_failures and continues
+// (main.rs:347-353).
+bool scale_to_zero(const k8s::Client& client, const core::ScaleTarget& target,
                    const ScaleOptions& opts = {});
 
 }  // namespace tpupruner::actuate

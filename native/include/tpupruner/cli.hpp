@@ -62,6 +62,12 @@ struct Cli {
   // --join-resource (gke-system): KSM resource selector; "none" disables.
   std::string join_resource;
   int64_t max_scale_per_cycle = 0;        // --max-scale-per-cycle (0 = unlimited)
+  // --watch-cache {on, off}: informer-style List+Watch cluster cache. "on"
+  // serves pod acquisition and the owner walk from a watch-backed store
+  // (steady-state API cost scales with churn, not cluster size); "off"
+  // keeps the watch-free GET/LIST client — the parity mode.
+  std::string watch_cache = "off";
+  int64_t max_cycles = 0;                 // --max-cycles (daemon mode; 0 = unlimited)
   int64_t resolve_concurrency = 10;       // --resolve-concurrency (ref: fixed 10)
   int64_t resolve_batch_threshold = 8;    // --resolve-batch-threshold (0 = off)
   int64_t scale_concurrency = 8;          // --scale-concurrency (ref: serial consumer)

@@ -13,10 +13,12 @@
 #pragma once
 
 #include <cstddef>
+#include <cstdint>
 #include <functional>
 #include <string>
 
 #include "tpupruner/cli.hpp"
+#include "tpupruner/informer.hpp"
 #include "tpupruner/k8s.hpp"
 
 namespace tpupruner::daemon {
@@ -25,6 +27,7 @@ struct CycleStats {
   size_t num_series = 0;       // raw series from the query
   size_t num_pods = 0;         // unique (pod, ns)
   size_t shutdown_events = 0;  // deduped root objects surviving gates
+  uint64_t api_calls = 0;      // K8s API requests issued during the cycle
 };
 
 // One evaluation cycle (reference: run_query_and_scale, main.rs:390-570).
@@ -32,9 +35,16 @@ struct CycleStats {
 // consumer-side, as in the reference; `enabled` is used only so the
 // --max-scale-per-cycle budget counts actionable targets, not ones the
 // consumer will skip). Throws on query failure (feeds the failure budget).
+// `watch_cache` (nullable): the informer store pod acquisition and the
+// owner walk read through (--watch-cache=on); unsynced resources degrade
+// to the watch-free GET/LIST path per lookup. The multi-host group gate
+// deliberately KEEPS its fresh LIST either way: it is the last check
+// before suspending every host of a slice, and a store lookup would
+// re-widen the new-pod race the fresh LIST exists to close.
 CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
                      core::ResourceSet enabled,
-                     const std::function<void(core::ScaleTarget)>& enqueue);
+                     const std::function<void(core::ScaleTarget)>& enqueue,
+                     const informer::ClusterCache* watch_cache = nullptr);
 
 // Full daemon: spawns the two threads, joins them, returns the process
 // exit code (0 normal, 1 after failure-budget exhaustion).

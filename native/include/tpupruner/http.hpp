@@ -17,6 +17,7 @@
 // once on a fresh connection iff no response bytes were received.
 #pragma once
 
+#include <functional>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -66,6 +67,25 @@ class Client {
   // are returned, not thrown. Thread-safe; idle connections are pooled and
   // reused across calls.
   Response request(const Request& req) const;
+
+  // Streaming request for long-lived bodies (K8s `watch=true`). Always a
+  // FRESH connection (never pooled; never returned to the pool): a watch
+  // monopolizes its socket for minutes. Status + headers come back in the
+  // Response (its body stays empty); decoded body bytes — chunked,
+  // content-length, or close-delimited framing — are handed to on_data as
+  // they arrive, regardless of status (error bodies stream too, so callers
+  // can collect the apiserver's Status JSON). on_data returning false ends
+  // the stream early. `abort` (optional) is polled ~4x/s while waiting for
+  // data; returning true closes the connection and returns — the reflector
+  // shutdown path, bounded regardless of req.timeout_ms (which still caps
+  // each individual socket wait).
+  // `on_headers` (optional) fires once after the status line + headers
+  // parse, before any body byte — callers branch on status without
+  // waiting for the stream to end.
+  Response request_stream(const Request& req,
+                          const std::function<bool(const char*, size_t)>& on_data,
+                          const std::function<bool()>& abort = nullptr,
+                          const std::function<void(const Response&)>& on_headers = nullptr) const;
 
  private:
   Response request_once(const Request& req, const Url& url, bool allow_reuse) const;

@@ -1,0 +1,162 @@
+// Informer-style List+Watch cluster cache (store + reflector).
+//
+// Reference analog: client-go's Reflector/Store pair (the machinery behind
+// every Kubernetes controller), which the reference binary — and this
+// rebuild until now — deliberately lacked: the watch-free client re-LISTs
+// candidate pods and re-GETs owner chains every cycle, so steady-state
+// API-server cost scales with CLUSTER SIZE (~7.5k calls per cycle on the
+// r05 bench's 4,416-pod cluster) instead of with CHURN. The cache LISTs
+// each resource once, then holds a streaming `watch=true` connection and
+// applies ADDED/MODIFIED/DELETED/BOOKMARK events under resourceVersion
+// ordering; a `410 Gone` (apiserver compacted past our resourceVersion)
+// triggers a full relist with jittered backoff.
+//
+// Safety contract (the part that lets the daemon trust a cache):
+//   - A store only answers (`get` returns a value) while its watch loop is
+//     SYNCED: listed at least once AND no un-relisted 410/error streak.
+//     Everything else returns nullopt and the caller falls back to the
+//     watch-free GET — graceful degradation is the miss path, not a mode.
+//   - On 410 (events were missed) the store is marked UNSYNCED BEFORE the
+//     relist starts, so no concurrent cycle can actuate from pre-compaction
+//     state — asserted by tests: no stale-object patch after a relist.
+//   - Lookup misses are never negative-cached: an absent object still GETs,
+//     so a lagging watch can only cost an API call, never skip the
+//     tpu-pruner.dev/skip annotation check.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+#include "tpupruner/k8s.hpp"
+
+namespace tpupruner::informer {
+
+// One watched resource: the cluster-scoped LIST+WATCH endpoint plus the
+// pieces needed to rebuild per-object paths ("<prefix>namespaces/<ns>/
+// <plural>/<name>") — the same keys k8s::Client's path builders produce,
+// so walker/daemon lookups need no translation layer.
+struct ResourceSpec {
+  std::string list_path;  // e.g. "/api/v1/pods", "/apis/apps/v1/replicasets"
+  std::string prefix;     // e.g. "/api/v1/", "/apis/apps/v1/"
+  std::string plural;     // e.g. "pods"
+};
+
+// Spec for a well-known plural ("pods", "replicasets", "jobs", "jobsets",
+// ...); nullopt for unknown names.
+std::optional<ResourceSpec> spec_for(std::string_view plural);
+// The daemon's full watch set: pods + every owner/root kind it resolves.
+std::vector<ResourceSpec> daemon_specs();
+
+struct ResourceStats {
+  bool synced = false;
+  uint64_t objects = 0;
+  uint64_t adds = 0;
+  uint64_t updates = 0;
+  uint64_t deletes = 0;
+  uint64_t bookmarks = 0;
+  uint64_t relists = 0;
+  uint64_t watch_failures = 0;
+  std::string resource_version;
+};
+
+// Thread-safe object store for one resource. Values share JSON nodes
+// (json::Value is COW), so get() copies are pointer-sized.
+class Store {
+ public:
+  std::optional<json::Value> get(const std::string& object_path) const;
+  size_t size() const;
+  // Swap in a full LIST snapshot (relist semantics: objects deleted while
+  // the watch was down vanish here).
+  void replace(std::map<std::string, json::Value> objects);
+  void upsert(const std::string& object_path, json::Value object);
+  void erase(const std::string& object_path);
+
+ private:
+  mutable std::mutex mutex_;
+  std::map<std::string, json::Value> objects_;
+};
+
+// List+watch driver for one resource, owning its Store and worker thread.
+// Exposed (rather than folded into ClusterCache) for unit tests: apply_*
+// methods are the pure event-application core the reflector thread drives.
+class Reflector {
+ public:
+  Reflector(const k8s::Client& kube, ResourceSpec spec);
+  ~Reflector();
+
+  void start();
+  void stop();  // signal + join; bounded by the watch read poll (~250ms)
+
+  bool synced() const { return synced_.load(); }
+  std::optional<json::Value> get(const std::string& object_path) const;
+  ResourceStats stats() const;
+  const ResourceSpec& spec() const { return spec_; }
+
+  // ── pure event application (unit-testable without a server) ──
+  // Apply one watch event {type, object}. Returns false when the event
+  // demands a relist (ERROR status, e.g. code 410).
+  bool apply_event(const json::Value& event);
+  // Apply a LIST result (replace + resourceVersion adoption).
+  void apply_list(const json::Value& list);
+  // Object path for an object of this resource (empty when metadata is
+  // missing — such objects are ignored, never half-keyed).
+  std::string object_path_of(const json::Value& object) const;
+
+ private:
+  void run();  // thread body: relist loop wrapping the watch loop
+  void bump_watch_failure(const std::string& why);
+
+  const k8s::Client& kube_;
+  ResourceSpec spec_;
+  Store store_;
+  std::atomic<bool> synced_{false};
+  std::atomic<bool> stop_{false};
+  std::thread thread_;
+  mutable std::mutex stats_mutex_;
+  ResourceStats stats_;
+  std::string resource_version_;  // watch bookmark, owned by the thread
+};
+
+// The daemon-facing facade: one Reflector per watched resource, lookups
+// routed by object path shape.
+class ClusterCache {
+ public:
+  ClusterCache(const k8s::Client& kube, std::vector<ResourceSpec> specs);
+  ~ClusterCache();
+
+  void start();
+  void stop();
+
+  // Block until every resource has completed its initial LIST, up to
+  // timeout_ms. Returns whether full sync was reached (callers proceed
+  // either way — unsynced resources just miss).
+  bool wait_synced(int timeout_ms) const;
+
+  // Cached object for a namespaced object path, or nullopt when the path's
+  // resource is unwatched/unsynced or the object is absent. Callers MUST
+  // treat nullopt as "ask the API server", never as a 404.
+  std::optional<json::Value> get(const std::string& object_path) const;
+
+  bool all_synced() const;
+  // True when the pods resource specifically is synced (the resolve
+  // phase's gate for skipping its namespace pod LISTs).
+  bool pods_synced() const;
+
+  // Aggregate + per-resource stats (capi/tests/metrics).
+  json::Value stats_json() const;
+
+ private:
+  const Reflector* route(const std::string& object_path) const;
+
+  std::vector<std::unique_ptr<Reflector>> reflectors_;
+};
+
+}  // namespace tpupruner::informer

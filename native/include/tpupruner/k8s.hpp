@@ -1,10 +1,12 @@
 // Minimal Kubernetes REST client.
 //
 // Reference analog: the kube-rs Client (gpu-pruner/src/main.rs:333, 411) —
-// but deliberately watch-free and typed-binding-free: the reference only
-// ever GETs single objects, LISTs pods by label, PATCHes, and POSTs Events
-// (SURVEY.md §7 "hard parts" #2), and CR objects are handled as JSON
-// (§2 #10). Config inference order:
+// typed-binding-free: the reference only ever GETs single objects, LISTs
+// pods by label, PATCHes, and POSTs Events (SURVEY.md §7 "hard parts" #2),
+// and CR objects are handled as JSON (§2 #10). One deliberate extension
+// beyond the reference: a streaming `watch()` verb, the transport under
+// the informer-style cluster cache (informer.hpp / --watch-cache=on).
+// Config inference order:
 //   1. env: KUBE_API_URL (+ KUBE_TOKEN / KUBE_TOKEN_FILE / KUBE_CA_FILE /
 //      KUBE_TLS_SKIP) — also the hermetic-test seam;
 //   2. in-cluster: KUBERNETES_SERVICE_HOST/PORT + mounted SA token and CA;
@@ -12,6 +14,9 @@
 //      only; exec/client-cert auth is out of scope and errors clearly).
 #pragma once
 
+#include <atomic>
+#include <cstdint>
+#include <functional>
 #include <optional>
 #include <stdexcept>
 #include <string>
@@ -68,6 +73,30 @@ class Client {
   json::Value post(const std::string& path, const json::Value& body,
                    bool retry_throttle = true) const;
 
+  // ── watch (the informer transport) ──
+  struct WatchOptions {
+    // Start point: events strictly after this version stream; empty asks
+    // the server for "current state onward" (informers always pass the
+    // version of their LIST snapshot).
+    std::string resource_version;
+    bool bookmarks = true;       // allowWatchBookmarks=true
+    int read_timeout_ms = 90000;  // per-socket-wait cap, not a stream cap
+    std::function<bool()> abort;  // polled ~4x/s while idle; true = hang up
+  };
+  // Long-lived streaming GET `path?watch=true&...`. Decodes the
+  // newline-delimited event frames and hands each {type, object} JSON to
+  // on_event; returning false ends the watch cleanly. Returns when the
+  // server closes the stream (routine — re-watch from the last seen
+  // resourceVersion). Throws ApiError on a non-200 response — 410 Gone is
+  // the relist signal — and runtime_error on transport failures.
+  void watch(const std::string& path, const WatchOptions& opts,
+             const std::function<bool(const json::Value&)>& on_event) const;
+
+  // Monotonic count of API requests issued through this client (watch
+  // connections count once). Feeds the per-cycle call accounting the
+  // daemon logs and the bench asserts on.
+  uint64_t api_calls() const { return api_calls_.load(); }
+
   // ── path builders ──
   static std::string pod_path(const std::string& ns, const std::string& name);
   static std::string pods_path(const std::string& ns);
@@ -93,6 +122,7 @@ class Client {
 
   Config config_;
   http::Client http_;
+  mutable std::atomic<uint64_t> api_calls_{0};
 };
 
 }  // namespace tpupruner::k8s

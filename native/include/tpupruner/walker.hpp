@@ -24,6 +24,7 @@
 #include <vector>
 
 #include "tpupruner/core.hpp"
+#include "tpupruner/informer.hpp"
 #include "tpupruner/k8s.hpp"
 
 namespace tpupruner::walker {
@@ -76,8 +77,14 @@ size_t prefetch_owner_chains(const k8s::Client& client, FetchCache& cache,
 // Throws std::runtime_error("no scalable root object ...") when the pod has
 // no recognized owner chain — callers log-and-skip (main.rs:517-527).
 // `cache` (optional) memoizes owner fetches within an evaluation cycle.
+// `watch_cache` (optional) makes the per-cycle cache a READ-THROUGH view of
+// the watch-backed cluster store: each owner fetch consults the store
+// first and only falls back to a live GET on a miss (store unsynced,
+// resource unwatched, or object genuinely absent — absence is never
+// negative-cached, so a lagging watch costs an API call, not correctness).
 core::ScaleTarget find_root_object(const k8s::Client& client, const json::Value& pod,
-                                   FetchCache* cache = nullptr);
+                                   FetchCache* cache = nullptr,
+                                   const informer::ClusterCache* watch_cache = nullptr);
 
 // Key "ns/pod" set of idle pods discovered this cycle.
 using IdlePodSet = std::set<std::string>;

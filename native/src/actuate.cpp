@@ -11,12 +11,43 @@ using core::Kind;
 using core::ScaleTarget;
 using json::Value;
 
-void scale_to_zero(const k8s::Client& client, const ScaleTarget& target,
+bool already_paused(const ScaleTarget& target) {
+  const Value& obj = target.object;
+  switch (target.kind) {
+    case Kind::Deployment:
+    case Kind::ReplicaSet:
+    case Kind::StatefulSet:
+    case Kind::LeaderWorkerSet: {
+      const Value* r = obj.at_path("spec.replicas");
+      return r && r->is_number() && r->as_int() == 0;
+    }
+    case Kind::JobSet: {
+      const Value* s = obj.at_path("spec.suspend");
+      return s && s->is_bool() && s->as_bool();
+    }
+    case Kind::Notebook: {
+      const Value* a = obj.at_path("metadata.annotations");
+      return a && a->is_object() && a->find("kubeflow-resource-stopped");
+    }
+    case Kind::InferenceService: {
+      const Value* m = obj.at_path("spec.predictor.minReplicas");
+      return m && m->is_number() && m->as_int() == 0;
+    }
+  }
+  return false;
+}
+
+bool scale_to_zero(const k8s::Client& client, const ScaleTarget& target,
                    const ScaleOptions& opts) {
   auto ns_opt = target.ns();
   if (!ns_opt) throw std::runtime_error("target has no namespace: " + target.name());
   const std::string& ns = *ns_opt;
   const std::string name = target.name();
+
+  if (opts.skip_if_already_paused && already_paused(target)) {
+    log::debug("actuate", ns + "/" + name + " already at paused state; skipping");
+    return false;
+  }
 
   // 1. audit Event first; failure is log-only (lib.rs:344-348)
   {
@@ -69,6 +100,7 @@ void scale_to_zero(const k8s::Client& client, const ScaleTarget& target,
       break;
     }
   }
+  return true;
 }
 
 }  // namespace tpupruner::actuate

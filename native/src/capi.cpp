@@ -5,16 +5,23 @@
 // back as {"error": "..."} so test assertions can target messages.
 #include <cstdlib>
 #include <cstring>
+#include <memory>
+#include <mutex>
 #include <string>
+#include <unordered_map>
 
 #include "otlp_grpc.hpp"
 #include "tpupruner/core.hpp"
+#include "tpupruner/informer.hpp"
 #include "tpupruner/json.hpp"
+#include "tpupruner/k8s.hpp"
 #include "tpupruner/metrics.hpp"
 #include "tpupruner/query.hpp"
 
 using tpupruner::json::Value;
 namespace core = tpupruner::core;
+namespace informer = tpupruner::informer;
+namespace k8s = tpupruner::k8s;
 namespace otlp_grpc = tpupruner::otlp_grpc;
 
 namespace {
@@ -102,6 +109,35 @@ tpupruner::query::QueryArgs query_args_from_json(const Value& v) {
     a.duty_cycle_metric = x->as_string();
   if (const Value* x = v.find("hbm_metric"); x && x->is_string()) a.hbm_metric = x->as_string();
   return a;
+}
+
+// ── informer sessions ──
+//
+// The informer's reflector threads live inside THIS library, so the
+// Python tier can drive the real list+watch machinery against its fake
+// apiserver in-process: start a session, mutate the fake, poll the store
+// until it converges, inject 410s/drops, assert the relist behavior. A
+// session owns its own k8s::Client (the daemon path shares the daemon's).
+struct InformerSession {
+  k8s::Client client;
+  informer::ClusterCache cache;
+  InformerSession(k8s::Config cfg, std::vector<informer::ResourceSpec> specs)
+      : client(std::move(cfg)), cache(client, std::move(specs)) {}
+};
+
+std::mutex g_informer_mutex;
+std::unordered_map<int64_t, std::unique_ptr<InformerSession>> g_informer_sessions;
+int64_t g_next_informer_id = 1;
+
+InformerSession& informer_session(const Value& payload) {
+  const Value* h = payload.find("handle");
+  if (!h || !h->is_number()) throw std::runtime_error("missing handle");
+  std::lock_guard<std::mutex> lock(g_informer_mutex);
+  auto it = g_informer_sessions.find(h->as_int());
+  if (it == g_informer_sessions.end()) {
+    throw std::runtime_error("unknown informer handle " + std::to_string(h->as_int()));
+  }
+  return *it->second;
 }
 
 }  // namespace
@@ -217,6 +253,93 @@ char* tp_dedup_targets(const char* targets_json) {
 char* tp_target_meta(const char* target_json) {
   return guarded([&] {
     return ok(meta_to_json(target_from_json(Value::parse(target_json))));
+  });
+}
+
+char* tp_informer_start(const char* payload_json) {
+  // {api_url, token?, resources?: ["pods", ...], wait_ms?} → {handle, synced}.
+  // resources defaults to the daemon's full watch set; wait_ms (default
+  // 5000) bounds the initial-sync wait — synced=false is returned, not
+  // thrown, so tests can assert the degraded path too.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    const Value* url = p.find("api_url");
+    if (!url || !url->is_string()) throw std::runtime_error("missing api_url");
+    k8s::Config cfg;
+    cfg.api_url = url->as_string();
+    cfg.token = p.get_string("token");
+    std::vector<informer::ResourceSpec> specs;
+    if (const Value* res = p.find("resources"); res && res->is_array()) {
+      for (const Value& r : res->as_array()) {
+        auto spec = informer::spec_for(r.as_string());
+        if (!spec) throw std::runtime_error("unknown resource: " + r.as_string());
+        specs.push_back(std::move(*spec));
+      }
+    } else {
+      specs = informer::daemon_specs();
+    }
+    int wait_ms = 5000;
+    if (const Value* w = p.find("wait_ms"); w && w->is_number())
+      wait_ms = static_cast<int>(w->as_int());
+
+    auto session = std::make_unique<InformerSession>(std::move(cfg), std::move(specs));
+    session->cache.start();
+    bool synced = session->cache.wait_synced(wait_ms);
+    int64_t handle;
+    {
+      std::lock_guard<std::mutex> lock(g_informer_mutex);
+      handle = g_next_informer_id++;
+      g_informer_sessions[handle] = std::move(session);
+    }
+    Value out = Value::object();
+    out.set("handle", Value(handle));
+    out.set("synced", Value(synced));
+    return ok(out);
+  });
+}
+
+char* tp_informer_stats(const char* payload_json) {
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    return ok(informer_session(p).cache.stats_json());
+  });
+}
+
+char* tp_informer_get(const char* payload_json) {
+  // {handle, path} → {found, object?}; found=false covers both a genuine
+  // absence and an unsynced/unwatched resource (the cache's own
+  // "fall back to a GET" signal, surfaced verbatim).
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    const Value* path = p.find("path");
+    if (!path || !path->is_string()) throw std::runtime_error("missing path");
+    auto obj = informer_session(p).cache.get(path->as_string());
+    Value out = Value::object();
+    out.set("found", Value(obj.has_value()));
+    if (obj) out.set("object", std::move(*obj));
+    return ok(out);
+  });
+}
+
+char* tp_informer_stop(const char* payload_json) {
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    const Value* h = p.find("handle");
+    if (!h || !h->is_number()) throw std::runtime_error("missing handle");
+    std::unique_ptr<InformerSession> session;
+    {
+      std::lock_guard<std::mutex> lock(g_informer_mutex);
+      auto it = g_informer_sessions.find(h->as_int());
+      if (it != g_informer_sessions.end()) {
+        session = std::move(it->second);
+        g_informer_sessions.erase(it);
+      }
+    }
+    bool stopped = session != nullptr;
+    if (session) session->cache.stop();  // join reflectors before the client dies
+    Value out = Value::object();
+    out.set("stopped", Value(stopped));
+    return ok(out);
   });
 }
 

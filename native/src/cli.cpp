@@ -113,6 +113,17 @@ TPU FLAGS:
                                 (a metric-plane outage reading the whole fleet
                                 as idle then can't suspend it all at once);
                                 0 = unlimited [default: 0]
+      --watch-cache <M>         on | off [default: off] — informer-style
+                                List+Watch cluster cache: LIST each resource
+                                once, then hold a watch stream and serve pod
+                                acquisition + the owner walk from the local
+                                store (steady-state K8s API cost scales with
+                                churn, not cluster size; falls back to live
+                                GETs whenever the watch is unhealthy). "off"
+                                keeps the watch-free client for parity.
+                                RBAC: needs the `watch` verb (clusterrole.yaml)
+      --max-cycles <N>          daemon mode: exit cleanly after N evaluation
+                                cycles (bench/test harness; 0 = unlimited)
       --metrics-port <P>        serve Prometheus /metrics + /healthz on this port
                                 (0 = disabled, "auto" = ephemeral)
       --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
@@ -211,6 +222,16 @@ Cli parse(int argc, char** argv) {
          cli.max_scale_per_cycle = parse_int("--max-scale-per-cycle", v);
          if (cli.max_scale_per_cycle < 0)
            throw CliError("--max-scale-per-cycle must be >= 0");
+       }},
+      {"--watch-cache",
+       [&](const std::string& v) {
+         check_choice("--watch-cache", v, {"on", "off"});
+         cli.watch_cache = v;
+       }},
+      {"--max-cycles",
+       [&](const std::string& v) {
+         cli.max_cycles = parse_int("--max-cycles", v);
+         if (cli.max_cycles < 0) throw CliError("--max-cycles must be >= 0");
        }},
       {"--metrics-port",
        [&](const std::string& v) {

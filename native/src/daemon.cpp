@@ -133,7 +133,8 @@ extern "C" void on_shutdown_signal(int signum) {
 // O(namespaces × kinds) API calls instead of O(pods).
 ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
                             const std::vector<core::PodMetricSample>& samples,
-                            const otlp::SpanContext& parent_ctx) {
+                            const otlp::SpanContext& parent_ctx,
+                            const informer::ClusterCache* watch_cache) {
   ResolveOutcome out;
   std::mutex out_mutex;
   walker::FetchCache owner_cache;  // memoize shared owner chains this cycle
@@ -141,14 +142,22 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   int64_t now = util::now_unix();
   size_t workers = static_cast<size_t>(args.resolve_concurrency);
 
+  // Watch-backed store states, sampled ONCE per cycle: flipping mid-cycle
+  // (a relist landing between phases) must not mix strategies — per-lookup
+  // fallbacks still apply either way.
+  const bool store_pods = watch_cache && watch_cache->pods_synced();
+  const bool store_owners = watch_cache && watch_cache->all_synced();
+
   // Phase 1 — acquire pods. Namespaces with more candidates than the batch
   // threshold are fetched with one pods LIST; the rest (and any pod missing
-  // from its LIST snapshot) fall back to per-pod GETs.
+  // from its LIST snapshot) fall back to per-pod GETs. With a synced watch
+  // store the LISTs are pointless — every lookup below hits the store — so
+  // the phase is skipped wholesale.
   std::unordered_map<std::string, size_t> ns_counts;
   for (const core::PodMetricSample& s : samples) ++ns_counts[s.ns];
   std::vector<std::string> batch_ns;
   for (const auto& [ns, count] : ns_counts) {
-    if (args.resolve_batch_threshold > 0 &&
+    if (!store_pods && args.resolve_batch_threshold > 0 &&
         count > static_cast<size_t>(args.resolve_batch_threshold)) {
       batch_ns.push_back(ns);
     }
@@ -204,6 +213,17 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     {
       auto it = prefetched.find(key);
       if (it != prefetched.end()) pod = it->second;
+    }
+    if (!pod && watch_cache) {
+      // Watch-backed store hit (the steady-state path: zero API calls). A
+      // miss is NOT authoritative — fall through to the GET below, so a
+      // lagging watch can never hide a pod (and with it a possible
+      // tpu-pruner.dev/skip annotation) from the safety gates.
+      if (auto hit = watch_cache->get(k8s::Client::pod_path(pmd.ns, pmd.name))) {
+        std::lock_guard<std::mutex> lock(out_mutex);
+        owned_pods.push_back(std::move(*hit));
+        pod = &owned_pods.back();
+      }
     }
     if (!pod) {
       std::optional<json::Value> fetched;
@@ -264,7 +284,9 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   });
 
   // Phase 3 — batched owner prefetch, then the owner walk per eligible pod.
-  if (args.resolve_batch_threshold > 0 && !eligible.empty()) {
+  // A fully synced store makes the prefetch LISTs redundant: the walk's
+  // read-through cache hits the store per owner instead.
+  if (!store_owners && args.resolve_batch_threshold > 0 && !eligible.empty()) {
     otlp::Span span("prefetch_owner_chains", &parent_ctx);
     std::vector<const json::Value*> pods;
     pods.reserve(eligible.size());
@@ -285,7 +307,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       otlp::Span span("find_root_object", &parent_ctx);  // lib.rs:436 span
       span.attr("pod", key);
       try {
-        target = walker::find_root_object(kube, *e.pod, &owner_cache);
+        target = walker::find_root_object(kube, *e.pod, &owner_cache, watch_cache);
       } catch (const std::exception& e2) {
         span.set_error(e2.what());
         if (e.opted_out) {
@@ -329,10 +351,12 @@ static auto with_span(otlp::Span& span, Fn&& fn) -> decltype(fn()) {
 
 CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
                      core::ResourceSet enabled,
-                     const std::function<void(ScaleTarget)>& enqueue) {
+                     const std::function<void(ScaleTarget)>& enqueue,
+                     const informer::ClusterCache* watch_cache) {
   // Cycle span (reference #[tracing::instrument] on run_query_and_scale,
   // main.rs:390); children below mirror the instrumented callees.
   otlp::Span cycle("run_query_and_scale");
+  const uint64_t api_calls_before = kube.api_calls();
   return with_span(cycle, [&] {
   prom::Client prom_client = build_prom_client(args);
   json::Value response = [&] {
@@ -348,7 +372,8 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   log::info("daemon", "Query returned " + std::to_string(decoded.num_series) + " series across " +
             std::to_string(decoded.samples.size()) + " unique pods");
 
-  ResolveOutcome resolved = resolve_pods(args, kube, decoded.samples, cycle.context());
+  ResolveOutcome resolved =
+      resolve_pods(args, kube, decoded.samples, cycle.context(), watch_cache);
   std::vector<ScaleTarget> unique = core::dedup_targets(std::move(resolved.targets));
 
   // Opt-out valves, applied before the group gate so a skipped JobSet/LWS
@@ -446,6 +471,11 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   stats.num_series = decoded.num_series;
   stats.num_pods = decoded.samples.size();
   stats.shutdown_events = survivors.size();
+  // Resolution-side count (actuation calls land on the consumers after
+  // this returns; the producer loop logs the full-cycle figure). Reflector
+  // threads share the client, so informer LIST/watch requests are counted
+  // too — deliberate: they ARE cycle-serving traffic.
+  stats.api_calls = kube.api_calls() - api_calls_before;
   cycle.attr("num_series", static_cast<int64_t>(stats.num_series));
   cycle.attr("num_pods", static_cast<int64_t>(stats.num_pods));
   cycle.attr("shutdown_events", static_cast<int64_t>(stats.shutdown_events));
@@ -493,6 +523,24 @@ int run(const cli::Cli& args) {
       throw;
     }
   }();
+
+  // Watch-backed cluster cache (--watch-cache=on): LIST each resource once,
+  // hold watch streams, serve resolution from the local store. The initial
+  // sync wait is best-effort — an unsynced resource just means its lookups
+  // fall back to live GETs (same degradation as a mid-run watch outage),
+  // so a slow or watch-hostile apiserver delays nothing but the savings.
+  std::unique_ptr<informer::ClusterCache> watch_cache;
+  if (args.watch_cache == "on") {
+    watch_cache = std::make_unique<informer::ClusterCache>(kube, informer::daemon_specs());
+    watch_cache->start();
+    if (watch_cache->wait_synced(10000)) {
+      log::info("daemon", "watch cache synced (" +
+                watch_cache->stats_json().find("objects")->dump() + " objects)");
+    } else {
+      log::warn("daemon", "watch cache not fully synced after 10s; "
+                "unsynced resources fall back to live GETs");
+    }
+  }
 
   // Optional pull-based counters exposition (OTLP-push analog, SURVEY.md §2 #12).
   std::unique_ptr<metrics_http::Server> metrics_server;
@@ -618,6 +666,12 @@ int run(const cli::Cli& args) {
       }
       actuate::ScaleOptions opts;
       opts.device = args.device;
+      // With the watch cache on, resolved objects are fresh enough to see
+      // our own previous patch — skip targets already at their paused
+      // state instead of re-patching every cycle. Gated on the flag so
+      // --watch-cache=off reproduces the re-patch-each-cycle behavior
+      // exactly (parity runs).
+      opts.skip_if_already_paused = args.watch_cache == "on";
       // Root span per actuation: the consumer runs on its own task, so
       // scale traces are separate from the query cycle's, as in the
       // reference (lib.rs:338 instrument on scale()).
@@ -625,12 +679,20 @@ int run(const cli::Cli& args) {
       span.attr("kind", std::string(core::kind_name(t->kind)));
       span.attr("name", t->name());
       span.attr("namespace", t->ns().value_or(""));
+      bool patched = false;
       try {
-        actuate::scale_to_zero(kube, *t, opts);
+        patched = actuate::scale_to_zero(kube, *t, opts);
       } catch (const std::exception& e) {
         span.set_error(e.what());
         log::counter_add("scale_failures", 1);
         log::error("daemon", std::string("Failed to scale resource! ") + e.what());
+        continue;
+      }
+      if (!patched) {
+        log::counter_add("scale_noops", 1);
+        log::info("daemon", "Already paused (no-op): [" +
+                  std::string(core::kind_name(t->kind)) + "] - " +
+                  t->ns().value_or("default") + ":" + t->name());
         continue;
       }
       log::counter_add("scale_successes", 1);
@@ -646,6 +708,8 @@ int run(const cli::Cli& args) {
   int consecutive_failures = 0;
   bool budget_exhausted = false;
   bool last_cycle_failed = false;
+  int64_t cycles_run = 0;
+  bool cache_was_healthy = true;
   while (true) {
     if (g_shutdown_signal) break;
     auto cycle_start = std::chrono::steady_clock::now();
@@ -667,17 +731,35 @@ int run(const cli::Cli& args) {
       }
       continue;
     }
+    if (watch_cache) {
+      // Surface health transitions once, not per lookup: degraded mode is
+      // per-lookup GET fallback, which is silent by design.
+      bool healthy = watch_cache->all_synced();
+      if (healthy != cache_was_healthy) {
+        if (healthy) log::info("daemon", "watch cache recovered; serving lookups from the store");
+        else log::warn("daemon", "watch cache degraded (watch loop unhealthy); "
+                       "falling back to live GETs until it resyncs");
+        cache_was_healthy = healthy;
+      }
+      const json::Value stats = watch_cache->stats_json();
+      if (const json::Value* objs = stats.find("objects"); objs && objs->is_number()) {
+        log::counter_set("informer_objects", static_cast<uint64_t>(objs->as_int()));
+      }
+      log::counter_set("informer_synced", healthy ? 1 : 0);
+    }
     last_cycle_failed = false;
     try {
       CycleStats stats = run_cycle(args, query, kube, enabled, [&](ScaleTarget t) {
         queue.push(std::move(t));
-      });
+      }, watch_cache.get());
       consecutive_failures = 0;
       log::counter_add("query_successes", 1);
       log::counter_set("query_returned_candidates", stats.num_pods);
       log::counter_set("query_returned_shutdown_events", stats.shutdown_events);
+      log::counter_set("cycle_resolution_api_calls", stats.api_calls);
       log::info("daemon", "Query succeeded: " + std::to_string(stats.num_pods) + " candidates, " +
-                std::to_string(stats.shutdown_events) + " shutdown events");
+                std::to_string(stats.shutdown_events) + " shutdown events, " +
+                std::to_string(stats.api_calls) + " resolution K8s API calls");
     } catch (const std::exception& e) {
       int prev = consecutive_failures++;
       last_cycle_failed = true;
@@ -691,6 +773,11 @@ int run(const cli::Cli& args) {
     }
     last_progress->store(util::mono_secs());  // cycle completed (or failed cleanly)
     if (!args.daemon_mode) break;
+    if (args.max_cycles > 0 && ++cycles_run >= args.max_cycles) {
+      log::info("daemon", "Reached --max-cycles=" + std::to_string(args.max_cycles) +
+                ", exiting");
+      break;
+    }
     // Interruptible interval sleep: a signal handler can't safely notify a
     // condition variable, so poll the flag in short chunks instead of one
     // long sleep_for — shutdown latency stays <250ms within a K8s
@@ -720,6 +807,7 @@ int run(const cli::Cli& args) {
     }
     notifier.join();
   }
+  if (watch_cache) watch_cache->stop();  // hang up the watch streams (≤250ms each)
   // Deviation from the reference (which exits 0 even when its only cycle
   // failed, main.rs:324-326): a failed single-shot run exits 1 so cron/CI
   // wrappers can detect it. Daemon mode exits 1 only on budget exhaustion.

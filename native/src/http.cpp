@@ -131,6 +131,12 @@ int connect_with_timeout(const std::string& host, int port, int timeout_ms) {
 // produce a transport error, not an OOM kill.
 constexpr size_t kMaxResponseBytes = 256u << 20;  // 256 MiB
 
+// Thrown (and caught inside request_stream) when the caller's abort
+// predicate fires mid-stream — an orderly local hang-up, not an error.
+struct StreamAborted : std::runtime_error {
+  StreamAborted() : std::runtime_error("stream aborted by caller") {}
+};
+
 // Incremental reader with buffering for header/line parsing.
 struct Reader {
   Conn& conn;
@@ -138,9 +144,22 @@ struct Reader {
   size_t pos = 0;
   bool eof = false;
   bool got_bytes = false;  // any response bytes at all (stale-retry signal)
+  // Streaming mode: polled ~4x/s while the socket is idle so a watch
+  // shutdown never waits out the full read timeout.
+  std::function<bool()> abort_check{};
 
   bool fill() {
     if (eof) return false;
+    if (abort_check) {
+      // TLS may hold decrypted bytes a raw-fd poll can't see.
+      while (!(conn.tls_conn && conn.tls_conn->pending())) {
+        struct pollfd pfd{conn.fd, POLLIN, 0};
+        int rc = ::poll(&pfd, 1, 250);
+        if (rc > 0) break;
+        if (rc < 0 && errno != EINTR) fail(std::string("poll: ") + std::strerror(errno));
+        if (abort_check()) throw StreamAborted();
+      }
+    }
     // Cap the UNCONSUMED tail, not the lifetime stream: consumed bytes are
     // trimmed below, so a legal body of exactly kMaxResponseBytes passes
     // while a hostile one fails before buffering past ~the cap.
@@ -334,6 +353,72 @@ void establish_tunnel(Conn& conn, const Url& target, const ProxyTarget& proxy,
   // reader buffer is empty past the proxy headers.
 }
 
+// Serialized request line + headers + body. Through an http proxy,
+// plain-http requests go out in absolute-form (RFC 9112 §3.2.2) so the
+// proxy knows the upstream; tunneled https and direct connections keep
+// origin-form.
+std::string build_request_message(const Request& req, const Url& url,
+                                  const std::optional<ProxyTarget>& proxy) {
+  std::string request_target = url.target;
+  if (proxy && url.scheme == "http") {
+    request_target = "http://" + url.host +
+                     (url.port != 80 ? ":" + std::to_string(url.port) : "") + url.target;
+  }
+  std::string msg = req.method + " " + request_target + " HTTP/1.1\r\n";
+  msg += "Host: " + url.host +
+         (url.port != (url.scheme == "https" ? 443 : 80) ? ":" + std::to_string(url.port) : "") +
+         "\r\n";
+  if (proxy && url.scheme == "http" && !proxy->basic_auth.empty()) {
+    msg += "Proxy-Authorization: " + proxy->basic_auth + "\r\n";
+  }
+  bool has_ua = false;
+  for (const auto& [k, v] : req.headers) {
+    msg += k + ": " + v + "\r\n";
+    if (util::to_lower(k) == "user-agent") has_ua = true;
+  }
+  if (!has_ua) msg += "User-Agent: tpu-pruner/0.1\r\n";
+  if (!req.body.empty() || req.method == "POST" || req.method == "PATCH" || req.method == "PUT") {
+    msg += "Content-Length: " + std::to_string(req.body.size()) + "\r\n";
+  }
+  msg += "\r\n";
+  msg += req.body;
+  return msg;
+}
+
+// Header block into resp.headers (keys lowercased), up to the blank line.
+void read_headers(Reader& reader, Response& resp) {
+  while (true) {
+    std::string line = reader.read_line();
+    if (line.empty()) break;
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string key = util::to_lower(util::trim(line.substr(0, colon)));
+    resp.headers[key] = util::trim(line.substr(colon + 1));
+  }
+}
+
+// Fresh (non-pooled) connection to `url`, via `proxy` when set, TLS
+// attached for https — the connect path request_once uses on a pool miss,
+// shared with the streaming entry point.
+std::unique_ptr<Conn> open_fresh_conn(const Url& url, const std::optional<ProxyTarget>& proxy,
+                                      int timeout_ms, TlsMode tls_mode,
+                                      const std::string& ca_file) {
+  auto conn = std::make_unique<Conn>();
+  if (proxy) {
+    conn->fd = connect_with_timeout(proxy->host, proxy->port, timeout_ms);
+    if (url.scheme == "https") {
+      establish_tunnel(*conn, url, *proxy, timeout_ms);
+    }
+  } else {
+    conn->fd = connect_with_timeout(url.host, url.port, timeout_ms);
+  }
+  if (url.scheme == "https") {
+    conn->tls_conn = std::make_unique<tls::Conn>(conn->fd, url.host,
+                                                 tls_mode == TlsMode::Verify, ca_file);
+  }
+  return conn;
+}
+
 }  // namespace
 
 std::optional<Url> parse_url(std::string_view url) {
@@ -416,49 +501,10 @@ Response Client::request_once(const Request& req, const Url& url, bool allow_reu
     }
   }
   if (!conn) {
-    conn = std::make_unique<Conn>();
-    if (proxy) {
-      conn->fd = connect_with_timeout(proxy->host, proxy->port, req.timeout_ms);
-      if (url.scheme == "https") {
-        establish_tunnel(*conn, url, *proxy, req.timeout_ms);
-      }
-    } else {
-      conn->fd = connect_with_timeout(url.host, url.port, req.timeout_ms);
-    }
-    if (url.scheme == "https") {
-      conn->tls_conn = std::make_unique<tls::Conn>(conn->fd, url.host,
-                                                   tls_mode_ == TlsMode::Verify, ca_file_);
-    }
+    conn = open_fresh_conn(url, proxy, req.timeout_ms, tls_mode_, ca_file_);
   }
   conn->set_timeout(req.timeout_ms);
-
-  // ── send request ──
-  // Through an http proxy, plain-http requests go out in absolute-form
-  // (RFC 9112 §3.2.2) so the proxy knows the upstream; tunneled https and
-  // direct connections keep origin-form.
-  std::string request_target = url.target;
-  if (proxy && url.scheme == "http") {
-    request_target = "http://" + url.host +
-                     (url.port != 80 ? ":" + std::to_string(url.port) : "") + url.target;
-  }
-  std::string msg = req.method + " " + request_target + " HTTP/1.1\r\n";
-  msg += "Host: " + url.host +
-         (url.port != (url.scheme == "https" ? 443 : 80) ? ":" + std::to_string(url.port) : "") +
-         "\r\n";
-  if (proxy && url.scheme == "http" && !proxy->basic_auth.empty()) {
-    msg += "Proxy-Authorization: " + proxy->basic_auth + "\r\n";
-  }
-  bool has_ua = false;
-  for (const auto& [k, v] : req.headers) {
-    msg += k + ": " + v + "\r\n";
-    if (util::to_lower(k) == "user-agent") has_ua = true;
-  }
-  if (!has_ua) msg += "User-Agent: tpu-pruner/0.1\r\n";
-  if (!req.body.empty() || req.method == "POST" || req.method == "PATCH" || req.method == "PUT") {
-    msg += "Content-Length: " + std::to_string(req.body.size()) + "\r\n";
-  }
-  msg += "\r\n";
-  msg += req.body;
+  std::string msg = build_request_message(req, url, proxy);
 
   // Wire log under its own module so production debugging can do
   // `TPU_PRUNER_LOG=info,http=trace` (or the inverse: silence it with
@@ -495,14 +541,7 @@ Response Client::request_once(const Request& req, const Url& url, bool allow_reu
     if (conn->reused && !reader.got_bytes) throw StaleConnection(e.what());
     throw;
   }
-  while (true) {
-    std::string line = reader.read_line();
-    if (line.empty()) break;
-    size_t colon = line.find(':');
-    if (colon == std::string::npos) continue;
-    std::string key = util::to_lower(util::trim(line.substr(0, colon)));
-    resp.headers[key] = util::trim(line.substr(colon + 1));
-  }
+  read_headers(reader, resp);
 
   bool keep_alive = true;
   if (auto c = resp.headers.find("connection"); c != resp.headers.end()) {
@@ -570,6 +609,94 @@ Response Client::request_once(const Request& req, const Url& url, bool allow_reu
       conn->reused = false;
       pool_.emplace(pool_key, std::move(conn));
     }
+  }
+  return resp;
+}
+
+Response Client::request_stream(const Request& req,
+                                const std::function<bool(const char*, size_t)>& on_data,
+                                const std::function<bool()>& abort,
+                                const std::function<void(const Response&)>& on_headers) const {
+  auto url = parse_url(req.url);
+  if (!url) fail("invalid url: " + req.url);
+  std::optional<ProxyTarget> proxy = proxy_for(*url);
+  std::unique_ptr<Conn> conn =
+      open_fresh_conn(*url, proxy, req.timeout_ms, tls_mode_, ca_file_);
+  conn->set_timeout(req.timeout_ms);
+
+  std::string msg = build_request_message(req, *url, proxy);
+  conn->write_all(msg.data(), msg.size());
+
+  Response resp;
+  Reader reader{*conn};
+  std::string status_line = reader.read_line();
+  auto sp1 = status_line.find(' ');
+  if (sp1 == std::string::npos) fail("malformed status line: " + status_line);
+  resp.status = std::atoi(status_line.c_str() + sp1 + 1);
+  if (resp.status < 100 || resp.status > 599) fail("bad status in: " + status_line);
+  read_headers(reader, resp);
+  if (on_headers) on_headers(resp);
+  // Arm the abort poll only for the body: headers arrive promptly, bodies
+  // (watch streams) idle for arbitrary stretches.
+  reader.abort_check = abort;
+
+  // Deliver consumed-and-decoded body bytes; returns false to stop.
+  auto deliver = [&](const char* data, size_t n) { return n == 0 || on_data(data, n); };
+  bool body_expected = !(req.method == "HEAD" || resp.status == 204 || resp.status == 304);
+  try {
+    if (!body_expected) return resp;
+    auto te = resp.headers.find("transfer-encoding");
+    if (te != resp.headers.end() &&
+        util::to_lower(te->second).find("chunked") != std::string::npos) {
+      while (true) {
+        std::string size_line = reader.read_line();
+        size_t semi = size_line.find(';');
+        if (semi != std::string::npos) size_line.resize(semi);
+        size_t chunk_size = 0;
+        try {
+          chunk_size = static_cast<size_t>(std::stoul(util::trim(size_line), nullptr, 16));
+        } catch (const std::exception&) {
+          fail("bad chunk size: " + size_line);
+        }
+        if (chunk_size == 0) break;
+        std::string chunk = reader.read_exact(chunk_size);
+        reader.read_line();  // CRLF after chunk data
+        if (!deliver(chunk.data(), chunk.size())) return resp;
+      }
+      // Trailers are tolerated like request_once: the body is complete.
+      try {
+        while (!reader.read_line().empty()) {
+        }
+      } catch (const std::exception&) {
+      }
+    } else if (auto cl = resp.headers.find("content-length"); cl != resp.headers.end()) {
+      size_t n = 0;
+      try {
+        n = static_cast<size_t>(std::stoul(cl->second));
+      } catch (const std::exception&) {
+        fail("bad content-length: " + cl->second);
+      }
+      size_t remaining = n;
+      while (remaining > 0) {
+        // Drain buffered bytes first, then read socket-sized pieces —
+        // never buffer the whole declared length (a watch would OOM).
+        if (reader.drained() && !reader.fill()) fail("unexpected EOF in body");
+        size_t take = std::min(remaining, reader.buf.size() - reader.pos);
+        std::string piece = reader.read_exact(take);
+        remaining -= take;
+        if (!deliver(piece.data(), piece.size())) return resp;
+      }
+    } else {
+      // Close-delimited: stream until EOF.
+      while (true) {
+        if (reader.drained() && !reader.fill()) break;
+        size_t take = reader.buf.size() - reader.pos;
+        std::string piece = reader.read_exact(take);
+        if (!deliver(piece.data(), piece.size())) return resp;
+      }
+    }
+  } catch (const StreamAborted&) {
+    // Caller asked to stop; the connection just closes.
   }
   return resp;
 }

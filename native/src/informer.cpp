@@ -1,0 +1,378 @@
+#include "tpupruner/informer.hpp"
+
+#include <chrono>
+#include <functional>
+
+#include "tpupruner/log.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::informer {
+
+using json::Value;
+
+std::optional<ResourceSpec> spec_for(std::string_view plural) {
+  static const std::map<std::string, ResourceSpec, std::less<>> kSpecs = [] {
+    std::map<std::string, ResourceSpec, std::less<>> out;
+    auto add = [&](const std::string& prefix, const std::string& p) {
+      out[p] = ResourceSpec{prefix + p, prefix, p};
+    };
+    add("/api/v1/", "pods");
+    add("/apis/apps/v1/", "replicasets");
+    add("/apis/apps/v1/", "deployments");
+    add("/apis/apps/v1/", "statefulsets");
+    add("/apis/batch/v1/", "jobs");
+    add("/apis/jobset.x-k8s.io/v1alpha2/", "jobsets");
+    add("/apis/leaderworkerset.x-k8s.io/v1/", "leaderworkersets");
+    add("/apis/kubeflow.org/v1/", "notebooks");
+    add("/apis/serving.kserve.io/v1beta1/", "inferenceservices");
+    return out;
+  }();
+  auto it = kSpecs.find(plural);
+  if (it == kSpecs.end()) return std::nullopt;
+  return it->second;
+}
+
+std::vector<ResourceSpec> daemon_specs() {
+  // Pods plus every kind the owner walk can touch: the walk must be able
+  // to resolve a full chain (Pod → RS → Deployment, Pod → Job → JobSet,
+  // label shortcuts to LWS/InferenceService) without leaving the cache.
+  std::vector<ResourceSpec> out;
+  for (const char* p : {"pods", "replicasets", "deployments", "statefulsets", "jobs",
+                        "jobsets", "leaderworkersets", "notebooks", "inferenceservices"}) {
+    out.push_back(*spec_for(p));
+  }
+  return out;
+}
+
+// ── Store ──
+
+std::optional<Value> Store::get(const std::string& object_path) const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = objects_.find(object_path);
+  if (it == objects_.end()) return std::nullopt;
+  return it->second;  // COW copy: shares nodes, pointer-sized
+}
+
+size_t Store::size() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return objects_.size();
+}
+
+void Store::replace(std::map<std::string, Value> objects) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  objects_ = std::move(objects);
+}
+
+void Store::upsert(const std::string& object_path, Value object) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  objects_[object_path] = std::move(object);
+}
+
+void Store::erase(const std::string& object_path) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  objects_.erase(object_path);
+}
+
+// ── Reflector ──
+
+Reflector::Reflector(const k8s::Client& kube, ResourceSpec spec)
+    : kube_(kube), spec_(std::move(spec)) {}
+
+Reflector::~Reflector() { stop(); }
+
+void Reflector::start() {
+  if (thread_.joinable()) return;
+  stop_.store(false);
+  thread_ = std::thread(&Reflector::run, this);
+}
+
+void Reflector::stop() {
+  stop_.store(true);
+  if (thread_.joinable()) thread_.join();
+}
+
+std::optional<Value> Reflector::get(const std::string& object_path) const {
+  return store_.get(object_path);
+}
+
+ResourceStats Reflector::stats() const {
+  ResourceStats out;
+  {
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    out = stats_;
+  }
+  out.synced = synced_.load();
+  out.objects = store_.size();
+  return out;
+}
+
+std::string Reflector::object_path_of(const Value& object) const {
+  const Value* ns = object.at_path("metadata.namespace");
+  const Value* name = object.at_path("metadata.name");
+  if (!ns || !ns->is_string() || !name || !name->is_string()) return "";
+  return spec_.prefix + "namespaces/" + ns->as_string() + "/" + spec_.plural + "/" +
+         name->as_string();
+}
+
+void Reflector::apply_list(const Value& list) {
+  std::map<std::string, Value> snapshot;
+  if (const Value* items = list.find("items"); items && items->is_array()) {
+    for (const Value& item : items->as_array()) {
+      std::string path = object_path_of(item);
+      if (!path.empty()) snapshot[std::move(path)] = item;
+    }
+  }
+  std::string rv;
+  if (const Value* v = list.at_path("metadata.resourceVersion"); v && v->is_string()) {
+    rv = v->as_string();
+  }
+  store_.replace(std::move(snapshot));
+  resource_version_ = rv;
+  {
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    ++stats_.relists;  // counts the initial LIST too: relists == LISTs issued
+    stats_.resource_version = rv;
+  }
+  synced_.store(true);
+  log::counter_add("informer_relists", 1);
+}
+
+bool Reflector::apply_event(const Value& event) {
+  std::string type = event.get_string("type");
+  const Value* object = event.find("object");
+
+  if (type == "ERROR") {
+    // The in-band relist signal: {"type":"ERROR","object":<Status>}, most
+    // commonly code 410 after apiserver compaction. Any ERROR means the
+    // stream can no longer be trusted — relist regardless of code.
+    int64_t code = 0;
+    if (object) {
+      if (const Value* c = object->find("code"); c && c->is_number()) code = c->as_int();
+    }
+    log::warn("informer", "watch " + spec_.list_path + " ERROR event (code " +
+              std::to_string(code) + "); relisting");
+    return false;
+  }
+
+  std::string rv;
+  if (object) {
+    if (const Value* v = object->at_path("metadata.resourceVersion"); v && v->is_string()) {
+      rv = v->as_string();
+    }
+  }
+
+  if (type == "BOOKMARK") {
+    // Progress marker only: no object payload beyond metadata. Advancing
+    // the resume point here is what keeps a relist after a quiet period
+    // from replaying (or 410ing on) long-compacted history.
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    ++stats_.bookmarks;
+    if (!rv.empty()) stats_.resource_version = rv;
+  } else if (type == "ADDED" || type == "MODIFIED") {
+    if (!object) return true;
+    std::string path = object_path_of(*object);
+    if (path.empty()) return true;
+    bool existed = store_.get(path).has_value();
+    store_.upsert(path, *object);
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    ++(existed ? stats_.updates : stats_.adds);
+    if (!rv.empty()) stats_.resource_version = rv;
+  } else if (type == "DELETED") {
+    if (!object) return true;
+    std::string path = object_path_of(*object);
+    if (path.empty()) return true;
+    store_.erase(path);
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    ++stats_.deletes;
+    if (!rv.empty()) stats_.resource_version = rv;
+  } else {
+    log::debug("informer", "ignoring unknown watch event type: " + type);
+    return true;
+  }
+  if (!rv.empty()) resource_version_ = rv;
+  return true;
+}
+
+namespace {
+
+// Stop-responsive jittered sleep: exponential base capped at 10 s, plus a
+// deterministic per-path offset so a fleet of reflectors knocked over by
+// one apiserver hiccup does not relist in lockstep (the same rationale as
+// the 429 path in k8s.cpp).
+void backoff_sleep(const std::string& path, int attempt, const std::atomic<bool>& stop) {
+  int64_t base = std::min<int64_t>(500LL << std::min(attempt, 5), 10000);
+  int64_t jitter =
+      static_cast<int64_t>(std::hash<std::string>{}(path + std::to_string(attempt)) % 500);
+  int64_t wait_ms = base + jitter;
+  for (int64_t waited = 0; waited < wait_ms && !stop.load(); waited += 100) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+}
+
+}  // namespace
+
+void Reflector::run() {
+  int list_failures = 0;
+  while (!stop_.load()) {
+    Value list;
+    try {
+      list = kube_.list(spec_.list_path, "");
+    } catch (const std::exception& e) {
+      synced_.store(false);
+      log::warn("informer", "LIST " + spec_.list_path + " failed: " + std::string(e.what()));
+      backoff_sleep(spec_.list_path, ++list_failures, stop_);
+      continue;
+    }
+    list_failures = 0;
+    apply_list(list);
+    log::debug("informer", "synced " + spec_.list_path + " (" +
+               std::to_string(store_.size()) + " objects at rv " + resource_version_ + ")");
+
+    int watch_failures = 0;
+    bool relist = false;
+    while (!stop_.load() && !relist) {
+      k8s::Client::WatchOptions wopts;
+      wopts.resource_version = resource_version_;
+      wopts.abort = [this] { return stop_.load(); };
+      try {
+        kube_.watch(spec_.list_path, wopts, [&](const Value& ev) {
+          if (!apply_event(ev)) {
+            relist = true;
+            return false;
+          }
+          watch_failures = 0;
+          return !stop_.load();
+        });
+        // Clean server close: routine — re-watch from the last seen rv.
+      } catch (const k8s::ApiError& e) {
+        if (e.status == 410) {
+          log::info("informer", "watch " + spec_.list_path +
+                    " got 410 Gone (compacted past rv " + resource_version_ + "); relisting");
+          relist = true;
+        } else {
+          ++watch_failures;
+          bump_watch_failure(e.what());
+          backoff_sleep(spec_.list_path, watch_failures, stop_);
+        }
+      } catch (const std::exception& e) {
+        ++watch_failures;
+        bump_watch_failure(e.what());
+        backoff_sleep(spec_.list_path, watch_failures, stop_);
+      }
+      if (watch_failures >= 3) {
+        // The watch cannot hold; events may have been missed while flapping.
+        // Treat like a 410: stop serving, then rebuild from a fresh LIST.
+        relist = true;
+      }
+    }
+    if (relist && !stop_.load()) {
+      // CRITICAL ORDER: unsync BEFORE the relist LIST goes out. Between
+      // the missed events and the fresh snapshot the store may describe
+      // deleted or replaced objects; a concurrent cycle must fall back to
+      // live GETs rather than actuate from that state (the no-stale-patch
+      // guarantee the tests pin).
+      synced_.store(false);
+    }
+  }
+}
+
+void Reflector::bump_watch_failure(const std::string& why) {
+  {
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    ++stats_.watch_failures;
+  }
+  log::counter_add("informer_watch_failures", 1);
+  log::warn("informer", "watch " + spec_.list_path + " failed: " + why);
+}
+
+// ── ClusterCache ──
+
+ClusterCache::ClusterCache(const k8s::Client& kube, std::vector<ResourceSpec> specs) {
+  reflectors_.reserve(specs.size());
+  for (ResourceSpec& spec : specs) {
+    reflectors_.push_back(std::make_unique<Reflector>(kube, std::move(spec)));
+  }
+}
+
+ClusterCache::~ClusterCache() { stop(); }
+
+void ClusterCache::start() {
+  for (auto& r : reflectors_) r->start();
+}
+
+void ClusterCache::stop() {
+  // Signal everyone first, then join: stops overlap instead of serializing
+  // nine 250ms-bounded poll exits.
+  for (auto& r : reflectors_) r->stop();
+}
+
+bool ClusterCache::all_synced() const {
+  for (const auto& r : reflectors_) {
+    if (!r->synced()) return false;
+  }
+  return !reflectors_.empty();
+}
+
+bool ClusterCache::pods_synced() const {
+  for (const auto& r : reflectors_) {
+    if (r->spec().plural == "pods") return r->synced();
+  }
+  return false;
+}
+
+bool ClusterCache::wait_synced(int timeout_ms) const {
+  int64_t deadline = util::mono_secs() * 1000 + timeout_ms;
+  while (!all_synced()) {
+    if (util::mono_secs() * 1000 >= deadline) return false;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  return true;
+}
+
+const Reflector* ClusterCache::route(const std::string& object_path) const {
+  for (const auto& r : reflectors_) {
+    const ResourceSpec& s = r->spec();
+    std::string ns_prefix = s.prefix + "namespaces/";
+    if (!util::starts_with(object_path, ns_prefix)) continue;
+    // Expect "<ns>/<plural>/<name>" past the prefix.
+    std::vector<std::string> parts =
+        util::split(object_path.substr(ns_prefix.size()), '/');
+    if (parts.size() == 3 && parts[1] == s.plural && !parts[2].empty()) return r.get();
+  }
+  return nullptr;
+}
+
+std::optional<Value> ClusterCache::get(const std::string& object_path) const {
+  const Reflector* r = route(object_path);
+  if (!r || !r->synced()) return std::nullopt;
+  return r->get(object_path);
+}
+
+Value ClusterCache::stats_json() const {
+  Value resources = Value::object();
+  bool synced = !reflectors_.empty();
+  uint64_t objects = 0;
+  for (const auto& r : reflectors_) {
+    ResourceStats s = r->stats();
+    synced = synced && s.synced;
+    objects += s.objects;
+    Value rs = Value::object();
+    rs.set("synced", Value(s.synced));
+    rs.set("objects", Value(static_cast<int64_t>(s.objects)));
+    rs.set("adds", Value(static_cast<int64_t>(s.adds)));
+    rs.set("updates", Value(static_cast<int64_t>(s.updates)));
+    rs.set("deletes", Value(static_cast<int64_t>(s.deletes)));
+    rs.set("bookmarks", Value(static_cast<int64_t>(s.bookmarks)));
+    rs.set("relists", Value(static_cast<int64_t>(s.relists)));
+    rs.set("watch_failures", Value(static_cast<int64_t>(s.watch_failures)));
+    rs.set("resource_version", Value(s.resource_version));
+    resources.set(r->spec().list_path, std::move(rs));
+  }
+  Value out = Value::object();
+  out.set("synced", Value(synced));
+  out.set("objects", Value(static_cast<int64_t>(objects)));
+  out.set("resources", std::move(resources));
+  return out;
+}
+
+}  // namespace tpupruner::informer

@@ -65,6 +65,7 @@ Client::Client(Config config)
 json::Value Client::request_json(const std::string& method, const std::string& path,
                                  const std::string& body, const std::string& content_type,
                                  int* status_out, bool retry_throttle) const {
+  api_calls_.fetch_add(1, std::memory_order_relaxed);
   http::Request req;
   req.method = method;
   req.url = config_.api_url + path;
@@ -236,6 +237,71 @@ json::Value Client::patch_merge(const std::string& path, const json::Value& body
 json::Value Client::post(const std::string& path, const json::Value& body,
                          bool retry_throttle) const {
   return request_json("POST", path, body.dump(), "application/json", nullptr, retry_throttle);
+}
+
+void Client::watch(const std::string& path, const WatchOptions& opts,
+                   const std::function<bool(const json::Value&)>& on_event) const {
+  api_calls_.fetch_add(1, std::memory_order_relaxed);
+  std::string query = "watch=true";
+  if (!opts.resource_version.empty())
+    query += "&resourceVersion=" + util::url_encode(opts.resource_version);
+  if (opts.bookmarks) query += "&allowWatchBookmarks=true";
+
+  http::Request req;
+  req.url = config_.api_url + path +
+            (path.find('?') == std::string::npos ? "?" : "&") + query;
+  req.timeout_ms = opts.read_timeout_ms;
+  req.headers.push_back({"Accept", "application/json"});
+  if (!config_.token.empty())
+    req.headers.push_back({"Authorization", "Bearer " + config_.token});
+
+  // Watch frames are newline-delimited JSON objects; transport chunks do
+  // not align with them, so carry the partial tail between deliveries.
+  // On a non-200 the body is the apiserver's Status object, not events —
+  // it accumulates verbatim for the ApiError message.
+  std::string pending;
+  int status = 0;
+  http::Response resp = http_.request_stream(
+      req,
+      [&](const char* data, size_t n) {
+        pending.append(data, n);
+        if (pending.size() > (64u << 20)) {
+          throw std::runtime_error("k8s: watch frame exceeds 64 MiB without newline");
+        }
+        if (status != 200) return pending.size() < 65536;  // error body, bounded
+        size_t start = 0;
+        while (true) {
+          size_t nl = pending.find('\n', start);
+          if (nl == std::string::npos) break;
+          std::string_view line(pending.data() + start, nl - start);
+          start = nl + 1;
+          if (util::trim(line).empty()) continue;
+          json::Value event;
+          try {
+            event = json::Value::parse(line);
+          } catch (const json::ParseError& e) {
+            throw std::runtime_error(std::string("k8s: unparseable watch event: ") + e.what());
+          }
+          if (!on_event(event)) {
+            pending.clear();
+            return false;
+          }
+        }
+        pending.erase(0, start);
+        return true;
+      },
+      opts.abort,
+      [&](const http::Response& r) { status = r.status; });
+  if (resp.status != 200) {
+    std::string message;
+    try {
+      message = json::Value::parse(pending).get_string("message", pending.substr(0, 256));
+    } catch (const std::exception&) {
+      message = pending.substr(0, 256);
+    }
+    throw ApiError(resp.status, "k8s: WATCH " + path + " → HTTP " +
+                                    std::to_string(resp.status) + ": " + message);
+  }
 }
 
 std::string Client::pod_path(const std::string& ns, const std::string& name) {

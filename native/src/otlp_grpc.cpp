@@ -654,6 +654,15 @@ CallResult unary_call(const std::string& host, int port, const std::string& path
                            (static_cast<uint32_t>(static_cast<uint8_t>(payload[o + 4])) << 8) |
                            static_cast<uint32_t>(static_cast<uint8_t>(payload[o + 5]));
               if (id == 0x4) {
+                // RFC 7540 §6.5.2: values above 2^31-1 are a
+                // FLOW_CONTROL_ERROR — reject rather than let a broken
+                // peer inflate the send window past what flow-control
+                // arithmetic (int64 deltas around int32 windows) assumes.
+                if (v > 0x7fffffffu) {
+                  throw std::runtime_error(
+                      "h2 SETTINGS_INITIAL_WINDOW_SIZE " + std::to_string(v) +
+                      " exceeds 2^31-1 (RFC 7540 FLOW_CONTROL_ERROR)");
+                }
                 stream_window += static_cast<int64_t>(v) - initial_stream_window;
                 initial_stream_window = static_cast<int64_t>(v);
               }

@@ -23,6 +23,7 @@ struct Api {
   int (*SSL_set_fd)(void*, int);
   int (*SSL_connect)(void*);
   int (*SSL_read)(void*, void*, int);
+  int (*SSL_pending)(const void*);
   int (*SSL_write)(void*, const void*, int);
   int (*SSL_shutdown)(void*);
   long (*SSL_ctrl)(void*, int, long, void*);
@@ -66,6 +67,7 @@ const Api& api() {
     load(out.SSL_set_fd, "SSL_set_fd", ssl);
     load(out.SSL_connect, "SSL_connect", ssl);
     load(out.SSL_read, "SSL_read", ssl);
+    load(out.SSL_pending, "SSL_pending", ssl);
     load(out.SSL_write, "SSL_write", ssl);
     load(out.SSL_shutdown, "SSL_shutdown", ssl);
     load(out.SSL_ctrl, "SSL_ctrl", ssl);
@@ -138,9 +140,18 @@ Conn::Conn(int fd, const std::string& sni_host, bool verify, const std::string& 
     std::string wire;
     wire.push_back(static_cast<char>(alpn.size()));
     wire += alpn;
-    // Returns 0 on success (unlike most SSL_* APIs).
-    a.SSL_set_alpn_protos(ssl_, reinterpret_cast<const unsigned char*>(wire.data()),
-                          static_cast<unsigned int>(wire.size()));
+    // Returns 0 on success (unlike most SSL_* APIs). A failure here means
+    // the handshake would proceed WITHOUT offering the protocol, and the
+    // post-handshake check below would then blame the server ("did not
+    // negotiate ALPN") for a client-side setup error — fail distinctly.
+    if (a.SSL_set_alpn_protos(ssl_, reinterpret_cast<const unsigned char*>(wire.data()),
+                              static_cast<unsigned int>(wire.size())) != 0) {
+      std::string err = last_error("failed to set ALPN protocol list \"" + alpn + "\"");
+      a.SSL_free(ssl_);
+      a.SSL_CTX_free(ctx_);
+      ssl_ = ctx_ = nullptr;
+      throw std::runtime_error(err);
+    }
   }
 
   int rc = a.SSL_connect(ssl_);
@@ -191,6 +202,11 @@ size_t Conn::read(char* buf, size_t n) {
   int err = a.SSL_get_error(ssl_, rc);
   if (err == kSslErrorZeroReturn) return 0;  // clean close_notify
   throw std::runtime_error(last_error("read failed"));
+}
+
+bool Conn::pending() const {
+  const Api& a = api();
+  return a.SSL_pending(ssl_) > 0;
 }
 
 void Conn::write_all(const char* buf, size_t n) {

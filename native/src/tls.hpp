@@ -30,6 +30,11 @@ class Conn {
 
   // Return >0 bytes, 0 on orderly close, throw on error.
   size_t read(char* buf, size_t n);
+  // Decrypted bytes already buffered in the session (SSL_pending) — a
+  // poll() on the raw fd can report "nothing to read" while a previous
+  // record still holds deliverable plaintext; streaming readers must
+  // check this before waiting on the socket.
+  bool pending() const;
   void write_all(const char* buf, size_t n);
 
  private:

@@ -21,8 +21,17 @@ std::string pod_ns(const Value& pod) {
 }
 
 std::optional<Value> cached_get_opt(const k8s::Client& client, FetchCache* cache,
+                                    const informer::ClusterCache* store,
                                     const std::string& path) {
-  auto do_fetch = [&]() -> FetchCache::Entry { return client.get_opt(path); };
+  // Read-through order: per-cycle single-flight cache → watch-backed store
+  // → live GET. The store only answers while synced, and its misses are
+  // never treated as 404s (the GET decides) — see walker.hpp.
+  auto do_fetch = [&]() -> FetchCache::Entry {
+    if (store) {
+      if (auto hit = store->get(path)) return hit;
+    }
+    return client.get_opt(path);
+  };
   if (cache) return cache->get_or_fetch(path, do_fetch);
   return do_fetch();
 }
@@ -30,10 +39,11 @@ std::optional<Value> cached_get_opt(const k8s::Client& client, FetchCache* cache
 // Mid-level fetch (ReplicaSet/StatefulSet/Job): failures are swallowed and
 // the ownerRef loop moves on (reference: `if let Ok(rs) = rs_api.get(...)`,
 // lib.rs:465, 485).
-std::optional<ScaleTarget> fetch(const k8s::Client& client, FetchCache* cache, Kind kind,
+std::optional<ScaleTarget> fetch(const k8s::Client& client, FetchCache* cache,
+                                 const informer::ClusterCache* store, Kind kind,
                                  const std::string& ns, const std::string& name) {
   try {
-    auto obj = cached_get_opt(client, cache, k8s::Client::object_path(kind, ns, name));
+    auto obj = cached_get_opt(client, cache, store, k8s::Client::object_path(kind, ns, name));
     if (!obj) return std::nullopt;
     return ScaleTarget{kind, std::move(*obj)};
   } catch (const std::exception& e) {
@@ -48,9 +58,10 @@ std::optional<ScaleTarget> fetch(const k8s::Client& client, FetchCache* cache, K
 // silently actuating the intermediate owner (reference `?` operator,
 // lib.rs:472, 492 — a transient apiserver error must not demote the target
 // from Deployment to ReplicaSet).
-ScaleTarget fetch_must(const k8s::Client& client, FetchCache* cache, Kind kind,
+ScaleTarget fetch_must(const k8s::Client& client, FetchCache* cache,
+                       const informer::ClusterCache* store, Kind kind,
                        const std::string& ns, const std::string& name) {
-  auto obj = cached_get_opt(client, cache, k8s::Client::object_path(kind, ns, name));
+  auto obj = cached_get_opt(client, cache, store, k8s::Client::object_path(kind, ns, name));
   if (!obj) {
     throw std::runtime_error(std::string(core::kind_name(kind)) + " " + ns + "/" + name +
                              " referenced by owner chain but not found");
@@ -251,7 +262,8 @@ size_t prefetch_owner_chains(const k8s::Client& client, FetchCache& cache,
   return lists;
 }
 
-ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchCache* cache) {
+ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchCache* cache,
+                             const informer::ClusterCache* store) {
   std::string ns = pod_ns(pod);
   std::string pod_name = pod.at_path("metadata.name") ? pod.at_path("metadata.name")->as_string()
                                                       : "<unnamed>";
@@ -261,7 +273,7 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
   if (const Value* labels = pod.at_path("metadata.labels"); labels && labels->is_object()) {
     const Value* ks = labels->find("serving.kserve.io/inferenceservice");
     if (ks && ks->is_string()) {
-      return fetch_must(client, cache, Kind::InferenceService, ns, ks->as_string());
+      return fetch_must(client, cache, store, Kind::InferenceService, ns, ks->as_string());
     }
     // LWS shortcut: EVERY pod of a LeaderWorkerSet (leader and worker)
     // carries this label, while the ownerRef chain differs by role (the
@@ -269,7 +281,7 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
     // LWS object) — the label is the only uniform path to the root.
     const Value* lws = labels->find("leaderworkerset.sigs.k8s.io/name");
     if (lws && lws->is_string()) {
-      return fetch_must(client, cache, Kind::LeaderWorkerSet, ns, lws->as_string());
+      return fetch_must(client, cache, store, Kind::LeaderWorkerSet, ns, lws->as_string());
     }
   }
 
@@ -280,21 +292,21 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
       std::string name = owner.get_string("name");
 
       if (kind == "ReplicaSet") {
-        if (auto rs = fetch(client, cache, Kind::ReplicaSet, ns, name)) {
+        if (auto rs = fetch(client, cache, store, Kind::ReplicaSet, ns, name)) {
           if (const Value* dep_or = owner_of_kind(rs->object, "Deployment")) {
-            return fetch_must(client, cache, Kind::Deployment, ns, dep_or->get_string("name"));
+            return fetch_must(client, cache, store, Kind::Deployment, ns, dep_or->get_string("name"));
           }
           return std::move(*rs);  // ReplicaSet with no Deployment owner
         }
       } else if (kind == "StatefulSet") {
-        if (auto ss = fetch(client, cache, Kind::StatefulSet, ns, name)) {
+        if (auto ss = fetch(client, cache, store, Kind::StatefulSet, ns, name)) {
           if (const Value* nb_or = owner_of_kind(ss->object, "Notebook")) {
-            return fetch_must(client, cache, Kind::Notebook, ns, nb_or->get_string("name"));
+            return fetch_must(client, cache, store, Kind::Notebook, ns, nb_or->get_string("name"));
           }
           // Multi-host serving groups: LWS creates one StatefulSet per
           // replica group; the LeaderWorkerSet is the scalable root.
           if (const Value* lws_or = owner_of_kind(ss->object, "LeaderWorkerSet")) {
-            return fetch_must(client, cache, Kind::LeaderWorkerSet, ns,
+            return fetch_must(client, cache, store, Kind::LeaderWorkerSet, ns,
                               lws_or->get_string("name"));
           }
           return std::move(*ss);  // StatefulSet with no CR owner
@@ -305,13 +317,13 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
         // suspending them mid-run is destructive, so fall through.
         std::optional<Value> job;
         try {
-          job = cached_get_opt(client, cache, k8s::Client::job_path(ns, name));
+          job = cached_get_opt(client, cache, store, k8s::Client::job_path(ns, name));
         } catch (const std::exception& e) {
           log::warn("walker", "fetch Job " + ns + "/" + name + " failed: " + e.what());
         }
         if (job) {
           if (const Value* js_or = owner_of_kind(*job, "JobSet")) {
-            return fetch_must(client, cache, Kind::JobSet, ns, js_or->get_string("name"));
+            return fetch_must(client, cache, store, Kind::JobSet, ns, js_or->get_string("name"));
           }
           log::debug("walker", "pod " + ns + "/" + pod_name + ": bare Job owner '" + name +
                      "' is not scalable, ignoring");

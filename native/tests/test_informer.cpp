@@ -1,0 +1,177 @@
+// Informer store + event application (native/include/tpupruner/informer.hpp).
+// The pure core the reflector thread drives: these tests pin the event
+// ordering, bookmark, and relist-replace semantics without a server (the
+// Python tier covers the live list+watch loop against the fake apiserver).
+// Concurrency (store reads under reflector writes) runs under TSan via
+// `just test-tsan`.
+#include "testing.hpp"
+
+#include <thread>
+#include <vector>
+
+#include "tpupruner/informer.hpp"
+
+using tpupruner::informer::ClusterCache;
+using tpupruner::informer::Reflector;
+using tpupruner::informer::ResourceSpec;
+using tpupruner::informer::Store;
+using tpupruner::informer::spec_for;
+using tpupruner::json::Value;
+namespace k8s = tpupruner::k8s;
+
+namespace {
+
+// A client that never talks: apply_* methods under test issue no requests.
+const k8s::Client& offline_client() {
+  static k8s::Client client = [] {
+    k8s::Config cfg;
+    cfg.api_url = "http://127.0.0.1:1";
+    return k8s::Client(std::move(cfg));
+  }();
+  return client;
+}
+
+Value pod_event(const char* type, const char* ns, const char* name, const char* rv,
+                const char* phase = "Running") {
+  return Value::parse(std::string(R"({"type":")") + type +
+                      R"(","object":{"apiVersion":"v1","kind":"Pod","metadata":{"namespace":")" +
+                      ns + R"(","name":")" + name + R"(","resourceVersion":")" + rv +
+                      R"("},"status":{"phase":")" + phase + R"("}}})");
+}
+
+}  // namespace
+
+TP_TEST(informer_store_replace_and_lookup) {
+  Store store;
+  std::map<std::string, Value> snapshot;
+  snapshot["/api/v1/namespaces/ml/pods/a"] = Value::parse(R"({"metadata":{"name":"a"}})");
+  snapshot["/api/v1/namespaces/ml/pods/b"] = Value::parse(R"({"metadata":{"name":"b"}})");
+  store.replace(std::move(snapshot));
+  TP_CHECK_EQ(store.size(), size_t{2});
+  TP_CHECK(store.get("/api/v1/namespaces/ml/pods/a").has_value());
+  TP_CHECK(!store.get("/api/v1/namespaces/ml/pods/zzz").has_value());
+  // replace is wholesale: objects deleted while the watch was down vanish
+  store.replace({});
+  TP_CHECK_EQ(store.size(), size_t{0});
+  TP_CHECK(!store.get("/api/v1/namespaces/ml/pods/a").has_value());
+}
+
+TP_TEST(informer_event_ordering_added_modified_deleted) {
+  Reflector r(offline_client(), *spec_for("pods"));
+  TP_CHECK(r.apply_event(pod_event("ADDED", "ml", "p", "5", "Pending")));
+  auto obj = r.get("/api/v1/namespaces/ml/pods/p");
+  TP_CHECK(obj.has_value());
+  TP_CHECK_EQ(obj->at_path("status.phase")->as_string(), std::string("Pending"));
+
+  // MODIFIED replaces the stored object (last write wins, server order)
+  TP_CHECK(r.apply_event(pod_event("MODIFIED", "ml", "p", "6", "Running")));
+  obj = r.get("/api/v1/namespaces/ml/pods/p");
+  TP_CHECK_EQ(obj->at_path("status.phase")->as_string(), std::string("Running"));
+
+  TP_CHECK(r.apply_event(pod_event("DELETED", "ml", "p", "7")));
+  TP_CHECK(!r.get("/api/v1/namespaces/ml/pods/p").has_value());
+
+  auto stats = r.stats();
+  TP_CHECK_EQ(stats.adds, uint64_t{1});
+  TP_CHECK_EQ(stats.updates, uint64_t{1});
+  TP_CHECK_EQ(stats.deletes, uint64_t{1});
+  TP_CHECK_EQ(stats.resource_version, std::string("7"));
+}
+
+TP_TEST(informer_bookmark_advances_rv_without_touching_objects) {
+  Reflector r(offline_client(), *spec_for("pods"));
+  TP_CHECK(r.apply_event(pod_event("ADDED", "ml", "p", "5")));
+  Value bookmark = Value::parse(
+      R"({"type":"BOOKMARK","object":{"kind":"Pod","metadata":{"resourceVersion":"42"}}})");
+  TP_CHECK(r.apply_event(bookmark));
+  auto stats = r.stats();
+  TP_CHECK_EQ(stats.bookmarks, uint64_t{1});
+  TP_CHECK_EQ(stats.resource_version, std::string("42"));
+  TP_CHECK_EQ(stats.objects, uint64_t{1});  // bookmark carries no object delta
+}
+
+TP_TEST(informer_error_event_demands_relist) {
+  Reflector r(offline_client(), *spec_for("pods"));
+  Value gone = Value::parse(
+      R"({"type":"ERROR","object":{"kind":"Status","code":410,"message":"too old"}})");
+  // false = the stream can't be trusted; the reflector loop relists
+  TP_CHECK(!r.apply_event(gone));
+}
+
+TP_TEST(informer_unknown_event_type_is_ignored) {
+  Reflector r(offline_client(), *spec_for("pods"));
+  Value odd = Value::parse(R"({"type":"WAT","object":{"metadata":{"name":"x"}}})");
+  TP_CHECK(r.apply_event(odd));  // no relist, no store change
+  TP_CHECK_EQ(r.stats().objects, uint64_t{0});
+}
+
+TP_TEST(informer_apply_list_adopts_snapshot_and_rv) {
+  Reflector r(offline_client(), *spec_for("pods"));
+  // pre-existing entry that the relist snapshot no longer contains
+  TP_CHECK(r.apply_event(pod_event("ADDED", "ml", "stale", "3")));
+  Value list = Value::parse(R"({
+    "kind": "List", "metadata": {"resourceVersion": "9"},
+    "items": [
+      {"metadata": {"namespace": "ml", "name": "fresh", "resourceVersion": "8"}},
+      {"metadata": {"namespace": "other", "name": "fresh2", "resourceVersion": "9"}}
+    ]})");
+  r.apply_list(list);
+  TP_CHECK(r.synced());
+  TP_CHECK(!r.get("/api/v1/namespaces/ml/pods/stale").has_value());
+  TP_CHECK(r.get("/api/v1/namespaces/ml/pods/fresh").has_value());
+  TP_CHECK(r.get("/api/v1/namespaces/other/pods/fresh2").has_value());
+  auto stats = r.stats();
+  TP_CHECK_EQ(stats.resource_version, std::string("9"));
+  TP_CHECK_EQ(stats.relists, uint64_t{1});
+}
+
+TP_TEST(informer_object_path_requires_full_metadata) {
+  Reflector pods(offline_client(), *spec_for("pods"));
+  Value no_ns = Value::parse(R"({"metadata":{"name":"x"}})");
+  TP_CHECK_EQ(pods.object_path_of(no_ns), std::string(""));
+  Reflector rs(offline_client(), *spec_for("replicasets"));
+  Value full = Value::parse(R"({"metadata":{"namespace":"ml","name":"rs1"}})");
+  TP_CHECK_EQ(rs.object_path_of(full),
+              std::string("/apis/apps/v1/namespaces/ml/replicasets/rs1"));
+}
+
+TP_TEST(informer_cluster_cache_routes_by_path_shape) {
+  ClusterCache cache(offline_client(),
+                     {*spec_for("pods"), *spec_for("replicasets"), *spec_for("jobsets")});
+  // nothing synced yet: every lookup says "ask the API server"
+  TP_CHECK(!cache.get("/api/v1/namespaces/ml/pods/p").has_value());
+  TP_CHECK(!cache.all_synced());
+  TP_CHECK(!cache.pods_synced());
+  // unwatched resources and unparseable paths also answer nullopt
+  TP_CHECK(!cache.get("/apis/kubeflow.org/v1/namespaces/ml/notebooks/n").has_value());
+  TP_CHECK(!cache.get("/not/an/object/path").has_value());
+}
+
+TP_TEST(informer_store_concurrent_readers_and_writer) {
+  // The daemon's shape: resolve fan-out reads while the reflector applies
+  // events. Run readers against a writer; TSan (just test-tsan) turns any
+  // unlocked access into a failure.
+  Store store;
+  std::atomic<bool> done{false};
+  std::thread writer([&] {
+    for (int i = 0; i < 500; ++i) {
+      std::string key = "/api/v1/namespaces/ml/pods/p" + std::to_string(i % 16);
+      store.upsert(key, Value::parse(R"({"metadata":{"name":"p"}})"));
+      if (i % 3 == 0) store.erase(key);
+    }
+    done.store(true);
+  });
+  std::vector<std::thread> readers;
+  for (int t = 0; t < 4; ++t) {
+    readers.emplace_back([&] {
+      while (!done.load()) {
+        for (int i = 0; i < 16; ++i) {
+          auto v = store.get("/api/v1/namespaces/ml/pods/p" + std::to_string(i));
+          if (v) TP_CHECK(v->at_path("metadata.name") != nullptr);
+        }
+      }
+    });
+  }
+  writer.join();
+  for (auto& t : readers) t.join();
+}

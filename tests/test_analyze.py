@@ -229,3 +229,24 @@ def test_stream_matches_batch_over_full_window(built, tmp_path):
             concat[ch["id"]]["tc"] += ch["tc"]
     batch_out, _ = run_analyze(tmp_path, {"chips": list(concat.values())})
     assert out["reclaimable_slices"] == batch_out["reclaimable_slices"]
+
+
+def test_stream_warns_on_positional_chip_ids(built, tmp_path):
+    """--stream with chips lacking explicit ids: ring-row identity is
+    positional, so the fleet-identity check can't catch producers that
+    reorder chips between cycles — the tool must say so (ADVICE r5)."""
+    doc = {"chips": [chip("ml/a", [0.0] * 4), chip("ml/a", [0.0] * 4)]}
+    _, err = run_analyze(tmp_path, doc, "--stream", str(tmp_path / "s.npz"),
+                         "--reset")
+    assert "positional identity" in err
+
+    # explicit ids: no warning
+    with_ids = {"chips": [dict(chip("ml/a", [0.0] * 4), id="c0"),
+                          dict(chip("ml/a", [0.0] * 4), id="c1")]}
+    _, err = run_analyze(tmp_path, with_ids, "--stream",
+                         str(tmp_path / "s2.npz"), "--reset")
+    assert "positional identity" not in err
+
+    # one-shot (batch) audits stay silent: order within one dump is fine
+    _, err = run_analyze(tmp_path, doc)
+    assert "positional identity" not in err

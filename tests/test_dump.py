@@ -145,3 +145,22 @@ def test_dump_streamed_cycles_feed_analyze_stream(built, tmp_path):
     # --lookback-s kept the age gate at the FULL policy lookback even
     # though each export covers one 180s cycle
     assert out["lookback_s"] == 2100.0
+
+
+def test_build_dump_tolerates_exported_accelerator_id(built):
+    """honor_labels scrapes prefix accelerator_id as exported_accelerator_id
+    like the other identity labels; chips of one pod must not collapse onto
+    accelerator '0' (duplicate ids, wrong hbm join) (ADVICE r5)."""
+    from tpu_pruner.dump import build_dump
+
+    def series(accel, vals):
+        return {"metric": {"exported_namespace": "ml", "exported_pod": "p",
+                           "exported_accelerator_id": accel},
+                "values": [[float(i), str(v)] for i, v in enumerate(vals)]}
+
+    tc = [series("0", [0.0] * 3), series("1", [0.0] * 3)]
+    hbm = [series("1", [0.5] * 3)]
+    doc = build_dump(tc, hbm, SLICE_LABEL, 7200.0, 2100.0)
+    by_id = {c["id"]: c for c in doc["chips"]}
+    assert set(by_id) == {"ml/p/0", "ml/p/1"}
+    assert "hbm" in by_id["ml/p/1"] and "hbm" not in by_id["ml/p/0"]

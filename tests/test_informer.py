@@ -1,0 +1,296 @@
+"""Informer (List+Watch cluster cache) tier: the C++ reflector/store driven
+in-process against the fake apiserver's watch surface, plus the daemon
+binary running with --watch-cache=on.
+
+Covers the contract ISSUE 1 pins:
+  - initial LIST sync and live ADDED/MODIFIED/DELETED convergence;
+  - 410 Gone → relist with the store marked unsynced until the fresh
+    snapshot lands (and NO stale-object patch after a relist);
+  - dropped watch connections → reconnect and resume;
+  - graceful daemon degradation to watch-free GETs when a resource's
+    watch loop cannot sync;
+  - steady-state cycles: warm-cycle K8s API calls scale with churn, not
+    cluster size, while the patched target set stays exactly right.
+"""
+
+import subprocess
+import time
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+def wait_for(predicate, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+DAEMON_ENV_BASE = {"KUBE_TOKEN": "t", "PROMETHEUS_TOKEN": "t",
+                   "PATH": "/usr/bin:/bin", "TPU_PRUNER_LOG": "debug"}
+
+
+def daemon_cmd(prom, *extra):
+    return [str(DAEMON_PATH), "--prometheus-url", prom.url,
+            "--run-mode", "scale-down", *extra]
+
+
+# ── in-process reflector/store against the fake watch surface ──────────────
+
+
+def test_informer_syncs_and_follows_events(built, fake_k8s):
+    fake_k8s.add_deployment_chain("ml", "trainer", num_pods=2)
+    fake_k8s.start()
+    with native.InformerSession(
+            fake_k8s.url, resources=["pods", "replicasets", "deployments"]) as s:
+        assert s.synced
+        pod_path = "/api/v1/namespaces/ml/pods/trainer-abc123-0"
+        assert s.get(pod_path)["metadata"]["name"] == "trainer-abc123-0"
+        assert s.get("/apis/apps/v1/namespaces/ml/deployments/trainer")
+
+        # live ADDED
+        fake_k8s.add_pod("ml", "newpod")
+        assert wait_for(lambda: s.get("/api/v1/namespaces/ml/pods/newpod"))
+        # live MODIFIED (reassignment emits the event)
+        pod = dict(fake_k8s.objects[pod_path])
+        pod["status"] = {"phase": "Succeeded"}
+        fake_k8s.objects[pod_path] = pod
+        assert wait_for(
+            lambda: s.get(pod_path)["status"]["phase"] == "Succeeded")
+        # live DELETED
+        del fake_k8s.objects[pod_path]
+        assert wait_for(lambda: s.get(pod_path) is None)
+
+        stats = s.stats()["resources"]["/api/v1/pods"]
+        assert stats["adds"] >= 1
+        assert stats["updates"] >= 1
+        assert stats["deletes"] >= 1
+
+
+def test_informer_receives_bookmarks_while_idle(built, fake_k8s):
+    fake_k8s.add_pod("ml", "p0")
+    fake_k8s.bookmark_interval_s = 0.1
+    fake_k8s.start()
+    with native.InformerSession(fake_k8s.url, resources=["pods"]) as s:
+        assert s.synced
+        assert wait_for(
+            lambda: s.stats()["resources"]["/api/v1/pods"]["bookmarks"] >= 2)
+
+
+def test_informer_survives_410_with_relist(built, fake_k8s):
+    fake_k8s.add_pod("ml", "p0")
+    fake_k8s.start()
+    with native.InformerSession(fake_k8s.url, resources=["pods"]) as s:
+        assert s.synced
+        relists0 = s.stats()["resources"]["/api/v1/pods"]["relists"]
+
+        # Mutate while the stream is compacted away: the relist (not the
+        # dead watch) must deliver the delta.
+        del fake_k8s.objects["/api/v1/namespaces/ml/pods/p0"]
+        fake_k8s.add_pod("ml", "p1")
+        fake_k8s.expire_watches()
+
+        assert wait_for(
+            lambda: s.stats()["resources"]["/api/v1/pods"]["relists"] > relists0)
+        assert wait_for(lambda: s.get("/api/v1/namespaces/ml/pods/p0") is None
+                        and s.get("/api/v1/namespaces/ml/pods/p1") is not None)
+        assert s.stats()["resources"]["/api/v1/pods"]["synced"]
+
+
+def test_informer_survives_dropped_watch_connections(built, fake_k8s):
+    fake_k8s.add_pod("ml", "p0")
+    fake_k8s.start()
+    with native.InformerSession(fake_k8s.url, resources=["pods"]) as s:
+        assert s.synced
+        fake_k8s.kill_watches()
+        # resumes from the last resourceVersion on a fresh connection and
+        # keeps following events — no relist required for a mere drop
+        fake_k8s.add_pod("ml", "afterdrop")
+        assert wait_for(
+            lambda: s.get("/api/v1/namespaces/ml/pods/afterdrop") is not None,
+            timeout=15)
+        assert s.stats()["resources"]["/api/v1/pods"]["watch_failures"] >= 1
+
+
+def test_informer_unsynced_resource_answers_nothing(built, fake_k8s):
+    # pods LIST permanently failing: the resource must never answer (the
+    # caller's GET fallback is the degradation path), while other
+    # resources sync normally.
+    fake_k8s.add_pod("ml", "p0")
+    fake_k8s.add_deployment("ml", "dep")
+    fake_k8s.fail_next("GET", "/api/v1/pods", code=500, times=-1)
+    fake_k8s.start()
+    s = native.InformerSession(fake_k8s.url,
+                               resources=["pods", "deployments"], wait_ms=700)
+    try:
+        assert not s.synced
+        assert s.get("/api/v1/namespaces/ml/pods/p0") is None
+        assert wait_for(
+            lambda: s.get("/apis/apps/v1/namespaces/ml/deployments/dep") is not None)
+        stats = s.stats()
+        assert not stats["resources"]["/api/v1/pods"]["synced"]
+        assert stats["resources"]["/apis/apps/v1/deployments"]["synced"]
+    finally:
+        s.stop()
+
+
+# ── daemon e2e with --watch-cache=on ───────────────────────────────────────
+
+
+def run_two_cycle_daemon(fake_k8s, fake_prom, between_cycles, check_interval=4,
+                         extra=()):
+    """Start the daemon for exactly two cycles, invoke `between_cycles`
+    once the first cycle's patches landed, and return (stderr, the request
+    index and time at injection). stderr goes to a temp file, not a pipe:
+    an undrained pipe would wedge a chatty daemon mid-cycle."""
+    import tempfile
+
+    with tempfile.TemporaryFile(mode="w+") as err:
+        proc = subprocess.Popen(
+            daemon_cmd(fake_prom, "--daemon-mode", "--check-interval",
+                       str(check_interval), "--max-cycles", "2",
+                       "--watch-cache", "on", *extra),
+            env={**DAEMON_ENV_BASE, "KUBE_API_URL": fake_k8s.url},
+            stdout=subprocess.DEVNULL, stderr=err, text=True)
+        try:
+            assert wait_for(lambda: len(fake_k8s.patches) > 0, timeout=30), \
+                "first cycle never patched"
+            time.sleep(0.3)  # let cycle-1 actuation drain
+            idx = len(fake_k8s.requests)
+            t_inject = time.monotonic()
+            between_cycles()
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise
+            err.seek(0)
+            stderr = err.read()
+            assert proc.returncode == 0, stderr
+            return stderr, idx, t_inject
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+def test_warm_cycle_api_calls_scale_with_churn(built, fake_k8s, fake_prom):
+    """The tentpole's headline contract in miniature: cycle 2 on an
+    unchanged-except-for-churn cluster costs O(changes) API calls, not
+    O(pods), and patches exactly the new target."""
+    _, jpods = fake_k8s.add_jobset_slice("ml", "slice-0", num_hosts=4)
+    for p in jpods:
+        fake_prom.add_idle_pod_series(p["metadata"]["name"], "ml", chips=4)
+    for i in range(6):
+        _, _, dpods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(dpods[0]["metadata"]["name"], "ml", chips=4)
+    fake_k8s.start()
+
+    def inject_churn():
+        _, _, pods = fake_k8s.add_deployment_chain("ml", "fresh")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml", chips=4)
+
+    stderr, idx, _ = run_two_cycle_daemon(fake_k8s, fake_prom, inject_churn)
+
+    patched = [p for p, _ in fake_k8s.patches]
+    # cold cycle got everything once; warm cycle added ONLY the new target
+    assert patched.count("/apis/jobset.x-k8s.io/v1alpha2/namespaces/ml/jobsets/slice-0") == 1
+    for i in range(6):
+        assert patched.count(f"/apis/apps/v1/namespaces/ml/deployments/dep-{i}/scale") == 1
+    assert patched.count("/apis/apps/v1/namespaces/ml/deployments/fresh/scale") == 1
+    assert "Already paused (no-op)" in stderr
+
+    # warm-cycle K8s API traffic: group-gate LIST + the new target's
+    # Event+PATCH (+ a watch reconnect at most) — NOT O(pods)
+    warm_calls = len(fake_k8s.requests) - idx
+    assert warm_calls <= 10, fake_k8s.requests[idx:]
+
+
+def test_no_stale_patch_after_relist(built, fake_k8s, fake_prom):
+    """Acceptance: after a 410-forced relist, the daemon never patches an
+    object deleted while the watch was dark (even though the metric plane
+    still reports its pod idle)."""
+    for name in ("keep", "gone"):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", name)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml", chips=4)
+    fake_k8s.start()
+
+    def delete_behind_watchs_back():
+        fake_k8s.kill_watches()
+        # deleted while no watch is connected...
+        del fake_k8s.objects["/apis/apps/v1/namespaces/ml/deployments/gone"]
+        del fake_k8s.objects["/apis/apps/v1/namespaces/ml/replicasets/gone-abc123"]
+        del fake_k8s.objects["/api/v1/namespaces/ml/pods/gone-abc123-0"]
+        # ...and compacted past: resuming watches 410 and must relist
+        fake_k8s.expire_watches()
+
+    cold_patches = len(fake_k8s.patches)
+    run_two_cycle_daemon(fake_k8s, fake_prom, delete_behind_watchs_back)
+
+    # No patch — landed or rejected — touched the deleted chain after the
+    # relist: the pod lookup fell back to a live GET, saw the 404, and
+    # skipped, exactly like the watch-free client would have.
+    warm = [p for p, _ in fake_k8s.patches][cold_patches + 2:]  # past cycle 1
+    assert all("gone" not in p for p in warm), warm
+    assert all("gone" not in p for p, _, _ in fake_k8s.rejected_patches), \
+        fake_k8s.rejected_patches
+
+
+def test_daemon_degrades_to_watch_free_when_pods_watch_cannot_sync(
+        built, fake_k8s, fake_prom):
+    """Graceful fallback: the pods reflector never syncs (cluster-scoped
+    pods LIST/WATCH 500s forever), yet --watch-cache=on must still patch
+    the right targets through the watch-free GET/LIST path."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml", chips=4)
+    fake_k8s.fail_next("GET", "/api/v1/pods", code=500, times=-1)
+    fake_k8s.start()
+
+    proc = subprocess.run(
+        daemon_cmd(fake_prom, "--watch-cache", "on"),
+        env={**DAEMON_ENV_BASE, "KUBE_API_URL": fake_k8s.url},
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "not fully synced" in proc.stderr
+    assert fake_k8s.scale_patches()[0][0] == \
+        "/apis/apps/v1/namespaces/ml/deployments/trainer/scale"
+
+
+def test_watch_cache_off_is_parity(built, fake_k8s, fake_prom):
+    """--watch-cache=off (and the default) keep the watch-free client:
+    no watch requests at all, and the re-patch-every-cycle behavior."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml", chips=4)
+    fake_k8s.start()
+
+    proc = subprocess.run(
+        daemon_cmd(fake_prom, "--daemon-mode", "--check-interval", "1",
+                   "--max-cycles", "2", "--watch-cache", "off"),
+        env={**DAEMON_ENV_BASE, "KUBE_API_URL": fake_k8s.url},
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert not any("watch=true" in p for _, p in fake_k8s.requests)
+    # both cycles re-patched (idempotent): the parity contract
+    patched = [p for p, _ in fake_k8s.scale_patches()]
+    assert patched.count("/apis/apps/v1/namespaces/ml/deployments/trainer/scale") == 2

@@ -33,6 +33,10 @@ CORE_RE = re.compile(r"^/api/v1/namespaces/[^/]+/([^/]+)(?:/([^/]+))?(?:/([^/]+)
 # /apis/{group}/{version}/namespaces/{ns}/{resource}[/{name}[/{sub}]]
 GROUP_RE = re.compile(
     r"^/apis/([^/]+)/[^/]+/namespaces/[^/]+/([^/]+)(?:/([^/]+))?(?:/([^/]+))?$")
+# cluster-scoped collections (the informer's all-namespace list+watch):
+# /api/v1/{resource} and /apis/{group}/{version}/{resource}
+CORE_CLUSTER_RE = re.compile(r"^/api/v1/([a-z]+)$")
+GROUP_CLUSTER_RE = re.compile(r"^/apis/([^/]+)/[^/]+/([a-z]+)$")
 
 METHOD_VERB = {"PATCH": "patch", "POST": "create", "PUT": "update",
                "DELETE": "delete"}
@@ -41,17 +45,25 @@ METHOD_VERB = {"PATCH": "patch", "POST": "create", "PUT": "update",
 def rbac_triple(method: str, raw_path: str):
     """Map one observed request to the (apiGroup, resource, verb) a real
     apiserver's authorizer would check."""
-    path = urlparse(raw_path).path
+    parsed = urlparse(raw_path)
+    path = parsed.path
     if m := CORE_RE.match(path):
         group, (resource, name, sub) = "", m.groups()
     elif m := GROUP_RE.match(path):
         group, resource, name, sub = m.groups()
+    elif m := CORE_CLUSTER_RE.match(path):
+        group, resource, name, sub = "", m.group(1), None, None
+    elif m := GROUP_CLUSTER_RE.match(path):
+        group, resource, name, sub = m.group(1), m.group(2), None, None
     else:
         raise AssertionError(f"unrecognized API path shape: {path}")
     if sub:
         resource = f"{resource}/{sub}"  # subresource, e.g. deployments/scale
     if method == "GET":
-        verb = "get" if name else "list"
+        if "watch=true" in parsed.query:
+            verb = "watch"
+        else:
+            verb = "get" if name else "list"
     else:
         verb = METHOD_VERB[method]
     return group, resource, verb
@@ -135,6 +147,13 @@ def observed_requests():
                  "--resolve-batch-threshold", threshold],
                 capture_output=True, text=True, timeout=60, env=env)
             assert proc.returncode == 0, proc.stderr
+        # informer pass: cluster-scoped LIST + WATCH on every watched kind
+        # (the `watch` verbs in the ClusterRole exist for this mode)
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "--prometheus-url", prom.url,
+             "--run-mode", "scale-down", "--watch-cache", "on"],
+            capture_output=True, text=True, timeout=60, env=env)
+        assert proc.returncode == 0, proc.stderr
         # leader election: lease create/get/patch + graceful release
         daemon = subprocess.Popen(
             [str(DAEMON_PATH), "--prometheus-url", prom.url,
@@ -177,7 +196,10 @@ def test_scenario_exercises_every_api_group(requests):
     BECAUSE the lease traffic is really in the observed set."""
     observed = {rbac_triple(m, p) for m, p in requests}
     must_observe = {
-        ("", "pods", "get"), ("", "pods", "list"), ("", "events", "create"),
+        ("", "pods", "get"), ("", "pods", "list"), ("", "pods", "watch"),
+        ("", "events", "create"),
+        ("apps", "deployments", "watch"), ("batch", "jobs", "watch"),
+        ("jobset.x-k8s.io", "jobsets", "watch"),
         ("apps", "deployments", "get"), ("apps", "deployments/scale", "patch"),
         ("apps", "replicasets/scale", "patch"),
         ("apps", "statefulsets/scale", "patch"),

@@ -67,7 +67,10 @@ def load_fleet(doc: dict):
     slice_id = np.zeros(num_chips, dtype=np.int32)
 
     chip_ids = []
+    default_ids = 0  # chips relying on positional identity (no "id" key)
     for i, c in enumerate(chips):
+        if "id" not in c:
+            default_ids += 1
         samples = np.asarray(c["tc"], dtype=np.float32)
         n = len(samples)
         if n:
@@ -89,8 +92,8 @@ def load_fleet(doc: dict):
     # check then tolerates producers that emit chips in varying order.
     ids = np.asarray(chip_ids)
     order = np.lexsort((ids, slice_id))
-    return (tc[order], hbm[order], valid[order], age[order],
-            slice_id[order]), slice_names, ids[order]
+    return ((tc[order], hbm[order], valid[order], age[order],
+             slice_id[order]), slice_names, ids[order], default_ids)
 
 
 def _run_stream(args, doc, fleet, slice_names, chip_ids, params, parr) -> int:
@@ -250,8 +253,20 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     doc = json.load(sys.stdin if args.dump == "-" else open(args.dump))
-    fleet, slice_names, chip_ids = load_fleet(doc)
+    fleet, slice_names, chip_ids, default_ids = load_fleet(doc)
     tc, hbm, valid, age, slice_id = fleet
+    if args.stream and default_ids:
+        # Positional default ids make the --stream fleet-identity check
+        # vacuous: a producer emitting chips in a different order next
+        # cycle passes the check while ring rows silently swap physical
+        # chips. Warn loudly (not fatal: a strictly order-stable producer
+        # is still correct, and one-shot-style audits shouldn't break).
+        print(f"WARNING: {default_ids}/{len(chip_ids)} chips have no explicit "
+              "'id' and fall back to positional identity; --stream cannot "
+              "detect producers that reorder chips between cycles — ring "
+              "rows would silently swap physical chips. Give chips stable "
+              "ids (dump.py emits namespace/pod/accelerator).",
+              file=sys.stderr)
 
     from tpu_pruner.policy import PolicyParams
     from tpu_pruner.policy.engine import params_array

@@ -69,9 +69,13 @@ def build_dump(tc_result, hbm_result, slice_label: str, pod_age_s: float,
     exports, so successive dumps feed `analyze --stream` directly.
     """
     def key(metric):
+        # accelerator_id needs the same exported_ tolerance as the identity
+        # labels: under an honor_labels scrape it arrives as
+        # exported_accelerator_id, and a plain .get would collapse every
+        # chip of a pod onto accelerator '0' (duplicate ids, wrong hbm join)
         return (_label(metric, "namespace") or "",
                 _label(metric, "pod") or "",
-                metric.get("accelerator_id") or "0")
+                _label(metric, "accelerator_id") or "0")
 
     hbm_by_key = {}
     for series in hbm_result or []:

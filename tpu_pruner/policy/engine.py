@@ -29,6 +29,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:  # jax >= 0.5 exports shard_map at top level; 0.4.x keeps it experimental
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 @dataclass(frozen=True)
 class PolicyParams:
@@ -304,7 +309,7 @@ def _make_sharded_impl(mesh: Mesh, num_slices: int, axis: str, quantized: bool):
         return (busy == 0) & (chips > 0), candidate
 
     num_inputs = 5 if quantized else 6
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         local_eval,
         mesh=mesh,
         in_specs=tuple([P(axis)] * (num_inputs - 1) + [P()]),
@@ -448,7 +453,7 @@ def make_sharded_evaluator_qc(mesh: Mesh, num_slices: int, axis: str = "fleet"):
         return (busy == 0) & (chips > 0), candidate
 
     del num_slices  # shape carried by local_bounds; kept in the cache key
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
@@ -467,7 +472,7 @@ def make_sharded_evaluator_qu(mesh: Mesh, chips_per_slice: int, axis: str = "fle
         )
         return candidate.reshape(-1, chips_per_slice).all(axis=1), candidate
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P()),
@@ -585,7 +590,7 @@ def make_sharded_stream_step(mesh: Mesh, chips_per_slice: int, axis: str = "flee
         verdicts = candidate.reshape(-1, chips_per_slice).all(axis=1)
         return tc_ring, hbm_ring, verdicts
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P(axis), P(axis), P(axis), P()),

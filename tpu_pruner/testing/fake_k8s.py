@@ -2,7 +2,21 @@
 
 Generic path-keyed object store with real RFC 7386 merge-patch semantics,
 labelSelector pod LISTs, the /scale subresource, and an Events sink — the
-exact surface the pruner's watch-free client uses (GET/LIST/PATCH/POST).
+exact surface the pruner's watch-free client uses (GET/LIST/PATCH/POST) —
+plus the watch surface its informer mode uses: every store write stamps a
+global monotonic resourceVersion and lands in a watch log; `?watch=true`
+GETs (namespaced or cluster-scoped collections) hold a chunked streaming
+connection delivering newline-delimited ADDED/MODIFIED/DELETED events past
+the client's resourceVersion, BOOKMARK events while idle
+(allowWatchBookmarks), HTTP 410 Gone for versions older than the
+compaction floor (`expire_watches()`), and injectable connection drops
+(`kill_watches()`).
+
+Watch caveats: assigning `fake.objects[path] = obj` emits the event —
+mutating an already-stored dict in place does NOT (reassign to emit
+MODIFIED). In multi-process mode (`start(workers=N)`) each forked worker
+has its own store snapshot, so watch events do not propagate across
+workers — exercise watches with the default single-process server.
 
 Scenario helpers build the reference's ownership chains (Pod→RS→Deployment,
 Pod→SS→Notebook, kserve-labelled pods) plus the TPU-native one
@@ -11,6 +25,7 @@ Pod→SS→Notebook, kserve-labelled pods) plus the TPU-native one
 
 from __future__ import annotations
 
+import copy
 import json
 import re
 import threading
@@ -38,9 +53,10 @@ def _mp_worker_main(fake: "FakeK8s", sock, conn) -> None:
     # drop them so this process serves its OWN state (plain-attribute mode).
     fake._mp_conns = []
     fake._mp_procs = []
-    # Fresh lock: the parent's may have been held mid-fork in a scenario
+    # Fresh locks: the parent's may have been held mid-fork in a scenario
     # helper thread, which would deadlock every request here.
     fake._lock = threading.Lock()
+    fake._watch_cond = threading.Condition()
     server = ThreadingHTTPServer(sock.getsockname(), fake._make_handler(),
                                  bind_and_activate=False)
     server.socket.close()  # replace the unused socket with the shared one
@@ -211,6 +227,54 @@ def validate_patch(path: str, body) -> None:
             _non_negative_int(spec["replicas"], "LeaderWorkerSet.spec.replicas")
 
 
+class _ObjectStore(dict):
+    """Path-keyed object dict that journals writes for the watch surface.
+
+    Every insert/replace/delete stamps the object with the next global
+    resourceVersion and appends an ADDED/MODIFIED/DELETED event (deep-copy
+    snapshot) to the fake's watch log under `_watch_cond`, waking any
+    streaming watch handlers. Never takes the fake's request `_lock` —
+    handlers call in while already holding it.
+    """
+
+    def __init__(self, fake: "FakeK8s"):
+        super().__init__()
+        self._fake = fake
+
+    def __setitem__(self, path: str, obj: dict) -> None:
+        fake = self._fake
+        event_type = "MODIFIED" if path in self else "ADDED"
+        with fake._watch_cond:
+            fake._rv += 1
+            obj.setdefault("metadata", {})["resourceVersion"] = str(fake._rv)
+            super().__setitem__(path, obj)
+            fake._watch_log.append(
+                {"rv": fake._rv, "type": event_type, "path": path,
+                 "object": copy.deepcopy(obj)})
+            fake._watch_cond.notify_all()
+
+    def __delitem__(self, path: str) -> None:
+        fake = self._fake
+        with fake._watch_cond:
+            obj = super().pop(path)
+            fake._rv += 1
+            snapshot = copy.deepcopy(obj)
+            snapshot.setdefault("metadata", {})["resourceVersion"] = str(fake._rv)
+            fake._watch_log.append(
+                {"rv": fake._rv, "type": "DELETED", "path": path,
+                 "object": snapshot})
+            fake._watch_cond.notify_all()
+
+    def pop(self, path, *default):
+        if path not in self:
+            if default:
+                return default[0]
+            raise KeyError(path)
+        obj = self[path]
+        del self[path]
+        return obj
+
+
 def rfc3339(dt: datetime) -> str:
     return dt.astimezone(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
@@ -222,8 +286,17 @@ def age(seconds: int) -> str:
 
 class FakeK8s:
     def __init__(self):
+        # ── watch surface state (before `objects`: the store journals
+        # into these) ──
+        self._rv = 0                 # global monotonic resourceVersion
+        self._watch_log: list[dict] = []   # {rv, type, path, object}
+        self._watch_floor = 0        # rv below which watches 410 (compaction)
+        self._watch_generation = 0   # bumped by kill_watches(): drop streams
+        self._watch_stop = False     # set by stop(): end all streams
+        self._watch_cond = threading.Condition()
+        self.bookmark_interval_s = 0.5  # idle-stream BOOKMARK cadence
         # path (e.g. "/api/v1/namespaces/ns/pods/p") → object dict
-        self.objects: dict[str, dict] = {}
+        self.objects: dict[str, dict] = _ObjectStore(self)
         # Recording state lives in underscored attributes; the public names
         # are properties so that in multi-process mode (start(workers=N))
         # the parent transparently serves the MERGED view across workers
@@ -517,6 +590,26 @@ class FakeK8s:
                 return rule[0], (rule[2] if len(rule) > 2 else None)
         return None
 
+    def kill_watches(self):
+        """Abruptly drop every active watch stream (mid-stream connection
+        loss). New watch requests are served normally — the client's
+        reconnect-and-resume path is what this exercises."""
+        with self._watch_cond:
+            self._watch_generation += 1
+            self._watch_cond.notify_all()
+
+    def expire_watches(self):
+        """Simulate apiserver history compaction: any watch resuming from
+        a resourceVersion older than *now* gets HTTP 410 Gone and must
+        relist; active streams are dropped. The floor is set to a fresh
+        version (not current+1) so the relist's LIST version is always
+        acceptable — clients can recover, exactly once through a relist."""
+        with self._watch_cond:
+            self._rv += 1  # synthetic compaction marker: floor > all prior rvs
+            self._watch_floor = self._rv
+            self._watch_generation += 1
+            self._watch_cond.notify_all()
+
     def scale_patches(self):
         return [(p, b) for p, b in self.patches if p.endswith("/scale")]
 
@@ -570,16 +663,38 @@ class FakeK8s:
                     return
                 super().handle_one_request()
 
-            # namespaced collection resources the real API server LISTs
-            # (a GET of /…/namespaces/<ns>/<plural> with no trailing name)
+            # collection resources the real API server LISTs/WATCHes —
+            # namespaced (/…/namespaces/<ns>/<plural>) and cluster-scoped
+            # (/api/v1/<plural>, /apis/<group>/<version>/<plural>; the
+            # informer's all-namespace list+watch shape)
             COLLECTIONS = {
                 "pods", "replicasets", "deployments", "statefulsets", "jobs",
                 "jobsets", "leaderworkersets", "notebooks", "inferenceservices",
             }
 
+            def _collection_object_re(self, path):
+                """Regex matching object paths of the collection at `path`
+                (namespaced or cluster-scoped), or None when `path` is not
+                a collection."""
+                if path.rsplit("/", 1)[-1] not in self.COLLECTIONS:
+                    return None
+                if "/namespaces/" in path:
+                    return re.compile(re.escape(path) + r"/[^/]+$")
+                if m := re.fullmatch(r"/api/v1/([a-z]+)", path):
+                    return re.compile(r"/api/v1/namespaces/[^/]+/%s/[^/]+$" % m.group(1))
+                if m := re.fullmatch(r"/apis/([^/]+)/([^/]+)/([a-z]+)", path):
+                    return re.compile(r"/apis/%s/%s/namespaces/[^/]+/%s/[^/]+$"
+                                      % (re.escape(m.group(1)), re.escape(m.group(2)),
+                                         m.group(3)))
+                return None
+
             def do_GET(self):
                 parsed = urlparse(self.path)
                 path = parsed.path
+                query = parse_qs(parsed.query)
+                if query.get("watch", ["false"])[0] == "true":
+                    self._do_watch(path, query)
+                    return
                 with fake._lock:
                     fake.requests.append(("GET", self.path))
                     if (inj := fake._injected_failure("GET", path)) is not None:
@@ -589,37 +704,117 @@ class FakeK8s:
                                       retry_after=retry_after)
                         return
                     # collection LIST (optional labelSelector), incl. empty lists
-                    if path.rsplit("/", 1)[-1] in self.COLLECTIONS and "/namespaces/" in path:
-                        selector = parse_qs(parsed.query).get("labelSelector", [""])[0]
+                    if (rx := self._collection_object_re(path)) is not None:
+                        selector = query.get("labelSelector", [""])[0]
                         reqs = parse_label_selector(selector)
-                        prefix = path + "/"
                         items = [
                             obj for p, obj in fake.objects.items()
-                            if p.startswith(prefix) and "/" not in p[len(prefix):]
+                            if rx.fullmatch(p)
                             and all(
                                 obj["metadata"].get("labels", {}).get(k) in vals
                                 for k, vals in reqs
                             )
                         ]
+                        # a real LIST carries the store's resourceVersion —
+                        # the version a subsequent watch resumes from
+                        meta = {"resourceVersion": str(fake._rv)}
                         page = fake.paginate_lists
                         if page > 0:
-                            start = int(parse_qs(parsed.query).get(
-                                "continue", ["0"])[0] or "0")
+                            start = int(query.get("continue", ["0"])[0] or "0")
                             chunk = items[start:start + page]
-                            meta = {}
                             if start + page < len(items):
                                 meta["continue"] = str(start + page)
                             self._respond(200, {"kind": "List", "apiVersion": "v1",
                                                 "metadata": meta, "items": chunk})
                             return
                         self._respond(200, {"kind": "List", "apiVersion": "v1",
-                                            "items": items})
+                                            "metadata": meta, "items": items})
                         return
                     obj = fake.objects.get(path)
                 if obj is None:
                     self._not_found()
                     return
                 self._respond(200, obj)
+
+            def _do_watch(self, path, query):
+                """Streaming `?watch=true` on a collection: chunked
+                newline-delimited events past the client's resourceVersion,
+                BOOKMARKs while idle, 410 below the compaction floor,
+                abrupt drop on kill_watches()/stop()."""
+                with fake._lock:
+                    fake.requests.append(("GET", self.path))
+                    inj = fake._injected_failure("GET", path)
+                if inj is not None:
+                    code, retry_after = inj
+                    self._respond(code, {"kind": "Status", "status": "Failure",
+                                         "message": "injected failure (test)"},
+                                  retry_after=retry_after)
+                    return
+                rx = self._collection_object_re(path)
+                if rx is None:
+                    self._not_found()
+                    return
+                try:
+                    cursor = int(query.get("resourceVersion", ["0"])[0] or "0")
+                except ValueError:
+                    cursor = 0
+                bookmarks = query.get("allowWatchBookmarks", ["false"])[0] == "true"
+                with fake._watch_cond:
+                    expired = cursor < fake._watch_floor
+                    gen = fake._watch_generation
+                    # log is append-only with increasing rv: start past the
+                    # client's version, then advance an index (no rescans)
+                    idx = 0
+                    while idx < len(fake._watch_log) and fake._watch_log[idx]["rv"] <= cursor:
+                        idx += 1
+                if expired:
+                    self._respond(410, {"kind": "Status", "status": "Failure",
+                                        "reason": "Expired", "code": 410,
+                                        "message": f"too old resource version: {cursor}"})
+                    self.close_connection = True
+                    return
+
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def write_event(payload):
+                    data = (json.dumps(payload) + "\n").encode()
+                    self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    while True:
+                        batch, bookmark_rv, dropped = [], None, False
+                        with fake._watch_cond:
+                            for _scan in range(2):  # events now, or after one wait
+                                if fake._watch_stop or fake._watch_generation != gen:
+                                    dropped = True
+                                    break
+                                while idx < len(fake._watch_log):
+                                    ev = fake._watch_log[idx]
+                                    idx += 1
+                                    if rx.fullmatch(ev["path"]):
+                                        batch.append(ev)
+                                if batch or _scan == 1:
+                                    break
+                                fake._watch_cond.wait(timeout=fake.bookmark_interval_s)
+                            if not dropped and not batch:
+                                bookmark_rv = fake._rv
+                        if dropped:
+                            # abrupt close (no terminating chunk): clients
+                            # observe a dropped connection, as intended
+                            self.close_connection = True
+                            return
+                        for ev in batch:
+                            write_event({"type": ev["type"], "object": ev["object"]})
+                        if bookmark_rv is not None and bookmarks:
+                            write_event({"type": "BOOKMARK", "object": {
+                                "kind": "Bookmark",
+                                "metadata": {"resourceVersion": str(bookmark_rv)}}})
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    self.close_connection = True
 
             def do_PATCH(self):
                 length = int(self.headers.get("Content-Length", "0"))
@@ -665,8 +860,8 @@ class FakeK8s:
                     fake.patches.append((path, body))
                     fake.patch_times.append(time.monotonic())
                     merged = merge_patch(obj, body)
-                    merged.setdefault("metadata", {})["resourceVersion"] = str(
-                        int(have_rv or "0") + 1)
+                    # the store stamps the next global resourceVersion and
+                    # journals the MODIFIED watch event
                     fake.objects[target_path] = merged
                     self._respond(200, merged)
 
@@ -772,6 +967,9 @@ class FakeK8s:
         return f"http://127.0.0.1:{self._server.server_address[1]}"
 
     def stop(self) -> None:
+        with self._watch_cond:  # end streaming watch handlers first
+            self._watch_stop = True
+            self._watch_cond.notify_all()
         if self._server:
             self._server.shutdown()
             self._server.server_close()

@@ -53,6 +53,7 @@ class FakePrometheus:
         self.series: list[dict] = []
         self.queries: list[str] = []
         self.query_paths: list[str] = []  # full request paths (Cloud Monitoring prefix checks)
+        self.query_times: list[float] = []  # time.monotonic() per query (cycle windowing)
         self.auth_headers: list[str | None] = []
         self.fail_requests_remaining = 0
         self.fail_status = 500
@@ -270,12 +271,14 @@ class FakePrometheus:
                 query = parse_qs(body).get("query", [""])[0]
                 if parsed.path.endswith("/api/v1/query_range"):
                     fake.query_paths.append(parsed.path)
+                    fake.query_times.append(time.monotonic())
                     self._handle_query_range(query)
                     return
                 if not parsed.path.endswith("/api/v1/query"):
                     self._respond(404, {"status": "error", "error": "not found"})
                     return
                 fake.query_paths.append(parsed.path)
+                fake.query_times.append(time.monotonic())
                 self._handle_query(query)
 
             def do_GET(self):
@@ -283,12 +286,14 @@ class FakePrometheus:
                 query = parse_qs(parsed.query).get("query", [""])[0]
                 if parsed.path.endswith("/api/v1/query_range"):
                     fake.query_paths.append(parsed.path)
+                    fake.query_times.append(time.monotonic())
                     self._handle_query_range(query)
                     return
                 if not parsed.path.endswith("/api/v1/query"):
                     self._respond(404, {"status": "error", "error": "not found"})
                     return
                 fake.query_paths.append(parsed.path)
+                fake.query_times.append(time.monotonic())
                 self._handle_query(query)
 
         # default backlog of 5 drops SYNs under concurrent load
