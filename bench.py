@@ -358,6 +358,46 @@ CHURN_DEPLOYMENTS = max(2, 64 // _S)  # new idle targets injected mid-run
 WATCH_CHECK_INTERVAL_S = 8 if SMOKE else 20  # > cold-cycle wall, < patience
 
 
+def _phase_percentiles(metrics_body: str) -> dict:
+    """p50/p95 per pipeline phase (ms) from the daemon's own
+    tpu_pruner_cycle_phase_seconds exposition — Prometheus-style linear
+    interpolation over the cumulative buckets. The daemon measures its
+    phases itself; the bench just reads them back, so these numbers are
+    exactly what an operator's histogram_quantile() would show."""
+    import re
+
+    series: dict = {}
+    for m in re.finditer(
+            r'tpu_pruner_cycle_phase_seconds_bucket\{phase="(\w+)",le="([^"]+)"\} (\d+)',
+            metrics_body):
+        series.setdefault(m.group(1), []).append(
+            (float("inf") if m.group(2) == "+Inf" else float(m.group(2)),
+             int(m.group(3))))
+
+    def quantile(buckets, q):
+        total = buckets[-1][1]
+        if total == 0:
+            return None
+        rank = q * total
+        prev_b, prev_c = 0.0, 0
+        for b, c in buckets:
+            if c >= rank:
+                if b == float("inf") or c == prev_c:
+                    return prev_b
+                return prev_b + (b - prev_b) * (rank - prev_c) / (c - prev_c)
+            prev_b, prev_c = b, c
+        return prev_b
+
+    p50, p95 = {}, {}
+    for phase, buckets in series.items():
+        buckets.sort(key=lambda bc: bc[0])
+        for name, q, out in (("p50", 0.5, p50), ("p95", 0.95, p95)):
+            v = quantile(buckets, q)
+            if v is not None:
+                out[phase] = round(v * 1000, 3)
+    return {"cycle_phase_p50_ms": p50, "cycle_phase_p95_ms": p95}
+
+
 def run_watch_cache_steady_state():
     """Tentpole measurement (ISSUE 1): informer-backed steady state.
 
@@ -386,6 +426,7 @@ def run_watch_cache_steady_state():
                "--run-mode", "scale-down",
                "--daemon-mode", "--check-interval", str(WATCH_CHECK_INTERVAL_S),
                "--max-cycles", "2", "--watch-cache", "on",
+               "--metrics-port", "auto",
                "--resolve-concurrency", "64", "--scale-concurrency", "32"]
         env = {"KUBE_API_URL": k8s.url, "KUBE_TOKEN": "bench",
                "PROMETHEUS_TOKEN": "bench", "PATH": "/usr/bin:/bin"}
@@ -393,16 +434,45 @@ def run_watch_cache_steady_state():
                                 stderr=subprocess.PIPE, text=True)
         # Drain stderr continuously: the daemon logs per-pod lines, and an
         # undrained 64 KiB pipe would wedge it mid-cycle at fleet scale.
+        import re as _re
         import threading
+        import urllib.request
         stderr_tail: list = []
+        metrics_port: list = []
 
         def _drain():
             for line in proc.stderr:
+                if not metrics_port:
+                    m = _re.search(r"serving /metrics on port (\d+)", line)
+                    if m:
+                        metrics_port.append(int(m.group(1)))
                 stderr_tail.append(line)
                 del stderr_tail[:-50]
 
         drainer = threading.Thread(target=_drain, daemon=True)
         drainer.start()
+
+        # Keep the freshest /metrics body (phase-latency histograms): the
+        # daemon exits right after cycle 2, so poll while it lives and use
+        # whatever the last successful scrape saw (2-cycle data when the
+        # scrape wins the race, cold-cycle data at minimum).
+        metrics_last: list = []
+
+        def _scrape():
+            while proc.poll() is None:
+                if metrics_port:
+                    try:
+                        body = urllib.request.urlopen(
+                            f"http://127.0.0.1:{metrics_port[0]}/metrics",
+                            timeout=2).read().decode()
+                        if "cycle_phase_seconds" in body:
+                            metrics_last[:] = [body]
+                    except OSError:
+                        pass
+                time.sleep(0.3)
+
+        scraper = threading.Thread(target=_scrape, daemon=True)
+        scraper.start()
         try:
             deadline = time.monotonic() + 300
             # cold quiesce: every reclaimable target patched once
@@ -463,7 +533,10 @@ def run_watch_cache_steady_state():
         t_detect = prom.query_times[warm_query_idx]
         lat = sorted(t - t_detect for t in k8s.patch_times[cold_patches:])
         warm_p50 = statistics.median(lat)
+        phases = _phase_percentiles(metrics_last[0]) if metrics_last else {
+            "cycle_phase_p50_ms": {}, "cycle_phase_p95_ms": {}}
         return {
+            **phases,
             "cold_api_calls": cold_api_calls,
             "steady_state_api_calls": steady_calls,
             "steady_to_cold_call_ratio": round(ratio, 4),
@@ -1394,6 +1467,10 @@ def main():
         "steady_state_api_calls": watch_cache["steady_state_api_calls"],
         "warm_p50_detect_to_scaledown_s": watch_cache[
             "warm_p50_detect_to_scaledown_s"],
+        # the daemon's OWN phase-latency histograms, read off /metrics
+        # during the watch-cache section (query/decode/resolve/actuate/total)
+        "cycle_phase_p50_ms": watch_cache["cycle_phase_p50_ms"],
+        "cycle_phase_p95_ms": watch_cache["cycle_phase_p95_ms"],
         "spread_max": (round(max(RUN_SPREADS.values()), 3)
                        if RUN_SPREADS else None),
         "detail_file": detail_path.name,

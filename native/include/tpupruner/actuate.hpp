@@ -35,6 +35,9 @@ struct ScaleOptions {
   // the watch-backed store (or a live GET); the watch-free mode keeps the
   // re-patch-every-cycle behavior (idempotent, and the parity contract).
   bool skip_if_already_paused = false;
+  // Exemplar trace id for the per-actuation latency histogram
+  // (tpu_pruner_scale_patch_seconds) — the consumer's `scale` span.
+  std::string trace_id;
 };
 
 // True when the target object already carries its kind's paused state:

@@ -1,0 +1,117 @@
+// Decision audit trail: one DecisionRecord per candidate pod per cycle.
+//
+// The daemon's only audit surface until now was a K8s Event per actuation
+// plus counters — "why was pod X paused at 14:02, and why was pod Y NOT?"
+// had no queryable answer. Every pipeline gate now lands a DecisionRecord
+// carrying the observed signal, the lookback window, the resolved owner
+// chain, the verdict and a stable machine-readable reason code. Records
+// live in a bounded in-process ring buffer served as JSON at
+// /debug/decisions (metrics port) and are appended as JSONL to the
+// optional --audit-log file; `python -m tpu_pruner.analyze --explain
+// <ns>/<pod>` consumes either. Deliberate non-actuations are first-class:
+// a pod that was NOT touched gets a record saying exactly which gate
+// stopped it.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::audit {
+
+// Stable machine-readable reason codes. Every code here must be documented
+// in docs/OPERATIONS.md — tests/test_docs_drift.py fails on undocumented
+// codes, so the list can only grow together with its runbook entry.
+enum class Reason : uint8_t {
+  Scaled,               // SCALED: pause patch landed
+  DryRun,               // DRY_RUN: would have paused (run-mode dry-run)
+  AlreadyPaused,        // ALREADY_PAUSED: root already at paused state (no-op)
+  ScaleFailed,          // SCALE_FAILED: actuation threw (see detail)
+  KindDisabled,         // KIND_DISABLED: root kind not in --enabled-resources
+  NoScalableOwner,      // NO_SCALABLE_OWNER: owner walk found no scalable root
+  PodGone,              // POD_GONE: in the metric plane, 404 in the cluster
+  WatchCacheMiss,       // WATCH_CACHE_MISS: absent from the synced watch
+                        // store AND from the live GET fallback
+  FetchError,           // FETCH_ERROR: pod GET failed (namespace vetoed)
+  PendingPod,           // PENDING_POD: pod phase is still Pending
+  NoCreationTimestamp,  // NO_CREATION_TIMESTAMP
+  BadCreationTimestamp, // BAD_CREATION_TIMESTAMP
+  BelowMinAge,          // BELOW_MIN_AGE: created within the lookback window
+  OptedOut,             // OPTED_OUT: pod carries tpu-pruner.dev/skip=true
+  RootOptedOut,         // ROOT_OPTED_OUT: root object carries the annotation
+  VetoedByAnnotatedPod, // VETOED_BY_ANNOTATED_POD: sibling pod's annotation
+  NamespaceVetoed,      // NAMESPACE_VETOED: fail-closed veto (see detail)
+  GroupNotIdle,         // GROUP_NOT_IDLE: JobSet/LWS gate found active hosts
+  Deferred,             // DEFERRED: over --max-scale-per-cycle this cycle
+  ShutdownAborted,      // SHUTDOWN_ABORTED: enqueued but daemon shut down
+};
+
+const char* reason_name(Reason r);
+// Every code, in enum order (capi → drift-guard test).
+std::vector<std::string> all_reason_codes();
+
+struct DecisionRecord {
+  uint64_t cycle = 0;
+  int64_t ts_unix = 0;
+  std::string ns, pod;
+  // Observed signal from the idle query's instant vector (the joined
+  // max-over-window utilization — 0 for every row the `== 0` query
+  // returns). HBM corroboration acts as an `unless` clause: rescued pods
+  // never appear, so no per-pod HBM value exists to record.
+  std::string signal_metric;
+  double signal_value = 0.0;
+  bool has_signal = false;
+  std::string accelerator;
+  int64_t lookback_s = 0;
+  std::vector<std::string> owner_chain;  // "Kind/ns/name" hops, pod first
+  std::string root_kind, root_ns, root_name;
+  Reason reason = Reason::DryRun;
+  std::string action;  // "scale_down" | "none"
+  std::string detail;  // free-text context (error messages, veto causes)
+  std::string trace_id;  // cycle trace id (OTLP correlation); may be empty
+
+  json::Value to_json() const;
+};
+
+// ── cycle lifecycle ──
+// Monotonic process-wide cycle counter; also stamps log lines (log.cpp)
+// so logs join against DecisionRecord.cycle without timestamp guessing.
+uint64_t begin_cycle();
+uint64_t current_cycle();
+
+// ── recording ──
+// Optional JSONL sink (--audit-log). "" disables. Lines are appended and
+// flushed per record; failures are log-only (telemetry never kills cycles).
+void set_audit_log(const std::string& path);
+
+// Final record: ring buffer + JSONL.
+void record(DecisionRecord rec);
+// Record whose verdict awaits the actuation consumer: held pending under
+// (cycle, root identity) until finalize() moves it to the ring.
+void record_pending(DecisionRecord rec, const std::string& root_identity);
+// Resolve every pending record of (cycle, root identity).
+void finalize(uint64_t cycle, const std::string& root_identity, Reason reason,
+              const std::string& action, const std::string& detail = "");
+// Shutdown drain: resolve whatever is still pending.
+void finalize_all_pending(Reason reason);
+
+// ── actuate-phase tracker ──
+// The actuate phase is asynchronous (consumer pool); observe ONE histogram
+// sample per cycle when the last enqueued target of the cycle completes,
+// so every phase's _count advances in lockstep. expected==0 observes 0s
+// immediately (dry-run / no-candidate cycles). Also sets the per-cycle
+// noop gauge when the drain completes.
+void arm_actuation(uint64_t cycle, size_t expected, const std::string& trace_id);
+void actuation_done(uint64_t cycle, bool was_noop);
+
+// ── serving ──
+// Ring-buffer contents as {"decisions": [...], "dropped": N, "capacity": N},
+// oldest first. `query_string` supports namespace=<ns>&pod=<name> and the
+// combined pod=<ns>/<name> form (the /debug/decisions URL surface).
+json::Value decisions_json(const std::string& query_string = "");
+
+void reset_for_test();
+
+}  // namespace tpupruner::audit

@@ -73,6 +73,7 @@ struct Cli {
   int64_t scale_concurrency = 8;          // --scale-concurrency (ref: serial consumer)
   int metrics_port = -1;                  // --metrics-port: -1 disabled (flag "0" maps
                                           // here too), 0 ephemeral (flag "auto"), else port
+  std::string audit_log;                  // --audit-log: JSONL DecisionRecord sink ("" = off)
   std::string otlp_endpoint;              // --otlp-endpoint (default: $OTEL_EXPORTER_OTLP_ENDPOINT)
   std::string gcp_project;                // --gcp-project (Cloud Monitoring PromQL API)
   std::string monitoring_endpoint = "https://monitoring.googleapis.com";  // --monitoring-endpoint

@@ -56,6 +56,17 @@ namespace detail {
 struct Conn;  // pooled transport (fd + optional TLS session)
 }
 
+// ── W3C trace-context propagation ──
+// Outbound requests carry a `traceparent` header so the daemon's OTLP
+// spans correlate with server-side traces (apiserver audit logs, managed
+// Prometheus request logs). Resolution order per request: an explicit
+// traceparent in Request.headers wins, then the calling thread's value
+// (consumer actuations propagate their own `scale` span), then the
+// client's default (the producer sets the cycle trace at cycle start).
+// Empty string everywhere → no header, zero cost.
+void set_thread_traceparent(std::string tp);  // "" clears
+const std::string& thread_traceparent();
+
 class Client {
  public:
   explicit Client(TlsMode tls_mode = TlsMode::Verify, std::string ca_file = "");
@@ -87,13 +98,22 @@ class Client {
                           const std::function<bool()>& abort = nullptr,
                           const std::function<void(const Response&)>& on_headers = nullptr) const;
 
+  // Default `traceparent` attached to every request without an explicit or
+  // thread-scoped one (see set_thread_traceparent above). The daemon sets
+  // the cycle's trace context here each cycle; "" clears. Const because
+  // the shared k8s client is held by const& throughout the pipeline.
+  void set_default_traceparent(std::string tp) const;
+
  private:
   Response request_once(const Request& req, const Url& url, bool allow_reuse) const;
+  std::string resolved_traceparent(const Request& req) const;
 
   TlsMode tls_mode_;
   std::string ca_file_;
   mutable std::mutex pool_mutex_;
   mutable std::multimap<std::string, std::unique_ptr<detail::Conn>> pool_;
+  mutable std::mutex traceparent_mutex_;
+  mutable std::string default_traceparent_;
 };
 
 }  // namespace tpupruner::http

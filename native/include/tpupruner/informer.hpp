@@ -99,6 +99,9 @@ class Reflector {
   std::optional<json::Value> get(const std::string& object_path) const;
   ResourceStats stats() const;
   const ResourceSpec& spec() const { return spec_; }
+  // Monotonic seconds of the last applied LIST or watch event (bookmarks
+  // count: they prove the stream is live). 0 = never.
+  int64_t last_activity_mono() const { return last_activity_mono_.load(); }
 
   // ── pure event application (unit-testable without a server) ──
   // Apply one watch event {type, object}. Returns false when the event
@@ -119,6 +122,7 @@ class Reflector {
   Store store_;
   std::atomic<bool> synced_{false};
   std::atomic<bool> stop_{false};
+  std::atomic<int64_t> last_activity_mono_{0};
   std::thread thread_;
   mutable std::mutex stats_mutex_;
   ResourceStats stats_;
@@ -149,6 +153,12 @@ class ClusterCache {
   // True when the pods resource specifically is synced (the resolve
   // phase's gate for skipping its namespace pod LISTs).
   bool pods_synced() const;
+
+  // Worst-resource staleness: seconds since the least-recently-active
+  // reflector applied a LIST or watch event (bookmarks count). Feeds the
+  // tpu_pruner_informer_staleness_seconds gauge — a watch stream that went
+  // quiet without erroring shows up here long before a relist fires.
+  int64_t staleness_secs() const;
 
   // Aggregate + per-resource stats (capi/tests/metrics).
   json::Value stats_json() const;

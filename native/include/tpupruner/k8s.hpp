@@ -97,6 +97,13 @@ class Client {
   // daemon logs and the bench asserts on.
   uint64_t api_calls() const { return api_calls_.load(); }
 
+  // W3C trace-context propagation: every subsequent request carries this
+  // `traceparent` (consumer threads may override per-thread via
+  // http::set_thread_traceparent). The daemon stamps the cycle span's
+  // context here at cycle start so apiserver audit logs join the OTLP
+  // trace. "" clears.
+  void set_traceparent(const std::string& tp) const { http_.set_default_traceparent(tp); }
+
   // ── path builders ──
   static std::string pod_path(const std::string& ns, const std::string& name);
   static std::string pods_path(const std::string& ns);

@@ -16,6 +16,7 @@
 #include <map>
 #include <string>
 #include <string_view>
+#include <vector>
 
 namespace tpupruner::log {
 
@@ -48,6 +49,16 @@ inline void info(std::string_view m, const std::string& msg) { write(Level::Info
 inline void warn(std::string_view m, const std::string& msg) { write(Level::Warn, m, msg); }
 inline void error(std::string_view m, const std::string& msg) { write(Level::Error, m, msg); }
 
+// ── cycle stamping ──
+// Monotonic cycle id appended to every log line (json: a "cycle" field;
+// default/pretty: a trailing " cycle=N") so logs join against
+// DecisionRecord.cycle without timestamp guessing. The producer sets the
+// process-wide id at cycle start (audit::begin_cycle); consumer threads —
+// which may still be actuating cycle N while the producer runs N+1 — pin
+// their own lines with the thread override. 0 = unstamped.
+void set_cycle(uint64_t cycle);              // process-wide (producer)
+void set_thread_cycle(uint64_t cycle);       // per-thread override; 0 clears
+
 // Counters (reference names, main.rs:300-365):
 //   query_successes, query_failures, scale_successes, scale_failures,
 //   query_returned_candidates, query_returned_shutdown_events
@@ -62,5 +73,30 @@ void counter_add(const std::string& name, uint64_t delta);
 void counter_set(const std::string& name, uint64_t value);
 std::map<std::string, Counter> counters_snapshot();
 void counters_reset_for_test();
+
+// ── histograms ──
+// Prometheus-histogram registry for phase latencies: fixed buckets, one
+// optional label value per family (the label name is always "phase"; ""
+// renders unlabelled). Each bucket remembers its latest exemplar trace id
+// — /metrics serves them under the OpenMetrics negotiation so histogram
+// points link back to the cycle's OTLP trace.
+struct HistogramSnapshot {
+  struct Exemplar {
+    std::string trace_id;
+    double value = 0;
+    int64_t ts_unix = 0;
+    bool set = false;
+  };
+  std::vector<double> bounds;      // upper bounds, excludes +Inf
+  std::vector<uint64_t> buckets;   // per-bucket (NON-cumulative); size bounds+1
+  std::vector<Exemplar> exemplars; // aligned with buckets
+  double sum = 0;
+  uint64_t count = 0;
+};
+void histogram_observe(const std::string& family, const std::string& phase,
+                       double value, const std::string& exemplar_trace_id = "");
+// family → phase label value → snapshot
+std::map<std::string, std::map<std::string, HistogramSnapshot>> histograms_snapshot();
+void histograms_reset_for_test();
 
 }  // namespace tpupruner::log

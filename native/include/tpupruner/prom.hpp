@@ -23,6 +23,11 @@ class Client {
   // body; throws std::runtime_error on transport errors or non-2xx status.
   json::Value instant_query(const std::string& promql) const;
 
+  // W3C trace-context propagation onto the query requests (the daemon
+  // stamps each cycle's span context; managed-Prometheus request logs
+  // then join the OTLP trace). "" clears.
+  void set_traceparent(const std::string& tp) const { http_.set_default_traceparent(tp); }
+
  private:
   std::string base_url_;
   std::string token_;

@@ -82,9 +82,12 @@ size_t prefetch_owner_chains(const k8s::Client& client, FetchCache& cache,
 // first and only falls back to a live GET on a miss (store unsynced,
 // resource unwatched, or object genuinely absent — absence is never
 // negative-cached, so a lagging watch costs an API call, not correctness).
+// `chain_out` (optional) receives the resolved hops as "Kind/ns/name"
+// strings, pod first — the DecisionRecord.owner_chain audit field.
 core::ScaleTarget find_root_object(const k8s::Client& client, const json::Value& pod,
                                    FetchCache* cache = nullptr,
-                                   const informer::ClusterCache* watch_cache = nullptr);
+                                   const informer::ClusterCache* watch_cache = nullptr,
+                                   std::vector<std::string>* chain_out = nullptr);
 
 // Key "ns/pod" set of idle pods discovered this cycle.
 using IdlePodSet = std::set<std::string>;

@@ -1,5 +1,6 @@
 #include "tpupruner/actuate.hpp"
 
+#include <chrono>
 #include <stdexcept>
 
 #include "tpupruner/log.hpp"
@@ -48,6 +49,21 @@ bool scale_to_zero(const k8s::Client& client, const ScaleTarget& target,
     log::debug("actuate", ns + "/" + name + " already at paused state; skipping");
     return false;
   }
+
+  // Per-target actuation latency (Event POST + pause PATCH), observed on
+  // every exit path including the PATCH throw — a failing apiserver is
+  // exactly when the latency distribution matters.
+  auto started = std::chrono::steady_clock::now();
+  struct Observe {
+    std::chrono::steady_clock::time_point start;
+    const std::string& trace_id;
+    ~Observe() {
+      log::histogram_observe(
+          "scale_patch_seconds", "",
+          std::chrono::duration<double>(std::chrono::steady_clock::now() - start).count(),
+          trace_id);
+    }
+  } observe{started, opts.trace_id};
 
   // 1. audit Event first; failure is log-only (lib.rs:344-348)
   {

@@ -1,0 +1,299 @@
+#include "tpupruner/audit.hpp"
+
+#include <chrono>
+#include <cstdio>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+
+#include "tpupruner/log.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::audit {
+
+namespace {
+
+constexpr size_t kDefaultCapacity = 2048;
+
+struct PendingGroup {
+  std::vector<DecisionRecord> records;
+};
+
+struct ActuationTracker {
+  size_t remaining = 0;
+  size_t noops = 0;
+  std::string trace_id;
+  std::chrono::steady_clock::time_point armed_at;
+};
+
+struct Registry {
+  std::mutex mutex;
+  std::deque<DecisionRecord> ring;
+  size_t capacity = kDefaultCapacity;
+  uint64_t dropped = 0;
+  std::atomic<uint64_t> cycle{0};
+  // (cycle << separator) root identity → records awaiting the consumer
+  std::map<std::pair<uint64_t, std::string>, PendingGroup> pending;
+  std::map<uint64_t, ActuationTracker> actuations;
+  std::string audit_log_path;
+  std::FILE* audit_log = nullptr;
+  bool capacity_read = false;
+};
+
+Registry& reg() {
+  static Registry r;
+  return r;
+}
+
+void push_locked(Registry& r, DecisionRecord&& rec) {
+  if (r.audit_log) {
+    std::string line = rec.to_json().dump();
+    line += '\n';
+    if (std::fwrite(line.data(), 1, line.size(), r.audit_log) != line.size()) {
+      // Disable on write failure (disk full, rotated-away path): the audit
+      // trail is telemetry, and retrying every record would spam the log.
+      std::fclose(r.audit_log);
+      r.audit_log = nullptr;
+      log::warn("audit", "audit log write failed; disabling --audit-log sink");
+    } else {
+      std::fflush(r.audit_log);
+    }
+  }
+  if (!r.capacity_read) {
+    r.capacity_read = true;
+    if (auto cap = util::env("TPU_PRUNER_DECISION_CAPACITY")) {
+      try {
+        long long v = std::stoll(*cap);
+        if (v > 0) r.capacity = static_cast<size_t>(v);
+      } catch (const std::exception&) {
+      }
+    }
+  }
+  while (r.ring.size() >= r.capacity) {
+    r.ring.pop_front();
+    ++r.dropped;
+  }
+  r.ring.push_back(std::move(rec));
+}
+
+void observe_actuation_locked(Registry& r, std::map<uint64_t, ActuationTracker>::iterator it) {
+  const ActuationTracker& t = it->second;
+  double secs = std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                              t.armed_at).count();
+  log::histogram_observe("cycle_phase_seconds", "actuate", secs, t.trace_id);
+  log::counter_set("cycle_noop_targets", t.noops);
+  r.actuations.erase(it);
+}
+
+}  // namespace
+
+const char* reason_name(Reason r) {
+  switch (r) {
+    case Reason::Scaled: return "SCALED";
+    case Reason::DryRun: return "DRY_RUN";
+    case Reason::AlreadyPaused: return "ALREADY_PAUSED";
+    case Reason::ScaleFailed: return "SCALE_FAILED";
+    case Reason::KindDisabled: return "KIND_DISABLED";
+    case Reason::NoScalableOwner: return "NO_SCALABLE_OWNER";
+    case Reason::PodGone: return "POD_GONE";
+    case Reason::WatchCacheMiss: return "WATCH_CACHE_MISS";
+    case Reason::FetchError: return "FETCH_ERROR";
+    case Reason::PendingPod: return "PENDING_POD";
+    case Reason::NoCreationTimestamp: return "NO_CREATION_TIMESTAMP";
+    case Reason::BadCreationTimestamp: return "BAD_CREATION_TIMESTAMP";
+    case Reason::BelowMinAge: return "BELOW_MIN_AGE";
+    case Reason::OptedOut: return "OPTED_OUT";
+    case Reason::RootOptedOut: return "ROOT_OPTED_OUT";
+    case Reason::VetoedByAnnotatedPod: return "VETOED_BY_ANNOTATED_POD";
+    case Reason::NamespaceVetoed: return "NAMESPACE_VETOED";
+    case Reason::GroupNotIdle: return "GROUP_NOT_IDLE";
+    case Reason::Deferred: return "DEFERRED";
+    case Reason::ShutdownAborted: return "SHUTDOWN_ABORTED";
+  }
+  return "?";
+}
+
+std::vector<std::string> all_reason_codes() {
+  std::vector<std::string> out;
+  for (int i = 0; i <= static_cast<int>(Reason::ShutdownAborted); ++i) {
+    out.push_back(reason_name(static_cast<Reason>(i)));
+  }
+  return out;
+}
+
+json::Value DecisionRecord::to_json() const {
+  json::Value v = json::Value::object();
+  v.set("cycle", json::Value(static_cast<int64_t>(cycle)));
+  v.set("ts", json::Value(util::format_rfc3339(ts_unix)));
+  v.set("namespace", json::Value(ns));
+  v.set("pod", json::Value(pod));
+  if (has_signal) {
+    json::Value sig = json::Value::object();
+    sig.set("metric", json::Value(signal_metric));
+    sig.set("value", json::Value(signal_value));
+    if (!accelerator.empty()) sig.set("accelerator", json::Value(accelerator));
+    v.set("signal", std::move(sig));
+  }
+  v.set("lookback_s", json::Value(lookback_s));
+  if (!owner_chain.empty()) {
+    json::Value chain = json::Value::array();
+    for (const std::string& hop : owner_chain) chain.push_back(json::Value(hop));
+    v.set("owner_chain", std::move(chain));
+  }
+  if (!root_kind.empty()) {
+    json::Value root = json::Value::object();
+    root.set("kind", json::Value(root_kind));
+    root.set("namespace", json::Value(root_ns));
+    root.set("name", json::Value(root_name));
+    v.set("root", std::move(root));
+  }
+  v.set("reason", json::Value(std::string(reason_name(reason))));
+  v.set("action", json::Value(action.empty() ? "none" : action));
+  if (!detail.empty()) v.set("detail", json::Value(detail));
+  if (!trace_id.empty()) v.set("trace_id", json::Value(trace_id));
+  return v;
+}
+
+uint64_t begin_cycle() {
+  uint64_t c = reg().cycle.fetch_add(1) + 1;
+  log::set_cycle(c);
+  return c;
+}
+
+uint64_t current_cycle() { return reg().cycle.load(); }
+
+void set_audit_log(const std::string& path) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  if (r.audit_log) {
+    std::fclose(r.audit_log);
+    r.audit_log = nullptr;
+  }
+  r.audit_log_path = path;
+  if (path.empty()) return;
+  r.audit_log = std::fopen(path.c_str(), "a");
+  if (!r.audit_log) {
+    log::warn("audit", "cannot open --audit-log " + path + "; decisions go to the "
+              "ring buffer only");
+  } else {
+    log::info("audit", "appending decision records to " + path);
+  }
+}
+
+void record(DecisionRecord rec) {
+  if (rec.ts_unix == 0) rec.ts_unix = util::now_unix();
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  push_locked(r, std::move(rec));
+}
+
+void record_pending(DecisionRecord rec, const std::string& root_identity) {
+  if (rec.ts_unix == 0) rec.ts_unix = util::now_unix();
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  r.pending[{rec.cycle, root_identity}].records.push_back(std::move(rec));
+}
+
+void finalize(uint64_t cycle, const std::string& root_identity, Reason reason,
+              const std::string& action, const std::string& detail) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  auto it = r.pending.find({cycle, root_identity});
+  if (it == r.pending.end()) return;
+  PendingGroup group = std::move(it->second);
+  r.pending.erase(it);
+  for (DecisionRecord& rec : group.records) {
+    rec.reason = reason;
+    rec.action = action;
+    if (!detail.empty()) rec.detail = detail;
+    push_locked(r, std::move(rec));
+  }
+}
+
+void finalize_all_pending(Reason reason) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  for (auto& [key, group] : r.pending) {
+    for (DecisionRecord& rec : group.records) {
+      rec.reason = reason;
+      rec.action = "none";
+      push_locked(r, std::move(rec));
+    }
+  }
+  r.pending.clear();
+}
+
+void arm_actuation(uint64_t cycle, size_t expected, const std::string& trace_id) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  ActuationTracker t;
+  t.remaining = expected;
+  t.trace_id = trace_id;
+  t.armed_at = std::chrono::steady_clock::now();
+  auto [it, _] = r.actuations.insert_or_assign(cycle, std::move(t));
+  if (expected == 0) observe_actuation_locked(r, it);
+}
+
+void actuation_done(uint64_t cycle, bool was_noop) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  auto it = r.actuations.find(cycle);
+  if (it == r.actuations.end()) return;
+  if (was_noop) ++it->second.noops;
+  if (it->second.remaining > 0 && --it->second.remaining == 0) {
+    observe_actuation_locked(r, it);
+  }
+}
+
+json::Value decisions_json(const std::string& query_string) {
+  // namespace=<ns>&pod=<name>, or pod=<ns>/<name> (split on the first '/').
+  std::string want_ns, want_pod;
+  for (const std::string& pair : util::split(query_string, '&')) {
+    size_t eq = pair.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = pair.substr(0, eq);
+    std::string value = util::url_decode(pair.substr(eq + 1));
+    if (key == "namespace") want_ns = value;
+    else if (key == "pod") {
+      size_t slash = value.find('/');
+      if (slash != std::string::npos) {
+        want_ns = value.substr(0, slash);
+        want_pod = value.substr(slash + 1);
+      } else {
+        want_pod = value;
+      }
+    }
+  }
+
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  json::Value decisions = json::Value::array();
+  for (const DecisionRecord& rec : r.ring) {
+    if (!want_ns.empty() && rec.ns != want_ns) continue;
+    if (!want_pod.empty() && rec.pod != want_pod) continue;
+    decisions.push_back(rec.to_json());
+  }
+  json::Value out = json::Value::object();
+  out.set("decisions", std::move(decisions));
+  out.set("dropped", json::Value(static_cast<int64_t>(r.dropped)));
+  out.set("capacity", json::Value(static_cast<int64_t>(r.capacity)));
+  return out;
+}
+
+void reset_for_test() {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  r.ring.clear();
+  r.pending.clear();
+  r.actuations.clear();
+  r.dropped = 0;
+  r.cycle.store(0);
+  if (r.audit_log) {
+    std::fclose(r.audit_log);
+    r.audit_log = nullptr;
+  }
+  r.audit_log_path.clear();
+}
+
+}  // namespace tpupruner::audit

@@ -11,6 +11,7 @@
 #include <unordered_map>
 
 #include "otlp_grpc.hpp"
+#include "tpupruner/audit.hpp"
 #include "tpupruner/core.hpp"
 #include "tpupruner/informer.hpp"
 #include "tpupruner/json.hpp"
@@ -339,6 +340,21 @@ char* tp_informer_stop(const char* payload_json) {
     if (session) session->cache.stop();  // join reflectors before the client dies
     Value out = Value::object();
     out.set("stopped", Value(stopped));
+    return ok(out);
+  });
+}
+
+char* tp_audit_reason_codes(const char*) {
+  // The canonical DecisionRecord reason-code list (enum order). The
+  // docs-drift test joins this against docs/OPERATIONS.md so every code
+  // the daemon can emit stays documented.
+  return guarded([&] {
+    Value codes = Value::array();
+    for (const std::string& code : tpupruner::audit::all_reason_codes()) {
+      codes.push_back(Value(code));
+    }
+    Value out = Value::object();
+    out.set("codes", std::move(codes));
     return ok(out);
   });
 }

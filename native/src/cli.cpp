@@ -124,8 +124,13 @@ TPU FLAGS:
                                 RBAC: needs the `watch` verb (clusterrole.yaml)
       --max-cycles <N>          daemon mode: exit cleanly after N evaluation
                                 cycles (bench/test harness; 0 = unlimited)
-      --metrics-port <P>        serve Prometheus /metrics + /healthz on this port
+      --metrics-port <P>        serve Prometheus /metrics (+ /healthz, /readyz,
+                                /debug/decisions) on this port
                                 (0 = disabled, "auto" = ephemeral)
+      --audit-log <FILE>        append one JSONL DecisionRecord per candidate
+                                pod per cycle (the /debug/decisions ring
+                                buffer, durable; consumed by
+                                `python -m tpu_pruner.analyze --explain`)
       --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
                                 [default: $OTEL_EXPORTER_OTLP_ENDPOINT]
       --gcp-project <ID>        query the Cloud Monitoring PromQL API for this
@@ -245,6 +250,7 @@ Cli parse(int argc, char** argv) {
          // default) so existing manifests don't start binding random ports.
          cli.metrics_port = port == 0 ? -1 : port;
        }},
+      {"--audit-log", [&](const std::string& v) { cli.audit_log = v; }},
       {"--otlp-endpoint", [&](const std::string& v) { cli.otlp_endpoint = v; }},
       {"--gcp-project", [&](const std::string& v) { cli.gcp_project = v; }},
       {"--monitoring-endpoint", [&](const std::string& v) { cli.monitoring_endpoint = v; }},

@@ -16,6 +16,7 @@
 #include "metrics_http.hpp"
 #include "otlp.hpp"
 #include "tpupruner/actuate.hpp"
+#include "tpupruner/audit.hpp"
 #include "tpupruner/auth.hpp"
 #include "tpupruner/http.hpp"
 #include "tpupruner/leader.hpp"
@@ -31,13 +32,21 @@ using core::ScaleTarget;
 
 namespace {
 
+// Queue item: the target plus the cycle that produced it, so the consumer
+// can finalize that cycle's pending DecisionRecords and stamp its log
+// lines even while the producer is already running the next cycle.
+struct QueuedTarget {
+  ScaleTarget target;
+  uint64_t cycle = 0;
+};
+
 // Bounded MPSC queue with close semantics (reference: tokio mpsc::channel
 // of 100, main.rs:284).
 class TargetQueue {
  public:
   explicit TargetQueue(size_t capacity) : capacity_(capacity) {}
 
-  void push(ScaleTarget t) {
+  void push(QueuedTarget t) {
     std::unique_lock<std::mutex> lock(mutex_);
     not_full_.wait(lock, [&] { return queue_.size() < capacity_ || closed_; });
     if (closed_) return;
@@ -45,11 +54,11 @@ class TargetQueue {
     not_empty_.notify_one();
   }
 
-  std::optional<ScaleTarget> pop() {
+  std::optional<QueuedTarget> pop() {
     std::unique_lock<std::mutex> lock(mutex_);
     not_empty_.wait(lock, [&] { return !queue_.empty() || closed_; });
     if (queue_.empty()) return std::nullopt;  // closed and drained
-    ScaleTarget t = std::move(queue_.front());
+    QueuedTarget t = std::move(queue_.front());
     queue_.pop_front();
     not_full_.notify_one();
     return t;
@@ -65,10 +74,15 @@ class TargetQueue {
  private:
   std::mutex mutex_;
   std::condition_variable not_empty_, not_full_;
-  std::deque<ScaleTarget> queue_;
+  std::deque<QueuedTarget> queue_;
   size_t capacity_;
   bool closed_ = false;
 };
+
+// Seconds since `since` (phase-latency histogram observations).
+double secs_since(std::chrono::steady_clock::time_point since) {
+  return std::chrono::duration<double>(std::chrono::steady_clock::now() - since).count();
+}
 
 prom::Client build_prom_client(const cli::Cli& args) {
   // Fresh token each cycle, like the reference's per-cycle client rebuild
@@ -87,6 +101,13 @@ prom::Client build_prom_client(const cli::Cli& args) {
 struct ResolveOutcome {
   std::vector<ScaleTarget> targets;
   walker::IdlePodSet idle_pods;  // pods idle AND eligible (for the slice gate)
+  // Audit trail: records terminal at the resolve stage (eligibility gates,
+  // fetch failures, failed walks) ...
+  std::vector<audit::DecisionRecord> decided;
+  // ... and per-pod records that resolved to a root — their verdict lands
+  // later (opt-out valves, group gate, breaker, actuation), keyed by the
+  // root's identity so run_cycle can join them against target outcomes.
+  std::vector<std::pair<std::string, audit::DecisionRecord>> resolved_records;
   // Root identities vetoed by a pod-level tpu-pruner.dev/skip annotation:
   // an annotated pod must protect its owner for EVERY kind, not only the
   // group kinds the all-idle gate covers — a sibling pod of the same
@@ -134,13 +155,40 @@ extern "C" void on_shutdown_signal(int signum) {
 ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
                             const std::vector<core::PodMetricSample>& samples,
                             const otlp::SpanContext& parent_ctx,
-                            const informer::ClusterCache* watch_cache) {
+                            const informer::ClusterCache* watch_cache,
+                            uint64_t cycle_id) {
   ResolveOutcome out;
   std::mutex out_mutex;
   walker::FetchCache owner_cache;  // memoize shared owner chains this cycle
   int64_t lookback_secs = args.duration * 60 + args.grace_period;  // main.rs:413-414
   int64_t now = util::now_unix();
   size_t workers = static_cast<size_t>(args.resolve_concurrency);
+
+  // DecisionRecord skeleton per candidate: observed signal (the idle
+  // query's joined max-over-window utilization), lookback, cycle, trace.
+  const std::string signal_metric =
+      args.device == "gpu" ? "dcgm/gr_engine_active" : "tensorcore/duty_cycle";
+  auto base_record = [&](const core::PodMetricSample& s) {
+    audit::DecisionRecord r;
+    r.cycle = cycle_id;
+    r.ns = s.ns;
+    r.pod = s.name;
+    r.signal_metric = signal_metric;
+    r.signal_value = s.value;
+    r.has_signal = true;
+    r.accelerator = s.accelerator;
+    r.lookback_s = lookback_secs;
+    r.trace_id = parent_ctx.trace_id;
+    return r;
+  };
+  auto decide = [&](audit::DecisionRecord rec, audit::Reason reason,
+                    const std::string& detail = "") {
+    rec.reason = reason;
+    rec.action = "none";
+    rec.detail = detail;
+    std::lock_guard<std::mutex> lock(out_mutex);
+    out.decided.push_back(std::move(rec));
+  };
 
   // Watch-backed store states, sampled ONCE per cycle: flipping mid-cycle
   // (a relist landing between phases) must not mix strategies — per-lookup
@@ -210,6 +258,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     std::string key = pmd.ns + "/" + pmd.name;
 
     const json::Value* pod = nullptr;
+    bool store_missed = false;  // synced store consulted but had no entry
     {
       auto it = prefetched.find(key);
       if (it != prefetched.end()) pod = it->second;
@@ -223,6 +272,8 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
         std::lock_guard<std::mutex> lock(out_mutex);
         owned_pods.push_back(std::move(*hit));
         pod = &owned_pods.back();
+      } else {
+        store_missed = store_pods;
       }
     }
     if (!pod) {
@@ -237,12 +288,18 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
         // cycle once the API answers again.
         log::error("daemon", "Skipping " + key + ", retrieval error (vetoing namespace " + pmd.ns +
                    " this cycle): " + e.what());
+        decide(base_record(pmd), audit::Reason::FetchError,
+               std::string("pod GET failed, namespace vetoed: ") + e.what());
         std::lock_guard<std::mutex> lock(out_mutex);
         out.vetoed_namespaces.emplace(pmd.ns, "fetch error for pod " + key);
         return;
       }
       if (!fetched) {
         log::info("daemon", "Skipping " + key + ", pod no longer exists");
+        decide(base_record(pmd),
+               store_missed ? audit::Reason::WatchCacheMiss : audit::Reason::PodGone,
+               store_missed ? "absent from the synced watch store and from the live GET"
+                            : "in the metric plane but not in the cluster");
         return;
       }
       std::lock_guard<std::mutex> lock(out_mutex);
@@ -254,15 +311,20 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     switch (elig) {
       case core::Eligibility::Pending:
         log::info("daemon", "Skipping pod " + key + ", it's still pending");
+        decide(base_record(pmd), audit::Reason::PendingPod);
         return;
       case core::Eligibility::NoCreationTs:
         log::warn("daemon", "Pod " + key + " has no creation timestamp, skipping");
+        decide(base_record(pmd), audit::Reason::NoCreationTimestamp);
         return;
       case core::Eligibility::BadTimestamp:
         log::warn("daemon", "Pod " + key + " has unparseable creation timestamp, skipping");
+        decide(base_record(pmd), audit::Reason::BadCreationTimestamp);
         return;
       case core::Eligibility::TooYoung:
         log::info("daemon", "Pod " + key + " created within lookback window, skipping");
+        decide(base_record(pmd), audit::Reason::BelowMinAge,
+               "created within the " + std::to_string(lookback_secs) + "s lookback window");
         return;
       case core::Eligibility::OptedOut: {
         // Not a candidate — but its root must be vetoed for every kind, so
@@ -303,31 +365,48 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     const EligiblePod& e = eligible[i];
     std::string key = e.sample->ns + "/" + e.sample->name;
     std::optional<ScaleTarget> target;
+    std::vector<std::string> chain;
     {
       otlp::Span span("find_root_object", &parent_ctx);  // lib.rs:436 span
       span.attr("pod", key);
       try {
-        target = walker::find_root_object(kube, *e.pod, &owner_cache, watch_cache);
+        target = walker::find_root_object(kube, *e.pod, &owner_cache, watch_cache, &chain);
       } catch (const std::exception& e2) {
         span.set_error(e2.what());
+        audit::DecisionRecord rec = base_record(*e.sample);
+        rec.owner_chain = chain;
         if (e.opted_out) {
           // Can't learn which root the annotation protects — fail closed
           // on the whole namespace this cycle instead of failing open.
           log::warn("daemon", "Annotated pod " + key + " has no resolvable root (" + e2.what() +
                     "); vetoing namespace " + e.sample->ns + " this cycle");
+          decide(std::move(rec), audit::Reason::OptedOut,
+                 std::string("annotated pod with unresolvable root; namespace vetoed: ") +
+                     e2.what());
           std::lock_guard<std::mutex> lock(out_mutex);
           out.vetoed_namespaces.emplace(e.sample->ns,
                                         "annotated pod " + key + " with unresolvable root");
         } else {
           log::warn("daemon", "Skipping " + key + ", no scalable root object: " + e2.what());
+          decide(std::move(rec), audit::Reason::NoScalableOwner, e2.what());
         }
       }
     }
     if (target) {
+      audit::DecisionRecord rec = base_record(*e.sample);
+      rec.owner_chain = std::move(chain);
+      rec.root_kind = core::kind_name(target->kind);
+      rec.root_ns = target->ns().value_or("");
+      rec.root_name = target->name();
       std::lock_guard<std::mutex> lock(out_mutex);
       if (e.opted_out) {
+        rec.reason = audit::Reason::OptedOut;
+        rec.action = "none";
+        rec.detail = "pod annotation vetoes its root for every kind this cycle";
+        out.decided.push_back(std::move(rec));
         out.vetoed_roots.insert(target->identity());
       } else {
+        out.resolved_records.emplace_back(target->identity(), std::move(rec));
         out.targets.push_back(std::move(*target));
       }
     }
@@ -353,17 +432,34 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
                      core::ResourceSet enabled,
                      const std::function<void(ScaleTarget)>& enqueue,
                      const informer::ClusterCache* watch_cache) {
-  // Cycle span (reference #[tracing::instrument] on run_query_and_scale,
+  // Audit cycle id first (stamps every log line of the cycle), then the
+  // cycle span (reference #[tracing::instrument] on run_query_and_scale,
   // main.rs:390); children below mirror the instrumented callees.
+  const uint64_t cycle_id = audit::begin_cycle();
   otlp::Span cycle("run_query_and_scale");
+  cycle.attr("cycle", static_cast<int64_t>(cycle_id));
+  const std::string trace_id = cycle.context().trace_id;
+  // W3C trace propagation: every outbound Prometheus and K8s request of
+  // this cycle carries the cycle span's context, so server-side request
+  // logs join the OTLP trace end-to-end. Consumer actuations override
+  // per-thread with their own `scale` span context.
+  kube.set_traceparent(otlp::traceparent(cycle.context()));
   const uint64_t api_calls_before = kube.api_calls();
+  const auto cycle_start = std::chrono::steady_clock::now();
+  auto observe_phase = [&](const char* phase, std::chrono::steady_clock::time_point since) {
+    log::histogram_observe("cycle_phase_seconds", phase, secs_since(since), trace_id);
+  };
   return with_span(cycle, [&] {
+  auto phase_start = std::chrono::steady_clock::now();
   prom::Client prom_client = build_prom_client(args);
+  prom_client.set_traceparent(otlp::traceparent(cycle.context()));
   json::Value response = [&] {
     otlp::Span span("prometheus.instant_query", &cycle.context());
     return with_span(span, [&] { return prom_client.instant_query(query); });
   }();
+  observe_phase("query", phase_start);
 
+  phase_start = std::chrono::steady_clock::now();
   metrics::DecodeResult decoded =
       metrics::decode_instant_vector(response, args.device, cli::resolved_schema(args));
   for (const std::string& err : decoded.errors) {
@@ -371,10 +467,22 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   }
   log::info("daemon", "Query returned " + std::to_string(decoded.num_series) + " series across " +
             std::to_string(decoded.samples.size()) + " unique pods");
+  observe_phase("decode", phase_start);
 
+  phase_start = std::chrono::steady_clock::now();
   ResolveOutcome resolved =
-      resolve_pods(args, kube, decoded.samples, cycle.context(), watch_cache);
+      resolve_pods(args, kube, decoded.samples, cycle.context(), watch_cache, cycle_id);
+  observe_phase("resolve", phase_start);
+  // Gate-terminal decisions (ineligible pods, failed fetches/walks) are
+  // final now; resolved pods' records land after the target-level gates.
+  for (audit::DecisionRecord& rec : resolved.decided) {
+    audit::record(std::move(rec));
+  }
   std::vector<ScaleTarget> unique = core::dedup_targets(std::move(resolved.targets));
+
+  // Target-level verdicts, joined back onto every contributing pod's
+  // DecisionRecord after the gates below run.
+  std::unordered_map<std::string, std::pair<audit::Reason, std::string>> outcome;
 
   // Opt-out valves, applied before the group gate so a skipped JobSet/LWS
   // doesn't still pay that gate's per-namespace pods LIST: (a) the root
@@ -384,17 +492,21 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
     kept.reserve(unique.size());
     for (ScaleTarget& t : unique) {
       std::string why;
+      audit::Reason reason = audit::Reason::RootOptedOut;
       if (core::is_opted_out(t.object)) {
         why = "annotated " + std::string(core::kSkipAnnotation) + "=true";
       } else if (resolved.vetoed_roots.count(t.identity())) {
         why = "vetoed by an annotated pod";
+        reason = audit::Reason::VetoedByAnnotatedPod;
       } else if (auto it = resolved.vetoed_namespaces.find(t.ns().value_or(""));
                  it != resolved.vetoed_namespaces.end()) {
         why = "namespace vetoed (" + it->second + ")";
+        reason = audit::Reason::NamespaceVetoed;
       }
       if (!why.empty()) {
         log::info("daemon", "Skipping [" + std::string(core::kind_name(t.kind)) + "] " +
                   t.ns().value_or("") + ":" + t.name() + ", " + why);
+        outcome.emplace(t.identity(), std::make_pair(reason, why));
         continue;
       }
       kept.push_back(std::move(t));
@@ -430,7 +542,13 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   std::vector<ScaleTarget> survivors;
   survivors.reserve(unique.size());
   for (size_t i = 0; i < unique.size(); ++i) {
-    if (keep[i]) survivors.push_back(std::move(unique[i]));
+    if (keep[i]) {
+      survivors.push_back(std::move(unique[i]));
+    } else {
+      outcome.emplace(unique[i].identity(),
+                      std::make_pair(audit::Reason::GroupNotIdle,
+                                     "group has active (or too-young) TPU hosts"));
+    }
   }
 
   // Blast-radius circuit breaker: a poisoned metric plane (scrape outage,
@@ -455,6 +573,10 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
         capped.push_back(std::move(t));
       } else {
         ++deferred;
+        outcome.emplace(t.identity(),
+                        std::make_pair(audit::Reason::Deferred,
+                                       "over --max-scale-per-cycle=" +
+                                           std::to_string(args.max_scale_per_cycle)));
       }
     }
     if (deferred > 0) {
@@ -480,6 +602,36 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   cycle.attr("num_pods", static_cast<int64_t>(stats.num_pods));
   cycle.attr("shutdown_events", static_cast<int64_t>(stats.shutdown_events));
 
+  // Flush the resolved pods' records BEFORE anything is enqueued: a fast
+  // consumer may finalize a pending record the instant the target hits the
+  // queue, so the pending entry must already exist.
+  {
+    std::unordered_set<std::string> enqueue_ids;
+    if (!args.dry_run()) {
+      for (const ScaleTarget& t : survivors) enqueue_ids.insert(t.identity());
+    }
+    for (auto& [identity, rec] : resolved.resolved_records) {
+      if (auto it = outcome.find(identity); it != outcome.end()) {
+        rec.reason = it->second.first;
+        rec.action = "none";
+        rec.detail = it->second.second;
+        audit::record(std::move(rec));
+      } else if (enqueue_ids.count(identity)) {
+        audit::record_pending(std::move(rec), identity);
+      } else {
+        // dry-run survivor (or a disabled-kind target in dry-run mode)
+        rec.reason = audit::Reason::DryRun;
+        rec.action = "none";
+        rec.detail = "would have paused (run-mode dry-run)";
+        audit::record(std::move(rec));
+      }
+    }
+  }
+  // One actuate-phase observation per cycle, taken when the consumers
+  // finish this cycle's queue (0s immediately when nothing is enqueued) —
+  // keeps every phase histogram's _count in lockstep per cycle.
+  audit::arm_actuation(cycle_id, args.dry_run() ? 0 : survivors.size(), trace_id);
+
   for (ScaleTarget& t : survivors) {
     std::string desc = "[" + std::string(core::kind_name(t.kind)) + "] " +
                        t.ns().value_or("") + ":" + t.name();
@@ -490,6 +642,7 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
       enqueue(std::move(t));
     }
   }
+  observe_phase("total", cycle_start);
   return stats;
   });
 }
@@ -514,6 +667,10 @@ int run(const cli::Cli& args) {
   // Query built once, reused every cycle (main.rs:280-282).
   std::string query = query::build_idle_query(cli::to_query_args(args));
   log::info("daemon", "Running w/ Query: " + query);
+
+  // Durable decision audit trail (--audit-log): every DecisionRecord the
+  // ring buffer sees is also appended as JSONL here.
+  audit::set_audit_log(args.audit_log);
 
   k8s::Client kube = [&] {
     try {
@@ -546,6 +703,18 @@ int run(const cli::Cli& args) {
   std::unique_ptr<metrics_http::Server> metrics_server;
   if (args.metrics_port >= 0) {  // 0 = ephemeral (port logged at startup)
     metrics_server = std::make_unique<metrics_http::Server>(args.metrics_port);
+    // Decision audit trail: the in-process ring buffer, filterable by
+    // ?namespace= / ?pod= (or pod=ns/name) — `analyze --explain` hits this.
+    metrics_server->set_decisions_provider(
+        [](const std::string& query_string) { return audit::decisions_json(query_string).dump(); });
+    // /readyz reflects informer sync state — distinct from the /healthz
+    // liveness stamp: a daemon mid-relist is alive but serving degraded
+    // (GET-fallback) lookups, and a rollout should wait it out. Without
+    // the watch cache there is no sync concept: always ready.
+    const informer::ClusterCache* cache_ptr = watch_cache.get();
+    metrics_server->set_ready_probe([cache_ptr] {
+      return cache_ptr == nullptr || cache_ptr->all_synced();
+    });
   }
   // Liveness = the producer loop ticked (cycle completed, failed-but-handled,
   // or standby poll) within 3 check intervals. A static "ok" would keep a
@@ -657,11 +826,22 @@ int run(const cli::Cli& args) {
 
   auto consume_fn = [&] {
     while (true) {
-      std::optional<ScaleTarget> t = queue.pop();
-      if (!t) break;  // closed + drained
-      if (!(enabled & core::flag(t->kind))) {
-        log::info("daemon", "Skipping resource type " + std::string(core::kind_name(t->kind)) +
+      std::optional<QueuedTarget> item = queue.pop();
+      if (!item) break;  // closed + drained
+      ScaleTarget& t = item->target;
+      // Log lines of this actuation belong to the cycle that produced the
+      // target, not whatever cycle the producer is on by now.
+      log::set_thread_cycle(item->cycle);
+      const std::string identity = t.identity();
+      auto finish = [&](audit::Reason reason, const std::string& action,
+                        const std::string& detail = "") {
+        audit::finalize(item->cycle, identity, reason, action, detail);
+        audit::actuation_done(item->cycle, reason == audit::Reason::AlreadyPaused);
+      };
+      if (!(enabled & core::flag(t.kind))) {
+        log::info("daemon", "Skipping resource type " + std::string(core::kind_name(t.kind)) +
                   " because it is not enabled");
+        finish(audit::Reason::KindDisabled, "none");
         continue;
       }
       actuate::ScaleOptions opts;
@@ -674,32 +854,42 @@ int run(const cli::Cli& args) {
       opts.skip_if_already_paused = args.watch_cache == "on";
       // Root span per actuation: the consumer runs on its own task, so
       // scale traces are separate from the query cycle's, as in the
-      // reference (lib.rs:338 instrument on scale()).
+      // reference (lib.rs:338 instrument on scale()). The span context
+      // rides the thread's traceparent so the Event POST and pause PATCH
+      // correlate with THIS trace, not the producer's current cycle.
       otlp::Span span("scale");
-      span.attr("kind", std::string(core::kind_name(t->kind)));
-      span.attr("name", t->name());
-      span.attr("namespace", t->ns().value_or(""));
+      span.attr("kind", std::string(core::kind_name(t.kind)));
+      span.attr("name", t.name());
+      span.attr("namespace", t.ns().value_or(""));
+      http::set_thread_traceparent(otlp::traceparent(span.context()));
+      opts.trace_id = span.context().trace_id;
       bool patched = false;
       try {
-        patched = actuate::scale_to_zero(kube, *t, opts);
+        patched = actuate::scale_to_zero(kube, t, opts);
       } catch (const std::exception& e) {
         span.set_error(e.what());
         log::counter_add("scale_failures", 1);
         log::error("daemon", std::string("Failed to scale resource! ") + e.what());
+        finish(audit::Reason::ScaleFailed, "scale_down", e.what());
+        http::set_thread_traceparent("");
         continue;
       }
+      http::set_thread_traceparent("");
       if (!patched) {
         log::counter_add("scale_noops", 1);
         log::info("daemon", "Already paused (no-op): [" +
-                  std::string(core::kind_name(t->kind)) + "] - " +
-                  t->ns().value_or("default") + ":" + t->name());
+                  std::string(core::kind_name(t.kind)) + "] - " +
+                  t.ns().value_or("default") + ":" + t.name());
+        finish(audit::Reason::AlreadyPaused, "none", "root already at its paused state");
         continue;
       }
       log::counter_add("scale_successes", 1);
-      log::info("daemon", "Scaled Resource: [" + std::string(core::kind_name(t->kind)) + "] - " +
-                t->ns().value_or("default") + ":" + t->name());
-      notify(*t);
+      log::info("daemon", "Scaled Resource: [" + std::string(core::kind_name(t.kind)) + "] - " +
+                t.ns().value_or("default") + ":" + t.name());
+      finish(audit::Reason::Scaled, "scale_down");
+      notify(t);
     }
+    log::set_thread_cycle(0);
   };
   std::vector<std::thread> consumers;
   for (int64_t i = 0; i < args.scale_concurrency; ++i) consumers.emplace_back(consume_fn);
@@ -746,11 +936,13 @@ int run(const cli::Cli& args) {
         log::counter_set("informer_objects", static_cast<uint64_t>(objs->as_int()));
       }
       log::counter_set("informer_synced", healthy ? 1 : 0);
+      log::counter_set("informer_staleness_seconds",
+                       static_cast<uint64_t>(std::max<int64_t>(watch_cache->staleness_secs(), 0)));
     }
     last_cycle_failed = false;
     try {
       CycleStats stats = run_cycle(args, query, kube, enabled, [&](ScaleTarget t) {
-        queue.push(std::move(t));
+        queue.push({std::move(t), audit::current_cycle()});
       }, watch_cache.get());
       consecutive_failures = 0;
       log::counter_add("query_successes", 1);
@@ -797,6 +989,10 @@ int run(const cli::Cli& args) {
   }
   queue.close();
   for (std::thread& c : consumers) c.join();
+  // Targets enqueued but never consumed (close() dropped them) leave
+  // pending DecisionRecords — land them with an honest terminal code so
+  // the audit trail never silently loses a decision.
+  audit::finalize_all_pending(audit::Reason::ShutdownAborted);
   if (notifier.joinable()) {
     // Consumers are done, so no new notifications arrive; drain what's
     // queued (bounded: cap x 5s worst case, usually zero) and stop.

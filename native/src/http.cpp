@@ -358,7 +358,8 @@ void establish_tunnel(Conn& conn, const Url& target, const ProxyTarget& proxy,
 // proxy knows the upstream; tunneled https and direct connections keep
 // origin-form.
 std::string build_request_message(const Request& req, const Url& url,
-                                  const std::optional<ProxyTarget>& proxy) {
+                                  const std::optional<ProxyTarget>& proxy,
+                                  const std::string& traceparent = "") {
   std::string request_target = url.target;
   if (proxy && url.scheme == "http") {
     request_target = "http://" + url.host +
@@ -372,11 +373,15 @@ std::string build_request_message(const Request& req, const Url& url,
     msg += "Proxy-Authorization: " + proxy->basic_auth + "\r\n";
   }
   bool has_ua = false;
+  bool has_traceparent = false;
   for (const auto& [k, v] : req.headers) {
     msg += k + ": " + v + "\r\n";
-    if (util::to_lower(k) == "user-agent") has_ua = true;
+    std::string lk = util::to_lower(k);
+    if (lk == "user-agent") has_ua = true;
+    if (lk == "traceparent") has_traceparent = true;
   }
   if (!has_ua) msg += "User-Agent: tpu-pruner/0.1\r\n";
+  if (!has_traceparent && !traceparent.empty()) msg += "traceparent: " + traceparent + "\r\n";
   if (!req.body.empty() || req.method == "POST" || req.method == "PATCH" || req.method == "PUT") {
     msg += "Content-Length: " + std::to_string(req.body.size()) + "\r\n";
   }
@@ -465,6 +470,29 @@ Client::Client(Client&& other) noexcept
     : tls_mode_(other.tls_mode_), ca_file_(std::move(other.ca_file_)) {
   std::lock_guard<std::mutex> lock(other.pool_mutex_);
   pool_ = std::move(other.pool_);
+  std::lock_guard<std::mutex> tp_lock(other.traceparent_mutex_);
+  default_traceparent_ = std::move(other.default_traceparent_);
+}
+
+namespace {
+thread_local std::string t_traceparent;
+}  // namespace
+
+void set_thread_traceparent(std::string tp) { t_traceparent = std::move(tp); }
+const std::string& thread_traceparent() { return t_traceparent; }
+
+void Client::set_default_traceparent(std::string tp) const {
+  std::lock_guard<std::mutex> lock(traceparent_mutex_);
+  default_traceparent_ = std::move(tp);
+}
+
+std::string Client::resolved_traceparent(const Request& req) const {
+  for (const auto& [k, v] : req.headers) {
+    if (util::to_lower(k) == "traceparent") return "";  // explicit header wins
+  }
+  if (!t_traceparent.empty()) return t_traceparent;
+  std::lock_guard<std::mutex> lock(traceparent_mutex_);
+  return default_traceparent_;
 }
 
 Response Client::request(const Request& req) const {
@@ -504,7 +532,7 @@ Response Client::request_once(const Request& req, const Url& url, bool allow_reu
     conn = open_fresh_conn(url, proxy, req.timeout_ms, tls_mode_, ca_file_);
   }
   conn->set_timeout(req.timeout_ms);
-  std::string msg = build_request_message(req, url, proxy);
+  std::string msg = build_request_message(req, url, proxy, resolved_traceparent(req));
 
   // Wire log under its own module so production debugging can do
   // `TPU_PRUNER_LOG=info,http=trace` (or the inverse: silence it with
@@ -624,7 +652,7 @@ Response Client::request_stream(const Request& req,
       open_fresh_conn(*url, proxy, req.timeout_ms, tls_mode_, ca_file_);
   conn->set_timeout(req.timeout_ms);
 
-  std::string msg = build_request_message(req, *url, proxy);
+  std::string msg = build_request_message(req, *url, proxy, resolved_traceparent(req));
   conn->write_all(msg.data(), msg.size());
 
   Response resp;

@@ -1,5 +1,6 @@
 #include "tpupruner/informer.hpp"
 
+#include <algorithm>
 #include <chrono>
 #include <functional>
 
@@ -134,6 +135,7 @@ void Reflector::apply_list(const Value& list) {
     stats_.resource_version = rv;
   }
   synced_.store(true);
+  last_activity_mono_.store(util::mono_secs());
   log::counter_add("informer_relists", 1);
 }
 
@@ -190,6 +192,7 @@ bool Reflector::apply_event(const Value& event) {
     return true;
   }
   if (!rv.empty()) resource_version_ = rv;
+  last_activity_mono_.store(util::mono_secs());
   return true;
 }
 
@@ -346,6 +349,19 @@ std::optional<Value> ClusterCache::get(const std::string& object_path) const {
   const Reflector* r = route(object_path);
   if (!r || !r->synced()) return std::nullopt;
   return r->get(object_path);
+}
+
+int64_t ClusterCache::staleness_secs() const {
+  int64_t now = util::mono_secs();
+  int64_t worst = 0;
+  for (const auto& r : reflectors_) {
+    int64_t last = r->last_activity_mono();
+    // A reflector that never applied anything is as stale as the process
+    // is old — report since-start rather than pretending freshness.
+    int64_t age = last == 0 ? now : now - last;
+    worst = std::max(worst, age);
+  }
+  return worst;
 }
 
 Value ClusterCache::stats_json() const {

@@ -1,5 +1,7 @@
 #include "tpupruner/log.hpp"
 
+#include <algorithm>
+#include <atomic>
 #include <cstdio>
 #include <mutex>
 
@@ -16,6 +18,21 @@ Level g_threshold = Level::Info;
 bool g_initialized = false;
 std::map<std::string, Counter> g_counters;
 std::map<std::string, Level, std::less<>> g_module_levels;
+
+// Cycle stamping: process-wide id set by the producer, thread override for
+// consumers still draining an earlier cycle. Lock-free reads — log lines
+// are emitted from every thread.
+std::atomic<uint64_t> g_cycle{0};
+thread_local uint64_t t_cycle = 0;
+
+uint64_t effective_cycle() { return t_cycle ? t_cycle : g_cycle.load(std::memory_order_relaxed); }
+
+// Histogram registry. Phase latencies span ~1ms (decode on a small fleet)
+// to tens of seconds (a slow-API cycle), hence the wide log-ish ladder.
+constexpr double kHistBounds[] = {0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                                  0.1,   0.25,   0.5,   1,    2.5,   5,
+                                  10,    30,     60};
+std::map<std::string, std::map<std::string, HistogramSnapshot>> g_histograms;
 
 Level parse_level(const std::string& s) {
   std::string l = util::to_lower(s);
@@ -116,6 +133,7 @@ void write(Level level, std::string_view module, const std::string& msg) {
   std::string target = "tpu_pruner";
   if (!module.empty()) target += "::" + std::string(module);
   std::string ts = util::now_rfc3339_micro();
+  uint64_t cycle = effective_cycle();
   switch (g_format) {
     case Format::Json: {
       json::Value v = json::Value::object();
@@ -123,21 +141,31 @@ void write(Level level, std::string_view module, const std::string& msg) {
       v.set("level", json::Value(util::to_lower(level_name(level))));
       v.set("fields", json::Value(json::Object{{"message", json::Value(msg)}}));
       v.set("target", json::Value(target));
+      if (cycle) v.set("cycle", json::Value(static_cast<int64_t>(cycle)));
       std::fprintf(stderr, "%s\n", v.dump().c_str());
       break;
     }
     case Format::Pretty:
-      std::fprintf(stderr, "  %s%s\x1b[0m %s\n    \x1b[90mat %s %s\x1b[0m\n",
-                   level_color(level), level_name(level), msg.c_str(), target.c_str(),
-                   ts.c_str());
+      std::fprintf(stderr, "  %s%s\x1b[0m %s%s\n    \x1b[90mat %s %s\x1b[0m\n",
+                   level_color(level), level_name(level), msg.c_str(),
+                   cycle ? (" cycle=" + std::to_string(cycle)).c_str() : "",
+                   target.c_str(), ts.c_str());
       break;
     case Format::Default:
-      std::fprintf(stderr, "%s %5s %s: %s\n", ts.c_str(), level_name(level), target.c_str(),
-                   msg.c_str());
+      if (cycle) {
+        std::fprintf(stderr, "%s %5s %s: %s cycle=%llu\n", ts.c_str(), level_name(level),
+                     target.c_str(), msg.c_str(), static_cast<unsigned long long>(cycle));
+      } else {
+        std::fprintf(stderr, "%s %5s %s: %s\n", ts.c_str(), level_name(level), target.c_str(),
+                     msg.c_str());
+      }
       break;
   }
   std::fflush(stderr);
 }
+
+void set_cycle(uint64_t cycle) { g_cycle.store(cycle, std::memory_order_relaxed); }
+void set_thread_cycle(uint64_t cycle) { t_cycle = cycle; }
 
 void counter_add(const std::string& name, uint64_t delta) {
   std::lock_guard<std::mutex> lock(g_mutex);
@@ -161,6 +189,34 @@ std::map<std::string, Counter> counters_snapshot() {
 void counters_reset_for_test() {
   std::lock_guard<std::mutex> lock(g_mutex);
   g_counters.clear();
+}
+
+void histogram_observe(const std::string& family, const std::string& phase, double value,
+                       const std::string& exemplar_trace_id) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  HistogramSnapshot& h = g_histograms[family][phase];
+  if (h.bounds.empty()) {
+    h.bounds.assign(std::begin(kHistBounds), std::end(kHistBounds));
+    h.buckets.assign(h.bounds.size() + 1, 0);
+    h.exemplars.assign(h.bounds.size() + 1, {});
+  }
+  size_t idx = std::lower_bound(h.bounds.begin(), h.bounds.end(), value) - h.bounds.begin();
+  ++h.buckets[idx];
+  if (!exemplar_trace_id.empty()) {
+    h.exemplars[idx] = {exemplar_trace_id, value, util::now_unix(), true};
+  }
+  h.sum += value;
+  ++h.count;
+}
+
+std::map<std::string, std::map<std::string, HistogramSnapshot>> histograms_snapshot() {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  return g_histograms;
+}
+
+void histograms_reset_for_test() {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  g_histograms.clear();
 }
 
 }  // namespace tpupruner::log

@@ -6,13 +6,57 @@
 #include <unistd.h>
 
 #include <cstring>
+#include <map>
 #include <stdexcept>
 #include <string>
 #include <string_view>
 
 #include "tpupruner/log.hpp"
+#include "tpupruner/util.hpp"
 
 namespace tpupruner::metrics_http {
+
+namespace {
+
+// HELP text per metric (the suffix-free family name). Every name served
+// here must also appear in docs/OPERATIONS.md — tests/test_docs_drift.py
+// enforces it, so adding a metric without documenting it fails CI.
+const std::map<std::string, std::string>& help_texts() {
+  static const std::map<std::string, std::string> kHelp = {
+      {"query_successes", "Evaluation cycles whose Prometheus query succeeded"},
+      {"query_failures", "Evaluation cycles whose Prometheus query failed"},
+      {"scale_successes", "Scale-down patches that landed"},
+      {"scale_failures", "Scale-down actuations that threw"},
+      {"scale_noops", "Actuations skipped because the root was already paused"},
+      {"scale_deferred", "Targets deferred by the --max-scale-per-cycle circuit breaker"},
+      {"query_returned_candidates", "Unique candidate pods in the last cycle's query result"},
+      {"query_returned_shutdown_events", "Root objects surviving all gates last cycle"},
+      {"cycle_resolution_api_calls", "K8s API requests issued by the last cycle's resolution"},
+      {"cycle_noop_targets", "Already-paused no-op targets in the last cycle"},
+      {"informer_objects", "Objects held in the watch-backed cluster store"},
+      {"informer_synced", "1 when every watched resource is synced, else 0"},
+      {"informer_relists", "Full relists performed by the watch cache (410/backoff)"},
+      {"informer_watch_failures", "Watch stream failures observed by the cache"},
+      {"informer_staleness_seconds", "Seconds since the watch cache last applied an event or list"},
+      {"cycle_phase_seconds", "Per-cycle pipeline phase latency (phase label: "
+                              "query, decode, resolve, actuate, total)"},
+      {"scale_patch_seconds", "Per-target actuation latency (Event POST + pause PATCH)"},
+  };
+  return kHelp;
+}
+
+std::string help_for(const std::string& name) {
+  auto it = help_texts().find(name);
+  return it != help_texts().end() ? it->second : "tpu-pruner operational metric";
+}
+
+std::string fmt_double(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%.9g", v);
+  return buf;
+}
+
+}  // namespace
 
 Server::Server(int port) {
   listen_fd_ = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
@@ -50,6 +94,60 @@ void Server::set_health_probe(std::function<bool()> probe) {
   probe_ = std::move(probe);
 }
 
+void Server::set_ready_probe(std::function<bool()> probe) {
+  std::lock_guard<std::mutex> lock(probe_mutex_);
+  ready_probe_ = std::move(probe);
+}
+
+void Server::set_decisions_provider(std::function<std::string(const std::string&)> provider) {
+  std::lock_guard<std::mutex> lock(probe_mutex_);
+  decisions_provider_ = std::move(provider);
+}
+
+std::string Server::render_exposition(bool openmetrics) const {
+  // Counters/gauges, then histograms. Classic text format (0.0.4) keeps
+  // the established names byte-for-byte; the OpenMetrics negotiation adds
+  // bucket exemplars (`# {trace_id="..."}`) so a histogram point links
+  // back to its cycle's OTLP trace — exemplars are only legal there, a
+  // 0.0.4 parser would reject the suffix. Counters render as `unknown`
+  // under OpenMetrics: the spec reserves `counter` for `_total`-suffixed
+  // names and renaming between negotiations would break dashboards.
+  std::string body = "# tpu-pruner operational counters\n";
+  for (const auto& [name, counter] : log::counters_snapshot()) {
+    std::string metric = "tpu_pruner_" + name;
+    const char* type = counter.gauge ? "gauge" : (openmetrics ? "unknown" : "counter");
+    body += "# HELP " + metric + " " + help_for(name) + "\n";
+    body += "# TYPE " + metric + " " + std::string(type) + "\n";
+    body += metric + " " + std::to_string(counter.value) + "\n";
+  }
+  for (const auto& [family, phases] : log::histograms_snapshot()) {
+    std::string metric = "tpu_pruner_" + family;
+    body += "# HELP " + metric + " " + help_for(family) + "\n";
+    body += "# TYPE " + metric + " histogram\n";
+    for (const auto& [phase, h] : phases) {
+      std::string label_prefix = phase.empty() ? "" : "phase=\"" + phase + "\",";
+      std::string bare_label = phase.empty() ? "" : "{phase=\"" + phase + "\"}";
+      uint64_t cum = 0;
+      for (size_t i = 0; i <= h.bounds.size(); ++i) {
+        cum += h.buckets[i];
+        std::string le = i < h.bounds.size() ? fmt_double(h.bounds[i]) : "+Inf";
+        body += metric + "_bucket{" + label_prefix + "le=\"" + le + "\"} " +
+                std::to_string(cum);
+        if (openmetrics && h.exemplars[i].set) {
+          const auto& ex = h.exemplars[i];
+          body += " # {trace_id=\"" + ex.trace_id + "\"} " + fmt_double(ex.value) + " " +
+                  std::to_string(ex.ts_unix);
+        }
+        body += "\n";
+      }
+      body += metric + "_sum" + bare_label + " " + fmt_double(h.sum) + "\n";
+      body += metric + "_count" + bare_label + " " + std::to_string(h.count) + "\n";
+    }
+  }
+  if (openmetrics) body += "# EOF\n";
+  return body;
+}
+
 void Server::serve() {
   while (!stop_.load()) {
     struct pollfd pfd{listen_fd_, POLLIN, 0};
@@ -57,11 +155,9 @@ void Server::serve() {
     if (rc <= 0) continue;
     int fd = ::accept(listen_fd_, nullptr, nullptr);
     if (fd < 0) continue;
-    // Read until the request line is complete (a probe's first TCP segment
-    // may split mid-line), bounded by the buffer and the 1s socket timeout.
-    // /healthz (exact path, query string allowed) answers probes; any
-    // other GET gets the metrics exposition.
-    char buf[2048];
+    // Read until the header block is complete (probes may split segments
+    // mid-line), bounded by the buffer and the 1s socket timeout.
+    char buf[8192];
     struct timeval tv{1, 0};
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     size_t have = 0;
@@ -69,36 +165,89 @@ void Server::serve() {
       ssize_t n = ::recv(fd, buf + have, sizeof(buf) - 1 - have, 0);
       if (n <= 0) break;
       have += static_cast<size_t>(n);
-      if (std::memchr(buf, '\n', have)) break;  // request line complete
+      buf[have] = '\0';
+      if (std::strstr(buf, "\r\n\r\n") || std::strstr(buf, "\n\n")) break;
     }
     buf[have] = '\0';
-    bool healthz = false;
-    if (std::strncmp(buf, "GET ", 4) == 0) {
-      const char* path = buf + 4;
-      size_t len = std::strcspn(path, " ?\r\n");
-      healthz = std::string_view(path, len) == "/healthz";
+
+    std::string path, query;
+    bool is_get = std::strncmp(buf, "GET ", 4) == 0;
+    if (is_get) {
+      const char* start = buf + 4;
+      size_t len = std::strcspn(start, " \r\n");
+      std::string_view target(start, len);
+      size_t qpos = target.find('?');
+      path = std::string(target.substr(0, qpos == std::string_view::npos ? len : qpos));
+      if (qpos != std::string_view::npos) query = std::string(target.substr(qpos + 1));
+    }
+    // Accept header (case-insensitive name), for the OpenMetrics negotiation.
+    bool want_openmetrics = false;
+    {
+      std::string lower = util::to_lower(std::string_view(buf, have));
+      size_t pos = lower.find("\naccept:");
+      if (pos != std::string::npos) {
+        size_t end = lower.find_first_of("\r\n", pos + 1);
+        std::string accept = lower.substr(pos + 8, end - pos - 8);
+        want_openmetrics = accept.find("application/openmetrics-text") != std::string::npos;
+      }
     }
 
     std::string body;
     std::string content_type = "text/plain";
-    bool healthy = true;
-    if (healthz) {
+    int status = 200;
+    std::string status_text = "OK";
+    if (!is_get) {
+      status = 405;
+      status_text = "Method Not Allowed";
+      body = "only GET is served\n";
+    } else if (path == "/healthz") {
+      bool healthy = true;
       {
         std::lock_guard<std::mutex> lock(probe_mutex_);
         if (probe_) healthy = probe_();
       }
-      body = healthy ? "ok\n" : "stalled: no completed cycle within the staleness window\n";
-    } else {
-      content_type = "text/plain; version=0.0.4";
-      body = "# tpu-pruner operational counters\n";
-      for (const auto& [name, counter] : log::counters_snapshot()) {
-        std::string metric = "tpu_pruner_" + name;
-        body += "# TYPE " + metric + (counter.gauge ? " gauge\n" : " counter\n");
-        body += metric + " " + std::to_string(counter.value) + "\n";
+      if (healthy) {
+        body = "ok\n";
+      } else {
+        status = 503;
+        status_text = "Service Unavailable";
+        body = "stalled: no completed cycle within the staleness window\n";
       }
+    } else if (path == "/readyz") {
+      bool ready = true;
+      {
+        std::lock_guard<std::mutex> lock(probe_mutex_);
+        if (ready_probe_) ready = ready_probe_();
+      }
+      if (ready) {
+        body = "ok\n";
+      } else {
+        status = 503;
+        status_text = "Service Unavailable";
+        body = "not ready: watch cache not synced\n";
+      }
+    } else if (path == "/debug/decisions") {
+      std::function<std::string(const std::string&)> provider;
+      {
+        std::lock_guard<std::mutex> lock(probe_mutex_);
+        provider = decisions_provider_;
+      }
+      if (provider) {
+        content_type = "application/json";
+        body = provider(query);
+      } else {
+        status = 404;
+        status_text = "Not Found";
+        body = "decision audit trail not enabled\n";
+      }
+    } else {
+      content_type = want_openmetrics
+                         ? "application/openmetrics-text; version=1.0.0; charset=utf-8"
+                         : "text/plain; version=0.0.4";
+      body = render_exposition(want_openmetrics);
     }
-    std::string status_line = healthy ? "HTTP/1.1 200 OK" : "HTTP/1.1 503 Service Unavailable";
-    std::string resp = status_line + "\r\nContent-Type: " + content_type +
+    std::string resp = "HTTP/1.1 " + std::to_string(status) + " " + status_text +
+                       "\r\nContent-Type: " + content_type +
                        "\r\nContent-Length: " + std::to_string(body.size()) +
                        "\r\nConnection: close\r\n\r\n" + body;
     ::send(fd, resp.data(), resp.size(), MSG_NOSIGNAL);

@@ -1,14 +1,18 @@
-// Minimal Prometheus /metrics exposition server (internal).
+// Minimal Prometheus /metrics + debug HTTP server (internal).
 //
 // The reference pushes its six operational counters over OTLP when built
 // with the `otel` feature (main.rs:138-155, 194-271). Pull-based /metrics
 // is the idiomatic GKE shape (PodMonitoring scrapes it), so the daemon
-// serves the same counter names as a text exposition instead.
+// serves the counter names as a text exposition instead — now alongside
+// phase-latency histograms (with OTLP trace-id exemplars under OpenMetrics
+// content negotiation), a /readyz informer-sync probe distinct from the
+// /healthz liveness stamp, and the /debug/decisions audit-trail endpoint.
 #pragma once
 
 #include <atomic>
 #include <functional>
 #include <mutex>
+#include <string>
 #include <thread>
 
 namespace tpupruner::metrics_http {
@@ -26,12 +30,25 @@ class Server {
   // probe — process death alone K8s already handles; hangs it cannot see.
   void set_health_probe(std::function<bool()> probe);
 
+  // Readiness seam (/readyz): reflects informer sync state — a daemon
+  // whose watch cache is mid-relist is alive (healthz 200) but should not
+  // be Ready until lookups serve from the store again. Unset → always 200.
+  void set_ready_probe(std::function<bool()> probe);
+
+  // /debug/decisions provider: receives the raw query string ("pod=ns/x")
+  // and returns the JSON body. Unset → 404.
+  void set_decisions_provider(std::function<std::string(const std::string&)> provider);
+
  private:
   void serve();
+  std::string render_exposition(bool openmetrics) const;
+
   int listen_fd_ = -1;
   int port_ = 0;
   std::atomic<bool> stop_{false};
   std::function<bool()> probe_;
+  std::function<bool()> ready_probe_;
+  std::function<std::string(const std::string&)> decisions_provider_;
   std::mutex probe_mutex_;
   std::thread thread_;
 };

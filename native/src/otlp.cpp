@@ -66,6 +66,12 @@ bool recording() { return g_recording.load(std::memory_order_relaxed); }
 void set_recording_for_test(bool on) { g_recording.store(on); }
 std::vector<FinishedSpan> drain_spans_for_test() { return drain_spans(); }
 
+std::string traceparent(const SpanContext& ctx) {
+  if (ctx.trace_id.empty() || ctx.span_id.empty()) return "";
+  // version 00, sampled flag 01 (these spans are all exported).
+  return "00-" + ctx.trace_id + "-" + ctx.span_id + "-01";
+}
+
 Span::Span(std::string name, const SpanContext* parent) : enabled_(recording()) {
   if (!enabled_) return;
   rec_.name = std::move(name);

@@ -71,6 +71,12 @@ bool recording();                        // true while an Exporter is live
 void set_recording_for_test(bool on);    // test hook
 std::vector<FinishedSpan> drain_spans_for_test();
 
+// W3C trace-context header value ("00-<trace>-<span>-01") for a span
+// context, or "" when the context is empty (recording off) — callers hand
+// it to http::Client::set_default_traceparent / set_thread_traceparent so
+// outbound Prometheus and K8s API requests correlate with the OTLP trace.
+std::string traceparent(const SpanContext& ctx);
+
 class Exporter {
  public:
   // `endpoint` is the OTLP base (e.g. http://collector:4318); metrics go

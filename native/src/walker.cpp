@@ -263,16 +263,25 @@ size_t prefetch_owner_chains(const k8s::Client& client, FetchCache& cache,
 }
 
 ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchCache* cache,
-                             const informer::ClusterCache* store) {
+                             const informer::ClusterCache* store,
+                             std::vector<std::string>* chain_out) {
   std::string ns = pod_ns(pod);
   std::string pod_name = pod.at_path("metadata.name") ? pod.at_path("metadata.name")->as_string()
                                                       : "<unnamed>";
+  // Audit hop trail ("Kind/ns/name", pod first) — feeds
+  // DecisionRecord.owner_chain so an operator can see exactly which chain
+  // a verdict walked, including hops that turned out not to be the root.
+  auto hop = [&](std::string_view kind, const std::string& name) {
+    if (chain_out) chain_out->push_back(std::string(kind) + "/" + ns + "/" + name);
+  };
+  hop("Pod", pod_name);
 
   // kserve shortcut: serving pods carry the InferenceService name as a
   // label — skip the ownerRef chain entirely (lib.rs:448-456).
   if (const Value* labels = pod.at_path("metadata.labels"); labels && labels->is_object()) {
     const Value* ks = labels->find("serving.kserve.io/inferenceservice");
     if (ks && ks->is_string()) {
+      hop("InferenceService", ks->as_string());
       return fetch_must(client, cache, store, Kind::InferenceService, ns, ks->as_string());
     }
     // LWS shortcut: EVERY pod of a LeaderWorkerSet (leader and worker)
@@ -281,6 +290,7 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
     // LWS object) — the label is the only uniform path to the root.
     const Value* lws = labels->find("leaderworkerset.sigs.k8s.io/name");
     if (lws && lws->is_string()) {
+      hop("LeaderWorkerSet", lws->as_string());
       return fetch_must(client, cache, store, Kind::LeaderWorkerSet, ns, lws->as_string());
     }
   }
@@ -293,19 +303,24 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
 
       if (kind == "ReplicaSet") {
         if (auto rs = fetch(client, cache, store, Kind::ReplicaSet, ns, name)) {
+          hop("ReplicaSet", name);
           if (const Value* dep_or = owner_of_kind(rs->object, "Deployment")) {
+            hop("Deployment", dep_or->get_string("name"));
             return fetch_must(client, cache, store, Kind::Deployment, ns, dep_or->get_string("name"));
           }
           return std::move(*rs);  // ReplicaSet with no Deployment owner
         }
       } else if (kind == "StatefulSet") {
         if (auto ss = fetch(client, cache, store, Kind::StatefulSet, ns, name)) {
+          hop("StatefulSet", name);
           if (const Value* nb_or = owner_of_kind(ss->object, "Notebook")) {
+            hop("Notebook", nb_or->get_string("name"));
             return fetch_must(client, cache, store, Kind::Notebook, ns, nb_or->get_string("name"));
           }
           // Multi-host serving groups: LWS creates one StatefulSet per
           // replica group; the LeaderWorkerSet is the scalable root.
           if (const Value* lws_or = owner_of_kind(ss->object, "LeaderWorkerSet")) {
+            hop("LeaderWorkerSet", lws_or->get_string("name"));
             return fetch_must(client, cache, store, Kind::LeaderWorkerSet, ns,
                               lws_or->get_string("name"));
           }
@@ -322,7 +337,9 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
           log::warn("walker", "fetch Job " + ns + "/" + name + " failed: " + e.what());
         }
         if (job) {
+          hop("Job", name);
           if (const Value* js_or = owner_of_kind(*job, "JobSet")) {
+            hop("JobSet", js_or->get_string("name"));
             return fetch_must(client, cache, store, Kind::JobSet, ns, js_or->get_string("name"));
           }
           log::debug("walker", "pod " + ns + "/" + pod_name + ": bare Job owner '" + name +

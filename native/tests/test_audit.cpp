@@ -1,0 +1,131 @@
+// Audit-trail + histogram registry units (the in-process halves of the
+// decision audit layer; the e2e behavior rides tests/test_audit_trail.py).
+#include "testing.hpp"
+#include "tpupruner/audit.hpp"
+#include "tpupruner/json.hpp"
+#include "tpupruner/log.hpp"
+
+namespace audit = tpupruner::audit;
+namespace log_ = tpupruner::log;
+using tpupruner::json::Value;
+
+namespace {
+
+audit::DecisionRecord make_record(uint64_t cycle, const std::string& pod) {
+  audit::DecisionRecord r;
+  r.cycle = cycle;
+  r.ns = "ml";
+  r.pod = pod;
+  r.reason = audit::Reason::DryRun;
+  r.action = "none";
+  return r;
+}
+
+}  // namespace
+
+TP_TEST(audit_reason_codes_unique_and_stable) {
+  auto codes = audit::all_reason_codes();
+  TP_CHECK(codes.size() >= 20);
+  for (size_t i = 0; i < codes.size(); ++i) {
+    TP_CHECK(!codes[i].empty() && codes[i] != "?");
+    for (size_t j = i + 1; j < codes.size(); ++j) TP_CHECK(codes[i] != codes[j]);
+  }
+  TP_CHECK_EQ(codes.front(), std::string("SCALED"));
+  TP_CHECK_EQ(codes.back(), std::string("SHUTDOWN_ABORTED"));
+}
+
+TP_TEST(audit_ring_serves_and_filters) {
+  audit::reset_for_test();
+  uint64_t cycle = audit::begin_cycle();
+  audit::record(make_record(cycle, "a"));
+  audit::record(make_record(cycle, "b"));
+
+  Value all = audit::decisions_json("");
+  TP_CHECK_EQ(all.find("decisions")->as_array().size(), size_t{2});
+  Value one = audit::decisions_json("pod=ml/a");
+  TP_CHECK_EQ(one.find("decisions")->as_array().size(), size_t{1});
+  TP_CHECK_EQ(one.find("decisions")->as_array()[0].get_string("pod"), std::string("a"));
+  Value none = audit::decisions_json("namespace=other");
+  TP_CHECK_EQ(none.find("decisions")->as_array().size(), size_t{0});
+  audit::reset_for_test();
+}
+
+TP_TEST(audit_pending_finalize_applies_verdict) {
+  audit::reset_for_test();
+  uint64_t cycle = audit::begin_cycle();
+  audit::record_pending(make_record(cycle, "a"), "Deployment:uid1");
+  audit::record_pending(make_record(cycle, "b"), "Deployment:uid1");
+  // not visible until finalized
+  TP_CHECK_EQ(audit::decisions_json("").find("decisions")->as_array().size(), size_t{0});
+
+  audit::finalize(cycle, "Deployment:uid1", audit::Reason::Scaled, "scale_down");
+  Value out = audit::decisions_json("");
+  TP_CHECK_EQ(out.find("decisions")->as_array().size(), size_t{2});
+  for (const Value& d : out.find("decisions")->as_array()) {
+    TP_CHECK_EQ(d.get_string("reason"), std::string("SCALED"));
+    TP_CHECK_EQ(d.get_string("action"), std::string("scale_down"));
+  }
+  // unknown identity is a no-op, not a crash
+  audit::finalize(cycle, "nope", audit::Reason::Scaled, "scale_down");
+  audit::reset_for_test();
+}
+
+TP_TEST(audit_shutdown_drain_lands_pending) {
+  audit::reset_for_test();
+  uint64_t cycle = audit::begin_cycle();
+  audit::record_pending(make_record(cycle, "a"), "JobSet:uid2");
+  audit::finalize_all_pending(audit::Reason::ShutdownAborted);
+  Value out = audit::decisions_json("");
+  TP_CHECK_EQ(out.find("decisions")->as_array().size(), size_t{1});
+  TP_CHECK_EQ(out.find("decisions")->as_array()[0].get_string("reason"),
+              std::string("SHUTDOWN_ABORTED"));
+  audit::reset_for_test();
+}
+
+TP_TEST(histogram_observe_buckets_sum_count) {
+  log_::histograms_reset_for_test();
+  log_::histogram_observe("t_seconds", "query", 0.003, "abc");
+  log_::histogram_observe("t_seconds", "query", 0.02, "");
+  log_::histogram_observe("t_seconds", "query", 1000.0, "");  // over the top bound
+
+  auto snap = log_::histograms_snapshot();
+  const auto& h = snap.at("t_seconds").at("query");
+  TP_CHECK_EQ(h.count, uint64_t{3});
+  TP_CHECK(h.sum > 1000.0 && h.sum < 1000.1);
+  TP_CHECK_EQ(h.buckets.size(), h.bounds.size() + 1);
+  uint64_t total = 0;
+  for (uint64_t b : h.buckets) total += b;
+  TP_CHECK_EQ(total, uint64_t{3});
+  TP_CHECK_EQ(h.buckets.back(), uint64_t{1});  // the +Inf overflow landed alone
+  // 0.003 falls in the le=0.005 bucket (le is an inclusive upper bound)
+  size_t idx = 0;
+  while (idx < h.bounds.size() && h.bounds[idx] < 0.003) ++idx;
+  TP_CHECK_EQ(h.buckets[idx], uint64_t{1});
+  TP_CHECK(h.exemplars[idx].set);
+  TP_CHECK_EQ(h.exemplars[idx].trace_id, std::string("abc"));
+  log_::histograms_reset_for_test();
+}
+
+TP_TEST(decision_record_json_shape) {
+  audit::DecisionRecord r = make_record(7, "worker-0");
+  r.signal_metric = "tensorcore/duty_cycle";
+  r.signal_value = 0.0;
+  r.has_signal = true;
+  r.lookback_s = 2100;
+  r.owner_chain = {"Pod/ml/worker-0", "Job/ml/j", "JobSet/ml/slice"};
+  r.root_kind = "JobSet";
+  r.root_ns = "ml";
+  r.root_name = "slice";
+  r.trace_id = "cafe";
+  Value v = r.to_json();
+  TP_CHECK_EQ(v.find("cycle")->as_int(), int64_t{7});
+  TP_CHECK_EQ(v.get_string("reason"), std::string("DRY_RUN"));
+  TP_CHECK_EQ(v.find("signal")->get_string("metric"), std::string("tensorcore/duty_cycle"));
+  TP_CHECK_EQ(v.find("owner_chain")->as_array().size(), size_t{3});
+  TP_CHECK_EQ(v.find("root")->get_string("kind"), std::string("JobSet"));
+  TP_CHECK_EQ(v.get_string("trace_id"), std::string("cafe"));
+  // absent optionals stay absent (no "signal" when has_signal is false)
+  audit::DecisionRecord bare = make_record(1, "x");
+  TP_CHECK(!bare.to_json().find("signal"));
+  TP_CHECK(!bare.to_json().find("root"));
+}

@@ -1,0 +1,285 @@
+"""Decision audit trail e2e (the observability tentpole).
+
+Every candidate pod of a cycle must land a DecisionRecord with a stable
+machine-readable reason — including the pods the daemon deliberately did
+NOT touch ("why was pod Y not paused at 14:02" is the question the trail
+exists to answer). Covered here through the real binary against the fake
+apiserver/Prometheus: the --audit-log JSONL sink, /debug/decisions, the
+`analyze --explain` consumer, W3C traceparent propagation, and the cycle
+id stamped on log lines.
+"""
+
+import json
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+TRACEPARENT_RE = re.compile(r"^00-[0-9a-f]{32}-[0-9a-f]{16}-01$")
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def run_pruner(fake_prom, fake_k8s, *extra_args, check=True, timeout=60, env_extra=None):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--log-format", "json", *extra_args]
+    env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin"}
+    env.update(env_extra or {})
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout, env=env)
+    if check:
+        assert proc.returncode == 0, f"pruner failed:\n{proc.stdout}\n{proc.stderr}"
+    return proc
+
+
+def mixed_cluster(fake_prom, fake_k8s):
+    """One of everything the resolve gates distinguish."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=2)
+    for pod in pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml")
+    fake_k8s.add_pod("ml", "young", created_age=60)
+    fake_prom.add_idle_pod_series("young", "ml")
+    fake_prom.add_idle_pod_series("ghost", "ml")  # metric plane only
+    fake_k8s.add_job("ml", "one-off")
+    fake_k8s.add_pod("ml", "bare-job-0", owners=[fake_k8s.owner("Job", "one-off")])
+    fake_prom.add_idle_pod_series("bare-job-0", "ml")
+    # partial slice: 1 of 2 hosts idle → GROUP_NOT_IDLE
+    _, slice_pods = fake_k8s.add_jobset_slice("tpu-jobs", "half-idle", num_hosts=2)
+    fake_prom.add_idle_pod_series(slice_pods[0]["metadata"]["name"], "tpu-jobs")
+    return pods, slice_pods
+
+
+def load_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def by_pod(records):
+    return {(r["namespace"], r["pod"]): r for r in records}
+
+
+# ── acceptance: a dry-run cycle records every candidate with a reason ──
+
+
+def test_dry_run_records_every_candidate(built, fake_prom, fake_k8s, tmp_path):
+    pods, slice_pods = mixed_cluster(fake_prom, fake_k8s)
+    audit = tmp_path / "audit.jsonl"
+    run_pruner(fake_prom, fake_k8s, "--run-mode", "dry-run", "--audit-log", str(audit))
+
+    records = load_jsonl(audit)
+    recorded = by_pod(records)
+    # every pod the query returned has a record with a non-empty reason
+    expected = {("ml", p["metadata"]["name"]) for p in pods} | {
+        ("ml", "young"), ("ml", "ghost"), ("ml", "bare-job-0"),
+        ("tpu-jobs", slice_pods[0]["metadata"]["name"])}
+    assert set(recorded) == expected
+    assert all(r["reason"] for r in records)
+
+    for pod in pods:
+        r = recorded[("ml", pod["metadata"]["name"])]
+        assert r["reason"] == "DRY_RUN"
+        assert r["action"] == "none"
+        assert r["root"] == {"kind": "Deployment", "namespace": "ml", "name": "trainer"}
+        assert r["owner_chain"][0].startswith("Pod/ml/")
+        assert r["owner_chain"][-1] == "Deployment/ml/trainer"
+        assert r["lookback_s"] == 30 * 60 + 300
+        assert r["signal"]["metric"] == "tensorcore/duty_cycle"
+        assert r["signal"]["value"] == 0
+    assert recorded[("ml", "young")]["reason"] == "BELOW_MIN_AGE"
+    assert recorded[("ml", "ghost")]["reason"] == "POD_GONE"
+    assert recorded[("ml", "bare-job-0")]["reason"] == "NO_SCALABLE_OWNER"
+    group = recorded[("tpu-jobs", slice_pods[0]["metadata"]["name"])]
+    assert group["reason"] == "GROUP_NOT_IDLE"
+    assert group["root"]["kind"] == "JobSet"
+    # all records of one single-shot run share one cycle id
+    assert {r["cycle"] for r in records} == {1}
+
+
+def test_scale_down_records_scaled_and_opt_out_reasons(built, fake_prom, fake_k8s, tmp_path):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=1)
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    dep, _, vet_pods = fake_k8s.add_deployment_chain("ml", "protected", num_pods=2)
+    vet_pods[0]["metadata"]["annotations"] = {"tpu-pruner.dev/skip": "true"}
+    for pod in vet_pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml")
+    audit = tmp_path / "audit.jsonl"
+    run_pruner(fake_prom, fake_k8s, "--run-mode", "scale-down",
+               "--audit-log", str(audit))
+
+    recorded = by_pod(load_jsonl(audit))
+    scaled = recorded[("ml", pods[0]["metadata"]["name"])]
+    assert scaled["reason"] == "SCALED"
+    assert scaled["action"] == "scale_down"
+    assert recorded[("ml", vet_pods[0]["metadata"]["name"])]["reason"] == "OPTED_OUT"
+    sibling = recorded[("ml", vet_pods[1]["metadata"]["name"])]
+    assert sibling["reason"] == "VETOED_BY_ANNOTATED_POD"
+    assert sibling["action"] == "none"
+    # the protected deployment was indeed untouched
+    assert fake_k8s.objects["/apis/apps/v1/namespaces/ml/deployments/protected"][
+        "spec"]["replicas"] == 2
+
+
+def test_deferred_and_root_opt_out_reasons(built, fake_prom, fake_k8s, tmp_path):
+    for i in range(3):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    dep, _, rpods = fake_k8s.add_deployment_chain("ml", "keep")
+    dep["metadata"]["annotations"] = {"tpu-pruner.dev/skip": "true"}
+    fake_prom.add_idle_pod_series(rpods[0]["metadata"]["name"], "ml")
+    audit = tmp_path / "audit.jsonl"
+    run_pruner(fake_prom, fake_k8s, "--run-mode", "scale-down",
+               "--max-scale-per-cycle", "1", "--audit-log", str(audit))
+
+    records = load_jsonl(audit)
+    reasons = sorted(r["reason"] for r in records)
+    assert reasons.count("SCALED") == 1
+    assert reasons.count("DEFERRED") == 2
+    assert reasons.count("ROOT_OPTED_OUT") == 1
+
+
+def test_cycle_id_stamps_log_lines_and_joins_records(built, fake_prom, fake_k8s, tmp_path):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    audit = tmp_path / "audit.jsonl"
+    proc = run_pruner(fake_prom, fake_k8s, "--run-mode", "scale-down",
+                      "--audit-log", str(audit))
+
+    cycles = {r["cycle"] for r in load_jsonl(audit)}
+    assert cycles == {1}
+    stamped = [json.loads(line) for line in proc.stderr.splitlines()
+               if line.startswith("{") and '"cycle"' in line]
+    assert stamped, proc.stderr
+    # the per-cycle lines carry the SAME id the records carry
+    assert {line["cycle"] for line in stamped} == {1}
+    # the eligibility log line joins against the record without timestamps
+    assert any("idle and eligible" in line["fields"]["message"] for line in stamped)
+
+
+# ── /debug/decisions + analyze --explain (both retrieval paths) ──
+
+
+def daemon_with_metrics(fake_prom, fake_k8s, *extra):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "scale-down", "--daemon-mode", "--check-interval", "60",
+           "--metrics-port", "auto", *extra]
+    env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin"}
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    port = None
+    for line in proc.stderr:
+        m = re.search(r"serving /metrics on port (\d+)", line)
+        if m:
+            port = int(m.group(1))
+            break
+    assert port
+    return proc, port
+
+
+def test_explain_reads_debug_decisions_endpoint(built, fake_prom, fake_k8s):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    pod_name = pods[0]["metadata"]["name"]
+    fake_prom.add_idle_pod_series(pod_name, "ml")
+    proc, port = daemon_with_metrics(fake_prom, fake_k8s)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and not fake_k8s.scale_patches():
+            time.sleep(0.2)
+        time.sleep(0.5)  # let the consumer finalize the record
+        out = subprocess.run(
+            [sys.executable, "-m", "tpu_pruner.analyze", "--explain",
+             f"ml/{pod_name}", "--decisions-url", f"http://127.0.0.1:{port}"],
+            capture_output=True, text=True, timeout=60,
+            env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": str(DAEMON_PATH.parent.parent)})
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout)
+        assert doc["pod"] == pod_name
+        assert doc["decisions"][0]["reason"] == "SCALED"
+        assert "SCALED" in out.stderr  # human history on stderr
+        assert "Deployment/ml/trainer" in out.stderr
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_explain_reads_audit_log(built, fake_prom, fake_k8s, tmp_path):
+    pods, _ = mixed_cluster(fake_prom, fake_k8s)
+    audit = tmp_path / "audit.jsonl"
+    run_pruner(fake_prom, fake_k8s, "--run-mode", "dry-run", "--audit-log", str(audit))
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu_pruner.analyze", "--explain", "ml/young",
+         "--audit-log", str(audit)],
+        capture_output=True, text=True, timeout=60,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(DAEMON_PATH.parent.parent)})
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert [d["reason"] for d in doc["decisions"]] == ["BELOW_MIN_AGE"]
+    assert "BELOW_MIN_AGE" in out.stderr
+    # a pod with no records is a clean empty answer, not an error
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu_pruner.analyze", "--explain", "ml/absent",
+         "--audit-log", str(audit)],
+        capture_output=True, text=True, timeout=60,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(DAEMON_PATH.parent.parent)})
+    assert out.returncode == 0
+    assert json.loads(out.stdout)["decisions"] == []
+    assert "no decisions recorded" in out.stderr
+
+
+# ── W3C traceparent propagation ──
+
+
+def test_traceparent_on_prometheus_and_k8s_requests(built, fake_prom, fake_k8s):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    # recording on: exporter active (endpoint unreachable; export failure is
+    # log-only) so spans carry real ids
+    run_pruner(fake_prom, fake_k8s, "--run-mode", "scale-down",
+               env_extra={"OTEL_EXPORTER_OTLP_ENDPOINT": "http://127.0.0.1:9"})
+
+    assert len(fake_prom.traceparents) == 1
+    tp = fake_prom.traceparents[0]
+    assert tp and TRACEPARENT_RE.match(tp), tp
+    cycle_trace = tp.split("-")[1]
+
+    k8s_tps = [t for t in fake_k8s.traceparents if t]
+    assert k8s_tps, "no traceparent on any K8s API request"
+    assert all(TRACEPARENT_RE.match(t) for t in k8s_tps)
+    # resolution-phase requests carry the cycle trace; the actuation PATCH
+    # carries its own `scale` root span's trace (separate trace by design)
+    traces = {t.split("-")[1] for t in k8s_tps}
+    assert cycle_trace in traces
+    patch_idx = [i for i, (m, _) in enumerate(fake_k8s.requests) if m == "PATCH"]
+    assert patch_idx
+    patch_tp = fake_k8s.traceparents[patch_idx[0]]
+    assert patch_tp and TRACEPARENT_RE.match(patch_tp)
+    assert patch_tp.split("-")[1] != cycle_trace
+
+
+def test_no_traceparent_when_telemetry_disabled(built, fake_prom, fake_k8s):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    run_pruner(fake_prom, fake_k8s, "--run-mode", "scale-down")
+    assert fake_prom.traceparents == [None]
+    assert all(t is None for t in fake_k8s.traceparents)

@@ -1,0 +1,85 @@
+"""Docs drift guard: served metric names and emitted reason codes must be
+documented in docs/OPERATIONS.md.
+
+An operator debugging "why was pod X paused" greps the runbook for the
+reason code in front of them; a metric on a dashboard with no runbook
+entry is a dead end. This test makes an undocumented metric name or
+DecisionRecord reason code a test failure, so the lists can only grow
+together with their documentation.
+"""
+
+import re
+import subprocess
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+OPERATIONS = Path(__file__).resolve().parent.parent / "docs" / "OPERATIONS.md"
+
+
+def test_every_reason_code_documented(built):
+    doc = OPERATIONS.read_text()
+    codes = native.audit_reason_codes()
+    assert len(codes) >= 15  # the canonical list is non-trivial
+    missing = [c for c in codes if c not in doc]
+    assert not missing, (
+        f"DecisionRecord reason codes missing from docs/OPERATIONS.md: {missing} "
+        "— document each code in the 'Explaining a decision' section")
+
+
+def test_every_served_metric_documented(built):
+    """Scrape the real daemon after a full scale-down cycle and check every
+    family name on /metrics (histograms included) against OPERATIONS.md."""
+    prom = FakePrometheus()
+    prom.start()
+    k8s = FakeK8s()
+    k8s.start()
+    proc = None
+    try:
+        _, _, pods = k8s.add_deployment_chain("ml", "trainer")
+        prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+        cmd = [str(DAEMON_PATH), "--prometheus-url", prom.url,
+               "--run-mode", "scale-down", "--daemon-mode",
+               "--check-interval", "60", "--metrics-port", "auto"]
+        proc = subprocess.Popen(
+            cmd, env={"KUBE_API_URL": k8s.url, "PATH": "/usr/bin:/bin"},
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+        port = None
+        for line in proc.stderr:
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port
+        deadline = time.time() + 30
+        body = ""
+        while time.time() < deadline:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+            if "tpu_pruner_scale_patch_seconds" in body:
+                break
+            time.sleep(0.2)
+        families = set()
+        for line in body.splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            name = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)", line).group(1)
+            families.add(re.sub(r"_(bucket|sum|count)$", "", name))
+        assert len(families) >= 8, body
+        doc = OPERATIONS.read_text()
+        missing = sorted(f for f in families if f not in doc)
+        assert not missing, (
+            f"metric names served on /metrics but missing from docs/OPERATIONS.md: "
+            f"{missing}")
+    finally:
+        if proc is not None:
+            proc.terminate()
+            proc.wait(timeout=10)
+        prom.stop()
+        k8s.stop()

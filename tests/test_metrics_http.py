@@ -1,0 +1,191 @@
+"""/metrics exposition contract tests (satellite of the observability PR).
+
+The exposition itself was previously untested: a malformed `# TYPE` line or
+a non-cumulative histogram bucket would ship silently and only break when a
+real Prometheus scraped it. These tests drive the REAL daemon binary (the
+in-process hermetic pipeline: fake Prometheus + fake K8s API) and assert
+the wire format: content type, HELP/TYPE pairs, histogram
+_bucket/_sum/_count well-formedness, per-cycle phase-count consistency,
+and the OpenMetrics negotiation that carries trace-id exemplars.
+"""
+
+import json
+import re
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+class MetricsDaemon:
+    """Daemon-mode run with --metrics-port auto; port parsed from stderr."""
+
+    def __init__(self, fake_prom, fake_k8s, *extra_args, env_extra=None):
+        cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+               "--run-mode", "scale-down", "--daemon-mode",
+               "--check-interval", "60", "--metrics-port", "auto", *extra_args]
+        env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin"}
+        env.update(env_extra or {})
+        self.proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                                     stderr=subprocess.PIPE, text=True)
+        self.port = None
+        for line in self.proc.stderr:
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                self.port = int(m.group(1))
+                break
+        assert self.port, "daemon never reported its metrics port"
+
+    def get(self, path, accept=None):
+        req = urllib.request.Request(f"http://127.0.0.1:{self.port}{path}")
+        if accept:
+            req.add_header("Accept", accept)
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.headers.get("Content-Type"), resp.read().decode()
+
+    def wait_for_cycle(self, timeout=30):
+        """Block until the first full cycle (incl. the actuate drain) is on
+        /metrics — all five phase _counts present and equal."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            _, _, body = self.get("/metrics")
+            counts = dict(re.findall(
+                r'tpu_pruner_cycle_phase_seconds_count\{phase="(\w+)"\} (\d+)', body))
+            if len(counts) == 5 and len(set(counts.values())) == 1 and "0" not in counts.values():
+                return body
+            time.sleep(0.2)
+        raise AssertionError(f"phase histograms never converged:\n{body}")
+
+    def stop(self):
+        self.proc.terminate()
+        self.proc.wait(timeout=10)
+
+
+@pytest.fixture()
+def daemon(built, fake_prom, fake_k8s):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=2)
+    for pod in pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml")
+    d = MetricsDaemon(fake_prom, fake_k8s)
+    yield d
+    d.stop()
+
+
+def test_classic_content_type_and_help_type_pairs(daemon):
+    body = daemon.wait_for_cycle()
+    status, ctype, body = daemon.get("/metrics")
+    assert status == 200
+    assert ctype == "text/plain; version=0.0.4"
+    # every sample line's metric family carries a HELP and a TYPE line
+    families = set()
+    for line in body.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)", line).group(1)
+        families.add(re.sub(r"_(bucket|sum|count)$", "", name))
+    assert families, body
+    for fam in families:
+        assert f"# HELP {fam} " in body, f"missing HELP for {fam}"
+        assert f"# TYPE {fam} " in body, f"missing TYPE for {fam}"
+    # the TYPE values are legal for the classic format
+    for m in re.finditer(r"# TYPE \S+ (\w+)", body):
+        assert m.group(1) in {"counter", "gauge", "histogram"}, m.group(0)
+
+
+def test_histogram_buckets_well_formed(daemon):
+    body = daemon.wait_for_cycle()
+    # per (family, phase): le values ascending ending at +Inf, cumulative
+    # counts non-decreasing, +Inf bucket == _count, _sum present
+    series = {}
+    for m in re.finditer(
+            r'(\w+)_bucket\{(?:phase="(\w+)",)?le="([^"]+)"\} (\d+)', body):
+        series.setdefault((m.group(1), m.group(2)), []).append(
+            (float("inf") if m.group(3) == "+Inf" else float(m.group(3)),
+             int(m.group(4))))
+    assert series
+    for (family, phase), buckets in series.items():
+        label = f'{{phase="{phase}"}}' if phase else ""
+        les = [le for le, _ in buckets]
+        counts = [c for _, c in buckets]
+        assert les == sorted(les), (family, phase)
+        assert les[-1] == float("inf"), (family, phase)
+        assert counts == sorted(counts), f"non-cumulative buckets: {family} {phase}"
+        total = re.search(
+            rf"{family}_count{re.escape(label)} (\d+)", body)
+        assert total, (family, phase)
+        assert counts[-1] == int(total.group(1))
+        assert re.search(rf"{family}_sum{re.escape(label)} [0-9.e+-]+", body)
+
+
+def test_phase_counts_consistent_per_cycle(daemon):
+    body = daemon.wait_for_cycle()
+    counts = dict(re.findall(
+        r'tpu_pruner_cycle_phase_seconds_count\{phase="(\w+)"\} (\d+)', body))
+    assert set(counts) == {"query", "decode", "resolve", "actuate", "total"}
+    assert len(set(counts.values())) == 1, counts
+
+
+def test_openmetrics_negotiation_serves_exemplars(built, fake_prom, fake_k8s):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    # recording on (exporter active) but nothing listens: spans get real
+    # trace ids, failed exports are log-only
+    d = MetricsDaemon(fake_prom, fake_k8s,
+                      env_extra={"OTEL_EXPORTER_OTLP_ENDPOINT": "http://127.0.0.1:9"})
+    try:
+        d.wait_for_cycle()
+        status, ctype, body = d.get(
+            "/metrics", accept="application/openmetrics-text")
+        assert status == 200
+        assert ctype.startswith("application/openmetrics-text")
+        assert body.rstrip().endswith("# EOF")
+        exemplars = re.findall(r'# \{trace_id="([0-9a-f]{32})"\} [0-9.e+-]+ \d+', body)
+        assert exemplars, "no trace-id exemplars on histogram buckets"
+        # classic negotiation must NOT leak exemplars (0.0.4 parsers reject them)
+        _, _, classic = d.get("/metrics")
+        assert "# {" not in classic
+    finally:
+        d.stop()
+
+
+def test_readyz_distinct_from_healthz(daemon):
+    status, _, body = daemon.get("/readyz")
+    assert (status, body) == (200, "ok\n")
+    status, _, body = daemon.get("/healthz")
+    assert (status, body) == (200, "ok\n")
+
+
+def test_debug_decisions_served_and_filterable(daemon):
+    daemon.wait_for_cycle()
+    _, ctype, body = daemon.get("/debug/decisions")
+    assert ctype == "application/json"
+    doc = json.loads(body)
+    assert doc["decisions"], doc
+    assert all(d["reason"] for d in doc["decisions"])
+    pod = doc["decisions"][0]["pod"]
+    _, _, filtered = daemon.get(f"/debug/decisions?pod=ml/{pod}")
+    filtered = json.loads(filtered)
+    assert filtered["decisions"]
+    assert all(d["pod"] == pod for d in filtered["decisions"])
+    _, _, none = daemon.get("/debug/decisions?namespace=nope")
+    assert json.loads(none)["decisions"] == []

@@ -23,6 +23,14 @@ Chips of one slice may have different sample counts; series are
 right-aligned and padded with invalid samples. Output: one human table on
 stderr and one machine-readable JSON line on stdout.
 
+Decision-audit mode (`--explain <ns>/<pod>`): instead of evaluating a
+dump, read the daemon's DecisionRecord trail — either the `--audit-log`
+JSONL file or the live `/debug/decisions` endpoint on the metrics port
+(`--decisions-url http://host:8080`) — and print the decision history for
+one pod: per cycle, the observed signal, the resolved owner chain, and
+the machine-readable reason the pod was (or was NOT) acted on. Human
+lines go to stderr, one JSON document to stdout.
+
 Incremental mode (`--stream STATE.npz`): successive invocations feed
 successive dumps (one per daemon cycle); the two-level sliding-window
 engine (engine.py streaming block) folds each dump's samples into a ring
@@ -202,11 +210,82 @@ def _run_stream(args, doc, fleet, slice_names, chip_ids, params, parr) -> int:
     return 0
 
 
+def _load_decision_records(args) -> list[dict]:
+    """DecisionRecords from the JSONL audit log or /debug/decisions."""
+    if args.audit_log:
+        records = []
+        with open(args.audit_log) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    # a torn tail line (daemon killed mid-write) is expected;
+                    # anything else is worth surfacing but not fatal
+                    print(f"WARNING: skipping unparseable audit line {lineno}",
+                          file=sys.stderr)
+        return records
+    import urllib.request
+
+    url = args.decisions_url.rstrip("/") + "/debug/decisions"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.load(resp)["decisions"]
+
+
+def _run_explain(args) -> int:
+    """Decision history for one pod (the audit-trail consumer)."""
+    target = args.explain
+    if "/" not in target:
+        print("--explain expects <namespace>/<pod>", file=sys.stderr)
+        return 2
+    ns, pod = target.split("/", 1)
+    records = [r for r in _load_decision_records(args)
+               if r.get("namespace") == ns and r.get("pod") == pod]
+    records.sort(key=lambda r: (r.get("cycle", 0), r.get("ts", "")))
+
+    if not records:
+        print(f"no decisions recorded for {ns}/{pod} (pod never appeared in "
+              "the idle candidate set, or the trail rotated past it)",
+              file=sys.stderr)
+    for r in records:
+        sig = r.get("signal") or {}
+        signal = (f"{sig.get('metric', '?')}={sig.get('value')}"
+                  if sig else "no signal")
+        chain = " -> ".join(r.get("owner_chain") or []) or "(no owner walk)"
+        root = r.get("root")
+        root_s = (f"{root['kind']}/{root['namespace']}/{root['name']}"
+                  if root else "(none)")
+        print(f"cycle {r.get('cycle', '?')} {r.get('ts', '?')}  "
+              f"{r.get('reason', '?'):<24} action={r.get('action', 'none')}\n"
+              f"  signal: {signal} (lookback {r.get('lookback_s', '?')}s)\n"
+              f"  chain:  {chain}\n"
+              f"  root:   {root_s}"
+              + (f"\n  detail: {r['detail']}" if r.get("detail") else "")
+              + (f"\n  trace:  {r['trace_id']}" if r.get("trace_id") else ""),
+              file=sys.stderr)
+    print(json.dumps({"namespace": ns, "pod": pod, "decisions": records}))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tpu_pruner.analyze", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    parser.add_argument("dump", help="metrics dump JSON path, or '-' for stdin")
+    parser.add_argument("dump", nargs="?",
+                        help="metrics dump JSON path, or '-' for stdin "
+                             "(omit with --explain)")
+    parser.add_argument("--explain", metavar="NS/POD",
+                        help="decision-audit mode: print the DecisionRecord "
+                             "history for one pod from --audit-log or "
+                             "--decisions-url instead of evaluating a dump")
+    parser.add_argument("--audit-log", metavar="FILE",
+                        help="with --explain: read the daemon's --audit-log "
+                             "JSONL file")
+    parser.add_argument("--decisions-url", metavar="URL",
+                        help="with --explain: query /debug/decisions on the "
+                             "daemon's metrics port (e.g. http://host:8080)")
     parser.add_argument("--lookback-s", type=float, default=None,
                         help="override lookback seconds (default: dump value or 2100)")
     parser.add_argument("--hbm-threshold", type=float, default=None,
@@ -232,6 +311,15 @@ def main(argv=None) -> int:
                         help="with --stream: discard STATE and start a fresh "
                              "window from this dump")
     args = parser.parse_args(argv)
+    if args.explain:
+        if bool(args.audit_log) == bool(args.decisions_url):
+            parser.error("--explain needs exactly one of --audit-log or "
+                         "--decisions-url")
+        return _run_explain(args)
+    if args.audit_log or args.decisions_url:
+        parser.error("--audit-log/--decisions-url only apply with --explain")
+    if not args.dump:
+        parser.error("a metrics dump path is required (or use --explain)")
     if args.window_chunks < 1:
         parser.error("--window-chunks must be >= 1")
     if args.stream and args.shard:

@@ -153,6 +153,7 @@ def load() -> ctypes.CDLL:
         "tp_dedup_targets",
         "tp_target_meta",
         "tp_otlp_grpc_call",
+        "tp_audit_reason_codes",
         "tp_informer_start",
         "tp_informer_stats",
         "tp_informer_get",
@@ -227,6 +228,13 @@ def dedup_targets(targets: list[dict]) -> list[dict]:
 def target_meta(target: dict) -> dict:
     """Meta accessors (name/namespace/kind/uid/apiVersion) for a target."""
     return _call("tp_target_meta", target)
+
+
+def audit_reason_codes() -> list[str]:
+    """Canonical DecisionRecord reason codes (SCALED, DRY_RUN, ...) —
+    every code the daemon can emit, in enum order. The docs drift-guard
+    test joins this list against docs/OPERATIONS.md."""
+    return _call("tp_audit_reason_codes", {})["codes"]
 
 
 class InformerSession:

@@ -307,6 +307,10 @@ class FakeK8s:
         # (path, body, status) for patches the server refused (400/404/409/422)
         self._rejected_patches: list[tuple[str, dict, int]] = []
         self._requests: list[tuple[str, str]] = []  # (method, path)
+        # W3C traceparent header per recorded request (None when absent),
+        # aligned with _requests. Single-process mode only: the traceparent
+        # tests drive the default in-process server.
+        self._traceparents: list[str | None] = []
         self.outage = False  # True → every request 503s (apiserver outage)
         # Server-side structural-schema validation (see validate_patch).
         # ON by default so every hermetic test proves the daemon's patches
@@ -374,6 +378,12 @@ class FakeK8s:
     @property
     def events(self):
         return self._mp_stats()["events"] if self._mp_conns else self._events
+
+    @property
+    def traceparents(self):
+        """traceparent header per request, aligned with `requests`
+        (single-process mode; workers don't forward it)."""
+        return self._traceparents
 
     # ── object builders ────────────────────────────────────────────────
     @staticmethod
@@ -697,6 +707,7 @@ class FakeK8s:
                     return
                 with fake._lock:
                     fake.requests.append(("GET", self.path))
+                    fake._traceparents.append(self.headers.get("traceparent"))
                     if (inj := fake._injected_failure("GET", path)) is not None:
                         code, retry_after = inj
                         self._respond(code, {"kind": "Status", "status": "Failure",
@@ -743,6 +754,7 @@ class FakeK8s:
                 abrupt drop on kill_watches()/stop()."""
                 with fake._lock:
                     fake.requests.append(("GET", self.path))
+                    fake._traceparents.append(self.headers.get("traceparent"))
                     inj = fake._injected_failure("GET", path)
                 if inj is not None:
                     code, retry_after = inj
@@ -822,6 +834,7 @@ class FakeK8s:
                 path = urlparse(self.path).path
                 with fake._lock:
                     fake.requests.append(("PATCH", self.path))
+                    fake._traceparents.append(self.headers.get("traceparent"))
                     if (inj := fake._injected_failure("PATCH", path)) is not None:
                         code, retry_after = inj
                         self._respond(code, {"kind": "Status", "status": "Failure",
@@ -871,6 +884,7 @@ class FakeK8s:
                 path = urlparse(self.path).path
                 with fake._lock:
                     fake.requests.append(("POST", self.path))
+                    fake._traceparents.append(self.headers.get("traceparent"))
                     if (inj := fake._injected_failure("POST", path)) is not None:
                         code, retry_after = inj
                         self._respond(code, {"kind": "Status", "status": "Failure",

@@ -55,6 +55,7 @@ class FakePrometheus:
         self.query_paths: list[str] = []  # full request paths (Cloud Monitoring prefix checks)
         self.query_times: list[float] = []  # time.monotonic() per query (cycle windowing)
         self.auth_headers: list[str | None] = []
+        self.traceparents: list[str | None] = []  # W3C traceparent per query
         self.fail_requests_remaining = 0
         self.fail_status = 500
         self.hang_seconds = 0.0  # >0 → every query stalls (wedged-backend sim)
@@ -189,6 +190,7 @@ class FakePrometheus:
                 with fake._lock:
                     fake.queries.append(query)
                     fake.auth_headers.append(self.headers.get("Authorization"))
+                    fake.traceparents.append(self.headers.get("traceparent"))
                     if err := promql_structure_error(query):
                         # 400 like a real Prometheus parse error — feeds the
                         # daemon's failure budget instead of fake success
@@ -234,6 +236,7 @@ class FakePrometheus:
                 with fake._lock:
                     fake.queries.append(query)
                     fake.auth_headers.append(self.headers.get("Authorization"))
+                    fake.traceparents.append(self.headers.get("traceparent"))
                     if err := promql_structure_error(query):
                         self._respond(400, {"status": "error",
                                             "errorType": "bad_data",
