@@ -420,6 +420,11 @@ def run_watch_cache_steady_state():
     Prometheus query (the detect instant) to each churn patch.
     """
     k8s, prom = build_cluster(workers=1)
+    ledger_path = str(Path(__file__).resolve().parent / "bench_ledger.jsonl")
+    try:
+        os.remove(ledger_path)
+    except FileNotFoundError:
+        pass
     try:
         cmd = [str(native.DAEMON_PATH),
                "--prometheus-url", prom.url,
@@ -427,6 +432,7 @@ def run_watch_cache_steady_state():
                "--daemon-mode", "--check-interval", str(WATCH_CHECK_INTERVAL_S),
                "--max-cycles", "2", "--watch-cache", "on",
                "--metrics-port", "auto",
+               "--ledger-file", ledger_path,
                "--resolve-concurrency", "64", "--scale-concurrency", "32"]
         env = {"KUBE_API_URL": k8s.url, "KUBE_TOKEN": "bench",
                "PROMETHEUS_TOKEN": "bench", "PATH": "/usr/bin:/bin"}
@@ -535,8 +541,30 @@ def run_watch_cache_steady_state():
         warm_p50 = statistics.median(lat)
         phases = _phase_percentiles(metrics_last[0]) if metrics_last else {
             "cycle_phase_p50_ms": {}, "cycle_phase_p95_ms": {}}
+
+        # Workload-ledger savings: the daemon checkpointed its utilization
+        # ledger; `analyze --fleet-report` renders the machine-readable
+        # summary whose headline fields the bench summary carries.
+        fleet_report = {}
+        try:
+            rep = subprocess.run(
+                [sys.executable, "-m", "tpu_pruner.analyze", "--fleet-report",
+                 "--ledger-file", ledger_path],
+                capture_output=True, text=True, timeout=120,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                cwd=str(Path(__file__).resolve().parent))
+            if rep.returncode == 0 and rep.stdout.strip():
+                fleet_report = json.loads(rep.stdout.strip().splitlines()[-1])
+            else:
+                log(f"fleet-report failed (rc={rep.returncode}): "
+                    f"{rep.stderr[-500:]}")
+        except (OSError, ValueError, subprocess.SubprocessError) as e:
+            log(f"fleet-report failed: {e}")
         return {
             **phases,
+            "reclaimed_chip_hours": fleet_report.get("reclaimed_chip_hours"),
+            "tracked_workloads": fleet_report.get("tracked_workloads"),
+            "fleet_report": fleet_report or None,
             "cold_api_calls": cold_api_calls,
             "steady_state_api_calls": steady_calls,
             "steady_to_cold_call_ratio": round(ratio, 4),
@@ -1368,6 +1396,10 @@ def main():
         f"{watch_cache['cold_api_calls']}), warm p50 "
         f"{watch_cache['warm_p50_detect_to_scaledown_s'] * 1000:.0f}ms over "
         f"{watch_cache['churn_targets']} churn targets")
+    if watch_cache.get("reclaimed_chip_hours") is not None:
+        log(f"workload ledger: {watch_cache['tracked_workloads']} workloads tracked, "
+            f"{watch_cache['reclaimed_chip_hours']:.3f} chip-hours reclaimed "
+            "across the two-cycle section")
 
     # TPU fleet eval with spaced retries: now, +60s, +120s (only on failure).
     tpu = tpu_section([None] if SMOKE else [
@@ -1471,6 +1503,10 @@ def main():
         # during the watch-cache section (query/decode/resolve/actuate/total)
         "cycle_phase_p50_ms": watch_cache["cycle_phase_p50_ms"],
         "cycle_phase_p95_ms": watch_cache["cycle_phase_p95_ms"],
+        # workload-ledger savings over the watch-cache section's two
+        # cycles, via `analyze --fleet-report` on the daemon's checkpoint
+        "reclaimed_chip_hours": watch_cache.get("reclaimed_chip_hours"),
+        "tracked_workloads": watch_cache.get("tracked_workloads"),
         "spread_max": (round(max(RUN_SPREADS.values()), 3)
                        if RUN_SPREADS else None),
         "detail_file": detail_path.name,

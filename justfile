@@ -3,6 +3,13 @@
 build:
     cmake -G Ninja -S . -B build && cmake --build build
 
+# tier-1 verify: the ROADMAP.md "Tier-1 verify" command VERBATIM (bash:
+# it uses PIPESTATUS). tests/test_justfile_guard.py fails the build if
+# this recipe drifts from ROADMAP.md.
+verify:
+    #!/usr/bin/env bash
+    set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+
 test: build
     ./build/tpupruner_tests
     python -m pytest tests/ -q

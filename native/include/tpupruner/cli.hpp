@@ -74,6 +74,8 @@ struct Cli {
   int metrics_port = -1;                  // --metrics-port: -1 disabled (flag "0" maps
                                           // here too), 0 ephemeral (flag "auto"), else port
   std::string audit_log;                  // --audit-log: JSONL DecisionRecord sink ("" = off)
+  std::string ledger_file;                // --ledger-file: JSONL workload-ledger checkpoint ("" = off)
+  int64_t ledger_top_k = 10;              // --ledger-top-k: /metrics workload label cardinality bound
   std::string otlp_endpoint;              // --otlp-endpoint (default: $OTEL_EXPORTER_OTLP_ENDPOINT)
   std::string gcp_project;                // --gcp-project (Cloud Monitoring PromQL API)
   std::string monitoring_endpoint = "https://monitoring.googleapis.com";  // --monitoring-endpoint

@@ -124,6 +124,12 @@ bool is_opted_out(const json::Value& object);
 // `lookback_secs` = duration*60 + grace_period (main.rs:413-414).
 Eligibility check_eligibility(const json::Value& pod, int64_t now_unix, int64_t lookback_secs);
 
+// Accelerator chips the pod reserves: per container max(requests, limits)
+// of google.com/tpu (device=tpu) or nvidia.com/gpu (device=gpu), summed.
+// 0 for pods with no accelerator resources — the workload-ledger's
+// per-root chip accounting input.
+int64_t pod_chip_count(const json::Value& pod, std::string_view device = "tpu");
+
 // ── metric samples ────────────────────────────────────────────────────────
 
 // One decoded Prometheus series (reference: PodMetricData, lib.rs:136-145).

@@ -167,6 +167,10 @@ class ClusterCache {
   const Reflector* route(const std::string& object_path) const;
 
   std::vector<std::unique_ptr<Reflector>> reflectors_;
+  // Monotonic second start() ran: a reflector that never applied anything
+  // is as stale as the CACHE is old — without this anchor it would report
+  // the steady clock's epoch distance (machine uptime), i.e. garbage.
+  std::atomic<int64_t> start_mono_{0};
 };
 
 }  // namespace tpupruner::informer

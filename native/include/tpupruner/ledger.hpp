@@ -1,0 +1,110 @@
+// Workload utilization ledger: per-root idle/active accounting and
+// reclaimed chip-hour attribution.
+//
+// The audit trail (audit.hpp) answers "why was pod X touched"; the ledger
+// answers the question operators budget against: "how much TPU time did
+// each workload waste, and how much did the pruner reclaim?" For every
+// root object the walker resolves, a continuously-updated account keyed by
+// kind/namespace/name integrates per-cycle duty-cycle observations into
+// cumulative idle-seconds and active-seconds, tracks the current idle
+// streak, keeps a bounded history of scale events (paused/resumed, by
+// whom, at which cycle, with the audit reason code), and derives
+// reclaimed chip-seconds — chips × time the root spent scaled-to-zero
+// after the pruner paused it.
+//
+// Exposed three ways: bounded-cardinality metric families on /metrics
+// (top-K by chips + one "_other" rollup so label cardinality never scales
+// with fleet size), a /debug/workloads JSON snapshot on the metrics port,
+// and an optional JSONL checkpoint (--ledger-file) written at cycle end
+// and reloaded at startup so savings survive restarts and leader
+// failover. `python -m tpu_pruner.analyze --fleet-report` consumes the
+// file or the endpoint and renders the per-namespace savings report.
+//
+// Accounting semantics (deliberately conservative):
+//   - Integration is cycle-driven: dt = time since the previous cycle of
+//     THIS process. The first cycle after a (re)start integrates nothing,
+//     so a reloaded checkpoint's cumulative totals are reproduced exactly
+//     before any new evidence lands.
+//   - A paused account accrues reclaimed chip-seconds (chips-at-pause ×
+//     dt) and nothing else; observations while paused (metric series that
+//     outlive the pods) never double-count as idle time.
+//   - Resume detection is informer-driven: a paused root whose stored
+//     object no longer shows its kind's paused state was resumed
+//     externally. Without --watch-cache the account stays paused until
+//     the pruner itself re-pauses the root (a no-op on the ledger).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::ledger {
+
+// One cycle's evidence for one root: the root identity plus the chips its
+// observed idle pods reserve (summed per root by the caller).
+struct Observation {
+  std::string kind, ns, name;
+  int64_t chips = 0;
+};
+
+// A currently-paused account (kind/ns/name), for the daemon's informer
+// resume sweep.
+struct PausedRoot {
+  std::string kind, ns, name;
+};
+
+// Optional JSONL checkpoint ("" disables). Setting a non-empty path loads
+// any existing checkpoint into the registry (accounts merge over whatever
+// is already tracked) before enabling the per-cycle rewrite.
+void set_ledger_file(const std::string& path);
+
+// Fold one cycle's idle-root observations into the registry:
+//   observed & not paused  → idle_seconds += dt, idle streak advances
+//   tracked but unobserved → active_seconds += dt, idle streak resets
+//   paused (either way)    → reclaimed_chip_seconds += chips_at_pause × dt
+// dt = now_unix − previous observe_cycle's now_unix (0 on the first call
+// of the process). Writes the checkpoint when a ledger file is set.
+void observe_cycle(uint64_t cycle, int64_t now_unix,
+                   const std::vector<Observation>& idle_roots);
+
+// The consumer landed (or confirmed) a pause on this root. No-op when the
+// account is already marked paused — watch-cache-off re-patches of an
+// already-paused root must not inflate the pause count. `reason` is the
+// audit reason code (SCALED / ALREADY_PAUSED).
+void record_pause(uint64_t cycle, const std::string& kind, const std::string& ns,
+                  const std::string& name, const std::string& reason);
+
+// A paused root came back (informer saw it leave its paused state, or a
+// test drives the transition directly). No-op when not marked paused.
+// `actor` is "external" for operator resumes.
+void record_resume(uint64_t cycle, const std::string& kind, const std::string& ns,
+                   const std::string& name, const std::string& actor);
+
+// Accounts currently marked paused — the daemon's per-cycle informer
+// resume sweep iterates these.
+std::vector<PausedRoot> paused_roots();
+
+// /debug/workloads body: {"workloads": [...], "tracked": N, "totals":
+// {...}}. `query_string` supports ns=<namespace> (alias namespace=) and
+// sort=reclaimed|idle|chips (descending; default reclaimed).
+json::Value workloads_json(const std::string& query_string = "");
+
+// Prometheus text for the ledger's metric families, bounded to the top-K
+// accounts by chips plus one "_other" rollup series per family (totals
+// across served series always equal the full-fleet totals):
+//   tpu_pruner_workload_idle_seconds_total{workload=...}            counter
+//   tpu_pruner_workload_reclaimed_chip_seconds_total{workload=...}  counter
+//   tpu_pruner_workload_chips{workload=...,state=idle|active|paused} gauge
+//   tpu_pruner_workloads_tracked                                    gauge
+// `openmetrics` switches counter TYPE lines to the OpenMetrics family
+// form (name without the _total suffix).
+std::string render_metrics(int top_k, bool openmetrics);
+
+// The family names served above, for the docs drift guard (capi).
+std::vector<std::string> metric_families();
+
+void reset_for_test();
+
+}  // namespace tpupruner::ledger

@@ -8,7 +8,9 @@
 #include <memory>
 #include <mutex>
 #include <string>
+#include <tuple>
 #include <unordered_map>
+#include <vector>
 
 #include "otlp_grpc.hpp"
 #include "tpupruner/audit.hpp"
@@ -16,6 +18,7 @@
 #include "tpupruner/informer.hpp"
 #include "tpupruner/json.hpp"
 #include "tpupruner/k8s.hpp"
+#include "tpupruner/ledger.hpp"
 #include "tpupruner/metrics.hpp"
 #include "tpupruner/query.hpp"
 
@@ -355,6 +358,84 @@ char* tp_audit_reason_codes(const char*) {
     }
     Value out = Value::object();
     out.set("codes", std::move(codes));
+    return ok(out);
+  });
+}
+
+char* tp_ledger_sim(const char* payload_json) {
+  // Deterministic replay harness for the workload utilization ledger
+  // (ledger.cpp): the pytest tier drives the REAL accounting code with
+  // scripted cycles and injected timestamps, then inspects both export
+  // surfaces. Payload:
+  //   {"top_k": K,                       // /metrics cardinality bound (default 10)
+  //    "cycles": [{"now": <unix>,        // cycle timestamp (dt integration)
+  //                "idle": [{"kind","namespace","name","chips"}...],
+  //                "pauses": [{"kind","namespace","name","reason"?}...],
+  //                "resumes": [{"kind","namespace","name","actor"?}...]}, ...]}
+  // Cycle i replays as cycle number i+1: observe, then pauses, then
+  // resumes. Returns {"workloads": <the /debug/workloads body>,
+  // "metrics": "<classic exposition>", "metrics_openmetrics": "<OM form>"}.
+  // Resets the process-wide ledger registry first — a test seam, never
+  // called by the daemon path.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    namespace ledger = tpupruner::ledger;
+    ledger::reset_for_test();
+    int top_k = 10;
+    if (const Value* k = p.find("top_k"); k && k->is_number())
+      top_k = static_cast<int>(k->as_int());
+    const Value* cycles = p.find("cycles");
+    if (!cycles || !cycles->is_array()) throw std::runtime_error("missing cycles");
+    auto root_of = [](const Value& v) {
+      return std::tuple<std::string, std::string, std::string>{
+          v.get_string("kind"), v.get_string("namespace"), v.get_string("name")};
+    };
+    uint64_t cycle = 0;
+    for (const Value& c : cycles->as_array()) {
+      ++cycle;
+      const Value* now = c.find("now");
+      if (!now || !now->is_number()) throw std::runtime_error("cycle missing now");
+      std::vector<ledger::Observation> obs;
+      if (const Value* idle = c.find("idle"); idle && idle->is_array()) {
+        for (const Value& o : idle->as_array()) {
+          auto [kind, ns, name] = root_of(o);
+          int64_t chips = 0;
+          if (const Value* ch = o.find("chips"); ch && ch->is_number()) chips = ch->as_int();
+          obs.push_back({kind, ns, name, chips});
+        }
+      }
+      ledger::observe_cycle(cycle, now->as_int(), obs);
+      if (const Value* pauses = c.find("pauses"); pauses && pauses->is_array()) {
+        for (const Value& o : pauses->as_array()) {
+          auto [kind, ns, name] = root_of(o);
+          ledger::record_pause(cycle, kind, ns, name, o.get_string("reason", "SCALED"));
+        }
+      }
+      if (const Value* resumes = c.find("resumes"); resumes && resumes->is_array()) {
+        for (const Value& o : resumes->as_array()) {
+          auto [kind, ns, name] = root_of(o);
+          ledger::record_resume(cycle, kind, ns, name, o.get_string("actor", "external"));
+        }
+      }
+    }
+    Value out = Value::object();
+    out.set("workloads", ledger::workloads_json(p.get_string("query")));
+    out.set("metrics", Value(ledger::render_metrics(top_k, /*openmetrics=*/false)));
+    out.set("metrics_openmetrics", Value(ledger::render_metrics(top_k, true)));
+    return ok(out);
+  });
+}
+
+char* tp_ledger_metric_families(const char*) {
+  // The canonical workload-ledger metric family names — the docs-drift
+  // test joins this against docs/OPERATIONS.md, like the audit codes.
+  return guarded([&] {
+    Value families = Value::array();
+    for (const std::string& f : tpupruner::ledger::metric_families()) {
+      families.push_back(Value(f));
+    }
+    Value out = Value::object();
+    out.set("families", std::move(families));
     return ok(out);
   });
 }

@@ -131,6 +131,17 @@ TPU FLAGS:
                                 pod per cycle (the /debug/decisions ring
                                 buffer, durable; consumed by
                                 `python -m tpu_pruner.analyze --explain`)
+      --ledger-file <FILE>      checkpoint the workload utilization ledger
+                                (per-root idle/active seconds, reclaimed
+                                chip-seconds, pause/resume history) as JSONL
+                                at cycle end; reloaded at startup so savings
+                                survive restarts and leader failover —
+                                consumed by `python -m tpu_pruner.analyze
+                                --fleet-report`
+      --ledger-top-k <N>        bound the /metrics workload label
+                                cardinality: the top N workloads by chips
+                                get their own series, the rest roll up into
+                                one "_other" series per family [default: 10]
       --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
                                 [default: $OTEL_EXPORTER_OTLP_ENDPOINT]
       --gcp-project <ID>        query the Cloud Monitoring PromQL API for this
@@ -251,6 +262,12 @@ Cli parse(int argc, char** argv) {
          cli.metrics_port = port == 0 ? -1 : port;
        }},
       {"--audit-log", [&](const std::string& v) { cli.audit_log = v; }},
+      {"--ledger-file", [&](const std::string& v) { cli.ledger_file = v; }},
+      {"--ledger-top-k",
+       [&](const std::string& v) {
+         cli.ledger_top_k = parse_int("--ledger-top-k", v);
+         if (cli.ledger_top_k < 1) throw CliError("--ledger-top-k must be >= 1");
+       }},
       {"--otlp-endpoint", [&](const std::string& v) { cli.otlp_endpoint = v; }},
       {"--gcp-project", [&](const std::string& v) { cli.gcp_project = v; }},
       {"--monitoring-endpoint", [&](const std::string& v) { cli.monitoring_endpoint = v; }},

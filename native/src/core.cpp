@@ -1,5 +1,6 @@
 #include "tpupruner/core.hpp"
 
+#include <algorithm>
 #include <unordered_set>
 
 #include "tpupruner/util.hpp"
@@ -186,6 +187,38 @@ bool is_opted_out(const json::Value& object) {
   if (!v || !v->is_object()) return false;
   const json::Value* skip = v->find(std::string(kSkipAnnotation));
   return skip && skip->is_string() && skip->as_string() == "true";
+}
+
+int64_t pod_chip_count(const json::Value& pod, std::string_view device) {
+  const char* resource = device == "gpu" ? "nvidia.com/gpu" : "google.com/tpu";
+  const json::Value* containers = pod.at_path("spec.containers");
+  if (!containers || !containers->is_array()) return 0;
+  int64_t total = 0;
+  for (const json::Value& c : containers->as_array()) {
+    const json::Value* resources = c.find("resources");
+    if (!resources) continue;
+    // per container: max(requests, limits) — a pod normally sets both to
+    // the same value, but either alone still reserves the chips
+    int64_t per_container = 0;
+    for (const char* section : {"requests", "limits"}) {
+      const json::Value* res = resources->find(section);
+      if (!res || !res->is_object()) continue;
+      const json::Value* count = res->find(resource);
+      if (!count) continue;
+      int64_t n = 0;
+      if (count->is_number()) {
+        n = count->as_int();
+      } else if (count->is_string()) {
+        try {
+          n = std::stoll(count->as_string());
+        } catch (const std::exception&) {
+        }
+      }
+      per_container = std::max(per_container, n);
+    }
+    total += per_container;
+  }
+  return total;
 }
 
 Eligibility check_eligibility(const json::Value& pod, int64_t now_unix, int64_t lookback_secs) {

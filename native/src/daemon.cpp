@@ -20,6 +20,7 @@
 #include "tpupruner/auth.hpp"
 #include "tpupruner/http.hpp"
 #include "tpupruner/leader.hpp"
+#include "tpupruner/ledger.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/metrics.hpp"
 #include "tpupruner/prom.hpp"
@@ -108,6 +109,11 @@ struct ResolveOutcome {
   // later (opt-out valves, group gate, breaker, actuation), keyed by the
   // root's identity so run_cycle can join them against target outcomes.
   std::vector<std::pair<std::string, audit::DecisionRecord>> resolved_records;
+  // Workload-ledger evidence: per resolved root, the chips its observed
+  // idle pods reserve this cycle (keyed "Kind/ns/name" — the ledger's
+  // account key, not the uid identity: savings must survive root
+  // recreation under a new uid).
+  std::unordered_map<std::string, ledger::Observation> ledger_obs;
   // Root identities vetoed by a pod-level tpu-pruner.dev/skip annotation:
   // an annotated pod must protect its owner for EVERY kind, not only the
   // group kinds the all-idle gate covers — a sibling pod of the same
@@ -406,6 +412,17 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
         out.decided.push_back(std::move(rec));
         out.vetoed_roots.insert(target->identity());
       } else {
+        // Ledger evidence: this root had an idle-observed pod this cycle;
+        // chips sum over the root's contributing pods.
+        ledger::Observation& obs =
+            out.ledger_obs[std::string(core::kind_name(target->kind)) + "/" +
+                           target->ns().value_or("") + "/" + target->name()];
+        if (obs.kind.empty()) {
+          obs.kind = core::kind_name(target->kind);
+          obs.ns = target->ns().value_or("");
+          obs.name = target->name();
+        }
+        obs.chips += core::pod_chip_count(*e.pod, args.device);
         out.resolved_records.emplace_back(target->identity(), std::move(rec));
         out.targets.push_back(std::move(*target));
       }
@@ -477,6 +494,15 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   // final now; resolved pods' records land after the target-level gates.
   for (audit::DecisionRecord& rec : resolved.decided) {
     audit::record(std::move(rec));
+  }
+  // Workload ledger: fold this cycle's idle-root evidence in BEFORE any
+  // target is enqueued — a fast consumer's record_pause must find the
+  // account (and its chip count) already present.
+  {
+    std::vector<ledger::Observation> obs;
+    obs.reserve(resolved.ledger_obs.size());
+    for (auto& [key, o] : resolved.ledger_obs) obs.push_back(std::move(o));
+    ledger::observe_cycle(cycle_id, util::now_unix(), obs);
   }
   std::vector<ScaleTarget> unique = core::dedup_targets(std::move(resolved.targets));
 
@@ -671,6 +697,10 @@ int run(const cli::Cli& args) {
   // Durable decision audit trail (--audit-log): every DecisionRecord the
   // ring buffer sees is also appended as JSONL here.
   audit::set_audit_log(args.audit_log);
+  // Workload utilization ledger checkpoint (--ledger-file): reloading an
+  // existing file restores the fleet's savings accounts across restarts
+  // and leader failover.
+  ledger::set_ledger_file(args.ledger_file);
 
   k8s::Client kube = [&] {
     try {
@@ -707,6 +737,14 @@ int run(const cli::Cli& args) {
     // ?namespace= / ?pod= (or pod=ns/name) — `analyze --explain` hits this.
     metrics_server->set_decisions_provider(
         [](const std::string& query_string) { return audit::decisions_json(query_string).dump(); });
+    // Workload ledger: JSON snapshot at /debug/workloads (`analyze
+    // --fleet-report --workloads-url` hits this) and bounded-cardinality
+    // workload metric families on /metrics.
+    metrics_server->set_workloads_provider(
+        [](const std::string& query_string) { return ledger::workloads_json(query_string).dump(); });
+    const int ledger_top_k = static_cast<int>(args.ledger_top_k);
+    metrics_server->set_extra_metrics_provider(
+        [ledger_top_k](bool openmetrics) { return ledger::render_metrics(ledger_top_k, openmetrics); });
     // /readyz reflects informer sync state — distinct from the /healthz
     // liveness stamp: a daemon mid-relist is alive but serving degraded
     // (GET-fallback) lookups, and a rollout should wait it out. Without
@@ -881,12 +919,18 @@ int run(const cli::Cli& args) {
                   std::string(core::kind_name(t.kind)) + "] - " +
                   t.ns().value_or("default") + ":" + t.name());
         finish(audit::Reason::AlreadyPaused, "none", "root already at its paused state");
+        // The root IS at its paused state; if the ledger doesn't know yet
+        // (fresh process without a checkpoint), start the savings clock.
+        ledger::record_pause(item->cycle, std::string(core::kind_name(t.kind)),
+                             t.ns().value_or(""), t.name(), "ALREADY_PAUSED");
         continue;
       }
       log::counter_add("scale_successes", 1);
       log::info("daemon", "Scaled Resource: [" + std::string(core::kind_name(t.kind)) + "] - " +
                 t.ns().value_or("default") + ":" + t.name());
       finish(audit::Reason::Scaled, "scale_down");
+      ledger::record_pause(item->cycle, std::string(core::kind_name(t.kind)),
+                           t.ns().value_or(""), t.name(), "SCALED");
       notify(t);
     }
     log::set_thread_cycle(0);
@@ -938,6 +982,23 @@ int run(const cli::Cli& args) {
       log::counter_set("informer_synced", healthy ? 1 : 0);
       log::counter_set("informer_staleness_seconds",
                        static_cast<uint64_t>(std::max<int64_t>(watch_cache->staleness_secs(), 0)));
+      // Ledger resume sweep: a paused root whose stored object no longer
+      // shows its kind's paused state was resumed externally (kubectl
+      // scale / unsuspend). Store-only — an unsynced resource just skips
+      // a sweep (get() answers nullopt), and the account resumes on a
+      // later cycle; never worth a GET storm.
+      for (const ledger::PausedRoot& p : ledger::paused_roots()) {
+        auto kind = core::kind_from_name(p.kind);
+        if (!kind) continue;
+        auto obj = watch_cache->get(k8s::Client::object_path(*kind, p.ns, p.name));
+        if (!obj) continue;
+        core::ScaleTarget t{*kind, std::move(*obj)};
+        if (!actuate::already_paused(t)) {
+          log::info("daemon", "ledger: [" + p.kind + "] " + p.ns + ":" + p.name +
+                    " was resumed externally; closing its reclaim window");
+          ledger::record_resume(audit::current_cycle(), p.kind, p.ns, p.name, "external");
+        }
+      }
     }
     last_cycle_failed = false;
     try {

@@ -300,6 +300,7 @@ ClusterCache::ClusterCache(const k8s::Client& kube, std::vector<ResourceSpec> sp
 ClusterCache::~ClusterCache() { stop(); }
 
 void ClusterCache::start() {
+  start_mono_.store(util::mono_secs());
   for (auto& r : reflectors_) r->start();
 }
 
@@ -353,12 +354,15 @@ std::optional<Value> ClusterCache::get(const std::string& object_path) const {
 
 int64_t ClusterCache::staleness_secs() const {
   int64_t now = util::mono_secs();
+  int64_t started = start_mono_.load();
   int64_t worst = 0;
   for (const auto& r : reflectors_) {
     int64_t last = r->last_activity_mono();
-    // A reflector that never applied anything is as stale as the process
-    // is old — report since-start rather than pretending freshness.
-    int64_t age = last == 0 ? now : now - last;
+    // A reflector that never applied anything is as stale as the CACHE is
+    // old. Anchor to start() — the raw steady clock reads as machine
+    // uptime here, which served a garbage gauge whenever a resource never
+    // managed its first LIST (e.g. a denied `watch`/`list` RBAC verb).
+    int64_t age = last == 0 ? (started ? now - started : 0) : now - last;
     worst = std::max(worst, age);
   }
   return worst;

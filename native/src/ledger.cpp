@@ -1,0 +1,467 @@
+#include "tpupruner/ledger.hpp"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "tpupruner/log.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::ledger {
+
+namespace {
+
+// Scale-event history per account. Big enough for months of normal
+// pause/resume churn, small enough that a flapping workload can't grow
+// the checkpoint without bound.
+constexpr size_t kEventCap = 32;
+
+struct ScaleEventRec {
+  uint64_t cycle = 0;
+  int64_t ts_unix = 0;
+  std::string action;  // "paused" | "resumed"
+  std::string reason;  // audit reason code on pauses; "" on resumes
+  std::string actor;   // "tpu-pruner" | "external"
+};
+
+struct Account {
+  std::string kind, ns, name;
+  int64_t chips = 0;  // latest observed per-root request (sum over idle pods)
+  double idle_seconds = 0;
+  double active_seconds = 0;
+  double reclaimed_chip_seconds = 0;
+  uint64_t idle_streak_cycles = 0;
+  bool paused = false;
+  bool idle_now = false;  // observed idle in the most recent cycle
+  int64_t paused_since_unix = 0;
+  int64_t chips_when_paused = 0;
+  uint64_t pauses = 0, resumes = 0;
+  uint64_t first_seen_cycle = 0, last_seen_cycle = 0;
+  std::deque<ScaleEventRec> events;
+
+  const char* state() const {
+    if (paused) return "paused";
+    return idle_now ? "idle" : "active";
+  }
+};
+
+struct Registry {
+  std::mutex mutex;
+  // std::map: deterministic iteration for serialization and tests.
+  std::map<std::string, Account> accounts;  // key "Kind/ns/name"
+  int64_t prev_cycle_unix = 0;  // 0 = no cycle integrated yet (fresh start)
+  std::string file_path;
+};
+
+Registry& reg() {
+  static Registry r;
+  return r;
+}
+
+std::string key_of(const std::string& kind, const std::string& ns, const std::string& name) {
+  return kind + "/" + ns + "/" + name;
+}
+
+double round3(double v) { return std::round(v * 1000.0) / 1000.0; }
+
+json::Value account_to_json(const std::string& key, const Account& a) {
+  json::Value v = json::Value::object();
+  v.set("workload", json::Value(key));
+  v.set("kind", json::Value(a.kind));
+  v.set("namespace", json::Value(a.ns));
+  v.set("name", json::Value(a.name));
+  v.set("chips", json::Value(a.chips));
+  v.set("state", json::Value(std::string(a.state())));
+  v.set("idle_seconds", json::Value(round3(a.idle_seconds)));
+  v.set("active_seconds", json::Value(round3(a.active_seconds)));
+  v.set("reclaimed_chip_seconds", json::Value(round3(a.reclaimed_chip_seconds)));
+  v.set("idle_streak_cycles", json::Value(static_cast<int64_t>(a.idle_streak_cycles)));
+  v.set("pauses", json::Value(static_cast<int64_t>(a.pauses)));
+  v.set("resumes", json::Value(static_cast<int64_t>(a.resumes)));
+  v.set("first_seen_cycle", json::Value(static_cast<int64_t>(a.first_seen_cycle)));
+  v.set("last_seen_cycle", json::Value(static_cast<int64_t>(a.last_seen_cycle)));
+  if (a.paused) {
+    v.set("paused_since", json::Value(util::format_rfc3339(a.paused_since_unix)));
+    v.set("paused_since_unix", json::Value(a.paused_since_unix));
+    v.set("chips_when_paused", json::Value(a.chips_when_paused));
+  }
+  json::Value events = json::Value::array();
+  for (const ScaleEventRec& e : a.events) {
+    json::Value ev = json::Value::object();
+    ev.set("cycle", json::Value(static_cast<int64_t>(e.cycle)));
+    ev.set("ts", json::Value(util::format_rfc3339(e.ts_unix)));
+    ev.set("ts_unix", json::Value(e.ts_unix));
+    ev.set("action", json::Value(e.action));
+    if (!e.reason.empty()) ev.set("reason", json::Value(e.reason));
+    ev.set("actor", json::Value(e.actor));
+    events.push_back(std::move(ev));
+  }
+  v.set("events", std::move(events));
+  return v;
+}
+
+// Rewrite the JSONL checkpoint (one account per line) atomically: a crash
+// mid-write must never destroy the accumulated savings, so write a
+// same-directory temp file and rename over the target. Caller holds the
+// registry lock. Failures are log-only — the ledger is telemetry.
+void checkpoint_locked(Registry& r) {
+  if (r.file_path.empty()) return;
+  std::string tmp = r.file_path + ".tmp";
+  std::FILE* f = std::fopen(tmp.c_str(), "w");
+  if (!f) {
+    log::warn("ledger", "cannot write --ledger-file " + tmp + "; checkpointing disabled");
+    r.file_path.clear();
+    return;
+  }
+  bool ok = true;
+  for (const auto& [key, a] : r.accounts) {
+    std::string line = account_to_json(key, a).dump();
+    line += '\n';
+    if (std::fwrite(line.data(), 1, line.size(), f) != line.size()) {
+      ok = false;
+      break;
+    }
+  }
+  ok = std::fclose(f) == 0 && ok;
+  if (!ok || std::rename(tmp.c_str(), r.file_path.c_str()) != 0) {
+    std::remove(tmp.c_str());
+    log::warn("ledger", "ledger checkpoint write failed; disabling --ledger-file sink");
+    r.file_path.clear();
+  }
+}
+
+void load_locked(Registry& r, const std::string& path) {
+  auto content = util::read_file(path);
+  if (!content) return;  // fresh file: nothing to restore
+  size_t restored = 0, bad = 0;
+  for (const std::string& line : util::split(*content, '\n')) {
+    std::string t = util::trim(line);
+    if (t.empty()) continue;
+    json::Value v;
+    try {
+      v = json::Value::parse(t);
+    } catch (const std::exception&) {
+      ++bad;  // torn tail line (killed mid-write before the rename landed)
+      continue;
+    }
+    Account a;
+    a.kind = v.get_string("kind");
+    a.ns = v.get_string("namespace");
+    a.name = v.get_string("name");
+    if (a.kind.empty() || a.name.empty()) {
+      ++bad;
+      continue;
+    }
+    auto num = [&](const char* k) -> double {
+      const json::Value* x = v.find(k);
+      return x && x->is_number() ? x->as_double() : 0.0;
+    };
+    a.chips = static_cast<int64_t>(num("chips"));
+    a.idle_seconds = num("idle_seconds");
+    a.active_seconds = num("active_seconds");
+    a.reclaimed_chip_seconds = num("reclaimed_chip_seconds");
+    a.idle_streak_cycles = static_cast<uint64_t>(num("idle_streak_cycles"));
+    a.pauses = static_cast<uint64_t>(num("pauses"));
+    a.resumes = static_cast<uint64_t>(num("resumes"));
+    a.first_seen_cycle = static_cast<uint64_t>(num("first_seen_cycle"));
+    a.last_seen_cycle = static_cast<uint64_t>(num("last_seen_cycle"));
+    a.paused = v.get_string("state") == "paused";
+    a.idle_now = v.get_string("state") == "idle";
+    if (a.paused) {
+      a.paused_since_unix = static_cast<int64_t>(num("paused_since_unix"));
+      a.chips_when_paused = static_cast<int64_t>(num("chips_when_paused"));
+      if (a.chips_when_paused == 0) a.chips_when_paused = a.chips;
+    }
+    if (const json::Value* events = v.find("events"); events && events->is_array()) {
+      for (const json::Value& ev : events->as_array()) {
+        ScaleEventRec e;
+        e.cycle = static_cast<uint64_t>(
+            ev.find("cycle") && ev.find("cycle")->is_number() ? ev.find("cycle")->as_int() : 0);
+        e.ts_unix = ev.find("ts_unix") && ev.find("ts_unix")->is_number()
+                        ? ev.find("ts_unix")->as_int() : 0;
+        e.action = ev.get_string("action");
+        e.reason = ev.get_string("reason");
+        e.actor = ev.get_string("actor");
+        a.events.push_back(std::move(e));
+        if (a.events.size() > kEventCap) a.events.pop_front();
+      }
+    }
+    r.accounts[key_of(a.kind, a.ns, a.name)] = std::move(a);
+    ++restored;
+  }
+  if (restored || bad) {
+    log::info("ledger", "restored " + std::to_string(restored) + " workload account(s) from " +
+              path + (bad ? " (" + std::to_string(bad) + " unparseable line(s) skipped)" : ""));
+  }
+}
+
+void push_event_locked(Account& a, ScaleEventRec e) {
+  a.events.push_back(std::move(e));
+  while (a.events.size() > kEventCap) a.events.pop_front();
+}
+
+std::string fmt_value(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%.9g", v);
+  return buf;
+}
+
+}  // namespace
+
+void set_ledger_file(const std::string& path) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  r.file_path = path;
+  if (path.empty()) return;
+  load_locked(r, path);
+  log::info("ledger", "checkpointing workload ledger to " + path);
+}
+
+void observe_cycle(uint64_t cycle, int64_t now_unix,
+                   const std::vector<Observation>& idle_roots) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  // First cycle of the process integrates nothing: there is no previous
+  // observation to span, and a restart from a checkpoint must reproduce
+  // the stored totals exactly until new evidence accrues.
+  double dt = 0;
+  if (r.prev_cycle_unix > 0 && now_unix > r.prev_cycle_unix) {
+    dt = static_cast<double>(now_unix - r.prev_cycle_unix);
+  }
+  r.prev_cycle_unix = now_unix;
+
+  std::map<std::string, const Observation*> observed;
+  for (const Observation& o : idle_roots) observed[key_of(o.kind, o.ns, o.name)] = &o;
+
+  for (const auto& [key, o] : observed) {
+    Account& a = r.accounts[key];
+    if (a.kind.empty()) {
+      a.kind = o->kind;
+      a.ns = o->ns;
+      a.name = o->name;
+      a.first_seen_cycle = cycle;
+    }
+    a.chips = o->chips;
+    a.last_seen_cycle = cycle;
+  }
+  for (auto& [key, a] : r.accounts) {
+    bool was_observed = observed.count(key) != 0;
+    if (a.first_seen_cycle == cycle && !a.paused) {
+      // New this cycle: dt spans a period before the root was tracked, so
+      // nothing accrues yet — the streak starts at 1.
+      a.idle_now = was_observed;
+      if (was_observed) a.idle_streak_cycles = 1;
+      continue;
+    }
+    if (a.paused) {
+      // Chips the pause freed keep accruing; series that outlive the
+      // scaled-away pods (metric retention) never double-count as idle.
+      a.reclaimed_chip_seconds += static_cast<double>(a.chips_when_paused) * dt;
+    } else if (was_observed) {
+      a.idle_seconds += dt;
+      ++a.idle_streak_cycles;
+    } else {
+      a.active_seconds += dt;
+      a.idle_streak_cycles = 0;
+    }
+    a.idle_now = was_observed;
+  }
+  checkpoint_locked(r);
+}
+
+void record_pause(uint64_t cycle, const std::string& kind, const std::string& ns,
+                  const std::string& name, const std::string& reason) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  Account& a = r.accounts[key_of(kind, ns, name)];
+  if (a.kind.empty()) {  // pause before any observation (shouldn't happen)
+    a.kind = kind;
+    a.ns = ns;
+    a.name = name;
+    a.first_seen_cycle = cycle;
+  }
+  if (a.paused) return;  // re-patch of an already-paused root (watch-cache off)
+  a.paused = true;
+  a.paused_since_unix = util::now_unix();
+  a.chips_when_paused = a.chips;
+  ++a.pauses;
+  push_event_locked(a, {cycle, a.paused_since_unix, "paused", reason, "tpu-pruner"});
+  checkpoint_locked(r);
+}
+
+void record_resume(uint64_t cycle, const std::string& kind, const std::string& ns,
+                   const std::string& name, const std::string& actor) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  auto it = r.accounts.find(key_of(kind, ns, name));
+  if (it == r.accounts.end() || !it->second.paused) return;
+  Account& a = it->second;
+  a.paused = false;
+  a.paused_since_unix = 0;
+  ++a.resumes;
+  push_event_locked(a, {cycle, util::now_unix(), "resumed", "", actor});
+  checkpoint_locked(r);
+}
+
+std::vector<PausedRoot> paused_roots() {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  std::vector<PausedRoot> out;
+  for (const auto& [key, a] : r.accounts) {
+    if (a.paused) out.push_back({a.kind, a.ns, a.name});
+  }
+  return out;
+}
+
+json::Value workloads_json(const std::string& query_string) {
+  std::string want_ns, sort = "reclaimed";
+  for (const std::string& pair : util::split(query_string, '&')) {
+    size_t eq = pair.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = pair.substr(0, eq);
+    std::string value = util::url_decode(pair.substr(eq + 1));
+    if (key == "ns" || key == "namespace") want_ns = value;
+    else if (key == "sort" && (value == "reclaimed" || value == "idle" || value == "chips")) {
+      sort = value;
+    }
+  }
+
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  std::vector<std::pair<const std::string*, const Account*>> rows;
+  double total_idle = 0, total_active = 0, total_reclaimed = 0;
+  for (const auto& [key, a] : r.accounts) {
+    total_idle += a.idle_seconds;
+    total_active += a.active_seconds;
+    total_reclaimed += a.reclaimed_chip_seconds;
+    if (!want_ns.empty() && a.ns != want_ns) continue;
+    rows.push_back({&key, &a});
+  }
+  std::stable_sort(rows.begin(), rows.end(), [&](const auto& x, const auto& y) {
+    const Account& a = *x.second;
+    const Account& b = *y.second;
+    if (sort == "idle") return a.idle_seconds > b.idle_seconds;
+    if (sort == "chips") return a.chips > b.chips;
+    return a.reclaimed_chip_seconds > b.reclaimed_chip_seconds;
+  });
+
+  json::Value workloads = json::Value::array();
+  for (const auto& [key, a] : rows) workloads.push_back(account_to_json(*key, *a));
+  json::Value totals = json::Value::object();
+  totals.set("idle_seconds", json::Value(round3(total_idle)));
+  totals.set("active_seconds", json::Value(round3(total_active)));
+  totals.set("reclaimed_chip_seconds", json::Value(round3(total_reclaimed)));
+  json::Value out = json::Value::object();
+  out.set("workloads", std::move(workloads));
+  out.set("tracked", json::Value(static_cast<int64_t>(r.accounts.size())));
+  out.set("totals", std::move(totals));
+  out.set("sort", json::Value(sort));
+  return out;
+}
+
+std::string render_metrics(int top_k, bool openmetrics) {
+  if (top_k < 1) top_k = 1;
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+
+  // Top-K accounts by chips (ties broken by key for determinism) get
+  // their own series; everything else folds into one "_other" series per
+  // family so totals still sum correctly but cardinality never scales
+  // with fleet size.
+  std::vector<std::pair<const std::string*, const Account*>> ranked;
+  ranked.reserve(r.accounts.size());
+  for (const auto& [key, a] : r.accounts) ranked.push_back({&key, &a});
+  std::sort(ranked.begin(), ranked.end(), [](const auto& x, const auto& y) {
+    if (x.second->chips != y.second->chips) return x.second->chips > y.second->chips;
+    return *x.first < *y.first;
+  });
+  size_t named = std::min(ranked.size(), static_cast<size_t>(top_k));
+  double other_idle = 0, other_reclaimed = 0;
+  int64_t other_chips = 0;
+  for (size_t i = named; i < ranked.size(); ++i) {
+    other_idle += ranked[i].second->idle_seconds;
+    other_reclaimed += ranked[i].second->reclaimed_chip_seconds;
+    other_chips += ranked[i].second->chips;
+  }
+  bool has_other = named < ranked.size();
+
+  auto family = [&](const std::string& name, const char* type, const std::string& help) {
+    // OpenMetrics reserves the `counter` type for families whose samples
+    // carry the _total suffix — the TYPE line then names the family
+    // WITHOUT it; the classic 0.0.4 format types the full sample name.
+    std::string fam = name;
+    if (openmetrics && std::string(type) == "counter" && fam.size() > 6 &&
+        fam.compare(fam.size() - 6, 6, "_total") == 0) {
+      fam = fam.substr(0, fam.size() - 6);
+    }
+    return "# HELP " + fam + " " + help + "\n# TYPE " + fam + " " + type + "\n";
+  };
+  auto esc = [](const std::string& s) { return json::escape(s); };
+
+  std::string body;
+  body += family("tpu_pruner_workload_idle_seconds_total", "counter",
+                 "Cumulative seconds a workload's TPU pods were observed idle "
+                 "(top-K by chips; _other = rollup of the rest)");
+  for (size_t i = 0; i < named; ++i) {
+    body += "tpu_pruner_workload_idle_seconds_total{workload=\"" + esc(*ranked[i].first) +
+            "\"} " + fmt_value(ranked[i].second->idle_seconds) + "\n";
+  }
+  if (has_other) {
+    body += "tpu_pruner_workload_idle_seconds_total{workload=\"_other\"} " +
+            fmt_value(other_idle) + "\n";
+  }
+
+  body += family("tpu_pruner_workload_reclaimed_chip_seconds_total", "counter",
+                 "Cumulative chip-seconds reclaimed: chips x time the root spent "
+                 "scaled-to-zero after the pruner paused it");
+  for (size_t i = 0; i < named; ++i) {
+    body += "tpu_pruner_workload_reclaimed_chip_seconds_total{workload=\"" +
+            esc(*ranked[i].first) + "\"} " +
+            fmt_value(ranked[i].second->reclaimed_chip_seconds) + "\n";
+  }
+  if (has_other) {
+    body += "tpu_pruner_workload_reclaimed_chip_seconds_total{workload=\"_other\"} " +
+            fmt_value(other_reclaimed) + "\n";
+  }
+
+  body += family("tpu_pruner_workload_chips", "gauge",
+                 "Chips a tracked workload requests, labelled with its current "
+                 "state (idle|active|paused; _other rollup carries state=_other)");
+  for (size_t i = 0; i < named; ++i) {
+    body += "tpu_pruner_workload_chips{workload=\"" + esc(*ranked[i].first) +
+            "\",state=\"" + ranked[i].second->state() + "\"} " +
+            std::to_string(ranked[i].second->chips) + "\n";
+  }
+  if (has_other) {
+    body += "tpu_pruner_workload_chips{workload=\"_other\",state=\"_other\"} " +
+            std::to_string(other_chips) + "\n";
+  }
+
+  body += family("tpu_pruner_workloads_tracked", "gauge",
+                 "Workload accounts the utilization ledger tracks");
+  body += "tpu_pruner_workloads_tracked " + std::to_string(r.accounts.size()) + "\n";
+  return body;
+}
+
+std::vector<std::string> metric_families() {
+  return {
+      "tpu_pruner_workload_idle_seconds_total",
+      "tpu_pruner_workload_reclaimed_chip_seconds_total",
+      "tpu_pruner_workload_chips",
+      "tpu_pruner_workloads_tracked",
+  };
+}
+
+void reset_for_test() {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  r.accounts.clear();
+  r.prev_cycle_unix = 0;
+  r.file_path.clear();
+}
+
+}  // namespace tpupruner::ledger

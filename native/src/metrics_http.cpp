@@ -104,6 +104,16 @@ void Server::set_decisions_provider(std::function<std::string(const std::string&
   decisions_provider_ = std::move(provider);
 }
 
+void Server::set_workloads_provider(std::function<std::string(const std::string&)> provider) {
+  std::lock_guard<std::mutex> lock(probe_mutex_);
+  workloads_provider_ = std::move(provider);
+}
+
+void Server::set_extra_metrics_provider(std::function<std::string(bool)> provider) {
+  std::lock_guard<std::mutex> lock(probe_mutex_);
+  extra_metrics_provider_ = std::move(provider);
+}
+
 std::string Server::render_exposition(bool openmetrics) const {
   // Counters/gauges, then histograms. Classic text format (0.0.4) keeps
   // the established names byte-for-byte; the OpenMetrics negotiation adds
@@ -144,6 +154,14 @@ std::string Server::render_exposition(bool openmetrics) const {
       body += metric + "_count" + bare_label + " " + std::to_string(h.count) + "\n";
     }
   }
+  // Provider-rendered families (the workload ledger's bounded-cardinality
+  // series) land after the registries and before the OpenMetrics EOF.
+  std::function<std::string(bool)> extra;
+  {
+    std::lock_guard<std::mutex> lock(probe_mutex_);
+    extra = extra_metrics_provider_;
+  }
+  if (extra) body += extra(openmetrics);
   if (openmetrics) body += "# EOF\n";
   return body;
 }
@@ -239,6 +257,20 @@ void Server::serve() {
         status = 404;
         status_text = "Not Found";
         body = "decision audit trail not enabled\n";
+      }
+    } else if (path == "/debug/workloads") {
+      std::function<std::string(const std::string&)> provider;
+      {
+        std::lock_guard<std::mutex> lock(probe_mutex_);
+        provider = workloads_provider_;
+      }
+      if (provider) {
+        content_type = "application/json";
+        body = provider(query);
+      } else {
+        status = 404;
+        status_text = "Not Found";
+        body = "workload ledger not enabled\n";
       }
     } else {
       content_type = want_openmetrics

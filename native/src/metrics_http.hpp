@@ -39,6 +39,17 @@ class Server {
   // and returns the JSON body. Unset → 404.
   void set_decisions_provider(std::function<std::string(const std::string&)> provider);
 
+  // /debug/workloads provider (the workload-ledger snapshot): receives the
+  // raw query string ("ns=…&sort=reclaimed") and returns the JSON body.
+  // Unset → 404.
+  void set_workloads_provider(std::function<std::string(const std::string&)> provider);
+
+  // Extra /metrics families rendered outside the counter/histogram
+  // registries (the ledger's bounded-cardinality workload series). The
+  // provider returns ready-made exposition text (HELP/TYPE included);
+  // the bool argument is the OpenMetrics negotiation.
+  void set_extra_metrics_provider(std::function<std::string(bool)> provider);
+
  private:
   void serve();
   std::string render_exposition(bool openmetrics) const;
@@ -49,7 +60,9 @@ class Server {
   std::function<bool()> probe_;
   std::function<bool()> ready_probe_;
   std::function<std::string(const std::string&)> decisions_provider_;
-  std::mutex probe_mutex_;
+  std::function<std::string(const std::string&)> workloads_provider_;
+  std::function<std::string(bool)> extra_metrics_provider_;
+  mutable std::mutex probe_mutex_;
   std::thread thread_;
 };
 

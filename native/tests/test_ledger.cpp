@@ -1,0 +1,195 @@
+// Workload utilization ledger unit tests: cycle integration math, the
+// pause/resume lifecycle, the top-K + "_other" cardinality rollup, event
+// history bounding, and the JSONL checkpoint round trip.
+#include "tpupruner/ledger.hpp"
+
+#include <unistd.h>
+
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "testing.hpp"
+#include "tpupruner/json.hpp"
+
+namespace ledger = tpupruner::ledger;
+using tpupruner::json::Value;
+
+namespace {
+
+ledger::Observation obs(const std::string& name, int64_t chips) {
+  return {"Deployment", "ml", name, chips};
+}
+
+const Value* workload(const Value& doc, const std::string& key) {
+  for (const Value& w : doc.find("workloads")->as_array()) {
+    if (w.get_string("workload") == key) return &w;
+  }
+  return nullptr;
+}
+
+double num(const Value& v, const char* k) {
+  const Value* x = v.find(k);
+  return x && x->is_number() ? x->as_double() : -1;
+}
+
+size_t count_series(const std::string& text, const std::string& family) {
+  // sample lines start with `family{` (labelled) or `family ` (bare)
+  size_t n = 0, pos = 0;
+  while ((pos = text.find("\n" + family, pos)) != std::string::npos) {
+    char next = text[pos + 1 + family.size()];
+    if (next == '{' || next == ' ') ++n;
+    pos += family.size();
+  }
+  return n;
+}
+
+}  // namespace
+
+TP_TEST(ledger_integrates_idle_active_and_reclaimed) {
+  ledger::reset_for_test();
+  // cycle 1: first sighting — nothing accrues, streak starts
+  ledger::observe_cycle(1, 1000, {obs("a", 4)});
+  // cycle 2 (+10s): still idle → idle_seconds
+  ledger::observe_cycle(2, 1010, {obs("a", 4)});
+  Value doc = ledger::workloads_json();
+  const Value* a = workload(doc, "Deployment/ml/a");
+  TP_CHECK(a != nullptr);
+  TP_CHECK_EQ(num(*a, "idle_seconds"), 10.0);
+  TP_CHECK_EQ(num(*a, "idle_streak_cycles"), 2.0);
+  TP_CHECK_EQ(a->get_string("state"), std::string("idle"));
+
+  // cycle 3 (+5s): absent from the idle set → active, streak resets
+  ledger::observe_cycle(3, 1015, {});
+  doc = ledger::workloads_json();
+  a = workload(doc, "Deployment/ml/a");
+  TP_CHECK_EQ(num(*a, "active_seconds"), 5.0);
+  TP_CHECK_EQ(num(*a, "idle_streak_cycles"), 0.0);
+  TP_CHECK_EQ(a->get_string("state"), std::string("active"));
+
+  // idle again, then paused: reclaimed accrues at chips-at-pause x dt,
+  // idle time stops (series outliving the pods must not double-count)
+  ledger::observe_cycle(4, 1020, {obs("a", 4)});
+  ledger::record_pause(4, "Deployment", "ml", "a", "SCALED");
+  ledger::observe_cycle(5, 1030, {obs("a", 4)});
+  ledger::observe_cycle(6, 1040, {});
+  doc = ledger::workloads_json();
+  a = workload(doc, "Deployment/ml/a");
+  TP_CHECK_EQ(num(*a, "reclaimed_chip_seconds"), 80.0);  // 4 chips x 20s
+  TP_CHECK_EQ(num(*a, "idle_seconds"), 15.0);            // 10 + 5 (cycle 4)
+  TP_CHECK_EQ(a->get_string("state"), std::string("paused"));
+  TP_CHECK_EQ(num(*a, "pauses"), 1.0);
+
+  // resume closes the reclaim window; idle accrual resumes on observation
+  ledger::record_resume(6, "Deployment", "ml", "a", "external");
+  ledger::observe_cycle(7, 1050, {obs("a", 4)});
+  doc = ledger::workloads_json();
+  a = workload(doc, "Deployment/ml/a");
+  TP_CHECK_EQ(num(*a, "reclaimed_chip_seconds"), 80.0);  // frozen
+  TP_CHECK_EQ(num(*a, "resumes"), 1.0);
+  TP_CHECK_EQ(num(*a, "idle_seconds"), 25.0);
+}
+
+TP_TEST(ledger_repatch_of_paused_root_is_noop) {
+  ledger::reset_for_test();
+  ledger::observe_cycle(1, 1000, {obs("a", 4)});
+  ledger::record_pause(1, "Deployment", "ml", "a", "SCALED");
+  // watch-cache-off re-patches land SCALED every cycle; the pause count
+  // and the savings clock must not restart
+  ledger::record_pause(2, "Deployment", "ml", "a", "SCALED");
+  ledger::record_pause(3, "Deployment", "ml", "a", "ALREADY_PAUSED");
+  Value doc = ledger::workloads_json();
+  const Value* a = workload(doc, "Deployment/ml/a");
+  TP_CHECK_EQ(num(*a, "pauses"), 1.0);
+  // resume without a pause is equally inert
+  ledger::record_resume(3, "Deployment", "ml", "b", "external");
+  TP_CHECK(workload(ledger::workloads_json(), "Deployment/ml/b") == nullptr);
+}
+
+TP_TEST(ledger_rollup_serves_topk_plus_other_and_sums) {
+  ledger::reset_for_test();
+  // 5 workloads, chips 1..5; two cycles so idle_seconds accrue
+  std::vector<ledger::Observation> fleet;
+  for (int i = 1; i <= 5; ++i) fleet.push_back(obs("w" + std::to_string(i), i));
+  ledger::observe_cycle(1, 1000, fleet);
+  ledger::observe_cycle(2, 1010, fleet);
+  std::string text = "\n" + ledger::render_metrics(/*top_k=*/2, false);
+
+  // exactly K + _other series per family
+  TP_CHECK_EQ(count_series(text, "tpu_pruner_workload_idle_seconds_total"), 3u);
+  TP_CHECK_EQ(count_series(text, "tpu_pruner_workload_reclaimed_chip_seconds_total"), 3u);
+  TP_CHECK_EQ(count_series(text, "tpu_pruner_workload_chips"), 3u);
+  TP_CHECK_EQ(count_series(text, "tpu_pruner_workloads_tracked"), 1u);
+  // top-K is by chips: w5 and w4 get their own series
+  TP_CHECK(text.find("{workload=\"Deployment/ml/w5\"} 10") != std::string::npos);
+  TP_CHECK(text.find("{workload=\"Deployment/ml/w4\"} 10") != std::string::npos);
+  // the rollup preserves totals: 3 remaining workloads x 10s idle,
+  // 1+2+3 chips
+  TP_CHECK(text.find("tpu_pruner_workload_idle_seconds_total{workload=\"_other\"} 30")
+           != std::string::npos);
+  TP_CHECK(text.find("tpu_pruner_workload_chips{workload=\"_other\",state=\"_other\"} 6")
+           != std::string::npos);
+  TP_CHECK(text.find("tpu_pruner_workloads_tracked 5") != std::string::npos);
+
+  // at or below K every workload is named and no rollup appears
+  std::string all = "\n" + ledger::render_metrics(/*top_k=*/5, false);
+  TP_CHECK_EQ(count_series(all, "tpu_pruner_workload_idle_seconds_total"), 5u);
+  TP_CHECK(all.find("\"_other\"") == std::string::npos);
+
+  // OpenMetrics form: counter families are typed WITHOUT the _total
+  // suffix (the classic form keeps the full sample name)
+  std::string om = ledger::render_metrics(2, true);
+  TP_CHECK(om.find("# TYPE tpu_pruner_workload_idle_seconds counter") != std::string::npos);
+  TP_CHECK(om.find("# TYPE tpu_pruner_workload_idle_seconds_total counter") == std::string::npos);
+  TP_CHECK(text.find("# TYPE tpu_pruner_workload_idle_seconds_total counter") != std::string::npos);
+}
+
+TP_TEST(ledger_event_history_is_bounded) {
+  ledger::reset_for_test();
+  ledger::observe_cycle(1, 1000, {obs("flappy", 4)});
+  for (uint64_t c = 0; c < 100; ++c) {
+    ledger::record_pause(c, "Deployment", "ml", "flappy", "SCALED");
+    ledger::record_resume(c, "Deployment", "ml", "flappy", "external");
+  }
+  Value doc = ledger::workloads_json();
+  const Value* a = workload(doc, "Deployment/ml/flappy");
+  TP_CHECK_EQ(num(*a, "pauses"), 100.0);
+  TP_CHECK_EQ(num(*a, "resumes"), 100.0);
+  TP_CHECK(a->find("events")->as_array().size() <= 32);
+}
+
+TP_TEST(ledger_checkpoint_roundtrip_restores_totals) {
+  std::string path = "/tmp/tp_test_ledger_" + std::to_string(::getpid()) + ".jsonl";
+  std::remove(path.c_str());
+  ledger::reset_for_test();
+  ledger::set_ledger_file(path);
+  ledger::observe_cycle(1, 1000, {obs("a", 4), obs("b", 8)});
+  ledger::observe_cycle(2, 1010, {obs("a", 4), obs("b", 8)});
+  ledger::record_pause(2, "Deployment", "ml", "a", "SCALED");
+  ledger::observe_cycle(3, 1025, {obs("b", 8)});
+  Value before = ledger::workloads_json();
+
+  // a fresh process restores the checkpoint and reproduces the totals
+  // exactly — its first cycle integrates nothing
+  ledger::reset_for_test();
+  ledger::set_ledger_file(path);
+  Value after = ledger::workloads_json();
+  TP_CHECK_EQ(num(*after.find("totals"), "reclaimed_chip_seconds"),
+              num(*before.find("totals"), "reclaimed_chip_seconds"));
+  TP_CHECK_EQ(num(*after.find("totals"), "idle_seconds"),
+              num(*before.find("totals"), "idle_seconds"));
+  const Value* a = workload(after, "Deployment/ml/a");
+  TP_CHECK_EQ(a->get_string("state"), std::string("paused"));
+  TP_CHECK_EQ(num(*a, "reclaimed_chip_seconds"), 60.0);  // 4 chips x 15s
+  TP_CHECK_EQ(num(*a, "pauses"), 1.0);
+  // the restored clock starts fresh: cycle 1 of the new process adds 0
+  ledger::observe_cycle(1, 5000, {obs("b", 8)});
+  Value again = ledger::workloads_json();
+  TP_CHECK_EQ(num(*again.find("totals"), "reclaimed_chip_seconds"), 60.0);
+  // ...and the next cycle accrues from the new baseline
+  ledger::observe_cycle(2, 5010, {obs("b", 8)});
+  again = ledger::workloads_json();
+  TP_CHECK_EQ(num(*again.find("totals"), "reclaimed_chip_seconds"), 100.0);
+  ledger::reset_for_test();
+  std::remove(path.c_str());
+}

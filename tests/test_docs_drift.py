@@ -20,7 +20,8 @@ from tpu_pruner import native
 from tpu_pruner.native import DAEMON_PATH
 from tpu_pruner.testing import FakeK8s, FakePrometheus
 
-OPERATIONS = Path(__file__).resolve().parent.parent / "docs" / "OPERATIONS.md"
+REPO = Path(__file__).resolve().parent.parent
+OPERATIONS = REPO / "docs" / "OPERATIONS.md"
 
 
 def test_every_reason_code_documented(built):
@@ -31,6 +32,32 @@ def test_every_reason_code_documented(built):
     assert not missing, (
         f"DecisionRecord reason codes missing from docs/OPERATIONS.md: {missing} "
         "— document each code in the 'Explaining a decision' section")
+
+
+def test_every_ledger_metric_family_documented(built):
+    """The workload-ledger family names come from the native canonical
+    list (like the audit codes) so a family added to ledger.cpp without a
+    runbook row fails here even when the serving test's daemon happens
+    not to exercise it."""
+    doc = OPERATIONS.read_text()
+    families = native.ledger_metric_families()
+    assert len(families) >= 4
+    missing = [f for f in families if f not in doc]
+    assert not missing, (
+        f"ledger metric families missing from docs/OPERATIONS.md: {missing} "
+        "— document each in the Observability table and the 'Accounting "
+        "for savings' section")
+
+
+def test_ledger_bench_summary_fields_documented():
+    """Every ledger-derived bench summary field must be in BENCH_FIELDS.md
+    AND actually emitted by bench.py — a drift on either side fails."""
+    bench_src = (REPO / "bench.py").read_text()
+    fields_doc = (REPO / "docs" / "BENCH_FIELDS.md").read_text()
+    for field in ("reclaimed_chip_hours", "tracked_workloads"):
+        assert f'"{field}"' in bench_src, f"bench.py no longer emits {field}"
+        assert field in fields_doc, (
+            f"bench summary field {field} missing from docs/BENCH_FIELDS.md")
 
 
 def test_every_served_metric_documented(built):

@@ -1,0 +1,50 @@
+"""Justfile drift guard: `just verify` must run the ROADMAP.md tier-1
+command VERBATIM.
+
+ROADMAP.md is the single source of truth for the tier-1 verify line (the
+driver runs it as written). A `just verify` that silently drifts —
+dropped plugin pins, a different timeout, a narrower test selection —
+would let local runs pass while the canonical gate fails. This test
+fails the build when the two diverge in either direction.
+"""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def roadmap_tier1_command() -> str:
+    text = (REPO / "ROADMAP.md").read_text()
+    m = re.search(r"\*\*Tier-1 verify:\*\*\s*`([^`]+)`", text)
+    assert m, "ROADMAP.md no longer carries a **Tier-1 verify:** `...` line"
+    return m.group(1).strip()
+
+
+def justfile_verify_command() -> str:
+    lines = (REPO / "justfile").read_text().splitlines()
+    body = []
+    in_recipe = False
+    for line in lines:
+        if re.match(r"^verify\s*:", line):
+            in_recipe = True
+            continue
+        if in_recipe:
+            if line and not line[0].isspace():  # next top-level item
+                break
+            stripped = line.strip()
+            if not stripped or stripped.startswith("#!"):
+                continue  # shebang line (bash: the command uses PIPESTATUS)
+            body.append(stripped)
+    assert body, "justfile has no `verify:` recipe"
+    return " ".join(body)
+
+
+def test_just_verify_matches_roadmap_tier1():
+    roadmap = roadmap_tier1_command()
+    justfile = justfile_verify_command()
+    assert justfile == roadmap, (
+        "`just verify` drifted from the ROADMAP.md tier-1 command:\n"
+        f"  roadmap:  {roadmap}\n"
+        f"  justfile: {justfile}\n"
+        "Update the justfile recipe (or ROADMAP.md) so they match verbatim.")

@@ -1,0 +1,353 @@
+"""Workload utilization ledger tests (the observability tentpole).
+
+Drives the REAL daemon binary against the hermetic fakes and asserts the
+capacity-accounting contract end to end: monotonically increasing
+reclaimed chip-seconds for a paused root across cycles, the same numbers
+on /metrics, /debug/workloads and `analyze --fleet-report`, survival of
+cumulative totals across a daemon restart from --ledger-file, bounded
+/metrics label cardinality (top-K + _other rollup), and external-resume
+detection via the informer.
+"""
+
+import json
+import re
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+class LedgerDaemon:
+    """Daemon-mode run with --metrics-port auto; port parsed from stderr."""
+
+    def __init__(self, fake_prom, fake_k8s, *extra_args):
+        cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+               "--run-mode", "scale-down", "--daemon-mode",
+               "--check-interval", "1", "--metrics-port", "auto", *extra_args]
+        env = {"KUBE_API_URL": fake_k8s.url, "PATH": "/usr/bin:/bin"}
+        self.proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                                     stderr=subprocess.PIPE, text=True)
+        self.port = None
+        for line in self.proc.stderr:
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                self.port = int(m.group(1))
+                break
+        assert self.port, "daemon never reported its metrics port"
+
+    def get(self, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.port}{path}", timeout=5) as resp:
+            return resp.read().decode()
+
+    def workloads(self, query=""):
+        return json.loads(self.get("/debug/workloads" + query))
+
+    def reclaimed_series(self):
+        """workload → value of tpu_pruner_workload_reclaimed_chip_seconds_total."""
+        body = self.get("/metrics")
+        return {m.group(1): float(m.group(2)) for m in re.finditer(
+            r'tpu_pruner_workload_reclaimed_chip_seconds_total\{workload="([^"]+)"\} '
+            r'([0-9.e+-]+)', body)}
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+        self.proc.wait(timeout=10)
+
+
+def wait_until(predicate, timeout=30, interval=0.2):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            last = predicate()
+        except OSError:  # daemon still wiring its providers (404) / booting
+            last = None
+        if last:
+            return last
+        time.sleep(interval)
+    raise AssertionError(f"condition never held (last={last!r})")
+
+
+WL = "Deployment/ml/trainer"
+
+
+# ── acceptance pipeline: ≥3 cycles, monotonic reclaimed, 3-surface
+#    consistency, restart continuity ─────────────────────────────────────
+
+
+def test_ledger_pipeline_reclaimed_monotonic_consistent_and_durable(
+        built, fake_prom, fake_k8s, tmp_path):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=2,
+                                               tpu_chips=4)
+    for pod in pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml", chips=4)
+    ledger_file = tmp_path / "ledger.jsonl"
+
+    d = LedgerDaemon(fake_prom, fake_k8s, "--ledger-file", str(ledger_file))
+    try:
+        # the pause lands in cycle 1; reclaimed chip-seconds then accrue
+        # every cycle — sample three strictly increasing values
+        wait_until(lambda: fake_k8s.scale_patches())
+        samples = []
+        for _ in range(3):
+            prev = samples[-1] if samples else 0
+            samples.append(wait_until(
+                lambda: (lambda v: v if v > prev else None)(
+                    d.reclaimed_series().get(WL, 0.0))))
+        assert samples == sorted(samples) and samples[0] > 0
+
+        # same numbers via /debug/workloads as via /metrics: accrual only
+        # moves at cycle boundaries, so bracket the snapshot between two
+        # identical /metrics scrapes (retry across cycle edges)
+        for _ in range(20):
+            before = d.reclaimed_series()[WL]
+            doc = d.workloads()
+            after = d.reclaimed_series()[WL]
+            if before == after:
+                break
+        assert before == after, "never caught a stable inter-cycle window"
+        (entry,) = [w for w in doc["workloads"] if w["workload"] == WL]
+        assert entry["reclaimed_chip_seconds"] == before
+        assert entry["state"] == "paused"
+        assert entry["chips"] == 8  # 2 pods x 4 chips
+        assert entry["pauses"] == 1 and entry["resumes"] == 0
+        assert entry["events"][0]["action"] == "paused"
+        assert entry["events"][0]["reason"] == "SCALED"
+        assert entry["events"][0]["actor"] == "tpu-pruner"
+        # ns filter + sort plumbing
+        assert d.workloads("?ns=nope")["workloads"] == []
+        assert d.workloads("?ns=ml&sort=chips")["workloads"][0][
+            "workload"] == WL
+    finally:
+        d.stop()
+
+    # the checkpoint carries the trail; --fleet-report agrees with it
+    lines = [json.loads(l) for l in ledger_file.read_text().splitlines() if l]
+    (acct,) = [l for l in lines if l["workload"] == WL]
+    assert acct["state"] == "paused"
+    file_reclaimed = acct["reclaimed_chip_seconds"]
+    assert file_reclaimed >= before  # cycles may have run after our scrape
+
+    rep = subprocess.run(
+        ["python", "-m", "tpu_pruner.analyze", "--fleet-report",
+         "--ledger-file", str(ledger_file)],
+        capture_output=True, text=True, timeout=60)
+    assert rep.returncode == 0, rep.stderr
+    report = json.loads(rep.stdout)
+    assert report["tracked_workloads"] == 1
+    assert report["reclaimed_chip_hours"] == round(file_reclaimed / 3600, 3)
+    assert report["pause_events"] == 1
+    assert report["namespaces"][0]["namespace"] == "ml"
+    assert report["top_offenders"][0]["workload"] == WL
+    assert "chip-hours reclaimed" in rep.stderr
+
+    # restart from the checkpoint: the first cycle integrates nothing, so
+    # cumulative totals are identical to the file's before new accrual
+    d2 = LedgerDaemon(fake_prom, fake_k8s, "--ledger-file", str(ledger_file),
+                      "--check-interval", "60")
+    try:
+        doc = wait_until(lambda: (lambda w: w if w["workloads"] else None)(
+            d2.workloads()))
+        (entry,) = [w for w in doc["workloads"] if w["workload"] == WL]
+        assert entry["reclaimed_chip_seconds"] == file_reclaimed
+        assert entry["state"] == "paused"
+        assert entry["pauses"] == 1  # the restart's re-patch is not a new pause
+        assert d2.reclaimed_series()[WL] == file_reclaimed
+    finally:
+        d2.stop()
+
+
+# ── satellite: scripted duty-cycle series drive idle→active→idle ───────────
+
+
+def test_scripted_series_advance_per_query(fake_prom):
+    """fake_prom unit contract: values[i] scripts the i-th instant query;
+    None = absent (busy); the last entry repeats."""
+    fake_prom.add_idle_pod_series("static", "ml")
+    fake_prom.add_scripted_pod_series("flappy", "ml", [0.0, None, 0.0])
+
+    def pods_in_response():
+        body = urllib.request.urlopen(
+            fake_prom.url + "/api/v1/query?query=up", timeout=5).read()
+        return {s["metric"].get("exported_pod")
+                for s in json.loads(body)["data"]["result"]}
+
+    assert pods_in_response() == {"static", "flappy"}   # query 0: idle
+    assert pods_in_response() == {"static"}             # query 1: busy
+    assert pods_in_response() == {"static", "flappy"}   # query 2: idle
+    assert pods_in_response() == {"static", "flappy"}   # query 3: last repeats
+
+
+def test_ledger_idle_active_idle_transitions(built, fake_prom, fake_k8s):
+    """A workload that goes idle→active→idle accrues BOTH idle and active
+    seconds, and the active cycle resets the idle streak."""
+    fake_k8s.add_deployment_chain("ml", "flappy", num_pods=1, tpu_chips=4)
+    # idle for 2 cycles, busy for 2, then idle for the rest
+    fake_prom.add_scripted_pod_series("flappy-abc123-0", "ml",
+                                      [0.0, 0.0, None, None, 0.0])
+
+    d = LedgerDaemon(fake_prom, fake_k8s, "--run-mode", "dry-run")
+    try:
+        entry = wait_until(lambda: next(
+            (w for w in d.workloads()["workloads"]
+             if w["workload"] == "Deployment/ml/flappy"
+             and w["idle_seconds"] > 0 and w["active_seconds"] > 0
+             and w["state"] == "idle"), None))
+        # dry-run never pauses: the account keeps both sides of the book
+        assert entry["pauses"] == 0
+        assert entry["reclaimed_chip_seconds"] == 0
+        # the busy window reset the streak, so streak < total idle cycles
+        assert entry["idle_streak_cycles"] >= 1
+    finally:
+        d.stop()
+
+
+# ── satellite: /metrics label-cardinality bounding ─────────────────────────
+
+
+def test_metric_cardinality_bounded_with_other_rollup(built):
+    """With more workloads than K, each family serves exactly K + _other
+    series and the totals still sum correctly."""
+    idle = [{"kind": "Deployment", "namespace": f"ns{i % 3}",
+             "name": f"w{i}", "chips": i + 1} for i in range(7)]
+    out = native.ledger_sim(3, [
+        {"now": 1000, "idle": idle,
+         "pauses": [{"kind": "Deployment", "namespace": "ns0",
+                     "name": "w6", "reason": "SCALED"}]},
+        {"now": 1010, "idle": idle},
+        {"now": 1030, "idle": idle},
+    ])
+    text = "\n" + out["metrics"]
+    for family in ("tpu_pruner_workload_idle_seconds_total",
+                   "tpu_pruner_workload_reclaimed_chip_seconds_total",
+                   "tpu_pruner_workload_chips"):
+        series = re.findall(rf'\n{family}\{{workload="([^"]+)"[^}}]*\}} '
+                            rf'([0-9.e+-]+)', text)
+        assert len(series) == 4, (family, series)  # K=3 + _other
+        assert [w for w, _ in series].count("_other") == 1
+
+    # totals survive the rollup: sum of served series == full-fleet sum
+    workloads = out["workloads"]["workloads"]
+    for family, key in (
+            ("tpu_pruner_workload_idle_seconds_total", "idle_seconds"),
+            ("tpu_pruner_workload_reclaimed_chip_seconds_total",
+             "reclaimed_chip_seconds"),
+            ("tpu_pruner_workload_chips", "chips")):
+        served = sum(float(v) for _, v in re.findall(
+            rf'\n{family}\{{workload="([^"]+)"[^}}]*\}} ([0-9.e+-]+)', text))
+        assert served == pytest.approx(
+            sum(w[key] for w in workloads)), family
+    assert "tpu_pruner_workloads_tracked 7" in text
+
+    # at or below K: every workload named, no rollup
+    out_all = native.ledger_sim(7, [{"now": 1000, "idle": idle}])
+    assert '"_other"' not in out_all["metrics"]
+
+
+def test_daemon_metrics_respect_ledger_top_k(built, fake_prom, fake_k8s):
+    """--ledger-top-k bounds the daemon's served cardinality end to end."""
+    for i in range(4):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}",
+                                                   num_pods=1, tpu_chips=4)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    d = LedgerDaemon(fake_prom, fake_k8s, "--ledger-top-k", "2")
+    try:
+        wait_until(lambda: len(fake_k8s.scale_patches()) == 4)
+        body = wait_until(lambda: (lambda b:
+            b if "tpu_pruner_workloads_tracked 4" in b else None)(
+                d.get("/metrics")))
+        series = re.findall(
+            r'tpu_pruner_workload_idle_seconds_total\{workload="([^"]+)"\}', body)
+        assert len(series) == 3 and "_other" in series
+    finally:
+        d.stop()
+
+
+# ── satellite: resume detection via the informer ───────────────────────────
+
+
+def test_external_resume_detected_via_informer(built, fake_prom, fake_k8s):
+    """An operator re-scaling a paused root (a real scale-up PATCH against
+    the API) must surface in the ledger as a resume event — detected from
+    the watch store, no polling — and the root's later re-pause opens a
+    fresh reclaim window."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=1,
+                                               tpu_chips=4)
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml", chips=4)
+    dep_path = "/apis/apps/v1/namespaces/ml/deployments/trainer"
+
+    d = LedgerDaemon(fake_prom, fake_k8s, "--watch-cache", "on")
+    try:
+        wait_until(lambda: fake_k8s.scale_patches())
+        wait_until(lambda: any(w["state"] == "paused"
+                               for w in d.workloads()["workloads"]))
+
+        # operator resume: a real scale-up PATCH over HTTP — recorded by
+        # the fake (resume_patches) and journaled into the watch stream
+        body = json.dumps({"spec": {"replicas": 2}}).encode()
+        req = urllib.request.Request(
+            fake_k8s.url + dep_path, data=body, method="PATCH",
+            headers={"Content-Type": "application/merge-patch+json"})
+        urllib.request.urlopen(req, timeout=5)
+        assert fake_k8s.resume_patches() == [(dep_path, {"spec": {"replicas": 2}})]
+
+        entry = wait_until(lambda: next(
+            (w for w in d.workloads()["workloads"]
+             if w["workload"] == WL and w["resumes"] >= 1), None))
+        resumed = [e for e in entry["events"] if e["action"] == "resumed"]
+        assert resumed and resumed[0]["actor"] == "external"
+
+        # the still-idle pods re-pause the root: a second pause event
+        entry = wait_until(lambda: next(
+            (w for w in d.workloads()["workloads"]
+             if w["workload"] == WL and w["pauses"] >= 2
+             and w["state"] == "paused"), None))
+        actions = [e["action"] for e in entry["events"]]
+        assert actions[:3] == ["paused", "resumed", "paused"]
+    finally:
+        d.stop()
+
+
+def test_resume_root_helper_emits_watch_event(fake_k8s):
+    """fake_k8s.resume_root flips the paused state in the store and
+    journals MODIFIED — the seam informer-driven tests build on."""
+    fake_k8s.add_deployment("ml", "dep")
+    fake_k8s.objects["/apis/apps/v1/namespaces/ml/deployments/dep"][
+        "spec"]["replicas"] = 0
+    log_before = len(fake_k8s._watch_log)
+    obj = fake_k8s.resume_root("/apis/apps/v1/namespaces/ml/deployments/dep",
+                               replicas=3)
+    assert obj["spec"]["replicas"] == 3
+    ev = fake_k8s._watch_log[-1]
+    assert len(fake_k8s._watch_log) == log_before + 1
+    assert ev["type"] == "MODIFIED"
+    assert ev["object"]["spec"]["replicas"] == 3
+
+    js = fake_k8s.add_jobset("tpu", "slice")
+    js["spec"]["suspend"] = True
+    out = fake_k8s.resume_root(
+        "/apis/jobset.x-k8s.io/v1alpha2/namespaces/tpu/jobsets/slice")
+    assert out["spec"]["suspend"] is False

@@ -175,6 +175,40 @@ def test_readyz_distinct_from_healthz(daemon):
     assert (status, body) == (200, "ok\n")
 
 
+def test_informer_families_omitted_when_watch_cache_off(daemon):
+    """With --watch-cache off there is no informer: serving its gauges
+    anyway (as 0/garbage) would read as "synced: no, stale forever" on a
+    dashboard. The families must be ABSENT, not zero."""
+    body = daemon.wait_for_cycle()
+    for family in ("tpu_pruner_informer_staleness_seconds",
+                   "tpu_pruner_informer_synced",
+                   "tpu_pruner_informer_objects"):
+        assert family not in body, f"{family} served without an informer"
+
+
+def test_informer_staleness_bounded_when_resource_never_syncs(
+        built, fake_prom, fake_k8s):
+    """A resource that never completes its first LIST (here: a denied
+    cluster-scoped pods LIST) used to make the staleness gauge report the
+    steady clock's epoch distance — machine uptime, i.e. garbage. It must
+    be anchored to cache start: present, but bounded by process age."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    fake_k8s.fail_next("GET", "/api/v1/pods", code=503, times=-1)
+    d = MetricsDaemon(fake_prom, fake_k8s, "--watch-cache", "on")
+    try:
+        d.wait_for_cycle()
+        _, _, body = d.get("/metrics")
+        m = re.search(r"tpu_pruner_informer_staleness_seconds (\d+)", body)
+        assert m, "staleness gauge missing with --watch-cache on"
+        # the daemon waits up to 10s for initial sync; anything within a
+        # couple of minutes is process-relative, machine uptime is not
+        assert int(m.group(1)) < 300, f"garbage staleness: {m.group(1)}s"
+        assert re.search(r"tpu_pruner_informer_synced 0", body)
+    finally:
+        d.stop()
+
+
 def test_debug_decisions_served_and_filterable(daemon):
     daemon.wait_for_cycle()
     _, ctype, body = daemon.get("/debug/decisions")

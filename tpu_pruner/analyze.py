@@ -31,6 +31,16 @@ one pod: per cycle, the observed signal, the resolved owner chain, and
 the machine-readable reason the pod was (or was NOT) acted on. Human
 lines go to stderr, one JSON document to stdout.
 
+Fleet-savings mode (`--fleet-report`): read the daemon's workload
+utilization ledger — either the `--ledger-file` JSONL checkpoint or the
+live `/debug/workloads` endpoint (`--workloads-url http://host:8080`) —
+and render the capacity-accounting answer operators budget against: a
+per-namespace savings table (chip-hours reclaimed, workload counts,
+pause/resume churn) plus the top offenders by wasted capacity. Human
+table on stderr, one machine-readable JSON summary on stdout (bench.py
+folds its `reclaimed_chip_hours` / `tracked_workloads` fields into the
+benchmark summary).
+
 Incremental mode (`--stream STATE.npz`): successive invocations feed
 successive dumps (one per daemon cycle); the two-level sliding-window
 engine (engine.py streaming block) folds each dump's samples into a ring
@@ -269,6 +279,108 @@ def _run_explain(args) -> int:
     return 0
 
 
+def _load_workload_records(args) -> list[dict]:
+    """Workload accounts from the ledger JSONL checkpoint or /debug/workloads."""
+    if args.ledger_file:
+        records = []
+        with open(args.ledger_file) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    # a torn tail line can only exist if the atomic-rename
+                    # checkpoint was interrupted pre-rename; tolerate it
+                    print(f"WARNING: skipping unparseable ledger line {lineno}",
+                          file=sys.stderr)
+        return records
+    import urllib.request
+
+    url = args.workloads_url.rstrip("/") + "/debug/workloads"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.load(resp)["workloads"]
+
+
+def _run_fleet_report(args) -> int:
+    """Per-namespace savings report over the workload utilization ledger."""
+    records = _load_workload_records(args)
+
+    namespaces: dict[str, dict] = {}
+    pause_events = resume_events = 0
+    for r in records:
+        ns = r.get("namespace", "")
+        agg = namespaces.setdefault(ns, {
+            "namespace": ns, "workloads": 0, "chips": 0,
+            "reclaimed_chip_hours": 0.0, "idle_hours": 0.0,
+            "active_hours": 0.0, "pauses": 0, "resumes": 0,
+        })
+        agg["workloads"] += 1
+        agg["chips"] += int(r.get("chips", 0))
+        agg["reclaimed_chip_hours"] += float(r.get("reclaimed_chip_seconds", 0)) / 3600
+        agg["idle_hours"] += float(r.get("idle_seconds", 0)) / 3600
+        agg["active_hours"] += float(r.get("active_seconds", 0)) / 3600
+        agg["pauses"] += int(r.get("pauses", 0))
+        agg["resumes"] += int(r.get("resumes", 0))
+        pause_events += int(r.get("pauses", 0))
+        resume_events += int(r.get("resumes", 0))
+
+    ns_rows = sorted(namespaces.values(),
+                     key=lambda a: a["reclaimed_chip_hours"], reverse=True)
+    offenders = sorted(records,
+                       key=lambda r: float(r.get("reclaimed_chip_seconds", 0)),
+                       reverse=True)[:10]
+    total_reclaimed = sum(a["reclaimed_chip_hours"] for a in ns_rows)
+
+    if not records:
+        print("ledger is empty: no workloads tracked yet", file=sys.stderr)
+    else:
+        print(f"{'namespace':32s} {'workloads':>9s} {'chips':>6s} "
+              f"{'reclaimed chip-hrs':>18s} {'idle hrs':>9s} {'pauses':>6s} "
+              f"{'resumes':>7s}", file=sys.stderr)
+        for a in ns_rows:
+            print(f"{a['namespace']:32s} {a['workloads']:9d} {a['chips']:6d} "
+                  f"{a['reclaimed_chip_hours']:18.3f} {a['idle_hours']:9.3f} "
+                  f"{a['pauses']:6d} {a['resumes']:7d}", file=sys.stderr)
+        print(f"\ntotal: {total_reclaimed:.3f} chip-hours reclaimed across "
+              f"{len(records)} tracked workload(s); {pause_events} pause / "
+              f"{resume_events} resume event(s)", file=sys.stderr)
+        print("\ntop offenders (reclaimed capacity):", file=sys.stderr)
+        for r in offenders:
+            if float(r.get("reclaimed_chip_seconds", 0)) <= 0:
+                continue
+            wl = r.get("workload") or (f"{r.get('kind')}/{r.get('namespace')}"
+                                       f"/{r.get('name')}")
+            print(f"  {wl:48s} {float(r['reclaimed_chip_seconds']) / 3600:10.3f} "
+                  f"chip-hrs ({r.get('state', '?')})", file=sys.stderr)
+
+    def round3(x):
+        return round(x, 3)
+
+    print(json.dumps({
+        "tracked_workloads": len(records),
+        "reclaimed_chip_hours": round3(total_reclaimed),
+        "idle_workload_hours": round3(sum(a["idle_hours"] for a in ns_rows)),
+        "pause_events": pause_events,
+        "resume_events": resume_events,
+        "namespaces": [{k: (round3(v) if isinstance(v, float) else v)
+                        for k, v in a.items()} for a in ns_rows],
+        "top_offenders": [
+            {"workload": r.get("workload") or (f"{r.get('kind')}/"
+                                               f"{r.get('namespace')}/"
+                                               f"{r.get('name')}"),
+             "state": r.get("state"),
+             "chips": int(r.get("chips", 0)),
+             "reclaimed_chip_hours": round3(
+                 float(r.get("reclaimed_chip_seconds", 0)) / 3600),
+             "pauses": int(r.get("pauses", 0)),
+             "resumes": int(r.get("resumes", 0))}
+            for r in offenders if float(r.get("reclaimed_chip_seconds", 0)) > 0],
+    }))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tpu_pruner.analyze", description=__doc__,
@@ -286,6 +398,19 @@ def main(argv=None) -> int:
     parser.add_argument("--decisions-url", metavar="URL",
                         help="with --explain: query /debug/decisions on the "
                              "daemon's metrics port (e.g. http://host:8080)")
+    parser.add_argument("--fleet-report", action="store_true",
+                        help="fleet-savings mode: render the per-namespace "
+                             "savings table (chip-hours reclaimed, top "
+                             "offenders, pause/resume churn) from the "
+                             "workload utilization ledger instead of "
+                             "evaluating a dump")
+    parser.add_argument("--ledger-file", metavar="FILE",
+                        help="with --fleet-report: read the daemon's "
+                             "--ledger-file JSONL checkpoint")
+    parser.add_argument("--workloads-url", metavar="URL",
+                        help="with --fleet-report: query /debug/workloads on "
+                             "the daemon's metrics port (e.g. "
+                             "http://host:8080)")
     parser.add_argument("--lookback-s", type=float, default=None,
                         help="override lookback seconds (default: dump value or 2100)")
     parser.add_argument("--hbm-threshold", type=float, default=None,
@@ -311,6 +436,16 @@ def main(argv=None) -> int:
                         help="with --stream: discard STATE and start a fresh "
                              "window from this dump")
     args = parser.parse_args(argv)
+    if args.fleet_report:
+        if args.explain:
+            parser.error("--fleet-report and --explain are mutually exclusive")
+        if bool(args.ledger_file) == bool(args.workloads_url):
+            parser.error("--fleet-report needs exactly one of --ledger-file "
+                         "or --workloads-url")
+        return _run_fleet_report(args)
+    if args.ledger_file or args.workloads_url:
+        parser.error("--ledger-file/--workloads-url only apply with "
+                     "--fleet-report")
     if args.explain:
         if bool(args.audit_log) == bool(args.decisions_url):
             parser.error("--explain needs exactly one of --audit-log or "

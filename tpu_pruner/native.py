@@ -154,6 +154,8 @@ def load() -> ctypes.CDLL:
         "tp_target_meta",
         "tp_otlp_grpc_call",
         "tp_audit_reason_codes",
+        "tp_ledger_sim",
+        "tp_ledger_metric_families",
         "tp_informer_start",
         "tp_informer_stats",
         "tp_informer_get",
@@ -235,6 +237,25 @@ def audit_reason_codes() -> list[str]:
     every code the daemon can emit, in enum order. The docs drift-guard
     test joins this list against docs/OPERATIONS.md."""
     return _call("tp_audit_reason_codes", {})["codes"]
+
+
+def ledger_sim(top_k: int, cycles: list[dict], query: str = "") -> dict:
+    """Replay scripted cycles through the REAL workload-ledger accounting
+    (native/src/ledger.cpp) with injected timestamps — the deterministic
+    test seam for integration math and /metrics cardinality bounding.
+
+    Each cycle: {"now": unix_ts, "idle": [{kind, namespace, name, chips}],
+    "pauses": [...], "resumes": [...]}. Returns {"workloads": <the
+    /debug/workloads body for `query`>, "metrics": <classic exposition
+    text>, "metrics_openmetrics": <OpenMetrics form>}."""
+    return _call("tp_ledger_sim",
+                 {"top_k": top_k, "cycles": cycles, "query": query})
+
+
+def ledger_metric_families() -> list[str]:
+    """Canonical workload-ledger metric family names served on /metrics —
+    the docs drift-guard test joins this list against docs/OPERATIONS.md."""
+    return _call("tp_ledger_metric_families", {})["families"]
 
 
 class InformerSession:

@@ -626,6 +626,46 @@ class FakeK8s:
     def patches_for(self, path_suffix):
         return [b for p, b in self.patches if p.endswith(path_suffix)]
 
+    def resume_patches(self):
+        """Landed patches that bring a root BACK UP (replicas>0,
+        suspend=false, minReplicas>0, or removal of the Kubeflow stop
+        annotation) — operator/test resume actions. The daemon only ever
+        scales down, so anything here came from outside it; ledger tests
+        assert resume detection against this record."""
+        out = []
+        for p, b in self.patches:
+            spec = b.get("spec") or {}
+            replicas = spec.get("replicas")
+            min_replicas = (spec.get("predictor") or {}).get("minReplicas")
+            annotations = (b.get("metadata") or {}).get("annotations") or {}
+            if ((isinstance(replicas, int) and replicas > 0)
+                    or spec.get("suspend") is False
+                    or (isinstance(min_replicas, int) and min_replicas > 0)
+                    or ("kubeflow-resource-stopped" in annotations
+                        and annotations["kubeflow-resource-stopped"] is None)):
+                out.append((p, b))
+        return out
+
+    def resume_root(self, path, replicas=2):
+        """Re-scale a paused root back up — what an operator's `kubectl
+        scale` / unsuspend does. Flips the kind's paused state on the
+        stored object and journals the MODIFIED watch event, so an
+        informer-backed daemon observes the resume without polling.
+        Returns the updated object."""
+        obj = copy.deepcopy(self.objects[path])
+        if "/jobsets/" in path:
+            obj.setdefault("spec", {})["suspend"] = False
+        elif "/notebooks/" in path:
+            (obj.get("metadata", {}).get("annotations") or {}).pop(
+                "kubeflow-resource-stopped", None)
+        elif "/inferenceservices/" in path:
+            obj.setdefault("spec", {}).setdefault("predictor", {})[
+                "minReplicas"] = replicas
+        else:
+            obj.setdefault("spec", {})["replicas"] = replicas
+        self.objects[path] = obj  # reassign: stamps rv + emits MODIFIED
+        return obj
+
     # ── lifecycle ──────────────────────────────────────────────────────
     def _make_handler(self):
         fake = self

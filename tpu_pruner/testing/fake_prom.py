@@ -51,6 +51,11 @@ def promql_structure_error(query: str) -> str | None:
 class FakePrometheus:
     def __init__(self):
         self.series: list[dict] = []
+        # time-advancing per-pod series: [{"labels": {...}, "values": [...]}]
+        # where values[i] scripts the i-th instant query served (see
+        # add_scripted_pod_series)
+        self.scripted_series: list[dict] = []
+        self.instant_queries_served = 0  # advances the scripts, one per query
         self.queries: list[str] = []
         self.query_paths: list[str] = []  # full request paths (Cloud Monitoring prefix checks)
         self.query_times: list[float] = []  # time.monotonic() per query (cycle windowing)
@@ -126,6 +131,45 @@ class FakePrometheus:
                 },
                 "value": [time.time(), str(value)],
             })
+        self._version += 1
+
+    def add_scripted_pod_series(
+        self,
+        pod: str,
+        namespace: str,
+        values: list,
+        container: str = "main",
+        accelerator_type: str = "tpu-v5-lite-podslice",
+        chips: int = 1,
+        exported: bool = True,
+        extra_labels: dict | None = None,
+    ) -> None:
+        """Time-advancing duty-cycle series: `values[i]` scripts the i-th
+        instant query this fake serves (i.e. the daemon's i-th cycle).
+
+        A float means the pod's series is present with that value — the
+        daemon's `== 0` idle query only ever returns idle rows, so 0.0
+        models an idle cycle. ``None`` means the series is ABSENT from
+        that response: the pod was busy that cycle (a real Prometheus
+        returns no row for it). The last entry repeats once the script is
+        exhausted, so tests don't have to predict exact cycle counts.
+        Ledger integration tests drive idle→active→idle transitions with
+        e.g. ``values=[0.0, None, 0.0]``.
+        """
+        if not values:
+            raise ValueError("scripted series needs at least one entry")
+        prefix = "exported_" if exported else ""
+        for chip in range(chips):
+            labels = {
+                f"{prefix}pod": pod,
+                f"{prefix}namespace": namespace,
+                f"{prefix}container": container,
+                "accelerator_id": str(chip),
+                "accelerator_type": accelerator_type,
+                "node_type": accelerator_type,
+            }
+            labels.update(extra_labels or {})
+            self.scripted_series.append({"labels": labels, "values": list(values)})
         self._version += 1
 
     def add_range_pod_series(
@@ -216,6 +260,26 @@ class FakePrometheus:
                         }).encode()
                         fake._cached_version = fake._version
                     body = fake._cached
+                    if fake.scripted_series:
+                        # time-advancing scripts make the response a
+                        # function of the query index — rebuild per query
+                        # (the scripted path is a correctness fixture, not
+                        # the fleet-scale one)
+                        idx = fake.instant_queries_served
+                        result = [s for s in fake.series if "value" in s]
+                        now = time.time()
+                        for s in fake.scripted_series:
+                            vals = s["values"]
+                            v = vals[idx] if idx < len(vals) else vals[-1]
+                            if v is None:  # busy this cycle: no row
+                                continue
+                            result.append({"metric": s["labels"],
+                                           "value": [now, str(v)]})
+                        body = json.dumps({
+                            "status": "success",
+                            "data": {"resultType": "vector", "result": result},
+                        }).encode()
+                    fake.instant_queries_served += 1
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
