@@ -81,6 +81,13 @@ docker-build:
 bench-smoke:
     TP_BENCH_SMOKE=1 python bench.py
 
+# flight-recorder smoke: record two daemon cycles against the hermetic
+# fakes, then replay every capsule offline (fakes torn down first) —
+# non-zero exit on decision drift. tests/test_justfile_guard.py pins the
+# recipe to the module it invokes.
+replay-smoke:
+    python -m tpu_pruner.testing.replay_smoke
+
 # standalone TPU capture: probe + fleet eval + bench_tpu_last_good.json
 # (run EARLY in a round / whenever the chip tunnel is up; exits 1 when no
 # real accelerator measurement happened)

@@ -14,6 +14,8 @@
 #pragma once
 
 #include <cstdint>
+#include <functional>
+#include <optional>
 #include <string>
 #include <vector>
 
@@ -49,6 +51,9 @@ enum class Reason : uint8_t {
 };
 
 const char* reason_name(Reason r);
+// Inverse of reason_name (the flight-recorder replay engine rebuilds
+// DecisionRecords from recorded actuation outcomes); nullopt for unknown.
+std::optional<Reason> reason_from_name(std::string_view name);
 // Every code, in enum order (capi → drift-guard test).
 std::vector<std::string> all_reason_codes();
 
@@ -85,6 +90,13 @@ uint64_t current_cycle();
 // Optional JSONL sink (--audit-log). "" disables. Lines are appended and
 // flushed per record; failures are log-only (telemetry never kills cycles).
 void set_audit_log(const std::string& path);
+
+// Optional extra sink invoked (under the registry lock) for EVERY record
+// that lands in the ring — the single choke point record(), finalize()
+// and finalize_all_pending() all pass through. The flight recorder hangs
+// its per-cycle capsule capture here; the sink must not call back into
+// audit. nullptr clears.
+void set_record_sink(std::function<void(const DecisionRecord&)> sink);
 
 // Final record: ring buffer + JSONL.
 void record(DecisionRecord rec);

@@ -76,6 +76,8 @@ struct Cli {
   std::string audit_log;                  // --audit-log: JSONL DecisionRecord sink ("" = off)
   std::string ledger_file;                // --ledger-file: JSONL workload-ledger checkpoint ("" = off)
   int64_t ledger_top_k = 10;              // --ledger-top-k: /metrics workload label cardinality bound
+  std::string flight_dir;                 // --flight-dir: cycle flight-recorder capsule ring ("" = off)
+  int64_t flight_keep = 64;               // --flight-keep: capsules retained in the on-disk ring
   std::string otlp_endpoint;              // --otlp-endpoint (default: $OTEL_EXPORTER_OTLP_ENDPOINT)
   std::string gcp_project;                // --gcp-project (Cloud Monitoring PromQL API)
   std::string monitoring_endpoint = "https://monitoring.googleapis.com";  // --monitoring-endpoint

@@ -21,7 +21,10 @@ class Client {
 
   // POST /api/v1/query (form-encoded). Returns the decoded JSON response
   // body; throws std::runtime_error on transport errors or non-2xx status.
-  json::Value instant_query(const std::string& promql) const;
+  // `raw_body` (optional) receives the VERBATIM 2xx response text before
+  // parsing — the flight recorder stores it so a replay decodes exactly
+  // the bytes the daemon received, not a re-serialization.
+  json::Value instant_query(const std::string& promql, std::string* raw_body = nullptr) const;
 
   // W3C trace-context propagation onto the query requests (the daemon
   // stamps each cycle's span context; managed-Prometheus request logs

@@ -56,6 +56,8 @@
 #include <optional>
 #include <string>
 
+#include "tpupruner/json.hpp"
+
 namespace tpupruner::query {
 
 struct QueryArgs {
@@ -99,5 +101,16 @@ struct QueryArgs {
 
 // Build the instant-query PromQL for the configured source.
 std::string build_idle_query(const QueryArgs& args);
+
+// JSON round-trip for QueryArgs. One shape shared by three consumers: the
+// capi payload (tp_build_query), the flight-recorder capsule's config
+// fingerprint, and the replay engine's what-if re-render — so a capsule's
+// recorded query is always re-buildable from its own config. Keys are the
+// capi names (device, duration, namespace, namespace_exclude, model_name,
+// accelerator_type, power_threshold, hbm_threshold, honor_labels,
+// metric_schema, join_metric, join_resource, tensorcore_metric,
+// duty_cycle_metric, hbm_metric); absent keys keep defaults.
+json::Value args_to_json(const QueryArgs& args);
+QueryArgs args_from_json(const json::Value& v);
 
 }  // namespace tpupruner::query

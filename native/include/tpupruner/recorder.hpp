@@ -1,0 +1,119 @@
+// Cycle flight recorder + deterministic replay / what-if engine.
+//
+// The audit trail (audit.hpp) made the *outputs* of each cycle queryable
+// and the ledger (ledger.hpp) made their cost visible — but the *inputs*
+// died with the cycle: once a reconcile ends, the raw Prometheus evidence,
+// the watch-store objects the owner walk consulted, and the config that
+// produced a scale-down are gone, so a 3am "why did you pause my JobSet?"
+// can only be answered from derived records, and a threshold change can
+// only be validated live. The recorder captures one self-contained
+// CycleCapsule per cycle:
+//
+//   - the rendered PromQL and the VERBATIM Prometheus response body,
+//   - a config fingerprint (query args, lookback, run mode, enabled
+//     kinds, breaker limit, watch-cache mode),
+//   - per-candidate pod evidence (the Pod JSON as consulted, store-miss /
+//     fetch-error facts) and per-pod owner-walk results,
+//   - the owner/root objects the walk touched (the FetchCache snapshot),
+//   - cycle facts that are cluster state, not config: veto sets, group
+//     all-idle verdicts, breaker deferrals, consumer actuation outcomes,
+//   - and the final DecisionRecords (captured via the audit sink).
+//
+// Capsules persist to a bounded on-disk ring (--flight-dir, --flight-keep;
+// atomic tmp+rename writes; the index is rebuilt from the directory on
+// restart) and are served at /debug/cycles (index) and /debug/cycles/<id>
+// (full capsule) on the metrics port.
+//
+// replay() re-runs decode → eligibility → owner walk → target gates
+// purely from capsule contents — zero network — and asserts the replayed
+// decisions reproduce the recorded ones bit-for-bit (reason codes, roots,
+// actions). A what-if overlay ({"lookback": "10m", ...}) re-decides under
+// altered config and reports exactly which decisions flip. Facts that
+// depend on cluster state the capsule can't re-derive (veto sets, group
+// verdicts, actuation results) are held fixed; what-if flips that reach
+// actuation are reported as predicted.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::recorder {
+
+// ── lifecycle / configuration ──
+// Enable the on-disk ring. `dir` is created when missing; existing
+// cycle-*.json capsules are reloaded into the index (then pruned to
+// `keep`). "" disables capture entirely — every hook becomes a no-op.
+void configure(const std::string& dir, int keep);
+bool enabled();
+
+// Static per-run context: the config fingerprint (see capsule schema in
+// recorder.cpp) and the rendered idle query, identical for every cycle of
+// the process.
+void set_run_context(json::Value config, std::string query);
+
+// ── per-cycle capture hooks (all no-ops while disabled) ──
+// Opens the cycle's capsule; also drops any stale capsule of an earlier
+// cycle that never reached arm() (a failed query leaves one behind).
+void begin_cycle(uint64_t cycle, int64_t ts_unix);
+void record_prom_body(uint64_t cycle, const std::string& body);
+// The eligibility clock resolve_pods used (util::now_unix at resolve
+// start) — replay feeds it back into core::check_eligibility.
+void record_resolve_now(uint64_t cycle, int64_t now_unix);
+// Per-candidate pod acquisition evidence. `pod` nullptr = absent;
+// `fetch_error` non-empty = the GET threw (namespace veto follows).
+void record_pod(uint64_t cycle, const std::string& key, const json::Value* pod,
+                bool store_missed, const std::string& fetch_error);
+// Per-pod owner-walk result: either a resolved root or the walk error.
+void record_resolution(uint64_t cycle, const std::string& key,
+                       const std::vector<std::string>& chain,
+                       const std::string& root_kind, const std::string& root_ns,
+                       const std::string& root_name, const std::string& identity,
+                       const std::string& error);
+// One owner/root object the walk consulted (FetchCache snapshot entry);
+// nullptr records a cached miss (404) explicitly.
+void record_object(uint64_t cycle, const std::string& path, const json::Value* object);
+// Cycle facts: fail-closed veto sets, per-root gate flags, breaker stamp.
+void record_vetoes(uint64_t cycle, const std::vector<std::string>& vetoed_roots,
+                   const std::vector<std::pair<std::string, std::string>>& vetoed_namespaces);
+// `flag` ∈ {"root_opted_out", "group_not_idle", "deferred"}.
+void flag_root(uint64_t cycle, const std::string& identity, const char* flag);
+void record_breaker(uint64_t cycle, int64_t limit, size_t actionable, size_t deferred);
+void record_stats(uint64_t cycle, size_t num_series, size_t num_pods,
+                  size_t shutdown_events);
+// Final DecisionRecord (verbatim JSON) — wired as the audit record sink.
+void record_decision(uint64_t cycle, json::Value decision);
+// Arm the capsule for `expected` consumer actuations; 0 seals immediately
+// (dry-run / no-candidate cycles). Each record_actuation decrements and
+// the last one seals (writes the capsule to the ring).
+void arm(uint64_t cycle, size_t expected);
+void record_actuation(uint64_t cycle, const std::string& identity,
+                      const std::string& reason, const std::string& action,
+                      const std::string& detail);
+// Shutdown flush: seal every armed capsule still waiting on a drained
+// queue (its dropped targets' SHUTDOWN_ABORTED records are already in).
+void seal_all();
+
+// ── serving ──
+// /debug/cycles body: {"capsules": [{id, cycle, ts, decisions,
+// scale_downs, breaker_tripped}...], "dir": ..., "keep": N}, oldest first.
+json::Value index_json();
+// Full capsule JSON text by id ("" when unknown / traversal-unsafe).
+std::string capsule_body(const std::string& id);
+
+// ── replay ──
+// Re-decide a capsule offline. `what_if` is an object of config overrides
+// (values as strings or numbers): lookback (duration, e.g. "30m"/"600s"/
+// seconds), duration (minutes), grace (seconds), run_mode, enabled_resources,
+// max_scale_per_cycle, hbm_threshold (re-renders the query only — the
+// recorded response can't be re-queried offline). Empty object = pure
+// replay. Returns {match, replayed, recorded, drift, flips, query_changed,
+// replay_query, actions}; throws on a malformed capsule or unknown key.
+json::Value replay(const json::Value& capsule, const json::Value& what_if);
+
+void reset_for_test();
+
+}  // namespace tpupruner::recorder

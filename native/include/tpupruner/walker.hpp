@@ -45,6 +45,11 @@ class FetchCache {
   // seed never overwrites a fetched or previously seeded entry.
   void seed(const std::string& key, Entry entry);
 
+  // Every completed entry (fetched or seeded, cached misses included) —
+  // the flight recorder's owner-object snapshot. In-flight and failed
+  // flights are skipped.
+  std::vector<std::pair<std::string, Entry>> snapshot();
+
  private:
   struct Flight {
     std::mutex m;
@@ -72,6 +77,21 @@ class FetchCache {
 size_t prefetch_owner_chains(const k8s::Client& client, FetchCache& cache,
                              const std::vector<const json::Value*>& pods,
                              int64_t threshold, size_t concurrency);
+
+// Object source for the owner walk: API object path → object (nullopt =
+// absent/404). May throw for transport errors — each hop handles that the
+// way the live walk does (mid-chain fetches are best-effort, root fetches
+// propagate). The live walk wraps client+cache+store into one of these;
+// the flight-recorder replay wraps a capsule's recorded object snapshot,
+// so the SAME walk code runs online and offline.
+using ObjectFetcher = std::function<std::optional<json::Value>(const std::string&)>;
+
+// The walk itself, over an abstract object source. Throws
+// std::runtime_error("no scalable root object ...") when the pod has no
+// recognized owner chain. `chain_out` (optional) receives the resolved
+// hops as "Kind/ns/name" strings, pod first.
+core::ScaleTarget find_root_object_from(const ObjectFetcher& fetch, const json::Value& pod,
+                                        std::vector<std::string>* chain_out = nullptr);
 
 // Resolve the root scalable object for a pod (fetched Pod JSON).
 // Throws std::runtime_error("no scalable root object ...") when the pod has

@@ -39,6 +39,8 @@ struct Registry {
   std::string audit_log_path;
   std::FILE* audit_log = nullptr;
   bool capacity_read = false;
+  // Extra per-record sink (flight recorder); invoked under `mutex`.
+  std::function<void(const DecisionRecord&)> sink;
 };
 
 Registry& reg() {
@@ -47,6 +49,7 @@ Registry& reg() {
 }
 
 void push_locked(Registry& r, DecisionRecord&& rec) {
+  if (r.sink) r.sink(rec);
   if (r.audit_log) {
     std::string line = rec.to_json().dump();
     line += '\n';
@@ -114,6 +117,14 @@ const char* reason_name(Reason r) {
   return "?";
 }
 
+std::optional<Reason> reason_from_name(std::string_view name) {
+  for (int i = 0; i <= static_cast<int>(Reason::ShutdownAborted); ++i) {
+    Reason r = static_cast<Reason>(i);
+    if (name == reason_name(r)) return r;
+  }
+  return std::nullopt;
+}
+
 std::vector<std::string> all_reason_codes() {
   std::vector<std::string> out;
   for (int i = 0; i <= static_cast<int>(Reason::ShutdownAborted); ++i) {
@@ -179,6 +190,12 @@ void set_audit_log(const std::string& path) {
   } else {
     log::info("audit", "appending decision records to " + path);
   }
+}
+
+void set_record_sink(std::function<void(const DecisionRecord&)> sink) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  r.sink = std::move(sink);
 }
 
 void record(DecisionRecord rec) {
@@ -294,6 +311,7 @@ void reset_for_test() {
     r.audit_log = nullptr;
   }
   r.audit_log_path.clear();
+  r.sink = nullptr;
 }
 
 }  // namespace tpupruner::audit

@@ -14,6 +14,7 @@
 
 #include "otlp_grpc.hpp"
 #include "tpupruner/audit.hpp"
+#include "tpupruner/recorder.hpp"
 #include "tpupruner/core.hpp"
 #include "tpupruner/informer.hpp"
 #include "tpupruner/json.hpp"
@@ -86,34 +87,8 @@ Value meta_to_json(const core::ScaleTarget& t) {
   return out;
 }
 
-tpupruner::query::QueryArgs query_args_from_json(const Value& v) {
-  tpupruner::query::QueryArgs a;
-  if (const Value* x = v.find("device"); x && x->is_string()) a.device = x->as_string();
-  if (const Value* x = v.find("duration"); x && x->is_number()) a.duration_min = x->as_int();
-  if (const Value* x = v.find("namespace"); x && x->is_string()) a.namespace_regex = x->as_string();
-  if (const Value* x = v.find("namespace_exclude"); x && x->is_string())
-    a.namespace_exclude_regex = x->as_string();
-  if (const Value* x = v.find("model_name"); x && x->is_string()) a.model_regex = x->as_string();
-  if (const Value* x = v.find("accelerator_type"); x && x->is_string())
-    a.accelerator_regex = x->as_string();
-  if (const Value* x = v.find("power_threshold"); x && x->is_number())
-    a.power_threshold = x->as_double();
-  if (const Value* x = v.find("hbm_threshold"); x && x->is_number())
-    a.hbm_threshold = x->as_double();
-  if (const Value* x = v.find("honor_labels"); x && x->is_bool()) a.honor_labels = x->as_bool();
-  if (const Value* x = v.find("metric_schema"); x && x->is_string())
-    a.metric_schema = x->as_string();
-  if (const Value* x = v.find("join_metric"); x && x->is_string())
-    a.join_metric = x->as_string();
-  if (const Value* x = v.find("join_resource"); x && x->is_string())
-    a.join_resource = x->as_string();
-  if (const Value* x = v.find("tensorcore_metric"); x && x->is_string())
-    a.tensorcore_metric = x->as_string();
-  if (const Value* x = v.find("duty_cycle_metric"); x && x->is_string())
-    a.duty_cycle_metric = x->as_string();
-  if (const Value* x = v.find("hbm_metric"); x && x->is_string()) a.hbm_metric = x->as_string();
-  return a;
-}
+// QueryArgs decoding now lives in query.cpp (query::args_from_json) — one
+// shape shared with the flight-recorder capsule's config fingerprint.
 
 // ── informer sessions ──
 //
@@ -160,7 +135,8 @@ char* tp_build_query(const char* args_json) {
   return guarded([&] {
     Value args = Value::parse(args_json);
     Value out = Value::object();
-    out.set("query", Value(tpupruner::query::build_idle_query(query_args_from_json(args))));
+    out.set("query",
+            Value(tpupruner::query::build_idle_query(tpupruner::query::args_from_json(args))));
     return ok(out);
   });
 }
@@ -437,6 +413,23 @@ char* tp_ledger_metric_families(const char*) {
     Value out = Value::object();
     out.set("families", std::move(families));
     return ok(out);
+  });
+}
+
+char* tp_replay_cycle(const char* payload_json) {
+  // Deterministic replay / what-if over a flight-recorder CycleCapsule
+  // (recorder.cpp) — the `analyze --replay` backend. Payload:
+  //   {"capsule": {<capsule JSON>}, "what_if": {"lookback": "10m", ...}?}
+  // Runs decode → eligibility → owner walk → target gates purely from
+  // capsule contents (zero network) and returns {match, replayed,
+  // recorded, drift, flips?, query_changed, replay_query?, actions}.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    const Value* capsule = p.find("capsule");
+    if (!capsule) throw std::runtime_error("missing capsule");
+    const Value* what_if = p.find("what_if");
+    return ok(tpupruner::recorder::replay(*capsule,
+                                          what_if ? *what_if : Value::object()));
   });
 }
 

@@ -125,8 +125,8 @@ TPU FLAGS:
       --max-cycles <N>          daemon mode: exit cleanly after N evaluation
                                 cycles (bench/test harness; 0 = unlimited)
       --metrics-port <P>        serve Prometheus /metrics (+ /healthz, /readyz,
-                                /debug/decisions) on this port
-                                (0 = disabled, "auto" = ephemeral)
+                                and the /debug surfaces — /debug lists them)
+                                on this port (0 = disabled, "auto" = ephemeral)
       --audit-log <FILE>        append one JSONL DecisionRecord per candidate
                                 pod per cycle (the /debug/decisions ring
                                 buffer, durable; consumed by
@@ -142,6 +142,16 @@ TPU FLAGS:
                                 cardinality: the top N workloads by chips
                                 get their own series, the rest roll up into
                                 one "_other" series per family [default: 10]
+      --flight-dir <DIR>        cycle flight recorder: persist one self-
+                                contained capsule per evaluation cycle (the
+                                rendered query, the verbatim Prometheus
+                                response, config fingerprint, pod/owner
+                                evidence, final decisions) to a bounded
+                                on-disk ring, served at /debug/cycles and
+                                replayable offline with `python -m
+                                tpu_pruner.analyze --replay` / `--what-if`
+      --flight-keep <N>         capsules retained in the --flight-dir ring
+                                (oldest pruned first) [default: 64]
       --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
                                 [default: $OTEL_EXPORTER_OTLP_ENDPOINT]
       --gcp-project <ID>        query the Cloud Monitoring PromQL API for this
@@ -267,6 +277,12 @@ Cli parse(int argc, char** argv) {
        [&](const std::string& v) {
          cli.ledger_top_k = parse_int("--ledger-top-k", v);
          if (cli.ledger_top_k < 1) throw CliError("--ledger-top-k must be >= 1");
+       }},
+      {"--flight-dir", [&](const std::string& v) { cli.flight_dir = v; }},
+      {"--flight-keep",
+       [&](const std::string& v) {
+         cli.flight_keep = parse_int("--flight-keep", v);
+         if (cli.flight_keep < 1) throw CliError("--flight-keep must be >= 1");
        }},
       {"--otlp-endpoint", [&](const std::string& v) { cli.otlp_endpoint = v; }},
       {"--gcp-project", [&](const std::string& v) { cli.gcp_project = v; }},

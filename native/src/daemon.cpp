@@ -24,6 +24,7 @@
 #include "tpupruner/log.hpp"
 #include "tpupruner/metrics.hpp"
 #include "tpupruner/prom.hpp"
+#include "tpupruner/recorder.hpp"
 #include "tpupruner/util.hpp"
 #include "tpupruner/walker.hpp"
 
@@ -169,6 +170,9 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   int64_t lookback_secs = args.duration * 60 + args.grace_period;  // main.rs:413-414
   int64_t now = util::now_unix();
   size_t workers = static_cast<size_t>(args.resolve_concurrency);
+  // Flight recorder: the eligibility clock must be replayed verbatim — a
+  // capsule re-decided with a different `now` would re-age every pod.
+  recorder::record_resolve_now(cycle_id, now);
 
   // DecisionRecord skeleton per candidate: observed signal (the idle
   // query's joined max-over-window utilization), lookback, cycle, trace.
@@ -294,6 +298,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
         // cycle once the API answers again.
         log::error("daemon", "Skipping " + key + ", retrieval error (vetoing namespace " + pmd.ns +
                    " this cycle): " + e.what());
+        recorder::record_pod(cycle_id, key, nullptr, false, e.what());
         decide(base_record(pmd), audit::Reason::FetchError,
                std::string("pod GET failed, namespace vetoed: ") + e.what());
         std::lock_guard<std::mutex> lock(out_mutex);
@@ -302,6 +307,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       }
       if (!fetched) {
         log::info("daemon", "Skipping " + key + ", pod no longer exists");
+        recorder::record_pod(cycle_id, key, nullptr, store_missed, "");
         decide(base_record(pmd),
                store_missed ? audit::Reason::WatchCacheMiss : audit::Reason::PodGone,
                store_missed ? "absent from the synced watch store and from the live GET"
@@ -313,6 +319,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       pod = &owned_pods.back();
     }
 
+    recorder::record_pod(cycle_id, key, pod, false, "");
     core::Eligibility elig = core::check_eligibility(*pod, now, lookback_secs);
     switch (elig) {
       case core::Eligibility::Pending:
@@ -379,6 +386,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
         target = walker::find_root_object(kube, *e.pod, &owner_cache, watch_cache, &chain);
       } catch (const std::exception& e2) {
         span.set_error(e2.what());
+        recorder::record_resolution(cycle_id, key, chain, "", "", "", "", e2.what());
         audit::DecisionRecord rec = base_record(*e.sample);
         rec.owner_chain = chain;
         if (e.opted_out) {
@@ -399,6 +407,10 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       }
     }
     if (target) {
+      recorder::record_resolution(cycle_id, key, chain,
+                                  std::string(core::kind_name(target->kind)),
+                                  target->ns().value_or(""), target->name(),
+                                  target->identity(), "");
       audit::DecisionRecord rec = base_record(*e.sample);
       rec.owner_chain = std::move(chain);
       rec.root_kind = core::kind_name(target->kind);
@@ -428,6 +440,15 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       }
     }
   });
+  // Flight recorder: snapshot every owner/root object the walk consulted
+  // this cycle (single-flight cache contents, cached 404s included) so a
+  // replay — including what-if paths the live cycle never walked — runs
+  // the real walk against the same cluster state, offline.
+  if (recorder::enabled()) {
+    for (auto& [path, entry] : owner_cache.snapshot()) {
+      recorder::record_object(cycle_id, path, entry ? &*entry : nullptr);
+    }
+  }
   return out;
 }
 
@@ -453,6 +474,7 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   // cycle span (reference #[tracing::instrument] on run_query_and_scale,
   // main.rs:390); children below mirror the instrumented callees.
   const uint64_t cycle_id = audit::begin_cycle();
+  recorder::begin_cycle(cycle_id, util::now_unix());
   otlp::Span cycle("run_query_and_scale");
   cycle.attr("cycle", static_cast<int64_t>(cycle_id));
   const std::string trace_id = cycle.context().trace_id;
@@ -470,10 +492,14 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   auto phase_start = std::chrono::steady_clock::now();
   prom::Client prom_client = build_prom_client(args);
   prom_client.set_traceparent(otlp::traceparent(cycle.context()));
+  std::string raw_body;
   json::Value response = [&] {
     otlp::Span span("prometheus.instant_query", &cycle.context());
-    return with_span(span, [&] { return prom_client.instant_query(query); });
+    return with_span(span, [&] {
+      return prom_client.instant_query(query, recorder::enabled() ? &raw_body : nullptr);
+    });
   }();
+  recorder::record_prom_body(cycle_id, raw_body);
   observe_phase("query", phase_start);
 
   phase_start = std::chrono::steady_clock::now();
@@ -505,6 +531,14 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
     ledger::observe_cycle(cycle_id, util::now_unix(), obs);
   }
   std::vector<ScaleTarget> unique = core::dedup_targets(std::move(resolved.targets));
+  // Flight recorder: the fail-closed veto sets are cycle facts (cluster
+  // state, not config) — a replay reuses them verbatim.
+  if (recorder::enabled()) {
+    std::vector<std::string> vroots(resolved.vetoed_roots.begin(), resolved.vetoed_roots.end());
+    std::vector<std::pair<std::string, std::string>> vns(resolved.vetoed_namespaces.begin(),
+                                                         resolved.vetoed_namespaces.end());
+    recorder::record_vetoes(cycle_id, vroots, vns);
+  }
 
   // Target-level verdicts, joined back onto every contributing pod's
   // DecisionRecord after the gates below run.
@@ -521,6 +555,7 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
       audit::Reason reason = audit::Reason::RootOptedOut;
       if (core::is_opted_out(t.object)) {
         why = "annotated " + std::string(core::kSkipAnnotation) + "=true";
+        recorder::flag_root(cycle_id, t.identity(), "root_opted_out");
       } else if (resolved.vetoed_roots.count(t.identity())) {
         why = "vetoed by an annotated pod";
         reason = audit::Reason::VetoedByAnnotatedPod;
@@ -574,6 +609,7 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
       outcome.emplace(unique[i].identity(),
                       std::make_pair(audit::Reason::GroupNotIdle,
                                      "group has active (or too-young) TPU hosts"));
+      recorder::flag_root(cycle_id, unique[i].identity(), "group_not_idle");
     }
   }
 
@@ -603,6 +639,7 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
                         std::make_pair(audit::Reason::Deferred,
                                        "over --max-scale-per-cycle=" +
                                            std::to_string(args.max_scale_per_cycle)));
+        recorder::flag_root(cycle_id, t.identity(), "deferred");
       }
     }
     if (deferred > 0) {
@@ -611,7 +648,14 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
                 std::to_string(args.max_scale_per_cycle) + "; deferring " +
                 std::to_string(deferred) + " to later cycles");
       log::counter_add("scale_deferred", static_cast<int64_t>(deferred));
+      // A trip was a log line only until now — count it, stamp which cycle
+      // tripped last and how hard, and put the trip into the cycle's
+      // flight capsule so replays see it.
+      log::counter_add("breaker_trips_total", 1);
+      log::counter_set("breaker_last_trip_cycle", cycle_id);
+      log::counter_set("breaker_last_trip_deferred", deferred);
     }
+    recorder::record_breaker(cycle_id, args.max_scale_per_cycle, actionable, deferred);
     survivors = std::move(capped);
   }
 
@@ -624,6 +668,7 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   // threads share the client, so informer LIST/watch requests are counted
   // too — deliberate: they ARE cycle-serving traffic.
   stats.api_calls = kube.api_calls() - api_calls_before;
+  recorder::record_stats(cycle_id, stats.num_series, stats.num_pods, stats.shutdown_events);
   cycle.attr("num_series", static_cast<int64_t>(stats.num_series));
   cycle.attr("num_pods", static_cast<int64_t>(stats.num_pods));
   cycle.attr("shutdown_events", static_cast<int64_t>(stats.shutdown_events));
@@ -657,6 +702,10 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   // finish this cycle's queue (0s immediately when nothing is enqueued) —
   // keeps every phase histogram's _count in lockstep per cycle.
   audit::arm_actuation(cycle_id, args.dry_run() ? 0 : survivors.size(), trace_id);
+  // The capsule seals when this cycle's actuations drain (immediately on
+  // dry-run / no-candidate cycles) — by then every DecisionRecord has
+  // passed through the audit sink into it.
+  recorder::arm(cycle_id, args.dry_run() ? 0 : survivors.size());
 
   for (ScaleTarget& t : survivors) {
     std::string desc = "[" + std::string(core::kind_name(t.kind)) + "] " +
@@ -701,6 +750,26 @@ int run(const cli::Cli& args) {
   // existing file restores the fleet's savings accounts across restarts
   // and leader failover.
   ledger::set_ledger_file(args.ledger_file);
+  // Cycle flight recorder (--flight-dir): one self-contained capsule per
+  // cycle into a bounded on-disk ring, replayable offline. The audit sink
+  // feeds every final DecisionRecord into the open capsule.
+  if (!args.flight_dir.empty()) {
+    recorder::configure(args.flight_dir, static_cast<int>(args.flight_keep));
+    json::Value config = json::Value::object();
+    config.set("query_args", query::args_to_json(cli::to_query_args(args)));
+    config.set("run_mode", json::Value(args.run_mode));
+    config.set("dry_run", json::Value(args.dry_run()));
+    config.set("enabled_resources", json::Value(args.enabled_resources));
+    config.set("duration_min", json::Value(args.duration));
+    config.set("grace_s", json::Value(args.grace_period));
+    config.set("lookback_s", json::Value(args.duration * 60 + args.grace_period));
+    config.set("max_scale_per_cycle", json::Value(args.max_scale_per_cycle));
+    config.set("watch_cache", json::Value(args.watch_cache));
+    recorder::set_run_context(std::move(config), query);
+    audit::set_record_sink([](const audit::DecisionRecord& rec) {
+      recorder::record_decision(rec.cycle, rec.to_json());
+    });
+  }
 
   k8s::Client kube = [&] {
     try {
@@ -745,6 +814,13 @@ int run(const cli::Cli& args) {
     const int ledger_top_k = static_cast<int>(args.ledger_top_k);
     metrics_server->set_extra_metrics_provider(
         [ledger_top_k](bool openmetrics) { return ledger::render_metrics(ledger_top_k, openmetrics); });
+    // Flight recorder: capsule index at /debug/cycles, full capsules at
+    // /debug/cycles/<id> ("" from the provider → 404).
+    if (recorder::enabled()) {
+      metrics_server->set_cycles_provider([](const std::string& id) {
+        return id.empty() ? recorder::index_json().dump() : recorder::capsule_body(id);
+      });
+    }
     // /readyz reflects informer sync state — distinct from the /healthz
     // liveness stamp: a daemon mid-relist is alive but serving degraded
     // (GET-fallback) lookups, and a rollout should wait it out. Without
@@ -874,6 +950,11 @@ int run(const cli::Cli& args) {
       auto finish = [&](audit::Reason reason, const std::string& action,
                         const std::string& detail = "") {
         audit::finalize(item->cycle, identity, reason, action, detail);
+        // Actuation outcomes are the one stage a replay cannot re-run (a
+        // cluster interaction) — stamp them into the capsule; the last
+        // one of the cycle seals it.
+        recorder::record_actuation(item->cycle, identity, audit::reason_name(reason),
+                                   action, detail);
         audit::actuation_done(item->cycle, reason == audit::Reason::AlreadyPaused);
       };
       if (!(enabled & core::flag(t.kind))) {
@@ -1054,6 +1135,9 @@ int run(const cli::Cli& args) {
   // pending DecisionRecords — land them with an honest terminal code so
   // the audit trail never silently loses a decision.
   audit::finalize_all_pending(audit::Reason::ShutdownAborted);
+  // Flush capsules still waiting on a drained queue (their dropped
+  // targets' SHUTDOWN_ABORTED records just landed via the audit sink).
+  recorder::seal_all();
   if (notifier.joinable()) {
     // Consumers are done, so no new notifications arrive; drain what's
     // queued (bounded: cap x 5s worst case, usually zero) and stop.

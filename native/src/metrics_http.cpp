@@ -29,6 +29,9 @@ const std::map<std::string, std::string>& help_texts() {
       {"scale_failures", "Scale-down actuations that threw"},
       {"scale_noops", "Actuations skipped because the root was already paused"},
       {"scale_deferred", "Targets deferred by the --max-scale-per-cycle circuit breaker"},
+      {"breaker_trips_total", "Cycles in which the --max-scale-per-cycle circuit breaker tripped"},
+      {"breaker_last_trip_cycle", "Cycle id of the most recent circuit-breaker trip"},
+      {"breaker_last_trip_deferred", "Targets deferred at the most recent circuit-breaker trip"},
       {"query_returned_candidates", "Unique candidate pods in the last cycle's query result"},
       {"query_returned_shutdown_events", "Root objects surviving all gates last cycle"},
       {"cycle_resolution_api_calls", "K8s API requests issued by the last cycle's resolution"},
@@ -107,6 +110,11 @@ void Server::set_decisions_provider(std::function<std::string(const std::string&
 void Server::set_workloads_provider(std::function<std::string(const std::string&)> provider) {
   std::lock_guard<std::mutex> lock(probe_mutex_);
   workloads_provider_ = std::move(provider);
+}
+
+void Server::set_cycles_provider(std::function<std::string(const std::string&)> provider) {
+  std::lock_guard<std::mutex> lock(probe_mutex_);
+  cycles_provider_ = std::move(provider);
 }
 
 void Server::set_extra_metrics_provider(std::function<std::string(bool)> provider) {
@@ -272,6 +280,43 @@ void Server::serve() {
         status_text = "Not Found";
         body = "workload ledger not enabled\n";
       }
+    } else if (path == "/debug/cycles" || util::starts_with(path, "/debug/cycles/")) {
+      std::function<std::string(const std::string&)> provider;
+      {
+        std::lock_guard<std::mutex> lock(probe_mutex_);
+        provider = cycles_provider_;
+      }
+      std::string id =
+          path == "/debug/cycles" ? "" : path.substr(std::strlen("/debug/cycles/"));
+      std::string result = provider ? provider(id) : "";
+      if (provider && !result.empty()) {
+        content_type = "application/json";
+        body = std::move(result);
+      } else {
+        status = 404;
+        status_text = "Not Found";
+        body = provider ? "no such capsule\n" : "flight recorder not enabled (--flight-dir)\n";
+      }
+    } else if (path == "/debug" || path == "/debug/") {
+      // Discovery index: every debug surface with a one-line description,
+      // so an operator with only the metrics port finds the tooling
+      // without reading docs. Served even when a provider is off — the
+      // entries say which flag enables what.
+      content_type = "application/json";
+      body = std::string("{\"routes\":[") +
+             "{\"path\":\"/metrics\",\"description\":\"Prometheus exposition (classic + "
+             "OpenMetrics negotiation with trace exemplars)\"}," +
+             "{\"path\":\"/healthz\",\"description\":\"liveness: the producer loop ticked "
+             "within the staleness window\"}," +
+             "{\"path\":\"/readyz\",\"description\":\"readiness: watch cache synced (always "
+             "ok without --watch-cache)\"}," +
+             "{\"path\":\"/debug/decisions\",\"description\":\"DecisionRecord ring buffer, "
+             "filterable with ?pod=ns/name or ?namespace=\"}," +
+             "{\"path\":\"/debug/workloads\",\"description\":\"workload utilization ledger "
+             "snapshot, ?ns= and ?sort=reclaimed|idle|chips\"}," +
+             "{\"path\":\"/debug/cycles\",\"description\":\"flight-recorder capsule index; "
+             "/debug/cycles/<id> serves one full capsule (--flight-dir)\"}" +
+             "]}";
     } else {
       content_type = want_openmetrics
                          ? "application/openmetrics-text; version=1.0.0; charset=utf-8"

@@ -44,6 +44,11 @@ class Server {
   // Unset → 404.
   void set_workloads_provider(std::function<std::string(const std::string&)> provider);
 
+  // /debug/cycles provider (the flight-recorder capsule ring): receives
+  // the capsule id ("" = the index) and returns the JSON body — an empty
+  // return means "no such capsule" (404). Unset → 404 for both routes.
+  void set_cycles_provider(std::function<std::string(const std::string&)> provider);
+
   // Extra /metrics families rendered outside the counter/histogram
   // registries (the ledger's bounded-cardinality workload series). The
   // provider returns ready-made exposition text (HELP/TYPE included);
@@ -61,6 +66,7 @@ class Server {
   std::function<bool()> ready_probe_;
   std::function<std::string(const std::string&)> decisions_provider_;
   std::function<std::string(const std::string&)> workloads_provider_;
+  std::function<std::string(const std::string&)> cycles_provider_;
   std::function<std::string(bool)> extra_metrics_provider_;
   mutable std::mutex probe_mutex_;
   std::thread thread_;

@@ -15,7 +15,7 @@ Client::Client(std::string base_url, std::string bearer_token, http::TlsMode tls
   while (!base_url_.empty() && base_url_.back() == '/') base_url_.pop_back();
 }
 
-json::Value Client::instant_query(const std::string& promql) const {
+json::Value Client::instant_query(const std::string& promql, std::string* raw_body) const {
   http::Request req;
   req.method = "POST";
   req.url = base_url_ + "/api/v1/query";
@@ -33,6 +33,7 @@ json::Value Client::instant_query(const std::string& promql) const {
     throw std::runtime_error("prometheus returned HTTP " + std::to_string(resp.status) + ": " +
                              snippet);
   }
+  if (raw_body) *raw_body = resp.body;
   try {
     return json::Value::parse(resp.body);
   } catch (const json::ParseError& e) {

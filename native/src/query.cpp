@@ -248,4 +248,59 @@ std::string build_idle_query(const QueryArgs& args) {
   throw std::invalid_argument("unknown device: " + args.device + " (expected tpu|gpu)");
 }
 
+json::Value args_to_json(const QueryArgs& a) {
+  json::Value v = json::Value::object();
+  v.set("device", json::Value(a.device));
+  v.set("duration", json::Value(a.duration_min));
+  if (!a.namespace_regex.empty()) v.set("namespace", json::Value(a.namespace_regex));
+  if (!a.namespace_exclude_regex.empty())
+    v.set("namespace_exclude", json::Value(a.namespace_exclude_regex));
+  if (!a.model_regex.empty()) v.set("model_name", json::Value(a.model_regex));
+  if (!a.accelerator_regex.empty())
+    v.set("accelerator_type", json::Value(a.accelerator_regex));
+  if (a.power_threshold) v.set("power_threshold", json::Value(*a.power_threshold));
+  if (a.hbm_threshold) v.set("hbm_threshold", json::Value(*a.hbm_threshold));
+  v.set("honor_labels", json::Value(a.honor_labels));
+  v.set("metric_schema", json::Value(a.metric_schema));
+  v.set("join_metric", json::Value(a.join_metric));
+  v.set("join_resource", json::Value(a.join_resource));
+  v.set("tensorcore_metric", json::Value(a.tensorcore_metric));
+  v.set("duty_cycle_metric", json::Value(a.duty_cycle_metric));
+  v.set("hbm_metric", json::Value(a.hbm_metric));
+  return v;
+}
+
+QueryArgs args_from_json(const json::Value& v) {
+  QueryArgs a;
+  if (const json::Value* x = v.find("device"); x && x->is_string()) a.device = x->as_string();
+  if (const json::Value* x = v.find("duration"); x && x->is_number()) a.duration_min = x->as_int();
+  if (const json::Value* x = v.find("namespace"); x && x->is_string())
+    a.namespace_regex = x->as_string();
+  if (const json::Value* x = v.find("namespace_exclude"); x && x->is_string())
+    a.namespace_exclude_regex = x->as_string();
+  if (const json::Value* x = v.find("model_name"); x && x->is_string())
+    a.model_regex = x->as_string();
+  if (const json::Value* x = v.find("accelerator_type"); x && x->is_string())
+    a.accelerator_regex = x->as_string();
+  if (const json::Value* x = v.find("power_threshold"); x && x->is_number())
+    a.power_threshold = x->as_double();
+  if (const json::Value* x = v.find("hbm_threshold"); x && x->is_number())
+    a.hbm_threshold = x->as_double();
+  if (const json::Value* x = v.find("honor_labels"); x && x->is_bool())
+    a.honor_labels = x->as_bool();
+  if (const json::Value* x = v.find("metric_schema"); x && x->is_string())
+    a.metric_schema = x->as_string();
+  if (const json::Value* x = v.find("join_metric"); x && x->is_string())
+    a.join_metric = x->as_string();
+  if (const json::Value* x = v.find("join_resource"); x && x->is_string())
+    a.join_resource = x->as_string();
+  if (const json::Value* x = v.find("tensorcore_metric"); x && x->is_string())
+    a.tensorcore_metric = x->as_string();
+  if (const json::Value* x = v.find("duty_cycle_metric"); x && x->is_string())
+    a.duty_cycle_metric = x->as_string();
+  if (const json::Value* x = v.find("hbm_metric"); x && x->is_string())
+    a.hbm_metric = x->as_string();
+  return a;
+}
+
 }  // namespace tpupruner::query

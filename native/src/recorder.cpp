@@ -1,0 +1,967 @@
+#include "tpupruner/recorder.hpp"
+
+#include <algorithm>
+#include <cctype>
+#include <filesystem>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <set>
+#include <stdexcept>
+#include <tuple>
+
+#include "tpupruner/audit.hpp"
+#include "tpupruner/core.hpp"
+#include "tpupruner/log.hpp"
+#include "tpupruner/metrics.hpp"
+#include "tpupruner/query.hpp"
+#include "tpupruner/util.hpp"
+#include "tpupruner/walker.hpp"
+
+namespace tpupruner::recorder {
+
+namespace fs = std::filesystem;
+using json::Value;
+
+namespace {
+
+// ── capture state ──
+
+struct OpenCapsule {
+  int64_t ts_unix = 0;
+  int64_t ts_ms = 0;        // capsule id component (restart-unique)
+  int64_t now_unix = 0;     // eligibility clock (resolve phase)
+  std::string prom_body;
+  Value pods = Value::object();         // "ns/name" → acquisition evidence
+  Value resolutions = Value::object();  // "ns/name" → walk result
+  Value objects = Value::object();      // API path → object | null (miss)
+  Value root_flags = Value::object();   // identity → {root_opted_out, ...}
+  Value actuations = Value::object();   // identity → {reason, action, detail}
+  Value vetoed_roots = Value::array();
+  Value vetoed_namespaces = Value::object();
+  Value breaker;                        // {limit, actionable, deferred, tripped}
+  Value stats;                          // {num_series, num_pods, shutdown_events}
+  std::vector<Value> decisions;         // verbatim DecisionRecord JSON
+  bool armed = false;
+  size_t remaining = 0;
+};
+
+struct IndexEntry {
+  std::string id;
+  Value summary;  // {id, cycle, ts, decisions, scale_downs, breaker_tripped}
+};
+
+struct Registry {
+  std::mutex mutex;
+  bool enabled = false;
+  std::string dir;
+  size_t keep = 64;
+  Value config;       // run config fingerprint
+  std::string query;  // rendered idle query
+  std::map<uint64_t, OpenCapsule> open;
+  std::vector<IndexEntry> index;  // oldest first (ids sort chronologically)
+};
+
+Registry& reg() {
+  static Registry r;
+  return r;
+}
+
+std::string pad(uint64_t n, int width) {
+  std::string s = std::to_string(n);
+  return s.size() >= static_cast<size_t>(width)
+             ? s
+             : std::string(static_cast<size_t>(width) - s.size(), '0') + s;
+}
+
+bool id_safe(const std::string& id) {
+  if (id.empty() || id.size() > 128) return false;
+  for (char c : id) {
+    if (!(std::isalnum(static_cast<unsigned char>(c)) || c == '-' || c == '_')) return false;
+  }
+  return true;
+}
+
+Value summarize(const std::string& id, const Value& doc) {
+  Value s = Value::object();
+  s.set("id", Value(id));
+  if (const Value* c = doc.find("cycle")) s.set("cycle", *c);
+  s.set("ts", Value(doc.get_string("ts")));
+  int64_t decisions = 0, scale_downs = 0;
+  if (const Value* d = doc.find("decisions"); d && d->is_array()) {
+    decisions = static_cast<int64_t>(d->as_array().size());
+    for (const Value& rec : d->as_array()) {
+      if (rec.get_string("action") == "scale_down") ++scale_downs;
+    }
+  }
+  s.set("decisions", Value(decisions));
+  s.set("scale_downs", Value(scale_downs));
+  bool tripped = false;
+  if (const Value* b = doc.at_path("breaker.tripped"); b && b->is_bool()) tripped = b->as_bool();
+  s.set("breaker_tripped", Value(tripped));
+  return s;
+}
+
+void prune_locked(Registry& r) {
+  while (r.index.size() > r.keep) {
+    std::error_code ec;
+    fs::remove(fs::path(r.dir) / (r.index.front().id + ".json"), ec);
+    r.index.erase(r.index.begin());
+  }
+}
+
+OpenCapsule* open_capsule_locked(Registry& r, uint64_t cycle) {
+  auto it = r.open.find(cycle);
+  return it == r.open.end() ? nullptr : &it->second;
+}
+
+// Assemble, atomically write and index the capsule, then drop it.
+void seal_locked(Registry& r, uint64_t cycle) {
+  auto it = r.open.find(cycle);
+  if (it == r.open.end()) return;
+  OpenCapsule& c = it->second;
+
+  // Deterministic decision order (capture lands them from fan-out threads).
+  std::sort(c.decisions.begin(), c.decisions.end(), [](const Value& a, const Value& b) {
+    return std::make_tuple(a.get_string("namespace"), a.get_string("pod")) <
+           std::make_tuple(b.get_string("namespace"), b.get_string("pod"));
+  });
+  Value decisions = Value::array();
+  for (Value& d : c.decisions) decisions.push_back(std::move(d));
+
+  std::string id = "cycle-" + pad(static_cast<uint64_t>(c.ts_ms), 13) + "-" + pad(cycle, 6);
+  Value doc = Value::object();
+  doc.set("version", Value(1));
+  doc.set("id", Value(id));
+  doc.set("cycle", Value(static_cast<int64_t>(cycle)));
+  doc.set("ts", Value(util::format_rfc3339(c.ts_unix)));
+  doc.set("ts_unix", Value(c.ts_unix));
+  doc.set("now_unix", Value(c.now_unix ? c.now_unix : c.ts_unix));
+  doc.set("query", Value(r.query));
+  doc.set("config", r.config);
+  Value prom = Value::object();
+  prom.set("body", Value(c.prom_body));
+  doc.set("prom", std::move(prom));
+  doc.set("pods", std::move(c.pods));
+  doc.set("resolutions", std::move(c.resolutions));
+  doc.set("objects", std::move(c.objects));
+  doc.set("vetoed_roots", std::move(c.vetoed_roots));
+  doc.set("vetoed_namespaces", std::move(c.vetoed_namespaces));
+  doc.set("root_flags", std::move(c.root_flags));
+  if (!c.breaker.is_null()) doc.set("breaker", std::move(c.breaker));
+  if (!c.stats.is_null()) doc.set("stats", std::move(c.stats));
+  doc.set("decisions", std::move(decisions));
+
+  fs::path final_path = fs::path(r.dir) / (id + ".json");
+  fs::path tmp_path = fs::path(r.dir) / (id + ".json.tmp");
+  {
+    std::ofstream out(tmp_path, std::ios::binary | std::ios::trunc);
+    out << doc.dump() << "\n";
+    if (!out.good()) {
+      log::warn("recorder", "capsule write failed for " + id + "; dropping it");
+      std::error_code ec;
+      fs::remove(tmp_path, ec);
+      r.open.erase(it);
+      return;
+    }
+  }
+  std::error_code ec;
+  fs::rename(tmp_path, final_path, ec);
+  if (ec) {
+    log::warn("recorder", "capsule rename failed for " + id + ": " + ec.message());
+    fs::remove(tmp_path, ec);
+    r.open.erase(it);
+    return;
+  }
+  r.index.push_back({id, summarize(id, doc)});
+  prune_locked(r);
+  r.open.erase(it);
+}
+
+}  // namespace
+
+void configure(const std::string& dir, int keep) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  r.open.clear();
+  r.index.clear();
+  r.dir = dir;
+  r.keep = keep < 1 ? 1 : static_cast<size_t>(keep);
+  r.enabled = !dir.empty();
+  if (!r.enabled) return;
+  std::error_code ec;
+  fs::create_directories(dir, ec);
+  if (ec) {
+    log::warn("recorder", "cannot create --flight-dir " + dir + ": " + ec.message() +
+              "; flight recorder disabled");
+    r.enabled = false;
+    return;
+  }
+  // Rebuild the index from whatever a previous run left behind, then
+  // prune — the ring survives restarts.
+  std::vector<std::string> ids;
+  for (const auto& entry : fs::directory_iterator(dir, ec)) {
+    std::string name = entry.path().filename().string();
+    if (name.rfind("cycle-", 0) == 0 && name.size() > 5 &&
+        name.substr(name.size() - 5) == ".json") {
+      ids.push_back(name.substr(0, name.size() - 5));
+    }
+  }
+  std::sort(ids.begin(), ids.end());
+  for (const std::string& id : ids) {
+    auto text = util::read_file((fs::path(dir) / (id + ".json")).string());
+    if (!text) continue;
+    try {
+      r.index.push_back({id, summarize(id, Value::parse(*text))});
+    } catch (const std::exception&) {
+      log::warn("recorder", "skipping unparseable capsule " + id + ".json");
+    }
+  }
+  prune_locked(r);
+  log::info("recorder", "flight recorder on: " + dir + " (keep " + std::to_string(r.keep) +
+            ", " + std::to_string(r.index.size()) + " capsule(s) reloaded)");
+}
+
+bool enabled() {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  return r.enabled;
+}
+
+void set_run_context(Value config, std::string query) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  r.config = std::move(config);
+  r.query = std::move(query);
+}
+
+void begin_cycle(uint64_t cycle, int64_t ts_unix) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  if (!r.enabled) return;
+  // A cycle that failed before arm() (query error) left its capsule open
+  // with no drain to seal it — drop such strays rather than leak them.
+  for (auto it = r.open.begin(); it != r.open.end();) {
+    it = (it->first < cycle && !it->second.armed) ? r.open.erase(it) : std::next(it);
+  }
+  OpenCapsule c;
+  c.ts_unix = ts_unix;
+  c.ts_ms = util::now_unix_nanos() / 1000000;
+  r.open[cycle] = std::move(c);
+}
+
+void record_prom_body(uint64_t cycle, const std::string& body) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  if (OpenCapsule* c = open_capsule_locked(r, cycle)) c->prom_body = body;
+}
+
+void record_resolve_now(uint64_t cycle, int64_t now_unix) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  if (OpenCapsule* c = open_capsule_locked(r, cycle)) c->now_unix = now_unix;
+}
+
+void record_pod(uint64_t cycle, const std::string& key, const Value* pod,
+                bool store_missed, const std::string& fetch_error) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  Value ev = Value::object();
+  ev.set("present", Value(pod != nullptr));
+  if (pod) ev.set("pod", *pod);
+  if (store_missed) ev.set("store_missed", Value(true));
+  if (!fetch_error.empty()) ev.set("fetch_error", Value(fetch_error));
+  c->pods.set(key, std::move(ev));
+}
+
+void record_resolution(uint64_t cycle, const std::string& key,
+                       const std::vector<std::string>& chain, const std::string& root_kind,
+                       const std::string& root_ns, const std::string& root_name,
+                       const std::string& identity, const std::string& error) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  Value res = Value::object();
+  Value hops = Value::array();
+  for (const std::string& hop : chain) hops.push_back(Value(hop));
+  res.set("chain", std::move(hops));
+  if (!error.empty()) {
+    res.set("error", Value(error));
+  } else {
+    Value root = Value::object();
+    root.set("kind", Value(root_kind));
+    root.set("namespace", Value(root_ns));
+    root.set("name", Value(root_name));
+    res.set("root", std::move(root));
+    res.set("identity", Value(identity));
+  }
+  c->resolutions.set(key, std::move(res));
+}
+
+void record_object(uint64_t cycle, const std::string& path, const Value* object) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  c->objects.set(path, object ? *object : Value(nullptr));
+}
+
+void record_vetoes(uint64_t cycle, const std::vector<std::string>& vetoed_roots,
+                   const std::vector<std::pair<std::string, std::string>>& vetoed_namespaces) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  for (const std::string& id : vetoed_roots) c->vetoed_roots.push_back(Value(id));
+  for (const auto& [ns, cause] : vetoed_namespaces) c->vetoed_namespaces.set(ns, Value(cause));
+}
+
+void flag_root(uint64_t cycle, const std::string& identity, const char* flag) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  const Value* existing = c->root_flags.find(identity);
+  Value flags = existing ? *existing : Value::object();
+  flags.set(flag, Value(true));
+  c->root_flags.set(identity, std::move(flags));
+}
+
+void record_breaker(uint64_t cycle, int64_t limit, size_t actionable, size_t deferred) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  Value b = Value::object();
+  b.set("limit", Value(limit));
+  b.set("actionable", Value(static_cast<int64_t>(actionable)));
+  b.set("deferred", Value(static_cast<int64_t>(deferred)));
+  b.set("tripped", Value(deferred > 0));
+  c->breaker = std::move(b);
+}
+
+void record_stats(uint64_t cycle, size_t num_series, size_t num_pods, size_t shutdown_events) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  Value s = Value::object();
+  s.set("num_series", Value(static_cast<int64_t>(num_series)));
+  s.set("num_pods", Value(static_cast<int64_t>(num_pods)));
+  s.set("shutdown_events", Value(static_cast<int64_t>(shutdown_events)));
+  c->stats = std::move(s);
+}
+
+void record_decision(uint64_t cycle, Value decision) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  c->decisions.push_back(std::move(decision));
+}
+
+void arm(uint64_t cycle, size_t expected) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  c->armed = true;
+  c->remaining = expected;
+  if (expected == 0) seal_locked(r, cycle);
+}
+
+void record_actuation(uint64_t cycle, const std::string& identity, const std::string& reason,
+                      const std::string& action, const std::string& detail) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  Value a = Value::object();
+  a.set("reason", Value(reason));
+  a.set("action", Value(action));
+  if (!detail.empty()) a.set("detail", Value(detail));
+  c->actuations.set(identity, std::move(a));
+  if (c->armed && c->remaining > 0 && --c->remaining == 0) seal_locked(r, cycle);
+}
+
+void seal_all() {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  // Armed capsules still waiting on a drained queue flush (their dropped
+  // targets already landed SHUTDOWN_ABORTED decisions); unarmed strays
+  // (mid-cycle shutdown) are dropped — a capsule without its decisions
+  // would replay as drift, which helps nobody.
+  std::vector<uint64_t> cycles;
+  for (const auto& [cycle, c] : r.open) {
+    if (c.armed) cycles.push_back(cycle);
+  }
+  for (uint64_t cycle : cycles) seal_locked(r, cycle);
+  r.open.clear();
+}
+
+json::Value index_json() {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  Value capsules = Value::array();
+  for (const IndexEntry& e : r.index) capsules.push_back(e.summary);
+  Value out = Value::object();
+  out.set("capsules", std::move(capsules));
+  out.set("dir", Value(r.dir));
+  out.set("keep", Value(static_cast<int64_t>(r.keep)));
+  return out;
+}
+
+std::string capsule_body(const std::string& id) {
+  if (!id_safe(id)) return "";
+  Registry& r = reg();
+  std::string path;
+  {
+    std::lock_guard<std::mutex> lock(r.mutex);
+    if (!r.enabled) return "";
+    path = (fs::path(r.dir) / (id + ".json")).string();
+  }
+  return util::read_file(path).value_or("");
+}
+
+void reset_for_test() {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  r.enabled = false;
+  r.dir.clear();
+  r.keep = 64;
+  r.config = Value();
+  r.query.clear();
+  r.open.clear();
+  r.index.clear();
+}
+
+// ── replay engine ─────────────────────────────────────────────────────────
+
+namespace {
+
+int64_t parse_duration_secs(const std::string& key, const Value& v) {
+  if (v.is_number()) return v.as_int();
+  if (!v.is_string()) throw std::runtime_error("what-if " + key + ": expected duration");
+  const std::string& s = v.as_string();
+  try {
+    size_t idx = 0;
+    long long n = std::stoll(s, &idx);
+    if (idx == s.size()) return n;  // bare number: seconds
+    if (idx == s.size() - 1) {
+      switch (s[idx]) {
+        case 's': return n;
+        case 'm': return n * 60;
+        case 'h': return n * 3600;
+      }
+    }
+  } catch (const std::exception&) {
+  }
+  throw std::runtime_error("what-if " + key + ": invalid duration '" + s +
+                           "' (expected e.g. 30m, 600s, 2h, or bare seconds)");
+}
+
+int64_t parse_int_value(const std::string& key, const Value& v) {
+  if (v.is_number()) return v.as_int();
+  if (v.is_string()) {
+    try {
+      size_t idx = 0;
+      long long n = std::stoll(v.as_string(), &idx);
+      if (idx == v.as_string().size()) return n;
+    } catch (const std::exception&) {
+    }
+  }
+  throw std::runtime_error("what-if " + key + ": invalid integer");
+}
+
+double parse_double_value(const std::string& key, const Value& v) {
+  if (v.is_number()) return v.as_double();
+  if (v.is_string()) {
+    try {
+      size_t idx = 0;
+      double d = std::stod(v.as_string(), &idx);
+      if (idx == v.as_string().size()) return d;
+    } catch (const std::exception&) {
+    }
+  }
+  throw std::runtime_error("what-if " + key + ": invalid number");
+}
+
+std::string value_string(const std::string& key, const Value& v) {
+  if (!v.is_string()) throw std::runtime_error("what-if " + key + ": expected a string");
+  return v.as_string();
+}
+
+// Volatile fields stripped before the bit-for-bit comparison: wall-clock
+// timestamps and OTLP trace ids legitimately differ between a live cycle
+// and its offline replay; everything else must match byte-identically.
+Value normalize_decision(const Value& d) {
+  Value c = d;
+  c.as_object().erase("ts");
+  c.as_object().erase("trace_id");
+  return c;
+}
+
+bool is_actuation_reason(const std::string& reason) {
+  return reason == "SCALED" || reason == "ALREADY_PAUSED" || reason == "SCALE_FAILED" ||
+         reason == "KIND_DISABLED" || reason == "SHUTDOWN_ABORTED";
+}
+
+}  // namespace
+
+Value replay(const Value& capsule, const Value& what_if) {
+  auto require = [&](const char* key) -> const Value& {
+    const Value* v = capsule.find(key);
+    if (!v) throw std::runtime_error(std::string("malformed capsule: missing ") + key);
+    return *v;
+  };
+
+  // ── effective config = capsule fingerprint + what-if overlay ──
+  const Value& cfg = require("config");
+  const Value* qa = cfg.find("query_args");
+  if (!qa) throw std::runtime_error("malformed capsule: config missing query_args");
+  query::QueryArgs qargs = query::args_from_json(*qa);
+  std::string run_mode = cfg.get_string("run_mode", "dry-run");
+  std::string enabled_flags = cfg.get_string("enabled_resources", "drsinjl");
+  auto cfg_int = [&](const char* key, int64_t dflt) {
+    const Value* v = cfg.find(key);
+    return (v && v->is_number()) ? v->as_int() : dflt;
+  };
+  int64_t grace_s = cfg_int("grace_s", 300);
+  int64_t lookback_s = cfg_int("lookback_s", qargs.duration_min * 60 + grace_s);
+  const int64_t recorded_max_scale = cfg_int("max_scale_per_cycle", 0);
+  int64_t max_scale = recorded_max_scale;
+
+  bool breaker_overridden = false, lookback_explicit = false, window_derived = false;
+  bool has_what_if = what_if.is_object() && !what_if.as_object().empty();
+  if (what_if.is_object()) {
+    for (const auto& [key, val] : what_if.as_object()) {
+      if (key == "lookback") {
+        lookback_s = parse_duration_secs(key, val);
+        lookback_explicit = true;
+      } else if (key == "duration") {
+        // Plain numbers are minutes (the -t flag's unit); suffixed
+        // durations ("45m", "3600s") convert through seconds.
+        if (val.is_string() && !val.as_string().empty() &&
+            !std::isdigit(static_cast<unsigned char>(val.as_string().back()))) {
+          qargs.duration_min = parse_duration_secs(key, val) / 60;
+        } else {
+          qargs.duration_min = parse_int_value(key, val);
+        }
+        window_derived = true;
+      } else if (key == "grace") {
+        grace_s = parse_duration_secs(key, val);
+        window_derived = true;
+      } else if (key == "run_mode") {
+        run_mode = value_string(key, val);
+        if (run_mode != "scale-down" && run_mode != "dry-run") {
+          throw std::runtime_error("what-if run_mode: expected scale-down|dry-run");
+        }
+      } else if (key == "enabled_resources") {
+        enabled_flags = value_string(key, val);
+      } else if (key == "max_scale_per_cycle") {
+        max_scale = parse_int_value(key, val);
+        breaker_overridden = true;
+      } else if (key == "hbm_threshold") {
+        qargs.hbm_threshold = parse_double_value(key, val);
+      } else {
+        throw std::runtime_error(
+            "unknown what-if key: " + key +
+            " (supported: lookback, duration, grace, run_mode, enabled_resources, "
+            "max_scale_per_cycle, hbm_threshold)");
+      }
+    }
+    if (window_derived && !lookback_explicit) lookback_s = qargs.duration_min * 60 + grace_s;
+  }
+  const bool dry_run = run_mode != "scale-down";
+  const core::ResourceSet enabled = core::parse_enabled_resources(enabled_flags);
+
+  // Query-shaping keys (duration window, hbm_threshold) re-render the
+  // PromQL; the recorded response can't be re-queried offline, so the
+  // changed query is REPORTED while decisions evaluate recorded evidence.
+  std::string replay_query = query::build_idle_query(qargs);
+  const bool query_changed = replay_query != capsule.get_string("query");
+
+  // ── decode the verbatim recorded body (zero network) ──
+  metrics::DecodeResult decoded = metrics::decode_instant_vector(
+      Value::parse(require("prom").get_string("body")), qargs.device, qargs.metric_schema);
+
+  const int64_t now = require("now_unix").as_int();
+  const uint64_t cycle = static_cast<uint64_t>(require("cycle").as_int());
+  const Value* pods_ev = capsule.find("pods");
+  const Value* resolutions = capsule.find("resolutions");
+  const Value* objects = capsule.find("objects");
+  const Value* root_flags = capsule.find("root_flags");
+  const Value* actuations = capsule.find("actuations");
+  std::set<std::string> vetoed_roots;
+  if (const Value* vr = capsule.find("vetoed_roots"); vr && vr->is_array()) {
+    for (const Value& v : vr->as_array()) vetoed_roots.insert(v.as_string());
+  }
+  std::map<std::string, std::string> vetoed_ns;
+  if (const Value* vn = capsule.find("vetoed_namespaces"); vn && vn->is_object()) {
+    for (const auto& [k, v] : vn->as_object()) vetoed_ns[k] = v.as_string();
+  }
+
+  // The REAL owner walk over the capsule's recorded object snapshot —
+  // used only for pods the captured cycle never walked (a gate the
+  // what-if re-opened). A path absent from the snapshot answers like a
+  // 404: the offline store cannot invent topology it never saw.
+  walker::ObjectFetcher fetcher = [&](const std::string& path) -> std::optional<Value> {
+    const Value* o = objects ? objects->find(path) : nullptr;
+    if (!o || o->is_null()) return std::nullopt;
+    return *o;
+  };
+
+  const std::string signal_metric =
+      qargs.device == "gpu" ? "dcgm/gr_engine_active" : "tensorcore/duty_cycle";
+
+  struct PendingT {
+    audit::DecisionRecord rec;
+    std::string identity;
+    core::Kind kind = core::Kind::Deployment;
+  };
+  std::vector<audit::DecisionRecord> finals;
+  std::vector<PendingT> pendings;
+  std::map<std::string, bool> predicted_by_pod;
+
+  // Deterministic order (capture fan-out order is thread-dependent; the
+  // comparison is keyed by pod, so only tie-breaking cares).
+  std::vector<const core::PodMetricSample*> samples;
+  for (const core::PodMetricSample& s : decoded.samples) samples.push_back(&s);
+  std::sort(samples.begin(), samples.end(),
+            [](const core::PodMetricSample* a, const core::PodMetricSample* b) {
+              return std::tie(a->ns, a->name) < std::tie(b->ns, b->name);
+            });
+
+  struct Res {
+    bool resolved = false;
+    std::vector<std::string> chain;
+    std::string kind, ns, name, identity, error;
+  };
+
+  for (const core::PodMetricSample* s : samples) {
+    const std::string key = s->ns + "/" + s->name;
+    audit::DecisionRecord rec;
+    rec.cycle = cycle;
+    rec.ns = s->ns;
+    rec.pod = s->name;
+    rec.signal_metric = signal_metric;
+    rec.signal_value = s->value;
+    rec.has_signal = true;
+    rec.accelerator = s->accelerator;
+    rec.lookback_s = lookback_s;
+    auto decide = [&](audit::Reason reason, const std::string& detail = "") {
+      rec.reason = reason;
+      rec.action = "none";
+      rec.detail = detail;
+      finals.push_back(rec);
+    };
+
+    const Value* ev = pods_ev ? pods_ev->find(key) : nullptr;
+    if (!ev) {
+      throw std::runtime_error("malformed capsule: no pod evidence for candidate " + key);
+    }
+    if (std::string fetch_error = ev->get_string("fetch_error"); !fetch_error.empty()) {
+      decide(audit::Reason::FetchError, "pod GET failed, namespace vetoed: " + fetch_error);
+      continue;
+    }
+    const Value* present = ev->find("present");
+    if (!(present && present->is_bool() && present->as_bool())) {
+      const Value* sm = ev->find("store_missed");
+      bool store_missed = sm && sm->is_bool() && sm->as_bool();
+      decide(store_missed ? audit::Reason::WatchCacheMiss : audit::Reason::PodGone,
+             store_missed ? "absent from the synced watch store and from the live GET"
+                          : "in the metric plane but not in the cluster");
+      continue;
+    }
+    const Value* pod = ev->find("pod");
+    if (!pod) throw std::runtime_error("malformed capsule: pod evidence without object for " + key);
+
+    auto resolve = [&]() -> Res {
+      Res r;
+      if (const Value* rv = resolutions ? resolutions->find(key) : nullptr) {
+        if (const Value* c = rv->find("chain"); c && c->is_array()) {
+          for (const Value& hop : c->as_array()) r.chain.push_back(hop.as_string());
+        }
+        if (const Value* root = rv->find("root")) {
+          r.resolved = true;
+          r.kind = root->get_string("kind");
+          r.ns = root->get_string("namespace");
+          r.name = root->get_string("name");
+          r.identity = rv->get_string("identity");
+        } else {
+          r.error = rv->get_string("error",
+                                   "no scalable root object found for pod " + key);
+        }
+        return r;
+      }
+      try {
+        core::ScaleTarget t = walker::find_root_object_from(fetcher, *pod, &r.chain);
+        r.resolved = true;
+        r.kind = std::string(core::kind_name(t.kind));
+        r.ns = t.ns().value_or("");
+        r.name = t.name();
+        r.identity = t.identity();
+      } catch (const std::exception& e) {
+        r.error = e.what();
+      }
+      return r;
+    };
+
+    core::Eligibility elig = core::check_eligibility(*pod, now, lookback_s);
+    if (elig == core::Eligibility::Pending) {
+      decide(audit::Reason::PendingPod);
+      continue;
+    }
+    if (elig == core::Eligibility::NoCreationTs) {
+      decide(audit::Reason::NoCreationTimestamp);
+      continue;
+    }
+    if (elig == core::Eligibility::BadTimestamp) {
+      decide(audit::Reason::BadCreationTimestamp);
+      continue;
+    }
+    if (elig == core::Eligibility::TooYoung) {
+      decide(audit::Reason::BelowMinAge,
+             "created within the " + std::to_string(lookback_s) + "s lookback window");
+      continue;
+    }
+    if (elig == core::Eligibility::OptedOut) {
+      Res r = resolve();
+      rec.owner_chain = r.chain;
+      if (!r.resolved) {
+        decide(audit::Reason::OptedOut,
+               "annotated pod with unresolvable root; namespace vetoed: " + r.error);
+      } else {
+        rec.root_kind = r.kind;
+        rec.root_ns = r.ns;
+        rec.root_name = r.name;
+        decide(audit::Reason::OptedOut,
+               "pod annotation vetoes its root for every kind this cycle");
+      }
+      continue;
+    }
+    // Eligible
+    Res r = resolve();
+    rec.owner_chain = r.chain;
+    if (!r.resolved) {
+      decide(audit::Reason::NoScalableOwner, r.error);
+      continue;
+    }
+    rec.root_kind = r.kind;
+    rec.root_ns = r.ns;
+    rec.root_name = r.name;
+    PendingT p;
+    p.rec = std::move(rec);
+    p.identity = r.identity;
+    if (auto k = core::kind_from_name(r.kind)) p.kind = *k;
+    pendings.push_back(std::move(p));
+  }
+
+  // ── target-level gates (same order as run_cycle: valves → group gate →
+  //    breaker → dry-run / consumer) over unique root identities ──
+  std::vector<std::string> order;
+  std::map<std::string, core::Kind> kind_of;
+  std::map<std::string, std::string> ns_of;
+  for (const PendingT& p : pendings) {
+    if (!kind_of.count(p.identity)) {
+      order.push_back(p.identity);
+      kind_of[p.identity] = p.kind;
+      ns_of[p.identity] = p.rec.root_ns;
+    }
+  }
+  auto flag_set = [&](const std::string& id, const char* f) {
+    const Value* fl = root_flags ? root_flags->find(id) : nullptr;
+    if (!fl) return false;
+    const Value* b = fl->find(f);
+    return b && b->is_bool() && b->as_bool();
+  };
+
+  struct Outcome {
+    audit::Reason reason = audit::Reason::DryRun;
+    std::string action = "none";
+    std::string detail;
+    bool pending_actuation = false;  // enabled survivor awaiting per-pod join
+    bool predicted = false;
+  };
+  std::map<std::string, Outcome> outcomes;
+  std::vector<std::string> survivors;
+  for (const std::string& id : order) {
+    if (flag_set(id, "root_opted_out")) {
+      outcomes[id] = {audit::Reason::RootOptedOut, "none",
+                      "annotated " + std::string(core::kSkipAnnotation) + "=true", false, false};
+    } else if (vetoed_roots.count(id)) {
+      outcomes[id] = {audit::Reason::VetoedByAnnotatedPod, "none", "vetoed by an annotated pod",
+                      false, false};
+    } else if (auto it = vetoed_ns.find(ns_of[id]); it != vetoed_ns.end()) {
+      outcomes[id] = {audit::Reason::NamespaceVetoed, "none",
+                      "namespace vetoed (" + it->second + ")", false, false};
+    } else if (flag_set(id, "group_not_idle")) {
+      outcomes[id] = {audit::Reason::GroupNotIdle, "none",
+                      "group has active (or too-young) TPU hosts", false, false};
+    } else {
+      survivors.push_back(id);
+    }
+  }
+  auto final_stage = [&](const std::string& id) {
+    Outcome o;
+    if (dry_run) {
+      o = {audit::Reason::DryRun, "none", "would have paused (run-mode dry-run)", false, false};
+    } else if (!(enabled & core::flag(kind_of[id]))) {
+      o = {audit::Reason::KindDisabled, "none", "", false, false};
+    } else {
+      o.pending_actuation = true;
+    }
+    outcomes[id] = o;
+  };
+  if (!breaker_overridden) {
+    // Breaker deferrals are recorded cluster-time facts; held fixed.
+    for (const std::string& id : survivors) {
+      if (flag_set(id, "deferred")) {
+        outcomes[id] = {audit::Reason::Deferred, "none",
+                        "over --max-scale-per-cycle=" + std::to_string(recorded_max_scale),
+                        false, false};
+      } else {
+        final_stage(id);
+      }
+    }
+  } else if (max_scale > 0) {
+    size_t budget = static_cast<size_t>(max_scale);
+    for (const std::string& id : survivors) {
+      if (!(enabled & core::flag(kind_of[id]))) {
+        final_stage(id);  // disabled kinds never consume breaker slots
+        continue;
+      }
+      if (budget > 0) {
+        --budget;
+        final_stage(id);
+      } else {
+        outcomes[id] = {audit::Reason::Deferred, "none",
+                        "over --max-scale-per-cycle=" + std::to_string(max_scale), false, false};
+      }
+    }
+  } else {
+    for (const std::string& id : survivors) final_stage(id);
+  }
+
+  // Recorded decisions, keyed by pod — the comparison baseline and the
+  // per-pod fallback for actuation outcomes (the one stage replay cannot
+  // re-run: it was a cluster interaction).
+  std::map<std::string, Value> recorded_by_pod;
+  if (const Value* recs = capsule.find("decisions"); recs && recs->is_array()) {
+    for (const Value& d : recs->as_array()) {
+      recorded_by_pod[d.get_string("namespace") + "/" + d.get_string("pod")] = d;
+    }
+  }
+
+  for (PendingT& p : pendings) {
+    const std::string key = p.rec.ns + "/" + p.rec.pod;
+    Outcome o = outcomes[p.identity];
+    if (o.pending_actuation) {
+      const Value* act = actuations ? actuations->find(p.identity) : nullptr;
+      if (act) {
+        o.reason = audit::reason_from_name(act->get_string("reason"))
+                       .value_or(audit::Reason::Scaled);
+        o.action = act->get_string("action", "none");
+        o.detail = act->get_string("detail");
+      } else if (auto it = recorded_by_pod.find(key);
+                 it != recorded_by_pod.end() &&
+                 is_actuation_reason(it->second.get_string("reason"))) {
+        o.reason = audit::reason_from_name(it->second.get_string("reason"))
+                       .value_or(audit::Reason::Scaled);
+        o.action = it->second.get_string("action", "none");
+        o.detail = it->second.get_string("detail");
+      } else {
+        // What-if opened a path the recorded cycle never actuated.
+        o.reason = audit::Reason::Scaled;
+        o.action = "scale_down";
+        o.predicted = true;
+      }
+    }
+    p.rec.reason = o.reason;
+    p.rec.action = o.action;
+    p.rec.detail = o.detail;
+    if (o.predicted) predicted_by_pod[key] = true;
+    finals.push_back(std::move(p.rec));
+  }
+
+  // ── bit-for-bit comparison over normalized records ──
+  std::map<std::string, Value> replayed_by_pod;
+  for (const audit::DecisionRecord& rec : finals) {
+    replayed_by_pod[rec.ns + "/" + rec.pod] = normalize_decision(rec.to_json());
+  }
+  std::map<std::string, Value> recorded_norm;
+  for (const auto& [key, d] : recorded_by_pod) recorded_norm[key] = normalize_decision(d);
+
+  Value drift = Value::array();
+  Value flips = Value::array();
+  std::set<std::string> keys;
+  for (const auto& [k, _] : replayed_by_pod) keys.insert(k);
+  for (const auto& [k, _] : recorded_norm) keys.insert(k);
+  for (const std::string& k : keys) {
+    auto rep = replayed_by_pod.find(k);
+    auto recd = recorded_norm.find(k);
+    const bool have_rep = rep != replayed_by_pod.end();
+    const bool have_rec = recd != recorded_norm.end();
+    if (have_rep && have_rec && rep->second.dump() == recd->second.dump()) continue;
+    Value entry = Value::object();
+    entry.set("pod", Value(k));
+    entry.set("recorded", have_rec ? recd->second : Value(nullptr));
+    entry.set("replayed", have_rep ? rep->second : Value(nullptr));
+    drift.push_back(std::move(entry));
+    if (has_what_if && have_rep && have_rec) {
+      const std::string from_reason = recd->second.get_string("reason");
+      const std::string to_reason = rep->second.get_string("reason");
+      const std::string from_action = recd->second.get_string("action");
+      const std::string to_action = rep->second.get_string("action");
+      if (from_reason != to_reason || from_action != to_action) {
+        Value flip = Value::object();
+        flip.set("pod", Value(k));
+        Value from = Value::object();
+        from.set("reason", Value(from_reason));
+        from.set("action", Value(from_action));
+        Value to = Value::object();
+        to.set("reason", Value(to_reason));
+        to.set("action", Value(to_action));
+        flip.set("from", std::move(from));
+        flip.set("to", std::move(to));
+        flip.set("predicted", Value(predicted_by_pod.count(k) > 0));
+        flips.push_back(std::move(flip));
+      }
+    }
+  }
+
+  int64_t recorded_scale_downs = 0, replayed_scale_downs = 0;
+  for (const auto& [_, d] : recorded_norm) {
+    if (d.get_string("action") == "scale_down") ++recorded_scale_downs;
+  }
+  Value replayed = Value::array();
+  for (const auto& [_, d] : replayed_by_pod) {
+    if (d.get_string("action") == "scale_down") ++replayed_scale_downs;
+    replayed.push_back(d);
+  }
+  Value recorded = Value::array();
+  for (const auto& [_, d] : recorded_norm) recorded.push_back(d);
+
+  Value out = Value::object();
+  out.set("cycle", Value(static_cast<int64_t>(cycle)));
+  out.set("match", Value(drift.as_array().empty()));
+  out.set("replayed", std::move(replayed));
+  out.set("recorded", std::move(recorded));
+  out.set("drift", std::move(drift));
+  if (has_what_if) {
+    out.set("flips", std::move(flips));
+    out.set("what_if", what_if);
+  }
+  out.set("query_changed", Value(query_changed));
+  if (query_changed) out.set("replay_query", Value(replay_query));
+  Value actions = Value::object();
+  actions.set("recorded_scale_downs", Value(recorded_scale_downs));
+  actions.set("replayed_scale_downs", Value(replayed_scale_downs));
+  out.set("actions", std::move(actions));
+  return out;
+}
+
+}  // namespace tpupruner::recorder

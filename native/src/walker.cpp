@@ -39,11 +39,10 @@ std::optional<Value> cached_get_opt(const k8s::Client& client, FetchCache* cache
 // Mid-level fetch (ReplicaSet/StatefulSet/Job): failures are swallowed and
 // the ownerRef loop moves on (reference: `if let Ok(rs) = rs_api.get(...)`,
 // lib.rs:465, 485).
-std::optional<ScaleTarget> fetch(const k8s::Client& client, FetchCache* cache,
-                                 const informer::ClusterCache* store, Kind kind,
+std::optional<ScaleTarget> fetch(const ObjectFetcher& fetcher, Kind kind,
                                  const std::string& ns, const std::string& name) {
   try {
-    auto obj = cached_get_opt(client, cache, store, k8s::Client::object_path(kind, ns, name));
+    auto obj = fetcher(k8s::Client::object_path(kind, ns, name));
     if (!obj) return std::nullopt;
     return ScaleTarget{kind, std::move(*obj)};
   } catch (const std::exception& e) {
@@ -58,10 +57,9 @@ std::optional<ScaleTarget> fetch(const k8s::Client& client, FetchCache* cache,
 // silently actuating the intermediate owner (reference `?` operator,
 // lib.rs:472, 492 — a transient apiserver error must not demote the target
 // from Deployment to ReplicaSet).
-ScaleTarget fetch_must(const k8s::Client& client, FetchCache* cache,
-                       const informer::ClusterCache* store, Kind kind,
+ScaleTarget fetch_must(const ObjectFetcher& fetcher, Kind kind,
                        const std::string& ns, const std::string& name) {
-  auto obj = cached_get_opt(client, cache, store, k8s::Client::object_path(kind, ns, name));
+  auto obj = fetcher(k8s::Client::object_path(kind, ns, name));
   if (!obj) {
     throw std::runtime_error(std::string(core::kind_name(kind)) + " " + ns + "/" + name +
                              " referenced by owner chain but not found");
@@ -139,6 +137,22 @@ void FetchCache::seed(const std::string& key, Entry entry) {
   flight->entry = std::move(entry);
   std::lock_guard<std::mutex> lock(mutex_);
   map_.emplace(key, std::move(flight));  // emplace: no-op when key exists
+}
+
+std::vector<std::pair<std::string, FetchCache::Entry>> FetchCache::snapshot() {
+  std::vector<std::pair<std::string, std::shared_ptr<Flight>>> flights;
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    flights.reserve(map_.size());
+    for (const auto& [key, flight] : map_) flights.push_back({key, flight});
+  }
+  std::vector<std::pair<std::string, Entry>> out;
+  out.reserve(flights.size());
+  for (auto& [key, flight] : flights) {
+    std::lock_guard<std::mutex> lock(flight->m);
+    if (flight->done && !flight->failed) out.push_back({key, flight->entry});
+  }
+  return out;
 }
 
 namespace {
@@ -265,6 +279,14 @@ size_t prefetch_owner_chains(const k8s::Client& client, FetchCache& cache,
 ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchCache* cache,
                              const informer::ClusterCache* store,
                              std::vector<std::string>* chain_out) {
+  ObjectFetcher fetcher = [&](const std::string& path) {
+    return cached_get_opt(client, cache, store, path);
+  };
+  return find_root_object_from(fetcher, pod, chain_out);
+}
+
+ScaleTarget find_root_object_from(const ObjectFetcher& fetcher, const Value& pod,
+                                  std::vector<std::string>* chain_out) {
   std::string ns = pod_ns(pod);
   std::string pod_name = pod.at_path("metadata.name") ? pod.at_path("metadata.name")->as_string()
                                                       : "<unnamed>";
@@ -282,7 +304,7 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
     const Value* ks = labels->find("serving.kserve.io/inferenceservice");
     if (ks && ks->is_string()) {
       hop("InferenceService", ks->as_string());
-      return fetch_must(client, cache, store, Kind::InferenceService, ns, ks->as_string());
+      return fetch_must(fetcher, Kind::InferenceService, ns, ks->as_string());
     }
     // LWS shortcut: EVERY pod of a LeaderWorkerSet (leader and worker)
     // carries this label, while the ownerRef chain differs by role (the
@@ -291,7 +313,7 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
     const Value* lws = labels->find("leaderworkerset.sigs.k8s.io/name");
     if (lws && lws->is_string()) {
       hop("LeaderWorkerSet", lws->as_string());
-      return fetch_must(client, cache, store, Kind::LeaderWorkerSet, ns, lws->as_string());
+      return fetch_must(fetcher, Kind::LeaderWorkerSet, ns, lws->as_string());
     }
   }
 
@@ -302,26 +324,26 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
       std::string name = owner.get_string("name");
 
       if (kind == "ReplicaSet") {
-        if (auto rs = fetch(client, cache, store, Kind::ReplicaSet, ns, name)) {
+        if (auto rs = fetch(fetcher, Kind::ReplicaSet, ns, name)) {
           hop("ReplicaSet", name);
           if (const Value* dep_or = owner_of_kind(rs->object, "Deployment")) {
             hop("Deployment", dep_or->get_string("name"));
-            return fetch_must(client, cache, store, Kind::Deployment, ns, dep_or->get_string("name"));
+            return fetch_must(fetcher, Kind::Deployment, ns, dep_or->get_string("name"));
           }
           return std::move(*rs);  // ReplicaSet with no Deployment owner
         }
       } else if (kind == "StatefulSet") {
-        if (auto ss = fetch(client, cache, store, Kind::StatefulSet, ns, name)) {
+        if (auto ss = fetch(fetcher, Kind::StatefulSet, ns, name)) {
           hop("StatefulSet", name);
           if (const Value* nb_or = owner_of_kind(ss->object, "Notebook")) {
             hop("Notebook", nb_or->get_string("name"));
-            return fetch_must(client, cache, store, Kind::Notebook, ns, nb_or->get_string("name"));
+            return fetch_must(fetcher, Kind::Notebook, ns, nb_or->get_string("name"));
           }
           // Multi-host serving groups: LWS creates one StatefulSet per
           // replica group; the LeaderWorkerSet is the scalable root.
           if (const Value* lws_or = owner_of_kind(ss->object, "LeaderWorkerSet")) {
             hop("LeaderWorkerSet", lws_or->get_string("name"));
-            return fetch_must(client, cache, store, Kind::LeaderWorkerSet, ns,
+            return fetch_must(fetcher, Kind::LeaderWorkerSet, ns,
                               lws_or->get_string("name"));
           }
           return std::move(*ss);  // StatefulSet with no CR owner
@@ -332,7 +354,7 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
         // suspending them mid-run is destructive, so fall through.
         std::optional<Value> job;
         try {
-          job = cached_get_opt(client, cache, store, k8s::Client::job_path(ns, name));
+          job = fetcher(k8s::Client::job_path(ns, name));
         } catch (const std::exception& e) {
           log::warn("walker", "fetch Job " + ns + "/" + name + " failed: " + e.what());
         }
@@ -340,7 +362,7 @@ ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchC
           hop("Job", name);
           if (const Value* js_or = owner_of_kind(*job, "JobSet")) {
             hop("JobSet", js_or->get_string("name"));
-            return fetch_must(client, cache, store, Kind::JobSet, ns, js_or->get_string("name"));
+            return fetch_must(fetcher, Kind::JobSet, ns, js_or->get_string("name"));
           }
           log::debug("walker", "pod " + ns + "/" + pod_name + ": bare Job owner '" + name +
                      "' is not scalable, ignoring");

@@ -1,0 +1,207 @@
+// Flight-recorder units: capsule serialize/deserialize round-trips through
+// the on-disk ring, ring bounding + restart reload, id hygiene, and the
+// replay engine's pure-replay/what-if mechanics. The e2e behavior (real
+// daemon, fakes, analyze --replay) rides tests/test_flight_recorder.py.
+#include <cstdlib>
+#include <unistd.h>
+
+#include "testing.hpp"
+#include "tpupruner/audit.hpp"
+#include "tpupruner/json.hpp"
+#include "tpupruner/recorder.hpp"
+
+namespace recorder = tpupruner::recorder;
+namespace audit = tpupruner::audit;
+using tpupruner::json::Value;
+
+namespace {
+
+std::string make_tmpdir() {
+  char tmpl[] = "/tmp/tp-recorder-XXXXXX";
+  char* dir = ::mkdtemp(tmpl);
+  TP_CHECK(dir != nullptr);
+  return dir;
+}
+
+Value run_config() {
+  Value qa = Value::object();
+  qa.set("device", Value("tpu"));
+  qa.set("duration", Value(30));
+  qa.set("metric_schema", Value("gmp"));
+  Value cfg = Value::object();
+  cfg.set("query_args", std::move(qa));
+  cfg.set("run_mode", Value("dry-run"));
+  cfg.set("dry_run", Value(true));
+  cfg.set("enabled_resources", Value("drsinjl"));
+  cfg.set("duration_min", Value(30));
+  cfg.set("grace_s", Value(300));
+  cfg.set("lookback_s", Value(2100));
+  cfg.set("max_scale_per_cycle", Value(0));
+  cfg.set("watch_cache", Value("off"));
+  return cfg;
+}
+
+const char* kPromBody =
+    "{\"status\":\"success\",\"data\":{\"resultType\":\"vector\",\"result\":"
+    "[{\"metric\":{\"exported_pod\":\"p1\",\"exported_namespace\":\"ml\","
+    "\"exported_container\":\"main\",\"accelerator_type\":\"v5e\","
+    "\"node_type\":\"v5e\",\"accelerator_id\":\"0\"},"
+    "\"value\":[1000,\"0\"]}]}}";
+
+Value old_pod() {
+  return Value::parse(
+      "{\"metadata\":{\"name\":\"p1\",\"namespace\":\"ml\","
+      "\"creationTimestamp\":\"2020-01-01T00:00:00Z\"},"
+      "\"status\":{\"phase\":\"Running\"}}");
+}
+
+// The DecisionRecord the dry-run pipeline produces for the capsule above —
+// recorded verbatim so pure replay must reproduce it bit-for-bit.
+Value expected_decision(uint64_t cycle) {
+  audit::DecisionRecord rec;
+  rec.cycle = cycle;
+  rec.ns = "ml";
+  rec.pod = "p1";
+  rec.signal_metric = "tensorcore/duty_cycle";
+  rec.signal_value = 0.0;
+  rec.has_signal = true;
+  rec.accelerator = "v5e";
+  rec.lookback_s = 2100;
+  rec.owner_chain = {"Pod/ml/p1", "ReplicaSet/ml/rs", "Deployment/ml/dep"};
+  rec.root_kind = "Deployment";
+  rec.root_ns = "ml";
+  rec.root_name = "dep";
+  rec.reason = audit::Reason::DryRun;
+  rec.action = "none";
+  rec.detail = "would have paused (run-mode dry-run)";
+  return rec.to_json();
+}
+
+// Seal one full capsule for `cycle` through the capture API.
+void seal_cycle(uint64_t cycle) {
+  recorder::begin_cycle(cycle, 1754000000 + static_cast<int64_t>(cycle));
+  recorder::record_prom_body(cycle, kPromBody);
+  recorder::record_resolve_now(cycle, 1754000000);
+  Value pod = old_pod();
+  recorder::record_pod(cycle, "ml/p1", &pod, false, "");
+  recorder::record_resolution(cycle, "ml/p1",
+                              {"Pod/ml/p1", "ReplicaSet/ml/rs", "Deployment/ml/dep"},
+                              "Deployment", "ml", "dep", "Deployment:uid1", "");
+  recorder::record_stats(cycle, 1, 1, 0);
+  recorder::record_decision(cycle, expected_decision(cycle));
+  recorder::arm(cycle, 0);  // dry-run: seals immediately
+}
+
+}  // namespace
+
+TP_TEST(recorder_capsule_roundtrip_and_replay) {
+  recorder::reset_for_test();
+  std::string dir = make_tmpdir();
+  recorder::configure(dir, 8);
+  TP_CHECK(recorder::enabled());
+  recorder::set_run_context(run_config(), "idle_query_placeholder == 0");
+  seal_cycle(1);
+
+  Value index = recorder::index_json();
+  TP_CHECK_EQ(index.find("capsules")->as_array().size(), size_t{1});
+  std::string id = index.find("capsules")->as_array()[0].get_string("id");
+  TP_CHECK(!id.empty());
+
+  // serialize → file → deserialize: the capsule is self-contained
+  std::string body = recorder::capsule_body(id);
+  TP_CHECK(!body.empty());
+  Value capsule = Value::parse(body);
+  TP_CHECK_EQ(capsule.get_string("id"), id);
+  TP_CHECK_EQ(capsule.find("cycle")->as_int(), int64_t{1});
+  TP_CHECK_EQ(capsule.find("prom")->get_string("body"), std::string(kPromBody));
+  TP_CHECK(capsule.find("pods")->find("ml/p1") != nullptr);
+  TP_CHECK(capsule.find("resolutions")->find("ml/p1") != nullptr);
+  TP_CHECK_EQ(capsule.find("decisions")->as_array().size(), size_t{1});
+
+  // pure replay reproduces the recorded decision bit-for-bit
+  Value result = recorder::replay(capsule, Value::object());
+  TP_CHECK(result.find("match")->as_bool());
+  TP_CHECK_EQ(result.find("drift")->as_array().size(), size_t{0});
+  TP_CHECK_EQ(result.find("replayed")->as_array().size(), size_t{1});
+
+  // what-if run_mode flips the dry-run record to a predicted SCALED
+  Value what_if = Value::object();
+  what_if.set("run_mode", Value("scale-down"));
+  Value flipped = recorder::replay(capsule, what_if);
+  TP_CHECK(!flipped.find("match")->as_bool());
+  const Value& flips = *flipped.find("flips");
+  TP_CHECK_EQ(flips.as_array().size(), size_t{1});
+  TP_CHECK_EQ(flips.as_array()[0].find("to")->get_string("reason"), std::string("SCALED"));
+  TP_CHECK(flips.as_array()[0].find("predicted")->as_bool());
+
+  // what-if lookback pushes the pod below min age
+  Value tighter = Value::object();
+  tighter.set("lookback", Value("200000h"));
+  Value aged = recorder::replay(capsule, tighter);
+  TP_CHECK_EQ(aged.find("flips")->as_array()[0].find("to")->get_string("reason"),
+              std::string("BELOW_MIN_AGE"));
+
+  // unknown what-if keys throw (loud, not a silent no-op)
+  bool threw = false;
+  Value bogus = Value::object();
+  bogus.set("bogus", Value(1));
+  try {
+    recorder::replay(capsule, bogus);
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  TP_CHECK(threw);
+  recorder::reset_for_test();
+}
+
+TP_TEST(recorder_ring_bounds_and_reload) {
+  recorder::reset_for_test();
+  std::string dir = make_tmpdir();
+  recorder::configure(dir, 2);
+  recorder::set_run_context(run_config(), "q");
+  seal_cycle(1);
+  seal_cycle(2);
+  seal_cycle(3);
+
+  Value index = recorder::index_json();
+  const auto& capsules = index.find("capsules")->as_array();
+  TP_CHECK_EQ(capsules.size(), size_t{2});  // keep=2: oldest pruned
+  TP_CHECK_EQ(capsules[0].find("cycle")->as_int(), int64_t{2});
+  TP_CHECK_EQ(capsules[1].find("cycle")->as_int(), int64_t{3});
+  // the pruned capsule's file is gone, the survivors' files are readable
+  for (const Value& c : capsules) {
+    TP_CHECK(!recorder::capsule_body(c.get_string("id")).empty());
+  }
+
+  // restart: reconfigure over the same dir rebuilds the index from disk
+  recorder::reset_for_test();
+  recorder::configure(dir, 8);
+  Value reloaded = recorder::index_json();
+  TP_CHECK_EQ(reloaded.find("capsules")->as_array().size(), size_t{2});
+  TP_CHECK_EQ(reloaded.find("capsules")->as_array()[0].find("cycle")->as_int(), int64_t{2});
+  recorder::reset_for_test();
+}
+
+TP_TEST(recorder_capsule_body_rejects_unsafe_ids) {
+  recorder::reset_for_test();
+  std::string dir = make_tmpdir();
+  recorder::configure(dir, 2);
+  TP_CHECK_EQ(recorder::capsule_body("../../etc/passwd"), std::string(""));
+  TP_CHECK_EQ(recorder::capsule_body("a/b"), std::string(""));
+  TP_CHECK_EQ(recorder::capsule_body(""), std::string(""));
+  recorder::reset_for_test();
+}
+
+TP_TEST(recorder_disabled_hooks_are_noops) {
+  recorder::reset_for_test();
+  TP_CHECK(!recorder::enabled());
+  // none of these may crash or create state while disabled
+  recorder::begin_cycle(1, 1000);
+  recorder::record_prom_body(1, "x");
+  Value pod = old_pod();
+  recorder::record_pod(1, "ml/p1", &pod, false, "");
+  recorder::arm(1, 0);
+  recorder::seal_all();
+  TP_CHECK_EQ(recorder::index_json().find("capsules")->as_array().size(), size_t{0});
+  recorder::reset_for_test();
+}

@@ -60,6 +60,30 @@ def test_ledger_bench_summary_fields_documented():
             f"bench summary field {field} missing from docs/BENCH_FIELDS.md")
 
 
+def test_breaker_and_flight_surfaces_documented():
+    """The breaker metric family names and the flight-recorder surfaces
+    are forced into the runbook here: the serving test below only sees
+    families the daemon happened to emit during its run (a breaker that
+    never trips serves nothing), and the capsule endpoints/flags have no
+    metric family to piggyback on."""
+    doc = OPERATIONS.read_text()
+    missing = [needle for needle in (
+        "tpu_pruner_breaker_trips_total",
+        "tpu_pruner_breaker_last_trip_cycle",
+        "tpu_pruner_breaker_last_trip_deferred",
+        "/debug/cycles",
+        "`/debug`",
+        "--flight-dir",
+        "--flight-keep",
+        "--replay",
+        "--what-if",
+        "replay-smoke",
+    ) if needle not in doc]
+    assert not missing, (
+        f"flight-recorder/breaker surfaces missing from docs/OPERATIONS.md: "
+        f"{missing}")
+
+
 def test_every_served_metric_documented(built):
     """Scrape the real daemon after a full scale-down cycle and check every
     family name on /metrics (histograms included) against OPERATIONS.md."""

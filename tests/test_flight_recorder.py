@@ -1,0 +1,380 @@
+"""Cycle flight recorder + deterministic replay tests (the observability
+tentpole).
+
+Drives the REAL daemon against the hermetic fakes with --flight-dir on,
+then asserts the capsule contract end to end: a recorded cycle replayed
+via `analyze --replay` reproduces the original DecisionRecords
+bit-for-bit with ZERO network calls (the fakes are torn down before the
+replay), `--what-if` flips decisions when the idle predicate is loosened
+or tightened, the on-disk ring is bounded by --flight-keep and reloaded
+across restarts, the /debug/cycles endpoints serve the capsules, and the
+capsule's raw Prometheus body is byte-identical to what the fake served.
+"""
+
+import json
+import re
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def record_cycles(fake_prom, fake_k8s, flight_dir, *extra_args, cycles=2,
+                  run_mode="scale-down"):
+    """Run the daemon for N cycles with the flight recorder on, to exit."""
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", run_mode, "--daemon-mode", "--check-interval", "1",
+           "--max-cycles", str(cycles), "--flight-dir", str(flight_dir),
+           *extra_args]
+    proc = subprocess.run(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    return sorted(flight_dir.glob("cycle-*.json"))
+
+
+def analyze_replay(capsule, *what_if):
+    args = [sys.executable, "-m", "tpu_pruner.analyze", "--replay", str(capsule)]
+    if what_if:
+        args += ["--what-if", *what_if]
+    proc = subprocess.run(args, capture_output=True, text=True, timeout=120)
+    out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+    return proc.returncode, out, proc.stderr
+
+
+def idle_fleet(fake_prom, fake_k8s, young_sibling=False):
+    """Two old idle pods under one Deployment; optionally a young sibling
+    of the same ReplicaSet (recorded BELOW_MIN_AGE, the what-if lever)."""
+    dep, rs, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=2,
+                                                  tpu_chips=4)
+    for pod in pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml", chips=4)
+    if young_sibling:
+        fake_k8s.add_pod(
+            "ml", "trainer-abc123-9",
+            owners=[fake_k8s.owner("ReplicaSet", rs["metadata"]["name"],
+                                   rs["metadata"]["uid"])],
+            created_age=600)
+        fake_prom.add_idle_pod_series("trainer-abc123-9", "ml", chips=4)
+    return dep, rs, pods
+
+
+# ── acceptance: record → replay reproduces decisions bit-for-bit, with
+#    zero network calls during replay ───────────────────────────────────
+
+
+def test_scale_down_cycles_replay_bit_for_bit(built, tmp_path):
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    flight = tmp_path / "flight"
+    try:
+        idle_fleet(prom, k8s, young_sibling=True)
+        capsules = record_cycles(prom, k8s, flight, cycles=2)
+    finally:
+        # fakes DOWN before any replay: a replay that touched the network
+        # would fail below, proving the offline contract
+        prom.stop()
+        k8s.stop()
+    assert len(capsules) == 2
+
+    queries_before = len(prom.queries)
+    for capsule in capsules:
+        rc, out, err = analyze_replay(capsule)
+        assert rc == 0, err
+        assert out["match"] is True
+        assert out["drift"] == []
+        # scale-down landed on the two old pods; the young sibling is
+        # BELOW_MIN_AGE — deliberate non-actuation is replayed too
+        reasons = {d["pod"]: d["reason"] for d in out["replayed"]}
+        assert reasons["trainer-abc123-0"] == "SCALED"
+        assert reasons["trainer-abc123-1"] == "SCALED"
+        assert reasons["trainer-abc123-9"] == "BELOW_MIN_AGE"
+        assert out["actions"]["replayed_scale_downs"] == 2
+        # bit-for-bit: the normalized record dumps are identical
+        recorded = {d["pod"]: json.dumps(d, sort_keys=True)
+                    for d in out["recorded"]}
+        replayed = {d["pod"]: json.dumps(d, sort_keys=True)
+                    for d in out["replayed"]}
+        assert recorded == replayed
+    assert len(prom.queries) == queries_before  # zero network during replay
+
+
+def test_dry_run_cycle_replays_exactly(built, fake_prom, fake_k8s, tmp_path):
+    idle_fleet(fake_prom, fake_k8s)
+    capsules = record_cycles(fake_prom, fake_k8s, tmp_path / "flight",
+                             cycles=1, run_mode="dry-run")
+    (capsule,) = capsules
+    rc, out, err = analyze_replay(capsule)
+    assert rc == 0, err
+    assert out["match"] is True
+    assert {d["reason"] for d in out["replayed"]} == {"DRY_RUN"}
+    assert all(d["action"] == "none" for d in out["replayed"])
+
+
+# ── acceptance: what-if flips when the idle predicate is loosened (and
+#    the inverse when tightened) ───────────────────────────────────────
+
+
+def test_what_if_lookback_flips(built, tmp_path):
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    flight = tmp_path / "flight"
+    try:
+        idle_fleet(prom, k8s, young_sibling=True)
+        capsules = record_cycles(prom, k8s, flight, cycles=1)
+    finally:
+        prom.stop()
+        k8s.stop()
+    (capsule,) = capsules
+
+    # loosened: a 300s window makes the 600s-old sibling eligible — it
+    # flips to a (predicted) SCALED via the real owner walk over the
+    # capsule's recorded object snapshot
+    rc, out, _ = analyze_replay(capsule, "lookback=300s")
+    assert rc == 0
+    flips = {f["pod"]: f for f in out["flips"]}
+    assert flips, "loosened lookback produced an empty flip set"
+    flip = flips["ml/trainer-abc123-9"]
+    assert flip["from"]["reason"] == "BELOW_MIN_AGE"
+    assert flip["to"]["reason"] == "SCALED"
+    assert flip["to"]["action"] == "scale_down"
+    assert flip["predicted"] is True
+    assert out["actions"]["replayed_scale_downs"] == 3
+
+    # tightened: a 4h window puts the 2h-old pods below min age
+    rc, out, _ = analyze_replay(capsule, "lookback=4h")
+    assert rc == 0
+    flipped = {f["pod"]: f["to"]["reason"] for f in out["flips"]}
+    assert flipped == {"ml/trainer-abc123-0": "BELOW_MIN_AGE",
+                       "ml/trainer-abc123-1": "BELOW_MIN_AGE"}
+    assert out["actions"]["replayed_scale_downs"] == 0
+
+    # run-mode what-if: everything that scaled would have been DRY_RUN
+    rc, out, _ = analyze_replay(capsule, "run_mode=dry-run")
+    assert rc == 0
+    assert {f["to"]["reason"] for f in out["flips"]} == {"DRY_RUN"}
+
+    # query-shaping keys are honest about their limit: the query changes,
+    # decisions still evaluate the recorded response
+    rc, out, _ = analyze_replay(capsule, "hbm_threshold=0.5")
+    assert rc == 0
+    assert out["query_changed"] is True
+    assert "replay_query" in out
+
+    # unknown keys are a loud error, not a silent no-op
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_pruner.analyze", "--replay", str(capsule),
+         "--what-if", "bogus=1"], capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+
+
+# ── ring bounding + restart reload ─────────────────────────────────────
+
+
+def test_flight_keep_bounds_the_ring(built, fake_prom, fake_k8s, tmp_path):
+    idle_fleet(fake_prom, fake_k8s)
+    flight = tmp_path / "flight"
+    record_cycles(fake_prom, fake_k8s, flight, "--flight-keep", "3", cycles=5)
+    capsules = sorted(flight.glob("cycle-*.json"))
+    assert len(capsules) == 3
+    # the survivors are the NEWEST three (ids sort chronologically)
+    cycles = [json.loads(c.read_text())["cycle"] for c in capsules]
+    assert cycles == [3, 4, 5]
+
+
+def test_restart_reloads_ring_into_index(built, fake_prom, fake_k8s, tmp_path):
+    idle_fleet(fake_prom, fake_k8s)
+    flight = tmp_path / "flight"
+    old = record_cycles(fake_prom, fake_k8s, flight, cycles=2)
+    old_ids = [json.loads(c.read_text())["id"] for c in old]
+
+    d = FlightDaemon(fake_prom, fake_k8s, "--flight-dir", str(flight))
+    try:
+        index = wait_until(lambda: (lambda doc:
+            doc if len(doc["capsules"]) >= 3 else None)(
+                json.loads(d.get("/debug/cycles"))))
+        ids = [c["id"] for c in index["capsules"]]
+        # the previous run's capsules survive the restart, oldest first
+        assert ids[:2] == old_ids
+        # and are served in full
+        reloaded = json.loads(d.get(f"/debug/cycles/{old_ids[0]}"))
+        assert reloaded["id"] == old_ids[0]
+        assert reloaded["decisions"]
+    finally:
+        d.stop()
+
+
+# ── /debug endpoints contract + raw-body round-trip fidelity ───────────
+
+
+class FlightDaemon:
+    """Daemon-mode run with --metrics-port auto; port parsed from stderr."""
+
+    def __init__(self, fake_prom, fake_k8s, *extra_args):
+        cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+               "--run-mode", "scale-down", "--daemon-mode",
+               "--check-interval", "1", "--metrics-port", "auto", *extra_args]
+        self.proc = subprocess.Popen(
+            cmd, env={"KUBE_API_URL": fake_k8s.url},
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+        self.port = None
+        for line in self.proc.stderr:
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                self.port = int(m.group(1))
+                break
+        assert self.port, "daemon never reported its metrics port"
+
+    def get(self, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.port}{path}", timeout=5) as resp:
+            return resp.read().decode()
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+        self.proc.wait(timeout=10)
+
+
+def wait_until(predicate, timeout=30, interval=0.2):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            last = predicate()
+        except OSError:
+            last = None
+        if last:
+            return last
+        time.sleep(interval)
+    raise AssertionError(f"condition never held (last={last!r})")
+
+
+def test_debug_cycles_endpoints_and_raw_body(built, fake_prom, fake_k8s,
+                                             tmp_path):
+    idle_fleet(fake_prom, fake_k8s)
+    # scripted per-pod series (PR 3): the served body now differs per
+    # cycle, so the raw-body assertion below proves per-cycle fidelity,
+    # not just a static-body match
+    fake_prom.add_scripted_pod_series("flappy", "ml", [0.0, None, 0.0])
+    d = FlightDaemon(fake_prom, fake_k8s,
+                     "--flight-dir", str(tmp_path / "flight"))
+    try:
+        # /debug discovery index names every surface
+        routes = json.loads(d.get("/debug"))["routes"]
+        paths = {r["path"] for r in routes}
+        assert {"/metrics", "/healthz", "/readyz", "/debug/decisions",
+                "/debug/workloads", "/debug/cycles"} <= paths
+        assert all(r["description"] for r in routes)
+
+        index = wait_until(lambda: (lambda doc:
+            doc if doc["capsules"] else None)(
+                json.loads(d.get("/debug/cycles"))))
+        entry = index["capsules"][0]
+        assert entry["cycle"] >= 1
+        assert entry["decisions"] >= 2
+        assert entry["scale_downs"] >= 2
+
+        capsule = json.loads(d.get(f"/debug/cycles/{entry['id']}"))
+        # self-contained: query + config + verbatim body + evidence
+        assert capsule["query"].startswith("(")
+        assert capsule["config"]["run_mode"] == "scale-down"
+        assert capsule["config"]["lookback_s"] == 30 * 60 + 300
+        assert capsule["pods"]
+        assert capsule["decisions"]
+        # round-trip fidelity: the recorded body is byte-identical to a
+        # body the fake actually served — and each capsule carries ITS
+        # cycle's body (the scripted series makes bodies differ per cycle)
+        assert capsule["prom"]["body"] in fake_prom.response_bodies
+        second = wait_until(lambda: (lambda doc:
+            doc if len(doc["capsules"]) >= 2 else None)(
+                json.loads(d.get("/debug/cycles"))))
+        other = json.loads(d.get(f"/debug/cycles/{second['capsules'][1]['id']}"))
+        assert other["prom"]["body"] in fake_prom.response_bodies
+        assert other["prom"]["body"] != capsule["prom"]["body"]
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            d.get("/debug/cycles/nope")
+        assert exc.value.code == 404
+    finally:
+        d.stop()
+
+
+def test_debug_cycles_404_without_flight_dir(built, fake_prom, fake_k8s):
+    fake_k8s.add_deployment_chain("ml", "trainer")
+    d = FlightDaemon(fake_prom, fake_k8s)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            d.get("/debug/cycles")
+        assert exc.value.code == 404
+        assert "flight recorder not enabled" in exc.value.read().decode()
+    finally:
+        d.stop()
+
+
+# ── satellite: breaker trips are metrics + capsule facts, and the
+#    deferral replays ──────────────────────────────────────────────────
+
+
+def test_breaker_trip_metrics_and_capsule_stamp(built, fake_prom, fake_k8s,
+                                                tmp_path):
+    for i in range(2):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}",
+                                                   num_pods=1, tpu_chips=4)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    d = FlightDaemon(fake_prom, fake_k8s,
+                     "--flight-dir", str(tmp_path / "flight"),
+                     "--max-scale-per-cycle", "1")
+    try:
+        body = wait_until(lambda: (lambda b:
+            b if "tpu_pruner_breaker_trips_total" in b else None)(
+                d.get("/metrics")))
+        trips = int(re.search(r"tpu_pruner_breaker_trips_total (\d+)",
+                              body).group(1))
+        assert trips >= 1
+        assert int(re.search(r"tpu_pruner_breaker_last_trip_cycle (\d+)",
+                             body).group(1)) >= 1
+        assert int(re.search(r"tpu_pruner_breaker_last_trip_deferred (\d+)",
+                             body).group(1)) == 1
+
+        index = json.loads(d.get("/debug/cycles"))
+        tripped = [c for c in index["capsules"] if c["breaker_tripped"]]
+        assert tripped, "no capsule carries the breaker trip"
+        capsule = json.loads(d.get(f"/debug/cycles/{tripped[0]['id']}"))
+        assert capsule["breaker"]["tripped"] is True
+        assert capsule["breaker"]["limit"] == 1
+        assert capsule["breaker"]["deferred"] == 1
+        reasons = {d_["reason"] for d_ in capsule["decisions"]}
+        assert "DEFERRED" in reasons
+    finally:
+        d.stop()
+    # the deferral replays bit-for-bit from the sealed capsule
+    caps = sorted((tmp_path / "flight").glob("cycle-*.json"))
+    target = [c for c in caps
+              if json.loads(c.read_text()).get("breaker", {}).get("tripped")]
+    rc, out, err = analyze_replay(target[0])
+    assert rc == 0, err
+    assert out["match"] is True

@@ -40,6 +40,22 @@ def justfile_verify_command() -> str:
     return " ".join(body)
 
 
+def test_replay_smoke_recipe_present_and_wired():
+    """`just replay-smoke` must exist and invoke the real smoke module —
+    a recipe that silently vanishes (or points at a renamed module) would
+    leave the flight-recorder contract unguarded in CI."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^replay-smoke\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `replay-smoke:` recipe"
+    assert "tpu_pruner.testing.replay_smoke" in m.group(1), (
+        "replay-smoke no longer invokes tpu_pruner.testing.replay_smoke")
+    import importlib
+
+    module = importlib.import_module("tpu_pruner.testing.replay_smoke")
+    assert callable(module.main)
+
+
 def test_just_verify_matches_roadmap_tier1():
     roadmap = roadmap_tier1_command()
     justfile = justfile_verify_command()

@@ -41,6 +41,21 @@ table on stderr, one machine-readable JSON summary on stdout (bench.py
 folds its `reclaimed_chip_hours` / `tracked_workloads` fields into the
 benchmark summary).
 
+Replay mode (`--replay <capsule.json|url>`): deterministically re-run a
+cycle from a flight-recorder CycleCapsule (`--flight-dir` on the daemon;
+fetch one from `/debug/cycles/<id>` or read the file straight out of the
+ring). The native replay engine re-decides the cycle purely from capsule
+contents — the verbatim Prometheus body, the recorded pod/owner evidence,
+the config fingerprint — with ZERO network calls, and asserts the
+replayed DecisionRecords reproduce the recorded ones bit-for-bit (reason
+codes, roots, actions). Drift prints a per-pod diff and exits non-zero.
+`--what-if key=value ...` (e.g. `lookback=10m`, `run_mode=scale-down`,
+`max_scale_per_cycle=2`, `hbm_threshold=0.05`) re-decides under altered
+config and reports exactly which decisions flip; cluster-state facts the
+capsule can't re-derive offline (veto sets, group all-idle verdicts,
+actuation results) are held fixed, and flips that newly reach actuation
+are marked predicted.
+
 Incremental mode (`--stream STATE.npz`): successive invocations feed
 successive dumps (one per daemon cycle); the two-level sliding-window
 engine (engine.py streaming block) folds each dump's samples into a ring
@@ -279,6 +294,72 @@ def _run_explain(args) -> int:
     return 0
 
 
+def _run_replay(args) -> int:
+    """Deterministic capsule replay / what-if (the flight-recorder consumer).
+
+    Pure replay exits 0 only when the replayed decisions reproduce the
+    recorded ones bit-for-bit; drift prints a per-pod diff and exits 1.
+    With --what-if the flip report is the product and the exit is 0
+    (flips are the expected outcome, not drift)."""
+    source = args.replay
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            capsule = json.load(resp)
+    else:
+        with open(source) as f:
+            capsule = json.load(f)
+
+    what_if = {}
+    for pair in args.what_if or []:
+        if "=" not in pair:
+            print(f"--what-if expects key=value, got {pair!r}", file=sys.stderr)
+            return 2
+        key, value = pair.split("=", 1)
+        what_if[key] = value
+
+    from tpu_pruner import native
+
+    result = native.replay_cycle(capsule, what_if or None)
+
+    cycle = result.get("cycle")
+    actions = result.get("actions", {})
+    if what_if:
+        flips = result.get("flips", [])
+        print(f"cycle {cycle}: what-if {what_if} flips "
+              f"{len(flips)} decision(s) "
+              f"(scale_downs {actions.get('recorded_scale_downs')} -> "
+              f"{actions.get('replayed_scale_downs')})", file=sys.stderr)
+        for f in flips:
+            marker = " [predicted]" if f.get("predicted") else ""
+            print(f"  {f['pod']}: {f['from']['reason']}/{f['from']['action']}"
+                  f" -> {f['to']['reason']}/{f['to']['action']}{marker}",
+                  file=sys.stderr)
+        if result.get("query_changed"):
+            print("NOTE: this what-if changes the PromQL itself; decisions "
+                  "above are evaluated against the RECORDED response — "
+                  "re-run live to see the new query's candidate set:\n  "
+                  + result.get("replay_query", ""), file=sys.stderr)
+        print(json.dumps(result))
+        return 0
+
+    if result.get("match"):
+        print(f"cycle {cycle}: replay reproduced all "
+              f"{len(result.get('recorded', []))} recorded decision(s) "
+              "bit-for-bit", file=sys.stderr)
+        print(json.dumps(result))
+        return 0
+    print(f"cycle {cycle}: REPLAY DRIFT — {len(result.get('drift', []))} "
+          "decision(s) differ:", file=sys.stderr)
+    for d in result.get("drift", []):
+        print(f"  {d['pod']}:", file=sys.stderr)
+        print(f"    recorded: {json.dumps(d.get('recorded'))}", file=sys.stderr)
+        print(f"    replayed: {json.dumps(d.get('replayed'))}", file=sys.stderr)
+    print(json.dumps(result))
+    return 1
+
+
 def _load_workload_records(args) -> list[dict]:
     """Workload accounts from the ledger JSONL checkpoint or /debug/workloads."""
     if args.ledger_file:
@@ -411,6 +492,19 @@ def main(argv=None) -> int:
                         help="with --fleet-report: query /debug/workloads on "
                              "the daemon's metrics port (e.g. "
                              "http://host:8080)")
+    parser.add_argument("--replay", metavar="CAPSULE",
+                        help="replay mode: deterministically re-run a "
+                             "flight-recorder cycle capsule (a --flight-dir "
+                             "file or a /debug/cycles/<id> URL) with zero "
+                             "network calls; exits non-zero when the "
+                             "replayed decisions drift from the recorded "
+                             "ones")
+    parser.add_argument("--what-if", nargs="+", metavar="KEY=VALUE",
+                        help="with --replay: re-decide under altered config "
+                             "(lookback=10m, duration=45, grace=600, "
+                             "run_mode=scale-down, enabled_resources=dr, "
+                             "max_scale_per_cycle=2, hbm_threshold=0.05) "
+                             "and report which decisions flip")
     parser.add_argument("--lookback-s", type=float, default=None,
                         help="override lookback seconds (default: dump value or 2100)")
     parser.add_argument("--hbm-threshold", type=float, default=None,
@@ -436,6 +530,13 @@ def main(argv=None) -> int:
                         help="with --stream: discard STATE and start a fresh "
                              "window from this dump")
     args = parser.parse_args(argv)
+    if args.replay:
+        if args.explain or args.fleet_report:
+            parser.error("--replay is mutually exclusive with --explain and "
+                         "--fleet-report")
+        return _run_replay(args)
+    if args.what_if:
+        parser.error("--what-if only applies with --replay")
     if args.fleet_report:
         if args.explain:
             parser.error("--fleet-report and --explain are mutually exclusive")

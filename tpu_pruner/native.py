@@ -154,6 +154,7 @@ def load() -> ctypes.CDLL:
         "tp_target_meta",
         "tp_otlp_grpc_call",
         "tp_audit_reason_codes",
+        "tp_replay_cycle",
         "tp_ledger_sim",
         "tp_ledger_metric_families",
         "tp_informer_start",
@@ -237,6 +238,23 @@ def audit_reason_codes() -> list[str]:
     every code the daemon can emit, in enum order. The docs drift-guard
     test joins this list against docs/OPERATIONS.md."""
     return _call("tp_audit_reason_codes", {})["codes"]
+
+
+def replay_cycle(capsule: dict, what_if: dict | None = None) -> dict:
+    """Deterministically replay a flight-recorder CycleCapsule through the
+    REAL decision pipeline (recorder.cpp): decode the recorded Prometheus
+    body, re-run eligibility and the owner walk over the capsule's object
+    snapshot, re-apply the target gates — zero network. Returns {match,
+    replayed, recorded, drift, flips?, query_changed, actions}.
+
+    ``what_if`` re-decides under altered config (keys: lookback, duration,
+    grace, run_mode, enabled_resources, max_scale_per_cycle,
+    hbm_threshold) and adds the ``flips`` list — exactly which decisions
+    change. This is `analyze --replay` / `--what-if`'s backend."""
+    payload: dict = {"capsule": capsule}
+    if what_if:
+        payload["what_if"] = what_if
+    return _call("tp_replay_cycle", payload)
 
 
 def ledger_sim(top_k: int, cycles: list[dict], query: str = "") -> dict:

@@ -57,6 +57,11 @@ class FakePrometheus:
         self.scripted_series: list[dict] = []
         self.instant_queries_served = 0  # advances the scripts, one per query
         self.queries: list[str] = []
+        # VERBATIM response body per successfully served instant query —
+        # flight-recorder tests assert a capsule's recorded raw body is
+        # byte-identical to what this fake actually sent (round-trip
+        # fidelity, scripted per-pod series included)
+        self.response_bodies: list[str] = []
         self.query_paths: list[str] = []  # full request paths (Cloud Monitoring prefix checks)
         self.query_times: list[float] = []  # time.monotonic() per query (cycle windowing)
         self.auth_headers: list[str | None] = []
@@ -280,6 +285,7 @@ class FakePrometheus:
                             "data": {"resultType": "vector", "result": result},
                         }).encode()
                     fake.instant_queries_served += 1
+                    fake.response_bodies.append(body.decode())
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
