@@ -433,6 +433,7 @@ def run_watch_cache_steady_state():
                "--max-cycles", "2", "--watch-cache", "on",
                "--metrics-port", "auto",
                "--ledger-file", ledger_path,
+               "--signal-guard", "on",
                "--resolve-concurrency", "64", "--scale-concurrency", "32"]
         env = {"KUBE_API_URL": k8s.url, "KUBE_TOKEN": "bench",
                "PROMETHEUS_TOKEN": "bench", "PATH": "/usr/bin:/bin"}
@@ -542,6 +543,18 @@ def run_watch_cache_steady_state():
         phases = _phase_percentiles(metrics_last[0]) if metrics_last else {
             "cycle_phase_p50_ms": {}, "cycle_phase_p95_ms": {}}
 
+        # Signal-guard overhead + health: the section runs with
+        # --signal-guard on (every registered pod's evidence is healthy by
+        # default, so decisions are unchanged); the extra evidence query's
+        # latency is the daemon's own phase="signal" histogram, and the
+        # coverage gauge proves the watchdog judged the full fleet.
+        signal_coverage = None
+        if metrics_last:
+            m = _re.search(r"tpu_pruner_signal_coverage_ratio ([0-9.eE+-]+)",
+                           metrics_last[0])
+            if m:
+                signal_coverage = float(m.group(1))
+
         # Workload-ledger savings: the daemon checkpointed its utilization
         # ledger; `analyze --fleet-report` renders the machine-readable
         # summary whose headline fields the bench summary carries.
@@ -562,6 +575,8 @@ def run_watch_cache_steady_state():
             log(f"fleet-report failed: {e}")
         return {
             **phases,
+            "signal_query_p50_ms": phases["cycle_phase_p50_ms"].get("signal"),
+            "signal_coverage_ratio": signal_coverage,
             "reclaimed_chip_hours": fleet_report.get("reclaimed_chip_hours"),
             "tracked_workloads": fleet_report.get("tracked_workloads"),
             "fleet_report": fleet_report or None,
@@ -1400,6 +1415,10 @@ def main():
         log(f"workload ledger: {watch_cache['tracked_workloads']} workloads tracked, "
             f"{watch_cache['reclaimed_chip_hours']:.3f} chip-hours reclaimed "
             "across the two-cycle section")
+    if watch_cache.get("signal_query_p50_ms") is not None:
+        log(f"signal guard: evidence query p50 "
+            f"{watch_cache['signal_query_p50_ms']:.1f}ms per cycle, coverage "
+            f"{watch_cache.get('signal_coverage_ratio')}")
 
     # TPU fleet eval with spaced retries: now, +60s, +120s (only on failure).
     tpu = tpu_section([None] if SMOKE else [
@@ -1507,6 +1526,11 @@ def main():
         # cycles, via `analyze --fleet-report` on the daemon's checkpoint
         "reclaimed_chip_hours": watch_cache.get("reclaimed_chip_hours"),
         "tracked_workloads": watch_cache.get("tracked_workloads"),
+        # signal-guard overhead + health: the section runs --signal-guard
+        # on, so the evidence query's own phase latency and the fleet
+        # coverage it judged ride the summary
+        "signal_query_p50_ms": watch_cache.get("signal_query_p50_ms"),
+        "signal_coverage_ratio": watch_cache.get("signal_coverage_ratio"),
         "spread_max": (round(max(RUN_SPREADS.values()), 3)
                        if RUN_SPREADS else None),
         "detail_file": detail_path.name,

@@ -48,6 +48,13 @@ enum class Reason : uint8_t {
   GroupNotIdle,         // GROUP_NOT_IDLE: JobSet/LWS gate found active hosts
   Deferred,             // DEFERRED: over --max-scale-per-cycle this cycle
   ShutdownAborted,      // SHUTDOWN_ABORTED: enqueued but daemon shut down
+  // Signal-quality watchdog vetoes (signal.hpp, --signal-guard on): the
+  // EVIDENCE was untrustworthy, not the workload busy.
+  SignalStale,          // SIGNAL_STALE: newest sample older than --signal-max-age
+  SignalGappy,          // SIGNAL_GAPPY: sample coverage below the scrape-interval floor
+  SignalAbsent,         // SIGNAL_ABSENT: no evidence series for the candidate at all
+  SignalBrownout,       // SIGNAL_BROWNOUT: fleet coverage below --signal-min-coverage;
+                        // every scale-down of the cycle deferred
 };
 
 const char* reason_name(Reason r);

@@ -78,6 +78,15 @@ struct Cli {
   int64_t ledger_top_k = 10;              // --ledger-top-k: /metrics workload label cardinality bound
   std::string flight_dir;                 // --flight-dir: cycle flight-recorder capsule ring ("" = off)
   int64_t flight_keep = 64;               // --flight-keep: capsules retained in the on-disk ring
+  // --signal-guard {on, off}: signal-quality watchdog (signal.hpp). "on"
+  // runs a second per-cycle evidence query (per-pod sample coverage +
+  // last-sample age), vetoes unhealthy-signal pods with SIGNAL_* reason
+  // codes, and defers every scale-down under a fleet brownout. "off"
+  // (default) keeps exact decision parity with the pre-watchdog daemon.
+  std::string signal_guard = "off";
+  int64_t signal_scrape_interval = 30;    // --signal-scrape-interval: expected scrape cadence, s
+  int64_t signal_max_age = 300;           // --signal-max-age: STALE threshold, s
+  double signal_min_coverage = 0.9;       // --signal-min-coverage: brownout floor, 0-1
   std::string otlp_endpoint;              // --otlp-endpoint (default: $OTEL_EXPORTER_OTLP_ENDPOINT)
   std::string gcp_project;                // --gcp-project (Cloud Monitoring PromQL API)
   std::string monitoring_endpoint = "https://monitoring.googleapis.com";  // --monitoring-endpoint

@@ -41,10 +41,15 @@ struct CycleStats {
 // deliberately KEEPS its fresh LIST either way: it is the last check
 // before suspending every host of a slice, and a store lookup would
 // re-widen the new-pod race the fresh LIST exists to close.
+// `evidence_query` ("" with --signal-guard off) is the signal-quality
+// watchdog's second per-cycle query (query::build_evidence_query): its
+// assessment vetoes unhealthy-signal candidates and can brown out the
+// whole cycle's scale-downs (signal.hpp).
 CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
                      core::ResourceSet enabled,
                      const std::function<void(core::ScaleTarget)>& enqueue,
-                     const informer::ClusterCache* watch_cache = nullptr);
+                     const informer::ClusterCache* watch_cache = nullptr,
+                     const std::string& evidence_query = "");
 
 // Full daemon: spawns the two threads, joins them, returns the process
 // exit code (0 normal, 1 after failure-budget exhaustion).

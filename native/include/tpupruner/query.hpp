@@ -102,6 +102,20 @@ struct QueryArgs {
 // Build the instant-query PromQL for the configured source.
 std::string build_idle_query(const QueryArgs& args);
 
+// Build the companion *evidence* query (the signal-quality watchdog's
+// second per-cycle query, signal.hpp): instead of asking "which pods are
+// idle?" it asks "how trustworthy is the utilization signal itself?" —
+// per pod, the sample coverage over the lookback window
+// (count_over_time) and the age of the newest sample (time() −
+// timestamp()). The two statistics ride ONE instant query, distinguished
+// by a synthetic `signal_stat` label ("samples" | "age") stamped with
+// label_replace, so a cycle costs exactly one extra round-trip. Shares
+// the idle query's selectors, schema switch (gmp pod-labeled series vs
+// gke-system node-scoped series joined onto pods) and honor_labels
+// handling, so the evidence always covers exactly the series the idle
+// verdict was computed from.
+std::string build_evidence_query(const QueryArgs& args);
+
 // JSON round-trip for QueryArgs. One shape shared by three consumers: the
 // capi payload (tp_build_query), the flight-recorder capsule's config
 // fingerprint, and the replay engine's what-if re-render — so a capsule's

@@ -51,15 +51,22 @@ void configure(const std::string& dir, int keep);
 bool enabled();
 
 // Static per-run context: the config fingerprint (see capsule schema in
-// recorder.cpp) and the rendered idle query, identical for every cycle of
-// the process.
-void set_run_context(json::Value config, std::string query);
+// recorder.cpp), the rendered idle query, and (with --signal-guard on)
+// the rendered evidence query — identical for every cycle of the process.
+void set_run_context(json::Value config, std::string query, std::string evidence_query = "");
 
 // ── per-cycle capture hooks (all no-ops while disabled) ──
 // Opens the cycle's capsule; also drops any stale capsule of an earlier
 // cycle that never reached arm() (a failed query leaves one behind).
 void begin_cycle(uint64_t cycle, int64_t ts_unix);
 void record_prom_body(uint64_t cycle, const std::string& body);
+// The signal watchdog's VERBATIM evidence-query response body — replay
+// re-derives every per-pod verdict from these bytes, bit-for-bit.
+void record_evidence_body(uint64_t cycle, const std::string& body);
+// The derived assessment (signal::assessment_to_json) — stamped for
+// forensics (`analyze --signal-report <capsule>`); replay recomputes it
+// from the evidence body rather than trusting the stamp.
+void record_signal(uint64_t cycle, json::Value assessment);
 // The eligibility clock resolve_pods used (util::now_unix at resolve
 // start) — replay feeds it back into core::check_eligibility.
 void record_resolve_now(uint64_t cycle, int64_t now_unix);
@@ -79,7 +86,8 @@ void record_object(uint64_t cycle, const std::string& path, const json::Value* o
 // Cycle facts: fail-closed veto sets, per-root gate flags, breaker stamp.
 void record_vetoes(uint64_t cycle, const std::vector<std::string>& vetoed_roots,
                    const std::vector<std::pair<std::string, std::string>>& vetoed_namespaces);
-// `flag` ∈ {"root_opted_out", "group_not_idle", "deferred"}.
+// `flag` ∈ {"root_opted_out", "group_not_idle", "deferred",
+// "signal_brownout"}.
 void flag_root(uint64_t cycle, const std::string& identity, const char* flag);
 void record_breaker(uint64_t cycle, int64_t limit, size_t actionable, size_t deferred);
 void record_stats(uint64_t cycle, size_t num_series, size_t num_pods,
@@ -109,9 +117,12 @@ std::string capsule_body(const std::string& id);
 // (values as strings or numbers): lookback (duration, e.g. "30m"/"600s"/
 // seconds), duration (minutes), grace (seconds), run_mode, enabled_resources,
 // max_scale_per_cycle, hbm_threshold (re-renders the query only — the
-// recorded response can't be re-queried offline). Empty object = pure
-// replay. Returns {match, replayed, recorded, drift, flips, query_changed,
-// replay_query, actions}; throws on a malformed capsule or unknown key.
+// recorded response can't be re-queried offline), signal_min_coverage
+// (re-judges the fleet brownout from the recorded evidence), signal_guard
+// ("off" replays a guarded capsule without the watchdog; "on" requires a
+// recorded evidence body). Empty object = pure replay. Returns {match,
+// replayed, recorded, drift, flips, query_changed, replay_query, actions};
+// throws on a malformed capsule or unknown key.
 json::Value replay(const json::Value& capsule, const json::Value& what_if);
 
 void reset_for_test();

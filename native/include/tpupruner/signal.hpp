@@ -1,0 +1,140 @@
+// Signal-quality watchdog: per-pod evidence provenance + fleet brownout
+// guard.
+//
+// The whole pruner rests on one inference — "zero peak duty cycle over
+// the lookback ⇒ idle" — but a broken scrape, metric-plane ingestion
+// lag, or an absent metric family produces EXACTLY the same query result
+// as a truly idle fleet. The watchdog makes the daemon observe the
+// health of its own evidence, not just the evidence: each cycle a second
+// *evidence query* (query::build_evidence_query) asks the metric plane
+// for per-pod sample coverage (count_over_time over the lookback) and
+// last-sample age (time() − timestamp()), and assess() folds both
+// against the cycle's candidate set into one per-pod verdict:
+//
+//   HEALTHY  fresh samples, adequate coverage — evidence trustworthy
+//   STALE    newest sample older than --signal-max-age (ingestion lag /
+//            dead scrape; the "idle" reading is a memory, not a fact)
+//   GAPPY    fewer than half the samples the scrape interval implies
+//            over the window (flapping scrape; peaks can hide in gaps)
+//   ABSENT   the candidate appears in the idle result but the evidence
+//            query has no coverage or freshness row for it at all
+//            (metric family missing / relabeling dropped the series)
+//
+// Behind --signal-guard on (off = exact decision parity with the
+// pre-watchdog daemon), unhealthy-signal pods are vetoed with dedicated
+// audit reason codes (SIGNAL_STALE / SIGNAL_GAPPY / SIGNAL_ABSENT), the
+// ledger consequently never integrates idle-seconds from untrustworthy
+// evidence (vetoed pods never reach resolution), and a fleet-wide
+// *brownout* — healthy coverage below --signal-min-coverage — defers
+// EVERY scale-down of the cycle (reason SIGNAL_BROWNOUT), the way the
+// blast-radius breaker defers its overflow. The assessment is exported
+// three ways: /metrics families (signal_coverage_ratio, signal_pods by
+// verdict, signal_brownouts_total, pod_signal_age_seconds histogram),
+// the /debug/signals JSON endpoint, and a stamp in the flight-recorder
+// capsule so replay reproduces every verdict bit-for-bit offline.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tpupruner/audit.hpp"
+#include "tpupruner/core.hpp"
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::signal {
+
+enum class Verdict : uint8_t { Healthy, Stale, Gappy, Absent };
+
+const char* verdict_name(Verdict v);  // "healthy" | "stale" | "gappy" | "absent"
+
+// Assessment thresholds (CLI: --signal-scrape-interval, --signal-max-age,
+// --signal-min-coverage; window_s is the evidence query's lookback —
+// duration minutes, the count_over_time range).
+struct Config {
+  int64_t scrape_interval_s = 30;
+  int64_t max_age_s = 300;
+  double min_coverage = 0.9;
+  int64_t window_s = 1800;
+
+  // GAPPY floor: below half the samples a healthy scrape would land in
+  // the window, coverage is too thin to trust a zero-peak reading.
+  double min_samples() const {
+    double expected = scrape_interval_s > 0
+                          ? static_cast<double>(window_s) / static_cast<double>(scrape_interval_s)
+                          : 1.0;
+    return expected * 0.5 < 1.0 ? 1.0 : expected * 0.5;
+  }
+};
+
+// One candidate pod's evidence health.
+struct PodSignal {
+  std::string ns, pod;
+  double sample_count = 0.0;  // count_over_time over the window
+  double last_age_s = 0.0;    // seconds since the newest sample
+  bool has_samples = false;   // a "samples" evidence row existed
+  bool has_age = false;       // an "age" evidence row existed
+  Verdict verdict = Verdict::Absent;
+};
+
+// The cycle's whole evidence-health picture.
+struct Assessment {
+  uint64_t cycle = 0;
+  double coverage_ratio = 1.0;  // healthy candidates / all candidates (1.0 when none)
+  bool brownout = false;        // coverage below Config::min_coverage
+  double min_coverage = 0.9;    // threshold the brownout was judged against
+  std::vector<PodSignal> pods;  // one entry per candidate, candidate order
+
+  size_t count(Verdict v) const;
+};
+
+// Decode one evidence-query response (instant vector with the synthetic
+// signal_stat label) against the cycle's candidate set and derive the
+// per-pod verdicts + fleet coverage. Throws on a non-success response
+// (an unanswerable evidence query feeds the failure budget like the idle
+// query — no evidence is itself a signal-quality fact the guard must not
+// paper over).
+Assessment assess(const json::Value& evidence_response,
+                  const std::vector<core::PodMetricSample>& candidates, const Config& cfg,
+                  uint64_t cycle);
+
+// The audit reason code a verdict vetoes with (Healthy has none — do not
+// call it for healthy pods).
+audit::Reason veto_reason(Verdict v);
+
+// Deterministic detail strings, shared verbatim by the daemon and the
+// flight-recorder replay so replayed DecisionRecords match bit-for-bit.
+std::string veto_detail(const PodSignal& p, const Config& cfg);
+std::string brownout_detail(const Assessment& a, const Config& cfg);
+
+// JSON round-trip: the flight-recorder capsule stamp and the
+// /debug/signals payload share this shape.
+json::Value assessment_to_json(const Assessment& a);
+Assessment assessment_from_json(const json::Value& v);
+
+// ── process-wide export registry ──
+// publish() installs the latest assessment (and folds it into the
+// cumulative brownout counter + age histogram); the /metrics extra
+// provider and /debug/signals read it back.
+void publish(const Assessment& a, const Config& cfg);
+
+// /debug/signals body: {"enabled", "cycle", "coverage_ratio", "brownout",
+// "brownouts_total", "thresholds", "pods" (verdict counts), "details"}.
+// {"enabled": false} before the first publish (guard off).
+json::Value signals_json();
+
+// Prometheus exposition for the signal families ("" before the first
+// publish, so --signal-guard off serves no signal series — the absent-
+// not-zero convention the informer families follow):
+//   tpu_pruner_signal_coverage_ratio                  gauge
+//   tpu_pruner_signal_pods{verdict=...}               gauge
+//   tpu_pruner_signal_brownouts_total                 counter
+//   tpu_pruner_pod_signal_age_seconds                 histogram
+std::string render_metrics(bool openmetrics);
+
+// The family names served above (docs drift guard, via capi).
+std::vector<std::string> metric_families();
+
+void reset_for_test();
+
+}  // namespace tpupruner::signal

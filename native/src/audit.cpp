@@ -113,12 +113,16 @@ const char* reason_name(Reason r) {
     case Reason::GroupNotIdle: return "GROUP_NOT_IDLE";
     case Reason::Deferred: return "DEFERRED";
     case Reason::ShutdownAborted: return "SHUTDOWN_ABORTED";
+    case Reason::SignalStale: return "SIGNAL_STALE";
+    case Reason::SignalGappy: return "SIGNAL_GAPPY";
+    case Reason::SignalAbsent: return "SIGNAL_ABSENT";
+    case Reason::SignalBrownout: return "SIGNAL_BROWNOUT";
   }
   return "?";
 }
 
 std::optional<Reason> reason_from_name(std::string_view name) {
-  for (int i = 0; i <= static_cast<int>(Reason::ShutdownAborted); ++i) {
+  for (int i = 0; i <= static_cast<int>(Reason::SignalBrownout); ++i) {
     Reason r = static_cast<Reason>(i);
     if (name == reason_name(r)) return r;
   }
@@ -127,7 +131,7 @@ std::optional<Reason> reason_from_name(std::string_view name) {
 
 std::vector<std::string> all_reason_codes() {
   std::vector<std::string> out;
-  for (int i = 0; i <= static_cast<int>(Reason::ShutdownAborted); ++i) {
+  for (int i = 0; i <= static_cast<int>(Reason::SignalBrownout); ++i) {
     out.push_back(reason_name(static_cast<Reason>(i)));
   }
   return out;

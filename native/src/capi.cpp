@@ -22,6 +22,7 @@
 #include "tpupruner/ledger.hpp"
 #include "tpupruner/metrics.hpp"
 #include "tpupruner/query.hpp"
+#include "tpupruner/signal.hpp"
 
 using tpupruner::json::Value;
 namespace core = tpupruner::core;
@@ -408,6 +409,71 @@ char* tp_ledger_metric_families(const char*) {
   return guarded([&] {
     Value families = Value::array();
     for (const std::string& f : tpupruner::ledger::metric_families()) {
+      families.push_back(Value(f));
+    }
+    Value out = Value::object();
+    out.set("families", std::move(families));
+    return ok(out);
+  });
+}
+
+char* tp_build_evidence_query(const char* args_json) {
+  // The signal watchdog's companion evidence query (per-pod sample
+  // coverage + last-sample age) for the same CLI-style args
+  // tp_build_query takes — the pytest tier lints it like the idle query.
+  return guarded([&] {
+    Value args = Value::parse(args_json);
+    Value out = Value::object();
+    out.set("query", Value(tpupruner::query::build_evidence_query(
+                         tpupruner::query::args_from_json(args))));
+    return ok(out);
+  });
+}
+
+char* tp_signal_assess(const char* payload_json) {
+  // Deterministic harness for the signal watchdog's assessment math
+  // (signal.cpp): drive the REAL verdict/coverage code with a synthetic
+  // evidence response and candidate set. Payload:
+  //   {"response": {<instant vector with signal_stat labels>},
+  //    "candidates": [{"namespace","pod"}...],
+  //    "config": {"scrape_interval_s"?, "max_age_s"?, "min_coverage"?,
+  //               "window_s"?}}
+  // Returns the assessment JSON (signal::assessment_to_json shape).
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    const Value* response = p.find("response");
+    if (!response) throw std::runtime_error("missing response");
+    std::vector<tpupruner::core::PodMetricSample> candidates;
+    if (const Value* c = p.find("candidates"); c && c->is_array()) {
+      for (const Value& v : c->as_array()) {
+        tpupruner::core::PodMetricSample s;
+        s.ns = v.get_string("namespace");
+        s.name = v.get_string("pod");
+        candidates.push_back(std::move(s));
+      }
+    }
+    tpupruner::signal::Config cfg;
+    if (const Value* c = p.find("config"); c && c->is_object()) {
+      auto num = [&](const char* key, auto dflt) {
+        const Value* x = c->find(key);
+        return x && x->is_number() ? static_cast<decltype(dflt)>(x->as_double()) : dflt;
+      };
+      cfg.scrape_interval_s = num("scrape_interval_s", cfg.scrape_interval_s);
+      cfg.max_age_s = num("max_age_s", cfg.max_age_s);
+      cfg.min_coverage = num("min_coverage", cfg.min_coverage);
+      cfg.window_s = num("window_s", cfg.window_s);
+    }
+    return ok(tpupruner::signal::assessment_to_json(
+        tpupruner::signal::assess(*response, candidates, cfg, /*cycle=*/1)));
+  });
+}
+
+char* tp_signal_metric_families(const char*) {
+  // The canonical signal-watchdog metric family names — the docs-drift
+  // test joins this against docs/OPERATIONS.md, like the ledger families.
+  return guarded([&] {
+    Value families = Value::array();
+    for (const std::string& f : tpupruner::signal::metric_families()) {
       families.push_back(Value(f));
     }
     Value out = Value::object();

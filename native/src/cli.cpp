@@ -152,6 +152,29 @@ TPU FLAGS:
                                 tpu_pruner.analyze --replay` / `--what-if`
       --flight-keep <N>         capsules retained in the --flight-dir ring
                                 (oldest pruned first) [default: 64]
+      --signal-guard <M>        on | off [default: off] — signal-quality
+                                watchdog: each cycle a second *evidence
+                                query* asks the metric plane for per-pod
+                                sample coverage and last-sample age; pods
+                                whose evidence is stale/gappy/absent are
+                                vetoed (SIGNAL_* reason codes) instead of
+                                trusted as idle, and a fleet brownout
+                                (healthy coverage below
+                                --signal-min-coverage) defers every
+                                scale-down of the cycle. "off" keeps
+                                exact decision parity. Assessment served
+                                at /debug/signals + signal_* /metrics
+                                families
+      --signal-scrape-interval <SEC>
+                                expected scrape cadence; fewer than half
+                                the implied samples over the lookback
+                                window reads GAPPY [default: 30]
+      --signal-max-age <SEC>    newest sample older than this reads STALE
+                                [default: 300]
+      --signal-min-coverage <F> healthy-evidence coverage (0-1) below
+                                which the cycle browns out — all
+                                scale-downs deferred, like the circuit
+                                breaker [default: 0.9]
       --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
                                 [default: $OTEL_EXPORTER_OTLP_ENDPOINT]
       --gcp-project <ID>        query the Cloud Monitoring PromQL API for this
@@ -283,6 +306,28 @@ Cli parse(int argc, char** argv) {
        [&](const std::string& v) {
          cli.flight_keep = parse_int("--flight-keep", v);
          if (cli.flight_keep < 1) throw CliError("--flight-keep must be >= 1");
+       }},
+      {"--signal-guard",
+       [&](const std::string& v) {
+         check_choice("--signal-guard", v, {"on", "off"});
+         cli.signal_guard = v;
+       }},
+      {"--signal-scrape-interval",
+       [&](const std::string& v) {
+         cli.signal_scrape_interval = parse_int("--signal-scrape-interval", v);
+         if (cli.signal_scrape_interval < 1)
+           throw CliError("--signal-scrape-interval must be >= 1 second");
+       }},
+      {"--signal-max-age",
+       [&](const std::string& v) {
+         cli.signal_max_age = parse_int("--signal-max-age", v);
+         if (cli.signal_max_age < 1) throw CliError("--signal-max-age must be >= 1 second");
+       }},
+      {"--signal-min-coverage",
+       [&](const std::string& v) {
+         cli.signal_min_coverage = parse_double("--signal-min-coverage", v);
+         if (cli.signal_min_coverage < 0.0 || cli.signal_min_coverage > 1.0)
+           throw CliError("--signal-min-coverage must be between 0 and 1");
        }},
       {"--otlp-endpoint", [&](const std::string& v) { cli.otlp_endpoint = v; }},
       {"--gcp-project", [&](const std::string& v) { cli.gcp_project = v; }},

@@ -25,6 +25,7 @@
 #include "tpupruner/metrics.hpp"
 #include "tpupruner/prom.hpp"
 #include "tpupruner/recorder.hpp"
+#include "tpupruner/signal.hpp"
 #include "tpupruner/util.hpp"
 #include "tpupruner/walker.hpp"
 
@@ -98,6 +99,18 @@ prom::Client build_prom_client(const cli::Cli& args) {
   http::TlsMode tls =
       args.prometheus_tls_mode == "skip" ? http::TlsMode::Skip : http::TlsMode::Verify;
   return prom::Client(cli::prometheus_base(args), token, tls, args.prometheus_tls_cert);
+}
+
+// Signal-quality watchdog thresholds from the CLI surface. The window is
+// the evidence query's count_over_time range — the idle query's duration
+// window, without grace (grace pads the AGE gate, not the metric range).
+signal::Config signal_config(const cli::Cli& args) {
+  signal::Config cfg;
+  cfg.scrape_interval_s = args.signal_scrape_interval;
+  cfg.max_age_s = args.signal_max_age;
+  cfg.min_coverage = args.signal_min_coverage;
+  cfg.window_s = args.duration * 60;
+  return cfg;
 }
 
 struct ResolveOutcome {
@@ -469,7 +482,8 @@ static auto with_span(otlp::Span& span, Fn&& fn) -> decltype(fn()) {
 CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
                      core::ResourceSet enabled,
                      const std::function<void(ScaleTarget)>& enqueue,
-                     const informer::ClusterCache* watch_cache) {
+                     const informer::ClusterCache* watch_cache,
+                     const std::string& evidence_query) {
   // Audit cycle id first (stamps every log line of the cycle), then the
   // cycle span (reference #[tracing::instrument] on run_query_and_scale,
   // main.rs:390); children below mirror the instrumented callees.
@@ -511,6 +525,74 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   log::info("daemon", "Query returned " + std::to_string(decoded.num_series) + " series across " +
             std::to_string(decoded.samples.size()) + " unique pods");
   observe_phase("decode", phase_start);
+
+  // Signal-quality watchdog: assess the health of the evidence ITSELF
+  // before trusting a single zero-peak reading. One extra instant query
+  // per cycle (the evidence query), decoded against the candidate set
+  // into per-pod verdicts + a fleet coverage ratio. The phase is observed
+  // every cycle — ~0s with the guard off — so every phase histogram's
+  // _count keeps advancing in lockstep.
+  phase_start = std::chrono::steady_clock::now();
+  signal::Assessment assessment;
+  const bool signal_on = args.signal_guard == "on" && !evidence_query.empty();
+  if (signal_on) {
+    const signal::Config scfg = signal_config(args);
+    std::string evidence_raw;
+    json::Value evidence_response = [&] {
+      otlp::Span span("prometheus.evidence_query", &cycle.context());
+      return with_span(span, [&] {
+        return prom_client.instant_query(evidence_query,
+                                         recorder::enabled() ? &evidence_raw : nullptr);
+      });
+    }();
+    recorder::record_evidence_body(cycle_id, evidence_raw);
+    assessment = signal::assess(evidence_response, decoded.samples, scfg, cycle_id);
+    signal::publish(assessment, scfg);
+    recorder::record_signal(cycle_id, signal::assessment_to_json(assessment));
+    log::info("daemon", "Signal assessment: " +
+              std::to_string(assessment.count(signal::Verdict::Healthy)) + " healthy / " +
+              std::to_string(assessment.pods.size()) + " candidates (coverage " +
+              std::to_string(assessment.coverage_ratio).substr(0, 5) +
+              (assessment.brownout ? ", BROWNOUT)" : ")"));
+
+    // Per-pod vetoes: a candidate whose evidence is stale/gappy/absent is
+    // removed from the pipeline HERE — before resolution — so it never
+    // produces a scale target and the ledger never integrates
+    // idle-seconds from untrustworthy evidence. Each veto lands a
+    // terminal DecisionRecord with its SIGNAL_* reason code.
+    const std::string signal_metric =
+        args.device == "gpu" ? "dcgm/gr_engine_active" : "tensorcore/duty_cycle";
+    const int64_t lookback_secs = args.duration * 60 + args.grace_period;
+    std::vector<core::PodMetricSample> trusted;
+    trusted.reserve(decoded.samples.size());
+    for (size_t i = 0; i < decoded.samples.size(); ++i) {
+      const core::PodMetricSample& s = decoded.samples[i];
+      const signal::PodSignal& p = assessment.pods[i];  // assess keeps candidate order
+      if (p.verdict == signal::Verdict::Healthy) {
+        trusted.push_back(s);
+        continue;
+      }
+      log::warn("daemon", "Vetoing " + s.ns + "/" + s.name + ": evidence " +
+                std::string(signal::verdict_name(p.verdict)) + " (" +
+                signal::veto_detail(p, scfg) + ")");
+      audit::DecisionRecord rec;
+      rec.cycle = cycle_id;
+      rec.ns = s.ns;
+      rec.pod = s.name;
+      rec.signal_metric = signal_metric;
+      rec.signal_value = s.value;
+      rec.has_signal = true;
+      rec.accelerator = s.accelerator;
+      rec.lookback_s = lookback_secs;
+      rec.trace_id = trace_id;
+      rec.reason = signal::veto_reason(p.verdict);
+      rec.action = "none";
+      rec.detail = signal::veto_detail(p, scfg);
+      audit::record(std::move(rec));
+    }
+    decoded.samples = std::move(trusted);
+  }
+  observe_phase("signal", phase_start);
 
   phase_start = std::chrono::steady_clock::now();
   ResolveOutcome resolved =
@@ -659,6 +741,23 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
     survivors = std::move(capped);
   }
 
+  // Fleet brownout: when too little of the candidate set has healthy
+  // evidence, the metric plane itself is suspect — ONE cycle's worth of
+  // restraint costs nothing (the daemon is stateless; still-idle targets
+  // re-surface next cycle), while trusting a browned-out plane can
+  // suspend a busy fleet. Defers EVERY remaining survivor, like the
+  // breaker defers its overflow.
+  if (signal_on && assessment.brownout && !survivors.empty()) {
+    const std::string why = signal::brownout_detail(assessment, signal_config(args));
+    log::warn("daemon", "Signal guard: " + why + " (" + std::to_string(survivors.size()) +
+              " candidate root(s) held)");
+    for (ScaleTarget& t : survivors) {
+      outcome.emplace(t.identity(), std::make_pair(audit::Reason::SignalBrownout, why));
+      recorder::flag_root(cycle_id, t.identity(), "signal_brownout");
+    }
+    survivors.clear();
+  }
+
   CycleStats stats;
   stats.num_series = decoded.num_series;
   stats.num_pods = decoded.samples.size();
@@ -743,6 +842,14 @@ int run(const cli::Cli& args) {
   std::string query = query::build_idle_query(cli::to_query_args(args));
   log::info("daemon", "Running w/ Query: " + query);
 
+  // Signal-quality watchdog (--signal-guard on): the companion evidence
+  // query is as static as the idle query — render it once too.
+  std::string evidence_query;
+  if (args.signal_guard == "on") {
+    evidence_query = query::build_evidence_query(cli::to_query_args(args));
+    log::info("daemon", "Signal guard on; evidence query: " + evidence_query);
+  }
+
   // Durable decision audit trail (--audit-log): every DecisionRecord the
   // ring buffer sees is also appended as JSONL here.
   audit::set_audit_log(args.audit_log);
@@ -765,7 +872,11 @@ int run(const cli::Cli& args) {
     config.set("lookback_s", json::Value(args.duration * 60 + args.grace_period));
     config.set("max_scale_per_cycle", json::Value(args.max_scale_per_cycle));
     config.set("watch_cache", json::Value(args.watch_cache));
-    recorder::set_run_context(std::move(config), query);
+    config.set("signal_guard", json::Value(args.signal_guard));
+    config.set("signal_scrape_interval_s", json::Value(args.signal_scrape_interval));
+    config.set("signal_max_age_s", json::Value(args.signal_max_age));
+    config.set("signal_min_coverage", json::Value(args.signal_min_coverage));
+    recorder::set_run_context(std::move(config), query, evidence_query);
     audit::set_record_sink([](const audit::DecisionRecord& rec) {
       recorder::record_decision(rec.cycle, rec.to_json());
     });
@@ -812,8 +923,17 @@ int run(const cli::Cli& args) {
     metrics_server->set_workloads_provider(
         [](const std::string& query_string) { return ledger::workloads_json(query_string).dump(); });
     const int ledger_top_k = static_cast<int>(args.ledger_top_k);
-    metrics_server->set_extra_metrics_provider(
-        [ledger_top_k](bool openmetrics) { return ledger::render_metrics(ledger_top_k, openmetrics); });
+    // Extra /metrics families: the ledger's bounded-cardinality workload
+    // series plus the signal watchdog's evidence-health families (the
+    // latter render empty until the guard publishes its first
+    // assessment — absent, not zero, with --signal-guard off).
+    metrics_server->set_extra_metrics_provider([ledger_top_k](bool openmetrics) {
+      return ledger::render_metrics(ledger_top_k, openmetrics) +
+             signal::render_metrics(openmetrics);
+    });
+    // Evidence-health snapshot at /debug/signals (`analyze
+    // --signal-report` hits this); {"enabled": false} with the guard off.
+    metrics_server->set_signals_provider([] { return signal::signals_json().dump(); });
     // Flight recorder: capsule index at /debug/cycles, full capsules at
     // /debug/cycles/<id> ("" from the provider → 404).
     if (recorder::enabled()) {
@@ -1085,7 +1205,7 @@ int run(const cli::Cli& args) {
     try {
       CycleStats stats = run_cycle(args, query, kube, enabled, [&](ScaleTarget t) {
         queue.push({std::move(t), audit::current_cycle()});
-      }, watch_cache.get());
+      }, watch_cache.get(), evidence_query);
       consecutive_failures = 0;
       log::counter_add("query_successes", 1);
       log::counter_set("query_returned_candidates", stats.num_pods);

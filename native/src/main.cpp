@@ -32,11 +32,22 @@ int main(int argc, char** argv) {
 
   if (argc >= 2 && std::strcmp(argv[1], "querytest") == 0) {
     if (argc != 4) {
-      std::fprintf(stderr, "usage: tpu-pruner querytest <promql> <prometheus-url>\n");
+      std::fprintf(stderr,
+                   "usage: tpu-pruner querytest <promql> <prometheus-url>\n"
+                   "       tpu-pruner querytest --evidence <prometheus-url>\n"
+                   "  --evidence renders and runs the signal watchdog's evidence query\n"
+                   "  (per-pod sample coverage + last-sample age; default TPU/gmp args)\n");
       return 2;
     }
     log::init(log::Format::Default);
     try {
+      if (std::strcmp(argv[2], "--evidence") == 0) {
+        // Ad-hoc evidence-health check: the same query --signal-guard on
+        // issues per cycle, runnable standalone before enabling the guard.
+        std::string evidence = query::build_evidence_query(query::QueryArgs{});
+        std::fprintf(stderr, "evidence query:\n%s\n", evidence.c_str());
+        return querytest::run(evidence, argv[3]);
+      }
       return querytest::run(argv[2], argv[3]);
     } catch (const std::exception& e) {
       std::fprintf(stderr, "querytest: %s\n", e.what());

@@ -42,7 +42,7 @@ const std::map<std::string, std::string>& help_texts() {
       {"informer_watch_failures", "Watch stream failures observed by the cache"},
       {"informer_staleness_seconds", "Seconds since the watch cache last applied an event or list"},
       {"cycle_phase_seconds", "Per-cycle pipeline phase latency (phase label: "
-                              "query, decode, resolve, actuate, total)"},
+                              "query, decode, signal, resolve, actuate, total)"},
       {"scale_patch_seconds", "Per-target actuation latency (Event POST + pause PATCH)"},
   };
   return kHelp;
@@ -115,6 +115,11 @@ void Server::set_workloads_provider(std::function<std::string(const std::string&
 void Server::set_cycles_provider(std::function<std::string(const std::string&)> provider) {
   std::lock_guard<std::mutex> lock(probe_mutex_);
   cycles_provider_ = std::move(provider);
+}
+
+void Server::set_signals_provider(std::function<std::string()> provider) {
+  std::lock_guard<std::mutex> lock(probe_mutex_);
+  signals_provider_ = std::move(provider);
 }
 
 void Server::set_extra_metrics_provider(std::function<std::string(bool)> provider) {
@@ -280,6 +285,20 @@ void Server::serve() {
         status_text = "Not Found";
         body = "workload ledger not enabled\n";
       }
+    } else if (path == "/debug/signals") {
+      std::function<std::string()> provider;
+      {
+        std::lock_guard<std::mutex> lock(probe_mutex_);
+        provider = signals_provider_;
+      }
+      if (provider) {
+        content_type = "application/json";
+        body = provider();
+      } else {
+        status = 404;
+        status_text = "Not Found";
+        body = "signal watchdog not available\n";
+      }
     } else if (path == "/debug/cycles" || util::starts_with(path, "/debug/cycles/")) {
       std::function<std::string(const std::string&)> provider;
       {
@@ -315,7 +334,9 @@ void Server::serve() {
              "{\"path\":\"/debug/workloads\",\"description\":\"workload utilization ledger "
              "snapshot, ?ns= and ?sort=reclaimed|idle|chips\"}," +
              "{\"path\":\"/debug/cycles\",\"description\":\"flight-recorder capsule index; "
-             "/debug/cycles/<id> serves one full capsule (--flight-dir)\"}" +
+             "/debug/cycles/<id> serves one full capsule (--flight-dir)\"}," +
+             "{\"path\":\"/debug/signals\",\"description\":\"signal-quality watchdog: per-pod "
+             "evidence verdicts + fleet coverage (--signal-guard on)\"}" +
              "]}";
     } else {
       content_type = want_openmetrics

@@ -49,6 +49,10 @@ class Server {
   // return means "no such capsule" (404). Unset → 404 for both routes.
   void set_cycles_provider(std::function<std::string(const std::string&)> provider);
 
+  // /debug/signals provider (the signal-quality watchdog's latest
+  // evidence assessment). Unset → 404.
+  void set_signals_provider(std::function<std::string()> provider);
+
   // Extra /metrics families rendered outside the counter/histogram
   // registries (the ledger's bounded-cardinality workload series). The
   // provider returns ready-made exposition text (HELP/TYPE included);
@@ -67,6 +71,7 @@ class Server {
   std::function<std::string(const std::string&)> decisions_provider_;
   std::function<std::string(const std::string&)> workloads_provider_;
   std::function<std::string(const std::string&)> cycles_provider_;
+  std::function<std::string()> signals_provider_;
   std::function<std::string(bool)> extra_metrics_provider_;
   mutable std::mutex probe_mutex_;
   std::thread thread_;

@@ -226,7 +226,105 @@ std::string build_gpu_query(const QueryArgs& a) {
   return assemble(idle_block, group_labels, enrich_join, unless_clause);
 }
 
+// Stamp a constant-valued synthetic label onto every series of `expr`:
+// label_replace with an empty source label ("" always exists, as the
+// empty string) and an empty anchored regex (matches exactly "").
+std::string stamp_stat(const std::string& expr, const char* stat) {
+  return "label_replace(\n  " + expr + ",\n  \"signal_stat\", \"" + std::string(stat) +
+         "\", \"\", \"\"\n)";
+}
+
+// gmp evidence: per-pod coverage + freshness over the same selectors the
+// idle query uses. `or` between the two metric variants keeps the primary
+// (tensorcore) statistic where both exist, like the idle block.
+std::string build_evidence_query_podlabeled(const QueryArgs& a, const std::string& primary,
+                                            const std::string& fallback,
+                                            const std::string& extra_label,
+                                            const std::string& extra_regex) {
+  Labels l(a.honor_labels);
+  std::string sel = selector(l, a, extra_label, extra_regex);
+  std::string group = l.pod + ", " + l.ns;
+  std::string samples = "sum by (" + group + ") (\n    count_over_time(" + primary + sel +
+                        window(a) + ")\n    or\n    count_over_time(" + fallback + sel +
+                        window(a) + ")\n  )";
+  std::string age = "time()\n  - max by (" + group + ") (\n    timestamp(" + primary + sel +
+                    ")\n    or\n    timestamp(" + fallback + sel + ")\n  )";
+  return "(\n" + stamp_stat(samples, "samples") + "\n)\nor\n(\n" + stamp_stat(age, "age") + "\n)";
+}
+
+// gke-system evidence: coverage/freshness are node-scoped facts (the
+// accelerator series carry no pod labels); attribute them to pods with
+// the SAME many-to-one KSM join the idle query uses, masked to 1 with a
+// `> bool 0` so the joined value stays the node statistic, not
+// request_count × statistic.
+std::string build_evidence_query_gke_system(const QueryArgs& a) {
+  Labels l(a.honor_labels);
+  auto effective = [](const std::string& configured, const char* gmp_default,
+                     const char* gke_name) {
+    return configured == gmp_default ? std::string(gke_name) : configured;
+  };
+  std::string tensorcore =
+      effective(a.tensorcore_metric, "tensorcore_utilization",
+                "kubernetes_io:node_accelerator_tensorcore_utilization");
+  std::string duty = effective(a.duty_cycle_metric, "tensorcore_duty_cycle",
+                               "kubernetes_io:node_accelerator_duty_cycle");
+  std::string accel_sel;
+  if (!a.accelerator_regex.empty()) {
+    accel_sel = "{model =~ \"" + promql_string_escape(a.accelerator_regex) + "\"}";
+  }
+  std::string join_sel = "{";
+  bool first = true;
+  auto add = [&](const std::string& clause) {
+    if (!first) join_sel += ", ";
+    join_sel += clause;
+    first = false;
+  };
+  if (!a.join_resource.empty())
+    add("resource = \"" + promql_string_escape(a.join_resource) + "\"");
+  if (!a.namespace_regex.empty())
+    add(l.ns + " =~ \"" + promql_string_escape(a.namespace_regex) + "\"");
+  if (!a.namespace_exclude_regex.empty())
+    add(l.ns + " !~ \"" + promql_string_escape(a.namespace_exclude_regex) + "\"");
+  join_sel += "}";
+  if (join_sel == "{}") join_sel.clear();
+
+  std::string pods_mask = "(\n    max by (node_name, pod, " + l.ns +
+                          ", container) (\n      label_replace(\n        " + a.join_metric +
+                          join_sel + ",\n        \"node_name\", \"$1\", \"node\", \"(.+)\"\n"
+                          "      )\n    ) > bool 0\n  )";
+  std::string node_samples = "sum by (node_name) (\n    count_over_time(" + tensorcore +
+                             accel_sel + window(a) + ")\n    or\n    count_over_time(" + duty +
+                             accel_sel + window(a) + ")\n  )";
+  std::string node_age = "time()\n  - max by (node_name) (\n    timestamp(" + tensorcore +
+                         accel_sel + ")\n    or\n    timestamp(" + duty + accel_sel + ")\n  )";
+  std::string samples =
+      pods_mask + "\n  * on (node_name) group_left\n  " + node_samples;
+  std::string age = pods_mask + "\n  * on (node_name) group_left\n  (\n  " + node_age + "\n  )";
+  return "(\n" + stamp_stat(samples, "samples") + "\n)\nor\n(\n" + stamp_stat(age, "age") + "\n)";
+}
+
 }  // namespace
+
+std::string build_evidence_query(const QueryArgs& args) {
+  if (args.metric_schema != "gmp" && args.metric_schema != "gke-system") {
+    throw std::invalid_argument("unknown metric schema: " + args.metric_schema +
+                                " (expected gmp|gke-system)");
+  }
+  if (args.device == "gpu") {
+    if (args.metric_schema == "gke-system") {
+      throw std::invalid_argument("--metric-schema=gke-system requires --device=tpu");
+    }
+    return build_evidence_query_podlabeled(args, "DCGM_FI_PROF_GR_ENGINE_ACTIVE",
+                                           "DCGM_FI_DEV_GPU_UTIL", "modelName",
+                                           args.model_regex);
+  }
+  if (args.device == "tpu") {
+    if (args.metric_schema == "gke-system") return build_evidence_query_gke_system(args);
+    return build_evidence_query_podlabeled(args, args.tensorcore_metric, args.duty_cycle_metric,
+                                           "accelerator_type", args.accelerator_regex);
+  }
+  throw std::invalid_argument("unknown device: " + args.device + " (expected tpu|gpu)");
+}
 
 std::string build_idle_query(const QueryArgs& args) {
   if (args.metric_schema != "gmp" && args.metric_schema != "gke-system") {

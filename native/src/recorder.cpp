@@ -15,6 +15,7 @@
 #include "tpupruner/log.hpp"
 #include "tpupruner/metrics.hpp"
 #include "tpupruner/query.hpp"
+#include "tpupruner/signal.hpp"
 #include "tpupruner/util.hpp"
 #include "tpupruner/walker.hpp"
 
@@ -32,6 +33,8 @@ struct OpenCapsule {
   int64_t ts_ms = 0;        // capsule id component (restart-unique)
   int64_t now_unix = 0;     // eligibility clock (resolve phase)
   std::string prom_body;
+  std::string evidence_body;  // signal watchdog's raw evidence response
+  Value signal_assessment;    // derived verdicts (forensics; replay recomputes)
   Value pods = Value::object();         // "ns/name" → acquisition evidence
   Value resolutions = Value::object();  // "ns/name" → walk result
   Value objects = Value::object();      // API path → object | null (miss)
@@ -58,6 +61,7 @@ struct Registry {
   size_t keep = 64;
   Value config;       // run config fingerprint
   std::string query;  // rendered idle query
+  std::string evidence_query;  // rendered evidence query ("" = guard off)
   std::map<uint64_t, OpenCapsule> open;
   std::vector<IndexEntry> index;  // oldest first (ids sort chronologically)
 };
@@ -142,6 +146,13 @@ void seal_locked(Registry& r, uint64_t cycle) {
   Value prom = Value::object();
   prom.set("body", Value(c.prom_body));
   doc.set("prom", std::move(prom));
+  if (!c.evidence_body.empty() || !r.evidence_query.empty()) {
+    Value evidence = Value::object();
+    evidence.set("query", Value(r.evidence_query));
+    evidence.set("body", Value(c.evidence_body));
+    doc.set("evidence", std::move(evidence));
+  }
+  if (!c.signal_assessment.is_null()) doc.set("signal", std::move(c.signal_assessment));
   doc.set("pods", std::move(c.pods));
   doc.set("resolutions", std::move(c.resolutions));
   doc.set("objects", std::move(c.objects));
@@ -228,11 +239,12 @@ bool enabled() {
   return r.enabled;
 }
 
-void set_run_context(Value config, std::string query) {
+void set_run_context(Value config, std::string query, std::string evidence_query) {
   Registry& r = reg();
   std::lock_guard<std::mutex> lock(r.mutex);
   r.config = std::move(config);
   r.query = std::move(query);
+  r.evidence_query = std::move(evidence_query);
 }
 
 void begin_cycle(uint64_t cycle, int64_t ts_unix) {
@@ -254,6 +266,18 @@ void record_prom_body(uint64_t cycle, const std::string& body) {
   Registry& r = reg();
   std::lock_guard<std::mutex> lock(r.mutex);
   if (OpenCapsule* c = open_capsule_locked(r, cycle)) c->prom_body = body;
+}
+
+void record_evidence_body(uint64_t cycle, const std::string& body) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  if (OpenCapsule* c = open_capsule_locked(r, cycle)) c->evidence_body = body;
+}
+
+void record_signal(uint64_t cycle, Value assessment) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  if (OpenCapsule* c = open_capsule_locked(r, cycle)) c->signal_assessment = std::move(assessment);
 }
 
 void record_resolve_now(uint64_t cycle, int64_t now_unix) {
@@ -434,6 +458,7 @@ void reset_for_test() {
   r.keep = 64;
   r.config = Value();
   r.query.clear();
+  r.evidence_query.clear();
   r.open.clear();
   r.index.clear();
 }
@@ -533,6 +558,15 @@ Value replay(const Value& capsule, const Value& what_if) {
   int64_t lookback_s = cfg_int("lookback_s", qargs.duration_min * 60 + grace_s);
   const int64_t recorded_max_scale = cfg_int("max_scale_per_cycle", 0);
   int64_t max_scale = recorded_max_scale;
+  // Signal-quality watchdog config (absent on pre-watchdog capsules →
+  // guard off, exactly how those cycles ran).
+  std::string signal_guard = cfg.get_string("signal_guard", "off");
+  signal::Config scfg;
+  scfg.scrape_interval_s = cfg_int("signal_scrape_interval_s", 30);
+  scfg.max_age_s = cfg_int("signal_max_age_s", 300);
+  if (const Value* mc = cfg.find("signal_min_coverage"); mc && mc->is_number()) {
+    scfg.min_coverage = mc->as_double();
+  }
 
   bool breaker_overridden = false, lookback_explicit = false, window_derived = false;
   bool has_what_if = what_if.is_object() && !what_if.as_object().empty();
@@ -566,11 +600,21 @@ Value replay(const Value& capsule, const Value& what_if) {
         breaker_overridden = true;
       } else if (key == "hbm_threshold") {
         qargs.hbm_threshold = parse_double_value(key, val);
+      } else if (key == "signal_min_coverage") {
+        scfg.min_coverage = parse_double_value(key, val);
+        if (scfg.min_coverage < 0.0 || scfg.min_coverage > 1.0) {
+          throw std::runtime_error("what-if signal_min_coverage: expected 0..1");
+        }
+      } else if (key == "signal_guard") {
+        signal_guard = value_string(key, val);
+        if (signal_guard != "on" && signal_guard != "off") {
+          throw std::runtime_error("what-if signal_guard: expected on|off");
+        }
       } else {
         throw std::runtime_error(
             "unknown what-if key: " + key +
             " (supported: lookback, duration, grace, run_mode, enabled_resources, "
-            "max_scale_per_cycle, hbm_threshold)");
+            "max_scale_per_cycle, hbm_threshold, signal_min_coverage, signal_guard)");
       }
     }
     if (window_derived && !lookback_explicit) lookback_s = qargs.duration_min * 60 + grace_s;
@@ -590,6 +634,27 @@ Value replay(const Value& capsule, const Value& what_if) {
 
   const int64_t now = require("now_unix").as_int();
   const uint64_t cycle = static_cast<uint64_t>(require("cycle").as_int());
+
+  // ── signal watchdog: re-derive every verdict from the recorded raw
+  //    evidence body (never from the stamped assessment), so the veto
+  //    and brownout decisions below are recomputed facts, bit-for-bit ──
+  scfg.window_s = qargs.duration_min * 60;
+  const bool guard_on = signal_guard == "on";
+  signal::Assessment sig;
+  std::map<std::string, const signal::PodSignal*> signal_by_pod;
+  if (guard_on) {
+    const Value* evidence = capsule.find("evidence");
+    if (!evidence) {
+      throw std::runtime_error(
+          "signal_guard=on but the capsule carries no evidence recording "
+          "(the cycle ran without --signal-guard on)");
+    }
+    sig = signal::assess(Value::parse(evidence->get_string("body")), decoded.samples, scfg,
+                         cycle);
+    for (const signal::PodSignal& p : sig.pods) signal_by_pod[p.ns + "/" + p.pod] = &p;
+  }
+  const bool signal_brownout = guard_on && sig.brownout;
+
   const Value* pods_ev = capsule.find("pods");
   const Value* resolutions = capsule.find("resolutions");
   const Value* objects = capsule.find("objects");
@@ -622,6 +687,15 @@ Value replay(const Value& capsule, const Value& what_if) {
     std::string identity;
     core::Kind kind = core::Kind::Deployment;
   };
+  // Recorded decisions, keyed by pod — the comparison baseline, the
+  // per-pod fallback for actuation outcomes, and the held-fixed source
+  // for signal-vetoed pods whose cluster evidence was never captured.
+  std::map<std::string, Value> recorded_by_pod;
+  if (const Value* recs = capsule.find("decisions"); recs && recs->is_array()) {
+    for (const Value& d : recs->as_array()) {
+      recorded_by_pod[d.get_string("namespace") + "/" + d.get_string("pod")] = d;
+    }
+  }
   std::vector<audit::DecisionRecord> finals;
   std::vector<PendingT> pendings;
   std::map<std::string, bool> predicted_by_pod;
@@ -659,8 +733,34 @@ Value replay(const Value& capsule, const Value& what_if) {
       finals.push_back(rec);
     };
 
+    // Signal vetoes run BEFORE pod acquisition, exactly as in the daemon:
+    // a vetoed candidate never reached resolution, so the capsule holds
+    // no pod evidence for it either.
+    if (guard_on) {
+      auto sp = signal_by_pod.find(key);
+      if (sp != signal_by_pod.end() && sp->second->verdict != signal::Verdict::Healthy) {
+        decide(signal::veto_reason(sp->second->verdict),
+               signal::veto_detail(*sp->second, scfg));
+        continue;
+      }
+    }
+
     const Value* ev = pods_ev ? pods_ev->find(key) : nullptr;
     if (!ev) {
+      // A candidate without acquisition evidence was signal-vetoed at
+      // record time: the guard stops vetoed pods BEFORE any cluster
+      // fetch, so the capsule never saw their Pod JSON or owner chain.
+      // When a what-if re-opens that path (signal_guard=off), the
+      // offline store cannot re-derive what was never captured — hold
+      // the recorded veto fixed, like the other cluster-state facts.
+      if (auto recd = recorded_by_pod.find(key); recd != recorded_by_pod.end()) {
+        const std::string recorded_reason = recd->second.get_string("reason");
+        if (recorded_reason.rfind("SIGNAL_", 0) == 0) {
+          decide(audit::reason_from_name(recorded_reason).value_or(audit::Reason::SignalAbsent),
+                 recd->second.get_string("detail"));
+          continue;
+        }
+      }
       throw std::runtime_error("malformed capsule: no pod evidence for candidate " + key);
     }
     if (std::string fetch_error = ev->get_string("fetch_error"); !fetch_error.empty()) {
@@ -807,6 +907,14 @@ Value replay(const Value& capsule, const Value& what_if) {
   }
   auto final_stage = [&](const std::string& id) {
     Outcome o;
+    if (signal_brownout) {
+      // The daemon clears every post-breaker survivor under a brownout
+      // (disabled kinds and dry-run included) — the outcome map wins
+      // over the dry-run/pending paths, so mirror that precedence here.
+      outcomes[id] = {audit::Reason::SignalBrownout, "none",
+                      signal::brownout_detail(sig, scfg), false, false};
+      return;
+    }
     if (dry_run) {
       o = {audit::Reason::DryRun, "none", "would have paused (run-mode dry-run)", false, false};
     } else if (!(enabled & core::flag(kind_of[id]))) {
@@ -844,16 +952,6 @@ Value replay(const Value& capsule, const Value& what_if) {
     }
   } else {
     for (const std::string& id : survivors) final_stage(id);
-  }
-
-  // Recorded decisions, keyed by pod — the comparison baseline and the
-  // per-pod fallback for actuation outcomes (the one stage replay cannot
-  // re-run: it was a cluster interaction).
-  std::map<std::string, Value> recorded_by_pod;
-  if (const Value* recs = capsule.find("decisions"); recs && recs->is_array()) {
-    for (const Value& d : recs->as_array()) {
-      recorded_by_pod[d.get_string("namespace") + "/" + d.get_string("pod")] = d;
-    }
   }
 
   for (PendingT& p : pendings) {

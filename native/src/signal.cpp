@@ -1,0 +1,366 @@
+#include "tpupruner/signal.hpp"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <stdexcept>
+
+namespace tpupruner::signal {
+
+using json::Value;
+
+namespace {
+
+std::string fmt_value(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%.9g", v);
+  return buf;
+}
+
+std::string fmt_ratio(double v) {
+  char buf[32];
+  std::snprintf(buf, sizeof(buf), "%.3f", v);
+  return buf;
+}
+
+// Label lookup with the exported_*/native fallback chain, mirroring
+// metrics.cpp's decoder — the evidence query rides the same scrape
+// pipeline as the idle query, so its labels wear the same prefixes.
+const std::string* label(const Value& metric, const char* exported, const char* native) {
+  const Value* v = metric.find(exported);
+  if (v && v->is_string()) return &v->as_string();
+  v = metric.find(native);
+  if (v && v->is_string()) return &v->as_string();
+  return nullptr;
+}
+
+// Evidence-age histogram: ages span a healthy scrape interval (tens of
+// seconds) to a dead exporter (hours), so the ladder is wider and coarser
+// than the phase-latency buckets in log.cpp.
+constexpr double kAgeBounds[] = {15, 30, 60, 120, 300, 600, 1800, 3600, 14400, 86400};
+constexpr size_t kAgeBuckets = sizeof(kAgeBounds) / sizeof(kAgeBounds[0]) + 1;
+
+struct Registry {
+  std::mutex mutex;
+  bool published = false;
+  Assessment latest;
+  Config cfg;
+  uint64_t brownouts_total = 0;
+  uint64_t age_buckets[kAgeBuckets] = {};
+  double age_sum = 0;
+  uint64_t age_count = 0;
+};
+
+Registry& reg() {
+  static Registry r;
+  return r;
+}
+
+}  // namespace
+
+const char* verdict_name(Verdict v) {
+  switch (v) {
+    case Verdict::Healthy: return "healthy";
+    case Verdict::Stale: return "stale";
+    case Verdict::Gappy: return "gappy";
+    case Verdict::Absent: return "absent";
+  }
+  return "?";
+}
+
+size_t Assessment::count(Verdict v) const {
+  size_t n = 0;
+  for (const PodSignal& p : pods) {
+    if (p.verdict == v) ++n;
+  }
+  return n;
+}
+
+Assessment assess(const Value& evidence_response,
+                  const std::vector<core::PodMetricSample>& candidates, const Config& cfg,
+                  uint64_t cycle) {
+  const Value* status = evidence_response.find("status");
+  if (!status || !status->is_string() || status->as_string() != "success") {
+    throw std::runtime_error("evidence query failed: " +
+                             evidence_response.get_string("error", "unknown error"));
+  }
+  const Value* result = evidence_response.at_path("data.result");
+  if (!result || !result->is_array()) {
+    throw std::runtime_error("malformed evidence response: missing data.result");
+  }
+
+  // Fold the response into per-pod statistics. After the query's
+  // `sum by`/`max by` there is one row per (pod, stat); tolerate
+  // duplicates anyway (chip-level rows from a permissive fake or a
+  // non-aggregating override) by summing coverage and keeping the
+  // freshest age.
+  struct Stats {
+    double samples = 0;
+    double age = 0;
+    bool has_samples = false, has_age = false;
+  };
+  std::map<std::string, Stats> by_pod;
+  for (const Value& series : result->as_array()) {
+    const Value* metric = series.find("metric");
+    if (!metric || !metric->is_object()) continue;
+    const std::string* pod = label(*metric, "exported_pod", "pod");
+    const std::string* ns = label(*metric, "exported_namespace", "namespace");
+    if (!pod || !ns) continue;
+    std::string stat = metric->get_string("signal_stat");
+    const Value* value = series.find("value");
+    if (!value || !value->is_array() || value->as_array().size() != 2) continue;
+    const Value& v = value->as_array()[1];
+    double x = 0;
+    try {
+      x = v.is_string() ? std::stod(v.as_string()) : v.as_double();
+    } catch (const std::exception&) {
+      continue;
+    }
+    Stats& s = by_pod[*ns + "/" + *pod];
+    if (stat == "samples") {
+      s.samples += x;
+      s.has_samples = true;
+    } else if (stat == "age") {
+      s.age = s.has_age ? std::min(s.age, x) : x;
+      s.has_age = true;
+    }
+  }
+
+  Assessment out;
+  out.cycle = cycle;
+  out.min_coverage = cfg.min_coverage;
+  const double min_samples = cfg.min_samples();
+  size_t healthy = 0;
+  for (const core::PodMetricSample& c : candidates) {
+    PodSignal p;
+    p.ns = c.ns;
+    p.pod = c.name;
+    auto it = by_pod.find(c.ns + "/" + c.name);
+    if (it != by_pod.end()) {
+      p.sample_count = it->second.samples;
+      p.last_age_s = it->second.age;
+      p.has_samples = it->second.has_samples;
+      p.has_age = it->second.has_age;
+    }
+    if (!p.has_samples && !p.has_age) {
+      p.verdict = Verdict::Absent;
+    } else if (p.has_age && p.last_age_s > static_cast<double>(cfg.max_age_s)) {
+      p.verdict = Verdict::Stale;
+    } else if (p.has_samples && p.sample_count < min_samples) {
+      p.verdict = Verdict::Gappy;
+    } else {
+      p.verdict = Verdict::Healthy;
+      ++healthy;
+    }
+    out.pods.push_back(std::move(p));
+  }
+  out.coverage_ratio =
+      candidates.empty() ? 1.0
+                         : static_cast<double>(healthy) / static_cast<double>(candidates.size());
+  out.brownout = !candidates.empty() && out.coverage_ratio < cfg.min_coverage;
+  return out;
+}
+
+audit::Reason veto_reason(Verdict v) {
+  switch (v) {
+    case Verdict::Stale: return audit::Reason::SignalStale;
+    case Verdict::Gappy: return audit::Reason::SignalGappy;
+    case Verdict::Absent: return audit::Reason::SignalAbsent;
+    case Verdict::Healthy: break;
+  }
+  return audit::Reason::SignalAbsent;
+}
+
+std::string veto_detail(const PodSignal& p, const Config& cfg) {
+  switch (p.verdict) {
+    case Verdict::Stale:
+      return "newest sample " + fmt_value(p.last_age_s) + "s old, over --signal-max-age=" +
+             std::to_string(cfg.max_age_s) + "s (the idle reading is a memory, not a fact)";
+    case Verdict::Gappy:
+      return "only " + fmt_value(p.sample_count) + " samples over the " +
+             std::to_string(cfg.window_s) + "s window, below the " + fmt_value(cfg.min_samples()) +
+             " floor (--signal-scrape-interval=" + std::to_string(cfg.scrape_interval_s) + "s)";
+    case Verdict::Absent:
+      return "no evidence series for this pod (metric family absent or dropped by relabeling)";
+    case Verdict::Healthy:
+      break;
+  }
+  return "";
+}
+
+std::string brownout_detail(const Assessment& a, const Config& cfg) {
+  return "signal brownout: healthy evidence coverage " + fmt_ratio(a.coverage_ratio) +
+         " below --signal-min-coverage=" + fmt_ratio(cfg.min_coverage) +
+         "; all scale-downs deferred this cycle";
+}
+
+json::Value assessment_to_json(const Assessment& a) {
+  Value v = Value::object();
+  v.set("cycle", Value(static_cast<int64_t>(a.cycle)));
+  v.set("coverage_ratio", Value(a.coverage_ratio));
+  v.set("brownout", Value(a.brownout));
+  v.set("min_coverage", Value(a.min_coverage));
+  Value counts = Value::object();
+  for (Verdict verdict : {Verdict::Healthy, Verdict::Stale, Verdict::Gappy, Verdict::Absent}) {
+    counts.set(verdict_name(verdict), Value(static_cast<int64_t>(a.count(verdict))));
+  }
+  v.set("pods", std::move(counts));
+  Value details = Value::array();
+  for (const PodSignal& p : a.pods) {
+    Value d = Value::object();
+    d.set("namespace", Value(p.ns));
+    d.set("pod", Value(p.pod));
+    d.set("verdict", Value(std::string(verdict_name(p.verdict))));
+    if (p.has_samples) d.set("sample_count", Value(p.sample_count));
+    if (p.has_age) d.set("last_age_s", Value(p.last_age_s));
+    details.push_back(std::move(d));
+  }
+  v.set("details", std::move(details));
+  return v;
+}
+
+Assessment assessment_from_json(const json::Value& v) {
+  Assessment a;
+  if (const Value* x = v.find("cycle"); x && x->is_number())
+    a.cycle = static_cast<uint64_t>(x->as_int());
+  if (const Value* x = v.find("coverage_ratio"); x && x->is_number())
+    a.coverage_ratio = x->as_double();
+  if (const Value* x = v.find("brownout"); x && x->is_bool()) a.brownout = x->as_bool();
+  if (const Value* x = v.find("min_coverage"); x && x->is_number())
+    a.min_coverage = x->as_double();
+  if (const Value* details = v.find("details"); details && details->is_array()) {
+    for (const Value& d : details->as_array()) {
+      PodSignal p;
+      p.ns = d.get_string("namespace");
+      p.pod = d.get_string("pod");
+      std::string verdict = d.get_string("verdict");
+      for (Verdict candidate :
+           {Verdict::Healthy, Verdict::Stale, Verdict::Gappy, Verdict::Absent}) {
+        if (verdict == verdict_name(candidate)) p.verdict = candidate;
+      }
+      if (const Value* x = d.find("sample_count"); x && x->is_number()) {
+        p.sample_count = x->as_double();
+        p.has_samples = true;
+      }
+      if (const Value* x = d.find("last_age_s"); x && x->is_number()) {
+        p.last_age_s = x->as_double();
+        p.has_age = true;
+      }
+      a.pods.push_back(std::move(p));
+    }
+  }
+  return a;
+}
+
+void publish(const Assessment& a, const Config& cfg) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  r.published = true;
+  r.latest = a;
+  r.cfg = cfg;
+  if (a.brownout) ++r.brownouts_total;
+  for (const PodSignal& p : a.pods) {
+    if (!p.has_age) continue;
+    size_t idx = std::lower_bound(std::begin(kAgeBounds), std::end(kAgeBounds), p.last_age_s) -
+                 std::begin(kAgeBounds);
+    ++r.age_buckets[idx];
+    r.age_sum += p.last_age_s;
+    ++r.age_count;
+  }
+}
+
+json::Value signals_json() {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  if (!r.published) {
+    Value v = Value::object();
+    v.set("enabled", Value(false));
+    v.set("hint", Value("run the daemon with --signal-guard on to assess evidence health"));
+    return v;
+  }
+  Value v = assessment_to_json(r.latest);
+  v.set("enabled", Value(true));
+  v.set("brownouts_total", Value(static_cast<int64_t>(r.brownouts_total)));
+  Value thresholds = Value::object();
+  thresholds.set("scrape_interval_s", Value(r.cfg.scrape_interval_s));
+  thresholds.set("max_age_s", Value(r.cfg.max_age_s));
+  thresholds.set("min_coverage", Value(r.cfg.min_coverage));
+  thresholds.set("window_s", Value(r.cfg.window_s));
+  thresholds.set("min_samples", Value(r.cfg.min_samples()));
+  v.set("thresholds", std::move(thresholds));
+  return v;
+}
+
+std::string render_metrics(bool openmetrics) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  // Absent-not-zero, like the informer families: before the first
+  // assessment (guard off) these series would read "no coverage, never
+  // brownouted" — a dashboard would misread silence as health.
+  if (!r.published) return "";
+
+  auto family = [&](const std::string& name, const char* type, const std::string& help) {
+    std::string fam = name;
+    if (openmetrics && std::string(type) == "counter" && fam.size() > 6 &&
+        fam.compare(fam.size() - 6, 6, "_total") == 0) {
+      fam = fam.substr(0, fam.size() - 6);
+    }
+    return "# HELP " + fam + " " + help + "\n# TYPE " + fam + " " + type + "\n";
+  };
+
+  std::string body;
+  body += family("tpu_pruner_signal_coverage_ratio", "gauge",
+                 "Fraction of last cycle's candidate pods whose evidence is healthy");
+  body += "tpu_pruner_signal_coverage_ratio " + fmt_value(r.latest.coverage_ratio) + "\n";
+
+  body += family("tpu_pruner_signal_pods", "gauge",
+                 "Last cycle's candidate pods by evidence verdict "
+                 "(healthy|stale|gappy|absent)");
+  for (Verdict v : {Verdict::Healthy, Verdict::Stale, Verdict::Gappy, Verdict::Absent}) {
+    body += "tpu_pruner_signal_pods{verdict=\"" + std::string(verdict_name(v)) + "\"} " +
+            std::to_string(r.latest.count(v)) + "\n";
+  }
+
+  body += family("tpu_pruner_signal_brownouts_total", "counter",
+                 "Cycles whose scale-downs were all deferred because healthy evidence "
+                 "coverage fell below --signal-min-coverage");
+  body += "tpu_pruner_signal_brownouts_total " + std::to_string(r.brownouts_total) + "\n";
+
+  body += family("tpu_pruner_pod_signal_age_seconds", "histogram",
+                 "Age of each candidate pod's newest utilization sample, per cycle");
+  uint64_t cum = 0;
+  for (size_t i = 0; i < kAgeBuckets; ++i) {
+    cum += r.age_buckets[i];
+    std::string le = i < kAgeBuckets - 1 ? fmt_value(kAgeBounds[i]) : "+Inf";
+    body += "tpu_pruner_pod_signal_age_seconds_bucket{le=\"" + le + "\"} " +
+            std::to_string(cum) + "\n";
+  }
+  body += "tpu_pruner_pod_signal_age_seconds_sum " + fmt_value(r.age_sum) + "\n";
+  body += "tpu_pruner_pod_signal_age_seconds_count " + std::to_string(r.age_count) + "\n";
+  return body;
+}
+
+std::vector<std::string> metric_families() {
+  return {
+      "tpu_pruner_signal_coverage_ratio",
+      "tpu_pruner_signal_pods",
+      "tpu_pruner_signal_brownouts_total",
+      "tpu_pruner_pod_signal_age_seconds",
+  };
+}
+
+void reset_for_test() {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  r.published = false;
+  r.latest = Assessment{};
+  r.cfg = Config{};
+  r.brownouts_total = 0;
+  std::fill(std::begin(r.age_buckets), std::end(r.age_buckets), 0);
+  r.age_sum = 0;
+  r.age_count = 0;
+}
+
+}  // namespace tpupruner::signal

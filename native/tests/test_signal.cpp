@@ -1,0 +1,254 @@
+// Signal-quality watchdog units: verdict thresholds, coverage math,
+// serialize round-trip, evidence-query shape, and the export registry
+// (the e2e behavior rides tests/test_signal_guard.py).
+#include "testing.hpp"
+#include "tpupruner/audit.hpp"
+#include "tpupruner/json.hpp"
+#include "tpupruner/query.hpp"
+#include "tpupruner/signal.hpp"
+
+namespace signal = tpupruner::signal;
+namespace query = tpupruner::query;
+using tpupruner::core::PodMetricSample;
+using tpupruner::json::Value;
+
+namespace {
+
+Value evidence_row(const std::string& ns, const std::string& pod, const char* stat,
+                   double value) {
+  Value metric = Value::object();
+  metric.set("exported_pod", Value(pod));
+  metric.set("exported_namespace", Value(ns));
+  metric.set("signal_stat", Value(std::string(stat)));
+  Value sample = Value::array();
+  sample.push_back(Value(0));
+  sample.push_back(Value(std::to_string(value)));
+  Value row = Value::object();
+  row.set("metric", std::move(metric));
+  row.set("value", std::move(sample));
+  return row;
+}
+
+Value response_of(std::vector<Value> rows) {
+  Value result = Value::array();
+  for (Value& r : rows) result.push_back(std::move(r));
+  Value data = Value::object();
+  data.set("resultType", Value(std::string("vector")));
+  data.set("result", std::move(result));
+  Value resp = Value::object();
+  resp.set("status", Value(std::string("success")));
+  resp.set("data", std::move(data));
+  return resp;
+}
+
+PodMetricSample candidate(const std::string& ns, const std::string& pod) {
+  PodMetricSample s;
+  s.ns = ns;
+  s.name = pod;
+  return s;
+}
+
+signal::Config default_cfg() {
+  signal::Config cfg;
+  cfg.scrape_interval_s = 30;
+  cfg.max_age_s = 300;
+  cfg.min_coverage = 0.9;
+  cfg.window_s = 1800;
+  return cfg;
+}
+
+}  // namespace
+
+TP_TEST(signal_verdict_thresholds) {
+  // window 1800 / scrape 30 → 60 expected → GAPPY floor at 30.
+  signal::Config cfg = default_cfg();
+  TP_CHECK_EQ(cfg.min_samples(), 30.0);
+  Value resp = response_of({
+      evidence_row("ml", "ok", "samples", 60), evidence_row("ml", "ok", "age", 10),
+      evidence_row("ml", "old", "samples", 60), evidence_row("ml", "old", "age", 301),
+      evidence_row("ml", "thin", "samples", 29), evidence_row("ml", "thin", "age", 10),
+      // exactly at the floor/threshold stays healthy (strict comparisons)
+      evidence_row("ml", "edge", "samples", 30), evidence_row("ml", "edge", "age", 300),
+  });
+  signal::Assessment a = signal::assess(
+      resp,
+      {candidate("ml", "ok"), candidate("ml", "old"), candidate("ml", "thin"),
+       candidate("ml", "edge"), candidate("ml", "ghost")},
+      cfg, 7);
+  TP_CHECK_EQ(a.cycle, 7u);
+  TP_CHECK_EQ(a.pods.size(), 5u);
+  TP_CHECK_EQ(std::string(signal::verdict_name(a.pods[0].verdict)), std::string("healthy"));
+  TP_CHECK_EQ(std::string(signal::verdict_name(a.pods[1].verdict)), std::string("stale"));
+  TP_CHECK_EQ(std::string(signal::verdict_name(a.pods[2].verdict)), std::string("gappy"));
+  TP_CHECK_EQ(std::string(signal::verdict_name(a.pods[3].verdict)), std::string("healthy"));
+  TP_CHECK_EQ(std::string(signal::verdict_name(a.pods[4].verdict)), std::string("absent"));
+  // stale wins over gappy when both apply: freshness is the sharper fact
+  Value both = response_of({
+      evidence_row("ml", "p", "samples", 1), evidence_row("ml", "p", "age", 9999),
+  });
+  signal::Assessment b = signal::assess(both, {candidate("ml", "p")}, cfg, 1);
+  TP_CHECK(b.pods[0].verdict == signal::Verdict::Stale);
+}
+
+TP_TEST(signal_coverage_math_and_brownout) {
+  signal::Config cfg = default_cfg();
+  Value resp = response_of({
+      evidence_row("ml", "a", "samples", 60), evidence_row("ml", "a", "age", 1),
+  });
+  // 1 healthy of 2 → coverage 0.5 < 0.9 → brownout
+  signal::Assessment a =
+      signal::assess(resp, {candidate("ml", "a"), candidate("ml", "b")}, cfg, 1);
+  TP_CHECK_EQ(a.coverage_ratio, 0.5);
+  TP_CHECK(a.brownout);
+  TP_CHECK_EQ(a.count(signal::Verdict::Healthy), 1u);
+  TP_CHECK_EQ(a.count(signal::Verdict::Absent), 1u);
+  // empty candidate set: vacuous full coverage, never a brownout
+  signal::Assessment empty = signal::assess(resp, {}, cfg, 1);
+  TP_CHECK_EQ(empty.coverage_ratio, 1.0);
+  TP_CHECK(!empty.brownout);
+  // coverage exactly at the floor does not brown out (strict <)
+  cfg.min_coverage = 0.5;
+  signal::Assessment at_floor =
+      signal::assess(resp, {candidate("ml", "a"), candidate("ml", "b")}, cfg, 1);
+  TP_CHECK(!at_floor.brownout);
+}
+
+TP_TEST(signal_min_samples_floor_never_below_one) {
+  signal::Config cfg = default_cfg();
+  cfg.window_s = 10;  // scrape slower than the window → floor clamps to 1
+  cfg.scrape_interval_s = 60;
+  TP_CHECK_EQ(cfg.min_samples(), 1.0);
+}
+
+TP_TEST(signal_assessment_json_round_trip) {
+  signal::Config cfg = default_cfg();
+  Value resp = response_of({
+      evidence_row("ml", "a", "samples", 60), evidence_row("ml", "a", "age", 12),
+      evidence_row("ml", "b", "age", 5000),
+  });
+  signal::Assessment a = signal::assess(
+      resp, {candidate("ml", "a"), candidate("ml", "b"), candidate("ml", "c")}, cfg, 42);
+  signal::Assessment back = signal::assessment_from_json(signal::assessment_to_json(a));
+  TP_CHECK_EQ(back.cycle, a.cycle);
+  TP_CHECK_EQ(back.coverage_ratio, a.coverage_ratio);
+  TP_CHECK_EQ(back.brownout, a.brownout);
+  TP_CHECK_EQ(back.min_coverage, a.min_coverage);
+  TP_CHECK_EQ(back.pods.size(), a.pods.size());
+  for (size_t i = 0; i < a.pods.size(); ++i) {
+    TP_CHECK_EQ(back.pods[i].ns, a.pods[i].ns);
+    TP_CHECK_EQ(back.pods[i].pod, a.pods[i].pod);
+    TP_CHECK(back.pods[i].verdict == a.pods[i].verdict);
+    TP_CHECK_EQ(back.pods[i].has_samples, a.pods[i].has_samples);
+    TP_CHECK_EQ(back.pods[i].has_age, a.pods[i].has_age);
+    TP_CHECK_EQ(back.pods[i].sample_count, a.pods[i].sample_count);
+    TP_CHECK_EQ(back.pods[i].last_age_s, a.pods[i].last_age_s);
+  }
+  // the serialized dump is stable through a second round-trip
+  TP_CHECK_EQ(signal::assessment_to_json(back).dump(), signal::assessment_to_json(a).dump());
+}
+
+TP_TEST(signal_veto_reasons_and_details) {
+  signal::Config cfg = default_cfg();
+  signal::PodSignal p;
+  p.verdict = signal::Verdict::Stale;
+  p.last_age_s = 4000;
+  p.has_age = true;
+  TP_CHECK(signal::veto_reason(p.verdict) == tpupruner::audit::Reason::SignalStale);
+  TP_CHECK(signal::veto_detail(p, cfg).find("--signal-max-age=300") != std::string::npos);
+  p.verdict = signal::Verdict::Gappy;
+  TP_CHECK(signal::veto_reason(p.verdict) == tpupruner::audit::Reason::SignalGappy);
+  TP_CHECK(signal::veto_detail(p, cfg).find("--signal-scrape-interval=30") != std::string::npos);
+  p.verdict = signal::Verdict::Absent;
+  TP_CHECK(signal::veto_reason(p.verdict) == tpupruner::audit::Reason::SignalAbsent);
+  TP_CHECK(!signal::veto_detail(p, cfg).empty());
+
+  signal::Assessment a;
+  a.coverage_ratio = 0.25;
+  std::string why = signal::brownout_detail(a, cfg);
+  TP_CHECK(why.find("0.250") != std::string::npos);
+  TP_CHECK(why.find("--signal-min-coverage=0.900") != std::string::npos);
+}
+
+TP_TEST(signal_registry_publish_and_render) {
+  signal::reset_for_test();
+  TP_CHECK_EQ(signal::render_metrics(false), std::string(""));  // absent before publish
+  TP_CHECK(!signal::signals_json().find("enabled")->as_bool());
+
+  signal::Config cfg = default_cfg();
+  Value resp = response_of({
+      evidence_row("ml", "a", "samples", 60), evidence_row("ml", "a", "age", 10),
+  });
+  signal::Assessment healthy = signal::assess(resp, {candidate("ml", "a")}, cfg, 1);
+  signal::publish(healthy, cfg);
+  std::string body = signal::render_metrics(false);
+  TP_CHECK(body.find("tpu_pruner_signal_coverage_ratio 1\n") != std::string::npos);
+  TP_CHECK(body.find("tpu_pruner_signal_pods{verdict=\"healthy\"} 1") != std::string::npos);
+  TP_CHECK(body.find("tpu_pruner_signal_brownouts_total 0") != std::string::npos);
+  TP_CHECK(body.find("tpu_pruner_pod_signal_age_seconds_bucket{le=\"15\"} 1") !=
+           std::string::npos);
+
+  signal::Assessment browned =
+      signal::assess(resp, {candidate("ml", "a"), candidate("ml", "gone")}, cfg, 2);
+  TP_CHECK(browned.brownout);
+  signal::publish(browned, cfg);
+  signal::publish(browned, cfg);  // two browned-out cycles
+  body = signal::render_metrics(false);
+  TP_CHECK(body.find("tpu_pruner_signal_brownouts_total 2") != std::string::npos);
+  TP_CHECK(body.find("tpu_pruner_signal_pods{verdict=\"absent\"} 1") != std::string::npos);
+
+  // OpenMetrics negotiation strips _total from the counter TYPE line
+  std::string om = signal::render_metrics(true);
+  TP_CHECK(om.find("# TYPE tpu_pruner_signal_brownouts counter") != std::string::npos);
+
+  Value served = signal::signals_json();
+  TP_CHECK(served.find("enabled")->as_bool());
+  TP_CHECK_EQ(served.find("brownouts_total")->as_int(), 2);
+  TP_CHECK(served.at_path("thresholds.min_samples") != nullptr);
+  signal::reset_for_test();
+}
+
+TP_TEST(signal_evidence_query_covers_every_schema) {
+  query::QueryArgs gmp;
+  std::string q = query::build_evidence_query(gmp);
+  TP_CHECK(q.find("signal_stat") != std::string::npos);
+  TP_CHECK(q.find("count_over_time(tensorcore_utilization") != std::string::npos);
+  TP_CHECK(q.find("timestamp(tensorcore_duty_cycle") != std::string::npos);
+
+  query::QueryArgs gke;
+  gke.metric_schema = "gke-system";
+  gke.namespace_regex = "ml-.*";
+  std::string gq = query::build_evidence_query(gke);
+  TP_CHECK(gq.find("kubernetes_io:node_accelerator_tensorcore_utilization") !=
+           std::string::npos);
+  TP_CHECK(gq.find("> bool 0") != std::string::npos);  // join mask, not request_count×stat
+  TP_CHECK(gq.find("group_left") != std::string::npos);
+  TP_CHECK(gq.find("exported_namespace =~ \"ml-.*\"") != std::string::npos);
+
+  query::QueryArgs gpu;
+  gpu.device = "gpu";
+  std::string pq = query::build_evidence_query(gpu);
+  TP_CHECK(pq.find("DCGM_FI_PROF_GR_ENGINE_ACTIVE") != std::string::npos);
+
+  bool threw = false;
+  query::QueryArgs bad;
+  bad.metric_schema = "nope";
+  try {
+    query::build_evidence_query(bad);
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  TP_CHECK(threw);
+}
+
+TP_TEST(signal_reason_codes_registered) {
+  auto codes = tpupruner::audit::all_reason_codes();
+  for (const char* code :
+       {"SIGNAL_STALE", "SIGNAL_GAPPY", "SIGNAL_ABSENT", "SIGNAL_BROWNOUT"}) {
+    bool found = false;
+    for (const std::string& c : codes) {
+      if (c == code) found = true;
+    }
+    TP_CHECK(found);
+    TP_CHECK(tpupruner::audit::reason_from_name(code).has_value());
+  }
+}

@@ -84,6 +84,39 @@ def test_breaker_and_flight_surfaces_documented():
         f"{missing}")
 
 
+def test_signal_surfaces_documented(built):
+    """The signal-watchdog families come from the native canonical list
+    (like the ledger's) so a family added to signal.cpp without a runbook
+    row fails even when the serving test's daemon runs with the guard off
+    (the families are then deliberately absent from /metrics). The flags,
+    endpoint and tooling surfaces ride the same guard."""
+    doc = OPERATIONS.read_text()
+    families = native.signal_metric_families()
+    assert len(families) >= 4
+    missing = [f for f in families if f not in doc]
+    assert not missing, (
+        f"signal metric families missing from docs/OPERATIONS.md: {missing} "
+        "— document each in the Observability table and the 'When the "
+        "evidence goes dark' section")
+    needles = ("/debug/signals", "--signal-guard", "--signal-min-coverage",
+               "--signal-max-age", "--signal-scrape-interval",
+               "--signal-report", "querytest --evidence", "SIGNAL_BROWNOUT")
+    missing = [n for n in needles if n not in doc]
+    assert not missing, (
+        f"signal-watchdog surfaces missing from docs/OPERATIONS.md: {missing}")
+
+
+def test_signal_bench_summary_fields_documented():
+    """Signal-guard bench summary fields must be in BENCH_FIELDS.md AND
+    actually emitted by bench.py — a drift on either side fails."""
+    bench_src = (REPO / "bench.py").read_text()
+    fields_doc = (REPO / "docs" / "BENCH_FIELDS.md").read_text()
+    for field in ("signal_query_p50_ms", "signal_coverage_ratio"):
+        assert f'"{field}"' in bench_src, f"bench.py no longer emits {field}"
+        assert field in fields_doc, (
+            f"bench summary field {field} missing from docs/BENCH_FIELDS.md")
+
+
 def test_every_served_metric_documented(built):
     """Scrape the real daemon after a full scale-down cycle and check every
     family name on /metrics (histograms included) against OPERATIONS.md."""

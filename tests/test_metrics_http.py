@@ -65,13 +65,15 @@ class MetricsDaemon:
 
     def wait_for_cycle(self, timeout=30):
         """Block until the first full cycle (incl. the actuate drain) is on
-        /metrics — all five phase _counts present and equal."""
+        /metrics — all six phase _counts present and equal (the signal
+        phase observes ~0s every cycle even with --signal-guard off, so
+        the counts stay in lockstep)."""
         deadline = time.time() + timeout
         while time.time() < deadline:
             _, _, body = self.get("/metrics")
             counts = dict(re.findall(
                 r'tpu_pruner_cycle_phase_seconds_count\{phase="(\w+)"\} (\d+)', body))
-            if len(counts) == 5 and len(set(counts.values())) == 1 and "0" not in counts.values():
+            if len(counts) == 6 and len(set(counts.values())) == 1 and "0" not in counts.values():
                 return body
             time.sleep(0.2)
         raise AssertionError(f"phase histograms never converged:\n{body}")
@@ -141,7 +143,8 @@ def test_phase_counts_consistent_per_cycle(daemon):
     body = daemon.wait_for_cycle()
     counts = dict(re.findall(
         r'tpu_pruner_cycle_phase_seconds_count\{phase="(\w+)"\} (\d+)', body))
-    assert set(counts) == {"query", "decode", "resolve", "actuate", "total"}
+    assert set(counts) == {"query", "decode", "signal", "resolve", "actuate",
+                           "total"}
     assert len(set(counts.values())) == 1, counts
 
 

@@ -1,0 +1,402 @@
+"""Signal-quality watchdog tests (the observability tentpole).
+
+The pruner's core inference — "zero peak duty cycle over the lookback ⇒
+idle" — is indistinguishable from a dead scrape or an absent metric
+family. These tests drive the REAL daemon against the hermetic fakes
+with scripted evidence health (fake_prom's sample_count /
+last_sample_age knobs) and assert the guard matrix end to end:
+
+  - --signal-guard off is exact parity (stale evidence still scales down,
+    no evidence query is even issued) — the documented escape hatch;
+  - guard on + every pod stale ⇒ ZERO scale-downs, a
+    signal_brownouts_total increment, per-pod SIGNAL_STALE records, and
+    a flight capsule whose replay reproduces the verdicts bit-for-bit;
+  - per-pod stale / gappy / absent vetoes land their own reason codes
+    while a healthy sibling proceeds, and the workload ledger never
+    integrates idle-seconds from untrustworthy evidence;
+  - a fleet brownout defers even healthy-evidence scale-downs, and
+    `--what-if signal_min_coverage=...` flips them back (predicted);
+  - /debug/signals + the signal /metrics families serve the assessment
+    (and are ABSENT, not zero, with the guard off).
+"""
+
+import json
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+from tpu_pruner.testing.fake_prom import promql_structure_error
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def run_daemon(fake_prom, fake_k8s, *extra_args, cycles=2, run_mode="scale-down"):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", run_mode, "--daemon-mode", "--check-interval", "1",
+           "--max-cycles", str(cycles), *extra_args]
+    proc = subprocess.run(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    return proc
+
+
+def read_audit(path):
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+
+def analyze_replay(capsule, *what_if):
+    args = [sys.executable, "-m", "tpu_pruner.analyze", "--replay", str(capsule)]
+    if what_if:
+        args += ["--what-if", *what_if]
+    proc = subprocess.run(args, capture_output=True, text=True, timeout=120)
+    out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+    return proc.returncode, out, proc.stderr
+
+
+class SignalDaemon:
+    """Daemon-mode run with --metrics-port auto; port parsed from stderr."""
+
+    def __init__(self, fake_prom, fake_k8s, *extra_args):
+        cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+               "--run-mode", "scale-down", "--daemon-mode",
+               "--check-interval", "1", "--metrics-port", "auto", *extra_args]
+        self.proc = subprocess.Popen(
+            cmd, env={"KUBE_API_URL": fake_k8s.url},
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+        self.port = None
+        for line in self.proc.stderr:
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                self.port = int(m.group(1))
+                break
+        assert self.port, "daemon never reported its metrics port"
+
+    def get(self, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.port}{path}", timeout=5) as resp:
+            return resp.read().decode()
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+        self.proc.wait(timeout=10)
+
+
+def wait_until(predicate, timeout=30, interval=0.2):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            last = predicate()
+        except OSError:
+            last = None
+        if last:
+            return last
+        time.sleep(interval)
+    raise AssertionError(f"condition never held (last={last!r})")
+
+
+# ── the evidence query itself ──────────────────────────────────────────
+
+
+def test_evidence_query_shape_and_lint(built):
+    for args in ({"device": "tpu"},
+                 {"device": "tpu", "metric_schema": "gke-system",
+                  "namespace": "ml.*", "accelerator_type": "tpu-v5p-slice"},
+                 {"device": "gpu", "model_name": "NVIDIA A10G"}):
+        q = native.build_evidence_query(args)
+        assert promql_structure_error(q) is None, q
+        assert "signal_stat" in q
+        assert "count_over_time" in q
+        assert "timestamp(" in q
+
+
+# ── acceptance: parity with the guard off ──────────────────────────────
+
+
+def test_guard_off_is_exact_parity(built, fake_prom, fake_k8s, tmp_path):
+    """Stale evidence, guard OFF: the daemon trusts the zero-peak reading
+    and scales down — the documented pre-watchdog behavior — and never
+    even issues an evidence query."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=1,
+                                               tpu_chips=4)
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml",
+                                  last_sample_age=4000.0)
+    audit = tmp_path / "audit.jsonl"
+    run_daemon(fake_prom, fake_k8s, "--audit-log", str(audit), cycles=2)
+    assert len(fake_k8s.patches) == 2  # re-patched every cycle (parity)
+    assert fake_prom.evidence_queries_served == 0
+    assert len(fake_prom.queries) == 2  # one idle query per cycle, nothing else
+    assert {r["reason"] for r in read_audit(audit)} == {"SCALED"}
+
+
+# ── acceptance: every pod stale ⇒ brownout, zero scale-downs, replay ───
+
+
+def test_all_stale_brownout_zero_scaledowns_and_replay(built, tmp_path):
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    flight = tmp_path / "flight"
+    audit = tmp_path / "audit.jsonl"
+    try:
+        for i in range(2):
+            _, _, pods = k8s.add_deployment_chain("ml", f"dep-{i}", num_pods=1,
+                                                  tpu_chips=4)
+            prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml",
+                                     last_sample_age=4000.0)
+        d = SignalDaemon(prom, k8s, "--signal-guard", "on",
+                         "--flight-dir", str(flight),
+                         "--audit-log", str(audit))
+        try:
+            body = wait_until(lambda: (lambda b:
+                b if "tpu_pruner_signal_brownouts_total" in b else None)(
+                    d.get("/metrics")))
+            assert int(re.search(r"tpu_pruner_signal_brownouts_total (\d+)",
+                                 body).group(1)) >= 1
+            assert re.search(r"tpu_pruner_signal_coverage_ratio 0\b", body)
+            assert re.search(r'tpu_pruner_signal_pods\{verdict="stale"\} 2', body)
+
+            signals = json.loads(d.get("/debug/signals"))
+            assert signals["enabled"] is True
+            assert signals["brownout"] is True
+            assert signals["pods"]["stale"] == 2
+
+            decisions = json.loads(d.get("/debug/decisions"))["decisions"]
+            assert decisions and all(r["reason"] == "SIGNAL_STALE"
+                                     for r in decisions)
+        finally:
+            d.stop()
+        assert k8s.patches == []  # zero scale-downs across every cycle
+    finally:
+        prom.stop()
+        k8s.stop()
+
+    # the capsule replays the verdicts bit-for-bit, fakes already down
+    capsules = sorted(flight.glob("cycle-*.json"))
+    assert capsules
+    rc, out, err = analyze_replay(capsules[0])
+    assert rc == 0, err
+    assert out["match"] is True
+    assert {r["reason"] for r in out["replayed"]} == {"SIGNAL_STALE"}
+    assert out["actions"]["replayed_scale_downs"] == 0
+    capsule_doc = json.loads(capsules[0].read_text())
+    assert capsule_doc["signal"]["brownout"] is True
+    assert capsule_doc["evidence"]["body"] in prom.evidence_bodies
+
+
+# ── per-pod verdict matrix + ledger integration gate ───────────────────
+
+
+def test_stale_gappy_absent_vetoes_and_ledger_gate(built, fake_prom, fake_k8s,
+                                                   tmp_path):
+    """One pod per verdict; --signal-min-coverage 0.2 keeps the cycle out
+    of brownout (coverage 0.25), so the healthy pod proceeds while each
+    unhealthy pod gets its own reason code — and the ledger only ever
+    integrates idle-seconds for the healthy pod's root."""
+    scenarios = {
+        "healthy": {},
+        "stale": {"last_sample_age": 4000.0},
+        "gappy": {"sample_count": 3.0},
+        "absent": {"sample_count": None, "last_sample_age": None},
+    }
+    for name, knobs in scenarios.items():
+        _, _, pods = fake_k8s.add_deployment_chain("ml", name, num_pods=1,
+                                                   tpu_chips=4)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml", **knobs)
+    audit = tmp_path / "audit.jsonl"
+    ledger = tmp_path / "ledger.jsonl"
+    run_daemon(fake_prom, fake_k8s, "--signal-guard", "on",
+               "--signal-min-coverage", "0.2",
+               "--audit-log", str(audit), "--ledger-file", str(ledger),
+               cycles=3, run_mode="dry-run")
+
+    by_pod = {}
+    for r in read_audit(audit):
+        by_pod.setdefault(r["pod"], set()).add(r["reason"])
+    assert by_pod["healthy-abc123-0"] == {"DRY_RUN"}
+    assert by_pod["stale-abc123-0"] == {"SIGNAL_STALE"}
+    assert by_pod["gappy-abc123-0"] == {"SIGNAL_GAPPY"}
+    assert by_pod["absent-abc123-0"] == {"SIGNAL_ABSENT"}
+    details = {r["pod"]: r.get("detail", "") for r in read_audit(audit)}
+    assert "--signal-max-age" in details["stale-abc123-0"]
+    assert "--signal-scrape-interval" in details["gappy-abc123-0"]
+
+    # ledger gate: only the healthy pod's root has an account at all —
+    # vetoed pods never reach resolution, so no idle-seconds integrate
+    # from untrustworthy evidence
+    accounts = {json.loads(line)["name"]: json.loads(line)
+                for line in open(ledger) if line.strip()}
+    assert set(accounts) == {"healthy"}
+    assert accounts["healthy"]["idle_seconds"] > 0
+
+
+# ── brownout defers even healthy-evidence scale-downs ──────────────────
+
+
+def test_brownout_defers_healthy_pod_and_what_if_flips(built, tmp_path):
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    flight = tmp_path / "flight"
+    audit = tmp_path / "audit.jsonl"
+    try:
+        _, _, pods = k8s.add_deployment_chain("ml", "healthy", num_pods=1,
+                                              tpu_chips=4)
+        prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+        for i in range(3):
+            _, _, pods = k8s.add_deployment_chain("ml", f"stale-{i}",
+                                                  num_pods=1, tpu_chips=4)
+            prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml",
+                                     last_sample_age=4000.0)
+        # coverage 0.25 < 0.9 (default) → brownout every cycle
+        run_daemon(prom, k8s, "--signal-guard", "on",
+                   "--flight-dir", str(flight), "--audit-log", str(audit),
+                   cycles=2)
+        assert k8s.patches == []
+    finally:
+        prom.stop()
+        k8s.stop()
+
+    by_pod = {}
+    for r in read_audit(audit):
+        by_pod.setdefault(r["pod"], set()).add(r["reason"])
+    assert by_pod["healthy-abc123-0"] == {"SIGNAL_BROWNOUT"}
+    for i in range(3):
+        assert by_pod[f"stale-{i}-abc123-0"] == {"SIGNAL_STALE"}
+
+    capsules = sorted(flight.glob("cycle-*.json"))
+    rc, out, err = analyze_replay(capsules[0])
+    assert rc == 0, err
+    assert out["match"] is True
+
+    # lowering the coverage floor un-browns the cycle: the healthy pod
+    # flips to a predicted scale-down, the stale vetoes hold
+    rc, out, _ = analyze_replay(capsules[0], "signal_min_coverage=0.1")
+    assert rc == 0
+    flips = {f["pod"]: f for f in out["flips"]}
+    flip = flips["ml/healthy-abc123-0"]
+    assert flip["from"]["reason"] == "SIGNAL_BROWNOUT"
+    assert flip["to"]["reason"] == "SCALED"
+    assert flip["predicted"] is True
+    assert out["actions"]["replayed_scale_downs"] == 1
+    assert all(f["pod"] == "ml/healthy-abc123-0" for f in out["flips"])
+
+    # guard-off what-if: the brownout-held pod scales (predicted); the
+    # per-pod vetoes are held fixed (their cluster evidence was never
+    # captured — the capsule cannot re-derive what the guard never fetched)
+    rc, out, _ = analyze_replay(capsules[0], "signal_guard=off")
+    assert rc == 0
+    flips = {f["pod"]: f for f in out["flips"]}
+    assert flips["ml/healthy-abc123-0"]["to"]["reason"] == "SCALED"
+
+
+# ── serving surfaces: /debug/signals, /metrics families, parity off ────
+
+
+def test_debug_signals_and_metrics_families(built, fake_prom, fake_k8s):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=1,
+                                               tpu_chips=4)
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml",
+                                  last_sample_age=12.0)
+    d = SignalDaemon(fake_prom, fake_k8s, "--signal-guard", "on")
+    try:
+        routes = json.loads(d.get("/debug"))["routes"]
+        assert "/debug/signals" in {r["path"] for r in routes}
+
+        signals = wait_until(lambda: (lambda doc:
+            doc if doc.get("enabled") else None)(
+                json.loads(d.get("/debug/signals"))))
+        assert signals["coverage_ratio"] == 1.0
+        assert signals["brownout"] is False
+        assert signals["pods"]["healthy"] == 1
+        assert signals["thresholds"]["min_samples"] > 0
+
+        body = wait_until(lambda: (lambda b:
+            b if "tpu_pruner_signal_coverage_ratio" in b else None)(
+                d.get("/metrics")))
+        for family in native.signal_metric_families():
+            assert family in body, family
+        # the age histogram observed the scripted 12s age
+        assert re.search(
+            r'tpu_pruner_pod_signal_age_seconds_bucket\{le="15"\} [1-9]', body)
+        assert "tpu_pruner_signal_brownouts_total 0" in body
+    finally:
+        d.stop()
+
+
+def test_guard_off_serves_no_signal_families(built, fake_prom, fake_k8s):
+    """Absent, not zero: with the guard off the signal families would read
+    as 'no coverage, never brownouted' — so they are omitted entirely,
+    and /debug/signals says so."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    d = SignalDaemon(fake_prom, fake_k8s)
+    try:
+        wait_until(lambda: "tpu_pruner_query_successes" in d.get("/metrics"))
+        body = d.get("/metrics")
+        for family in native.signal_metric_families():
+            assert family not in body, family
+        signals = json.loads(d.get("/debug/signals"))
+        assert signals["enabled"] is False
+    finally:
+        d.stop()
+
+
+# ── analyze --signal-report ────────────────────────────────────────────
+
+
+def test_signal_report_from_capsule_and_live_url(built, fake_prom, fake_k8s,
+                                                 tmp_path):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=1,
+                                               tpu_chips=4)
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml",
+                                  last_sample_age=4000.0)
+    flight = tmp_path / "flight"
+    d = SignalDaemon(fake_prom, fake_k8s, "--signal-guard", "on",
+                     "--flight-dir", str(flight))
+    try:
+        wait_until(lambda: json.loads(d.get("/debug/signals")).get("enabled"))
+        # live endpoint (bare base URL is expanded to /debug/signals)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_pruner.analyze", "--signal-report",
+             f"http://127.0.0.1:{d.port}"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["pods"]["stale"] == 1
+        assert "stale" in proc.stderr
+        wait_until(lambda: sorted(flight.glob("cycle-*.json")))
+    finally:
+        d.stop()
+
+    capsule = sorted(flight.glob("cycle-*.json"))[0]
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_pruner.analyze", "--signal-report",
+         str(capsule)], capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["pods"]["stale"] == 1
+    assert doc["source"]["capsule"]
+    assert doc["thresholds"]["max_age_s"] == 300

@@ -56,6 +56,15 @@ capsule can't re-derive offline (veto sets, group all-idle verdicts,
 actuation results) are held fixed, and flips that newly reach actuation
 are marked predicted.
 
+Signal-health mode (`--signal-report <capsule.json|url>`): render the
+fleet's evidence health from the signal-quality watchdog (`--signal-guard
+on` on the daemon) — per-pod verdicts (healthy / stale / gappy / absent),
+the healthy-coverage ratio and whether the cycle browned out. The source
+is either a flight-recorder capsule (file or `/debug/cycles/<id>` URL,
+reading its stamped assessment) or the daemon's live `/debug/signals`
+endpoint (a bare `http://host:8080` is expanded). Human table on stderr,
+one JSON document on stdout.
+
 Incremental mode (`--stream STATE.npz`): successive invocations feed
 successive dumps (one per daemon cycle); the two-level sliding-window
 engine (engine.py streaming block) folds each dump's samples into a ring
@@ -360,6 +369,69 @@ def _run_replay(args) -> int:
     return 1
 
 
+def _run_signal_report(args) -> int:
+    """Fleet evidence-health report (the signal-watchdog consumer)."""
+    source = args.signal_report
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        url = source
+        if "/debug/" not in url:  # bare daemon base → the live endpoint
+            url = url.rstrip("/") + "/debug/signals"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = json.load(resp)
+    else:
+        with open(source) as f:
+            doc = json.load(f)
+
+    if "decisions" in doc or "prom" in doc:  # a flight-recorder capsule
+        sig = doc.get("signal")
+        if not sig:
+            print("capsule carries no signal assessment — the recorded cycle "
+                  "ran without --signal-guard on", file=sys.stderr)
+            return 1
+        cfg = doc.get("config", {})
+        sig.setdefault("thresholds", {
+            "scrape_interval_s": cfg.get("signal_scrape_interval_s"),
+            "max_age_s": cfg.get("signal_max_age_s"),
+            "min_coverage": cfg.get("signal_min_coverage"),
+        })
+        sig["source"] = {"capsule": doc.get("id"), "cycle": doc.get("cycle")}
+        doc = sig
+    if doc.get("enabled") is False:
+        print("signal watchdog not enabled on this daemon — run it with "
+              "--signal-guard on", file=sys.stderr)
+        return 1
+
+    details = doc.get("details", [])
+    counts = doc.get("pods") or {}
+    total = sum(counts.values()) if counts else len(details)
+    coverage = doc.get("coverage_ratio", 1.0)
+    print(f"evidence health (cycle {doc.get('cycle', '?')}): coverage "
+          f"{coverage:.3f} over {total} candidate pod(s)"
+          + ("   ** BROWNOUT — all scale-downs deferred **"
+             if doc.get("brownout") else ""), file=sys.stderr)
+    print("  " + "  ".join(f"{v}={counts.get(v, 0)}"
+                           for v in ("healthy", "stale", "gappy", "absent")),
+          file=sys.stderr)
+    unhealthy = [d for d in details if d.get("verdict") != "healthy"]
+    if unhealthy:
+        print(f"\n{'pod':48s} {'verdict':>8s} {'samples':>9s} {'age s':>9s}",
+              file=sys.stderr)
+        for d in unhealthy:
+            samples = d.get("sample_count")
+            age = d.get("last_age_s")
+            print(f"{d.get('namespace', '?') + '/' + d.get('pod', '?'):48s} "
+                  f"{d.get('verdict', '?'):>8s} "
+                  f"{'-' if samples is None else format(samples, '.0f'):>9s} "
+                  f"{'-' if age is None else format(age, '.0f'):>9s}",
+                  file=sys.stderr)
+    elif total:
+        print("every candidate's evidence is healthy", file=sys.stderr)
+    print(json.dumps(doc))
+    return 0
+
+
 def _load_workload_records(args) -> list[dict]:
     """Workload accounts from the ledger JSONL checkpoint or /debug/workloads."""
     if args.ledger_file:
@@ -503,8 +575,15 @@ def main(argv=None) -> int:
                         help="with --replay: re-decide under altered config "
                              "(lookback=10m, duration=45, grace=600, "
                              "run_mode=scale-down, enabled_resources=dr, "
-                             "max_scale_per_cycle=2, hbm_threshold=0.05) "
+                             "max_scale_per_cycle=2, hbm_threshold=0.05, "
+                             "signal_min_coverage=0.5, signal_guard=off) "
                              "and report which decisions flip")
+    parser.add_argument("--signal-report", metavar="SOURCE",
+                        help="signal-health mode: render the fleet's "
+                             "evidence health (per-pod verdicts, coverage, "
+                             "brownout) from a flight-recorder capsule file/"
+                             "URL or the daemon's /debug/signals endpoint "
+                             "(a bare http://host:port is expanded)")
     parser.add_argument("--lookback-s", type=float, default=None,
                         help="override lookback seconds (default: dump value or 2100)")
     parser.add_argument("--hbm-threshold", type=float, default=None,
@@ -530,6 +609,11 @@ def main(argv=None) -> int:
                         help="with --stream: discard STATE and start a fresh "
                              "window from this dump")
     args = parser.parse_args(argv)
+    if args.signal_report:
+        if args.replay or args.explain or args.fleet_report:
+            parser.error("--signal-report is mutually exclusive with "
+                         "--replay, --explain and --fleet-report")
+        return _run_signal_report(args)
     if args.replay:
         if args.explain or args.fleet_report:
             parser.error("--replay is mutually exclusive with --explain and "

@@ -146,6 +146,9 @@ def load() -> ctypes.CDLL:
     lib.tp_free.restype = None
     for fn in (
         "tp_build_query",
+        "tp_build_evidence_query",
+        "tp_signal_assess",
+        "tp_signal_metric_families",
         "tp_enabled_resources",
         "tp_decode_samples",
         "tp_generate_event",
@@ -193,6 +196,33 @@ def _call(name: str, payload) -> dict | list | str | int | float | None:
 def build_query(args: dict) -> str:
     """Render the idle-workload PromQL for the given CLI-style args."""
     return _call("tp_build_query", args)["query"]
+
+
+def build_evidence_query(args: dict) -> str:
+    """Render the signal watchdog's companion evidence PromQL (per-pod
+    sample coverage + last-sample age over the lookback window) for the
+    same CLI-style args ``build_query`` takes."""
+    return _call("tp_build_evidence_query", args)["query"]
+
+
+def signal_assess(response: dict, candidates: list[dict],
+                  config: dict | None = None) -> dict:
+    """Run the REAL signal-watchdog assessment (native/src/signal.cpp)
+    over a synthetic evidence response and candidate set. ``candidates``
+    is [{"namespace", "pod"}...]; ``config`` overrides
+    scrape_interval_s / max_age_s / min_coverage / window_s. Returns the
+    assessment JSON: coverage_ratio, brownout, per-verdict pod counts and
+    per-pod details."""
+    payload: dict = {"response": response, "candidates": candidates}
+    if config:
+        payload["config"] = config
+    return _call("tp_signal_assess", payload)
+
+
+def signal_metric_families() -> list[str]:
+    """Canonical signal-watchdog metric family names served on /metrics —
+    the docs drift-guard test joins this list against docs/OPERATIONS.md."""
+    return _call("tp_signal_metric_families", {})["families"]
 
 
 def enabled_resources(flags: str) -> list[str]:

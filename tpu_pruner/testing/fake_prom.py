@@ -56,6 +56,15 @@ class FakePrometheus:
         # add_scripted_pod_series)
         self.scripted_series: list[dict] = []
         self.instant_queries_served = 0  # advances the scripts, one per query
+        # signal-watchdog evidence: per-pod sample coverage / last-sample
+        # age served to the daemon's evidence query (detected by its
+        # synthetic signal_stat label). Keyed (namespace, pod); the knobs
+        # ride add_idle_pod_series / add_scripted_pod_series. Evidence
+        # queries have their own script index so a guard-on daemon's two
+        # queries per cycle don't double-advance the duty-cycle scripts.
+        self.evidence_series: dict[tuple, dict] = {}
+        self.evidence_queries_served = 0
+        self.evidence_bodies: list[str] = []  # verbatim evidence responses
         self.queries: list[str] = []
         # VERBATIM response body per successfully served instant query —
         # flight-recorder tests assert a capsule's recorded raw body is
@@ -87,8 +96,16 @@ class FakePrometheus:
         chips: int = 1,
         exported: bool = True,
         extra_labels: dict | None = None,
+        sample_count=1200.0,
+        last_sample_age=0.0,
     ) -> None:
-        """One series per chip, like real per-chip TPU metrics."""
+        """One series per chip, like real per-chip TPU metrics.
+
+        ``sample_count`` / ``last_sample_age`` script the pod's rows in
+        the signal watchdog's evidence query (see _register_evidence):
+        scalars repeat every cycle, lists advance one entry per evidence
+        query (last repeats), ``None`` omits that statistic's row, and
+        ``None`` for both models an ABSENT metric family."""
         prefix = "exported_" if exported else ""
         for chip in range(chips):
             labels = {
@@ -101,7 +118,39 @@ class FakePrometheus:
             }
             labels.update(extra_labels or {})
             self.series.append({"metric": labels, "value": [time.time(), str(value)]})
+        self._register_evidence(pod, namespace, exported, sample_count, last_sample_age)
         self._version += 1
+
+    def _register_evidence(self, pod, namespace, exported, sample_count,
+                           last_sample_age) -> None:
+        """Evidence-query rows for one pod: what the real query's
+        `sum by (pod, ns) (count_over_time(...))` / `time() - timestamp(...)`
+        would return, pre-aggregated (one "samples" + one "age" row)."""
+        prefix = "exported_" if exported else ""
+        self.evidence_series[(namespace, pod)] = {
+            "labels": {f"{prefix}pod": pod, f"{prefix}namespace": namespace},
+            "sample_count": sample_count,
+            "last_sample_age": last_sample_age,
+        }
+
+    def _evidence_result(self, idx: int) -> list[dict]:
+        def pick(v):
+            if isinstance(v, (list, tuple)):
+                return v[idx] if idx < len(v) else v[-1]
+            return v
+
+        now = time.time()
+        result = []
+        for ev in self.evidence_series.values():
+            count = pick(ev["sample_count"])
+            age = pick(ev["last_sample_age"])
+            if count is not None:
+                result.append({"metric": {**ev["labels"], "signal_stat": "samples"},
+                               "value": [now, str(count)]})
+            if age is not None:
+                result.append({"metric": {**ev["labels"], "signal_stat": "age"},
+                               "value": [now, str(age)]})
+        return result
 
     def add_idle_node_series(
         self,
@@ -148,6 +197,8 @@ class FakePrometheus:
         chips: int = 1,
         exported: bool = True,
         extra_labels: dict | None = None,
+        sample_count=1200.0,
+        last_sample_age=0.0,
     ) -> None:
         """Time-advancing duty-cycle series: `values[i]` scripts the i-th
         instant query this fake serves (i.e. the daemon's i-th cycle).
@@ -160,6 +211,14 @@ class FakePrometheus:
         exhausted, so tests don't have to predict exact cycle counts.
         Ledger integration tests drive idle→active→idle transitions with
         e.g. ``values=[0.0, None, 0.0]``.
+
+        ``sample_count`` / ``last_sample_age`` script the pod's evidence
+        rows (signal watchdog): scalars repeat, lists advance one entry
+        per EVIDENCE query (its own index — a guard-on daemon issues two
+        queries per cycle and the duty-cycle script must not
+        double-advance), ``None`` omits the row; both ``None`` models an
+        ABSENT metric family. Staleness/gap scenarios script e.g.
+        ``last_sample_age=[0.0, 4000.0]`` (healthy, then a dead scrape).
         """
         if not values:
             raise ValueError("scripted series needs at least one entry")
@@ -175,6 +234,7 @@ class FakePrometheus:
             }
             labels.update(extra_labels or {})
             self.scripted_series.append({"labels": labels, "values": list(values)})
+        self._register_evidence(pod, namespace, exported, sample_count, last_sample_age)
         self._version += 1
 
     def add_range_pod_series(
@@ -253,6 +313,26 @@ class FakePrometheus:
                             fake.fail_status,
                             {"status": "error", "errorType": "internal", "error": "injected"},
                         )
+                        return
+                    if "signal_stat" in query:
+                        # the signal watchdog's evidence query (its
+                        # synthetic label is the marker): serve the
+                        # per-pod coverage/age rows on the evidence
+                        # script's OWN index so duty-cycle scripts stay
+                        # cycle-aligned
+                        idx = fake.evidence_queries_served
+                        fake.evidence_queries_served += 1
+                        body = json.dumps({
+                            "status": "success",
+                            "data": {"resultType": "vector",
+                                     "result": fake._evidence_result(idx)},
+                        }).encode()
+                        fake.evidence_bodies.append(body.decode())
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
                         return
                     # serialize once per series-list version (large fleets);
                     # instant vectors exclude range-only series (no "value")
