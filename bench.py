@@ -368,7 +368,7 @@ def _phase_percentiles(metrics_body: str) -> dict:
 
     series: dict = {}
     for m in re.finditer(
-            r'tpu_pruner_cycle_phase_seconds_bucket\{phase="(\w+)",le="([^"]+)"\} (\d+)',
+            r'tpu_pruner_cycle_phase_seconds_bucket\{[^}]*phase="(\w+)",le="([^"]+)"\} (\d+)',
             metrics_body):
         series.setdefault(m.group(1), []).append(
             (float("inf") if m.group(2) == "+Inf" else float(m.group(2)),
@@ -550,7 +550,7 @@ def run_watch_cache_steady_state():
         # coverage gauge proves the watchdog judged the full fleet.
         signal_coverage = None
         if metrics_last:
-            m = _re.search(r"tpu_pruner_signal_coverage_ratio ([0-9.eE+-]+)",
+            m = _re.search(r"tpu_pruner_signal_coverage_ratio(?:\{[^}]*\})? ([0-9.eE+-]+)",
                            metrics_last[0])
             if m:
                 signal_coverage = float(m.group(1))
@@ -597,6 +597,75 @@ def run_watch_cache_steady_state():
     finally:
         k8s.stop()
         prom.stop()
+
+
+def run_fleet_federation():
+    """Federation-hub section: 3 real member daemons (distinct
+    --cluster-name identities) + the hub on a 1 s poll interval. The
+    number that matters at fleet scale is the hub's own merge latency —
+    polling every member and folding the fleet view — read back from its
+    `tpu_pruner_fleet_merge_seconds` histogram the same way the
+    watch-cache section reads the daemon's phase histograms."""
+    import re as _re
+    import tempfile
+    import time as _time
+
+    from tpu_pruner.testing.fake_fleet import FakeFleet
+
+    tmp = tempfile.mkdtemp(prefix="tp-bench-fleet-")
+    members = 3
+    with FakeFleet(tmp) as fleet:
+        for i in range(members):
+            fleet.add_member(f"bench-{i}", idle_pods=2)
+        fleet.start_hub(poll_interval=1)
+        deadline = _time.monotonic() + 60
+        body = ""
+        while _time.monotonic() < deadline:
+            body = fleet.hub_get("/metrics")
+            m = _re.search(
+                r"tpu_pruner_fleet_merge_seconds_count(?:\{[^}]*\})? (\d+)", body)
+            clusters = fleet.hub_get_json("/debug/fleet/clusters")
+            # several merge rounds with every member reachable, so the
+            # p50 reflects steady-state polling, not the first round
+            if (m and int(m.group(1)) >= 4 and clusters.get("members")
+                    and all(r["status"] == "OK"
+                            for r in clusters["members"])):
+                break
+            _time.sleep(0.3)
+        else:
+            raise RuntimeError("hub never reached 4 merge rounds with all "
+                               f"members OK:\n{body[-1500:]}")
+
+        buckets = []
+        for m in _re.finditer(
+                r'tpu_pruner_fleet_merge_seconds_bucket\{[^}]*le="([^"]+)"\} (\d+)',
+                body):
+            buckets.append((float("inf") if m.group(1) == "+Inf"
+                            else float(m.group(1)), int(m.group(2))))
+        total = buckets[-1][1]
+        rank = 0.5 * total
+        p50_ms = None
+        prev_b, prev_c = 0.0, 0
+        for b, c in buckets:
+            if c >= rank:
+                if b == float("inf") or c == prev_c:
+                    p50_ms = prev_b * 1000
+                else:
+                    p50_ms = (prev_b + (b - prev_b) * (rank - prev_c)
+                              / (c - prev_c)) * 1000
+                break
+            prev_b, prev_c = b, c
+        workloads = fleet.hub_get_json("/debug/fleet/workloads")
+        return {
+            "fleet_members": members,
+            "fleet_merge_p50_ms": round(p50_ms, 3) if p50_ms is not None else None,
+            "fleet_merge_rounds": total,
+            "fleet_tracked_total": workloads.get("tracked_total"),
+            "note": f"{members} single-pod-fixture members + hub on a 1s "
+                    "poll interval; merge p50 from the hub's own "
+                    "fleet_merge_seconds histogram (poll all members + "
+                    "aggregate)",
+        }
 
 
 def measure_fixture_ceiling(k8s, seconds=1.5, threads=8):
@@ -1420,6 +1489,18 @@ def main():
             f"{watch_cache['signal_query_p50_ms']:.1f}ms per cycle, coverage "
             f"{watch_cache.get('signal_coverage_ratio')}")
 
+    # Federation hub: 3 members + hub, merge latency from the hub's own
+    # histogram. Failures degrade to a recorded error, like the TPU tiers
+    # — the federation number is additive, not a gate on the headline.
+    try:
+        fleet_fed = run_fleet_federation()
+        log(f"fleet federation: {fleet_fed['fleet_members']} members merged, "
+            f"merge p50 {fleet_fed['fleet_merge_p50_ms']}ms over "
+            f"{fleet_fed['fleet_merge_rounds']} rounds")
+    except Exception as e:  # noqa: BLE001 — any fixture failure degrades
+        fleet_fed = {"error": str(e)[-500:]}
+        log(f"fleet federation section failed: {e}")
+
     # TPU fleet eval with spaced retries: now, +60s, +120s (only on failure).
     tpu = tpu_section([None] if SMOKE else [
         None,
@@ -1487,6 +1568,7 @@ def main():
         "self_reference_mode_same_kinds": self_ref_same,
         "circuit_breaker": breaker,
         "watch_cache": watch_cache,
+        "fleet_federation": fleet_fed,
         "baseline_model": {"ref_wall_s": round(ref_wall, 3),
                            "ref_resolve_s": round(ref_resolve, 3),
                            "ref_scale_s": round(ref_scale, 3),
@@ -1531,6 +1613,10 @@ def main():
         # coverage it judged ride the summary
         "signal_query_p50_ms": watch_cache.get("signal_query_p50_ms"),
         "signal_coverage_ratio": watch_cache.get("signal_coverage_ratio"),
+        # federation hub: members merged + the hub's own poll-and-merge
+        # round latency (tpu_pruner_fleet_merge_seconds p50)
+        "fleet_members": fleet_fed.get("fleet_members"),
+        "fleet_merge_p50_ms": fleet_fed.get("fleet_merge_p50_ms"),
         "spread_max": (round(max(RUN_SPREADS.values()), 3)
                        if RUN_SPREADS else None),
         "detail_file": detail_path.name,

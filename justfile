@@ -88,6 +88,14 @@ bench-smoke:
 replay-smoke:
     python -m tpu_pruner.testing.replay_smoke
 
+# fleet-federation smoke: 3 real member daemons (one browned out, one
+# killed mid-run) → hub → assert the merged report (totals sum,
+# per-cluster-minimum coverage, UNREACHABLE row) and the offline
+# 3-ledger merge. tests/test_justfile_guard.py pins the recipe to the
+# module it invokes.
+fleet-smoke:
+    python -m tpu_pruner.testing.fleet_smoke
+
 # standalone TPU capture: probe + fleet eval + bench_tpu_last_good.json
 # (run EARLY in a round / whenever the chip tunnel is up; exits 1 when no
 # real accelerator measurement happened)

@@ -73,6 +73,13 @@ struct Cli {
   int64_t scale_concurrency = 8;          // --scale-concurrency (ref: serial consumer)
   int metrics_port = -1;                  // --metrics-port: -1 disabled (flag "0" maps
                                           // here too), 0 ephemeral (flag "auto"), else port
+  // --cluster-name: fleet identity stamped on every exported surface (a
+  // `cluster` label on every /metrics sample, a "cluster" key in every
+  // /debug/* payload, DecisionRecord, ledger checkpoint line, and flight
+  // capsule). "" → fleet::resolve_cluster_name's heuristic
+  // ($TPU_PRUNER_CLUSTER_NAME, in-cluster namespace, $POD_NAMESPACE,
+  // kubeconfig current-context, "default").
+  std::string cluster_name;
   std::string audit_log;                  // --audit-log: JSONL DecisionRecord sink ("" = off)
   std::string ledger_file;                // --ledger-file: JSONL workload-ledger checkpoint ("" = off)
   int64_t ledger_top_k = 10;              // --ledger-top-k: /metrics workload label cardinality bound

@@ -1,0 +1,125 @@
+// Fleet federation: per-cluster identity, exposition stamping, and the
+// hub's merge math.
+//
+// One daemon prunes one cluster, but the north star is a fleet of them —
+// and every observability surface built so far (metrics, DecisionRecords,
+// the workload ledger, flight capsules, the /debug endpoints) was blind
+// to WHICH cluster it came from, so N ledgers could not merge and a
+// browned-out cluster could hide inside a fleet average. This module is
+// the federation layer's foundation, in three parts:
+//
+//   1. Identity: a process-wide cluster name (--cluster-name; default
+//      resolved by resolve_cluster_name's heuristic) that every exporter
+//      stamps — a `cluster` label on every /metrics sample line (the
+//      stamp_exposition choke point in metrics_http), a "cluster" key in
+//      every /debug/* JSON payload, every DecisionRecord, every ledger
+//      checkpoint line, and every flight capsule.
+//   2. Merge math: aggregate() folds N member snapshots (each member's
+//      /debug/{workloads,signals,decisions} documents plus reachability
+//      facts) into the fleet view — per-cluster sections, fleet totals
+//      that provably sum, per-cluster-MINIMUM coverage (never the mean:
+//      one cluster's dead scrapes must surface even when the fleet looks
+//      healthy), and explicit UNREACHABLE rows for members gone dark
+//      (never a silent drop from the average). Pure function — the
+//      native unit tier drives it directly.
+//   3. The hub shell (hub.cpp) polls members and serves the view at
+//      /debug/fleet/* plus tpu_pruner_fleet_* metric families.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::fleet {
+
+// ── cluster identity ──
+// Process-wide cluster name; "default" until set. Thread-safe.
+void set_cluster_name(const std::string& name);
+std::string cluster_name();
+
+// Resolution heuristic for the --cluster-name default, first hit wins:
+//   1. the flag value itself (non-empty),
+//   2. $TPU_PRUNER_CLUSTER_NAME,
+//   3. the in-cluster serviceaccount namespace file,
+//   4. $POD_NAMESPACE,
+//   5. the kubeconfig's `current-context:`,
+//   6. "default".
+std::string resolve_cluster_name(const std::string& flag_value);
+
+// ── exposition stamping (the metric-label drift guard's choke point) ──
+// Insert `cluster="<cluster>"` into the label set of EVERY sample line of
+// a Prometheus text exposition (comments and blank lines untouched;
+// lines already carrying a cluster label — the hub's per-member rows —
+// are left verbatim, so stamping is idempotent). Applied once, at the
+// serving boundary (metrics_http::render_exposition), so no renderer can
+// ship an unlabelled family.
+std::string stamp_exposition(const std::string& body, const std::string& cluster);
+
+// ── hub merge math ──
+// Everything the hub learned about one member daemon: the parsed /debug
+// documents from its last successful poll plus reachability facts.
+struct MemberSnapshot {
+  std::string url;          // member base URL (http://host:port)
+  std::string cluster;      // from the member's payloads; url fallback
+  bool reachable = false;   // the LAST poll round succeeded
+  bool ever_reached = false;
+  int64_t staleness_s = -1; // seconds since the last successful poll; -1 = never
+  std::string last_error;   // last poll failure ("" when none)
+  uint64_t polls = 0, failures = 0;
+  json::Value workloads;    // member /debug/workloads (null until first success)
+  json::Value signals;      // member /debug/signals
+  json::Value decisions;    // member /debug/decisions
+};
+
+// The four /debug/fleet/* documents plus the fleet metric families'
+// exposition text, derived from one poll round's snapshots.
+struct FleetView {
+  json::Value workloads;  // /debug/fleet/workloads
+  json::Value signals;    // /debug/fleet/signals
+  json::Value decisions;  // /debug/fleet/decisions
+  json::Value clusters;   // /debug/fleet/clusters
+  std::string metrics_text;        // classic exposition
+  std::string metrics_openmetrics; // OpenMetrics TYPE naming
+};
+
+// Member status for the clusters table and the metric rows:
+//   OK           reachable and fresh (staleness within stale_after_s)
+//   PENDING      never polled successfully, never failed (startup)
+//   UNREACHABLE  gone dark — failed polls, or last success too old
+// Semantics the view guarantees:
+//   - fleet workload totals = the SUM of every member's own last-known
+//     /debug/workloads totals (cached data from an unreachable member is
+//     kept and flagged, never silently dropped);
+//   - fleet coverage = the per-cluster MINIMUM: OK members with the
+//     signal guard on contribute their coverage_ratio, UNREACHABLE
+//     members contribute 0.0 (a dark cluster's evidence health is
+//     unknown, which is the opposite of healthy), guard-off members
+//     contribute nothing;
+//   - every member yields exactly one row in every document.
+FleetView aggregate(const std::vector<MemberSnapshot>& members, int64_t stale_after_s,
+                    size_t decisions_per_member = 100);
+
+// The tpu_pruner_fleet_* family names the hub serves (docs drift guard,
+// via capi — includes the fleet_merge_seconds histogram the hub's poll
+// loop observes through the log registry).
+std::vector<std::string> hub_metric_families();
+
+void reset_for_test();
+
+}  // namespace tpupruner::fleet
+
+namespace tpupruner::hub {
+
+// `tpu-pruner hub` entry point (hub.cpp): parse the hub flag surface
+// (--member, --metrics-port, --poll-interval, --stale-after,
+// --member-timeout-ms, --cluster-name, --log-format), poll every member's
+// /debug/{workloads,signals,decisions}, and serve the merged fleet view
+// (fleet::aggregate) at /debug/fleet/* plus tpu_pruner_fleet_* metric
+// families until SIGTERM/SIGINT. argv excludes the "hub" token. Returns
+// the process exit code (2 on flag errors).
+int run(int argc, char** argv);
+std::string usage();
+
+}  // namespace tpupruner::hub

@@ -16,6 +16,7 @@ namespace tpupruner::kubeconfig {
 struct Info {
   std::string server;  // first `server:` value
   std::string token;   // first `token:` value, or contents of `tokenFile:`
+  std::string current_context;  // `current-context:` value (cluster-name heuristic)
   bool tls_skip = false;
 };
 

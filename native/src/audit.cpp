@@ -7,6 +7,7 @@
 #include <mutex>
 #include <unordered_map>
 
+#include "tpupruner/fleet.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/util.hpp"
 
@@ -139,6 +140,10 @@ std::vector<std::string> all_reason_codes() {
 
 json::Value DecisionRecord::to_json() const {
   json::Value v = json::Value::object();
+  // Fleet identity: which cluster decided. Stamped at serialization time
+  // (the ring holds per-process records, so the process identity IS the
+  // record's); replay normalizes it out before bit-for-bit comparison.
+  v.set("cluster", json::Value(fleet::cluster_name()));
   v.set("cycle", json::Value(static_cast<int64_t>(cycle)));
   v.set("ts", json::Value(util::format_rfc3339(ts_unix)));
   v.set("namespace", json::Value(ns));
@@ -296,6 +301,7 @@ json::Value decisions_json(const std::string& query_string) {
     decisions.push_back(rec.to_json());
   }
   json::Value out = json::Value::object();
+  out.set("cluster", json::Value(fleet::cluster_name()));
   out.set("decisions", std::move(decisions));
   out.set("dropped", json::Value(static_cast<int64_t>(r.dropped)));
   out.set("capacity", json::Value(static_cast<int64_t>(r.capacity)));

@@ -14,6 +14,7 @@
 
 #include "otlp_grpc.hpp"
 #include "tpupruner/audit.hpp"
+#include "tpupruner/fleet.hpp"
 #include "tpupruner/recorder.hpp"
 #include "tpupruner/core.hpp"
 #include "tpupruner/informer.hpp"
@@ -478,6 +479,90 @@ char* tp_signal_metric_families(const char*) {
     }
     Value out = Value::object();
     out.set("families", std::move(families));
+    return ok(out);
+  });
+}
+
+char* tp_fleet_metric_families(const char*) {
+  // The canonical tpu_pruner_fleet_* family names the federation hub
+  // serves — the docs-drift test joins this against docs/OPERATIONS.md,
+  // like the ledger and signal families.
+  return guarded([&] {
+    Value families = Value::array();
+    for (const std::string& f : tpupruner::fleet::hub_metric_families()) {
+      families.push_back(Value(f));
+    }
+    Value out = Value::object();
+    out.set("families", std::move(families));
+    return ok(out);
+  });
+}
+
+char* tp_fleet_aggregate(const char* payload_json) {
+  // Deterministic harness for the hub's merge math (fleet::aggregate):
+  // the pytest tier drives the REAL aggregation over synthetic member
+  // snapshots. Payload:
+  //   {"members": [{"url","cluster","reachable","ever_reached",
+  //                 "staleness_s","polls","failures","last_error",
+  //                 "workloads","signals","decisions"}...],
+  //    "stale_after_s": N, "decisions_per_member": K?}
+  // Returns the four /debug/fleet documents plus both exposition renders.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    const Value* members = p.find("members");
+    if (!members || !members->is_array()) throw std::runtime_error("missing members");
+    std::vector<tpupruner::fleet::MemberSnapshot> snaps;
+    for (const Value& m : members->as_array()) {
+      tpupruner::fleet::MemberSnapshot s;
+      s.url = m.get_string("url");
+      s.cluster = m.get_string("cluster", s.url);
+      auto boolean = [&](const char* key) {
+        const Value* v = m.find(key);
+        return v && v->is_bool() && v->as_bool();
+      };
+      auto num = [&](const char* key, int64_t dflt) {
+        const Value* v = m.find(key);
+        return v && v->is_number() ? v->as_int() : dflt;
+      };
+      s.reachable = boolean("reachable");
+      s.ever_reached = boolean("ever_reached") || s.reachable;
+      s.staleness_s = num("staleness_s", s.ever_reached ? 0 : -1);
+      s.polls = static_cast<uint64_t>(num("polls", 1));
+      s.failures = static_cast<uint64_t>(num("failures", 0));
+      s.last_error = m.get_string("last_error");
+      if (const Value* v = m.find("workloads")) s.workloads = *v;
+      if (const Value* v = m.find("signals")) s.signals = *v;
+      if (const Value* v = m.find("decisions")) s.decisions = *v;
+      snaps.push_back(std::move(s));
+    }
+    int64_t stale_after = 30;
+    if (const Value* v = p.find("stale_after_s"); v && v->is_number())
+      stale_after = v->as_int();
+    size_t per_member = 100;
+    if (const Value* v = p.find("decisions_per_member"); v && v->is_number())
+      per_member = static_cast<size_t>(v->as_int());
+    tpupruner::fleet::FleetView view =
+        tpupruner::fleet::aggregate(snaps, stale_after, per_member);
+    Value out = Value::object();
+    out.set("workloads", std::move(view.workloads));
+    out.set("signals", std::move(view.signals));
+    out.set("decisions", std::move(view.decisions));
+    out.set("clusters", std::move(view.clusters));
+    out.set("metrics", Value(view.metrics_text));
+    out.set("metrics_openmetrics", Value(view.metrics_openmetrics));
+    return ok(out);
+  });
+}
+
+char* tp_stamp_exposition(const char* payload_json) {
+  // The cluster-label choke point (fleet::stamp_exposition), exposed so
+  // the pytest tier can assert the stamping contract (idempotence,
+  // histogram lines, exemplar suffixes) without a live daemon.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    Value out = Value::object();
+    out.set("body", Value(tpupruner::fleet::stamp_exposition(
+                        p.get_string("body"), p.get_string("cluster"))));
     return ok(out);
   });
 }

@@ -56,6 +56,8 @@ owner chain to the root scalable object, and non-destructively pauses it.
 USAGE:
   tpu-pruner [FLAGS]
   tpu-pruner querytest <promql> <prometheus-url>
+  tpu-pruner hub --member <url> [...]   (fleet federation hub; see
+                                         `tpu-pruner hub --help`)
 
 FLAGS:
   -t, --duration <MIN>          minutes of no activity required to prune [default: 30]
@@ -127,6 +129,15 @@ TPU FLAGS:
       --metrics-port <P>        serve Prometheus /metrics (+ /healthz, /readyz,
                                 and the /debug surfaces — /debug lists them)
                                 on this port (0 = disabled, "auto" = ephemeral)
+      --cluster-name <NAME>     fleet identity: stamped as a `cluster` label
+                                on every /metrics sample and a "cluster" key
+                                in every /debug payload, DecisionRecord,
+                                ledger checkpoint line and flight capsule, so
+                                N clusters' telemetry merges without guessing
+                                [default: $TPU_PRUNER_CLUSTER_NAME, the
+                                in-cluster serviceaccount namespace,
+                                $POD_NAMESPACE, the kubeconfig
+                                current-context, or "default"]
       --audit-log <FILE>        append one JSONL DecisionRecord per candidate
                                 pod per cycle (the /debug/decisions ring
                                 buffer, durable; consumed by
@@ -294,6 +305,7 @@ Cli parse(int argc, char** argv) {
          // default) so existing manifests don't start binding random ports.
          cli.metrics_port = port == 0 ? -1 : port;
        }},
+      {"--cluster-name", [&](const std::string& v) { cli.cluster_name = v; }},
       {"--audit-log", [&](const std::string& v) { cli.audit_log = v; }},
       {"--ledger-file", [&](const std::string& v) { cli.ledger_file = v; }},
       {"--ledger-top-k",

@@ -18,6 +18,7 @@
 #include "tpupruner/actuate.hpp"
 #include "tpupruner/audit.hpp"
 #include "tpupruner/auth.hpp"
+#include "tpupruner/fleet.hpp"
 #include "tpupruner/http.hpp"
 #include "tpupruner/leader.hpp"
 #include "tpupruner/ledger.hpp"
@@ -824,6 +825,15 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
 int run(const cli::Cli& args) {
   std::signal(SIGTERM, on_shutdown_signal);
   std::signal(SIGINT, on_shutdown_signal);
+
+  // Fleet identity first: every surface below (metrics exposition,
+  // DecisionRecords, ledger checkpoint lines, flight capsules, /debug
+  // payloads) stamps this cluster name, so it must be resolved before any
+  // of them initializes.
+  fleet::set_cluster_name(fleet::resolve_cluster_name(args.cluster_name));
+  log::info("daemon", "cluster identity: " + fleet::cluster_name() +
+            (args.cluster_name.empty() ? " (resolved; override with --cluster-name)"
+                                       : " (--cluster-name)"));
 
   core::ResourceSet enabled = core::parse_enabled_resources(args.enabled_resources);
   {

@@ -1,0 +1,381 @@
+#include "tpupruner/fleet.hpp"
+
+#include <algorithm>
+#include <cstdio>
+#include <mutex>
+
+#include "tpupruner/kubeconfig.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::fleet {
+
+using json::Value;
+
+namespace {
+
+std::mutex g_mutex;
+std::string g_cluster = "default";
+
+std::string fmt_value(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%.9g", v);
+  return buf;
+}
+
+double num_at(const Value& doc, const char* key, double dflt = 0.0) {
+  const Value* v = doc.find(key);
+  return v && v->is_number() ? v->as_double() : dflt;
+}
+
+const char* status_of(const MemberSnapshot& m, int64_t stale_after_s) {
+  if (m.polls == 0) return "PENDING";
+  if (m.reachable && (stale_after_s <= 0 || (m.staleness_s >= 0 && m.staleness_s <= stale_after_s)))
+    return "OK";
+  return "UNREACHABLE";
+}
+
+// OpenMetrics types counter families without the _total suffix (the same
+// convention ledger.cpp and signal.cpp follow).
+std::string family(const std::string& name, const char* type, const std::string& help,
+                   bool openmetrics) {
+  std::string fam = name;
+  if (openmetrics && std::string(type) == "counter" && fam.size() > 6 &&
+      fam.compare(fam.size() - 6, 6, "_total") == 0) {
+    fam = fam.substr(0, fam.size() - 6);
+  }
+  return "# HELP " + fam + " " + help + "\n# TYPE " + fam + " " + type + "\n";
+}
+
+std::string render_fleet_metrics(const std::vector<const MemberSnapshot*>& ordered,
+                                 int64_t stale_after_s, double coverage_min,
+                                 size_t unreachable, bool openmetrics) {
+  auto esc = [](const std::string& s) { return json::escape(s); };
+  std::string body;
+  body += family("tpu_pruner_fleet_members", "gauge",
+                 "Member daemons the fleet hub is configured to poll", openmetrics);
+  body += "tpu_pruner_fleet_members " + std::to_string(ordered.size()) + "\n";
+
+  body += family("tpu_pruner_fleet_members_unreachable", "gauge",
+                 "Members whose last polls failed or went stale (explicit UNREACHABLE "
+                 "rows, never dropped from the fleet view)", openmetrics);
+  body += "tpu_pruner_fleet_members_unreachable " + std::to_string(unreachable) + "\n";
+
+  body += family("tpu_pruner_fleet_coverage_ratio_min", "gauge",
+                 "Per-cluster MINIMUM signal coverage across the fleet (unreachable "
+                 "members count as 0) — never the mean, so one dark cluster cannot "
+                 "hide in a fleet average", openmetrics);
+  body += "tpu_pruner_fleet_coverage_ratio_min " + fmt_value(coverage_min) + "\n";
+
+  body += family("tpu_pruner_fleet_member_up", "gauge",
+                 "1 when the member's last poll succeeded and is fresh, else 0",
+                 openmetrics);
+  for (const MemberSnapshot* m : ordered) {
+    body += "tpu_pruner_fleet_member_up{cluster=\"" + esc(m->cluster) + "\"} " +
+            (std::string(status_of(*m, stale_after_s)) == "OK" ? "1" : "0") + "\n";
+  }
+
+  body += family("tpu_pruner_fleet_member_staleness_seconds", "gauge",
+                 "Seconds since the member was last polled successfully", openmetrics);
+  for (const MemberSnapshot* m : ordered) {
+    if (m->staleness_s < 0) continue;  // never reached: absent, not zero
+    body += "tpu_pruner_fleet_member_staleness_seconds{cluster=\"" + esc(m->cluster) +
+            "\"} " + std::to_string(m->staleness_s) + "\n";
+  }
+
+  body += family("tpu_pruner_fleet_coverage_ratio", "gauge",
+                 "Per-member signal coverage as last reported (members with the "
+                 "signal guard on only)", openmetrics);
+  for (const MemberSnapshot* m : ordered) {
+    const Value* enabled = m->signals.find("enabled");
+    if (!enabled || !enabled->is_bool() || !enabled->as_bool()) continue;
+    body += "tpu_pruner_fleet_coverage_ratio{cluster=\"" + esc(m->cluster) + "\"} " +
+            fmt_value(num_at(m->signals, "coverage_ratio", 1.0)) + "\n";
+  }
+
+  body += family("tpu_pruner_fleet_brownout", "gauge",
+                 "1 when the member last reported a signal brownout", openmetrics);
+  for (const MemberSnapshot* m : ordered) {
+    const Value* enabled = m->signals.find("enabled");
+    if (!enabled || !enabled->is_bool() || !enabled->as_bool()) continue;
+    const Value* b = m->signals.find("brownout");
+    body += "tpu_pruner_fleet_brownout{cluster=\"" + esc(m->cluster) + "\"} " +
+            ((b && b->is_bool() && b->as_bool()) ? "1" : "0") + "\n";
+  }
+
+  body += family("tpu_pruner_fleet_workloads_tracked", "gauge",
+                 "Workload accounts each member's utilization ledger tracks",
+                 openmetrics);
+  for (const MemberSnapshot* m : ordered) {
+    if (m->workloads.is_null()) continue;
+    body += "tpu_pruner_fleet_workloads_tracked{cluster=\"" + esc(m->cluster) + "\"} " +
+            std::to_string(static_cast<int64_t>(num_at(m->workloads, "tracked"))) + "\n";
+  }
+
+  auto totals_of = [](const MemberSnapshot& m) -> const Value* {
+    const Value* t = m.workloads.find("totals");
+    return t && t->is_object() ? t : nullptr;
+  };
+  body += family("tpu_pruner_fleet_idle_seconds_total", "counter",
+                 "Cumulative idle seconds per member cluster, from its workload "
+                 "ledger totals", openmetrics);
+  for (const MemberSnapshot* m : ordered) {
+    if (const Value* t = totals_of(*m)) {
+      body += "tpu_pruner_fleet_idle_seconds_total{cluster=\"" + esc(m->cluster) + "\"} " +
+              fmt_value(num_at(*t, "idle_seconds")) + "\n";
+    }
+  }
+  body += family("tpu_pruner_fleet_reclaimed_chip_seconds_total", "counter",
+                 "Cumulative reclaimed chip-seconds per member cluster, from its "
+                 "workload ledger totals", openmetrics);
+  for (const MemberSnapshot* m : ordered) {
+    if (const Value* t = totals_of(*m)) {
+      body += "tpu_pruner_fleet_reclaimed_chip_seconds_total{cluster=\"" +
+              esc(m->cluster) + "\"} " + fmt_value(num_at(*t, "reclaimed_chip_seconds")) +
+              "\n";
+    }
+  }
+  return body;
+}
+
+}  // namespace
+
+void set_cluster_name(const std::string& name) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  g_cluster = name.empty() ? "default" : name;
+}
+
+std::string cluster_name() {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  return g_cluster;
+}
+
+std::string resolve_cluster_name(const std::string& flag_value) {
+  if (!flag_value.empty()) return flag_value;
+  if (auto env = util::env("TPU_PRUNER_CLUSTER_NAME"); env && !env->empty()) return *env;
+  // In-cluster: the serviceaccount namespace is the best per-cluster-ish
+  // identity the pod can read without extra RBAC.
+  if (auto ns = util::read_file(
+          "/var/run/secrets/kubernetes.io/serviceaccount/namespace")) {
+    std::string t = util::trim(*ns);
+    if (!t.empty()) return t;
+  }
+  if (auto env = util::env("POD_NAMESPACE"); env && !env->empty()) return *env;
+  if (auto kc = kubeconfig::scan(); kc && !kc->current_context.empty()) {
+    return kc->current_context;
+  }
+  return "default";
+}
+
+std::string stamp_exposition(const std::string& body, const std::string& cluster) {
+  if (cluster.empty()) return body;
+  const std::string label = "cluster=\"" + json::escape(cluster) + "\"";
+  std::string out;
+  out.reserve(body.size() + (label.size() + 3) * 64);
+  size_t pos = 0;
+  while (pos < body.size()) {
+    size_t eol = body.find('\n', pos);
+    bool had_nl = eol != std::string::npos;
+    std::string_view line(body.data() + pos,
+                          (had_nl ? eol : body.size()) - pos);
+    pos = had_nl ? eol + 1 : body.size();
+
+    if (line.empty() || line[0] == '#') {
+      out.append(line);
+    } else {
+      size_t brace = line.find('{');
+      size_t space = line.find(' ');
+      if (brace != std::string_view::npos &&
+          (space == std::string_view::npos || brace < space)) {
+        // Labelled sample. Already cluster-stamped (hub per-member rows)
+        // → verbatim; else the label lands FIRST in the set.
+        size_t close = line.find('}', brace);
+        std::string_view labels =
+            close == std::string_view::npos ? std::string_view{}
+                                            : line.substr(brace + 1, close - brace - 1);
+        if (labels.find("cluster=\"") != std::string_view::npos) {
+          out.append(line);
+        } else {
+          out.append(line.substr(0, brace + 1));
+          out += label;
+          if (!labels.empty()) out += ',';
+          out.append(line.substr(brace + 1));
+        }
+      } else if (space != std::string_view::npos) {
+        out.append(line.substr(0, space));
+        out += '{';
+        out += label;
+        out += '}';
+        out.append(line.substr(space));
+      } else {
+        out.append(line);  // malformed line: leave it alone
+      }
+    }
+    if (had_nl) out += '\n';
+  }
+  return out;
+}
+
+FleetView aggregate(const std::vector<MemberSnapshot>& members, int64_t stale_after_s,
+                    size_t decisions_per_member) {
+  // Deterministic member order: by cluster name, then URL — merged
+  // documents and summed totals are a function of the snapshots alone.
+  std::vector<const MemberSnapshot*> ordered;
+  ordered.reserve(members.size());
+  for (const MemberSnapshot& m : members) ordered.push_back(&m);
+  std::stable_sort(ordered.begin(), ordered.end(),
+                   [](const MemberSnapshot* a, const MemberSnapshot* b) {
+                     if (a->cluster != b->cluster) return a->cluster < b->cluster;
+                     return a->url < b->url;
+                   });
+
+  FleetView view;
+  size_t unreachable = 0;
+
+  // ── workloads: per-cluster sections + fleet totals that provably sum ──
+  Value wl_clusters = Value::array();
+  double fleet_idle = 0, fleet_active = 0, fleet_reclaimed = 0;
+  int64_t fleet_tracked = 0;
+  for (const MemberSnapshot* m : ordered) {
+    const char* status = status_of(*m, stale_after_s);
+    if (std::string(status) == "UNREACHABLE") ++unreachable;
+    Value row = Value::object();
+    row.set("cluster", Value(m->cluster));
+    row.set("member", Value(m->url));
+    row.set("status", Value(std::string(status)));
+    if (!m->workloads.is_null()) {
+      // Last-known data from a dark member is kept (flagged by status),
+      // never silently dropped — its savings are real even if its daemon
+      // is not answering right now.
+      row.set("tracked", Value(static_cast<int64_t>(num_at(m->workloads, "tracked"))));
+      if (const Value* t = m->workloads.find("totals"); t && t->is_object()) {
+        fleet_idle += num_at(*t, "idle_seconds");
+        fleet_active += num_at(*t, "active_seconds");
+        fleet_reclaimed += num_at(*t, "reclaimed_chip_seconds");
+        row.set("totals", *t);
+      }
+      fleet_tracked += static_cast<int64_t>(num_at(m->workloads, "tracked"));
+      if (const Value* w = m->workloads.find("workloads"); w && w->is_array()) {
+        row.set("workloads", *w);
+      }
+      if (const Value* e = m->workloads.find("epoch"); e && e->is_number()) {
+        row.set("epoch", *e);
+      }
+    }
+    wl_clusters.push_back(std::move(row));
+  }
+  Value fleet_totals = Value::object();
+  fleet_totals.set("idle_seconds", Value(fleet_idle));
+  fleet_totals.set("active_seconds", Value(fleet_active));
+  fleet_totals.set("reclaimed_chip_seconds", Value(fleet_reclaimed));
+  view.workloads = Value::object();
+  view.workloads.set("members", Value(static_cast<int64_t>(ordered.size())));
+  view.workloads.set("clusters", std::move(wl_clusters));
+  view.workloads.set("fleet_totals", std::move(fleet_totals));
+  view.workloads.set("tracked_total", Value(fleet_tracked));
+
+  // ── signals: per-cluster minimum coverage + named brownout clusters ──
+  Value sig_clusters = Value::array();
+  Value brownout_clusters = Value::array();
+  Value unreachable_clusters = Value::array();
+  double coverage_min = 1.0;
+  bool any_contribution = false;
+  for (const MemberSnapshot* m : ordered) {
+    const char* status = status_of(*m, stale_after_s);
+    Value row = Value::object();
+    row.set("cluster", Value(m->cluster));
+    row.set("status", Value(std::string(status)));
+    bool enabled = false;
+    if (const Value* e = m->signals.find("enabled"); e && e->is_bool()) {
+      enabled = e->as_bool();
+    }
+    row.set("enabled", Value(enabled));
+    if (std::string(status) == "UNREACHABLE") {
+      // A dark cluster's evidence health is unknown — the opposite of
+      // healthy. It pins the fleet minimum to 0 and is named, so it can
+      // never hide inside an average of its healthy peers.
+      coverage_min = 0.0;
+      any_contribution = true;
+      unreachable_clusters.push_back(Value(m->cluster));
+    } else if (enabled) {
+      double ratio = num_at(m->signals, "coverage_ratio", 1.0);
+      coverage_min = std::min(coverage_min, ratio);
+      any_contribution = true;
+      row.set("coverage_ratio", Value(ratio));
+      const Value* b = m->signals.find("brownout");
+      bool brownout = b && b->is_bool() && b->as_bool();
+      row.set("brownout", Value(brownout));
+      if (brownout) brownout_clusters.push_back(Value(m->cluster));
+      if (const Value* pods = m->signals.find("pods"); pods && pods->is_object()) {
+        row.set("pods", *pods);
+      }
+    }
+    sig_clusters.push_back(std::move(row));
+  }
+  if (!any_contribution) coverage_min = 1.0;
+  view.signals = Value::object();
+  view.signals.set("coverage_min", Value(coverage_min));
+  view.signals.set("brownout_clusters", std::move(brownout_clusters));
+  view.signals.set("unreachable_clusters", std::move(unreachable_clusters));
+  view.signals.set("clusters", std::move(sig_clusters));
+
+  // ── decisions: last K per member, per-cluster sections ──
+  Value dec_clusters = Value::array();
+  for (const MemberSnapshot* m : ordered) {
+    Value row = Value::object();
+    row.set("cluster", Value(m->cluster));
+    row.set("status", Value(std::string(status_of(*m, stale_after_s))));
+    Value decisions = Value::array();
+    if (const Value* d = m->decisions.find("decisions"); d && d->is_array()) {
+      const auto& arr = d->as_array();
+      size_t start = arr.size() > decisions_per_member ? arr.size() - decisions_per_member : 0;
+      for (size_t i = start; i < arr.size(); ++i) decisions.push_back(arr[i]);
+    }
+    row.set("decisions", std::move(decisions));
+    dec_clusters.push_back(std::move(row));
+  }
+  view.decisions = Value::object();
+  view.decisions.set("clusters", std::move(dec_clusters));
+
+  // ── clusters: the member status table ──
+  Value member_rows = Value::array();
+  for (const MemberSnapshot* m : ordered) {
+    Value row = Value::object();
+    row.set("member", Value(m->url));
+    row.set("cluster", Value(m->cluster));
+    row.set("status", Value(std::string(status_of(*m, stale_after_s))));
+    if (m->staleness_s >= 0) row.set("last_success_age_s", Value(m->staleness_s));
+    row.set("polls", Value(static_cast<int64_t>(m->polls)));
+    row.set("failures", Value(static_cast<int64_t>(m->failures)));
+    if (!m->last_error.empty()) row.set("last_error", Value(m->last_error));
+    member_rows.push_back(std::move(row));
+  }
+  view.clusters = Value::object();
+  view.clusters.set("members", std::move(member_rows));
+  view.clusters.set("unreachable", Value(static_cast<int64_t>(unreachable)));
+
+  view.metrics_text =
+      render_fleet_metrics(ordered, stale_after_s, coverage_min, unreachable, false);
+  view.metrics_openmetrics =
+      render_fleet_metrics(ordered, stale_after_s, coverage_min, unreachable, true);
+  return view;
+}
+
+std::vector<std::string> hub_metric_families() {
+  return {
+      "tpu_pruner_fleet_members",
+      "tpu_pruner_fleet_members_unreachable",
+      "tpu_pruner_fleet_coverage_ratio_min",
+      "tpu_pruner_fleet_member_up",
+      "tpu_pruner_fleet_member_staleness_seconds",
+      "tpu_pruner_fleet_coverage_ratio",
+      "tpu_pruner_fleet_brownout",
+      "tpu_pruner_fleet_workloads_tracked",
+      "tpu_pruner_fleet_idle_seconds_total",
+      "tpu_pruner_fleet_reclaimed_chip_seconds_total",
+      "tpu_pruner_fleet_merge_seconds",
+  };
+}
+
+void reset_for_test() { set_cluster_name("default"); }
+
+}  // namespace tpupruner::fleet

@@ -1,0 +1,315 @@
+// Fleet federation hub: `tpu-pruner hub --member <url> [--member <url>...]`.
+//
+// One daemon per cluster, one hub per fleet. The hub polls each member
+// daemon's metrics port (/debug/workloads, /debug/signals,
+// /debug/decisions) on --poll-interval, folds the snapshots through
+// fleet::aggregate into the merged fleet view, and serves it on its own
+// metrics port:
+//
+//   /debug/fleet/workloads   per-cluster ledger sections + fleet totals
+//   /debug/fleet/signals     per-cluster-MINIMUM coverage, named brownout
+//                            and unreachable clusters
+//   /debug/fleet/decisions   recent DecisionRecords per member cluster
+//   /debug/fleet/clusters    member status table (OK/PENDING/UNREACHABLE)
+//   /metrics                 tpu_pruner_fleet_* families + the
+//                            fleet_merge_seconds poll-round histogram
+//
+// A member going dark becomes an explicit UNREACHABLE row (and pins the
+// fleet coverage minimum to 0) rather than silently dropping out of an
+// average; its last-known ledger data is kept, flagged by status.
+// /readyz fails until at least one member has been polled successfully —
+// a hub that has never seen a member has no fleet view to serve.
+#include <chrono>
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "metrics_http.hpp"
+#include "tpupruner/fleet.hpp"
+#include "tpupruner/http.hpp"
+#include "tpupruner/json.hpp"
+#include "tpupruner/log.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::hub {
+
+namespace {
+
+struct Options {
+  std::vector<std::string> members;
+  int metrics_port = 8080;  // 0 = ephemeral ("auto")
+  int64_t poll_interval_s = 10;
+  int64_t stale_after_s = 0;  // 0 → 3 × poll interval
+  int64_t member_timeout_ms = 5000;
+  std::string cluster_name;  // hub's own identity ("" → heuristic)
+  std::string log_format = "default";
+};
+
+struct FlagError : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+// Per-member poll state: the fleet::MemberSnapshot facts plus the
+// monotonic clock of the last success (staleness is derived per round).
+struct MemberState {
+  fleet::MemberSnapshot snap;
+  int64_t last_success_mono = -1;
+};
+
+std::atomic<int>& g_shutdown = util::shutdown_flag();
+
+extern "C" void on_hub_signal(int signum) {
+  g_shutdown = signum;
+  std::signal(signum, SIG_DFL);  // graceful once, lethal twice
+}
+
+int64_t parse_int(const std::string& flag, const std::string& v) {
+  try {
+    size_t idx = 0;
+    int64_t out = std::stoll(v, &idx);
+    if (idx != v.size()) throw std::invalid_argument("trailing");
+    return out;
+  } catch (const std::exception&) {
+    throw FlagError("invalid integer for " + flag + ": '" + v + "'");
+  }
+}
+
+Options parse(int argc, char** argv) {
+  Options opt;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto value = [&]() -> std::string {
+      if (i + 1 >= argc) throw FlagError(arg + " requires a value");
+      return argv[++i];
+    };
+    if (arg == "--member") {
+      std::string url = value();
+      while (!url.empty() && url.back() == '/') url.pop_back();
+      if (!util::starts_with(url, "http://") && !util::starts_with(url, "https://")) {
+        url = "http://" + url;  // bare host:port convenience
+      }
+      opt.members.push_back(std::move(url));
+    } else if (arg == "--metrics-port") {
+      std::string v = value();
+      if (v == "auto") {
+        opt.metrics_port = 0;
+      } else {
+        int64_t port = parse_int("--metrics-port", v);
+        if (port < 1 || port > 65535) throw FlagError("--metrics-port out of range");
+        opt.metrics_port = static_cast<int>(port);
+      }
+    } else if (arg == "--poll-interval") {
+      opt.poll_interval_s = parse_int("--poll-interval", value());
+      if (opt.poll_interval_s < 1) throw FlagError("--poll-interval must be >= 1 second");
+    } else if (arg == "--stale-after") {
+      opt.stale_after_s = parse_int("--stale-after", value());
+      if (opt.stale_after_s < 1) throw FlagError("--stale-after must be >= 1 second");
+    } else if (arg == "--member-timeout-ms") {
+      opt.member_timeout_ms = parse_int("--member-timeout-ms", value());
+      if (opt.member_timeout_ms < 1) throw FlagError("--member-timeout-ms must be >= 1");
+    } else if (arg == "--cluster-name") {
+      opt.cluster_name = value();
+    } else if (arg == "--log-format") {
+      opt.log_format = value();
+      if (opt.log_format != "default" && opt.log_format != "json" &&
+          opt.log_format != "pretty") {
+        throw FlagError("invalid value for --log-format: '" + opt.log_format + "'");
+      }
+    } else {
+      throw FlagError("unknown hub flag: " + arg + " (see tpu-pruner hub --help)");
+    }
+  }
+  if (opt.members.empty()) {
+    throw FlagError("tpu-pruner hub needs at least one --member <url> (see --help)");
+  }
+  if (opt.stale_after_s == 0) opt.stale_after_s = 3 * opt.poll_interval_s;
+  return opt;
+}
+
+// One member poll: the three /debug documents, all-or-nothing. Throws a
+// descriptive error on any transport/HTTP/parse failure.
+void poll_member(const http::Client& client, const Options& opt, MemberState& m) {
+  auto fetch = [&](const char* path) {
+    http::Request req;
+    req.url = m.snap.url + path;
+    req.timeout_ms = static_cast<int>(opt.member_timeout_ms);
+    http::Response resp = client.request(req);
+    if (resp.status != 200) {
+      throw std::runtime_error(std::string(path) + " returned HTTP " +
+                               std::to_string(resp.status));
+    }
+    return json::Value::parse(resp.body);
+  };
+  json::Value workloads = fetch("/debug/workloads");
+  json::Value signals = fetch("/debug/signals");
+  json::Value decisions = fetch("/debug/decisions");
+  m.snap.workloads = std::move(workloads);
+  m.snap.signals = std::move(signals);
+  m.snap.decisions = std::move(decisions);
+  // Every member payload is cluster-stamped; keep the last known name so
+  // an UNREACHABLE row still says WHICH cluster went dark.
+  std::string cluster = m.snap.workloads.get_string("cluster");
+  if (cluster.empty()) cluster = m.snap.signals.get_string("cluster");
+  if (!cluster.empty()) m.snap.cluster = cluster;
+}
+
+}  // namespace
+
+std::string usage() {
+  return R"(tpu-pruner hub — fleet federation hub
+
+Polls N member daemons' metrics ports and serves the merged fleet view:
+per-cluster workload ledgers with fleet totals that provably sum,
+per-cluster-MINIMUM signal coverage (a browned-out or unreachable cluster
+can never hide in a fleet average), recent decisions per cluster, and a
+member status table with explicit UNREACHABLE rows.
+
+USAGE:
+  tpu-pruner hub --member <url> [--member <url> ...] [FLAGS]
+
+FLAGS:
+      --member <URL>            a member daemon's metrics base URL
+                                (http://host:port); repeatable, >= 1 required
+      --metrics-port <P>        serve the fleet view on this port
+                                ("auto" = ephemeral, logged at startup)
+                                [default: 8080]
+      --poll-interval <SEC>     seconds between member poll rounds [default: 10]
+      --stale-after <SEC>       a member last polled successfully longer ago
+                                than this reads UNREACHABLE
+                                [default: 3x --poll-interval]
+      --member-timeout-ms <MS>  per-request member poll timeout [default: 5000]
+      --cluster-name <NAME>     the hub's own cluster identity (stamps its
+                                fleet-scoped metric rows; per-member rows keep
+                                their member's label) [default: heuristic —
+                                $TPU_PRUNER_CLUSTER_NAME, in-cluster namespace,
+                                kubeconfig current-context, "default"]
+      --log-format <F>          default | json | pretty [default: default]
+  -h, --help                    print this help
+)";
+}
+
+int run(int argc, char** argv) {
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "-h") == 0 || std::strcmp(argv[i], "--help") == 0) {
+      std::fprintf(stdout, "%s\n", usage().c_str());
+      return 0;
+    }
+  }
+  Options opt;
+  try {
+    opt = parse(argc, argv);
+  } catch (const FlagError& e) {
+    std::fprintf(stderr, "%s\n", e.what());
+    return 2;
+  }
+  log::init(opt.log_format == "json"
+                ? log::Format::Json
+                : opt.log_format == "pretty" ? log::Format::Pretty : log::Format::Default);
+  fleet::set_cluster_name(fleet::resolve_cluster_name(opt.cluster_name));
+  std::signal(SIGTERM, on_hub_signal);
+  std::signal(SIGINT, on_hub_signal);
+
+  std::vector<MemberState> members(opt.members.size());
+  for (size_t i = 0; i < opt.members.size(); ++i) {
+    members[i].snap.url = opt.members[i];
+    members[i].snap.cluster = opt.members[i];  // until the first payload names it
+  }
+  log::info("hub", "federating " + std::to_string(members.size()) + " member(s), poll every " +
+            std::to_string(opt.poll_interval_s) + "s, stale after " +
+            std::to_string(opt.stale_after_s) + "s");
+
+  std::mutex view_mutex;
+  // Latest merged view. Seeded from the unpolled snapshots so the fleet
+  // endpoints serve well-formed documents (every member PENDING) from
+  // the first request, not "{}" until a poll round lands.
+  fleet::FleetView view = [&] {
+    std::vector<fleet::MemberSnapshot> snaps;
+    for (const MemberState& m : members) snaps.push_back(m.snap);
+    return fleet::aggregate(snaps, opt.stale_after_s);
+  }();
+  bool ever_synced = false;
+  auto last_round = std::make_shared<std::atomic<int64_t>>(util::mono_secs());
+
+  metrics_http::Server server(opt.metrics_port);
+  server.set_fleet_provider([&](const std::string& sub, const std::string&) -> std::string {
+    std::lock_guard<std::mutex> lock(view_mutex);
+    if (sub == "workloads") return view.workloads.is_null() ? "{}" : view.workloads.dump();
+    if (sub == "signals") return view.signals.is_null() ? "{}" : view.signals.dump();
+    if (sub == "decisions") return view.decisions.is_null() ? "{}" : view.decisions.dump();
+    if (sub == "clusters" || sub.empty())
+      return view.clusters.is_null() ? "{}" : view.clusters.dump();
+    return "";
+  });
+  server.set_extra_metrics_provider([&](bool openmetrics) {
+    std::lock_guard<std::mutex> lock(view_mutex);
+    return openmetrics ? view.metrics_openmetrics : view.metrics_text;
+  });
+  // Ready = member sync happened: at least one member answered a full
+  // poll at least once. A hub that never reached anyone has no fleet
+  // view and must not pass readiness.
+  server.set_ready_probe([&] {
+    std::lock_guard<std::mutex> lock(view_mutex);
+    return ever_synced;
+  });
+  // Alive = the poll loop keeps rounding (3 intervals of slack, floor 60s
+  // — same shape as the daemon's cycle-staleness probe).
+  const int64_t stalled_after = std::max<int64_t>(3 * opt.poll_interval_s, 60);
+  server.set_health_probe([last_round, stalled_after] {
+    return util::mono_secs() - last_round->load() <= stalled_after;
+  });
+
+  http::Client client;
+  while (!g_shutdown.load()) {
+    auto round_start = std::chrono::steady_clock::now();
+    for (MemberState& m : members) {
+      ++m.snap.polls;
+      try {
+        poll_member(client, opt, m);
+        m.snap.reachable = true;
+        m.snap.ever_reached = true;
+        m.snap.last_error.clear();
+        m.last_success_mono = util::mono_secs();
+      } catch (const std::exception& e) {
+        m.snap.reachable = false;
+        ++m.snap.failures;
+        m.snap.last_error = e.what();
+        log::warn("hub", "poll of " + m.snap.url + " (" + m.snap.cluster + ") failed: " +
+                  e.what());
+      }
+      m.snap.staleness_s =
+          m.last_success_mono < 0 ? -1 : util::mono_secs() - m.last_success_mono;
+    }
+    {
+      std::vector<fleet::MemberSnapshot> snaps;
+      snaps.reserve(members.size());
+      for (const MemberState& m : members) snaps.push_back(m.snap);
+      fleet::FleetView next = fleet::aggregate(snaps, opt.stale_after_s);
+      std::lock_guard<std::mutex> lock(view_mutex);
+      view = std::move(next);
+      for (const MemberState& m : members) {
+        if (m.snap.ever_reached) ever_synced = true;
+      }
+    }
+    double round_secs =
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - round_start).count();
+    log::histogram_observe("fleet_merge_seconds", "", round_secs);
+    last_round->store(util::mono_secs());
+
+    // Interruptible interval sleep (same idiom as the daemon loop).
+    auto interval = std::chrono::seconds(opt.poll_interval_s);
+    while (!g_shutdown.load() &&
+           std::chrono::steady_clock::now() - round_start < interval) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      last_round->store(util::mono_secs());  // sleeping != stalled
+    }
+  }
+  log::info("hub", std::string("Received ") +
+            (g_shutdown.load() == SIGINT ? "SIGINT" : "SIGTERM") + ", shutting down");
+  return 0;
+}
+
+}  // namespace tpupruner::hub

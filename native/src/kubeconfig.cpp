@@ -40,6 +40,9 @@ std::optional<Info> scan() {
         info.token = util::trim(*tf);
       }
     }
+    if (info.current_context.empty() && util::starts_with(line, "current-context:")) {
+      info.current_context = strip_quotes(util::trim(line.substr(16)));
+    }
     if (line == "insecure-skip-tls-verify: true") info.tls_skip = true;
   }
   if (info.server.empty()) return std::nullopt;

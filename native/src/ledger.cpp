@@ -8,6 +8,7 @@
 #include <mutex>
 #include <string>
 
+#include "tpupruner/fleet.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/util.hpp"
 
@@ -55,6 +56,12 @@ struct Registry {
   std::map<std::string, Account> accounts;  // key "Kind/ns/name"
   int64_t prev_cycle_unix = 0;  // 0 = no cycle integrated yet (fresh start)
   std::string file_path;
+  // Checkpoint epoch: increments on every checkpoint write and restores
+  // as the max of the loaded lines' epochs, so it is monotonic across
+  // restarts. Merge consumers (analyze --fleet-report over N ledgers)
+  // use it to pick the fresher of two checkpoints claiming the same
+  // cluster.
+  uint64_t epoch = 0;
 };
 
 Registry& reg() {
@@ -68,8 +75,14 @@ std::string key_of(const std::string& kind, const std::string& ns, const std::st
 
 double round3(double v) { return std::round(v * 1000.0) / 1000.0; }
 
-json::Value account_to_json(const std::string& key, const Account& a) {
+json::Value account_to_json(const std::string& key, const Account& a, uint64_t epoch) {
   json::Value v = json::Value::object();
+  // Merge-safe checkpoint schema (v2): cluster identity + monotonic epoch
+  // on EVERY line, so N clusters' JSONL checkpoints merge without
+  // guessing and a stale duplicate of one cluster loses deterministically.
+  v.set("schema", json::Value(static_cast<int64_t>(2)));
+  v.set("cluster", json::Value(fleet::cluster_name()));
+  v.set("epoch", json::Value(static_cast<int64_t>(epoch)));
   v.set("workload", json::Value(key));
   v.set("kind", json::Value(a.kind));
   v.set("namespace", json::Value(a.ns));
@@ -117,9 +130,10 @@ void checkpoint_locked(Registry& r) {
     r.file_path.clear();
     return;
   }
+  ++r.epoch;  // every rewrite advances the checkpoint epoch
   bool ok = true;
   for (const auto& [key, a] : r.accounts) {
-    std::string line = account_to_json(key, a).dump();
+    std::string line = account_to_json(key, a, r.epoch).dump();
     line += '\n';
     if (std::fwrite(line.data(), 1, line.size(), f) != line.size()) {
       ok = false;
@@ -160,6 +174,7 @@ void load_locked(Registry& r, const std::string& path) {
       const json::Value* x = v.find(k);
       return x && x->is_number() ? x->as_double() : 0.0;
     };
+    r.epoch = std::max(r.epoch, static_cast<uint64_t>(num("epoch")));
     a.chips = static_cast<int64_t>(num("chips"));
     a.idle_seconds = num("idle_seconds");
     a.active_seconds = num("active_seconds");
@@ -350,12 +365,15 @@ json::Value workloads_json(const std::string& query_string) {
   });
 
   json::Value workloads = json::Value::array();
-  for (const auto& [key, a] : rows) workloads.push_back(account_to_json(*key, *a));
+  for (const auto& [key, a] : rows) workloads.push_back(account_to_json(*key, *a, r.epoch));
   json::Value totals = json::Value::object();
   totals.set("idle_seconds", json::Value(round3(total_idle)));
   totals.set("active_seconds", json::Value(round3(total_active)));
   totals.set("reclaimed_chip_seconds", json::Value(round3(total_reclaimed)));
   json::Value out = json::Value::object();
+  out.set("schema", json::Value(static_cast<int64_t>(2)));
+  out.set("cluster", json::Value(fleet::cluster_name()));
+  out.set("epoch", json::Value(static_cast<int64_t>(r.epoch)));
   out.set("workloads", std::move(workloads));
   out.set("tracked", json::Value(static_cast<int64_t>(r.accounts.size())));
   out.set("totals", std::move(totals));
@@ -462,6 +480,7 @@ void reset_for_test() {
   r.accounts.clear();
   r.prev_cycle_unix = 0;
   r.file_path.clear();
+  r.epoch = 0;
 }
 
 }  // namespace tpupruner::ledger

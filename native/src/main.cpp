@@ -11,6 +11,7 @@
 #include "querytest.hpp"
 #include "tpupruner/cli.hpp"
 #include "tpupruner/daemon.hpp"
+#include "tpupruner/fleet.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/query.hpp"
 
@@ -28,6 +29,18 @@ int main(int argc, char** argv) {
                     std::strcmp(argv[1], "version") == 0)) {
     std::fprintf(stdout, "tpu-pruner %s (%s)\n", TP_VERSION, TP_GIT_REV);
     return 0;
+  }
+
+  if (argc >= 2 && std::strcmp(argv[1], "hub") == 0) {
+    // Fleet federation hub: poll N member daemons, serve the merged view
+    // (per-cluster ledgers, per-cluster-minimum coverage, UNREACHABLE
+    // rows) at /debug/fleet/* + tpu_pruner_fleet_* families.
+    try {
+      return hub::run(argc - 1, argv + 1);
+    } catch (const std::exception& e) {
+      std::fprintf(stderr, "hub: %s\n", e.what());
+      return 1;
+    }
   }
 
   if (argc >= 2 && std::strcmp(argv[1], "querytest") == 0) {

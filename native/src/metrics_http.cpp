@@ -11,6 +11,7 @@
 #include <string>
 #include <string_view>
 
+#include "tpupruner/fleet.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/util.hpp"
 
@@ -44,6 +45,8 @@ const std::map<std::string, std::string>& help_texts() {
       {"cycle_phase_seconds", "Per-cycle pipeline phase latency (phase label: "
                               "query, decode, signal, resolve, actuate, total)"},
       {"scale_patch_seconds", "Per-target actuation latency (Event POST + pause PATCH)"},
+      {"fleet_merge_seconds", "Hub poll round latency: polling every member and "
+                              "merging the fleet view (tpu-pruner hub)"},
   };
   return kHelp;
 }
@@ -57,6 +60,12 @@ std::string fmt_double(double v) {
   char buf[64];
   std::snprintf(buf, sizeof(buf), "%.9g", v);
   return buf;
+}
+
+// The /debug index stamps the serving cluster like every other /debug
+// payload (fleet identity drift guard).
+std::string json_escape_cluster() {
+  return tpupruner::json::escape(fleet::cluster_name());
 }
 
 }  // namespace
@@ -122,6 +131,12 @@ void Server::set_signals_provider(std::function<std::string()> provider) {
   signals_provider_ = std::move(provider);
 }
 
+void Server::set_fleet_provider(
+    std::function<std::string(const std::string&, const std::string&)> provider) {
+  std::lock_guard<std::mutex> lock(probe_mutex_);
+  fleet_provider_ = std::move(provider);
+}
+
 void Server::set_extra_metrics_provider(std::function<std::string(bool)> provider) {
   std::lock_guard<std::mutex> lock(probe_mutex_);
   extra_metrics_provider_ = std::move(provider);
@@ -175,6 +190,11 @@ std::string Server::render_exposition(bool openmetrics) const {
     extra = extra_metrics_provider_;
   }
   if (extra) body += extra(openmetrics);
+  // Fleet identity choke point: EVERY sample line leaves this process
+  // carrying a `cluster` label (tests/test_fleet.py asserts it), so no
+  // renderer — present or future — can ship an unlabelled family. Lines
+  // already stamped (the hub's per-member rows) pass through verbatim.
+  body = fleet::stamp_exposition(body, fleet::cluster_name());
   if (openmetrics) body += "# EOF\n";
   return body;
 }
@@ -299,6 +319,24 @@ void Server::serve() {
         status_text = "Not Found";
         body = "signal watchdog not available\n";
       }
+    } else if (path == "/debug/fleet" || util::starts_with(path, "/debug/fleet/")) {
+      std::function<std::string(const std::string&, const std::string&)> provider;
+      {
+        std::lock_guard<std::mutex> lock(probe_mutex_);
+        provider = fleet_provider_;
+      }
+      std::string sub =
+          path == "/debug/fleet" ? "" : path.substr(std::strlen("/debug/fleet/"));
+      std::string result = provider ? provider(sub, query) : "";
+      if (provider && !result.empty()) {
+        content_type = "application/json";
+        body = std::move(result);
+      } else {
+        status = 404;
+        status_text = "Not Found";
+        body = provider ? "no such fleet view (try workloads, signals, decisions, clusters)\n"
+                        : "fleet endpoints are served by the federation hub (tpu-pruner hub)\n";
+      }
     } else if (path == "/debug/cycles" || util::starts_with(path, "/debug/cycles/")) {
       std::function<std::string(const std::string&)> provider;
       {
@@ -322,7 +360,7 @@ void Server::serve() {
       // without reading docs. Served even when a provider is off — the
       // entries say which flag enables what.
       content_type = "application/json";
-      body = std::string("{\"routes\":[") +
+      body = std::string("{\"cluster\":\"") + json_escape_cluster() + "\",\"routes\":[" +
              "{\"path\":\"/metrics\",\"description\":\"Prometheus exposition (classic + "
              "OpenMetrics negotiation with trace exemplars)\"}," +
              "{\"path\":\"/healthz\",\"description\":\"liveness: the producer loop ticked "
@@ -336,7 +374,16 @@ void Server::serve() {
              "{\"path\":\"/debug/cycles\",\"description\":\"flight-recorder capsule index; "
              "/debug/cycles/<id> serves one full capsule (--flight-dir)\"}," +
              "{\"path\":\"/debug/signals\",\"description\":\"signal-quality watchdog: per-pod "
-             "evidence verdicts + fleet coverage (--signal-guard on)\"}" +
+             "evidence verdicts + fleet coverage (--signal-guard on)\"}," +
+             "{\"path\":\"/debug/fleet/workloads\",\"description\":\"federation hub: merged "
+             "per-cluster workload ledgers + fleet totals (tpu-pruner hub)\"}," +
+             "{\"path\":\"/debug/fleet/signals\",\"description\":\"federation hub: per-cluster-"
+             "minimum coverage + named brownout/unreachable clusters (tpu-pruner hub)\"}," +
+             "{\"path\":\"/debug/fleet/decisions\",\"description\":\"federation hub: recent "
+             "DecisionRecords per member cluster (tpu-pruner hub)\"}," +
+             "{\"path\":\"/debug/fleet/clusters\",\"description\":\"federation hub: member "
+             "status table — OK / PENDING / UNREACHABLE, staleness, poll errors "
+             "(tpu-pruner hub)\"}" +
              "]}";
     } else {
       content_type = want_openmetrics

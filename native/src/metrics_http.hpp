@@ -53,6 +53,14 @@ class Server {
   // evidence assessment). Unset → 404.
   void set_signals_provider(std::function<std::string()> provider);
 
+  // /debug/fleet/* provider (the federation hub's merged views): receives
+  // the subpath ("workloads" | "signals" | "decisions" | "clusters") and
+  // the raw query string, returns the JSON body — an empty return means
+  // "no such view" (404). Unset → 404 with a hint that the routes are
+  // served by `tpu-pruner hub`.
+  void set_fleet_provider(
+      std::function<std::string(const std::string&, const std::string&)> provider);
+
   // Extra /metrics families rendered outside the counter/histogram
   // registries (the ledger's bounded-cardinality workload series). The
   // provider returns ready-made exposition text (HELP/TYPE included);
@@ -72,6 +80,7 @@ class Server {
   std::function<std::string(const std::string&)> workloads_provider_;
   std::function<std::string(const std::string&)> cycles_provider_;
   std::function<std::string()> signals_provider_;
+  std::function<std::string(const std::string&, const std::string&)> fleet_provider_;
   std::function<std::string(bool)> extra_metrics_provider_;
   mutable std::mutex probe_mutex_;
   std::thread thread_;

@@ -3,6 +3,7 @@
 #include <cctype>
 
 #include "otlp_grpc.hpp"
+#include "tpupruner/fleet.hpp"
 #include "tpupruner/http.hpp"
 #include "tpupruner/json.hpp"
 #include "tpupruner/log.hpp"
@@ -22,13 +23,19 @@ Value data_point(uint64_t value, int64_t start_nanos, int64_t now_nanos) {
   return dp;
 }
 
-// service.name = tpu-pruner (reference Resource, main.rs:139-143).
+// service.name = tpu-pruner (reference Resource, main.rs:139-143), plus
+// the fleet cluster identity so pushed telemetry merges like the pull
+// surfaces do.
 Value service_resource() {
   Value attr = Value::object();
   attr.set("key", Value("service.name"));
   attr.set("value", Value(json::Object{{"stringValue", Value("tpu-pruner")}}));
+  Value cluster = Value::object();
+  cluster.set("key", Value("cluster"));
+  cluster.set("value",
+              Value(json::Object{{"stringValue", Value(fleet::cluster_name())}}));
   Value resource = Value::object();
-  resource.set("attributes", Value(json::Array{std::move(attr)}));
+  resource.set("attributes", Value(json::Array{std::move(attr), std::move(cluster)}));
   return resource;
 }
 

@@ -12,6 +12,7 @@
 
 #include "tpupruner/audit.hpp"
 #include "tpupruner/core.hpp"
+#include "tpupruner/fleet.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/metrics.hpp"
 #include "tpupruner/query.hpp"
@@ -136,6 +137,7 @@ void seal_locked(Registry& r, uint64_t cycle) {
   std::string id = "cycle-" + pad(static_cast<uint64_t>(c.ts_ms), 13) + "-" + pad(cycle, 6);
   Value doc = Value::object();
   doc.set("version", Value(1));
+  doc.set("cluster", Value(fleet::cluster_name()));
   doc.set("id", Value(id));
   doc.set("cycle", Value(static_cast<int64_t>(cycle)));
   doc.set("ts", Value(util::format_rfc3339(c.ts_unix)));
@@ -432,6 +434,7 @@ json::Value index_json() {
   Value capsules = Value::array();
   for (const IndexEntry& e : r.index) capsules.push_back(e.summary);
   Value out = Value::object();
+  out.set("cluster", Value(fleet::cluster_name()));
   out.set("capsules", std::move(capsules));
   out.set("dir", Value(r.dir));
   out.set("keep", Value(static_cast<int64_t>(r.keep)));
@@ -526,6 +529,10 @@ Value normalize_decision(const Value& d) {
   Value c = d;
   c.as_object().erase("ts");
   c.as_object().erase("trace_id");
+  // The replay process stamps ITS cluster identity into rebuilt records
+  // (DecisionRecord::to_json reads the process-wide name), which is not
+  // the recording daemon's — identity is provenance, not a decision.
+  c.as_object().erase("cluster");
   return c;
 }
 

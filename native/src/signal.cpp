@@ -7,6 +7,8 @@
 #include <mutex>
 #include <stdexcept>
 
+#include "tpupruner/fleet.hpp"
+
 namespace tpupruner::signal {
 
 using json::Value;
@@ -276,11 +278,13 @@ json::Value signals_json() {
   std::lock_guard<std::mutex> lock(r.mutex);
   if (!r.published) {
     Value v = Value::object();
+    v.set("cluster", Value(fleet::cluster_name()));
     v.set("enabled", Value(false));
     v.set("hint", Value("run the daemon with --signal-guard on to assess evidence health"));
     return v;
   }
   Value v = assessment_to_json(r.latest);
+  v.set("cluster", Value(fleet::cluster_name()));
   v.set("enabled", Value(true));
   v.set("brownouts_total", Value(static_cast<int64_t>(r.brownouts_total)));
   Value thresholds = Value::object();

@@ -1,0 +1,225 @@
+// Fleet federation units: cluster-label stamping (the exposition choke
+// point), the hub's merge math (totals that sum, per-cluster-minimum
+// coverage, UNREACHABLE semantics), and identity resolution. The e2e
+// behavior (real members + hub binary) rides tests/test_fleet.py.
+#include <cstdlib>
+
+#include "testing.hpp"
+#include "tpupruner/fleet.hpp"
+#include "tpupruner/json.hpp"
+
+namespace fleet = tpupruner::fleet;
+using tpupruner::json::Value;
+
+namespace {
+
+fleet::MemberSnapshot member(const std::string& cluster, bool reachable,
+                             double coverage, bool guard_on, double reclaimed,
+                             double idle = 0) {
+  fleet::MemberSnapshot m;
+  m.url = "http://" + cluster;
+  m.cluster = cluster;
+  m.reachable = reachable;
+  m.ever_reached = reachable;
+  m.staleness_s = reachable ? 0 : -1;
+  m.polls = 3;
+  m.failures = reachable ? 0 : 3;
+  if (!reachable) m.last_error = "connection refused";
+  Value totals = Value::object();
+  totals.set("idle_seconds", Value(idle));
+  totals.set("active_seconds", Value(0.0));
+  totals.set("reclaimed_chip_seconds", Value(reclaimed));
+  Value wl = Value::object();
+  wl.set("cluster", Value(cluster));
+  wl.set("tracked", Value(static_cast<int64_t>(1)));
+  wl.set("totals", std::move(totals));
+  wl.set("workloads", Value::array());
+  m.workloads = std::move(wl);
+  Value sig = Value::object();
+  sig.set("enabled", Value(guard_on));
+  if (guard_on) {
+    sig.set("coverage_ratio", Value(coverage));
+    sig.set("brownout", Value(coverage < 0.9));
+  }
+  m.signals = std::move(sig);
+  Value decisions = Value::array();
+  Value d = Value::object();
+  d.set("pod", Value(cluster + "-pod"));
+  decisions.push_back(std::move(d));
+  Value dec = Value::object();
+  dec.set("decisions", std::move(decisions));
+  m.decisions = std::move(dec);
+  return m;
+}
+
+const Value* find_cluster_row(const Value& doc, const char* list_key,
+                              const std::string& cluster) {
+  const Value* rows = doc.find(list_key);
+  if (!rows || !rows->is_array()) return nullptr;
+  for (const Value& row : rows->as_array()) {
+    if (row.get_string("cluster") == cluster) return &row;
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+TP_TEST(stamp_exposition_labels_every_sample_line) {
+  std::string body =
+      "# HELP tpu_pruner_x help\n"
+      "# TYPE tpu_pruner_x counter\n"
+      "tpu_pruner_x 3\n"
+      "tpu_pruner_h_bucket{phase=\"q\",le=\"+Inf\"} 2 # {trace_id=\"ab\"} 0.1 9\n"
+      "tpu_pruner_h_sum{phase=\"q\"} 0.5\n";
+  std::string out = fleet::stamp_exposition(body, "east");
+  TP_CHECK(out.find("tpu_pruner_x{cluster=\"east\"} 3\n") != std::string::npos);
+  TP_CHECK(out.find("tpu_pruner_h_bucket{cluster=\"east\",phase=\"q\",le=\"+Inf\"} 2 "
+                    "# {trace_id=\"ab\"} 0.1 9\n") != std::string::npos);
+  TP_CHECK(out.find("tpu_pruner_h_sum{cluster=\"east\",phase=\"q\"} 0.5\n") !=
+           std::string::npos);
+  // comments untouched
+  TP_CHECK(out.find("# HELP tpu_pruner_x help\n") != std::string::npos);
+  // idempotent: a second stamp (or a pre-labelled hub row) changes nothing
+  TP_CHECK_EQ(fleet::stamp_exposition(out, "east"), out);
+  TP_CHECK_EQ(fleet::stamp_exposition("m{cluster=\"w\"} 1\n", "east"),
+              "m{cluster=\"w\"} 1\n");
+  // empty cluster: no-op
+  TP_CHECK_EQ(fleet::stamp_exposition(body, ""), body);
+}
+
+TP_TEST(aggregate_totals_sum_over_clusters) {
+  auto view = fleet::aggregate(
+      {member("a", true, 1.0, true, 100.0, 10.0),
+       member("b", true, 1.0, true, 7.5, 5.0)},
+      30);
+  const Value* totals = view.workloads.find("fleet_totals");
+  TP_CHECK(totals != nullptr);
+  TP_CHECK_EQ(totals->find("reclaimed_chip_seconds")->as_double(), 107.5);
+  TP_CHECK_EQ(totals->find("idle_seconds")->as_double(), 15.0);
+  TP_CHECK_EQ(view.workloads.find("tracked_total")->as_int(), 2);
+  // per-cluster sections carry each member's own totals verbatim
+  const Value* a = find_cluster_row(view.workloads, "clusters", "a");
+  TP_CHECK(a != nullptr);
+  TP_CHECK_EQ(a->find("totals")->find("reclaimed_chip_seconds")->as_double(), 100.0);
+}
+
+TP_TEST(aggregate_coverage_is_minimum_never_mean) {
+  auto view = fleet::aggregate(
+      {member("a", true, 1.0, true, 0),
+       member("b", true, 0.25, true, 0),
+       member("c", true, 1.0, true, 0)},
+      30);
+  // mean would be 0.75; the fleet figure must be b's 0.25
+  TP_CHECK_EQ(view.signals.find("coverage_min")->as_double(), 0.25);
+  const Value* brownouts = view.signals.find("brownout_clusters");
+  TP_CHECK_EQ(brownouts->as_array().size(), static_cast<size_t>(1));
+  TP_CHECK_EQ(brownouts->as_array()[0].as_string(), "b");
+}
+
+TP_TEST(aggregate_unreachable_pins_minimum_to_zero) {
+  auto view = fleet::aggregate(
+      {member("a", true, 1.0, true, 0), member("dark", false, 0, false, 0)},
+      30);
+  TP_CHECK_EQ(view.signals.find("coverage_min")->as_double(), 0.0);
+  const Value* unreachable = view.signals.find("unreachable_clusters");
+  TP_CHECK_EQ(unreachable->as_array()[0].as_string(), "dark");
+  const Value* row = find_cluster_row(view.clusters, "members", "dark");
+  TP_CHECK_EQ(row->get_string("status"), "UNREACHABLE");
+  TP_CHECK_EQ(row->get_string("last_error"), "connection refused");
+  TP_CHECK_EQ(view.clusters.find("unreachable")->as_int(), 1);
+  // the dark member's last-known ledger data is kept, flagged, summed
+  const Value* wl = find_cluster_row(view.workloads, "clusters", "dark");
+  TP_CHECK_EQ(wl->get_string("status"), "UNREACHABLE");
+  TP_CHECK(view.metrics_text.find("tpu_pruner_fleet_member_up{cluster=\"dark\"} 0") !=
+           std::string::npos);
+  TP_CHECK(view.metrics_text.find("tpu_pruner_fleet_members_unreachable 1") !=
+           std::string::npos);
+}
+
+TP_TEST(aggregate_guard_off_members_contribute_nothing) {
+  // guard-off member alongside a browned one: minimum is the browned
+  // member's ratio, not diluted and not zeroed by the guard-off member
+  auto view = fleet::aggregate(
+      {member("off", true, 0, false, 0), member("b", true, 0.4, true, 0)}, 30);
+  TP_CHECK_EQ(view.signals.find("coverage_min")->as_double(), 0.4);
+  // no guard anywhere → nothing to judge → 1.0
+  view = fleet::aggregate({member("off", true, 0, false, 0)}, 30);
+  TP_CHECK_EQ(view.signals.find("coverage_min")->as_double(), 1.0);
+  // guard-off member serves no per-member coverage row
+  TP_CHECK(view.metrics_text.find("tpu_pruner_fleet_coverage_ratio{cluster=\"off\"}") ==
+           std::string::npos);
+}
+
+TP_TEST(aggregate_stale_member_reads_unreachable) {
+  auto m = member("lagging", true, 1.0, true, 0);
+  m.staleness_s = 120;  // reachable flag stale: last success 2 min ago
+  auto view = fleet::aggregate({m}, /*stale_after_s=*/30);
+  const Value* row = find_cluster_row(view.clusters, "members", "lagging");
+  TP_CHECK_EQ(row->get_string("status"), "UNREACHABLE");
+  TP_CHECK_EQ(view.signals.find("coverage_min")->as_double(), 0.0);
+}
+
+TP_TEST(aggregate_never_polled_member_is_pending) {
+  fleet::MemberSnapshot m;
+  m.url = "http://new";
+  m.cluster = "new";
+  m.polls = 0;
+  auto view = fleet::aggregate({m}, 30);
+  const Value* row = find_cluster_row(view.clusters, "members", "new");
+  TP_CHECK_EQ(row->get_string("status"), "PENDING");
+}
+
+TP_TEST(aggregate_orders_clusters_deterministically) {
+  auto view = fleet::aggregate(
+      {member("zeta", true, 1.0, true, 0), member("alpha", true, 1.0, true, 0)},
+      30);
+  const Value& rows = *view.clusters.find("members");
+  TP_CHECK_EQ(rows.as_array()[0].get_string("cluster"), "alpha");
+  TP_CHECK_EQ(rows.as_array()[1].get_string("cluster"), "zeta");
+}
+
+TP_TEST(aggregate_caps_decisions_per_member) {
+  auto m = member("a", true, 1.0, true, 0);
+  Value decisions = Value::array();
+  for (int i = 0; i < 10; ++i) {
+    Value d = Value::object();
+    d.set("pod", Value("p" + std::to_string(i)));
+    decisions.push_back(std::move(d));
+  }
+  Value dec = Value::object();
+  dec.set("decisions", std::move(decisions));
+  m.decisions = std::move(dec);
+  auto view = fleet::aggregate({m}, 30, /*decisions_per_member=*/3);
+  const Value* row = find_cluster_row(view.decisions, "clusters", "a");
+  const Value& kept = *row->find("decisions");
+  TP_CHECK_EQ(kept.as_array().size(), static_cast<size_t>(3));
+  // the LAST K survive (most recent decisions)
+  TP_CHECK_EQ(kept.as_array()[2].get_string("pod"), "p9");
+}
+
+TP_TEST(cluster_identity_resolution_order) {
+  ::setenv("TPU_PRUNER_CLUSTER_NAME", "from-env", 1);
+  TP_CHECK_EQ(fleet::resolve_cluster_name("from-flag"), "from-flag");
+  TP_CHECK_EQ(fleet::resolve_cluster_name(""), "from-env");
+  ::unsetenv("TPU_PRUNER_CLUSTER_NAME");
+  fleet::set_cluster_name("my-cluster");
+  TP_CHECK_EQ(fleet::cluster_name(), "my-cluster");
+  fleet::set_cluster_name("");  // empty never sticks
+  TP_CHECK_EQ(fleet::cluster_name(), "default");
+  fleet::reset_for_test();
+}
+
+TP_TEST(hub_metric_families_are_prefixed_and_complete) {
+  auto families = fleet::hub_metric_families();
+  TP_CHECK(families.size() >= 10);
+  for (const std::string& f : families) {
+    TP_CHECK(f.rfind("tpu_pruner_fleet_", 0) == 0);
+  }
+  // every family rendered by aggregate appears in the canonical list
+  auto view = fleet::aggregate({member("a", true, 0.5, true, 1.0)}, 30);
+  for (const std::string& f :
+       {"tpu_pruner_fleet_members", "tpu_pruner_fleet_coverage_ratio_min",
+        "tpu_pruner_fleet_member_up", "tpu_pruner_fleet_reclaimed_chip_seconds_total"}) {
+    TP_CHECK(view.metrics_text.find(f) != std::string::npos);
+  }
+}

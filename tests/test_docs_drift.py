@@ -117,6 +117,42 @@ def test_signal_bench_summary_fields_documented():
             f"bench summary field {field} missing from docs/BENCH_FIELDS.md")
 
 
+def test_fleet_surfaces_documented(built):
+    """The federation hub's families come from the native canonical list
+    (like the signal/ledger families: the served-metric test below never
+    scrapes a hub, so an undocumented fleet family would slip through).
+    The hub flags, fleet endpoints, merge tooling and the UNREACHABLE
+    semantics ride the same guard."""
+    doc = OPERATIONS.read_text()
+    families = native.fleet_metric_families()
+    assert len(families) >= 10
+    missing = [f for f in families if f not in doc]
+    assert not missing, (
+        f"fleet metric families missing from docs/OPERATIONS.md: {missing} "
+        "— document each in the Observability table and the 'Running a "
+        "fleet' section")
+    needles = ("tpu-pruner hub", "--cluster-name", "--member",
+               "--poll-interval", "--stale-after",
+               "/debug/fleet/workloads", "/debug/fleet/signals",
+               "/debug/fleet/decisions", "/debug/fleet/clusters",
+               "UNREACHABLE", "--merged-ledger-out", "fleet-smoke",
+               "coverage_min", "epoch")
+    missing = [n for n in needles if n not in doc]
+    assert not missing, (
+        f"fleet federation surfaces missing from docs/OPERATIONS.md: {missing}")
+
+
+def test_fleet_bench_summary_fields_documented():
+    """Fleet bench summary fields must be in BENCH_FIELDS.md AND actually
+    emitted by bench.py — a drift on either side fails."""
+    bench_src = (REPO / "bench.py").read_text()
+    fields_doc = (REPO / "docs" / "BENCH_FIELDS.md").read_text()
+    for field in ("fleet_members", "fleet_merge_p50_ms"):
+        assert f'"{field}"' in bench_src, f"bench.py no longer emits {field}"
+        assert field in fields_doc, (
+            f"bench summary field {field} missing from docs/BENCH_FIELDS.md")
+
+
 def test_every_served_metric_documented(built):
     """Scrape the real daemon after a full scale-down cycle and check every
     family name on /metrics (histograms included) against OPERATIONS.md."""

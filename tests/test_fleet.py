@@ -1,0 +1,416 @@
+"""Fleet federation tests (the observability tentpole).
+
+Every surface the daemon exports now carries its cluster identity, and a
+new hub mode merges N members into one fleet view. These tests drive a
+REAL 3-member fleet — one healthy, one browned out by stale evidence,
+one killed mid-run — through the real hub binary and assert the
+federation invariants end to end:
+
+  - identity: every /metrics sample line and every /debug payload of a
+    member daemon carries its --cluster-name (the drift guard);
+  - merge-safe ledger: checkpoint lines carry cluster + monotonic epoch,
+    `analyze --fleet-report` accepts N repeatable sources, per-cluster
+    totals reproduce each member's own /debug/workloads totals
+    bit-for-bit and the fleet totals sum; mixed-schema and divergent
+    same-epoch sources error clearly instead of silently merging;
+  - hub: fleet coverage is the per-cluster MINIMUM (never the mean),
+    /debug/fleet/signals names the browned-out cluster, a dead member
+    becomes an explicit UNREACHABLE row, and the fleet workload totals
+    equal the sum of the per-cluster rows.
+"""
+
+import json
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.testing.fake_fleet import FakeFleet, FleetMember
+
+
+def wait_until(predicate, timeout=45, interval=0.2):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            last = predicate()
+        except OSError:
+            last = None
+        if last:
+            return last
+        time.sleep(interval)
+    raise AssertionError(f"condition never held (last={last!r})")
+
+
+def run_fleet_report(*args):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_pruner.analyze", "--fleet-report", *args],
+        capture_output=True, text=True, timeout=120)
+    doc = json.loads(proc.stdout) if proc.stdout.strip() else {}
+    return proc.returncode, doc, proc.stderr
+
+
+@pytest.fixture(scope="module")
+def fleet(built, tmp_path_factory):
+    """3-member fleet: east healthy (scales down, accrues savings), west
+    browned out (1 healthy + 3 stale pods → coverage 0.25, every
+    scale-down deferred), null killed after its first OK poll.
+    Module-scoped — the members' surfaces are read-only for every test
+    here, and a real 3-daemon + hub tree is too heavy per-test."""
+    tmp = tmp_path_factory.mktemp("fleet")
+    f = FakeFleet(tmp)
+    f.add_member("east", idle_pods=2,
+                 extra_args=("--flight-dir", str(tmp / "flight-east")))
+    f.add_member("west", idle_pods=1, stale_pods=3)
+    f.add_member("null", idle_pods=1)
+    f.start_hub(poll_interval=1, stale_after=3)
+    wait_until(lambda: all(
+        m["status"] == "OK"
+        for m in f.hub_get_json("/debug/fleet/clusters")["members"]))
+    # east's pause must have started the savings clock, and west's
+    # brownout must be visible, before the snapshot below
+    wait_until(lambda: f.members[0].get_json(
+        "/debug/workloads")["totals"]["reclaimed_chip_seconds"] > 0)
+    wait_until(lambda: "west" in f.hub_get_json(
+        "/debug/fleet/signals")["brownout_clusters"])
+    # the whole-fleet-reachable signals view: the per-cluster minimum is
+    # the browned-out cluster's coverage while every member is up
+    f.pre_kill_signals = f.hub_get_json("/debug/fleet/signals")
+    f.members[2].kill()
+    wait_until(lambda: [
+        m for m in f.hub_get_json("/debug/fleet/clusters")["members"]
+        if m["cluster"] == "null" and m["status"] == "UNREACHABLE"])
+    yield f
+    f.stop()
+
+
+# ── identity: the cluster label / key drift guard ──────────────────────
+
+
+def test_every_metric_sample_carries_cluster_label(fleet):
+    east = fleet.members[0]
+    body = east.get("/metrics")
+    samples = [l for l in body.splitlines() if l.strip() and not l.startswith("#")]
+    assert len(samples) >= 10
+    unlabeled = [l for l in samples if 'cluster="' not in l]
+    assert not unlabeled, (
+        f"/metrics sample lines without a cluster label: {unlabeled[:5]}")
+    assert any('cluster="east"' in l for l in samples)
+
+
+def test_every_debug_payload_carries_cluster_key(fleet):
+    east = fleet.members[0]
+    for path in ("/debug", "/debug/decisions", "/debug/workloads",
+                 "/debug/signals"):
+        doc = east.get_json(path)
+        assert doc.get("cluster") == "east", (path, doc.get("cluster"))
+    # every DecisionRecord row too
+    decisions = east.get_json("/debug/decisions")["decisions"]
+    assert decisions
+    assert all(d["cluster"] == "east" for d in decisions)
+
+
+def test_flight_capsules_carry_cluster(fleet):
+    east = fleet.members[0]
+    index = east.get_json("/debug/cycles")
+    assert index["cluster"] == "east"
+    assert index["capsules"]
+    capsule = east.get_json(f"/debug/cycles/{index['capsules'][-1]['id']}")
+    assert capsule["cluster"] == "east"
+    # the capsule's DecisionRecords are stamped too (audit sink path)
+    assert capsule["decisions"]
+    assert all(d["cluster"] == "east" for d in capsule["decisions"])
+
+
+def test_ledger_checkpoint_lines_carry_cluster_and_epoch(fleet):
+    east = fleet.members[0]
+    lines = [json.loads(l) for l in open(east.ledger_path) if l.strip()]
+    assert lines
+    for line in lines:
+        assert line["schema"] == 2
+        assert line["cluster"] == "east"
+        assert line["epoch"] >= 1
+
+
+def test_stamp_exposition_contract(built):
+    """The choke point itself: histogram lines, exemplar suffixes, and
+    idempotence (pre-labelled lines pass through verbatim)."""
+    body = ("# HELP x y\n"
+            "plain_total 3\n"
+            'hist_bucket{phase="q",le="+Inf"} 1 # {trace_id="ab"} 0.1 9\n'
+            'prelabeled{cluster="other"} 5\n'
+            "# EOF\n")
+    out = native.stamp_exposition(body, "c1")
+    assert 'plain_total{cluster="c1"} 3' in out
+    assert 'hist_bucket{cluster="c1",phase="q",le="+Inf"} 1 # {trace_id="ab"}' in out
+    assert 'prelabeled{cluster="other"} 5' in out  # idempotent
+    assert out == native.stamp_exposition(out, "c1")
+    assert "# HELP x y" in out and "# EOF" in out
+
+
+# ── hub: minimum coverage, named brownouts, UNREACHABLE rows ───────────
+
+
+def test_hub_coverage_is_per_cluster_minimum_not_mean(fleet):
+    # while every member was reachable: east 1.0, west 0.25, null 1.0 —
+    # a fleet MEAN would read a healthy-looking 0.75; the hub must report
+    # the per-cluster minimum, i.e. the browned-out cluster's 0.25
+    pre = fleet.pre_kill_signals
+    rows = {c["cluster"]: c for c in pre["clusters"]}
+    assert rows["east"]["coverage_ratio"] == 1.0
+    assert rows["west"]["coverage_ratio"] == 0.25
+    assert rows["west"]["brownout"] is True
+    assert pre["coverage_min"] == 0.25
+    assert pre["brownout_clusters"] == ["west"]
+    assert pre["unreachable_clusters"] == []
+
+    # with null dark, the unknown cluster pins the minimum to 0
+    signals = fleet.hub_get_json("/debug/fleet/signals")
+    assert signals["coverage_min"] == 0.0
+    assert "west" in signals["brownout_clusters"]
+    assert "null" in signals["unreachable_clusters"]
+
+    body = fleet.hub_get("/metrics")
+    m = re.search(
+        r"tpu_pruner_fleet_coverage_ratio_min(?:\{[^}]*\})? ([0-9.]+)", body)
+    assert m and float(m.group(1)) == 0.0
+    assert re.search(r'tpu_pruner_fleet_coverage_ratio\{cluster="west"\} 0.25\b',
+                     body)
+    assert re.search(r'tpu_pruner_fleet_brownout\{cluster="west"\} 1', body)
+
+
+def test_hub_unreachable_member_is_explicit_row(fleet):
+    clusters = fleet.hub_get_json("/debug/fleet/clusters")
+    rows = {m["cluster"]: m for m in clusters["members"]}
+    assert rows["null"]["status"] == "UNREACHABLE"
+    assert rows["null"]["failures"] >= 1
+    assert rows["null"]["last_error"]
+    assert rows["east"]["status"] == "OK"
+    assert clusters["unreachable"] == 1
+    body = fleet.hub_get("/metrics")
+    assert re.search(r'tpu_pruner_fleet_member_up\{cluster="null"\} 0', body)
+    assert re.search(r'tpu_pruner_fleet_member_up\{cluster="east"\} 1', body)
+    assert re.search(
+        r"tpu_pruner_fleet_members_unreachable(?:\{[^}]*\})? 1", body)
+
+
+def test_hub_fleet_totals_sum_and_name_every_cluster(fleet):
+    doc = fleet.hub_get_json("/debug/fleet/workloads")
+    assert {c["cluster"] for c in doc["clusters"]} == {"east", "west", "null"}
+    summed = sum(c.get("totals", {}).get("reclaimed_chip_seconds", 0.0)
+                 for c in doc["clusters"])
+    assert summed == doc["fleet_totals"]["reclaimed_chip_seconds"]
+    east_row = next(c for c in doc["clusters"] if c["cluster"] == "east")
+    assert east_row["totals"]["reclaimed_chip_seconds"] > 0
+    # a browned-out cluster never scales down, so it never reclaims
+    west_row = next(c for c in doc["clusters"] if c["cluster"] == "west")
+    assert west_row["totals"]["reclaimed_chip_seconds"] == 0
+
+
+def test_hub_debug_index_readyz_and_decisions(fleet):
+    routes = {r["path"] for r in fleet.hub_get_json("/debug")["routes"]}
+    for path in ("/debug/fleet/workloads", "/debug/fleet/signals",
+                 "/debug/fleet/decisions", "/debug/fleet/clusters"):
+        assert path in routes
+    assert fleet.hub_get("/readyz") == "ok\n"
+    decisions = fleet.hub_get_json("/debug/fleet/decisions")
+    east = next(c for c in decisions["clusters"] if c["cluster"] == "east")
+    assert east["decisions"]
+    assert all(d["cluster"] == "east" for d in east["decisions"])
+    west = next(c for c in decisions["clusters"] if c["cluster"] == "west")
+    west_reasons = {d["reason"] for d in west["decisions"]}
+    assert "SIGNAL_STALE" in west_reasons
+    assert "SIGNAL_BROWNOUT" in west_reasons  # the healthy sibling, deferred
+
+
+def test_member_daemon_404s_fleet_routes(fleet):
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fleet.members[0].get("/debug/fleet/workloads")
+    assert err.value.code == 404
+
+
+def test_hub_readyz_fails_until_first_member_poll(built, tmp_path):
+    f = FakeFleet(tmp_path)
+    try:
+        # a member URL nothing listens on: the hub can never sync
+        f.start_hub(poll_interval=1, member_urls=["http://127.0.0.1:9"])
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as err:
+            f.hub_get("/readyz")
+        assert err.value.code == 503
+        # the fleet view serves from the first request (the member is
+        # PENDING until its first poll round fails, then UNREACHABLE)
+        clusters = wait_until(lambda: (lambda doc:
+            doc if doc.get("members")
+            and doc["members"][0]["status"] == "UNREACHABLE" else None)(
+                f.hub_get_json("/debug/fleet/clusters")))
+        assert clusters["members"][0]["failures"] >= 1
+    finally:
+        f.stop()
+
+
+# ── analyze --fleet-report over N ledgers ──────────────────────────────
+
+
+def test_fleet_report_merges_three_ledgers_bit_for_bit(fleet, tmp_path):
+    # Snapshot each LIVE member's own /debug/workloads totals and its
+    # checkpoint in one breath: accrual only moves at cycle boundaries,
+    # so retry until a stable window brackets both reads.
+    east = fleet.members[0]
+    for _ in range(30):
+        before = east.get_json("/debug/workloads")["totals"]
+        ledger_snapshot = open(east.ledger_path).read()
+        after = east.get_json("/debug/workloads")["totals"]
+        if before == after:
+            break
+        time.sleep(0.2)
+    assert before == after, "never caught a stable inter-cycle window"
+    east_copy = tmp_path / "east.jsonl"
+    east_copy.write_text(ledger_snapshot)
+
+    rc, doc, err = run_fleet_report(
+        "--ledger-file", str(east_copy),
+        "--ledger-file", fleet.members[1].ledger_path,
+        "--ledger-file", fleet.members[2].ledger_path,
+        "--merged-ledger-out", str(tmp_path / "merged.jsonl"))
+    assert rc == 0, err
+    by_cluster = {c["cluster"]: c for c in doc["clusters"]}
+    assert set(by_cluster) == {"east", "west", "null"}
+    # bit-for-bit: the merged east section reproduces east's own
+    # /debug/workloads totals (same accounts, same floats)
+    assert by_cluster["east"]["reclaimed_chip_seconds"] == \
+        before["reclaimed_chip_seconds"]
+    assert by_cluster["east"]["idle_seconds"] == before["idle_seconds"]
+    # fleet totals sum over the per-cluster sections
+    assert doc["fleet_totals"]["reclaimed_chip_seconds"] == sum(
+        c["reclaimed_chip_seconds"] for c in doc["clusters"])
+    # west was browned out every cycle: evidence was never trusted, so
+    # the ledger never integrated anything for it... but its accounts may
+    # exist with zero reclaimed
+    assert by_cluster["west"]["reclaimed_chip_seconds"] == 0
+    # cluster-qualified workload keys in the offender table
+    assert all(":" in o["workload"] for o in doc["top_offenders"])
+
+    # the merged checkpoint composes: feeding it back reproduces the
+    # per-cluster sections exactly
+    rc, doc2, err = run_fleet_report(
+        "--ledger-file", str(tmp_path / "merged.jsonl"))
+    assert rc == 0, err
+    assert doc2["clusters"] == doc["clusters"]
+    assert doc2["fleet_totals"] == doc["fleet_totals"]
+
+
+def test_fleet_report_single_url_source(fleet):
+    rc, doc, err = run_fleet_report(
+        "--workloads-url", fleet.members[0].url)
+    assert rc == 0, err
+    assert [c["cluster"] for c in doc["clusters"]] == ["east"]
+    assert doc["tracked_workloads"] == doc["clusters"][0]["workloads"]
+
+
+def test_fleet_report_rejects_legacy_schema_in_merge(built, tmp_path):
+    legacy = tmp_path / "legacy.jsonl"
+    legacy.write_text(json.dumps({
+        "workload": "Deployment/ml/x", "kind": "Deployment",
+        "namespace": "ml", "name": "x", "chips": 4, "state": "idle",
+        "idle_seconds": 10.0, "reclaimed_chip_seconds": 0.0}) + "\n")
+    stamped = tmp_path / "stamped.jsonl"
+    stamped.write_text(json.dumps({
+        "schema": 2, "cluster": "a", "epoch": 1,
+        "workload": "Deployment/ml/y", "kind": "Deployment",
+        "namespace": "ml", "name": "y", "chips": 4, "state": "idle",
+        "idle_seconds": 5.0, "reclaimed_chip_seconds": 0.0}) + "\n")
+
+    # alone, the legacy file still renders (pre-federation behavior)
+    rc, doc, err = run_fleet_report("--ledger-file", str(legacy))
+    assert rc == 0, err
+    assert doc["tracked_workloads"] == 1
+    assert "clusters" not in doc
+
+    # merged with a stamped source it must error clearly, not half-merge
+    rc, _, err = run_fleet_report("--ledger-file", str(legacy),
+                                  "--ledger-file", str(stamped))
+    assert rc != 0
+    assert "schema-1" in err and "cluster" in err
+
+    # a half-stamped single file is refused outright
+    mixed = tmp_path / "mixed.jsonl"
+    mixed.write_text(legacy.read_text() + stamped.read_text())
+    rc, _, err = run_fleet_report("--ledger-file", str(mixed))
+    assert rc != 0
+    assert "mixed-schema" in err
+
+
+def test_fleet_report_duplicate_cluster_epoch_rules(built, tmp_path):
+    def account(cluster, epoch, idle):
+        return json.dumps({
+            "schema": 2, "cluster": cluster, "epoch": epoch,
+            "workload": "Deployment/ml/x", "kind": "Deployment",
+            "namespace": "ml", "name": "x", "chips": 4, "state": "idle",
+            "idle_seconds": idle, "reclaimed_chip_seconds": 0.0}) + "\n"
+
+    stale = tmp_path / "stale.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    stale.write_text(account("a", 3, 10.0))
+    fresh.write_text(account("a", 7, 25.0))
+    # higher epoch wins wholesale, regardless of argument order
+    for order in ((stale, fresh), (fresh, stale)):
+        rc, doc, err = run_fleet_report(
+            "--ledger-file", str(order[0]), "--ledger-file", str(order[1]))
+        assert rc == 0, err
+        assert doc["clusters"][0]["idle_seconds"] == 25.0
+        assert doc["clusters"][0]["epoch"] == 7
+
+    # the same file twice is fine (identical records dedupe)...
+    rc, doc, err = run_fleet_report(
+        "--ledger-file", str(fresh), "--ledger-file", str(fresh))
+    assert rc == 0, err
+    assert doc["tracked_workloads"] == 1
+
+    # ...but divergent accounts at the SAME epoch cannot be ordered
+    diverged = tmp_path / "diverged.jsonl"
+    diverged.write_text(account("a", 7, 99.0))
+    rc, _, err = run_fleet_report(
+        "--ledger-file", str(fresh), "--ledger-file", str(diverged))
+    assert rc != 0
+    assert "DIVERGENT" in err
+
+
+# ── merge math units via the capi seam ─────────────────────────────────
+
+
+def test_aggregate_counts_unreachable_as_zero_coverage(built):
+    out = native.fleet_aggregate([
+        {"url": "http://a", "cluster": "a", "reachable": True,
+         "signals": {"enabled": True, "coverage_ratio": 0.95,
+                     "brownout": False}},
+        {"url": "http://b", "cluster": "b", "reachable": False,
+         "ever_reached": True, "staleness_s": 999, "failures": 5,
+         "last_error": "timed out"},
+    ], stale_after_s=30)
+    assert out["signals"]["coverage_min"] == 0.0
+    assert out["signals"]["unreachable_clusters"] == ["b"]
+    rows = {m["cluster"]: m for m in out["clusters"]["members"]}
+    assert rows["b"]["status"] == "UNREACHABLE"
+
+
+def test_aggregate_guard_off_members_do_not_mask_minimum(built):
+    out = native.fleet_aggregate([
+        {"url": "http://a", "cluster": "a", "reachable": True,
+         "signals": {"enabled": False}},
+        {"url": "http://b", "cluster": "b", "reachable": True,
+         "signals": {"enabled": True, "coverage_ratio": 0.4,
+                     "brownout": True}},
+    ], stale_after_s=30)
+    assert out["signals"]["coverage_min"] == 0.4
+    assert out["signals"]["brownout_clusters"] == ["b"]
+    # no guard anywhere → nothing to judge → 1.0, not 0
+    out = native.fleet_aggregate([
+        {"url": "http://a", "cluster": "a", "reachable": True,
+         "signals": {"enabled": False}},
+    ], stale_after_s=30)
+    assert out["signals"]["coverage_min"] == 1.0

@@ -352,12 +352,12 @@ def test_breaker_trip_metrics_and_capsule_stamp(built, fake_prom, fake_k8s,
         body = wait_until(lambda: (lambda b:
             b if "tpu_pruner_breaker_trips_total" in b else None)(
                 d.get("/metrics")))
-        trips = int(re.search(r"tpu_pruner_breaker_trips_total (\d+)",
+        trips = int(re.search(r"tpu_pruner_breaker_trips_total(?:\{[^}]*\})? (\d+)",
                               body).group(1))
         assert trips >= 1
-        assert int(re.search(r"tpu_pruner_breaker_last_trip_cycle (\d+)",
+        assert int(re.search(r"tpu_pruner_breaker_last_trip_cycle(?:\{[^}]*\})? (\d+)",
                              body).group(1)) >= 1
-        assert int(re.search(r"tpu_pruner_breaker_last_trip_deferred (\d+)",
+        assert int(re.search(r"tpu_pruner_breaker_last_trip_deferred(?:\{[^}]*\})? (\d+)",
                              body).group(1)) == 1
 
         index = json.loads(d.get("/debug/cycles"))

@@ -56,6 +56,22 @@ def test_replay_smoke_recipe_present_and_wired():
     assert callable(module.main)
 
 
+def test_fleet_smoke_recipe_present_and_wired():
+    """`just fleet-smoke` must exist and invoke the real smoke module —
+    the federation contract (merged totals sum, per-cluster-minimum
+    coverage, UNREACHABLE rows) would otherwise go unguarded in CI."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^fleet-smoke\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `fleet-smoke:` recipe"
+    assert "tpu_pruner.testing.fleet_smoke" in m.group(1), (
+        "fleet-smoke no longer invokes tpu_pruner.testing.fleet_smoke")
+    import importlib
+
+    module = importlib.import_module("tpu_pruner.testing.fleet_smoke")
+    assert callable(module.main)
+
+
 def test_just_verify_matches_roadmap_tier1():
     roadmap = roadmap_tier1_command()
     justfile = justfile_verify_command()

@@ -68,7 +68,7 @@ class LedgerDaemon:
         """workload → value of tpu_pruner_workload_reclaimed_chip_seconds_total."""
         body = self.get("/metrics")
         return {m.group(1): float(m.group(2)) for m in re.finditer(
-            r'tpu_pruner_workload_reclaimed_chip_seconds_total\{workload="([^"]+)"\} '
+            r'tpu_pruner_workload_reclaimed_chip_seconds_total\{[^}]*workload="([^"]+)"\} '
             r'([0-9.e+-]+)', body)}
 
     def stop(self):
@@ -277,10 +277,10 @@ def test_daemon_metrics_respect_ledger_top_k(built, fake_prom, fake_k8s):
     try:
         wait_until(lambda: len(fake_k8s.scale_patches()) == 4)
         body = wait_until(lambda: (lambda b:
-            b if "tpu_pruner_workloads_tracked 4" in b else None)(
-                d.get("/metrics")))
+            b if re.search(r"tpu_pruner_workloads_tracked(?:\{[^}]*\})? 4", b)
+            else None)(d.get("/metrics")))
         series = re.findall(
-            r'tpu_pruner_workload_idle_seconds_total\{workload="([^"]+)"\}', body)
+            r'tpu_pruner_workload_idle_seconds_total\{[^}]*workload="([^"]+)"\}', body)
         assert len(series) == 3 and "_other" in series
     finally:
         d.stop()

@@ -72,7 +72,8 @@ class MetricsDaemon:
         while time.time() < deadline:
             _, _, body = self.get("/metrics")
             counts = dict(re.findall(
-                r'tpu_pruner_cycle_phase_seconds_count\{phase="(\w+)"\} (\d+)', body))
+                r'tpu_pruner_cycle_phase_seconds_count\{[^}]*phase="(\w+)"\} (\d+)',
+                body))
             if len(counts) == 6 and len(set(counts.values())) == 1 and "0" not in counts.values():
                 return body
             time.sleep(0.2)
@@ -116,25 +117,27 @@ def test_classic_content_type_and_help_type_pairs(daemon):
 
 def test_histogram_buckets_well_formed(daemon):
     body = daemon.wait_for_cycle()
-    # per (family, phase): le values ascending ending at +Inf, cumulative
-    # counts non-decreasing, +Inf bucket == _count, _sum present
+    # per (family, label-prefix): le values ascending ending at +Inf,
+    # cumulative counts non-decreasing, +Inf bucket == _count, _sum
+    # present. Every series carries at least the cluster label; the
+    # phase histograms add phase="..." before le.
     series = {}
     for m in re.finditer(
-            r'(\w+)_bucket\{(?:phase="(\w+)",)?le="([^"]+)"\} (\d+)', body):
+            r'(\w+)_bucket\{([^}]*?)le="([^"]+)"\} (\d+)', body):
         series.setdefault((m.group(1), m.group(2)), []).append(
             (float("inf") if m.group(3) == "+Inf" else float(m.group(3)),
              int(m.group(4))))
     assert series
-    for (family, phase), buckets in series.items():
-        label = f'{{phase="{phase}"}}' if phase else ""
+    for (family, prefix), buckets in series.items():
+        label = "{" + prefix.rstrip(",") + "}" if prefix else ""
         les = [le for le, _ in buckets]
         counts = [c for _, c in buckets]
-        assert les == sorted(les), (family, phase)
-        assert les[-1] == float("inf"), (family, phase)
-        assert counts == sorted(counts), f"non-cumulative buckets: {family} {phase}"
+        assert les == sorted(les), (family, prefix)
+        assert les[-1] == float("inf"), (family, prefix)
+        assert counts == sorted(counts), f"non-cumulative buckets: {family} {prefix}"
         total = re.search(
             rf"{family}_count{re.escape(label)} (\d+)", body)
-        assert total, (family, phase)
+        assert total, (family, prefix)
         assert counts[-1] == int(total.group(1))
         assert re.search(rf"{family}_sum{re.escape(label)} [0-9.e+-]+", body)
 
@@ -142,7 +145,7 @@ def test_histogram_buckets_well_formed(daemon):
 def test_phase_counts_consistent_per_cycle(daemon):
     body = daemon.wait_for_cycle()
     counts = dict(re.findall(
-        r'tpu_pruner_cycle_phase_seconds_count\{phase="(\w+)"\} (\d+)', body))
+        r'tpu_pruner_cycle_phase_seconds_count\{[^}]*phase="(\w+)"\} (\d+)', body))
     assert set(counts) == {"query", "decode", "signal", "resolve", "actuate",
                            "total"}
     assert len(set(counts.values())) == 1, counts
@@ -202,12 +205,13 @@ def test_informer_staleness_bounded_when_resource_never_syncs(
     try:
         d.wait_for_cycle()
         _, _, body = d.get("/metrics")
-        m = re.search(r"tpu_pruner_informer_staleness_seconds (\d+)", body)
+        m = re.search(r"tpu_pruner_informer_staleness_seconds(?:\{[^}]*\})? (\d+)",
+                      body)
         assert m, "staleness gauge missing with --watch-cache on"
         # the daemon waits up to 10s for initial sync; anything within a
         # couple of minutes is process-relative, machine uptime is not
         assert int(m.group(1)) < 300, f"garbage staleness: {m.group(1)}s"
-        assert re.search(r"tpu_pruner_informer_synced 0", body)
+        assert re.search(r"tpu_pruner_informer_synced(?:\{[^}]*\})? 0", body)
     finally:
         d.stop()
 

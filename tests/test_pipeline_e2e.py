@@ -902,7 +902,7 @@ def test_daemon_soak_with_churn(built, fake_prom, fake_k8s):
 
         body = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
-        m = re.search(r"tpu_pruner_scale_successes (\d+)", body)
+        m = re.search(r"tpu_pruner_scale_successes(?:\{[^}]*\})? (\d+)", body)
         assert m and int(m.group(1)) >= 4, body
 
         proc.send_signal(signal.SIGTERM)

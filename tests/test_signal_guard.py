@@ -173,10 +173,13 @@ def test_all_stale_brownout_zero_scaledowns_and_replay(built, tmp_path):
             body = wait_until(lambda: (lambda b:
                 b if "tpu_pruner_signal_brownouts_total" in b else None)(
                     d.get("/metrics")))
-            assert int(re.search(r"tpu_pruner_signal_brownouts_total (\d+)",
-                                 body).group(1)) >= 1
-            assert re.search(r"tpu_pruner_signal_coverage_ratio 0\b", body)
-            assert re.search(r'tpu_pruner_signal_pods\{verdict="stale"\} 2', body)
+            assert int(re.search(
+                r"tpu_pruner_signal_brownouts_total(?:\{[^}]*\})? (\d+)",
+                body).group(1)) >= 1
+            assert re.search(
+                r"tpu_pruner_signal_coverage_ratio(?:\{[^}]*\})? 0\b", body)
+            assert re.search(
+                r'tpu_pruner_signal_pods\{[^}]*verdict="stale"\} 2', body)
 
             signals = json.loads(d.get("/debug/signals"))
             assert signals["enabled"] is True
@@ -340,8 +343,8 @@ def test_debug_signals_and_metrics_families(built, fake_prom, fake_k8s):
             assert family in body, family
         # the age histogram observed the scripted 12s age
         assert re.search(
-            r'tpu_pruner_pod_signal_age_seconds_bucket\{le="15"\} [1-9]', body)
-        assert "tpu_pruner_signal_brownouts_total 0" in body
+            r'tpu_pruner_pod_signal_age_seconds_bucket\{[^}]*le="15"\} [1-9]', body)
+        assert re.search(r"tpu_pruner_signal_brownouts_total(?:\{[^}]*\})? 0", body)
     finally:
         d.stop()
 

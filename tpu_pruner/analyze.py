@@ -432,11 +432,20 @@ def _run_signal_report(args) -> int:
     return 0
 
 
-def _load_workload_records(args) -> list[dict]:
-    """Workload accounts from the ledger JSONL checkpoint or /debug/workloads."""
-    if args.ledger_file:
+def _load_ledger_sources(args) -> list[dict]:
+    """Workload accounts from N ledger JSONL checkpoints and/or
+    /debug/workloads endpoints (both flags are repeatable).
+
+    Each source is {"name", "records", "cluster"?}. Schema-2 sources
+    (daemon stamps cluster identity + a monotonic checkpoint epoch on
+    every line) are merge-safe; a schema-1 source (no cluster identity)
+    is only accepted ALONE — merging it would silently conflate clusters.
+    A source whose lines disagree about carrying a cluster is rejected
+    outright (a torn mixed-schema checkpoint must never half-merge)."""
+    sources = []
+    for path in (args.ledger_file or []):
         records = []
-        with open(args.ledger_file) as f:
+        with open(path) as f:
             for lineno, line in enumerate(f, 1):
                 line = line.strip()
                 if not line:
@@ -448,23 +457,140 @@ def _load_workload_records(args) -> list[dict]:
                     # checkpoint was interrupted pre-rename; tolerate it
                     print(f"WARNING: skipping unparseable ledger line {lineno}",
                           file=sys.stderr)
-        return records
+        sources.append({"name": path, "records": records})
     import urllib.request
 
-    url = args.workloads_url.rstrip("/") + "/debug/workloads"
-    with urllib.request.urlopen(url, timeout=10) as resp:
-        return json.load(resp)["workloads"]
+    for url in (args.workloads_url or []):
+        full = url.rstrip("/") + "/debug/workloads"
+        with urllib.request.urlopen(full, timeout=10) as resp:
+            doc = json.load(resp)
+        sources.append({"name": url, "records": doc.get("workloads", []),
+                        "cluster": doc.get("cluster")})
+
+    for src in sources:
+        stamped = [r for r in src["records"] if r.get("cluster")]
+        if stamped and len(stamped) != len(src["records"]):
+            raise SystemExit(
+                f"{src['name']}: mixed-schema checkpoint — "
+                f"{len(src['records']) - len(stamped)} of {len(src['records'])} "
+                "line(s) carry no cluster identity; refusing to merge a "
+                "half-stamped ledger (re-checkpoint it with a current daemon)")
+        src["schema2"] = bool(stamped) or bool(src.get("cluster"))
+    if len(sources) > 1:
+        # An empty checkpoint (a daemon that never tracked a workload) is
+        # schema-agnostic and merges fine; only sources with actual
+        # unstamped accounts are unmergeable.
+        legacy = [s["name"] for s in sources
+                  if s["records"] and not s["schema2"]]
+        if legacy:
+            raise SystemExit(
+                "cannot merge schema-1 ledger source(s) without cluster "
+                f"identity: {legacy} — every merged checkpoint needs the "
+                "daemon's cluster + epoch stamps (--cluster-name; any "
+                "current daemon writes them)")
+    return sources
+
+
+def _merge_ledger_sources(sources: list[dict]) -> tuple[list[dict], list[str]]:
+    """Merge N schema-2 sources into one record list, deterministically.
+
+    Conflict rule for the same cluster appearing in several sources: the
+    source with the HIGHER checkpoint epoch wins wholesale (epochs are
+    monotonic per daemon, so higher = fresher); equal epochs are accepted
+    only when the records are identical (the same file given twice),
+    otherwise the merge refuses — two divergent checkpoints claiming the
+    same cluster at the same epoch cannot be ordered."""
+    by_cluster: dict[str, dict] = {}
+    for src in sources:
+        groups: dict[str, list[dict]] = {}
+        for r in src["records"]:
+            groups.setdefault(r.get("cluster") or src.get("cluster") or "",
+                              []).append(r)
+        for cluster, records in groups.items():
+            epoch = max(int(r.get("epoch", 0)) for r in records)
+            incumbent = by_cluster.get(cluster)
+            if incumbent is None or epoch > incumbent["epoch"]:
+                by_cluster[cluster] = {"epoch": epoch, "records": records,
+                                       "name": src["name"]}
+            elif epoch == incumbent["epoch"]:
+                def keyed(rows):
+                    return sorted(json.dumps(r, sort_keys=True) for r in rows)
+                if keyed(records) != keyed(incumbent["records"]):
+                    raise SystemExit(
+                        f"sources {incumbent['name']!r} and {src['name']!r} "
+                        f"both claim cluster {cluster!r} at epoch {epoch} "
+                        "with DIVERGENT accounts; refusing to merge "
+                        "(two daemons sharing one --cluster-name?)")
+            # lower epoch: the incumbent is fresher — drop this copy
+    merged, clusters = [], []
+    for cluster in sorted(by_cluster):
+        clusters.append(cluster)
+        merged.extend(by_cluster[cluster]["records"])
+    return merged, clusters
 
 
 def _run_fleet_report(args) -> int:
-    """Per-namespace savings report over the workload utilization ledger."""
-    records = _load_workload_records(args)
+    """Per-namespace (and, with merged sources, per-cluster) savings
+    report over N workload utilization ledgers."""
+    sources = _load_ledger_sources(args)
+    schema2 = any(s["schema2"] for s in sources)
+    if schema2:
+        records, cluster_names = _merge_ledger_sources(sources)
+    else:  # single legacy schema-1 source: the pre-federation report
+        records, cluster_names = sources[0]["records"], []
+    # Cluster-qualified workload keys and table columns only earn their
+    # noise once the report actually spans clusters; a single-cluster
+    # report keeps the familiar shape (plus the "clusters" section).
+    multi = len(cluster_names) > 1
 
-    namespaces: dict[str, dict] = {}
+    if args.merged_ledger_out:
+        # Merged-checkpoint writer: the output is itself a valid schema-2
+        # multi-cluster JSONL source, so reports compose (feed it back in,
+        # alone or with fresher per-cluster checkpoints).
+        with open(args.merged_ledger_out, "w") as f:
+            for r in records:
+                f.write(json.dumps(r, sort_keys=True) + "\n")
+        print(f"wrote merged checkpoint ({len(records)} account(s), "
+              f"{len(cluster_names)} cluster(s)) to {args.merged_ledger_out}",
+              file=sys.stderr)
+
+    def wl_key(r):
+        base = r.get("workload") or (f"{r.get('kind')}/{r.get('namespace')}"
+                                     f"/{r.get('name')}")
+        return f"{r['cluster']}:{base}" if multi and r.get("cluster") else base
+
+    clusters: dict[str, dict] = {}
+    for r in records if schema2 else []:
+        cl = r.get("cluster", "")
+        agg = clusters.setdefault(cl, {
+            "cluster": cl, "workloads": 0, "chips": 0,
+            "reclaimed_chip_hours": 0.0, "idle_hours": 0.0,
+            "active_hours": 0.0, "pauses": 0, "resumes": 0,
+            "epoch": 0,
+            # raw seconds, NEVER rounded: the bit-for-bit join key against
+            # each member's own /debug/workloads totals
+            "reclaimed_chip_seconds": 0.0, "idle_seconds": 0.0,
+            "active_seconds": 0.0,
+        })
+        agg["workloads"] += 1
+        agg["chips"] += int(r.get("chips", 0))
+        agg["reclaimed_chip_seconds"] += float(r.get("reclaimed_chip_seconds", 0))
+        agg["idle_seconds"] += float(r.get("idle_seconds", 0))
+        agg["active_seconds"] += float(r.get("active_seconds", 0))
+        agg["reclaimed_chip_hours"] += float(r.get("reclaimed_chip_seconds", 0)) / 3600
+        agg["idle_hours"] += float(r.get("idle_seconds", 0)) / 3600
+        agg["active_hours"] += float(r.get("active_seconds", 0)) / 3600
+        agg["pauses"] += int(r.get("pauses", 0))
+        agg["resumes"] += int(r.get("resumes", 0))
+        agg["epoch"] = max(agg["epoch"], int(r.get("epoch", 0)))
+
+    namespaces: dict[tuple, dict] = {}
     pause_events = resume_events = 0
     for r in records:
         ns = r.get("namespace", "")
-        agg = namespaces.setdefault(ns, {
+        ns_key = (r.get("cluster", ""), ns) if multi else ("", ns)
+        agg = namespaces.setdefault(ns_key, {
+            **({"cluster": r.get("cluster", "")} if multi else {}),
             "namespace": ns, "workloads": 0, "chips": 0,
             "reclaimed_chip_hours": 0.0, "idle_hours": 0.0,
             "active_hours": 0.0, "pauses": 0, "resumes": 0,
@@ -489,29 +615,44 @@ def _run_fleet_report(args) -> int:
     if not records:
         print("ledger is empty: no workloads tracked yet", file=sys.stderr)
     else:
-        print(f"{'namespace':32s} {'workloads':>9s} {'chips':>6s} "
+        if multi:
+            print(f"{'cluster':20s} {'workloads':>9s} {'chips':>6s} "
+                  f"{'reclaimed chip-hrs':>18s} {'idle hrs':>9s} {'epoch':>6s}",
+                  file=sys.stderr)
+            for cl in sorted(clusters):
+                a = clusters[cl]
+                print(f"{a['cluster']:20s} {a['workloads']:9d} {a['chips']:6d} "
+                      f"{a['reclaimed_chip_hours']:18.3f} "
+                      f"{a['idle_hours']:9.3f} {a['epoch']:6d}",
+                      file=sys.stderr)
+            print("", file=sys.stderr)
+        ns_label = "cluster/namespace" if multi else "namespace"
+        print(f"{ns_label:32s} {'workloads':>9s} {'chips':>6s} "
               f"{'reclaimed chip-hrs':>18s} {'idle hrs':>9s} {'pauses':>6s} "
               f"{'resumes':>7s}", file=sys.stderr)
         for a in ns_rows:
-            print(f"{a['namespace']:32s} {a['workloads']:9d} {a['chips']:6d} "
+            ns_name = (f"{a['cluster']}/{a['namespace']}" if multi
+                       else a["namespace"])
+            print(f"{ns_name:32s} {a['workloads']:9d} {a['chips']:6d} "
                   f"{a['reclaimed_chip_hours']:18.3f} {a['idle_hours']:9.3f} "
                   f"{a['pauses']:6d} {a['resumes']:7d}", file=sys.stderr)
         print(f"\ntotal: {total_reclaimed:.3f} chip-hours reclaimed across "
-              f"{len(records)} tracked workload(s); {pause_events} pause / "
+              f"{len(records)} tracked workload(s)"
+              + (f" in {len(clusters)} cluster(s)" if multi else "")
+              + f"; {pause_events} pause / "
               f"{resume_events} resume event(s)", file=sys.stderr)
         print("\ntop offenders (reclaimed capacity):", file=sys.stderr)
         for r in offenders:
             if float(r.get("reclaimed_chip_seconds", 0)) <= 0:
                 continue
-            wl = r.get("workload") or (f"{r.get('kind')}/{r.get('namespace')}"
-                                       f"/{r.get('name')}")
-            print(f"  {wl:48s} {float(r['reclaimed_chip_seconds']) / 3600:10.3f} "
+            print(f"  {wl_key(r):48s} "
+                  f"{float(r['reclaimed_chip_seconds']) / 3600:10.3f} "
                   f"chip-hrs ({r.get('state', '?')})", file=sys.stderr)
 
     def round3(x):
         return round(x, 3)
 
-    print(json.dumps({
+    doc = {
         "tracked_workloads": len(records),
         "reclaimed_chip_hours": round3(total_reclaimed),
         "idle_workload_hours": round3(sum(a["idle_hours"] for a in ns_rows)),
@@ -520,9 +661,7 @@ def _run_fleet_report(args) -> int:
         "namespaces": [{k: (round3(v) if isinstance(v, float) else v)
                         for k, v in a.items()} for a in ns_rows],
         "top_offenders": [
-            {"workload": r.get("workload") or (f"{r.get('kind')}/"
-                                               f"{r.get('namespace')}/"
-                                               f"{r.get('name')}"),
+            {"workload": wl_key(r),
              "state": r.get("state"),
              "chips": int(r.get("chips", 0)),
              "reclaimed_chip_hours": round3(
@@ -530,7 +669,28 @@ def _run_fleet_report(args) -> int:
              "pauses": int(r.get("pauses", 0)),
              "resumes": int(r.get("resumes", 0))}
             for r in offenders if float(r.get("reclaimed_chip_seconds", 0)) > 0],
-    }))
+    }
+    if schema2:
+        # Per-cluster sections + fleet totals that provably sum: the fleet
+        # figures ARE the sum of the cluster rows (same floats, same
+        # order), so a consumer can re-add them and land on the totals
+        # bit-for-bit.
+        raw_keys = ("reclaimed_chip_seconds", "idle_seconds", "active_seconds")
+        doc["clusters"] = [
+            {k: (round3(v) if isinstance(v, float) and k not in raw_keys else v)
+             for k, v in clusters[cl].items()}
+            for cl in sorted(clusters)]
+        doc["fleet_totals"] = {
+            "reclaimed_chip_hours": round3(sum(
+                clusters[cl]["reclaimed_chip_hours"] for cl in sorted(clusters))),
+            "idle_workload_hours": round3(sum(
+                clusters[cl]["idle_hours"] for cl in sorted(clusters))),
+            "chips": sum(clusters[cl]["chips"] for cl in sorted(clusters)),
+            # raw seconds: sums of the per-cluster raw figures, bit-for-bit
+            **{k: sum(clusters[cl][k] for cl in sorted(clusters))
+               for k in raw_keys},
+        }
+    print(json.dumps(doc))
     return 0
 
 
@@ -553,17 +713,25 @@ def main(argv=None) -> int:
                              "daemon's metrics port (e.g. http://host:8080)")
     parser.add_argument("--fleet-report", action="store_true",
                         help="fleet-savings mode: render the per-namespace "
-                             "savings table (chip-hours reclaimed, top "
-                             "offenders, pause/resume churn) from the "
-                             "workload utilization ledger instead of "
-                             "evaluating a dump")
-    parser.add_argument("--ledger-file", metavar="FILE",
-                        help="with --fleet-report: read the daemon's "
-                             "--ledger-file JSONL checkpoint")
-    parser.add_argument("--workloads-url", metavar="URL",
+                             "(and per-cluster, when sources carry cluster "
+                             "identity) savings table from N workload "
+                             "utilization ledgers instead of evaluating a "
+                             "dump; merged totals provably sum and a stale "
+                             "duplicate of one cluster loses by checkpoint "
+                             "epoch")
+    parser.add_argument("--ledger-file", metavar="FILE", action="append",
+                        help="with --fleet-report: read a daemon's "
+                             "--ledger-file JSONL checkpoint (repeatable — "
+                             "one per cluster)")
+    parser.add_argument("--workloads-url", metavar="URL", action="append",
                         help="with --fleet-report: query /debug/workloads on "
-                             "the daemon's metrics port (e.g. "
-                             "http://host:8080)")
+                             "a daemon's metrics port (e.g. "
+                             "http://host:8080; repeatable)")
+    parser.add_argument("--merged-ledger-out", metavar="FILE",
+                        help="with --fleet-report: also write the merged "
+                             "accounts as one schema-2 JSONL checkpoint "
+                             "(itself a valid --ledger-file source, so "
+                             "reports compose)")
     parser.add_argument("--replay", metavar="CAPSULE",
                         help="replay mode: deterministically re-run a "
                              "flight-recorder cycle capsule (a --flight-dir "
@@ -624,13 +792,13 @@ def main(argv=None) -> int:
     if args.fleet_report:
         if args.explain:
             parser.error("--fleet-report and --explain are mutually exclusive")
-        if bool(args.ledger_file) == bool(args.workloads_url):
-            parser.error("--fleet-report needs exactly one of --ledger-file "
-                         "or --workloads-url")
+        if not args.ledger_file and not args.workloads_url:
+            parser.error("--fleet-report needs at least one --ledger-file "
+                         "or --workloads-url source (both repeatable)")
         return _run_fleet_report(args)
-    if args.ledger_file or args.workloads_url:
-        parser.error("--ledger-file/--workloads-url only apply with "
-                     "--fleet-report")
+    if args.ledger_file or args.workloads_url or args.merged_ledger_out:
+        parser.error("--ledger-file/--workloads-url/--merged-ledger-out only "
+                     "apply with --fleet-report")
     if args.explain:
         if bool(args.audit_log) == bool(args.decisions_url):
             parser.error("--explain needs exactly one of --audit-log or "

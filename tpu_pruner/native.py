@@ -157,6 +157,9 @@ def load() -> ctypes.CDLL:
         "tp_target_meta",
         "tp_otlp_grpc_call",
         "tp_audit_reason_codes",
+        "tp_fleet_metric_families",
+        "tp_fleet_aggregate",
+        "tp_stamp_exposition",
         "tp_replay_cycle",
         "tp_ledger_sim",
         "tp_ledger_metric_families",
@@ -268,6 +271,34 @@ def audit_reason_codes() -> list[str]:
     every code the daemon can emit, in enum order. The docs drift-guard
     test joins this list against docs/OPERATIONS.md."""
     return _call("tp_audit_reason_codes", {})["codes"]
+
+
+def fleet_metric_families() -> list[str]:
+    """Canonical tpu_pruner_fleet_* family names the federation hub serves
+    on /metrics — the docs drift-guard test joins this list against
+    docs/OPERATIONS.md."""
+    return _call("tp_fleet_metric_families", {})["families"]
+
+
+def fleet_aggregate(members: list[dict], stale_after_s: int = 30,
+                    decisions_per_member: int | None = None) -> dict:
+    """Run the REAL hub merge math (native/src/fleet.cpp) over synthetic
+    member snapshots. Each member: {"url", "cluster", "reachable",
+    "ever_reached"?, "staleness_s"?, "polls"?, "failures"?, "last_error"?,
+    "workloads"?, "signals"?, "decisions"?} where workloads/signals/
+    decisions are the member's /debug documents. Returns the four
+    /debug/fleet documents plus "metrics"/"metrics_openmetrics" exposition
+    text."""
+    payload: dict = {"members": members, "stale_after_s": stale_after_s}
+    if decisions_per_member is not None:
+        payload["decisions_per_member"] = decisions_per_member
+    return _call("tp_fleet_aggregate", payload)
+
+
+def stamp_exposition(body: str, cluster: str) -> str:
+    """Insert cluster="..." into every sample line of a Prometheus text
+    exposition (the fleet identity choke point; idempotent)."""
+    return _call("tp_stamp_exposition", {"body": body, "cluster": cluster})["body"]
 
 
 def replay_cycle(capsule: dict, what_if: dict | None = None) -> dict:

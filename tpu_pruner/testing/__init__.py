@@ -12,4 +12,14 @@ from tpu_pruner.testing.fake_k8s import FakeK8s
 from tpu_pruner.testing.fake_prom import FakePrometheus
 from tpu_pruner.testing.fake_proxy import FakeProxy
 
-__all__ = ["FakeK8s", "FakePrometheus", "FakeProxy"]
+__all__ = ["FakeFleet", "FakeK8s", "FakePrometheus", "FakeProxy", "FleetMember"]
+
+
+def __getattr__(name):
+    # FakeFleet spawns the daemon binary; import it lazily so the plain
+    # fakes stay importable without a built native tree.
+    if name in ("FakeFleet", "FleetMember"):
+        from tpu_pruner.testing import fake_fleet
+
+        return getattr(fake_fleet, name)
+    raise AttributeError(name)

@@ -1,0 +1,161 @@
+"""Fake fleet: N real member daemons (each against its own hermetic fake
+Prometheus + fake K8s API) plus the federation hub, in one process tree.
+
+The fleet tests, `just fleet-smoke`, and the bench's federation section
+all need the same scaffolding: spin member daemons with distinct
+--cluster-name identities and scripted evidence health, point a
+`tpu-pruner hub` at their metrics ports, and read the merged view back.
+Members are REAL daemon binaries — the fleet surface is asserted end to
+end, not against stubs.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+from pathlib import Path
+
+
+def _popen_with_port(cmd, env):
+    """Start a metrics-serving process and parse its ephemeral port from
+    stderr, then keep draining stderr on a thread (a --check-interval 1
+    daemon logs enough to fill an undrained pipe mid-test)."""
+    import subprocess
+
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    port = None
+    for line in proc.stderr:
+        m = re.search(r"serving /metrics on port (\d+)", line)
+        if m:
+            port = int(m.group(1))
+            break
+    assert port, f"{cmd[0]} never reported its metrics port"
+    drainer = threading.Thread(
+        target=lambda: [None for _ in proc.stderr], daemon=True)
+    drainer.start()
+    return proc, port
+
+
+def _http_get(port: int, path: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+class FleetMember:
+    """One member daemon with its own fakes, cluster identity and ledger."""
+
+    def __init__(self, cluster: str, tmp_dir: Path, *, idle_pods: int = 1,
+                 stale_pods: int = 0, tpu_chips: int = 4,
+                 signal_guard: str = "on", run_mode: str = "scale-down",
+                 extra_args: tuple = ()):
+        from tpu_pruner.native import DAEMON_PATH
+        from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+        self.cluster = cluster
+        self.prom = FakePrometheus()
+        self.k8s = FakeK8s()
+        self.prom.start()
+        self.k8s.start()
+        self.ledger_path = str(Path(tmp_dir) / f"ledger-{cluster}.jsonl")
+        # idle_pods have healthy evidence; stale_pods' newest sample is
+        # hours old, so the signal guard reads them STALE — enough of them
+        # drops coverage below --signal-min-coverage and browns the member
+        # out (healthy siblings then defer with SIGNAL_BROWNOUT but still
+        # resolve, so the member's ledger tracks their roots).
+        for i in range(idle_pods + stale_pods):
+            _, _, pods = self.k8s.add_deployment_chain(
+                "ml", f"{cluster}-dep-{i}", num_pods=1, tpu_chips=tpu_chips)
+            knobs = {"chips": tpu_chips}
+            if i >= idle_pods:
+                knobs["last_sample_age"] = 4000.0
+            self.prom.add_idle_pod_series(
+                pods[0]["metadata"]["name"], "ml", **knobs)
+        cmd = [str(DAEMON_PATH), "--prometheus-url", self.prom.url,
+               "--run-mode", run_mode, "--daemon-mode",
+               "--check-interval", "1", "--metrics-port", "auto",
+               "--cluster-name", cluster,
+               "--signal-guard", signal_guard,
+               "--ledger-file", self.ledger_path, *extra_args]
+        self.proc, self.port = _popen_with_port(
+            cmd, {"KUBE_API_URL": self.k8s.url})
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def get(self, path: str) -> str:
+        return _http_get(self.port, path)
+
+    def get_json(self, path: str) -> dict:
+        return json.loads(self.get(path))
+
+    def kill(self):
+        """Hard-stop the daemon (fakes stay up): the member goes dark the
+        way a crashed pod does, for UNREACHABLE-row tests."""
+        if self.proc.poll() is None:
+            self.proc.kill()
+        self.proc.wait(timeout=10)
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+        self.proc.wait(timeout=10)
+        self.prom.stop()
+        self.k8s.stop()
+
+
+class FakeFleet:
+    """N members + one hub. Use as a context manager, or call stop()."""
+
+    def __init__(self, tmp_dir):
+        self.tmp_dir = Path(tmp_dir)
+        self.members: list[FleetMember] = []
+        self.hub_proc = None
+        self.hub_port = None
+
+    def add_member(self, cluster: str, **kwargs) -> FleetMember:
+        member = FleetMember(cluster, self.tmp_dir, **kwargs)
+        self.members.append(member)
+        return member
+
+    def start_hub(self, *, poll_interval: int = 1, stale_after: int | None = None,
+                  member_urls: list[str] | None = None, extra_args: tuple = ()):
+        from tpu_pruner.native import DAEMON_PATH
+
+        urls = member_urls if member_urls is not None else [
+            m.url for m in self.members]
+        cmd = [str(DAEMON_PATH), "hub", "--metrics-port", "auto",
+               "--poll-interval", str(poll_interval),
+               "--cluster-name", "hub"]
+        if stale_after is not None:
+            cmd += ["--stale-after", str(stale_after)]
+        for url in urls:
+            cmd += ["--member", url]
+        cmd += list(extra_args)
+        self.hub_proc, self.hub_port = _popen_with_port(cmd, {})
+        return self.hub_port
+
+    def hub_get(self, path: str) -> str:
+        assert self.hub_port, "hub not started"
+        return _http_get(self.hub_port, path)
+
+    def hub_get_json(self, path: str) -> dict:
+        return json.loads(self.hub_get(path))
+
+    def stop(self):
+        if self.hub_proc is not None and self.hub_proc.poll() is None:
+            self.hub_proc.terminate()
+        if self.hub_proc is not None:
+            self.hub_proc.wait(timeout=10)
+        for m in self.members:
+            m.stop()
+
+    def __enter__(self) -> "FakeFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
