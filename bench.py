@@ -668,6 +668,59 @@ def run_fleet_federation():
         }
 
 
+def run_policy_gym():
+    """Policy-gym section: record a synthetic trace corpus with the real
+    daemon (trace_gen, back-to-back cycles), then time `tpu-pruner gym`
+    replaying it against the default 3-policy panel in one pass. The
+    number that matters is the gym's replay throughput — capsule cycles
+    re-decided per second across all policies — plus the winner's
+    reclaimed chip-hours (the simulator's output, not a fleet
+    projection)."""
+    import json as _json
+    import subprocess as _subprocess
+    import tempfile
+    import time as _time
+    from pathlib import Path as _Path
+
+    from tpu_pruner import native as _native
+    from tpu_pruner.testing import trace_gen
+
+    cycles = 40 if SMOKE else 200
+    tmp = _Path(tempfile.mkdtemp(prefix="tp-bench-gym-"))
+    spec = trace_gen.generate("flapping", cycles, workloads=3, seed=7)
+    t0 = _time.monotonic()
+    capsules = trace_gen.record_corpus(spec, tmp / "flight")
+    record_s = _time.monotonic() - t0
+    if len(capsules) != cycles:
+        raise RuntimeError(f"gym corpus recorded {len(capsules)}/{cycles} capsules")
+
+    t0 = _time.monotonic()
+    proc = _subprocess.run(
+        [str(_native.DAEMON_PATH), "gym", "--flight-dir", str(tmp / "flight"),
+         "--assume-interval", "180"],
+        capture_output=True, text=True, timeout=600)
+    gym_s = _time.monotonic() - t0
+    if proc.returncode != 0:
+        raise RuntimeError(f"gym exited {proc.returncode}: {proc.stderr[-500:]}")
+    out = _json.loads(proc.stdout)
+    winner = out.get("winner", {})
+    return {
+        "gym_cycles": cycles,
+        "gym_policies": len(out.get("policies", [])),
+        "gym_cycles_per_s": round(cycles / gym_s, 1),
+        "gym_wall_s": round(gym_s, 3),
+        "gym_corpus_record_s": round(record_s, 3),
+        "gym_best_policy": winner.get("name"),
+        "gym_best_policy_reclaimed_chip_hours": winner.get("reclaimed_chip_hours"),
+        "gym_best_policy_flag_line": winner.get("flag_line"),
+        "note": f"{cycles}-cycle synthetic flapping corpus (trace_gen, "
+                "recorded by the real daemon back-to-back) replayed against "
+                "the default 3-policy panel in one `tpu-pruner gym` pass; "
+                "cycles/s counts capsule cycles re-decided across ALL "
+                "policies",
+    }
+
+
 def measure_fixture_ceiling(k8s, seconds=1.5, threads=8):
     """Standalone serving ceiling of the fake apiserver (VERDICT r4 #7).
 
@@ -1501,6 +1554,19 @@ def main():
         fleet_fed = {"error": str(e)[-500:]}
         log(f"fleet federation section failed: {e}")
 
+    # Policy gym: synthetic corpus → 3 policies replayed in one pass.
+    # Failures degrade to a recorded error, like the federation section.
+    try:
+        gym = run_policy_gym()
+        log(f"policy gym: {gym['gym_cycles']}-cycle corpus x "
+            f"{gym['gym_policies']} policies in {gym['gym_wall_s']}s "
+            f"({gym['gym_cycles_per_s']} cycles/s); winner "
+            f"{gym['gym_best_policy']} reclaiming "
+            f"{gym['gym_best_policy_reclaimed_chip_hours']} chip-hrs")
+    except Exception as e:  # noqa: BLE001 — any fixture failure degrades
+        gym = {"error": str(e)[-500:]}
+        log(f"policy gym section failed: {e}")
+
     # TPU fleet eval with spaced retries: now, +60s, +120s (only on failure).
     tpu = tpu_section([None] if SMOKE else [
         None,
@@ -1569,6 +1635,7 @@ def main():
         "circuit_breaker": breaker,
         "watch_cache": watch_cache,
         "fleet_federation": fleet_fed,
+        "policy_gym": gym,
         "baseline_model": {"ref_wall_s": round(ref_wall, 3),
                            "ref_resolve_s": round(ref_resolve, 3),
                            "ref_scale_s": round(ref_scale, 3),
@@ -1617,6 +1684,11 @@ def main():
         # round latency (tpu_pruner_fleet_merge_seconds p50)
         "fleet_members": fleet_fed.get("fleet_members"),
         "fleet_merge_p50_ms": fleet_fed.get("fleet_merge_p50_ms"),
+        # policy gym: capsule-cycle replay throughput across the 3-policy
+        # panel + the winning policy's simulated savings
+        "gym_cycles_per_s": gym.get("gym_cycles_per_s"),
+        "gym_best_policy_reclaimed_chip_hours": gym.get(
+            "gym_best_policy_reclaimed_chip_hours"),
         "spread_max": (round(max(RUN_SPREADS.values()), 3)
                        if RUN_SPREADS else None),
         "detail_file": detail_path.name,

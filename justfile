@@ -96,6 +96,13 @@ replay-smoke:
 fleet-smoke:
     python -m tpu_pruner.testing.fleet_smoke
 
+# policy-gym smoke: synthetic 200-cycle trace corpus (trace_gen) recorded
+# by the real daemon, replayed against 3 policies in one pass, winner
+# flag line printed — non-zero exit when the scoring contract breaks.
+# tests/test_justfile_guard.py pins the recipe to the module it invokes.
+gym-smoke:
+    python -m tpu_pruner.testing.gym_smoke
+
 # standalone TPU capture: probe + fleet eval + bench_tpu_last_good.json
 # (run EARLY in a round / whenever the chip tunnel is up; exits 1 when no
 # real accelerator measurement happened)

@@ -54,4 +54,14 @@ bool already_paused(const core::ScaleTarget& target);
 bool scale_to_zero(const k8s::Client& client, const core::ScaleTarget& target,
                    const ScaleOptions& opts = {});
 
+// Replica right-sizing (--right-size on, gym.hpp): partial scale-down to
+// `replicas` for the replica-knob kinds — /scale merge-patch for
+// Deployment/ReplicaSet/StatefulSet/LeaderWorkerSet,
+// spec.predictor.minReplicas for InferenceService. Same Event-first
+// contract as scale_to_zero. Returns false when skip_if_already_paused
+// elided the patch (the object already shows <= replicas); throws on an
+// unsupported kind — the caller gates on gym::right_size_plan.
+bool scale_to_replicas(const k8s::Client& client, const core::ScaleTarget& target,
+                       int64_t replicas, const ScaleOptions& opts = {});
+
 }  // namespace tpupruner::actuate

@@ -55,6 +55,11 @@ enum class Reason : uint8_t {
   SignalAbsent,         // SIGNAL_ABSENT: no evidence series for the candidate at all
   SignalBrownout,       // SIGNAL_BROWNOUT: fleet coverage below --signal-min-coverage;
                         // every scale-down of the cycle deferred
+  // Replica right-sizing (--right-size on, gym.hpp): partially idle
+  // replica-knob roots scale to N instead of all-or-nothing zero.
+  RightSized,           // RIGHT_SIZED: partial scale-down patch landed (R → N replicas)
+  RightSizeHeld,        // RIGHT_SIZE_HELD: projected duty cycle stays over the
+                        // threshold at every lower replica count — no action
 };
 
 const char* reason_name(Reason r);

@@ -94,6 +94,15 @@ struct Cli {
   int64_t signal_scrape_interval = 30;    // --signal-scrape-interval: expected scrape cadence, s
   int64_t signal_max_age = 300;           // --signal-max-age: STALE threshold, s
   double signal_min_coverage = 0.9;       // --signal-min-coverage: brownout floor, 0-1
+  // --right-size {on, off}: replica right-sizing (gym.hpp). "on" scales
+  // partially idle replica-knob roots (Deployment/ReplicaSet/StatefulSet/
+  // LWS/InferenceService) to the smallest replica count whose projected
+  // per-replica duty cycle stays under --right-size-threshold, instead of
+  // the all-or-nothing scale-to-zero; audit codes RIGHT_SIZED /
+  // RIGHT_SIZE_HELD, partial reclaim in the ledger (freed chips × time).
+  // "off" (default) keeps exact decision parity.
+  std::string right_size = "off";
+  double right_size_threshold = 0.8;      // --right-size-threshold: duty ceiling, (0-1]
   std::string otlp_endpoint;              // --otlp-endpoint (default: $OTEL_EXPORTER_OTLP_ENDPOINT)
   std::string gcp_project;                // --gcp-project (Cloud Monitoring PromQL API)
   std::string monitoring_endpoint = "https://monitoring.googleapis.com";  // --monitoring-endpoint

@@ -30,6 +30,17 @@ struct CycleStats {
   uint64_t api_calls = 0;      // K8s API requests issued during the cycle
 };
 
+// Consumer instruction attached to each enqueued target. target_replicas
+// 0 = the classic scale-to-zero pause; > 0 = a right-size patch
+// (--right-size on, gym.hpp) to that replica count, crediting
+// freed_chips to the ledger as partial reclaim and landing a RIGHT_SIZED
+// DecisionRecord with `detail`.
+struct ScalePlan {
+  int64_t target_replicas = 0;
+  int64_t freed_chips = 0;
+  std::string detail;
+};
+
 // One evaluation cycle (reference: run_query_and_scale, main.rs:390-570).
 // `enqueue` receives each surviving target (enabled-kind filtering stays
 // consumer-side, as in the reference; `enabled` is used only so the
@@ -47,7 +58,7 @@ struct CycleStats {
 // whole cycle's scale-downs (signal.hpp).
 CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
                      core::ResourceSet enabled,
-                     const std::function<void(core::ScaleTarget)>& enqueue,
+                     const std::function<void(core::ScaleTarget, ScalePlan)>& enqueue,
                      const informer::ClusterCache* watch_cache = nullptr,
                      const std::string& evidence_query = "");
 

@@ -1,0 +1,122 @@
+// Policy gym: offline replay simulator for auto-scaling policies.
+//
+// The flight recorder (recorder.hpp) made single cycles replayable and
+// `--what-if` flips one knob at a time; the ledger (ledger.hpp) defined
+// the money math. The gym composes them into a KIS-S-style simulator
+// (arxiv 2507.07932): replay a *stream* of cycle capsules — a recorded
+// --flight-dir corpus or a synthetic trace (tpu_pruner/testing/trace_gen)
+// — against N candidate policies side by side in ONE pass over the
+// stream, scoring each with the ledger's own integration math:
+//
+//   reclaimed chip-hours   chips × time a policy kept roots scaled down
+//                          (the ledger's dt-integration, bit-for-bit for
+//                          the baseline policy on the recording run's own
+//                          capsules — asserted by tests/test_gym.py),
+//   false pauses           a pause whose root shows busy evidence within
+//                          --regret-window seconds (the workload was
+//                          needed; the pause cost a cold restart),
+//   actuation churn        pause + resume events (each is an API patch
+//                          and a workload disruption).
+//
+// Policies are first-class (PolicySpec):
+//   baseline               the daemon's current config, replayed verbatim
+//   sweep:<k=v,...>        a what-if overlay (lookback, grace, run_mode,
+//                          max_scale_per_cycle, ...) applied every cycle
+//   right-size[:threshold=T]
+//                          scale partially idle replica-knob roots to the
+//                          smallest replica count whose projected duty
+//                          cycle stays under T instead of all-or-nothing
+//                          zero (the batching-vs-multi-tenancy tradeoff,
+//                          arxiv 2308.13803)
+//   hysteresis[:pause_after=K]
+//                          per-root streak state: only pause after K
+//                          consecutive candidate cycles (flapping guard)
+//
+// The winner's config is emitted as a ready-to-apply daemon flag line.
+// The right-size policy is promoted into the daemon behind
+// `--right-size on|off` (off = exact decision parity); right_size_plan()
+// below is the ONE implementation of that math, shared by the daemon
+// (run_cycle), the replay engine (recorder::replay re-derives
+// RIGHT_SIZED / RIGHT_SIZE_HELD offline) and the simulator.
+//
+// Counterfactual honesty: a corpus recorded in scale-down mode carries
+// evidence shadows — once the live daemon paused a root, later capsules
+// hold no busy/idle evidence for it, so false-pause detection is
+// suppressed for live-paused roots (tracked from the capsules' own
+// actuation records). Corpora recorded in dry-run mode are evidence-
+// complete and are the recommended gym input; `assume_scale_down`
+// (default on) then scores every policy as if it had been acting.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tpupruner/core.hpp"
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::gym {
+
+// ── replica right-sizing (shared daemon / replay / simulator math) ──
+
+struct RightSizePlan {
+  // False when the kind has no replica knob, the root object carries no
+  // replica count, R <= 1, or every replica is idle — all of which keep
+  // the classic scale-to-zero pause (exact baseline behavior).
+  bool applicable = false;
+  int64_t current_replicas = 0;
+  int64_t busy_replicas = 0;    // replicas NOT observed idle this cycle
+  int64_t target_replicas = 0;  // N = min(R, ceil(busy / threshold))
+  int64_t freed_chips = 0;      // chips_per_replica × (R − N)
+  bool held = false;            // N >= R: nothing to shrink this cycle
+  std::string detail;           // deterministic audit/replay detail string
+};
+
+// The right-size decision for one resolved root: scale to the smallest
+// replica count N whose projected per-replica duty cycle — busy_replicas
+// (each conservatively assumed fully busy) redistributed over N replicas
+// — stays under `threshold`. `idle_pods`/`idle_chips` are the cycle's
+// observed idle evidence for the root (the ledger observation); replica
+// counts come from the root object (spec.replicas, or
+// spec.predictor.minReplicas for InferenceService). Pure and
+// deterministic: the daemon, the offline replay and the gym all call
+// exactly this.
+RightSizePlan right_size_plan(core::Kind kind, const json::Value& root_object,
+                              int64_t idle_pods, int64_t idle_chips,
+                              double threshold);
+
+// ── policy specs ──
+
+// Parse a CLI policy spec string into the structured form simulate()
+// takes: "baseline", "sweep:lookback=10m,grace=60",
+// "right-size[:threshold=0.8]", "hysteresis[:pause_after=3]". Throws
+// std::runtime_error on malformed specs (unknown kinds/keys surface on
+// replay). The spec string itself becomes the policy name.
+json::Value parse_policy_spec(const std::string& spec);
+
+// The default 3-policy panel (baseline, right-size:threshold=0.8,
+// hysteresis:pause_after=3) used when no --policy is given.
+json::Value default_policies();
+
+// ── the simulator ──
+// payload:
+//   {"capsules": [<capsule JSON>...],        // any order; sorted by cycle
+//    "policies": ["baseline", {...}, ...],   // spec strings or objects
+//    "regret_window_s": 600,                 // false-pause window
+//    "assume_scale_down": true,              // score dry-run corpora as
+//                                            // if run_mode=scale-down
+//    "false_pause_penalty_chip_hours": 1.0,  // scoring weights
+//    "churn_penalty_chip_hours": 0.01}
+// Returns {"cycles", "policies": [{name, kind, reclaimed_chip_seconds,
+// reclaimed_chip_hours, false_pauses, pauses, resumes, actuation_churn,
+// right_size_applied, right_size_held, score, flag_line}...],
+// "winner": {...}, "regret_window_s", "assume_scale_down"}. Throws on
+// malformed capsules or policy specs.
+json::Value simulate(const json::Value& payload);
+
+// `tpu-pruner gym` entry point (flag surface: --flight-dir, --capsule,
+// --policy, --regret-window, --as-recorded, --false-pause-penalty,
+// --churn-penalty). Human table on stderr, one JSON document on stdout.
+int run_cli(int argc, char** argv);
+
+}  // namespace tpupruner::gym

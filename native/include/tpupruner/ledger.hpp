@@ -43,10 +43,14 @@
 namespace tpupruner::ledger {
 
 // One cycle's evidence for one root: the root identity plus the chips its
-// observed idle pods reserve (summed per root by the caller).
+// observed idle pods reserve (summed per root by the caller). `pods` is
+// the contributing idle-pod count — the ledger itself only integrates
+// chips, but the right-size planner (gym.hpp) and the flight capsule's
+// ledger stamp ride the same struct.
 struct Observation {
   std::string kind, ns, name;
   int64_t chips = 0;
+  int64_t pods = 0;
 };
 
 // A currently-paused account (kind/ns/name), for the daemon's informer
@@ -75,6 +79,16 @@ void observe_cycle(uint64_t cycle, int64_t now_unix,
 // audit reason code (SCALED / ALREADY_PAUSED).
 void record_pause(uint64_t cycle, const std::string& kind, const std::string& ns,
                   const std::string& name, const std::string& reason);
+
+// A right-size patch landed (--right-size on): the root kept its busy
+// replicas and freed `freed_chips` worth of idle ones. The account enters
+// the "right_sized" state — partial reclaim accrues as freed_chips × dt,
+// exactly like a pause accrues chips_when_paused × dt. Repeated
+// right-sizes of the same root (progressive consolidation) ACCUMULATE
+// freed chips; a later full pause upgrades the account in record_pause.
+// No-op when the account is already fully paused.
+void record_right_size(uint64_t cycle, const std::string& kind, const std::string& ns,
+                       const std::string& name, int64_t freed_chips);
 
 // A paused root came back (informer saw it leave its paused state, or a
 // test drives the transition directly). No-op when not marked paused.

@@ -40,6 +40,7 @@
 #include <vector>
 
 #include "tpupruner/json.hpp"
+#include "tpupruner/ledger.hpp"
 
 namespace tpupruner::recorder {
 
@@ -83,6 +84,13 @@ void record_resolution(uint64_t cycle, const std::string& key,
 // One owner/root object the walk consulted (FetchCache snapshot entry);
 // nullptr records a cached miss (404) explicitly.
 void record_object(uint64_t cycle, const std::string& path, const json::Value* object);
+// The cycle's ledger feed, verbatim: the clock and per-root observations
+// passed to ledger::observe_cycle. The policy gym integrates savings from
+// exactly these inputs, so its baseline policy reproduces the live
+// ledger's reclaimed chip-seconds bit-for-bit on the recording run's own
+// capsules.
+void record_ledger(uint64_t cycle, int64_t now_unix,
+                   const std::vector<ledger::Observation>& observations);
 // Cycle facts: fail-closed veto sets, per-root gate flags, breaker stamp.
 void record_vetoes(uint64_t cycle, const std::vector<std::string>& vetoed_roots,
                    const std::vector<std::pair<std::string, std::string>>& vetoed_namespaces);

@@ -119,4 +119,79 @@ bool scale_to_zero(const k8s::Client& client, const ScaleTarget& target,
   return true;
 }
 
+bool scale_to_replicas(const k8s::Client& client, const ScaleTarget& target, int64_t replicas,
+                       const ScaleOptions& opts) {
+  auto ns_opt = target.ns();
+  if (!ns_opt) throw std::runtime_error("target has no namespace: " + target.name());
+  const std::string& ns = *ns_opt;
+  const std::string name = target.name();
+
+  // Freshness-gated no-op, like scale_to_zero's already_paused skip: the
+  // resolved object already sits at (or below) the right-sized count.
+  if (opts.skip_if_already_paused) {
+    const Value* current = target.kind == Kind::InferenceService
+                               ? target.object.at_path("spec.predictor.minReplicas")
+                               : target.object.at_path("spec.replicas");
+    if (current && current->is_number() && current->as_int() <= replicas) {
+      log::debug("actuate", ns + "/" + name + " already at or below " +
+                 std::to_string(replicas) + " replicas; skipping");
+      return false;
+    }
+  }
+
+  auto started = std::chrono::steady_clock::now();
+  struct Observe {
+    std::chrono::steady_clock::time_point start;
+    const std::string& trace_id;
+    ~Observe() {
+      log::histogram_observe(
+          "scale_patch_seconds", "",
+          std::chrono::duration<double>(std::chrono::steady_clock::now() - start).count(),
+          trace_id);
+    }
+  } observe{started, opts.trace_id};
+
+  {
+    core::EventOptions ev_opts;
+    ev_opts.device = opts.device;
+    ev_opts.now_unix = opts.now_unix;
+    ev_opts.reporting_instance = opts.reporting_instance;
+    Value event = core::generate_scale_event(target, ev_opts);
+    try {
+      client.post(k8s::Client::events_path(ns), event);
+      log::debug("actuate", "emitted scale event for " + ns + "/" + name);
+    } catch (const std::exception& e) {
+      log::error("actuate", std::string("Failed to push Event for scale down!: ") + e.what());
+    }
+  }
+
+  switch (target.kind) {
+    case Kind::Deployment:
+    case Kind::ReplicaSet:
+    case Kind::StatefulSet:
+    case Kind::LeaderWorkerSet: {
+      Value patch = Value::object();
+      Value spec = Value::object();
+      spec.set("replicas", Value(replicas));
+      patch.set("spec", std::move(spec));
+      client.patch_merge(k8s::Client::scale_path(target.kind, ns, name), patch);
+      break;
+    }
+    case Kind::InferenceService: {
+      Value predictor = Value::object();
+      predictor.set("minReplicas", Value(replicas));
+      Value spec = Value::object();
+      spec.set("predictor", std::move(predictor));
+      Value patch = Value::object();
+      patch.set("spec", std::move(spec));
+      client.patch_merge(k8s::Client::object_path(Kind::InferenceService, ns, name), patch);
+      break;
+    }
+    default:
+      throw std::runtime_error(std::string("right-size unsupported for kind ") +
+                               std::string(core::kind_name(target.kind)));
+  }
+  return true;
+}
+
 }  // namespace tpupruner::actuate

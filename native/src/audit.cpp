@@ -118,12 +118,14 @@ const char* reason_name(Reason r) {
     case Reason::SignalGappy: return "SIGNAL_GAPPY";
     case Reason::SignalAbsent: return "SIGNAL_ABSENT";
     case Reason::SignalBrownout: return "SIGNAL_BROWNOUT";
+    case Reason::RightSized: return "RIGHT_SIZED";
+    case Reason::RightSizeHeld: return "RIGHT_SIZE_HELD";
   }
   return "?";
 }
 
 std::optional<Reason> reason_from_name(std::string_view name) {
-  for (int i = 0; i <= static_cast<int>(Reason::SignalBrownout); ++i) {
+  for (int i = 0; i <= static_cast<int>(Reason::RightSizeHeld); ++i) {
     Reason r = static_cast<Reason>(i);
     if (name == reason_name(r)) return r;
   }
@@ -132,7 +134,7 @@ std::optional<Reason> reason_from_name(std::string_view name) {
 
 std::vector<std::string> all_reason_codes() {
   std::vector<std::string> out;
-  for (int i = 0; i <= static_cast<int>(Reason::SignalBrownout); ++i) {
+  for (int i = 0; i <= static_cast<int>(Reason::RightSizeHeld); ++i) {
     out.push_back(reason_name(static_cast<Reason>(i)));
   }
   return out;

@@ -15,6 +15,7 @@
 #include "otlp_grpc.hpp"
 #include "tpupruner/audit.hpp"
 #include "tpupruner/fleet.hpp"
+#include "tpupruner/gym.hpp"
 #include "tpupruner/recorder.hpp"
 #include "tpupruner/core.hpp"
 #include "tpupruner/informer.hpp"
@@ -581,6 +582,51 @@ char* tp_replay_cycle(const char* payload_json) {
     const Value* what_if = p.find("what_if");
     return ok(tpupruner::recorder::replay(*capsule,
                                           what_if ? *what_if : Value::object()));
+  });
+}
+
+char* tp_gym_simulate(const char* payload_json) {
+  // Policy gym (gym.cpp): replay a capsule corpus against N policies in
+  // one pass, scoring reclaimed chip-hours vs false pauses vs actuation
+  // churn with the ledger's own integration math — the `analyze --gym`
+  // backend. Payload: {"capsules": [...], "policies": ["baseline",
+  // "right-size:threshold=0.8", ...]?, "regret_window_s"?,
+  // "assume_scale_down"?, "false_pause_penalty_chip_hours"?,
+  // "churn_penalty_chip_hours"?}. Policies may be spec strings or
+  // structured objects. Returns {cycles, policies: [...], winner, ...}.
+  return guarded([&] {
+    return ok(tpupruner::gym::simulate(Value::parse(payload_json)));
+  });
+}
+
+char* tp_right_size_plan(const char* payload_json) {
+  // The replica right-sizing math (gym::right_size_plan) — the ONE
+  // implementation the daemon, the replay engine and the gym share —
+  // exposed for the pytest tier. Payload: {"kind": "Deployment",
+  // "object": {...}, "idle_pods": N, "idle_chips": N, "threshold": 0.8}.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    auto kind = core::kind_from_name(p.get_string("kind"));
+    if (!kind) throw std::runtime_error("unknown kind: " + p.get_string("kind"));
+    const Value* object = p.find("object");
+    if (!object) throw std::runtime_error("missing object");
+    auto num = [&](const char* key, int64_t dflt) {
+      const Value* v = p.find(key);
+      return v && v->is_number() ? v->as_int() : dflt;
+    };
+    double threshold = 0.8;
+    if (const Value* t = p.find("threshold"); t && t->is_number()) threshold = t->as_double();
+    tpupruner::gym::RightSizePlan plan = tpupruner::gym::right_size_plan(
+        *kind, *object, num("idle_pods", 0), num("idle_chips", 0), threshold);
+    Value out = Value::object();
+    out.set("applicable", Value(plan.applicable));
+    out.set("current_replicas", Value(plan.current_replicas));
+    out.set("busy_replicas", Value(plan.busy_replicas));
+    out.set("target_replicas", Value(plan.target_replicas));
+    out.set("freed_chips", Value(plan.freed_chips));
+    out.set("held", Value(plan.held));
+    out.set("detail", Value(plan.detail));
+    return ok(out);
   });
 }
 

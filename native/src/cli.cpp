@@ -58,6 +58,8 @@ USAGE:
   tpu-pruner querytest <promql> <prometheus-url>
   tpu-pruner hub --member <url> [...]   (fleet federation hub; see
                                          `tpu-pruner hub --help`)
+  tpu-pruner gym --flight-dir <dir>     (offline policy simulator; see
+                                         `tpu-pruner gym --help`)
 
 FLAGS:
   -t, --duration <MIN>          minutes of no activity required to prune [default: 30]
@@ -65,7 +67,8 @@ FLAGS:
   -e, --enabled-resources <S>   kinds that may be scaled, as flag chars [default: drsinjl]
                                   d=Deployment r=ReplicaSet s=StatefulSet l=LeaderWorkerSet
                                   i=InferenceService n=Notebook j=JobSet
-  -c, --check-interval <SEC>    daemon-mode cycle interval [default: 180]
+  -c, --check-interval <SEC>    daemon-mode cycle interval; 0 = back-to-back
+                                cycles (gym corpus recording) [default: 180]
   -n, --namespace <REGEX>       namespace filter pushed into the query
       --namespace-exclude <RE>  namespaces to exclude (ns !~ in the query;
                                 RE2 has no lookahead, so this can't be
@@ -186,6 +189,23 @@ TPU FLAGS:
                                 which the cycle browns out — all
                                 scale-downs deferred, like the circuit
                                 breaker [default: 0.9]
+      --right-size <M>          on | off [default: off] — replica
+                                right-sizing: a partially idle Deployment/
+                                ReplicaSet/StatefulSet/LeaderWorkerSet/
+                                InferenceService scales to the smallest
+                                replica count whose projected per-replica
+                                duty cycle stays under
+                                --right-size-threshold, instead of the
+                                all-or-nothing scale-to-zero (audit codes
+                                RIGHT_SIZED / RIGHT_SIZE_HELD; the ledger
+                                credits the freed chips as partial
+                                reclaim). Tune offline with
+                                `tpu-pruner gym` before enabling. "off"
+                                keeps exact decision parity
+      --right-size-threshold <F>
+                                per-replica duty-cycle ceiling for
+                                --right-size: scale to
+                                N = ceil(busy_replicas / F) [default: 0.8]
       --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
                                 [default: $OTEL_EXPORTER_OTLP_ENDPOINT]
       --gcp-project <ID>        query the Cloud Monitoring PromQL API for this
@@ -341,6 +361,17 @@ Cli parse(int argc, char** argv) {
          if (cli.signal_min_coverage < 0.0 || cli.signal_min_coverage > 1.0)
            throw CliError("--signal-min-coverage must be between 0 and 1");
        }},
+      {"--right-size",
+       [&](const std::string& v) {
+         check_choice("--right-size", v, {"on", "off"});
+         cli.right_size = v;
+       }},
+      {"--right-size-threshold",
+       [&](const std::string& v) {
+         cli.right_size_threshold = parse_double("--right-size-threshold", v);
+         if (!(cli.right_size_threshold > 0.0 && cli.right_size_threshold <= 1.0))
+           throw CliError("--right-size-threshold must be in (0, 1]");
+       }},
       {"--otlp-endpoint", [&](const std::string& v) { cli.otlp_endpoint = v; }},
       {"--gcp-project", [&](const std::string& v) { cli.gcp_project = v; }},
       {"--monitoring-endpoint", [&](const std::string& v) { cli.monitoring_endpoint = v; }},
@@ -414,7 +445,9 @@ Cli parse(int argc, char** argv) {
     throw CliError("--metric-schema=gke-system requires --device=tpu");
   }
   if (cli.duration < 1) throw CliError("--duration must be >= 1 minute");
-  if (cli.check_interval < 1) throw CliError("--check-interval must be >= 1 second");
+  // 0 = no sleep between cycles: back-to-back evaluation for recording
+  // multi-hundred-cycle gym corpora against hermetic fakes (trace_gen).
+  if (cli.check_interval < 0) throw CliError("--check-interval must be >= 0 seconds");
   if (cli.grace_period < 0) throw CliError("--grace-period must be >= 0");
   if (cli.leader_elect && !cli.daemon_mode) {
     throw CliError("--leader-elect requires --daemon-mode");

@@ -19,6 +19,7 @@
 #include "tpupruner/audit.hpp"
 #include "tpupruner/auth.hpp"
 #include "tpupruner/fleet.hpp"
+#include "tpupruner/gym.hpp"
 #include "tpupruner/http.hpp"
 #include "tpupruner/leader.hpp"
 #include "tpupruner/ledger.hpp"
@@ -42,6 +43,8 @@ namespace {
 struct QueuedTarget {
   ScaleTarget target;
   uint64_t cycle = 0;
+  // target_replicas 0 = scale-to-zero; > 0 = right-size patch (gym.hpp).
+  ScalePlan plan;
 };
 
 // Bounded MPSC queue with close semantics (reference: tokio mpsc::channel
@@ -449,6 +452,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
           obs.name = target->name();
         }
         obs.chips += core::pod_chip_count(*e.pod, args.device);
+        obs.pods += 1;  // contributing idle pods (right-size evidence)
         out.resolved_records.emplace_back(target->identity(), std::move(rec));
         out.targets.push_back(std::move(*target));
       }
@@ -482,7 +486,7 @@ static auto with_span(otlp::Span& span, Fn&& fn) -> decltype(fn()) {
 
 CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
                      core::ResourceSet enabled,
-                     const std::function<void(ScaleTarget)>& enqueue,
+                     const std::function<void(ScaleTarget, ScalePlan)>& enqueue,
                      const informer::ClusterCache* watch_cache,
                      const std::string& evidence_query) {
   // Audit cycle id first (stamps every log line of the cycle), then the
@@ -606,12 +610,16 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   }
   // Workload ledger: fold this cycle's idle-root evidence in BEFORE any
   // target is enqueued — a fast consumer's record_pause must find the
-  // account (and its chip count) already present.
+  // account (and its chip count) already present. The SAME clock and
+  // observations are stamped into the flight capsule, so the policy
+  // gym's baseline integration reproduces this ledger bit-for-bit.
   {
     std::vector<ledger::Observation> obs;
     obs.reserve(resolved.ledger_obs.size());
-    for (auto& [key, o] : resolved.ledger_obs) obs.push_back(std::move(o));
-    ledger::observe_cycle(cycle_id, util::now_unix(), obs);
+    for (auto& [key, o] : resolved.ledger_obs) obs.push_back(o);
+    const int64_t ledger_now = util::now_unix();
+    recorder::record_ledger(cycle_id, ledger_now, obs);
+    ledger::observe_cycle(cycle_id, ledger_now, obs);
   }
   std::vector<ScaleTarget> unique = core::dedup_targets(std::move(resolved.targets));
   // Flight recorder: the fail-closed veto sets are cycle facts (cluster
@@ -759,6 +767,53 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
     survivors.clear();
   }
 
+  // Replica right-sizing (--right-size on, scale-down mode): split each
+  // enabled-kind survivor on gym::right_size_plan — the SAME math the
+  // replay engine re-derives offline, so these decisions replay
+  // bit-for-bit. Partially idle replica-knob roots scale to N (partial
+  // reclaim) instead of zero; roots whose projected duty cycle stays
+  // over the threshold at every lower count are held (RIGHT_SIZE_HELD).
+  // Disabled kinds pass through for the consumer's KIND_DISABLED record,
+  // and dry-run keeps plain DRY_RUN records (preview right-size effects
+  // offline with `tpu-pruner gym` / `analyze --what-if right_size=on`).
+  std::unordered_map<std::string, ScalePlan> rs_plans;
+  if (args.right_size == "on" && !args.dry_run()) {
+    std::vector<ScaleTarget> kept;
+    kept.reserve(survivors.size());
+    for (ScaleTarget& t : survivors) {
+      if (!(enabled & core::flag(t.kind))) {
+        kept.push_back(std::move(t));
+        continue;
+      }
+      const std::string lkey = std::string(core::kind_name(t.kind)) + "/" +
+                               t.ns().value_or("") + "/" + t.name();
+      int64_t idle_pods = 0, idle_chips = 0;
+      if (auto it = resolved.ledger_obs.find(lkey); it != resolved.ledger_obs.end()) {
+        idle_pods = it->second.pods;
+        idle_chips = it->second.chips;
+      }
+      gym::RightSizePlan plan = gym::right_size_plan(t.kind, t.object, idle_pods, idle_chips,
+                                                     args.right_size_threshold);
+      if (!plan.applicable) {
+        kept.push_back(std::move(t));  // classic scale-to-zero
+        continue;
+      }
+      if (plan.held) {
+        log::info("daemon", "Right-size hold [" + std::string(core::kind_name(t.kind)) + "] " +
+                  t.ns().value_or("") + ":" + t.name() + ": " + plan.detail);
+        outcome.emplace(t.identity(),
+                        std::make_pair(audit::Reason::RightSizeHeld, plan.detail));
+        continue;
+      }
+      log::info("daemon", "Right-sizing [" + std::string(core::kind_name(t.kind)) + "] " +
+                t.ns().value_or("") + ":" + t.name() + ": " + plan.detail);
+      rs_plans.emplace(t.identity(),
+                       ScalePlan{plan.target_replicas, plan.freed_chips, plan.detail});
+      kept.push_back(std::move(t));
+    }
+    survivors = std::move(kept);
+  }
+
   CycleStats stats;
   stats.num_series = decoded.num_series;
   stats.num_pods = decoded.samples.size();
@@ -813,8 +868,10 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
     if (args.dry_run()) {
       log::info("daemon", "Dry-run: Would have sent " + desc + " for scaledown");
     } else {
+      ScalePlan plan;
+      if (auto it = rs_plans.find(t.identity()); it != rs_plans.end()) plan = it->second;
       log::info("daemon", "Sending " + desc + " for scaledown");
-      enqueue(std::move(t));
+      enqueue(std::move(t), std::move(plan));
     }
   }
   observe_phase("total", cycle_start);
@@ -886,6 +943,8 @@ int run(const cli::Cli& args) {
     config.set("signal_scrape_interval_s", json::Value(args.signal_scrape_interval));
     config.set("signal_max_age_s", json::Value(args.signal_max_age));
     config.set("signal_min_coverage", json::Value(args.signal_min_coverage));
+    config.set("right_size", json::Value(args.right_size));
+    config.set("right_size_threshold", json::Value(args.right_size_threshold));
     recorder::set_run_context(std::move(config), query, evidence_query);
     audit::set_record_sink([](const audit::DecisionRecord& rec) {
       recorder::record_decision(rec.cycle, rec.to_json());
@@ -1112,6 +1171,40 @@ int run(const cli::Cli& args) {
       span.attr("namespace", t.ns().value_or(""));
       http::set_thread_traceparent(otlp::traceparent(span.context()));
       opts.trace_id = span.context().trace_id;
+      if (item->plan.target_replicas > 0) {
+        // Right-size actuation (--right-size on): partial scale-down to
+        // the planned replica count, partial reclaim in the ledger.
+        bool patched = false;
+        try {
+          patched = actuate::scale_to_replicas(kube, t, item->plan.target_replicas, opts);
+        } catch (const std::exception& e) {
+          span.set_error(e.what());
+          log::counter_add("scale_failures", 1);
+          log::error("daemon", std::string("Failed to right-size resource! ") + e.what());
+          finish(audit::Reason::ScaleFailed, "scale_down", e.what());
+          http::set_thread_traceparent("");
+          continue;
+        }
+        http::set_thread_traceparent("");
+        if (!patched) {
+          log::counter_add("scale_noops", 1);
+          log::info("daemon", "Already right-sized (no-op): [" +
+                    std::string(core::kind_name(t.kind)) + "] - " +
+                    t.ns().value_or("default") + ":" + t.name());
+          finish(audit::Reason::AlreadyPaused, "none",
+                 "root already at or below its right-sized replica count");
+          continue;
+        }
+        log::counter_add("scale_successes", 1);
+        log::counter_add("right_sizes_total", 1);
+        log::info("daemon", "Right-sized Resource: [" + std::string(core::kind_name(t.kind)) +
+                  "] - " + t.ns().value_or("default") + ":" + t.name() + " (" +
+                  item->plan.detail + ")");
+        finish(audit::Reason::RightSized, "scale_down", item->plan.detail);
+        ledger::record_right_size(item->cycle, std::string(core::kind_name(t.kind)),
+                                  t.ns().value_or(""), t.name(), item->plan.freed_chips);
+        continue;
+      }
       bool patched = false;
       try {
         patched = actuate::scale_to_zero(kube, t, opts);
@@ -1213,8 +1306,9 @@ int run(const cli::Cli& args) {
     }
     last_cycle_failed = false;
     try {
-      CycleStats stats = run_cycle(args, query, kube, enabled, [&](ScaleTarget t) {
-        queue.push({std::move(t), audit::current_cycle()});
+      CycleStats stats = run_cycle(args, query, kube, enabled,
+                                   [&](ScaleTarget t, ScalePlan plan) {
+        queue.push({std::move(t), audit::current_cycle(), std::move(plan)});
       }, watch_cache.get(), evidence_query);
       consecutive_failures = 0;
       log::counter_add("query_successes", 1);

@@ -1,0 +1,775 @@
+#include "tpupruner/gym.hpp"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+#include <filesystem>
+#include <map>
+#include <set>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "tpupruner/k8s.hpp"
+#include "tpupruner/log.hpp"
+#include "tpupruner/recorder.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::gym {
+
+namespace fs = std::filesystem;
+using json::Value;
+
+namespace {
+
+std::string fmt_g(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%g", v);
+  return buf;
+}
+
+double round3(double v) { return std::round(v * 1000.0) / 1000.0; }
+
+}  // namespace
+
+// ── right-size math (the ONE implementation: daemon + replay + gym) ──
+
+RightSizePlan right_size_plan(core::Kind kind, const Value& root_object,
+                              int64_t idle_pods, int64_t idle_chips, double threshold) {
+  if (!(threshold > 0.0 && threshold <= 1.0)) {
+    throw std::runtime_error("right-size threshold must be in (0, 1]");
+  }
+  RightSizePlan p;
+  const Value* replicas = nullptr;
+  switch (kind) {
+    case core::Kind::Deployment:
+    case core::Kind::ReplicaSet:
+    case core::Kind::StatefulSet:
+    case core::Kind::LeaderWorkerSet:
+      replicas = root_object.at_path("spec.replicas");
+      break;
+    case core::Kind::InferenceService:
+      // minReplicas is the knob the pruner owns for KServe; treat it as
+      // the root's floor replica count (the classic pause sets it to 0).
+      replicas = root_object.at_path("spec.predictor.minReplicas");
+      break;
+    default:
+      return p;  // no replica knob (JobSet suspend, Notebook annotation)
+  }
+  if (!replicas || !replicas->is_number()) return p;
+  const int64_t r = replicas->as_int();
+  if (r <= 1) return p;  // right-sizing a single replica IS scale-to-zero
+  const int64_t busy = r - idle_pods;
+  if (busy <= 0) return p;  // fully idle: the classic pause frees everything
+  p.applicable = true;
+  p.current_replicas = r;
+  p.busy_replicas = busy;
+  // Smallest N whose projected per-replica duty cycle — busy replicas,
+  // each conservatively assumed fully busy, consolidated onto N — stays
+  // under the threshold: N = ceil(busy / threshold), clamped to R.
+  int64_t n = static_cast<int64_t>(std::ceil(static_cast<double>(busy) / threshold));
+  p.held = n >= r;
+  p.target_replicas = p.held ? r : n;
+  const int64_t chips_per_replica = idle_pods > 0 ? idle_chips / idle_pods : 0;
+  p.freed_chips = (r - p.target_replicas) * chips_per_replica;
+  if (p.held) {
+    p.detail = "right-size held at " + std::to_string(r) + " replicas (" +
+               std::to_string(busy) + " busy over threshold " + fmt_g(threshold) + ")";
+  } else {
+    p.detail = "right-sized from " + std::to_string(r) + " to " +
+               std::to_string(p.target_replicas) + " replicas (" + std::to_string(busy) +
+               " busy, threshold " + fmt_g(threshold) + ", freed " +
+               std::to_string(p.freed_chips) + " chips)";
+  }
+  return p;
+}
+
+// ── policy specs ──
+
+Value parse_policy_spec(const std::string& spec) {
+  std::string head = spec, rest;
+  if (auto colon = spec.find(':'); colon != std::string::npos) {
+    head = spec.substr(0, colon);
+    rest = spec.substr(colon + 1);
+  }
+  auto kv_pairs = [&] {
+    std::vector<std::pair<std::string, std::string>> out;
+    for (const std::string& pair : util::split(rest, ',')) {
+      std::string t = util::trim(pair);
+      if (t.empty()) continue;
+      auto eq = t.find('=');
+      if (eq == std::string::npos) {
+        throw std::runtime_error("policy spec '" + spec + "': expected key=value, got '" + t +
+                                 "'");
+      }
+      out.push_back({t.substr(0, eq), t.substr(eq + 1)});
+    }
+    return out;
+  };
+  auto num = [&](const std::string& key, const std::string& v) {
+    try {
+      size_t idx = 0;
+      double d = std::stod(v, &idx);
+      if (idx != v.size()) throw std::invalid_argument("trailing");
+      return d;
+    } catch (const std::exception&) {
+      throw std::runtime_error("policy spec '" + spec + "': invalid number for " + key);
+    }
+  };
+
+  Value p = Value::object();
+  p.set("name", Value(spec));
+  if (head == "baseline") {
+    if (!util::trim(rest).empty()) {
+      throw std::runtime_error("policy spec '" + spec + "': baseline takes no parameters");
+    }
+    p.set("kind", Value("baseline"));
+  } else if (head == "sweep") {
+    Value what_if = Value::object();
+    for (auto& [k, v] : kv_pairs()) what_if.set(k, Value(v));
+    if (what_if.as_object().empty()) {
+      throw std::runtime_error("policy spec '" + spec + "': sweep needs at least one key=value");
+    }
+    p.set("kind", Value("sweep"));
+    p.set("what_if", std::move(what_if));
+  } else if (head == "right-size" || head == "right_size") {
+    double threshold = 0.8;
+    for (auto& [k, v] : kv_pairs()) {
+      if (k == "threshold") threshold = num(k, v);
+      else throw std::runtime_error("policy spec '" + spec + "': unknown key " + k);
+    }
+    if (!(threshold > 0.0 && threshold <= 1.0)) {
+      throw std::runtime_error("policy spec '" + spec + "': threshold must be in (0, 1]");
+    }
+    p.set("kind", Value("right_size"));
+    p.set("threshold", Value(threshold));
+  } else if (head == "hysteresis") {
+    int64_t pause_after = 3;
+    for (auto& [k, v] : kv_pairs()) {
+      if (k == "pause_after") pause_after = static_cast<int64_t>(num(k, v));
+      else throw std::runtime_error("policy spec '" + spec + "': unknown key " + k);
+    }
+    if (pause_after < 1) {
+      throw std::runtime_error("policy spec '" + spec + "': pause_after must be >= 1");
+    }
+    p.set("kind", Value("hysteresis"));
+    p.set("pause_after", Value(pause_after));
+  } else {
+    throw std::runtime_error(
+        "unknown policy kind '" + head +
+        "' (expected baseline, sweep:<k=v,...>, right-size[:threshold=T], "
+        "hysteresis[:pause_after=K])");
+  }
+  return p;
+}
+
+Value default_policies() {
+  Value out = Value::array();
+  out.push_back(parse_policy_spec("baseline"));
+  out.push_back(parse_policy_spec("right-size:threshold=0.8"));
+  out.push_back(parse_policy_spec("hysteresis:pause_after=3"));
+  return out;
+}
+
+// ── the simulator ──
+
+namespace {
+
+// One cycle's ledger evidence for one root (the exact observe_cycle input).
+struct Obs {
+  std::string kind, ns, name;
+  int64_t chips = 0;
+  int64_t pods = 0;
+};
+
+// Evidence per capsule: the recorded "ledger" block when present (new
+// capsules — guarantees the baseline integration is driven by the exact
+// inputs the live ledger saw), else reconstructed from resolutions + pod
+// evidence exactly the way resolve_pods builds ledger_obs.
+std::map<std::string, Obs> capsule_observations(const Value& capsule, const std::string& device) {
+  std::map<std::string, Obs> out;
+  if (const Value* led = capsule.find("ledger")) {
+    if (const Value* obs = led->find("observations"); obs && obs->is_array()) {
+      for (const Value& o : obs->as_array()) {
+        Obs x;
+        x.kind = o.get_string("kind");
+        x.ns = o.get_string("namespace");
+        x.name = o.get_string("name");
+        if (const Value* c = o.find("chips"); c && c->is_number()) x.chips = c->as_int();
+        if (const Value* n = o.find("pods"); n && n->is_number()) x.pods = n->as_int();
+        out[x.kind + "/" + x.ns + "/" + x.name] = std::move(x);
+      }
+      return out;
+    }
+  }
+  std::set<std::string> opted_out;
+  if (const Value* decs = capsule.find("decisions"); decs && decs->is_array()) {
+    for (const Value& d : decs->as_array()) {
+      if (d.get_string("reason") == "OPTED_OUT") {
+        opted_out.insert(d.get_string("namespace") + "/" + d.get_string("pod"));
+      }
+    }
+  }
+  const Value* res = capsule.find("resolutions");
+  const Value* pods = capsule.find("pods");
+  if (!res || !res->is_object()) return out;
+  for (const auto& [key, r] : res->as_object()) {
+    const Value* root = r.find("root");
+    if (!root || opted_out.count(key)) continue;
+    Obs& x = out[root->get_string("kind") + "/" + root->get_string("namespace") + "/" +
+                 root->get_string("name")];
+    if (x.kind.empty()) {
+      x.kind = root->get_string("kind");
+      x.ns = root->get_string("namespace");
+      x.name = root->get_string("name");
+    }
+    x.pods += 1;
+    const Value* ev = pods ? pods->find(key) : nullptr;
+    if (const Value* pod = ev ? ev->find("pod") : nullptr) {
+      x.chips += core::pod_chip_count(*pod, device);
+    }
+  }
+  return out;
+}
+
+int64_t capsule_ledger_now(const Value& capsule) {
+  if (const Value* led = capsule.find("ledger")) {
+    if (const Value* n = led->find("now_unix"); n && n->is_number()) return n->as_int();
+  }
+  if (const Value* n = capsule.find("now_unix"); n && n->is_number()) return n->as_int();
+  if (const Value* n = capsule.find("ts_unix"); n && n->is_number()) return n->as_int();
+  throw std::runtime_error("gym: capsule carries no usable clock");
+}
+
+struct Policy {
+  std::string name;
+  std::string kind;  // baseline | sweep | right_size | hysteresis
+  Value what_if = Value::object();
+  double threshold = 0.8;
+  int64_t pause_after = 1;  // >1 only for hysteresis
+};
+
+// Per-policy virtual ledger account — observe_cycle's state machine with
+// a virtual pause bit and a candidate streak for hysteresis.
+struct VAccount {
+  int64_t chips = 0;  // latest observed idle chips (ledger a.chips analog)
+  uint64_t first_seen = 0;
+  bool paused = false;
+  bool right_sized = false;
+  int64_t freed_chips = 0;  // chips_when_paused analog
+  int64_t paused_at = 0;
+  double reclaimed = 0, idle_s = 0, active_s = 0;
+  uint64_t streak = 0;  // consecutive candidate cycles (hysteresis)
+};
+
+struct PolicyState {
+  Policy spec;
+  std::map<std::string, VAccount> accounts;
+  uint64_t pauses = 0, resumes = 0, false_pauses = 0;
+  uint64_t right_size_applied = 0, right_size_held = 0;
+};
+
+Policy policy_from_json(const Value& v) {
+  Policy p;
+  p.name = v.get_string("name");
+  p.kind = v.get_string("kind");
+  if (p.name.empty()) p.name = p.kind;
+  if (p.kind == "baseline") {
+  } else if (p.kind == "sweep") {
+    const Value* w = v.find("what_if");
+    if (!w || !w->is_object() || w->as_object().empty()) {
+      throw std::runtime_error("gym: sweep policy '" + p.name + "' needs a what_if object");
+    }
+    p.what_if = *w;
+  } else if (p.kind == "right_size") {
+    if (const Value* t = v.find("threshold"); t && t->is_number()) p.threshold = t->as_double();
+    if (!(p.threshold > 0.0 && p.threshold <= 1.0)) {
+      throw std::runtime_error("gym: right_size threshold must be in (0, 1]");
+    }
+    p.what_if.set("right_size", Value("on"));
+    p.what_if.set("right_size_threshold", Value(p.threshold));
+  } else if (p.kind == "hysteresis") {
+    if (const Value* k = v.find("pause_after"); k && k->is_number()) p.pause_after = k->as_int();
+    if (p.pause_after < 1) throw std::runtime_error("gym: pause_after must be >= 1");
+    if (const Value* w = v.find("what_if"); w && w->is_object()) p.what_if = *w;
+  } else {
+    throw std::runtime_error("gym: unknown policy kind '" + p.kind + "'");
+  }
+  return p;
+}
+
+std::string flag_line_of(const Policy& p) {
+  if (p.kind == "baseline") {
+    return "# baseline: the daemon's current configuration (no flag changes)";
+  }
+  if (p.kind == "right_size") {
+    return "--right-size on --right-size-threshold " + fmt_g(p.threshold);
+  }
+  if (p.kind == "hysteresis") {
+    return "# hysteresis (pause_after=" + std::to_string(p.pause_after) +
+           ") is a gym-only policy today; nearest production guard: --max-scale-per-cycle";
+  }
+  std::string flags, comments;
+  for (const auto& [k, v] : p.what_if.as_object()) {
+    std::string val = v.is_string() ? v.as_string() : v.dump();
+    if (k == "duration") flags += " -t " + val;
+    else if (k == "grace") flags += " -g " + val;
+    else if (k == "run_mode") flags += " --run-mode " + val;
+    else if (k == "enabled_resources") flags += " -e " + val;
+    else if (k == "max_scale_per_cycle") flags += " --max-scale-per-cycle " + val;
+    else if (k == "hbm_threshold") flags += " --hbm-threshold " + val;
+    else if (k == "signal_min_coverage") flags += " --signal-min-coverage " + val;
+    else if (k == "signal_guard") flags += " --signal-guard " + val;
+    else if (k == "right_size") flags += " --right-size " + val;
+    else if (k == "right_size_threshold") flags += " --right-size-threshold " + val;
+    else if (k == "lookback") comments += "  # lookback=" + val + " derives from -t (min) + -g (sec)";
+    else comments += "  # " + k + "=" + val;
+  }
+  std::string out = util::trim(flags + comments);
+  return out.empty() ? "# (no flag changes)" : out;
+}
+
+}  // namespace
+
+Value simulate(const Value& payload) {
+  const Value* caps_v = payload.find("capsules");
+  if (!caps_v || !caps_v->is_array() || caps_v->as_array().empty()) {
+    throw std::runtime_error("gym: missing or empty capsules");
+  }
+
+  std::vector<PolicyState> policies;
+  {
+    Value specs = default_policies();
+    if (const Value* pol = payload.find("policies"); pol && pol->is_array() &&
+        !pol->as_array().empty()) {
+      specs = *pol;
+    }
+    for (const Value& s : specs.as_array()) {
+      PolicyState st;
+      st.spec = policy_from_json(s.is_string() ? parse_policy_spec(s.as_string()) : s);
+      policies.push_back(std::move(st));
+    }
+  }
+
+  int64_t regret_window_s = 600;
+  if (const Value* r = payload.find("regret_window_s"); r && r->is_number()) {
+    regret_window_s = r->as_int();
+  }
+  bool assume_scale_down = true;
+  if (const Value* a = payload.find("assume_scale_down"); a && a->is_bool()) {
+    assume_scale_down = a->as_bool();
+  }
+  double fp_penalty = 1.0, churn_penalty = 0.01;
+  if (const Value* v = payload.find("false_pause_penalty_chip_hours"); v && v->is_number()) {
+    fp_penalty = v->as_double();
+  }
+  if (const Value* v = payload.find("churn_penalty_chip_hours"); v && v->is_number()) {
+    churn_penalty = v->as_double();
+  }
+  // Synthetic corpora recorded back-to-back (--check-interval 0) carry
+  // near-zero wall-clock dt between capsules; an assumed interval scores
+  // them at their LOGICAL cadence instead (0 = use the capsules' own
+  // ledger clocks — the bit-for-bit parity mode).
+  int64_t assume_interval_s = 0;
+  if (const Value* v = payload.find("assume_interval_s"); v && v->is_number()) {
+    assume_interval_s = v->as_int();
+    if (assume_interval_s < 0) throw std::runtime_error("gym: assume_interval_s must be >= 0");
+  }
+
+  // Chronological order: cycle number first, capsule id as tiebreak.
+  std::vector<const Value*> capsules;
+  for (const Value& c : caps_v->as_array()) capsules.push_back(&c);
+  std::sort(capsules.begin(), capsules.end(), [](const Value* a, const Value* b) {
+    int64_t ca = 0, cb = 0;
+    if (const Value* v = a->find("cycle"); v && v->is_number()) ca = v->as_int();
+    if (const Value* v = b->find("cycle"); v && v->is_number()) cb = v->as_int();
+    if (ca != cb) return ca < cb;
+    return a->get_string("id") < b->get_string("id");
+  });
+
+  // Effective what-if per policy (assume_scale_down injects run_mode
+  // without polluting the policy's flag line).
+  std::vector<Value> effective_what_if;
+  for (const PolicyState& st : policies) {
+    Value w = st.spec.what_if;
+    if (assume_scale_down && !w.find("run_mode")) w.set("run_mode", Value("scale-down"));
+    effective_what_if.push_back(std::move(w));
+  }
+
+  // Roots the LIVE daemon paused (actuation reasons in the capsules'
+  // decisions): their later absence from the idle evidence is a shadow,
+  // not a busy signal — false-pause detection must skip them.
+  std::set<std::string> live_paused;
+
+  int64_t prev_now = 0;
+  bool first = true;
+  uint64_t cycles = 0;
+  for (const Value* capsule : capsules) {
+    ++cycles;
+    const std::string device =
+        capsule->at_path("config.query_args") ? capsule->at_path("config.query_args")->get_string("device", "tpu") : "tpu";
+    const int64_t now_clock = capsule_ledger_now(*capsule);
+    const int64_t now = assume_interval_s > 0
+                            ? (first ? now_clock : prev_now + assume_interval_s)
+                            : now_clock;
+    const double dt = (!first && now > prev_now) ? static_cast<double>(now - prev_now) : 0.0;
+
+    std::map<std::string, Obs> observed = capsule_observations(*capsule, device);
+
+    // Replay the capsule once per DISTINCT overlay (baseline + hysteresis
+    // usually share one replay), then extract each policy's wanted set.
+    std::map<std::string, Value> replay_cache;
+    for (size_t pi = 0; pi < policies.size(); ++pi) {
+      PolicyState& st = policies[pi];
+      const std::string cache_key = effective_what_if[pi].dump();
+      auto cached = replay_cache.find(cache_key);
+      if (cached == replay_cache.end()) {
+        try {
+          cached = replay_cache
+                       .emplace(cache_key, recorder::replay(*capsule, effective_what_if[pi]))
+                       .first;
+        } catch (const std::exception& e) {
+          throw std::runtime_error("gym: capsule " + capsule->get_string("id", "<unnamed>") +
+                                   ", policy '" + st.spec.name + "': " + e.what());
+        }
+      }
+      const Value& replayed = *cached->second.find("replayed");
+
+      // Wanted-pause roots this cycle under this policy, split full vs
+      // right-size partial; held roots are counted for the report.
+      std::map<std::string, bool> wanted;  // ledger key → is_right_size
+      std::set<std::string> held_roots;
+      for (const Value& rec : replayed.as_array()) {
+        const Value* root = rec.find("root");
+        if (!root) continue;
+        const std::string key = root->get_string("kind") + "/" +
+                                root->get_string("namespace") + "/" + root->get_string("name");
+        const std::string reason = rec.get_string("reason");
+        if (reason == "RIGHT_SIZE_HELD") held_roots.insert(key);
+        if (rec.get_string("action") != "scale_down") continue;
+        bool rs = reason == "RIGHT_SIZED";
+        auto it = wanted.find(key);
+        if (it == wanted.end()) wanted.emplace(key, rs);
+        else it->second = it->second && rs;
+      }
+      st.right_size_held += held_roots.size();
+
+      // ── ledger integration (observe_cycle's state machine, verbatim) ──
+      for (const auto& [key, o] : observed) {
+        VAccount& a = st.accounts[key];
+        if (a.first_seen == 0) a.first_seen = cycles;
+        a.chips = o.chips;
+      }
+      std::vector<std::string> resumed;
+      for (auto& [key, a] : st.accounts) {
+        const bool was_observed = observed.count(key) != 0;
+        if (a.first_seen == cycles && !a.paused) continue;  // new: nothing spans yet
+        if (a.paused) {
+          a.reclaimed += static_cast<double>(a.freed_chips) * dt;
+          // Busy evidence against a virtual pause: the root left the idle
+          // set while its pods still exist in the corpus — the workload
+          // was needed. Right-sized roots keep their busy replicas, so
+          // busy evidence is expected, not a regret signal.
+          if (!was_observed && !a.right_sized && !live_paused.count(key)) {
+            resumed.push_back(key);
+          }
+        } else if (was_observed) {
+          a.idle_s += dt;
+        } else {
+          a.active_s += dt;
+        }
+      }
+      for (const std::string& key : resumed) {
+        VAccount& a = st.accounts[key];
+        a.paused = false;
+        a.freed_chips = 0;
+        ++st.resumes;
+        if (now - a.paused_at <= regret_window_s) ++st.false_pauses;
+      }
+
+      // ── hysteresis streaks, then this cycle's pauses ──
+      for (auto& [key, a] : st.accounts) {
+        a.streak = wanted.count(key) ? a.streak + 1 : 0;
+      }
+      for (const auto& [key, is_rs] : wanted) {
+        VAccount& a = st.accounts[key];
+        if (a.streak < static_cast<uint64_t>(st.spec.pause_after)) continue;
+        if (is_rs) {
+          if (a.paused) continue;  // virtual right-size applies once
+          const Obs* o = observed.count(key) ? &observed.at(key) : nullptr;
+          auto kind = core::kind_from_name(util::split(key, '/')[0]);
+          RightSizePlan plan;
+          if (kind && o) {
+            const Value* objects = capsule->find("objects");
+            const Value* root_obj =
+                objects ? objects->find(k8s::Client::object_path(*kind, o->ns, o->name))
+                        : nullptr;
+            if (root_obj && !root_obj->is_null()) {
+              plan = right_size_plan(*kind, *root_obj, o->pods, o->chips, st.spec.threshold);
+            }
+          }
+          if (!plan.applicable || plan.held) continue;  // evidence too thin: hold
+          a.paused = true;
+          a.right_sized = true;
+          a.freed_chips = plan.freed_chips;
+          a.paused_at = now;
+          ++st.pauses;
+          ++st.right_size_applied;
+        } else {
+          if (a.paused && !a.right_sized) continue;
+          if (a.paused && a.right_sized) {
+            // Full pause upgrades a virtual right-size: everything the
+            // idle evidence covers is now freed (conservative: observed
+            // idle chips, the same figure record_pause would take).
+            a.right_sized = false;
+            a.freed_chips = a.chips;
+            ++st.pauses;
+            continue;
+          }
+          a.paused = true;
+          a.right_sized = false;
+          a.freed_chips = a.chips;
+          a.paused_at = now;
+          ++st.pauses;
+        }
+      }
+    }
+
+    // Evidence shadows start AFTER the cycle that actually paused a root.
+    if (const Value* decs = capsule->find("decisions"); decs && decs->is_array()) {
+      for (const Value& d : decs->as_array()) {
+        const std::string reason = d.get_string("reason");
+        if (reason != "SCALED" && reason != "ALREADY_PAUSED" && reason != "RIGHT_SIZED") {
+          continue;
+        }
+        if (const Value* root = d.find("root")) {
+          live_paused.insert(root->get_string("kind") + "/" + root->get_string("namespace") +
+                             "/" + root->get_string("name"));
+        }
+      }
+    }
+    prev_now = now;
+    first = false;
+  }
+
+  // ── scoring ──
+  Value out_policies = Value::array();
+  double best_score = 0;
+  size_t best_index = 0;
+  for (size_t pi = 0; pi < policies.size(); ++pi) {
+    PolicyState& st = policies[pi];
+    double reclaimed = 0, idle_s = 0, active_s = 0;
+    for (const auto& [key, a] : st.accounts) {
+      reclaimed += a.reclaimed;
+      idle_s += a.idle_s;
+      active_s += a.active_s;
+    }
+    const uint64_t churn = st.pauses + st.resumes;
+    const double score = reclaimed / 3600.0 - fp_penalty * static_cast<double>(st.false_pauses) -
+                         churn_penalty * static_cast<double>(churn);
+    if (pi == 0 || score > best_score) {
+      best_score = score;
+      best_index = pi;
+    }
+    Value p = Value::object();
+    p.set("name", Value(st.spec.name));
+    p.set("kind", Value(st.spec.kind));
+    if (st.spec.kind == "sweep") p.set("what_if", st.spec.what_if);
+    if (st.spec.kind == "right_size") p.set("threshold", Value(st.spec.threshold));
+    if (st.spec.kind == "hysteresis") p.set("pause_after", Value(st.spec.pause_after));
+    p.set("reclaimed_chip_seconds", Value(round3(reclaimed)));
+    p.set("reclaimed_chip_hours", Value(round3(reclaimed / 3600.0)));
+    p.set("idle_seconds", Value(round3(idle_s)));
+    p.set("active_seconds", Value(round3(active_s)));
+    p.set("false_pauses", Value(static_cast<int64_t>(st.false_pauses)));
+    p.set("pauses", Value(static_cast<int64_t>(st.pauses)));
+    p.set("resumes", Value(static_cast<int64_t>(st.resumes)));
+    p.set("actuation_churn", Value(static_cast<int64_t>(churn)));
+    p.set("right_size_applied", Value(static_cast<int64_t>(st.right_size_applied)));
+    p.set("right_size_held", Value(static_cast<int64_t>(st.right_size_held)));
+    p.set("tracked_workloads", Value(static_cast<int64_t>(st.accounts.size())));
+    p.set("score", Value(round3(score)));
+    p.set("flag_line", Value(flag_line_of(st.spec)));
+    out_policies.push_back(std::move(p));
+  }
+
+  Value out = Value::object();
+  out.set("cycles", Value(static_cast<int64_t>(cycles)));
+  out.set("regret_window_s", Value(regret_window_s));
+  out.set("assume_scale_down", Value(assume_scale_down));
+  if (assume_interval_s > 0) out.set("assume_interval_s", Value(assume_interval_s));
+  out.set("winner", out_policies.as_array()[best_index]);
+  out.set("policies", std::move(out_policies));
+  return out;
+}
+
+// ── CLI shell: `tpu-pruner gym` ──
+
+namespace {
+
+const char kGymUsage[] = R"(tpu-pruner gym — offline policy simulator over flight-recorder capsules
+
+Replays a capsule corpus against N candidate policies in one pass and
+scores each with the ledger's own math: reclaimed chip-hours vs false
+pauses (a pause whose root shows busy evidence within the regret window)
+vs actuation churn. Human table on stderr, one JSON document on stdout.
+
+USAGE:
+  tpu-pruner gym --flight-dir <DIR> [FLAGS]
+  tpu-pruner gym --capsule <FILE> [--capsule <FILE>...] [FLAGS]
+
+FLAGS:
+      --flight-dir <DIR>       load every cycle-*.json capsule in DIR
+      --capsule <FILE>         load one capsule file (repeatable)
+      --policy <SPEC>          policy to score (repeatable); specs:
+                                 baseline
+                                 sweep:<key=value,...>   (what-if keys)
+                                 right-size[:threshold=0.8]
+                                 hysteresis[:pause_after=3]
+                               default: baseline, right-size:threshold=0.8,
+                               hysteresis:pause_after=3
+      --regret-window <SEC>    a pause whose root shows busy evidence
+                               within this window counts as a false pause
+                               [default: 600]
+      --as-recorded            score run modes exactly as recorded (a
+                               dry-run corpus then reclaims nothing);
+                               default scores every policy as if
+                               run_mode=scale-down
+      --assume-interval <SEC>  score cycles SEC seconds apart instead of
+                               using the capsules' own clocks — for
+                               synthetic corpora recorded back-to-back
+                               (--check-interval 0), whose wall-clock dt
+                               is near zero [default: 0 = capsule clocks]
+      --false-pause-penalty <CHIP_HOURS>
+                               score penalty per false pause [default: 1]
+      --churn-penalty <CHIP_HOURS>
+                               score penalty per pause/resume actuation
+                               [default: 0.01]
+  -h, --help                   print this help
+)";
+
+}  // namespace
+
+int run_cli(int argc, char** argv) {
+  std::string flight_dir;
+  std::vector<std::string> capsule_paths, policy_specs;
+  int64_t regret_window_s = 600;
+  int64_t assume_interval_s = 0;
+  bool as_recorded = false;
+  double fp_penalty = 1.0, churn_penalty = 0.01;
+
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto value = [&]() -> std::string {
+      if (i + 1 >= argc) throw std::runtime_error(arg + " requires a value");
+      return argv[++i];
+    };
+    if (arg == "-h" || arg == "--help") {
+      std::fprintf(stdout, "%s", kGymUsage);
+      return 0;
+    } else if (arg == "--flight-dir") {
+      flight_dir = value();
+    } else if (arg == "--capsule") {
+      capsule_paths.push_back(value());
+    } else if (arg == "--policy") {
+      policy_specs.push_back(value());
+    } else if (arg == "--regret-window") {
+      regret_window_s = std::stoll(value());
+    } else if (arg == "--assume-interval") {
+      assume_interval_s = std::stoll(value());
+    } else if (arg == "--as-recorded") {
+      as_recorded = true;
+    } else if (arg == "--false-pause-penalty") {
+      fp_penalty = std::stod(value());
+    } else if (arg == "--churn-penalty") {
+      churn_penalty = std::stod(value());
+    } else {
+      std::fprintf(stderr, "gym: unknown flag %s\n%s", arg.c_str(), kGymUsage);
+      return 2;
+    }
+  }
+
+  std::vector<std::string> files;
+  if (!flight_dir.empty()) {
+    std::error_code ec;
+    std::vector<std::string> found;
+    for (const auto& entry : fs::directory_iterator(flight_dir, ec)) {
+      std::string name = entry.path().filename().string();
+      if (name.rfind("cycle-", 0) == 0 && name.size() > 5 &&
+          name.substr(name.size() - 5) == ".json") {
+        found.push_back(entry.path().string());
+      }
+    }
+    if (ec) {
+      std::fprintf(stderr, "gym: cannot read --flight-dir %s: %s\n", flight_dir.c_str(),
+                   ec.message().c_str());
+      return 1;
+    }
+    std::sort(found.begin(), found.end());
+    files.insert(files.end(), found.begin(), found.end());
+  }
+  files.insert(files.end(), capsule_paths.begin(), capsule_paths.end());
+  if (files.empty()) {
+    std::fprintf(stderr, "gym: no capsules (--flight-dir or --capsule required)\n%s", kGymUsage);
+    return 2;
+  }
+
+  Value capsules = Value::array();
+  for (const std::string& f : files) {
+    auto text = util::read_file(f);
+    if (!text) {
+      std::fprintf(stderr, "gym: cannot read capsule %s\n", f.c_str());
+      return 1;
+    }
+    try {
+      capsules.push_back(Value::parse(*text));
+    } catch (const std::exception& e) {
+      std::fprintf(stderr, "gym: unparseable capsule %s: %s\n", f.c_str(), e.what());
+      return 1;
+    }
+  }
+
+  Value payload = Value::object();
+  payload.set("capsules", std::move(capsules));
+  if (!policy_specs.empty()) {
+    Value pol = Value::array();
+    for (const std::string& s : policy_specs) pol.push_back(Value(s));
+    payload.set("policies", std::move(pol));
+  }
+  payload.set("regret_window_s", Value(regret_window_s));
+  payload.set("assume_scale_down", Value(!as_recorded));
+  if (assume_interval_s > 0) payload.set("assume_interval_s", Value(assume_interval_s));
+  payload.set("false_pause_penalty_chip_hours", Value(fp_penalty));
+  payload.set("churn_penalty_chip_hours", Value(churn_penalty));
+
+  Value out;
+  try {
+    out = simulate(payload);
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "gym: %s\n", e.what());
+    return 1;
+  }
+
+  std::fprintf(stderr, "policy gym: %lld capsule cycle(s), %zu policy(ies), regret window %llds\n\n",
+               static_cast<long long>(out.find("cycles")->as_int()),
+               out.find("policies")->as_array().size(),
+               static_cast<long long>(regret_window_s));
+  std::fprintf(stderr, "%-36s %14s %12s %7s %6s %8s\n", "policy", "reclaimed", "false", "churn",
+               "held", "score");
+  std::fprintf(stderr, "%-36s %14s %12s %7s %6s %8s\n", "", "chip-hrs", "pauses", "", "", "");
+  for (const Value& p : out.find("policies")->as_array()) {
+    std::fprintf(stderr, "%-36s %14.3f %12lld %7lld %6lld %8.3f\n",
+                 p.get_string("name").c_str(), p.find("reclaimed_chip_hours")->as_double(),
+                 static_cast<long long>(p.find("false_pauses")->as_int()),
+                 static_cast<long long>(p.find("actuation_churn")->as_int()),
+                 static_cast<long long>(p.find("right_size_held")->as_int()),
+                 p.find("score")->as_double());
+  }
+  const Value* winner = out.find("winner");
+  std::fprintf(stderr, "\nwinner: %s\napply with: %s\n", winner->get_string("name").c_str(),
+               winner->get_string("flag_line").c_str());
+  std::fprintf(stdout, "%s\n", out.dump().c_str());
+  return 0;
+}
+
+}  // namespace tpupruner::gym

@@ -37,6 +37,12 @@ struct Account {
   double reclaimed_chip_seconds = 0;
   uint64_t idle_streak_cycles = 0;
   bool paused = false;
+  // Right-sized accounts are "paused" with chips_when_paused = the FREED
+  // chips only (partial reclaim = freed × time); the kept replicas are
+  // still serving, so the informer resume sweep skips these accounts
+  // (already_paused would read the non-zero replica count as an external
+  // resume every cycle).
+  bool right_sized = false;
   bool idle_now = false;  // observed idle in the most recent cycle
   int64_t paused_since_unix = 0;
   int64_t chips_when_paused = 0;
@@ -45,7 +51,7 @@ struct Account {
   std::deque<ScaleEventRec> events;
 
   const char* state() const {
-    if (paused) return "paused";
+    if (paused) return right_sized ? "right_sized" : "paused";
     return idle_now ? "idle" : "active";
   }
 };
@@ -184,7 +190,8 @@ void load_locked(Registry& r, const std::string& path) {
     a.resumes = static_cast<uint64_t>(num("resumes"));
     a.first_seen_cycle = static_cast<uint64_t>(num("first_seen_cycle"));
     a.last_seen_cycle = static_cast<uint64_t>(num("last_seen_cycle"));
-    a.paused = v.get_string("state") == "paused";
+    a.paused = v.get_string("state") == "paused" || v.get_string("state") == "right_sized";
+    a.right_sized = v.get_string("state") == "right_sized";
     a.idle_now = v.get_string("state") == "idle";
     if (a.paused) {
       a.paused_since_unix = static_cast<int64_t>(num("paused_since_unix"));
@@ -299,12 +306,51 @@ void record_pause(uint64_t cycle, const std::string& kind, const std::string& ns
     a.name = name;
     a.first_seen_cycle = cycle;
   }
-  if (a.paused) return;  // re-patch of an already-paused root (watch-cache off)
+  if (a.paused && !a.right_sized) return;  // re-patch of an already-paused root
+  if (a.paused && a.right_sized) {
+    // Full pause upgrades a right-sized account: the previously freed
+    // chips keep counting, and everything the current idle evidence
+    // covers (the kept replicas' chips) is freed on top.
+    a.right_sized = false;
+    a.chips_when_paused += a.chips;
+    a.paused_since_unix = util::now_unix();
+    ++a.pauses;
+    push_event_locked(a, {cycle, a.paused_since_unix, "paused", reason, "tpu-pruner"});
+    checkpoint_locked(r);
+    return;
+  }
   a.paused = true;
   a.paused_since_unix = util::now_unix();
   a.chips_when_paused = a.chips;
   ++a.pauses;
   push_event_locked(a, {cycle, a.paused_since_unix, "paused", reason, "tpu-pruner"});
+  checkpoint_locked(r);
+}
+
+void record_right_size(uint64_t cycle, const std::string& kind, const std::string& ns,
+                       const std::string& name, int64_t freed_chips) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  Account& a = r.accounts[key_of(kind, ns, name)];
+  if (a.kind.empty()) {
+    a.kind = kind;
+    a.ns = ns;
+    a.name = name;
+    a.first_seen_cycle = cycle;
+  }
+  if (a.paused && !a.right_sized) return;  // full pause already accounts more
+  int64_t now = util::now_unix();
+  if (a.paused && a.right_sized) {
+    // Progressive consolidation: a deeper right-size frees more chips.
+    a.chips_when_paused += freed_chips;
+  } else {
+    a.paused = true;
+    a.right_sized = true;
+    a.paused_since_unix = now;
+    a.chips_when_paused = freed_chips;
+  }
+  ++a.pauses;
+  push_event_locked(a, {cycle, now, "right_sized", "RIGHT_SIZED", "tpu-pruner"});
   checkpoint_locked(r);
 }
 
@@ -316,6 +362,7 @@ void record_resume(uint64_t cycle, const std::string& kind, const std::string& n
   if (it == r.accounts.end() || !it->second.paused) return;
   Account& a = it->second;
   a.paused = false;
+  a.right_sized = false;
   a.paused_since_unix = 0;
   ++a.resumes;
   push_event_locked(a, {cycle, util::now_unix(), "resumed", "", actor});
@@ -327,7 +374,9 @@ std::vector<PausedRoot> paused_roots() {
   std::lock_guard<std::mutex> lock(r.mutex);
   std::vector<PausedRoot> out;
   for (const auto& [key, a] : r.accounts) {
-    if (a.paused) out.push_back({a.kind, a.ns, a.name});
+    // Right-sized accounts keep serving replicas: already_paused() would
+    // read them as externally resumed every sweep — skip them.
+    if (a.paused && !a.right_sized) out.push_back({a.kind, a.ns, a.name});
   }
   return out;
 }

@@ -12,6 +12,7 @@
 #include "tpupruner/cli.hpp"
 #include "tpupruner/daemon.hpp"
 #include "tpupruner/fleet.hpp"
+#include "tpupruner/gym.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/query.hpp"
 
@@ -39,6 +40,19 @@ int main(int argc, char** argv) {
       return hub::run(argc - 1, argv + 1);
     } catch (const std::exception& e) {
       std::fprintf(stderr, "hub: %s\n", e.what());
+      return 1;
+    }
+  }
+
+  if (argc >= 2 && std::strcmp(argv[1], "gym") == 0) {
+    // Policy gym: replay a flight-recorder capsule corpus against N
+    // candidate policies (baseline, sweeps, right-size, hysteresis) and
+    // score reclaimed chip-hours vs false pauses vs actuation churn.
+    log::init(log::Format::Default);
+    try {
+      return gym::run_cli(argc - 1, argv + 1);
+    } catch (const std::exception& e) {
+      std::fprintf(stderr, "gym: %s\n", e.what());
       return 1;
     }
   }

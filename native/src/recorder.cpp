@@ -13,6 +13,8 @@
 #include "tpupruner/audit.hpp"
 #include "tpupruner/core.hpp"
 #include "tpupruner/fleet.hpp"
+#include "tpupruner/gym.hpp"
+#include "tpupruner/k8s.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/metrics.hpp"
 #include "tpupruner/query.hpp"
@@ -43,6 +45,7 @@ struct OpenCapsule {
   Value actuations = Value::object();   // identity → {reason, action, detail}
   Value vetoed_roots = Value::array();
   Value vetoed_namespaces = Value::object();
+  Value ledger;                         // {now_unix, observations} — the observe_cycle feed
   Value breaker;                        // {limit, actionable, deferred, tripped}
   Value stats;                          // {num_series, num_pods, shutdown_events}
   std::vector<Value> decisions;         // verbatim DecisionRecord JSON
@@ -160,6 +163,7 @@ void seal_locked(Registry& r, uint64_t cycle) {
   doc.set("objects", std::move(c.objects));
   doc.set("vetoed_roots", std::move(c.vetoed_roots));
   doc.set("vetoed_namespaces", std::move(c.vetoed_namespaces));
+  if (!c.ledger.is_null()) doc.set("ledger", std::move(c.ledger));
   doc.set("root_flags", std::move(c.root_flags));
   if (!c.breaker.is_null()) doc.set("breaker", std::move(c.breaker));
   if (!c.stats.is_null()) doc.set("stats", std::move(c.stats));
@@ -333,6 +337,35 @@ void record_object(uint64_t cycle, const std::string& path, const Value* object)
   OpenCapsule* c = open_capsule_locked(r, cycle);
   if (!c) return;
   c->objects.set(path, object ? *object : Value(nullptr));
+}
+
+void record_ledger(uint64_t cycle, int64_t now_unix,
+                   const std::vector<ledger::Observation>& observations) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  // Deterministic order: the daemon feeds from an unordered map.
+  std::vector<const ledger::Observation*> sorted;
+  for (const ledger::Observation& o : observations) sorted.push_back(&o);
+  std::sort(sorted.begin(), sorted.end(),
+            [](const ledger::Observation* a, const ledger::Observation* b) {
+              return std::tie(a->kind, a->ns, a->name) < std::tie(b->kind, b->ns, b->name);
+            });
+  Value obs = Value::array();
+  for (const ledger::Observation* o : sorted) {
+    Value v = Value::object();
+    v.set("kind", Value(o->kind));
+    v.set("namespace", Value(o->ns));
+    v.set("name", Value(o->name));
+    v.set("chips", Value(o->chips));
+    v.set("pods", Value(o->pods));
+    obs.push_back(std::move(v));
+  }
+  Value led = Value::object();
+  led.set("now_unix", Value(now_unix));
+  led.set("observations", std::move(obs));
+  c->ledger = std::move(led);
 }
 
 void record_vetoes(uint64_t cycle, const std::vector<std::string>& vetoed_roots,
@@ -538,7 +571,8 @@ Value normalize_decision(const Value& d) {
 
 bool is_actuation_reason(const std::string& reason) {
   return reason == "SCALED" || reason == "ALREADY_PAUSED" || reason == "SCALE_FAILED" ||
-         reason == "KIND_DISABLED" || reason == "SHUTDOWN_ABORTED";
+         reason == "KIND_DISABLED" || reason == "SHUTDOWN_ABORTED" ||
+         reason == "RIGHT_SIZED";
 }
 
 }  // namespace
@@ -565,6 +599,15 @@ Value replay(const Value& capsule, const Value& what_if) {
   int64_t lookback_s = cfg_int("lookback_s", qargs.duration_min * 60 + grace_s);
   const int64_t recorded_max_scale = cfg_int("max_scale_per_cycle", 0);
   int64_t max_scale = recorded_max_scale;
+  // Replica right-sizing config (absent on pre-gym capsules → off,
+  // exactly how those cycles ran).
+  std::string right_size = cfg.get_string("right_size", "off");
+  double rs_threshold = 0.8;
+  if (const Value* t = cfg.find("right_size_threshold"); t && t->is_number()) {
+    rs_threshold = t->as_double();
+  }
+  const std::string recorded_right_size = right_size;
+  const double recorded_rs_threshold = rs_threshold;
   // Signal-quality watchdog config (absent on pre-watchdog capsules →
   // guard off, exactly how those cycles ran).
   std::string signal_guard = cfg.get_string("signal_guard", "off");
@@ -617,11 +660,22 @@ Value replay(const Value& capsule, const Value& what_if) {
         if (signal_guard != "on" && signal_guard != "off") {
           throw std::runtime_error("what-if signal_guard: expected on|off");
         }
+      } else if (key == "right_size") {
+        right_size = value_string(key, val);
+        if (right_size != "on" && right_size != "off") {
+          throw std::runtime_error("what-if right_size: expected on|off");
+        }
+      } else if (key == "right_size_threshold") {
+        rs_threshold = parse_double_value(key, val);
+        if (!(rs_threshold > 0.0 && rs_threshold <= 1.0)) {
+          throw std::runtime_error("what-if right_size_threshold: expected (0, 1]");
+        }
       } else {
         throw std::runtime_error(
             "unknown what-if key: " + key +
             " (supported: lookback, duration, grace, run_mode, enabled_resources, "
-            "max_scale_per_cycle, hbm_threshold, signal_min_coverage, signal_guard)");
+            "max_scale_per_cycle, hbm_threshold, signal_min_coverage, signal_guard, "
+            "right_size, right_size_threshold)");
       }
     }
     if (window_derived && !lookback_explicit) lookback_s = qargs.duration_min * 60 + grace_s;
@@ -693,6 +747,7 @@ Value replay(const Value& capsule, const Value& what_if) {
     audit::DecisionRecord rec;
     std::string identity;
     core::Kind kind = core::Kind::Deployment;
+    int64_t chips = 0;  // pod chip request (right-size evidence)
   };
   // Recorded decisions, keyed by pod — the comparison baseline, the
   // per-pod fallback for actuation outcomes, and the held-fixed source
@@ -864,6 +919,7 @@ Value replay(const Value& capsule, const Value& what_if) {
     p.rec = std::move(rec);
     p.identity = r.identity;
     if (auto k = core::kind_from_name(r.kind)) p.kind = *k;
+    p.chips = core::pod_chip_count(*pod, qargs.device);
     pendings.push_back(std::move(p));
   }
 
@@ -871,12 +927,41 @@ Value replay(const Value& capsule, const Value& what_if) {
   //    breaker → dry-run / consumer) over unique root identities ──
   std::vector<std::string> order;
   std::map<std::string, core::Kind> kind_of;
-  std::map<std::string, std::string> ns_of;
+  std::map<std::string, std::string> ns_of, name_of;
   for (const PendingT& p : pendings) {
     if (!kind_of.count(p.identity)) {
       order.push_back(p.identity);
       kind_of[p.identity] = p.kind;
       ns_of[p.identity] = p.rec.root_ns;
+      name_of[p.identity] = p.rec.root_name;
+    }
+  }
+
+  // Replica right-sizing: re-derive each candidate root's plan from the
+  // capsule's own evidence (root object snapshot + per-pod chip
+  // requests) with the SAME math the daemon runs (gym::right_size_plan),
+  // so RIGHT_SIZED / RIGHT_SIZE_HELD decisions replay offline — and flip
+  // under what-if right_size / right_size_threshold overlays.
+  const bool right_size_on = right_size == "on";
+  const bool rs_config_changed =
+      right_size != recorded_right_size || rs_threshold != recorded_rs_threshold;
+  std::map<std::string, gym::RightSizePlan> rs_plans;
+  if (right_size_on) {
+    std::map<std::string, std::pair<int64_t, int64_t>> stats;  // identity → {pods, chips}
+    for (const PendingT& p : pendings) {
+      auto& s = stats[p.identity];
+      s.first += 1;
+      s.second += p.chips;
+    }
+    for (const auto& [id, s] : stats) {
+      const Value* root_obj =
+          objects ? objects->find(
+                        k8s::Client::object_path(kind_of[id], ns_of[id], name_of[id]))
+                  : nullptr;
+      if (root_obj && !root_obj->is_null()) {
+        rs_plans[id] = gym::right_size_plan(kind_of[id], *root_obj, s.first, s.second,
+                                            rs_threshold);
+      }
     }
   }
   auto flag_set = [&](const std::string& id, const char* f) {
@@ -926,6 +1011,12 @@ Value replay(const Value& capsule, const Value& what_if) {
       o = {audit::Reason::DryRun, "none", "would have paused (run-mode dry-run)", false, false};
     } else if (!(enabled & core::flag(kind_of[id]))) {
       o = {audit::Reason::KindDisabled, "none", "", false, false};
+    } else if (right_size_on && rs_plans.count(id) && rs_plans[id].applicable &&
+               rs_plans[id].held) {
+      // Same precedence as the daemon: the right-size split runs
+      // producer-side for enabled kinds in scale-down mode, after the
+      // breaker and the brownout.
+      o = {audit::Reason::RightSizeHeld, "none", rs_plans[id].detail, false, false};
     } else {
       o.pending_actuation = true;
     }
@@ -965,24 +1056,47 @@ Value replay(const Value& capsule, const Value& what_if) {
     const std::string key = p.rec.ns + "/" + p.rec.pod;
     Outcome o = outcomes[p.identity];
     if (o.pending_actuation) {
+      // Recorded actuation outcomes are cluster facts — trusted verbatim
+      // UNLESS a right-size what-if changed the decision itself: a
+      // record that was (or was not) RIGHT_SIZED under the recorded
+      // config is stale once the overlay flips the plan, and the replay
+      // predicts the new outcome instead.
+      const bool expect_rs = right_size_on && rs_plans.count(p.identity) &&
+                             rs_plans[p.identity].applicable && !rs_plans[p.identity].held;
+      auto stale_record = [&](const std::string& reason) {
+        if (!rs_config_changed) return false;
+        if (expect_rs) return true;  // plan (R→N, freed chips) may differ
+        return reason == "RIGHT_SIZED";  // was partial, now a full pause
+      };
+      auto predict = [&] {
+        if (expect_rs) {
+          o.reason = audit::Reason::RightSized;
+          o.detail = rs_plans[p.identity].detail;
+        } else {
+          o.reason = audit::Reason::Scaled;
+          o.detail = "";
+        }
+        o.action = "scale_down";
+        o.predicted = true;
+      };
       const Value* act = actuations ? actuations->find(p.identity) : nullptr;
-      if (act) {
+      if (act && !stale_record(act->get_string("reason"))) {
         o.reason = audit::reason_from_name(act->get_string("reason"))
                        .value_or(audit::Reason::Scaled);
         o.action = act->get_string("action", "none");
         o.detail = act->get_string("detail");
       } else if (auto it = recorded_by_pod.find(key);
                  it != recorded_by_pod.end() &&
-                 is_actuation_reason(it->second.get_string("reason"))) {
+                 is_actuation_reason(it->second.get_string("reason")) &&
+                 !stale_record(it->second.get_string("reason"))) {
         o.reason = audit::reason_from_name(it->second.get_string("reason"))
                        .value_or(audit::Reason::Scaled);
         o.action = it->second.get_string("action", "none");
         o.detail = it->second.get_string("detail");
       } else {
-        // What-if opened a path the recorded cycle never actuated.
-        o.reason = audit::Reason::Scaled;
-        o.action = "scale_down";
-        o.predicted = true;
+        // What-if opened a path the recorded cycle never actuated (or
+        // the right-size overlay invalidated the recorded outcome).
+        predict();
       }
     }
     p.rec.reason = o.reason;

@@ -1,0 +1,303 @@
+// Policy-gym units: the right-size math shared by the daemon, the replay
+// engine and the simulator; policy-spec parsing; and a two-capsule
+// simulate() pass over handcrafted corpus evidence.
+#include <string>
+
+#include "testing.hpp"
+#include "tpupruner/core.hpp"
+#include "tpupruner/gym.hpp"
+#include "tpupruner/json.hpp"
+
+namespace gym = tpupruner::gym;
+namespace core = tpupruner::core;
+using tpupruner::json::Value;
+
+namespace {
+
+Value deployment_with_replicas(int64_t replicas) {
+  Value obj = Value::object();
+  Value spec = Value::object();
+  spec.set("replicas", Value(replicas));
+  obj.set("spec", std::move(spec));
+  return obj;
+}
+
+}  // namespace
+
+TP_TEST(right_size_plan_partial_idle_scales_to_ceil_busy_over_threshold) {
+  // R=4, 2 idle pods observed (8 chips) → busy=2; τ=0.8 → N=ceil(2.5)=3,
+  // freeing one replica's worth of chips (8/2 = 4 per replica).
+  gym::RightSizePlan p = gym::right_size_plan(core::Kind::Deployment,
+                                              deployment_with_replicas(4), 2, 8, 0.8);
+  TP_CHECK(p.applicable);
+  TP_CHECK(!p.held);
+  TP_CHECK_EQ(p.current_replicas, int64_t{4});
+  TP_CHECK_EQ(p.busy_replicas, int64_t{2});
+  TP_CHECK_EQ(p.target_replicas, int64_t{3});
+  TP_CHECK_EQ(p.freed_chips, int64_t{4});
+  TP_CHECK(p.detail.find("right-sized from 4 to 3 replicas") != std::string::npos);
+  TP_CHECK(p.detail.find("freed 4 chips") != std::string::npos);
+}
+
+TP_TEST(right_size_plan_holds_when_no_smaller_count_satisfies_threshold) {
+  // R=2, 1 idle → busy=1; τ=0.25 → ceil(4)=4 >= R: held, nothing freed.
+  gym::RightSizePlan p = gym::right_size_plan(core::Kind::Deployment,
+                                              deployment_with_replicas(2), 1, 4, 0.25);
+  TP_CHECK(p.applicable);
+  TP_CHECK(p.held);
+  TP_CHECK_EQ(p.target_replicas, int64_t{2});
+  TP_CHECK_EQ(p.freed_chips, int64_t{0});
+  TP_CHECK(p.detail.find("right-size held at 2 replicas") != std::string::npos);
+}
+
+TP_TEST(right_size_plan_fully_idle_and_single_replica_keep_classic_pause) {
+  // busy == 0 (all replicas idle): scale-to-zero frees everything.
+  TP_CHECK(!gym::right_size_plan(core::Kind::Deployment, deployment_with_replicas(2), 2, 8,
+                                 0.8).applicable);
+  // R <= 1: right-sizing IS scale-to-zero.
+  TP_CHECK(!gym::right_size_plan(core::Kind::Deployment, deployment_with_replicas(1), 0, 0,
+                                 0.8).applicable);
+  // No replica knob on the object at all.
+  TP_CHECK(!gym::right_size_plan(core::Kind::Deployment, Value::object(), 0, 0, 0.8)
+                .applicable);
+  // Kinds without a replica knob (JobSet suspend, Notebook annotation).
+  TP_CHECK(!gym::right_size_plan(core::Kind::JobSet, deployment_with_replicas(4), 2, 8, 0.8)
+                .applicable);
+}
+
+TP_TEST(right_size_plan_inference_service_uses_predictor_min_replicas) {
+  Value isvc = Value::object();
+  Value predictor = Value::object();
+  predictor.set("minReplicas", Value(int64_t{3}));
+  Value spec = Value::object();
+  spec.set("predictor", std::move(predictor));
+  isvc.set("spec", std::move(spec));
+  gym::RightSizePlan p = gym::right_size_plan(core::Kind::InferenceService, isvc, 1, 4, 0.9);
+  // busy=2 → ceil(2/0.9)=3 >= R: held.
+  TP_CHECK(p.applicable);
+  TP_CHECK(p.held);
+  gym::RightSizePlan p2 = gym::right_size_plan(core::Kind::InferenceService, isvc, 2, 8, 0.9);
+  // busy=1 → N=2 < 3: frees one replica (4 chips).
+  TP_CHECK(p2.applicable && !p2.held);
+  TP_CHECK_EQ(p2.target_replicas, int64_t{2});
+  TP_CHECK_EQ(p2.freed_chips, int64_t{4});
+}
+
+TP_TEST(right_size_plan_rejects_bad_threshold) {
+  bool threw = false;
+  try {
+    gym::right_size_plan(core::Kind::Deployment, deployment_with_replicas(4), 2, 8, 0.0);
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  TP_CHECK(threw);
+}
+
+TP_TEST(policy_spec_parsing_round_trips_every_kind) {
+  Value b = gym::parse_policy_spec("baseline");
+  TP_CHECK_EQ(b.get_string("kind"), std::string("baseline"));
+  TP_CHECK_EQ(b.get_string("name"), std::string("baseline"));
+
+  Value s = gym::parse_policy_spec("sweep:lookback=10m,grace=60");
+  TP_CHECK_EQ(s.get_string("kind"), std::string("sweep"));
+  TP_CHECK_EQ(s.find("what_if")->get_string("lookback"), std::string("10m"));
+  TP_CHECK_EQ(s.find("what_if")->get_string("grace"), std::string("60"));
+
+  Value r = gym::parse_policy_spec("right-size:threshold=0.5");
+  TP_CHECK_EQ(r.get_string("kind"), std::string("right_size"));
+  TP_CHECK(r.find("threshold")->as_double() == 0.5);
+
+  Value h = gym::parse_policy_spec("hysteresis:pause_after=5");
+  TP_CHECK_EQ(h.get_string("kind"), std::string("hysteresis"));
+  TP_CHECK_EQ(h.find("pause_after")->as_int(), int64_t{5});
+
+  TP_CHECK_EQ(gym::default_policies().as_array().size(), size_t{3});
+}
+
+TP_TEST(policy_spec_parsing_rejects_malformed_specs) {
+  for (const char* bad : {"bogus", "sweep", "sweep:novalue", "right-size:threshold=2",
+                          "hysteresis:pause_after=0", "baseline:x=1",
+                          "right-size:unknown=1"}) {
+    bool threw = false;
+    try {
+      gym::parse_policy_spec(bad);
+    } catch (const std::exception&) {
+      threw = true;
+    }
+    TP_CHECK(threw);
+  }
+}
+
+namespace {
+
+// A minimal self-consistent capsule: one old idle pod resolving to a
+// 2-replica Deployment, fully idle → the baseline pauses it.
+Value mini_capsule(uint64_t cycle, int64_t now, bool observed_idle) {
+  Value cap = Value::object();
+  cap.set("id", Value("cycle-" + std::to_string(now) + "-" + std::to_string(cycle)));
+  cap.set("cycle", Value(static_cast<int64_t>(cycle)));
+  cap.set("ts_unix", Value(now));
+  cap.set("now_unix", Value(now));
+
+  Value qa = Value::object();
+  qa.set("device", Value("tpu"));
+  qa.set("duration", Value(int64_t{30}));
+  qa.set("metric_schema", Value("gmp"));
+  Value cfg = Value::object();
+  cfg.set("query_args", std::move(qa));
+  cfg.set("run_mode", Value("scale-down"));
+  cfg.set("lookback_s", Value(int64_t{2100}));
+  cfg.set("grace_s", Value(int64_t{300}));
+  cap.set("config", std::move(cfg));
+  cap.set("query", Value("(q)"));
+
+  Value result = Value::array();
+  if (observed_idle) {
+    Value metric = Value::object();
+    metric.set("exported_pod", Value("p0"));
+    metric.set("exported_namespace", Value("ml"));
+    metric.set("exported_container", Value("main"));
+    metric.set("accelerator_type", Value("tpu-v5-lite-podslice"));
+    metric.set("node_type", Value("tpu-v5-lite-podslice"));
+    Value series = Value::object();
+    series.set("metric", std::move(metric));
+    Value value = Value::array();
+    value.push_back(Value(static_cast<int64_t>(now)));
+    value.push_back(Value("0"));
+    series.set("value", std::move(value));
+    result.push_back(std::move(series));
+  }
+  Value data = Value::object();
+  data.set("resultType", Value("vector"));
+  data.set("result", std::move(result));
+  Value body = Value::object();
+  body.set("status", Value("success"));
+  body.set("data", std::move(data));
+  Value prom = Value::object();
+  prom.set("body", Value(body.dump()));
+  cap.set("prom", std::move(prom));
+
+  Value pods = Value::object();
+  Value resolutions = Value::object();
+  if (observed_idle) {
+    Value pod = Value::object();
+    Value meta = Value::object();
+    meta.set("name", Value("p0"));
+    meta.set("namespace", Value("ml"));
+    meta.set("creationTimestamp", Value("2020-01-01T00:00:00Z"));
+    pod.set("metadata", std::move(meta));
+    Value status = Value::object();
+    status.set("phase", Value("Running"));
+    pod.set("status", std::move(status));
+    Value resources = Value::object();
+    Value requests = Value::object();
+    requests.set("google.com/tpu", Value("4"));
+    resources.set("requests", std::move(requests));
+    Value container = Value::object();
+    container.set("name", Value("main"));
+    container.set("resources", std::move(resources));
+    Value containers = Value::array();
+    containers.push_back(std::move(container));
+    Value spec = Value::object();
+    spec.set("containers", std::move(containers));
+    pod.set("spec", std::move(spec));
+
+    Value ev = Value::object();
+    ev.set("present", Value(true));
+    ev.set("pod", std::move(pod));
+    pods.set("ml/p0", std::move(ev));
+
+    Value res = Value::object();
+    Value chain = Value::array();
+    chain.push_back(Value("Pod/ml/p0"));
+    chain.push_back(Value("Deployment/ml/serve"));
+    res.set("chain", std::move(chain));
+    Value root = Value::object();
+    root.set("kind", Value("Deployment"));
+    root.set("namespace", Value("ml"));
+    root.set("name", Value("serve"));
+    res.set("root", std::move(root));
+    res.set("identity", Value("Deployment/uid:d1"));
+    resolutions.set("ml/p0", std::move(res));
+  }
+  cap.set("pods", std::move(pods));
+  cap.set("resolutions", std::move(resolutions));
+  cap.set("objects", Value::object());
+  cap.set("vetoed_roots", Value::array());
+  cap.set("vetoed_namespaces", Value::object());
+  cap.set("root_flags", Value::object());
+  cap.set("decisions", Value::array());
+
+  Value obs = Value::array();
+  if (observed_idle) {
+    Value o = Value::object();
+    o.set("kind", Value("Deployment"));
+    o.set("namespace", Value("ml"));
+    o.set("name", Value("serve"));
+    o.set("chips", Value(int64_t{4}));
+    o.set("pods", Value(int64_t{1}));
+    obs.push_back(std::move(o));
+  }
+  Value led = Value::object();
+  led.set("now_unix", Value(now));
+  led.set("observations", std::move(obs));
+  cap.set("ledger", std::move(led));
+  return cap;
+}
+
+}  // namespace
+
+TP_TEST(gym_simulate_integrates_reclaim_and_detects_false_pause) {
+  // Cycle 1: idle → the baseline pauses Deployment/ml/serve (4 chips).
+  // Cycle 2 (+60s): busy (no evidence row) within the
+  // regret window → resume + ONE false pause, after accruing 4×60
+  // reclaimed chip-seconds for the paused minute.
+  Value capsules = Value::array();
+  capsules.push_back(mini_capsule(1, 1700000000, true));
+  capsules.push_back(mini_capsule(2, 1700000060, false));
+  Value payload = Value::object();
+  payload.set("capsules", std::move(capsules));
+  Value policies = Value::array();
+  policies.push_back(Value("baseline"));
+  payload.set("policies", std::move(policies));
+  payload.set("regret_window_s", Value(int64_t{600}));
+
+  Value out = gym::simulate(payload);
+  TP_CHECK_EQ(out.find("cycles")->as_int(), int64_t{2});
+  const Value& p = out.find("policies")->as_array()[0];
+  TP_CHECK_EQ(p.get_string("kind"), std::string("baseline"));
+  TP_CHECK(p.find("reclaimed_chip_seconds")->as_double() == 240.0);
+  TP_CHECK_EQ(p.find("false_pauses")->as_int(), int64_t{1});
+  TP_CHECK_EQ(p.find("pauses")->as_int(), int64_t{1});
+  TP_CHECK_EQ(p.find("resumes")->as_int(), int64_t{1});
+  TP_CHECK_EQ(out.find("winner")->get_string("kind"), std::string("baseline"));
+}
+
+TP_TEST(gym_simulate_regret_window_bounds_false_pauses) {
+  // Same corpus, but the busy evidence lands OUTSIDE a 30s regret
+  // window: the pause still resumes (churn) but is not a false pause.
+  Value capsules = Value::array();
+  capsules.push_back(mini_capsule(1, 1700000000, true));
+  capsules.push_back(mini_capsule(2, 1700000060, false));
+  Value payload = Value::object();
+  payload.set("capsules", std::move(capsules));
+  Value policies = Value::array();
+  policies.push_back(Value("baseline"));
+  payload.set("policies", std::move(policies));
+  payload.set("regret_window_s", Value(int64_t{30}));
+
+  Value out = gym::simulate(payload);
+  const Value& p = out.find("policies")->as_array()[0];
+  TP_CHECK_EQ(p.find("false_pauses")->as_int(), int64_t{0});
+  TP_CHECK_EQ(p.find("resumes")->as_int(), int64_t{1});
+}
+
+TP_TEST(gym_simulate_rejects_empty_and_malformed_payloads) {
+  bool threw = false;
+  try {
+    gym::simulate(Value::object());
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  TP_CHECK(threw);
+}

@@ -3,6 +3,8 @@
 import json
 import subprocess
 import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_pruner.native import REPO_ROOT
 
@@ -120,6 +122,91 @@ def test_analyze_quantize_matches_f32(built, tmp_path):
     assert q["reclaimable_slices"] == f32["reclaimable_slices"] == ["ml/idle"]
     assert q_sharded["reclaimable_slices"] == ["ml/idle"]
     assert q["idle_chips"] == q_sharded["idle_chips"] == f32["idle_chips"] == 3
+
+
+# ── URL ergonomics: bare host:port expands to the right /debug path ──────
+
+
+class DebugStub:
+    """Tiny daemon stand-in serving /debug/decisions and /debug/workloads
+    with canned JSON, recording every path it served."""
+
+    DECISIONS = {"decisions": [
+        {"cycle": 1, "ts": "2026-01-01T00:00:00Z", "namespace": "ml",
+         "pod": "p0", "reason": "DRY_RUN", "action": "none"}]}
+    WORKLOADS = {"cluster": "stub", "schema": 2, "epoch": 1, "workloads": [
+        {"cluster": "stub", "epoch": 1, "workload": "Deployment/ml/w",
+         "kind": "Deployment", "namespace": "ml", "name": "w", "chips": 4,
+         "state": "idle", "idle_seconds": 60.0, "active_seconds": 0.0,
+         "reclaimed_chip_seconds": 0.0, "pauses": 0, "resumes": 0}]}
+
+    def __init__(self):
+        stub = self
+        stub.paths = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                stub.paths.append(self.path)
+                doc = (stub.DECISIONS if self.path.startswith("/debug/decisions")
+                       else stub.WORKLOADS if self.path.startswith("/debug/workloads")
+                       else None)
+                body = json.dumps(doc or {"error": "not found"}).encode()
+                self.send_response(200 if doc else 404)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        self.url = f"http://127.0.0.1:{self._server.server_address[1]}"
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def run_analyze_raw(*args):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_pruner.analyze", *args],
+        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT)
+    return proc
+
+
+def test_decisions_url_bare_host_port_expands(built):
+    """--decisions-url accepts a bare daemon base URL (expanded to
+    /debug/decisions) AND a full /debug/... URL verbatim — the same
+    ergonomics --signal-report always had."""
+    stub = DebugStub()
+    try:
+        for url in (stub.url, stub.url + "/",
+                    stub.url + "/debug/decisions"):
+            proc = run_analyze_raw("--explain", "ml/p0",
+                                   "--decisions-url", url)
+            assert proc.returncode == 0, proc.stderr
+            out = json.loads(proc.stdout)
+            assert out["decisions"][0]["reason"] == "DRY_RUN"
+        assert all(p.startswith("/debug/decisions") for p in stub.paths)
+    finally:
+        stub.stop()
+
+
+def test_workloads_url_bare_host_port_expands(built):
+    """--workloads-url gets the same bare-URL expansion + verbatim
+    passthrough."""
+    stub = DebugStub()
+    try:
+        for url in (stub.url, stub.url + "/debug/workloads"):
+            proc = run_analyze_raw("--fleet-report", "--workloads-url", url)
+            assert proc.returncode == 0, proc.stderr
+            out = json.loads(proc.stdout)
+            assert out["tracked_workloads"] == 1
+        assert all(p.startswith("/debug/workloads") for p in stub.paths)
+    finally:
+        stub.stop()
 
 
 # ── incremental/streaming mode (--stream; VERDICT r4 #3 + #8) ────────────

@@ -142,6 +142,34 @@ def test_fleet_surfaces_documented(built):
         f"fleet federation surfaces missing from docs/OPERATIONS.md: {missing}")
 
 
+def test_gym_surfaces_documented():
+    """The policy-gym CLI surfaces, the right-size flags/reason codes and
+    the new what-if keys must be in the runbook: the reason codes ride
+    the canonical-list guard above, but the gym subcommand, the analyze
+    mode and the flags have no metric family to piggyback on."""
+    doc = OPERATIONS.read_text()
+    needles = ("tpu-pruner gym", "--gym", "--gym-policy", "--regret-window",
+               "--as-recorded", "--right-size on", "--right-size-threshold",
+               "RIGHT_SIZED", "RIGHT_SIZE_HELD", "right_size_threshold",
+               "gym-smoke", "trace_gen", "hysteresis", "right-size:threshold",
+               "tpu_pruner_right_sizes_total")
+    missing = [n for n in needles if n not in doc]
+    assert not missing, (
+        f"policy-gym surfaces missing from docs/OPERATIONS.md: {missing} "
+        "— document each in the 'Tuning policies offline' section")
+
+
+def test_gym_bench_summary_fields_documented():
+    """Gym bench summary fields must be in BENCH_FIELDS.md AND actually
+    emitted by bench.py — a drift on either side fails."""
+    bench_src = (REPO / "bench.py").read_text()
+    fields_doc = (REPO / "docs" / "BENCH_FIELDS.md").read_text()
+    for field in ("gym_cycles_per_s", "gym_best_policy_reclaimed_chip_hours"):
+        assert f'"{field}"' in bench_src, f"bench.py no longer emits {field}"
+        assert field in fields_doc, (
+            f"bench summary field {field} missing from docs/BENCH_FIELDS.md")
+
+
 def test_fleet_bench_summary_fields_documented():
     """Fleet bench summary fields must be in BENCH_FIELDS.md AND actually
     emitted by bench.py — a drift on either side fails."""

@@ -191,6 +191,42 @@ def test_what_if_lookback_flips(built, tmp_path):
     assert proc.returncode != 0
 
 
+def test_what_if_repeatable_flag_combines_keys(built, tmp_path):
+    """--what-if is repeatable AND takes several key=value pairs per
+    occurrence; every form folds into ONE combined overlay and one flip
+    report (today each knob no longer needs a separate run)."""
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    try:
+        idle_fleet(prom, k8s, young_sibling=True)
+        (capsule,) = record_cycles(prom, k8s, tmp_path / "flight", cycles=1)
+    finally:
+        prom.stop()
+        k8s.stop()
+
+    # one occurrence, two pairs — and two occurrences, one pair each,
+    # must produce the identical combined flip report
+    combined = [sys.executable, "-m", "tpu_pruner.analyze", "--replay",
+                str(capsule), "--what-if", "lookback=300s", "run_mode=dry-run"]
+    repeated = [sys.executable, "-m", "tpu_pruner.analyze", "--replay",
+                str(capsule), "--what-if", "lookback=300s",
+                "--what-if", "run_mode=dry-run"]
+    outs = []
+    for cmd in (combined, repeated):
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        outs.append(json.loads(proc.stdout))
+    assert outs[0]["what_if"] == {"lookback": "300s", "run_mode": "dry-run"}
+    assert outs[0] == outs[1]
+    # BOTH keys acted in one pass: the loosened lookback admits the young
+    # sibling AND the dry-run mode turns every scale-down into DRY_RUN
+    flips = {f["pod"]: f["to"]["reason"] for f in outs[0]["flips"]}
+    assert flips == {"ml/trainer-abc123-0": "DRY_RUN",
+                     "ml/trainer-abc123-1": "DRY_RUN",
+                     "ml/trainer-abc123-9": "DRY_RUN"}
+
+
 # ── ring bounding + restart reload ─────────────────────────────────────
 
 

@@ -1,0 +1,425 @@
+"""Policy gym tests (the new_subsystem tentpole).
+
+Acceptance contract:
+- the gym replays a >= 200-cycle corpus (synthetic, recorded by the REAL
+  daemon via trace_gen) scoring >= 3 policies in ONE pass;
+- the baseline policy's reclaimed chip-seconds reproduce the live
+  ledger's figure bit-for-bit on the recording run's own capsules;
+- `--right-size off` is exact decision parity (the classic scale-to-zero
+  patch, asserted against the PR-4 replay engine), while `--right-size
+  on` produces a partial scale-down with RIGHT_SIZED audit records,
+  partial-reclaim ledger accounting, and bit-for-bit capsule replay.
+
+Satellites pinned here too: fake_prom scripted-series exhaustion
+semantics (last value repeats) and the trace_gen → fake_prom round trip.
+"""
+
+import json
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus, trace_gen
+
+
+def run_gym_binary(*args):
+    proc = subprocess.run([str(DAEMON_PATH), "gym", *args],
+                          capture_output=True, text=True, timeout=600)
+    out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+    return proc.returncode, out, proc.stderr
+
+
+def run_analyze(*args):
+    proc = subprocess.run([sys.executable, "-m", "tpu_pruner.analyze", *args],
+                          capture_output=True, text=True, timeout=600)
+    out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+    return proc.returncode, out, proc.stderr
+
+
+# ── acceptance: >= 200-cycle corpus, >= 3 policies, one pass ────────────
+
+
+@pytest.fixture(scope="module")
+def flapping_corpus(built, tmp_path_factory):
+    """A 200-cycle evidence-complete (dry-run) synthetic corpus recorded
+    by the real daemon back-to-back (--check-interval 0)."""
+    flight = tmp_path_factory.mktemp("gym") / "flight"
+    spec = trace_gen.generate("flapping", 200, workloads=3, seed=7)
+    capsules = trace_gen.record_corpus(spec, flight)
+    assert len(capsules) == 200
+    return flight
+
+
+def test_gym_scores_three_policies_over_200_cycles(flapping_corpus):
+    rc, out, err = run_gym_binary("--flight-dir", str(flapping_corpus))
+    assert rc == 0, err
+    assert out["cycles"] == 200
+    policies = {p["name"]: p for p in out["policies"]}
+    assert len(policies) >= 3
+    assert {p["kind"] for p in out["policies"]} == {
+        "baseline", "right_size", "hysteresis"}
+
+    # Flapping idleness is the false-pause trap: the immediate baseline
+    # must pay for it, and a 3-cycle hysteresis streak must pay less.
+    baseline = policies["baseline"]
+    hysteresis = policies["hysteresis:pause_after=3"]
+    assert baseline["false_pauses"] > 0
+    assert hysteresis["false_pauses"] <= baseline["false_pauses"]
+    assert hysteresis["actuation_churn"] < baseline["actuation_churn"]
+
+    # The winner ships a ready-to-apply flag line.
+    assert out["winner"]["flag_line"]
+    assert out["winner"]["name"] in policies
+
+    # The human table and the flag line surface on stderr.
+    assert "winner:" in err
+    assert "apply with:" in err
+
+
+def test_analyze_gym_mode_matches_binary_and_honors_policy_flags(flapping_corpus):
+    rc, out, err = run_analyze("--gym", str(flapping_corpus),
+                               "--gym-policy", "baseline",
+                               "--gym-policy", "sweep:lookback=10m",
+                               "--gym-policy", "hysteresis:pause_after=2")
+    assert rc == 0, err
+    assert out["cycles"] == 200
+    names = [p["name"] for p in out["policies"]]
+    assert names == ["baseline", "sweep:lookback=10m", "hysteresis:pause_after=2"]
+    # same corpus + same default policy panel ⇒ same result as the binary
+    rc2, out2, _ = run_gym_binary("--flight-dir", str(flapping_corpus))
+    rc3, out3, _ = run_analyze("--gym", str(flapping_corpus))
+    assert rc2 == 0 and rc3 == 0
+    assert out2 == out3
+
+
+def test_gym_as_recorded_dry_run_corpus_reclaims_nothing(flapping_corpus):
+    """Strict as-recorded mode on a dry-run corpus: the baseline never
+    actuates, so nothing reclaims — the assume-scale-down default is what
+    makes dry-run corpora meaningful."""
+    rc, out, _ = run_gym_binary("--flight-dir", str(flapping_corpus),
+                                "--policy", "baseline", "--as-recorded")
+    assert rc == 0
+    assert out["policies"][0]["reclaimed_chip_seconds"] == 0
+    assert out["policies"][0]["pauses"] == 0
+
+
+def test_gym_assume_interval_scores_synthetic_cadence(flapping_corpus):
+    """Back-to-back recordings compress wall time to ~0; --assume-interval
+    scores each cycle at the production cadence it models, so the
+    baseline's reclaim becomes visible (and scales with the interval)."""
+    rc, clocked, _ = run_gym_binary("--flight-dir", str(flapping_corpus),
+                                    "--policy", "baseline")
+    rc2, assumed, _ = run_gym_binary("--flight-dir", str(flapping_corpus),
+                                     "--policy", "baseline",
+                                     "--assume-interval", "180")
+    assert rc == 0 and rc2 == 0
+    assert assumed["assume_interval_s"] == 180
+    assert (assumed["policies"][0]["reclaimed_chip_seconds"]
+            > clocked["policies"][0]["reclaimed_chip_seconds"])
+    assert assumed["policies"][0]["reclaimed_chip_seconds"] > 0
+
+
+def test_gym_rejects_unknown_policy_spec(flapping_corpus):
+    rc, _, err = run_gym_binary("--flight-dir", str(flapping_corpus),
+                                "--policy", "bogus")
+    assert rc != 0
+    assert "unknown policy kind" in err
+
+
+# ── acceptance: baseline reproduces the live ledger bit-for-bit ─────────
+
+
+def test_gym_baseline_reproduces_live_ledger_bit_for_bit(built, tmp_path):
+    """Record a scale-down corpus WITH --ledger-file, then assert the
+    gym's as-recorded baseline integrates the exact same reclaimed
+    chip-seconds from the capsules alone (the capsule stamps the ledger's
+    own clock and observations)."""
+    ledger = tmp_path / "ledger.jsonl"
+    spec = trace_gen.generate("diurnal", 6, workloads=2, seed=3)
+    capsules = trace_gen.record_corpus(
+        spec, tmp_path / "flight", run_mode="scale-down",
+        extra_args=("--ledger-file", str(ledger)), check_interval=1)
+    assert len(capsules) == 6
+
+    live_total = 0.0
+    for line in ledger.read_text().splitlines():
+        live_total += json.loads(line).get("reclaimed_chip_seconds", 0)
+    assert live_total > 0  # paused roots accrued across the 1s cycles
+
+    rc, out, err = run_gym_binary("--flight-dir", str(tmp_path / "flight"),
+                                  "--policy", "baseline", "--as-recorded")
+    assert rc == 0, err
+    assert out["policies"][0]["reclaimed_chip_seconds"] == live_total
+
+
+# ── acceptance: --right-size promotion into the daemon ──────────────────
+
+
+def record_right_size(tmp_path, prom, k8s, *extra, cycles=2):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", prom.url,
+           "--run-mode", "scale-down", "--daemon-mode",
+           "--check-interval", "1", "--max-cycles", str(cycles),
+           "--flight-dir", str(tmp_path / "flight"),
+           "--ledger-file", str(tmp_path / "ledger.jsonl"), *extra]
+    proc = subprocess.run(cmd, env={"KUBE_API_URL": k8s.url},
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    return sorted((tmp_path / "flight").glob("cycle-*.json"))
+
+
+def partially_idle_deployment(prom, k8s, replicas=4, idle=2):
+    """A Deployment with `replicas` replicas of which only `idle` pods
+    show up in the idle query (the rest are busy — absent rows)."""
+    dep, rs, pods = k8s.add_deployment_chain("ml", "serve", num_pods=idle,
+                                             tpu_chips=4, replicas=replicas)
+    for pod in pods:
+        prom.add_idle_pod_series(pod["metadata"]["name"], "ml", chips=4)
+    return dep
+
+
+def test_right_size_on_partial_deployment(built, tmp_path):
+    """R=4, 2 idle, τ=0.8 → N=3: one replica freed, RIGHT_SIZED records,
+    partial-reclaim ledger state, bit-for-bit replay, and what-if
+    right_size=off flips the decision back to a full SCALED."""
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    try:
+        partially_idle_deployment(prom, k8s)
+        capsules = record_right_size(tmp_path, prom, k8s, "--right-size", "on")
+        patches = k8s.scale_patches()
+    finally:
+        prom.stop()
+        k8s.stop()
+
+    # Cycle 1 right-sizes 4 → 3; cycle 2 sees R=3, still 2 idle → 1 busy
+    # → N=2 (progressive consolidation).
+    assert [b["spec"]["replicas"] for _, b in patches] == [3, 2]
+
+    doc = json.loads(capsules[0].read_text())
+    assert doc["config"]["right_size"] == "on"
+    reasons = {d["pod"]: d["reason"] for d in doc["decisions"]}
+    assert set(reasons.values()) == {"RIGHT_SIZED"}
+    details = {d["detail"] for d in doc["decisions"]}
+    assert details == {"right-sized from 4 to 3 replicas "
+                       "(2 busy, threshold 0.8, freed 4 chips)"}
+
+    # Ledger: partial reclaim — the account is right_sized with the freed
+    # chips accumulating (4 from cycle 1 + 4 more from cycle 2).
+    (account,) = [json.loads(line)
+                  for line in (tmp_path / "ledger.jsonl").read_text().splitlines()]
+    assert account["state"] == "right_sized"
+    assert account["chips_when_paused"] == 8
+    assert account["reclaimed_chip_seconds"] > 0
+    assert account["events"][0]["action"] == "right_sized"
+    assert account["events"][0]["reason"] == "RIGHT_SIZED"
+
+    # Bit-for-bit replay of both capsules, then the off-flip preview.
+    for capsule in capsules:
+        rc, out, err = run_analyze("--replay", str(capsule))
+        assert rc == 0, err
+        assert out["match"] is True
+    rc, out, _ = run_analyze("--replay", str(capsules[0]),
+                             "--what-if", "right_size=off")
+    assert rc == 0
+    flips = {f["pod"]: f for f in out["flips"]}
+    assert all(f["from"]["reason"] == "RIGHT_SIZED" and
+               f["to"]["reason"] == "SCALED" and f["predicted"]
+               for f in flips.values())
+
+
+def test_right_size_held_when_threshold_unreachable(built, tmp_path):
+    """τ=0.25 with 3 busy of 4: ceil(3/0.25)=12 >= R — held, no patch,
+    RIGHT_SIZE_HELD records, bit-for-bit replay."""
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    try:
+        partially_idle_deployment(prom, k8s, replicas=4, idle=1)
+        capsules = record_right_size(tmp_path, prom, k8s, "--right-size", "on",
+                                     "--right-size-threshold", "0.25", cycles=1)
+        patches = k8s.scale_patches()
+    finally:
+        prom.stop()
+        k8s.stop()
+
+    assert patches == []
+    doc = json.loads(capsules[0].read_text())
+    (decision,) = doc["decisions"]
+    assert decision["reason"] == "RIGHT_SIZE_HELD"
+    assert decision["action"] == "none"
+    assert "right-size held at 4 replicas" in decision["detail"]
+    rc, out, err = run_analyze("--replay", str(capsules[0]))
+    assert rc == 0, err
+    assert out["match"] is True
+
+
+def test_right_size_off_is_exact_parity_with_what_if_preview(built, tmp_path):
+    """Default --right-size off: the same partially idle Deployment takes
+    the classic all-or-nothing scale-to-zero (SCALED, replicas=0) exactly
+    as before this subsystem existed; the PR-4 replay reproduces it
+    bit-for-bit, and --what-if right_size=on previews the split without
+    touching anything."""
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    try:
+        partially_idle_deployment(prom, k8s)
+        capsules = record_right_size(tmp_path, prom, k8s, cycles=1)
+        patches = k8s.scale_patches()
+    finally:
+        prom.stop()
+        k8s.stop()
+
+    assert [b["spec"]["replicas"] for _, b in patches] == [0]
+    doc = json.loads(capsules[0].read_text())
+    assert doc["config"]["right_size"] == "off"
+    assert {d["reason"] for d in doc["decisions"]} == {"SCALED"}
+
+    rc, out, err = run_analyze("--replay", str(capsules[0]))
+    assert rc == 0, err
+    assert out["match"] is True
+
+    rc, out, _ = run_analyze("--replay", str(capsules[0]),
+                             "--what-if", "right_size=on",
+                             "--what-if", "right_size_threshold=0.8")
+    assert rc == 0
+    flips = {f["pod"]: f for f in out["flips"]}
+    assert len(flips) == 2
+    assert all(f["from"]["reason"] == "SCALED" and
+               f["to"]["reason"] == "RIGHT_SIZED" and f["predicted"]
+               for f in flips.values())
+
+
+def test_right_size_gym_policy_beats_baseline_on_partially_idle_fleet(
+        built, tmp_path):
+    """On a corpus whose roots are partially idle, the right-size policy
+    avoids the baseline's false pauses (pausing a root whose siblings are
+    busy IS the regret case) while still reclaiming capacity."""
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    try:
+        # 2 partially idle deployments: 4 replicas, 2 idle pods each.
+        for i in range(2):
+            dep, rs, pods = k8s.add_deployment_chain(
+                "ml", f"svc-{i}", num_pods=2, tpu_chips=4, replicas=4)
+            for pod in pods:
+                prom.add_idle_pod_series(pod["metadata"]["name"], "ml", chips=4)
+        cmd = [str(DAEMON_PATH), "--prometheus-url", prom.url,
+               "--run-mode", "dry-run", "--daemon-mode",
+               "--check-interval", "1", "--max-cycles", "3",
+               "--flight-dir", str(tmp_path / "flight")]
+        proc = subprocess.run(cmd, env={"KUBE_API_URL": k8s.url},
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+    finally:
+        prom.stop()
+        k8s.stop()
+
+    rc, out, err = run_gym_binary("--flight-dir", str(tmp_path / "flight"),
+                                  "--policy", "baseline",
+                                  "--policy", "right-size:threshold=0.8")
+    assert rc == 0, err
+    policies = {p["kind"]: p for p in out["policies"]}
+    assert policies["right_size"]["right_size_applied"] > 0
+    assert policies["right_size"]["reclaimed_chip_seconds"] > 0
+    # the partial policy reclaims less than all-or-nothing but never more
+    assert (policies["right_size"]["reclaimed_chip_seconds"]
+            <= policies["baseline"]["reclaimed_chip_seconds"])
+
+
+# ── satellite: scripted-series exhaustion semantics + round trip ────────
+
+
+def query_fake_prom(prom):
+    with urllib.request.urlopen(prom.url + "/api/v1/query?query=up", timeout=5) as resp:
+        return json.load(resp)
+
+
+def served_idle_pods(doc):
+    return {r["metric"].get("exported_pod") for r in doc["data"]["result"]}
+
+
+def test_scripted_series_exhaustion_repeats_last_value(built):
+    """The fake_prom scripted-series contract multi-hundred-cycle gym
+    traces rely on: once values[] is exhausted, the LAST entry repeats
+    forever — both for a trailing idle (row keeps being served) and a
+    trailing busy (row stays absent)."""
+    prom = FakePrometheus()
+    prom.start()
+    try:
+        prom.add_scripted_pod_series("ends-idle", "ml", [None, 0.0])
+        prom.add_scripted_pod_series("ends-busy", "ml", [0.0, None])
+        served = [served_idle_pods(query_fake_prom(prom)) for _ in range(5)]
+    finally:
+        prom.stop()
+    assert [("ends-idle" in s) for s in served] == [False, True, True, True, True]
+    assert [("ends-busy" in s) for s in served] == [True, False, False, False, False]
+
+
+def test_evidence_script_exhaustion_repeats_last_age(built):
+    """Evidence scripts (signal watchdog knobs) exhaust the same way, on
+    their OWN index."""
+    prom = FakePrometheus()
+    prom.start()
+    try:
+        prom.add_idle_pod_series("p0", "ml", last_sample_age=[0.0, 4000.0])
+        ages = []
+        for _ in range(4):
+            with urllib.request.urlopen(
+                    prom.url + "/api/v1/query?query=x{signal_stat=\"age\"}",
+                    timeout=5) as resp:
+                doc = json.load(resp)
+            (age_row,) = [r for r in doc["data"]["result"]
+                          if r["metric"].get("signal_stat") == "age"]
+            ages.append(float(age_row["value"][1]))
+    finally:
+        prom.stop()
+    assert ages == [0.0, 4000.0, 4000.0, 4000.0]
+
+
+def test_trace_gen_fake_prom_round_trip(built):
+    """generate → install → query the fake cycles+2 times: the served
+    idle sets must follow the spec's scripts cycle by cycle, including
+    the repeat-last tail beyond the scripted horizon."""
+    spec = trace_gen.generate("flapping", 10, workloads=2, seed=11)
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    try:
+        trace_gen.install(spec, prom, k8s)
+        served = [served_idle_pods(query_fake_prom(prom)) for _ in range(12)]
+    finally:
+        prom.stop()
+        k8s.stop()
+
+    for wl in spec["workloads"]:
+        pod = f"{wl['name']}-abc123-0"
+        for cycle in range(12):
+            expected = wl["values"][min(cycle, len(wl["values"]) - 1)]
+            assert (pod in served[cycle]) == (expected is not None), (
+                f"{pod} cycle {cycle}: script={expected}")
+
+
+def test_trace_gen_deterministic_and_validates(built):
+    assert trace_gen.generate("flapping", 20, seed=5) == \
+        trace_gen.generate("flapping", 20, seed=5)
+    a = trace_gen.generate("flapping", 20, seed=5)["workloads"][0]["values"]
+    b = trace_gen.generate("flapping", 20, seed=6)["workloads"][0]["values"]
+    assert a != b
+    with pytest.raises(ValueError):
+        trace_gen.generate("nope", 10)
+    with pytest.raises(ValueError):
+        trace_gen.generate("flapping", 0)
+    storm = trace_gen.generate("resume-storm", 20, workloads=2)
+    # every workload goes busy simultaneously somewhere mid-corpus
+    busy_at = [{i for i, v in enumerate(w["values"]) if v is None}
+               for w in storm["workloads"]]
+    assert busy_at[0] == busy_at[1] and busy_at[0]
+    brown = trace_gen.generate("brownout", 20)
+    ages = brown["workloads"][0]["last_sample_age"]
+    assert trace_gen.BROWNOUT_STALE_AGE in ages and 0.0 in ages

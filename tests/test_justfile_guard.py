@@ -72,6 +72,22 @@ def test_fleet_smoke_recipe_present_and_wired():
     assert callable(module.main)
 
 
+def test_gym_smoke_recipe_present_and_wired():
+    """`just gym-smoke` must exist and invoke the real smoke module — the
+    policy-gym contract (200-cycle synthetic corpus, 3 policies scored in
+    one pass, winner flag line) would otherwise go unguarded in CI."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^gym-smoke\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `gym-smoke:` recipe"
+    assert "tpu_pruner.testing.gym_smoke" in m.group(1), (
+        "gym-smoke no longer invokes tpu_pruner.testing.gym_smoke")
+    import importlib
+
+    module = importlib.import_module("tpu_pruner.testing.gym_smoke")
+    assert callable(module.main)
+
+
 def test_just_verify_matches_roadmap_tier1():
     roadmap = roadmap_tier1_command()
     justfile = justfile_verify_command()

@@ -56,6 +56,20 @@ capsule can't re-derive offline (veto sets, group all-idle verdicts,
 actuation results) are held fixed, and flips that newly reach actuation
 are marked predicted.
 
+Policy-gym mode (`--gym <flight-dir|capsule.json|url>`): replay a whole
+capsule corpus — a `--flight-dir` directory, individual capsule files, or
+a daemon's `/debug/cycles` index URL — against N candidate policies in
+ONE pass and score each with the ledger's own integration math:
+reclaimed chip-hours vs false pauses (a pause whose root shows busy
+evidence within `--regret-window` seconds) vs actuation churn. Policies
+(`--gym-policy`, repeatable) are spec strings: `baseline`,
+`sweep:lookback=10m,grace=60`, `right-size:threshold=0.8`,
+`hysteresis:pause_after=3`; the default panel scores those three kinds.
+The winner's config prints as a ready-to-apply daemon flag line. Human
+table on stderr, one JSON document on stdout. Synthetic corpora come
+from tpu_pruner.testing.trace_gen (diurnal load, flapping idleness,
+resume storms, brownout windows).
+
 Signal-health mode (`--signal-report <capsule.json|url>`): render the
 fleet's evidence health from the signal-quality watchdog (`--signal-guard
 on` on the daemon) — per-pod verdicts (healthy / stale / gappy / absent),
@@ -263,7 +277,11 @@ def _load_decision_records(args) -> list[dict]:
         return records
     import urllib.request
 
-    url = args.decisions_url.rstrip("/") + "/debug/decisions"
+    # Bare host:port expands to the live endpoint; a full /debug/... URL
+    # passes through verbatim (same ergonomics as --signal-report).
+    url = args.decisions_url
+    if "/debug/" not in url:
+        url = url.rstrip("/") + "/debug/decisions"
     with urllib.request.urlopen(url, timeout=10) as resp:
         return json.load(resp)["decisions"]
 
@@ -320,13 +338,17 @@ def _run_replay(args) -> int:
         with open(source) as f:
             capsule = json.load(f)
 
+    # --what-if is repeatable AND takes several key=value pairs per
+    # occurrence: `--what-if lookback=10m grace=60 --what-if run_mode=...`
+    # all fold into ONE combined overlay (one flip report).
     what_if = {}
-    for pair in args.what_if or []:
-        if "=" not in pair:
-            print(f"--what-if expects key=value, got {pair!r}", file=sys.stderr)
-            return 2
-        key, value = pair.split("=", 1)
-        what_if[key] = value
+    for group in args.what_if or []:
+        for pair in group:
+            if "=" not in pair:
+                print(f"--what-if expects key=value, got {pair!r}", file=sys.stderr)
+                return 2
+            key, value = pair.split("=", 1)
+            what_if[key] = value
 
     from tpu_pruner import native
 
@@ -367,6 +389,73 @@ def _run_replay(args) -> int:
         print(f"    replayed: {json.dumps(d.get('replayed'))}", file=sys.stderr)
     print(json.dumps(result))
     return 1
+
+
+def _load_gym_capsules(source: str) -> list[dict]:
+    """Capsule corpus from a --flight-dir directory, one capsule file, or
+    a daemon URL (bare host:port expands to /debug/cycles; each indexed
+    capsule is then fetched from /debug/cycles/<id>)."""
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        base = source.rstrip("/")
+        index_url = base if "/debug/" in base else base + "/debug/cycles"
+        with urllib.request.urlopen(index_url, timeout=10) as resp:
+            index = json.load(resp)
+        root = index_url.rsplit("/debug/", 1)[0]
+        capsules = []
+        for entry in index.get("capsules", []):
+            with urllib.request.urlopen(
+                    f"{root}/debug/cycles/{entry['id']}", timeout=10) as resp:
+                capsules.append(json.load(resp))
+        return capsules
+    import glob
+    import os.path
+
+    if os.path.isdir(source):
+        paths = sorted(glob.glob(os.path.join(source, "cycle-*.json")))
+    else:
+        paths = [source]
+    capsules = []
+    for path in paths:
+        with open(path) as f:
+            capsules.append(json.load(f))
+    return capsules
+
+
+def _run_gym(args) -> int:
+    """Policy-gym mode: score N policies over a capsule corpus."""
+    capsules = _load_gym_capsules(args.gym)
+    if not capsules:
+        print(f"no capsules found at {args.gym} (need a --flight-dir "
+              "directory, capsule file, or daemon URL)", file=sys.stderr)
+        return 1
+
+    from tpu_pruner import native
+
+    result = native.gym_simulate(
+        capsules, policies=args.gym_policy or None,
+        regret_window_s=args.regret_window,
+        assume_scale_down=not args.as_recorded,
+        assume_interval_s=args.assume_interval)
+
+    print(f"policy gym: {result['cycles']} capsule cycle(s), "
+          f"{len(result['policies'])} policies, regret window "
+          f"{result['regret_window_s']}s"
+          + (" (as recorded)" if args.as_recorded else ""), file=sys.stderr)
+    print(f"\n{'policy':36s} {'reclaimed':>12s} {'false':>6s} {'churn':>6s} "
+          f"{'held':>5s} {'score':>9s}", file=sys.stderr)
+    print(f"{'':36s} {'chip-hrs':>12s} {'pauses':>6s} {'':>6s} {'':>5s} "
+          f"{'':>9s}", file=sys.stderr)
+    for p in result["policies"]:
+        print(f"{p['name']:36s} {p['reclaimed_chip_hours']:12.3f} "
+              f"{p['false_pauses']:6d} {p['actuation_churn']:6d} "
+              f"{p['right_size_held']:5d} {p['score']:9.3f}", file=sys.stderr)
+    winner = result["winner"]
+    print(f"\nwinner: {winner['name']}\napply with: {winner['flag_line']}",
+          file=sys.stderr)
+    print(json.dumps(result))
+    return 0
 
 
 def _run_signal_report(args) -> int:
@@ -461,7 +550,8 @@ def _load_ledger_sources(args) -> list[dict]:
     import urllib.request
 
     for url in (args.workloads_url or []):
-        full = url.rstrip("/") + "/debug/workloads"
+        # Bare host:port expands; full /debug/... URLs pass through.
+        full = url if "/debug/" in url else url.rstrip("/") + "/debug/workloads"
         with urllib.request.urlopen(full, timeout=10) as resp:
             doc = json.load(resp)
         sources.append({"name": url, "records": doc.get("workloads", []),
@@ -739,13 +829,46 @@ def main(argv=None) -> int:
                              "network calls; exits non-zero when the "
                              "replayed decisions drift from the recorded "
                              "ones")
-    parser.add_argument("--what-if", nargs="+", metavar="KEY=VALUE",
+    parser.add_argument("--what-if", nargs="+", action="append",
+                        metavar="KEY=VALUE",
                         help="with --replay: re-decide under altered config "
                              "(lookback=10m, duration=45, grace=600, "
                              "run_mode=scale-down, enabled_resources=dr, "
                              "max_scale_per_cycle=2, hbm_threshold=0.05, "
-                             "signal_min_coverage=0.5, signal_guard=off) "
-                             "and report which decisions flip")
+                             "signal_min_coverage=0.5, signal_guard=off, "
+                             "right_size=on, right_size_threshold=0.8) "
+                             "and report which decisions flip; repeatable, "
+                             "and several key=value pairs may ride one "
+                             "occurrence — all fold into ONE combined flip "
+                             "report")
+    parser.add_argument("--gym", metavar="SOURCE",
+                        help="policy-gym mode: replay a capsule corpus (a "
+                             "--flight-dir directory, a capsule file, or a "
+                             "daemon URL whose /debug/cycles index is "
+                             "fetched) against N candidate policies in one "
+                             "pass, scoring reclaimed chip-hours vs false "
+                             "pauses vs actuation churn; the winner's "
+                             "config prints as a ready-to-apply flag line")
+    parser.add_argument("--gym-policy", metavar="SPEC", action="append",
+                        help="with --gym: a policy to score (repeatable): "
+                             "baseline | sweep:<k=v,...> | "
+                             "right-size[:threshold=0.8] | "
+                             "hysteresis[:pause_after=3]; default panel "
+                             "scores all three kinds")
+    parser.add_argument("--regret-window", type=int, default=600,
+                        help="with --gym: a pause whose root shows busy "
+                             "evidence within this window counts as a "
+                             "false pause (seconds, default 600)")
+    parser.add_argument("--as-recorded", action="store_true",
+                        help="with --gym: score run modes exactly as "
+                             "recorded (a dry-run corpus then reclaims "
+                             "nothing); default scores every policy as if "
+                             "run_mode=scale-down")
+    parser.add_argument("--assume-interval", type=int, default=0,
+                        help="with --gym: score cycles this many seconds "
+                             "apart instead of using the capsules' own "
+                             "clocks — for synthetic corpora recorded "
+                             "back-to-back (default 0 = capsule clocks)")
     parser.add_argument("--signal-report", metavar="SOURCE",
                         help="signal-health mode: render the fleet's "
                              "evidence health (per-pod verdicts, coverage, "
@@ -777,6 +900,13 @@ def main(argv=None) -> int:
                         help="with --stream: discard STATE and start a fresh "
                              "window from this dump")
     args = parser.parse_args(argv)
+    if args.gym:
+        if args.replay or args.explain or args.fleet_report or args.signal_report:
+            parser.error("--gym is mutually exclusive with --replay, "
+                         "--explain, --fleet-report and --signal-report")
+        return _run_gym(args)
+    if args.gym_policy or args.as_recorded:
+        parser.error("--gym-policy/--as-recorded only apply with --gym")
     if args.signal_report:
         if args.replay or args.explain or args.fleet_report:
             parser.error("--signal-report is mutually exclusive with "

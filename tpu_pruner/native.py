@@ -161,6 +161,8 @@ def load() -> ctypes.CDLL:
         "tp_fleet_aggregate",
         "tp_stamp_exposition",
         "tp_replay_cycle",
+        "tp_gym_simulate",
+        "tp_right_size_plan",
         "tp_ledger_sim",
         "tp_ledger_metric_families",
         "tp_informer_start",
@@ -316,6 +318,44 @@ def replay_cycle(capsule: dict, what_if: dict | None = None) -> dict:
     if what_if:
         payload["what_if"] = what_if
     return _call("tp_replay_cycle", payload)
+
+
+def gym_simulate(capsules: list[dict], policies: list | None = None,
+                 regret_window_s: int = 600, assume_scale_down: bool = True,
+                 assume_interval_s: int = 0,
+                 false_pause_penalty_chip_hours: float | None = None,
+                 churn_penalty_chip_hours: float | None = None) -> dict:
+    """Run the policy gym (native/src/gym.cpp) over a flight-recorder
+    capsule corpus: one pass, N policies scored side by side with the
+    ledger's own integration math (reclaimed chip-hours vs false pauses
+    vs actuation churn). ``policies`` entries are spec strings
+    ("baseline", "sweep:lookback=10m", "right-size:threshold=0.8",
+    "hysteresis:pause_after=3") or structured objects; None scores the
+    default 3-policy panel. ``assume_scale_down`` scores dry-run corpora
+    as if run_mode=scale-down (False = strict as-recorded mode, the
+    ledger-parity contract). This is `analyze --gym`'s backend."""
+    payload: dict = {"capsules": capsules, "regret_window_s": regret_window_s,
+                     "assume_scale_down": assume_scale_down}
+    if assume_interval_s:
+        payload["assume_interval_s"] = assume_interval_s
+    if policies:
+        payload["policies"] = policies
+    if false_pause_penalty_chip_hours is not None:
+        payload["false_pause_penalty_chip_hours"] = false_pause_penalty_chip_hours
+    if churn_penalty_chip_hours is not None:
+        payload["churn_penalty_chip_hours"] = churn_penalty_chip_hours
+    return _call("tp_gym_simulate", payload)
+
+
+def right_size_plan(kind: str, obj: dict, idle_pods: int, idle_chips: int,
+                    threshold: float = 0.8) -> dict:
+    """The replica right-sizing math (gym::right_size_plan) — the ONE
+    implementation shared by the daemon's --right-size split, the replay
+    engine and the gym. Returns {applicable, current_replicas,
+    busy_replicas, target_replicas, freed_chips, held, detail}."""
+    return _call("tp_right_size_plan",
+                 {"kind": kind, "object": obj, "idle_pods": idle_pods,
+                  "idle_chips": idle_chips, "threshold": threshold})
 
 
 def ledger_sim(top_k: int, cycles: list[dict], query: str = "") -> dict:
