@@ -103,6 +103,24 @@ fleet-smoke:
 gym-smoke:
     python -m tpu_pruner.testing.gym_smoke
 
+# mega-bench smoke: the 50k-pod tier scaled down to 10,240 pods so CI can
+# run it in minutes — every tier target is still asserted inside
+# run_mega_tier (shard resolve speedup >1 on multi-core hosts, capsules
+# recorded under N shards replay bit-for-bit, warm steady-state API calls
+# O(churn), warm p50 detect→scaledown under the 100 ms bar), so a miss
+# exits non-zero. tests/test_justfile_guard.py pins the recipe to
+# bench.py --mega-only.
+bench-mega:
+    TP_MEGA_PODS=10240 python bench.py --mega-only
+
+# shard-engine race tier: the sharded resolve fan-out, worker pool reuse
+# and the informer's concurrent 410+relist coalescing under
+# ThreadSanitizer (substring filter of the native test binary)
+tsan-shard:
+    cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
+    ./build-tsan/tpupruner_tests shard
+    ./build-tsan/tpupruner_tests informer
+
 # standalone TPU capture: probe + fleet eval + bench_tpu_last_good.json
 # (run EARLY in a round / whenever the chip tunnel is up; exits 1 when no
 # real accelerator measurement happened)

@@ -71,6 +71,18 @@ struct Cli {
   int64_t resolve_concurrency = 10;       // --resolve-concurrency (ref: fixed 10)
   int64_t resolve_batch_threshold = 8;    // --resolve-batch-threshold (0 = off)
   int64_t scale_concurrency = 8;          // --scale-concurrency (ref: serial consumer)
+  // --shards: reconcile-engine shard count (shard.hpp). Candidates walk
+  // shard-parallel (per-shard owner cache, read-through to the informer
+  // store) and fold keyed by resolved-root hash, then merge in stable
+  // order — every count produces byte-identical decisions. 1 = the
+  // serial engine; 0 (default) = auto: hardware_concurrency clamped to 8.
+  int64_t shards = 0;
+  // --overlap {on, off}: pipeline adjacent cycles — cycle N+1's
+  // query+decode+signal phases run on a helper thread while cycle N
+  // resolves and its actuations drain (bounded two-cycle handoff; the
+  // breaker, brownout and --max-scale-per-cycle caps still apply per
+  // cycle). "off" (default) keeps the strictly serial producer loop.
+  std::string overlap = "off";
   int metrics_port = -1;                  // --metrics-port: -1 disabled (flag "0" maps
                                           // here too), 0 ephemeral (flag "auto"), else port
   // --cluster-name: fleet identity stamped on every exported surface (a

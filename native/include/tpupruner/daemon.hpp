@@ -42,7 +42,10 @@ struct ScalePlan {
 };
 
 // One evaluation cycle (reference: run_query_and_scale, main.rs:390-570).
-// `enqueue` receives each surviving target (enabled-kind filtering stays
+// `enqueue` receives each surviving target plus the id of the cycle that
+// produced it — under --overlap the producer may already be preparing the
+// NEXT cycle while this one's targets enqueue, so the consumer must never
+// infer the cycle from a global counter (enabled-kind filtering stays
 // consumer-side, as in the reference; `enabled` is used only so the
 // --max-scale-per-cycle budget counts actionable targets, not ones the
 // consumer will skip). Throws on query failure (feeds the failure budget).
@@ -58,7 +61,7 @@ struct ScalePlan {
 // whole cycle's scale-downs (signal.hpp).
 CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
                      core::ResourceSet enabled,
-                     const std::function<void(core::ScaleTarget, ScalePlan)>& enqueue,
+                     const std::function<void(core::ScaleTarget, ScalePlan, uint64_t)>& enqueue,
                      const informer::ClusterCache* watch_cache = nullptr,
                      const std::string& evidence_query = "");
 

@@ -63,6 +63,10 @@ struct ResourceStats {
   uint64_t deletes = 0;
   uint64_t bookmarks = 0;
   uint64_t relists = 0;
+  // Relist REQUESTS (ERROR/410 events, watch-failure streaks) after
+  // coalescing: a 410 that lands while a relist is already in flight is
+  // absorbed, not queued — `relists` counts LISTs actually applied.
+  uint64_t relist_requests = 0;
   uint64_t watch_failures = 0;
   std::string resource_version;
 };
@@ -105,10 +109,18 @@ class Reflector {
 
   // ── pure event application (unit-testable without a server) ──
   // Apply one watch event {type, object}. Returns false when the event
-  // demands a relist (ERROR status, e.g. code 410).
+  // demands a relist (ERROR status, e.g. code 410). Relist requests are
+  // COALESCED: an ERROR/410 arriving while a relist is already pending
+  // (LIST in flight) marks nothing new — apply_list services and clears
+  // the pending flag — so a 410 storm can never stack relists. Safe to
+  // call concurrently with apply_list (the relist window is exactly when
+  // a late watch event can still race the fresh LIST).
   bool apply_event(const json::Value& event);
-  // Apply a LIST result (replace + resourceVersion adoption).
+  // Apply a LIST result (replace + resourceVersion adoption); services
+  // any pending relist request.
   void apply_list(const json::Value& list);
+  // True while a requested relist has not yet been serviced by apply_list.
+  bool relist_pending() const { return relist_pending_.load(); }
   // Object path for an object of this resource (empty when metadata is
   // missing — such objects are ignored, never half-keyed).
   std::string object_path_of(const json::Value& object) const;
@@ -116,17 +128,25 @@ class Reflector {
  private:
   void run();  // thread body: relist loop wrapping the watch loop
   void bump_watch_failure(const std::string& why);
+  // Mark a relist request; returns false when one was already pending
+  // (the request is coalesced, not stacked).
+  bool request_relist(const std::string& why);
+  std::string resource_version() const;
 
   const k8s::Client& kube_;
   ResourceSpec spec_;
   Store store_;
   std::atomic<bool> synced_{false};
   std::atomic<bool> stop_{false};
+  std::atomic<bool> relist_pending_{false};
   std::atomic<int64_t> last_activity_mono_{0};
   std::thread thread_;
   mutable std::mutex stats_mutex_;
   ResourceStats stats_;
-  std::string resource_version_;  // watch bookmark, owned by the thread
+  // Watch resume point. Guarded by stats_mutex_: apply_event and
+  // apply_list may run concurrently around a relist (a straggling watch
+  // frame vs the fresh LIST), and both touch it.
+  std::string resource_version_;
 };
 
 // The daemon-facing facade: one Reflector per watched resource, lookups

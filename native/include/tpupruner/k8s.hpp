@@ -66,7 +66,12 @@ class Client {
   // GET that throws on any non-2xx.
   json::Value get(const std::string& path) const;
   // LIST with an urlencoded labelSelector; returns the List object.
-  json::Value list(const std::string& path, const std::string& label_selector) const;
+  // `limit` > 0 requests server-side pagination (`limit=N` per page) and
+  // the client transparently follows `metadata.continue` until the
+  // collection is complete — the informer's initial LIST passes a page
+  // size so a 100k-object collection never materializes as one response.
+  json::Value list(const std::string& path, const std::string& label_selector,
+                   int64_t limit = 0) const;
   // application/merge-patch+json PATCH (reference Patch::Merge).
   json::Value patch_merge(const std::string& path, const json::Value& body,
                           bool retry_throttle = true) const;

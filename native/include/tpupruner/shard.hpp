@@ -1,0 +1,92 @@
+// Sharded reconcile-engine primitives: stable root-keyed shard placement
+// plus a persistent worker pool.
+//
+// The reconcile cycle used to be a serial phase chain whose resolve stage
+// fanned work out with per-call thread spawns (util::fan_out) and folded
+// every result under one mutex — at the 100k-pod bench scale the fold
+// mutex and thread churn become the ceiling, and the nondeterministic
+// fold order made byte-level audit/capsule comparisons across
+// configurations impossible. This module provides the two pieces the
+// sharded engine in daemon.cpp builds on:
+//
+//   - stable_hash / shard_of: placement keyed by the RESOLVED ROOT's
+//     identity, so every pod of one root folds on one shard and per-root
+//     state (group gates, right-size plans, ledger accounts) stays
+//     single-writer per shard. FNV-1a, not std::hash: placement must be
+//     identical across runs, builds and platforms — capsule replay and
+//     the --shards 1 vs N byte-identity contract depend on it.
+//
+//   - Pool: a persistent worker pool with fan_out semantics. The daemon
+//     runs one pool for the life of the process (sized by --shards)
+//     instead of spawning threads per phase per cycle; the policy gym's
+//     capsule replay loop reuses the same pool.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <string_view>
+#include <thread>
+#include <vector>
+
+namespace tpupruner::shard {
+
+// FNV-1a 64-bit over the key bytes. Deliberately not std::hash (its value
+// is implementation-defined and may differ across libstdc++ versions) —
+// shard placement is part of the engine's determinism contract.
+uint64_t stable_hash(std::string_view key);
+
+// Shard index for a key. num_shards == 0 is treated as 1 (everything on
+// shard 0). Same key + same shard count → same shard, always.
+size_t shard_of(std::string_view key, size_t num_shards);
+
+// --shards resolution: values >= 1 are clamped to [1, kMaxShards]; 0
+// ("auto", the default) resolves to hardware_concurrency clamped to
+// [1, kAutoMaxShards] — past ~8 shards the per-cycle fold is merge-bound
+// on the clusters the bench models, so auto stays conservative and the
+// flag allows explicit wider counts.
+constexpr size_t kMaxShards = 64;
+constexpr size_t kAutoMaxShards = 8;
+size_t resolve_shard_count(int64_t flag);
+
+// Persistent worker pool: run(n, fn) has util::fan_out semantics (fn(i)
+// for i in [0, n), all workers pulling off a shared counter, blocking
+// until every index completed) but reuses the same threads across calls.
+// The first exception thrown by fn is captured and rethrown from run()
+// (fan_out would std::terminate). run() is not reentrant — a task must
+// not call run() on its own pool.
+class Pool {
+ public:
+  explicit Pool(size_t workers);
+  ~Pool();
+  Pool(const Pool&) = delete;
+  Pool& operator=(const Pool&) = delete;
+
+  size_t size() const { return threads_.size(); }
+  void run(size_t n_tasks, const std::function<void(size_t)>& fn);
+
+ private:
+  void worker_loop();
+
+  std::mutex mutex_;
+  std::condition_variable work_cv_;   // workers wait for a new generation
+  std::condition_variable done_cv_;   // run() waits for completion
+  uint64_t generation_ = 0;           // bumped per run() call
+  size_t n_tasks_ = 0;
+  size_t next_ = 0;                   // next index to hand out
+  size_t active_ = 0;                 // workers still inside fn
+  const std::function<void(size_t)>* fn_ = nullptr;
+  std::exception_ptr first_error_;
+  bool stop_ = false;
+  std::vector<std::thread> threads_;
+};
+
+// Process-wide pool for the reconcile engine. The first caller sizes it;
+// a later call with a DIFFERENT size tears the old pool down and builds a
+// fresh one (the daemon uses one constant size for the process lifetime —
+// resizing exists for tests and the gym, which may run with their own
+// shard counts).
+Pool& pool(size_t workers);
+
+}  // namespace tpupruner::shard

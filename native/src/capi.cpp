@@ -24,6 +24,7 @@
 #include "tpupruner/ledger.hpp"
 #include "tpupruner/metrics.hpp"
 #include "tpupruner/query.hpp"
+#include "tpupruner/shard.hpp"
 #include "tpupruner/signal.hpp"
 
 using tpupruner::json::Value;
@@ -322,6 +323,27 @@ char* tp_informer_stop(const char* payload_json) {
     if (session) session->cache.stop();  // join reflectors before the client dies
     Value out = Value::object();
     out.set("stopped", Value(stopped));
+    return ok(out);
+  });
+}
+
+char* tp_shard_of(const char* payload_json) {
+  // Shard placement for a resolved-root key — the python determinism
+  // tests assert the same key always lands on the same shard and that
+  // placement is stable across processes (FNV-1a, shard.hpp).
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    const Value* key = p.find("key");
+    if (!key || !key->is_string()) throw std::runtime_error("missing key");
+    int64_t shards = 0;
+    if (const Value* s = p.find("shards"); s && s->is_number()) shards = s->as_int();
+    if (shards < 0) throw std::runtime_error("shards must be >= 0");
+    Value out = Value::object();
+    out.set("shard", Value(static_cast<int64_t>(
+        tpupruner::shard::shard_of(key->as_string(), static_cast<size_t>(shards)))));
+    out.set("hash", Value(static_cast<int64_t>(tpupruner::shard::stable_hash(key->as_string()))));
+    out.set("resolved_count", Value(static_cast<int64_t>(
+        tpupruner::shard::resolve_shard_count(shards))));
     return ok(out);
   });
 }

@@ -113,6 +113,19 @@ TPU FLAGS:
                                 collection LIST instead of per-object GETs;
                                 0 disables batching [default: 8]
       --scale-concurrency <N>   concurrent scale actuations [default: 8]
+      --shards <N>              reconcile-engine shard count: candidates walk
+                                shard-parallel and fold keyed by resolved-root
+                                hash, merging in stable order (every count
+                                produces byte-identical decisions; 1 = the
+                                serial engine) [default: 0 = auto, the host's
+                                hardware concurrency clamped to 8]
+      --overlap <M>             on | off [default: off] — pipeline adjacent
+                                cycles: cycle N+1's query+decode+signal run on
+                                a helper thread while cycle N resolves and its
+                                actuations drain. Per-cycle caps (breaker,
+                                brownout) are unaffected; best with short
+                                --check-interval (prefetched evidence ages by
+                                up to one interval otherwise)
       --max-scale-per-cycle <N> blast-radius circuit breaker: pause at most N
                                 root objects per cycle, deferring the rest
                                 (a metric-plane outage reading the whole fleet
@@ -302,6 +315,16 @@ Cli parse(int argc, char** argv) {
          cli.max_scale_per_cycle = parse_int("--max-scale-per-cycle", v);
          if (cli.max_scale_per_cycle < 0)
            throw CliError("--max-scale-per-cycle must be >= 0");
+       }},
+      {"--shards",
+       [&](const std::string& v) {
+         cli.shards = parse_int("--shards", v);
+         if (cli.shards < 0) throw CliError("--shards must be >= 0 (0 = auto)");
+       }},
+      {"--overlap",
+       [&](const std::string& v) {
+         check_choice("--overlap", v, {"on", "off"});
+         cli.overlap = v;
        }},
       {"--watch-cache",
        [&](const std::string& v) {

@@ -7,7 +7,10 @@
 #include <condition_variable>
 #include <csignal>
 #include <deque>
+#include <future>
+#include <map>
 #include <mutex>
+#include <set>
 #include <thread>
 #include <unordered_map>
 #include <unordered_set>
@@ -27,6 +30,7 @@
 #include "tpupruner/metrics.hpp"
 #include "tpupruner/prom.hpp"
 #include "tpupruner/recorder.hpp"
+#include "tpupruner/shard.hpp"
 #include "tpupruner/signal.hpp"
 #include "tpupruner/util.hpp"
 #include "tpupruner/walker.hpp"
@@ -118,34 +122,54 @@ signal::Config signal_config(const cli::Cli& args) {
 }
 
 struct ResolveOutcome {
-  std::vector<ScaleTarget> targets;
+  std::vector<ScaleTarget> targets;  // deduped per root, identity-sorted
   walker::IdlePodSet idle_pods;  // pods idle AND eligible (for the slice gate)
   // Audit trail: records terminal at the resolve stage (eligibility gates,
-  // fetch failures, failed walks) ...
+  // fetch failures, failed walks), sorted by (ns, pod) ...
   std::vector<audit::DecisionRecord> decided;
   // ... and per-pod records that resolved to a root — their verdict lands
   // later (opt-out valves, group gate, breaker, actuation), keyed by the
   // root's identity so run_cycle can join them against target outcomes.
+  // Sorted by (ns, pod) too: together with the target sort this makes the
+  // audit JSONL and capsule bytes independent of the shard count.
   std::vector<std::pair<std::string, audit::DecisionRecord>> resolved_records;
   // Workload-ledger evidence: per resolved root, the chips its observed
   // idle pods reserve this cycle (keyed "Kind/ns/name" — the ledger's
   // account key, not the uid identity: savings must survive root
-  // recreation under a new uid).
-  std::unordered_map<std::string, ledger::Observation> ledger_obs;
+  // recreation under a new uid). Ordered map: the capsule's ledger feed
+  // iterates it, and capsule bytes must not depend on hash order.
+  std::map<std::string, ledger::Observation> ledger_obs;
   // Root identities vetoed by a pod-level tpu-pruner.dev/skip annotation:
   // an annotated pod must protect its owner for EVERY kind, not only the
   // group kinds the all-idle gate covers — a sibling pod of the same
   // Deployment would otherwise scale the shared root to zero and delete
   // the annotated pod with it.
-  std::unordered_set<std::string> vetoed_roots;
-  // Namespaces vetoed for the cycle, with the first cause (for operator-
-  // facing skip logs): an annotated pod whose root could NOT be resolved,
-  // or a candidate pod whose GET failed (it could carry the annotation).
-  // A safety valve must fail closed: with the protected root unknown,
-  // every target in the namespace is dropped this cycle rather than risk
-  // pruning it; transient API errors self-heal next cycle.
-  std::unordered_map<std::string, std::string> vetoed_namespaces;
+  std::set<std::string> vetoed_roots;
+  // Namespaces vetoed for the cycle, with a deterministic cause (the
+  // lexicographically smallest, so the reported cause is independent of
+  // shard count and fold order): an annotated pod whose root could NOT be
+  // resolved, or a candidate pod whose GET failed (it could carry the
+  // annotation). A safety valve must fail closed: with the protected root
+  // unknown, every target in the namespace is dropped this cycle rather
+  // than risk pruning it; transient API errors self-heal next cycle.
+  std::map<std::string, std::string> vetoed_namespaces;
 };
+
+// Deterministic-merge helpers: the sharded engine's output order must be a
+// pure function of the candidate set, never of thread interleaving.
+void veto_namespace(std::map<std::string, std::string>& vetoes, const std::string& ns,
+                    const std::string& cause) {
+  auto it = vetoes.find(ns);
+  if (it == vetoes.end()) {
+    vetoes.emplace(ns, cause);
+  } else if (cause < it->second) {
+    it->second = cause;
+  }
+}
+
+bool record_before(const audit::DecisionRecord& a, const audit::DecisionRecord& b) {
+  return std::tie(a.ns, a.pod) < std::tie(b.ns, b.pod);
+}
 
 using util::fan_out;
 
@@ -170,11 +194,29 @@ extern "C" void on_shutdown_signal(int signum) {
   std::signal(signum, SIG_DFL);
 }
 
-// Concurrent pod-resolution fan-out (reference: buffer_unordered(10),
-// main.rs:447-532 — 1-3 K8s round-trips per sample). Above
-// --resolve-batch-threshold candidates per namespace, pod fetches collapse
-// into one namespace LIST and owner fetches into per-collection LISTs
-// (walker::prefetch_owner_chains), so a big reclaim cycle costs
+// Sharded pod resolution (replacing the single fan-out + one-mutex fold
+// of the serial engine; reference analog: buffer_unordered(10),
+// main.rs:447-532 — 1-3 K8s round-trips per sample). Three stages:
+//
+//   walk  — candidates are pre-partitioned across --shards workers by pod
+//           key; each shard acquires pods, gates eligibility and runs the
+//           owner walk with its OWN walker::FetchCache (read-through to
+//           the shared informer store), fanning out WITHIN the shard so
+//           total lookup concurrency stays --resolve-concurrency;
+//   fold  — walk results re-partition by RESOLVED-ROOT hash
+//           (shard::shard_of over the root identity), so every pod of one
+//           root folds on exactly one shard and all per-root state
+//           (ledger observations, target dedup, veto sets, the group
+//           gate's idle evidence) is single-writer per shard;
+//   merge — per-shard outputs merge in stable (ns, pod) / root-identity
+//           order, so DecisionRecords, capsules and /debug/decisions are
+//           byte-identical for every shard count (--shards 1 ≡ N; the
+//           old engine's fold order wasn't even stable run-to-run).
+//
+// Above --resolve-batch-threshold candidates per namespace, pod fetches
+// still collapse into one namespace LIST and owner fetches into
+// per-collection LISTs (walker::prefetch_owner_chains, issued ONCE and
+// seeded into every shard's cache), so a big reclaim cycle costs
 // O(namespaces × kinds) API calls instead of O(pods).
 ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
                             const std::vector<core::PodMetricSample>& samples,
@@ -182,11 +224,14 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
                             const informer::ClusterCache* watch_cache,
                             uint64_t cycle_id) {
   ResolveOutcome out;
-  std::mutex out_mutex;
-  walker::FetchCache owner_cache;  // memoize shared owner chains this cycle
+  const size_t nshards = shard::resolve_shard_count(args.shards);
+  shard::Pool& pool = shard::pool(nshards);
   int64_t lookback_secs = args.duration * 60 + args.grace_period;  // main.rs:413-414
   int64_t now = util::now_unix();
   size_t workers = static_cast<size_t>(args.resolve_concurrency);
+  // Each shard keeps its slice of the --resolve-concurrency lookup budget
+  // (--shards 1 reproduces the pre-shard engine's fan-out width exactly).
+  size_t shard_workers = std::max<size_t>(1, workers / nshards);
   // Flight recorder: the eligibility clock must be replayed verbatim — a
   // capsule re-decided with a different `now` would re-age every pod.
   recorder::record_resolve_now(cycle_id, now);
@@ -208,15 +253,6 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     r.trace_id = parent_ctx.trace_id;
     return r;
   };
-  auto decide = [&](audit::DecisionRecord rec, audit::Reason reason,
-                    const std::string& detail = "") {
-    rec.reason = reason;
-    rec.action = "none";
-    rec.detail = detail;
-    std::lock_guard<std::mutex> lock(out_mutex);
-    out.decided.push_back(std::move(rec));
-  };
-
   // Watch-backed store states, sampled ONCE per cycle: flipping mid-cycle
   // (a relist landing between phases) must not mix strategies — per-lookup
   // fallbacks still apply either way.
@@ -272,199 +308,361 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
               " namespace LIST(s) covering " + std::to_string(prefetched.size()) + " pods");
   }
 
-  // Phase 2 — per-pod acquisition (cache hit or GET) + eligibility gates.
+  // ── walk stage, part 1: per-shard pod acquisition + eligibility ──
   struct EligiblePod {
     const core::PodMetricSample* sample;
     const json::Value* pod;
     bool opted_out = false;  // walks to find its root, which is then vetoed
   };
-  std::vector<EligiblePod> eligible;
-  std::deque<json::Value> owned_pods;  // stable storage for GET results
-  fan_out(workers, samples.size(), [&](size_t i) {
-    const core::PodMetricSample& pmd = samples[i];
-    std::string key = pmd.ns + "/" + pmd.name;
-
-    const json::Value* pod = nullptr;
-    bool store_missed = false;  // synced store consulted but had no entry
-    {
-      auto it = prefetched.find(key);
-      if (it != prefetched.end()) pod = it->second;
-    }
-    if (!pod && watch_cache) {
-      // Watch-backed store hit (the steady-state path: zero API calls). A
-      // miss is NOT authoritative — fall through to the GET below, so a
-      // lagging watch can never hide a pod (and with it a possible
-      // tpu-pruner.dev/skip annotation) from the safety gates.
-      if (auto hit = watch_cache->get(k8s::Client::pod_path(pmd.ns, pmd.name))) {
-        std::lock_guard<std::mutex> lock(out_mutex);
-        owned_pods.push_back(std::move(*hit));
-        pod = &owned_pods.back();
-      } else {
-        store_missed = store_pods;
-      }
-    }
-    if (!pod) {
-      std::optional<json::Value> fetched;
-      try {
-        fetched = kube.get_opt(k8s::Client::pod_path(pmd.ns, pmd.name));
-      } catch (const std::exception& e) {
-        // Fail CLOSED, like the unresolvable-root case below: the unfetched
-        // pod could carry the skip annotation, and silently dropping it
-        // would let an idle un-annotated sibling scale their shared root
-        // away this very cycle. Veto the namespace; it self-heals next
-        // cycle once the API answers again.
-        log::error("daemon", "Skipping " + key + ", retrieval error (vetoing namespace " + pmd.ns +
-                   " this cycle): " + e.what());
-        recorder::record_pod(cycle_id, key, nullptr, false, e.what());
-        decide(base_record(pmd), audit::Reason::FetchError,
-               std::string("pod GET failed, namespace vetoed: ") + e.what());
-        std::lock_guard<std::mutex> lock(out_mutex);
-        out.vetoed_namespaces.emplace(pmd.ns, "fetch error for pod " + key);
-        return;
-      }
-      if (!fetched) {
-        log::info("daemon", "Skipping " + key + ", pod no longer exists");
-        recorder::record_pod(cycle_id, key, nullptr, store_missed, "");
-        decide(base_record(pmd),
-               store_missed ? audit::Reason::WatchCacheMiss : audit::Reason::PodGone,
-               store_missed ? "absent from the synced watch store and from the live GET"
-                            : "in the metric plane but not in the cluster");
-        return;
-      }
-      std::lock_guard<std::mutex> lock(out_mutex);
-      owned_pods.push_back(std::move(*fetched));
-      pod = &owned_pods.back();
-    }
-
-    recorder::record_pod(cycle_id, key, pod, false, "");
-    core::Eligibility elig = core::check_eligibility(*pod, now, lookback_secs);
-    switch (elig) {
-      case core::Eligibility::Pending:
-        log::info("daemon", "Skipping pod " + key + ", it's still pending");
-        decide(base_record(pmd), audit::Reason::PendingPod);
-        return;
-      case core::Eligibility::NoCreationTs:
-        log::warn("daemon", "Pod " + key + " has no creation timestamp, skipping");
-        decide(base_record(pmd), audit::Reason::NoCreationTimestamp);
-        return;
-      case core::Eligibility::BadTimestamp:
-        log::warn("daemon", "Pod " + key + " has unparseable creation timestamp, skipping");
-        decide(base_record(pmd), audit::Reason::BadCreationTimestamp);
-        return;
-      case core::Eligibility::TooYoung:
-        log::info("daemon", "Pod " + key + " created within lookback window, skipping");
-        decide(base_record(pmd), audit::Reason::BelowMinAge,
-               "created within the " + std::to_string(lookback_secs) + "s lookback window");
-        return;
-      case core::Eligibility::OptedOut: {
-        // Not a candidate — but its root must be vetoed for every kind, so
-        // it still walks (kept out of idle_pods: an opted-out worker also
-        // fails its group's all-idle gate).
-        log::info("daemon", "Pod " + key + " is annotated " + std::string(core::kSkipAnnotation) +
-                  "=true, vetoing its root object");
-        std::lock_guard<std::mutex> lock(out_mutex);
-        eligible.push_back({&pmd, pod, /*opted_out=*/true});
-        return;
-      }
-      case core::Eligibility::Eligible:
-        break;
-    }
-    log::info("daemon", "Pod " + key + " is idle and eligible for scaledown");
-    std::lock_guard<std::mutex> lock(out_mutex);
-    out.idle_pods.insert(std::move(key));
-    eligible.push_back({&pmd, pod});
-  });
-
-  // Phase 3 — batched owner prefetch, then the owner walk per eligible pod.
-  // A fully synced store makes the prefetch LISTs redundant: the walk's
-  // read-through cache hits the store per owner instead.
-  if (!store_owners && args.resolve_batch_threshold > 0 && !eligible.empty()) {
-    otlp::Span span("prefetch_owner_chains", &parent_ctx);
-    std::vector<const json::Value*> pods;
-    pods.reserve(eligible.size());
-    for (const EligiblePod& e : eligible) pods.push_back(e.pod);
-    size_t lists =
-        walker::prefetch_owner_chains(kube, owner_cache, pods,
-                                      args.resolve_batch_threshold, workers);
-    span.attr("collection_lists", static_cast<int64_t>(lists));
-    if (lists > 0) {
-      log::info("daemon", "Batched owner resolution: " + std::to_string(lists) + " collection LIST(s)");
-    }
-  }
-  fan_out(workers, eligible.size(), [&](size_t i) {
-    const EligiblePod& e = eligible[i];
-    std::string key = e.sample->ns + "/" + e.sample->name;
+  // Per-pod result slots, written by candidate index so each shard's
+  // output order is a pure function of the candidate order — never of
+  // fan-out interleaving (the determinism the merge stage relies on).
+  struct PodSlot {
+    std::optional<audit::DecisionRecord> decided;  // terminal at this stage
+    bool veto_ns = false;  // pod GET failed → fail-closed namespace veto
+    std::string veto_cause;
+    bool idle = false;                 // idle AND eligible
+    const json::Value* pod = nullptr;  // non-null → proceeds to the walk
+    bool opted_out = false;
+  };
+  // Per-pod owner-walk result (part 2), also slot-indexed.
+  struct WalkedPod {
+    const core::PodMetricSample* sample = nullptr;
+    bool opted_out = false;
     std::optional<ScaleTarget> target;
     std::vector<std::string> chain;
-    {
-      otlp::Span span("find_root_object", &parent_ctx);  // lib.rs:436 span
-      span.attr("pod", key);
-      try {
-        target = walker::find_root_object(kube, *e.pod, &owner_cache, watch_cache, &chain);
-      } catch (const std::exception& e2) {
-        span.set_error(e2.what());
-        recorder::record_resolution(cycle_id, key, chain, "", "", "", "", e2.what());
-        audit::DecisionRecord rec = base_record(*e.sample);
-        rec.owner_chain = chain;
-        if (e.opted_out) {
-          // Can't learn which root the annotation protects — fail closed
-          // on the whole namespace this cycle instead of failing open.
-          log::warn("daemon", "Annotated pod " + key + " has no resolvable root (" + e2.what() +
-                    "); vetoing namespace " + e.sample->ns + " this cycle");
-          decide(std::move(rec), audit::Reason::OptedOut,
-                 std::string("annotated pod with unresolvable root; namespace vetoed: ") +
-                     e2.what());
-          std::lock_guard<std::mutex> lock(out_mutex);
-          out.vetoed_namespaces.emplace(e.sample->ns,
-                                        "annotated pod " + key + " with unresolvable root");
+    std::string error;  // non-empty: the walk threw
+    int64_t chips = 0;  // pod chip count (ledger evidence)
+  };
+  struct ShardScratch {
+    std::vector<size_t> sample_idx;      // pre-partitioned candidate indices
+    walker::FetchCache cache;            // per-shard owner cache
+    std::deque<json::Value> owned_pods;  // stable storage for GET/store hits
+    std::mutex pods_mutex;               // guards owned_pods only
+    std::vector<PodSlot> slots;
+    std::vector<EligiblePod> eligible;   // compacted from slots, in order
+    std::vector<audit::DecisionRecord> decided;
+    walker::IdlePodSet idle_pods;
+    std::map<std::string, std::string> vetoed_namespaces;
+    std::vector<WalkedPod> walked;       // aligned with `eligible`
+    double secs = 0;  // this shard's resolve work (acquisition + walk)
+  };
+  std::vector<ShardScratch> shards(nshards);
+  for (size_t i = 0; i < samples.size(); ++i) {
+    size_t s = shard::shard_of(samples[i].ns + "/" + samples[i].name, nshards);
+    shards[s].sample_idx.push_back(i);
+  }
+
+  pool.run(nshards, [&](size_t s) {
+    ShardScratch& sh = shards[s];
+    auto shard_t0 = std::chrono::steady_clock::now();
+    sh.slots.resize(sh.sample_idx.size());
+    fan_out(shard_workers, sh.sample_idx.size(), [&](size_t j) {
+      const core::PodMetricSample& pmd = samples[sh.sample_idx[j]];
+      PodSlot& slot = sh.slots[j];
+      std::string key = pmd.ns + "/" + pmd.name;
+
+      const json::Value* pod = nullptr;
+      bool store_missed = false;  // synced store consulted but had no entry
+      {
+        auto it = prefetched.find(key);
+        if (it != prefetched.end()) pod = it->second;
+      }
+      if (!pod && watch_cache) {
+        // Watch-backed store hit (the steady-state path: zero API calls). A
+        // miss is NOT authoritative — fall through to the GET below, so a
+        // lagging watch can never hide a pod (and with it a possible
+        // tpu-pruner.dev/skip annotation) from the safety gates.
+        if (auto hit = watch_cache->get(k8s::Client::pod_path(pmd.ns, pmd.name))) {
+          std::lock_guard<std::mutex> lock(sh.pods_mutex);
+          sh.owned_pods.push_back(std::move(*hit));
+          pod = &sh.owned_pods.back();
         } else {
-          log::warn("daemon", "Skipping " + key + ", no scalable root object: " + e2.what());
-          decide(std::move(rec), audit::Reason::NoScalableOwner, e2.what());
+          store_missed = store_pods;
         }
       }
+      auto decide = [&](audit::Reason reason, const std::string& detail = "") {
+        audit::DecisionRecord rec = base_record(pmd);
+        rec.reason = reason;
+        rec.action = "none";
+        rec.detail = detail;
+        slot.decided = std::move(rec);
+      };
+      if (!pod) {
+        std::optional<json::Value> fetched;
+        try {
+          fetched = kube.get_opt(k8s::Client::pod_path(pmd.ns, pmd.name));
+        } catch (const std::exception& e) {
+          // Fail CLOSED, like the unresolvable-root case below: the unfetched
+          // pod could carry the skip annotation, and silently dropping it
+          // would let an idle un-annotated sibling scale their shared root
+          // away this very cycle. Veto the namespace; it self-heals next
+          // cycle once the API answers again.
+          log::error("daemon", "Skipping " + key + ", retrieval error (vetoing namespace " +
+                     pmd.ns + " this cycle): " + e.what());
+          recorder::record_pod(cycle_id, key, nullptr, false, e.what());
+          decide(audit::Reason::FetchError,
+                 std::string("pod GET failed, namespace vetoed: ") + e.what());
+          slot.veto_ns = true;
+          slot.veto_cause = "fetch error for pod " + key;
+          return;
+        }
+        if (!fetched) {
+          log::info("daemon", "Skipping " + key + ", pod no longer exists");
+          recorder::record_pod(cycle_id, key, nullptr, store_missed, "");
+          decide(store_missed ? audit::Reason::WatchCacheMiss : audit::Reason::PodGone,
+                 store_missed ? "absent from the synced watch store and from the live GET"
+                              : "in the metric plane but not in the cluster");
+          return;
+        }
+        std::lock_guard<std::mutex> lock(sh.pods_mutex);
+        sh.owned_pods.push_back(std::move(*fetched));
+        pod = &sh.owned_pods.back();
+      }
+
+      recorder::record_pod(cycle_id, key, pod, false, "");
+      core::Eligibility elig = core::check_eligibility(*pod, now, lookback_secs);
+      switch (elig) {
+        case core::Eligibility::Pending:
+          log::info("daemon", "Skipping pod " + key + ", it's still pending");
+          decide(audit::Reason::PendingPod);
+          return;
+        case core::Eligibility::NoCreationTs:
+          log::warn("daemon", "Pod " + key + " has no creation timestamp, skipping");
+          decide(audit::Reason::NoCreationTimestamp);
+          return;
+        case core::Eligibility::BadTimestamp:
+          log::warn("daemon", "Pod " + key + " has unparseable creation timestamp, skipping");
+          decide(audit::Reason::BadCreationTimestamp);
+          return;
+        case core::Eligibility::TooYoung:
+          log::info("daemon", "Pod " + key + " created within lookback window, skipping");
+          decide(audit::Reason::BelowMinAge,
+                 "created within the " + std::to_string(lookback_secs) + "s lookback window");
+          return;
+        case core::Eligibility::OptedOut:
+          // Not a candidate — but its root must be vetoed for every kind, so
+          // it still walks (kept out of idle_pods: an opted-out worker also
+          // fails its group's all-idle gate).
+          log::info("daemon", "Pod " + key + " is annotated " +
+                    std::string(core::kSkipAnnotation) + "=true, vetoing its root object");
+          slot.pod = pod;
+          slot.opted_out = true;
+          return;
+        case core::Eligibility::Eligible:
+          break;
+      }
+      log::info("daemon", "Pod " + key + " is idle and eligible for scaledown");
+      slot.idle = true;
+      slot.pod = pod;
+    });
+    // Serial per-shard compaction in candidate order (deterministic).
+    for (size_t j = 0; j < sh.slots.size(); ++j) {
+      PodSlot& slot = sh.slots[j];
+      const core::PodMetricSample& pmd = samples[sh.sample_idx[j]];
+      if (slot.decided) sh.decided.push_back(std::move(*slot.decided));
+      if (slot.veto_ns) veto_namespace(sh.vetoed_namespaces, pmd.ns, slot.veto_cause);
+      if (slot.idle) sh.idle_pods.insert(pmd.ns + "/" + pmd.name);
+      if (slot.pod) sh.eligible.push_back({&pmd, slot.pod, slot.opted_out});
     }
-    if (target) {
-      recorder::record_resolution(cycle_id, key, chain,
-                                  std::string(core::kind_name(target->kind)),
-                                  target->ns().value_or(""), target->name(),
-                                  target->identity(), "");
-      audit::DecisionRecord rec = base_record(*e.sample);
-      rec.owner_chain = std::move(chain);
-      rec.root_kind = core::kind_name(target->kind);
-      rec.root_ns = target->ns().value_or("");
-      rec.root_name = target->name();
-      std::lock_guard<std::mutex> lock(out_mutex);
-      if (e.opted_out) {
+    sh.secs += secs_since(shard_t0);
+  });
+
+  // Batched owner prefetch (shared): demand spans EVERY shard's eligible
+  // pods so each over-threshold collection is LISTed exactly once, then
+  // the results seed every shard's cache (seeding shares COW nodes — no
+  // copies, no extra API calls). A fully synced store makes the prefetch
+  // redundant: the walk's read-through cache hits the store per owner.
+  if (!store_owners && args.resolve_batch_threshold > 0) {
+    std::vector<const json::Value*> pods;
+    for (const ShardScratch& sh : shards) {
+      for (const EligiblePod& e : sh.eligible) pods.push_back(e.pod);
+    }
+    if (!pods.empty()) {
+      otlp::Span span("prefetch_owner_chains", &parent_ctx);
+      walker::FetchCache prefetch_cache;
+      size_t lists = walker::prefetch_owner_chains(kube, prefetch_cache, pods,
+                                                   args.resolve_batch_threshold, workers);
+      span.attr("collection_lists", static_cast<int64_t>(lists));
+      if (lists > 0) {
+        log::info("daemon",
+                  "Batched owner resolution: " + std::to_string(lists) + " collection LIST(s)");
+      }
+      for (auto& [path, entry] : prefetch_cache.snapshot()) {
+        for (ShardScratch& sh : shards) sh.cache.seed(path, entry);
+      }
+    }
+  }
+
+  // ── walk stage, part 2: the owner walk, per shard with its own cache ──
+  pool.run(nshards, [&](size_t s) {
+    ShardScratch& sh = shards[s];
+    auto shard_t0 = std::chrono::steady_clock::now();
+    sh.walked.resize(sh.eligible.size());
+    fan_out(shard_workers, sh.eligible.size(), [&](size_t j) {
+      const EligiblePod& e = sh.eligible[j];
+      std::string key = e.sample->ns + "/" + e.sample->name;
+      WalkedPod w;
+      w.sample = e.sample;
+      w.opted_out = e.opted_out;
+      {
+        otlp::Span span("find_root_object", &parent_ctx);  // lib.rs:436 span
+        span.attr("pod", key);
+        try {
+          w.target = walker::find_root_object(kube, *e.pod, &sh.cache, watch_cache, &w.chain);
+          w.chips = core::pod_chip_count(*e.pod, args.device);
+        } catch (const std::exception& e2) {
+          span.set_error(e2.what());
+          w.error = e2.what();
+        }
+      }
+      if (w.target) {
+        recorder::record_resolution(cycle_id, key, w.chain,
+                                    std::string(core::kind_name(w.target->kind)),
+                                    w.target->ns().value_or(""), w.target->name(),
+                                    w.target->identity(), "");
+      } else {
+        recorder::record_resolution(cycle_id, key, w.chain, "", "", "", "", w.error);
+      }
+      sh.walked[j] = std::move(w);  // distinct slot per index; no lock
+    });
+    sh.secs += secs_since(shard_t0);
+    // One per-shard observation per cycle (zero-candidate shards observe
+    // their ~0s too, so the _count advances shards×cycles in lockstep) —
+    // the histogram that shows whether the walk stage scales with
+    // --shards or one hot shard is the ceiling.
+    log::histogram_observe("cycle_phase_seconds", "resolve_shard", sh.secs,
+                           parent_ctx.trace_id);
+  });
+
+  // ── fold stage: re-partition by resolved-root hash ──
+  // Every pod of one root lands on one fold shard (shard::shard_of over
+  // the root identity), so per-root ledger accounts, target dedup and
+  // veto sets are single-writer per shard; rootless pods fold by pod key.
+  struct FoldScratch {
+    std::vector<WalkedPod*> items;
+    std::vector<audit::DecisionRecord> decided;
+    std::vector<std::pair<std::string, audit::DecisionRecord>> resolved_records;
+    std::vector<ScaleTarget> targets;
+    std::set<std::string> seen_roots;  // complete dedup: roots never span shards
+    std::map<std::string, ledger::Observation> ledger_obs;
+    std::set<std::string> vetoed_roots;
+    std::map<std::string, std::string> vetoed_namespaces;
+  };
+  auto merge_t0 = std::chrono::steady_clock::now();
+  std::vector<FoldScratch> folds(nshards);
+  for (ShardScratch& sh : shards) {
+    for (WalkedPod& w : sh.walked) {
+      const std::string key =
+          w.target ? w.target->identity() : w.sample->ns + "/" + w.sample->name;
+      folds[shard::shard_of(key, nshards)].items.push_back(&w);
+    }
+  }
+  pool.run(nshards, [&](size_t f) {
+    FoldScratch& fo = folds[f];
+    for (WalkedPod* wp : fo.items) {
+      WalkedPod& w = *wp;
+      std::string key = w.sample->ns + "/" + w.sample->name;
+      audit::DecisionRecord rec = base_record(*w.sample);
+      rec.owner_chain = w.chain;
+      if (!w.target) {
+        rec.action = "none";
+        if (w.opted_out) {
+          // Can't learn which root the annotation protects — fail closed
+          // on the whole namespace this cycle instead of failing open.
+          log::warn("daemon", "Annotated pod " + key + " has no resolvable root (" + w.error +
+                    "); vetoing namespace " + w.sample->ns + " this cycle");
+          rec.reason = audit::Reason::OptedOut;
+          rec.detail = std::string("annotated pod with unresolvable root; namespace vetoed: ") +
+                       w.error;
+          fo.decided.push_back(std::move(rec));
+          veto_namespace(fo.vetoed_namespaces, w.sample->ns,
+                         "annotated pod " + key + " with unresolvable root");
+        } else {
+          log::warn("daemon", "Skipping " + key + ", no scalable root object: " + w.error);
+          rec.reason = audit::Reason::NoScalableOwner;
+          rec.detail = w.error;
+          fo.decided.push_back(std::move(rec));
+        }
+        continue;
+      }
+      rec.root_kind = core::kind_name(w.target->kind);
+      rec.root_ns = w.target->ns().value_or("");
+      rec.root_name = w.target->name();
+      if (w.opted_out) {
         rec.reason = audit::Reason::OptedOut;
         rec.action = "none";
         rec.detail = "pod annotation vetoes its root for every kind this cycle";
-        out.decided.push_back(std::move(rec));
-        out.vetoed_roots.insert(target->identity());
+        fo.decided.push_back(std::move(rec));
+        fo.vetoed_roots.insert(w.target->identity());
       } else {
         // Ledger evidence: this root had an idle-observed pod this cycle;
-        // chips sum over the root's contributing pods.
+        // chips sum over the root's contributing pods — single-writer
+        // here because the root's pods all fold on this shard.
         ledger::Observation& obs =
-            out.ledger_obs[std::string(core::kind_name(target->kind)) + "/" +
-                           target->ns().value_or("") + "/" + target->name()];
+            fo.ledger_obs[std::string(core::kind_name(w.target->kind)) + "/" +
+                          w.target->ns().value_or("") + "/" + w.target->name()];
         if (obs.kind.empty()) {
-          obs.kind = core::kind_name(target->kind);
-          obs.ns = target->ns().value_or("");
-          obs.name = target->name();
+          obs.kind = core::kind_name(w.target->kind);
+          obs.ns = w.target->ns().value_or("");
+          obs.name = w.target->name();
         }
-        obs.chips += core::pod_chip_count(*e.pod, args.device);
+        obs.chips += w.chips;
         obs.pods += 1;  // contributing idle pods (right-size evidence)
-        out.resolved_records.emplace_back(target->identity(), std::move(rec));
-        out.targets.push_back(std::move(*target));
+        fo.resolved_records.emplace_back(w.target->identity(), std::move(rec));
+        if (fo.seen_roots.insert(w.target->identity()).second) {
+          fo.targets.push_back(std::move(*w.target));
+        }
       }
     }
   });
+
+  // ── merge stage: stable root/pod-ordered consolidation ──
+  for (FoldScratch& fo : folds) {
+    for (audit::DecisionRecord& r : fo.decided) out.decided.push_back(std::move(r));
+    for (auto& rr : fo.resolved_records) out.resolved_records.push_back(std::move(rr));
+    for (ScaleTarget& t : fo.targets) out.targets.push_back(std::move(t));
+    out.ledger_obs.insert(std::make_move_iterator(fo.ledger_obs.begin()),
+                          std::make_move_iterator(fo.ledger_obs.end()));
+    out.vetoed_roots.insert(fo.vetoed_roots.begin(), fo.vetoed_roots.end());
+    for (const auto& [ns, cause] : fo.vetoed_namespaces) {
+      veto_namespace(out.vetoed_namespaces, ns, cause);
+    }
+  }
+  for (ShardScratch& sh : shards) {
+    for (audit::DecisionRecord& r : sh.decided) out.decided.push_back(std::move(r));
+    out.idle_pods.insert(sh.idle_pods.begin(), sh.idle_pods.end());
+    for (const auto& [ns, cause] : sh.vetoed_namespaces) {
+      veto_namespace(out.vetoed_namespaces, ns, cause);
+    }
+  }
+  // One record per candidate pod per cycle → (ns, pod) is a unique sort
+  // key; targets sort by root identity. This ordering — not the shard
+  // count, not thread timing — is what the audit JSONL, capsules and
+  // /debug/decisions serve, so --shards 1 and --shards N are
+  // byte-identical (the pre-shard engine's fold order wasn't even stable
+  // run-to-run).
+  std::sort(out.decided.begin(), out.decided.end(), record_before);
+  std::sort(out.resolved_records.begin(), out.resolved_records.end(),
+            [](const auto& a, const auto& b) { return record_before(a.second, b.second); });
+  std::sort(out.targets.begin(), out.targets.end(),
+            [](const ScaleTarget& a, const ScaleTarget& b) { return a.identity() < b.identity(); });
+  // The consolidation cost the sharded engine ADDED — its own phase so
+  // operators can see when merge (not the walk) becomes the ceiling.
+  log::histogram_observe("cycle_phase_seconds", "merge", secs_since(merge_t0),
+                         parent_ctx.trace_id);
+
   // Flight recorder: snapshot every owner/root object the walk consulted
   // this cycle (single-flight cache contents, cached 404s included) so a
   // replay — including what-if paths the live cycle never walked — runs
-  // the real walk against the same cluster state, offline.
+  // the real walk against the same cluster state, offline. Shard caches
+  // may share keys (seeded prefetch entries); the capsule's object map is
+  // path-keyed, so duplicates collapse deterministically.
   if (recorder::enabled()) {
-    for (auto& [path, entry] : owner_cache.snapshot()) {
-      recorder::record_object(cycle_id, path, entry ? &*entry : nullptr);
+    for (ShardScratch& sh : shards) {
+      for (auto& [path, entry] : sh.cache.snapshot()) {
+        recorder::record_object(cycle_id, path, entry ? &*entry : nullptr);
+      }
     }
   }
   return out;
@@ -482,32 +680,44 @@ static auto with_span(otlp::Span& span, Fn&& fn) -> decltype(fn()) {
   }
 }
 
-}  // namespace
+// ── cycle pipeline: prepare (query+decode+signal) / finish (resolve→enqueue) ──
+// Split so --overlap can run cycle N+1's prepare on a helper thread while
+// cycle N finishes its resolve and its actuations drain (a bounded
+// two-cycle handoff, daemon::run); run_cycle() composes the two for the
+// serial parity path.
+struct Prepared {
+  uint64_t cycle_id = 0;
+  std::string trace_id;
+  std::unique_ptr<otlp::Span> span;  // cycle span; closes when Prepared dies
+  std::chrono::steady_clock::time_point cycle_start;
+  metrics::DecodeResult decoded;
+  signal::Assessment assessment;
+  bool signal_on = false;
+};
 
-CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
-                     core::ResourceSet enabled,
-                     const std::function<void(ScaleTarget, ScalePlan)>& enqueue,
-                     const informer::ClusterCache* watch_cache,
-                     const std::string& evidence_query) {
+Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
+                       const std::string& evidence_query) {
   // Audit cycle id first (stamps every log line of the cycle), then the
   // cycle span (reference #[tracing::instrument] on run_query_and_scale,
   // main.rs:390); children below mirror the instrumented callees.
-  const uint64_t cycle_id = audit::begin_cycle();
-  recorder::begin_cycle(cycle_id, util::now_unix());
-  otlp::Span cycle("run_query_and_scale");
-  cycle.attr("cycle", static_cast<int64_t>(cycle_id));
-  const std::string trace_id = cycle.context().trace_id;
-  // W3C trace propagation: every outbound Prometheus and K8s request of
-  // this cycle carries the cycle span's context, so server-side request
-  // logs join the OTLP trace end-to-end. Consumer actuations override
-  // per-thread with their own `scale` span context.
-  kube.set_traceparent(otlp::traceparent(cycle.context()));
-  const uint64_t api_calls_before = kube.api_calls();
-  const auto cycle_start = std::chrono::steady_clock::now();
+  Prepared p;
+  p.cycle_id = audit::begin_cycle();
+  // Under --overlap this runs on a helper thread while the producer is
+  // still finishing the PREVIOUS cycle — stamp this thread's log lines
+  // explicitly instead of trusting the process-global cycle counter.
+  log::set_thread_cycle(p.cycle_id);
+  recorder::begin_cycle(p.cycle_id, util::now_unix());
+  p.span = std::make_unique<otlp::Span>("run_query_and_scale");
+  otlp::Span& cycle = *p.span;
+  cycle.attr("cycle", static_cast<int64_t>(p.cycle_id));
+  p.trace_id = cycle.context().trace_id;
+  p.cycle_start = std::chrono::steady_clock::now();
+  const uint64_t cycle_id = p.cycle_id;
+  const std::string& trace_id = p.trace_id;
   auto observe_phase = [&](const char* phase, std::chrono::steady_clock::time_point since) {
     log::histogram_observe("cycle_phase_seconds", phase, secs_since(since), trace_id);
   };
-  return with_span(cycle, [&] {
+  with_span(cycle, [&] {
   auto phase_start = std::chrono::steady_clock::now();
   prom::Client prom_client = build_prom_client(args);
   prom_client.set_traceparent(otlp::traceparent(cycle.context()));
@@ -522,13 +732,12 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   observe_phase("query", phase_start);
 
   phase_start = std::chrono::steady_clock::now();
-  metrics::DecodeResult decoded =
-      metrics::decode_instant_vector(response, args.device, cli::resolved_schema(args));
-  for (const std::string& err : decoded.errors) {
+  p.decoded = metrics::decode_instant_vector(response, args.device, cli::resolved_schema(args));
+  for (const std::string& err : p.decoded.errors) {
     log::error("daemon", "Failed to unwrap pod fields: " + err);
   }
-  log::info("daemon", "Query returned " + std::to_string(decoded.num_series) + " series across " +
-            std::to_string(decoded.samples.size()) + " unique pods");
+  log::info("daemon", "Query returned " + std::to_string(p.decoded.num_series) +
+            " series across " + std::to_string(p.decoded.samples.size()) + " unique pods");
   observe_phase("decode", phase_start);
 
   // Signal-quality watchdog: assess the health of the evidence ITSELF
@@ -538,9 +747,8 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
   // every cycle — ~0s with the guard off — so every phase histogram's
   // _count keeps advancing in lockstep.
   phase_start = std::chrono::steady_clock::now();
-  signal::Assessment assessment;
-  const bool signal_on = args.signal_guard == "on" && !evidence_query.empty();
-  if (signal_on) {
+  p.signal_on = args.signal_guard == "on" && !evidence_query.empty();
+  if (p.signal_on) {
     const signal::Config scfg = signal_config(args);
     std::string evidence_raw;
     json::Value evidence_response = [&] {
@@ -551,14 +759,14 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
       });
     }();
     recorder::record_evidence_body(cycle_id, evidence_raw);
-    assessment = signal::assess(evidence_response, decoded.samples, scfg, cycle_id);
-    signal::publish(assessment, scfg);
-    recorder::record_signal(cycle_id, signal::assessment_to_json(assessment));
+    p.assessment = signal::assess(evidence_response, p.decoded.samples, scfg, cycle_id);
+    signal::publish(p.assessment, scfg);
+    recorder::record_signal(cycle_id, signal::assessment_to_json(p.assessment));
     log::info("daemon", "Signal assessment: " +
-              std::to_string(assessment.count(signal::Verdict::Healthy)) + " healthy / " +
-              std::to_string(assessment.pods.size()) + " candidates (coverage " +
-              std::to_string(assessment.coverage_ratio).substr(0, 5) +
-              (assessment.brownout ? ", BROWNOUT)" : ")"));
+              std::to_string(p.assessment.count(signal::Verdict::Healthy)) + " healthy / " +
+              std::to_string(p.assessment.pods.size()) + " candidates (coverage " +
+              std::to_string(p.assessment.coverage_ratio).substr(0, 5) +
+              (p.assessment.brownout ? ", BROWNOUT)" : ")"));
 
     // Per-pod vetoes: a candidate whose evidence is stale/gappy/absent is
     // removed from the pipeline HERE — before resolution — so it never
@@ -569,17 +777,17 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
         args.device == "gpu" ? "dcgm/gr_engine_active" : "tensorcore/duty_cycle";
     const int64_t lookback_secs = args.duration * 60 + args.grace_period;
     std::vector<core::PodMetricSample> trusted;
-    trusted.reserve(decoded.samples.size());
-    for (size_t i = 0; i < decoded.samples.size(); ++i) {
-      const core::PodMetricSample& s = decoded.samples[i];
-      const signal::PodSignal& p = assessment.pods[i];  // assess keeps candidate order
-      if (p.verdict == signal::Verdict::Healthy) {
+    trusted.reserve(p.decoded.samples.size());
+    for (size_t i = 0; i < p.decoded.samples.size(); ++i) {
+      const core::PodMetricSample& s = p.decoded.samples[i];
+      const signal::PodSignal& ps = p.assessment.pods[i];  // assess keeps candidate order
+      if (ps.verdict == signal::Verdict::Healthy) {
         trusted.push_back(s);
         continue;
       }
       log::warn("daemon", "Vetoing " + s.ns + "/" + s.name + ": evidence " +
-                std::string(signal::verdict_name(p.verdict)) + " (" +
-                signal::veto_detail(p, scfg) + ")");
+                std::string(signal::verdict_name(ps.verdict)) + " (" +
+                signal::veto_detail(ps, scfg) + ")");
       audit::DecisionRecord rec;
       rec.cycle = cycle_id;
       rec.ns = s.ns;
@@ -590,16 +798,44 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
       rec.accelerator = s.accelerator;
       rec.lookback_s = lookback_secs;
       rec.trace_id = trace_id;
-      rec.reason = signal::veto_reason(p.verdict);
+      rec.reason = signal::veto_reason(ps.verdict);
       rec.action = "none";
-      rec.detail = signal::veto_detail(p, scfg);
+      rec.detail = signal::veto_detail(ps, scfg);
       audit::record(std::move(rec));
     }
-    decoded.samples = std::move(trusted);
+    p.decoded.samples = std::move(trusted);
   }
   observe_phase("signal", phase_start);
+  });
+  log::set_thread_cycle(0);
+  return p;
+}
 
-  phase_start = std::chrono::steady_clock::now();
+CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kube,
+                        core::ResourceSet enabled,
+                        const std::function<void(ScaleTarget, ScalePlan, uint64_t)>& enqueue,
+                        const informer::ClusterCache* watch_cache) {
+  const uint64_t cycle_id = p.cycle_id;
+  const std::string trace_id = p.trace_id;
+  otlp::Span& cycle = *p.span;
+  // Producer-thread log lines of this cycle's back half stamp ITS id —
+  // under --overlap the global counter already points at the next cycle.
+  log::set_thread_cycle(cycle_id);
+  // W3C trace propagation: every outbound K8s request of this cycle
+  // carries the cycle span's context, so server-side request logs join
+  // the OTLP trace end-to-end. Consumer actuations override per-thread
+  // with their own `scale` span context.
+  kube.set_traceparent(otlp::traceparent(cycle.context()));
+  const uint64_t api_calls_before = kube.api_calls();
+  const auto cycle_start = p.cycle_start;
+  metrics::DecodeResult& decoded = p.decoded;
+  signal::Assessment& assessment = p.assessment;
+  const bool signal_on = p.signal_on;
+  auto observe_phase = [&](const char* phase, std::chrono::steady_clock::time_point since) {
+    log::histogram_observe("cycle_phase_seconds", phase, secs_since(since), trace_id);
+  };
+  return with_span(cycle, [&] {
+  auto phase_start = std::chrono::steady_clock::now();
   ResolveOutcome resolved =
       resolve_pods(args, kube, decoded.samples, cycle.context(), watch_cache, cycle_id);
   observe_phase("resolve", phase_start);
@@ -871,12 +1107,23 @@ CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::
       ScalePlan plan;
       if (auto it = rs_plans.find(t.identity()); it != rs_plans.end()) plan = it->second;
       log::info("daemon", "Sending " + desc + " for scaledown");
-      enqueue(std::move(t), std::move(plan));
+      enqueue(std::move(t), std::move(plan), cycle_id);
     }
   }
   observe_phase("total", cycle_start);
   return stats;
   });
+}
+
+}  // namespace
+
+CycleStats run_cycle(const cli::Cli& args, const std::string& query, const k8s::Client& kube,
+                     core::ResourceSet enabled,
+                     const std::function<void(ScaleTarget, ScalePlan, uint64_t)>& enqueue,
+                     const informer::ClusterCache* watch_cache,
+                     const std::string& evidence_query) {
+  return finish_cycle(args, prepare_cycle(args, query, evidence_query), kube, enabled, enqueue,
+                      watch_cache);
 }
 
 int run(const cli::Cli& args) {
@@ -903,6 +1150,15 @@ int run(const cli::Cli& args) {
       }
     }
     log::info("daemon", "Enabled resources: [" + kinds + "]");
+  }
+
+  // Sharded reconcile engine: warm the worker pool once (it lives for the
+  // whole process) and log the pipeline shape the daemon will run with.
+  {
+    const size_t nshards = shard::resolve_shard_count(args.shards);
+    shard::pool(nshards);
+    log::info("daemon", "Reconcile engine: " + std::to_string(nshards) + " shard(s)" +
+              (args.shards == 0 ? " (auto)" : "") + ", cycle overlap " + args.overlap);
   }
 
   // Query built once, reused every cycle (main.rs:280-282).
@@ -1243,6 +1499,26 @@ int run(const cli::Cli& args) {
   for (int64_t i = 0; i < args.scale_concurrency; ++i) consumers.emplace_back(consume_fn);
 
   // Producer loop (reference query_task, main.rs:286-330).
+  //
+  // --overlap on: a bounded two-cycle handoff. While cycle N's back half
+  // runs on this thread (resolve → gates → enqueue) and its actuations
+  // drain on the consumers, cycle N+1's query+decode+signal phases
+  // already run on one helper thread. Depth is exactly one prepared
+  // cycle — the handoff's backpressure — and every per-cycle cap
+  // (breaker, brownout, --max-scale-per-cycle) still applies inside
+  // finish_cycle to its own cycle. Intended for saturated back-to-back
+  // operation (--check-interval 0 / cycle-bound fleets): with a long
+  // interval the prefetched evidence is up to one interval old by the
+  // time its cycle finishes.
+  const bool overlap_on = args.overlap == "on" && args.daemon_mode;
+  std::future<Prepared> prepared_next;
+  auto drop_prepared = [&] {
+    if (!prepared_next.valid()) return;
+    try {
+      prepared_next.get();  // bounded: one prom round-trip; cycle never runs
+    } catch (...) {
+    }
+  };
   int consecutive_failures = 0;
   bool budget_exhausted = false;
   bool last_cycle_failed = false;
@@ -1252,6 +1528,9 @@ int run(const cli::Cli& args) {
     if (g_shutdown_signal) break;
     auto cycle_start = std::chrono::steady_clock::now();
     if (elector && !elector->is_leader()) {
+      // A cycle prepared before losing the lease is stale by the whole
+      // standby stretch — drop it rather than actuate from old evidence.
+      drop_prepared();
       // Standby: no cycles, no failure-budget ticks. The 1 s re-check is
       // deliberately NOT scaled to the lease duration: is_leader() is an
       // atomic read (zero API traffic — the elector's own thread does the
@@ -1306,10 +1585,25 @@ int run(const cli::Cli& args) {
     }
     last_cycle_failed = false;
     try {
-      CycleStats stats = run_cycle(args, query, kube, enabled,
-                                   [&](ScaleTarget t, ScalePlan plan) {
-        queue.push({std::move(t), audit::current_cycle(), std::move(plan)});
-      }, watch_cache.get(), evidence_query);
+      // Queue items carry their PRODUCING cycle explicitly: under
+      // --overlap the global cycle counter already points at the next
+      // prepared cycle while this one's targets enqueue.
+      auto enqueue = [&](ScaleTarget t, ScalePlan plan, uint64_t cycle) {
+        queue.push({std::move(t), cycle, std::move(plan)});
+      };
+      CycleStats stats;
+      if (overlap_on) {
+        Prepared prep = prepared_next.valid()
+                            ? prepared_next.get()
+                            : prepare_cycle(args, query, evidence_query);
+        prepared_next = std::async(std::launch::async, [&args, &query, &evidence_query] {
+          return prepare_cycle(args, query, evidence_query);
+        });
+        stats = finish_cycle(args, std::move(prep), kube, enabled, enqueue, watch_cache.get());
+      } else {
+        stats = run_cycle(args, query, kube, enabled, enqueue, watch_cache.get(),
+                          evidence_query);
+      }
       consecutive_failures = 0;
       log::counter_add("query_successes", 1);
       log::counter_set("query_returned_candidates", stats.num_pods);
@@ -1353,6 +1647,9 @@ int run(const cli::Cli& args) {
               (g_shutdown_signal == SIGINT ? "SIGINT" : "SIGTERM") +
               ", shutting down gracefully");
   }
+  // Drain the in-flight prepare (its cycle never runs) so the helper
+  // thread's span and open capsule close out before the queue drains.
+  drop_prepared();
   queue.close();
   for (std::thread& c : consumers) c.join();
   // Targets enqueued but never consumed (close() dropped them) leave

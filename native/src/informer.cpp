@@ -76,6 +76,12 @@ void Store::erase(const std::string& object_path) {
 
 // ── Reflector ──
 
+// Page size for the initial/relist LIST (limit/continue). 500 is the
+// client-go pager default: big enough that a 4k-pod cluster still lists
+// in a handful of round-trips, small enough that a 100k-pod LIST never
+// materializes as one response on either end.
+constexpr int64_t kListPageLimit = 500;
+
 Reflector::Reflector(const k8s::Client& kube, ResourceSpec spec)
     : kube_(kube), spec_(std::move(spec)) {}
 
@@ -115,6 +121,25 @@ std::string Reflector::object_path_of(const Value& object) const {
          name->as_string();
 }
 
+std::string Reflector::resource_version() const {
+  std::lock_guard<std::mutex> lock(stats_mutex_);
+  return resource_version_;
+}
+
+bool Reflector::request_relist(const std::string& why) {
+  if (relist_pending_.exchange(true)) {
+    // A relist is already in flight — coalesce, never stack: two LISTs
+    // for one gap would double the apiserver cost of every compaction
+    // and re-unsync the store right after it recovered.
+    log::debug("informer", "watch " + spec_.list_path + " relist request (" + why +
+               ") coalesced into the in-flight relist");
+    return false;
+  }
+  std::lock_guard<std::mutex> lock(stats_mutex_);
+  ++stats_.relist_requests;
+  return true;
+}
+
 void Reflector::apply_list(const Value& list) {
   std::map<std::string, Value> snapshot;
   if (const Value* items = list.find("items"); items && items->is_array()) {
@@ -128,12 +153,15 @@ void Reflector::apply_list(const Value& list) {
     rv = v->as_string();
   }
   store_.replace(std::move(snapshot));
-  resource_version_ = rv;
   {
     std::lock_guard<std::mutex> lock(stats_mutex_);
+    resource_version_ = rv;
     ++stats_.relists;  // counts the initial LIST too: relists == LISTs issued
     stats_.resource_version = rv;
   }
+  // The fresh snapshot services any pending relist request — a 410 that
+  // arrived while this LIST was in flight is satisfied by it, not queued.
+  relist_pending_.store(false);
   synced_.store(true);
   last_activity_mono_.store(util::mono_secs());
   log::counter_add("informer_relists", 1);
@@ -146,13 +174,17 @@ bool Reflector::apply_event(const Value& event) {
   if (type == "ERROR") {
     // The in-band relist signal: {"type":"ERROR","object":<Status>}, most
     // commonly code 410 after apiserver compaction. Any ERROR means the
-    // stream can no longer be trusted — relist regardless of code.
+    // stream can no longer be trusted — relist regardless of code. A 410
+    // arriving while a relist LIST is already in flight coalesces into it
+    // (request_relist) instead of queueing a second relist.
     int64_t code = 0;
     if (object) {
       if (const Value* c = object->find("code"); c && c->is_number()) code = c->as_int();
     }
-    log::warn("informer", "watch " + spec_.list_path + " ERROR event (code " +
-              std::to_string(code) + "); relisting");
+    if (request_relist("ERROR event code " + std::to_string(code))) {
+      log::warn("informer", "watch " + spec_.list_path + " ERROR event (code " +
+                std::to_string(code) + "); relisting");
+    }
     return false;
   }
 
@@ -191,7 +223,10 @@ bool Reflector::apply_event(const Value& event) {
     log::debug("informer", "ignoring unknown watch event type: " + type);
     return true;
   }
-  if (!rv.empty()) resource_version_ = rv;
+  if (!rv.empty()) {
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    resource_version_ = rv;
+  }
   last_activity_mono_.store(util::mono_secs());
   return true;
 }
@@ -219,7 +254,11 @@ void Reflector::run() {
   while (!stop_.load()) {
     Value list;
     try {
-      list = kube_.list(spec_.list_path, "");
+      // Paginated initial LIST (limit/continue): a 100k-pod cluster
+      // arrives in kListPageLimit-object chunks instead of one giant
+      // response the apiserver (or this process) has to materialize at
+      // once — the same chunking client-go's pager applies.
+      list = kube_.list(spec_.list_path, "", kListPageLimit);
     } catch (const std::exception& e) {
       synced_.store(false);
       log::warn("informer", "LIST " + spec_.list_path + " failed: " + std::string(e.what()));
@@ -229,13 +268,13 @@ void Reflector::run() {
     list_failures = 0;
     apply_list(list);
     log::debug("informer", "synced " + spec_.list_path + " (" +
-               std::to_string(store_.size()) + " objects at rv " + resource_version_ + ")");
+               std::to_string(store_.size()) + " objects at rv " + resource_version() + ")");
 
     int watch_failures = 0;
     bool relist = false;
     while (!stop_.load() && !relist) {
       k8s::Client::WatchOptions wopts;
-      wopts.resource_version = resource_version_;
+      wopts.resource_version = resource_version();
       wopts.abort = [this] { return stop_.load(); };
       try {
         kube_.watch(spec_.list_path, wopts, [&](const Value& ev) {
@@ -249,8 +288,11 @@ void Reflector::run() {
         // Clean server close: routine — re-watch from the last seen rv.
       } catch (const k8s::ApiError& e) {
         if (e.status == 410) {
-          log::info("informer", "watch " + spec_.list_path +
-                    " got 410 Gone (compacted past rv " + resource_version_ + "); relisting");
+          if (request_relist("watch HTTP 410")) {
+            log::info("informer", "watch " + spec_.list_path +
+                      " got 410 Gone (compacted past rv " + resource_version() +
+                      "); relisting");
+          }
           relist = true;
         } else {
           ++watch_failures;
@@ -262,9 +304,10 @@ void Reflector::run() {
         bump_watch_failure(e.what());
         backoff_sleep(spec_.list_path, watch_failures, stop_);
       }
-      if (watch_failures >= 3) {
+      if (watch_failures >= 3 && !relist) {
         // The watch cannot hold; events may have been missed while flapping.
         // Treat like a 410: stop serving, then rebuild from a fresh LIST.
+        request_relist("watch failure streak");
         relist = true;
       }
     }
@@ -384,6 +427,7 @@ Value ClusterCache::stats_json() const {
     rs.set("deletes", Value(static_cast<int64_t>(s.deletes)));
     rs.set("bookmarks", Value(static_cast<int64_t>(s.bookmarks)));
     rs.set("relists", Value(static_cast<int64_t>(s.relists)));
+    rs.set("relist_requests", Value(static_cast<int64_t>(s.relist_requests)));
     rs.set("watch_failures", Value(static_cast<int64_t>(s.watch_failures)));
     rs.set("resource_version", Value(s.resource_version));
     resources.set(r->spec().list_path, std::move(rs));

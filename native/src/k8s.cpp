@@ -161,14 +161,19 @@ json::Value Client::get(const std::string& path) const {
   return request_json("GET", path, "", "", nullptr);
 }
 
-json::Value Client::list(const std::string& path, const std::string& label_selector) const {
+json::Value Client::list(const std::string& path, const std::string& label_selector,
+                         int64_t limit) const {
   // Follow metadata.continue. Stock apiservers only paginate when the
-  // client sends `limit` (we never do), but an intermediary cache or
-  // aggregated apiserver may chunk anyway — ignoring the token would
-  // silently truncate batched resolution (e.g. a JobSet's all-idle gate
-  // deciding on half its worker pods).
+  // client sends `limit` (resolution LISTs don't; the informer does), but
+  // an intermediary cache or aggregated apiserver may chunk anyway —
+  // ignoring the token would silently truncate batched resolution (e.g. a
+  // JobSet's all-idle gate deciding on half its worker pods).
   std::string base_query;
   if (!label_selector.empty()) base_query = "labelSelector=" + util::url_encode(label_selector);
+  if (limit > 0) {
+    if (!base_query.empty()) base_query += "&";
+    base_query += "limit=" + std::to_string(limit);
+  }
 
   json::Value out;
   std::string continue_token;

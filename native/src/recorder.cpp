@@ -259,8 +259,11 @@ void begin_cycle(uint64_t cycle, int64_t ts_unix) {
   if (!r.enabled) return;
   // A cycle that failed before arm() (query error) left its capsule open
   // with no drain to seal it — drop such strays rather than leak them.
+  // Keep the IMMEDIATELY preceding cycle: under --overlap, cycle N+1's
+  // prepare opens its capsule while cycle N is still mid-resolve (not yet
+  // armed), and dropping it would lose a healthy cycle's flight data.
   for (auto it = r.open.begin(); it != r.open.end();) {
-    it = (it->first < cycle && !it->second.armed) ? r.open.erase(it) : std::next(it);
+    it = (it->first + 1 < cycle && !it->second.armed) ? r.open.erase(it) : std::next(it);
   }
   OpenCapsule c;
   c.ts_unix = ts_unix;

@@ -1,0 +1,102 @@
+#include "tpupruner/shard.hpp"
+
+#include <algorithm>
+#include <memory>
+#include <mutex>
+
+namespace tpupruner::shard {
+
+uint64_t stable_hash(std::string_view key) {
+  // FNV-1a 64-bit (public-domain constants). Stable across platforms by
+  // construction — byte-wise, no word-size or endianness dependence.
+  uint64_t h = 14695981039346656037ULL;
+  for (unsigned char c : key) {
+    h ^= c;
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+size_t shard_of(std::string_view key, size_t num_shards) {
+  if (num_shards <= 1) return 0;
+  return static_cast<size_t>(stable_hash(key) % num_shards);
+}
+
+size_t resolve_shard_count(int64_t flag) {
+  if (flag >= 1) {
+    return std::min<size_t>(static_cast<size_t>(flag), kMaxShards);
+  }
+  size_t hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 1;  // hardware_concurrency may legally answer "unknown"
+  return std::clamp<size_t>(hw, 1, kAutoMaxShards);
+}
+
+Pool::Pool(size_t workers) {
+  workers = std::max<size_t>(workers, 1);
+  threads_.reserve(workers);
+  for (size_t i = 0; i < workers; ++i) {
+    threads_.emplace_back(&Pool::worker_loop, this);
+  }
+}
+
+Pool::~Pool() {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    stop_ = true;
+  }
+  work_cv_.notify_all();
+  for (std::thread& t : threads_) t.join();
+}
+
+void Pool::run(size_t n_tasks, const std::function<void(size_t)>& fn) {
+  if (n_tasks == 0) return;
+  std::unique_lock<std::mutex> lock(mutex_);
+  ++generation_;
+  n_tasks_ = n_tasks;
+  next_ = 0;
+  active_ = 0;
+  fn_ = &fn;
+  first_error_ = nullptr;
+  work_cv_.notify_all();
+  done_cv_.wait(lock, [&] { return next_ >= n_tasks_ && active_ == 0; });
+  fn_ = nullptr;
+  if (first_error_) std::rethrow_exception(first_error_);
+}
+
+void Pool::worker_loop() {
+  uint64_t seen_generation = 0;
+  std::unique_lock<std::mutex> lock(mutex_);
+  while (true) {
+    work_cv_.wait(lock, [&] { return stop_ || generation_ != seen_generation; });
+    if (stop_) return;
+    seen_generation = generation_;
+    while (next_ < n_tasks_) {
+      size_t i = next_++;
+      ++active_;
+      lock.unlock();
+      try {
+        (*fn_)(i);
+      } catch (...) {
+        lock.lock();
+        if (!first_error_) first_error_ = std::current_exception();
+        --active_;
+        continue;
+      }
+      lock.lock();
+      --active_;
+    }
+    if (active_ == 0) done_cv_.notify_all();
+  }
+}
+
+Pool& pool(size_t workers) {
+  static std::mutex m;
+  static std::unique_ptr<Pool> p;
+  std::lock_guard<std::mutex> lock(m);
+  if (!p || p->size() != std::max<size_t>(workers, 1)) {
+    p = std::make_unique<Pool>(workers);
+  }
+  return *p;
+}
+
+}  // namespace tpupruner::shard

@@ -175,3 +175,65 @@ TP_TEST(informer_store_concurrent_readers_and_writer) {
   writer.join();
   for (auto& t : readers) t.join();
 }
+
+TP_TEST(informer_relist_requests_coalesce) {
+  // A 410/ERROR landing while a relist is already pending must not queue
+  // a second relist: one LIST services every request that accumulated
+  // while it was in flight.
+  Reflector r(offline_client(), *spec_for("pods"));
+  Value gone = Value::parse(
+      R"({"type":"ERROR","object":{"kind":"Status","code":410,"message":"too old"}})");
+  TP_CHECK(!r.apply_event(gone));
+  TP_CHECK(r.relist_pending());
+  TP_CHECK_EQ(r.stats().relist_requests, uint64_t{1});
+  // second 410 before the relist lands: coalesced, still one request
+  TP_CHECK(!r.apply_event(gone));
+  TP_CHECK_EQ(r.stats().relist_requests, uint64_t{1});
+  // the relist LIST services the request
+  r.apply_list(Value::parse(
+      R"({"kind":"List","metadata":{"resourceVersion":"12"},"items":[]})"));
+  TP_CHECK(!r.relist_pending());
+  TP_CHECK_EQ(r.stats().relists, uint64_t{1});
+  // a NEW 410 after recovery opens a fresh request
+  TP_CHECK(!r.apply_event(gone));
+  TP_CHECK_EQ(r.stats().relist_requests, uint64_t{2});
+}
+
+TP_TEST(informer_concurrent_410_and_relist_is_race_free) {
+  // The satellite contract (ISSUE 8): a watch 410 arriving while a LIST
+  // is in flight must neither race (TSan-clean: resource_version_ and the
+  // stats block are shared between the two paths) nor double-relist.
+  // One thread replays relist LISTs, another storms 410 ERROR events and
+  // watch frames; afterwards the counters must show every LIST applied
+  // and coalesced (not stacked) relist requests.
+  Reflector r(offline_client(), *spec_for("pods"));
+  constexpr int kLists = 200;
+  constexpr int kEvents = 500;
+  std::thread lister([&] {
+    for (int i = 0; i < kLists; ++i) {
+      r.apply_list(Value::parse(
+          R"({"kind":"List","metadata":{"resourceVersion":")" + std::to_string(1000 + i) +
+          R"("},"items":[{"metadata":{"namespace":"ml","name":"p0","resourceVersion":")" +
+          std::to_string(1000 + i) + R"("}}]})"));
+    }
+  });
+  std::atomic<bool> error_event_kept_stream{false};  // must stay false
+  std::thread eventer([&] {
+    Value gone = Value::parse(
+        R"({"type":"ERROR","object":{"kind":"Status","code":410,"message":"too old"}})");
+    for (int i = 0; i < kEvents; ++i) {
+      if (r.apply_event(gone)) error_event_kept_stream.store(true);
+      r.apply_event(pod_event("MODIFIED", "ml", "p0", std::to_string(2000 + i).c_str()));
+    }
+  });
+  lister.join();
+  eventer.join();
+  TP_CHECK(!error_event_kept_stream.load());
+  auto stats = r.stats();
+  TP_CHECK_EQ(stats.relists, uint64_t{kLists});
+  // Coalescing bound: between two applied LISTs at most ONE request can
+  // open (the exchange gate), so requests can never exceed LISTs + 1.
+  TP_CHECK(stats.relist_requests <= uint64_t{kLists + 1});
+  TP_CHECK(stats.relist_requests >= 1);
+  TP_CHECK(r.synced());
+}

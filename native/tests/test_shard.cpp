@@ -1,0 +1,111 @@
+// Sharded reconcile-engine primitives (native/include/tpupruner/shard.hpp).
+// These pin the determinism contract the daemon's merge stage relies on:
+// placement is a pure function of (key, shard count) — stable across
+// runs, builds and platforms — and the worker pool runs every task
+// exactly once, reusing its threads across calls.
+#include "testing.hpp"
+
+#include <atomic>
+#include <set>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpupruner/shard.hpp"
+
+namespace shard = tpupruner::shard;
+
+TP_TEST(shard_stable_hash_pinned_values) {
+  // FNV-1a 64 test vectors: a drifting hash would silently re-place every
+  // root and break cross-build capsule byte-identity, so the exact values
+  // are pinned (the empty string is the FNV offset basis).
+  TP_CHECK_EQ(shard::stable_hash(""), 14695981039346656037ULL);
+  TP_CHECK_EQ(shard::stable_hash("a"), 12638187200555641996ULL);
+  TP_CHECK_EQ(shard::stable_hash("Deployment/ml-0/dep-0"),
+              shard::stable_hash("Deployment/ml-0/dep-0"));
+  TP_CHECK(shard::stable_hash("Deployment/ml-0/dep-0") !=
+           shard::stable_hash("Deployment/ml-0/dep-1"));
+}
+
+TP_TEST(shard_of_same_key_same_shard) {
+  for (int i = 0; i < 100; ++i) {
+    std::string key = "JobSet/tpu-jobs/slice-" + std::to_string(i);
+    size_t first = shard::shard_of(key, 8);
+    TP_CHECK(first < 8);
+    for (int repeat = 0; repeat < 3; ++repeat) {
+      TP_CHECK_EQ(shard::shard_of(key, 8), first);
+    }
+  }
+}
+
+TP_TEST(shard_of_degenerate_counts) {
+  TP_CHECK_EQ(shard::shard_of("anything", 0), size_t{0});
+  TP_CHECK_EQ(shard::shard_of("anything", 1), size_t{0});
+}
+
+TP_TEST(shard_of_spreads_roots) {
+  // Not a distribution-quality proof — just a guard against a
+  // constant-output regression (everything hashing to shard 0 would
+  // silently serialize the engine).
+  std::set<size_t> seen;
+  for (int i = 0; i < 64; ++i) {
+    seen.insert(shard::shard_of("Deployment/ml/dep-" + std::to_string(i), 8));
+  }
+  TP_CHECK(seen.size() >= 4);
+}
+
+TP_TEST(shard_resolve_count_clamps) {
+  TP_CHECK_EQ(shard::resolve_shard_count(1), size_t{1});
+  TP_CHECK_EQ(shard::resolve_shard_count(8), size_t{8});
+  TP_CHECK_EQ(shard::resolve_shard_count(100000), shard::kMaxShards);
+  size_t auto_count = shard::resolve_shard_count(0);
+  TP_CHECK(auto_count >= 1);
+  TP_CHECK(auto_count <= shard::kAutoMaxShards);
+}
+
+TP_TEST(shard_pool_runs_every_task_once) {
+  shard::Pool pool(4);
+  std::vector<std::atomic<int>> hits(100);
+  for (auto& h : hits) h.store(0);
+  pool.run(hits.size(), [&](size_t i) { hits[i].fetch_add(1); });
+  for (auto& h : hits) TP_CHECK_EQ(h.load(), 1);
+  // Reuse across calls: the same pool must serve a second, larger batch.
+  std::atomic<int> total{0};
+  pool.run(257, [&](size_t) { total.fetch_add(1); });
+  TP_CHECK_EQ(total.load(), 257);
+  pool.run(0, [&](size_t) { total.fetch_add(1); });  // no-op, must not hang
+  TP_CHECK_EQ(total.load(), 257);
+}
+
+TP_TEST(shard_pool_rethrows_first_error) {
+  shard::Pool pool(3);
+  std::atomic<int> ran{0};
+  bool threw = false;
+  try {
+    pool.run(16, [&](size_t i) {
+      ran.fetch_add(1);
+      if (i == 5) throw std::runtime_error("boom");
+    });
+  } catch (const std::runtime_error& e) {
+    threw = std::string(e.what()) == "boom";
+  }
+  TP_CHECK(threw);
+  // Every index was still handed out (a throwing task must not wedge the
+  // remaining indices or the next run).
+  std::atomic<int> again{0};
+  pool.run(8, [&](size_t) { again.fetch_add(1); });
+  TP_CHECK_EQ(again.load(), 8);
+}
+
+TP_TEST(shard_pool_concurrent_callers_from_global) {
+  // The process-wide pool accessor returns a working pool and resizes on
+  // a different requested width.
+  shard::Pool& p4 = shard::pool(4);
+  TP_CHECK_EQ(p4.size(), size_t{4});
+  std::atomic<int> n{0};
+  p4.run(32, [&](size_t) { n.fetch_add(1); });
+  TP_CHECK_EQ(n.load(), 32);
+  shard::Pool& p2 = shard::pool(2);
+  TP_CHECK_EQ(p2.size(), size_t{2});
+}

@@ -88,6 +88,48 @@ def test_gym_smoke_recipe_present_and_wired():
     assert callable(module.main)
 
 
+def test_bench_mega_recipe_present_and_wired():
+    """`just bench-mega` must exist and invoke the real mega tier — the
+    scale contract (shard speedup, bit-for-bit replay under N shards,
+    O(churn) steady state, the 100 ms warm-p50 bar) would otherwise go
+    unguarded in CI. The 10,240-pod override keeps the smoke in CI
+    minutes; the assertions inside run_mega_tier are the same ones the
+    full 50k-pod tier enforces."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^bench-mega\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `bench-mega:` recipe"
+    body = m.group(1)
+    assert "bench.py --mega-only" in body, (
+        "bench-mega no longer invokes bench.py --mega-only")
+    assert "TP_MEGA_PODS=10240" in body, (
+        "bench-mega lost its 10,240-pod smoke override — the recipe would "
+        "run the full 50k-pod tier in CI")
+    bench = (REPO / "bench.py").read_text()
+    assert "--mega-only" in bench and "run_mega_tier" in bench, (
+        "bench.py no longer implements the --mega-only mega tier")
+
+
+def test_tsan_shard_recipe_present_and_wired():
+    """`just tsan-shard` must exist and run the shard + informer native
+    tests under ThreadSanitizer — the sharded resolve fan-out and the
+    concurrent 410+relist coalescing are exactly the code whose races
+    TSan catches and plain asserts don't."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^tsan-shard\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `tsan-shard:` recipe"
+    body = m.group(1)
+    assert "-DTP_TSAN=ON" in body, "tsan-shard no longer builds with TSan"
+    assert re.search(r"tpupruner_tests\s+shard", body), (
+        "tsan-shard no longer runs the native shard tests")
+    assert re.search(r"tpupruner_tests\s+informer", body), (
+        "tsan-shard no longer runs the native informer tests")
+    assert (REPO / "native" / "tests" / "test_shard.cpp").exists(), (
+        "native/tests/test_shard.cpp vanished — the filter would match "
+        "nothing and the recipe would vacuously pass")
+
+
 def test_just_verify_matches_roadmap_tier1():
     roadmap = roadmap_tier1_command()
     justfile = justfile_verify_command()

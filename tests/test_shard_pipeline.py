@@ -1,0 +1,335 @@
+"""Sharded reconcile pipeline tests (the ISSUE 8 perf tentpole).
+
+The engine partitions each cycle's candidates across N worker shards,
+walks owner chains shard-parallel, folds results keyed by RESOLVED-ROOT
+hash (every pod of one root on one shard — per-root state is
+single-writer) and merges in stable order. The contract pinned here:
+
+  - shard placement is a pure, portable function of the root identity
+    (FNV-1a — verified against an independent Python implementation);
+  - ``--shards 1`` and ``--shards 8`` produce byte-identical audit JSONL
+    and flight capsules on the same cluster (volatile clock/trace fields
+    normalized — they differ between any two runs, sharded or not);
+  - scale-down under N shards patches exactly the reclaimable set, and
+    its capsules replay bit-for-bit offline (``analyze --replay``);
+  - ``--overlap on`` (cycle N+1's query/decode/signal prepared while
+    cycle N finishes) changes pipelining, never decisions;
+  - the informer's initial LIST paginates (``limit``/``continue``), and
+    the fake apiserver's continue tokens are opaque and expire with 410.
+"""
+
+import json
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def run_daemon(fake_prom, fake_k8s, *extra, run_mode="scale-down", cycles=None):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", run_mode, *extra]
+    if cycles is not None:
+        cmd += ["--daemon-mode", "--check-interval", "1",
+                "--max-cycles", str(cycles)]
+    proc = subprocess.run(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc
+
+
+# ── shard placement: pure, portable, root-keyed ────────────────────────
+
+
+def _fnv1a64(key: str) -> int:
+    """Independent FNV-1a 64 reference — the native hash must match it
+    (a drifting hash would re-place every root across builds and break
+    capsule byte-identity)."""
+    h = 0xCBF29CE484222325
+    for b in key.encode():
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def test_shard_of_matches_independent_fnv1a(built):
+    keys = ["", "a", "Deployment/ml-0/dep-0",
+            "JobSet/tpu-jobs/slice-17", "LeaderWorkerSet/serve/lws-3",
+            "Notebook/research/nb-üñïçødé"]
+    for key in keys:
+        out = native.shard_of(key, 8)
+        expected = _fnv1a64(key)
+        # the C API returns the hash as a signed 64-bit value
+        assert out["hash"] & 0xFFFFFFFFFFFFFFFF == expected, key
+        assert out["shard"] == expected % 8, key
+
+
+def test_same_root_always_lands_on_same_shard(built):
+    """Property over many synthetic roots: every pod of a root shards by
+    the ROOT identity, so placement is identical for all of them, stable
+    across repeated calls, and in range for every shard count."""
+    for i in range(200):
+        root = f"Deployment/ml-{i % 7}/dep-{i}"
+        for shards in (1, 2, 8, 64):
+            placements = {native.shard_of(root, shards)["shard"]
+                          for _ in range(5)}
+            assert len(placements) == 1
+            assert placements.pop() < max(shards, 1)
+    assert native.shard_of("anything", 1)["shard"] == 0
+    assert native.shard_of("anything", 0)["shard"] == 0
+
+
+def test_resolved_shard_count_clamps(built):
+    assert native.shard_of("x", 100000)["resolved_count"] == 64
+    auto = native.shard_of("x", 0)["resolved_count"]
+    assert 1 <= auto <= 8
+
+
+# ── byte-identity: --shards 1 vs --shards 8 ────────────────────────────
+
+VOLATILE_KEYS = {"ts", "ts_unix", "ts_ms", "now_unix", "trace_id", "id"}
+
+
+def _normalize(obj):
+    """Drop fields that differ between ANY two runs (clocks, trace ids,
+    the ts-derived capsule id) — everything else must be byte-identical
+    across shard counts."""
+    if isinstance(obj, dict):
+        return {k: _normalize(v) for k, v in obj.items()
+                if k not in VOLATILE_KEYS}
+    if isinstance(obj, list):
+        return [_normalize(v) for v in obj]
+    return obj
+
+
+def _mixed_cluster(fake_prom, fake_k8s):
+    """A cluster exercising every fold path: plain idle roots in several
+    namespaces, a multi-pod root (dedup), a full idle slice, a partial
+    slice (group gate), an annotated pod (root veto), an unresolvable
+    owner (NO_SCALABLE_OWNER), a too-young pod and a ghost pod."""
+    for i in range(6):
+        _, _, pods = fake_k8s.add_deployment_chain(
+            f"ml-{i % 2}", f"dep-{i}", num_pods=2, tpu_chips=4)
+        for pod in pods:
+            fake_prom.add_idle_pod_series(pod["metadata"]["name"],
+                                          f"ml-{i % 2}", chips=4)
+    _, slice_pods = fake_k8s.add_jobset_slice("tpu-jobs", "slice-0",
+                                              num_hosts=4, tpu_chips=4)
+    for pod in slice_pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "tpu-jobs",
+                                      chips=4)
+    _, partial_pods = fake_k8s.add_jobset_slice("tpu-jobs", "partial-0",
+                                                num_hosts=4, tpu_chips=4)
+    for pod in partial_pods[1:]:  # host 0 busy → group gate must veto
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "tpu-jobs",
+                                      chips=4)
+    _, _, vetoed = fake_k8s.add_deployment_chain("ml-0", "protected",
+                                                 num_pods=2, tpu_chips=4)
+    vetoed[0]["metadata"]["annotations"] = {"tpu-pruner.dev/skip": "true"}
+    for pod in vetoed:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml-0", chips=4)
+    fake_k8s.add_pod("ml-1", "orphan",
+                     owners=[fake_k8s.owner("DaemonSet", "ds-x")])
+    fake_prom.add_idle_pod_series("orphan", "ml-1")
+    _, _, young = fake_k8s.add_deployment_chain("ml-1", "young", num_pods=1,
+                                                pod_age=60)
+    fake_prom.add_idle_pod_series(young[0]["metadata"]["name"], "ml-1")
+    fake_prom.add_idle_pod_series("ghost", "ml-0")  # in prom, not in k8s
+
+
+def test_shards_1_vs_8_byte_identical_audit_and_capsules(
+        built, fake_prom, fake_k8s, tmp_path):
+    """THE determinism acceptance: the same cluster decided under one
+    shard and under eight produces byte-identical DecisionRecords and
+    flight capsules (dry-run: the cluster stays untouched between runs,
+    so the only differences any run-pair shows are the normalized clock
+    and trace fields)."""
+    _mixed_cluster(fake_prom, fake_k8s)
+
+    outputs = {}
+    for shards in (1, 8):
+        audit = tmp_path / f"audit-{shards}.jsonl"
+        flight = tmp_path / f"flight-{shards}"
+        run_daemon(fake_prom, fake_k8s, "--shards", str(shards),
+                   "--audit-log", str(audit), "--flight-dir", str(flight),
+                   run_mode="dry-run")
+        records = [_normalize(json.loads(line))
+                   for line in audit.read_text().splitlines()]
+        capsules = [_normalize(json.loads(p.read_text()))
+                    for p in sorted(flight.glob("cycle-*.json"))]
+        assert records, "no audit records written"
+        assert capsules, "no capsules written"
+        outputs[shards] = (json.dumps(records, sort_keys=True),
+                           json.dumps(capsules, sort_keys=True))
+
+    assert outputs[1][0] == outputs[8][0], "audit JSONL differs across shard counts"
+    assert outputs[1][1] == outputs[8][1], "capsules differ across shard counts"
+
+
+def test_scale_down_under_shards_patches_exact_set_and_replays(
+        built, tmp_path):
+    """Scale-down with 8 shards: exactly the reclaimable roots are
+    patched (partial slice and annotated root spared), and the sharded
+    capsules replay bit-for-bit offline — fakes torn down first."""
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    flight = tmp_path / "flight"
+    try:
+        _mixed_cluster(prom, k8s)
+        run_daemon(prom, k8s, "--shards", "8", "--flight-dir", str(flight),
+                   "--scale-concurrency", "4", cycles=1)
+        patched = {p for p, _ in k8s.scale_patches()}
+        patched |= {p for p, b in k8s.patches
+                    if "/jobsets/" in p and b.get("spec", {}).get("suspend")}
+        expected = {f"/apis/apps/v1/namespaces/ml-{i % 2}/deployments/dep-{i}/scale"
+                    for i in range(6)}
+        expected.add("/apis/jobset.x-k8s.io/v1alpha2/namespaces/tpu-jobs/jobsets/slice-0")
+        assert patched == expected, patched ^ expected
+        capsules = sorted(flight.glob("cycle-*.json"))
+        assert capsules
+    finally:
+        prom.stop()
+        k8s.stop()
+
+    for capsule in capsules:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_pruner.analyze", "--replay",
+             str(capsule)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["match"] is True
+        assert out["drift"] == []
+
+
+# ── cross-cycle overlap ────────────────────────────────────────────────
+
+
+def test_overlap_mode_decisions_unchanged(built, fake_prom, fake_k8s):
+    """--overlap on pipelines cycle N+1's query phases with cycle N's
+    drain; the decided set must be unaffected: cycle 1 pauses every idle
+    root, warm cycles 2-3 detect them already paused from the store."""
+    for i in range(6):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    proc = run_daemon(fake_prom, fake_k8s, "--overlap", "on",
+                      "--watch-cache", "on", cycles=3)
+    assert "cycle overlap on" in proc.stderr
+    assert "Reached --max-cycles=3" in proc.stderr
+    patched = {p for p, _ in fake_k8s.scale_patches()}
+    assert patched == {f"/apis/apps/v1/namespaces/ml/deployments/dep-{i}/scale"
+                       for i in range(6)}, patched
+
+
+def test_overlap_breaker_cap_applies_per_cycle(built, fake_prom, fake_k8s):
+    """The blast-radius cap is a PER-CYCLE property and must survive the
+    two-cycle handoff: one overlapped cycle with cap 2 pauses exactly 2
+    of 6 idle roots."""
+    for i in range(6):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    proc = run_daemon(fake_prom, fake_k8s, "--overlap", "on",
+                      "--max-scale-per-cycle", "2", cycles=1)
+    assert "Circuit breaker" in proc.stderr
+    assert len({p for p, _ in fake_k8s.scale_patches()}) == 2
+
+
+def test_overlap_off_is_default(built, fake_prom, fake_k8s):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    proc = run_daemon(fake_prom, fake_k8s, cycles=1)
+    assert "cycle overlap off" in proc.stderr
+
+
+# ── paginated LIST (limit/continue) ────────────────────────────────────
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_fake_k8s_client_driven_limit_paginates_with_opaque_tokens(fake_k8s):
+    for i in range(7):
+        fake_k8s.add_pod("ml", f"p-{i}")
+    base = fake_k8s.url + "/api/v1/namespaces/ml/pods"
+    status, page1 = _get(base + "?limit=3")
+    assert status == 200
+    assert len(page1["items"]) == 3
+    token = page1["metadata"]["continue"]
+    # opaque: not a bare integer cursor
+    assert not token.isdigit()
+    status, page2 = _get(base + f"?limit=3&continue={token}")
+    assert len(page2["items"]) == 3
+    status, page3 = _get(
+        base + f"?limit=3&continue={page2['metadata']['continue']}")
+    assert len(page3["items"]) == 1
+    assert "continue" not in page3["metadata"]
+    names = {p["metadata"]["name"]
+             for page in (page1, page2, page3) for p in page["items"]}
+    assert names == {f"p-{i}" for i in range(7)}
+
+
+def test_fake_k8s_expired_continue_token_gets_410(fake_k8s):
+    for i in range(4):
+        fake_k8s.add_pod("ml", f"p-{i}")
+    base = fake_k8s.url + "/api/v1/namespaces/ml/pods"
+    _, page1 = _get(base + "?limit=2")
+    token = page1["metadata"]["continue"]
+    fake_k8s.expire_watches()  # compaction floor moves past the snapshot
+    status, body = _get(base + f"?limit=2&continue={token}")
+    assert status == 410
+    assert body["reason"] == "Expired"
+    # malformed tokens are refused the same way, never misread as cursors
+    status, _ = _get(base + "?limit=2&continue=not-a-token")
+    assert status == 410
+    # a fresh LIST (no token) recovers immediately
+    status, page = _get(base + "?limit=10")
+    assert status == 200 and len(page["items"]) == 4
+
+
+def test_informer_initial_list_uses_pagination(built, fake_prom, fake_k8s):
+    """The informer's initial LIST must arrive in limit/continue pages —
+    at mega scale one monolithic LIST response is exactly what kills the
+    fixture and the apiserver. 600 pods > the 500-object page, so the
+    pods sync must issue a continue'd second page and still decide
+    correctly from the store."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=1)
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    for i in range(600):
+        fake_k8s.add_pod("filler", f"busy-{i}")  # never idle in prom
+
+    run_daemon(fake_prom, fake_k8s, "--watch-cache", "on", cycles=1)
+    pod_lists = [p for m, p in fake_k8s.requests
+                 if m == "GET" and p.startswith("/api/v1/pods")]
+    assert any("limit=500" in p for p in pod_lists), pod_lists
+    assert any("continue=" in p for p in pod_lists), pod_lists
+    assert {p for p, _ in fake_k8s.scale_patches()} == {
+        "/apis/apps/v1/namespaces/ml/deployments/trainer/scale"}

@@ -157,6 +157,7 @@ def load() -> ctypes.CDLL:
         "tp_target_meta",
         "tp_otlp_grpc_call",
         "tp_audit_reason_codes",
+        "tp_shard_of",
         "tp_fleet_metric_families",
         "tp_fleet_aggregate",
         "tp_stamp_exposition",
@@ -266,6 +267,14 @@ def dedup_targets(targets: list[dict]) -> list[dict]:
 def target_meta(target: dict) -> dict:
     """Meta accessors (name/namespace/kind/uid/apiVersion) for a target."""
     return _call("tp_target_meta", target)
+
+
+def shard_of(key: str, shards: int) -> dict:
+    """Shard placement for a resolved-root key (native/src/shard.cpp):
+    ``{"shard": i, "hash": fnv1a64, "resolved_count": n}``. The shard
+    index is a pure function of (key, shards) — the reconcile engine's
+    same-root-same-shard guarantee the determinism tests pin."""
+    return _call("tp_shard_of", {"key": key, "shards": shards})
 
 
 def audit_reason_codes() -> list[str]:

@@ -25,6 +25,7 @@ Pod→SS→Notebook, kserve-labelled pods) plus the TPU-native one
 
 from __future__ import annotations
 
+import base64
 import copy
 import json
 import re
@@ -318,9 +319,13 @@ class FakeK8s:
         # a permissive aggregated apiserver.
         self.strict_validation = True
         # >0 → chunk every collection LIST into pages of this size with
-        # metadata.continue tokens (what an intermediary cache or an
-        # apiserver serving `limit` does); clients that ignore the token
-        # silently see only the first page.
+        # metadata.continue tokens even when the client sends no `limit`
+        # (what an intermediary cache does); clients that ignore the token
+        # silently see only the first page. Independently of this switch,
+        # a client-sent `limit=N` query param always paginates at N, with
+        # OPAQUE continue tokens that 410 once the compaction floor moves
+        # past their snapshot (expire_watches) — the real apiserver's
+        # limit/continue contract, which the informer's initial LIST uses.
         self.paginate_lists = 0
         # targeted fault injection: (method or "*", exact path) → [code, n]
         # where n is the remaining failure count (-1 = fail forever)
@@ -620,6 +625,35 @@ class FakeK8s:
             self._watch_generation += 1
             self._watch_cond.notify_all()
 
+    def _encode_continue(self, start: int) -> str:
+        """Opaque continue token, shaped like a real apiserver's: carries
+        the cursor AND the resourceVersion of the snapshot it belongs to,
+        base64'd so clients cannot (and must not) interpret it — they pass
+        it back verbatim."""
+        raw = f"v1:{start}:{self._rv}"
+        return base64.urlsafe_b64encode(raw.encode()).decode().rstrip("=")
+
+    def _decode_continue(self, token: str):
+        """Returns (start_index, None) or (0, 410): malformed tokens and
+        tokens whose snapshot rv predates the compaction floor
+        (expire_watches) get HTTP 410 Expired, exactly the real
+        apiserver's answer to a stale continue — the client must restart
+        the LIST from the beginning."""
+        if not token:
+            return 0, None
+        try:
+            pad = "=" * (-len(token) % 4)
+            raw = base64.urlsafe_b64decode((token + pad).encode()).decode()
+            version, start, rv = raw.split(":")
+            if version != "v1":
+                return 0, 410
+            start, rv = int(start), int(rv)
+        except Exception:
+            return 0, 410
+        if rv < self._watch_floor:
+            return 0, 410
+        return start, None
+
     def scale_patches(self):
         return [(p, b) for p, b in self.patches if p.endswith("/scale")]
 
@@ -769,12 +803,26 @@ class FakeK8s:
                         # a real LIST carries the store's resourceVersion —
                         # the version a subsequent watch resumes from
                         meta = {"resourceVersion": str(fake._rv)}
-                        page = fake.paginate_lists
+                        try:
+                            limit = int(query.get("limit", ["0"])[0] or "0")
+                        except ValueError:
+                            limit = 0
+                        page = limit if limit > 0 else fake.paginate_lists
                         if page > 0:
-                            start = int(query.get("continue", ["0"])[0] or "0")
+                            token = query.get("continue", [""])[0]
+                            start, expired = fake._decode_continue(token)
+                            if expired is not None:
+                                self._respond(410, {
+                                    "kind": "Status", "status": "Failure",
+                                    "reason": "Expired", "code": 410,
+                                    "message": "The provided continue parameter "
+                                               "is too old to display a "
+                                               "consistent list result."})
+                                return
                             chunk = items[start:start + page]
                             if start + page < len(items):
-                                meta["continue"] = str(start + page)
+                                meta["continue"] = fake._encode_continue(
+                                    start + page)
                             self._respond(200, {"kind": "List", "apiVersion": "v1",
                                                 "metadata": meta, "items": chunk})
                             return
