@@ -489,6 +489,12 @@ def run_watch_cache_steady_state():
             time.sleep(0.5)  # drain actuation stragglers
             cold_patches = len(k8s.patches)
             cold_api_calls = len(k8s.requests)
+            # shared-transport accounting (fakes count accepted TCP
+            # connections): the whole cold cycle — informer LISTs, watch
+            # streams, queries, owner GETs, patches — should have opened
+            # ONE connection per endpoint, and the warm cycle ZERO more.
+            connections_cold = (k8s.transport.snapshot()["connections"]
+                                + prom.transport.snapshot()["connections"])
             patched_cold = {p for p, _ in k8s.patches[:cold_patches]}
             wrong = [p for p in patched_cold
                      if "/jobsets/partial-" in p or "/deployments/busy-" in p]
@@ -528,6 +534,14 @@ def run_watch_cache_steady_state():
                 "warm cycle did not patch exactly the churn set: "
                 f"extra={sorted(warm_patched - churn_paths)[:3]} "
                 f"missing={sorted(churn_paths - warm_patched)[:3]}")
+        connections_warm = (k8s.transport.snapshot()["connections"]
+                            + prom.transport.snapshot()["connections"]
+                            - connections_cold)
+        if connections_warm > 2:  # two endpoints: <= 1 connection each
+            raise RuntimeError(
+                f"ACCEPTANCE MISS: warm cycle opened {connections_warm} new "
+                "transport connections (bar: <= 1 per endpoint — the "
+                "multiplexed connections must persist across cycles)")
         steady_calls = len(k8s.requests) - warm_req_idx
         ratio = steady_calls / cold_api_calls
         if ratio > 0.10:
@@ -573,6 +587,70 @@ def run_watch_cache_steady_state():
                     f"{rep.stderr[-500:]}")
         except (OSError, ValueError, subprocess.SubprocessError) as e:
             log(f"fleet-report failed: {e}")
+
+        # Transport on/off delta: two IDENTICAL 2-cycle probe runs on the
+        # now-quiesced cluster (all targets paused → every cycle decodes
+        # the same bodies and actuates nothing), one with the shared h2
+        # transport + zero-copy decoder (the defaults), one with
+        # `--transport http1 --zero-copy-json off`. The query+decode phase
+        # p50s are the front half this PR attacks — probing both modes
+        # under the same conditions (no cold LIST, no actuation burst
+        # contending for the single-process fixture) makes the pair an
+        # honest before/after.
+        def _phase_probe(extra):
+            probe_proc = None
+            try:
+                probe_cmd = cmd + list(extra)
+                probe_proc = subprocess.Popen(probe_cmd, env=env,
+                                              stdout=subprocess.DEVNULL,
+                                              stderr=subprocess.PIPE, text=True)
+                port: list = []
+                last: list = []
+
+                def _probe_drain():
+                    for line in probe_proc.stderr:
+                        if not port:
+                            m = _re.search(r"serving /metrics on port (\d+)", line)
+                            if m:
+                                port.append(int(m.group(1)))
+
+                threading.Thread(target=_probe_drain, daemon=True).start()
+
+                def _probe_scrape():
+                    while probe_proc.poll() is None:
+                        if port:
+                            try:
+                                body = urllib.request.urlopen(
+                                    f"http://127.0.0.1:{port[0]}/metrics",
+                                    timeout=2).read().decode()
+                                if "cycle_phase_seconds" in body:
+                                    last[:] = [body]
+                            except OSError:
+                                pass
+                        time.sleep(0.3)
+
+                threading.Thread(target=_probe_scrape, daemon=True).start()
+                probe_proc.wait(timeout=300)
+                if last:
+                    return _phase_percentiles(last[0])
+            except (OSError, subprocess.SubprocessError) as e:
+                log(f"transport phase probe {extra} failed: {e}")
+            finally:
+                if probe_proc is not None and probe_proc.poll() is None:
+                    probe_proc.kill()
+                    probe_proc.wait()
+            return {"cycle_phase_p50_ms": {}}
+
+        phases_on = _phase_probe(())
+        phases_off = _phase_probe(("--transport", "http1",
+                                   "--zero-copy-json", "off"))
+
+        def _query_decode_p50(p50s):
+            q, d = p50s.get("query"), p50s.get("decode")
+            if q is None or d is None:
+                return None
+            return round(q + d, 3)
+
         return {
             **phases,
             "signal_query_p50_ms": phases["cycle_phase_p50_ms"].get("signal"),
@@ -580,6 +658,12 @@ def run_watch_cache_steady_state():
             "reclaimed_chip_hours": fleet_report.get("reclaimed_chip_hours"),
             "tracked_workloads": fleet_report.get("tracked_workloads"),
             "fleet_report": fleet_report or None,
+            "connections_opened_cold": connections_cold,
+            "connections_opened_warm": connections_warm,
+            "query_decode_p50_ms": _query_decode_p50(
+                phases_on["cycle_phase_p50_ms"]),
+            "transport_off_query_decode_p50_ms": _query_decode_p50(
+                phases_off["cycle_phase_p50_ms"]),
             "cold_api_calls": cold_api_calls,
             "steady_state_api_calls": steady_calls,
             "steady_to_cold_call_ratio": round(ratio, 4),
@@ -811,6 +895,22 @@ def run_mega_tier():
         phases = (_phase_percentiles(daemon.metrics_last[0])
                   if daemon.metrics_last else
                   {"cycle_phase_p50_ms": {}, "cycle_phase_p95_ms": {}})
+        # Shared-transport proof at mega scale, from the daemon's own
+        # counters: the whole 2-cycle run — paginated 50k-pod LISTs, all
+        # watch streams, queries, patches — over <= 1 connection per
+        # endpoint (2 endpoints: apiserver + prometheus).
+        import re as _re_t
+        mega_connections = None
+        if daemon.metrics_last:
+            mega_connections = sum(
+                int(m) for m in _re_t.findall(
+                    r'tpu_pruner_transport_connections_total\{[^}]*\} (\d+)',
+                    daemon.metrics_last[0]))
+            if mega_connections > 2:
+                raise RuntimeError(
+                    f"mega run opened {mega_connections} transport "
+                    "connections (bar: <= 1 per endpoint)")
+        result["mega_transport_connections"] = mega_connections
         result.update({
             "mega_cold_api_calls": cold_api_calls,
             "mega_steady_state_api_calls": steady_calls,
@@ -2024,6 +2124,16 @@ def main():
         # coverage it judged ride the summary
         "signal_query_p50_ms": watch_cache.get("signal_query_p50_ms"),
         "signal_coverage_ratio": watch_cache.get("signal_coverage_ratio"),
+        # shared transport: TCP connections the fakes accepted during the
+        # watch-cache section's cold cycle (bar: ~1 per endpoint) and the
+        # warm cycle (bar: <= 1 per endpoint, 0 in practice — the
+        # multiplexed connections persist), plus the query+decode front
+        # half with the h2 transport + zero-copy decoder ON vs OFF
+        "connections_opened_cold": watch_cache.get("connections_opened_cold"),
+        "connections_opened_warm": watch_cache.get("connections_opened_warm"),
+        "query_decode_p50_ms": watch_cache.get("query_decode_p50_ms"),
+        "transport_off_query_decode_p50_ms": watch_cache.get(
+            "transport_off_query_decode_p50_ms"),
         # federation hub: members merged + the hub's own poll-and-merge
         # round latency (tpu_pruner_fleet_merge_seconds p50)
         "fleet_members": fleet_fed.get("fleet_members"),

@@ -121,6 +121,24 @@ tsan-shard:
     ./build-tsan/tpupruner_tests shard
     ./build-tsan/tpupruner_tests informer
 
+# shared-transport race tier: the h2 multiplexing client (concurrent
+# streams on one connection, GOAWAY retry, fallback demotion) and the
+# informer's LIST/watch-over-h2 path under ThreadSanitizer (substring
+# filter of the native test binary)
+tsan-transport:
+    cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
+    ./build-tsan/tpupruner_tests h2
+    ./build-tsan/tpupruner_tests informer
+
+# zero-copy JSON memory tier: the arena Doc decoder's parity units plus
+# the mutation fuzzer's Doc-vs-Value accept/tree invariant under
+# AddressSanitizer — string_view-into-buffer decoding is exactly the
+# code whose lifetime bugs ASan catches and plain asserts don't
+asan-json:
+    cmake -G Ninja -S . -B build-asan -DTP_SANITIZE=ON && cmake --build build-asan
+    ./build-asan/tpupruner_tests json
+    ./build-asan/tpupruner_fuzz 200000
+
 # standalone TPU capture: probe + fleet eval + bench_tpu_last_good.json
 # (run EARLY in a round / whenever the chip tunnel is up; exits 1 when no
 # real accelerator measurement happened)

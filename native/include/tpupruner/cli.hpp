@@ -83,6 +83,12 @@ struct Cli {
   // breaker, brownout and --max-scale-per-cycle caps still apply per
   // cycle). "off" (default) keeps the strictly serial producer loop.
   std::string overlap = "off";
+  // --transport: shared h2 transport mode (auto = ALPN/prior-knowledge
+  // negotiation with transparent HTTP/1.1 fallback; http1 = parity escape
+  // hatch). --zero-copy-json: arena decode at the LIST/watch and
+  // Prometheus-matrix call sites (off = Value::parse everywhere).
+  std::string transport = "auto";
+  std::string zero_copy_json = "on";
   int metrics_port = -1;                  // --metrics-port: -1 disabled (flag "0" maps
                                           // here too), 0 ephemeral (flag "auto"), else port
   // --cluster-name: fleet identity stamped on every exported surface (a

@@ -1,0 +1,172 @@
+// Shared HTTP/2 transport layer.
+//
+// PR 1 grew a working h2 framing + HPACK stack inside otlp_grpc.cpp for
+// the gRPC exporter; until now the daemon's HOT traffic — the informer's
+// LIST+watch streams, the per-cycle idleness+evidence query pair, and
+// consumer scale patches — still rode one-request-per-connection-ish
+// HTTP/1.1 (http.cpp). This header factors that layer out into two
+// surfaces:
+//
+//   1. Wire primitives (frame headers, HPACK literal encode, HPACK +
+//      huffman decode) shared by the multiplexing client below AND by
+//      otlp_grpc.cpp's single-stream gRPC state machine (rebased onto
+//      these instead of its private copies).
+//
+//   2. h2::Transport — a drop-in replacement for http::Client that
+//      multiplexes every request to one endpoint over ONE connection as
+//      concurrent h2 streams (per-stream idle deadlines, GOAWAY /
+//      dead-connection retry), with transparent HTTP/1.1 fallback:
+//        - https: ALPN-negotiated ({"h2","http/1.1"} offered; the
+//          server's pick decides),
+//        - cleartext http: prior-knowledge probe (client preface +
+//          SETTINGS; a peer that answers with anything but an h2
+//          SETTINGS frame is remembered as http1 and the request is
+//          re-issued through the pooled HTTP/1.1 client).
+//      Mode::Http1 bypasses h2 entirely — the exact-parity escape hatch
+//      behind the daemon's `--transport http1`.
+//
+// Reference analog: hyper's auto-negotiating client pool under kube-rs /
+// reqwest — one h2 connection per host carrying watches and GETs
+// side by side — which the hand-rolled HTTP/1.1 client could not express.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <string_view>
+#include <vector>
+
+#include "tpupruner/http.hpp"
+
+namespace tpupruner::h2 {
+
+// ── wire primitives (shared with otlp_grpc.cpp) ─────────────────────────
+
+// Frame types / flags (RFC 7540 §6, §4.1).
+constexpr uint8_t kFrameData = 0x0, kFrameHeaders = 0x1, kFrameRst = 0x3,
+                  kFrameSettings = 0x4, kFramePing = 0x6, kFrameGoaway = 0x7,
+                  kFrameWindowUpdate = 0x8, kFrameContinuation = 0x9;
+constexpr uint8_t kFlagEndStream = 0x1, kFlagAck = 0x1, kFlagEndHeaders = 0x4,
+                  kFlagPadded = 0x8, kFlagPriority = 0x20;
+
+constexpr const char* kClientPreface = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+
+// 9-byte frame header.
+std::string frame_header(size_t len, uint8_t type, uint8_t flags, uint32_t stream);
+
+// HPACK "literal header field without indexing — new name", both strings
+// raw (huffman bit 0). Always legal regardless of table state (RFC 7541
+// §6.2.2); names must already be lowercase.
+void hpack_literal(std::string& out, std::string_view name, std::string_view value);
+
+struct Header {
+  std::string name, value;
+  bool huffman_value = false;  // huffman-coded AND undecodable (opaque)
+};
+
+// Decode one HPACK header block (static table + literals; dynamic-table
+// references are tolerated as unknowns — we advertise table size 0).
+// Returns false on malformed input.
+bool hpack_decode(std::string_view block, std::vector<Header>& out);
+
+// RFC 7541 §5.2 huffman string decode. False on decoding errors.
+bool huffman_decode(std::string_view in, std::string& out);
+
+// A SETTINGS payload: {HEADER_TABLE_SIZE: 0, ENABLE_PUSH: 0} plus the
+// given INITIAL_WINDOW_SIZE when > 0 (0 keeps the protocol default).
+std::string settings_payload(uint32_t initial_window);
+
+// ── process-wide transport counters ─────────────────────────────────────
+// Bumped by both this client and http.cpp's pooled HTTP/1.1 client, and
+// served as /metrics families (render_transport_metrics) so the bench can
+// read connections_opened before/after a warm cycle.
+struct TransportCounters {
+  std::atomic<uint64_t> h2_connections{0};     // h2 connections established
+  std::atomic<uint64_t> http1_connections{0};  // HTTP/1.1 connections opened
+  std::atomic<uint64_t> h2_streams_total{0};   // h2 request streams opened
+  std::atomic<int64_t> streams_active{0};      // h2 streams currently open
+  std::atomic<uint64_t> h2_fallbacks{0};       // endpoints demoted to http1
+  std::atomic<uint64_t> retries{0};            // GOAWAY/dead-conn h2 retries
+};
+TransportCounters& counters();
+
+// Canonical transport family names served on /metrics — the docs
+// drift-guard joins this list against OPERATIONS.md.
+std::vector<std::string> transport_metric_families();
+// Exposition text for those families (extra-metrics provider shape).
+std::string render_transport_metrics(bool openmetrics);
+
+// ── the multiplexing client ─────────────────────────────────────────────
+
+enum class Mode { Auto, H2, Http1 };
+// "auto" | "h2" | "http1"; throws std::runtime_error on anything else.
+Mode mode_from_string(const std::string& s);
+const char* mode_name(Mode m);
+
+// Process-wide default for clients constructed without an explicit mode
+// (k8s::Client, prom::Client). Initialized lazily from
+// $TPU_PRUNER_TRANSPORT (auto|h2|http1; default auto); the daemon's
+// `--transport` flag overrides it at startup, before any client exists.
+Mode default_mode();
+void set_default_mode(Mode m);
+
+namespace detail {
+class Conn;  // one multiplexed h2 connection (internal)
+}
+
+class Transport {
+ public:
+  explicit Transport(Mode mode, http::TlsMode tls_mode = http::TlsMode::Verify,
+                     std::string ca_file = "");
+  ~Transport();
+  Transport(Transport&&) noexcept;
+  Transport& operator=(Transport&&) = delete;
+
+  // Same contract as http::Client::request — HTTP statuses returned,
+  // transport errors thrown — but requests to an h2 endpoint share one
+  // connection as concurrent streams. req.timeout_ms is a per-stream
+  // IDLE deadline over h2 (reset by any frame for the stream), matching
+  // the HTTP/1.1 client's per-socket-wait semantics.
+  http::Response request(const http::Request& req) const;
+
+  // Streaming request (K8s watch shape; see http::Client::request_stream
+  // for the callback contract). Over h2 the stream multiplexes onto the
+  // endpoint's shared connection instead of monopolizing a socket —
+  // the point of this refactor.
+  http::Response request_stream(
+      const http::Request& req, const std::function<bool(const char*, size_t)>& on_data,
+      const std::function<bool()>& abort = nullptr,
+      const std::function<void(const http::Response&)>& on_headers = nullptr) const;
+
+  void set_default_traceparent(std::string tp) const;
+
+  // Protocol this transport is using for the URL's endpoint:
+  // "h2" | "http1" | "unknown" (not yet contacted).
+  std::string protocol_for(const std::string& url) const;
+
+  Mode mode() const { return mode_; }
+
+ private:
+  struct Endpoint;
+  std::shared_ptr<Endpoint> endpoint_for(const std::string& key) const;
+  std::string resolved_traceparent(const http::Request& req) const;
+  http::Response dispatch(const http::Request& req,
+                          const std::function<bool(const char*, size_t)>* on_data,
+                          const std::function<bool()>* abort,
+                          const std::function<void(const http::Response&)>* on_headers) const;
+
+  Mode mode_;
+  http::TlsMode tls_mode_;
+  std::string ca_file_;
+  http::Client http1_;  // fallback + Mode::Http1 path (owns its own pool)
+  mutable std::mutex mutex_;
+  mutable std::map<std::string, std::shared_ptr<Endpoint>> endpoints_;
+  mutable std::mutex traceparent_mutex_;
+  mutable std::string default_traceparent_;
+};
+
+}  // namespace tpupruner::h2

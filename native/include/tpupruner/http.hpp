@@ -38,6 +38,15 @@ struct Url {
 
 std::optional<Url> parse_url(std::string_view url);
 
+// Connected TCP socket (blocking, TCP_NODELAY, SO_RCV/SNDTIMEO set to
+// timeout_ms) or throws — the dial path shared with the h2 transport.
+int connect_tcp(const std::string& host, int port, int timeout_ms);
+
+// True when the process's proxy environment (HTTPS_PROXY/HTTP_PROXY/
+// NO_PROXY) routes this URL through an egress proxy. The h2 transport
+// keeps proxied endpoints on the HTTP/1.1 client.
+bool proxy_in_use(const Url& url);
+
 struct Request {
   std::string method = "GET";
   std::string url;

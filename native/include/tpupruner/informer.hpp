@@ -73,19 +73,38 @@ struct ResourceStats {
 
 // Thread-safe object store for one resource. Values share JSON nodes
 // (json::Value is COW), so get() copies are pointer-sized.
+//
+// Zero-copy mode (json::zero_copy_enabled): entries are (DocPtr, node)
+// references into the LIST-page / watch-event arenas instead of Value
+// trees — a 100k-pod LIST never materializes 100k maps-of-shared-ptrs.
+// get() materializes a Value on demand, so only the objects a cycle
+// actually touches (candidates, owner chains) ever pay tree construction.
 class Store {
  public:
+  // Either a materialized Value (doc == nullptr) or an arena reference.
+  struct Entry {
+    json::Value value;
+    json::DocPtr doc;
+    uint32_t node = 0;
+  };
+
   std::optional<json::Value> get(const std::string& object_path) const;
+  bool contains(const std::string& object_path) const;
   size_t size() const;
   // Swap in a full LIST snapshot (relist semantics: objects deleted while
   // the watch was down vanish here).
   void replace(std::map<std::string, json::Value> objects);
+  void replace_entries(std::map<std::string, Entry> objects);
   void upsert(const std::string& object_path, json::Value object);
+  void upsert_doc(const std::string& object_path, json::DocPtr doc, uint32_t node);
   void erase(const std::string& object_path);
 
  private:
   mutable std::mutex mutex_;
-  std::map<std::string, json::Value> objects_;
+  // mutable: get() memoizes an arena entry's materialized Value in place
+  // (logically const — the entry's content is unchanged, only its
+  // representation).
+  mutable std::map<std::string, Entry> objects_;
 };
 
 // List+watch driver for one resource, owning its Store and worker thread.
@@ -116,9 +135,18 @@ class Reflector {
   // call concurrently with apply_list (the relist window is exactly when
   // a late watch event can still race the fresh LIST).
   bool apply_event(const json::Value& event);
+  // Zero-copy sibling: the event Doc's object subtree is stored as an
+  // arena reference (the event Doc stays alive while its object is in the
+  // store). Semantics identical to apply_event.
+  bool apply_event_doc(const json::DocPtr& event);
   // Apply a LIST result (replace + resourceVersion adoption); services
   // any pending relist request.
   void apply_list(const json::Value& list);
+  // Snapshot-level core shared by apply_list and the paginated zero-copy
+  // LIST path in run(): swaps the store and adopts `rv`.
+  void apply_list_snapshot(std::map<std::string, Store::Entry> snapshot, std::string rv);
+  // Object path for an arena-doc object node ("" when metadata is missing).
+  std::string object_path_of_doc(const json::Doc::Node& object) const;
   // True while a requested relist has not yet been serviced by apply_list.
   bool relist_pending() const { return relist_pending_.load(); }
   // Object path for an object of this resource (empty when metadata is

@@ -143,4 +143,127 @@ class Value {
 // Escape a string for embedding in JSON output (without surrounding quotes).
 std::string escape(std::string_view s);
 
+// ── arena / zero-copy document ──────────────────────────────────────────
+//
+// Value::parse builds a shared_ptr-per-string, map-node-per-member DOM —
+// fine for config blobs, pathological for the transport hot path, where a
+// warm cycle decodes megabytes of pod LIST pages and Prometheus matrices
+// per cycle. Doc is the opt-in alternative: one flat preorder node arena
+// over an OWNED response buffer, strings as string_views into that buffer
+// (escaped strings decode once into a side arena), numbers resolved with
+// EXACTLY Value::parse's grammar and int/double rules. Grammar, depth
+// limit, duplicate-key semantics (last wins) and error behavior match
+// Value::parse — pinned by the decode-parity corpus tests — so a tree
+// built via Doc::to_value() is indistinguishable from Value::parse(text).
+//
+// Consumers hold a DocPtr (shared ownership of buffer + arena) and walk
+// Node cursors; the informer store keeps (DocPtr, node) pairs and
+// materializes a Value only for the objects a cycle actually touches.
+class Doc;
+using DocPtr = std::shared_ptr<const Doc>;
+
+// Process-wide opt-in for the Doc-based decode path at the transport hot
+// call sites (informer LIST pages + watch events, the Prometheus
+// idleness/evidence matrices). Default ON — parity with Value::parse is a
+// tested invariant, not a risk — with $TPU_PRUNER_ZERO_COPY_JSON=off /
+// `--zero-copy-json off` as the measured-comparison escape hatch.
+bool zero_copy_enabled();
+void set_zero_copy(bool on);
+
+class Doc {
+ public:
+  // Parses `body`, taking ownership (nodes view into it). Throws
+  // ParseError exactly where Value::parse(body) would.
+  static DocPtr parse(std::string body);
+
+  // Lightweight cursor: (doc, node index). Valid while the Doc lives.
+  class Node {
+   public:
+    Type type() const;
+    bool is_null() const { return type() == Type::Null; }
+    bool is_bool() const { return type() == Type::Bool; }
+    bool is_number() const { return type() == Type::Int || type() == Type::Double; }
+    bool is_string() const { return type() == Type::String; }
+    bool is_array() const { return type() == Type::Array; }
+    bool is_object() const { return type() == Type::Object; }
+
+    bool as_bool() const;
+    int64_t as_int() const;      // Value::as_int semantics (Double truncates)
+    double as_double() const;    // Value::as_double semantics (Int widens)
+    std::string_view as_sv() const;  // string payload, escapes decoded
+    std::string as_string() const { return std::string(as_sv()); }
+
+    // Direct children of an array/object (0 otherwise).
+    size_t size() const;
+    // child(i) walks siblings from the first child — O(i). Hot loops must
+    // step with first_child()/next_sibling() instead (O(1) each); the
+    // caller bounds the walk by size().
+    Node child(size_t i) const;                              // array element i
+    std::pair<std::string_view, Node> member(size_t i) const;  // object member i
+    Node first_child() const { return Node(doc_, idx_ + 1); }
+    Node next_sibling() const;
+    std::string_view key() const;  // member key ("" for array elements)
+
+    // Object lookup; like Value::parse's duplicate-key handling, the LAST
+    // occurrence of a repeated key wins. nullopt when absent or non-object.
+    std::optional<Node> find(std::string_view key) const;
+    std::optional<Node> at_path(std::string_view path) const;
+    std::string_view get_string(std::string_view key,
+                                std::string_view fallback = "") const;
+
+    // Materialize this subtree as a regular Value (identical to what
+    // Value::parse would have produced for the same bytes).
+    Value to_value() const;
+
+    // Stable handle for re-deriving this node later from a held DocPtr
+    // (the informer store keeps (doc, index) pairs): doc->node(index).
+    uint32_t index() const { return idx_; }
+
+   private:
+    friend class Doc;
+    Node(const Doc* doc, uint32_t idx) : doc_(doc), idx_(idx) {}
+    const Doc* doc_;
+    uint32_t idx_;
+  };
+
+  Node root() const { return Node(this, 0); }
+  Node node(uint32_t index) const { return Node(this, index); }
+  Value to_value() const { return root().to_value(); }
+  const std::string& body() const { return body_; }
+  size_t node_count() const { return nodes_.size(); }
+
+ private:
+  friend class Node;
+  friend struct DocParser;  // json.cpp's arena-emitting parser
+  struct Rep {
+    Type type = Type::Null;
+    // Subtree extent: children of a container start at (self+1); the next
+    // sibling of node i is nodes_[i].end — one uint32 buys full traversal
+    // of the preorder arena without per-child pointers.
+    uint32_t end = 0;
+    uint32_t count = 0;  // direct children (containers)
+    union {
+      bool b;
+      int64_t i;
+      double d;
+    };
+    // String payload / member key: (offset, len) into body_ or, when the
+    // source contained escapes, into decoded_ (flagged).
+    uint32_t str_off = 0, str_len = 0;
+    uint32_t key_off = 0, key_len = 0;
+    bool str_decoded = false, key_decoded = false, has_key = false;
+    Rep() : i(0) {}
+  };
+  std::string_view str_of(const Rep& r) const {
+    return std::string_view((r.str_decoded ? decoded_ : body_).data() + r.str_off, r.str_len);
+  }
+  std::string_view key_of(const Rep& r) const {
+    return std::string_view((r.key_decoded ? decoded_ : body_).data() + r.key_off, r.key_len);
+  }
+
+  std::string body_;     // the response buffer (owned; nodes view into it)
+  std::string decoded_;  // side arena for escape-decoded strings
+  std::vector<Rep> nodes_;
+};
+
 }  // namespace tpupruner::json

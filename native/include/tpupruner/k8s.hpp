@@ -22,6 +22,7 @@
 #include <string>
 
 #include "tpupruner/core.hpp"
+#include "tpupruner/h2.hpp"
 #include "tpupruner/http.hpp"
 #include "tpupruner/json.hpp"
 
@@ -72,6 +73,14 @@ class Client {
   // size so a 100k-object collection never materializes as one response.
   json::Value list(const std::string& path, const std::string& label_selector,
                    int64_t limit = 0) const;
+  // Paginated LIST delivering each page as an arena Doc (the zero-copy
+  // informer path): `on_page` receives every page in order; the caller
+  // extracts items/continue-free metadata itself. Returns the LAST page's
+  // metadata.resourceVersion — the newest snapshot version a watch may
+  // legally resume from. Same limit/continue/429 semantics as list().
+  std::string list_pages(const std::string& path, const std::string& label_selector,
+                         int64_t limit,
+                         const std::function<void(const json::DocPtr&)>& on_page) const;
   // application/merge-patch+json PATCH (reference Patch::Merge).
   json::Value patch_merge(const std::string& path, const json::Value& body,
                           bool retry_throttle = true) const;
@@ -96,6 +105,15 @@ class Client {
   // the relist signal — and runtime_error on transport failures.
   void watch(const std::string& path, const WatchOptions& opts,
              const std::function<bool(const json::Value&)>& on_event) const;
+  // Zero-copy sibling: each newline-delimited event frame is parsed as its
+  // own arena Doc (strings view into the frame buffer) instead of a Value
+  // tree. Framing, error, and abort semantics identical to watch().
+  void watch_doc(const std::string& path, const WatchOptions& opts,
+                 const std::function<bool(const json::DocPtr&)>& on_event) const;
+
+  // Transport protocol negotiated for the API server endpoint
+  // ("h2" | "http1" | "unknown") — surfaced in /debug and logs.
+  std::string transport_protocol() const { return http_.protocol_for(config_.api_url); }
 
   // Monotonic count of API requests issued through this client (watch
   // connections count once). Feeds the per-cycle call accounting the
@@ -130,10 +148,17 @@ class Client {
  private:
   json::Value request_json(const std::string& method, const std::string& path,
                            const std::string& body, const std::string& content_type,
-                           int* status_out, bool retry_throttle = true) const;
+                           int* status_out, bool retry_throttle = true,
+                           json::DocPtr* doc_out = nullptr) const;
+  void watch_impl(const std::string& path, const WatchOptions& opts,
+                  const std::function<bool(std::string_view)>& on_line) const;
 
   Config config_;
-  http::Client http_;
+  // The shared multiplexing transport (ALPN h2 with transparent HTTP/1.1
+  // fallback): every verb of this client — LIST pages, watch streams,
+  // owner GETs, scale PATCHes — rides ONE connection per endpoint as
+  // concurrent streams when the server speaks h2.
+  h2::Transport http_;
   mutable std::atomic<uint64_t> api_calls_{0};
 };
 

@@ -98,6 +98,14 @@ void record_resume(uint64_t cycle, const std::string& kind, const std::string& n
 
 // Accounts currently marked paused — the daemon's per-cycle informer
 // resume sweep iterates these.
+// Rewrite the checkpoint now if throttled record_* writes left it dirty
+// (record_pause and friends rewrite at most once per second — a
+// fleet-scale actuation drain would otherwise spend O(pauses x accounts)
+// re-serializing the whole file). The daemon calls this at shutdown so
+// the final drain's tail is never lost; observe_cycle flushes every
+// cycle in steady state.
+void flush();
+
 std::vector<PausedRoot> paused_roots();
 
 // /debug/workloads body: {"workloads": [...], "tracked": N, "totals":

@@ -37,4 +37,12 @@ struct DecodeResult {
 DecodeResult decode_instant_vector(const json::Value& response, const std::string& device,
                                    const std::string& schema = "gmp");
 
+// Zero-copy sibling walking the arena Doc directly — no Value tree is ever
+// built for the (potentially multi-megabyte) matrix. Samples, dedup order,
+// per-series error strings, and throw behavior are IDENTICAL to the Value
+// overload on the same bytes (pinned by the decode-parity corpus tests;
+// flight-recorder replay re-decodes capsule bytes through the Value path).
+DecodeResult decode_instant_vector(const json::Doc& response, const std::string& device,
+                                   const std::string& schema = "gmp");
+
 }  // namespace tpupruner::metrics

@@ -6,8 +6,10 @@
 // the GKE managed-Prometheus query endpoint (all speak /api/v1/query).
 #pragma once
 
+#include <mutex>
 #include <string>
 
+#include "tpupruner/h2.hpp"
 #include "tpupruner/http.hpp"
 #include "tpupruner/json.hpp"
 
@@ -26,15 +28,41 @@ class Client {
   // the bytes the daemon received, not a re-serialization.
   json::Value instant_query(const std::string& promql, std::string* raw_body = nullptr) const;
 
+  // Zero-copy sibling: the 2xx response body moves into an arena Doc
+  // (labels/values are string_views into it) instead of a Value tree —
+  // the warm cycle's matrix decode walks the Doc directly. Same error
+  // semantics as instant_query; `raw_body` still receives a verbatim copy
+  // (the flight recorder's contract).
+  json::DocPtr instant_query_doc(const std::string& promql,
+                                 std::string* raw_body = nullptr) const;
+
+  // Transport protocol negotiated for the Prometheus endpoint
+  // ("h2" | "http1" | "unknown").
+  std::string transport_protocol() const { return http_.protocol_for(base_url_ + "/"); }
+
   // W3C trace-context propagation onto the query requests (the daemon
   // stamps each cycle's span context; managed-Prometheus request logs
   // then join the OTLP trace). "" clears.
   void set_traceparent(const std::string& tp) const { http_.set_default_traceparent(tp); }
 
+  // Refresh the bearer token (SA projections and metadata-server tokens
+  // rotate): the daemon refreshes per cycle while KEEPING the client — and
+  // its warm multiplexed connection — alive across cycles.
+  void set_token(std::string token) const {
+    std::lock_guard<std::mutex> lock(token_mutex_);
+    token_ = std::move(token);
+  }
+
  private:
+  http::Response query_once(const std::string& promql) const;
+
   std::string base_url_;
-  std::string token_;
-  http::Client http_;
+  mutable std::mutex token_mutex_;
+  mutable std::string token_;
+  // Shared multiplexing transport: the per-cycle idleness + evidence query
+  // pair is issued as two concurrent streams on ONE h2 connection (or two
+  // pooled HTTP/1.1 sockets after fallback).
+  h2::Transport http_;
   int timeout_ms_;
 };
 

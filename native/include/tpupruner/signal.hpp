@@ -97,6 +97,13 @@ struct Assessment {
 Assessment assess(const json::Value& evidence_response,
                   const std::vector<core::PodMetricSample>& candidates, const Config& cfg,
                   uint64_t cycle);
+// Zero-copy sibling walking the arena Doc directly; verdicts, ordering,
+// and throw behavior identical to the Value overload on the same bytes
+// (replay re-derives from capsule bytes via the Value path — bit-for-bit
+// holds only because these two agree).
+Assessment assess(const json::Doc& evidence_response,
+                  const std::vector<core::PodMetricSample>& candidates, const Config& cfg,
+                  uint64_t cycle);
 
 // The audit reason code a verdict vetoes with (Healthy has none — do not
 // call it for healthy pods).

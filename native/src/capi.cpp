@@ -16,6 +16,7 @@
 #include "tpupruner/audit.hpp"
 #include "tpupruner/fleet.hpp"
 #include "tpupruner/gym.hpp"
+#include "tpupruner/h2.hpp"
 #include "tpupruner/recorder.hpp"
 #include "tpupruner/core.hpp"
 #include "tpupruner/informer.hpp"
@@ -164,10 +165,26 @@ char* tp_decode_samples(const char* payload_json) {
   return guarded([&] {
     Value payload = Value::parse(payload_json);
     const Value* response = payload.find("response");
-    if (!response) throw std::runtime_error("missing response");
     std::string device = checked_device(payload.get_string("device", "tpu"));
     std::string schema = payload.get_string("schema", "gmp");
-    auto result = tpupruner::metrics::decode_instant_vector(*response, device, schema);
+    // "response_raw" (optional): the verbatim body text — required for the
+    // zero-copy path (the Doc views into the bytes) and used by the decode
+    // parity tests to drive BOTH decoders from identical input.
+    bool zero_copy = false;
+    if (const Value* z = payload.find("zero_copy"); z && z->is_bool()) zero_copy = z->as_bool();
+    tpupruner::metrics::DecodeResult result;
+    if (const Value* raw = payload.find("response_raw"); raw && raw->is_string()) {
+      if (zero_copy) {
+        auto doc = tpupruner::json::Doc::parse(raw->as_string());
+        result = tpupruner::metrics::decode_instant_vector(*doc, device, schema);
+      } else {
+        result = tpupruner::metrics::decode_instant_vector(Value::parse(raw->as_string()),
+                                                           device, schema);
+      }
+    } else {
+      if (!response) throw std::runtime_error("missing response");
+      result = tpupruner::metrics::decode_instant_vector(*response, device, schema);
+    }
 
     Value samples = Value::array();
     for (const auto& s : result.samples) {
@@ -489,6 +506,41 @@ char* tp_signal_assess(const char* payload_json) {
     }
     return ok(tpupruner::signal::assessment_to_json(
         tpupruner::signal::assess(*response, candidates, cfg, /*cycle=*/1)));
+  });
+}
+
+char* tp_json_parse(const char* payload_json) {
+  // Decode-parity harness for the arena/zero-copy JSON path: parse `body`
+  // through Value::parse or (zero_copy) Doc::parse → to_value, returning
+  // canonical dumps. The parity corpus tests assert byte-identical dumps
+  // — and identical ParseError messages — across both paths on recorded
+  // LIST/watch/Prometheus bodies plus escape/UTF-8/truncation edge cases.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    const Value* body = p.find("body");
+    if (!body || !body->is_string()) throw std::runtime_error("missing body");
+    bool zero_copy = false;
+    if (const Value* z = p.find("zero_copy"); z && z->is_bool()) zero_copy = z->as_bool();
+    Value parsed = zero_copy ? tpupruner::json::Doc::parse(body->as_string())->to_value()
+                             : Value::parse(body->as_string());
+    Value out = Value::object();
+    out.set("dump", Value(parsed.dump()));
+    out.set("pretty", Value(parsed.dump(2)));
+    return ok(out);
+  });
+}
+
+char* tp_transport_metric_families(const char*) {
+  // The canonical shared-transport metric family names — the docs-drift
+  // test joins this against docs/OPERATIONS.md, like the signal families.
+  return guarded([&] {
+    Value families = Value::array();
+    for (const std::string& f : tpupruner::h2::transport_metric_families()) {
+      families.push_back(Value(f));
+    }
+    Value out = Value::object();
+    out.set("families", std::move(families));
+    return ok(out);
   });
 }
 

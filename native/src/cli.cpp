@@ -126,6 +126,21 @@ TPU FLAGS:
                                 brownout) are unaffected; best with short
                                 --check-interval (prefetched evidence ages by
                                 up to one interval otherwise)
+      --transport <M>           auto | h2 | http1 [default: auto] — the shared
+                                Prometheus/K8s transport: "auto" negotiates
+                                HTTP/2 (ALPN on https, prior-knowledge probe
+                                on cleartext) and multiplexes every request
+                                to an endpoint over ONE connection, falling
+                                back per endpoint to pooled HTTP/1.1; "h2"
+                                requires HTTP/2; "http1" bypasses h2 — the
+                                exact-parity escape hatch
+      --zero-copy-json <M>      on | off [default: on] — decode LIST pages,
+                                watch events, and Prometheus matrices through
+                                the arena/zero-copy JSON path (string_views
+                                over the response buffer) instead of full
+                                Value trees; off = the measured-comparison
+                                escape hatch (decisions are identical either
+                                way)
       --max-scale-per-cycle <N> blast-radius circuit breaker: pause at most N
                                 root objects per cycle, deferring the rest
                                 (a metric-plane outage reading the whole fleet
@@ -325,6 +340,16 @@ Cli parse(int argc, char** argv) {
        [&](const std::string& v) {
          check_choice("--overlap", v, {"on", "off"});
          cli.overlap = v;
+       }},
+      {"--transport",
+       [&](const std::string& v) {
+         check_choice("--transport", v, {"auto", "h2", "http1"});
+         cli.transport = v;
+       }},
+      {"--zero-copy-json",
+       [&](const std::string& v) {
+         check_choice("--zero-copy-json", v, {"on", "off"});
+         cli.zero_copy_json = v;
        }},
       {"--watch-cache",
        [&](const std::string& v) {

@@ -95,18 +95,26 @@ double secs_since(std::chrono::steady_clock::time_point since) {
   return std::chrono::duration<double>(std::chrono::steady_clock::now() - since).count();
 }
 
-prom::Client build_prom_client(const cli::Cli& args) {
-  // Fresh token each cycle, like the reference's per-cycle client rebuild
-  // (main.rs:296, 377-388) — tokens rotate (SA projection, metadata server).
+// Fresh token each cycle, like the reference's per-cycle client rebuild
+// (main.rs:296, 377-388) — tokens rotate (SA projection, metadata server).
+// The CLIENT, unlike the token, persists across cycles now: tearing it
+// down each cycle would throw away the warm multiplexed connection the
+// shared transport exists to keep.
+std::string resolve_prom_token(const cli::Cli& args) {
   auth::TokenOptions topts;
   topts.explicit_token = args.prometheus_token;
   std::string token = auth::get_bearer_token(topts).value_or("");
   if (token.empty()) {
     log::warn("daemon", "no bearer token resolved for prometheus; sending unauthenticated requests");
   }
+  return token;
+}
+
+prom::Client build_prom_client(const cli::Cli& args) {
   http::TlsMode tls =
       args.prometheus_tls_mode == "skip" ? http::TlsMode::Skip : http::TlsMode::Verify;
-  return prom::Client(cli::prometheus_base(args), token, tls, args.prometheus_tls_cert);
+  return prom::Client(cli::prometheus_base(args), resolve_prom_token(args), tls,
+                      args.prometheus_tls_cert);
 }
 
 // Signal-quality watchdog thresholds from the CLI surface. The window is
@@ -696,7 +704,8 @@ struct Prepared {
 };
 
 Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
-                       const std::string& evidence_query) {
+                       const std::string& evidence_query,
+                       prom::Client* persistent_prom = nullptr) {
   // Audit cycle id first (stamps every log line of the cycle), then the
   // cycle span (reference #[tracing::instrument] on run_query_and_scale,
   // main.rs:390); children below mirror the instrumented callees.
@@ -719,20 +728,75 @@ Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
   };
   with_span(cycle, [&] {
   auto phase_start = std::chrono::steady_clock::now();
-  prom::Client prom_client = build_prom_client(args);
+  // Persistent client (daemon run loop): refresh only the bearer token and
+  // keep the warm multiplexed connection. Fallback (external run_cycle
+  // callers): per-cycle client, the pre-transport behavior.
+  prom::Client local_prom = persistent_prom ? prom::Client("", "") : build_prom_client(args);
+  prom::Client& prom_client = persistent_prom ? *persistent_prom : local_prom;
+  if (persistent_prom) prom_client.set_token(resolve_prom_token(args));
   prom_client.set_traceparent(otlp::traceparent(cycle.context()));
-  std::string raw_body;
-  json::Value response = [&] {
-    otlp::Span span("prometheus.instant_query", &cycle.context());
-    return with_span(span, [&] {
-      return prom_client.instant_query(query, recorder::enabled() ? &raw_body : nullptr);
+  const bool zero_copy = json::zero_copy_enabled();
+
+  // Signal-quality watchdog: assess the health of the evidence ITSELF
+  // before trusting a single zero-peak reading. Its evidence query is
+  // issued CONCURRENTLY with the idleness query — two streams on the one
+  // h2 Prometheus connection (two pooled sockets after http1 fallback) —
+  // so the cycle's query wall-clock is max(idle, evidence), not the sum.
+  p.signal_on = args.signal_guard == "on" && !evidence_query.empty();
+  std::string evidence_raw;
+  json::Value evidence_response;
+  json::DocPtr evidence_doc;
+  std::exception_ptr evidence_error;
+  std::thread evidence_thread;
+  if (p.signal_on) {
+    evidence_thread = std::thread([&] {
+      try {
+        otlp::Span span("prometheus.evidence_query", &cycle.context());
+        with_span(span, [&] {
+          if (zero_copy) {
+            evidence_doc = prom_client.instant_query_doc(
+                evidence_query, recorder::enabled() ? &evidence_raw : nullptr);
+          } else {
+            evidence_response = prom_client.instant_query(
+                evidence_query, recorder::enabled() ? &evidence_raw : nullptr);
+          }
+        });
+      } catch (...) {
+        evidence_error = std::current_exception();
+      }
     });
-  }();
+  }
+  // The idleness query must never leave the evidence thread dangling —
+  // join on EVERY exit path (a throw below would otherwise terminate()).
+  struct Joiner {
+    std::thread& t;
+    ~Joiner() {
+      if (t.joinable()) t.join();
+    }
+  } evidence_joiner{evidence_thread};
+
+  std::string raw_body;
+  json::Value response;
+  json::DocPtr response_doc;
+  {
+    otlp::Span span("prometheus.instant_query", &cycle.context());
+    with_span(span, [&] {
+      if (zero_copy) {
+        response_doc =
+            prom_client.instant_query_doc(query, recorder::enabled() ? &raw_body : nullptr);
+      } else {
+        response = prom_client.instant_query(query, recorder::enabled() ? &raw_body : nullptr);
+      }
+    });
+  }
   recorder::record_prom_body(cycle_id, raw_body);
   observe_phase("query", phase_start);
 
   phase_start = std::chrono::steady_clock::now();
-  p.decoded = metrics::decode_instant_vector(response, args.device, cli::resolved_schema(args));
+  p.decoded = zero_copy ? metrics::decode_instant_vector(*response_doc, args.device,
+                                                         cli::resolved_schema(args))
+                        : metrics::decode_instant_vector(response, args.device,
+                                                         cli::resolved_schema(args));
   for (const std::string& err : p.decoded.errors) {
     log::error("daemon", "Failed to unwrap pod fields: " + err);
   }
@@ -740,26 +804,19 @@ Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
             " series across " + std::to_string(p.decoded.samples.size()) + " unique pods");
   observe_phase("decode", phase_start);
 
-  // Signal-quality watchdog: assess the health of the evidence ITSELF
-  // before trusting a single zero-peak reading. One extra instant query
-  // per cycle (the evidence query), decoded against the candidate set
-  // into per-pod verdicts + a fleet coverage ratio. The phase is observed
-  // every cycle — ~0s with the guard off — so every phase histogram's
-  // _count keeps advancing in lockstep.
+  // Signal phase: wait out the concurrent evidence query, then fold its
+  // verdicts against the candidate set. The phase is observed every cycle
+  // — ~0s with the guard off — so every phase histogram's _count keeps
+  // advancing in lockstep.
   phase_start = std::chrono::steady_clock::now();
-  p.signal_on = args.signal_guard == "on" && !evidence_query.empty();
   if (p.signal_on) {
     const signal::Config scfg = signal_config(args);
-    std::string evidence_raw;
-    json::Value evidence_response = [&] {
-      otlp::Span span("prometheus.evidence_query", &cycle.context());
-      return with_span(span, [&] {
-        return prom_client.instant_query(evidence_query,
-                                         recorder::enabled() ? &evidence_raw : nullptr);
-      });
-    }();
+    if (evidence_thread.joinable()) evidence_thread.join();
+    if (evidence_error) std::rethrow_exception(evidence_error);
     recorder::record_evidence_body(cycle_id, evidence_raw);
-    p.assessment = signal::assess(evidence_response, p.decoded.samples, scfg, cycle_id);
+    p.assessment = zero_copy
+                       ? signal::assess(*evidence_doc, p.decoded.samples, scfg, cycle_id)
+                       : signal::assess(evidence_response, p.decoded.samples, scfg, cycle_id);
     signal::publish(p.assessment, scfg);
     recorder::record_signal(cycle_id, signal::assessment_to_json(p.assessment));
     log::info("daemon", "Signal assessment: " +
@@ -1161,6 +1218,14 @@ int run(const cli::Cli& args) {
               (args.shards == 0 ? " (auto)" : "") + ", cycle overlap " + args.overlap);
   }
 
+  // Shared transport + decode path: set the process-wide defaults BEFORE
+  // any client (k8s, prom, leader) is constructed so every connection in
+  // the process rides the selected mode.
+  h2::set_default_mode(h2::mode_from_string(args.transport));
+  json::set_zero_copy(args.zero_copy_json == "on");
+  log::info("daemon", std::string("Transport: ") + h2::mode_name(h2::default_mode()) +
+            ", zero-copy JSON " + args.zero_copy_json);
+
   // Query built once, reused every cycle (main.rs:280-282).
   std::string query = query::build_idle_query(cli::to_query_args(args));
   log::info("daemon", "Running w/ Query: " + query);
@@ -1216,6 +1281,11 @@ int run(const cli::Cli& args) {
     }
   }();
 
+  // One Prometheus client for the whole run: cycles refresh its bearer
+  // token (prepare_cycle) but reuse its warm multiplexed connection —
+  // warm-cycle connections per endpoint stays ≤ 1 instead of 1 per cycle.
+  prom::Client prom_client = build_prom_client(args);
+
   // Watch-backed cluster cache (--watch-cache=on): LIST each resource once,
   // hold watch streams, serve resolution from the local store. The initial
   // sync wait is best-effort — an unsynced resource just means its lookups
@@ -1252,9 +1322,12 @@ int run(const cli::Cli& args) {
     // series plus the signal watchdog's evidence-health families (the
     // latter render empty until the guard publishes its first
     // assessment — absent, not zero, with --signal-guard off).
+    // ... plus the shared transport's connection/stream counters (the
+    // bench reads connections_opened around a warm cycle from these).
     metrics_server->set_extra_metrics_provider([ledger_top_k](bool openmetrics) {
       return ledger::render_metrics(ledger_top_k, openmetrics) +
-             signal::render_metrics(openmetrics);
+             signal::render_metrics(openmetrics) +
+             h2::render_transport_metrics(openmetrics);
     });
     // Evidence-health snapshot at /debug/signals (`analyze
     // --signal-report` hits this); {"enabled": false} with the guard off.
@@ -1595,14 +1668,15 @@ int run(const cli::Cli& args) {
       if (overlap_on) {
         Prepared prep = prepared_next.valid()
                             ? prepared_next.get()
-                            : prepare_cycle(args, query, evidence_query);
-        prepared_next = std::async(std::launch::async, [&args, &query, &evidence_query] {
-          return prepare_cycle(args, query, evidence_query);
-        });
+                            : prepare_cycle(args, query, evidence_query, &prom_client);
+        prepared_next =
+            std::async(std::launch::async, [&args, &query, &evidence_query, &prom_client] {
+              return prepare_cycle(args, query, evidence_query, &prom_client);
+            });
         stats = finish_cycle(args, std::move(prep), kube, enabled, enqueue, watch_cache.get());
       } else {
-        stats = run_cycle(args, query, kube, enabled, enqueue, watch_cache.get(),
-                          evidence_query);
+        stats = finish_cycle(args, prepare_cycle(args, query, evidence_query, &prom_client),
+                             kube, enabled, enqueue, watch_cache.get());
       }
       consecutive_failures = 0;
       log::counter_add("query_successes", 1);
@@ -1652,6 +1726,10 @@ int run(const cli::Cli& args) {
   drop_prepared();
   queue.close();
   for (std::thread& c : consumers) c.join();
+  // The final drain's record_pause calls may have been throttled into the
+  // ledger's dirty flag — flush so the checkpoint on disk reflects every
+  // actuation that landed before exit.
+  ledger::flush();
   // Targets enqueued but never consumed (close() dropped them) leave
   // pending DecisionRecords — land them with an honest terminal code so
   // the audit trail never silently loses a decision.

@@ -12,6 +12,7 @@
 #include <stdexcept>
 
 #include "tls.hpp"
+#include "tpupruner/h2.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/util.hpp"
 
@@ -421,10 +422,17 @@ std::unique_ptr<Conn> open_fresh_conn(const Url& url, const std::optional<ProxyT
     conn->tls_conn = std::make_unique<tls::Conn>(conn->fd, url.host,
                                                  tls_mode == TlsMode::Verify, ca_file);
   }
+  h2::counters().http1_connections.fetch_add(1, std::memory_order_relaxed);
   return conn;
 }
 
 }  // namespace
+
+int connect_tcp(const std::string& host, int port, int timeout_ms) {
+  return connect_with_timeout(host, port, timeout_ms);
+}
+
+bool proxy_in_use(const Url& url) { return proxy_for(url).has_value(); }
 
 std::optional<Url> parse_url(std::string_view url) {
   Url out;
@@ -506,10 +514,16 @@ Response Client::request(const Request& req) const {
   bool reuse_ok = req.method != "POST";
   try {
     return request_once(req, *url, reuse_ok);
-  } catch (const StaleConnection&) {
+  } catch (const StaleConnection& e) {
     // The pooled connection died between requests (idle timeout on the
-    // server side). No response bytes were received, so a single retry on
-    // a fresh connection is safe for these idempotent methods.
+    // server side — clean FIN or ECONNRESET before any response byte). A
+    // single retry on a fresh connection is safe for these idempotent
+    // methods; surfacing it as a cycle error would turn routine server
+    // idle-timeouts into failure-budget ticks.
+    h2::counters().retries.fetch_add(1, std::memory_order_relaxed);
+    log::debug("http", "retrying " + req.method + " " + url->host + ":" +
+                           std::to_string(url->port) + url->target +
+                           " on a fresh connection (stale keep-alive socket: " + e.what() + ")");
     return request_once(req, *url, /*allow_reuse=*/false);
   }
 }

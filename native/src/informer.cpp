@@ -51,7 +51,24 @@ std::optional<Value> Store::get(const std::string& object_path) const {
   std::lock_guard<std::mutex> lock(mutex_);
   auto it = objects_.find(object_path);
   if (it == objects_.end()) return std::nullopt;
-  return it->second;  // COW copy: shares nodes, pointer-sized
+  Entry& e = it->second;
+  if (e.doc) {
+    // Arena-backed entry: materialize on demand, then MEMOIZE — a warm
+    // cycle re-reads the same candidate pods and owner objects every
+    // interval, and re-building the tree each time put the conversion in
+    // the resolve hot path. Only the objects a cycle touches pay (once);
+    // the other 99k pods stay flat arena nodes. The doc stays referenced
+    // so sibling entries of the same LIST page / watch event are
+    // unaffected.
+    e.value = e.doc->node(e.node).to_value();
+    e.doc.reset();
+  }
+  return e.value;  // COW copy: shares nodes, pointer-sized
+}
+
+bool Store::contains(const std::string& object_path) const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return objects_.count(object_path) > 0;
 }
 
 size_t Store::size() const {
@@ -60,13 +77,26 @@ size_t Store::size() const {
 }
 
 void Store::replace(std::map<std::string, Value> objects) {
+  std::map<std::string, Entry> entries;
+  for (auto& [path, v] : objects) {
+    entries[path].value = std::move(v);
+  }
+  replace_entries(std::move(entries));
+}
+
+void Store::replace_entries(std::map<std::string, Entry> objects) {
   std::lock_guard<std::mutex> lock(mutex_);
   objects_ = std::move(objects);
 }
 
 void Store::upsert(const std::string& object_path, Value object) {
   std::lock_guard<std::mutex> lock(mutex_);
-  objects_[object_path] = std::move(object);
+  objects_[object_path] = Entry{std::move(object), nullptr, 0};
+}
+
+void Store::upsert_doc(const std::string& object_path, json::DocPtr doc, uint32_t node) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  objects_[object_path] = Entry{Value(), std::move(doc), node};
 }
 
 void Store::erase(const std::string& object_path) {
@@ -141,18 +171,23 @@ bool Reflector::request_relist(const std::string& why) {
 }
 
 void Reflector::apply_list(const Value& list) {
-  std::map<std::string, Value> snapshot;
+  std::map<std::string, Store::Entry> snapshot;
   if (const Value* items = list.find("items"); items && items->is_array()) {
     for (const Value& item : items->as_array()) {
       std::string path = object_path_of(item);
-      if (!path.empty()) snapshot[std::move(path)] = item;
+      if (!path.empty()) snapshot[std::move(path)].value = item;
     }
   }
   std::string rv;
   if (const Value* v = list.at_path("metadata.resourceVersion"); v && v->is_string()) {
     rv = v->as_string();
   }
-  store_.replace(std::move(snapshot));
+  apply_list_snapshot(std::move(snapshot), std::move(rv));
+}
+
+void Reflector::apply_list_snapshot(std::map<std::string, Store::Entry> snapshot,
+                                    std::string rv) {
+  store_.replace_entries(std::move(snapshot));
   {
     std::lock_guard<std::mutex> lock(stats_mutex_);
     resource_version_ = rv;
@@ -165,6 +200,14 @@ void Reflector::apply_list(const Value& list) {
   synced_.store(true);
   last_activity_mono_.store(util::mono_secs());
   log::counter_add("informer_relists", 1);
+}
+
+std::string Reflector::object_path_of_doc(const json::Doc::Node& object) const {
+  auto ns = object.at_path("metadata.namespace");
+  auto name = object.at_path("metadata.name");
+  if (!ns || !ns->is_string() || !name || !name->is_string()) return "";
+  return spec_.prefix + "namespaces/" + std::string(ns->as_sv()) + "/" + spec_.plural + "/" +
+         std::string(name->as_sv());
 }
 
 bool Reflector::apply_event(const Value& event) {
@@ -231,6 +274,65 @@ bool Reflector::apply_event(const Value& event) {
   return true;
 }
 
+bool Reflector::apply_event_doc(const json::DocPtr& event) {
+  json::Doc::Node root = event->root();
+  std::string type(root.get_string("type"));
+  std::optional<json::Doc::Node> object = root.find("object");
+
+  if (type == "ERROR") {
+    int64_t code = 0;
+    if (object) {
+      if (auto c = object->find("code"); c && c->is_number()) code = c->as_int();
+    }
+    if (request_relist("ERROR event code " + std::to_string(code))) {
+      log::warn("informer", "watch " + spec_.list_path + " ERROR event (code " +
+                std::to_string(code) + "); relisting");
+    }
+    return false;
+  }
+
+  std::string rv;
+  if (object) {
+    if (auto v = object->at_path("metadata.resourceVersion"); v && v->is_string()) {
+      rv = std::string(v->as_sv());
+    }
+  }
+
+  if (type == "BOOKMARK") {
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    ++stats_.bookmarks;
+    if (!rv.empty()) stats_.resource_version = rv;
+  } else if (type == "ADDED" || type == "MODIFIED") {
+    if (!object) return true;
+    std::string path = object_path_of_doc(*object);
+    if (path.empty()) return true;
+    bool existed = store_.contains(path);
+    // The event Doc rides into the store: the object stays arena-flat
+    // until some cycle actually looks it up.
+    store_.upsert_doc(path, event, object->index());
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    ++(existed ? stats_.updates : stats_.adds);
+    if (!rv.empty()) stats_.resource_version = rv;
+  } else if (type == "DELETED") {
+    if (!object) return true;
+    std::string path = object_path_of_doc(*object);
+    if (path.empty()) return true;
+    store_.erase(path);
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    ++stats_.deletes;
+    if (!rv.empty()) stats_.resource_version = rv;
+  } else {
+    log::debug("informer", "ignoring unknown watch event type: " + type);
+    return true;
+  }
+  if (!rv.empty()) {
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    resource_version_ = rv;
+  }
+  last_activity_mono_.store(util::mono_secs());
+  return true;
+}
+
 namespace {
 
 // Stop-responsive jittered sleep: exponential base capped at 10 s, plus a
@@ -251,14 +353,36 @@ void backoff_sleep(const std::string& path, int attempt, const std::atomic<bool>
 
 void Reflector::run() {
   int list_failures = 0;
+  // Latched once per reflector lifetime: flipping the process-wide toggle
+  // mid-watch must not mix decode paths within one stream.
+  const bool zero_copy = json::zero_copy_enabled();
   while (!stop_.load()) {
-    Value list;
     try {
       // Paginated initial LIST (limit/continue): a 100k-pod cluster
       // arrives in kListPageLimit-object chunks instead of one giant
       // response the apiserver (or this process) has to materialize at
       // once — the same chunking client-go's pager applies.
-      list = kube_.list(spec_.list_path, "", kListPageLimit);
+      if (zero_copy) {
+        // Zero-copy: each page body becomes an arena Doc; the snapshot
+        // holds (page, node) references and the pods stay un-materialized
+        // until a cycle looks them up.
+        std::map<std::string, Store::Entry> snapshot;
+        std::string rv =
+            kube_.list_pages(spec_.list_path, "", kListPageLimit, [&](const json::DocPtr& page) {
+              auto items = page->root().find("items");
+              if (!items || !items->is_array()) return;
+              json::Doc::Node item = items->first_child();
+              for (size_t i = 0; i < items->size(); ++i, item = item.next_sibling()) {
+                std::string path = object_path_of_doc(item);
+                if (!path.empty()) {
+                  snapshot[std::move(path)] = Store::Entry{Value(), page, item.index()};
+                }
+              }
+            });
+        apply_list_snapshot(std::move(snapshot), std::move(rv));
+      } else {
+        apply_list(kube_.list(spec_.list_path, "", kListPageLimit));
+      }
     } catch (const std::exception& e) {
       synced_.store(false);
       log::warn("informer", "LIST " + spec_.list_path + " failed: " + std::string(e.what()));
@@ -266,7 +390,6 @@ void Reflector::run() {
       continue;
     }
     list_failures = 0;
-    apply_list(list);
     log::debug("informer", "synced " + spec_.list_path + " (" +
                std::to_string(store_.size()) + " objects at rv " + resource_version() + ")");
 
@@ -277,14 +400,25 @@ void Reflector::run() {
       wopts.resource_version = resource_version();
       wopts.abort = [this] { return stop_.load(); };
       try {
-        kube_.watch(spec_.list_path, wopts, [&](const Value& ev) {
-          if (!apply_event(ev)) {
-            relist = true;
-            return false;
-          }
-          watch_failures = 0;
-          return !stop_.load();
-        });
+        if (zero_copy) {
+          kube_.watch_doc(spec_.list_path, wopts, [&](const json::DocPtr& ev) {
+            if (!apply_event_doc(ev)) {
+              relist = true;
+              return false;
+            }
+            watch_failures = 0;
+            return !stop_.load();
+          });
+        } else {
+          kube_.watch(spec_.list_path, wopts, [&](const Value& ev) {
+            if (!apply_event(ev)) {
+              relist = true;
+              return false;
+            }
+            watch_failures = 0;
+            return !stop_.load();
+          });
+        }
         // Clean server close: routine — re-watch from the last seen rv.
       } catch (const k8s::ApiError& e) {
         if (e.status == 410) {

@@ -1,11 +1,26 @@
 #include "tpupruner/json.hpp"
 
 #include <array>
+#include <atomic>
 #include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 
 namespace tpupruner::json {
+
+namespace {
+std::atomic<bool>& zero_copy_slot() {
+  static std::atomic<bool> slot{[] {
+    const char* v = std::getenv("TPU_PRUNER_ZERO_COPY_JSON");
+    return !(v && std::string_view(v) == "off");
+  }()};
+  return slot;
+}
+}  // namespace
+
+bool zero_copy_enabled() { return zero_copy_slot().load(std::memory_order_relaxed); }
+void set_zero_copy(bool on) { zero_copy_slot().store(on, std::memory_order_relaxed); }
 
 namespace {
 
@@ -369,6 +384,409 @@ Value Value::parse(std::string_view text) {
   p.skip_ws();
   if (!p.eof()) throw ParseError("trailing characters", p.pos);
   return v;
+}
+
+// ── arena / zero-copy document ──────────────────────────────────────────
+
+// Mirror of Parser above emitting flat arena nodes instead of Values.
+// Grammar, depth limit, and error messages/offsets must stay IDENTICAL —
+// the decode-parity corpus tests compare both paths on valid AND invalid
+// bodies, and the flight-recorder replay re-decodes capsule bytes through
+// whichever path the daemon recorded with.
+struct DocParser {
+  std::string_view text;
+  std::string& decoded;
+  std::vector<Doc::Rep>& nodes;
+  size_t pos = 0;
+
+  [[noreturn]] void fail(const std::string& msg) { throw ParseError(msg, pos); }
+
+  char peek() {
+    if (pos >= text.size()) fail("unexpected end of input");
+    return text[pos];
+  }
+  char next() {
+    char c = peek();
+    ++pos;
+    return c;
+  }
+  bool eof() const { return pos >= text.size(); }
+
+  void skip_ws() {
+    while (pos < text.size()) {
+      char c = text[pos];
+      if (c == ' ' || c == '\t' || c == '\n' || c == '\r') {
+        ++pos;
+      } else {
+        break;
+      }
+    }
+  }
+
+  void expect_lit(std::string_view lit) {
+    if (text.substr(pos, lit.size()) != lit) fail("invalid literal");
+    pos += lit.size();
+  }
+
+  uint32_t new_node(Type t) {
+    nodes.emplace_back();
+    nodes.back().type = t;
+    return static_cast<uint32_t>(nodes.size() - 1);
+  }
+
+  uint32_t parse_value(int depth) {
+    if (depth > 256) fail("nesting too deep");
+    skip_ws();
+    char c = peek();
+    switch (c) {
+      case '{': return parse_object(depth);
+      case '[': return parse_array(depth);
+      case '"': {
+        uint32_t n = new_node(Type::String);
+        parse_string(nodes[n].str_off, nodes[n].str_len, nodes[n].str_decoded);
+        nodes[n].end = static_cast<uint32_t>(nodes.size());
+        return n;
+      }
+      case 't': {
+        expect_lit("true");
+        uint32_t n = new_node(Type::Bool);
+        nodes[n].b = true;
+        nodes[n].end = static_cast<uint32_t>(nodes.size());
+        return n;
+      }
+      case 'f': {
+        expect_lit("false");
+        uint32_t n = new_node(Type::Bool);
+        nodes[n].b = false;
+        nodes[n].end = static_cast<uint32_t>(nodes.size());
+        return n;
+      }
+      case 'n': {
+        expect_lit("null");
+        uint32_t n = new_node(Type::Null);
+        nodes[n].end = static_cast<uint32_t>(nodes.size());
+        return n;
+      }
+      default: return parse_number();
+    }
+  }
+
+  uint32_t parse_object(int depth) {
+    next();  // '{'
+    uint32_t n = new_node(Type::Object);
+    skip_ws();
+    if (peek() == '}') {
+      next();
+      nodes[n].end = static_cast<uint32_t>(nodes.size());
+      return n;
+    }
+    uint32_t count = 0;
+    while (true) {
+      skip_ws();
+      if (peek() != '"') fail("expected object key");
+      uint32_t key_off = 0, key_len = 0;
+      bool key_decoded = false;
+      parse_string(key_off, key_len, key_decoded);
+      skip_ws();
+      if (next() != ':') fail("expected ':'");
+      uint32_t child = parse_value(depth + 1);
+      nodes[child].key_off = key_off;
+      nodes[child].key_len = key_len;
+      nodes[child].key_decoded = key_decoded;
+      nodes[child].has_key = true;
+      ++count;
+      skip_ws();
+      char c = next();
+      if (c == '}') break;
+      if (c != ',') fail("expected ',' or '}'");
+    }
+    nodes[n].count = count;
+    nodes[n].end = static_cast<uint32_t>(nodes.size());
+    return n;
+  }
+
+  uint32_t parse_array(int depth) {
+    next();  // '['
+    uint32_t n = new_node(Type::Array);
+    skip_ws();
+    if (peek() == ']') {
+      next();
+      nodes[n].end = static_cast<uint32_t>(nodes.size());
+      return n;
+    }
+    uint32_t count = 0;
+    while (true) {
+      parse_value(depth + 1);
+      ++count;
+      skip_ws();
+      char c = next();
+      if (c == ']') break;
+      if (c != ',') fail("expected ',' or ']'");
+    }
+    nodes[n].count = count;
+    nodes[n].end = static_cast<uint32_t>(nodes.size());
+    return n;
+  }
+
+  // The zero-copy core: a string without escapes is a VIEW into the body
+  // (the overwhelmingly common case for pod JSON and PromQL label values);
+  // only escaped strings decode — once — into the shared side arena.
+  void parse_string(uint32_t& off, uint32_t& len, bool& is_decoded) {
+    next();  // '"'
+    size_t start = pos;
+    // Fast scan to the closing quote or the first escape/control byte.
+    while (pos < text.size()) {
+      unsigned char c = static_cast<unsigned char>(text[pos]);
+      if (c == '"') {
+        off = static_cast<uint32_t>(start);
+        len = static_cast<uint32_t>(pos - start);
+        is_decoded = false;
+        ++pos;
+        return;
+      }
+      if (c == '\\' || c < 0x20) break;
+      ++pos;
+    }
+    // Slow path: decode into the arena (same escape rules as Parser).
+    size_t dstart = decoded.size();
+    decoded.append(text.data() + start, pos - start);
+    while (true) {
+      char c = next();
+      if (c == '"') break;
+      if (static_cast<unsigned char>(c) < 0x20) fail("control character in string");
+      if (c != '\\') {
+        decoded.push_back(c);
+        continue;
+      }
+      char esc = next();
+      switch (esc) {
+        case '"': decoded.push_back('"'); break;
+        case '\\': decoded.push_back('\\'); break;
+        case '/': decoded.push_back('/'); break;
+        case 'b': decoded.push_back('\b'); break;
+        case 'f': decoded.push_back('\f'); break;
+        case 'n': decoded.push_back('\n'); break;
+        case 'r': decoded.push_back('\r'); break;
+        case 't': decoded.push_back('\t'); break;
+        case 'u': {
+          unsigned cp = parse_hex4();
+          if (cp >= 0xDC00 && cp <= 0xDFFF) fail("unpaired low surrogate");
+          if (cp >= 0xD800 && cp <= 0xDBFF) {
+            if (pos + 1 < text.size() && text[pos] == '\\' && text[pos + 1] == 'u') {
+              pos += 2;
+              unsigned lo = parse_hex4();
+              if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              } else {
+                fail("invalid low surrogate");
+              }
+            } else {
+              fail("unpaired surrogate");
+            }
+          }
+          Parser::append_utf8(decoded, cp);
+          break;
+        }
+        default: fail("invalid escape");
+      }
+    }
+    off = static_cast<uint32_t>(dstart);
+    len = static_cast<uint32_t>(decoded.size() - dstart);
+    is_decoded = true;
+  }
+
+  unsigned parse_hex4() {
+    unsigned v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = next();
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= static_cast<unsigned>(c - '0');
+      else if (c >= 'a' && c <= 'f') v |= static_cast<unsigned>(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') v |= static_cast<unsigned>(c - 'A' + 10);
+      else fail("invalid \\u escape");
+    }
+    return v;
+  }
+
+  uint32_t parse_number() {
+    size_t start = pos;
+    auto digits = [&]() {
+      size_t n = 0;
+      while (!eof() && isdigit(static_cast<unsigned char>(text[pos]))) ++pos, ++n;
+      return n;
+    };
+    if (!eof() && text[pos] == '-') ++pos;
+    if (eof() || !isdigit(static_cast<unsigned char>(text[pos]))) fail("bad number");
+    if (text[pos] == '0') {
+      ++pos;
+      if (!eof() && isdigit(static_cast<unsigned char>(text[pos]))) fail("leading zero");
+    } else {
+      digits();
+    }
+    bool is_double = false;
+    if (!eof() && text[pos] == '.') {
+      is_double = true;
+      ++pos;
+      if (digits() == 0) fail("digits required after '.'");
+    }
+    if (!eof() && (text[pos] == 'e' || text[pos] == 'E')) {
+      is_double = true;
+      ++pos;
+      if (!eof() && (text[pos] == '+' || text[pos] == '-')) ++pos;
+      if (digits() == 0) fail("digits required in exponent");
+    }
+    std::string num(text.substr(start, pos - start));
+    // Resolve the value BEFORE allocating the node: std::stoll's
+    // out-of-range fallback to double must not leave an orphan arena slot.
+    try {
+      if (!is_double) {
+        try {
+          int64_t iv = static_cast<int64_t>(std::stoll(num));
+          uint32_t n = new_node(Type::Int);
+          nodes[n].i = iv;
+          nodes[n].end = static_cast<uint32_t>(nodes.size());
+          return n;
+        } catch (const std::out_of_range&) {
+          // magnitude exceeds int64 — fall through to double
+        }
+      }
+      double dv = std::stod(num);
+      uint32_t n = new_node(Type::Double);
+      nodes[n].d = dv;
+      nodes[n].end = static_cast<uint32_t>(nodes.size());
+      return n;
+    } catch (const std::exception&) {
+      fail("bad number");
+    }
+  }
+};
+
+DocPtr Doc::parse(std::string body) {
+  auto doc = std::make_shared<Doc>();
+  doc->body_ = std::move(body);
+  // ~16 bytes of JSON per node is a good prior for K8s/Prometheus bodies;
+  // one up-front reserve keeps arena growth off the hot path.
+  doc->nodes_.reserve(doc->body_.size() / 16 + 4);
+  DocParser p{doc->body_, doc->decoded_, doc->nodes_};
+  p.parse_value(0);
+  p.skip_ws();
+  if (!p.eof()) throw ParseError("trailing characters", p.pos);
+  return doc;
+}
+
+Type Doc::Node::type() const { return doc_->nodes_[idx_].type; }
+
+bool Doc::Node::as_bool() const {
+  const Rep& r = doc_->nodes_[idx_];
+  if (r.type != Type::Bool) throw std::runtime_error("json: wrong type access");
+  return r.b;
+}
+
+int64_t Doc::Node::as_int() const {
+  const Rep& r = doc_->nodes_[idx_];
+  if (r.type == Type::Double) return static_cast<int64_t>(r.d);
+  if (r.type != Type::Int) throw std::runtime_error("json: wrong type access");
+  return r.i;
+}
+
+double Doc::Node::as_double() const {
+  const Rep& r = doc_->nodes_[idx_];
+  if (r.type == Type::Int) return static_cast<double>(r.i);
+  if (r.type != Type::Double) throw std::runtime_error("json: wrong type access");
+  return r.d;
+}
+
+std::string_view Doc::Node::as_sv() const {
+  const Rep& r = doc_->nodes_[idx_];
+  if (r.type != Type::String) throw std::runtime_error("json: wrong type access");
+  return doc_->str_of(r);
+}
+
+size_t Doc::Node::size() const { return doc_->nodes_[idx_].count; }
+
+Doc::Node Doc::Node::next_sibling() const { return Node(doc_, doc_->nodes_[idx_].end); }
+
+std::string_view Doc::Node::key() const {
+  const Rep& r = doc_->nodes_[idx_];
+  return r.has_key ? doc_->key_of(r) : std::string_view();
+}
+
+Doc::Node Doc::Node::child(size_t i) const {
+  const Rep& r = doc_->nodes_[idx_];
+  uint32_t c = idx_ + 1;
+  for (size_t k = 0; k < i; ++k) c = doc_->nodes_[c].end;
+  (void)r;
+  return Node(doc_, c);
+}
+
+std::pair<std::string_view, Doc::Node> Doc::Node::member(size_t i) const {
+  Node c = child(i);
+  return {doc_->key_of(doc_->nodes_[c.idx_]), c};
+}
+
+std::optional<Doc::Node> Doc::Node::find(std::string_view key) const {
+  const Rep& r = doc_->nodes_[idx_];
+  if (r.type != Type::Object) return std::nullopt;
+  std::optional<Node> found;
+  uint32_t c = idx_ + 1;
+  for (uint32_t k = 0; k < r.count; ++k) {
+    if (doc_->key_of(doc_->nodes_[c]) == key) found = Node(doc_, c);
+    c = doc_->nodes_[c].end;
+  }
+  return found;
+}
+
+std::optional<Doc::Node> Doc::Node::at_path(std::string_view path) const {
+  std::optional<Node> cur = *this;
+  size_t start = 0;
+  while (start <= path.size()) {
+    size_t dot = path.find('.', start);
+    std::string_view key =
+        dot == std::string_view::npos ? path.substr(start) : path.substr(start, dot - start);
+    cur = cur->find(key);
+    if (!cur) return std::nullopt;
+    if (dot == std::string_view::npos) break;
+    start = dot + 1;
+  }
+  return cur;
+}
+
+std::string_view Doc::Node::get_string(std::string_view key, std::string_view fallback) const {
+  std::optional<Node> v = find(key);
+  return (v && v->is_string()) ? v->as_sv() : fallback;
+}
+
+Value Doc::Node::to_value() const {
+  const Rep& r = doc_->nodes_[idx_];
+  switch (r.type) {
+    case Type::Null: return Value(nullptr);
+    case Type::Bool: return Value(r.b);
+    case Type::Int: return Value(r.i);
+    case Type::Double: return Value(r.d);
+    case Type::String: return Value(doc_->str_of(r));
+    case Type::Array: {
+      Array arr;
+      arr.reserve(r.count);
+      uint32_t c = idx_ + 1;
+      for (uint32_t k = 0; k < r.count; ++k) {
+        arr.push_back(Node(doc_, c).to_value());
+        c = doc_->nodes_[c].end;
+      }
+      return Value(std::move(arr));
+    }
+    case Type::Object: {
+      Object obj;
+      uint32_t c = idx_ + 1;
+      for (uint32_t k = 0; k < r.count; ++k) {
+        // operator[] assignment: duplicate keys resolve last-wins, exactly
+        // like Parser::parse_object.
+        obj[std::string(doc_->key_of(doc_->nodes_[c]))] = Node(doc_, c).to_value();
+        c = doc_->nodes_[c].end;
+      }
+      return Value(std::move(obj));
+    }
+  }
+  return Value();
 }
 
 }  // namespace tpupruner::json

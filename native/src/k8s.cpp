@@ -60,11 +60,13 @@ Config Config::infer() {
 
 Client::Client(Config config)
     : config_(std::move(config)),
-      http_(config_.tls_skip ? http::TlsMode::Skip : http::TlsMode::Verify, config_.ca_file) {}
+      http_(h2::default_mode(),
+            config_.tls_skip ? http::TlsMode::Skip : http::TlsMode::Verify, config_.ca_file) {}
 
 json::Value Client::request_json(const std::string& method, const std::string& path,
                                  const std::string& body, const std::string& content_type,
-                                 int* status_out, bool retry_throttle) const {
+                                 int* status_out, bool retry_throttle,
+                                 json::DocPtr* doc_out) const {
   api_calls_.fetch_add(1, std::memory_order_relaxed);
   http::Request req;
   req.method = method;
@@ -129,8 +131,17 @@ json::Value Client::request_json(const std::string& method, const std::string& p
   }
   if (status_out) *status_out = resp.status;
   if (resp.status >= 200 && resp.status < 300) {
-    if (resp.body.empty()) return json::Value::object();
+    if (resp.body.empty()) {
+      if (doc_out) *doc_out = json::Doc::parse("{}");
+      return json::Value::object();
+    }
     try {
+      if (doc_out) {
+        // Zero-copy delivery: the response body MOVES into the Doc and the
+        // arena nodes view into it; no Value tree is built here at all.
+        *doc_out = json::Doc::parse(std::move(resp.body));
+        return json::Value();
+      }
       return json::Value::parse(resp.body);
     } catch (const json::ParseError& e) {
       throw std::runtime_error("k8s: unparseable response body from " + path + ": " + e.what());
@@ -227,6 +238,44 @@ json::Value Client::list(const std::string& path, const std::string& label_selec
                            std::to_string(kMaxPages) + " continue pages");
 }
 
+std::string Client::list_pages(const std::string& path, const std::string& label_selector,
+                               int64_t limit,
+                               const std::function<void(const json::DocPtr&)>& on_page) const {
+  std::string base_query;
+  if (!label_selector.empty()) base_query = "labelSelector=" + util::url_encode(label_selector);
+  if (limit > 0) {
+    if (!base_query.empty()) base_query += "&";
+    base_query += "limit=" + std::to_string(limit);
+  }
+  std::string rv;
+  std::string continue_token;
+  constexpr int kMaxPages = 1000;  // same runaway-server guard as list()
+  for (int page = 0; page < kMaxPages; ++page) {
+    std::string query = base_query;
+    if (!continue_token.empty()) {
+      if (!query.empty()) query += "&";
+      query += "continue=" + util::url_encode(continue_token);
+    }
+    json::DocPtr doc;
+    request_json("GET", query.empty() ? path : path + "?" + query, "", "", nullptr,
+                 /*retry_throttle=*/true, &doc);
+    std::string next;
+    if (auto meta = doc->root().find("metadata"); meta && meta->is_object()) {
+      if (auto c = meta->find("continue"); c && c->is_string()) next = c->as_string();
+      if (auto v = meta->find("resourceVersion"); v && v->is_string()) {
+        // Last page's version wins — the newest legal watch resume point,
+        // same rule as list()'s metadata carry.
+        rv = v->as_string();
+      }
+    }
+    on_page(doc);
+    if (next.empty()) return rv;
+    continue_token = next;
+  }
+  throw std::runtime_error("k8s: LIST " + path + " did not terminate after " +
+                           std::to_string(kMaxPages) + " continue pages");
+}
+
 json::Value Client::patch_merge(const std::string& path, const json::Value& body,
                                 bool retry_throttle) const {
   // fieldValidation=Strict (server-side field validation, K8s >= 1.25):
@@ -246,6 +295,32 @@ json::Value Client::post(const std::string& path, const json::Value& body,
 
 void Client::watch(const std::string& path, const WatchOptions& opts,
                    const std::function<bool(const json::Value&)>& on_event) const {
+  watch_impl(path, opts, [&](std::string_view line) {
+    json::Value event;
+    try {
+      event = json::Value::parse(line);
+    } catch (const json::ParseError& e) {
+      throw std::runtime_error(std::string("k8s: unparseable watch event: ") + e.what());
+    }
+    return on_event(event);
+  });
+}
+
+void Client::watch_doc(const std::string& path, const WatchOptions& opts,
+                       const std::function<bool(const json::DocPtr&)>& on_event) const {
+  watch_impl(path, opts, [&](std::string_view line) {
+    json::DocPtr event;
+    try {
+      event = json::Doc::parse(std::string(line));
+    } catch (const json::ParseError& e) {
+      throw std::runtime_error(std::string("k8s: unparseable watch event: ") + e.what());
+    }
+    return on_event(event);
+  });
+}
+
+void Client::watch_impl(const std::string& path, const WatchOptions& opts,
+                        const std::function<bool(std::string_view)>& on_line) const {
   api_calls_.fetch_add(1, std::memory_order_relaxed);
   std::string query = "watch=true";
   if (!opts.resource_version.empty())
@@ -281,13 +356,7 @@ void Client::watch(const std::string& path, const WatchOptions& opts,
           std::string_view line(pending.data() + start, nl - start);
           start = nl + 1;
           if (util::trim(line).empty()) continue;
-          json::Value event;
-          try {
-            event = json::Value::parse(line);
-          } catch (const json::ParseError& e) {
-            throw std::runtime_error(std::string("k8s: unparseable watch event: ") + e.what());
-          }
-          if (!on_event(event)) {
+          if (!on_line(line)) {
             pending.clear();
             return false;
           }

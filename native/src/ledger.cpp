@@ -1,6 +1,7 @@
 #include "tpupruner/ledger.hpp"
 
 #include <algorithm>
+#include <chrono>
 #include <cmath>
 #include <cstdio>
 #include <deque>
@@ -68,6 +69,18 @@ struct Registry {
   // use it to pick the fresher of two checkpoints claiming the same
   // cluster.
   uint64_t epoch = 0;
+  // Checkpoint amortization: record_pause/right_size/resume land once per
+  // TARGET, and each checkpoint rewrites one line per ACCOUNT — a
+  // fleet-scale reclaim cycle (thousands of pauses against thousands of
+  // accounts) made eager per-record rewrites O(n^2): ~90 s of pure
+  // serialization in the actuation drain at 3.7k roots, stretching the
+  // cycle past any sane --check-interval. Throttled instead: a record_*
+  // rewrite runs at most once per second; skipped writes set `dirty` and
+  // are flushed by the next observe_cycle (every cycle checkpoints
+  // unconditionally) or by ledger::flush() at daemon shutdown — the
+  // durability loss window is <=1 s of telemetry on a kill -9.
+  std::chrono::steady_clock::time_point last_checkpoint{};
+  bool dirty = false;
 };
 
 Registry& reg() {
@@ -151,6 +164,19 @@ void checkpoint_locked(Registry& r) {
     std::remove(tmp.c_str());
     log::warn("ledger", "ledger checkpoint write failed; disabling --ledger-file sink");
     r.file_path.clear();
+  }
+  r.last_checkpoint = std::chrono::steady_clock::now();
+  r.dirty = false;
+}
+
+// Throttled sibling for the per-target record_* paths (see Registry):
+// rewrite at most once per second, mark dirty otherwise.
+void maybe_checkpoint_locked(Registry& r) {
+  if (r.file_path.empty()) return;
+  if (std::chrono::steady_clock::now() - r.last_checkpoint >= std::chrono::seconds(1)) {
+    checkpoint_locked(r);
+  } else {
+    r.dirty = true;
   }
 }
 
@@ -316,7 +342,7 @@ void record_pause(uint64_t cycle, const std::string& kind, const std::string& ns
     a.paused_since_unix = util::now_unix();
     ++a.pauses;
     push_event_locked(a, {cycle, a.paused_since_unix, "paused", reason, "tpu-pruner"});
-    checkpoint_locked(r);
+    maybe_checkpoint_locked(r);
     return;
   }
   a.paused = true;
@@ -324,7 +350,7 @@ void record_pause(uint64_t cycle, const std::string& kind, const std::string& ns
   a.chips_when_paused = a.chips;
   ++a.pauses;
   push_event_locked(a, {cycle, a.paused_since_unix, "paused", reason, "tpu-pruner"});
-  checkpoint_locked(r);
+  maybe_checkpoint_locked(r);
 }
 
 void record_right_size(uint64_t cycle, const std::string& kind, const std::string& ns,
@@ -351,7 +377,7 @@ void record_right_size(uint64_t cycle, const std::string& kind, const std::strin
   }
   ++a.pauses;
   push_event_locked(a, {cycle, now, "right_sized", "RIGHT_SIZED", "tpu-pruner"});
-  checkpoint_locked(r);
+  maybe_checkpoint_locked(r);
 }
 
 void record_resume(uint64_t cycle, const std::string& kind, const std::string& ns,
@@ -366,7 +392,13 @@ void record_resume(uint64_t cycle, const std::string& kind, const std::string& n
   a.paused_since_unix = 0;
   ++a.resumes;
   push_event_locked(a, {cycle, util::now_unix(), "resumed", "", actor});
-  checkpoint_locked(r);
+  maybe_checkpoint_locked(r);
+}
+
+void flush() {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  if (r.dirty) checkpoint_locked(r);
 }
 
 std::vector<PausedRoot> paused_roots() {

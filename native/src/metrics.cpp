@@ -110,4 +110,102 @@ DecodeResult decode_instant_vector(const json::Value& response, const std::strin
   return out;
 }
 
+namespace {
+
+// Doc twin of label(): exported_*/native fallback chain over arena nodes.
+std::optional<std::string_view> label_doc(const json::Doc::Node& metric,
+                                          std::string_view exported, std::string_view native) {
+  if (auto v = metric.find(exported); v && v->is_string()) return v->as_sv();
+  if (auto v = metric.find(native); v && v->is_string()) return v->as_sv();
+  return std::nullopt;
+}
+
+}  // namespace
+
+DecodeResult decode_instant_vector(const json::Doc& response, const std::string& device,
+                                   const std::string& schema) {
+  if (schema != "gmp" && schema != "gke-system") {
+    throw std::runtime_error("unknown metric schema: " + schema + " (expected gmp|gke-system)");
+  }
+  json::Doc::Node root = response.root();
+  auto status = root.find("status");
+  if (!status || !status->is_string() || status->as_sv() != "success") {
+    std::string err(root.get_string("error", "unknown error"));
+    throw std::runtime_error("prometheus query failed: " + err);
+  }
+  auto rtype = root.at_path("data.resultType");
+  if (!rtype || !rtype->is_string() || rtype->as_sv() != "vector") {
+    throw std::runtime_error("expected vector response from prometheus");
+  }
+  auto result = root.at_path("data.result");
+  if (!result || !result->is_array()) {
+    throw std::runtime_error("malformed vector response: missing data.result");
+  }
+
+  DecodeResult out;
+  out.num_series = result->size();
+  std::unordered_set<std::string> seen;
+
+  json::Doc::Node series = result->first_child();
+  for (size_t i = 0; i < result->size(); ++i, series = series.next_sibling()) {
+    auto metric = series.find("metric");
+    if (!metric || !metric->is_object()) {
+      out.errors.push_back("series missing metric labels");
+      continue;
+    }
+    auto pod = label_doc(*metric, "exported_pod", "pod");
+    if (!pod) {
+      out.errors.push_back("the data for key `exported_pod/pod` is not available");
+      continue;
+    }
+    auto ns = label_doc(*metric, "exported_namespace", "namespace");
+    if (!ns) {
+      out.errors.push_back("the data for key `exported_namespace/namespace` is not available");
+      continue;
+    }
+    auto container = label_doc(*metric, "exported_container", "container");
+    if (!container && schema != "gke-system") {
+      out.errors.push_back("the data for key `exported_container/container` is not available");
+      continue;
+    }
+
+    core::PodMetricSample sample;
+    sample.name = std::string(*pod);
+    sample.ns = std::string(*ns);
+    sample.container = container ? std::string(*container) : "unknown";
+    sample.node_type =
+        std::string(metric->get_string("node_type", metric->get_string("model", "unknown")));
+
+    if (device == "gpu") {
+      auto model = metric->find("modelName");
+      if (!model || !model->is_string()) {
+        out.errors.push_back("the data for key `modelName` is not available");
+        continue;
+      }
+      sample.accelerator = std::string(model->as_sv());
+    } else {
+      sample.accelerator = std::string(
+          metric->get_string("accelerator_type", metric->get_string("model", "unknown")));
+    }
+
+    auto value = series.find("value");
+    if (!value || !value->is_array() || value->size() != 2) {
+      out.errors.push_back("series missing sample value");
+      continue;
+    }
+    json::Doc::Node v = value->child(1);
+    try {
+      sample.value = v.is_string() ? std::stod(std::string(v.as_sv())) : v.as_double();
+    } catch (const std::exception&) {
+      out.errors.push_back("unparseable sample value for pod " + sample.name);
+      continue;
+    }
+
+    if (seen.insert(sample.ns + "/" + sample.name).second) {
+      out.samples.push_back(std::move(sample));
+    }
+  }
+  return out;
+}
+
 }  // namespace tpupruner::metrics

@@ -17,6 +17,7 @@
 #include <memory>
 
 #include "tls.hpp"
+#include "tpupruner/h2.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/util.hpp"
 
@@ -194,13 +195,31 @@ std::string encode_traces_request(const std::vector<otlp::FinishedSpan>& spans) 
 }
 
 // ── minimal HTTP/2 / gRPC client ────────────────────────────────────────
+//
+// Wire primitives (frame headers, HPACK literal encode, HPACK + huffman
+// decode) moved to the shared h2 transport layer (h2.hpp) so the gRPC
+// exporter and the daemon's multiplexing client speak from ONE copy of
+// the RFC 7540/7541 tables; this file keeps only the gRPC-specific
+// single-stream state machine (preface, stream 1, trailers-as-status).
 namespace {
 
-constexpr uint8_t kFrameData = 0x0, kFrameHeaders = 0x1, kFrameRst = 0x3,
-                  kFrameSettings = 0x4, kFramePing = 0x6, kFrameGoaway = 0x7,
-                  kFrameWindowUpdate = 0x8, kFrameContinuation = 0x9;
-constexpr uint8_t kFlagEndStream = 0x1, kFlagAck = 0x1, kFlagEndHeaders = 0x4,
-                  kFlagPadded = 0x8, kFlagPriority = 0x20;
+using h2::kFrameData;
+using h2::kFrameHeaders;
+using h2::kFrameRst;
+using h2::kFrameSettings;
+using h2::kFramePing;
+using h2::kFrameGoaway;
+using h2::kFrameWindowUpdate;
+using h2::kFrameContinuation;
+using h2::kFlagEndStream;
+using h2::kFlagAck;
+using h2::kFlagEndHeaders;
+using h2::kFlagPadded;
+using h2::kFlagPriority;
+using h2::frame_header;
+using h2::hpack_literal;
+using h2::hpack_decode;
+using h2::Header;
 
 // Near-twin of http.cpp's detail::Conn (fd + optional TLS session), kept
 // separate deliberately: that one classifies EAGAIN as a typed timeout
@@ -272,278 +291,10 @@ int dial(const std::string& host, int port, int timeout_ms) {
   return fd;
 }
 
-std::string frame_header(size_t len, uint8_t type, uint8_t flags, uint32_t stream) {
-  std::string h(9, '\0');
-  h[0] = static_cast<char>((len >> 16) & 0xff);
-  h[1] = static_cast<char>((len >> 8) & 0xff);
-  h[2] = static_cast<char>(len & 0xff);
-  h[3] = static_cast<char>(type);
-  h[4] = static_cast<char>(flags);
-  h[5] = static_cast<char>((stream >> 24) & 0x7f);
-  h[6] = static_cast<char>((stream >> 16) & 0xff);
-  h[7] = static_cast<char>((stream >> 8) & 0xff);
-  h[8] = static_cast<char>(stream & 0xff);
-  return h;
-}
-
-// HPACK "literal header field without indexing — new name", both strings
-// raw (huffman bit 0). Always legal regardless of table state (RFC 7541
-// §6.2.2); names must already be lowercase.
-void hpack_literal(std::string& out, std::string_view name, std::string_view value) {
-  auto put_str = [&](std::string_view s) {
-    // 7-bit prefix integer, H bit 0
-    if (s.size() < 127) {
-      out.push_back(static_cast<char>(s.size()));
-    } else {
-      out.push_back(0x7f);
-      uint64_t rest = s.size() - 127;
-      while (rest >= 0x80) {
-        out.push_back(static_cast<char>((rest & 0x7f) | 0x80));
-        rest >>= 7;
-      }
-      out.push_back(static_cast<char>(rest));
-    }
-    out.append(s.data(), s.size());
-  };
-  out.push_back(0x00);
-  put_str(name);
-  put_str(value);
-}
-
-// HPACK static table (RFC 7541 appendix A), names only; the handful of
-// entries with fixed values carry them.
-const char* kStaticNames[62] = {
-    nullptr, ":authority", ":method", ":method", ":path", ":path", ":scheme",
-    ":scheme", ":status", ":status", ":status", ":status", ":status", ":status",
-    ":status", "accept-charset", "accept-encoding", "accept-language",
-    "accept-ranges", "accept", "access-control-allow-origin", "age", "allow",
-    "authorization", "cache-control", "content-disposition", "content-encoding",
-    "content-language", "content-length", "content-location", "content-range",
-    "content-type", "cookie", "date", "etag", "expect", "expires", "from",
-    "host", "if-match", "if-modified-since", "if-none-match", "if-range",
-    "if-unmodified-since", "last-modified", "link", "location", "max-forwards",
-    "proxy-authenticate", "proxy-authorization", "range", "referer", "refresh",
-    "retry-after", "server", "set-cookie", "strict-transport-security",
-    "transfer-encoding", "user-agent", "vary", "via", "www-authenticate"};
-const char* kStaticValues[62] = {
-    nullptr, "", "GET", "POST", "/", "/index.html", "http", "https", "200",
-    "204", "206", "304", "400", "404", "500", "", "gzip, deflate", "", "", "",
-    "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "",
-    "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "", "",
-    "", "", "", "", "", ""};
-
-// ── HPACK huffman decoding (RFC 7541 §5.2, appendix B) ──────────────────
-// Real gRPC servers huffman-code literal trailer NAMES: grpc-go emits
-// "grpc-status" as ~8 huffman bytes vs 11 raw, so reading the status
-// verbatim requires an actual decoder — opaque-flagging the string made
-// every successful export against otel-collector log as "no grpc-status
-// in trailers" (round-4 advisor finding). Codes are the canonical RFC
-// 7541 appendix B table, one (code, bit-length) pair per symbol 0..255
-// plus EOS=256; decode walks a binary tree built from it once.
-const uint32_t kHuffCodes[257] = {
-    0x1ff8,    0x7fffd8,  0xfffffe2, 0xfffffe3, 0xfffffe4, 0xfffffe5,
-    0xfffffe6, 0xfffffe7, 0xfffffe8, 0xffffea,  0x3ffffffc, 0xfffffe9,
-    0xfffffea, 0x3ffffffd, 0xfffffeb, 0xfffffec, 0xfffffed, 0xfffffee,
-    0xfffffef, 0xffffff0, 0xffffff1, 0xffffff2, 0x3ffffffe, 0xffffff3,
-    0xffffff4, 0xffffff5, 0xffffff6, 0xffffff7, 0xffffff8, 0xffffff9,
-    0xffffffa, 0xffffffb, 0x14,      0x3f8,     0x3f9,     0xffa,
-    0x1ff9,    0x15,      0xf8,      0x7fa,     0x3fa,     0x3fb,
-    0xf9,      0x7fb,     0xfa,      0x16,      0x17,      0x18,
-    0x0,       0x1,       0x2,       0x19,      0x1a,      0x1b,
-    0x1c,      0x1d,      0x1e,      0x1f,      0x5c,      0xfb,
-    0x7ffc,    0x20,      0xffb,     0x3fc,     0x1ffa,    0x21,
-    0x5d,      0x5e,      0x5f,      0x60,      0x61,      0x62,
-    0x63,      0x64,      0x65,      0x66,      0x67,      0x68,
-    0x69,      0x6a,      0x6b,      0x6c,      0x6d,      0x6e,
-    0x6f,      0x70,      0x71,      0x72,      0xfc,      0x73,
-    0xfd,      0x1ffb,    0x7fff0,   0x1ffc,    0x3ffc,    0x22,
-    0x7ffd,    0x3,       0x23,      0x4,       0x24,      0x5,
-    0x25,      0x26,      0x27,      0x6,       0x74,      0x75,
-    0x28,      0x29,      0x2a,      0x7,       0x2b,      0x76,
-    0x2c,      0x8,       0x9,       0x2d,      0x77,      0x78,
-    0x79,      0x7a,      0x7b,      0x7ffe,    0x7fc,     0x3ffd,
-    0x1ffd,    0xffffffc, 0xfffe6,   0x3fffd2,  0xfffe7,   0xfffe8,
-    0x3fffd3,  0x3fffd4,  0x3fffd5,  0x7fffd9,  0x3fffd6,  0x7fffda,
-    0x7fffdb,  0x7fffdc,  0x7fffdd,  0x7fffde,  0xffffeb,  0x7fffdf,
-    0xffffec,  0xffffed,  0x3fffd7,  0x7fffe0,  0xffffee,  0x7fffe1,
-    0x7fffe2,  0x7fffe3,  0x7fffe4,  0x1fffdc,  0x3fffd8,  0x7fffe5,
-    0x3fffd9,  0x7fffe6,  0x7fffe7,  0xffffef,  0x3fffda,  0x1fffdd,
-    0xfffe9,   0x3fffdb,  0x3fffdc,  0x7fffe8,  0x7fffe9,  0x1fffde,
-    0x7fffea,  0x3fffdd,  0x3fffde,  0xfffff0,  0x1fffdf,  0x3fffdf,
-    0x7fffeb,  0x7fffec,  0x1fffe0,  0x1fffe1,  0x3fffe0,  0x1fffe2,
-    0x7fffed,  0x3fffe1,  0x7fffee,  0x7fffef,  0xfffea,   0x3fffe2,
-    0x3fffe3,  0x3fffe4,  0x7ffff0,  0x3fffe5,  0x3fffe6,  0x7ffff1,
-    0x3ffffe0, 0x3ffffe1, 0xfffeb,   0x7fff1,   0x3fffe7,  0x7ffff2,
-    0x3fffe8,  0x1ffffec, 0x3ffffe2, 0x3ffffe3, 0x3ffffe4, 0x7ffffde,
-    0x7ffffdf, 0x3ffffe5, 0xfffff1,  0x1ffffed, 0x7fff2,   0x1fffe3,
-    0x3ffffe6, 0x7ffffe0, 0x7ffffe1, 0x3ffffe7, 0x7ffffe2, 0xfffff2,
-    0x1fffe4,  0x1fffe5,  0x3ffffe8, 0x3ffffe9, 0xffffffd, 0x7ffffe3,
-    0x7ffffe4, 0x7ffffe5, 0xfffec,   0xfffff3,  0xfffed,   0x1fffe6,
-    0x3fffe9,  0x1fffe7,  0x1fffe8,  0x7ffff3,  0x3fffea,  0x3fffeb,
-    0x1ffffee, 0x1ffffef, 0xfffff4,  0xfffff5,  0x3ffffea, 0x7ffff4,
-    0x3ffffeb, 0x7ffffe6, 0x3ffffec, 0x3ffffed, 0x7ffffe7, 0x7ffffe8,
-    0x7ffffe9, 0x7ffffea, 0x7ffffeb, 0xffffffe, 0x7ffffec, 0x7ffffed,
-    0x7ffffee, 0x7ffffef, 0x7fffff0, 0x3ffffee, 0x3fffffff};
-const uint8_t kHuffBits[257] = {
-    13, 23, 28, 28, 28, 28, 28, 28, 28, 24, 30, 28, 28, 30, 28, 28,  //
-    28, 28, 28, 28, 28, 28, 30, 28, 28, 28, 28, 28, 28, 28, 28, 28,  //
-    6,  10, 10, 12, 13, 6,  8,  11, 10, 10, 8,  11, 8,  6,  6,  6,   //
-    5,  5,  5,  6,  6,  6,  6,  6,  6,  6,  7,  8,  15, 6,  12, 10,  //
-    13, 6,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,   //
-    7,  7,  7,  7,  7,  7,  7,  7,  8,  7,  8,  13, 19, 13, 14, 6,   //
-    15, 5,  6,  5,  6,  5,  6,  6,  6,  5,  7,  7,  6,  6,  6,  5,   //
-    6,  7,  6,  5,  5,  6,  7,  7,  7,  7,  7,  15, 11, 14, 13, 28,  //
-    20, 22, 20, 20, 22, 22, 22, 23, 22, 23, 23, 23, 23, 23, 24, 23,  //
-    24, 24, 22, 23, 24, 23, 23, 23, 23, 21, 22, 23, 22, 23, 23, 24,  //
-    22, 21, 20, 22, 22, 23, 23, 21, 23, 22, 22, 24, 21, 22, 23, 23,  //
-    21, 21, 22, 21, 23, 22, 23, 23, 20, 22, 22, 22, 23, 22, 22, 23,  //
-    26, 26, 20, 19, 22, 23, 22, 25, 26, 26, 26, 27, 27, 26, 24, 25,  //
-    19, 21, 26, 27, 27, 26, 27, 24, 21, 21, 26, 26, 28, 27, 27, 27,  //
-    20, 24, 20, 21, 22, 21, 21, 23, 22, 22, 25, 25, 24, 24, 26, 23,  //
-    26, 27, 26, 26, 27, 27, 27, 27, 27, 28, 27, 27, 27, 27, 27, 26,  //
-    30};
-
-struct HuffNode {
-  int16_t next[2] = {-1, -1};
-  int16_t sym = -1;
-};
-
-const std::vector<HuffNode>& huff_tree() {
-  static const std::vector<HuffNode> tree = [] {
-    std::vector<HuffNode> t(1);
-    for (int s = 0; s < 257; ++s) {
-      size_t cur = 0;
-      for (int b = kHuffBits[s] - 1; b >= 0; --b) {
-        int bit = (kHuffCodes[s] >> b) & 1;
-        if (t[cur].next[bit] < 0) {
-          t[cur].next[bit] = static_cast<int16_t>(t.size());
-          t.emplace_back();
-        }
-        cur = static_cast<size_t>(t[cur].next[bit]);
-      }
-      t[cur].sym = static_cast<int16_t>(s);
-    }
-    return t;
-  }();
-  return tree;
-}
-
-// Decodes a huffman-coded HPACK string. False on: a bit path outside the
-// code tree, EOS inside the string, or padding that is not a (<8-bit)
-// prefix of EOS — all decoding errors per RFC 7541 §5.2.
-bool huffman_decode(std::string_view in, std::string& out) {
-  const std::vector<HuffNode>& t = huff_tree();
-  size_t cur = 0;
-  int pad_bits = 0;
-  bool pad_all_ones = true;
-  for (char c : in) {
-    uint8_t byte = static_cast<uint8_t>(c);
-    for (int b = 7; b >= 0; --b) {
-      int bit = (byte >> b) & 1;
-      int16_t nxt = t[cur].next[bit];
-      if (nxt < 0) return false;
-      cur = static_cast<size_t>(nxt);
-      ++pad_bits;
-      pad_all_ones = pad_all_ones && bit == 1;
-      if (t[cur].sym >= 0) {
-        if (t[cur].sym == 256) return false;  // EOS must never appear in-string
-        out.push_back(static_cast<char>(t[cur].sym));
-        cur = 0;
-        pad_bits = 0;
-        pad_all_ones = true;
-      }
-    }
-  }
-  return pad_bits < 8 && pad_all_ones;
-}
-
-struct Header {
-  std::string name, value;
-  bool huffman_value = false;  // huffman-coded AND undecodable (opaque)
-};
-
-// Decode one HPACK header block (static table + literals; dynamic-table
-// references can't legally appear because we advertise table size 0, but
-// are tolerated as unknowns). Returns false on malformed input.
-bool hpack_decode(std::string_view block, std::vector<Header>& out) {
-  size_t i = 0;
-  auto read_int = [&](int prefix_bits, uint64_t& v) -> bool {
-    if (i >= block.size()) return false;
-    uint8_t mask = static_cast<uint8_t>((1u << prefix_bits) - 1);
-    v = static_cast<uint8_t>(block[i]) & mask;
-    ++i;
-    if (v < mask) return true;
-    int shift = 0;
-    while (i < block.size()) {
-      uint8_t b = static_cast<uint8_t>(block[i++]);
-      v += static_cast<uint64_t>(b & 0x7f) << shift;
-      if (!(b & 0x80)) return true;
-      shift += 7;
-      if (shift > 56) return false;
-    }
-    return false;
-  };
-  auto read_str = [&](std::string& s, bool& huff) -> bool {
-    if (i >= block.size()) return false;
-    huff = (static_cast<uint8_t>(block[i]) & 0x80) != 0;
-    uint64_t len = 0;
-    if (!read_int(7, len)) return false;
-    if (i + len > block.size()) return false;
-    s.assign(block.data() + i, len);
-    i += len;
-    if (huff) {
-      // Decode in place; only an undecodable string stays opaque (huff
-      // stays true). A malformed huffman string is NOT a block error —
-      // the surrounding headers still parse (server-controlled bytes).
-      std::string decoded;
-      if (huffman_decode(s, decoded)) {
-        s = std::move(decoded);
-        huff = false;
-      }
-    }
-    return true;
-  };
-  while (i < block.size()) {
-    uint8_t b = static_cast<uint8_t>(block[i]);
-    if (b & 0x80) {  // indexed
-      uint64_t idx = 0;
-      if (!read_int(7, idx)) return false;
-      Header h;
-      if (idx >= 1 && idx <= 61) {
-        h.name = kStaticNames[idx];
-        h.value = kStaticValues[idx];
-      } else {
-        h.name = "<dynamic-" + std::to_string(idx) + ">";
-      }
-      out.push_back(std::move(h));
-    } else if ((b & 0xe0) == 0x20) {  // dynamic table size update
-      uint64_t sz = 0;
-      if (!read_int(5, sz)) return false;
-    } else {  // literal (incremental 01, without 0000, never 0001)
-      int prefix = (b & 0xc0) == 0x40 ? 6 : 4;
-      uint64_t idx = 0;
-      if (!read_int(prefix, idx)) return false;
-      Header h;
-      bool name_huff = false;
-      if (idx == 0) {
-        if (!read_str(h.name, name_huff)) return false;
-      } else if (idx <= 61) {
-        h.name = kStaticNames[idx];
-      } else {
-        h.name = "<dynamic-" + std::to_string(idx) + ">";
-      }
-      if (!read_str(h.value, h.huffman_value)) return false;
-      if (name_huff) h.name = "<huffman>";  // UNDECODABLE name: can't match it
-      out.push_back(std::move(h));
-    }
-  }
-  return true;
-}
-
 }  // namespace
 
 bool huffman_decode_for_test(std::string_view in, std::string& out) {
-  return huffman_decode(in, out);
+  return h2::huffman_decode(in, out);
 }
 
 bool hpack_decode_for_test(
@@ -578,15 +329,8 @@ CallResult unary_call(const std::string& host, int port, const std::string& path
 
     // Connection preface + SETTINGS: table size 0 (no dynamic HPACK state
     // for peers to reference), push off.
-    std::string out("PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n");
-    std::string settings;
-    auto put_setting = [&](uint16_t id, uint32_t v) {
-      settings.push_back(static_cast<char>(id >> 8));
-      settings.push_back(static_cast<char>(id & 0xff));
-      for (int s = 24; s >= 0; s -= 8) settings.push_back(static_cast<char>((v >> s) & 0xff));
-    };
-    put_setting(0x1, 0);  // HEADER_TABLE_SIZE
-    put_setting(0x2, 0);  // ENABLE_PUSH
+    std::string out(h2::kClientPreface);
+    std::string settings = h2::settings_payload(0);
     out += frame_header(settings.size(), kFrameSettings, 0, 0) + settings;
 
     // HEADERS (stream 1): gRPC request pseudo-headers + metadata.

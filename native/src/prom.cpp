@@ -10,18 +10,21 @@ Client::Client(std::string base_url, std::string bearer_token, http::TlsMode tls
                std::string ca_file, int timeout_ms)
     : base_url_(std::move(base_url)),
       token_(std::move(bearer_token)),
-      http_(tls_mode, std::move(ca_file)),
+      http_(h2::default_mode(), tls_mode, std::move(ca_file)),
       timeout_ms_(timeout_ms) {
   while (!base_url_.empty() && base_url_.back() == '/') base_url_.pop_back();
 }
 
-json::Value Client::instant_query(const std::string& promql, std::string* raw_body) const {
+http::Response Client::query_once(const std::string& promql) const {
   http::Request req;
   req.method = "POST";
   req.url = base_url_ + "/api/v1/query";
   req.headers.push_back({"Content-Type", "application/x-www-form-urlencoded"});
   req.headers.push_back({"Accept", "application/json"});
-  if (!token_.empty()) req.headers.push_back({"Authorization", "Bearer " + token_});
+  {
+    std::lock_guard<std::mutex> lock(token_mutex_);
+    if (!token_.empty()) req.headers.push_back({"Authorization", "Bearer " + token_});
+  }
   req.body = "query=" + util::url_encode(promql);
   req.timeout_ms = timeout_ms_;
 
@@ -33,9 +36,24 @@ json::Value Client::instant_query(const std::string& promql, std::string* raw_bo
     throw std::runtime_error("prometheus returned HTTP " + std::to_string(resp.status) + ": " +
                              snippet);
   }
+  return resp;
+}
+
+json::Value Client::instant_query(const std::string& promql, std::string* raw_body) const {
+  http::Response resp = query_once(promql);
   if (raw_body) *raw_body = resp.body;
   try {
     return json::Value::parse(resp.body);
+  } catch (const json::ParseError& e) {
+    throw std::runtime_error(std::string("prometheus returned unparseable body: ") + e.what());
+  }
+}
+
+json::DocPtr Client::instant_query_doc(const std::string& promql, std::string* raw_body) const {
+  http::Response resp = query_once(promql);
+  if (raw_body) *raw_body = resp.body;  // verbatim copy BEFORE the body moves
+  try {
+    return json::Doc::parse(std::move(resp.body));
   } catch (const json::ParseError& e) {
     throw std::runtime_error(std::string("prometheus returned unparseable body: ") + e.what());
   }

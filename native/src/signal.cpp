@@ -80,6 +80,38 @@ size_t Assessment::count(Verdict v) const {
   return n;
 }
 
+namespace {
+
+// Per-pod statistics folded out of the evidence response. After the
+// query's `sum by`/`max by` there is one row per (pod, stat); duplicates
+// are tolerated anyway (chip-level rows from a permissive fake or a
+// non-aggregating override) by summing coverage and keeping the freshest
+// age.
+struct Stats {
+  double samples = 0;
+  double age = 0;
+  bool has_samples = false, has_age = false;
+};
+
+void fold_row(std::map<std::string, Stats>& by_pod, const std::string& key,
+              std::string_view stat, double x) {
+  Stats& s = by_pod[key];
+  if (stat == "samples") {
+    s.samples += x;
+    s.has_samples = true;
+  } else if (stat == "age") {
+    s.age = s.has_age ? std::min(s.age, x) : x;
+    s.has_age = true;
+  }
+}
+
+// Verdict derivation shared by the Value and Doc folds.
+Assessment derive(std::map<std::string, Stats>&& by_pod,
+                  const std::vector<core::PodMetricSample>& candidates, const Config& cfg,
+                  uint64_t cycle);
+
+}  // namespace
+
 Assessment assess(const Value& evidence_response,
                   const std::vector<core::PodMetricSample>& candidates, const Config& cfg,
                   uint64_t cycle) {
@@ -93,16 +125,6 @@ Assessment assess(const Value& evidence_response,
     throw std::runtime_error("malformed evidence response: missing data.result");
   }
 
-  // Fold the response into per-pod statistics. After the query's
-  // `sum by`/`max by` there is one row per (pod, stat); tolerate
-  // duplicates anyway (chip-level rows from a permissive fake or a
-  // non-aggregating override) by summing coverage and keeping the
-  // freshest age.
-  struct Stats {
-    double samples = 0;
-    double age = 0;
-    bool has_samples = false, has_age = false;
-  };
   std::map<std::string, Stats> by_pod;
   for (const Value& series : result->as_array()) {
     const Value* metric = series.find("metric");
@@ -120,16 +142,59 @@ Assessment assess(const Value& evidence_response,
     } catch (const std::exception&) {
       continue;
     }
-    Stats& s = by_pod[*ns + "/" + *pod];
-    if (stat == "samples") {
-      s.samples += x;
-      s.has_samples = true;
-    } else if (stat == "age") {
-      s.age = s.has_age ? std::min(s.age, x) : x;
-      s.has_age = true;
-    }
+    fold_row(by_pod, *ns + "/" + *pod, stat, x);
+  }
+  return derive(std::move(by_pod), candidates, cfg, cycle);
+}
+
+Assessment assess(const json::Doc& evidence_response,
+                  const std::vector<core::PodMetricSample>& candidates, const Config& cfg,
+                  uint64_t cycle) {
+  json::Doc::Node root = evidence_response.root();
+  auto status = root.find("status");
+  if (!status || !status->is_string() || status->as_sv() != "success") {
+    throw std::runtime_error("evidence query failed: " +
+                             std::string(root.get_string("error", "unknown error")));
+  }
+  auto result = root.at_path("data.result");
+  if (!result || !result->is_array()) {
+    throw std::runtime_error("malformed evidence response: missing data.result");
   }
 
+  std::map<std::string, Stats> by_pod;
+  json::Doc::Node series = result->first_child();
+  for (size_t i = 0; i < result->size(); ++i, series = series.next_sibling()) {
+    auto metric = series.find("metric");
+    if (!metric || !metric->is_object()) continue;
+    auto label_of = [&](const char* exported,
+                        const char* native) -> std::optional<std::string_view> {
+      if (auto v = metric->find(exported); v && v->is_string()) return v->as_sv();
+      if (auto v = metric->find(native); v && v->is_string()) return v->as_sv();
+      return std::nullopt;
+    };
+    auto pod = label_of("exported_pod", "pod");
+    auto ns = label_of("exported_namespace", "namespace");
+    if (!pod || !ns) continue;
+    std::string_view stat = metric->get_string("signal_stat");
+    auto value = series.find("value");
+    if (!value || !value->is_array() || value->size() != 2) continue;
+    json::Doc::Node v = value->child(1);
+    double x = 0;
+    try {
+      x = v.is_string() ? std::stod(std::string(v.as_sv())) : v.as_double();
+    } catch (const std::exception&) {
+      continue;
+    }
+    fold_row(by_pod, std::string(*ns) + "/" + std::string(*pod), stat, x);
+  }
+  return derive(std::move(by_pod), candidates, cfg, cycle);
+}
+
+namespace {
+
+Assessment derive(std::map<std::string, Stats>&& by_pod,
+                  const std::vector<core::PodMetricSample>& candidates, const Config& cfg,
+                  uint64_t cycle) {
   Assessment out;
   out.cycle = cycle;
   out.min_coverage = cfg.min_coverage;
@@ -164,6 +229,8 @@ Assessment assess(const Value& evidence_response,
   out.brownout = !candidates.empty() && out.coverage_ratio < cfg.min_coverage;
   return out;
 }
+
+}  // namespace
 
 audit::Reason veto_reason(Verdict v) {
   switch (v) {

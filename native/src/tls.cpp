@@ -2,6 +2,7 @@
 
 #include <dlfcn.h>
 
+#include <cerrno>
 #include <cstdio>
 #include <mutex>
 #include <stdexcept>
@@ -100,6 +101,20 @@ bool available() { return api().ok; }
 
 Conn::Conn(int fd, const std::string& sni_host, bool verify, const std::string& ca_file,
            const std::string& alpn) {
+  // Single-protocol form: offering exactly one protocol and requiring it
+  // be selected (the gRPC "h2" contract this ctor always carried).
+  std::vector<std::string> protos;
+  if (!alpn.empty()) protos.push_back(alpn);
+  init(fd, sni_host, verify, ca_file, protos, /*require_alpn=*/true);
+}
+
+Conn::Conn(int fd, const std::string& sni_host, bool verify, const std::string& ca_file,
+           const std::vector<std::string>& alpn_protos, bool require_alpn) {
+  init(fd, sni_host, verify, ca_file, alpn_protos, require_alpn);
+}
+
+void Conn::init(int fd, const std::string& sni_host, bool verify, const std::string& ca_file,
+                const std::vector<std::string>& alpn_protos, bool require_alpn) {
   const Api& a = api();
   if (!a.ok) {
     throw std::runtime_error(
@@ -135,18 +150,22 @@ Conn::Conn(int fd, const std::string& sni_host, bool verify, const std::string& 
   a.SSL_ctrl(ssl_, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
              const_cast<char*>(sni_host.c_str()));
   if (verify) a.SSL_set1_host(ssl_, sni_host.c_str());
-  if (!alpn.empty()) {
-    // RFC 7301 wire format: length-prefixed protocol names.
+  if (!alpn_protos.empty()) {
+    // RFC 7301 wire format: length-prefixed protocol names, in client
+    // preference order.
     std::string wire;
-    wire.push_back(static_cast<char>(alpn.size()));
-    wire += alpn;
+    for (const std::string& p : alpn_protos) {
+      wire.push_back(static_cast<char>(p.size()));
+      wire += p;
+    }
     // Returns 0 on success (unlike most SSL_* APIs). A failure here means
     // the handshake would proceed WITHOUT offering the protocol, and the
     // post-handshake check below would then blame the server ("did not
     // negotiate ALPN") for a client-side setup error — fail distinctly.
     if (a.SSL_set_alpn_protos(ssl_, reinterpret_cast<const unsigned char*>(wire.data()),
                               static_cast<unsigned int>(wire.size())) != 0) {
-      std::string err = last_error("failed to set ALPN protocol list \"" + alpn + "\"");
+      std::string err =
+          last_error("failed to set ALPN protocol list \"" + alpn_protos.front() + "\"");
       a.SSL_free(ssl_);
       a.SSL_CTX_free(ctx_);
       ssl_ = ctx_ = nullptr;
@@ -165,23 +184,27 @@ Conn::Conn(int fd, const std::string& sni_host, bool verify, const std::string& 
     ssl_ = ctx_ = nullptr;
     throw std::runtime_error(err);
   }
-  if (!alpn.empty()) {
-    // gRPC servers require the negotiated protocol, not just a working
-    // TLS session: no/different selection means the peer would reset the
-    // h2 stream anyway — fail with the actionable error instead.
+  if (!alpn_protos.empty()) {
     const unsigned char* sel = nullptr;
     unsigned int sel_len = 0;
     a.SSL_get0_alpn_selected(ssl_, &sel, &sel_len);
-    if (!sel || std::string(reinterpret_cast<const char*>(sel), sel_len) != alpn) {
+    if (sel) alpn_selected_.assign(reinterpret_cast<const char*>(sel), sel_len);
+    bool offered = false;
+    for (const std::string& p : alpn_protos) offered = offered || p == alpn_selected_;
+    // gRPC servers require the negotiated protocol, not just a working
+    // TLS session: no/different selection means the peer would reset the
+    // h2 stream anyway — fail with the actionable error instead. The
+    // multi-protocol (require_alpn=false) form lets a no-selection
+    // handshake through: the shared transport treats "" as HTTP/1.1.
+    if (require_alpn && (!sel || !offered)) {
+      std::string err =
+          "tls: server did not negotiate ALPN \"" + alpn_protos.front() + "\" (selected " +
+          (sel ? "\"" + alpn_selected_ + "\"" : "nothing") +
+          "); the endpoint does not speak HTTP/2 — is it a gRPC listener?";
       a.SSL_free(ssl_);
       a.SSL_CTX_free(ctx_);
       ssl_ = ctx_ = nullptr;
-      throw std::runtime_error(
-          "tls: server did not negotiate ALPN \"" + alpn +
-          "\" (selected " +
-          (sel ? "\"" + std::string(reinterpret_cast<const char*>(sel), sel_len) + "\""
-               : "nothing") +
-          "); the endpoint does not speak HTTP/2 — is it a gRPC listener?");
+      throw std::runtime_error(err);
     }
   }
 }
@@ -201,6 +224,27 @@ size_t Conn::read(char* buf, size_t n) {
   if (rc > 0) return static_cast<size_t>(rc);
   int err = a.SSL_get_error(ssl_, rc);
   if (err == kSslErrorZeroReturn) return 0;  // clean close_notify
+  throw std::runtime_error(last_error("read failed"));
+}
+
+Conn::IoStatus Conn::read_nb(char* buf, size_t n, size_t& got) {
+  const Api& a = api();
+  got = 0;
+  errno = 0;
+  int rc = a.SSL_read(ssl_, buf, static_cast<int>(n));
+  if (rc > 0) {
+    got = static_cast<size_t>(rc);
+    return IoStatus::Data;
+  }
+  int err = a.SSL_get_error(ssl_, rc);
+  if (err == kSslErrorZeroReturn) return IoStatus::Eof;
+  constexpr int kWantRead = 2, kWantWrite = 3, kSyscall = 5;
+  if (err == kWantRead || err == kWantWrite) return IoStatus::WouldBlock;
+  if (err == kSyscall && (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)) {
+    return IoStatus::WouldBlock;
+  }
+  // SSL_ERROR_SYSCALL with errno 0 is the peer dropping without
+  // close_notify — a dead session, not a retryable wait.
   throw std::runtime_error(last_error("read failed"));
 }
 

@@ -9,6 +9,7 @@
 
 #include <memory>
 #include <string>
+#include <vector>
 
 namespace tpupruner::tls {
 
@@ -24,12 +25,28 @@ class Conn {
  public:
   Conn(int fd, const std::string& sni_host, bool verify, const std::string& ca_file,
        const std::string& alpn = "");
+  // Multi-protocol ALPN offer (RFC 7301 preference order). When
+  // `require_alpn` the handshake fails unless the server selects one of
+  // the offered protocols; otherwise a no-selection handshake succeeds
+  // and alpn_selected() reads "" — the shared-transport client offers
+  // {"h2","http/1.1"} this way and branches on the answer.
+  Conn(int fd, const std::string& sni_host, bool verify, const std::string& ca_file,
+       const std::vector<std::string>& alpn_protos, bool require_alpn);
   ~Conn();
   Conn(const Conn&) = delete;
   Conn& operator=(const Conn&) = delete;
 
+  // The server's ALPN selection ("" when none was negotiated).
+  const std::string& alpn_selected() const { return alpn_selected_; }
+
   // Return >0 bytes, 0 on orderly close, throw on error.
   size_t read(char* buf, size_t n);
+  // Timeout-tolerant read for pollers: a socket-timeout (SO_RCVTIMEO
+  // expiring mid-wait) or a retryable WANT_READ returns WouldBlock with
+  // got=0 instead of throwing — the h2 IO loop reads with a short
+  // timeout and must tell "nothing arrived yet" from a dead session.
+  enum class IoStatus { Data, WouldBlock, Eof };
+  IoStatus read_nb(char* buf, size_t n, size_t& got);
   // Decrypted bytes already buffered in the session (SSL_pending) — a
   // poll() on the raw fd can report "nothing to read" while a previous
   // record still holds deliverable plaintext; streaming readers must
@@ -38,8 +55,12 @@ class Conn {
   void write_all(const char* buf, size_t n);
 
  private:
+  void init(int fd, const std::string& sni_host, bool verify, const std::string& ca_file,
+            const std::vector<std::string>& alpn_protos, bool require_alpn);
+
   void* ctx_ = nullptr;  // SSL_CTX*
   void* ssl_ = nullptr;  // SSL*
+  std::string alpn_selected_;
 };
 
 }  // namespace tpupruner::tls

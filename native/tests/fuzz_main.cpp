@@ -160,14 +160,42 @@ int run(uint64_t iterations, uint64_t seed) {
     }
 
     Value v;
+    bool value_parsed = true;
     try {
       v = Value::parse(input);
       ++parsed;
       if (corpus.size() < kMaxCorpus) corpus.push_back(input);
     } catch (const ParseError&) {
       ++rejected;
-      continue;  // invariant 1 satisfied: documented rejection
+      value_parsed = false;
     }
+    // invariant 6: the arena/zero-copy Doc parser accepts and rejects
+    // EXACTLY the inputs Value::parse does, and on acceptance produces an
+    // identical tree — the transport hot path's decode-parity contract on
+    // arbitrary bytes, not just the recorded corpus.
+    {
+      tpupruner::json::DocPtr doc;
+      bool doc_parsed = true;
+      try {
+        doc = tpupruner::json::Doc::parse(input);
+      } catch (const ParseError&) {
+        doc_parsed = false;
+      }
+      if (doc_parsed != value_parsed) {
+        std::fprintf(stderr,
+                     "DOC/VALUE ACCEPT DIVERGENCE (iter %llu, seed %llu, doc=%d value=%d):\n%s\n",
+                     static_cast<unsigned long long>(i), static_cast<unsigned long long>(seed),
+                     doc_parsed ? 1 : 0, value_parsed ? 1 : 0, input.c_str());
+        return 1;
+      }
+      if (doc_parsed && doc->to_value() != v) {
+        std::fprintf(stderr, "DOC/VALUE TREE DIVERGENCE (iter %llu, seed %llu):\n%s\n",
+                     static_cast<unsigned long long>(i), static_cast<unsigned long long>(seed),
+                     input.c_str());
+        return 1;
+      }
+    }
+    if (!value_parsed) continue;  // invariant 1 satisfied: documented rejection
     // invariant 2: round-trip stability
     std::string dumped = v.dump();
     Value v2 = Value::parse(dumped);  // must not throw: we produced it

@@ -152,3 +152,96 @@ TP_TEST(util_url_encode) {
   TP_CHECK_EQ(util::url_encode("a b&c=d"), std::string("a%20b%26c%3Dd"));
   TP_CHECK_EQ(util::url_encode("safe-._~"), std::string("safe-._~"));
 }
+
+// ── arena / zero-copy Doc parser (the transport hot-path decoder) ───────
+
+TP_TEST(json_doc_parity_on_wire_shapes) {
+  // The two real wire shapes the zero-copy path decodes every cycle: a
+  // Prometheus matrix and a pod LIST page. Doc::parse must produce a tree
+  // indistinguishable from Value::parse on the same bytes.
+  const char* bodies[] = {
+      R"({"status":"success","data":{"resultType":"vector","result":[
+        {"metric":{"pod":"t-0","namespace":"ml"},"value":[1722249000.123,"0"]},
+        {"metric":{"exported_pod":"t-1"},"value":[1722249000.123,"0.5"]}]}})",
+      R"({"kind":"PodList","apiVersion":"v1","metadata":{"resourceVersion":"812",
+        "continue":"tok"},"items":[{"metadata":{"name":"w-0","namespace":"tpu",
+        "creationTimestamp":"2026-07-28T10:00:00Z"},"spec":{"containers":[
+        {"resources":{"requests":{"google.com/tpu":"4"}}}]},
+        "status":{"phase":"Running"}}]})",
+      R"([0,-1,1e308,-2.5e-308,9223372036854775807,"  \u00e9 😀\\\"\n",null,true,false])",
+  };
+  for (const char* text : bodies) {
+    json::DocPtr doc = json::Doc::parse(text);
+    Value v = Value::parse(text);
+    TP_CHECK(doc->to_value() == v);
+    TP_CHECK_EQ(doc->to_value().dump(), v.dump());
+  }
+}
+
+TP_TEST(json_doc_cursor_walk) {
+  json::DocPtr doc = json::Doc::parse(
+      R"({"metadata":{"name":"p","labels":{"a":"1"}},"items":[10,20,30],"n":2.5})");
+  json::Doc::Node root = doc->root();
+  TP_CHECK(root.is_object());
+  TP_CHECK_EQ(root.size(), size_t(3));
+  TP_CHECK_EQ(root.at_path("metadata.name")->as_string(), std::string("p"));
+  TP_CHECK_EQ(root.find("metadata")->get_string("name"), std::string_view("p"));
+  TP_CHECK(!root.find("missing").has_value());
+  json::Doc::Node items = *root.find("items");
+  TP_CHECK_EQ(items.size(), size_t(3));
+  TP_CHECK_EQ(items.child(2).as_int(), int64_t(30));
+  // O(1) sibling stepping must visit the same children as child(i).
+  json::Doc::Node it = items.first_child();
+  int64_t sum = 0;
+  for (size_t i = 0; i < items.size(); ++i, it = it.next_sibling()) sum += it.as_int();
+  TP_CHECK_EQ(sum, int64_t(60));
+  auto [key, n] = root.member(2);
+  TP_CHECK_EQ(key, std::string_view("n"));
+  TP_CHECK_EQ(n.as_double(), 2.5);
+  // Stable (doc, index) handles — the informer store's entry shape.
+  uint32_t idx = root.find("metadata")->index();
+  TP_CHECK_EQ(doc->node(idx).get_string("name"), std::string_view("p"));
+}
+
+TP_TEST(json_doc_strings_view_into_body) {
+  // The zero-copy property itself: an escape-free string payload is a
+  // view into the owned response buffer, not a copy; escaped strings
+  // decode into the side arena (and still compare equal to Value::parse).
+  json::DocPtr doc = json::Doc::parse(R"({"plain":"abcdef","esc":"a\nb"})");
+  std::string_view plain = doc->root().find("plain")->as_sv();
+  const std::string& body = doc->body();
+  TP_CHECK(plain.data() >= body.data() && plain.data() < body.data() + body.size());
+  TP_CHECK_EQ(doc->root().find("esc")->as_string(), std::string("a\nb"));
+}
+
+TP_TEST(json_doc_error_parity) {
+  // Accept/reject must agree with Value::parse on the edge corpus the
+  // Python parity tests also pin: truncations, bad escapes, lone
+  // surrogates, trailing garbage, depth bombs.
+  const char* cases[] = {
+      "", "{", "[1,", "{\"a\":}", "\"unterminated", "\"bad\\q\"",
+      "\"\\ud800\"", "01", "1.2.3", "[1] trailing", "nul", "tru",
+      R"({"a":1,"a":2,"b":3})", "[[[[[[[[[[1]]]]]]]]]]", "  42  ",
+  };
+  for (const char* text : cases) {
+    bool value_ok = true, doc_ok = true;
+    Value v;
+    try {
+      v = Value::parse(text);
+    } catch (const json::ParseError&) {
+      value_ok = false;
+    }
+    json::DocPtr doc;
+    try {
+      doc = json::Doc::parse(text);
+    } catch (const json::ParseError&) {
+      doc_ok = false;
+    }
+    TP_CHECK_EQ(doc_ok, value_ok);
+    if (value_ok) {
+      TP_CHECK(doc->to_value() == v);
+      // duplicate keys: last occurrence wins in BOTH parsers
+      if (v.is_object() && v.find("a")) TP_CHECK_EQ(doc->root().find("a")->as_int(), v.find("a")->as_int());
+    }
+  }
+}

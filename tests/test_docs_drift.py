@@ -106,6 +106,39 @@ def test_signal_surfaces_documented(built):
         f"signal-watchdog surfaces missing from docs/OPERATIONS.md: {missing}")
 
 
+def test_transport_surfaces_documented(built):
+    """The shared-transport families come from the native canonical list
+    (h2::transport_metric_families) so a counter added to h2.cpp without a
+    runbook row fails even though the families render zeros on a daemon
+    that never negotiated h2. The knobs and runbook section ride along."""
+    doc = OPERATIONS.read_text()
+    families = native.transport_metric_families()
+    assert len(families) >= 4
+    missing = [f for f in families if f not in doc]
+    assert not missing, (
+        f"transport metric families missing from docs/OPERATIONS.md: {missing} "
+        "— document each in the Observability table and the 'Transport "
+        "tuning' section")
+    needles = ("Transport tuning", "--transport", "--zero-copy-json",
+               "--transport http1", "ALPN")
+    missing = [n for n in needles if n not in doc]
+    assert not missing, (
+        f"shared-transport surfaces missing from docs/OPERATIONS.md: {missing}")
+
+
+def test_transport_bench_summary_fields_documented():
+    """Transport bench summary fields must be in BENCH_FIELDS.md AND
+    actually emitted by bench.py — a drift on either side fails."""
+    bench_src = (REPO / "bench.py").read_text()
+    fields_doc = (REPO / "docs" / "BENCH_FIELDS.md").read_text()
+    for field in ("connections_opened_cold", "connections_opened_warm",
+                  "transport_off_query_decode_p50_ms",
+                  "query_decode_p50_ms"):
+        assert f'"{field}"' in bench_src, f"bench.py no longer emits {field}"
+        assert field in fields_doc, (
+            f"bench summary field {field} missing from docs/BENCH_FIELDS.md")
+
+
 def test_signal_bench_summary_fields_documented():
     """Signal-guard bench summary fields must be in BENCH_FIELDS.md AND
     actually emitted by bench.py — a drift on either side fails."""

@@ -79,6 +79,12 @@ def fleet(built, tmp_path_factory):
     # the whole-fleet-reachable signals view: the per-cluster minimum is
     # the browned-out cluster's coverage while every member is up
     f.pre_kill_signals = f.hub_get_json("/debug/fleet/signals")
+    # null's first ledger checkpoint (written at its first cycle's end)
+    # must exist before the kill: the 3-ledger merge test reads it, and
+    # null — started last — can still be inside cycle 1 when east's
+    # reclaimed>0 signal fires above.
+    from pathlib import Path
+    wait_until(lambda: Path(f.members[2].ledger_path).exists())
     f.members[2].kill()
     wait_until(lambda: [
         m for m in f.hub_get_json("/debug/fleet/clusters")["members"]

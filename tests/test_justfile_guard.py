@@ -130,6 +130,47 @@ def test_tsan_shard_recipe_present_and_wired():
         "nothing and the recipe would vacuously pass")
 
 
+def test_tsan_transport_recipe_present_and_wired():
+    """`just tsan-transport` must exist and run the h2 + informer native
+    tests under ThreadSanitizer — the multiplexing client's concurrent
+    stream dispatch and the informer's watch-over-h2 path are exactly the
+    code whose races TSan catches and plain asserts don't."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^tsan-transport\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `tsan-transport:` recipe"
+    body = m.group(1)
+    assert "-DTP_TSAN=ON" in body, "tsan-transport no longer builds with TSan"
+    assert re.search(r"tpupruner_tests\s+h2", body), (
+        "tsan-transport no longer runs the native h2 tests")
+    assert re.search(r"tpupruner_tests\s+informer", body), (
+        "tsan-transport no longer runs the native informer tests")
+    assert (REPO / "native" / "tests" / "test_h2.cpp").exists(), (
+        "native/tests/test_h2.cpp vanished — the filter would match "
+        "nothing and the recipe would vacuously pass")
+
+
+def test_asan_json_recipe_present_and_wired():
+    """`just asan-json` must exist and run the zero-copy decoder under
+    AddressSanitizer — Doc's string_view-into-buffer decoding is exactly
+    the code whose lifetime bugs ASan catches — plus the mutation fuzzer,
+    whose Doc-vs-Value parity invariant covers arbitrary bytes."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^asan-json\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `asan-json:` recipe"
+    body = m.group(1)
+    assert "-DTP_SANITIZE=ON" in body, "asan-json no longer builds with ASan"
+    assert re.search(r"tpupruner_tests\s+json", body), (
+        "asan-json no longer runs the native json tests")
+    assert "tpupruner_fuzz" in body, (
+        "asan-json no longer runs the mutation fuzzer")
+    fuzz_src = (REPO / "native" / "tests" / "fuzz_main.cpp").read_text()
+    assert "Doc::parse" in fuzz_src, (
+        "fuzz_main.cpp lost its Doc-vs-Value parity invariant — asan-json "
+        "would no longer exercise the zero-copy decoder on mutated bytes")
+
+
 def test_just_verify_matches_roadmap_tier1():
     roadmap = roadmap_tier1_command()
     justfile = justfile_verify_command()

@@ -1,0 +1,472 @@
+"""Shared HTTP/2 transport + zero-copy JSON tests (the ISSUE 9 perf
+tentpole).
+
+The daemon's hot traffic — informer LIST/watch, the per-cycle idleness +
+evidence query pair, scale patches — rides one multiplexing h2 transport
+(ALPN / prior-knowledge negotiated, transparent HTTP/1.1 fallback), and
+the hot call sites decode through an arena/zero-copy JSON path. Pinned
+here, end to end against the fakes' own transport accounting:
+
+  - multiplexing actually happens: a whole 2-cycle watch-cache run opens
+    ONE connection per endpoint (every watch stream, LIST page, GET and
+    PATCH as concurrent h2 streams), and the warm cycle opens ZERO new
+    connections;
+  - the idleness + evidence queries leave as two CONCURRENT streams on
+    the one Prometheus connection (max_concurrent_streams >= 2);
+  - `--transport http1` and `--zero-copy-json off` are exact-parity
+    escape hatches: normalized audit JSONL is byte-identical across all
+    modes;
+  - a pooled HTTP/1.1 keep-alive socket the server closed between
+    requests retries once on a fresh connection instead of surfacing a
+    cycle error (the stale-socket bugfix);
+  - zero-copy decode parity: recorded LIST/object/Prometheus bodies and
+    an escape/UTF-8/truncation edge corpus decode identically through
+    Value::parse and the arena Doc path — same trees, same errors.
+"""
+
+import json
+import re
+import socket
+import subprocess
+import threading
+import time
+import urllib.request
+from urllib.parse import quote
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def daemon_env(fake_k8s):
+    # Static tokens: no metadata-server probing, so the fakes see ONLY the
+    # daemon's real API traffic and the connection accounting is exact.
+    return {"KUBE_API_URL": fake_k8s.url, "KUBE_TOKEN": "t",
+            "PROMETHEUS_TOKEN": "t", "PATH": "/usr/bin:/bin"}
+
+
+def run_daemon(fake_prom, fake_k8s, *extra, run_mode="scale-down",
+               cycles=None):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", run_mode, *extra]
+    if cycles is not None:
+        cmd += ["--daemon-mode", "--check-interval", "1",
+                "--max-cycles", str(cycles)]
+    proc = subprocess.run(cmd, env=daemon_env(fake_k8s),
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc
+
+
+def idle_cluster(fake_prom, fake_k8s, n=4, ns="ml"):
+    paths = set()
+    for i in range(n):
+        _, _, pods = fake_k8s.add_deployment_chain(ns, f"dep-{i}",
+                                                   num_pods=1, tpu_chips=4)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], ns,
+                                      chips=4)
+        paths.add(f"/apis/apps/v1/namespaces/{ns}/deployments/dep-{i}/scale")
+    return paths
+
+
+# ── multiplexing: one connection per endpoint, zero warm connections ───
+
+
+def test_warm_cycle_opens_no_new_connections(built, fake_prom, fake_k8s):
+    """THE transport acceptance, scaled to a test: a 2-cycle watch-cache
+    scale-down run multiplexes EVERYTHING — informer LISTs + watch
+    streams, both cycle queries, owner GETs, scale patches — over one h2
+    connection per endpoint, and the warm cycle opens zero new ones."""
+    idle_cluster(fake_prom, fake_k8s)
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "scale-down", "--daemon-mode",
+           "--check-interval", "1", "--max-cycles", "2",
+           "--watch-cache", "on", "--signal-guard", "on"]
+    proc = subprocess.Popen(cmd, env=daemon_env(fake_k8s),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 60
+        while len(fake_k8s.patches) < 4 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(fake_k8s.patches) >= 4, "cold cycle never actuated"
+        time.sleep(0.3)  # actuation stragglers
+        cold_k8s = fake_k8s.transport.snapshot()
+        cold_prom = fake_prom.transport.snapshot()
+
+        # churn: one new idle deployment arrives via the watch stream
+        _, _, pods = fake_k8s.add_deployment_chain("ml", "churn-0",
+                                                   num_pods=1, tpu_chips=4)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml",
+                                      chips=4)
+        stderr = proc.communicate(timeout=120)[1]
+        assert proc.returncode == 0, stderr[-3000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    warm_k8s = fake_k8s.transport.snapshot()
+    warm_prom = fake_prom.transport.snapshot()
+    # one connection per endpoint, h2-negotiated, carrying many streams
+    for name, snap in (("k8s", warm_k8s), ("prom", warm_prom)):
+        assert snap["connections"] == 1, (name, snap)
+        assert snap["h2_connections"] == 1, (name, snap)
+    assert warm_k8s["h2_streams"] > 8, warm_k8s  # LISTs + watches + verbs
+    # the warm cycle rode the SAME connections — zero new ones
+    assert warm_k8s["connections"] == cold_k8s["connections"]
+    assert warm_prom["connections"] == cold_prom["connections"]
+
+
+def test_query_pair_issues_concurrent_streams(built, fake_prom, fake_k8s):
+    """--signal-guard on issues the idleness and evidence queries as two
+    concurrent streams on ONE Prometheus connection: the cycle's query
+    wall-clock is max(idle, evidence), not the sum. The fake stalls each
+    query briefly so the overlap is deterministic."""
+    idle_cluster(fake_prom, fake_k8s, n=1)
+    fake_prom.hang_seconds = 0.4
+    run_daemon(fake_prom, fake_k8s, "--signal-guard", "on",
+               run_mode="dry-run")
+    snap = fake_prom.transport.snapshot()
+    assert snap["connections"] == 1, snap
+    assert snap["h2_streams"] >= 2, snap
+    assert snap["max_concurrent_streams"] >= 2, (
+        f"idleness+evidence queries never overlapped on the connection: {snap}")
+
+
+# ── parity: --transport http1 / --zero-copy-json off change nothing ────
+
+VOLATILE_KEYS = {"ts", "ts_unix", "ts_ms", "now_unix", "trace_id", "id"}
+
+
+def _normalize(obj):
+    if isinstance(obj, dict):
+        return {k: _normalize(v) for k, v in obj.items()
+                if k not in VOLATILE_KEYS}
+    if isinstance(obj, list):
+        return [_normalize(v) for v in obj]
+    return obj
+
+
+def test_transport_and_decode_modes_decision_parity(built, fake_prom,
+                                                    fake_k8s, tmp_path):
+    """Dry-run the same cluster under (auto + zero-copy), http1, and
+    zero-copy-off: normalized audit JSONL must be byte-identical — the
+    transport and the decoder may change HOW bytes move, never what the
+    daemon decides. The fakes' accounting proves each mode actually took
+    its path (h2 negotiated vs never spoken)."""
+    idle_cluster(fake_prom, fake_k8s, n=3)
+    # an ineligible pod too, so parity covers veto records
+    fake_k8s.add_pod("ml", "orphan",
+                     owners=[fake_k8s.owner("DaemonSet", "ds-x")])
+    fake_prom.add_idle_pod_series("orphan", "ml")
+
+    outputs = {}
+    for mode, extra in (
+            ("auto", ()),
+            ("http1", ("--transport", "http1")),
+            ("zc-off", ("--zero-copy-json", "off"))):
+        before = fake_prom.transport.snapshot()["h2_connections"]
+        audit = tmp_path / f"audit-{mode}.jsonl"
+        flight = tmp_path / f"flight-{mode}"
+        run_daemon(fake_prom, fake_k8s, "--audit-log", str(audit),
+                   "--flight-dir", str(flight), *extra, run_mode="dry-run")
+        delta_h2 = fake_prom.transport.snapshot()["h2_connections"] - before
+        if mode == "http1":
+            assert delta_h2 == 0, "--transport http1 still spoke h2"
+        else:
+            assert delta_h2 >= 1, f"mode {mode} never negotiated h2"
+        records = [_normalize(json.loads(line))
+                   for line in audit.read_text().splitlines()]
+        assert records, f"no audit records under {mode}"
+        capsules = [_normalize(json.loads(p.read_text()))
+                    for p in sorted(flight.glob("cycle-*.json"))]
+        assert capsules, f"no flight capsules under {mode}"
+        outputs[mode] = (json.dumps(records, sort_keys=True),
+                         json.dumps(capsules, sort_keys=True))
+
+    assert outputs["auto"][0] == outputs["http1"][0], (
+        "--transport http1 changed decisions")
+    assert outputs["auto"][0] == outputs["zc-off"][0], (
+        "--zero-copy-json off changed decisions")
+    # Flight capsules — verbatim response bodies included — are
+    # byte-identical too: the transport moves the same bytes, the decoder
+    # reads them the same way.
+    assert outputs["auto"][1] == outputs["http1"][1], (
+        "--transport http1 changed flight capsules")
+    assert outputs["auto"][1] == outputs["zc-off"][1], (
+        "--zero-copy-json off changed flight capsules")
+
+
+# ── the stale keep-alive socket bugfix ─────────────────────────────────
+
+
+class CloseAfterResponseServer:
+    """Minimal HTTP/1.1 'Prometheus' that serves ONE query per TCP
+    connection, then closes it WITHOUT a Connection: close header — the
+    server-side idle-timeout shape that turns a pooled client socket
+    stale. Every reused-socket request hits ECONNRESET/0-byte-read and
+    must be retried on a fresh connection, not surfaced as a cycle
+    error."""
+
+    BODY = json.dumps({"status": "success",
+                       "data": {"resultType": "vector", "result": []}}).encode()
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.connections = 0
+        self.requests = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with self._lock:
+                self.connections += 1
+            try:
+                conn.settimeout(10)
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        break
+                    buf += chunk
+                if b"\r\n\r\n" in buf:
+                    with self._lock:
+                        self.requests += 1
+                    conn.sendall(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: application/json\r\n"
+                        b"Content-Length: " + str(len(self.BODY)).encode() +
+                        b"\r\n\r\n" + self.BODY)
+            except OSError:
+                pass
+            finally:
+                # close immediately: the client's pooled socket is now a
+                # stale keep-alive socket it has no way to know about
+                conn.close()
+
+    def stop(self):
+        self._stop.set()
+        self.sock.close()
+
+
+def test_stale_keepalive_socket_retries_on_fresh_connection(built, fake_k8s):
+    """Two --transport http1 cycles against a server that closes every
+    connection after one response: cycle 2's pooled socket is stale, the
+    client must retry once on a fresh connection and the cycle must
+    SUCCEED — before the fix this surfaced as a cycle error."""
+    server = CloseAfterResponseServer()
+    try:
+        cmd = [str(DAEMON_PATH), "--prometheus-url", server.url,
+               "--run-mode", "dry-run", "--transport", "http1",
+               "--daemon-mode", "--check-interval", "1", "--max-cycles", "3"]
+        proc = subprocess.run(cmd, env=daemon_env(fake_k8s),
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        assert proc.stderr.count("Query succeeded") == 3, proc.stderr[-3000:]
+        assert "Failed to run query and scale down" not in proc.stderr, (
+            proc.stderr[-3000:])
+        assert server.requests >= 3
+        assert server.connections >= 3  # each retry dialed fresh
+    finally:
+        server.stop()
+
+
+# ── zero-copy decode parity: recorded bodies + edge corpus ─────────────
+
+
+def _both_paths(body: str):
+    """(ok, payload) for Value::parse and Doc::parse on the same bytes —
+    payload is the canonical dump on success, the error message on
+    failure. The two must be IDENTICAL either way."""
+    out = []
+    for zero_copy in (False, True):
+        try:
+            r = native.json_parse(body, zero_copy=zero_copy)
+            out.append((True, (r["dump"], r["pretty"])))
+        except ValueError as e:
+            out.append((False, str(e)))
+    return out
+
+
+def _assert_parity(body: str, label: str):
+    value_path, doc_path = _both_paths(body)
+    assert value_path == doc_path, (
+        f"zero-copy decode diverged on {label!r}:\n value: {value_path}\n"
+        f" doc:   {doc_path}")
+
+
+def test_zero_copy_parity_on_recorded_transport_bodies(built, fake_prom,
+                                                       fake_k8s):
+    """The real wire bytes of the three hot flows — a Prometheus vector,
+    a paginated pod LIST, an object GET wrapped as a watch event — must
+    decode to identical trees through both paths, and the metric decoder
+    must produce identical samples from the raw body."""
+    for i in range(3):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}",
+                                                   num_pods=2, tpu_chips=4)
+        for pod in pods:
+            fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml",
+                                          chips=4)
+
+    def get(url):
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.read().decode()
+
+    prom_body = get(fake_prom.url + "/api/v1/query?query=" +
+                    quote('tensorcore_duty_cycle{exported_pod!=""}'))
+    bodies = {
+        "prom-vector": prom_body,
+        "pod-list": get(fake_k8s.url + "/api/v1/pods"),
+        "pod-list-page": get(fake_k8s.url + "/api/v1/pods?limit=2"),
+        "deployment-list": get(
+            fake_k8s.url + "/apis/apps/v1/namespaces/ml/deployments"),
+    }
+    pod_obj = get(fake_k8s.url + "/api/v1/namespaces/ml/pods/" +
+                  json.loads(bodies["pod-list"])["items"][0]["metadata"]["name"])
+    bodies["watch-event"] = json.dumps(
+        {"type": "MODIFIED", "object": json.loads(pod_obj)})
+
+    for label, body in bodies.items():
+        assert body.strip(), label
+        _assert_parity(body, label)
+
+    # the metric decoder itself: identical samples, errors and dedup from
+    # the same raw bytes
+    plain = native.decode_samples(None, response_raw=prom_body,
+                                  zero_copy=False)
+    arena = native.decode_samples(None, response_raw=prom_body,
+                                  zero_copy=True)
+    assert plain == arena
+    assert plain["samples"], "recorded prom body decoded to no samples"
+
+
+EDGE_CORPUS_VALID = [
+    '{"a":"\\u00e9 caf\xc3\xa9 \xf0\x9f\x98\x80"}',  # escapes + raw UTF-8
+    '"\\ud83d\\ude00 surrogate pair"',
+    '"\\n\\t\\"\\\\\\/\\b\\f\\r"',
+    '{"a":1,"a":2,"b":{"a":[1,2,{"c":null}]}}',  # duplicate keys: last wins
+    '[9223372036854775807,-9223372036854775808,1e308,-2.5e-308,0.0,-0.0]',
+    '[1e5,1E5,1e+5,1e-5,0e0]',  # exponent forms
+    '   {"ws":  [ 1 ,\t2 , 3 ]\n}  ',
+    '[[[[[[[[[[[[[[[["deep"]]]]]]]]]]]]]]]]',
+    '{"empty":{},"earr":[],"estr":""}',
+]
+
+EDGE_CORPUS_INVALID = [
+    "", "{", "[1,", '{"a":}', '"unterminated', '"bad\\q"', '"\\ud800"',
+    '"\\ud800x"', "01", "1.", ".5", "+1", "1e", "[1] trailing", "nul",
+    "tru", "falsey", '{"a" 1}', "[1 2]", '"tab\tliteral"', "'single'",
+    "\x00", '{"\\ud83d":1}',  # lone high surrogate in a KEY
+]
+
+
+def test_zero_copy_parity_on_edge_corpus(built):
+    """Escapes, UTF-8, surrogate pairs, number grammar edges, duplicate
+    keys and malformed inputs: both decoders accept/reject identically —
+    with the SAME error message — on every case."""
+    for body in EDGE_CORPUS_VALID:
+        value_path, doc_path = _both_paths(body)
+        assert value_path[0], f"valid edge case rejected: {body!r}: {value_path}"
+        _assert_parity(body, body)
+    for body in EDGE_CORPUS_INVALID:
+        value_path, doc_path = _both_paths(body)
+        assert not value_path[0], f"invalid edge case accepted: {body!r}"
+        assert value_path == doc_path, (
+            f"error divergence on {body!r}:\n value: {value_path}\n"
+            f" doc:   {doc_path}")
+
+
+def test_zero_copy_parity_under_truncation(built, fake_prom, fake_k8s):
+    """Every prefix of a real recorded body (the torn-read shape) must
+    behave identically through both decoders: same rejection, same
+    message — a decoder that reads past the buffer end is exactly what
+    this corpus plus `just asan-json` exists to catch."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "dep-0", num_pods=1,
+                                               tpu_chips=4)
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml", chips=4)
+    with urllib.request.urlopen(
+            fake_prom.url + "/api/v1/query?query=" +
+            quote('tensorcore_duty_cycle{exported_pod!=""}'),
+            timeout=10) as resp:
+        body = resp.read().decode()
+    assert len(body) > 80
+    step = max(1, len(body) // 97)  # ~97 prefixes incl. ragged offsets
+    for cut in range(0, len(body), step):
+        value_path, doc_path = _both_paths(body[:cut])
+        assert value_path == doc_path, (
+            f"truncation divergence at byte {cut}:\n value: {value_path}\n"
+            f" doc:   {doc_path}")
+
+
+# ── the transport families on /metrics ─────────────────────────────────
+
+
+def test_transport_metrics_served(built, fake_prom, fake_k8s):
+    """The shared-transport counters are served as /metrics families and
+    show the h2 connections the run actually opened."""
+    idle_cluster(fake_prom, fake_k8s, n=1)
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "dry-run", "--daemon-mode",
+           "--check-interval", "60", "--metrics-port", "auto"]
+    proc = subprocess.Popen(cmd, env=daemon_env(fake_k8s),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        for line in proc.stderr:
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port
+        deadline = time.time() + 30
+        body = ""
+        while time.time() < deadline:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+            if re.search(r'tpu_pruner_transport_connections_total\{[^}]*'
+                         r'protocol="h2"[^}]*\} [1-9]', body):
+                break
+            time.sleep(0.2)
+        for family in native.transport_metric_families():
+            assert family in body, f"{family} missing from /metrics"
+        assert re.search(r'tpu_pruner_transport_connections_total\{[^}]*'
+                         r'protocol="h2"[^}]*\} [1-9]', body), (
+            "h2 connection count never became non-zero on /metrics")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -149,6 +149,8 @@ def load() -> ctypes.CDLL:
         "tp_build_evidence_query",
         "tp_signal_assess",
         "tp_signal_metric_families",
+        "tp_transport_metric_families",
+        "tp_json_parse",
         "tp_enabled_resources",
         "tp_decode_samples",
         "tp_generate_event",
@@ -236,11 +238,39 @@ def enabled_resources(flags: str) -> list[str]:
     return _call("tp_enabled_resources", flags)["kinds"]
 
 
-def decode_samples(prom_response: dict, device: str = "tpu", schema: str = "gmp") -> dict:
-    """Decode a Prometheus instant-query response into pod metric samples."""
-    return _call(
-        "tp_decode_samples", {"response": prom_response, "device": device, "schema": schema}
-    )
+def transport_metric_families() -> list[str]:
+    """Canonical shared-transport metric family names served on /metrics —
+    the docs drift-guard test joins this list against docs/OPERATIONS.md."""
+    return _call("tp_transport_metric_families", {})["families"]
+
+
+def json_parse(body: str, zero_copy: bool = False) -> dict:
+    """Parse a JSON body through the Value parser or (zero_copy=True) the
+    arena/zero-copy Doc parser, returning canonical {"dump","pretty"} text.
+    The decode-parity tests assert both paths agree byte-for-byte (and
+    raise identical errors) on recorded transport bodies."""
+    return _call("tp_json_parse", {"body": body, "zero_copy": zero_copy})
+
+
+def decode_samples(
+    prom_response: dict | None,
+    device: str = "tpu",
+    schema: str = "gmp",
+    response_raw: str | None = None,
+    zero_copy: bool = False,
+) -> dict:
+    """Decode a Prometheus instant-query response into pod metric samples.
+
+    Pass `response_raw` (verbatim body text) to drive the decoder from raw
+    bytes; with zero_copy=True it runs the arena/Doc-walking decoder — the
+    parity tests compare both against identical input."""
+    payload: dict = {"device": device, "schema": schema}
+    if response_raw is not None:
+        payload["response_raw"] = response_raw
+        payload["zero_copy"] = zero_copy
+    else:
+        payload["response"] = prom_response
+    return _call("tp_decode_samples", payload)
 
 
 def generate_event(target: dict, device: str = "tpu", now: int | None = None) -> dict:

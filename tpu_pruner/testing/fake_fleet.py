@@ -21,20 +21,35 @@ from pathlib import Path
 def _popen_with_port(cmd, env):
     """Start a metrics-serving process and parse its ephemeral port from
     stderr, then keep draining stderr on a thread (a --check-interval 1
-    daemon logs enough to fill an undrained pipe mid-test)."""
+    daemon logs enough to fill an undrained pipe mid-test). Set
+    TP_FLEET_TEE=<path> to also append every member's stderr there —
+    interleaved member logs are the only way to debug a fleet fixture."""
+    import os
     import subprocess
 
     proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
                             stderr=subprocess.PIPE, text=True)
+    tee_path = os.environ.get("TP_FLEET_TEE")
+
+    def _sink(line):
+        if tee_path:
+            with open(tee_path, "a") as f:
+                f.write(line)
+
     port = None
     for line in proc.stderr:
+        _sink(line)
         m = re.search(r"serving /metrics on port (\d+)", line)
         if m:
             port = int(m.group(1))
             break
     assert port, f"{cmd[0]} never reported its metrics port"
-    drainer = threading.Thread(
-        target=lambda: [None for _ in proc.stderr], daemon=True)
+
+    def _drain():
+        for line in proc.stderr:
+            _sink(line)
+
+    drainer = threading.Thread(target=_drain, daemon=True)
     drainer.start()
     return proc, port
 

@@ -38,6 +38,8 @@ from urllib.parse import parse_qs, urlparse
 
 import multiprocessing
 
+from tpu_pruner.testing import h2_server
+
 
 def _mp_worker_main(fake: "FakeK8s", sock, conn) -> None:
     """Entry point of one forked API-server worker (start(workers=N)).
@@ -330,6 +332,10 @@ class FakeK8s:
         # targeted fault injection: (method or "*", exact path) → [code, n]
         # where n is the remaining failure count (-1 = fail forever)
         self.fail_rules: dict[tuple[str, str], list] = {}
+        # shared-transport accounting: accepted connections + h2 streams,
+        # so tests can assert multiplexing actually happened (e.g. a warm
+        # cycle opens <= 1 connection to this endpoint)
+        self.transport = h2_server.TransportStats()
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -728,7 +734,18 @@ class FakeK8s:
                                     "reason": "NotFound", "code": 404,
                                     "message": f"{self.path} not found"})
 
+            def setup(self):
+                super().setup()
+                fake.transport.connection_opened()
+
             def handle_one_request(self):
+                # Shared-transport clients may speak h2 (connection preface
+                # instead of a request line): hand the socket to the h2
+                # shim, which replays each stream through this same handler
+                # class — one request implementation, both protocols.
+                if h2_server.maybe_serve_h2(self, fake.transport):
+                    self.close_connection = True
+                    return
                 # Outage simulation: stop() alone can't take the server
                 # dark — handler threads keep serving pooled keep-alive
                 # connections — so every verb checks the switch first.

@@ -14,6 +14,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from tpu_pruner.testing import h2_server
+
 
 def promql_structure_error(query: str) -> str | None:
     """Structural lint of a received PromQL string: balanced (), {}, []
@@ -81,6 +83,10 @@ class FakePrometheus:
         self._cached = None
         self._cached_version = -1
         self._version = 0
+        # shared-transport accounting (see fake_k8s): connections accepted,
+        # h2 streams, peak concurrency — the concurrent idleness+evidence
+        # query pair shows up here as max_concurrent_streams >= 2.
+        self.transport = h2_server.TransportStats()
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -284,6 +290,18 @@ class FakePrometheus:
 
             def log_message(self, *args):  # silence
                 pass
+
+            def setup(self):
+                super().setup()
+                fake.transport.connection_opened()
+
+            def handle_one_request(self):
+                # h2 preface → the shared h2 shim (streams replay through
+                # this handler class); anything else is normal HTTP/1.1.
+                if h2_server.maybe_serve_h2(self, fake.transport):
+                    self.close_connection = True
+                    return
+                super().handle_one_request()
 
             def _respond(self, code: int, payload: dict):
                 body = json.dumps(payload).encode()
