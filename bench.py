@@ -464,9 +464,13 @@ def run_watch_cache_steady_state():
         # whatever the last successful scrape saw (2-cycle data when the
         # scrape wins the race, cold-cycle data at minimum).
         metrics_last: list = []
+        cpu_samples: list = []  # (monotonic, cpu_ms) for warm_cycle_cpu_ms
 
         def _scrape():
             while proc.poll() is None:
+                cpu = _proc_cpu_ms(proc.pid)
+                if cpu is not None:
+                    cpu_samples.append((time.monotonic(), cpu))
                 if metrics_port:
                     try:
                         body = urllib.request.urlopen(
@@ -476,7 +480,7 @@ def run_watch_cache_steady_state():
                             metrics_last[:] = [body]
                     except OSError:
                         pass
-                time.sleep(0.3)
+                time.sleep(0.1)
 
         scraper = threading.Thread(target=_scrape, daemon=True)
         scraper.start()
@@ -556,6 +560,14 @@ def run_watch_cache_steady_state():
         warm_p50 = statistics.median(lat)
         phases = _phase_percentiles(metrics_last[0]) if metrics_last else {
             "cycle_phase_p50_ms": {}, "cycle_phase_p95_ms": {}}
+        # Warm-cycle CPU (rusage-style utime+stime delta): from the warm
+        # cycle's detect instant to the last sample before exit — the CPU
+        # the daemon spent deciding + actuating the churn, next to the
+        # wall p50 so CPU-bound vs fixture-bound is visible at a glance.
+        warm_cycle_cpu_ms = None
+        before = [c for t, c in cpu_samples if t <= t_detect]
+        if before and cpu_samples:
+            warm_cycle_cpu_ms = cpu_samples[-1][1] - before[-1]
 
         # Signal-guard overhead + health: the section runs with
         # --signal-guard on (every registered pod's evidence is healthy by
@@ -668,6 +680,7 @@ def run_watch_cache_steady_state():
             "steady_state_api_calls": steady_calls,
             "steady_to_cold_call_ratio": round(ratio, 4),
             "churn_targets": CHURN_DEPLOYMENTS,
+            "warm_cycle_cpu_ms": warm_cycle_cpu_ms,
             "warm_p50_detect_to_scaledown_s": round(warm_p50, 3),
             "warm_p95_detect_to_scaledown_s": round(
                 lat[int(len(lat) * 0.95)], 3),
@@ -700,6 +713,11 @@ MEGA_CHIPS_PER_POD = 4
 MEGA_CHURN = 32 if MEGA_PODS >= 10000 else 8
 MEGA_BUSY_OWNERS = 128  # busy filler pods spread over this many deployments
 MEGA_WARM_P50_TARGET_S = 0.100
+# Perf-regression guard (ISSUE 10 satellite): warm p50 recorded on the
+# 1-core reference container with --incremental on; `just bench-mega`
+# fails when a run exceeds 110% of the recorded bar for its cluster
+# size. TP_MEGA_P50_BAR_S overrides on hosts with different baselines.
+MEGA_WARM_P50_RECORDED_S = {10240: 0.072, 50176: 0.092}
 
 
 def build_mega_cluster():
@@ -735,6 +753,19 @@ def build_mega_cluster():
     k8s.start(workers=1)
     prom.start()
     return k8s, prom
+
+
+def _proc_cpu_ms(pid: int):
+    """CPU milliseconds (utime+stime) consumed by `pid` so far, from
+    /proc/<pid>/stat — the rusage-style counter the warm_cycle_cpu_ms
+    fields are deltas of. None once the process is gone."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            fields = f.read().split(") ", 1)[1].split()
+        ticks = int(fields[11]) + int(fields[12])  # utime + stime
+        return ticks * 1000 // os.sysconf("SC_CLK_TCK")
+    except (OSError, IndexError, ValueError):
+        return None
 
 
 def _mega_daemon_cmd(prom, k8s, *extra):
@@ -830,9 +861,16 @@ def run_mega_tier():
         "mega_shards_auto": shards_auto,
     }
     try:
-        # ── phase A: cold reclaim + warm churn (latency + API accounting) ──
+        # ── phase A: cold reclaim → settle → warm churn (latency + API
+        # accounting), --incremental on (the ISSUE 10 engine). Three
+        # cycles: cycle 1 reclaims and mutates the cluster, cycle 2
+        # converges the decision cache (every root re-verified by the
+        # consumers as an ALREADY_PAUSED no-op), cycle 3 is the true warm
+        # steady state — O(churn) CPU and API — and is what the 100 ms
+        # detect→scaledown bar is measured against.
         cmd, env = _mega_daemon_cmd(
-            prom, k8s, "--max-cycles", "2", "--check-interval", "25",
+            prom, k8s, "--incremental", "on",
+            "--max-cycles", "3", "--check-interval", "25",
             "--flight-dir", str(flight_dir), "--flight-keep", "4")
         daemon = _MegaDaemon(cmd, env)
         try:
@@ -858,6 +896,11 @@ def run_mega_tier():
                     f"informer LIST did not paginate: {pod_lists[:3]}")
             result["mega_informer_pod_list_pages"] = len(paged)
 
+            # settle: wait out cycle 2 (its query + the no-op drain) so
+            # the cache is converged before the churn lands
+            while len(prom.query_times) < 2 and time.monotonic() < deadline:
+                time.sleep(0.2)
+            time.sleep(3.0)
             churn_paths = set()
             for i in range(MEGA_CHURN):
                 _, _, pods = k8s.add_deployment_chain(
@@ -921,11 +964,93 @@ def run_mega_tier():
             "mega_cycle_phase_p50_ms": phases["cycle_phase_p50_ms"],
             "mega_cycle_phase_p95_ms": phases["cycle_phase_p95_ms"],
         })
+        inc_ratio = None
+        if daemon.metrics_last:
+            m = _re_t.search(
+                r'^tpu_pruner_incremental_cache_hit_ratio(?:\{[^}]*\})? (\S+)',
+                daemon.metrics_last[0], _re_t.M)
+            if m:
+                inc_ratio = float(m.group(1))
+        result["mega_incremental_cache_hit_ratio"] = inc_ratio
         if warm_p50 >= MEGA_WARM_P50_TARGET_S:
             raise RuntimeError(
                 f"MEGA TARGET MISS: warm p50 detect→scaledown "
                 f"{warm_p50 * 1000:.1f} ms >= "
                 f"{MEGA_WARM_P50_TARGET_S * 1000:.0f} ms")
+        # Perf-regression guard: the bar already MET must not silently
+        # erode — fail the tier when warm p50 exceeds 110% of the
+        # recorded bar for this cluster size (TP_MEGA_P50_BAR_S overrides
+        # for hosts with a different recorded baseline).
+        recorded_bar = MEGA_WARM_P50_RECORDED_S.get(MEGA_PODS)
+        if os.environ.get("TP_MEGA_P50_BAR_S"):
+            recorded_bar = float(os.environ["TP_MEGA_P50_BAR_S"])
+        result["mega_warm_p50_recorded_bar_s"] = recorded_bar
+        if recorded_bar is not None and warm_p50 > 1.10 * recorded_bar:
+            raise RuntimeError(
+                f"MEGA REGRESSION: warm p50 {warm_p50 * 1000:.1f} ms exceeds "
+                f"110% of the recorded bar ({recorded_bar * 1000:.1f} ms)")
+
+        # ── phase A2: warm-cycle CPU, differential vs full engine ──
+        # The quiesced (all-paused) cluster is exactly the warm steady
+        # state; run 4 back-to-back scale-down cycles per mode and charge
+        # each mode the /proc utime+stime consumed between its 3rd and
+        # 4th Prometheus queries — one fully-warm cycle, cache converged
+        # (the full engine has no convergence, every cycle is the same).
+        def _warm_cpu_probe(mode):
+            # interval 2 s: the converging cycle's no-op drain must finish
+            # before the next cycle plans, or the cache never converges
+            pcmd, penv = _mega_daemon_cmd(
+                prom, k8s, "--incremental", mode,
+                "--max-cycles", "6", "--check-interval", "2")
+            q_base = len(prom.query_times)
+            d = _MegaDaemon(pcmd, penv)
+            samples = []  # (wall, cpu_ms)
+            try:
+                probe_deadline = time.monotonic() + 600
+                while d.proc.poll() is None and time.monotonic() < probe_deadline:
+                    cpu = _proc_cpu_ms(d.proc.pid)
+                    if cpu is not None:
+                        samples.append((time.monotonic(), cpu))
+                    time.sleep(0.02)
+                d.wait(timeout=60)
+            finally:
+                d.kill()
+            queries = prom.query_times[q_base:]
+            if len(queries) < 6 or not samples:
+                return None, None
+            def cpu_at(t):
+                best = None
+                for wall, cpu in samples:
+                    if wall <= t:
+                        best = cpu
+                    else:
+                        break
+                return best if best is not None else samples[0][1]
+            warm_cpu = cpu_at(queries[5]) - cpu_at(queries[4])
+            ratio = None
+            if mode == "on" and d.metrics_last:
+                m = _re_t.search(
+                    r'^tpu_pruner_incremental_cache_hit_ratio(?:\{[^}]*\})? (\S+)',
+                    d.metrics_last[0], _re_t.M)
+                if m:
+                    ratio = float(m.group(1))
+            return warm_cpu, ratio
+
+        warm_cpu_on, quiesced_ratio = _warm_cpu_probe("on")
+        warm_cpu_off, _ = _warm_cpu_probe("off")
+        result["mega_warm_cycle_cpu_ms"] = warm_cpu_on
+        result["mega_full_warm_cycle_cpu_ms"] = warm_cpu_off
+        result["mega_quiesced_cache_hit_ratio"] = quiesced_ratio
+        if quiesced_ratio is not None and quiesced_ratio < 0.95:
+            raise RuntimeError(
+                f"ACCEPTANCE MISS: quiesced-cluster cache hit ratio "
+                f"{quiesced_ratio:.3f} < 0.95")
+        if (warm_cpu_on is not None and warm_cpu_off is not None
+                and warm_cpu_off > 50 and warm_cpu_on >= warm_cpu_off):
+            raise RuntimeError(
+                f"ACCEPTANCE MISS: differential warm-cycle CPU "
+                f"{warm_cpu_on} ms is not below the full engine's "
+                f"{warm_cpu_off} ms")
 
         # ── phase B: shard-count scaling curve (dry-run, store-served) ──
         # Same cluster, decisions untouched (dry-run). The resolve phase
@@ -988,6 +1113,79 @@ def run_mega_tier():
         result["mega_overlap_speedup"] = (
             round(overlap_walls["off"] / overlap_walls["on"], 3)
             if overlap_walls["on"] else None)
+
+        # ── phase E: byte-identity at mega scale ──
+        # Audit JSONL + flight capsules must be byte-identical between
+        # --incremental on and off at shard counts 1 and auto, on the
+        # same quiesced cluster (dry-run; volatile clock/trace fields and
+        # the capsule's "incremental" provenance stamp normalized away —
+        # the ISSUE 10 acceptance bar, asserted at full scale).
+        volatile = {"ts", "ts_unix", "ts_ms", "now_unix", "trace_id", "id",
+                    "incremental"}
+
+        def _norm(obj):
+            if isinstance(obj, dict):
+                return {k: _norm(v) for k, v in obj.items()
+                        if k not in volatile}
+            if isinstance(obj, list):
+                return [_norm(v) for v in obj]
+            return obj
+
+        import tempfile as _tempfile
+        identity_dir = Path(_tempfile.mkdtemp(prefix="tp-mega-ident-"))
+        shard_points = [1]
+        if shards_auto != 1:
+            shard_points.append(shards_auto)
+        for shards in shard_points:
+            digests = {}
+            for mode in ("off", "on"):
+                audit = identity_dir / f"audit-{shards}-{mode}.jsonl"
+                flight = identity_dir / f"flight-{shards}-{mode}"
+                icmd, ienv = _mega_daemon_cmd(
+                    prom, k8s, "--incremental", mode,
+                    "--shards", str(shards),
+                    "--max-cycles", "2", "--check-interval", "0",
+                    "--audit-log", str(audit),
+                    "--flight-dir", str(flight), "--flight-keep", "2")
+                icmd[icmd.index("scale-down")] = "dry-run"
+                d = _MegaDaemon(icmd, ienv)
+                try:
+                    d.wait(timeout=600)
+                finally:
+                    d.kill()
+                records = [_norm(json.loads(line))
+                           for line in audit.read_text().splitlines()]
+                caps = [_norm(json.loads(p.read_text()))
+                        for p in sorted(flight.glob("cycle-*.json"))]
+                if not records or not caps:
+                    raise RuntimeError(
+                        f"mega identity run ({shards} shards, {mode}) "
+                        "produced no audit records or capsules")
+                digests[mode] = (json.dumps(records, sort_keys=True),
+                                 json.dumps(caps, sort_keys=True))
+            if digests["off"][0] != digests["on"][0]:
+                raise RuntimeError(
+                    f"ACCEPTANCE MISS: audit JSONL differs between "
+                    f"--incremental on|off at {shards} shard(s)")
+            if digests["off"][1] != digests["on"][1]:
+                raise RuntimeError(
+                    f"ACCEPTANCE MISS: capsules differ between "
+                    f"--incremental on|off at {shards} shard(s)")
+        result["mega_incremental_byte_identity_ok"] = True
+        # The on-mode capsules must also replay bit-for-bit offline.
+        ident_caps = sorted(
+            (identity_dir / f"flight-{shard_points[-1]}-on").glob(
+                "cycle-*.json"))
+        rep = subprocess.run(
+            [sys.executable, "-m", "tpu_pruner.analyze", "--replay",
+             str(ident_caps[-1])],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=str(Path(__file__).resolve().parent))
+        if rep.returncode != 0 or not json.loads(rep.stdout).get("match"):
+            raise RuntimeError(
+                "mega incremental capsule replay drifted: "
+                f"{(rep.stderr or rep.stdout)[-500:]}")
     finally:
         k8s.stop()
         prom.stop()
@@ -2111,6 +2309,9 @@ def main():
         "steady_state_api_calls": watch_cache["steady_state_api_calls"],
         "warm_p50_detect_to_scaledown_s": watch_cache[
             "warm_p50_detect_to_scaledown_s"],
+        # rusage-style utime+stime spent on the warm (churn) cycle — next
+        # to the wall p50 so CPU-bound vs fixture-bound reads at a glance
+        "warm_cycle_cpu_ms": watch_cache.get("warm_cycle_cpu_ms"),
         # the daemon's OWN phase-latency histograms, read off /metrics
         # during the watch-cache section (query/decode/resolve/actuate/total)
         "cycle_phase_p50_ms": watch_cache["cycle_phase_p50_ms"],

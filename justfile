@@ -113,6 +113,15 @@ gym-smoke:
 bench-mega:
     TP_MEGA_PODS=10240 python bench.py --mega-only
 
+# differential-reconcile race tier: the dirty-tracker + decision cache
+# (written by the producer's plan/commit while consumer threads report
+# actuation outcomes) and the informer's dirty journal under
+# ThreadSanitizer (substring filter of the native test binary)
+tsan-incremental:
+    cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
+    ./build-tsan/tpupruner_tests incremental
+    ./build-tsan/tpupruner_tests informer
+
 # shard-engine race tier: the sharded resolve fan-out, worker pool reuse
 # and the informer's concurrent 410+relist coalescing under
 # ThreadSanitizer (substring filter of the native test binary)

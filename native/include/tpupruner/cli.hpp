@@ -83,6 +83,14 @@ struct Cli {
   // breaker, brownout and --max-scale-per-cycle caps still apply per
   // cycle). "off" (default) keeps the strictly serial producer loop.
   std::string overlap = "off";
+  // --incremental {on, off}: differential reconcile engine
+  // (incremental.hpp). "on" fuses watch-event, sample-diff and
+  // config/clock invalidation into per-root dirty marks and serves clean
+  // roots from a memoized decision cache, making warm-cycle CPU O(churn);
+  // requires --watch-cache on (the dirty journal is watch-driven). "off"
+  // (default) keeps the full per-cycle recompute — exact output parity
+  // either way (audit JSONL, capsules, ledger, replay are byte-identical).
+  std::string incremental = "off";
   // --transport: shared h2 transport mode (auto = ALPN/prior-knowledge
   // negotiation with transparent HTTP/1.1 fallback; http1 = parity escape
   // hatch). --zero-copy-json: arena decode at the LIST/watch and

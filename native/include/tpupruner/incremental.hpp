@@ -1,0 +1,236 @@
+// Differential reconcile engine: dirty-set invalidation + a memoized
+// per-root decision cache (the ISSUE 10 perf tentpole).
+//
+// PRs 8–9 made warm-cycle *API traffic* O(churn): with a synced watch
+// store, a quiesced 50k-pod cluster costs a handful of API calls per
+// cycle. But the CPU spent per cycle still scaled with the candidate set
+// — every cycle re-ran acquire → eligibility → owner walk → record
+// construction → enqueue → consumer no-op over thousands of pods whose
+// inputs had not changed since the previous cycle. This module makes the
+// warm-cycle CPU itself O(churn): a pod whose decoded Prometheus samples
+// are identical to last cycle's, whose Pod object and every owner object
+// its walk consulted saw no watch event, and whose decision carries no
+// armed timer, is CLEAN — and the per-root decision cache replays its
+// DecisionRecords, scale target, ledger observation and flight-capsule
+// evidence verbatim (re-stamped with the current cycle id/ts) instead of
+// recomputing them.
+//
+// Invalidation fuses three sources into per-unit dirty marks (a unit is a
+// resolved root, or a rootless candidate pod):
+//   1. informer watch events — the dirty journal (informer.hpp) maps
+//      ADDED/MODIFIED/DELETED object paths onto units via the pod→unit
+//      map and the consulted-object reverse index; a relist (events may
+//      have been missed) or an unsynced store is GLOBALLY dirty.
+//   2. Prometheus sample diffing — metrics::sample_fingerprint over the
+//      decoded samples; a new, absent, or changed sample dirties the pod
+//      and its unit. Signal-guard verdict flips ride the same diff: a
+//      vetoed pod leaves the post-veto candidate set (absent ⇒ dirty),
+//      and a recovered one re-enters it (new ⇒ dirty).
+//   3. config/clock edges — a config-fingerprint change clears the cache
+//      outright; timer-armed units (BELOW_MIN_AGE pods waiting out the
+//      lookback window) self-dirty at their deadline, never silently
+//      staying stale.
+//
+// What is deliberately NEVER cached (correctness before hit ratio):
+//   - units whose evidence came from a live GET fallback (store miss) or
+//     whose cycle saw a fetch error / namespace veto: transients self-heal
+//     by recomputation;
+//   - units whose last actuation mutated the cluster (SCALED,
+//     RIGHT_SIZED, SCALE_FAILED) or has not reported back yet;
+//   - per-cycle cross-root verdicts (breaker deferrals, brownouts,
+//     namespace vetoes, right-size plans): those gates re-run every cycle
+//     over the MERGED target set (cached + recomputed), so the caps stay
+//     per-cycle properties and a deferral is never served from cache.
+//     The group all-idle gate caches only a VERIFIED all-idle verdict,
+//     invalidated by any pod watch event in the group's namespace (see
+//     Unit::GroupVerdict).
+//
+// The byte-identity contract: with --incremental on, audit JSONL,
+// /debug/decisions, flight capsules, ledger integration and
+// `analyze --replay` are byte-identical to --incremental off at every
+// shard count (volatile clock/trace fields aside, plus the capsule's
+// "incremental" provenance stamp, which records the dirty set and cache
+// hits so a replay can re-derive the same view offline).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "tpupruner/audit.hpp"
+#include "tpupruner/core.hpp"
+#include "tpupruner/informer.hpp"
+#include "tpupruner/json.hpp"
+#include "tpupruner/ledger.hpp"
+
+namespace tpupruner::incremental {
+
+// Per-pod acquisition + walk evidence, cached so a clean unit can replay
+// its flight-capsule contributions (recorder::record_pod /
+// record_resolution) without touching the store.
+struct PodEvidence {
+  std::string key;  // "ns/name"
+  bool has_pod = false;
+  json::Value pod;  // as consulted (COW — shares nodes with the store)
+  bool store_missed = false;
+  bool walked = false;  // reached the owner walk (eligible or opted out)
+  std::vector<std::string> chain;
+  std::string root_kind, root_ns, root_name, identity, walk_error;
+};
+
+// One cacheable unit: the per-root (or per-rootless-pod) slice of a
+// cycle's resolve output, plus everything needed to re-stamp its records
+// and capsule evidence into a later cycle.
+struct Unit {
+  std::string key;  // root identity, or "pod:<ns>/<name>" for rootless pods
+  // Contributing candidate pods with their sample fingerprints.
+  std::vector<std::pair<std::string, uint64_t>> members;
+  std::vector<PodEvidence> evidence;
+  // Records terminal at the resolve stage (ineligible pods, failed walks).
+  std::vector<audit::DecisionRecord> decided;
+  // Per-pod records that resolved to this unit's root; their verdict is
+  // joined against the per-cycle gate outcomes, exactly like freshly
+  // resolved records.
+  std::vector<audit::DecisionRecord> resolved;
+  bool has_target = false;
+  core::ScaleTarget target;  // object included (COW)
+  bool vetoed_root = false;  // an annotated member pod vetoes this root
+  std::vector<std::string> idle_pods;  // "ns/name" members that were idle+eligible
+  bool has_obs = false;
+  ledger::Observation obs;
+  // Owner/root object paths this unit's walks consulted (404 misses
+  // included) — the capsule object snapshot AND the watch-event reverse
+  // index both come from this list.
+  std::vector<std::pair<std::string, std::optional<json::Value>>> objects;
+  // Invalidation state.
+  bool never_cache = false;   // transients: recompute every cycle
+  int64_t deadline_unix = 0;  // self-dirty at this unix time (0 = no timer)
+  // Group-kind roots (JobSet/LWS): the all-idle gate's verdict depends on
+  // pods OUTSIDE the candidate set, so it is cached only as IDLE (a
+  // verified all-idle LIST) and invalidated by ANY pod watch event in the
+  // root's namespace; Unknown (never verified, gate failed, or group not
+  // fully idle) recomputes — and re-gates — every cycle.
+  enum class GroupVerdict : uint8_t { NotGroup, Unknown, Idle };
+  GroupVerdict group_verdict = GroupVerdict::NotGroup;
+  std::string group_ns;  // root namespace (group units only)
+  // Actuation state machine. Only a unit whose last enqueue came back as a
+  // cacheable no-op (ALREADY_PAUSED / KIND_DISABLED) may skip the queue;
+  // anything that mutated the cluster — or has not reported back yet —
+  // recomputes next cycle.
+  enum class Actuation : uint8_t { None, InFlight, Noop, Mutated };
+  Actuation actuation = Actuation::None;
+  uint64_t actuation_cycle = 0;
+  audit::Reason noop_reason = audit::Reason::AlreadyPaused;
+  std::string noop_action, noop_detail;
+};
+
+class Engine {
+ public:
+  // Enable/disable and (re)key the cache. A fingerprint change (any
+  // decision-affecting flag) clears every cached unit — config edges are
+  // invalidation source 3.
+  void configure(bool enabled, uint64_t config_fingerprint);
+  bool enabled() const;
+
+  // One cycle's differential plan: which candidate samples must recompute
+  // and which units serve from cache.
+  struct Plan {
+    bool active = false;  // engine enabled and the planner ran this cycle
+    bool full = false;    // global dirty: every candidate recomputes
+    std::vector<size_t> recompute;         // indices into the sample vector
+    std::vector<std::string> dirty_units;  // unit keys being recomputed
+    // Units served from cache this cycle. Pointers stay valid until
+    // commit_cycle: only the producer thread inserts/erases units, and
+    // consumers only touch actuation fields.
+    std::map<std::string, const Unit*> cached;
+    size_t hits = 0;        // cached pods (not units)
+    size_t pods_total = 0;  // candidate pods this cycle
+  };
+
+  // Fuse the invalidation sources against the post-veto candidate set.
+  // `store_trusted` must be false whenever the watch store cannot vouch
+  // for object freshness (not fully synced) — the plan degrades to a full
+  // recompute rather than serving possibly-stale decisions.
+  Plan plan_cycle(const std::vector<core::PodMetricSample>& samples,
+                  const informer::ClusterCache::DirtyDrain& drain, int64_t now_unix,
+                  bool store_trusted);
+
+  // Wave-2 invalidation: a recomputed pod's walk resolved to `unit_key`,
+  // which the plan had marked clean (e.g. a new pod joined a cached
+  // root). Drops the unit from the cache-served set and returns its
+  // member pod keys so the caller re-walks them too. Empty when the unit
+  // was not being served from cache.
+  std::vector<std::string> invalidate_unit(Plan& plan, const std::string& unit_key);
+
+  // Replace the dirty units (and drop vanished ones) with this cycle's
+  // freshly built units; cached units carry forward untouched. Under
+  // plan.full the whole cache is rebuilt.
+  void commit_cycle(const Plan& plan, std::vector<Unit> fresh_units);
+
+  // Producer: the group all-idle gate verified this unit's group as fully
+  // idle this cycle — the verdict may serve from cache until a pod event
+  // lands in the group's namespace. `fully_idle == false` resets to
+  // Unknown (re-gate every cycle; a failed LIST must not stick).
+  void record_group_verdict(const std::string& unit_key, bool fully_idle);
+  // Producer: a unit's target entered the scale queue this cycle — its
+  // outcome is unknown until the consumer reports back, so it recomputes
+  // next cycle unless record_actuation_outcome lands a cacheable no-op.
+  void mark_enqueued(uint64_t cycle, const std::string& unit_key);
+  // Consumer: the actuation outcome for a unit enqueued this cycle.
+  void record_actuation_outcome(uint64_t cycle, const std::string& unit_key,
+                                audit::Reason reason, const std::string& action,
+                                const std::string& detail);
+
+  // The capsule provenance stamp: {"enabled", "full", "pods",
+  // "cache_hits", "hit_ratio", "dirty_units"} — how this cycle's view was
+  // assembled, so an offline replay (which always recomputes in full) can
+  // attribute any drift to a specific dirty set.
+  json::Value provenance_json(const Plan& plan) const;
+
+  size_t unit_count() const;
+  void reset();
+
+ private:
+  bool unit_dirty_locked(const Unit& u, int64_t now_unix,
+                         const std::unordered_map<std::string, size_t>& present) const;
+  void index_unit_locked(const Unit& u);
+  void unindex_unit_locked(const Unit& u);
+
+  mutable std::mutex mutex_;
+  bool enabled_ = false;
+  uint64_t config_fp_ = 0;
+  std::unordered_map<std::string, Unit> units_;
+  std::unordered_map<std::string, std::string> pod_unit_;  // pod key → unit key
+  std::unordered_map<std::string, uint64_t> pod_fp_;       // pod key → sample fp
+  // Consulted object path → unit keys (watch-event reverse index).
+  std::unordered_map<std::string, std::set<std::string>> path_units_;
+  // Namespace → group-unit keys (pod-event invalidation of gate verdicts).
+  std::unordered_map<std::string, std::set<std::string>> ns_groups_;
+};
+
+// Process-wide engine (daemon lifetime; reset_for_test between tests).
+Engine& engine();
+
+// "ns/name" for an informer pods path ("/api/v1/namespaces/<ns>/pods/<n>"),
+// empty for any other resource path.
+std::string pod_key_of_path(const std::string& path);
+
+// Per-cycle gauges for /metrics (absent until the first incremental cycle
+// publishes, like the signal families):
+//   tpu_pruner_incremental_cache_hit_ratio   gauge (cached pods / candidates)
+//   tpu_pruner_incremental_cached_pods       gauge
+//   tpu_pruner_incremental_dirty_pods        gauge
+//   tpu_pruner_incremental_full_recomputes_total  counter
+void publish_metrics(const Engine::Plan& plan);
+std::string render_metrics(bool openmetrics);
+std::vector<std::string> metric_families();
+
+void reset_for_test();
+
+}  // namespace tpupruner::incremental

@@ -153,9 +153,24 @@ class Reflector {
   // missing — such objects are ignored, never half-keyed).
   std::string object_path_of(const json::Value& object) const;
 
+  // ── dirty journal (incremental reconcile, incremental.hpp) ──
+  // When enabled, every applied ADDED/MODIFIED/DELETED event appends its
+  // object path to a per-reflector journal and every LIST snapshot
+  // (initial sync or relist — events may have been missed) marks the
+  // journal GLOBALLY dirty. drain_dirty() moves the journal out under the
+  // lock; the journal is bounded (overflow degrades to globally dirty,
+  // never to a silently dropped invalidation). Off by default: without a
+  // drain the journal would grow for the life of the process.
+  void enable_dirty_journal();
+  // const: drains a logically-external queue (the cycle holds the cache
+  // by const pointer); journal state is mutable under its own mutex.
+  void drain_dirty(std::vector<std::string>& paths, bool& all) const;
+
  private:
   void run();  // thread body: relist loop wrapping the watch loop
   void bump_watch_failure(const std::string& why);
+  void journal_touch(const std::string& path);  // dirty-journal append
+  void journal_all();                           // dirty-journal global mark
   // Mark a relist request; returns false when one was already pending
   // (the request is coalesced, not stacked).
   bool request_relist(const std::string& why);
@@ -168,6 +183,13 @@ class Reflector {
   std::atomic<bool> stop_{false};
   std::atomic<bool> relist_pending_{false};
   std::atomic<int64_t> last_activity_mono_{0};
+  // Dirty journal: touched object paths since the last drain. Guarded by
+  // dirty_mutex_; journal_enabled_ is set once before start() (daemon
+  // startup) and read on every event, so it is atomic.
+  std::atomic<bool> journal_enabled_{false};
+  mutable std::mutex dirty_mutex_;
+  mutable std::vector<std::string> dirty_paths_;
+  mutable bool dirty_all_ = false;
   std::thread thread_;
   mutable std::mutex stats_mutex_;
   ResourceStats stats_;
@@ -210,6 +232,19 @@ class ClusterCache {
 
   // Aggregate + per-resource stats (capi/tests/metrics).
   json::Value stats_json() const;
+
+  // ── dirty journal (incremental reconcile) ──
+  // Enable journaling on every reflector (call before start()).
+  void enable_dirty_journal();
+  // Everything touched since the last drain, across all resources.
+  // `all == true` means at least one resource relisted (or its journal
+  // overflowed) — events may have been missed, so the caller must treat
+  // the WHOLE world as dirty, not just `paths`.
+  struct DirtyDrain {
+    bool all = false;
+    std::vector<std::string> paths;
+  };
+  DirtyDrain drain_dirty() const;
 
  private:
   const Reflector* route(const std::string& object_path) const;

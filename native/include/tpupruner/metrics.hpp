@@ -45,4 +45,15 @@ DecodeResult decode_instant_vector(const json::Value& response, const std::strin
 DecodeResult decode_instant_vector(const json::Doc& response, const std::string& device,
                                    const std::string& schema = "gmp");
 
+// Sample-diff fingerprint (the incremental reconcile engine's
+// invalidation source 2): FNV-1a over every decoded field of the sample —
+// the entirety of what one candidate feeds into the decision pipeline, so
+// equal fingerprints mean the pod's Prometheus evidence cannot change the
+// cycle's output. Byte-equal raw series always decode to equal samples;
+// decode-equal is strictly tighter (label reordering or whitespace churn
+// in the response body never false-dirties a pod). Identical across the
+// Value and Doc decode paths by construction: both produce the same
+// PodMetricSample.
+uint64_t sample_fingerprint(const core::PodMetricSample& sample);
+
 }  // namespace tpupruner::metrics

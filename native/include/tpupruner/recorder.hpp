@@ -91,6 +91,11 @@ void record_object(uint64_t cycle, const std::string& path, const json::Value* o
 // capsules.
 void record_ledger(uint64_t cycle, int64_t now_unix,
                    const std::vector<ledger::Observation>& observations);
+// Differential-engine provenance: the cycle's dirty set + cache-hit
+// counts (incremental::Engine::provenance_json). Pure metadata — replay
+// recomputes in full and never reads it; byte-identity comparisons
+// between --incremental modes normalize the "incremental" key away.
+void record_incremental(uint64_t cycle, json::Value provenance);
 // Cycle facts: fail-closed veto sets, per-root gate flags, breaker stamp.
 void record_vetoes(uint64_t cycle, const std::vector<std::string>& vetoed_roots,
                    const std::vector<std::pair<std::string, std::string>>& vetoed_namespaces);
@@ -103,12 +108,16 @@ void record_stats(uint64_t cycle, size_t num_series, size_t num_pods,
 // Final DecisionRecord (verbatim JSON) — wired as the audit record sink.
 void record_decision(uint64_t cycle, json::Value decision);
 // Arm the capsule for `expected` consumer actuations; 0 seals immediately
-// (dry-run / no-candidate cycles). Each record_actuation decrements and
-// the last one seals (writes the capsule to the ring).
+// (dry-run / no-candidate cycles). Each counting record_actuation
+// decrements and the last one seals (writes the capsule to the ring);
+// consumer outcomes that land BEFORE arm() are credited at arm time (the
+// incremental fast path enqueues first, emits cached records, then
+// arms). `counts_toward_seal = false` stamps an outcome without touching
+// the seal count — the producer-side cached no-op replay.
 void arm(uint64_t cycle, size_t expected);
 void record_actuation(uint64_t cycle, const std::string& identity,
                       const std::string& reason, const std::string& action,
-                      const std::string& detail);
+                      const std::string& detail, bool counts_toward_seal = true);
 // Shutdown flush: seal every armed capsule still waiting on a drained
 // queue (its dropped targets' SHUTDOWN_ABORTED records are already in).
 void seal_all();

@@ -18,9 +18,9 @@
 #include <memory>
 #include <mutex>
 #include <optional>
-#include <set>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "tpupruner/core.hpp"
@@ -93,6 +93,16 @@ using ObjectFetcher = std::function<std::optional<json::Value>(const std::string
 core::ScaleTarget find_root_object_from(const ObjectFetcher& fetch, const json::Value& pod,
                                         std::vector<std::string>* chain_out = nullptr);
 
+// The live read-through chain (per-cycle cache → watch store → GET) as an
+// ObjectFetcher — exactly what find_root_object wraps. Exposed so the
+// incremental reconcile engine can interpose a tracing fetcher and record
+// which object paths a pod's walk consulted (the watch-event reverse
+// index and the cached capsule object snapshot both need the per-pod
+// list). The returned fetcher borrows `client`/`cache`/`store`: they must
+// outlive it (one resolve stage).
+ObjectFetcher live_fetcher(const k8s::Client& client, FetchCache* cache,
+                           const informer::ClusterCache* store);
+
 // Resolve the root scalable object for a pod (fetched Pod JSON).
 // Throws std::runtime_error("no scalable root object ...") when the pod has
 // no recognized owner chain — callers log-and-skip (main.rs:517-527).
@@ -109,8 +119,10 @@ core::ScaleTarget find_root_object(const k8s::Client& client, const json::Value&
                                    const informer::ClusterCache* watch_cache = nullptr,
                                    std::vector<std::string>* chain_out = nullptr);
 
-// Key "ns/pod" set of idle pods discovered this cycle.
-using IdlePodSet = std::set<std::string>;
+// Key "ns/pod" set of idle pods discovered this cycle. Unordered: only
+// membership is ever asked (the group gates), and at fleet scale the
+// per-cycle inserts sit on the reconcile hot path.
+using IdlePodSet = std::unordered_set<std::string>;
 inline std::string pod_key(const std::string& ns, const std::string& name) {
   return ns + "/" + name;
 }

@@ -37,6 +37,10 @@ struct Registry {
   // (cycle << separator) root identity → records awaiting the consumer
   std::map<std::pair<uint64_t, std::string>, PendingGroup> pending;
   std::map<uint64_t, ActuationTracker> actuations;
+  // actuation_done calls that arrive BEFORE arm_actuation (the
+  // incremental fast path enqueues first, emits cached records, then
+  // arms): cycle → {completions, noops}, credited and erased at arm.
+  std::map<uint64_t, std::pair<size_t, size_t>> early_dones;
   std::string audit_log_path;
   std::FILE* audit_log = nullptr;
   bool capacity_read = false;
@@ -259,15 +263,28 @@ void arm_actuation(uint64_t cycle, size_t expected, const std::string& trace_id)
   t.remaining = expected;
   t.trace_id = trace_id;
   t.armed_at = std::chrono::steady_clock::now();
+  // Credit consumer completions that landed before arming (the
+  // incremental fast path arms after its cached records emit) and drop
+  // stale pre-arm entries of older cycles (cycles arm monotonically).
+  if (auto e = r.early_dones.find(cycle); e != r.early_dones.end()) {
+    t.remaining = expected > e->second.first ? expected - e->second.first : 0;
+    t.noops = e->second.second;
+  }
+  r.early_dones.erase(r.early_dones.begin(), r.early_dones.upper_bound(cycle));
   auto [it, _] = r.actuations.insert_or_assign(cycle, std::move(t));
-  if (expected == 0) observe_actuation_locked(r, it);
+  if (it->second.remaining == 0) observe_actuation_locked(r, it);
 }
 
 void actuation_done(uint64_t cycle, bool was_noop) {
   Registry& r = reg();
   std::lock_guard<std::mutex> lock(r.mutex);
   auto it = r.actuations.find(cycle);
-  if (it == r.actuations.end()) return;
+  if (it == r.actuations.end()) {
+    auto& early = r.early_dones[cycle];
+    ++early.first;
+    if (was_noop) ++early.second;
+    return;
+  }
   if (was_noop) ++it->second.noops;
   if (it->second.remaining > 0 && --it->second.remaining == 0) {
     observe_actuation_locked(r, it);
@@ -316,6 +333,7 @@ void reset_for_test() {
   r.ring.clear();
   r.pending.clear();
   r.actuations.clear();
+  r.early_dones.clear();
   r.dropped = 0;
   r.cycle.store(0);
   if (r.audit_log) {

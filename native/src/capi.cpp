@@ -17,6 +17,7 @@
 #include "tpupruner/fleet.hpp"
 #include "tpupruner/gym.hpp"
 #include "tpupruner/h2.hpp"
+#include "tpupruner/incremental.hpp"
 #include "tpupruner/recorder.hpp"
 #include "tpupruner/core.hpp"
 #include "tpupruner/informer.hpp"
@@ -536,6 +537,20 @@ char* tp_transport_metric_families(const char*) {
   return guarded([&] {
     Value families = Value::array();
     for (const std::string& f : tpupruner::h2::transport_metric_families()) {
+      families.push_back(Value(f));
+    }
+    Value out = Value::object();
+    out.set("families", std::move(families));
+    return ok(out);
+  });
+}
+
+char* tp_incremental_metric_families(const char*) {
+  // The canonical differential-engine metric family names — the
+  // docs-drift test joins this against docs/OPERATIONS.md.
+  return guarded([&] {
+    Value families = Value::array();
+    for (const std::string& f : tpupruner::incremental::metric_families()) {
       families.push_back(Value(f));
     }
     Value out = Value::object();

@@ -126,6 +126,15 @@ TPU FLAGS:
                                 brownout) are unaffected; best with short
                                 --check-interval (prefetched evidence ages by
                                 up to one interval otherwise)
+      --incremental <M>         on | off [default: off] — differential
+                                reconcile: watch events, Prometheus sample
+                                diffs and config/clock edges mark roots dirty;
+                                clean roots replay from a memoized decision
+                                cache (records re-stamped with the current
+                                cycle), so warm-cycle CPU scales with churn,
+                                not cluster size. Requires --watch-cache on.
+                                Output parity with "off" is byte-identical
+                                (audit JSONL, capsules, ledger, replay)
       --transport <M>           auto | h2 | http1 [default: auto] — the shared
                                 Prometheus/K8s transport: "auto" negotiates
                                 HTTP/2 (ALPN on https, prior-knowledge probe
@@ -341,6 +350,11 @@ Cli parse(int argc, char** argv) {
          check_choice("--overlap", v, {"on", "off"});
          cli.overlap = v;
        }},
+      {"--incremental",
+       [&](const std::string& v) {
+         check_choice("--incremental", v, {"on", "off"});
+         cli.incremental = v;
+       }},
       {"--transport",
        [&](const std::string& v) {
          check_choice("--transport", v, {"auto", "h2", "http1"});
@@ -481,6 +495,12 @@ Cli parse(int argc, char** argv) {
 
   if (cli.prometheus_url.empty() && cli.gcp_project.empty()) {
     throw CliError("--prometheus-url or --gcp-project is required (see --help)");
+  }
+  if (cli.incremental == "on" && cli.watch_cache != "on") {
+    // The dirty journal is watch-driven: without the informer there is no
+    // invalidation source for cluster objects, and a cache that can go
+    // silently stale is worse than a slow full recompute.
+    throw CliError("--incremental on requires --watch-cache on");
   }
   if (!cli.prometheus_url.empty() && !cli.gcp_project.empty()) {
     throw CliError("--prometheus-url and --gcp-project are mutually exclusive");

@@ -24,6 +24,7 @@
 #include "tpupruner/fleet.hpp"
 #include "tpupruner/gym.hpp"
 #include "tpupruner/http.hpp"
+#include "tpupruner/incremental.hpp"
 #include "tpupruner/leader.hpp"
 #include "tpupruner/ledger.hpp"
 #include "tpupruner/log.hpp"
@@ -144,9 +145,10 @@ struct ResolveOutcome {
   // Workload-ledger evidence: per resolved root, the chips its observed
   // idle pods reserve this cycle (keyed "Kind/ns/name" — the ledger's
   // account key, not the uid identity: savings must survive root
-  // recreation under a new uid). Ordered map: the capsule's ledger feed
-  // iterates it, and capsule bytes must not depend on hash order.
-  std::map<std::string, ledger::Observation> ledger_obs;
+  // recreation under a new uid). Unordered: both consumers re-key it
+  // (ledger::observe_cycle into its own account map, the capsule's
+  // record_ledger sorts), so hash order never reaches any byte surface.
+  std::unordered_map<std::string, ledger::Observation> ledger_obs;
   // Root identities vetoed by a pod-level tpu-pruner.dev/skip annotation:
   // an annotated pod must protect its owner for EVERY kind, not only the
   // group kinds the all-idle gate covers — a sibling pod of the same
@@ -161,6 +163,12 @@ struct ResolveOutcome {
   // unknown, every target in the namespace is dropped this cycle rather
   // than risk pruning it; transient API errors self-heal next cycle.
   std::map<std::string, std::string> vetoed_namespaces;
+  // Differential engine (--incremental on): the per-unit cache entries
+  // this cycle's recompute produced, handed to Engine::commit_cycle by
+  // finish_cycle, plus the plan/serve wall-clock for the cache_merge
+  // phase histogram.
+  std::vector<incremental::Unit> fresh_units;
+  double cache_merge_secs = 0;
 };
 
 // Deterministic-merge helpers: the sharded engine's output order must be a
@@ -230,7 +238,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
                             const std::vector<core::PodMetricSample>& samples,
                             const otlp::SpanContext& parent_ctx,
                             const informer::ClusterCache* watch_cache,
-                            uint64_t cycle_id) {
+                            uint64_t cycle_id, incremental::Engine::Plan& inc_plan) {
   ResolveOutcome out;
   const size_t nshards = shard::resolve_shard_count(args.shards);
   shard::Pool& pool = shard::pool(nshards);
@@ -267,13 +275,50 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   const bool store_pods = watch_cache && watch_cache->pods_synced();
   const bool store_owners = watch_cache && watch_cache->all_synced();
 
+  // ── differential plan (--incremental on) ──
+  // Fuse the dirty journal, the sample diff and the timer/actuation edges
+  // into the cycle's recompute set; everything else serves from the
+  // decision cache below. With the engine off (or the store untrusted)
+  // the plan is a full recompute — the exact-parity path.
+  const bool inc_on = incremental::engine().enabled();
+  {
+    auto cache_t0 = std::chrono::steady_clock::now();
+    if (inc_on) {
+      informer::ClusterCache::DirtyDrain drain;
+      if (watch_cache) {
+        drain = watch_cache->drain_dirty();
+      } else {
+        drain.all = true;  // no watch stream: nothing can vouch for object freshness
+      }
+      inc_plan = incremental::engine().plan_cycle(samples, drain, now,
+                                                  store_pods && store_owners);
+    } else {
+      inc_plan = incremental::Engine::Plan{};
+      inc_plan.full = true;
+      inc_plan.pods_total = samples.size();
+      inc_plan.recompute.reserve(samples.size());
+      for (size_t i = 0; i < samples.size(); ++i) inc_plan.recompute.push_back(i);
+    }
+    out.cache_merge_secs += secs_since(cache_t0);
+    log::debug("daemon", "incremental plan: " + std::to_string(inc_plan.recompute.size()) +
+               " dirty / " + std::to_string(inc_plan.hits) + " cached in " +
+               std::to_string(out.cache_merge_secs * 1000) + "ms");
+  }
+  std::unordered_map<std::string, size_t> key_idx;  // "ns/name" → sample index
+  if (inc_on && !inc_plan.full) {
+    key_idx.reserve(samples.size());
+    for (size_t i = 0; i < samples.size(); ++i) {
+      key_idx.emplace(samples[i].ns + "/" + samples[i].name, i);
+    }
+  }
+
   // Phase 1 — acquire pods. Namespaces with more candidates than the batch
   // threshold are fetched with one pods LIST; the rest (and any pod missing
   // from its LIST snapshot) fall back to per-pod GETs. With a synced watch
   // store the LISTs are pointless — every lookup below hits the store — so
   // the phase is skipped wholesale.
   std::unordered_map<std::string, size_t> ns_counts;
-  for (const core::PodMetricSample& s : samples) ++ns_counts[s.ns];
+  for (size_t i : inc_plan.recompute) ++ns_counts[samples[i].ns];
   std::vector<std::string> batch_ns;
   for (const auto& [ns, count] : ns_counts) {
     if (!store_pods && args.resolve_batch_threshold > 0 &&
@@ -321,6 +366,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     const core::PodMetricSample* sample;
     const json::Value* pod;
     bool opted_out = false;  // walks to find its root, which is then vetoed
+    bool from_store = false;  // served by the synced watch store (cacheable)
   };
   // Per-pod result slots, written by candidate index so each shard's
   // output order is a pure function of the candidate order — never of
@@ -332,41 +378,66 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     bool idle = false;                 // idle AND eligible
     const json::Value* pod = nullptr;  // non-null → proceeds to the walk
     bool opted_out = false;
+    // Differential-engine provenance for terminal slots.
+    const json::Value* pod_seen = nullptr;  // the pod as consulted (any outcome)
+    bool from_store = false;
+    bool store_missed = false;
+    bool fetch_error = false;
+    int64_t deadline = 0;  // BELOW_MIN_AGE: unix time the pod leaves the window
   };
   // Per-pod owner-walk result (part 2), also slot-indexed.
   struct WalkedPod {
     const core::PodMetricSample* sample = nullptr;
+    const json::Value* pod = nullptr;  // the pod as walked (unit evidence)
     bool opted_out = false;
+    bool from_store = false;
     std::optional<ScaleTarget> target;
     std::vector<std::string> chain;
     std::string error;  // non-empty: the walk threw
     int64_t chips = 0;  // pod chip count (ledger evidence)
+    // Object paths this walk consulted (404 misses included) — the
+    // dirty-tracker reverse index + the cached capsule object snapshot.
+    std::vector<std::pair<std::string, std::optional<json::Value>>> paths;
   };
   struct ShardScratch {
-    std::vector<size_t> sample_idx;      // pre-partitioned candidate indices
+    std::vector<size_t> wave_idx;        // this wave's candidate indices
     walker::FetchCache cache;            // per-shard owner cache
     std::deque<json::Value> owned_pods;  // stable storage for GET/store hits
     std::mutex pods_mutex;               // guards owned_pods only
-    std::vector<PodSlot> slots;
-    std::vector<EligiblePod> eligible;   // compacted from slots, in order
+    std::vector<PodSlot> slots;          // per-wave scratch
+    std::vector<EligiblePod> eligible;   // compacted from slots, across waves
+    size_t walk_done = 0;                // eligible entries already walked
     std::vector<audit::DecisionRecord> decided;
     walker::IdlePodSet idle_pods;
     std::map<std::string, std::string> vetoed_namespaces;
     std::vector<WalkedPod> walked;       // aligned with `eligible`
+    std::vector<incremental::Unit> units;  // rootless cache units (stage 1)
     double secs = 0;  // this shard's resolve work (acquisition + walk)
   };
   std::vector<ShardScratch> shards(nshards);
-  for (size_t i = 0; i < samples.size(); ++i) {
-    size_t s = shard::shard_of(samples[i].ns + "/" + samples[i].name, nshards);
-    shards[s].sample_idx.push_back(i);
-  }
+  std::vector<char> processed(samples.size(), 0);
+
+  // One wave of acquisition + walk over `wave` (candidate indices).
+  // Returns the root identities the wave's walks resolved, so the caller
+  // can run wave-2 invalidation (a recomputed pod joining a cached root
+  // pulls the root's cached siblings into the next wave). Per-shard
+  // output order varies with wave composition, but every downstream
+  // surface is sorted in the merge stage — order never leaks.
+  auto run_wave = [&](const std::vector<size_t>& wave) -> std::vector<std::string> {
+    for (ShardScratch& sh : shards) sh.wave_idx.clear();
+    for (size_t i : wave) {
+      if (processed[i]) continue;
+      processed[i] = 1;
+      size_t s = shard::shard_of(samples[i].ns + "/" + samples[i].name, nshards);
+      shards[s].wave_idx.push_back(i);
+    }
 
   pool.run(nshards, [&](size_t s) {
     ShardScratch& sh = shards[s];
     auto shard_t0 = std::chrono::steady_clock::now();
-    sh.slots.resize(sh.sample_idx.size());
-    fan_out(shard_workers, sh.sample_idx.size(), [&](size_t j) {
-      const core::PodMetricSample& pmd = samples[sh.sample_idx[j]];
+    sh.slots.assign(sh.wave_idx.size(), PodSlot{});
+    fan_out(shard_workers, sh.wave_idx.size(), [&](size_t j) {
+      const core::PodMetricSample& pmd = samples[sh.wave_idx[j]];
       PodSlot& slot = sh.slots[j];
       std::string key = pmd.ns + "/" + pmd.name;
 
@@ -385,6 +456,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
           std::lock_guard<std::mutex> lock(sh.pods_mutex);
           sh.owned_pods.push_back(std::move(*hit));
           pod = &sh.owned_pods.back();
+          slot.from_store = true;
         } else {
           store_missed = store_pods;
         }
@@ -413,6 +485,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
                  std::string("pod GET failed, namespace vetoed: ") + e.what());
           slot.veto_ns = true;
           slot.veto_cause = "fetch error for pod " + key;
+          slot.fetch_error = true;
           return;
         }
         if (!fetched) {
@@ -421,6 +494,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
           decide(store_missed ? audit::Reason::WatchCacheMiss : audit::Reason::PodGone,
                  store_missed ? "absent from the synced watch store and from the live GET"
                               : "in the metric plane but not in the cluster");
+          slot.store_missed = store_missed;
           return;
         }
         std::lock_guard<std::mutex> lock(sh.pods_mutex);
@@ -428,6 +502,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
         pod = &sh.owned_pods.back();
       }
 
+      slot.pod_seen = pod;
       recorder::record_pod(cycle_id, key, pod, false, "");
       core::Eligibility elig = core::check_eligibility(*pod, now, lookback_secs);
       switch (elig) {
@@ -447,6 +522,17 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
           log::info("daemon", "Pod " + key + " created within lookback window, skipping");
           decide(audit::Reason::BelowMinAge,
                  "created within the " + std::to_string(lookback_secs) + "s lookback window");
+          // Timer-armed: the verdict flips by clock alone (no watch event,
+          // no sample change), so the cached decision self-dirties the
+          // moment the pod leaves the lookback window.
+          if (inc_on) {
+            if (const json::Value* created = pod->at_path("metadata.creationTimestamp");
+                created && created->is_string()) {
+              if (auto ts = util::parse_rfc3339(created->as_string())) {
+                slot.deadline = *ts + lookback_secs;
+              }
+            }
+          }
           return;
         case core::Eligibility::OptedOut:
           // Not a candidate — but its root must be vetoed for every kind, so
@@ -467,11 +553,34 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     // Serial per-shard compaction in candidate order (deterministic).
     for (size_t j = 0; j < sh.slots.size(); ++j) {
       PodSlot& slot = sh.slots[j];
-      const core::PodMetricSample& pmd = samples[sh.sample_idx[j]];
+      const core::PodMetricSample& pmd = samples[sh.wave_idx[j]];
+      if (inc_on && slot.decided) {
+        // Terminal at stage 1 → a rootless cache unit of one pod.
+        incremental::Unit u;
+        const std::string key = pmd.ns + "/" + pmd.name;
+        u.key = "pod:" + key;
+        u.members.emplace_back(key, metrics::sample_fingerprint(pmd));
+        u.decided.push_back(*slot.decided);
+        incremental::PodEvidence ev;
+        ev.key = key;
+        if (slot.pod_seen) {
+          ev.has_pod = true;
+          ev.pod = *slot.pod_seen;  // COW copy
+        }
+        ev.store_missed = slot.store_missed;
+        u.evidence.push_back(std::move(ev));
+        u.deadline_unix = slot.deadline;
+        // Transients (GET failures) and GET-acquired pods (no watch event
+        // will announce their next change while the store lags) recompute
+        // every cycle; a timer unit without a parsed deadline must too.
+        u.never_cache = slot.fetch_error || (slot.pod_seen && !slot.from_store) ||
+                        (slot.decided->reason == audit::Reason::BelowMinAge && slot.deadline == 0);
+        sh.units.push_back(std::move(u));
+      }
       if (slot.decided) sh.decided.push_back(std::move(*slot.decided));
       if (slot.veto_ns) veto_namespace(sh.vetoed_namespaces, pmd.ns, slot.veto_cause);
       if (slot.idle) sh.idle_pods.insert(pmd.ns + "/" + pmd.name);
-      if (slot.pod) sh.eligible.push_back({&pmd, slot.pod, slot.opted_out});
+      if (slot.pod) sh.eligible.push_back({&pmd, slot.pod, slot.opted_out, slot.from_store});
     }
     sh.secs += secs_since(shard_t0);
   });
@@ -484,7 +593,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   if (!store_owners && args.resolve_batch_threshold > 0) {
     std::vector<const json::Value*> pods;
     for (const ShardScratch& sh : shards) {
-      for (const EligiblePod& e : sh.eligible) pods.push_back(e.pod);
+      for (size_t j = sh.walk_done; j < sh.eligible.size(); ++j) pods.push_back(sh.eligible[j].pod);
     }
     if (!pods.empty()) {
       otlp::Span span("prefetch_owner_chains", &parent_ctx);
@@ -506,18 +615,35 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   pool.run(nshards, [&](size_t s) {
     ShardScratch& sh = shards[s];
     auto shard_t0 = std::chrono::steady_clock::now();
+    const size_t wave_base = sh.walk_done;
     sh.walked.resize(sh.eligible.size());
-    fan_out(shard_workers, sh.eligible.size(), [&](size_t j) {
+    walker::ObjectFetcher base_fetcher = walker::live_fetcher(kube, &sh.cache, watch_cache);
+    fan_out(shard_workers, sh.eligible.size() - wave_base, [&](size_t k) {
+      const size_t j = wave_base + k;
       const EligiblePod& e = sh.eligible[j];
       std::string key = e.sample->ns + "/" + e.sample->name;
       WalkedPod w;
       w.sample = e.sample;
+      w.pod = e.pod;
       w.opted_out = e.opted_out;
+      w.from_store = e.from_store;
       {
         otlp::Span span("find_root_object", &parent_ctx);  // lib.rs:436 span
         span.attr("pod", key);
         try {
-          w.target = walker::find_root_object(kube, *e.pod, &sh.cache, watch_cache, &w.chain);
+          if (inc_on) {
+            // Traced walk: record every consulted object path so the
+            // dirty tracker can map future watch events back to this
+            // unit (and the cache can replay the capsule objects).
+            walker::ObjectFetcher traced = [&](const std::string& path) {
+              auto entry = base_fetcher(path);
+              w.paths.emplace_back(path, entry);
+              return entry;
+            };
+            w.target = walker::find_root_object_from(traced, *e.pod, &w.chain);
+          } else {
+            w.target = walker::find_root_object_from(base_fetcher, *e.pod, &w.chain);
+          }
           w.chips = core::pod_chip_count(*e.pod, args.device);
         } catch (const std::exception& e2) {
           span.set_error(e2.what());
@@ -535,14 +661,55 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       sh.walked[j] = std::move(w);  // distinct slot per index; no lock
     });
     sh.secs += secs_since(shard_t0);
-    // One per-shard observation per cycle (zero-candidate shards observe
-    // their ~0s too, so the _count advances shards×cycles in lockstep) —
-    // the histogram that shows whether the walk stage scales with
-    // --shards or one hot shard is the ceiling.
-    log::histogram_observe("cycle_phase_seconds", "resolve_shard", sh.secs,
-                           parent_ctx.trace_id);
   });
 
+  // Identities resolved this wave (for wave-2 invalidation), gathered
+  // serially so walk_done advances exactly once per wave.
+  std::vector<std::string> wave_roots;
+  for (ShardScratch& sh : shards) {
+    for (size_t j = sh.walk_done; j < sh.eligible.size(); ++j) {
+      if (sh.walked[j].target) wave_roots.push_back(sh.walked[j].target->identity());
+    }
+    sh.walk_done = sh.eligible.size();
+  }
+  return wave_roots;
+  };  // run_wave
+
+  auto waves_t0 = std::chrono::steady_clock::now();
+  // Wave 1 is the plan's recompute set; each further wave re-walks the
+  // cached siblings of any root a recomputed pod newly resolved to (their
+  // unit can no longer serve from cache — its member set changed).
+  // Termination: objects of invalidated members are unchanged, so they
+  // re-resolve to the same (already invalidated) root — every root is
+  // invalidated at most once, and each wave only processes new indices.
+  {
+    std::vector<size_t> wave = inc_plan.recompute;
+    while (!wave.empty()) {
+      std::vector<std::string> resolved_roots = run_wave(wave);
+      wave.clear();
+      if (inc_on && !inc_plan.full) {
+        for (const std::string& id : resolved_roots) {
+          for (const std::string& member : incremental::engine().invalidate_unit(inc_plan, id)) {
+            auto it = key_idx.find(member);
+            if (it != key_idx.end() && !processed[it->second]) wave.push_back(it->second);
+          }
+        }
+        std::sort(wave.begin(), wave.end());
+        wave.erase(std::unique(wave.begin(), wave.end()), wave.end());
+      }
+    }
+  }
+  // One per-shard observation per cycle (zero-candidate shards observe
+  // their ~0s too, so the _count advances shards×cycles in lockstep) —
+  // the histogram that shows whether the walk stage scales with
+  // --shards or one hot shard is the ceiling.
+  for (ShardScratch& sh : shards) {
+    log::histogram_observe("cycle_phase_seconds", "resolve_shard", sh.secs,
+                           parent_ctx.trace_id);
+  }
+  log::debug("daemon", "resolve waves: " + std::to_string(secs_since(waves_t0) * 1000) + "ms");
+
+  auto fold_t0 = std::chrono::steady_clock::now();
   // ── fold stage: re-partition by resolved-root hash ──
   // Every pod of one root lands on one fold shard (shard::shard_of over
   // the root identity), so per-root ledger accounts, target dedup and
@@ -553,9 +720,13 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     std::vector<std::pair<std::string, audit::DecisionRecord>> resolved_records;
     std::vector<ScaleTarget> targets;
     std::set<std::string> seen_roots;  // complete dedup: roots never span shards
-    std::map<std::string, ledger::Observation> ledger_obs;
+    std::unordered_map<std::string, ledger::Observation> ledger_obs;
     std::set<std::string> vetoed_roots;
     std::map<std::string, std::string> vetoed_namespaces;
+    // Cache units built alongside (roots + walk-failure pods) — a root's
+    // unit folds on exactly one shard, like every other per-root output;
+    // unordered, the engine re-keys them at commit.
+    std::unordered_map<std::string, incremental::Unit> units;
   };
   auto merge_t0 = std::chrono::steady_clock::now();
   std::vector<FoldScratch> folds(nshards);
@@ -571,6 +742,33 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     for (WalkedPod* wp : fo.items) {
       WalkedPod& w = *wp;
       std::string key = w.sample->ns + "/" + w.sample->name;
+      // Cache-unit assembly (engine on): every walked pod lands its
+      // evidence in a unit — the root's for resolved pods, its own
+      // rootless unit otherwise — so a later clean cycle can replay it.
+      incremental::Unit* unit = nullptr;
+      if (inc_on) {
+        const std::string ukey = w.target ? w.target->identity() : "pod:" + key;
+        unit = &fo.units[ukey];
+        if (unit->key.empty()) unit->key = ukey;
+        unit->members.emplace_back(key, metrics::sample_fingerprint(*w.sample));
+        incremental::PodEvidence ev;
+        ev.key = key;
+        ev.has_pod = true;
+        ev.pod = *w.pod;  // COW copy
+        ev.walked = true;
+        ev.chain = w.chain;
+        ev.walk_error = w.error;
+        if (w.target) {
+          ev.root_kind = core::kind_name(w.target->kind);
+          ev.root_ns = w.target->ns().value_or("");
+          ev.root_name = w.target->name();
+          ev.identity = w.target->identity();
+        }
+        unit->evidence.push_back(std::move(ev));
+        for (auto& pe : w.paths) unit->objects.push_back(std::move(pe));
+        // GET-fallback pods have no watch stream vouching for them.
+        if (!w.from_store) unit->never_cache = true;
+      }
       audit::DecisionRecord rec = base_record(*w.sample);
       rec.owner_chain = w.chain;
       if (!w.target) {
@@ -583,6 +781,11 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
           rec.reason = audit::Reason::OptedOut;
           rec.detail = std::string("annotated pod with unresolvable root; namespace vetoed: ") +
                        w.error;
+          if (unit) {
+            // Namespace vetoes are per-cycle transients — never cached.
+            unit->never_cache = true;
+            unit->decided.push_back(rec);
+          }
           fo.decided.push_back(std::move(rec));
           veto_namespace(fo.vetoed_namespaces, w.sample->ns,
                          "annotated pod " + key + " with unresolvable root");
@@ -590,6 +793,16 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
           log::warn("daemon", "Skipping " + key + ", no scalable root object: " + w.error);
           rec.reason = audit::Reason::NoScalableOwner;
           rec.detail = w.error;
+          if (unit) {
+            // Only the walker's terminal verdict is a stable fact; any
+            // other error (transport, 5xx) is transient and self-heals
+            // by recomputation.
+            if (!util::starts_with(w.error, "no scalable root object")) {
+              unit->never_cache = true;
+            }
+            unit->idle_pods.push_back(key);
+            unit->decided.push_back(rec);
+          }
           fo.decided.push_back(std::move(rec));
         }
         continue;
@@ -597,10 +810,30 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       rec.root_kind = core::kind_name(w.target->kind);
       rec.root_ns = w.target->ns().value_or("");
       rec.root_name = w.target->name();
+      if (unit) {
+        // Group-kind (JobSet/LWS) roots: the all-idle gate depends on
+        // pods outside the candidate set, so the gate verdict starts
+        // Unknown (re-gated every cycle) until finish_cycle records a
+        // verified all-idle LIST — from then on the cached verdict holds
+        // until any pod watch event lands in the root's namespace.
+        if (w.target->kind == core::Kind::JobSet ||
+            w.target->kind == core::Kind::LeaderWorkerSet) {
+          unit->group_verdict = incremental::Unit::GroupVerdict::Unknown;
+          unit->group_ns = w.target->ns().value_or("");
+        }
+        if (!unit->has_target) {
+          unit->has_target = true;
+          unit->target = *w.target;  // COW copy, before the move below
+        }
+      }
       if (w.opted_out) {
         rec.reason = audit::Reason::OptedOut;
         rec.action = "none";
         rec.detail = "pod annotation vetoes its root for every kind this cycle";
+        if (unit) {
+          unit->vetoed_root = true;
+          unit->decided.push_back(rec);
+        }
         fo.decided.push_back(std::move(rec));
         fo.vetoed_roots.insert(w.target->identity());
       } else {
@@ -617,6 +850,16 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
         }
         obs.chips += w.chips;
         obs.pods += 1;  // contributing idle pods (right-size evidence)
+        if (unit) {
+          unit->has_obs = true;
+          unit->obs.kind = obs.kind;
+          unit->obs.ns = obs.ns;
+          unit->obs.name = obs.name;
+          unit->obs.chips += w.chips;
+          unit->obs.pods += 1;
+          unit->idle_pods.push_back(key);
+          unit->resolved.push_back(rec);
+        }
         fo.resolved_records.emplace_back(w.target->identity(), std::move(rec));
         if (fo.seen_roots.insert(w.target->identity()).second) {
           fo.targets.push_back(std::move(*w.target));
@@ -636,6 +879,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     for (const auto& [ns, cause] : fo.vetoed_namespaces) {
       veto_namespace(out.vetoed_namespaces, ns, cause);
     }
+    for (auto& [ukey, u] : fo.units) out.fresh_units.push_back(std::move(u));
   }
   for (ShardScratch& sh : shards) {
     for (audit::DecisionRecord& r : sh.decided) out.decided.push_back(std::move(r));
@@ -643,6 +887,63 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
     for (const auto& [ns, cause] : sh.vetoed_namespaces) {
       veto_namespace(out.vetoed_namespaces, ns, cause);
     }
+    for (incremental::Unit& u : sh.units) out.fresh_units.push_back(std::move(u));
+  }
+
+  // ── decision cache: serve every clean unit ──
+  // Gate inputs (targets, veto flags, idle evidence, ledger observations)
+  // always merge here — the per-cycle gates below need them. The RECORD
+  // and capsule-evidence replay is mode-dependent:
+  //   dry-run — served here too, re-stamped and joined before the sorts,
+  //     so the audit JSONL keeps the full engine's deterministic order
+  //     byte for byte;
+  //   scale-down — deferred to finish_cycle's post-enqueue emission (the
+  //     fast path): thousands of cached record copies and capsule-map
+  //     inserts must not sit between detection and the churn's patches.
+  //     Scale-down record order is consumer-timing-dependent in both
+  //     engines, so only the record SET is contractual there.
+  const bool defer_records = inc_on && !args.dry_run();
+  if (inc_on && !inc_plan.cached.empty()) {
+    auto cache_t0 = std::chrono::steady_clock::now();
+    const bool record = recorder::enabled() && !defer_records;
+    for (const auto& [ukey, uptr] : inc_plan.cached) {
+      const incremental::Unit& u = *uptr;
+      if (!defer_records) {
+        auto restamp = [&](const audit::DecisionRecord& r) {
+          audit::DecisionRecord c = r;
+          c.cycle = cycle_id;
+          c.ts_unix = 0;  // audit::record stamps the current clock
+          c.trace_id = parent_ctx.trace_id;
+          return c;
+        };
+        for (const audit::DecisionRecord& r : u.decided) {
+          out.decided.push_back(restamp(r));
+        }
+        for (const audit::DecisionRecord& r : u.resolved) {
+          out.resolved_records.emplace_back(u.key, restamp(r));
+        }
+      }
+      if (u.has_target) out.targets.push_back(u.target);
+      if (u.vetoed_root) out.vetoed_roots.insert(u.key);
+      for (const std::string& pod : u.idle_pods) out.idle_pods.insert(pod);
+      if (u.has_obs) {
+        out.ledger_obs[u.obs.kind + "/" + u.obs.ns + "/" + u.obs.name] = u.obs;
+      }
+      if (record) {
+        for (const incremental::PodEvidence& ev : u.evidence) {
+          recorder::record_pod(cycle_id, ev.key, ev.has_pod ? &ev.pod : nullptr,
+                               ev.store_missed, "");
+          if (ev.walked) {
+            recorder::record_resolution(cycle_id, ev.key, ev.chain, ev.root_kind, ev.root_ns,
+                                        ev.root_name, ev.identity, ev.walk_error);
+          }
+        }
+        for (const auto& [path, obj] : u.objects) {
+          recorder::record_object(cycle_id, path, obj ? &*obj : nullptr);
+        }
+      }
+    }
+    out.cache_merge_secs += secs_since(cache_t0);
   }
   // One record per candidate pod per cycle → (ns, pod) is a unique sort
   // key; targets sort by root identity. This ordering — not the shard
@@ -659,6 +960,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   // operators can see when merge (not the walk) becomes the ceiling.
   log::histogram_observe("cycle_phase_seconds", "merge", secs_since(merge_t0),
                          parent_ctx.trace_id);
+  log::debug("daemon", "fold+merge+serve: " + std::to_string(secs_since(fold_t0) * 1000) + "ms");
 
   // Flight recorder: snapshot every owner/root object the walk consulted
   // this cycle (single-flight cache contents, cached 404s included) so a
@@ -893,9 +1195,36 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
   };
   return with_span(cycle, [&] {
   auto phase_start = std::chrono::steady_clock::now();
+  incremental::Engine::Plan inc_plan;
   ResolveOutcome resolved =
-      resolve_pods(args, kube, decoded.samples, cycle.context(), watch_cache, cycle_id);
+      resolve_pods(args, kube, decoded.samples, cycle.context(), watch_cache, cycle_id, inc_plan);
   observe_phase("resolve", phase_start);
+  // Differential engine bookkeeping: commit this cycle's fresh units
+  // (cached ones carry forward), stamp the provenance into the capsule,
+  // publish the hit-ratio gauges, and observe the cache_merge phase —
+  // every cycle, ~0s with the engine off, so the phase _counts stay in
+  // lockstep.
+  if (incremental::engine().enabled()) {
+    auto commit_t0 = std::chrono::steady_clock::now();
+    incremental::engine().commit_cycle(inc_plan, std::move(resolved.fresh_units));
+    resolved.cache_merge_secs += secs_since(commit_t0);
+    incremental::publish_metrics(inc_plan);
+    recorder::record_incremental(cycle_id, incremental::engine().provenance_json(inc_plan));
+    log::counter_set("incremental_cache_hits", inc_plan.hits);
+    log::counter_set("incremental_dirty_pods", inc_plan.recompute.size());
+    log::info("daemon", "incremental: " + std::to_string(inc_plan.hits) + "/" +
+              std::to_string(inc_plan.pods_total) + " candidate pods served from cache (" +
+              std::to_string(inc_plan.dirty_units.size()) + " dirty unit(s)" +
+              (inc_plan.full ? ", full recompute" : "") + ")");
+  }
+  log::histogram_observe("cycle_phase_seconds", "cache_merge", resolved.cache_merge_secs,
+                         trace_id);
+  auto seg_t0 = std::chrono::steady_clock::now();
+  auto seg = [&](const char* what) {
+    log::debug("daemon", std::string(what) + ": " + std::to_string(secs_since(seg_t0) * 1000) +
+               "ms");
+    seg_t0 = std::chrono::steady_clock::now();
+  };
   // Gate-terminal decisions (ineligible pods, failed fetches/walks) are
   // final now; resolved pods' records land after the target-level gates.
   for (audit::DecisionRecord& rec : resolved.decided) {
@@ -906,15 +1235,22 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
   // account (and its chip count) already present. The SAME clock and
   // observations are stamped into the flight capsule, so the policy
   // gym's baseline integration reproduces this ledger bit-for-bit.
+  const bool inc_fast = inc_plan.active && !args.dry_run();
+  std::vector<ledger::Observation> ledger_feed;
+  int64_t ledger_now = 0;
   {
-    std::vector<ledger::Observation> obs;
-    obs.reserve(resolved.ledger_obs.size());
-    for (auto& [key, o] : resolved.ledger_obs) obs.push_back(o);
-    const int64_t ledger_now = util::now_unix();
-    recorder::record_ledger(cycle_id, ledger_now, obs);
-    ledger::observe_cycle(cycle_id, ledger_now, obs);
+    ledger_feed.reserve(resolved.ledger_obs.size());
+    for (auto& [key, o] : resolved.ledger_obs) ledger_feed.push_back(o);
+    ledger_now = util::now_unix();
+    // The capsule's ledger stamp (record_ledger sorts + serializes every
+    // observation) defers to the post-enqueue emission on the fast path;
+    // the ledger itself must integrate BEFORE anything enqueues.
+    if (!inc_fast) recorder::record_ledger(cycle_id, ledger_now, ledger_feed);
+    ledger::observe_cycle(cycle_id, ledger_now, ledger_feed);
   }
+  seg("decided flush + ledger observe");
   std::vector<ScaleTarget> unique = core::dedup_targets(std::move(resolved.targets));
+  seg("dedup");
   // Flight recorder: the fail-closed veto sets are cycle facts (cluster
   // state, not config) — a replay reuses them verbatim.
   if (recorder::enabled()) {
@@ -959,6 +1295,7 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
     unique = std::move(kept);
   }
 
+  seg("valves");
   // Multi-host group gate: a JobSet/LeaderWorkerSet is only a candidate
   // when every google.com/tpu pod of the group is idle (SURVEY.md §7
   // hard-part #1 — a partial-slice suspend kills live hosts
@@ -970,6 +1307,17 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
     for (size_t i = 0; i < unique.size(); ++i) {
       if (unique[i].kind == core::Kind::JobSet ||
           unique[i].kind == core::Kind::LeaderWorkerSet) {
+        // Cached all-idle verdict (--incremental on): a clean group unit
+        // whose LIST was verified all-idle — and whose namespace has seen
+        // no pod event since — skips the gate entirely; everything else
+        // LISTs live below.
+        if (inc_plan.active) {
+          auto it = inc_plan.cached.find(unique[i].identity());
+          if (it != inc_plan.cached.end() &&
+              it->second->group_verdict == incremental::Unit::GroupVerdict::Idle) {
+            continue;
+          }
+        }
         group_targets.push_back(&unique[i]);
         group_indices.push_back(i);
       }
@@ -980,7 +1328,13 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
       with_span(span, [&] {
         std::vector<char> verdicts =
             walker::groups_fully_idle(kube, group_targets, resolved.idle_pods);
-        for (size_t j = 0; j < group_indices.size(); ++j) keep[group_indices[j]] = verdicts[j];
+        for (size_t j = 0; j < group_indices.size(); ++j) {
+          keep[group_indices[j]] = verdicts[j];
+          if (incremental::engine().enabled()) {
+            incremental::engine().record_group_verdict(group_targets[j]->identity(),
+                                                       verdicts[j] != 0);
+          }
+        }
       });
     }
   }
@@ -1107,6 +1461,7 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
     survivors = std::move(kept);
   }
 
+  seg("group gate + breaker + brownout + right-size");
   CycleStats stats;
   stats.num_series = decoded.num_series;
   stats.num_pods = decoded.samples.size();
@@ -1121,22 +1476,97 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
   cycle.attr("num_pods", static_cast<int64_t>(stats.num_pods));
   cycle.attr("shutdown_events", static_cast<int64_t>(stats.shutdown_events));
 
-  // Flush the resolved pods' records BEFORE anything is enqueued: a fast
-  // consumer may finalize a pending record the instant the target hits the
-  // queue, so the pending entry must already exist.
-  {
-    std::unordered_set<std::string> enqueue_ids;
-    if (!args.dry_run()) {
-      for (const ScaleTarget& t : survivors) enqueue_ids.insert(t.identity());
+  // Cached-no-op suppression (--incremental on, scale-down): a clean unit
+  // whose last enqueue came back "already paused" (or kind-disabled)
+  // would ride the queue only for the consumer to verify a no-op against
+  // an unchanged store — serve the consumer's verdict from cache instead
+  // and keep the queue O(churn). The verdict joins the records below
+  // through the same outcome map every other gate uses, and the capsule
+  // actuation stamp is replayed verbatim, so audit bytes match the full
+  // recompute. Runs AFTER record_stats: shutdown_events counts these
+  // targets exactly as the full engine does.
+  struct SuppressedNoop {
+    std::string identity, kind, ns, name;
+    const incremental::Unit* unit;
+  };
+  std::vector<SuppressedNoop> suppressed;
+  if (!inc_plan.cached.empty() && !args.dry_run()) {
+    std::vector<ScaleTarget> kept;
+    kept.reserve(survivors.size());
+    for (ScaleTarget& t : survivors) {
+      const std::string identity = t.identity();
+      auto it = inc_plan.cached.find(identity);
+      const incremental::Unit* u = it != inc_plan.cached.end() ? it->second : nullptr;
+      if (!u || u->actuation != incremental::Unit::Actuation::Noop) {
+        kept.push_back(std::move(t));
+        continue;
+      }
+      // Everything about a suppressed no-op — its records' verdict join,
+      // the capsule stamp, the ledger echo, the counters — is deferred to
+      // the post-enqueue emission below: the churn must not wait out
+      // thousands of cached bookkeeping writes.
+      suppressed.push_back({identity, std::string(core::kind_name(t.kind)),
+                            t.ns().value_or(""), t.name(), u});
     }
-    for (auto& [identity, rec] : resolved.resolved_records) {
+    survivors = std::move(kept);
+    if (!suppressed.empty()) {
+      log::info("daemon", "incremental: " + std::to_string(suppressed.size()) +
+                " cached no-op actuation(s) served without enqueue");
+    }
+  }
+
+  seg("stats + suppression decide");
+  // Pending records must exist BEFORE anything is enqueued: a fast
+  // consumer may finalize one the instant its target hits the queue.
+  // Everything else — outcome-joined verdicts, dry-run records, the
+  // suppressed no-ops' capsule/ledger echoes — is emitted by emit_rest.
+  std::unordered_set<std::string> enqueue_ids;
+  if (!args.dry_run()) {
+    for (const ScaleTarget& t : survivors) enqueue_ids.insert(t.identity());
+  }
+  // In the scale-down fast path the cached units' records never rode
+  // ResolveOutcome (resolve_pods deferred them — see decision-cache
+  // serve); they re-stamp and emit here instead, pending-first for any
+  // cached unit whose target IS enqueued this cycle (a previously
+  // deferred or brownout-held root being admitted).
+  const bool fast = inc_fast;
+  auto restamp = [&](const audit::DecisionRecord& r) {
+    audit::DecisionRecord c = r;
+    c.cycle = cycle_id;
+    c.ts_unix = 0;  // audit::record stamps the current clock
+    c.trace_id = trace_id;
+    return c;
+  };
+  std::unordered_set<std::string> suppressed_ids;
+  suppressed_ids.reserve(suppressed.size());
+  for (const SuppressedNoop& sn : suppressed) suppressed_ids.insert(sn.identity);
+  std::unordered_set<std::string> cached_pending;
+  std::vector<char> rec_handled(resolved.resolved_records.size(), 0);
+  for (size_t i = 0; i < resolved.resolved_records.size(); ++i) {
+    auto& [identity, rec] = resolved.resolved_records[i];
+    if (enqueue_ids.count(identity) && !outcome.count(identity)) {
+      audit::record_pending(std::move(rec), identity);
+      rec_handled[i] = 1;
+    }
+  }
+  if (fast) {
+    for (const auto& [ukey, u] : inc_plan.cached) {
+      if (!enqueue_ids.count(ukey) || outcome.count(ukey)) continue;
+      for (const audit::DecisionRecord& r : u->resolved) {
+        audit::record_pending(restamp(r), ukey);
+      }
+      cached_pending.insert(ukey);
+    }
+  }
+  auto emit_rest = [&] {
+    for (size_t i = 0; i < resolved.resolved_records.size(); ++i) {
+      if (rec_handled[i]) continue;
+      auto& [identity, rec] = resolved.resolved_records[i];
       if (auto it = outcome.find(identity); it != outcome.end()) {
         rec.reason = it->second.first;
         rec.action = "none";
         rec.detail = it->second.second;
         audit::record(std::move(rec));
-      } else if (enqueue_ids.count(identity)) {
-        audit::record_pending(std::move(rec), identity);
       } else {
         // dry-run survivor (or a disabled-kind target in dry-run mode)
         rec.reason = audit::Reason::DryRun;
@@ -1145,27 +1575,116 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
         audit::record(std::move(rec));
       }
     }
-  }
-  // One actuate-phase observation per cycle, taken when the consumers
-  // finish this cycle's queue (0s immediately when nothing is enqueued) —
-  // keeps every phase histogram's _count in lockstep per cycle.
-  audit::arm_actuation(cycle_id, args.dry_run() ? 0 : survivors.size(), trace_id);
-  // The capsule seals when this cycle's actuations drain (immediately on
-  // dry-run / no-candidate cycles) — by then every DecisionRecord has
-  // passed through the audit sink into it.
-  recorder::arm(cycle_id, args.dry_run() ? 0 : survivors.size());
-
-  for (ScaleTarget& t : survivors) {
-    std::string desc = "[" + std::string(core::kind_name(t.kind)) + "] " +
-                       t.ns().value_or("") + ":" + t.name();
-    if (args.dry_run()) {
-      log::info("daemon", "Dry-run: Would have sent " + desc + " for scaledown");
-    } else {
-      ScalePlan plan;
-      if (auto it = rs_plans.find(t.identity()); it != rs_plans.end()) plan = it->second;
-      log::info("daemon", "Sending " + desc + " for scaledown");
-      enqueue(std::move(t), std::move(plan), cycle_id);
+    if (fast) {
+      // Deferred cache serve: records + capsule evidence for every clean
+      // unit, emitted while the (small) enqueued set drains on the
+      // consumers. All of it lands before arm(), so the capsule still
+      // seals with the complete decision set.
+      const bool record = recorder::enabled();
+      for (const auto& [ukey, uptr] : inc_plan.cached) {
+        const incremental::Unit& u = *uptr;
+        for (const audit::DecisionRecord& r : u.decided) {
+          audit::record(restamp(r));
+        }
+        if (!cached_pending.count(ukey)) {
+          for (const audit::DecisionRecord& r : u.resolved) {
+            audit::DecisionRecord c = restamp(r);
+            if (auto it = outcome.find(ukey); it != outcome.end()) {
+              c.reason = it->second.first;
+              c.action = "none";
+              c.detail = it->second.second;
+            } else if (suppressed_ids.count(ukey)) {
+              c.reason = u.noop_reason;
+              c.action = "none";
+              c.detail = u.noop_detail;
+            } else {
+              c.reason = audit::Reason::DryRun;
+              c.action = "none";
+              c.detail = "would have paused (run-mode dry-run)";
+            }
+            audit::record(std::move(c));
+          }
+        }
+        if (record) {
+          for (const incremental::PodEvidence& ev : u.evidence) {
+            recorder::record_pod(cycle_id, ev.key, ev.has_pod ? &ev.pod : nullptr,
+                                 ev.store_missed, "");
+            if (ev.walked) {
+              recorder::record_resolution(cycle_id, ev.key, ev.chain, ev.root_kind,
+                                          ev.root_ns, ev.root_name, ev.identity,
+                                          ev.walk_error);
+            }
+          }
+          for (const auto& [path, obj] : u.objects) {
+            recorder::record_object(cycle_id, path, obj ? &*obj : nullptr);
+          }
+        }
+      }
     }
+    if (fast && recorder::enabled()) {
+      recorder::record_ledger(cycle_id, ledger_now, ledger_feed);
+    }
+    for (const SuppressedNoop& s : suppressed) {
+      const incremental::Unit* u = s.unit;
+      recorder::record_actuation(cycle_id, s.identity, audit::reason_name(u->noop_reason),
+                                 u->noop_action, u->noop_detail,
+                                 /*counts_toward_seal=*/false);
+      if (u->noop_reason == audit::Reason::AlreadyPaused) {
+        log::counter_add("scale_noops", 1);
+        // The consumer's ledger echo: a no-op on an account already
+        // marked paused, kept for bit-identical ledger behavior.
+        ledger::record_pause(cycle_id, s.kind, s.ns, s.name, "ALREADY_PAUSED");
+      }
+    }
+  };
+  auto arm = [&] {
+    // One actuate-phase observation per cycle, taken when the consumers
+    // finish this cycle's queue (0s immediately when nothing is enqueued)
+    // — keeps every phase histogram's _count in lockstep per cycle. The
+    // capsule seals when the actuations drain; consumer outcomes that
+    // land before arming are credited at arm time.
+    audit::arm_actuation(cycle_id, args.dry_run() ? 0 : survivors.size(), trace_id);
+    recorder::arm(cycle_id, args.dry_run() ? 0 : survivors.size());
+  };
+  auto do_enqueue = [&] {
+    for (ScaleTarget& t : survivors) {
+      std::string desc = "[" + std::string(core::kind_name(t.kind)) + "] " +
+                         t.ns().value_or("") + ":" + t.name();
+      if (args.dry_run()) {
+        log::info("daemon", "Dry-run: Would have sent " + desc + " for scaledown");
+      } else {
+        ScalePlan plan;
+        if (auto it = rs_plans.find(t.identity()); it != rs_plans.end()) plan = it->second;
+        log::info("daemon", "Sending " + desc + " for scaledown");
+        // Differential engine: an enqueued unit's outcome is unknown until
+        // the consumer reports back — it must not serve from cache before
+        // then (the overlap-handoff deferral bug class).
+        if (incremental::engine().enabled()) {
+          incremental::engine().mark_enqueued(cycle_id, t.identity());
+        }
+        enqueue(std::move(t), std::move(plan), cycle_id);
+      }
+    }
+  };
+  seg("pending pass");
+  if (inc_plan.active && !args.dry_run()) {
+    // Incremental fast path: the (small) dirty survivor set enqueues
+    // FIRST, so detect→scaledown stops paying for the cached majority's
+    // record emission; the emission overlaps the consumer drain and the
+    // trackers arm last (early completions credited). Record ORDER in
+    // the ring/JSONL shifts relative to the full engine, but scale-down
+    // ordering is consumer-timing-dependent in both engines — only the
+    // record SET is part of the byte-identity contract there (dry-run,
+    // where ordering IS deterministic, keeps the classic sequence).
+    do_enqueue();
+    seg("enqueue");
+    emit_rest();
+    seg("emit_rest");
+    arm();
+  } else {
+    emit_rest();
+    arm();
+    do_enqueue();
   }
   observe_phase("total", cycle_start);
   return stats;
@@ -1215,7 +1734,8 @@ int run(const cli::Cli& args) {
     const size_t nshards = shard::resolve_shard_count(args.shards);
     shard::pool(nshards);
     log::info("daemon", "Reconcile engine: " + std::to_string(nshards) + " shard(s)" +
-              (args.shards == 0 ? " (auto)" : "") + ", cycle overlap " + args.overlap);
+              (args.shards == 0 ? " (auto)" : "") + ", cycle overlap " + args.overlap +
+              ", incremental " + args.incremental);
   }
 
   // Shared transport + decode path: set the process-wide defaults BEFORE
@@ -1236,6 +1756,24 @@ int run(const cli::Cli& args) {
   if (args.signal_guard == "on") {
     evidence_query = query::build_evidence_query(cli::to_query_args(args));
     log::info("daemon", "Signal guard on; evidence query: " + evidence_query);
+  }
+
+  // Differential reconcile engine (--incremental on): key the decision
+  // cache by a fingerprint of every decision-affecting input. The queries
+  // embed the thresholds, windows and schema; the remaining flags cover
+  // run mode, gates and right-sizing. A changed fingerprint clears the
+  // cache (config edges are invalidation source 3).
+  {
+    const std::string fp_src =
+        query + "\x1f" + evidence_query + "\x1f" + args.run_mode + "\x1f" +
+        args.enabled_resources + "\x1f" + std::to_string(args.duration) + "\x1f" +
+        std::to_string(args.grace_period) + "\x1f" + std::to_string(args.max_scale_per_cycle) +
+        "\x1f" + args.signal_guard + "\x1f" + std::to_string(args.signal_scrape_interval) +
+        "\x1f" + std::to_string(args.signal_max_age) + "\x1f" +
+        std::to_string(args.signal_min_coverage) + "\x1f" + args.right_size + "\x1f" +
+        std::to_string(args.right_size_threshold) + "\x1f" + args.device + "\x1f" +
+        cli::resolved_schema(args);
+    incremental::engine().configure(args.incremental == "on", shard::stable_hash(fp_src));
   }
 
   // Durable decision audit trail (--audit-log): every DecisionRecord the
@@ -1294,6 +1832,9 @@ int run(const cli::Cli& args) {
   std::unique_ptr<informer::ClusterCache> watch_cache;
   if (args.watch_cache == "on") {
     watch_cache = std::make_unique<informer::ClusterCache>(kube, informer::daemon_specs());
+    // Dirty journal before start(): the initial LISTs must land their
+    // global-dirty marks, not slip through an un-enabled journal.
+    if (args.incremental == "on") watch_cache->enable_dirty_journal();
     watch_cache->start();
     if (watch_cache->wait_synced(10000)) {
       log::info("daemon", "watch cache synced (" +
@@ -1327,7 +1868,8 @@ int run(const cli::Cli& args) {
     metrics_server->set_extra_metrics_provider([ledger_top_k](bool openmetrics) {
       return ledger::render_metrics(ledger_top_k, openmetrics) +
              signal::render_metrics(openmetrics) +
-             h2::render_transport_metrics(openmetrics);
+             h2::render_transport_metrics(openmetrics) +
+             incremental::render_metrics(openmetrics);
     });
     // Evidence-health snapshot at /debug/signals (`analyze
     // --signal-report` hits this); {"enabled": false} with the guard off.
@@ -1473,6 +2015,11 @@ int run(const cli::Cli& args) {
         // one of the cycle seals it.
         recorder::record_actuation(item->cycle, identity, audit::reason_name(reason),
                                    action, detail);
+        // Differential engine: a verified no-op makes the unit cacheable
+        // next cycle; anything that mutated the cluster (or failed) keeps
+        // it dirty. No-op with the engine off.
+        incremental::engine().record_actuation_outcome(item->cycle, identity, reason, action,
+                                                       detail);
         audit::actuation_done(item->cycle, reason == audit::Reason::AlreadyPaused);
       };
       if (!(enabled & core::flag(t.kind))) {

@@ -33,6 +33,7 @@
 #include "tpupruner/http.hpp"
 #include "tpupruner/json.hpp"
 #include "tpupruner/log.hpp"
+#include "tpupruner/shard.hpp"
 #include "tpupruner/util.hpp"
 
 namespace tpupruner::hub {
@@ -263,9 +264,17 @@ int run(int argc, char** argv) {
   });
 
   http::Client client;
+  // Member polls fan out over the shared worker pool: each member writes
+  // only its own MemberState slot and http::Client::request is
+  // thread-safe, so one slow (or timing-out) member costs the round
+  // max(member latencies) instead of the sum — fleet_merge_seconds no
+  // longer stretches for everyone when a single cluster drags.
+  shard::Pool& poll_pool =
+      shard::pool(std::min<size_t>(std::max<size_t>(members.size(), 1), 16));
   while (!g_shutdown.load()) {
     auto round_start = std::chrono::steady_clock::now();
-    for (MemberState& m : members) {
+    poll_pool.run(members.size(), [&](size_t i) {
+      MemberState& m = members[i];
       ++m.snap.polls;
       try {
         poll_member(client, opt, m);
@@ -282,7 +291,7 @@ int run(int argc, char** argv) {
       }
       m.snap.staleness_s =
           m.last_success_mono < 0 ? -1 : util::mono_secs() - m.last_success_mono;
-    }
+    });
     {
       std::vector<fleet::MemberSnapshot> snaps;
       snaps.reserve(members.size());

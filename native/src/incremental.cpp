@@ -1,0 +1,393 @@
+#include "tpupruner/incremental.hpp"
+
+#include <algorithm>
+#include <cstdio>
+
+#include "tpupruner/log.hpp"
+#include "tpupruner/metrics.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::incremental {
+
+namespace {
+
+std::string fmt_value(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%.9g", v);
+  return buf;
+}
+
+}  // namespace
+
+void Engine::configure(bool enabled, uint64_t config_fingerprint) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  if (enabled_ != enabled || config_fp_ != config_fingerprint) {
+    // Config edge: any decision-affecting flag change invalidates every
+    // cached decision — the cache is keyed by the config that produced it.
+    units_.clear();
+    pod_unit_.clear();
+    pod_fp_.clear();
+    path_units_.clear();
+    ns_groups_.clear();
+  }
+  enabled_ = enabled;
+  config_fp_ = config_fingerprint;
+}
+
+bool Engine::enabled() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return enabled_;
+}
+
+bool Engine::unit_dirty_locked(const Unit& u, int64_t now_unix,
+                               const std::unordered_map<std::string, size_t>& present) const {
+  if (u.never_cache) return true;
+  // A group unit without a verified all-idle verdict must re-gate (and
+  // therefore re-resolve) every cycle.
+  if (u.group_verdict == Unit::GroupVerdict::Unknown) return true;
+  if (u.deadline_unix != 0 && now_unix >= u.deadline_unix) return true;
+  // An enqueue that has not reported back (or that mutated the cluster)
+  // means the cached outcome no longer describes the world.
+  if (u.actuation == Unit::Actuation::InFlight || u.actuation == Unit::Actuation::Mutated) {
+    return true;
+  }
+  // Absent member: a pod that contributed last cycle but produces no
+  // sample now (deleted, went busy, or was signal-vetoed) changes the
+  // unit's record set, ledger chips and group evidence.
+  for (const auto& [pod, fp] : u.members) {
+    if (!present.count(pod)) return true;
+  }
+  return false;
+}
+
+Engine::Plan Engine::plan_cycle(const std::vector<core::PodMetricSample>& samples,
+                                const informer::ClusterCache::DirtyDrain& drain,
+                                int64_t now_unix, bool store_trusted) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  Plan plan;
+  plan.active = enabled_;
+  plan.pods_total = samples.size();
+  if (!enabled_ || drain.all || !store_trusted) {
+    plan.full = true;
+    plan.recompute.reserve(samples.size());
+    for (size_t i = 0; i < samples.size(); ++i) plan.recompute.push_back(i);
+    return plan;
+  }
+
+  std::unordered_set<std::string> dirty_units;
+  std::unordered_set<std::string> dirty_pods;
+  auto dirty_unit = [&](const std::string& key) { dirty_units.insert(key); };
+
+  // Source 1: the informer dirty journal. Pod events dirty the pod (and
+  // its unit); owner events dirty every unit whose walk consulted them.
+  for (const std::string& path : drain.paths) {
+    std::string pod = pod_key_of_path(path);
+    if (!pod.empty()) {
+      dirty_pods.insert(pod);
+      if (auto it = pod_unit_.find(pod); it != pod_unit_.end()) dirty_unit(it->second);
+      // Any pod event in a namespace invalidates every cached group-gate
+      // verdict there: the all-idle LIST covers pods the candidate set
+      // (and thus the sample diff) cannot see.
+      std::string ns = pod.substr(0, pod.find('/'));
+      if (auto it = ns_groups_.find(ns); it != ns_groups_.end()) {
+        for (const std::string& u : it->second) dirty_unit(u);
+      }
+    }
+    if (auto it = path_units_.find(path); it != path_units_.end()) {
+      for (const std::string& u : it->second) dirty_unit(u);
+    }
+  }
+
+  // Source 2: sample diffing. New or changed samples dirty the pod and
+  // its previous unit (a changed pod object can re-home a pod, so the old
+  // unit's siblings must recompute with it).
+  std::unordered_map<std::string, size_t> present;
+  present.reserve(samples.size());
+  for (size_t i = 0; i < samples.size(); ++i) {
+    const core::PodMetricSample& s = samples[i];
+    std::string key = s.ns + "/" + s.name;
+    uint64_t fp = metrics::sample_fingerprint(s);
+    auto pu = pod_unit_.find(key);
+    if (pu == pod_unit_.end()) {
+      dirty_pods.insert(key);
+    } else {
+      auto pf = pod_fp_.find(key);
+      if (pf == pod_fp_.end() || pf->second != fp) {
+        dirty_pods.insert(key);
+        dirty_unit(pu->second);
+      }
+    }
+    present.emplace(std::move(key), i);
+  }
+
+  // Source 3 + unit-local state: timers, transients, actuation echoes,
+  // absent members.
+  for (const auto& [key, u] : units_) {
+    if (dirty_units.count(key)) continue;
+    if (unit_dirty_locked(u, now_unix, present)) dirty_unit(key);
+  }
+
+  // A candidate recomputes when it is new, individually dirty, or a
+  // member of a dirty unit; everything else serves from cache.
+  for (size_t i = 0; i < samples.size(); ++i) {
+    const core::PodMetricSample& s = samples[i];
+    std::string key = s.ns + "/" + s.name;
+    auto pu = pod_unit_.find(key);
+    if (dirty_pods.count(key) || pu == pod_unit_.end() || dirty_units.count(pu->second)) {
+      plan.recompute.push_back(i);
+    }
+  }
+  for (const auto& [key, u] : units_) {
+    if (!dirty_units.count(key)) {
+      plan.cached.emplace(key, &u);
+      plan.hits += u.members.size();
+    }
+  }
+  plan.dirty_units.assign(dirty_units.begin(), dirty_units.end());
+  std::sort(plan.dirty_units.begin(), plan.dirty_units.end());
+  return plan;
+}
+
+std::vector<std::string> Engine::invalidate_unit(Plan& plan, const std::string& unit_key) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = plan.cached.find(unit_key);
+  if (it == plan.cached.end()) return {};
+  std::vector<std::string> members;
+  for (const auto& [pod, fp] : it->second->members) members.push_back(pod);
+  plan.hits -= it->second->members.size();
+  plan.cached.erase(it);
+  plan.dirty_units.insert(
+      std::lower_bound(plan.dirty_units.begin(), plan.dirty_units.end(), unit_key), unit_key);
+  return members;
+}
+
+void Engine::index_unit_locked(const Unit& u) {
+  for (const auto& [pod, fp] : u.members) {
+    pod_unit_[pod] = u.key;
+    pod_fp_[pod] = fp;
+  }
+  for (const auto& [path, obj] : u.objects) path_units_[path].insert(u.key);
+  if (u.group_verdict != Unit::GroupVerdict::NotGroup) ns_groups_[u.group_ns].insert(u.key);
+}
+
+void Engine::unindex_unit_locked(const Unit& u) {
+  for (const auto& [path, obj] : u.objects) {
+    auto it = path_units_.find(path);
+    if (it == path_units_.end()) continue;
+    it->second.erase(u.key);
+    if (it->second.empty()) path_units_.erase(it);
+  }
+  if (u.group_verdict != Unit::GroupVerdict::NotGroup) {
+    auto it = ns_groups_.find(u.group_ns);
+    if (it != ns_groups_.end()) {
+      it->second.erase(u.key);
+      if (it->second.empty()) ns_groups_.erase(it);
+    }
+  }
+}
+
+void Engine::commit_cycle(const Plan& plan, std::vector<Unit> fresh_units) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  if (!enabled_) return;
+  if (plan.full) {
+    units_.clear();
+    pod_unit_.clear();
+    pod_fp_.clear();
+    path_units_.clear();
+    ns_groups_.clear();
+  } else {
+    // Every unit that did not serve from cache this cycle is stale: it
+    // was either recomputed (a fresh unit replaces it below) or its pods
+    // vanished from the candidate set.
+    for (auto it = units_.begin(); it != units_.end();) {
+      if (plan.cached.count(it->first)) {
+        ++it;
+      } else {
+        unindex_unit_locked(it->second);
+        it = units_.erase(it);
+      }
+    }
+  }
+  for (Unit& u : fresh_units) {
+    auto existing = units_.find(u.key);
+    if (existing != units_.end()) {
+      // Replacing a still-cached unit (wave-2 corner: the unit was
+      // invalidated after planning) — drop the old index entries first.
+      unindex_unit_locked(existing->second);
+    }
+    std::string key = u.key;
+    Unit& stored = units_[key];
+    stored = std::move(u);
+    index_unit_locked(stored);
+  }
+  // Pod entries whose unit is gone (vanished candidates) must not keep
+  // answering the next plan's membership lookups.
+  for (auto it = pod_unit_.begin(); it != pod_unit_.end();) {
+    if (units_.count(it->second)) {
+      ++it;
+    } else {
+      pod_fp_.erase(it->first);
+      it = pod_unit_.erase(it);
+    }
+  }
+}
+
+void Engine::record_group_verdict(const std::string& unit_key, bool fully_idle) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = units_.find(unit_key);
+  if (it == units_.end()) return;
+  Unit& u = it->second;
+  if (u.group_verdict == Unit::GroupVerdict::NotGroup) return;
+  u.group_verdict =
+      fully_idle ? Unit::GroupVerdict::Idle : Unit::GroupVerdict::Unknown;
+}
+
+void Engine::mark_enqueued(uint64_t cycle, const std::string& unit_key) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = units_.find(unit_key);
+  if (it == units_.end()) return;
+  it->second.actuation = Unit::Actuation::InFlight;
+  it->second.actuation_cycle = cycle;
+}
+
+void Engine::record_actuation_outcome(uint64_t cycle, const std::string& unit_key,
+                                      audit::Reason reason, const std::string& action,
+                                      const std::string& detail) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = units_.find(unit_key);
+  if (it == units_.end()) return;
+  Unit& u = it->second;
+  if (u.actuation != Unit::Actuation::InFlight || u.actuation_cycle != cycle) return;
+  // Cacheable no-ops: the consumer verified the cluster already matches
+  // the decision (or the kind is disabled — a constant). Everything else
+  // changed the cluster or failed transiently: recompute next cycle.
+  if (reason == audit::Reason::AlreadyPaused || reason == audit::Reason::KindDisabled) {
+    u.actuation = Unit::Actuation::Noop;
+    u.noop_reason = reason;
+    u.noop_action = action;
+    u.noop_detail = detail;
+  } else {
+    u.actuation = Unit::Actuation::Mutated;
+  }
+}
+
+json::Value Engine::provenance_json(const Plan& plan) const {
+  json::Value v = json::Value::object();
+  v.set("enabled", json::Value(plan.active));
+  v.set("full", json::Value(plan.full));
+  v.set("pods", json::Value(static_cast<int64_t>(plan.pods_total)));
+  v.set("cache_hits", json::Value(static_cast<int64_t>(plan.hits)));
+  double ratio = plan.pods_total == 0
+                     ? 1.0
+                     : static_cast<double>(plan.hits) / static_cast<double>(plan.pods_total);
+  v.set("hit_ratio", json::Value(ratio));
+  json::Value dirty = json::Value::array();
+  for (const std::string& u : plan.dirty_units) dirty.push_back(json::Value(u));
+  v.set("dirty_units", std::move(dirty));
+  return v;
+}
+
+size_t Engine::unit_count() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return units_.size();
+}
+
+void Engine::reset() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  enabled_ = false;
+  config_fp_ = 0;
+  units_.clear();
+  pod_unit_.clear();
+  pod_fp_.clear();
+  path_units_.clear();
+  ns_groups_.clear();
+}
+
+Engine& engine() {
+  static Engine e;
+  return e;
+}
+
+std::string pod_key_of_path(const std::string& path) {
+  constexpr std::string_view kPrefix = "/api/v1/namespaces/";
+  if (!util::starts_with(path, kPrefix)) return "";
+  std::string rest = path.substr(kPrefix.size());
+  std::vector<std::string> parts = util::split(rest, '/');
+  if (parts.size() != 3 || parts[1] != "pods" || parts[0].empty() || parts[2].empty()) return "";
+  return parts[0] + "/" + parts[2];
+}
+
+// ── /metrics gauges ──
+
+namespace {
+
+struct MetricsState {
+  std::mutex mutex;
+  bool published = false;
+  double hit_ratio = 0;
+  uint64_t cached_pods = 0;
+  uint64_t dirty_pods = 0;
+  uint64_t full_recomputes = 0;
+};
+
+MetricsState& metrics_state() {
+  static MetricsState s;
+  return s;
+}
+
+}  // namespace
+
+void publish_metrics(const Engine::Plan& plan) {
+  MetricsState& s = metrics_state();
+  std::lock_guard<std::mutex> lock(s.mutex);
+  s.published = true;
+  s.hit_ratio = plan.pods_total == 0
+                    ? 1.0
+                    : static_cast<double>(plan.hits) / static_cast<double>(plan.pods_total);
+  s.cached_pods = plan.hits;
+  s.dirty_pods = plan.recompute.size();
+  if (plan.full) ++s.full_recomputes;
+}
+
+std::string render_metrics(bool openmetrics) {
+  MetricsState& s = metrics_state();
+  std::lock_guard<std::mutex> lock(s.mutex);
+  if (!s.published) return "";  // absent, not zero, until the first incremental cycle
+  std::string out;
+  auto gauge = [&](const char* name, const std::string& value, const char* help) {
+    out += std::string("# HELP tpu_pruner_") + name + " " + help + "\n";
+    out += std::string("# TYPE tpu_pruner_") + name + " gauge\n";
+    out += std::string("tpu_pruner_") + name + " " + value + "\n";
+  };
+  gauge("incremental_cache_hit_ratio", fmt_value(s.hit_ratio),
+        "Fraction of this cycle's candidate pods served from the decision cache");
+  gauge("incremental_cached_pods", std::to_string(s.cached_pods),
+        "Candidate pods served from the decision cache this cycle");
+  gauge("incremental_dirty_pods", std::to_string(s.dirty_pods),
+        "Candidate pods recomputed this cycle (the dirty set)");
+  const char* counter_name = "tpu_pruner_incremental_full_recomputes_total";
+  out += std::string("# HELP ") + counter_name +
+         " Cycles that fell back to a full recompute (relist, unsynced store, config edge)\n";
+  out += std::string("# TYPE ") +
+         (openmetrics ? "tpu_pruner_incremental_full_recomputes" : counter_name) + " counter\n";
+  out += std::string(counter_name) + " " + std::to_string(s.full_recomputes) + "\n";
+  return out;
+}
+
+std::vector<std::string> metric_families() {
+  return {"tpu_pruner_incremental_cache_hit_ratio", "tpu_pruner_incremental_cached_pods",
+          "tpu_pruner_incremental_dirty_pods", "tpu_pruner_incremental_full_recomputes_total"};
+}
+
+void reset_for_test() {
+  engine().reset();
+  MetricsState& s = metrics_state();
+  std::lock_guard<std::mutex> lock(s.mutex);
+  s.published = false;
+  s.hit_ratio = 0;
+  s.cached_pods = 0;
+  s.dirty_pods = 0;
+  s.full_recomputes = 0;
+}
+
+}  // namespace tpupruner::incremental

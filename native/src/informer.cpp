@@ -112,6 +112,42 @@ void Store::erase(const std::string& object_path) {
 // materializes as one response on either end.
 constexpr int64_t kListPageLimit = 500;
 
+// Dirty-journal bound: past this many undrained paths the journal
+// degrades to globally dirty. A cycle interval's worth of churn is
+// normally a few hundred events; hitting the cap means the consumer
+// stopped draining (or the cluster is churning at relist scale), and a
+// full recompute is the honest answer either way.
+constexpr size_t kDirtyJournalCap = 65536;
+
+void Reflector::enable_dirty_journal() { journal_enabled_.store(true); }
+
+void Reflector::drain_dirty(std::vector<std::string>& paths, bool& all) const {
+  std::lock_guard<std::mutex> lock(dirty_mutex_);
+  if (dirty_all_) all = true;
+  dirty_all_ = false;
+  for (std::string& p : dirty_paths_) paths.push_back(std::move(p));
+  dirty_paths_.clear();
+}
+
+void Reflector::journal_touch(const std::string& path) {
+  if (!journal_enabled_.load(std::memory_order_relaxed)) return;
+  std::lock_guard<std::mutex> lock(dirty_mutex_);
+  if (dirty_all_) return;  // already globally dirty; paths are redundant
+  if (dirty_paths_.size() >= kDirtyJournalCap) {
+    dirty_paths_.clear();
+    dirty_all_ = true;
+    return;
+  }
+  dirty_paths_.push_back(path);
+}
+
+void Reflector::journal_all() {
+  if (!journal_enabled_.load(std::memory_order_relaxed)) return;
+  std::lock_guard<std::mutex> lock(dirty_mutex_);
+  dirty_paths_.clear();
+  dirty_all_ = true;
+}
+
 Reflector::Reflector(const k8s::Client& kube, ResourceSpec spec)
     : kube_(kube), spec_(std::move(spec)) {}
 
@@ -187,6 +223,10 @@ void Reflector::apply_list(const Value& list) {
 
 void Reflector::apply_list_snapshot(std::map<std::string, Store::Entry> snapshot,
                                     std::string rv) {
+  // A LIST snapshot means the watch stream could not be trusted (initial
+  // sync, 410, failure streak) — events may have been missed, so the
+  // incremental engine must treat everything as changed.
+  journal_all();
   store_.replace_entries(std::move(snapshot));
   {
     std::lock_guard<std::mutex> lock(stats_mutex_);
@@ -250,6 +290,7 @@ bool Reflector::apply_event(const Value& event) {
     std::string path = object_path_of(*object);
     if (path.empty()) return true;
     bool existed = store_.get(path).has_value();
+    journal_touch(path);
     store_.upsert(path, *object);
     std::lock_guard<std::mutex> lock(stats_mutex_);
     ++(existed ? stats_.updates : stats_.adds);
@@ -258,6 +299,7 @@ bool Reflector::apply_event(const Value& event) {
     if (!object) return true;
     std::string path = object_path_of(*object);
     if (path.empty()) return true;
+    journal_touch(path);
     store_.erase(path);
     std::lock_guard<std::mutex> lock(stats_mutex_);
     ++stats_.deletes;
@@ -309,6 +351,7 @@ bool Reflector::apply_event_doc(const json::DocPtr& event) {
     bool existed = store_.contains(path);
     // The event Doc rides into the store: the object stays arena-flat
     // until some cycle actually looks it up.
+    journal_touch(path);
     store_.upsert_doc(path, event, object->index());
     std::lock_guard<std::mutex> lock(stats_mutex_);
     ++(existed ? stats_.updates : stats_.adds);
@@ -317,6 +360,7 @@ bool Reflector::apply_event_doc(const json::DocPtr& event) {
     if (!object) return true;
     std::string path = object_path_of_doc(*object);
     if (path.empty()) return true;
+    journal_touch(path);
     store_.erase(path);
     std::lock_guard<std::mutex> lock(stats_mutex_);
     ++stats_.deletes;
@@ -543,6 +587,16 @@ int64_t ClusterCache::staleness_secs() const {
     worst = std::max(worst, age);
   }
   return worst;
+}
+
+void ClusterCache::enable_dirty_journal() {
+  for (auto& r : reflectors_) r->enable_dirty_journal();
+}
+
+ClusterCache::DirtyDrain ClusterCache::drain_dirty() const {
+  DirtyDrain out;
+  for (auto& r : reflectors_) r->drain_dirty(out.paths, out.all);
+  return out;
 }
 
 Value ClusterCache::stats_json() const {

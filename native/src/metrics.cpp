@@ -208,4 +208,31 @@ DecodeResult decode_instant_vector(const json::Doc& response, const std::string&
   return out;
 }
 
+uint64_t sample_fingerprint(const core::PodMetricSample& s) {
+  // FNV-1a, field-delimited so ("ab","c") never collides with ("a","bc").
+  // Not std::hash for the same reason shard placement isn't: the value
+  // participates in a cross-cycle contract and must be stable.
+  uint64_t h = 0xcbf29ce484222325ull;
+  auto mix = [&](const void* p, size_t n) {
+    const unsigned char* b = static_cast<const unsigned char*>(p);
+    for (size_t i = 0; i < n; ++i) {
+      h ^= b[i];
+      h *= 0x100000001b3ull;
+    }
+  };
+  auto mix_str = [&](const std::string& v) {
+    mix(v.data(), v.size());
+    h ^= 0xffu;  // field delimiter (never a UTF-8 byte in label values)
+    h *= 0x100000001b3ull;
+  };
+  mix_str(s.name);
+  mix_str(s.ns);
+  mix_str(s.container);
+  mix_str(s.node_type);
+  mix_str(s.accelerator);
+  double value = s.value;
+  mix(&value, sizeof(value));
+  return h;
+}
+
 }  // namespace tpupruner::metrics

@@ -43,11 +43,16 @@ struct OpenCapsule {
   Value objects = Value::object();      // API path → object | null (miss)
   Value root_flags = Value::object();   // identity → {root_opted_out, ...}
   Value actuations = Value::object();   // identity → {reason, action, detail}
+  // Consumer actuations that reported back BEFORE arm() (the incremental
+  // fast path enqueues first and arms after the cached records emit):
+  // arm() credits them against `expected` so the capsule still seals.
+  size_t early_actuations = 0;
   Value vetoed_roots = Value::array();
   Value vetoed_namespaces = Value::object();
   Value ledger;                         // {now_unix, observations} — the observe_cycle feed
   Value breaker;                        // {limit, actionable, deferred, tripped}
   Value stats;                          // {num_series, num_pods, shutdown_events}
+  Value incremental;                    // differential-engine provenance (dirty set, hits)
   std::vector<Value> decisions;         // verbatim DecisionRecord JSON
   bool armed = false;
   size_t remaining = 0;
@@ -167,6 +172,11 @@ void seal_locked(Registry& r, uint64_t cycle) {
   doc.set("root_flags", std::move(c.root_flags));
   if (!c.breaker.is_null()) doc.set("breaker", std::move(c.breaker));
   if (!c.stats.is_null()) doc.set("stats", std::move(c.stats));
+  // Provenance, not evidence: how the differential engine assembled this
+  // cycle's view (dirty set + cache hits). Replay recomputes in full and
+  // never consults it — byte-identity comparisons across --incremental
+  // modes normalize this key away, like ts/trace_id.
+  if (!c.incremental.is_null()) doc.set("incremental", std::move(c.incremental));
   doc.set("decisions", std::move(decisions));
 
   fs::path final_path = fs::path(r.dir) / (id + ".json");
@@ -392,6 +402,14 @@ void flag_root(uint64_t cycle, const std::string& identity, const char* flag) {
   c->root_flags.set(identity, std::move(flags));
 }
 
+void record_incremental(uint64_t cycle, Value provenance) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  c->incremental = std::move(provenance);
+}
+
 void record_breaker(uint64_t cycle, int64_t limit, size_t actionable, size_t deferred) {
   Registry& r = reg();
   std::lock_guard<std::mutex> lock(r.mutex);
@@ -431,12 +449,16 @@ void arm(uint64_t cycle, size_t expected) {
   OpenCapsule* c = open_capsule_locked(r, cycle);
   if (!c) return;
   c->armed = true;
-  c->remaining = expected;
-  if (expected == 0) seal_locked(r, cycle);
+  // Credit consumer outcomes that landed before arming (see
+  // early_actuations above) so a fast drain can never wedge the seal.
+  c->remaining = expected > c->early_actuations ? expected - c->early_actuations : 0;
+  c->early_actuations = 0;
+  if (c->remaining == 0) seal_locked(r, cycle);
 }
 
 void record_actuation(uint64_t cycle, const std::string& identity, const std::string& reason,
-                      const std::string& action, const std::string& detail) {
+                      const std::string& action, const std::string& detail,
+                      bool counts_toward_seal) {
   Registry& r = reg();
   std::lock_guard<std::mutex> lock(r.mutex);
   OpenCapsule* c = open_capsule_locked(r, cycle);
@@ -446,7 +468,12 @@ void record_actuation(uint64_t cycle, const std::string& identity, const std::st
   a.set("action", Value(action));
   if (!detail.empty()) a.set("detail", Value(detail));
   c->actuations.set(identity, std::move(a));
-  if (c->armed && c->remaining > 0 && --c->remaining == 0) seal_locked(r, cycle);
+  if (!counts_toward_seal) return;  // producer-side cached no-op stamps
+  if (c->armed) {
+    if (c->remaining > 0 && --c->remaining == 0) seal_locked(r, cycle);
+  } else {
+    ++c->early_actuations;
+  }
 }
 
 void seal_all() {

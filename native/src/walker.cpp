@@ -1,5 +1,7 @@
 #include "tpupruner/walker.hpp"
 
+#include <set>
+
 #include <atomic>
 #include <stdexcept>
 #include <thread>
@@ -276,13 +278,18 @@ size_t prefetch_owner_chains(const k8s::Client& client, FetchCache& cache,
   return lists;
 }
 
+ObjectFetcher live_fetcher(const k8s::Client& client, FetchCache* cache,
+                           const informer::ClusterCache* store) {
+  const k8s::Client* c = &client;
+  return [c, cache, store](const std::string& path) {
+    return cached_get_opt(*c, cache, store, path);
+  };
+}
+
 ScaleTarget find_root_object(const k8s::Client& client, const Value& pod, FetchCache* cache,
                              const informer::ClusterCache* store,
                              std::vector<std::string>* chain_out) {
-  ObjectFetcher fetcher = [&](const std::string& path) {
-    return cached_get_opt(client, cache, store, path);
-  };
-  return find_root_object_from(fetcher, pod, chain_out);
+  return find_root_object_from(live_fetcher(client, cache, store), pod, chain_out);
 }
 
 ScaleTarget find_root_object_from(const ObjectFetcher& fetcher, const Value& pod,

@@ -1,0 +1,359 @@
+// Differential reconcile engine units (tpupruner/incremental.hpp) — the
+// dirty-set planner and memoized decision cache behind --incremental.
+// What is pinned here:
+//   - the three invalidation sources (watch events via pod map + object
+//     reverse index, sample-fingerprint diffs, timer/config edges) each
+//     dirty exactly the affected units;
+//   - relist / untrusted-store / journal-overflow degrade to a FULL
+//     recompute, never to a silently stale cache;
+//   - the actuation state machine: an enqueued unit stays dirty until the
+//     consumer reports a cacheable no-op, and anything that mutated the
+//     cluster recomputes next cycle (the overlap-deferral bug class);
+//   - wave-2 invalidation hands back a cached unit's members when a
+//     recomputed pod resolves into it;
+//   - the cache is written by the producer and updated by concurrent
+//     consumers — the TSan tier (just tsan-incremental) runs these tests
+//     to prove the locking.
+#include "testing.hpp"
+
+#include <thread>
+#include <vector>
+
+#include "tpupruner/incremental.hpp"
+#include "tpupruner/metrics.hpp"
+
+namespace incremental = tpupruner::incremental;
+namespace metrics = tpupruner::metrics;
+using tpupruner::audit::Reason;
+using tpupruner::core::PodMetricSample;
+using tpupruner::informer::ClusterCache;
+
+namespace {
+
+PodMetricSample sample(const std::string& ns, const std::string& name, double value = 0.0) {
+  PodMetricSample s;
+  s.ns = ns;
+  s.name = name;
+  s.container = "main";
+  s.node_type = "tpu-v5-lite-podslice";
+  s.accelerator = "tpu-v5-lite-podslice";
+  s.value = value;
+  return s;
+}
+
+incremental::Unit unit_for(const std::string& key,
+                           const std::vector<PodMetricSample>& pods,
+                           const std::string& object_path = "") {
+  incremental::Unit u;
+  u.key = key;
+  for (const PodMetricSample& p : pods) {
+    u.members.emplace_back(p.ns + "/" + p.name, metrics::sample_fingerprint(p));
+  }
+  if (!object_path.empty()) u.objects.emplace_back(object_path, std::nullopt);
+  return u;
+}
+
+// A fresh enabled engine seeded with `units` via a full-recompute commit.
+void seed(incremental::Engine& e, std::vector<incremental::Unit> units) {
+  e.configure(true, 42);
+  incremental::Engine::Plan full;
+  full.active = true;
+  full.full = true;
+  e.commit_cycle(full, std::move(units));
+}
+
+}  // namespace
+
+TP_TEST(incremental_quiesced_cluster_serves_everything_from_cache) {
+  incremental::Engine e;
+  std::vector<PodMetricSample> pods = {sample("ml", "a"), sample("ml", "b")};
+  seed(e, {unit_for("Deployment/uid:1", pods, "/apis/apps/v1/namespaces/ml/deployments/d")});
+  ClusterCache::DirtyDrain drain;  // no events
+  auto plan = e.plan_cycle(pods, drain, 1000, true);
+  TP_CHECK(plan.active);
+  TP_CHECK(!plan.full);
+  TP_CHECK_EQ(plan.recompute.size(), size_t(0));
+  TP_CHECK_EQ(plan.hits, size_t(2));
+  TP_CHECK_EQ(plan.cached.size(), size_t(1));
+}
+
+TP_TEST(incremental_sample_change_dirties_pod_and_unit) {
+  incremental::Engine e;
+  std::vector<PodMetricSample> pods = {sample("ml", "a"), sample("ml", "b")};
+  seed(e, {unit_for("Deployment/uid:1", pods)});
+  std::vector<PodMetricSample> next = pods;
+  next[0].value = 0.5;  // the sample diff — fingerprint flips
+  auto plan = e.plan_cycle(next, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK(!plan.full);
+  // The dirty pod drags its whole unit (sibling included) into recompute.
+  TP_CHECK_EQ(plan.recompute.size(), size_t(2));
+  TP_CHECK_EQ(plan.hits, size_t(0));
+  TP_CHECK_EQ(plan.dirty_units.size(), size_t(1));
+  TP_CHECK_EQ(plan.dirty_units[0], std::string("Deployment/uid:1"));
+}
+
+TP_TEST(incremental_new_and_absent_pods_dirty) {
+  incremental::Engine e;
+  std::vector<PodMetricSample> pods = {sample("ml", "a")};
+  seed(e, {unit_for("Deployment/uid:1", pods)});
+  // New pod: recomputes (and may wave-2 into a cached root later).
+  std::vector<PodMetricSample> with_new = {sample("ml", "a"), sample("ml", "new")};
+  auto plan = e.plan_cycle(with_new, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan.recompute.size(), size_t(1));
+  TP_CHECK_EQ(with_new[plan.recompute[0]].name, std::string("new"));
+  TP_CHECK_EQ(plan.hits, size_t(1));
+  // Absent member: the unit is dirty even though no present pod changed.
+  auto plan2 = e.plan_cycle({}, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan2.cached.size(), size_t(0));
+}
+
+TP_TEST(incremental_watch_event_dirties_via_pod_map_and_object_index) {
+  incremental::Engine e;
+  std::vector<PodMetricSample> a = {sample("ml", "a")};
+  std::vector<PodMetricSample> b = {sample("ml", "b")};
+  incremental::Unit ua = unit_for("Deployment/uid:1", a, "/apis/apps/v1/namespaces/ml/deployments/da");
+  incremental::Unit ub = unit_for("Deployment/uid:2", b, "/apis/apps/v1/namespaces/ml/deployments/db");
+  seed(e, {ua, ub});
+  std::vector<PodMetricSample> all = {sample("ml", "a"), sample("ml", "b")};
+
+  // Pod event → unit 1 dirty via the pod→unit map.
+  ClusterCache::DirtyDrain pod_ev;
+  pod_ev.paths.push_back("/api/v1/namespaces/ml/pods/a");
+  auto plan = e.plan_cycle(all, pod_ev, 1000, true);
+  TP_CHECK(plan.dirty_units == (std::vector<std::string>{"Deployment/uid:1"}));
+  TP_CHECK_EQ(plan.hits, size_t(1));
+
+  // Owner event → unit 2 dirty via the consulted-object reverse index.
+  ClusterCache::DirtyDrain owner_ev;
+  owner_ev.paths.push_back("/apis/apps/v1/namespaces/ml/deployments/db");
+  plan = e.plan_cycle(all, owner_ev, 1000, true);
+  TP_CHECK(plan.dirty_units == (std::vector<std::string>{"Deployment/uid:2"}));
+
+  // Unrelated event → nothing dirties.
+  ClusterCache::DirtyDrain other;
+  other.paths.push_back("/apis/apps/v1/namespaces/elsewhere/deployments/x");
+  plan = e.plan_cycle(all, other, 1000, true);
+  TP_CHECK_EQ(plan.dirty_units.size(), size_t(0));
+  TP_CHECK_EQ(plan.hits, size_t(2));
+}
+
+TP_TEST(incremental_relist_and_untrusted_store_force_full_recompute) {
+  incremental::Engine e;
+  std::vector<PodMetricSample> pods = {sample("ml", "a")};
+  seed(e, {unit_for("Deployment/uid:1", pods)});
+  ClusterCache::DirtyDrain relist;
+  relist.all = true;
+  auto plan = e.plan_cycle(pods, relist, 1000, true);
+  TP_CHECK(plan.full);
+  TP_CHECK_EQ(plan.recompute.size(), size_t(1));
+  TP_CHECK_EQ(plan.cached.size(), size_t(0));
+  // Unsynced store: the journal can't vouch for object freshness.
+  seed(e, {unit_for("Deployment/uid:1", pods)});
+  plan = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 1000, false);
+  TP_CHECK(plan.full);
+}
+
+TP_TEST(incremental_timer_unit_self_dirties_at_deadline) {
+  incremental::Engine e;
+  std::vector<PodMetricSample> pods = {sample("ml", "young")};
+  incremental::Unit u = unit_for("pod:ml/young", pods);
+  u.deadline_unix = 500;
+  seed(e, {u});
+  auto before = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 499, true);
+  TP_CHECK_EQ(before.hits, size_t(1));
+  auto at = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 500, true);
+  TP_CHECK_EQ(at.hits, size_t(0));
+  TP_CHECK_EQ(at.recompute.size(), size_t(1));
+}
+
+TP_TEST(incremental_never_cache_units_recompute_every_cycle) {
+  incremental::Engine e;
+  std::vector<PodMetricSample> pods = {sample("tpu-jobs", "host-0")};
+  incremental::Unit u = unit_for("JobSet/uid:7", pods);
+  u.never_cache = true;  // transients, GET-fallback pods, unparsed timers
+  seed(e, {u});
+  auto plan = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(0));
+  TP_CHECK_EQ(plan.recompute.size(), size_t(1));
+}
+
+TP_TEST(incremental_enqueued_unit_stays_dirty_until_noop_reported) {
+  incremental::Engine e;
+  std::vector<PodMetricSample> pods = {sample("ml", "a")};
+  seed(e, {unit_for("Deployment/uid:1", pods)});
+  // Enqueued, no outcome yet → dirty (a deferral or in-flight actuation
+  // must never be served from cache on the following cycle).
+  e.mark_enqueued(7, "Deployment/uid:1");
+  auto plan = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(0));
+  // A mutating outcome (SCALED) keeps it dirty.
+  seed(e, {unit_for("Deployment/uid:1", pods)});
+  e.mark_enqueued(8, "Deployment/uid:1");
+  e.record_actuation_outcome(8, "Deployment/uid:1", Reason::Scaled, "scale_down", "");
+  plan = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(0));
+  // A verified no-op makes it cacheable, and the verdict rides the unit.
+  seed(e, {unit_for("Deployment/uid:1", pods)});
+  e.mark_enqueued(9, "Deployment/uid:1");
+  e.record_actuation_outcome(9, "Deployment/uid:1", Reason::AlreadyPaused, "none",
+                             "root already at its paused state");
+  plan = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(1));
+  const incremental::Unit* cached = plan.cached.at("Deployment/uid:1");
+  TP_CHECK(cached->actuation == incremental::Unit::Actuation::Noop);
+  TP_CHECK(cached->noop_reason == Reason::AlreadyPaused);
+  // A stale outcome (wrong cycle) is ignored.
+  seed(e, {unit_for("Deployment/uid:1", pods)});
+  e.mark_enqueued(10, "Deployment/uid:1");
+  e.record_actuation_outcome(3, "Deployment/uid:1", Reason::AlreadyPaused, "none", "");
+  plan = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(0));
+}
+
+TP_TEST(incremental_group_verdict_gates_caching) {
+  incremental::Engine e;
+  std::vector<PodMetricSample> pods = {sample("tpu-jobs", "host-0"),
+                                       sample("tpu-jobs", "host-1")};
+  incremental::Unit u = unit_for("JobSet/uid:7", pods);
+  u.group_verdict = incremental::Unit::GroupVerdict::Unknown;
+  u.group_ns = "tpu-jobs";
+  seed(e, {u});
+  // Unknown verdict (never verified / gate failed / not fully idle):
+  // the unit re-gates — and re-resolves — every cycle.
+  auto plan = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(0));
+  // A verified all-idle verdict makes it cacheable...
+  seed(e, {u});
+  e.record_group_verdict("JobSet/uid:7", true);
+  plan = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(2));
+  // ...until ANY pod event lands in the group's namespace (the gate's
+  // LIST covers pods the candidate set cannot see).
+  ClusterCache::DirtyDrain ns_event;
+  ns_event.paths.push_back("/api/v1/namespaces/tpu-jobs/pods/some-other-pod");
+  plan = e.plan_cycle(pods, ns_event, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(0));
+  // A pod event elsewhere leaves the verdict standing.
+  seed(e, {u});
+  e.record_group_verdict("JobSet/uid:7", true);
+  ClusterCache::DirtyDrain other_ns;
+  other_ns.paths.push_back("/api/v1/namespaces/elsewhere/pods/p");
+  plan = e.plan_cycle(pods, other_ns, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(2));
+  // A failed/not-idle verdict resets to Unknown — never sticky.
+  e.record_group_verdict("JobSet/uid:7", false);
+  plan = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(0));
+}
+
+TP_TEST(incremental_wave2_invalidation_returns_members) {
+  incremental::Engine e;
+  std::vector<PodMetricSample> pods = {sample("ml", "a"), sample("ml", "b")};
+  seed(e, {unit_for("Deployment/uid:1", pods)});
+  std::vector<PodMetricSample> with_new = {sample("ml", "a"), sample("ml", "b"),
+                                           sample("ml", "joiner")};
+  auto plan = e.plan_cycle(with_new, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(2));
+  // The joiner's walk resolved into the cached root: its siblings come
+  // back for re-walking and the unit stops serving.
+  auto members = e.invalidate_unit(plan, "Deployment/uid:1");
+  TP_CHECK_EQ(members.size(), size_t(2));
+  TP_CHECK_EQ(plan.hits, size_t(0));
+  TP_CHECK_EQ(plan.cached.size(), size_t(0));
+  // Second invalidation is a no-op.
+  TP_CHECK_EQ(e.invalidate_unit(plan, "Deployment/uid:1").size(), size_t(0));
+}
+
+TP_TEST(incremental_config_edge_clears_cache) {
+  incremental::Engine e;
+  std::vector<PodMetricSample> pods = {sample("ml", "a")};
+  seed(e, {unit_for("Deployment/uid:1", pods)});
+  e.configure(true, 43);  // flag fingerprint changed
+  auto plan = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(0));
+  TP_CHECK_EQ(e.unit_count(), size_t(0));
+}
+
+TP_TEST(incremental_commit_drops_vanished_units_and_reindexes) {
+  incremental::Engine e;
+  std::vector<PodMetricSample> a = {sample("ml", "a")};
+  std::vector<PodMetricSample> b = {sample("ml", "b")};
+  seed(e, {unit_for("Deployment/uid:1", a), unit_for("Deployment/uid:2", b)});
+  TP_CHECK_EQ(e.unit_count(), size_t(2));
+  // Next cycle only unit 2 is present and clean; unit 1's pod vanished.
+  auto plan = e.plan_cycle(b, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan.hits, size_t(1));
+  e.commit_cycle(plan, {});
+  TP_CHECK_EQ(e.unit_count(), size_t(1));
+  // The vanished pod's map entry is gone: it re-registers as new.
+  auto plan2 = e.plan_cycle(a, ClusterCache::DirtyDrain{}, 1000, true);
+  TP_CHECK_EQ(plan2.recompute.size(), size_t(1));
+}
+
+TP_TEST(incremental_pod_key_of_path_parses_only_pod_paths) {
+  TP_CHECK_EQ(incremental::pod_key_of_path("/api/v1/namespaces/ml/pods/a"),
+              std::string("ml/a"));
+  TP_CHECK_EQ(incremental::pod_key_of_path("/apis/apps/v1/namespaces/ml/deployments/d"),
+              std::string(""));
+  TP_CHECK_EQ(incremental::pod_key_of_path("/api/v1/namespaces/ml/configmaps/c"),
+              std::string(""));
+  TP_CHECK_EQ(incremental::pod_key_of_path("/api/v1/namespaces/ml/pods/a/status"),
+              std::string(""));
+}
+
+TP_TEST(incremental_sample_fingerprint_field_sensitivity) {
+  PodMetricSample s = sample("ml", "a", 0.0);
+  uint64_t base = metrics::sample_fingerprint(s);
+  TP_CHECK_EQ(metrics::sample_fingerprint(s), base);  // stable
+  PodMetricSample v = s;
+  v.value = 0.25;
+  TP_CHECK(metrics::sample_fingerprint(v) != base);
+  PodMetricSample acc = s;
+  acc.accelerator = "tpu-v4-podslice";
+  TP_CHECK(metrics::sample_fingerprint(acc) != base);
+  // Field-delimited: ("ab","c") vs ("a","bc") must not collide.
+  PodMetricSample x = sample("ml", "ab");
+  x.container = "c";
+  PodMetricSample y = sample("ml", "a");
+  y.container = "bc";
+  TP_CHECK(metrics::sample_fingerprint(x) != metrics::sample_fingerprint(y));
+}
+
+TP_TEST(incremental_concurrent_consumers_and_planner_race_free) {
+  // The cache is written by the producer (plan/commit) while consumer
+  // threads report actuation outcomes — the TSan tier runs this test to
+  // prove the engine's locking (just tsan-incremental).
+  incremental::Engine e;
+  std::vector<PodMetricSample> pods;
+  std::vector<incremental::Unit> units;
+  for (int i = 0; i < 16; ++i) {
+    PodMetricSample p = sample("ml", "p" + std::to_string(i));
+    pods.push_back(p);
+    units.push_back(unit_for("Deployment/uid:" + std::to_string(i), {p}));
+  }
+  seed(e, units);
+  std::vector<std::thread> consumers;
+  for (int t = 0; t < 4; ++t) {
+    consumers.emplace_back([&e, t] {
+      for (int i = 0; i < 200; ++i) {
+        std::string key = "Deployment/uid:" + std::to_string((t * 53 + i) % 16);
+        e.record_actuation_outcome(1, key, Reason::AlreadyPaused, "none", "noop");
+      }
+    });
+  }
+  for (int cycle = 0; cycle < 50; ++cycle) {
+    auto plan = e.plan_cycle(pods, ClusterCache::DirtyDrain{}, 1000 + cycle, true);
+    // Recompute dirty units (what the daemon's resolve stage would do):
+    // commit a fresh unit for every pod not served from cache.
+    std::vector<incremental::Unit> fresh;
+    for (size_t idx : plan.recompute) {
+      fresh.push_back(unit_for("Deployment/uid:" + std::to_string(idx), {pods[idx]}));
+    }
+    e.commit_cycle(plan, std::move(fresh));
+    for (int i = 0; i < 4; ++i) {
+      e.mark_enqueued(1, "Deployment/uid:" + std::to_string(i));
+    }
+  }
+  for (std::thread& t : consumers) t.join();
+  TP_CHECK_EQ(e.unit_count(), size_t(16));
+}

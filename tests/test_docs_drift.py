@@ -126,6 +126,44 @@ def test_transport_surfaces_documented(built):
         f"shared-transport surfaces missing from docs/OPERATIONS.md: {missing}")
 
 
+def test_incremental_surfaces_documented(built):
+    """The differential-reconcile families come from the native canonical
+    list (incremental::metric_families) so a gauge added to
+    incremental.cpp without a runbook row fails even though the families
+    are absent from /metrics until the engine runs. The flag, runbook
+    section and provenance surfaces ride the same guard."""
+    doc = OPERATIONS.read_text()
+    families = native.incremental_metric_families()
+    assert len(families) >= 4
+    missing = [f for f in families if f not in doc]
+    assert not missing, (
+        f"incremental metric families missing from docs/OPERATIONS.md: "
+        f"{missing} — document each in the Observability table and the "
+        "'Incremental reconcile' section")
+    needles = ("Incremental reconcile", "--incremental", "--incremental off",
+               "dirty", "cache_merge", "never served")
+    missing = [n for n in needles if n not in doc]
+    assert not missing, (
+        f"incremental-reconcile surfaces missing from docs/OPERATIONS.md: "
+        f"{missing}")
+
+
+def test_incremental_bench_summary_fields_documented():
+    """Incremental bench fields must be in BENCH_FIELDS.md AND actually
+    emitted by bench.py — a drift on either side fails."""
+    bench_src = (REPO / "bench.py").read_text()
+    fields_doc = (REPO / "docs" / "BENCH_FIELDS.md").read_text()
+    for field in ("warm_cycle_cpu_ms", "mega_warm_cycle_cpu_ms",
+                  "mega_full_warm_cycle_cpu_ms",
+                  "mega_incremental_cache_hit_ratio",
+                  "mega_quiesced_cache_hit_ratio",
+                  "mega_incremental_byte_identity_ok",
+                  "mega_warm_p50_recorded_bar_s"):
+        assert f'"{field}"' in bench_src, f"bench.py no longer emits {field}"
+        assert field in fields_doc, (
+            f"bench summary field {field} missing from docs/BENCH_FIELDS.md")
+
+
 def test_transport_bench_summary_fields_documented():
     """Transport bench summary fields must be in BENCH_FIELDS.md AND
     actually emitted by bench.py — a drift on either side fails."""

@@ -239,6 +239,78 @@ def test_member_daemon_404s_fleet_routes(fleet):
     assert err.value.code == 404
 
 
+def test_hub_polls_members_in_parallel(built, tmp_path):
+    """Member polls fan out over the worker pool: a slow member must cost
+    the round max(member latencies), not the sum. Two stub members that
+    sleep 0.8 s per request (3 requests each per round) would serialize
+    to >= 4.8 s/round; the parallel hub finishes a round in ~2.4 s. The
+    hub's own fleet_merge_seconds histogram is the measurement."""
+    import http.server
+    import threading
+
+    class SlowMember(http.server.ThreadingHTTPServer):
+        daemon_threads = True
+
+        def __init__(self, cluster):
+            self.cluster = cluster
+            super().__init__(("127.0.0.1", 0), SlowHandler)
+
+    class SlowHandler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            time.sleep(0.8)
+            if self.path.endswith("workloads"):
+                doc = {"cluster": self.server.cluster, "workloads": [],
+                       "tracked": 0, "totals": {}}
+            elif self.path.endswith("signals"):
+                doc = {"cluster": self.server.cluster, "enabled": False}
+            else:
+                doc = {"cluster": self.server.cluster, "decisions": []}
+            body = json.dumps(doc).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    servers = [SlowMember("slow-0"), SlowMember("slow-1")]
+    for s in servers:
+        threading.Thread(target=s.serve_forever, daemon=True).start()
+    f = FakeFleet(tmp_path)
+    try:
+        f.start_hub(poll_interval=1, member_urls=[
+            f"http://127.0.0.1:{s.server_address[1]}" for s in servers])
+
+        def round_stats():
+            body = f.hub_get("/metrics")
+            m_sum = re.search(
+                r"tpu_pruner_fleet_merge_seconds_sum(?:\{[^}]*\})? "
+                r"([0-9.eE+-]+)", body)
+            m_count = re.search(
+                r"tpu_pruner_fleet_merge_seconds_count(?:\{[^}]*\})? (\d+)",
+                body)
+            if not m_sum or not m_count or int(m_count.group(1)) < 2:
+                return None
+            return float(m_sum.group(1)), int(m_count.group(1))
+
+        stats = wait_until(round_stats, timeout=30)
+        mean_round = stats[0] / stats[1]
+        # serial would be >= 4.8 s/round; allow generous 1-core slack
+        # above the ~2.4 s parallel floor
+        assert mean_round < 4.0, (
+            f"hub poll rounds average {mean_round:.2f}s over {stats[1]} "
+            "rounds — members are being polled serially")
+        clusters = f.hub_get_json("/debug/fleet/clusters")
+        assert {m["cluster"] for m in clusters["members"]} == {
+            "slow-0", "slow-1"}
+        assert all(m["status"] == "OK" for m in clusters["members"])
+    finally:
+        f.stop()
+        for s in servers:
+            s.shutdown()
+
+
 def test_hub_readyz_fails_until_first_member_poll(built, tmp_path):
     f = FakeFleet(tmp_path)
     try:

@@ -1,0 +1,570 @@
+"""Differential reconcile engine tests (the ISSUE 10 perf tentpole).
+
+``--incremental on`` fuses three invalidation sources — informer watch
+events (the dirty journal), Prometheus sample diffs, and config/clock
+edges — into per-root dirty marks, and serves clean roots from a memoized
+decision cache instead of re-running acquire → eligibility → owner walk →
+enqueue → consumer no-op over the full candidate set. The contract pinned
+here:
+
+  - audit JSONL and flight capsules are BYTE-IDENTICAL between
+    ``--incremental on`` and ``off`` on the same cluster, at shard
+    counts 1 and 8 (volatile clock/trace fields and the capsule's
+    ``incremental`` provenance stamp normalized — mode metadata, like a
+    trace id);
+  - warm cycles stop re-enqueueing already-paused roots (cached no-ops
+    are served without the queue) while churn still actuates promptly;
+  - invalidation is complete: a new pod joining a cached root (wave-2),
+    an external resume (watch event on the root), and a BELOW_MIN_AGE
+    pod crossing the lookback window (timer edge) all recompute;
+  - a breaker deferral is NEVER served from cache on the following
+    cycle, even under ``--overlap on`` (the handoff regression);
+  - N seeded interleavings of watch events + scripted series flips
+    produce byte-identical audit JSONL for on vs off (the property test,
+    trace_gen as the event source).
+"""
+
+import json
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+from tpu_pruner.testing import trace_gen
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def run_daemon(fake_prom, fake_k8s, *extra, run_mode="scale-down", cycles=2,
+               interval=1):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--prometheus-token", "inc-test", "--run-mode", run_mode,
+           "--watch-cache", "on", "--incremental", "on",
+           "--daemon-mode", "--check-interval", str(interval),
+           "--max-cycles", str(cycles), *extra]
+    proc = subprocess.run(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc
+
+
+# The shard-pipeline volatile set plus the capsule's "incremental"
+# provenance stamp: it records HOW the view was assembled (dirty set,
+# cache hits) and legitimately differs between modes, like a trace id.
+VOLATILE_KEYS = {"ts", "ts_unix", "ts_ms", "now_unix", "trace_id", "id",
+                 "incremental"}
+
+
+def _normalize(obj):
+    if isinstance(obj, dict):
+        return {k: _normalize(v) for k, v in obj.items()
+                if k not in VOLATILE_KEYS}
+    if isinstance(obj, list):
+        return [_normalize(v) for v in obj]
+    return obj
+
+
+def _mixed_cluster(fake_prom, fake_k8s):
+    """Every fold path: multi-pod roots, a full idle slice (group kind —
+    cached, but its all-idle gate re-runs live), an annotated pod (root
+    veto), an orphan (NO_SCALABLE_OWNER), a too-young pod (timer) and a
+    ghost pod."""
+    for i in range(5):
+        _, _, pods = fake_k8s.add_deployment_chain(
+            f"ml-{i % 2}", f"dep-{i}", num_pods=2, tpu_chips=4)
+        for pod in pods:
+            fake_prom.add_idle_pod_series(pod["metadata"]["name"],
+                                          f"ml-{i % 2}", chips=4)
+    _, slice_pods = fake_k8s.add_jobset_slice("tpu-jobs", "slice-0",
+                                              num_hosts=4, tpu_chips=4)
+    for pod in slice_pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "tpu-jobs",
+                                      chips=4)
+    _, _, vetoed = fake_k8s.add_deployment_chain("ml-0", "protected",
+                                                 num_pods=2, tpu_chips=4)
+    vetoed[0]["metadata"]["annotations"] = {"tpu-pruner.dev/skip": "true"}
+    for pod in vetoed:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml-0", chips=4)
+    fake_k8s.add_pod("ml-1", "orphan",
+                     owners=[fake_k8s.owner("DaemonSet", "ds-x")])
+    fake_prom.add_idle_pod_series("orphan", "ml-1")
+    _, _, young = fake_k8s.add_deployment_chain("ml-1", "young", num_pods=1,
+                                                pod_age=60)
+    fake_prom.add_idle_pod_series(young[0]["metadata"]["name"], "ml-1")
+    fake_prom.add_idle_pod_series("ghost", "ml-0")
+
+
+# ── THE acceptance: byte-identity between --incremental on and off ─────
+
+
+def test_incremental_on_vs_off_byte_identical_at_shard_counts(
+        built, fake_prom, fake_k8s, tmp_path):
+    """The same cluster decided with and without the decision cache — at
+    one shard and at eight — produces byte-identical audit JSONL and
+    flight capsules (dry-run: the fixture stays untouched, so the only
+    run-to-run differences are the normalized clock/trace fields). Warm
+    cycles must actually HIT the cache, or this would pass vacuously."""
+    _mixed_cluster(fake_prom, fake_k8s)
+
+    outputs = {}
+    for shards in (1, 8):
+        for mode in ("off", "on"):
+            audit = tmp_path / f"audit-{shards}-{mode}.jsonl"
+            flight = tmp_path / f"flight-{shards}-{mode}"
+            proc = run_daemon(
+                fake_prom, fake_k8s, "--shards", str(shards),
+                "--incremental", mode, "--audit-log", str(audit),
+                "--flight-dir", str(flight), run_mode="dry-run", cycles=3)
+            records = [_normalize(json.loads(line))
+                       for line in audit.read_text().splitlines()]
+            capsules = [_normalize(json.loads(p.read_text()))
+                        for p in sorted(flight.glob("cycle-*.json"))]
+            assert records and capsules
+            outputs[(shards, mode)] = (
+                json.dumps(records, sort_keys=True),
+                json.dumps(capsules, sort_keys=True))
+            if mode == "on":
+                hits = re.findall(r"incremental: (\d+)/(\d+) candidate pods "
+                                  r"served from cache", proc.stderr)
+                assert hits, "no incremental log lines"
+                served, total = map(int, hits[-1])
+                # warm cycles serve the ENTIRE candidate set from cache
+                # (group roots included: their gate re-runs live)
+                assert served == total > 0, proc.stderr[-1500:]
+
+    for shards in (1, 8):
+        off, on = outputs[(shards, "off")], outputs[(shards, "on")]
+        assert off[0] == on[0], f"audit JSONL differs at {shards} shard(s)"
+        assert off[1] == on[1], f"capsules differ at {shards} shard(s)"
+
+
+def test_incremental_capsules_carry_provenance_and_replay(
+        built, fake_prom, fake_k8s, tmp_path):
+    """Capsules recorded under the cache stamp their provenance (dirty
+    set + cache hits) and still replay bit-for-bit offline — replay
+    always recomputes in full, so a hit served from a stale cache would
+    surface as decision drift here."""
+    for i in range(3):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    flight = tmp_path / "flight"
+    run_daemon(fake_prom, fake_k8s, "--flight-dir", str(flight), cycles=4)
+
+    capsules = sorted(flight.glob("cycle-*.json"))
+    assert len(capsules) == 4
+    warm = json.loads(capsules[-1].read_text())
+    prov = warm["incremental"]
+    assert prov["enabled"] is True
+    assert prov["full"] is False
+    assert prov["cache_hits"] == prov["pods"] == 3
+    assert prov["hit_ratio"] == 1.0
+    assert prov["dirty_units"] == []
+    cold = json.loads(capsules[0].read_text())
+    assert cold["incremental"]["full"] is True
+
+    for capsule in capsules:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_pruner.analyze", "--replay",
+             str(capsule)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert json.loads(proc.stdout)["match"] is True
+
+
+# ── warm-cycle behavior: cached no-ops, churn, invalidation ────────────
+
+
+def test_warm_cycles_serve_noops_without_enqueue_and_patch_once(
+        built, fake_prom, fake_k8s):
+    """Scale-down over a static cluster: every root is patched exactly
+    once (cycle 1), cycle 2 converges the cache through the consumer's
+    ALREADY_PAUSED verdicts, and from cycle 3 on the queue stays empty —
+    cached no-ops are served without enqueue."""
+    for i in range(4):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    proc = run_daemon(fake_prom, fake_k8s, cycles=4)
+    patches = [p for p, _ in fake_k8s.scale_patches()]
+    assert sorted(patches) == sorted(
+        f"/apis/apps/v1/namespaces/ml/deployments/dep-{i}/scale"
+        for i in range(4)), "roots must be patched exactly once"
+    noop_lines = re.findall(r"incremental: (\d+) cached no-op actuation",
+                            proc.stderr)
+    assert noop_lines and int(noop_lines[-1]) == 4, proc.stderr[-1500:]
+
+
+def test_churn_pod_is_dirty_and_actuates_while_rest_served_from_cache(
+        built, fake_k8s, fake_prom):
+    """A deployment added mid-run (watch ADDED + new series) must be
+    detected and patched by a later cycle even though every other root is
+    served from cache by then."""
+    for i in range(3):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--prometheus-token", "inc-test", "--run-mode", "scale-down",
+           "--watch-cache", "on", "--incremental", "on",
+           "--daemon-mode", "--check-interval", "1", "--max-cycles", "8"]
+    proc = subprocess.Popen(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 60
+        while len(fake_k8s.scale_patches()) < 3 and time.time() < deadline:
+            time.sleep(0.2)
+        assert len(fake_k8s.scale_patches()) >= 3
+        _, _, pods = fake_k8s.add_deployment_chain("ml", "churn")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+        while time.time() < deadline:
+            if any("/deployments/churn/scale" in p
+                   for p, _ in fake_k8s.scale_patches()):
+                break
+            time.sleep(0.2)
+        assert any("/deployments/churn/scale" in p
+                   for p, _ in fake_k8s.scale_patches()), \
+            "churn deployment never patched"
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_external_resume_dirties_root_and_repauses(built, fake_prom, fake_k8s):
+    """An operator resume (kubectl scale up) lands a MODIFIED watch event
+    on the root — the unit must recompute and re-pause instead of serving
+    the stale ALREADY_PAUSED no-op from cache."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    path = "/apis/apps/v1/namespaces/ml/deployments/trainer"
+
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--prometheus-token", "inc-test", "--run-mode", "scale-down",
+           "--watch-cache", "on", "--incremental", "on",
+           "--daemon-mode", "--check-interval", "1", "--max-cycles", "10"]
+    proc = subprocess.Popen(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 60
+        while not fake_k8s.scale_patches() and time.time() < deadline:
+            time.sleep(0.2)
+        assert fake_k8s.scale_patches(), "first pause never landed"
+        time.sleep(1.5)  # let the cache converge to the no-op state
+        fake_k8s.resume_root(path)
+        while time.time() < deadline:
+            if len([p for p, _ in fake_k8s.scale_patches()
+                    if p == path + "/scale"]) >= 2:
+                break
+            time.sleep(0.2)
+        repatches = [p for p, _ in fake_k8s.scale_patches()
+                     if p == path + "/scale"]
+        assert len(repatches) >= 2, "resumed root never re-paused"
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_below_min_age_timer_self_dirties_at_the_window_edge(
+        built, fake_prom, fake_k8s):
+    """A BELOW_MIN_AGE decision is clock-dependent: with no watch event
+    and byte-equal samples, the cached unit must still self-dirty when
+    the pod leaves the lookback window, and the pause must land."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "young", num_pods=1,
+                                               pod_age=52)
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    proc = run_daemon(fake_prom, fake_k8s, "--duration", "1",
+                      "--grace-period", "0", cycles=14)
+    assert "created within lookback window, skipping" in proc.stderr
+    patched = {p for p, _ in fake_k8s.scale_patches()}
+    assert patched == {"/apis/apps/v1/namespaces/ml/deployments/young/scale"}, \
+        (patched, proc.stderr[-1500:])
+
+
+def test_partial_slice_regates_and_suspends_when_last_host_idles(
+        built, fake_prom, fake_k8s):
+    """Group-gate verdict caching must never hold a slice: a partial
+    slice (one busy host) re-gates every cycle (only verified ALL-IDLE
+    verdicts cache), so when the busy host finally idles — a new sample,
+    dirtying the unit — the JobSet is suspended promptly."""
+    _, pods = fake_k8s.add_jobset_slice("tpu-jobs", "slice-0", num_hosts=4,
+                                        tpu_chips=4)
+    # hosts 1-3 idle from the start; host 0 busy for 3 cycles, then idle
+    for pod in pods[1:]:
+        fake_prom.add_scripted_pod_series(pod["metadata"]["name"],
+                                          "tpu-jobs", [0.0] * 8)
+    fake_prom.add_scripted_pod_series(pods[0]["metadata"]["name"],
+                                      "tpu-jobs", [None, None, None] + [0.0] * 5)
+
+    run_daemon(fake_prom, fake_k8s, cycles=8)
+    suspended = [p for p, b in fake_k8s.patches
+                 if "/jobsets/slice-0" in p and b.get("spec", {}).get("suspend")]
+    assert suspended, "slice never suspended after its last host idled"
+
+
+# ── the overlap-handoff regression (satellite): deferrals vs the cache ─
+
+
+def test_breaker_deferral_rederived_every_cycle_under_overlap(
+        built, fake_prom, fake_k8s, tmp_path):
+    """A breaker trip during an --overlap handoff must not freeze the
+    deferred roots' verdicts in the cache: DEFERRED is a per-cycle
+    cross-root decision, so every later cycle must re-derive it over the
+    merged (cached + recomputed) target set and stamp it with ITS cycle
+    number — the breaker cap stays a per-cycle property."""
+    for i in range(3):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    audit = tmp_path / "audit.jsonl"
+
+    proc = run_daemon(fake_prom, fake_k8s, "--overlap", "on",
+                      "--max-scale-per-cycle", "1",
+                      "--audit-log", str(audit), cycles=4)
+    assert "Circuit breaker" in proc.stderr
+    # exactly one root ever patched (cap 1, and the already-paused root
+    # keeps winning the per-cycle budget in identity order)
+    assert len({p for p, _ in fake_k8s.scale_patches()}) == 1
+    by_cycle = {}
+    for line in audit.read_text().splitlines():
+        rec = json.loads(line)
+        if rec["reason"] == "DEFERRED":
+            by_cycle.setdefault(rec["cycle"], []).append(rec["pod"])
+    # two roots deferred in EVERY cycle — re-decided fresh each time, not
+    # served once and then silently dropped (or leaked) by the cache
+    assert set(by_cycle) == {1, 2, 3, 4}, by_cycle
+    assert all(len(pods) == 2 for pods in by_cycle.values()), by_cycle
+
+
+def test_brownout_deferral_actuates_after_recovery_from_cache(
+        built, fake_prom, fake_k8s):
+    """The brownout sibling of the deferral regression: cycle 1 browns
+    out (2 of 3 pods have stale evidence → coverage 1/3), holding the
+    healthy root's scale-down. When coverage recovers, the held root —
+    whose unit is CLEAN and cache-served by then — must still enqueue
+    and patch; a cache that replayed the SIGNAL_BROWNOUT verdict would
+    hold it forever."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "healthy")
+    fake_prom.add_scripted_pod_series(
+        pods[0]["metadata"]["name"], "ml", [0.0] * 6,
+        last_sample_age=[0.0] * 6)
+    for i in range(2):
+        _, _, spods = fake_k8s.add_deployment_chain("ml", f"flaky-{i}")
+        fake_prom.add_scripted_pod_series(
+            spods[0]["metadata"]["name"], "ml", [0.0] * 6,
+            last_sample_age=[4000.0, 4000.0] + [0.0] * 4)
+
+    proc = run_daemon(fake_prom, fake_k8s, "--overlap", "on",
+                      "--signal-guard", "on", cycles=6)
+    assert "BROWNOUT" in proc.stderr
+    patched = {p for p, _ in fake_k8s.scale_patches()}
+    assert "/apis/apps/v1/namespaces/ml/deployments/healthy/scale" in patched, \
+        (patched, proc.stderr[-2000:])
+
+
+# ── property test (satellite): seeded interleavings, on ≡ off ──────────
+
+
+def _interleaved_run(mode, seed, cycles, tmp_path):
+    """One daemon run over a seeded world: trace_gen flapping scripts
+    drive per-cycle series flips while a seeded schedule of watch-event
+    mutations (new deployments, object touches) lands between cycles
+    (synced on capsule seals, inside the 1 s interval sleep). Returns the
+    normalized audit lines."""
+    import random
+    rng = random.Random(seed)
+    spec = trace_gen.generate("flapping", cycles=cycles, workloads=3,
+                              seed=seed)
+    # Pre-draw the whole mutation schedule so both modes see the same one.
+    schedule = [rng.choice(("add", "touch", "none")) for _ in range(cycles)]
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    flight = tmp_path / f"prop-{mode}-{seed}"
+    audit = tmp_path / f"prop-{mode}-{seed}.jsonl"
+    try:
+        trace_gen.install(spec, prom, k8s)
+        k8s.add_deployment_chain("gym", "touch-me")
+        cmd = [str(DAEMON_PATH), "--prometheus-url", prom.url,
+               "--prometheus-token", "inc-test", "--run-mode", "dry-run",
+               "--watch-cache", "on", "--incremental", mode,
+               "--daemon-mode", "--check-interval", "1",
+               "--max-cycles", str(cycles), "--flight-dir", str(flight),
+               "--flight-keep", str(cycles), "--audit-log", str(audit)]
+        proc = subprocess.Popen(cmd, env={"KUBE_API_URL": k8s.url},
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.PIPE, text=True)
+        try:
+            applied = 0
+            deadline = time.time() + 120
+            while proc.poll() is None and time.time() < deadline:
+                sealed = len(list(flight.glob("cycle-*.json")))
+                while applied < sealed and applied < len(schedule):
+                    action = schedule[applied]
+                    applied += 1
+                    if action == "add":
+                        _, _, pods = k8s.add_deployment_chain(
+                            "gym", f"late-{applied}")
+                        prom.add_idle_pod_series(
+                            pods[0]["metadata"]["name"], "gym")
+                    elif action == "touch":
+                        k8s.resume_root(
+                            "/apis/apps/v1/namespaces/gym/deployments/touch-me")
+                time.sleep(0.05)
+            proc.wait(timeout=30)
+            assert proc.returncode == 0, proc.stderr.read()[-2000:]
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    finally:
+        prom.stop()
+        k8s.stop()
+    return [json.dumps(_normalize(json.loads(line)), sort_keys=True)
+            for line in audit.read_text().splitlines()]
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_property_interleavings_byte_identical_audit(built, tmp_path, seed):
+    """Property: a seeded random interleaving of watch events and
+    scripted series flips decides identically with and without the
+    decision cache — byte-identical audit JSONL (records carry no
+    fixture-run identity, so the worlds rebuild per run; the mutation
+    schedule and flip scripts are seed-deterministic)."""
+    cycles = 6
+    off = _interleaved_run("off", seed, cycles, tmp_path)
+    on = _interleaved_run("on", seed, cycles, tmp_path)
+    assert off == on, (
+        f"decision stream diverged for seed {seed}: "
+        f"{len(off)} vs {len(on)} records")
+
+
+# ── metrics + CLI surface ──────────────────────────────────────────────
+
+
+def test_incremental_metric_families_and_quiesced_hit_ratio(
+        built, fake_prom, fake_k8s):
+    """The incremental families serve on /metrics once the engine runs a
+    cycle, and a quiesced cluster reads a hit ratio of 1.0 (the >= 0.95
+    acceptance bar with margin)."""
+    for i in range(3):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--prometheus-token", "inc-test", "--run-mode", "dry-run",
+           "--watch-cache", "on", "--incremental", "on",
+           "--metrics-port", "auto",
+           "--daemon-mode", "--check-interval", "1", "--max-cycles", "30"]
+    proc = subprocess.Popen(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    port = None
+    body = ""
+    try:
+        deadline = time.time() + 60
+        stderr_lines = []
+        while time.time() < deadline and port is None:
+            line = proc.stderr.readline()
+            stderr_lines.append(line)
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                port = int(m.group(1))
+        assert port, "".join(stderr_lines)[-1500:]
+        # Drain the rest of stderr: a full pipe would block the daemon
+        # mid-cycle and the hit ratio would never converge.
+        threading.Thread(target=proc.stderr.read, daemon=True).start()
+        while time.time() < deadline:
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5
+                ).read().decode()
+            except OSError:
+                time.sleep(0.2)
+                continue
+            m = re.search(r"^tpu_pruner_incremental_cache_hit_ratio(?:\{[^}]*\})? (\S+)",
+                          body, re.M)
+            if m and float(m.group(1)) >= 0.95:
+                break
+            time.sleep(0.2)
+    finally:
+        proc.kill()
+        proc.wait()
+    for family in ("tpu_pruner_incremental_cache_hit_ratio",
+                   "tpu_pruner_incremental_cached_pods",
+                   "tpu_pruner_incremental_dirty_pods",
+                   "tpu_pruner_incremental_full_recomputes_total"):
+        assert family + " " in body, family
+    ratio = float(re.search(
+        r"^tpu_pruner_incremental_cache_hit_ratio(?:\{[^}]*\})? (\S+)",
+        body, re.M).group(1))
+    assert ratio >= 0.95, body[-1500:]
+    assert re.search(r"^tpu_pruner_incremental_dirty_pods(?:\{[^}]*\})? 0$",
+                     body, re.M)
+
+
+def test_incremental_families_absent_when_off(built, fake_prom, fake_k8s):
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--prometheus-token", "inc-test", "--run-mode", "dry-run",
+           "--watch-cache", "on", "--metrics-port", "auto",
+           "--daemon-mode", "--check-interval", "1", "--max-cycles", "30"]
+    proc = subprocess.Popen(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        deadline = time.time() + 60
+        while time.time() < deadline and port is None:
+            m = re.search(r"serving /metrics on port (\d+)",
+                          proc.stderr.readline())
+            if m:
+                port = int(m.group(1))
+        assert port
+        threading.Thread(target=proc.stderr.read, daemon=True).start()
+        body = ""
+        while time.time() < deadline:
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5
+                ).read().decode()
+                if "cycle_phase_seconds" in body:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        assert "tpu_pruner_incremental_" not in body
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_incremental_requires_watch_cache(built, fake_prom):
+    proc = subprocess.run(
+        [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+         "--incremental", "on"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+    assert "--incremental on requires --watch-cache on" in proc.stderr

@@ -110,6 +110,24 @@ def test_bench_mega_recipe_present_and_wired():
         "bench.py no longer implements the --mega-only mega tier")
 
 
+def test_tsan_incremental_recipe_present_and_wired():
+    """`just tsan-incremental` must exist and run the incremental-engine +
+    informer native tests under TSan — the decision cache is written by
+    the producer while consumer threads report actuation outcomes, and
+    the dirty journal is written by reflector threads while the producer
+    drains it, exactly the concurrency TSan exists to check."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^tsan-incremental\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)",
+                  text, re.M)
+    assert m, "justfile has no `tsan-incremental:` recipe"
+    body = m.group(1)
+    assert "-DTP_TSAN=ON" in body, "tsan-incremental no longer builds with TSan"
+    assert re.search(r"tpupruner_tests\s+incremental", body), (
+        "tsan-incremental no longer runs the native incremental tests")
+    assert re.search(r"tpupruner_tests\s+informer", body), (
+        "tsan-incremental no longer runs the native informer tests")
+
+
 def test_tsan_shard_recipe_present_and_wired():
     """`just tsan-shard` must exist and run the shard + informer native
     tests under ThreadSanitizer — the sharded resolve fan-out and the

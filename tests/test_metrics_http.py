@@ -65,11 +65,11 @@ class MetricsDaemon:
 
     def wait_for_cycle(self, timeout=30):
         """Block until the first full cycle (incl. the actuate drain) is on
-        /metrics — all seven per-cycle phase _counts present and equal
+        /metrics — all eight per-cycle phase _counts present and equal
         (the signal phase observes ~0s every cycle even with
-        --signal-guard off, and merge observes every cycle too, so the
-        counts stay in lockstep). resolve_shard is the one NON-lockstep
-        phase: it observes once per shard per cycle."""
+        --signal-guard off, and merge + cache_merge observe every cycle
+        too, so the counts stay in lockstep). resolve_shard is the one
+        NON-lockstep phase: it observes once per shard per cycle."""
         deadline = time.time() + timeout
         while time.time() < deadline:
             _, _, body = self.get("/metrics")
@@ -77,7 +77,7 @@ class MetricsDaemon:
                 r'tpu_pruner_cycle_phase_seconds_count\{[^}]*phase="(\w+)"\} (\d+)',
                 body))
             counts.pop("resolve_shard", None)
-            if len(counts) == 7 and len(set(counts.values())) == 1 and "0" not in counts.values():
+            if len(counts) == 8 and len(set(counts.values())) == 1 and "0" not in counts.values():
                 return body
             time.sleep(0.2)
         raise AssertionError(f"phase histograms never converged:\n{body}")
@@ -153,7 +153,7 @@ def test_phase_counts_consistent_per_cycle(daemon):
     # multiple of the per-cycle phases, never in lockstep with them.
     shard_count = int(counts.pop("resolve_shard", "0"))
     assert set(counts) == {"query", "decode", "signal", "resolve", "merge",
-                           "actuate", "total"}
+                           "cache_merge", "actuate", "total"}
     assert len(set(counts.values())) == 1, counts
     # >= cycles (one observation per shard per cycle, >= 1 shard); not a
     # modulo check — a scrape can land mid-resolve of the NEXT cycle,

@@ -150,6 +150,7 @@ def load() -> ctypes.CDLL:
         "tp_signal_assess",
         "tp_signal_metric_families",
         "tp_transport_metric_families",
+        "tp_incremental_metric_families",
         "tp_json_parse",
         "tp_enabled_resources",
         "tp_decode_samples",
@@ -242,6 +243,13 @@ def transport_metric_families() -> list[str]:
     """Canonical shared-transport metric family names served on /metrics —
     the docs drift-guard test joins this list against docs/OPERATIONS.md."""
     return _call("tp_transport_metric_families", {})["families"]
+
+
+def incremental_metric_families() -> list[str]:
+    """Canonical differential-reconcile metric family names served on
+    /metrics — the docs drift-guard test joins this list against
+    docs/OPERATIONS.md."""
+    return _call("tp_incremental_metric_families", {})["families"]
 
 
 def json_parse(body: str, zero_copy: bool = False) -> dict:
