@@ -148,6 +148,23 @@ asan-json:
     ./build-asan/tpupruner_tests json
     ./build-asan/tpupruner_fuzz 200000
 
+# binary-wire memory tier: the proto decoder's units plus its
+# truncation/byte-flip parity sweeps under AddressSanitizer —
+# varint/length-delimited scanning over untrusted bytes is exactly the
+# code whose OOB reads ASan catches and plain asserts don't
+asan-proto:
+    cmake -G Ninja -S . -B build-asan -DTP_SANITIZE=ON && cmake --build build-asan
+    ./build-asan/tpupruner_tests proto
+
+# binary-wire race tier: the fused decode → journal_touch → store-upsert
+# path (reflector threads apply proto frames while the producer drains
+# the dirty journal) plus the informer machinery it rides, under
+# ThreadSanitizer (substring filter of the native test binary)
+tsan-wire:
+    cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
+    ./build-tsan/tpupruner_tests proto
+    ./build-tsan/tpupruner_tests informer
+
 # standalone TPU capture: probe + fleet eval + bench_tpu_last_good.json
 # (run EARLY in a round / whenever the chip tunnel is up; exits 1 when no
 # real accelerator measurement happened)

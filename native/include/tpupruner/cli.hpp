@@ -97,6 +97,14 @@ struct Cli {
   // Prometheus-matrix call sites (off = Value::parse everywhere).
   std::string transport = "auto";
   std::string zero_copy_json = "on";
+  // --wire: wire FORMAT for the pods list+watch and the Prometheus
+  // instant queries (proto.hpp). "proto" negotiates
+  // application/vnd.kubernetes.protobuf (+ the Prometheus protobuf
+  // exposition) with per-request JSON fallback and fuses watch-event
+  // decode into the incremental engine's dirty journal; "auto" asks once
+  // per endpoint and remembers a refusal; "json" (default) never asks —
+  // exact output parity (audit/capsules/ledger/replay byte-identical).
+  std::string wire = "json";
   int metrics_port = -1;                  // --metrics-port: -1 disabled (flag "0" maps
                                           // here too), 0 ephemeral (flag "auto"), else port
   // --cluster-name: fleet identity stamped on every exported surface (a

@@ -36,6 +36,7 @@
 
 #include "tpupruner/json.hpp"
 #include "tpupruner/k8s.hpp"
+#include "tpupruner/proto.hpp"
 
 namespace tpupruner::informer {
 
@@ -81,11 +82,21 @@ struct ResourceStats {
 // actually touches (candidates, owner chains) ever pay tree construction.
 class Store {
  public:
-  // Either a materialized Value (doc == nullptr) or an arena reference.
+  // Either a materialized Value, an arena (Doc, node) reference, or — on
+  // the binary wire path — a protobuf slice into a shared page/frame
+  // buffer. All three materialize to IDENTICAL Values on get().
   struct Entry {
     json::Value value;
     json::DocPtr doc;
     uint32_t node = 0;
+    // Proto-backed entry (--wire proto): raw object bytes inside a LIST
+    // page / watch frame (aliased shared_ptr keeps the buffer alive),
+    // materialized lazily via proto::object_to_value. `pfp` is the
+    // fused-path fingerprint over those bytes.
+    std::shared_ptr<const std::string> pbody;
+    size_t poff = 0, plen = 0;
+    std::string papi, pkind;
+    uint64_t pfp = 0;
   };
 
   std::optional<json::Value> get(const std::string& object_path) const;
@@ -97,6 +108,15 @@ class Store {
   void replace_entries(std::map<std::string, Entry> objects);
   void upsert(const std::string& object_path, json::Value object);
   void upsert_doc(const std::string& object_path, json::DocPtr doc, uint32_t node);
+  // Binary wire path: store the raw protobuf object slice (no tree of any
+  // kind is built until some cycle actually reads the object).
+  void upsert_proto(const std::string& object_path, std::shared_ptr<const std::string> body,
+                    size_t off, size_t len, std::string api_version, std::string kind,
+                    uint64_t fp);
+  // The stored entry's fused-path fingerprint (0 for non-proto entries /
+  // absent paths) — the native wire tests assert single-pass decode
+  // against it.
+  uint64_t proto_fingerprint(const std::string& object_path) const;
   void erase(const std::string& object_path);
 
  private:
@@ -139,6 +159,12 @@ class Reflector {
   // arena reference (the event Doc stays alive while its object is in the
   // store). Semantics identical to apply_event.
   bool apply_event_doc(const json::DocPtr& event);
+  // Binary-wire sibling — the FUSED path: the frame was decoded in one
+  // scan (type + object slice + store key + fingerprint, proto.cpp); this
+  // applies journal_touch and the store upsert from those fields with no
+  // intermediate Value/Doc ever built. Semantics identical to
+  // apply_event: same journal marks, same stats, same relist requests.
+  bool apply_event_proto(const proto::WatchEventPtr& event);
   // Apply a LIST result (replace + resourceVersion adoption); services
   // any pending relist request.
   void apply_list(const json::Value& list);

@@ -25,6 +25,7 @@
 #include "tpupruner/h2.hpp"
 #include "tpupruner/http.hpp"
 #include "tpupruner/json.hpp"
+#include "tpupruner/proto.hpp"
 
 namespace tpupruner::k8s {
 
@@ -111,6 +112,36 @@ class Client {
   void watch_doc(const std::string& path, const WatchOptions& opts,
                  const std::function<bool(const json::DocPtr&)>& on_event) const;
 
+  // ── binary wire path (--wire proto|auto; proto.hpp) ──
+  // One LIST page in whichever representation the server negotiated:
+  // exactly one of pb (application/vnd.kubernetes.protobuf) or doc
+  // (JSON, served after a refusal) is set.
+  struct WirePage {
+    json::DocPtr doc;
+    proto::ListPagePtr pb;
+  };
+  // list_pages with content negotiation: requests
+  // `application/vnd.kubernetes.protobuf, application/json` and decodes
+  // whichever comes back, counting negotiation fallbacks. Pagination,
+  // 429 and error semantics identical to list_pages(); returns the last
+  // page's resourceVersion.
+  std::string list_pages_wire(const std::string& path, const std::string& label_selector,
+                              int64_t limit,
+                              const std::function<void(const WirePage&)>& on_page) const;
+
+  // One watch event in whichever representation the stream negotiated.
+  struct WireWatchEvent {
+    json::DocPtr doc;
+    proto::WatchEventPtr pb;
+  };
+  // watch with content negotiation: requests the `;stream=watch` protobuf
+  // variant; a protobuf stream arrives as 4-byte big-endian
+  // length-delimited runtime.Unknown(WatchEvent) frames (k8s's
+  // LengthDelimitedFramer), a JSON stream as the usual newline-delimited
+  // events. Error/abort semantics identical to watch().
+  void watch_wire(const std::string& path, const WatchOptions& opts,
+                  const std::function<bool(const WireWatchEvent&)>& on_event) const;
+
   // Transport protocol negotiated for the API server endpoint
   // ("h2" | "http1" | "unknown") — surfaced in /debug and logs.
   std::string transport_protocol() const { return http_.protocol_for(config_.api_url); }
@@ -150,6 +181,10 @@ class Client {
                            const std::string& body, const std::string& content_type,
                            int* status_out, bool retry_throttle = true,
                            json::DocPtr* doc_out = nullptr) const;
+  // Issue one request with the 429/Retry-After handling every verb
+  // shares; the response comes back raw (any content type).
+  http::Response issue(http::Request& req, const std::string& method,
+                       const std::string& path, bool retry_throttle) const;
   void watch_impl(const std::string& path, const WatchOptions& opts,
                   const std::function<bool(std::string_view)>& on_line) const;
 

@@ -11,6 +11,7 @@
 
 #include "tpupruner/core.hpp"
 #include "tpupruner/json.hpp"
+#include "tpupruner/proto.hpp"
 
 namespace tpupruner::metrics {
 
@@ -43,6 +44,15 @@ DecodeResult decode_instant_vector(const json::Value& response, const std::strin
 // overload on the same bytes (pinned by the decode-parity corpus tests;
 // flight-recorder replay re-decodes capsule bytes through the Value path).
 DecodeResult decode_instant_vector(const json::Doc& response, const std::string& device,
+                                   const std::string& schema = "gmp");
+
+// Binary-wire sibling (--wire proto): the fused protobuf decode already
+// produced per-series label lists and exact value text (proto.hpp); this
+// overload applies the SAME label-fallback / dedup / per-series-error
+// semantics to them. Samples, order, error strings, and throw behavior
+// are identical to the JSON overloads on the equivalent body — pinned by
+// the wire parity corpus.
+DecodeResult decode_instant_vector(const proto::PromVector& response, const std::string& device,
                                    const std::string& schema = "gmp");
 
 // Sample-diff fingerprint (the incremental reconcile engine's

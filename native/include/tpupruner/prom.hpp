@@ -12,6 +12,7 @@
 #include "tpupruner/h2.hpp"
 #include "tpupruner/http.hpp"
 #include "tpupruner/json.hpp"
+#include "tpupruner/proto.hpp"
 
 namespace tpupruner::prom {
 
@@ -36,6 +37,26 @@ class Client {
   json::DocPtr instant_query_doc(const std::string& promql,
                                  std::string* raw_body = nullptr) const;
 
+  // ── binary wire path (--wire proto|auto; proto.hpp) ──
+  // The negotiated instant-query result: exactly one representation is
+  // populated. Under the protobuf exposition the samples are decoded in
+  // the SAME pass that reads the body (no Doc/Value is ever built), and
+  // `raw_body` receives the canonical JSON reconstruction — byte-identical
+  // to what the JSON wire would have delivered for the same data, which
+  // is what keeps flight capsules wire-format independent.
+  struct WireVector {
+    bool proto = false;
+    proto::PromVector pv;   // proto: fused label/timestamp/value series
+    json::DocPtr doc;       // JSON + zero-copy on
+    json::Value response;   // JSON + zero-copy off
+  };
+  // POST /api/v1/query asking `application/x-protobuf, application/json`
+  // (when the wire mode wants proto; plain JSON otherwise), decoding
+  // whichever content type comes back. Error semantics identical to
+  // instant_query.
+  WireVector instant_query_wire(const std::string& promql,
+                                std::string* raw_body = nullptr) const;
+
   // Transport protocol negotiated for the Prometheus endpoint
   // ("h2" | "http1" | "unknown").
   std::string transport_protocol() const { return http_.protocol_for(base_url_ + "/"); }
@@ -54,7 +75,8 @@ class Client {
   }
 
  private:
-  http::Response query_once(const std::string& promql) const;
+  http::Response query_once(const std::string& promql,
+                            std::string_view accept = "application/json") const;
 
   std::string base_url_;
   mutable std::mutex token_mutex_;

@@ -1,0 +1,202 @@
+// Binary wire protocol: hand-rolled protobuf decode for the two hot
+// conversations.
+//
+// PRs 8-10 made the warm cycle transport-cheap (one h2 connection per
+// endpoint) and CPU-cheap (O(churn) incremental reconcile); the remaining
+// wall is the wire FORMAT — every watch event and Prometheus matrix still
+// arrives as JSON and re-parses into a tree/arena before it touches the
+// dirty journal. Real apiservers speak `application/vnd.kubernetes.protobuf`
+// for exactly this reason, and this module adds that path end to end
+// behind `--wire proto|json|auto` (json = exact output parity):
+//
+//   - a varint/length-delimited decoder for the runtime.Unknown envelope
+//     (the `k8s\0` magic), meta/v1 WatchEvent frames, and the subset of
+//     core/v1 PodList/Pod the informer, walker and actuator actually read
+//     (metadata name/namespace/uid/resourceVersion/labels/ownerReferences,
+//     spec containers + accelerator resource requests, status.phase) —
+//     no protobuf library, mirroring the hand-rolled h2/HPACK approach;
+//   - watch-event decode FUSED into the incremental engine: one scan per
+//     frame extracts the store key + resourceVersion, fingerprints the raw
+//     object bytes, journal-touches and upserts a lazily-materialized
+//     entry — no intermediate json::Value or Doc is ever built for the
+//     99% of objects a cycle never looks at;
+//   - a Prometheus protobuf exposition for the idleness and evidence
+//     instant queries (label/timestamp/value series carrying the EXACT
+//     decimal text of the JSON form, so flight capsules can store a
+//     canonical JSON body byte-identical to what `--wire json` records).
+//
+// Field numbers follow the real k8s.io generated.proto messages (TypeMeta
+// apiVersion=1/kind=2, Unknown typeMeta=1/raw=2, ObjectMeta name=1/
+// namespace=3/uid=5/resourceVersion=6/creationTimestamp=8/labels=11/
+// annotations=12/ownerReferences=13, PodList metadata=1/items=2, ...) so
+// the decoder is honest about the upstream schema; unknown fields are
+// skipped by wire type, never rejected. The hermetic fakes encode the
+// SAME subset (tpu_pruner/testing/wire_proto.py) and fall back to JSON
+// for any object outside it, which is what keeps audit JSONL, capsules,
+// ledger checkpoints and `analyze --replay` byte-identical across
+// `--wire` modes.
+//
+// Scope: protobuf is negotiated for the PODS list+watch (the dominant
+// collection — real apiservers refuse protobuf for CRs anyway, and the
+// owner kinds here include four CRs) and the Prometheus instant queries.
+// Owner GETs, scale patches and the other informer resources stay JSON.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <string_view>
+#include <utility>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::proto {
+
+// ── wire mode (process-wide, like json::zero_copy / h2::default_mode) ──
+enum class WireMode : uint8_t { Json, Proto, Auto };
+
+// "json" | "proto" | "auto"; throws std::runtime_error on anything else.
+WireMode wire_mode_from_string(const std::string& s);
+const char* wire_mode_name(WireMode m);
+
+// Initialized lazily from $TPU_PRUNER_WIRE (default json — the exact
+// parity mode); the daemon's `--wire` flag overrides at startup before
+// any client exists.
+WireMode wire_mode();
+void set_wire_mode(WireMode m);
+
+// Should the NEXT eligible request ask for protobuf? Proto: always.
+// Auto: until the endpoint refuses once (sticky per-process fallback).
+// Json: never.
+bool k8s_proto_wanted();
+bool prom_proto_wanted();
+// A proto-accepting request came back as JSON: count the fallback and —
+// under Auto — remember the refusal so we stop asking.
+void note_k8s_fallback();
+void note_prom_fallback();
+
+// ── content types ──
+constexpr std::string_view kK8sProtoContentType = "application/vnd.kubernetes.protobuf";
+constexpr std::string_view kK8sProtoAccept =
+    "application/vnd.kubernetes.protobuf, application/json";
+constexpr std::string_view kK8sProtoWatchAccept =
+    "application/vnd.kubernetes.protobuf;stream=watch, application/json";
+constexpr std::string_view kPromProtoContentType = "application/x-protobuf";
+constexpr std::string_view kPromProtoAccept = "application/x-protobuf, application/json";
+
+// True when the (lowercased) Content-Type names the protobuf form.
+bool is_k8s_proto(std::string_view content_type);
+bool is_prom_proto(std::string_view content_type);
+
+// ── process-wide wire counters (served as tpu_pruner_wire_* families) ──
+struct WireCounters {
+  std::atomic<uint64_t> k8s_proto_bytes{0};   // LIST/watch bytes decoded as proto
+  std::atomic<uint64_t> k8s_json_bytes{0};    // ... as JSON (same call sites)
+  std::atomic<uint64_t> prom_proto_bytes{0};  // query bytes decoded as proto
+  std::atomic<uint64_t> prom_json_bytes{0};
+  std::atomic<uint64_t> negotiation_fallbacks{0};  // proto asked, JSON served
+  std::atomic<uint64_t> fused_events{0};  // watch events through the fused path
+};
+WireCounters& counters();
+
+// Canonical family names served on /metrics (docs drift guard, via capi):
+//   tpu_pruner_wire_bytes_decoded_total{endpoint,content_type}  counter
+//   tpu_pruner_wire_negotiation_fallbacks_total                 counter
+//   tpu_pruner_wire_fused_decode_events_total                   counter
+//   tpu_pruner_wire_mode{mode}                                  gauge (1)
+std::vector<std::string> wire_metric_families();
+std::string render_wire_metrics(bool openmetrics);
+
+// FNV-1a64 over raw bytes (the fused-path object fingerprint; same
+// constants as shard::stable_hash / metrics::sample_fingerprint).
+uint64_t fingerprint(std::string_view bytes);
+
+// ── Kubernetes decode ───────────────────────────────────────────────────
+// All parse_* functions throw json::ParseError (offset = byte position)
+// on truncated or malformed input — the same typed error the JSON path
+// raises, so callers and the fuzzer-invariant tests treat both wires
+// uniformly.
+
+// One object inside a LIST page: a byte range into the page body plus the
+// store key fields scanned in the same pass (never a materialized tree).
+struct ObjectRef {
+  size_t off = 0, len = 0;   // object message bytes within the page body
+  std::string ns, name;      // metadata.namespace / metadata.name
+  uint64_t fp = 0;           // fingerprint over the object bytes
+};
+
+// A decoded LIST page: raw body (owned; ObjectRefs view into it), the
+// items' TypeMeta, and the ListMeta fields the pagination loop reads.
+struct ListPage {
+  std::string body;
+  std::string api_version, kind;  // per-ITEM type (e.g. "v1", "Pod")
+  std::string resource_version, continue_token;
+  std::vector<ObjectRef> items;
+};
+using ListPagePtr = std::shared_ptr<const ListPage>;
+ListPagePtr parse_list(std::string body);
+
+// A decoded watch frame (one length-delimited runtime.Unknown(WatchEvent)).
+// For ADDED/MODIFIED/DELETED/BOOKMARK the object slice + scanned key
+// fields are populated; for ERROR the embedded Status code/message are.
+struct WatchEvent {
+  std::string body;  // raw frame (owned; the object slice views into it)
+  std::string type;  // "ADDED" | "MODIFIED" | "DELETED" | "BOOKMARK" | "ERROR" | ...
+  std::string api_version, kind;  // embedded object's TypeMeta ("" when absent)
+  size_t obj_off = 0, obj_len = 0;
+  bool has_object = false;
+  std::string ns, name, resource_version;
+  uint64_t fp = 0;
+  int64_t error_code = 0;     // ERROR events: Status.code
+  std::string error_message;  // ERROR events: Status.message
+};
+using WatchEventPtr = std::shared_ptr<const WatchEvent>;
+WatchEventPtr parse_watch_event(std::string frame);
+
+// Materialize an object slice (the Pod-subset schema) as a json::Value
+// IDENTICAL to parsing the JSON representation of the same object —
+// json::Object is key-sorted, so field order never matters. api_version /
+// kind are stamped as the "apiVersion"/"kind" members when non-empty
+// (protobuf items carry TypeMeta out of band).
+json::Value object_to_value(std::string_view bytes, const std::string& api_version,
+                            const std::string& kind);
+
+// ── Prometheus decode ───────────────────────────────────────────────────
+
+// One series of the instant-vector exposition. Labels preserve wire
+// order; ts_text/value_text carry the EXACT decimal tokens of the JSON
+// form so the canonical body reconstruction is byte-faithful.
+struct PromSeries {
+  std::vector<std::pair<std::string, std::string>> labels;
+  std::string ts_text;     // JSON number token, e.g. "1754300123.456789"
+  std::string value_text;  // sample value string, e.g. "0.0"
+};
+
+// QueryResponse message: status=1, errorType=2, error=3,
+// result=4 repeated Series{label=1 repeated Label{name=1,value=2},
+// ts_text=2, value_text=3}.
+struct PromVector {
+  std::string status;  // "success" | "error"
+  std::string error_type, error;
+  std::vector<PromSeries> result;
+};
+PromVector parse_prom_vector(std::string_view body);
+
+// Canonical JSON reconstruction of the vector — byte-identical to
+// Python's `json.dumps({"status": ..., "data": {"resultType": "vector",
+// "result": [...]}})` with default separators and ensure_ascii (what
+// fake_prom and real Prometheus emit for the same data), so a flight
+// capsule recorded under `--wire proto` stores exactly the body
+// `--wire json` would have recorded.
+std::string prom_canonical_body(const PromVector& v);
+
+// Python-compatible JSON string escape (ensure_ascii: non-ASCII and
+// control characters as \uXXXX with lowercase hex, surrogate pairs for
+// non-BMP) — exposed for the canonical-body unit tests.
+void python_json_escape(std::string& out, std::string_view s);
+
+void reset_for_test();  // counters + sticky fallbacks
+
+}  // namespace tpupruner::proto

@@ -41,6 +41,7 @@
 #include "tpupruner/audit.hpp"
 #include "tpupruner/core.hpp"
 #include "tpupruner/json.hpp"
+#include "tpupruner/proto.hpp"
 
 namespace tpupruner::signal {
 
@@ -102,6 +103,13 @@ Assessment assess(const json::Value& evidence_response,
 // (replay re-derives from capsule bytes via the Value path — bit-for-bit
 // holds only because these two agree).
 Assessment assess(const json::Doc& evidence_response,
+                  const std::vector<core::PodMetricSample>& candidates, const Config& cfg,
+                  uint64_t cycle);
+// Binary-wire sibling (--wire proto): folds the fused protobuf decode's
+// series (proto.hpp) with the same label chain and row semantics; replay
+// re-derives from the capsule's canonical JSON body via the Value path —
+// bit-for-bit holds only because all three agree.
+Assessment assess(const proto::PromVector& evidence_response,
                   const std::vector<core::PodMetricSample>& candidates, const Config& cfg,
                   uint64_t cycle);
 

@@ -3,6 +3,8 @@
 // Every function takes a JSON (or plain) C string and returns a
 // heap-allocated JSON C string the caller frees with tp_free. Errors come
 // back as {"error": "..."} so test assertions can target messages.
+#include <array>
+#include <chrono>
 #include <cstdlib>
 #include <cstring>
 #include <memory>
@@ -25,9 +27,11 @@
 #include "tpupruner/k8s.hpp"
 #include "tpupruner/ledger.hpp"
 #include "tpupruner/metrics.hpp"
+#include "tpupruner/proto.hpp"
 #include "tpupruner/query.hpp"
 #include "tpupruner/shard.hpp"
 #include "tpupruner/signal.hpp"
+#include "tpupruner/util.hpp"
 
 using tpupruner::json::Value;
 namespace core = tpupruner::core;
@@ -60,6 +64,35 @@ char* guarded(Fn&& fn) {
   } catch (...) {
     return err("unknown error");
   }
+}
+
+// Standard base64 decode (the wire parity harness ships raw protobuf
+// bytes through the JSON C API). Whitespace tolerated; throws on any
+// other non-alphabet byte.
+std::string b64_decode(const std::string& in) {
+  static const auto table = [] {
+    std::array<int8_t, 256> t{};
+    t.fill(-1);
+    const char* alphabet = "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+    for (int i = 0; i < 64; ++i) t[static_cast<unsigned char>(alphabet[i])] = int8_t(i);
+    return t;
+  }();
+  std::string out;
+  out.reserve(in.size() / 4 * 3);
+  uint32_t acc = 0;
+  int bits = 0;
+  for (char ch : in) {
+    if (ch == '=' || ch == '\n' || ch == '\r' || ch == ' ') continue;
+    int8_t v = table[static_cast<unsigned char>(ch)];
+    if (v < 0) throw std::runtime_error("invalid base64 input");
+    acc = (acc << 6) | static_cast<uint32_t>(v);
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out.push_back(static_cast<char>((acc >> bits) & 0xFF));
+    }
+  }
+  return out;
 }
 
 std::string checked_device(const std::string& d) {
@@ -541,6 +574,160 @@ char* tp_transport_metric_families(const char*) {
     }
     Value out = Value::object();
     out.set("families", std::move(families));
+    return ok(out);
+  });
+}
+
+char* tp_wire_metric_families(const char*) {
+  // The canonical binary-wire metric family names — the docs-drift test
+  // joins this against docs/OPERATIONS.md.
+  return guarded([&] {
+    Value families = Value::array();
+    for (const std::string& f : tpupruner::proto::wire_metric_families()) {
+      families.push_back(Value(f));
+    }
+    Value out = Value::object();
+    out.set("families", std::move(families));
+    return ok(out);
+  });
+}
+
+char* tp_wire_decode_k8s(const char* payload_json) {
+  // Wire parity harness: decode a protobuf LIST / watch-frame body (b64,
+  // raw bytes can't ride a JSON string) through the REAL proto decoder
+  // and return the materialized objects — the Python parity corpus
+  // compares them against json.loads of the JSON form of the same data.
+  // {"body_b64": ..., "shape": "list"|"watch"}.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    const Value* b64 = p.find("body_b64");
+    if (!b64 || !b64->is_string()) throw std::runtime_error("missing body_b64");
+    std::string body = b64_decode(b64->as_string());
+    std::string shape = p.get_string("shape", "list");
+    Value out = Value::object();
+    if (shape == "list") {
+      tpupruner::proto::ListPagePtr page = tpupruner::proto::parse_list(std::move(body));
+      out.set("api_version", Value(page->api_version));
+      out.set("kind", Value(page->kind));
+      out.set("resource_version", Value(page->resource_version));
+      out.set("continue", Value(page->continue_token));
+      Value items = Value::array();
+      Value keys = Value::array();
+      for (const tpupruner::proto::ObjectRef& ref : page->items) {
+        items.push_back(tpupruner::proto::object_to_value(
+            std::string_view(page->body.data() + ref.off, ref.len), page->api_version,
+            page->kind));
+        Value key = Value::object();
+        key.set("namespace", Value(ref.ns));
+        key.set("name", Value(ref.name));
+        key.set("fingerprint", Value(static_cast<int64_t>(ref.fp)));
+        keys.push_back(std::move(key));
+      }
+      out.set("items", std::move(items));
+      out.set("keys", std::move(keys));
+    } else if (shape == "watch") {
+      tpupruner::proto::WatchEventPtr ev =
+          tpupruner::proto::parse_watch_event(std::move(body));
+      out.set("type", Value(ev->type));
+      out.set("namespace", Value(ev->ns));
+      out.set("name", Value(ev->name));
+      out.set("resource_version", Value(ev->resource_version));
+      out.set("fingerprint", Value(static_cast<int64_t>(ev->fp)));
+      out.set("error_code", Value(ev->error_code));
+      if (ev->has_object && ev->type != "ERROR") {
+        out.set("object", tpupruner::proto::object_to_value(
+                              std::string_view(ev->body.data() + ev->obj_off, ev->obj_len),
+                              ev->api_version, ev->kind));
+      }
+    } else {
+      throw std::runtime_error("unknown shape: " + shape + " (expected list|watch)");
+    }
+    return ok(out);
+  });
+}
+
+char* tp_wire_decode_prom(const char* payload_json) {
+  // Prometheus wire parity: decode a protobuf exposition body through the
+  // fused decoder and return samples + the canonical JSON reconstruction
+  // (which must be byte-identical to the JSON body the fake recorded).
+  // {"body_b64": ..., "device"?: ..., "schema"?: ...}.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    const Value* b64 = p.find("body_b64");
+    if (!b64 || !b64->is_string()) throw std::runtime_error("missing body_b64");
+    std::string body = b64_decode(b64->as_string());
+    std::string device = checked_device(p.get_string("device", "tpu"));
+    std::string schema = p.get_string("schema", "gmp");
+    tpupruner::proto::PromVector pv = tpupruner::proto::parse_prom_vector(body);
+    tpupruner::metrics::DecodeResult result =
+        tpupruner::metrics::decode_instant_vector(pv, device, schema);
+    Value samples = Value::array();
+    for (const auto& s : result.samples) {
+      Value sv = Value::object();
+      sv.set("name", Value(s.name));
+      sv.set("namespace", Value(s.ns));
+      sv.set("container", Value(s.container));
+      sv.set("node_type", Value(s.node_type));
+      sv.set("accelerator", Value(s.accelerator));
+      sv.set("value", Value(s.value));
+      samples.push_back(std::move(sv));
+    }
+    Value errors = Value::array();
+    for (const auto& e : result.errors) errors.push_back(Value(e));
+    Value out = Value::object();
+    out.set("samples", std::move(samples));
+    out.set("num_series", Value(static_cast<int64_t>(result.num_series)));
+    out.set("errors", std::move(errors));
+    out.set("canonical_body", Value(tpupruner::proto::prom_canonical_body(pv)));
+    return ok(out);
+  });
+}
+
+char* tp_wire_bench_decode(const char* payload_json) {
+  // Cold-LIST decode-wall probe (bench.py): read a response body from
+  // `path` and decode it `iters` times through the informer-shaped
+  // decode for its content type — protobuf: parse_list (item ranges +
+  // store keys + fingerprints, what the reflector does per page); json:
+  // Doc::parse + the items walk. Returns total seconds + per-pass item
+  // count so the bench records MB/s and pods/s.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    std::string path = p.get_string("path");
+    if (path.empty()) throw std::runtime_error("missing path");
+    auto content = tpupruner::util::read_file(path);
+    if (!content) throw std::runtime_error("unreadable file: " + path);
+    std::string content_type = p.get_string("content_type", "json");
+    int64_t iters = 1;
+    if (const Value* it = p.find("iters"); it && it->is_number()) iters = it->as_int();
+    if (iters < 1) iters = 1;
+    size_t items = 0;
+    auto t0 = std::chrono::steady_clock::now();
+    for (int64_t i = 0; i < iters; ++i) {
+      items = 0;
+      if (content_type == "protobuf") {
+        tpupruner::proto::ListPagePtr page = tpupruner::proto::parse_list(*content);
+        for (const tpupruner::proto::ObjectRef& ref : page->items) {
+          if (!ref.ns.empty() && !ref.name.empty()) ++items;
+        }
+      } else {
+        tpupruner::json::DocPtr doc = tpupruner::json::Doc::parse(*content);
+        auto root_items = doc->root().find("items");
+        if (root_items && root_items->is_array()) {
+          tpupruner::json::Doc::Node item = root_items->first_child();
+          for (size_t i2 = 0; i2 < root_items->size(); ++i2, item = item.next_sibling()) {
+            auto ns = item.at_path("metadata.namespace");
+            auto name = item.at_path("metadata.name");
+            if (ns && ns->is_string() && name && name->is_string()) ++items;
+          }
+        }
+      }
+    }
+    double secs = std::chrono::duration<double>(std::chrono::steady_clock::now() - t0).count();
+    Value out = Value::object();
+    out.set("seconds", Value(secs));
+    out.set("iters", Value(iters));
+    out.set("items", Value(static_cast<int64_t>(items)));
+    out.set("bytes", Value(static_cast<int64_t>(content->size())));
     return ok(out);
   });
 }

@@ -150,6 +150,19 @@ TPU FLAGS:
                                 Value trees; off = the measured-comparison
                                 escape hatch (decisions are identical either
                                 way)
+      --wire <M>                json | proto | auto [default: json] — wire
+                                format for the pods list+watch and the
+                                Prometheus instant queries: "proto" asks for
+                                application/vnd.kubernetes.protobuf (and the
+                                Prometheus protobuf exposition) and fuses
+                                watch-event decode into the dirty journal,
+                                falling back per request when a server
+                                answers JSON; "auto" asks once per endpoint
+                                and remembers a refusal; "json" never asks —
+                                the exact-parity mode (audit JSONL, capsules,
+                                ledger and replay are byte-identical across
+                                modes). Owner GETs, patches and CR kinds
+                                always speak JSON
       --max-scale-per-cycle <N> blast-radius circuit breaker: pause at most N
                                 root objects per cycle, deferring the rest
                                 (a metric-plane outage reading the whole fleet
@@ -359,6 +372,11 @@ Cli parse(int argc, char** argv) {
        [&](const std::string& v) {
          check_choice("--transport", v, {"auto", "h2", "http1"});
          cli.transport = v;
+       }},
+      {"--wire",
+       [&cli](const std::string& v) {
+         check_choice("--wire", v, {"json", "proto", "auto"});
+         cli.wire = v;
        }},
       {"--zero-copy-json",
        [&](const std::string& v) {

@@ -1038,6 +1038,12 @@ Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
   if (persistent_prom) prom_client.set_token(resolve_prom_token(args));
   prom_client.set_traceparent(otlp::traceparent(cycle.context()));
   const bool zero_copy = json::zero_copy_enabled();
+  // Binary wire path (--wire proto|auto): the instant queries negotiate
+  // the protobuf exposition; a protobuf response decodes into samples in
+  // the same pass (no Doc/Value), a JSON answer flows into the existing
+  // decode branches below. The recorder still receives a JSON body — the
+  // canonical reconstruction, byte-identical to the --wire json capsule.
+  const bool wire_proto = proto::wire_mode() != proto::WireMode::Json;
 
   // Signal-quality watchdog: assess the health of the evidence ITSELF
   // before trusting a single zero-peak reading. Its evidence query is
@@ -1048,6 +1054,7 @@ Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
   std::string evidence_raw;
   json::Value evidence_response;
   json::DocPtr evidence_doc;
+  prom::Client::WireVector evidence_wire;
   std::exception_ptr evidence_error;
   std::thread evidence_thread;
   if (p.signal_on) {
@@ -1055,7 +1062,12 @@ Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
       try {
         otlp::Span span("prometheus.evidence_query", &cycle.context());
         with_span(span, [&] {
-          if (zero_copy) {
+          if (wire_proto) {
+            evidence_wire = prom_client.instant_query_wire(
+                evidence_query, recorder::enabled() ? &evidence_raw : nullptr);
+            evidence_doc = evidence_wire.doc;            // JSON-fallback forms feed
+            evidence_response = evidence_wire.response;  // the existing branches
+          } else if (zero_copy) {
             evidence_doc = prom_client.instant_query_doc(
                 evidence_query, recorder::enabled() ? &evidence_raw : nullptr);
           } else {
@@ -1080,10 +1092,15 @@ Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
   std::string raw_body;
   json::Value response;
   json::DocPtr response_doc;
+  prom::Client::WireVector wire;
   {
     otlp::Span span("prometheus.instant_query", &cycle.context());
     with_span(span, [&] {
-      if (zero_copy) {
+      if (wire_proto) {
+        wire = prom_client.instant_query_wire(query, recorder::enabled() ? &raw_body : nullptr);
+        response_doc = wire.doc;
+        response = wire.response;
+      } else if (zero_copy) {
         response_doc =
             prom_client.instant_query_doc(query, recorder::enabled() ? &raw_body : nullptr);
       } else {
@@ -1095,10 +1112,14 @@ Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
   observe_phase("query", phase_start);
 
   phase_start = std::chrono::steady_clock::now();
-  p.decoded = zero_copy ? metrics::decode_instant_vector(*response_doc, args.device,
-                                                         cli::resolved_schema(args))
-                        : metrics::decode_instant_vector(response, args.device,
-                                                         cli::resolved_schema(args));
+  p.decoded = (wire_proto && wire.proto)
+                  ? metrics::decode_instant_vector(wire.pv, args.device,
+                                                   cli::resolved_schema(args))
+              : (zero_copy && response_doc)
+                  ? metrics::decode_instant_vector(*response_doc, args.device,
+                                                   cli::resolved_schema(args))
+                  : metrics::decode_instant_vector(response, args.device,
+                                                   cli::resolved_schema(args));
   for (const std::string& err : p.decoded.errors) {
     log::error("daemon", "Failed to unwrap pod fields: " + err);
   }
@@ -1116,9 +1137,12 @@ Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
     if (evidence_thread.joinable()) evidence_thread.join();
     if (evidence_error) std::rethrow_exception(evidence_error);
     recorder::record_evidence_body(cycle_id, evidence_raw);
-    p.assessment = zero_copy
-                       ? signal::assess(*evidence_doc, p.decoded.samples, scfg, cycle_id)
-                       : signal::assess(evidence_response, p.decoded.samples, scfg, cycle_id);
+    p.assessment =
+        (wire_proto && evidence_wire.proto)
+            ? signal::assess(evidence_wire.pv, p.decoded.samples, scfg, cycle_id)
+        : (zero_copy && evidence_doc)
+            ? signal::assess(*evidence_doc, p.decoded.samples, scfg, cycle_id)
+            : signal::assess(evidence_response, p.decoded.samples, scfg, cycle_id);
     signal::publish(p.assessment, scfg);
     recorder::record_signal(cycle_id, signal::assessment_to_json(p.assessment));
     log::info("daemon", "Signal assessment: " +
@@ -1743,8 +1767,10 @@ int run(const cli::Cli& args) {
   // the process rides the selected mode.
   h2::set_default_mode(h2::mode_from_string(args.transport));
   json::set_zero_copy(args.zero_copy_json == "on");
+  proto::set_wire_mode(proto::wire_mode_from_string(args.wire));
   log::info("daemon", std::string("Transport: ") + h2::mode_name(h2::default_mode()) +
-            ", zero-copy JSON " + args.zero_copy_json);
+            ", zero-copy JSON " + args.zero_copy_json + ", wire " +
+            proto::wire_mode_name(proto::wire_mode()));
 
   // Query built once, reused every cycle (main.rs:280-282).
   std::string query = query::build_idle_query(cli::to_query_args(args));
@@ -1869,7 +1895,8 @@ int run(const cli::Cli& args) {
       return ledger::render_metrics(ledger_top_k, openmetrics) +
              signal::render_metrics(openmetrics) +
              h2::render_transport_metrics(openmetrics) +
-             incremental::render_metrics(openmetrics);
+             incremental::render_metrics(openmetrics) +
+             proto::render_wire_metrics(openmetrics);
     });
     // Evidence-health snapshot at /debug/signals (`analyze
     // --signal-report` hits this); {"enabled": false} with the guard off.

@@ -62,6 +62,14 @@ std::optional<Value> Store::get(const std::string& object_path) const {
     // unaffected.
     e.value = e.doc->node(e.node).to_value();
     e.doc.reset();
+  } else if (e.pbody) {
+    // Proto-backed entry (--wire proto): same memoized-materialization
+    // contract, from the raw protobuf slice. Produces a Value identical
+    // to parsing the object's JSON form (pinned by the wire parity
+    // corpus), so every consumer downstream is wire-format blind.
+    e.value = proto::object_to_value(
+        std::string_view(e.pbody->data() + e.poff, e.plen), e.papi, e.pkind);
+    e.pbody.reset();
   }
   return e.value;  // COW copy: shares nodes, pointer-sized
 }
@@ -97,6 +105,26 @@ void Store::upsert(const std::string& object_path, Value object) {
 void Store::upsert_doc(const std::string& object_path, json::DocPtr doc, uint32_t node) {
   std::lock_guard<std::mutex> lock(mutex_);
   objects_[object_path] = Entry{Value(), std::move(doc), node};
+}
+
+void Store::upsert_proto(const std::string& object_path, std::shared_ptr<const std::string> body,
+                         size_t off, size_t len, std::string api_version, std::string kind,
+                         uint64_t fp) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  Entry e;
+  e.pbody = std::move(body);
+  e.poff = off;
+  e.plen = len;
+  e.papi = std::move(api_version);
+  e.pkind = std::move(kind);
+  e.pfp = fp;
+  objects_[object_path] = std::move(e);
+}
+
+uint64_t Store::proto_fingerprint(const std::string& object_path) const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = objects_.find(object_path);
+  return it == objects_.end() ? 0 : it->second.pfp;
 }
 
 void Store::erase(const std::string& object_path) {
@@ -377,6 +405,66 @@ bool Reflector::apply_event_doc(const json::DocPtr& event) {
   return true;
 }
 
+bool Reflector::apply_event_proto(const proto::WatchEventPtr& event) {
+  const std::string& type = event->type;
+
+  if (type == "ERROR") {
+    if (request_relist("ERROR event code " + std::to_string(event->error_code))) {
+      log::warn("informer", "watch " + spec_.list_path + " ERROR event (code " +
+                std::to_string(event->error_code) + "); relisting");
+    }
+    return false;
+  }
+
+  const std::string& rv = event->resource_version;
+
+  if (type == "BOOKMARK") {
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    ++stats_.bookmarks;
+    if (!rv.empty()) stats_.resource_version = rv;
+  } else if (type == "ADDED" || type == "MODIFIED") {
+    if (!event->has_object) return true;
+    if (event->ns.empty() || event->name.empty()) return true;
+    std::string path =
+        spec_.prefix + "namespaces/" + event->ns + "/" + spec_.plural + "/" + event->name;
+    bool existed = store_.contains(path);
+    // The FUSED path: the frame's single decode scan already produced the
+    // key, the object byte range and its fingerprint — journal mark and
+    // store write happen here with no Value/Doc in between. The frame
+    // buffer rides into the store via an aliasing shared_ptr; the object
+    // materializes only if some cycle actually reads it.
+    journal_touch(path);
+    store_.upsert_proto(path,
+                        std::shared_ptr<const std::string>(event, &event->body),
+                        event->obj_off, event->obj_len, event->api_version, event->kind,
+                        event->fp);
+    proto::counters().fused_events.fetch_add(1, std::memory_order_relaxed);
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    ++(existed ? stats_.updates : stats_.adds);
+    if (!rv.empty()) stats_.resource_version = rv;
+  } else if (type == "DELETED") {
+    if (!event->has_object) return true;
+    if (event->ns.empty() || event->name.empty()) return true;
+    std::string path =
+        spec_.prefix + "namespaces/" + event->ns + "/" + spec_.plural + "/" + event->name;
+    journal_touch(path);
+    store_.erase(path);
+    proto::counters().fused_events.fetch_add(1, std::memory_order_relaxed);
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    ++stats_.deletes;
+    if (!rv.empty()) stats_.resource_version = rv;
+  } else {
+    log::debug("informer", "ignoring unknown watch event type: " + type);
+    return true;
+  }
+  if (!rv.empty()) {
+    std::lock_guard<std::mutex> lock(stats_mutex_);
+    resource_version_ = rv;
+  }
+  last_activity_mono_.store(util::mono_secs());
+  return true;
+}
+
 namespace {
 
 // Stop-responsive jittered sleep: exponential base capped at 10 s, plus a
@@ -401,12 +489,53 @@ void Reflector::run() {
   // mid-watch must not mix decode paths within one stream.
   const bool zero_copy = json::zero_copy_enabled();
   while (!stop_.load()) {
+    // Binary wire path (--wire proto|auto): negotiated per LIST/watch
+    // attempt — under auto a refused endpoint flips k8s_proto_wanted()
+    // off and the next attempt stops asking. Pods only: the owner kinds
+    // include four CRs, which real apiservers serve as JSON anyway.
+    const bool wire_proto = spec_.plural == "pods" && proto::k8s_proto_wanted();
     try {
       // Paginated initial LIST (limit/continue): a 100k-pod cluster
       // arrives in kListPageLimit-object chunks instead of one giant
       // response the apiserver (or this process) has to materialize at
       // once — the same chunking client-go's pager applies.
-      if (zero_copy) {
+      if (wire_proto) {
+        // Each protobuf page was scanned ONCE (item ranges + store keys +
+        // fingerprints); entries reference the page buffer and stay
+        // un-materialized until a cycle looks them up. JSON fallback
+        // pages take the arena-Doc shape.
+        std::map<std::string, Store::Entry> snapshot;
+        std::string rv = kube_.list_pages_wire(
+            spec_.list_path, "", kListPageLimit, [&](const k8s::Client::WirePage& page) {
+              if (page.pb) {
+                auto body = std::shared_ptr<const std::string>(page.pb, &page.pb->body);
+                for (const proto::ObjectRef& ref : page.pb->items) {
+                  if (ref.ns.empty() || ref.name.empty()) continue;
+                  std::string path = spec_.prefix + "namespaces/" + ref.ns + "/" +
+                                     spec_.plural + "/" + ref.name;
+                  Store::Entry e;
+                  e.pbody = body;
+                  e.poff = ref.off;
+                  e.plen = ref.len;
+                  e.papi = page.pb->api_version;
+                  e.pkind = page.pb->kind;
+                  e.pfp = ref.fp;
+                  snapshot[std::move(path)] = std::move(e);
+                }
+              } else if (page.doc) {
+                auto items = page.doc->root().find("items");
+                if (!items || !items->is_array()) return;
+                json::Doc::Node item = items->first_child();
+                for (size_t i = 0; i < items->size(); ++i, item = item.next_sibling()) {
+                  std::string path = object_path_of_doc(item);
+                  if (!path.empty()) {
+                    snapshot[std::move(path)] = Store::Entry{Value(), page.doc, item.index()};
+                  }
+                }
+              }
+            });
+        apply_list_snapshot(std::move(snapshot), std::move(rv));
+      } else if (zero_copy) {
         // Zero-copy: each page body becomes an arena Doc; the snapshot
         // holds (page, node) references and the pods stay un-materialized
         // until a cycle looks them up.
@@ -444,7 +573,17 @@ void Reflector::run() {
       wopts.resource_version = resource_version();
       wopts.abort = [this] { return stop_.load(); };
       try {
-        if (zero_copy) {
+        if (wire_proto) {
+          kube_.watch_wire(spec_.list_path, wopts, [&](const k8s::Client::WireWatchEvent& ev) {
+            bool ok = ev.pb ? apply_event_proto(ev.pb) : apply_event_doc(ev.doc);
+            if (!ok) {
+              relist = true;
+              return false;
+            }
+            watch_failures = 0;
+            return !stop_.load();
+          });
+        } else if (zero_copy) {
           kube_.watch_doc(spec_.list_path, wopts, [&](const json::DocPtr& ev) {
             if (!apply_event_doc(ev)) {
               relist = true;

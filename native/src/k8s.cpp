@@ -63,21 +63,9 @@ Client::Client(Config config)
       http_(h2::default_mode(),
             config_.tls_skip ? http::TlsMode::Skip : http::TlsMode::Verify, config_.ca_file) {}
 
-json::Value Client::request_json(const std::string& method, const std::string& path,
-                                 const std::string& body, const std::string& content_type,
-                                 int* status_out, bool retry_throttle,
-                                 json::DocPtr* doc_out) const {
+http::Response Client::issue(http::Request& req, const std::string& method,
+                             const std::string& path, bool retry_throttle) const {
   api_calls_.fetch_add(1, std::memory_order_relaxed);
-  http::Request req;
-  req.method = method;
-  req.url = config_.api_url + path;
-  req.timeout_ms = config_.timeout_ms;
-  req.headers.push_back({"Accept", "application/json"});
-  if (!config_.token.empty())
-    req.headers.push_back({"Authorization", "Bearer " + config_.token});
-  if (!content_type.empty()) req.headers.push_back({"Content-Type", content_type});
-  req.body = body;
-
   http::Response resp = http_.request(req);
   // API Priority & Fairness throttling (stock GKE behavior): the server
   // sheds load with 429 + Retry-After. Honoring it with a bounded wait
@@ -129,6 +117,24 @@ json::Value Client::request_json(const std::string& method, const std::string& p
     if (util::shutdown_flag().load()) break;
     resp = http_.request(req);
   }
+  return resp;
+}
+
+json::Value Client::request_json(const std::string& method, const std::string& path,
+                                 const std::string& body, const std::string& content_type,
+                                 int* status_out, bool retry_throttle,
+                                 json::DocPtr* doc_out) const {
+  http::Request req;
+  req.method = method;
+  req.url = config_.api_url + path;
+  req.timeout_ms = config_.timeout_ms;
+  req.headers.push_back({"Accept", "application/json"});
+  if (!config_.token.empty())
+    req.headers.push_back({"Authorization", "Bearer " + config_.token});
+  if (!content_type.empty()) req.headers.push_back({"Content-Type", content_type});
+  req.body = body;
+
+  http::Response resp = issue(req, method, path, retry_throttle);
   if (status_out) *status_out = resp.status;
   if (resp.status >= 200 && resp.status < 300) {
     if (resp.body.empty()) {
@@ -259,6 +265,7 @@ std::string Client::list_pages(const std::string& path, const std::string& label
     json::DocPtr doc;
     request_json("GET", query.empty() ? path : path + "?" + query, "", "", nullptr,
                  /*retry_throttle=*/true, &doc);
+    proto::counters().k8s_json_bytes.fetch_add(doc->body().size(), std::memory_order_relaxed);
     std::string next;
     if (auto meta = doc->root().find("metadata"); meta && meta->is_object()) {
       if (auto c = meta->find("continue"); c && c->is_string()) next = c->as_string();
@@ -269,6 +276,82 @@ std::string Client::list_pages(const std::string& path, const std::string& label
       }
     }
     on_page(doc);
+    if (next.empty()) return rv;
+    continue_token = next;
+  }
+  throw std::runtime_error("k8s: LIST " + path + " did not terminate after " +
+                           std::to_string(kMaxPages) + " continue pages");
+}
+
+std::string Client::list_pages_wire(const std::string& path, const std::string& label_selector,
+                                    int64_t limit,
+                                    const std::function<void(const WirePage&)>& on_page) const {
+  std::string base_query;
+  if (!label_selector.empty()) base_query = "labelSelector=" + util::url_encode(label_selector);
+  if (limit > 0) {
+    if (!base_query.empty()) base_query += "&";
+    base_query += "limit=" + std::to_string(limit);
+  }
+  std::string rv;
+  std::string continue_token;
+  constexpr int kMaxPages = 1000;  // same runaway-server guard as list()
+  for (int page_i = 0; page_i < kMaxPages; ++page_i) {
+    std::string query = base_query;
+    if (!continue_token.empty()) {
+      if (!query.empty()) query += "&";
+      query += "continue=" + util::url_encode(continue_token);
+    }
+    const std::string full_path = query.empty() ? path : path + "?" + query;
+    http::Request req;
+    req.url = config_.api_url + full_path;
+    req.timeout_ms = config_.timeout_ms;
+    const bool want_proto = proto::k8s_proto_wanted();
+    req.headers.push_back(
+        {"Accept", want_proto ? std::string(proto::kK8sProtoAccept) : "application/json"});
+    if (!config_.token.empty())
+      req.headers.push_back({"Authorization", "Bearer " + config_.token});
+    http::Response resp = issue(req, "GET", full_path, /*retry_throttle=*/true);
+    if (resp.status < 200 || resp.status >= 300) {
+      std::string message;
+      try {
+        message = json::Value::parse(resp.body).get_string("message", resp.body.substr(0, 256));
+      } catch (const std::exception&) {
+        message = resp.body.substr(0, 256);
+      }
+      throw ApiError(resp.status, "k8s: GET " + full_path + " → HTTP " +
+                                      std::to_string(resp.status) + ": " + message);
+    }
+    std::string content_type;
+    if (auto it = resp.headers.find("content-type"); it != resp.headers.end()) {
+      content_type = it->second;
+    }
+    WirePage page;
+    std::string next;
+    if (proto::is_k8s_proto(content_type)) {
+      proto::counters().k8s_proto_bytes.fetch_add(resp.body.size(), std::memory_order_relaxed);
+      try {
+        page.pb = proto::parse_list(std::move(resp.body));
+      } catch (const json::ParseError& e) {
+        throw std::runtime_error("k8s: unparseable protobuf LIST from " + path + ": " +
+                                 e.what());
+      }
+      next = page.pb->continue_token;
+      if (!page.pb->resource_version.empty()) rv = page.pb->resource_version;
+    } else {
+      if (want_proto) proto::note_k8s_fallback();
+      proto::counters().k8s_json_bytes.fetch_add(resp.body.size(), std::memory_order_relaxed);
+      try {
+        page.doc = json::Doc::parse(std::move(resp.body));
+      } catch (const json::ParseError& e) {
+        throw std::runtime_error("k8s: unparseable response body from " + path + ": " +
+                                 e.what());
+      }
+      if (auto meta = page.doc->root().find("metadata"); meta && meta->is_object()) {
+        if (auto c = meta->find("continue"); c && c->is_string()) next = c->as_string();
+        if (auto v = meta->find("resourceVersion"); v && v->is_string()) rv = v->as_string();
+      }
+    }
+    on_page(page);
     if (next.empty()) return rv;
     continue_token = next;
   }
@@ -356,6 +439,7 @@ void Client::watch_impl(const std::string& path, const WatchOptions& opts,
           std::string_view line(pending.data() + start, nl - start);
           start = nl + 1;
           if (util::trim(line).empty()) continue;
+          proto::counters().k8s_json_bytes.fetch_add(line.size(), std::memory_order_relaxed);
           if (!on_line(line)) {
             pending.clear();
             return false;
@@ -366,6 +450,116 @@ void Client::watch_impl(const std::string& path, const WatchOptions& opts,
       },
       opts.abort,
       [&](const http::Response& r) { status = r.status; });
+  if (resp.status != 200) {
+    std::string message;
+    try {
+      message = json::Value::parse(pending).get_string("message", pending.substr(0, 256));
+    } catch (const std::exception&) {
+      message = pending.substr(0, 256);
+    }
+    throw ApiError(resp.status, "k8s: WATCH " + path + " → HTTP " +
+                                    std::to_string(resp.status) + ": " + message);
+  }
+}
+
+void Client::watch_wire(const std::string& path, const WatchOptions& opts,
+                        const std::function<bool(const WireWatchEvent&)>& on_event) const {
+  api_calls_.fetch_add(1, std::memory_order_relaxed);
+  std::string query = "watch=true";
+  if (!opts.resource_version.empty())
+    query += "&resourceVersion=" + util::url_encode(opts.resource_version);
+  if (opts.bookmarks) query += "&allowWatchBookmarks=true";
+
+  http::Request req;
+  req.url = config_.api_url + path +
+            (path.find('?') == std::string::npos ? "?" : "&") + query;
+  req.timeout_ms = opts.read_timeout_ms;
+  const bool want_proto = proto::k8s_proto_wanted();
+  req.headers.push_back(
+      {"Accept", want_proto ? std::string(proto::kK8sProtoWatchAccept) : "application/json"});
+  if (!config_.token.empty())
+    req.headers.push_back({"Authorization", "Bearer " + config_.token});
+
+  // Framing depends on the NEGOTIATED content type (known from the
+  // response headers before the first body byte): protobuf streams are
+  // 4-byte big-endian length-delimited runtime.Unknown(WatchEvent)
+  // frames; JSON streams are newline-delimited events. Error bodies
+  // (non-200) are always the apiserver's JSON Status object.
+  std::string pending;
+  int status = 0;
+  bool proto_stream = false;
+  http::Response resp = http_.request_stream(
+      req,
+      [&](const char* data, size_t n) {
+        pending.append(data, n);
+        if (pending.size() > (64u << 20)) {
+          throw std::runtime_error("k8s: watch frame exceeds 64 MiB");
+        }
+        if (status != 200) return pending.size() < 65536;  // error body, bounded
+        if (proto_stream) {
+          while (pending.size() >= 4) {
+            uint32_t len = (static_cast<uint32_t>(static_cast<unsigned char>(pending[0])) << 24) |
+                           (static_cast<uint32_t>(static_cast<unsigned char>(pending[1])) << 16) |
+                           (static_cast<uint32_t>(static_cast<unsigned char>(pending[2])) << 8) |
+                           static_cast<uint32_t>(static_cast<unsigned char>(pending[3]));
+            if (len > (64u << 20)) {
+              throw std::runtime_error("k8s: watch frame exceeds 64 MiB");
+            }
+            if (pending.size() < 4u + len) break;
+            std::string frame = pending.substr(4, len);
+            pending.erase(0, 4u + len);
+            proto::counters().k8s_proto_bytes.fetch_add(len + 4, std::memory_order_relaxed);
+            WireWatchEvent ev;
+            try {
+              // ONE scan per frame: type + object slice + store key +
+              // fingerprint come out of this parse; the reflector's fused
+              // apply path touches the journal and store directly.
+              ev.pb = proto::parse_watch_event(std::move(frame));
+            } catch (const json::ParseError& e) {
+              throw std::runtime_error(std::string("k8s: unparseable watch frame: ") +
+                                       e.what());
+            }
+            if (!on_event(ev)) {
+              pending.clear();
+              return false;
+            }
+          }
+          return true;
+        }
+        size_t start = 0;
+        while (true) {
+          size_t nl = pending.find('\n', start);
+          if (nl == std::string::npos) break;
+          std::string_view line(pending.data() + start, nl - start);
+          start = nl + 1;
+          if (util::trim(line).empty()) continue;
+          proto::counters().k8s_json_bytes.fetch_add(line.size(), std::memory_order_relaxed);
+          WireWatchEvent ev;
+          try {
+            ev.doc = json::Doc::parse(std::string(line));
+          } catch (const json::ParseError& e) {
+            throw std::runtime_error(std::string("k8s: unparseable watch event: ") + e.what());
+          }
+          if (!on_event(ev)) {
+            pending.clear();
+            return false;
+          }
+        }
+        pending.erase(0, start);
+        return true;
+      },
+      opts.abort,
+      [&](const http::Response& r) {
+        status = r.status;
+        if (status == 200) {
+          std::string content_type;
+          if (auto it = r.headers.find("content-type"); it != r.headers.end()) {
+            content_type = it->second;
+          }
+          proto_stream = proto::is_k8s_proto(content_type);
+          if (want_proto && !proto_stream) proto::note_k8s_fallback();
+        }
+      });
   if (resp.status != 200) {
     std::string message;
     try {

@@ -58,16 +58,25 @@ int main(int argc, char** argv) {
   }
 
   if (argc >= 2 && std::strcmp(argv[1], "querytest") == 0) {
-    if (argc != 4) {
+    const bool wire_form = argc == 6 && std::strcmp(argv[2], "--wire") == 0;
+    if (argc != 4 && !wire_form) {
       std::fprintf(stderr,
                    "usage: tpu-pruner querytest <promql> <prometheus-url>\n"
                    "       tpu-pruner querytest --evidence <prometheus-url>\n"
+                   "       tpu-pruner querytest --wire proto|json <promql> <prometheus-url>\n"
                    "  --evidence renders and runs the signal watchdog's evidence query\n"
-                   "  (per-pod sample coverage + last-sample age; default TPU/gmp args)\n");
+                   "  (per-pod sample coverage + last-sample age; default TPU/gmp args)\n"
+                   "  --wire fetches ONE raw response in the chosen content type and\n"
+                   "  hex-dumps it (debugging protobuf negotiation against real endpoints)\n");
       return 2;
     }
     log::init(log::Format::Default);
     try {
+      if (wire_form) {
+        // Raw-wire debugging: what does this endpoint actually answer
+        // when asked for the protobuf exposition?
+        return querytest::run_wire(argv[4], argv[5], argv[3]);
+      }
       if (std::strcmp(argv[2], "--evidence") == 0) {
         // Ad-hoc evidence-health check: the same query --signal-guard on
         // issues per cycle, runnable standalone before enabling the guard.

@@ -208,6 +208,95 @@ DecodeResult decode_instant_vector(const json::Doc& response, const std::string&
   return out;
 }
 
+namespace {
+
+// Wire-series twin of label(): first matching label wins (labels are
+// unique per series — Prometheus label sets are maps), exported_*/native
+// fallback chain preserved.
+const std::string* label_wire(const proto::PromSeries& series, std::string_view exported,
+                              std::string_view native) {
+  const std::string* native_hit = nullptr;
+  for (const auto& [name, value] : series.labels) {
+    if (name == exported) return &value;
+    if (!native_hit && name == native) native_hit = &value;
+  }
+  return native_hit;
+}
+
+std::string label_wire_or(const proto::PromSeries& series, std::string_view key,
+                          std::string fallback) {
+  for (const auto& [name, value] : series.labels) {
+    if (name == key) return value;
+  }
+  return fallback;
+}
+
+}  // namespace
+
+DecodeResult decode_instant_vector(const proto::PromVector& response, const std::string& device,
+                                   const std::string& schema) {
+  if (schema != "gmp" && schema != "gke-system") {
+    throw std::runtime_error("unknown metric schema: " + schema + " (expected gmp|gke-system)");
+  }
+  if (response.status != "success") {
+    throw std::runtime_error("prometheus query failed: " +
+                             (response.error.empty() ? "unknown error" : response.error));
+  }
+
+  DecodeResult out;
+  out.num_series = response.result.size();
+  std::unordered_set<std::string> seen;
+
+  for (const proto::PromSeries& series : response.result) {
+    const std::string* pod = label_wire(series, "exported_pod", "pod");
+    if (!pod) {
+      out.errors.push_back("the data for key `exported_pod/pod` is not available");
+      continue;
+    }
+    const std::string* ns = label_wire(series, "exported_namespace", "namespace");
+    if (!ns) {
+      out.errors.push_back("the data for key `exported_namespace/namespace` is not available");
+      continue;
+    }
+    const std::string* container = label_wire(series, "exported_container", "container");
+    if (!container && schema != "gke-system") {
+      out.errors.push_back("the data for key `exported_container/container` is not available");
+      continue;
+    }
+
+    core::PodMetricSample sample;
+    sample.name = *pod;
+    sample.ns = *ns;
+    sample.container = container ? *container : "unknown";
+    sample.node_type =
+        label_wire_or(series, "node_type", label_wire_or(series, "model", "unknown"));
+
+    if (device == "gpu") {
+      const std::string* model = label_wire(series, "modelName", "modelName");
+      if (!model) {
+        out.errors.push_back("the data for key `modelName` is not available");
+        continue;
+      }
+      sample.accelerator = *model;
+    } else {
+      sample.accelerator =
+          label_wire_or(series, "accelerator_type", label_wire_or(series, "model", "unknown"));
+    }
+
+    try {
+      sample.value = std::stod(series.value_text);
+    } catch (const std::exception&) {
+      out.errors.push_back("unparseable sample value for pod " + sample.name);
+      continue;
+    }
+
+    if (seen.insert(sample.ns + "/" + sample.name).second) {
+      out.samples.push_back(std::move(sample));
+    }
+  }
+  return out;
+}
+
 uint64_t sample_fingerprint(const core::PodMetricSample& s) {
   // FNV-1a, field-delimited so ("ab","c") never collides with ("a","bc").
   // Not std::hash for the same reason shard placement isn't: the value

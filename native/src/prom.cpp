@@ -15,12 +15,12 @@ Client::Client(std::string base_url, std::string bearer_token, http::TlsMode tls
   while (!base_url_.empty() && base_url_.back() == '/') base_url_.pop_back();
 }
 
-http::Response Client::query_once(const std::string& promql) const {
+http::Response Client::query_once(const std::string& promql, std::string_view accept) const {
   http::Request req;
   req.method = "POST";
   req.url = base_url_ + "/api/v1/query";
   req.headers.push_back({"Content-Type", "application/x-www-form-urlencoded"});
-  req.headers.push_back({"Accept", "application/json"});
+  req.headers.push_back({"Accept", std::string(accept)});
   {
     std::lock_guard<std::mutex> lock(token_mutex_);
     if (!token_.empty()) req.headers.push_back({"Authorization", "Bearer " + token_});
@@ -42,6 +42,7 @@ http::Response Client::query_once(const std::string& promql) const {
 json::Value Client::instant_query(const std::string& promql, std::string* raw_body) const {
   http::Response resp = query_once(promql);
   if (raw_body) *raw_body = resp.body;
+  proto::counters().prom_json_bytes.fetch_add(resp.body.size(), std::memory_order_relaxed);
   try {
     return json::Value::parse(resp.body);
   } catch (const json::ParseError& e) {
@@ -52,11 +53,53 @@ json::Value Client::instant_query(const std::string& promql, std::string* raw_bo
 json::DocPtr Client::instant_query_doc(const std::string& promql, std::string* raw_body) const {
   http::Response resp = query_once(promql);
   if (raw_body) *raw_body = resp.body;  // verbatim copy BEFORE the body moves
+  proto::counters().prom_json_bytes.fetch_add(resp.body.size(), std::memory_order_relaxed);
   try {
     return json::Doc::parse(std::move(resp.body));
   } catch (const json::ParseError& e) {
     throw std::runtime_error(std::string("prometheus returned unparseable body: ") + e.what());
   }
+}
+
+Client::WireVector Client::instant_query_wire(const std::string& promql,
+                                              std::string* raw_body) const {
+  const bool want_proto = proto::prom_proto_wanted();
+  http::Response resp = query_once(
+      promql, want_proto ? proto::kPromProtoAccept : std::string_view("application/json"));
+  WireVector out;
+  std::string content_type;
+  if (auto it = resp.headers.find("content-type"); it != resp.headers.end()) {
+    content_type = it->second;
+  }
+  if (proto::is_prom_proto(content_type)) {
+    proto::counters().prom_proto_bytes.fetch_add(resp.body.size(), std::memory_order_relaxed);
+    try {
+      // Fused decode: ONE scan of the body yields the per-series labels
+      // and the exact timestamp/value text — no tree, no arena.
+      out.pv = proto::parse_prom_vector(resp.body);
+    } catch (const json::ParseError& e) {
+      throw std::runtime_error(std::string("prometheus returned unparseable body: ") +
+                               e.what());
+    }
+    out.proto = true;
+    // Canonical JSON reconstruction for the flight recorder: replay and
+    // `--wire json` capsules must carry the SAME bytes.
+    if (raw_body) *raw_body = proto::prom_canonical_body(out.pv);
+    return out;
+  }
+  if (want_proto) proto::note_prom_fallback();
+  if (raw_body) *raw_body = resp.body;
+  proto::counters().prom_json_bytes.fetch_add(resp.body.size(), std::memory_order_relaxed);
+  try {
+    if (json::zero_copy_enabled()) {
+      out.doc = json::Doc::parse(std::move(resp.body));
+    } else {
+      out.response = json::Value::parse(resp.body);
+    }
+  } catch (const json::ParseError& e) {
+    throw std::runtime_error(std::string("prometheus returned unparseable body: ") + e.what());
+  }
+  return out;
 }
 
 }  // namespace tpupruner::prom

@@ -1,0 +1,700 @@
+#include "tpupruner/proto.hpp"
+
+#include <algorithm>
+#include <cstdio>
+#include <ctime>
+#include <stdexcept>
+
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::proto {
+
+using json::ParseError;
+using json::Value;
+
+// ── wire mode ───────────────────────────────────────────────────────────
+
+namespace {
+
+std::atomic<int> g_mode{-1};  // -1 = not yet initialized from the env
+std::atomic<bool> g_k8s_refused{false};
+std::atomic<bool> g_prom_refused{false};
+
+WireMode env_mode() {
+  if (auto v = util::env("TPU_PRUNER_WIRE")) {
+    try {
+      return wire_mode_from_string(*v);
+    } catch (const std::exception&) {
+      // A typo'd env var must not silently change the wire format.
+      return WireMode::Json;
+    }
+  }
+  return WireMode::Json;
+}
+
+}  // namespace
+
+WireMode wire_mode_from_string(const std::string& s) {
+  if (s == "json") return WireMode::Json;
+  if (s == "proto") return WireMode::Proto;
+  if (s == "auto") return WireMode::Auto;
+  throw std::runtime_error("proto: unknown wire mode '" + s + "' (json|proto|auto)");
+}
+
+const char* wire_mode_name(WireMode m) {
+  switch (m) {
+    case WireMode::Json: return "json";
+    case WireMode::Proto: return "proto";
+    case WireMode::Auto: return "auto";
+  }
+  return "?";
+}
+
+WireMode wire_mode() {
+  int m = g_mode.load(std::memory_order_relaxed);
+  if (m < 0) {
+    m = static_cast<int>(env_mode());
+    g_mode.store(m, std::memory_order_relaxed);
+  }
+  return static_cast<WireMode>(m);
+}
+
+void set_wire_mode(WireMode m) { g_mode.store(static_cast<int>(m)); }
+
+bool k8s_proto_wanted() {
+  WireMode m = wire_mode();
+  if (m == WireMode::Proto) return true;
+  return m == WireMode::Auto && !g_k8s_refused.load(std::memory_order_relaxed);
+}
+
+bool prom_proto_wanted() {
+  WireMode m = wire_mode();
+  if (m == WireMode::Proto) return true;
+  return m == WireMode::Auto && !g_prom_refused.load(std::memory_order_relaxed);
+}
+
+void note_k8s_fallback() {
+  counters().negotiation_fallbacks.fetch_add(1, std::memory_order_relaxed);
+  if (wire_mode() == WireMode::Auto) g_k8s_refused.store(true, std::memory_order_relaxed);
+}
+
+void note_prom_fallback() {
+  counters().negotiation_fallbacks.fetch_add(1, std::memory_order_relaxed);
+  if (wire_mode() == WireMode::Auto) g_prom_refused.store(true, std::memory_order_relaxed);
+}
+
+bool is_k8s_proto(std::string_view content_type) {
+  return content_type.substr(0, kK8sProtoContentType.size()) == kK8sProtoContentType;
+}
+
+bool is_prom_proto(std::string_view content_type) {
+  return content_type.substr(0, kPromProtoContentType.size()) == kPromProtoContentType;
+}
+
+// ── counters / metrics ──────────────────────────────────────────────────
+
+WireCounters& counters() {
+  static WireCounters c;
+  return c;
+}
+
+std::vector<std::string> wire_metric_families() {
+  return {"tpu_pruner_wire_bytes_decoded_total", "tpu_pruner_wire_negotiation_fallbacks_total",
+          "tpu_pruner_wire_fused_decode_events_total", "tpu_pruner_wire_mode"};
+}
+
+std::string render_wire_metrics(bool openmetrics) {
+  WireCounters& c = counters();
+  std::string out;
+  auto counter = [&](const std::string& name, const std::string& help,
+                     const std::string& body) {
+    out += "# HELP " + name + " " + help + "\n";
+    // OpenMetrics reserves `counter` for suffix-transformed names; keep
+    // the 0.0.4-compatible rendering the transport families use.
+    out += "# TYPE " + name + " " + (openmetrics ? "unknown" : "counter") + "\n";
+    out += body;
+  };
+  auto row = [](const char* ep, const char* ct, uint64_t v) {
+    return std::string("tpu_pruner_wire_bytes_decoded_total{endpoint=\"") + ep +
+           "\",content_type=\"" + ct + "\"} " + std::to_string(v) + "\n";
+  };
+  counter("tpu_pruner_wire_bytes_decoded_total",
+          "Response bytes decoded at the hot call sites (informer LIST/watch, Prometheus "
+          "instant queries), by endpoint and negotiated content type",
+          row("k8s", "protobuf", c.k8s_proto_bytes.load()) +
+              row("k8s", "json", c.k8s_json_bytes.load()) +
+              row("prom", "protobuf", c.prom_proto_bytes.load()) +
+              row("prom", "json", c.prom_json_bytes.load()));
+  counter("tpu_pruner_wire_negotiation_fallbacks_total",
+          "Requests that asked for protobuf and were answered with JSON (under --wire auto "
+          "the endpoint is then remembered as JSON-only)",
+          "tpu_pruner_wire_negotiation_fallbacks_total " +
+              std::to_string(c.negotiation_fallbacks.load()) + "\n");
+  counter("tpu_pruner_wire_fused_decode_events_total",
+          "Watch events decoded through the fused single-pass path (decode -> fingerprint "
+          "-> journal_touch -> store upsert, no intermediate tree)",
+          "tpu_pruner_wire_fused_decode_events_total " + std::to_string(c.fused_events.load()) +
+              "\n");
+  out += "# HELP tpu_pruner_wire_mode Selected wire mode (--wire); the labeled mode is 1\n";
+  out += "# TYPE tpu_pruner_wire_mode gauge\n";
+  out += std::string("tpu_pruner_wire_mode{mode=\"") + wire_mode_name(wire_mode()) + "\"} 1\n";
+  return out;
+}
+
+uint64_t fingerprint(std::string_view bytes) {
+  uint64_t h = 0xcbf29ce484222325ull;
+  for (unsigned char b : bytes) {
+    h ^= b;
+    h *= 0x100000001b3ull;
+  }
+  return h;
+}
+
+void reset_for_test() {
+  WireCounters& c = counters();
+  c.k8s_proto_bytes = 0;
+  c.k8s_json_bytes = 0;
+  c.prom_proto_bytes = 0;
+  c.prom_json_bytes = 0;
+  c.negotiation_fallbacks = 0;
+  c.fused_events = 0;
+  g_k8s_refused = false;
+  g_prom_refused = false;
+}
+
+// ── protobuf wire primitives ────────────────────────────────────────────
+//
+// Only the three wire types the schema uses: varint (0), length-delimited
+// (2), and (skipped) fixed64/fixed32 (1/5). Every read is bounds-checked
+// against the slice; violations throw json::ParseError with the absolute
+// byte offset — the same typed error the JSON decoders raise, pinned by
+// the truncation/garbage sweep tests.
+
+namespace {
+
+struct Reader {
+  std::string_view data;
+  size_t pos = 0;    // position within `data`
+  size_t base = 0;   // absolute offset of data[0] (error reporting)
+
+  [[noreturn]] void fail(const std::string& msg) const {
+    throw ParseError("proto: " + msg, base + pos);
+  }
+  bool done() const { return pos >= data.size(); }
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (true) {
+      if (pos >= data.size()) fail("truncated varint");
+      if (shift >= 64) fail("varint overflow");
+      uint8_t b = static_cast<uint8_t>(data[pos++]);
+      v |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+  }
+
+  // (field number, wire type)
+  std::pair<uint32_t, uint32_t> tag() {
+    uint64_t t = varint();
+    uint32_t field = static_cast<uint32_t>(t >> 3);
+    uint32_t wt = static_cast<uint32_t>(t & 7);
+    if (field == 0) fail("field number 0");
+    return {field, wt};
+  }
+
+  std::string_view bytes() {
+    uint64_t len = varint();
+    if (len > data.size() - pos) fail("length-delimited field overruns buffer");
+    std::string_view out = data.substr(pos, len);
+    pos += len;
+    return out;
+  }
+
+  // Sub-reader over a length-delimited field, carrying absolute offsets.
+  Reader message() {
+    size_t at = pos;
+    std::string_view b = bytes();
+    return Reader{b, 0, base + at + (pos - at - b.size())};
+  }
+
+  void skip(uint32_t wire_type) {
+    switch (wire_type) {
+      case 0: varint(); return;
+      case 1:
+        if (data.size() - pos < 8) fail("truncated fixed64");
+        pos += 8;
+        return;
+      case 2: bytes(); return;
+      case 5:
+        if (data.size() - pos < 4) fail("truncated fixed32");
+        pos += 4;
+        return;
+      default: fail("unsupported wire type " + std::to_string(wire_type));
+    }
+  }
+};
+
+constexpr char kMagic[4] = {0x6b, 0x38, 0x73, 0x00};  // "k8s\0"
+
+// runtime.Unknown envelope past the magic: typeMeta=1 {apiVersion=1,
+// kind=2}, raw=2. Returns the raw slice; offsets stay absolute.
+struct Envelope {
+  std::string api_version, kind;
+  std::string_view raw;
+  size_t raw_off = 0;  // absolute offset of raw within the original buffer
+};
+
+Envelope parse_unknown(std::string_view buf, size_t base) {
+  if (buf.size() < 4 || std::string_view(buf.data(), 4) != std::string_view(kMagic, 4)) {
+    throw ParseError("proto: missing k8s protobuf magic prefix", base);
+  }
+  Reader r{buf.substr(4), 0, base + 4};
+  Envelope env;
+  while (!r.done()) {
+    auto [field, wt] = r.tag();
+    if (field == 1 && wt == 2) {
+      Reader tm = r.message();
+      while (!tm.done()) {
+        auto [f2, w2] = tm.tag();
+        if (f2 == 1 && w2 == 2) env.api_version = std::string(tm.bytes());
+        else if (f2 == 2 && w2 == 2) env.kind = std::string(tm.bytes());
+        else tm.skip(w2);
+      }
+    } else if (field == 2 && wt == 2) {
+      size_t at = r.pos;
+      env.raw = r.bytes();
+      env.raw_off = base + 4 + at + (r.pos - at - env.raw.size());
+    } else {
+      r.skip(wt);
+    }
+  }
+  return env;
+}
+
+// Shallow ObjectMeta scan: name (1), namespace (3), resourceVersion (6).
+// One pass, no allocation beyond the three strings — the fused path's
+// store-key extraction.
+void scan_meta(Reader meta, std::string* name, std::string* ns, std::string* rv) {
+  while (!meta.done()) {
+    auto [f, w] = meta.tag();
+    if (f == 1 && w == 2) *name = std::string(meta.bytes());
+    else if (f == 3 && w == 2) *ns = std::string(meta.bytes());
+    else if (f == 6 && w == 2) *rv = std::string(meta.bytes());
+    else meta.skip(w);
+  }
+}
+
+// Object scan for the key fields: field 1 = ObjectMeta.
+void scan_object(Reader obj, std::string* name, std::string* ns, std::string* rv) {
+  while (!obj.done()) {
+    auto [f, w] = obj.tag();
+    if (f == 1 && w == 2) scan_meta(obj.message(), name, ns, rv);
+    else obj.skip(w);
+  }
+}
+
+std::string rfc3339(int64_t seconds) {
+  std::time_t t = static_cast<std::time_t>(seconds);
+  std::tm tm{};
+  gmtime_r(&t, &tm);
+  char buf[32];
+  std::snprintf(buf, sizeof(buf), "%04d-%02d-%02dT%02d:%02d:%02dZ", tm.tm_year + 1900,
+                tm.tm_mon + 1, tm.tm_mday, tm.tm_hour, tm.tm_min, tm.tm_sec);
+  return buf;
+}
+
+// meta/v1 Time: seconds=1 (varint, zigzag NOT used upstream — plain
+// int64), nanos=2. Rendered in the compact RFC3339 form the fakes (and
+// apiservers) emit in JSON.
+Value time_to_value(Reader t) {
+  int64_t seconds = 0;
+  while (!t.done()) {
+    auto [f, w] = t.tag();
+    if (f == 1 && w == 0) seconds = static_cast<int64_t>(t.varint());
+    else t.skip(w);
+  }
+  return Value(rfc3339(seconds));
+}
+
+// map<string,string> entry {key=1, value=2} folded into `obj`.
+void map_entry_into(Reader e, Value& obj) {
+  std::string key, value;
+  while (!e.done()) {
+    auto [f, w] = e.tag();
+    if (f == 1 && w == 2) key = std::string(e.bytes());
+    else if (f == 2 && w == 2) value = std::string(e.bytes());
+    else e.skip(w);
+  }
+  obj.set(std::move(key), Value(std::move(value)));
+}
+
+// map<string,Quantity> entry {key=1, value=Quantity{string=1}}.
+void quantity_entry_into(Reader e, Value& obj) {
+  std::string key, value;
+  while (!e.done()) {
+    auto [f, w] = e.tag();
+    if (f == 1 && w == 2) key = std::string(e.bytes());
+    else if (f == 2 && w == 2) {
+      Reader q = e.message();
+      while (!q.done()) {
+        auto [f2, w2] = q.tag();
+        if (f2 == 1 && w2 == 2) value = std::string(q.bytes());
+        else q.skip(w2);
+      }
+    } else e.skip(w);
+  }
+  obj.set(std::move(key), Value(std::move(value)));
+}
+
+// OwnerReference: kind=1, name=3, uid=4, apiVersion=5, controller=6,
+// blockOwnerDeletion=7 (the real generated.proto numbering).
+Value owner_ref_to_value(Reader o) {
+  Value out = Value::object();
+  while (!o.done()) {
+    auto [f, w] = o.tag();
+    if (f == 1 && w == 2) out.set("kind", Value(std::string(o.bytes())));
+    else if (f == 3 && w == 2) out.set("name", Value(std::string(o.bytes())));
+    else if (f == 4 && w == 2) out.set("uid", Value(std::string(o.bytes())));
+    else if (f == 5 && w == 2) out.set("apiVersion", Value(std::string(o.bytes())));
+    else if (f == 6 && w == 0) out.set("controller", Value(o.varint() != 0));
+    else if (f == 7 && w == 0) out.set("blockOwnerDeletion", Value(o.varint() != 0));
+    else o.skip(w);
+  }
+  return out;
+}
+
+Value object_meta_to_value(Reader m) {
+  Value out = Value::object();
+  Value labels, annotations, owners;
+  while (!m.done()) {
+    auto [f, w] = m.tag();
+    if (f == 1 && w == 2) out.set("name", Value(std::string(m.bytes())));
+    else if (f == 2 && w == 2) out.set("generateName", Value(std::string(m.bytes())));
+    else if (f == 3 && w == 2) out.set("namespace", Value(std::string(m.bytes())));
+    else if (f == 4 && w == 2) out.set("selfLink", Value(std::string(m.bytes())));
+    else if (f == 5 && w == 2) out.set("uid", Value(std::string(m.bytes())));
+    else if (f == 6 && w == 2) out.set("resourceVersion", Value(std::string(m.bytes())));
+    else if (f == 8 && w == 2) out.set("creationTimestamp", time_to_value(m.message()));
+    else if (f == 11 && w == 2) {
+      if (!labels.is_object()) labels = Value::object();
+      map_entry_into(m.message(), labels);
+    } else if (f == 12 && w == 2) {
+      if (!annotations.is_object()) annotations = Value::object();
+      map_entry_into(m.message(), annotations);
+    } else if (f == 13 && w == 2) {
+      if (!owners.is_array()) owners = Value::array();
+      owners.push_back(owner_ref_to_value(m.message()));
+    } else m.skip(w);
+  }
+  if (labels.is_object()) out.set("labels", std::move(labels));
+  if (annotations.is_object()) out.set("annotations", std::move(annotations));
+  if (owners.is_array()) out.set("ownerReferences", std::move(owners));
+  return out;
+}
+
+// ResourceRequirements: limits=1 map, requests=2 map.
+Value resources_to_value(Reader r) {
+  Value out = Value::object();
+  Value limits, requests;
+  while (!r.done()) {
+    auto [f, w] = r.tag();
+    if (f == 1 && w == 2) {
+      if (!limits.is_object()) limits = Value::object();
+      quantity_entry_into(r.message(), limits);
+    } else if (f == 2 && w == 2) {
+      if (!requests.is_object()) requests = Value::object();
+      quantity_entry_into(r.message(), requests);
+    } else r.skip(w);
+  }
+  if (limits.is_object()) out.set("limits", std::move(limits));
+  if (requests.is_object()) out.set("requests", std::move(requests));
+  return out;
+}
+
+// Container: name=1, image=2, resources=8.
+Value container_to_value(Reader c) {
+  Value out = Value::object();
+  while (!c.done()) {
+    auto [f, w] = c.tag();
+    if (f == 1 && w == 2) out.set("name", Value(std::string(c.bytes())));
+    else if (f == 2 && w == 2) out.set("image", Value(std::string(c.bytes())));
+    else if (f == 8 && w == 2) out.set("resources", resources_to_value(c.message()));
+    else c.skip(w);
+  }
+  return out;
+}
+
+// PodSpec: containers=2, nodeName=10.
+Value pod_spec_to_value(Reader s) {
+  Value out = Value::object();
+  Value containers;
+  while (!s.done()) {
+    auto [f, w] = s.tag();
+    if (f == 2 && w == 2) {
+      if (!containers.is_array()) containers = Value::array();
+      containers.push_back(container_to_value(s.message()));
+    } else if (f == 10 && w == 2) {
+      out.set("nodeName", Value(std::string(s.bytes())));
+    } else s.skip(w);
+  }
+  if (containers.is_array()) out.set("containers", std::move(containers));
+  return out;
+}
+
+// PodStatus: phase=1, message=3, reason=4.
+Value pod_status_to_value(Reader s) {
+  Value out = Value::object();
+  while (!s.done()) {
+    auto [f, w] = s.tag();
+    if (f == 1 && w == 2) out.set("phase", Value(std::string(s.bytes())));
+    else if (f == 3 && w == 2) out.set("message", Value(std::string(s.bytes())));
+    else if (f == 4 && w == 2) out.set("reason", Value(std::string(s.bytes())));
+    else s.skip(w);
+  }
+  return out;
+}
+
+// meta/v1 Status (ERROR watch events): status=2, message=3, reason=4,
+// code=6.
+void scan_status(Reader s, int64_t* code, std::string* message) {
+  while (!s.done()) {
+    auto [f, w] = s.tag();
+    if (f == 3 && w == 2) *message = std::string(s.bytes());
+    else if (f == 6 && w == 0) *code = static_cast<int64_t>(s.varint());
+    else s.skip(w);
+  }
+}
+
+}  // namespace
+
+Value object_to_value(std::string_view bytes, const std::string& api_version,
+                      const std::string& kind) {
+  Value out = Value::object();
+  if (!api_version.empty()) out.set("apiVersion", Value(api_version));
+  if (!kind.empty()) out.set("kind", Value(kind));
+  Reader r{bytes, 0, 0};
+  while (!r.done()) {
+    auto [f, w] = r.tag();
+    if (f == 1 && w == 2) out.set("metadata", object_meta_to_value(r.message()));
+    else if (f == 2 && w == 2) out.set("spec", pod_spec_to_value(r.message()));
+    else if (f == 3 && w == 2) out.set("status", pod_status_to_value(r.message()));
+    else r.skip(w);
+  }
+  return out;
+}
+
+ListPagePtr parse_list(std::string body) {
+  auto page = std::make_shared<ListPage>();
+  page->body = std::move(body);
+  Envelope env = parse_unknown(page->body, 0);
+  // Envelope TypeMeta names the LIST type ("v1"/"PodList"); items are the
+  // element type. A list kind without the List suffix is malformed.
+  if (env.kind.size() <= 4 || env.kind.substr(env.kind.size() - 4) != "List") {
+    throw ParseError("proto: list envelope kind '" + env.kind + "' lacks List suffix", 0);
+  }
+  page->api_version = env.api_version;
+  page->kind = env.kind.substr(0, env.kind.size() - 4);
+  Reader list{env.raw, 0, env.raw_off};
+  while (!list.done()) {
+    auto [f, w] = list.tag();
+    if (f == 1 && w == 2) {
+      // ListMeta: selfLink=1, resourceVersion=2, continue=3.
+      Reader meta = list.message();
+      while (!meta.done()) {
+        auto [f2, w2] = meta.tag();
+        if (f2 == 2 && w2 == 2) page->resource_version = std::string(meta.bytes());
+        else if (f2 == 3 && w2 == 2) page->continue_token = std::string(meta.bytes());
+        else meta.skip(w2);
+      }
+    } else if (f == 2 && w == 2) {
+      std::string_view item = list.bytes();
+      ObjectRef ref;
+      // Offsets are relative to page->body (env.raw views into it).
+      ref.off = static_cast<size_t>(item.data() - page->body.data());
+      ref.len = item.size();
+      std::string rv_unused;
+      scan_object(Reader{item, 0, ref.off}, &ref.name, &ref.ns, &rv_unused);
+      ref.fp = fingerprint(item);
+      page->items.push_back(std::move(ref));
+    } else {
+      list.skip(w);
+    }
+  }
+  return page;
+}
+
+WatchEventPtr parse_watch_event(std::string frame) {
+  auto ev = std::make_shared<WatchEvent>();
+  ev->body = std::move(frame);
+  Envelope env = parse_unknown(ev->body, 0);
+  // env.raw is the meta/v1 WatchEvent message: type=1, object=2
+  // (RawExtension{raw=1} holding a nested Unknown-wrapped object).
+  Reader we{env.raw, 0, env.raw_off};
+  std::string_view raw_ext;
+  size_t raw_ext_off = 0;
+  while (!we.done()) {
+    auto [f, w] = we.tag();
+    if (f == 1 && w == 2) ev->type = std::string(we.bytes());
+    else if (f == 2 && w == 2) {
+      Reader re = we.message();
+      while (!re.done()) {
+        auto [f2, w2] = re.tag();
+        if (f2 == 1 && w2 == 2) {
+          size_t at = re.pos;
+          raw_ext = re.bytes();
+          raw_ext_off = re.base + at + (re.pos - at - raw_ext.size());
+        } else re.skip(w2);
+      }
+    } else we.skip(w);
+  }
+  if (!raw_ext.empty()) {
+    Envelope inner = parse_unknown(raw_ext, raw_ext_off);
+    ev->api_version = inner.api_version;
+    ev->kind = inner.kind;
+    ev->has_object = true;
+    ev->obj_off = static_cast<size_t>(inner.raw.data() - ev->body.data());
+    ev->obj_len = inner.raw.size();
+    std::string_view obj = inner.raw;
+    if (ev->type == "ERROR") {
+      scan_status(Reader{obj, 0, ev->obj_off}, &ev->error_code, &ev->error_message);
+    } else {
+      scan_object(Reader{obj, 0, ev->obj_off}, &ev->name, &ev->ns, &ev->resource_version);
+      ev->fp = fingerprint(obj);
+    }
+  }
+  return ev;
+}
+
+// ── Prometheus ──────────────────────────────────────────────────────────
+
+PromVector parse_prom_vector(std::string_view body) {
+  PromVector out;
+  Reader r{body, 0, 0};
+  while (!r.done()) {
+    auto [f, w] = r.tag();
+    if (f == 1 && w == 2) out.status = std::string(r.bytes());
+    else if (f == 2 && w == 2) out.error_type = std::string(r.bytes());
+    else if (f == 3 && w == 2) out.error = std::string(r.bytes());
+    else if (f == 4 && w == 2) {
+      Reader s = r.message();
+      PromSeries series;
+      while (!s.done()) {
+        auto [f2, w2] = s.tag();
+        if (f2 == 1 && w2 == 2) {
+          Reader l = s.message();
+          std::string name, value;
+          while (!l.done()) {
+            auto [f3, w3] = l.tag();
+            if (f3 == 1 && w3 == 2) name = std::string(l.bytes());
+            else if (f3 == 2 && w3 == 2) value = std::string(l.bytes());
+            else l.skip(w3);
+          }
+          series.labels.emplace_back(std::move(name), std::move(value));
+        } else if (f2 == 2 && w2 == 2) series.ts_text = std::string(s.bytes());
+        else if (f2 == 3 && w2 == 2) series.value_text = std::string(s.bytes());
+        else s.skip(w2);
+      }
+      out.result.push_back(std::move(series));
+    } else r.skip(w);
+  }
+  if (out.status.empty()) {
+    throw ParseError("proto: prometheus response carries no status field", body.size());
+  }
+  return out;
+}
+
+void python_json_escape(std::string& out, std::string_view s) {
+  // Mirrors CPython's json.dumps default (ensure_ascii=True): the two-char
+  // shortcuts, \uXXXX with lowercase hex for other control chars and ALL
+  // non-ASCII, surrogate pairs for non-BMP code points.
+  auto u16 = [&](unsigned cp) {
+    char buf[8];
+    std::snprintf(buf, sizeof(buf), "\\u%04x", cp & 0xFFFF);
+    out += buf;
+  };
+  size_t i = 0;
+  while (i < s.size()) {
+    unsigned char c = static_cast<unsigned char>(s[i]);
+    if (c == '"') { out += "\\\""; ++i; }
+    else if (c == '\\') { out += "\\\\"; ++i; }
+    else if (c == '\n') { out += "\\n"; ++i; }
+    else if (c == '\t') { out += "\\t"; ++i; }
+    else if (c == '\r') { out += "\\r"; ++i; }
+    else if (c == '\b') { out += "\\b"; ++i; }
+    else if (c == '\f') { out += "\\f"; ++i; }
+    else if (c < 0x20) { u16(c); ++i; }
+    else if (c < 0x80) { out.push_back(static_cast<char>(c)); ++i; }
+    else {
+      // Decode one UTF-8 sequence; invalid bytes degrade to U+FFFD the
+      // way a lenient re-encoder would (label values on this path are
+      // produced by our own fakes, so this is a never-taken safety net).
+      unsigned cp = 0xFFFD;
+      size_t n = 1;
+      if ((c & 0xE0) == 0xC0 && i + 1 < s.size()) {
+        cp = (c & 0x1F) << 6 | (s[i + 1] & 0x3F);
+        n = 2;
+      } else if ((c & 0xF0) == 0xE0 && i + 2 < s.size()) {
+        cp = (c & 0x0F) << 12 | (s[i + 1] & 0x3F) << 6 | (s[i + 2] & 0x3F);
+        n = 3;
+      } else if ((c & 0xF8) == 0xF0 && i + 3 < s.size()) {
+        cp = (c & 0x07) << 18 | (s[i + 1] & 0x3F) << 12 | (s[i + 2] & 0x3F) << 6 |
+             (s[i + 3] & 0x3F);
+        n = 4;
+      }
+      if (cp >= 0x10000) {
+        unsigned v = cp - 0x10000;
+        u16(0xD800 + (v >> 10));
+        u16(0xDC00 + (v & 0x3FF));
+      } else {
+        u16(cp);
+      }
+      i += n;
+    }
+  }
+}
+
+std::string prom_canonical_body(const PromVector& v) {
+  // Byte-faithful reconstruction of Python's json.dumps with DEFAULT
+  // separators (", " / ": ") over the dict shapes fake_prom (and a real
+  // Prometheus) builds, in their construction order.
+  std::string out;
+  out.reserve(64 + v.result.size() * 160);
+  if (v.status != "success") {
+    out += "{\"status\": \"";
+    python_json_escape(out, v.status);
+    out += "\", \"errorType\": \"";
+    python_json_escape(out, v.error_type);
+    out += "\", \"error\": \"";
+    python_json_escape(out, v.error);
+    out += "\"}";
+    return out;
+  }
+  out += "{\"status\": \"success\", \"data\": {\"resultType\": \"vector\", \"result\": [";
+  bool first_series = true;
+  for (const PromSeries& s : v.result) {
+    if (!first_series) out += ", ";
+    first_series = false;
+    out += "{\"metric\": {";
+    bool first_label = true;
+    for (const auto& [name, value] : s.labels) {
+      if (!first_label) out += ", ";
+      first_label = false;
+      out += '"';
+      python_json_escape(out, name);
+      out += "\": \"";
+      python_json_escape(out, value);
+      out += '"';
+    }
+    out += "}, \"value\": [";
+    out += s.ts_text;
+    out += ", \"";
+    python_json_escape(out, s.value_text);
+    out += "\"]}";
+  }
+  out += "]}}";
+  return out;
+}
+
+}  // namespace tpupruner::proto

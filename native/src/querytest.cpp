@@ -13,8 +13,11 @@
 #include <vector>
 
 #include "tpupruner/auth.hpp"
+#include "tpupruner/h2.hpp"
 #include "tpupruner/json.hpp"
 #include "tpupruner/prom.hpp"
+#include "tpupruner/proto.hpp"
+#include "tpupruner/util.hpp"
 
 namespace tpupruner::querytest {
 
@@ -148,6 +151,70 @@ int run(const std::string& promql, const std::string& url, const std::string& cs
   }
   std::printf("wrote %zu rows to %s\n", rows.size(), csv_path.c_str());
   return 0;
+}
+
+int run_wire(const std::string& promql, const std::string& url, const std::string& wire) {
+  if (wire != "proto" && wire != "json") {
+    std::fprintf(stderr, "querytest: --wire must be proto or json (got '%s')\n", wire.c_str());
+    return 2;
+  }
+  auth::TokenOptions topts;
+  std::string token = auth::get_bearer_token(topts).value_or("");
+
+  std::string base = url;
+  while (!base.empty() && base.back() == '/') base.pop_back();
+  h2::Transport http(h2::default_mode());
+  http::Request req;
+  req.method = "POST";
+  req.url = base + "/api/v1/query";
+  req.headers.push_back({"Content-Type", "application/x-www-form-urlencoded"});
+  req.headers.push_back({"Accept", wire == "proto" ? std::string(proto::kPromProtoAccept)
+                                                   : std::string("application/json")});
+  if (!token.empty()) req.headers.push_back({"Authorization", "Bearer " + token});
+  req.body = "query=" + util::url_encode(promql);
+
+  http::Response resp;
+  try {
+    resp = http.request(req);
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "querytest: %s\n", e.what());
+    return 1;
+  }
+  std::string content_type = "unknown";
+  if (auto it = resp.headers.find("content-type"); it != resp.headers.end()) {
+    content_type = it->second;
+  }
+  std::printf("HTTP %d  content-type: %s  (%zu bytes, asked for %s)\n", resp.status,
+              content_type.c_str(), resp.body.size(), wire.c_str());
+  if (wire == "proto" && !proto::is_prom_proto(content_type)) {
+    std::printf("note: server answered JSON — the negotiation-fallback path "
+                "(--wire auto would now stop asking this endpoint)\n");
+  }
+
+  // Classic offset | hex | ascii dump, capped so a multi-megabyte matrix
+  // doesn't flood the terminal.
+  constexpr size_t kDumpCap = 4096;
+  const size_t n = std::min(resp.body.size(), kDumpCap);
+  for (size_t off = 0; off < n; off += 16) {
+    std::printf("%08zx ", off);
+    for (size_t i = 0; i < 16; ++i) {
+      if (i == 8) std::printf(" ");
+      if (off + i < n)
+        std::printf(" %02x", static_cast<unsigned char>(resp.body[off + i]));
+      else
+        std::printf("   ");
+    }
+    std::printf("  |");
+    for (size_t i = 0; i < 16 && off + i < n; ++i) {
+      unsigned char c = static_cast<unsigned char>(resp.body[off + i]);
+      std::printf("%c", (c >= 0x20 && c < 0x7F) ? c : '.');
+    }
+    std::printf("|\n");
+  }
+  if (resp.body.size() > kDumpCap) {
+    std::printf("... (%zu more bytes)\n", resp.body.size() - kDumpCap);
+  }
+  return (resp.status >= 200 && resp.status < 300) ? 0 : 1;
 }
 
 }  // namespace tpupruner::querytest

@@ -8,4 +8,11 @@ namespace tpupruner::querytest {
 int run(const std::string& promql, const std::string& url,
         const std::string& csv_path = "output.csv");
 
+// `querytest --wire proto|json <promql> <url>`: fetch ONE raw instant-query
+// response in the requested content type (proto = the same
+// application/x-protobuf negotiation the daemon's --wire proto uses) and
+// hex-dump it with the negotiated Content-Type — the debugging tool for
+// wire negotiation against real endpoints. Returns exit code.
+int run_wire(const std::string& promql, const std::string& url, const std::string& wire);
+
 }  // namespace tpupruner::querytest

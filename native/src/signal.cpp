@@ -190,6 +190,46 @@ Assessment assess(const json::Doc& evidence_response,
   return derive(std::move(by_pod), candidates, cfg, cycle);
 }
 
+Assessment assess(const proto::PromVector& evidence_response,
+                  const std::vector<core::PodMetricSample>& candidates, const Config& cfg,
+                  uint64_t cycle) {
+  if (evidence_response.status != "success") {
+    throw std::runtime_error(
+        "evidence query failed: " +
+        (evidence_response.error.empty() ? "unknown error" : evidence_response.error));
+  }
+  std::map<std::string, Stats> by_pod;
+  for (const proto::PromSeries& series : evidence_response.result) {
+    auto label_of = [&](std::string_view exported,
+                        std::string_view native) -> const std::string* {
+      const std::string* native_hit = nullptr;
+      for (const auto& [name, value] : series.labels) {
+        if (name == exported) return &value;
+        if (!native_hit && name == native) native_hit = &value;
+      }
+      return native_hit;
+    };
+    const std::string* pod = label_of("exported_pod", "pod");
+    const std::string* ns = label_of("exported_namespace", "namespace");
+    if (!pod || !ns) continue;
+    std::string stat;
+    for (const auto& [name, value] : series.labels) {
+      if (name == "signal_stat") {
+        stat = value;
+        break;
+      }
+    }
+    double x = 0;
+    try {
+      x = std::stod(series.value_text);
+    } catch (const std::exception&) {
+      continue;
+    }
+    fold_row(by_pod, *ns + "/" + *pod, stat, x);
+  }
+  return derive(std::move(by_pod), candidates, cfg, cycle);
+}
+
 namespace {
 
 Assessment derive(std::map<std::string, Stats>&& by_pod,

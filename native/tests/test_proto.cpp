@@ -1,0 +1,336 @@
+// Binary wire protocol units (native/src/proto.cpp): the hand-rolled
+// varint/length-delimited decoder for the runtime.Unknown envelope, the
+// Pod-subset schema, the watch-frame scan, and the Prometheus exposition
+// — plus the truncation/byte-flip sweeps (the fuzzer-invariant pattern:
+// decode either succeeds or throws a typed ParseError, never crashes;
+// `just asan-proto` runs this file under AddressSanitizer) and the fused
+// decode → journal_touch → store-upsert path under concurrency (`just
+// tsan-wire` runs it under ThreadSanitizer).
+#include "testing.hpp"
+
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpupruner/informer.hpp"
+#include "tpupruner/json.hpp"
+#include "tpupruner/k8s.hpp"
+#include "tpupruner/metrics.hpp"
+#include "tpupruner/proto.hpp"
+
+namespace proto = tpupruner::proto;
+namespace informer = tpupruner::informer;
+namespace k8s = tpupruner::k8s;
+using tpupruner::json::ParseError;
+using tpupruner::json::Value;
+
+namespace {
+
+// ── tiny encoder (the C++ twin of tpu_pruner/testing/wire_proto.py) ──
+
+std::string enc_varint(uint64_t n) {
+  std::string out;
+  while (true) {
+    uint8_t b = n & 0x7F;
+    n >>= 7;
+    if (n) out.push_back(static_cast<char>(b | 0x80));
+    else {
+      out.push_back(static_cast<char>(b));
+      return out;
+    }
+  }
+}
+
+std::string enc_tag(uint32_t field, uint32_t wt) { return enc_varint((field << 3) | wt); }
+
+std::string enc_ld(uint32_t field, const std::string& data) {
+  return enc_tag(field, 2) + enc_varint(data.size()) + data;
+}
+
+std::string enc_str(uint32_t field, const std::string& s) { return enc_ld(field, s); }
+
+std::string enc_unknown(const std::string& api_version, const std::string& kind,
+                        const std::string& raw) {
+  std::string tm;
+  if (!api_version.empty()) tm += enc_str(1, api_version);
+  if (!kind.empty()) tm += enc_str(2, kind);
+  return std::string("k8s\x00", 4) + enc_ld(1, tm) + enc_ld(2, raw);
+}
+
+// metadata {name, namespace, uid, resourceVersion, labels{app:demo},
+// ownerReferences[{kind,name,uid,apiVersion,controller}]}, spec
+// {containers[{name, resources{requests/limits google.com/tpu=4}}]},
+// status {phase Running}.
+std::string enc_demo_pod() {
+  std::string meta = enc_str(1, "pod-0") + enc_str(3, "ml") + enc_str(5, "uid-0") +
+                     enc_str(6, "41");
+  meta += enc_ld(11, enc_str(1, "app") + enc_str(2, "demo"));
+  std::string owner = enc_str(1, "ReplicaSet") + enc_str(3, "rs-0") + enc_str(4, "uid-rs") +
+                      enc_str(5, "apps/v1") + enc_tag(6, 0) + enc_varint(1);
+  meta += enc_ld(13, owner);
+  std::string quantity = enc_ld(2, enc_str(1, "4"));
+  std::string requests = enc_ld(2, enc_str(1, "google.com/tpu") + quantity);
+  std::string limits = enc_ld(1, enc_str(1, "google.com/tpu") + quantity);
+  std::string container = enc_str(1, "main") + enc_ld(8, limits + requests);
+  std::string spec = enc_ld(2, container);
+  std::string status = enc_str(1, "Running");
+  return enc_ld(1, meta) + enc_ld(2, spec) + enc_ld(3, status);
+}
+
+std::string enc_demo_list() {
+  std::string list_meta = enc_str(2, "41");  // resourceVersion
+  return enc_unknown("v1", "PodList", enc_ld(1, list_meta) + enc_ld(2, enc_demo_pod()));
+}
+
+std::string enc_watch_frame(const std::string& type) {
+  std::string inner = enc_unknown("v1", "Pod", enc_demo_pod());
+  std::string we = enc_str(1, type) + enc_ld(2, enc_ld(1, inner));
+  return enc_unknown("v1", "WatchEvent", we);
+}
+
+}  // namespace
+
+// ── decode correctness ──────────────────────────────────────────────────
+
+TP_TEST(proto_pod_materializes_like_its_json_form) {
+  Value pod = proto::object_to_value(enc_demo_pod(), "v1", "Pod");
+  Value expect = Value::parse(R"({
+    "apiVersion": "v1", "kind": "Pod",
+    "metadata": {"name": "pod-0", "namespace": "ml", "uid": "uid-0",
+                 "resourceVersion": "41", "labels": {"app": "demo"},
+                 "ownerReferences": [{"apiVersion": "apps/v1", "kind": "ReplicaSet",
+                                      "name": "rs-0", "uid": "uid-rs",
+                                      "controller": true}]},
+    "spec": {"containers": [{"name": "main",
+              "resources": {"limits": {"google.com/tpu": "4"},
+                            "requests": {"google.com/tpu": "4"}}}]},
+    "status": {"phase": "Running"}})");
+  TP_CHECK_EQ(pod.dump(), expect.dump());
+  // the chip accounting reads straight through the materialized form
+  TP_CHECK_EQ(tpupruner::core::pod_chip_count(pod), int64_t{4});
+}
+
+TP_TEST(proto_list_scan_extracts_keys_in_one_pass) {
+  proto::ListPagePtr page = proto::parse_list(enc_demo_list());
+  TP_CHECK_EQ(page->api_version, std::string("v1"));
+  TP_CHECK_EQ(page->kind, std::string("Pod"));
+  TP_CHECK_EQ(page->resource_version, std::string("41"));
+  TP_CHECK_EQ(page->items.size(), size_t{1});
+  const proto::ObjectRef& ref = page->items[0];
+  TP_CHECK_EQ(ref.ns, std::string("ml"));
+  TP_CHECK_EQ(ref.name, std::string("pod-0"));
+  TP_CHECK_EQ(ref.fp, proto::fingerprint(enc_demo_pod()));
+  Value pod = proto::object_to_value(
+      std::string_view(page->body.data() + ref.off, ref.len), page->api_version, page->kind);
+  TP_CHECK_EQ(pod.at_path("metadata.name")->as_string(), std::string("pod-0"));
+}
+
+TP_TEST(proto_watch_frame_single_scan) {
+  proto::WatchEventPtr ev = proto::parse_watch_event(enc_watch_frame("MODIFIED"));
+  TP_CHECK_EQ(ev->type, std::string("MODIFIED"));
+  TP_CHECK(ev->has_object);
+  TP_CHECK_EQ(ev->ns, std::string("ml"));
+  TP_CHECK_EQ(ev->name, std::string("pod-0"));
+  TP_CHECK_EQ(ev->resource_version, std::string("41"));
+  TP_CHECK_EQ(ev->fp, proto::fingerprint(enc_demo_pod()));
+  Value pod = proto::object_to_value(
+      std::string_view(ev->body.data() + ev->obj_off, ev->obj_len), ev->api_version, ev->kind);
+  TP_CHECK_EQ(pod.at_path("metadata.namespace")->as_string(), std::string("ml"));
+}
+
+TP_TEST(proto_error_event_carries_status_code) {
+  std::string status = enc_str(3, "too old resource version") + enc_tag(6, 0) + enc_varint(410);
+  std::string inner = enc_unknown("v1", "Status", status);
+  std::string we = enc_str(1, "ERROR") + enc_ld(2, enc_ld(1, inner));
+  proto::WatchEventPtr ev = proto::parse_watch_event(enc_unknown("v1", "WatchEvent", we));
+  TP_CHECK_EQ(ev->type, std::string("ERROR"));
+  TP_CHECK_EQ(ev->error_code, int64_t{410});
+  TP_CHECK_EQ(ev->error_message, std::string("too old resource version"));
+}
+
+TP_TEST(proto_rejects_missing_magic_and_bad_list_kind) {
+  bool threw = false;
+  try {
+    proto::parse_list("xyz" + enc_demo_list());
+  } catch (const ParseError&) {
+    threw = true;
+  }
+  TP_CHECK(threw);
+  threw = false;
+  try {
+    proto::parse_list(enc_unknown("v1", "Pod", enc_demo_pod()));  // not a *List
+  } catch (const ParseError&) {
+    threw = true;
+  }
+  TP_CHECK(threw);
+}
+
+// ── truncation / byte-flip sweeps (fuzzer-invariant pattern) ────────────
+
+namespace {
+
+// Decode must either succeed or throw ParseError; anything else —
+// another exception type, a crash, an OOB read (ASan) — fails.
+template <typename Fn>
+void sweep(const std::string& body, Fn&& decode) {
+  for (size_t cut = 0; cut <= body.size(); ++cut) {
+    try {
+      decode(body.substr(0, cut));
+    } catch (const ParseError&) {
+    }
+  }
+  for (size_t i = 0; i < body.size(); ++i) {
+    std::string mutated = body;
+    mutated[i] = static_cast<char>(mutated[i] ^ 0xFF);
+    try {
+      decode(mutated);
+    } catch (const ParseError&) {
+    }
+  }
+}
+
+}  // namespace
+
+TP_TEST(proto_truncation_and_byteflip_sweep_list) {
+  sweep(enc_demo_list(), [](const std::string& b) { proto::parse_list(b); });
+}
+
+TP_TEST(proto_truncation_and_byteflip_sweep_watch) {
+  sweep(enc_watch_frame("ADDED"), [](const std::string& b) { proto::parse_watch_event(b); });
+}
+
+TP_TEST(proto_truncation_and_byteflip_sweep_prom) {
+  std::string series = enc_ld(1, enc_str(1, "exported_pod") + enc_str(2, "pod-0")) +
+                       enc_ld(1, enc_str(1, "exported_namespace") + enc_str(2, "ml")) +
+                       enc_ld(1, enc_str(1, "exported_container") + enc_str(2, "main")) +
+                       enc_str(2, "1754300000.25") + enc_str(3, "0.0");
+  std::string body = enc_str(1, "success") + enc_ld(4, series);
+  sweep(body, [](const std::string& b) { proto::parse_prom_vector(b); });
+  // and the full body must actually decode
+  proto::PromVector v = proto::parse_prom_vector(body);
+  TP_CHECK_EQ(v.result.size(), size_t{1});
+  auto decoded = tpupruner::metrics::decode_instant_vector(v, "tpu", "gmp");
+  TP_CHECK_EQ(decoded.samples.size(), size_t{1});
+  TP_CHECK_EQ(decoded.samples[0].name, std::string("pod-0"));
+}
+
+// ── canonical body reconstruction (python json.dumps fidelity) ──────────
+
+TP_TEST(proto_prom_canonical_body_matches_python_dumps) {
+  proto::PromVector v;
+  v.status = "success";
+  proto::PromSeries s;
+  s.labels = {{"exported_pod", "pod-0"}, {"exported_namespace", "ml"}};
+  s.ts_text = "1754300000.25";
+  s.value_text = "0.0";
+  v.result.push_back(s);
+  TP_CHECK_EQ(proto::prom_canonical_body(v),
+              std::string("{\"status\": \"success\", \"data\": {\"resultType\": \"vector\", "
+                          "\"result\": [{\"metric\": {\"exported_pod\": \"pod-0\", "
+                          "\"exported_namespace\": \"ml\"}, \"value\": [1754300000.25, "
+                          "\"0.0\"]}]}}"));
+  proto::PromVector empty;
+  empty.status = "success";
+  TP_CHECK_EQ(proto::prom_canonical_body(empty),
+              std::string("{\"status\": \"success\", \"data\": {\"resultType\": \"vector\", "
+                          "\"result\": []}}"));
+}
+
+TP_TEST(proto_python_json_escape_matches_ensure_ascii) {
+  auto esc = [](std::string_view in) {
+    std::string out;
+    proto::python_json_escape(out, in);
+    return out;
+  };
+  TP_CHECK_EQ(esc("plain"), std::string("plain"));
+  TP_CHECK_EQ(esc("a\"b\\c"), std::string("a\\\"b\\\\c"));
+  TP_CHECK_EQ(esc("\n\t\r\b\f"), std::string("\\n\\t\\r\\b\\f"));
+  TP_CHECK_EQ(esc(std::string("\x01", 1)), std::string("\\u0001"));
+  TP_CHECK_EQ(esc("caf\xc3\xa9"), std::string("caf\\u00e9"));          // é
+  TP_CHECK_EQ(esc("\xf0\x9f\x98\x80"), std::string("\\ud83d\\ude00"));  // 😀 pair
+}
+
+// ── the fused path: decode → fingerprint → journal_touch → upsert ──────
+
+namespace {
+
+const k8s::Client& offline_client() {
+  static k8s::Client client = [] {
+    k8s::Config cfg;
+    cfg.api_url = "http://127.0.0.1:1";  // never dialed by apply_* units
+    return k8s::Client(std::move(cfg));
+  }();
+  return client;
+}
+
+}  // namespace
+
+TP_TEST(proto_fused_apply_journals_and_stores_without_materializing) {
+  informer::Reflector r(offline_client(), *informer::spec_for("pods"));
+  r.enable_dirty_journal();
+  proto::WatchEventPtr ev = proto::parse_watch_event(enc_watch_frame("ADDED"));
+  TP_CHECK(r.apply_event_proto(ev));
+  const std::string path = "/api/v1/namespaces/ml/pods/pod-0";
+  std::vector<std::string> dirty;
+  bool all = false;
+  r.drain_dirty(dirty, all);
+  TP_CHECK(!all);
+  TP_CHECK_EQ(dirty.size(), size_t{1});
+  TP_CHECK_EQ(dirty[0], path);
+  // the store answers with the materialized twin of the JSON form
+  auto got = r.get(path);
+  TP_CHECK(got.has_value());
+  TP_CHECK_EQ(got->at_path("metadata.resourceVersion")->as_string(), std::string("41"));
+  TP_CHECK_EQ(tpupruner::core::pod_chip_count(*got), int64_t{4});
+  // DELETED erases and journals again
+  proto::WatchEventPtr del = proto::parse_watch_event(enc_watch_frame("DELETED"));
+  TP_CHECK(r.apply_event_proto(del));
+  dirty.clear();
+  r.drain_dirty(dirty, all);
+  TP_CHECK_EQ(dirty.size(), size_t{1});
+  TP_CHECK(!r.get(path).has_value());
+}
+
+TP_TEST(proto_fused_store_keeps_fingerprint_until_materialized) {
+  informer::Store store;
+  std::string pod_bytes = enc_demo_pod();
+  auto body = std::make_shared<const std::string>(pod_bytes);
+  uint64_t fp = proto::fingerprint(pod_bytes);
+  store.upsert_proto("/api/v1/namespaces/ml/pods/pod-0", body, 0, body->size(), "v1", "Pod",
+                     fp);
+  TP_CHECK_EQ(store.proto_fingerprint("/api/v1/namespaces/ml/pods/pod-0"), fp);
+  TP_CHECK(store.get("/api/v1/namespaces/ml/pods/pod-0").has_value());
+}
+
+TP_TEST(proto_fused_journal_concurrent_apply_and_drain_is_race_free) {
+  // The TSan target (`just tsan-wire`): reflector threads apply fused
+  // events while the producer drains the journal — exactly the
+  // concurrency the incremental engine rides every warm cycle.
+  informer::Reflector r(offline_client(), *informer::spec_for("pods"));
+  r.enable_dirty_journal();
+  std::thread applier([&] {
+    for (int i = 0; i < 500; ++i) {
+      proto::WatchEventPtr ev =
+          proto::parse_watch_event(enc_watch_frame(i % 2 ? "MODIFIED" : "ADDED"));
+      r.apply_event_proto(ev);
+    }
+  });
+  std::thread reader([&] {
+    for (int i = 0; i < 200; ++i) {
+      r.get("/api/v1/namespaces/ml/pods/pod-0");
+    }
+  });
+  size_t drained = 0;
+  bool all = false;
+  for (int i = 0; i < 200; ++i) {
+    std::vector<std::string> dirty;
+    r.drain_dirty(dirty, all);
+    drained += dirty.size();
+  }
+  applier.join();
+  reader.join();
+  std::vector<std::string> dirty;
+  r.drain_dirty(dirty, all);
+  drained += dirty.size();
+  TP_CHECK_EQ(drained, size_t{500});
+}

@@ -126,6 +126,50 @@ def test_transport_surfaces_documented(built):
         f"shared-transport surfaces missing from docs/OPERATIONS.md: {missing}")
 
 
+def test_wire_surfaces_documented(built):
+    """The binary-wire families come from the native canonical list
+    (proto::wire_metric_families via capi) so a counter added to
+    proto.cpp without a runbook row fails even though the families
+    render zeros on a --wire json daemon. The flag, the querytest
+    debugging tool and the sanitizer recipes ride the same guard."""
+    doc = OPERATIONS.read_text()
+    families = native.wire_metric_families()
+    assert len(families) >= 4
+    missing = [f for f in families if f not in doc]
+    assert not missing, (
+        f"wire metric families missing from docs/OPERATIONS.md: {missing} "
+        "— document each in the Observability table and the 'Wire "
+        "protocol' section")
+    needles = ("--wire", "Wire protocol",
+               "application/vnd.kubernetes.protobuf",
+               "querytest --wire", "asan-proto", "tsan-wire")
+    missing = [n for n in needles if n not in doc]
+    assert not missing, (
+        f"wire-protocol surfaces missing from docs/OPERATIONS.md: {missing}")
+
+
+def test_wire_bench_fields_documented():
+    """Every mega_wire_* bench field must be in BENCH_FIELDS.md AND
+    actually emitted by bench.py — drift on either side fails."""
+    bench_src = (REPO / "bench.py").read_text()
+    fields_doc = (REPO / "docs" / "BENCH_FIELDS.md").read_text()
+    for field in ("mega_wire_wall_pods",
+                  "mega_wire_cold_list_decode_s_json",
+                  "mega_wire_cold_list_decode_s_proto"):
+        assert f'"{field}"' in bench_src, f"bench.py no longer emits {field}"
+        assert field in fields_doc, (
+            f"bench field {field} missing from docs/BENCH_FIELDS.md")
+    # the per-wire-mode phase fields are emitted via f-strings — pin the
+    # stem in bench.py and both concrete names in the docs
+    for stem in ("mega_wire_decode_p50_ms_",
+                 "mega_wire_query_decode_p50_ms_",
+                 "mega_wire_cache_merge_p50_ms_"):
+        assert stem in bench_src, f"bench.py no longer emits {stem}*"
+        for mode in ("json", "proto"):
+            assert stem + mode in fields_doc, (
+                f"bench field {stem}{mode} missing from docs/BENCH_FIELDS.md")
+
+
 def test_incremental_surfaces_documented(built):
     """The differential-reconcile families come from the native canonical
     list (incremental::metric_families) so a gauge added to

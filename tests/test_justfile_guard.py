@@ -189,6 +189,47 @@ def test_asan_json_recipe_present_and_wired():
         "would no longer exercise the zero-copy decoder on mutated bytes")
 
 
+def test_asan_proto_recipe_present_and_wired():
+    """`just asan-proto` must exist and run the binary-wire decoder units
+    — including their truncation/byte-flip sweeps — under
+    AddressSanitizer: hand-rolled varint/length-delimited scanning over
+    untrusted bytes is exactly the code whose out-of-bounds reads ASan
+    catches and plain asserts don't."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^asan-proto\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `asan-proto:` recipe"
+    body = m.group(1)
+    assert "-DTP_SANITIZE=ON" in body, "asan-proto no longer builds with ASan"
+    assert re.search(r"tpupruner_tests\s+proto", body), (
+        "asan-proto no longer runs the native proto tests")
+    src = (REPO / "native" / "tests" / "test_proto.cpp").read_text()
+    assert "sweep" in src and "ParseError" in src, (
+        "test_proto.cpp lost its truncation/byte-flip parity sweep — "
+        "asan-proto would no longer exercise the decoder on mutated bytes")
+
+
+def test_tsan_wire_recipe_present_and_wired():
+    """`just tsan-wire` must exist and run the fused decode → dirty
+    journal path plus the informer machinery under ThreadSanitizer —
+    reflector threads apply proto frames while the producer drains the
+    journal, exactly the concurrency the incremental engine rides."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^tsan-wire\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `tsan-wire:` recipe"
+    body = m.group(1)
+    assert "-DTP_TSAN=ON" in body, "tsan-wire no longer builds with TSan"
+    assert re.search(r"tpupruner_tests\s+proto", body), (
+        "tsan-wire no longer runs the native proto tests")
+    assert re.search(r"tpupruner_tests\s+informer", body), (
+        "tsan-wire no longer runs the native informer tests")
+    src = (REPO / "native" / "tests" / "test_proto.cpp").read_text()
+    assert "apply_event_proto" in src and "drain_dirty" in src, (
+        "test_proto.cpp lost its fused-journal concurrency test — "
+        "tsan-wire would vacuously pass")
+
+
 def test_just_verify_matches_roadmap_tier1():
     roadmap = roadmap_tier1_command()
     justfile = justfile_verify_command()

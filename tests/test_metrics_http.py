@@ -192,6 +192,72 @@ def test_readyz_distinct_from_healthz(daemon):
     assert (status, body) == (200, "ok\n")
 
 
+def test_wire_families_served_and_count_decoded_bytes(built, fake_prom,
+                                                      fake_k8s):
+    """The tpu_pruner_wire_* families (ISSUE 11): every canonical family
+    name is served, the selected --wire mode shows as the mode gauge, a
+    proto run counts protobuf bytes at both endpoints, and the fused
+    watch-event counter advances once events ride the binary wire."""
+    from tpu_pruner import native as _native
+
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=2)
+    for pod in pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml")
+    d = MetricsDaemon(fake_prom, fake_k8s, "--wire", "proto",
+                      "--watch-cache", "on",
+                      env_extra={"KUBE_TOKEN": "t", "PROMETHEUS_TOKEN": "t"})
+    try:
+        body = d.wait_for_cycle()
+        for family in _native.wire_metric_families():
+            assert family in body, f"{family} missing from /metrics"
+        # every sample line carries the fleet cluster label — match around it
+        assert re.search(r'tpu_pruner_wire_mode\{[^}]*mode="proto"[^}]*\} 1', body)
+        assert re.search(r'tpu_pruner_wire_bytes_decoded_total\{[^}]*endpoint="k8s"'
+                         r'[^}]*content_type="protobuf"[^}]*\} [1-9]', body), body[-2000:]
+        assert re.search(r'tpu_pruner_wire_bytes_decoded_total\{[^}]*endpoint="prom"'
+                         r'[^}]*content_type="protobuf"[^}]*\} [1-9]', body)
+        assert re.search(r'tpu_pruner_wire_negotiation_fallbacks_total(\{[^}]*\})? 0\b',
+                         body)
+        # churn one pod so a fused watch event lands, then the counter
+        # must go non-zero
+        fake_k8s.add_pod("ml", "churn-pod")
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            _, _, body = d.get("/metrics")
+            if re.search(r'tpu_pruner_wire_fused_decode_events_total(\{[^}]*\})? [1-9]',
+                         body):
+                break
+            time.sleep(0.2)
+        assert re.search(r'tpu_pruner_wire_fused_decode_events_total(\{[^}]*\})? [1-9]',
+                         body), "fused-decode counter never advanced"
+    finally:
+        d.stop()
+
+
+def test_wire_fallbacks_counted_against_json_only_server(built, fake_prom,
+                                                         fake_k8s):
+    """A JSON-only backend answering a --wire proto daemon advances the
+    negotiation-fallback counter and the json byte counters — visible
+    evidence the binary wire was refused, not silently skipped."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer", num_pods=1)
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    fake_k8s.serve_protobuf = False
+    fake_prom.serve_protobuf = False
+    d = MetricsDaemon(fake_prom, fake_k8s, "--wire", "proto",
+                      "--watch-cache", "on",
+                      env_extra={"KUBE_TOKEN": "t", "PROMETHEUS_TOKEN": "t"})
+    try:
+        body = d.wait_for_cycle()
+        assert re.search(r'tpu_pruner_wire_negotiation_fallbacks_total'
+                         r'(\{[^}]*\})? [1-9]', body)
+        assert re.search(r'tpu_pruner_wire_bytes_decoded_total\{[^}]*endpoint="prom"'
+                         r'[^}]*content_type="json"[^}]*\} [1-9]', body)
+        assert re.search(r'tpu_pruner_wire_bytes_decoded_total\{[^}]*endpoint="k8s"'
+                         r'[^}]*content_type="protobuf"[^}]*\} 0\b', body)
+    finally:
+        d.stop()
+
+
 def test_informer_families_omitted_when_watch_cache_off(daemon):
     """With --watch-cache off there is no informer: serving its gauges
     anyway (as 0/garbage) would read as "synced: no, stale forever" on a

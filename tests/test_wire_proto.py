@@ -1,0 +1,465 @@
+"""Binary wire protocol tests (the ISSUE 11 perf tentpole).
+
+The daemon's two hot conversations — the informer's pods list+watch and
+the Prometheus instant-query pair — can negotiate a binary wire format
+behind `--wire proto|json|auto` (native/src/proto.cpp: a hand-rolled
+varint/length-delimited decoder for the runtime.Unknown envelope, the
+Pod-subset schema, and a Prometheus instant-vector exposition), with
+watch-event decode FUSED into the incremental engine's dirty journal.
+Pinned here, end to end against the fakes' own wire accounting:
+
+  - negotiation actually happens: a `--wire proto` watch-cache run is
+    served protobuf LISTs, protobuf watch frames, and protobuf query
+    responses by the fakes;
+  - `--wire json` and `--wire proto` are byte-identical on normalized
+    audit JSONL, flight capsules and ledger checkpoints — at shards 1
+    and 8, with --incremental on and off — and proto-recorded capsules
+    replay bit-for-bit through `analyze --replay` (the capsule stores
+    the canonical JSON body, wire-format independent);
+  - a JSON-only server (fake with serve_protobuf=False) degrades
+    transparently: the run succeeds with identical decisions and the
+    negotiation-fallback counter advances;
+  - decode parity corpus: recorded LIST/watch/Prometheus bodies decoded
+    through the proto path yield IDENTICAL objects, store keys, samples
+    and canonical bodies as the JSON path on the same logical data;
+  - truncation/garbage sweep: every prefix and byte-flip mutation of a
+    real proto body either decodes or raises a clean ParseError — never
+    a crash (the fuzzer-invariant pattern; `just asan-proto` runs the
+    native twin under ASan).
+"""
+
+import json
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+from urllib.parse import quote
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus, wire_proto
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def daemon_env(fake_k8s):
+    # Static tokens: no metadata-server probing — the fakes see only the
+    # daemon's real traffic, so the proto counters are exact.
+    return {"KUBE_API_URL": fake_k8s.url, "KUBE_TOKEN": "t",
+            "PROMETHEUS_TOKEN": "t", "PATH": "/usr/bin:/bin"}
+
+
+def run_daemon(fake_prom, fake_k8s, *extra, run_mode="scale-down", cycles=2):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", run_mode, "--daemon-mode", "--check-interval", "1",
+           "--max-cycles", str(cycles), "--watch-cache", "on", *extra]
+    proc = subprocess.run(cmd, env=daemon_env(fake_k8s),
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc
+
+
+def idle_cluster(fake_prom, fake_k8s, n=3, ns="ml"):
+    for i in range(n):
+        _, _, pods = fake_k8s.add_deployment_chain(ns, f"dep-{i}",
+                                                   num_pods=1, tpu_chips=4)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], ns, chips=4)
+
+
+def mixed_cluster(fake_prom, fake_k8s):
+    """Deployments, a full idle JobSet slice (group gate), an annotated
+    pod (root veto), an orphan, and a ghost series — every decision path
+    the byte-identity matrix must reproduce across wire modes."""
+    idle_cluster(fake_prom, fake_k8s, n=3)
+    _, slice_pods = fake_k8s.add_jobset_slice("tpu-jobs", "slice-0",
+                                              num_hosts=4, tpu_chips=4)
+    for pod in slice_pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "tpu-jobs",
+                                      chips=4)
+    _, _, vetoed = fake_k8s.add_deployment_chain("ml", "protected",
+                                                 num_pods=1, tpu_chips=4)
+    vetoed[0]["metadata"]["annotations"] = {"tpu-pruner.dev/skip": "true"}
+    fake_prom.add_idle_pod_series(vetoed[0]["metadata"]["name"], "ml")
+    fake_k8s.add_pod("ml", "orphan",
+                     owners=[fake_k8s.owner("DaemonSet", "ds-x")])
+    fake_prom.add_idle_pod_series("orphan", "ml")
+    fake_prom.add_idle_pod_series("ghost", "ml")
+
+
+# ── negotiation happens end to end ─────────────────────────────────────
+
+
+def test_wire_proto_negotiated_end_to_end(built, fake_prom, fake_k8s):
+    """A `--wire proto` run actually RIDES the binary wire: the fakes
+    served protobuf LIST pages, protobuf watch frames and protobuf query
+    responses, and the daemon still scaled the idle roots down."""
+    idle_cluster(fake_prom, fake_k8s)
+    proc = run_daemon(fake_prom, fake_k8s, "--wire", "proto",
+                      "--signal-guard", "on")
+    assert "wire proto" in proc.stderr
+    assert fake_k8s.proto_lists >= 1, "pods LIST was never served as protobuf"
+    assert fake_k8s.proto_watch_frames >= 1, (
+        "no watch frame was served as protobuf")
+    # idleness + evidence per cycle, 2 cycles
+    assert fake_prom.proto_queries >= 4, fake_prom.proto_queries
+    assert len(fake_k8s.scale_patches()) == 3, fake_k8s.scale_patches()
+
+
+def test_wire_json_never_asks_for_protobuf(built, fake_prom, fake_k8s):
+    """--wire json (the default) must not even negotiate: zero protobuf
+    responses, byte-for-byte the pre-wire daemon."""
+    idle_cluster(fake_prom, fake_k8s, n=1)
+    run_daemon(fake_prom, fake_k8s, run_mode="dry-run")
+    assert fake_k8s.proto_lists == 0
+    assert fake_k8s.proto_watch_frames == 0
+    assert fake_prom.proto_queries == 0
+
+
+def test_wire_proto_falls_back_on_json_only_servers(built, fake_prom,
+                                                    fake_k8s):
+    """A JSON-only apiserver/Prometheus (serve_protobuf=False) answers a
+    proto-accepting request with JSON; the daemon must decode it and
+    decide identically — the negotiation-fallback path, not an error."""
+    idle_cluster(fake_prom, fake_k8s)
+    fake_k8s.serve_protobuf = False
+    fake_prom.serve_protobuf = False
+    run_daemon(fake_prom, fake_k8s, "--wire", "proto")
+    assert fake_k8s.proto_lists == 0
+    assert fake_prom.proto_queries == 0
+    assert len(fake_k8s.scale_patches()) == 3
+
+
+def test_wire_auto_negotiates_when_server_speaks_proto(built, fake_prom,
+                                                       fake_k8s):
+    """--wire auto against protobuf-capable servers rides the binary
+    wire like proto does; against JSON-only servers it remembers the
+    refusal (sticky per-process fallback) and still decides identically."""
+    idle_cluster(fake_prom, fake_k8s)
+    run_daemon(fake_prom, fake_k8s, "--wire", "auto", run_mode="dry-run")
+    assert fake_k8s.proto_lists >= 1
+    assert fake_prom.proto_queries >= 1
+
+
+def test_wire_proto_without_watch_cache_still_covers_prometheus(
+        built, fake_prom, fake_k8s):
+    """The k8s protobuf path rides the informer; with --watch-cache off
+    the Prometheus queries still negotiate protobuf and the pipeline's
+    decisions are unchanged."""
+    idle_cluster(fake_prom, fake_k8s)
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "scale-down", "--wire", "proto"]
+    proc = subprocess.run(cmd, env=daemon_env(fake_k8s),
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert fake_prom.proto_queries >= 1
+    assert fake_k8s.proto_lists == 0  # resolution LISTs stay JSON
+    assert len(fake_k8s.scale_patches()) == 3
+
+
+# ── THE acceptance: byte-identity across wire modes ────────────────────
+
+# The shard/incremental volatile set: clock/trace fields plus the
+# capsule's "incremental" provenance stamp (it records HOW the view was
+# assembled and legitimately differs run to run).
+VOLATILE_KEYS = {"ts", "ts_unix", "ts_ms", "now_unix", "trace_id", "id",
+                 "incremental"}
+# Ledger fields integrated from the wall clock (dt between cycles of two
+# separate daemon RUNS can never be equal); identity, chips, state and
+# event/pause counters must still match exactly.
+LEDGER_VOLATILE = VOLATILE_KEYS | {"epoch", "idle_seconds", "active_seconds",
+                                   "reclaimed_chip_seconds", "paused_since",
+                                   "paused_since_unix"}
+
+
+def _normalize(obj, volatile=VOLATILE_KEYS):
+    if isinstance(obj, dict):
+        return {k: _normalize(v, volatile) for k, v in obj.items()
+                if k not in volatile}
+    if isinstance(obj, list):
+        return [_normalize(v, volatile) for v in obj]
+    return obj
+
+
+def test_wire_modes_byte_identical_at_shards_and_incremental(
+        built, fake_prom, fake_k8s, tmp_path):
+    """`--wire json` vs `--wire proto` on one fixture — at shards 1 and
+    8, with --incremental on and off — produce byte-identical normalized
+    audit JSONL, flight capsules and ledger checkpoints, and every
+    proto-recorded capsule replays bit-for-bit offline. The capsule's
+    Prometheus bodies are the canonical JSON reconstruction, so they
+    carry the SAME bytes either wire; the fake's freeze_time pins the
+    one remaining nondeterminism (per-query evidence timestamps)."""
+    mixed_cluster(fake_prom, fake_k8s)
+    fake_prom.freeze_time = 1754300000.25
+    outputs = {}
+    proto_flight = None
+    for shards in (1, 8):
+        for inc in ("off", "on"):
+            for mode in ("json", "proto"):
+                tag = f"{mode}-{shards}-{inc}"
+                audit = tmp_path / f"audit-{tag}.jsonl"
+                flight = tmp_path / f"flight-{tag}"
+                ledger = tmp_path / f"ledger-{tag}.jsonl"
+                served_before = fake_k8s.proto_lists
+                run_daemon(fake_prom, fake_k8s, "--wire", mode,
+                           "--shards", str(shards), "--incremental", inc,
+                           "--signal-guard", "on",
+                           "--audit-log", str(audit),
+                           "--flight-dir", str(flight),
+                           "--ledger-file", str(ledger),
+                           run_mode="dry-run")
+                if mode == "proto":
+                    assert fake_k8s.proto_lists > served_before, (
+                        f"{tag} never negotiated protobuf")
+                    proto_flight = flight
+                records = [_normalize(json.loads(line))
+                           for line in audit.read_text().splitlines()]
+                capsules = [_normalize(json.loads(p.read_text()))
+                            for p in sorted(flight.glob("cycle-*.json"))]
+                accounts = [_normalize(json.loads(line), LEDGER_VOLATILE)
+                            for line in ledger.read_text().splitlines()]
+                assert records and capsules and accounts, tag
+                outputs[(mode, shards, inc)] = (
+                    json.dumps(records, sort_keys=True),
+                    json.dumps(capsules, sort_keys=True),
+                    json.dumps(accounts, sort_keys=True))
+
+    for shards in (1, 8):
+        for inc in ("off", "on"):
+            js = outputs[("json", shards, inc)]
+            pb = outputs[("proto", shards, inc)]
+            where = f"shards={shards} incremental={inc}"
+            assert js[0] == pb[0], f"audit JSONL differs across wire ({where})"
+            assert js[1] == pb[1], f"capsules differ across wire ({where})"
+            assert js[2] == pb[2], f"ledger differs across wire ({where})"
+
+    # proto-recorded capsules replay bit-for-bit: the canonical body IS a
+    # valid Prometheus JSON body, and replay recomputes from it in full
+    assert proto_flight is not None
+    for capsule in sorted(proto_flight.glob("cycle-*.json")):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_pruner.analyze", "--replay",
+             str(capsule)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert json.loads(proc.stdout)["match"] is True
+
+
+# ── decode parity corpus: recorded bodies, both wires ──────────────────
+
+
+def _get(url, accept=None):
+    req = urllib.request.Request(url, headers={"Accept": accept} if accept
+                                 else {})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.read(), resp.headers.get("Content-Type", "")
+
+
+def test_wire_parity_corpus_k8s_list(built, fake_k8s, fake_prom):
+    """The SAME logical LIST fetched in both content types decodes to
+    identical object trees — and the fused key scan agrees with the
+    materialized metadata (ns/name), so the store the reflector builds is
+    wire-format independent. Paginated pages keep their continue token."""
+    mixed_cluster(fake_prom, fake_k8s)
+    json_body, ct = _get(fake_k8s.url + "/api/v1/pods")
+    assert ct.startswith("application/json")
+    pb_body, ct = _get(fake_k8s.url + "/api/v1/pods",
+                       accept=wire_proto.K8S_PROTO)
+    assert ct.startswith(wire_proto.K8S_PROTO), ct
+    decoded = native.wire_decode_k8s(pb_body, "list")
+    ref = json.loads(json_body)
+    assert decoded["items"] == ref["items"]
+    assert decoded["resource_version"] == ref["metadata"]["resourceVersion"]
+    for item, key in zip(decoded["items"], decoded["keys"]):
+        assert key["namespace"] == item["metadata"]["namespace"]
+        assert key["name"] == item["metadata"]["name"]
+        assert key["fingerprint"] != 0
+
+    # paginated page: continue token survives the proto ListMeta
+    pb_page, ct = _get(fake_k8s.url + "/api/v1/pods?limit=2",
+                       accept=wire_proto.K8S_PROTO)
+    assert ct.startswith(wire_proto.K8S_PROTO)
+    page = native.wire_decode_k8s(pb_page, "list")
+    json_page, _ = _get(fake_k8s.url + "/api/v1/pods?limit=2")
+    ref_page = json.loads(json_page)
+    assert len(page["items"]) == 2
+    assert page["continue"] == ref_page["metadata"]["continue"]
+
+
+def test_wire_parity_corpus_k8s_watch(built, fake_k8s, fake_prom):
+    """Watch frames encoded from every stored pod decode back to the
+    exact object, with the fused scan's key/rv fields agreeing with the
+    object's own metadata; bookmark frames carry their resume point."""
+    mixed_cluster(fake_prom, fake_k8s)
+    pods = [v for k, v in fake_k8s.objects.items() if "/pods/" in k]
+    assert len(pods) >= 9
+    for event_type in ("ADDED", "MODIFIED", "DELETED"):
+        for pod in pods:
+            frame = wire_proto.encode_watch_frame(event_type, pod)
+            assert frame is not None, pod["metadata"]["name"]
+            decoded = native.wire_decode_k8s(frame[4:], "watch")
+            assert decoded["type"] == event_type
+            assert decoded["object"] == json.loads(json.dumps(pod))
+            assert decoded["namespace"] == pod["metadata"]["namespace"]
+            assert decoded["name"] == pod["metadata"]["name"]
+            assert (decoded["resource_version"]
+                    == pod["metadata"]["resourceVersion"])
+    bookmark = wire_proto.encode_watch_frame(
+        "BOOKMARK", {"kind": "Bookmark",
+                     "metadata": {"resourceVersion": "123"}})
+    decoded = native.wire_decode_k8s(bookmark[4:], "watch")
+    assert decoded["type"] == "BOOKMARK"
+    assert decoded["resource_version"] == "123"
+
+
+def test_wire_unencodable_objects_fall_back_to_json(built, fake_k8s,
+                                                    fake_prom):
+    """An object outside the encoder's schema (extra field) must make the
+    fake REFUSE protobuf for that response — the safety valve that keeps
+    byte-identity honest instead of silently dropping fields."""
+    fake_k8s.add_pod("ml", "weird")
+    pod = fake_k8s.objects["/api/v1/namespaces/ml/pods/weird"]
+    pod["spec"]["tolerations"] = [{"key": "x"}]  # outside the schema
+    fake_k8s.objects["/api/v1/namespaces/ml/pods/weird"] = pod
+    body, ct = _get(fake_k8s.url + "/api/v1/pods",
+                    accept=wire_proto.K8S_PROTO)
+    assert ct.startswith("application/json"), (
+        "fake served protobuf for an unencodable object")
+    assert json.loads(body)["items"][0]["spec"]["tolerations"] == [{"key": "x"}]
+
+
+def test_wire_parity_corpus_prom(built, fake_prom, fake_k8s):
+    """The same instant query answered in both content types: the fused
+    decoder's samples/num_series/errors equal the JSON decoder's on the
+    recorded body, and the canonical reconstruction is BYTE-IDENTICAL to
+    the JSON body — the flight-recorder contract."""
+    fake_prom.freeze_time = 1754300000.25
+    fake_prom.add_idle_pod_series("pod-a", "ml", chips=4)
+    fake_prom.add_idle_pod_series("pod-b", "ml")
+    fake_prom.add_idle_node_series("pod-c", "ml", node="node-1")
+    url = (fake_prom.url + "/api/v1/query?query=" +
+           quote('tensorcore_duty_cycle{exported_pod!=""}'))
+    json_body, ct = _get(url)
+    assert ct.startswith("application/json")
+    pb_body, ct = _get(url, accept=wire_proto.PROM_PROTO)
+    assert ct.startswith(wire_proto.PROM_PROTO), ct
+
+    decoded = native.wire_decode_prom(pb_body)
+    ref = native.decode_samples(None, response_raw=json_body.decode(),
+                                zero_copy=True)
+    assert decoded["samples"] == ref["samples"]
+    assert decoded["num_series"] == ref["num_series"]
+    assert decoded["errors"] == ref["errors"]
+    assert decoded["canonical_body"] == json_body.decode()
+
+    # gke-system schema tolerances ride the same wire
+    decoded_gke = native.wire_decode_prom(pb_body, schema="gke-system")
+    ref_gke = native.decode_samples(None, response_raw=json_body.decode(),
+                                    zero_copy=True, schema="gke-system")
+    assert decoded_gke["samples"] == ref_gke["samples"]
+
+
+# ── truncation / garbage: clean ParseErrors, never a crash ─────────────
+
+
+def _proto_bodies(fake_k8s, fake_prom):
+    mixed_cluster(fake_prom, fake_k8s)
+    list_body, _ = _get(fake_k8s.url + "/api/v1/pods",
+                        accept=wire_proto.K8S_PROTO)
+    pod = fake_k8s.objects["/api/v1/namespaces/ml/pods/dep-0-abc123-0"]
+    watch_body = wire_proto.encode_watch_frame("MODIFIED", pod)[4:]
+    prom_body, _ = _get(fake_prom.url + "/api/v1/query?query=up",
+                        accept=wire_proto.PROM_PROTO)
+    return {"list": list_body, "watch": watch_body, "prom": prom_body}
+
+
+def _decode(shape, body):
+    if shape == "prom":
+        return native.wire_decode_prom(body)
+    return native.wire_decode_k8s(body, shape)
+
+
+def test_wire_truncation_sweep_raises_clean_parse_errors(built, fake_k8s,
+                                                         fake_prom):
+    """Every prefix of a real proto body (the torn-read shape) either
+    decodes (a prefix can end on a field boundary) or raises a clean
+    typed error carrying a byte offset — the same contract the JSON
+    decoders honor, extended to the binary wire. `just asan-proto` runs
+    the native twin of this sweep under AddressSanitizer."""
+    bodies = _proto_bodies(fake_k8s, fake_prom)
+    for shape, body in bodies.items():
+        assert _decode(shape, body), shape  # the full body must decode
+        step = max(1, len(body) // 97)
+        for cut in range(0, len(body), step):
+            try:
+                _decode(shape, body[:cut])
+            except ValueError as e:
+                msg = str(e)
+                assert "proto:" in msg or "offset" in msg, (shape, cut, msg)
+
+
+def test_wire_garbage_sweep_never_crashes(built, fake_k8s, fake_prom):
+    """Deterministic byte-flip mutations of real proto bodies: decode
+    either succeeds (a flipped byte can land in a string payload) or
+    raises ValueError — never crashes, never hangs."""
+    bodies = _proto_bodies(fake_k8s, fake_prom)
+    for shape, body in bodies.items():
+        b = bytearray(body)
+        for i in range(0, len(b), max(1, len(b) // 64)):
+            mutated = bytearray(b)
+            mutated[i] ^= 0xFF
+            try:
+                _decode(shape, bytes(mutated))
+            except ValueError:
+                pass
+    # pure garbage
+    for shape in ("list", "watch", "prom"):
+        for garbage in (b"", b"\x00", b"k8s\x00", b"k8s\x00\xff\xff\xff\xff",
+                        b"not a proto body at all", bytes(range(256))):
+            try:
+                _decode(shape, garbage)
+            except ValueError:
+                pass
+
+
+# ── querytest --wire: raw-response debugging ───────────────────────────
+
+
+def test_querytest_wire_hex_dump(built, fake_prom, fake_k8s):
+    """`tpu-pruner querytest --wire proto|json <promql> <url>` fetches ONE
+    raw response in the chosen content type and hex-dumps it — the
+    debugging tool for negotiation against real endpoints."""
+    fake_prom.add_idle_pod_series("pod-a", "ml")
+    out = {}
+    for mode in ("proto", "json"):
+        proc = subprocess.run(
+            [str(DAEMON_PATH), "querytest", "--wire", mode,
+             'tensorcore_duty_cycle{exported_pod!=""}', fake_prom.url],
+            capture_output=True, text=True, timeout=60,
+            env={"PROMETHEUS_TOKEN": "t", "PATH": "/usr/bin:/bin"})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out[mode] = proc.stdout
+    assert "application/x-protobuf" in out["proto"]
+    assert "application/json" in out["json"]
+    # offset | hex | ascii rows
+    assert re.search(r"^00000000 ", out["proto"], re.M), out["proto"][:400]
+    assert re.search(r"^00000000 ", out["json"], re.M)
+    # the JSON body's text shows through the ascii gutter
+    assert "status" in out["json"]

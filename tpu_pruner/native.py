@@ -151,6 +151,10 @@ def load() -> ctypes.CDLL:
         "tp_signal_metric_families",
         "tp_transport_metric_families",
         "tp_incremental_metric_families",
+        "tp_wire_metric_families",
+        "tp_wire_decode_k8s",
+        "tp_wire_decode_prom",
+        "tp_wire_bench_decode",
         "tp_json_parse",
         "tp_enabled_resources",
         "tp_decode_samples",
@@ -250,6 +254,47 @@ def incremental_metric_families() -> list[str]:
     /metrics — the docs drift-guard test joins this list against
     docs/OPERATIONS.md."""
     return _call("tp_incremental_metric_families", {})["families"]
+
+
+def wire_metric_families() -> list[str]:
+    """Canonical binary-wire (tpu_pruner_wire_*) metric family names
+    served on /metrics — the docs drift-guard test joins this list
+    against docs/OPERATIONS.md."""
+    return _call("tp_wire_metric_families", {})["families"]
+
+
+def wire_decode_k8s(body: bytes, shape: str = "list") -> dict:
+    """Decode a Kubernetes protobuf body through the REAL wire decoder
+    (native/src/proto.cpp). ``shape`` is "list" (an
+    application/vnd.kubernetes.protobuf LIST response) or "watch" (one
+    length-delimited frame WITHOUT its 4-byte length prefix). Returns the
+    materialized items/object plus the fused-path key fields and
+    fingerprints — the wire parity corpus compares these against
+    json.loads of the JSON form of the same data."""
+    import base64
+
+    return _call("tp_wire_decode_k8s",
+                 {"body_b64": base64.b64encode(body).decode(), "shape": shape})
+
+
+def wire_decode_prom(body: bytes, device: str = "tpu", schema: str = "gmp") -> dict:
+    """Decode a Prometheus protobuf exposition body through the fused
+    wire decoder: returns {"samples", "num_series", "errors",
+    "canonical_body"} where canonical_body must be byte-identical to the
+    JSON body the fake recorded for the same data."""
+    import base64
+
+    return _call("tp_wire_decode_prom",
+                 {"body_b64": base64.b64encode(body).decode(),
+                  "device": device, "schema": schema})
+
+
+def wire_bench_decode(path: str, content_type: str, iters: int = 1) -> dict:
+    """Time `iters` informer-shaped decodes of the response body stored
+    at ``path`` ("protobuf" → proto::parse_list; "json" → Doc::parse +
+    items walk). The bench's cold-LIST decode-wall probe."""
+    return _call("tp_wire_bench_decode",
+                 {"path": path, "content_type": content_type, "iters": iters})
 
 
 def json_parse(body: str, zero_copy: bool = False) -> dict:

@@ -38,7 +38,7 @@ from urllib.parse import parse_qs, urlparse
 
 import multiprocessing
 
-from tpu_pruner.testing import h2_server
+from tpu_pruner.testing import h2_server, wire_proto
 
 
 def _mp_worker_main(fake: "FakeK8s", sock, conn) -> None:
@@ -320,6 +320,17 @@ class FakeK8s:
         # survive a validating API server; tests may disable it to model
         # a permissive aggregated apiserver.
         self.strict_validation = True
+        # Binary wire path (--wire proto): serve
+        # application/vnd.kubernetes.protobuf for collection LISTs and
+        # watch streams whose request Accept asks for it AND whose
+        # objects fit the encoder's Pod-subset schema (wire_proto.py);
+        # anything else falls back to JSON — the negotiation-fallback
+        # path the native client counts. False models a JSON-only
+        # apiserver. Counters below record what actually went out;
+        # response recording (requests/patches/...) is wire-independent.
+        self.serve_protobuf = True
+        self.proto_lists = 0         # LIST responses served as protobuf
+        self.proto_watch_frames = 0  # watch frames served as protobuf
         # >0 → chunk every collection LIST into pages of this size with
         # metadata.continue tokens even when the client sends no `limit`
         # (what an intermediary cache does); clients that ignore the token
@@ -734,6 +745,25 @@ class FakeK8s:
                                     "reason": "NotFound", "code": 404,
                                     "message": f"{self.path} not found"})
 
+            def _respond_collection(self, items, meta):
+                """LIST response with content negotiation: protobuf when
+                the client asked for it and every item fits the encoder's
+                schema, JSON otherwise (the fallback a JSON-only
+                apiserver exercises)."""
+                accept = self.headers.get("Accept", "")
+                if fake.serve_protobuf and wire_proto.K8S_PROTO in accept:
+                    pb = wire_proto.encode_pod_list(items, meta)
+                    if pb is not None:
+                        fake.proto_lists += 1
+                        self.send_response(200)
+                        self.send_header("Content-Type", wire_proto.K8S_PROTO)
+                        self.send_header("Content-Length", str(len(pb)))
+                        self.end_headers()
+                        self.wfile.write(pb)
+                        return
+                self._respond(200, {"kind": "List", "apiVersion": "v1",
+                                    "metadata": meta, "items": items})
+
             def setup(self):
                 super().setup()
                 fake.transport.connection_opened()
@@ -840,11 +870,9 @@ class FakeK8s:
                             if start + page < len(items):
                                 meta["continue"] = fake._encode_continue(
                                     start + page)
-                            self._respond(200, {"kind": "List", "apiVersion": "v1",
-                                                "metadata": meta, "items": chunk})
+                            self._respond_collection(chunk, meta)
                             return
-                        self._respond(200, {"kind": "List", "apiVersion": "v1",
-                                            "metadata": meta, "items": items})
+                        self._respond_collection(items, meta)
                         return
                     obj = fake.objects.get(path)
                 if obj is None:
@@ -891,13 +919,30 @@ class FakeK8s:
                     self.close_connection = True
                     return
 
+                # Binary wire path: a proto-accepting watch streams
+                # 4-byte big-endian length-delimited Unknown(WatchEvent)
+                # frames instead of newline-delimited JSON. An object the
+                # encoder can't represent tears the stream down (the
+                # client re-watches; its relist LIST falls back to JSON).
+                accept = self.headers.get("Accept", "")
+                proto_watch = fake.serve_protobuf and wire_proto.K8S_PROTO in accept
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type",
+                                 wire_proto.K8S_PROTO_WATCH if proto_watch
+                                 else "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
 
                 def write_event(payload):
-                    data = (json.dumps(payload) + "\n").encode()
+                    if proto_watch:
+                        data = wire_proto.encode_watch_frame(
+                            payload["type"], payload["object"])
+                        if data is None:
+                            raise BrokenPipeError(
+                                "watch object outside the proto schema")
+                        fake.proto_watch_frames += 1
+                    else:
+                        data = (json.dumps(payload) + "\n").encode()
                     self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
                     self.wfile.flush()
 

@@ -14,7 +14,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from tpu_pruner.testing import h2_server
+from tpu_pruner.testing import h2_server, wire_proto
 
 
 def promql_structure_error(query: str) -> str | None:
@@ -80,9 +80,27 @@ class FakePrometheus:
         self.fail_requests_remaining = 0
         self.fail_status = 500
         self.hang_seconds = 0.0  # >0 → every query stalls (wedged-backend sim)
+        # Pin the `now` used for evidence rows and scripted-series sample
+        # timestamps (float unix). The byte-identity tests (wire modes,
+        # incremental on/off) compare recorded response bodies across
+        # daemon RUNS against one fixture; the per-query wall clock is
+        # the only nondeterminism in those bodies. None = real time.
+        self.freeze_time: float | None = None
         self._cached = None
+        self._cached_payload = None
         self._cached_version = -1
         self._version = 0
+        # Binary wire path (--wire proto): serve the protobuf
+        # instant-vector exposition when the request Accept asks for it
+        # (wire_proto.encode_prom_vector — it carries the EXACT decimal
+        # text of the JSON form, so the native side reconstructs a
+        # canonical body byte-identical to the JSON one). response_bodies
+        # / evidence_bodies always record the JSON rendering regardless
+        # of what went on the wire: they are the byte-identity reference
+        # the flight-recorder tests compare capsules against. False
+        # models a JSON-only Prometheus (negotiation fallback).
+        self.serve_protobuf = True
+        self.proto_queries = 0  # instant queries answered as protobuf
         # shared-transport accounting (see fake_k8s): connections accepted,
         # h2 streams, peak concurrency — the concurrent idleness+evidence
         # query pair shows up here as max_concurrent_streams >= 2.
@@ -145,7 +163,7 @@ class FakePrometheus:
                 return v[idx] if idx < len(v) else v[-1]
             return v
 
-        now = time.time()
+        now = self.freeze_time if self.freeze_time is not None else time.time()
         result = []
         for ev in self.evidence_series.values():
             count = pick(ev["sample_count"])
@@ -311,6 +329,29 @@ class FakePrometheus:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_query_body(self, payload: dict, body: bytes):
+                """Send a successful instant-query response in whichever
+                wire format the client negotiated. `body` is the JSON
+                rendering (already recorded as the byte-identity
+                reference); `payload` is the same data as objects, which
+                the protobuf encoder consumes."""
+                accept = self.headers.get("Accept", "")
+                if fake.serve_protobuf and wire_proto.PROM_PROTO in accept:
+                    pb = wire_proto.encode_prom_vector(payload)
+                    if pb is not None:
+                        fake.proto_queries += 1
+                        self.send_response(200)
+                        self.send_header("Content-Type", wire_proto.PROM_PROTO)
+                        self.send_header("Content-Length", str(len(pb)))
+                        self.end_headers()
+                        self.wfile.write(pb)
+                        return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _handle_query(self, query: str):
                 if fake.hang_seconds:  # before the lock: other verbs stay live
                     time.sleep(fake.hang_seconds)
@@ -340,28 +381,27 @@ class FakePrometheus:
                         # cycle-aligned
                         idx = fake.evidence_queries_served
                         fake.evidence_queries_served += 1
-                        body = json.dumps({
+                        payload = {
                             "status": "success",
                             "data": {"resultType": "vector",
                                      "result": fake._evidence_result(idx)},
-                        }).encode()
+                        }
+                        body = json.dumps(payload).encode()
                         fake.evidence_bodies.append(body.decode())
-                        self.send_response(200)
-                        self.send_header("Content-Type", "application/json")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
+                        self._send_query_body(payload, body)
                         return
                     # serialize once per series-list version (large fleets);
                     # instant vectors exclude range-only series (no "value")
                     if fake._cached_version != fake._version or fake._cached is None:
-                        fake._cached = json.dumps({
+                        fake._cached_payload = {
                             "status": "success",
                             "data": {"resultType": "vector",
                                      "result": [s for s in fake.series
                                                 if "value" in s]},
-                        }).encode()
+                        }
+                        fake._cached = json.dumps(fake._cached_payload).encode()
                         fake._cached_version = fake._version
+                    payload = fake._cached_payload
                     body = fake._cached
                     if fake.scripted_series:
                         # time-advancing scripts make the response a
@@ -370,7 +410,8 @@ class FakePrometheus:
                         # the fleet-scale one)
                         idx = fake.instant_queries_served
                         result = [s for s in fake.series if "value" in s]
-                        now = time.time()
+                        now = (fake.freeze_time if fake.freeze_time is not None
+                               else time.time())
                         for s in fake.scripted_series:
                             vals = s["values"]
                             v = vals[idx] if idx < len(vals) else vals[-1]
@@ -378,17 +419,14 @@ class FakePrometheus:
                                 continue
                             result.append({"metric": s["labels"],
                                            "value": [now, str(v)]})
-                        body = json.dumps({
+                        payload = {
                             "status": "success",
                             "data": {"resultType": "vector", "result": result},
-                        }).encode()
+                        }
+                        body = json.dumps(payload).encode()
                     fake.instant_queries_served += 1
                     fake.response_bodies.append(body.decode())
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send_query_body(payload, body)
 
             def _handle_query_range(self, query: str):
                 """Matrix response filtered by the queried metric name (a
