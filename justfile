@@ -96,6 +96,16 @@ replay-smoke:
 fleet-smoke:
     python -m tpu_pruner.testing.fleet_smoke
 
+# federation-at-scale smoke: 100 scripted lightweight members under one
+# real hub in snapshot vs --fleet-delta on vs +streamed modes — merged
+# views asserted byte-identical across all three, the quiesced delta
+# round asserted ≥10x cheaper than snapshot polling in bytes AND hub
+# CPU, churn propagation timed. TP_PLANET_PODS=0 skips the 250k-pod
+# single-cluster rung so the smoke fits CI minutes.
+# tests/test_justfile_guard.py pins the recipe.
+fleet-mega:
+    TP_PLANET_MEMBERS=100 TP_PLANET_PODS=0 python bench.py --planet-only
+
 # policy-gym smoke: synthetic 200-cycle trace corpus (trace_gen) recorded
 # by the real daemon, replayed against 3 policies in one pass, winner
 # flag line printed — non-zero exit when the scoring contract breaks.
@@ -164,6 +174,15 @@ tsan-wire:
     cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
     ./build-tsan/tpupruner_tests proto
     ./build-tsan/tpupruner_tests informer
+
+# delta-federation race tier: the member-side change journal (cycle
+# publishers vs parked long-pollers on the same condition variable) and
+# the hub's merge math the poll fan-out feeds, under ThreadSanitizer
+# (substring filter of the native test binary)
+tsan-fleet:
+    cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
+    ./build-tsan/tpupruner_tests delta
+    ./build-tsan/tpupruner_tests fleet
 
 # standalone TPU capture: probe + fleet eval + bench_tpu_last_good.json
 # (run EARLY in a round / whenever the chip tunnel is up; exits 1 when no

@@ -1,0 +1,186 @@
+// Delta federation protocol: the member-side change journal behind
+// /debug/delta and the hub-side cursor/apply state machine.
+//
+// PR 10 made the daemon's warm cycle O(churn) with a dirty journal; the
+// fleet layer never got the same treatment — `tpu-pruner hub` re-polled
+// every member's FULL /debug/{workloads,signals,decisions} snapshot every
+// interval, so hub cost grew as O(members x fleet-size) even when nothing
+// changed. This module applies the daemon's own trick at the fleet layer:
+//
+//   Member side (Journal): each cycle end the daemon snapshots its three
+//   debug surfaces and journals row-level changes under a process-wide
+//   monotonic epoch — the same epoch discipline the ledger's checkpoint
+//   lines already carry, extended to every surface. A hub polls
+//   /debug/delta?since=<epoch>&gen=<generation> and receives only what
+//   changed; a quiesced member answers with a ~100-byte header. The
+//   journal's change log is BOUNDED (TPU_PRUNER_DELTA_JOURNAL_CAP, def
+//   4096 row-changes): a cursor that has aged out of the window — or a
+//   generation mismatch after a member restart — forces a clean
+//   full-snapshot resync carried inline in the same response, mirroring
+//   the informer's 410→relist semantics (and like the informer's
+//   coalescing rules, deltas are latest-state per key: N changes to one
+//   row between polls ship once).
+//
+//   Hub side (DeltaState + apply_delta): a per-member cursor plus the row
+//   maps needed to reconstruct each member's debug documents EXACTLY as a
+//   full-snapshot poll would have parsed them — merged fleet views are
+//   byte-identical across --fleet-delta on|off by construction.
+//
+// The journal is LAZY: it costs nothing (no per-cycle render/diff) until
+// the first /debug/delta request activates it, so a daemon that is not
+// federated never pays for the protocol.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::delta {
+
+// The three journaled surfaces, in canonical order.
+inline constexpr const char* kSurfaces[] = {"workloads", "signals", "decisions"};
+
+// Current-document providers (the same renderers the /debug endpoints
+// serve). A null provider means the surface is absent for this process.
+struct Renderers {
+  std::function<json::Value()> workloads;
+  std::function<json::Value()> signals;
+  std::function<json::Value()> decisions;
+};
+
+class Journal {
+ public:
+  Journal();
+
+  void set_renderers(Renderers r);
+  // Change-log bound (row-changes retained). Also read from
+  // $TPU_PRUNER_DELTA_JOURNAL_CAP at construction; this overrides.
+  void set_log_cap(size_t cap);
+
+  // True once a /debug/delta request has been seen: the daemon only
+  // renders + diffs its surfaces per cycle while someone is listening.
+  bool active() const;
+
+  // Snapshot the surfaces through the renderers and journal the changes
+  // under a fresh epoch (one epoch per publish that changed anything).
+  // Cheap no-op until active(). Thread-safe; wakes long-pollers.
+  void publish();
+
+  // Serve one /debug/delta request. `query` is the raw query string
+  // (since=<epoch>&gen=<generation>&wait_ms=<ms>); `abort` is polled
+  // ~5x/s while long-polling (server shutdown seam). Activates the
+  // journal (and self-primes from the renderers) on first use.
+  std::string handle_request(const std::string& query,
+                             const std::function<bool()>& abort);
+
+  // Release any long-poll waiters immediately (daemon shutdown).
+  void wake_all();
+
+  uint64_t epoch() const;
+  std::string generation() const;
+
+  void reset_for_test();
+
+ private:
+  struct WorkloadsState {
+    bool have = false;
+    uint64_t meta_epoch = 0;
+    uint64_t meta_fp = 0;
+    json::Value meta;                               // doc minus "workloads"
+    std::map<std::string, uint64_t> row_epoch;      // key → epoch last changed
+    std::map<std::string, uint64_t> row_fp;         // key → row fingerprint
+    std::map<std::string, json::Value> rows;        // key → row (latest)
+    std::map<std::string, uint64_t> removed;        // key → epoch removed
+  };
+  struct SignalsState {
+    bool have = false;
+    uint64_t doc_epoch = 0;
+    uint64_t fp = 0;
+    json::Value doc;
+  };
+  struct DecisionsState {
+    bool have = false;
+    int64_t capacity = 0;
+    int64_t dropped = 0;
+    uint64_t appended_total = 0;                    // dropped + ring length
+    uint64_t meta_epoch = 0;
+    uint64_t meta_fp = 0;
+    json::Value meta;                               // doc minus "decisions"
+    std::deque<std::pair<uint64_t, json::Value>> ring;  // (epoch, record)
+  };
+
+  void publish_locked();
+  void note_change_locked(uint64_t epoch);
+  std::string build_response_locked(int64_t since, bool resync, bool first);
+  json::Value full_docs_locked() const;
+
+  mutable std::mutex mutex_;
+  std::condition_variable cv_;
+  Renderers renderers_;
+  std::string gen_;
+  uint64_t epoch_ = 0;
+  // Oldest `since` the change log can still answer; a smaller cursor has
+  // aged out of the window and must resync.
+  uint64_t min_since_ = 0;
+  size_t log_cap_ = 4096;
+  std::deque<uint64_t> log_;  // epoch per retained row-change (bound bookkeeping)
+  bool active_ = false;
+  bool primed_ = false;
+  WorkloadsState wl_;
+  SignalsState sig_;
+  DecisionsState dec_;
+};
+
+// Process-wide journal (the daemon's). The hub builds its own instance
+// for its rollup surfaces.
+Journal& journal();
+
+// ── hub side ──
+
+// A member's three debug documents as the hub holds them.
+struct MemberDocs {
+  json::Value workloads, signals, decisions;
+};
+
+// Per-member delta cursor + reconstruction state.
+struct DeltaState {
+  bool primed = false;     // a full snapshot (resync or first poll) landed
+  std::string gen;
+  uint64_t epoch = 0;
+  // workloads reconstruction
+  json::Value wl_meta;
+  std::map<std::string, json::Value> wl_rows;  // key → row
+  // decisions reconstruction (ring semantics)
+  std::deque<json::Value> dec_ring;
+  int64_t dec_capacity = 0;
+  int64_t dec_dropped = 0;
+  json::Value signals;
+};
+
+// Result of applying one /debug/delta response.
+struct ApplyResult {
+  bool ok = false;       // response parsed and applied
+  bool resync = false;   // the member forced (or served) a full snapshot
+  bool changed = false;  // any surface changed (epoch advanced or resync)
+};
+
+// Apply one parsed /debug/delta response body to the member state and
+// rebuild `out` — documents EQUAL to what a full-snapshot poll of the
+// member would have parsed (fleet::aggregate consumes either
+// interchangeably). Malformed responses return ok=false and leave the
+// state untouched; the caller falls back to snapshot polling.
+ApplyResult apply_delta(DeltaState& st, const json::Value& resp, MemberDocs& out);
+
+// The hub-side query string for the next poll given the member state
+// ("since=-1" before the first snapshot). wait_ms==0 omits the long-poll
+// parameter (plain poll).
+std::string cursor_query(const DeltaState& st, int64_t wait_ms);
+
+}  // namespace tpupruner::delta

@@ -68,6 +68,8 @@ struct MemberSnapshot {
   int64_t staleness_s = -1; // seconds since the last successful poll; -1 = never
   std::string last_error;   // last poll failure ("" when none)
   uint64_t polls = 0, failures = 0;
+  uint64_t backoffs = 0;    // poll rounds skipped by the failure backoff
+  std::string via;          // parent hub URL when expanded from a rollup ("" direct)
   json::Value workloads;    // member /debug/workloads (null until first success)
   json::Value signals;      // member /debug/signals
   json::Value decisions;    // member /debug/decisions
@@ -98,8 +100,34 @@ struct FleetView {
 //     unknown, which is the opposite of healthy), guard-off members
 //     contribute nothing;
 //   - every member yields exactly one row in every document.
+// Hub-of-hubs: a member whose /debug documents carry `"rollup": true` is
+// itself a hub (region → global). aggregate() EXPANDS such members into
+// their per-cluster leaves before merging, so a parent hub's view over
+// two child hubs is byte-identical (workloads/signals/decisions documents
+// and fleet_totals) to one hub over all leaves directly. Semantics:
+//   - stale propagation: a child hub gone dark forces every one of its
+//     last-known leaves UNREACHABLE — a dark REGION pins the fleet
+//     coverage minimum to 0 globally, never the mean;
+//   - disjointness: the same cluster name surfacing from two different
+//     members is a topology error — flagged in `duplicate_clusters` on
+//     the signals + clusters documents and pinning coverage_min to 0;
+//   - the clusters table keeps leaf rows (each stamped `via` = the child
+//     hub's URL) plus a `hubs` section for the child hubs themselves.
 FleetView aggregate(const std::vector<MemberSnapshot>& members, int64_t stale_after_s,
                     size_t decisions_per_member = 100);
+
+// The hub's own member-compatible /debug/{workloads,signals,decisions}
+// documents (`"rollup": true` + per-cluster sections), so a hub can be a
+// --member of a parent hub and its journal can delta-serve them.
+json::Value rollup_workloads(const FleetView& view, const std::string& hub_cluster);
+json::Value rollup_signals(const FleetView& view, const std::string& hub_cluster);
+json::Value rollup_decisions(const FleetView& view, const std::string& hub_cluster);
+
+// Status string for one member snapshot ("OK" | "PENDING" |
+// "UNREACHABLE") — the same derivation aggregate() applies, exposed so
+// the hub's change-gated merge can notice a staleness-driven transition
+// without re-running the whole merge.
+const char* member_status(const MemberSnapshot& m, int64_t stale_after_s);
 
 // The tpu_pruner_fleet_* family names the hub serves (docs drift guard,
 // via capi — includes the fleet_merge_seconds histogram the hub's poll

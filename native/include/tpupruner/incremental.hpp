@@ -227,6 +227,11 @@ std::string pod_key_of_path(const std::string& path);
 //   tpu_pruner_incremental_cached_pods       gauge
 //   tpu_pruner_incremental_dirty_pods        gauge
 //   tpu_pruner_incremental_full_recomputes_total  counter
+//   tpu_pruner_incremental_journal_depth     gauge (dirty paths drained at plan)
+//   tpu_pruner_incremental_journal_overflows_total  counter (cap hits)
+//   tpu_pruner_incremental_cache_units       gauge (bounded by
+//                                            TPU_PRUNER_INCREMENTAL_CACHE_CAP)
+//   tpu_pruner_incremental_cache_evictions_total    counter
 void publish_metrics(const Engine::Plan& plan);
 std::string render_metrics(bool openmetrics);
 std::vector<std::string> metric_families();

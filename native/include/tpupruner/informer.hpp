@@ -191,6 +191,9 @@ class Reflector {
   // const: drains a logically-external queue (the cycle holds the cache
   // by const pointer); journal state is mutable under its own mutex.
   void drain_dirty(std::vector<std::string>& paths, bool& all) const;
+  // Cumulative journal-cap overflows (each degraded one drain to
+  // globally dirty) — the churn-storm instrumentation.
+  uint64_t journal_overflows() const;
 
  private:
   void run();  // thread body: relist loop wrapping the watch loop
@@ -216,6 +219,7 @@ class Reflector {
   mutable std::mutex dirty_mutex_;
   mutable std::vector<std::string> dirty_paths_;
   mutable bool dirty_all_ = false;
+  mutable uint64_t journal_overflows_ = 0;
   std::thread thread_;
   mutable std::mutex stats_mutex_;
   ResourceStats stats_;
@@ -269,6 +273,7 @@ class ClusterCache {
   struct DirtyDrain {
     bool all = false;
     std::vector<std::string> paths;
+    uint64_t overflows_total = 0;  // cumulative journal-cap overflows
   };
   DirtyDrain drain_dirty() const;
 
@@ -281,5 +286,10 @@ class ClusterCache {
   // the steady clock's epoch distance (machine uptime), i.e. garbage.
   std::atomic<int64_t> start_mono_{0};
 };
+
+// The per-reflector dirty-journal bound (paths retained before a drain
+// degrades to globally dirty) — exported so the bench's churn-storm
+// phase can assert the served journal-depth gauge stays under it.
+size_t dirty_journal_cap();
 
 }  // namespace tpupruner::informer

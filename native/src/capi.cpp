@@ -16,6 +16,7 @@
 
 #include "otlp_grpc.hpp"
 #include "tpupruner/audit.hpp"
+#include "tpupruner/delta.hpp"
 #include "tpupruner/fleet.hpp"
 #include "tpupruner/gym.hpp"
 #include "tpupruner/h2.hpp"
@@ -807,6 +808,8 @@ char* tp_fleet_aggregate(const char* payload_json) {
       s.polls = static_cast<uint64_t>(num("polls", 1));
       s.failures = static_cast<uint64_t>(num("failures", 0));
       s.last_error = m.get_string("last_error");
+      s.backoffs = static_cast<uint64_t>(num("backoffs", 0));
+      s.via = m.get_string("via");
       if (const Value* v = m.find("workloads")) s.workloads = *v;
       if (const Value* v = m.find("signals")) s.signals = *v;
       if (const Value* v = m.find("decisions")) s.decisions = *v;
@@ -840,6 +843,100 @@ char* tp_stamp_exposition(const char* payload_json) {
     Value out = Value::object();
     out.set("body", Value(tpupruner::fleet::stamp_exposition(
                         p.get_string("body"), p.get_string("cluster"))));
+    return ok(out);
+  });
+}
+
+char* tp_delta_sim(const char* payload_json) {
+  // Deterministic harness for the delta-federation protocol: drives the
+  // REAL member-side Journal and hub-side apply_delta state machine
+  // (delta.cpp) through a scripted publish/poll/restart sequence, so the
+  // pytest tier can pin the wire contract (epoch monotonicity, quiesced
+  // responses, journal-overflow and generation-mismatch resyncs, and
+  // reconstruction equality vs the published documents) without spinning
+  // a daemon+hub tree. Payload:
+  //   {"log_cap": N?, "steps": [
+  //      {"op": "publish", "workloads": {...}?, "signals": {...}?,
+  //       "decisions": {...}?},
+  //      {"op": "poll", "since": N?, "gen": "..."?, }   // omitted → own cursor
+  //      {"op": "restart"}                              // journal reborn
+  //   ]}
+  // Returns {"results": [...]} — per publish {"epoch"}, per poll
+  // {"response", "applied": {ok,resync,changed}, "docs"}.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    auto journal = std::make_shared<tpupruner::delta::Journal>();
+    if (const Value* v = p.find("log_cap"); v && v->is_number()) {
+      journal->set_log_cap(static_cast<size_t>(v->as_int()));
+    }
+    auto slots = std::make_shared<std::map<std::string, Value>>();
+    auto renderer = [slots](const char* surface) {
+      return [slots, surface]() -> Value {
+        auto it = slots->find(surface);
+        return it == slots->end() ? Value() : it->second;
+      };
+    };
+    auto wire = [&] {
+      journal->set_renderers(tpupruner::delta::Renderers{
+          renderer("workloads"), renderer("signals"), renderer("decisions")});
+    };
+    wire();
+
+    tpupruner::delta::DeltaState state;
+    tpupruner::delta::MemberDocs docs;
+    const Value* steps = p.find("steps");
+    if (!steps || !steps->is_array()) throw std::runtime_error("missing steps");
+    Value results = Value::array();
+    for (const Value& step : steps->as_array()) {
+      std::string op = step.get_string("op");
+      Value r = Value::object();
+      if (op == "publish") {
+        for (const char* surface : tpupruner::delta::kSurfaces) {
+          if (const Value* doc = step.find(surface)) (*slots)[surface] = *doc;
+        }
+        // Publishing only matters once a poller activated the journal —
+        // exactly the daemon's lazy contract.
+        journal->handle_request("since=" + std::to_string(journal->epoch()) +
+                                    "&gen=" + journal->generation(),
+                                nullptr);  // activation probe (no-op once active)
+        journal->publish();
+        r.set("epoch", Value(static_cast<int64_t>(journal->epoch())));
+      } else if (op == "poll") {
+        std::string query;
+        if (const Value* since = step.find("since"); since && since->is_number()) {
+          query = "since=" + std::to_string(since->as_int());
+          if (const Value* g = step.find("gen"); g && g->is_string()) {
+            query += "&gen=" + g->as_string();
+          }
+        } else {
+          query = tpupruner::delta::cursor_query(state, 0);
+        }
+        std::string body = journal->handle_request(query, nullptr);
+        Value resp = Value::parse(body);
+        tpupruner::delta::ApplyResult applied =
+            tpupruner::delta::apply_delta(state, resp, docs);
+        r.set("response", resp);
+        Value a = Value::object();
+        a.set("ok", Value(applied.ok));
+        a.set("resync", Value(applied.resync));
+        a.set("changed", Value(applied.changed));
+        r.set("applied", std::move(a));
+        Value d = Value::object();
+        if (!docs.workloads.is_null()) d.set("workloads", docs.workloads);
+        if (!docs.signals.is_null()) d.set("signals", docs.signals);
+        if (!docs.decisions.is_null()) d.set("decisions", docs.decisions);
+        r.set("docs", std::move(d));
+        r.set("bytes", Value(static_cast<int64_t>(body.size())));
+      } else if (op == "restart") {
+        journal->reset_for_test();  // new generation, epoch back to 0
+        wire();
+      } else {
+        throw std::runtime_error("unknown step op: " + op);
+      }
+      results.push_back(std::move(r));
+    }
+    Value out = Value::object();
+    out.set("results", std::move(results));
     return ok(out);
   });
 }

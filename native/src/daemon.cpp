@@ -21,6 +21,7 @@
 #include "tpupruner/actuate.hpp"
 #include "tpupruner/audit.hpp"
 #include "tpupruner/auth.hpp"
+#include "tpupruner/delta.hpp"
 #include "tpupruner/fleet.hpp"
 #include "tpupruner/gym.hpp"
 #include "tpupruner/http.hpp"
@@ -1901,6 +1902,19 @@ int run(const cli::Cli& args) {
     // Evidence-health snapshot at /debug/signals (`analyze
     // --signal-report` hits this); {"enabled": false} with the guard off.
     metrics_server->set_signals_provider([] { return signal::signals_json().dump(); });
+    // Delta-federation journal (/debug/delta): serves O(churn) diffs of
+    // the three debug surfaces to a polling hub, keyed by a monotonic
+    // epoch with full-snapshot resync when a cursor ages out. Lazy: the
+    // journal only starts rendering+diffing per cycle once a hub polls.
+    delta::journal().set_renderers(delta::Renderers{
+        [] { return ledger::workloads_json(""); },
+        [] { return signal::signals_json(); },
+        [] { return audit::decisions_json(""); },
+    });
+    metrics_server->set_delta_provider(
+        [](const std::string& query, const std::function<bool()>& abort) {
+          return delta::journal().handle_request(query, abort);
+        });
     // Flight recorder: capsule index at /debug/cycles, full capsules at
     // /debug/cycles/<id> ("" from the provider → 404).
     if (recorder::enabled()) {
@@ -2252,6 +2266,10 @@ int run(const cli::Cli& args) {
         stats = finish_cycle(args, prepare_cycle(args, query, evidence_query, &prom_client),
                              kube, enabled, enqueue, watch_cache.get());
       }
+      // Delta-federation journal: snapshot the debug surfaces into the
+      // change journal at cycle end — free until a hub's first
+      // /debug/delta poll activates it, O(changed rows) after.
+      if (delta::journal().active()) delta::journal().publish();
       consecutive_failures = 0;
       log::counter_add("query_successes", 1);
       log::counter_set("query_returned_candidates", stats.num_pods);
@@ -2295,6 +2313,9 @@ int run(const cli::Cli& args) {
               (g_shutdown_signal == SIGINT ? "SIGINT" : "SIGTERM") +
               ", shutting down gracefully");
   }
+  // Release any hub long-poll parked in /debug/delta before the server
+  // teardown joins its connection threads.
+  delta::journal().wake_all();
   // Drain the in-flight prepare (its cycle never runs) so the helper
   // thread's span and open capsule close out before the queue drains.
   drop_prepared();

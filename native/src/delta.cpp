@@ -1,0 +1,519 @@
+#include "tpupruner/delta.hpp"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+
+#include "tpupruner/fleet.hpp"
+#include "tpupruner/log.hpp"
+#include "tpupruner/shard.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::delta {
+
+using json::Value;
+
+namespace {
+
+uint64_t fp_of(const Value& v) { return shard::stable_hash(v.dump()); }
+
+// Row identity inside a workloads document: the ledger's account key.
+std::string row_key(const Value& row) {
+  std::string key = row.get_string("workload");
+  if (!key.empty()) return key;
+  return row.get_string("kind") + "/" + row.get_string("namespace") + "/" +
+         row.get_string("name");
+}
+
+// Everything in the document EXCEPT the row array — totals, tracked,
+// cluster, epoch, sort... The hub re-attaches the reconstructed array
+// under `array_key`, so meta + rows rebuild the document exactly.
+Value doc_meta(const Value& doc, const char* array_key) {
+  Value meta = Value::object();
+  if (!doc.is_object()) return meta;
+  for (const auto& [k, v] : doc.as_object()) {
+    if (k != array_key) meta.set(k, v);
+  }
+  return meta;
+}
+
+int64_t int_at(const Value& doc, const char* key, int64_t dflt) {
+  const Value* v = doc.find(key);
+  return v && v->is_number() ? static_cast<int64_t>(v->as_double()) : dflt;
+}
+
+double sort_field(const Value& row, const std::string& sort) {
+  const char* field = sort == "idle" ? "idle_seconds"
+                      : sort == "chips" ? "chips"
+                                        : "reclaimed_chip_seconds";
+  const Value* v = row.find(field);
+  return v && v->is_number() ? v->as_double() : 0.0;
+}
+
+// Rebuild a workloads document from meta + rows, replicating the member's
+// own ordering (ledger::workloads_json): rows enter in ascending account
+// key order (its accounts map), then a STABLE sort by the sort field,
+// descending — so the reconstructed array is byte-identical to the
+// member's render.
+Value rebuild_workloads(const Value& meta, const std::map<std::string, Value>& rows) {
+  Value doc = meta;  // COW copy
+  if (!doc.is_object()) doc = Value::object();
+  std::string sort = meta.get_string("sort", "reclaimed");
+  std::vector<const Value*> ordered;
+  ordered.reserve(rows.size());
+  for (const auto& [k, row] : rows) ordered.push_back(&row);
+  std::stable_sort(ordered.begin(), ordered.end(),
+                   [&](const Value* a, const Value* b) {
+                     return sort_field(*a, sort) > sort_field(*b, sort);
+                   });
+  Value arr = Value::array();
+  for (const Value* row : ordered) arr.push_back(*row);
+  doc.set("workloads", std::move(arr));
+  return doc;
+}
+
+Value rebuild_decisions(const Value& meta, const std::deque<Value>& ring) {
+  Value doc = meta;  // COW copy
+  if (!doc.is_object()) doc = Value::object();
+  Value arr = Value::array();
+  for (const Value& rec : ring) arr.push_back(rec);
+  doc.set("decisions", std::move(arr));
+  return doc;
+}
+
+}  // namespace
+
+namespace {
+// Journal generations must never repeat across journal lifetimes — a
+// member restart is DETECTED by the mismatch (the informer's
+// resourceVersion analog), so "<unix>-<pid>-<seq>" carries a process-wide
+// sequence in case two journals are born within the same second.
+std::string next_generation() {
+  static std::atomic<uint64_t> seq{0};
+  return std::to_string(util::now_unix()) + "-" + std::to_string(::getpid()) + "-" +
+         std::to_string(seq.fetch_add(1));
+}
+}  // namespace
+
+Journal::Journal() {
+  gen_ = next_generation();
+  if (auto cap = util::env("TPU_PRUNER_DELTA_JOURNAL_CAP"); cap && !cap->empty()) {
+    try {
+      log_cap_ = static_cast<size_t>(std::stoull(*cap));
+    } catch (const std::exception&) {
+      // ignore: keep the default — a bad env var must not kill the daemon
+    }
+  }
+}
+
+void Journal::set_renderers(Renderers r) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  renderers_ = std::move(r);
+}
+
+void Journal::set_log_cap(size_t cap) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  log_cap_ = cap == 0 ? 1 : cap;
+}
+
+bool Journal::active() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return active_;
+}
+
+uint64_t Journal::epoch() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return epoch_;
+}
+
+std::string Journal::generation() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return gen_;
+}
+
+void Journal::note_change_locked(uint64_t epoch) {
+  log_.push_back(epoch);
+  while (log_.size() > log_cap_) {
+    // The popped change has aged out of the window: cursors at or before
+    // its epoch can no longer be served a faithful diff (the informer's
+    // 410 analog — the hub resyncs from a full snapshot).
+    min_since_ = std::max(min_since_, log_.front());
+    log_.pop_front();
+  }
+}
+
+void Journal::publish() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  if (!active_) return;
+  publish_locked();
+}
+
+void Journal::publish_locked() {
+  const uint64_t next = epoch_ + 1;
+  bool changed = false;
+
+  if (renderers_.workloads) {
+    Value doc = renderers_.workloads();
+    Value meta = doc_meta(doc, "workloads");
+    uint64_t mfp = fp_of(meta);
+    if (!wl_.have || mfp != wl_.meta_fp) {
+      wl_.meta = std::move(meta);
+      wl_.meta_fp = mfp;
+      wl_.meta_epoch = next;
+      note_change_locked(next);
+      changed = true;
+    }
+    std::map<std::string, uint64_t> seen;
+    if (const Value* arr = doc.find("workloads"); arr && arr->is_array()) {
+      for (const Value& row : arr->as_array()) {
+        std::string key = row_key(row);
+        uint64_t fp = fp_of(row);
+        seen.emplace(key, fp);
+        auto it = wl_.row_fp.find(key);
+        if (it == wl_.row_fp.end() || it->second != fp) {
+          wl_.row_fp[key] = fp;
+          wl_.rows[key] = row;
+          wl_.row_epoch[key] = next;
+          wl_.removed.erase(key);
+          note_change_locked(next);
+          changed = true;
+        }
+      }
+    }
+    for (auto it = wl_.rows.begin(); it != wl_.rows.end();) {
+      if (seen.count(it->first)) {
+        ++it;
+        continue;
+      }
+      wl_.removed[it->first] = next;
+      wl_.row_fp.erase(it->first);
+      wl_.row_epoch.erase(it->first);
+      note_change_locked(next);
+      changed = true;
+      it = wl_.rows.erase(it);
+    }
+    wl_.have = true;
+  }
+
+  if (renderers_.signals) {
+    Value doc = renderers_.signals();
+    uint64_t fp = fp_of(doc);
+    if (!sig_.have || fp != sig_.fp) {
+      sig_.doc = std::move(doc);
+      sig_.fp = fp;
+      sig_.doc_epoch = next;
+      sig_.have = true;
+      note_change_locked(next);
+      changed = true;
+    }
+  }
+
+  if (renderers_.decisions) {
+    Value doc = renderers_.decisions();
+    Value meta = doc_meta(doc, "decisions");
+    uint64_t mfp = fp_of(meta);
+    int64_t capacity = int_at(doc, "capacity", 0);
+    int64_t dropped = int_at(doc, "dropped", 0);
+    const Value* arr = doc.find("decisions");
+    size_t len = arr && arr->is_array() ? arr->as_array().size() : 0;
+    uint64_t total = static_cast<uint64_t>(dropped) + len;
+    bool discontinuity =
+        dec_.have && (total < dec_.appended_total || capacity != dec_.capacity);
+    if (discontinuity || !dec_.have) {
+      dec_.ring.clear();
+      dec_.appended_total = static_cast<uint64_t>(dropped);
+      // Ring rebuilt wholesale below (every record reads as an append).
+    }
+    uint64_t fresh = total - dec_.appended_total;
+    if (fresh > 0 && arr) {
+      const auto& records = arr->as_array();
+      size_t start = records.size() >= fresh ? records.size() - fresh : 0;
+      for (size_t i = start; i < records.size(); ++i) {
+        dec_.ring.emplace_back(next, records[i]);
+        note_change_locked(next);
+      }
+      while (capacity > 0 && dec_.ring.size() > static_cast<size_t>(capacity)) {
+        dec_.ring.pop_front();
+      }
+      changed = true;
+    }
+    if (!dec_.have || mfp != dec_.meta_fp) {
+      dec_.meta = std::move(meta);
+      dec_.meta_fp = mfp;
+      dec_.meta_epoch = next;
+      note_change_locked(next);
+      changed = true;
+    }
+    dec_.capacity = capacity;
+    dec_.dropped = dropped;
+    dec_.appended_total = total;
+    dec_.have = true;
+  }
+
+  if (changed) {
+    epoch_ = next;
+    cv_.notify_all();
+  }
+  primed_ = true;
+}
+
+json::Value Journal::full_docs_locked() const {
+  Value full = Value::object();
+  if (wl_.have) full.set("workloads", rebuild_workloads(wl_.meta, wl_.rows));
+  if (sig_.have) full.set("signals", sig_.doc);
+  if (dec_.have) {
+    std::deque<Value> ring;
+    for (const auto& [e, rec] : dec_.ring) ring.push_back(rec);
+    full.set("decisions", rebuild_decisions(dec_.meta, ring));
+  }
+  return full;
+}
+
+std::string Journal::build_response_locked(int64_t since, bool resync, bool first) {
+  Value resp = Value::object();
+  resp.set("cluster", Value(fleet::cluster_name()));
+  resp.set("gen", Value(gen_));
+  resp.set("epoch", Value(static_cast<int64_t>(epoch_)));
+  if (resync || first) {
+    if (resync) resp.set("resync", Value(true));
+    resp.set("full", full_docs_locked());
+    return resp.dump();
+  }
+  resp.set("since", Value(since));
+  const uint64_t u_since = static_cast<uint64_t>(since);
+  Value surfaces = Value::object();
+
+  if (wl_.have) {
+    bool meta_changed = wl_.meta_epoch > u_since;
+    Value upserts = Value::array();
+    for (const auto& [key, e] : wl_.row_epoch) {
+      if (e > u_since) upserts.push_back(wl_.rows.at(key));
+    }
+    Value removes = Value::array();
+    for (const auto& [key, e] : wl_.removed) {
+      if (e > u_since) removes.push_back(Value(key));
+    }
+    if (meta_changed || !upserts.as_array().empty() || !removes.as_array().empty()) {
+      Value s = Value::object();
+      s.set("meta", wl_.meta);
+      s.set("upserts", std::move(upserts));
+      s.set("removes", std::move(removes));
+      surfaces.set("workloads", std::move(s));
+    }
+  }
+  if (sig_.have && sig_.doc_epoch > u_since) {
+    Value s = Value::object();
+    s.set("doc", sig_.doc);
+    surfaces.set("signals", std::move(s));
+  }
+  if (dec_.have) {
+    size_t fresh = 0;
+    for (auto it = dec_.ring.rbegin(); it != dec_.ring.rend() && it->first > u_since; ++it) {
+      ++fresh;
+    }
+    if (fresh > 0 || dec_.meta_epoch > u_since) {
+      Value s = Value::object();
+      s.set("meta", dec_.meta);
+      Value appends = Value::array();
+      for (size_t i = dec_.ring.size() - fresh; i < dec_.ring.size(); ++i) {
+        appends.push_back(dec_.ring[i].second);
+      }
+      s.set("appends", std::move(appends));
+      // When every retained record is fresh, the appends ARE the member's
+      // whole current ring — the hub REPLACES its copy (its older records
+      // may have wrapped out on the member side) instead of extending.
+      s.set("replace", Value(fresh == dec_.ring.size()));
+      surfaces.set("decisions", std::move(s));
+    }
+  }
+  if (!surfaces.as_object().empty()) resp.set("surfaces", std::move(surfaces));
+  return resp.dump();
+}
+
+std::string Journal::handle_request(const std::string& query,
+                                    const std::function<bool()>& abort) {
+  int64_t since = -1;
+  std::string want_gen;
+  int64_t wait_ms = 0;
+  for (const std::string& pair : util::split(query, '&')) {
+    size_t eq = pair.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = pair.substr(0, eq);
+    std::string value = util::url_decode(pair.substr(eq + 1));
+    try {
+      if (key == "since") since = std::stoll(value);
+      else if (key == "gen") want_gen = value;
+      else if (key == "wait_ms") wait_ms = std::stoll(value);
+    } catch (const std::exception&) {
+      since = -1;  // malformed cursor → full snapshot
+    }
+  }
+  wait_ms = std::min<int64_t>(std::max<int64_t>(wait_ms, 0), 55000);
+  log::counter_add("delta_requests_total", 1);
+
+  std::unique_lock<std::mutex> lock(mutex_);
+  if (!active_) {
+    active_ = true;
+    log::info("delta", "first /debug/delta poll: change journal activated "
+              "(gen " + gen_ + ")");
+  }
+  if (!primed_) publish_locked();  // self-prime so the first poll sees state
+
+  bool first = since < 0;
+  bool resync = !first && (want_gen != gen_ || static_cast<uint64_t>(since) > epoch_ ||
+                           static_cast<uint64_t>(since) < min_since_);
+  if (resync) log::counter_add("delta_resyncs_served_total", 1);
+
+  if (!first && !resync && static_cast<uint64_t>(since) == epoch_ && wait_ms > 0) {
+    // Long poll: hold until something changes, the deadline passes, or
+    // the server is shutting down. Quiesced members cost ~zero bytes per
+    // round in this mode.
+    auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(wait_ms);
+    while (epoch_ == static_cast<uint64_t>(since) &&
+           std::chrono::steady_clock::now() < deadline && !(abort && abort())) {
+      cv_.wait_for(lock, std::chrono::milliseconds(200));
+    }
+  }
+  return build_response_locked(since, resync, first);
+}
+
+void Journal::wake_all() { cv_.notify_all(); }
+
+void Journal::reset_for_test() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  renderers_ = {};
+  epoch_ = 0;
+  min_since_ = 0;
+  log_.clear();
+  active_ = false;
+  primed_ = false;
+  wl_ = {};
+  sig_ = {};
+  dec_ = {};
+  gen_ = next_generation();
+}
+
+Journal& journal() {
+  static Journal j;
+  return j;
+}
+
+// ── hub side ──
+
+std::string cursor_query(const DeltaState& st, int64_t wait_ms) {
+  // Generations are "<unix>-<pid>" — URL-safe by construction, no
+  // encoding needed.
+  std::string q = "since=" + (st.primed ? std::to_string(st.epoch) : std::string("-1"));
+  if (st.primed && !st.gen.empty()) q += "&gen=" + st.gen;
+  if (wait_ms > 0) q += "&wait_ms=" + std::to_string(wait_ms);
+  return q;
+}
+
+namespace {
+
+void prime_workloads(DeltaState& st, const Value& doc) {
+  st.wl_meta = doc_meta(doc, "workloads");
+  st.wl_rows.clear();
+  if (const Value* arr = doc.find("workloads"); arr && arr->is_array()) {
+    for (const Value& row : arr->as_array()) st.wl_rows[row_key(row)] = row;
+  }
+}
+
+void prime_decisions(DeltaState& st, const Value& doc) {
+  st.dec_ring.clear();
+  st.dec_capacity = int_at(doc, "capacity", 0);
+  st.dec_dropped = int_at(doc, "dropped", 0);
+  if (const Value* arr = doc.find("decisions"); arr && arr->is_array()) {
+    for (const Value& rec : arr->as_array()) st.dec_ring.push_back(rec);
+  }
+}
+
+}  // namespace
+
+ApplyResult apply_delta(DeltaState& st, const Value& resp, MemberDocs& out) {
+  ApplyResult res;
+  if (!resp.is_object()) return res;
+  const Value* gen = resp.find("gen");
+  const Value* epoch = resp.find("epoch");
+  if (!gen || !gen->is_string() || !epoch || !epoch->is_number()) return res;
+
+  if (const Value* full = resp.find("full"); full && full->is_object()) {
+    // Full snapshot (first poll or resync): the documents arrive verbatim
+    // — adopt them and rebuild the reconstruction state from scratch.
+    st = DeltaState{};
+    st.gen = gen->as_string();
+    st.epoch = static_cast<uint64_t>(epoch->as_double());
+    st.primed = true;
+    if (const Value* wl = full->find("workloads")) {
+      prime_workloads(st, *wl);
+      out.workloads = *wl;
+    }
+    if (const Value* sig = full->find("signals")) {
+      st.signals = *sig;
+      out.signals = *sig;
+    }
+    if (const Value* dec = full->find("decisions")) {
+      prime_decisions(st, *dec);
+      out.decisions = *dec;
+    }
+    res.ok = true;
+    const Value* r = resp.find("resync");
+    res.resync = r && r->is_bool() && r->as_bool();
+    res.changed = true;
+    return res;
+  }
+
+  if (!st.primed || gen->as_string() != st.gen) return res;  // caller resets cursor
+  uint64_t new_epoch = static_cast<uint64_t>(epoch->as_double());
+  if (new_epoch < st.epoch) return res;
+
+  const Value* surfaces = resp.find("surfaces");
+  if (surfaces && surfaces->is_object()) {
+    if (const Value* wl = surfaces->find("workloads"); wl && wl->is_object()) {
+      if (const Value* meta = wl->find("meta"); meta && meta->is_object()) {
+        st.wl_meta = *meta;
+      }
+      if (const Value* ups = wl->find("upserts"); ups && ups->is_array()) {
+        for (const Value& row : ups->as_array()) st.wl_rows[row_key(row)] = row;
+      }
+      if (const Value* rms = wl->find("removes"); rms && rms->is_array()) {
+        for (const Value& key : rms->as_array()) {
+          if (key.is_string()) st.wl_rows.erase(key.as_string());
+        }
+      }
+      out.workloads = rebuild_workloads(st.wl_meta, st.wl_rows);
+      res.changed = true;
+    }
+    if (const Value* sig = surfaces->find("signals"); sig && sig->is_object()) {
+      if (const Value* doc = sig->find("doc")) {
+        st.signals = *doc;
+        out.signals = *doc;
+        res.changed = true;
+      }
+    }
+    if (const Value* dec = surfaces->find("decisions"); dec && dec->is_object()) {
+      const Value* meta = dec->find("meta");
+      Value meta_doc = meta && meta->is_object() ? *meta : Value::object();
+      st.dec_capacity = int_at(meta_doc, "capacity", st.dec_capacity);
+      st.dec_dropped = int_at(meta_doc, "dropped", st.dec_dropped);
+      const Value* rep = dec->find("replace");
+      if (rep && rep->is_bool() && rep->as_bool()) st.dec_ring.clear();
+      if (const Value* app = dec->find("appends"); app && app->is_array()) {
+        for (const Value& rec : app->as_array()) st.dec_ring.push_back(rec);
+      }
+      while (st.dec_capacity > 0 &&
+             st.dec_ring.size() > static_cast<size_t>(st.dec_capacity)) {
+        st.dec_ring.pop_front();
+      }
+      out.decisions = rebuild_decisions(meta_doc, st.dec_ring);
+      res.changed = true;
+    }
+  }
+  st.epoch = new_epoch;
+  res.ok = true;
+  return res;
+}
+
+}  // namespace tpupruner::delta

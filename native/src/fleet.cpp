@@ -47,8 +47,10 @@ std::string family(const std::string& name, const char* type, const std::string&
 }
 
 std::string render_fleet_metrics(const std::vector<const MemberSnapshot*>& ordered,
+                                 const std::vector<MemberSnapshot>& polled,
                                  int64_t stale_after_s, double coverage_min,
-                                 size_t unreachable, bool openmetrics) {
+                                 size_t unreachable, size_t duplicates,
+                                 bool openmetrics) {
   auto esc = [](const std::string& s) { return json::escape(s); };
   std::string body;
   body += family("tpu_pruner_fleet_members", "gauge",
@@ -65,6 +67,21 @@ std::string render_fleet_metrics(const std::vector<const MemberSnapshot*>& order
                  "members count as 0) — never the mean, so one dark cluster cannot "
                  "hide in a fleet average", openmetrics);
   body += "tpu_pruner_fleet_coverage_ratio_min " + fmt_value(coverage_min) + "\n";
+
+  body += family("tpu_pruner_fleet_duplicate_clusters", "gauge",
+                 "Cluster names claimed by more than one member (hub-of-hubs "
+                 "disjointness violation; pins the coverage minimum to 0)",
+                 openmetrics);
+  body += "tpu_pruner_fleet_duplicate_clusters " + std::to_string(duplicates) + "\n";
+
+  body += family("tpu_pruner_fleet_member_backoff_total", "counter",
+                 "Poll rounds in which the member was skipped by the "
+                 "unreachable-member exponential backoff (capped at "
+                 "--stale-after)", openmetrics);
+  for (const MemberSnapshot& m : polled) {
+    body += "tpu_pruner_fleet_member_backoff_total{cluster=\"" + esc(m.cluster) +
+            "\"} " + std::to_string(m.backoffs) + "\n";
+  }
 
   body += family("tpu_pruner_fleet_member_up", "gauge",
                  "1 when the member's last poll succeeded and is fresh, else 0",
@@ -137,7 +154,89 @@ std::string render_fleet_metrics(const std::vector<const MemberSnapshot*>& order
   return body;
 }
 
+// A member document stamped `"rollup": true` came from a child hub.
+bool is_rollup(const MemberSnapshot& m) {
+  const Value* r = m.workloads.find("rollup");
+  return r && r->is_bool() && r->as_bool();
+}
+
+// Expand a child hub's rollup documents into per-cluster leaf snapshots
+// that merge EXACTLY like directly-polled members (two-level determinism:
+// the leaf documents reconstruct every key aggregate() reads from a
+// direct member's /debug documents, so a parent hub over child hubs and
+// one hub over all leaves produce byte-identical merged views). Stale
+// propagation: a child hub that is not OK forces every last-known leaf
+// UNREACHABLE — a dark region pins the fleet coverage minimum to 0.
+std::vector<MemberSnapshot> expand_rollup(const MemberSnapshot& hub, int64_t stale_after_s) {
+  std::vector<MemberSnapshot> leaves;
+  bool hub_ok = std::string(status_of(hub, stale_after_s)) == "OK";
+
+  // Index the signals / decisions per-cluster rows.
+  std::map<std::string, const Value*> sig_rows, dec_rows;
+  if (const Value* rows = hub.signals.find("clusters"); rows && rows->is_array()) {
+    for (const Value& row : rows->as_array()) sig_rows.emplace(row.get_string("cluster"), &row);
+  }
+  if (const Value* rows = hub.decisions.find("clusters"); rows && rows->is_array()) {
+    for (const Value& row : rows->as_array()) dec_rows.emplace(row.get_string("cluster"), &row);
+  }
+
+  const Value* rows = hub.workloads.find("clusters");
+  if (!rows || !rows->is_array()) return leaves;
+  for (const Value& row : rows->as_array()) {
+    MemberSnapshot leaf;
+    leaf.cluster = row.get_string("cluster");
+    leaf.url = row.get_string("member");
+    leaf.via = hub.url;
+    std::string status = row.get_string("status", "PENDING");
+    if (!hub_ok && status != "PENDING") status = "UNREACHABLE";
+    if (status == "OK") {
+      leaf.polls = 1;
+      leaf.reachable = true;
+      leaf.ever_reached = true;
+      leaf.staleness_s = 0;
+    } else if (status == "UNREACHABLE") {
+      leaf.polls = 1;
+      leaf.reachable = false;
+      leaf.staleness_s = -1;
+      if (!hub_ok) leaf.last_error = "region hub " + hub.url + " unreachable";
+    }  // PENDING: the zero-initialized snapshot already reads PENDING
+
+    // Reconstruct the leaf's /debug/workloads from the rollup row. A row
+    // carries "tracked" exactly when the child held member data.
+    if (row.find("tracked")) {
+      Value wl = Value::object();
+      wl.set("cluster", Value(leaf.cluster));
+      for (const char* key : {"tracked", "totals", "workloads", "epoch"}) {
+        if (const Value* v = row.find(key)) wl.set(key, *v);
+      }
+      leaf.workloads = std::move(wl);
+    }
+    if (auto it = sig_rows.find(leaf.cluster); it != sig_rows.end()) {
+      Value sig = Value::object();
+      sig.set("cluster", Value(leaf.cluster));
+      for (const char* key : {"enabled", "coverage_ratio", "brownout", "pods"}) {
+        if (const Value* v = it->second->find(key)) sig.set(key, *v);
+      }
+      leaf.signals = std::move(sig);
+    }
+    if (auto it = dec_rows.find(leaf.cluster); it != dec_rows.end()) {
+      if (const Value* d = it->second->find("decisions"); d && d->is_array()) {
+        Value dec = Value::object();
+        dec.set("cluster", Value(leaf.cluster));
+        dec.set("decisions", *d);
+        leaf.decisions = std::move(dec);
+      }
+    }
+    leaves.push_back(std::move(leaf));
+  }
+  return leaves;
+}
+
 }  // namespace
+
+const char* member_status(const MemberSnapshot& m, int64_t stale_after_s) {
+  return status_of(m, stale_after_s);
+}
 
 void set_cluster_name(const std::string& name) {
   std::lock_guard<std::mutex> lock(g_mutex);
@@ -217,16 +316,45 @@ std::string stamp_exposition(const std::string& body, const std::string& cluster
 
 FleetView aggregate(const std::vector<MemberSnapshot>& members, int64_t stale_after_s,
                     size_t decisions_per_member) {
+  // Hub-of-hubs: expand child-hub rollup documents into per-cluster leaf
+  // snapshots first — every later stage sees only leaves, so one-level
+  // and two-level topologies merge through identical code.
+  std::vector<MemberSnapshot> expanded;
+  std::vector<const MemberSnapshot*> hubs;
+  expanded.reserve(members.size());
+  for (const MemberSnapshot& m : members) {
+    if (is_rollup(m)) {
+      hubs.push_back(&m);
+      for (MemberSnapshot& leaf : expand_rollup(m, stale_after_s)) {
+        expanded.push_back(std::move(leaf));
+      }
+    } else {
+      expanded.push_back(m);
+    }
+  }
+
   // Deterministic member order: by cluster name, then URL — merged
   // documents and summed totals are a function of the snapshots alone.
   std::vector<const MemberSnapshot*> ordered;
-  ordered.reserve(members.size());
-  for (const MemberSnapshot& m : members) ordered.push_back(&m);
+  ordered.reserve(expanded.size());
+  for (const MemberSnapshot& m : expanded) ordered.push_back(&m);
   std::stable_sort(ordered.begin(), ordered.end(),
                    [](const MemberSnapshot* a, const MemberSnapshot* b) {
                      if (a->cluster != b->cluster) return a->cluster < b->cluster;
                      return a->url < b->url;
                    });
+
+  // Cluster-set disjointness: the same cluster name claimed by more than
+  // one member (two regions both federating "east", or a member listed
+  // twice) makes every per-cluster statement ambiguous — flag it and pin
+  // the coverage minimum rather than silently double-counting.
+  std::vector<std::string> duplicate_clusters;
+  for (size_t i = 1; i < ordered.size(); ++i) {
+    if (ordered[i]->cluster == ordered[i - 1]->cluster &&
+        (duplicate_clusters.empty() || duplicate_clusters.back() != ordered[i]->cluster)) {
+      duplicate_clusters.push_back(ordered[i]->cluster);
+    }
+  }
 
   FleetView view;
   size_t unreachable = 0;
@@ -312,10 +440,21 @@ FleetView aggregate(const std::vector<MemberSnapshot>& members, int64_t stale_af
     sig_clusters.push_back(std::move(row));
   }
   if (!any_contribution) coverage_min = 1.0;
+  if (!duplicate_clusters.empty()) {
+    // Ambiguous topology: per-cluster guarantees (minimum coverage,
+    // totals that sum once) cannot hold — surface it as loudly as a dark
+    // cluster does.
+    coverage_min = 0.0;
+  }
   view.signals = Value::object();
   view.signals.set("coverage_min", Value(coverage_min));
   view.signals.set("brownout_clusters", std::move(brownout_clusters));
   view.signals.set("unreachable_clusters", std::move(unreachable_clusters));
+  if (!duplicate_clusters.empty()) {
+    Value dups = Value::array();
+    for (const std::string& c : duplicate_clusters) dups.push_back(Value(c));
+    view.signals.set("duplicate_clusters", std::move(dups));
+  }
   view.signals.set("clusters", std::move(sig_clusters));
 
   // ── decisions: last K per member, per-cluster sections ──
@@ -346,18 +485,80 @@ FleetView aggregate(const std::vector<MemberSnapshot>& members, int64_t stale_af
     if (m->staleness_s >= 0) row.set("last_success_age_s", Value(m->staleness_s));
     row.set("polls", Value(static_cast<int64_t>(m->polls)));
     row.set("failures", Value(static_cast<int64_t>(m->failures)));
+    if (m->backoffs > 0) row.set("backoffs", Value(static_cast<int64_t>(m->backoffs)));
+    if (!m->via.empty()) row.set("via", Value(m->via));
     if (!m->last_error.empty()) row.set("last_error", Value(m->last_error));
     member_rows.push_back(std::move(row));
   }
   view.clusters = Value::object();
   view.clusters.set("members", std::move(member_rows));
   view.clusters.set("unreachable", Value(static_cast<int64_t>(unreachable)));
+  if (!hubs.empty()) {
+    Value hub_rows = Value::array();
+    for (const MemberSnapshot* h : hubs) {
+      Value row = Value::object();
+      row.set("member", Value(h->url));
+      row.set("cluster", Value(h->cluster));
+      row.set("status", Value(std::string(status_of(*h, stale_after_s))));
+      if (h->staleness_s >= 0) row.set("last_success_age_s", Value(h->staleness_s));
+      row.set("polls", Value(static_cast<int64_t>(h->polls)));
+      row.set("failures", Value(static_cast<int64_t>(h->failures)));
+      if (!h->last_error.empty()) row.set("last_error", Value(h->last_error));
+      hub_rows.push_back(std::move(row));
+    }
+    view.clusters.set("hubs", std::move(hub_rows));
+  }
+  if (!duplicate_clusters.empty()) {
+    Value dups = Value::array();
+    for (const std::string& c : duplicate_clusters) dups.push_back(Value(c));
+    view.clusters.set("duplicate_clusters", std::move(dups));
+  }
 
+  // Backoff counters are a fact about the hub's own poll targets (the
+  // members it dials — a child hub, not that hub's leaves), so they
+  // render from the un-expanded member list.
+  std::vector<MemberSnapshot> polled(members);
+  std::stable_sort(polled.begin(), polled.end(),
+                   [](const MemberSnapshot& a, const MemberSnapshot& b) {
+                     if (a.cluster != b.cluster) return a.cluster < b.cluster;
+                     return a.url < b.url;
+                   });
   view.metrics_text =
-      render_fleet_metrics(ordered, stale_after_s, coverage_min, unreachable, false);
+      render_fleet_metrics(ordered, polled, stale_after_s, coverage_min, unreachable,
+                           duplicate_clusters.size(), false);
   view.metrics_openmetrics =
-      render_fleet_metrics(ordered, stale_after_s, coverage_min, unreachable, true);
+      render_fleet_metrics(ordered, polled, stale_after_s, coverage_min, unreachable,
+                           duplicate_clusters.size(), true);
   return view;
+}
+
+json::Value rollup_workloads(const FleetView& view, const std::string& hub_cluster) {
+  Value doc = Value::object();
+  doc.set("rollup", Value(true));
+  doc.set("cluster", Value(hub_cluster));
+  for (const char* key : {"members", "clusters", "fleet_totals", "tracked_total"}) {
+    if (const Value* v = view.workloads.find(key)) doc.set(key, *v);
+  }
+  return doc;
+}
+
+json::Value rollup_signals(const FleetView& view, const std::string& hub_cluster) {
+  Value doc = Value::object();
+  doc.set("rollup", Value(true));
+  doc.set("cluster", Value(hub_cluster));
+  for (const char* key : {"coverage_min", "brownout_clusters", "unreachable_clusters",
+                          "duplicate_clusters", "clusters"}) {
+    if (const Value* v = view.signals.find(key)) doc.set(key, *v);
+  }
+  return doc;
+}
+
+json::Value rollup_decisions(const FleetView& view, const std::string& hub_cluster) {
+  Value doc = Value::object();
+  doc.set("rollup", Value(true));
+  doc.set("cluster", Value(hub_cluster));
+  if (const Value* v = view.decisions.find("clusters")) doc.set("clusters", *v);
+  return doc;
 }
 
 std::vector<std::string> hub_metric_families() {
@@ -365,14 +566,19 @@ std::vector<std::string> hub_metric_families() {
       "tpu_pruner_fleet_members",
       "tpu_pruner_fleet_members_unreachable",
       "tpu_pruner_fleet_coverage_ratio_min",
+      "tpu_pruner_fleet_duplicate_clusters",
       "tpu_pruner_fleet_member_up",
       "tpu_pruner_fleet_member_staleness_seconds",
+      "tpu_pruner_fleet_member_backoff_total",
       "tpu_pruner_fleet_coverage_ratio",
       "tpu_pruner_fleet_brownout",
       "tpu_pruner_fleet_workloads_tracked",
       "tpu_pruner_fleet_idle_seconds_total",
       "tpu_pruner_fleet_reclaimed_chip_seconds_total",
       "tpu_pruner_fleet_merge_seconds",
+      "tpu_pruner_fleet_poll_bytes_total",
+      "tpu_pruner_fleet_delta_resyncs_total",
+      "tpu_pruner_fleet_delta_fallbacks_total",
   };
 }
 

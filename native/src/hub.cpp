@@ -1,8 +1,8 @@
 // Fleet federation hub: `tpu-pruner hub --member <url> [--member <url>...]`.
 //
-// One daemon per cluster, one hub per fleet. The hub polls each member
-// daemon's metrics port (/debug/workloads, /debug/signals,
-// /debug/decisions) on --poll-interval, folds the snapshots through
+// One daemon per cluster, one hub per fleet (and, since the
+// delta-federation work, one hub per REGION under a parent hub). The hub
+// polls each member daemon's metrics port, folds the snapshots through
 // fleet::aggregate into the merged fleet view, and serves it on its own
 // metrics port:
 //
@@ -13,12 +13,36 @@
 //   /debug/fleet/clusters    member status table (OK/PENDING/UNREACHABLE)
 //   /metrics                 tpu_pruner_fleet_* families + the
 //                            fleet_merge_seconds poll-round histogram
+//   /debug/{workloads,signals,decisions}
+//                            member-compatible ROLLUP documents
+//                            ("rollup": true + per-cluster sections) so
+//                            this hub can itself be a --member of a
+//                            parent hub (region → global)
+//   /debug/delta             the hub's own change journal over those
+//                            rollup documents (a parent hub polls it
+//                            exactly like a member daemon's)
+//
+// Scaling like the daemon (--fleet-delta on): member polls become
+// /debug/delta cursor polls over ONE pooled connection per member (the
+// shared h2 transport), a quiesced member costs a ~100-byte round, and
+// the merge is CHANGE-GATED — a round in which no member changed (and no
+// status flipped) skips fleet::aggregate entirely, so hub CPU is
+// O(churn), not O(members x fleet-size). --fleet-stream on turns the
+// cursor polls into long-polls (one parked request per member; a change
+// publishes within milliseconds, a quiet interval costs one empty
+// response). Members that do not serve /debug/delta (older daemons)
+// transparently demote to snapshot polling, counted in
+// tpu_pruner_fleet_delta_fallbacks_total.
 //
 // A member going dark becomes an explicit UNREACHABLE row (and pins the
 // fleet coverage minimum to 0) rather than silently dropping out of an
-// average; its last-known ledger data is kept, flagged by status.
-// /readyz fails until at least one member has been polled successfully —
-// a hub that has never seen a member has no fleet view to serve.
+// average; its last-known ledger data is kept, flagged by status. Failed
+// members are re-polled under exponential backoff with jitter (capped at
+// --stale-after, counted per member in
+// tpu_pruner_fleet_member_backoff_total) so one dead member cannot burn a
+// poll slot every round. /readyz fails until at least one member has been
+// polled successfully — a hub that has never seen a member has no fleet
+// view to serve.
 #include <chrono>
 #include <csignal>
 #include <cstdio>
@@ -29,7 +53,9 @@
 #include <vector>
 
 #include "metrics_http.hpp"
+#include "tpupruner/delta.hpp"
 #include "tpupruner/fleet.hpp"
+#include "tpupruner/h2.hpp"
 #include "tpupruner/http.hpp"
 #include "tpupruner/json.hpp"
 #include "tpupruner/log.hpp"
@@ -48,6 +74,8 @@ struct Options {
   int64_t member_timeout_ms = 5000;
   std::string cluster_name;  // hub's own identity ("" → heuristic)
   std::string log_format = "default";
+  std::string fleet_delta = "off";   // on = cursor polls over /debug/delta
+  std::string fleet_stream = "off";  // on = long-poll member updates
 };
 
 struct FlagError : std::runtime_error {
@@ -55,10 +83,20 @@ struct FlagError : std::runtime_error {
 };
 
 // Per-member poll state: the fleet::MemberSnapshot facts plus the
-// monotonic clock of the last success (staleness is derived per round).
+// monotonic clock of the last success, the delta cursor, and the
+// failure-backoff window.
 struct MemberState {
   fleet::MemberSnapshot snap;
   int64_t last_success_mono = -1;
+  delta::DeltaState delta;
+  bool delta_unsupported = false;  // member 404s /debug/delta → snapshot polls
+  int64_t backoff_until_mono = 0;
+  int64_t backoff_s = 0;
+  uint32_t jitter_seed = 0;
+  uint64_t snapshot_fp = 0;    // snapshot mode: fingerprint of the 3 bodies
+  std::string last_status;     // status at the last aggregate (change gate)
+  uint64_t merged_backoffs = 0;  // backoffs folded into the served view
+  bool changed = true;         // this member needs folding into a new view
 };
 
 std::atomic<int>& g_shutdown = util::shutdown_flag();
@@ -77,6 +115,13 @@ int64_t parse_int(const std::string& flag, const std::string& v) {
   } catch (const std::exception&) {
     throw FlagError("invalid integer for " + flag + ": '" + v + "'");
   }
+}
+
+std::string parse_on_off(const std::string& flag, const std::string& v) {
+  if (v != "on" && v != "off") {
+    throw FlagError("invalid value for " + flag + ": '" + v + "' (on|off)");
+  }
+  return v;
 }
 
 Options parse(int argc, char** argv) {
@@ -114,6 +159,10 @@ Options parse(int argc, char** argv) {
       if (opt.member_timeout_ms < 1) throw FlagError("--member-timeout-ms must be >= 1");
     } else if (arg == "--cluster-name") {
       opt.cluster_name = value();
+    } else if (arg == "--fleet-delta") {
+      opt.fleet_delta = parse_on_off("--fleet-delta", value());
+    } else if (arg == "--fleet-stream") {
+      opt.fleet_stream = parse_on_off("--fleet-stream", value());
     } else if (arg == "--log-format") {
       opt.log_format = value();
       if (opt.log_format != "default" && opt.log_format != "json" &&
@@ -128,21 +177,29 @@ Options parse(int argc, char** argv) {
     throw FlagError("tpu-pruner hub needs at least one --member <url> (see --help)");
   }
   if (opt.stale_after_s == 0) opt.stale_after_s = 3 * opt.poll_interval_s;
+  if (opt.fleet_stream == "on" && opt.fleet_delta != "on") {
+    throw FlagError("--fleet-stream on requires --fleet-delta on");
+  }
   return opt;
 }
 
-// One member poll: the three /debug documents, all-or-nothing. Throws a
-// descriptive error on any transport/HTTP/parse failure.
-void poll_member(const http::Client& client, const Options& opt, MemberState& m) {
+// One full-snapshot member poll: the three /debug documents,
+// all-or-nothing. Throws a descriptive error on any transport/HTTP/parse
+// failure. Returns true when any document's bytes changed.
+bool poll_member_snapshot(const h2::Transport& transport, const Options& opt,
+                          MemberState& m) {
+  uint64_t fp = 1469598103934665603ULL;
   auto fetch = [&](const char* path) {
     http::Request req;
     req.url = m.snap.url + path;
     req.timeout_ms = static_cast<int>(opt.member_timeout_ms);
-    http::Response resp = client.request(req);
+    http::Response resp = transport.request(req);
     if (resp.status != 200) {
       throw std::runtime_error(std::string(path) + " returned HTTP " +
                                std::to_string(resp.status));
     }
+    log::counter_add("fleet_poll_bytes_total", resp.body.size());
+    fp = fp * 1099511628211ULL ^ shard::stable_hash(resp.body);
     return json::Value::parse(resp.body);
   };
   json::Value workloads = fetch("/debug/workloads");
@@ -151,11 +208,100 @@ void poll_member(const http::Client& client, const Options& opt, MemberState& m)
   m.snap.workloads = std::move(workloads);
   m.snap.signals = std::move(signals);
   m.snap.decisions = std::move(decisions);
+  bool changed = fp != m.snapshot_fp;
+  m.snapshot_fp = fp;
   // Every member payload is cluster-stamped; keep the last known name so
   // an UNREACHABLE row still says WHICH cluster went dark.
   std::string cluster = m.snap.workloads.get_string("cluster");
   if (cluster.empty()) cluster = m.snap.signals.get_string("cluster");
   if (!cluster.empty()) m.snap.cluster = cluster;
+  return changed;
+}
+
+// One delta-cursor poll: a single /debug/delta request carrying the
+// member's cursor, applied through delta::apply_delta so the held
+// documents stay EQUAL to what snapshot polling would have parsed.
+// Falls back to snapshot polling (sticky) when the member 404s the
+// endpoint. Returns true when anything changed.
+bool poll_member_delta(const h2::Transport& transport, const Options& opt,
+                       MemberState& m, int64_t wait_ms) {
+  if (m.delta_unsupported) return poll_member_snapshot(transport, opt, m);
+  http::Request req;
+  req.url = m.snap.url + "/debug/delta?" + delta::cursor_query(m.delta, wait_ms);
+  req.timeout_ms = static_cast<int>(opt.member_timeout_ms + wait_ms);
+  http::Response resp = transport.request(req);
+  if (resp.status == 404) {
+    // Pre-delta member: demote to snapshot polling and remember it.
+    m.delta_unsupported = true;
+    log::counter_add("fleet_delta_fallbacks_total", 1);
+    log::warn("hub", m.snap.url + " does not serve /debug/delta; " +
+              "falling back to snapshot polls for this member");
+    return poll_member_snapshot(transport, opt, m);
+  }
+  if (resp.status != 200) {
+    throw std::runtime_error("/debug/delta returned HTTP " + std::to_string(resp.status));
+  }
+  log::counter_add("fleet_poll_bytes_total", resp.body.size());
+  json::Value parsed = json::Value::parse(resp.body);
+  delta::MemberDocs docs;
+  delta::ApplyResult res = delta::apply_delta(m.delta, parsed, docs);
+  if (!res.ok) {
+    // Protocol violation (or cursor rejected without a resync body):
+    // drop the cursor so the next poll asks for a full snapshot.
+    m.delta = delta::DeltaState{};
+    throw std::runtime_error("/debug/delta response not applicable; cursor reset");
+  }
+  if (res.resync) log::counter_add("fleet_delta_resyncs_total", 1);
+  if (res.changed) {
+    if (!docs.workloads.is_null()) m.snap.workloads = std::move(docs.workloads);
+    if (!docs.signals.is_null()) m.snap.signals = std::move(docs.signals);
+    if (!docs.decisions.is_null()) m.snap.decisions = std::move(docs.decisions);
+    std::string cluster = m.snap.workloads.get_string("cluster");
+    if (cluster.empty()) cluster = m.snap.signals.get_string("cluster");
+    if (!cluster.empty()) m.snap.cluster = cluster;
+  }
+  return res.changed;
+}
+
+// Shared post-poll bookkeeping for one member attempt (either mode).
+// Returns true when the member changed (data or reachability).
+bool poll_member_once(const h2::Transport& transport, const Options& opt,
+                      MemberState& m, int64_t now_mono, int64_t wait_ms) {
+  bool changed = false;
+  ++m.snap.polls;
+  try {
+    bool data_changed = opt.fleet_delta == "on"
+                            ? poll_member_delta(transport, opt, m, wait_ms)
+                            : poll_member_snapshot(transport, opt, m);
+    changed = data_changed || !m.snap.reachable;
+    m.snap.reachable = true;
+    m.snap.ever_reached = true;
+    m.snap.last_error.clear();
+    m.last_success_mono = util::mono_secs();
+    m.backoff_s = 0;
+    m.backoff_until_mono = 0;
+  } catch (const std::exception& e) {
+    changed = m.snap.reachable;  // reachability flip needs a re-merge
+    m.snap.reachable = false;
+    ++m.snap.failures;
+    m.snap.last_error = e.what();
+    // Exponential backoff with jitter, capped at --stale-after: a dead
+    // member is re-dialed at interval, 2x, 4x, ... never rarer than the
+    // staleness window (so recovery is seen within one UNREACHABLE
+    // period), and never burns a poll slot every round.
+    m.backoff_s = std::min(std::max<int64_t>(m.backoff_s * 2, opt.poll_interval_s),
+                           opt.stale_after_s);
+    uint32_t r = m.jitter_seed = m.jitter_seed * 1664525u + 1013904223u;
+    double jitter = 0.75 + 0.5 * (static_cast<double>(r % 1000) / 1000.0);
+    m.backoff_until_mono =
+        now_mono + std::max<int64_t>(1, static_cast<int64_t>(m.backoff_s * jitter));
+    log::warn("hub", "poll of " + m.snap.url + " (" + m.snap.cluster + ") failed: " +
+              std::string(e.what()) + "; backing off " +
+              std::to_string(m.backoff_until_mono - now_mono) + "s");
+  }
+  m.snap.staleness_s =
+      m.last_success_mono < 0 ? -1 : util::mono_secs() - m.last_success_mono;
+  return changed;
 }
 
 }  // namespace
@@ -167,22 +313,36 @@ Polls N member daemons' metrics ports and serves the merged fleet view:
 per-cluster workload ledgers with fleet totals that provably sum,
 per-cluster-MINIMUM signal coverage (a browned-out or unreachable cluster
 can never hide in a fleet average), recent decisions per cluster, and a
-member status table with explicit UNREACHABLE rows.
+member status table with explicit UNREACHABLE rows. A hub can itself be a
+--member of a parent hub (region -> global rollup): it serves
+member-compatible /debug documents stamped "rollup": true, which the
+parent expands back into per-cluster leaves.
 
 USAGE:
   tpu-pruner hub --member <url> [--member <url> ...] [FLAGS]
 
 FLAGS:
-      --member <URL>            a member daemon's metrics base URL
-                                (http://host:port); repeatable, >= 1 required
+      --member <URL>            a member daemon's (or child hub's) metrics
+                                base URL (http://host:port); repeatable,
+                                >= 1 required
       --metrics-port <P>        serve the fleet view on this port
                                 ("auto" = ephemeral, logged at startup)
                                 [default: 8080]
       --poll-interval <SEC>     seconds between member poll rounds [default: 10]
       --stale-after <SEC>       a member last polled successfully longer ago
-                                than this reads UNREACHABLE
+                                than this reads UNREACHABLE; also caps the
+                                failed-member poll backoff
                                 [default: 3x --poll-interval]
       --member-timeout-ms <MS>  per-request member poll timeout [default: 5000]
+      --fleet-delta <on|off>    poll members through their /debug/delta
+                                change journals: O(churn) bytes + CPU per
+                                round, byte-identical merged views
+                                (members without the endpoint demote to
+                                snapshot polls) [default: off]
+      --fleet-stream <on|off>   long-poll member deltas over the pooled
+                                per-member connection (quiesced members
+                                cost one empty response per interval);
+                                requires --fleet-delta on [default: off]
       --cluster-name <NAME>     the hub's own cluster identity (stamps its
                                 fleet-scoped metric rows; per-member rows keep
                                 their member's label) [default: heuristic —
@@ -214,28 +374,75 @@ int run(int argc, char** argv) {
   std::signal(SIGTERM, on_hub_signal);
   std::signal(SIGINT, on_hub_signal);
 
+  // Register the poll counters up front so the families serve (as zeros)
+  // from the first scrape, not only after the first event.
+  log::counter_add("fleet_poll_bytes_total", 0);
+  if (opt.fleet_delta == "on") {
+    log::counter_add("fleet_delta_resyncs_total", 0);
+    log::counter_add("fleet_delta_fallbacks_total", 0);
+  }
+
+  std::mutex members_mutex;  // guards every MemberState (stream pollers write them)
   std::vector<MemberState> members(opt.members.size());
   for (size_t i = 0; i < opt.members.size(); ++i) {
     members[i].snap.url = opt.members[i];
     members[i].snap.cluster = opt.members[i];  // until the first payload names it
+    members[i].jitter_seed = static_cast<uint32_t>(i * 2654435761u + 1);
   }
   log::info("hub", "federating " + std::to_string(members.size()) + " member(s), poll every " +
             std::to_string(opt.poll_interval_s) + "s, stale after " +
-            std::to_string(opt.stale_after_s) + "s");
+            std::to_string(opt.stale_after_s) + "s, delta " + opt.fleet_delta +
+            ", stream " + opt.fleet_stream);
 
   std::mutex view_mutex;
-  // Latest merged view. Seeded from the unpolled snapshots so the fleet
+  // Latest merged view + the member-compatible rollup documents a parent
+  // hub consumes. Seeded from the unpolled snapshots so the fleet
   // endpoints serve well-formed documents (every member PENDING) from
   // the first request, not "{}" until a poll round lands.
-  fleet::FleetView view = [&] {
+  fleet::FleetView view;
+  json::Value roll_wl, roll_sig, roll_dec;
+  const std::string hub_cluster = fleet::cluster_name();
+  auto remerge = [&](std::vector<fleet::MemberSnapshot> snaps) {
+    fleet::FleetView next = fleet::aggregate(snaps, opt.stale_after_s);
+    json::Value wl = fleet::rollup_workloads(next, hub_cluster);
+    json::Value sig = fleet::rollup_signals(next, hub_cluster);
+    json::Value dec = fleet::rollup_decisions(next, hub_cluster);
+    std::lock_guard<std::mutex> lock(view_mutex);
+    view = std::move(next);
+    roll_wl = std::move(wl);
+    roll_sig = std::move(sig);
+    roll_dec = std::move(dec);
+  };
+  {
     std::vector<fleet::MemberSnapshot> snaps;
     for (const MemberState& m : members) snaps.push_back(m.snap);
-    return fleet::aggregate(snaps, opt.stale_after_s);
-  }();
+    remerge(std::move(snaps));
+  }
   bool ever_synced = false;
   auto last_round = std::make_shared<std::atomic<int64_t>>(util::mono_secs());
 
+  // The hub's own change journal over the rollup documents: a parent hub
+  // polls this hub's /debug/delta exactly as this hub polls a member's.
+  delta::Journal hub_journal;
+  hub_journal.set_renderers(delta::Renderers{
+      [&] { std::lock_guard<std::mutex> lock(view_mutex); return roll_wl; },
+      [&] { std::lock_guard<std::mutex> lock(view_mutex); return roll_sig; },
+      [&] { std::lock_guard<std::mutex> lock(view_mutex); return roll_dec; },
+  });
+
   metrics_http::Server server(opt.metrics_port);
+  // Probes FIRST: the server answers requests from its constructor on,
+  // and an unset ready probe reads 200 — registering it before the data
+  // providers closes the window where a fast client could read "ready"
+  // from a hub that has never polled anyone.
+  server.set_ready_probe([&] {
+    std::lock_guard<std::mutex> lock(view_mutex);
+    return ever_synced;
+  });
+  const int64_t stalled_after = std::max<int64_t>(3 * opt.poll_interval_s, 60);
+  server.set_health_probe([last_round, stalled_after] {
+    return util::mono_secs() - last_round->load() <= stalled_after;
+  });
   server.set_fleet_provider([&](const std::string& sub, const std::string&) -> std::string {
     std::lock_guard<std::mutex> lock(view_mutex);
     if (sub == "workloads") return view.workloads.is_null() ? "{}" : view.workloads.dump();
@@ -245,62 +452,145 @@ int run(int argc, char** argv) {
       return view.clusters.is_null() ? "{}" : view.clusters.dump();
     return "";
   });
+  // Member-compatible rollup surfaces (hub-of-hubs): the same paths a
+  // daemon serves, carrying per-cluster sections a parent hub expands.
+  server.set_workloads_provider([&](const std::string&) {
+    std::lock_guard<std::mutex> lock(view_mutex);
+    return roll_wl.is_null() ? std::string("{}") : roll_wl.dump();
+  });
+  server.set_signals_provider([&] {
+    std::lock_guard<std::mutex> lock(view_mutex);
+    return roll_sig.is_null() ? std::string("{}") : roll_sig.dump();
+  });
+  server.set_decisions_provider([&](const std::string&) {
+    std::lock_guard<std::mutex> lock(view_mutex);
+    return roll_dec.is_null() ? std::string("{}") : roll_dec.dump();
+  });
+  server.set_delta_provider([&](const std::string& query, const std::function<bool()>& abort) {
+    return hub_journal.handle_request(query, abort);
+  });
   server.set_extra_metrics_provider([&](bool openmetrics) {
     std::lock_guard<std::mutex> lock(view_mutex);
     return openmetrics ? view.metrics_openmetrics : view.metrics_text;
   });
-  // Ready = member sync happened: at least one member answered a full
-  // poll at least once. A hub that never reached anyone has no fleet
-  // view and must not pass readiness.
-  server.set_ready_probe([&] {
-    std::lock_guard<std::mutex> lock(view_mutex);
-    return ever_synced;
-  });
-  // Alive = the poll loop keeps rounding (3 intervals of slack, floor 60s
-  // — same shape as the daemon's cycle-staleness probe).
-  const int64_t stalled_after = std::max<int64_t>(3 * opt.poll_interval_s, 60);
-  server.set_health_probe([last_round, stalled_after] {
-    return util::mono_secs() - last_round->load() <= stalled_after;
-  });
+  // Readiness above = member sync happened: at least one member answered
+  // a full poll at least once. Liveness = the poll loop keeps rounding
+  // (3 intervals of slack, floor 60s — the daemon's cycle-staleness
+  // probe's shape).
 
-  http::Client client;
-  // Member polls fan out over the shared worker pool: each member writes
-  // only its own MemberState slot and http::Client::request is
-  // thread-safe, so one slow (or timing-out) member costs the round
-  // max(member latencies) instead of the sum — fleet_merge_seconds no
-  // longer stretches for everyone when a single cluster drags.
+  // One pooled connection per member endpoint (h2 when the member speaks
+  // it, keep-alive HTTP/1.1 otherwise) — a poll round opens ZERO new
+  // connections in steady state, where the old per-request client paid a
+  // fresh TCP handshake per document per member per round.
+  h2::Transport transport(h2::Mode::Auto);
+  const bool streaming = opt.fleet_stream == "on";
+  std::atomic<bool> need_merge{true};
+
+  // Streaming mode: one long-poll loop per member. The thread parks
+  // inside the member's /debug/delta for up to ~one interval; a change
+  // lands here within milliseconds of the member publishing it.
+  std::vector<std::thread> pollers;
+  if (streaming) {
+    // Park each long-poll for up to half the staleness window, clamped to
+    // [1s, 5s]: a quiesced member then costs one ~100-byte response per
+    // PARK (not per round), its last-success clock refreshes comfortably
+    // inside --stale-after, and an in-flight park bounds shutdown drain
+    // to ~5s (a parked request cannot be interrupted mid-read).
+    const int64_t wait_ms = std::min<int64_t>(
+        std::max<int64_t>(opt.stale_after_s * 500, 1000), 5000);
+    for (size_t i = 0; i < members.size(); ++i) {
+      pollers.emplace_back([&, i, wait_ms] {
+        while (!g_shutdown.load()) {
+          int64_t now = util::mono_secs();
+          bool backing_off;
+          {
+            std::lock_guard<std::mutex> lock(members_mutex);
+            backing_off = members[i].backoff_until_mono > now;
+            if (backing_off) ++members[i].snap.backoffs;
+          }
+          if (backing_off) {
+            std::this_thread::sleep_for(std::chrono::milliseconds(200));
+            continue;
+          }
+          // The long poll itself runs OUTSIDE members_mutex (it can park
+          // for a whole interval); only the state apply takes the lock.
+          MemberState scratch;
+          {
+            std::lock_guard<std::mutex> lock(members_mutex);
+            scratch = members[i];
+          }
+          bool changed = poll_member_once(transport, opt, scratch, now, wait_ms);
+          {
+            std::lock_guard<std::mutex> lock(members_mutex);
+            scratch.snap.backoffs = members[i].snap.backoffs;  // kept by the skip path
+            members[i] = std::move(scratch);
+            if (changed) members[i].changed = true;
+          }
+          if (changed) need_merge.store(true);
+        }
+      });
+    }
+  }
+
   shard::Pool& poll_pool =
       shard::pool(std::min<size_t>(std::max<size_t>(members.size(), 1), 16));
   while (!g_shutdown.load()) {
     auto round_start = std::chrono::steady_clock::now();
-    poll_pool.run(members.size(), [&](size_t i) {
-      MemberState& m = members[i];
-      ++m.snap.polls;
-      try {
-        poll_member(client, opt, m);
-        m.snap.reachable = true;
-        m.snap.ever_reached = true;
-        m.snap.last_error.clear();
-        m.last_success_mono = util::mono_secs();
-      } catch (const std::exception& e) {
-        m.snap.reachable = false;
-        ++m.snap.failures;
-        m.snap.last_error = e.what();
-        log::warn("hub", "poll of " + m.snap.url + " (" + m.snap.cluster + ") failed: " +
-                  e.what());
-      }
-      m.snap.staleness_s =
-          m.last_success_mono < 0 ? -1 : util::mono_secs() - m.last_success_mono;
-    });
+    if (!streaming) {
+      // Member polls fan out over the shared worker pool: each member
+      // writes only its own MemberState slot, so one slow (or
+      // timing-out) member costs the round max(member latencies), not
+      // the sum.
+      int64_t now = util::mono_secs();
+      poll_pool.run(members.size(), [&](size_t i) {
+        MemberState& m = members[i];
+        if (m.backoff_until_mono > now) {
+          // Failure backoff: skip the slot, keep serving last-known data.
+          ++m.snap.backoffs;
+          m.snap.staleness_s =
+              m.last_success_mono < 0 ? -1 : util::mono_secs() - m.last_success_mono;
+          return;
+        }
+        if (poll_member_once(transport, opt, m, now, 0)) m.changed = true;
+      });
+    }
+    // Change-gated merge: re-aggregate when any member's data changed OR
+    // any member's derived status flipped (staleness can flip a member
+    // UNREACHABLE without any poll succeeding). With --fleet-delta off
+    // every successful snapshot round re-merges (exact legacy parity);
+    // with delta on, a fully quiesced round skips the merge — the hub's
+    // cost becomes O(churn).
     {
-      std::vector<fleet::MemberSnapshot> snaps;
-      snaps.reserve(members.size());
-      for (const MemberState& m : members) snaps.push_back(m.snap);
-      fleet::FleetView next = fleet::aggregate(snaps, opt.stale_after_s);
-      std::lock_guard<std::mutex> lock(view_mutex);
-      view = std::move(next);
-      for (const MemberState& m : members) {
-        if (m.snap.ever_reached) ever_synced = true;
+      std::lock_guard<std::mutex> lock(members_mutex);
+      bool any_changed = need_merge.exchange(false);
+      for (MemberState& m : members) {
+        std::string status = fleet::member_status(m.snap, opt.stale_after_s);
+        // A backoff tick must surface in the served counters even though
+        // no member data changed (outage rounds re-merge; bounded by the
+        // outage itself).
+        if (m.changed || status != m.last_status ||
+            m.snap.backoffs != m.merged_backoffs) {
+          any_changed = true;
+        }
+        m.last_status = std::move(status);
+        m.merged_backoffs = m.snap.backoffs;
+      }
+      if (opt.fleet_delta != "on") any_changed = true;
+      if (any_changed) {
+        std::vector<fleet::MemberSnapshot> snaps;
+        snaps.reserve(members.size());
+        for (MemberState& m : members) {
+          snaps.push_back(m.snap);
+          m.changed = false;
+        }
+        remerge(std::move(snaps));
+        {
+          std::lock_guard<std::mutex> lock2(view_mutex);
+          for (const MemberState& m : members) {
+            if (m.snap.ever_reached) ever_synced = true;
+          }
+        }
+        if (hub_journal.active()) hub_journal.publish();
       }
     }
     double round_secs =
@@ -308,13 +598,19 @@ int run(int argc, char** argv) {
     log::histogram_observe("fleet_merge_seconds", "", round_secs);
     last_round->store(util::mono_secs());
 
-    // Interruptible interval sleep (same idiom as the daemon loop).
+    // Interruptible interval sleep (same idiom as the daemon loop). In
+    // streaming mode a member change wakes the merge early.
     auto interval = std::chrono::seconds(opt.poll_interval_s);
     while (!g_shutdown.load() &&
            std::chrono::steady_clock::now() - round_start < interval) {
+      if (streaming && need_merge.load()) break;
       std::this_thread::sleep_for(std::chrono::milliseconds(100));
       last_round->store(util::mono_secs());  // sleeping != stalled
     }
+  }
+  hub_journal.wake_all();
+  for (std::thread& t : pollers) {
+    if (t.joinable()) t.join();
   }
   log::info("hub", std::string("Received ") +
             (g_shutdown.load() == SIGINT ? "SIGINT" : "SIGTERM") + ", shutting down");

@@ -17,7 +17,26 @@ std::string fmt_value(double v) {
   return buf;
 }
 
+// Decision-cache unit bound (satellite of the churn-storm hardening):
+// overridable for tests via $TPU_PRUNER_INCREMENTAL_CACHE_CAP.
+size_t cache_unit_cap() {
+  static const size_t cap = [] {
+    if (auto v = util::env("TPU_PRUNER_INCREMENTAL_CACHE_CAP"); v && !v->empty()) {
+      try {
+        return static_cast<size_t>(std::stoull(*v));
+      } catch (const std::exception&) {
+      }
+    }
+    return size_t{65536};
+  }();
+  return cap == 0 ? 1 : cap;
+}
+
 }  // namespace
+
+// Defined with the MetricsState block below.
+void note_cache_metrics(size_t units, uint64_t evicted_delta);
+void note_journal_metrics(size_t depth, uint64_t overflows_total);
 
 void Engine::configure(bool enabled, uint64_t config_fingerprint) {
   std::lock_guard<std::mutex> lock(mutex_);
@@ -67,6 +86,10 @@ Engine::Plan Engine::plan_cycle(const std::vector<core::PodMetricSample>& sample
   Plan plan;
   plan.active = enabled_;
   plan.pods_total = samples.size();
+  // Journal instrumentation rides every plan: the drained depth is the
+  // churn the informer absorbed since the last cycle, the overflow count
+  // is how often the bounded journal degraded to globally dirty.
+  if (enabled_) note_journal_metrics(drain.paths.size(), drain.overflows_total);
   if (!enabled_ || drain.all || !store_trusted) {
     plan.full = true;
     plan.recompute.reserve(samples.size());
@@ -220,6 +243,18 @@ void Engine::commit_cycle(const Plan& plan, std::vector<Unit> fresh_units) {
     stored = std::move(u);
     index_unit_locked(stored);
   }
+  // Hard cache bound (TPU_PRUNER_INCREMENTAL_CACHE_CAP, def 65536 units):
+  // an unbounded decision cache can't hide behind fast p50s — beyond the
+  // cap, units are evicted (correctness-safe: an evicted unit simply
+  // recomputes when its pods next appear) and counted.
+  uint64_t evicted = 0;
+  const size_t cap = cache_unit_cap();
+  for (auto it = units_.begin(); units_.size() > cap && it != units_.end();) {
+    unindex_unit_locked(it->second);
+    it = units_.erase(it);
+    ++evicted;
+  }
+  note_cache_metrics(units_.size(), evicted);
   // Pod entries whose unit is gone (vanished candidates) must not keep
   // answering the next plan's membership lookups.
   for (auto it = pod_unit_.begin(); it != pod_unit_.end();) {
@@ -328,6 +363,10 @@ struct MetricsState {
   uint64_t cached_pods = 0;
   uint64_t dirty_pods = 0;
   uint64_t full_recomputes = 0;
+  uint64_t journal_depth = 0;       // dirty paths drained at the last plan
+  uint64_t journal_overflows = 0;   // cumulative journal-cap overflows
+  uint64_t cache_units = 0;         // decision-cache units after the last commit
+  uint64_t cache_evictions = 0;     // cumulative cap evictions
 };
 
 MetricsState& metrics_state() {
@@ -336,6 +375,20 @@ MetricsState& metrics_state() {
 }
 
 }  // namespace
+
+void note_cache_metrics(size_t units, uint64_t evicted_delta) {
+  MetricsState& s = metrics_state();
+  std::lock_guard<std::mutex> lock(s.mutex);
+  s.cache_units = units;
+  s.cache_evictions += evicted_delta;
+}
+
+void note_journal_metrics(size_t depth, uint64_t overflows_total) {
+  MetricsState& s = metrics_state();
+  std::lock_guard<std::mutex> lock(s.mutex);
+  s.journal_depth = depth;
+  s.journal_overflows = overflows_total;
+}
 
 void publish_metrics(const Engine::Plan& plan) {
   MetricsState& s = metrics_state();
@@ -365,18 +418,37 @@ std::string render_metrics(bool openmetrics) {
         "Candidate pods served from the decision cache this cycle");
   gauge("incremental_dirty_pods", std::to_string(s.dirty_pods),
         "Candidate pods recomputed this cycle (the dirty set)");
-  const char* counter_name = "tpu_pruner_incremental_full_recomputes_total";
-  out += std::string("# HELP ") + counter_name +
-         " Cycles that fell back to a full recompute (relist, unsynced store, config edge)\n";
-  out += std::string("# TYPE ") +
-         (openmetrics ? "tpu_pruner_incremental_full_recomputes" : counter_name) + " counter\n";
-  out += std::string(counter_name) + " " + std::to_string(s.full_recomputes) + "\n";
+  gauge("incremental_journal_depth", std::to_string(s.journal_depth),
+        "Informer dirty-journal paths drained at the last cycle's plan (the "
+        "churn absorbed since the previous cycle; bounded by the journal cap)");
+  gauge("incremental_cache_units", std::to_string(s.cache_units),
+        "Decision-cache units held after the last commit (bounded by "
+        "TPU_PRUNER_INCREMENTAL_CACHE_CAP)");
+  auto counter = [&](const char* name, uint64_t value, const char* help) {
+    std::string full = std::string("tpu_pruner_") + name + "_total";
+    out += "# HELP " + full + " " + help + "\n";
+    out += "# TYPE " +
+           (openmetrics ? std::string("tpu_pruner_") + name : full) + " counter\n";
+    out += full + " " + std::to_string(value) + "\n";
+  };
+  counter("incremental_full_recomputes", s.full_recomputes,
+          "Cycles that fell back to a full recompute (relist, unsynced store, config edge)");
+  counter("incremental_journal_overflows", s.journal_overflows,
+          "Times the bounded informer dirty journal overflowed and degraded to "
+          "globally dirty (churn storm; invalidation is never silently dropped)");
+  counter("incremental_cache_evictions", s.cache_evictions,
+          "Decision-cache units evicted by the cache bound (evicted units "
+          "recompute when next seen — CPU, never correctness)");
   return out;
 }
 
 std::vector<std::string> metric_families() {
   return {"tpu_pruner_incremental_cache_hit_ratio", "tpu_pruner_incremental_cached_pods",
-          "tpu_pruner_incremental_dirty_pods", "tpu_pruner_incremental_full_recomputes_total"};
+          "tpu_pruner_incremental_dirty_pods", "tpu_pruner_incremental_full_recomputes_total",
+          "tpu_pruner_incremental_journal_depth",
+          "tpu_pruner_incremental_journal_overflows_total",
+          "tpu_pruner_incremental_cache_units",
+          "tpu_pruner_incremental_cache_evictions_total"};
 }
 
 void reset_for_test() {
@@ -388,6 +460,10 @@ void reset_for_test() {
   s.cached_pods = 0;
   s.dirty_pods = 0;
   s.full_recomputes = 0;
+  s.journal_depth = 0;
+  s.journal_overflows = 0;
+  s.cache_units = 0;
+  s.cache_evictions = 0;
 }
 
 }  // namespace tpupruner::incremental

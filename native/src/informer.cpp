@@ -164,10 +164,18 @@ void Reflector::journal_touch(const std::string& path) {
   if (dirty_paths_.size() >= kDirtyJournalCap) {
     dirty_paths_.clear();
     dirty_all_ = true;
+    ++journal_overflows_;
     return;
   }
   dirty_paths_.push_back(path);
 }
+
+uint64_t Reflector::journal_overflows() const {
+  std::lock_guard<std::mutex> lock(dirty_mutex_);
+  return journal_overflows_;
+}
+
+size_t dirty_journal_cap() { return kDirtyJournalCap; }
 
 void Reflector::journal_all() {
   if (!journal_enabled_.load(std::memory_order_relaxed)) return;
@@ -734,7 +742,10 @@ void ClusterCache::enable_dirty_journal() {
 
 ClusterCache::DirtyDrain ClusterCache::drain_dirty() const {
   DirtyDrain out;
-  for (auto& r : reflectors_) r->drain_dirty(out.paths, out.all);
+  for (auto& r : reflectors_) {
+    r->drain_dirty(out.paths, out.all);
+    out.overflows_total += r->journal_overflows();
+  }
   return out;
 }
 

@@ -5,6 +5,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <cstring>
 #include <map>
 #include <stdexcept>
@@ -47,6 +48,21 @@ const std::map<std::string, std::string>& help_texts() {
       {"scale_patch_seconds", "Per-target actuation latency (Event POST + pause PATCH)"},
       {"fleet_merge_seconds", "Hub poll round latency: polling every member and "
                               "merging the fleet view (tpu-pruner hub)"},
+      {"delta_requests_total", "/debug/delta polls served by this process's "
+                               "change journal"},
+      {"delta_resyncs_served_total", "Delta polls whose cursor had aged out of the "
+                                     "journal window (or mismatched the journal "
+                                     "generation) and were answered with a full "
+                                     "snapshot resync"},
+      {"fleet_poll_bytes_total", "Member poll response bytes the hub has moved "
+                                 "(both snapshot and delta modes — the "
+                                 "delta-vs-snapshot wire saving reads directly "
+                                 "off this counter)"},
+      {"fleet_delta_resyncs_total", "Member polls that fell back to a full-snapshot "
+                                    "resync (member restart, journal overflow, or "
+                                    "first contact)"},
+      {"fleet_delta_fallbacks_total", "Members demoted to snapshot polling because "
+                                      "they do not serve /debug/delta"},
   };
   return kHelp;
 }
@@ -98,6 +114,16 @@ Server::Server(int port) {
 Server::~Server() {
   stop_.store(true);
   if (thread_.joinable()) thread_.join();
+  {
+    // Connection threads observe stop_ through their poll loops (and
+    // long-poll providers through the abort predicate), so these joins
+    // complete within a poll slice.
+    std::lock_guard<std::mutex> lock(conns_mutex_);
+    for (auto& c : conns_) {
+      if (c->thread.joinable()) c->thread.join();
+    }
+    conns_.clear();
+  }
   if (listen_fd_ >= 0) ::close(listen_fd_);
 }
 
@@ -135,6 +161,12 @@ void Server::set_fleet_provider(
     std::function<std::string(const std::string&, const std::string&)> provider) {
   std::lock_guard<std::mutex> lock(probe_mutex_);
   fleet_provider_ = std::move(provider);
+}
+
+void Server::set_delta_provider(
+    std::function<std::string(const std::string&, const std::function<bool()>&)> provider) {
+  std::lock_guard<std::mutex> lock(probe_mutex_);
+  delta_provider_ = std::move(provider);
 }
 
 void Server::set_extra_metrics_provider(std::function<std::string(bool)> provider) {
@@ -206,20 +238,61 @@ void Server::serve() {
     if (rc <= 0) continue;
     int fd = ::accept(listen_fd_, nullptr, nullptr);
     if (fd < 0) continue;
+    // One thread per connection: a federation hub holds ONE persistent
+    // keep-alive connection per member (possibly parked in a
+    // /debug/delta long-poll) while Prometheus scrapes and kubelet
+    // probes keep arriving — a sequential accept loop would wedge.
+    std::lock_guard<std::mutex> lock(conns_mutex_);
+    // Sweep finished connections so the vector tracks live ones only.
+    for (auto it = conns_.begin(); it != conns_.end();) {
+      if ((*it)->done.load()) {
+        if ((*it)->thread.joinable()) (*it)->thread.join();
+        it = conns_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    if (conns_.size() >= 256) {  // runaway-client backstop
+      ::close(fd);
+      continue;
+    }
+    auto conn = std::make_unique<Conn>();
+    Conn* raw = conn.get();
+    conn->thread = std::thread([this, fd, raw] {
+      handle_connection(fd);
+      raw->done.store(true);
+    });
+    conns_.push_back(std::move(conn));
+  }
+}
+
+void Server::handle_connection(int fd) {
+  bool keep_alive = true;
+  while (keep_alive && !stop_.load()) {
     // Read until the header block is complete (probes may split segments
-    // mid-line), bounded by the buffer and the 1s socket timeout.
+    // mid-line), bounded by the buffer; between requests the socket is
+    // polled in 200 ms slices so server stop is honored promptly and an
+    // idle keep-alive peer costs nothing.
     char buf[8192];
-    struct timeval tv{1, 0};
-    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     size_t have = 0;
+    bool got_request = false;
+    auto idle_deadline = std::chrono::steady_clock::now() + std::chrono::seconds(75);
     while (have < sizeof(buf) - 1) {
+      struct pollfd pfd{fd, POLLIN, 0};
+      int prc = ::poll(&pfd, 1, 200);
+      if (stop_.load() || std::chrono::steady_clock::now() > idle_deadline) break;
+      if (prc <= 0) continue;
       ssize_t n = ::recv(fd, buf + have, sizeof(buf) - 1 - have, 0);
-      if (n <= 0) break;
+      if (n <= 0) break;  // peer closed or error
       have += static_cast<size_t>(n);
       buf[have] = '\0';
-      if (std::strstr(buf, "\r\n\r\n") || std::strstr(buf, "\n\n")) break;
+      if (std::strstr(buf, "\r\n\r\n") || std::strstr(buf, "\n\n")) {
+        got_request = true;
+        break;
+      }
     }
     buf[have] = '\0';
+    if (!got_request) break;
 
     std::string path, query;
     bool is_get = std::strncmp(buf, "GET ", 4) == 0;
@@ -240,6 +313,12 @@ void Server::serve() {
         size_t end = lower.find_first_of("\r\n", pos + 1);
         std::string accept = lower.substr(pos + 8, end - pos - 8);
         want_openmetrics = accept.find("application/openmetrics-text") != std::string::npos;
+      }
+      // HTTP/1.1 defaults to keep-alive; honor an explicit close (and
+      // close on HTTP/1.0, which never promised persistence).
+      if (lower.find("connection: close") != std::string::npos ||
+          lower.find("http/1.0") != std::string::npos) {
+        keep_alive = false;
       }
     }
 
@@ -319,6 +398,22 @@ void Server::serve() {
         status_text = "Not Found";
         body = "signal watchdog not available\n";
       }
+    } else if (path == "/debug/delta") {
+      std::function<std::string(const std::string&, const std::function<bool()>&)> provider;
+      {
+        std::lock_guard<std::mutex> lock(probe_mutex_);
+        provider = delta_provider_;
+      }
+      if (provider) {
+        content_type = "application/json";
+        // May long-poll (wait_ms=…): runs on this connection's own
+        // thread, aborted when the server stops.
+        body = provider(query, [this] { return stop_.load(); });
+      } else {
+        status = 404;
+        status_text = "Not Found";
+        body = "delta journal not available on this process\n";
+      }
     } else if (path == "/debug/fleet" || util::starts_with(path, "/debug/fleet/")) {
       std::function<std::string(const std::string&, const std::string&)> provider;
       {
@@ -375,6 +470,9 @@ void Server::serve() {
              "/debug/cycles/<id> serves one full capsule (--flight-dir)\"}," +
              "{\"path\":\"/debug/signals\",\"description\":\"signal-quality watchdog: per-pod "
              "evidence verdicts + fleet coverage (--signal-guard on)\"}," +
+             "{\"path\":\"/debug/delta\",\"description\":\"delta-federation change journal: "
+             "?since=<epoch>&gen=<generation>&wait_ms=<long-poll> serves O(churn) surface "
+             "diffs (full snapshot on first poll or aged-out cursor)\"}," +
              "{\"path\":\"/debug/fleet/workloads\",\"description\":\"federation hub: merged "
              "per-cluster workload ledgers + fleet totals (tpu-pruner hub)\"}," +
              "{\"path\":\"/debug/fleet/signals\",\"description\":\"federation hub: per-cluster-"
@@ -391,13 +489,18 @@ void Server::serve() {
                          : "text/plain; version=0.0.4";
       body = render_exposition(want_openmetrics);
     }
+    if (stop_.load()) keep_alive = false;
     std::string resp = "HTTP/1.1 " + std::to_string(status) + " " + status_text +
                        "\r\nContent-Type: " + content_type +
                        "\r\nContent-Length: " + std::to_string(body.size()) +
-                       "\r\nConnection: close\r\n\r\n" + body;
-    ::send(fd, resp.data(), resp.size(), MSG_NOSIGNAL);
-    ::close(fd);
+                       "\r\nConnection: " + (keep_alive ? "keep-alive" : "close") +
+                       "\r\n\r\n" + body;
+    if (::send(fd, resp.data(), resp.size(), MSG_NOSIGNAL) !=
+        static_cast<ssize_t>(resp.size())) {
+      break;
+    }
   }
+  ::close(fd);
 }
 
 }  // namespace tpupruner::metrics_http

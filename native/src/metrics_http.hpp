@@ -11,9 +11,11 @@
 
 #include <atomic>
 #include <functional>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
+#include <vector>
 
 namespace tpupruner::metrics_http {
 
@@ -61,6 +63,15 @@ class Server {
   void set_fleet_provider(
       std::function<std::string(const std::string&, const std::string&)> provider);
 
+  // /debug/delta provider (the delta-federation change journal): receives
+  // the raw query string ("since=…&gen=…&wait_ms=…") and an abort
+  // predicate (true once the server is stopping) the provider must poll
+  // while long-polling. Runs on the connection's own thread, so a held
+  // request blocks nobody else. Unset → 404.
+  void set_delta_provider(
+      std::function<std::string(const std::string&, const std::function<bool()>&)>
+          provider);
+
   // Extra /metrics families rendered outside the counter/histogram
   // registries (the ledger's bounded-cardinality workload series). The
   // provider returns ready-made exposition text (HELP/TYPE included);
@@ -69,6 +80,11 @@ class Server {
 
  private:
   void serve();
+  // One accepted connection: sequential HTTP/1.1 keep-alive requests until
+  // the peer closes, an error, or server stop. Runs on its own thread so
+  // a long-poll (/debug/delta?wait_ms=…) or a hub holding a persistent
+  // connection never blocks the accept loop or other clients.
+  void handle_connection(int fd);
   std::string render_exposition(bool openmetrics) const;
 
   int listen_fd_ = -1;
@@ -81,9 +97,18 @@ class Server {
   std::function<std::string(const std::string&)> cycles_provider_;
   std::function<std::string()> signals_provider_;
   std::function<std::string(const std::string&, const std::string&)> fleet_provider_;
+  std::function<std::string(const std::string&, const std::function<bool()>&)>
+      delta_provider_;
   std::function<std::string(bool)> extra_metrics_provider_;
   mutable std::mutex probe_mutex_;
   std::thread thread_;
+  // Connection threads: swept as they finish, joined at shutdown.
+  struct Conn {
+    std::thread thread;
+    std::atomic<bool> done{false};
+  };
+  std::mutex conns_mutex_;
+  std::vector<std::unique_ptr<Conn>> conns_;
 };
 
 }  // namespace tpupruner::metrics_http

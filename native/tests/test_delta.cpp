@@ -1,0 +1,375 @@
+// Delta-federation protocol units: the member-side change journal
+// (epoch monotonicity, quiesced answers, coalescing, journal-window
+// overflow → resync, generation mismatch → resync, decisions ring
+// reconstruction) and the hub-side apply_delta state machine — including
+// the property the whole tentpole rests on: after ANY publish/poll
+// interleaving, the hub's reconstructed documents EQUAL the member's
+// full renders. The e2e surface (real hub binary over scripted members)
+// rides tests/test_fleet_delta.py; the concurrency shape (publishers vs
+// long-pollers) runs here under `just tsan-fleet`.
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "testing.hpp"
+#include "tpupruner/delta.hpp"
+#include "tpupruner/json.hpp"
+
+namespace delta = tpupruner::delta;
+using tpupruner::json::Value;
+
+namespace {
+
+// A mutable member-surface fixture the journal renders from.
+struct Member {
+  Value workloads = Value::object();
+  Value signals = Value::object();
+  Value decisions = Value::object();
+  std::map<std::string, Value> rows;
+  std::vector<Value> dec_records;
+  int64_t dec_capacity = 4;
+  int64_t dec_dropped = 0;
+
+  Member() {
+    signals.set("cluster", Value("unit"));
+    signals.set("enabled", Value(true));
+    signals.set("coverage_ratio", Value(1.0));
+    rebuild();
+  }
+
+  void set_row(const std::string& key, double reclaimed) {
+    Value row = Value::object();
+    row.set("workload", Value(key));
+    row.set("kind", Value("Deployment"));
+    row.set("namespace", Value("ml"));
+    row.set("name", Value(key));
+    row.set("chips", Value(static_cast<int64_t>(4)));
+    row.set("idle_seconds", Value(1.0));
+    row.set("reclaimed_chip_seconds", Value(reclaimed));
+    rows[key] = std::move(row);
+    rebuild();
+  }
+
+  void remove_row(const std::string& key) {
+    rows.erase(key);
+    rebuild();
+  }
+
+  void append_decision(const std::string& pod) {
+    Value rec = Value::object();
+    rec.set("pod", Value(pod));
+    dec_records.push_back(std::move(rec));
+    while (dec_records.size() > static_cast<size_t>(dec_capacity)) {
+      dec_records.erase(dec_records.begin());
+      ++dec_dropped;
+    }
+    rebuild();
+  }
+
+  void rebuild() {
+    // Member array order: key-ascending then stable reclaimed-descending
+    // (ledger::workloads_json's comparator).
+    std::vector<const Value*> ordered;
+    for (const auto& [k, v] : rows) ordered.push_back(&v);
+    std::stable_sort(ordered.begin(), ordered.end(), [](const Value* a, const Value* b) {
+      return a->find("reclaimed_chip_seconds")->as_double() >
+             b->find("reclaimed_chip_seconds")->as_double();
+    });
+    Value arr = Value::array();
+    double reclaimed = 0;
+    for (const Value* r : ordered) {
+      reclaimed += r->find("reclaimed_chip_seconds")->as_double();
+      arr.push_back(*r);
+    }
+    Value totals = Value::object();
+    totals.set("idle_seconds", Value(static_cast<double>(rows.size())));
+    totals.set("active_seconds", Value(0.0));
+    totals.set("reclaimed_chip_seconds", Value(reclaimed));
+    workloads = Value::object();
+    workloads.set("cluster", Value("unit"));
+    workloads.set("sort", Value("reclaimed"));
+    workloads.set("tracked", Value(static_cast<int64_t>(rows.size())));
+    workloads.set("totals", std::move(totals));
+    workloads.set("workloads", std::move(arr));
+
+    Value dec_arr = Value::array();
+    for (const Value& r : dec_records) dec_arr.push_back(r);
+    decisions = Value::object();
+    decisions.set("cluster", Value("unit"));
+    decisions.set("capacity", Value(dec_capacity));
+    decisions.set("dropped", Value(dec_dropped));
+    decisions.set("decisions", std::move(dec_arr));
+  }
+};
+
+struct Harness {
+  Member member;
+  delta::Journal journal;
+  delta::DeltaState state;
+  delta::MemberDocs docs;
+
+  Harness() {
+    journal.set_renderers(delta::Renderers{
+        [this] { return member.workloads; },
+        [this] { return member.signals; },
+        [this] { return member.decisions; },
+    });
+    // Activate (the first poll primes the journal from the renderers).
+  }
+
+  Value poll(int64_t wait_ms = 0) {
+    std::string q = delta::cursor_query(state, wait_ms);
+    Value resp = Value::parse(journal.handle_request(q, nullptr));
+    delta::ApplyResult res = delta::apply_delta(state, resp, docs);
+    TP_CHECK(res.ok);
+    return resp;
+  }
+
+  // The tentpole invariant: reconstruction equals the member's renders.
+  void check_equal() {
+    TP_CHECK_EQ(docs.workloads.dump(), member.workloads.dump());
+    TP_CHECK_EQ(docs.signals.dump(), member.signals.dump());
+    TP_CHECK_EQ(docs.decisions.dump(), member.decisions.dump());
+  }
+};
+
+}  // namespace
+
+TP_TEST(delta_first_poll_serves_full_snapshot) {
+  Harness h;
+  h.member.set_row("Deployment/ml/a", 5.0);
+  Value resp = h.poll();
+  TP_CHECK(resp.find("full") != nullptr);
+  TP_CHECK(resp.find("resync") == nullptr);  // first contact, not a resync
+  h.check_equal();
+}
+
+TP_TEST(delta_quiesced_poll_is_tiny_and_changeless) {
+  Harness h;
+  h.member.set_row("Deployment/ml/a", 5.0);
+  h.poll();
+  std::string q = delta::cursor_query(h.state, 0);
+  std::string body = h.journal.handle_request(q, nullptr);
+  TP_CHECK(body.size() < 120);  // {"cluster","epoch","gen","since"} only
+  Value resp = Value::parse(body);
+  TP_CHECK(resp.find("surfaces") == nullptr);
+  delta::ApplyResult res = delta::apply_delta(h.state, resp, h.docs);
+  TP_CHECK(res.ok);
+  TP_CHECK(!res.changed);
+}
+
+TP_TEST(delta_row_churn_ships_only_changed_rows) {
+  Harness h;
+  for (int i = 0; i < 8; ++i) h.member.set_row("Deployment/ml/r" + std::to_string(i), i);
+  h.poll();
+  h.journal.publish();
+  h.member.set_row("Deployment/ml/r3", 100.0);
+  h.journal.publish();
+  Value resp = h.poll();
+  const Value* wl = resp.find("surfaces")->find("workloads");
+  TP_CHECK(wl != nullptr);
+  TP_CHECK_EQ(wl->find("upserts")->as_array().size(), size_t{1});
+  TP_CHECK_EQ(wl->find("upserts")->as_array()[0].get_string("workload"),
+              "Deployment/ml/r3");
+  h.check_equal();  // incl. the re-sorted array order (r3 now leads)
+}
+
+TP_TEST(delta_coalesces_repeated_changes_to_one_row) {
+  Harness h;
+  h.member.set_row("Deployment/ml/a", 1.0);
+  h.poll();
+  for (int i = 0; i < 5; ++i) {
+    h.member.set_row("Deployment/ml/a", 10.0 + i);
+    h.journal.publish();
+  }
+  Value resp = h.poll();
+  // Five publishes between polls, ONE upsert: latest-state per key, the
+  // informer's coalescing rule at the fleet layer.
+  const Value* wl = resp.find("surfaces")->find("workloads");
+  TP_CHECK_EQ(wl->find("upserts")->as_array().size(), size_t{1});
+  TP_CHECK_EQ(wl->find("upserts")->as_array()[0].find("reclaimed_chip_seconds")->as_double(),
+              14.0);
+  h.check_equal();
+}
+
+TP_TEST(delta_remove_ships_tombstone) {
+  Harness h;
+  h.member.set_row("Deployment/ml/a", 1.0);
+  h.member.set_row("Deployment/ml/b", 2.0);
+  h.poll();
+  h.member.remove_row("Deployment/ml/a");
+  h.journal.publish();
+  Value resp = h.poll();
+  const Value* wl = resp.find("surfaces")->find("workloads");
+  TP_CHECK_EQ(wl->find("removes")->as_array().size(), size_t{1});
+  TP_CHECK_EQ(wl->find("removes")->as_array()[0].as_string(), "Deployment/ml/a");
+  h.check_equal();
+}
+
+TP_TEST(delta_journal_overflow_forces_resync) {
+  Harness h;
+  h.journal.set_log_cap(4);
+  h.member.set_row("Deployment/ml/a", 1.0);
+  h.poll();
+  // Blow far past the 4-entry window between polls.
+  for (int i = 0; i < 16; ++i) {
+    h.member.set_row("Deployment/ml/x" + std::to_string(i), i);
+    h.journal.publish();
+  }
+  Value resp = h.poll();
+  const Value* r = resp.find("resync");
+  TP_CHECK(r && r->as_bool());
+  TP_CHECK(resp.find("full") != nullptr);
+  h.check_equal();
+}
+
+TP_TEST(delta_generation_mismatch_forces_resync) {
+  Harness h;
+  h.member.set_row("Deployment/ml/a", 1.0);
+  h.poll();
+  // Member restart: journal reborn, epoch space reset, surfaces changed.
+  h.journal.reset_for_test();
+  h.journal.set_renderers(delta::Renderers{
+      [&h] { return h.member.workloads; },
+      [&h] { return h.member.signals; },
+      [&h] { return h.member.decisions; },
+  });
+  h.member.set_row("Deployment/ml/b", 9.0);
+  Value resp = h.poll();
+  const Value* r = resp.find("resync");
+  TP_CHECK(r && r->as_bool());
+  h.check_equal();
+}
+
+TP_TEST(delta_decisions_ring_reconstructs_through_wrap) {
+  Harness h;  // capacity 4
+  h.member.append_decision("ml/p1");
+  h.poll();
+  // Append 6 records (> capacity): the hub's ring must wrap identically,
+  // including the dropped count.
+  for (int i = 2; i <= 7; ++i) h.member.append_decision("ml/p" + std::to_string(i));
+  h.journal.publish();
+  Value resp = h.poll();
+  const Value* dec = resp.find("surfaces")->find("decisions");
+  TP_CHECK(dec->find("replace")->as_bool());  // every retained record is fresh
+  h.check_equal();
+  // And a partial append after the wrap extends rather than replaces.
+  h.member.append_decision("ml/p8");
+  h.journal.publish();
+  Value resp2 = h.poll();
+  const Value* dec2 = resp2.find("surfaces")->find("decisions");
+  TP_CHECK(!dec2->find("replace")->as_bool());
+  TP_CHECK_EQ(dec2->find("appends")->as_array().size(), size_t{1});
+  h.check_equal();
+}
+
+TP_TEST(delta_signals_ship_whole_doc_on_change) {
+  Harness h;
+  h.poll();
+  h.member.signals.set("coverage_ratio", Value(0.25));
+  h.member.signals.set("brownout", Value(true));
+  h.journal.publish();
+  Value resp = h.poll();
+  TP_CHECK(resp.find("surfaces")->find("signals") != nullptr);
+  h.check_equal();
+}
+
+TP_TEST(delta_randomized_interleaving_reconstructs_exactly) {
+  // Deterministic pseudo-random walk over every mutation kind with a
+  // small journal window (resyncs happen en route): after EVERY poll the
+  // reconstruction must equal the member's renders bit for bit.
+  Harness h;
+  h.journal.set_log_cap(8);
+  uint32_t rng = 0xC0FFEE;
+  auto next = [&rng] { return rng = rng * 1664525u + 1013904223u; };
+  for (int step = 0; step < 200; ++step) {
+    switch (next() % 5) {
+      case 0:
+        h.member.set_row("Deployment/ml/r" + std::to_string(next() % 12),
+                         static_cast<double>(next() % 1000) / 10.0);
+        break;
+      case 1:
+        h.member.remove_row("Deployment/ml/r" + std::to_string(next() % 12));
+        break;
+      case 2:
+        h.member.append_decision("ml/p" + std::to_string(next() % 50));
+        break;
+      case 3:
+        h.member.signals.set("coverage_ratio",
+                             Value(static_cast<double>(next() % 100) / 100.0));
+        break;
+      case 4:
+        break;  // quiesced publish
+    }
+    h.journal.publish();
+    if (next() % 3 == 0) {  // poll only sometimes: deltas batch up
+      h.poll();
+      h.check_equal();
+    }
+  }
+  h.poll();
+  h.check_equal();
+}
+
+TP_TEST(delta_concurrent_publish_and_longpoll_is_race_free) {
+  // The TSan target (`just tsan-fleet`): publishers hammer the journal
+  // while long-pollers wait/drain concurrently.
+  Harness h;
+  h.poll();
+  std::atomic<bool> stop{false};
+  std::thread publisher([&] {
+    for (int i = 0; i < 200 && !stop.load(); ++i) {
+      h.member.set_row("Deployment/ml/hot", static_cast<double>(i));
+      h.journal.publish();
+    }
+    stop.store(true);
+  });
+  std::thread poller([&] {
+    delta::DeltaState st;
+    delta::MemberDocs docs;
+    while (!stop.load()) {
+      Value resp = Value::parse(
+          h.journal.handle_request(delta::cursor_query(st, 5), nullptr));
+      delta::apply_delta(st, resp, docs);
+    }
+  });
+  publisher.join();
+  stop.store(true);
+  h.journal.wake_all();
+  poller.join();
+  h.poll();
+  h.check_equal();
+}
+
+TP_TEST(delta_cursor_query_shapes) {
+  delta::DeltaState st;
+  TP_CHECK_EQ(delta::cursor_query(st, 0), "since=-1");
+  st.primed = true;
+  st.gen = "123-9";
+  st.epoch = 42;
+  TP_CHECK_EQ(delta::cursor_query(st, 0), "since=42&gen=123-9");
+  TP_CHECK_EQ(delta::cursor_query(st, 2500), "since=42&gen=123-9&wait_ms=2500");
+}
+
+TP_TEST(delta_longpoll_wakes_on_publish) {
+  Harness h;
+  h.member.set_row("Deployment/ml/a", 1.0);
+  h.poll();
+  std::thread waker([&h] {
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    h.member.set_row("Deployment/ml/a", 2.0);
+    h.journal.publish();
+  });
+  auto t0 = std::chrono::steady_clock::now();
+  Value resp = h.poll(5000);  // would park 5s without the wake
+  double waited = std::chrono::duration<double>(
+      std::chrono::steady_clock::now() - t0).count();
+  waker.join();
+  TP_CHECK(waited < 3.0);
+  TP_CHECK(resp.find("surfaces") != nullptr);
+  h.check_equal();
+}

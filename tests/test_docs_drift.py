@@ -192,6 +192,40 @@ def test_incremental_surfaces_documented(built):
         f"{missing}")
 
 
+def test_delta_federation_surfaces_documented():
+    """The delta-federation protocol surfaces (ISSUE 12): the member's
+    /debug/delta endpoint + journal knob, the hub's delta/stream flags,
+    the hub-of-hubs semantics and the smoke recipes must all appear in
+    the 'Federation at scale' runbook — the protocol is useless to an
+    operator who cannot find its resync rules."""
+    doc = OPERATIONS.read_text()
+    needles = ("Federation at scale", "/debug/delta", "--fleet-delta",
+               "--fleet-stream", "TPU_PRUNER_DELTA_JOURNAL_CAP",
+               "generation", "resync", "rollup", "hub-of-hubs",
+               "duplicate_clusters", "fleet-mega", "via")
+    missing = [n for n in needles if n not in doc]
+    assert not missing, (
+        f"delta-federation surfaces missing from docs/OPERATIONS.md: "
+        f"{missing} — document each in the 'Federation at scale' section")
+
+
+def test_planet_bench_summary_fields_documented():
+    """Planet-tier bench fields must be in BENCH_FIELDS.md AND actually
+    emitted by bench.py — a drift on either side fails."""
+    bench_src = (REPO / "bench.py").read_text()
+    fields_doc = (REPO / "docs" / "BENCH_FIELDS.md").read_text()
+    for field in ("planet_members", "planet_snapshot_bytes_per_round",
+                  "planet_delta_bytes_per_round",
+                  "planet_stream_bytes_per_round",
+                  "planet_delta_bytes_ratio", "planet_delta_cpu_ratio",
+                  "planet_parity_ok", "planet_churn_propagation_s",
+                  "planet_pods", "planet_phase_envelopes",
+                  "planet_journal_depth_max", "planet_rss_mb_peak"):
+        assert f'"{field}"' in bench_src, f"bench.py no longer emits {field}"
+        assert field in fields_doc, (
+            f"bench summary field {field} missing from docs/BENCH_FIELDS.md")
+
+
 def test_incremental_bench_summary_fields_documented():
     """Incremental bench fields must be in BENCH_FIELDS.md AND actually
     emitted by bench.py — a drift on either side fails."""

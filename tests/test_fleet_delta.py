@@ -1,0 +1,420 @@
+"""Delta-federation tests (the ISSUE 12 tentpole).
+
+The hub now scales like the daemon: member polls ride /debug/delta change
+journals (O(churn) bytes, cursor + generation, bounded window with
+410-style full-snapshot resync), optionally long-polled over the pooled
+per-member connection, and a hub can itself be a --member of a parent hub
+(region → global rollup). These tests drive the REAL hub binary over
+scripted lightweight members (fake_fleet.LightMember — the building block
+that lets 100+-member federations fit in this container) and pin the
+invariants the protocol rests on:
+
+  - parity: merged /debug/fleet/* payloads and fleet_totals are
+    byte-identical across --fleet-delta on|off and streamed|polled, under
+    quiesce AND churn;
+  - resync: a member restart (journal gone, epoch space reset) and a
+    journal-window overflow both force a clean full resync with no
+    double-counted ledger totals;
+  - hub-of-hubs: two-level merges are byte-identical to one-level, a dark
+    region pins fleet_coverage_ratio_min to 0 globally, duplicate cluster
+    names are flagged;
+  - backoff: a dead member is re-polled under capped exponential backoff,
+    counted per member, instead of burning a poll slot every round;
+  - the real daemon serves the same protocol at /debug/delta.
+"""
+
+import json
+import re
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.testing.fake_fleet import FakeFleet
+
+
+def get(port, path, timeout=5.0):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def get_json(port, path):
+    return json.loads(get(port, path))
+
+
+def wait_until(predicate, timeout=45, interval=0.2):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            last = predicate()
+        except OSError:
+            last = None
+        if last:
+            return last
+        time.sleep(interval)
+    raise AssertionError(f"condition never held (last={last!r})")
+
+
+def scrape_counter(port, name):
+    """Sum of the family's sample values (labelled rows sum; absent → None)."""
+    body = get(port, "/metrics")
+    vals = re.findall(rf"^{name}(?:{{[^}}]*}})? (\d+(?:\.\d+)?)", body, re.M)
+    return sum(float(v) for v in vals) if vals else None
+
+
+def all_ok(port):
+    doc = get_json(port, "/debug/fleet/clusters")
+    return doc["members"] and all(m["status"] == "OK" for m in doc["members"])
+
+
+# ── protocol units over the native sim (no processes) ──
+
+
+def test_delta_sim_quiesced_and_churn(built):
+    """Epochs advance only on change; a quiesced poll is a ~70-byte
+    header; churn ships exactly the changed rows; the hub-side
+    reconstruction equals the member's own render."""
+    def wl(rows, reclaimed):
+        return {"cluster": "c1", "sort": "reclaimed", "tracked": len(rows),
+                "totals": {"idle_seconds": 1.0, "active_seconds": 0.0,
+                           "reclaimed_chip_seconds": reclaimed},
+                "workloads": rows}
+
+    def row(key, rec):
+        return {"workload": key, "kind": "Deployment", "namespace": "ml",
+                "name": key, "chips": 4, "idle_seconds": 1.0,
+                "reclaimed_chip_seconds": rec}
+
+    sig = {"cluster": "c1", "enabled": True, "coverage_ratio": 1.0}
+    dec = {"cluster": "c1", "capacity": 8, "dropped": 0, "decisions": []}
+    res = native.delta_sim([
+        {"op": "publish", "workloads": wl([row("a", 5.0), row("b", 9.0)], 14.0),
+         "signals": sig, "decisions": dec},
+        {"op": "poll"},          # full snapshot
+        {"op": "poll"},          # quiesced
+        {"op": "publish", "workloads": wl([row("a", 50.0), row("b", 9.0)], 59.0),
+         "signals": sig, "decisions": dec},
+        {"op": "poll"},          # one changed row
+    ])
+    full, quiesced, churn = res[1], res[2], res[4]
+    assert "full" in full["response"] and full["applied"]["changed"]
+    assert "surfaces" not in quiesced["response"]
+    assert not quiesced["applied"]["changed"]
+    assert quiesced["bytes"] < 120
+    ups = churn["response"]["surfaces"]["workloads"]["upserts"]
+    assert [u["workload"] for u in ups] == ["a"]
+    # Reconstruction equality incl. the re-sorted array (a overtakes b).
+    assert [w["workload"] for w in churn["docs"]["workloads"]["workloads"]] == ["a", "b"]
+    assert churn["docs"]["workloads"]["totals"]["reclaimed_chip_seconds"] == 59.0
+    # Epoch advanced exactly once per changing publish.
+    assert res[0]["epoch"] == 1 and res[3]["epoch"] == 2
+
+
+def test_delta_sim_restart_and_overflow_resync(built):
+    """A cursor that predates the journal window — or survives a member
+    restart — is answered with resync:true + the full snapshot, and the
+    reconstructed totals carry no double counting."""
+    def wl(n):
+        rows = [{"workload": f"Deployment/ml/r{i}", "kind": "Deployment",
+                 "namespace": "ml", "name": f"r{i}", "chips": 4,
+                 "idle_seconds": 1.0, "reclaimed_chip_seconds": float(i)}
+                for i in range(n)]
+        return {"cluster": "c1", "sort": "reclaimed", "tracked": n,
+                "totals": {"idle_seconds": float(n), "active_seconds": 0.0,
+                           "reclaimed_chip_seconds": sum(float(i) for i in range(n))},
+                "workloads": rows}
+
+    sig = {"cluster": "c1", "enabled": True, "coverage_ratio": 1.0}
+    dec = {"cluster": "c1", "capacity": 8, "dropped": 0, "decisions": []}
+    steps = [{"op": "publish", "workloads": wl(2), "signals": sig, "decisions": dec},
+             {"op": "poll"}]
+    # Overflow: 20 single-row publishes through a 4-entry window.
+    for n in range(3, 23):
+        steps.append({"op": "publish", "workloads": wl(n), "signals": sig,
+                      "decisions": dec})
+    steps.append({"op": "poll"})
+    # Restart: epoch space reborn; cursor from the old life must resync.
+    steps.append({"op": "restart"})
+    steps.append({"op": "publish", "workloads": wl(3), "signals": sig,
+                  "decisions": dec})
+    steps.append({"op": "poll"})
+    res = native.delta_sim(steps, log_cap=4)
+    overflow_poll, restart_poll = res[22], res[-1]
+    assert overflow_poll["response"].get("resync") is True
+    assert overflow_poll["docs"]["workloads"]["tracked"] == 22
+    assert restart_poll["response"].get("resync") is True
+    assert restart_poll["docs"]["workloads"]["totals"]["reclaimed_chip_seconds"] == 3.0
+
+
+# ── hub e2e over scripted lightweight members ──
+
+
+@pytest.fixture()
+def fleet(built, tmp_path):
+    f = FakeFleet(tmp_path)
+    try:
+        yield f
+    finally:
+        f.stop()
+
+
+def test_hub_delta_parity_quiesced_and_churn(fleet):
+    """Snapshot, delta-polled and delta-streamed hubs over the SAME
+    members serve byte-identical /debug/fleet payloads — before and after
+    churn — and the quiesced delta hub moves >=10x fewer bytes per round."""
+    members = [fleet.add_light_member(f"c{i}", tracked=3) for i in range(4)]
+    urls = [m.url for m in members]
+    fleet.start_hub(poll_interval=1, stale_after=6, member_urls=urls,
+                    extra_args=("--fleet-delta", "off"))
+    _, dport = fleet.start_child_hub(urls, cluster="hub", poll_interval=1,
+                                     stale_after=6,
+                                     extra_args=("--fleet-delta", "on"))
+    _, sport = fleet.start_child_hub(
+        urls, cluster="hub", poll_interval=1, stale_after=6,
+        extra_args=("--fleet-delta", "on", "--fleet-stream", "on"))
+    for port in (fleet.hub_port, dport, sport):
+        wait_until(lambda p=port: all_ok(p))
+    time.sleep(2)
+
+    def views(port):
+        return {p: get(port, f"/debug/fleet/{p}")
+                for p in ("workloads", "signals", "decisions")}
+
+    before = {p: views(p) for p in (fleet.hub_port, dport, sport)}
+    for surface in ("workloads", "signals", "decisions"):
+        assert (before[fleet.hub_port][surface] == before[dport][surface]
+                == before[sport][surface]), surface
+
+    # Quiesced wire cost: several settled rounds, then compare the byte
+    # counters' growth across one more quiesced window.
+    b0_snap = scrape_counter(fleet.hub_port, "tpu_pruner_fleet_poll_bytes_total")
+    b0_delta = scrape_counter(dport, "tpu_pruner_fleet_poll_bytes_total")
+    time.sleep(3)
+    snap_bytes = scrape_counter(
+        fleet.hub_port, "tpu_pruner_fleet_poll_bytes_total") - b0_snap
+    delta_bytes = scrape_counter(
+        dport, "tpu_pruner_fleet_poll_bytes_total") - b0_delta
+    assert snap_bytes > 0
+    assert snap_bytes >= 10 * max(delta_bytes, 1), (snap_bytes, delta_bytes)
+
+    # Churn: one member's row jumps, a decision lands — every hub
+    # converges to the identical updated view.
+    members[2].set_workload("Deployment/ml/c2-dep-0",
+                            reclaimed_chip_seconds=4242.0)
+    members[2].append_decision({"pod": "ml/churned", "reason": "SCALED"})
+    wait_until(lambda: "4242" in get(dport, "/debug/fleet/workloads"))
+    wait_until(lambda: "4242" in get(sport, "/debug/fleet/workloads"))
+    wait_until(lambda: "4242" in get(fleet.hub_port, "/debug/fleet/workloads"))
+    time.sleep(1.5)
+    after = {p: views(p) for p in (fleet.hub_port, dport, sport)}
+    for surface in ("workloads", "signals", "decisions"):
+        assert (after[fleet.hub_port][surface] == after[dport][surface]
+                == after[sport][surface]), surface
+    assert "churned" in after[dport]["decisions"]
+
+
+def test_member_restart_forces_resync_without_double_counting(fleet):
+    """A member restart resets its journal generation; the hub must
+    resync cleanly — fleet_totals stay bit-for-bit equal to a
+    snapshot-polling hub's, never doubled."""
+    m = fleet.add_light_member("bouncy", tracked=2)
+    fleet.start_hub(poll_interval=1, stale_after=6, member_urls=[m.url],
+                    extra_args=("--fleet-delta", "on"))
+    _, snap_port = fleet.start_child_hub([m.url], cluster="hub",
+                                         poll_interval=1, stale_after=6)
+    wait_until(lambda: all_ok(fleet.hub_port))
+    wait_until(lambda: all_ok(snap_port))
+
+    m.restart()
+    m.set_workload("Deployment/ml/bouncy-dep-0", reclaimed_chip_seconds=777.0)
+    wait_until(lambda: "777" in get(fleet.hub_port, "/debug/fleet/workloads"))
+    wait_until(lambda: "777" in get(snap_port, "/debug/fleet/workloads"))
+    delta_wl = get_json(fleet.hub_port, "/debug/fleet/workloads")
+    snap_wl = get_json(snap_port, "/debug/fleet/workloads")
+    assert delta_wl["fleet_totals"] == snap_wl["fleet_totals"]
+    assert json.dumps(delta_wl, sort_keys=True) == json.dumps(snap_wl, sort_keys=True)
+    resyncs = scrape_counter(fleet.hub_port, "tpu_pruner_fleet_delta_resyncs_total")
+    assert resyncs and resyncs >= 1
+
+
+def test_journal_overflow_forces_resync_e2e(fleet):
+    """More row-changes between polls than the member's journal window
+    retains → the cursor has aged out, the member answers with a full
+    resync, and the merged view still matches a snapshot hub's exactly."""
+    m = fleet.add_light_member("stormy", tracked=2, journal_cap=3)
+    fleet.start_hub(poll_interval=1, stale_after=6, member_urls=[m.url],
+                    extra_args=("--fleet-delta", "on"))
+    _, snap_port = fleet.start_child_hub([m.url], cluster="hub",
+                                         poll_interval=1, stale_after=6)
+    wait_until(lambda: all_ok(fleet.hub_port))
+    # Burst 20 row-changes inside one poll interval: the 3-entry window
+    # cannot answer the hub's cursor.
+    for i in range(20):
+        m.set_workload(f"Deployment/ml/storm-{i}",
+                       reclaimed_chip_seconds=float(i))
+    wait_until(lambda: scrape_counter(
+        fleet.hub_port, "tpu_pruner_fleet_delta_resyncs_total") >= 1)
+    wait_until(lambda: "storm-19" in get(fleet.hub_port, "/debug/fleet/workloads"))
+    wait_until(lambda: "storm-19" in get(snap_port, "/debug/fleet/workloads"))
+    assert (get_json(fleet.hub_port, "/debug/fleet/workloads")["fleet_totals"]
+            == get_json(snap_port, "/debug/fleet/workloads")["fleet_totals"])
+
+
+def test_hub_of_hubs_two_level_byte_identity(fleet):
+    """region → global: a parent hub over two child hubs serves
+    workloads/signals/decisions byte-identical to ONE hub over all four
+    leaves; the clusters table stamps leaves with their region (via) and
+    lists the hubs."""
+    members = [fleet.add_light_member(f"leaf{i}", tracked=1) for i in range(4)]
+    urls = [m.url for m in members]
+    fleet.start_hub(poll_interval=1, stale_after=8, member_urls=urls)
+    _, east = fleet.start_child_hub(urls[:2], cluster="region-east",
+                                    poll_interval=1, stale_after=8,
+                                    extra_args=("--fleet-delta", "on"))
+    _, west = fleet.start_child_hub(urls[2:], cluster="region-west",
+                                    poll_interval=1, stale_after=8,
+                                    extra_args=("--fleet-delta", "on"))
+    _, parent = fleet.start_child_hub(
+        [f"http://127.0.0.1:{east}", f"http://127.0.0.1:{west}"],
+        cluster="global", poll_interval=1, stale_after=8,
+        extra_args=("--fleet-delta", "on"))
+    wait_until(lambda: all_ok(fleet.hub_port))
+    wait_until(lambda: len(get_json(parent, "/debug/fleet/clusters")["members"]) == 4
+               and all_ok(parent))
+    time.sleep(2)
+    for surface in ("workloads", "signals", "decisions"):
+        direct = get(fleet.hub_port, f"/debug/fleet/{surface}")
+        two_level = get(parent, f"/debug/fleet/{surface}")
+        assert direct == two_level, surface
+    clusters = get_json(parent, "/debug/fleet/clusters")
+    assert all(m.get("via") for m in clusters["members"])
+    assert sorted(h["cluster"] for h in clusters["hubs"]) == [
+        "region-east", "region-west"]
+    # Churn in one region propagates through the rollup chain.
+    members[3].set_workload("Deployment/ml/leaf3-dep-0",
+                            reclaimed_chip_seconds=31337.0)
+    wait_until(lambda: "31337" in get(parent, "/debug/fleet/workloads"))
+    time.sleep(1.5)
+    assert (get(fleet.hub_port, "/debug/fleet/workloads")
+            == get(parent, "/debug/fleet/workloads"))
+
+
+def test_dark_region_pins_global_coverage_to_zero(fleet):
+    """Stale propagation: a region hub going dark forces every one of its
+    last-known leaves UNREACHABLE at the parent — fleet_coverage_ratio_min
+    reads 0 globally, never the mean of the surviving region."""
+    members = [fleet.add_light_member(f"d{i}", tracked=1) for i in range(2)]
+    _, region = fleet.start_child_hub([m.url for m in members],
+                                      cluster="region", poll_interval=1,
+                                      stale_after=4)
+    fleet.start_hub(poll_interval=1, stale_after=4,
+                    member_urls=[f"http://127.0.0.1:{region}"])
+    wait_until(lambda: all_ok(fleet.hub_port))
+    proc, _ = fleet.child_hubs[0]
+    proc.terminate()
+    proc.wait(timeout=10)
+    wait_until(lambda: get_json(
+        fleet.hub_port, "/debug/fleet/signals")["coverage_min"] == 0.0, timeout=30)
+    sig = get_json(fleet.hub_port, "/debug/fleet/signals")
+    assert sorted(sig["unreachable_clusters"]) == ["d0", "d1"]
+    body = get(fleet.hub_port, "/metrics")
+    assert re.search(r"tpu_pruner_fleet_coverage_ratio_min(?:{[^}]*})? 0(\.0+)?\b",
+                     body), body
+
+
+def test_duplicate_cluster_names_flagged(fleet):
+    """Disjointness check: two members claiming the same cluster name is
+    a topology error — named in duplicate_clusters and pinning the
+    coverage minimum to 0."""
+    a = fleet.add_light_member("same-name", tracked=1)
+    b = fleet.add_light_member("same-name", tracked=1)
+    fleet.start_hub(poll_interval=1, stale_after=6,
+                    member_urls=[a.url, b.url])
+    wait_until(lambda: all_ok(fleet.hub_port))
+    sig = get_json(fleet.hub_port, "/debug/fleet/signals")
+    assert sig["duplicate_clusters"] == ["same-name"]
+    assert sig["coverage_min"] == 0.0
+    assert get_json(fleet.hub_port,
+                    "/debug/fleet/clusters")["duplicate_clusters"] == ["same-name"]
+    body = get(fleet.hub_port, "/metrics")
+    assert re.search(r"tpu_pruner_fleet_duplicate_clusters(?:{[^}]*})? 1\b", body)
+
+
+def test_dead_member_backoff(fleet):
+    """A member that never answers is re-polled under exponential backoff
+    (capped at --stale-after) instead of burning a slot every round —
+    counted per member in tpu_pruner_fleet_member_backoff_total."""
+    alive = fleet.add_light_member("alive", tracked=1)
+    # A port with nothing listening: connect() fails fast.
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_url = f"http://127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    fleet.start_hub(poll_interval=1, stale_after=8,
+                    member_urls=[alive.url, dead_url],
+                    extra_args=("--member-timeout-ms", "300"))
+    wait_until(lambda: scrape_counter(
+        fleet.hub_port, "tpu_pruner_fleet_member_backoff_total") >= 1,
+        timeout=30)
+    time.sleep(8)
+    clusters = get_json(fleet.hub_port, "/debug/fleet/clusters")
+    dead_row = next(m for m in clusters["members"] if m["member"] == dead_url)
+    # ~12s of 1s rounds: without backoff the dead member would have been
+    # dialed ~every round (>=10 polls); with doubling backoff (1,2,4,8s,
+    # jittered) dials stay a small minority of rounds.
+    assert dead_row["status"] == "UNREACHABLE"
+    assert dead_row.get("backoffs", 0) >= 3
+    assert dead_row["polls"] <= 7
+    # The healthy member kept its OK row throughout.
+    alive_row = next(m for m in clusters["members"] if m["cluster"] == "alive")
+    assert alive_row["status"] == "OK"
+
+
+def test_streamed_member_sees_longpolls_not_snapshot_sets(fleet):
+    """--fleet-stream on: the member sees ONE parked /debug/delta request
+    per interval instead of a 3-GET snapshot set, and a mutation surfaces
+    at the hub within ~a second (the long-poll wake)."""
+    m = fleet.add_light_member("streamy", tracked=2)
+    fleet.start_hub(poll_interval=5, stale_after=20, member_urls=[m.url],
+                    extra_args=("--fleet-delta", "on", "--fleet-stream", "on"))
+    wait_until(lambda: all_ok(fleet.hub_port))
+    snap_gets = sum(m.requests.get(p, 0) for p in
+                    ("/debug/workloads", "/debug/signals", "/debug/decisions"))
+    m.set_workload("Deployment/ml/streamy-dep-0", reclaimed_chip_seconds=555.0)
+    t0 = time.monotonic()
+    wait_until(lambda: "555" in get(fleet.hub_port, "/debug/fleet/workloads"),
+               timeout=10)
+    latency = time.monotonic() - t0
+    assert latency < 4.0, latency  # well under the 5s poll interval
+    assert snap_gets == 0, m.requests
+    assert m.requests.get("/debug/delta", 0) >= 1
+
+
+def test_real_daemon_serves_delta_protocol(fleet):
+    """The member daemon's own /debug/delta: first poll returns the full
+    surfaces (equal to the live endpoints), a cursor poll answers from
+    the journal, and a bogus generation forces a resync."""
+    member = fleet.add_member("realdelta", idle_pods=1)
+    wait_until(lambda: member.get_json(
+        "/debug/workloads")["totals"]["reclaimed_chip_seconds"] > 0)
+    first = member.get_json("/debug/delta?since=-1")
+    assert first["gen"] and first["epoch"] >= 0
+    assert set(first["full"].keys()) == {"workloads", "signals", "decisions"}
+    assert first["full"]["workloads"]["cluster"] == "realdelta"
+    assert first["full"]["signals"]["enabled"] is True
+    # Cursor poll: served (either quiesced or a diff — the daemon cycles
+    # every second), never a resync.
+    cursor = member.get_json(
+        f"/debug/delta?since={first['epoch']}&gen={first['gen']}")
+    assert "resync" not in cursor
+    assert cursor["gen"] == first["gen"]
+    # A generation from another life → resync with full snapshot.
+    bogus = member.get_json(f"/debug/delta?since=1&gen=not-this-life")
+    assert bogus.get("resync") is True and "full" in bogus
+    # The journal self-describes in the /debug index.
+    index = member.get_json("/debug")
+    assert any(r["path"] == "/debug/delta" for r in index["routes"])

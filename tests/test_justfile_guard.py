@@ -230,6 +230,54 @@ def test_tsan_wire_recipe_present_and_wired():
         "tsan-wire would vacuously pass")
 
 
+def test_fleet_mega_recipe_present_and_wired():
+    """`just fleet-mega` must exist and run the 100-member delta
+    federation smoke — parity-vs-snapshot (byte-identical merged views
+    across snapshot/delta/stream hubs) and the ≥10x quiesced bytes+CPU
+    bars are asserted inside run_planet_federation, so losing the recipe
+    loses the O(churn) fleet guard from CI."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^fleet-mega\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `fleet-mega:` recipe"
+    body = m.group(1)
+    assert "bench.py --planet-only" in body, (
+        "fleet-mega no longer invokes bench.py --planet-only")
+    assert "TP_PLANET_MEMBERS=100" in body, (
+        "fleet-mega lost its 100-member federation — the ≥10x quiesced "
+        "bars are only asserted at ≥50 members")
+    assert "TP_PLANET_PODS=0" in body, (
+        "fleet-mega lost the TP_PLANET_PODS=0 override — the recipe would "
+        "run the full 250k-pod rung in CI")
+    bench = (REPO / "bench.py").read_text()
+    assert "--planet-only" in bench and "run_planet_federation" in bench, (
+        "bench.py no longer implements the --planet-only planet tier")
+    assert "--fleet-delta" in bench, (
+        "the planet federation section no longer exercises --fleet-delta")
+
+
+def test_tsan_fleet_recipe_present_and_wired():
+    """`just tsan-fleet` must exist and run the delta-journal + fleet
+    native tests under ThreadSanitizer — cycle publishers race parked
+    long-pollers on the journal's condition variable, and the hub's
+    streaming pollers write member state the merge loop reads; exactly
+    the concurrency TSan exists to check."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^tsan-fleet\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `tsan-fleet:` recipe"
+    body = m.group(1)
+    assert "-DTP_TSAN=ON" in body, "tsan-fleet no longer builds with TSan"
+    assert re.search(r"tpupruner_tests\s+delta", body), (
+        "tsan-fleet no longer runs the native delta tests")
+    assert re.search(r"tpupruner_tests\s+fleet", body), (
+        "tsan-fleet no longer runs the native fleet tests")
+    src = (REPO / "native" / "tests" / "test_delta.cpp").read_text()
+    assert "delta_concurrent_publish_and_longpoll_is_race_free" in src, (
+        "test_delta.cpp lost its concurrency test — tsan-fleet would "
+        "vacuously pass")
+
+
 def test_just_verify_matches_roadmap_tier1():
     roadmap = roadmap_tier1_command()
     justfile = justfile_verify_command()

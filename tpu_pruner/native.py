@@ -168,6 +168,7 @@ def load() -> ctypes.CDLL:
         "tp_fleet_metric_families",
         "tp_fleet_aggregate",
         "tp_stamp_exposition",
+        "tp_delta_sim",
         "tp_replay_cycle",
         "tp_gym_simulate",
         "tp_right_size_plan",
@@ -393,6 +394,23 @@ def stamp_exposition(body: str, cluster: str) -> str:
     """Insert cluster="..." into every sample line of a Prometheus text
     exposition (the fleet identity choke point; idempotent)."""
     return _call("tp_stamp_exposition", {"body": body, "cluster": cluster})["body"]
+
+
+def delta_sim(steps: list[dict], log_cap: int | None = None) -> list[dict]:
+    """Drive the REAL delta-federation protocol (native/src/delta.cpp):
+    the member-side change journal AND the hub-side cursor/apply state
+    machine, through a scripted sequence of steps:
+      {"op": "publish", "workloads": {...}, "signals": {...},
+       "decisions": {...}}      journal a new surface snapshot
+      {"op": "poll"}            poll with the applier's own cursor
+      {"op": "poll", "since": N, "gen": "..."}   poll an explicit cursor
+      {"op": "restart"}         member restart (new generation, epoch 0)
+    Returns one result per step — polls carry the raw wire "response",
+    the "applied" verdict and the hub's reconstructed "docs"."""
+    payload: dict = {"steps": steps}
+    if log_cap is not None:
+        payload["log_cap"] = log_cap
+    return _call("tp_delta_sim", payload)["results"]
 
 
 def replay_cycle(capsule: dict, what_if: dict | None = None) -> dict:
