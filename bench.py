@@ -1751,14 +1751,323 @@ def run_planet_single_cluster():
     return out
 
 
+# ── planet store rung (ISSUE 14): the 1M-pod compact-store envelope ────
+#
+# One informer (proto wire, compact PodRecords) cold-syncing a
+# TP_PLANET_STORE_PODS-pod fixture (default 1,000,000; 0 skips) in a
+# SUBPROCESS, so the RSS/CPU envelopes are the consumer's own — the
+# parent holds the Python fixture (~GBs at 1M) and must not pollute them.
+# Asserted at any size: the bytes-per-pod bar and the pipelined cold sync
+# being no worse than the serial baseline; at >=10k pods also the compact
+# on/off steady-state RSS ratio (>=2x) and the RSS-per-pod envelope.
+PLANET_STORE_PODS = int(os.environ.get("TP_PLANET_STORE_PODS", "1000000"))
+STORE_BYTES_PER_POD_BAR = float(
+    os.environ.get("TP_STORE_BYTES_PER_POD_BAR", "1024"))
+STORE_RSS_PER_POD_BAR_KB = float(
+    os.environ.get("TP_STORE_RSS_PER_POD_BAR_KB", "2.5"))
+STORE_RSS_RATIO_BAR = float(os.environ.get("TP_STORE_RSS_RATIO_BAR", "2.0"))
+STORE_SETTLE_S = 3
+
+_STORE_CHILD = r"""
+import ctypes, gc, json, os, sys, time
+from tpu_pruner import native
+
+url = sys.argv[1]
+pods_expected = int(sys.argv[2])
+compact = sys.argv[3]
+settle_s = float(sys.argv[4])
+churn = sys.argv[5] == "churn"
+
+def rss_kb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    return 0
+
+def cpu_ms():
+    with open("/proc/self/stat") as f:
+        parts = f.read().split()
+    return (int(parts[13]) + int(parts[14])) * 1000.0 / os.sysconf("SC_CLK_TCK")
+
+def trim():
+    gc.collect()
+    try:
+        ctypes.CDLL("libc.so.6").malloc_trim(0)
+    except Exception:
+        pass
+
+native.load()
+trim()
+out = {"phases": {}}
+rss0, cpu0 = rss_kb(), cpu_ms()
+out["rss_base_mb"] = round(rss0 / 1024, 1)
+t0 = time.monotonic()
+r = native._call("tp_informer_start",
+                 {"api_url": url, "resources": ["pods"],
+                  "compact_store": compact, "wait_ms": 1800000})
+wall = time.monotonic() - t0
+assert r["synced"], r
+h = r["handle"]
+stats = native._call("tp_informer_stats", {"handle": h})
+assert stats["objects"] == pods_expected, (stats["objects"], pods_expected)
+trim()
+st = native.store_stats()
+out["phases"]["cold"] = {"wall_s": round(wall, 2),
+                         "rss_mb": round((rss_kb() - rss0) / 1024, 1),
+                         "cpu_ms": round(cpu_ms() - cpu0)}
+out["cold_sync_seconds"] = st["cold_sync_seconds_pods"]
+out["store_bytes"] = st["store_bytes"]
+out["store_pods"] = st["store_pods"]
+out["interned_strings"] = st["interned_strings"]
+out["interned_bytes"] = st["interned_bytes"]
+out["doc_arena"] = st["doc_arena"]
+c0 = cpu_ms()
+time.sleep(settle_s)
+trim()
+out["phases"]["settle"] = {"rss_mb": round((rss_kb() - rss0) / 1024, 1),
+                           "cpu_ms": round(cpu_ms() - c0)}
+if churn:
+    print("SETTLED", flush=True)
+    sentinel = sys.stdin.readline().strip()
+    c0 = cpu_ms()
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        g = native._call("tp_informer_get", {"handle": h, "path": sentinel})
+        if g["found"]:
+            break
+        time.sleep(0.1)
+    else:
+        raise RuntimeError("churn sentinel never arrived: " + sentinel)
+    trim()
+    out["phases"]["churn"] = {"rss_mb": round((rss_kb() - rss0) / 1024, 1),
+                              "cpu_ms": round(cpu_ms() - c0)}
+native._call("tp_informer_stop", {"handle": h})
+print("RESULT " + json.dumps(out), flush=True)
+"""
+
+
+def _store_child(k8s, pods, compact="on", settle_s=0.0, churn=False,
+                 env_extra=None):
+    """One subprocess informer run over the store fixture; returns the
+    child's phase/stats JSON. Caller mutates the fixture while the child
+    waits when churn=True."""
+    env = dict(os.environ)
+    env["TPU_PRUNER_WIRE"] = "proto"
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _STORE_CHILD, k8s.url, str(pods), compact,
+         str(settle_s), "churn" if churn else "-"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    return proc
+
+
+def _store_child_result(proc, timeout=1800):
+    out, err = proc.communicate(timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(f"store child failed: {err[-2000:]}")
+    for line in out.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise RuntimeError(f"store child printed no RESULT: {out[-500:]}")
+
+
+def run_store_scale_rung():
+    """The 1M-pod store rung: cold → settle → churn envelopes for the
+    compact store over the binary wire, the bytes-per-pod bar, the
+    compact on/off steady-state RSS ratio, the pipelined-vs-serial cold
+    sync A/B, and the decode shard curve (explicitly skip-marked on
+    1-core hosts)."""
+    from tpu_pruner.testing import FakeK8s
+
+    pods = PLANET_STORE_PODS
+    churn_n = max(64, min(2000, pods // 500))
+    k8s = FakeK8s()
+    # Single-process server: watch events must propagate (the churn
+    # phase), and the per-snapshot encode cache amortizes the repeat
+    # LISTs the A/B + shard sweeps issue over the same fixture.
+    k8s.start()
+    out = {"store_pods": pods, "store_churn_targets": churn_n}
+    try:
+        t0 = time.monotonic()
+        ns_count = max(1, min(64, pods // 512))
+        # Realistic GKE-shaped metadata: every pod carries the label set
+        # of its jobset, so values repeat across the fleet exactly like
+        # production label cardinality does (the compact store interns
+        # each distinct value once; the exact representations pay full
+        # bytes per pod). Dicts are precomputed per jobset — building a
+        # million fresh dicts would dominate fixture time.
+        n_jobsets = max(1, min(96, pods // 128))
+        label_sets = [
+            {
+                "app": f"trainer-{j}",
+                "jobset.sigs.k8s.io/jobset-name": f"trainer-{j}",
+                "jobset.sigs.k8s.io/replicatedjob-name": "worker",
+                "batch.kubernetes.io/job-name": f"trainer-{j}-worker-0",
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+                "cloud.google.com/gke-nodepool": f"tpu-pool-{j % 8}",
+                "topology.kubernetes.io/zone": f"us-central2-{'ab'[j % 2]}",
+                "pod-template-hash": f"{(j * 2654435761) % (1 << 32):08x}",
+            }
+            for j in range(n_jobsets)
+        ]
+        for i in range(pods):
+            k8s.add_pod(f"sns{i % ns_count}", f"store-{i:07d}", tpu_chips=4,
+                        labels=label_sets[i % n_jobsets])
+        out["store_fixture_build_s"] = round(time.monotonic() - t0, 1)
+        log(f"store rung: {pods} pods built in "
+            f"{out['store_fixture_build_s']}s")
+
+        # Warm the fake's per-snapshot encode cache before the first timed
+        # child: the cache is built lazily on the first LIST of a snapshot
+        # rv, so without this the pipelined A/B arm would pay the whole
+        # fixture encode while the later serial arm cache-hits — the A/B
+        # must compare client decode paths, not fixture encode order. (The
+        # post-churn snapshot is warmed the same way by the untimed
+        # compact-off child before the serial/shard children list it.)
+        def warm_encode_cache():
+            import urllib.request
+            from tpu_pruner.testing import wire_proto
+            req = urllib.request.Request(
+                k8s.url + "/api/v1/pods?limit=500",
+                headers={"Accept": wire_proto.K8S_PROTO})
+            with urllib.request.urlopen(req) as resp:
+                resp.read()
+
+        t0 = time.monotonic()
+        warm_encode_cache()
+        out["store_fixture_warm_encode_s"] = round(time.monotonic() - t0, 1)
+
+        # Main envelope run: compact on, pipelined, with a churn phase.
+        child = _store_child(k8s, pods, compact="on",
+                             settle_s=STORE_SETTLE_S, churn=True)
+        line = child.stdout.readline().strip()
+        if line != "SETTLED":
+            _, err = child.communicate(timeout=60)
+            raise RuntimeError(f"store child never settled: {err[-2000:]}")
+        for i in range(churn_n):
+            del k8s.objects[f"/api/v1/namespaces/sns{i % ns_count}"
+                            f"/pods/store-{i:07d}"]
+        for i in range(churn_n):
+            k8s.add_pod(f"sns{i % ns_count}", f"store-churn-{i}", tpu_chips=4,
+                        labels=label_sets[i % n_jobsets])
+        sentinel = (f"/api/v1/namespaces/sns{(churn_n - 1) % ns_count}"
+                    f"/pods/store-churn-{churn_n - 1}")
+        child.stdin.write(sentinel + "\n")
+        child.stdin.flush()
+        on = _store_child_result(child)
+        out["store_phase_envelopes"] = on["phases"]
+        out["store_rss_base_mb"] = on["rss_base_mb"]
+        out["store_bytes"] = on["store_bytes"]
+        out["store_interned_strings"] = on["interned_strings"]
+        out["store_doc_arena"] = on["doc_arena"]
+        out["store_cold_sync_s"] = round(on["cold_sync_seconds"], 2)
+        bytes_per_pod = on["store_bytes"] / max(on["store_pods"], 1)
+        out["store_bytes_per_pod"] = round(bytes_per_pod)
+        log(f"store rung: cold sync {out['store_cold_sync_s']}s, "
+            f"{out['store_bytes_per_pod']} B/pod packed, phases "
+            f"{on['phases']}")
+        if bytes_per_pod > STORE_BYTES_PER_POD_BAR:
+            raise RuntimeError(
+                f"STORE BAR MISS: {bytes_per_pod:.0f} packed bytes/pod "
+                f"exceeds the {STORE_BYTES_PER_POD_BAR:.0f} B bar")
+        rss_per_pod_kb = on["phases"]["cold"]["rss_mb"] * 1024.0 / pods
+        out["store_rss_kb_per_pod"] = round(rss_per_pod_kb, 2)
+        if pods >= 10000 and rss_per_pod_kb > STORE_RSS_PER_POD_BAR_KB:
+            raise RuntimeError(
+                f"STORE BAR MISS: {rss_per_pod_kb:.2f} KB RSS/pod exceeds "
+                f"the {STORE_RSS_PER_POD_BAR_KB} KB envelope")
+
+        # Compact OFF twin: same fixture, settle-phase RSS → the >=2x
+        # steady-state ratio the tentpole claims (deltas over each
+        # child's own baseline, so interpreter overhead cancels).
+        off = _store_child_result(
+            _store_child(k8s, pods, compact="off", settle_s=STORE_SETTLE_S))
+        out["store_off_rss_mb"] = off["phases"]["settle"]["rss_mb"]
+        out["store_on_rss_mb"] = on["phases"]["settle"]["rss_mb"]
+        ratio = (off["phases"]["settle"]["rss_mb"]
+                 / max(on["phases"]["settle"]["rss_mb"], 0.1))
+        out["store_rss_ratio_off_over_on"] = round(ratio, 2)
+        out["store_bytes_ratio_off_over_on"] = round(
+            off["store_bytes"] / max(on["store_bytes"], 1), 2)
+        log(f"store rung: steady RSS {out['store_off_rss_mb']} MB (off) vs "
+            f"{out['store_on_rss_mb']} MB (on) — {ratio:.1f}x")
+        if pods >= 10000 and ratio < STORE_RSS_RATIO_BAR:
+            raise RuntimeError(
+                f"STORE BAR MISS: compact store only {ratio:.1f}x below "
+                f"non-compact steady RSS (bar: {STORE_RSS_RATIO_BAR}x)")
+
+        # Pipeline A/B: serial fetch→decode (the pre-PR14 shape, env
+        # TPU_PRUNER_SYNC_PIPELINE=off) vs the default. The default must
+        # never be slower; on multi-core hosts the overlap must actually
+        # pay. (On a 1-core host the pipeline auto-disables — the default
+        # IS the serial shape, and the A/B degenerates to a noise check.)
+        cores = os.cpu_count() or 1
+        out["store_sync_pipeline"] = (
+            "pipelined" if cores > 1 else "auto-serial (1-core host)")
+        serial = _store_child_result(_store_child(
+            k8s, pods, compact="on",
+            env_extra={"TPU_PRUNER_SYNC_PIPELINE": "off"}))
+        out["store_cold_sync_serial_s"] = round(serial["cold_sync_seconds"], 2)
+        slack = 1.10 if pods >= 10000 else 1.5  # tiny fixtures are noise
+        if on["cold_sync_seconds"] > serial["cold_sync_seconds"] * slack:
+            raise RuntimeError(
+                f"STORE BAR MISS: pipelined cold sync "
+                f"{on['cold_sync_seconds']:.2f}s slower than serial "
+                f"{serial['cold_sync_seconds']:.2f}s")
+        if cores > 1 and pods >= 10000 and \
+                on["cold_sync_seconds"] >= serial["cold_sync_seconds"]:
+            raise RuntimeError(
+                f"STORE BAR MISS: {cores}-core host but the pipelined cold "
+                f"sync ({on['cold_sync_seconds']:.2f}s) shows no overlap win "
+                f"over serial ({serial['cold_sync_seconds']:.2f}s)")
+        log(f"store rung: cold sync {out['store_sync_pipeline']} "
+            f"{out['store_cold_sync_s']}s vs serial "
+            f"{out['store_cold_sync_serial_s']}s")
+
+        # Decode shard curve: cold sync wall vs TPU_PRUNER_SYNC_WORKERS.
+        # hardware_concurrency=1 cannot show parallel speedup — emit the
+        # explicit skip marker instead of a meaningless flat curve.
+        out["store_shard_curve_cores"] = cores
+        if cores > 1:
+            curve = {}
+            for w in sorted({1, 2, min(4, cores), cores}):
+                res = _store_child_result(_store_child(
+                    k8s, pods, compact="on",
+                    env_extra={"TPU_PRUNER_SYNC_WORKERS": str(w)}))
+                curve[str(w)] = round(res["cold_sync_seconds"], 2)
+            base = curve["1"]
+            out["store_shard_curve_s"] = curve
+            out["store_shard_speedups"] = {
+                w: round(base / max(s, 1e-9), 2) for w, s in curve.items()}
+            log(f"store rung: shard curve {curve}")
+        else:
+            out["store_shard_curve"] = "skipped (1-core host)"
+
+        # Fixture-side encode cost (satellite: the fake encodes each pod
+        # once per snapshot rv) — detail-file context, not a bar.
+        out["store_fixture_encode"] = dict(k8s.list_encode_stats)
+        out["store_fixture_encode"]["encode_seconds"] = round(
+            out["store_fixture_encode"]["encode_seconds"], 2)
+    finally:
+        k8s.stop()
+    return out
+
+
 def run_planet_tier():
     """The full planet tier: federation half + (unless TP_PLANET_PODS=0)
-    the single-cluster rung."""
+    the 250k single-cluster rung + (unless TP_PLANET_STORE_PODS=0) the
+    1M compact-store rung."""
     out = run_planet_federation()
     if PLANET_PODS > 0:
         out.update(run_planet_single_cluster())
     else:
         out["planet_single_cluster_note"] = "skipped (TP_PLANET_PODS=0)"
+    if PLANET_STORE_PODS > 0:
+        out.update(run_store_scale_rung())
+    else:
+        out["planet_store_note"] = "skipped (TP_PLANET_STORE_PODS=0)"
     return out
 
 
@@ -2877,6 +3186,15 @@ def main():
         "planet_delta_cpu_ratio": planet.get("planet_delta_cpu_ratio"),
         "planet_pods": planet.get("planet_pods"),
         "planet_rss_mb_peak": planet.get("planet_rss_mb_peak"),
+        # compact-store rung: packed PodRecord footprint + pipelined cold
+        # sync at TP_PLANET_STORE_PODS (full block incl. per-phase
+        # envelopes, arena stats and the shard curve in the detail file)
+        "planet_store_pods": planet.get("store_pods"),
+        "store_bytes_per_pod": planet.get("store_bytes_per_pod"),
+        "store_rss_ratio_off_over_on": planet.get("store_rss_ratio_off_over_on"),
+        "store_cold_sync_s": planet.get("store_cold_sync_s"),
+        "store_cold_sync_serial_s": planet.get("store_cold_sync_serial_s"),
+        "store_shard_curve_cores": planet.get("store_shard_curve_cores"),
         "spread_max": (round(max(RUN_SPREADS.values()), 3)
                        if RUN_SPREADS else None),
         "detail_file": detail_path.name,
@@ -2946,6 +3264,22 @@ if __name__ == "__main__":
             out = run_planet_tier()
         except Exception as e:  # noqa: BLE001 — the smoke's failure signal
             log(f"planet tier FAILED: {e}")
+            sys.exit(1)
+        print(json.dumps(out, indent=1))
+        sys.exit(0)
+    if "--planet-1m-only" in sys.argv:
+        # Standalone compact-store rung (the `just bench-planet-1m` smoke
+        # runs this at TP_PLANET_STORE_PODS=65536; the flagship default is
+        # 1,000,000): the bytes-per-pod bar, the compact on/off
+        # steady-state RSS ratio, the pipelined-vs-serial cold-sync
+        # no-worse bar and the shard curve (or its 1-core skip marker)
+        # are all asserted inside — a miss exits non-zero with the reason
+        # on stderr.
+        native.ensure_built()
+        try:
+            out = run_store_scale_rung()
+        except Exception as e:  # noqa: BLE001 — the smoke's failure signal
+            log(f"store scale rung FAILED: {e}")
             sys.exit(1)
         print(json.dumps(out, indent=1))
         sys.exit(0)

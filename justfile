@@ -166,6 +166,26 @@ asan-proto:
     cmake -G Ninja -S . -B build-asan -DTP_SANITIZE=ON && cmake --build build-asan
     ./build-asan/tpupruner_tests proto
 
+# compact-store memory tier: the intern table (concurrent relist units),
+# the packed PodRecord builders and their materialization parity corpus
+# (escape/UTF-8 edges), and the Doc-arena recycling under
+# AddressSanitizer — offset-into-blob string packing is exactly the code
+# whose OOB reads ASan catches and plain asserts don't
+asan-store:
+    cmake -G Ninja -S . -B build-asan -DTP_SANITIZE=ON && cmake --build build-asan
+    ./build-asan/tpupruner_tests compact
+
+# planet-1M store smoke: the 1,000,000-pod compact-store rung scaled to
+# 65,536 pods so CI can run it in minutes — every envelope assertion is
+# still live inside run_store_scale_rung (bytes-per-pod bar, compact
+# on/off steady-state RSS ratio ≥2x, pipelined cold sync no worse than
+# serial, shard-curve or its 1-core skip marker), so a miss exits
+# non-zero. The flagship run is the default TP_PLANET_STORE_PODS=1000000.
+# tests/test_justfile_guard.py pins the recipe to bench.py
+# --planet-1m-only.
+bench-planet-1m:
+    TP_PLANET_STORE_PODS=65536 python bench.py --planet-1m-only
+
 # binary-wire race tier: the fused decode → journal_touch → store-upsert
 # path (reflector threads apply proto frames while the producer drains
 # the dirty journal) plus the informer machinery it rides, under

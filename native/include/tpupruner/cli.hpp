@@ -105,6 +105,12 @@ struct Cli {
   // per endpoint and remembers a refusal; "json" (default) never asks —
   // exact output parity (audit/capsules/ledger/replay byte-identical).
   std::string wire = "json";
+  // --compact-store: pods LIST/watch decode straight into packed,
+  // string-interned PodRecords (compact.hpp) instead of pinning LIST
+  // pages / JSON arenas per entry. Materialization back to a Value is
+  // byte-identical (pinned over the wire-parity corpus); "off" is the
+  // exact-parity escape hatch that keeps the PR 9/11 representations.
+  std::string compact_store = "on";
   int metrics_port = -1;                  // --metrics-port: -1 disabled (flag "0" maps
                                           // here too), 0 ephemeral (flag "auto"), else port
   // --cluster-name: fleet identity stamped on every exported surface (a

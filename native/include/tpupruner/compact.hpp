@@ -1,0 +1,220 @@
+// Compact interned pod store (PR 14).
+//
+// The informer's three entry representations (materialized Value, arena
+// Doc node, aliased proto slice) all retain far more bytes per pod than
+// the walker/actuator/ledger/capsule path ever reads. Behind
+// `--compact-store on` the store decodes pods straight into a packed
+// PodRecord: interned refs for the fleet-repeated strings (namespaces,
+// kinds, apiVersions, owner-ref kinds, label/annotation/resource keys,
+// node names), one per-record byte blob for everything else, presence
+// bits for every optional field. Materialization back to a json::Value
+// is lazy, memoized, and byte-identical to what the JSON/proto decode
+// paths would have produced — a record is only built when the object
+// conforms to the decoder subset exactly, so `dump()` of the
+// materialized Value equals `dump()` of the original parse.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <string_view>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::compact {
+
+// ── process-wide toggle ──
+//
+// Same contract as proto::wire_mode/json::zero_copy: lazily initialized
+// from $TPU_PRUNER_COMPACT_STORE (on|off, default on — parity with the
+// exact representations is a tested invariant, not a risk), overridden
+// by the daemon's --compact-store flag before any client is constructed.
+bool enabled();
+void set_enabled(bool on);
+
+// ── intern table ──
+//
+// Thread-safe, append-only, FNV-sharded. Ids are stable for the process
+// lifetime (records hold them forever), so there is no erase. intern()
+// and str() are safe to call concurrently from the cold-sync pool
+// workers and the watch threads.
+class Interner {
+ public:
+  uint32_t intern(std::string_view s);
+  // The returned view points at an immutable, never-moved string and
+  // stays valid for the process lifetime.
+  std::string_view str(uint32_t id) const;
+  uint64_t count() const { return count_.load(std::memory_order_relaxed); }
+  uint64_t bytes() const { return bytes_.load(std::memory_order_relaxed); }
+
+  Interner();
+  ~Interner();
+  Interner(const Interner&) = delete;
+  Interner& operator=(const Interner&) = delete;
+
+ private:
+  static constexpr size_t kShards = 16;
+  struct Shard;  // compact.cpp: mutex + map + stable string deque
+  Shard* shards_;
+  std::atomic<uint64_t> count_{0};
+  std::atomic<uint64_t> bytes_{0};
+};
+
+Interner& interner();
+
+// ── packed pod record ──
+
+// (offset, length) into PodRecord::blob.
+struct Str {
+  uint32_t off = 0;
+  uint32_t len = 0;
+};
+
+// One label/resource-map entry: interned key AND value — label values
+// (app names, zones, template hashes) and resource quantities repeat
+// across the fleet as much as their keys do.
+struct KV {
+  uint32_t key = 0;
+  uint32_t val = 0;
+};
+
+// One annotation entry: interned key, blob value. Annotation values are
+// frequently per-object-unique (applied configs, checksums) and the
+// intern table never frees, so they stay record-local and die with the
+// record.
+struct AnnKV {
+  uint32_t key = 0;
+  Str value;
+};
+
+struct OwnerRec {
+  enum : uint8_t {
+    kKind = 1u << 0,
+    kName = 1u << 1,
+    kUid = 1u << 2,
+    kApiVersion = 1u << 3,
+    kController = 1u << 4,
+    kControllerVal = 1u << 5,
+    kBlockOwnerDeletion = 1u << 6,
+    kBlockOwnerDeletionVal = 1u << 7,
+  };
+  uint8_t present = 0;
+  uint32_t kind = 0;         // interned
+  uint32_t api_version = 0;  // interned
+  Str name, uid;
+};
+
+struct ContainerRec {
+  enum : uint8_t {
+    kName = 1u << 0,
+    kImage = 1u << 1,
+    kResources = 1u << 2,
+    kLimits = 1u << 3,
+    kRequests = 1u << 4,
+  };
+  uint8_t present = 0;
+  Str name, image;
+  std::vector<KV> limits, requests;  // key = interned resource name
+};
+
+struct PodRecord {
+  enum : uint32_t {
+    kApiVersion = 1u << 0,
+    kKind = 1u << 1,
+    kMetadata = 1u << 2,
+    kSpec = 1u << 3,
+    kStatus = 1u << 4,
+    kName = 1u << 5,
+    kGenerateName = 1u << 6,
+    kNamespace = 1u << 7,
+    kSelfLink = 1u << 8,
+    kUid = 1u << 9,
+    kResourceVersion = 1u << 10,
+    kCreationTs = 1u << 11,
+    kLabels = 1u << 12,
+    kAnnotations = 1u << 13,
+    kOwners = 1u << 14,
+    kContainers = 1u << 15,
+    kNodeName = 1u << 16,
+    kPhase = 1u << 17,
+    kMessage = 1u << 18,
+    kReason = 1u << 19,
+  };
+  uint32_t present = 0;
+  // Interned refs (valid only when the matching presence bit is set).
+  uint32_t ns = 0, api_version = 0, kind = 0, node_name = 0;
+  // Inline strings (blob slices).
+  Str name, generate_name, self_link, uid, resource_version, creation_ts,
+      phase, message, reason;
+  std::vector<KV> labels;
+  std::vector<AnnKV> annotations;
+  std::vector<OwnerRec> owners;
+  std::vector<ContainerRec> containers;
+  // Reserved TPU+GPU chips summed over containers (max of request/limit
+  // per container, matching core's "either alone reserves" rule).
+  uint32_t chips = 0;
+  std::string blob;
+
+  std::string_view view(const Str& s) const {
+    return std::string_view(blob.data() + s.off, s.len);
+  }
+  Str append(std::string_view s) {
+    Str out{static_cast<uint32_t>(blob.size()), static_cast<uint32_t>(s.size())};
+    blob.append(s.data(), s.size());
+    return out;
+  }
+
+  // Materialize the exact Value the JSON/proto decode of the source
+  // object would have produced (construction mirrors
+  // proto::object_to_value field-for-field; json::Object sorts keys, so
+  // dump() is deterministic).
+  json::Value to_value() const;
+  // Approximate retained heap bytes (struct + blob + vectors).
+  size_t bytes() const;
+  // Drop slack capacity after building (records live for a long time).
+  void shrink();
+  // Post-build pass shared by both builders: compute `chips` from the
+  // container resource maps and shrink slack capacity.
+  void finish();
+};
+
+// Build a record from a materialized Value. Returns nullopt when the
+// object falls outside the decoder subset (any unknown key, non-string
+// scalar, null, nested structure the record cannot carry) — the caller
+// keeps the exact representation instead. Round-trip is exact by
+// construction for every accepted object.
+std::optional<PodRecord> record_from_value(const json::Value& v);
+
+// Build a record straight from a protobuf object payload (the slice a
+// LIST page / watch frame carries). Mirrors proto::object_to_value
+// byte-for-byte; throws json::ParseError exactly where it would.
+// Implemented in proto.cpp (shares the wire Reader).
+PodRecord record_from_proto(std::string_view bytes, const std::string& api_version,
+                            const std::string& kind);
+
+// ── store gauges / cold-sync telemetry ──
+//
+// The informer updates these process-wide aggregates; the daemon's
+// /metrics provider renders them. Kept here (not in informer state) so
+// rendering needs no back-reference into live caches.
+void add_store_bytes(int64_t delta);
+void add_store_pods(int64_t delta);
+uint64_t store_bytes();
+uint64_t store_pods();
+// Record one cold LIST→synced duration for `resource` (plural).
+void note_cold_sync(const std::string& resource, double seconds, uint64_t objects);
+// Last cold-sync duration for `resource`, or negative when none yet.
+double last_cold_sync_seconds(const std::string& resource);
+
+// Canonical family list + Prometheus exposition (text or OpenMetrics),
+// appended to the daemon's /metrics by the extra-metrics provider.
+std::vector<std::string> store_metric_families();
+std::string render_store_metrics(bool openmetrics);
+
+// Test hook: clears the toggle cache and the store gauges (NOT the
+// intern table — ids embedded in live records must stay valid).
+void reset_for_test();
+
+}  // namespace tpupruner::compact

@@ -34,6 +34,7 @@
 #include <thread>
 #include <vector>
 
+#include "tpupruner/compact.hpp"
 #include "tpupruner/json.hpp"
 #include "tpupruner/k8s.hpp"
 #include "tpupruner/proto.hpp"
@@ -70,6 +71,10 @@ struct ResourceStats {
   uint64_t relist_requests = 0;
   uint64_t watch_failures = 0;
   std::string resource_version;
+  // Approximate retained bytes of this resource's store entries.
+  uint64_t store_bytes = 0;
+  // Last cold LIST→synced wall for this resource (negative: none yet).
+  double cold_sync_seconds = -1.0;
 };
 
 // Thread-safe object store for one resource. Values share JSON nodes
@@ -82,21 +87,40 @@ struct ResourceStats {
 // actually touches (candidates, owner chains) ever pay tree construction.
 class Store {
  public:
-  // Either a materialized Value, an arena (Doc, node) reference, or — on
-  // the binary wire path — a protobuf slice into a shared page/frame
-  // buffer. All three materialize to IDENTICAL Values on get().
+  // Either a materialized Value, an arena (Doc, node) reference, a
+  // packed compact record (--compact-store on), or — on the binary wire
+  // path — a protobuf slice into a shared page/frame buffer. All four
+  // materialize to IDENTICAL Values on get().
   struct Entry {
-    json::Value value;
-    json::DocPtr doc;
-    uint32_t node = 0;
-    // Proto-backed entry (--wire proto): raw object bytes inside a LIST
-    // page / watch frame (aliased shared_ptr keeps the buffer alive),
-    // materialized lazily via proto::object_to_value. `pfp` is the
-    // fused-path fingerprint over those bytes.
-    std::shared_ptr<const std::string> pbody;
-    size_t poff = 0, plen = 0;
-    std::string papi, pkind;
+    // Exact (non-compact) representations, out-of-line: a million-pod
+    // compact store pays 32 inline bytes per entry instead of ~216 —
+    // the Exact block is allocated only for entries that actually hold
+    // a Value tree, an arena node or a proto slice (or memoize one on
+    // first read).
+    struct Exact {
+      json::Value value;
+      json::DocPtr doc;
+      uint32_t node = 0;
+      // Proto-backed entry (--wire proto): raw object bytes inside a
+      // LIST page / watch frame (aliased shared_ptr keeps the buffer
+      // alive), materialized lazily via proto::object_to_value.
+      std::shared_ptr<const std::string> pbody;
+      size_t poff = 0, plen = 0;
+      std::string papi, pkind;
+    };
+    std::unique_ptr<Exact> exact;
+    // Fused-path fingerprint over the object's wire bytes.
     uint64_t pfp = 0;
+    // Compact-store entry: a packed interned record the upsert decoded
+    // straight into — no page buffer, Doc arena or Value tree retained.
+    // Materializes lazily (and memoizes into `exact`) via
+    // PodRecord::to_value.
+    std::shared_ptr<const compact::PodRecord> rec;
+
+    Exact& ex() {
+      if (!exact) exact = std::make_unique<Exact>();
+      return *exact;
+    }
   };
 
   std::optional<json::Value> get(const std::string& object_path) const;
@@ -119,12 +143,33 @@ class Store {
   uint64_t proto_fingerprint(const std::string& object_path) const;
   void erase(const std::string& object_path);
 
+  ~Store();
+  // Resource identity for the store gauges (pods feed
+  // tpu_pruner_store_pods) and the compact-record upsert gate. Called
+  // once by the owning Reflector before any entry lands.
+  void configure(std::string plural);
+  // Approximate retained bytes across entries (the per-store slice of
+  // tpu_pruner_store_bytes).
+  uint64_t retained_bytes() const;
+  // Entry cost estimator shared with the cold-sync snapshot builder.
+  static size_t entry_cost(const std::string& path, const Entry& e);
+
  private:
+  // Re-point this store's contribution to the process-wide gauges after
+  // a mutation (caller holds mutex_; const because get() memoization
+  // shifts representation cost under a const API).
+  void settle_gauges(int64_t bytes_delta, int64_t object_delta) const;
+
+  // Accounted single-entry insert/overwrite shared by the upsert_* paths.
+  void put(const std::string& object_path, Entry e);
+
+  bool pods_ = false;
   mutable std::mutex mutex_;
   // mutable: get() memoizes an arena entry's materialized Value in place
   // (logically const — the entry's content is unchanged, only its
   // representation).
   mutable std::map<std::string, Entry> objects_;
+  mutable size_t bytes_ = 0;
 };
 
 // List+watch driver for one resource, owning its Store and worker thread.
@@ -197,6 +242,10 @@ class Reflector {
 
  private:
   void run();  // thread body: relist loop wrapping the watch loop
+  // Cold LIST→synced: fetches pages on a helper thread while this thread
+  // decodes+keys them (compact mode fans item decode out over a shard
+  // pool), then swaps the snapshot in. Throws on fetch/decode failure.
+  void cold_sync(bool wire_proto, bool zero_copy);
   void bump_watch_failure(const std::string& why);
   void journal_touch(const std::string& path);  // dirty-journal append
   void journal_all();                           // dirty-journal global mark
@@ -212,6 +261,8 @@ class Reflector {
   std::atomic<bool> stop_{false};
   std::atomic<bool> relist_pending_{false};
   std::atomic<int64_t> last_activity_mono_{0};
+  // Last cold LIST→synced wall (seconds; negative until the first sync).
+  std::atomic<double> cold_sync_secs_{-1.0};
   // Dirty journal: touched object paths since the last drain. Guarded by
   // dirty_mutex_; journal_enabled_ is set once before start() (daemon
   // startup) and read on every event, so it is atomic.

@@ -13,6 +13,7 @@
 #include <cstdint>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <stdexcept>
 #include <string>
 #include <string_view>
@@ -176,6 +177,12 @@ class Doc {
   // ParseError exactly where Value::parse(body) would.
   static DocPtr parse(std::string body);
 
+  Doc() = default;
+  // Releases the node arena into the recycled-arena pool (below).
+  ~Doc();
+  Doc(const Doc&) = delete;
+  Doc& operator=(const Doc&) = delete;
+
   // Lightweight cursor: (doc, node index). Valid while the Doc lives.
   class Node {
    public:
@@ -261,9 +268,32 @@ class Doc {
     return std::string_view((r.key_decoded ? decoded_ : body_).data() + r.key_off, r.key_len);
   }
 
+  // Recycled-arena hooks (json.cpp): parse draws a pooled node vector,
+  // the destructor returns it if the pool budget allows.
+  static std::vector<Rep> take_arena();
+  static void recycle_arena(std::vector<Rep>&& arena);
+  static std::mutex& arena_mutex();
+  static std::vector<std::vector<Rep>>& arena_pool();
+
   std::string body_;     // the response buffer (owned; nodes view into it)
   std::string decoded_;  // side arena for escape-decoded strings
   std::vector<Rep> nodes_;
 };
+
+// ── recycled Doc arenas ─────────────────────────────────────────────────
+//
+// A warm informer cycle parses and drops hundreds of page-sized Docs; the
+// node arenas are identical-shaped allocations, so destroyed Docs donate
+// their arena capacity to a bounded process-wide pool that Doc::parse
+// draws from. The pooled capacity is capped by $TPU_PRUNER_DOC_ARENA_MB
+// (default 32; 0 disables recycling) — the daemon's steady-state Doc
+// allocation cost becomes O(budget), not O(pages parsed).
+struct DocArenaStats {
+  uint64_t reuses = 0;   // parses served from the pool
+  uint64_t returns = 0;  // arenas accepted back into the pool
+  uint64_t drops = 0;    // arenas freed because the pool was at budget
+  uint64_t pooled_bytes = 0;
+};
+DocArenaStats doc_arena_stats();
 
 }  // namespace tpupruner::json

@@ -16,6 +16,7 @@
 
 #include "otlp_grpc.hpp"
 #include "tpupruner/audit.hpp"
+#include "tpupruner/compact.hpp"
 #include "tpupruner/delta.hpp"
 #include "tpupruner/fleet.hpp"
 #include "tpupruner/gym.hpp"
@@ -317,6 +318,11 @@ char* tp_informer_start(const char* payload_json) {
     int wait_ms = 5000;
     if (const Value* w = p.find("wait_ms"); w && w->is_number())
       wait_ms = static_cast<int>(w->as_int());
+    // Optional per-test override of the PROCESS-WIDE compact-store
+    // toggle (the daemon sets it from --compact-store; tests flip it
+    // here before the reflectors latch their decode path).
+    if (const Value* c = p.find("compact_store"); c && c->is_string())
+      tpupruner::compact::set_enabled(c->as_string() == "on");
 
     auto session = std::make_unique<InformerSession>(std::move(cfg), std::move(specs));
     session->cache.start();
@@ -589,6 +595,82 @@ char* tp_wire_metric_families(const char*) {
     }
     Value out = Value::object();
     out.set("families", std::move(families));
+    return ok(out);
+  });
+}
+
+char* tp_store_metric_families(const char*) {
+  // The canonical compact-store metric family names — the docs-drift test
+  // joins this against docs/OPERATIONS.md.
+  return guarded([&] {
+    Value families = Value::array();
+    for (const std::string& f : tpupruner::compact::store_metric_families()) {
+      families.push_back(Value(f));
+    }
+    Value out = Value::object();
+    out.set("families", std::move(families));
+    return ok(out);
+  });
+}
+
+char* tp_compact_roundtrip(const char* payload_json) {
+  // Compact-record parity harness: decode one object through the REAL
+  // PodRecord path and return the materialized form — the Python corpus
+  // compares it byte-for-byte against the non-compact decode of the same
+  // data. {"json": "<object text>"} runs record_from_value (compact=false
+  // when the strict-subset builder refused and the exact Value was kept);
+  // {"body_b64", "api_version", "kind"} runs record_from_proto.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    Value out = Value::object();
+    if (const Value* text = p.find("json"); text && text->is_string()) {
+      Value parsed = Value::parse(text->as_string());
+      if (auto rec = tpupruner::compact::record_from_value(parsed)) {
+        out.set("compact", Value(true));
+        out.set("dump", Value(rec->to_value().dump()));
+        out.set("bytes", Value(static_cast<int64_t>(rec->bytes())));
+        out.set("chips", Value(static_cast<int64_t>(rec->chips)));
+      } else {
+        out.set("compact", Value(false));
+        out.set("dump", Value(parsed.dump()));
+      }
+    } else if (const Value* b64 = p.find("body_b64"); b64 && b64->is_string()) {
+      std::string body = b64_decode(b64->as_string());
+      tpupruner::compact::PodRecord rec = tpupruner::compact::record_from_proto(
+          body, p.get_string("api_version", "v1"), p.get_string("kind", "Pod"));
+      out.set("compact", Value(true));
+      out.set("dump", Value(rec.to_value().dump()));
+      out.set("bytes", Value(static_cast<int64_t>(rec.bytes())));
+      out.set("chips", Value(static_cast<int64_t>(rec.chips)));
+    } else {
+      throw std::runtime_error("missing json or body_b64");
+    }
+    return ok(out);
+  });
+}
+
+char* tp_store_stats(const char*) {
+  // Process-wide compact-store observability for tests and the bench:
+  // the gauge pair behind tpu_pruner_store_{bytes,pods}, the intern
+  // table's size, and the recycled Doc-arena counters.
+  return guarded([&] {
+    Value out = Value::object();
+    out.set("enabled", Value(tpupruner::compact::enabled()));
+    out.set("store_bytes", Value(static_cast<int64_t>(tpupruner::compact::store_bytes())));
+    out.set("store_pods", Value(static_cast<int64_t>(tpupruner::compact::store_pods())));
+    out.set("interned_strings",
+            Value(static_cast<int64_t>(tpupruner::compact::interner().count())));
+    out.set("interned_bytes",
+            Value(static_cast<int64_t>(tpupruner::compact::interner().bytes())));
+    out.set("cold_sync_seconds_pods",
+            Value(tpupruner::compact::last_cold_sync_seconds("pods")));
+    tpupruner::json::DocArenaStats arena = tpupruner::json::doc_arena_stats();
+    Value a = Value::object();
+    a.set("reuses", Value(static_cast<int64_t>(arena.reuses)));
+    a.set("returns", Value(static_cast<int64_t>(arena.returns)));
+    a.set("drops", Value(static_cast<int64_t>(arena.drops)));
+    a.set("pooled_bytes", Value(static_cast<int64_t>(arena.pooled_bytes)));
+    out.set("doc_arena", std::move(a));
     return ok(out);
   });
 }

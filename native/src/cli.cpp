@@ -163,6 +163,14 @@ TPU FLAGS:
                                 ledger and replay are byte-identical across
                                 modes). Owner GETs, patches and CR kinds
                                 always speak JSON
+      --compact-store <M>       on | off [default: on] — hold pods as packed,
+                                string-interned records (namespaces, kinds,
+                                label keys, node names deduplicated
+                                process-wide) decoded straight off the wire,
+                                instead of per-entry JSON arenas or pinned
+                                LIST pages; cuts steady-state RSS on large
+                                fleets. Materialized output is byte-identical;
+                                "off" is the exact-parity escape hatch
       --max-scale-per-cycle <N> blast-radius circuit breaker: pause at most N
                                 root objects per cycle, deferring the rest
                                 (a metric-plane outage reading the whole fleet
@@ -382,6 +390,11 @@ Cli parse(int argc, char** argv) {
        [&](const std::string& v) {
          check_choice("--zero-copy-json", v, {"on", "off"});
          cli.zero_copy_json = v;
+       }},
+      {"--compact-store",
+       [&](const std::string& v) {
+         check_choice("--compact-store", v, {"on", "off"});
+         cli.compact_store = v;
        }},
       {"--watch-cache",
        [&](const std::string& v) {

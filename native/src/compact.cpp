@@ -1,0 +1,536 @@
+// Compact interned pod store: intern table, Value→record builder,
+// record→Value materializer, process toggle and the store gauge
+// families. The proto→record builder lives in proto.cpp (it shares the
+// wire-format Reader).
+#include "tpupruner/compact.hpp"
+
+#include <algorithm>
+#include <cstdio>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+
+#include "tpupruner/shard.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::compact {
+
+using json::Value;
+
+// ── toggle ──
+
+namespace {
+// -1 = unresolved; resolved lazily from the environment on first use, or
+// eagerly by set_enabled (the daemon's --compact-store flag).
+std::atomic<int> g_enabled{-1};
+}  // namespace
+
+bool enabled() {
+  int v = g_enabled.load(std::memory_order_relaxed);
+  if (v < 0) {
+    auto env = util::env("TPU_PRUNER_COMPACT_STORE");
+    v = (env && *env == "off") ? 0 : 1;
+    g_enabled.store(v, std::memory_order_relaxed);
+  }
+  return v == 1;
+}
+
+void set_enabled(bool on) { g_enabled.store(on ? 1 : 0, std::memory_order_relaxed); }
+
+// ── intern table ──
+
+struct Interner::Shard {
+  std::mutex mu;
+  // Keys view into `strings` entries — std::deque never moves elements,
+  // so the views (and ids) stay valid across growth.
+  std::unordered_map<std::string_view, uint32_t> map;
+  std::deque<std::string> strings;
+};
+
+Interner::Interner() : shards_(new Shard[kShards]) {}
+// The process-wide table is never destroyed in practice (interner() holds
+// a leaky static); the destructor exists for completeness.
+Interner::~Interner() { delete[] shards_; }
+
+uint32_t Interner::intern(std::string_view s) {
+  size_t si = static_cast<size_t>(shard::stable_hash(s) % kShards);
+  Shard& sh = shards_[si];
+  std::lock_guard<std::mutex> lock(sh.mu);
+  auto it = sh.map.find(s);
+  if (it != sh.map.end()) return it->second;
+  uint32_t id = static_cast<uint32_t>(sh.strings.size() * kShards + si);
+  sh.strings.emplace_back(s);
+  sh.map.emplace(std::string_view(sh.strings.back()), id);
+  count_.fetch_add(1, std::memory_order_relaxed);
+  bytes_.fetch_add(s.size() + sizeof(std::string), std::memory_order_relaxed);
+  return id;
+}
+
+std::string_view Interner::str(uint32_t id) const {
+  Shard& sh = shards_[id % kShards];
+  // The lock guards the deque's block structure against concurrent
+  // push_back; the element itself is immutable after insert, so the view
+  // stays valid after release.
+  std::lock_guard<std::mutex> lock(sh.mu);
+  return std::string_view(sh.strings[id / kShards]);
+}
+
+Interner& interner() {
+  // Leaked on purpose: record ids must outlive every static destructor.
+  static Interner* table = new Interner();
+  return *table;
+}
+
+// ── record materialization ──
+
+namespace {
+
+Value str_value(const PodRecord& r, const Str& s) { return Value(r.view(s)); }
+
+Value interned_value(uint32_t id) { return Value(interner().str(id)); }
+
+// Duplicate map keys collapse last-wins through Value::set — the same
+// semantics the proto map-entry fold and Value::parse both have.
+Value kv_map(const std::vector<KV>& kvs) {
+  Value out = Value::object();
+  for (const KV& kv : kvs) {
+    out.set(std::string(interner().str(kv.key)), interned_value(kv.val));
+  }
+  return out;
+}
+
+Value ann_map(const PodRecord& r, const std::vector<AnnKV>& kvs) {
+  Value out = Value::object();
+  for (const AnnKV& kv : kvs) {
+    out.set(std::string(interner().str(kv.key)), str_value(r, kv.value));
+  }
+  return out;
+}
+
+}  // namespace
+
+Value PodRecord::to_value() const {
+  Value out = Value::object();
+  if (present & kApiVersion) out.set("apiVersion", interned_value(api_version));
+  if (present & kKind) out.set("kind", interned_value(kind));
+  if (present & kMetadata) {
+    Value meta = Value::object();
+    if (present & kName) meta.set("name", str_value(*this, name));
+    if (present & kGenerateName) meta.set("generateName", str_value(*this, generate_name));
+    if (present & kNamespace) meta.set("namespace", interned_value(ns));
+    if (present & kSelfLink) meta.set("selfLink", str_value(*this, self_link));
+    if (present & kUid) meta.set("uid", str_value(*this, uid));
+    if (present & kResourceVersion)
+      meta.set("resourceVersion", str_value(*this, resource_version));
+    if (present & kCreationTs) meta.set("creationTimestamp", str_value(*this, creation_ts));
+    if (present & kLabels) meta.set("labels", kv_map(labels));
+    if (present & kAnnotations) meta.set("annotations", ann_map(*this, annotations));
+    if (present & kOwners) {
+      Value arr = Value::array();
+      for (const OwnerRec& o : owners) {
+        Value ref = Value::object();
+        if (o.present & OwnerRec::kKind) ref.set("kind", interned_value(o.kind));
+        if (o.present & OwnerRec::kName) ref.set("name", str_value(*this, o.name));
+        if (o.present & OwnerRec::kUid) ref.set("uid", str_value(*this, o.uid));
+        if (o.present & OwnerRec::kApiVersion)
+          ref.set("apiVersion", interned_value(o.api_version));
+        if (o.present & OwnerRec::kController)
+          ref.set("controller", Value((o.present & OwnerRec::kControllerVal) != 0));
+        if (o.present & OwnerRec::kBlockOwnerDeletion)
+          ref.set("blockOwnerDeletion",
+                  Value((o.present & OwnerRec::kBlockOwnerDeletionVal) != 0));
+        arr.push_back(std::move(ref));
+      }
+      meta.set("ownerReferences", std::move(arr));
+    }
+    out.set("metadata", std::move(meta));
+  }
+  if (present & kSpec) {
+    Value spec = Value::object();
+    if (present & kContainers) {
+      Value arr = Value::array();
+      for (const ContainerRec& c : containers) {
+        Value cv = Value::object();
+        if (c.present & ContainerRec::kName) cv.set("name", str_value(*this, c.name));
+        if (c.present & ContainerRec::kImage) cv.set("image", str_value(*this, c.image));
+        if (c.present & ContainerRec::kResources) {
+          Value res = Value::object();
+          if (c.present & ContainerRec::kLimits) res.set("limits", kv_map(c.limits));
+          if (c.present & ContainerRec::kRequests)
+            res.set("requests", kv_map(c.requests));
+          cv.set("resources", std::move(res));
+        }
+        arr.push_back(std::move(cv));
+      }
+      spec.set("containers", std::move(arr));
+    }
+    if (present & kNodeName) spec.set("nodeName", interned_value(node_name));
+    out.set("spec", std::move(spec));
+  }
+  if (present & kStatus) {
+    Value status = Value::object();
+    if (present & kPhase) status.set("phase", str_value(*this, phase));
+    if (present & kMessage) status.set("message", str_value(*this, message));
+    if (present & kReason) status.set("reason", str_value(*this, reason));
+    out.set("status", std::move(status));
+  }
+  return out;
+}
+
+size_t PodRecord::bytes() const {
+  size_t n = sizeof(PodRecord) + blob.capacity();
+  n += labels.capacity() * sizeof(KV);
+  n += annotations.capacity() * sizeof(AnnKV);
+  n += owners.capacity() * sizeof(OwnerRec);
+  n += containers.capacity() * sizeof(ContainerRec);
+  for (const ContainerRec& c : containers) {
+    n += (c.limits.capacity() + c.requests.capacity()) * sizeof(KV);
+  }
+  return n;
+}
+
+void PodRecord::shrink() {
+  blob.shrink_to_fit();
+  labels.shrink_to_fit();
+  annotations.shrink_to_fit();
+  owners.shrink_to_fit();
+  for (ContainerRec& c : containers) {
+    c.limits.shrink_to_fit();
+    c.requests.shrink_to_fit();
+  }
+  containers.shrink_to_fit();
+}
+
+// ── Value → record (strict subset conformance) ──
+
+namespace {
+
+// Chip accounting mirrors core's actuator view: google.com/tpu and
+// nvidia.com/gpu, request or limit alone reserves (max of the two).
+int64_t quantity_chips(const std::vector<KV>& kvs) {
+  int64_t chips = 0;
+  for (const KV& kv : kvs) {
+    std::string_view key = interner().str(kv.key);
+    if (key != "google.com/tpu" && key != "nvidia.com/gpu") continue;
+    std::string_view v = interner().str(kv.val);
+    int64_t n = 0;
+    bool numeric = !v.empty();
+    for (char ch : v) {
+      if (ch < '0' || ch > '9') { numeric = false; break; }
+      n = n * 10 + (ch - '0');
+      if (n > (1 << 30)) { n = 1 << 30; break; }
+    }
+    if (numeric) chips += n;
+  }
+  return chips;
+}
+
+// All values must be strings (labels, annotations, resource quantities).
+bool build_kv_map(const Value& v, std::vector<KV>& out) {
+  if (!v.is_object()) return false;
+  for (const auto& [key, val] : v.as_object()) {
+    if (!val.is_string()) return false;
+    out.push_back(
+        KV{interner().intern(key), interner().intern(val.as_string())});
+  }
+  return true;
+}
+
+bool build_ann_map(PodRecord& r, const Value& v, std::vector<AnnKV>& out) {
+  if (!v.is_object()) return false;
+  for (const auto& [key, val] : v.as_object()) {
+    if (!val.is_string()) return false;
+    out.push_back(AnnKV{interner().intern(key), r.append(val.as_string())});
+  }
+  return true;
+}
+
+bool build_owner(PodRecord& r, const Value& v, OwnerRec& o) {
+  if (!v.is_object()) return false;
+  for (const auto& [key, val] : v.as_object()) {
+    if (key == "kind" && val.is_string()) {
+      o.kind = interner().intern(val.as_string());
+      o.present |= OwnerRec::kKind;
+    } else if (key == "name" && val.is_string()) {
+      o.name = r.append(val.as_string());
+      o.present |= OwnerRec::kName;
+    } else if (key == "uid" && val.is_string()) {
+      o.uid = r.append(val.as_string());
+      o.present |= OwnerRec::kUid;
+    } else if (key == "apiVersion" && val.is_string()) {
+      o.api_version = interner().intern(val.as_string());
+      o.present |= OwnerRec::kApiVersion;
+    } else if (key == "controller" && val.is_bool()) {
+      o.present |= OwnerRec::kController;
+      if (val.as_bool()) o.present |= OwnerRec::kControllerVal;
+    } else if (key == "blockOwnerDeletion" && val.is_bool()) {
+      o.present |= OwnerRec::kBlockOwnerDeletion;
+      if (val.as_bool()) o.present |= OwnerRec::kBlockOwnerDeletionVal;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+bool build_container(PodRecord& r, const Value& v, ContainerRec& c) {
+  if (!v.is_object()) return false;
+  for (const auto& [key, val] : v.as_object()) {
+    if (key == "name" && val.is_string()) {
+      c.name = r.append(val.as_string());
+      c.present |= ContainerRec::kName;
+    } else if (key == "image" && val.is_string()) {
+      c.image = r.append(val.as_string());
+      c.present |= ContainerRec::kImage;
+    } else if (key == "resources" && val.is_object()) {
+      c.present |= ContainerRec::kResources;
+      for (const auto& [rkey, rval] : val.as_object()) {
+        if (rkey == "limits") {
+          if (!build_kv_map(rval, c.limits)) return false;
+          c.present |= ContainerRec::kLimits;
+        } else if (rkey == "requests") {
+          if (!build_kv_map(rval, c.requests)) return false;
+          c.present |= ContainerRec::kRequests;
+        } else {
+          return false;
+        }
+      }
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+bool build_metadata(PodRecord& r, const Value& v) {
+  if (!v.is_object()) return false;
+  r.present |= PodRecord::kMetadata;
+  for (const auto& [key, val] : v.as_object()) {
+    if (key == "name" && val.is_string()) {
+      r.name = r.append(val.as_string());
+      r.present |= PodRecord::kName;
+    } else if (key == "generateName" && val.is_string()) {
+      r.generate_name = r.append(val.as_string());
+      r.present |= PodRecord::kGenerateName;
+    } else if (key == "namespace" && val.is_string()) {
+      r.ns = interner().intern(val.as_string());
+      r.present |= PodRecord::kNamespace;
+    } else if (key == "selfLink" && val.is_string()) {
+      r.self_link = r.append(val.as_string());
+      r.present |= PodRecord::kSelfLink;
+    } else if (key == "uid" && val.is_string()) {
+      r.uid = r.append(val.as_string());
+      r.present |= PodRecord::kUid;
+    } else if (key == "resourceVersion" && val.is_string()) {
+      r.resource_version = r.append(val.as_string());
+      r.present |= PodRecord::kResourceVersion;
+    } else if (key == "creationTimestamp" && val.is_string()) {
+      r.creation_ts = r.append(val.as_string());
+      r.present |= PodRecord::kCreationTs;
+    } else if (key == "labels") {
+      if (!build_kv_map(val, r.labels)) return false;
+      r.present |= PodRecord::kLabels;
+    } else if (key == "annotations") {
+      if (!build_ann_map(r, val, r.annotations)) return false;
+      r.present |= PodRecord::kAnnotations;
+    } else if (key == "ownerReferences" && val.is_array()) {
+      r.present |= PodRecord::kOwners;
+      for (const Value& ov : val.as_array()) {
+        OwnerRec o;
+        if (!build_owner(r, ov, o)) return false;
+        r.owners.push_back(std::move(o));
+      }
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+bool build_spec(PodRecord& r, const Value& v) {
+  if (!v.is_object()) return false;
+  r.present |= PodRecord::kSpec;
+  for (const auto& [key, val] : v.as_object()) {
+    if (key == "containers" && val.is_array()) {
+      r.present |= PodRecord::kContainers;
+      for (const Value& cv : val.as_array()) {
+        ContainerRec c;
+        if (!build_container(r, cv, c)) return false;
+        r.containers.push_back(std::move(c));
+      }
+    } else if (key == "nodeName" && val.is_string()) {
+      r.node_name = interner().intern(val.as_string());
+      r.present |= PodRecord::kNodeName;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+bool build_status(PodRecord& r, const Value& v) {
+  if (!v.is_object()) return false;
+  r.present |= PodRecord::kStatus;
+  for (const auto& [key, val] : v.as_object()) {
+    if (key == "phase" && val.is_string()) {
+      r.phase = r.append(val.as_string());
+      r.present |= PodRecord::kPhase;
+    } else if (key == "message" && val.is_string()) {
+      r.message = r.append(val.as_string());
+      r.present |= PodRecord::kMessage;
+    } else if (key == "reason" && val.is_string()) {
+      r.reason = r.append(val.as_string());
+      r.present |= PodRecord::kReason;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+std::optional<PodRecord> record_from_value(const Value& v) {
+  if (!v.is_object()) return std::nullopt;
+  PodRecord r;
+  for (const auto& [key, val] : v.as_object()) {
+    if (key == "apiVersion" && val.is_string() && !val.as_string().empty()) {
+      // Materialization emits apiVersion/kind only when non-empty (the
+      // proto decoder's rule), so empty strings fall outside the subset.
+      r.api_version = interner().intern(val.as_string());
+      r.present |= PodRecord::kApiVersion;
+    } else if (key == "kind" && val.is_string() && !val.as_string().empty()) {
+      r.kind = interner().intern(val.as_string());
+      r.present |= PodRecord::kKind;
+    } else if (key == "metadata") {
+      if (!build_metadata(r, val)) return std::nullopt;
+    } else if (key == "spec") {
+      if (!build_spec(r, val)) return std::nullopt;
+    } else if (key == "status") {
+      if (!build_status(r, val)) return std::nullopt;
+    } else {
+      return std::nullopt;
+    }
+  }
+  r.finish();
+  return r;
+}
+
+void PodRecord::finish() {
+  chips = 0;
+  for (const ContainerRec& c : containers) {
+    int64_t n = std::max(quantity_chips(c.limits), quantity_chips(c.requests));
+    chips += static_cast<uint32_t>(n);
+  }
+  shrink();
+}
+
+// ── store gauges / cold-sync telemetry ──
+
+namespace {
+
+std::atomic<int64_t> g_store_bytes{0};
+std::atomic<int64_t> g_store_pods{0};
+
+std::mutex g_cold_sync_mutex;
+// plural → {seconds, objects}; std::map keeps exposition order stable.
+std::map<std::string, std::pair<double, uint64_t>>& cold_syncs() {
+  static std::map<std::string, std::pair<double, uint64_t>> m;
+  return m;
+}
+
+std::string fmt_double(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%.6g", v);
+  return buf;
+}
+
+}  // namespace
+
+void add_store_bytes(int64_t delta) {
+  g_store_bytes.fetch_add(delta, std::memory_order_relaxed);
+}
+
+void add_store_pods(int64_t delta) {
+  g_store_pods.fetch_add(delta, std::memory_order_relaxed);
+}
+
+uint64_t store_bytes() {
+  int64_t v = g_store_bytes.load(std::memory_order_relaxed);
+  return v > 0 ? static_cast<uint64_t>(v) : 0;
+}
+
+uint64_t store_pods() {
+  int64_t v = g_store_pods.load(std::memory_order_relaxed);
+  return v > 0 ? static_cast<uint64_t>(v) : 0;
+}
+
+void note_cold_sync(const std::string& resource, double seconds, uint64_t objects) {
+  std::lock_guard<std::mutex> lock(g_cold_sync_mutex);
+  cold_syncs()[resource] = {seconds, objects};
+}
+
+double last_cold_sync_seconds(const std::string& resource) {
+  std::lock_guard<std::mutex> lock(g_cold_sync_mutex);
+  auto it = cold_syncs().find(resource);
+  return it == cold_syncs().end() ? -1.0 : it->second.first;
+}
+
+std::vector<std::string> store_metric_families() {
+  return {
+      "tpu_pruner_store_bytes",
+      "tpu_pruner_store_pods",
+      "tpu_pruner_store_interned_strings",
+      "tpu_pruner_cold_sync_seconds",
+  };
+}
+
+std::string render_store_metrics(bool openmetrics) {
+  (void)openmetrics;  // all families here are gauges in both formats
+  std::string out;
+  auto gauge = [&](const char* name, const char* help, const std::string& value) {
+    out += "# HELP ";
+    out += name;
+    out += ' ';
+    out += help;
+    out += "\n# TYPE ";
+    out += name;
+    out += " gauge\n";
+    out += name;
+    out += ' ';
+    out += value;
+    out += '\n';
+  };
+  gauge("tpu_pruner_store_bytes",
+        "Approximate retained bytes across informer store entries "
+        "(per-entry exclusive representations; shared page buffers "
+        "counted by slice)",
+        std::to_string(store_bytes()));
+  gauge("tpu_pruner_store_pods", "Pod entries held in the informer store",
+        std::to_string(store_pods()));
+  gauge("tpu_pruner_store_interned_strings",
+        "Distinct strings held by the compact store's intern table",
+        std::to_string(interner().count()));
+  {
+    out += "# HELP tpu_pruner_cold_sync_seconds Last cold LIST->synced wall "
+           "per watched resource\n";
+    out += "# TYPE tpu_pruner_cold_sync_seconds gauge\n";
+    std::lock_guard<std::mutex> lock(g_cold_sync_mutex);
+    for (const auto& [resource, rec] : cold_syncs()) {
+      out += "tpu_pruner_cold_sync_seconds{resource=\"" + resource + "\"} " +
+             fmt_double(rec.first) + "\n";
+    }
+  }
+  return out;
+}
+
+void reset_for_test() {
+  g_enabled.store(-1, std::memory_order_relaxed);
+  g_store_bytes.store(0, std::memory_order_relaxed);
+  g_store_pods.store(0, std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lock(g_cold_sync_mutex);
+  cold_syncs().clear();
+}
+
+}  // namespace tpupruner::compact

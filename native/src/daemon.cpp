@@ -21,6 +21,7 @@
 #include "tpupruner/actuate.hpp"
 #include "tpupruner/audit.hpp"
 #include "tpupruner/auth.hpp"
+#include "tpupruner/compact.hpp"
 #include "tpupruner/delta.hpp"
 #include "tpupruner/fleet.hpp"
 #include "tpupruner/gym.hpp"
@@ -1769,9 +1770,11 @@ int run(const cli::Cli& args) {
   h2::set_default_mode(h2::mode_from_string(args.transport));
   json::set_zero_copy(args.zero_copy_json == "on");
   proto::set_wire_mode(proto::wire_mode_from_string(args.wire));
+  compact::set_enabled(args.compact_store == "on");
   log::info("daemon", std::string("Transport: ") + h2::mode_name(h2::default_mode()) +
             ", zero-copy JSON " + args.zero_copy_json + ", wire " +
-            proto::wire_mode_name(proto::wire_mode()));
+            proto::wire_mode_name(proto::wire_mode()) + ", compact store " +
+            args.compact_store);
 
   // Query built once, reused every cycle (main.rs:280-282).
   std::string query = query::build_idle_query(cli::to_query_args(args));
@@ -1897,7 +1900,8 @@ int run(const cli::Cli& args) {
              signal::render_metrics(openmetrics) +
              h2::render_transport_metrics(openmetrics) +
              incremental::render_metrics(openmetrics) +
-             proto::render_wire_metrics(openmetrics);
+             proto::render_wire_metrics(openmetrics) +
+             compact::render_store_metrics(openmetrics);
     });
     // Evidence-health snapshot at /debug/signals (`analyze
     // --signal-report` hits this); {"enabled": false} with the guard off.

@@ -2,9 +2,14 @@
 
 #include <algorithm>
 #include <chrono>
+#include <condition_variable>
+#include <cstdlib>
+#include <deque>
 #include <functional>
+#include <thread>
 
 #include "tpupruner/log.hpp"
+#include "tpupruner/shard.hpp"
 #include "tpupruner/util.hpp"
 
 namespace tpupruner::informer {
@@ -47,12 +52,93 @@ std::vector<ResourceSpec> daemon_specs() {
 
 // ── Store ──
 
+namespace {
+
+// Rough retained-bytes walk over a materialized Value (shared_ptr blocks
+// + container nodes + string payloads). An estimate, not an audit: the
+// gauge it feeds compares representations, it does not bill the heap.
+size_t value_cost(const Value& v) {
+  switch (v.type()) {
+    case json::Type::String:
+      return 48 + v.as_string().size();
+    case json::Type::Array: {
+      size_t n = 56;
+      for (const Value& c : v.as_array()) n += sizeof(Value) + value_cost(c);
+      return n;
+    }
+    case json::Type::Object: {
+      size_t n = 56;
+      for (const auto& [k, c] : v.as_object()) {
+        n += 64 + k.size() + sizeof(Value) + value_cost(c);
+      }
+      return n;
+    }
+    default:
+      return 0;
+  }
+}
+
+// Flat per-entry share of a LIST-page / watch-event Doc arena. The real
+// cost is shared across every entry of the page; a fixed prior keeps the
+// estimator O(1) (a pod subtree is ~40 nodes at ~48 bytes each, plus its
+// slice of the page body).
+constexpr size_t kDocEntryShare = 2048;
+
+}  // namespace
+
+size_t Store::entry_cost(const std::string& path, const Entry& e) {
+  size_t n = path.size() + 96;  // key + map node overhead
+  if (e.rec) {
+    n += e.rec->bytes();
+    return n;
+  }
+  if (!e.exact) return n;  // empty entry (no allocation on const reads)
+  const Entry::Exact& x = *e.exact;
+  n += sizeof(Entry::Exact);
+  if (x.pbody) {
+    // Counted by slice: after the page-retention copy-out the slice IS
+    // the allocation; an aliased small frame undercounts only its header.
+    n += x.plen + x.papi.size() + x.pkind.size() + 64;
+  } else if (x.doc) {
+    n += kDocEntryShare;
+  } else {
+    n += value_cost(x.value);
+  }
+  return n;
+}
+
+void Store::configure(std::string plural) { pods_ = (plural == "pods"); }
+
+void Store::settle_gauges(int64_t bytes_delta, int64_t object_delta) const {
+  bytes_ = static_cast<size_t>(static_cast<int64_t>(bytes_) + bytes_delta);
+  compact::add_store_bytes(bytes_delta);
+  if (pods_ && object_delta != 0) compact::add_store_pods(object_delta);
+}
+
+Store::~Store() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  settle_gauges(-static_cast<int64_t>(bytes_), -static_cast<int64_t>(objects_.size()));
+  objects_.clear();
+}
+
+uint64_t Store::retained_bytes() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return bytes_;
+}
+
 std::optional<Value> Store::get(const std::string& object_path) const {
   std::lock_guard<std::mutex> lock(mutex_);
   auto it = objects_.find(object_path);
   if (it == objects_.end()) return std::nullopt;
   Entry& e = it->second;
-  if (e.doc) {
+  size_t before = entry_cost(object_path, e);
+  if (e.rec) {
+    // Compact entry: materialize the packed record, then MEMOIZE — same
+    // contract as the arena/proto arms below, and byte-identical to them
+    // by the record builders' strict-subset rule.
+    e.ex().value = e.rec->to_value();
+    e.rec.reset();
+  } else if (e.exact && e.exact->doc) {
     // Arena-backed entry: materialize on demand, then MEMOIZE — a warm
     // cycle re-reads the same candidate pods and owner objects every
     // interval, and re-building the tree each time put the conversion in
@@ -60,18 +146,23 @@ std::optional<Value> Store::get(const std::string& object_path) const {
     // the other 99k pods stay flat arena nodes. The doc stays referenced
     // so sibling entries of the same LIST page / watch event are
     // unaffected.
-    e.value = e.doc->node(e.node).to_value();
-    e.doc.reset();
-  } else if (e.pbody) {
+    e.exact->value = e.exact->doc->node(e.exact->node).to_value();
+    e.exact->doc.reset();
+  } else if (e.exact && e.exact->pbody) {
     // Proto-backed entry (--wire proto): same memoized-materialization
     // contract, from the raw protobuf slice. Produces a Value identical
     // to parsing the object's JSON form (pinned by the wire parity
     // corpus), so every consumer downstream is wire-format blind.
-    e.value = proto::object_to_value(
-        std::string_view(e.pbody->data() + e.poff, e.plen), e.papi, e.pkind);
-    e.pbody.reset();
+    Entry::Exact& x = *e.exact;
+    x.value = proto::object_to_value(
+        std::string_view(x.pbody->data() + x.poff, x.plen), x.papi, x.pkind);
+    x.pbody.reset();
   }
-  return e.value;  // COW copy: shares nodes, pointer-sized
+  size_t after = entry_cost(object_path, e);
+  if (after != before) {
+    settle_gauges(static_cast<int64_t>(after) - static_cast<int64_t>(before), 0);
+  }
+  return e.exact ? e.exact->value : Value();  // COW copy: shares nodes, pointer-sized
 }
 
 bool Store::contains(const std::string& object_path) const {
@@ -87,38 +178,101 @@ size_t Store::size() const {
 void Store::replace(std::map<std::string, Value> objects) {
   std::map<std::string, Entry> entries;
   for (auto& [path, v] : objects) {
-    entries[path].value = std::move(v);
+    entries[path].ex().value = std::move(v);
   }
   replace_entries(std::move(entries));
 }
 
 void Store::replace_entries(std::map<std::string, Entry> objects) {
+  size_t total = 0;
+  for (const auto& [path, e] : objects) total += entry_cost(path, e);
   std::lock_guard<std::mutex> lock(mutex_);
+  int64_t bytes_delta = static_cast<int64_t>(total) - static_cast<int64_t>(bytes_);
+  int64_t object_delta =
+      static_cast<int64_t>(objects.size()) - static_cast<int64_t>(objects_.size());
   objects_ = std::move(objects);
+  settle_gauges(bytes_delta, object_delta);
+}
+
+void Store::put(const std::string& object_path, Entry e) {
+  size_t cost = entry_cost(object_path, e);
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = objects_.find(object_path);
+  int64_t bytes_delta = static_cast<int64_t>(cost);
+  int64_t object_delta = 1;
+  if (it != objects_.end()) {
+    bytes_delta -= static_cast<int64_t>(entry_cost(object_path, it->second));
+    object_delta = 0;
+    it->second = std::move(e);
+  } else {
+    objects_.emplace(object_path, std::move(e));
+  }
+  settle_gauges(bytes_delta, object_delta);
 }
 
 void Store::upsert(const std::string& object_path, Value object) {
-  std::lock_guard<std::mutex> lock(mutex_);
-  objects_[object_path] = Entry{std::move(object), nullptr, 0};
+  Entry e;
+  if (pods_ && compact::enabled()) {
+    // Decode straight into a packed record when the object conforms to
+    // the decoder subset; a non-conformant pod keeps its exact Value.
+    if (auto rec = compact::record_from_value(object)) {
+      e.rec = std::make_shared<const compact::PodRecord>(std::move(*rec));
+    }
+  }
+  if (!e.rec) e.ex().value = std::move(object);
+  put(object_path, std::move(e));
 }
 
 void Store::upsert_doc(const std::string& object_path, json::DocPtr doc, uint32_t node) {
-  std::lock_guard<std::mutex> lock(mutex_);
-  objects_[object_path] = Entry{Value(), std::move(doc), node};
+  Entry e;
+  if (pods_ && compact::enabled()) {
+    // Compact mode must not pin the event/page Doc: conforming pods pack
+    // into a record, the rest materialize an owned Value immediately.
+    Value v = doc->node(node).to_value();
+    if (auto rec = compact::record_from_value(v)) {
+      e.rec = std::make_shared<const compact::PodRecord>(std::move(*rec));
+    } else {
+      e.ex().value = std::move(v);
+    }
+  } else {
+    Entry::Exact& x = e.ex();
+    x.doc = std::move(doc);
+    x.node = node;
+  }
+  put(object_path, std::move(e));
 }
 
 void Store::upsert_proto(const std::string& object_path, std::shared_ptr<const std::string> body,
                          size_t off, size_t len, std::string api_version, std::string kind,
                          uint64_t fp) {
-  std::lock_guard<std::mutex> lock(mutex_);
   Entry e;
-  e.pbody = std::move(body);
-  e.poff = off;
-  e.plen = len;
-  e.papi = std::move(api_version);
-  e.pkind = std::move(kind);
   e.pfp = fp;
-  objects_[object_path] = std::move(e);
+  if (pods_ && compact::enabled()) {
+    try {
+      compact::PodRecord rec = compact::record_from_proto(
+          std::string_view(body->data() + off, len), api_version, kind);
+      e.rec = std::make_shared<const compact::PodRecord>(std::move(rec));
+    } catch (const json::ParseError&) {
+      // Malformed payload: keep the raw bytes (copied out, never pinning
+      // the frame) so the error still surfaces at get(), exactly where
+      // the lazy decode would have thrown.
+      Entry::Exact& x = e.ex();
+      x.pbody = std::make_shared<const std::string>(body->data() + off, len);
+      x.poff = 0;
+      x.plen = len;
+      x.papi = std::move(api_version);
+      x.pkind = std::move(kind);
+    }
+  }
+  if (!e.rec && !e.exact) {
+    Entry::Exact& x = e.ex();
+    x.pbody = std::move(body);
+    x.poff = off;
+    x.plen = len;
+    x.papi = std::move(api_version);
+    x.pkind = std::move(kind);
+  }
+  put(object_path, std::move(e));
 }
 
 uint64_t Store::proto_fingerprint(const std::string& object_path) const {
@@ -129,7 +283,10 @@ uint64_t Store::proto_fingerprint(const std::string& object_path) const {
 
 void Store::erase(const std::string& object_path) {
   std::lock_guard<std::mutex> lock(mutex_);
-  objects_.erase(object_path);
+  auto it = objects_.find(object_path);
+  if (it == objects_.end()) return;
+  settle_gauges(-static_cast<int64_t>(entry_cost(object_path, it->second)), -1);
+  objects_.erase(it);
 }
 
 // ── Reflector ──
@@ -185,7 +342,9 @@ void Reflector::journal_all() {
 }
 
 Reflector::Reflector(const k8s::Client& kube, ResourceSpec spec)
-    : kube_(kube), spec_(std::move(spec)) {}
+    : kube_(kube), spec_(std::move(spec)) {
+  store_.configure(spec_.plural);
+}
 
 Reflector::~Reflector() { stop(); }
 
@@ -212,6 +371,8 @@ ResourceStats Reflector::stats() const {
   }
   out.synced = synced_.load();
   out.objects = store_.size();
+  out.store_bytes = store_.retained_bytes();
+  out.cold_sync_seconds = cold_sync_secs_.load();
   return out;
 }
 
@@ -247,7 +408,7 @@ void Reflector::apply_list(const Value& list) {
   if (const Value* items = list.find("items"); items && items->is_array()) {
     for (const Value& item : items->as_array()) {
       std::string path = object_path_of(item);
-      if (!path.empty()) snapshot[std::move(path)].value = item;
+      if (!path.empty()) snapshot[std::move(path)].ex().value = item;
     }
   }
   std::string rv;
@@ -491,6 +652,282 @@ void backoff_sleep(const std::string& path, int attempt, const std::atomic<bool>
 
 }  // namespace
 
+namespace {
+
+// Satellite: LIST page bodies above this threshold never ride into the
+// store via aliasing shared_ptr slices — one live pod must not pin a
+// whole page. Tunable for the regression test; 64 KiB keeps small-page
+// zero-copy behavior intact.
+size_t page_retain_limit() {
+  static const size_t limit = [] {
+    long v = 64 * 1024;
+    if (auto e = util::env("TPU_PRUNER_PAGE_RETAIN_BYTES")) {
+      char* end = nullptr;
+      long parsed = std::strtol(e->c_str(), &end, 10);
+      if (end && *end == '\0' && parsed >= 0) v = parsed;
+    }
+    return static_cast<size_t>(v);
+  }();
+  return limit;
+}
+
+// Cold-sync decode pool. Informer-owned: shard::Pool::run is
+// single-client, and the process-wide shard::pool() belongs to the
+// daemon's reconcile loop, which a mid-run relist would race. The mutex
+// serializes fan-out across reflectors (capi sessions can run several
+// pods reflectors at once). TPU_PRUNER_SYNC_WORKERS pins the pool size
+// (the bench's shard-curve sweep); default = hardware concurrency.
+shard::Pool& sync_pool() {
+  static shard::Pool pool([] {
+    if (auto e = util::env("TPU_PRUNER_SYNC_WORKERS")) {
+      char* end = nullptr;
+      long v = std::strtol(e->c_str(), &end, 10);
+      if (end && *end == '\0' && v >= 1) return static_cast<size_t>(v);
+    }
+    return shard::resolve_shard_count(-1);
+  }());
+  return pool;
+}
+
+// TPU_PRUNER_SYNC_PIPELINE=off falls back to the serial fetch→decode
+// LIST (page N fully decoded before page N+1 is requested) — the
+// pre-pipeline shape, kept as the bench's before/after baseline and an
+// escape hatch.
+bool sync_pipeline_enabled() {
+  static const bool on = [] {
+    auto e = util::env("TPU_PRUNER_SYNC_PIPELINE");
+    if (e) return *e != "off";
+    // Auto: overlapping fetch with decode needs a second core — on a
+    // 1-core host the fetcher thread only steals time from the decoder
+    // (measured ~40% slower), so default to the serial shape there.
+    return std::thread::hardware_concurrency() > 1;
+  }();
+  return on;
+}
+
+std::mutex& sync_pool_mutex() {
+  static std::mutex m;
+  return m;
+}
+
+}  // namespace
+
+void Reflector::cold_sync(bool wire_proto, bool zero_copy) {
+  const auto t0 = std::chrono::steady_clock::now();
+  if (!wire_proto && !zero_copy) {
+    // Legacy single-response LIST (zero-copy off): Value trees, no pages
+    // to pipeline.
+    apply_list(kube_.list(spec_.list_path, "", kListPageLimit));
+  } else {
+    // Pipelined paginated LIST: a fetcher thread pulls page N+1 while
+    // this thread decodes and keys page N. Keyed upserts into the
+    // snapshot map are order-independent, and apply_list_snapshot marks
+    // the journal globally dirty — incremental semantics are untouched.
+    struct Page {
+      proto::ListPagePtr pb;
+      json::DocPtr doc;
+    };
+    std::string rv;
+    const bool compact_pods = spec_.plural == "pods" && compact::enabled();
+    std::map<std::string, Store::Entry> snapshot;
+
+    // Build one (path, entry) pair for a protobuf item.
+    auto wire_entry = [&](const proto::ListPagePtr& pb,
+                          const std::shared_ptr<const std::string>& body, bool copy_out,
+                          const proto::ObjectRef& ref) {
+      Store::Entry e;
+      e.pfp = ref.fp;
+      if (compact_pods) {
+        try {
+          e.rec = std::make_shared<const compact::PodRecord>(compact::record_from_proto(
+              std::string_view(body->data() + ref.off, ref.len), pb->api_version, pb->kind));
+        } catch (const json::ParseError&) {
+          // Keep the raw bytes (copied out) so the malformed payload
+          // still throws at get(), where the lazy decode would have.
+          Store::Entry::Exact& x = e.ex();
+          x.pbody = std::make_shared<const std::string>(body->data() + ref.off, ref.len);
+          x.plen = ref.len;
+          x.papi = pb->api_version;
+          x.pkind = pb->kind;
+        }
+      } else if (copy_out) {
+        Store::Entry::Exact& x = e.ex();
+        x.pbody = std::make_shared<const std::string>(body->data() + ref.off, ref.len);
+        x.plen = ref.len;
+        x.papi = pb->api_version;
+        x.pkind = pb->kind;
+      } else {
+        Store::Entry::Exact& x = e.ex();
+        x.pbody = body;
+        x.poff = ref.off;
+        x.plen = ref.len;
+        x.papi = pb->api_version;
+        x.pkind = pb->kind;
+      }
+      return e;
+    };
+
+    // Build one (path, entry) pair for an arena-Doc item node.
+    auto doc_entry = [&](const json::DocPtr& doc, uint32_t node) {
+      Store::Entry e;
+      if (compact_pods) {
+        Value v = doc->node(node).to_value();
+        if (auto rec = compact::record_from_value(v)) {
+          e.rec = std::make_shared<const compact::PodRecord>(std::move(*rec));
+        } else {
+          e.ex().value = std::move(v);
+        }
+      } else {
+        Store::Entry::Exact& x = e.ex();
+        x.doc = doc;
+        x.node = node;
+      }
+      return e;
+    };
+
+    auto decode_page = [&](const Page& page) {
+      if (page.pb) {
+        // Each protobuf page was scanned ONCE (item ranges + store keys +
+        // fingerprints). Compact mode decodes items straight into packed
+        // records; otherwise entries reference the page buffer — copied
+        // out per item above the retention threshold so one live pod
+        // cannot pin a large page.
+        const auto& pb = page.pb;
+        auto body = std::shared_ptr<const std::string>(pb, &pb->body);
+        const bool copy_out = pb->body.size() > page_retain_limit();
+        const size_t n = pb->items.size();
+        const size_t workers = compact_pods ? std::min(sync_pool().size(), n) : 1;
+        if (workers > 1) {
+          std::vector<std::vector<std::pair<std::string, Store::Entry>>> partial(workers);
+          std::lock_guard<std::mutex> pool_lock(sync_pool_mutex());
+          sync_pool().run(workers, [&](size_t w) {
+            for (size_t i = w; i < n; i += workers) {
+              const proto::ObjectRef& ref = pb->items[i];
+              if (ref.ns.empty() || ref.name.empty()) continue;
+              std::string path = spec_.prefix + "namespaces/" + ref.ns + "/" + spec_.plural +
+                                 "/" + ref.name;
+              partial[w].emplace_back(std::move(path), wire_entry(pb, body, copy_out, ref));
+            }
+          });
+          for (auto& vec : partial) {
+            for (auto& [path, e] : vec) snapshot[std::move(path)] = std::move(e);
+          }
+        } else {
+          for (const proto::ObjectRef& ref : pb->items) {
+            if (ref.ns.empty() || ref.name.empty()) continue;
+            std::string path =
+                spec_.prefix + "namespaces/" + ref.ns + "/" + spec_.plural + "/" + ref.name;
+            snapshot[std::move(path)] = wire_entry(pb, body, copy_out, ref);
+          }
+        }
+      } else if (page.doc) {
+        // Zero-copy JSON page: the snapshot holds (page, node) references
+        // (compact mode packs pods into records instead and releases the
+        // page arena).
+        auto items = page.doc->root().find("items");
+        if (!items || !items->is_array()) return;
+        std::vector<uint32_t> nodes;
+        nodes.reserve(items->size());
+        json::Doc::Node item = items->first_child();
+        for (size_t i = 0; i < items->size(); ++i, item = item.next_sibling()) {
+          nodes.push_back(item.index());
+        }
+        const size_t workers = compact_pods ? std::min(sync_pool().size(), nodes.size()) : 1;
+        if (workers > 1) {
+          std::vector<std::vector<std::pair<std::string, Store::Entry>>> partial(workers);
+          std::lock_guard<std::mutex> pool_lock(sync_pool_mutex());
+          sync_pool().run(workers, [&](size_t w) {
+            for (size_t i = w; i < nodes.size(); i += workers) {
+              std::string path = object_path_of_doc(page.doc->node(nodes[i]));
+              if (path.empty()) continue;
+              partial[w].emplace_back(std::move(path), doc_entry(page.doc, nodes[i]));
+            }
+          });
+          for (auto& vec : partial) {
+            for (auto& [path, e] : vec) snapshot[std::move(path)] = std::move(e);
+          }
+        } else {
+          for (uint32_t node : nodes) {
+            std::string path = object_path_of_doc(page.doc->node(node));
+            if (!path.empty()) snapshot[std::move(path)] = doc_entry(page.doc, node);
+          }
+        }
+      }
+    };
+
+    if (!sync_pipeline_enabled()) {
+      // Serial baseline: decode page N before requesting N+1 (decode
+      // errors propagate straight out of the pager callback).
+      if (wire_proto) {
+        rv = kube_.list_pages_wire(
+            spec_.list_path, "", kListPageLimit,
+            [&](const k8s::Client::WirePage& page) { decode_page(Page{page.pb, page.doc}); });
+      } else {
+        rv = kube_.list_pages(spec_.list_path, "", kListPageLimit,
+                              [&](const json::DocPtr& page) { decode_page(Page{nullptr, page}); });
+      }
+    } else {
+      constexpr size_t kMaxQueuedPages = 4;
+      std::mutex qmu;
+      std::condition_variable qcv;
+      std::deque<Page> queue;
+      bool fetch_done = false;
+      std::exception_ptr fetch_err;
+      std::exception_ptr decode_err;
+      auto push = [&](Page page) {
+        std::unique_lock<std::mutex> lock(qmu);
+        qcv.wait(lock, [&] { return queue.size() < kMaxQueuedPages; });
+        queue.push_back(std::move(page));
+        qcv.notify_all();
+      };
+      std::thread fetcher([&] {
+        try {
+          if (wire_proto) {
+            rv = kube_.list_pages_wire(
+                spec_.list_path, "", kListPageLimit,
+                [&](const k8s::Client::WirePage& page) { push(Page{page.pb, page.doc}); });
+          } else {
+            rv = kube_.list_pages(spec_.list_path, "", kListPageLimit,
+                                  [&](const json::DocPtr& page) { push(Page{nullptr, page}); });
+          }
+        } catch (...) {
+          std::lock_guard<std::mutex> lock(qmu);
+          fetch_err = std::current_exception();
+        }
+        {
+          std::lock_guard<std::mutex> lock(qmu);
+          fetch_done = true;
+        }
+        qcv.notify_all();
+      });
+      while (true) {
+        Page page;
+        {
+          std::unique_lock<std::mutex> lock(qmu);
+          qcv.wait(lock, [&] { return !queue.empty() || fetch_done; });
+          if (queue.empty()) break;
+          page = std::move(queue.front());
+          queue.pop_front();
+          qcv.notify_all();
+        }
+        if (decode_err) continue;  // keep draining so the fetcher can finish
+        try {
+          decode_page(page);
+        } catch (...) {
+          decode_err = std::current_exception();
+        }
+      }
+      fetcher.join();
+      if (fetch_err) std::rethrow_exception(fetch_err);
+      if (decode_err) std::rethrow_exception(decode_err);
+    }
+    apply_list_snapshot(std::move(snapshot), std::move(rv));
+  }
+  double secs = std::chrono::duration<double>(std::chrono::steady_clock::now() - t0).count();
+  cold_sync_secs_.store(secs);
+  compact::note_cold_sync(spec_.plural, secs, store_.size());
+}
+
 void Reflector::run() {
   int list_failures = 0;
   // Latched once per reflector lifetime: flipping the process-wide toggle
@@ -506,64 +943,11 @@ void Reflector::run() {
       // Paginated initial LIST (limit/continue): a 100k-pod cluster
       // arrives in kListPageLimit-object chunks instead of one giant
       // response the apiserver (or this process) has to materialize at
-      // once — the same chunking client-go's pager applies.
-      if (wire_proto) {
-        // Each protobuf page was scanned ONCE (item ranges + store keys +
-        // fingerprints); entries reference the page buffer and stay
-        // un-materialized until a cycle looks them up. JSON fallback
-        // pages take the arena-Doc shape.
-        std::map<std::string, Store::Entry> snapshot;
-        std::string rv = kube_.list_pages_wire(
-            spec_.list_path, "", kListPageLimit, [&](const k8s::Client::WirePage& page) {
-              if (page.pb) {
-                auto body = std::shared_ptr<const std::string>(page.pb, &page.pb->body);
-                for (const proto::ObjectRef& ref : page.pb->items) {
-                  if (ref.ns.empty() || ref.name.empty()) continue;
-                  std::string path = spec_.prefix + "namespaces/" + ref.ns + "/" +
-                                     spec_.plural + "/" + ref.name;
-                  Store::Entry e;
-                  e.pbody = body;
-                  e.poff = ref.off;
-                  e.plen = ref.len;
-                  e.papi = page.pb->api_version;
-                  e.pkind = page.pb->kind;
-                  e.pfp = ref.fp;
-                  snapshot[std::move(path)] = std::move(e);
-                }
-              } else if (page.doc) {
-                auto items = page.doc->root().find("items");
-                if (!items || !items->is_array()) return;
-                json::Doc::Node item = items->first_child();
-                for (size_t i = 0; i < items->size(); ++i, item = item.next_sibling()) {
-                  std::string path = object_path_of_doc(item);
-                  if (!path.empty()) {
-                    snapshot[std::move(path)] = Store::Entry{Value(), page.doc, item.index()};
-                  }
-                }
-              }
-            });
-        apply_list_snapshot(std::move(snapshot), std::move(rv));
-      } else if (zero_copy) {
-        // Zero-copy: each page body becomes an arena Doc; the snapshot
-        // holds (page, node) references and the pods stay un-materialized
-        // until a cycle looks them up.
-        std::map<std::string, Store::Entry> snapshot;
-        std::string rv =
-            kube_.list_pages(spec_.list_path, "", kListPageLimit, [&](const json::DocPtr& page) {
-              auto items = page->root().find("items");
-              if (!items || !items->is_array()) return;
-              json::Doc::Node item = items->first_child();
-              for (size_t i = 0; i < items->size(); ++i, item = item.next_sibling()) {
-                std::string path = object_path_of_doc(item);
-                if (!path.empty()) {
-                  snapshot[std::move(path)] = Store::Entry{Value(), page, item.index()};
-                }
-              }
-            });
-        apply_list_snapshot(std::move(snapshot), std::move(rv));
-      } else {
-        apply_list(kube_.list(spec_.list_path, "", kListPageLimit));
-      }
+      // once — the same chunking client-go's pager applies. PR 14: the
+      // fetch and the decode of successive pages now overlap
+      // (cold_sync's pipeline), and compact mode fans item decode out
+      // over the informer's shard pool.
+      cold_sync(wire_proto, zero_copy);
     } catch (const std::exception& e) {
       synced_.store(false);
       log::warn("informer", "LIST " + spec_.list_path + " failed: " + std::string(e.what()));
@@ -753,10 +1137,12 @@ Value ClusterCache::stats_json() const {
   Value resources = Value::object();
   bool synced = !reflectors_.empty();
   uint64_t objects = 0;
+  uint64_t store_bytes = 0;
   for (const auto& r : reflectors_) {
     ResourceStats s = r->stats();
     synced = synced && s.synced;
     objects += s.objects;
+    store_bytes += s.store_bytes;
     Value rs = Value::object();
     rs.set("synced", Value(s.synced));
     rs.set("objects", Value(static_cast<int64_t>(s.objects)));
@@ -768,11 +1154,14 @@ Value ClusterCache::stats_json() const {
     rs.set("relist_requests", Value(static_cast<int64_t>(s.relist_requests)));
     rs.set("watch_failures", Value(static_cast<int64_t>(s.watch_failures)));
     rs.set("resource_version", Value(s.resource_version));
+    rs.set("store_bytes", Value(static_cast<int64_t>(s.store_bytes)));
+    if (s.cold_sync_seconds >= 0) rs.set("cold_sync_seconds", Value(s.cold_sync_seconds));
     resources.set(r->spec().list_path, std::move(rs));
   }
   Value out = Value::object();
   out.set("synced", Value(synced));
   out.set("objects", Value(static_cast<int64_t>(objects)));
+  out.set("store_bytes", Value(static_cast<int64_t>(store_bytes)));
   out.set("resources", std::move(resources));
   return out;
 }

@@ -6,6 +6,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 
 namespace tpupruner::json {
 
@@ -661,11 +662,88 @@ struct DocParser {
   }
 };
 
+// ── recycled Doc arenas ──
+
+namespace {
+
+size_t arena_budget_bytes() {
+  static const size_t budget = [] {
+    const char* v = std::getenv("TPU_PRUNER_DOC_ARENA_MB");
+    long mb = 32;
+    if (v && *v) {
+      char* end = nullptr;
+      long parsed = std::strtol(v, &end, 10);
+      if (end && *end == '\0' && parsed >= 0) mb = parsed;
+    }
+    return static_cast<size_t>(mb) * 1024 * 1024;
+  }();
+  return budget;
+}
+
+std::atomic<uint64_t> g_arena_reuses{0};
+std::atomic<uint64_t> g_arena_returns{0};
+std::atomic<uint64_t> g_arena_drops{0};
+std::atomic<uint64_t> g_arena_pooled_bytes{0};
+
+}  // namespace
+
+std::mutex& Doc::arena_mutex() {
+  static std::mutex mu;
+  return mu;
+}
+
+std::vector<std::vector<Doc::Rep>>& Doc::arena_pool() {
+  // Leaked so Docs destroyed during static teardown can still recycle.
+  static auto* pool = new std::vector<std::vector<Rep>>();
+  return *pool;
+}
+
+std::vector<Doc::Rep> Doc::take_arena() {
+  std::lock_guard<std::mutex> lock(arena_mutex());
+  auto& pool = arena_pool();
+  if (pool.empty()) return {};
+  std::vector<Rep> arena = std::move(pool.back());
+  pool.pop_back();
+  g_arena_pooled_bytes.fetch_sub(arena.capacity() * sizeof(Rep), std::memory_order_relaxed);
+  g_arena_reuses.fetch_add(1, std::memory_order_relaxed);
+  return arena;
+}
+
+void Doc::recycle_arena(std::vector<Rep>&& arena) {
+  size_t cap_bytes = arena.capacity() * sizeof(Rep);
+  if (cap_bytes == 0) return;
+  {
+    std::lock_guard<std::mutex> lock(arena_mutex());
+    uint64_t pooled = g_arena_pooled_bytes.load(std::memory_order_relaxed);
+    if (pooled + cap_bytes <= arena_budget_bytes()) {
+      arena.clear();
+      arena_pool().push_back(std::move(arena));
+      g_arena_pooled_bytes.fetch_add(cap_bytes, std::memory_order_relaxed);
+      g_arena_returns.fetch_add(1, std::memory_order_relaxed);
+      return;
+    }
+  }
+  g_arena_drops.fetch_add(1, std::memory_order_relaxed);
+}
+
+Doc::~Doc() { recycle_arena(std::move(nodes_)); }
+
+DocArenaStats doc_arena_stats() {
+  DocArenaStats s;
+  s.reuses = g_arena_reuses.load(std::memory_order_relaxed);
+  s.returns = g_arena_returns.load(std::memory_order_relaxed);
+  s.drops = g_arena_drops.load(std::memory_order_relaxed);
+  s.pooled_bytes = g_arena_pooled_bytes.load(std::memory_order_relaxed);
+  return s;
+}
+
 DocPtr Doc::parse(std::string body) {
   auto doc = std::make_shared<Doc>();
   doc->body_ = std::move(body);
+  doc->nodes_ = take_arena();
   // ~16 bytes of JSON per node is a good prior for K8s/Prometheus bodies;
-  // one up-front reserve keeps arena growth off the hot path.
+  // one up-front reserve keeps arena growth off the hot path (a recycled
+  // arena usually already has the capacity).
   doc->nodes_.reserve(doc->body_.size() / 16 + 4);
   DocParser p{doc->body_, doc->decoded_, doc->nodes_};
   p.parse_value(0);

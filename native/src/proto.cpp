@@ -5,6 +5,7 @@
 #include <ctime>
 #include <stdexcept>
 
+#include "tpupruner/compact.hpp"
 #include "tpupruner/util.hpp"
 
 namespace tpupruner::proto {
@@ -698,3 +699,249 @@ std::string prom_canonical_body(const PromVector& v) {
 }
 
 }  // namespace tpupruner::proto
+
+// ── compact::record_from_proto ──────────────────────────────────────────
+//
+// Lives here (not compact.cpp) so it can share the wire Reader and
+// rfc3339 with the Value decoders above. The builder mirrors
+// object_to_value FIELD-FOR-FIELD — same field numbers, same lazy
+// sub-object creation, same last-wins scalar rule — so a record's
+// to_value() is byte-identical to the lazy decode it replaces. The
+// decode-parity corpus (test_compact.cpp + tests/test_compact_store.py)
+// pins that equivalence.
+namespace tpupruner::compact {
+
+// Reaches the anonymous-namespace helpers of tpupruner::proto (same TU).
+using namespace tpupruner::proto;
+
+namespace {
+
+Str record_time(PodRecord& r, Reader t) {
+  int64_t seconds = 0;
+  while (!t.done()) {
+    auto [f, w] = t.tag();
+    if (f == 1 && w == 0) seconds = static_cast<int64_t>(t.varint());
+    else t.skip(w);
+  }
+  return r.append(rfc3339(seconds));
+}
+
+void record_map_entry(Reader e, std::vector<KV>& out) {
+  std::string key, value;
+  while (!e.done()) {
+    auto [f, w] = e.tag();
+    if (f == 1 && w == 2) key = std::string(e.bytes());
+    else if (f == 2 && w == 2) value = std::string(e.bytes());
+    else e.skip(w);
+  }
+  out.push_back(KV{interner().intern(key), interner().intern(value)});
+}
+
+void record_ann_entry(PodRecord& r, Reader e, std::vector<AnnKV>& out) {
+  std::string key, value;
+  while (!e.done()) {
+    auto [f, w] = e.tag();
+    if (f == 1 && w == 2) key = std::string(e.bytes());
+    else if (f == 2 && w == 2) value = std::string(e.bytes());
+    else e.skip(w);
+  }
+  out.push_back(AnnKV{interner().intern(key), r.append(value)});
+}
+
+void record_quantity_entry(Reader e, std::vector<KV>& out) {
+  std::string key, value;
+  while (!e.done()) {
+    auto [f, w] = e.tag();
+    if (f == 1 && w == 2) key = std::string(e.bytes());
+    else if (f == 2 && w == 2) {
+      Reader q = e.message();
+      while (!q.done()) {
+        auto [f2, w2] = q.tag();
+        if (f2 == 1 && w2 == 2) value = std::string(q.bytes());
+        else q.skip(w2);
+      }
+    } else e.skip(w);
+  }
+  out.push_back(KV{interner().intern(key), interner().intern(value)});
+}
+
+OwnerRec record_owner(PodRecord& r, Reader o) {
+  OwnerRec out;
+  while (!o.done()) {
+    auto [f, w] = o.tag();
+    if (f == 1 && w == 2) {
+      out.kind = interner().intern(o.bytes());
+      out.present |= OwnerRec::kKind;
+    } else if (f == 3 && w == 2) {
+      out.name = r.append(o.bytes());
+      out.present |= OwnerRec::kName;
+    } else if (f == 4 && w == 2) {
+      out.uid = r.append(o.bytes());
+      out.present |= OwnerRec::kUid;
+    } else if (f == 5 && w == 2) {
+      out.api_version = interner().intern(o.bytes());
+      out.present |= OwnerRec::kApiVersion;
+    } else if (f == 6 && w == 0) {
+      out.present |= OwnerRec::kController;
+      if (o.varint() != 0) out.present |= OwnerRec::kControllerVal;
+      else out.present &= static_cast<uint8_t>(~OwnerRec::kControllerVal);
+    } else if (f == 7 && w == 0) {
+      out.present |= OwnerRec::kBlockOwnerDeletion;
+      if (o.varint() != 0) out.present |= OwnerRec::kBlockOwnerDeletionVal;
+      else out.present &= static_cast<uint8_t>(~OwnerRec::kBlockOwnerDeletionVal);
+    } else {
+      o.skip(w);
+    }
+  }
+  return out;
+}
+
+void record_meta(PodRecord& r, Reader m) {
+  // A repeated metadata field replaces the whole sub-object (last wins),
+  // exactly as object_to_value's out.set("metadata", ...) does.
+  r.present &= ~(PodRecord::kName | PodRecord::kGenerateName | PodRecord::kNamespace |
+                 PodRecord::kSelfLink | PodRecord::kUid | PodRecord::kResourceVersion |
+                 PodRecord::kCreationTs | PodRecord::kLabels | PodRecord::kAnnotations |
+                 PodRecord::kOwners);
+  r.labels.clear();
+  r.annotations.clear();
+  r.owners.clear();
+  r.present |= PodRecord::kMetadata;
+  while (!m.done()) {
+    auto [f, w] = m.tag();
+    if (f == 1 && w == 2) {
+      r.name = r.append(m.bytes());
+      r.present |= PodRecord::kName;
+    } else if (f == 2 && w == 2) {
+      r.generate_name = r.append(m.bytes());
+      r.present |= PodRecord::kGenerateName;
+    } else if (f == 3 && w == 2) {
+      r.ns = interner().intern(m.bytes());
+      r.present |= PodRecord::kNamespace;
+    } else if (f == 4 && w == 2) {
+      r.self_link = r.append(m.bytes());
+      r.present |= PodRecord::kSelfLink;
+    } else if (f == 5 && w == 2) {
+      r.uid = r.append(m.bytes());
+      r.present |= PodRecord::kUid;
+    } else if (f == 6 && w == 2) {
+      r.resource_version = r.append(m.bytes());
+      r.present |= PodRecord::kResourceVersion;
+    } else if (f == 8 && w == 2) {
+      r.creation_ts = record_time(r, m.message());
+      r.present |= PodRecord::kCreationTs;
+    } else if (f == 11 && w == 2) {
+      record_map_entry(m.message(), r.labels);
+      r.present |= PodRecord::kLabels;
+    } else if (f == 12 && w == 2) {
+      record_ann_entry(r, m.message(), r.annotations);
+      r.present |= PodRecord::kAnnotations;
+    } else if (f == 13 && w == 2) {
+      r.owners.push_back(record_owner(r, m.message()));
+      r.present |= PodRecord::kOwners;
+    } else {
+      m.skip(w);
+    }
+  }
+}
+
+ContainerRec record_container(PodRecord& r, Reader c) {
+  ContainerRec out;
+  while (!c.done()) {
+    auto [f, w] = c.tag();
+    if (f == 1 && w == 2) {
+      out.name = r.append(c.bytes());
+      out.present |= ContainerRec::kName;
+    } else if (f == 2 && w == 2) {
+      out.image = r.append(c.bytes());
+      out.present |= ContainerRec::kImage;
+    } else if (f == 8 && w == 2) {
+      // Repeated resources replaces (container_to_value sets the key).
+      out.present |= ContainerRec::kResources;
+      out.present &= static_cast<uint8_t>(~(ContainerRec::kLimits | ContainerRec::kRequests));
+      out.limits.clear();
+      out.requests.clear();
+      Reader res = c.message();
+      while (!res.done()) {
+        auto [f2, w2] = res.tag();
+        if (f2 == 1 && w2 == 2) {
+          record_quantity_entry(res.message(), out.limits);
+          out.present |= ContainerRec::kLimits;
+        } else if (f2 == 2 && w2 == 2) {
+          record_quantity_entry(res.message(), out.requests);
+          out.present |= ContainerRec::kRequests;
+        } else {
+          res.skip(w2);
+        }
+      }
+    } else {
+      c.skip(w);
+    }
+  }
+  return out;
+}
+
+void record_spec(PodRecord& r, Reader s) {
+  r.present &= ~(PodRecord::kContainers | PodRecord::kNodeName);
+  r.containers.clear();
+  r.present |= PodRecord::kSpec;
+  while (!s.done()) {
+    auto [f, w] = s.tag();
+    if (f == 2 && w == 2) {
+      r.containers.push_back(record_container(r, s.message()));
+      r.present |= PodRecord::kContainers;
+    } else if (f == 10 && w == 2) {
+      r.node_name = interner().intern(s.bytes());
+      r.present |= PodRecord::kNodeName;
+    } else {
+      s.skip(w);
+    }
+  }
+}
+
+void record_status(PodRecord& r, Reader s) {
+  r.present &= ~(PodRecord::kPhase | PodRecord::kMessage | PodRecord::kReason);
+  r.present |= PodRecord::kStatus;
+  while (!s.done()) {
+    auto [f, w] = s.tag();
+    if (f == 1 && w == 2) {
+      r.phase = r.append(s.bytes());
+      r.present |= PodRecord::kPhase;
+    } else if (f == 3 && w == 2) {
+      r.message = r.append(s.bytes());
+      r.present |= PodRecord::kMessage;
+    } else if (f == 4 && w == 2) {
+      r.reason = r.append(s.bytes());
+      r.present |= PodRecord::kReason;
+    } else {
+      s.skip(w);
+    }
+  }
+}
+
+}  // namespace
+
+PodRecord record_from_proto(std::string_view bytes, const std::string& api_version,
+                            const std::string& kind) {
+  PodRecord r;
+  if (!api_version.empty()) {
+    r.api_version = interner().intern(api_version);
+    r.present |= PodRecord::kApiVersion;
+  }
+  if (!kind.empty()) {
+    r.kind = interner().intern(kind);
+    r.present |= PodRecord::kKind;
+  }
+  Reader rd{bytes, 0, 0};
+  while (!rd.done()) {
+    auto [f, w] = rd.tag();
+    if (f == 1 && w == 2) record_meta(r, rd.message());
+    else if (f == 2 && w == 2) record_spec(r, rd.message());
+    else if (f == 3 && w == 2) record_status(r, rd.message());
+    else rd.skip(w);
+  }
+  r.finish();
+  return r;
+}
+
+}  // namespace tpupruner::compact

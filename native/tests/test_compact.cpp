@@ -1,0 +1,285 @@
+// Compact interned pod store (native/include/tpupruner/compact.hpp).
+//
+// Two contracts are load-bearing enough to pin natively:
+//   1. The intern table is safe under concurrent intern+lookup — a relist
+//      decodes pages on the sync pool while warm cycles read entries, so
+//      this is the TSan target (`just asan-store` runs it sanitized).
+//   2. A PodRecord materializes to EXACTLY the Value the non-compact
+//      decode produces — dump() byte-identity over JSON and protobuf
+//      forms, including escape/UTF-8 edges — and the strict-subset
+//      builder REFUSES anything it could not round-trip, falling back to
+//      the exact representation instead of guessing.
+#include "testing.hpp"
+
+#include <atomic>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpupruner/compact.hpp"
+#include "tpupruner/core.hpp"
+#include "tpupruner/json.hpp"
+#include "tpupruner/proto.hpp"
+
+namespace compact = tpupruner::compact;
+namespace proto = tpupruner::proto;
+using tpupruner::json::Value;
+
+namespace {
+
+// ── tiny encoder (the C++ twin of tpu_pruner/testing/wire_proto.py) ──
+
+std::string enc_varint(uint64_t n) {
+  std::string out;
+  while (true) {
+    uint8_t b = n & 0x7F;
+    n >>= 7;
+    if (n) out.push_back(static_cast<char>(b | 0x80));
+    else {
+      out.push_back(static_cast<char>(b));
+      return out;
+    }
+  }
+}
+
+std::string enc_tag(uint32_t field, uint32_t wt) { return enc_varint((field << 3) | wt); }
+
+std::string enc_ld(uint32_t field, const std::string& data) {
+  return enc_tag(field, 2) + enc_varint(data.size()) + data;
+}
+
+std::string enc_str(uint32_t field, const std::string& s) { return enc_ld(field, s); }
+
+std::string enc_demo_pod() {
+  std::string meta = enc_str(1, "pod-0") + enc_str(3, "ml") + enc_str(5, "uid-0") +
+                     enc_str(6, "41");
+  meta += enc_ld(11, enc_str(1, "app") + enc_str(2, "demo"));
+  std::string owner = enc_str(1, "ReplicaSet") + enc_str(3, "rs-0") + enc_str(4, "uid-rs") +
+                      enc_str(5, "apps/v1") + enc_tag(6, 0) + enc_varint(1);
+  meta += enc_ld(13, owner);
+  std::string quantity = enc_ld(2, enc_str(1, "4"));
+  std::string requests = enc_ld(2, enc_str(1, "google.com/tpu") + quantity);
+  std::string limits = enc_ld(1, enc_str(1, "google.com/tpu") + quantity);
+  std::string container = enc_str(1, "main") + enc_ld(8, limits + requests);
+  std::string spec = enc_ld(2, container) + enc_str(10, "node-7");
+  std::string status = enc_str(1, "Running");
+  return enc_ld(1, meta) + enc_ld(2, spec) + enc_ld(3, status);
+}
+
+}  // namespace
+
+// ── intern table ────────────────────────────────────────────────────────
+
+TP_TEST(compact_intern_dedup_and_roundtrip) {
+  compact::Interner& in = compact::interner();
+  uint32_t a = in.intern("compact-test-ns-alpha");
+  uint32_t b = in.intern("compact-test-ns-beta");
+  TP_CHECK(a != b);
+  TP_CHECK_EQ(in.intern("compact-test-ns-alpha"), a);
+  TP_CHECK_EQ(std::string(in.str(a)), std::string("compact-test-ns-alpha"));
+  TP_CHECK_EQ(std::string(in.str(b)), std::string("compact-test-ns-beta"));
+  // the empty string is a valid (and common: generateName-only pods) key
+  uint32_t e = in.intern("");
+  TP_CHECK_EQ(std::string(in.str(e)), std::string(""));
+}
+
+TP_TEST(compact_intern_concurrent_relist) {
+  // The TSan target: writer threads intern a churning key set (what the
+  // sync pool does during a relist) while reader threads resolve ids
+  // interned moments earlier. Any lock hole shows up as a data race on
+  // the shard maps or a dangling string_view.
+  compact::Interner& in = compact::interner();
+  constexpr int kThreads = 4;
+  constexpr int kKeys = 400;
+  std::atomic<bool> failed{false};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back([&, t] {
+      std::vector<uint32_t> ids;
+      ids.reserve(kKeys);
+      for (int i = 0; i < kKeys; ++i) {
+        // overlapping across threads (i) and thread-unique (t:i) keys
+        std::string shared = "compact-race-shared-" + std::to_string(i);
+        std::string unique =
+            "compact-race-" + std::to_string(t) + "-" + std::to_string(i);
+        uint32_t sid = in.intern(shared);
+        uint32_t uid = in.intern(unique);
+        ids.push_back(uid);
+        if (std::string(in.str(sid)) != shared) failed.store(true);
+        if (std::string(in.str(uid)) != unique) failed.store(true);
+        // re-intern must dedup even under contention
+        if (in.intern(shared) != sid) failed.store(true);
+      }
+      for (int i = 0; i < kKeys; ++i) {
+        std::string expect = "compact-race-" + std::to_string(t) + "-" + std::to_string(i);
+        if (std::string(in.str(ids[i])) != expect) failed.store(true);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  TP_CHECK(!failed.load());
+  TP_CHECK(in.count() > 0);
+  TP_CHECK(in.bytes() > 0);
+}
+
+// ── record materialization parity ───────────────────────────────────────
+
+namespace {
+
+// Assert the compact record round-trips `text` byte-identically.
+void check_json_roundtrip(const std::string& text) {
+  Value v = Value::parse(text);
+  auto rec = compact::record_from_value(v);
+  TP_CHECK(rec.has_value());
+  TP_CHECK_EQ(rec->to_value().dump(), v.dump());
+}
+
+}  // namespace
+
+TP_TEST(compact_record_json_parity_corpus) {
+  // The recorded LIST/watch shapes the store sees, plus the edges the
+  // satellite calls out: escapes, UTF-8, empty label maps, empty
+  // containers, generateName-only metadata, gpu + tpu chips.
+  check_json_roundtrip(R"({"apiVersion": "v1", "kind": "Pod",
+    "metadata": {"name": "pod-0", "namespace": "ml", "uid": "uid-0",
+                 "resourceVersion": "41", "labels": {"app": "demo"},
+                 "ownerReferences": [{"apiVersion": "apps/v1", "kind": "ReplicaSet",
+                                      "name": "rs-0", "uid": "uid-rs",
+                                      "controller": true}]},
+    "spec": {"containers": [{"name": "main",
+              "resources": {"limits": {"google.com/tpu": "4"},
+                            "requests": {"google.com/tpu": "4"}}}]},
+    "status": {"phase": "Running"}})");
+  check_json_roundtrip(R"({"apiVersion": "v1", "kind": "Pod",
+    "metadata": {"generateName": "burst-", "namespace": "ns"},
+    "spec": {"containers": []}})");
+  check_json_roundtrip(R"({"apiVersion": "v1", "kind": "Pod",
+    "metadata": {"name": "esc", "namespace": "ns",
+                 "labels": {"quote\"key": "tab\tval", "nl": "a\nb"},
+                 "annotations": {"übergroß": "ключ"}},
+    "spec": {"nodeName": "node-ü", "containers": [{"name": "c"}]},
+    "status": {"message": "back\\slash \"x\"", "reason": "Evicted"}})");
+  check_json_roundtrip(R"({"apiVersion": "v1", "kind": "Pod",
+    "metadata": {"name": "empty-maps", "namespace": "ns",
+                 "labels": {}, "annotations": {}, "ownerReferences": []},
+    "spec": {"containers": [{"name": "c", "resources": {}}]},
+    "status": {}})");
+  check_json_roundtrip(R"({"apiVersion": "v1", "kind": "Pod",
+    "metadata": {"name": "gpu", "namespace": "ns",
+                 "creationTimestamp": "2026-01-02T03:04:05Z",
+                 "selfLink": "/api/v1/x"},
+    "spec": {"containers": [{"name": "c", "image": "i",
+              "resources": {"limits": {"nvidia.com/gpu": "8"},
+                            "requests": {"nvidia.com/gpu": "2"}}}]},
+    "status": {"phase": "Pending", "message": "m", "reason": "r"}})");
+}
+
+TP_TEST(compact_record_chips_match_core_accounting) {
+  const char* text = R"({"apiVersion": "v1", "kind": "Pod",
+    "metadata": {"name": "chips", "namespace": "ns"},
+    "spec": {"containers": [
+      {"name": "a", "resources": {"limits": {"google.com/tpu": "4"},
+                                  "requests": {"google.com/tpu": "2"}}},
+      {"name": "b", "resources": {"requests": {"nvidia.com/gpu": "3"}}}]},
+    "status": {"phase": "Running"}})";
+  Value v = Value::parse(text);
+  auto rec = compact::record_from_value(v);
+  TP_CHECK(rec.has_value());
+  // max(limits, requests) per container, both devices: 4 tpu + 3 gpu
+  TP_CHECK_EQ(static_cast<int64_t>(rec->chips),
+              tpupruner::core::pod_chip_count(v, "tpu") +
+                  tpupruner::core::pod_chip_count(v, "gpu"));
+}
+
+TP_TEST(compact_record_refuses_out_of_schema_shapes) {
+  // Every refusal keeps the exact original representation in the store —
+  // so a refusal is a correctness non-event, but a silent ACCEPT of one
+  // of these would corrupt the materialized bytes.
+  const char* shapes[] = {
+      // unknown metadata key
+      R"({"apiVersion": "v1", "kind": "Pod",
+          "metadata": {"name": "x", "namespace": "ns", "finalizers": ["a"]},
+          "spec": {"containers": []}})",
+      // non-string label value
+      R"({"apiVersion": "v1", "kind": "Pod",
+          "metadata": {"name": "x", "namespace": "ns", "labels": {"a": 1}},
+          "spec": {"containers": []}})",
+      // unknown spec key
+      R"({"apiVersion": "v1", "kind": "Pod",
+          "metadata": {"name": "x", "namespace": "ns"},
+          "spec": {"containers": [], "hostNetwork": true}})",
+      // unknown container key
+      R"({"apiVersion": "v1", "kind": "Pod",
+          "metadata": {"name": "x", "namespace": "ns"},
+          "spec": {"containers": [{"name": "c", "env": []}]}})",
+      // unknown status key
+      R"({"apiVersion": "v1", "kind": "Pod",
+          "metadata": {"name": "x", "namespace": "ns"},
+          "spec": {"containers": []},
+          "status": {"phase": "Running", "hostIP": "1.2.3.4"}})",
+      // null where the subset wants a string
+      R"({"apiVersion": "v1", "kind": "Pod",
+          "metadata": {"name": null, "namespace": "ns"},
+          "spec": {"containers": []}})",
+  };
+  for (const char* text : shapes) {
+    TP_CHECK(!compact::record_from_value(Value::parse(text)).has_value());
+  }
+}
+
+TP_TEST(compact_record_proto_parity) {
+  // record_from_proto must materialize EXACTLY what the lazy
+  // object_to_value path yields for the same bytes.
+  std::string body = enc_demo_pod();
+  Value lazy = proto::object_to_value(body, "v1", "Pod");
+  compact::PodRecord rec = compact::record_from_proto(body, "v1", "Pod");
+  TP_CHECK_EQ(rec.to_value().dump(), lazy.dump());
+  TP_CHECK_EQ(static_cast<int64_t>(rec.chips), tpupruner::core::pod_chip_count(lazy));
+  TP_CHECK(rec.bytes() < body.size() + sizeof(compact::PodRecord) + 256);
+}
+
+TP_TEST(compact_record_proto_duplicate_fields_last_wins) {
+  // Repeated metadata (field 1) replaces the whole sub-object, exactly
+  // like proto.cpp's object_to_value (out.set is last-wins).
+  std::string meta1 = enc_str(1, "first") + enc_str(3, "ns");
+  std::string meta2 = enc_str(1, "second") + enc_str(3, "ns") +
+                      enc_ld(11, enc_str(1, "k") + enc_str(2, "v"));
+  std::string body = enc_ld(1, meta1) + enc_ld(1, meta2);
+  Value lazy = proto::object_to_value(body, "v1", "Pod");
+  compact::PodRecord rec = compact::record_from_proto(body, "v1", "Pod");
+  TP_CHECK_EQ(rec.to_value().dump(), lazy.dump());
+}
+
+TP_TEST(compact_record_proto_throws_where_lazy_would) {
+  // Truncated length prefix: both decode paths must throw ParseError —
+  // cold_sync relies on matching error behavior to keep get()-time
+  // semantics when it falls back to raw bytes.
+  std::string body = enc_demo_pod();
+  std::string truncated = body.substr(0, body.size() / 2);
+  bool lazy_threw = false, record_threw = false;
+  try {
+    proto::object_to_value(truncated, "v1", "Pod");
+  } catch (const tpupruner::json::ParseError&) {
+    lazy_threw = true;
+  }
+  try {
+    compact::record_from_proto(truncated, "v1", "Pod");
+  } catch (const tpupruner::json::ParseError&) {
+    record_threw = true;
+  }
+  TP_CHECK_EQ(lazy_threw, record_threw);
+}
+
+// ── doc arena recycling ─────────────────────────────────────────────────
+
+TP_TEST(compact_doc_arena_recycles_across_parses) {
+  using tpupruner::json::Doc;
+  auto before = tpupruner::json::doc_arena_stats();
+  { auto doc = Doc::parse(R"({"a": [1, 2, 3], "b": {"c": "d"}})"); }
+  auto mid = tpupruner::json::doc_arena_stats();
+  TP_CHECK(mid.returns > before.returns || mid.drops > before.drops);
+  { auto doc = Doc::parse(R"({"e": [4, 5, 6], "f": {"g": "h"}})"); }
+  auto after = tpupruner::json::doc_arena_stats();
+  // the second parse draws the arena the first one returned
+  TP_CHECK(after.reuses > before.reuses || after.drops > mid.drops);
+}

@@ -32,6 +32,13 @@ import pytest  # noqa: E402
 from tpu_pruner import native  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 verify run (-m 'not slow'); "
+        "`just test` runs the unfiltered suite")
+
+
 @pytest.fixture(scope="session")
 def built():
     """Session-scoped native build: returns the tpu_pruner.native module."""

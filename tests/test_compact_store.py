@@ -1,0 +1,419 @@
+"""Compact interned store tests (the ISSUE 14 perf tentpole).
+
+`--compact-store on|off` switches the pods store between packed,
+string-interned PodRecords decoded straight off the wire and the PR 9/11
+representations (arena-Doc / raw proto slice per entry). Pinned here:
+
+  - THE acceptance: `--compact-store on` and `off` are byte-identical on
+    normalized audit JSONL, flight capsules and ledger checkpoints — at
+    shards 1 and 8 × `--wire json|proto` — and a compact-recorded
+    capsule replays bit-for-bit through `analyze --replay`;
+  - materialization parity corpus: every pod in the recorded fixture
+    decodes through the compact record path (JSON and protobuf forms) to
+    EXACTLY the bytes the non-compact decode produces, including
+    escape/UTF-8 edges (`just asan-store` runs the native twin
+    sanitized);
+  - the page-body pinning fix rides along even with compact OFF: after a
+    cold sync over multi-megabyte protobuf pages, deleting nearly every
+    pod releases the pages — survivors hold copied-out slices, so RSS
+    does not stay pinned at page-size granularity (the `upsert_proto`
+    aliasing-shared_ptr bug, ISSUE 14 satellite 1);
+  - store observability: tpu_pruner_store_{bytes,pods,interned_strings}
+    and cold_sync_seconds served on /metrics, store_bytes /
+    cold_sync_seconds in the informer debug stats, and the compact store
+    measurably (≥2×) smaller than the non-compact one on the same data.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus, wire_proto
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def daemon_env(fake_k8s):
+    return {"KUBE_API_URL": fake_k8s.url, "KUBE_TOKEN": "t",
+            "PROMETHEUS_TOKEN": "t", "PATH": "/usr/bin:/bin"}
+
+
+def run_daemon(fake_prom, fake_k8s, *extra, run_mode="dry-run", cycles=2):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", run_mode, "--daemon-mode", "--check-interval", "1",
+           "--max-cycles", str(cycles), "--watch-cache", "on", *extra]
+    proc = subprocess.run(cmd, env=daemon_env(fake_k8s),
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc
+
+
+def mixed_cluster(fake_prom, fake_k8s):
+    """The wire-parity fixture: deployments, a full idle JobSet slice, an
+    annotated pod (root veto), an orphan and a ghost series — every
+    decision path the byte-identity matrix must reproduce across store
+    modes."""
+    for i in range(3):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}",
+                                                   num_pods=1, tpu_chips=4)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml",
+                                      chips=4)
+    _, slice_pods = fake_k8s.add_jobset_slice("tpu-jobs", "slice-0",
+                                              num_hosts=4, tpu_chips=4)
+    for pod in slice_pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "tpu-jobs",
+                                      chips=4)
+    _, _, vetoed = fake_k8s.add_deployment_chain("ml", "protected",
+                                                 num_pods=1, tpu_chips=4)
+    vetoed[0]["metadata"]["annotations"] = {"tpu-pruner.dev/skip": "true"}
+    fake_prom.add_idle_pod_series(vetoed[0]["metadata"]["name"], "ml")
+    fake_k8s.add_pod("ml", "orphan",
+                     owners=[fake_k8s.owner("DaemonSet", "ds-x")])
+    fake_prom.add_idle_pod_series("orphan", "ml")
+    fake_prom.add_idle_pod_series("ghost", "ml")
+
+
+# Normalization identical to the wire matrix (test_wire_proto.py): clock,
+# trace and provenance fields legitimately differ run to run.
+VOLATILE_KEYS = {"ts", "ts_unix", "ts_ms", "now_unix", "trace_id", "id",
+                 "incremental"}
+LEDGER_VOLATILE = VOLATILE_KEYS | {"epoch", "idle_seconds", "active_seconds",
+                                   "reclaimed_chip_seconds", "paused_since",
+                                   "paused_since_unix"}
+
+
+def _normalize(obj, volatile=VOLATILE_KEYS):
+    if isinstance(obj, dict):
+        return {k: _normalize(v, volatile) for k, v in obj.items()
+                if k not in volatile}
+    if isinstance(obj, list):
+        return [_normalize(v, volatile) for v in obj]
+    return obj
+
+
+# ── THE acceptance: byte-identity compact on|off × shards × wire ───────
+
+
+def test_compact_modes_byte_identical_matrix(built, fake_prom, fake_k8s,
+                                             tmp_path):
+    """`--compact-store on` vs `off` on one fixture — at shards 1 and 8,
+    `--wire json` and `--wire proto` — produce byte-identical normalized
+    audit JSONL, flight capsules and ledger checkpoints, and a
+    compact-recorded capsule set replays bit-for-bit offline."""
+    mixed_cluster(fake_prom, fake_k8s)
+    fake_prom.freeze_time = 1754300000.25
+    outputs = {}
+    compact_flight = None
+    for shards in (1, 8):
+        for wire in ("json", "proto"):
+            for store in ("on", "off"):
+                tag = f"{store}-{shards}-{wire}"
+                audit = tmp_path / f"audit-{tag}.jsonl"
+                flight = tmp_path / f"flight-{tag}"
+                ledger = tmp_path / f"ledger-{tag}.jsonl"
+                run_daemon(fake_prom, fake_k8s, "--wire", wire,
+                           "--shards", str(shards),
+                           "--compact-store", store,
+                           "--signal-guard", "on",
+                           "--audit-log", str(audit),
+                           "--flight-dir", str(flight),
+                           "--ledger-file", str(ledger))
+                if store == "on" and wire == "proto":
+                    compact_flight = flight
+                records = [_normalize(json.loads(line))
+                           for line in audit.read_text().splitlines()]
+                capsules = [_normalize(json.loads(p.read_text()))
+                            for p in sorted(flight.glob("cycle-*.json"))]
+                accounts = [_normalize(json.loads(line), LEDGER_VOLATILE)
+                            for line in ledger.read_text().splitlines()]
+                assert records and capsules and accounts, tag
+                outputs[(store, shards, wire)] = (
+                    json.dumps(records, sort_keys=True),
+                    json.dumps(capsules, sort_keys=True),
+                    json.dumps(accounts, sort_keys=True))
+
+    for shards in (1, 8):
+        for wire in ("json", "proto"):
+            on = outputs[("on", shards, wire)]
+            off = outputs[("off", shards, wire)]
+            where = f"shards={shards} wire={wire}"
+            assert on[0] == off[0], f"audit differs across store ({where})"
+            assert on[1] == off[1], f"capsules differ across store ({where})"
+            assert on[2] == off[2], f"ledger differs across store ({where})"
+
+    # a capsule recorded THROUGH the compact store replays bit-for-bit
+    assert compact_flight is not None
+    capsules = sorted(compact_flight.glob("cycle-*.json"))
+    assert capsules
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_pruner.analyze", "--replay",
+         str(capsules[-1])],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert json.loads(proc.stdout)["match"] is True
+
+
+# ── materialization parity over the recorded fixture ───────────────────
+
+
+def _plain_dump(obj_text):
+    """The non-compact decode's bytes for the same object text."""
+    return native._call("tp_json_parse", {"body": obj_text})["dump"]
+
+
+def test_compact_record_parity_over_fixture(built, fake_prom, fake_k8s):
+    """Every pod in the recorded mixed fixture — plus escape/UTF-8 edge
+    pods — round-trips the compact record path byte-identically in BOTH
+    wire forms (record_from_value and record_from_proto)."""
+    mixed_cluster(fake_prom, fake_k8s)
+    edge = fake_k8s.add_pod("ml", "edge-pod",
+                            labels={"app\ttab": 'quo"te',
+                                    "ünïcode": "значение"})
+    edge["metadata"]["annotations"] = {"back\\slash": "line\nbreak",
+                                       "ключ": "übergroß"}
+    pods = [obj for path, obj in fake_k8s.objects.items() if "/pods/" in path]
+    assert len(pods) >= 10
+    compacted = 0
+    for obj in pods:
+        name = obj["metadata"]["name"]
+        text = json.dumps(obj)
+        expect = _plain_dump(text)
+        got = native.compact_roundtrip(text)
+        assert got["dump"] == expect, name
+        if got["compact"]:
+            compacted += 1
+        try:
+            body = wire_proto.encode_object_body(obj)
+        except wire_proto.Unencodable:
+            continue
+        via_proto = native.compact_roundtrip(proto_body=body)
+        assert via_proto["compact"], name
+        # The wire corpus (test_wire_proto) pins proto-decode == the JSON
+        # object for schema-covered pods, so the record built FROM proto
+        # must land on the same canonical bytes.
+        assert via_proto["dump"] == expect, name
+    # every fixture pod must fit the packed schema — a silent fallback to
+    # Value entries would fake the parity result (and the memory win)
+    assert compacted == len(pods)
+
+
+def test_compact_refusal_falls_back_without_drift(built):
+    """An out-of-schema pod (unknown metadata key) is refused by the
+    strict-subset builder and kept as an exact Value — no field drops."""
+    text = json.dumps({"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": "x", "namespace": "ns",
+                                    "finalizers": ["keep"]},
+                       "spec": {"containers": []}})
+    got = native.compact_roundtrip(text)
+    assert got["compact"] is False
+    assert got["dump"] == _plain_dump(text)
+
+
+# ── satellite 1: page-body pinning fixed with --compact-store off ──────
+
+
+_PIN_SCRIPT = textwrap.dedent("""\
+    import ctypes, gc, json, sys, time
+    from tpu_pruner import native
+
+    url = sys.argv[1]
+
+    def rss_kb():
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+        raise RuntimeError("no VmRSS")
+
+    def trim():
+        gc.collect()
+        try:
+            ctypes.CDLL("libc.so.6").malloc_trim(0)
+        except Exception:
+            pass
+
+    native.load()
+    r = native._call("tp_informer_start",
+                     {"api_url": url, "resources": ["pods"],
+                      "wait_ms": 60000})
+    assert r["synced"], r
+    h = r["handle"]
+    trim()
+    print("SYNCED", rss_kb(), flush=True)
+    survivors = json.loads(sys.stdin.readline())
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        stats = native._call("tp_informer_stats", {"handle": h})
+        if stats["objects"] <= len(survivors):
+            break
+        time.sleep(0.2)
+    else:
+        raise RuntimeError("churn never observed: %r" % stats["objects"])
+    # survivors must still materialize from their (copied-out) slices
+    for path in survivors:
+        g = native._call("tp_informer_get", {"handle": h, "path": path})
+        assert g["found"], path
+        assert g["object"]["metadata"]["annotations"]["payload"]
+    trim()
+    print("DRAINED", rss_kb(), flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_page_pinning_released_with_compact_off(built, fake_k8s):
+    """The `upsert_proto` aliasing-slice fix: with compact store OFF and
+    protobuf LIST pages of ~8 MB, deleting all but 3 pods after the cold
+    sync releases the page memory — each surviving entry holds its own
+    copied-out slice (TPU_PRUNER_PAGE_RETAIN_BYTES), not a shared_ptr
+    aliasing the whole page. Pinned pages would keep ~3 × ~8 MB resident
+    no matter how small the survivors are — i.e. RSS would scale with
+    PAGE size, not with survivor size."""
+    fat = "x" * 16384
+    n = 1500  # 3 LIST pages at the informer's 500-pod page limit
+    for i in range(n):
+        pod = fake_k8s.add_pod(f"ns{i % 3}", f"pin-{i}", tpu_chips=4)
+        pod["metadata"]["annotations"] = {"payload": fat}
+    env = dict(os.environ)
+    env.update({"TPU_PRUNER_WIRE": "proto",
+                "TPU_PRUNER_COMPACT_STORE": "off"})
+    proc = subprocess.Popen([sys.executable, "-c", _PIN_SCRIPT, fake_k8s.url],
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env,
+                            cwd="/root/repo")
+    try:
+        line = proc.stdout.readline().split()
+        assert line and line[0] == "SYNCED", (line, proc.stderr.read()[-3000:])
+        rss_synced_kb = int(line[1])
+        # one survivor per page region; delete the rest (journals DELETED
+        # watch events — deletion, not MODIFIED, so no replacement bodies
+        # muddy the accounting)
+        survivors, doomed = [], []
+        for i in range(n):
+            path = f"/api/v1/namespaces/ns{i % 3}/pods/pin-{i}"
+            (survivors if i in (0, 600, 1200) else doomed).append(path)
+        for path in doomed:
+            del fake_k8s.objects[path]
+        proc.stdin.write(json.dumps(survivors) + "\n")
+        proc.stdin.flush()
+        out, err = proc.communicate(timeout=180)
+    finally:
+        proc.kill()
+    assert proc.returncode == 0, err[-3000:]
+    drained = [l for l in out.splitlines() if l.startswith("DRAINED")]
+    assert drained, out
+    rss_after_kb = int(drained[0].split()[1])
+    released_mb = (rss_synced_kb - rss_after_kb) / 1024.0
+    # The synced store holds ~24 MB of pod payloads (1500 × ~16.5 KB).
+    # With the fix, deleting 1497 of them frees their exclusive copies:
+    # RSS must DROP by well over half of that. With the aliasing bug the
+    # 3 survivors pin all 3 pages, so nothing comes back (released ≈ 0).
+    assert released_mb > 12, (
+        f"pages still pinned: synced RSS {rss_synced_kb} KB, after churn "
+        f"{rss_after_kb} KB (released {released_mb:.1f} MB)")
+
+
+# ── store observability ────────────────────────────────────────────────
+
+
+def test_store_metric_families_on_daemon_metrics(built, fake_prom, fake_k8s):
+    """A `--compact-store on` daemon serves all four store families on
+    /metrics, with a live cold_sync_seconds sample for the pods LIST."""
+    mixed_cluster(fake_prom, fake_k8s)
+    for i in range(6):  # the fixture is 9 pods; the floor below wants >= 10
+        fake_k8s.add_pod("bulk", f"filler-{i}", tpu_chips=4)
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--run-mode", "dry-run", "--daemon-mode", "--check-interval", "60",
+           "--watch-cache", "on", "--compact-store", "on",
+           "--metrics-port", "auto"]
+    proc = subprocess.Popen(cmd, env=daemon_env(fake_k8s),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        for line in proc.stderr:
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port, "daemon never reported its metrics port"
+        families = set(native.store_metric_families())
+        assert families == {"tpu_pruner_store_bytes", "tpu_pruner_store_pods",
+                            "tpu_pruner_store_interned_strings",
+                            "tpu_pruner_cold_sync_seconds"}
+        deadline = time.time() + 30
+        body = ""
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+                body = resp.read().decode()
+            if re.search(
+                    r'tpu_pruner_cold_sync_seconds\{[^}]*resource="pods"\} ',
+                    body):
+                break
+            time.sleep(0.2)
+        for fam in families:
+            assert f"# HELP {fam} " in body, fam
+            assert f"# TYPE {fam} gauge" in body, fam
+        # every sample line carries the daemon's cluster label
+        m = re.search(r'^tpu_pruner_store_pods\{[^}]*\} ([0-9.]+)$', body,
+                      re.M)
+        assert m and float(m.group(1)) >= 10, body[-2000:]
+        assert re.search(
+            r'tpu_pruner_cold_sync_seconds\{[^}]*resource="pods"\} [0-9.e+-]+',
+            body)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_compact_store_at_least_2x_smaller(built, fake_prom, fake_k8s):
+    """The tentpole's point, measured on the informer's own stats: the
+    SAME fixture synced compact-on retains less than half the bytes of
+    compact-off, and records a cold_sync_seconds sample."""
+    mixed_cluster(fake_prom, fake_k8s)
+    for i in range(6):  # the fixture is 9 pods; the floor below wants >= 10
+        fake_k8s.add_pod("bulk", f"filler-{i}", tpu_chips=4)
+
+    def pods_stats(store):
+        r = native._call("tp_informer_start",
+                         {"api_url": fake_k8s.url, "resources": ["pods"],
+                          "compact_store": store, "wait_ms": 30000})
+        assert r["synced"], r
+        stats = native._call("tp_informer_stats", {"handle": r["handle"]})
+        native._call("tp_informer_stop", {"handle": r["handle"]})
+        [(path, rs)] = stats["resources"].items()
+        assert path.endswith("/pods")
+        return rs
+
+    on = pods_stats("on")
+    off = pods_stats("off")
+    assert on["objects"] == off["objects"] >= 10
+    assert on["cold_sync_seconds"] >= 0
+    assert 0 < on["store_bytes"] * 2 <= off["store_bytes"], (
+        on["store_bytes"], off["store_bytes"])
+    proc_stats = native.store_stats()
+    assert proc_stats["interned_strings"] > 0
+    assert proc_stats["interned_bytes"] > 0

@@ -170,6 +170,44 @@ def test_wire_bench_fields_documented():
                 f"bench field {stem}{mode} missing from docs/BENCH_FIELDS.md")
 
 
+def test_store_surfaces_documented(built):
+    """The compact-store families come from the native canonical list
+    (compact::store_metric_families via capi) so a gauge added to
+    compact.cpp without a runbook row fails even though the families
+    render zeros with the store off. The flag, the memory-tuning knobs
+    and the sanitizer/smoke recipes ride the same guard."""
+    doc = OPERATIONS.read_text()
+    families = native.store_metric_families()
+    assert len(families) >= 4
+    missing = [f for f in families if f not in doc]
+    assert not missing, (
+        f"store metric families missing from docs/OPERATIONS.md: {missing} "
+        "— document each in the Observability table and the 'Memory "
+        "tuning' section")
+    needles = ("Memory tuning", "--compact-store", "TPU_PRUNER_COMPACT_STORE",
+               "TPU_PRUNER_DOC_ARENA_MB", "TPU_PRUNER_PAGE_RETAIN_BYTES",
+               "TPU_PRUNER_SYNC_WORKERS", "TPU_PRUNER_SYNC_PIPELINE",
+               "asan-store", "bench-planet-1m")
+    missing = [n for n in needles if n not in doc]
+    assert not missing, (
+        f"compact-store surfaces missing from docs/OPERATIONS.md: {missing}")
+
+
+def test_store_bench_fields_documented():
+    """Every compact-store rung bench field must be in BENCH_FIELDS.md
+    AND actually emitted by bench.py — drift on either side fails."""
+    bench_src = (REPO / "bench.py").read_text()
+    fields_doc = (REPO / "docs" / "BENCH_FIELDS.md").read_text()
+    for field in ("planet_store_pods", "store_bytes_per_pod",
+                  "store_rss_kb_per_pod", "store_rss_ratio_off_over_on",
+                  "store_cold_sync_s", "store_cold_sync_serial_s",
+                  "store_shard_curve_cores", "store_phase_envelopes",
+                  "store_fixture_encode"):
+        assert f'"{field}"' in bench_src, f"bench.py no longer emits {field}"
+        assert field in fields_doc, (
+            f"bench field {field} missing from docs/BENCH_FIELDS.md")
+
+
 def test_incremental_surfaces_documented(built):
     """The differential-reconcile families come from the native canonical
     list (incremental::metric_families) so a gauge added to

@@ -230,6 +230,47 @@ def test_tsan_wire_recipe_present_and_wired():
         "tsan-wire would vacuously pass")
 
 
+def test_asan_store_recipe_present_and_wired():
+    """`just asan-store` must exist and run the compact-store native
+    tests under AddressSanitizer — the intern table's offset-into-blob
+    packing and the PodRecord materialization path are exactly the code
+    whose out-of-bounds reads ASan catches and plain asserts don't."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^asan-store\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `asan-store:` recipe"
+    body = m.group(1)
+    assert "-DTP_SANITIZE=ON" in body, "asan-store no longer builds with ASan"
+    assert re.search(r"tpupruner_tests\s+compact", body), (
+        "asan-store no longer runs the native compact tests")
+    src = (REPO / "native" / "tests" / "test_compact.cpp").read_text()
+    assert "intern" in src and "record_from" in src, (
+        "test_compact.cpp lost its intern/record coverage — asan-store "
+        "would no longer exercise the packed store")
+
+
+def test_bench_planet_1m_recipe_present_and_wired():
+    """`just bench-planet-1m` must exist and invoke the compact-store
+    scale rung — the bytes-per-pod bar, the compact on/off RSS ratio and
+    the pipelined-vs-serial cold-sync bar would otherwise go unguarded
+    in CI. The 65,536-pod override keeps the smoke in CI minutes; the
+    assertions inside run_store_scale_rung are the same ones the full
+    1M-pod rung enforces."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^bench-planet-1m\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)",
+                  text, re.M)
+    assert m, "justfile has no `bench-planet-1m:` recipe"
+    body = m.group(1)
+    assert "bench.py --planet-1m-only" in body, (
+        "bench-planet-1m no longer invokes bench.py --planet-1m-only")
+    assert "TP_PLANET_STORE_PODS=65536" in body, (
+        "bench-planet-1m lost its 65,536-pod smoke override — the recipe "
+        "would run the full 1M-pod rung in CI")
+    bench = (REPO / "bench.py").read_text()
+    assert "--planet-1m-only" in bench and "run_store_scale_rung" in bench, (
+        "bench.py no longer implements the --planet-1m-only store rung")
+
+
 def test_fleet_mega_recipe_present_and_wired():
     """`just fleet-mega` must exist and run the 100-member delta
     federation smoke — parity-vs-snapshot (byte-identical merged views

@@ -152,6 +152,9 @@ def load() -> ctypes.CDLL:
         "tp_transport_metric_families",
         "tp_incremental_metric_families",
         "tp_wire_metric_families",
+        "tp_store_metric_families",
+        "tp_compact_roundtrip",
+        "tp_store_stats",
         "tp_wire_decode_k8s",
         "tp_wire_decode_prom",
         "tp_wire_bench_decode",
@@ -262,6 +265,42 @@ def wire_metric_families() -> list[str]:
     served on /metrics — the docs drift-guard test joins this list
     against docs/OPERATIONS.md."""
     return _call("tp_wire_metric_families", {})["families"]
+
+
+def store_metric_families() -> list[str]:
+    """Canonical compact-store (tpu_pruner_store_* / cold_sync) metric
+    family names served on /metrics — the docs drift-guard test joins
+    this list against docs/OPERATIONS.md."""
+    return _call("tp_store_metric_families", {})["families"]
+
+
+def compact_roundtrip(obj_json: str | None = None, *, proto_body: bytes | None = None,
+                      api_version: str = "v1", kind: str = "Pod") -> dict:
+    """Decode one object through the REAL compact PodRecord path
+    (native/src/compact.cpp) and return its materialized form.
+
+    Pass ``obj_json`` (object text → record_from_value; ``compact`` is
+    False when the strict-subset builder refused and kept the exact
+    Value) or ``proto_body`` (an ObjectMeta-bearing protobuf object body
+    → record_from_proto). ``dump`` must be byte-identical to the
+    non-compact decode of the same data — the parity corpus asserts it."""
+    if proto_body is not None:
+        import base64
+
+        return _call("tp_compact_roundtrip",
+                     {"body_b64": base64.b64encode(proto_body).decode(),
+                      "api_version": api_version, "kind": kind})
+    if obj_json is None:
+        raise ValueError("pass obj_json or proto_body")
+    return _call("tp_compact_roundtrip", {"json": obj_json})
+
+
+def store_stats() -> dict:
+    """Process-wide compact-store gauges (store_bytes/store_pods), intern
+    table size, and recycled Doc-arena counters (reuses/returns/drops/
+    pooled_bytes) — the bench's bytes-per-pod bar and the page-pinning
+    regression test read these."""
+    return _call("tp_store_stats", {})
 
 
 def wire_decode_k8s(body: bytes, shape: str = "list") -> dict:

@@ -340,6 +340,20 @@ class FakeK8s:
         # past their snapshot (expire_watches) — the real apiserver's
         # limit/continue contract, which the informer's initial LIST uses.
         self.paginate_lists = 0
+        # LIST encode cache (PR 14): per-(path, selector) scan results and
+        # per-pod JSON/protobuf encodings computed ONCE per snapshot rv
+        # instead of once per page request. A 1M-pod paginated cold LIST
+        # is thousands of page GETs; without this the fake rescans — and
+        # re-encodes — the whole store per page, and the FIXTURE, not the
+        # daemon, dominates the bench wall. Per-pod encodings only engage
+        # at >= ENCODE_CACHE_MIN items (big bench fixtures); small tests
+        # keep the uncached path so in-place object mutation (see module
+        # docstring caveat) stays visible. Stats ride the bench detail
+        # (list_encode_cache_stats) and are never asserted on.
+        self.ENCODE_CACHE_MIN = 512
+        self._list_cache: dict[tuple[str, str], dict] = {}
+        self.list_encode_stats = {"scans": 0, "scan_hits": 0,
+                                  "encodes": 0, "encode_seconds": 0.0}
         # targeted fault injection: (method or "*", exact path) → [code, n]
         # where n is the remaining failure count (-1 = fail forever)
         self.fail_rules: dict[tuple[str, str], list] = {}
@@ -745,22 +759,53 @@ class FakeK8s:
                                     "reason": "NotFound", "code": 404,
                                     "message": f"{self.path} not found"})
 
-            def _respond_collection(self, items, meta):
+            def _respond_raw(self, code, body, content_type):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _respond_collection(self, items, meta, cached=None, start=0):
                 """LIST response with content negotiation: protobuf when
                 the client asked for it and every item fits the encoder's
                 schema, JSON otherwise (the fallback a JSON-only
-                apiserver exercises)."""
+                apiserver exercises). ``cached``/``start`` identify this
+                page's slice of a snapshot-rv scan entry: big fixtures
+                serve pages assembled from per-pod encodings computed
+                once per snapshot (byte-identical to the direct encode)."""
                 accept = self.headers.get("Accept", "")
+                use_cache = (cached is not None
+                             and len(cached["items"]) >= fake.ENCODE_CACHE_MIN)
+                if use_cache and cached["pod_json"] is None:
+                    t0 = time.perf_counter()
+                    cached["pod_json"] = [json.dumps(o) for o in cached["items"]]
+                    if fake.serve_protobuf:
+                        cached["pod_pb"] = [wire_proto.encode_pod_chunk(o)
+                                            for o in cached["items"]]
+                    fake.list_encode_stats["encodes"] += 1
+                    fake.list_encode_stats["encode_seconds"] += (
+                        time.perf_counter() - t0)
                 if fake.serve_protobuf and wire_proto.K8S_PROTO in accept:
-                    pb = wire_proto.encode_pod_list(items, meta)
+                    if use_cache and cached["pod_pb"] is not None:
+                        pb = wire_proto.assemble_pod_list(
+                            cached["pod_pb"][start:start + len(items)], meta)
+                    else:
+                        pb = wire_proto.encode_pod_list(items, meta)
                     if pb is not None:
                         fake.proto_lists += 1
-                        self.send_response(200)
-                        self.send_header("Content-Type", wire_proto.K8S_PROTO)
-                        self.send_header("Content-Length", str(len(pb)))
-                        self.end_headers()
-                        self.wfile.write(pb)
+                        self._respond_raw(200, pb, wire_proto.K8S_PROTO)
                         return
+                if use_cache:
+                    # assembled to be byte-identical to json.dumps of the
+                    # full payload (default separators)
+                    body = ('{"kind": "List", "apiVersion": "v1", '
+                            '"metadata": ' + json.dumps(meta) + ', "items": ['
+                            + ", ".join(
+                                cached["pod_json"][start:start + len(items)])
+                            + ']}').encode()
+                    self._respond_raw(200, body, "application/json")
+                    return
                 self._respond(200, {"kind": "List", "apiVersion": "v1",
                                     "metadata": meta, "items": items})
 
@@ -838,15 +883,30 @@ class FakeK8s:
                     # collection LIST (optional labelSelector), incl. empty lists
                     if (rx := self._collection_object_re(path)) is not None:
                         selector = query.get("labelSelector", [""])[0]
-                        reqs = parse_label_selector(selector)
-                        items = [
-                            obj for p, obj in fake.objects.items()
-                            if rx.fullmatch(p)
-                            and all(
-                                obj["metadata"].get("labels", {}).get(k) in vals
-                                for k, vals in reqs
-                            )
-                        ]
+                        # snapshot-rv scan cache: page N+1 of the same
+                        # LIST reuses page N's scan instead of re-walking
+                        # the whole store (items are refs, so the
+                        # in-place-mutation caveat still holds)
+                        cache_key = (path, selector)
+                        cached = fake._list_cache.get(cache_key)
+                        if cached is not None and cached["rv"] == fake._rv:
+                            items = cached["items"]
+                            fake.list_encode_stats["scan_hits"] += 1
+                        else:
+                            reqs = parse_label_selector(selector)
+                            items = [
+                                obj for p, obj in fake.objects.items()
+                                if rx.fullmatch(p)
+                                and all(
+                                    obj["metadata"].get("labels", {}).get(k)
+                                    in vals
+                                    for k, vals in reqs
+                                )
+                            ]
+                            cached = {"rv": fake._rv, "items": items,
+                                      "pod_json": None, "pod_pb": None}
+                            fake._list_cache[cache_key] = cached
+                            fake.list_encode_stats["scans"] += 1
                         # a real LIST carries the store's resourceVersion —
                         # the version a subsequent watch resumes from
                         meta = {"resourceVersion": str(fake._rv)}
@@ -870,9 +930,11 @@ class FakeK8s:
                             if start + page < len(items):
                                 meta["continue"] = fake._encode_continue(
                                     start + page)
-                            self._respond_collection(chunk, meta)
+                            self._respond_collection(chunk, meta,
+                                                     cached=cached,
+                                                     start=start)
                             return
-                        self._respond_collection(items, meta)
+                        self._respond_collection(items, meta, cached=cached)
                         return
                     obj = fake.objects.get(path)
                 if obj is None:

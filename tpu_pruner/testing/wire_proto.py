@@ -287,6 +287,33 @@ def encode_pod_list(items: list, meta: dict) -> bytes | None:
         return None
 
 
+def encode_pod_chunk(item: dict) -> bytes | None:
+    """One LIST item's length-delimited chunk (PodList field 2), or None
+    when the item falls outside the schema. Page-INDEPENDENT — a
+    paginated fake encodes each pod once per snapshot rv and assembles
+    pages by concatenation (assemble_pod_list)."""
+    try:
+        if item.get("apiVersion") != "v1" or item.get("kind") != "Pod":
+            raise Unencodable("proto LIST items must be v1 Pods")
+        return _ld(2, encode_object_body(item))
+    except Unencodable:
+        return None
+
+
+def assemble_pod_list(chunks: list, meta: dict) -> bytes | None:
+    """Assemble a LIST response from encode_pod_chunk outputs —
+    byte-identical to encode_pod_list(items, meta) over the same items.
+    None when any chunk was unencodable (serve JSON instead)."""
+    if any(c is None for c in chunks):
+        return None
+    lm = bytearray()
+    if "resourceVersion" in meta:
+        lm += _str(2, meta["resourceVersion"])
+    if "continue" in meta:
+        lm += _str(3, meta["continue"])
+    return encode_unknown("v1", "PodList", bytes(_ld(1, bytes(lm))) + b"".join(chunks))
+
+
 def encode_watch_frame(event_type: str, obj: dict) -> bytes | None:
     """One length-prefixed watch frame (4-byte big-endian length + the
     Unknown-wrapped meta/v1 WatchEvent, k8s's LengthDelimitedFramer), or
