@@ -3252,7 +3252,179 @@ def main():
     print(line)
 
 
+
+# ── long-soak drift tier (chaos PR): RSS/CPU flat-slope under background
+#    faults ────────────────────────────────────────────────────────────────
+
+def _child_rss_kb(pid: int):
+    """VmRSS of `pid` in kB (None once the process is gone)."""
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return None
+
+
+def run_soak_tier():
+    """`bench.py --soak-only`: TP_SOAK_CYCLES (default 10,000) warm
+    back-to-back cycles of the REAL daemon against the hermetic fakes,
+    with seeded background chaos (429s, 5xx, truncated bodies, stale
+    evidence) injected every sampling window — then assert the drift bar:
+    steady-state RSS slope under TP_SOAK_RSS_SLOPE_KB (default 512) kB
+    per 1k cycles past the warmup windows. A leak in any per-cycle path
+    (audit ring, flight ring, retry telemetry, decision cache, fault
+    recovery) shows up as a positive slope long before it would OOM a
+    pod; per-window CPU confirms no algorithmic decay either. The daemon
+    must ALSO exit 0: the background chaos is bounded well under the
+    consecutive-failure budget, so a budget exhaustion is a recovery
+    regression, not bad luck."""
+    import random
+    import re as _re
+    import subprocess
+    import tempfile
+    import threading
+
+    from tpu_pruner.testing import FakeK8s, FakePrometheus
+    from tpu_pruner.testing import chaos as chaos_mod
+
+    cycles = int(os.environ.get("TP_SOAK_CYCLES", "10000"))
+    window = max(100, cycles // 10)
+    rss_bar = float(os.environ.get("TP_SOAK_RSS_SLOPE_KB", "512"))
+    seed = int(os.environ.get("TP_SOAK_SEED", "1107"))
+
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    tmp = Path(tempfile.mkdtemp(prefix="tp-soak-"))
+    proc = None
+    try:
+        _, _, pods = k8s.add_deployment_chain("ml", "trainer", num_pods=2,
+                                              tpu_chips=4)
+        for pod in pods:
+            prom.add_idle_pod_series(pod["metadata"]["name"], "ml", chips=4)
+
+        cmd = [str(native.DAEMON_PATH), "--prometheus-url", prom.url,
+               "--run-mode", "scale-down", "--daemon-mode",
+               "--check-interval", "0", "--max-cycles", str(cycles),
+               "--metrics-port", "auto",
+               "--ledger-file", str(tmp / "ledger.jsonl"),
+               "--flight-dir", str(tmp / "flight")]
+        env = {"KUBE_API_URL": k8s.url, "KUBE_TOKEN": "soak",
+               "PROMETHEUS_TOKEN": "soak", "PATH": "/usr/bin:/bin"}
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.PIPE, text=True)
+        for line in proc.stderr:
+            if _re.search(r"serving /metrics on port (\d+)", line):
+                break
+        stderr_tail: list = []
+
+        def _drain():
+            for line in proc.stderr:
+                stderr_tail.append(line)
+                del stderr_tail[:-50]
+        threading.Thread(target=_drain, daemon=True).start()
+
+        # Seeded background chaos: one small burst armed at every window
+        # boundary. Times are bounded (the retry layer absorbs most of
+        # them) so the failure budget never trips on a correct daemon.
+        rng = random.Random(seed)
+        sched = chaos_mod.build_schedule(seed, rounds=max(4, cycles // window),
+                                         faults_per_round=2)
+        windows: list = []
+        next_mark = window
+        burst_idx = 0
+        log(f"soak: {cycles} cycles, window {window}, rss bar "
+            f"{rss_bar} kB/1k cycles, seed {seed}")
+        deadline = time.monotonic() + 560
+        while proc.poll() is None and time.monotonic() < deadline:
+            done = prom.instant_queries_served  # 1 instant query per cycle
+            if done >= next_mark:
+                rss = _child_rss_kb(proc.pid)
+                cpu = _proc_cpu_ms(proc.pid)
+                if rss is not None and cpu is not None:
+                    windows.append({"cycles": done, "rss_kb": rss,
+                                    "cpu_ms": cpu,
+                                    "wall_s": round(time.monotonic(), 3)})
+                if burst_idx < len(sched.rounds):
+                    k8s.inject(sched.entries_for(burst_idx, "k8s"))
+                    prom.inject(sched.entries_for(burst_idx, "prom"))
+                    burst_idx += 1
+                next_mark += window
+            time.sleep(0.02)
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+            raise RuntimeError(
+                f"soak daemon still running past the deadline at "
+                f"~{prom.instant_queries_served} cycles")
+        if proc.returncode != 0:
+            raise RuntimeError(
+                "soak daemon exited "
+                f"{proc.returncode} (failure budget blown under background "
+                "chaos?):\n" + "".join(stderr_tail)[-2000:])
+
+        fired = len(k8s.faults_fired) + len(prom.faults_fired)
+        if burst_idx and not fired:
+            raise RuntimeError("background chaos never fired — the soak "
+                               "measured a calm sea, not a storm")
+
+        # Drift: skip the warmup windows (allocator arenas, interning,
+        # flight-ring fill are one-time costs), then fit the steady tail.
+        out = {"cycles": cycles, "window": window, "seed": seed,
+               "faults_fired": fired, "windows": windows}
+        steady = windows[2:]
+        if len(steady) >= 2:
+            dc = steady[-1]["cycles"] - steady[0]["cycles"]
+            drss = steady[-1]["rss_kb"] - steady[0]["rss_kb"]
+            slope = drss / (dc / 1000.0) if dc else 0.0
+            dcpu = steady[-1]["cpu_ms"] - steady[0]["cpu_ms"]
+            out["rss_slope_kb_per_kcycle"] = round(slope, 1)
+            out["cpu_ms_per_cycle_steady"] = round(dcpu / dc, 3) if dc else None
+            first = windows[0]
+            dcycles0 = windows[1]["cycles"] - first["cycles"]
+            if dcycles0:
+                out["cpu_ms_per_cycle_warmup"] = round(
+                    (windows[1]["cpu_ms"] - first["cpu_ms"]) / dcycles0, 3)
+            log(f"soak: steady RSS slope {slope:.1f} kB/1k cycles over "
+                f"{dc} cycles ({fired} faults fired)")
+            if slope > rss_bar:
+                raise RuntimeError(
+                    f"RSS drift {slope:.1f} kB/1k cycles exceeds the "
+                    f"{rss_bar} kB flat-slope bar "
+                    f"(windows: {[w['rss_kb'] for w in windows]})")
+            out["pass"] = True
+        else:
+            # too few windows to fit a slope (tiny TP_SOAK_CYCLES): report
+            # the raw samples; the smoke still proves crash-free chaos
+            out["pass"] = True
+            out["note"] = "fewer than 4 windows; slope not fitted"
+        return out
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        prom.stop()
+        k8s.stop()
+
+
 if __name__ == "__main__":
+    if "--soak-only" in sys.argv:
+        # Standalone long-soak drift tier (the `just soak-smoke` recipe
+        # runs this at TP_SOAK_CYCLES=500): warm-cycle RSS/CPU drift
+        # windows under seeded background chaos, with the flat-slope bar
+        # asserted inside — a miss exits non-zero with the reason on
+        # stderr.
+        native.ensure_built()
+        try:
+            out = run_soak_tier()
+        except Exception as e:  # noqa: BLE001 — the smoke's failure signal
+            log(f"soak tier FAILED: {e}")
+            sys.exit(1)
+        print(json.dumps(out, indent=1))
+        sys.exit(0)
     if "--planet-only" in sys.argv:
         # Standalone planet tier (the `just fleet-mega` smoke runs this at
         # TP_PLANET_MEMBERS=100 TP_PLANET_PODS=0): the 10x quiesced

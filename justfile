@@ -214,3 +214,30 @@ bench-tpu:
 # (f32 and int8+cumsum) on an actual TPU chip
 test-policy-tpu:
     TP_POLICY_TPU=1 python -m pytest tests/test_policy_tpu.py -q
+
+# chaos smoke: three seeded fault scenarios against the real daemon
+# (multi-fault storm byte-identical to an undisturbed control, 2x
+# SIGKILL ledger accounting, stale-evidence veto + recovery under
+# --signal-guard on) — non-zero exit on any invariant miss, <60 s.
+# tests/test_justfile_guard.py pins the recipe to the module it invokes.
+chaos-smoke:
+    python -m tpu_pruner.testing.chaos_smoke
+
+# long-soak drift smoke: 500 warm back-to-back daemon cycles under
+# seeded background chaos, per-window RSS/CPU sampled and the flat-slope
+# bar asserted inside run_soak_tier. 500 cycles sit inside allocator
+# warmup, so the smoke loosens the RSS bar to 2 MB/1k cycles; the
+# flagship run is the default TP_SOAK_CYCLES=10000 at the tight 512 kB
+# bar. tests/test_justfile_guard.py pins the recipe to bench.py
+# --soak-only.
+soak-smoke:
+    TP_SOAK_CYCLES=500 TP_SOAK_RSS_SLOPE_KB=2048 python bench.py --soak-only
+
+# chaos race tier: the seeded backoff policy's shared retry telemetry
+# (concurrent recorders vs the metrics renderer) and the per-cycle
+# deadline watchdog (producer arms/disarms vs phase-boundary probes)
+# under ThreadSanitizer (substring filter of the native test binary)
+tsan-chaos:
+    cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
+    ./build-tsan/tpupruner_tests backoff
+    ./build-tsan/tpupruner_tests watchdog

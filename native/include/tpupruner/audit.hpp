@@ -60,6 +60,10 @@ enum class Reason : uint8_t {
   RightSized,           // RIGHT_SIZED: partial scale-down patch landed (R → N replicas)
   RightSizeHeld,        // RIGHT_SIZE_HELD: projected duty cycle stays over the
                         // threshold at every lower replica count — no action
+  // Cycle watchdog (--cycle-deadline, watchdog.hpp): the CYCLE was
+  // abandoned at a phase boundary, not a judgment on the workload.
+  CycleTimeout,         // CYCLE_TIMEOUT: cycle blew past --cycle-deadline;
+                        // pending records landed unactuated
 };
 
 const char* reason_name(Reason r);

@@ -1,0 +1,85 @@
+// Unified seeded retry/backoff policy (PR 15 chaos tier).
+//
+// Before this module the daemon had three ad-hoc retry mechanisms with
+// three independently-tuned jitter formulas: the k8s 429 loop
+// (k8s.cpp issue()), the stale keep-alive retry (http.cpp), and the
+// informer relist/watch backoff (informer.cpp backoff_sleep). All three
+// now route through one Policy so the chaos harness can (a) reason about
+// worst-case stall time with a single cap, and (b) reseed the jitter for
+// deterministic fault-schedule replay via TPU_PRUNER_BACKOFF_SEED.
+//
+// Every retry — wherever it happens — is counted into one labeled
+// family, tpu_pruner_retries_total{endpoint,cause}, and every backoff
+// wait lands in the tpu_pruner_backoff_seconds histogram, both rendered
+// by render_metrics() onto /metrics (drift-guarded against
+// docs/OPERATIONS.md through metric_families()).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace tpupruner::backoff {
+
+// Deterministic jittered delay computation. All jitter is a pure
+// function of (seed, key) — no RNG state — so a retry storm replays
+// byte-identically under the same seed, which is what lets the chaos
+// tier compare a faulted run against an undisturbed control run.
+struct Policy {
+  int64_t cap_ms = 10000;   // worst case per attempt (matches the
+                            // documented 10 s bound of the old 429 loop)
+  int64_t jitter_ms = 500;  // deterministic per-key spread, breaks
+                            // lockstep wake across workers/reflectors
+  uint64_t seed = 0;        // 0 = legacy hash (bit-identical to the
+                            // pre-unification formulas)
+
+  // Per-key jitter in [0, jitter_ms).
+  int64_t jitter(const std::string& key) const;
+
+  // Exponential schedule: min(500ms << min(attempt,5), cap_ms) plus
+  // jitter over (key, attempt) — the informer relist/watch formula.
+  int64_t exp_delay_ms(const std::string& key, int attempt) const;
+
+  // Server-hinted schedule (Retry-After): the hint is capped at
+  // cap_ms - jitter_ms BEFORE the jitter is added, never after —
+  // capping the sum would collapse every long Retry-After to an
+  // identical cap_ms, recreating exactly the lockstep wake the jitter
+  // exists to break. The k8s 429 formula.
+  int64_t hinted_delay_ms(const std::string& key, int64_t hint_ms) const;
+};
+
+// Process-wide policy. Seeded once from TPU_PRUNER_BACKOFF_SEED (decimal
+// uint64; absent/invalid = 0 = legacy behavior).
+const Policy& policy();
+
+// Parse an RFC 7231 Retry-After header into a wait hint in ms:
+// delta-seconds clamped to [1, 10] BEFORE the *1000 multiply (a hostile
+// proxy can send a delta that fits int64 but overflows once scaled),
+// or the HTTP-date form relative to now. Unparseable → 1000 ms.
+int64_t parse_retry_after_ms(const std::string& header);
+
+// Chunked, interruptible wait (the daemon's 100 ms sleep convention):
+// polls util::shutdown_flag() and, when given, *stop every chunk.
+// Returns false when interrupted before the full wait elapsed.
+bool sleep_interruptible(int64_t wait_ms, const std::atomic<bool>* stop = nullptr);
+
+// Account one retry: bumps tpu_pruner_retries_total{endpoint,cause} and
+// observes the backoff wait (seconds; 0.0 for immediate retries like the
+// stale keep-alive replay) into tpu_pruner_backoff_seconds.
+void record_retry(const std::string& endpoint, const std::string& cause,
+                  double backoff_seconds);
+
+// Canonical native family list served by render_metrics, exported
+// through the C API so tests/test_docs_drift.py can hold
+// docs/OPERATIONS.md to the real set.
+const std::vector<std::string>& metric_families();
+
+// Prometheus text exposition for the retry/backoff families; appended to
+// /metrics by the daemon's extra-metrics provider.
+std::string render_metrics(bool openmetrics);
+
+// Test hook: zero the counters/histogram (native units only).
+void reset_for_test();
+
+}  // namespace tpupruner::backoff

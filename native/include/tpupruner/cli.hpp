@@ -68,6 +68,10 @@ struct Cli {
   // keeps the watch-free GET/LIST client — the parity mode.
   std::string watch_cache = "off";
   int64_t max_cycles = 0;                 // --max-cycles (daemon mode; 0 = unlimited)
+  // --cycle-deadline: abort a cycle wedged past N x max(check-interval,
+  // 1 s) at the next phase boundary with audit reason CYCLE_TIMEOUT
+  // (watchdog.hpp). 0 = off (the default; opt-in hardening).
+  int64_t cycle_deadline = 0;
   int64_t resolve_concurrency = 10;       // --resolve-concurrency (ref: fixed 10)
   int64_t resolve_batch_threshold = 8;    // --resolve-batch-threshold (0 = off)
   int64_t scale_concurrency = 8;          // --scale-concurrency (ref: serial consumer)

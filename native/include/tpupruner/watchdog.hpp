@@ -1,0 +1,52 @@
+// Per-cycle deadline watchdog (--cycle-deadline, PR 15 chaos tier).
+//
+// A wedged phase — an apiserver that accepts connections but never
+// finishes a LIST page, a Prometheus that trickles bytes forever —
+// previously stalled the producer loop until the transport timeout
+// fired, and a pathological sequence of slow-but-not-dead calls could
+// stretch one cycle far past the check interval with no audit trail.
+// The watchdog bounds a whole cycle: armed at cycle start with deadline
+// N x check-interval, checked at every phase boundary (the
+// observe_phase choke points in daemon.cpp), and when breached the
+// cycle is abandoned by throwing CycleTimeout BEFORE the next phase's
+// side effects — pending audit rows land with reason CYCLE_TIMEOUT,
+// tpu_pruner_cycle_timeouts_total ticks, and the incremental engine is
+// reset so the next cycle recomputes from a globally-dirty state.
+//
+// Checks happen only at phase boundaries, never mid-I/O: each network
+// call is already bounded by its own transport timeout, so a boundary
+// check is reached within one transport timeout of the breach — the
+// watchdog turns "slow forever" into "bounded, audited abort" without
+// the races of cross-thread I/O cancellation.
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+
+namespace tpupruner::watchdog {
+
+// Thrown from check() at a phase boundary once the armed deadline has
+// passed. Caught specifically by the daemon run loop (before its
+// generic failure handler) to do the CYCLE_TIMEOUT bookkeeping.
+struct CycleTimeout : std::runtime_error {
+  explicit CycleTimeout(const std::string& what) : std::runtime_error(what) {}
+};
+
+// Set the per-cycle deadline; 0 disables (the default — the flag is
+// opt-in). Thread-safe, callable at any time.
+void configure(int64_t deadline_ms);
+int64_t deadline_ms();
+
+// Arm/disarm around one producer cycle. Disarmed, check() never throws.
+void arm();
+void disarm();
+
+// True when armed, enabled, and the deadline has elapsed.
+bool expired();
+
+// Phase-boundary probe: throws CycleTimeout naming the phase when
+// expired(). No-op otherwise.
+void check(const char* phase);
+
+}  // namespace tpupruner::watchdog

@@ -1,0 +1,186 @@
+#include "tpupruner/backoff.hpp"
+
+#include <algorithm>
+#include <chrono>
+#include <cmath>
+#include <ctime>
+#include <functional>
+#include <iomanip>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <thread>
+
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::backoff {
+
+namespace {
+
+// splitmix64 finalizer: mixes the seed into the key hash so two seeds
+// produce decorrelated jitter sequences while staying a pure function.
+uint64_t mix(uint64_t h, uint64_t seed) {
+  uint64_t z = h + seed * 0x9e3779b97f4a7c15ULL;
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+struct Telemetry {
+  std::mutex mu;
+  // (endpoint, cause) → retry count. A flat map: the label space is
+  // tiny and bounded by call sites, not by input.
+  std::map<std::pair<std::string, std::string>, uint64_t> retries;
+  // Fixed-bucket histogram of backoff waits, seconds.
+  static constexpr double kBuckets[] = {0.05, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0};
+  uint64_t bucket_counts[7] = {0, 0, 0, 0, 0, 0, 0};
+  uint64_t count = 0;
+  double sum = 0.0;
+};
+
+Telemetry& telemetry() {
+  static Telemetry t;
+  return t;
+}
+
+// Render a double the way Prometheus clients do: shortest round-trip
+// form, no trailing noise for whole numbers.
+std::string fmt(double v) {
+  if (v == static_cast<int64_t>(v)) return std::to_string(static_cast<int64_t>(v));
+  std::ostringstream ss;
+  ss << v;
+  return ss.str();
+}
+
+}  // namespace
+
+int64_t Policy::jitter(const std::string& key) const {
+  if (jitter_ms <= 0) return 0;
+  uint64_t h = std::hash<std::string>{}(key);
+  // seed == 0 preserves the legacy formula bit-for-bit: the informer
+  // and 429 jitters were plain hash(key) % 500 before unification, and
+  // existing tests (and byte-identity replay baselines) depend on it.
+  if (seed != 0) h = mix(h, seed);
+  return static_cast<int64_t>(h % static_cast<uint64_t>(jitter_ms));
+}
+
+int64_t Policy::exp_delay_ms(const std::string& key, int attempt) const {
+  int64_t base = std::min<int64_t>(500LL << std::min(attempt, 5), cap_ms);
+  return base + jitter(key + std::to_string(attempt));
+}
+
+int64_t Policy::hinted_delay_ms(const std::string& key, int64_t hint_ms) const {
+  return std::min<int64_t>(hint_ms, cap_ms - jitter_ms) + jitter(key);
+}
+
+const Policy& policy() {
+  static Policy p = [] {
+    Policy out;
+    if (auto s = util::env("TPU_PRUNER_BACKOFF_SEED")) {
+      try {
+        out.seed = static_cast<uint64_t>(std::stoull(*s));
+      } catch (const std::exception&) {
+        // invalid seed → legacy behavior; the chaos harness always sets
+        // a well-formed decimal, operators normally leave it unset
+      }
+    }
+    return out;
+  }();
+  return p;
+}
+
+int64_t parse_retry_after_ms(const std::string& header) {
+  try {
+    // cap the seconds BEFORE the multiply: a hostile/broken proxy can
+    // send a delta that fits int64 but overflows once *1000 (UB, and
+    // the negative product would skip the wait entirely)
+    return std::clamp<int64_t>(std::stoll(header), 1, 10) * 1000;
+  } catch (const std::exception&) {
+    // RFC 7231 also allows the HTTP-date form ("Wed, 21 Oct 2015
+    // 07:28:00 GMT"); apiservers send delta-seconds, but an
+    // intermediary proxy may rewrite it.
+    std::tm tm{};
+    std::istringstream ss(header);
+    ss >> std::get_time(&tm, "%a, %d %b %Y %H:%M:%S");
+    if (!ss.fail()) {
+      std::time_t when = timegm(&tm);
+      std::time_t now = std::time(nullptr);
+      if (when > now) return static_cast<int64_t>(when - now) * 1000;
+    }
+  }
+  return 1000;
+}
+
+bool sleep_interruptible(int64_t wait_ms, const std::atomic<bool>* stop) {
+  for (int64_t waited = 0; waited < wait_ms; waited += 100) {
+    if (util::shutdown_flag().load()) return false;
+    if (stop && stop->load()) return false;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+  return !util::shutdown_flag().load() && !(stop && stop->load());
+}
+
+void record_retry(const std::string& endpoint, const std::string& cause,
+                  double backoff_seconds) {
+  Telemetry& t = telemetry();
+  std::lock_guard<std::mutex> lock(t.mu);
+  ++t.retries[{endpoint, cause}];
+  ++t.count;
+  t.sum += backoff_seconds;
+  for (size_t i = 0; i < 7; ++i) {
+    if (backoff_seconds <= Telemetry::kBuckets[i]) ++t.bucket_counts[i];
+  }
+}
+
+const std::vector<std::string>& metric_families() {
+  static const std::vector<std::string> families = {
+      "tpu_pruner_retries_total",
+      "tpu_pruner_backoff_seconds",
+  };
+  return families;
+}
+
+std::string render_metrics(bool openmetrics) {
+  Telemetry& t = telemetry();
+  std::lock_guard<std::mutex> lock(t.mu);
+  std::string out;
+  out += "# HELP tpu_pruner_retries_total Requests retried through the unified "
+         "backoff policy, by endpoint and cause\n";
+  // OpenMetrics reserves the `counter` type for suffix-transformed
+  // names; keep the 0.0.4-compatible rendering the other families use.
+  out += "# TYPE tpu_pruner_retries_total " +
+         std::string(openmetrics ? "unknown" : "counter") + "\n";
+  if (t.retries.empty()) {
+    out += "tpu_pruner_retries_total 0\n";
+  } else {
+    for (const auto& [key, n] : t.retries) {
+      out += "tpu_pruner_retries_total{endpoint=\"" + key.first + "\",cause=\"" +
+             key.second + "\"} " + std::to_string(n) + "\n";
+    }
+  }
+  out += "# HELP tpu_pruner_backoff_seconds Backoff wait before each retry, "
+         "seconds\n";
+  out += "# TYPE tpu_pruner_backoff_seconds histogram\n";
+  uint64_t cumulative = 0;
+  for (size_t i = 0; i < 7; ++i) {
+    cumulative = t.bucket_counts[i];
+    out += "tpu_pruner_backoff_seconds_bucket{le=\"" + fmt(Telemetry::kBuckets[i]) +
+           "\"} " + std::to_string(cumulative) + "\n";
+  }
+  out += "tpu_pruner_backoff_seconds_bucket{le=\"+Inf\"} " + std::to_string(t.count) +
+         "\n";
+  out += "tpu_pruner_backoff_seconds_sum " + fmt(t.sum) + "\n";
+  out += "tpu_pruner_backoff_seconds_count " + std::to_string(t.count) + "\n";
+  return out;
+}
+
+void reset_for_test() {
+  Telemetry& t = telemetry();
+  std::lock_guard<std::mutex> lock(t.mu);
+  t.retries.clear();
+  for (auto& b : t.bucket_counts) b = 0;
+  t.count = 0;
+  t.sum = 0.0;
+}
+
+}  // namespace tpupruner::backoff

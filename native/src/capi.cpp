@@ -20,6 +20,7 @@
 #include "tpupruner/delta.hpp"
 #include "tpupruner/fleet.hpp"
 #include "tpupruner/gym.hpp"
+#include "tpupruner/backoff.hpp"
 #include "tpupruner/h2.hpp"
 #include "tpupruner/incremental.hpp"
 #include "tpupruner/recorder.hpp"
@@ -577,6 +578,20 @@ char* tp_transport_metric_families(const char*) {
   return guarded([&] {
     Value families = Value::array();
     for (const std::string& f : tpupruner::h2::transport_metric_families()) {
+      families.push_back(Value(f));
+    }
+    Value out = Value::object();
+    out.set("families", std::move(families));
+    return ok(out);
+  });
+}
+
+char* tp_backoff_metric_families(const char*) {
+  // The canonical unified retry/backoff metric family names — the
+  // docs-drift test joins this against docs/OPERATIONS.md.
+  return guarded([&] {
+    Value families = Value::array();
+    for (const std::string& f : tpupruner::backoff::metric_families()) {
       families.push_back(Value(f));
     }
     Value out = Value::object();

@@ -187,6 +187,10 @@ TPU FLAGS:
                                 RBAC: needs the `watch` verb (clusterrole.yaml)
       --max-cycles <N>          daemon mode: exit cleanly after N evaluation
                                 cycles (bench/test harness; 0 = unlimited)
+      --cycle-deadline <N>      abort a cycle stuck past N x check-interval
+                                (min 1 s) at the next phase boundary: pending
+                                audit rows land as CYCLE_TIMEOUT, the next
+                                cycle recomputes from scratch (0 = off)
       --metrics-port <P>        serve Prometheus /metrics (+ /healthz, /readyz,
                                 and the /debug surfaces — /debug lists them)
                                 on this port (0 = disabled, "auto" = ephemeral)
@@ -405,6 +409,11 @@ Cli parse(int argc, char** argv) {
        [&](const std::string& v) {
          cli.max_cycles = parse_int("--max-cycles", v);
          if (cli.max_cycles < 0) throw CliError("--max-cycles must be >= 0");
+       }},
+      {"--cycle-deadline",
+       [&](const std::string& v) {
+         cli.cycle_deadline = parse_int("--cycle-deadline", v);
+         if (cli.cycle_deadline < 0) throw CliError("--cycle-deadline must be >= 0");
        }},
       {"--metrics-port",
        [&](const std::string& v) {

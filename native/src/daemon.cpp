@@ -21,6 +21,7 @@
 #include "tpupruner/actuate.hpp"
 #include "tpupruner/audit.hpp"
 #include "tpupruner/auth.hpp"
+#include "tpupruner/backoff.hpp"
 #include "tpupruner/compact.hpp"
 #include "tpupruner/delta.hpp"
 #include "tpupruner/fleet.hpp"
@@ -37,6 +38,7 @@
 #include "tpupruner/signal.hpp"
 #include "tpupruner/util.hpp"
 #include "tpupruner/walker.hpp"
+#include "tpupruner/watchdog.hpp"
 
 namespace tpupruner::daemon {
 
@@ -1029,6 +1031,10 @@ Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
   const std::string& trace_id = p.trace_id;
   auto observe_phase = [&](const char* phase, std::chrono::steady_clock::time_point since) {
     log::histogram_observe("cycle_phase_seconds", phase, secs_since(since), trace_id);
+    // Watchdog probe: a breached --cycle-deadline aborts the cycle HERE,
+    // at the phase boundary, before the next phase's side effects.
+    // "total" is the cycle's own epilogue — nothing left to abort.
+    if (std::string_view(phase) != "total") watchdog::check(phase);
   };
   with_span(cycle, [&] {
   auto phase_start = std::chrono::steady_clock::now();
@@ -1218,6 +1224,10 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
   const bool signal_on = p.signal_on;
   auto observe_phase = [&](const char* phase, std::chrono::steady_clock::time_point since) {
     log::histogram_observe("cycle_phase_seconds", phase, secs_since(since), trace_id);
+    // Watchdog probe: a breached --cycle-deadline aborts the cycle HERE,
+    // at the phase boundary, before the next phase's side effects.
+    // "total" is the cycle's own epilogue — nothing left to abort.
+    if (std::string_view(phase) != "total") watchdog::check(phase);
   };
   return with_span(cycle, [&] {
   auto phase_start = std::chrono::steady_clock::now();
@@ -1901,7 +1911,8 @@ int run(const cli::Cli& args) {
              h2::render_transport_metrics(openmetrics) +
              incremental::render_metrics(openmetrics) +
              proto::render_wire_metrics(openmetrics) +
-             compact::render_store_metrics(openmetrics);
+             compact::render_store_metrics(openmetrics) +
+             backoff::render_metrics(openmetrics);
     });
     // Evidence-health snapshot at /debug/signals (`analyze
     // --signal-report` hits this); {"enabled": false} with the guard off.
@@ -1957,6 +1968,11 @@ int run(const cli::Cli& args) {
       return util::mono_secs() - last_progress->load() <= stale_after;
     });
   }
+  // Every provider is wired — only now does the server answer requests
+  // (and print the port line clients wait for). Starting earlier opens a
+  // window where /debug/delta 404s and a polling hub permanently demotes
+  // this member to snapshot mode.
+  if (metrics_server) metrics_server->start();
   // Optional OTLP/HTTP push (reference `otel` feature; OTEL_* env config).
   // Activation, per-signal URLs, and interval all resolve inside the
   // factory — one point of truth for the env shape.
@@ -2176,6 +2192,14 @@ int run(const cli::Cli& args) {
   // interval the prefetched evidence is up to one interval old by the
   // time its cycle finishes.
   const bool overlap_on = args.overlap == "on" && args.daemon_mode;
+  // Cycle watchdog (--cycle-deadline, opt-in): deadline is N x the check
+  // interval, floored at 1 s so --check-interval 0 (back-to-back test
+  // mode) still gets a non-degenerate bound. Phase boundaries probe it
+  // via watchdog::check in the observe_phase choke points.
+  if (args.cycle_deadline > 0) {
+    watchdog::configure(args.cycle_deadline * std::max<int64_t>(args.check_interval, 1) *
+                        1000);
+  }
   std::future<Prepared> prepared_next;
   auto drop_prepared = [&] {
     if (!prepared_next.valid()) return;
@@ -2256,6 +2280,7 @@ int run(const cli::Cli& args) {
       auto enqueue = [&](ScaleTarget t, ScalePlan plan, uint64_t cycle) {
         queue.push({std::move(t), cycle, std::move(plan)});
       };
+      watchdog::arm();
       CycleStats stats;
       if (overlap_on) {
         Prepared prep = prepared_next.valid()
@@ -2270,6 +2295,7 @@ int run(const cli::Cli& args) {
         stats = finish_cycle(args, prepare_cycle(args, query, evidence_query, &prom_client),
                              kube, enabled, enqueue, watch_cache.get());
       }
+      watchdog::disarm();
       // Delta-federation journal: snapshot the debug surfaces into the
       // change journal at cycle end — free until a hub's first
       // /debug/delta poll activates it, O(changed rows) after.
@@ -2282,7 +2308,30 @@ int run(const cli::Cli& args) {
       log::info("daemon", "Query succeeded: " + std::to_string(stats.num_pods) + " candidates, " +
                 std::to_string(stats.shutdown_events) + " shutdown events, " +
                 std::to_string(stats.api_calls) + " resolution K8s API calls");
+    } catch (const watchdog::CycleTimeout& e) {
+      // The cycle blew past --cycle-deadline and was abandoned at a
+      // phase boundary (before that phase's side effects). Land every
+      // pending audit row with the terminal CYCLE_TIMEOUT code — the
+      // cycle made no judgment on those workloads — and reset the
+      // incremental engine so the next cycle starts globally dirty: a
+      // half-committed dirty-set from an aborted cycle must never feed
+      // decision reuse. Counts against the failure budget like any
+      // other failed cycle.
+      watchdog::disarm();
+      int prev = consecutive_failures++;
+      last_cycle_failed = true;
+      log::counter_add("cycle_timeouts_total", 1);
+      log::counter_add("query_failures", 1);
+      audit::finalize_all_pending(audit::Reason::CycleTimeout);
+      if (args.incremental == "on") incremental::engine().reset();
+      log::error("daemon", std::string("Cycle aborted by watchdog: ") + e.what());
+      if (prev > kMaxConsecutiveFailures) {
+        log::error("daemon", "Too many consecutive failures, exiting");
+        budget_exhausted = true;
+        break;
+      }
     } catch (const std::exception& e) {
+      watchdog::disarm();
       int prev = consecutive_failures++;
       last_cycle_failed = true;
       log::counter_add("query_failures", 1);

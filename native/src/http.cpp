@@ -12,6 +12,7 @@
 #include <stdexcept>
 
 #include "tls.hpp"
+#include "tpupruner/backoff.hpp"
 #include "tpupruner/h2.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/util.hpp"
@@ -521,6 +522,10 @@ Response Client::request(const Request& req) const {
     // methods; surfacing it as a cycle error would turn routine server
     // idle-timeouts into failure-budget ticks.
     h2::counters().retries.fetch_add(1, std::memory_order_relaxed);
+    // Immediate replay (no wait): still accounted through the unified
+    // backoff telemetry so tpu_pruner_retries_total covers every retry
+    // in the process, not just the delayed ones.
+    backoff::record_retry("transport", "stale_conn", 0.0);
     log::debug("http", "retrying " + req.method + " " + url->host + ":" +
                            std::to_string(url->port) + url->target +
                            " on a fresh connection (stale keep-alive socket: " + e.what() + ")");

@@ -431,10 +431,9 @@ int run(int argc, char** argv) {
   });
 
   metrics_http::Server server(opt.metrics_port);
-  // Probes FIRST: the server answers requests from its constructor on,
-  // and an unset ready probe reads 200 — registering it before the data
-  // providers closes the window where a fast client could read "ready"
-  // from a hub that has never polled anyone.
+  // The server binds here (port final) but answers nothing until
+  // start() below — after every probe and provider is registered, so no
+  // request can race the wiring and read 404/ready from a half-built hub.
   server.set_ready_probe([&] {
     std::lock_guard<std::mutex> lock(view_mutex);
     return ever_synced;
@@ -473,6 +472,7 @@ int run(int argc, char** argv) {
     std::lock_guard<std::mutex> lock(view_mutex);
     return openmetrics ? view.metrics_openmetrics : view.metrics_text;
   });
+  server.start();
   // Readiness above = member sync happened: at least one member answered
   // a full poll at least once. Liveness = the poll loop keeps rounding
   // (3 intervals of slack, floor 60s — the daemon's cycle-staleness

@@ -1,5 +1,7 @@
 #include "tpupruner/informer.hpp"
 
+#include "tpupruner/backoff.hpp"
+
 #include <algorithm>
 #include <chrono>
 #include <condition_variable>
@@ -636,18 +638,17 @@ bool Reflector::apply_event_proto(const proto::WatchEventPtr& event) {
 
 namespace {
 
-// Stop-responsive jittered sleep: exponential base capped at 10 s, plus a
-// deterministic per-path offset so a fleet of reflectors knocked over by
-// one apiserver hiccup does not relist in lockstep (the same rationale as
-// the 429 path in k8s.cpp).
-void backoff_sleep(const std::string& path, int attempt, const std::atomic<bool>& stop) {
-  int64_t base = std::min<int64_t>(500LL << std::min(attempt, 5), 10000);
-  int64_t jitter =
-      static_cast<int64_t>(std::hash<std::string>{}(path + std::to_string(attempt)) % 500);
-  int64_t wait_ms = base + jitter;
-  for (int64_t waited = 0; waited < wait_ms && !stop.load(); waited += 100) {
-    std::this_thread::sleep_for(std::chrono::milliseconds(100));
-  }
+// Stop-responsive jittered sleep via the unified backoff::Policy:
+// exponential base capped at 10 s, plus a deterministic per-path offset
+// so a fleet of reflectors knocked over by one apiserver hiccup does not
+// relist in lockstep (the same rationale as the 429 path in k8s.cpp).
+// `cause` distinguishes relist from watch retries in
+// tpu_pruner_retries_total.
+void backoff_sleep(const std::string& path, int attempt, const std::atomic<bool>& stop,
+                   const char* cause) {
+  int64_t wait_ms = backoff::policy().exp_delay_ms(path, attempt);
+  backoff::record_retry("k8s", cause, static_cast<double>(wait_ms) / 1000.0);
+  backoff::sleep_interruptible(wait_ms, &stop);
 }
 
 }  // namespace
@@ -951,7 +952,7 @@ void Reflector::run() {
     } catch (const std::exception& e) {
       synced_.store(false);
       log::warn("informer", "LIST " + spec_.list_path + " failed: " + std::string(e.what()));
-      backoff_sleep(spec_.list_path, ++list_failures, stop_);
+      backoff_sleep(spec_.list_path, ++list_failures, stop_, "relist");
       continue;
     }
     list_failures = 0;
@@ -1006,12 +1007,12 @@ void Reflector::run() {
         } else {
           ++watch_failures;
           bump_watch_failure(e.what());
-          backoff_sleep(spec_.list_path, watch_failures, stop_);
+          backoff_sleep(spec_.list_path, watch_failures, stop_, "watch");
         }
       } catch (const std::exception& e) {
         ++watch_failures;
         bump_watch_failure(e.what());
-        backoff_sleep(spec_.list_path, watch_failures, stop_);
+        backoff_sleep(spec_.list_path, watch_failures, stop_, "watch");
       }
       if (watch_failures >= 3 && !relist) {
         // The watch cannot hold; events may have been missed while flapping.

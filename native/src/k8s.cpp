@@ -8,6 +8,7 @@
 #include <stdexcept>
 #include <thread>
 
+#include "tpupruner/backoff.hpp"
 #include "tpupruner/kubeconfig.hpp"
 #include "tpupruner/log.hpp"
 #include "tpupruner/util.hpp"
@@ -76,45 +77,22 @@ http::Response Client::issue(http::Request& req, const std::string& method,
   // a 429 is shed BEFORE admission (nothing was applied). Two retries,
   // waits capped at 10 s, keeps the worst case << one check interval.
   for (int attempt = 0; resp.status == 429 && retry_throttle && attempt < 2; ++attempt) {
-    int64_t wait_ms = 1000;
-    if (auto it = resp.headers.find("retry-after"); it != resp.headers.end()) {
-      try {
-        // cap the seconds BEFORE the multiply: a hostile/broken proxy can
-        // send a delta that fits int64 but overflows once *1000 (UB, and
-        // the negative product would skip the wait entirely)
-        wait_ms = std::clamp<int64_t>(std::stoll(it->second), 1, 10) * 1000;
-      } catch (const std::exception&) {
-        // RFC 7231 also allows the HTTP-date form ("Wed, 21 Oct 2015
-        // 07:28:00 GMT"); apiservers send delta-seconds, but an
-        // intermediary proxy may rewrite it.
-        std::tm tm{};
-        std::istringstream ss(it->second);
-        ss >> std::get_time(&tm, "%a, %d %b %Y %H:%M:%S");
-        if (!ss.fail()) {
-          std::time_t when = timegm(&tm);
-          std::time_t now = std::time(nullptr);
-          if (when > now) wait_ms = static_cast<int64_t>(when - now) * 1000;
-        }
-      }
-    }
-    // Deterministic per-path jitter: every throttled worker receives the
-    // same Retry-After, and waking them in lockstep would re-hammer the
-    // already-shedding apiserver. The base is capped BEFORE the jitter —
-    // capping the sum would collapse every long Retry-After to an
-    // identical 10,000 ms, recreating exactly the lockstep wake the
-    // jitter exists to break — and the cap leaves the jitter headroom so
-    // the documented 10 s worst case per attempt still holds.
-    wait_ms = std::min<int64_t>(wait_ms, 10000 - 500);
-    wait_ms += static_cast<int64_t>(std::hash<std::string>{}(path) % 500);
+    int64_t hint_ms = 1000;
+    if (auto it = resp.headers.find("retry-after"); it != resp.headers.end())
+      hint_ms = backoff::parse_retry_after_ms(it->second);
+    // Deterministic per-path jitter (backoff::Policy): every throttled
+    // worker receives the same Retry-After, and waking them in lockstep
+    // would re-hammer the already-shedding apiserver. The hint is capped
+    // BEFORE the jitter so the documented 10 s worst case per attempt
+    // still holds without collapsing long Retry-After values onto one
+    // identical wake time.
+    int64_t wait_ms = backoff::policy().hinted_delay_ms(path, hint_ms);
     log::warn("k8s", "HTTP 429 (apiserver throttling) on " + method + " " + path +
               "; retrying in " + std::to_string(wait_ms) + "ms");
+    backoff::record_retry("k8s", "http429", static_cast<double>(wait_ms) / 1000.0);
     // Chunked, shutdown-interruptible wait (the daemon's sleep convention):
     // a SIGTERM mid-backoff aborts the retry so the drain starts promptly.
-    for (int64_t waited = 0; waited < wait_ms && !util::shutdown_flag().load();
-         waited += 100) {
-      std::this_thread::sleep_for(std::chrono::milliseconds(100));
-    }
-    if (util::shutdown_flag().load()) break;
+    if (!backoff::sleep_interruptible(wait_ms)) break;
     resp = http_.request(req);
   }
   return resp;

@@ -107,6 +107,10 @@ Server::Server(int port) {
     ::close(listen_fd_);
     throw std::runtime_error("metrics: listen failed");
   }
+}
+
+void Server::start() {
+  if (thread_.joinable()) return;  // idempotent
   thread_ = std::thread([this] { serve(); });
   log::info("metrics", "serving /metrics on port " + std::to_string(port_));
 }

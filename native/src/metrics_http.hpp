@@ -22,9 +22,18 @@ namespace tpupruner::metrics_http {
 class Server {
  public:
   // Binds 0.0.0.0:port; throws std::runtime_error when the bind fails.
+  // The socket listens (so port() is final and concurrent binds lose)
+  // but no request is ANSWERED until start().
   explicit Server(int port);
   ~Server();
   int port() const { return port_; }
+
+  // Launch the accept loop and log the "serving /metrics on port" line.
+  // Callers register every provider BEFORE start(): a request racing the
+  // registration window would otherwise 404 — and a hub whose first
+  // /debug/delta poll lands in that window demotes the member to
+  // snapshot polling for good (it reads 404 as "unsupported").
+  void start();
 
   // Liveness seam: when set, /healthz answers 503 while the probe returns
   // false. The daemon wires a cycle-staleness check here so a wedged

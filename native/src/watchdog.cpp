@@ -1,0 +1,45 @@
+#include "tpupruner/watchdog.hpp"
+
+#include <atomic>
+#include <chrono>
+
+namespace tpupruner::watchdog {
+
+namespace {
+
+std::atomic<int64_t> g_deadline_ms{0};
+std::atomic<int64_t> g_armed_at_ms{0};  // 0 = disarmed
+
+int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace
+
+void configure(int64_t deadline_ms) {
+  g_deadline_ms.store(deadline_ms, std::memory_order_relaxed);
+}
+
+int64_t deadline_ms() { return g_deadline_ms.load(std::memory_order_relaxed); }
+
+void arm() { g_armed_at_ms.store(now_ms(), std::memory_order_relaxed); }
+
+void disarm() { g_armed_at_ms.store(0, std::memory_order_relaxed); }
+
+bool expired() {
+  int64_t deadline = g_deadline_ms.load(std::memory_order_relaxed);
+  int64_t armed_at = g_armed_at_ms.load(std::memory_order_relaxed);
+  return deadline > 0 && armed_at > 0 && now_ms() - armed_at > deadline;
+}
+
+void check(const char* phase) {
+  if (!expired()) return;
+  int64_t over_ms = now_ms() - g_armed_at_ms.load(std::memory_order_relaxed);
+  throw CycleTimeout("cycle exceeded --cycle-deadline at phase '" + std::string(phase) +
+                     "' (" + std::to_string(over_ms) + "ms elapsed, deadline " +
+                     std::to_string(g_deadline_ms.load(std::memory_order_relaxed)) + "ms)");
+}
+
+}  // namespace tpupruner::watchdog

@@ -1,0 +1,213 @@
+// Unified retry/backoff policy + cycle watchdog units (PR 15 chaos
+// tier). The `just tsan-chaos` recipe runs these under ThreadSanitizer
+// via the binary's substring filter ("backoff" / "watchdog"), so the
+// concurrent cases double as the race tier for both modules.
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <ctime>
+#include <functional>
+#include <thread>
+#include <vector>
+
+#include "testing.hpp"
+#include "tpupruner/backoff.hpp"
+#include "tpupruner/watchdog.hpp"
+
+namespace backoff = tpupruner::backoff;
+namespace watchdog = tpupruner::watchdog;
+
+TP_TEST(backoff_exp_delay_matches_legacy_informer_formula) {
+  // seed 0 must reproduce the pre-unification informer backoff
+  // bit-for-bit: min(500 << min(a,5), 10000) + hash(path+attempt) % 500.
+  backoff::Policy p;
+  const std::string path = "/api/v1/pods";
+  for (int a = 0; a <= 8; ++a) {
+    int64_t base = std::min<int64_t>(500LL << std::min(a, 5), 10000);
+    int64_t jitter = static_cast<int64_t>(
+        std::hash<std::string>{}(path + std::to_string(a)) % 500);
+    TP_CHECK_EQ(p.exp_delay_ms(path, a), base + jitter);
+  }
+}
+
+TP_TEST(backoff_hinted_delay_caps_hint_before_jitter) {
+  // The legacy 429 formula: min(hint, cap - jitter_ms) + hash(path)%500.
+  // Capping BEFORE the jitter keeps the spread for long Retry-After
+  // values instead of collapsing them all onto cap_ms.
+  backoff::Policy p;
+  const std::string path = "/apis/apps/v1/deployments";
+  int64_t jitter = static_cast<int64_t>(std::hash<std::string>{}(path) % 500);
+  TP_CHECK_EQ(p.hinted_delay_ms(path, 1000), 1000 + jitter);
+  TP_CHECK_EQ(p.hinted_delay_ms(path, 50000), 9500 + jitter);
+  TP_CHECK(p.hinted_delay_ms(path, 50000) < 10000);  // documented worst case
+}
+
+TP_TEST(backoff_seeded_jitter_deterministic_and_decorrelated) {
+  backoff::Policy a;
+  a.seed = 42;
+  backoff::Policy b;
+  b.seed = 42;
+  backoff::Policy c;
+  c.seed = 43;
+  bool seeds_differ_somewhere = false;
+  for (const char* key : {"alpha", "beta", "gamma", "delta", "epsilon"}) {
+    // Same seed ⇒ identical jitter (the replayability contract the
+    // chaos harness depends on); always within [0, jitter_ms).
+    TP_CHECK_EQ(a.jitter(key), b.jitter(key));
+    TP_CHECK(a.jitter(key) >= 0 && a.jitter(key) < a.jitter_ms);
+    if (a.jitter(key) != c.jitter(key)) seeds_differ_somewhere = true;
+  }
+  // Different seeds ⇒ decorrelated sequences (5 keys all colliding by
+  // chance is ~(1/500)^5).
+  TP_CHECK(seeds_differ_somewhere);
+}
+
+TP_TEST(backoff_parse_retry_after_forms) {
+  TP_CHECK_EQ(backoff::parse_retry_after_ms("3"), 3000);
+  // delta-seconds clamp to [1, 10] BEFORE the *1000 multiply
+  TP_CHECK_EQ(backoff::parse_retry_after_ms("0"), 1000);
+  TP_CHECK_EQ(backoff::parse_retry_after_ms("100"), 10000);
+  // out-of-int64 delta throws inside stoll, falls to the date parse,
+  // lands on the 1 s default instead of a negative/overflowed wait
+  TP_CHECK_EQ(backoff::parse_retry_after_ms("99999999999999999999999"), 1000);
+  TP_CHECK_EQ(backoff::parse_retry_after_ms("not-a-date"), 1000);
+  // HTTP-date in the past → default (never a negative wait)
+  TP_CHECK_EQ(backoff::parse_retry_after_ms("Wed, 21 Oct 2015 07:28:00 GMT"), 1000);
+  // HTTP-date a few seconds out → a positive bounded wait
+  std::time_t future = std::time(nullptr) + 5;
+  std::tm tm{};
+  gmtime_r(&future, &tm);
+  char buf[64];
+  std::strftime(buf, sizeof(buf), "%a, %d %b %Y %H:%M:%S GMT", &tm);
+  int64_t ms = backoff::parse_retry_after_ms(buf);
+  TP_CHECK(ms >= 3000 && ms <= 5000);
+}
+
+TP_TEST(backoff_record_retry_counts_and_renders) {
+  backoff::reset_for_test();
+  backoff::record_retry("k8s", "http429", 1.2);
+  backoff::record_retry("k8s", "http429", 1.2);
+  backoff::record_retry("transport", "stale_conn", 0.0);
+  std::string text = backoff::render_metrics(false);
+  TP_CHECK(text.find("tpu_pruner_retries_total{endpoint=\"k8s\",cause=\"http429\"} 2") !=
+           std::string::npos);
+  TP_CHECK(text.find("tpu_pruner_retries_total{endpoint=\"transport\","
+                     "cause=\"stale_conn\"} 1") != std::string::npos);
+  TP_CHECK(text.find("tpu_pruner_backoff_seconds_count 3") != std::string::npos);
+  // 0.0 lands in every bucket; the 1.2 s pair only from le=2.5 up
+  TP_CHECK(text.find("tpu_pruner_backoff_seconds_bucket{le=\"1\"} 1") !=
+           std::string::npos);
+  TP_CHECK(text.find("tpu_pruner_backoff_seconds_bucket{le=\"2.5\"} 3") !=
+           std::string::npos);
+  TP_CHECK(text.find("tpu_pruner_backoff_seconds_bucket{le=\"+Inf\"} 3") !=
+           std::string::npos);
+  // OpenMetrics rendering keeps the 0.0.4-compatible family shape
+  std::string om = backoff::render_metrics(true);
+  TP_CHECK(om.find("# TYPE tpu_pruner_retries_total unknown") != std::string::npos);
+  backoff::reset_for_test();
+}
+
+TP_TEST(backoff_metric_families_canonical) {
+  const auto& families = backoff::metric_families();
+  TP_CHECK_EQ(families.size(), static_cast<size_t>(2));
+  TP_CHECK_EQ(families[0], std::string("tpu_pruner_retries_total"));
+  TP_CHECK_EQ(families[1], std::string("tpu_pruner_backoff_seconds"));
+  // Every canonical family must actually render (the /metrics-serving
+  // drift test enumerates what the daemon serves).
+  std::string text = backoff::render_metrics(false);
+  for (const std::string& f : families) {
+    TP_CHECK(text.find("# HELP " + f) != std::string::npos);
+  }
+}
+
+TP_TEST(backoff_sleep_interruptible_honors_stop) {
+  std::atomic<bool> stop{true};
+  auto t0 = std::chrono::steady_clock::now();
+  TP_CHECK(!backoff::sleep_interruptible(60000, &stop));
+  auto elapsed = std::chrono::steady_clock::now() - t0;
+  TP_CHECK(elapsed < std::chrono::seconds(2));  // aborted, not slept
+}
+
+TP_TEST(backoff_concurrent_record_and_render) {
+  // TSan tier: concurrent recorders + a renderer on the shared telemetry.
+  backoff::reset_for_test();
+  std::vector<std::thread> threads;
+  for (int i = 0; i < 4; ++i) {
+    threads.emplace_back([i] {
+      for (int n = 0; n < 200; ++n) {
+        backoff::record_retry("k8s", i % 2 ? "relist" : "watch", 0.1 * (n % 7));
+      }
+    });
+  }
+  threads.emplace_back([] {
+    for (int n = 0; n < 50; ++n) (void)backoff::render_metrics(n % 2 == 0);
+  });
+  for (auto& t : threads) t.join();
+  std::string text = backoff::render_metrics(false);
+  TP_CHECK(text.find("tpu_pruner_backoff_seconds_count 800") != std::string::npos);
+  backoff::reset_for_test();
+}
+
+TP_TEST(watchdog_disabled_never_trips) {
+  watchdog::configure(0);
+  watchdog::arm();
+  TP_CHECK(!watchdog::expired());
+  watchdog::check("resolve");  // must not throw
+  watchdog::disarm();
+}
+
+TP_TEST(watchdog_expires_and_throws_at_phase_boundary) {
+  watchdog::configure(20);  // ms
+  watchdog::arm();
+  std::this_thread::sleep_for(std::chrono::milliseconds(60));
+  TP_CHECK(watchdog::expired());
+  bool threw = false;
+  try {
+    watchdog::check("resolve");
+  } catch (const watchdog::CycleTimeout& e) {
+    threw = true;
+    TP_CHECK(std::string(e.what()).find("'resolve'") != std::string::npos);
+    TP_CHECK(std::string(e.what()).find("--cycle-deadline") != std::string::npos);
+  }
+  TP_CHECK(threw);
+  // disarmed ⇒ quiet again, whatever the deadline
+  watchdog::disarm();
+  TP_CHECK(!watchdog::expired());
+  watchdog::check("resolve");
+  watchdog::configure(0);
+}
+
+TP_TEST(watchdog_rearm_resets_the_clock) {
+  watchdog::configure(50);
+  watchdog::arm();
+  std::this_thread::sleep_for(std::chrono::milliseconds(80));
+  TP_CHECK(watchdog::expired());
+  watchdog::arm();  // next cycle: fresh deadline
+  TP_CHECK(!watchdog::expired());
+  watchdog::disarm();
+  watchdog::configure(0);
+}
+
+TP_TEST(watchdog_concurrent_arm_check_probe) {
+  // TSan tier: the producer arms/disarms while phase boundaries (and the
+  // metrics thread reading expired()) probe concurrently.
+  watchdog::configure(1);
+  std::atomic<bool> done{false};
+  std::thread prober([&] {
+    while (!done.load()) {
+      try {
+        watchdog::check("probe");
+      } catch (const watchdog::CycleTimeout&) {
+      }
+      (void)watchdog::expired();
+    }
+  });
+  for (int i = 0; i < 500; ++i) {
+    watchdog::arm();
+    watchdog::disarm();
+  }
+  done.store(true);
+  prober.join();
+  watchdog::configure(0);
+}

@@ -1,0 +1,346 @@
+"""Chaos tier: seeded fault injection, crash-restart invariants,
+convergence-to-control byte identity.
+
+Everything here reduces production pathology to a SEEDED schedule
+(tpu_pruner.testing.chaos): apiserver 429/5xx storms, connections cut
+mid-body, 410 relist storms, stale-but-plausible Prometheus bodies,
+SIGKILL at arbitrary points. The invariants under test:
+
+- a chaos run converges to the SAME canonical steady state as an
+  undisturbed control run (byte-identical fingerprint);
+- the daemon never scales on untrusted evidence (stale bodies veto,
+  they don't actuate);
+- reclaimed chip-seconds stay monotonic and physically bounded across
+  SIGKILL restarts (no double-counting from checkpoint reload);
+- the flight ring and the delta journal resync cleanly after a crash.
+"""
+
+import json
+import re
+import signal
+import subprocess
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+from tpu_pruner.testing import chaos
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def idle_cluster(fake_k8s, fake_prom, pods: int = 2):
+    _, _, pod_objs = fake_k8s.add_deployment_chain("ml", "trainer",
+                                                   num_pods=pods, tpu_chips=4)
+    for pod in pod_objs:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "ml", chips=4)
+    return pod_objs
+
+
+# ── fixture self-test: the inject() fault API itself ───────────────────────
+
+
+def test_inject_rejects_unknown_kinds(fake_prom, fake_k8s):
+    with pytest.raises(ValueError):
+        fake_k8s.inject([{"fault": "meteor_strike"}])
+    with pytest.raises(ValueError):
+        fake_prom.inject([{"fault": "meteor_strike"}])
+
+
+def test_inject_faults_fire_first_match_and_burn_out(fake_k8s):
+    """status faults answer with the injected code (and Retry-After),
+    consume their budget first-match-wins, then the path serves clean."""
+    fake_k8s.inject([
+        {"fault": "status", "code": 429, "retry_after": "2",
+         "match": r"/api/v1/pods", "times": 2},
+        {"fault": "status", "code": 503, "match": r"/api/v1/pods"},
+    ])
+    codes = []
+    for _ in range(4):
+        try:
+            with urllib.request.urlopen(fake_k8s.url + "/api/v1/pods") as r:
+                codes.append(r.status)
+        except urllib.error.HTTPError as e:
+            codes.append(e.code)
+            if e.code == 429:
+                assert e.headers["Retry-After"] == "2"
+    assert codes == [429, 429, 503, 200]
+    assert [k for k, _, _ in fake_k8s.faults_fired] == \
+        ["status", "status", "status"]
+    # clear_faults drops whatever is left
+    fake_k8s.inject([{"fault": "status", "code": 500}])
+    fake_k8s.clear_faults()
+    with urllib.request.urlopen(fake_k8s.url + "/api/v1/pods") as r:
+        assert r.status == 200
+
+
+def test_inject_transport_faults_cut_the_socket(fake_prom, fake_k8s):
+    """disconnect / drop_after really sever the byte stream (the client
+    sees a protocol error, not a clean short response)."""
+    fake_k8s.add_pod("ml", "p0")
+    fake_prom.add_idle_pod_series("p0", "ml")
+    fake_k8s.inject([{"fault": "disconnect"}])
+    with pytest.raises(Exception):
+        urllib.request.urlopen(fake_k8s.url + "/api/v1/pods").read()
+    # mid-body cut: headers promise more than arrives
+    fake_prom.inject([{"fault": "drop_after", "bytes": 200}])
+    with pytest.raises(Exception):
+        urllib.request.urlopen(
+            fake_prom.url + "/api/v1/query?query=tensorcore").read()
+    assert fake_k8s.faults_fired[0][0] == "disconnect"
+    assert fake_prom.faults_fired[0][0] == "drop_after"
+
+
+def test_inject_data_faults_are_plausible_lies(fake_prom, fake_k8s):
+    """wrong_rv / stale_ts / dup_series serve well-formed bodies whose
+    CONTENT is wrong — the fault class retries can't paper over."""
+    fake_k8s.add_pod("ml", "p0")
+    fake_prom.add_idle_pod_series("p0", "ml")
+
+    fake_k8s.inject([{"fault": "wrong_rv", "rv": "31337"}])
+    with urllib.request.urlopen(fake_k8s.url + "/api/v1/pods") as r:
+        assert json.load(r)["metadata"]["resourceVersion"] == "31337"
+    with urllib.request.urlopen(fake_k8s.url + "/api/v1/pods") as r:
+        assert json.load(r)["metadata"]["resourceVersion"] != "31337"
+
+    def query(q="tensorcore"):
+        with urllib.request.urlopen(
+                fake_prom.url + "/api/v1/query?query=" + q) as r:
+            return json.load(r)["data"]["result"]
+
+    clean = query()
+    fake_prom.inject([{"fault": "stale_ts", "age_s": 1000.0},
+                      {"fault": "dup_series"}])
+    stale = query()
+    assert float(stale[0]["value"][0]) == \
+        pytest.approx(float(clean[0]["value"][0]) - 1000.0, abs=30)
+    assert len(query()) == 2 * len(clean)  # dup_series doubled the rows
+    # recorded == served: the dup body is what response_bodies holds
+    assert len(json.loads(fake_prom.response_bodies[-1])
+               ["data"]["result"]) == 2 * len(clean)
+
+
+def test_chaos_schedule_seeded_and_replayable():
+    """One integer reproduces the whole plan — the debugging contract."""
+    a = chaos.build_schedule(1107, rounds=6)
+    b = chaos.build_schedule(1107, rounds=6)
+    assert a.rounds == b.rounds
+    assert chaos.build_schedule(1108, rounds=6).rounds != a.rounds
+    assert len(a.fault_types) >= 3
+
+
+# ── tentpole: chaos run converges byte-identically to control ──────────────
+
+
+def drive_run(seed, rounds, cycles_per_round, extra_args=()):
+    """One full run (chaos when seed is not None, control otherwise)
+    against fresh fakes; returns (fingerprint, audit records, k8s fake)."""
+    fp, fk = FakePrometheus(), FakeK8s()
+    fp.start()
+    fk.start()
+    try:
+        idle_cluster(fk, fp)
+        state = tempfile.mkdtemp(prefix="tp-chaos-state-")
+        run = chaos.ChaosRun(fp, fk, state, extra_args=extra_args)
+        if seed is not None:
+            sched = chaos.build_schedule(seed, rounds=rounds)
+            procs = chaos.run_chaos(sched, run,
+                                    cycles_per_round=cycles_per_round)
+            assert len(sched.fault_types) >= 5, sorted(sched.fault_types)
+        else:
+            procs = [run.run_segment((rounds + 1) * cycles_per_round)]
+        for p in procs:
+            assert p.returncode == 0, p.stderr[-2000:]
+        records = [json.loads(l) for l in
+                   run.audit_log.read_text().splitlines() if l.strip()]
+        fired = list(fk.faults_fired) + list(fp.faults_fired)
+        return chaos.steady_state_fingerprint(run.audit_log, fk), records, \
+            fired
+    finally:
+        fp.stop()
+        fk.stop()
+
+
+def test_chaos_run_converges_byte_identical_to_control(built):
+    """≥5 fault types over ≥50 cycles; the post-storm steady state must
+    be byte-identical to an undisturbed control run, and no cycle that
+    saw untrusted evidence may contain a scale action."""
+    rounds, cpr = 8, 7  # 8 fault bursts + final clean segment = 63 cycles
+    guard = ("--signal-guard", "on")
+    control_fp, _, control_fired = drive_run(None, rounds, cpr, guard)
+    chaos_fp, records, fired = drive_run(1107, rounds, cpr, guard)
+
+    assert control_fired == []
+    assert len(fired) >= 5, f"storm too mild: {fired}"
+    assert chaos_fp == control_fp
+
+    # the untrusted-evidence invariant, cycle by cycle: any cycle where
+    # the signal guard vetoed (stale/brownout evidence) must contain zero
+    # actuations — a veto and a scale in the same cycle is the regression
+    by_cycle = {}
+    for r in records:
+        by_cycle.setdefault(r["cycle"], []).append(r)
+    for cycle, recs in by_cycle.items():
+        reasons = {r["reason"] for r in recs}
+        if reasons & {"SIGNAL_STALE", "SIGNAL_BROWNOUT", "SIGNAL_GAPPY"}:
+            actions = {r["action"] for r in recs}
+            assert "scale_down" not in actions, (cycle, recs)
+
+
+# ── stale evidence NEVER scales; recovery is complete ──────────────────────
+
+
+def test_stale_evidence_vetoes_then_recovers(built, fake_prom, fake_k8s,
+                                             tmp_path):
+    """With --signal-guard on and the evidence body lying about sample
+    age (stale_ts on the evidence query), NOTHING scales — and once the
+    fault clears, the same daemon state converges to the normal scale
+    decision with no residue."""
+    idle_cluster(fake_k8s, fake_prom)
+    run = chaos.ChaosRun(fake_prom, fake_k8s, tmp_path,
+                         extra_args=("--signal-guard", "on"))
+    # every evidence body for the whole first segment reads 2h stale
+    fake_prom.inject([{"fault": "stale_ts", "age_s": 7200.0,
+                       "match": "signal_stat", "times": -1}])
+    p = run.run_segment(3)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert fake_k8s.scale_patches() == []
+    records = [json.loads(l) for l in
+               run.audit_log.read_text().splitlines() if l.strip()]
+    reasons = {r["reason"] for r in records}
+    assert reasons & {"SIGNAL_STALE", "SIGNAL_BROWNOUT"}
+    assert "SCALED" not in reasons
+    assert all(r["action"] != "scale_down" for r in records)
+
+    fake_prom.clear_faults()
+    p = run.run_segment(2)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert len(fake_k8s.scale_patches()) >= 1
+    tail = chaos.final_cycle_records(run.audit_log)
+    assert {r["reason"] for r in tail} == {"SCALED"}
+
+
+# ── SIGKILL property: ledger monotonic, bounded, no double-count ───────────
+
+
+def test_sigkill_restarts_never_double_count(built, tmp_path):
+    """SIGKILL the daemon at seeded points across ≥3 restarts: reclaimed
+    chip-seconds reloaded from --ledger-file must stay monotonic AND
+    physically bounded by chips x wall-time (a double-count from
+    checkpoint reload breaks the bound), and the flight ring must stay
+    parseable."""
+    import random
+
+    fp, fk = FakePrometheus(), FakeK8s()
+    fp.start()
+    fk.start()
+    try:
+        idle_cluster(fk, fp)
+        run = chaos.ChaosRun(fp, fk, tmp_path)
+        rng = random.Random(1107)
+        t0 = time.time()
+        p = run.run_segment(5)  # establish the pause + first checkpoint
+        assert p.returncode == 0, p.stderr[-2000:]
+        samples = [run.ledger_totals().get("Deployment/ml/trainer", 0.0)]
+        for _ in range(3):
+            run.run_segment_sigkill(rng.uniform(0.6, 1.5))
+            samples.append(run.ledger_totals().get("Deployment/ml/trainer",
+                                                   0.0))
+        p = run.run_segment(5)
+        assert p.returncode == 0, p.stderr[-2000:]
+        samples.append(run.ledger_totals().get("Deployment/ml/trainer", 0.0))
+        wall = time.time() - t0
+
+        assert samples == sorted(samples), samples  # monotonic, never back
+        assert samples[-1] > 0
+        # physical bound: 2 pods x 4 chips accruing for at most `wall`
+        # seconds; double-counting any restarted span would exceed it
+        assert samples[-1] <= 8 * wall + 8, (samples, wall)
+
+        capsules = sorted(run.flight_dir.glob("cycle-*.json"))
+        assert capsules, "flight ring empty after restarts"
+        for c in capsules:
+            json.loads(c.read_text())  # every capsule parses post-crash
+    finally:
+        fp.stop()
+        fk.stop()
+
+
+# ── delta journal resyncs cleanly across a crash ───────────────────────────
+
+
+class _DaemonMode:
+    """Daemon-mode run with --metrics-port auto (LedgerDaemon idiom)."""
+
+    def __init__(self, fake_prom, fake_k8s, *extra):
+        cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+               "--run-mode", "scale-down", "--daemon-mode",
+               "--check-interval", "1", "--metrics-port", "auto", *extra]
+        env = {"KUBE_API_URL": fake_k8s.url, "KUBE_TOKEN": "t",
+               "PROMETHEUS_TOKEN": "p", "PATH": "/usr/bin:/bin"}
+        self.proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                                     stderr=subprocess.PIPE, text=True)
+        self.port = None
+        for line in self.proc.stderr:
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                self.port = int(m.group(1))
+                break
+        assert self.port, "daemon never reported its metrics port"
+
+    def get_json(self, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.port}{path}", timeout=5) as resp:
+            return json.load(resp)
+
+    def sigkill(self):
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+        self.proc.wait(timeout=10)
+
+
+def test_delta_journal_resyncs_after_sigkill(built, fake_prom, fake_k8s,
+                                             tmp_path):
+    """A hub cursor from before the crash must be answered with
+    resync:true + a full snapshot by the restarted daemon — never a
+    bogus delta against a dead epoch space."""
+    idle_cluster(fake_k8s, fake_prom)
+    ledger = tmp_path / "ledger.jsonl"
+    d = _DaemonMode(fake_prom, fake_k8s, "--ledger-file", str(ledger))
+    try:
+        first = d.get_json("/debug/delta?since=-1")
+        assert set(first["full"].keys()) >= {"workloads", "decisions"}
+        cursor = f"?since={first['epoch']}&gen={first['gen']}"
+        d.get_json("/debug/delta" + cursor)  # cursor valid in this life
+    finally:
+        d.sigkill()
+
+    d2 = _DaemonMode(fake_prom, fake_k8s, "--ledger-file", str(ledger))
+    try:
+        after = d2.get_json("/debug/delta" + cursor)
+        assert after.get("resync") is True
+        assert "full" in after
+    finally:
+        d2.stop()

@@ -368,6 +368,32 @@ def test_fleet_bench_summary_fields_documented():
             f"bench summary field {field} missing from docs/BENCH_FIELDS.md")
 
 
+def test_chaos_surfaces_documented(built):
+    """The unified-backoff families come from the native canonical list
+    (backoff::metric_families via capi) so a counter added to
+    backoff.cpp without a runbook row fails even on a daemon that never
+    retried anything. The watchdog flag/metric, the fakes' fault-
+    injection API and the chaos recipes ride the same guard."""
+    doc = OPERATIONS.read_text()
+    families = native.backoff_metric_families()
+    assert len(families) >= 2
+    missing = [f for f in families if f not in doc]
+    assert not missing, (
+        f"backoff metric families missing from docs/OPERATIONS.md: {missing} "
+        "— document each in the Observability table and the 'Surviving "
+        "failure' section")
+    needles = ("Surviving failure", "--cycle-deadline", "CYCLE_TIMEOUT",
+               "tpu_pruner_cycle_timeouts_total", "TPU_PRUNER_BACKOFF_SEED",
+               "Retry-After", "inject(", "drop_after", "wrong_rv",
+               "stale_ts", "dup_series", "build_schedule",
+               "steady_state_fingerprint", "chaos-smoke", "soak-smoke",
+               "--soak-only", "TP_SOAK_CYCLES", "tsan-chaos")
+    missing = [n for n in needles if n not in doc]
+    assert not missing, (
+        f"chaos-tier surfaces missing from docs/OPERATIONS.md: {missing} "
+        "— document each in the 'Surviving failure' section")
+
+
 def test_every_served_metric_documented(built):
     """Scrape the real daemon after a full scale-down cycle and check every
     family name on /metrics (histograms included) against OPERATIONS.md."""

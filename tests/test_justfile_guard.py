@@ -319,6 +319,69 @@ def test_tsan_fleet_recipe_present_and_wired():
         "vacuously pass")
 
 
+def test_chaos_smoke_recipe_present_and_wired():
+    """`just chaos-smoke` must exist and invoke the real smoke module —
+    the chaos-tier contract (seeded storm byte-identical to control,
+    SIGKILL ledger accounting, stale-evidence veto + recovery) would
+    otherwise go unguarded in CI."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^chaos-smoke\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `chaos-smoke:` recipe"
+    assert "tpu_pruner.testing.chaos_smoke" in m.group(1), (
+        "chaos-smoke no longer invokes tpu_pruner.testing.chaos_smoke")
+    import importlib
+
+    module = importlib.import_module("tpu_pruner.testing.chaos_smoke")
+    assert callable(module.main)
+
+
+def test_soak_smoke_recipe_present_and_wired():
+    """`just soak-smoke` must exist and invoke the long-soak drift tier —
+    the flat-slope RSS bar under background chaos would otherwise go
+    unguarded in CI. The 500-cycle override keeps the smoke in CI
+    seconds (with the warmup-tail bar loosened to 2 MB/1k cycles); the
+    flagship run is the default TP_SOAK_CYCLES=10000 at the tight bar."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^soak-smoke\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `soak-smoke:` recipe"
+    body = m.group(1)
+    assert "bench.py --soak-only" in body, (
+        "soak-smoke no longer invokes bench.py --soak-only")
+    assert "TP_SOAK_CYCLES=500" in body, (
+        "soak-smoke lost its 500-cycle override — the recipe would run "
+        "the full 10k-cycle soak in CI")
+    bench = (REPO / "bench.py").read_text()
+    assert "--soak-only" in bench and "run_soak_tier" in bench, (
+        "bench.py no longer implements the --soak-only soak tier")
+
+
+def test_tsan_chaos_recipe_present_and_wired():
+    """`just tsan-chaos` must exist and run the backoff + watchdog native
+    tests under ThreadSanitizer — retry telemetry is recorded by worker
+    threads while the metrics thread renders it, and the cycle watchdog
+    is armed by the producer while phase boundaries probe it; exactly
+    the concurrency TSan exists to check."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^tsan-chaos\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `tsan-chaos:` recipe"
+    body = m.group(1)
+    assert "-DTP_TSAN=ON" in body, "tsan-chaos no longer builds with TSan"
+    assert re.search(r"tpupruner_tests\s+backoff", body), (
+        "tsan-chaos no longer runs the native backoff tests")
+    assert re.search(r"tpupruner_tests\s+watchdog", body), (
+        "tsan-chaos no longer runs the native watchdog tests")
+    src = (REPO / "native" / "tests" / "test_backoff.cpp").read_text()
+    assert "backoff_concurrent_record_and_render" in src, (
+        "test_backoff.cpp lost its concurrency test — tsan-chaos would "
+        "vacuously pass")
+    assert "watchdog_concurrent_arm_check_probe" in src, (
+        "test_backoff.cpp lost the watchdog concurrency test — tsan-chaos "
+        "would vacuously pass")
+
+
 def test_just_verify_matches_roadmap_tier1():
     roadmap = roadmap_tier1_command()
     justfile = justfile_verify_command()

@@ -150,6 +150,7 @@ def load() -> ctypes.CDLL:
         "tp_signal_assess",
         "tp_signal_metric_families",
         "tp_transport_metric_families",
+        "tp_backoff_metric_families",
         "tp_incremental_metric_families",
         "tp_wire_metric_families",
         "tp_store_metric_families",
@@ -251,6 +252,13 @@ def transport_metric_families() -> list[str]:
     """Canonical shared-transport metric family names served on /metrics —
     the docs drift-guard test joins this list against docs/OPERATIONS.md."""
     return _call("tp_transport_metric_families", {})["families"]
+
+
+def backoff_metric_families() -> list[str]:
+    """Canonical unified retry/backoff metric family names served on
+    /metrics (backoff.cpp) — the docs drift-guard test joins this list
+    against docs/OPERATIONS.md."""
+    return _call("tp_backoff_metric_families", {})["families"]
 
 
 def incremental_metric_families() -> list[str]:

@@ -12,6 +12,22 @@ the client's resourceVersion, BOOKMARK events while idle
 compaction floor (`expire_watches()`), and injectable connection drops
 (`kill_watches()`).
 
+Fault injection is a first-class API (PR 15 chaos tier): beyond the
+targeted `fail_next()` / `outage` / `kill_watches()` knobs, `inject()`
+takes a declarative schedule of per-request fault points — `status`
+(respond N, optional Retry-After), `delay` (stall the request, modeling a
+wedged apiserver: the fixture's request lock is held, so everything
+queues behind it), `disconnect` (close before any response byte),
+`drop_after` (truncate the response after N bytes — headers included —
+then abruptly close: mid-LIST-page and mid-watch-frame cuts), and
+`wrong_rv` (serve a LIST whose metadata.resourceVersion is a lie, the
+stale-but-plausible shape). Entries match on a path regex + method and
+decrement a `times` budget, so a schedule is consumed deterministically
+in request-arrival order: the same seed-generated schedule against the
+same request sequence replays the same faults (the chaos harness's
+replayability contract). Single-process mode only, like the watch
+surface. See `inject()` for the schema.
+
 Watch caveats: assigning `fake.objects[path] = obj` emits the event —
 mutating an already-stored dict in place does NOT (reassign to emit
 MODIFIED). In multi-process mode (`start(workers=N)`) each forked worker
@@ -29,6 +45,7 @@ import base64
 import copy
 import json
 import re
+import socket
 import threading
 import time
 import uuid
@@ -287,7 +304,59 @@ def age(seconds: int) -> str:
     return rfc3339(datetime.now(timezone.utc) - timedelta(seconds=seconds))
 
 
+class _TruncatingFile:
+    """Write-side wfile wrapper implementing the `drop_after` fault: pass
+    through `budget` response bytes (status line and headers included),
+    then shut the socket down abruptly and raise BrokenPipeError — the
+    client observes a response (or watch frame) cut mid-byte-stream, not
+    a clean close. The handler's BrokenPipeError guard swallows the
+    raise, so the thread unwinds quietly like a real client disconnect."""
+
+    def __init__(self, raw, sock, budget: int):
+        self._raw = raw
+        self._sock = sock
+        self._budget = budget
+
+    def write(self, data):
+        if self._budget <= 0:
+            self._die()
+        chunk = data[:self._budget]
+        self._raw.write(chunk)
+        self._budget -= len(chunk)
+        if len(chunk) < len(data):
+            try:
+                self._raw.flush()
+            except OSError:
+                pass
+            self._die()
+        return len(data)
+
+    def _die(self):
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        raise BrokenPipeError("drop_after budget exhausted (injected fault)")
+
+    def flush(self):
+        try:
+            self._raw.flush()
+        except OSError:
+            pass
+
+    def close(self):
+        self._raw.close()
+
+    @property
+    def closed(self):
+        return self._raw.closed
+
+
 class FakeK8s:
+    # fault kinds inject() accepts; see the method docstring
+    FAULT_KINDS = frozenset(
+        {"status", "delay", "disconnect", "drop_after", "wrong_rv"})
+
     def __init__(self):
         # ── watch surface state (before `objects`: the store journals
         # into these) ──
@@ -357,6 +426,11 @@ class FakeK8s:
         # targeted fault injection: (method or "*", exact path) → [code, n]
         # where n is the remaining failure count (-1 = fail forever)
         self.fail_rules: dict[tuple[str, str], list] = {}
+        # declarative fault schedule (PR 15 chaos tier): inject() appends
+        # entries, every request consumes them first-match-wins under
+        # _lock — see inject() for the schema and fault kinds
+        self.fault_schedule: list[dict] = []
+        self.faults_fired: list[tuple[str, str, str]] = []  # (kind, method, path)
         # shared-transport accounting: accepted connections + h2 streams,
         # so tests can assert multiplexing actually happened (e.g. a warm
         # cycle opens <= 1 connection to this endpoint)
@@ -636,6 +710,74 @@ class FakeK8s:
                 return rule[0], (rule[2] if len(rule) > 2 else None)
         return None
 
+    def inject(self, schedule: list[dict]):
+        """Append a declarative fault schedule (PR 15 chaos tier).
+
+        Each entry is a dict::
+
+            {"fault": <kind>, "match": <path regex, default ".*">,
+             "method": <"GET"|"PATCH"|"POST"|"*", default "*">,
+             "times": <budget, default 1; -1 = unlimited>, ...params}
+
+        Kinds and their params:
+
+        - ``status``: respond ``code`` (default 503) with a Status body;
+          ``retry_after`` adds a Retry-After header (int delta-seconds or
+          an HTTP-date string) — the 429/5xx-burst shape.
+        - ``delay``: sleep ``seconds`` (default 1.0) before serving
+          normally. Served under the fixture's request lock, so this
+          models a WEDGED apiserver: everything queues behind it.
+        - ``disconnect``: close the connection before any response byte.
+        - ``drop_after``: serve normally but cut the connection after
+          ``bytes`` response bytes (status line + headers included) —
+          mid-LIST-page / mid-watch-frame truncation.
+        - ``wrong_rv``: serve the LIST normally but lie in
+          ``metadata.resourceVersion`` (value ``rv``, default "1") — the
+          stale-but-plausible response a broken cache produces.
+
+        Entries are consumed FIRST-MATCH-WINS in schedule order, each
+        decrementing its ``times`` budget, requests arriving in order —
+        so a seed-generated schedule replays deterministically against
+        the same request sequence. Every fired fault is recorded in
+        ``faults_fired`` as (kind, method, path). Single-process servers
+        only (``start()`` without workers), like the watch surface.
+        """
+        compiled = []
+        for entry in schedule:
+            kind = entry.get("fault")
+            if kind not in self.FAULT_KINDS:
+                raise ValueError(f"unknown fault kind {kind!r} "
+                                 f"(one of {sorted(self.FAULT_KINDS)})")
+            e = dict(entry)
+            e.setdefault("method", "*")
+            e.setdefault("times", 1)
+            e["_re"] = re.compile(e.get("match", ".*"))
+            compiled.append(e)
+        with self._lock:
+            self.fault_schedule.extend(compiled)
+
+    def clear_faults(self):
+        """Drop every un-consumed inject() entry."""
+        with self._lock:
+            self.fault_schedule.clear()
+
+    def _take_fault(self, method: str, path: str):
+        """First schedule entry matching (method, path) with budget left,
+        or None; decrements the budget and records the firing. Caller
+        holds _lock."""
+        for e in self.fault_schedule:
+            if e["times"] == 0:
+                continue
+            if e["method"] not in ("*", method):
+                continue
+            if not e["_re"].search(path):
+                continue
+            if e["times"] > 0:
+                e["times"] -= 1
+            self.faults_fired.append((e["fault"], method, path))
+            return e
+        return None
+
     def kill_watches(self):
         """Abruptly drop every active watch stream (mid-stream connection
         loss). New watch requests are served normally — the client's
@@ -743,6 +885,47 @@ class FakeK8s:
 
             def log_message(self, *args):
                 pass
+
+            def handle_one_request(self):
+                # The drop_after fault raises BrokenPipeError from inside
+                # the handler (as would a real client disconnect mid-
+                # response); unwind quietly instead of a stderr traceback.
+                try:
+                    super().handle_one_request()
+                except BrokenPipeError:
+                    self.close_connection = True
+
+            def _apply_fault(self, fault):
+                """Apply a consumed inject() fault. Returns False when the
+                request was already answered (or the connection killed);
+                True to continue serving normally (delay slept / wfile
+                wrapped for drop_after / wrong_rv armed)."""
+                kind = fault["fault"]
+                if kind == "status":
+                    self._respond(fault.get("code", 503),
+                                  {"kind": "Status", "status": "Failure",
+                                   "message": "injected fault (test)"},
+                                  retry_after=fault.get("retry_after"))
+                    return False
+                if kind == "disconnect":
+                    self.close_connection = True
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    return False
+                if kind == "delay":
+                    time.sleep(fault.get("seconds", 1.0))
+                    return True
+                if kind == "drop_after":
+                    self.wfile = _TruncatingFile(self.wfile, self.connection,
+                                                 int(fault.get("bytes", 0)))
+                    self.close_connection = True
+                    return True
+                if kind == "wrong_rv":
+                    self._wrong_rv = str(fault.get("rv", "1"))
+                    return True
+                return True
 
             def _respond(self, code, payload, retry_after=None):
                 body = json.dumps(payload).encode()
@@ -880,6 +1063,10 @@ class FakeK8s:
                                              "message": "injected failure (test)"},
                                       retry_after=retry_after)
                         return
+                    self._wrong_rv = None
+                    if (flt := fake._take_fault("GET", path)) is not None:
+                        if not self._apply_fault(flt):
+                            return
                     # collection LIST (optional labelSelector), incl. empty lists
                     if (rx := self._collection_object_re(path)) is not None:
                         selector = query.get("labelSelector", [""])[0]
@@ -909,7 +1096,8 @@ class FakeK8s:
                             fake.list_encode_stats["scans"] += 1
                         # a real LIST carries the store's resourceVersion —
                         # the version a subsequent watch resumes from
-                        meta = {"resourceVersion": str(fake._rv)}
+                        # (unless a wrong_rv fault armed a lie)
+                        meta = {"resourceVersion": self._wrong_rv or str(fake._rv)}
                         try:
                             limit = int(query.get("limit", ["0"])[0] or "0")
                         except ValueError:
@@ -951,11 +1139,14 @@ class FakeK8s:
                     fake.requests.append(("GET", self.path))
                     fake._traceparents.append(self.headers.get("traceparent"))
                     inj = fake._injected_failure("GET", path)
+                    flt = None if inj is not None else fake._take_fault("GET", path)
                 if inj is not None:
                     code, retry_after = inj
                     self._respond(code, {"kind": "Status", "status": "Failure",
                                          "message": "injected failure (test)"},
                                   retry_after=retry_after)
+                    return
+                if flt is not None and not self._apply_fault(flt):
                     return
                 rx = self._collection_object_re(path)
                 if rx is None:
@@ -1053,6 +1244,9 @@ class FakeK8s:
                                              "message": "injected failure (test)"},
                                       retry_after=retry_after)
                         return
+                    if (flt := fake._take_fault("PATCH", path)) is not None:
+                        if not self._apply_fault(flt):
+                            return
                     target_path = path.removesuffix("/scale")
                     obj = fake.objects.get(target_path)
                     if obj is None:
@@ -1103,6 +1297,9 @@ class FakeK8s:
                                              "message": "injected failure (test)"},
                                       retry_after=retry_after)
                         return
+                    if (flt := fake._take_fault("POST", path)) is not None:
+                        if not self._apply_fault(flt):
+                            return
                     if path.endswith("/events"):
                         fake.events.append(body)
                         self._respond(201, body)

@@ -3,10 +3,24 @@
 Returns canned instant-vector series, records every query (and auth
 header) it receives, and can be told to fail N requests — which is how the
 daemon's consecutive-failure budget is exercised hermetically.
+
+Fault injection is a first-class API (PR 15 chaos tier): `inject()` takes
+a declarative schedule of per-query fault points — `status` (respond N),
+`delay` (stall the query under the fixture lock: a wedged backend),
+`drop_after` (truncate the response after N bytes, headers included, then
+abruptly close), `stale_ts` (serve the normal body with every sample
+timestamp shifted `age_s` into the past — stale-but-plausible evidence),
+and `dup_series` (serve every result row twice — the duplicate-series
+shape a misconfigured federation produces). Entries match on a query
+regex and decrement a `times` budget, consumed first-match-wins in
+query-arrival order, so a seed-generated schedule replays
+deterministically. Fired faults are recorded in `faults_fired`. See
+`inject()` for the schema.
 """
 
 from __future__ import annotations
 
+import copy
 import json
 import re
 import threading
@@ -15,6 +29,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from tpu_pruner.testing import h2_server, wire_proto
+from tpu_pruner.testing.fake_k8s import _TruncatingFile
 
 
 def promql_structure_error(query: str) -> str | None:
@@ -108,6 +123,81 @@ class FakePrometheus:
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
+        # declarative fault schedule (PR 15 chaos tier): inject() appends
+        # entries, instant queries consume them first-match-wins under
+        # _lock — see inject() for the schema and fault kinds
+        self.fault_schedule: list[dict] = []
+        self.faults_fired: list[tuple[str, str]] = []  # (kind, query)
+
+    # fault kinds inject() accepts; see the method docstring
+    FAULT_KINDS = frozenset(
+        {"status", "delay", "drop_after", "stale_ts", "dup_series"})
+
+    def inject(self, schedule: list[dict]):
+        """Append a declarative fault schedule (PR 15 chaos tier).
+
+        Each entry is a dict::
+
+            {"fault": <kind>, "match": <query regex, default ".*">,
+             "times": <budget, default 1; -1 = unlimited>, ...params}
+
+        Kinds and their params:
+
+        - ``status``: answer with HTTP ``code`` (default 503) and a
+          Prometheus error body — the 5xx-burst shape.
+        - ``delay``: sleep ``seconds`` (default 1.0) before serving,
+          holding the fixture's query lock (a wedged backend: queries
+          pile up behind it).
+        - ``drop_after``: serve the normal response but cut the
+          connection after ``bytes`` response bytes (headers included) —
+          a truncated body mid-transfer.
+        - ``stale_ts``: serve the normal body claiming to be ``age_s``
+          seconds (default 3600) older than it is — sample timestamps
+          shift into the past, and evidence ``signal_stat="age"`` rows
+          report ``age_s`` more. Valid JSON, plausible values,
+          untrustworthy evidence: a ``--signal-guard on`` daemon must
+          veto rather than scale on it.
+        - ``dup_series``: serve every result row twice — duplicate
+          series, the shape a misconfigured federation/HA pair produces.
+
+        Entries are consumed FIRST-MATCH-WINS in schedule order against
+        each instant query (``/api/v1/query``), each decrementing its
+        ``times`` budget — a seed-generated schedule replays
+        deterministically against the same query sequence. Fired faults
+        are recorded in ``faults_fired`` as (kind, query).
+        """
+        compiled = []
+        for entry in schedule:
+            kind = entry.get("fault")
+            if kind not in self.FAULT_KINDS:
+                raise ValueError(f"unknown fault kind {kind!r} "
+                                 f"(one of {sorted(self.FAULT_KINDS)})")
+            e = dict(entry)
+            e.setdefault("times", 1)
+            e["_re"] = re.compile(e.get("match", ".*"))
+            compiled.append(e)
+        with self._lock:
+            self.fault_schedule.extend(compiled)
+
+    def clear_faults(self):
+        """Drop every un-consumed inject() entry."""
+        with self._lock:
+            self.fault_schedule.clear()
+
+    def _take_fault(self, query: str):
+        """First schedule entry matching `query` with budget left, or
+        None; decrements the budget and records the firing. Caller holds
+        _lock."""
+        for e in self.fault_schedule:
+            if e["times"] == 0:
+                continue
+            if not e["_re"].search(query):
+                continue
+            if e["times"] > 0:
+                e["times"] -= 1
+            self.faults_fired.append((e["fault"], query))
+            return e
+        return None
 
     # ── scenario helpers ──
     def add_idle_pod_series(
@@ -319,7 +409,13 @@ class FakePrometheus:
                 if h2_server.maybe_serve_h2(self, fake.transport):
                     self.close_connection = True
                     return
-                super().handle_one_request()
+                # drop_after faults raise BrokenPipeError from inside the
+                # handler (like a real mid-response disconnect); unwind
+                # quietly instead of a stderr traceback
+                try:
+                    super().handle_one_request()
+                except BrokenPipeError:
+                    self.close_connection = True
 
             def _respond(self, code: int, payload: dict):
                 body = json.dumps(payload).encode()
@@ -352,6 +448,31 @@ class FakePrometheus:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _fault_payload(self, flt, payload):
+                """stale_ts / dup_series response-shape faults: a
+                well-formed body whose DATA is untrustworthy."""
+                payload = copy.deepcopy(payload)
+                result = payload["data"]["result"]
+                if flt["fault"] == "stale_ts":
+                    # one semantic, two encodings: the data claims to be
+                    # `age_s` older than it is. Plain samples shift their
+                    # timestamp back; evidence "age" rows (whose VALUE is
+                    # the age) report age_s more — either way a
+                    # --signal-guard daemon must refuse to act on it.
+                    age = float(flt.get("age_s", 3600.0))
+                    for srs in result:
+                        if "value" not in srs:
+                            continue
+                        if srs.get("metric", {}).get("signal_stat") == "age":
+                            srs["value"] = [srs["value"][0],
+                                            str(float(srs["value"][1]) + age)]
+                        else:
+                            srs["value"] = [float(srs["value"][0]) - age,
+                                            srs["value"][1]]
+                elif flt["fault"] == "dup_series":
+                    payload["data"]["result"] = result + copy.deepcopy(result)
+                return payload
+
             def _handle_query(self, query: str):
                 if fake.hang_seconds:  # before the lock: other verbs stay live
                     time.sleep(fake.hang_seconds)
@@ -359,6 +480,26 @@ class FakePrometheus:
                     fake.queries.append(query)
                     fake.auth_headers.append(self.headers.get("Authorization"))
                     fake.traceparents.append(self.headers.get("traceparent"))
+                    # injected fault schedule (inject()): transport-level
+                    # kinds apply immediately; the data-shape kinds
+                    # (stale_ts/dup_series) arm and rewrite the payload
+                    # just before it is recorded + sent below
+                    flt = fake._take_fault(query)
+                    if flt is not None:
+                        kind = flt["fault"]
+                        if kind == "status":
+                            self._respond(int(flt.get("code", 503)),
+                                          {"status": "error",
+                                           "errorType": "internal",
+                                           "error": "injected fault (test)"})
+                            return
+                        if kind == "delay":
+                            time.sleep(float(flt.get("seconds", 1.0)))
+                        elif kind == "drop_after":
+                            self.wfile = _TruncatingFile(
+                                self.wfile, self.connection,
+                                int(flt.get("bytes", 0)))
+                            self.close_connection = True
                     if err := promql_structure_error(query):
                         # 400 like a real Prometheus parse error — feeds the
                         # daemon's failure budget instead of fake success
@@ -386,6 +527,9 @@ class FakePrometheus:
                             "data": {"resultType": "vector",
                                      "result": fake._evidence_result(idx)},
                         }
+                        if flt is not None and flt["fault"] in ("stale_ts",
+                                                                "dup_series"):
+                            payload = self._fault_payload(flt, payload)
                         body = json.dumps(payload).encode()
                         fake.evidence_bodies.append(body.decode())
                         self._send_query_body(payload, body)
@@ -423,6 +567,10 @@ class FakePrometheus:
                             "status": "success",
                             "data": {"resultType": "vector", "result": result},
                         }
+                        body = json.dumps(payload).encode()
+                    if flt is not None and flt["fault"] in ("stale_ts",
+                                                            "dup_series"):
+                        payload = self._fault_payload(flt, payload)
                         body = json.dumps(payload).encode()
                     fake.instant_queries_served += 1
                     fake.response_bodies.append(body.decode())
