@@ -241,3 +241,21 @@ tsan-chaos:
     cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
     ./build-tsan/tpupruner_tests backoff
     ./build-tsan/tpupruner_tests watchdog
+
+# event-dispatcher smoke: three scenarios against the real daemon
+# (metric flip patched in <1 s against a 60 s interval, event-vs-cycle
+# audit byte-identity on a quiesced cluster, --pause-after hysteresis
+# streak) — non-zero exit on any invariant miss, <60 s.
+# tests/test_justfile_guard.py pins the recipe to the module it invokes.
+event-smoke:
+    python -m tpu_pruner.testing.event_smoke
+
+# event-engine race tier: the timer wheel + sliding-window token bucket
+# (dispatcher advance vs informer-notify schedule/cancel, consumer
+# try_acquire vs /debug/timers stats reads) and the informer's dirty
+# journal under ThreadSanitizer (substring filter of the native test
+# binary)
+tsan-event:
+    cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
+    ./build-tsan/tpupruner_tests timerwheel
+    ./build-tsan/tpupruner_tests informer

@@ -64,6 +64,10 @@ enum class Reason : uint8_t {
   // abandoned at a phase boundary, not a judgment on the workload.
   CycleTimeout,         // CYCLE_TIMEOUT: cycle blew past --cycle-deadline;
                         // pending records landed unactuated
+  // Hysteresis (--pause-after K, promoted from the gym policy): the root
+  // IS idle and actionable, but its consecutive-idle streak has not
+  // reached K evaluations yet — the flap damper, not a veto.
+  HysteresisHold,       // HYSTERESIS_HOLD: idle streak below --pause-after
 };
 
 const char* reason_name(Reason r);

@@ -87,6 +87,33 @@ struct Cli {
   // breaker, brownout and --max-scale-per-cycle caps still apply per
   // cycle). "off" (default) keeps the strictly serial producer loop.
   std::string overlap = "off";
+  // --reconcile {cycle, event}: reconcile engine inversion. "cycle"
+  // (default) is the polling loop: evaluate everything every
+  // --check-interval seconds. "event" turns the engine into a streaming
+  // dataflow — informer dirty-journal notifications, Prometheus
+  // sample-fingerprint flips and timer-wheel deadline expiries each
+  // trigger an evaluation within milliseconds, while the old cycle
+  // survives only as a periodic full anti-entropy pass every
+  // --check-interval seconds (the informer relist analog). Every
+  // evaluation runs the same prepare/finish pipeline a polled cycle
+  // does, so audit/capsules/ledger/replay stay byte-identical on
+  // quiesced and replayed-churn corpora. Requires --watch-cache on
+  // (events come from the watch plane). Cross-evaluation gates
+  // (--max-scale-per-cycle) become sliding-window token buckets over
+  // one --check-interval with the same DEFERRED audit code.
+  std::string reconcile = "cycle";
+  // --sample-interval-ms (event mode): cadence of the cheap Prometheus
+  // probe query whose decoded-sample fingerprint flip triggers an
+  // evaluation — the detection path that decouples detect→action
+  // latency from --check-interval. Ignored under --reconcile cycle.
+  int64_t sample_interval_ms = 500;
+  // --pause-after K: hysteresis promoted from the gym policy — a root
+  // must be observed idle-and-actionable on K CONSECUTIVE evaluations
+  // before the pause lands (audit code HYSTERESIS_HOLD while the streak
+  // builds; any non-idle evaluation resets it). 1 (default) = exact
+  // parity with the pre-hysteresis daemon. Event mode wants K>1 so a
+  // flap-triggered evaluation cannot actuate on one sample.
+  int64_t pause_after = 1;
   // --incremental {on, off}: differential reconcile engine
   // (incremental.hpp). "on" fuses watch-event, sample-diff and
   // config/clock invalidation into per-root dirty marks and serves clean

@@ -193,6 +193,15 @@ class Engine {
   // attribute any drift to a specific dirty set.
   json::Value provenance_json(const Plan& plan) const;
 
+  // Timer-armed units: (unit key, deadline_unix) for every cached unit
+  // whose verdict flips by clock alone (BELOW_MIN_AGE pods leaving the
+  // lookback window). The event dispatcher (--reconcile event) arms these
+  // in its timer wheel so the flip re-evaluates at the deadline instead of
+  // waiting out the anti-entropy interval; the cycle engine never calls
+  // this (unit_dirty_locked self-dirties on the same clock). Sorted by
+  // key for deterministic scheduling order.
+  std::vector<std::pair<std::string, int64_t>> pending_deadlines() const;
+
   size_t unit_count() const;
   void reset();
 

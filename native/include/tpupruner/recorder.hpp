@@ -96,6 +96,13 @@ void record_ledger(uint64_t cycle, int64_t now_unix,
 // recomputes in full and never reads it; byte-identity comparisons
 // between --incremental modes normalize the "incremental" key away.
 void record_incremental(uint64_t cycle, json::Value provenance);
+// Event-engine provenance (--reconcile event): which trigger (dirty watch
+// burst, sample-flip probe, timer-wheel expiry, anti-entropy pass) opened
+// this logical capsule. Pure metadata like the incremental stamp — replay
+// never reads it, and byte-identity comparisons between --reconcile modes
+// normalize the "reconcile" key away. Never written in cycle mode, so
+// cycle-mode capsules are byte-identical to pre-event builds.
+void record_reconcile(uint64_t cycle, json::Value info);
 // Cycle facts: fail-closed veto sets, per-root gate flags, breaker stamp.
 void record_vetoes(uint64_t cycle, const std::vector<std::string>& vetoed_roots,
                    const std::vector<std::pair<std::string, std::string>>& vetoed_namespaces);

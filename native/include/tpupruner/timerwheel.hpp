@@ -1,0 +1,112 @@
+// Hierarchical timer wheel + sliding-window token buckets — the event
+// engine's time plane.
+//
+// Under --reconcile cycle, per-root deadlines (min-age expiry, lookback
+// boundaries, anti-entropy) are implicit: every cycle re-scans everything,
+// so "check again later" costs a full recompute per interval. Event mode
+// has no periodic re-scan to hide behind, so deadlines become explicit
+// entries in a hierarchical wheel (the kernel-timer shape: O(1) schedule/
+// cancel, expiries cascade down levels as time advances) and the
+// dispatcher sleeps until the earliest of {watch event, sample probe,
+// next timer}. Cross-evaluation gates (--max-scale-per-cycle) become
+// sliding-window token buckets: the same budget the per-cycle breaker
+// enforced, measured over one --check-interval window instead of one
+// cycle, with the same DEFERRED audit reason.
+//
+// Both structures are deterministic given the injected clock (callers
+// pass now_ms; nothing here reads the wall clock) so the simulator seam
+// (capi tp_timerwheel_sim) can drive them from tests byte-for-byte.
+#pragma once
+
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::timerwheel {
+
+// ── hierarchical wheel ──
+//
+// kLevels levels of kSlots slots each; level 0 slots span kTickMs, each
+// higher level spans kSlots x the level below. An entry lands in the
+// coarsest level whose horizon contains it and cascades toward level 0 as
+// advance() moves the clock, so a far-future deadline costs one slot hop
+// per level, not a per-tick re-sort. Keys are caller identities (root
+// paths); re-scheduling a key replaces its previous deadline.
+class Wheel {
+ public:
+  static constexpr int kLevels = 4;
+  static constexpr int kSlots = 64;
+  static constexpr int64_t kTickMs = 64;
+
+  explicit Wheel(int64_t origin_ms = 0);
+
+  // Arm (or re-arm) `key` to fire at `due_ms`. A due time at or before
+  // the current clock fires on the next advance().
+  void schedule(const std::string& key, int64_t due_ms);
+  // Disarm; false when the key was not scheduled.
+  bool cancel(const std::string& key);
+  // Move the clock to now_ms and collect every entry whose deadline
+  // passed, ordered by (due_ms, key) so expiry order is deterministic
+  // regardless of slot layout.
+  std::vector<std::string> advance(int64_t now_ms);
+  // Earliest armed deadline, or -1 when the wheel is empty — the
+  // dispatcher's sleep bound.
+  int64_t next_due() const;
+  size_t size() const;
+  // /debug/timers: clock, per-level occupancy, lifetime counters.
+  json::Value stats_json() const;
+
+ private:
+  struct Entry {
+    int64_t due_ms = 0;
+    int level = 0;
+    int slot = 0;
+  };
+  // Place an entry into the right (level, slot) for its distance from
+  // the current clock. Caller holds the lock.
+  void place(const std::string& key, int64_t due_ms);
+
+  mutable std::mutex mu_;
+  int64_t now_ms_ = 0;
+  std::unordered_map<std::string, Entry> entries_;
+  // slots_[level][slot] → keys parked there (unsorted; advance sorts).
+  std::vector<std::vector<std::vector<std::string>>> slots_;
+  uint64_t scheduled_total_ = 0;
+  uint64_t fired_total_ = 0;
+  uint64_t cancelled_total_ = 0;
+  uint64_t cascades_total_ = 0;
+};
+
+// ── sliding-window token bucket ──
+//
+// Exact sliding-window log (not a leaky-bucket approximation): a grant
+// timestamp ages out of the window after window_ms, so "at most N pauses
+// per --check-interval" holds over EVERY window position — strictly
+// tighter than the per-cycle breaker it replaces, never looser.
+class TokenBucket {
+ public:
+  // capacity 0 = unlimited (mirrors --max-scale-per-cycle 0).
+  TokenBucket(int64_t capacity, int64_t window_ms);
+
+  // Take one token at now_ms; false when the window is saturated.
+  bool try_acquire(int64_t now_ms);
+  // Tokens still grantable at now_ms (INT64_MAX when unlimited).
+  int64_t available(int64_t now_ms) const;
+  json::Value stats_json() const;
+
+ private:
+  void expire(int64_t now_ms) const;
+
+  mutable std::mutex mu_;
+  int64_t capacity_;
+  int64_t window_ms_;
+  mutable std::vector<int64_t> grants_;  // in-window grant times, oldest first
+  uint64_t granted_total_ = 0;
+  uint64_t denied_total_ = 0;
+};
+
+}  // namespace tpupruner::timerwheel

@@ -34,6 +34,7 @@
 #include "tpupruner/query.hpp"
 #include "tpupruner/shard.hpp"
 #include "tpupruner/signal.hpp"
+#include "tpupruner/timerwheel.hpp"
 #include "tpupruner/util.hpp"
 
 using tpupruner::json::Value;
@@ -1035,6 +1036,78 @@ char* tp_delta_sim(const char* payload_json) {
     Value out = Value::object();
     out.set("results", std::move(results));
     return ok(out);
+  });
+}
+
+char* tp_timerwheel_sim(const char* payload_json) {
+  // Deterministic harness for the event engine's time plane: drives the
+  // REAL hierarchical Wheel and sliding-window TokenBucket (timerwheel.cpp)
+  // through a scripted sequence under an injected clock, so the pytest
+  // tier can pin cascade behavior, expiry ordering, re-arm/cancel
+  // semantics, and window-edge token accounting without timing sleeps.
+  // Payload:
+  //   {"bucket": {"capacity": N, "window_ms": N}?, "origin_ms": N?,
+  //    "steps": [
+  //      {"op": "schedule", "key": "...", "due_ms": N},
+  //      {"op": "cancel", "key": "..."},
+  //      {"op": "advance", "now_ms": N},      // → {"fired": [...]}
+  //      {"op": "next_due"},                  // → {"next_due": N|-1}
+  //      {"op": "acquire", "now_ms": N},      // → {"granted": bool}
+  //      {"op": "available", "now_ms": N}     // → {"available": N}
+  //   ]}
+  // Returns {"results": [...], "wheel": <stats>, "bucket": <stats>?}.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    auto geti = [](const Value& v, const char* key) {
+      const Value* f = v.find(key);
+      if (!f || !f->is_number()) throw std::runtime_error(std::string("missing ") + key);
+      return f->as_int();
+    };
+    int64_t origin = 0;
+    if (const Value* v = p.find("origin_ms"); v && v->is_number()) origin = v->as_int();
+    tpupruner::timerwheel::Wheel wheel(origin);
+    std::unique_ptr<tpupruner::timerwheel::TokenBucket> bucket;
+    if (const Value* b = p.find("bucket")) {
+      bucket = std::make_unique<tpupruner::timerwheel::TokenBucket>(
+          geti(*b, "capacity"), geti(*b, "window_ms"));
+    }
+    auto need_bucket = [&]() -> tpupruner::timerwheel::TokenBucket& {
+      if (!bucket) throw std::runtime_error("step needs a bucket but none configured");
+      return *bucket;
+    };
+    const Value* steps = p.find("steps");
+    if (!steps || !steps->is_array()) throw std::runtime_error("missing steps");
+    Value results = Value::array();
+    for (const Value& step : steps->as_array()) {
+      std::string op = step.get_string("op");
+      Value r = Value::object();
+      if (op == "schedule") {
+        wheel.schedule(step.get_string("key"), geti(step, "due_ms"));
+        r.set("size", Value(static_cast<int64_t>(wheel.size())));
+      } else if (op == "cancel") {
+        r.set("cancelled", Value(wheel.cancel(step.get_string("key"))));
+      } else if (op == "advance") {
+        Value fired = Value::array();
+        for (const std::string& key : wheel.advance(geti(step, "now_ms"))) {
+          fired.push_back(Value(key));
+        }
+        r.set("fired", std::move(fired));
+      } else if (op == "next_due") {
+        r.set("next_due", Value(wheel.next_due()));
+      } else if (op == "acquire") {
+        r.set("granted", Value(need_bucket().try_acquire(geti(step, "now_ms"))));
+      } else if (op == "available") {
+        r.set("available", Value(need_bucket().available(geti(step, "now_ms"))));
+      } else {
+        throw std::runtime_error("unknown step op: " + op);
+      }
+      results.push_back(std::move(r));
+    }
+    Value out = Value::object();
+    out.set("results", std::move(results));
+    out.set("wheel", wheel.stats_json());
+    if (bucket) out.set("bucket", bucket->stats_json());
+    return ok(std::move(out));
   });
 }
 

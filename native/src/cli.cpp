@@ -126,6 +126,26 @@ TPU FLAGS:
                                 brownout) are unaffected; best with short
                                 --check-interval (prefetched evidence ages by
                                 up to one interval otherwise)
+      --reconcile <M>           cycle | event [default: cycle] — reconcile
+                                engine: "cycle" evaluates everything every
+                                --check-interval seconds; "event" turns the
+                                engine into a streaming dataflow — informer
+                                watch events, Prometheus sample-fingerprint
+                                flips and timer-wheel deadline expiries each
+                                trigger an evaluation within milliseconds,
+                                and the old cycle survives only as a periodic
+                                anti-entropy pass every --check-interval.
+                                Requires --daemon-mode and --watch-cache on.
+                                Output parity with "cycle" is byte-identical
+                                (audit JSONL, capsules, ledger, replay)
+      --sample-interval-ms <MS> event mode: cadence of the cheap Prometheus
+                                probe whose decoded-sample fingerprint flip
+                                triggers an evaluation [default: 500]
+      --pause-after <K>         hysteresis: a root must be observed idle on K
+                                CONSECUTIVE evaluations before the pause
+                                lands (HYSTERESIS_HOLD while the streak
+                                builds; any busy evaluation resets it).
+                                1 = no hysteresis, exact parity [default: 1]
       --incremental <M>         on | off [default: off] — differential
                                 reconcile: watch events, Prometheus sample
                                 diffs and config/clock edges mark roots dirty;
@@ -380,6 +400,22 @@ Cli parse(int argc, char** argv) {
          check_choice("--incremental", v, {"on", "off"});
          cli.incremental = v;
        }},
+      {"--reconcile",
+       [&](const std::string& v) {
+         check_choice("--reconcile", v, {"cycle", "event"});
+         cli.reconcile = v;
+       }},
+      {"--sample-interval-ms",
+       [&](const std::string& v) {
+         cli.sample_interval_ms = parse_int("--sample-interval-ms", v);
+         if (cli.sample_interval_ms < 10)
+           throw CliError("--sample-interval-ms must be >= 10");
+       }},
+      {"--pause-after",
+       [&](const std::string& v) {
+         cli.pause_after = parse_int("--pause-after", v);
+         if (cli.pause_after < 1) throw CliError("--pause-after must be >= 1");
+       }},
       {"--transport",
        [&](const std::string& v) {
          check_choice("--transport", v, {"auto", "h2", "http1"});
@@ -541,6 +577,19 @@ Cli parse(int argc, char** argv) {
     // invalidation source for cluster objects, and a cache that can go
     // silently stale is worse than a slow full recompute.
     throw CliError("--incremental on requires --watch-cache on");
+  }
+  if (cli.reconcile == "event" && cli.watch_cache != "on") {
+    // Event mode is driven by informer dirty-journal notifications —
+    // without the watch plane there is no event source, only polling.
+    throw CliError("--reconcile event requires --watch-cache on");
+  }
+  if (cli.reconcile == "event" && !cli.daemon_mode) {
+    throw CliError("--reconcile event requires --daemon-mode");
+  }
+  if (cli.reconcile == "event" && cli.overlap == "on") {
+    // Overlap pipelines adjacent polled cycles; event mode already runs
+    // evaluations on demand, so the prefetch would only age evidence.
+    throw CliError("--reconcile event and --overlap on are mutually exclusive");
   }
   if (!cli.prometheus_url.empty() && !cli.gcp_project.empty()) {
     throw CliError("--prometheus-url and --gcp-project are mutually exclusive");

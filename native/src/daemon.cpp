@@ -36,6 +36,7 @@
 #include "tpupruner/recorder.hpp"
 #include "tpupruner/shard.hpp"
 #include "tpupruner/signal.hpp"
+#include "tpupruner/timerwheel.hpp"
 #include "tpupruner/util.hpp"
 #include "tpupruner/walker.hpp"
 #include "tpupruner/watchdog.hpp"
@@ -54,6 +55,11 @@ struct QueuedTarget {
   uint64_t cycle = 0;
   // target_replicas 0 = scale-to-zero; > 0 = right-size patch (gym.hpp).
   ScalePlan plan;
+  // Monotonic ms when the condition driving this target's evaluation was
+  // detected (event mode: the trigger's arrival; cycle mode: evaluation
+  // start) — the consumer observes detect_to_action_seconds against it at
+  // patch time.
+  int64_t trigger_ms = 0;
 };
 
 // Bounded MPSC queue with close semantics (reference: tokio mpsc::channel
@@ -99,6 +105,38 @@ class TargetQueue {
 double secs_since(std::chrono::steady_clock::time_point since) {
   return std::chrono::duration<double>(std::chrono::steady_clock::now() - since).count();
 }
+
+// Monotonic milliseconds — the event engine's time plane (timer wheel,
+// token-bucket windows, detect→action stamps). Monotonic, not wall clock:
+// an NTP step must never fire or starve a deadline.
+int64_t mono_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Event-engine seams between daemon::run and the cycle pipeline (one
+// daemon::run per process; all three are reset by run() on entry):
+//  - g_trigger_ms: detection time of the condition driving the current
+//    evaluation; run()'s enqueue stamps it into each QueuedTarget.
+//  - g_event_bucket: --reconcile event swaps the per-cycle breaker budget
+//    for this sliding-window token bucket (same --max-scale-per-cycle
+//    capacity over one --check-interval window) — nullptr in cycle mode,
+//    so the classic per-cycle count is untouched.
+//  - g_event_full_pass: armed before an anti-entropy evaluation; the next
+//    resolve treats the entire candidate set as dirty (the full
+//    fingerprint pass that bounds how long event mode can drift).
+std::atomic<int64_t> g_trigger_ms{0};
+std::atomic<timerwheel::TokenBucket*> g_event_bucket{nullptr};
+std::atomic<bool> g_event_full_pass{false};
+
+// --pause-after hysteresis: per-root consecutive idle-evaluation streaks
+// (the gym policy's flap damper, promoted to the live engine). A root
+// actuates only once K consecutive evaluations found it idle and
+// actionable; absence from an evaluation's actionable set resets its
+// streak. Process-lifetime state, like the incremental engine's cache.
+std::mutex g_streaks_mutex;
+std::unordered_map<std::string, int64_t> g_streaks;
 
 // Fresh token each cycle, like the reference's per-cycle client rebuild
 // (main.rs:296, 377-388) — tokens rotate (SA projection, metadata server).
@@ -287,6 +325,9 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   const bool inc_on = incremental::engine().enabled();
   {
     auto cache_t0 = std::chrono::steady_clock::now();
+    // Consumed unconditionally so a stale arm can never leak into a later
+    // evaluation after the engine is toggled.
+    const bool full_pass = g_event_full_pass.exchange(false);
     if (inc_on) {
       informer::ClusterCache::DirtyDrain drain;
       if (watch_cache) {
@@ -294,6 +335,9 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
       } else {
         drain.all = true;  // no watch stream: nothing can vouch for object freshness
       }
+      // Anti-entropy (--reconcile event): re-fingerprint everything, as if
+      // globally dirty — the full pass that bounds event-mode drift.
+      if (full_pass) drain.all = true;
       inc_plan = incremental::engine().plan_cycle(samples, drain, now,
                                                   store_pods && store_owners);
     } else {
@@ -1387,6 +1431,44 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
     }
   }
 
+  // Hysteresis (--pause-after K): actuate a root only after K consecutive
+  // evaluations observed it idle and actionable — the flap damper that
+  // keeps a workload oscillating around the idle threshold from being
+  // paused on one excursion. In event mode, where a single sample flip
+  // re-evaluates within milliseconds, this is the shock absorber; the
+  // default K=1 admits every root immediately and emits no record, so
+  // cycle parity (and every replay corpus) is untouched.
+  if (args.pause_after > 1) {
+    std::lock_guard<std::mutex> streaks_lock(g_streaks_mutex);
+    std::unordered_map<std::string, int64_t> next_streaks;
+    std::vector<ScaleTarget> seasoned;
+    seasoned.reserve(survivors.size());
+    for (ScaleTarget& t : survivors) {
+      if (!(enabled & core::flag(t.kind))) {
+        seasoned.push_back(std::move(t));  // consumer records KIND_DISABLED
+        continue;
+      }
+      const std::string identity = t.identity();
+      auto it = g_streaks.find(identity);
+      const int64_t streak = (it == g_streaks.end() ? 0 : it->second) + 1;
+      next_streaks.emplace(identity, streak);
+      if (streak < args.pause_after) {
+        const std::string why = "idle streak " + std::to_string(streak) + " of " +
+                                std::to_string(args.pause_after) + " (--pause-after)";
+        log::info("daemon", "Hysteresis hold [" + std::string(core::kind_name(t.kind)) +
+                  "] " + t.ns().value_or("") + ":" + t.name() + ": " + why);
+        outcome.emplace(identity, std::make_pair(audit::Reason::HysteresisHold, why));
+        recorder::flag_root(cycle_id, identity, "hysteresis_hold");
+        continue;
+      }
+      seasoned.push_back(std::move(t));
+    }
+    // Roots absent this evaluation (busy again, scaled, vanished) drop out
+    // wholesale: the streak is CONSECUTIVE by construction.
+    g_streaks = std::move(next_streaks);
+    survivors = std::move(seasoned);
+  }
+
   // Blast-radius circuit breaker: a poisoned metric plane (scrape outage,
   // relabeling bug) can read the entire fleet as idle; cap how much of it
   // one cycle may pause. Deferred targets are re-discovered next cycle if
@@ -1394,6 +1476,11 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
   // counts only enabled-kind targets: disabled kinds pass through (the
   // consumer skips them, as in the reference) without consuming slots.
   if (args.max_scale_per_cycle > 0) {
+    // Event mode swaps the per-cycle count for a sliding-window token
+    // bucket: same capacity, measured over one --check-interval window, so
+    // back-to-back event evaluations cannot multiply the blast radius the
+    // flag was set to cap. Audit reason and detail are byte-identical.
+    timerwheel::TokenBucket* bucket = g_event_bucket.load();
     size_t budget = static_cast<size_t>(args.max_scale_per_cycle);
     size_t actionable = 0, deferred = 0;
     std::vector<ScaleTarget> capped;
@@ -1404,8 +1491,9 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
         continue;
       }
       ++actionable;
-      if (budget > 0) {
-        --budget;
+      const bool admit = bucket ? bucket->try_acquire(mono_ms()) : budget > 0;
+      if (admit) {
+        if (!bucket) --budget;
         capped.push_back(std::move(t));
       } else {
         ++deferred;
@@ -1864,6 +1952,30 @@ int run(const cli::Cli& args) {
   // warm-cycle connections per endpoint stays ≤ 1 instead of 1 per cycle.
   prom::Client prom_client = build_prom_client(args);
 
+  // ── event-engine state (--reconcile event) ──
+  // Declared before the watch cache and the consumers: the informer's
+  // dirty-notify callback and the consumer drain guard both outlive the
+  // dispatcher loop, so the signal block must outlive them (and it does —
+  // reflector threads stop before `ev` unwinds).
+  const bool event_on = args.reconcile == "event";
+  struct EventSignal {
+    std::mutex mu;
+    std::condition_variable cv;
+    uint64_t dirty_seq = 0;      // bumped once per informer journal mark
+    int64_t first_dirty_ms = 0;  // arrival of the oldest unconsumed mark
+    int64_t last_dirty_ms = 0;   // arrival of the newest (debounce clock)
+  } ev;
+  std::atomic<int64_t> inflight_actuations{0};
+  timerwheel::Wheel wheel(mono_ms());
+  timerwheel::TokenBucket event_bucket(args.max_scale_per_cycle,
+                                       std::max<int64_t>(args.check_interval, 1) * 1000);
+  g_event_bucket.store(event_on ? &event_bucket : nullptr);
+  g_event_full_pass.store(false);
+  {
+    std::lock_guard<std::mutex> lock(g_streaks_mutex);
+    g_streaks.clear();
+  }
+
   // Watch-backed cluster cache (--watch-cache=on): LIST each resource once,
   // hold watch streams, serve resolution from the local store. The initial
   // sync wait is best-effort — an unsynced resource just means its lookups
@@ -1873,8 +1985,24 @@ int run(const cli::Cli& args) {
   if (args.watch_cache == "on") {
     watch_cache = std::make_unique<informer::ClusterCache>(kube, informer::daemon_specs());
     // Dirty journal before start(): the initial LISTs must land their
-    // global-dirty marks, not slip through an un-enabled journal.
-    if (args.incremental == "on") watch_cache->enable_dirty_journal();
+    // global-dirty marks, not slip through an un-enabled journal. Event
+    // mode needs the journal even without --incremental — the marks are
+    // its wake signal.
+    if (args.incremental == "on" || event_on) watch_cache->enable_dirty_journal();
+    // Event dispatcher wake-up: every journal mark nudges the condition
+    // variable (outside the journal lock; the callback does nothing but
+    // stamp arrival times). Registered before start() — the reflector
+    // threads read the callback pointer without a lock.
+    if (event_on) {
+      watch_cache->set_dirty_notify([&ev] {
+        std::lock_guard<std::mutex> lock(ev.mu);
+        ++ev.dirty_seq;
+        const int64_t now = mono_ms();
+        if (ev.first_dirty_ms == 0) ev.first_dirty_ms = now;
+        ev.last_dirty_ms = now;
+        ev.cv.notify_all();
+      });
+    }
     watch_cache->start();
     if (watch_cache->wait_synced(10000)) {
       log::info("daemon", "watch cache synced (" +
@@ -1917,6 +2045,26 @@ int run(const cli::Cli& args) {
     // Evidence-health snapshot at /debug/signals (`analyze
     // --signal-report` hits this); {"enabled": false} with the guard off.
     metrics_server->set_signals_provider([] { return signal::signals_json().dump(); });
+    // Event-engine time plane at /debug/timers: wheel occupancy/counters +
+    // the sliding-window breaker bucket. Unset in cycle mode (404 with a
+    // hint), so the route doubles as a mode probe.
+    if (event_on) {
+      timerwheel::Wheel* wheel_ptr = &wheel;
+      timerwheel::TokenBucket* bucket_ptr = &event_bucket;
+      const int64_t sample_interval_ms = args.sample_interval_ms;
+      const int64_t anti_entropy_ms_cfg = std::max<int64_t>(args.check_interval, 1) * 1000;
+      metrics_server->set_timers_provider(
+          [wheel_ptr, bucket_ptr, sample_interval_ms, anti_entropy_ms_cfg] {
+            json::Value v = json::Value::object();
+            v.set("mode", json::Value("event"));
+            v.set("now_ms", json::Value(mono_ms()));
+            v.set("sample_interval_ms", json::Value(sample_interval_ms));
+            v.set("anti_entropy_ms", json::Value(anti_entropy_ms_cfg));
+            v.set("wheel", wheel_ptr->stats_json());
+            v.set("breaker_bucket", bucket_ptr->stats_json());
+            return v.dump();
+          });
+    }
     // Delta-federation journal (/debug/delta): serves O(churn) diffs of
     // the three debug surfaces to a polling hub, keyed by a monotonic
     // epoch with full-snapshot resync when a cursor ages out. Lazy: the
@@ -2064,6 +2212,21 @@ int run(const cli::Cli& args) {
       std::optional<QueuedTarget> item = queue.pop();
       if (!item) break;  // closed + drained
       ScaleTarget& t = item->target;
+      // Event-dispatcher drain tracking: every dequeued target decrements
+      // the in-flight count on EVERY exit path of this iteration and wakes
+      // the debounce wait — the dispatcher holds its next evaluation until
+      // the previous one's actuations have landed, so the evaluation sees
+      // the settled post-patch state (what makes a quiesced event run
+      // reproduce the polling engine's cycle sequence byte for byte).
+      struct Drained {
+        std::atomic<int64_t>& inflight;
+        EventSignal& ev;
+        ~Drained() {
+          --inflight;
+          std::lock_guard<std::mutex> lock(ev.mu);
+          ev.cv.notify_all();
+        }
+      } drained{inflight_actuations, ev};
       // Log lines of this actuation belong to the cycle that produced the
       // target, not whatever cycle the producer is on by now.
       log::set_thread_cycle(item->cycle);
@@ -2134,6 +2297,10 @@ int run(const cli::Cli& args) {
         }
         log::counter_add("scale_successes", 1);
         log::counter_add("right_sizes_total", 1);
+        if (item->trigger_ms > 0) {
+          log::histogram_observe("detect_to_action_seconds", args.reconcile,
+                                 (mono_ms() - item->trigger_ms) / 1000.0, opts.trace_id);
+        }
         log::info("daemon", "Right-sized Resource: [" + std::string(core::kind_name(t.kind)) +
                   "] - " + t.ns().value_or("default") + ":" + t.name() + " (" +
                   item->plan.detail + ")");
@@ -2167,6 +2334,12 @@ int run(const cli::Cli& args) {
         continue;
       }
       log::counter_add("scale_successes", 1);
+      // Detect→action: the headline event-mode histogram (cycle mode
+      // observes it too, from evaluation start, for cross-mode p50/p99).
+      if (item->trigger_ms > 0) {
+        log::histogram_observe("detect_to_action_seconds", args.reconcile,
+                               (mono_ms() - item->trigger_ms) / 1000.0, opts.trace_id);
+      }
       log::info("daemon", "Scaled Resource: [" + std::string(core::kind_name(t.kind)) + "] - " +
                 t.ns().value_or("default") + ":" + t.name());
       finish(audit::Reason::Scaled, "scale_down");
@@ -2208,6 +2381,148 @@ int run(const cli::Cli& args) {
     } catch (...) {
     }
   };
+  // ── event dispatcher (--reconcile event) ──
+  // Replaces the interval sleep at the bottom of the loop: instead of
+  // waking every --check-interval seconds, the producer blocks on a
+  // condition variable until one of four triggers fires, then runs the
+  // SAME prepare_cycle/finish_cycle pipeline the polling engine runs.
+  // Triggers, in priority order when several are due at once:
+  //   anti_entropy — the old cycle, demoted to a periodic full-fingerprint
+  //                  pass every max(--check-interval, 1) s since the last
+  //                  evaluation (failed evaluations also re-arm it, which
+  //                  paces retries exactly like the polling engine's
+  //                  failure budget expects);
+  //   timer        — a per-root deadline (BELOW_MIN_AGE lookback expiry)
+  //                  left the timer wheel;
+  //   dirty        — informer watch events, debounced: evaluate after
+  //                  kDebounceMs of quiet AND all in-flight actuations
+  //                  drained (our own patches echo back as watch events —
+  //                  waiting for the drain + quiet means the evaluation
+  //                  sees the settled post-actuation state, which is what
+  //                  makes a quiesced event run reproduce the polling
+  //                  engine's cycle sequence byte for byte), capped at
+  //                  kDebounceCapMs so a steady churn stream cannot starve
+  //                  evaluation;
+  //   probe        — a cheap idle-query fingerprint flip every
+  //                  --sample-interval-ms (the metric plane has no watch
+  //                  API; this is its event source).
+  std::string trigger = "anti_entropy";       // what woke the current evaluation
+  int64_t trigger_detect_ms = mono_ms();      // detection time (detect→action clock)
+  int64_t last_eval_ms = mono_ms();           // anti-entropy anchor
+  uint64_t consumed_dirty_seq = 0;            // dirty marks already folded in
+  const int64_t anti_entropy_ms = std::max<int64_t>(args.check_interval, 1) * 1000;
+  constexpr int64_t kDebounceMs = 80;
+  constexpr int64_t kDebounceCapMs = 2000;
+  // Order-independent fold of a decoded sample set: the probe must not
+  // care what order Prometheus returns series in, only whether any pod's
+  // (identity, value) pair changed, appeared, or vanished.
+  auto plane_fingerprint = [](const metrics::DecodeResult& d) {
+    uint64_t acc = 0xcbf29ce484222325ull ^ static_cast<uint64_t>(d.samples.size());
+    for (const core::PodMetricSample& smp : d.samples) {
+      acc += shard::stable_hash(smp.ns + "/" + smp.name) * 0x100000001b3ull ^
+             metrics::sample_fingerprint(smp);
+    }
+    return acc;
+  };
+  bool probe_fp_known = false;
+  uint64_t probe_fp = 0;
+  // One cheap instant query + decode + fingerprint. Returns true only on a
+  // flip AFTER a baseline exists — the first probe records and stays
+  // silent, and the baseline is the probe's OWN (never the signal-guarded
+  // evaluation view, whose veto filtering would make the two planes
+  // disagree forever on a guarded fleet and re-trigger every probe).
+  // Probe failures are log::debug noise, not failure-budget ticks: the
+  // anti-entropy pass carries the budget, exactly like a failed poll did.
+  auto probe_plane = [&]() -> bool {
+    try {
+      const json::Value resp = prom_client.instant_query(query, nullptr);
+      const uint64_t fp =
+          plane_fingerprint(metrics::decode_instant_vector(resp, args.device,
+                                                           cli::resolved_schema(args)));
+      if (!probe_fp_known) {
+        probe_fp_known = true;
+        probe_fp = fp;
+        return false;
+      }
+      if (fp == probe_fp) return false;
+      probe_fp = fp;
+      return true;
+    } catch (const std::exception& e) {
+      log::debug("daemon", std::string("metric-plane probe failed (anti-entropy pass "
+                                       "will retry): ") + e.what());
+      return false;
+    }
+  };
+  // Block until something warrants an evaluation; returns the trigger name
+  // and sets trigger_detect_ms. All deadlines — anti-entropy, probe, and
+  // per-root lookback expiries — live in the one timer wheel, so /debug/
+  // timers shows the complete time plane.
+  auto wait_for_trigger = [&]() -> std::string {
+    wheel.schedule("anti-entropy", last_eval_ms + anti_entropy_ms);
+    wheel.schedule("probe", mono_ms() + args.sample_interval_ms);
+    if (args.incremental == "on") {
+      const int64_t now_ms_0 = mono_ms();
+      const int64_t now_unix_0 = util::now_unix();
+      for (const auto& [key, deadline_unix] : incremental::engine().pending_deadlines()) {
+        wheel.schedule("deadline:" + key,
+                       now_ms_0 + std::max<int64_t>((deadline_unix - now_unix_0) * 1000, 0));
+      }
+    }
+    while (true) {
+      last_progress->store(util::mono_secs());  // waiting for events ≠ stalled
+      if (g_shutdown_signal) return "shutdown";
+      // Losing the lease is handled by the outer loop's standby branch;
+      // returning anti_entropy here just hands control back to it.
+      if (elector && !elector->is_leader()) return "anti_entropy";
+      const int64_t now = mono_ms();
+      bool anti_due = false;
+      bool probe_due = false;
+      bool timer_due = false;
+      for (const std::string& key : wheel.advance(now)) {
+        if (key == "anti-entropy") anti_due = true;
+        else if (key == "probe") probe_due = true;
+        else timer_due = true;
+      }
+      if (anti_due) {
+        trigger_detect_ms = mono_ms();
+        return "anti_entropy";
+      }
+      if (timer_due) {
+        trigger_detect_ms = mono_ms();
+        return "timer";
+      }
+      bool debouncing = false;
+      {
+        std::unique_lock<std::mutex> lock(ev.mu);
+        if (ev.dirty_seq != consumed_dirty_seq) {
+          debouncing = true;
+          const bool quiet = now - ev.last_dirty_ms >= kDebounceMs;
+          const bool drained = inflight_actuations.load() == 0;
+          const bool capped = ev.first_dirty_ms > 0 && now - ev.first_dirty_ms >= kDebounceCapMs;
+          if ((quiet && drained) || capped) {
+            trigger_detect_ms = ev.first_dirty_ms > 0 ? ev.first_dirty_ms : now;
+            return "dirty";
+          }
+        }
+      }
+      if (probe_due) {
+        if (probe_plane()) {
+          trigger_detect_ms = mono_ms();
+          return "probe";
+        }
+        wheel.schedule("probe", mono_ms() + args.sample_interval_ms);
+      }
+      // Sleep until the wheel's next deadline (never past 250 ms — the
+      // shutdown flag is signal-set and can't notify the cv; tighter while
+      // a dirty burst is debouncing so the quiet window is hit promptly).
+      int64_t sleep_ms = debouncing ? kDebounceMs / 2 : 250;
+      if (const int64_t next = wheel.next_due(); next >= 0) {
+        sleep_ms = std::min(sleep_ms, std::max<int64_t>(next - mono_ms(), 1));
+      }
+      std::unique_lock<std::mutex> lock(ev.mu);
+      ev.cv.wait_for(lock, std::chrono::milliseconds(sleep_ms));
+    }
+  };
   int consecutive_failures = 0;
   bool budget_exhausted = false;
   bool last_cycle_failed = false;
@@ -2234,6 +2549,12 @@ int run(const cli::Cli& args) {
       while (!g_shutdown_signal &&
              std::chrono::steady_clock::now() - cycle_start < std::chrono::seconds(1)) {
         std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      }
+      if (event_on) {
+        // First post-takeover evaluation is a full anti-entropy pass: the
+        // whole standby stretch of events was consumed without evaluating.
+        trigger = "anti_entropy";
+        trigger_detect_ms = mono_ms();
       }
       continue;
     }
@@ -2273,12 +2594,32 @@ int run(const cli::Cli& args) {
       }
     }
     last_cycle_failed = false;
+    if (event_on) {
+      // Stamp the trigger for this evaluation's enqueues (detect→action
+      // clock) and consume the dirty marks it will fold in. Anti-entropy
+      // passes force the incremental planner to a full re-fingerprint —
+      // the event engine's defense against a dropped watch event.
+      g_trigger_ms.store(trigger_detect_ms);
+      if (trigger == "anti_entropy") g_event_full_pass.store(true);
+      {
+        std::lock_guard<std::mutex> lock(ev.mu);
+        consumed_dirty_seq = ev.dirty_seq;
+        ev.first_dirty_ms = 0;
+      }
+      log::info("daemon", "event evaluation (trigger: " + trigger + ")");
+    } else {
+      g_trigger_ms.store(mono_ms());
+    }
     try {
       // Queue items carry their PRODUCING cycle explicitly: under
       // --overlap the global cycle counter already points at the next
       // prepared cycle while this one's targets enqueue.
       auto enqueue = [&](ScaleTarget t, ScalePlan plan, uint64_t cycle) {
-        queue.push({std::move(t), cycle, std::move(plan)});
+        // finish_cycle enqueues synchronously on this (producer) thread, so
+        // the trigger stamp set just before the evaluation is still the one
+        // this target belongs to (event+overlap is rejected at the CLI).
+        ++inflight_actuations;
+        queue.push({std::move(t), cycle, std::move(plan), g_trigger_ms.load()});
       };
       watchdog::arm();
       CycleStats stats;
@@ -2292,8 +2633,18 @@ int run(const cli::Cli& args) {
             });
         stats = finish_cycle(args, std::move(prep), kube, enabled, enqueue, watch_cache.get());
       } else {
-        stats = finish_cycle(args, prepare_cycle(args, query, evidence_query, &prom_client),
-                             kube, enabled, enqueue, watch_cache.get());
+        Prepared prep = prepare_cycle(args, query, evidence_query, &prom_client);
+        if (event_on) {
+          // Capsule provenance: which trigger opened this logical capsule.
+          // Only ever written in event mode — cycle-mode capsules stay
+          // byte-identical to pre-event builds, and cross-mode diffs
+          // normalize the "reconcile" key like the "incremental" one.
+          json::Value rv = json::Value::object();
+          rv.set("mode", json::Value("event"));
+          rv.set("trigger", json::Value(trigger));
+          recorder::record_reconcile(prep.cycle_id, std::move(rv));
+        }
+        stats = finish_cycle(args, std::move(prep), kube, enabled, enqueue, watch_cache.get());
       }
       watchdog::disarm();
       // Delta-federation journal: snapshot the debug surfaces into the
@@ -2342,12 +2693,23 @@ int run(const cli::Cli& args) {
         break;
       }
     }
+    if (event_on) {
+      // Failed evaluations observe too (latency of the attempt) and still
+      // advance the anti-entropy anchor — retries are paced at the
+      // interval, never hot-looped off a failing Prometheus.
+      log::histogram_observe("event_evaluation_seconds", trigger, secs_since(cycle_start));
+      last_eval_ms = mono_ms();
+    }
     last_progress->store(util::mono_secs());  // cycle completed (or failed cleanly)
     if (!args.daemon_mode) break;
     if (args.max_cycles > 0 && ++cycles_run >= args.max_cycles) {
       log::info("daemon", "Reached --max-cycles=" + std::to_string(args.max_cycles) +
                 ", exiting");
       break;
+    }
+    if (event_on) {
+      trigger = wait_for_trigger();
+      continue;  // loop top handles shutdown/standby
     }
     // Interruptible interval sleep: a signal handler can't safely notify a
     // condition variable, so poll the flag in short chunks instead of one
@@ -2396,6 +2758,7 @@ int run(const cli::Cli& args) {
     notifier.join();
   }
   if (watch_cache) watch_cache->stop();  // hang up the watch streams (≤250ms each)
+  g_event_bucket.store(nullptr);  // consumers are joined; drop the dangling-after-return pointer
   // Deviation from the reference (which exits 0 even when its only cycle
   // failed, main.rs:324-326): a failed single-shot run exits 1 so cron/CI
   // wrappers can detect it. Daemon mode exits 1 only on budget exhaustion.

@@ -322,6 +322,16 @@ json::Value Engine::provenance_json(const Plan& plan) const {
   return v;
 }
 
+std::vector<std::pair<std::string, int64_t>> Engine::pending_deadlines() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  std::vector<std::pair<std::string, int64_t>> out;
+  for (const auto& [key, u] : units_) {
+    if (u.deadline_unix > 0) out.emplace_back(key, u.deadline_unix);
+  }
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
 size_t Engine::unit_count() const {
   std::lock_guard<std::mutex> lock(mutex_);
   return units_.size();

@@ -308,6 +308,12 @@ constexpr size_t kDirtyJournalCap = 65536;
 
 void Reflector::enable_dirty_journal() { journal_enabled_.store(true); }
 
+void Reflector::set_dirty_notify(std::function<void()> notify) {
+  // Pre-start() only: the reflector thread reads this without a lock
+  // (thread creation is the happens-before edge).
+  dirty_notify_ = std::move(notify);
+}
+
 void Reflector::drain_dirty(std::vector<std::string>& paths, bool& all) const {
   std::lock_guard<std::mutex> lock(dirty_mutex_);
   if (dirty_all_) all = true;
@@ -318,15 +324,20 @@ void Reflector::drain_dirty(std::vector<std::string>& paths, bool& all) const {
 
 void Reflector::journal_touch(const std::string& path) {
   if (!journal_enabled_.load(std::memory_order_relaxed)) return;
-  std::lock_guard<std::mutex> lock(dirty_mutex_);
-  if (dirty_all_) return;  // already globally dirty; paths are redundant
-  if (dirty_paths_.size() >= kDirtyJournalCap) {
-    dirty_paths_.clear();
-    dirty_all_ = true;
-    ++journal_overflows_;
-    return;
+  {
+    std::lock_guard<std::mutex> lock(dirty_mutex_);
+    if (dirty_all_) {
+      // already globally dirty; paths are redundant — but the mark still
+      // notifies below: the dispatcher may not have drained yet.
+    } else if (dirty_paths_.size() >= kDirtyJournalCap) {
+      dirty_paths_.clear();
+      dirty_all_ = true;
+      ++journal_overflows_;
+    } else {
+      dirty_paths_.push_back(path);
+    }
   }
-  dirty_paths_.push_back(path);
+  if (dirty_notify_) dirty_notify_();  // outside the lock: wake, don't hold
 }
 
 uint64_t Reflector::journal_overflows() const {
@@ -338,9 +349,12 @@ size_t dirty_journal_cap() { return kDirtyJournalCap; }
 
 void Reflector::journal_all() {
   if (!journal_enabled_.load(std::memory_order_relaxed)) return;
-  std::lock_guard<std::mutex> lock(dirty_mutex_);
-  dirty_paths_.clear();
-  dirty_all_ = true;
+  {
+    std::lock_guard<std::mutex> lock(dirty_mutex_);
+    dirty_paths_.clear();
+    dirty_all_ = true;
+  }
+  if (dirty_notify_) dirty_notify_();
 }
 
 Reflector::Reflector(const k8s::Client& kube, ResourceSpec spec)
@@ -1123,6 +1137,10 @@ int64_t ClusterCache::staleness_secs() const {
 
 void ClusterCache::enable_dirty_journal() {
   for (auto& r : reflectors_) r->enable_dirty_journal();
+}
+
+void ClusterCache::set_dirty_notify(std::function<void()> notify) {
+  for (auto& r : reflectors_) r->set_dirty_notify(notify);
 }
 
 ClusterCache::DirtyDrain ClusterCache::drain_dirty() const {

@@ -161,6 +161,11 @@ void Server::set_signals_provider(std::function<std::string()> provider) {
   signals_provider_ = std::move(provider);
 }
 
+void Server::set_timers_provider(std::function<std::string()> provider) {
+  std::lock_guard<std::mutex> lock(probe_mutex_);
+  timers_provider_ = std::move(provider);
+}
+
 void Server::set_fleet_provider(
     std::function<std::string(const std::string&, const std::string&)> provider) {
   std::lock_guard<std::mutex> lock(probe_mutex_);
@@ -402,6 +407,20 @@ void Server::handle_connection(int fd) {
         status_text = "Not Found";
         body = "signal watchdog not available\n";
       }
+    } else if (path == "/debug/timers") {
+      std::function<std::string()> provider;
+      {
+        std::lock_guard<std::mutex> lock(probe_mutex_);
+        provider = timers_provider_;
+      }
+      if (provider) {
+        content_type = "application/json";
+        body = provider();
+      } else {
+        status = 404;
+        status_text = "Not Found";
+        body = "timer wheel not active (--reconcile event)\n";
+      }
     } else if (path == "/debug/delta") {
       std::function<std::string(const std::string&, const std::function<bool()>&)> provider;
       {
@@ -474,6 +493,9 @@ void Server::handle_connection(int fd) {
              "/debug/cycles/<id> serves one full capsule (--flight-dir)\"}," +
              "{\"path\":\"/debug/signals\",\"description\":\"signal-quality watchdog: per-pod "
              "evidence verdicts + fleet coverage (--signal-guard on)\"}," +
+             "{\"path\":\"/debug/timers\",\"description\":\"event-engine time plane: timer-"
+             "wheel occupancy, pending deadlines, token-bucket gate windows "
+             "(--reconcile event)\"}," +
              "{\"path\":\"/debug/delta\",\"description\":\"delta-federation change journal: "
              "?since=<epoch>&gen=<generation>&wait_ms=<long-poll> serves O(churn) surface "
              "diffs (full snapshot on first poll or aged-out cursor)\"}," +

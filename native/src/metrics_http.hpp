@@ -72,6 +72,11 @@ class Server {
   void set_fleet_provider(
       std::function<std::string(const std::string&, const std::string&)> provider);
 
+  // /debug/timers provider (the event engine's time plane: timer-wheel
+  // occupancy + token-bucket gate windows). Unset → 404 with a hint that
+  // the surface exists under --reconcile event.
+  void set_timers_provider(std::function<std::string()> provider);
+
   // /debug/delta provider (the delta-federation change journal): receives
   // the raw query string ("since=…&gen=…&wait_ms=…") and an abort
   // predicate (true once the server is stopping) the provider must poll
@@ -105,6 +110,7 @@ class Server {
   std::function<std::string(const std::string&)> workloads_provider_;
   std::function<std::string(const std::string&)> cycles_provider_;
   std::function<std::string()> signals_provider_;
+  std::function<std::string()> timers_provider_;
   std::function<std::string(const std::string&, const std::string&)> fleet_provider_;
   std::function<std::string(const std::string&, const std::function<bool()>&)>
       delta_provider_;

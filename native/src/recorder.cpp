@@ -53,6 +53,7 @@ struct OpenCapsule {
   Value breaker;                        // {limit, actionable, deferred, tripped}
   Value stats;                          // {num_series, num_pods, shutdown_events}
   Value incremental;                    // differential-engine provenance (dirty set, hits)
+  Value reconcile;                      // event-engine provenance (mode + trigger)
   std::vector<Value> decisions;         // verbatim DecisionRecord JSON
   bool armed = false;
   size_t remaining = 0;
@@ -177,6 +178,9 @@ void seal_locked(Registry& r, uint64_t cycle) {
   // never consults it — byte-identity comparisons across --incremental
   // modes normalize this key away, like ts/trace_id.
   if (!c.incremental.is_null()) doc.set("incremental", std::move(c.incremental));
+  // Same provenance-not-evidence contract for the event engine's trigger
+  // stamp: absent in cycle mode, normalized away in cross-mode diffs.
+  if (!c.reconcile.is_null()) doc.set("reconcile", std::move(c.reconcile));
   doc.set("decisions", std::move(decisions));
 
   fs::path final_path = fs::path(r.dir) / (id + ".json");
@@ -408,6 +412,14 @@ void record_incremental(uint64_t cycle, Value provenance) {
   OpenCapsule* c = open_capsule_locked(r, cycle);
   if (!c) return;
   c->incremental = std::move(provenance);
+}
+
+void record_reconcile(uint64_t cycle, Value info) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  c->reconcile = std::move(info);
 }
 
 void record_breaker(uint64_t cycle, int64_t limit, size_t actionable, size_t deferred) {

@@ -1,0 +1,213 @@
+#include "tpupruner/timerwheel.hpp"
+
+#include <algorithm>
+#include <climits>
+
+namespace tpupruner::timerwheel {
+
+Wheel::Wheel(int64_t origin_ms) : now_ms_(origin_ms) {
+  slots_.resize(kLevels);
+  for (auto& level : slots_) level.resize(kSlots);
+}
+
+void Wheel::place(const std::string& key, int64_t due_ms) {
+  // Distance in level-0 ticks decides the level: each level l covers
+  // kSlots^(l+1) ticks. Past-due entries park in the current level-0
+  // slot so the next advance() collects them.
+  int64_t delta = due_ms > now_ms_ ? due_ms - now_ms_ : 0;
+  int64_t ticks = delta / kTickMs;
+  int level = 0;
+  int64_t span = kSlots;  // ticks covered by level 0
+  while (level < kLevels - 1 && ticks >= span) {
+    ++level;
+    span *= kSlots;
+  }
+  // Slot within the level: absolute tick index scaled to the level's
+  // granularity, modulo the ring.
+  int64_t level_tick = kTickMs;
+  for (int l = 0; l < level; ++l) level_tick *= kSlots;
+  int slot = static_cast<int>((due_ms / level_tick) % kSlots);
+  slots_[level][slot].push_back(key);
+  entries_[key] = Entry{due_ms, level, slot};
+}
+
+void Wheel::schedule(const std::string& key, int64_t due_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = entries_.find(key);
+  if (it != entries_.end()) {
+    auto& parked = slots_[it->second.level][it->second.slot];
+    parked.erase(std::remove(parked.begin(), parked.end(), key), parked.end());
+    entries_.erase(it);
+  }
+  place(key, due_ms);
+  ++scheduled_total_;
+}
+
+bool Wheel::cancel(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = entries_.find(key);
+  if (it == entries_.end()) return false;
+  auto& parked = slots_[it->second.level][it->second.slot];
+  parked.erase(std::remove(parked.begin(), parked.end(), key), parked.end());
+  entries_.erase(it);
+  ++cancelled_total_;
+  return true;
+}
+
+std::vector<std::string> Wheel::advance(int64_t now_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (now_ms < now_ms_) now_ms = now_ms_;
+  std::vector<std::pair<int64_t, std::string>> fired;
+  // Tick walk with cascade — the O(1)-amortized common case. A clock
+  // jump wider than a few level-0 laps (first advance after construction,
+  // injected test clocks) skips the walk; the due-sweep below fires
+  // whatever the skipped cascades would have, with identical ordering.
+  if (now_ms - now_ms_ <= kTickMs * kSlots * 4) {
+    while (now_ms_ < now_ms) {
+      int64_t step = std::min<int64_t>(kTickMs, now_ms - now_ms_);
+      int slot0 = static_cast<int>((now_ms_ / kTickMs) % kSlots);
+      // Collect the current level-0 slot before moving off it.
+      auto due_here = std::move(slots_[0][slot0]);
+      slots_[0][slot0].clear();
+      for (auto& key : due_here) {
+        auto it = entries_.find(key);
+        if (it == entries_.end()) continue;
+        if (it->second.due_ms <= now_ms) {
+          fired.emplace_back(it->second.due_ms, key);
+          entries_.erase(it);
+          ++fired_total_;
+        } else {
+          // Same ring slot, later lap: re-park for a future pass.
+          slots_[0][slot0].push_back(key);
+        }
+      }
+      now_ms_ += step;
+      // Lap boundary on level l → cascade the matching slot of level
+      // l+1 down: its entries re-place against the advanced clock,
+      // landing in finer levels (or firing via the sweep below).
+      int64_t level_tick = kTickMs;
+      for (int l = 0; l + 1 < kLevels; ++l) {
+        level_tick *= kSlots;
+        if (now_ms_ % level_tick != 0) break;
+        int slot = static_cast<int>((now_ms_ / level_tick) % kSlots);
+        auto cascading = std::move(slots_[l + 1][slot]);
+        slots_[l + 1][slot].clear();
+        for (auto& key : cascading) {
+          auto it = entries_.find(key);
+          if (it == entries_.end()) continue;
+          int64_t due = it->second.due_ms;
+          entries_.erase(it);
+          place(key, due);
+          ++cascades_total_;
+        }
+      }
+    }
+  } else {
+    now_ms_ = now_ms;
+  }
+  // Sweep: anything armed at/before now fires even if its slot was
+  // never walked (huge jumps, schedule-in-the-past).
+  for (auto it = entries_.begin(); it != entries_.end();) {
+    if (it->second.due_ms <= now_ms) {
+      auto& parked = slots_[it->second.level][it->second.slot];
+      parked.erase(std::remove(parked.begin(), parked.end(), it->first),
+                   parked.end());
+      fired.emplace_back(it->second.due_ms, it->first);
+      ++fired_total_;
+      it = entries_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  std::sort(fired.begin(), fired.end());
+  std::vector<std::string> out;
+  out.reserve(fired.size());
+  for (auto& [due, key] : fired) out.push_back(std::move(key));
+  return out;
+}
+
+int64_t Wheel::next_due() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  int64_t best = -1;
+  for (const auto& [key, e] : entries_) {
+    if (best < 0 || e.due_ms < best) best = e.due_ms;
+  }
+  return best;
+}
+
+size_t Wheel::size() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return entries_.size();
+}
+
+json::Value Wheel::stats_json() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  json::Value v = json::Value::object();
+  v.set("now_ms", json::Value(now_ms_));
+  v.set("entries", json::Value(static_cast<int64_t>(entries_.size())));
+  v.set("levels", json::Value(static_cast<int64_t>(kLevels)));
+  v.set("slots_per_level", json::Value(static_cast<int64_t>(kSlots)));
+  v.set("tick_ms", json::Value(kTickMs));
+  json::Value per_level = json::Value::array();
+  for (int l = 0; l < kLevels; ++l) {
+    int64_t occupied = 0;
+    for (const auto& slot : slots_[l]) occupied += static_cast<int64_t>(slot.size());
+    per_level.push_back(json::Value(occupied));
+  }
+  v.set("entries_per_level", std::move(per_level));
+  int64_t best = -1;
+  for (const auto& [key, e] : entries_) {
+    if (best < 0 || e.due_ms < best) best = e.due_ms;
+  }
+  v.set("next_due_ms", json::Value(best));
+  v.set("scheduled_total", json::Value(static_cast<int64_t>(scheduled_total_)));
+  v.set("fired_total", json::Value(static_cast<int64_t>(fired_total_)));
+  v.set("cancelled_total", json::Value(static_cast<int64_t>(cancelled_total_)));
+  v.set("cascades_total", json::Value(static_cast<int64_t>(cascades_total_)));
+  return v;
+}
+
+TokenBucket::TokenBucket(int64_t capacity, int64_t window_ms)
+    : capacity_(capacity), window_ms_(window_ms < 1 ? 1 : window_ms) {}
+
+void TokenBucket::expire(int64_t now_ms) const {
+  auto first_live = std::lower_bound(grants_.begin(), grants_.end(),
+                                     now_ms - window_ms_ + 1);
+  grants_.erase(grants_.begin(), first_live);
+}
+
+bool TokenBucket::try_acquire(int64_t now_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (capacity_ <= 0) {  // unlimited, but still counted for stats
+    ++granted_total_;
+    return true;
+  }
+  expire(now_ms);
+  if (static_cast<int64_t>(grants_.size()) >= capacity_) {
+    ++denied_total_;
+    return false;
+  }
+  grants_.push_back(now_ms);
+  ++granted_total_;
+  return true;
+}
+
+int64_t TokenBucket::available(int64_t now_ms) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (capacity_ <= 0) return INT64_MAX;
+  expire(now_ms);
+  return capacity_ - static_cast<int64_t>(grants_.size());
+}
+
+json::Value TokenBucket::stats_json() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  json::Value v = json::Value::object();
+  v.set("capacity", json::Value(capacity_));
+  v.set("window_ms", json::Value(window_ms_));
+  v.set("in_window", json::Value(static_cast<int64_t>(grants_.size())));
+  v.set("granted_total", json::Value(static_cast<int64_t>(granted_total_)));
+  v.set("denied_total", json::Value(static_cast<int64_t>(denied_total_)));
+  return v;
+}
+
+}  // namespace tpupruner::timerwheel

@@ -230,6 +230,41 @@ def test_incremental_surfaces_documented(built):
         f"{missing}")
 
 
+def test_event_surfaces_documented():
+    """The event-dispatcher surfaces (ISSUE 16): the mode flag, the four
+    triggers, the hysteresis flag + reason, the probe interval, the
+    /debug/timers plane and both latency histograms must all appear in
+    the 'Event-driven reconcile' runbook — a sub-second detect→action
+    path is useless to an operator who cannot find its failure modes."""
+    doc = OPERATIONS.read_text()
+    needles = ("Event-driven reconcile", "--reconcile event",
+               "--reconcile cycle", "anti_entropy", "dirty", "timer",
+               "probe", "--pause-after", "HYSTERESIS_HOLD",
+               "--sample-interval-ms", "/debug/timers", "token bucket",
+               "tpu_pruner_detect_to_action_seconds",
+               "tpu_pruner_event_evaluation_seconds", "tp_timerwheel_sim",
+               "event-smoke")
+    missing = [n for n in needles if n not in doc]
+    assert not missing, (
+        f"event-reconcile surfaces missing from docs/OPERATIONS.md: "
+        f"{missing} — document each in the 'Event-driven reconcile' "
+        "section")
+
+
+def test_event_bench_summary_fields_documented():
+    """Event-mode bench fields must be in BENCH_FIELDS.md AND actually
+    emitted by bench.py — a drift on either side fails."""
+    bench_src = (REPO / "bench.py").read_text()
+    fields_doc = (REPO / "docs" / "BENCH_FIELDS.md").read_text()
+    for field in ("event_detect_to_action_p50_ms",
+                  "event_detect_to_action_p99_ms",
+                  "event_mega_detect_to_scaledown_s",
+                  "event_quiesced_cpu_ratio"):
+        assert f'"{field}"' in bench_src, f"bench.py no longer emits {field}"
+        assert field in fields_doc, (
+            f"bench summary field {field} missing from docs/BENCH_FIELDS.md")
+
+
 def test_delta_federation_surfaces_documented():
     """The delta-federation protocol surfaces (ISSUE 12): the member's
     /debug/delta endpoint + journal knob, the hub's delta/stream flags,

@@ -1,0 +1,608 @@
+"""Event-driven reconcile engine tests (the ISSUE 16 perf tentpole).
+
+``--reconcile event`` retires the polling cycle: informer watch events
+(the dirty journal), metric-plane probe fingerprint flips, and timer-wheel
+deadline expiries drive reconciliation as a streaming dataflow, with the
+old cycle demoted to a periodic full-fingerprint anti-entropy pass. The
+contract pinned here:
+
+  - audit JSONL and flight capsules are BYTE-IDENTICAL between
+    ``--reconcile event`` and ``cycle`` on a quiesced cluster, at shard
+    counts 1 and 8 (volatile clock/trace fields plus the capsule's
+    ``reconcile`` provenance stamp normalized — mode metadata, exactly
+    like the ``incremental`` stamp);
+  - event-mode capsules replay bit-for-bit offline (`analyze --replay`);
+  - a churned world converges to the SAME steady state in both modes
+    (final-cycle decisions + cluster scale state fingerprint), and the
+    ledger agrees on which roots were paused;
+  - detect→action latency is decoupled from --check-interval: a metric
+    flip actuates in well under a second against a 60 s interval;
+  - the cross-root breaker becomes a sliding-window token bucket with the
+    SAME audit reason + detail, never looser than the per-cycle cap;
+  - ``--pause-after K`` (hysteresis, both modes) holds actuation until K
+    consecutive idle evaluations; K=1 is exact parity;
+  - a chaos storm in event mode converges with zero scale actions in any
+    evaluation that saw untrusted evidence;
+  - the timer wheel + token bucket are deterministic under the injected
+    clock (the tp_timerwheel_sim seam).
+"""
+
+import json
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus, chaos
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def run_daemon(fake_prom, fake_k8s, *extra, run_mode="scale-down", cycles=2,
+               interval=1, reconcile="event"):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--prometheus-token", "ev-test", "--run-mode", run_mode,
+           "--watch-cache", "on", "--reconcile", reconcile,
+           "--daemon-mode", "--check-interval", str(interval),
+           "--max-cycles", str(cycles), *extra]
+    proc = subprocess.run(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc
+
+
+# The incremental-suite volatile set plus the capsule's "reconcile"
+# provenance stamp: it records WHICH trigger opened the logical capsule
+# and legitimately differs between modes, like a trace id.
+VOLATILE_KEYS = {"ts", "ts_unix", "ts_ms", "now_unix", "trace_id", "id",
+                 "incremental", "reconcile"}
+
+
+def _normalize(obj):
+    if isinstance(obj, dict):
+        return {k: _normalize(v) for k, v in obj.items()
+                if k not in VOLATILE_KEYS}
+    if isinstance(obj, list):
+        return [_normalize(v) for v in obj]
+    return obj
+
+
+def _mixed_cluster(fake_prom, fake_k8s):
+    """Multi-pod roots, a full idle slice (group gate), an orphan — every
+    decision path the byte-identity diff should cover."""
+    for i in range(5):
+        _, _, pods = fake_k8s.add_deployment_chain(
+            f"ml-{i % 2}", f"dep-{i}", num_pods=2, tpu_chips=4)
+        for pod in pods:
+            fake_prom.add_idle_pod_series(pod["metadata"]["name"],
+                                          f"ml-{i % 2}", chips=4)
+    _, slice_pods = fake_k8s.add_jobset_slice("tpu-jobs", "slice-0",
+                                              num_hosts=4, tpu_chips=4)
+    for pod in slice_pods:
+        fake_prom.add_idle_pod_series(pod["metadata"]["name"], "tpu-jobs",
+                                      chips=4)
+    fake_k8s.add_pod("ml-1", "orphan",
+                     owners=[fake_k8s.owner("DaemonSet", "ds-x")])
+    fake_prom.add_idle_pod_series("orphan", "ml-1")
+
+
+# ── CLI surface ────────────────────────────────────────────────────────
+
+
+def _expect_cli_error(fake_prom, fake_k8s, *args):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--prometheus-token", "t", *args]
+    proc = subprocess.run(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+    return proc.stderr
+
+
+def test_event_mode_cli_validations(built, fake_prom, fake_k8s):
+    """Event mode needs the informer (its wake signal) and the daemon
+    loop, and is mutually exclusive with --overlap (the pipelined prepare
+    would race the dispatcher's trigger bookkeeping)."""
+    err = _expect_cli_error(fake_prom, fake_k8s, "--reconcile", "event",
+                            "--daemon-mode", "--watch-cache", "off")
+    assert "--reconcile event requires --watch-cache on" in err
+    err = _expect_cli_error(fake_prom, fake_k8s, "--reconcile", "event",
+                            "--watch-cache", "on")
+    assert "requires --daemon-mode" in err
+    err = _expect_cli_error(fake_prom, fake_k8s, "--reconcile", "event",
+                            "--daemon-mode", "--watch-cache", "on",
+                            "--overlap", "on")
+    assert "mutually exclusive" in err
+    err = _expect_cli_error(fake_prom, fake_k8s, "--reconcile", "sometimes")
+    assert "--reconcile" in err
+    err = _expect_cli_error(fake_prom, fake_k8s, "--sample-interval-ms", "5")
+    assert "--sample-interval-ms" in err
+    err = _expect_cli_error(fake_prom, fake_k8s, "--pause-after", "0")
+    assert "--pause-after" in err
+
+
+# ── THE acceptance: byte-identity between event and cycle mode ─────────
+
+
+def test_event_vs_cycle_byte_identical_on_quiesced_cluster(
+        built, fake_prom, fake_k8s, tmp_path):
+    """The same quiesced cluster decided by the event dispatcher and by
+    the polling loop — at one shard and at eight — produces byte-identical
+    audit JSONL and flight capsules (dry-run: the fixture stays untouched,
+    so the only run-to-run differences are the normalized clock/trace
+    fields and the capsule's reconcile stamp)."""
+    _mixed_cluster(fake_prom, fake_k8s)
+
+    outputs = {}
+    for shards in (1, 8):
+        for mode in ("cycle", "event"):
+            audit = tmp_path / f"audit-{shards}-{mode}.jsonl"
+            flight = tmp_path / f"flight-{shards}-{mode}"
+            run_daemon(fake_prom, fake_k8s, "--shards", str(shards),
+                       "--audit-log", str(audit), "--flight-dir", str(flight),
+                       run_mode="dry-run", cycles=3, reconcile=mode)
+            records = [_normalize(json.loads(line))
+                       for line in audit.read_text().splitlines()]
+            capsules = [_normalize(json.loads(p.read_text()))
+                        for p in sorted(flight.glob("cycle-*.json"))]
+            assert records and len(capsules) == 3
+            outputs[(shards, mode)] = (
+                json.dumps(records, sort_keys=True),
+                json.dumps(capsules, sort_keys=True))
+
+    for shards in (1, 8):
+        cyc, ev = outputs[(shards, "cycle")], outputs[(shards, "event")]
+        assert cyc[0] == ev[0], f"audit JSONL differs at {shards} shard(s)"
+        assert cyc[1] == ev[1], f"capsules differ at {shards} shard(s)"
+
+
+def test_event_capsules_stamp_trigger_and_replay_bit_for_bit(
+        built, fake_prom, fake_k8s, tmp_path):
+    """Event-mode capsules carry the reconcile provenance stamp (mode +
+    trigger; the startup evaluation is an anti-entropy pass) and still
+    replay bit-for-bit offline — replay never reads the stamp."""
+    for i in range(3):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    flight = tmp_path / "flight"
+    run_daemon(fake_prom, fake_k8s, "--flight-dir", str(flight), cycles=2)
+
+    capsules = sorted(flight.glob("cycle-*.json"))
+    assert len(capsules) == 2
+    first = json.loads(capsules[0].read_text())
+    assert first["reconcile"]["mode"] == "event"
+    assert first["reconcile"]["trigger"] == "anti_entropy"
+    assert json.loads(capsules[1].read_text())["reconcile"]["trigger"] in (
+        "dirty", "anti_entropy", "probe", "timer")
+
+    for capsule in capsules:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_pruner.analyze", "--replay",
+             str(capsule)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert json.loads(proc.stdout)["match"] is True
+
+
+def test_cycle_mode_capsules_carry_no_reconcile_stamp(
+        built, fake_prom, fake_k8s, tmp_path):
+    """Cycle mode must stay byte-identical to pre-event builds: the
+    reconcile stamp never appears outside event mode."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "dep-0")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    flight = tmp_path / "flight"
+    run_daemon(fake_prom, fake_k8s, "--flight-dir", str(flight),
+               run_mode="dry-run", cycles=2, reconcile="cycle")
+    for p in flight.glob("cycle-*.json"):
+        assert "reconcile" not in json.loads(p.read_text())
+
+
+def _churned_run(mode, seed, tmp_path):
+    """One daemon run over a seeded churn schedule: deployments added and
+    roots externally resumed while the daemon runs, synced on capsule
+    seals so both modes see the same world history. Returns the converged
+    steady-state fingerprint plus the ledger's paused-root set."""
+    import random
+    rng = random.Random(seed)
+    schedule = [rng.choice(("add", "resume", "none")) for _ in range(6)]
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    state = tmp_path / f"churn-{mode}-{seed}"
+    flight = state / "flight"
+    audit = state / "audit.jsonl"
+    ledger = state / "ledger.jsonl"
+    state.mkdir(parents=True)
+    try:
+        for i in range(3):
+            _, _, pods = k8s.add_deployment_chain("gym", f"dep-{i}")
+            prom.add_idle_pod_series(pods[0]["metadata"]["name"], "gym")
+        cmd = [str(DAEMON_PATH), "--prometheus-url", prom.url,
+               "--prometheus-token", "ev-test", "--run-mode", "scale-down",
+               "--watch-cache", "on", "--reconcile", mode,
+               "--daemon-mode", "--check-interval", "1",
+               # Probes advance FakePrometheus's scripted-query counter;
+               # park them outside the run so both modes see the same
+               # per-evaluation query stream.
+               "--sample-interval-ms", "60000",
+               "--max-cycles", "14", "--flight-dir", str(flight),
+               "--flight-keep", "20", "--audit-log", str(audit),
+               "--ledger-file", str(ledger)]
+        proc = subprocess.Popen(cmd, env={"KUBE_API_URL": k8s.url},
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.PIPE, text=True)
+        try:
+            applied = 0
+            deadline = time.time() + 150
+            while proc.poll() is None and time.time() < deadline:
+                sealed = len(list(flight.glob("cycle-*.json")))
+                while applied < sealed and applied < len(schedule):
+                    action = schedule[applied]
+                    applied += 1
+                    if action == "add":
+                        _, _, pods = k8s.add_deployment_chain(
+                            "gym", f"late-{applied}")
+                        prom.add_idle_pod_series(
+                            pods[0]["metadata"]["name"], "gym")
+                    elif action == "resume":
+                        k8s.resume_root(
+                            "/apis/apps/v1/namespaces/gym/deployments/dep-0")
+                time.sleep(0.05)
+            proc.wait(timeout=30)
+            assert proc.returncode == 0, proc.stderr.read()[-2000:]
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        patched = {p for p, _ in k8s.scale_patches()}
+        return chaos.steady_state_fingerprint(audit, k8s), patched
+    finally:
+        prom.stop()
+        k8s.stop()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_churned_world_converges_identically_in_both_modes(
+        built, tmp_path, seed):
+    """Property: a seeded schedule of watch-event churn (new deployments,
+    external resumes) converges to the SAME steady state — final-cycle
+    decisions + cluster scale state — under the event dispatcher as under
+    the polling loop, and both modes paused the same roots. Event mode
+    runs MORE evaluations (that is the point), so the streams are compared
+    at the converged fixpoint, not evaluation-by-evaluation."""
+    cycle_fp, cycle_patched = _churned_run("cycle", seed, tmp_path)
+    event_fp, event_patched = _churned_run("event", seed, tmp_path)
+    assert cycle_fp == event_fp, f"steady state diverged for seed {seed}"
+    assert {p.rsplit("/", 2)[0] for p in cycle_patched} == \
+        {p.rsplit("/", 2)[0] for p in event_patched}
+
+
+# ── the headline: detect→action decoupled from --check-interval ────────
+
+
+def _start_event_daemon(fake_prom, fake_k8s, *extra):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--prometheus-token", "ev-test", "--run-mode", "scale-down",
+           "--watch-cache", "on", "--reconcile", "event",
+           "--daemon-mode", "--check-interval", "60",
+           "--metrics-port", "auto", *extra]
+    proc = subprocess.Popen(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    port = None
+    lines = []
+    deadline = time.time() + 30
+    while time.time() < deadline and port is None:
+        line = proc.stderr.readline()
+        lines.append(line)
+        if m := re.search(r"serving /metrics on port (\d+)", line):
+            port = int(m.group(1))
+    assert port, "".join(lines)[-2000:]
+    # keep draining stderr so the daemon never blocks on a full pipe
+    threading.Thread(target=lambda: [lines.append(l) for l in proc.stderr],
+                     daemon=True).start()
+    return proc, port, lines
+
+
+def test_metric_flip_actuates_in_milliseconds_despite_60s_interval(
+        built, fake_prom, fake_k8s):
+    """A pod's idle series appearing on the metric plane (probe trigger)
+    must reach the scale patch in well under a second while the polling
+    interval is 60 s — the detect→action acceptance. The latency lands in
+    the tpu_pruner_detect_to_action_seconds histogram."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "dep-0")
+    proc, port, lines = _start_event_daemon(fake_prom, fake_k8s,
+                                            "--sample-interval-ms", "100")
+    try:
+        time.sleep(1.5)  # startup anti-entropy done, probe baseline set
+        assert fake_k8s.scale_patches() == []
+        t0 = time.time()
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+        while time.time() - t0 < 10 and not fake_k8s.scale_patches():
+            time.sleep(0.02)
+        latency = time.time() - t0
+        assert fake_k8s.scale_patches(), "metric flip never actuated"
+        assert latency < 1.0, f"detect→action took {latency:.2f}s"
+        time.sleep(0.3)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert re.search(
+            r'tpu_pruner_detect_to_action_seconds_count\{[^}]*phase="event"'
+            r'[^}]*\} [1-9]', body), body[-2000:]
+        assert "tpu_pruner_event_evaluation_seconds_count" in body
+    finally:
+        proc.terminate()
+        proc.wait(timeout=20)
+
+
+def test_watch_event_triggers_evaluation_without_waiting_for_interval(
+        built, fake_prom, fake_k8s):
+    """An external resume (MODIFIED watch event on a paused root) is
+    re-paused within the dirty debounce window, not the 60 s interval."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    proc, _port, lines = _start_event_daemon(fake_prom, fake_k8s)
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and not fake_k8s.scale_patches():
+            time.sleep(0.05)
+        assert len(fake_k8s.scale_patches()) == 1
+        t0 = time.time()
+        fake_k8s.resume_root("/apis/apps/v1/namespaces/ml/deployments/trainer")
+        while time.time() - t0 < 10 and len(fake_k8s.scale_patches()) < 2:
+            time.sleep(0.02)
+        assert len(fake_k8s.scale_patches()) >= 2, "resume never re-paused"
+        assert time.time() - t0 < 5.0
+        assert any("(trigger: dirty)" in l for l in lines)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=20)
+
+
+# ── token-bucket gates: same budget, sliding window ────────────────────
+
+
+def test_token_bucket_caps_scale_rate_with_breaker_reason_codes(
+        built, fake_prom, fake_k8s, tmp_path):
+    """--max-scale-per-cycle N in event mode: at most N admissions per
+    --check-interval window, enforced by the sliding-window token bucket
+    with the SAME DEFERRED reason + detail as the per-cycle breaker —
+    and STRICTLY tighter: the dirty evaluation that follows the first
+    pause lands inside the window and admits NOTHING, where the per-cycle
+    breaker would have handed it a fresh budget."""
+    for i in range(3):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}")
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    audit = tmp_path / "audit.jsonl"
+    run_daemon(fake_prom, fake_k8s, "--max-scale-per-cycle", "1",
+               "--audit-log", str(audit), cycles=4)
+    assert len(fake_k8s.scale_patches()) >= 1
+    records = [json.loads(l) for l in audit.read_text().splitlines()]
+    by_cycle = {}
+    for r in records:
+        by_cycle.setdefault(r["cycle"], []).append(r["reason"])
+    # evaluation 1: one admission, two deferrals — same as the breaker
+    assert sorted(by_cycle[1]) == ["DEFERRED", "DEFERRED", "SCALED"]
+    deferred = [r for r in records if r["reason"] == "DEFERRED"]
+    assert all(r["detail"] == "over --max-scale-per-cycle=1"
+               for r in deferred), "bucket must reuse the breaker detail"
+    # evaluation 2 is the actuation-echo dirty pass, milliseconds into the
+    # 1 s window: the grant from evaluation 1 is still in the window, so
+    # ALL three targets defer (a per-cycle budget would admit one)
+    assert set(by_cycle[2]) == {"DEFERRED"}, by_cycle
+    # never more than one admission per evaluation anywhere
+    assert all(rs.count("SCALED") + rs.count("ALREADY_PAUSED") <= 1
+               for rs in by_cycle.values()), by_cycle
+
+
+# ── hysteresis: --pause-after K ────────────────────────────────────────
+
+
+def test_pause_after_holds_until_streak_then_pauses(
+        built, fake_prom, fake_k8s, tmp_path):
+    """--pause-after 3: two HYSTERESIS_HOLD evaluations (streak 1, 2),
+    then the pause lands on the third consecutive idle one."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    audit = tmp_path / "audit.jsonl"
+    run_daemon(fake_prom, fake_k8s, "--pause-after", "3",
+               "--audit-log", str(audit), cycles=4)
+    seq = [(r["cycle"], r["reason"]) for r in
+           map(json.loads, audit.read_text().splitlines())]
+    assert seq[:3] == [(1, "HYSTERESIS_HOLD"), (2, "HYSTERESIS_HOLD"),
+                       (3, "SCALED")], seq
+    assert len(fake_k8s.scale_patches()) == 1
+    details = [json.loads(l)["detail"] for l in
+               audit.read_text().splitlines()[:2]]
+    assert details == ["idle streak 1 of 3 (--pause-after)",
+                       "idle streak 2 of 3 (--pause-after)"]
+
+
+def test_pause_after_streak_resets_when_root_goes_busy(
+        built, fake_prom, fake_k8s, tmp_path):
+    """The streak counts CONSECUTIVE idle evaluations: a busy blip resets
+    it, so the root must re-earn the full K before pausing."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    # idle, idle, busy (sample absent — the fake's busy idiom), then idle
+    fake_prom.add_scripted_pod_series(pods[0]["metadata"]["name"], "ml",
+                                      [0.0, 0.0, None] + [0.0] * 9)
+    audit = tmp_path / "audit.jsonl"
+    run_daemon(fake_prom, fake_k8s, "--pause-after", "3",
+               "--audit-log", str(audit), cycles=7, reconcile="cycle")
+    reasons = [json.loads(l)["reason"] for l in
+               audit.read_text().splitlines()]
+    # cycles 1-2 hold, cycle 3 busy (no record or not-idle), 4-5 hold
+    # again from streak 1, cycle 6 pauses
+    assert reasons.count("HYSTERESIS_HOLD") == 4, reasons
+    assert "SCALED" in reasons
+    assert len(fake_k8s.scale_patches()) == 1
+
+
+def test_pause_after_default_is_exact_parity(built, fake_prom, fake_k8s,
+                                             tmp_path):
+    """K=1 (the default) must be indistinguishable from a build without
+    the flag: no HYSTERESIS_HOLD records, first idle evaluation pauses."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "trainer")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    audit = tmp_path / "audit.jsonl"
+    run_daemon(fake_prom, fake_k8s, "--audit-log", str(audit), cycles=2,
+               reconcile="cycle")
+    reasons = [json.loads(l)["reason"] for l in audit.read_text().splitlines()]
+    assert "HYSTERESIS_HOLD" not in reasons
+    assert reasons[0] == "SCALED"
+
+
+# ── chaos storm in event mode ──────────────────────────────────────────
+
+
+def test_event_mode_chaos_storm_never_scales_on_untrusted_evidence(
+        built, fake_prom, fake_k8s, tmp_path):
+    """A seeded fault storm driven through the event dispatcher: the run
+    converges (exit 0, failure budget intact) and no evaluation that saw
+    untrusted evidence (SIGNAL_* veto) contains a scale action — the
+    anti-entropy pass carries the recovery, events never bypass the
+    guard."""
+    for i in range(4):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}",
+                                                   tpu_chips=4)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml",
+                                      chips=4)
+    run = chaos.ChaosRun(fake_prom, fake_k8s, tmp_path,
+                         extra_args=("--signal-guard", "on",
+                                     "--watch-cache", "on",
+                                     "--reconcile", "event",
+                                     # last flag wins over ChaosRun's
+                                     # hardcoded --check-interval 0
+                                     "--check-interval", "1"))
+    sched = chaos.build_schedule(1107, rounds=4)
+    procs = chaos.run_chaos(sched, run, cycles_per_round=5)
+    for p in procs:
+        assert p.returncode == 0, p.stderr[-2000:]
+    records = [json.loads(l) for l in
+               run.audit_log.read_text().splitlines() if l.strip()]
+    assert records
+    by_cycle = {}
+    for r in records:
+        by_cycle.setdefault(r["cycle"], []).append(r)
+    for cycle, recs in by_cycle.items():
+        reasons = {r["reason"] for r in recs}
+        if reasons & {"SIGNAL_STALE", "SIGNAL_BROWNOUT", "SIGNAL_GAPPY"}:
+            assert "scale_down" not in {r["action"] for r in recs}, \
+                (cycle, recs)
+
+
+# ── /debug/timers + the sim seam ───────────────────────────────────────
+
+
+def test_debug_timers_serves_time_plane_in_event_mode_404_in_cycle(
+        built, fake_prom, fake_k8s):
+    """/debug/timers exposes the wheel + breaker bucket in event mode and
+    404s with a mode hint in cycle mode (the route doubles as a probe)."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "dep-0")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    proc, port, _ = _start_event_daemon(fake_prom, fake_k8s)
+    try:
+        time.sleep(1.5)
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/timers", timeout=10).read())
+        assert doc["mode"] == "event"
+        assert doc["wheel"]["entries"] >= 1  # anti-entropy always armed
+        assert doc["wheel"]["tick_ms"] == 64
+        assert doc["breaker_bucket"]["window_ms"] == 60000
+        assert doc["anti_entropy_ms"] == 60000
+    finally:
+        proc.terminate()
+        proc.wait(timeout=20)
+
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--prometheus-token", "t", "--run-mode", "dry-run",
+           "--daemon-mode", "--check-interval", "1", "--max-cycles", "30",
+           "--metrics-port", "auto"]
+    proc = subprocess.Popen(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        deadline = time.time() + 30
+        while time.time() < deadline and port is None:
+            if m := re.search(r"serving /metrics on port (\d+)",
+                              proc.stderr.readline()):
+                port = int(m.group(1))
+        assert port
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/debug/timers",
+                                   timeout=10)
+        assert exc.value.code == 404
+        assert "--reconcile event" in exc.value.read().decode()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=20)
+
+
+def test_timerwheel_sim_deterministic_expiry_and_window(built):
+    """The ctypes seam drives the REAL wheel + bucket under an injected
+    clock: due-order expiry, cascade through coarse levels, exact
+    window-edge token accounting — byte-for-byte deterministic."""
+    steps = [
+        {"op": "schedule", "key": "b", "due_ms": 200},
+        {"op": "schedule", "key": "a", "due_ms": 100},
+        {"op": "schedule", "key": "deep", "due_ms": 50000},
+        {"op": "next_due"},
+        {"op": "advance", "now_ms": 300},
+        {"op": "advance", "now_ms": 60000},
+        {"op": "acquire", "now_ms": 0},
+        {"op": "acquire", "now_ms": 10},
+        {"op": "acquire", "now_ms": 999},
+        {"op": "acquire", "now_ms": 1000},
+        {"op": "available", "now_ms": 1005},
+    ]
+    out = native.timerwheel_sim(steps, bucket={"capacity": 2,
+                                               "window_ms": 1000})
+    results = out["results"]
+    assert results[3] == {"next_due": 100}
+    assert results[4] == {"fired": ["a", "b"]}  # due order, not insert order
+    assert results[5] == {"fired": ["deep"]}
+    assert [r["granted"] for r in results[6:10]] == [True, True, False, True]
+    assert results[10] == {"available": 0}  # grants at 10 and 1000 in window
+    assert out["wheel"]["fired_total"] == 3
+    assert out["bucket"]["denied_total"] == 1
+    # determinism: an identical script replays to identical results
+    assert native.timerwheel_sim(
+        steps, bucket={"capacity": 2, "window_ms": 1000}) == out
+
+
+def test_event_mode_quiesced_daemon_runs_no_spurious_evaluations(
+        built, fake_prom, fake_k8s):
+    """Once quiesced (everything paused, no churn, no metric flips), the
+    dispatcher runs ONLY anti-entropy evaluations — the interval governs
+    the idle evaluation rate, not a busy-poll."""
+    _, _, pods = fake_k8s.add_deployment_chain("ml", "dep-0")
+    fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml")
+    proc = run_daemon(fake_prom, fake_k8s, cycles=5, interval=1)
+    triggers = re.findall(r"event evaluation \(trigger: (\w+)\)",
+                          proc.stderr)
+    assert len(triggers) == 5
+    assert triggers[0] == "anti_entropy"
+    # evaluation 2 folds in the actuation echo; after that, anti-entropy only
+    assert set(triggers[2:]) == {"anti_entropy"}, triggers

@@ -390,3 +390,42 @@ def test_just_verify_matches_roadmap_tier1():
         f"  roadmap:  {roadmap}\n"
         f"  justfile: {justfile}\n"
         "Update the justfile recipe (or ROADMAP.md) so they match verbatim.")
+
+
+def test_event_smoke_recipe_present_and_wired():
+    """`just event-smoke` must exist and invoke the real smoke module —
+    the event-dispatcher contract (sub-second detect→action against a
+    60 s interval, event-vs-cycle audit byte-identity, --pause-after
+    hysteresis) would otherwise go unguarded in CI."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^event-smoke\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `event-smoke:` recipe"
+    assert "tpu_pruner.testing.event_smoke" in m.group(1), (
+        "event-smoke no longer invokes tpu_pruner.testing.event_smoke")
+    import importlib
+
+    module = importlib.import_module("tpu_pruner.testing.event_smoke")
+    assert callable(module.main)
+
+
+def test_tsan_event_recipe_present_and_wired():
+    """`just tsan-event` must exist and run the timer-wheel + token
+    bucket native tests under ThreadSanitizer — the dispatcher advances
+    the wheel while the informer's notify path schedules into it and the
+    consumer races the breaker bucket against /debug/timers stats reads;
+    exactly the concurrency TSan exists to check."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^tsan-event\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `tsan-event:` recipe"
+    body = m.group(1)
+    assert "-DTP_TSAN=ON" in body, "tsan-event no longer builds with TSan"
+    assert re.search(r"tpupruner_tests\s+timerwheel", body), (
+        "tsan-event no longer runs the native timerwheel tests")
+    assert re.search(r"tpupruner_tests\s+informer", body), (
+        "tsan-event no longer runs the native informer tests")
+    src = (REPO / "native" / "tests" / "test_timerwheel.cpp").read_text()
+    assert "timerwheel_concurrent_schedule_advance" in src, (
+        "test_timerwheel.cpp lost its concurrency test — tsan-event would "
+        "vacuously pass")

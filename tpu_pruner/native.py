@@ -173,6 +173,7 @@ def load() -> ctypes.CDLL:
         "tp_fleet_aggregate",
         "tp_stamp_exposition",
         "tp_delta_sim",
+        "tp_timerwheel_sim",
         "tp_replay_cycle",
         "tp_gym_simulate",
         "tp_right_size_plan",
@@ -458,6 +459,26 @@ def delta_sim(steps: list[dict], log_cap: int | None = None) -> list[dict]:
     if log_cap is not None:
         payload["log_cap"] = log_cap
     return _call("tp_delta_sim", payload)["results"]
+
+
+def timerwheel_sim(steps: list[dict], bucket: dict | None = None,
+                   origin_ms: int = 0) -> dict:
+    """Drive the event engine's REAL time plane (native/src/timerwheel.cpp)
+    — the hierarchical timer wheel and the sliding-window token bucket —
+    through a scripted sequence under an injected clock. Steps:
+      {"op": "schedule", "key": k, "due_ms": N}
+      {"op": "cancel", "key": k}
+      {"op": "advance", "now_ms": N}    -> {"fired": [keys...]}
+      {"op": "next_due"}                -> {"next_due": N | -1}
+      {"op": "acquire", "now_ms": N}    -> {"granted": bool}
+      {"op": "available", "now_ms": N}  -> {"available": N}
+    ``bucket`` is {"capacity": N, "window_ms": N} (required for acquire/
+    available steps). Returns {"results": [...], "wheel": stats,
+    "bucket": stats?} — deterministic byte-for-byte, no sleeps."""
+    payload: dict = {"steps": steps, "origin_ms": origin_ms}
+    if bucket is not None:
+        payload["bucket"] = bucket
+    return _call("tp_timerwheel_sim", payload)
 
 
 def replay_cycle(capsule: dict, what_if: dict | None = None) -> dict:
