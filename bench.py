@@ -2227,6 +2227,88 @@ def run_policy_gym():
     }
 
 
+def run_capacity_section():
+    """Capacity-observatory section: record a `defrag` trace_gen corpus
+    (3 single-tenant slices draining one at a time + 1 spare slice with
+    no pods) with `--capacity on`, then replay the defragmentation
+    report from the capsules' capacity stamps. Asserted: zero byte
+    drift between recorded and recomputed inventories, and the report's
+    after-moves whole-free count = spare + 3 drained slices."""
+    import json as _json
+    import statistics as _statistics
+    import subprocess as _subprocess
+    import sys as _sys
+    import tempfile
+    import time as _time
+    from pathlib import Path as _Path
+
+    from tpu_pruner import native as _native
+    from tpu_pruner.testing import trace_gen
+
+    cycles = 12 if SMOKE else 24
+    tmp = _Path(tempfile.mkdtemp(prefix="tp-bench-capacity-"))
+    spec = trace_gen.generate("defrag", cycles, workloads=3, seed=7)
+    spec["slices"].append({"pool": "slice-spare", "topology": "2x2",
+                           "nodes": ["slice-spare-node-0"]})
+    t0 = _time.monotonic()
+    capsules = trace_gen.record_corpus(spec, tmp / "flight",
+                                       extra_args=("--capacity", "on"))
+    record_s = _time.monotonic() - t0
+    if len(capsules) != cycles:
+        raise RuntimeError(
+            f"capacity corpus recorded {len(capsules)}/{cycles} capsules")
+
+    stamps = []
+    for path in capsules:
+        c = _json.loads(path.read_text())
+        stamp = c.get("capacity")
+        if stamp is None:
+            raise RuntimeError(f"capsule {path.name} has no capacity stamp "
+                               "(daemon ignored --capacity on?)")
+        stamps.append({"cycle": c.get("cycle"), "now_unix": c.get("now_unix"),
+                       "inputs": stamp.get("inputs"), "doc": stamp.get("doc")})
+
+    walls = []
+    for _ in range(5):
+        t0 = _time.monotonic()
+        report = _native.capacity_report(stamps)
+        walls.append(_time.monotonic() - t0)
+    if report["drift"]:
+        raise RuntimeError("capacity report drift: recomputed inventories "
+                           f"diverge at cycles {report['drifted_cycles']}")
+    cons = report["consolidation"]
+    if cons["whole_free_slices_after"] != 4:
+        raise RuntimeError(
+            "defrag report expected 4 whole-free slices after moves "
+            f"(1 spare + 3 drained), got {cons['whole_free_slices_after']}")
+
+    # One full CLI pass: same corpus through `analyze --capacity-report`
+    # (exits non-zero on drift or missing stamps).
+    proc = _subprocess.run(
+        [_sys.executable, "-m", "tpu_pruner.analyze",
+         "--capacity-report", str(tmp / "flight")],
+        capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"analyze --capacity-report exited {proc.returncode}: "
+            f"{proc.stderr[-500:]}")
+
+    return {
+        "capacity_cycles": cycles,
+        "capacity_whole_free_slices": cons["whole_free_slices_after"],
+        "capacity_defrag_report_p50_ms": round(
+            _statistics.median(walls) * 1000, 2),
+        "capacity_consolidatable_slices": cons["freed_whole_slices"],
+        "capacity_chip_hours": cons["chip_hours"],
+        "capacity_moves": len(report["moves"]),
+        "capacity_corpus_record_s": round(record_s, 3),
+        "note": f"{cycles}-cycle defrag corpus (3 tenant slices + 1 spare, "
+                "staggered drain, --capacity on) recorded by the real "
+                "daemon; report replayed bit-for-bit from capsule stamps "
+                "(5 reps) + one analyze --capacity-report CLI pass",
+    }
+
+
 def measure_fixture_ceiling(k8s, seconds=1.5, threads=8):
     """Standalone serving ceiling of the fake apiserver (VERDICT r4 #7).
 
@@ -3103,6 +3185,19 @@ def main():
         gym = {"error": str(e)[-500:]}
         log(f"policy gym section failed: {e}")
 
+    # Capacity observatory: defrag corpus → bit-for-bit report replay.
+    # Failures degrade to a recorded error, like the gym section.
+    try:
+        capacity = run_capacity_section()
+        log(f"capacity: {capacity['capacity_cycles']}-cycle defrag corpus — "
+            f"{capacity['capacity_whole_free_slices']} whole-free slices "
+            f"after {capacity['capacity_moves']} moves "
+            f"({capacity['capacity_chip_hours']:.2f} chip-hrs), report p50 "
+            f"{capacity['capacity_defrag_report_p50_ms']}ms")
+    except Exception as e:  # noqa: BLE001 — any fixture failure degrades
+        capacity = {"error": str(e)[-500:]}
+        log(f"capacity section failed: {e}")
+
     # Mega tier: 50k+ pods through the sharded, pipelined engine.
     # Failures degrade to a recorded error like the federation/gym
     # sections — but the targets (warm p50 <100 ms, O(churn) steady
@@ -3204,6 +3299,7 @@ def main():
         "watch_cache": watch_cache,
         "fleet_federation": fleet_fed,
         "policy_gym": gym,
+        "capacity": capacity,
         "mega": mega,
         "planet": planet,
         "baseline_model": {"ref_wall_s": round(ref_wall, 3),
@@ -3272,6 +3368,12 @@ def main():
         "gym_cycles_per_s": gym.get("gym_cycles_per_s"),
         "gym_best_policy_reclaimed_chip_hours": gym.get(
             "gym_best_policy_reclaimed_chip_hours"),
+        # capacity observatory: whole-free slices after the defrag
+        # report's moves + the report engine's replay latency
+        "capacity_whole_free_slices": capacity.get(
+            "capacity_whole_free_slices"),
+        "capacity_defrag_report_p50_ms": capacity.get(
+            "capacity_defrag_report_p50_ms"),
         # mega tier: the 50k-pod sharded-engine numbers (full block incl.
         # the shard curve and per-phase percentiles in the detail file)
         "mega_pods": mega.get("mega_pods"),

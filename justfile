@@ -113,6 +113,15 @@ fleet-mega:
 gym-smoke:
     python -m tpu_pruner.testing.gym_smoke
 
+# capacity-observatory smoke: one --capacity on member over a sliced
+# fixture (1 whole-free spare + 2 consolidatable tenant slices) → the
+# member /debug/capacity inventory, the hub /debug/fleet/capacity
+# rollup and the bit-for-bit `analyze --capacity-report` defrag replay
+# asserted end to end. tests/test_justfile_guard.py pins the recipe to
+# the module it invokes.
+capacity-smoke:
+    python -m tpu_pruner.testing.capacity_smoke
+
 # mega-bench smoke: the 50k-pod tier scaled down to 10,240 pods so CI can
 # run it in minutes — every tier target is still asserted inside
 # run_mega_tier (shard resolve speedup >1 on multi-core hosts, capsules

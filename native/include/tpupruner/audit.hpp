@@ -68,6 +68,11 @@ enum class Reason : uint8_t {
   // IS idle and actionable, but its consecutive-idle streak has not
   // reached K evaluations yet — the flap damper, not a veto.
   HysteresisHold,       // HYSTERESIS_HOLD: idle streak below --pause-after
+  // Slice-topology group gate (--slice-gate, capacity.hpp): the root IS
+  // idle, but one of its idle pods shares a TPU slice (node-pool) with a
+  // busy tenant — evicting it would fragment a slice that cannot become
+  // whole anyway.
+  SliceSharedBusy,      // SLICE_SHARED_BUSY: idle pods share a slice with a busy tenant
 };
 
 const char* reason_name(Reason r);

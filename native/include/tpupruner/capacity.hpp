@@ -1,0 +1,125 @@
+// Capacity observatory: the live free-capacity inventory behind
+// /debug/capacity, the tpu_pruner_capacity_* metric families, the fourth
+// delta-journaled fleet surface, and the replayable defragmentation
+// report.
+//
+// Pruning's chief output — freed TPU capacity — was invisible: the
+// ledger knows what was reclaimed, but nothing published what is free
+// RIGHT NOW, where, and in what shape. Shape matters because multi-host
+// slices are only schedulable whole (MLPerf TPU-pod scaling, arxiv
+// 1909.09756): 3 idle chips scattered across three 4-chip slices are
+// worth far less than one whole free slice. ParvaGPU (arxiv 2409.14447)
+// treats reclaimed accelerator capacity as supply to be packed; this
+// module is the supply ledger for that view.
+//
+// Everything observable is derived from a canonical, order-normalized
+// Inputs record (nodes with their node-pool/slice-topology labels, TPU
+// pod placements with idleness + owning root, freed ledger accounts).
+// build() is a PURE function of Inputs — the daemon stamps the result
+// with its cluster identity and republishes per evaluation; the recorder
+// stamps {inputs, doc} into the flight capsule so `analyze
+// --capacity-report` can recompute the document bit-for-bit and score
+// consolidation with the gym's dt-integration ledger math.
+//
+// Slice semantics (one GKE node-pool == one TPU slice):
+//   whole_free     zero occupied chips — schedulable as a whole slice
+//   partial_idle   occupied, but some occupied chips belong to idle roots
+//                  (or some capacity is unallocated) — the defrag signal
+//   busy           every chip accounted to non-idle tenants, none free
+//   consolidatable partial_idle AND every occupied chip belongs to idle
+//                  roots: pausing/right-sizing its tenants frees the
+//                  WHOLE slice.
+//
+// The slice-topology group gate (satellite of the same PR) rides on the
+// same Inputs: a root whose idle pods share a slice with a BUSY tenant
+// is spared (audit reason SLICE_SHARED_BUSY) — evicting it would
+// fragment a slice that cannot become whole anyway.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::capacity {
+
+// The FIXED audit detail for SLICE_SHARED_BUSY — shared verbatim by the
+// daemon gate and capsule replay so replayed outcomes are byte-identical.
+inline constexpr const char* kSliceSharedBusyDetail =
+    "slice has busy co-tenants (slice gate)";
+
+// One TPU node (slice host) observed via /api/v1/nodes.
+struct NodeFact {
+  std::string name;
+  std::string pool;      // cloud.google.com/gke-nodepool → slice identity
+  std::string topology;  // cloud.google.com/gke-tpu-topology ("" unknown)
+  int64_t chips = 0;     // allocatable google.com/tpu
+};
+
+// One TPU-requesting pod placed on a node.
+struct PlacementFact {
+  std::string pod;   // "ns/name"
+  std::string node;  // spec.nodeName ("" unscheduled → ignored by build)
+  int64_t chips = 0;
+  bool idle = false;  // member of this evaluation's idle+eligible set
+  std::string root;   // owning root "Kind/ns/name" ("" unresolved)
+};
+
+// One ledger account whose capacity is currently freed by an actuation.
+struct FreedFact {
+  std::string kind, ns, name;
+  int64_t chips = 0;
+  std::string state;  // "paused" | "right_sized"
+};
+
+struct Inputs {
+  std::vector<NodeFact> nodes;
+  std::vector<PlacementFact> placements;
+  std::vector<FreedFact> freed;
+};
+
+// Canonical JSON round-trip for Inputs (the capsule "capacity.inputs"
+// stamp). inputs_json SORTS each section (nodes by name, placements by
+// pod, freed by kind/ns/name), so the stamp — and everything derived
+// from it — is independent of informer shard count and wire format.
+json::Value inputs_json(const Inputs& in);
+Inputs inputs_from_json(const json::Value& v);
+
+// The inventory document: {"schema", "slices": [...], "totals": {...},
+// "freed": {...}} — pure, deterministic, no cluster/cycle stamps (the
+// daemon layers identity on the published copy).
+json::Value build(const Inputs& in);
+
+// Slice-topology group gate: the sorted, de-duplicated roots that must
+// be HELD because at least one of their idle pods shares a slice
+// (node-pool) with a busy TPU tenant.
+std::vector<std::string> shared_busy_roots(const Inputs& in);
+
+// ── the daemon's published document (process-wide, thread-safe) ──
+// null until the first publish; reset_for_test clears.
+void set_current(json::Value doc);
+json::Value current();
+bool enabled();
+void set_enabled(bool on);
+void reset_for_test();
+
+// Prometheus text for one inventory document (all gauges, so the
+// OpenMetrics flag only matters for future counter families).
+std::string render_metrics(const json::Value& doc, bool openmetrics);
+
+// Canonical tpu_pruner_capacity_* family list (docs drift guard, capi).
+std::vector<std::string> metric_families();
+
+// Defragmentation report over an ARRAY of capsule capacity stamps
+// [{"cycle", "now_unix", "inputs", "doc"}, ...] (any order; sorted by
+// cycle internally). Recomputes every document from its inputs —
+// byte-level drift against the recorded doc is reported per cycle — and
+// dt-integrates consolidation potential across the window with the
+// gym's ledger math (dt = now - previous stamp's now; the first stamp
+// integrates nothing). The moves section lists, from the LAST stamp,
+// the pause/right-size actions that would free each consolidatable
+// slice whole. Throws std::runtime_error on malformed stamps.
+json::Value report(const json::Value& stamps);
+
+}  // namespace tpupruner::capacity

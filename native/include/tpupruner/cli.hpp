@@ -174,6 +174,17 @@ struct Cli {
   // "off" (default) keeps exact decision parity.
   std::string right_size = "off";
   double right_size_threshold = 0.8;      // --right-size-threshold: duty ceiling, (0-1]
+  // --capacity {on, off}: the capacity observatory (capacity.hpp). "on"
+  // lists nodes + TPU pod placements each evaluation and publishes the
+  // free-capacity inventory (/debug/capacity, tpu_pruner_capacity_*
+  // families, the fourth delta surface, capsule capacity stamps). "off"
+  // (default) keeps the API call pattern and every artifact byte-exact.
+  std::string capacity = "off";
+  // --slice-gate {on, off}: slice-topology group gate — an idle root
+  // whose pods share a TPU slice (node-pool) with a busy tenant is held
+  // (SLICE_SHARED_BUSY) instead of evicted. Implies the same node/pod
+  // listing as --capacity. "off" (default) keeps exact decision parity.
+  std::string slice_gate = "off";
   std::string otlp_endpoint;              // --otlp-endpoint (default: $OTEL_EXPORTER_OTLP_ENDPOINT)
   std::string gcp_project;                // --gcp-project (Cloud Monitoring PromQL API)
   std::string monitoring_endpoint = "https://monitoring.googleapis.com";  // --monitoring-endpoint

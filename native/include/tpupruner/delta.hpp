@@ -44,8 +44,11 @@
 
 namespace tpupruner::delta {
 
-// The three journaled surfaces, in canonical order.
-inline constexpr const char* kSurfaces[] = {"workloads", "signals", "decisions"};
+// The journaled surfaces, in canonical order. "capacity" (PR 18) is only
+// present on daemons running --capacity on; members without it simply
+// never journal the surface, and hubs merge whatever subset arrives.
+inline constexpr const char* kSurfaces[] = {"workloads", "signals", "decisions",
+                                            "capacity"};
 
 // Current-document providers (the same renderers the /debug endpoints
 // serve). A null provider means the surface is absent for this process.
@@ -53,6 +56,7 @@ struct Renderers {
   std::function<json::Value()> workloads;
   std::function<json::Value()> signals;
   std::function<json::Value()> decisions;
+  std::function<json::Value()> capacity;
 };
 
 class Journal {
@@ -105,6 +109,15 @@ class Journal {
     uint64_t fp = 0;
     json::Value doc;
   };
+  // The capacity inventory ships whole-document-on-change like signals:
+  // the document is small (one row per slice) and its totals are
+  // cross-coupled, so row-level diffing buys nothing.
+  struct CapacityState {
+    bool have = false;
+    uint64_t doc_epoch = 0;
+    uint64_t fp = 0;
+    json::Value doc;
+  };
   struct DecisionsState {
     bool have = false;
     int64_t capacity = 0;
@@ -136,6 +149,7 @@ class Journal {
   WorkloadsState wl_;
   SignalsState sig_;
   DecisionsState dec_;
+  CapacityState cap_;
 };
 
 // Process-wide journal (the daemon's). The hub builds its own instance
@@ -146,7 +160,7 @@ Journal& journal();
 
 // A member's three debug documents as the hub holds them.
 struct MemberDocs {
-  json::Value workloads, signals, decisions;
+  json::Value workloads, signals, decisions, capacity;
 };
 
 // Per-member delta cursor + reconstruction state.
@@ -162,6 +176,7 @@ struct DeltaState {
   int64_t dec_capacity = 0;
   int64_t dec_dropped = 0;
   json::Value signals;
+  json::Value capacity;
 };
 
 // Result of applying one /debug/delta response.

@@ -73,6 +73,7 @@ struct MemberSnapshot {
   json::Value workloads;    // member /debug/workloads (null until first success)
   json::Value signals;      // member /debug/signals
   json::Value decisions;    // member /debug/decisions
+  json::Value capacity;     // member /debug/capacity (null: not running --capacity)
 };
 
 // The four /debug/fleet/* documents plus the fleet metric families'
@@ -81,6 +82,7 @@ struct FleetView {
   json::Value workloads;  // /debug/fleet/workloads
   json::Value signals;    // /debug/fleet/signals
   json::Value decisions;  // /debug/fleet/decisions
+  json::Value capacity;   // /debug/fleet/capacity (free-TPU supply map)
   json::Value clusters;   // /debug/fleet/clusters
   std::string metrics_text;        // classic exposition
   std::string metrics_openmetrics; // OpenMetrics TYPE naming
@@ -122,6 +124,7 @@ FleetView aggregate(const std::vector<MemberSnapshot>& members, int64_t stale_af
 json::Value rollup_workloads(const FleetView& view, const std::string& hub_cluster);
 json::Value rollup_signals(const FleetView& view, const std::string& hub_cluster);
 json::Value rollup_decisions(const FleetView& view, const std::string& hub_cluster);
+json::Value rollup_capacity(const FleetView& view, const std::string& hub_cluster);
 
 // Status string for one member snapshot ("OK" | "PENDING" |
 // "UNREACHABLE") — the same derivation aggregate() applies, exposed so

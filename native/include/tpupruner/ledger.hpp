@@ -108,6 +108,17 @@ void flush();
 
 std::vector<PausedRoot> paused_roots();
 
+// Accounts whose capacity is currently freed by an actuation (fully
+// paused OR right-sized), with the chips basis their reclaim accrues at
+// (chips_when_paused) — the capacity observatory's "freed" section.
+// Sorted by account key (the registry map order).
+struct FreedAccount {
+  std::string kind, ns, name;
+  int64_t chips = 0;   // the reclaim basis: freed chips x dt accrues
+  std::string state;   // "paused" | "right_sized"
+};
+std::vector<FreedAccount> freed_accounts();
+
 // /debug/workloads body: {"workloads": [...], "tracked": N, "totals":
 // {...}}. `query_string` supports ns=<namespace> (alias namespace=) and
 // sort=reclaimed|idle|chips (descending; default reclaimed).

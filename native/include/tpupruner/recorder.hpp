@@ -96,6 +96,11 @@ void record_ledger(uint64_t cycle, int64_t now_unix,
 // recomputes in full and never reads it; byte-identity comparisons
 // between --incremental modes normalize the "incremental" key away.
 void record_incremental(uint64_t cycle, json::Value provenance);
+// Capacity observatory stamp (--capacity on): the canonical {inputs, doc}
+// pair — inputs via capacity::inputs_json (order-normalized), doc the
+// PURE capacity::build output (no cluster/cycle keys). `analyze
+// --capacity-report` recomputes doc from inputs and flags byte drift.
+void record_capacity(uint64_t cycle, json::Value stamp);
 // Event-engine provenance (--reconcile event): which trigger (dirty watch
 // burst, sample-flip probe, timer-wheel expiry, anti-entropy pass) opened
 // this logical capsule. Pure metadata like the incremental stamp — replay
@@ -106,8 +111,8 @@ void record_reconcile(uint64_t cycle, json::Value info);
 // Cycle facts: fail-closed veto sets, per-root gate flags, breaker stamp.
 void record_vetoes(uint64_t cycle, const std::vector<std::string>& vetoed_roots,
                    const std::vector<std::pair<std::string, std::string>>& vetoed_namespaces);
-// `flag` ∈ {"root_opted_out", "group_not_idle", "deferred",
-// "signal_brownout"}.
+// `flag` ∈ {"root_opted_out", "group_not_idle", "slice_shared_busy",
+// "hysteresis_hold", "deferred", "signal_brownout"}.
 void flag_root(uint64_t cycle, const std::string& identity, const char* flag);
 void record_breaker(uint64_t cycle, int64_t limit, size_t actionable, size_t deferred);
 void record_stats(uint64_t cycle, size_t num_series, size_t num_pods,

@@ -126,12 +126,13 @@ const char* reason_name(Reason r) {
     case Reason::RightSizeHeld: return "RIGHT_SIZE_HELD";
     case Reason::CycleTimeout: return "CYCLE_TIMEOUT";
     case Reason::HysteresisHold: return "HYSTERESIS_HOLD";
+    case Reason::SliceSharedBusy: return "SLICE_SHARED_BUSY";
   }
   return "?";
 }
 
 std::optional<Reason> reason_from_name(std::string_view name) {
-  for (int i = 0; i <= static_cast<int>(Reason::HysteresisHold); ++i) {
+  for (int i = 0; i <= static_cast<int>(Reason::SliceSharedBusy); ++i) {
     Reason r = static_cast<Reason>(i);
     if (name == reason_name(r)) return r;
   }
@@ -140,7 +141,7 @@ std::optional<Reason> reason_from_name(std::string_view name) {
 
 std::vector<std::string> all_reason_codes() {
   std::vector<std::string> out;
-  for (int i = 0; i <= static_cast<int>(Reason::HysteresisHold); ++i) {
+  for (int i = 0; i <= static_cast<int>(Reason::SliceSharedBusy); ++i) {
     out.push_back(reason_name(static_cast<Reason>(i)));
   }
   return out;

@@ -1,0 +1,478 @@
+// Capacity observatory implementation — see capacity.hpp for the model.
+//
+// Everything here is a pure fold over the canonical Inputs record; the
+// only process state is the daemon's latest published document (the
+// /debug/capacity + metrics + delta-surface provider cache). Determinism
+// discipline matches the rest of the codebase: every section is sorted,
+// std::map keys every grouping, and no wall-clock or cycle counter leaks
+// into build()'s output — that is what makes the capsule stamp replay
+// bit-for-bit across shard counts, wire formats, and reconcile modes.
+#include "tpupruner/capacity.hpp"
+
+#include <algorithm>
+#include <cinttypes>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <set>
+#include <stdexcept>
+
+namespace tpupruner::capacity {
+
+namespace {
+
+struct State {
+  std::mutex mutex;
+  bool enabled = false;
+  json::Value doc;  // null until the first publish
+};
+
+State& state() {
+  static State s;
+  return s;
+}
+
+// Per-slice accumulator keyed by node-pool.
+struct Slice {
+  std::string topology;
+  int64_t nodes = 0;
+  int64_t chips = 0;
+  int64_t occupied = 0;
+  int64_t idle = 0;
+  // tenant root → (chips on this slice, idle chips on this slice)
+  std::map<std::string, std::pair<int64_t, int64_t>> tenants;
+};
+
+// Fold Inputs into the per-pool slice table. Nodes without TPU chips are
+// not slice hosts; placements on unknown (or no) nodes carry no shape
+// information and are skipped. A node with no pool label is its own
+// single-host slice.
+std::map<std::string, Slice> fold_slices(const Inputs& in,
+                                         std::map<std::string, std::string>* node_pool) {
+  std::map<std::string, Slice> slices;
+  std::map<std::string, std::string> pools;
+  for (const NodeFact& n : in.nodes) {
+    if (n.chips <= 0) continue;
+    std::string pool = n.pool.empty() ? n.name : n.pool;
+    pools[n.name] = pool;
+    Slice& s = slices[pool];
+    ++s.nodes;
+    s.chips += n.chips;
+    // First (lexicographically smallest) node naming a topology wins —
+    // nodes of one slice agree in practice, and the rule is stable.
+    if (s.topology.empty() && !n.topology.empty()) s.topology = n.topology;
+  }
+  for (const PlacementFact& p : in.placements) {
+    auto it = pools.find(p.node);
+    if (it == pools.end()) continue;
+    Slice& s = slices[it->second];
+    s.occupied += p.chips;
+    if (p.idle) s.idle += p.chips;
+    std::string tenant = p.root.empty() ? "Pod/" + p.pod : p.root;
+    auto& t = s.tenants[tenant];
+    t.first += p.chips;
+    if (p.idle) t.second += p.chips;
+  }
+  if (node_pool) *node_pool = std::move(pools);
+  return slices;
+}
+
+const char* slice_state(const Slice& s) {
+  if (s.occupied == 0) return "whole_free";
+  if (s.chips - s.occupied > 0 || s.idle > 0) return "partial_idle";
+  return "busy";
+}
+
+bool consolidatable(const Slice& s) {
+  return s.occupied > 0 && s.idle == s.occupied;
+}
+
+int64_t int_at(const json::Value& v, std::string_view key, int64_t fallback = 0) {
+  const json::Value* f = v.find(key);
+  return (f && f->is_number()) ? f->as_int() : fallback;
+}
+
+std::string fmt_hours(double h) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%.2f", h);
+  return buf;
+}
+
+}  // namespace
+
+json::Value inputs_json(const Inputs& in) {
+  Inputs sorted = in;
+  std::sort(sorted.nodes.begin(), sorted.nodes.end(),
+            [](const NodeFact& a, const NodeFact& b) { return a.name < b.name; });
+  std::sort(sorted.placements.begin(), sorted.placements.end(),
+            [](const PlacementFact& a, const PlacementFact& b) { return a.pod < b.pod; });
+  std::sort(sorted.freed.begin(), sorted.freed.end(),
+            [](const FreedFact& a, const FreedFact& b) {
+              return std::tie(a.kind, a.ns, a.name) < std::tie(b.kind, b.ns, b.name);
+            });
+  json::Value nodes = json::Value::array();
+  for (const NodeFact& n : sorted.nodes) {
+    json::Value row = json::Value::object();
+    row.set("name", json::Value(n.name));
+    row.set("pool", json::Value(n.pool));
+    row.set("topology", json::Value(n.topology));
+    row.set("chips", json::Value(n.chips));
+    nodes.push_back(std::move(row));
+  }
+  json::Value placements = json::Value::array();
+  for (const PlacementFact& p : sorted.placements) {
+    json::Value row = json::Value::object();
+    row.set("pod", json::Value(p.pod));
+    row.set("node", json::Value(p.node));
+    row.set("chips", json::Value(p.chips));
+    row.set("idle", json::Value(p.idle));
+    row.set("root", json::Value(p.root));
+    placements.push_back(std::move(row));
+  }
+  json::Value freed = json::Value::array();
+  for (const FreedFact& f : sorted.freed) {
+    json::Value row = json::Value::object();
+    row.set("kind", json::Value(f.kind));
+    row.set("ns", json::Value(f.ns));
+    row.set("name", json::Value(f.name));
+    row.set("chips", json::Value(f.chips));
+    row.set("state", json::Value(f.state));
+    freed.push_back(std::move(row));
+  }
+  json::Value out = json::Value::object();
+  out.set("nodes", std::move(nodes));
+  out.set("placements", std::move(placements));
+  out.set("freed", std::move(freed));
+  return out;
+}
+
+Inputs inputs_from_json(const json::Value& v) {
+  Inputs in;
+  if (const json::Value* nodes = v.find("nodes"); nodes && nodes->is_array()) {
+    for (const json::Value& row : nodes->as_array()) {
+      NodeFact n;
+      n.name = row.get_string("name");
+      n.pool = row.get_string("pool");
+      n.topology = row.get_string("topology");
+      n.chips = int_at(row, "chips");
+      in.nodes.push_back(std::move(n));
+    }
+  }
+  if (const json::Value* placements = v.find("placements");
+      placements && placements->is_array()) {
+    for (const json::Value& row : placements->as_array()) {
+      PlacementFact p;
+      p.pod = row.get_string("pod");
+      p.node = row.get_string("node");
+      p.chips = int_at(row, "chips");
+      const json::Value* idle = row.find("idle");
+      p.idle = idle && idle->is_bool() && idle->as_bool();
+      p.root = row.get_string("root");
+      in.placements.push_back(std::move(p));
+    }
+  }
+  if (const json::Value* freed = v.find("freed"); freed && freed->is_array()) {
+    for (const json::Value& row : freed->as_array()) {
+      FreedFact f;
+      f.kind = row.get_string("kind");
+      f.ns = row.get_string("ns");
+      f.name = row.get_string("name");
+      f.chips = int_at(row, "chips");
+      f.state = row.get_string("state");
+      in.freed.push_back(std::move(f));
+    }
+  }
+  return in;
+}
+
+json::Value build(const Inputs& in) {
+  std::map<std::string, Slice> slices = fold_slices(in, nullptr);
+
+  json::Value slice_rows = json::Value::array();
+  int64_t total_chips = 0, free_chips = 0, fragmented = 0, potential = 0;
+  int64_t whole_free = 0, consolidatable_slices = 0;
+  for (const auto& [pool, s] : slices) {
+    const char* st = slice_state(s);
+    bool cons = consolidatable(s);
+    total_chips += s.chips;
+    free_chips += s.chips - s.occupied;
+    if (std::string_view(st) == "whole_free") ++whole_free;
+    if (std::string_view(st) == "partial_idle") fragmented += s.chips - s.occupied;
+    if (cons) {
+      ++consolidatable_slices;
+      potential += s.chips;
+    }
+    json::Value tenants = json::Value::array();
+    for (const auto& [root, t] : s.tenants) {
+      json::Value row = json::Value::object();
+      row.set("root", json::Value(root));
+      row.set("chips", json::Value(t.first));
+      row.set("idle_chips", json::Value(t.second));
+      row.set("idle", json::Value(t.second == t.first));
+      tenants.push_back(std::move(row));
+    }
+    json::Value row = json::Value::object();
+    row.set("pool", json::Value(pool));
+    row.set("topology", json::Value(s.topology));
+    row.set("nodes", json::Value(s.nodes));
+    row.set("chips", json::Value(s.chips));
+    row.set("occupied_chips", json::Value(s.occupied));
+    row.set("idle_chips", json::Value(s.idle));
+    row.set("free_chips", json::Value(s.chips - s.occupied));
+    row.set("state", json::Value(st));
+    row.set("consolidatable", json::Value(cons));
+    row.set("tenants", std::move(tenants));
+    slice_rows.push_back(std::move(row));
+  }
+
+  // Freed supply by root kind (the ledger's view of what pruning bought).
+  std::map<std::string, int64_t> by_kind;
+  int64_t freed_chips = 0;
+  for (const FreedFact& f : in.freed) {
+    by_kind[f.kind.empty() ? "unknown" : f.kind] += f.chips;
+    freed_chips += f.chips;
+  }
+  json::Value freed_kinds = json::Value::object();
+  for (const auto& [kind, chips] : by_kind) freed_kinds.set(kind, json::Value(chips));
+  json::Value freed = json::Value::object();
+  freed.set("chips", json::Value(freed_chips));
+  freed.set("accounts", json::Value(static_cast<int64_t>(in.freed.size())));
+  freed.set("by_kind", std::move(freed_kinds));
+
+  json::Value totals = json::Value::object();
+  totals.set("slices", json::Value(static_cast<int64_t>(slices.size())));
+  totals.set("chips", json::Value(total_chips));
+  totals.set("free_chips", json::Value(free_chips));
+  totals.set("whole_free_slices", json::Value(whole_free));
+  totals.set("fragmented_chips", json::Value(fragmented));
+  totals.set("consolidatable_slices", json::Value(consolidatable_slices));
+  totals.set("consolidation_potential_chips", json::Value(potential));
+  totals.set("freed_chips", json::Value(freed_chips));
+
+  json::Value doc = json::Value::object();
+  doc.set("schema", json::Value(static_cast<int64_t>(1)));
+  doc.set("slices", std::move(slice_rows));
+  doc.set("totals", std::move(totals));
+  doc.set("freed", std::move(freed));
+  return doc;
+}
+
+std::vector<std::string> shared_busy_roots(const Inputs& in) {
+  std::map<std::string, std::string> pools;
+  fold_slices(in, &pools);
+  std::set<std::string> busy_pools;
+  for (const PlacementFact& p : in.placements) {
+    if (p.idle) continue;
+    auto it = pools.find(p.node);
+    if (it != pools.end()) busy_pools.insert(it->second);
+  }
+  std::set<std::string> held;
+  for (const PlacementFact& p : in.placements) {
+    if (!p.idle || p.root.empty()) continue;
+    auto it = pools.find(p.node);
+    if (it != pools.end() && busy_pools.count(it->second)) held.insert(p.root);
+  }
+  return {held.begin(), held.end()};
+}
+
+void set_current(json::Value doc) {
+  State& s = state();
+  std::lock_guard<std::mutex> lock(s.mutex);
+  s.doc = std::move(doc);
+}
+
+json::Value current() {
+  State& s = state();
+  std::lock_guard<std::mutex> lock(s.mutex);
+  return s.doc;
+}
+
+bool enabled() {
+  State& s = state();
+  std::lock_guard<std::mutex> lock(s.mutex);
+  return s.enabled;
+}
+
+void set_enabled(bool on) {
+  State& s = state();
+  std::lock_guard<std::mutex> lock(s.mutex);
+  s.enabled = on;
+}
+
+void reset_for_test() {
+  State& s = state();
+  std::lock_guard<std::mutex> lock(s.mutex);
+  s.enabled = false;
+  s.doc = json::Value();
+}
+
+std::string render_metrics(const json::Value& doc, bool /*openmetrics*/) {
+  // All capacity families are gauges, so classic and OpenMetrics render
+  // identically (no _total counter-suffix dance needed).
+  auto family = [](const char* name, const char* help) {
+    return std::string("# HELP ") + name + " " + help + "\n# TYPE " + name + " gauge\n";
+  };
+  std::string body;
+
+  body += family("tpu_pruner_capacity_freed_chips",
+                 "TPU chips currently freed by pruning actuations, by root kind");
+  if (const json::Value* by_kind = doc.at_path("freed.by_kind");
+      by_kind && by_kind->is_object()) {
+    for (const auto& [kind, chips] : by_kind->as_object()) {
+      body += "tpu_pruner_capacity_freed_chips{root_kind=\"" + json::escape(kind) +
+              "\"} " + std::to_string(chips.as_int()) + "\n";
+    }
+  }
+
+  body += family("tpu_pruner_capacity_whole_free_slices",
+                 "TPU slices with zero occupied chips (schedulable whole), by topology");
+  if (const json::Value* slices = doc.find("slices"); slices && slices->is_array()) {
+    std::map<std::string, int64_t> per_topology;
+    for (const json::Value& s : slices->as_array()) {
+      if (s.get_string("state") != "whole_free") continue;
+      std::string topo = s.get_string("topology");
+      per_topology[topo.empty() ? "unknown" : topo] += 1;
+    }
+    for (const auto& [topo, count] : per_topology) {
+      body += "tpu_pruner_capacity_whole_free_slices{topology=\"" + json::escape(topo) +
+              "\"} " + std::to_string(count) + "\n";
+    }
+  }
+
+  const json::Value* totals = doc.find("totals");
+  json::Value empty = json::Value::object();
+  const json::Value& t = totals ? *totals : empty;
+  body += family("tpu_pruner_capacity_fragmented_chips",
+                 "Free TPU chips stranded inside partially occupied slices");
+  body += "tpu_pruner_capacity_fragmented_chips " +
+          std::to_string(int_at(t, "fragmented_chips")) + "\n";
+  body += family("tpu_pruner_capacity_consolidation_potential_chips",
+                 "Whole-slice TPU chips freeable by pausing/right-sizing the idle "
+                 "tenants of consolidatable slices");
+  body += "tpu_pruner_capacity_consolidation_potential_chips " +
+          std::to_string(int_at(t, "consolidation_potential_chips")) + "\n";
+  return body;
+}
+
+std::vector<std::string> metric_families() {
+  return {
+      "tpu_pruner_capacity_freed_chips",
+      "tpu_pruner_capacity_whole_free_slices",
+      "tpu_pruner_capacity_fragmented_chips",
+      "tpu_pruner_capacity_consolidation_potential_chips",
+  };
+}
+
+json::Value report(const json::Value& stamps) {
+  if (!stamps.is_array()) {
+    throw std::runtime_error("capacity report: stamps must be an array");
+  }
+  struct Entry {
+    int64_t cycle = 0;
+    int64_t now_unix = 0;
+    json::Value inputs;
+    json::Value recorded;
+  };
+  std::vector<Entry> entries;
+  for (const json::Value& s : stamps.as_array()) {
+    if (!s.is_object() || !s.find("inputs") || !s.find("doc")) {
+      throw std::runtime_error("capacity report: stamp missing inputs/doc");
+    }
+    Entry e;
+    e.cycle = int_at(s, "cycle");
+    e.now_unix = int_at(s, "now_unix");
+    e.inputs = *s.find("inputs");
+    e.recorded = *s.find("doc");
+    entries.push_back(std::move(e));
+  }
+  if (entries.empty()) {
+    throw std::runtime_error("capacity report: no capacity stamps "
+                             "(daemon recorded without --capacity on?)");
+  }
+  std::sort(entries.begin(), entries.end(), [](const Entry& a, const Entry& b) {
+    return std::tie(a.cycle, a.now_unix) < std::tie(b.cycle, b.now_unix);
+  });
+
+  // Recompute every document from its inputs: the consolidation claim is
+  // only as good as the stamp's replayability, so drift is a first-class
+  // result, not an exception.
+  json::Value drifted = json::Value::array();
+  std::vector<json::Value> docs;
+  for (const Entry& e : entries) {
+    json::Value recomputed = build(inputs_from_json(e.inputs));
+    if (recomputed.dump() != e.recorded.dump()) drifted.push_back(json::Value(e.cycle));
+    docs.push_back(std::move(recomputed));
+  }
+
+  // dt-integration over the window (the gym's ledger math): each stamp's
+  // consolidation potential is held for the interval SINCE the previous
+  // stamp; the first stamp integrates nothing.
+  int64_t chip_seconds = 0;
+  for (size_t i = 1; i < docs.size(); ++i) {
+    int64_t dt = entries[i].now_unix - entries[i - 1].now_unix;
+    if (dt <= 0) continue;
+    chip_seconds += int_at(*docs[i].find("totals"), "consolidation_potential_chips") * dt;
+  }
+  double chip_hours = static_cast<double>(chip_seconds) / 3600.0;
+
+  // The moves: from the LAST stamp, what would free each consolidatable
+  // slice whole. A tenant whose every placement (cluster-wide) is idle
+  // can be paused outright; one with busy pods elsewhere needs a
+  // right-size that sheds only the idle replicas.
+  Inputs last = inputs_from_json(entries.back().inputs);
+  std::map<std::string, std::pair<int64_t, int64_t>> root_chips;  // root → (chips, idle)
+  std::map<std::string, std::string> pools;
+  std::map<std::string, Slice> slices = fold_slices(last, &pools);
+  for (const PlacementFact& p : last.placements) {
+    if (pools.find(p.node) == pools.end()) continue;
+    std::string tenant = p.root.empty() ? "Pod/" + p.pod : p.root;
+    auto& rc = root_chips[tenant];
+    rc.first += p.chips;
+    if (p.idle) rc.second += p.chips;
+  }
+  json::Value moves = json::Value::array();
+  for (const auto& [pool, s] : slices) {
+    if (!consolidatable(s)) continue;
+    for (const auto& [root, t] : s.tenants) {
+      if (t.second == 0) continue;
+      const auto& rc = root_chips[root];
+      json::Value row = json::Value::object();
+      row.set("root", json::Value(root));
+      row.set("pool", json::Value(pool));
+      row.set("action", json::Value(rc.second == rc.first ? "pause" : "right_size"));
+      row.set("idle_chips", json::Value(t.second));
+      moves.push_back(std::move(row));
+    }
+  }
+
+  const json::Value& final_totals = *docs.back().find("totals");
+  int64_t whole_now = int_at(final_totals, "whole_free_slices");
+  int64_t freed_slices = int_at(final_totals, "consolidatable_slices");
+  int64_t potential = int_at(final_totals, "consolidation_potential_chips");
+
+  json::Value consolidation = json::Value::object();
+  consolidation.set("whole_free_slices_now", json::Value(whole_now));
+  consolidation.set("freed_whole_slices", json::Value(freed_slices));
+  consolidation.set("whole_free_slices_after", json::Value(whole_now + freed_slices));
+  consolidation.set("chips", json::Value(potential));
+  consolidation.set("chip_seconds", json::Value(chip_seconds));
+  consolidation.set("chip_hours", json::Value(chip_hours));
+
+  json::Value out = json::Value::object();
+  out.set("schema", json::Value(static_cast<int64_t>(1)));
+  out.set("capsules", json::Value(static_cast<int64_t>(entries.size())));
+  out.set("first_cycle", json::Value(entries.front().cycle));
+  out.set("last_cycle", json::Value(entries.back().cycle));
+  out.set("window_s", json::Value(entries.back().now_unix - entries.front().now_unix));
+  out.set("drift", json::Value(drifted.as_array().size() > 0));
+  out.set("drifted_cycles", std::move(drifted));
+  out.set("consolidation", std::move(consolidation));
+  out.set("moves", std::move(moves));
+  out.set("inventory", docs.back());
+  out.set("summary", json::Value("consolidation frees " + std::to_string(freed_slices) +
+                                 " whole slice(s) worth " + fmt_hours(chip_hours) +
+                                 " chip-hours"));
+  return out;
+}
+
+}  // namespace tpupruner::capacity

@@ -16,6 +16,7 @@
 
 #include "otlp_grpc.hpp"
 #include "tpupruner/audit.hpp"
+#include "tpupruner/capacity.hpp"
 #include "tpupruner/compact.hpp"
 #include "tpupruner/delta.hpp"
 #include "tpupruner/fleet.hpp"
@@ -874,15 +875,71 @@ char* tp_fleet_metric_families(const char*) {
   });
 }
 
+char* tp_capacity_metric_families(const char*) {
+  // The canonical tpu_pruner_capacity_* family names — the docs-drift
+  // test joins this against docs/OPERATIONS.md, like the other families.
+  return guarded([&] {
+    Value families = Value::array();
+    for (const std::string& f : tpupruner::capacity::metric_families()) {
+      families.push_back(Value(f));
+    }
+    Value out = Value::object();
+    out.set("families", std::move(families));
+    return ok(out);
+  });
+}
+
+char* tp_capacity_build(const char* payload_json) {
+  // The capacity observatory's pure inventory math (capacity::build) —
+  // the ONE implementation the daemon, the hub rollup and the defrag
+  // report share — exposed for the pytest tier. Payload:
+  //   {"inputs": {"nodes": [...], "placements": [...], "freed": [...]}}
+  // Returns {"doc", "inputs_canonical", "shared_busy_roots", "metrics",
+  // "metrics_openmetrics"}.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    const Value* inputs = p.find("inputs");
+    if (!inputs) throw std::runtime_error("missing inputs");
+    tpupruner::capacity::Inputs in = tpupruner::capacity::inputs_from_json(*inputs);
+    Value doc = tpupruner::capacity::build(in);
+    Value out = Value::object();
+    out.set("inputs_canonical", tpupruner::capacity::inputs_json(in));
+    Value held = Value::array();
+    for (const std::string& r : tpupruner::capacity::shared_busy_roots(in)) {
+      held.push_back(Value(r));
+    }
+    out.set("shared_busy_roots", std::move(held));
+    out.set("metrics", Value(tpupruner::capacity::render_metrics(doc, false)));
+    out.set("metrics_openmetrics", Value(tpupruner::capacity::render_metrics(doc, true)));
+    out.set("doc", std::move(doc));
+    return ok(out);
+  });
+}
+
+char* tp_capacity_report(const char* payload_json) {
+  // The replayable defragmentation report (capacity::report) — the
+  // `analyze --capacity-report` backend. Payload: {"stamps": [{"cycle",
+  // "now_unix", "inputs", "doc"}...]}. Recomputes every inventory from
+  // its inputs (byte drift reported per cycle) and dt-integrates the
+  // consolidation potential across the window.
+  return guarded([&] {
+    Value p = Value::parse(payload_json);
+    const Value* stamps = p.find("stamps");
+    if (!stamps) throw std::runtime_error("missing stamps");
+    return ok(tpupruner::capacity::report(*stamps));
+  });
+}
+
 char* tp_fleet_aggregate(const char* payload_json) {
   // Deterministic harness for the hub's merge math (fleet::aggregate):
   // the pytest tier drives the REAL aggregation over synthetic member
   // snapshots. Payload:
   //   {"members": [{"url","cluster","reachable","ever_reached",
   //                 "staleness_s","polls","failures","last_error",
-  //                 "workloads","signals","decisions"}...],
-  //    "stale_after_s": N, "decisions_per_member": K?}
-  // Returns the four /debug/fleet documents plus both exposition renders.
+  //                 "workloads","signals","decisions","capacity"}...],
+  //    "stale_after_s": N, "decisions_per_member": K?, "hub_cluster"?}
+  // Returns the five /debug/fleet documents plus both exposition renders
+  // and the hub's own /debug/capacity rollup body (capacity_rollup).
   return guarded([&] {
     Value p = Value::parse(payload_json);
     const Value* members = p.find("members");
@@ -911,6 +968,7 @@ char* tp_fleet_aggregate(const char* payload_json) {
       if (const Value* v = m.find("workloads")) s.workloads = *v;
       if (const Value* v = m.find("signals")) s.signals = *v;
       if (const Value* v = m.find("decisions")) s.decisions = *v;
+      if (const Value* v = m.find("capacity")) s.capacity = *v;
       snaps.push_back(std::move(s));
     }
     int64_t stale_after = 30;
@@ -925,6 +983,11 @@ char* tp_fleet_aggregate(const char* payload_json) {
     out.set("workloads", std::move(view.workloads));
     out.set("signals", std::move(view.signals));
     out.set("decisions", std::move(view.decisions));
+    // Capacity BEFORE the move of view.capacity below feeds the rollup —
+    // the hub's own /debug/capacity body (hub-of-hubs remerge input).
+    out.set("capacity_rollup", tpupruner::fleet::rollup_capacity(
+                                   view, p.get_string("hub_cluster", "hub")));
+    out.set("capacity", std::move(view.capacity));
     out.set("clusters", std::move(view.clusters));
     out.set("metrics", Value(view.metrics_text));
     out.set("metrics_openmetrics", Value(view.metrics_openmetrics));
@@ -976,7 +1039,8 @@ char* tp_delta_sim(const char* payload_json) {
     };
     auto wire = [&] {
       journal->set_renderers(tpupruner::delta::Renderers{
-          renderer("workloads"), renderer("signals"), renderer("decisions")});
+          renderer("workloads"), renderer("signals"), renderer("decisions"),
+          renderer("capacity")});
     };
     wire();
 
@@ -1023,6 +1087,7 @@ char* tp_delta_sim(const char* payload_json) {
         if (!docs.workloads.is_null()) d.set("workloads", docs.workloads);
         if (!docs.signals.is_null()) d.set("signals", docs.signals);
         if (!docs.decisions.is_null()) d.set("decisions", docs.decisions);
+        if (!docs.capacity.is_null()) d.set("capacity", docs.capacity);
         r.set("docs", std::move(d));
         r.set("bytes", Value(static_cast<int64_t>(body.size())));
       } else if (op == "restart") {

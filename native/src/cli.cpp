@@ -288,6 +288,20 @@ TPU FLAGS:
                                 per-replica duty-cycle ceiling for
                                 --right-size: scale to
                                 N = ceil(busy_replicas / F) [default: 0.8]
+      --capacity <M>            on | off [default: off] — capacity
+                                observatory: list nodes + TPU pod
+                                placements each evaluation and publish
+                                the free-capacity inventory
+                                (/debug/capacity, tpu_pruner_capacity_*
+                                families, the delta "capacity" surface,
+                                capsule capacity stamps for
+                                `analyze --capacity-report`)
+      --slice-gate <M>          on | off [default: off] — slice-topology
+                                group gate: hold an idle root whose pods
+                                share a TPU slice (node-pool) with a busy
+                                tenant (audit code SLICE_SHARED_BUSY)
+                                instead of fragmenting the slice. "off"
+                                keeps exact decision parity
       --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
                                 [default: $OTEL_EXPORTER_OTLP_ENDPOINT]
       --gcp-project <ID>        query the Cloud Monitoring PromQL API for this
@@ -503,6 +517,16 @@ Cli parse(int argc, char** argv) {
        [&](const std::string& v) {
          check_choice("--right-size", v, {"on", "off"});
          cli.right_size = v;
+       }},
+      {"--capacity",
+       [&](const std::string& v) {
+         check_choice("--capacity", v, {"on", "off"});
+         cli.capacity = v;
+       }},
+      {"--slice-gate",
+       [&](const std::string& v) {
+         check_choice("--slice-gate", v, {"on", "off"});
+         cli.slice_gate = v;
        }},
       {"--right-size-threshold",
        [&](const std::string& v) {

@@ -22,6 +22,7 @@
 #include "tpupruner/audit.hpp"
 #include "tpupruner/auth.hpp"
 #include "tpupruner/backoff.hpp"
+#include "tpupruner/capacity.hpp"
 #include "tpupruner/compact.hpp"
 #include "tpupruner/delta.hpp"
 #include "tpupruner/fleet.hpp"
@@ -212,6 +213,87 @@ struct ResolveOutcome {
   std::vector<incremental::Unit> fresh_units;
   double cache_merge_secs = 0;
 };
+
+// Capacity observatory acquisition: fold cluster-scoped node/pod LISTs +
+// the cycle's resolve outcome + the ledger's freed accounts into the
+// capacity module's canonical Inputs record. Nodes keep only TPU hosts
+// (allocatable google.com/tpu > 0 is enforced by build()); placements
+// keep only chip-requesting pods bound to a node. The LISTs are plain
+// JSON regardless of --wire — the capsule's capacity stamp must be
+// byte-identical across wire modes, and inputs_json's canonical sort
+// makes it independent of shard count too.
+capacity::Inputs gather_capacity_inputs(const cli::Cli& args, const k8s::Client& kube,
+                                        const ResolveOutcome& resolved) {
+  capacity::Inputs in;
+  const json::Value nodes = kube.list("/api/v1/nodes", "");
+  if (const json::Value* items = nodes.find("items"); items && items->is_array()) {
+    for (const json::Value& n : items->as_array()) {
+      capacity::NodeFact nf;
+      if (const json::Value* name = n.at_path("metadata.name"); name && name->is_string()) {
+        nf.name = name->as_string();
+      }
+      if (nf.name.empty()) continue;
+      if (const json::Value* labels = n.at_path("metadata.labels");
+          labels && labels->is_object()) {
+        if (const json::Value* pool = labels->find("cloud.google.com/gke-nodepool");
+            pool && pool->is_string()) {
+          nf.pool = pool->as_string();
+        }
+        if (const json::Value* topo = labels->find("cloud.google.com/gke-tpu-topology");
+            topo && topo->is_string()) {
+          nf.topology = topo->as_string();
+        }
+      }
+      if (const json::Value* alloc = n.at_path("status.allocatable");
+          alloc && alloc->is_object()) {
+        const char* resource = args.device == "gpu" ? "nvidia.com/gpu" : "google.com/tpu";
+        if (const json::Value* chips = alloc->find(resource)) {
+          if (chips->is_number()) {
+            nf.chips = chips->as_int();
+          } else if (chips->is_string()) {
+            try {
+              nf.chips = std::stoll(chips->as_string());
+            } catch (const std::exception&) {
+            }
+          }
+        }
+      }
+      in.nodes.push_back(std::move(nf));
+    }
+  }
+  // Pod → owning-root display map from this cycle's resolved records: the
+  // slice gate (and the inventory's tenant rows) must name roots exactly
+  // as every other surface does ("Kind/ns/name").
+  std::unordered_map<std::string, std::string> pod_root;
+  for (const auto& [identity, rec] : resolved.resolved_records) {
+    if (rec.root_kind.empty()) continue;
+    pod_root[rec.ns + "/" + rec.pod] =
+        rec.root_kind + "/" + rec.root_ns + "/" + rec.root_name;
+  }
+  const json::Value pods = kube.list("/api/v1/pods", "");
+  if (const json::Value* items = pods.find("items"); items && items->is_array()) {
+    for (const json::Value& pod : items->as_array()) {
+      capacity::PlacementFact pf;
+      const json::Value* ns = pod.at_path("metadata.namespace");
+      const json::Value* name = pod.at_path("metadata.name");
+      if (!ns || !ns->is_string() || !name || !name->is_string()) continue;
+      pf.pod = ns->as_string() + "/" + name->as_string();
+      if (const json::Value* node = pod.at_path("spec.nodeName"); node && node->is_string()) {
+        pf.node = node->as_string();
+      }
+      if (pf.node.empty()) continue;  // unscheduled: occupies nothing
+      pf.chips = core::pod_chip_count(pod, args.device);
+      if (pf.chips <= 0) continue;  // not a TPU tenant
+      pf.idle = resolved.idle_pods.count(pf.pod) > 0;
+      if (auto it = pod_root.find(pf.pod); it != pod_root.end()) pf.root = it->second;
+      in.placements.push_back(std::move(pf));
+    }
+  }
+  for (const ledger::FreedAccount& a : ledger::freed_accounts()) {
+    in.freed.push_back(capacity::FreedFact{a.kind, a.ns, a.name, a.chips, a.state});
+  }
+  return in;
+}
 
 // Deterministic-merge helpers: the sharded engine's output order must be a
 // pure function of the candidate set, never of thread interleaving.
@@ -1329,6 +1411,41 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
     ledger::observe_cycle(cycle_id, ledger_now, ledger_feed);
   }
   seg("decided flush + ledger observe");
+  // Capacity observatory (--capacity on) + slice-topology gate
+  // (--slice-gate on): both derive from ONE canonical Inputs record folded
+  // from cluster-scoped node/pod LISTs, this evaluation's idle set, the
+  // resolved pod→root map, and the ledger's freed accounts. Fail-open: a
+  // failed LIST logs and skips both surfaces for the cycle — a topology
+  // blind spot must never hold the pipeline hostage. Both flags default
+  // off, so the default pipeline (and its api-call counts) is untouched.
+  const bool capacity_on = args.capacity == "on";
+  const bool slice_gate_on = args.slice_gate == "on";
+  capacity::Inputs cap_inputs;
+  bool cap_have = false;
+  if (capacity_on || slice_gate_on) {
+    try {
+      cap_inputs = gather_capacity_inputs(args, kube, resolved);
+      cap_have = true;
+    } catch (const std::exception& e) {
+      log::warn("daemon", std::string("capacity: cluster LIST failed (") + e.what() +
+                "); skipping inventory/slice gate this cycle");
+    }
+  }
+  if (capacity_on && cap_have) {
+    json::Value doc = capacity::build(cap_inputs);
+    // The capsule stamps the PURE {inputs, doc} pair — no cluster/cycle
+    // keys — so `analyze --capacity-report` recomputes bit-for-bit.
+    if (recorder::enabled()) {
+      json::Value stamp = json::Value::object();
+      stamp.set("inputs", capacity::inputs_json(cap_inputs));
+      stamp.set("doc", doc);
+      recorder::record_capacity(cycle_id, std::move(stamp));
+    }
+    json::Value published = doc;
+    published.set("cluster", json::Value(fleet::cluster_name()));
+    capacity::set_current(std::move(published));
+  }
+  seg("capacity");
   std::vector<ScaleTarget> unique = core::dedup_targets(std::move(resolved.targets));
   seg("dedup");
   // Flight recorder: the fail-closed veto sets are cycle facts (cluster
@@ -1428,6 +1545,38 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
                       std::make_pair(audit::Reason::GroupNotIdle,
                                      "group has active (or too-young) TPU hosts"));
       recorder::flag_root(cycle_id, unique[i].identity(), "group_not_idle");
+    }
+  }
+
+  // Slice-topology group gate (--slice-gate on): hold a survivor whose
+  // idle pods share a TPU slice (node-pool) with a busy tenant — evicting
+  // it would fragment a slice that cannot become whole anyway (the
+  // capacity inventory's consolidatable test is the exact complement).
+  // Runs after the multi-host group gate (same "don't break a live
+  // collective" family) and before hysteresis, so a held root never
+  // accrues an idle streak it couldn't act on.
+  if (slice_gate_on && cap_have) {
+    std::set<std::string> held;
+    for (std::string& r : capacity::shared_busy_roots(cap_inputs)) held.insert(std::move(r));
+    if (!held.empty()) {
+      std::vector<ScaleTarget> kept;
+      kept.reserve(survivors.size());
+      for (ScaleTarget& t : survivors) {
+        const std::string display = std::string(core::kind_name(t.kind)) + "/" +
+                                    t.ns().value_or("") + "/" + t.name();
+        if (held.count(display)) {
+          log::info("daemon", "Slice gate hold [" + std::string(core::kind_name(t.kind)) +
+                    "] " + t.ns().value_or("") + ":" + t.name() + ": " +
+                    capacity::kSliceSharedBusyDetail);
+          outcome.emplace(t.identity(),
+                          std::make_pair(audit::Reason::SliceSharedBusy,
+                                         std::string(capacity::kSliceSharedBusyDetail)));
+          recorder::flag_root(cycle_id, t.identity(), "slice_shared_busy");
+          continue;
+        }
+        kept.push_back(std::move(t));
+      }
+      survivors = std::move(kept);
     }
   }
 
@@ -1869,6 +2018,7 @@ int run(const cli::Cli& args) {
   json::set_zero_copy(args.zero_copy_json == "on");
   proto::set_wire_mode(proto::wire_mode_from_string(args.wire));
   compact::set_enabled(args.compact_store == "on");
+  capacity::set_enabled(args.capacity == "on");
   log::info("daemon", std::string("Transport: ") + h2::mode_name(h2::default_mode()) +
             ", zero-copy JSON " + args.zero_copy_json + ", wire " +
             proto::wire_mode_name(proto::wire_mode()) + ", compact store " +
@@ -1899,8 +2049,8 @@ int run(const cli::Cli& args) {
         "\x1f" + args.signal_guard + "\x1f" + std::to_string(args.signal_scrape_interval) +
         "\x1f" + std::to_string(args.signal_max_age) + "\x1f" +
         std::to_string(args.signal_min_coverage) + "\x1f" + args.right_size + "\x1f" +
-        std::to_string(args.right_size_threshold) + "\x1f" + args.device + "\x1f" +
-        cli::resolved_schema(args);
+        std::to_string(args.right_size_threshold) + "\x1f" + args.slice_gate + "\x1f" +
+        args.device + "\x1f" + cli::resolved_schema(args);
     incremental::engine().configure(args.incremental == "on", shard::stable_hash(fp_src));
   }
 
@@ -1932,6 +2082,7 @@ int run(const cli::Cli& args) {
     config.set("signal_min_coverage", json::Value(args.signal_min_coverage));
     config.set("right_size", json::Value(args.right_size));
     config.set("right_size_threshold", json::Value(args.right_size_threshold));
+    config.set("slice_gate", json::Value(args.slice_gate));
     recorder::set_run_context(std::move(config), query, evidence_query);
     audit::set_record_sink([](const audit::DecisionRecord& rec) {
       recorder::record_decision(rec.cycle, rec.to_json());
@@ -2034,17 +2185,31 @@ int run(const cli::Cli& args) {
     // ... plus the shared transport's connection/stream counters (the
     // bench reads connections_opened around a warm cycle from these).
     metrics_server->set_extra_metrics_provider([ledger_top_k](bool openmetrics) {
-      return ledger::render_metrics(ledger_top_k, openmetrics) +
-             signal::render_metrics(openmetrics) +
-             h2::render_transport_metrics(openmetrics) +
-             incremental::render_metrics(openmetrics) +
-             proto::render_wire_metrics(openmetrics) +
-             compact::render_store_metrics(openmetrics) +
-             backoff::render_metrics(openmetrics);
+      std::string extra = ledger::render_metrics(ledger_top_k, openmetrics) +
+                          signal::render_metrics(openmetrics) +
+                          h2::render_transport_metrics(openmetrics) +
+                          incremental::render_metrics(openmetrics) +
+                          proto::render_wire_metrics(openmetrics) +
+                          compact::render_store_metrics(openmetrics) +
+                          backoff::render_metrics(openmetrics);
+      // Capacity families render only once the first inventory publishes
+      // (absent, not zero, with --capacity off — same contract as signal).
+      if (capacity::enabled()) {
+        json::Value cap = capacity::current();
+        if (!cap.is_null()) extra += capacity::render_metrics(cap, openmetrics);
+      }
+      return extra;
     });
     // Evidence-health snapshot at /debug/signals (`analyze
     // --signal-report` hits this); {"enabled": false} with the guard off.
     metrics_server->set_signals_provider([] { return signal::signals_json().dump(); });
+    // Capacity observatory at /debug/capacity (--capacity on): the live
+    // free-capacity inventory, cluster-stamped. "null" until the first
+    // evaluation publishes; unset (404 + hint) with the flag off, so the
+    // route doubles as a feature probe for hubs.
+    if (args.capacity == "on") {
+      metrics_server->set_capacity_provider([] { return capacity::current().dump(); });
+    }
     // Event-engine time plane at /debug/timers: wheel occupancy/counters +
     // the sliding-window breaker bucket. Unset in cycle mode (404 with a
     // hint), so the route doubles as a mode probe.
@@ -2073,6 +2238,10 @@ int run(const cli::Cli& args) {
         [] { return ledger::workloads_json(""); },
         [] { return signal::signals_json(); },
         [] { return audit::decisions_json(""); },
+        // Fourth surface (--capacity on): null provider otherwise, so
+        // members without the flag simply never journal it.
+        args.capacity == "on" ? std::function<json::Value()>([] { return capacity::current(); })
+                              : std::function<json::Value()>(),
     });
     metrics_server->set_delta_provider(
         [](const std::string& query, const std::function<bool()>& abort) {

@@ -210,6 +210,19 @@ void Journal::publish_locked() {
     }
   }
 
+  if (renderers_.capacity) {
+    Value doc = renderers_.capacity();
+    uint64_t fp = fp_of(doc);
+    if (!cap_.have || fp != cap_.fp) {
+      cap_.doc = std::move(doc);
+      cap_.fp = fp;
+      cap_.doc_epoch = next;
+      cap_.have = true;
+      note_change_locked(next);
+      changed = true;
+    }
+  }
+
   if (renderers_.decisions) {
     Value doc = renderers_.decisions();
     Value meta = doc_meta(doc, "decisions");
@@ -263,6 +276,7 @@ json::Value Journal::full_docs_locked() const {
   Value full = Value::object();
   if (wl_.have) full.set("workloads", rebuild_workloads(wl_.meta, wl_.rows));
   if (sig_.have) full.set("signals", sig_.doc);
+  if (cap_.have) full.set("capacity", cap_.doc);
   if (dec_.have) {
     std::deque<Value> ring;
     for (const auto& [e, rec] : dec_.ring) ring.push_back(rec);
@@ -307,6 +321,11 @@ std::string Journal::build_response_locked(int64_t since, bool resync, bool firs
     Value s = Value::object();
     s.set("doc", sig_.doc);
     surfaces.set("signals", std::move(s));
+  }
+  if (cap_.have && cap_.doc_epoch > u_since) {
+    Value s = Value::object();
+    s.set("doc", cap_.doc);
+    surfaces.set("capacity", std::move(s));
   }
   if (dec_.have) {
     size_t fresh = 0;
@@ -392,6 +411,7 @@ void Journal::reset_for_test() {
   wl_ = {};
   sig_ = {};
   dec_ = {};
+  cap_ = {};
   gen_ = next_generation();
 }
 
@@ -454,6 +474,10 @@ ApplyResult apply_delta(DeltaState& st, const Value& resp, MemberDocs& out) {
       st.signals = *sig;
       out.signals = *sig;
     }
+    if (const Value* cap = full->find("capacity")) {
+      st.capacity = *cap;
+      out.capacity = *cap;
+    }
     if (const Value* dec = full->find("decisions")) {
       prime_decisions(st, *dec);
       out.decisions = *dec;
@@ -490,6 +514,13 @@ ApplyResult apply_delta(DeltaState& st, const Value& resp, MemberDocs& out) {
       if (const Value* doc = sig->find("doc")) {
         st.signals = *doc;
         out.signals = *doc;
+        res.changed = true;
+      }
+    }
+    if (const Value* cap = surfaces->find("capacity"); cap && cap->is_object()) {
+      if (const Value* doc = cap->find("doc")) {
+        st.capacity = *doc;
+        out.capacity = *doc;
         res.changed = true;
       }
     }

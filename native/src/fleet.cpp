@@ -172,12 +172,15 @@ std::vector<MemberSnapshot> expand_rollup(const MemberSnapshot& hub, int64_t sta
   bool hub_ok = std::string(status_of(hub, stale_after_s)) == "OK";
 
   // Index the signals / decisions per-cluster rows.
-  std::map<std::string, const Value*> sig_rows, dec_rows;
+  std::map<std::string, const Value*> sig_rows, dec_rows, cap_rows;
   if (const Value* rows = hub.signals.find("clusters"); rows && rows->is_array()) {
     for (const Value& row : rows->as_array()) sig_rows.emplace(row.get_string("cluster"), &row);
   }
   if (const Value* rows = hub.decisions.find("clusters"); rows && rows->is_array()) {
     for (const Value& row : rows->as_array()) dec_rows.emplace(row.get_string("cluster"), &row);
+  }
+  if (const Value* rows = hub.capacity.find("clusters"); rows && rows->is_array()) {
+    for (const Value& row : rows->as_array()) cap_rows.emplace(row.get_string("cluster"), &row);
   }
 
   const Value* rows = hub.workloads.find("clusters");
@@ -225,6 +228,15 @@ std::vector<MemberSnapshot> expand_rollup(const MemberSnapshot& hub, int64_t sta
         dec.set("cluster", Value(leaf.cluster));
         dec.set("decisions", *d);
         leaf.decisions = std::move(dec);
+      }
+    }
+    // The rollup's capacity row carries the member's /debug/capacity
+    // document VERBATIM under "inventory", so the reconstructed leaf —
+    // and therefore a two-level merge — is byte-identical to polling the
+    // leaf directly.
+    if (auto it = cap_rows.find(leaf.cluster); it != cap_rows.end()) {
+      if (const Value* inv = it->second->find("inventory"); inv && inv->is_object()) {
+        leaf.capacity = *inv;
       }
     }
     leaves.push_back(std::move(leaf));
@@ -475,6 +487,49 @@ FleetView aggregate(const std::vector<MemberSnapshot>& members, int64_t stale_af
   view.decisions = Value::object();
   view.decisions.set("clusters", std::move(dec_clusters));
 
+  // ── capacity: the fleet's free-TPU supply map ──
+  // Per-cluster rows keep each member's inventory document verbatim (the
+  // hub-of-hubs reconstruction contract); fleet totals sum the facts a
+  // scheduler shops for — whole free slices, stranded chips, and the
+  // consolidation upside.
+  Value cap_clusters = Value::array();
+  int64_t cap_members = 0;
+  int64_t cap_slices = 0, cap_chips = 0, cap_free = 0, cap_whole = 0;
+  int64_t cap_fragmented = 0, cap_consolidatable = 0, cap_potential = 0, cap_freed = 0;
+  for (const MemberSnapshot* m : ordered) {
+    Value row = Value::object();
+    row.set("cluster", Value(m->cluster));
+    row.set("status", Value(std::string(status_of(*m, stale_after_s))));
+    if (m->capacity.is_object()) {
+      ++cap_members;
+      if (const Value* t = m->capacity.find("totals"); t && t->is_object()) {
+        cap_slices += static_cast<int64_t>(num_at(*t, "slices"));
+        cap_chips += static_cast<int64_t>(num_at(*t, "chips"));
+        cap_free += static_cast<int64_t>(num_at(*t, "free_chips"));
+        cap_whole += static_cast<int64_t>(num_at(*t, "whole_free_slices"));
+        cap_fragmented += static_cast<int64_t>(num_at(*t, "fragmented_chips"));
+        cap_consolidatable += static_cast<int64_t>(num_at(*t, "consolidatable_slices"));
+        cap_potential += static_cast<int64_t>(num_at(*t, "consolidation_potential_chips"));
+        cap_freed += static_cast<int64_t>(num_at(*t, "freed_chips"));
+      }
+      row.set("inventory", m->capacity);
+    }
+    cap_clusters.push_back(std::move(row));
+  }
+  Value cap_totals = Value::object();
+  cap_totals.set("slices", Value(cap_slices));
+  cap_totals.set("chips", Value(cap_chips));
+  cap_totals.set("free_chips", Value(cap_free));
+  cap_totals.set("whole_free_slices", Value(cap_whole));
+  cap_totals.set("fragmented_chips", Value(cap_fragmented));
+  cap_totals.set("consolidatable_slices", Value(cap_consolidatable));
+  cap_totals.set("consolidation_potential_chips", Value(cap_potential));
+  cap_totals.set("freed_chips", Value(cap_freed));
+  view.capacity = Value::object();
+  view.capacity.set("members_reporting", Value(cap_members));
+  view.capacity.set("clusters", std::move(cap_clusters));
+  view.capacity.set("fleet_totals", std::move(cap_totals));
+
   // ── clusters: the member status table ──
   Value member_rows = Value::array();
   for (const MemberSnapshot* m : ordered) {
@@ -558,6 +613,16 @@ json::Value rollup_decisions(const FleetView& view, const std::string& hub_clust
   doc.set("rollup", Value(true));
   doc.set("cluster", Value(hub_cluster));
   if (const Value* v = view.decisions.find("clusters")) doc.set("clusters", *v);
+  return doc;
+}
+
+json::Value rollup_capacity(const FleetView& view, const std::string& hub_cluster) {
+  Value doc = Value::object();
+  doc.set("rollup", Value(true));
+  doc.set("cluster", Value(hub_cluster));
+  for (const char* key : {"members_reporting", "clusters", "fleet_totals"}) {
+    if (const Value* v = view.capacity.find(key)) doc.set(key, *v);
+  }
   return doc;
 }
 

@@ -205,9 +205,29 @@ bool poll_member_snapshot(const h2::Transport& transport, const Options& opt,
   json::Value workloads = fetch("/debug/workloads");
   json::Value signals = fetch("/debug/signals");
   json::Value decisions = fetch("/debug/decisions");
+  // The capacity surface is optional (members predating it, or running
+  // --capacity off, 404 it): absent folds as a null document, exactly
+  // like the delta path's missing "capacity" surface — so snapshot and
+  // delta polling stay byte-identical member by member.
+  json::Value capacity;
+  {
+    http::Request req;
+    req.url = m.snap.url + "/debug/capacity";
+    req.timeout_ms = static_cast<int>(opt.member_timeout_ms);
+    http::Response resp = transport.request(req);
+    if (resp.status == 200) {
+      log::counter_add("fleet_poll_bytes_total", resp.body.size());
+      fp = fp * 1099511628211ULL ^ shard::stable_hash(resp.body);
+      capacity = json::Value::parse(resp.body);
+    } else if (resp.status != 404) {
+      throw std::runtime_error("/debug/capacity returned HTTP " +
+                               std::to_string(resp.status));
+    }
+  }
   m.snap.workloads = std::move(workloads);
   m.snap.signals = std::move(signals);
   m.snap.decisions = std::move(decisions);
+  m.snap.capacity = std::move(capacity);
   bool changed = fp != m.snapshot_fp;
   m.snapshot_fp = fp;
   // Every member payload is cluster-stamped; keep the last known name so
@@ -256,6 +276,7 @@ bool poll_member_delta(const h2::Transport& transport, const Options& opt,
     if (!docs.workloads.is_null()) m.snap.workloads = std::move(docs.workloads);
     if (!docs.signals.is_null()) m.snap.signals = std::move(docs.signals);
     if (!docs.decisions.is_null()) m.snap.decisions = std::move(docs.decisions);
+    if (!docs.capacity.is_null()) m.snap.capacity = std::move(docs.capacity);
     std::string cluster = m.snap.workloads.get_string("cluster");
     if (cluster.empty()) cluster = m.snap.signals.get_string("cluster");
     if (!cluster.empty()) m.snap.cluster = cluster;
@@ -400,18 +421,20 @@ int run(int argc, char** argv) {
   // endpoints serve well-formed documents (every member PENDING) from
   // the first request, not "{}" until a poll round lands.
   fleet::FleetView view;
-  json::Value roll_wl, roll_sig, roll_dec;
+  json::Value roll_wl, roll_sig, roll_dec, roll_cap;
   const std::string hub_cluster = fleet::cluster_name();
   auto remerge = [&](std::vector<fleet::MemberSnapshot> snaps) {
     fleet::FleetView next = fleet::aggregate(snaps, opt.stale_after_s);
     json::Value wl = fleet::rollup_workloads(next, hub_cluster);
     json::Value sig = fleet::rollup_signals(next, hub_cluster);
     json::Value dec = fleet::rollup_decisions(next, hub_cluster);
+    json::Value cap = fleet::rollup_capacity(next, hub_cluster);
     std::lock_guard<std::mutex> lock(view_mutex);
     view = std::move(next);
     roll_wl = std::move(wl);
     roll_sig = std::move(sig);
     roll_dec = std::move(dec);
+    roll_cap = std::move(cap);
   };
   {
     std::vector<fleet::MemberSnapshot> snaps;
@@ -428,6 +451,7 @@ int run(int argc, char** argv) {
       [&] { std::lock_guard<std::mutex> lock(view_mutex); return roll_wl; },
       [&] { std::lock_guard<std::mutex> lock(view_mutex); return roll_sig; },
       [&] { std::lock_guard<std::mutex> lock(view_mutex); return roll_dec; },
+      [&] { std::lock_guard<std::mutex> lock(view_mutex); return roll_cap; },
   });
 
   metrics_http::Server server(opt.metrics_port);
@@ -447,6 +471,7 @@ int run(int argc, char** argv) {
     if (sub == "workloads") return view.workloads.is_null() ? "{}" : view.workloads.dump();
     if (sub == "signals") return view.signals.is_null() ? "{}" : view.signals.dump();
     if (sub == "decisions") return view.decisions.is_null() ? "{}" : view.decisions.dump();
+    if (sub == "capacity") return view.capacity.is_null() ? "{}" : view.capacity.dump();
     if (sub == "clusters" || sub.empty())
       return view.clusters.is_null() ? "{}" : view.clusters.dump();
     return "";
@@ -464,6 +489,10 @@ int run(int argc, char** argv) {
   server.set_decisions_provider([&](const std::string&) {
     std::lock_guard<std::mutex> lock(view_mutex);
     return roll_dec.is_null() ? std::string("{}") : roll_dec.dump();
+  });
+  server.set_capacity_provider([&] {
+    std::lock_guard<std::mutex> lock(view_mutex);
+    return roll_cap.is_null() ? std::string("{}") : roll_cap.dump();
   });
   server.set_delta_provider([&](const std::string& query, const std::function<bool()>& abort) {
     return hub_journal.handle_request(query, abort);

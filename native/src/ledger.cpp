@@ -413,6 +413,17 @@ std::vector<PausedRoot> paused_roots() {
   return out;
 }
 
+std::vector<FreedAccount> freed_accounts() {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  std::vector<FreedAccount> out;
+  for (const auto& [key, a] : r.accounts) {
+    if (!a.paused) continue;
+    out.push_back({a.kind, a.ns, a.name, a.chips_when_paused, a.state()});
+  }
+  return out;
+}
+
 json::Value workloads_json(const std::string& query_string) {
   std::string want_ns, sort = "reclaimed";
   for (const std::string& pair : util::split(query_string, '&')) {

@@ -161,6 +161,11 @@ void Server::set_signals_provider(std::function<std::string()> provider) {
   signals_provider_ = std::move(provider);
 }
 
+void Server::set_capacity_provider(std::function<std::string()> provider) {
+  std::lock_guard<std::mutex> lock(probe_mutex_);
+  capacity_provider_ = std::move(provider);
+}
+
 void Server::set_timers_provider(std::function<std::string()> provider) {
   std::lock_guard<std::mutex> lock(probe_mutex_);
   timers_provider_ = std::move(provider);
@@ -407,6 +412,20 @@ void Server::handle_connection(int fd) {
         status_text = "Not Found";
         body = "signal watchdog not available\n";
       }
+    } else if (path == "/debug/capacity") {
+      std::function<std::string()> provider;
+      {
+        std::lock_guard<std::mutex> lock(probe_mutex_);
+        provider = capacity_provider_;
+      }
+      if (provider) {
+        content_type = "application/json";
+        body = provider();
+      } else {
+        status = 404;
+        status_text = "Not Found";
+        body = "capacity inventory not enabled (--capacity on)\n";
+      }
     } else if (path == "/debug/timers") {
       std::function<std::string()> provider;
       {
@@ -452,7 +471,8 @@ void Server::handle_connection(int fd) {
       } else {
         status = 404;
         status_text = "Not Found";
-        body = provider ? "no such fleet view (try workloads, signals, decisions, clusters)\n"
+        body = provider ? "no such fleet view (try workloads, signals, decisions, "
+                          "capacity, clusters)\n"
                         : "fleet endpoints are served by the federation hub (tpu-pruner hub)\n";
       }
     } else if (path == "/debug/cycles" || util::starts_with(path, "/debug/cycles/")) {
@@ -496,6 +516,9 @@ void Server::handle_connection(int fd) {
              "{\"path\":\"/debug/timers\",\"description\":\"event-engine time plane: timer-"
              "wheel occupancy, pending deadlines, token-bucket gate windows "
              "(--reconcile event)\"}," +
+             "{\"path\":\"/debug/capacity\",\"description\":\"capacity observatory: freed-"
+             "chip inventory + slice-topology map — whole-free vs partial-idle slices, "
+             "consolidation potential (--capacity on)\"}," +
              "{\"path\":\"/debug/delta\",\"description\":\"delta-federation change journal: "
              "?since=<epoch>&gen=<generation>&wait_ms=<long-poll> serves O(churn) surface "
              "diffs (full snapshot on first poll or aged-out cursor)\"}," +
@@ -505,6 +528,9 @@ void Server::handle_connection(int fd) {
              "minimum coverage + named brownout/unreachable clusters (tpu-pruner hub)\"}," +
              "{\"path\":\"/debug/fleet/decisions\",\"description\":\"federation hub: recent "
              "DecisionRecords per member cluster (tpu-pruner hub)\"}," +
+             "{\"path\":\"/debug/fleet/capacity\",\"description\":\"federation hub: the "
+             "fleet's free-TPU supply map — per-cluster inventories + summed totals "
+             "(tpu-pruner hub)\"}," +
              "{\"path\":\"/debug/fleet/clusters\",\"description\":\"federation hub: member "
              "status table — OK / PENDING / UNREACHABLE, staleness, poll errors "
              "(tpu-pruner hub)\"}" +

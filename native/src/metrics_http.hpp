@@ -64,6 +64,11 @@ class Server {
   // evidence assessment). Unset → 404.
   void set_signals_provider(std::function<std::string()> provider);
 
+  // /debug/capacity provider (the capacity observatory's free-TPU
+  // inventory). Unset → 404 with a hint that the surface exists under
+  // --capacity on.
+  void set_capacity_provider(std::function<std::string()> provider);
+
   // /debug/fleet/* provider (the federation hub's merged views): receives
   // the subpath ("workloads" | "signals" | "decisions" | "clusters") and
   // the raw query string, returns the JSON body — an empty return means
@@ -110,6 +115,7 @@ class Server {
   std::function<std::string(const std::string&)> workloads_provider_;
   std::function<std::string(const std::string&)> cycles_provider_;
   std::function<std::string()> signals_provider_;
+  std::function<std::string()> capacity_provider_;
   std::function<std::string()> timers_provider_;
   std::function<std::string(const std::string&, const std::string&)> fleet_provider_;
   std::function<std::string(const std::string&, const std::function<bool()>&)>

@@ -11,6 +11,7 @@
 #include <tuple>
 
 #include "tpupruner/audit.hpp"
+#include "tpupruner/capacity.hpp"
 #include "tpupruner/core.hpp"
 #include "tpupruner/fleet.hpp"
 #include "tpupruner/gym.hpp"
@@ -54,6 +55,7 @@ struct OpenCapsule {
   Value stats;                          // {num_series, num_pods, shutdown_events}
   Value incremental;                    // differential-engine provenance (dirty set, hits)
   Value reconcile;                      // event-engine provenance (mode + trigger)
+  Value capacity;                       // {inputs, doc} — the capacity observatory stamp
   std::vector<Value> decisions;         // verbatim DecisionRecord JSON
   bool armed = false;
   size_t remaining = 0;
@@ -181,6 +183,9 @@ void seal_locked(Registry& r, uint64_t cycle) {
   // Same provenance-not-evidence contract for the event engine's trigger
   // stamp: absent in cycle mode, normalized away in cross-mode diffs.
   if (!c.reconcile.is_null()) doc.set("reconcile", std::move(c.reconcile));
+  // Capacity observatory stamp (--capacity on): the canonical {inputs,
+  // doc} pair `analyze --capacity-report` recomputes bit-for-bit.
+  if (!c.capacity.is_null()) doc.set("capacity", std::move(c.capacity));
   doc.set("decisions", std::move(decisions));
 
   fs::path final_path = fs::path(r.dir) / (id + ".json");
@@ -422,6 +427,14 @@ void record_reconcile(uint64_t cycle, Value info) {
   c->reconcile = std::move(info);
 }
 
+void record_capacity(uint64_t cycle, Value stamp) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  c->capacity = std::move(stamp);
+}
+
 void record_breaker(uint64_t cycle, int64_t limit, size_t actionable, size_t deferred) {
   Registry& r = reg();
   std::lock_guard<std::mutex> lock(r.mutex);
@@ -650,6 +663,12 @@ Value replay(const Value& capsule, const Value& what_if) {
   }
   const std::string recorded_right_size = right_size;
   const double recorded_rs_threshold = rs_threshold;
+  // Slice-topology gate config (absent on pre-capacity capsules → off,
+  // exactly how those cycles ran). The gate's verdicts are cycle facts
+  // (the slice_shared_busy root flag); what-if slice_gate=off re-opens
+  // the held roots, =on on a capsule recorded without the gate is a
+  // no-op (no flags were captured to honor).
+  std::string slice_gate = cfg.get_string("slice_gate", "off");
   // Signal-quality watchdog config (absent on pre-watchdog capsules →
   // guard off, exactly how those cycles ran).
   std::string signal_guard = cfg.get_string("signal_guard", "off");
@@ -712,12 +731,17 @@ Value replay(const Value& capsule, const Value& what_if) {
         if (!(rs_threshold > 0.0 && rs_threshold <= 1.0)) {
           throw std::runtime_error("what-if right_size_threshold: expected (0, 1]");
         }
+      } else if (key == "slice_gate") {
+        slice_gate = value_string(key, val);
+        if (slice_gate != "on" && slice_gate != "off") {
+          throw std::runtime_error("what-if slice_gate: expected on|off");
+        }
       } else {
         throw std::runtime_error(
             "unknown what-if key: " + key +
             " (supported: lookback, duration, grace, run_mode, enabled_resources, "
             "max_scale_per_cycle, hbm_threshold, signal_min_coverage, signal_guard, "
-            "right_size, right_size_threshold)");
+            "right_size, right_size_threshold, slice_gate)");
       }
     }
     if (window_derived && !lookback_explicit) lookback_s = qargs.duration_min * 60 + grace_s;
@@ -1035,6 +1059,13 @@ Value replay(const Value& capsule, const Value& what_if) {
     } else if (flag_set(id, "group_not_idle")) {
       outcomes[id] = {audit::Reason::GroupNotIdle, "none",
                       "group has active (or too-young) TPU hosts", false, false};
+    } else if (slice_gate == "on" && flag_set(id, "slice_shared_busy")) {
+      // Cycle fact like the group verdict: the slice-topology co-tenancy
+      // came from a cluster LIST the capsule can't re-derive. What-if
+      // slice_gate=off re-opens the root (it falls through to the
+      // breaker/actuation stages as a predicted flip).
+      outcomes[id] = {audit::Reason::SliceSharedBusy, "none",
+                      capacity::kSliceSharedBusyDetail, false, false};
     } else {
       survivors.push_back(id);
     }

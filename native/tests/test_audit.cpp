@@ -31,7 +31,7 @@ TP_TEST(audit_reason_codes_unique_and_stable) {
     for (size_t j = i + 1; j < codes.size(); ++j) TP_CHECK(codes[i] != codes[j]);
   }
   TP_CHECK_EQ(codes.front(), std::string("SCALED"));
-  TP_CHECK_EQ(codes.back(), std::string("HYSTERESIS_HOLD"));
+  TP_CHECK_EQ(codes.back(), std::string("SLICE_SHARED_BUSY"));
 }
 
 TP_TEST(audit_ring_serves_and_filters) {

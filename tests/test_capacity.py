@@ -1,0 +1,647 @@
+"""Capacity observatory tests (the ISSUE 18 observability tentpole).
+
+``--capacity on`` folds the ledger's freed accounts, the node/pod LISTs
+and the evaluation's idle set into a live free-capacity inventory —
+whole-free vs partial-idle slices keyed by the GKE node-pool/topology
+labels — served on /debug/capacity, exported as tpu_pruner_capacity_*
+gauges, journaled as the delta federation's fourth surface, and stamped
+into flight capsules as the canonical {inputs, doc} pair that `analyze
+--capacity-report` recomputes bit-for-bit. The contract pinned here:
+
+  - the inventory math (capacity::build) classifies slices and sums
+    totals deterministically, independent of input list order;
+  - capsule capacity stamps are BYTE-IDENTICAL across ``--reconcile
+    event|cycle`` × ``--wire proto|json`` × shards 1 and 8;
+  - the defragmentation report dt-integrates consolidation potential
+    with the ledger's math, names pause vs right-size moves, and reports
+    byte drift as a first-class (rc 1) result;
+  - the delta protocol journals capacity as a fourth surface: full
+    snapshot on first poll, quiesced polls ship nothing, restart forces
+    a resync that still reconstructs the document;
+  - a parent hub fed one child-hub capacity rollup merges byte-identical
+    to a single hub over the leaves (hub-of-hubs determinism);
+  - ``--slice-gate on`` holds a root whose idle pods share a slice with
+    a busy tenant (audit reason SLICE_SHARED_BUSY), replays bit-for-bit,
+    and what-if slice_gate=off re-opens the root; the default (off) is
+    exact parity;
+  - the /debug discovery index is complete: every indexed route serves,
+    /debug/capacity and /debug/timers included, and the hub's fleet view
+    list matches the index.
+"""
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpu_pruner import native
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def node(name, pool, chips=4, topology="2x2"):
+    return {"name": name, "pool": pool, "topology": topology, "chips": chips}
+
+
+def place(pod, on, chips=4, idle=False, root=""):
+    return {"pod": pod, "node": on, "chips": chips, "idle": idle, "root": root}
+
+
+# ── inventory math units (capacity::build via the capi seam) ───────────
+
+
+def test_capacity_build_classifies_slices_and_sums_totals(built):
+    """Three slices, three states: a busy+free mix is partial-idle (its
+    free chips are fragmented), a fully-idle single tenant is
+    consolidatable, a pod-less slice is whole-free."""
+    out = native.capacity_build({
+        "nodes": [node("a0", "sA"), node("a1", "sA"),
+                  node("b0", "sB"), node("spare0", "spare")],
+        "placements": [
+            place("ml/busy-0", "a0", idle=False, root="Deployment/ml/busy"),
+            place("ml/idle-0", "b0", idle=True, root="Deployment/ml/idle"),
+        ],
+        "freed": [{"kind": "Deployment", "ns": "ml", "name": "old",
+                   "chips": 8, "state": "paused"}],
+    })
+    doc = out["doc"]
+    t = doc["totals"]
+    assert t == {"slices": 3, "chips": 16, "free_chips": 8,
+                 "whole_free_slices": 1, "fragmented_chips": 4,
+                 "consolidatable_slices": 1,
+                 "consolidation_potential_chips": 4, "freed_chips": 8}
+    states = {s["pool"]: s["state"] for s in doc["slices"]}
+    assert states == {"sA": "partial_idle", "sB": "partial_idle",
+                      "spare": "whole_free"}
+    cons = {s["pool"]: s["consolidatable"] for s in doc["slices"]}
+    assert cons == {"sA": False, "sB": True, "spare": False}
+    sB = next(s for s in doc["slices"] if s["pool"] == "sB")
+    assert sB["tenants"] == [{"root": "Deployment/ml/idle", "chips": 4,
+                              "idle_chips": 4, "idle": True}]
+    assert doc["freed"] == {"chips": 8, "accounts": 1,
+                            "by_kind": {"Deployment": 8}}
+    # All capacity families are gauges: classic == OpenMetrics render.
+    assert out["metrics"] == out["metrics_openmetrics"]
+    assert 'tpu_pruner_capacity_freed_chips{root_kind="Deployment"} 8' \
+        in out["metrics"]
+    assert 'tpu_pruner_capacity_whole_free_slices{topology="2x2"} 1' \
+        in out["metrics"]
+    assert "tpu_pruner_capacity_fragmented_chips 4" in out["metrics"]
+    assert "tpu_pruner_capacity_consolidation_potential_chips 4" \
+        in out["metrics"]
+    for family in native.capacity_metric_families():
+        assert family in out["metrics"]
+
+
+def test_capacity_build_is_input_order_independent(built):
+    """The canonical inputs round-trip sorts nodes/placements/freed, so
+    the inventory — and therefore every byte-identity contract downstream
+    — is a pure function of the fact SET, not the LIST order."""
+    inputs = {
+        "nodes": [node("a0", "sA"), node("b0", "sB"), node("spare0", "sp")],
+        "placements": [
+            place("ml/p1", "a0", idle=True, root="Deployment/ml/d1"),
+            place("ml/p0", "b0", idle=False, root="Deployment/ml/d0"),
+        ],
+        "freed": [
+            {"kind": "JobSet", "ns": "tpu", "name": "j", "chips": 16,
+             "state": "paused"},
+            {"kind": "Deployment", "ns": "ml", "name": "d", "chips": 4,
+             "state": "paused"},
+        ],
+    }
+    reversed_inputs = {k: list(reversed(v)) for k, v in inputs.items()}
+    a, b = native.capacity_build(inputs), native.capacity_build(reversed_inputs)
+    assert json.dumps(a["inputs_canonical"], sort_keys=True) == \
+        json.dumps(b["inputs_canonical"], sort_keys=True)
+    assert json.dumps(a["doc"], sort_keys=True) == \
+        json.dumps(b["doc"], sort_keys=True)
+    assert a["metrics"] == b["metrics"]
+
+
+def test_capacity_shared_busy_roots(built):
+    """The slice gate's predicate: an idle root is held exactly when a
+    slice hosting its idle pods also hosts a busy TPU tenant."""
+    out = native.capacity_build({
+        "nodes": [node("n1", "p1"), node("n2", "p1"), node("n3", "p2")],
+        "placements": [
+            place("ml/victim-0", "n1", idle=True, root="Deployment/ml/victim"),
+            place("ml/hog-0", "n2", idle=False, root="Deployment/ml/hog"),
+            place("ml/clean-0", "n3", idle=True, root="Deployment/ml/clean"),
+        ],
+        "freed": [],
+    })
+    assert out["shared_busy_roots"] == ["Deployment/ml/victim"]
+    # No busy co-tenant anywhere → nothing held.
+    out = native.capacity_build({
+        "nodes": [node("n1", "p1")],
+        "placements": [place("ml/victim-0", "n1", idle=True,
+                             root="Deployment/ml/victim")],
+        "freed": [],
+    })
+    assert out["shared_busy_roots"] == []
+
+
+# ── the defragmentation report (capacity::report) ──────────────────────
+
+
+def _stamp(cycle, now_unix, inputs):
+    return {"cycle": cycle, "now_unix": now_unix, "inputs": inputs,
+            "doc": native.capacity_build(inputs)["doc"]}
+
+
+def test_capacity_report_integrates_and_names_moves(built):
+    """dt-integration holds each stamp's consolidation potential for the
+    interval since the previous stamp (first stamp integrates nothing);
+    moves come from the last stamp — pause when the root is fully idle
+    cluster-wide, right-size when it has busy replicas elsewhere."""
+    def inputs(idle):
+        return {
+            "nodes": [node("a0", "sA"), node("b0", "sB")],
+            "placements": [
+                place("ml/a-0", "a0", idle=idle, root="Deployment/ml/a"),
+                place("ml/b-0", "b0", idle=False, root="Deployment/ml/b"),
+            ],
+            "freed": [],
+        }
+    report = native.capacity_report([
+        _stamp(1, 1000, inputs(idle=False)),
+        _stamp(2, 1060, inputs(idle=True)),
+        _stamp(3, 1120, inputs(idle=True)),
+    ])
+    assert report["drift"] is False and report["drifted_cycles"] == []
+    assert report["capsules"] == 3 and report["window_s"] == 120
+    cons = report["consolidation"]
+    # potential is 4 chips at stamps 2 and 3, held 60 s each.
+    assert cons["chip_seconds"] == 480
+    assert cons["chip_hours"] == pytest.approx(480 / 3600.0)
+    assert cons["whole_free_slices_now"] == 0
+    assert cons["freed_whole_slices"] == 1
+    assert cons["whole_free_slices_after"] == 1
+    assert report["moves"] == [{"root": "Deployment/ml/a", "pool": "sA",
+                                "action": "pause", "idle_chips": 4}]
+    assert "frees 1 whole slice(s)" in report["summary"]
+
+    # A root with busy replicas on another slice gets a right-size, not a
+    # pause — shedding only the idle replicas keeps the live ones up.
+    mixed = {
+        "nodes": [node("a0", "sA"), node("b0", "sB")],
+        "placements": [
+            place("ml/r-0", "a0", idle=True, root="Deployment/ml/r"),
+            place("ml/r-1", "b0", idle=False, root="Deployment/ml/r"),
+        ],
+        "freed": [],
+    }
+    report = native.capacity_report([_stamp(1, 1000, mixed)])
+    assert report["consolidation"]["chip_seconds"] == 0  # single stamp
+    assert report["moves"] == [{"root": "Deployment/ml/r", "pool": "sA",
+                                "action": "right_size", "idle_chips": 4}]
+
+
+def test_capacity_report_flags_byte_drift(built):
+    """A recorded inventory that the recomputation cannot reproduce is a
+    first-class result — drift:true with the cycle named, and rc 1 from
+    the analyze CLI (the bit-for-bit claim is the product)."""
+    inputs = {
+        "nodes": [node("a0", "sA")],
+        "placements": [place("ml/a-0", "a0", idle=True,
+                             root="Deployment/ml/a")],
+        "freed": [],
+    }
+    stamps = [_stamp(1, 1000, inputs), _stamp(2, 1060, inputs)]
+    stamps[1]["doc"]["totals"]["free_chips"] += 1  # tampered record
+    report = native.capacity_report(stamps)
+    assert report["drift"] is True
+    assert report["drifted_cycles"] == [2]
+
+    # The CLI exits non-zero on drift, still printing the full report.
+    capsule = {"cycle": 2, "now_unix": 1060, "capacity": {
+        "inputs": stamps[1]["inputs"], "doc": stamps[1]["doc"]}}
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        path = Path(d) / "cycle-000002.json"
+        path.write_text(json.dumps(capsule))
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_pruner.analyze",
+             "--capacity-report", str(path)],
+            capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "REPLAY DRIFT" in proc.stderr
+    assert json.loads(proc.stdout)["drift"] is True
+
+
+# ── THE acceptance: capacity stamps are byte-identical across engines ──
+
+
+def run_daemon(fake_prom, fake_k8s, *extra, run_mode="dry-run", cycles=3,
+               reconcile="event", wire="json"):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--prometheus-token", "cap-test", "--run-mode", run_mode,
+           "--watch-cache", "on", "--reconcile", reconcile, "--wire", wire,
+           "--daemon-mode", "--check-interval", "1",
+           "--max-cycles", str(cycles), *extra]
+    proc = subprocess.run(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc
+
+
+def _sliced_cluster(fake_prom, fake_k8s):
+    """Two single-tenant idle slices, one shared busy slice, one spare —
+    every slice state the inventory distinguishes."""
+    fake_k8s.add_node("spare-0", pool="slice-spare", topology="2x2")
+    pools = (("slice-0", True), ("slice-1", True), ("slice-2", False))
+    for i, (pool, idle) in enumerate(pools):
+        fake_k8s.add_node(f"{pool}-n0", pool=pool, topology="2x2")
+        _, _, pods = fake_k8s.add_deployment_chain(
+            "ml", f"dep-{i}", num_pods=1, tpu_chips=4, nodes=[f"{pool}-n0"])
+        if idle:
+            fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml",
+                                          chips=4)
+
+
+def test_capacity_stamps_byte_identical_across_mode_wire_shards(
+        built, fake_prom, fake_k8s, tmp_path):
+    """The same quiesced sliced cluster recorded by every engine
+    combination — event vs cycle reconcile, proto vs JSON wire, 1 vs 8
+    shards — produces byte-identical capsule capacity stamps: the supply
+    map is a pure function of the cluster, never of the plumbing."""
+    _sliced_cluster(fake_prom, fake_k8s)
+    outputs = {}
+    for shards in (1, 8):
+        for mode in ("cycle", "event"):
+            for wire in ("json", "proto"):
+                flight = tmp_path / f"flight-{shards}-{mode}-{wire}"
+                run_daemon(fake_prom, fake_k8s, "--shards", str(shards),
+                           "--capacity", "on", "--flight-dir", str(flight),
+                           reconcile=mode, wire=wire)
+                stamps = []
+                for p in sorted(flight.glob("cycle-*.json")):
+                    capsule = json.loads(p.read_text())
+                    assert "capacity" in capsule, p.name
+                    stamps.append(capsule["capacity"])
+                assert len(stamps) == 3
+                outputs[(shards, mode, wire)] = json.dumps(stamps,
+                                                           sort_keys=True)
+    baseline = outputs[(1, "cycle", "json")]
+    doc = json.loads(baseline)[0]["doc"]
+    assert doc["totals"]["slices"] == 4
+    assert doc["totals"]["whole_free_slices"] == 1
+    for combo, stamped in outputs.items():
+        assert stamped == baseline, f"capacity stamps differ at {combo}"
+
+
+# ── the delta federation's fourth surface ──────────────────────────────
+
+
+def test_delta_journals_capacity_as_fourth_surface(built):
+    """First poll ships the capacity snapshot, a quiesced poll ships
+    nothing, a capacity-only change re-ships it, and a member restart
+    forces a resync that still reconstructs the document byte-for-byte."""
+    wl = {"cluster": "c1", "sort": "reclaimed", "tracked": 0,
+          "totals": {"idle_seconds": 0.0, "active_seconds": 0.0,
+                     "reclaimed_chip_seconds": 0.0},
+          "workloads": []}
+    sig = {"cluster": "c1", "enabled": True, "coverage_ratio": 1.0}
+    dec = {"cluster": "c1", "capacity": 8, "dropped": 0, "decisions": []}
+
+    def cap(freed):
+        doc = native.capacity_build({
+            "nodes": [node("a0", "sA"), node("spare0", "sp")],
+            "placements": [place("ml/a-0", "a0", idle=True,
+                                 root="Deployment/ml/a")],
+            "freed": [{"kind": "Deployment", "ns": "ml", "name": "a",
+                       "chips": freed, "state": "paused"}] if freed else [],
+        })["doc"]
+        doc["cluster"] = "c1"
+        return doc
+
+    cap1, cap2 = cap(0), cap(4)
+    res = native.delta_sim([
+        {"op": "publish", "workloads": wl, "signals": sig, "decisions": dec,
+         "capacity": cap1},
+        {"op": "poll"},   # full snapshot carries the fourth surface
+        {"op": "poll"},   # quiesced
+        {"op": "publish", "workloads": wl, "signals": sig, "decisions": dec,
+         "capacity": cap2},
+        {"op": "poll"},   # capacity-only delta
+        {"op": "restart"},
+        {"op": "publish", "workloads": wl, "signals": sig, "decisions": dec,
+         "capacity": cap2},
+        {"op": "poll"},   # stale-generation cursor → resync
+    ])
+    full, quiesced, churn, resync = res[1], res[2], res[4], res[7]
+    assert full["applied"]["changed"]
+    assert json.dumps(full["docs"]["capacity"], sort_keys=True) == \
+        json.dumps(cap1, sort_keys=True)
+    assert not quiesced["applied"]["changed"]
+    assert "surfaces" not in quiesced["response"]
+    assert churn["applied"]["changed"]
+    assert json.dumps(churn["docs"]["capacity"], sort_keys=True) == \
+        json.dumps(cap2, sort_keys=True)
+    assert resync["response"].get("resync") is True
+    assert json.dumps(resync["docs"]["capacity"], sort_keys=True) == \
+        json.dumps(cap2, sort_keys=True)
+
+
+# ── hub-of-hubs: two-level capacity rollup pinned to single-level ──────
+
+
+def test_capacity_rollup_two_level_matches_single_level(built):
+    """A parent hub fed one child hub's rollup documents merges the
+    capacity view byte-identical to a single hub over both leaves — the
+    rollup's per-cluster rows carry each inventory verbatim, so nothing
+    is lost in the middle tier."""
+    def member(cluster, idle):
+        doc = native.capacity_build({
+            "nodes": [node(f"{cluster}-n0", f"{cluster}-s0"),
+                      node(f"{cluster}-spare", f"{cluster}-sp")],
+            "placements": [place(f"ml/{cluster}-0", f"{cluster}-n0",
+                                 idle=idle, root=f"Deployment/ml/{cluster}")],
+            "freed": [],
+        })["doc"]
+        doc["cluster"] = cluster
+        wl = {"cluster": cluster, "sort": "reclaimed", "tracked": 1,
+              "totals": {"idle_seconds": 5.0, "active_seconds": 0.0,
+                         "reclaimed_chip_seconds": 1.0},
+              "workloads": [{"workload": f"Deployment/ml/{cluster}",
+                             "kind": "Deployment", "namespace": "ml",
+                             "name": cluster, "chips": 4,
+                             "idle_seconds": 5.0,
+                             "reclaimed_chip_seconds": 1.0}]}
+        return {"url": f"http://{cluster}", "cluster": cluster,
+                "reachable": True, "workloads": wl, "capacity": doc}
+
+    leaves = [member("c1", True), member("c2", False)]
+    single = native.fleet_aggregate(leaves, stale_after_s=30)
+
+    child = native.fleet_aggregate(leaves, stale_after_s=30,
+                                   hub_cluster="hub-a")
+    rollup = child["capacity_rollup"]
+    assert rollup["rollup"] is True and rollup["cluster"] == "hub-a"
+    # The rollup rows carry each member inventory VERBATIM.
+    for leaf in leaves:
+        row = next(c for c in rollup["clusters"]
+                   if c["cluster"] == leaf["cluster"])
+        assert json.dumps(row["inventory"], sort_keys=True) == \
+            json.dumps(leaf["capacity"], sort_keys=True)
+
+    # Parent hub over the child hub: the workloads rollup marks the member
+    # as a child hub; the capacity rollup reconstructs the leaves.
+    hub_member = {"url": "http://hub-a", "cluster": "hub-a",
+                  "reachable": True,
+                  "workloads": {"rollup": True, "cluster": "hub-a",
+                                "clusters": child["workloads"]["clusters"]},
+                  "capacity": rollup}
+    two_level = native.fleet_aggregate([hub_member], stale_after_s=30)
+    assert json.dumps(two_level["capacity"], sort_keys=True) == \
+        json.dumps(single["capacity"], sort_keys=True)
+    assert two_level["capacity"]["fleet_totals"]["slices"] == 4
+    assert two_level["capacity"]["fleet_totals"]["whole_free_slices"] == 2
+
+
+def test_hub_capacity_delta_vs_snapshot_byte_identical(built, tmp_path):
+    """A --fleet-delta hub (riding the fourth journaled surface) and a
+    snapshot hub polling the same real --capacity member serve the same
+    /debug/fleet/capacity bytes once both have the member's inventory."""
+    import time
+    from tpu_pruner.testing.fake_fleet import FakeFleet
+    with FakeFleet(tmp_path) as fleet:
+        member = fleet.add_member(
+            "dv-east", idle_pods=1, slice_topology="2x2",
+            extra_args=("--capacity", "on"))
+        fleet.start_hub(poll_interval=1, stale_after=10,
+                        extra_args=("--fleet-delta", "on"))
+        _, snap_port = fleet.start_child_hub([member.url], cluster="snap",
+                                             poll_interval=1, stale_after=10)
+
+        def settled(get_json):
+            doc = get_json("/debug/fleet/capacity")
+            rows = doc.get("clusters", []) if isinstance(doc, dict) else []
+            return any(c.get("cluster") == "dv-east" and "inventory" in c
+                       for c in rows)
+
+        import urllib.request
+
+        def snap_get_json(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{snap_port}{path}", timeout=5) as r:
+                return json.loads(r.read().decode())
+
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            try:
+                if settled(fleet.hub_get_json) and settled(snap_get_json):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.3)
+        # The member's inventory is stable (quiesced fixture), so once
+        # both hubs hold it the merged documents must agree byte-for-byte
+        # (modulo member URL stamps, which name different poll targets —
+        # here both hubs poll the same URL, so even those agree).
+        delta_doc = fleet.hub_get_json("/debug/fleet/capacity")
+        snap_doc = snap_get_json("/debug/fleet/capacity")
+        assert json.dumps(delta_doc, sort_keys=True) == \
+            json.dumps(snap_doc, sort_keys=True)
+        assert delta_doc["fleet_totals"]["slices"] >= 1
+
+
+# ── the slice-topology group gate (--slice-gate on) ────────────────────
+
+
+def _gate_fixture():
+    """A victim idle root sharing pool p1 with a busy hog, plus a clean
+    idle root alone on p2. The hog has no metrics series — never idle."""
+    prom, k8s = FakePrometheus(), FakeK8s()
+    prom.start()
+    k8s.start()
+    for name, pool in (("n1", "p1"), ("n2", "p1"), ("n3", "p2")):
+        k8s.add_node(name, pool=pool, topology="2x2", tpu_chips=4)
+    _, _, pods = k8s.add_deployment_chain("ml", "victim", num_pods=1,
+                                          tpu_chips=4, nodes=["n1"])
+    prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml", chips=4)
+    k8s.add_pod("ml", "hog-0", owners=[k8s.owner("DaemonSet", "hog")],
+                node="n2", tpu_chips=4)
+    _, _, pods = k8s.add_deployment_chain("ml", "clean", num_pods=1,
+                                          tpu_chips=4, nodes=["n3"])
+    prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml", chips=4)
+    return prom, k8s
+
+
+def _gate_run(tmp_path, tag, *extra, cycles=1):
+    prom, k8s = _gate_fixture()
+    audit = tmp_path / f"audit-{tag}.jsonl"
+    try:
+        cmd = [str(DAEMON_PATH), "--prometheus-url", prom.url,
+               "--run-mode", "scale-down", "--daemon-mode",
+               "--check-interval", "1", "--max-cycles", str(cycles),
+               "--audit-log", str(audit), *extra]
+        proc = subprocess.run(cmd, env={"KUBE_API_URL": k8s.url},
+                              capture_output=True, text=True, timeout=180)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+    finally:
+        prom.stop()
+        k8s.stop()
+    return [json.loads(line) for line in audit.read_text().splitlines()]
+
+
+def test_slice_gate_holds_shared_busy_root(built, tmp_path):
+    """With the gate on, the victim is held with SLICE_SHARED_BUSY (its
+    slice hosts a busy co-tenant) while the clean root still scales; with
+    the default (off) the victim scales — exact parity, the reason never
+    appears."""
+    records = _gate_run(tmp_path, "on", "--slice-gate", "on")
+    by_reason = {}
+    for r in records:
+        by_reason.setdefault(r["reason"], []).append(r)
+    held = by_reason.get("SLICE_SHARED_BUSY")
+    assert held, f"no SLICE_SHARED_BUSY record: {sorted(by_reason)}"
+    assert all("victim" in r["pod"] for r in held)
+    assert all(r["action"] == "none" for r in held)
+    assert all("busy co-tenants" in r.get("detail", "") for r in held)
+    scaled = {r["pod"] for r in by_reason.get("SCALED", [])}
+    assert any("clean" in p for p in scaled)
+    assert not any("victim" in p for p in scaled)
+
+    records = _gate_run(tmp_path, "off")
+    reasons = {r["reason"] for r in records}
+    assert "SLICE_SHARED_BUSY" not in reasons
+    scaled = {r["pod"] for r in records if r["reason"] == "SCALED"}
+    assert any("victim" in p for p in scaled)
+
+
+def test_slice_gate_replays_and_what_if_reopens(built, tmp_path):
+    """A gate-on capsule replays the hold bit-for-bit offline, and
+    `--what-if slice_gate=off` flips the victim to a predicted scale —
+    the gate is a replayable decision input like every other knob."""
+    prom, k8s = _gate_fixture()
+    flight = tmp_path / "flight"
+    try:
+        cmd = [str(DAEMON_PATH), "--prometheus-url", prom.url,
+               "--run-mode", "scale-down", "--daemon-mode",
+               "--check-interval", "1", "--max-cycles", "1",
+               "--slice-gate", "on", "--capacity", "on",
+               "--flight-dir", str(flight)]
+        proc = subprocess.run(cmd, env={"KUBE_API_URL": k8s.url},
+                              capture_output=True, text=True, timeout=180)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+    finally:
+        prom.stop()
+        k8s.stop()
+    (capsule,) = sorted(flight.glob("cycle-*.json"))
+    assert "capacity" in json.loads(capsule.read_text())
+
+    def replay(*what_if):
+        args = [sys.executable, "-m", "tpu_pruner.analyze", "--replay",
+                str(capsule)]
+        if what_if:
+            args += ["--what-if", *what_if]
+        proc = subprocess.run(args, capture_output=True, text=True,
+                              timeout=120)
+        return proc.returncode, (json.loads(proc.stdout)
+                                 if proc.stdout.strip() else {}), proc.stderr
+
+    rc, out, err = replay()
+    assert rc == 0, err
+    assert out["match"] is True
+    replayed = {d["pod"]: d["reason"] for d in out["replayed"]}
+    assert any("victim" in p and r == "SLICE_SHARED_BUSY"
+               for p, r in replayed.items()), replayed
+
+    rc, out, _ = replay("slice_gate=off")
+    assert rc == 0
+    flips = [f for f in out["flips"]
+             if f["from"]["reason"] == "SLICE_SHARED_BUSY"]
+    assert flips, out["flips"]
+    assert all(f["to"]["reason"] == "SCALED" and f["predicted"]
+               for f in flips)
+
+    rc, _, err = replay("slice_gate=sometimes")
+    assert rc != 0
+    assert "slice_gate" in err
+
+
+# ── /debug discovery index completeness (satellite: observability) ─────
+
+
+def test_debug_index_lists_every_served_surface(built, tmp_path):
+    """Every route the member daemon dispatches appears in the /debug
+    index (capacity and timers included), every indexed member route
+    actually serves with the right flags on, and the hub's fleet-view
+    list matches the index's /debug/fleet entries."""
+    src = (REPO / "native" / "src" / "metrics_http.cpp").read_text()
+    indexed = set(re.findall(r'\\"path\\":\\"([^\\]+)\\"', src))
+    assert {"/debug/capacity", "/debug/timers"} <= indexed
+
+    # Source-side completeness: every exact-match dispatch branch and
+    # every prefix-dispatch root is indexed.
+    served = set(re.findall(r'path == "(/[^"]+)"', src))
+    served -= {"/debug", "/debug/"}  # the index itself
+    for prefix in re.findall(r'starts_with\(path,\s*"(/[^"]+?)/?"\)', src):
+        served.add(prefix.rstrip("/"))
+    served.discard("/debug/fleet")  # indexed per-view, checked below
+    missing = sorted(p for p in served if p not in indexed)
+    assert not missing, f"served but not in the /debug index: {missing}"
+
+    # The hub's fleet views (from its own 404 hint) are all indexed.
+    hint = re.search(r"no such fleet view \(try ([^)]+)\)", src)
+    assert hint
+    views = re.findall(r"[a-z]+", hint.group(1))
+    for view in views:
+        assert f"/debug/fleet/{view}" in indexed
+
+    # Live: a fully-flagged member + hub serve every indexed route.
+    from tpu_pruner.testing.fake_fleet import FakeFleet
+    with FakeFleet(tmp_path) as fleet:
+        member = fleet.add_member(
+            "idx", idle_pods=1, slice_topology="2x2",
+            extra_args=("--capacity", "on", "--watch-cache", "on",
+                        "--reconcile", "event",
+                        "--flight-dir", str(tmp_path / "flight")))
+        fleet.start_hub(poll_interval=1, stale_after=10)
+        # Let one evaluation land so the per-provider routes (capacity,
+        # cycles, timers) have something to serve, and the hub a poll.
+        import time
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            try:
+                if (isinstance(member.get_json("/debug/capacity"), dict)
+                        and json.loads(member.get("/debug/cycles"))
+                        and any(m.get("status") == "OK" for m in
+                                fleet.hub_get_json(
+                                    "/debug/fleet/clusters")["members"])):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.3)
+        index = member.get_json("/debug")
+        live_paths = {r["path"] for r in index["routes"]}
+        assert live_paths == indexed
+        for path in sorted(live_paths):
+            if path.startswith("/debug/fleet/"):
+                body = fleet.hub_get(path)
+            else:
+                body = member.get(path)  # raises on a non-2xx status
+            assert body, path

@@ -479,3 +479,47 @@ def test_every_served_metric_documented(built):
             proc.wait(timeout=10)
         prom.stop()
         k8s.stop()
+
+
+def test_capacity_surfaces_documented(built):
+    """Every capacity metric family (native canonical list) plus the
+    operator-facing capacity surfaces must appear in the OPERATIONS.md
+    'Capacity as a product' runbook — adding a family or surface without
+    documenting it fails here."""
+    doc = OPERATIONS.read_text()
+    families = native.capacity_metric_families()
+    assert len(families) >= 4
+    missing = [f for f in families if f not in doc]
+    assert not missing, (
+        f"capacity metric families missing from docs/OPERATIONS.md: {missing} "
+        "— document each in the Observability table and the 'Capacity as "
+        "a product' section")
+    needles = (
+        "Capacity as a product",
+        "--capacity on",
+        "--slice-gate",
+        "/debug/capacity",
+        "/debug/fleet/capacity",
+        "SLICE_SHARED_BUSY",
+        "cloud.google.com/gke-tpu-topology",
+        "whole-free",
+        "partial-idle",
+        "--capacity-report",
+        "capacity-smoke",
+        "slice_gate",
+        "defrag",
+    )
+    for needle in needles:
+        assert needle in doc, (
+            f"capacity surface {needle!r} missing from docs/OPERATIONS.md")
+
+
+def test_capacity_bench_summary_fields_documented():
+    """The capacity bench summary fields must be emitted by bench.py AND
+    described in BENCH_FIELDS.md."""
+    bench_src = (REPO / "bench.py").read_text()
+    fields_doc = (REPO / "docs" / "BENCH_FIELDS.md").read_text()
+    for field in ("capacity_whole_free_slices", "capacity_defrag_report_p50_ms"):
+        assert f'"{field}"' in bench_src, f"bench.py no longer emits {field}"
+        assert field in fields_doc, (
+            f"bench summary field {field} missing from docs/BENCH_FIELDS.md")

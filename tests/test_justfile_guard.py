@@ -88,6 +88,23 @@ def test_gym_smoke_recipe_present_and_wired():
     assert callable(module.main)
 
 
+def test_capacity_smoke_recipe_present_and_wired():
+    """`just capacity-smoke` must exist and invoke the real smoke module
+    — the capacity-observatory contract (member inventory, hub rollup
+    agreement, bit-for-bit defrag-report replay) would otherwise go
+    unguarded in CI."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^capacity-smoke\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)",
+                  text, re.M)
+    assert m, "justfile has no `capacity-smoke:` recipe"
+    assert "tpu_pruner.testing.capacity_smoke" in m.group(1), (
+        "capacity-smoke no longer invokes tpu_pruner.testing.capacity_smoke")
+    import importlib
+
+    module = importlib.import_module("tpu_pruner.testing.capacity_smoke")
+    assert callable(module.main)
+
+
 def test_bench_mega_recipe_present_and_wired():
     """`just bench-mega` must exist and invoke the real mega tier — the
     scale contract (shard speedup, bit-for-bit replay under N shards,

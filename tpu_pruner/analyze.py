@@ -70,6 +70,16 @@ table on stderr, one JSON document on stdout. Synthetic corpora come
 from tpu_pruner.testing.trace_gen (diurnal load, flapping idleness,
 resume storms, brownout windows).
 
+Defragmentation-report mode (`--capacity-report <flight-dir|capsule.json|
+url>`): replay the capacity observatory offline. Each capsule recorded
+with `--capacity on` stamps the canonical {inputs, doc} pair; the report
+recomputes every inventory from its inputs (byte-level drift against the
+recorded document is flagged per cycle and exits 1), dt-integrates the
+consolidation potential across the window with the ledger's math, and
+lists — from the last stamp — the pause/right-size moves that would
+consolidate partial-idle slices into whole free ones. Human summary on
+stderr, one JSON document on stdout.
+
 Signal-health mode (`--signal-report <capsule.json|url>`): render the
 fleet's evidence health from the signal-quality watchdog (`--signal-guard
 on` on the daemon) — per-pod verdicts (healthy / stale / gappy / absent),
@@ -454,6 +464,54 @@ def _run_gym(args) -> int:
     winner = result["winner"]
     print(f"\nwinner: {winner['name']}\napply with: {winner['flag_line']}",
           file=sys.stderr)
+    print(json.dumps(result))
+    return 0
+
+
+def _run_capacity_report(args) -> int:
+    """Replayable defragmentation report over capsule capacity stamps."""
+    capsules = _load_gym_capsules(args.capacity_report)
+    if not capsules:
+        print(f"no capsules found at {args.capacity_report} (need a "
+              "--flight-dir directory, capsule file, or daemon URL)",
+              file=sys.stderr)
+        return 1
+    stamps = []
+    for c in capsules:
+        stamp = c.get("capacity")
+        if not stamp:
+            continue  # recorded without --capacity on
+        stamps.append({"cycle": c.get("cycle"), "now_unix": c.get("now_unix"),
+                       "inputs": stamp.get("inputs"), "doc": stamp.get("doc")})
+    if not stamps:
+        print(f"{len(capsules)} capsule(s) but no capacity stamps — the "
+              "recording daemon ran without --capacity on", file=sys.stderr)
+        return 1
+
+    from tpu_pruner import native
+
+    result = native.capacity_report(stamps)
+
+    cons = result["consolidation"]
+    inv = result["inventory"]["totals"]
+    print(f"capacity report: {result['capsules']} stamp(s), cycles "
+          f"{result['first_cycle']}..{result['last_cycle']}, window "
+          f"{result['window_s']}s", file=sys.stderr)
+    print(f"  now: {inv['slices']} slice(s), {inv['free_chips']} free / "
+          f"{inv['chips']} chips, {inv['whole_free_slices']} whole-free, "
+          f"{inv['fragmented_chips']} fragmented", file=sys.stderr)
+    print(f"  {result['summary']}", file=sys.stderr)
+    for m in result.get("moves", []):
+        print(f"    {m['action']:10s} {m['root']:40s} slice {m['pool']} "
+              f"({m['idle_chips']} idle chip(s))", file=sys.stderr)
+    if result["drift"]:
+        print(f"  REPLAY DRIFT — {len(result['drifted_cycles'])} cycle(s) "
+              "whose recorded inventory differs from the recomputed one: "
+              f"{result['drifted_cycles']}", file=sys.stderr)
+        print(json.dumps(result))
+        return 1
+    print(f"  replay: all {result['capsules']} recorded inventories "
+          "reproduced bit-for-bit", file=sys.stderr)
     print(json.dumps(result))
     return 0
 
@@ -869,6 +927,16 @@ def main(argv=None) -> int:
                              "apart instead of using the capsules' own "
                              "clocks — for synthetic corpora recorded "
                              "back-to-back (default 0 = capsule clocks)")
+    parser.add_argument("--capacity-report", metavar="SOURCE",
+                        help="defragmentation-report mode: recompute every "
+                             "capsule's capacity inventory from its recorded "
+                             "inputs (bit-for-bit, drift flagged), "
+                             "dt-integrate consolidation potential across "
+                             "the window, and list the pause/right-size "
+                             "moves that free whole slices. SOURCE is a "
+                             "--flight-dir directory, capsule file, or "
+                             "daemon URL (bare http://host:port expands to "
+                             "/debug/cycles)")
     parser.add_argument("--signal-report", metavar="SOURCE",
                         help="signal-health mode: render the fleet's "
                              "evidence health (per-pod verdicts, coverage, "
@@ -901,12 +969,20 @@ def main(argv=None) -> int:
                              "window from this dump")
     args = parser.parse_args(argv)
     if args.gym:
-        if args.replay or args.explain or args.fleet_report or args.signal_report:
+        if (args.replay or args.explain or args.fleet_report
+                or args.signal_report or args.capacity_report):
             parser.error("--gym is mutually exclusive with --replay, "
-                         "--explain, --fleet-report and --signal-report")
+                         "--explain, --fleet-report, --signal-report and "
+                         "--capacity-report")
         return _run_gym(args)
     if args.gym_policy or args.as_recorded:
         parser.error("--gym-policy/--as-recorded only apply with --gym")
+    if args.capacity_report:
+        if args.replay or args.explain or args.fleet_report or args.signal_report:
+            parser.error("--capacity-report is mutually exclusive with "
+                         "--replay, --explain, --fleet-report and "
+                         "--signal-report")
+        return _run_capacity_report(args)
     if args.signal_report:
         if args.replay or args.explain or args.fleet_report:
             parser.error("--signal-report is mutually exclusive with "

@@ -171,6 +171,9 @@ def load() -> ctypes.CDLL:
         "tp_shard_of",
         "tp_fleet_metric_families",
         "tp_fleet_aggregate",
+        "tp_capacity_metric_families",
+        "tp_capacity_build",
+        "tp_capacity_report",
         "tp_stamp_exposition",
         "tp_delta_sim",
         "tp_timerwheel_sim",
@@ -424,17 +427,21 @@ def fleet_metric_families() -> list[str]:
 
 
 def fleet_aggregate(members: list[dict], stale_after_s: int = 30,
-                    decisions_per_member: int | None = None) -> dict:
+                    decisions_per_member: int | None = None,
+                    hub_cluster: str | None = None) -> dict:
     """Run the REAL hub merge math (native/src/fleet.cpp) over synthetic
     member snapshots. Each member: {"url", "cluster", "reachable",
     "ever_reached"?, "staleness_s"?, "polls"?, "failures"?, "last_error"?,
     "workloads"?, "signals"?, "decisions"?} where workloads/signals/
-    decisions are the member's /debug documents. Returns the four
-    /debug/fleet documents plus "metrics"/"metrics_openmetrics" exposition
-    text."""
+    decisions are the member's /debug documents (plus "capacity"? — a
+    member's /debug/capacity inventory). Returns the five /debug/fleet
+    documents, "metrics"/"metrics_openmetrics" exposition text, and
+    "capacity_rollup" — the hub's own /debug/capacity body."""
     payload: dict = {"members": members, "stale_after_s": stale_after_s}
     if decisions_per_member is not None:
         payload["decisions_per_member"] = decisions_per_member
+    if hub_cluster is not None:
+        payload["hub_cluster"] = hub_cluster
     return _call("tp_fleet_aggregate", payload)
 
 
@@ -442,6 +449,32 @@ def stamp_exposition(body: str, cluster: str) -> str:
     """Insert cluster="..." into every sample line of a Prometheus text
     exposition (the fleet identity choke point; idempotent)."""
     return _call("tp_stamp_exposition", {"body": body, "cluster": cluster})["body"]
+
+
+def capacity_metric_families() -> list[str]:
+    """Canonical tpu_pruner_capacity_* family names served on /metrics with
+    --capacity on — the docs drift-guard test joins this list against
+    docs/OPERATIONS.md."""
+    return _call("tp_capacity_metric_families", {})["families"]
+
+
+def capacity_build(inputs: dict) -> dict:
+    """Run the REAL capacity-inventory math (native/src/capacity.cpp) over
+    a canonical inputs record {"nodes": [...], "placements": [...],
+    "freed": [...]}. Returns {"doc" (the inventory), "inputs_canonical"
+    (order-normalized round-trip), "shared_busy_roots" (the slice gate's
+    held roots), "metrics", "metrics_openmetrics"}."""
+    return _call("tp_capacity_build", {"inputs": inputs})
+
+
+def capacity_report(stamps: list[dict]) -> dict:
+    """The replayable defragmentation report (capacity::report) — the
+    `analyze --capacity-report` backend. ``stamps`` is a list of capsule
+    capacity stamps [{"cycle", "now_unix", "inputs", "doc"}...]; every
+    inventory is recomputed from its inputs (byte drift reported per
+    cycle) and consolidation potential is dt-integrated across the
+    window with the ledger's math."""
+    return _call("tp_capacity_report", {"stamps": stamps})
 
 
 def delta_sim(steps: list[dict], log_cap: int | None = None) -> list[dict]:

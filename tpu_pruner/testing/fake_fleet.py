@@ -76,7 +76,7 @@ class FleetMember:
     def __init__(self, cluster: str, tmp_dir: Path, *, idle_pods: int = 1,
                  stale_pods: int = 0, tpu_chips: int = 4,
                  signal_guard: str = "on", run_mode: str = "scale-down",
-                 extra_args: tuple = ()):
+                 slice_topology: str | None = None, extra_args: tuple = ()):
         from tpu_pruner.native import DAEMON_PATH
         from tpu_pruner.testing import FakeK8s, FakePrometheus
 
@@ -92,8 +92,19 @@ class FleetMember:
         # out (healthy siblings then defer with SIGNAL_BROWNOUT but still
         # resolve, so the member's ledger tracks their roots).
         for i in range(idle_pods + stale_pods):
+            nodes = None
+            if slice_topology:
+                # One single-tenant slice per deployment: node i in pool
+                # "<cluster>-slice-i" with the GKE topology label, pod i
+                # placed on it — the capacity observatory's unit fixture.
+                node = f"{cluster}-node-{i}"
+                self.k8s.add_node(node, pool=f"{cluster}-slice-{i}",
+                                  topology=slice_topology,
+                                  tpu_chips=tpu_chips)
+                nodes = [node]
             _, _, pods = self.k8s.add_deployment_chain(
-                "ml", f"{cluster}-dep-{i}", num_pods=1, tpu_chips=tpu_chips)
+                "ml", f"{cluster}-dep-{i}", num_pods=1, tpu_chips=tpu_chips,
+                nodes=nodes)
             knobs = {"chips": tpu_chips}
             if i >= idle_pods:
                 knobs["last_sample_age"] = 4000.0

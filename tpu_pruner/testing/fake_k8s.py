@@ -516,7 +516,7 @@ class FakeK8s:
         return {"apiVersion": "v1", "kind": kind, "name": name, "uid": uid, "controller": True}
 
     def add_pod(self, ns, name, owners=None, labels=None, phase="Running",
-                created_age=7200, tpu_chips=4, no_creation_ts=False):
+                created_age=7200, tpu_chips=4, no_creation_ts=False, node=None):
         pod = {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -537,10 +537,39 @@ class FakeK8s:
             },
             "status": {"phase": phase},
         }
+        if node:
+            pod["spec"]["nodeName"] = node
         if no_creation_ts:
             del pod["metadata"]["creationTimestamp"]
         self.objects[f"/api/v1/namespaces/{ns}/pods/{name}"] = pod
         return pod
+
+    def add_node(self, name, pool=None, topology=None, tpu_chips=4,
+                 device="google.com/tpu"):
+        """Cluster-scoped Node carrying the GKE slice-topology labels the
+        capacity observatory reads (nodepool = slice id, tpu-topology =
+        slice shape) plus an allocatable accelerator quantity."""
+        labels = {}
+        if pool:
+            labels["cloud.google.com/gke-nodepool"] = pool
+        if topology:
+            labels["cloud.google.com/gke-tpu-topology"] = topology
+        meta = {
+            "name": name,
+            "uid": str(uuid.uuid4()),
+            "resourceVersion": "1",
+            "creationTimestamp": age(7200),
+        }
+        if labels:
+            meta["labels"] = labels
+        node = {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": meta,
+            "status": {"allocatable": {device: str(tpu_chips)}},
+        }
+        self.objects[f"/api/v1/nodes/{name}"] = node
+        return node
 
     def _add_apps(self, plural, kind, ns, name, uid=None, owners=None, replicas=2):
         obj = {
@@ -672,7 +701,8 @@ class FakeK8s:
 
     # ── deployment chain helper (Pod→RS→Deployment) ──
     def add_deployment_chain(self, ns, name, num_pods=1, tpu_chips=4, pod_age=7200,
-                             pod_labels=None, annotations=None, replicas=None):
+                             pod_labels=None, annotations=None, replicas=None,
+                             nodes=None):
         dep = self.add_deployment(
             ns, name, replicas=replicas if replicas is not None else 2)
         if annotations:
@@ -685,7 +715,8 @@ class FakeK8s:
                 ns, f"{name}-abc123-{i}",
                 owners=[self.owner("ReplicaSet", rs["metadata"]["name"], rs["metadata"]["uid"])],
                 labels=dict(pod_labels) if pod_labels else None,
-                tpu_chips=tpu_chips, created_age=pod_age)
+                tpu_chips=tpu_chips, created_age=pod_age,
+                node=nodes[i % len(nodes)] if nodes else None)
             for i in range(num_pods)
         ]
         return dep, rs, pods
@@ -1029,6 +1060,7 @@ class FakeK8s:
             COLLECTIONS = {
                 "pods", "replicasets", "deployments", "statefulsets", "jobs",
                 "jobsets", "leaderworkersets", "notebooks", "inferenceservices",
+                "nodes",
             }
 
             def _collection_object_re(self, path):
@@ -1039,6 +1071,12 @@ class FakeK8s:
                     return None
                 if "/namespaces/" in path:
                     return re.compile(re.escape(path) + r"/[^/]+$")
+                # Nodes are cluster-scoped OBJECTS, not just a cluster-scoped
+                # LIST view over namespaced objects: they live directly at
+                # /api/v1/nodes/<name>, so they must not take the namespaced
+                # mapping below.
+                if path == "/api/v1/nodes":
+                    return re.compile(r"/api/v1/nodes/[^/]+$")
                 if m := re.fullmatch(r"/api/v1/([a-z]+)", path):
                     return re.compile(r"/api/v1/namespaces/[^/]+/%s/[^/]+$" % m.group(1))
                 if m := re.fullmatch(r"/apis/([^/]+)/([^/]+)/([a-z]+)", path):

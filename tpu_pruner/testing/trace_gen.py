@@ -21,6 +21,11 @@ Scenarios:
   brownout      always idle, but the evidence's last-sample age spikes
                 mid-corpus (record with --signal-guard on to exercise
                 SIGNAL_* vetoes and the fleet brownout in the corpus)
+  defrag        each workload pinned to its own slice (fake_k8s Nodes
+                with GKE nodepool/tpu-topology labels, pods placed via
+                spec.nodeName), draining one slice at a time — record
+                with --capacity on to exercise the capacity observatory's
+                partial-idle → whole-free inventory transitions
 
 Scripted fake_prom series repeat their LAST value once exhausted, so a
 script of ``cycles`` entries stays well-defined however many cycles the
@@ -33,7 +38,7 @@ import random
 import subprocess
 from pathlib import Path
 
-SCENARIOS = ("diurnal", "flapping", "resume-storm", "brownout")
+SCENARIOS = ("diurnal", "flapping", "resume-storm", "brownout", "defrag")
 
 # Evidence age served while a brownout window is open: far beyond the
 # default --signal-max-age of 300 s, so every pod reads STALE.
@@ -59,6 +64,15 @@ def generate(scenario: str, cycles: int, workloads: int = 3,
 
     spec = {"scenario": scenario, "cycles": cycles, "namespace": namespace,
             "chips": chips, "workloads": []}
+    if scenario == "defrag":
+        # One single-tenant slice (node pool) per workload: node w-j hosts
+        # the workload's j-th pod, so slice `slice-w` is whole-free exactly
+        # while workload w is idle.
+        spec["slices"] = [
+            {"pool": f"slice-{w}", "topology": "2x2",
+             "nodes": [f"slice-{w}-node-{j}" for j in range(pods_per_workload)]}
+            for w in range(workloads)
+        ]
     for w in range(workloads):
         values: list[float | None] = []
         ages: list[float] = [0.0] * cycles
@@ -84,6 +98,13 @@ def generate(scenario: str, cycles: int, workloads: int = 3,
             lo, hi = int(cycles * 0.4), int(cycles * 0.6)
             ages = [BROWNOUT_STALE_AGE if lo <= i < hi else 0.0
                     for i in range(cycles)]
+        elif scenario == "defrag":
+            # Staggered drain: workload w goes idle at cycle (w+1)*step and
+            # stays idle, so mid-corpus the fleet is a mix of whole-free and
+            # partial-idle slices (the defragmentation report's subject).
+            step = max(1, cycles // (workloads + 1))
+            values = [0.0 if i >= (w + 1) * step else None
+                      for i in range(cycles)]
         spec["workloads"].append({
             "name": f"{scenario.replace('-', '')}-{w}",
             "pods": pods_per_workload,
@@ -98,10 +119,20 @@ def install(spec: dict, fake_prom, fake_k8s) -> None:
     in fake_k8s (replicas = pod count) and one scripted duty-cycle series
     per pod in fake_prom, with the evidence-age script riding along."""
     ns = spec["namespace"]
-    for wl in spec["workloads"]:
+    slices = spec.get("slices")
+    if slices:
+        # Every slice gets its nodes — entries beyond the workload list are
+        # empty (whole-free) spare slices the capacity inventory should see.
+        for sl in slices:
+            for node_name in sl["nodes"]:
+                fake_k8s.add_node(node_name, pool=sl["pool"],
+                                  topology=sl["topology"],
+                                  tpu_chips=spec["chips"])
+    for w, wl in enumerate(spec["workloads"]):
+        nodes = slices[w]["nodes"] if slices else None
         _, _, pods = fake_k8s.add_deployment_chain(
             ns, wl["name"], num_pods=wl["pods"], tpu_chips=spec["chips"],
-            replicas=wl["pods"])
+            replicas=wl["pods"], nodes=nodes)
         for pod in pods:
             fake_prom.add_scripted_pod_series(
                 pod["metadata"]["name"], ns, list(wl["values"]),
