@@ -268,3 +268,19 @@ tsan-event:
     cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
     ./build-tsan/tpupruner_tests timerwheel
     ./build-tsan/tpupruner_tests informer
+
+# provenance-trace smoke: record a traced action, breach a 1 ms
+# detect→action SLO so the trace pins past ring eviction, fetch it by id
+# at /debug/traces/<id>, and render the waterfall three ways (analyze
+# --trace by id, --slow, offline capsule stamp) — non-zero exit on any
+# miss. tests/test_justfile_guard.py pins the recipe to the module.
+trace-smoke:
+    python -m tpu_pruner.testing.trace_smoke
+
+# trace-engine race tier: concurrent span begin/add/arm/actuation-end/
+# export against ring eviction and /debug/traces index reads under
+# ThreadSanitizer (substring filter of the native test binary)
+tsan-trace:
+    cmake -G Ninja -S . -B build-tsan -DTP_TSAN=ON && cmake --build build-tsan
+    ./build-tsan/tpupruner_tests trace
+    ./build-tsan/tpupruner_tests informer

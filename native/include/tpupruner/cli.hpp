@@ -185,6 +185,18 @@ struct Cli {
   // (SLICE_SHARED_BUSY) instead of evicted. Implies the same node/pod
   // listing as --capacity. "off" (default) keeps exact decision parity.
   std::string slice_gate = "off";
+  // --trace {on, off}: action provenance traces (trace.hpp). "on" builds
+  // one causal span tree per evaluation (rooted at trigger ingress, with
+  // per-phase / per-shard / per-actuation children) retained in a bounded
+  // ring at /debug/traces and exported over OTLP when the exporter is
+  // live. "off" (default) keeps audit/capsule/ledger output byte-exact;
+  // the flag never enters the config fingerprint.
+  std::string trace = "off";
+  // --slo-detect-to-action-ms: detect→action latency objective. > 0 arms
+  // the SLO engine (tpu_pruner_slo_* counters + burn ratio), judges every
+  // actuation's root-relative latency, and pins breaching traces past
+  // normal ring eviction. Requires --trace on. 0 (default) disables.
+  int64_t slo_detect_to_action_ms = 0;
   std::string otlp_endpoint;              // --otlp-endpoint (default: $OTEL_EXPORTER_OTLP_ENDPOINT)
   std::string gcp_project;                // --gcp-project (Cloud Monitoring PromQL API)
   std::string monitoring_endpoint = "https://monitoring.googleapis.com";  // --monitoring-endpoint

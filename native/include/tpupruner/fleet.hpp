@@ -74,15 +74,18 @@ struct MemberSnapshot {
   json::Value signals;      // member /debug/signals
   json::Value decisions;    // member /debug/decisions
   json::Value capacity;     // member /debug/capacity (null: not running --capacity)
+  json::Value slo;          // member SLO summary, the "slo" key of
+                            // /debug/traces (null: not running --trace)
 };
 
-// The four /debug/fleet/* documents plus the fleet metric families'
+// The /debug/fleet/* documents plus the fleet metric families'
 // exposition text, derived from one poll round's snapshots.
 struct FleetView {
   json::Value workloads;  // /debug/fleet/workloads
   json::Value signals;    // /debug/fleet/signals
   json::Value decisions;  // /debug/fleet/decisions
   json::Value capacity;   // /debug/fleet/capacity (free-TPU supply map)
+  json::Value slo;        // /debug/fleet/slo (detect→action burn + worst traces)
   json::Value clusters;   // /debug/fleet/clusters
   std::string metrics_text;        // classic exposition
   std::string metrics_openmetrics; // OpenMetrics TYPE naming
@@ -125,6 +128,7 @@ json::Value rollup_workloads(const FleetView& view, const std::string& hub_clust
 json::Value rollup_signals(const FleetView& view, const std::string& hub_cluster);
 json::Value rollup_decisions(const FleetView& view, const std::string& hub_cluster);
 json::Value rollup_capacity(const FleetView& view, const std::string& hub_cluster);
+json::Value rollup_slo(const FleetView& view, const std::string& hub_cluster);
 
 // Status string for one member snapshot ("OK" | "PENDING" |
 // "UNREACHABLE") — the same derivation aggregate() applies, exposed so

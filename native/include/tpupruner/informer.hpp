@@ -234,11 +234,12 @@ class Reflector {
   // drain the journal would grow for the life of the process.
   void enable_dirty_journal();
   // Event fan-out (--reconcile event): invoked (outside the journal lock)
-  // after every journal mark — the dispatcher's wake signal. Must be set
-  // BEFORE start() (read lock-free on the reflector thread) and must not
-  // call back into the reflector; a notify is a hint to drain, not a
-  // payload.
-  void set_dirty_notify(std::function<void()> notify);
+  // after every journal mark — the dispatcher's wake signal, carrying the
+  // monotonic ms the event was decoded (the trigger-ingress stamp the
+  // trace engine backdates its root span to). Must be set BEFORE start()
+  // (read lock-free on the reflector thread) and must not call back into
+  // the reflector; a notify is a hint to drain, not a payload.
+  void set_dirty_notify(std::function<void(int64_t arrival_mono_ms)> notify);
   // const: drains a logically-external queue (the cycle holds the cache
   // by const pointer); journal state is mutable under its own mutex.
   void drain_dirty(std::vector<std::string>& paths, bool& all) const;
@@ -273,7 +274,7 @@ class Reflector {
   // dirty_mutex_; journal_enabled_ is set once before start() (daemon
   // startup) and read on every event, so it is atomic.
   std::atomic<bool> journal_enabled_{false};
-  std::function<void()> dirty_notify_;  // set before start(); see setter
+  std::function<void(int64_t)> dirty_notify_;  // set before start(); see setter
   mutable std::mutex dirty_mutex_;
   mutable std::vector<std::string> dirty_paths_;
   mutable bool dirty_all_ = false;
@@ -325,8 +326,9 @@ class ClusterCache {
   // Enable journaling on every reflector (call before start()).
   void enable_dirty_journal();
   // Event fan-out: wake `notify` after any reflector journals a mark
-  // (--reconcile event's watch-plane trigger). Call before start().
-  void set_dirty_notify(std::function<void()> notify);
+  // (--reconcile event's watch-plane trigger), passing the monotonic ms
+  // the event was decoded. Call before start().
+  void set_dirty_notify(std::function<void(int64_t arrival_mono_ms)> notify);
   // Everything touched since the last drain, across all resources.
   // `all == true` means at least one resource relisted (or its journal
   // overflowed) — events may have been missed, so the caller must treat

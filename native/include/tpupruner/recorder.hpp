@@ -108,6 +108,13 @@ void record_capacity(uint64_t cycle, json::Value stamp);
 // normalize the "reconcile" key away. Never written in cycle mode, so
 // cycle-mode capsules are byte-identical to pre-event builds.
 void record_reconcile(uint64_t cycle, json::Value info);
+// Normalized action-provenance trace stamp (--trace on): {trace_id,
+// trigger, root_start_nanos, spans-so-far} from trace::capsule_stamp —
+// the trace-id ↔ capsule cross-link `analyze --trace` joins on. Pure
+// provenance like the incremental/reconcile stamps: replay never reads
+// it, cross-mode byte-identity diffs normalize the key away, and it is
+// never written with --trace off (capsules stay byte-identical).
+void record_trace(uint64_t cycle, json::Value stamp);
 // Cycle facts: fail-closed veto sets, per-root gate flags, breaker stamp.
 void record_vetoes(uint64_t cycle, const std::vector<std::string>& vetoed_roots,
                    const std::vector<std::pair<std::string, std::string>>& vetoed_namespaces);

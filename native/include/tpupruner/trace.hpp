@@ -1,0 +1,150 @@
+// Action provenance traces (--trace on): per-evaluation causal span trees
+// plus a detect→action SLO engine.
+//
+// PR 16 collapsed detect→scaledown latency to tens of milliseconds, but
+// the only view into that path was aggregate histograms — when one action
+// takes 2 s instead of 100 ms nothing says *which phase, shard, retry, or
+// debounce extension* ate the budget. This module is the measurement
+// substrate: every evaluation builds ONE span tree rooted at trigger
+// ingress (watch-event arrival / probe sample flip / timer expiry /
+// anti-entropy tick), with child spans for debounce wait, query, decode,
+// signal, per-shard resolve, merge, cross-root gates, and one span per
+// actuation patch carrying its retry/backoff ticks as span events.
+//
+// Completed traces land in a bounded in-memory ring served at
+// /debug/traces (index + SLO summary) and /debug/traces/<id> (full tree);
+// when the OTLP exporter is live every sealed tree is also converted to
+// otlp::FinishedSpan records (events included) and rides the existing
+// TraceService export. The trace id doubles as the W3C traceparent /
+// histogram-exemplar id, so an exemplar on detect_to_action_seconds now
+// resolves to a real retained trace.
+//
+// SLO engine: --slo-detect-to-action-ms N judges every actuation's
+// root-relative latency, feeds good/bad budget counters and a burn-ratio
+// gauge, and PINS every breaching trace past normal ring eviction so the
+// evidence for a 3am "why was this slow" survives the storm that caused
+// it. The hub rolls per-member burn + worst traces into /debug/fleet/slo.
+//
+// Parity contract: with --trace off every entry point is a no-op and the
+// flag is excluded from the audit/capsule config fingerprint — audit
+// JSONL, capsules, ledger and `analyze --replay` are byte-identical with
+// tracing on and off (pinned by tests at shards 1 and 8 × both reconcile
+// modes). The capsule gains a normalized "trace" stamp only when tracing
+// is on; byte-identity comparisons normalize that key away like
+// "incremental" and "reconcile".
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+
+namespace tpupruner::trace {
+
+// Timestamped point event inside a span — mirrors otlp::SpanEvent without
+// coupling the public header to the exporter's internals.
+struct Event {
+  int64_t time_nanos = 0;
+  std::string name;
+  std::vector<std::pair<std::string, std::string>> str_attrs;
+  std::vector<std::pair<std::string, int64_t>> int_attrs;
+};
+
+// One child span in an evaluation's tree. span_id is assigned by the
+// engine when the span attaches; parent defaults to the trace root.
+struct Span {
+  std::string name;
+  int64_t start_nanos = 0, end_nanos = 0;
+  std::vector<std::pair<std::string, std::string>> str_attrs;
+  std::vector<std::pair<std::string, int64_t>> int_attrs;
+  std::vector<Event> events;
+  bool error = false;
+  std::string error_message;
+};
+
+// ── lifecycle / configuration ──
+// `on` gates every hook below (all no-ops while off, zero contention on
+// the hot path beyond one relaxed atomic load). `slo_ms` > 0 arms the
+// detect→action SLO engine; 0 disables it.
+void configure(bool on, int64_t slo_ms);
+bool enabled();
+int64_t slo_ms();
+
+// ── per-evaluation capture (keyed by audit cycle id) ──
+// Open the evaluation's trace. `trigger` names the ingress (dirty /
+// probe / timer / anti_entropy / cycle); the root span is backdated by
+// `ingress_lag_ms` so it starts at trigger arrival, not evaluation start.
+// `hint_trace_id` (32 hex) reuses the OTLP cycle span's trace id when the
+// exporter is live — exemplars, headers, and the retained tree then all
+// share one id; "" mints a fresh id. Returns the trace id ("" while off).
+std::string begin(uint64_t cycle, const std::string& trigger, int64_t ingress_lag_ms,
+                  const std::string& hint_trace_id);
+
+// The trace id / W3C traceparent of an open (or just-sealed) evaluation;
+// "" when unknown or off. The traceparent carries the ROOT span id, so
+// fake_prom/fake_k8s header assertions join on the same id the exemplars
+// carry.
+std::string trace_id_of(uint64_t cycle);
+std::string traceparent(uint64_t cycle);
+
+// Attach a finished child span verbatim (shard resolves, debounce wait).
+void add_span(uint64_t cycle, Span span);
+// Convenience for the observe_phase call sites: a span that ENDED now and
+// lasted `seconds`, parented to the root.
+void add_phase_span(uint64_t cycle, const std::string& name, double seconds);
+
+// ── actuation spans (consumer threads) ──
+// An actuation span is assembled in a thread-local between begin and end
+// so retry hooks (backoff::record_retry → thread_retry_event) append
+// LOCK-FREE from anywhere inside the patch attempt; the span only touches
+// the engine mutex once, at actuation_end.
+void actuation_begin(uint64_t cycle, const std::string& identity);
+// Appends a retry/backoff event to the thread's open actuation span.
+// Safe to call unconditionally — a no-op when no actuation is open (e.g.
+// informer relist retries on the reflector thread).
+void thread_retry_event(const std::string& endpoint, const std::string& cause,
+                        double backoff_seconds);
+// Close the span: `outcome` ∈ {scaled, right_sized, noop, error, ...};
+// `error` marks span status. Decrements the pending-actuation count and
+// seals the trace when the last one lands. Also feeds the SLO engine with
+// the actuation's root-relative latency.
+void actuation_end(uint64_t cycle, const std::string& outcome, bool error,
+                   const std::string& error_message);
+
+// Arm the trace for `expected` actuations; 0 seals immediately with zero
+// actuation spans (dry-run, no-candidate, SIGNAL_STALE / BROWNOUT veto
+// evaluations). Actuations that ended BEFORE arm (the incremental fast
+// path enqueues first) are credited at arm time, like recorder::arm.
+void arm(uint64_t cycle, size_t expected);
+
+// Normalized capsule stamp for the open trace ({trace_id, trigger,
+// root_start_nanos, spans-so-far}) — recorded via recorder::record_trace
+// at arm time so `analyze --trace <flight-dir>` renders waterfalls
+// offline. Null while off/unknown.
+json::Value capsule_stamp(uint64_t cycle);
+
+// ── serving ──
+// /debug/traces body: {"traces": [recent, newest first, capped], "slo":
+// slo_summary(), "retained": N, "pinned": N, "enabled": true}.
+json::Value index_json();
+// Full tree by trace id ("" when not retained).
+std::string trace_json(const std::string& id);
+// {"enabled", "slo_ms", "good", "bad", "breaches", "burn_ratio",
+// "worst": [{trace_id, cycle, trigger, root_ms}...]} — embedded in the
+// index doc; the hub folds it into /debug/fleet/slo.
+json::Value slo_summary();
+
+// ── metrics ──
+// Canonical native family list (tpu_pruner_trace_* / tpu_pruner_slo_*),
+// exported through the C API so tests/test_docs_drift.py holds
+// docs/OPERATIONS.md to the real set.
+const std::vector<std::string>& metric_families();
+// Prometheus text exposition; appended to /metrics by the daemon's
+// extra-metrics provider ("" while off, so the scrape is byte-identical).
+std::string render_metrics(bool openmetrics);
+
+void reset_for_test();
+
+}  // namespace tpupruner::trace

@@ -11,6 +11,7 @@
 #include <sstream>
 #include <thread>
 
+#include "tpupruner/trace.hpp"
 #include "tpupruner/util.hpp"
 
 namespace tpupruner::backoff {
@@ -122,14 +123,20 @@ bool sleep_interruptible(int64_t wait_ms, const std::atomic<bool>* stop) {
 
 void record_retry(const std::string& endpoint, const std::string& cause,
                   double backoff_seconds) {
-  Telemetry& t = telemetry();
-  std::lock_guard<std::mutex> lock(t.mu);
-  ++t.retries[{endpoint, cause}];
-  ++t.count;
-  t.sum += backoff_seconds;
-  for (size_t i = 0; i < 7; ++i) {
-    if (backoff_seconds <= Telemetry::kBuckets[i]) ++t.bucket_counts[i];
+  {
+    Telemetry& t = telemetry();
+    std::lock_guard<std::mutex> lock(t.mu);
+    ++t.retries[{endpoint, cause}];
+    ++t.count;
+    t.sum += backoff_seconds;
+    for (size_t i = 0; i < 7; ++i) {
+      if (backoff_seconds <= Telemetry::kBuckets[i]) ++t.bucket_counts[i];
+    }
   }
+  // Provenance traces: a retry inside an actuation patch lands as a span
+  // event on that actuation's span. No-op when no actuation is open on
+  // this thread (informer relists, evidence queries) or with --trace off.
+  trace::thread_retry_event(endpoint, cause, backoff_seconds);
 }
 
 const std::vector<std::string>& metric_families() {

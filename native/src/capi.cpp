@@ -36,6 +36,7 @@
 #include "tpupruner/shard.hpp"
 #include "tpupruner/signal.hpp"
 #include "tpupruner/timerwheel.hpp"
+#include "tpupruner/trace.hpp"
 #include "tpupruner/util.hpp"
 
 using tpupruner::json::Value;
@@ -622,6 +623,20 @@ char* tp_store_metric_families(const char*) {
   return guarded([&] {
     Value families = Value::array();
     for (const std::string& f : tpupruner::compact::store_metric_families()) {
+      families.push_back(Value(f));
+    }
+    Value out = Value::object();
+    out.set("families", std::move(families));
+    return ok(out);
+  });
+}
+
+char* tp_trace_metric_families(const char*) {
+  // The canonical trace/SLO metric family names — the docs-drift test
+  // joins this against docs/OPERATIONS.md.
+  return guarded([&] {
+    Value families = Value::array();
+    for (const std::string& f : tpupruner::trace::metric_families()) {
       families.push_back(Value(f));
     }
     Value out = Value::object();

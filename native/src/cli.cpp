@@ -302,6 +302,21 @@ TPU FLAGS:
                                 tenant (audit code SLICE_SHARED_BUSY)
                                 instead of fragmenting the slice. "off"
                                 keeps exact decision parity
+      --trace <M>               on | off [default: off] — action provenance
+                                traces: one causal span tree per evaluation
+                                (trigger ingress → debounce/query/decode/
+                                signal/resolve/merge/gates → one span per
+                                actuation with retry events), retained in a
+                                bounded ring at /debug/traces[/<id>] and
+                                exported as OTLP TraceService spans when the
+                                exporter is live. "off" keeps audit, capsule
+                                and ledger output byte-exact
+      --slo-detect-to-action-ms <N>
+                                detect→action latency objective in ms: judge
+                                every actuation, burn tpu_pruner_slo_* budget
+                                counters, pin breaching traces past ring
+                                eviction, roll burn into /debug/fleet/slo
+                                (requires --trace on) [default: 0 = off]
       --otlp-endpoint <URL>     push counters as OTLP/HTTP JSON metrics
                                 [default: $OTEL_EXPORTER_OTLP_ENDPOINT]
       --gcp-project <ID>        query the Cloud Monitoring PromQL API for this
@@ -534,6 +549,17 @@ Cli parse(int argc, char** argv) {
          if (!(cli.right_size_threshold > 0.0 && cli.right_size_threshold <= 1.0))
            throw CliError("--right-size-threshold must be in (0, 1]");
        }},
+      {"--trace",
+       [&](const std::string& v) {
+         check_choice("--trace", v, {"on", "off"});
+         cli.trace = v;
+       }},
+      {"--slo-detect-to-action-ms",
+       [&](const std::string& v) {
+         cli.slo_detect_to_action_ms = parse_int("--slo-detect-to-action-ms", v);
+         if (cli.slo_detect_to_action_ms < 0)
+           throw CliError("--slo-detect-to-action-ms must be >= 0");
+       }},
       {"--otlp-endpoint", [&](const std::string& v) { cli.otlp_endpoint = v; }},
       {"--gcp-project", [&](const std::string& v) { cli.gcp_project = v; }},
       {"--monitoring-endpoint", [&](const std::string& v) { cli.monitoring_endpoint = v; }},
@@ -630,6 +656,11 @@ Cli parse(int argc, char** argv) {
   // multi-hundred-cycle gym corpora against hermetic fakes (trace_gen).
   if (cli.check_interval < 0) throw CliError("--check-interval must be >= 0 seconds");
   if (cli.grace_period < 0) throw CliError("--grace-period must be >= 0");
+  if (cli.slo_detect_to_action_ms > 0 && cli.trace != "on") {
+    // The SLO engine judges per-actuation latency off the trace root —
+    // without the span trees there is nothing to measure or pin.
+    throw CliError("--slo-detect-to-action-ms requires --trace on");
+  }
   if (cli.leader_elect && !cli.daemon_mode) {
     throw CliError("--leader-elect requires --daemon-mode");
   }

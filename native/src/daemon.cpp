@@ -38,6 +38,7 @@
 #include "tpupruner/shard.hpp"
 #include "tpupruner/signal.hpp"
 #include "tpupruner/timerwheel.hpp"
+#include "tpupruner/trace.hpp"
 #include "tpupruner/util.hpp"
 #include "tpupruner/walker.hpp"
 #include "tpupruner/watchdog.hpp"
@@ -130,6 +131,16 @@ int64_t mono_ms() {
 std::atomic<int64_t> g_trigger_ms{0};
 std::atomic<timerwheel::TokenBucket*> g_event_bucket{nullptr};
 std::atomic<bool> g_event_full_pass{false};
+
+// Trace-engine trigger context (--trace on; set by run() before each
+// evaluation). g_trace_trigger names what woke this evaluation — fixed
+// literals only ("cycle" in cycle mode; the event loop stores
+// dirty/probe/timer/anti_entropy). g_trace_ingress_ms is the monotonic ms
+// the condition was DETECTED: prepare_cycle backdates the trace root to
+// it, so the waterfall shows trigger→evaluation wait (debounce, queue)
+// rather than starting at pipeline entry. 0 = no backdating.
+std::atomic<const char*> g_trace_trigger{"cycle"};
+std::atomic<int64_t> g_trace_ingress_ms{0};
 
 // --pause-after hysteresis: per-root consecutive idle-evaluation streaks
 // (the gym policy's flap damper, promoted to the live engine). A root
@@ -833,9 +844,18 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   // their ~0s too, so the _count advances shards×cycles in lockstep) —
   // the histogram that shows whether the walk stage scales with
   // --shards or one hot shard is the ceiling.
-  for (ShardScratch& sh : shards) {
+  for (size_t s = 0; s < shards.size(); ++s) {
+    ShardScratch& sh = shards[s];
     log::histogram_observe("cycle_phase_seconds", "resolve_shard", sh.secs,
                            parent_ctx.trace_id);
+    if (trace::enabled()) {
+      trace::Span span;
+      span.name = "resolve_shard";
+      span.end_nanos = util::now_unix_nanos();
+      span.start_nanos = span.end_nanos - static_cast<int64_t>(sh.secs * 1e9);
+      span.int_attrs.emplace_back("shard", static_cast<int64_t>(s));
+      trace::add_span(cycle_id, std::move(span));
+    }
   }
   log::debug("daemon", "resolve waves: " + std::to_string(secs_since(waves_t0) * 1000) + "ms");
 
@@ -1090,6 +1110,7 @@ ResolveOutcome resolve_pods(const cli::Cli& args, const k8s::Client& kube,
   // operators can see when merge (not the walk) becomes the ceiling.
   log::histogram_observe("cycle_phase_seconds", "merge", secs_since(merge_t0),
                          parent_ctx.trace_id);
+  trace::add_phase_span(cycle_id, "merge", secs_since(merge_t0));
   log::debug("daemon", "fold+merge+serve: " + std::to_string(secs_since(fold_t0) * 1000) + "ms");
 
   // Flight recorder: snapshot every owner/root object the walk consulted
@@ -1152,15 +1173,32 @@ Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
   otlp::Span& cycle = *p.span;
   cycle.attr("cycle", static_cast<int64_t>(p.cycle_id));
   p.trace_id = cycle.context().trace_id;
+  // Provenance trace (--trace on): open this evaluation's causal tree,
+  // rooted at trigger ingress (the root is backdated by the detect→prepare
+  // lag). The OTLP cycle trace id — when the exporter is recording —
+  // seeds the trace id so spans, exemplars and the /debug/traces ring all
+  // agree; with OTLP off the engine mints one and the exemplars adopt it,
+  // so a scraped exemplar still resolves at /debug/traces/<id>.
+  if (trace::enabled()) {
+    const int64_t ingress = g_trace_ingress_ms.load();
+    const int64_t lag = ingress > 0 ? std::max<int64_t>(mono_ms() - ingress, 0) : 0;
+    trace::begin(p.cycle_id, g_trace_trigger.load(), lag, p.trace_id);
+    if (p.trace_id.empty()) p.trace_id = trace::trace_id_of(p.cycle_id);
+  }
   p.cycle_start = std::chrono::steady_clock::now();
   const uint64_t cycle_id = p.cycle_id;
   const std::string& trace_id = p.trace_id;
   auto observe_phase = [&](const char* phase, std::chrono::steady_clock::time_point since) {
-    log::histogram_observe("cycle_phase_seconds", phase, secs_since(since), trace_id);
+    const double secs = secs_since(since);
+    log::histogram_observe("cycle_phase_seconds", phase, secs, trace_id);
     // Watchdog probe: a breached --cycle-deadline aborts the cycle HERE,
     // at the phase boundary, before the next phase's side effects.
-    // "total" is the cycle's own epilogue — nothing left to abort.
-    if (std::string_view(phase) != "total") watchdog::check(phase);
+    // "total" is the cycle's own epilogue — nothing left to abort (and
+    // the trace root already spans it, so no "total" child span either).
+    if (std::string_view(phase) != "total") {
+      trace::add_phase_span(cycle_id, phase, secs);
+      watchdog::check(phase);
+    }
   };
   with_span(cycle, [&] {
   auto phase_start = std::chrono::steady_clock::now();
@@ -1170,7 +1208,14 @@ Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
   prom::Client local_prom = persistent_prom ? prom::Client("", "") : build_prom_client(args);
   prom::Client& prom_client = persistent_prom ? *persistent_prom : local_prom;
   if (persistent_prom) prom_client.set_token(resolve_prom_token(args));
-  prom_client.set_traceparent(otlp::traceparent(cycle.context()));
+  {
+    // Client-default traceparent: the OTLP cycle span when recording, else
+    // the trace engine's root (--trace on without an exporter still tags
+    // outbound evidence with a resolvable trace id).
+    std::string tp = otlp::traceparent(cycle.context());
+    if (tp.empty()) tp = trace::traceparent(cycle_id);
+    prom_client.set_traceparent(tp);
+  }
   const bool zero_copy = json::zero_copy_enabled();
   // Binary wire path (--wire proto|auto): the instant queries negotiate
   // the protobuf exposition; a protobuf response decodes into samples in
@@ -1195,6 +1240,18 @@ Prepared prepare_cycle(const cli::Cli& args, const std::string& query,
     evidence_thread = std::thread([&] {
       try {
         otlp::Span span("prometheus.evidence_query", &cycle.context());
+        // Per-thread span-context override: the helper thread must send
+        // the EVIDENCE span's traceparent (same trace id as the idleness
+        // query, its own span id) — the client default alone would tag the
+        // evidence stream with the cycle span, and with OTLP off it would
+        // carry nothing at all. Thread-local, so the producer's concurrent
+        // idleness query is untouched; cleared before the thread exits.
+        std::string tp = otlp::traceparent(span.context());
+        if (tp.empty()) tp = trace::traceparent(cycle_id);
+        if (!tp.empty()) http::set_thread_traceparent(tp);
+        struct TpClear {
+          ~TpClear() { http::set_thread_traceparent(""); }
+        } tp_clear;
         with_span(span, [&] {
           if (wire_proto) {
             evidence_wire = prom_client.instant_query_wire(
@@ -1342,18 +1399,27 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
   // carries the cycle span's context, so server-side request logs join
   // the OTLP trace end-to-end. Consumer actuations override per-thread
   // with their own `scale` span context.
-  kube.set_traceparent(otlp::traceparent(cycle.context()));
+  {
+    std::string tp = otlp::traceparent(cycle.context());
+    if (tp.empty()) tp = trace::traceparent(cycle_id);
+    kube.set_traceparent(tp);
+  }
   const uint64_t api_calls_before = kube.api_calls();
   const auto cycle_start = p.cycle_start;
   metrics::DecodeResult& decoded = p.decoded;
   signal::Assessment& assessment = p.assessment;
   const bool signal_on = p.signal_on;
   auto observe_phase = [&](const char* phase, std::chrono::steady_clock::time_point since) {
-    log::histogram_observe("cycle_phase_seconds", phase, secs_since(since), trace_id);
+    const double secs = secs_since(since);
+    log::histogram_observe("cycle_phase_seconds", phase, secs, trace_id);
     // Watchdog probe: a breached --cycle-deadline aborts the cycle HERE,
     // at the phase boundary, before the next phase's side effects.
-    // "total" is the cycle's own epilogue — nothing left to abort.
-    if (std::string_view(phase) != "total") watchdog::check(phase);
+    // "total" is the cycle's own epilogue — nothing left to abort (and
+    // the trace root already spans it, so no "total" child span either).
+    if (std::string_view(phase) != "total") {
+      trace::add_phase_span(cycle_id, phase, secs);
+      watchdog::check(phase);
+    }
   };
   return with_span(cycle, [&] {
   auto phase_start = std::chrono::steady_clock::now();
@@ -1381,6 +1447,12 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
   }
   log::histogram_observe("cycle_phase_seconds", "cache_merge", resolved.cache_merge_secs,
                          trace_id);
+  trace::add_phase_span(cycle_id, "cache_merge", resolved.cache_merge_secs);
+  // The cross-root gate cascade (valves → group gate → slice gate →
+  // hysteresis → breaker → brownout → right-size) traces as ONE "gates"
+  // span: individual gates are microseconds, their ORDER is fixed, and
+  // per-root verdicts already land in DecisionRecords.
+  const auto gates_t0 = std::chrono::steady_clock::now();
   auto seg_t0 = std::chrono::steady_clock::now();
   auto seg = [&](const char* what) {
     log::debug("daemon", std::string(what) + ": " + std::to_string(secs_since(seg_t0) * 1000) +
@@ -1735,6 +1807,7 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
   }
 
   seg("group gate + breaker + brownout + right-size");
+  trace::add_phase_span(cycle_id, "gates", secs_since(gates_t0));
   CycleStats stats;
   stats.num_series = decoded.num_series;
   stats.num_pods = decoded.samples.size();
@@ -1917,7 +1990,13 @@ CycleStats finish_cycle(const cli::Cli& args, Prepared p, const k8s::Client& kub
     // capsule seals when the actuations drain; consumer outcomes that
     // land before arming are credited at arm time.
     audit::arm_actuation(cycle_id, args.dry_run() ? 0 : survivors.size(), trace_id);
+    // Capsule trace stamp BEFORE recorder::arm — a zero-expected arm seals
+    // the capsule immediately, and the stamp must already be inside it.
+    if (trace::enabled() && recorder::enabled()) {
+      recorder::record_trace(cycle_id, trace::capsule_stamp(cycle_id));
+    }
     recorder::arm(cycle_id, args.dry_run() ? 0 : survivors.size());
+    trace::arm(cycle_id, args.dry_run() ? 0 : survivors.size());
   };
   auto do_enqueue = [&] {
     for (ScaleTarget& t : survivors) {
@@ -2019,6 +2098,12 @@ int run(const cli::Cli& args) {
   proto::set_wire_mode(proto::wire_mode_from_string(args.wire));
   compact::set_enabled(args.compact_store == "on");
   capacity::set_enabled(args.capacity == "on");
+  // Action provenance traces (--trace on) + detect→action SLO engine.
+  // Deliberately absent from the incremental fingerprint and the capsule
+  // config below: tracing observes decisions, it never affects them.
+  trace::configure(args.trace == "on", args.slo_detect_to_action_ms);
+  g_trace_trigger.store("cycle");
+  g_trace_ingress_ms.store(0);
   log::info("daemon", std::string("Transport: ") + h2::mode_name(h2::default_mode()) +
             ", zero-copy JSON " + args.zero_copy_json + ", wire " +
             proto::wire_mode_name(proto::wire_mode()) + ", compact store " +
@@ -2145,12 +2230,11 @@ int run(const cli::Cli& args) {
     // stamp arrival times). Registered before start() — the reflector
     // threads read the callback pointer without a lock.
     if (event_on) {
-      watch_cache->set_dirty_notify([&ev] {
+      watch_cache->set_dirty_notify([&ev](int64_t arrival_mono_ms) {
         std::lock_guard<std::mutex> lock(ev.mu);
         ++ev.dirty_seq;
-        const int64_t now = mono_ms();
-        if (ev.first_dirty_ms == 0) ev.first_dirty_ms = now;
-        ev.last_dirty_ms = now;
+        if (ev.first_dirty_ms == 0) ev.first_dirty_ms = arrival_mono_ms;
+        ev.last_dirty_ms = arrival_mono_ms;
         ev.cv.notify_all();
       });
     }
@@ -2191,7 +2275,10 @@ int run(const cli::Cli& args) {
                           incremental::render_metrics(openmetrics) +
                           proto::render_wire_metrics(openmetrics) +
                           compact::render_store_metrics(openmetrics) +
-                          backoff::render_metrics(openmetrics);
+                          backoff::render_metrics(openmetrics) +
+                          // Trace/SLO families ("" with --trace off — the
+                          // scrape stays byte-identical to a pre-trace build).
+                          trace::render_metrics(openmetrics);
       // Capacity families render only once the first inventory publishes
       // (absent, not zero, with --capacity off — same contract as signal).
       if (capacity::enabled()) {
@@ -2252,6 +2339,15 @@ int run(const cli::Cli& args) {
     if (recorder::enabled()) {
       metrics_server->set_cycles_provider([](const std::string& id) {
         return id.empty() ? recorder::index_json().dump() : recorder::capsule_body(id);
+      });
+    }
+    // Action-provenance trace ring: index + SLO summary at /debug/traces,
+    // full span trees at /debug/traces/<id> ("" from the provider → 404).
+    // Unset (404 + hint) with --trace off, so the route doubles as a
+    // feature probe for hubs and `analyze --trace <url>`.
+    if (args.trace == "on") {
+      metrics_server->set_traces_provider([](const std::string& id) {
+        return id.empty() ? trace::index_json().dump() : trace::trace_json(id);
       });
     }
     // /readyz reflects informer sync state — distinct from the /healthz
@@ -2400,6 +2496,10 @@ int run(const cli::Cli& args) {
       // target, not whatever cycle the producer is on by now.
       log::set_thread_cycle(item->cycle);
       const std::string identity = t.identity();
+      // Trace actuation span: opened at dequeue so the waterfall shows
+      // queue wait + patch; retry hooks (backoff::record_retry) append
+      // events to the thread-local span until `finish` closes it.
+      trace::actuation_begin(item->cycle, identity);
       auto finish = [&](audit::Reason reason, const std::string& action,
                         const std::string& detail = "") {
         audit::finalize(item->cycle, identity, reason, action, detail);
@@ -2414,6 +2514,10 @@ int run(const cli::Cli& args) {
         incremental::engine().record_actuation_outcome(item->cycle, identity, reason, action,
                                                        detail);
         audit::actuation_done(item->cycle, reason == audit::Reason::AlreadyPaused);
+        // AFTER the capsule stamp: the trace's last actuation_end seals
+        // the trace, and its span set must match the sealed capsule's.
+        trace::actuation_end(item->cycle, audit::reason_name(reason),
+                             reason == audit::Reason::ScaleFailed, detail);
       };
       if (!(enabled & core::flag(t.kind))) {
         log::info("daemon", "Skipping resource type " + std::string(core::kind_name(t.kind)) +
@@ -2438,8 +2542,16 @@ int run(const cli::Cli& args) {
       span.attr("kind", std::string(core::kind_name(t.kind)));
       span.attr("name", t.name());
       span.attr("namespace", t.ns().value_or(""));
-      http::set_thread_traceparent(otlp::traceparent(span.context()));
+      std::string actuation_tp = otlp::traceparent(span.context());
       opts.trace_id = span.context().trace_id;
+      if (opts.trace_id.empty()) {
+        // OTLP exporter off: the actuation joins the evaluation's
+        // provenance trace instead, so a detect_to_action_seconds
+        // exemplar still resolves at /debug/traces/<id>.
+        actuation_tp = trace::traceparent(item->cycle);
+        opts.trace_id = trace::trace_id_of(item->cycle);
+      }
+      http::set_thread_traceparent(actuation_tp);
       if (item->plan.target_replicas > 0) {
         // Right-size actuation (--right-size on): partial scale-down to
         // the planned replica count, partial reclaim in the ledger.
@@ -2579,6 +2691,11 @@ int run(const cli::Cli& args) {
   int64_t trigger_detect_ms = mono_ms();      // detection time (detect→action clock)
   int64_t last_eval_ms = mono_ms();           // anti-entropy anchor
   uint64_t consumed_dirty_seq = 0;            // dirty marks already folded in
+  // Debounce-wait provenance (--trace on): how many wait passes extended
+  // the dirty debounce, and how many of those were held by in-flight
+  // actuations rather than fresh churn — attrs on the debounce_wait span.
+  int64_t debounce_extensions = 0;
+  int64_t debounce_inflight_extensions = 0;
   const int64_t anti_entropy_ms = std::max<int64_t>(args.check_interval, 1) * 1000;
   constexpr int64_t kDebounceMs = 80;
   constexpr int64_t kDebounceCapMs = 2000;
@@ -2627,6 +2744,8 @@ int run(const cli::Cli& args) {
   // per-root lookback expiries — live in the one timer wheel, so /debug/
   // timers shows the complete time plane.
   auto wait_for_trigger = [&]() -> std::string {
+    debounce_extensions = 0;
+    debounce_inflight_extensions = 0;
     wheel.schedule("anti-entropy", last_eval_ms + anti_entropy_ms);
     wheel.schedule("probe", mono_ms() + args.sample_interval_ms);
     if (args.incremental == "on") {
@@ -2672,6 +2791,8 @@ int run(const cli::Cli& args) {
             trigger_detect_ms = ev.first_dirty_ms > 0 ? ev.first_dirty_ms : now;
             return "dirty";
           }
+          ++debounce_extensions;
+          if (quiet && !drained) ++debounce_inflight_extensions;
         }
       }
       if (probe_due) {
@@ -2769,6 +2890,14 @@ int run(const cli::Cli& args) {
       // passes force the incremental planner to a full re-fingerprint —
       // the event engine's defense against a dropped watch event.
       g_trigger_ms.store(trigger_detect_ms);
+      // Trace trigger context: fixed literals only (the atomic holds a
+      // borrowed pointer), ingress = the trigger's detection stamp so the
+      // trace root starts at trigger arrival.
+      g_trace_trigger.store(trigger == "dirty"   ? "dirty"
+                            : trigger == "probe" ? "probe"
+                            : trigger == "timer" ? "timer"
+                                                 : "anti_entropy");
+      g_trace_ingress_ms.store(trigger_detect_ms);
       if (trigger == "anti_entropy") g_event_full_pass.store(true);
       {
         std::lock_guard<std::mutex> lock(ev.mu);
@@ -2778,6 +2907,11 @@ int run(const cli::Cli& args) {
       log::info("daemon", "event evaluation (trigger: " + trigger + ")");
     } else {
       g_trigger_ms.store(mono_ms());
+      g_trace_trigger.store("cycle");
+      // Under --overlap the NEXT cycle's prepare runs asynchronously long
+      // before its evaluation is current — backdating from a stale stamp
+      // would inflate its root span, so overlap traces start at prepare.
+      g_trace_ingress_ms.store(overlap_on ? 0 : g_trigger_ms.load());
     }
     try {
       // Queue items carry their PRODUCING cycle explicitly: under
@@ -2802,6 +2936,14 @@ int run(const cli::Cli& args) {
             });
         stats = finish_cycle(args, std::move(prep), kube, enabled, enqueue, watch_cache.get());
       } else {
+        // Debounce-wait provenance: the stretch between the first dirty
+        // mark and this evaluation's start is real detect→action budget —
+        // captured before prepare so the span ends where the query begins.
+        int64_t eval_nanos = 0, eval_mono = 0;
+        if (event_on && trace::enabled() && trigger == "dirty") {
+          eval_nanos = util::now_unix_nanos();
+          eval_mono = mono_ms();
+        }
         Prepared prep = prepare_cycle(args, query, evidence_query, &prom_client);
         if (event_on) {
           // Capsule provenance: which trigger opened this logical capsule.
@@ -2812,6 +2954,15 @@ int run(const cli::Cli& args) {
           rv.set("mode", json::Value("event"));
           rv.set("trigger", json::Value(trigger));
           recorder::record_reconcile(prep.cycle_id, std::move(rv));
+          if (eval_nanos > 0) {
+            trace::Span d;
+            d.name = "debounce_wait";
+            d.end_nanos = eval_nanos;
+            d.start_nanos = eval_nanos - (eval_mono - trigger_detect_ms) * 1000000ll;
+            d.int_attrs.emplace_back("extensions", debounce_extensions);
+            d.int_attrs.emplace_back("inflight_extensions", debounce_inflight_extensions);
+            trace::add_span(prep.cycle_id, std::move(d));
+          }
         }
         stats = finish_cycle(args, std::move(prep), kube, enabled, enqueue, watch_cache.get());
       }

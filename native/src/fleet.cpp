@@ -172,7 +172,7 @@ std::vector<MemberSnapshot> expand_rollup(const MemberSnapshot& hub, int64_t sta
   bool hub_ok = std::string(status_of(hub, stale_after_s)) == "OK";
 
   // Index the signals / decisions per-cluster rows.
-  std::map<std::string, const Value*> sig_rows, dec_rows, cap_rows;
+  std::map<std::string, const Value*> sig_rows, dec_rows, cap_rows, slo_rows;
   if (const Value* rows = hub.signals.find("clusters"); rows && rows->is_array()) {
     for (const Value& row : rows->as_array()) sig_rows.emplace(row.get_string("cluster"), &row);
   }
@@ -181,6 +181,9 @@ std::vector<MemberSnapshot> expand_rollup(const MemberSnapshot& hub, int64_t sta
   }
   if (const Value* rows = hub.capacity.find("clusters"); rows && rows->is_array()) {
     for (const Value& row : rows->as_array()) cap_rows.emplace(row.get_string("cluster"), &row);
+  }
+  if (const Value* rows = hub.slo.find("clusters"); rows && rows->is_array()) {
+    for (const Value& row : rows->as_array()) slo_rows.emplace(row.get_string("cluster"), &row);
   }
 
   const Value* rows = hub.workloads.find("clusters");
@@ -237,6 +240,12 @@ std::vector<MemberSnapshot> expand_rollup(const MemberSnapshot& hub, int64_t sta
     if (auto it = cap_rows.find(leaf.cluster); it != cap_rows.end()) {
       if (const Value* inv = it->second->find("inventory"); inv && inv->is_object()) {
         leaf.capacity = *inv;
+      }
+    }
+    // Same verbatim-document contract for the SLO summary row.
+    if (auto it = slo_rows.find(leaf.cluster); it != slo_rows.end()) {
+      if (const Value* doc = it->second->find("slo"); doc && doc->is_object()) {
+        leaf.slo = *doc;
       }
     }
     leaves.push_back(std::move(leaf));
@@ -530,6 +539,56 @@ FleetView aggregate(const std::vector<MemberSnapshot>& members, int64_t stale_af
   view.capacity.set("clusters", std::move(cap_clusters));
   view.capacity.set("fleet_totals", std::move(cap_totals));
 
+  // ── slo: detect→action budget burn + fleet worst traces ──
+  // Per-cluster rows keep each member's SLO summary verbatim (the
+  // hub-of-hubs reconstruction contract); fleet totals sum the budget
+  // counters, derive the fleet burn ratio from the sums, and surface the
+  // globally worst retained traces (cluster-stamped) so one view answers
+  // "where are we slow and why".
+  Value slo_clusters = Value::array();
+  Value slo_worst = Value::array();
+  int64_t slo_members = 0;
+  int64_t slo_good = 0, slo_bad = 0, slo_breaches = 0;
+  for (const MemberSnapshot* m : ordered) {
+    Value row = Value::object();
+    row.set("cluster", Value(m->cluster));
+    row.set("status", Value(std::string(status_of(*m, stale_after_s))));
+    if (m->slo.is_object()) {
+      ++slo_members;
+      slo_good += static_cast<int64_t>(num_at(m->slo, "good"));
+      slo_bad += static_cast<int64_t>(num_at(m->slo, "bad"));
+      slo_breaches += static_cast<int64_t>(num_at(m->slo, "breaches"));
+      if (const Value* w = m->slo.find("worst"); w && w->is_array()) {
+        for (const Value& t : w->as_array()) {
+          Value entry = t;
+          entry.set("cluster", Value(m->cluster));
+          slo_worst.push_back(std::move(entry));
+        }
+      }
+      row.set("slo", m->slo);
+    }
+    slo_clusters.push_back(std::move(row));
+  }
+  {
+    auto& arr = slo_worst.as_array();
+    std::stable_sort(arr.begin(), arr.end(), [](const Value& a, const Value& b) {
+      return num_at(a, "root_ms") > num_at(b, "root_ms");
+    });
+    if (arr.size() > 5) arr.resize(5);
+  }
+  Value slo_totals = Value::object();
+  slo_totals.set("good", Value(slo_good));
+  slo_totals.set("bad", Value(slo_bad));
+  slo_totals.set("breaches", Value(slo_breaches));
+  int64_t slo_sum = slo_good + slo_bad;
+  slo_totals.set("burn_ratio",
+                 Value(slo_sum ? static_cast<double>(slo_bad) / slo_sum : 0.0));
+  view.slo = Value::object();
+  view.slo.set("members_reporting", Value(slo_members));
+  view.slo.set("clusters", std::move(slo_clusters));
+  view.slo.set("fleet_totals", std::move(slo_totals));
+  view.slo.set("worst", std::move(slo_worst));
+
   // ── clusters: the member status table ──
   Value member_rows = Value::array();
   for (const MemberSnapshot* m : ordered) {
@@ -622,6 +681,16 @@ json::Value rollup_capacity(const FleetView& view, const std::string& hub_cluste
   doc.set("cluster", Value(hub_cluster));
   for (const char* key : {"members_reporting", "clusters", "fleet_totals"}) {
     if (const Value* v = view.capacity.find(key)) doc.set(key, *v);
+  }
+  return doc;
+}
+
+json::Value rollup_slo(const FleetView& view, const std::string& hub_cluster) {
+  Value doc = Value::object();
+  doc.set("rollup", Value(true));
+  doc.set("cluster", Value(hub_cluster));
+  for (const char* key : {"members_reporting", "clusters", "fleet_totals", "worst"}) {
+    if (const Value* v = view.slo.find(key)) doc.set(key, *v);
   }
   return doc;
 }

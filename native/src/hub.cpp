@@ -94,6 +94,7 @@ struct MemberState {
   int64_t backoff_s = 0;
   uint32_t jitter_seed = 0;
   uint64_t snapshot_fp = 0;    // snapshot mode: fingerprint of the 3 bodies
+  uint64_t slo_fp = 0;         // change gate for the member's SLO summary
   std::string last_status;     // status at the last aggregate (change gate)
   uint64_t merged_backoffs = 0;  // backoffs folded into the served view
   bool changed = true;         // this member needs folding into a new view
@@ -284,6 +285,34 @@ bool poll_member_delta(const h2::Transport& transport, const Options& opt,
   return res.changed;
 }
 
+// The trace/SLO surface is optional (members predating it, or running
+// --trace off, 404 it): absent folds as a null document. Fetched on both
+// poll modes — the trace ring is not delta-journaled — and change-gated
+// by its own fingerprint over just the "slo" key, so a member whose
+// trace LIST churns but whose burn counters are quiet stays quiet.
+bool poll_member_slo(const h2::Transport& transport, const Options& opt,
+                     MemberState& m) {
+  http::Request req;
+  req.url = m.snap.url + "/debug/traces";
+  req.timeout_ms = static_cast<int>(opt.member_timeout_ms);
+  http::Response resp = transport.request(req);
+  uint64_t fp = 0;
+  json::Value slo;
+  if (resp.status == 200) {
+    log::counter_add("fleet_poll_bytes_total", resp.body.size());
+    json::Value doc = json::Value::parse(resp.body);
+    if (const json::Value* v = doc.find("slo"); v && v->is_object()) slo = *v;
+    if (!slo.is_null()) fp = shard::stable_hash(slo.dump());
+  } else if (resp.status != 404) {
+    throw std::runtime_error("/debug/traces returned HTTP " +
+                             std::to_string(resp.status));
+  }
+  m.snap.slo = std::move(slo);
+  bool changed = fp != m.slo_fp;
+  m.slo_fp = fp;
+  return changed;
+}
+
 // Shared post-poll bookkeeping for one member attempt (either mode).
 // Returns true when the member changed (data or reachability).
 bool poll_member_once(const h2::Transport& transport, const Options& opt,
@@ -294,7 +323,8 @@ bool poll_member_once(const h2::Transport& transport, const Options& opt,
     bool data_changed = opt.fleet_delta == "on"
                             ? poll_member_delta(transport, opt, m, wait_ms)
                             : poll_member_snapshot(transport, opt, m);
-    changed = data_changed || !m.snap.reachable;
+    bool slo_changed = poll_member_slo(transport, opt, m);
+    changed = data_changed || slo_changed || !m.snap.reachable;
     m.snap.reachable = true;
     m.snap.ever_reached = true;
     m.snap.last_error.clear();
@@ -421,7 +451,7 @@ int run(int argc, char** argv) {
   // endpoints serve well-formed documents (every member PENDING) from
   // the first request, not "{}" until a poll round lands.
   fleet::FleetView view;
-  json::Value roll_wl, roll_sig, roll_dec, roll_cap;
+  json::Value roll_wl, roll_sig, roll_dec, roll_cap, roll_slo;
   const std::string hub_cluster = fleet::cluster_name();
   auto remerge = [&](std::vector<fleet::MemberSnapshot> snaps) {
     fleet::FleetView next = fleet::aggregate(snaps, opt.stale_after_s);
@@ -429,12 +459,14 @@ int run(int argc, char** argv) {
     json::Value sig = fleet::rollup_signals(next, hub_cluster);
     json::Value dec = fleet::rollup_decisions(next, hub_cluster);
     json::Value cap = fleet::rollup_capacity(next, hub_cluster);
+    json::Value slo = fleet::rollup_slo(next, hub_cluster);
     std::lock_guard<std::mutex> lock(view_mutex);
     view = std::move(next);
     roll_wl = std::move(wl);
     roll_sig = std::move(sig);
     roll_dec = std::move(dec);
     roll_cap = std::move(cap);
+    roll_slo = std::move(slo);
   };
   {
     std::vector<fleet::MemberSnapshot> snaps;
@@ -472,6 +504,7 @@ int run(int argc, char** argv) {
     if (sub == "signals") return view.signals.is_null() ? "{}" : view.signals.dump();
     if (sub == "decisions") return view.decisions.is_null() ? "{}" : view.decisions.dump();
     if (sub == "capacity") return view.capacity.is_null() ? "{}" : view.capacity.dump();
+    if (sub == "slo") return view.slo.is_null() ? "{}" : view.slo.dump();
     if (sub == "clusters" || sub.empty())
       return view.clusters.is_null() ? "{}" : view.clusters.dump();
     return "";
@@ -493,6 +526,17 @@ int run(int argc, char** argv) {
   server.set_capacity_provider([&] {
     std::lock_guard<std::mutex> lock(view_mutex);
     return roll_cap.is_null() ? std::string("{}") : roll_cap.dump();
+  });
+  // Member-compatible SLO surface: a parent hub polls /debug/traces and
+  // reads the "slo" key, so serve the rollup doc there (the hub retains
+  // no member trace trees — only the burn summaries).
+  server.set_traces_provider([&](const std::string& id) -> std::string {
+    if (!id.empty()) return "";
+    std::lock_guard<std::mutex> lock(view_mutex);
+    json::Value doc = json::Value::object();
+    doc.set("cluster", json::Value(hub_cluster));
+    doc.set("slo", roll_slo.is_null() ? json::Value::object() : roll_slo);
+    return doc.dump();
   });
   server.set_delta_provider([&](const std::string& query, const std::function<bool()>& abort) {
     return hub_journal.handle_request(query, abort);

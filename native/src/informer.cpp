@@ -308,7 +308,15 @@ constexpr size_t kDirtyJournalCap = 65536;
 
 void Reflector::enable_dirty_journal() { journal_enabled_.store(true); }
 
-void Reflector::set_dirty_notify(std::function<void()> notify) {
+// Event-arrival stamp for the dirty-notify fan-out: monotonic ms at decode
+// time, the same clock the daemon's detect→action plane runs on.
+int64_t arrival_mono_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void Reflector::set_dirty_notify(std::function<void(int64_t)> notify) {
   // Pre-start() only: the reflector thread reads this without a lock
   // (thread creation is the happens-before edge).
   dirty_notify_ = std::move(notify);
@@ -337,7 +345,7 @@ void Reflector::journal_touch(const std::string& path) {
       dirty_paths_.push_back(path);
     }
   }
-  if (dirty_notify_) dirty_notify_();  // outside the lock: wake, don't hold
+  if (dirty_notify_) dirty_notify_(arrival_mono_ms());  // outside the lock: wake, don't hold
 }
 
 uint64_t Reflector::journal_overflows() const {
@@ -354,7 +362,7 @@ void Reflector::journal_all() {
     dirty_paths_.clear();
     dirty_all_ = true;
   }
-  if (dirty_notify_) dirty_notify_();
+  if (dirty_notify_) dirty_notify_(arrival_mono_ms());
 }
 
 Reflector::Reflector(const k8s::Client& kube, ResourceSpec spec)
@@ -1139,7 +1147,7 @@ void ClusterCache::enable_dirty_journal() {
   for (auto& r : reflectors_) r->enable_dirty_journal();
 }
 
-void ClusterCache::set_dirty_notify(std::function<void()> notify) {
+void ClusterCache::set_dirty_notify(std::function<void(int64_t)> notify) {
   for (auto& r : reflectors_) r->set_dirty_notify(notify);
 }
 

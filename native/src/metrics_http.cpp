@@ -156,6 +156,11 @@ void Server::set_cycles_provider(std::function<std::string(const std::string&)> 
   cycles_provider_ = std::move(provider);
 }
 
+void Server::set_traces_provider(std::function<std::string(const std::string&)> provider) {
+  std::lock_guard<std::mutex> lock(probe_mutex_);
+  traces_provider_ = std::move(provider);
+}
+
 void Server::set_signals_provider(std::function<std::string()> provider) {
   std::lock_guard<std::mutex> lock(probe_mutex_);
   signals_provider_ = std::move(provider);
@@ -456,6 +461,24 @@ void Server::handle_connection(int fd) {
         status_text = "Not Found";
         body = "delta journal not available on this process\n";
       }
+    } else if (path == "/debug/traces" || util::starts_with(path, "/debug/traces/")) {
+      std::function<std::string(const std::string&)> provider;
+      {
+        std::lock_guard<std::mutex> lock(probe_mutex_);
+        provider = traces_provider_;
+      }
+      std::string id =
+          path == "/debug/traces" ? "" : path.substr(std::strlen("/debug/traces/"));
+      std::string result = provider ? provider(id) : "";
+      if (provider && !result.empty()) {
+        content_type = "application/json";
+        body = std::move(result);
+      } else {
+        status = 404;
+        status_text = "Not Found";
+        body = provider ? "no such trace (evicted or never retained)\n"
+                        : "trace ring not enabled (--trace on)\n";
+      }
     } else if (path == "/debug/fleet" || util::starts_with(path, "/debug/fleet/")) {
       std::function<std::string(const std::string&, const std::string&)> provider;
       {
@@ -472,7 +495,7 @@ void Server::handle_connection(int fd) {
         status = 404;
         status_text = "Not Found";
         body = provider ? "no such fleet view (try workloads, signals, decisions, "
-                          "capacity, clusters)\n"
+                          "capacity, slo, clusters)\n"
                         : "fleet endpoints are served by the federation hub (tpu-pruner hub)\n";
       }
     } else if (path == "/debug/cycles" || util::starts_with(path, "/debug/cycles/")) {
@@ -519,6 +542,9 @@ void Server::handle_connection(int fd) {
              "{\"path\":\"/debug/capacity\",\"description\":\"capacity observatory: freed-"
              "chip inventory + slice-topology map — whole-free vs partial-idle slices, "
              "consolidation potential (--capacity on)\"}," +
+             "{\"path\":\"/debug/traces\",\"description\":\"action provenance traces: "
+             "bounded ring of per-evaluation span trees + SLO burn summary; "
+             "/debug/traces/<id> serves one full waterfall (--trace on)\"}," +
              "{\"path\":\"/debug/delta\",\"description\":\"delta-federation change journal: "
              "?since=<epoch>&gen=<generation>&wait_ms=<long-poll> serves O(churn) surface "
              "diffs (full snapshot on first poll or aged-out cursor)\"}," +
@@ -530,6 +556,9 @@ void Server::handle_connection(int fd) {
              "DecisionRecords per member cluster (tpu-pruner hub)\"}," +
              "{\"path\":\"/debug/fleet/capacity\",\"description\":\"federation hub: the "
              "fleet's free-TPU supply map — per-cluster inventories + summed totals "
+             "(tpu-pruner hub)\"}," +
+             "{\"path\":\"/debug/fleet/slo\",\"description\":\"federation hub: per-member "
+             "detect-to-action SLO burn + fleet worst-trace summaries "
              "(tpu-pruner hub)\"}," +
              "{\"path\":\"/debug/fleet/clusters\",\"description\":\"federation hub: member "
              "status table — OK / PENDING / UNREACHABLE, staleness, poll errors "

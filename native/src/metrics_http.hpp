@@ -60,6 +60,12 @@ class Server {
   // return means "no such capsule" (404). Unset → 404 for both routes.
   void set_cycles_provider(std::function<std::string(const std::string&)> provider);
 
+  // /debug/traces provider (the action-provenance trace ring): receives
+  // the trace id ("" = the index + SLO summary) and returns the JSON body
+  // — an empty return means "no such trace" (404). Unset → 404 with a
+  // hint that the ring exists under --trace on.
+  void set_traces_provider(std::function<std::string(const std::string&)> provider);
+
   // /debug/signals provider (the signal-quality watchdog's latest
   // evidence assessment). Unset → 404.
   void set_signals_provider(std::function<std::string()> provider);
@@ -70,7 +76,8 @@ class Server {
   void set_capacity_provider(std::function<std::string()> provider);
 
   // /debug/fleet/* provider (the federation hub's merged views): receives
-  // the subpath ("workloads" | "signals" | "decisions" | "clusters") and
+  // the subpath ("workloads" | "signals" | "decisions" | "capacity" |
+  // "slo" | "clusters") and
   // the raw query string, returns the JSON body — an empty return means
   // "no such view" (404). Unset → 404 with a hint that the routes are
   // served by `tpu-pruner hub`.
@@ -114,6 +121,7 @@ class Server {
   std::function<std::string(const std::string&)> decisions_provider_;
   std::function<std::string(const std::string&)> workloads_provider_;
   std::function<std::string(const std::string&)> cycles_provider_;
+  std::function<std::string(const std::string&)> traces_provider_;
   std::function<std::string()> signals_provider_;
   std::function<std::string()> capacity_provider_;
   std::function<std::string()> timers_provider_;

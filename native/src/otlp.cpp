@@ -73,6 +73,11 @@ bool recording() { return g_recording.load(std::memory_order_relaxed); }
 void set_recording_for_test(bool on) { g_recording.store(on); }
 std::vector<FinishedSpan> drain_spans_for_test() { return drain_spans(); }
 
+void buffer_finished_span(FinishedSpan&& span) {
+  if (!recording()) return;
+  buffer_span(std::move(span));
+}
+
 std::string traceparent(const SpanContext& ctx) {
   if (ctx.trace_id.empty() || ctx.span_id.empty()) return "";
   // version 00, sampled flag 01 (these spans are all exported).
@@ -401,6 +406,30 @@ bool Exporter::export_traces() {
       attrs.push_back(std::move(a));
     }
     span.set("attributes", std::move(attrs));
+    if (!fs.events.empty()) {
+      Value events = Value::array();
+      for (SpanEvent& ev : fs.events) {
+        Value e = Value::object();
+        e.set("timeUnixNano", Value(std::to_string(ev.time_nanos)));
+        e.set("name", Value(std::move(ev.name)));
+        Value eattrs = Value::array();
+        for (auto& [k, v] : ev.str_attrs) {
+          Value a = Value::object();
+          a.set("key", Value(std::move(k)));
+          a.set("value", Value(json::Object{{"stringValue", Value(std::move(v))}}));
+          eattrs.push_back(std::move(a));
+        }
+        for (auto& [k, v] : ev.int_attrs) {
+          Value a = Value::object();
+          a.set("key", Value(std::move(k)));
+          a.set("value", Value(json::Object{{"intValue", Value(std::to_string(v))}}));
+          eattrs.push_back(std::move(a));
+        }
+        e.set("attributes", std::move(eattrs));
+        events.push_back(std::move(e));
+      }
+      span.set("events", std::move(events));
+    }
     Value status = Value::object();
     if (fs.error) {
       status.set("code", Value(2));  // STATUS_CODE_ERROR

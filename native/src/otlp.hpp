@@ -37,12 +37,22 @@ struct SpanContext {
   std::string span_id;   // 16 hex chars
 };
 
+// Timestamped point event inside a span (OTLP Span.events) — e.g. one
+// retry/backoff tick inside an actuation span.
+struct SpanEvent {
+  int64_t time_nanos = 0;
+  std::string name;
+  std::vector<std::pair<std::string, std::string>> str_attrs;
+  std::vector<std::pair<std::string, int64_t>> int_attrs;
+};
+
 struct FinishedSpan {
   std::string name;
   std::string trace_id, span_id, parent_span_id;
   int64_t start_nanos = 0, end_nanos = 0;
   std::vector<std::pair<std::string, std::string>> str_attrs;
   std::vector<std::pair<std::string, int64_t>> int_attrs;
+  std::vector<SpanEvent> events;
   bool error = false;
   std::string error_message;
 };
@@ -70,6 +80,11 @@ class Span {
 bool recording();                        // true while an Exporter is live
 void set_recording_for_test(bool on);    // test hook
 std::vector<FinishedSpan> drain_spans_for_test();
+
+// Buffer an externally-assembled finished span (the trace engine seals
+// whole span trees at once, with ids and timestamps of its own). No-op
+// unless recording — same gate as the RAII Span.
+void buffer_finished_span(FinishedSpan&& span);
 
 // W3C trace-context header value ("00-<trace>-<span>-01") for a span
 // context, or "" when the context is empty (recording off) — callers hand

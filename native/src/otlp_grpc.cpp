@@ -173,6 +173,15 @@ std::string encode_traces_request(const std::vector<otlp::FinishedSpan>& spans) 
     put_fixed64_field(span, 8, static_cast<uint64_t>(fs.end_nanos));
     for (const auto& [k, v] : fs.str_attrs) put_bytes_field(span, 9, kv_string(k, v));
     for (const auto& [k, v] : fs.int_attrs) put_bytes_field(span, 9, kv_int(k, v));
+    for (const otlp::SpanEvent& ev : fs.events) {
+      // Span.Event{time_unix_nano=1(f64), name=2, attributes=7}
+      std::string event;
+      put_fixed64_field(event, 1, static_cast<uint64_t>(ev.time_nanos));
+      put_bytes_field(event, 2, ev.name);
+      for (const auto& [k, v] : ev.str_attrs) put_bytes_field(event, 7, kv_string(k, v));
+      for (const auto& [k, v] : ev.int_attrs) put_bytes_field(event, 7, kv_int(k, v));
+      put_bytes_field(span, 11, event);  // Span.events
+    }
     if (fs.error) {
       std::string status;  // Status{message=2, code=3}
       put_bytes_field(status, 2, fs.error_message);

@@ -56,6 +56,7 @@ struct OpenCapsule {
   Value incremental;                    // differential-engine provenance (dirty set, hits)
   Value reconcile;                      // event-engine provenance (mode + trigger)
   Value capacity;                       // {inputs, doc} — the capacity observatory stamp
+  Value trace;                          // normalized trace stamp (--trace on)
   std::vector<Value> decisions;         // verbatim DecisionRecord JSON
   bool armed = false;
   size_t remaining = 0;
@@ -186,6 +187,12 @@ void seal_locked(Registry& r, uint64_t cycle) {
   // Capacity observatory stamp (--capacity on): the canonical {inputs,
   // doc} pair `analyze --capacity-report` recomputes bit-for-bit.
   if (!c.capacity.is_null()) doc.set("capacity", std::move(c.capacity));
+  // Trace stamp (--trace on): the evaluation's span-tree-so-far, keyed by
+  // trace id — `analyze --trace <flight-dir>` renders waterfalls offline
+  // and joins them with this capsule's decisions. Provenance, not
+  // evidence: replay never reads it; cross-mode byte-identity
+  // comparisons normalize the key away like "incremental"/"reconcile".
+  if (!c.trace.is_null()) doc.set("trace", std::move(c.trace));
   doc.set("decisions", std::move(decisions));
 
   fs::path final_path = fs::path(r.dir) / (id + ".json");
@@ -425,6 +432,14 @@ void record_reconcile(uint64_t cycle, Value info) {
   OpenCapsule* c = open_capsule_locked(r, cycle);
   if (!c) return;
   c->reconcile = std::move(info);
+}
+
+void record_trace(uint64_t cycle, Value stamp) {
+  Registry& r = reg();
+  std::lock_guard<std::mutex> lock(r.mutex);
+  OpenCapsule* c = open_capsule_locked(r, cycle);
+  if (!c) return;
+  c->trace = std::move(stamp);
 }
 
 void record_capacity(uint64_t cycle, Value stamp) {

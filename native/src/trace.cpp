@@ -1,0 +1,565 @@
+#include "tpupruner/trace.hpp"
+
+#include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <unordered_map>
+
+#include "otlp.hpp"
+#include "tpupruner/fleet.hpp"
+#include "tpupruner/util.hpp"
+
+namespace tpupruner::trace {
+
+using json::Value;
+
+namespace {
+
+std::atomic<bool> g_enabled{false};
+std::atomic<int64_t> g_slo_ms{0};
+
+// Ring sizes: 256 recent traces (~a few KB each) bounds steady-state RSS;
+// 64 pinned SLO breaches survive past normal eviction so breach evidence
+// outlives the storm that caused it. Index serves the newest 50 so the
+// hub's per-member poll stays bounded.
+constexpr size_t kRingCap = 256;
+constexpr size_t kPinnedCap = 64;
+constexpr size_t kIndexCap = 50;
+constexpr size_t kActiveCap = 64;  // abandoned-trace backstop (failed cycles)
+
+struct StoredSpan {
+  std::string span_id;  // 16 hex, assigned at attach
+  Span s;
+};
+
+struct ActiveTrace {
+  std::string trace_id, root_span_id, trigger;
+  uint64_t cycle = 0;
+  int64_t root_start_nanos = 0;
+  int64_t ingress_lag_ms = 0;
+  std::vector<StoredSpan> spans;
+  bool armed = false;
+  size_t expected = 0;        // actuations promised by arm()
+  size_t done = 0;            // actuations landed (may precede arm)
+  size_t actuations = 0;
+  bool breached = false;
+  int64_t worst_actuation_ms = 0;
+};
+
+struct FinishedTrace {
+  std::string trace_id, root_span_id, trigger;
+  uint64_t cycle = 0;
+  int64_t root_start_nanos = 0, root_end_nanos = 0;
+  int64_t ingress_lag_ms = 0;
+  std::vector<StoredSpan> spans;
+  size_t actuations = 0;
+  bool breached = false;
+  bool pinned = false;
+  int64_t worst_actuation_ms = 0;
+
+  double root_ms() const {
+    return static_cast<double>(root_end_nanos - root_start_nanos) / 1e6;
+  }
+};
+
+struct Engine {
+  std::mutex mu;
+  std::unordered_map<uint64_t, ActiveTrace> active;
+  std::deque<std::shared_ptr<FinishedTrace>> ring;    // newest at back
+  std::deque<std::shared_ptr<FinishedTrace>> pinned;  // SLO breaches
+  uint64_t completed_total = 0;
+  uint64_t evicted_total = 0;
+  uint64_t slo_good = 0, slo_bad = 0, slo_breaches = 0;
+};
+
+Engine& engine() {
+  static Engine e;
+  return e;
+}
+
+// Per-consumer-thread open actuation span: retry events append here
+// LOCK-FREE (backoff::record_retry fires from arbitrary depths of the
+// patch attempt); the span touches the engine mutex once, at end.
+struct OpenActuation {
+  bool open = false;
+  uint64_t cycle = 0;
+  Span span;
+};
+thread_local OpenActuation t_act;
+
+std::string new_span_id() { return util::random_hex32().substr(16); }
+
+std::string fmt_double(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%g", v);
+  return buf;
+}
+
+void export_otlp_locked(const FinishedTrace& ft) {
+  if (!otlp::recording()) return;
+  otlp::FinishedSpan root;
+  root.name = "evaluate";
+  root.trace_id = ft.trace_id;
+  root.span_id = ft.root_span_id;
+  root.start_nanos = ft.root_start_nanos;
+  root.end_nanos = ft.root_end_nanos;
+  root.str_attrs.emplace_back("trigger", ft.trigger);
+  root.int_attrs.emplace_back("cycle", static_cast<int64_t>(ft.cycle));
+  if (ft.breached) root.int_attrs.emplace_back("slo_breached", 1);
+  otlp::buffer_finished_span(std::move(root));
+  for (const StoredSpan& ss : ft.spans) {
+    otlp::FinishedSpan child;
+    child.name = ss.s.name;
+    child.trace_id = ft.trace_id;
+    child.span_id = ss.span_id;
+    child.parent_span_id = ft.root_span_id;
+    child.start_nanos = ss.s.start_nanos;
+    child.end_nanos = ss.s.end_nanos;
+    child.str_attrs = ss.s.str_attrs;
+    child.int_attrs = ss.s.int_attrs;
+    child.error = ss.s.error;
+    child.error_message = ss.s.error_message;
+    for (const Event& ev : ss.s.events) {
+      otlp::SpanEvent oe;
+      oe.time_nanos = ev.time_nanos;
+      oe.name = ev.name;
+      oe.str_attrs = ev.str_attrs;
+      oe.int_attrs = ev.int_attrs;
+      child.events.push_back(std::move(oe));
+    }
+    otlp::buffer_finished_span(std::move(child));
+  }
+}
+
+void seal_locked(Engine& e, std::unordered_map<uint64_t, ActiveTrace>::iterator it) {
+  ActiveTrace& a = it->second;
+  auto ft = std::make_shared<FinishedTrace>();
+  ft->trace_id = a.trace_id;
+  ft->root_span_id = a.root_span_id;
+  ft->trigger = a.trigger;
+  ft->cycle = a.cycle;
+  ft->root_start_nanos = a.root_start_nanos;
+  ft->ingress_lag_ms = a.ingress_lag_ms;
+  ft->actuations = a.actuations;
+  ft->breached = a.breached;
+  ft->worst_actuation_ms = a.worst_actuation_ms;
+  ft->spans = std::move(a.spans);
+  // Root ends when its last child does (the final actuation for acting
+  // evaluations — detect→action joins on this); a childless evaluation
+  // ends at seal time.
+  int64_t end = a.root_start_nanos;
+  for (const StoredSpan& ss : ft->spans) end = std::max(end, ss.s.end_nanos);
+  if (end <= a.root_start_nanos) end = util::now_unix_nanos();
+  ft->root_end_nanos = end;
+  e.active.erase(it);
+
+  ++e.completed_total;
+  if (ft->breached) ++e.slo_breaches;
+  export_otlp_locked(*ft);
+
+  if (ft->breached) {
+    ft->pinned = true;
+    e.pinned.push_back(std::move(ft));
+    if (e.pinned.size() > kPinnedCap) {
+      e.pinned.pop_front();
+      ++e.evicted_total;
+    }
+    return;
+  }
+  e.ring.push_back(std::move(ft));
+  if (e.ring.size() > kRingCap) {
+    e.ring.pop_front();
+    ++e.evicted_total;
+  }
+}
+
+// All retained traces, newest root-end first (pinned interleaved).
+std::vector<std::shared_ptr<FinishedTrace>> retained_locked(Engine& e) {
+  std::vector<std::shared_ptr<FinishedTrace>> all;
+  all.reserve(e.ring.size() + e.pinned.size());
+  for (const auto& t : e.ring) all.push_back(t);
+  for (const auto& t : e.pinned) all.push_back(t);
+  std::stable_sort(all.begin(), all.end(), [](const auto& x, const auto& y) {
+    return x->root_end_nanos > y->root_end_nanos;
+  });
+  return all;
+}
+
+Value attrs_json(const std::vector<std::pair<std::string, std::string>>& strs,
+                 const std::vector<std::pair<std::string, int64_t>>& ints) {
+  Value attrs = Value::object();
+  for (const auto& [k, v] : strs) attrs.set(k, Value(v));
+  for (const auto& [k, v] : ints) attrs.set(k, Value(v));
+  return attrs;
+}
+
+Value span_json(const FinishedTrace& ft, const StoredSpan& ss) {
+  Value s = Value::object();
+  s.set("span_id", Value(ss.span_id));
+  s.set("parent_span_id", Value(ft.root_span_id));
+  s.set("name", Value(ss.s.name));
+  s.set("start_us", Value((ss.s.start_nanos - ft.root_start_nanos) / 1000));
+  s.set("end_us", Value((ss.s.end_nanos - ft.root_start_nanos) / 1000));
+  if (!ss.s.str_attrs.empty() || !ss.s.int_attrs.empty())
+    s.set("attrs", attrs_json(ss.s.str_attrs, ss.s.int_attrs));
+  if (!ss.s.events.empty()) {
+    Value events = Value::array();
+    for (const Event& ev : ss.s.events) {
+      Value e = Value::object();
+      e.set("time_us", Value((ev.time_nanos - ft.root_start_nanos) / 1000));
+      e.set("name", Value(ev.name));
+      if (!ev.str_attrs.empty() || !ev.int_attrs.empty())
+        e.set("attrs", attrs_json(ev.str_attrs, ev.int_attrs));
+      events.push_back(std::move(e));
+    }
+    s.set("events", std::move(events));
+  }
+  if (ss.s.error) {
+    s.set("error", Value(true));
+    s.set("error_message", Value(ss.s.error_message));
+  }
+  return s;
+}
+
+Value summary_json(const FinishedTrace& ft) {
+  Value t = Value::object();
+  t.set("trace_id", Value(ft.trace_id));
+  t.set("cycle", Value(static_cast<int64_t>(ft.cycle)));
+  t.set("trigger", Value(ft.trigger));
+  t.set("root_ms", Value(ft.root_ms()));
+  t.set("spans", Value(static_cast<int64_t>(ft.spans.size())));
+  t.set("actuations", Value(static_cast<int64_t>(ft.actuations)));
+  t.set("breached", Value(ft.breached));
+  t.set("pinned", Value(ft.pinned));
+  return t;
+}
+
+Value slo_summary_locked(Engine& e) {
+  Value doc = Value::object();
+  int64_t slo = g_slo_ms.load(std::memory_order_relaxed);
+  doc.set("enabled", Value(slo > 0));
+  doc.set("slo_ms", Value(slo));
+  doc.set("good", Value(static_cast<int64_t>(e.slo_good)));
+  doc.set("bad", Value(static_cast<int64_t>(e.slo_bad)));
+  doc.set("breaches", Value(static_cast<int64_t>(e.slo_breaches)));
+  uint64_t total = e.slo_good + e.slo_bad;
+  doc.set("burn_ratio", Value(total ? static_cast<double>(e.slo_bad) / total : 0.0));
+  Value worst = Value::array();
+  auto all = retained_locked(e);
+  std::stable_sort(all.begin(), all.end(), [](const auto& x, const auto& y) {
+    return x->root_ms() > y->root_ms();
+  });
+  for (size_t i = 0; i < all.size() && i < 5; ++i) {
+    Value w = Value::object();
+    w.set("trace_id", Value(all[i]->trace_id));
+    w.set("cycle", Value(static_cast<int64_t>(all[i]->cycle)));
+    w.set("trigger", Value(all[i]->trigger));
+    w.set("root_ms", Value(all[i]->root_ms()));
+    w.set("breached", Value(all[i]->breached));
+    worst.push_back(std::move(w));
+  }
+  doc.set("worst", std::move(worst));
+  return doc;
+}
+
+}  // namespace
+
+void configure(bool on, int64_t slo) {
+  g_enabled.store(on, std::memory_order_relaxed);
+  g_slo_ms.store(slo, std::memory_order_relaxed);
+}
+
+bool enabled() { return g_enabled.load(std::memory_order_relaxed); }
+int64_t slo_ms() { return g_slo_ms.load(std::memory_order_relaxed); }
+
+std::string begin(uint64_t cycle, const std::string& trigger, int64_t ingress_lag_ms,
+                  const std::string& hint_trace_id) {
+  if (!enabled()) return "";
+  ActiveTrace a;
+  a.trace_id = hint_trace_id.size() == 32 ? hint_trace_id : util::random_hex32();
+  a.root_span_id = new_span_id();
+  a.trigger = trigger;
+  a.cycle = cycle;
+  a.ingress_lag_ms = std::max<int64_t>(0, ingress_lag_ms);
+  a.root_start_nanos = util::now_unix_nanos() - a.ingress_lag_ms * 1000000ll;
+  std::string id = a.trace_id;
+  std::lock_guard<std::mutex> lock(engine().mu);
+  Engine& e = engine();
+  e.active[cycle] = std::move(a);
+  // Abandoned-trace backstop: a cycle that dies before arm() (failed
+  // query, shutdown) would leak its entry; bound the map by dropping the
+  // oldest unarmed trace.
+  if (e.active.size() > kActiveCap) {
+    auto oldest = e.active.end();
+    for (auto it = e.active.begin(); it != e.active.end(); ++it) {
+      if (it->second.armed || it->first == cycle) continue;
+      if (oldest == e.active.end() || it->first < oldest->first) oldest = it;
+    }
+    if (oldest != e.active.end()) {
+      e.active.erase(oldest);
+      ++e.evicted_total;
+    }
+  }
+  return id;
+}
+
+std::string trace_id_of(uint64_t cycle) {
+  if (!enabled()) return "";
+  Engine& e = engine();
+  std::lock_guard<std::mutex> lock(e.mu);
+  auto it = e.active.find(cycle);
+  if (it != e.active.end()) return it->second.trace_id;
+  for (auto r = e.ring.rbegin(); r != e.ring.rend(); ++r)
+    if ((*r)->cycle == cycle) return (*r)->trace_id;
+  for (auto r = e.pinned.rbegin(); r != e.pinned.rend(); ++r)
+    if ((*r)->cycle == cycle) return (*r)->trace_id;
+  return "";
+}
+
+std::string traceparent(uint64_t cycle) {
+  if (!enabled()) return "";
+  Engine& e = engine();
+  std::lock_guard<std::mutex> lock(e.mu);
+  auto it = e.active.find(cycle);
+  if (it == e.active.end()) return "";
+  return "00-" + it->second.trace_id + "-" + it->second.root_span_id + "-01";
+}
+
+void add_span(uint64_t cycle, Span span) {
+  if (!enabled()) return;
+  Engine& e = engine();
+  std::lock_guard<std::mutex> lock(e.mu);
+  auto it = e.active.find(cycle);
+  if (it == e.active.end()) return;
+  // Clamp into the root window so a backdated debounce span can never
+  // start before trigger ingress (clock skew between stamping sites).
+  span.start_nanos = std::max(span.start_nanos, it->second.root_start_nanos);
+  it->second.spans.push_back(StoredSpan{new_span_id(), std::move(span)});
+}
+
+void add_phase_span(uint64_t cycle, const std::string& name, double seconds) {
+  if (!enabled()) return;
+  Span s;
+  s.name = name;
+  s.end_nanos = util::now_unix_nanos();
+  s.start_nanos = s.end_nanos - static_cast<int64_t>(seconds * 1e9);
+  add_span(cycle, std::move(s));
+}
+
+void actuation_begin(uint64_t cycle, const std::string& identity) {
+  if (!enabled()) return;
+  t_act.open = true;
+  t_act.cycle = cycle;
+  t_act.span = Span{};
+  t_act.span.name = "actuate";
+  t_act.span.start_nanos = util::now_unix_nanos();
+  t_act.span.str_attrs.emplace_back("identity", identity);
+}
+
+void thread_retry_event(const std::string& endpoint, const std::string& cause,
+                        double backoff_seconds) {
+  if (!t_act.open) return;
+  Event ev;
+  ev.time_nanos = util::now_unix_nanos();
+  ev.name = "retry";
+  ev.str_attrs.emplace_back("endpoint", endpoint);
+  ev.str_attrs.emplace_back("cause", cause);
+  ev.int_attrs.emplace_back("backoff_ms", static_cast<int64_t>(backoff_seconds * 1000.0));
+  t_act.span.events.push_back(std::move(ev));
+}
+
+void actuation_end(uint64_t cycle, const std::string& outcome, bool error,
+                   const std::string& error_message) {
+  if (!t_act.open) return;
+  t_act.open = false;
+  Span span = std::move(t_act.span);
+  span.end_nanos = util::now_unix_nanos();
+  span.str_attrs.emplace_back("outcome", outcome);
+  if (!span.events.empty())
+    span.int_attrs.emplace_back("retries", static_cast<int64_t>(span.events.size()));
+  span.error = error;
+  span.error_message = error_message;
+  if (!enabled()) return;
+  Engine& e = engine();
+  std::lock_guard<std::mutex> lock(e.mu);
+  auto it = e.active.find(cycle);
+  if (it == e.active.end()) return;
+  ActiveTrace& a = it->second;
+  ++a.actuations;
+  // SLO judgment: the actuation's root-relative latency IS the
+  // detect→action latency (root starts at trigger ingress).
+  int64_t latency_ms = (span.end_nanos - a.root_start_nanos) / 1000000ll;
+  a.worst_actuation_ms = std::max(a.worst_actuation_ms, latency_ms);
+  int64_t slo = g_slo_ms.load(std::memory_order_relaxed);
+  if (slo > 0) {
+    if (latency_ms > slo) {
+      ++e.slo_bad;
+      a.breached = true;
+    } else {
+      ++e.slo_good;
+    }
+  }
+  a.spans.push_back(StoredSpan{new_span_id(), std::move(span)});
+  ++a.done;
+  if (a.armed && a.done >= a.expected) seal_locked(e, it);
+}
+
+void arm(uint64_t cycle, size_t expected) {
+  if (!enabled()) return;
+  Engine& e = engine();
+  std::lock_guard<std::mutex> lock(e.mu);
+  auto it = e.active.find(cycle);
+  if (it == e.active.end()) return;
+  it->second.armed = true;
+  it->second.expected = expected;
+  if (it->second.done >= expected) seal_locked(e, it);
+}
+
+json::Value capsule_stamp(uint64_t cycle) {
+  if (!enabled()) return Value();
+  Engine& e = engine();
+  std::lock_guard<std::mutex> lock(e.mu);
+  auto it = e.active.find(cycle);
+  if (it == e.active.end()) return Value();
+  const ActiveTrace& a = it->second;
+  Value doc = Value::object();
+  doc.set("trace_id", Value(a.trace_id));
+  doc.set("trigger", Value(a.trigger));
+  doc.set("root_start_nanos", Value(a.root_start_nanos));
+  Value spans = Value::array();
+  for (const StoredSpan& ss : a.spans) {
+    Value s = Value::object();
+    s.set("name", Value(ss.s.name));
+    s.set("start_us", Value((ss.s.start_nanos - a.root_start_nanos) / 1000));
+    s.set("end_us", Value((ss.s.end_nanos - a.root_start_nanos) / 1000));
+    if (!ss.s.str_attrs.empty() || !ss.s.int_attrs.empty())
+      s.set("attrs", attrs_json(ss.s.str_attrs, ss.s.int_attrs));
+    spans.push_back(std::move(s));
+  }
+  doc.set("spans", std::move(spans));
+  return doc;
+}
+
+json::Value index_json() {
+  Engine& e = engine();
+  std::lock_guard<std::mutex> lock(e.mu);
+  Value doc = Value::object();
+  doc.set("cluster", Value(fleet::cluster_name()));
+  doc.set("enabled", Value(enabled()));
+  Value traces = Value::array();
+  auto all = retained_locked(e);
+  for (size_t i = 0; i < all.size() && i < kIndexCap; ++i)
+    traces.push_back(summary_json(*all[i]));
+  doc.set("traces", std::move(traces));
+  doc.set("retained", Value(static_cast<int64_t>(e.ring.size() + e.pinned.size())));
+  doc.set("pinned", Value(static_cast<int64_t>(e.pinned.size())));
+  doc.set("completed_total", Value(static_cast<int64_t>(e.completed_total)));
+  doc.set("evicted_total", Value(static_cast<int64_t>(e.evicted_total)));
+  doc.set("slo", slo_summary_locked(e));
+  return doc;
+}
+
+std::string trace_json(const std::string& id) {
+  Engine& e = engine();
+  std::lock_guard<std::mutex> lock(e.mu);
+  std::shared_ptr<FinishedTrace> found;
+  for (const auto& t : e.pinned)
+    if (t->trace_id == id) found = t;
+  if (!found)
+    for (const auto& t : e.ring)
+      if (t->trace_id == id) found = t;
+  if (!found) return "";
+  const FinishedTrace& ft = *found;
+  Value doc = summary_json(ft);
+  doc.set("cluster", Value(fleet::cluster_name()));
+  Value root = Value::object();
+  root.set("span_id", Value(ft.root_span_id));
+  root.set("name", Value("evaluate"));
+  root.set("start_nanos", Value(ft.root_start_nanos));
+  root.set("end_nanos", Value(ft.root_end_nanos));
+  root.set("duration_ms", Value(ft.root_ms()));
+  root.set("ingress_lag_ms", Value(ft.ingress_lag_ms));
+  doc.set("root", std::move(root));
+  doc.set("worst_actuation_ms", Value(ft.worst_actuation_ms));
+  Value spans = Value::array();
+  for (const StoredSpan& ss : ft.spans) spans.push_back(span_json(ft, ss));
+  doc.set("span_tree", std::move(spans));
+  return doc.dump();
+}
+
+json::Value slo_summary() {
+  Engine& e = engine();
+  std::lock_guard<std::mutex> lock(e.mu);
+  return slo_summary_locked(e);
+}
+
+const std::vector<std::string>& metric_families() {
+  static const std::vector<std::string> families = {
+      "tpu_pruner_trace_completed_total", "tpu_pruner_trace_retained",
+      "tpu_pruner_trace_pinned",          "tpu_pruner_trace_evicted_total",
+      "tpu_pruner_slo_good_total",        "tpu_pruner_slo_bad_total",
+      "tpu_pruner_slo_breaches_total",    "tpu_pruner_slo_burn_ratio",
+  };
+  return families;
+}
+
+std::string render_metrics(bool openmetrics) {
+  if (!enabled()) return "";
+  Engine& e = engine();
+  std::lock_guard<std::mutex> lock(e.mu);
+  // OpenMetrics reserves the `counter` type for suffix-transformed names;
+  // keep the 0.0.4-compatible rendering the other families use.
+  const std::string ctype = openmetrics ? "unknown" : "counter";
+  std::string out;
+  auto counter = [&](const char* name, const char* help, uint64_t v) {
+    out += "# HELP " + std::string(name) + " " + help + "\n";
+    out += "# TYPE " + std::string(name) + " " + ctype + "\n";
+    out += std::string(name) + " " + std::to_string(v) + "\n";
+  };
+  auto gauge = [&](const char* name, const char* help, const std::string& v) {
+    out += "# HELP " + std::string(name) + " " + help + "\n";
+    out += "# TYPE " + std::string(name) + " gauge\n";
+    out += std::string(name) + " " + v + "\n";
+  };
+  counter("tpu_pruner_trace_completed_total",
+          "Evaluation traces sealed into the retention ring", e.completed_total);
+  gauge("tpu_pruner_trace_retained",
+        "Traces currently retained (ring + pinned SLO breaches)",
+        std::to_string(e.ring.size() + e.pinned.size()));
+  gauge("tpu_pruner_trace_pinned",
+        "SLO-breaching traces pinned past normal ring eviction",
+        std::to_string(e.pinned.size()));
+  counter("tpu_pruner_trace_evicted_total",
+          "Traces evicted from the bounded ring (or abandoned before seal)",
+          e.evicted_total);
+  counter("tpu_pruner_slo_good_total",
+          "Actuations inside the --slo-detect-to-action-ms budget", e.slo_good);
+  counter("tpu_pruner_slo_bad_total",
+          "Actuations past the --slo-detect-to-action-ms budget", e.slo_bad);
+  counter("tpu_pruner_slo_breaches_total",
+          "Traces with at least one SLO-breaching actuation (each pinned)",
+          e.slo_breaches);
+  uint64_t total = e.slo_good + e.slo_bad;
+  gauge("tpu_pruner_slo_burn_ratio",
+        "Fraction of SLO budget burnt: bad / (good + bad) actuations",
+        fmt_double(total ? static_cast<double>(e.slo_bad) / total : 0.0));
+  return out;
+}
+
+void reset_for_test() {
+  Engine& e = engine();
+  std::lock_guard<std::mutex> lock(e.mu);
+  e.active.clear();
+  e.ring.clear();
+  e.pinned.clear();
+  e.completed_total = e.evicted_total = 0;
+  e.slo_good = e.slo_bad = e.slo_breaches = 0;
+  t_act = OpenActuation{};
+  g_enabled.store(false, std::memory_order_relaxed);
+  g_slo_ms.store(0, std::memory_order_relaxed);
+}
+
+}  // namespace tpupruner::trace

@@ -1,0 +1,243 @@
+// Action provenance traces (native/src/trace.cpp): the per-evaluation
+// span-tree engine behind --trace on. The parity contract (every hook a
+// no-op while off), the bounded retention ring, the SLO engine's
+// breach-pinning, and the lock discipline between producer begins,
+// consumer actuation ends, and serving-thread index reads are what the
+// daemon's byte-identity and /debug/traces surfaces lean on.
+#include "testing.hpp"
+
+#include <atomic>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpupruner/json.hpp"
+#include "tpupruner/trace.hpp"
+
+namespace trace = tpupruner::trace;
+using tpupruner::json::Value;
+
+namespace {
+
+// Drive one evaluation through its whole lifecycle: begin (root
+// backdated by lag_ms), one query phase span, `acts` actuation spans
+// (ended BEFORE arm — the incremental fast path enqueues first, so
+// pre-arm credit is load-bearing), then arm to seal.
+std::string complete_trace(uint64_t cycle, int64_t lag_ms, int acts) {
+  std::string id = trace::begin(cycle, "dirty", lag_ms, "");
+  trace::add_phase_span(cycle, "query", 0.0001);
+  for (int i = 0; i < acts; ++i) {
+    trace::actuation_begin(cycle, "ml/dep-" + std::to_string(i));
+    trace::actuation_end(cycle, "SCALED", false, "");
+  }
+  trace::arm(cycle, static_cast<size_t>(acts));
+  return id;
+}
+
+std::vector<std::string> span_names(const Value& doc) {
+  std::vector<std::string> names;
+  if (const Value* tree = doc.find("span_tree"); tree && tree->is_array()) {
+    for (const Value& s : tree->as_array()) names.push_back(s.get_string("name"));
+  }
+  return names;
+}
+
+bool contains(const std::vector<std::string>& names, const std::string& want) {
+  for (const auto& n : names)
+    if (n == want) return true;
+  return false;
+}
+
+struct TraceOffAtExit {
+  ~TraceOffAtExit() {
+    trace::configure(false, 0);
+    trace::reset_for_test();
+  }
+};
+
+}  // namespace
+
+TP_TEST(trace_off_every_hook_is_noop) {
+  trace::reset_for_test();
+  trace::configure(false, 0);
+  TP_CHECK_EQ(trace::begin(1, "cycle", 0, ""), std::string());
+  TP_CHECK_EQ(trace::trace_id_of(1), std::string());
+  TP_CHECK_EQ(trace::traceparent(1), std::string());
+  trace::add_phase_span(1, "query", 0.1);
+  trace::actuation_begin(1, "ml/x");
+  trace::thread_retry_event("kube_patch", "429", 0.1);
+  trace::actuation_end(1, "SCALED", false, "");
+  trace::arm(1, 1);
+  TP_CHECK(trace::capsule_stamp(1).is_null());
+  // "" keeps the /metrics scrape byte-identical with tracing off.
+  TP_CHECK_EQ(trace::render_metrics(false), std::string());
+  TP_CHECK_EQ(trace::render_metrics(true), std::string());
+}
+
+TP_TEST(trace_span_tree_has_phases_actuation_and_retry_events) {
+  TraceOffAtExit off;
+  trace::reset_for_test();
+  trace::configure(true, 0);
+  std::string id = trace::begin(42, "probe", 7, "");
+  TP_CHECK_EQ(id.size(), static_cast<size_t>(32));
+  TP_CHECK_EQ(trace::trace_id_of(42), id);
+  // The traceparent carries this trace's id, so fake_prom header
+  // assertions and histogram exemplars join on the retained tree.
+  TP_CHECK(trace::traceparent(42).find(id) != std::string::npos);
+  trace::add_phase_span(42, "query", 0.002);
+  trace::add_phase_span(42, "decode", 0.001);
+  trace::actuation_begin(42, "ml/dep-0");
+  trace::thread_retry_event("kube_patch", "429", 0.25);
+  trace::actuation_end(42, "SCALED", false, "");
+  trace::arm(42, 1);
+
+  std::string body = trace::trace_json(id);
+  TP_CHECK(!body.empty());
+  Value doc = Value::parse(body);
+  TP_CHECK_EQ(doc.get_string("trace_id"), id);
+  TP_CHECK_EQ(doc.get_string("trigger"), std::string("probe"));
+  const Value* root = doc.find("root");
+  TP_CHECK(root != nullptr);
+  TP_CHECK_EQ(root->get_string("name"), std::string("evaluate"));
+  TP_CHECK_EQ(root->find("ingress_lag_ms")->as_int(), static_cast<int64_t>(7));
+
+  auto names = span_names(doc);
+  TP_CHECK(contains(names, "query"));
+  TP_CHECK(contains(names, "decode"));
+  TP_CHECK(contains(names, "actuate"));
+  for (const Value& s : doc.find("span_tree")->as_array()) {
+    if (s.get_string("name") != "actuate") continue;
+    TP_CHECK_EQ(s.find("attrs")->get_string("identity"), std::string("ml/dep-0"));
+    const Value* events = s.find("events");
+    TP_CHECK(events != nullptr && events->is_array());
+    TP_CHECK_EQ(events->as_array().size(), static_cast<size_t>(1));
+    const Value& ev = events->as_array()[0];
+    TP_CHECK_EQ(ev.get_string("name"), std::string("retry"));
+    TP_CHECK_EQ(ev.find("attrs")->get_string("endpoint"), std::string("kube_patch"));
+    TP_CHECK_EQ(ev.find("attrs")->get_string("cause"), std::string("429"));
+    TP_CHECK_EQ(ev.find("attrs")->find("backoff_ms")->as_int(),
+                static_cast<int64_t>(250));
+    // Every child parents to the evaluation root — one tree, no orphans.
+    TP_CHECK_EQ(s.get_string("parent_span_id"), root->get_string("span_id"));
+  }
+}
+
+TP_TEST(trace_arm_zero_seals_with_no_actuation_spans) {
+  // Dry-run / SIGNAL_STALE / BROWNOUT evaluations still trace, with zero
+  // actuation spans — the chaos join test keys on this shape.
+  TraceOffAtExit off;
+  trace::reset_for_test();
+  trace::configure(true, 0);
+  std::string id = trace::begin(7, "timer", 0, "");
+  trace::add_phase_span(7, "query", 0.001);
+  trace::arm(7, 0);
+  Value doc = Value::parse(trace::trace_json(id));
+  TP_CHECK_EQ(doc.find("actuations")->as_int(), static_cast<int64_t>(0));
+  TP_CHECK(!contains(span_names(doc), "actuate"));
+  TP_CHECK(!doc.find("breached")->as_bool());
+}
+
+TP_TEST(trace_capsule_stamp_carries_spans_so_far) {
+  TraceOffAtExit off;
+  trace::reset_for_test();
+  trace::configure(true, 0);
+  std::string id = trace::begin(9, "anti_entropy", 0, "");
+  trace::add_phase_span(9, "query", 0.001);
+  Value stamp = trace::capsule_stamp(9);
+  TP_CHECK(stamp.is_object());
+  TP_CHECK_EQ(stamp.get_string("trace_id"), id);
+  TP_CHECK_EQ(stamp.get_string("trigger"), std::string("anti_entropy"));
+  const Value* spans = stamp.find("spans");
+  TP_CHECK(spans != nullptr && spans->is_array());
+  TP_CHECK_EQ(spans->as_array().size(), static_cast<size_t>(1));
+  TP_CHECK_EQ(spans->as_array()[0].get_string("name"), std::string("query"));
+  // Offsets are root-relative (normalized) — the offline waterfall and
+  // byte-identity normalization both depend on that, not wall clocks.
+  TP_CHECK(spans->as_array()[0].find("end_us")->as_int() >=
+           spans->as_array()[0].find("start_us")->as_int());
+  trace::arm(9, 0);
+  // Sealed → no longer open; the stamp is only for the recording cycle.
+  TP_CHECK(trace::capsule_stamp(9).is_null());
+}
+
+TP_TEST(trace_ring_bounded_and_eviction_counted) {
+  TraceOffAtExit off;
+  trace::reset_for_test();
+  trace::configure(true, 0);
+  for (uint64_t c = 1; c <= 300; ++c) complete_trace(c, 0, 0);
+  Value idx = trace::index_json();
+  TP_CHECK_EQ(idx.find("completed_total")->as_int(), static_cast<int64_t>(300));
+  TP_CHECK(idx.find("retained")->as_int() <= 256);
+  TP_CHECK_EQ(idx.find("evicted_total")->as_int(), static_cast<int64_t>(44));
+  // The index body is capped; the ring itself holds more.
+  TP_CHECK(idx.find("traces")->as_array().size() <= static_cast<size_t>(50));
+}
+
+TP_TEST(trace_slo_breach_pins_past_ring_eviction) {
+  TraceOffAtExit off;
+  trace::reset_for_test();
+  trace::configure(true, 100);  // 100 ms detect→action budget
+  // Root backdated 5 s: the actuation's root-relative latency breaches.
+  std::string bad = complete_trace(1, 5000, 1);
+  // Flood the ring well past kRingCap with fast (good) evaluations.
+  for (uint64_t c = 2; c <= 300; ++c) complete_trace(c, 0, 1);
+
+  std::string body = trace::trace_json(bad);
+  TP_CHECK(!body.empty());  // survived 299 completions behind it
+  Value doc = Value::parse(body);
+  TP_CHECK(doc.find("breached")->as_bool());
+  TP_CHECK(doc.find("pinned")->as_bool());
+  TP_CHECK(doc.find("worst_actuation_ms")->as_double() >= 100.0);
+
+  Value slo = trace::slo_summary();
+  TP_CHECK(slo.find("enabled")->as_bool());
+  TP_CHECK_EQ(slo.find("slo_ms")->as_int(), static_cast<int64_t>(100));
+  TP_CHECK_EQ(slo.find("bad")->as_int(), static_cast<int64_t>(1));
+  TP_CHECK_EQ(slo.find("good")->as_int(), static_cast<int64_t>(299));
+  TP_CHECK_EQ(slo.find("breaches")->as_int(), static_cast<int64_t>(1));
+  TP_CHECK(slo.find("burn_ratio")->as_double() > 0.0);
+  // Worst-first: the 5 s breach outranks every sub-ms good trace.
+  TP_CHECK_EQ(slo.find("worst")->as_array()[0].get_string("trace_id"), bad);
+
+  std::string metrics = trace::render_metrics(false);
+  TP_CHECK(metrics.find("tpu_pruner_slo_breaches_total 1") != std::string::npos);
+  TP_CHECK(metrics.find("tpu_pruner_trace_pinned 1") != std::string::npos);
+}
+
+TP_TEST(trace_concurrent_begin_end_export_eviction) {
+  // Producer begins + consumer actuation ends + serving-thread index and
+  // tree reads, all racing ring eviction — the tsan-trace tier runs this
+  // under ThreadSanitizer.
+  TraceOffAtExit off;
+  trace::reset_for_test();
+  trace::configure(true, 50);
+  std::atomic<bool> stop{false};
+  std::thread reader([&stop] {
+    while (!stop.load()) {
+      (void)trace::index_json();
+      (void)trace::slo_summary();
+      (void)trace::render_metrics(true);
+    }
+  });
+  std::vector<std::thread> workers;
+  for (int t = 0; t < 4; ++t) {
+    workers.emplace_back([t] {
+      for (int i = 0; i < 200; ++i) {
+        const uint64_t cycle = static_cast<uint64_t>(t) * 1000 + i + 1;
+        std::string id = trace::begin(cycle, "dirty", i % 3, "");
+        trace::add_phase_span(cycle, "query", 0.0001);
+        trace::actuation_begin(cycle, "ml/dep");
+        trace::thread_retry_event("kube_patch", "429", 0.01);
+        trace::actuation_end(cycle, "SCALED", false, "");
+        trace::arm(cycle, 1);
+        (void)trace::trace_json(id);
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  stop.store(true);
+  reader.join();
+  Value idx = trace::index_json();
+  TP_CHECK_EQ(idx.find("completed_total")->as_int(), static_cast<int64_t>(800));
+  TP_CHECK(idx.find("retained")->as_int() <= 256 + 64);
+}

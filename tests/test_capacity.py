@@ -618,7 +618,7 @@ def test_debug_index_lists_every_served_surface(built, tmp_path):
         member = fleet.add_member(
             "idx", idle_pods=1, slice_topology="2x2",
             extra_args=("--capacity", "on", "--watch-cache", "on",
-                        "--reconcile", "event",
+                        "--reconcile", "event", "--trace", "on",
                         "--flight-dir", str(tmp_path / "flight")))
         fleet.start_hub(poll_interval=1, stale_after=10)
         # Let one evaluation land so the per-provider routes (capacity,

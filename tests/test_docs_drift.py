@@ -514,6 +514,48 @@ def test_capacity_surfaces_documented(built):
             f"capacity surface {needle!r} missing from docs/OPERATIONS.md")
 
 
+def test_trace_surfaces_documented(built):
+    """The provenance-trace / SLO families come from the native canonical
+    list (trace::metric_families via tp_trace_metric_families) so a
+    family added to trace.cpp without a runbook row fails even though the
+    families render nothing with --trace off. The flags, the debug
+    endpoints, the analyze modes and the smoke/TSan recipes ride the
+    same guard."""
+    doc = OPERATIONS.read_text()
+    families = native.trace_metric_families()
+    assert len(families) >= 8
+    missing = [f for f in families if f not in doc]
+    assert not missing, (
+        f"trace metric families missing from docs/OPERATIONS.md: {missing} "
+        "— document each in the Observability table and the 'Tracing an "
+        "action' section")
+    needles = ("Tracing an action", "--trace on", "/debug/traces",
+               "--slo-detect-to-action-ms", "/debug/fleet/slo",
+               "analyze --trace", "--traces-url", "--slow", "waterfall",
+               "ingress_lag_ms", "trace_id", "traceparent",
+               "trace-smoke", "tsan-trace")
+    missing = [n for n in needles if n not in doc]
+    assert not missing, (
+        f"provenance-trace surfaces missing from docs/OPERATIONS.md: "
+        f"{missing} — document each in the 'Tracing an action' section")
+
+
+def test_trace_bench_summary_fields_documented():
+    """Trace bench summary fields must be in BENCH_FIELDS.md AND actually
+    emitted by bench.py — a drift on either side fails."""
+    bench_src = (REPO / "bench.py").read_text()
+    fields_doc = (REPO / "docs" / "BENCH_FIELDS.md").read_text()
+    for field in ("trace_overhead_ratio", "slo_breach_trace_retained",
+                  "shard_curve_speedups"):
+        assert f'"{field}"' in bench_src, f"bench.py no longer emits {field}"
+        assert field in fields_doc, (
+            f"bench summary field {field} missing from docs/BENCH_FIELDS.md")
+    # the 1-core marker is load-bearing (the multi-core residual's
+    # explicit skip) — pin it in both places
+    assert 'skipped (1-core host)' in bench_src
+    assert 'skipped (1-core host)' in fields_doc
+
+
 def test_capacity_bench_summary_fields_documented():
     """The capacity bench summary fields must be emitted by bench.py AND
     described in BENCH_FIELDS.md."""

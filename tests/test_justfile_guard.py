@@ -426,6 +426,45 @@ def test_event_smoke_recipe_present_and_wired():
     assert callable(module.main)
 
 
+def test_trace_smoke_recipe_present_and_wired():
+    """`just trace-smoke` must exist and invoke the real smoke module —
+    the provenance-trace contract (SLO breach pins the trace, fetch by
+    id at /debug/traces/<id>, waterfall render live + offline) would
+    otherwise go unguarded in CI."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^trace-smoke\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `trace-smoke:` recipe"
+    assert "tpu_pruner.testing.trace_smoke" in m.group(1), (
+        "trace-smoke no longer invokes tpu_pruner.testing.trace_smoke")
+    import importlib
+
+    module = importlib.import_module("tpu_pruner.testing.trace_smoke")
+    assert callable(module.main)
+
+
+def test_tsan_trace_recipe_present_and_wired():
+    """`just tsan-trace` must exist and run the trace-engine native tests
+    under ThreadSanitizer — consumer threads end actuation spans and seal
+    traces while the producer begins new ones and the metrics thread
+    reads the /debug/traces index against ring eviction; exactly the
+    concurrency TSan exists to check."""
+    text = (REPO / "justfile").read_text()
+    m = re.search(r"^tsan-trace\s*:[^\n]*\n((?:[ \t]+\S[^\n]*\n?)+)", text,
+                  re.M)
+    assert m, "justfile has no `tsan-trace:` recipe"
+    body = m.group(1)
+    assert "-DTP_TSAN=ON" in body, "tsan-trace no longer builds with TSan"
+    assert re.search(r"tpupruner_tests\s+trace", body), (
+        "tsan-trace no longer runs the native trace tests")
+    assert re.search(r"tpupruner_tests\s+informer", body), (
+        "tsan-trace no longer runs the native informer tests")
+    src = (REPO / "native" / "tests" / "test_trace.cpp").read_text()
+    assert "trace_concurrent_begin_end_export_eviction" in src, (
+        "test_trace.cpp lost its concurrency test — tsan-trace would "
+        "vacuously pass")
+
+
 def test_tsan_event_recipe_present_and_wired():
     """`just tsan-event` must exist and run the timer-wheel + token
     bucket native tests under ThreadSanitizer — the dispatcher advances

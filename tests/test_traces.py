@@ -1,0 +1,536 @@
+"""Action provenance traces (the ISSUE 19 observability tentpole).
+
+``--trace on`` builds ONE causal span tree per evaluation, rooted at
+trigger ingress, with child spans for every phase, per-shard resolve,
+and per-actuation patch (retries as span events). The contract pinned
+here:
+
+  - audit JSONL and flight capsules are BYTE-IDENTICAL with ``--trace
+    on`` and ``off``, at shards 1 and 8 × both reconcile modes (the
+    capsule's normalized ``trace`` stamp is mode metadata, normalized
+    away exactly like ``incremental`` / ``reconcile``);
+  - histogram trace-id exemplars resolve to REAL retained traces at
+    /debug/traces/<id> — no more dangling exemplar ids;
+  - the concurrent evidence-query thread carries the SAME trace id as
+    the idleness query (the PR 9 helper-thread propagation fix);
+  - ``--slo-detect-to-action-ms`` pins every breaching trace past ring
+    eviction and the hub rolls per-member burn into /debug/fleet/slo;
+  - under a seeded fault storm every SCALED actuation has a complete
+    retained trace whose root duration matches the paired
+    detect_to_action observation and whose retry span events match the
+    faults that fired; SIGNAL_STALE / BROWNOUT evaluations trace with
+    ZERO actuation spans.
+"""
+
+import json
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tpu_pruner.native import DAEMON_PATH
+from tpu_pruner.testing import FakeK8s, FakePrometheus
+
+TRACEPARENT_RE = re.compile(r"^00-([0-9a-f]{32})-[0-9a-f]{16}-[0-9a-f]{2}$")
+
+# The event-reconcile volatile set plus the capsule's "trace" stamp:
+# provenance metadata that legitimately exists only with --trace on,
+# normalized away like "incremental" and "reconcile".
+VOLATILE_KEYS = {"ts", "ts_unix", "ts_ms", "now_unix", "trace_id", "id",
+                 "incremental", "reconcile", "trace"}
+
+
+@pytest.fixture()
+def fake_prom():
+    f = FakePrometheus()
+    f.start()
+    yield f
+    f.stop()
+
+
+@pytest.fixture()
+def fake_k8s():
+    f = FakeK8s()
+    f.start()
+    yield f
+    f.stop()
+
+
+def _normalize(obj):
+    if isinstance(obj, dict):
+        return {k: _normalize(v) for k, v in obj.items()
+                if k not in VOLATILE_KEYS}
+    if isinstance(obj, list):
+        return [_normalize(v) for v in obj]
+    return obj
+
+
+def run_daemon(fake_prom, fake_k8s, *extra, run_mode="scale-down", cycles=2,
+               interval=1):
+    cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+           "--prometheus-token", "tr-test", "--run-mode", run_mode,
+           "--daemon-mode", "--check-interval", str(interval),
+           "--max-cycles", str(cycles), *extra]
+    proc = subprocess.run(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc
+
+
+class TracedDaemon:
+    """Daemon-mode run with --metrics-port auto; port parsed from stderr
+    (the test_metrics_http idiom), plus JSON debug-surface helpers."""
+
+    def __init__(self, fake_prom, fake_k8s, *extra_args):
+        cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url,
+               "--run-mode", "scale-down", "--daemon-mode",
+               "--check-interval", "60", "--metrics-port", "auto",
+               *extra_args]
+        self.proc = subprocess.Popen(
+            cmd, env={"KUBE_API_URL": fake_k8s.url},
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+        self.port = None
+        for line in self.proc.stderr:
+            m = re.search(r"serving /metrics on port (\d+)", line)
+            if m:
+                self.port = int(m.group(1))
+                break
+        assert self.port, "daemon never reported its metrics port"
+
+    def get(self, path, accept=None):
+        req = urllib.request.Request(f"http://127.0.0.1:{self.port}{path}")
+        if accept:
+            req.add_header("Accept", accept)
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.read().decode()
+
+    def get_json(self, path):
+        return json.loads(self.get(path))
+
+    def wait_until(self, predicate, timeout=45, what="condition"):
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                last = predicate()
+            except OSError:
+                last = None
+            if last:
+                return last
+            time.sleep(0.3)
+        raise AssertionError(f"{what} never held (last={last!r})")
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+        self.proc.wait(timeout=10)
+
+
+def _idle_cluster(fake_prom, fake_k8s, roots=2):
+    for i in range(roots):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"dep-{i}",
+                                                   tpu_chips=4)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml",
+                                      chips=4)
+
+
+# ── CLI surface ────────────────────────────────────────────────────────
+
+
+def test_trace_cli_validations(built, fake_prom, fake_k8s):
+    def expect_error(*args):
+        cmd = [str(DAEMON_PATH), "--prometheus-url", fake_prom.url, *args]
+        proc = subprocess.run(cmd, env={"KUBE_API_URL": fake_k8s.url},
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode != 0
+        return proc.stderr
+
+    assert "--trace" in expect_error("--trace", "sometimes")
+    assert "--trace on" in expect_error("--slo-detect-to-action-ms", "250")
+    assert "--slo-detect-to-action-ms" in expect_error(
+        "--trace", "on", "--slo-detect-to-action-ms", "-1")
+
+
+# ── THE acceptance: byte-identity with tracing on and off ──────────────
+
+
+def test_trace_on_off_byte_identical_both_modes_and_shards(
+        built, fake_prom, fake_k8s, tmp_path):
+    """The same cluster decided with --trace on and off — at shards 1 and
+    8, in both reconcile modes — produces byte-identical audit JSONL and
+    flight capsules once the normalized trace stamp (provenance metadata,
+    like `incremental`) is stripped. Tracing observes; it never decides."""
+    _idle_cluster(fake_prom, fake_k8s, roots=3)
+
+    outputs = {}
+    for shards in (1, 8):
+        for mode in ("cycle", "event"):
+            for trace in ("off", "on"):
+                audit = tmp_path / f"audit-{shards}-{mode}-{trace}.jsonl"
+                flight = tmp_path / f"flight-{shards}-{mode}-{trace}"
+                run_daemon(fake_prom, fake_k8s, "--shards", str(shards),
+                           "--watch-cache", "on", "--reconcile", mode,
+                           "--trace", trace,
+                           "--audit-log", str(audit),
+                           "--flight-dir", str(flight),
+                           run_mode="dry-run", cycles=3)
+                records = [_normalize(json.loads(line))
+                           for line in audit.read_text().splitlines()]
+                capsules = [json.loads(p.read_text())
+                            for p in sorted(flight.glob("cycle-*.json"))]
+                assert records and len(capsules) == 3
+                # The stamp exists exactly when tracing is on — and only
+                # as normalized (root-relative) offsets.
+                for c in capsules:
+                    if trace == "on":
+                        assert len(c["trace"]["trace_id"]) == 32
+                        assert isinstance(c["trace"]["spans"], list)
+                    else:
+                        assert "trace" not in c
+                outputs[(shards, mode, trace)] = (
+                    json.dumps(records, sort_keys=True),
+                    json.dumps([_normalize(c) for c in capsules],
+                               sort_keys=True))
+
+    for shards in (1, 8):
+        for mode in ("cycle", "event"):
+            off = outputs[(shards, mode, "off")]
+            on = outputs[(shards, mode, "on")]
+            assert off[0] == on[0], \
+                f"audit JSONL differs at {shards} shard(s), {mode} mode"
+            assert off[1] == on[1], \
+                f"capsules differ at {shards} shard(s), {mode} mode"
+
+
+# ── exemplars resolve to retained traces ───────────────────────────────
+
+
+def test_histogram_exemplars_resolve_at_debug_traces(built, fake_prom,
+                                                     fake_k8s):
+    """Every trace-id exemplar on cycle_phase_seconds /
+    detect_to_action_seconds resolves to a real retained trace at
+    /debug/traces/<id> — with the OTLP exporter OFF, so the ids come from
+    the trace engine itself."""
+    _idle_cluster(fake_prom, fake_k8s)
+    d = TracedDaemon(fake_prom, fake_k8s, "--watch-cache", "on",
+                     "--reconcile", "event", "--trace", "on")
+    try:
+        d.wait_until(lambda: d.get_json("/debug/traces")
+                     .get("completed_total", 0) > 0,
+                     what="first trace sealed")
+
+        def _all_exemplars_resolve():
+            # Re-scrape each attempt: an exemplar can briefly point at an
+            # evaluation that observed its phase but hasn't sealed yet;
+            # a 404 (HTTPError ⊂ OSError) retries via wait_until.
+            body = d.get("/metrics", accept="application/openmetrics-text")
+            ids = set()
+            for family in ("tpu_pruner_cycle_phase_seconds",
+                           "tpu_pruner_detect_to_action_seconds"):
+                ids |= set(re.findall(
+                    family
+                    + r'_bucket\{[^}]*\} \d+ # \{trace_id="([0-9a-f]{32})"\}',
+                    body))
+            if not ids:
+                return None
+            for trace_id in ids:
+                doc = d.get_json(f"/debug/traces/{trace_id}")
+                assert doc["trace_id"] == trace_id
+                assert doc["span_tree"], trace_id
+            return len(ids)
+
+        resolved = d.wait_until(_all_exemplars_resolve,
+                                what="every exemplar id resolves")
+        assert resolved > 0
+    finally:
+        d.stop()
+
+
+# ── satellite 1: the concurrent evidence-query thread ──────────────────
+
+
+def test_evidence_thread_carries_the_same_trace_id(built, fake_prom,
+                                                   fake_k8s):
+    """Both concurrent Prometheus streams of one evaluation — the
+    idleness query (producer thread) and the evidence query (the PR 9
+    helper thread) — carry the evaluation's trace id. Before the
+    per-thread override covered the helper thread, the evidence stream
+    carried no traceparent at all with OTLP off."""
+    _idle_cluster(fake_prom, fake_k8s, roots=1)
+    run_daemon(fake_prom, fake_k8s, "--signal-guard", "on",
+               "--trace", "on", run_mode="dry-run", cycles=1)
+
+    tps = fake_prom.traceparents
+    assert len(tps) == 2, tps  # idleness + evidence, one evaluation
+    assert all(t and TRACEPARENT_RE.match(t) for t in tps), tps
+    trace_ids = {TRACEPARENT_RE.match(t).group(1) for t in tps}
+    assert len(trace_ids) == 1, f"streams diverged: {tps}"
+
+
+def test_no_traceparent_with_trace_off(built, fake_prom, fake_k8s):
+    """Parity: with --trace off (and no OTLP) neither stream grows a
+    header — the scrape surface stays byte-identical to pre-trace
+    builds."""
+    _idle_cluster(fake_prom, fake_k8s, roots=1)
+    run_daemon(fake_prom, fake_k8s, "--signal-guard", "on",
+               run_mode="dry-run", cycles=1)
+    assert all(t is None for t in fake_prom.traceparents), \
+        fake_prom.traceparents
+
+
+# ── SLO engine: breach pinning + fleet rollup ──────────────────────────
+
+
+def test_slo_breach_pins_trace_and_rolls_into_fleet_slo(built, tmp_path):
+    """A 1 ms detect→action budget: the first actuated evaluation
+    breaches, the trace pins past eviction, tpu_pruner_slo_* metrics
+    burn, and the hub rolls the member's burn + worst trace into
+    /debug/fleet/slo."""
+    from tpu_pruner.testing.fake_fleet import FakeFleet
+    with FakeFleet(tmp_path) as fleet:
+        member = fleet.add_member(
+            "slo-east", idle_pods=1,
+            extra_args=("--trace", "on", "--slo-detect-to-action-ms", "1"))
+        fleet.start_hub(poll_interval=1, stale_after=10)
+
+        def _breached():
+            doc = member.get_json("/debug/traces")
+            slo = doc.get("slo", {})
+            if (doc.get("pinned", 0) > 0 and slo.get("breaches", 0) > 0
+                    and any(w.get("breached") for w in slo.get("worst", []))):
+                return doc
+            return None
+
+        deadline = time.time() + 45
+        index = None
+        while time.time() < deadline and index is None:
+            try:
+                index = _breached()
+            except OSError:
+                pass
+            time.sleep(0.3)
+        assert index, "SLO breach never pinned a trace"
+        assert index["slo"]["enabled"] and index["slo"]["slo_ms"] == 1
+        assert index["slo"]["burn_ratio"] > 0
+        breach = next(w for w in index["slo"]["worst"] if w["breached"])
+
+        # The pinned trace resolves with its actuation span and breach
+        # flags — the 3am "why was this slow" evidence.
+        trace = member.get_json(f"/debug/traces/{breach['trace_id']}")
+        assert trace["breached"] and trace["pinned"]
+        assert any(s["name"] == "actuate" for s in trace["span_tree"])
+        assert trace["worst_actuation_ms"] >= 1
+
+        # The member's /metrics burn.
+        metrics = member.get("/metrics")
+        assert re.search(
+            r"tpu_pruner_slo_breaches_total(\{[^}]*\})? [1-9]", metrics)
+        assert re.search(
+            r"tpu_pruner_trace_pinned(\{[^}]*\})? [1-9]", metrics)
+
+        # The hub rollup: per-member burn + cluster-stamped worst trace.
+        deadline = time.time() + 45
+        rollup = None
+        while time.time() < deadline:
+            try:
+                doc = fleet.hub_get_json("/debug/fleet/slo")
+                if (doc.get("fleet_totals", {}).get("breaches", 0) > 0
+                        and any(w.get("breached")
+                                for w in doc.get("worst", []))):
+                    rollup = doc
+                    break
+            except OSError:
+                pass
+            time.sleep(0.3)
+        assert rollup, "hub never rolled the member's SLO burn up"
+        assert rollup["members_reporting"] >= 1
+        row = next(c for c in rollup["clusters"]
+                   if c["cluster"] == "slo-east")
+        assert row["slo"]["breaches"] >= 1
+        fleet_breach = next(w for w in rollup["worst"] if w["breached"])
+        assert fleet_breach["cluster"] == "slo-east"
+        assert fleet_breach["trace_id"] == trace["trace_id"]
+        assert rollup["fleet_totals"]["burn_ratio"] > 0
+
+
+# ── satellite 3: trace↔capsule join under a seeded fault storm ─────────
+
+
+def test_chaos_storm_every_scaled_actuation_has_a_complete_trace(
+        built, fake_prom, fake_k8s, tmp_path):
+    """Event-mode storm (seeded 429s on the PATCH path): every SCALED
+    actuation still seals a complete retained trace; the retry span
+    events on its actuate spans match the faults that fired; and the
+    detect_to_action exemplar's value matches the trace's own root
+    duration (the exemplar IS the paired observation)."""
+    _idle_cluster(fake_prom, fake_k8s, roots=2)
+    flight = tmp_path / "flight"
+    fake_k8s.inject([
+        {"fault": "status", "code": 429, "retry_after": "1",
+         "match": r"/scale$", "method": "PATCH", "times": 2},
+    ])
+    d = TracedDaemon(fake_prom, fake_k8s, "--watch-cache", "on",
+                     "--reconcile", "event", "--trace", "on",
+                     "--flight-dir", str(flight))
+    try:
+        d.wait_until(
+            lambda: sum(t.get("actuations", 0)
+                        for t in d.get_json("/debug/traces")
+                        .get("traces", [])) >= 2,
+            what="both roots actuated with traces sealed")
+
+        def _join_capsules():
+            # A capsule seals microseconds before its trace does — a 404
+            # on the join (HTTPError ⊂ OSError) retries via wait_until.
+            scaled_cycles = 0
+            retry_events = 0
+            for p in sorted(flight.glob("cycle-*.json")):
+                capsule = json.loads(p.read_text())
+                scaled = [rec for rec in capsule.get("decisions", [])
+                          if rec.get("reason") == "SCALED"]
+                if not scaled:
+                    continue
+                scaled_cycles += 1
+                assert "trace" in capsule, p.name
+                trace = d.get_json(
+                    f"/debug/traces/{capsule['trace']['trace_id']}")
+                acts = [s for s in trace["span_tree"]
+                        if s["name"] == "actuate"]
+                assert len(acts) == len(scaled), (p.name,
+                                                  trace["span_tree"])
+                for s in acts:
+                    retry_events += sum(1 for ev in s.get("events", [])
+                                        if ev["name"] == "retry")
+            return (scaled_cycles, retry_events) if scaled_cycles else None
+
+        scaled_cycles, retry_events = d.wait_until(
+            _join_capsules, what="every SCALED capsule joins its trace")
+        patch_faults = [f for f in fake_k8s.faults_fired if f[0] == "status"]
+        assert retry_events == len(patch_faults) == 2, \
+            (retry_events, fake_k8s.faults_fired)
+
+        def _join_exemplars():
+            # The exemplar's recorded value must match the resolved
+            # trace's own root duration — the exemplar IS the paired
+            # detect_to_action observation.
+            body = d.get("/metrics", accept="application/openmetrics-text")
+            pairs = dict(re.findall(
+                r'tpu_pruner_detect_to_action_seconds_bucket\{[^}]*\} \d+ '
+                r'# \{trace_id="([0-9a-f]{32})"\} ([0-9.e+-]+)', body))
+            if not pairs:
+                return None
+            for trace_id, value in pairs.items():
+                doc = d.get_json(f"/debug/traces/{trace_id}")
+                root_s = doc["root"]["duration_ms"] / 1000.0
+                # The observation lands just before the trace seals; the
+                # root then extends to the LAST actuation's end. Same
+                # scale, small skew.
+                assert abs(root_s - float(value)) < 1.0, \
+                    (trace_id, value, root_s)
+            return len(pairs)
+
+        joined = d.wait_until(_join_exemplars,
+                              what="detect_to_action exemplars join")
+        assert joined > 0
+    finally:
+        d.stop()
+
+
+def test_stale_and_brownout_evaluations_trace_with_zero_actuations(
+        built, fake_prom, fake_k8s):
+    """Evidence the signal guard distrusts vetoes actuation — the
+    evaluation still traces (the veto is an outcome worth explaining)
+    but with ZERO actuation spans."""
+    # Two roots whose newest samples are hours old: per-pod SIGNAL_STALE
+    # and coverage 0 → brownout.
+    for i in range(2):
+        _, _, pods = fake_k8s.add_deployment_chain("ml", f"stale-{i}",
+                                                   tpu_chips=4)
+        fake_prom.add_idle_pod_series(pods[0]["metadata"]["name"], "ml",
+                                      chips=4, last_sample_age=4000.0)
+    d = TracedDaemon(fake_prom, fake_k8s, "--signal-guard", "on",
+                     "--trace", "on")
+    try:
+        index = d.wait_until(
+            lambda: (lambda doc:
+                     doc if doc.get("completed_total", 0) >= 1 else None)(
+                d.get_json("/debug/traces")),
+            what="vetoed evaluation sealed its trace")
+        assert index["traces"], index
+        for summary in index["traces"]:
+            assert summary["actuations"] == 0, summary
+            trace = d.get_json(f"/debug/traces/{summary['trace_id']}")
+            assert not any(s["name"] == "actuate"
+                           for s in trace["span_tree"]), trace
+            # The tree still explains the evaluation: phases traced.
+            names = {s["name"] for s in trace["span_tree"]}
+            assert "query" in names and "signal" in names, names
+    finally:
+        d.stop()
+
+
+# ── analyze surfaces ───────────────────────────────────────────────────
+
+
+def test_analyze_trace_and_slow_modes(built, fake_prom, fake_k8s, tmp_path):
+    """`analyze --trace` renders a waterfall from a live trace id, a bare
+    daemon URL, or an offline capsule; `analyze --slow` lists the worst
+    retained traces. Mutual exclusion with the other report modes is a
+    parser error."""
+    _idle_cluster(fake_prom, fake_k8s, roots=1)
+    flight = tmp_path / "flight"
+    d = TracedDaemon(fake_prom, fake_k8s, "--trace", "on",
+                     "--flight-dir", str(flight))
+    try:
+        d.wait_until(lambda: d.get_json("/debug/traces")
+                     .get("completed_total", 0) > 0,
+                     what="first trace sealed")
+        url = f"http://127.0.0.1:{d.port}"
+
+        def analyze(*argv):
+            return subprocess.run(
+                [sys.executable, "-m", "tpu_pruner.analyze", *argv],
+                capture_output=True, text=True, timeout=120)
+
+        # Bare URL → newest retained trace.
+        proc = analyze("--trace", url)
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert len(doc["trace_id"]) == 32
+        assert "timeline" in proc.stderr  # the waterfall table header
+
+        # By id (+ --traces-url).
+        proc = analyze("--trace", doc["trace_id"], "--traces-url", url)
+        assert proc.returncode == 0, proc.stderr
+        assert json.loads(proc.stdout)["trace_id"] == doc["trace_id"]
+
+        # --slow over the index.
+        proc = analyze("--slow", url)
+        assert proc.returncode == 0, proc.stderr
+        slow = json.loads(proc.stdout)
+        assert slow["retained"] >= 1 and slow["traces"]
+
+        # A missing id without --traces-url is a usage error, not a
+        # stack trace.
+        proc = analyze("--trace", "0" * 32)
+        assert proc.returncode == 1
+        assert "--traces-url" in proc.stderr
+
+        # Mode mutual exclusion.
+        proc = analyze("--trace", url, "--slow", url)
+        assert proc.returncode == 2
+        assert "mutually exclusive" in proc.stderr
+    finally:
+        d.stop()
+
+    # Offline: the capsule's trace stamp renders without the daemon.
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_pruner.analyze", "--trace", str(flight)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    offline = json.loads(proc.stdout)
+    assert len(offline["trace_id"]) == 32
+    assert "timeline" in proc.stderr

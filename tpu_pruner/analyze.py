@@ -579,6 +579,175 @@ def _run_signal_report(args) -> int:
     return 0
 
 
+def _fetch_json(url: str):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.load(resp)
+
+
+def _render_waterfall(total_us: int, spans: list[dict]) -> None:
+    """ASCII span waterfall to stderr: one row per span, bars positioned
+    on a shared timeline whose width is the root span's duration."""
+    width = 48
+    total = max(int(total_us), 1)
+    print(f"\n{'span':16s} {'start ms':>9s} {'dur ms':>9s}  timeline",
+          file=sys.stderr)
+    for s in sorted(spans, key=lambda s: (s.get("start_us", 0),
+                                          s.get("end_us", 0))):
+        start = int(s.get("start_us", 0))
+        end = max(int(s.get("end_us", 0)), start)
+        lo = min(int(width * start / total), width - 1)
+        hi = max(lo + 1, min(int(-(-width * end // total)), width))
+        bar = "." * lo + "#" * (hi - lo) + "." * (width - hi)
+        extra = []
+        attrs = s.get("attrs") or {}
+        for k, v in attrs.items():
+            extra.append(f"{k}={v}")
+        events = s.get("events") or []
+        if events:
+            extra.append(f"{len(events)} event(s): "
+                         + ",".join(e.get("name", "?") for e in events[:4])
+                         + ("…" if len(events) > 4 else ""))
+        if s.get("error"):
+            extra.append(f"ERROR {s.get('error_message', '')}".rstrip())
+        print(f"{s.get('name', '?'):16s} {start / 1000:9.2f} "
+              f"{(end - start) / 1000:9.2f}  |{bar}|"
+              + (f"  {' '.join(extra)}" if extra else ""), file=sys.stderr)
+
+
+def _render_decision_join(decisions: list[dict]) -> None:
+    if not decisions:
+        return
+    print(f"\ncapsule decisions ({len(decisions)}):", file=sys.stderr)
+    for d in decisions:
+        pod = f"{d.get('namespace', '?')}/{d.get('pod', '?')}"
+        print(f"  {pod:48s} {d.get('reason', '?'):>16s} "
+              f"{d.get('action', 'none')}", file=sys.stderr)
+
+
+def _run_trace(args) -> int:
+    """Waterfall one provenance trace: a retained trace fetched from the
+    daemon's /debug/traces ring (by id or URL), or the offline `trace`
+    stamp a flight-recorder capsule carries — joined with the capsule's
+    decision records when they travel together."""
+    import re as _re
+
+    source = args.trace
+    doc = None
+    decisions: list[dict] = []
+    if _re.fullmatch(r"[0-9a-f]{32}", source):
+        if not args.traces_url:
+            print("--trace <id> needs --traces-url pointing at the daemon's "
+                  "metrics port (e.g. http://host:8080) — ids alone don't "
+                  "say which ring to search", file=sys.stderr)
+            return 1
+        base = args.traces_url.rstrip("/")
+        root = base.rsplit("/debug/", 1)[0] if "/debug/" in base else base
+        doc = _fetch_json(f"{root}/debug/traces/{source}")
+    elif source.startswith(("http://", "https://")):
+        base = source.rstrip("/")
+        if "/debug/traces/" in base:  # a full per-trace URL
+            doc = _fetch_json(base)
+        else:
+            index_url = base if "/debug/" in base else base + "/debug/traces"
+            index = _fetch_json(index_url)
+            traces = index.get("traces", [])
+            if not traces:
+                print("daemon retains no completed traces yet"
+                      + ("" if index.get("enabled", True)
+                         else " — run it with --trace on"), file=sys.stderr)
+                return 1
+            root = index_url.rsplit("/debug/", 1)[0]
+            doc = _fetch_json(f"{root}/debug/traces/{traces[0]['trace_id']}")
+    else:
+        capsules = [c for c in _load_gym_capsules(source) if c.get("trace")]
+        if not capsules:
+            print(f"no capsule at {source} carries a trace stamp — the "
+                  "recording daemon ran without --trace on", file=sys.stderr)
+            return 1
+        capsule = capsules[-1]  # newest stamped cycle in a flight-dir
+        stamp = capsule["trace"]
+        spans = stamp.get("spans", [])
+        total_us = max([int(s.get("end_us", 0)) for s in spans] + [1])
+        doc = {"trace_id": stamp.get("trace_id"),
+               "cycle": capsule.get("cycle"),
+               "trigger": stamp.get("trigger"),
+               "root_ms": total_us / 1000.0,
+               "root": {"name": "evaluate", "duration_ms": total_us / 1000.0},
+               "span_tree": spans,
+               "source": {"capsule": capsule.get("id")}}
+        decisions = capsule.get("decisions", [])
+
+    if doc.get("cycle") is not None and not decisions \
+            and source.startswith(("http://", "https://")):
+        # Same daemon records capsules too? Join on the cycle id; a
+        # daemon running without --flight-dir just 404s here.
+        try:
+            root = source.rstrip("/")
+            root = root.rsplit("/debug/", 1)[0] if "/debug/" in root else root
+            capsule = _fetch_json(f"{root}/debug/cycles/{doc['cycle']}")
+            decisions = capsule.get("decisions", [])
+        except Exception:
+            pass
+
+    root_span = doc.get("root", {})
+    total_ms = root_span.get("duration_ms", doc.get("root_ms", 0.0))
+    print(f"trace {doc.get('trace_id', '?')}  cycle {doc.get('cycle', '?')}  "
+          f"trigger={doc.get('trigger', '?')}  root {total_ms:.2f}ms"
+          + (f"  ingress lag {root_span['ingress_lag_ms']}ms"
+             if root_span.get("ingress_lag_ms") else "")
+          + ("  ** SLO BREACH (pinned) **" if doc.get("breached") else ""),
+          file=sys.stderr)
+    _render_waterfall(int(total_ms * 1000), doc.get("span_tree", []))
+    _render_decision_join(decisions)
+    out = dict(doc)
+    if decisions:
+        out["decisions"] = decisions
+    print(json.dumps(out))
+    return 0
+
+
+def _run_slow(args) -> int:
+    """Worst retained traces + SLO burn from a daemon's /debug/traces
+    index (a bare http://host:port is expanded)."""
+    url = args.slow
+    if "/debug/" not in url:
+        url = url.rstrip("/") + "/debug/traces"
+    index = _fetch_json(url)
+    if index.get("enabled") is False:
+        print("tracing not enabled on this daemon — run it with --trace on",
+              file=sys.stderr)
+        return 1
+    slo = index.get("slo", {})
+    print(f"traces: {index.get('retained', 0)} retained "
+          f"({index.get('pinned', 0)} pinned), "
+          f"{index.get('completed_total', 0)} completed, "
+          f"{index.get('evicted_total', 0)} evicted", file=sys.stderr)
+    if slo.get("enabled"):
+        print(f"SLO {slo.get('slo_ms')}ms: {slo.get('breaches', 0)} "
+              f"breach(es), burn ratio {slo.get('burn_ratio', 0.0):.3f} "
+              f"({slo.get('bad', 0)} bad / "
+              f"{slo.get('good', 0) + slo.get('bad', 0)} total)",
+              file=sys.stderr)
+    worst = slo.get("worst") or sorted(
+        index.get("traces", []), key=lambda t: -t.get("root_ms", 0.0))[:5]
+    if worst:
+        print(f"\n{'trace id':34s} {'cycle':>7s} {'trigger':>12s} "
+              f"{'root ms':>10s} {'slo':>8s}", file=sys.stderr)
+        for t in worst:
+            print(f"{t.get('trace_id', '?'):34s} {t.get('cycle', 0):7d} "
+                  f"{t.get('trigger', '?'):>12s} {t.get('root_ms', 0.0):10.2f} "
+                  f"{'BREACH' if t.get('breached') else 'ok':>8s}",
+                  file=sys.stderr)
+        print("\ninspect one: python -m tpu_pruner.analyze --trace <id> "
+              "--traces-url " + args.slow.rstrip("/"), file=sys.stderr)
+    else:
+        print("no completed traces retained yet", file=sys.stderr)
+    print(json.dumps(index))
+    return 0
+
+
 def _load_ledger_sources(args) -> list[dict]:
     """Workload accounts from N ledger JSONL checkpoints and/or
     /debug/workloads endpoints (both flags are repeatable).
@@ -943,6 +1112,24 @@ def main(argv=None) -> int:
                              "brownout) from a flight-recorder capsule file/"
                              "URL or the daemon's /debug/signals endpoint "
                              "(a bare http://host:port is expanded)")
+    parser.add_argument("--trace", metavar="ID|SOURCE",
+                        help="waterfall mode: render one action-provenance "
+                             "trace as a span waterfall joined with the "
+                             "capsule's decision records. Accepts a 32-hex "
+                             "trace id (with --traces-url), a "
+                             "/debug/traces/<id> URL, a bare daemon URL "
+                             "(newest retained trace), or a --flight-dir "
+                             "directory / capsule file whose `trace` stamp "
+                             "renders offline")
+    parser.add_argument("--traces-url", metavar="URL",
+                        help="with --trace <id>: the daemon metrics port "
+                             "whose /debug/traces ring holds the id (e.g. "
+                             "http://host:8080)")
+    parser.add_argument("--slow", metavar="URL",
+                        help="slow-trace mode: list the worst retained "
+                             "traces and SLO budget burn from a daemon's "
+                             "/debug/traces index (a bare http://host:port "
+                             "is expanded)")
     parser.add_argument("--lookback-s", type=float, default=None,
                         help="override lookback seconds (default: dump value or 2100)")
     parser.add_argument("--hbm-threshold", type=float, default=None,
@@ -968,6 +1155,20 @@ def main(argv=None) -> int:
                         help="with --stream: discard STATE and start a fresh "
                              "window from this dump")
     args = parser.parse_args(argv)
+    if args.trace:
+        if (args.gym or args.replay or args.explain or args.fleet_report
+                or args.signal_report or args.capacity_report or args.slow):
+            parser.error("--trace is mutually exclusive with the other "
+                         "report modes")
+        return _run_trace(args)
+    if args.traces_url:
+        parser.error("--traces-url only applies with --trace")
+    if args.slow:
+        if (args.gym or args.replay or args.explain or args.fleet_report
+                or args.signal_report or args.capacity_report):
+            parser.error("--slow is mutually exclusive with the other "
+                         "report modes")
+        return _run_slow(args)
     if args.gym:
         if (args.replay or args.explain or args.fleet_report
                 or args.signal_report or args.capacity_report):

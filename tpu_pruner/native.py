@@ -154,6 +154,7 @@ def load() -> ctypes.CDLL:
         "tp_incremental_metric_families",
         "tp_wire_metric_families",
         "tp_store_metric_families",
+        "tp_trace_metric_families",
         "tp_compact_roundtrip",
         "tp_store_stats",
         "tp_wire_decode_k8s",
@@ -284,6 +285,14 @@ def store_metric_families() -> list[str]:
     family names served on /metrics — the docs drift-guard test joins
     this list against docs/OPERATIONS.md."""
     return _call("tp_store_metric_families", {})["families"]
+
+
+def trace_metric_families() -> list[str]:
+    """Canonical action-provenance trace/SLO (tpu_pruner_trace_* /
+    tpu_pruner_slo_*) metric family names served on /metrics with --trace
+    on — the docs drift-guard test joins this list against
+    docs/OPERATIONS.md."""
+    return _call("tp_trace_metric_families", {})["families"]
 
 
 def compact_roundtrip(obj_json: str | None = None, *, proto_body: bytes | None = None,
